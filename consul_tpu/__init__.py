@@ -1,0 +1,24 @@
+"""consul_tpu — a TPU-native framework with HashiCorp Consul's capabilities.
+
+Instead of porting Consul's goroutine-per-node Go design (reference at
+/root/reference), the core is a synchronous-parallel cluster simulator/oracle:
+the full membership, suspicion-timer, rumor-dissemination and RTT-coordinate
+state lives in device arrays and advances one gossip tick at a time inside a
+single jitted `step` function.  Host-side Python provides the Consul-shaped
+control plane (catalog, KV, health, HTTP API, CLI) around it.
+
+Layout (mirrors SURVEY.md §7 build plan):
+  models/    — simulation models: SWIM membership, Serf events, Vivaldi, AE
+  ops/       — tensor ops / Pallas kernels shared by the models
+  parallel/  — device mesh + sharding helpers (node-axis SPMD)
+  catalog/   — host-side state store (catalog/KV/sessions/health)
+  api/       — HTTP API (Consul /v1 shape)
+  utils/     — PRNG, clocks, metrics
+"""
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import swim
+
+__version__ = "0.1.0"
+
+__all__ = ["GossipConfig", "SimConfig", "swim", "__version__"]

@@ -1,0 +1,3 @@
+from consul_tpu.models import swim
+
+__all__ = ["swim"]

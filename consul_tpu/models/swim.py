@@ -1,0 +1,565 @@
+"""SWIM failure detection + infection-style dissemination as a tensor kernel.
+
+This is the TPU-native replacement for the hashicorp/memberlist engine that
+Consul builds its Serf LAN/WAN pools on (reference: go.mod:53; tuning surface
+agent/config/default.go:70-84; member-event consumption
+agent/consul/server_serf.go:203-255; Lifeguard description
+website/content/docs/architecture/gossip.mdx:45-60).  The SWIM/Lifeguard
+behavior is reconstructed from the published algorithms (Das et al. 2002;
+Dadgar et al., Lifeguard) — no reference code is translated.
+
+Design (SURVEY.md §7): instead of N goroutines with per-node O(N) views
+(O(N^2) state — 4TB at 1M nodes), the state is **rumor-centric**:
+
+  * ground truth per node: up/down, member/left, incarnation      — O(N)
+  * a fixed table of U active rumors (alive/suspect/dead/left)     — O(U)
+  * per-(node, rumor) knowledge, learn tick, retransmit budget     — O(N·U)
+
+One jitted `step(params, state)` advances every node one gossip tick:
+
+  probe round (every probe_interval/gossip_interval ticks)
+    → random direct probe, k indirect probes, timeouts sampled from a
+      factored coordinate RTT model (no N×N matrix)
+    → failed probes originate/confirm `suspect` rumors (Lifeguard timer
+      shortened by independent confirmations)
+  suspicion expiry → first expiring holder originates a `dead` rumor
+  refutation      → a live suspect bumps its incarnation, originates `alive`
+  dissemination   → every carrier gossips its queued rumors to
+      `gossip_nodes` random targets: 3 scatter-max ops over the [N, U]
+      knowledge matrix (the SpMV of SURVEY.md §2.1)
+  expiry          → fully-retransmitted rumors free their slot; `dead`/`left`
+      commit to the O(N) ground-truth belief baseline
+
+All shapes are static; control flow is `lax.cond`/`lax.scan`; randomness is
+counter-based (seed, tick, stream).  The node axis shards over a
+`jax.sharding.Mesh` — see consul_tpu/parallel/mesh.py.
+
+Known simplifications vs memberlist (documented, to refine):
+  * probe/gossip targets are uniform over all slots rather than a shuffled
+    ring over live-believed members (negligible until a large fraction of
+    the cluster is down);
+  * a rumor's payload always fits the packet (U is small);
+  * `dead` is terminal per subject — no rejoin-with-higher-incarnation yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.utils import prng
+
+# Rumor kinds (serf member lifecycle, consumed by the reference's leader
+# reconcile loop agent/consul/leader.go:1234-1432).
+ALIVE = 0
+SUSPECT = 1
+DEAD = 2
+LEFT = 3
+
+_NEG = jnp.int32(-1)
+
+
+@dataclasses.dataclass(frozen=True)
+class SwimParams:
+    """Static (hashable) parameters baked into the jitted step."""
+
+    n_nodes: int
+    rumor_slots: int
+    gossip_nodes: int
+    indirect_checks: int
+    probe_period_ticks: int
+    probe_timeout_ms: float
+    retransmit_limit: int
+    suspicion_min_ticks: int
+    suspicion_max_ticks: int
+    confirm_k: int
+    alloc_cap: int
+    expiry_gossip_ticks: int   # lifetime of alive/dead/left rumors
+    expiry_suspect_ticks: int  # lifetime of suspect rumors (> max timeout)
+    p_loss: float
+    rtt_base_ms: float
+    seed: int
+
+
+def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
+    n = sim.n_nodes
+    limit = gossip.retransmit_limit(n)
+    # A rumor is fully disseminated within ~O(log N) gossip ticks; keep the
+    # slot a few multiples of that so stragglers (lossy links) still hear it.
+    spread = max(8, 4 * math.ceil(math.log2(n + 1)))
+    return SwimParams(
+        n_nodes=n,
+        rumor_slots=sim.rumor_slots,
+        gossip_nodes=gossip.gossip_nodes,
+        indirect_checks=gossip.indirect_checks,
+        probe_period_ticks=gossip.probe_period_ticks,
+        probe_timeout_ms=gossip.probe_timeout * 1000.0,
+        retransmit_limit=limit,
+        suspicion_min_ticks=gossip.suspicion_min_ticks(n),
+        suspicion_max_ticks=gossip.suspicion_max_ticks(n),
+        confirm_k=gossip.confirm_k(),
+        alloc_cap=sim.alloc_cap,
+        expiry_gossip_ticks=spread,
+        expiry_suspect_ticks=gossip.suspicion_max_ticks(n) + spread,
+        p_loss=sim.p_loss,
+        rtt_base_ms=sim.rtt_base_ms,
+        seed=sim.seed,
+    )
+
+
+@struct.dataclass
+class SwimState:
+    """Full simulator state; a pytree of device arrays (N = nodes, U = slots)."""
+
+    tick: jnp.ndarray            # int32 scalar
+    # --- ground truth ---
+    up: jnp.ndarray              # [N] bool: process actually running
+    member: jnp.ndarray          # [N] bool: joined and not intentionally left
+    incarnation: jnp.ndarray     # [N] int32: self incarnation number
+    coords: jnp.ndarray          # [N, D] float32: latent latency-space coords (ms)
+    # --- committed (post-rumor) global belief baseline ---
+    committed_dead: jnp.ndarray  # [N] bool
+    committed_left: jnp.ndarray  # [N] bool
+    committed_inc: jnp.ndarray   # [N] int32: highest fully-disseminated alive
+    #                                 incarnation (refutations outlive their
+    #                                 rumor slot, like memberlist node tables)
+    # --- rumor table ---
+    r_active: jnp.ndarray        # [U] bool
+    r_kind: jnp.ndarray          # [U] int32 (ALIVE/SUSPECT/DEAD/LEFT)
+    r_subject: jnp.ndarray       # [U] int32
+    r_inc: jnp.ndarray           # [U] int32
+    r_start: jnp.ndarray         # [U] int32: origin tick
+    r_confirm: jnp.ndarray       # [U] int32: independent suspicion confirmations
+    # --- per (node, rumor) ---
+    know: jnp.ndarray            # [N, U] bool
+    learn_tick: jnp.ndarray      # [N, U] int32
+    sends_left: jnp.ndarray      # [N, U] int32
+
+
+def init_state(params: SwimParams, key=None) -> SwimState:
+    n, u = params.n_nodes, params.rumor_slots
+    if key is None:
+        key = jax.random.PRNGKey(params.seed ^ 0x5EEDF00D)
+    coords = jax.random.uniform(key, (n, 2), jnp.float32) * 30.0
+    return SwimState(
+        tick=jnp.int32(0),
+        up=jnp.ones((n,), bool),
+        member=jnp.ones((n,), bool),
+        incarnation=jnp.zeros((n,), jnp.int32),
+        coords=coords,
+        committed_dead=jnp.zeros((n,), bool),
+        committed_left=jnp.zeros((n,), bool),
+        committed_inc=jnp.zeros((n,), jnp.int32),
+        r_active=jnp.zeros((u,), bool),
+        r_kind=jnp.zeros((u,), jnp.int32),
+        r_subject=jnp.zeros((u,), jnp.int32),
+        r_inc=jnp.zeros((u,), jnp.int32),
+        r_start=jnp.zeros((u,), jnp.int32),
+        r_confirm=jnp.zeros((u,), jnp.int32),
+        know=jnp.zeros((n, u), bool),
+        learn_tick=jnp.zeros((n, u), jnp.int32),
+        sends_left=jnp.zeros((n, u), jnp.int32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# derived per-subject maps
+# ---------------------------------------------------------------------------
+
+def _subject_map(params: SwimParams, s: SwimState, kind: int, values) -> jnp.ndarray:
+    """Scatter rumor-table `values` into a dense [N] subject-indexed map.
+
+    Inactive/other-kind slots write -1; result is -1 where no rumor exists.
+    """
+    mask = s.r_active & (s.r_kind == kind)
+    subj = jnp.where(mask, s.r_subject, 0)
+    val = jnp.where(mask, values, _NEG)
+    return jnp.full((params.n_nodes,), -1, jnp.int32).at[subj].max(val)
+
+
+def _maps(params: SwimParams, s: SwimState):
+    u = params.rumor_slots
+    slots = jnp.arange(u, dtype=jnp.int32)
+    suspect_of = _subject_map(params, s, SUSPECT, slots)
+    dead_of = _subject_map(params, s, DEAD, slots)
+    left_of = _subject_map(params, s, LEFT, slots)
+    # alive map keeps the highest-incarnation alive rumor: value = inc*U + slot
+    alive_val = _subject_map(params, s, ALIVE, s.r_inc * u + slots)
+    return suspect_of, dead_of, left_of, alive_val
+
+
+def _row_gather(mat: jnp.ndarray, cols: jnp.ndarray):
+    """mat[i, cols[i]] with cols possibly -1 (returns False/0 there)."""
+    safe = jnp.clip(cols, 0, mat.shape[1] - 1)
+    got = jnp.take_along_axis(mat, safe[:, None], axis=1)[:, 0]
+    return jnp.where(cols >= 0, got, jnp.zeros((), mat.dtype))
+
+
+def _suspicion_timeout_ticks(params: SwimParams, confirm: jnp.ndarray) -> jnp.ndarray:
+    """Lifeguard: timer decays from max to min as confirmations arrive.
+
+    timeout = max - (max - min) * log(c+1)/log(k+1), floored at min.
+    """
+    mn = jnp.float32(params.suspicion_min_ticks)
+    mx = jnp.float32(params.suspicion_max_ticks)
+    frac = jnp.log(confirm.astype(jnp.float32) + 1.0) / math.log(params.confirm_k + 1.0)
+    t = mx - (mx - mn) * jnp.clip(frac, 0.0, 1.0)
+    return jnp.ceil(jnp.maximum(t, mn)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# belief queries (used by probe target filtering and by metrics)
+# ---------------------------------------------------------------------------
+
+def _believes_down_of(params: SwimParams, s: SwimState, maps, subj: jnp.ndarray,
+                      tick: jnp.ndarray) -> jnp.ndarray:
+    """[N] bool: does node i believe node subj[i] is dead or left?
+
+    A node believes a subject down when it (a) is committed dead/left,
+    (b) knows a dead/left rumor for it, or (c) holds an expired, unrefuted
+    suspicion for it.  Mirrors memberlist state precedence: alive with a
+    higher incarnation refutes suspect; dead is terminal.
+    """
+    suspect_of, dead_of, left_of, alive_val = maps
+    u = params.rumor_slots
+    down = s.committed_dead[subj] | s.committed_left[subj]
+    down |= _row_gather(s.know, dead_of[subj])
+    down |= _row_gather(s.know, left_of[subj])
+    # expired unrefuted suspicion
+    ss = suspect_of[subj]
+    know_s = _row_gather(s.know, ss)
+    learn = _row_gather(s.learn_tick, ss)
+    conf = s.r_confirm[jnp.clip(ss, 0, u - 1)]
+    expired = know_s & (tick - learn >= _suspicion_timeout_ticks(params, conf))
+    av = alive_val[subj]
+    a_slot = jnp.where(av >= 0, av % u, 0)
+    a_inc = jnp.where(av >= 0, av // u, -1)
+    s_inc = s.r_inc[jnp.clip(ss, 0, u - 1)]
+    refuted = (av >= 0) & (a_inc > s_inc) & _row_gather(s.know, jnp.where(av >= 0, a_slot, _NEG))
+    refuted |= s_inc < s.committed_inc[subj]
+    down |= expired & ~refuted
+    return down
+
+
+def believed_down_fraction(params: SwimParams, s: SwimState, subject: int) -> jnp.ndarray:
+    """Fraction of live members (excluding the subject) that believe `subject`
+    is down.  The convergence metric for the north-star benchmark."""
+    n = params.n_nodes
+    subj = jnp.full((n,), subject, jnp.int32)
+    down = _believes_down_of(params, s, _maps(params, s), subj, s.tick)
+    observer = s.up & s.member & (jnp.arange(n) != subject)
+    return jnp.sum(down & observer) / jnp.maximum(jnp.sum(observer), 1)
+
+
+# ---------------------------------------------------------------------------
+# rumor allocation / origination
+# ---------------------------------------------------------------------------
+
+def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
+               kind: int, inc_of_subject: jnp.ndarray, knower_cols_fn) -> SwimState:
+    """Allocate up to `alloc_cap` rumor slots for subjects with want_score > 0.
+
+    `inc_of_subject`: [N] int32 incarnation to record per subject.
+    `knower_cols_fn(subject) -> [N] bool`: which nodes know the new rumor at
+    birth (the originators).
+    """
+    a = params.alloc_cap
+    u = params.rumor_slots
+    n = params.n_nodes
+    score, subjects = jax.lax.top_k(want_score, a)
+    free_score, slots = jax.lax.top_k(jnp.where(s.r_active, 0, 1) *
+                                      (u - jnp.arange(u, dtype=jnp.int32)), a)
+    ok = (score > 0) & (free_score > 0)
+
+    r_active, r_kind, r_subject = s.r_active, s.r_kind, s.r_subject
+    r_inc, r_start, r_confirm = s.r_inc, s.r_start, s.r_confirm
+    know, learn_tick, sends_left = s.know, s.learn_tick, s.sends_left
+    slot_ids = jnp.arange(u, dtype=jnp.int32)
+    for i in range(a):
+        slot, subj, oki = slots[i], subjects[i], ok[i]
+        onehot = (slot_ids == slot) & oki
+        r_active = r_active | onehot
+        r_kind = jnp.where(onehot, kind, r_kind)
+        r_subject = jnp.where(onehot, subj, r_subject)
+        r_inc = jnp.where(onehot, inc_of_subject[subj], r_inc)
+        r_start = jnp.where(onehot, s.tick, r_start)
+        r_confirm = jnp.where(onehot, 1, r_confirm)
+        col = knower_cols_fn(subj) & oki                       # [N]
+        cell = col[:, None] & onehot[None, :]                  # [N, U]
+        know = know | cell
+        learn_tick = jnp.where(cell, s.tick, learn_tick)
+        sends_left = jnp.where(cell, params.retransmit_limit, sends_left)
+    return s.replace(r_active=r_active, r_kind=r_kind, r_subject=r_subject,
+                     r_inc=r_inc, r_start=r_start, r_confirm=r_confirm,
+                     know=know, learn_tick=learn_tick, sends_left=sends_left)
+
+
+# ---------------------------------------------------------------------------
+# step phases
+# ---------------------------------------------------------------------------
+
+def _probe_round(params: SwimParams, s: SwimState) -> SwimState:
+    """One SWIM probe round: direct probe + k indirect probes + suspicion.
+
+    Reference behavior: memberlist probe loop (probe_interval /
+    probe_timeout / indirect_checks — options.mdx:1509-1532).
+    """
+    n = params.n_nodes
+    tick = s.tick
+    kt = prng.tick_key(params.seed, tick, 1)
+    k_target, k_direct, k_relay, k_leg, k_rtt = jax.random.split(kt, 5)
+
+    maps = _maps(params, s)
+    prober = s.up & s.member
+    target = prng.other_nodes(k_target, n, (n,))
+    skip = _believes_down_of(params, s, maps, target, tick)
+    t_up = s.up[target] & s.member[target]
+
+    # direct probe: two UDP legs + RTT under probe_timeout
+    rtt = jnp.linalg.norm(s.coords - s.coords[target], axis=-1) + params.rtt_base_ms
+    rtt = rtt * (1.0 + jax.random.exponential(k_rtt, (n,)) * 0.1)
+    legs_ok = jax.random.bernoulli(k_direct, (1.0 - params.p_loss) ** 2, (n,))
+    ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
+
+    # k indirect probes through random relays (4 UDP legs each)
+    relays = prng.other_nodes(k_relay, n, (n, params.indirect_checks))
+    relay_ok = s.up[relays] & s.member[relays]
+    legs4 = jax.random.bernoulli(k_leg, (1.0 - params.p_loss) ** 4,
+                                 (n, params.indirect_checks))
+    ack |= (t_up & jnp.any(relay_ok & legs4, axis=-1))
+
+    failed = prober & ~skip & ~ack
+    # per-subject count of this round's new suspectors
+    cnt = jnp.zeros((n,), jnp.int32).at[jnp.where(failed, target, 0)].add(
+        failed.astype(jnp.int32))
+    suspect_of, dead_of, left_of, _ = _maps(params, s)
+
+    # (a) confirm existing suspicions (Lifeguard): each independent suspector
+    # this round shortens the timer; they also start carrying the rumor.
+    r_confirm = s.r_confirm + jnp.where(
+        s.r_active & (s.r_kind == SUSPECT), jnp.minimum(cnt[s.r_subject], 8), 0)
+    r_confirm = jnp.minimum(r_confirm, 64)
+    es = suspect_of[target]                                     # [N] existing slot
+    joiner = failed & (es >= 0)
+    cell = (jnp.clip(es, 0, params.rumor_slots - 1)[:, None] ==
+            jnp.arange(params.rumor_slots)[None, :]) & joiner[:, None]
+    know = s.know | cell
+    learn_tick = jnp.where(cell & ~s.know, tick, s.learn_tick)
+    sends_left = jnp.where(cell & ~s.know, params.retransmit_limit, s.sends_left)
+    s = s.replace(r_confirm=r_confirm, know=know, learn_tick=learn_tick,
+                  sends_left=sends_left)
+
+    # (b) originate new suspect rumors for subjects with no existing rumor
+    fresh = (cnt > 0) & (suspect_of < 0) & (dead_of < 0) & (left_of < 0) \
+        & ~s.committed_dead & ~s.committed_left
+    want = jnp.where(fresh, cnt, 0)
+
+    def knowers(subj):
+        return failed & (target == subj)
+
+    return _originate(params, s, want, SUSPECT, s.incarnation, knowers)
+
+
+def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
+    """Holders whose suspicion timer expired declare the subject dead; the
+    first expiry originates a `dead` rumor (memberlist: suspicion timeout
+    → markDead + broadcast)."""
+    n, u = params.n_nodes, params.rumor_slots
+    tick = s.tick
+    is_suspect = s.r_active & (s.r_kind == SUSPECT)
+    timeout = _suspicion_timeout_ticks(params, s.r_confirm)      # [U]
+    age = tick - s.learn_tick                                    # [N, U]
+    # refutation: an alive rumor for the same subject with higher incarnation
+    _, _, _, alive_val = _maps(params, s)
+    av = alive_val[s.r_subject]                                  # [U]
+    a_slot = jnp.where(av >= 0, av % u, 0)
+    a_inc = jnp.where(av >= 0, av // u, -1)
+    refutable = (av >= 0) & (a_inc > s.r_inc)                    # [U]
+    know_alive = jnp.take(s.know, a_slot, axis=1)                # [N, U]
+    refuted = refutable[None, :] & know_alive
+    refuted |= (s.r_inc < s.committed_inc[s.r_subject])[None, :]
+    observer = (s.up & s.member)[:, None]
+    expired = s.know & is_suspect[None, :] & (age >= timeout[None, :]) \
+        & ~refuted & observer                                    # [N, U]
+    any_exp = jnp.any(expired, axis=0)                           # [U]
+
+    suspect_of, dead_of, left_of, _ = _maps(params, s)
+    subj_exp = jnp.zeros((n,), bool).at[jnp.where(any_exp, s.r_subject, 0)].max(any_exp)
+    fresh = subj_exp & (dead_of < 0) & ~s.committed_dead
+    want = jnp.where(fresh, 1, 0)
+
+    def knowers(subj):
+        ss = suspect_of[subj]                                    # scalar slot
+        return jnp.where(ss >= 0, expired[:, jnp.clip(ss, 0, u - 1)], False)
+
+    return _originate(params, s, want, DEAD, s.incarnation, knowers)
+
+
+def _refutation(params: SwimParams, s: SwimState) -> SwimState:
+    """A live subject that hears it is suspected bumps its incarnation and
+    broadcasts alive (SWIM refutation; memberlist aliveNode)."""
+    u = params.rumor_slots
+    is_suspect = s.r_active & (s.r_kind == SUSPECT)
+    subj = s.r_subject
+    subject_knows = s.know[subj, jnp.arange(u)]                  # [U]
+    need = is_suspect & subject_knows & s.up[subj] & s.member[subj] \
+        & (s.r_inc >= s.incarnation[subj])
+    # bump incarnation above the suspected one
+    inc = s.incarnation.at[jnp.where(need, subj, 0)].max(
+        jnp.where(need, s.r_inc + 1, _NEG))
+    s = s.replace(incarnation=inc)
+
+    _, _, _, alive_val = _maps(params, s)
+    has_alive = alive_val[subj] >= 0                             # [U]
+    # in-place refresh of an existing alive rumor for this subject
+    refresh_slot = jnp.where(need & has_alive, alive_val[subj] % u, -1)  # [U]
+    refresh = jnp.zeros((u,), bool).at[jnp.clip(refresh_slot, 0, u - 1)].max(refresh_slot >= 0)
+    new_inc_of = s.incarnation                                    # [N]
+    if True:  # refresh existing alive slots
+        tgt_subj = s.r_subject                                    # [U]
+        r_inc = jnp.where(refresh, new_inc_of[tgt_subj], s.r_inc)
+        r_start = jnp.where(refresh, s.tick, s.r_start)
+        onehot_subj = (jnp.arange(params.n_nodes)[:, None] == tgt_subj[None, :])
+        cell_keep = ~refresh[None, :] & s.know
+        cell_new = refresh[None, :] & onehot_subj
+        know = cell_keep | cell_new
+        learn_tick = jnp.where(cell_new, s.tick, s.learn_tick)
+        sends_left = jnp.where(cell_new, params.retransmit_limit,
+                               jnp.where(refresh[None, :], 0, s.sends_left))
+        s = s.replace(r_inc=r_inc, r_start=r_start, know=know,
+                      learn_tick=learn_tick, sends_left=sends_left)
+
+    # allocate alive rumors for refuting subjects with no existing alive slot
+    want = jnp.zeros((params.n_nodes,), jnp.int32).at[
+        jnp.where(need & ~has_alive, subj, 0)].max(
+        jnp.where(need & ~has_alive, 1, 0))
+
+    def knowers(sj):
+        return jnp.arange(params.n_nodes) == sj
+
+    return _originate(params, s, want, ALIVE, s.incarnation, knowers)
+
+
+def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
+    """Piggyback gossip: every live carrier with budget sends its queued
+    rumors to `gossip_nodes` random targets (memberlist gossip interval /
+    gossip_nodes — options.mdx:1498-1508).  Three scatter-max ops."""
+    n, u = params.n_nodes, params.rumor_slots
+    tick = s.tick
+    key = prng.tick_key(params.seed, tick, 2)
+    targets = prng.other_nodes(key, n, (n, params.gossip_nodes))
+
+    # Senders need only be up (a gracefully-left node keeps gossiping its
+    # leave intent — serf LeavePropagateDelay, lib/serf/serf.go:26-30);
+    # receivers must be live members.
+    send = s.know & (s.sends_left > 0) & s.up[:, None]           # [N, U]
+    got = jnp.zeros((n, u), jnp.uint8)
+    send8 = send.astype(jnp.uint8)
+    for g in range(params.gossip_nodes):
+        got = got.at[targets[:, g]].max(send8)
+    received = (got > 0) & (s.up & s.member)[:, None] & s.r_active[None, :]
+    newly = received & ~s.know
+    know = s.know | newly
+    learn_tick = jnp.where(newly, tick, s.learn_tick)
+    sends_left = jnp.where(newly, params.retransmit_limit,
+                           jnp.where(send, jnp.maximum(
+                               s.sends_left - params.gossip_nodes, 0),
+                               s.sends_left))
+    return s.replace(know=know, learn_tick=learn_tick, sends_left=sends_left)
+
+
+def _expire(params: SwimParams, s: SwimState) -> SwimState:
+    """Free slots whose dissemination window has passed; commit dead/left
+    into the O(N) baseline (assumes full coverage — the dissemination window
+    is several multiples of the O(log N) spread time)."""
+    tick = s.tick
+    life = jnp.where(s.r_kind == SUSPECT,
+                     params.expiry_suspect_ticks, params.expiry_gossip_ticks)
+    done = s.r_active & (tick - s.r_start >= life)
+    commit_dead = done & (s.r_kind == DEAD)
+    commit_left = done & (s.r_kind == LEFT)
+    commit_alive = done & (s.r_kind == ALIVE)
+    committed_dead = s.committed_dead.at[
+        jnp.where(commit_dead, s.r_subject, 0)].max(commit_dead)
+    committed_left = s.committed_left.at[
+        jnp.where(commit_left, s.r_subject, 0)].max(commit_left)
+    committed_inc = s.committed_inc.at[
+        jnp.where(commit_alive, s.r_subject, 0)].max(
+        jnp.where(commit_alive, s.r_inc, 0))
+    keep = ~done
+    return s.replace(
+        r_active=s.r_active & keep,
+        committed_dead=committed_dead,
+        committed_left=committed_left,
+        committed_inc=committed_inc,
+        know=s.know & keep[None, :],
+        sends_left=jnp.where(keep[None, :], s.sends_left, 0),
+    )
+
+
+def step(params: SwimParams, s: SwimState) -> SwimState:
+    """Advance the whole cluster one gossip tick (jit this)."""
+    do_probe = (s.tick % params.probe_period_ticks) == 0
+    s = jax.lax.cond(do_probe, lambda st: _probe_round(params, st),
+                     lambda st: st, s)
+    s = _suspicion_expiry(params, s)
+    s = _refutation(params, s)
+    s = _disseminate(params, s)
+    s = _expire(params, s)
+    return s.replace(tick=s.tick + 1)
+
+
+def run(params: SwimParams, s: SwimState, n_ticks: int,
+        monitor_subject: int | None = None) -> Tuple[SwimState, jnp.ndarray]:
+    """Run `n_ticks` steps under lax.scan; optionally trace the believed-down
+    fraction of one subject per tick (for convergence curves)."""
+
+    def body(st, _):
+        st = step(params, st)
+        if monitor_subject is None:
+            return st, jnp.float32(0)
+        return st, believed_down_fraction(params, st, monitor_subject)
+
+    return jax.lax.scan(body, s, None, length=n_ticks)
+
+
+# ---------------------------------------------------------------------------
+# fault injection / membership control (ground truth)
+# ---------------------------------------------------------------------------
+
+def kill(s: SwimState, node: int) -> SwimState:
+    """Crash a node (fail-stop).  The detector must discover this."""
+    return s.replace(up=s.up.at[node].set(False))
+
+
+def revive(s: SwimState, node: int) -> SwimState:
+    return s.replace(up=s.up.at[node].set(True))
+
+
+def leave(params: SwimParams, s: SwimState, node: int) -> SwimState:
+    """Graceful leave: the node broadcasts `left` before shutting down
+    (serf intent; consumed at reference agent/consul/leader.go:1390)."""
+    want = jnp.zeros((params.n_nodes,), jnp.int32).at[node].set(1)
+
+    def knowers(subj):
+        return jnp.arange(params.n_nodes) == subj
+
+    s = _originate(params, s, want, LEFT, s.incarnation, knowers)
+    return s.replace(member=s.member.at[node].set(False))
+
+
+def inject_suspicion(params: SwimParams, s: SwimState, subject: int,
+                     origin: int) -> SwimState:
+    """Testing hook: make `origin` suspect `subject` right now."""
+    want = jnp.zeros((params.n_nodes,), jnp.int32).at[subject].set(1)
+
+    def knowers(subj):
+        return jnp.arange(params.n_nodes) == origin
+
+    return _originate(params, s, want, SUSPECT, s.incarnation, knowers)

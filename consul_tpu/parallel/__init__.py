@@ -1,0 +1,3 @@
+from consul_tpu.parallel import mesh
+
+__all__ = ["mesh"]

@@ -1,0 +1,48 @@
+"""Device mesh + sharding for the simulator state.
+
+The reference scales membership across machines with gossip fanout
+(SURVEY.md §2.2); the TPU build scales the *simulation* across chips by
+sharding the node axis of every [N] / [N, U] tensor over a 1-D
+`jax.sharding.Mesh` ("nodes" axis).  Cross-shard interactions — gossip
+scatter targets and per-subject scatter/gathers — are expressed as plain
+jnp scatters under `jit` with sharding annotations, so GSPMD inserts the
+ICI collectives (all-to-all-ish scatter traffic) instead of hand-written
+NCCL-style point-to-point code (reference equivalent: memberlist UDP
+transport, agent/consul/server_serf.go:124-131).
+
+Multi-slice (DCN) scaling maps the WAN pool: one LAN shard group per
+slice, with the WAN tensor replicated — see consul_tpu/models/wan.py.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(devices: Iterable[jax.Device] | None = None) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(devs, (NODE_AXIS,))
+
+
+def state_sharding(state, mesh: Mesh):
+    """NamedSharding pytree for a SwimState: node-leading arrays sharded on
+    the node axis, rumor table + scalars replicated."""
+    n_shards = mesh.shape[NODE_AXIS]
+
+    def spec(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] % n_shards == 0 and leaf.shape[0] > n_shards:
+            return NamedSharding(mesh, P(NODE_AXIS))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(spec, state)
+
+
+def shard_state(state, mesh: Mesh):
+    """Place a SwimState onto the mesh, node axis sharded."""
+    return jax.device_put(state, state_sharding(state, mesh))

@@ -1,0 +1,3 @@
+from consul_tpu.utils import prng
+
+__all__ = ["prng"]

@@ -1,0 +1,30 @@
+"""Counter-based stateless PRNG helpers.
+
+At 1M simulated nodes there is no per-node host entropy; every random draw
+is derived from (seed, tick, stream) via threefry fold-ins so the whole
+simulation is a pure function of its seed (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def tick_key(seed, tick, stream: int):
+    """Derive a key for (tick, stream) from an integer seed.
+
+    `tick` may be a traced int32; `stream` must be a static python int.
+    """
+    base = jax.random.PRNGKey(seed)
+    return jax.random.fold_in(jax.random.fold_in(base, stream), tick)
+
+
+def other_nodes(key, n: int, shape) -> jnp.ndarray:
+    """Uniform node ids excluding the row's own id.
+
+    Returns int32 array of `shape`; shape[0] must be n (row i never draws i).
+    """
+    draw = jax.random.randint(key, shape, 0, n - 1, dtype=jnp.int32)
+    rows = jnp.arange(n, dtype=jnp.int32).reshape((n,) + (1,) * (len(shape) - 1))
+    return (rows + 1 + draw) % n

@@ -1,0 +1,18 @@
+"""Test env: virtual 8-device CPU mesh (mirrors the reference's in-process
+multi-server cluster testing trick, SURVEY.md §4 tier 2 —
+agent/consul/server_test.go:116-122).
+
+The ambient environment registers a real single-chip TPU backend via
+sitecustomize and pins jax_platforms to it, so we must both extend
+XLA_FLAGS *and* override the config after import, before any backend
+initialization."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

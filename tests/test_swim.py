@@ -1,0 +1,100 @@
+"""SWIM kernel behavior tests.
+
+Validates the documented memberlist/serf behaviors (BASELINE.md timer table;
+website/content/docs/architecture/gossip.mdx): no false positives on a clean
+network, crash detection + cluster-wide convergence, Lifeguard refutation of
+a wrongly-suspected live node, graceful leave propagation, determinism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import swim
+
+
+def make(n, seed=0, p_loss=0.01, rumor_slots=16):
+    params = swim.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=n, rumor_slots=rumor_slots,
+                                        p_loss=p_loss, seed=seed))
+    return params, swim.init_state(params)
+
+
+def run_n(params, state, ticks, monitor=None):
+    fn = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    return fn(params, state, ticks, monitor)
+
+
+def test_no_false_positives_clean_network():
+    params, s = make(128, p_loss=0.0)
+    s, _ = run_n(params, s, 100)
+    assert not bool(jnp.any(s.r_active))
+    assert not bool(jnp.any(s.committed_dead))
+    assert int(jnp.sum(s.incarnation)) == 0
+
+
+def test_crash_detection_converges():
+    params, s = make(256, p_loss=0.01)
+    s, _ = run_n(params, s, 20)
+    s = swim.kill(s, 7)
+    # detect (few probe rounds) + Lifeguard suspicion timeout (<= max 294
+    # ticks at N=256, ~O(min)=49 with confirmations) + dissemination
+    s, frac = run_n(params, s, 400, monitor=7)
+    frac = np.asarray(frac)
+    assert frac[-1] > 0.99, f"final believed-down fraction {frac[-1]}"
+    # monotone-ish rise: no mass un-detection
+    assert frac[-1] >= frac[200] >= frac[0] - 1e-6
+    # eventually committed into the O(N) baseline
+    assert bool(s.committed_dead[7])
+
+
+def test_no_detection_before_suspicion_timeout():
+    params, s = make(256, p_loss=0.01)
+    s = swim.kill(s, 7)
+    # nothing can be declared dead before the min suspicion timeout elapses
+    s, frac = run_n(params, s, params.suspicion_min_ticks // 2, monitor=7)
+    assert float(np.asarray(frac)[-1]) == 0.0
+
+
+def test_refutation_of_live_node():
+    params, s = make(64, p_loss=0.0)
+    s = swim.inject_suspicion(params, s, subject=3, origin=11)
+    s, frac = run_n(params, s, 300, monitor=3)
+    # the suspect rumor reaches node 3, which bumps incarnation + refutes
+    assert int(s.incarnation[3]) >= 1
+    assert not bool(jnp.any(s.committed_dead))
+    assert float(np.asarray(frac)[-1]) == 0.0
+
+
+def test_graceful_leave_propagates():
+    params, s = make(64, p_loss=0.0)
+    s = swim.leave(params, s, 5)
+    s, frac = run_n(params, s, 120, monitor=5)
+    assert float(np.asarray(frac)[-1]) > 0.99
+    assert bool(s.committed_left[5])
+    # leave is not a failure: never committed dead
+    assert not bool(s.committed_dead[5])
+
+
+def test_deterministic():
+    params, s0 = make(64, p_loss=0.05, seed=42)
+    s0 = swim.kill(s0, 1)
+    a, _ = run_n(params, s0, 60)
+    b, _ = run_n(params, s0, 60)
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_timer_formulas_match_memberlist():
+    g = GossipConfig.lan()
+    # retransmitLimit = mult * ceil(log10(n+1))
+    assert g.retransmit_limit(9) == 4 * 1
+    assert g.retransmit_limit(255) == 4 * 3
+    assert g.retransmit_limit(10**6) == 4 * 7
+    # suspicion timeout = mult * max(1, log10 n) * probe_interval
+    assert g.suspicion_min_ticks(10) == 4 * 1 * 5
+    assert g.suspicion_min_ticks(1000) == 4 * 3 * 5
+    w = GossipConfig.wan()
+    assert w.probe_period_ticks == 10  # 5s probe / 0.5s gossip
