@@ -1,0 +1,70 @@
+"""North-star benchmark: 1M-node serf LAN pool, crash-to-convergence wall-clock.
+
+Simulates a 1,000,000-node SWIM/serf cluster (LAN gossip defaults) on the
+attached TPU, kills one node, and measures wall-clock until >99.9% of live
+members believe it dead (detect → Lifeguard suspicion → dead-rumor spread).
+Target from BASELINE.json: < 10 s.  The reference has no 1M benchmark — its
+published envelope is timer math (suspicion_mult·log10 N·probe_interval) and
+the serf-simulator claim that a leave reaches >99.99% of 100k nodes in 3 s
+(lib/serf/serf.go:26-30); the simulated gossip here reproduces those curves.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf, swim
+
+N = 1_000_000
+TARGET_S = 10.0
+CHUNK = 25
+VICTIM = 123_456
+
+
+def main():
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=N, rumor_slots=32,
+                                        alloc_cap=8, p_loss=0.01, seed=7))
+    s = serf.init_state(params)
+    run = jax.jit(serf.run, static_argnums=(0, 2, 3))
+
+    # warm start: a few ticks of steady-state gossip + compile both paths
+    s, _ = run(params, s, CHUNK, VICTIM)
+    jax.block_until_ready(s)
+
+    s = s.replace(swim=swim.kill(s.swim, VICTIM))
+    t0 = time.time()
+    ticks = 0
+    frac = 0.0
+    while ticks < 1200:
+        s, fr = run(params, s, CHUNK, VICTIM)
+        fr = np.asarray(fr)
+        ticks += CHUNK
+        if (fr > 0.999).any():
+            extra = int(np.argmax(fr > 0.999)) + 1
+            ticks = ticks - CHUNK + extra
+            frac = float(fr[extra - 1])
+            break
+        frac = float(fr[-1])
+    wall = time.time() - t0
+
+    ok = frac > 0.999
+    print(json.dumps({
+        "metric": "serf_1M_node_crash_convergence_wallclock",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": round(TARGET_S / wall, 3) if ok else 0.0,
+    }))
+    if not ok:
+        print(f"# did not converge: frac={frac} after {ticks} ticks", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

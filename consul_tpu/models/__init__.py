@@ -1,3 +1,3 @@
-from consul_tpu.models import swim
+from consul_tpu.models import events, serf, swim, vivaldi
 
-__all__ = ["swim"]
+__all__ = ["events", "serf", "swim", "vivaldi"]

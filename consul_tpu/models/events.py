@@ -1,0 +1,156 @@
+"""Lamport-clocked user-event broadcast with dedup — serf's event layer.
+
+Reference behavior: custom events over serf with a ring buffer and filters
+(agent/user_event.go:23-130; server event prefix `consul:event:`
+agent/consul/server_serf.go:28,257; serf buffers recent events for dedup and
+orders them by Lamport time).  Rebuilt as tensors:
+
+  * a per-node Lamport clock [N] advanced on send and on first delivery;
+  * an event table of E in-flight events (name/payload ids, origin ltime);
+  * a [N, E] knowledge matrix riding the shared gossip kernel
+    (ops/gossip.py) — same infection dynamics as membership rumors;
+  * a per-node dedup/delivery ring: events are "delivered" the tick they
+    are first learned; `deliveries` counts per event reach the oracle can
+    expose (the HTTP event-fire/list API reads from this — api/event.py).
+
+Event payloads live host-side (the device only tracks ids); the host
+control plane maps id → (name, payload) like the reference's UserEvents()
+ring (agent/user_event.go:207).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.ops import gossip as gossip_ops
+from consul_tpu.utils import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class EventParams:
+    n_nodes: int
+    event_slots: int = 32
+    gossip_nodes: int = 3
+    retransmit_limit: int = 16
+    expiry_ticks: int = 64
+    seed: int = 0
+
+
+def make_params(gossip: GossipConfig, sim: SimConfig,
+                event_slots: int = 32) -> EventParams:
+    import math
+    spread = max(8, 4 * math.ceil(math.log2(sim.n_nodes + 1)))
+    return EventParams(
+        n_nodes=sim.n_nodes,
+        event_slots=event_slots,
+        gossip_nodes=gossip.gossip_nodes,
+        retransmit_limit=gossip.retransmit_limit(sim.n_nodes),
+        expiry_ticks=spread,
+        seed=sim.seed ^ 0xE7E7,
+    )
+
+
+@struct.dataclass
+class EventState:
+    tick: jnp.ndarray        # int32 scalar
+    lamport: jnp.ndarray     # [N] int32 per-node Lamport clock
+    e_active: jnp.ndarray    # [E] bool
+    e_id: jnp.ndarray        # [E] int32 host-side event id (name+payload)
+    e_ltime: jnp.ndarray     # [E] int32 Lamport time of the fire
+    e_origin: jnp.ndarray    # [E] int32
+    e_start: jnp.ndarray     # [E] int32 origin tick
+    know: jnp.ndarray        # [N, E] bool
+    deliver_tick: jnp.ndarray  # [N, E] int32 first-delivery tick
+    sends_left: jnp.ndarray  # [N, E] int32
+
+
+def init_state(params: EventParams) -> EventState:
+    n, e = params.n_nodes, params.event_slots
+    return EventState(
+        tick=jnp.int32(0),
+        lamport=jnp.zeros((n,), jnp.int32),
+        e_active=jnp.zeros((e,), bool),
+        e_id=jnp.zeros((e,), jnp.int32),
+        e_ltime=jnp.zeros((e,), jnp.int32),
+        e_origin=jnp.zeros((e,), jnp.int32),
+        e_start=jnp.zeros((e,), jnp.int32),
+        know=jnp.zeros((n, e), bool),
+        deliver_tick=jnp.full((n, e), -1, jnp.int32),
+        sends_left=jnp.zeros((n, e), jnp.int32),
+    )
+
+
+def fire(params: EventParams, s: EventState, origin: int | jnp.ndarray,
+         event_id: int | jnp.ndarray) -> EventState:
+    """Fire a user event from `origin` (UserEvent — agent/user_event.go:23).
+
+    Allocates the lowest free slot; if the table is full the oldest slot is
+    recycled (serf's event buffer also evicts by age)."""
+    e = params.event_slots
+    origin = jnp.asarray(origin, jnp.int32)
+    ltime = s.lamport[origin] + 1
+    lamport = s.lamport.at[origin].set(ltime)
+
+    age_score = jnp.where(s.e_active, s.e_start, -(10 ** 9))
+    slot = jnp.where(jnp.any(~s.e_active),
+                     jnp.argmin(s.e_active),
+                     jnp.argmin(-age_score)).astype(jnp.int32)
+    onehot = jnp.arange(e) == slot
+    origin_row = jnp.arange(params.n_nodes) == origin
+    cell = origin_row[:, None] & onehot[None, :]
+    return s.replace(
+        lamport=lamport,
+        e_active=s.e_active | onehot,
+        e_id=jnp.where(onehot, event_id, s.e_id),
+        e_ltime=jnp.where(onehot, ltime, s.e_ltime),
+        e_origin=jnp.where(onehot, origin, s.e_origin),
+        e_start=jnp.where(onehot, s.tick, s.e_start),
+        know=jnp.where(onehot[None, :], cell, s.know),
+        deliver_tick=jnp.where(onehot[None, :],
+                               jnp.where(cell, s.tick, -1), s.deliver_tick),
+        sends_left=jnp.where(onehot[None, :],
+                             jnp.where(cell, params.retransmit_limit, 0),
+                             s.sends_left),
+    )
+
+
+def step(params: EventParams, s: EventState, up: jnp.ndarray,
+         member: jnp.ndarray) -> EventState:
+    """One gossip tick of event dissemination; `up`/`member` come from the
+    membership model so events only flow between live members."""
+    n = params.n_nodes
+    key = prng.tick_key(params.seed, s.tick, 3)
+    targets = prng.other_nodes(key, n, (n, params.gossip_nodes))
+    res = gossip_ops.disseminate(targets, s.know, s.sends_left,
+                                 sender_ok=up, receiver_ok=up & member,
+                                 slot_active=s.e_active,
+                                 retransmit_limit=params.retransmit_limit)
+    deliver_tick = jnp.where(res.newly, s.tick, s.deliver_tick)
+    # Lamport witness: clock jumps past the max ltime delivered this tick
+    seen = jnp.where(res.newly, s.e_ltime[None, :], 0)
+    lamport = jnp.maximum(s.lamport, jnp.max(seen, axis=1))
+
+    done = s.e_active & (s.tick - s.e_start >= params.expiry_ticks)
+    return s.replace(
+        tick=s.tick + 1,
+        lamport=lamport,
+        e_active=s.e_active & ~done,
+        know=res.know & ~done[None, :],
+        deliver_tick=deliver_tick,
+        sends_left=jnp.where(done[None, :], 0, res.sends_left),
+    )
+
+
+def coverage(params: EventParams, s: EventState, slot: int,
+             up: jnp.ndarray, member: jnp.ndarray) -> jnp.ndarray:
+    """Fraction of live members that have ever received event in `slot`
+    (delivery records outlive the slot's dissemination window)."""
+    alive = up & member
+    got = (s.deliver_tick[:, slot] >= 0) & alive
+    return jnp.sum(got) / jnp.maximum(jnp.sum(alive), 1)

@@ -1,0 +1,91 @@
+"""The Serf layer: SWIM membership + Vivaldi coordinates in one cluster step.
+
+This is the flagship model — the batched equivalent of a whole Consul LAN
+gossip pool (reference: pool creation agent/consul/server_serf.go:36-185;
+the serf library layers coordinates and events over memberlist, go.mod:58).
+Each tick advances failure detection and dissemination (models/swim.py) and
+feeds the round's direct probe acks to the coordinate solver
+(models/vivaldi.py), mirroring serf's update-on-probe-ack coupling
+(reference agent/agent.go:1629 GetLANCoordinate ← probe acks).
+
+Lamport-clocked user events ride the same rumor table (swim.LEFT-style
+dissemination) — see models/events.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import events, swim, vivaldi
+
+
+@dataclasses.dataclass(frozen=True)
+class SerfParams:
+    swim: swim.SwimParams
+    vivaldi: vivaldi.VivaldiParams
+    events: events.EventParams
+
+    @property
+    def n_nodes(self) -> int:
+        return self.swim.n_nodes
+
+
+def make_params(gossip: GossipConfig | None = None,
+                sim: SimConfig | None = None,
+                coord_dims: int = 8, event_slots: int = 32) -> SerfParams:
+    gossip = gossip or GossipConfig.lan()
+    sim = sim or SimConfig()
+    return SerfParams(
+        swim=swim.make_params(gossip, sim),
+        vivaldi=vivaldi.VivaldiParams(n_nodes=sim.n_nodes, dims=coord_dims,
+                                      seed=sim.seed),
+        events=events.make_params(gossip, sim, event_slots),
+    )
+
+
+@struct.dataclass
+class ClusterState:
+    swim: swim.SwimState
+    coords: vivaldi.VivaldiState
+    events: events.EventState
+
+
+def init_state(params: SerfParams, key=None) -> ClusterState:
+    return ClusterState(swim=swim.init_state(params.swim, key),
+                        coords=vivaldi.init_state(params.vivaldi),
+                        events=events.init_state(params.events))
+
+
+def step(params: SerfParams, s: ClusterState) -> ClusterState:
+    """One gossip tick of the full serf pool (jit this)."""
+    sw, obs = swim.step_with_obs(params.swim, s.swim)
+    src = jnp.arange(params.n_nodes, dtype=jnp.int32)
+    coords = vivaldi.observe(params.vivaldi, s.coords, src, obs.target,
+                             obs.rtt_ms / 1000.0, mask=obs.acked)
+    ev = events.step(params.events, s.events, up=sw.up, member=sw.member)
+    return ClusterState(swim=sw, coords=coords, events=ev)
+
+
+def fire_event(params: SerfParams, s: ClusterState, origin: int,
+               event_id: int) -> ClusterState:
+    """Fire a user event (reference agent/user_event.go:23 UserEvent)."""
+    return s.replace(events=events.fire(params.events, s.events, origin,
+                                        event_id))
+
+
+def run(params: SerfParams, s: ClusterState, n_ticks: int,
+        monitor_subject: int | None = None) -> Tuple[ClusterState, jnp.ndarray]:
+    def body(st, _):
+        st = step(params, st)
+        if monitor_subject is None:
+            return st, jnp.float32(0)
+        return st, swim.believed_down_fraction(params.swim, st.swim,
+                                               monitor_subject)
+
+    return jax.lax.scan(body, s, None, length=n_ticks)

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 from flax import struct
 
 from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.ops import gossip as gossip_ops
 from consul_tpu.utils import prng
 
 # Rumor kinds (serf member lifecycle, consumed by the reference's leader
@@ -304,7 +305,25 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
 # step phases
 # ---------------------------------------------------------------------------
 
-def _probe_round(params: SwimParams, s: SwimState) -> SwimState:
+@struct.dataclass
+class ProbeObs:
+    """Per-node probe measurements from one probe round; acked direct probes
+    carry an RTT sample (the serf coordinate client updates on every probe
+    ack — reference agent/agent.go:1629)."""
+
+    target: jnp.ndarray   # [N] int32
+    rtt_ms: jnp.ndarray   # [N] float32
+    acked: jnp.ndarray    # [N] bool (direct ack — RTT sample is meaningful)
+
+
+def _empty_obs(params: SwimParams) -> ProbeObs:
+    n = params.n_nodes
+    return ProbeObs(target=jnp.zeros((n,), jnp.int32),
+                    rtt_ms=jnp.ones((n,), jnp.float32),
+                    acked=jnp.zeros((n,), bool))
+
+
+def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]:
     """One SWIM probe round: direct probe + k indirect probes + suspicion.
 
     Reference behavior: memberlist probe loop (probe_interval /
@@ -363,7 +382,11 @@ def _probe_round(params: SwimParams, s: SwimState) -> SwimState:
     def knowers(subj):
         return failed & (target == subj)
 
-    return _originate(params, s, want, SUSPECT, s.incarnation, knowers)
+    s = _originate(params, s, want, SUSPECT, s.incarnation, knowers)
+    direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
+    obs = ProbeObs(target=target, rtt_ms=2.0 * rtt,
+                   acked=prober & ~skip & direct_ack)
+    return s, obs
 
 
 def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
@@ -450,28 +473,21 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
     """Piggyback gossip: every live carrier with budget sends its queued
     rumors to `gossip_nodes` random targets (memberlist gossip interval /
     gossip_nodes — options.mdx:1498-1508).  Three scatter-max ops."""
-    n, u = params.n_nodes, params.rumor_slots
+    n = params.n_nodes
     tick = s.tick
     key = prng.tick_key(params.seed, tick, 2)
     targets = prng.other_nodes(key, n, (n, params.gossip_nodes))
-
     # Senders need only be up (a gracefully-left node keeps gossiping its
     # leave intent — serf LeavePropagateDelay, lib/serf/serf.go:26-30);
     # receivers must be live members.
-    send = s.know & (s.sends_left > 0) & s.up[:, None]           # [N, U]
-    got = jnp.zeros((n, u), jnp.uint8)
-    send8 = send.astype(jnp.uint8)
-    for g in range(params.gossip_nodes):
-        got = got.at[targets[:, g]].max(send8)
-    received = (got > 0) & (s.up & s.member)[:, None] & s.r_active[None, :]
-    newly = received & ~s.know
-    know = s.know | newly
-    learn_tick = jnp.where(newly, tick, s.learn_tick)
-    sends_left = jnp.where(newly, params.retransmit_limit,
-                           jnp.where(send, jnp.maximum(
-                               s.sends_left - params.gossip_nodes, 0),
-                               s.sends_left))
-    return s.replace(know=know, learn_tick=learn_tick, sends_left=sends_left)
+    res = gossip_ops.disseminate(targets, s.know, s.sends_left,
+                                 sender_ok=s.up,
+                                 receiver_ok=s.up & s.member,
+                                 slot_active=s.r_active,
+                                 retransmit_limit=params.retransmit_limit)
+    learn_tick = jnp.where(res.newly, tick, s.learn_tick)
+    return s.replace(know=res.know, learn_tick=learn_tick,
+                     sends_left=res.sends_left)
 
 
 def _expire(params: SwimParams, s: SwimState) -> SwimState:
@@ -503,16 +519,23 @@ def _expire(params: SwimParams, s: SwimState) -> SwimState:
     )
 
 
-def step(params: SwimParams, s: SwimState) -> SwimState:
-    """Advance the whole cluster one gossip tick (jit this)."""
+def step_with_obs(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]:
+    """Advance the whole cluster one gossip tick, returning this tick's probe
+    measurements (for the Vivaldi solver — see models/serf.py)."""
     do_probe = (s.tick % params.probe_period_ticks) == 0
-    s = jax.lax.cond(do_probe, lambda st: _probe_round(params, st),
-                     lambda st: st, s)
+    s, obs = jax.lax.cond(do_probe,
+                          lambda st: _probe_round(params, st),
+                          lambda st: (st, _empty_obs(params)), s)
     s = _suspicion_expiry(params, s)
     s = _refutation(params, s)
     s = _disseminate(params, s)
     s = _expire(params, s)
-    return s.replace(tick=s.tick + 1)
+    return s.replace(tick=s.tick + 1), obs
+
+
+def step(params: SwimParams, s: SwimState) -> SwimState:
+    """Advance the whole cluster one gossip tick (jit this)."""
+    return step_with_obs(params, s)[0]
 
 
 def run(params: SwimParams, s: SwimState, n_ticks: int,

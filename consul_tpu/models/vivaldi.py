@@ -1,0 +1,189 @@
+"""Vivaldi network coordinates as vectorized spring relaxation.
+
+TPU-native replacement for the serf coordinate client Consul consumes
+(reference: coordinate updates staged/batched at
+agent/consul/coordinate_endpoint.go:20-130; distance math `ComputeDistance`
+lib/rtt.go:13-43; RTT-sorted query results agent/consul/rtt.go:196; client
+send loop agent/agent.go:1635-1688).  The algorithm follows the published
+Vivaldi paper (Dabek et al., SIGCOMM'04) with serf's documented extensions —
+height vector, adaptive error, gravity, and a latency-adjustment window
+(website/content/docs/architecture/coordinates.mdx) — re-derived, not
+translated.
+
+In the reference every probe ack yields one coordinate update on one node.
+Here a whole cluster's worth of observations applies in one batched tick:
+`observe(state, src, dst, rtt)` updates every source row at once, so the
+100k-node config of BASELINE.json is a handful of fused [N, D] ops per tick
+on the VPU instead of 100k goroutine callbacks.
+
+Units: seconds (like the reference's coordinate package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.utils import prng
+
+
+@dataclasses.dataclass(frozen=True)
+class VivaldiParams:
+    """serf coordinate tuning surface (documented defaults)."""
+
+    n_nodes: int
+    dims: int = 8
+    vivaldi_error_max: float = 1.5
+    vivaldi_ce: float = 0.25          # error-estimate smoothing
+    vivaldi_cc: float = 0.25          # spring-force gain
+    adjustment_window: int = 20       # rolling latency-adjustment samples
+    height_min: float = 10.0e-6       # seconds
+    gravity_rho: float = 150.0        # pull toward origin per second of radius
+    seed: int = 0
+
+
+@struct.dataclass
+class VivaldiState:
+    coords: jnp.ndarray      # [N, D] float32, seconds
+    height: jnp.ndarray      # [N] float32, seconds (access-link latency)
+    error: jnp.ndarray       # [N] float32, confidence (lower is better)
+    adj_window: jnp.ndarray  # [N, W] float32: last W (rtt - predicted) samples
+    adj_index: jnp.ndarray   # int32 scalar: ring cursor
+    adjustment: jnp.ndarray  # [N] float32: current additive adjustment
+
+
+def init_state(params: VivaldiParams) -> VivaldiState:
+    n, d = params.n_nodes, params.dims
+    return VivaldiState(
+        coords=jnp.zeros((n, d), jnp.float32),
+        height=jnp.full((n,), params.height_min, jnp.float32),
+        error=jnp.full((n,), params.vivaldi_error_max, jnp.float32),
+        adj_window=jnp.zeros((n, params.adjustment_window), jnp.float32),
+        adj_index=jnp.int32(0),
+        adjustment=jnp.zeros((n,), jnp.float32),
+    )
+
+
+def raw_distance(s: VivaldiState, src: jnp.ndarray, dst: jnp.ndarray) -> jnp.ndarray:
+    """Euclidean + height distance between node rows src and dst ([K] ids)."""
+    diff = s.coords[src] - s.coords[dst]
+    return jnp.linalg.norm(diff, axis=-1) + s.height[src] + s.height[dst]
+
+
+def estimate_rtt(s: VivaldiState, src: jnp.ndarray, dst: jnp.ndarray) -> jnp.ndarray:
+    """Predicted RTT with adjustment terms, floored like the reference
+    (lib/rtt.go:13-43 ComputeDistance semantics)."""
+    d = raw_distance(s, src, dst)
+    adjusted = d + s.adjustment[src] + s.adjustment[dst]
+    return jnp.where(adjusted > 0.0, adjusted, d)
+
+
+def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray,
+            dst: jnp.ndarray, rtt: jnp.ndarray,
+            mask: jnp.ndarray | None = None) -> VivaldiState:
+    """Apply one RTT observation per source node, batched.
+
+    src, dst: [K] int32 node ids (K is typically N — one probe per node);
+    rtt: [K] float32 seconds; mask: [K] bool (False rows are no-ops).
+    Rows of `src` must be distinct (each node observes once per tick).
+    """
+    if mask is None:
+        mask = jnp.ones(src.shape, bool)
+    rtt = jnp.maximum(rtt, 1.0e-6)
+
+    ci, cj = s.coords[src], s.coords[dst]
+    hi, hj = s.height[src], s.height[dst]
+    ei, ej = s.error[src], s.error[dst]
+
+    diff = ci - cj
+    norm = jnp.linalg.norm(diff, axis=-1)
+    dist = norm + hi + hj
+
+    # sample weight balances confidence between the two nodes
+    w = ei / jnp.maximum(ei + ej, 1.0e-9)
+    err_sample = jnp.abs(dist - rtt) / rtt
+    new_err = err_sample * params.vivaldi_ce * w + ei * (1.0 - params.vivaldi_ce * w)
+    new_err = jnp.clip(new_err, 1.0e-6, params.vivaldi_error_max)
+
+    # spring force along the unit vector (random direction if colocated)
+    key = prng.tick_key(params.seed, s.adj_index, 7)
+    rand_dir = jax.random.normal(key, ci.shape, jnp.float32)
+    unit = jnp.where((norm > 1.0e-9)[:, None], diff / jnp.maximum(norm, 1.0e-9)[:, None],
+                     rand_dir / jnp.linalg.norm(rand_dir, axis=-1, keepdims=True))
+    force = params.vivaldi_cc * w * (rtt - dist)
+    new_ci = ci + unit * force[:, None]
+    new_hi = jnp.maximum(hi + (hi / jnp.maximum(dist, 1.0e-9)) * force,
+                         params.height_min)
+
+    m = mask
+    coords = s.coords.at[src].set(jnp.where(m[:, None], new_ci, ci))
+    height = s.height.at[src].set(jnp.where(m, new_hi, hi))
+    error = s.error.at[src].set(jnp.where(m, new_err, ei))
+
+    # gravity: keep the constellation centered so coordinates stay comparable
+    norms = jnp.linalg.norm(coords, axis=-1, keepdims=True)
+    grav = (norms / params.gravity_rho) ** 2
+    coords = coords * jnp.maximum(1.0 - grav, 0.0)
+
+    # latency adjustment ring: mean of last W (rtt - raw distance) residuals.
+    # (sample rows are src-ordered; scatter them into node-id order first)
+    col = (s.adj_index % params.adjustment_window).astype(jnp.int32)
+    old_col = jax.lax.dynamic_slice_in_dim(s.adj_window, col, 1, axis=1)[:, 0]
+    new_col = old_col.at[src].set(
+        jnp.where(m, (rtt - dist) / 2.0, old_col[src]))
+    adj_window = jax.lax.dynamic_update_slice_in_dim(
+        s.adj_window, new_col[:, None], col, axis=1)
+    adjustment = jnp.mean(adj_window, axis=1)
+
+    return VivaldiState(coords=coords, height=height, error=error,
+                        adj_window=adj_window, adj_index=s.adj_index + 1,
+                        adjustment=adjustment)
+
+
+def sort_by_distance(s: VivaldiState, origin: int) -> jnp.ndarray:
+    """Node ids sorted by estimated RTT from `origin` — the `?near=` query
+    path (reference agent/consul/rtt.go:196 sortNodesByDistanceFrom)."""
+    n = s.coords.shape[0]
+    all_ids = jnp.arange(n, dtype=jnp.int32)
+    d = estimate_rtt(s, jnp.full((n,), origin, jnp.int32), all_ids)
+    return jnp.argsort(d)
+
+
+# ---------------------------------------------------------------------------
+# standalone convergence sim (BASELINE.json config #3: 100k nodes)
+# ---------------------------------------------------------------------------
+
+def synthetic_rtt(true_coords: jnp.ndarray, src, dst, key,
+                  jitter: float = 0.02) -> jnp.ndarray:
+    """Ground-truth RTT (seconds) from latent coordinates with noise."""
+    base = jnp.linalg.norm(true_coords[src] - true_coords[dst], axis=-1)
+    noise = 1.0 + jitter * jax.random.normal(key, base.shape)
+    return jnp.maximum(base * noise, 1.0e-6)
+
+
+def sim_step(params: VivaldiParams, true_coords: jnp.ndarray,
+             s: VivaldiState, tick) -> VivaldiState:
+    """One relaxation tick: every node measures one random peer."""
+    n = params.n_nodes
+    key = prng.tick_key(params.seed, tick, 8)
+    k1, k2 = jax.random.split(key)
+    src = jnp.arange(n, dtype=jnp.int32)
+    dst = prng.other_nodes(k1, n, (n,))
+    rtt = synthetic_rtt(true_coords, src, dst, k2)
+    return observe(params, s, src, dst, rtt)
+
+
+def relative_error(params: VivaldiParams, true_coords: jnp.ndarray,
+                   s: VivaldiState, tick, n_pairs_per_node: int = 1):
+    """Median |predicted - true| / true over random pairs (convergence metric)."""
+    n = params.n_nodes
+    key = prng.tick_key(params.seed, tick, 9)
+    k1, k2 = jax.random.split(key)
+    src = jnp.arange(n, dtype=jnp.int32)
+    dst = prng.other_nodes(k1, n, (n,))
+    true_rtt = synthetic_rtt(true_coords, src, dst, k2, jitter=0.0)
+    est = estimate_rtt(s, src, dst)
+    return jnp.median(jnp.abs(est - true_rtt) / true_rtt)

@@ -1,0 +1,3 @@
+from consul_tpu.ops import gossip
+
+__all__ = ["gossip"]

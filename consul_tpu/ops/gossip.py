@@ -1,0 +1,47 @@
+"""Infection-style dissemination as scatter ops — the shared gossip kernel.
+
+One gossip tick: every live carrier with remaining retransmit budget picks
+`fanout` random targets and sends its queued item mask; receipt is a
+scatter-max OR into the [N, S] knowledge matrix.  This is the SpMV at the
+heart of both membership rumors (models/swim.py) and user events
+(models/events.py) — the TPU equivalent of memberlist's piggybacked UDP
+gossip (reference tuning agent/config/default.go:70-84: gossip_interval /
+gossip_nodes; retransmit queue lib/serf/serf.go:20-24).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+
+class GossipResult(NamedTuple):
+    know: jnp.ndarray        # [N, S] bool
+    sends_left: jnp.ndarray  # [N, S] int32
+    newly: jnp.ndarray       # [N, S] bool — learned this tick
+
+
+def disseminate(targets: jnp.ndarray, know: jnp.ndarray,
+                sends_left: jnp.ndarray, sender_ok: jnp.ndarray,
+                receiver_ok: jnp.ndarray, slot_active: jnp.ndarray,
+                retransmit_limit: int) -> GossipResult:
+    """One fanout round.
+
+    targets: [N, G] int32 gossip destinations per node;
+    sender_ok/receiver_ok: [N] bool; slot_active: [S] bool.
+    """
+    n, s = know.shape
+    send = know & (sends_left > 0) & sender_ok[:, None]
+    got = jnp.zeros((n, s), jnp.uint8)
+    send8 = send.astype(jnp.uint8)
+    for g in range(targets.shape[1]):
+        got = got.at[targets[:, g]].max(send8)
+    received = (got > 0) & receiver_ok[:, None] & slot_active[None, :]
+    newly = received & ~know
+    new_know = know | newly
+    new_sends = jnp.where(newly, retransmit_limit,
+                          jnp.where(send,
+                                    jnp.maximum(sends_left - targets.shape[1], 0),
+                                    sends_left))
+    return GossipResult(know=new_know, sends_left=new_sends, newly=newly)

@@ -1,0 +1,42 @@
+"""Combined serf-pool model: membership + coordinates in one step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf, swim, vivaldi
+
+
+def test_probe_acks_drive_coordinate_convergence():
+    # In the combined model Vivaldi learns swim's latent RTT geometry purely
+    # from the probe acks the failure detector already makes.
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=128, rumor_slots=16,
+                                        p_loss=0.0, seed=4))
+    s = serf.init_state(params)
+    run = jax.jit(serf.run, static_argnums=(0, 2, 3))
+    s, _ = run(params, s, 1500)
+
+    # predicted RTT (s) vs ground truth from swim's latent coords (ms)
+    src = jnp.arange(128, dtype=jnp.int32)
+    dst = (src + 31) % 128
+    true_ms = jnp.linalg.norm(s.swim.coords[src] - s.swim.coords[dst], axis=-1) \
+        + params.swim.rtt_base_ms
+    est_s = vivaldi.estimate_rtt(s.coords, src, dst)
+    rel = np.median(np.abs(np.asarray(est_s) * 1000.0 - 2.0 * np.asarray(true_ms))
+                    / (2.0 * np.asarray(true_ms)))
+    # probe rounds happen every 5th tick; ~300 observations per node
+    assert rel < 0.35, f"median relative coordinate error {rel}"
+
+
+def test_cluster_step_keeps_detection_working():
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=128, rumor_slots=16,
+                                        p_loss=0.01, seed=5))
+    s = serf.init_state(params)
+    run = jax.jit(serf.run, static_argnums=(0, 2, 3))
+    s, _ = run(params, s, 10)
+    s = s.replace(swim=swim.kill(s.swim, 9))
+    s, frac = run(params, s, 400, 9)
+    assert float(np.asarray(frac)[-1]) > 0.99

@@ -1,0 +1,53 @@
+"""Vivaldi solver convergence + RTT-sort semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import vivaldi
+
+
+def _converge(n=256, ticks=400, seed=0, dims=4):
+    params = vivaldi.VivaldiParams(n_nodes=n, dims=dims, seed=seed)
+    key = jax.random.PRNGKey(seed)
+    # latent 2-D geography, RTTs in tens of ms
+    true_coords = jax.random.uniform(key, (n, 2), jnp.float32) * 0.060
+    s = vivaldi.init_state(params)
+
+    def body(st, t):
+        return vivaldi.sim_step(params, true_coords, st, t), 0
+
+    s, _ = jax.lax.scan(body, s, jnp.arange(ticks))
+    return params, true_coords, s
+
+
+def test_spring_relaxation_converges():
+    params, true_coords, s = _converge()
+    err0 = float(vivaldi.relative_error(params, true_coords,
+                                        vivaldi.init_state(params), 0))
+    err = float(vivaldi.relative_error(params, true_coords, s, 1))
+    assert err < 0.15, f"median relative RTT error {err}"
+    assert err < err0 / 3
+    # error estimates dropped from the prior max
+    assert float(jnp.median(s.error)) < 0.4
+
+
+def test_rtt_sort_orders_by_true_distance():
+    params, true_coords, s = _converge(n=128, ticks=400, seed=1)
+    order = np.asarray(vivaldi.sort_by_distance(s, 0))
+    true_d = np.linalg.norm(np.asarray(true_coords) - np.asarray(true_coords)[0],
+                            axis=-1)
+    # nearest-10 by estimate should be drawn from the true nearest-30
+    top = set(order[:10].tolist()) - {0}
+    true_top = set(np.argsort(true_d)[:30].tolist())
+    assert len(top & true_top) >= 7
+
+
+def test_estimate_rtt_positive_and_symmetricish():
+    params, true_coords, s = _converge(n=64, ticks=200, seed=2)
+    src = jnp.arange(64, dtype=jnp.int32)
+    dst = (src + 13) % 64
+    ab = np.asarray(vivaldi.estimate_rtt(s, src, dst))
+    ba = np.asarray(vivaldi.estimate_rtt(s, dst, src))
+    assert (ab > 0).all()
+    np.testing.assert_allclose(ab, ba, rtol=1e-5)
