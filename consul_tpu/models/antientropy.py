@@ -1,0 +1,177 @@
+"""Anti-entropy: paced full/partial sync of agent state into the catalog.
+
+Reference behavior (agent/ae/ae.go + agent/local/state.go): every agent
+periodically diffs its desired services/checks against the server catalog
+(`SyncFull`, staggered and interval-scaled by cluster size) and pushes
+edge-triggered deltas (`SyncChanges`) in between.  The pacing constant is
+`scaleFactor` (ae.go:27-40): the full-sync interval doubles for every
+doubling of cluster size past 128 nodes.
+
+Tensorized: desired and actual are id-sorted columnar tables (service id →
+owner node, version); the diff is the sorted-merge kernel in
+ops/reconcile.py; per-agent sync timers advance in the same tick loop as
+gossip.  One step syncs *all* due agents' rows at once — the per-entry map
+walk of the reference becomes two binary-search joins plus masked merges.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.ops import reconcile
+from consul_tpu.utils import prng
+
+
+def scale_factor(n_nodes: int) -> int:
+    """Reference agent/ae/ae.go:27-40: 1 for <=128 nodes, then
+    ceil(log2(n) - log2(128)) + 1."""
+    if n_nodes <= 128:
+        return 1
+    return int(math.ceil(math.log2(n_nodes) - math.log2(128.0))) + 1
+
+
+@dataclasses.dataclass(frozen=True)
+class AEParams:
+    n_agents: int
+    capacity: int               # S: service-instance table capacity
+    sync_interval_ticks: int    # base full-sync interval (reference: 1m)
+    stagger_frac: float = 0.1   # randomized stagger (lib/rand.go RandomStagger)
+    seed: int = 0
+
+    @property
+    def scaled_interval(self) -> int:
+        return self.sync_interval_ticks * scale_factor(self.n_agents)
+
+
+@struct.dataclass
+class AEState:
+    tick: jnp.ndarray       # int32
+    # desired (agent-local) table, id-sorted
+    d_ids: jnp.ndarray      # [S] int32 (INVALID_ID = empty)
+    d_node: jnp.ndarray     # [S] int32 owning agent
+    d_ver: jnp.ndarray      # [S] int32 content version
+    d_dirty: jnp.ndarray    # [S] bool: changed since last sync (edge trigger)
+    # actual (catalog) table, id-sorted
+    a_ids: jnp.ndarray      # [S] int32
+    a_node: jnp.ndarray     # [S] int32
+    a_ver: jnp.ndarray      # [S] int32
+    # per-agent timers
+    next_full: jnp.ndarray  # [N] int32 next full-sync tick
+    n_dirty: jnp.ndarray    # [N] bool: agent has pending deletes/changes
+    syncs_done: jnp.ndarray  # int32 counter (telemetry)
+
+
+def init_state(params: AEParams) -> AEState:
+    s_cap, n = params.capacity, params.n_agents
+    key = prng.tick_key(params.seed, 0, 11)
+    stagger = jax.random.randint(key, (n,), 0,
+                                 max(1, params.scaled_interval), jnp.int32)
+    empty = jnp.full((s_cap,), reconcile.INVALID_ID, jnp.int32)
+    zeros = jnp.zeros((s_cap,), jnp.int32)
+    return AEState(
+        tick=jnp.int32(0),
+        d_ids=empty, d_node=zeros, d_ver=zeros,
+        d_dirty=jnp.zeros((s_cap,), bool),
+        a_ids=empty, a_node=zeros, a_ver=zeros,
+        next_full=stagger,
+        n_dirty=jnp.zeros((n,), bool),
+        syncs_done=jnp.int32(0),
+    )
+
+
+def register_desired(s: AEState, ids, nodes, vers) -> AEState:
+    """Host-side: add/update desired service instances (keeps id order)."""
+    d_ids = jnp.concatenate([s.d_ids, jnp.asarray(ids, jnp.int32)])
+    d_node = jnp.concatenate([s.d_node, jnp.asarray(nodes, jnp.int32)])
+    d_ver = jnp.concatenate([s.d_ver, jnp.asarray(vers, jnp.int32)])
+    d_dirty = jnp.concatenate([s.d_dirty, jnp.ones(len(ids), bool)])
+    prio = jnp.concatenate([jnp.ones_like(s.d_ids), jnp.zeros(len(ids), jnp.int32)])
+    order = jnp.lexsort((prio, d_ids))
+    d_ids, d_node, d_ver, d_dirty = (x[order] for x in (d_ids, d_node, d_ver, d_dirty))
+    first = jnp.concatenate([jnp.array([True]), d_ids[1:] != d_ids[:-1]])
+    d_ids = jnp.where(first, d_ids, reconcile.INVALID_ID)
+    order2 = jnp.argsort(jnp.where(d_ids == reconcile.INVALID_ID, 1, 0), stable=True)
+    cap = s.d_ids.shape[0]
+    return s.replace(d_ids=d_ids[order2][:cap], d_node=d_node[order2][:cap],
+                     d_ver=d_ver[order2][:cap], d_dirty=d_dirty[order2][:cap])
+
+
+def deregister_desired(s: AEState, ids) -> AEState:
+    ids = jnp.asarray(ids, jnp.int32)
+    pos = jnp.clip(jnp.searchsorted(s.d_ids, ids), 0, s.d_ids.shape[0] - 1)
+    hit = s.d_ids[pos] == ids
+    gone = jnp.zeros_like(s.d_ids, bool).at[jnp.where(hit, pos, 0)].max(hit)
+    # flag owners so the deletion syncs promptly (SyncChanges edge trigger)
+    n_dirty = s.n_dirty.at[jnp.where(gone, s.d_node, 0)].max(gone)
+    d_ids = jnp.where(gone, reconcile.INVALID_ID, s.d_ids)
+    order = jnp.argsort(jnp.where(d_ids == reconcile.INVALID_ID, 1, 0), stable=True)
+    return s.replace(d_ids=d_ids[order], d_node=s.d_node[order],
+                     d_ver=s.d_ver[order], d_dirty=s.d_dirty[order],
+                     n_dirty=n_dirty)
+
+
+def step(params: AEParams, s: AEState, up: jnp.ndarray) -> AEState:
+    """One tick: agents whose timer fired (or with dirty rows) sync.
+
+    `up`: [N] bool from the membership model — down agents don't sync
+    (their rows go stale until the leader reconciles them, mirroring
+    reference leader.go:1332 handleFailedMember)."""
+    tick = s.tick
+    due_full = (tick >= s.next_full) & up                         # [N]
+    # edge triggers: row-level change dirt or agent-level delete dirt
+    row_dirt_owner = jnp.zeros_like(up).at[
+        jnp.where(s.d_dirty, s.d_node, 0)].max(s.d_dirty)
+    due = (due_full | s.n_dirty | row_dirt_owner) & up            # [N]
+
+    diff = reconcile.diff_sorted(s.d_ids, s.d_ver, s.a_ids, s.a_ver)
+    push = diff.push & due[s.d_node]
+    drop = diff.drop & due[s.a_node]
+
+    a_ids = jnp.where(drop, reconcile.INVALID_ID, s.a_ids)
+    order = jnp.argsort(jnp.where(a_ids == reconcile.INVALID_ID, 1, 0), stable=True)
+    a_ids, a_node, a_ver = a_ids[order], s.a_node[order], s.a_ver[order]
+
+    a_ids, a_ver, a_node = _merge_push(s.d_ids, s.d_ver, s.d_node,
+                                       a_ids, a_ver, a_node, push)
+
+    # reset timers for agents that full-synced, with fresh stagger
+    key = prng.tick_key(params.seed, tick, 12)
+    jitter = jax.random.randint(
+        key, (params.n_agents,), 0,
+        max(1, int(params.scaled_interval * params.stagger_frac)) + 1, jnp.int32)
+    next_full = jnp.where(due_full, tick + params.scaled_interval + jitter,
+                          s.next_full)
+    return s.replace(tick=tick + 1, a_ids=a_ids, a_node=a_node, a_ver=a_ver,
+                     next_full=next_full,
+                     d_dirty=s.d_dirty & ~due[s.d_node],
+                     n_dirty=s.n_dirty & ~due,
+                     syncs_done=s.syncs_done + jnp.sum(due_full))
+
+
+def _merge_push(d_ids, d_ver, d_node, a_ids, a_ver, a_node, push):
+    """Merge pushed desired rows into the actual table (id-sorted, fixed cap)."""
+    cap = a_ids.shape[0]
+    cand = jnp.where(push, d_ids, reconcile.INVALID_ID)
+    ids = jnp.concatenate([cand, a_ids])
+    ver = jnp.concatenate([d_ver, a_ver])
+    node = jnp.concatenate([d_node, a_node])
+    prio = jnp.concatenate([jnp.zeros_like(cand), jnp.ones_like(a_ids)])
+    order = jnp.lexsort((prio, ids))
+    ids, ver, node = ids[order], ver[order], node[order]
+    first = jnp.concatenate([jnp.array([True]), ids[1:] != ids[:-1]])
+    ids = jnp.where(first, ids, reconcile.INVALID_ID)
+    order2 = jnp.argsort(jnp.where(ids == reconcile.INVALID_ID, 1, 0), stable=True)
+    return ids[order2][:cap], ver[order2][:cap], node[order2][:cap]
+
+
+def in_sync_fraction(s: AEState) -> jnp.ndarray:
+    """Fraction of live desired rows present and current in the catalog."""
+    diff = reconcile.diff_sorted(s.d_ids, s.d_ver, s.a_ids, s.a_ver)
+    live = s.d_ids != reconcile.INVALID_ID
+    return 1.0 - jnp.sum(diff.push & live) / jnp.maximum(jnp.sum(live), 1)

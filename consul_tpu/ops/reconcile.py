@@ -1,0 +1,71 @@
+"""Set-reconciliation kernel: sorted-merge diff of two keyed tables.
+
+The tensor replacement for the reference's per-entry map walk in
+`local.updateSyncState` (agent/local/state.go:880-1051), which diffs the
+agent's desired services/checks against the server catalog and emits
+register/deregister deltas.  Here both sides are id-sorted columnar tables
+and the diff is two vectorized binary-search joins — O((M+K) log K) work
+with no data-dependent shapes, so it scales to the 1M-service config of
+BASELINE.json on one chip.
+
+Invalid rows carry id = INT32_MAX so they sort to the tail and never match.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+INVALID_ID = jnp.int32(2**31 - 1)
+
+
+class DiffResult(NamedTuple):
+    push: jnp.ndarray   # [M] bool: src rows missing or stale in dst (register/update)
+    drop: jnp.ndarray   # [K] bool: dst rows absent from src (deregister)
+
+
+def diff_sorted(src_ids: jnp.ndarray, src_ver: jnp.ndarray,
+                dst_ids: jnp.ndarray, dst_ver: jnp.ndarray) -> DiffResult:
+    """Reconcile desired (src) against actual (dst); both id-ascending.
+
+    A src row is `push` when its id is absent from dst or present with a
+    different version (the reference compares full structs; versions stand
+    in for content hashes).  A dst row is `drop` when its id left src.
+    """
+    k = dst_ids.shape[0]
+    pos = jnp.clip(jnp.searchsorted(dst_ids, src_ids), 0, k - 1)
+    hit = (dst_ids[pos] == src_ids) & (src_ids != INVALID_ID)
+    stale = hit & (dst_ver[pos] != src_ver)
+    push = (src_ids != INVALID_ID) & (~hit | stale)
+
+    m = src_ids.shape[0]
+    rpos = jnp.clip(jnp.searchsorted(src_ids, dst_ids), 0, m - 1)
+    rhit = (src_ids[rpos] == dst_ids) & (dst_ids != INVALID_ID)
+    drop = (dst_ids != INVALID_ID) & ~rhit
+    return DiffResult(push=push, drop=drop)
+
+
+def apply_push(src_ids, src_ver, dst_ids, dst_ver, push: jnp.ndarray,
+               capacity_ok: bool = True):
+    """Merge pushed src rows into dst, keeping dst id-sorted.
+
+    Concatenate + sort by (id, source-priority) then dedup: the pushed copy
+    wins over a stale dst copy.  Returns new (dst_ids, dst_ver) with the
+    same capacity K (overflow rows beyond K are dropped — callers size K
+    ≥ live set, mirroring the watch-limit style capacity bounds of the
+    reference, state_store.go:87-97)."""
+    k = dst_ids.shape[0]
+    cand_ids = jnp.where(push, src_ids, INVALID_ID)
+    all_ids = jnp.concatenate([cand_ids, dst_ids])
+    all_ver = jnp.concatenate([src_ver, dst_ver])
+    # source-priority: pushed rows (index < M) win ties
+    prio = jnp.concatenate([jnp.zeros_like(cand_ids), jnp.ones_like(dst_ids)])
+    order = jnp.lexsort((prio, all_ids))
+    sids, sver = all_ids[order], all_ver[order]
+    first = jnp.concatenate([jnp.array([True]), sids[1:] != sids[:-1]])
+    sids = jnp.where(first, sids, INVALID_ID)
+    # compact: stable sort invalids to the tail, keep first K
+    order2 = jnp.argsort(jnp.where(sids == INVALID_ID, 1, 0), stable=True)
+    sids, sver = sids[order2], sver[order2]
+    return sids[:k], sver[:k]

@@ -1,0 +1,94 @@
+"""Anti-entropy: reconciliation kernel + paced sync semantics."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import antientropy as ae
+from consul_tpu.ops import reconcile
+
+
+def test_scale_factor_matches_reference():
+    # agent/ae/ae.go:27-40
+    assert ae.scale_factor(1) == 1
+    assert ae.scale_factor(128) == 1
+    assert ae.scale_factor(129) == 2
+    assert ae.scale_factor(256) == 2
+    assert ae.scale_factor(512) == 3
+    assert ae.scale_factor(8192) == 7
+
+
+def test_diff_sorted_basic():
+    inv = int(reconcile.INVALID_ID)
+    src = jnp.array([2, 5, 9, inv], jnp.int32)
+    sv = jnp.array([1, 1, 3, 0], jnp.int32)
+    dst = jnp.array([2, 7, 9, inv], jnp.int32)
+    dv = jnp.array([1, 1, 1, 0], jnp.int32)
+    d = reconcile.diff_sorted(src, sv, dst, dv)
+    np.testing.assert_array_equal(np.asarray(d.push), [False, True, True, False])
+    np.testing.assert_array_equal(np.asarray(d.drop), [False, True, False, False])
+
+
+def test_full_sync_converges_catalog():
+    params = ae.AEParams(n_agents=32, capacity=256, sync_interval_ticks=10, seed=3)
+    s = ae.init_state(params)
+    ids = jnp.arange(100, 200, dtype=jnp.int32)
+    nodes = ids % 32
+    s = ae.register_desired(s, ids, nodes, jnp.ones(100, jnp.int32))
+    step = jax.jit(ae.step, static_argnums=0)
+    up = jnp.ones((32,), bool)
+    for _ in range(30):
+        s = step(params, s, up)
+    assert float(ae.in_sync_fraction(s)) == 1.0
+    live = int(np.sum(np.asarray(s.a_ids) != int(reconcile.INVALID_ID)))
+    assert live == 100
+
+
+def test_deregister_syncs_promptly():
+    params = ae.AEParams(n_agents=8, capacity=64, sync_interval_ticks=50, seed=4)
+    s = ae.init_state(params)
+    ids = jnp.arange(10, 30, dtype=jnp.int32)
+    s = ae.register_desired(s, ids, ids % 8, jnp.ones(20, jnp.int32))
+    step = jax.jit(ae.step, static_argnums=0)
+    up = jnp.ones((8,), bool)
+    for _ in range(60):
+        s = step(params, s, up)
+    s = ae.deregister_desired(s, jnp.array([12, 17], jnp.int32))
+    # n_dirty edge trigger: deletion lands on the next tick, not next full sync
+    s = step(params, s, up)
+    a = np.asarray(s.a_ids)
+    assert 12 not in a and 17 not in a
+    assert int(np.sum(a != int(reconcile.INVALID_ID))) == 18
+
+
+def test_down_agent_rows_go_stale_until_it_returns():
+    params = ae.AEParams(n_agents=4, capacity=64, sync_interval_ticks=5, seed=5)
+    s = ae.init_state(params)
+    s = ae.register_desired(s, jnp.array([7], jnp.int32),
+                            jnp.array([2], jnp.int32), jnp.array([1], jnp.int32))
+    step = jax.jit(ae.step, static_argnums=0)
+    down = jnp.array([True, True, False, True])
+    for _ in range(20):
+        s = step(params, s, down)
+    assert float(ae.in_sync_fraction(s)) < 1.0   # agent 2 never synced
+    up = jnp.ones((4,), bool)
+    for _ in range(20):
+        s = step(params, s, up)
+    assert float(ae.in_sync_fraction(s)) == 1.0
+
+
+def test_version_bump_is_pushed():
+    params = ae.AEParams(n_agents=4, capacity=32, sync_interval_ticks=5, seed=6)
+    s = ae.init_state(params)
+    s = ae.register_desired(s, jnp.array([9], jnp.int32),
+                            jnp.array([1], jnp.int32), jnp.array([1], jnp.int32))
+    step = jax.jit(ae.step, static_argnums=0)
+    up = jnp.ones((4,), bool)
+    for _ in range(12):
+        s = step(params, s, up)
+    # update content (version 2) — re-register marks the row dirty
+    s = ae.register_desired(s, jnp.array([9], jnp.int32),
+                            jnp.array([1], jnp.int32), jnp.array([2], jnp.int32))
+    s = step(params, s, up)
+    pos = int(np.searchsorted(np.asarray(s.a_ids), 9))
+    assert int(np.asarray(s.a_ver)[pos]) == 2
