@@ -1,0 +1,101 @@
+"""Agent: wires the oracle, state store, and HTTP API into one lifecycle.
+
+The reference's agent (agent/agent.go:354 New / :446 Start) assembles
+config, the server core, local state, checks, and the HTTP/DNS servers.
+Here the assembly is: GossipOracle (device-resident membership +
+coordinates + events) + StateStore (host catalog/KV/sessions) + ApiServer
+(/v1 surface), plus a reconciler that mirrors the leader's serf→catalog
+loop (agent/consul/leader.go:1187 reconcileMember): members the gossip
+layer declares failed get their `serfHealth` check flipped critical and,
+on reap, deregistered.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from consul_tpu.api.http import ApiServer
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.oracle import GossipOracle
+
+
+class Agent:
+    def __init__(self, gossip: Optional[GossipConfig] = None,
+                 sim: Optional[SimConfig] = None,
+                 node_name: str = "node0", http_port: int = 0,
+                 dc: str = "dc1"):
+        self.oracle = GossipOracle(gossip, sim)
+        self.store = StateStore()
+        self.node_name = node_name
+        self.api = ApiServer(self.store, self.oracle, node_name=node_name,
+                             port=http_port, dc=dc)
+        self._reconcile_thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, tick_seconds: float = 0.0,
+              reconcile_interval: float = 0.5) -> None:
+        self.store.register_node(self.node_name, "127.0.0.1")
+        self.store.register_check(self.node_name, "serfHealth",
+                                  "Serf Health Status", status="passing")
+        self.oracle.start(tick_seconds)
+        self.api.start()
+        self._running = True
+
+        def reconcile_loop():
+            while self._running:
+                try:
+                    self.reconcile()
+                except Exception:
+                    pass
+                self.store.expire_sessions()
+                time.sleep(reconcile_interval)
+
+        self._reconcile_thread = threading.Thread(target=reconcile_loop,
+                                                  daemon=True)
+        self._reconcile_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        self.oracle.stop()
+        self.api.stop()
+        if self._reconcile_thread:
+            self._reconcile_thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------- reconcile
+
+    def reconcile(self) -> None:
+        """serf→catalog reconciliation (leader.go:1234 handleAliveMember /
+        :1332 handleFailedMember / :1390 handleReapMember)."""
+        catalog_nodes = {n["node"] for n in self.store.nodes()}
+        for m in self.oracle.members():
+            name = m["name"]
+            if name not in catalog_nodes:
+                continue  # only reconcile catalog-registered members
+            if m["status"] == "failed":
+                checks = {c["check_id"]: c
+                          for c in self.store.node_checks(name)}
+                sh = checks.get("serfHealth")
+                if sh is None or sh["status"] != "critical":
+                    self.store.register_check(
+                        name, "serfHealth", "Serf Health Status",
+                        status="critical",
+                        output="Agent not live or unreachable")
+            elif m["status"] == "left":
+                self.store.deregister_node(name)
+            else:
+                checks = {c["check_id"]: c
+                          for c in self.store.node_checks(name)}
+                sh = checks.get("serfHealth")
+                if sh is not None and sh["status"] != "passing":
+                    self.store.register_check(
+                        name, "serfHealth", "Serf Health Status",
+                        status="passing", output="Agent alive and reachable")
+
+    @property
+    def http_address(self) -> str:
+        return self.api.address

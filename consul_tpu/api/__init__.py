@@ -1,0 +1,4 @@
+from consul_tpu.api.http import ApiServer
+from consul_tpu.api.client import Client
+
+__all__ = ["ApiServer", "Client"]

@@ -1,0 +1,259 @@
+"""Python client library — the `api/` package equivalent (reference
+api/api.go: full Go client over HTTP; Lock/Semaphore in api/lock.go,
+api/semaphore.go).  Pure stdlib (urllib) so it has no dependency on the
+framework internals, mirroring how the reference keeps `api/` an
+independent module."""
+
+from __future__ import annotations
+
+import base64
+import json
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class ApiError(Exception):
+    def __init__(self, code: int, body: str):
+        super().__init__(f"HTTP {code}: {body}")
+        self.code = code
+
+
+class Client:
+    def __init__(self, address: str = "http://127.0.0.1:8500"):
+        self.address = address.rstrip("/")
+
+    # ------------------------------------------------------------- transport
+
+    def _call(self, verb: str, path: str, params: Dict[str, Any] | None = None,
+              body: bytes | None = None,
+              timeout: float = 330.0) -> Tuple[Any, int, bytes]:
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in (params or {}).items() if v is not None})
+        url = f"{self.address}{path}" + (f"?{qs}" if qs else "")
+        req = urllib.request.Request(url, data=body, method=verb)
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                raw = resp.read()
+                idx = int(resp.headers.get("X-Consul-Index") or 0)
+                ctype = resp.headers.get("Content-Type", "")
+                if "json" in ctype:
+                    return (json.loads(raw) if raw else None), idx, raw
+                return None, idx, raw
+        except urllib.error.HTTPError as e:
+            raise ApiError(e.code, e.read().decode(errors="replace")) from None
+
+    # -------------------------------------------------------------------- kv
+
+    def kv_put(self, key: str, value: bytes | str, flags: int = 0,
+               cas: Optional[int] = None, acquire: Optional[str] = None,
+               release: Optional[str] = None) -> bool:
+        if isinstance(value, str):
+            value = value.encode()
+        params = {"flags": flags or None, "cas": cas,
+                  "acquire": acquire, "release": release}
+        out, _, _ = self._call("PUT", f"/v1/kv/{key}", params, value)
+        return bool(out)
+
+    def kv_get(self, key: str, index: Optional[int] = None,
+               wait: Optional[str] = None) -> Tuple[Optional[dict], int]:
+        try:
+            out, idx, _ = self._call("GET", f"/v1/kv/{key}",
+                                     {"index": index, "wait": wait})
+        except ApiError as e:
+            if e.code == 404:
+                return None, 0
+            raise
+        row = out[0]
+        row["Value"] = base64.b64decode(row["Value"]) if row["Value"] else b""
+        return row, idx
+
+    def kv_list(self, prefix: str) -> List[dict]:
+        try:
+            out, _, _ = self._call("GET", f"/v1/kv/{prefix}", {"recurse": ""})
+        except ApiError as e:
+            if e.code == 404:
+                return []
+            raise
+        for row in out:
+            row["Value"] = base64.b64decode(row["Value"]) if row["Value"] else b""
+        return out
+
+    def kv_keys(self, prefix: str, separator: str = "") -> List[str]:
+        try:
+            out, _, _ = self._call("GET", f"/v1/kv/{prefix}",
+                                   {"keys": "", "separator": separator or None})
+            return out
+        except ApiError as e:
+            if e.code == 404:
+                return []
+            raise
+
+    def kv_delete(self, key: str, recurse: bool = False) -> bool:
+        out, _, _ = self._call("DELETE", f"/v1/kv/{key}",
+                               {"recurse": ""} if recurse else {})
+        return bool(out)
+
+    # --------------------------------------------------------------- catalog
+
+    def catalog_nodes(self, near: Optional[str] = None) -> List[dict]:
+        return self._call("GET", "/v1/catalog/nodes", {"near": near})[0]
+
+    def catalog_services(self) -> Dict[str, List[str]]:
+        return self._call("GET", "/v1/catalog/services")[0]
+
+    def catalog_service(self, name: str, tag: Optional[str] = None,
+                        near: Optional[str] = None) -> List[dict]:
+        return self._call("GET", f"/v1/catalog/service/{name}",
+                          {"tag": tag, "near": near})[0]
+
+    def catalog_register(self, node: str, address: str,
+                         service: Optional[dict] = None,
+                         check: Optional[dict] = None) -> bool:
+        body = {"Node": node, "Address": address}
+        if service:
+            body["Service"] = service
+        if check:
+            body["Check"] = check
+        return self._call("PUT", "/v1/catalog/register", None,
+                          json.dumps(body).encode())[0]
+
+    def catalog_deregister(self, node: str,
+                           service_id: Optional[str] = None) -> bool:
+        body = {"Node": node}
+        if service_id:
+            body["ServiceID"] = service_id
+        return self._call("PUT", "/v1/catalog/deregister", None,
+                          json.dumps(body).encode())[0]
+
+    # ---------------------------------------------------------------- health
+
+    def health_service(self, name: str, passing: bool = False,
+                       tag: Optional[str] = None,
+                       near: Optional[str] = None,
+                       index: Optional[int] = None,
+                       wait: Optional[str] = None) -> Tuple[List[dict], int]:
+        params = {"tag": tag, "near": near, "index": index, "wait": wait}
+        if passing:
+            params["passing"] = ""
+        out, idx, _ = self._call("GET", f"/v1/health/service/{name}", params)
+        return out, idx
+
+    def health_state(self, state: str = "any") -> List[dict]:
+        return self._call("GET", f"/v1/health/state/{state}")[0]
+
+    # ----------------------------------------------------------------- agent
+
+    def agent_self(self) -> dict:
+        return self._call("GET", "/v1/agent/self")[0]
+
+    def agent_members(self) -> List[dict]:
+        return self._call("GET", "/v1/agent/members")[0]
+
+    def agent_service_register(self, name: str, service_id: Optional[str] = None,
+                               port: int = 0, tags: List[str] | None = None,
+                               check: Optional[dict] = None) -> None:
+        body = {"Name": name, "ID": service_id or name, "Port": port,
+                "Tags": tags or []}
+        if check:
+            body["Check"] = check
+        self._call("PUT", "/v1/agent/service/register", None,
+                   json.dumps(body).encode())
+
+    def agent_service_deregister(self, service_id: str) -> None:
+        self._call("PUT", f"/v1/agent/service/deregister/{service_id}")
+
+    def agent_check_register(self, name: str, check_id: Optional[str] = None,
+                             service_id: str = "") -> None:
+        self._call("PUT", "/v1/agent/check/register", None, json.dumps(
+            {"Name": name, "CheckID": check_id or name,
+             "ServiceID": service_id}).encode())
+
+    def agent_check_update(self, check_id: str, status: str,
+                           note: str = "") -> None:
+        verb = {"passing": "pass", "warning": "warn",
+                "critical": "fail"}[status]
+        self._call("PUT", f"/v1/agent/check/{verb}/{check_id}",
+                   {"note": note or None})
+
+    def agent_force_leave(self, node: str) -> None:
+        self._call("PUT", f"/v1/agent/force-leave/{node}")
+
+    # -------------------------------------------------------------- sessions
+
+    def session_create(self, node: Optional[str] = None, ttl: str = "",
+                       behavior: str = "release") -> str:
+        body: Dict[str, Any] = {"Behavior": behavior}
+        if node:
+            body["Node"] = node
+        if ttl:
+            body["TTL"] = ttl
+        out, _, _ = self._call("PUT", "/v1/session/create", None,
+                               json.dumps(body).encode())
+        return out["ID"]
+
+    def session_destroy(self, sid: str) -> bool:
+        return self._call("PUT", f"/v1/session/destroy/{sid}")[0]
+
+    def session_renew(self, sid: str) -> dict:
+        return self._call("PUT", f"/v1/session/renew/{sid}")[0][0]
+
+    def session_list(self) -> List[dict]:
+        return self._call("GET", "/v1/session/list")[0]
+
+    # --------------------------------------------------------- coordinates
+
+    def coordinate_nodes(self) -> List[dict]:
+        return self._call("GET", "/v1/coordinate/nodes")[0]
+
+    def coordinate_node(self, node: str) -> List[dict]:
+        return self._call("GET", f"/v1/coordinate/node/{node}")[0]
+
+    # --------------------------------------------------------------- events
+
+    def event_fire(self, name: str, payload: bytes | str = b"") -> dict:
+        if isinstance(payload, str):
+            payload = payload.encode()
+        return self._call("PUT", f"/v1/event/fire/{name}", None, payload)[0]
+
+    def event_list(self, name: Optional[str] = None) -> List[dict]:
+        return self._call("GET", "/v1/event/list", {"name": name})[0]
+
+    # ------------------------------------------------------------------ txn
+
+    def txn(self, ops: List[dict]) -> dict:
+        try:
+            return self._call("PUT", "/v1/txn", None,
+                              json.dumps(ops).encode())[0]
+        except ApiError as e:
+            if e.code == 409:   # rolled back — body carries the op errors
+                return json.loads(str(e).split(": ", 1)[1])
+            raise
+
+    # ------------------------------------------------------------- snapshot
+
+    def snapshot_save(self) -> bytes:
+        return self._call("GET", "/v1/snapshot")[2]
+
+    def snapshot_restore(self, snap: bytes) -> None:
+        self._call("PUT", "/v1/snapshot", None, snap)
+
+    # ----------------------------------------------------------------- lock
+
+    def lock_acquire(self, key: str, value: bytes = b"", ttl: str = "15s",
+                     retries: int = 30, retry_wait: float = 0.2) -> Optional[str]:
+        """api/lock.go Lock(): session + acquire loop."""
+        sid = self.session_create(ttl=ttl)
+        for _ in range(retries):
+            if self.kv_put(key, value, acquire=sid):
+                return sid
+            time.sleep(retry_wait)
+        self.session_destroy(sid)
+        return None
+
+    def lock_release(self, key: str, sid: str) -> bool:
+        ok = self.kv_put(key, b"", release=sid)
+        self.session_destroy(sid)
+        return ok

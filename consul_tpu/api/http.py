@@ -1,0 +1,556 @@
+"""HTTP API — the Consul /v1 surface over (StateStore, GossipOracle).
+
+Route shape and JSON field names mirror the reference's HTTP API
+(route table agent/http_register.go:4-127; handler plumbing
+agent/http.go:115 registerEndpoint).  Implemented routes:
+
+  status:    /v1/status/leader /v1/status/peers
+  agent:     /v1/agent/self /v1/agent/members /v1/agent/metrics
+             /v1/agent/service/register /v1/agent/service/deregister/<id>
+             /v1/agent/check/register /v1/agent/check/(pass|warn|fail)/<id>
+             /v1/agent/force-leave/<node> /v1/agent/leave
+  catalog:   /v1/catalog/register /v1/catalog/deregister /v1/catalog/nodes
+             /v1/catalog/services /v1/catalog/service/<n> /v1/catalog/node/<n>
+  health:    /v1/health/service/<name>[?passing&tag=&near=]
+             /v1/health/node/<node> /v1/health/state/<state>
+  kv:        /v1/kv/<key> GET/PUT/DELETE with ?recurse ?keys ?raw ?cas=
+             ?flags= ?acquire= ?release= ?separator= and blocking ?index=&wait=
+  session:   /v1/session/create /destroy/<id> /renew/<id> /info/<id> /list /node/<n>
+  coordinate:/v1/coordinate/nodes /v1/coordinate/node/<node>
+  event:     /v1/event/fire/<name> /v1/event/list
+  txn:       /v1/txn
+  snapshot:  /v1/snapshot (GET save / PUT restore)
+
+Blocking queries honor ?index= & ?wait= (units "10s"/"1m") and every
+response carries X-Consul-Index (agent/consul/rpc.go:806 blockingQuery).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.oracle import GossipOracle
+from consul_tpu.version import VERSION
+
+
+def _parse_wait(val: str) -> float:
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", val)
+    if not m:
+        return 10.0
+    scale = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2) or "s"]
+    return float(m.group(1)) * scale
+
+
+class ApiServer:
+    """Threaded HTTP server bound to an ephemeral or fixed port."""
+
+    def __init__(self, store: StateStore, oracle: GossipOracle,
+                 node_name: str = "node0", host: str = "127.0.0.1",
+                 port: int = 0, dc: str = "dc1"):
+        self.store = store
+        self.oracle = oracle
+        self.node_name = node_name
+        self.dc = dc
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+
+def _make_handler(srv: ApiServer):
+    store, oracle = srv.store, srv.oracle
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # quiet
+            pass
+
+        # ------------------------------------------------------------ helpers
+
+        def _q(self):
+            parsed = urllib.parse.urlparse(self.path)
+            path = urllib.parse.unquote(parsed.path)
+            # trailing slashes are significant for KV keys (prefix reads)
+            if not path.startswith("/v1/kv/"):
+                path = path.rstrip("/")
+            return path, dict(
+                urllib.parse.parse_qsl(parsed.query, keep_blank_values=True))
+
+        def _body(self) -> bytes:
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n) if n else b""
+
+        def _send(self, obj, code: int = 200, raw: bytes | None = None,
+                  index: int | None = None):
+            payload = raw if raw is not None else json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type",
+                             "application/octet-stream" if raw is not None
+                             else "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.send_header("X-Consul-Index",
+                             str(index if index is not None else store.index))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def _err(self, code: int, msg: str):
+            self._send(None, code, raw=msg.encode())
+
+        def _block(self, q) -> int:
+            """Honor ?index/?wait before evaluating the read."""
+            if "index" in q:
+                wait = _parse_wait(q.get("wait", "300s"))
+                return store.wait_for(int(q["index"]), timeout=wait)
+            return store.index
+
+        # ------------------------------------------------------------- verbs
+
+        def do_GET(self):
+            self._route("GET")
+
+        def do_PUT(self):
+            self._route("PUT")
+
+        def do_DELETE(self):
+            self._route("DELETE")
+
+        def do_POST(self):
+            self._route("PUT")
+
+        def _route(self, verb: str):
+            try:
+                path, q = self._q()
+                if self._dispatch(verb, path, q):
+                    return
+                self._err(404, f"no route {verb} {path}")
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # pragma: no cover
+                try:
+                    self._err(500, f"{type(e).__name__}: {e}")
+                except Exception:
+                    pass
+
+        # ---------------------------------------------------------- dispatch
+
+        def _dispatch(self, verb: str, path: str, q) -> bool:
+            if path.startswith("/v1/kv/"):
+                return self._kv(verb, path[len("/v1/kv/"):], q)
+            if path == "/v1/status/leader" and verb == "GET":
+                self._send("127.0.0.1:8300")
+                return True
+            if path == "/v1/status/peers" and verb == "GET":
+                self._send(["127.0.0.1:8300"])
+                return True
+            if path == "/v1/agent/self" and verb == "GET":
+                self._send({"Config": {"NodeName": srv.node_name,
+                                       "Datacenter": srv.dc,
+                                       "Server": True,
+                                       "Version": VERSION},
+                            "Stats": {"sim_tick": oracle.tick,
+                                      "sim_nodes": oracle.n_nodes}})
+                return True
+            if path == "/v1/agent/members" and verb == "GET":
+                self._send([_member_json(m) for m in oracle.members()])
+                return True
+            if path == "/v1/agent/metrics" and verb == "GET":
+                self._send({"Timestamp": "", "Gauges": [
+                    {"Name": "consul.sim.tick", "Value": oracle.tick},
+                    {"Name": "consul.catalog.index", "Value": store.index},
+                ], "Counters": [], "Samples": []})
+                return True
+            if path == "/v1/agent/service/register" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                sid = body.get("ID") or body.get("Name")
+                store.register_service(
+                    srv.node_name, sid, body.get("Name", sid),
+                    port=body.get("Port", 0), tags=body.get("Tags") or [],
+                    meta=body.get("Meta") or {},
+                    address=body.get("Address", ""))
+                if "Check" in body and body["Check"]:
+                    chk = body["Check"]
+                    store.register_check(
+                        srv.node_name, chk.get("CheckID", f"service:{sid}"),
+                        chk.get("Name", f"Service '{sid}' check"),
+                        status=chk.get("Status", "critical"), service_id=sid)
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/service/deregister/(.+)", path)
+            if m and verb == "PUT":
+                store.deregister_service(srv.node_name, m.group(1))
+                self._send(None)
+                return True
+            if path == "/v1/agent/check/register" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                store.register_check(
+                    srv.node_name, body.get("CheckID") or body.get("Name"),
+                    body.get("Name", ""), status=body.get("Status", "critical"),
+                    service_id=body.get("ServiceID", ""))
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/check/(pass|warn|fail)/(.+)", path)
+            if m and verb == "PUT":
+                status = {"pass": "passing", "warn": "warning",
+                          "fail": "critical"}[m.group(1)]
+                try:
+                    store.update_check(srv.node_name, m.group(2), status,
+                                       output=q.get("note", ""))
+                except KeyError:
+                    self._err(404, "unknown check")
+                    return True
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/force-leave/(.+)", path)
+            if m and verb == "PUT":
+                oracle.leave(m.group(1))
+                self._send(None)
+                return True
+            if path == "/v1/agent/leave" and verb == "PUT":
+                oracle.leave(srv.node_name)
+                self._send(None)
+                return True
+            if path == "/v1/catalog/register" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                node = body.get("Node", srv.node_name)
+                idx = store.register_node(node, body.get("Address", ""),
+                                          meta=body.get("NodeMeta") or {})
+                svc = body.get("Service")
+                if svc:
+                    idx = store.register_service(
+                        node, svc.get("ID") or svc.get("Service"),
+                        svc.get("Service", ""), port=svc.get("Port", 0),
+                        tags=svc.get("Tags") or [],
+                        address=svc.get("Address", ""))
+                chk = body.get("Check")
+                if chk:
+                    idx = store.register_check(
+                        node, chk.get("CheckID", ""), chk.get("Name", ""),
+                        status=chk.get("Status", "critical"),
+                        service_id=chk.get("ServiceID", ""))
+                self._send(True, index=idx)
+                return True
+            if path == "/v1/catalog/deregister" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                node = body.get("Node")
+                if body.get("ServiceID"):
+                    store.deregister_service(node, body["ServiceID"])
+                else:
+                    store.deregister_node(node)
+                self._send(True)
+                return True
+            if path == "/v1/catalog/nodes" and verb == "GET":
+                idx = self._block(q)
+                rows = [{"Node": n["node"], "ID": n["id"],
+                         "Address": n["address"], "Meta": n["meta"],
+                         "ModifyIndex": n["modify_index"]}
+                        for n in store.nodes()]
+                if "near" in q:
+                    rows = self._near_sort(q["near"], rows,
+                                           key=lambda r: r["Node"])
+                self._send(rows, index=idx)
+                return True
+            if path == "/v1/catalog/services" and verb == "GET":
+                idx = self._block(q)
+                self._send(store.services(), index=idx)
+                return True
+            m = re.fullmatch(r"/v1/catalog/service/(.+)", path)
+            if m and verb == "GET":
+                idx = self._block(q)
+                rows = store.service_nodes(m.group(1), tag=q.get("tag"))
+                out = [_catalog_service_json(r) for r in rows]
+                if "near" in q:
+                    out = self._near_sort(q["near"], out,
+                                          key=lambda r: r["Node"])
+                self._send(out, index=idx)
+                return True
+            m = re.fullmatch(r"/v1/catalog/node/(.+)", path)
+            if m and verb == "GET":
+                idx = self._block(q)
+                node = m.group(1)
+                nrec = next((n for n in store.nodes() if n["node"] == node),
+                            None)
+                if nrec is None:
+                    self._send(None, index=idx)
+                    return True
+                svcs = {s["id"]: {"ID": s["id"], "Service": s["name"],
+                                  "Tags": s["tags"], "Port": s["port"],
+                                  "Meta": s["meta"]}
+                        for s in store.node_services(node)}
+                self._send({"Node": {"Node": node, "Address": nrec["address"],
+                                     "Meta": nrec["meta"]},
+                            "Services": svcs}, index=idx)
+                return True
+            m = re.fullmatch(r"/v1/health/service/(.+)", path)
+            if m and verb == "GET":
+                idx = self._block(q)
+                rows = store.health_service_nodes(
+                    m.group(1), tag=q.get("tag"),
+                    passing_only="passing" in q)
+                out = [_health_json(r, store) for r in rows]
+                if "near" in q:
+                    out = self._near_sort(q["near"], out,
+                                          key=lambda r: r["Node"]["Node"])
+                self._send(out, index=idx)
+                return True
+            m = re.fullmatch(r"/v1/health/node/(.+)", path)
+            if m and verb == "GET":
+                idx = self._block(q)
+                self._send([_check_json(c, c.get("node", m.group(1)))
+                            for c in store.node_checks(m.group(1))], index=idx)
+                return True
+            m = re.fullmatch(r"/v1/health/state/(.+)", path)
+            if m and verb == "GET":
+                idx = self._block(q)
+                self._send([_check_json(c, c["node"])
+                            for c in store.checks_in_state(m.group(1))],
+                           index=idx)
+                return True
+            if path == "/v1/session/create" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                ttl = _parse_wait(body["TTL"]) if body.get("TTL") else 0.0
+                sid, _ = store.session_create(
+                    body.get("Node", srv.node_name), ttl=ttl,
+                    behavior=body.get("Behavior", "release"),
+                    lock_delay=_parse_wait(str(body.get("LockDelay", "15s"))))
+                self._send({"ID": sid})
+                return True
+            m = re.fullmatch(r"/v1/session/destroy/(.+)", path)
+            if m and verb == "PUT":
+                store.session_destroy(m.group(1))
+                self._send(True)
+                return True
+            m = re.fullmatch(r"/v1/session/renew/(.+)", path)
+            if m and verb == "PUT":
+                ok = store.session_renew(m.group(1))
+                if not ok:
+                    self._err(404, "session not found")
+                    return True
+                info = store.session_info(m.group(1))
+                self._send([_session_json(info)])
+                return True
+            m = re.fullmatch(r"/v1/session/info/(.+)", path)
+            if m and verb == "GET":
+                info = store.session_info(m.group(1))
+                self._send([_session_json(info)] if info else [])
+                return True
+            if path == "/v1/session/list" and verb == "GET":
+                self._send([_session_json(s) for s in store.session_list()])
+                return True
+            m = re.fullmatch(r"/v1/session/node/(.+)", path)
+            if m and verb == "GET":
+                self._send([_session_json(s) for s in store.session_list()
+                            if s["node"] == m.group(1)])
+                return True
+            if path == "/v1/coordinate/nodes" and verb == "GET":
+                out = []
+                for mem in oracle.members():
+                    if mem["status"] != "alive":
+                        continue
+                    c = oracle.coordinate(mem["name"])
+                    out.append(_coord_json(c, srv.dc))
+                self._send(out)
+                return True
+            m = re.fullmatch(r"/v1/coordinate/node/(.+)", path)
+            if m and verb == "GET":
+                try:
+                    c = oracle.coordinate(m.group(1))
+                except KeyError:
+                    self._send([])
+                    return True
+                self._send([_coord_json(c, srv.dc)])
+                return True
+            m = re.fullmatch(r"/v1/event/fire/(.+)", path)
+            if m and verb == "PUT":
+                payload = self._body()
+                eid = oracle.fire_event(m.group(1), payload,
+                                        origin=srv.node_name)
+                self._send({"ID": eid, "Name": m.group(1),
+                            "Payload": base64.b64encode(payload).decode(),
+                            "Version": 1, "LTime": 0})
+                return True
+            if path == "/v1/event/list" and verb == "GET":
+                name = q.get("name")
+                out = [{"ID": str(e["id"]), "Name": e["name"],
+                        "Payload": base64.b64encode(e["payload"]).decode(),
+                        "LTime": e["ltime"],
+                        "Coverage": oracle.event_coverage(e["id"])}
+                       for e in oracle.event_list()
+                       if name is None or e["name"] == name]
+                self._send(out)
+                return True
+            if path == "/v1/txn" and verb == "PUT":
+                return self._txn()
+            if path == "/v1/snapshot" and verb == "GET":
+                snap = json.dumps(store.snapshot()).encode()
+                self._send(None, raw=snap)
+                return True
+            if path == "/v1/snapshot" and verb == "PUT":
+                snap = json.loads(self._body())
+                restored = StateStore.restore(snap)
+                with store._lock:
+                    store.__dict__.update(
+                        {k: v for k, v in restored.__dict__.items()
+                         if k not in ("_lock", "_cond")})
+                    store._cond.notify_all()
+                self._send(None)
+                return True
+            return False
+
+        # ------------------------------------------------------------- kv
+
+        def _kv(self, verb: str, key: str, q) -> bool:
+            if verb == "GET":
+                idx = self._block(q)
+                if "keys" in q:
+                    keys = store.kv_keys(key, q.get("separator", ""))
+                    if not keys:
+                        self._err(404, "")
+                        return True
+                    self._send(keys, index=idx)
+                    return True
+                rows = store.kv_list(key) if "recurse" in q else \
+                    ([store.kv_get(key)] if store.kv_get(key) else [])
+                if not rows:
+                    self._err(404, "")
+                    return True
+                if "raw" in q:
+                    self._send(None, raw=rows[0]["value"], index=idx)
+                    return True
+                self._send([_kv_json(r) for r in rows], index=idx)
+                return True
+            if verb == "PUT":
+                ok, idx = store.kv_set(
+                    key, self._body(),
+                    flags=int(q.get("flags", 0)),
+                    cas=int(q["cas"]) if "cas" in q else None,
+                    acquire=q.get("acquire"), release=q.get("release"))
+                self._send(ok, index=idx)
+                return True
+            if verb == "DELETE":
+                ok, idx = store.kv_delete(
+                    key, recurse="recurse" in q,
+                    cas=int(q["cas"]) if "cas" in q else None)
+                self._send(ok, index=idx)
+                return True
+            return False
+
+        def _txn(self) -> bool:
+            body = json.loads(self._body() or b"[]")
+            ops = []
+            for item in body:
+                kv = item.get("KV")
+                if not kv:
+                    self._err(400, "only KV txn ops supported")
+                    return True
+                verb = kv["Verb"]
+                op = {"verb": verb, "key": kv["Key"]}
+                if "Value" in kv and kv["Value"] is not None:
+                    op["value"] = base64.b64decode(kv["Value"])
+                if "Index" in kv:
+                    op["index"] = kv["Index"]
+                if "Session" in kv:
+                    op["session"] = kv["Session"]
+                if "Flags" in kv:
+                    op["flags"] = kv["Flags"]
+                ops.append(op)
+            ok, results, idx = store.txn(ops)
+            if not ok:
+                self._send({"Results": None,
+                            "Errors": [{"OpIndex": len(results) - 1 if results else 0,
+                                        "What": "txn op failed"}]}, code=409)
+                return True
+            out = []
+            for op in ops:
+                if op["verb"] == "get":
+                    e = store.kv_get(op["key"])
+                    out.append({"KV": _kv_json(e) if e else None})
+            self._send({"Results": out, "Errors": None}, index=idx)
+            return True
+
+        def _near_sort(self, origin: str, rows, key):
+            names = [key(r) for r in rows]
+            try:
+                order = oracle.sort_by_rtt(origin, names)
+            except KeyError:
+                return rows
+            pos = {n: i for i, n in enumerate(order)}
+            return sorted(rows, key=lambda r: pos.get(key(r), 1 << 30))
+
+    return Handler
+
+
+# ------------------------------------------------------------ JSON shapers
+
+def _member_json(m: dict) -> dict:
+    status_code = {"alive": 1, "leaving": 2, "left": 3, "failed": 4}
+    return {"Name": m["name"], "Addr": f"10.{(m['id'] >> 16) & 255}."
+            f"{(m['id'] >> 8) & 255}.{m['id'] & 255}",
+            "Port": 8301, "Status": status_code.get(m["status"], 0),
+            "Tags": {"role": "node", "incarnation": str(m["incarnation"])}}
+
+
+def _kv_json(e: dict) -> dict:
+    return {"Key": e["key"], "Flags": e["flags"],
+            "Value": base64.b64encode(e["value"]).decode(),
+            "CreateIndex": e["create_index"], "ModifyIndex": e["modify_index"],
+            "LockIndex": e.get("lock_index", 0),
+            **({"Session": e["session"]} if e.get("session") else {})}
+
+
+def _catalog_service_json(r: dict) -> dict:
+    return {"Node": r["node"], "Address": r["address"],
+            "ServiceID": r["service_id"], "ServiceName": r["service_name"],
+            "ServiceTags": r["tags"], "ServicePort": r["port"],
+            "ServiceAddress": r["service_address"],
+            "ModifyIndex": r["modify_index"]}
+
+
+def _check_json(c: dict, node: str) -> dict:
+    return {"Node": node, "CheckID": c["check_id"], "Name": c["name"],
+            "Status": c["status"], "Output": c["output"],
+            "ServiceID": c["service_id"]}
+
+
+def _health_json(r: dict, store: StateStore) -> dict:
+    svc = r["service"]
+    return {"Node": {"Node": svc["node"], "Address": svc["address"]},
+            "Service": {"ID": svc["service_id"], "Service": svc["service_name"],
+                        "Tags": svc["tags"], "Port": svc["port"],
+                        "Address": svc["service_address"]},
+            "Checks": [_check_json(c, svc["node"]) for c in r["checks"]]}
+
+
+def _session_json(s: dict) -> dict:
+    return {"ID": s["id"], "Node": s["node"], "Behavior": s["behavior"],
+            "TTL": f"{s['ttl']}s" if s["ttl"] else "",
+            "LockDelay": s["lock_delay"], "Checks": s["checks"],
+            "CreateIndex": s["create_index"]}
+
+
+def _coord_json(c: dict, dc: str) -> dict:
+    return {"Node": c["node"], "Segment": "",
+            "Coord": {"Vec": c["vec"], "Error": c["error"],
+                      "Adjustment": c["adjustment"], "Height": c["height"]}}

@@ -1,0 +1,3 @@
+from consul_tpu.catalog.store import StateStore
+
+__all__ = ["StateStore"]

@@ -1,0 +1,466 @@
+"""Host-side state store: catalog / KV / sessions with watch semantics.
+
+The live-path equivalent of the reference's memdb-backed state store
+(agent/consul/state/state_store.go:102-120: Store methods + WatchSet
+watches; schema agent/consul/state/schema.go:10).  The TPU oracle owns
+membership/coordinates at simulation scale; this store owns the small-N
+strongly-consistent side: service catalog, KV, sessions, health — with the
+same observable semantics as the reference:
+
+  * every write bumps a monotone raft-style index; reads report the index
+    (X-Consul-Index equivalent) so clients can long-poll;
+  * blocking queries: `wait_for(index, predicate, timeout)` parks until a
+    relevant write lands, mirroring blockingQuery (agent/consul/rpc.go:806)
+    with prefix-granular wakeups (memdb per-index watch channels);
+  * KV supports flags, CAS, session locks with lock-delay
+    (state/kvs.go lock semantics), recurse/prefix reads, tombstone-free
+    delete-index tracking (deletes bump the prefix index like the
+    reference's graveyard, state/graveyard.go);
+  * sessions: TTL expiry + invalidation releases or deletes held locks
+    (session behavior — agent/consul/session_ttl.go:110 invalidateSession).
+
+Thread-safe; one process-wide lock (writes are small and fast — the bulk
+work lives on the device).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class StateStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._index = 0
+        # kv: key -> dict(value, flags, create_index, modify_index, session)
+        self._kv: Dict[str, dict] = {}
+        self._kv_delete_index: Dict[str, int] = {}  # prefix-bump on deletes
+        # catalog
+        self._nodes: Dict[str, dict] = {}
+        self._services: Dict[Tuple[str, str], dict] = {}   # (node, sid) -> svc
+        self._checks: Dict[Tuple[str, str], dict] = {}     # (node, cid) -> chk
+        # sessions: id -> dict(node, ttl, behavior, create_index, expires, lock_delay)
+        self._sessions: Dict[str, dict] = {}
+        self._lock_delays: Dict[str, float] = {}           # key -> until ts
+
+    # ------------------------------------------------------------------ core
+
+    @property
+    def index(self) -> int:
+        with self._lock:
+            return self._index
+
+    def _bump(self) -> int:
+        self._index += 1
+        self._cond.notify_all()
+        return self._index
+
+    def wait_for(self, index: Optional[int], timeout: float = 300.0) -> int:
+        """Park until the store index exceeds `index` (blocking query).
+
+        Returns the current index.  index=None returns immediately.
+        Mirrors agent/consul/rpc.go:806 blockingQuery: no spurious early
+        return, wait capped by timeout."""
+        deadline = time.time() + timeout
+        with self._lock:
+            if index is None:
+                return self._index
+            while self._index <= index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self._index
+
+    # -------------------------------------------------------------------- KV
+
+    def kv_set(self, key: str, value: bytes, flags: int = 0,
+               cas: Optional[int] = None, acquire: Optional[str] = None,
+               release: Optional[str] = None) -> Tuple[bool, int]:
+        """PUT /v1/kv/<key> semantics incl. ?cas= ?acquire= ?release=
+        (reference agent/kvs_endpoint.go:15, state/kvs.go)."""
+        now = time.time()
+        with self._lock:
+            entry = self._kv.get(key)
+            if cas is not None:
+                current = entry["modify_index"] if entry else 0
+                if cas != current:
+                    return False, self._index
+            if acquire is not None:
+                if acquire not in self._sessions:
+                    return False, self._index
+                if now < self._lock_delays.get(key, 0.0):
+                    return False, self._index
+                if entry and entry.get("session") not in (None, acquire):
+                    return False, self._index
+            if release is not None:
+                if entry is None or entry.get("session") != release:
+                    return False, self._index
+            idx = self._bump()
+            if entry is None:
+                entry = {"value": value, "flags": flags, "create_index": idx,
+                         "modify_index": idx, "session": None,
+                         "lock_index": 0}
+                self._kv[key] = entry
+            else:
+                entry["value"] = value
+                entry["flags"] = flags
+                entry["modify_index"] = idx
+            if acquire is not None and entry.get("session") != acquire:
+                entry["session"] = acquire
+                entry["lock_index"] = entry.get("lock_index", 0) + 1
+            if release is not None:
+                entry["session"] = None
+            return True, idx
+
+    def kv_get(self, key: str) -> Optional[dict]:
+        with self._lock:
+            e = self._kv.get(key)
+            return dict(e, key=key) if e else None
+
+    def kv_list(self, prefix: str) -> List[dict]:
+        with self._lock:
+            return [dict(e, key=k) for k, e in sorted(self._kv.items())
+                    if k.startswith(prefix)]
+
+    def kv_keys(self, prefix: str, separator: str = "") -> List[str]:
+        with self._lock:
+            keys = sorted(k for k in self._kv if k.startswith(prefix))
+        if not separator:
+            return keys
+        out: List[str] = []
+        for k in keys:
+            rest = k[len(prefix):]
+            cut = rest.find(separator)
+            item = k if cut < 0 else prefix + rest[: cut + len(separator)]
+            if not out or out[-1] != item:
+                out.append(item)
+        return out
+
+    def kv_delete(self, key: str, recurse: bool = False,
+                  cas: Optional[int] = None) -> Tuple[bool, int]:
+        with self._lock:
+            keys = ([k for k in self._kv if k.startswith(key)] if recurse
+                    else ([key] if key in self._kv else []))
+            if cas is not None:
+                entry = self._kv.get(key)
+                current = entry["modify_index"] if entry else 0
+                if cas != current:
+                    return False, self._index
+            if not keys:
+                return True, self._index
+            idx = self._bump()
+            for k in keys:
+                del self._kv[k]
+                self._kv_delete_index[k] = idx
+            return True, idx
+
+    # --------------------------------------------------------------- catalog
+
+    def register_node(self, node: str, address: str, meta: dict | None = None,
+                      node_id: str | None = None) -> int:
+        """Catalog.Register node part (agent/consul/catalog_endpoint.go:144)."""
+        with self._lock:
+            idx = self._bump()
+            existing = self._nodes.get(node, {})
+            self._nodes[node] = {
+                "address": address, "meta": meta or {},
+                "id": node_id or existing.get("id") or str(uuid.uuid4()),
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def register_service(self, node: str, service_id: str, name: str,
+                         port: int = 0, tags: List[str] | None = None,
+                         meta: dict | None = None, address: str = "") -> int:
+        with self._lock:
+            if node not in self._nodes:
+                self.register_node(node, address or "127.0.0.1")
+            idx = self._bump()
+            key = (node, service_id)
+            existing = self._services.get(key, {})
+            self._services[key] = {
+                "name": name, "port": port, "tags": tags or [],
+                "meta": meta or {}, "address": address,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def register_check(self, node: str, check_id: str, name: str,
+                       status: str = "critical", service_id: str = "",
+                       output: str = "") -> int:
+        with self._lock:
+            idx = self._bump()
+            key = (node, check_id)
+            existing = self._checks.get(key, {})
+            self._checks[key] = {
+                "name": name, "status": status, "service_id": service_id,
+                "output": output,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def update_check(self, node: str, check_id: str, status: str,
+                     output: str = "") -> int:
+        with self._lock:
+            key = (node, check_id)
+            if key not in self._checks:
+                raise KeyError(f"unknown check {key}")
+            idx = self._bump()
+            self._checks[key]["status"] = status
+            self._checks[key]["output"] = output
+            self._checks[key]["modify_index"] = idx
+            return idx
+
+    def deregister_node(self, node: str) -> int:
+        """Full node deregistration cascades services/checks/sessions/locks
+        (leader reconcile path, agent/consul/leader.go:1332)."""
+        with self._lock:
+            idx = self._bump()
+            self._nodes.pop(node, None)
+            for key in [k for k in self._services if k[0] == node]:
+                del self._services[key]
+            for key in [k for k in self._checks if k[0] == node]:
+                del self._checks[key]
+            for sid in [s for s, v in self._sessions.items()
+                        if v["node"] == node]:
+                self._invalidate_session_locked(sid)
+            return idx
+
+    def deregister_service(self, node: str, service_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._services.pop((node, service_id), None)
+            for key in [k for k, c in self._checks.items()
+                        if k[0] == node and c["service_id"] == service_id]:
+                del self._checks[key]
+            return idx
+
+    def nodes(self) -> List[dict]:
+        with self._lock:
+            return [dict(v, node=k) for k, v in sorted(self._nodes.items())]
+
+    def node_services(self, node: str) -> List[dict]:
+        with self._lock:
+            return [dict(v, id=sid, node=n)
+                    for (n, sid), v in sorted(self._services.items())
+                    if n == node]
+
+    def services(self) -> Dict[str, List[str]]:
+        """GET /v1/catalog/services shape: name -> union of tags."""
+        with self._lock:
+            out: Dict[str, set] = {}
+            for v in self._services.values():
+                out.setdefault(v["name"], set()).update(v["tags"])
+            return {k: sorted(v) for k, v in sorted(out.items())}
+
+    def service_nodes(self, name: str, tag: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            rows = []
+            for (node, sid), v in sorted(self._services.items()):
+                if v["name"] != name:
+                    continue
+                if tag and tag not in v["tags"]:
+                    continue
+                nrec = self._nodes.get(node, {})
+                rows.append({"node": node, "address": nrec.get("address", ""),
+                             "service_id": sid, "service_name": name,
+                             "port": v["port"], "tags": v["tags"],
+                             "service_address": v["address"],
+                             "modify_index": v["modify_index"]})
+            return rows
+
+    def health_service_nodes(self, name: str, tag: Optional[str] = None,
+                             passing_only: bool = False) -> List[dict]:
+        """GET /v1/health/service/<name> (agent/consul/health_endpoint.go:174):
+        service rows joined with their node+service checks."""
+        with self._lock:
+            rows = []
+            for svc in self.service_nodes(name, tag):
+                node, sid = svc["node"], svc["service_id"]
+                checks = [dict(c, check_id=cid, node=n)
+                          for (n, cid), c in sorted(self._checks.items())
+                          if n == node and c["service_id"] in ("", sid)]
+                if passing_only and any(c["status"] != "passing"
+                                        for c in checks):
+                    continue
+                rows.append({"service": svc, "checks": checks})
+            return rows
+
+    def node_checks(self, node: str) -> List[dict]:
+        with self._lock:
+            return [dict(c, check_id=cid) for (n, cid), c
+                    in sorted(self._checks.items()) if n == node]
+
+    def checks_in_state(self, status: str) -> List[dict]:
+        with self._lock:
+            return [dict(c, check_id=cid, node=n)
+                    for (n, cid), c in sorted(self._checks.items())
+                    if status == "any" or c["status"] == status]
+
+    # -------------------------------------------------------------- sessions
+
+    def session_create(self, node: str, ttl: float = 0.0,
+                       behavior: str = "release",
+                       lock_delay: float = 15.0,
+                       checks: List[str] | None = None) -> Tuple[str, int]:
+        """PUT /v1/session/create (agent/consul/session_endpoint.go)."""
+        with self._lock:
+            if node not in self._nodes:
+                raise KeyError(f"unknown node {node}")
+            sid = str(uuid.uuid4())
+            idx = self._bump()
+            self._sessions[sid] = {
+                "node": node, "ttl": ttl, "behavior": behavior,
+                "lock_delay": lock_delay, "checks": checks or ["serfHealth"],
+                "create_index": idx,
+                "expires": (time.time() + ttl) if ttl > 0 else None,
+            }
+            return sid, idx
+
+    def session_renew(self, sid: str) -> bool:
+        with self._lock:
+            sess = self._sessions.get(sid)
+            if sess is None:
+                return False
+            if sess["ttl"] > 0:
+                sess["expires"] = time.time() + sess["ttl"]
+            return True
+
+    def session_destroy(self, sid: str) -> int:
+        with self._lock:
+            self._invalidate_session_locked(sid)
+            return self._index
+
+    def session_info(self, sid: str) -> Optional[dict]:
+        with self._lock:
+            s = self._sessions.get(sid)
+            return dict(s, id=sid) if s else None
+
+    def session_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v, id=k) for k, v in sorted(self._sessions.items())]
+
+    def expire_sessions(self, now: Optional[float] = None) -> List[str]:
+        """TTL sweep — the leader's session timer loop
+        (agent/consul/session_ttl.go:45 initializeSessionTimers)."""
+        now = now if now is not None else time.time()
+        expired = []
+        with self._lock:
+            for sid, sess in list(self._sessions.items()):
+                if sess["expires"] is not None and now >= sess["expires"]:
+                    expired.append(sid)
+                    self._invalidate_session_locked(sid)
+        return expired
+
+    def _invalidate_session_locked(self, sid: str) -> None:
+        """Release/delete locks held by the session, then drop it
+        (invalidateSession — agent/consul/session_ttl.go:110)."""
+        sess = self._sessions.pop(sid, None)
+        if sess is None:
+            return
+        idx = self._bump()
+        delay = sess.get("lock_delay", 0.0)
+        for key, entry in list(self._kv.items()):
+            if entry.get("session") == sid:
+                if sess["behavior"] == "delete":
+                    del self._kv[key]
+                    self._kv_delete_index[key] = idx
+                else:
+                    entry["session"] = None
+                    entry["modify_index"] = idx
+                if delay > 0:
+                    self._lock_delays[key] = time.time() + delay
+
+    # ------------------------------------------------------------------- txn
+
+    def txn(self, ops: List[dict]) -> Tuple[bool, List[Any], int]:
+        """Atomic multi-op (Txn.Apply — agent/consul/txn_endpoint.go:142).
+
+        Each op: {"verb": ..., ...args}.  All-or-nothing: state mutates only
+        if every op succeeds."""
+        import copy
+        with self._lock:
+            snapshot = (copy.deepcopy(self._kv),
+                        copy.deepcopy(self._kv_delete_index),
+                        copy.deepcopy(self._nodes),
+                        copy.deepcopy(self._services),
+                        copy.deepcopy(self._checks),
+                        self._index)
+            results: List[Any] = []
+            ok = True
+            for op in ops:
+                verb = op["verb"]
+                if verb == "set":
+                    good, _ = self.kv_set(op["key"], op["value"],
+                                          op.get("flags", 0))
+                elif verb == "cas":
+                    good, _ = self.kv_set(op["key"], op["value"],
+                                          op.get("flags", 0), cas=op["index"])
+                elif verb == "delete":
+                    good, _ = self.kv_delete(op["key"])
+                elif verb == "delete-cas":
+                    good, _ = self.kv_delete(op["key"], cas=op["index"])
+                elif verb == "get":
+                    res = self.kv_get(op["key"])
+                    good = res is not None
+                    results.append(res)
+                    continue
+                elif verb == "check-index":
+                    e = self.kv_get(op["key"])
+                    good = e is not None and e["modify_index"] == op["index"]
+                elif verb == "lock":
+                    good, _ = self.kv_set(op["key"], op["value"],
+                                          acquire=op["session"])
+                else:
+                    raise ValueError(f"unknown txn verb {verb}")
+                results.append(good)
+                if not good:
+                    ok = False
+                    break
+            if not ok:
+                (self._kv, self._kv_delete_index, self._nodes,
+                 self._services, self._checks, self._index) = snapshot
+                return False, results, self._index
+            return True, results, self._index
+
+    # -------------------------------------------------------- snapshot/restore
+
+    def snapshot(self) -> dict:
+        """Serializable full-state image (FSM Snapshot —
+        agent/consul/fsm/fsm.go:145; user archive snapshot/snapshot.go:164)."""
+        import base64
+        with self._lock:
+            return {
+                "index": self._index,
+                "kv": {k: dict(v, value=base64.b64encode(v["value"]).decode())
+                       for k, v in self._kv.items()},
+                "nodes": dict(self._nodes),
+                "services": {f"{n}\x00{s}": v
+                             for (n, s), v in self._services.items()},
+                "checks": {f"{n}\x00{c}": v
+                           for (n, c), v in self._checks.items()},
+                "sessions": dict(self._sessions),
+            }
+
+    @classmethod
+    def restore(cls, snap: dict) -> "StateStore":
+        import base64
+        st = cls()
+        st._index = snap["index"]
+        st._kv = {k: dict(v, value=base64.b64decode(v["value"]))
+                  for k, v in snap["kv"].items()}
+        st._nodes = dict(snap["nodes"])
+        st._services = {tuple(k.split("\x00")): v
+                        for k, v in snap["services"].items()}
+        st._checks = {tuple(k.split("\x00")): v
+                      for k, v in snap["checks"].items()}
+        st._sessions = dict(snap["sessions"])
+        return st

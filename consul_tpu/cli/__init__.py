@@ -1,0 +1,3 @@
+"""CLI — operator tooling over the HTTP client, registry-pattern dispatch
+(reference: command/registry.go:18-45; each subcommand wraps the api/
+client the same way the reference's command families do)."""

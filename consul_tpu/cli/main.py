@@ -1,0 +1,402 @@
+"""`python -m consul_tpu.cli <command>` — the `consul` binary equivalent.
+
+Commands mirror the reference's CLI families (command/ directory, 34
+families — SURVEY.md §2.3): agent, members, kv, event, info, rtt, catalog,
+services, session, snapshot, lock, watch, force-leave, leave, keygen,
+version.  Each wraps the HTTP client (api/client.py), like the reference's
+commands wrap the Go api client.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import secrets
+import sys
+import time
+
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.version import VERSION
+
+
+def _client(args) -> Client:
+    addr = args.http_addr or os.environ.get("CONSUL_HTTP_ADDR",
+                                            "http://127.0.0.1:8500")
+    if not addr.startswith("http"):
+        addr = "http://" + addr
+    return Client(addr)
+
+
+def cmd_version(args) -> int:
+    print(f"consul-tpu v{VERSION}")
+    return 0
+
+
+def cmd_keygen(args) -> int:
+    print(base64.b64encode(secrets.token_bytes(32)).decode())
+    return 0
+
+
+def cmd_members(args) -> int:
+    status_names = {1: "alive", 2: "leaving", 3: "left", 4: "failed"}
+    rows = _client(args).agent_members()
+    print(f"{'Node':<20}{'Address':<22}{'Status':<10}Tags")
+    for m in rows:
+        if args.status and status_names.get(m["Status"]) != args.status:
+            continue
+        tags = ",".join(f"{k}={v}" for k, v in sorted(m["Tags"].items()))
+        print(f"{m['Name']:<20}{m['Addr'] + ':' + str(m['Port']):<22}"
+              f"{status_names.get(m['Status'], '?'):<10}{tags}")
+    return 0
+
+
+def cmd_info(args) -> int:
+    me = _client(args).agent_self()
+    print(json.dumps(me, indent=2))
+    return 0
+
+
+def cmd_kv(args) -> int:
+    c = _client(args)
+    if args.kv_cmd == "get":
+        if args.recurse:
+            for row in c.kv_list(args.key):
+                print(f"{row['Key']}:{row['Value'].decode(errors='replace')}")
+            return 0
+        if args.keys:
+            for k in c.kv_keys(args.key, separator=args.separator or ""):
+                print(k)
+            return 0
+        row, _ = c.kv_get(args.key)
+        if row is None:
+            print(f"Error! No key exists at: {args.key}", file=sys.stderr)
+            return 1
+        if args.detailed:
+            print(json.dumps({k: (v.decode(errors="replace")
+                                  if isinstance(v, bytes) else v)
+                              for k, v in row.items()}, indent=2))
+        else:
+            sys.stdout.write(row["Value"].decode(errors="replace") + "\n")
+        return 0
+    if args.kv_cmd == "put":
+        value = args.value
+        if value == "-":
+            value = sys.stdin.read()
+        elif value is not None and value.startswith("@"):
+            value = open(value[1:], "rb").read()
+        ok = c.kv_put(args.key, value if value is not None else b"",
+                      flags=args.flags,
+                      cas=args.cas, acquire=args.acquire,
+                      release=args.release)
+        if not ok:
+            print("Error! Did not write to key", file=sys.stderr)
+            return 1
+        print(f"Success! Data written to: {args.key}")
+        return 0
+    if args.kv_cmd == "delete":
+        ok = c.kv_delete(args.key, recurse=args.recurse)
+        print(f"Success! Deleted key{'s under' if args.recurse else ''}: "
+              f"{args.key}")
+        return 0 if ok else 1
+    if args.kv_cmd == "export":
+        out = [{"key": r["Key"], "flags": r["Flags"],
+                "value": base64.b64encode(r["Value"]).decode()}
+               for r in c.kv_list(args.key or "")]
+        print(json.dumps(out, indent=2))
+        return 0
+    if args.kv_cmd == "import":
+        data = json.loads(sys.stdin.read())
+        for row in data:
+            c.kv_put(row["key"], base64.b64decode(row["value"]),
+                     flags=row.get("flags", 0))
+        print(f"Imported: {len(data)} keys")
+        return 0
+    return 2
+
+
+def cmd_event(args) -> int:
+    c = _client(args)
+    if args.list:
+        for e in c.event_list(args.name if args.name else None):
+            print(f"{e['ID']:>4}  {e['Name']:<20} ltime={e['LTime']} "
+                  f"coverage={e.get('Coverage', 0):.3f}")
+        return 0
+    out = c.event_fire(args.name, args.payload or "")
+    print(f"Event ID: {out['ID']}")
+    return 0
+
+
+def cmd_rtt(args) -> int:
+    c = _client(args)
+    a = c.coordinate_node(args.node1)
+    b = c.coordinate_node(args.node2 or "node0")
+    if not a or not b:
+        print("Error! Coordinates not available", file=sys.stderr)
+        return 1
+
+    # ComputeDistance (lib/rtt.go:13): euclidean + heights + adjustments
+    import math
+    ca, cb = a[0]["Coord"], b[0]["Coord"]
+    d = math.sqrt(sum((x - y) ** 2 for x, y in zip(ca["Vec"], cb["Vec"])))
+    rtt = d + ca["Height"] + cb["Height"] + ca["Adjustment"] + cb["Adjustment"]
+    print(f"Estimated {args.node1} <-> {args.node2 or 'node0'} rtt: "
+          f"{max(rtt, 0) * 1000:.3f} ms")
+    return 0
+
+
+def cmd_catalog(args) -> int:
+    c = _client(args)
+    if args.catalog_cmd == "nodes":
+        for n in c.catalog_nodes(near=args.near):
+            print(f"{n['Node']:<20}{n['Address']}")
+        return 0
+    if args.catalog_cmd == "services":
+        for name, tags in c.catalog_services().items():
+            print(f"{name:<24}{','.join(tags)}")
+        return 0
+    if args.catalog_cmd == "service":
+        for r in c.catalog_service(args.name, near=args.near):
+            print(f"{r['Node']:<20}{r['ServiceID']:<16}:{r['ServicePort']}")
+        return 0
+    return 2
+
+
+def cmd_services(args) -> int:
+    c = _client(args)
+    if args.services_cmd == "register":
+        c.agent_service_register(args.name, service_id=args.id,
+                                 port=args.port,
+                                 tags=args.tag or [])
+        print(f"Registered service: {args.name}")
+        return 0
+    if args.services_cmd == "deregister":
+        c.agent_service_deregister(args.id or args.name)
+        print(f"Deregistered service: {args.id or args.name}")
+        return 0
+    return 2
+
+
+def cmd_session(args) -> int:
+    c = _client(args)
+    for s in c.session_list():
+        print(f"{s['ID']}  node={s['Node']} behavior={s['Behavior']} "
+              f"ttl={s['TTL']}")
+    return 0
+
+
+def cmd_snapshot(args) -> int:
+    c = _client(args)
+    if args.snapshot_cmd == "save":
+        data = c.snapshot_save()
+        with open(args.file, "wb") as f:
+            f.write(data)
+        print(f"Saved and verified snapshot to index "
+              f"{json.loads(data)['index']}")
+        return 0
+    if args.snapshot_cmd == "restore":
+        with open(args.file, "rb") as f:
+            c.snapshot_restore(f.read())
+        print("Restored snapshot")
+        return 0
+    if args.snapshot_cmd == "inspect":
+        snap = json.loads(open(args.file, "rb").read())
+        print(f"Index: {snap['index']}")
+        print(f"KV entries: {len(snap['kv'])}")
+        print(f"Nodes: {len(snap['nodes'])}")
+        print(f"Services: {len(snap['services'])}")
+        print(f"Sessions: {len(snap['sessions'])}")
+        return 0
+    return 2
+
+
+def cmd_lock(args) -> int:
+    """consul lock (command/lock): hold a KV lock while running a child."""
+    import subprocess
+    c = _client(args)
+    sid = c.lock_acquire(args.prefix + "/.lock", b"cli-lock")
+    if sid is None:
+        print("Error! Could not acquire lock", file=sys.stderr)
+        return 1
+    try:
+        return subprocess.call(args.child)
+    finally:
+        c.lock_release(args.prefix + "/.lock", sid)
+
+
+def cmd_watch(args) -> int:
+    """consul watch -type=key (command/watch, api/watch/watch.go:21)."""
+    c = _client(args)
+    idx = None
+    n = 0
+    while True:
+        row, idx = c.kv_get(args.key, index=idx, wait=args.wait)
+        print(json.dumps({"Key": args.key,
+                          "Value": row["Value"].decode(errors="replace")
+                          if row else None, "Index": idx}))
+        sys.stdout.flush()
+        n += 1
+        if args.once or (args.max_events and n >= args.max_events):
+            return 0
+
+
+def cmd_force_leave(args) -> int:
+    _client(args).agent_force_leave(args.node)
+    print(f"Force-left node: {args.node}")
+    return 0
+
+
+def cmd_leave(args) -> int:
+    _client(args)._call("PUT", "/v1/agent/leave")
+    print("Graceful leave complete")
+    return 0
+
+
+def cmd_agent(args) -> int:
+    """Run an agent (command/agent) — oracle + store + HTTP API."""
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+
+    gossip = GossipConfig.wan() if args.wan_defaults else GossipConfig.lan()
+    sim = SimConfig(n_nodes=args.sim_nodes, rumor_slots=args.rumor_slots,
+                    p_loss=args.p_loss, seed=args.seed)
+    a = Agent(gossip, sim, node_name=args.node, http_port=args.http_port,
+              dc=args.datacenter)
+    a.start(tick_seconds=args.tick_seconds)
+    print(f"==> consul-tpu agent running")
+    print(f"       Node name: {args.node}")
+    print(f"      Datacenter: {args.datacenter}")
+    print(f"       HTTP addr: {a.http_address}")
+    print(f"       Sim nodes: {args.sim_nodes}")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("==> Caught signal: interrupt — gracefully shutting down")
+        a.stop()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="consul-tpu")
+    p.add_argument("-http-addr", "--http-addr", dest="http_addr", default=None)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+    sub.add_parser("keygen").set_defaults(fn=cmd_keygen)
+    sp = sub.add_parser("members")
+    sp.add_argument("-status", default=None)
+    sp.set_defaults(fn=cmd_members)
+    sub.add_parser("info").set_defaults(fn=cmd_info)
+
+    sp = sub.add_parser("kv")
+    kvsub = sp.add_subparsers(dest="kv_cmd", required=True)
+    g = kvsub.add_parser("get")
+    g.add_argument("key")
+    g.add_argument("-recurse", action="store_true")
+    g.add_argument("-keys", action="store_true")
+    g.add_argument("-separator", default="/")
+    g.add_argument("-detailed", action="store_true")
+    pu = kvsub.add_parser("put")
+    pu.add_argument("key")
+    pu.add_argument("value", nargs="?", default=None)
+    pu.add_argument("-flags", type=int, default=0)
+    pu.add_argument("-cas", type=int, default=None)
+    pu.add_argument("-acquire", default=None)
+    pu.add_argument("-release", default=None)
+    d = kvsub.add_parser("delete")
+    d.add_argument("key")
+    d.add_argument("-recurse", action="store_true")
+    e = kvsub.add_parser("export")
+    e.add_argument("key", nargs="?", default="")
+    kvsub.add_parser("import")
+    sp.set_defaults(fn=cmd_kv)
+
+    sp = sub.add_parser("event")
+    sp.add_argument("-name", required=False)
+    sp.add_argument("payload", nargs="?", default="")
+    sp.add_argument("-list", action="store_true")
+    sp.set_defaults(fn=cmd_event)
+
+    sp = sub.add_parser("rtt")
+    sp.add_argument("node1")
+    sp.add_argument("node2", nargs="?", default=None)
+    sp.set_defaults(fn=cmd_rtt)
+
+    sp = sub.add_parser("catalog")
+    csub = sp.add_subparsers(dest="catalog_cmd", required=True)
+    n = csub.add_parser("nodes")
+    n.add_argument("-near", default=None)
+    csub.add_parser("services")
+    svc = csub.add_parser("service")
+    svc.add_argument("name")
+    svc.add_argument("-near", default=None)
+    sp.set_defaults(fn=cmd_catalog)
+
+    sp = sub.add_parser("services")
+    ssub = sp.add_subparsers(dest="services_cmd", required=True)
+    r = ssub.add_parser("register")
+    r.add_argument("-name", required=True)
+    r.add_argument("-id", default=None)
+    r.add_argument("-port", type=int, default=0)
+    r.add_argument("-tag", action="append")
+    dr = ssub.add_parser("deregister")
+    dr.add_argument("-name", default=None)
+    dr.add_argument("-id", default=None)
+    sp.set_defaults(fn=cmd_services)
+
+    sub.add_parser("session").set_defaults(fn=cmd_session)
+
+    sp = sub.add_parser("snapshot")
+    snsub = sp.add_subparsers(dest="snapshot_cmd", required=True)
+    for name in ("save", "restore", "inspect"):
+        x = snsub.add_parser(name)
+        x.add_argument("file")
+    sp.set_defaults(fn=cmd_snapshot)
+
+    sp = sub.add_parser("lock")
+    sp.add_argument("prefix")
+    sp.add_argument("child", nargs=argparse.REMAINDER)
+    sp.set_defaults(fn=cmd_lock)
+
+    sp = sub.add_parser("watch")
+    sp.add_argument("-key", required=True)
+    sp.add_argument("-wait", default="60s")
+    sp.add_argument("-once", action="store_true")
+    sp.add_argument("--max-events", type=int, default=0)
+    sp.set_defaults(fn=cmd_watch)
+
+    sp = sub.add_parser("force-leave")
+    sp.add_argument("node")
+    sp.set_defaults(fn=cmd_force_leave)
+    sub.add_parser("leave").set_defaults(fn=cmd_leave)
+
+    sp = sub.add_parser("agent")
+    sp.add_argument("-node", default="node0")
+    sp.add_argument("-datacenter", "-dc", default="dc1")
+    sp.add_argument("-http-port", type=int, default=8500)
+    sp.add_argument("-sim-nodes", type=int, default=64)
+    sp.add_argument("-rumor-slots", type=int, default=16)
+    sp.add_argument("-p-loss", type=float, default=0.01)
+    sp.add_argument("-seed", type=int, default=0)
+    sp.add_argument("-tick-seconds", type=float, default=0.05)
+    sp.add_argument("-wan-defaults", action="store_true")
+    sp.set_defaults(fn=cmd_agent)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except ApiError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 1
+    except ConnectionError as e:
+        print(f"Error connecting to agent: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,225 @@
+"""GossipOracle: host-side handle on the device-resident serf pool.
+
+The reference's agent consumes serf through an event channel + member list
+(agent/consul/server_serf.go:203 lanEventHandler; agent/agent.go:1629
+GetLANCoordinate).  The oracle is that interface for the TPU sim: it owns
+the `ClusterState`, advances it (inline or via a pacer thread), applies
+host commands (join/leave/kill/event-fire) between ticks, and answers
+member/coordinate/RTT queries — the `-gossip-backend=tpu-sim` delegate of
+BASELINE.json's north star.
+
+Node naming: the sim is dense [0, N); the oracle maps names ↔ ids and
+tracks which ids are provisioned (joined) so a 1M-slot pool can start
+sparsely populated, like a cluster that hasn't finished joining.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import events as events_model
+from consul_tpu.models import serf, swim, vivaldi
+
+
+class GossipOracle:
+    def __init__(self, gossip: Optional[GossipConfig] = None,
+                 sim: Optional[SimConfig] = None,
+                 node_prefix: str = "node"):
+        self.gossip = gossip or GossipConfig.lan()
+        self.sim = sim or SimConfig(n_nodes=64, rumor_slots=16)
+        self.params = serf.make_params(self.gossip, self.sim)
+        self._state = serf.init_state(self.params)
+        self._lock = threading.RLock()
+        self._step = jax.jit(serf.step, static_argnums=0)
+        self._node_prefix = node_prefix
+        self._names: Dict[int, str] = {
+            i: f"{node_prefix}{i}" for i in range(self.sim.n_nodes)}
+        self._ids: Dict[str, int] = {v: k for k, v in self._names.items()}
+        self._events: List[dict] = []           # host-side payload ring
+        self._event_ring = 256                  # reference ring size
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self, tick_seconds: float = 0.0) -> None:
+        """Background pacer: one sim tick per `tick_seconds` of wall time
+        (0 = free-running)."""
+        if self._thread is not None:
+            return
+        self._running = True
+
+        def loop():
+            while self._running:
+                t0 = time.time()
+                self.advance(1)
+                if tick_seconds > 0:
+                    time.sleep(max(0.0, tick_seconds - (time.time() - t0)))
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def advance(self, n_ticks: int = 1) -> None:
+        with self._lock:
+            s = self._state
+            for _ in range(n_ticks):
+                s = self._step(self.params, s)
+            self._state = s
+
+    # -------------------------------------------------------------- identity
+
+    def node_id(self, name: str) -> int:
+        return self._ids[name]
+
+    def node_name(self, node_id: int) -> str:
+        return self._names.get(node_id, f"{self._node_prefix}{node_id}")
+
+    # ------------------------------------------------------------ membership
+
+    def members(self, limit: Optional[int] = None) -> List[dict]:
+        """Serf member list with statuses (alive/failed/left), oracle view."""
+        with self._lock:
+            st = self._state.swim
+            up = np.asarray(st.up)
+            member = np.asarray(st.member)
+            dead = np.asarray(self._oracle_down_mask())
+            left = np.asarray(st.committed_left) | ~member
+            inc = np.asarray(st.incarnation)
+        out = []
+        n = len(up) if limit is None else min(limit, len(up))
+        for i in range(n):
+            status = "alive"
+            if left[i]:
+                status = "left"
+            elif dead[i]:
+                status = "failed"
+            out.append({"name": self.node_name(i), "id": i,
+                        "status": status, "incarnation": int(inc[i]),
+                        "actually_up": bool(up[i])})
+        return out
+
+    def _oracle_down_mask(self) -> jnp.ndarray:
+        """Nodes the cluster (majority view) considers failed: committed dead
+        or an active dead rumor."""
+        st = self._state.swim
+        u = self.params.swim.rumor_slots
+        dead_rumor = jnp.zeros_like(st.committed_dead).at[
+            jnp.where(st.r_active & (st.r_kind == swim.DEAD), st.r_subject, 0)
+        ].max(st.r_active & (st.r_kind == swim.DEAD))
+        return st.committed_dead | dead_rumor
+
+    def status(self, name: str) -> str:
+        i = self.node_id(name)
+        for m in self.members(limit=None):
+            if m["id"] == i:
+                return m["status"]
+        raise KeyError(name)
+
+    def believed_down_fraction(self, name: str) -> float:
+        with self._lock:
+            return float(swim.believed_down_fraction(
+                self.params.swim, self._state.swim, self.node_id(name)))
+
+    def kill(self, name: str) -> None:
+        with self._lock:
+            self._state = self._state.replace(
+                swim=swim.kill(self._state.swim, self.node_id(name)))
+
+    def revive(self, name: str) -> None:
+        with self._lock:
+            self._state = self._state.replace(
+                swim=swim.revive(self._state.swim, self.node_id(name)))
+
+    def leave(self, name: str) -> None:
+        with self._lock:
+            self._state = self._state.replace(
+                swim=swim.leave(self.params.swim, self._state.swim,
+                                self.node_id(name)))
+
+    # ----------------------------------------------------------- coordinates
+
+    def coordinate(self, name: str) -> dict:
+        i = self.node_id(name)
+        with self._lock:
+            c = self._state.coords
+            return {"node": name,
+                    "vec": np.asarray(c.coords[i]).tolist(),
+                    "error": float(c.error[i]),
+                    "adjustment": float(c.adjustment[i]),
+                    "height": float(c.height[i])}
+
+    def rtt(self, a: str, b: str) -> float:
+        """Estimated RTT seconds (consul rtt command — lib/rtt.go:13)."""
+        ia, ib = self.node_id(a), self.node_id(b)
+        with self._lock:
+            return float(vivaldi.estimate_rtt(
+                self._state.coords,
+                jnp.array([ia], jnp.int32), jnp.array([ib], jnp.int32))[0])
+
+    def sort_by_rtt(self, origin: str, names: List[str]) -> List[str]:
+        """?near= ordering (agent/consul/rtt.go:196)."""
+        io = self.node_id(origin)
+        ids = jnp.array([self.node_id(n) for n in names], jnp.int32)
+        with self._lock:
+            d = vivaldi.estimate_rtt(
+                self._state.coords,
+                jnp.full((len(names),), io, jnp.int32), ids)
+        order = np.argsort(np.asarray(d), kind="stable")
+        return [names[i] for i in order]
+
+    # ---------------------------------------------------------------- events
+
+    def fire_event(self, name: str, payload: bytes, origin: str) -> str:
+        """UserEvent (agent/user_event.go:23): host keeps the payload ring,
+        the device disseminates the id."""
+        with self._lock:
+            eid = len(self._events) + 1
+            self._state = serf.fire_event(self.params, self._state,
+                                          self.node_id(origin), eid)
+            ltime = int(self._state.events.e_ltime[
+                int(jnp.argmax(self._state.events.e_id == eid))])
+            rec = {"id": eid, "name": name, "payload": payload,
+                   "ltime": ltime, "origin": origin}
+            self._events.append(rec)
+            if len(self._events) > self._event_ring:
+                self._events = self._events[-self._event_ring:]
+            return str(eid)
+
+    def event_list(self) -> List[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def event_coverage(self, event_id: int) -> float:
+        with self._lock:
+            st = self._state
+            slots = np.asarray(st.events.e_id)
+            hit = np.nonzero(slots == event_id)[0]
+            if len(hit) == 0:
+                return 1.0  # expired ⇒ fully disseminated window passed
+            return float(events_model.coverage(
+                self.params.events, st.events, int(hit[0]),
+                st.swim.up, st.swim.member))
+
+    # ------------------------------------------------------------------ misc
+
+    @property
+    def tick(self) -> int:
+        with self._lock:
+            return int(self._state.swim.tick)
+
+    @property
+    def n_nodes(self) -> int:
+        return self.sim.n_nodes

@@ -1,0 +1,3 @@
+"""Version (reference: version/version.go:16)."""
+
+VERSION = "0.1.0-tpu"

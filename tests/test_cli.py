@@ -1,0 +1,95 @@
+"""CLI tests against a live in-process agent."""
+
+import json
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.cli.main import main
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=11))
+    a.start(tick_seconds=0.0, reconcile_interval=0.2)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def run(agent, capsys):
+    def _run(*argv, rc=0):
+        code = main(["-http-addr", agent.http_address, *argv])
+        out = capsys.readouterr()
+        assert code == rc, f"exit {code}: {out.err or out.out}"
+        return out.out
+    return _run
+
+
+def test_version_and_keygen(run):
+    assert "consul-tpu v" in run("version")
+    key = run("keygen").strip()
+    import base64
+    assert len(base64.b64decode(key)) == 32
+
+
+def test_members(run):
+    out = run("members")
+    assert "node0" in out and "alive" in out
+    assert out.count("alive") == 16
+
+
+def test_kv_cli_roundtrip(run):
+    run("kv", "put", "cli/x", "hello")
+    assert run("kv", "get", "cli/x").strip() == "hello"
+    run("kv", "put", "cli/y", "world")
+    keys = run("kv", "get", "cli/", "-keys").strip().splitlines()
+    assert keys == ["cli/x", "cli/y"]
+    run("kv", "delete", "cli/x")
+    run("kv", "get", "cli/x", rc=1)
+
+
+def test_kv_export(run):
+    run("kv", "put", "exp/a", "1")
+    data = json.loads(run("kv", "export", "exp/"))
+    assert data[0]["key"] == "exp/a"
+
+
+def test_event_fire_and_list(run, agent):
+    out = run("event", "-name", "deploy", "v1")
+    assert "Event ID:" in out
+    agent.oracle.advance(15)
+    out = run("event", "-list")
+    assert "deploy" in out
+
+
+def test_catalog_and_services(run):
+    run("services", "register", "-name", "api", "-port", "8080")
+    assert "api" in run("catalog", "services")
+    assert ":8080" in run("catalog", "service", "api")
+    run("services", "deregister", "-id", "api")
+    assert ":8080" not in run("catalog", "service", "api")
+
+
+def test_rtt(run, agent):
+    agent.oracle.advance(200)
+    out = run("rtt", "node1", "node2")
+    assert "rtt:" in out and "ms" in out
+
+
+def test_snapshot_cli(run, tmp_path):
+    run("kv", "put", "snap/k", "v")
+    f = tmp_path / "snap.json"
+    run("snapshot", "save", str(f))
+    out = run("snapshot", "inspect", str(f))
+    assert "KV entries:" in out
+    run("snapshot", "restore", str(f))
+
+
+def test_force_leave(run, agent):
+    run("force-leave", "node3")
+    agent.oracle.advance(80)
+    out = run("members")
+    assert "left" in out

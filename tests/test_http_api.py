@@ -1,0 +1,160 @@
+"""End-to-end HTTP API tests: a live agent driven through the client lib
+(mirrors the reference's TestAgent tier, SURVEY.md §4 tier 3)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=32, rumor_slots=16, p_loss=0.0, seed=9))
+    a.start(tick_seconds=0.0, reconcile_interval=0.1)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+def test_status_and_self(client):
+    assert client.agent_self()["Config"]["NodeName"] == "node0"
+    members = client.agent_members()
+    assert len(members) == 32
+    assert all(m["Status"] == 1 for m in members)
+
+
+def test_kv_roundtrip_flags_cas(client):
+    assert client.kv_put("foo/bar", b"hello", flags=7)
+    row, idx = client.kv_get("foo/bar")
+    assert row["Value"] == b"hello"
+    assert row["Flags"] == 7
+    assert idx > 0
+    # CAS: stale index fails, current succeeds
+    assert not client.kv_put("foo/bar", b"x", cas=row["ModifyIndex"] - 1)
+    assert client.kv_put("foo/bar", b"y", cas=row["ModifyIndex"])
+    assert client.kv_get("foo/bar")[0]["Value"] == b"y"
+    # keys + recurse
+    client.kv_put("foo/baz/deep", b"1")
+    assert client.kv_keys("foo/", separator="/") == ["foo/bar", "foo/baz/"]
+    assert len(client.kv_list("foo/")) == 2
+    assert client.kv_delete("foo/", recurse=True)
+    assert client.kv_get("foo/bar")[0] is None
+
+
+def test_kv_blocking_query_wakes_on_write(client):
+    client.kv_put("watch/me", b"v1")
+    row, idx = client.kv_get("watch/me")
+    got = {}
+
+    def waiter():
+        got["row"], got["idx"] = client.kv_get("watch/me", index=idx,
+                                               wait="10s")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    assert t.is_alive()              # parked, not spinning
+    client.kv_put("watch/me", b"v2")
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert got["row"]["Value"] == b"v2"
+    assert got["idx"] > idx
+
+
+def test_service_registration_and_health(client):
+    client.agent_service_register("web", port=80, tags=["primary"],
+                                  check={"Name": "web alive",
+                                         "Status": "passing"})
+    rows = client.catalog_service("web")
+    assert rows and rows[0]["ServicePort"] == 80
+    health, _ = client.health_service("web")
+    assert health and health[0]["Service"]["Service"] == "web"
+    # flip the check critical -> passing_only hides it
+    client.agent_check_update("service:web", "critical")
+    assert client.health_service("web", passing=True)[0] == []
+    client.agent_check_update("service:web", "passing")
+    assert client.health_service("web", passing=True)[0]
+
+
+def test_sessions_and_locks(client):
+    sid = client.session_create(ttl="10s")
+    assert client.kv_put("locks/a", b"owner1", acquire=sid)
+    row, _ = client.kv_get("locks/a")
+    assert row["Session"] == sid
+    # second session cannot steal
+    sid2 = client.session_create()
+    assert not client.kv_put("locks/a", b"owner2", acquire=sid2)
+    # destroy releases the lock
+    client.session_destroy(sid)
+    row, _ = client.kv_get("locks/a")
+    assert "Session" not in row
+    client.session_destroy(sid2)
+
+
+def test_txn_atomicity(client):
+    import base64
+    ops = [
+        {"KV": {"Verb": "set", "Key": "t/a",
+                "Value": base64.b64encode(b"1").decode()}},
+        {"KV": {"Verb": "cas", "Key": "t/b", "Index": 999,
+                "Value": base64.b64encode(b"2").decode()}},
+    ]
+    from consul_tpu.api.client import ApiError
+    out = client.txn(ops)
+    assert out["Errors"]            # cas failed → whole txn rolled back
+    assert client.kv_get("t/a")[0] is None
+
+
+def test_events_fire_and_coverage(client, agent):
+    ev = client.event_fire("deploy", b"v2.0")
+    agent.oracle.advance(20)
+    out = client.event_list("deploy")
+    assert out and out[0]["Name"] == "deploy"
+    assert out[0]["Coverage"] > 0.99
+
+
+def test_failure_reconciles_to_critical_serfhealth(client, agent):
+    # register node5 in the catalog, then crash it in the sim
+    client.catalog_register("node5", "10.0.0.5",
+                            service={"ID": "db", "Service": "db", "Port": 5432})
+    agent.oracle.kill("node5")
+    # run enough ticks for detect + suspicion + dead rumor at N=32
+    agent.oracle.advance(260)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        checks = client.health_state("critical")
+        if any(c["Node"] == "node5" and c["CheckID"] == "serfHealth"
+               for c in checks):
+            break
+        time.sleep(0.1)
+    else:
+        pytest.fail("node5 serfHealth never went critical")
+    members = client.agent_members()
+    st = {m["Name"]: m["Status"] for m in members}
+    assert st["node5"] == 4  # failed
+
+
+def test_coordinates_and_rtt_sort(client, agent):
+    agent.oracle.advance(400)   # let vivaldi see some probe rounds
+    coords = client.coordinate_nodes()
+    assert len(coords) >= 30
+    assert len(coords[0]["Coord"]["Vec"]) == 8
+    nodes = client.catalog_nodes(near="node0")
+    assert nodes  # near-sort executes the oracle RTT path
+
+
+def test_snapshot_save_restore(client):
+    client.kv_put("snap/x", b"keep")
+    snap = client.snapshot_save()
+    client.kv_put("snap/x", b"clobbered")
+    client.snapshot_restore(snap)
+    assert client.kv_get("snap/x")[0]["Value"] == b"keep"
