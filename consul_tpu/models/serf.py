@@ -65,8 +65,7 @@ def init_state(params: SerfParams, key=None) -> ClusterState:
 def step(params: SerfParams, s: ClusterState) -> ClusterState:
     """One gossip tick of the full serf pool (jit this)."""
     sw, obs = swim.step_with_obs(params.swim, s.swim)
-    src = jnp.arange(params.n_nodes, dtype=jnp.int32)
-    coords = vivaldi.observe(params.vivaldi, s.coords, src, obs.target,
+    coords = vivaldi.observe(params.vivaldi, s.coords, None, obs.target,
                              obs.rtt_ms / 1000.0, mask=obs.acked)
     ev = events.step(params.events, s.events, up=sw.up, member=sw.member)
     return ClusterState(swim=sw, coords=coords, events=ev)

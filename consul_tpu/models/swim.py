@@ -196,10 +196,16 @@ def _maps(params: SwimParams, s: SwimState):
 
 
 def _row_gather(mat: jnp.ndarray, cols: jnp.ndarray):
-    """mat[i, cols[i]] with cols possibly -1 (returns False/0 there)."""
-    safe = jnp.clip(cols, 0, mat.shape[1] - 1)
-    got = jnp.take_along_axis(mat, safe[:, None], axis=1)[:, 0]
-    return jnp.where(cols >= 0, got, jnp.zeros((), mat.dtype))
+    """mat[i, cols[i]] with cols possibly -1 (returns False/0 there).
+
+    Formulated as a one-hot compare+reduce over the small minor axis: a
+    per-row gather on a tiny minor dim lowers to a degenerate (serialized)
+    TPU gather — the [N, U] compare is ~15x faster at N=1M."""
+    u = mat.shape[1]
+    onehot = cols[:, None] == jnp.arange(u, dtype=jnp.int32)[None, :]
+    if mat.dtype == jnp.bool_:
+        return jnp.any(mat & onehot, axis=1)
+    return jnp.sum(jnp.where(onehot, mat, 0), axis=1)
 
 
 def _suspicion_timeout_ticks(params: SwimParams, confirm: jnp.ndarray) -> jnp.ndarray:
@@ -263,12 +269,15 @@ def believed_down_fraction(params: SwimParams, s: SwimState, subject: int) -> jn
 # ---------------------------------------------------------------------------
 
 def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
-               kind: int, inc_of_subject: jnp.ndarray, knower_cols_fn) -> SwimState:
+               kind: int, inc_of_subject: jnp.ndarray,
+               row_subject: jnp.ndarray) -> SwimState:
     """Allocate up to `alloc_cap` rumor slots for subjects with want_score > 0.
 
     `inc_of_subject`: [N] int32 incarnation to record per subject.
-    `knower_cols_fn(subject) -> [N] bool`: which nodes know the new rumor at
-    birth (the originators).
+    `row_subject`: [N] int32 — the subject node i originates/knows a rumor
+    about at birth (-1 = none).  All table updates are [U]-space scatters
+    and the knowledge seeding is ONE [N, U] one-hot comparison (this runs
+    inside the per-tick hot loop at N=1M).
     """
     a = params.alloc_cap
     u = params.rumor_slots
@@ -277,25 +286,25 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
     free_score, slots = jax.lax.top_k(jnp.where(s.r_active, 0, 1) *
                                       (u - jnp.arange(u, dtype=jnp.int32)), a)
     ok = (score > 0) & (free_score > 0)
+    oob = jnp.where(ok, slots, u)                              # drop if !ok
 
-    r_active, r_kind, r_subject = s.r_active, s.r_kind, s.r_subject
-    r_inc, r_start, r_confirm = s.r_inc, s.r_start, s.r_confirm
-    know, learn_tick, sends_left = s.know, s.learn_tick, s.sends_left
-    slot_ids = jnp.arange(u, dtype=jnp.int32)
-    for i in range(a):
-        slot, subj, oki = slots[i], subjects[i], ok[i]
-        onehot = (slot_ids == slot) & oki
-        r_active = r_active | onehot
-        r_kind = jnp.where(onehot, kind, r_kind)
-        r_subject = jnp.where(onehot, subj, r_subject)
-        r_inc = jnp.where(onehot, inc_of_subject[subj], r_inc)
-        r_start = jnp.where(onehot, s.tick, r_start)
-        r_confirm = jnp.where(onehot, 1, r_confirm)
-        col = knower_cols_fn(subj) & oki                       # [N]
-        cell = col[:, None] & onehot[None, :]                  # [N, U]
-        know = know | cell
-        learn_tick = jnp.where(cell, s.tick, learn_tick)
-        sends_left = jnp.where(cell, params.retransmit_limit, sends_left)
+    r_active = s.r_active.at[oob].set(True, mode="drop")
+    r_kind = s.r_kind.at[oob].set(kind, mode="drop")
+    r_subject = s.r_subject.at[oob].set(subjects, mode="drop")
+    r_inc = s.r_inc.at[oob].set(inc_of_subject[subjects], mode="drop")
+    r_start = s.r_start.at[oob].set(s.tick, mode="drop")
+    r_confirm = s.r_confirm.at[oob].set(1, mode="drop")
+
+    # subject -> allocated slot map, then one one-hot seed of the knowers
+    alloc_map = jnp.full((n,), -1, jnp.int32).at[
+        jnp.where(ok, subjects, 0)].max(jnp.where(ok, slots, -1))
+    slot_row = jnp.where(row_subject >= 0,
+                         alloc_map[jnp.clip(row_subject, 0, n - 1)], -1)
+    cell = (slot_row[:, None] == jnp.arange(u)[None, :]) \
+        & (slot_row >= 0)[:, None]
+    know = s.know | cell
+    learn_tick = jnp.where(cell, s.tick, s.learn_tick)
+    sends_left = jnp.where(cell, params.retransmit_limit, s.sends_left)
     return s.replace(r_active=r_active, r_kind=r_kind, r_subject=r_subject,
                      r_inc=r_inc, r_start=r_start, r_confirm=r_confirm,
                      know=know, learn_tick=learn_tick, sends_left=sends_left)
@@ -379,10 +388,8 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
         & ~s.committed_dead & ~s.committed_left
     want = jnp.where(fresh, cnt, 0)
 
-    def knowers(subj):
-        return failed & (target == subj)
-
-    s = _originate(params, s, want, SUSPECT, s.incarnation, knowers)
+    row_subject = jnp.where(failed, target, -1)
+    s = _originate(params, s, want, SUSPECT, s.incarnation, row_subject)
     direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
     obs = ProbeObs(target=target, rtt_ms=2.0 * rtt,
                    acked=prober & ~skip & direct_ack)
@@ -417,11 +424,13 @@ def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
     fresh = subj_exp & (dead_of < 0) & ~s.committed_dead
     want = jnp.where(fresh, 1, 0)
 
-    def knowers(subj):
-        ss = suspect_of[subj]                                    # scalar slot
-        return jnp.where(ss >= 0, expired[:, jnp.clip(ss, 0, u - 1)], False)
-
-    return _originate(params, s, want, DEAD, s.incarnation, knowers)
+    # row i knows the new dead rumor if one of its suspicions expired; when
+    # several expired at once the first one's subject is used (the rest are
+    # picked up by dissemination a tick later)
+    first_slot = jnp.argmax(expired, axis=1)                     # [N]
+    has_exp = jnp.any(expired, axis=1)
+    row_subject = jnp.where(has_exp, s.r_subject[first_slot], -1)
+    return _originate(params, s, want, DEAD, s.incarnation, row_subject)
 
 
 def _refutation(params: SwimParams, s: SwimState) -> SwimState:
@@ -462,11 +471,9 @@ def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     want = jnp.zeros((params.n_nodes,), jnp.int32).at[
         jnp.where(need & ~has_alive, subj, 0)].max(
         jnp.where(need & ~has_alive, 1, 0))
-
-    def knowers(sj):
-        return jnp.arange(params.n_nodes) == sj
-
-    return _originate(params, s, want, ALIVE, s.incarnation, knowers)
+    row_subject = jnp.where(want[jnp.arange(params.n_nodes)] > 0,
+                            jnp.arange(params.n_nodes), -1)
+    return _originate(params, s, want, ALIVE, s.incarnation, row_subject)
 
 
 def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
@@ -521,15 +528,25 @@ def _expire(params: SwimParams, s: SwimState) -> SwimState:
 
 def step_with_obs(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]:
     """Advance the whole cluster one gossip tick, returning this tick's probe
-    measurements (for the Vivaldi solver — see models/serf.py)."""
+    measurements (for the Vivaldi solver — see models/serf.py).
+
+    Dissemination runs every tick (gossip interval); the detector machinery
+    (probe round, suspicion expiry, refutation, rumor expiry) runs on probe
+    ticks only — timers quantize to the probe interval (≤0.8 s at LAN
+    defaults), which is inside memberlist's own timer jitter, and the
+    off-tick work drops to the three gossip scatters."""
     do_probe = (s.tick % params.probe_period_ticks) == 0
-    s, obs = jax.lax.cond(do_probe,
-                          lambda st: _probe_round(params, st),
+
+    def probe_branch(st):
+        st, obs = _probe_round(params, st)
+        st = _suspicion_expiry(params, st)
+        st = _refutation(params, st)
+        st = _expire(params, st)
+        return st, obs
+
+    s, obs = jax.lax.cond(do_probe, probe_branch,
                           lambda st: (st, _empty_obs(params)), s)
-    s = _suspicion_expiry(params, s)
-    s = _refutation(params, s)
     s = _disseminate(params, s)
-    s = _expire(params, s)
     return s.replace(tick=s.tick + 1), obs
 
 
@@ -569,11 +586,8 @@ def leave(params: SwimParams, s: SwimState, node: int) -> SwimState:
     """Graceful leave: the node broadcasts `left` before shutting down
     (serf intent; consumed at reference agent/consul/leader.go:1390)."""
     want = jnp.zeros((params.n_nodes,), jnp.int32).at[node].set(1)
-
-    def knowers(subj):
-        return jnp.arange(params.n_nodes) == subj
-
-    s = _originate(params, s, want, LEFT, s.incarnation, knowers)
+    row_subject = jnp.where(jnp.arange(params.n_nodes) == node, node, -1)
+    s = _originate(params, s, want, LEFT, s.incarnation, row_subject)
     return s.replace(member=s.member.at[node].set(False))
 
 
@@ -581,8 +595,5 @@ def inject_suspicion(params: SwimParams, s: SwimState, subject: int,
                      origin: int) -> SwimState:
     """Testing hook: make `origin` suspect `subject` right now."""
     want = jnp.zeros((params.n_nodes,), jnp.int32).at[subject].set(1)
-
-    def knowers(subj):
-        return jnp.arange(params.n_nodes) == origin
-
-    return _originate(params, s, want, SUSPECT, s.incarnation, knowers)
+    row_subject = jnp.where(jnp.arange(params.n_nodes) == origin, subject, -1)
+    return _originate(params, s, want, SUSPECT, s.incarnation, row_subject)

@@ -81,22 +81,27 @@ def estimate_rtt(s: VivaldiState, src: jnp.ndarray, dst: jnp.ndarray) -> jnp.nda
     return jnp.where(adjusted > 0.0, adjusted, d)
 
 
-def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray,
+def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray | None,
             dst: jnp.ndarray, rtt: jnp.ndarray,
             mask: jnp.ndarray | None = None) -> VivaldiState:
     """Apply one RTT observation per source node, batched.
 
-    src, dst: [K] int32 node ids (K is typically N — one probe per node);
-    rtt: [K] float32 seconds; mask: [K] bool (False rows are no-ops).
-    Rows of `src` must be distinct (each node observes once per tick).
+    src: [N] int32 node ids or None for the row-aligned fast path (node i
+    observes dst[i] — the common case; avoids TPU scatters entirely);
+    dst: [N] int32; rtt: [N] float32 seconds; mask: [N] bool (False rows
+    are no-ops).  Rows of `src` must be distinct.
     """
+    aligned = src is None
+    if aligned:
+        src = jnp.arange(s.coords.shape[0], dtype=jnp.int32)
     if mask is None:
         mask = jnp.ones(src.shape, bool)
     rtt = jnp.maximum(rtt, 1.0e-6)
 
-    ci, cj = s.coords[src], s.coords[dst]
-    hi, hj = s.height[src], s.height[dst]
-    ei, ej = s.error[src], s.error[dst]
+    ci = s.coords if aligned else s.coords[src]
+    hi = s.height if aligned else s.height[src]
+    ei = s.error if aligned else s.error[src]
+    cj, hj, ej = s.coords[dst], s.height[dst], s.error[dst]
 
     diff = ci - cj
     norm = jnp.linalg.norm(diff, axis=-1)
@@ -119,9 +124,14 @@ def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray,
                          params.height_min)
 
     m = mask
-    coords = s.coords.at[src].set(jnp.where(m[:, None], new_ci, ci))
-    height = s.height.at[src].set(jnp.where(m, new_hi, hi))
-    error = s.error.at[src].set(jnp.where(m, new_err, ei))
+    if aligned:
+        coords = jnp.where(m[:, None], new_ci, s.coords)
+        height = jnp.where(m, new_hi, s.height)
+        error = jnp.where(m, new_err, s.error)
+    else:
+        coords = s.coords.at[src].set(jnp.where(m[:, None], new_ci, ci))
+        height = s.height.at[src].set(jnp.where(m, new_hi, hi))
+        error = s.error.at[src].set(jnp.where(m, new_err, ei))
 
     # gravity: keep the constellation centered so coordinates stay comparable
     norms = jnp.linalg.norm(coords, axis=-1, keepdims=True)
@@ -132,8 +142,11 @@ def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray,
     # (sample rows are src-ordered; scatter them into node-id order first)
     col = (s.adj_index % params.adjustment_window).astype(jnp.int32)
     old_col = jax.lax.dynamic_slice_in_dim(s.adj_window, col, 1, axis=1)[:, 0]
-    new_col = old_col.at[src].set(
-        jnp.where(m, (rtt - dist) / 2.0, old_col[src]))
+    if aligned:
+        new_col = jnp.where(m, (rtt - dist) / 2.0, old_col)
+    else:
+        new_col = old_col.at[src].set(
+            jnp.where(m, (rtt - dist) / 2.0, old_col[src]))
     adj_window = jax.lax.dynamic_update_slice_in_dim(
         s.adj_window, new_col[:, None], col, axis=1)
     adjustment = jnp.mean(adj_window, axis=1)

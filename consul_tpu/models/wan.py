@@ -1,0 +1,202 @@
+"""Multi-datacenter federation: per-DC LAN pools + one WAN server pool.
+
+Consul's cross-DC architecture (SURVEY.md §2.2): every DC runs its own LAN
+gossip pool with every agent; the *servers* of all DCs additionally join a
+single WAN pool with slower timers (reference: setupSerf WAN
+agent/consul/server_serf.go:36-185 with `gossip_wan` defaults; Flood
+pushes LAN servers into WAN agent/consul/flood.go:12-27; cross-DC routing
+by WAN coordinates agent/router/router.go:534 GetDatacentersByDistance).
+
+Tensorization: the D LAN pools are a vmapped batch of serf cluster models
+(identical static shape per DC — one compiled step advances every DC at
+once); the WAN pool is one more serf model over the D·S servers.  User
+events bridge DCs through servers the way Consul replicates across
+federation: an event fired in DC d spreads over d's LAN, reaches a server,
+crosses the WAN pool, and each remote server re-fires it into its own LAN
+(cap: one inject per DC per tick per direction — events are rare next to
+the gossip tick rate).
+
+Node numbering: LAN node ids 0..S-1 of each DC are its servers; WAN node
+id = dc·S + server_index.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import events, serf, swim
+
+
+@dataclasses.dataclass(frozen=True)
+class WanParams:
+    n_dcs: int
+    servers_per_dc: int
+    lan: serf.SerfParams        # per-DC pool (same shape each DC)
+    wan: serf.SerfParams        # pool of n_dcs * servers_per_dc servers
+
+
+def make_params(n_dcs: int = 3, nodes_per_dc: int = 1024,
+                servers_per_dc: int = 5, p_loss: float = 0.01,
+                seed: int = 0, rumor_slots: int = 16,
+                event_slots: int = 16) -> WanParams:
+    lan = serf.make_params(
+        GossipConfig.lan(),
+        SimConfig(n_nodes=nodes_per_dc, rumor_slots=rumor_slots,
+                  p_loss=p_loss, seed=seed),
+        event_slots=event_slots)
+    wan = serf.make_params(
+        GossipConfig.wan(),
+        SimConfig(n_nodes=n_dcs * servers_per_dc, rumor_slots=rumor_slots,
+                  p_loss=p_loss, seed=seed ^ 0xBAD5EED),
+        event_slots=event_slots)
+    return WanParams(n_dcs=n_dcs, servers_per_dc=servers_per_dc,
+                     lan=lan, wan=wan)
+
+
+@struct.dataclass
+class WanState:
+    lan: serf.ClusterState      # batched: leading axis D on every leaf
+    wan: serf.ClusterState      # flat WAN pool
+
+
+def init_state(params: WanParams) -> WanState:
+    keys = jax.random.split(jax.random.PRNGKey(params.lan.swim.seed ^ 0xD0),
+                            params.n_dcs)
+    lan = jax.vmap(lambda k: serf.init_state(params.lan, k))(keys)
+    wan = serf.init_state(params.wan)
+    return WanState(lan=lan, wan=wan)
+
+
+def _first_active_candidate(e_active, known_mask, e_id, other_ids):
+    """Pick the first active event known to a bridge node whose id is not
+    in `other_ids`; returns (found, slot)."""
+    present = jnp.any(
+        e_id[:, None] == other_ids[None, :], axis=1)
+    cand = e_active & known_mask & ~present
+    slot = jnp.argmax(cand)
+    return jnp.any(cand), slot
+
+
+def step(params: WanParams, s: WanState) -> WanState:
+    """One gossip tick of the whole federation.
+
+    The WAN pool uses its own (slower) timers: its serf model steps every
+    tick of *this* function as well — callers that want exact wall-clock
+    alignment can step the WAN model every lan_gossip/wan_gossip ticks;
+    here both advance together and the WAN config's probe_period (10
+    ticks at WAN defaults vs 5 LAN) preserves the relative cadence."""
+    lan = jax.vmap(lambda st: serf.step(params.lan, st))(s.lan)
+    wan = serf.step(params.wan, s.wan)
+    s = WanState(lan=lan, wan=wan)
+    s = _bridge_events(params, s)
+    return s
+
+
+def _bridge_events(params: WanParams, s: WanState) -> WanState:
+    d, sp = params.n_dcs, params.servers_per_dc
+    lan_ev, wan_ev = s.lan.events, s.wan.events
+
+    # ---- LAN -> WAN: a server that knows a local event injects it
+    for dc in range(d):
+        ev = jax.tree_util.tree_map(lambda x: x[dc], lan_ev)
+        served = jnp.any(ev.know[:sp, :], axis=0)          # [E] some server knows
+        found, slot = _first_active_candidate(
+            ev.e_active, served, ev.e_id, wan_ev.e_id *
+            jnp.where(wan_ev.e_active, 1, 0))
+        origin_server = dc * sp + jnp.argmax(
+            jnp.any(ev.know[:sp, :], axis=1))
+        wan_ev = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found, new, old),
+            events.fire(params.wan.events, wan_ev, origin_server,
+                        ev.e_id[slot]),
+            wan_ev)
+
+    # ---- WAN -> LAN: a server that knows a WAN event fires it locally
+    new_lan_ev = []
+    for dc in range(d):
+        ev = jax.tree_util.tree_map(lambda x: x[dc], lan_ev)
+        my_servers = wan_ev.know[dc * sp:(dc + 1) * sp, :]  # [S, E]
+        known_here = jnp.any(my_servers, axis=0)            # [E]
+        found, slot = _first_active_candidate(
+            wan_ev.e_active, known_here, wan_ev.e_id,
+            ev.e_id * jnp.where(ev.e_active, 1, 0))
+        local_origin = jnp.argmax(jnp.any(my_servers, axis=1))
+        fired = events.fire(params.lan.events, ev, local_origin,
+                            wan_ev.e_id[slot])
+        new_lan_ev.append(jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found, new, old), fired, ev))
+
+    lan_ev = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *new_lan_ev)
+    return WanState(lan=s.lan.replace(events=lan_ev),
+                    wan=s.wan.replace(events=wan_ev))
+
+
+def run(params: WanParams, s: WanState, n_ticks: int) -> WanState:
+    def body(st, _):
+        return step(params, st), 0
+
+    return jax.lax.scan(body, s, None, length=n_ticks)[0]
+
+
+# ------------------------------------------------------------------- helpers
+
+def fire_event(params: WanParams, s: WanState, dc: int, origin: int,
+               event_id: int) -> WanState:
+    ev = jax.tree_util.tree_map(lambda x: x[dc], s.lan.events)
+    fired = events.fire(params.lan.events, ev, origin, event_id)
+    lan_ev = jax.tree_util.tree_map(
+        lambda full, one: full.at[dc].set(one), s.lan.events, fired)
+    return WanState(lan=s.lan.replace(events=lan_ev), wan=s.wan)
+
+
+def event_coverage_by_dc(params: WanParams, s: WanState,
+                         event_id: int) -> jnp.ndarray:
+    """[D] fraction of live members in each DC that received the event."""
+    def per_dc(cluster_events, up, member):
+        hit = jnp.any((cluster_events.e_id[None, :] == event_id)
+                      & (cluster_events.deliver_tick >= 0), axis=1)
+        alive = up & member
+        return jnp.sum(hit & alive) / jnp.maximum(jnp.sum(alive), 1)
+
+    return jax.vmap(per_dc)(s.lan.events, s.lan.swim.up, s.lan.swim.member)
+
+
+def dc_distance_matrix(params: WanParams, s: WanState) -> jnp.ndarray:
+    """[D, D] median server-to-server estimated RTT — the WAN-coordinate
+    DC ranking (reference agent/router/router.go:534)."""
+    from consul_tpu.models import vivaldi
+    d, sp = params.n_dcs, params.servers_per_dc
+    ids = jnp.arange(d * sp, dtype=jnp.int32)
+    ca = s.wan.coords
+    # pairwise server RTTs
+    diff = ca.coords[:, None, :] - ca.coords[None, :, :]
+    dist = jnp.linalg.norm(diff, axis=-1) + ca.height[:, None] + ca.height[None, :]
+    dist = dist + ca.adjustment[:, None] + ca.adjustment[None, :]
+    dist = dist.reshape(d, sp, d, sp)
+    return jnp.median(dist, axis=(1, 3))
+
+
+def wan_kill_dc(params: WanParams, s: WanState, dc: int) -> WanState:
+    """Partition a whole DC: crash its servers in the WAN pool (the other
+    DCs' routers should mark the DC unreachable)."""
+    sp = params.servers_per_dc
+    sw = s.wan.swim
+    ids = jnp.arange(sw.up.shape[0])
+    mask = (ids >= dc * sp) & (ids < (dc + 1) * sp)
+    return WanState(lan=s.lan,
+                    wan=s.wan.replace(swim=sw.replace(up=sw.up & ~mask)))
+
+
+def dc_reachable(params: WanParams, s: WanState) -> jnp.ndarray:
+    """[D] — a DC is reachable while any of its servers is WAN-alive
+    (committed view)."""
+    sp = params.servers_per_dc
+    alive = s.wan.swim.up & s.wan.swim.member & ~s.wan.swim.committed_dead
+    return jnp.any(alive.reshape(params.n_dcs, sp), axis=1)

@@ -1,12 +1,20 @@
-"""Infection-style dissemination as scatter ops — the shared gossip kernel.
+"""Infection-style dissemination — the shared gossip kernel.
 
-One gossip tick: every live carrier with remaining retransmit budget picks
-`fanout` random targets and sends its queued item mask; receipt is a
-scatter-max OR into the [N, S] knowledge matrix.  This is the SpMV at the
-heart of both membership rumors (models/swim.py) and user events
-(models/events.py) — the TPU equivalent of memberlist's piggybacked UDP
-gossip (reference tuning agent/config/default.go:70-84: gossip_interval /
-gossip_nodes; retransmit queue lib/serf/serf.go:20-24).
+One gossip tick: every live node samples `fanout` random peers and copies
+their queued item masks into its own [N, S] knowledge row.  This is the
+SpMV at the heart of both membership rumors (models/swim.py) and user
+events (models/events.py) — the TPU equivalent of memberlist's piggybacked
+UDP gossip (reference tuning agent/config/default.go:70-84:
+gossip_interval / gossip_nodes; retransmit queue lib/serf/serf.go:20-24).
+
+TPU-first formulation: memberlist *pushes* (sender picks targets), which
+tensorizes as a scatter with colliding row indices — slow on TPU.  Here
+receivers *pull* from `fanout` sampled sources, which tensorizes as row
+gathers (MXU/VPU-friendly, no collisions).  Push and pull epidemics have
+the same expected per-tick fanout and the same exponential spread rate
+(newly infected ≈ fanout·I for I ≪ N on both), and pull converges faster
+in the endgame; the serving budget below reproduces push's bounded
+per-node transmission count (retransmit_mult·ceil(log10 n) packets).
 """
 
 from __future__ import annotations
@@ -22,26 +30,27 @@ class GossipResult(NamedTuple):
     newly: jnp.ndarray       # [N, S] bool — learned this tick
 
 
-def disseminate(targets: jnp.ndarray, know: jnp.ndarray,
+def disseminate(sources: jnp.ndarray, know: jnp.ndarray,
                 sends_left: jnp.ndarray, sender_ok: jnp.ndarray,
                 receiver_ok: jnp.ndarray, slot_active: jnp.ndarray,
                 retransmit_limit: int) -> GossipResult:
     """One fanout round.
 
-    targets: [N, G] int32 gossip destinations per node;
+    sources: [N, G] int32 — peers each node pulls from this tick;
     sender_ok/receiver_ok: [N] bool; slot_active: [S] bool.
     """
-    n, s = know.shape
-    send = know & (sends_left > 0) & sender_ok[:, None]
-    got = jnp.zeros((n, s), jnp.uint8)
-    send8 = send.astype(jnp.uint8)
-    for g in range(targets.shape[1]):
-        got = got.at[targets[:, g]].max(send8)
-    received = (got > 0) & receiver_ok[:, None] & slot_active[None, :]
+    fanout = sources.shape[1]
+    serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
+    got = serve[sources[:, 0]]
+    for g in range(1, fanout):
+        got = got | serve[sources[:, g]]
+    received = got & receiver_ok[:, None] & slot_active[None, :]
     newly = received & ~know
     new_know = know | newly
+    # serving budget: a carrier burns `fanout` transmissions per tick while
+    # queued, matching the push formulation's packet accounting
     new_sends = jnp.where(newly, retransmit_limit,
-                          jnp.where(send,
-                                    jnp.maximum(sends_left - targets.shape[1], 0),
+                          jnp.where(serve,
+                                    jnp.maximum(sends_left - fanout, 0),
                                     sends_left))
     return GossipResult(know=new_know, sends_left=new_sends, newly=newly)

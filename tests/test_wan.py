@@ -1,0 +1,56 @@
+"""Multi-DC federation: cross-DC event propagation, DC partition detection."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu.models import wan
+
+
+def _mk(n_dcs=3, nodes=64, servers=3, seed=0):
+    params = wan.make_params(n_dcs=n_dcs, nodes_per_dc=nodes,
+                             servers_per_dc=servers, p_loss=0.0, seed=seed,
+                             rumor_slots=8, event_slots=8)
+    return params, wan.init_state(params)
+
+
+def test_event_crosses_datacenters():
+    params, s = _mk()
+    s = wan.fire_event(params, s, dc=0, origin=17, event_id=99)
+    run = jax.jit(wan.run, static_argnums=(0, 2))
+    s = run(params, s, 80)
+    cov = np.asarray(wan.event_coverage_by_dc(params, s, 99))
+    assert cov[0] > 0.99, f"origin DC coverage {cov}"
+    assert cov[1] > 0.99 and cov[2] > 0.99, f"remote DC coverage {cov}"
+
+
+def test_event_does_not_duplicate_local_slots():
+    params, s = _mk()
+    s = wan.fire_event(params, s, dc=1, origin=5, event_id=42)
+    run = jax.jit(wan.run, static_argnums=(0, 2))
+    s = run(params, s, 80)
+    # each DC's table holds the id at most once
+    ids = np.asarray(s.lan.events.e_id)
+    act = np.asarray(s.lan.events.e_active)
+    for dc in range(params.n_dcs):
+        assert int(((ids[dc] == 42) & act[dc]).sum()) <= 1
+
+
+def test_dc_partition_detected_over_wan():
+    params, s = _mk()
+    run = jax.jit(wan.run, static_argnums=(0, 2))
+    s = run(params, s, 10)
+    s = wan.wan_kill_dc(params, s, dc=2)
+    # WAN timers are slow (probe 5s, suspicion_mult 6); give it room
+    s = run(params, s, 900)
+    reach = np.asarray(wan.dc_reachable(params, s))
+    assert list(reach) == [True, True, False]
+
+
+def test_dc_distance_matrix_shape_and_symmetry():
+    params, s = _mk()
+    run = jax.jit(wan.run, static_argnums=(0, 2))
+    s = run(params, s, 200)
+    m = np.asarray(wan.dc_distance_matrix(params, s))
+    assert m.shape == (3, 3)
+    np.testing.assert_allclose(m, m.T, rtol=1e-4)
