@@ -310,33 +310,41 @@ class StateStore:
     def session_create(self, node: str, ttl: float = 0.0,
                        behavior: str = "release",
                        lock_delay: float = 15.0,
-                       checks: List[str] | None = None) -> Tuple[str, int]:
-        """PUT /v1/session/create (agent/consul/session_endpoint.go)."""
+                       checks: List[str] | None = None,
+                       sid: Optional[str] = None,
+                       now: Optional[float] = None) -> Tuple[str, int]:
+        """PUT /v1/session/create (agent/consul/session_endpoint.go).
+
+        `sid` and `now` are caller-supplied when the write is
+        raft-replicated: ids and clocks must be fixed at the proposer so
+        replica FSM applies stay pure functions of the command."""
+        now = now if now is not None else time.time()
         with self._lock:
             if node not in self._nodes:
                 raise KeyError(f"unknown node {node}")
-            sid = str(uuid.uuid4())
+            sid = sid or str(uuid.uuid4())
             idx = self._bump()
             self._sessions[sid] = {
                 "node": node, "ttl": ttl, "behavior": behavior,
                 "lock_delay": lock_delay, "checks": checks or ["serfHealth"],
                 "create_index": idx,
-                "expires": (time.time() + ttl) if ttl > 0 else None,
+                "expires": (now + ttl) if ttl > 0 else None,
             }
             return sid, idx
 
-    def session_renew(self, sid: str) -> bool:
+    def session_renew(self, sid: str, now: Optional[float] = None) -> bool:
+        now = now if now is not None else time.time()
         with self._lock:
             sess = self._sessions.get(sid)
             if sess is None:
                 return False
             if sess["ttl"] > 0:
-                sess["expires"] = time.time() + sess["ttl"]
+                sess["expires"] = now + sess["ttl"]
             return True
 
-    def session_destroy(self, sid: str) -> int:
+    def session_destroy(self, sid: str, now: Optional[float] = None) -> int:
         with self._lock:
-            self._invalidate_session_locked(sid)
+            self._invalidate_session_locked(sid, now)
             return self._index
 
     def session_info(self, sid: str) -> Optional[dict]:
@@ -347,6 +355,15 @@ class StateStore:
     def session_list(self) -> List[dict]:
         with self._lock:
             return [dict(v, id=k) for k, v in sorted(self._sessions.items())]
+
+    def peek_expired_sessions(self, now: Optional[float] = None) -> List[str]:
+        """Expired-but-not-yet-invalidated session ids, WITHOUT mutating —
+        the leader proposes the destroys through raft, every replica applies
+        (session_ttl.go:45: timers run on the leader only)."""
+        now = now if now is not None else time.time()
+        with self._lock:
+            return [sid for sid, s in self._sessions.items()
+                    if s["expires"] is not None and now >= s["expires"]]
 
     def expire_sessions(self, now: Optional[float] = None) -> List[str]:
         """TTL sweep — the leader's session timer loop
@@ -360,9 +377,11 @@ class StateStore:
                     self._invalidate_session_locked(sid)
         return expired
 
-    def _invalidate_session_locked(self, sid: str) -> None:
+    def _invalidate_session_locked(self, sid: str,
+                                   now: Optional[float] = None) -> None:
         """Release/delete locks held by the session, then drop it
         (invalidateSession — agent/consul/session_ttl.go:110)."""
+        now = now if now is not None else time.time()
         sess = self._sessions.pop(sid, None)
         if sess is None:
             return
@@ -377,7 +396,7 @@ class StateStore:
                     entry["session"] = None
                     entry["modify_index"] = idx
                 if delay > 0:
-                    self._lock_delays[key] = time.time() + delay
+                    self._lock_delays[key] = now + delay
 
     # ------------------------------------------------------------------- txn
 
@@ -437,30 +456,47 @@ class StateStore:
         """Serializable full-state image (FSM Snapshot —
         agent/consul/fsm/fsm.go:145; user archive snapshot/snapshot.go:164)."""
         import base64
+        import copy
         with self._lock:
+            # deep copies: the raft layer retains the snapshot across later
+            # in-place mutations (renew etc.) and ships it to followers —
+            # aliasing live dicts would both smear the point-in-time image
+            # and let replicas share mutable state outside the log
             return {
                 "index": self._index,
                 "kv": {k: dict(v, value=base64.b64encode(v["value"]).decode())
                        for k, v in self._kv.items()},
-                "nodes": dict(self._nodes),
-                "services": {f"{n}\x00{s}": v
+                "kv_delete_index": dict(self._kv_delete_index),
+                "nodes": copy.deepcopy(self._nodes),
+                "services": {f"{n}\x00{s}": copy.deepcopy(v)
                              for (n, s), v in self._services.items()},
-                "checks": {f"{n}\x00{c}": v
+                "checks": {f"{n}\x00{c}": copy.deepcopy(v)
                            for (n, c), v in self._checks.items()},
-                "sessions": dict(self._sessions),
+                "sessions": copy.deepcopy(self._sessions),
             }
+
+    def load_snapshot(self, snap: dict) -> None:
+        """In-place restore — raft InstallSnapshot hits a live store whose
+        identity is shared with the FSM and API (the reference swaps the
+        whole store and abandons the old one, state_store.go:106; here the
+        watchers are woken by the index bump instead)."""
+        import base64
+        import copy
+        with self._lock:
+            self._index = snap["index"]
+            self._kv = {k: dict(v, value=base64.b64decode(v["value"]))
+                        for k, v in snap["kv"].items()}
+            self._kv_delete_index = dict(snap.get("kv_delete_index", {}))
+            self._nodes = copy.deepcopy(snap["nodes"])
+            self._services = {tuple(k.split("\x00")): copy.deepcopy(v)
+                              for k, v in snap["services"].items()}
+            self._checks = {tuple(k.split("\x00")): copy.deepcopy(v)
+                            for k, v in snap["checks"].items()}
+            self._sessions = copy.deepcopy(snap["sessions"])
+            self._cond.notify_all()
 
     @classmethod
     def restore(cls, snap: dict) -> "StateStore":
-        import base64
         st = cls()
-        st._index = snap["index"]
-        st._kv = {k: dict(v, value=base64.b64decode(v["value"]))
-                  for k, v in snap["kv"].items()}
-        st._nodes = dict(snap["nodes"])
-        st._services = {tuple(k.split("\x00")): v
-                        for k, v in snap["services"].items()}
-        st._checks = {tuple(k.split("\x00")): v
-                      for k, v in snap["checks"].items()}
-        st._sessions = dict(snap["sessions"])
+        st.load_snapshot(snap)
         return st

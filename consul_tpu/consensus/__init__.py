@@ -1,0 +1,12 @@
+"""Host-side consensus: Raft leader election + replicated log + FSM.
+
+The reference keeps strong consistency in hashicorp/raft (go.mod:55,
+wired in agent/consul/server.go:674 setupRaft); SURVEY.md §2.1 marks this
+layer host-side for the TPU build — the cluster-scale work (membership,
+coordinates, dissemination) lives on the device, while the 3-7 server
+control plane stays a small, deterministic host protocol.
+"""
+
+from consul_tpu.consensus.raft import (  # noqa: F401
+    InMemTransport, NotLeaderError, RaftConfig, RaftNode,
+)

@@ -1,0 +1,115 @@
+"""FSM: replicated command registry over the StateStore.
+
+The reference's FSM (agent/consul/fsm/fsm.go:118 Apply; command registry
+fsm/commands_oss.go:105-134) decodes raft log entries into state-store
+mutations.  Same shape here: a command is `{"op": <name>, "args": {...}}`
+and every replica applies it to its own StateStore, so stores converge
+deterministically.  Anything nondeterministic (uuids, session ids) is
+generated at the *proposer* and carried inside the command — the apply
+path must be a pure function of (store, cmd).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from consul_tpu.catalog.store import StateStore
+
+
+class ServerFSM:
+    def __init__(self, store: StateStore):
+        self.store = store
+        self._ops = {
+            "kv_set": self._kv_set,
+            "kv_delete": self._kv_delete,
+            "txn": self._txn,
+            "register_node": self._register_node,
+            "register_service": self._register_service,
+            "register_check": self._register_check,
+            "update_check": self._update_check,
+            "deregister_node": self._deregister_node,
+            "deregister_service": self._deregister_service,
+            "session_create": self._session_create,
+            "session_renew": self._session_renew,
+            "session_destroy": self._session_destroy,
+        }
+
+    def apply(self, cmd: Dict[str, Any]) -> Any:
+        op = cmd["op"]
+        fn = self._ops.get(op)
+        if fn is None:
+            # unknown command: ignore-but-log stance of the reference's
+            # msgTypeMask forward-compat path (fsm.go:93-116 region)
+            return {"error": f"unknown op {op}"}
+        return fn(**cmd["args"])
+
+    # each handler returns a JSON-able result dict
+
+    def _kv_set(self, key, value, flags=0, cas=None, acquire=None,
+                release=None):
+        if isinstance(value, str):
+            # latin-1 round-trips arbitrary bytes 1:1 (the proposer encodes
+            # with latin-1 too); utf-8 would mangle bytes > 0x7F
+            value = value.encode("latin-1")
+        ok, idx = self.store.kv_set(key, value, flags=flags, cas=cas,
+                                    acquire=acquire, release=release)
+        return {"ok": ok, "index": idx}
+
+    def _kv_delete(self, key, recurse=False, cas=None):
+        ok, idx = self.store.kv_delete(key, recurse=recurse, cas=cas)
+        return {"ok": ok, "index": idx}
+
+    def _txn(self, ops):
+        for op in ops:
+            if isinstance(op.get("value"), str):
+                op["value"] = op["value"].encode("latin-1")
+        ok, results, idx = self.store.txn(ops)
+        safe = [r if not isinstance(r, dict) else
+                dict(r, value=(r["value"].decode("latin-1")
+                               if isinstance(r.get("value"), bytes) else
+                               r.get("value")))
+                for r in results]
+        return {"ok": ok, "results": safe, "index": idx}
+
+    def _register_node(self, node, address, meta=None, node_id=None):
+        return {"index": self.store.register_node(node, address, meta,
+                                                  node_id)}
+
+    def _register_service(self, node, service_id, name, port=0, tags=None,
+                          meta=None, address=""):
+        return {"index": self.store.register_service(
+            node, service_id, name, port, tags, meta, address)}
+
+    def _register_check(self, node, check_id, name, status="critical",
+                        service_id="", output=""):
+        return {"index": self.store.register_check(
+            node, check_id, name, status, service_id, output)}
+
+    def _update_check(self, node, check_id, status, output=""):
+        try:
+            return {"index": self.store.update_check(node, check_id, status,
+                                                     output)}
+        except KeyError:
+            return {"error": "unknown check", "index": self.store.index}
+
+    def _deregister_node(self, node):
+        return {"index": self.store.deregister_node(node)}
+
+    def _deregister_service(self, node, service_id):
+        return {"index": self.store.deregister_service(node, service_id)}
+
+    def _session_create(self, sid, node, ttl=0.0, behavior="release",
+                        lock_delay=15.0, checks=None, now=None):
+        try:
+            sid, idx = self.store.session_create(
+                node, ttl=ttl, behavior=behavior, lock_delay=lock_delay,
+                checks=checks, sid=sid, now=now)
+            return {"id": sid, "index": idx}
+        except KeyError:
+            return {"error": "unknown node", "index": self.store.index}
+
+    def _session_renew(self, sid, now=None):
+        return {"ok": self.store.session_renew(sid, now=now)}
+
+    def _session_destroy(self, sid, now=None):
+        return {"index": self.store.session_destroy(sid, now=now)}

@@ -1,0 +1,573 @@
+"""Tick-driven Raft: leader election, log replication, snapshots.
+
+Behavioral equivalent of the hashicorp/raft engine the reference wires in
+at agent/consul/server.go:674 (setupRaft) — terms, randomized election
+timeouts, AppendEntries consistency checking, quorum commit,
+FSM Apply/Snapshot/Restore (agent/consul/fsm/fsm.go:118,145,163), and
+InstallSnapshot for lagging followers.  Design departures, deliberate:
+
+  * **Tick-synchronous with an injectable clock.**  The reference absorbs
+    wall-clock flakiness with retry loops (sdk/testutil/retry); here time
+    is an explicit argument to `tick(now)`, so an in-process multi-server
+    cluster (SURVEY.md §4 tier 2) is stepped deterministically — the same
+    make-time-explicit stance the device kernels take.
+  * **Transport is an interface**; the in-memory one supports partitions
+    and message loss for fault-injection tests (the reference's partition
+    tests shut sockets down, agent/consul/leader_test.go patterns).
+  * raft_multiplier scaling (website docs performance.mdx:33-58) maps to
+    scaling `election_timeout` / `heartbeat_interval` in RaftConfig.
+
+Log indexing is 1-based global; `log_base`/`log_base_term` carry the
+snapshot horizon so the in-memory window is compacted (the reference's
+boltdb log + snapshot store collapse into one object here).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FOLLOWER = "follower"
+CANDIDATE = "candidate"
+LEADER = "leader"
+
+
+class NotLeaderError(Exception):
+    """Raised on apply() at a non-leader; carries the leader hint the way
+    structs.ErrNoLeader / leader-forwarding does (agent/consul/rpc.go:549)."""
+
+    def __init__(self, leader: Optional[str]):
+        super().__init__(f"node is not the leader (leader hint: {leader})")
+        self.leader = leader
+
+
+@dataclass
+class RaftConfig:
+    election_timeout: Tuple[float, float] = (0.15, 0.30)  # seconds, jittered
+    heartbeat_interval: float = 0.05
+    snapshot_threshold: int = 1024      # log entries before auto-compaction
+    snapshot_trailing: int = 128        # entries kept behind a snapshot
+    max_append_entries: int = 64
+
+    @classmethod
+    def scaled(cls, raft_multiplier: int = 1) -> "RaftConfig":
+        m = max(1, raft_multiplier)
+        return cls(election_timeout=(0.15 * m, 0.30 * m),
+                   heartbeat_interval=0.05 * m)
+
+
+class Transport:
+    """send() is fire-and-forget; delivery happens into the target inbox."""
+
+    def send(self, target: str, msg: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class InMemTransport(Transport):
+    """Process-local message bus with partition + loss injection — the
+    freeport/in-process-cluster trick of the reference's tests
+    (agent/consul/server_test.go:116-122) without sockets."""
+
+    def __init__(self, seed: int = 0):
+        self._nodes: Dict[str, "RaftNode"] = {}
+        self._lock = threading.Lock()
+        self._cut: set = set()          # directed (src, dst) pairs down
+        self.p_loss = 0.0
+        self._rng = random.Random(seed)
+
+    def register(self, node: "RaftNode") -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+
+    def partition(self, a: str, b: str, bidir: bool = True) -> None:
+        with self._lock:
+            self._cut.add((a, b))
+            if bidir:
+                self._cut.add((b, a))
+
+    def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> None:
+        with self._lock:
+            if a is None:
+                self._cut.clear()
+            else:
+                self._cut.discard((a, b))
+                self._cut.discard((b, a))
+
+    def isolate(self, node_id: str) -> None:
+        with self._lock:
+            for other in self._nodes:
+                if other != node_id:
+                    self._cut.add((node_id, other))
+                    self._cut.add((other, node_id))
+
+    def send(self, target: str, msg: dict) -> None:
+        with self._lock:
+            if (msg["from"], target) in self._cut:
+                return
+            if self.p_loss and self._rng.random() < self.p_loss:
+                return
+            node = self._nodes.get(target)
+        if node is not None:
+            node.deliver(msg)
+
+
+@dataclass
+class _Entry:
+    term: int
+    cmd: Any
+    noop: bool = False
+
+
+@dataclass
+class _Pending:
+    event: threading.Event = field(default_factory=threading.Event)
+    result: Any = None
+    error: Optional[Exception] = None
+
+
+class RaftNode:
+    """One Raft participant.  Drive it by calling tick(now) — from a test
+    harness with virtual time, or RaftDriver with wall time."""
+
+    def __init__(self, node_id: str, peers: List[str], transport: Transport,
+                 apply_fn: Callable[[Any], Any],
+                 snapshot_fn: Optional[Callable[[], Any]] = None,
+                 restore_fn: Optional[Callable[[Any], None]] = None,
+                 config: Optional[RaftConfig] = None, seed: int = 0):
+        self.node_id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.transport = transport
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.cfg = config or RaftConfig()
+        self._rng = random.Random(hash((node_id, seed)) & 0xFFFFFFFF)
+
+        # persistent state
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self.log: List[_Entry] = []
+        self.log_base = 0               # entries <= log_base are compacted
+        self.log_base_term = 0
+        self.snap_index = 0             # FSM state captured through here
+        self.snap_term = 0
+        self.snapshot_data: Any = None
+
+        # volatile
+        self.state = FOLLOWER
+        self.commit_index = 0
+        self.last_applied = 0
+        self.leader_id: Optional[str] = None
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._votes: set = set()
+        self._prevotes: set = set()
+        self._last_contact = -1e18      # last valid leader contact (for pre-vote)
+        self._election_deadline = 0.0
+        self._heartbeat_due = 0.0
+        self._inbox: List[dict] = []
+        self._lock = threading.RLock()
+        self._pending: Dict[int, _Pending] = {}   # log index -> waiter
+        self._leader_observers: List[Callable[[bool], None]] = []
+        self.applied_index_log: List[int] = []    # for tests/metrics
+        self._first_tick = True
+
+    # -------------------------------------------------------------- log math
+
+    @property
+    def last_log_index(self) -> int:
+        return self.log_base + len(self.log)
+
+    @property
+    def last_log_term(self) -> int:
+        return self.log[-1].term if self.log else self.log_base_term
+
+    def _term_at(self, idx: int) -> Optional[int]:
+        if idx == 0:
+            return 0
+        if idx == self.log_base:
+            return self.log_base_term
+        off = idx - self.log_base - 1
+        if 0 <= off < len(self.log):
+            return self.log[off].term
+        return None
+
+    def _entries_from(self, idx: int, limit: int) -> List[dict]:
+        off = idx - self.log_base - 1
+        return [{"term": e.term, "cmd": e.cmd, "noop": e.noop}
+                for e in self.log[off:off + limit]]
+
+    # ------------------------------------------------------------ public API
+
+    def deliver(self, msg: dict) -> None:
+        with self._lock:
+            self._inbox.append(msg)
+
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.state == LEADER
+
+    def add_leader_observer(self, fn: Callable[[bool], None]) -> None:
+        """Mirror of raft's LeaderCh feeding monitorLeadership
+        (agent/consul/leader.go:64)."""
+        self._leader_observers.append(fn)
+
+    def apply(self, cmd: Any, noop: bool = False) -> _Pending:
+        """Leader-only append; returns a waiter resolved at FSM apply
+        (raftApply — agent/consul/rpc.go:730)."""
+        with self._lock:
+            if self.state != LEADER:
+                raise NotLeaderError(self.leader_id)
+            self.log.append(_Entry(self.current_term, cmd, noop))
+            idx = self.last_log_index
+            pend = _Pending()
+            self._pending[idx] = pend
+            self.match_index[self.node_id] = idx
+            return pend
+
+    def barrier(self) -> _Pending:
+        """Commit a no-op in the current term — leader barrier before
+        serving (establishLeadership, agent/consul/leader.go:306)."""
+        return self.apply(None, noop=True)
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self, now: float) -> None:
+        with self._lock:
+            if self._first_tick:
+                self._reset_election_timer(now)
+                self._first_tick = False
+            inbox, self._inbox = self._inbox, []
+            for msg in inbox:
+                self._handle(msg, now)
+            if self.state in (FOLLOWER, CANDIDATE):
+                if now >= self._election_deadline:
+                    self._start_election(now)
+            if self.state == LEADER and now >= self._heartbeat_due:
+                self._broadcast_append(now)
+            self._advance_commit()
+            self._apply_committed()
+            self._maybe_compact()
+
+    # -------------------------------------------------------------- internal
+
+    def _reset_election_timer(self, now: float) -> None:
+        lo, hi = self.cfg.election_timeout
+        self._election_deadline = now + self._rng.uniform(lo, hi)
+
+    def _become_follower(self, term: int, now: float) -> None:
+        was_leader = self.state == LEADER
+        self.state = FOLLOWER
+        if term > self.current_term:
+            self.current_term = term
+            self.voted_for = None
+        self._reset_election_timer(now)
+        if was_leader:
+            self._fail_pending(NotLeaderError(self.leader_id))
+            for fn in self._leader_observers:
+                fn(False)
+
+    def _fail_pending(self, err: Exception) -> None:
+        for pend in self._pending.values():
+            pend.error = err
+            pend.event.set()
+        self._pending.clear()
+
+    def _start_election(self, now: float) -> None:
+        """Election timeout fired.  Phase 1 is Pre-Vote (Raft thesis §9.6,
+        hashicorp/raft PreVote): probe electability WITHOUT bumping our term
+        so a partitioned node can't depose a healthy leader on rejoin."""
+        self._prevotes = {self.node_id}
+        self._reset_election_timer(now)
+        for p in self.peers:
+            self.transport.send(p, {
+                "type": "pre_vote", "from": self.node_id,
+                "term": self.current_term + 1,
+                "last_log_index": self.last_log_index,
+                "last_log_term": self.last_log_term})
+        self._maybe_prevote_win(now)
+
+    def _maybe_prevote_win(self, now: float) -> None:
+        if self.state == LEADER:
+            return
+        if len(self._prevotes) * 2 <= len(self.peers) + 1:
+            return
+        self.state = CANDIDATE
+        self.current_term += 1
+        self.voted_for = self.node_id
+        self._votes = {self.node_id}
+        self._prevotes = set()
+        self.leader_id = None
+        for p in self.peers:
+            self.transport.send(p, {
+                "type": "request_vote", "from": self.node_id,
+                "term": self.current_term,
+                "last_log_index": self.last_log_index,
+                "last_log_term": self.last_log_term})
+        self._maybe_win(now)
+
+    def _maybe_win(self, now: float) -> None:
+        if self.state != CANDIDATE:
+            return
+        if len(self._votes) * 2 > len(self.peers) + 1:
+            self.state = LEADER
+            self.leader_id = self.node_id
+            nxt = self.last_log_index + 1
+            self.next_index = {p: nxt for p in self.peers}
+            self.match_index = {p: 0 for p in self.peers}
+            self.match_index[self.node_id] = self.last_log_index
+            # no-op barrier commits this term (Raft §8 / leader.go:306)
+            self.log.append(_Entry(self.current_term, None, True))
+            self.match_index[self.node_id] = self.last_log_index
+            self._heartbeat_due = now
+            self._broadcast_append(now)
+            for fn in self._leader_observers:
+                fn(True)
+
+    def _broadcast_append(self, now: float) -> None:
+        self._heartbeat_due = now + self.cfg.heartbeat_interval
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, peer: str) -> None:
+        nxt = self.next_index.get(peer, self.last_log_index + 1)
+        if nxt <= self.log_base:
+            # peer is behind the snapshot horizon → InstallSnapshot
+            self.transport.send(peer, {
+                "type": "install_snapshot", "from": self.node_id,
+                "term": self.current_term,
+                "last_index": self.snap_index, "last_term": self.snap_term,
+                "data": self.snapshot_data})
+            return
+        prev = nxt - 1
+        self.transport.send(peer, {
+            "type": "append_entries", "from": self.node_id,
+            "term": self.current_term,
+            "prev_index": prev, "prev_term": self._term_at(prev) or 0,
+            "entries": self._entries_from(nxt, self.cfg.max_append_entries),
+            "leader_commit": self.commit_index})
+
+    def _handle(self, msg: dict, now: float) -> None:
+        t = msg["type"]
+        if t == "pre_vote":
+            # grant without touching our term: candidate log up-to-date AND
+            # we have no live leader (quiet for >= min election timeout)
+            up_to_date = (
+                msg["last_log_term"] > self.last_log_term
+                or (msg["last_log_term"] == self.last_log_term
+                    and msg["last_log_index"] >= self.last_log_index))
+            quiet = (self.leader_id is None
+                     or now - self._last_contact
+                     >= self.cfg.election_timeout[0])
+            grant = (msg["term"] > self.current_term and up_to_date
+                     and quiet and self.state != LEADER)
+            self.transport.send(msg["from"], {
+                "type": "pre_vote_reply", "from": self.node_id,
+                "term": self.current_term, "granted": grant})
+            return
+        if t == "pre_vote_reply":
+            if msg["granted"] and self.state != LEADER:
+                self._prevotes.add(msg["from"])
+                self._maybe_prevote_win(now)
+            return
+        if msg.get("term", 0) > self.current_term:
+            self._become_follower(msg["term"], now)
+        if t == "request_vote":
+            self._on_request_vote(msg, now)
+        elif t == "vote_reply":
+            if (self.state == CANDIDATE and msg["term"] == self.current_term
+                    and msg["granted"]):
+                self._votes.add(msg["from"])
+                self._maybe_win(now)
+        elif t == "append_entries":
+            self._on_append_entries(msg, now)
+        elif t == "append_reply":
+            self._on_append_reply(msg)
+        elif t == "install_snapshot":
+            self._on_install_snapshot(msg, now)
+        elif t == "snapshot_reply":
+            if self.state == LEADER and msg["term"] == self.current_term:
+                self.next_index[msg["from"]] = msg["last_index"] + 1
+                self.match_index[msg["from"]] = msg["last_index"]
+
+    def _on_request_vote(self, msg: dict, now: float) -> None:
+        grant = False
+        if msg["term"] >= self.current_term:
+            up_to_date = (
+                msg["last_log_term"] > self.last_log_term
+                or (msg["last_log_term"] == self.last_log_term
+                    and msg["last_log_index"] >= self.last_log_index))
+            if up_to_date and self.voted_for in (None, msg["from"]):
+                grant = True
+                self.voted_for = msg["from"]
+                self._reset_election_timer(now)
+        self.transport.send(msg["from"], {
+            "type": "vote_reply", "from": self.node_id,
+            "term": self.current_term, "granted": grant})
+
+    def _on_append_entries(self, msg: dict, now: float) -> None:
+        ok = False
+        if msg["term"] >= self.current_term:
+            if self.state != FOLLOWER or msg["term"] > self.current_term:
+                self._become_follower(msg["term"], now)
+            self.leader_id = msg["from"]
+            self._last_contact = now
+            self._reset_election_timer(now)
+            prev_term = self._term_at(msg["prev_index"])
+            if msg["prev_index"] <= self.log_base:
+                # prefix is inside our snapshot — consistent by definition
+                prev_term = msg["prev_term"]
+            if prev_term == msg["prev_term"]:
+                ok = True
+                idx = msg["prev_index"]
+                for ent in msg["entries"]:
+                    idx += 1
+                    have = self._term_at(idx)
+                    if idx <= self.log_base:
+                        continue            # already snapshotted
+                    if have is not None and have != ent["term"]:
+                        del self.log[idx - self.log_base - 1:]
+                        have = None
+                    if have is None:
+                        self.log.append(_Entry(ent["term"], ent["cmd"],
+                                               ent.get("noop", False)))
+                if msg["leader_commit"] > self.commit_index:
+                    self.commit_index = min(msg["leader_commit"],
+                                            self.last_log_index)
+        self.transport.send(msg["from"], {
+            "type": "append_reply", "from": self.node_id,
+            "term": self.current_term, "ok": ok,
+            "match_index": (msg["prev_index"] + len(msg["entries"])) if ok
+            else 0,
+            "hint_index": min(msg["prev_index"], self.last_log_index + 1)
+            if not ok else 0})
+
+    def _on_append_reply(self, msg: dict) -> None:
+        if self.state != LEADER or msg["term"] != self.current_term:
+            return
+        peer = msg["from"]
+        if msg["ok"]:
+            self.match_index[peer] = max(self.match_index.get(peer, 0),
+                                         msg["match_index"])
+            self.next_index[peer] = self.match_index[peer] + 1
+            if self.next_index[peer] <= self.last_log_index:
+                self._send_append(peer)     # keep streaming backlog
+        else:
+            self.next_index[peer] = max(1, msg.get("hint_index", 1))
+            self._send_append(peer)
+
+    def _on_install_snapshot(self, msg: dict, now: float) -> None:
+        if msg["term"] >= self.current_term:
+            if self.state != FOLLOWER:
+                self._become_follower(msg["term"], now)
+            self.leader_id = msg["from"]
+            self._last_contact = now
+            self._reset_election_timer(now)
+            if msg["last_index"] > self.last_applied:
+                if self.restore_fn is not None:
+                    self.restore_fn(msg["data"])
+                self.snapshot_data = msg["data"]
+                self.log_base = msg["last_index"]
+                self.log_base_term = msg["last_term"]
+                self.snap_index = msg["last_index"]
+                self.snap_term = msg["last_term"]
+                self.log = []
+                self.commit_index = max(self.commit_index, self.log_base)
+                self.last_applied = max(self.last_applied, self.log_base)
+        self.transport.send(msg["from"], {
+            "type": "snapshot_reply", "from": self.node_id,
+            "term": self.current_term, "last_index": self.last_applied})
+
+    def _advance_commit(self) -> None:
+        if self.state != LEADER:
+            return
+        matches = sorted(self.match_index.values(), reverse=True)
+        quorum = (len(self.peers) + 1) // 2 + 1
+        if len(matches) < quorum:
+            return
+        candidate = matches[quorum - 1]
+        # Raft §5.4.2: only commit entries from the current term by counting
+        if (candidate > self.commit_index
+                and self._term_at(candidate) == self.current_term):
+            self.commit_index = candidate
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            self.last_applied += 1
+            off = self.last_applied - self.log_base - 1
+            if off < 0:
+                continue                    # covered by restored snapshot
+            ent = self.log[off]
+            result = None
+            if not ent.noop:
+                result = self.apply_fn(ent.cmd)
+            self.applied_index_log.append(self.last_applied)
+            pend = self._pending.pop(self.last_applied, None)
+            if pend is not None:
+                pend.result = result
+                pend.event.set()
+
+    def _maybe_compact(self) -> None:
+        if self.snapshot_fn is None:
+            return
+        applied_in_log = self.last_applied - self.log_base
+        if applied_in_log < self.cfg.snapshot_threshold:
+            return
+        keep_from = self.last_applied - self.cfg.snapshot_trailing
+        if keep_from <= self.log_base:
+            return
+        self.snapshot_data = self.snapshot_fn()
+        self.snap_index = self.last_applied
+        self.snap_term = self._term_at(self.last_applied) or 0
+        new_base_term = self._term_at(keep_from) or self.log_base_term
+        self.log = self.log[keep_from - self.log_base:]
+        self.log_base = keep_from
+        self.log_base_term = new_base_term
+
+    # ------------------------------------------------------------- stats API
+
+    def stats(self) -> dict:
+        """operator raft list-peers / autopilot-ish visibility
+        (agent/consul/operator_raft_endpoint.go)."""
+        with self._lock:
+            return {
+                "state": self.state, "term": self.current_term,
+                "leader": self.leader_id,
+                "commit_index": self.commit_index,
+                "last_applied": self.last_applied,
+                "last_log_index": self.last_log_index,
+                "log_base": self.log_base,
+                "peers": [self.node_id] + list(self.peers),
+            }
+
+
+class RaftDriver:
+    """Wall-clock pump for a set of nodes (one thread, like the reference's
+    runtime goroutines but centrally owned — lib/routine.Manager stance)."""
+
+    def __init__(self, nodes: List[RaftNode], tick_seconds: float = 0.01):
+        self.nodes = nodes
+        self.tick_seconds = tick_seconds
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        import time
+        self._running = True
+
+        def loop():
+            while self._running:
+                now = time.time()
+                for n in self.nodes:
+                    n.tick(now)
+                time.sleep(self.tick_seconds)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5.0)

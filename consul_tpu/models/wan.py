@@ -59,10 +59,15 @@ def make_params(n_dcs: int = 3, nodes_per_dc: int = 1024,
                      lan=lan, wan=wan)
 
 
+BRIDGE_RING = 4                 # x event_slots: per-DC bridged-id memory
+
+
 @struct.dataclass
 class WanState:
     lan: serf.ClusterState      # batched: leading axis D on every leaf
     wan: serf.ClusterState      # flat WAN pool
+    bridged: jnp.ndarray        # [D, B] int32 event ids already bridged (-1 empty)
+    bridged_ptr: jnp.ndarray    # [D] int32 ring cursor
 
 
 def init_state(params: WanParams) -> WanState:
@@ -70,17 +75,37 @@ def init_state(params: WanParams) -> WanState:
                             params.n_dcs)
     lan = jax.vmap(lambda k: serf.init_state(params.lan, k))(keys)
     wan = serf.init_state(params.wan)
-    return WanState(lan=lan, wan=wan)
+    b = BRIDGE_RING * params.lan.events.event_slots
+    return WanState(lan=lan, wan=wan,
+                    bridged=jnp.full((params.n_dcs, b), -1, jnp.int32),
+                    bridged_ptr=jnp.zeros((params.n_dcs,), jnp.int32))
 
 
-def _first_active_candidate(e_active, known_mask, e_id, other_ids):
+def _active_ids(e_active, e_id):
+    """Active-slot ids with -1 for inactive slots (0 is a valid event id;
+    multiplying by the mask would make id 0 look ever-present)."""
+    return jnp.where(e_active, e_id, -1)
+
+
+def _first_active_candidate(e_active, known_mask, e_id, other_ids, seen):
     """Pick the first active event known to a bridge node whose id is not
-    in `other_ids`; returns (found, slot)."""
-    present = jnp.any(
-        e_id[:, None] == other_ids[None, :], axis=1)
-    cand = e_active & known_mask & ~present
+    in the destination's active slots NOR in this DC's bridged-id ring;
+    returns (found, slot).  The ring is the re-fire guard: LAN and WAN
+    slots expire on different schedules, so table presence alone would let
+    an event ping-pong between pools forever."""
+    present = jnp.any(e_id[:, None] == other_ids[None, :], axis=1)
+    already = jnp.any(e_id[:, None] == seen[None, :], axis=1)
+    cand = e_active & known_mask & ~present & ~already
     slot = jnp.argmax(cand)
     return jnp.any(cand), slot
+
+
+def _ring_push(bridged_row, ptr, value, enable):
+    """Record `value` in the ring when `enable` (jit-safe)."""
+    b = bridged_row.shape[0]
+    row = jnp.where(enable,
+                    bridged_row.at[ptr % b].set(value), bridged_row)
+    return row, ptr + jnp.where(enable, 1, 0)
 
 
 def step(params: WanParams, s: WanState) -> WanState:
@@ -93,7 +118,7 @@ def step(params: WanParams, s: WanState) -> WanState:
     ticks at WAN defaults vs 5 LAN) preserves the relative cadence."""
     lan = jax.vmap(lambda st: serf.step(params.lan, st))(s.lan)
     wan = serf.step(params.wan, s.wan)
-    s = WanState(lan=lan, wan=wan)
+    s = s.replace(lan=lan, wan=wan)
     s = _bridge_events(params, s)
     return s
 
@@ -101,14 +126,15 @@ def step(params: WanParams, s: WanState) -> WanState:
 def _bridge_events(params: WanParams, s: WanState) -> WanState:
     d, sp = params.n_dcs, params.servers_per_dc
     lan_ev, wan_ev = s.lan.events, s.wan.events
+    bridged, bridged_ptr = s.bridged, s.bridged_ptr
 
     # ---- LAN -> WAN: a server that knows a local event injects it
     for dc in range(d):
         ev = jax.tree_util.tree_map(lambda x: x[dc], lan_ev)
         served = jnp.any(ev.know[:sp, :], axis=0)          # [E] some server knows
         found, slot = _first_active_candidate(
-            ev.e_active, served, ev.e_id, wan_ev.e_id *
-            jnp.where(wan_ev.e_active, 1, 0))
+            ev.e_active, served, ev.e_id,
+            _active_ids(wan_ev.e_active, wan_ev.e_id), bridged[dc])
         origin_server = dc * sp + jnp.argmax(
             jnp.any(ev.know[:sp, :], axis=1))
         wan_ev = jax.tree_util.tree_map(
@@ -116,6 +142,10 @@ def _bridge_events(params: WanParams, s: WanState) -> WanState:
             events.fire(params.wan.events, wan_ev, origin_server,
                         ev.e_id[slot]),
             wan_ev)
+        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc],
+                              ev.e_id[slot], found)
+        bridged = bridged.at[dc].set(row)
+        bridged_ptr = bridged_ptr.at[dc].set(ptr)
 
     # ---- WAN -> LAN: a server that knows a WAN event fires it locally
     new_lan_ev = []
@@ -125,17 +155,22 @@ def _bridge_events(params: WanParams, s: WanState) -> WanState:
         known_here = jnp.any(my_servers, axis=0)            # [E]
         found, slot = _first_active_candidate(
             wan_ev.e_active, known_here, wan_ev.e_id,
-            ev.e_id * jnp.where(ev.e_active, 1, 0))
+            _active_ids(ev.e_active, ev.e_id), bridged[dc])
         local_origin = jnp.argmax(jnp.any(my_servers, axis=1))
         fired = events.fire(params.lan.events, ev, local_origin,
                             wan_ev.e_id[slot])
         new_lan_ev.append(jax.tree_util.tree_map(
             lambda new, old: jnp.where(found, new, old), fired, ev))
+        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc],
+                              wan_ev.e_id[slot], found)
+        bridged = bridged.at[dc].set(row)
+        bridged_ptr = bridged_ptr.at[dc].set(ptr)
 
     lan_ev = jax.tree_util.tree_map(
         lambda *xs: jnp.stack(xs, axis=0), *new_lan_ev)
-    return WanState(lan=s.lan.replace(events=lan_ev),
-                    wan=s.wan.replace(events=wan_ev))
+    return s.replace(lan=s.lan.replace(events=lan_ev),
+                     wan=s.wan.replace(events=wan_ev),
+                     bridged=bridged, bridged_ptr=bridged_ptr)
 
 
 def run(params: WanParams, s: WanState, n_ticks: int) -> WanState:
@@ -153,7 +188,7 @@ def fire_event(params: WanParams, s: WanState, dc: int, origin: int,
     fired = events.fire(params.lan.events, ev, origin, event_id)
     lan_ev = jax.tree_util.tree_map(
         lambda full, one: full.at[dc].set(one), s.lan.events, fired)
-    return WanState(lan=s.lan.replace(events=lan_ev), wan=s.wan)
+    return s.replace(lan=s.lan.replace(events=lan_ev))
 
 
 def event_coverage_by_dc(params: WanParams, s: WanState,
@@ -170,16 +205,16 @@ def event_coverage_by_dc(params: WanParams, s: WanState,
 
 def dc_distance_matrix(params: WanParams, s: WanState) -> jnp.ndarray:
     """[D, D] median server-to-server estimated RTT — the WAN-coordinate
-    DC ranking (reference agent/router/router.go:534)."""
+    DC ranking (reference agent/router/router.go:534).  Uses the canonical
+    vivaldi.estimate_rtt (incl. its adjustment positivity floor) on all
+    server pairs rather than re-deriving the metric."""
     from consul_tpu.models import vivaldi
     d, sp = params.n_dcs, params.servers_per_dc
-    ids = jnp.arange(d * sp, dtype=jnp.int32)
-    ca = s.wan.coords
-    # pairwise server RTTs
-    diff = ca.coords[:, None, :] - ca.coords[None, :, :]
-    dist = jnp.linalg.norm(diff, axis=-1) + ca.height[:, None] + ca.height[None, :]
-    dist = dist + ca.adjustment[:, None] + ca.adjustment[None, :]
-    dist = dist.reshape(d, sp, d, sp)
+    n = d * sp
+    ii, jj = jnp.meshgrid(jnp.arange(n, dtype=jnp.int32),
+                          jnp.arange(n, dtype=jnp.int32), indexing="ij")
+    dist = vivaldi.estimate_rtt(s.wan.coords, ii.ravel(),
+                                jj.ravel()).reshape(d, sp, d, sp)
     return jnp.median(dist, axis=(1, 3))
 
 
@@ -190,8 +225,7 @@ def wan_kill_dc(params: WanParams, s: WanState, dc: int) -> WanState:
     sw = s.wan.swim
     ids = jnp.arange(sw.up.shape[0])
     mask = (ids >= dc * sp) & (ids < (dc + 1) * sp)
-    return WanState(lan=s.lan,
-                    wan=s.wan.replace(swim=sw.replace(up=sw.up & ~mask)))
+    return s.replace(wan=s.wan.replace(swim=sw.replace(up=sw.up & ~mask)))
 
 
 def dc_reachable(params: WanParams, s: WanState) -> jnp.ndarray:

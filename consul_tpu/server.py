@@ -1,0 +1,284 @@
+"""Server core: Raft-replicated state store behind Consul-shaped RPCs.
+
+The reference's server (agent/consul/server.go:322 NewServer) owns the
+raft engine, the FSM, the state store, and the RPC endpoints; writes
+funnel through raftApply (rpc.go:730) and non-leaders forward to the
+leader (rpc.go:549 ForwardRPC).  Same structure here:
+
+    Server = StateStore (replica) + ServerFSM + RaftNode
+    writes: Server.<mutation>() → leader lookup → raft.apply → quorum
+            commit → every replica's FSM mutates its store
+    reads:  local store (stale) or leader-verified (default/consistent,
+            via a raft barrier — the reference's consistentRead uses
+            VerifyLeader, rpc.go:~930)
+
+Leader duties (the monitorLeadership/leaderLoop analogue,
+agent/consul/leader.go:64,165) run inside tick(): session-TTL expiry is
+*proposed* by the leader and applied by every replica, so timers stay a
+leader concern while state changes replicate — exactly the reference's
+split (session_ttl.go:45).
+
+Servers discover each other through a process-local registry dict for
+in-process clusters (SURVEY.md §4 tier 2); swap the registry for an RPC
+proxy to cross process boundaries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.consensus.fsm import ServerFSM
+from consul_tpu.consensus.raft import (
+    NotLeaderError, RaftConfig, RaftNode, Transport,
+)
+
+
+class NoLeaderError(Exception):
+    """No leader available within the retry budget (structs.ErrNoLeader)."""
+
+
+class Server:
+    def __init__(self, node_id: str, peers: List[str], transport: Transport,
+                 registry: Dict[str, "Server"],
+                 raft_config: Optional[RaftConfig] = None, seed: int = 0):
+        self.node_id = node_id
+        self.store = StateStore()
+        self.fsm = ServerFSM(self.store)
+        self.registry = registry
+        self.raft = RaftNode(
+            node_id, peers, transport,
+            apply_fn=self.fsm.apply,
+            snapshot_fn=self.store.snapshot,
+            restore_fn=self.store.load_snapshot,
+            config=raft_config, seed=seed)
+        if hasattr(transport, "register"):
+            transport.register(self.raft)
+        registry[node_id] = self
+        self._ttl_reap_inflight: set = set()
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self, now: Optional[float] = None) -> None:
+        now = now if now is not None else time.time()
+        self.raft.tick(now)
+        if self.raft.is_leader():
+            self._leader_duties(now)
+
+    def _leader_duties(self, now: float) -> None:
+        # session TTL sweep: propose destroys, don't block the tick thread
+        for sid in self.store.peek_expired_sessions(now):
+            if sid in self._ttl_reap_inflight:
+                continue
+            try:
+                self.raft.apply({"op": "session_destroy",
+                                 "args": {"sid": sid, "now": now}})
+                self._ttl_reap_inflight.add(sid)
+            except NotLeaderError:
+                break
+        self._ttl_reap_inflight &= set(
+            s["id"] for s in self.store.session_list())
+
+    # ------------------------------------------------------------ raft apply
+
+    def is_leader(self) -> bool:
+        return self.raft.is_leader()
+
+    @property
+    def leader_id(self) -> Optional[str]:
+        return self.raft.leader_id if not self.raft.is_leader() \
+            else self.node_id
+
+    def raft_apply(self, op: str, timeout: float = 5.0, **args) -> Any:
+        """Propose on the leader (forwarding like ForwardRPC, rpc.go:549)
+        and wait for FSM apply.  Retries once across leader changes."""
+        deadline = time.time() + timeout
+        last_err: Optional[Exception] = None
+        while time.time() < deadline:
+            target = self if self.raft.is_leader() else \
+                self.registry.get(self.raft.leader_id or "")
+            if target is None:
+                time.sleep(0.01)
+                continue
+            try:
+                pend = target.raft.apply({"op": op, "args": args})
+            except NotLeaderError as e:
+                last_err = e
+                time.sleep(0.01)
+                continue
+            if pend.event.wait(max(0.0, deadline - time.time())):
+                if pend.error is not None:
+                    last_err = pend.error
+                    continue
+                return pend.result
+            last_err = TimeoutError(f"raft apply {op} timed out")
+            break
+        raise NoLeaderError(str(last_err))
+
+    def consistent_index(self, timeout: float = 5.0) -> int:
+        """Leader barrier — readers wanting ?consistent semantics call this
+        first (VerifyLeader / consistentRead)."""
+        target = self if self.raft.is_leader() else \
+            self.registry.get(self.raft.leader_id or "")
+        if target is None:
+            raise NoLeaderError("no leader for consistent read")
+        pend = target.raft.barrier()
+        if not pend.event.wait(timeout) or pend.error is not None:
+            raise NoLeaderError("barrier failed")
+        return target.store.index
+
+    # --------------------------------------------------- replicated mutations
+    # Same signatures as StateStore so the HTTP layer can take either
+    # (duck-typed "write surface"); ids are generated here, proposer-side.
+
+    def kv_set(self, key, value, flags=0, cas=None, acquire=None,
+               release=None):
+        r = self.raft_apply("kv_set", key=key,
+                            value=value.decode("latin-1")
+                            if isinstance(value, bytes) else value,
+                            flags=flags, cas=cas, acquire=acquire,
+                            release=release)
+        return r["ok"], r["index"]
+
+    def kv_delete(self, key, recurse=False, cas=None):
+        r = self.raft_apply("kv_delete", key=key, recurse=recurse, cas=cas)
+        return r["ok"], r["index"]
+
+    def txn(self, ops):
+        safe_ops = [dict(op, value=op["value"].decode("latin-1"))
+                    if isinstance(op.get("value"), bytes) else dict(op)
+                    for op in ops]
+        r = self.raft_apply("txn", ops=safe_ops)
+        results = [x if not isinstance(x, dict) else
+                   dict(x, value=x["value"].encode("latin-1")
+                        if isinstance(x.get("value"), str) else
+                        x.get("value"))
+                   for x in r["results"]]
+        return r["ok"], results, r["index"]
+
+    def register_node(self, node, address, meta=None, node_id=None):
+        return self.raft_apply(
+            "register_node", node=node, address=address, meta=meta,
+            node_id=node_id or str(uuid.uuid4()))["index"]
+
+    def register_service(self, node, service_id, name, port=0, tags=None,
+                         meta=None, address=""):
+        return self.raft_apply(
+            "register_service", node=node, service_id=service_id, name=name,
+            port=port, tags=tags, meta=meta, address=address)["index"]
+
+    def register_check(self, node, check_id, name, status="critical",
+                       service_id="", output=""):
+        return self.raft_apply(
+            "register_check", node=node, check_id=check_id, name=name,
+            status=status, service_id=service_id, output=output)["index"]
+
+    def update_check(self, node, check_id, status, output=""):
+        r = self.raft_apply("update_check", node=node, check_id=check_id,
+                            status=status, output=output)
+        if "error" in r:
+            raise KeyError(r["error"])
+        return r["index"]
+
+    def deregister_node(self, node):
+        return self.raft_apply("deregister_node", node=node)["index"]
+
+    def deregister_service(self, node, service_id):
+        return self.raft_apply("deregister_service", node=node,
+                               service_id=service_id)["index"]
+
+    def session_create(self, node, ttl=0.0, behavior="release",
+                       lock_delay=15.0, checks=None, sid=None):
+        r = self.raft_apply("session_create", sid=sid or str(uuid.uuid4()),
+                            node=node, ttl=ttl, behavior=behavior,
+                            lock_delay=lock_delay, checks=checks,
+                            now=time.time())
+        if "error" in r:
+            raise KeyError(r["error"])
+        return r["id"], r["index"]
+
+    def session_renew(self, sid):
+        return self.raft_apply("session_renew", sid=sid,
+                               now=time.time())["ok"]
+
+    def session_destroy(self, sid):
+        return self.raft_apply("session_destroy", sid=sid,
+                               now=time.time())["index"]
+
+    # ------------------------------------------------------------- read side
+    # Stale reads hit the local replica directly; the HTTP layer decides.
+
+    def __getattr__(self, name):
+        # read-only store surface (kv_get, service_nodes, wait_for, ...);
+        # guard against recursion during __init__ before `store` exists
+        if name == "store":
+            raise AttributeError(name)
+        return getattr(self.store, name)
+
+    def stats(self) -> dict:
+        s = self.raft.stats()
+        s["node_id"] = self.node_id
+        s["store_index"] = self.store.index
+        return s
+
+
+class ServerCluster:
+    """In-process multi-server fixture + wall-clock driver (the reference's
+    test tier 2 made a first-class runtime object)."""
+
+    def __init__(self, n: int = 3, raft_config: Optional[RaftConfig] = None,
+                 transport: Optional[Transport] = None, seed: int = 0):
+        from consul_tpu.consensus.raft import InMemTransport
+        self.transport = transport or InMemTransport(seed=seed)
+        self.registry: Dict[str, Server] = {}
+        ids = [f"server{i}" for i in range(n)]
+        self.servers = [Server(i, ids, self.transport, self.registry,
+                               raft_config=raft_config, seed=seed)
+                        for i in ids]
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    # virtual-clock stepping (tests)
+    def step(self, seconds: float, dt: float = 0.01,
+             start: Optional[float] = None) -> float:
+        now = start if start is not None else getattr(self, "_vnow", 0.0)
+        end = now + seconds
+        while now < end:
+            now += dt
+            for s in self.servers:
+                s.tick(now)
+        self._vnow = now
+        return now
+
+    def wait_leader(self, max_s: float = 5.0) -> Server:
+        for _ in range(int(max_s / 0.1)):
+            self.step(0.1)
+            leaders = [s for s in self.servers if s.is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+        raise RuntimeError("no leader elected")
+
+    # wall-clock driving (live agents)
+    def start(self, tick_seconds: float = 0.01) -> None:
+        self._running = True
+
+        def loop():
+            while self._running:
+                for s in self.servers:
+                    s.tick(time.time())
+                time.sleep(tick_seconds)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    def leader(self) -> Optional[Server]:
+        leaders = [s for s in self.servers if s.is_leader()]
+        return leaders[0] if len(leaders) == 1 else None

@@ -1,0 +1,12 @@
+"""Event streaming: topic buffers + subscriptions over state commits.
+
+Reference: agent/consul/stream/event_publisher.go (EventPublisher),
+subscription.go (Subscription), wired to state-store commits via
+changeTrackerDB (agent/consul/state/memdb.go:53) and served by the gRPC
+subscribe endpoint (agent/rpc/subscribe/, proto/pbsubscribe/subscribe.proto).
+"""
+
+from consul_tpu.stream.publisher import (  # noqa: F401
+    Event, EventPublisher, SnapshotFunc, Subscription, TOPIC_HEALTH,
+    TOPIC_KV, TOPIC_CATALOG,
+)

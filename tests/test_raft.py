@@ -1,0 +1,152 @@
+"""Raft tests — in-process multi-server clusters on a virtual clock.
+
+Mirrors the reference's tier-2 test strategy (SURVEY.md §4): several real
+server instances in one process, but with deterministic virtual time
+instead of wall-clock retry loops (sdk/testutil/retry)."""
+
+import pytest
+
+from consul_tpu.consensus.raft import (
+    InMemTransport, LEADER, NotLeaderError, RaftConfig, RaftNode,
+)
+
+
+class Cluster:
+    def __init__(self, n=3, seed=0):
+        self.transport = InMemTransport(seed=seed)
+        ids = [f"s{i}" for i in range(n)]
+        self.applied = {i: [] for i in ids}
+        self.nodes = {}
+        for i in ids:
+            node = RaftNode(
+                i, ids, self.transport,
+                apply_fn=(lambda cmd, _i=i: self.applied[_i].append(cmd)
+                          or f"ok:{cmd}"),
+                snapshot_fn=(lambda _i=i: list(self.applied[_i])),
+                restore_fn=(lambda data, _i=i: self.applied.__setitem__(
+                    _i, list(data))),
+                config=RaftConfig(snapshot_threshold=50, snapshot_trailing=8),
+                seed=seed)
+            self.transport.register(node)
+            self.nodes[i] = node
+        self.now = 0.0
+
+    def step(self, seconds, dt=0.01):
+        end = self.now + seconds
+        while self.now < end:
+            self.now += dt
+            for n in self.nodes.values():
+                n.tick(self.now)
+
+    def leader(self):
+        leaders = [n for n in self.nodes.values() if n.state == LEADER]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def wait_leader(self, max_s=5.0):
+        for _ in range(int(max_s / 0.1)):
+            self.step(0.1)
+            lead = self.leader()
+            if lead is not None:
+                # require all connected nodes agree
+                return lead
+        raise AssertionError("no leader elected")
+
+
+def test_single_leader_elected():
+    c = Cluster(3)
+    lead = c.wait_leader()
+    c.step(0.5)
+    assert sum(n.state == LEADER for n in c.nodes.values()) == 1
+    for n in c.nodes.values():
+        assert n.leader_id == lead.node_id
+
+
+def test_replication_and_fsm_apply():
+    c = Cluster(3)
+    lead = c.wait_leader()
+    waits = [lead.apply(f"cmd{i}") for i in range(5)]
+    c.step(1.0)
+    for i, w in enumerate(waits):
+        assert w.event.is_set() and w.result == f"ok:cmd{i}"
+    for logs in c.applied.values():
+        assert logs == [f"cmd{i}" for i in range(5)]
+
+
+def test_apply_on_follower_raises():
+    c = Cluster(3)
+    lead = c.wait_leader()
+    c.step(0.2)                     # let heartbeats set followers' leader hint
+    follower = next(n for n in c.nodes.values() if n is not lead)
+    with pytest.raises(NotLeaderError) as ei:
+        follower.apply("x")
+    assert ei.value.leader == lead.node_id
+
+
+def test_leader_failover_and_log_convergence():
+    c = Cluster(3)
+    lead = c.wait_leader()
+    lead.apply("before")
+    c.step(1.0)
+    c.transport.isolate(lead.node_id)
+    c.step(2.0)
+    new = c.leader() or next(n for n in c.nodes.values()
+                             if n.state == LEADER and n is not lead)
+    assert new is not None and new is not lead
+    new.apply("after")
+    c.step(1.0)
+    # heal: old leader steps down and catches up
+    c.transport.heal()
+    c.step(2.0)
+    assert lead.state != LEADER
+    for logs in c.applied.values():
+        assert logs == ["before", "after"]
+
+
+def test_uncommitted_entries_on_partitioned_leader_are_discarded():
+    c = Cluster(3)
+    lead = c.wait_leader()
+    c.transport.isolate(lead.node_id)
+    c.step(0.05)
+    w = lead.apply("lost")          # can never commit: no quorum
+    c.step(2.0)
+    others = [n for n in c.nodes.values() if n is not lead]
+    new = next(n for n in others if n.state == LEADER)
+    new.apply("kept")
+    c.step(1.0)
+    c.transport.heal()
+    c.step(2.0)
+    assert w.error is not None or not w.event.is_set() or w.result is None
+    for logs in c.applied.values():
+        assert "lost" not in logs and "kept" in logs
+
+
+def test_snapshot_compaction_and_install():
+    c = Cluster(3, seed=3)
+    lead = c.wait_leader()
+    slow = next(n for n in c.nodes.values() if n is not lead)
+    c.transport.partition(lead.node_id, slow.node_id)
+    for i in range(120):            # beyond snapshot_threshold=50
+        lead.apply(f"k{i}")
+        c.step(0.02)
+    c.step(1.0)
+    assert lead.log_base > 0, "leader should have compacted its log"
+    c.transport.heal()
+    c.step(3.0)
+    assert c.applied[slow.node_id] == [f"k{i}" for i in range(120)]
+    assert slow.log_base >= lead.log_base - lead.cfg.snapshot_trailing - 1
+
+
+def test_five_node_cluster_majority_commit():
+    c = Cluster(5, seed=7)
+    lead = c.wait_leader()
+    # two followers dark: 3/5 is still quorum
+    dark = [n for n in c.nodes.values() if n is not lead][:2]
+    for d in dark:
+        c.transport.isolate(d.node_id)
+    w = lead.apply("quorum-write")
+    c.step(1.5)
+    assert w.event.is_set() and w.error is None
+    lit = [i for i, n in c.nodes.items()
+           if n not in dark and i != lead.node_id]
+    for i in lit:
+        assert "quorum-write" in c.applied[i]
