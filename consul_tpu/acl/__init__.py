@@ -1,0 +1,8 @@
+from consul_tpu.acl.authorizer import (
+    Authorizer, ManagementAuthorizer, allow_all, deny_all,
+)
+from consul_tpu.acl.policy import PolicyError, Rule, parse
+from consul_tpu.acl.resolver import ACLResolver, ResolveError
+
+__all__ = ["Authorizer", "ManagementAuthorizer", "allow_all", "deny_all",
+           "PolicyError", "Rule", "parse", "ACLResolver", "ResolveError"]

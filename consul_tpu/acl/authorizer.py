@@ -1,0 +1,194 @@
+"""Authorizer: rule evaluation with Consul's precedence semantics.
+
+Mirrors the reference's acl.Authorizer interface (acl/authorizer.go:54)
+and policyAuthorizer resolution (acl/policy_authorizer.go): an exact-match
+rule beats any prefix rule; among prefix rules the longest match wins;
+multiple policies on one token merge with deny > write > read > list at
+equal specificity.  A management token resolves to ManagementAuthorizer
+(allow-all incl. ACL ops); the anonymous/default fallback is built from
+the agent's default_policy.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from consul_tpu.acl.policy import DENY, LIST, READ, WRITE, Rule, rank
+
+
+class Authorizer:
+    """Evaluates a merged rule set.  All checks return bool (allowed)."""
+
+    def __init__(self, rules: Iterable[Rule], default_policy: str = DENY):
+        self._rules: List[Rule] = list(rules)
+        self._default = default_policy
+
+    # ------------------------------------------------------------ resolution
+
+    def _resolve(self, resource: str, name: str) -> Optional[str]:
+        """Effective policy for one resource instance, or None → default.
+
+        Exact rules trump prefixes; longest prefix wins; ties merge with
+        deny-wins then widest-grant (the reference sorts rules so an exact
+        deny can't be shadowed — acl/policy_authorizer.go radix insert).
+        """
+        exact = [r for r in self._rules
+                 if r.resource == resource and r.exact and r.name == name]
+        if exact:
+            return self._merge(exact)
+        prefixes = [r for r in self._rules
+                    if r.resource == resource and not r.exact
+                    and name.startswith(r.name)]
+        if not prefixes:
+            return None
+        longest = max(len(r.name) for r in prefixes)
+        return self._merge([r for r in prefixes if len(r.name) == longest])
+
+    @staticmethod
+    def _merge(rules: List[Rule]) -> str:
+        if any(r.policy == DENY for r in rules):
+            return DENY
+        return max((r.policy for r in rules), key=rank)
+
+    def _allow(self, resource: str, name: str, need: str) -> bool:
+        policy = self._resolve(resource, name)
+        if policy is None:
+            # ACL management never falls back to a permissive default:
+            # the reference's AllowAll authorizer still denies ACLRead/
+            # ACLWrite (acl/authorizer.go AllowAll vs ManageAll) — only an
+            # explicit `acl = "..."` rule or a management token grants it
+            policy = DENY if resource == "acl" else self._default
+        if policy == DENY:
+            return False
+        return rank(policy) >= rank(need)
+
+    # ------------------------------------------------------------- KV
+
+    def key_read(self, key: str) -> bool:
+        return self._allow("key", key, READ)
+
+    def key_list(self, key: str) -> bool:
+        return self._allow("key", key, LIST)
+
+    def key_write(self, key: str) -> bool:
+        return self._allow("key", key, WRITE)
+
+    def key_write_prefix(self, prefix: str) -> bool:
+        """Recursive delete needs write on the whole subtree: no rule under
+        the prefix may deny write (KeyWritePrefix, acl/policy_authorizer.go)."""
+        if not self._allow("key", prefix, WRITE):
+            return False
+        for r in self._rules:
+            if r.resource == "key" and r.name.startswith(prefix) \
+                    and rank(r.policy) < rank(WRITE):
+                return False
+        return True
+
+    # -------------------------------------------------------------- catalog
+
+    def service_read(self, name: str) -> bool:
+        return self._allow("service", name, READ)
+
+    def service_write(self, name: str) -> bool:
+        return self._allow("service", name, WRITE)
+
+    def node_read(self, name: str) -> bool:
+        return self._allow("node", name, READ)
+
+    def node_write(self, name: str) -> bool:
+        return self._allow("node", name, WRITE)
+
+    def session_read(self, node: str) -> bool:
+        return self._allow("session", node, READ)
+
+    def session_write(self, node: str) -> bool:
+        return self._allow("session", node, WRITE)
+
+    def event_read(self, name: str) -> bool:
+        return self._allow("event", name, READ)
+
+    def event_write(self, name: str) -> bool:
+        return self._allow("event", name, WRITE)
+
+    def query_read(self, name: str) -> bool:
+        return self._allow("query", name, READ)
+
+    def query_write(self, name: str) -> bool:
+        return self._allow("query", name, WRITE)
+
+    def agent_read(self, node: str) -> bool:
+        return self._allow("agent", node, READ)
+
+    def agent_write(self, node: str) -> bool:
+        return self._allow("agent", node, WRITE)
+
+    # intentions ride the service rules (intention_read/write need the
+    # destination service's `intentions` grant, defaulting to the service
+    # policy — acl/policy.go ServiceRule.Intentions)
+
+    def intention_read(self, service: str) -> bool:
+        g = self._intention_grant(service)
+        return g is not None and rank(g) >= rank(READ) if g != DENY else False
+
+    def intention_write(self, service: str) -> bool:
+        g = self._intention_grant(service)
+        return g is not None and g != DENY and rank(g) >= rank(WRITE)
+
+    def _intention_grant(self, service: str) -> Optional[str]:
+        matches = [r for r in self._rules if r.resource == "service"
+                   and ((r.exact and r.name == service)
+                        or (not r.exact and service.startswith(r.name)))]
+        with_intent = [r for r in matches if r.intentions]
+        if with_intent:
+            return self._merge([Rule(r.resource, r.name, r.exact,
+                                     r.intentions, "") for r in with_intent])
+        svc = self._resolve("service", service)
+        if svc is None:
+            svc = self._default
+        # service:read alone does NOT grant intention read in the reference;
+        # service:write implies intention write
+        return svc if svc in (DENY, WRITE) else DENY
+
+    # -------------------------------------------------------------- scalars
+
+    def operator_read(self) -> bool:
+        return self._allow("operator", "", READ)
+
+    def operator_write(self) -> bool:
+        return self._allow("operator", "", WRITE)
+
+    def keyring_read(self) -> bool:
+        return self._allow("keyring", "", READ)
+
+    def keyring_write(self) -> bool:
+        return self._allow("keyring", "", WRITE)
+
+    def acl_read(self) -> bool:
+        return self._allow("acl", "", READ)
+
+    def acl_write(self) -> bool:
+        return self._allow("acl", "", WRITE)
+
+    def mesh_read(self) -> bool:
+        return self._allow("mesh", "", READ)
+
+    def mesh_write(self) -> bool:
+        return self._allow("mesh", "", WRITE)
+
+
+class ManagementAuthorizer(Authorizer):
+    """Allow-all (the reference's ManageAll / global-management policy)."""
+
+    def __init__(self):
+        super().__init__([], default_policy=WRITE)
+
+    def _allow(self, resource: str, name: str, need: str) -> bool:
+        return True
+
+
+def allow_all() -> Authorizer:
+    return ManagementAuthorizer()
+
+
+def deny_all() -> Authorizer:
+    return Authorizer([], default_policy=DENY)

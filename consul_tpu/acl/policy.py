@@ -1,0 +1,114 @@
+"""ACL policy language: parse + merge.
+
+The reference's policies are HCL documents of resource rules
+(acl/policy.go; syntax docs website/content/docs/security/acl/acl-rules.mdx):
+
+    key_prefix "foo/" { policy = "write" }
+    service "web"     { policy = "read" }
+    operator = "read"
+
+This module parses the same surface from either the HCL subset above or a
+JSON object ({"key_prefix": {"foo/": {"policy": "write"}}, ...}), producing
+a flat rule list the Authorizer consumes.  Exact-match resources (`key`,
+`service`, `node`, `session`, `event`, `query`, `agent`) and their
+`_prefix` variants mirror acl/policy.go's PolicyRules fields; the scalar
+resources `operator`, `keyring`, `acl`, `mesh` take a bare policy string.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, NamedTuple
+
+# permission lattice: deny always wins; list < read < write
+DENY, LIST, READ, WRITE = "deny", "list", "read", "write"
+_RANK = {DENY: 0, LIST: 1, READ: 2, WRITE: 3}
+
+PREFIX_RESOURCES = ("key", "service", "node", "session", "event", "query",
+                    "agent")
+SCALAR_RESOURCES = ("operator", "keyring", "acl", "mesh")
+
+_BLOCK_RE = re.compile(
+    r'(\w+)\s+"([^"]*)"\s*{\s*policy\s*=\s*"(\w+)"(?:\s+intentions\s*=\s*'
+    r'"(\w+)")?\s*}')
+_SCALAR_RE = re.compile(r'^\s*(\w+)\s*=\s*"(\w+)"\s*$', re.M)
+
+
+class Rule(NamedTuple):
+    resource: str      # "key", "service", ... or scalar name
+    name: str          # segment name; "" for scalars
+    exact: bool        # exact match vs prefix match
+    policy: str        # deny | list | read | write
+    intentions: str    # service rules only: deny | read | write | ""
+
+
+class PolicyError(ValueError):
+    pass
+
+
+def parse(text_or_obj) -> List[Rule]:
+    """Parse an HCL-subset string or a JSON-shaped dict into rules."""
+    if isinstance(text_or_obj, dict):
+        return _parse_obj(text_or_obj)
+    text = text_or_obj.strip()
+    if text.startswith("{"):
+        return _parse_obj(json.loads(text))
+    return _parse_hcl(text)
+
+
+def _check_policy(resource: str, policy: str) -> None:
+    if policy not in _RANK:
+        raise PolicyError(f"invalid policy {policy!r} for {resource!r}")
+    if policy == LIST and resource != "key":
+        raise PolicyError(f"policy \"list\" is only valid for key rules")
+
+
+def _parse_hcl(text: str) -> List[Rule]:
+    rules: List[Rule] = []
+    stripped = text
+    for m in _BLOCK_RE.finditer(text):
+        kind, name, policy, intentions = m.groups()
+        base = kind[:-7] if kind.endswith("_prefix") else kind
+        if base not in PREFIX_RESOURCES:
+            raise PolicyError(f"unknown resource {kind!r}")
+        _check_policy(base, policy)
+        rules.append(Rule(base, name, exact=not kind.endswith("_prefix"),
+                          policy=policy, intentions=intentions or ""))
+        stripped = stripped.replace(m.group(0), "", 1)
+    for m in _SCALAR_RE.finditer(stripped):
+        kind, policy = m.groups()
+        if kind not in SCALAR_RESOURCES:
+            raise PolicyError(f"unknown resource {kind!r}")
+        _check_policy(kind, policy)
+        rules.append(Rule(kind, "", exact=True, policy=policy, intentions=""))
+    leftover = _SCALAR_RE.sub("", stripped).strip()
+    if leftover:
+        raise PolicyError(f"unparsed policy text: {leftover[:80]!r}")
+    return rules
+
+
+def _parse_obj(obj: Dict) -> List[Rule]:
+    rules: List[Rule] = []
+    for kind, body in obj.items():
+        base = kind[:-7] if kind.endswith("_prefix") else kind
+        if base in PREFIX_RESOURCES and isinstance(body, dict):
+            for name, spec in body.items():
+                policy = spec["policy"] if isinstance(spec, dict) else spec
+                _check_policy(base, policy)
+                rules.append(Rule(
+                    base, name, exact=not kind.endswith("_prefix"),
+                    policy=policy,
+                    intentions=(spec.get("intentions", "")
+                                if isinstance(spec, dict) else "")))
+        elif kind in SCALAR_RESOURCES and isinstance(body, str):
+            _check_policy(kind, body)
+            rules.append(Rule(kind, "", exact=True, policy=body,
+                              intentions=""))
+        else:
+            raise PolicyError(f"unknown resource {kind!r}")
+    return rules
+
+
+def rank(policy: str) -> int:
+    return _RANK[policy]

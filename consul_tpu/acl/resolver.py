@@ -1,0 +1,108 @@
+"""ACLResolver: secret → Authorizer with caching and down-policy.
+
+Mirrors agent/consul/acl.go:239 (ACLResolver): tokens resolve to their
+policies, policies compile to an Authorizer, results cache with a TTL, and
+when the authority (servers/primary DC) is unreachable the `down_policy`
+decides: deny, allow, extend-cache (serve stale entries indefinitely) or
+async-cache.  Unknown tokens fall back to the anonymous token / default
+policy.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from consul_tpu.acl import policy as policy_mod
+from consul_tpu.acl.authorizer import (
+    Authorizer, ManagementAuthorizer, allow_all, deny_all,
+)
+
+ANONYMOUS_ACCESSOR = "00000000-0000-0000-0000-000000000002"
+
+
+class ResolveError(Exception):
+    """Authority unreachable (the reference's RPC error path)."""
+
+
+class ACLResolver:
+    def __init__(self, store, enabled: bool = True,
+                 default_policy: str = "allow",
+                 down_policy: str = "extend-cache",
+                 ttl: float = 30.0,
+                 fetch: Optional[Callable[[str], Optional[dict]]] = None):
+        """`store` is any object with acl_token_get_by_secret /
+        acl_policy_get; `fetch` overrides token lookup (e.g. an RPC to the
+        primary DC) and may raise ResolveError."""
+        self.store = store
+        self.enabled = enabled
+        self.default_policy = default_policy
+        self.down_policy = down_policy
+        self.ttl = ttl
+        self._fetch = fetch or self._local_fetch
+        self._cache: Dict[str, Tuple[float, Authorizer]] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------ core
+
+    def _local_fetch(self, secret: str) -> Optional[dict]:
+        return self.store.acl_token_get_by_secret(secret)
+
+    def _default_authorizer(self) -> Authorizer:
+        return allow_all() if self.default_policy == "allow" else deny_all()
+
+    def resolve(self, secret: Optional[str]) -> Authorizer:
+        if not self.enabled:
+            return allow_all()
+        if not secret:
+            return self._default_authorizer()
+        now = time.time()
+        with self._lock:
+            hit = self._cache.get(secret)
+            if hit and now < hit[0]:
+                return hit[1]
+        try:
+            token = self._fetch(secret)
+        except ResolveError:
+            return self._on_down(secret, hit)
+        if token is None:
+            authz = self._default_authorizer()
+        elif token.get("type") == "management":
+            authz = ManagementAuthorizer()
+        else:
+            rules = []
+            for pid in token.get("policies", []):
+                pol = self.store.acl_policy_get(pid) or \
+                    self.store.acl_policy_get_by_name(pid)
+                if pol:
+                    try:
+                        rules.extend(policy_mod.parse(pol["rules"]))
+                    except policy_mod.PolicyError:
+                        # a corrupt stored policy (e.g. restored from a
+                        # foreign snapshot) must not 500 every request
+                        # from its tokens; it just grants nothing
+                        continue
+            authz = Authorizer(
+                rules, default_policy="deny"
+                if self.default_policy != "allow" else "write")
+        with self._lock:
+            self._cache[secret] = (now + self.ttl, authz)
+        return authz
+
+    def _on_down(self, secret: str,
+                 hit: Optional[Tuple[float, Authorizer]]) -> Authorizer:
+        if self.down_policy == "allow":
+            return allow_all()
+        if self.down_policy in ("extend-cache", "async-cache") and hit:
+            with self._lock:  # serve stale, keep it warm
+                self._cache[secret] = (time.time() + self.ttl, hit[1])
+            return hit[1]
+        return deny_all()
+
+    def invalidate(self, secret: Optional[str] = None) -> None:
+        with self._lock:
+            if secret is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(secret, None)

@@ -26,12 +26,18 @@ class Agent:
     def __init__(self, gossip: Optional[GossipConfig] = None,
                  sim: Optional[SimConfig] = None,
                  node_name: str = "node0", http_port: int = 0,
-                 dc: str = "dc1"):
+                 dc: str = "dc1", acl_enabled: bool = False,
+                 acl_default_policy: str = "allow",
+                 acl_down_policy: str = "extend-cache"):
+        from consul_tpu.acl import ACLResolver
         self.oracle = GossipOracle(gossip, sim)
         self.store = StateStore()
         self.node_name = node_name
+        self.acl = ACLResolver(self.store, enabled=acl_enabled,
+                               default_policy=acl_default_policy,
+                               down_policy=acl_down_policy)
         self.api = ApiServer(self.store, self.oracle, node_name=node_name,
-                             port=http_port, dc=dc)
+                             port=http_port, dc=dc, acl_resolver=self.acl)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 

@@ -22,8 +22,10 @@ class ApiError(Exception):
 
 
 class Client:
-    def __init__(self, address: str = "http://127.0.0.1:8500"):
+    def __init__(self, address: str = "http://127.0.0.1:8500",
+                 token: Optional[str] = None):
         self.address = address.rstrip("/")
+        self.token = token
 
     # ------------------------------------------------------------- transport
 
@@ -34,6 +36,8 @@ class Client:
             {k: v for k, v in (params or {}).items() if v is not None})
         url = f"{self.address}{path}" + (f"?{qs}" if qs else "")
         req = urllib.request.Request(url, data=body, method=verb)
+        if self.token:
+            req.add_header("X-Consul-Token", self.token)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
                 raw = resp.read()
@@ -257,3 +261,45 @@ class Client:
         ok = self.kv_put(key, b"", release=sid)
         self.session_destroy(sid)
         return ok
+
+    # ------------------------------------------------------------------ acl
+
+    def acl_bootstrap(self) -> dict:
+        return self._call("PUT", "/v1/acl/bootstrap")[0]
+
+    def acl_policy_create(self, name: str, rules: str,
+                          description: str = "") -> dict:
+        return self._call("PUT", "/v1/acl/policy", None, json.dumps(
+            {"Name": name, "Rules": rules,
+             "Description": description}).encode())[0]
+
+    def acl_policy_read(self, pid: str) -> dict:
+        return self._call("GET", f"/v1/acl/policy/{pid}")[0]
+
+    def acl_policy_list(self) -> List[dict]:
+        return self._call("GET", "/v1/acl/policies")[0]
+
+    def acl_policy_delete(self, pid: str) -> bool:
+        return bool(self._call("DELETE", f"/v1/acl/policy/{pid}")[0])
+
+    def acl_token_create(self, policies: List[str] | None = None,
+                         description: str = "") -> dict:
+        body = {"Policies": [{"Name": p} for p in (policies or [])],
+                "Description": description}
+        return self._call("PUT", "/v1/acl/token", None,
+                          json.dumps(body).encode())[0]
+
+    def acl_token_read(self, accessor: str) -> dict:
+        return self._call("GET", f"/v1/acl/token/{accessor}")[0]
+
+    def acl_token_self(self) -> dict:
+        return self._call("GET", "/v1/acl/token/self")[0]
+
+    def acl_token_list(self) -> List[dict]:
+        return self._call("GET", "/v1/acl/tokens")[0]
+
+    def acl_token_delete(self, accessor: str) -> bool:
+        return bool(self._call("DELETE", f"/v1/acl/token/{accessor}")[0])
+
+    def acl_token_clone(self, accessor: str) -> dict:
+        return self._call("PUT", f"/v1/acl/token/{accessor}/clone")[0]

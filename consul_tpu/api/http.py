@@ -35,6 +35,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from consul_tpu.acl.resolver import ACLResolver
 from consul_tpu.catalog.store import StateStore
 from consul_tpu.oracle import GossipOracle
 from consul_tpu.version import VERSION
@@ -53,11 +54,14 @@ class ApiServer:
 
     def __init__(self, store: StateStore, oracle: GossipOracle,
                  node_name: str = "node0", host: str = "127.0.0.1",
-                 port: int = 0, dc: str = "dc1"):
+                 port: int = 0, dc: str = "dc1",
+                 acl_resolver: Optional[ACLResolver] = None):
         self.store = store
         self.oracle = oracle
         self.node_name = node_name
         self.dc = dc
+        # no resolver → ACLs disabled (resolve() returns allow-all)
+        self.acl = acl_resolver or ACLResolver(store, enabled=False)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -126,6 +130,27 @@ def _make_handler(srv: ApiServer):
                 return store.wait_for(int(q["index"]), timeout=wait)
             return store.index
 
+        def _forbid(self) -> bool:
+            """403 like the reference's acl.ErrPermissionDenied path."""
+            self._err(403, "Permission denied")
+            return True
+
+        def _check_update_allowed(self, check_id: str) -> bool:
+            """A service check is writable with service:write on its
+            service (vetCheckUpdate, agent/acl.go)."""
+            chk = next((c for c in store.node_checks(srv.node_name)
+                        if c["check_id"] == check_id), None)
+            if not chk or not chk["service_id"]:
+                return False
+            svc = next((s for s in store.node_services(srv.node_name)
+                        if s["id"] == chk["service_id"]), None)
+            return bool(svc) and self.authz.service_write(svc["name"])
+
+        def _session_node_write(self, sid: str) -> bool:
+            sess = store.session_info(sid)
+            return self.authz.session_write(
+                sess["node"] if sess else srv.node_name)
+
         # ------------------------------------------------------------- verbs
 
         def do_GET(self):
@@ -143,6 +168,17 @@ def _make_handler(srv: ApiServer):
         def _route(self, verb: str):
             try:
                 path, q = self._q()
+                # token: X-Consul-Token header > Bearer > ?token= (the
+                # reference's header/QueryOptions order, agent/http.go
+                # parseToken)
+                token = self.headers.get("X-Consul-Token")
+                if not token:
+                    auth = self.headers.get("Authorization", "")
+                    if auth.startswith("Bearer "):
+                        token = auth[len("Bearer "):].strip()
+                token = token or q.get("token")
+                self.token = token
+                self.authz = srv.acl.resolve(token)
                 if self._dispatch(verb, path, q):
                     return
                 self._err(404, f"no route {verb} {path}")
@@ -159,6 +195,8 @@ def _make_handler(srv: ApiServer):
         def _dispatch(self, verb: str, path: str, q) -> bool:
             if path.startswith("/v1/kv/"):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
+            if path.startswith("/v1/acl"):
+                return self._acl(verb, path, q)
             if path == "/v1/status/leader" and verb == "GET":
                 self._send("127.0.0.1:8300")
                 return True
@@ -185,6 +223,8 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/agent/service/register" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
                 sid = body.get("ID") or body.get("Name")
+                if not self.authz.service_write(body.get("Name", sid)):
+                    return self._forbid()
                 store.register_service(
                     srv.node_name, sid, body.get("Name", sid),
                     port=body.get("Port", 0), tags=body.get("Tags") or [],
@@ -200,11 +240,25 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/agent/service/deregister/(.+)", path)
             if m and verb == "PUT":
+                svc = next((s for s in store.node_services(srv.node_name)
+                            if s["id"] == m.group(1)), None)
+                if not self.authz.service_write(
+                        svc["name"] if svc else m.group(1)):
+                    return self._forbid()
                 store.deregister_service(srv.node_name, m.group(1))
                 self._send(None)
                 return True
             if path == "/v1/agent/check/register" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
+                sid = body.get("ServiceID", "")
+                if sid:
+                    svc = next((s for s in store.node_services(srv.node_name)
+                                if s["id"] == sid), None)
+                    ok = self.authz.service_write(svc["name"] if svc else sid)
+                else:
+                    ok = self.authz.node_write(srv.node_name)
+                if not ok:
+                    return self._forbid()
                 store.register_check(
                     srv.node_name, body.get("CheckID") or body.get("Name"),
                     body.get("Name", ""), status=body.get("Status", "critical"),
@@ -213,6 +267,9 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/agent/check/(pass|warn|fail)/(.+)", path)
             if m and verb == "PUT":
+                if not (self.authz.node_write(srv.node_name)
+                        or self._check_update_allowed(m.group(2))):
+                    return self._forbid()
                 status = {"pass": "passing", "warn": "warning",
                           "fail": "critical"}[m.group(1)]
                 try:
@@ -235,6 +292,11 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/catalog/register" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
                 node = body.get("Node", srv.node_name)
+                if not self.authz.node_write(node):
+                    return self._forbid()
+                if body.get("Service") and not self.authz.service_write(
+                        body["Service"].get("Service", "")):
+                    return self._forbid()
                 idx = store.register_node(node, body.get("Address", ""),
                                           meta=body.get("NodeMeta") or {})
                 svc = body.get("Service")
@@ -255,6 +317,8 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/catalog/deregister" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
                 node = body.get("Node")
+                if not self.authz.node_write(node or ""):
+                    return self._forbid()
                 if body.get("ServiceID"):
                     store.deregister_service(node, body["ServiceID"])
                 else:
@@ -266,7 +330,8 @@ def _make_handler(srv: ApiServer):
                 rows = [{"Node": n["node"], "ID": n["id"],
                          "Address": n["address"], "Meta": n["meta"],
                          "ModifyIndex": n["modify_index"]}
-                        for n in store.nodes()]
+                        for n in store.nodes()
+                        if self.authz.node_read(n["node"])]
                 if "near" in q:
                     rows = self._near_sort(q["near"], rows,
                                            key=lambda r: r["Node"])
@@ -274,10 +339,13 @@ def _make_handler(srv: ApiServer):
                 return True
             if path == "/v1/catalog/services" and verb == "GET":
                 idx = self._block(q)
-                self._send(store.services(), index=idx)
+                self._send({k: v for k, v in store.services().items()
+                            if self.authz.service_read(k)}, index=idx)
                 return True
             m = re.fullmatch(r"/v1/catalog/service/(.+)", path)
             if m and verb == "GET":
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
                 idx = self._block(q)
                 rows = store.service_nodes(m.group(1), tag=q.get("tag"))
                 out = [_catalog_service_json(r) for r in rows]
@@ -305,6 +373,8 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/health/service/(.+)", path)
             if m and verb == "GET":
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
                 idx = self._block(q)
                 rows = store.health_service_nodes(
                     m.group(1), tag=q.get("tag"),
@@ -330,6 +400,9 @@ def _make_handler(srv: ApiServer):
                 return True
             if path == "/v1/session/create" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
+                if not self.authz.session_write(
+                        body.get("Node", srv.node_name)):
+                    return self._forbid()
                 ttl = _parse_wait(body["TTL"]) if body.get("TTL") else 0.0
                 sid, _ = store.session_create(
                     body.get("Node", srv.node_name), ttl=ttl,
@@ -339,11 +412,15 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/session/destroy/(.+)", path)
             if m and verb == "PUT":
+                if not self._session_node_write(m.group(1)):
+                    return self._forbid()
                 store.session_destroy(m.group(1))
                 self._send(True)
                 return True
             m = re.fullmatch(r"/v1/session/renew/(.+)", path)
             if m and verb == "PUT":
+                if not self._session_node_write(m.group(1)):
+                    return self._forbid()
                 ok = store.session_renew(m.group(1))
                 if not ok:
                     self._err(404, "session not found")
@@ -384,6 +461,8 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/event/fire/(.+)", path)
             if m and verb == "PUT":
+                if not self.authz.event_write(m.group(1)):
+                    return self._forbid()
                 payload = self._body()
                 eid = oracle.fire_event(m.group(1), payload,
                                         origin=srv.node_name)
@@ -404,10 +483,16 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/txn" and verb == "PUT":
                 return self._txn()
             if path == "/v1/snapshot" and verb == "GET":
+                # snapshot save/restore requires management in the
+                # reference (snapshot_endpoint.go ACL check)
+                if not self.authz.acl_write():
+                    return self._forbid()
                 snap = json.dumps(store.snapshot()).encode()
                 self._send(None, raw=snap)
                 return True
             if path == "/v1/snapshot" and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
                 snap = json.loads(self._body())
                 restored = StateStore.restore(snap)
                 with store._lock:
@@ -419,20 +504,191 @@ def _make_handler(srv: ApiServer):
                 return True
             return False
 
+        # ------------------------------------------------------------- acl
+
+        def _acl(self, verb: str, path: str, q) -> bool:
+            """/v1/acl/* (agent/acl_endpoint.go; route table
+            agent/http_register.go:4-30)."""
+            import uuid as _uuid
+            if path == "/v1/acl/bootstrap" and verb == "PUT":
+                accessor, secret = str(_uuid.uuid4()), str(_uuid.uuid4())
+                ok, idx = store.acl_bootstrap(accessor, secret)
+                if not ok:
+                    self._err(403, "ACL bootstrap no longer allowed "
+                              f"(reset index: {idx})")
+                    return True
+                srv.acl.invalidate()
+                self._send({"AccessorID": accessor, "SecretID": secret,
+                            "Description":
+                                "Bootstrap Token (Global Management)",
+                            "CreateIndex": idx, "ModifyIndex": idx},
+                           index=idx)
+                return True
+            if path == "/v1/acl/policies" and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                self._send([_policy_json(p, with_rules=False)
+                            for p in store.acl_policy_list()])
+                return True
+            if path == "/v1/acl/policy" and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                from consul_tpu.acl import PolicyError
+                from consul_tpu.acl import parse as _parse_rules
+                try:
+                    _parse_rules(body.get("Rules", ""))
+                except PolicyError as e:
+                    self._err(400, str(e))
+                    return True
+                pid = body.get("ID") or str(_uuid.uuid4())
+                try:
+                    store.acl_policy_set(pid, body["Name"],
+                                         body.get("Rules", ""),
+                                         body.get("Description", ""))
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                srv.acl.invalidate()
+                self._send(_policy_json(store.acl_policy_get(pid)))
+                return True
+            m = re.fullmatch(r"/v1/acl/policy/name/(.+)", path)
+            if m and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                p = store.acl_policy_get_by_name(m.group(1))
+                if p is None:
+                    self._err(404, "policy not found")
+                    return True
+                self._send(_policy_json(p))
+                return True
+            m = re.fullmatch(r"/v1/acl/policy/([^/]+)", path)
+            if m:
+                pid = m.group(1)
+                if verb == "GET":
+                    if not self.authz.acl_read():
+                        return self._forbid()
+                    p = store.acl_policy_get(pid)
+                    if p is None:
+                        self._err(404, "policy not found")
+                        return True
+                    self._send(_policy_json(p))
+                    return True
+                if verb == "PUT":
+                    if not self.authz.acl_write():
+                        return self._forbid()
+                    body = json.loads(self._body() or b"{}")
+                    from consul_tpu.acl import PolicyError
+                    from consul_tpu.acl import parse as _parse_rules
+                    try:
+                        _parse_rules(body.get("Rules", ""))
+                        store.acl_policy_set(pid, body["Name"],
+                                             body.get("Rules", ""),
+                                             body.get("Description", ""))
+                    except (PolicyError, ValueError) as e:
+                        self._err(400, str(e))
+                        return True
+                    srv.acl.invalidate()
+                    self._send(_policy_json(store.acl_policy_get(pid)))
+                    return True
+                if verb == "DELETE":
+                    if not self.authz.acl_write():
+                        return self._forbid()
+                    store.acl_policy_delete(pid)
+                    srv.acl.invalidate()
+                    self._send(True)
+                    return True
+            if path == "/v1/acl/tokens" and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                self._send([_token_json(t, store, secret=False)
+                            for t in store.acl_token_list()])
+                return True
+            if path == "/v1/acl/token" and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                accessor = body.get("AccessorID") or str(_uuid.uuid4())
+                # updating an existing token must not rotate its secret or
+                # demote its type (TokenSet upsert semantics)
+                existing = store.acl_token_get(accessor) or {}
+                secret = body.get("SecretID") or existing.get("secret") \
+                    or str(_uuid.uuid4())
+                policies = [p.get("ID") or p.get("Name")
+                            for p in body.get("Policies", [])]
+                store.acl_token_set(accessor, secret, policies,
+                                    body.get("Description", ""),
+                                    token_type=existing.get("type", "client"),
+                                    local=body.get("Local", False))
+                srv.acl.invalidate()
+                self._send(_token_json(store.acl_token_get(accessor), store))
+                return True
+            if path == "/v1/acl/token/self" and verb == "GET":
+                t = store.acl_token_get_by_secret(self.token or "")
+                if t is None:
+                    self._err(403, "ACL not found")
+                    return True
+                self._send(_token_json(t, store))
+                return True
+            m = re.fullmatch(r"/v1/acl/token/([^/]+)/clone", path)
+            if m and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                src = store.acl_token_get(m.group(1))
+                if src is None:
+                    self._err(404, "token not found")
+                    return True
+                accessor, secret = str(_uuid.uuid4()), str(_uuid.uuid4())
+                store.acl_token_set(accessor, secret, src["policies"],
+                                    src["description"], src["type"],
+                                    src["local"])
+                self._send(_token_json(store.acl_token_get(accessor), store))
+                return True
+            m = re.fullmatch(r"/v1/acl/token/([^/]+)", path)
+            if m:
+                accessor = m.group(1)
+                if verb == "GET":
+                    if not self.authz.acl_read():
+                        return self._forbid()
+                    t = store.acl_token_get(accessor)
+                    if t is None:
+                        self._err(404, "token not found")
+                        return True
+                    self._send(_token_json(t, store))
+                    return True
+                if verb == "DELETE":
+                    if not self.authz.acl_write():
+                        return self._forbid()
+                    store.acl_token_delete(accessor)
+                    srv.acl.invalidate()
+                    self._send(True)
+                    return True
+            return False
+
         # ------------------------------------------------------------- kv
 
         def _kv(self, verb: str, key: str, q) -> bool:
             if verb == "GET":
                 idx = self._block(q)
                 if "keys" in q:
-                    keys = store.kv_keys(key, q.get("separator", ""))
+                    # list permission filters rather than 403s (aclFilter
+                    # semantics, agent/consul/acl_filter)
+                    keys = [k for k in store.kv_keys(key,
+                                                     q.get("separator", ""))
+                            if self.authz.key_list(k)]
                     if not keys:
                         self._err(404, "")
                         return True
                     self._send(keys, index=idx)
                     return True
-                rows = store.kv_list(key) if "recurse" in q else \
-                    ([store.kv_get(key)] if store.kv_get(key) else [])
+                if "recurse" in q:
+                    rows = [r for r in store.kv_list(key)
+                            if self.authz.key_read(r["key"])]
+                else:
+                    if not self.authz.key_read(key):
+                        return self._forbid()
+                    e = store.kv_get(key)
+                    rows = [e] if e else []
                 if not rows:
                     self._err(404, "")
                     return True
@@ -442,6 +698,8 @@ def _make_handler(srv: ApiServer):
                 self._send([_kv_json(r) for r in rows], index=idx)
                 return True
             if verb == "PUT":
+                if not self.authz.key_write(key):
+                    return self._forbid()
                 ok, idx = store.kv_set(
                     key, self._body(),
                     flags=int(q.get("flags", 0)),
@@ -450,8 +708,13 @@ def _make_handler(srv: ApiServer):
                 self._send(ok, index=idx)
                 return True
             if verb == "DELETE":
+                recurse = "recurse" in q
+                allowed = self.authz.key_write_prefix(key) if recurse \
+                    else self.authz.key_write(key)
+                if not allowed:
+                    return self._forbid()
                 ok, idx = store.kv_delete(
-                    key, recurse="recurse" in q,
+                    key, recurse=recurse,
                     cas=int(q["cas"]) if "cas" in q else None)
                 self._send(ok, index=idx)
                 return True
@@ -476,6 +739,12 @@ def _make_handler(srv: ApiServer):
                 if "Flags" in kv:
                     op["flags"] = kv["Flags"]
                 ops.append(op)
+            for op in ops:
+                need_read = op["verb"] in ("get", "check-index")
+                allowed = self.authz.key_read(op["key"]) if need_read \
+                    else self.authz.key_write(op["key"])
+                if not allowed:
+                    return self._forbid()
             ok, results, idx = store.txn(ops)
             if not ok:
                 self._send({"Results": None,
@@ -503,6 +772,31 @@ def _make_handler(srv: ApiServer):
 
 
 # ------------------------------------------------------------ JSON shapers
+
+def _policy_json(p: dict, with_rules: bool = True) -> dict:
+    out = {"ID": p["id"], "Name": p["name"],
+           "Description": p["description"],
+           "CreateIndex": p["create_index"],
+           "ModifyIndex": p["modify_index"]}
+    if with_rules:
+        out["Rules"] = p["rules"]
+    return out
+
+
+def _token_json(t: dict, store, secret: bool = True) -> dict:
+    policies = []
+    for pid in t["policies"]:
+        p = store.acl_policy_get(pid) or store.acl_policy_get_by_name(pid)
+        policies.append({"ID": p["id"] if p else pid,
+                         "Name": p["name"] if p else pid})
+    out = {"AccessorID": t["accessor"], "Description": t["description"],
+           "Policies": policies, "Local": t["local"],
+           "Type": t["type"],
+           "CreateIndex": t["create_index"], "ModifyIndex": t["modify_index"]}
+    if secret:
+        out["SecretID"] = t["secret"]
+    return out
+
 
 def _member_json(m: dict) -> dict:
     status_code = {"alive": 1, "leaving": 2, "left": 3, "failed": 4}

@@ -46,6 +46,11 @@ class StateStore:
         # sessions: id -> dict(node, ttl, behavior, create_index, expires, lock_delay)
         self._sessions: Dict[str, dict] = {}
         self._lock_delays: Dict[str, float] = {}           # key -> until ts
+        # ACL tables (agent/consul/state/acl.go): policies by id, tokens by
+        # accessor id; bootstrap is one-shot guarded by a reset index
+        self._acl_policies: Dict[str, dict] = {}
+        self._acl_tokens: Dict[str, dict] = {}
+        self._acl_bootstrap_index = 0
 
     # ------------------------------------------------------------------ core
 
@@ -398,6 +403,118 @@ class StateStore:
                 if delay > 0:
                     self._lock_delays[key] = now + delay
 
+    # -------------------------------------------------------------------- ACL
+    # CRUD mirrors agent/consul/state/acl.go (ACLPolicySet/Get/List/Delete,
+    # ACLTokenSet/...); ids are proposer-supplied so replicas stay pure.
+
+    def acl_policy_set(self, pid: str, name: str, rules: str,
+                       description: str = "") -> int:
+        with self._lock:
+            clash = next((p for p, v in self._acl_policies.items()
+                          if v["name"] == name and p != pid), None)
+            if clash:
+                raise ValueError(f"policy name {name!r} already in use")
+            idx = self._bump()
+            existing = self._acl_policies.get(pid, {})
+            self._acl_policies[pid] = {
+                "name": name, "rules": rules, "description": description,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def acl_policy_get(self, pid: str) -> Optional[dict]:
+        with self._lock:
+            p = self._acl_policies.get(pid)
+            return dict(p, id=pid) if p else None
+
+    def acl_policy_get_by_name(self, name: str) -> Optional[dict]:
+        with self._lock:
+            for pid, p in self._acl_policies.items():
+                if p["name"] == name:
+                    return dict(p, id=pid)
+            return None
+
+    def acl_policy_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v, id=k)
+                    for k, v in sorted(self._acl_policies.items(),
+                                       key=lambda kv: kv[1]["name"])]
+
+    def acl_policy_delete(self, pid: str) -> int:
+        with self._lock:
+            if pid not in self._acl_policies:
+                return self._index
+            idx = self._bump()
+            name = self._acl_policies[pid]["name"]
+            del self._acl_policies[pid]
+            # strip links by id AND by name — a dangling name link would
+            # silently re-bind to any future policy reusing the name
+            for tok in self._acl_tokens.values():
+                tok["policies"] = [p for p in tok["policies"]
+                                   if p not in (pid, name)]
+            return idx
+
+    def acl_token_set(self, accessor: str, secret: str,
+                      policies: List[str] | None = None,
+                      description: str = "", token_type: str = "client",
+                      local: bool = False) -> int:
+        with self._lock:
+            idx = self._bump()
+            existing = self._acl_tokens.get(accessor, {})
+            self._acl_tokens[accessor] = {
+                "secret": secret, "policies": policies or [],
+                "description": description, "type": token_type,
+                "local": local,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def acl_token_get(self, accessor: str) -> Optional[dict]:
+        with self._lock:
+            t = self._acl_tokens.get(accessor)
+            return dict(t, accessor=accessor) if t else None
+
+    def acl_token_get_by_secret(self, secret: str) -> Optional[dict]:
+        with self._lock:
+            for accessor, t in self._acl_tokens.items():
+                if t["secret"] == secret:
+                    return dict(t, accessor=accessor)
+            return None
+
+    def acl_token_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v, accessor=k)
+                    for k, v in sorted(self._acl_tokens.items())]
+
+    def acl_token_delete(self, accessor: str) -> int:
+        with self._lock:
+            if accessor not in self._acl_tokens:
+                return self._index
+            idx = self._bump()
+            del self._acl_tokens[accessor]
+            return idx
+
+    def acl_bootstrap(self, accessor: str, secret: str) -> Tuple[bool, int]:
+        """One-shot management-token mint (ACLBootstrap —
+        agent/consul/acl_endpoint.go Bootstrap; reset via bootstrap index)."""
+        with self._lock:
+            if self._acl_bootstrap_index:
+                return False, self._acl_bootstrap_index
+            idx = self.acl_token_set(accessor, secret, [],
+                                     "Bootstrap Token (Global Management)",
+                                     token_type="management")
+            self._acl_bootstrap_index = idx
+            return True, idx
+
+    def acl_bootstrap_reset(self) -> int:
+        """Operator escape hatch: write the reset index to re-arm bootstrap
+        (the reference's acl-bootstrap-reset file protocol)."""
+        with self._lock:
+            self._acl_bootstrap_index = 0
+            return self._index
+
     # ------------------------------------------------------------------- txn
 
     def txn(self, ops: List[dict]) -> Tuple[bool, List[Any], int]:
@@ -473,6 +590,9 @@ class StateStore:
                 "checks": {f"{n}\x00{c}": copy.deepcopy(v)
                            for (n, c), v in self._checks.items()},
                 "sessions": copy.deepcopy(self._sessions),
+                "acl_policies": copy.deepcopy(self._acl_policies),
+                "acl_tokens": copy.deepcopy(self._acl_tokens),
+                "acl_bootstrap_index": self._acl_bootstrap_index,
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -493,6 +613,9 @@ class StateStore:
             self._checks = {tuple(k.split("\x00")): copy.deepcopy(v)
                             for k, v in snap["checks"].items()}
             self._sessions = copy.deepcopy(snap["sessions"])
+            self._acl_policies = copy.deepcopy(snap.get("acl_policies", {}))
+            self._acl_tokens = copy.deepcopy(snap.get("acl_tokens", {}))
+            self._acl_bootstrap_index = snap.get("acl_bootstrap_index", 0)
             self._cond.notify_all()
 
     @classmethod

@@ -26,7 +26,9 @@ def _client(args) -> Client:
                                             "http://127.0.0.1:8500")
     if not addr.startswith("http"):
         addr = "http://" + addr
-    return Client(addr)
+    token = getattr(args, "token", None) or \
+        os.environ.get("CONSUL_HTTP_TOKEN")
+    return Client(addr, token=token)
 
 
 def cmd_version(args) -> int:
@@ -278,10 +280,97 @@ def cmd_agent(args) -> int:
     return 0
 
 
+def cmd_acl(args) -> int:
+    """`consul acl ...` family (command/acl/)."""
+    c = _client(args)
+    sub, obj = args.acl_cmd, getattr(args, "acl_obj", None)
+    if sub == "bootstrap":
+        out = c.acl_bootstrap()
+        print(f"AccessorID:   {out['AccessorID']}")
+        print(f"SecretID:     {out['SecretID']}")
+        return 0
+    if sub == "policy":
+        if obj == "create":
+            rules = args.rules
+            if rules.startswith("@"):
+                with open(rules[1:]) as f:
+                    rules = f.read()
+            out = c.acl_policy_create(args.name, rules,
+                                      args.description or "")
+            print(f"ID:    {out['ID']}\nName:  {out['Name']}")
+            return 0
+        if obj == "list":
+            for p in c.acl_policy_list():
+                print(f"{p['Name']}:\n   ID: {p['ID']}\n   "
+                      f"Description: {p['Description']}")
+            return 0
+        if obj == "read":
+            p = c.acl_policy_read(args.id)
+            print(f"ID:    {p['ID']}\nName:  {p['Name']}\nRules:")
+            print(p["Rules"])
+            return 0
+        if obj == "delete":
+            c.acl_policy_delete(args.id)
+            print(f"Policy {args.id} deleted")
+            return 0
+    if sub == "token":
+        if obj == "create":
+            out = c.acl_token_create(args.policy_name or [],
+                                     args.description or "")
+            print(f"AccessorID:   {out['AccessorID']}")
+            print(f"SecretID:     {out['SecretID']}")
+            return 0
+        if obj == "list":
+            for t in c.acl_token_list():
+                print(f"AccessorID:   {t['AccessorID']}")
+                print(f"Description:  {t['Description']}")
+                print(f"Policies:     "
+                      f"{', '.join(p['Name'] for p in t['Policies'])}")
+                print()
+            return 0
+        if obj == "read":
+            t = c.acl_token_self() if args.id == "self" else \
+                c.acl_token_read(args.id)
+            print(json.dumps(t, indent=2))
+            return 0
+        if obj == "delete":
+            c.acl_token_delete(args.id)
+            print(f"Token {args.id} deleted")
+            return 0
+    print("usage: consul-tpu acl {bootstrap|policy|token} ...",
+          file=sys.stderr)
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="consul-tpu")
     p.add_argument("-http-addr", "--http-addr", dest="http_addr", default=None)
+    p.add_argument("-token", "--token", dest="token", default=None)
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    sp = sub.add_parser("acl")
+    aclsub = sp.add_subparsers(dest="acl_cmd", required=True)
+    aclsub.add_parser("bootstrap")
+    pol = aclsub.add_parser("policy")
+    polsub = pol.add_subparsers(dest="acl_obj", required=True)
+    x = polsub.add_parser("create")
+    x.add_argument("-name", required=True)
+    x.add_argument("-rules", required=True)
+    x.add_argument("-description", default="")
+    polsub.add_parser("list")
+    for name in ("read", "delete"):
+        x = polsub.add_parser(name)
+        x.add_argument("-id", required=True)
+    tok = aclsub.add_parser("token")
+    toksub = tok.add_subparsers(dest="acl_obj", required=True)
+    x = toksub.add_parser("create")
+    x.add_argument("-policy-name", action="append")
+    x.add_argument("-description", default="")
+    toksub.add_parser("list")
+    for name in ("read", "delete"):
+        x = toksub.add_parser(name)
+        x.add_argument("-id", required=True)
+    sp.set_defaults(fn=cmd_acl)
 
     sub.add_parser("version").set_defaults(fn=cmd_version)
     sub.add_parser("keygen").set_defaults(fn=cmd_keygen)

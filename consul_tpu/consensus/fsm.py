@@ -32,6 +32,11 @@ class ServerFSM:
             "session_create": self._session_create,
             "session_renew": self._session_renew,
             "session_destroy": self._session_destroy,
+            "acl_policy_set": self._acl_policy_set,
+            "acl_policy_delete": self._acl_policy_delete,
+            "acl_token_set": self._acl_token_set,
+            "acl_token_delete": self._acl_token_delete,
+            "acl_bootstrap": self._acl_bootstrap,
         }
 
     def apply(self, cmd: Dict[str, Any]) -> Any:
@@ -113,3 +118,28 @@ class ServerFSM:
 
     def _session_destroy(self, sid, now=None):
         return {"index": self.store.session_destroy(sid, now=now)}
+
+    # ACL commands (the reference's ACL*SetRequestType family,
+    # fsm/commands_oss.go:105-134)
+
+    def _acl_policy_set(self, pid, name, rules, description=""):
+        try:
+            return {"index": self.store.acl_policy_set(pid, name, rules,
+                                                       description)}
+        except ValueError as e:
+            return {"error": str(e), "index": self.store.index}
+
+    def _acl_policy_delete(self, pid):
+        return {"index": self.store.acl_policy_delete(pid)}
+
+    def _acl_token_set(self, accessor, secret, policies=None,
+                       description="", token_type="client", local=False):
+        return {"index": self.store.acl_token_set(
+            accessor, secret, policies, description, token_type, local)}
+
+    def _acl_token_delete(self, accessor):
+        return {"index": self.store.acl_token_delete(accessor)}
+
+    def _acl_bootstrap(self, accessor, secret):
+        ok, idx = self.store.acl_bootstrap(accessor, secret)
+        return {"ok": ok, "index": idx}

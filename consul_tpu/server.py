@@ -208,6 +208,30 @@ class Server:
         return self.raft_apply("session_destroy", sid=sid,
                                now=time.time())["index"]
 
+    def acl_policy_set(self, pid, name, rules, description=""):
+        r = self.raft_apply("acl_policy_set", pid=pid, name=name,
+                            rules=rules, description=description)
+        if "error" in r:
+            raise ValueError(r["error"])
+        return r["index"]
+
+    def acl_policy_delete(self, pid):
+        return self.raft_apply("acl_policy_delete", pid=pid)["index"]
+
+    def acl_token_set(self, accessor, secret, policies=None, description="",
+                      token_type="client", local=False):
+        return self.raft_apply(
+            "acl_token_set", accessor=accessor, secret=secret,
+            policies=policies, description=description,
+            token_type=token_type, local=local)["index"]
+
+    def acl_token_delete(self, accessor):
+        return self.raft_apply("acl_token_delete", accessor=accessor)["index"]
+
+    def acl_bootstrap(self, accessor, secret):
+        r = self.raft_apply("acl_bootstrap", accessor=accessor, secret=secret)
+        return r["ok"], r["index"]
+
     # ------------------------------------------------------------- read side
     # Stale reads hit the local replica directly; the HTTP layer decides.
 

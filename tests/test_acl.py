@@ -1,0 +1,275 @@
+"""ACL system tests: policy parsing, authorizer precedence, replicated
+token/policy storage, HTTP enforcement (the reference's acl/ package tests
+and agent/consul/acl_endpoint_test.go patterns)."""
+
+import json
+
+import pytest
+
+from consul_tpu.acl import (
+    ACLResolver, Authorizer, PolicyError, allow_all, deny_all, parse,
+)
+from consul_tpu.acl.resolver import ResolveError
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+
+HCL = '''
+key_prefix "" { policy = "deny" }
+key_prefix "app/" { policy = "write" }
+key "app/secret" { policy = "read" }
+service_prefix "" { policy = "read" }
+service "admin" { policy = "deny" }
+node_prefix "" { policy = "read" }
+operator = "read"
+'''
+
+
+# ----------------------------------------------------------------- policy
+
+def test_parse_hcl():
+    rules = parse(HCL)
+    kinds = {(r.resource, r.name, r.exact) for r in rules}
+    assert ("key", "app/", False) in kinds
+    assert ("key", "app/secret", True) in kinds
+    assert ("operator", "", True) in kinds
+
+
+def test_parse_json():
+    rules = parse({"key_prefix": {"foo/": {"policy": "write"}},
+                   "operator": "read"})
+    assert len(rules) == 2
+
+
+def test_parse_rejects_unknown_resource():
+    with pytest.raises(PolicyError):
+        parse('frobnicate "x" { policy = "read" }')
+    with pytest.raises(PolicyError):
+        parse('key "x" { policy = "banana" }')
+    with pytest.raises(PolicyError):
+        parse('service "x" { policy = "list" }')  # list is key-only
+
+
+# ------------------------------------------------------------- authorizer
+
+def test_precedence_exact_beats_prefix():
+    a = Authorizer(parse(HCL), default_policy="deny")
+    assert a.key_write("app/data")          # app/ prefix write
+    assert not a.key_write("app/secret")    # exact read overrides
+    assert a.key_read("app/secret")
+    assert not a.key_read("other/thing")    # "" prefix deny
+    assert a.service_read("web")
+    assert not a.service_read("admin")      # exact deny
+    assert a.operator_read() and not a.operator_write()
+
+
+def test_longest_prefix_wins():
+    a = Authorizer(parse('key_prefix "a/" { policy = "deny" }\n'
+                         'key_prefix "a/b/" { policy = "write" }'),
+                   default_policy="deny")
+    assert a.key_write("a/b/c")
+    assert not a.key_read("a/x")
+
+
+def test_key_write_prefix_denied_by_inner_rule():
+    a = Authorizer(parse('key_prefix "" { policy = "write" }\n'
+                         'key "keep/me" { policy = "read" }'),
+                   default_policy="deny")
+    assert a.key_write("anything")
+    assert not a.key_write_prefix("keep/")   # subtree contains a non-write
+
+def test_service_write_implies_intention_write():
+    a = Authorizer(parse('service "web" { policy = "write" }'),
+                   default_policy="deny")
+    assert a.intention_write("web")
+    b = Authorizer(parse('service "web" { policy = "read" }'),
+                   default_policy="deny")
+    assert not b.intention_read("web")  # read alone grants no intentions
+
+
+def test_default_policies():
+    assert allow_all().key_write("x")
+    assert not deny_all().key_read("x")
+
+
+# ---------------------------------------------------------- store + resolver
+
+def test_store_acl_crud_and_bootstrap():
+    st = StateStore()
+    ok, idx = st.acl_bootstrap("acc1", "sec1")
+    assert ok
+    ok2, idx2 = st.acl_bootstrap("acc2", "sec2")
+    assert not ok2 and idx2 == idx           # one-shot
+    st.acl_bootstrap_reset()
+    ok3, _ = st.acl_bootstrap("acc3", "sec3")
+    assert ok3
+
+    st.acl_policy_set("p1", "readonly", 'key_prefix "" { policy = "read" }')
+    with pytest.raises(ValueError):          # name uniqueness
+        st.acl_policy_set("p2", "readonly", "")
+    st.acl_token_set("t1", "secret-1", ["p1"])
+    assert st.acl_token_get_by_secret("secret-1")["policies"] == ["p1"]
+    st.acl_policy_delete("p1")
+    assert st.acl_token_get("t1")["policies"] == []  # cascade unlink
+
+
+def test_resolver_caching_and_down_policy():
+    st = StateStore()
+    st.acl_policy_set("p1", "kv-read", 'key_prefix "" { policy = "read" }')
+    st.acl_token_set("t1", "sek", ["p1"])
+
+    calls = []
+
+    def fetch(secret):
+        if len(calls) >= 1 and fetch.down:
+            raise ResolveError("servers unreachable")
+        calls.append(secret)
+        return st.acl_token_get_by_secret(secret)
+
+    fetch.down = False
+    r = ACLResolver(st, default_policy="deny", ttl=0.0, fetch=fetch)
+    a1 = r.resolve("sek")
+    assert a1.key_read("x") and not a1.key_write("x")
+    # authority down + ttl expired → extend-cache serves the stale entry
+    fetch.down = True
+    a2 = r.resolve("sek")
+    assert a2.key_read("x")
+    # down policy deny drops it
+    r2 = ACLResolver(st, default_policy="deny", down_policy="deny",
+                     ttl=0.0, fetch=fetch)
+    assert not r2.resolve("sek").key_read("x")
+    # unknown token → default policy
+    fetch.down = False
+    assert not r.resolve("nope").key_read("x")
+    # disabled resolver allows everything
+    assert ACLResolver(st, enabled=False).resolve(None).acl_write()
+
+
+def test_management_token_resolves_allow_all():
+    st = StateStore()
+    st.acl_bootstrap("acc", "root-secret")
+    r = ACLResolver(st, default_policy="deny")
+    assert r.resolve("root-secret").acl_write()
+    assert not r.resolve(None).key_read("x")
+
+
+# -------------------------------------------------------------- HTTP e2e
+
+@pytest.fixture(scope="module")
+def acl_agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=3),
+              acl_enabled=True, acl_default_policy="deny")
+    a.start(tick_seconds=0.0, reconcile_interval=0.2)
+    yield a
+    a.stop()
+
+
+def test_http_acl_flow(acl_agent):
+    anon = Client(acl_agent.http_address)
+    # anonymous under default deny: no KV
+    with pytest.raises(ApiError) as e:
+        anon.kv_put("app/x", b"1")
+    assert e.value.code == 403
+
+    boot = anon.acl_bootstrap()
+    root = Client(acl_agent.http_address, token=boot["SecretID"])
+    assert root.kv_put("app/x", b"1")
+
+    # second bootstrap forbidden
+    with pytest.raises(ApiError) as e:
+        anon.acl_bootstrap()
+    assert e.value.code == 403
+
+    pol = root.acl_policy_create(
+        "app-rw", 'key_prefix "app/" { policy = "write" }\n'
+                  'service_prefix "" { policy = "read" }')
+    tok = root.acl_token_create(policies=["app-rw"], description="app")
+    app = Client(acl_agent.http_address, token=tok["SecretID"])
+
+    assert app.kv_put("app/y", b"2")
+    row, _ = app.kv_get("app/y")
+    assert row["Value"] == b"2"
+    with pytest.raises(ApiError) as e:
+        app.kv_put("other/z", b"3")
+    assert e.value.code == 403
+    # non-management token can't touch ACL endpoints
+    with pytest.raises(ApiError):
+        app.acl_token_list()
+    # token/self works with its own token
+    assert app.acl_token_self()["AccessorID"] == tok["AccessorID"]
+
+    # policy listing via root includes ours
+    names = {p["Name"] for p in root.acl_policy_list()}
+    assert "app-rw" in names
+
+    # invalid rules rejected at create
+    with pytest.raises(ApiError) as e:
+        root.acl_policy_create("bad", 'nope "x" { policy = "read" }')
+    assert e.value.code == 400
+
+    # token deletion revokes access
+    root.acl_token_delete(tok["AccessorID"])
+    with pytest.raises(ApiError) as e:
+        app.kv_put("app/y", b"9")
+    assert e.value.code == 403
+
+
+def test_http_catalog_filtering(acl_agent):
+    anon = Client(acl_agent.http_address)
+    boot = Client(acl_agent.http_address).acl_token_self \
+        if False else None  # noqa — keep flake quiet
+    # root lists services; anonymous (deny) sees an empty map
+    toks = acl_agent.store.acl_token_list()
+    root_secret = next(t["secret"] for t in toks
+                       if t["type"] == "management")
+    root = Client(acl_agent.http_address, token=root_secret)
+    root.agent_service_register("web", port=80)
+    assert "web" in root.catalog_services()
+    assert anon.catalog_services() == {}
+    with pytest.raises(ApiError) as e:
+        anon.catalog_service("web")
+    assert e.value.code == 403
+
+
+def test_default_allow_still_denies_acl_management():
+    # reference AllowAll denies ACLRead/Write; only management grants it
+    assert not allow_all().__class__ or True
+    from consul_tpu.acl.authorizer import Authorizer
+    a = Authorizer([], default_policy="write")
+    assert a.key_write("x") and a.operator_write()
+    assert not a.acl_read() and not a.acl_write()
+
+
+def test_txn_and_session_enforcement(acl_agent):
+    anon = Client(acl_agent.http_address)
+    toks = acl_agent.store.acl_token_list()
+    root_secret = next(t["secret"] for t in toks
+                       if t["type"] == "management")
+    root = Client(acl_agent.http_address, token=root_secret)
+    # txn bypass closed: anonymous txn set is 403
+    with pytest.raises(ApiError) as e:
+        anon.txn([{"KV": {"Verb": "set", "Key": "sneak", "Value": "eA=="}}])
+    assert e.value.code == 403
+    # session destroy of someone else's session is 403 for anonymous
+    sid = root.session_create(ttl="60s")
+    with pytest.raises(ApiError) as e:
+        anon._call("PUT", f"/v1/session/destroy/{sid}")
+    assert e.value.code == 403
+    assert root.session_destroy(sid)
+
+
+def test_token_update_preserves_secret_and_type(acl_agent):
+    toks = acl_agent.store.acl_token_list()
+    mgmt = next(t for t in toks if t["type"] == "management")
+    root = Client(acl_agent.http_address, token=mgmt["secret"])
+    out = root._call("PUT", "/v1/acl/token", None, json.dumps(
+        {"AccessorID": mgmt["accessor"],
+         "Description": "renamed"}).encode())[0]
+    kept = acl_agent.store.acl_token_get(mgmt["accessor"])
+    assert kept["secret"] == mgmt["secret"]
+    assert kept["type"] == "management"
+    assert kept["description"] == "renamed"
+    # the management secret still resolves as management
+    assert root.kv_put("app/after-update", b"1")
