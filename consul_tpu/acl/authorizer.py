@@ -140,8 +140,16 @@ class Authorizer:
                         or (not r.exact and service.startswith(r.name)))]
         with_intent = [r for r in matches if r.intentions]
         if with_intent:
+            # same precedence as _resolve: exact beats prefix, longest
+            # prefix wins; merge only rules at the winning specificity
+            exact = [r for r in with_intent if r.exact]
+            if exact:
+                pick = exact
+            else:
+                longest = max(len(r.name) for r in with_intent)
+                pick = [r for r in with_intent if len(r.name) == longest]
             return self._merge([Rule(r.resource, r.name, r.exact,
-                                     r.intentions, "") for r in with_intent])
+                                     r.intentions, "") for r in pick])
         svc = self._resolve("service", service)
         if svc is None:
             svc = self._default
@@ -187,7 +195,10 @@ class ManagementAuthorizer(Authorizer):
 
 
 def allow_all() -> Authorizer:
-    return ManagementAuthorizer()
+    """Permissive default (the reference's AllowAll): everything except
+    ACL management, which stays deny without an explicit rule or a
+    management token."""
+    return Authorizer([], default_policy=WRITE)
 
 
 def deny_all() -> Authorizer:

@@ -54,7 +54,8 @@ class ACLResolver:
 
     def resolve(self, secret: Optional[str]) -> Authorizer:
         if not self.enabled:
-            return allow_all()
+            # ACLs off: nothing is enforced, including ACL endpoints
+            return ManagementAuthorizer()
         if not secret:
             return self._default_authorizer()
         now = time.time()

@@ -1,0 +1,113 @@
+"""Anti-entropy StateSyncer: paced full + triggered partial sync.
+
+The reference's agent/ae/ae.go:54 StateSyncer drives local.State syncs:
+a full sync every SyncFull interval scaled by cluster size
+(scaleFactor :35 — log2(N/128)+1 above 128 nodes) with ±stagger, and a
+partial SyncChanges whenever a local mutation fires the trigger channel,
+debounced and retried on failure (retryFailInterval).  Same machine here
+with a condition-variable trigger instead of a channel.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+from typing import Callable, Optional
+
+SCALE_THRESHOLD = 128          # ae.go:27 scaleThreshold
+DEFAULT_SYNC_INTERVAL = 60.0   # config SyncFrequency equivalent
+RETRY_FAIL_INTERVAL = 15.0     # ae.go retryFailInterval
+
+
+def scale_factor(nodes: int) -> int:
+    """ae.go:35 scaleFactor: 1 below the threshold, then log2 growth so a
+    100k-node cluster syncs ~10x less often per node."""
+    if nodes <= SCALE_THRESHOLD:
+        return 1
+    return int(math.ceil(math.log2(nodes) - math.log2(SCALE_THRESHOLD))) + 1
+
+
+class StateSyncer:
+    def __init__(self, local_state, catalog,
+                 interval: float = DEFAULT_SYNC_INTERVAL,
+                 cluster_size: Callable[[], int] = lambda: 1,
+                 retry_fail_interval: float = RETRY_FAIL_INTERVAL,
+                 jitter: float = 0.1):
+        self.local = local_state
+        self.catalog = catalog
+        self.interval = interval
+        self.cluster_size = cluster_size
+        self.retry_fail_interval = retry_fail_interval
+        self.jitter = jitter
+        self._cond = threading.Condition()
+        self._triggered = False
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.syncs_full = 0
+        self.syncs_partial = 0
+        self.failures = 0
+
+    # ---------------------------------------------------------------- pacing
+
+    def full_interval(self) -> float:
+        """Interval scaled by cluster size with ±jitter stagger
+        (ae.go:155 Run → staggerFn)."""
+        base = self.interval * scale_factor(self.cluster_size())
+        return base * (1.0 + random.uniform(-self.jitter, self.jitter))
+
+    # --------------------------------------------------------------- trigger
+
+    def trigger(self) -> None:
+        """Edge-trigger a partial sync (ae/trigger.go SyncChanges.Trigger)."""
+        with self._cond:
+            self._triggered = True
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    def sync_full_now(self) -> int:
+        """One blocking full pass (Agent.StartSync's initial sync)."""
+        n = self.local.sync_full(self.catalog)
+        self.syncs_full += 1
+        return n
+
+    # ------------------------------------------------------------------ loop
+
+    def _run(self) -> None:
+        import time
+        next_full = time.time() + self.full_interval()
+        while True:
+            with self._cond:
+                if not self._triggered and self._running:
+                    self._cond.wait(
+                        timeout=max(0.0, next_full - time.time()))
+                if not self._running:
+                    return
+                triggered = self._triggered
+                self._triggered = False
+            now = time.time()
+            try:
+                if now >= next_full:
+                    # full sync supersedes any pending partial
+                    self.sync_full_now()
+                    next_full = now + self.full_interval()
+                elif triggered:
+                    self.local.update_sync_state(self.catalog)
+                    self.local.sync_changes(self.catalog)
+                    self.syncs_partial += 1
+            except Exception:
+                self.failures += 1
+                next_full = min(next_full, now + self.retry_fail_interval)

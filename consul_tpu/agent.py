@@ -30,16 +30,38 @@ class Agent:
                  acl_default_policy: str = "allow",
                  acl_down_policy: str = "extend-cache"):
         from consul_tpu.acl import ACLResolver
+        from consul_tpu.ae import StateSyncer
+        from consul_tpu.checks import CheckManager
+        from consul_tpu.local import LocalState
         self.oracle = GossipOracle(gossip, sim)
         self.store = StateStore()
         self.node_name = node_name
         self.acl = ACLResolver(self.store, enabled=acl_enabled,
                                default_policy=acl_default_policy,
                                down_policy=acl_down_policy)
+        # local state + AE: /v1/agent writes land here; the syncer pushes
+        # to the catalog (reference split: agent/local + agent/ae vs
+        # agent/consul catalog)
+        self.local = LocalState(node_name,
+                                on_change=lambda: self.syncer.trigger())
+        self.checks = CheckManager(self._check_notify)
+        self.syncer = StateSyncer(
+            self.local, self.store, interval=60.0,
+            cluster_size=lambda: self.oracle.n_nodes)
         self.api = ApiServer(self.store, self.oracle, node_name=node_name,
-                             port=http_port, dc=dc, acl_resolver=self.acl)
+                             port=http_port, dc=dc, acl_resolver=self.acl,
+                             local=self.local, checks=self.checks)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
+
+    def _check_notify(self, check_id: str, status: str, output: str) -> None:
+        """Check-runner callback → local state → AE push (the reference's
+        CheckNotifier wiring, agent/checks/check.go → local.UpdateCheck)."""
+        if self.local.update_check(check_id, status, output):
+            try:
+                self.local.sync_changes(self.store)
+            except Exception:
+                pass  # syncer retries on its own cadence
 
     # ------------------------------------------------------------- lifecycle
 
@@ -48,6 +70,7 @@ class Agent:
         self.store.register_node(self.node_name, "127.0.0.1")
         self.store.register_check(self.node_name, "serfHealth",
                                   "Serf Health Status", status="passing")
+        self.syncer.start()
         self.oracle.start(tick_seconds)
         self.api.start()
         self._running = True
@@ -67,6 +90,8 @@ class Agent:
 
     def stop(self) -> None:
         self._running = False
+        self.checks.stop_all()
+        self.syncer.stop()
         self.oracle.stop()
         self.api.stop()
         if self._reconcile_thread:

@@ -55,13 +55,20 @@ class ApiServer:
     def __init__(self, store: StateStore, oracle: GossipOracle,
                  node_name: str = "node0", host: str = "127.0.0.1",
                  port: int = 0, dc: str = "dc1",
-                 acl_resolver: Optional[ACLResolver] = None):
+                 acl_resolver: Optional[ACLResolver] = None,
+                 local=None, checks=None):
         self.store = store
         self.oracle = oracle
         self.node_name = node_name
         self.dc = dc
         # no resolver → ACLs disabled (resolve() returns allow-all)
         self.acl = acl_resolver or ACLResolver(store, enabled=False)
+        # agent-endpoint backing: LocalState + CheckManager when wired by
+        # an Agent (the reference's /v1/agent/* writes hit local state and
+        # anti-entropy pushes to the catalog; without an agent the routes
+        # fall through to direct store writes)
+        self.local = local
+        self.checks = checks
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -151,6 +158,58 @@ def _make_handler(srv: ApiServer):
             return self.authz.session_write(
                 sess["node"] if sess else srv.node_name)
 
+        # ------------------------------------------- agent-endpoint helpers
+
+        def _agent_register_service(self, sid: str, body: dict) -> None:
+            """Write through local state + AE when wired; otherwise the
+            store directly (structs.ServiceDefinition handling,
+            agent/agent_endpoint.go AgentRegisterService)."""
+            name = body.get("Name", sid)
+            if srv.local is not None:
+                srv.local.add_service(
+                    sid, name, port=body.get("Port", 0),
+                    tags=body.get("Tags") or [], meta=body.get("Meta") or {},
+                    address=body.get("Address", ""))
+            else:
+                store.register_service(
+                    srv.node_name, sid, name, port=body.get("Port", 0),
+                    tags=body.get("Tags") or [], meta=body.get("Meta") or {},
+                    address=body.get("Address", ""))
+            checks = list(body.get("Checks") or [])
+            if body.get("Check"):
+                checks.append(body["Check"])
+            for i, chk in enumerate(checks):
+                default_cid = f"service:{sid}" + (f":{i+1}" if i else "")
+                cid = chk.get("CheckID") or default_cid
+                self._agent_register_check(cid, chk, sid)
+            if srv.local is not None:
+                srv.local.sync_changes(store)
+
+        def _agent_register_check(self, cid: str, body: dict,
+                                  service_id: str = "") -> None:
+            name = body.get("Name") or cid
+            status = body.get("Status", "critical")
+            defn = _check_defn(body)
+            if srv.local is not None:
+                srv.local.add_check(cid, name, status=status,
+                                    service_id=service_id,
+                                    output=body.get("Notes", ""))
+                if srv.checks is not None and defn:
+                    if defn.get("alias_node") or defn.get("alias_service"):
+                        from consul_tpu.checks import CheckAlias
+                        srv.checks.add(CheckAlias(
+                            cid, srv.checks.notify, store,
+                            defn.get("alias_node") or srv.node_name,
+                            defn.get("alias_service", "")))
+                    else:
+                        runner = srv.checks.from_definition(cid, defn)
+                        if runner is not None:
+                            srv.checks.add(runner)
+                srv.local.sync_changes(store)
+            else:
+                store.register_check(srv.node_name, cid, name,
+                                     status=status, service_id=service_id)
+
         # ------------------------------------------------------------- verbs
 
         def do_GET(self):
@@ -220,32 +279,76 @@ def _make_handler(srv: ApiServer):
                     {"Name": "consul.catalog.index", "Value": store.index},
                 ], "Counters": [], "Samples": []})
                 return True
+            if path == "/v1/agent/services" and verb == "GET":
+                if srv.local is not None:
+                    out = {sid: {"ID": sid, "Service": s["name"],
+                                 "Tags": s["tags"], "Port": s["port"],
+                                 "Address": s["address"], "Meta": s["meta"]}
+                           for sid, s in srv.local.services().items()
+                           if self.authz.service_read(s["name"])}
+                else:
+                    out = {s["id"]: {"ID": s["id"], "Service": s["name"],
+                                     "Tags": s["tags"], "Port": s["port"],
+                                     "Address": s["address"],
+                                     "Meta": s["meta"]}
+                           for s in store.node_services(srv.node_name)
+                           if self.authz.service_read(s["name"])}
+                self._send(out)
+                return True
+            if path == "/v1/agent/checks" and verb == "GET":
+                def _chk_visible(service_id: str) -> bool:
+                    # service checks filter by service:read on their
+                    # service name, node checks by node:read (aclFilter)
+                    if not service_id:
+                        return self.authz.node_read(srv.node_name)
+                    if srv.local is not None:
+                        s = srv.local.services().get(service_id)
+                    else:
+                        s = next((x for x in
+                                  store.node_services(srv.node_name)
+                                  if x["id"] == service_id), None)
+                    return self.authz.service_read(
+                        s["name"] if s else service_id)
+                if srv.local is not None:
+                    out = {cid: {"CheckID": cid, "Name": c["name"],
+                                 "Status": c["status"], "Output": c["output"],
+                                 "ServiceID": c["service_id"],
+                                 "Node": srv.node_name}
+                           for cid, c in srv.local.checks().items()
+                           if _chk_visible(c["service_id"])}
+                else:
+                    out = {c["check_id"]: _check_json(c, srv.node_name)
+                           for c in store.node_checks(srv.node_name)
+                           if _chk_visible(c["service_id"])}
+                self._send(out)
+                return True
             if path == "/v1/agent/service/register" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
                 sid = body.get("ID") or body.get("Name")
                 if not self.authz.service_write(body.get("Name", sid)):
                     return self._forbid()
-                store.register_service(
-                    srv.node_name, sid, body.get("Name", sid),
-                    port=body.get("Port", 0), tags=body.get("Tags") or [],
-                    meta=body.get("Meta") or {},
-                    address=body.get("Address", ""))
-                if "Check" in body and body["Check"]:
-                    chk = body["Check"]
-                    store.register_check(
-                        srv.node_name, chk.get("CheckID", f"service:{sid}"),
-                        chk.get("Name", f"Service '{sid}' check"),
-                        status=chk.get("Status", "critical"), service_id=sid)
+                self._agent_register_service(sid, body)
                 self._send(None)
                 return True
             m = re.fullmatch(r"/v1/agent/service/deregister/(.+)", path)
             if m and verb == "PUT":
-                svc = next((s for s in store.node_services(srv.node_name)
-                            if s["id"] == m.group(1)), None)
+                sid = m.group(1)
+                svc = (srv.local.services().get(sid)
+                       if srv.local is not None else
+                       next((s for s in store.node_services(srv.node_name)
+                             if s["id"] == sid), None))
                 if not self.authz.service_write(
-                        svc["name"] if svc else m.group(1)):
+                        svc["name"] if svc else sid):
                     return self._forbid()
-                store.deregister_service(srv.node_name, m.group(1))
+                if srv.local is not None:
+                    if srv.checks is not None:
+                        for cid, c in srv.local.checks().items():
+                            if c["service_id"] == sid:
+                                srv.checks.remove(cid)
+                    srv.local.remove_service(sid)
+                    srv.local.sync_changes(store)
+                else:
+                    store.deregister_service(srv.node_name, sid)
                 self._send(None)
                 return True
             if path == "/v1/agent/check/register" and verb == "PUT":
@@ -259,25 +362,47 @@ def _make_handler(srv: ApiServer):
                     ok = self.authz.node_write(srv.node_name)
                 if not ok:
                     return self._forbid()
-                store.register_check(
-                    srv.node_name, body.get("CheckID") or body.get("Name"),
-                    body.get("Name", ""), status=body.get("Status", "critical"),
-                    service_id=body.get("ServiceID", ""))
+                cid = body.get("CheckID") or body.get("Name")
+                self._agent_register_check(cid, body, sid)
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/check/deregister/(.+)", path)
+            if m and verb == "PUT":
+                if not (self.authz.node_write(srv.node_name)
+                        or self._check_update_allowed(m.group(1))):
+                    return self._forbid()
+                if srv.checks is not None:
+                    srv.checks.remove(m.group(1))
+                if srv.local is not None:
+                    srv.local.remove_check(m.group(1))
+                    srv.local.sync_changes(store)
+                else:
+                    store.deregister_check(srv.node_name, m.group(1))
                 self._send(None)
                 return True
             m = re.fullmatch(r"/v1/agent/check/(pass|warn|fail)/(.+)", path)
             if m and verb == "PUT":
+                cid = m.group(2)
                 if not (self.authz.node_write(srv.node_name)
-                        or self._check_update_allowed(m.group(2))):
+                        or self._check_update_allowed(cid)):
                     return self._forbid()
                 status = {"pass": "passing", "warn": "warning",
                           "fail": "critical"}[m.group(1)]
-                try:
-                    store.update_check(srv.node_name, m.group(2), status,
-                                       output=q.get("note", ""))
-                except KeyError:
-                    self._err(404, "unknown check")
-                    return True
+                note = q.get("note", "")
+                ttl = srv.checks.ttl(cid) if srv.checks is not None else None
+                if ttl is not None:
+                    ttl.set_status(status, note)   # notifies local state
+                    srv.local.sync_changes(store)
+                elif srv.local is not None and srv.local.update_check(
+                        cid, status, note):
+                    srv.local.sync_changes(store)
+                else:
+                    try:
+                        store.update_check(srv.node_name, cid, status,
+                                           output=note)
+                    except KeyError:
+                        self._err(404, "unknown check")
+                        return True
                 self._send(None)
                 return True
             m = re.fullmatch(r"/v1/agent/force-leave/(.+)", path)
@@ -769,6 +894,38 @@ def _make_handler(srv: ApiServer):
             return sorted(rows, key=lambda r: pos.get(key(r), 1 << 30))
 
     return Handler
+
+
+def _check_defn(body: dict) -> dict:
+    """Normalize a structs.CheckType JSON body into CheckManager's
+    lowercase definition dict (duration strings → seconds)."""
+    defn = {}
+    if body.get("TTL"):
+        defn["ttl"] = _parse_wait(str(body["TTL"]))
+    if body.get("HTTP"):
+        defn["http"] = body["HTTP"]
+        defn["method"] = body.get("Method", "GET")
+        defn["header"] = {k: (v[0] if isinstance(v, list) else v)
+                          for k, v in (body.get("Header") or {}).items()}
+    if body.get("TCP"):
+        defn["tcp"] = body["TCP"]
+    if body.get("Args") or body.get("ScriptArgs"):
+        defn["args"] = body.get("Args") or body.get("ScriptArgs")
+    if body.get("H2PING"):
+        defn["h2ping"] = body["H2PING"]
+    if body.get("GRPC"):
+        defn["grpc"] = body["GRPC"]
+    if body.get("DockerContainerID"):
+        defn["docker_container_id"] = body["DockerContainerID"]
+        defn["shell_args"] = body.get("Args") or ["true"]
+    if body.get("AliasNode") or body.get("AliasService"):
+        defn["alias_node"] = body.get("AliasNode", "")
+        defn["alias_service"] = body.get("AliasService", "")
+    if body.get("Interval"):
+        defn["interval"] = _parse_wait(str(body["Interval"]))
+    if body.get("Timeout"):
+        defn["timeout"] = _parse_wait(str(body["Timeout"]))
+    return defn
 
 
 # ------------------------------------------------------------ JSON shapers

@@ -239,6 +239,12 @@ class StateStore:
                 self._invalidate_session_locked(sid)
             return idx
 
+    def deregister_check(self, node: str, check_id: str) -> int:
+        with self._lock:
+            idx = self._bump()
+            self._checks.pop((node, check_id), None)
+            return idx
+
     def deregister_service(self, node: str, service_id: str) -> int:
         with self._lock:
             idx = self._bump()

@@ -1,0 +1,8 @@
+from consul_tpu.checks.runner import (
+    CheckAlias, CheckDocker, CheckGRPC, CheckH2PING, CheckHTTP, CheckMonitor,
+    CheckManager, CheckTCP, CheckTTL,
+)
+
+__all__ = ["CheckAlias", "CheckDocker", "CheckGRPC", "CheckH2PING",
+           "CheckHTTP", "CheckMonitor", "CheckManager", "CheckTCP",
+           "CheckTTL"]

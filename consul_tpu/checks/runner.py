@@ -1,0 +1,387 @@
+"""Health-check runners — the agent/checks/ package equivalent.
+
+The reference ships 8 runner types (agent/checks/check.go): interval exec
+(CheckMonitor :65), TTL (CheckTTL :233), HTTP (CheckHTTP :335), HTTP/2
+ping (CheckH2PING :509), TCP (CheckTCP :610), Docker exec (CheckDocker
+:693), gRPC health (CheckGRPC :821) and alias (alias.go:23).  Each runs on
+its own interval with random initial stagger and reports status through a
+notifier callback — here `notify(check_id, status, output)`, the
+equivalent of the reference's CheckNotifier (local state).
+
+Statuses: "passing" | "warning" | "critical" (api.Health* constants).
+Output is truncated to BufSize=4K like the reference (checks/check.go
+CheckBufSize).
+"""
+
+from __future__ import annotations
+
+import random
+import shutil
+import socket
+import ssl
+import struct
+import subprocess
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Optional
+
+PASSING, WARNING, CRITICAL = "passing", "warning", "critical"
+OUTPUT_MAX = 4096
+
+Notify = Callable[[str, str, str], None]   # (check_id, status, output)
+
+
+class _IntervalRunner:
+    """Base: fire `check()` every `interval` seconds with initial stagger
+    (lib.RandomStagger — checks start spread to avoid thundering herd)."""
+
+    def __init__(self, check_id: str, notify: Notify, interval: float,
+                 timeout: float = 10.0):
+        self.check_id = check_id
+        self.notify = notify
+        self.interval = interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        # initial stagger in [0, interval)
+        if self._stop.wait(random.random() * min(self.interval, 1.0)):
+            return
+        while not self._stop.is_set():
+            try:
+                status, output = self.check()
+            except Exception as e:  # runner bugs surface as critical
+                status, output = CRITICAL, f"check raised: {e}"
+            self.notify(self.check_id, status, output[:OUTPUT_MAX])
+            if self._stop.wait(self.interval):
+                return
+
+    def check(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class CheckTTL:
+    """TTL check (check.go:233): the application pushes status via the
+    agent API; silence past the TTL flips it critical."""
+
+    def __init__(self, check_id: str, notify: Notify, ttl: float):
+        self.check_id = check_id
+        self.notify = notify
+        self.ttl = ttl
+        self._deadline = time.time() + ttl
+        self._expired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def set_status(self, status: str, output: str = "") -> None:
+        """App heartbeat (agent/check/pass|warn|fail → SetStatus)."""
+        with self._lock:
+            self._deadline = time.time() + self.ttl
+            self._expired = False
+        self.notify(self.check_id, status, output[:OUTPUT_MAX])
+
+    def _loop(self) -> None:
+        while not self._stop.wait(min(self.ttl / 4, 0.25)):
+            with self._lock:
+                expired = time.time() >= self._deadline and not self._expired
+                if expired:
+                    self._expired = True
+            if expired:
+                self.notify(self.check_id, CRITICAL,
+                            f"TTL expired ({self.ttl}s)")
+
+
+class CheckHTTP(_IntervalRunner):
+    """HTTP GET: 2xx passing, 429 warning, anything else critical
+    (check.go:335 CheckHTTP.check)."""
+
+    def __init__(self, check_id: str, notify: Notify, url: str,
+                 interval: float, timeout: float = 10.0,
+                 method: str = "GET", header: dict | None = None,
+                 tls_skip_verify: bool = False):
+        super().__init__(check_id, notify, interval, timeout)
+        self.url = url
+        self.method = method
+        self.header = header or {}
+
+    def check(self):
+        req = urllib.request.Request(self.url, method=self.method)
+        req.add_header("User-Agent", "Consul Health Check")
+        req.add_header("Accept", "text/plain, text/*, */*")
+        for k, v in self.header.items():
+            req.add_header(k, v)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read(OUTPUT_MAX).decode(errors="replace")
+                return PASSING, f"HTTP {self.method} {self.url}: " \
+                                f"{resp.status}  Output: {body}"
+        except urllib.error.HTTPError as e:
+            body = e.read(OUTPUT_MAX).decode(errors="replace")
+            status = WARNING if e.code == 429 else CRITICAL
+            return status, f"HTTP {self.method} {self.url}: {e.code}  " \
+                           f"Output: {body}"
+        except Exception as e:
+            return CRITICAL, f"HTTP {self.method} {self.url}: {e}"
+
+
+class CheckTCP(_IntervalRunner):
+    """TCP connect probe (check.go:610)."""
+
+    def __init__(self, check_id: str, notify: Notify, tcp: str,
+                 interval: float, timeout: float = 10.0):
+        super().__init__(check_id, notify, interval, timeout)
+        host, _, port = tcp.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+
+    def check(self):
+        try:
+            with socket.create_connection(self.addr, timeout=self.timeout):
+                return PASSING, f"TCP connect {self.addr[0]}:" \
+                                f"{self.addr[1]}: Success"
+        except OSError as e:
+            return CRITICAL, f"TCP connect {self.addr[0]}:" \
+                             f"{self.addr[1]}: {e}"
+
+
+class CheckMonitor(_IntervalRunner):
+    """Interval exec check (check.go:65): exit 0 passing, 1 warning,
+    other critical; stdout+stderr captured as output."""
+
+    def __init__(self, check_id: str, notify: Notify, args: list[str],
+                 interval: float, timeout: float = 30.0):
+        super().__init__(check_id, notify, interval, timeout)
+        self.args = args
+
+    def check(self):
+        try:
+            proc = subprocess.run(self.args, capture_output=True,
+                                  timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            return CRITICAL, f"exec timed out after {self.timeout}s"
+        except OSError as e:
+            return CRITICAL, f"exec failed: {e}"
+        output = (proc.stdout + proc.stderr).decode(errors="replace")
+        status = {0: PASSING, 1: WARNING}.get(proc.returncode, CRITICAL)
+        return status, output
+
+
+class CheckH2PING(_IntervalRunner):
+    """HTTP/2 ping (check.go:509): client preface + SETTINGS, then a PING
+    frame; a PING ack within the timeout is passing.  Hand-rolled h2
+    framing — 9-byte frame header (len, type, flags, stream id)."""
+
+    _PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+    _PING_TYPE = 0x6
+
+    def __init__(self, check_id: str, notify: Notify, h2ping: str,
+                 interval: float, timeout: float = 10.0,
+                 use_tls: bool = False):
+        super().__init__(check_id, notify, interval, timeout)
+        host, _, port = h2ping.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.use_tls = use_tls
+
+    def _frame(self, ftype: int, flags: int, payload: bytes) -> bytes:
+        return struct.pack(">I", len(payload))[1:] + \
+            bytes([ftype, flags]) + b"\x00\x00\x00\x00" + payload
+
+    def check(self):
+        try:
+            sock = socket.create_connection(self.addr, timeout=self.timeout)
+            if self.use_tls:
+                ctx = ssl.create_default_context()
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+                ctx.set_alpn_protocols(["h2"])
+                sock = ctx.wrap_socket(sock, server_hostname=self.addr[0])
+            with sock:
+                sock.sendall(self._PREFACE + self._frame(0x4, 0, b""))
+                opaque = struct.pack(">Q", 0x7075736870696e67)  # "pushping"
+                sock.sendall(self._frame(self._PING_TYPE, 0, opaque))
+                deadline = time.time() + self.timeout
+                buf = b""
+                while time.time() < deadline:
+                    sock.settimeout(max(0.05, deadline - time.time()))
+                    chunk = sock.recv(4096)
+                    if not chunk:
+                        break
+                    buf += chunk
+                    while len(buf) >= 9:
+                        ln = int.from_bytes(b"\x00" + buf[:3], "big")
+                        if len(buf) < 9 + ln:
+                            break
+                        ftype, flags = buf[3], buf[4]
+                        payload = buf[9:9 + ln]
+                        buf = buf[9 + ln:]
+                        if ftype == self._PING_TYPE and flags & 0x1 \
+                                and payload == opaque:
+                            return PASSING, "HTTP2 ping acked"
+                return CRITICAL, "no HTTP2 ping ack before timeout"
+        except OSError as e:
+            return CRITICAL, f"h2ping {self.addr[0]}:{self.addr[1]}: {e}"
+
+
+class CheckGRPC(_IntervalRunner):
+    """gRPC health-v1 probe (check.go:821).  Uses grpcio when installed;
+    otherwise reports critical with an explicit unsupported message (the
+    environment gates optional deps rather than pip-installing)."""
+
+    def __init__(self, check_id: str, notify: Notify, grpc_target: str,
+                 interval: float, timeout: float = 10.0):
+        super().__init__(check_id, notify, interval, timeout)
+        self.target = grpc_target
+
+    def check(self):
+        try:
+            import grpc  # noqa: F401  (optional)
+            from grpc_health.v1 import health_pb2, health_pb2_grpc
+        except ImportError:
+            return CRITICAL, "grpc check unsupported: grpcio not installed"
+        channel = grpc.insecure_channel(self.target)
+        try:
+            stub = health_pb2_grpc.HealthStub(channel)
+            resp = stub.Check(health_pb2.HealthCheckRequest(service=""),
+                              timeout=self.timeout)
+            if resp.status == health_pb2.HealthCheckResponse.SERVING:
+                return PASSING, "gRPC SERVING"
+            return CRITICAL, f"gRPC status {resp.status}"
+        except Exception as e:
+            return CRITICAL, f"gRPC check failed: {e}"
+        finally:
+            channel.close()
+
+
+class CheckDocker(_IntervalRunner):
+    """Docker exec check (check.go:693) via the docker CLI; critical with
+    an explicit message when no docker binary is present."""
+
+    def __init__(self, check_id: str, notify: Notify, container: str,
+                 args: list[str], interval: float, timeout: float = 30.0):
+        super().__init__(check_id, notify, interval, timeout)
+        self.container = container
+        self.args = args
+
+    def check(self):
+        if shutil.which("docker") is None:
+            return CRITICAL, "docker check unsupported: no docker binary"
+        try:
+            proc = subprocess.run(
+                ["docker", "exec", self.container, *self.args],
+                capture_output=True, timeout=self.timeout)
+        except subprocess.TimeoutExpired:
+            return CRITICAL, f"docker exec timed out after {self.timeout}s"
+        output = (proc.stdout + proc.stderr).decode(errors="replace")
+        status = {0: PASSING, 1: WARNING}.get(proc.returncode, CRITICAL)
+        return status, output
+
+
+class CheckAlias(_IntervalRunner):
+    """Alias check (alias.go:23): mirrors the aggregate status of another
+    service's checks read from a store-shaped source (worst status wins;
+    no checks at all is passing, like the reference's empty-checks rule)."""
+
+    def __init__(self, check_id: str, notify: Notify, store,
+                 node: str, service_id: str, interval: float = 0.5):
+        super().__init__(check_id, notify, interval)
+        self.store = store
+        self.node = node
+        self.service_id = service_id
+
+    def check(self):
+        checks = [c for c in self.store.node_checks(self.node)
+                  if not self.service_id
+                  or c["service_id"] in ("", self.service_id)]
+        checks = [c for c in checks if c["check_id"] != self.check_id]
+        if any(c["status"] == CRITICAL for c in checks):
+            return CRITICAL, "aliased target critical"
+        if any(c["status"] == WARNING for c in checks):
+            return WARNING, "aliased target warning"
+        return PASSING, "All checks passing"
+
+
+class CheckManager:
+    """Owns runner lifecycle per check id (the agent's checkMonitors /
+    checkTTLs / checkHTTPs maps, agent/agent.go:2405 region)."""
+
+    def __init__(self, notify: Notify):
+        self.notify = notify
+        self._runners: dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def add(self, runner) -> None:
+        with self._lock:
+            old = self._runners.pop(runner.check_id, None)
+            self._runners[runner.check_id] = runner
+        if old is not None:
+            old.stop()
+        runner.start()
+
+    def remove(self, check_id: str) -> None:
+        with self._lock:
+            runner = self._runners.pop(check_id, None)
+        if runner is not None:
+            runner.stop()
+
+    def ttl(self, check_id: str) -> Optional[CheckTTL]:
+        with self._lock:
+            r = self._runners.get(check_id)
+        return r if isinstance(r, CheckTTL) else None
+
+    def stop_all(self) -> None:
+        with self._lock:
+            runners = list(self._runners.values())
+            self._runners.clear()
+        for r in runners:
+            r.stop()
+
+    def from_definition(self, check_id: str, defn: dict):
+        """Build a runner from an HTTP-API check definition (the
+        reference's structs.CheckType dispatch, agent/agent.go:2403)."""
+        interval = defn.get("interval", 10.0)
+        timeout = defn.get("timeout", 10.0)
+        if defn.get("ttl"):
+            return CheckTTL(check_id, self.notify, defn["ttl"])
+        if defn.get("http"):
+            return CheckHTTP(check_id, self.notify, defn["http"], interval,
+                             timeout, method=defn.get("method", "GET"),
+                             header=defn.get("header"))
+        if defn.get("tcp"):
+            return CheckTCP(check_id, self.notify, defn["tcp"], interval,
+                            timeout)
+        if defn.get("args"):
+            return CheckMonitor(check_id, self.notify, defn["args"],
+                                interval, timeout)
+        if defn.get("h2ping"):
+            return CheckH2PING(check_id, self.notify, defn["h2ping"],
+                               interval, timeout)
+        if defn.get("grpc"):
+            return CheckGRPC(check_id, self.notify, defn["grpc"], interval,
+                             timeout)
+        if defn.get("docker_container_id"):
+            return CheckDocker(check_id, self.notify,
+                               defn["docker_container_id"],
+                               defn.get("shell_args", ["true"]), interval,
+                               timeout)
+        return None

@@ -29,6 +29,7 @@ class ServerFSM:
             "update_check": self._update_check,
             "deregister_node": self._deregister_node,
             "deregister_service": self._deregister_service,
+            "deregister_check": self._deregister_check,
             "session_create": self._session_create,
             "session_renew": self._session_renew,
             "session_destroy": self._session_destroy,
@@ -102,6 +103,9 @@ class ServerFSM:
 
     def _deregister_service(self, node, service_id):
         return {"index": self.store.deregister_service(node, service_id)}
+
+    def _deregister_check(self, node, check_id):
+        return {"index": self.store.deregister_check(node, check_id)}
 
     def _session_create(self, sid, node, ttl=0.0, behavior="release",
                         lock_delay=15.0, checks=None, now=None):

@@ -1,0 +1,3 @@
+from consul_tpu.local.state import LocalState
+
+__all__ = ["LocalState"]

@@ -1,0 +1,183 @@
+"""Agent-local state: the desired-state registry AE syncs to the catalog.
+
+The reference's agent/local/state.go:158 keeps the node's services and
+checks with per-entry InSync/Deferred flags; updateSyncState (:880) diffs
+them against the server catalog, SyncFull (:1053) resets and pushes
+everything, SyncChanges (:1071) pushes only out-of-sync entries.  Same
+model here against a duck-typed catalog surface (StateStore or a
+raft-replicated Server — both expose register_/deregister_/node_services/
+node_checks).
+
+The per-entry map walk the reference does is the host-side small-N path;
+the 1M-entry batched equivalent is ops/reconcile.diff_sorted consumed by
+models/antientropy (SURVEY.md §7 step 4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class LocalState:
+    def __init__(self, node_name: str, address: str = "127.0.0.1",
+                 on_change: Optional[Callable[[], None]] = None):
+        self.node_name = node_name
+        self.address = address
+        self._lock = threading.RLock()
+        self._services: Dict[str, dict] = {}        # sid -> defn + in_sync
+        self._checks: Dict[str, dict] = {}          # cid -> defn + in_sync
+        self._on_change = on_change or (lambda: None)
+
+    # ------------------------------------------------------------- mutation
+
+    def add_service(self, service_id: str, name: str, port: int = 0,
+                    tags: List[str] | None = None, meta: dict | None = None,
+                    address: str = "") -> None:
+        with self._lock:
+            self._services[service_id] = {
+                "name": name, "port": port, "tags": tags or [],
+                "meta": meta or {}, "address": address, "in_sync": False}
+        self._on_change()
+
+    def remove_service(self, service_id: str) -> None:
+        with self._lock:
+            if service_id in self._services:
+                self._services[service_id]["deleted"] = True
+                self._services[service_id]["in_sync"] = False
+            for cid, c in self._checks.items():
+                if c["service_id"] == service_id:
+                    c["deleted"] = True
+                    c["in_sync"] = False
+        self._on_change()
+
+    def add_check(self, check_id: str, name: str, status: str = "critical",
+                  service_id: str = "", output: str = "") -> None:
+        with self._lock:
+            self._checks[check_id] = {
+                "name": name, "status": status, "service_id": service_id,
+                "output": output, "in_sync": False}
+        self._on_change()
+
+    def remove_check(self, check_id: str) -> None:
+        with self._lock:
+            if check_id in self._checks:
+                self._checks[check_id]["deleted"] = True
+                self._checks[check_id]["in_sync"] = False
+        self._on_change()
+
+    def update_check(self, check_id: str, status: str,
+                     output: str = "") -> bool:
+        """Check runner callback (the reference defers frequent output-only
+        updates via CheckUpdateInterval; status flips always sync)."""
+        with self._lock:
+            c = self._checks.get(check_id)
+            if c is None or c.get("deleted"):
+                return False
+            if c["status"] == status and c["output"] == output:
+                return True
+            c["status"] = status
+            c["output"] = output
+            c["in_sync"] = False
+        self._on_change()
+        return True
+
+    # ---------------------------------------------------------------- reads
+
+    def services(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._services.items()
+                    if not v.get("deleted")}
+
+    def checks(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._checks.items()
+                    if not v.get("deleted")}
+
+    def check_status(self, check_id: str) -> Optional[str]:
+        with self._lock:
+            c = self._checks.get(check_id)
+            return None if c is None or c.get("deleted") else c["status"]
+
+    # ----------------------------------------------------------------- sync
+
+    def update_sync_state(self, catalog) -> Tuple[int, int]:
+        """Diff local vs catalog and mark out-of-sync entries
+        (updateSyncState, state.go:880).  Returns (dirty_services,
+        dirty_checks) counts."""
+        remote_svcs = {s["id"]: s
+                       for s in catalog.node_services(self.node_name)}
+        remote_chks = {c["check_id"]: c
+                       for c in catalog.node_checks(self.node_name)}
+        dirty_s = dirty_c = 0
+        with self._lock:
+            for sid, svc in self._services.items():
+                if svc.get("deleted"):
+                    svc["in_sync"] = sid not in remote_svcs
+                    continue
+                r = remote_svcs.get(sid)
+                same = r is not None and (
+                    r["name"] == svc["name"] and r["port"] == svc["port"]
+                    and r["tags"] == svc["tags"]
+                    and r["meta"] == svc["meta"]
+                    and r["address"] == svc["address"])
+                svc["in_sync"] = same
+                if not same:
+                    dirty_s += 1
+            for cid, chk in self._checks.items():
+                if chk.get("deleted"):
+                    chk["in_sync"] = cid not in remote_chks
+                    continue
+                r = remote_chks.get(cid)
+                same = r is not None and (
+                    r["status"] == chk["status"]
+                    and r["output"] == chk["output"]
+                    and r["service_id"] == chk["service_id"])
+                chk["in_sync"] = same
+                if not same:
+                    dirty_c += 1
+        return dirty_s, dirty_c
+
+    def sync_changes(self, catalog) -> int:
+        """Push only out-of-sync entries (SyncChanges, state.go:1071).
+        Returns number of operations pushed."""
+        ops = 0
+        with self._lock:
+            services = list(self._services.items())
+            checks = list(self._checks.items())
+        for sid, svc in services:
+            if svc["in_sync"]:
+                continue
+            if svc.get("deleted"):
+                catalog.deregister_service(self.node_name, sid)
+                with self._lock:
+                    self._services.pop(sid, None)
+            else:
+                catalog.register_service(
+                    self.node_name, sid, svc["name"], port=svc["port"],
+                    tags=svc["tags"], meta=svc["meta"],
+                    address=svc["address"])
+                with self._lock:
+                    svc["in_sync"] = True
+            ops += 1
+        for cid, chk in checks:
+            if chk["in_sync"]:
+                continue
+            if chk.get("deleted"):
+                catalog.deregister_check(self.node_name, cid)
+                with self._lock:
+                    self._checks.pop(cid, None)
+            else:
+                catalog.register_check(
+                    self.node_name, cid, chk["name"], status=chk["status"],
+                    service_id=chk["service_id"], output=chk["output"])
+                with self._lock:
+                    chk["in_sync"] = True
+            ops += 1
+        return ops
+
+    def sync_full(self, catalog) -> int:
+        """Full anti-entropy pass: re-diff then push (SyncFull,
+        state.go:1053)."""
+        self.update_sync_state(catalog)
+        return self.sync_changes(catalog)

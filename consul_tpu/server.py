@@ -190,6 +190,10 @@ class Server:
         return self.raft_apply("deregister_service", node=node,
                                service_id=service_id)["index"]
 
+    def deregister_check(self, node, check_id):
+        return self.raft_apply("deregister_check", node=node,
+                               check_id=check_id)["index"]
+
     def session_create(self, node, ttl=0.0, behavior="release",
                        lock_delay=15.0, checks=None, sid=None):
         r = self.raft_apply("session_create", sid=sid or str(uuid.uuid4()),

@@ -218,8 +218,6 @@ def test_http_acl_flow(acl_agent):
 
 def test_http_catalog_filtering(acl_agent):
     anon = Client(acl_agent.http_address)
-    boot = Client(acl_agent.http_address).acl_token_self \
-        if False else None  # noqa — keep flake quiet
     # root lists services; anonymous (deny) sees an empty map
     toks = acl_agent.store.acl_token_list()
     root_secret = next(t["secret"] for t in toks
@@ -273,3 +271,19 @@ def test_token_update_preserves_secret_and_type(acl_agent):
     assert kept["description"] == "renamed"
     # the management secret still resolves as management
     assert root.kv_put("app/after-update", b"1")
+
+
+def test_allow_all_denies_acl_management():
+    # default-allow must not grant ACL management (reference AllowAll)
+    a = allow_all()
+    assert a.key_write("x") and a.operator_write()
+    assert not a.acl_read() and not a.acl_write()
+
+
+def test_intention_precedence_exact_beats_prefix():
+    a = Authorizer(parse(
+        'service_prefix "" { policy = "read" intentions = "deny" }\n'
+        'service "web" { policy = "write" intentions = "write" }'),
+        default_policy="deny")
+    assert a.intention_write("web")       # exact beats the catch-all deny
+    assert not a.intention_read("other")  # prefix deny still applies

@@ -1,0 +1,358 @@
+"""Health-check runner + local-state + AE syncer tests (the reference's
+agent/checks/check_test.go and agent/local/state_test.go patterns, with
+real listeners on loopback instead of mocks)."""
+
+import http.server
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+import pytest
+
+from consul_tpu.ae import StateSyncer, scale_factor
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.checks import (
+    CheckAlias, CheckH2PING, CheckHTTP, CheckManager, CheckMonitor,
+    CheckTCP, CheckTTL,
+)
+from consul_tpu.local import LocalState
+
+
+class Recorder:
+    def __init__(self):
+        self.updates = []
+        self.event = threading.Event()
+
+    def __call__(self, cid, status, output):
+        self.updates.append((cid, status, output))
+        self.event.set()
+
+    def wait_status(self, want, timeout=5.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if any(s == want for _, s, _ in self.updates):
+                return True
+            time.sleep(0.02)
+        return False
+
+
+# ------------------------------------------------------------------ TTL
+
+def test_ttl_check_expires_and_resets():
+    rec = Recorder()
+    ttl = CheckTTL("t1", rec, ttl=0.3)
+    ttl.start()
+    try:
+        ttl.set_status("passing", "ok")
+        assert rec.updates[-1][1] == "passing"
+        assert rec.wait_status("critical", timeout=2.0)  # expiry
+        ttl.set_status("passing", "back")                # heartbeat resets
+        assert rec.updates[-1][1] == "passing"
+    finally:
+        ttl.stop()
+
+
+# ----------------------------------------------------------------- HTTP
+
+@pytest.fixture(scope="module")
+def http_target():
+    class H(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            code = int(self.path.rsplit("/", 1)[-1])
+            body = b"hello"
+            self.send_response(code)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+@pytest.mark.parametrize("code,want", [(200, "passing"), (429, "warning"),
+                                       (503, "critical")])
+def test_http_check_statuses(http_target, code, want):
+    rec = Recorder()
+    chk = CheckHTTP("h1", rec, f"{http_target}/{code}", interval=0.1,
+                    timeout=2.0)
+    status, output = chk.check()
+    assert status == want
+    assert str(code) in output
+
+
+def test_http_check_unreachable():
+    rec = Recorder()
+    chk = CheckHTTP("h2", rec, "http://127.0.0.1:1/x", interval=0.1,
+                    timeout=0.5)
+    status, _ = chk.check()
+    assert status == "critical"
+
+
+def test_http_check_runs_on_interval(http_target):
+    rec = Recorder()
+    chk = CheckHTTP("h3", rec, f"{http_target}/200", interval=0.05,
+                    timeout=2.0)
+    chk.start()
+    try:
+        assert rec.wait_status("passing")
+        rec.updates.clear()
+        assert rec.wait_status("passing")  # fires again
+    finally:
+        chk.stop()
+
+
+# ------------------------------------------------------------------ TCP
+
+def test_tcp_check():
+    srv = socketserver.TCPServer(("127.0.0.1", 0),
+                                 socketserver.BaseRequestHandler)
+    port = srv.server_address[1]
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    rec = Recorder()
+    assert CheckTCP("t", rec, f"127.0.0.1:{port}",
+                    interval=1).check()[0] == "passing"
+    srv.shutdown()
+    srv.server_close()
+    assert CheckTCP("t", rec, f"127.0.0.1:{port}",
+                    interval=1, timeout=0.5).check()[0] == "critical"
+
+
+# ----------------------------------------------------------------- exec
+
+@pytest.mark.parametrize("cmd,want", [("exit 0", "passing"),
+                                      ("exit 1", "warning"),
+                                      ("exit 2", "critical")])
+def test_monitor_exec_exit_codes(cmd, want):
+    rec = Recorder()
+    chk = CheckMonitor("m", rec, ["sh", "-c", cmd], interval=1)
+    assert chk.check()[0] == want
+
+
+def test_monitor_captures_output():
+    rec = Recorder()
+    chk = CheckMonitor("m", rec, ["sh", "-c", "echo all good"], interval=1)
+    status, output = chk.check()
+    assert status == "passing" and "all good" in output
+
+
+# --------------------------------------------------------------- h2ping
+
+def _fake_h2_server():
+    """Minimal h2 endpoint: swallow preface+SETTINGS, ack PINGs."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(1)
+
+    def serve():
+        conn, _ = sock.accept()
+        with conn:
+            buf = b""
+            while len(buf) < 24:           # preface
+                buf += conn.recv(4096)
+            buf = buf[24:]
+            conn.sendall(struct.pack(">I", 0)[1:] + b"\x04\x00"
+                         + b"\x00\x00\x00\x00")          # empty SETTINGS
+            while True:
+                while len(buf) < 9 or \
+                        len(buf) < 9 + int.from_bytes(b"\x00" + buf[:3],
+                                                      "big"):
+                    chunk = conn.recv(4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                ln = int.from_bytes(b"\x00" + buf[:3], "big")
+                ftype, payload = buf[3], buf[9:9 + ln]
+                buf = buf[9 + ln:]
+                if ftype == 0x6:           # PING → ack
+                    conn.sendall(struct.pack(">I", 8)[1:] + b"\x06\x01"
+                                 + b"\x00\x00\x00\x00" + payload)
+
+    threading.Thread(target=serve, daemon=True).start()
+    return sock.getsockname()[1]
+
+
+def test_h2ping_check():
+    port = _fake_h2_server()
+    rec = Recorder()
+    chk = CheckH2PING("h2", rec, f"127.0.0.1:{port}", interval=1,
+                      timeout=2.0)
+    status, output = chk.check()
+    assert status == "passing", output
+
+
+# ---------------------------------------------------------------- alias
+
+def test_alias_check_mirrors_target():
+    st = StateStore()
+    st.register_node("web1", "10.0.0.1")
+    st.register_service("web1", "web", "web", port=80)
+    st.register_check("web1", "svc:web", "web check", status="passing",
+                      service_id="web")
+    rec = Recorder()
+    alias = CheckAlias("alias1", rec, st, "web1", "web", interval=1)
+    assert alias.check()[0] == "passing"
+    st.update_check("web1", "svc:web", "critical")
+    assert alias.check()[0] == "critical"
+    st.update_check("web1", "svc:web", "warning")
+    assert alias.check()[0] == "warning"
+
+
+# -------------------------------------------------------------- manager
+
+def test_manager_from_definition_and_replace():
+    rec = Recorder()
+    mgr = CheckManager(rec)
+    r1 = mgr.from_definition("c1", {"ttl": 10.0})
+    assert isinstance(r1, CheckTTL)
+    mgr.add(r1)
+    assert mgr.ttl("c1") is r1
+    r2 = mgr.from_definition("c1", {"tcp": "127.0.0.1:9", "interval": 5})
+    mgr.add(r2)                      # replaces + stops r1
+    assert mgr.ttl("c1") is None
+    assert mgr.from_definition("x", {"args": ["true"]}).__class__.__name__ \
+        == "CheckMonitor"
+    assert mgr.from_definition("x", {}) is None
+    mgr.stop_all()
+
+
+# ------------------------------------------------------ local state + AE
+
+def test_local_state_sync_lifecycle():
+    st = StateStore()
+    st.register_node("n1", "127.0.0.1")
+    ls = LocalState("n1")
+    ls.add_service("web", "web", port=80, tags=["v1"])
+    ls.add_check("svc:web", "web alive", status="passing", service_id="web")
+    assert ls.sync_full(st) == 2
+    assert st.service_nodes("web")[0]["port"] == 80
+    assert st.node_checks("n1")[0]["status"] == "passing"
+
+    # no-op when in sync
+    assert ls.sync_full(st) == 0
+
+    # local status change → only the check syncs
+    ls.update_check("svc:web", "critical", "down")
+    assert ls.sync_full(st) == 1
+    assert st.node_checks("n1")[0]["status"] == "critical"
+
+    # remote drift (foreign write) healed by full sync
+    st.update_check("n1", "svc:web", "passing", "lies")
+    assert ls.sync_full(st) == 1
+    assert st.node_checks("n1")[0]["status"] == "critical"
+
+    # local removal deregisters remotely
+    ls.remove_service("web")
+    ls.sync_full(st)
+    assert st.service_nodes("web") == []
+    assert all(c["check_id"] != "svc:web" for c in st.node_checks("n1"))
+
+
+def test_scale_factor_log2():
+    assert scale_factor(1) == 1
+    assert scale_factor(128) == 1
+    assert scale_factor(256) == 2
+    assert scale_factor(1024) == 4
+    assert scale_factor(100_000) == 11
+
+
+def test_syncer_trigger_and_full():
+    st = StateStore()
+    st.register_node("n1", "127.0.0.1")
+    syncer_ref = []
+    ls = LocalState("n1", on_change=lambda: syncer_ref
+                    and syncer_ref[0].trigger())
+    sy = StateSyncer(ls, st, interval=0.2, cluster_size=lambda: 1,
+                     jitter=0.0)
+    syncer_ref.append(sy)
+    sy.start()
+    try:
+        ls.add_service("api", "api", port=8080)   # triggers partial sync
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not st.service_nodes("api"):
+            time.sleep(0.02)
+        assert st.service_nodes("api"), "partial sync never pushed"
+        # full sync heals foreign deletion
+        st.deregister_service("n1", "api")
+        deadline = time.time() + 3.0
+        while time.time() < deadline and not st.service_nodes("api"):
+            time.sleep(0.02)
+        assert st.service_nodes("api"), "full sync never healed drift"
+        assert sy.syncs_full >= 1
+    finally:
+        sy.stop()
+
+
+def test_syncer_retries_on_failure():
+    class Exploding:
+        def __getattr__(self, name):
+            raise RuntimeError("catalog down")
+
+    ls = LocalState("n1")
+    ls.add_service("x", "x")
+    sy = StateSyncer(ls, Exploding(), interval=0.05, cluster_size=lambda: 1,
+                     retry_fail_interval=0.05, jitter=0.0)
+    sy.start()
+    time.sleep(0.5)
+    sy.stop()
+    assert sy.failures >= 2
+
+
+# --------------------------------------------------- agent HTTP e2e
+
+def test_agent_http_check_flow(http_target):
+    from consul_tpu.agent import Agent
+    from consul_tpu.api.client import Client
+    from consul_tpu.config import GossipConfig, SimConfig
+
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=4))
+    a.start(tick_seconds=0.0, reconcile_interval=0.2)
+    try:
+        c = Client(a.http_address)
+        # service with an HTTP check definition → runner drives status
+        c._call("PUT", "/v1/agent/service/register", None, __import__(
+            "json").dumps({
+                "Name": "web", "Port": 80,
+                "Check": {"HTTP": f"{http_target}/200",
+                          "Interval": "50ms", "Timeout": "2s"}}).encode())
+        deadline = time.time() + 5.0
+        status = None
+        while time.time() < deadline:
+            rows = c.health_service("web")[0]
+            if rows:
+                checks = [ch for ch in rows[0]["Checks"]
+                          if ch["ServiceID"] == "web"]
+                if checks and checks[0]["Status"] == "passing":
+                    status = "passing"
+                    break
+            time.sleep(0.05)
+        assert status == "passing", "HTTP check never drove status passing"
+
+        # TTL check: register, pass it, see catalog update
+        c._call("PUT", "/v1/agent/check/register", None, __import__(
+            "json").dumps({"Name": "heartbeat", "TTL": "10s"}).encode())
+        c.agent_check_update("heartbeat", "passing", note="beat")
+        checks = {ch["CheckID"]: ch for ch in c.health_state("any")}
+        assert checks["heartbeat"]["Status"] == "passing"
+        assert checks["heartbeat"]["Output"] == "beat"
+
+        # /v1/agent/services and /v1/agent/checks reflect local state
+        svcs = c._call("GET", "/v1/agent/services")[0]
+        assert "web" in svcs
+        chks = c._call("GET", "/v1/agent/checks")[0]
+        assert "heartbeat" in chks
+
+        # deregister removes service + its check from the catalog
+        c.agent_service_deregister("web")
+        assert c.health_service("web")[0] == []
+    finally:
+        a.stop()
