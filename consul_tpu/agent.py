@@ -28,7 +28,8 @@ class Agent:
                  node_name: str = "node0", http_port: int = 0,
                  dc: str = "dc1", acl_enabled: bool = False,
                  acl_default_policy: str = "allow",
-                 acl_down_policy: str = "extend-cache"):
+                 acl_down_policy: str = "extend-cache",
+                 dns_port: int = 0):
         from consul_tpu.acl import ACLResolver
         from consul_tpu.ae import StateSyncer
         from consul_tpu.checks import CheckManager
@@ -51,6 +52,11 @@ class Agent:
         self.api = ApiServer(self.store, self.oracle, node_name=node_name,
                              port=http_port, dc=dc, acl_resolver=self.acl,
                              local=self.local, checks=self.checks)
+        # DNS frontend on its own ephemeral (or fixed) port; rides the
+        # same store/oracle (agent/agent.go:601 listenAndServeDNS)
+        from consul_tpu.dns import DNSServer
+        self.dns = DNSServer(self.store, self.oracle, node_name=node_name,
+                             port=dns_port)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 
@@ -73,6 +79,7 @@ class Agent:
         self.syncer.start()
         self.oracle.start(tick_seconds)
         self.api.start()
+        self.dns.start()
         self._running = True
 
         def reconcile_loop():
@@ -94,6 +101,7 @@ class Agent:
         self.syncer.stop()
         self.oracle.stop()
         self.api.stop()
+        self.dns.stop()
         if self._reconcile_thread:
             self._reconcile_thread.join(timeout=5.0)
 

@@ -1,0 +1,431 @@
+"""DNS interface — the agent/dns.go equivalent over (StateStore, oracle).
+
+Serves the reference's DNS surface (agent/dns.go:111 DNSServer;
+dispatch :644) from the host state store and the TPU membership oracle:
+
+    <node>.node.<domain>                      A / AAAA / ANY
+    [<tag>.]<service>.service.<domain>        A (healthy instances)
+    _<service>._<tag>.service.<domain>        SRV (RFC 2782, :1805)
+    <query>.query.<domain>                    prepared query execute
+    <reversed>.in-addr.arpa                   PTR (node by address)
+    <domain> SOA/NS                           zone records
+
+Health filtering drops critical instances (only_passing drops warning
+too — lookupServiceNodes :1218); results are RTT-sorted from this agent
+via the oracle's Vivaldi coordinates when available, else shuffled for
+load spread.  UDP answers overflowing the client budget set TC and
+truncate (:  the reference trims + sets Truncated, dns.go:1432 region);
+the same port serves TCP for the retry.
+
+Wire format is hand-rolled (header/question/RR encode-decode, RFC 1035)
+— no external dns library, mirroring how the reference carries miekg/dns
+rather than a resolver.
+"""
+
+from __future__ import annotations
+
+import random
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, List, Optional, Tuple
+
+# record types
+A, NS, CNAME, SOA, PTR, TXT, AAAA, SRV, ANY = \
+    1, 2, 5, 6, 12, 16, 28, 33, 255
+IN = 1
+NOERROR, FORMERR, SERVFAIL, NXDOMAIN, NOTIMP, REFUSED = 0, 1, 2, 3, 4, 5
+
+
+# ------------------------------------------------------------- wire codec
+
+def encode_name(name: str) -> bytes:
+    out = b""
+    for label in name.rstrip(".").split("."):
+        if not label:
+            continue
+        raw = label.encode()
+        if len(raw) > 63:
+            raise ValueError("label too long")
+        out += bytes([len(raw)]) + raw
+    return out + b"\x00"
+
+
+def decode_name(data: bytes, off: int) -> Tuple[str, int]:
+    labels = []
+    jumps = 0
+    pos = off
+    end = None
+    while True:
+        if pos >= len(data):
+            raise ValueError("truncated name")
+        ln = data[pos]
+        if ln & 0xC0 == 0xC0:            # compression pointer
+            if end is None:
+                end = pos + 2
+            pos = ((ln & 0x3F) << 8) | data[pos + 1]
+            jumps += 1
+            if jumps > 32:
+                raise ValueError("compression loop")
+            continue
+        pos += 1
+        if ln == 0:
+            break
+        labels.append(data[pos:pos + ln].decode(errors="replace"))
+        pos += ln
+    return ".".join(labels), (end if end is not None else pos)
+
+
+def parse_query(data: bytes) -> Tuple[int, int, str, int]:
+    """Returns (txn_id, flags, qname_lowercase, qtype); first question
+    only, like the reference handler."""
+    if len(data) < 12:
+        raise ValueError("short packet")
+    txn_id, flags, qd, _, _, _ = struct.unpack(">HHHHHH", data[:12])
+    if qd < 1:
+        raise ValueError("no question")
+    name, off = decode_name(data, 12)
+    qtype, _qclass = struct.unpack(">HH", data[off:off + 4])
+    return txn_id, flags, name.lower(), qtype
+
+
+class RR:
+    def __init__(self, name: str, rtype: int, rdata: bytes, ttl: int = 0):
+        self.name = name
+        self.rtype = rtype
+        self.rdata = rdata
+        self.ttl = ttl
+
+    def pack(self) -> bytes:
+        return encode_name(self.name) + struct.pack(
+            ">HHIH", self.rtype, IN, self.ttl, len(self.rdata)) + self.rdata
+
+
+def a_rdata(ip: str) -> bytes:
+    return socket.inet_aton(ip)
+
+
+def aaaa_rdata(ip6: str) -> bytes:
+    return socket.inet_pton(socket.AF_INET6, ip6)
+
+
+def srv_rdata(priority: int, weight: int, port: int, target: str) -> bytes:
+    return struct.pack(">HHH", priority, weight, port) + encode_name(target)
+
+
+def ptr_rdata(target: str) -> bytes:
+    return encode_name(target)
+
+
+def soa_rdata(mname: str, rname: str, serial: int) -> bytes:
+    return encode_name(mname) + encode_name(rname) + struct.pack(
+        ">IIIII", serial, 3600, 600, 86400, 0)
+
+
+def txt_rdata(text: str) -> bytes:
+    raw = text.encode()[:255]
+    return bytes([len(raw)]) + raw
+
+
+def build_response(txn_id: int, qname: str, qtype: int,
+                   answers: List[RR], authority: List[RR] | None = None,
+                   rcode: int = NOERROR, aa: bool = True,
+                   tc: bool = False, rd: bool = False) -> bytes:
+    flags = 0x8000 | (0x0400 if aa else 0) | (0x0200 if tc else 0) \
+        | (0x0100 if rd else 0) | rcode
+    authority = authority or []
+    head = struct.pack(">HHHHHH", txn_id, flags, 1, len(answers),
+                       len(authority), 0)
+    q = encode_name(qname) + struct.pack(">HH", qtype, IN)
+    body = b"".join(r.pack() for r in answers) + \
+        b"".join(r.pack() for r in authority)
+    return head + q + body
+
+
+# ------------------------------------------------------------- the server
+
+UDP_BUDGET = 512     # pre-EDNS answer budget (dns.go maxUDPAnswerLimit)
+
+
+class DNSServer:
+    """UDP+TCP DNS frontend (agent/dns.go:111).  `query_executor` is an
+    optional hook for <name>.query.<domain> lookups (prepared queries) —
+    returns health-service-shaped rows."""
+
+    def __init__(self, store, oracle=None, node_name: str = "node0",
+                 domain: str = "consul.", host: str = "127.0.0.1",
+                 port: int = 0, only_passing: bool = False,
+                 node_ttl: int = 0, service_ttl: int = 0,
+                 query_executor: Optional[Callable[[str], list]] = None):
+        self.store = store
+        self.oracle = oracle
+        self.node_name = node_name
+        self.domain = domain.rstrip(".").lower()
+        self.only_passing = only_passing
+        self.node_ttl = node_ttl
+        self.service_ttl = service_ttl
+        self.query_executor = query_executor
+
+        outer = self
+
+        class UdpHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                data, sock = self.request
+                resp = outer.handle_packet(data, udp=True)
+                if resp is not None:
+                    sock.sendto(resp, self.client_address)
+
+        class TcpHandler(socketserver.BaseRequestHandler):
+            def handle(self):
+                raw = self.request.recv(2)
+                if len(raw) < 2:
+                    return
+                (ln,) = struct.unpack(">H", raw)
+                data = b""
+                while len(data) < ln:
+                    chunk = self.request.recv(ln - len(data))
+                    if not chunk:
+                        return
+                    data += chunk
+                resp = outer.handle_packet(data, udp=False)
+                if resp is not None:
+                    self.request.sendall(struct.pack(">H", len(resp)) + resp)
+
+        self.udp = socketserver.ThreadingUDPServer((host, port), UdpHandler)
+        self.port = self.udp.server_address[1]
+        self.tcp = socketserver.ThreadingTCPServer(
+            (host, self.port), TcpHandler, bind_and_activate=False)
+        self.tcp.allow_reuse_address = True
+        self.tcp.server_bind()
+        self.tcp.server_activate()
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        for srv in (self.udp, self.tcp):
+            t = threading.Thread(target=srv.serve_forever, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        for srv in (self.udp, self.tcp):
+            srv.shutdown()
+            srv.server_close()
+        for t in self._threads:
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------- dispatch
+
+    def handle_packet(self, data: bytes, udp: bool) -> Optional[bytes]:
+        try:
+            txn_id, flags, qname, qtype = parse_query(data)
+        except ValueError:
+            return None
+        try:
+            answers, rcode = self.resolve(qname, qtype)
+        except Exception:
+            return build_response(0xFFFF & txn_id, qname, qtype, [],
+                                  rcode=SERVFAIL)
+        tc = False
+        if udp and answers:
+            kept = list(answers)
+            while kept and 12 + len(encode_name(qname)) + 4 + sum(
+                    len(r.pack()) for r in kept) > UDP_BUDGET:
+                kept.pop()
+                tc = True
+            answers = kept
+        authority = []
+        if rcode == NXDOMAIN or not answers:
+            authority = [self.soa()]
+        return build_response(txn_id, qname, qtype, answers,
+                              authority=authority, rcode=rcode, tc=tc)
+
+    def soa(self) -> RR:
+        idx = getattr(self.store, "index", 0)
+        return RR(self.domain, SOA,
+                  soa_rdata(f"ns.{self.domain}",
+                            f"hostmaster.{self.domain}", idx))
+
+    # -------------------------------------------------------------- resolve
+
+    def resolve(self, qname: str, qtype: int) -> Tuple[List[RR], int]:
+        """The dispatch tree (agent/dns.go:644)."""
+        name = qname.rstrip(".").lower()
+        if name.endswith(".in-addr.arpa"):
+            return self._ptr(name)
+        if name == self.domain:
+            if qtype in (SOA, ANY):
+                return [self.soa()], NOERROR
+            if qtype == NS:
+                ns = f"ns.{self.domain}"
+                return [RR(self.domain, NS, ptr_rdata(ns))], NOERROR
+            return [], NOERROR
+        if not name.endswith("." + self.domain):
+            return [], REFUSED    # not our zone; no recursors configured
+        rest = name[: -(len(self.domain) + 1)]
+        labels = rest.split(".")
+        # strip optional datacenter label: <...>.<dc>.<domain> — accept and
+        # ignore (single-dc view), mirroring parseDatacenter
+        if len(labels) >= 2 and labels[-1] not in ("node", "service",
+                                                   "query", "addr"):
+            labels = labels[:-1]
+        if len(labels) < 2:
+            return [], NXDOMAIN
+        kind = labels[-1]
+        if kind == "node":
+            return self._node(".".join(labels[:-1]), qtype)
+        if kind == "service":
+            return self._service(labels[:-1], qtype)
+        if kind == "query":
+            return self._query(".".join(labels[:-1]), qtype)
+        if kind == "addr":
+            return self._addr(labels[0], qtype)
+        return [], NXDOMAIN
+
+    # ------------------------------------------------------------- handlers
+
+    def _node_address(self, node: str) -> Optional[str]:
+        rec = next((n for n in self.store.nodes() if n["node"] == node),
+                   None)
+        return rec["address"] if rec else None
+
+    def _node(self, node: str, qtype: int) -> Tuple[List[RR], int]:
+        addr = self._node_address(node)
+        if addr is None:
+            return [], NXDOMAIN
+        fqdn = f"{node}.node.{self.domain}"
+        return self._addr_rrs(fqdn, addr, qtype, self.node_ttl), NOERROR
+
+    def _addr_rrs(self, fqdn: str, addr: str, qtype: int,
+                  ttl: int) -> List[RR]:
+        try:
+            if ":" in addr:
+                if qtype in (AAAA, ANY):
+                    return [RR(fqdn, AAAA, aaaa_rdata(addr), ttl)]
+                return []
+            if qtype in (A, ANY, SRV):
+                return [RR(fqdn, A, a_rdata(addr), ttl)]
+        except OSError:
+            # non-IP address (hostname): answer with TXT like the
+            # reference's CNAME fallback stance for non-IP addresses
+            return [RR(fqdn, TXT, txt_rdata(addr), ttl)]
+        return []
+
+    def _healthy_instances(self, service: str, tag: Optional[str]) -> list:
+        rows = self.store.health_service_nodes(service, tag=tag)
+        out = []
+        for r in rows:
+            statuses = [c["status"] for c in r["checks"]]
+            if any(s == "critical" for s in statuses):
+                continue
+            if self.only_passing and any(s == "warning" for s in statuses):
+                continue
+            out.append(r["service"])
+        return out
+
+    def _rtt_order(self, instances: list) -> list:
+        if self.oracle is not None:
+            try:
+                order = self.oracle.sort_by_rtt(
+                    self.node_name, [s["node"] for s in instances])
+                pos = {n: i for i, n in enumerate(order)}
+                return sorted(instances,
+                              key=lambda s: pos.get(s["node"], 1 << 30))
+            except KeyError:
+                pass
+        instances = list(instances)
+        random.shuffle(instances)
+        return instances
+
+    def _service(self, labels: List[str],
+                 qtype: int) -> Tuple[List[RR], int]:
+        # RFC 2782 form: _<service>._<tag|tcp|udp>
+        if len(labels) == 2 and labels[0].startswith("_") \
+                and labels[1].startswith("_"):
+            service = labels[0][1:]
+            tag = labels[1][1:]
+            if tag in ("tcp", "udp"):
+                tag = None
+            return self._service_records(service, tag, qtype, srv_form=True)
+        # [tag.]<service>
+        service = labels[-1]
+        tag = labels[0] if len(labels) == 2 else None
+        if len(labels) > 2:
+            return [], NXDOMAIN
+        return self._service_records(service, tag, qtype, srv_form=False)
+
+    def _service_records(self, service: str, tag: Optional[str], qtype: int,
+                         srv_form: bool) -> Tuple[List[RR], int]:
+        instances = self._healthy_instances(service, tag)
+        if not instances:
+            return [], NXDOMAIN
+        instances = self._rtt_order(instances)
+        fqdn = f"{service}.service.{self.domain}"
+        out: List[RR] = []
+        if qtype == SRV or (srv_form and qtype == ANY):
+            for s in instances:
+                target = f"{s['node']}.node.{self.domain}"
+                out.append(RR(fqdn, SRV,
+                              srv_rdata(1, 1, s["port"], target),
+                              self.service_ttl))
+            # additional A records ride authority-free in the answer
+            # section for simplicity (the reference puts them in Extra)
+            for s in instances:
+                addr = s["service_address"] or s["address"]
+                out.extend(self._addr_rrs(
+                    f"{s['node']}.node.{self.domain}", addr, A,
+                    self.service_ttl))
+            return out, NOERROR
+        for s in instances:
+            addr = s["service_address"] or s["address"]
+            out.extend(self._addr_rrs(fqdn, addr, qtype, self.service_ttl))
+        return out, NOERROR
+
+    def _query(self, name: str, qtype: int) -> Tuple[List[RR], int]:
+        if self.query_executor is None:
+            return [], NXDOMAIN
+        rows = self.query_executor(name)
+        if rows is None:
+            return [], NXDOMAIN
+        instances = [r["service"] for r in rows]
+        if not instances:
+            return [], NXDOMAIN
+        fqdn = f"{name}.query.{self.domain}"
+        out: List[RR] = []
+        if qtype == SRV:
+            for s in instances:
+                target = f"{s['node']}.node.{self.domain}"
+                out.append(RR(fqdn, SRV,
+                              srv_rdata(1, 1, s["port"], target),
+                              self.service_ttl))
+            return out, NOERROR
+        for s in instances:
+            addr = s["service_address"] or s["address"]
+            out.extend(self._addr_rrs(fqdn, addr, qtype, self.service_ttl))
+        return out, NOERROR
+
+    def _addr(self, hexip: str, qtype: int) -> Tuple[List[RR], int]:
+        """<hex-ip>.addr.<domain> — synthesized names used inside SRV
+        answers for service addresses (dns.go formatNodeRecord)."""
+        try:
+            raw = bytes.fromhex(hexip)
+            addr = socket.inet_ntoa(raw) if len(raw) == 4 else \
+                socket.inet_ntop(socket.AF_INET6, raw)
+        except (ValueError, OSError):
+            return [], NXDOMAIN
+        return self._addr_rrs(f"{hexip}.addr.{self.domain}", addr, qtype,
+                              self.node_ttl), NOERROR
+
+    def _ptr(self, name: str) -> Tuple[List[RR], int]:
+        parts = name.replace(".in-addr.arpa", "").split(".")
+        if len(parts) != 4:
+            return [], NXDOMAIN
+        addr = ".".join(reversed(parts))
+        for n in self.store.nodes():
+            if n["address"] == addr:
+                return [RR(name, PTR,
+                           ptr_rdata(f"{n['node']}.node.{self.domain}"),
+                           self.node_ttl)], NOERROR
+        return [], NXDOMAIN

@@ -169,15 +169,35 @@ class GossipOracle:
                 self._state.coords,
                 jnp.array([ia], jnp.int32), jnp.array([ib], jnp.int32))[0])
 
-    def sort_by_rtt(self, origin: str, names: List[str]) -> List[str]:
-        """?near= ordering (agent/consul/rtt.go:196)."""
-        io = self.node_id(origin)
-        ids = jnp.array([self.node_id(n) for n in names], jnp.int32)
+    def _coords_host(self, max_age: float = 1.0):
+        """Host-side numpy snapshot of the coordinate state, refreshed at
+        most every `max_age` seconds.  Serving paths (DNS ?near sorting,
+        /v1/coordinate) must not pay a device round-trip per request —
+        coordinates drift on gossip timescales, so a ~1s-stale view is
+        well inside Vivaldi's own error."""
+        import time as _time
+        now = _time.monotonic()
+        snap = self.__dict__.get("_coord_snap")
+        if snap is not None and now - snap[0] < max_age:
+            return snap[1]
         with self._lock:
-            d = vivaldi.estimate_rtt(
-                self._state.coords,
-                jnp.full((len(names),), io, jnp.int32), ids)
-        order = np.argsort(np.asarray(d), kind="stable")
+            c = self._state.coords
+            host = (np.asarray(c.coords), np.asarray(c.height),
+                    np.asarray(c.adjustment))
+        self.__dict__["_coord_snap"] = (now, host)
+        return host
+
+    def sort_by_rtt(self, origin: str, names: List[str]) -> List[str]:
+        """?near= ordering (agent/consul/rtt.go:196) — numpy on the cached
+        coordinate snapshot (estimate_rtt semantics, lib/rtt.go:13-43)."""
+        coords, height, adj = self._coords_host()
+        io = self.node_id(origin)
+        ids = np.array([self.node_id(n) for n in names], np.int32)
+        diff = coords[ids] - coords[io]
+        d = np.linalg.norm(diff, axis=-1) + height[ids] + height[io]
+        adjusted = d + adj[ids] + adj[io]
+        dist = np.where(adjusted > 0.0, adjusted, d)
+        order = np.argsort(dist, kind="stable")
         return [names[i] for i in order]
 
     # ---------------------------------------------------------------- events

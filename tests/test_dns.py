@@ -1,0 +1,200 @@
+"""DNS server tests — wire-level queries against a live server, mirroring
+the reference's agent/dns_test.go coverage (node, service, SRV, PTR, SOA,
+NXDOMAIN, truncation)."""
+
+import socket
+import struct
+
+import pytest
+
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.dns import (
+    A, AAAA, ANY, NXDOMAIN, PTR, REFUSED, SOA, SRV, TXT, DNSServer,
+    decode_name, encode_name, parse_query,
+)
+
+
+def encode_query(txn_id: int, name: str, qtype: int) -> bytes:
+    return struct.pack(">HHHHHH", txn_id, 0x0100, 1, 0, 0, 0) + \
+        encode_name(name) + struct.pack(">HH", qtype, 1)
+
+
+def decode_response(data: bytes):
+    txn_id, flags, qd, an, ns, ar = struct.unpack(">HHHHHH", data[:12])
+    off = 12
+    for _ in range(qd):
+        _, off = decode_name(data, off)
+        off += 4
+    answers = []
+    for _ in range(an + ns):
+        name, off = decode_name(data, off)
+        rtype, _cls, ttl, rdlen = struct.unpack(">HHIH", data[off:off + 10])
+        rdata = data[off + 10:off + 10 + rdlen]
+        off += 10 + rdlen
+        answers.append((name, rtype, ttl, rdata))
+    return {"id": txn_id, "flags": flags, "rcode": flags & 0xF,
+            "tc": bool(flags & 0x0200), "an": an, "ns": ns,
+            "records": answers}
+
+
+def udp_ask(port: int, name: str, qtype: int):
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.settimeout(3.0)
+        s.sendto(encode_query(4242, name, qtype), ("127.0.0.1", port))
+        data, _ = s.recvfrom(4096)
+    return decode_response(data)
+
+
+def tcp_ask(port: int, name: str, qtype: int):
+    with socket.create_connection(("127.0.0.1", port), timeout=3.0) as s:
+        q = encode_query(4243, name, qtype)
+        s.sendall(struct.pack(">H", len(q)) + q)
+        (ln,) = struct.unpack(">H", s.recv(2))
+        data = b""
+        while len(data) < ln:
+            data += s.recv(ln - len(data))
+    return decode_response(data)
+
+
+@pytest.fixture(scope="module")
+def dns():
+    st = StateStore()
+    st.register_node("web1", "10.0.0.1")
+    st.register_node("web2", "10.0.0.2")
+    st.register_node("db1", "10.0.0.3")
+    st.register_node("v6node", "fd00::1")
+    st.register_service("web1", "web", "web", port=80, tags=["v1"])
+    st.register_service("web2", "web", "web", port=80, tags=["v2"])
+    st.register_service("db1", "db", "db", port=5432)
+    st.register_check("web1", "svc:web", "c", status="passing",
+                      service_id="web")
+    st.register_check("web2", "svc:web", "c", status="critical",
+                      service_id="web")
+    srv = DNSServer(st, None, node_name="web1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_roundtrip_codec():
+    q = encode_query(7, "web.service.consul", A)
+    txn, flags, name, qtype = parse_query(q)
+    assert (txn, name, qtype) == (7, "web.service.consul", A)
+
+
+def test_node_a_record(dns):
+    r = udp_ask(dns.port, "web1.node.consul", A)
+    assert r["rcode"] == 0 and r["an"] == 1
+    name, rtype, _, rdata = r["records"][0]
+    assert rtype == A and socket.inet_ntoa(rdata) == "10.0.0.1"
+
+
+def test_node_aaaa_record(dns):
+    r = udp_ask(dns.port, "v6node.node.consul", AAAA)
+    assert r["an"] == 1
+    assert socket.inet_ntop(socket.AF_INET6,
+                            r["records"][0][3]) == "fd00::1"
+
+
+def test_node_with_dc_label(dns):
+    r = udp_ask(dns.port, "web1.node.dc1.consul", A)
+    assert r["an"] == 1
+
+
+def test_unknown_node_nxdomain_with_soa(dns):
+    r = udp_ask(dns.port, "ghost.node.consul", A)
+    assert r["rcode"] == NXDOMAIN
+    assert r["ns"] == 1 and r["records"][0][1] == SOA
+
+
+def test_service_filters_critical(dns):
+    r = udp_ask(dns.port, "web.service.consul", A)
+    assert r["an"] == 1     # web2 is critical → only web1
+    assert socket.inet_ntoa(r["records"][0][3]) == "10.0.0.1"
+
+
+def test_service_tag_filter(dns):
+    r = udp_ask(dns.port, "v1.web.service.consul", A)
+    assert r["an"] == 1
+    r = udp_ask(dns.port, "v2.web.service.consul", A)
+    assert r["rcode"] == NXDOMAIN   # v2 instance is critical
+
+
+def test_srv_rfc2782(dns):
+    r = udp_ask(dns.port, "_web._tcp.service.consul", SRV)
+    srvs = [x for x in r["records"] if x[1] == SRV]
+    assert len(srvs) == 1
+    prio, weight, port = struct.unpack(">HHH", srvs[0][3][:6])
+    assert port == 80
+    target, _ = decode_name(srvs[0][3], 6)
+    assert target == "web1.node.consul"
+    # extra A records for targets ride along
+    assert any(x[1] == A for x in r["records"])
+
+
+def test_ptr_lookup(dns):
+    r = udp_ask(dns.port, "3.0.0.10.in-addr.arpa", PTR)
+    assert r["an"] == 1
+    target, _ = decode_name(r["records"][0][3], 0)
+    assert target == "db1.node.consul"
+
+
+def test_soa_and_out_of_zone(dns):
+    r = udp_ask(dns.port, "consul", SOA)
+    assert r["an"] == 1 and r["records"][0][1] == SOA
+    r = udp_ask(dns.port, "example.com", A)
+    assert r["rcode"] == REFUSED
+
+
+def test_tcp_transport(dns):
+    r = tcp_ask(dns.port, "web1.node.consul", A)
+    assert r["an"] == 1
+
+
+def test_udp_truncation():
+    st = StateStore()
+    for i in range(60):
+        st.register_node(f"n{i}", f"10.1.{i // 256}.{i % 256}")
+        st.register_service(f"n{i}", "big", "big", port=8000 + i)
+    srv = DNSServer(st, None, port=0)
+    srv.start()
+    try:
+        r = udp_ask(srv.port, "big.service.consul", A)
+        assert r["tc"], "expected truncation bit on 60-instance answer"
+        assert r["an"] < 60
+        # TCP serves the full set
+        r2 = tcp_ask(srv.port, "big.service.consul", A)
+        assert r2["an"] == 60
+    finally:
+        srv.stop()
+
+
+def test_only_passing_filters_warning():
+    st = StateStore()
+    st.register_node("a", "10.0.0.1")
+    st.register_service("a", "api", "api", port=1)
+    st.register_check("a", "c", "c", status="warning", service_id="api")
+    lax = DNSServer(st, None, port=0)
+    strict = DNSServer(st, None, port=0, only_passing=True)
+    assert len(lax.resolve("api.service.consul", A)[0]) == 1
+    assert strict.resolve("api.service.consul", A)[1] == NXDOMAIN
+
+
+def test_addr_label():
+    st = StateStore()
+    srv = DNSServer(st, None, port=0)
+    rrs, rcode = srv.resolve("0a000001.addr.consul", A)
+    assert rcode == 0 and socket.inet_ntoa(rrs[0].rdata) == "10.0.0.1"
+
+
+def test_agent_wires_dns():
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=2))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        r = udp_ask(a.dns.port, "node0.node.consul", A)
+        assert r["an"] == 1
+    finally:
+        a.stop()
