@@ -50,6 +50,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 from flax import struct
 
 from consul_tpu.config import GossipConfig, SimConfig
@@ -63,7 +64,7 @@ SUSPECT = 1
 DEAD = 2
 LEFT = 3
 
-_NEG = jnp.int32(-1)
+_NEG = _np.int32(-1)  # host-side: keep module import free of backend init
 
 
 @dataclasses.dataclass(frozen=True)
