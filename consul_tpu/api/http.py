@@ -130,10 +130,18 @@ def _make_handler(srv: ApiServer):
         def _err(self, code: int, msg: str):
             self._send(None, code, raw=msg.encode())
 
-        def _block(self, q) -> int:
-            """Honor ?index/?wait before evaluating the read."""
+        def _block(self, q, *watches) -> int:
+            """Honor ?index/?wait before evaluating the read.
+
+            `watches` are (topic, key) specs for prefix-granular wakeups
+            (store.wait_on) — an unrelated write does not wake this query;
+            with no watches it degrades to the coarse any-write wait
+            (blockingQuery, agent/consul/rpc.go:806)."""
             if "index" in q:
                 wait = _parse_wait(q.get("wait", "300s"))
+                if watches:
+                    return store.wait_on(watches, int(q["index"]),
+                                         timeout=wait)
                 return store.wait_for(int(q["index"]), timeout=wait)
             return store.index
 
@@ -451,7 +459,7 @@ def _make_handler(srv: ApiServer):
                 self._send(True)
                 return True
             if path == "/v1/catalog/nodes" and verb == "GET":
-                idx = self._block(q)
+                idx = self._block(q, ("nodes", ""))
                 rows = [{"Node": n["node"], "ID": n["id"],
                          "Address": n["address"], "Meta": n["meta"],
                          "ModifyIndex": n["modify_index"]}
@@ -463,7 +471,7 @@ def _make_handler(srv: ApiServer):
                 self._send(rows, index=idx)
                 return True
             if path == "/v1/catalog/services" and verb == "GET":
-                idx = self._block(q)
+                idx = self._block(q, ("services", ""))
                 self._send({k: v for k, v in store.services().items()
                             if self.authz.service_read(k)}, index=idx)
                 return True
@@ -471,7 +479,8 @@ def _make_handler(srv: ApiServer):
             if m and verb == "GET":
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
-                idx = self._block(q)
+                idx = self._block(q, ("services", m.group(1)),
+                                  ("nodes", ""))
                 rows = store.service_nodes(m.group(1), tag=q.get("tag"))
                 out = [_catalog_service_json(r) for r in rows]
                 if "near" in q:
@@ -481,7 +490,7 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/catalog/node/(.+)", path)
             if m and verb == "GET":
-                idx = self._block(q)
+                idx = self._block(q, ("nodes", m.group(1)))
                 node = m.group(1)
                 nrec = next((n for n in store.nodes() if n["node"] == node),
                             None)
@@ -500,7 +509,8 @@ def _make_handler(srv: ApiServer):
             if m and verb == "GET":
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
-                idx = self._block(q)
+                idx = self._block(q, ("health", m.group(1)),
+                                  ("services", m.group(1)), ("nodes", ""))
                 rows = store.health_service_nodes(
                     m.group(1), tag=q.get("tag"),
                     passing_only="passing" in q)
@@ -512,13 +522,13 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/health/node/(.+)", path)
             if m and verb == "GET":
-                idx = self._block(q)
+                idx = self._block(q, ("nodechecks", m.group(1)))
                 self._send([_check_json(c, c.get("node", m.group(1)))
                             for c in store.node_checks(m.group(1))], index=idx)
                 return True
             m = re.fullmatch(r"/v1/health/state/(.+)", path)
             if m and verb == "GET":
-                idx = self._block(q)
+                idx = self._block(q, ("nodechecks", ""))
                 self._send([_check_json(c, c["node"])
                             for c in store.checks_in_state(m.group(1))],
                            index=idx)
@@ -794,7 +804,10 @@ def _make_handler(srv: ApiServer):
 
         def _kv(self, verb: str, key: str, q) -> bool:
             if verb == "GET":
-                idx = self._block(q)
+                if "recurse" in q or "keys" in q:
+                    idx = self._block(q, ("kv:prefix", key))
+                else:
+                    idx = self._block(q, ("kv", key))
                 if "keys" in q:
                     # list permission filters rather than 403s (aclFilter
                     # semantics, agent/consul/acl_filter)

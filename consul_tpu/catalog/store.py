@@ -28,7 +28,35 @@ from __future__ import annotations
 import threading
 import time
 import uuid
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from consul_tpu.stream.publisher import Event, EventPublisher
+
+# Fine-grained watch fan-in cap: past this many parked blocking queries the
+# store degrades to coarse any-write wakeups, like the reference's 8,192
+# watch-channel limit (agent/consul/state/state_store.go:87-97).
+WATCH_LIMIT = 8192
+
+
+class _Waiter:
+    """One parked blocking query and the (topic, key) set it watches."""
+
+    __slots__ = ("cond", "fired", "watches")
+
+    def __init__(self, lock, watches):
+        self.cond = threading.Condition(lock)
+        self.fired = False
+        self.watches = watches
+
+
+def _watch_matches(watches, topic: str, key: str) -> bool:
+    for wt, wk in watches:
+        if wt == topic:
+            if wk == "" or wk == key:
+                return True
+        elif wt == topic + ":prefix" and key.startswith(wk):
+            return True
+    return False
 
 
 class StateStore:
@@ -36,6 +64,14 @@ class StateStore:
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
         self._index = 0
+        # streaming + fine-grained watches (stream/event_publisher.go:12;
+        # per-index watch channels state_store.go:102-120)
+        self.publisher = EventPublisher()
+        self._waiters: List[_Waiter] = []
+        # topic -> {key -> last commit index}; bounded per-topic scans for
+        # prefix watches (the review's fix for an unbounded flat dict)
+        self._topic_index: Dict[str, Dict[str, int]] = {}
+        self._topic_max: Dict[str, int] = {}                # topic -> idx
         # kv: key -> dict(value, flags, create_index, modify_index, session)
         self._kv: Dict[str, dict] = {}
         self._kv_delete_index: Dict[str, int] = {}  # prefix-bump on deletes
@@ -59,17 +95,58 @@ class StateStore:
         with self._lock:
             return self._index
 
-    def _bump(self) -> int:
+    def _bump(self, events: Sequence[Tuple[str, str]] = ()) -> int:
+        """Advance the commit index, record per-(topic, key) indexes, wake
+        matching fine-grained waiters, and publish stream events.
+
+        `events`: (topic, key) pairs this write touched.  An empty list is a
+        legacy coarse write: it wakes every waiter (conservative)."""
         self._index += 1
+        idx = self._index
+        for topic, key in events:
+            self._topic_index.setdefault(topic, {})[key] = idx
+            if self._topic_max.get(topic, 0) < idx:
+                self._topic_max[topic] = idx
         self._cond.notify_all()
-        return self._index
+        for w in self._waiters:
+            if w.fired:
+                continue
+            if not events or any(_watch_matches(w.watches, t, k)
+                                 for t, k in events):
+                w.fired = True
+                w.cond.notify_all()
+        if events:
+            self.publisher.publish([Event(topic=t, key=k, index=idx)
+                                    for t, k in events])
+        return idx
+
+    def watch_index(self, watches: Sequence[Tuple[str, str]]) -> int:
+        """Highest commit index that touched any of `watches`.
+
+        Watch forms: (topic, key) exact, (topic, "") topic-wide,
+        (topic + ":prefix", prefix) prefix match (KV recurse)."""
+        with self._lock:
+            best = 0
+            for wt, wk in watches:
+                if wk == "" and not wt.endswith(":prefix"):
+                    best = max(best, self._topic_max.get(wt, 0))
+                elif wt.endswith(":prefix"):
+                    topic = wt[: -len(":prefix")]
+                    for k, i in self._topic_index.get(topic, {}).items():
+                        if k.startswith(wk):
+                            best = max(best, i)
+                else:
+                    best = max(best,
+                               self._topic_index.get(wt, {}).get(wk, 0))
+            return best
 
     def wait_for(self, index: Optional[int], timeout: float = 300.0) -> int:
         """Park until the store index exceeds `index` (blocking query).
 
         Returns the current index.  index=None returns immediately.
         Mirrors agent/consul/rpc.go:806 blockingQuery: no spurious early
-        return, wait capped by timeout."""
+        return, wait capped by timeout.  This is the coarse (any-write)
+        wakeup; prefer `wait_on` with watch specs."""
         deadline = time.time() + timeout
         with self._lock:
             if index is None:
@@ -79,6 +156,38 @@ class StateStore:
                 if remaining <= 0:
                     break
                 self._cond.wait(remaining)
+            return self._index
+
+    def wait_on(self, watches: Sequence[Tuple[str, str]],
+                index: Optional[int], timeout: float = 300.0) -> int:
+        """Park until a write touching `watches` lands with index > `index`.
+
+        The prefix-granular blocking query: a KV write does not wake a
+        health watcher.  Falls back to coarse wait past WATCH_LIMIT parked
+        waiters (state_store.go:87-97).  Returns the current store index."""
+        deadline = time.time() + timeout
+        with self._lock:
+            if index is None or not watches:
+                return self._index
+            if self.watch_index(watches) > index:
+                return self._index
+            if len(self._waiters) >= WATCH_LIMIT:
+                while self._index <= index:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                return self._index
+            w = _Waiter(self._lock, list(watches))
+            self._waiters.append(w)
+            try:
+                while not w.fired:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    w.cond.wait(remaining)
+            finally:
+                self._waiters.remove(w)
             return self._index
 
     # -------------------------------------------------------------------- KV
@@ -105,7 +214,7 @@ class StateStore:
             if release is not None:
                 if entry is None or entry.get("session") != release:
                     return False, self._index
-            idx = self._bump()
+            idx = self._bump([("kv", key)])
             if entry is None:
                 entry = {"value": value, "flags": flags, "create_index": idx,
                          "modify_index": idx, "session": None,
@@ -158,7 +267,7 @@ class StateStore:
                     return False, self._index
             if not keys:
                 return True, self._index
-            idx = self._bump()
+            idx = self._bump([("kv", k) for k in keys])
             for k in keys:
                 del self._kv[k]
                 self._kv_delete_index[k] = idx
@@ -170,7 +279,7 @@ class StateStore:
                       node_id: str | None = None) -> int:
         """Catalog.Register node part (agent/consul/catalog_endpoint.go:144)."""
         with self._lock:
-            idx = self._bump()
+            idx = self._bump([("nodes", node)])
             existing = self._nodes.get(node, {})
             self._nodes[node] = {
                 "address": address, "meta": meta or {},
@@ -186,7 +295,8 @@ class StateStore:
         with self._lock:
             if node not in self._nodes:
                 self.register_node(node, address or "127.0.0.1")
-            idx = self._bump()
+            idx = self._bump([("nodes", node), ("services", name),
+                              ("health", name)])
             key = (node, service_id)
             existing = self._services.get(key, {})
             self._services[key] = {
@@ -197,11 +307,26 @@ class StateStore:
             }
             return idx
 
+    def _check_events(self, node: str, service_id: str):
+        """Watch events for a check write: a node-level check touches the
+        health of every service on the node (the reference's health query
+        watches the checks table; health_endpoint.go:174)."""
+        ev = [("nodechecks", node)]
+        if service_id:
+            svc = self._services.get((node, service_id))
+            if svc:
+                ev.append(("health", svc["name"]))
+        else:
+            for (n, _sid), v in self._services.items():
+                if n == node:
+                    ev.append(("health", v["name"]))
+        return ev
+
     def register_check(self, node: str, check_id: str, name: str,
                        status: str = "critical", service_id: str = "",
                        output: str = "") -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump(self._check_events(node, service_id))
             key = (node, check_id)
             existing = self._checks.get(key, {})
             self._checks[key] = {
@@ -218,7 +343,8 @@ class StateStore:
             key = (node, check_id)
             if key not in self._checks:
                 raise KeyError(f"unknown check {key}")
-            idx = self._bump()
+            idx = self._bump(self._check_events(
+                node, self._checks[key]["service_id"]))
             self._checks[key]["status"] = status
             self._checks[key]["output"] = output
             self._checks[key]["modify_index"] = idx
@@ -228,7 +354,11 @@ class StateStore:
         """Full node deregistration cascades services/checks/sessions/locks
         (leader reconcile path, agent/consul/leader.go:1332)."""
         with self._lock:
-            idx = self._bump()
+            ev = [("nodes", node), ("nodechecks", node)]
+            for (n, _sid), v in self._services.items():
+                if n == node:
+                    ev += [("services", v["name"]), ("health", v["name"])]
+            idx = self._bump(ev)
             self._nodes.pop(node, None)
             for key in [k for k in self._services if k[0] == node]:
                 del self._services[key]
@@ -241,13 +371,19 @@ class StateStore:
 
     def deregister_check(self, node: str, check_id: str) -> int:
         with self._lock:
-            idx = self._bump()
+            chk = self._checks.get((node, check_id))
+            idx = self._bump(self._check_events(
+                node, chk["service_id"] if chk else ""))
             self._checks.pop((node, check_id), None)
             return idx
 
     def deregister_service(self, node: str, service_id: str) -> int:
         with self._lock:
-            idx = self._bump()
+            svc = self._services.get((node, service_id))
+            ev = [("nodes", node)]
+            if svc:
+                ev += [("services", svc["name"]), ("health", svc["name"])]
+            idx = self._bump(ev)
             self._services.pop((node, service_id), None)
             for key in [k for k, c in self._checks.items()
                         if k[0] == node and c["service_id"] == service_id]:
@@ -334,7 +470,7 @@ class StateStore:
             if node not in self._nodes:
                 raise KeyError(f"unknown node {node}")
             sid = sid or str(uuid.uuid4())
-            idx = self._bump()
+            idx = self._bump([("sessions", sid)])
             self._sessions[sid] = {
                 "node": node, "ttl": ttl, "behavior": behavior,
                 "lock_delay": lock_delay, "checks": checks or ["serfHealth"],
@@ -396,7 +532,9 @@ class StateStore:
         sess = self._sessions.pop(sid, None)
         if sess is None:
             return
-        idx = self._bump()
+        idx = self._bump([("sessions", sid)] +
+                         [("kv", k) for k, e in self._kv.items()
+                          if e.get("session") == sid])
         delay = sess.get("lock_delay", 0.0)
         for key, entry in list(self._kv.items()):
             if entry.get("session") == sid:
@@ -420,7 +558,7 @@ class StateStore:
                           if v["name"] == name and p != pid), None)
             if clash:
                 raise ValueError(f"policy name {name!r} already in use")
-            idx = self._bump()
+            idx = self._bump([("acl", f"policy:{pid}")])
             existing = self._acl_policies.get(pid, {})
             self._acl_policies[pid] = {
                 "name": name, "rules": rules, "description": description,
@@ -451,7 +589,7 @@ class StateStore:
         with self._lock:
             if pid not in self._acl_policies:
                 return self._index
-            idx = self._bump()
+            idx = self._bump([("acl", f"policy:{pid}")])
             name = self._acl_policies[pid]["name"]
             del self._acl_policies[pid]
             # strip links by id AND by name — a dangling name link would
@@ -466,7 +604,7 @@ class StateStore:
                       description: str = "", token_type: str = "client",
                       local: bool = False) -> int:
         with self._lock:
-            idx = self._bump()
+            idx = self._bump([("acl", f"token:{accessor}")])
             existing = self._acl_tokens.get(accessor, {})
             self._acl_tokens[accessor] = {
                 "secret": secret, "policies": policies or [],
@@ -498,7 +636,7 @@ class StateStore:
         with self._lock:
             if accessor not in self._acl_tokens:
                 return self._index
-            idx = self._bump()
+            idx = self._bump([("acl", f"token:{accessor}")])
             del self._acl_tokens[accessor]
             return idx
 

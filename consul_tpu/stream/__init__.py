@@ -7,6 +7,8 @@ subscribe endpoint (agent/rpc/subscribe/, proto/pbsubscribe/subscribe.proto).
 """
 
 from consul_tpu.stream.publisher import (  # noqa: F401
-    Event, EventPublisher, SnapshotFunc, Subscription, TOPIC_HEALTH,
-    TOPIC_KV, TOPIC_CATALOG,
+    Event, EventPublisher, SnapshotRequired, Subscription,
+    TOPIC_KV, TOPIC_SERVICE_HEALTH, TOPIC_CATALOG_NODES,
+    TOPIC_CATALOG_SERVICES, TOPIC_SESSIONS, TOPIC_ACL, TOPIC_INTENTIONS,
+    TOPIC_CONFIG, TOPIC_COORDINATES, TOPIC_QUERIES, TOPIC_CA,
 )

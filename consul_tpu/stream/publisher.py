@@ -1,0 +1,188 @@
+"""EventPublisher: topic-keyed event fan-out with snapshot-then-follow.
+
+The server side of the reference's streaming read path
+(agent/consul/stream/event_publisher.go:12 EventPublisher;
+stream/subscription.go:32 Subscription; wiring agent/consul/server.go:637-645).
+Store commits publish typed events onto topics; subscribers get a snapshot
+of current state followed by the live event stream from the snapshot index,
+so a materialized view (consul_tpu/submatview.py) can serve blocking reads
+without re-running the full query per wakeup.
+
+Design differences from the reference (deliberate, host-side Python):
+  * topics are (topic, key) pairs — e.g. ("health", "web") — matching how
+    the reference scopes Subscribe requests by Topic+Key
+    (proto/pbsubscribe/subscribe.proto:14,34);
+  * the per-topic buffer is a bounded deque of (index, events) batches; a
+    subscriber that falls off the tail gets a NewSnapshotToFollow-style
+    reset, like the reference's snapshot cache eviction;
+  * no gRPC framing — in-process subscriptions are iterators; the HTTP
+    layer exposes them as long-polls and the RPC layer as streamed frames.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+# Topic names (reference pbsubscribe topics + the memdb tables that feed
+# blocking queries; state/schema.go:10).
+TOPIC_KV = "kv"
+TOPIC_SERVICE_HEALTH = "health"        # key = service name
+TOPIC_CATALOG_NODES = "nodes"          # key = node name ("" = any)
+TOPIC_CATALOG_SERVICES = "services"    # key = service name ("" = any)
+TOPIC_SESSIONS = "sessions"
+TOPIC_ACL = "acl"
+TOPIC_INTENTIONS = "intentions"
+TOPIC_CONFIG = "config"                # config entries
+TOPIC_COORDINATES = "coordinates"
+TOPIC_QUERIES = "queries"              # prepared queries
+TOPIC_CA = "ca"                        # connect CA roots/leaf rotation
+
+
+@dataclass(frozen=True)
+class Event:
+    """One state-change event (stream/event_publisher.go Event shape)."""
+
+    topic: str
+    key: str
+    index: int
+    payload: Any = None
+    op: str = "update"          # update | delete | snapshot-end
+
+
+class SnapshotRequired(Exception):
+    """Raised to a follower that fell off the buffer tail: re-snapshot.
+
+    Mirrors the reference's NewSnapshotToFollow reset frame
+    (stream/subscription.go forceClose on buffer eviction)."""
+
+
+@dataclass
+class _Sub:
+    topic: str
+    key: Optional[str]                 # None = all keys on the topic
+    next_index: int
+    cond: threading.Condition
+    closed: bool = False
+    queue: deque = field(default_factory=deque)
+
+
+class Subscription:
+    """Iterator over events for one (topic, key) from a start index.
+
+    `events(timeout)` blocks for the next batch; raises SnapshotRequired
+    if the publisher evicted history the subscriber still needed."""
+
+    def __init__(self, pub: "EventPublisher", sub: _Sub):
+        self._pub = pub
+        self._sub = sub
+
+    def events(self, timeout: float = 300.0) -> List[Event]:
+        s = self._sub
+        with s.cond:
+            if not s.queue and not s.closed:
+                s.cond.wait(timeout)
+            if s.closed:
+                raise SnapshotRequired("subscription reset")
+            out: List[Event] = []
+            while s.queue:
+                out.extend(s.queue.popleft())
+            return out
+
+    def close(self) -> None:
+        self._pub.unsubscribe(self)
+
+    def __iter__(self) -> Iterator[List[Event]]:
+        while True:
+            batch = self.events()
+            if batch:
+                yield batch
+
+
+class EventPublisher:
+    """Topic buffers + subscriber registry (event_publisher.go:12).
+
+    Thread-safe.  `publish` is called under the store's write path with the
+    commit index; delivery to subscriber queues is synchronous (queues are
+    unbounded, consumers drain them on their own threads)."""
+
+    def __init__(self, buffer_len: int = 1024):
+        self._lock = threading.Lock()
+        self._buffer_len = buffer_len
+        # topic -> deque[(index, [Event])]
+        self._buffers: Dict[str, deque] = {}
+        # topic -> highest index evicted off the buffer tail (0 = nothing
+        # evicted): the explicit loss marker subscribe() checks against —
+        # inferring loss from the oldest buffered batch would misread
+        # cross-topic index gaps as eviction
+        self._evicted_through: Dict[str, int] = {}
+        self._subs: List[_Sub] = []
+
+    # ----------------------------------------------------------- publishing
+
+    def publish(self, events: List[Event]) -> None:
+        if not events:
+            return
+        by_topic: Dict[str, List[Event]] = {}
+        for e in events:
+            by_topic.setdefault(e.topic, []).append(e)
+        with self._lock:
+            for topic, evs in by_topic.items():
+                buf = self._buffers.setdefault(
+                    topic, deque(maxlen=self._buffer_len))
+                if len(buf) == self._buffer_len:
+                    self._evicted_through[topic] = buf[0][0]
+                buf.append((evs[0].index, evs))
+            subs = list(self._subs)
+        for s in subs:
+            mine = [e for e in by_topic.get(s.topic, ())
+                    if s.key is None or e.key == s.key]
+            if not mine:
+                continue
+            with s.cond:
+                s.queue.append(mine)
+                s.cond.notify_all()
+
+    # --------------------------------------------------------- subscription
+
+    def subscribe(self, topic: str, key: Optional[str] = None,
+                  since_index: int = 0) -> Subscription:
+        """Follow `topic` (optionally one key) from `since_index`.
+
+        Replays buffered batches newer than since_index; raises
+        SnapshotRequired if the buffer no longer reaches back that far
+        (caller must take a fresh snapshot and resubscribe)."""
+        sub = _Sub(topic=topic, key=key, next_index=since_index,
+                   cond=threading.Condition())
+        with self._lock:
+            buf = self._buffers.get(topic, ())
+            evicted = self._evicted_through.get(topic, 0)
+            if since_index < evicted:
+                raise SnapshotRequired(
+                    f"events through {evicted} evicted, need {since_index}")
+            replay = [[e for e in evs if key is None or e.key == key]
+                      for idx, evs in buf if idx > since_index]
+            replay = [b for b in replay if b]
+            for b in replay:
+                sub.queue.append(b)
+            self._subs.append(sub)
+        return Subscription(self, sub)
+
+    def unsubscribe(self, subscription: Subscription) -> None:
+        s = subscription._sub
+        with self._lock:
+            if s in self._subs:
+                self._subs.remove(s)
+        with s.cond:
+            s.closed = True
+            s.cond.notify_all()
+
+    def close_all(self) -> None:
+        with self._lock:
+            subs, self._subs = self._subs, []
+        for s in subs:
+            with s.cond:
+                s.closed = True
+                s.cond.notify_all()

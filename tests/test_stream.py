@@ -1,0 +1,222 @@
+"""Event streaming + fine-grained blocking-query wakeups.
+
+Covers the round-2 VERDICT item #3: EventPublisher (reference
+agent/consul/stream/event_publisher.go:12), store commit → topic events,
+and prefix-granular watch channels with the 8,192-watch coarse fallback
+(agent/consul/state/state_store.go:87-97).  The headline assertion: a KV
+write does NOT wake a health watcher.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.catalog.store import StateStore
+import consul_tpu.catalog.store as store_mod
+from consul_tpu.stream import Event, EventPublisher, SnapshotRequired
+
+
+# ---------------------------------------------------------------- publisher
+
+def test_publish_subscribe_roundtrip():
+    pub = EventPublisher()
+    sub = pub.subscribe("health", key="web")
+    pub.publish([Event(topic="health", key="web", index=5)])
+    evs = sub.events(timeout=2.0)
+    assert [e.index for e in evs] == [5]
+    assert evs[0].topic == "health" and evs[0].key == "web"
+
+
+def test_subscribe_key_filtering():
+    pub = EventPublisher()
+    sub = pub.subscribe("health", key="web")
+    pub.publish([Event(topic="health", key="db", index=3)])
+    pub.publish([Event(topic="kv", key="web", index=4)])
+    pub.publish([Event(topic="health", key="web", index=6)])
+    evs = sub.events(timeout=2.0)
+    assert [e.index for e in evs] == [6]
+
+
+def test_subscribe_replays_buffered_history():
+    pub = EventPublisher()
+    pub.publish([Event(topic="kv", key="a", index=1)])
+    pub.publish([Event(topic="kv", key="b", index=2)])
+    sub = pub.subscribe("kv", since_index=1)
+    evs = sub.events(timeout=2.0)
+    assert [e.key for e in evs] == ["b"]
+
+
+def test_subscribe_past_buffer_raises_snapshot_required():
+    pub = EventPublisher(buffer_len=4)
+    for i in range(1, 11):
+        pub.publish([Event(topic="kv", key=f"k{i}", index=i)])
+    with pytest.raises(SnapshotRequired):
+        pub.subscribe("kv", since_index=2)
+
+
+def test_unsubscribe_wakes_blocked_reader():
+    pub = EventPublisher()
+    sub = pub.subscribe("kv")
+    got = []
+
+    def reader():
+        try:
+            sub.events(timeout=10.0)
+        except SnapshotRequired:
+            got.append("reset")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.1)
+    sub.close()
+    t.join(timeout=2.0)
+    assert got == ["reset"]
+
+
+# ------------------------------------------------- store commit → events
+
+def test_store_commits_publish_topic_events():
+    st = StateStore()
+    sub = st.publisher.subscribe("health", key="web")
+    kv_sub = st.publisher.subscribe("kv")
+    st.register_service("n1", "web1", "web", port=80)
+    st.register_check("n1", "c1", "web check", status="passing",
+                      service_id="web1")
+    st.kv_set("cfg/a", b"1")
+    health_evs = sub.events(timeout=2.0)
+    assert all(e.topic == "health" and e.key == "web" for e in health_evs)
+    kv_evs = kv_sub.events(timeout=2.0)
+    assert [e.key for e in kv_evs] == ["cfg/a"]
+
+
+# ------------------------------------------- fine-grained blocking queries
+
+def _park(store, watches, index, timeout, out):
+    t0 = time.time()
+    got = store.wait_on(watches, index, timeout=timeout)
+    out.append((got, time.time() - t0))
+
+
+def test_kv_write_does_not_wake_health_watcher():
+    """THE criterion from VERDICT r1 #3."""
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    idx = st.index
+    out = []
+    t = threading.Thread(target=_park,
+                         args=(st, [("health", "web")], idx, 0.8, out))
+    t.start()
+    time.sleep(0.1)
+    st.kv_set("unrelated", b"x")          # must NOT wake the watcher
+    t.join(timeout=3.0)
+    got, took = out[0]
+    assert took >= 0.7, f"health watcher woke early ({took:.2f}s) on KV write"
+
+
+def test_health_watcher_wakes_on_own_service_check():
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    st.register_service("n2", "db1", "db", port=5432)
+    st.register_check("n1", "c1", "web check", status="passing",
+                      service_id="web1")
+    idx = st.index
+    out = []
+    t = threading.Thread(target=_park,
+                         args=(st, [("health", "web")], idx, 5.0, out))
+    t.start()
+    time.sleep(0.1)
+    st.update_check("n1", "c1", "critical")
+    t.join(timeout=3.0)
+    got, took = out[0]
+    assert took < 2.0, "health watcher did not wake on its own check update"
+    assert got > idx
+
+
+def test_other_service_check_does_not_wake_watcher():
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    st.register_service("n2", "db1", "db", port=5432)
+    st.register_check("n2", "c2", "db check", status="passing",
+                      service_id="db1")
+    idx = st.index
+    out = []
+    t = threading.Thread(target=_park,
+                         args=(st, [("health", "web")], idx, 0.8, out))
+    t.start()
+    time.sleep(0.1)
+    st.update_check("n2", "c2", "critical")   # db health — unrelated
+    t.join(timeout=3.0)
+    got, took = out[0]
+    assert took >= 0.7, "web health watcher woke on db check update"
+
+
+def test_node_level_check_wakes_all_service_watchers_on_node():
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    st.register_check("n1", "serfHealth", "serf", status="passing")
+    idx = st.index
+    out = []
+    t = threading.Thread(target=_park,
+                         args=(st, [("health", "web")], idx, 5.0, out))
+    t.start()
+    time.sleep(0.1)
+    st.update_check("n1", "serfHealth", "critical")
+    t.join(timeout=3.0)
+    got, took = out[0]
+    assert took < 2.0, "node-level check did not wake service health watcher"
+
+
+def test_kv_prefix_watch():
+    st = StateStore()
+    st.kv_set("app/x", b"1")
+    idx = st.index
+    out = []
+    t = threading.Thread(target=_park,
+                         args=(st, [("kv:prefix", "app/")], idx, 5.0, out))
+    t.start()
+    time.sleep(0.1)
+    st.kv_set("other/y", b"2")            # outside prefix: no wake
+    time.sleep(0.2)
+    assert not out
+    st.kv_set("app/z", b"3")              # inside prefix: wake
+    t.join(timeout=3.0)
+    got, took = out[0]
+    assert took < 2.0
+
+
+def test_wait_on_returns_immediately_when_already_past_index():
+    st = StateStore()
+    st.kv_set("a", b"1")
+    idx0 = st.index
+    st.kv_set("a", b"2")
+    t0 = time.time()
+    got = st.wait_on([("kv", "a")], idx0, timeout=5.0)
+    assert time.time() - t0 < 0.5
+    assert got > idx0
+
+
+def test_watch_limit_coarse_fallback(monkeypatch):
+    """Past WATCH_LIMIT parked queries, any write wakes (coarse mode)."""
+    monkeypatch.setattr(store_mod, "WATCH_LIMIT", 1)
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    idx = st.index
+    out1, out2 = [], []
+    t1 = threading.Thread(target=_park,
+                          args=(st, [("health", "web")], idx, 5.0, out1))
+    t1.start()
+    time.sleep(0.1)
+    # second waiter exceeds the limit -> coarse: any write wakes it
+    t2 = threading.Thread(target=_park,
+                          args=(st, [("health", "web")], idx, 5.0, out2))
+    t2.start()
+    time.sleep(0.1)
+    st.kv_set("unrelated", b"x")
+    t2.join(timeout=3.0)
+    assert out2 and out2[0][1] < 2.0, "coarse-fallback waiter did not wake"
+    # fine-grained waiter still parked; wake it properly
+    st.register_check("n1", "c1", "chk", status="critical",
+                      service_id="web1")
+    t1.join(timeout=3.0)
+    assert out1 and out1[0][1] < 5.0

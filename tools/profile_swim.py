@@ -1,0 +1,97 @@
+"""Per-phase profiling harness for the north-star bench (VERDICT r1 #2).
+
+Times each component of the 1M-node serf tick on the attached device and
+prints a JSON report: ticks/sec for dissemination-only ticks, probe ticks,
+the convergence monitor, the events layer, and the Vivaldi solver — so
+optimization is not flying blind.
+
+Usage: python tools/profile_swim.py [N] [reps]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import events, serf, swim, vivaldi
+
+
+def timeit(fn, *args, reps=20):
+    out = fn(*args)          # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=n, rumor_slots=32,
+                                        alloc_cap=8, p_loss=0.01, seed=7))
+    s = serf.init_state(params)
+    # steady state with one in-flight rumor + one probe round behind us
+    s = s.replace(swim=swim.kill(s.swim, 7))
+    warm = jax.jit(lambda st: serf.run(params, st, 12, 7)[0])
+    s = jax.block_until_ready(warm(s))
+
+    sw = s.swim
+    report = {"n_nodes": n, "reps": reps}
+
+    # full serf step (what the bench loops over), w/ and w/o monitor
+    full = jax.jit(lambda st: serf.step(params, st))
+    report["serf_step_s"] = timeit(full, s, reps=reps)
+
+    monitor = jax.jit(
+        lambda st: swim.believed_down_fraction(params.swim, st, 7))
+    report["monitor_s"] = timeit(monitor, sw, reps=reps)
+
+    # swim phases. step tick: sw.tick may or may not be a probe tick — pin it.
+    ppt = params.swim.probe_period_ticks
+    sw_probe = sw.replace(tick=(sw.tick // ppt) * ppt)
+    sw_off = sw.replace(tick=(sw.tick // ppt) * ppt + 1)
+    swim_step = jax.jit(lambda st: swim.step(params.swim, st))
+    report["swim_step_probe_tick_s"] = timeit(swim_step, sw_probe, reps=reps)
+    report["swim_step_gossip_tick_s"] = timeit(swim_step, sw_off, reps=reps)
+
+    dissem = jax.jit(lambda st: swim._disseminate(params.swim, st))
+    report["swim_disseminate_s"] = timeit(dissem, sw, reps=reps)
+
+    probe = jax.jit(lambda st: swim._probe_round(params.swim, st)[0])
+    report["swim_probe_round_s"] = timeit(probe, sw_probe, reps=reps)
+
+    expiry = jax.jit(lambda st: swim._suspicion_expiry(params.swim, st))
+    report["swim_suspicion_expiry_s"] = timeit(expiry, sw_probe, reps=reps)
+
+    refute = jax.jit(lambda st: swim._refutation(params.swim, st))
+    report["swim_refutation_s"] = timeit(refute, sw_probe, reps=reps)
+
+    # events layer (idle: no active events — the common case)
+    ev_step = jax.jit(lambda st: events.step(params.events, st,
+                                             up=sw.up, member=sw.member))
+    report["events_step_idle_s"] = timeit(ev_step, s.events, reps=reps)
+
+    # vivaldi observe with a full mask (probe tick) — worst case
+    key = jax.random.PRNGKey(0)
+    dst = jax.random.randint(key, (n,), 0, n, dtype=jnp.int32)
+    rtt = jnp.ones((n,), jnp.float32) * 0.01
+    viv = jax.jit(lambda st: vivaldi.observe(params.vivaldi, st, None,
+                                             dst, rtt))
+    report["vivaldi_observe_s"] = timeit(viv, s.coords, reps=reps)
+
+    # derived summary
+    per_tick = report["serf_step_s"] + report["monitor_s"]
+    report["bench_ticks_per_s_est"] = round(1.0 / per_tick, 1)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
