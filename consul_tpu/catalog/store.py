@@ -72,6 +72,11 @@ class StateStore:
         # prefix watches (the review's fix for an unbounded flat dict)
         self._topic_index: Dict[str, Dict[str, int]] = {}
         self._topic_max: Dict[str, int] = {}                # topic -> idx
+        # compaction floor: when a topic's per-key map is compacted, keys
+        # dropped resolve to this index (conservative — may cause a
+        # spurious immediate return, never a missed wakeup).  This is the
+        # tombstone-GC analogue (reference state/graveyard.go).
+        self._topic_floor: Dict[str, int] = {}
         # kv: key -> dict(value, flags, create_index, modify_index, session)
         self._kv: Dict[str, dict] = {}
         self._kv_delete_index: Dict[str, int] = {}  # prefix-bump on deletes
@@ -104,9 +109,17 @@ class StateStore:
         self._index += 1
         idx = self._index
         for topic, key in events:
-            self._topic_index.setdefault(topic, {})[key] = idx
+            tmap = self._topic_index.setdefault(topic, {})
+            tmap[key] = idx
             if self._topic_max.get(topic, 0) < idx:
                 self._topic_max[topic] = idx
+            if len(tmap) > 65536:
+                # drop the older half; dropped keys resolve to the floor
+                cut = sorted(tmap.values())[len(tmap) // 2]
+                self._topic_floor[topic] = max(
+                    self._topic_floor.get(topic, 0), cut)
+                self._topic_index[topic] = {
+                    k: i for k, i in tmap.items() if i > cut}
         self._cond.notify_all()
         for w in self._waiters:
             if w.fired:
@@ -132,12 +145,14 @@ class StateStore:
                     best = max(best, self._topic_max.get(wt, 0))
                 elif wt.endswith(":prefix"):
                     topic = wt[: -len(":prefix")]
+                    best = max(best, self._topic_floor.get(topic, 0))
                     for k, i in self._topic_index.get(topic, {}).items():
                         if k.startswith(wk):
                             best = max(best, i)
                 else:
                     best = max(best,
-                               self._topic_index.get(wt, {}).get(wk, 0))
+                               self._topic_index.get(wt, {}).get(
+                                   wk, self._topic_floor.get(wt, 0)))
             return best
 
     def wait_for(self, index: Optional[int], timeout: float = 300.0) -> int:
@@ -149,7 +164,7 @@ class StateStore:
         wakeup; prefer `wait_on` with watch specs."""
         deadline = time.time() + timeout
         with self._lock:
-            if index is None:
+            if index is None or index <= 0:
                 return self._index
             while self._index <= index:
                 remaining = deadline - time.time()
@@ -167,7 +182,9 @@ class StateStore:
         waiters (state_store.go:87-97).  Returns the current store index."""
         deadline = time.time() + timeout
         with self._lock:
-            if index is None or not watches:
+            # index<=0 is non-blocking by contract (X-Consul-Index starts
+            # at 1; blockingQuery treats MinQueryIndex 0 as immediate)
+            if index is None or index <= 0 or not watches:
                 return self._index
             if self.watch_index(watches) > index:
                 return self._index

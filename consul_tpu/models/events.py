@@ -8,7 +8,9 @@ orders them by Lamport time).  Rebuilt as tensors:
   * a per-node Lamport clock [N] advanced on send and on first delivery;
   * an event table of E in-flight events (name/payload ids, origin ltime);
   * a [N, E] knowledge matrix riding the shared gossip kernel
-    (ops/gossip.py) — same infection dynamics as membership rumors;
+    (ops/gossip.py, ring-shift peer exchange) — same infection dynamics as
+    membership rumors; the whole tick is skipped via lax.cond when no
+    event is in flight (the common case — saves the full [N, E] pass);
   * a per-node dedup/delivery ring: events are "delivered" the tick they
     are first learned; `deliveries` counts per event reach the oracle can
     expose (the HTTP event-fire/list API reads from this — api/event.py).
@@ -29,6 +31,7 @@ from flax import struct
 
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.ops import gossip as gossip_ops
+from consul_tpu.ops import rolls
 from consul_tpu.utils import prng
 
 
@@ -82,7 +85,7 @@ def init_state(params: EventParams) -> EventState:
         e_start=jnp.zeros((e,), jnp.int32),
         know=jnp.zeros((n, e), bool),
         deliver_tick=jnp.full((n, e), -1, jnp.int32),
-        sends_left=jnp.zeros((n, e), jnp.int32),
+        sends_left=jnp.zeros((n, e), jnp.int8),
     )
 
 
@@ -115,7 +118,9 @@ def fire(params: EventParams, s: EventState, origin: int | jnp.ndarray,
         deliver_tick=jnp.where(onehot[None, :],
                                jnp.where(cell, s.tick, -1), s.deliver_tick),
         sends_left=jnp.where(onehot[None, :],
-                             jnp.where(cell, params.retransmit_limit, 0),
+                             jnp.where(cell, jnp.int8(min(
+                                 params.retransmit_limit, 127)),
+                                 jnp.int8(0)),
                              s.sends_left),
     )
 
@@ -123,28 +128,35 @@ def fire(params: EventParams, s: EventState, origin: int | jnp.ndarray,
 def step(params: EventParams, s: EventState, up: jnp.ndarray,
          member: jnp.ndarray) -> EventState:
     """One gossip tick of event dissemination; `up`/`member` come from the
-    membership model so events only flow between live members."""
+    membership model so events only flow between live members.  Skipped
+    entirely (tick bump only) when no event is in flight."""
     n = params.n_nodes
-    key = prng.tick_key(params.seed, s.tick, 3)
-    targets = prng.other_nodes(key, n, (n, params.gossip_nodes))
-    res = gossip_ops.disseminate(targets, s.know, s.sends_left,
-                                 sender_ok=up, receiver_ok=up & member,
-                                 slot_active=s.e_active,
-                                 retransmit_limit=params.retransmit_limit)
-    deliver_tick = jnp.where(res.newly, s.tick, s.deliver_tick)
-    # Lamport witness: clock jumps past the max ltime delivered this tick
-    seen = jnp.where(res.newly, s.e_ltime[None, :], 0)
-    lamport = jnp.maximum(s.lamport, jnp.max(seen, axis=1))
 
-    done = s.e_active & (s.tick - s.e_start >= params.expiry_ticks)
-    return s.replace(
-        tick=s.tick + 1,
-        lamport=lamport,
-        e_active=s.e_active & ~done,
-        know=res.know & ~done[None, :],
-        deliver_tick=deliver_tick,
-        sends_left=jnp.where(done[None, :], 0, res.sends_left),
-    )
+    def active_branch(s):
+        key = prng.tick_key(params.seed, s.tick, 3)
+        offs = rolls.offsets(key, n, params.gossip_nodes)
+        res = gossip_ops.disseminate(offs, s.know, s.sends_left,
+                                     sender_ok=up, receiver_ok=up & member,
+                                     slot_active=s.e_active,
+                                     retransmit_limit=min(
+                                         params.retransmit_limit, 127))
+        deliver_tick = jnp.where(res.newly, s.tick, s.deliver_tick)
+        # Lamport witness: clock jumps past the max ltime delivered this tick
+        seen = jnp.where(res.newly, s.e_ltime[None, :], 0)
+        lamport = jnp.maximum(s.lamport, jnp.max(seen, axis=1))
+
+        done = s.e_active & (s.tick - s.e_start >= params.expiry_ticks)
+        return s.replace(
+            tick=s.tick + 1,
+            lamport=lamport,
+            e_active=s.e_active & ~done,
+            know=res.know & ~done[None, :],
+            deliver_tick=deliver_tick,
+            sends_left=jnp.where(done[None, :], jnp.int8(0), res.sends_left),
+        )
+
+    return jax.lax.cond(jnp.any(s.e_active), active_branch,
+                        lambda s: s.replace(tick=s.tick + 1), s)
 
 
 def coverage(params: EventParams, s: EventState, slot: int,

@@ -63,10 +63,19 @@ def init_state(params: SerfParams, key=None) -> ClusterState:
 
 
 def step(params: SerfParams, s: ClusterState) -> ClusterState:
-    """One gossip tick of the full serf pool (jit this)."""
+    """One gossip tick of the full serf pool (jit this).
+
+    The coordinate solver only has observations on probe ticks (acked ring
+    probes carry RTT samples); the whole Vivaldi update is gated out on
+    gossip-only ticks via lax.cond."""
+    do_probe = (s.swim.tick % params.swim.probe_period_ticks) == 0
     sw, obs = swim.step_with_obs(params.swim, s.swim)
-    coords = vivaldi.observe(params.vivaldi, s.coords, None, obs.target,
-                             obs.rtt_ms / 1000.0, mask=obs.acked)
+    coords = jax.lax.cond(
+        do_probe,
+        lambda c: vivaldi.observe_ring(params.vivaldi, c, obs.shift,
+                                       obs.rtt_ms / 1000.0, obs.acked),
+        lambda c: c,
+        s.coords)
     ev = events.step(params.events, s.events, up=sw.up, member=sw.member)
     return ClusterState(swim=sw, coords=coords, events=ev)
 

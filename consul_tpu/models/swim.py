@@ -18,26 +18,32 @@ Design (SURVEY.md §7): instead of N goroutines with per-node O(N) views
 One jitted `step(params, state)` advances every node one gossip tick:
 
   probe round (every probe_interval/gossip_interval ticks)
-    → random direct probe, k indirect probes, timeouts sampled from a
+    → ring probe at a shared random offset (memberlist walks a shuffled
+      ring for probe targets; the shift keeps exactly that one-prober-per-
+      subject-per-round structure while avoiding TPU gathers — ops/rolls.py)
+    → k indirect probes through ring relays, timeouts sampled from a
       factored coordinate RTT model (no N×N matrix)
     → failed probes originate/confirm `suspect` rumors (Lifeguard timer
       shortened by independent confirmations)
   suspicion expiry → first expiring holder originates a `dead` rumor
   refutation      → a live suspect bumps its incarnation, originates `alive`
-  dissemination   → every carrier gossips its queued rumors to
-      `gossip_nodes` random targets: 3 scatter-max ops over the [N, U]
+  dissemination   → every carrier serves its queued rumors to ring peers at
+      `gossip_nodes` random offsets: rotation ops over the [N, U]
       knowledge matrix (the SpMV of SURVEY.md §2.1)
   expiry          → fully-retransmitted rumors free their slot; `dead`/`left`
       commit to the O(N) ground-truth belief baseline
 
 All shapes are static; control flow is `lax.cond`/`lax.scan`; randomness is
-counter-based (seed, tick, stream).  The node axis shards over a
+counter-based (seed, tick, stream).  Per-node work avoids 1M-index gathers
+and scatters entirely: peer exchange is ring rotation, and all rumor-table
+lookups are one-hot compares over the tiny U axis (measured 90x faster
+than the gather formulation at N=1M on v5e).  The node axis shards over a
 `jax.sharding.Mesh` — see consul_tpu/parallel/mesh.py.
 
 Known simplifications vs memberlist (documented, to refine):
-  * probe/gossip targets are uniform over all slots rather than a shuffled
-    ring over live-believed members (negligible until a large fraction of
-    the cluster is down);
+  * probe/gossip peers are ring neighbors at shared random offsets rather
+    than per-node-independent uniform draws (same expected fanout, same
+    exponential spread; memberlist's own probe order is a shuffled ring);
   * a rumor's payload always fits the packet (U is small);
   * `dead` is terminal per subject — no rejoin-with-higher-incarnation yet.
 """
@@ -55,6 +61,7 @@ from flax import struct
 
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.ops import gossip as gossip_ops
+from consul_tpu.ops import rolls
 from consul_tpu.utils import prng
 
 # Rumor kinds (serf member lifecycle, consumed by the reference's leader
@@ -91,7 +98,8 @@ class SwimParams:
 
 def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
     n = sim.n_nodes
-    limit = gossip.retransmit_limit(n)
+    # int8 retransmit budget: the log-scaled limit is ~28 at 1M nodes
+    limit = min(gossip.retransmit_limit(n), 127)
     # A rumor is fully disseminated within ~O(log N) gossip ticks; keep the
     # slot a few multiples of that so stragglers (lossy links) still hear it.
     spread = max(8, 4 * math.ceil(math.log2(n + 1)))
@@ -141,7 +149,7 @@ class SwimState:
     # --- per (node, rumor) ---
     know: jnp.ndarray            # [N, U] bool
     learn_tick: jnp.ndarray      # [N, U] int32
-    sends_left: jnp.ndarray      # [N, U] int32
+    sends_left: jnp.ndarray      # [N, U] int8
 
 
 def init_state(params: SwimParams, key=None) -> SwimState:
@@ -166,18 +174,19 @@ def init_state(params: SwimParams, key=None) -> SwimState:
         r_confirm=jnp.zeros((u,), jnp.int32),
         know=jnp.zeros((n, u), bool),
         learn_tick=jnp.zeros((n, u), jnp.int32),
-        sends_left=jnp.zeros((n, u), jnp.int32),
+        sends_left=jnp.zeros((n, u), jnp.int8),
     )
 
 
 # ---------------------------------------------------------------------------
-# derived per-subject maps
+# derived per-subject maps + small-table lookups
 # ---------------------------------------------------------------------------
 
 def _subject_map(params: SwimParams, s: SwimState, kind: int, values) -> jnp.ndarray:
     """Scatter rumor-table `values` into a dense [N] subject-indexed map.
 
     Inactive/other-kind slots write -1; result is -1 where no rumor exists.
+    (A [U]-index scatter — U is tiny, this is cheap.)
     """
     mask = s.r_active & (s.r_kind == kind)
     subj = jnp.where(mask, s.r_subject, 0)
@@ -209,6 +218,14 @@ def _row_gather(mat: jnp.ndarray, cols: jnp.ndarray):
     return jnp.sum(jnp.where(onehot, mat, 0), axis=1)
 
 
+def _table_lookup(vec_u: jnp.ndarray, cols: jnp.ndarray):
+    """vec_u[cols] for a tiny [U] table and [N] cols — one-hot compare,
+    no gather.  cols=-1 yields 0."""
+    u = vec_u.shape[0]
+    onehot = cols[:, None] == jnp.arange(u, dtype=jnp.int32)[None, :]
+    return jnp.sum(jnp.where(onehot, vec_u[None, :], 0), axis=1)
+
+
 def _suspicion_timeout_ticks(params: SwimParams, confirm: jnp.ndarray) -> jnp.ndarray:
     """Lifeguard: timer decays from max to min as confirmations arrive.
 
@@ -225,9 +242,10 @@ def _suspicion_timeout_ticks(params: SwimParams, confirm: jnp.ndarray) -> jnp.nd
 # belief queries (used by probe target filtering and by metrics)
 # ---------------------------------------------------------------------------
 
-def _believes_down_of(params: SwimParams, s: SwimState, maps, subj: jnp.ndarray,
-                      tick: jnp.ndarray) -> jnp.ndarray:
-    """[N] bool: does node i believe node subj[i] is dead or left?
+def _believes_down_shift(params: SwimParams, s: SwimState, maps,
+                         shift, tick: jnp.ndarray) -> jnp.ndarray:
+    """[N] bool: does node i believe its ring peer (i + shift) % N is dead
+    or left?  All subject-side lookups are rotations (no gathers).
 
     A node believes a subject down when it (a) is committed dead/left,
     (b) knows a dead/left rumor for it, or (c) holds an expired, unrefuted
@@ -236,33 +254,51 @@ def _believes_down_of(params: SwimParams, s: SwimState, maps, subj: jnp.ndarray,
     """
     suspect_of, dead_of, left_of, alive_val = maps
     u = params.rumor_slots
-    down = s.committed_dead[subj] | s.committed_left[subj]
-    down |= _row_gather(s.know, dead_of[subj])
-    down |= _row_gather(s.know, left_of[subj])
+    down = rolls.pull(s.committed_dead | s.committed_left, shift)
+    down |= _row_gather(s.know, rolls.pull(dead_of, shift))
+    down |= _row_gather(s.know, rolls.pull(left_of, shift))
     # expired unrefuted suspicion
-    ss = suspect_of[subj]
+    ss = rolls.pull(suspect_of, shift)
     know_s = _row_gather(s.know, ss)
     learn = _row_gather(s.learn_tick, ss)
-    conf = s.r_confirm[jnp.clip(ss, 0, u - 1)]
+    conf = _table_lookup(s.r_confirm, ss)
     expired = know_s & (tick - learn >= _suspicion_timeout_ticks(params, conf))
-    av = alive_val[subj]
-    a_slot = jnp.where(av >= 0, av % u, 0)
+    av = rolls.pull(alive_val, shift)
+    a_slot = jnp.where(av >= 0, av % u, -1)
     a_inc = jnp.where(av >= 0, av // u, -1)
-    s_inc = s.r_inc[jnp.clip(ss, 0, u - 1)]
-    refuted = (av >= 0) & (a_inc > s_inc) & _row_gather(s.know, jnp.where(av >= 0, a_slot, _NEG))
-    refuted |= s_inc < s.committed_inc[subj]
+    s_inc = _table_lookup(s.r_inc, ss)
+    refuted = (av >= 0) & (a_inc > s_inc) & _row_gather(s.know, a_slot)
+    refuted |= s_inc < rolls.pull(s.committed_inc, shift)
     down |= expired & ~refuted
     return down
 
 
 def believed_down_fraction(params: SwimParams, s: SwimState, subject: int) -> jnp.ndarray:
     """Fraction of live members (excluding the subject) that believe `subject`
-    is down.  The convergence metric for the north-star benchmark."""
-    n = params.n_nodes
-    subj = jnp.full((n,), subject, jnp.int32)
-    down = _believes_down_of(params, s, _maps(params, s), subj, s.tick)
+    is down.  The convergence metric for the north-star benchmark.
+
+    Single-subject formulation: rumor-table masks over the tiny U axis —
+    no [N] subject maps, no gathers (this runs inside the bench scan)."""
+    n, u = params.n_nodes, params.rumor_slots
+    is_dl = s.r_active & ((s.r_kind == DEAD) | (s.r_kind == LEFT)) \
+        & (s.r_subject == subject)
+    is_s = s.r_active & (s.r_kind == SUSPECT) & (s.r_subject == subject)
+    is_a = s.r_active & (s.r_kind == ALIVE) & (s.r_subject == subject)
+
+    down = s.committed_dead[subject] | s.committed_left[subject]   # scalar
+    down_i = jnp.any(s.know & is_dl[None, :], axis=1) | down       # [N]
+
+    # expired, unrefuted suspicion
+    timeout = _suspicion_timeout_ticks(params, s.r_confirm)        # [U]
+    age_ok = (s.tick - s.learn_tick) >= timeout[None, :]           # [N, U]
+    a_inc_known = jnp.max(
+        jnp.where(is_a[None, :] & s.know, s.r_inc[None, :], -1), axis=1)  # [N]
+    refuted = (a_inc_known[:, None] > s.r_inc[None, :]) \
+        | (s.r_inc[None, :] < s.committed_inc[subject])            # [N, U]
+    down_i |= jnp.any(s.know & is_s[None, :] & age_ok & ~refuted, axis=1)
+
     observer = s.up & s.member & (jnp.arange(n) != subject)
-    return jnp.sum(down & observer) / jnp.maximum(jnp.sum(observer), 1)
+    return jnp.sum(down_i & observer) / jnp.maximum(jnp.sum(observer), 1)
 
 
 # ---------------------------------------------------------------------------
@@ -276,13 +312,13 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
 
     `inc_of_subject`: [N] int32 incarnation to record per subject.
     `row_subject`: [N] int32 — the subject node i originates/knows a rumor
-    about at birth (-1 = none).  All table updates are [U]-space scatters
-    and the knowledge seeding is ONE [N, U] one-hot comparison (this runs
-    inside the per-tick hot loop at N=1M).
+    about at birth (-1 = none).  All table updates are [U]-space scatters;
+    knowledge seeding matches row subjects against the <=alloc_cap freshly
+    allocated subjects with an [N, A] compare (no [N]-index gathers — this
+    runs inside the per-tick hot loop at N=1M).
     """
     a = params.alloc_cap
     u = params.rumor_slots
-    n = params.n_nodes
     score, subjects = jax.lax.top_k(want_score, a)
     free_score, slots = jax.lax.top_k(jnp.where(s.r_active, 0, 1) *
                                       (u - jnp.arange(u, dtype=jnp.int32)), a)
@@ -296,16 +332,17 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
     r_start = s.r_start.at[oob].set(s.tick, mode="drop")
     r_confirm = s.r_confirm.at[oob].set(1, mode="drop")
 
-    # subject -> allocated slot map, then one one-hot seed of the knowers
-    alloc_map = jnp.full((n,), -1, jnp.int32).at[
-        jnp.where(ok, subjects, 0)].max(jnp.where(ok, slots, -1))
-    slot_row = jnp.where(row_subject >= 0,
-                         alloc_map[jnp.clip(row_subject, 0, n - 1)], -1)
+    # row i knows the rumor whose subject matches row_subject[i]: compare
+    # against the A allocated (subject, slot) pairs, then one-hot the slot
+    match_subj = jnp.where(ok, subjects, -2)                   # [A]
+    match = row_subject[:, None] == match_subj[None, :]        # [N, A]
+    slot_row = jnp.max(jnp.where(match, slots[None, :], -1), axis=1)  # [N]
     cell = (slot_row[:, None] == jnp.arange(u)[None, :]) \
         & (slot_row >= 0)[:, None]
     know = s.know | cell
     learn_tick = jnp.where(cell, s.tick, s.learn_tick)
-    sends_left = jnp.where(cell, params.retransmit_limit, s.sends_left)
+    sends_left = jnp.where(cell, jnp.int8(params.retransmit_limit),
+                           s.sends_left)
     return s.replace(r_active=r_active, r_kind=r_kind, r_subject=r_subject,
                      r_inc=r_inc, r_start=r_start, r_confirm=r_confirm,
                      know=know, learn_tick=learn_tick, sends_left=sends_left)
@@ -319,68 +356,74 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
 class ProbeObs:
     """Per-node probe measurements from one probe round; acked direct probes
     carry an RTT sample (the serf coordinate client updates on every probe
-    ack — reference agent/agent.go:1629)."""
+    ack — reference agent/agent.go:1629).  The probe target of node i is
+    its ring peer (i + shift) % N."""
 
-    target: jnp.ndarray   # [N] int32
+    shift: jnp.ndarray    # int32 scalar ring offset (0 = no probe round)
     rtt_ms: jnp.ndarray   # [N] float32
     acked: jnp.ndarray    # [N] bool (direct ack — RTT sample is meaningful)
 
 
 def _empty_obs(params: SwimParams) -> ProbeObs:
     n = params.n_nodes
-    return ProbeObs(target=jnp.zeros((n,), jnp.int32),
+    return ProbeObs(shift=jnp.int32(0),
                     rtt_ms=jnp.ones((n,), jnp.float32),
                     acked=jnp.zeros((n,), bool))
 
 
 def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]:
-    """One SWIM probe round: direct probe + k indirect probes + suspicion.
+    """One SWIM probe round: ring probe + k indirect probes + suspicion.
 
     Reference behavior: memberlist probe loop (probe_interval /
-    probe_timeout / indirect_checks — options.mdx:1509-1532).
+    probe_timeout / indirect_checks — options.mdx:1509-1532); probe order
+    is memberlist's shuffled ring, realized as a shared random offset.
     """
     n = params.n_nodes
     tick = s.tick
     kt = prng.tick_key(params.seed, tick, 1)
-    k_target, k_direct, k_relay, k_leg, k_rtt = jax.random.split(kt, 5)
+    k_off, k_direct, k_leg, k_rtt = jax.random.split(kt, 4)
+    offs = rolls.offsets(k_off, n, 1 + params.indirect_checks)
+    d = offs[0]
 
     maps = _maps(params, s)
     prober = s.up & s.member
-    target = prng.other_nodes(k_target, n, (n,))
-    skip = _believes_down_of(params, s, maps, target, tick)
-    t_up = s.up[target] & s.member[target]
+    live = s.up & s.member
+    skip = _believes_down_shift(params, s, maps, d, tick)
+    t_up = rolls.pull(live, d)
 
     # direct probe: two UDP legs + RTT under probe_timeout
-    rtt = jnp.linalg.norm(s.coords - s.coords[target], axis=-1) + params.rtt_base_ms
+    rtt = jnp.linalg.norm(s.coords - rolls.pull(s.coords, d), axis=-1) \
+        + params.rtt_base_ms
     rtt = rtt * (1.0 + jax.random.exponential(k_rtt, (n,)) * 0.1)
     legs_ok = jax.random.bernoulli(k_direct, (1.0 - params.p_loss) ** 2, (n,))
-    ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
+    direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
 
-    # k indirect probes through random relays (4 UDP legs each)
-    relays = prng.other_nodes(k_relay, n, (n, params.indirect_checks))
-    relay_ok = s.up[relays] & s.member[relays]
+    # k indirect probes through ring relays (4 UDP legs each)
+    relay_ok = jnp.stack([rolls.pull(live, offs[1 + k])
+                          for k in range(params.indirect_checks)], axis=-1)
     legs4 = jax.random.bernoulli(k_leg, (1.0 - params.p_loss) ** 4,
                                  (n, params.indirect_checks))
-    ack |= (t_up & jnp.any(relay_ok & legs4, axis=-1))
+    ack = direct_ack | (t_up & jnp.any(relay_ok & legs4, axis=-1))
 
     failed = prober & ~skip & ~ack
-    # per-subject count of this round's new suspectors
-    cnt = jnp.zeros((n,), jnp.int32).at[jnp.where(failed, target, 0)].add(
-        failed.astype(jnp.int32))
-    suspect_of, dead_of, left_of, _ = _maps(params, s)
+    # per-subject suspector count: the shift is a bijection — exactly one
+    # prober per subject per round (cnt in {0,1}), like memberlist's ring
+    cnt = rolls.push(failed, d).astype(jnp.int32)
+    suspect_of, dead_of, left_of, _ = maps
 
     # (a) confirm existing suspicions (Lifeguard): each independent suspector
     # this round shortens the timer; they also start carrying the rumor.
     r_confirm = s.r_confirm + jnp.where(
         s.r_active & (s.r_kind == SUSPECT), jnp.minimum(cnt[s.r_subject], 8), 0)
     r_confirm = jnp.minimum(r_confirm, 64)
-    es = suspect_of[target]                                     # [N] existing slot
+    es = rolls.pull(suspect_of, d)                              # [N] existing slot
     joiner = failed & (es >= 0)
-    cell = (jnp.clip(es, 0, params.rumor_slots - 1)[:, None] ==
-            jnp.arange(params.rumor_slots)[None, :]) & joiner[:, None]
+    cell = (es[:, None] == jnp.arange(params.rumor_slots)[None, :]) \
+        & joiner[:, None]
     know = s.know | cell
     learn_tick = jnp.where(cell & ~s.know, tick, s.learn_tick)
-    sends_left = jnp.where(cell & ~s.know, params.retransmit_limit, s.sends_left)
+    sends_left = jnp.where(cell & ~s.know,
+                           jnp.int8(params.retransmit_limit), s.sends_left)
     s = s.replace(r_confirm=r_confirm, know=know, learn_tick=learn_tick,
                   sends_left=sends_left)
 
@@ -389,10 +432,10 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
         & ~s.committed_dead & ~s.committed_left
     want = jnp.where(fresh, cnt, 0)
 
+    target = (jnp.arange(n, dtype=jnp.int32) + d) % n
     row_subject = jnp.where(failed, target, -1)
     s = _originate(params, s, want, SUSPECT, s.incarnation, row_subject)
-    direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
-    obs = ProbeObs(target=target, rtt_ms=2.0 * rtt,
+    obs = ProbeObs(shift=d, rtt_ms=2.0 * rtt,
                    acked=prober & ~skip & direct_ack)
     return s, obs
 
@@ -407,12 +450,17 @@ def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
     timeout = _suspicion_timeout_ticks(params, s.r_confirm)      # [U]
     age = tick - s.learn_tick                                    # [N, U]
     # refutation: an alive rumor for the same subject with higher incarnation
-    _, _, _, alive_val = _maps(params, s)
+    maps = _maps(params, s)
+    _, _, _, alive_val = maps
     av = alive_val[s.r_subject]                                  # [U]
     a_slot = jnp.where(av >= 0, av % u, 0)
     a_inc = jnp.where(av >= 0, av // u, -1)
     refutable = (av >= 0) & (a_inc > s.r_inc)                    # [U]
-    know_alive = jnp.take(s.know, a_slot, axis=1)                # [N, U]
+    # know[:, a_slot[j]] for each slot j — [U,U] one-hot through the MXU
+    # (a minor-axis take with traced indices serializes on TPU)
+    col_onehot = (jnp.arange(u)[:, None] == a_slot[None, :])     # [U, U]
+    know_alive = jnp.einsum("nu,uv->nv", s.know.astype(jnp.int32),
+                            col_onehot.astype(jnp.int32)) > 0    # [N, U]
     refuted = refutable[None, :] & know_alive
     refuted |= (s.r_inc < s.committed_inc[s.r_subject])[None, :]
     observer = (s.up & s.member)[:, None]
@@ -420,7 +468,7 @@ def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
         & ~refuted & observer                                    # [N, U]
     any_exp = jnp.any(expired, axis=0)                           # [U]
 
-    suspect_of, dead_of, left_of, _ = _maps(params, s)
+    suspect_of, dead_of, left_of, _ = maps
     subj_exp = jnp.zeros((n,), bool).at[jnp.where(any_exp, s.r_subject, 0)].max(any_exp)
     fresh = subj_exp & (dead_of < 0) & ~s.committed_dead
     want = jnp.where(fresh, 1, 0)
@@ -430,13 +478,15 @@ def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
     # picked up by dissemination a tick later)
     first_slot = jnp.argmax(expired, axis=1)                     # [N]
     has_exp = jnp.any(expired, axis=1)
-    row_subject = jnp.where(has_exp, s.r_subject[first_slot], -1)
+    row_subject = jnp.where(has_exp, _table_lookup(s.r_subject, first_slot),
+                            -1)
     return _originate(params, s, want, DEAD, s.incarnation, row_subject)
 
 
 def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     """A live subject that hears it is suspected bumps its incarnation and
-    broadcasts alive (SWIM refutation; memberlist aliveNode)."""
+    broadcasts alive (SWIM refutation; memberlist aliveNode).  All index
+    work here is [U]-space (tiny)."""
     u = params.rumor_slots
     is_suspect = s.r_active & (s.r_kind == SUSPECT)
     subj = s.r_subject
@@ -454,41 +504,40 @@ def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     refresh_slot = jnp.where(need & has_alive, alive_val[subj] % u, -1)  # [U]
     refresh = jnp.zeros((u,), bool).at[jnp.clip(refresh_slot, 0, u - 1)].max(refresh_slot >= 0)
     new_inc_of = s.incarnation                                    # [N]
-    if True:  # refresh existing alive slots
-        tgt_subj = s.r_subject                                    # [U]
-        r_inc = jnp.where(refresh, new_inc_of[tgt_subj], s.r_inc)
-        r_start = jnp.where(refresh, s.tick, s.r_start)
-        onehot_subj = (jnp.arange(params.n_nodes)[:, None] == tgt_subj[None, :])
-        cell_keep = ~refresh[None, :] & s.know
-        cell_new = refresh[None, :] & onehot_subj
-        know = cell_keep | cell_new
-        learn_tick = jnp.where(cell_new, s.tick, s.learn_tick)
-        sends_left = jnp.where(cell_new, params.retransmit_limit,
-                               jnp.where(refresh[None, :], 0, s.sends_left))
-        s = s.replace(r_inc=r_inc, r_start=r_start, know=know,
-                      learn_tick=learn_tick, sends_left=sends_left)
+    tgt_subj = s.r_subject                                        # [U]
+    r_inc = jnp.where(refresh, new_inc_of[tgt_subj], s.r_inc)
+    r_start = jnp.where(refresh, s.tick, s.r_start)
+    onehot_subj = (jnp.arange(params.n_nodes)[:, None] == tgt_subj[None, :])
+    cell_keep = ~refresh[None, :] & s.know
+    cell_new = refresh[None, :] & onehot_subj
+    know = cell_keep | cell_new
+    learn_tick = jnp.where(cell_new, s.tick, s.learn_tick)
+    sends_left = jnp.where(cell_new, jnp.int8(params.retransmit_limit),
+                           jnp.where(refresh[None, :], jnp.int8(0),
+                                     s.sends_left))
+    s = s.replace(r_inc=r_inc, r_start=r_start, know=know,
+                  learn_tick=learn_tick, sends_left=sends_left)
 
     # allocate alive rumors for refuting subjects with no existing alive slot
     want = jnp.zeros((params.n_nodes,), jnp.int32).at[
         jnp.where(need & ~has_alive, subj, 0)].max(
         jnp.where(need & ~has_alive, 1, 0))
-    row_subject = jnp.where(want[jnp.arange(params.n_nodes)] > 0,
-                            jnp.arange(params.n_nodes), -1)
+    row_subject = jnp.where(want > 0, jnp.arange(params.n_nodes), -1)
     return _originate(params, s, want, ALIVE, s.incarnation, row_subject)
 
 
 def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
-    """Piggyback gossip: every live carrier with budget sends its queued
-    rumors to `gossip_nodes` random targets (memberlist gossip interval /
-    gossip_nodes — options.mdx:1498-1508).  Three scatter-max ops."""
+    """Piggyback gossip: every live carrier with budget serves its queued
+    rumors to ring peers at `gossip_nodes` random offsets (memberlist
+    gossip interval / gossip_nodes — options.mdx:1498-1508)."""
     n = params.n_nodes
     tick = s.tick
     key = prng.tick_key(params.seed, tick, 2)
-    targets = prng.other_nodes(key, n, (n, params.gossip_nodes))
+    offs = rolls.offsets(key, n, params.gossip_nodes)
     # Senders need only be up (a gracefully-left node keeps gossiping its
     # leave intent — serf LeavePropagateDelay, lib/serf/serf.go:26-30);
     # receivers must be live members.
-    res = gossip_ops.disseminate(targets, s.know, s.sends_left,
+    res = gossip_ops.disseminate(offs, s.know, s.sends_left,
                                  sender_ok=s.up,
                                  receiver_ok=s.up & s.member,
                                  slot_active=s.r_active,
@@ -523,7 +572,7 @@ def _expire(params: SwimParams, s: SwimState) -> SwimState:
         committed_left=committed_left,
         committed_inc=committed_inc,
         know=s.know & keep[None, :],
-        sends_left=jnp.where(keep[None, :], s.sends_left, 0),
+        sends_left=jnp.where(keep[None, :], s.sends_left, jnp.int8(0)),
     )
 
 
@@ -535,7 +584,7 @@ def step_with_obs(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs
     (probe round, suspicion expiry, refutation, rumor expiry) runs on probe
     ticks only — timers quantize to the probe interval (≤0.8 s at LAN
     defaults), which is inside memberlist's own timer jitter, and the
-    off-tick work drops to the three gossip scatters."""
+    off-tick work drops to the gossip rotations."""
     do_probe = (s.tick % params.probe_period_ticks) == 0
 
     def probe_branch(st):

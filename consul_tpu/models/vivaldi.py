@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
+from consul_tpu.ops import rolls
 from consul_tpu.utils import prng
 
 
@@ -147,6 +148,57 @@ def observe(params: VivaldiParams, s: VivaldiState, src: jnp.ndarray | None,
     else:
         new_col = old_col.at[src].set(
             jnp.where(m, (rtt - dist) / 2.0, old_col[src]))
+    adj_window = jax.lax.dynamic_update_slice_in_dim(
+        s.adj_window, new_col[:, None], col, axis=1)
+    adjustment = jnp.mean(adj_window, axis=1)
+
+    return VivaldiState(coords=coords, height=height, error=error,
+                        adj_window=adj_window, adj_index=s.adj_index + 1,
+                        adjustment=adjustment)
+
+
+def observe_ring(params: VivaldiParams, s: VivaldiState, shift,
+                 rtt: jnp.ndarray, mask: jnp.ndarray) -> VivaldiState:
+    """Row-aligned `observe` where node i's peer is (i + shift) % N — the
+    SWIM ring-probe coupling (models/swim.py ProbeObs.shift).  All peer
+    lookups are rotations; no gathers, no scatters (hot-loop path)."""
+    n = s.coords.shape[0]
+    rtt = jnp.maximum(rtt, 1.0e-6)
+    ci, hi, ei = s.coords, s.height, s.error
+    cj = rolls.pull(s.coords, shift)
+    hj = rolls.pull(s.height, shift)
+    ej = rolls.pull(s.error, shift)
+
+    diff = ci - cj
+    norm = jnp.linalg.norm(diff, axis=-1)
+    dist = norm + hi + hj
+
+    w = ei / jnp.maximum(ei + ej, 1.0e-9)
+    err_sample = jnp.abs(dist - rtt) / rtt
+    new_err = err_sample * params.vivaldi_ce * w + ei * (1.0 - params.vivaldi_ce * w)
+    new_err = jnp.clip(new_err, 1.0e-6, params.vivaldi_error_max)
+
+    key = prng.tick_key(params.seed, s.adj_index, 7)
+    rand_dir = jax.random.normal(key, ci.shape, jnp.float32)
+    unit = jnp.where((norm > 1.0e-9)[:, None], diff / jnp.maximum(norm, 1.0e-9)[:, None],
+                     rand_dir / jnp.linalg.norm(rand_dir, axis=-1, keepdims=True))
+    force = params.vivaldi_cc * w * (rtt - dist)
+    new_ci = ci + unit * force[:, None]
+    new_hi = jnp.maximum(hi + (hi / jnp.maximum(dist, 1.0e-9)) * force,
+                         params.height_min)
+
+    m = mask
+    coords = jnp.where(m[:, None], new_ci, s.coords)
+    height = jnp.where(m, new_hi, s.height)
+    error = jnp.where(m, new_err, s.error)
+
+    norms = jnp.linalg.norm(coords, axis=-1, keepdims=True)
+    grav = (norms / params.gravity_rho) ** 2
+    coords = coords * jnp.maximum(1.0 - grav, 0.0)
+
+    col = (s.adj_index % params.adjustment_window).astype(jnp.int32)
+    old_col = jax.lax.dynamic_slice_in_dim(s.adj_window, col, 1, axis=1)[:, 0]
+    new_col = jnp.where(m, (rtt - dist) / 2.0, old_col)
     adj_window = jax.lax.dynamic_update_slice_in_dim(
         s.adj_window, new_col[:, None], col, axis=1)
     adjustment = jnp.mean(adj_window, axis=1)

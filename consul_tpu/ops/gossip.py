@@ -1,20 +1,22 @@
 """Infection-style dissemination — the shared gossip kernel.
 
-One gossip tick: every live node samples `fanout` random peers and copies
-their queued item masks into its own [N, S] knowledge row.  This is the
-SpMV at the heart of both membership rumors (models/swim.py) and user
-events (models/events.py) — the TPU equivalent of memberlist's piggybacked
-UDP gossip (reference tuning agent/config/default.go:70-84:
+One gossip tick: every live node pulls the queued item masks of `fanout`
+ring peers at per-tick random offsets into its own [N, S] knowledge row.
+This is the SpMV at the heart of both membership rumors (models/swim.py)
+and user events (models/events.py) — the TPU equivalent of memberlist's
+piggybacked UDP gossip (reference tuning agent/config/default.go:70-84:
 gossip_interval / gossip_nodes; retransmit queue lib/serf/serf.go:20-24).
 
 TPU-first formulation: memberlist *pushes* (sender picks targets), which
-tensorizes as a scatter with colliding row indices — slow on TPU.  Here
-receivers *pull* from `fanout` sampled sources, which tensorizes as row
-gathers (MXU/VPU-friendly, no collisions).  Push and pull epidemics have
-the same expected per-tick fanout and the same exponential spread rate
-(newly infected ≈ fanout·I for I ≪ N on both), and pull converges faster
-in the endgame; the serving budget below reproduces push's bounded
-per-node transmission count (retransmit_mult·ceil(log10 n) packets).
+tensorizes as a scatter with colliding row indices, and a uniform random
+peer per node tensorizes as a 1M-index gather — both serialize on TPU
+(measured ~180 ms/tick at N=1M).  Here receivers pull from `fanout` ring
+peers at shared random offsets (ops/rolls.py): the exchange is a memory
+rotation (sequential HBM traffic; `ppermute` over a sharded node axis),
+with the same exponential spread rate as uniform gossip — the infected
+set unions `fanout` random-shifted copies of itself per tick — and the
+serving budget reproduces push's bounded per-node transmission count
+(retransmit_mult·ceil(log10 n) packets).
 """
 
 from __future__ import annotations
@@ -23,34 +25,39 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
+from consul_tpu.ops import rolls
+
 
 class GossipResult(NamedTuple):
     know: jnp.ndarray        # [N, S] bool
-    sends_left: jnp.ndarray  # [N, S] int32
+    sends_left: jnp.ndarray  # [N, S] int8
     newly: jnp.ndarray       # [N, S] bool — learned this tick
 
 
-def disseminate(sources: jnp.ndarray, know: jnp.ndarray,
+def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
                 sends_left: jnp.ndarray, sender_ok: jnp.ndarray,
                 receiver_ok: jnp.ndarray, slot_active: jnp.ndarray,
                 retransmit_limit: int) -> GossipResult:
     """One fanout round.
 
-    sources: [N, G] int32 — peers each node pulls from this tick;
-    sender_ok/receiver_ok: [N] bool; slot_active: [S] bool.
+    offsets: [G] int32 ring offsets shared by all nodes this tick (node i
+    pulls from (i + offsets[g]) % N); sender_ok/receiver_ok: [N] bool;
+    slot_active: [S] bool.
     """
-    fanout = sources.shape[1]
+    fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
-    got = serve[sources[:, 0]]
+    got = rolls.pull(serve, offsets[0])
     for g in range(1, fanout):
-        got = got | serve[sources[:, g]]
+        got = got | rolls.pull(serve, offsets[g])
     received = got & receiver_ok[:, None] & slot_active[None, :]
     newly = received & ~know
     new_know = know | newly
     # serving budget: a carrier burns `fanout` transmissions per tick while
     # queued, matching the push formulation's packet accounting
-    new_sends = jnp.where(newly, retransmit_limit,
+    limit = jnp.int8(retransmit_limit)
+    new_sends = jnp.where(newly, limit,
                           jnp.where(serve,
-                                    jnp.maximum(sends_left - fanout, 0),
+                                    jnp.maximum(sends_left - jnp.int8(fanout),
+                                                jnp.int8(0)),
                                     sends_left))
     return GossipResult(know=new_know, sends_left=new_sends, newly=newly)

@@ -1,0 +1,46 @@
+"""Ring-shift peer exchange — the TPU-native gather replacement.
+
+A uniform random peer per node (`x[targets]`, 1M random rows) lowers to a
+serialized TPU gather: measured ~180 ms/tick at N=1M, 90x off the HBM
+bandwidth bound.  Instead every node exchanges with its ring neighbor at a
+per-tick random offset d: source(i) = (i + d) mod N, so the whole exchange
+is one memory rotation (`roll`) — sequential HBM traffic on one chip and a
+`ppermute` collective over a sharded node axis on a mesh.
+
+Fidelity: memberlist itself walks a shuffled ring for probe targets (each
+node probed ~once per round); shift-exchange keeps exactly that structure
+(offset d is a bijection: every node probes once and is probed once per
+round).  For dissemination, the infected set grows as the union of
+`fanout` random-shifted copies of itself — the same exponential rate as
+uniform push/pull gossip until saturation, completing coverage in
+O(log N) rounds whp because each tick draws fresh offsets.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def offsets(key, n: int, k: int) -> jnp.ndarray:
+    """k nonzero ring offsets shared by all nodes this tick ([k] int32)."""
+    return jax.random.randint(key, (k,), 1, n, dtype=jnp.int32)
+
+
+def pull(mat: jnp.ndarray, d) -> jnp.ndarray:
+    """Row view from each node's ring peer: out[i] = mat[(i + d) % N].
+
+    `d` may be traced.  Lowers to two dynamic slices over a doubled
+    buffer — sequential HBM traffic, no gather."""
+    n = mat.shape[0]
+    d = jnp.asarray(d, jnp.int32) % n
+    doubled = jnp.concatenate([mat, mat], axis=0)
+    return jax.lax.dynamic_slice_in_dim(doubled, d, n, axis=0)
+
+
+def push(mat: jnp.ndarray, d) -> jnp.ndarray:
+    """Inverse view: out[j] = mat[(j - d) % N] — what node j receives when
+    every node i sends to (i + d) % N."""
+    n = mat.shape[0]
+    d = jnp.asarray(d, jnp.int32) % n
+    return pull(mat, n - d)

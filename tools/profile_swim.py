@@ -79,13 +79,14 @@ def main():
                                              up=sw.up, member=sw.member))
     report["events_step_idle_s"] = timeit(ev_step, s.events, reps=reps)
 
-    # vivaldi observe with a full mask (probe tick) — worst case
-    key = jax.random.PRNGKey(0)
-    dst = jax.random.randint(key, (n,), 0, n, dtype=jnp.int32)
+    # vivaldi ring observe with a full mask (probe tick) — the path
+    # serf.step actually runs
     rtt = jnp.ones((n,), jnp.float32) * 0.01
-    viv = jax.jit(lambda st: vivaldi.observe(params.vivaldi, st, None,
-                                             dst, rtt))
-    report["vivaldi_observe_s"] = timeit(viv, s.coords, reps=reps)
+    mask = jnp.ones((n,), bool)
+    viv = jax.jit(lambda st: vivaldi.observe_ring(params.vivaldi, st,
+                                                  jnp.int32(12345), rtt,
+                                                  mask))
+    report["vivaldi_observe_ring_s"] = timeit(viv, s.coords, reps=reps)
 
     # derived summary
     per_tick = report["serf_step_s"] + report["monitor_s"]
