@@ -153,9 +153,12 @@ class Authorizer:
         svc = self._resolve("service", service)
         if svc is None:
             svc = self._default
-        # service:read alone does NOT grant intention read in the reference;
-        # service:write implies intention write
-        return svc if svc in (DENY, WRITE) else DENY
+        # reference (acl/policy_authorizer.go:208-218): without an explicit
+        # intentions rule, service read OR write grants intention READ only
+        # — intention WRITE always needs an explicit intentions = "write"
+        if svc == DENY or rank(svc) < rank(READ):
+            return DENY
+        return READ
 
     # -------------------------------------------------------------- scalars
 

@@ -52,11 +52,38 @@ class ACLResolver:
     def _default_authorizer(self) -> Authorizer:
         return allow_all() if self.default_policy == "allow" else deny_all()
 
+    def _compile(self, token: dict) -> Authorizer:
+        """Token dict → Authorizer from its policies."""
+        if token.get("type") == "management":
+            return ManagementAuthorizer()
+        rules = []
+        for pid in token.get("policies", []):
+            pol = self.store.acl_policy_get(pid) or \
+                self.store.acl_policy_get_by_name(pid)
+            if pol:
+                try:
+                    rules.extend(policy_mod.parse(pol["rules"]))
+                except policy_mod.PolicyError:
+                    # a corrupt stored policy (e.g. restored from a
+                    # foreign snapshot) must not 500 every request
+                    # from its tokens; it just grants nothing
+                    continue
+        return Authorizer(
+            rules, default_policy="deny"
+            if self.default_policy != "allow" else "write")
+
     def resolve(self, secret: Optional[str]) -> Authorizer:
         if not self.enabled:
             # ACLs off: nothing is enforced, including ACL endpoints
             return ManagementAuthorizer()
         if not secret:
+            # tokenless requests run as the anonymous token when one
+            # exists (the reference resolves ANONYMOUS_ACCESSOR so
+            # operators can grant e.g. DNS read to anonymous), else the
+            # bare default policy
+            anon = self.store.acl_token_get(ANONYMOUS_ACCESSOR)
+            if anon and anon.get("policies"):
+                return self._compile(anon)
             return self._default_authorizer()
         now = time.time()
         with self._lock:
@@ -69,24 +96,8 @@ class ACLResolver:
             return self._on_down(secret, hit)
         if token is None:
             authz = self._default_authorizer()
-        elif token.get("type") == "management":
-            authz = ManagementAuthorizer()
         else:
-            rules = []
-            for pid in token.get("policies", []):
-                pol = self.store.acl_policy_get(pid) or \
-                    self.store.acl_policy_get_by_name(pid)
-                if pol:
-                    try:
-                        rules.extend(policy_mod.parse(pol["rules"]))
-                    except policy_mod.PolicyError:
-                        # a corrupt stored policy (e.g. restored from a
-                        # foreign snapshot) must not 500 every request
-                        # from its tokens; it just grants nothing
-                        continue
-            authz = Authorizer(
-                rules, default_policy="deny"
-                if self.default_policy != "allow" else "write")
+            authz = self._compile(token)
         with self._lock:
             self._cache[secret] = (now + self.ttl, authz)
         return authz

@@ -55,8 +55,11 @@ class Agent:
         # DNS frontend on its own ephemeral (or fixed) port; rides the
         # same store/oracle (agent/agent.go:601 listenAndServeDNS)
         from consul_tpu.dns import DNSServer
+        # DNS runs under the agent's (anonymous/default) token so
+        # acl_enabled + default deny is enforced on DNS lookups too
         self.dns = DNSServer(self.store, self.oracle, node_name=node_name,
-                             port=dns_port)
+                             port=dns_port,
+                             authz=lambda: self.acl.resolve(None))
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 

@@ -161,6 +161,30 @@ def _make_handler(srv: ApiServer):
                         if s["id"] == chk["service_id"]), None)
             return bool(svc) and self.authz.service_write(svc["name"])
 
+        def _check_visible(self, node: str, chk: dict,
+                           svc_cache: dict | None = None) -> bool:
+            """aclFilter for checks: service checks need service:read on
+            their service; node checks ride the node:read gate.
+            `svc_cache` maps node -> {service_id: name} across one request
+            (avoids a store scan per check)."""
+            sid = chk.get("service_id", "")
+            if not sid:
+                return True
+            if svc_cache is not None:
+                by_id = svc_cache.get(node)
+                if by_id is None:
+                    by_id = {s["id"]: s["name"]
+                             for s in store.node_services(node)}
+                    svc_cache[node] = by_id
+                name = by_id.get(sid)
+            else:
+                svc = next((s for s in store.node_services(node)
+                            if s["id"] == sid), None)
+                name = svc["name"] if svc else None
+            # unknown service id: fall back to the id as a name (agent
+            # default check naming uses service:<id>)
+            return self.authz.service_read(name if name else sid)
+
         def _session_node_write(self, sid: str) -> bool:
             sess = store.session_info(sid)
             return self.authz.session_write(
@@ -271,6 +295,8 @@ def _make_handler(srv: ApiServer):
                 self._send(["127.0.0.1:8300"])
                 return True
             if path == "/v1/agent/self" and verb == "GET":
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
                 self._send({"Config": {"NodeName": srv.node_name,
                                        "Datacenter": srv.dc,
                                        "Server": True,
@@ -279,9 +305,13 @@ def _make_handler(srv: ApiServer):
                                       "sim_nodes": oracle.n_nodes}})
                 return True
             if path == "/v1/agent/members" and verb == "GET":
-                self._send([_member_json(m) for m in oracle.members()])
+                # aclFilter: members filter by node:read, not 403
+                self._send([_member_json(m) for m in oracle.members()
+                            if self.authz.node_read(m["name"])])
                 return True
             if path == "/v1/agent/metrics" and verb == "GET":
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
                 self._send({"Timestamp": "", "Gauges": [
                     {"Name": "consul.sim.tick", "Value": oracle.tick},
                     {"Name": "consul.catalog.index", "Value": store.index},
@@ -415,10 +445,16 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/agent/force-leave/(.+)", path)
             if m and verb == "PUT":
+                # operator:write (AgentForceLeave, agent_endpoint.go:565)
+                if not self.authz.operator_write():
+                    return self._forbid()
                 oracle.leave(m.group(1))
                 self._send(None)
                 return True
             if path == "/v1/agent/leave" and verb == "PUT":
+                # agent:write on this node (AgentLeave, agent_endpoint.go:547)
+                if not self.authz.agent_write(srv.node_name):
+                    return self._forbid()
                 oracle.leave(srv.node_name)
                 self._send(None)
                 return True
@@ -490,8 +526,10 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/catalog/node/(.+)", path)
             if m and verb == "GET":
-                idx = self._block(q, ("nodes", m.group(1)))
                 node = m.group(1)
+                if not self.authz.node_read(node):
+                    return self._forbid()  # before blocking: no stall/leak
+                idx = self._block(q, ("nodes", node))
                 nrec = next((n for n in store.nodes() if n["node"] == node),
                             None)
                 if nrec is None:
@@ -500,7 +538,8 @@ def _make_handler(srv: ApiServer):
                 svcs = {s["id"]: {"ID": s["id"], "Service": s["name"],
                                   "Tags": s["tags"], "Port": s["port"],
                                   "Meta": s["meta"]}
-                        for s in store.node_services(node)}
+                        for s in store.node_services(node)
+                        if self.authz.service_read(s["name"])}
                 self._send({"Node": {"Node": node, "Address": nrec["address"],
                                      "Meta": nrec["meta"]},
                             "Services": svcs}, index=idx)
@@ -522,15 +561,22 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/health/node/(.+)", path)
             if m and verb == "GET":
+                if not self.authz.node_read(m.group(1)):
+                    return self._forbid()  # before blocking: no stall/leak
                 idx = self._block(q, ("nodechecks", m.group(1)))
                 self._send([_check_json(c, c.get("node", m.group(1)))
-                            for c in store.node_checks(m.group(1))], index=idx)
+                            for c in store.node_checks(m.group(1))
+                            if self._check_visible(m.group(1), c)],
+                           index=idx)
                 return True
             m = re.fullmatch(r"/v1/health/state/(.+)", path)
             if m and verb == "GET":
                 idx = self._block(q, ("nodechecks", ""))
+                svc_cache: dict = {}
                 self._send([_check_json(c, c["node"])
-                            for c in store.checks_in_state(m.group(1))],
+                            for c in store.checks_in_state(m.group(1))
+                            if self.authz.node_read(c["node"])
+                            and self._check_visible(c["node"], c, svc_cache)],
                            index=idx)
                 return True
             if path == "/v1/session/create" and verb == "PUT":
@@ -566,27 +612,36 @@ def _make_handler(srv: ApiServer):
             m = re.fullmatch(r"/v1/session/info/(.+)", path)
             if m and verb == "GET":
                 info = store.session_info(m.group(1))
+                if info and not self.authz.session_read(info["node"]):
+                    info = None  # filtered, not 403 (aclFilter)
                 self._send([_session_json(info)] if info else [])
                 return True
             if path == "/v1/session/list" and verb == "GET":
-                self._send([_session_json(s) for s in store.session_list()])
+                self._send([_session_json(s) for s in store.session_list()
+                            if self.authz.session_read(s["node"])])
                 return True
             m = re.fullmatch(r"/v1/session/node/(.+)", path)
             if m and verb == "GET":
                 self._send([_session_json(s) for s in store.session_list()
-                            if s["node"] == m.group(1)])
+                            if s["node"] == m.group(1)
+                            and self.authz.session_read(s["node"])])
                 return True
             if path == "/v1/coordinate/nodes" and verb == "GET":
                 out = []
                 for mem in oracle.members():
                     if mem["status"] != "alive":
                         continue
+                    if not self.authz.node_read(mem["name"]):
+                        continue  # aclFilter on coordinates
                     c = oracle.coordinate(mem["name"])
                     out.append(_coord_json(c, srv.dc))
                 self._send(out)
                 return True
             m = re.fullmatch(r"/v1/coordinate/node/(.+)", path)
             if m and verb == "GET":
+                if not self.authz.node_read(m.group(1)):
+                    self._send([])
+                    return True
                 try:
                     c = oracle.coordinate(m.group(1))
                 except KeyError:
@@ -612,7 +667,8 @@ def _make_handler(srv: ApiServer):
                         "LTime": e["ltime"],
                         "Coverage": oracle.event_coverage(e["id"])}
                        for e in oracle.event_list()
-                       if name is None or e["name"] == name]
+                       if (name is None or e["name"] == name)
+                       and self.authz.event_read(e["name"])]
                 self._send(out)
                 return True
             if path == "/v1/txn" and verb == "PUT":
@@ -920,6 +976,7 @@ def _check_defn(body: dict) -> dict:
         defn["method"] = body.get("Method", "GET")
         defn["header"] = {k: (v[0] if isinstance(v, list) else v)
                           for k, v in (body.get("Header") or {}).items()}
+        defn["tls_skip_verify"] = bool(body.get("TLSSkipVerify"))
     if body.get("TCP"):
         defn["tcp"] = body["TCP"]
     if body.get("Args") or body.get("ScriptArgs"):

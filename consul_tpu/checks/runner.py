@@ -125,6 +125,8 @@ class CheckHTTP(_IntervalRunner):
         self.url = url
         self.method = method
         self.header = header or {}
+        # TLSSkipVerify parity (check.go honors it for self-signed targets)
+        self.tls_skip_verify = tls_skip_verify
 
     def check(self):
         req = urllib.request.Request(self.url, method=self.method)
@@ -132,8 +134,15 @@ class CheckHTTP(_IntervalRunner):
         req.add_header("Accept", "text/plain, text/*, */*")
         for k, v in self.header.items():
             req.add_header(k, v)
+        ctx = None
+        if self.tls_skip_verify:
+            import ssl
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=ctx) as resp:
                 body = resp.read(OUTPUT_MAX).decode(errors="replace")
                 return PASSING, f"HTTP {self.method} {self.url}: " \
                                 f"{resp.status}  Output: {body}"
@@ -366,7 +375,9 @@ class CheckManager:
         if defn.get("http"):
             return CheckHTTP(check_id, self.notify, defn["http"], interval,
                              timeout, method=defn.get("method", "GET"),
-                             header=defn.get("header"))
+                             header=defn.get("header"),
+                             tls_skip_verify=defn.get("tls_skip_verify",
+                                                      False))
         if defn.get("tcp"):
             return CheckTCP(check_id, self.notify, defn["tcp"], interval,
                             timeout)

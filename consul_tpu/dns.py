@@ -157,7 +157,8 @@ class DNSServer:
                  domain: str = "consul.", host: str = "127.0.0.1",
                  port: int = 0, only_passing: bool = False,
                  node_ttl: int = 0, service_ttl: int = 0,
-                 query_executor: Optional[Callable[[str], list]] = None):
+                 query_executor: Optional[Callable[[str], list]] = None,
+                 authz: Optional[Callable[[], object]] = None):
         self.store = store
         self.oracle = oracle
         self.node_name = node_name
@@ -166,6 +167,11 @@ class DNSServer:
         self.node_ttl = node_ttl
         self.service_ttl = service_ttl
         self.query_executor = query_executor
+        # DNS queries carry no token: lookups run under the agent's token
+        # like the reference (DNS rides the RPC/ACL path with the agent
+        # token) — `authz` returns that resolved Authorizer per query
+        self.authz = authz
+        self._tls = threading.local()
 
         outer = self
 
@@ -219,6 +225,7 @@ class DNSServer:
     # ------------------------------------------------------------- dispatch
 
     def handle_packet(self, data: bytes, udp: bool) -> Optional[bytes]:
+        self._tls.authz = None  # fresh authorizer per query
         try:
             txn_id, flags, qname, qtype = parse_query(data)
         except ValueError:
@@ -286,7 +293,28 @@ class DNSServer:
 
     # ------------------------------------------------------------- handlers
 
+    def _authorizer(self):
+        """Resolve once per query (handle_packet caches on a thread local)
+        — per-row resolution was O(catalog) authorizer builds per PTR."""
+        if self.authz is None:
+            return None
+        cached = getattr(self._tls, "authz", None)
+        if cached is None:
+            cached = self.authz()
+            self._tls.authz = cached
+        return cached
+
+    def _node_readable(self, node: str) -> bool:
+        a = self._authorizer()
+        return a is None or a.node_read(node)
+
+    def _service_readable(self, service: str) -> bool:
+        a = self._authorizer()
+        return a is None or a.service_read(service)
+
     def _node_address(self, node: str) -> Optional[str]:
+        if not self._node_readable(node):
+            return None  # denied reads answer NXDOMAIN, not a leak
         rec = next((n for n in self.store.nodes() if n["node"] == node),
                    None)
         return rec["address"] if rec else None
@@ -314,6 +342,8 @@ class DNSServer:
         return []
 
     def _healthy_instances(self, service: str, tag: Optional[str]) -> list:
+        if not self._service_readable(service):
+            return []
         rows = self.store.health_service_nodes(service, tag=tag)
         out = []
         for r in rows:
@@ -321,6 +351,8 @@ class DNSServer:
             if any(s == "critical" for s in statuses):
                 continue
             if self.only_passing and any(s == "warning" for s in statuses):
+                continue
+            if not self._node_readable(r["service"]["node"]):
                 continue
             out.append(r["service"])
         return out
@@ -333,7 +365,7 @@ class DNSServer:
                 pos = {n: i for i, n in enumerate(order)}
                 return sorted(instances,
                               key=lambda s: pos.get(s["node"], 1 << 30))
-            except KeyError:
+            except (KeyError, IndexError):
                 pass
         instances = list(instances)
         random.shuffle(instances)
@@ -424,8 +456,11 @@ class DNSServer:
             return [], NXDOMAIN
         addr = ".".join(reversed(parts))
         for n in self.store.nodes():
-            if n["address"] == addr:
-                return [RR(name, PTR,
-                           ptr_rdata(f"{n['node']}.node.{self.domain}"),
-                           self.node_ttl)], NOERROR
+            if n["address"] != addr:
+                continue
+            if not self._node_readable(n["node"]):
+                continue
+            return [RR(name, PTR,
+                       ptr_rdata(f"{n['node']}.node.{self.domain}"),
+                       self.node_ttl)], NOERROR
         return [], NXDOMAIN

@@ -193,6 +193,14 @@ class GossipOracle:
         coords, height, adj = self._coords_host()
         io = self.node_id(origin)
         ids = np.array([self.node_id(n) for n in names], np.int32)
+        if io >= len(coords) or (len(ids) and ids.max() >= len(coords)):
+            # node registered after the <=1s-stale snapshot: refresh it
+            # rather than IndexError into a 500/SERVFAIL (advisor finding)
+            self.__dict__.pop("_coord_snap", None)
+            coords, height, adj = self._coords_host()
+            keep = ids < len(coords)
+            if io >= len(coords) or not keep.all():
+                return list(names)  # fall back to given order
         diff = coords[ids] - coords[io]
         d = np.linalg.norm(diff, axis=-1) + height[ids] + height[io]
         adjusted = d + adj[ids] + adj[io]

@@ -79,13 +79,22 @@ def test_key_write_prefix_denied_by_inner_rule():
     assert a.key_write("anything")
     assert not a.key_write_prefix("keep/")   # subtree contains a non-write
 
-def test_service_write_implies_intention_write():
+def test_intention_grants_derive_from_service_policy():
+    """Without an explicit intentions rule: service read OR write grants
+    intention READ only; intention WRITE needs intentions = "write"
+    (acl/policy_authorizer.go:208-218)."""
     a = Authorizer(parse('service "web" { policy = "write" }'),
                    default_policy="deny")
-    assert a.intention_write("web")
+    assert a.intention_read("web")
+    assert not a.intention_write("web")     # write needs explicit intentions
     b = Authorizer(parse('service "web" { policy = "read" }'),
                    default_policy="deny")
-    assert not b.intention_read("web")  # read alone grants no intentions
+    assert b.intention_read("web")          # read grants intention read
+    assert not b.intention_write("web")
+    c = Authorizer(parse(
+        'service "web" { policy = "write" intentions = "write" }'),
+        default_policy="deny")
+    assert c.intention_write("web")
 
 
 def test_default_policies():
@@ -287,3 +296,131 @@ def test_intention_precedence_exact_beats_prefix():
         default_policy="deny")
     assert a.intention_write("web")       # exact beats the catch-all deny
     assert not a.intention_read("other")  # prefix deny still applies
+
+
+def _root_secret(agent):
+    toks = agent.store.acl_token_list()
+    mgmt = next((t["secret"] for t in toks if t["type"] == "management"),
+                None)
+    if mgmt is None:
+        ok, _ = agent.store.acl_bootstrap("boot-acc", "boot-sec")
+        assert ok
+        mgmt = "boot-sec"
+    return mgmt
+
+
+def test_unauthenticated_reads_filtered_and_gated(acl_agent):
+    """ADVICE r1 (high): force-leave/leave gated; read endpoints filtered
+    under default deny (reference aclFilter + agent_endpoint.go:547,565)."""
+    import json
+    import urllib.request
+    import urllib.error
+
+    base = acl_agent.http_address
+    root_secret = _root_secret(acl_agent)
+
+    def get(path, token=None):
+        req = urllib.request.Request(base + path)
+        if token:
+            req.add_header("X-Consul-Token", token)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read() or b"null")
+
+    def put(path, token=None):
+        req = urllib.request.Request(base + path, data=b"", method="PUT")
+        if token:
+            req.add_header("X-Consul-Token", token)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, r.read()
+
+    # anonymous: members filtered empty, sessions/coordinates filtered
+    assert get("/v1/agent/members")[1] == []
+    assert get("/v1/session/list")[1] == []
+    assert get("/v1/coordinate/nodes")[1] == []
+    assert get("/v1/event/list")[1] == []
+
+    # agent/self + metrics 403 for anonymous
+    for path in ("/v1/agent/self", "/v1/agent/metrics"):
+        try:
+            get(path)
+            assert False, f"{path} should 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+    # force-leave / leave gated (operator:write / agent:write)
+    for path in ("/v1/agent/force-leave/node3", "/v1/agent/leave"):
+        try:
+            put(path)
+            assert False, f"{path} should 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+
+    # management token passes everywhere
+    assert get("/v1/agent/self", root_secret)[0] == 200
+    assert len(get("/v1/agent/members", root_secret)[1]) > 0
+    assert put("/v1/agent/force-leave/node9", root_secret)[0] == 200
+
+
+def test_dns_enforces_acl_default_deny(acl_agent):
+    """ADVICE r1 (medium): DNS rides the agent token — default deny means
+    no node/service answers over DNS."""
+    import socket
+    import struct as _struct
+
+    # register straight into the catalog so the assertion can't pass
+    # vacuously while the AE push is still in flight
+    acl_agent.store.register_service(acl_agent.node_name, "webdns",
+                                     "webdns", port=80)
+    assert acl_agent.store.health_service_nodes("webdns")
+
+    def dns_query(name, qtype=1):
+        q = _struct.pack(">HHHHHH", 0x1234, 0x0100, 1, 0, 0, 0)
+        for label in name.split("."):
+            q += bytes([len(label)]) + label.encode()
+        q += b"\x00" + _struct.pack(">HH", qtype, 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.settimeout(60)
+        s.sendto(q, ("127.0.0.1", acl_agent.dns.port))
+        data, _ = s.recvfrom(4096)
+        s.close()
+        rcode = data[3] & 0x0F
+        ancount = _struct.unpack(">H", data[6:8])[0]
+        return rcode, ancount
+
+    _, ancount = dns_query(f"{acl_agent.node_name}.node.consul")
+    assert ancount == 0, "default-deny DNS leaked a node address"
+    _, ancount = dns_query("webdns.service.consul")
+    assert ancount == 0, "default-deny DNS leaked service instances"
+
+
+def test_anonymous_token_policies_grant_dns_read(acl_agent):
+    """The reference recipe: attach node/service read policies to the
+    anonymous token to re-enable DNS under default deny."""
+    from consul_tpu.acl.resolver import ANONYMOUS_ACCESSOR
+    st = acl_agent.store
+    st.register_service(acl_agent.node_name, "anondns", "anondns", port=81)
+    st.acl_policy_set("anon-dns", "anon-dns",
+                      'node_prefix "" { policy = "read" }\n'
+                      'service_prefix "" { policy = "read" }')
+    st.acl_token_set(ANONYMOUS_ACCESSOR, "anonymous", ["anon-dns"],
+                     token_type="client")
+    try:
+        import socket
+        import struct as _struct
+
+        def dns_query(name, qtype=1):
+            q = _struct.pack(">HHHHHH", 0x77, 0x0100, 1, 0, 0, 0)
+            for label in name.split("."):
+                q += bytes([len(label)]) + label.encode()
+            q += b"\x00" + _struct.pack(">HH", qtype, 1)
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            s.settimeout(60)
+            s.sendto(q, ("127.0.0.1", acl_agent.dns.port))
+            data, _ = s.recvfrom(4096)
+            s.close()
+            return _struct.unpack(">H", data[6:8])[0]
+
+        assert dns_query("anondns.service.consul") >= 1, \
+            "anonymous-token read policy did not re-enable DNS"
+    finally:
+        st.acl_token_delete(ANONYMOUS_ACCESSOR)
