@@ -62,10 +62,13 @@ class Client:
         return bool(out)
 
     def kv_get(self, key: str, index: Optional[int] = None,
-               wait: Optional[str] = None) -> Tuple[Optional[dict], int]:
+               wait: Optional[str] = None,
+               consistent: bool = False) -> Tuple[Optional[dict], int]:
         try:
             out, idx, _ = self._call("GET", f"/v1/kv/{key}",
-                                     {"index": index, "wait": wait})
+                                     {"index": index, "wait": wait,
+                                      "consistent": "" if consistent
+                                      else None})
         except ApiError as e:
             if e.code == 404:
                 return None, 0

@@ -49,16 +49,50 @@ def _parse_wait(val: str) -> float:
     return float(m.group(1)) * scale
 
 
-class ApiServer:
-    """Threaded HTTP server bound to an ephemeral or fixed port."""
+class NullOracle:
+    """Inert oracle for server-backed ApiServers with no gossip device
+    attached (the pure control-plane deployment shape)."""
 
-    def __init__(self, store: StateStore, oracle: GossipOracle,
+    tick = 0
+    n_nodes = 0
+
+    def members(self):
+        return []
+
+    def coordinate(self, name):
+        raise KeyError(name)
+
+    def leave(self, name):
+        pass
+
+    def fire_event(self, name, payload, origin):
+        return "0"
+
+    def event_list(self):
+        return []
+
+    def event_coverage(self, event_id):
+        return 0.0
+
+    def sort_by_rtt(self, origin, names):
+        return list(names)
+
+
+class ApiServer:
+    """Threaded HTTP server bound to an ephemeral or fixed port.
+
+    `store` may be a bare StateStore or a raft-replicated Server (the
+    duck-typed write surface): reads hit the local replica, writes go
+    through raft with leader forwarding, and ?consistent reads barrier
+    via Server.consistent_index (agent/consul/rpc.go consistentRead)."""
+
+    def __init__(self, store: StateStore, oracle: GossipOracle = None,
                  node_name: str = "node0", host: str = "127.0.0.1",
                  port: int = 0, dc: str = "dc1",
                  acl_resolver: Optional[ACLResolver] = None,
                  local=None, checks=None):
         self.store = store
-        self.oracle = oracle
+        self.oracle = oracle if oracle is not None else NullOracle()
         self.node_name = node_name
         self.dc = dc
         # no resolver → ACLs disabled (resolve() returns allow-all)
@@ -130,6 +164,16 @@ def _make_handler(srv: ApiServer):
         def _err(self, code: int, msg: str):
             self._send(None, code, raw=msg.encode())
 
+        def _consistent(self, q) -> None:
+            """?consistent: leader barrier, then wait for the LOCAL
+            replica to catch up to the barrier index — serving straight
+            from a lagging follower would readmit the staleness the flag
+            excludes (rpc.go consistentRead).  500s when no leader."""
+            if "consistent" in q and hasattr(store, "consistent_index"):
+                idx = store.consistent_index()
+                if store.index < idx:
+                    store.wait_for(idx - 1, timeout=5.0)
+
         def _block(self, q, *watches) -> int:
             """Honor ?index/?wait before evaluating the read.
 
@@ -137,6 +181,7 @@ def _make_handler(srv: ApiServer):
             (store.wait_on) — an unrelated write does not wake this query;
             with no watches it degrades to the coarse any-write wait
             (blockingQuery, agent/consul/rpc.go:806)."""
+            self._consistent(q)
             if "index" in q:
                 wait = _parse_wait(q.get("wait", "300s"))
                 if watches:

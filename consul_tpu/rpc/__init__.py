@@ -1,0 +1,14 @@
+"""Socket RPC boundary: raft transport + leader forwarding over TCP.
+
+The reference's process boundary is a single TCP "server" port carrying
+msgpack net/rpc, raft, and gRPC behind a first-byte protocol mux
+(agent/consul/rpc.go:130 handleConn; conn pool agent/pool/pool.go:542).
+Here one listener per server carries two frame types over length-prefixed
+JSON — "raft" (fire-and-forget engine messages → RaftNode.deliver) and
+"rpc" (request/response: forwarded applies, barriers, stats) — with a
+pooled one-connection-per-peer client.
+"""
+
+from consul_tpu.rpc.net import (  # noqa: F401
+    RpcClient, RpcError, RpcListener, TcpTransport, recv_frame, send_frame,
+)

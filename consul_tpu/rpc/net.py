@@ -1,0 +1,245 @@
+"""Framed-JSON TCP plumbing for the server RPC boundary.
+
+Wire format: 4-byte big-endian length + UTF-8 JSON object.  Two frame
+kinds (the first-byte mux of agent/consul/rpc.go:130 collapsed into a
+"type" field):
+
+    {"type": "raft", "msg": {...}}                 fire-and-forget
+    {"type": "rpc", "id": n, "method": m, "args": {...}}   request
+    {"type": "resp", "id": n, "result": ..., "error": ...} response
+
+The raft engine's messages (AppendEntries / RequestVote / Install
+Snapshot and acks) are already JSON-safe dicts (bytes ride latin-1 /
+base64 in the command layer), so no extra codec is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from consul_tpu.consensus.raft import Transport
+
+_MAX_FRAME = 64 << 20  # 64 MiB: snapshots ride InstallSnapshot frames
+
+
+class RpcError(Exception):
+    """Remote handler raised; message carries the remote error string."""
+
+
+def send_frame(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+def recv_frame(sock: socket.socket) -> Optional[dict]:
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (ln,) = struct.unpack(">I", hdr)
+    if ln > _MAX_FRAME:
+        raise ValueError(f"frame too large: {ln}")
+    data = _recv_exact(sock, ln)
+    if data is None:
+        return None
+    return json.loads(data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class RpcListener:
+    """Per-server TCP listener: raft frames → deliver_fn, rpc frames →
+    handler(method, args) → result (the server-side mux, rpc.go:130)."""
+
+    def __init__(self, deliver_fn: Callable[[dict], None],
+                 handler: Callable[[str, dict], dict],
+                 host: str = "127.0.0.1", port: int = 0):
+        outer = self
+
+        class _Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                try:
+                    while True:
+                        frame = recv_frame(sock)
+                        if frame is None:
+                            return
+                        kind = frame.get("type")
+                        if kind == "raft":
+                            outer.deliver_fn(frame["msg"])
+                        elif kind == "rpc":
+                            resp = {"type": "resp", "id": frame.get("id")}
+                            try:
+                                resp["result"] = outer.handler(
+                                    frame["method"], frame.get("args") or {})
+                            except Exception as e:
+                                resp["error"] = f"{type(e).__name__}: {e}"
+                            send_frame(sock, resp)
+                except (ConnectionError, ValueError, OSError):
+                    return
+
+        self.deliver_fn = deliver_fn
+        self.handler = handler
+        self.server = socketserver.ThreadingTCPServer((host, port), _Handler,
+                                                      bind_and_activate=False)
+        self.server.allow_reuse_address = True
+        self.server.daemon_threads = True
+        self.server.server_bind()
+        self.server.server_activate()
+        self.addr: Tuple[str, int] = self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+
+class _ConnPool:
+    """One pooled connection per address, mutex-serialized requests
+    (a miniature agent/pool/pool.go ConnPool)."""
+
+    def __init__(self, timeout: float = 5.0):
+        self._conns: Dict[Tuple[str, int], socket.socket] = {}
+        self._locks: Dict[Tuple[str, int], threading.Lock] = {}
+        self._lock = threading.Lock()
+        self.timeout = timeout
+
+    def _get_lock(self, addr) -> threading.Lock:
+        with self._lock:
+            if addr not in self._locks:
+                self._locks[addr] = threading.Lock()
+            return self._locks[addr]
+
+    def _connect(self, addr) -> socket.socket:
+        sock = self._conns.get(addr)
+        if sock is not None:
+            return sock
+        sock = socket.create_connection(addr, timeout=self.timeout)
+        sock.settimeout(self.timeout)
+        self._conns[addr] = sock
+        return sock
+
+    def _drop(self, addr) -> None:
+        sock = self._conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def oneway(self, addr, obj: dict) -> None:
+        """Fire-and-forget (raft frames).  Errors drop the connection."""
+        lock = self._get_lock(addr)
+        with lock:
+            try:
+                send_frame(self._connect(addr), obj)
+            except OSError:
+                self._drop(addr)
+                # one reconnect attempt — the raft engine re-sends anyway
+                try:
+                    send_frame(self._connect(addr), obj)
+                except OSError:
+                    self._drop(addr)
+
+    def call(self, addr, obj: dict,
+             timeout: Optional[float] = None) -> dict:
+        lock = self._get_lock(addr)
+        with lock:
+            try:
+                sock = self._connect(addr)
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                send_frame(sock, obj)
+                # correlate on id: a stale response left by an earlier
+                # timed-out call must not be handed to this caller
+                while True:
+                    resp = recv_frame(sock)
+                    if resp is None:
+                        break
+                    if obj.get("id") is None or resp.get("id") == obj["id"]:
+                        break
+            except OSError as e:
+                self._drop(addr)
+                raise RpcError(f"rpc to {addr} failed: {e}") from e
+            finally:
+                if timeout is not None:
+                    try:
+                        sock.settimeout(self.timeout)
+                    except (OSError, UnboundLocalError):
+                        pass
+            if resp is None:
+                self._drop(addr)
+                raise RpcError(f"rpc to {addr}: connection closed")
+            return resp
+
+    def close(self) -> None:
+        with self._lock:
+            for addr in list(self._conns):
+                self._drop(addr)
+
+
+class RpcClient:
+    """Request/response calls to a peer's RpcListener."""
+
+    def __init__(self, timeout: float = 5.0):
+        self._pool = _ConnPool(timeout)
+        self._next_id = 0
+        self._id_lock = threading.Lock()
+
+    def call(self, addr: Tuple[str, int], method: str, args: dict,
+             timeout: Optional[float] = None) -> dict:
+        with self._id_lock:
+            self._next_id += 1
+            rid = self._next_id
+        resp = self._pool.call(tuple(addr), {"type": "rpc", "id": rid,
+                                             "method": method, "args": args},
+                               timeout=timeout)
+        if resp.get("error"):
+            raise RpcError(resp["error"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        self._pool.close()
+
+
+class TcpTransport(Transport):
+    """Raft Transport over sockets: `addresses` maps node_id → (host, port)
+    and is shared by every server in the cluster (the reference's router/
+    server-lookup role).  send() is fire-and-forget like the engine
+    expects; unknown/unreachable targets drop silently (raft retries)."""
+
+    def __init__(self, addresses: Optional[Dict[str, Tuple[str, int]]] = None,
+                 timeout: float = 5.0):
+        # identity matters: the caller shares one (initially empty)
+        # address book across transports — `or {}` would silently fork it
+        self.addresses: Dict[str, Tuple[str, int]] = (
+            addresses if addresses is not None else {})
+        self._pool = _ConnPool(timeout)
+
+    def send(self, target: str, msg: dict) -> None:
+        addr = self.addresses.get(target)
+        if addr is None:
+            return
+        self._pool.oneway(tuple(addr), {"type": "raft", "msg": msg})
+
+    def close(self) -> None:
+        self._pool.close()

@@ -19,8 +19,12 @@ leader concern while state changes replicate — exactly the reference's
 split (session_ttl.go:45).
 
 Servers discover each other through a process-local registry dict for
-in-process clusters (SURVEY.md §4 tier 2); swap the registry for an RPC
-proxy to cross process boundaries.
+in-process clusters (SURVEY.md §4 tier 2) and, across process
+boundaries, through the socket RPC layer (consul_tpu/rpc): serve_rpc()
+binds a listener carrying raft frames and forwarded applies, and
+raft_apply falls back to a remote "apply" call when the leader is not
+in-process (ForwardRPC over the conn pool — agent/consul/rpc.go:549,
+agent/pool/pool.go:542).
 """
 
 from __future__ import annotations
@@ -46,6 +50,7 @@ class Server:
                  registry: Dict[str, "Server"],
                  raft_config: Optional[RaftConfig] = None, seed: int = 0):
         self.node_id = node_id
+        self.transport = transport
         self.store = StateStore()
         self.fsm = ServerFSM(self.store)
         self.registry = registry
@@ -59,6 +64,66 @@ class Server:
             transport.register(self.raft)
         registry[node_id] = self
         self._ttl_reap_inflight: set = set()
+        self._listener = None
+        self._rpc_client = None
+
+    # --------------------------------------------------------------- rpc net
+
+    def serve_rpc(self, host: str = "127.0.0.1", port: int = 0):
+        """Bind the socket RPC listener (raft frames + forwarded applies)
+        and advertise our address in the transport's address book.
+        Returns (host, port)."""
+        from consul_tpu.rpc import RpcClient, RpcListener
+        self._listener = RpcListener(self.raft.deliver, self._handle_rpc,
+                                     host=host, port=port)
+        self._listener.start()
+        self._rpc_client = RpcClient()
+        if hasattr(self.transport, "addresses"):
+            self.transport.addresses[self.node_id] = self._listener.addr
+        return self._listener.addr
+
+    def close_rpc(self) -> None:
+        if hasattr(self.transport, "addresses"):
+            self.transport.addresses.pop(self.node_id, None)
+        if self._listener is not None:
+            self._listener.stop()
+            self._listener = None
+        if self._rpc_client is not None:
+            self._rpc_client.close()
+            self._rpc_client = None
+
+    def _handle_rpc(self, method: str, args: dict):
+        """Server-side forwarded calls (the RPC endpoints the mux routes
+        to, agent/consul/rpc.go:130).  'apply' rejects at a non-leader —
+        the caller targeted us as leader; re-forwarding could loop."""
+        if method == "apply":
+            if not self.raft.is_leader():
+                raise NotLeaderError(self.raft.leader_id)
+            pend = self.raft.apply({"op": args["op"],
+                                    "args": args.get("args") or {}})
+            if not pend.event.wait(5.0):
+                raise TimeoutError("apply timed out")
+            if pend.error is not None:
+                raise pend.error
+            return pend.result
+        if method == "barrier":
+            if not self.raft.is_leader():
+                raise NotLeaderError(self.raft.leader_id)
+            pend = self.raft.barrier()
+            if not pend.event.wait(5.0) or pend.error is not None:
+                raise TimeoutError("barrier failed")
+            return {"index": self.store.index}
+        if method == "stats":
+            return self.stats()
+        raise ValueError(f"unknown rpc method {method}")
+
+    def _remote_addr(self, node_id: str):
+        if self._rpc_client is None:
+            return None
+        addrs = getattr(self.transport, "addresses", None)
+        if not addrs:
+            return None
+        return addrs.get(node_id)
 
     # ------------------------------------------------------------------ tick
 
@@ -95,12 +160,24 @@ class Server:
     def raft_apply(self, op: str, timeout: float = 5.0, **args) -> Any:
         """Propose on the leader (forwarding like ForwardRPC, rpc.go:549)
         and wait for FSM apply.  Retries once across leader changes."""
+        from consul_tpu.rpc import RpcError
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
         while time.time() < deadline:
+            leader = self.leader_id
             target = self if self.raft.is_leader() else \
-                self.registry.get(self.raft.leader_id or "")
+                self.registry.get(leader or "")
             if target is None:
+                # leader not in-process: forward over the socket RPC,
+                # bounded by the caller's remaining budget
+                addr = self._remote_addr(leader or "")
+                if addr is not None:
+                    try:
+                        return self._rpc_client.call(
+                            addr, "apply", {"op": op, "args": args},
+                            timeout=max(0.05, deadline - time.time()))
+                    except (RpcError, TimeoutError) as e:
+                        last_err = e
                 time.sleep(0.01)
                 continue
             try:
@@ -121,9 +198,17 @@ class Server:
     def consistent_index(self, timeout: float = 5.0) -> int:
         """Leader barrier — readers wanting ?consistent semantics call this
         first (VerifyLeader / consistentRead)."""
+        from consul_tpu.rpc import RpcError
         target = self if self.raft.is_leader() else \
             self.registry.get(self.raft.leader_id or "")
         if target is None:
+            addr = self._remote_addr(self.raft.leader_id or "")
+            if addr is not None:
+                try:
+                    return self._rpc_client.call(
+                        addr, "barrier", {}, timeout=timeout)["index"]
+                except (RpcError, TimeoutError) as e:
+                    raise NoLeaderError(str(e))
             raise NoLeaderError("no leader for consistent read")
         pend = target.raft.barrier()
         if not pend.event.wait(timeout) or pend.error is not None:

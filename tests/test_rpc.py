@@ -1,0 +1,170 @@
+"""Socket RPC boundary: raft over TCP + leader forwarding + HTTP e2e.
+
+VERDICT r1 #4: serve HTTP from the replicated server over a real
+transport.  Each server gets an ISOLATED registry (as if in its own
+process) so every cross-server interaction — raft replication, forwarded
+writes, consistent-read barriers — must ride the socket layer
+(consul_tpu/rpc), like the reference's TCP msgpack RPC
+(agent/consul/rpc.go:130, agent/pool/pool.go:542).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from consul_tpu.api.client import Client
+from consul_tpu.api.http import ApiServer
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.rpc import RpcClient, RpcError, TcpTransport, recv_frame, \
+    send_frame
+from consul_tpu.server import NoLeaderError, Server
+
+
+def test_frame_roundtrip():
+    a, b = socket.socketpair()
+    send_frame(a, {"type": "rpc", "id": 1, "method": "x",
+                   "args": {"k": "v", "n": 3}})
+    got = recv_frame(b)
+    assert got == {"type": "rpc", "id": 1, "method": "x",
+                   "args": {"k": "v", "n": 3}}
+    a.close()
+    b.close()
+
+
+class TcpCluster:
+    """N servers, each with its own registry + TcpTransport instance
+    sharing one address book — process isolation without processes."""
+
+    def __init__(self, n=3, seed=0):
+        self.addresses = {}
+        ids = [f"server{i}" for i in range(n)]
+        self.servers = []
+        for i, nid in enumerate(ids):
+            transport = TcpTransport(self.addresses)
+            s = Server(nid, ids, transport, registry={},
+                       raft_config=RaftConfig(), seed=seed + i)
+            s.serve_rpc()
+            self.servers.append(s)
+        self._running = True
+        self._dead = set()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._running:
+            for s in self.servers:
+                if s.node_id not in self._dead:
+                    s.tick(time.time())
+            time.sleep(0.01)
+
+    def kill(self, node_id):
+        self._dead.add(node_id)
+        srv = next(s for s in self.servers if s.node_id == node_id)
+        srv.close_rpc()
+        self.addresses.pop(node_id, None)
+
+    def leader(self):
+        live = [s for s in self.servers if s.node_id not in self._dead]
+        leaders = [s for s in live if s.is_leader()]
+        return leaders[0] if len(leaders) == 1 else None
+
+    def wait_leader(self, max_s=10.0):
+        deadline = time.time() + max_s
+        while time.time() < deadline:
+            l = self.leader()
+            if l is not None:
+                return l
+            time.sleep(0.05)
+        raise RuntimeError("no leader")
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=5.0)
+        for s in self.servers:
+            s.close_rpc()
+
+
+@pytest.fixture()
+def tcp_cluster():
+    c = TcpCluster(3, seed=11)
+    yield c
+    c.stop()
+
+
+def test_raft_replicates_over_sockets(tcp_cluster):
+    leader = tcp_cluster.wait_leader()
+    ok, idx = leader.kv_set("a", b"1")
+    assert ok
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if all(s.store.kv_get("a") for s in tcp_cluster.servers):
+            break
+        time.sleep(0.05)
+    for s in tcp_cluster.servers:
+        assert s.store.kv_get("a")["value"] == b"1", s.node_id
+
+
+def test_follower_write_forwards_over_socket(tcp_cluster):
+    leader = tcp_cluster.wait_leader()
+    follower = next(s for s in tcp_cluster.servers if s is not leader)
+    assert not follower.is_leader()
+    ok, idx = follower.kv_set("fwd", b"x")     # socket ForwardRPC
+    assert ok
+    assert leader.store.kv_get("fwd")["value"] == b"x"
+
+
+def test_barrier_rpc(tcp_cluster):
+    leader = tcp_cluster.wait_leader()
+    follower = next(s for s in tcp_cluster.servers if s is not leader)
+    follower.kv_set("c", b"1")
+    idx = follower.consistent_index()
+    assert idx >= follower.store.index - 1
+
+
+def test_http_on_follower_with_leader_kill(tcp_cluster):
+    """The VERDICT done-criterion: 3-server cluster + HTTP client, kill
+    the leader mid-writes, writes succeed after failover, ?consistent
+    reads barrier."""
+    leader = tcp_cluster.wait_leader()
+    follower = next(s for s in tcp_cluster.servers if s is not leader)
+    api = ApiServer(follower, node_name=follower.node_id)
+    api.start()
+    try:
+        client = Client(api.address)
+        assert client.kv_put("app/1", b"one")      # forwarded write
+        row, idx = client.kv_get("app/1", consistent=True)
+        assert row["Value"] == b"one"
+
+        tcp_cluster.kill(leader.node_id)           # leader dies mid-run
+        new_leader = tcp_cluster.wait_leader(15.0)
+        assert new_leader.node_id != leader.node_id
+
+        deadline = time.time() + 10.0
+        wrote = False
+        while time.time() < deadline:
+            try:
+                wrote = client.kv_put("app/2", b"two")
+                if wrote:
+                    break
+            except Exception:
+                time.sleep(0.1)
+        assert wrote, "write did not succeed after failover"
+        row, _ = client.kv_get("app/2", consistent=True)
+        assert row["Value"] == b"two"
+    finally:
+        api.stop()
+
+
+def test_rpc_apply_rejected_at_follower(tcp_cluster):
+    leader = tcp_cluster.wait_leader()
+    follower = next(s for s in tcp_cluster.servers if s is not leader)
+    client = RpcClient()
+    try:
+        with pytest.raises(RpcError):
+            client.call(tcp_cluster.addresses[follower.node_id], "apply",
+                        {"op": "kv_set",
+                         "args": {"key": "x", "value": "1"}})
+    finally:
+        client.close()

@@ -99,7 +99,14 @@ def test_consistent_read_barrier(cluster):
 def test_blocking_query_wakes_on_replicated_write(cluster):
     import threading
     follower = next(s for s in cluster.servers if not s.is_leader())
+    # seed one write: index 0 is non-blocking by contract (blockingQuery
+    # treats MinQueryIndex 0 as immediate)
+    cluster.leader().kv_set("seed", b"s")
+    deadline = time.time() + 5.0
+    while follower.store.index == 0 and time.time() < deadline:
+        time.sleep(0.01)       # follower applies on a later tick
     start_idx = follower.store.index
+    assert start_idx > 0
     woke = {}
 
     def waiter():
