@@ -57,9 +57,22 @@ class Agent:
         from consul_tpu.dns import DNSServer
         # DNS runs under the agent's (anonymous/default) token so
         # acl_enabled + default deny is enforced on DNS lookups too
+        def _dns_query_exec(name):
+            """<name>.query.<domain> → prepared-query execute, adapted to
+            DNS's health-row shape (dns.py _query).  Runs under the same
+            anonymous-token authorizer as direct DNS service lookups — a
+            prepared query must not leak a service the token can't read."""
+            res = self.api.query_executor.execute(name)
+            if res is None:
+                return None
+            if not self.acl.resolve(None).service_read(res["Service"]):
+                return None
+            return [{"service": s} for s in res["Nodes"]]
+
         self.dns = DNSServer(self.store, self.oracle, node_name=node_name,
                              port=dns_port,
-                             authz=lambda: self.acl.resolve(None))
+                             authz=lambda: self.acl.resolve(None),
+                             query_executor=_dns_query_exec)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 

@@ -306,3 +306,49 @@ class Client:
 
     def acl_token_clone(self, accessor: str) -> dict:
         return self._call("PUT", f"/v1/acl/token/{accessor}/clone")[0]
+
+    # ------------------------------------------------------- prepared queries
+    # (api/prepared_query.go PreparedQuery client)
+
+    def query_create(self, definition: dict) -> str:
+        out, _, _ = self._call("POST", "/v1/query", None,
+                               json.dumps(definition).encode())
+        return out["ID"]
+
+    def query_list(self) -> List[dict]:
+        return self._call("GET", "/v1/query")[0]
+
+    def query_get(self, qid: str) -> Optional[dict]:
+        try:
+            out = self._call("GET", f"/v1/query/{qid}")[0]
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+        return out[0] if out else None
+
+    def query_update(self, qid: str, definition: dict) -> bool:
+        return bool(self._call("PUT", f"/v1/query/{qid}", None,
+                               json.dumps(definition).encode())[0])
+
+    def query_delete(self, qid: str) -> bool:
+        return bool(self._call("DELETE", f"/v1/query/{qid}")[0])
+
+    def query_execute(self, name_or_id: str, limit: int = 0,
+                      near: Optional[str] = None) -> Optional[dict]:
+        try:
+            return self._call(
+                "GET", f"/v1/query/{name_or_id}/execute",
+                {"limit": limit or None, "near": near})[0]
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def query_explain(self, name: str) -> Optional[dict]:
+        try:
+            return self._call("GET", f"/v1/query/{name}/explain")[0]
+        except ApiError as e:
+            if e.code == 404:
+                return None
+            raise

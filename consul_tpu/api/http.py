@@ -103,6 +103,9 @@ class ApiServer:
         # fall through to direct store writes)
         self.local = local
         self.checks = checks
+        from consul_tpu.prepared_query import QueryExecutor
+        self.query_executor = QueryExecutor(
+            self.store, self.oracle, node_name=node_name, dc=dc)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -716,6 +719,8 @@ def _make_handler(srv: ApiServer):
                        and self.authz.event_read(e["name"])]
                 self._send(out)
                 return True
+            if path == "/v1/query" or path.startswith("/v1/query/"):
+                return self._query(verb, path, q)
             if path == "/v1/txn" and verb == "PUT":
                 return self._txn()
             if path == "/v1/snapshot" and verb == "GET":
@@ -899,6 +904,151 @@ def _make_handler(srv: ApiServer):
                     srv.acl.invalidate()
                     self._send(True)
                     return True
+            return False
+
+        # -------------------------------------------------- prepared queries
+        # /v1/query CRUD + execute + explain
+        # (agent/consul/prepared_query_endpoint.go:341,477; structs
+        # PreparedQuery* JSON shapes)
+
+        def _query_defn(self, body: dict) -> dict:
+            svc = body.get("Service") or {}
+            fo = svc.get("Failover") or {}
+            defn = {
+                "name": body.get("Name", ""),
+                "session": body.get("Session", ""),
+                "token": body.get("Token", ""),
+                "service": {
+                    "service": svc.get("Service", ""),
+                    "tags": svc.get("Tags") or [],
+                    "only_passing": bool(svc.get("OnlyPassing")),
+                    "near": svc.get("Near", ""),
+                    "failover": {
+                        "nearest_n": int(fo.get("NearestN") or 0),
+                        "datacenters": fo.get("Datacenters") or [],
+                    },
+                },
+                "dns": {"ttl": (body.get("DNS") or {}).get("TTL", "")},
+            }
+            tpl = body.get("Template")
+            if tpl:
+                defn["template"] = {"type": tpl.get("Type",
+                                                    "name_prefix_match"),
+                                    "regexp": tpl.get("Regexp", "")}
+            return defn
+
+        def _query_json(self, q_: dict) -> dict:
+            svc = q_.get("service") or {}
+            fo = svc.get("failover") or {}
+            out = {
+                "ID": q_.get("id", ""), "Name": q_.get("name", ""),
+                "Session": q_.get("session", ""),
+                "Token": q_.get("token", ""),
+                "Service": {
+                    "Service": svc.get("service", ""),
+                    "Tags": svc.get("tags", []),
+                    "OnlyPassing": svc.get("only_passing", False),
+                    "Near": svc.get("near", ""),
+                    "Failover": {
+                        "NearestN": fo.get("nearest_n", 0),
+                        "Datacenters": fo.get("datacenters", []),
+                    },
+                },
+                "DNS": {"TTL": (q_.get("dns") or {}).get("ttl", "")},
+                "CreateIndex": q_.get("create_index", 0),
+                "ModifyIndex": q_.get("modify_index", 0),
+            }
+            if q_.get("template"):
+                out["Template"] = {"Type": q_["template"].get("type", ""),
+                                   "Regexp": q_["template"].get("regexp",
+                                                                "")}
+            return out
+
+        def _query(self, verb: str, path: str, q) -> bool:
+            import uuid as _uuid
+            if path == "/v1/query" and verb == "PUT":  # POST routes as PUT
+                body = json.loads(self._body() or b"{}")
+                if not self.authz.query_write(body.get("Name", "")):
+                    return self._forbid()
+                defn = self._query_defn(body)
+                qid = str(_uuid.uuid4())
+                try:
+                    store.query_set(qid, defn)
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send({"ID": qid})
+                return True
+            if path == "/v1/query" and verb == "GET":
+                idx = self._block(q, ("queries", ""))
+                self._send([self._query_json(x) for x in store.query_list()
+                            if self.authz.query_read(x.get("name", ""))],
+                           index=idx)
+                return True
+            m = re.fullmatch(r"/v1/query/([^/]+)/execute", path)
+            if m and verb == "GET":
+                res = srv.query_executor.execute(
+                    m.group(1), limit=int(q.get("limit", 0) or 0),
+                    near=q.get("near"))
+                if res is None:
+                    self._err(404, "query not found")
+                    return True
+                if not self.authz.service_read(res["Service"]):
+                    return self._forbid()
+                nodes = [_catalog_service_json(r) for r in res["Nodes"]]
+                self._send({"Service": res["Service"], "Nodes": nodes,
+                            "DNS": {"TTL": res["DNS"].get("ttl", "")},
+                            "Datacenter": res["Datacenter"],
+                            "Failovers": res["Failovers"]})
+                return True
+            m = re.fullmatch(r"/v1/query/([^/]+)/explain", path)
+            if m and verb == "GET":
+                from consul_tpu import prepared_query as pq
+                resolved = pq.resolve(store, m.group(1))
+                if resolved is None:
+                    self._err(404, "query not found")
+                    return True
+                if not self.authz.query_read(resolved.get("name", "")):
+                    return self._forbid()
+                self._send({"Query": self._query_json(resolved)})
+                return True
+            m = re.fullmatch(r"/v1/query/([^/]+)", path)
+            if m and verb == "GET":
+                q_ = store.query_get(m.group(1))
+                if q_ is None:
+                    self._err(404, "query not found")
+                    return True
+                if not self.authz.query_read(q_.get("name", "")):
+                    return self._forbid()
+                self._send([self._query_json(q_)])
+                return True
+            if m and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                existing = store.query_get(m.group(1))
+                if existing is None:
+                    self._err(404, "query not found")
+                    return True
+                # modify needs write on BOTH the old and the new name —
+                # otherwise a token could hijack queries it can't touch
+                # (prepared_query_endpoint.go Apply checks both)
+                if not self.authz.query_write(existing.get("name", "")) \
+                        or not self.authz.query_write(body.get("Name", "")):
+                    return self._forbid()
+                try:
+                    store.query_set(m.group(1), self._query_defn(body))
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send(True)
+                return True
+            if m and verb == "DELETE":
+                q_ = store.query_get(m.group(1))
+                if q_ is not None and not self.authz.query_write(
+                        q_.get("name", "")):
+                    return self._forbid()
+                store.query_delete(m.group(1))
+                self._send(True)
+                return True
             return False
 
         # ------------------------------------------------------------- kv

@@ -92,6 +92,8 @@ class StateStore:
         self._acl_policies: Dict[str, dict] = {}
         self._acl_tokens: Dict[str, dict] = {}
         self._acl_bootstrap_index = 0
+        # prepared queries: id -> definition dict (state/prepared_query.go)
+        self._queries: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -676,6 +678,57 @@ class StateStore:
             self._acl_bootstrap_index = 0
             return self._index
 
+    # -------------------------------------------------------- prepared queries
+    # CRUD mirrors state/prepared_query.go (PreparedQuerySet/Get/List/
+    # Delete); ids are proposer-supplied uuids.
+
+    def query_set(self, qid: str, query: dict) -> int:
+        tpl = query.get("template") or {}
+        if tpl.get("type") == "regexp":
+            import re as _re
+            try:
+                _re.compile(tpl.get("regexp", ""))
+            except _re.error as e:
+                raise ValueError(f"invalid template regexp: {e}")
+        with self._lock:
+            name = query.get("name", "")
+            if name:
+                clash = next((q for i, q in self._queries.items()
+                              if q.get("name") == name and i != qid), None)
+                if clash is not None:
+                    raise ValueError(f"query name {name!r} already in use")
+            idx = self._bump([("queries", qid)])
+            existing = self._queries.get(qid, {})
+            self._queries[qid] = dict(
+                query,
+                create_index=existing.get("create_index", idx),
+                modify_index=idx)
+            return idx
+
+    def query_get(self, qid: str) -> Optional[dict]:
+        with self._lock:
+            q = self._queries.get(qid)
+            return dict(q, id=qid) if q else None
+
+    def query_get_by_name(self, name: str) -> Optional[dict]:
+        with self._lock:
+            for qid, q in self._queries.items():
+                if q.get("name") == name:
+                    return dict(q, id=qid)
+            return None
+
+    def query_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(q, id=i) for i, q in sorted(self._queries.items())]
+
+    def query_delete(self, qid: str) -> int:
+        with self._lock:
+            if qid not in self._queries:
+                return self._index
+            idx = self._bump([("queries", qid)])
+            del self._queries[qid]
+            return idx
+
     # ------------------------------------------------------------------- txn
 
     def txn(self, ops: List[dict]) -> Tuple[bool, List[Any], int]:
@@ -754,6 +807,7 @@ class StateStore:
                 "acl_policies": copy.deepcopy(self._acl_policies),
                 "acl_tokens": copy.deepcopy(self._acl_tokens),
                 "acl_bootstrap_index": self._acl_bootstrap_index,
+                "queries": copy.deepcopy(self._queries),
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -777,6 +831,7 @@ class StateStore:
             self._acl_policies = copy.deepcopy(snap.get("acl_policies", {}))
             self._acl_tokens = copy.deepcopy(snap.get("acl_tokens", {}))
             self._acl_bootstrap_index = snap.get("acl_bootstrap_index", 0)
+            self._queries = copy.deepcopy(snap.get("queries", {}))
             self._cond.notify_all()
 
     @classmethod

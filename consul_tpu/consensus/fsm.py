@@ -38,6 +38,8 @@ class ServerFSM:
             "acl_token_set": self._acl_token_set,
             "acl_token_delete": self._acl_token_delete,
             "acl_bootstrap": self._acl_bootstrap,
+            "query_set": self._query_set,
+            "query_delete": self._query_delete,
         }
 
     def apply(self, cmd: Dict[str, Any]) -> Any:
@@ -143,6 +145,15 @@ class ServerFSM:
 
     def _acl_token_delete(self, accessor):
         return {"index": self.store.acl_token_delete(accessor)}
+
+    def _query_set(self, qid, query):
+        try:
+            return {"index": self.store.query_set(qid, query)}
+        except ValueError as e:
+            return {"error": str(e), "index": self.store.index}
+
+    def _query_delete(self, qid):
+        return {"index": self.store.query_delete(qid)}
 
     def _acl_bootstrap(self, accessor, secret):
         ok, idx = self.store.acl_bootstrap(accessor, secret)

@@ -1,0 +1,216 @@
+"""Prepared queries: stored service lookups with templates + DC failover.
+
+The reference's PreparedQuery endpoint (agent/consul/prepared_query_endpoint.go:
+341 Execute, :477 ExecuteRemote) and template engine
+(agent/consul/prepared_query/template.go).  A query definition:
+
+    {"name": "...", "service": {"service": "web", "tags": [...],
+     "only_passing": bool, "near": "<node>|_agent",
+     "failover": {"nearest_n": 2, "datacenters": ["dc2", ...]}},
+     "template": {"type": "name_prefix_match", "regexp": "..."},
+     "dns": {"ttl": "10s"}}
+
+Execution (Execute, :341): resolve by id or name — falling back to
+template match on the name — look up healthy instances, filter by tags,
+RTT-sort from the near-node, and when the local DC has no instances walk
+the failover DC list (nearest_n by WAN coordinate distance first, then
+the explicit list — querySetLimit/queryFailover, :600-700 region).
+
+Template interpolation supports ${name.full}, ${name.prefix},
+${name.suffix}, and ${match(N)} regex groups (template.go).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+TEMPLATE_NAME_PREFIX = "name_prefix_match"
+
+
+class QueryError(Exception):
+    pass
+
+
+def _interp(text: str, name: str, prefix: str,
+            groups: List[str]) -> str:
+    """Template variable interpolation (template.go renderTemplate)."""
+
+    def sub(m):
+        var = m.group(1).strip()
+        if var == "name.full":
+            return name
+        if var == "name.prefix":
+            return prefix
+        if var == "name.suffix":
+            return name[len(prefix):]
+        gm = re.fullmatch(r"match\((\d+)\)", var)
+        if gm:
+            i = int(gm.group(1))
+            return groups[i] if i < len(groups) else ""
+        return ""
+
+    return re.sub(r"\$\{([^}]*)\}", sub, text)
+
+
+def resolve(store, name_or_id: str) -> Optional[dict]:
+    """Find a query by id, exact name, or template match; template queries
+    are rendered against the looked-up name (prepared_query_endpoint.go
+    ExecuteRemote resolve + template apply)."""
+    q = store.query_get(name_or_id) or store.query_get_by_name(name_or_id)
+    if q is not None:
+        if not q.get("template"):
+            return q
+        # direct hit on a template (by id or exact name): render against
+        # the given lookup string so no raw ${...} ever leaks into a
+        # service lookup (the reference renders with empty matches here)
+        prefix = q.get("name", "")
+        if not name_or_id.startswith(prefix):
+            prefix = ""
+        return _render(q, name_or_id, prefix, [])
+    # template search: longest matching name_prefix_match, else regexp
+    best = None
+    for cand in store.query_list():
+        tpl = cand.get("template")
+        if not tpl:
+            continue
+        ttype = tpl.get("type", TEMPLATE_NAME_PREFIX)
+        if ttype == TEMPLATE_NAME_PREFIX:
+            prefix = cand.get("name", "")
+            if name_or_id.startswith(prefix):
+                if best is None or len(prefix) > len(best[1]):
+                    best = (cand, prefix, [])
+        elif ttype == "regexp":
+            try:
+                m = re.match(tpl.get("regexp", "$^"), name_or_id)
+            except re.error:
+                continue  # a bad stored regexp must not poison resolution
+            if m and best is None:
+                best = (cand, cand.get("name", ""), [m.group(0),
+                                                     *m.groups()])
+    if best is None:
+        return None
+    cand, prefix, groups = best
+    return _render(cand, name_or_id, prefix, groups)
+
+
+def _render(q: dict, name: str, prefix: str, groups: List[str]) -> dict:
+    import copy
+    out = copy.deepcopy(q)
+
+    def walk(obj):
+        if isinstance(obj, str):
+            return _interp(obj, name, prefix, groups)
+        if isinstance(obj, list):
+            return [walk(x) for x in obj]
+        if isinstance(obj, dict):
+            return {k: walk(v) for k, v in obj.items()}
+        return obj
+
+    svc = out.get("service") or {}
+    out["service"] = walk(svc)
+    return out
+
+
+class QueryExecutor:
+    """Executes prepared queries against (store, oracle) with DC failover.
+
+    `remote_execute(dc, query, limit)` is the cross-DC hook (ExecuteRemote
+    :477) — wired by the multi-DC layer; `dc_order()` ranks failover DCs
+    by WAN distance (router.GetDatacentersByDistance)."""
+
+    def __init__(self, store, oracle=None, node_name: str = "node0",
+                 dc: str = "dc1",
+                 remote_execute: Optional[Callable] = None,
+                 dc_order: Optional[Callable[[], List[str]]] = None):
+        self.store = store
+        self.oracle = oracle
+        self.node_name = node_name
+        self.dc = dc
+        self.remote_execute = remote_execute
+        self.dc_order = dc_order
+
+    # ------------------------------------------------------------- execute
+
+    def execute(self, name_or_id: str, limit: int = 0,
+                near: Optional[str] = None) -> Optional[dict]:
+        """Execute → {"Service", "Nodes", "DNS", "Datacenter",
+        "Failovers"}; None when the query doesn't resolve (DNS answers
+        NXDOMAIN)."""
+        q = resolve(self.store, name_or_id)
+        if q is None:
+            return None
+        svc = q.get("service") or {}
+        service = svc.get("service", "")
+        rows = self._local_rows(svc)
+        failovers = 0
+        result_dc = self.dc
+        if not rows:
+            rows, result_dc, failovers = self._failover(q, svc)
+        rows = self._sort(rows, near or svc.get("near"))
+        if limit:
+            rows = rows[:limit]
+        return {"Service": service, "Datacenter": result_dc,
+                "Failovers": failovers, "Nodes": rows,
+                "DNS": q.get("dns") or {}}
+
+    def _local_rows(self, svc: dict) -> List[dict]:
+        service = svc.get("service", "")
+        tags = [t for t in (svc.get("tags") or []) if not t.startswith("!")]
+        banned = [t[1:] for t in (svc.get("tags") or [])
+                  if t.startswith("!")]
+        rows = self.store.health_service_nodes(
+            service, passing_only=bool(svc.get("only_passing")))
+        out = []
+        for r in rows:
+            s = r["service"] if isinstance(r, dict) and "service" in r else r
+            row_tags = s.get("tags", [])
+            if any(t not in row_tags for t in tags):
+                continue
+            if any(t in row_tags for t in banned):
+                continue
+            # non-passing-only still drops critical (health filter)
+            checks = r.get("checks", []) if isinstance(r, dict) else []
+            if any(c["status"] == "critical" for c in checks):
+                continue
+            out.append(s)
+        return out
+
+    def _failover(self, q: dict, svc: dict):
+        """Walk failover DCs: nearest_n by WAN distance, then explicit
+        list, dedup preserving order (queryFailover)."""
+        fo = svc.get("failover") or {}
+        dcs: List[str] = []
+        n = int(fo.get("nearest_n", 0))
+        if n > 0 and self.dc_order is not None:
+            for d in self.dc_order()[:n + 1]:
+                if d != self.dc:
+                    dcs.append(d)
+            dcs = dcs[:n]
+        for d in fo.get("datacenters") or []:
+            if d != self.dc and d not in dcs:
+                dcs.append(d)
+        failovers = 0
+        if self.remote_execute is None:
+            return [], self.dc, len(dcs)
+        for d in dcs:
+            failovers += 1
+            try:
+                rows = self.remote_execute(d, q)
+            except Exception:
+                continue
+            if rows:
+                return rows, d, failovers
+        return [], self.dc, failovers
+
+    def _sort(self, rows: List[dict], near: Optional[str]) -> List[dict]:
+        origin = self.node_name if near in (None, "", "_agent") else near
+        if self.oracle is None:
+            return rows
+        try:
+            order = self.oracle.sort_by_rtt(origin,
+                                            [r["node"] for r in rows])
+            pos = {n: i for i, n in enumerate(order)}
+            return sorted(rows, key=lambda r: pos.get(r["node"], 1 << 30))
+        except (KeyError, IndexError):
+            return rows

@@ -321,6 +321,15 @@ class Server:
         r = self.raft_apply("acl_bootstrap", accessor=accessor, secret=secret)
         return r["ok"], r["index"]
 
+    def query_set(self, qid, query):
+        r = self.raft_apply("query_set", qid=qid, query=query)
+        if "error" in r:
+            raise ValueError(r["error"])
+        return r["index"]
+
+    def query_delete(self, qid):
+        return self.raft_apply("query_delete", qid=qid)["index"]
+
     # ------------------------------------------------------------- read side
     # Stale reads hit the local replica directly; the HTTP layer decides.
 
