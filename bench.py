@@ -55,11 +55,22 @@ def main():
     wall = time.time() - t0
 
     ok = frac > 0.999
+    # detection accuracy at the measured end state: recall = the victim
+    # converged; FP = live nodes with committed deaths (must be 0 — the
+    # coverage-guarded commit, models/swim.py _expire)
+    up = np.asarray(s.swim.up)
+    committed = np.asarray(s.swim.committed_dead)
+    false_commits = int((committed & up).sum())
+    tp = 1 if ok else 0
+    precision = tp / max(tp + false_commits, 1)
+    f1 = 2 * precision * tp / max(precision + tp, 1e-9)
     print(json.dumps({
         "metric": "serf_1M_node_crash_convergence_wallclock",
         "value": round(wall, 3),
         "unit": "s",
         "vs_baseline": round(TARGET_S / wall, 3) if ok else 0.0,
+        "f1": round(f1, 4),
+        "false_commits": false_commits,
     }))
     if not ok:
         print(f"# did not converge: frac={frac} after {ticks} ticks", file=sys.stderr)

@@ -353,17 +353,29 @@ def _make_handler(srv: ApiServer):
                                       "sim_nodes": oracle.n_nodes}})
                 return True
             if path == "/v1/agent/members" and verb == "GET":
-                # aclFilter: members filter by node:read, not 403
-                self._send([_member_json(m) for m in oracle.members()
+                # aclFilter: members filter by node:read, not 403.
+                # ?limit/?offset paginate (the sim targets N where a full
+                # dump is not servable)
+                limit = max(0, int(q["limit"])) if "limit" in q else None
+                offset = max(0, int(q.get("offset", 0) or 0))
+                self._send([_member_json(m)
+                            for m in oracle.members(limit=limit,
+                                                    offset=offset)
                             if self.authz.node_read(m["name"])])
                 return True
             if path == "/v1/agent/metrics" and verb == "GET":
                 if not self.authz.agent_read(srv.node_name):
                     return self._forbid()
-                self._send({"Timestamp": "", "Gauges": [
+                gauges = [
                     {"Name": "consul.sim.tick", "Value": oracle.tick},
                     {"Name": "consul.catalog.index", "Value": store.index},
-                ], "Counters": [], "Samples": []})
+                ]
+                if hasattr(oracle, "members_summary"):
+                    ms = oracle.members_summary()
+                    gauges += [{"Name": f"consul.members.{k}", "Value": v}
+                               for k, v in ms.items()]
+                self._send({"Timestamp": "", "Gauges": gauges,
+                            "Counters": [], "Samples": []})
                 return True
             if path == "/v1/agent/services" and verb == "GET":
                 if srv.local is not None:

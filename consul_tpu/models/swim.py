@@ -468,26 +468,49 @@ def _suspicion_expiry(params: SwimParams, s: SwimState) -> SwimState:
         & ~refuted & observer                                    # [N, U]
     any_exp = jnp.any(expired, axis=0)                           # [U]
 
-    suspect_of, dead_of, left_of, _ = maps
-    subj_exp = jnp.zeros((n,), bool).at[jnp.where(any_exp, s.r_subject, 0)].max(any_exp)
-    fresh = subj_exp & (dead_of < 0) & ~s.committed_dead
-    want = jnp.where(fresh, 1, 0)
-
-    # row i knows the new dead rumor if one of its suspicions expired; when
-    # several expired at once the first one's subject is used (the rest are
-    # picked up by dissemination a tick later)
-    first_slot = jnp.argmax(expired, axis=1)                     # [N]
-    has_exp = jnp.any(expired, axis=1)
-    row_subject = jnp.where(has_exp, _table_lookup(s.r_subject, first_slot),
-                            -1)
-    return _originate(params, s, want, DEAD, s.incarnation, row_subject)
+    # Convert each expired suspect slot into its dead rumor IN PLACE (no
+    # allocation, so conversion can't be starved under slot pressure).
+    # Fidelity: the dead rumor's initial carriers are ONLY the holders
+    # whose own timer expired (memberlist nodes mark dead independently);
+    # unexpired and refuted carriers drop off the slot and must re-learn
+    # the death through dissemination like any other receiver.  Skip when
+    # a dead rumor already exists or the death is committed.
+    _, dead_of, _, _ = maps
+    dead_exists = dead_of[s.r_subject] >= 0                      # [U]
+    convert = any_exp & ~dead_exists & ~s.committed_dead[s.r_subject]
+    know = jnp.where(convert[None, :], expired, s.know)
+    return s.replace(
+        r_kind=jnp.where(convert, DEAD, s.r_kind),
+        r_start=jnp.where(convert, tick, s.r_start),
+        know=know,
+        learn_tick=jnp.where(convert[None, :] & expired, tick,
+                             s.learn_tick),
+        sends_left=jnp.where(convert[None, :],
+                             jnp.where(expired,
+                                       jnp.int8(params.retransmit_limit),
+                                       jnp.int8(0)),
+                             s.sends_left))
 
 
 def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     """A live subject that hears it is suspected bumps its incarnation and
-    broadcasts alive (SWIM refutation; memberlist aliveNode).  All index
-    work here is [U]-space (tiny)."""
+    broadcasts alive (SWIM refutation; memberlist aliveNode).
+
+    The refutation TRANSFORMS the suspect slot in place into the alive
+    broadcast — no slot allocation.  The allocate-a-new-slot formulation
+    silently failed under slot exhaustion, letting false suspicions of
+    live nodes expire unrefuted and commit as deaths under loss (the
+    round-1 F1 gap); in-place conversion can never be starved.
+
+    Known approximation: holders whose timer had ALREADY expired flip
+    back to not-down immediately when the slot converts, where memberlist
+    would correct them only when the alive(inc+1) reaches them (~log N
+    ticks).  Refutation normally lands within ~1 probe round of the
+    subject hearing the suspicion — two orders of magnitude inside the
+    suspicion timeout — so the affected population is the rare holder
+    that expired during that window.  All index work is [U]-space."""
     u = params.rumor_slots
+    n = params.n_nodes
     is_suspect = s.r_active & (s.r_kind == SUSPECT)
     subj = s.r_subject
     subject_knows = s.know[subj, jnp.arange(u)]                  # [U]
@@ -496,34 +519,22 @@ def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     # bump incarnation above the suspected one
     inc = s.incarnation.at[jnp.where(need, subj, 0)].max(
         jnp.where(need, s.r_inc + 1, _NEG))
-    s = s.replace(incarnation=inc)
-
-    _, _, _, alive_val = _maps(params, s)
-    has_alive = alive_val[subj] >= 0                             # [U]
-    # in-place refresh of an existing alive rumor for this subject
-    refresh_slot = jnp.where(need & has_alive, alive_val[subj] % u, -1)  # [U]
-    refresh = jnp.zeros((u,), bool).at[jnp.clip(refresh_slot, 0, u - 1)].max(refresh_slot >= 0)
-    new_inc_of = s.incarnation                                    # [N]
-    tgt_subj = s.r_subject                                        # [U]
-    r_inc = jnp.where(refresh, new_inc_of[tgt_subj], s.r_inc)
-    r_start = jnp.where(refresh, s.tick, s.r_start)
-    onehot_subj = (jnp.arange(params.n_nodes)[:, None] == tgt_subj[None, :])
-    cell_keep = ~refresh[None, :] & s.know
-    cell_new = refresh[None, :] & onehot_subj
-    know = cell_keep | cell_new
-    learn_tick = jnp.where(cell_new, s.tick, s.learn_tick)
-    sends_left = jnp.where(cell_new, jnp.int8(params.retransmit_limit),
-                           jnp.where(refresh[None, :], jnp.int8(0),
-                                     s.sends_left))
-    s = s.replace(r_inc=r_inc, r_start=r_start, know=know,
-                  learn_tick=learn_tick, sends_left=sends_left)
-
-    # allocate alive rumors for refuting subjects with no existing alive slot
-    want = jnp.zeros((params.n_nodes,), jnp.int32).at[
-        jnp.where(need & ~has_alive, subj, 0)].max(
-        jnp.where(need & ~has_alive, 1, 0))
-    row_subject = jnp.where(want > 0, jnp.arange(params.n_nodes), -1)
-    return _originate(params, s, want, ALIVE, s.incarnation, row_subject)
+    # convert the suspect slot: alive(inc+1) broadcast seeded at the
+    # subject, full retransmit budget
+    onehot_subj = (jnp.arange(n)[:, None] == subj[None, :])      # [N, U]
+    cell_new = need[None, :] & onehot_subj
+    return s.replace(
+        incarnation=inc,
+        r_kind=jnp.where(need, ALIVE, s.r_kind),
+        r_inc=jnp.where(need, inc[subj], s.r_inc),
+        r_start=jnp.where(need, s.tick, s.r_start),
+        know=jnp.where(need[None, :], cell_new, s.know),
+        learn_tick=jnp.where(cell_new, s.tick, s.learn_tick),
+        sends_left=jnp.where(need[None, :],
+                             jnp.where(cell_new,
+                                       jnp.int8(params.retransmit_limit),
+                                       jnp.int8(0)),
+                             s.sends_left))
 
 
 def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
@@ -549,15 +560,28 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
 
 def _expire(params: SwimParams, s: SwimState) -> SwimState:
     """Free slots whose dissemination window has passed; commit dead/left
-    into the O(N) baseline (assumes full coverage — the dissemination window
-    is several multiples of the O(log N) spread time)."""
+    into the O(N) baseline.
+
+    Commit is coverage-guarded (VERDICT r1 weak #7): a timer alone could
+    commit a belief most nodes never heard under heavy loss.  A slot holds
+    past its nominal lifetime until >=99.5% of live members carry it (or a
+    4x hard cap); at expiry the belief only commits when a majority heard
+    it — a rumor that failed to spread ages out without poisoning the
+    baseline, like memberlist state that was never disseminated."""
     tick = s.tick
     life = jnp.where(s.r_kind == SUSPECT,
                      params.expiry_suspect_ticks, params.expiry_gossip_ticks)
-    done = s.r_active & (tick - s.r_start >= life)
-    commit_dead = done & (s.r_kind == DEAD)
-    commit_left = done & (s.r_kind == LEFT)
-    commit_alive = done & (s.r_kind == ALIVE)
+    age = tick - s.r_start
+    live = s.up & s.member
+    n_live = jnp.maximum(jnp.sum(live), 1)
+    coverage = jnp.sum(s.know & live[:, None],
+                       axis=0).astype(jnp.float32) / n_live      # [U]
+    done = s.r_active & (age >= life) \
+        & ((coverage >= 0.995) | (age >= 4 * life))
+    commit_ok = coverage >= 0.5
+    commit_dead = done & (s.r_kind == DEAD) & commit_ok
+    commit_left = done & (s.r_kind == LEFT) & commit_ok
+    commit_alive = done & (s.r_kind == ALIVE) & commit_ok
     committed_dead = s.committed_dead.at[
         jnp.where(commit_dead, s.r_subject, 0)].max(commit_dead)
     committed_left = s.committed_left.at[

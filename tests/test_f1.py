@@ -1,0 +1,87 @@
+"""Detection accuracy under loss + the coverage-guarded commit.
+
+VERDICT r1 #9: `_expire` committed dead beliefs on a timer assuming full
+dissemination; under loss that can commit a belief most nodes never
+heard.  These tests pin the guard:
+
+  * a dead rumor that never spread (no retransmit budget) ages out
+    WITHOUT committing;
+  * at p_loss=0.05 with real kills there are zero false committed deaths
+    and every real death still commits;
+  * the F1 harness scores 1.0 on a clean network.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import swim
+
+import jax
+
+
+def _params(n=256, p_loss=0.0, seed=3):
+    return swim.make_params(GossipConfig.lan(),
+                            SimConfig(n_nodes=n, rumor_slots=16,
+                                      alloc_cap=4, p_loss=p_loss,
+                                      seed=seed))
+
+
+def test_unspread_dead_rumor_does_not_commit():
+    params = _params()
+    s = swim.init_state(params)
+    # forge a dead rumor about a LIVE node, known only to node 0, with no
+    # retransmit budget: it can never disseminate
+    victim = 9
+    s = s.replace(
+        r_active=s.r_active.at[0].set(True),
+        r_kind=s.r_kind.at[0].set(swim.DEAD),
+        r_subject=s.r_subject.at[0].set(victim),
+        r_start=s.r_start.at[0].set(s.tick),
+        know=s.know.at[0, 0].set(True),
+        sends_left=s.sends_left.at[0, 0].set(0),
+    )
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    # run well past the 4x hard cap
+    s2, _ = run(params, s, 4 * params.expiry_gossip_ticks + 50, None)
+    assert not bool(s2.committed_dead[victim]), \
+        "an undisseminated dead rumor was committed"
+    assert not bool(s2.r_active[0]), "slot was never freed"
+
+
+def test_real_death_still_commits_with_guard():
+    params = _params()
+    s = swim.init_state(params)
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    s, _ = run(params, s, 25, None)
+    s = swim.kill(s, 7)
+    s, _ = run(params, s, 700, None)
+    assert bool(s.committed_dead[7]), "real death failed to commit"
+
+
+def test_no_false_commits_at_p_loss_005():
+    """The VERDICT done-criterion: zero false committed deaths at
+    p_loss=0.05, while real deaths commit."""
+    params = _params(n=512, p_loss=0.05, seed=11)
+    s = swim.init_state(params)
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    s, _ = run(params, s, 25, None)
+    victims = [5, 50, 500]
+    for v in victims:
+        s = swim.kill(s, v)
+    s, _ = run(params, s, 900, None)
+    up = np.asarray(s.up)
+    committed = np.asarray(s.committed_dead)
+    assert int((committed & up).sum()) == 0, "false committed death(s)"
+    for v in victims:
+        assert bool(committed[v]), f"victim {v} not committed dead"
+
+
+def test_f1_harness_clean_network():
+    import sys
+    sys.path.insert(0, "tools")
+    from f1_harness import run_one
+    res = run_one(n=512, kills=4, ticks=700, p_loss=0.0, seed=5)
+    assert res["f1"] == 1.0
+    assert res["false_commits"] == 0

@@ -1,0 +1,80 @@
+"""Failure-detection accuracy (F1) harness under packet loss.
+
+VERDICT r1 #9: the north-star metric is convergence wall-clock *with
+detection F1 matching a live run* — nothing measured false positives.
+This sweeps p_loss ∈ {0.02, 0.05, 0.10}, kills K nodes, runs the
+detector to steady state, and scores:
+
+  recall    = killed nodes believed down by >99% of live members
+  precision = TP / (TP + FP), FP = live nodes committed dead OR believed
+              down by a majority of live members
+  false_commits = committed_dead & actually-up (must be 0)
+
+Usage: python tools/f1_harness.py [N] [kills] [ticks]
+Prints one JSON line per p_loss.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+import numpy as np
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import swim
+
+
+def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7):
+    params = swim.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=n, rumor_slots=32,
+                                        alloc_cap=8, p_loss=p_loss,
+                                        seed=seed))
+    s = swim.init_state(params)
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    s, _ = run(params, s, 25, None)                      # steady state
+    victims = list(range(3, 3 + kills * 7, 7))[:kills]
+    for v in victims:
+        s = swim.kill(s, v)
+    s, _ = run(params, s, ticks, None)
+
+    up = np.asarray(s.up)
+    committed = np.asarray(s.committed_dead)
+    false_commits = int((committed & up).sum())
+
+    tp = 0
+    for v in victims:
+        frac = float(swim.believed_down_fraction(params, s, v))
+        if frac > 0.99:
+            tp += 1
+    # FP beliefs: sample live nodes, majority-believed-down
+    rng = np.random.default_rng(seed)
+    live_ids = np.nonzero(up)[0]
+    sample = rng.choice(live_ids, size=min(64, len(live_ids)),
+                        replace=False)
+    fp = false_commits
+    for i in sample:
+        if committed[i]:
+            continue  # already counted in false_commits
+        frac = float(swim.believed_down_fraction(params, s, int(i)))
+        if frac > 0.5:
+            fp += 1
+    precision = tp / max(tp + fp, 1)
+    recall = tp / max(len(victims), 1)
+    f1 = 2 * precision * recall / max(precision + recall, 1e-9)
+    return {"p_loss": p_loss, "n": n, "kills": kills,
+            "recall": round(recall, 4), "precision": round(precision, 4),
+            "f1": round(f1, 4), "false_commits": false_commits}
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    kills = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 900
+    for p_loss in (0.02, 0.05, 0.10):
+        print(json.dumps(run_one(n, kills, ticks, p_loss)))
+
+
+if __name__ == "__main__":
+    main()
