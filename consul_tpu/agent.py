@@ -23,6 +23,85 @@ from consul_tpu.oracle import GossipOracle
 
 
 class Agent:
+    @classmethod
+    def from_config(cls, rc=None, config_files=(), config_dirs=(),
+                    **flags) -> "Agent":
+        """Build an agent from the multi-source config pipeline
+        (consul_tpu/runtime_config.py; reference agent/config/builder.go
+        → RuntimeConfig → agent.New).  The sources are remembered so
+        `reload()` / PUT /v1/agent/reload can re-read them."""
+        from consul_tpu import runtime_config as rcfg
+        if rc is None:
+            rc = rcfg.load(files=list(config_files), dirs=list(config_dirs),
+                           **flags)
+        a = cls(gossip=rc.gossip_config(), sim=rc.sim_config(),
+                node_name=rc.node_name, http_port=rc.http_port,
+                dc=rc.datacenter, acl_enabled=rc.acl_enabled,
+                acl_default_policy=rc.acl_default_policy,
+                acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port)
+        a.runtime_config = rc
+        a._config_sources = (tuple(config_files), tuple(config_dirs),
+                             dict(flags))
+        a._apply_reloadable(rc)
+        if config_files or config_dirs:
+            # only re-readable sources make reload meaningful; a literal
+            # rc would "reload" back to pure defaults
+            a.api.reload_fn = a.reload
+        return a
+
+    def _apply_reloadable(self, rc) -> None:
+        """Apply the reloadable subset: DNS options + static service/check
+        definitions (the reference's ReloadConfig surface).  Definitions
+        removed from the config are deregistered; runtime check state is
+        preserved across reloads (snapshotCheckState parity)."""
+        self.dns.only_passing = rc.dns_only_passing
+        self.dns.node_ttl = rc.dns_node_ttl
+        self.dns.service_ttl = rc.dns_service_ttl
+        self.dns.domain = rc.dns_domain.rstrip(".").lower()
+        new_sids, new_cids = set(), set()
+        for svc in rc.services:
+            name = svc.get("Name") or svc.get("name")
+            sid = svc.get("ID") or svc.get("id") or name
+            new_sids.add(sid)
+            self.local.add_service(
+                sid, name, port=svc.get("Port") or svc.get("port") or 0,
+                tags=svc.get("Tags") or svc.get("tags") or [],
+                meta=svc.get("Meta") or svc.get("meta") or {})
+        existing_checks = self.local.checks()
+        for chk in rc.checks:
+            cid = chk.get("CheckID") or chk.get("id") or chk.get("Name")
+            new_cids.add(cid)
+            if cid in existing_checks:
+                continue  # keep runtime status across reloads
+            self.local.add_check(cid, chk.get("Name") or cid,
+                                 status=chk.get("Status", "critical"))
+        # deregister config-origin definitions dropped from the sources
+        for sid in getattr(self, "_config_service_ids", set()) - new_sids:
+            self.local.remove_service(sid)
+        for cid in getattr(self, "_config_check_ids", set()) - new_cids:
+            self.local.remove_check(cid)
+        self._config_service_ids = new_sids
+        self._config_check_ids = new_cids
+
+    def reload(self):
+        """Re-read config sources and apply reloadable fields; returns
+        {"reloaded": [...], "restart_required": [...]} (SIGHUP path,
+        reference server.go:1395 / Agent.ReloadConfig)."""
+        from consul_tpu import runtime_config as rcfg
+        files, dirs, flags = getattr(
+            self, "_config_sources", ((), (), {}))
+        new_rc = rcfg.load(files=list(files), dirs=list(dirs), **flags)
+        old_rc = getattr(self, "runtime_config", new_rc)
+        reload_keys, restart_keys = rcfg.diff_reloadable(old_rc, new_rc)
+        self._apply_reloadable(new_rc)
+        self.runtime_config = new_rc
+        if reload_keys:
+            try:
+                self.local.sync_changes(self.store)
+            except Exception:
+                pass
+        return {"reloaded": reload_keys, "restart_required": restart_keys}
+
     def __init__(self, gossip: Optional[GossipConfig] = None,
                  sim: Optional[SimConfig] = None,
                  node_name: str = "node0", http_port: int = 0,

@@ -106,6 +106,8 @@ class ApiServer:
         from consul_tpu.prepared_query import QueryExecutor
         self.query_executor = QueryExecutor(
             self.store, self.oracle, node_name=node_name, dc=dc)
+        # set by Agent.from_config: PUT /v1/agent/reload re-reads config
+        self.reload_fn = None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
@@ -502,6 +504,15 @@ def _make_handler(srv: ApiServer):
                         self._err(404, "unknown check")
                         return True
                 self._send(None)
+                return True
+            if path == "/v1/agent/reload" and verb == "PUT":
+                # agent:write like the reference (AgentReload)
+                if not self.authz.agent_write(srv.node_name):
+                    return self._forbid()
+                if srv.reload_fn is None:
+                    self._err(400, "agent not started from config sources")
+                    return True
+                self._send(srv.reload_fn())
                 return True
             m = re.fullmatch(r"/v1/agent/force-leave/(.+)", path)
             if m and verb == "PUT":

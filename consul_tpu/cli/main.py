@@ -260,17 +260,37 @@ def cmd_agent(args) -> int:
     from consul_tpu.agent import Agent
     from consul_tpu.config import GossipConfig, SimConfig
 
-    gossip = GossipConfig.wan() if args.wan_defaults else GossipConfig.lan()
-    sim = SimConfig(n_nodes=args.sim_nodes, rumor_slots=args.rumor_slots,
-                    p_loss=args.p_loss, seed=args.seed)
-    a = Agent(gossip, sim, node_name=args.node, http_port=args.http_port,
-              dc=args.datacenter)
+    if args.config_file or args.config_dir:
+        # config pipeline: files/dirs ← CLI flags (builder.go precedence);
+        # sim flags ride the same merge so nothing is silently dropped
+        sim_flags = {k: v for k, v in {
+            "n_nodes": args.sim_nodes, "rumor_slots": args.rumor_slots,
+            "p_loss": args.p_loss, "seed": args.seed}.items()
+            if v is not None}
+        a = Agent.from_config(
+            config_files=args.config_file or (),
+            config_dirs=args.config_dir or (),
+            node_name=args.node, datacenter=args.datacenter,
+            http_port=args.http_port,
+            sim=sim_flags or None)
+    else:
+        gossip = GossipConfig.wan() if args.wan_defaults \
+            else GossipConfig.lan()
+        sim = SimConfig(n_nodes=args.sim_nodes or 64,
+                        rumor_slots=args.rumor_slots or 16,
+                        p_loss=args.p_loss if args.p_loss is not None
+                        else 0.01,
+                        seed=args.seed or 0)
+        a = Agent(gossip, sim, node_name=args.node or "node0",
+                  http_port=args.http_port
+                  if args.http_port is not None else 8500,
+                  dc=args.datacenter or "dc1")
     a.start(tick_seconds=args.tick_seconds)
     print(f"==> consul-tpu agent running")
-    print(f"       Node name: {args.node}")
-    print(f"      Datacenter: {args.datacenter}")
+    print(f"       Node name: {a.node_name}")
+    print(f"      Datacenter: {a.api.dc}")
     print(f"       HTTP addr: {a.http_address}")
-    print(f"       Sim nodes: {args.sim_nodes}")
+    print(f"       Sim nodes: {a.oracle.n_nodes}")
     try:
         while True:
             time.sleep(1)
@@ -462,15 +482,19 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("leave").set_defaults(fn=cmd_leave)
 
     sp = sub.add_parser("agent")
-    sp.add_argument("-node", default="node0")
-    sp.add_argument("-datacenter", "-dc", default="dc1")
-    sp.add_argument("-http-port", type=int, default=8500)
-    sp.add_argument("-sim-nodes", type=int, default=64)
-    sp.add_argument("-rumor-slots", type=int, default=16)
-    sp.add_argument("-p-loss", type=float, default=0.01)
-    sp.add_argument("-seed", type=int, default=0)
+    # None = not given, so explicit flags are distinguishable from
+    # defaults and win over config files (builder precedence)
+    sp.add_argument("-node", default=None)
+    sp.add_argument("-datacenter", "-dc", default=None)
+    sp.add_argument("-http-port", type=int, default=None)
+    sp.add_argument("-sim-nodes", type=int, default=None)
+    sp.add_argument("-rumor-slots", type=int, default=None)
+    sp.add_argument("-p-loss", type=float, default=None)
+    sp.add_argument("-seed", type=int, default=None)
     sp.add_argument("-tick-seconds", type=float, default=0.05)
     sp.add_argument("-wan-defaults", action="store_true")
+    sp.add_argument("-config-file", action="append", default=None)
+    sp.add_argument("-config-dir", action="append", default=None)
     sp.set_defaults(fn=cmd_agent)
     return p
 

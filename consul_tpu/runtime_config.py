@@ -1,0 +1,373 @@
+"""Config system: multi-source merge → frozen RuntimeConfig + reload.
+
+The reference's config pipeline (agent/config/builder.go Builder.Build;
+immutable result agent/config/runtime.go:43 RuntimeConfig; defaults
+default.go:17-120; SIGHUP reload server.go:1395 ReloadableConfig):
+
+    defaults  ←  config files / dirs (HCL or JSON, auto-detected)
+              ←  CLI flags
+              →  validate  →  frozen RuntimeConfig
+
+Supported keys mirror the reference's surface where this framework has
+the feature: node_name, datacenter, server, ports{http,dns}, acl{...},
+gossip_lan{...}, gossip_wan{...}, sim{...} (the TPU pool sizing — this
+framework's analogue of bind/advertise), dns_config{...}, checks[...],
+services[...], log_level.
+
+Reload (`Agent.reload` / PUT /v1/agent/reload) re-applies the RELOADABLE
+subset — log_level, dns_config, check/service definitions — and reports
+which changed fields require a restart, like the reference's reload
+warning path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+RELOADABLE = {"log_level", "dns_config", "checks", "services"}
+
+
+class ConfigError(Exception):
+    pass
+
+
+# --------------------------------------------------------------- HCL subset
+
+_TOKEN = re.compile(r'''
+    (?P<ws>\s+|\#[^\n]*|//[^\n]*)
+  | (?P<lbrace>\{) | (?P<rbrace>\})
+  | (?P<lbrack>\[) | (?P<rbrack>\])
+  | (?P<eq>=) | (?P<comma>,)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<number>-?\d+(?:\.\d+)?)
+  | (?P<bool>\btrue\b|\bfalse\b)
+  | (?P<ident>[A-Za-z_][A-Za-z0-9_.-]*)
+''', re.X)
+
+
+def _tokenize(text: str):
+    pos = 0
+    out = []
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            raise ConfigError(f"bad config syntax at {text[pos:pos+20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "ws":
+            continue
+        out.append((kind, m.group()))
+    return out
+
+
+def parse_hcl(text: str) -> dict:
+    """Parse the HCL subset: `key = value`, `block "label" { ... }`,
+    lists, nested objects.  Labeled blocks become {key: {label: {...}}}
+    like hcl1's json representation."""
+    toks = _tokenize(text)
+    i = 0
+
+    def value():
+        nonlocal i
+        kind, tok = toks[i]
+        if kind == "string":
+            i += 1
+            return json.loads(tok)
+        if kind == "number":
+            i += 1
+            return float(tok) if "." in tok else int(tok)
+        if kind == "bool":
+            i += 1
+            return tok == "true"
+        if kind == "lbrack":
+            i += 1
+            items = []
+            while toks[i][0] != "rbrack":
+                items.append(value())
+                if toks[i][0] == "comma":
+                    i += 1
+            i += 1
+            return items
+        if kind == "lbrace":
+            return obj()
+        raise ConfigError(f"unexpected {tok!r}")
+
+    def obj():
+        nonlocal i
+        assert toks[i][0] == "lbrace"
+        i += 1
+        out: Dict[str, Any] = {}
+        while toks[i][0] != "rbrace":
+            for k, v in entry().items():
+                _merge_into(out, k, v)
+            if toks[i][0] == "comma":
+                i += 1
+        i += 1
+        return out
+
+    def entry():
+        nonlocal i
+        kind, tok = toks[i]
+        if kind not in ("ident", "string"):
+            raise ConfigError(f"expected key, got {tok!r}")
+        key = json.loads(tok) if kind == "string" else tok
+        i += 1
+        # labeled block: key "label" { ... }
+        labels = []
+        while i < len(toks) and toks[i][0] == "string":
+            labels.append(json.loads(toks[i][1]))
+            i += 1
+        if i < len(toks) and toks[i][0] == "eq":
+            i += 1
+            return {key: value()}
+        if i < len(toks) and toks[i][0] == "lbrace":
+            body = obj()
+            for lab in reversed(labels):
+                body = {lab: body}
+            return {key: body}
+        raise ConfigError(f"expected '=' or block after {key!r}")
+
+    out: Dict[str, Any] = {}
+    while i < len(toks):
+        for k, v in entry().items():
+            _merge_into(out, k, v)
+    return out
+
+
+def _merge_into(dst: dict, key: str, val: Any) -> None:
+    if key in dst and isinstance(dst[key], dict) and isinstance(val, dict):
+        for k, v in val.items():
+            _merge_into(dst[key], k, v)
+    elif key in dst and isinstance(dst[key], list) and isinstance(val, list):
+        dst[key] = dst[key] + val
+    else:
+        dst[key] = val
+
+
+def _deep_merge(base: dict, over: dict) -> dict:
+    out = dict(base)
+    for k, v in over.items():
+        if k in out and isinstance(out[k], dict) and isinstance(v, dict):
+            out[k] = _deep_merge(out[k], v)
+        elif k in out and isinstance(out[k], list) and isinstance(v, list):
+            # definitions accumulate across sources (two config files each
+            # adding a service both count — reference slice-merge)
+            out[k] = out[k] + v
+        else:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------------ RuntimeConfig
+
+@dataclasses.dataclass(frozen=True)
+class RuntimeConfig:
+    """Immutable merged config (agent/config/runtime.go:43)."""
+
+    node_name: str = "node0"
+    datacenter: str = "dc1"
+    server: bool = True
+    log_level: str = "INFO"
+    http_port: int = 0
+    dns_port: int = 0
+    # acl block (agent/config: acl{enabled, default_policy, down_policy,
+    # tokens{agent, default}})
+    acl_enabled: bool = False
+    acl_default_policy: str = "allow"
+    acl_down_policy: str = "extend-cache"
+    acl_agent_token: str = ""
+    # gossip tuning: (field, value) overrides onto GossipConfig defaults
+    gossip_lan: Tuple[Tuple[str, Any], ...] = ()
+    gossip_wan: Tuple[Tuple[str, Any], ...] = ()
+    # sim sizing (the TPU pool)
+    sim: Tuple[Tuple[str, Any], ...] = ()
+    # dns_config{only_passing, node_ttl, service_ttl, domain}
+    dns_only_passing: bool = False
+    dns_node_ttl: int = 0
+    dns_service_ttl: int = 0
+    dns_domain: str = "consul."
+    # static service/check definitions (lists of dicts, agent JSON shapes)
+    services: Tuple[dict, ...] = ()
+    checks: Tuple[dict, ...] = ()
+    # raw merged view for debugging / agent/self
+    raw: Tuple[Tuple[str, Any], ...] = ()
+
+    def gossip_config(self, wan: bool = False):
+        from consul_tpu.config import GossipConfig
+        base = GossipConfig.wan() if wan else GossipConfig.lan()
+        over = dict(self.gossip_wan if wan else self.gossip_lan)
+        return dataclasses.replace(base, **over) if over else base
+
+    def sim_config(self):
+        from consul_tpu.config import SimConfig
+        over = dict(self.sim)
+        return SimConfig(**over) if over else SimConfig()
+
+
+_DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)$")
+
+
+def _seconds(v: Any) -> Any:
+    if isinstance(v, str):
+        m = _DURATION.match(v)
+        if m:
+            scale = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+            return float(m.group(1)) * scale[m.group(2)]
+    return v
+
+
+class Builder:
+    """Accumulates sources in precedence order and builds (builder.go)."""
+
+    _GOSSIP_KEYS = {"probe_interval", "probe_timeout", "gossip_interval",
+                    "gossip_nodes", "indirect_checks", "suspicion_mult",
+                    "suspicion_max_timeout_mult", "retransmit_mult"}
+    _SIM_KEYS = {"n_nodes", "rumor_slots", "alloc_cap", "p_loss",
+                 "rtt_base_ms", "rtt_spread_ms", "coord_dims", "seed"}
+
+    def __init__(self):
+        self._sources: List[dict] = []
+
+    # ----------------------------------------------------------- sources
+
+    def add_dict(self, cfg: dict) -> "Builder":
+        self._sources.append(cfg)
+        return self
+
+    def add_file(self, path: str) -> "Builder":
+        with open(path) as f:
+            text = f.read()
+        if path.endswith(".json"):
+            cfg = json.loads(text or "{}")
+        elif path.endswith(".hcl"):
+            cfg = parse_hcl(text)
+        else:  # sniff (builder.go auto-detect)
+            try:
+                cfg = json.loads(text)
+            except json.JSONDecodeError:
+                cfg = parse_hcl(text)
+        if not isinstance(cfg, dict):
+            raise ConfigError(f"{path}: top level must be an object")
+        return self.add_dict(cfg)
+
+    def add_dir(self, path: str) -> "Builder":
+        """Load *.json/*.hcl in lexical order (config-dir semantics)."""
+        for name in sorted(os.listdir(path)):
+            if name.endswith((".json", ".hcl")):
+                self.add_file(os.path.join(path, name))
+        return self
+
+    def add_flags(self, **flags: Any) -> "Builder":
+        """CLI flags: highest precedence; None values are unset.  Flat
+        port flags nest into the ports block so deep-merge precedence
+        holds (an explicit -http-port must beat a file's ports.http)."""
+        src = {k: v for k, v in flags.items() if v is not None}
+        ports = {}
+        if "http_port" in src:
+            ports["http"] = src.pop("http_port")
+        if "dns_port" in src:
+            ports["dns"] = src.pop("dns_port")
+        if ports:
+            src["ports"] = {**src.get("ports", {}), **ports}
+        self._sources.append(src)
+        return self
+
+    # ------------------------------------------------------------- build
+
+    def build(self) -> RuntimeConfig:
+        merged: dict = {}
+        for src in self._sources:
+            merged = _deep_merge(merged, src)
+        return self._to_runtime(merged)
+
+    def _to_runtime(self, m: dict) -> RuntimeConfig:
+        acl = m.get("acl") or {}
+        tokens = acl.get("tokens") or {}
+        ports = m.get("ports") or {}
+        dnscfg = m.get("dns_config") or {}
+
+        def gossip_block(name):
+            blk = m.get(name) or {}
+            bad = set(blk) - self._GOSSIP_KEYS
+            if bad:
+                raise ConfigError(f"{name}: unknown keys {sorted(bad)}")
+            return tuple(sorted((k, _seconds(v)) for k, v in blk.items()))
+
+        sim = m.get("sim") or {}
+        bad = set(sim) - self._SIM_KEYS
+        if bad:
+            raise ConfigError(f"sim: unknown keys {sorted(bad)}")
+
+        dp = acl.get("default_policy", "allow")
+        if dp not in ("allow", "deny"):
+            raise ConfigError(f"acl.default_policy must be allow|deny, "
+                              f"got {dp!r}")
+        down = acl.get("down_policy", "extend-cache")
+        if down not in ("allow", "deny", "extend-cache", "async-cache"):
+            raise ConfigError(f"acl.down_policy invalid: {down!r}")
+        for svc in m.get("services") or []:
+            if not (svc.get("Name") or svc.get("name")):
+                raise ConfigError("service definition missing name")
+
+        def freeze(d):
+            return tuple(sorted(d.items()))
+
+        return RuntimeConfig(
+            node_name=m.get("node_name", "node0"),
+            datacenter=m.get("datacenter", "dc1"),
+            server=bool(m.get("server", True)),
+            log_level=str(m.get("log_level", "INFO")).upper(),
+            http_port=int(ports.get("http", 0) or 0),
+            dns_port=int(ports.get("dns", 0) or 0),
+            acl_enabled=bool(acl.get("enabled", False)),
+            acl_default_policy=dp,
+            acl_down_policy=down,
+            acl_agent_token=tokens.get("agent", ""),
+            gossip_lan=gossip_block("gossip_lan"),
+            gossip_wan=gossip_block("gossip_wan"),
+            sim=tuple(sorted(sim.items())),
+            dns_only_passing=bool(dnscfg.get("only_passing", False)),
+            dns_node_ttl=int(_seconds(dnscfg.get("node_ttl", 0)) or 0),
+            dns_service_ttl=int(_seconds(dnscfg.get("service_ttl", 0)) or 0),
+            dns_domain=str(dnscfg.get("domain", "consul.")),
+            services=tuple(m.get("services") or []),
+            checks=tuple(m.get("checks") or []),
+            raw=freeze({k: json.dumps(v, sort_keys=True)
+                        for k, v in m.items()}),
+        )
+
+
+def load(files: List[str] = (), dirs: List[str] = (),
+         **flags: Any) -> RuntimeConfig:
+    """One-call load: defaults ← files ← dirs ← flags."""
+    b = Builder()
+    for f in files:
+        b.add_file(f)
+    for d in dirs:
+        b.add_dir(d)
+    b.add_flags(**flags)
+    return b.build()
+
+
+def diff_reloadable(old: RuntimeConfig,
+                    new: RuntimeConfig) -> Tuple[List[str], List[str]]:
+    """(reloadable_changes, restart_required_changes) field names."""
+    reload_keys: List[str] = []
+    restart_keys: List[str] = []
+    for f in dataclasses.fields(RuntimeConfig):
+        if f.name == "raw":
+            continue
+        if getattr(old, f.name) != getattr(new, f.name):
+            base = f.name.split("_")[0]
+            # dns_port is a bound listener — changing it needs a restart
+            if f.name != "dns_port" and (
+                    f.name in RELOADABLE or f.name.startswith("dns_")
+                    or base in ("services", "checks")):
+                reload_keys.append(f.name)
+            else:
+                restart_keys.append(f.name)
+    return reload_keys, restart_keys
