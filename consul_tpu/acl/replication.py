@@ -1,0 +1,96 @@
+"""Cross-DC ACL replication: primary → secondary token/policy sync.
+
+The reference replicates ACL state from the primary datacenter with
+rate-limited, index-based round loops (agent/consul/replication.go
+Replicator; acl_replication.go diffACLPolicies/diffACLTokens; started
+from the leader loop, leader.go:873-896).  Same structure here: each
+round lists the primary's policies and tokens, diffs against the local
+secondary store by modify_index, and applies upserts + deletes.  Local
+tokens (`local: true`) never replicate (the reference's local-token
+carve-out).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+
+class AclReplicator:
+    def __init__(self, primary_store, secondary_store,
+                 interval: float = 30.0):
+        self.primary = primary_store
+        self.secondary = secondary_store
+        self.interval = interval
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self.last_round: Tuple[int, int] = (0, 0)  # (upserts, deletes)
+
+    # ------------------------------------------------------------ one round
+
+    def run_once(self) -> Tuple[int, int]:
+        """One replication round; returns (upserts, deletes)."""
+        ups = dels = 0
+        # policies first so token->policy links resolve (reference order:
+        # policies, roles, tokens — leader.go:873-896)
+        # content comparison, NOT modify_index: the two stores have
+        # independent raft index spaces, so cross-store index compares
+        # would re-upsert identical data every round forever
+        prim_pols = {p["id"]: p for p in self.primary.acl_policy_list()}
+        sec_pols = {p["id"]: p for p in self.secondary.acl_policy_list()}
+        for pid, pol in prim_pols.items():
+            mine = sec_pols.get(pid)
+            if mine is None or mine["rules"] != pol["rules"] \
+                    or mine["name"] != pol["name"] \
+                    or mine.get("description") != pol.get("description"):
+                self.secondary.acl_policy_set(
+                    pid, pol["name"], pol["rules"],
+                    pol.get("description", ""))
+                ups += 1
+        for pid in set(sec_pols) - set(prim_pols):
+            self.secondary.acl_policy_delete(pid)
+            dels += 1
+
+        prim_toks = {t["accessor"]: t for t in self.primary.acl_token_list()
+                     if not t.get("local")}
+        sec_toks = {t["accessor"]: t for t in self.secondary.acl_token_list()
+                    if not t.get("local")}
+        for acc, tok in prim_toks.items():
+            mine = sec_toks.get(acc)
+            if mine is None or mine["secret"] != tok["secret"] \
+                    or mine["policies"] != tok["policies"] \
+                    or mine.get("type") != tok.get("type") \
+                    or mine.get("description") != tok.get("description"):
+                self.secondary.acl_token_set(
+                    acc, tok["secret"], tok.get("policies") or [],
+                    tok.get("description", ""),
+                    token_type=tok.get("type", "client"), local=False)
+                ups += 1
+        for acc in set(sec_toks) - set(prim_toks):
+            self.secondary.acl_token_delete(acc)
+            dels += 1
+        self.last_round = (ups, dels)
+        return ups, dels
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._running = True
+
+        def loop():
+            while self._running:
+                try:
+                    self.run_once()
+                except Exception:
+                    pass  # rate-limited retry next round (replication.go)
+                time.sleep(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

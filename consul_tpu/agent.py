@@ -34,7 +34,8 @@ class Agent:
         if rc is None:
             rc = rcfg.load(files=list(config_files), dirs=list(config_dirs),
                            **flags)
-        a = cls(gossip=rc.gossip_config(), sim=rc.sim_config(),
+        wan = bool(flags.pop("wan_defaults", False))
+        a = cls(gossip=rc.gossip_config(wan=wan), sim=rc.sim_config(),
                 node_name=rc.node_name, http_port=rc.http_port,
                 dc=rc.datacenter, acl_enabled=rc.acl_enabled,
                 acl_default_policy=rc.acl_default_policy,
@@ -69,12 +70,14 @@ class Agent:
                 meta=svc.get("Meta") or svc.get("meta") or {})
         existing_checks = self.local.checks()
         for chk in rc.checks:
-            cid = chk.get("CheckID") or chk.get("id") or chk.get("Name")
+            name = chk.get("Name") or chk.get("name")
+            cid = chk.get("CheckID") or chk.get("id") or name
             new_cids.add(cid)
             if cid in existing_checks:
                 continue  # keep runtime status across reloads
-            self.local.add_check(cid, chk.get("Name") or cid,
-                                 status=chk.get("Status", "critical"))
+            self.local.add_check(
+                cid, name or cid,
+                status=chk.get("Status") or chk.get("status") or "critical")
         # deregister config-origin definitions dropped from the sources
         for sid in getattr(self, "_config_service_ids", set()) - new_sids:
             self.local.remove_service(sid)
@@ -229,6 +232,11 @@ class Agent:
                     self.store.register_check(
                         name, "serfHealth", "Serf Health Status",
                         status="passing", output="Agent alive and reachable")
+
+    def join_wan(self, router) -> None:
+        """Join a multi-DC federation through a WanRouter (the agent's
+        JoinWAN analogue, reference agent/consul/server.go:1100)."""
+        self.api.attach_router(router)
 
     @property
     def http_address(self) -> str:

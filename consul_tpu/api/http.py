@@ -108,10 +108,25 @@ class ApiServer:
             self.store, self.oracle, node_name=node_name, dc=dc)
         # set by Agent.from_config: PUT /v1/agent/reload re-reads config
         self.reload_fn = None
+        # multi-DC: a WanRouter enables ?dc= forwarding + query failover
+        # (agent/consul/rpc.go:658 forwardDC)
+        self.router = None
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def attach_router(self, router) -> None:
+        """Join a federation: register this DC's surface and wire the
+        prepared-query executor's cross-DC failover hooks."""
+        from consul_tpu.router import DcHandle
+        self.router = router
+        handle = DcHandle(self.dc, self.store,
+                          query_executor=self.query_executor)
+        handle.http_address = self.address
+        router.register(handle)
+        self.query_executor.remote_execute = router.execute_query
+        self.query_executor.dc_order = router.datacenters
 
     @property
     def address(self) -> str:
@@ -154,12 +169,12 @@ def _make_handler(srv: ApiServer):
             return self.rfile.read(n) if n else b""
 
         def _send(self, obj, code: int = 200, raw: bytes | None = None,
-                  index: int | None = None):
+                  index: int | None = None, ctype: str | None = None):
             payload = raw if raw is not None else json.dumps(obj).encode()
             self.send_response(code)
-            self.send_header("Content-Type",
+            self.send_header("Content-Type", ctype or (
                              "application/octet-stream" if raw is not None
-                             else "application/json")
+                             else "application/json"))
             self.send_header("Content-Length", str(len(payload)))
             self.send_header("X-Consul-Index",
                              str(index if index is not None else store.index))
@@ -333,7 +348,56 @@ def _make_handler(srv: ApiServer):
 
         # ---------------------------------------------------------- dispatch
 
+        def _forward_dc(self, verb: str, path: str, q) -> bool:
+            """?dc= forwarding: replay the request against the target
+            DC's HTTP surface (the reference's forwardDC network hop,
+            rpc.go:658).  Unknown DC → 500 like structs.ErrNoDCPath."""
+            import urllib.error
+            import urllib.request
+            from consul_tpu.router import NoPathError
+            dc = q.pop("dc")
+            try:
+                handle = srv.router.handle(dc)
+            except NoPathError as e:
+                self._err(500, str(e))
+                return True
+            addr = getattr(handle, "http_address", None)
+            if addr is None:
+                self._err(500, f"No path to datacenter: {dc!r}")
+                return True
+            qs = urllib.parse.urlencode(q)
+            # path was percent-decoded by _q(); re-quote for the hop
+            url = addr + urllib.parse.quote(path) + (f"?{qs}" if qs else "")
+            body = self._body() if verb in ("PUT", "POST") else None
+            req = urllib.request.Request(url, data=body, method=verb)
+            if self.token:
+                req.add_header("X-Consul-Token", self.token)
+            try:
+                with urllib.request.urlopen(req, timeout=330.0) as resp:
+                    raw = resp.read()
+                    self._send(None, resp.status, raw=raw,
+                               index=int(resp.headers.get(
+                                   "X-Consul-Index") or 0),
+                               ctype=resp.headers.get("Content-Type"))
+            except urllib.error.HTTPError as e:
+                self._err(e.code, e.read().decode(errors="replace"))
+            return True
+
+        # dc-forwardable surfaces (the reference forwards catalog-style
+        # RPCs only; /v1/agent/* and /v1/acl/* are strictly local)
+        _DC_FORWARDABLE = ("/v1/kv/", "/v1/catalog/", "/v1/health/",
+                           "/v1/query", "/v1/session/", "/v1/coordinate/",
+                           "/v1/event/", "/v1/txn")
+
         def _dispatch(self, verb: str, path: str, q) -> bool:
+            if q.get("dc") not in (None, "", srv.dc) \
+                    and path.startswith(self._DC_FORWARDABLE):
+                if srv.router is None:
+                    self._err(500,
+                              f"No path to datacenter: {q['dc']!r}")
+                    return True
+                return self._forward_dc(verb, path, q)
+            q.pop("dc", None)
             if path.startswith("/v1/kv/"):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
             if path.startswith("/v1/acl"):

@@ -272,7 +272,8 @@ def cmd_agent(args) -> int:
             config_dirs=args.config_dir or (),
             node_name=args.node, datacenter=args.datacenter,
             http_port=args.http_port,
-            sim=sim_flags or None)
+            sim=sim_flags or None,
+            wan_defaults=args.wan_defaults)
     else:
         gossip = GossipConfig.wan() if args.wan_defaults \
             else GossipConfig.lan()

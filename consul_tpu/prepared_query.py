@@ -154,6 +154,12 @@ class QueryExecutor:
                 "Failovers": failovers, "Nodes": rows,
                 "DNS": q.get("dns") or {}}
 
+    def execute_resolved(self, query: dict) -> List[dict]:
+        """Run an already-resolved query's service lookup locally — the
+        receiving side of cross-DC failover (ExecuteRemote :477)."""
+        svc = query.get("service") or {}
+        return self._sort(self._local_rows(svc), svc.get("near"))
+
     def _local_rows(self, svc: dict) -> List[dict]:
         service = svc.get("service", "")
         tags = [t for t in (svc.get("tags") or []) if not t.startswith("!")]

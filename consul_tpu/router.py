@@ -1,0 +1,93 @@
+"""WAN router: cross-DC request forwarding + coordinate-ranked DC lists.
+
+Host side of Consul's multi-DC story (SURVEY.md §2.2): each DC is its own
+raft/catalog domain; requests carrying `?dc=` forward to that DC's
+servers (agent/consul/rpc.go:658 forwardDC), and failover/ranking orders
+DCs by WAN Vivaldi distance (agent/router/router.go:534
+GetDatacentersByDistance).
+
+The router holds one handle per known DC.  In-process handles wrap the
+remote DC's store directly (the reference's connection-pool RPC collapses
+to a method call); a socket-backed handle can forward over
+consul_tpu/rpc the same way.  WAN distances come from a pluggable
+`distance_fn(dc_a, dc_b) -> seconds` — wire it to the WAN federation
+model's dc_distance_matrix (models/wan.py:206) or to live telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+
+class NoPathError(Exception):
+    """Unknown / unreachable datacenter (structs.ErrNoDCPath)."""
+
+
+class DcHandle:
+    """One datacenter's serving surface as seen by remote DCs."""
+
+    def __init__(self, name: str, store, query_executor=None):
+        self.name = name
+        self.store = store
+        self.query_executor = query_executor
+
+
+class WanRouter:
+    def __init__(self, local_dc: str,
+                 distance_fn: Optional[Callable[[str, str], float]] = None):
+        self.local_dc = local_dc
+        self.distance_fn = distance_fn
+        self._dcs: Dict[str, DcHandle] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ registry
+
+    def register(self, handle: DcHandle) -> None:
+        with self._lock:
+            self._dcs[handle.name] = handle
+
+    def deregister(self, name: str) -> None:
+        with self._lock:
+            self._dcs.pop(name, None)
+
+    def datacenters(self) -> List[str]:
+        """All known DCs, local first, remainder by WAN distance
+        (GetDatacentersByDistance ordering)."""
+        with self._lock:
+            names = list(self._dcs)
+        if self.local_dc not in names:
+            names.append(self.local_dc)
+        remote = [d for d in names if d != self.local_dc]
+        if self.distance_fn is not None:
+            remote.sort(key=lambda d: (self.distance_fn(self.local_dc, d),
+                                       d))
+        else:
+            remote.sort()
+        return [self.local_dc] + remote
+
+    def handle(self, dc: str) -> DcHandle:
+        with self._lock:
+            h = self._dcs.get(dc)
+        if h is None:
+            raise NoPathError(f"No path to datacenter: {dc!r}")
+        return h
+
+    # ---------------------------------------------------------- forwarding
+
+    def store_for(self, dc: Optional[str]):
+        """The store serving `dc` (None/local → local store), for read and
+        write forwarding (rpc.go:658 forwardDC)."""
+        if dc in (None, "", self.local_dc):
+            return self.handle(self.local_dc).store
+        return self.handle(dc).store
+
+    def execute_query(self, dc: str, query: dict) -> List[dict]:
+        """Cross-DC prepared-query execution (ExecuteRemote,
+        prepared_query_endpoint.go:477): run the already-resolved query's
+        service lookup against the remote DC."""
+        h = self.handle(dc)
+        if h.query_executor is not None:
+            res = h.query_executor.execute_resolved(query)
+            return res
+        return []

@@ -28,7 +28,9 @@ import os
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
-RELOADABLE = {"log_level", "dns_config", "checks", "services"}
+# RuntimeConfig FIELD names that reload applies without a restart
+RELOADABLE = {"log_level", "services", "checks", "dns_only_passing",
+              "dns_node_ttl", "dns_service_ttl", "dns_domain"}
 
 
 class ConfigError(Exception):
@@ -132,9 +134,12 @@ def parse_hcl(text: str) -> dict:
         raise ConfigError(f"expected '=' or block after {key!r}")
 
     out: Dict[str, Any] = {}
-    while i < len(toks):
-        for k, v in entry().items():
-            _merge_into(out, k, v)
+    try:
+        while i < len(toks):
+            for k, v in entry().items():
+                _merge_into(out, k, v)
+    except IndexError:
+        raise ConfigError("unexpected end of config (unclosed block?)")
     return out
 
 
@@ -312,6 +317,10 @@ class Builder:
         for svc in m.get("services") or []:
             if not (svc.get("Name") or svc.get("name")):
                 raise ConfigError("service definition missing name")
+        for chk in m.get("checks") or []:
+            if not (chk.get("Name") or chk.get("name")
+                    or chk.get("CheckID") or chk.get("id")):
+                raise ConfigError("check definition missing name/id")
 
         def freeze(d):
             return tuple(sorted(d.items()))
@@ -362,11 +371,7 @@ def diff_reloadable(old: RuntimeConfig,
         if f.name == "raw":
             continue
         if getattr(old, f.name) != getattr(new, f.name):
-            base = f.name.split("_")[0]
-            # dns_port is a bound listener — changing it needs a restart
-            if f.name != "dns_port" and (
-                    f.name in RELOADABLE or f.name.startswith("dns_")
-                    or base in ("services", "checks")):
+            if f.name in RELOADABLE:
                 reload_keys.append(f.name)
             else:
                 restart_keys.append(f.name)
