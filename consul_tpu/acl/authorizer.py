@@ -152,10 +152,13 @@ class Authorizer:
                                      r.intentions, "") for r in pick])
         svc = self._resolve("service", service)
         if svc is None:
-            svc = self._default
-        # reference (acl/policy_authorizer.go:208-218): without an explicit
-        # intentions rule, service read OR write grants intention READ only
-        # — intention WRITE always needs an explicit intentions = "write"
+            # no service rule matches at all: intentions follow the
+            # token's default policy (ACLs off / default allow ⇒ full
+            # intention access — you can manage intentions without ACLs)
+            return WRITE if self._default == WRITE else DENY
+        # a service RULE matched (acl/policy_authorizer.go:208-218):
+        # service read OR write derives intention READ only — intention
+        # WRITE always needs an explicit intentions = "write"
         if svc == DENY or rank(svc) < rank(READ):
             return DENY
         return READ

@@ -13,7 +13,6 @@ carve-out).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional, Tuple
 
 
@@ -24,7 +23,7 @@ class AclReplicator:
         self.secondary = secondary_store
         self.interval = interval
         self._thread: Optional[threading.Thread] = None
-        self._running = False
+        self._stop = threading.Event()
         self.last_round: Tuple[int, int] = (0, 0)  # (upserts, deletes)
 
     # ------------------------------------------------------------ one round
@@ -39,6 +38,12 @@ class AclReplicator:
         # would re-upsert identical data every round forever
         prim_pols = {p["id"]: p for p in self.primary.acl_policy_list()}
         sec_pols = {p["id"]: p for p in self.secondary.acl_policy_list()}
+        # deletes BEFORE upserts: a delete+recreate reusing a policy name
+        # would otherwise hit the secondary's name-uniqueness check and
+        # wedge every subsequent round (reference delete-first diff order)
+        for pid in set(sec_pols) - set(prim_pols):
+            self.secondary.acl_policy_delete(pid)
+            dels += 1
         for pid, pol in prim_pols.items():
             mine = sec_pols.get(pid)
             if mine is None or mine["rules"] != pol["rules"] \
@@ -48,14 +53,14 @@ class AclReplicator:
                     pid, pol["name"], pol["rules"],
                     pol.get("description", ""))
                 ups += 1
-        for pid in set(sec_pols) - set(prim_pols):
-            self.secondary.acl_policy_delete(pid)
-            dels += 1
 
         prim_toks = {t["accessor"]: t for t in self.primary.acl_token_list()
                      if not t.get("local")}
         sec_toks = {t["accessor"]: t for t in self.secondary.acl_token_list()
                     if not t.get("local")}
+        for acc in set(sec_toks) - set(prim_toks):
+            self.secondary.acl_token_delete(acc)
+            dels += 1
         for acc, tok in prim_toks.items():
             mine = sec_toks.get(acc)
             if mine is None or mine["secret"] != tok["secret"] \
@@ -67,30 +72,28 @@ class AclReplicator:
                     tok.get("description", ""),
                     token_type=tok.get("type", "client"), local=False)
                 ups += 1
-        for acc in set(sec_toks) - set(prim_toks):
-            self.secondary.acl_token_delete(acc)
-            dels += 1
         self.last_round = (ups, dels)
         return ups, dels
 
     # ------------------------------------------------------------ lifecycle
 
     def start(self) -> None:
-        self._running = True
+        self._stop.clear()
 
         def loop():
-            while self._running:
+            while not self._stop.is_set():
                 try:
                     self.run_once()
                 except Exception:
                     pass  # rate-limited retry next round (replication.go)
-                time.sleep(self.interval)
+                self._stop.wait(self.interval)
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
 
     def stop(self) -> None:
-        self._running = False
+        self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
-            self._thread = None
+            if not self._thread.is_alive():
+                self._thread = None

@@ -111,10 +111,24 @@ class ApiServer:
         # multi-DC: a WanRouter enables ?dc= forwarding + query failover
         # (agent/consul/rpc.go:658 forwardDC)
         self.router = None
+        # Connect CA (lazy: cert generation costs entropy/CPU at boot)
+        self._ca = None
+        self._ca_lock = threading.Lock()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def ca(self):
+        # double-checked under a lock: two concurrent first requests must
+        # not build two CAManagers with different trust domains
+        if self._ca is None:
+            with self._ca_lock:
+                if self._ca is None:
+                    from consul_tpu.connect.ca import CAManager
+                    self._ca = CAManager(dc=self.dc)
+        return self._ca
 
     def attach_router(self, router) -> None:
         """Join a federation: register this DC's surface and wire the
@@ -808,6 +822,9 @@ def _make_handler(srv: ApiServer):
                 return True
             if path == "/v1/query" or path.startswith("/v1/query/"):
                 return self._query(verb, path, q)
+            if path.startswith("/v1/connect/") \
+                    or path.startswith("/v1/agent/connect/"):
+                return self._connect(verb, path, q)
             if path == "/v1/txn" and verb == "PUT":
                 return self._txn()
             if path == "/v1/snapshot" and verb == "GET":
@@ -1135,6 +1152,147 @@ def _make_handler(srv: ApiServer):
                     return self._forbid()
                 store.query_delete(m.group(1))
                 self._send(True)
+                return True
+            return False
+
+        # --------------------------------------------------------- connect
+        # intentions CRUD/match/check (intention_endpoint.go:73), agent
+        # authorize (AgentConnectAuthorize), CA roots/rotation + leaf
+        # signing (provider.go:58, leader_connect_ca.go:53)
+
+        def _intention_json(self, it: dict) -> dict:
+            return {"ID": it.get("id", ""),
+                    "SourceName": it["source"],
+                    "DestinationName": it["destination"],
+                    "Action": it["action"],
+                    "Description": it.get("description", ""),
+                    "Meta": it.get("meta", {}),
+                    "Precedence": it["precedence"],
+                    "CreateIndex": it.get("create_index", 0),
+                    "ModifyIndex": it.get("modify_index", 0)}
+
+        def _connect(self, verb: str, path: str, q) -> bool:
+            import uuid as _uuid
+            from consul_tpu.connect import intentions as imod
+            if path == "/v1/connect/intentions" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                dst = body.get("DestinationName", "")
+                if not self.authz.intention_write(dst):
+                    return self._forbid()
+                iid = str(_uuid.uuid4())
+                try:
+                    store.intention_set(
+                        iid, body.get("SourceName", "*"), dst,
+                        body.get("Action", "deny"),
+                        body.get("Description", ""),
+                        body.get("Meta") or {})
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send({"ID": iid})
+                return True
+            if path == "/v1/connect/intentions" and verb == "GET":
+                idx = self._block(q, ("intentions", ""))
+                self._send([self._intention_json(i)
+                            for i in store.intention_list()
+                            if self.authz.intention_read(i["destination"])],
+                           index=idx)
+                return True
+            if path == "/v1/connect/intentions/match" and verb == "GET":
+                name = q.get("name", "")
+                by = q.get("by", "destination")
+                if by not in ("source", "destination"):
+                    self._err(400, "by must be source|destination")
+                    return True
+                if not self.authz.intention_read(name):
+                    return self._forbid()
+                idx = self._block(q, ("intentions", ""))
+                rows = imod.match_order(store.intention_list(), name, by)
+                self._send({name: [self._intention_json(i) for i in rows]},
+                           index=idx)
+                return True
+            if path == "/v1/connect/intentions/check" and verb == "GET":
+                src_n = q.get("source", "")
+                dst_n = q.get("destination", "")
+                if not self.authz.service_read(dst_n):
+                    return self._forbid()
+                default_allow = srv.acl.default_policy == "allow" \
+                    if getattr(srv.acl, "enabled", False) else True
+                ok, _reason = imod.authorize(
+                    store.intention_list(), src_n, dst_n, default_allow)
+                self._send({"Allowed": ok})
+                return True
+            m = re.fullmatch(r"/v1/connect/intentions/([^/]+)", path)
+            if m and verb == "GET":
+                it = store.intention_get(m.group(1))
+                if it is None:
+                    self._err(404, "intention not found")
+                    return True
+                if not self.authz.intention_read(it["destination"]):
+                    return self._forbid()
+                self._send(self._intention_json(it))
+                return True
+            if m and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                it = store.intention_get(m.group(1))
+                if it is None:
+                    self._err(404, "intention not found")
+                    return True
+                dst = body.get("DestinationName", it["destination"])
+                if not self.authz.intention_write(it["destination"]) \
+                        or not self.authz.intention_write(dst):
+                    return self._forbid()
+                try:
+                    store.intention_set(
+                        m.group(1), body.get("SourceName", it["source"]),
+                        dst, body.get("Action", it["action"]),
+                        body.get("Description",
+                                 it.get("description", "")),
+                        body.get("Meta") or it.get("meta") or {})
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send(True)
+                return True
+            if m and verb == "DELETE":
+                it = store.intention_get(m.group(1))
+                if it is not None and not self.authz.intention_write(
+                        it["destination"]):
+                    return self._forbid()
+                store.intention_delete(m.group(1))
+                self._send(True)
+                return True
+            if path == "/v1/connect/ca/roots" and verb == "GET":
+                roots = srv.ca.roots()
+                self._send({"ActiveRootID": next(
+                    (r["ID"] for r in roots if r["Active"]), ""),
+                    "TrustDomain": srv.ca.trust_domain,
+                    "Roots": roots})
+                return True
+            if path == "/v1/connect/ca/rotate" and verb == "PUT":
+                # operator:write like CA config changes
+                if not self.authz.operator_write():
+                    return self._forbid()
+                self._send({"ActiveRootID": srv.ca.rotate()})
+                return True
+            m = re.fullmatch(r"/v1/agent/connect/ca/leaf/([^/]+)", path)
+            if m and verb == "GET":
+                if not self.authz.service_write(m.group(1)):
+                    return self._forbid()
+                self._send(srv.ca.sign_leaf(m.group(1)))
+                return True
+            if path == "/v1/agent/connect/authorize" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                target = body.get("Target", "")
+                if not self.authz.service_write(target):
+                    return self._forbid()
+                client_uri = body.get("ClientCertURI", "")
+                source = imod.spiffe_service(client_uri) or ""
+                default_allow = srv.acl.default_policy == "allow" \
+                    if getattr(srv.acl, "enabled", False) else True
+                ok, reason = imod.authorize(store.intention_list(),
+                                            source, target, default_allow)
+                self._send({"Authorized": ok, "Reason": reason})
                 return True
             return False
 

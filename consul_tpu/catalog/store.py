@@ -94,6 +94,9 @@ class StateStore:
         self._acl_bootstrap_index = 0
         # prepared queries: id -> definition dict (state/prepared_query.go)
         self._queries: Dict[str, dict] = {}
+        # connect intentions: id -> {source, destination, action,
+        # precedence, ...} (state/intention.go)
+        self._intentions: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -729,6 +732,58 @@ class StateStore:
             del self._queries[qid]
             return idx
 
+    # ------------------------------------------------------------ intentions
+    # CRUD mirrors state/intention.go; precedence is computed at write so
+    # match/check order is a pure read (structs.Intention UpdatePrecedence)
+
+    def intention_set(self, iid: str, source: str, destination: str,
+                      action: str, description: str = "",
+                      meta: dict | None = None) -> int:
+        from consul_tpu.connect.intentions import precedence
+        if action not in ("allow", "deny"):
+            raise ValueError(f"intention action must be allow|deny, "
+                             f"got {action!r}")
+        if not source or not destination:
+            raise ValueError("intention source/destination must be "
+                             "non-empty (use \"*\" for wildcard)")
+        with self._lock:
+            dup = next((i for i, v in self._intentions.items()
+                        if v["source"] == source
+                        and v["destination"] == destination
+                        and i != iid), None)
+            if dup is not None:
+                raise ValueError(
+                    f"duplicate intention {source!r} -> {destination!r}")
+            idx = self._bump([("intentions", destination)])
+            existing = self._intentions.get(iid, {})
+            self._intentions[iid] = {
+                "source": source, "destination": destination,
+                "action": action, "description": description,
+                "meta": meta or {},
+                "precedence": precedence(source, destination),
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx,
+            }
+            return idx
+
+    def intention_get(self, iid: str) -> Optional[dict]:
+        with self._lock:
+            v = self._intentions.get(iid)
+            return dict(v, id=iid) if v else None
+
+    def intention_list(self) -> List[dict]:
+        with self._lock:
+            rows = [dict(v, id=i) for i, v in self._intentions.items()]
+        return sorted(rows, key=lambda r: (-r["precedence"],
+                                           r["destination"], r["source"]))
+
+    def intention_delete(self, iid: str) -> int:
+        with self._lock:
+            v = self._intentions.pop(iid, None)
+            if v is None:
+                return self._index
+            return self._bump([("intentions", v["destination"])])
+
     # ------------------------------------------------------------------- txn
 
     def txn(self, ops: List[dict]) -> Tuple[bool, List[Any], int]:
@@ -808,6 +863,7 @@ class StateStore:
                 "acl_tokens": copy.deepcopy(self._acl_tokens),
                 "acl_bootstrap_index": self._acl_bootstrap_index,
                 "queries": copy.deepcopy(self._queries),
+                "intentions": copy.deepcopy(self._intentions),
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -832,6 +888,7 @@ class StateStore:
             self._acl_tokens = copy.deepcopy(snap.get("acl_tokens", {}))
             self._acl_bootstrap_index = snap.get("acl_bootstrap_index", 0)
             self._queries = copy.deepcopy(snap.get("queries", {}))
+            self._intentions = copy.deepcopy(snap.get("intentions", {}))
             self._cond.notify_all()
 
     @classmethod

@@ -1,0 +1,21 @@
+"""Connect service mesh: intentions + certificate authority.
+
+Reference pillars: intention graph (agent/consul/intention_endpoint.go:73),
+authorize path (agent/agent_endpoint.go AgentConnectAuthorize), CA
+provider interface (agent/connect/ca/provider.go:58) with root rotation
+(agent/consul/leader_connect_ca.go:53 CAManager).
+
+CA classes are lazy exports: intentions need no crypto, and the
+`cryptography` import must not tax (or break) intention-only paths.
+"""
+
+from consul_tpu.connect.intentions import (  # noqa: F401
+    ALLOW, DENY, authorize, match_order, precedence,
+)
+
+
+def __getattr__(name):
+    if name in ("BuiltinCA", "CAManager"):
+        from consul_tpu.connect import ca
+        return getattr(ca, name)
+    raise AttributeError(name)

@@ -1,0 +1,197 @@
+"""Connect certificate authority: builtin provider + rotation manager.
+
+The reference's CA stack: a pluggable Provider interface
+(agent/connect/ca/provider.go:58 — builtin "consul" provider generates
+and stores its own root), leaf signing with URI SANs carrying SPIFFE ids
+(connect/), and a CAManager on the leader driving root generation and
+rotation with the old root kept in the trust bundle until its leaves age
+out (agent/consul/leader_connect_ca.go:53).
+
+Real X.509 via `cryptography`: EC P-256 keys, self-signed roots, leaf
+certs with spiffe:// URI SANs.  CA state (roots + active id) serializes
+to a plain dict so it can replicate through the FSM like the reference's
+raft-backed CA tables.
+"""
+
+from __future__ import annotations
+
+import datetime
+import threading
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+_BACKDATE = datetime.timedelta(minutes=5)   # clock-skew allowance
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+class BuiltinCA:
+    """The builtin ("consul") CA provider: one EC root, leaf signing."""
+
+    def __init__(self, trust_domain: str, dc: str = "dc1",
+                 root_ttl_days: int = 3650, leaf_ttl_hours: int = 72,
+                 serial: int = 1,
+                 key_pem: Optional[str] = None,
+                 cert_pem: Optional[str] = None):
+        self.trust_domain = trust_domain
+        self.dc = dc
+        self.leaf_ttl_hours = leaf_ttl_hours
+        self.id = f"root-{serial}"
+        if key_pem is None:
+            self._key = ec.generate_private_key(ec.SECP256R1())
+            subject = x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME,
+                                   f"Consul CA {serial}"),
+            ])
+            now = _utcnow()
+            self._cert = (
+                x509.CertificateBuilder()
+                .subject_name(subject).issuer_name(subject)
+                .public_key(self._key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _BACKDATE)
+                .not_valid_after(now + datetime.timedelta(
+                    days=root_ttl_days))
+                .add_extension(x509.BasicConstraints(ca=True,
+                                                     path_length=0),
+                               critical=True)
+                .add_extension(x509.SubjectAlternativeName([
+                    x509.UniformResourceIdentifier(
+                        f"spiffe://{trust_domain}")]),
+                    critical=False)
+                .sign(self._key, hashes.SHA256())
+            )
+        else:
+            self._key = serialization.load_pem_private_key(
+                key_pem.encode(), password=None)
+            self._cert = x509.load_pem_x509_certificate(cert_pem.encode())
+
+    # -------------------------------------------------------------- pems
+
+    @property
+    def cert_pem(self) -> str:
+        return self._cert.public_bytes(
+            serialization.Encoding.PEM).decode()
+
+    @property
+    def key_pem(self) -> str:
+        return self._key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()).decode()
+
+    # ------------------------------------------------------------ signing
+
+    def spiffe_id(self, service: str) -> str:
+        return (f"spiffe://{self.trust_domain}/ns/default/dc/{self.dc}"
+                f"/svc/{service}")
+
+    def sign_leaf(self, service: str) -> Tuple[str, str]:
+        """(cert_pem, key_pem) for a service leaf with a SPIFFE URI SAN
+        (provider.go Sign; leaf shape connect/)."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = _utcnow()
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(x509.Name([
+                x509.NameAttribute(NameOID.COMMON_NAME, service)]))
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _BACKDATE)
+            .not_valid_after(now + datetime.timedelta(
+                hours=self.leaf_ttl_hours))
+            .add_extension(x509.SubjectAlternativeName([
+                x509.UniformResourceIdentifier(self.spiffe_id(service))]),
+                critical=False)
+            .add_extension(x509.BasicConstraints(ca=False,
+                                                 path_length=None),
+                           critical=True)
+            .add_extension(x509.KeyUsage(
+                digital_signature=True, key_encipherment=True,
+                content_commitment=False, data_encipherment=False,
+                key_agreement=False, key_cert_sign=False, crl_sign=False,
+                encipher_only=False, decipher_only=False), critical=True)
+            .sign(self._key, hashes.SHA256())
+        )
+        return (cert.public_bytes(serialization.Encoding.PEM).decode(),
+                key.private_bytes(
+                    serialization.Encoding.PEM,
+                    serialization.PrivateFormat.PKCS8,
+                    serialization.NoEncryption()).decode())
+
+    def verify_leaf(self, cert_pem: str) -> bool:
+        """Does this leaf chain to our root (signature + validity)?"""
+        leaf = x509.load_pem_x509_certificate(cert_pem.encode())
+        try:
+            leaf.verify_directly_issued_by(self._cert)
+        except Exception:
+            return False
+        now = _utcnow()
+        return (leaf.not_valid_before_utc <= now
+                <= leaf.not_valid_after_utc)
+
+
+class CAManager:
+    """Root lifecycle on the leader (leader_connect_ca.go:53): initialize,
+    sign leaves under the ACTIVE root, rotate keeping the old root in the
+    trust bundle so in-flight leaves stay verifiable."""
+
+    def __init__(self, trust_domain: Optional[str] = None, dc: str = "dc1",
+                 leaf_ttl_hours: int = 72):
+        self.trust_domain = trust_domain or \
+            f"{uuid.uuid4()}.consul"
+        self.dc = dc
+        self.leaf_ttl_hours = leaf_ttl_hours
+        self._lock = threading.Lock()
+        self._serial = 1
+        self._roots: List[BuiltinCA] = [
+            BuiltinCA(self.trust_domain, dc, serial=1,
+                      leaf_ttl_hours=leaf_ttl_hours)]
+
+    # -------------------------------------------------------------- roots
+
+    @property
+    def active(self) -> BuiltinCA:
+        with self._lock:
+            return self._roots[-1]
+
+    def roots(self) -> List[dict]:
+        """Trust bundle (GET /v1/connect/ca/roots shape)."""
+        with self._lock:
+            active_id = self._roots[-1].id
+            return [{"ID": r.id, "Name": f"Consul CA {i + 1}",
+                     "RootCert": r.cert_pem,
+                     "Active": r.id == active_id}
+                    for i, r in enumerate(self._roots)]
+
+    def rotate(self) -> str:
+        """Generate + activate a new root; prior roots stay in the bundle
+        (rotation keeps old leaves verifiable — leader_connect_ca.go)."""
+        with self._lock:
+            self._serial += 1
+            self._roots.append(BuiltinCA(self.trust_domain, self.dc,
+                                         serial=self._serial,
+                                         leaf_ttl_hours=self.leaf_ttl_hours))
+            return self._roots[-1].id
+
+    # ------------------------------------------------------------- leaves
+
+    def sign_leaf(self, service: str) -> dict:
+        ca = self.active
+        cert, key = ca.sign_leaf(service)
+        return {"SerialNumber": "", "CertPEM": cert, "PrivateKeyPEM": key,
+                "Service": service,
+                "ServiceURI": ca.spiffe_id(service)}
+
+    def verify_leaf(self, cert_pem: str) -> bool:
+        with self._lock:
+            roots = list(self._roots)
+        return any(r.verify_leaf(cert_pem) for r in roots)

@@ -1,0 +1,72 @@
+"""Intention evaluation: source→destination L4 authorization graph.
+
+Reference semantics (agent/consul/intention_endpoint.go:73 Apply/Match/
+Check; precedence agent/structs/intention.go UpdatePrecedence): an
+intention names a source and destination service (either may be the
+wildcard "*") with an allow/deny action.  Matching orders candidates by
+precedence — exact beats wildcard, destination side weighs highest — and
+the FIRST match decides; with no match the ACL default policy applies
+(intention deny-by-default iff acl default deny).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+ALLOW = "allow"
+DENY = "deny"
+WILDCARD = "*"
+
+
+def precedence(source: str, destination: str) -> int:
+    """structs.Intention precedence values: exact/exact=9, */exact=8,
+    exact/*=6, */*=5 (destination specificity dominates)."""
+    src_exact = source != WILDCARD
+    dst_exact = destination != WILDCARD
+    if dst_exact and src_exact:
+        return 9
+    if dst_exact:
+        return 8
+    if src_exact:
+        return 6
+    return 5
+
+
+def _matches(pattern: str, name: str) -> bool:
+    return pattern == WILDCARD or pattern == name
+
+
+def match_order(intentions: List[dict], name: str,
+                by: str = "destination") -> List[dict]:
+    """Intentions whose `by` side matches `name`, highest precedence
+    first (IntentionMatch ordering)."""
+    hits = [i for i in intentions if _matches(i[by], name)]
+    return sorted(hits, key=lambda i: (-i["precedence"],
+                                       i["destination"], i["source"]))
+
+
+def authorize(intentions: List[dict], source: str, destination: str,
+              default_allow: bool) -> tuple[bool, str]:
+    """(authorized, reason) for a source→destination connection
+    (ConnectAuthorize / Intention.Check)."""
+    for i in sorted(intentions, key=lambda x: -x["precedence"]):
+        if _matches(i["source"], source) \
+                and _matches(i["destination"], destination):
+            ok = i["action"] == ALLOW
+            return ok, (f"Matched intention {i['source']}=>"
+                        f"{i['destination']} action={i['action']}")
+    if default_allow:
+        return True, "Default behavior (ACL allow)"
+    return False, "Default behavior (ACL deny): no matching intention"
+
+
+def spiffe_service(uri: str) -> Optional[str]:
+    """Extract the service name from a SPIFFE URI
+    (spiffe://<domain>/ns/<ns>/dc/<dc>/svc/<service> — connect/spiffe)."""
+    if not uri.startswith("spiffe://"):
+        return None
+    parts = uri.split("/")
+    try:
+        return parts[parts.index("svc") + 1]
+    except (ValueError, IndexError):
+        return None

@@ -40,6 +40,8 @@ class ServerFSM:
             "acl_bootstrap": self._acl_bootstrap,
             "query_set": self._query_set,
             "query_delete": self._query_delete,
+            "intention_set": self._intention_set,
+            "intention_delete": self._intention_delete,
         }
 
     def apply(self, cmd: Dict[str, Any]) -> Any:
@@ -154,6 +156,17 @@ class ServerFSM:
 
     def _query_delete(self, qid):
         return {"index": self.store.query_delete(qid)}
+
+    def _intention_set(self, iid, source, destination, action,
+                       description="", meta=None):
+        try:
+            return {"index": self.store.intention_set(
+                iid, source, destination, action, description, meta)}
+        except ValueError as e:
+            return {"error": str(e), "index": self.store.index}
+
+    def _intention_delete(self, iid):
+        return {"index": self.store.intention_delete(iid)}
 
     def _acl_bootstrap(self, accessor, secret):
         ok, idx = self.store.acl_bootstrap(accessor, secret)

@@ -330,6 +330,18 @@ class Server:
     def query_delete(self, qid):
         return self.raft_apply("query_delete", qid=qid)["index"]
 
+    def intention_set(self, iid, source, destination, action,
+                      description="", meta=None):
+        r = self.raft_apply("intention_set", iid=iid, source=source,
+                            destination=destination, action=action,
+                            description=description, meta=meta)
+        if "error" in r:
+            raise ValueError(r["error"])
+        return r["index"]
+
+    def intention_delete(self, iid):
+        return self.raft_apply("intention_delete", iid=iid)["index"]
+
     # ------------------------------------------------------------- read side
     # Stale reads hit the local replica directly; the HTTP layer decides.
 
