@@ -199,6 +199,11 @@ class Agent:
         self.syncer.stop()
         self.oracle.stop()
         self.api.stop()
+        # after the HTTP listener: a late ?cached request must not
+        # recreate views post-close
+        if self.api.view_store is not None:
+            self.api.view_store.close()
+        self.api.agent_cache.close()
         self.dns.stop()
         if self._reconcile_thread:
             self._reconcile_thread.join(timeout=5.0)

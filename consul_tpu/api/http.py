@@ -114,10 +114,28 @@ class ApiServer:
         # Connect CA (lazy: cert generation costs entropy/CPU at boot)
         self._ca = None
         self._ca_lock = threading.Lock()
+        # streaming read backend: materialized views over store events
+        # (?cached serving — agent/submatview); the request-keyed Cache
+        # serves Cache-Control max-age reads (agent/cache)
+        from consul_tpu.submatview import ViewStore
+        pub = getattr(self.store, "publisher", None)
+        self.view_store = ViewStore(pub) if pub is not None else None
+        from consul_tpu.cache import Cache as AgentCache
+        self.agent_cache = AgentCache()
+        self.agent_cache.register_type(
+            "health_services",
+            lambda key, min_index, timeout: self._fetch_health(key),
+            ttl=600.0)
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _fetch_health(self, key: str):
+        name, tag, passing = key.split("\x00")
+        rows = self.store.health_service_nodes(
+            name, tag=tag or None, passing_only=passing == "True")
+        return rows, self.store.index
 
     @property
     def ca(self):
@@ -697,11 +715,50 @@ def _make_handler(srv: ApiServer):
             if m and verb == "GET":
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
-                idx = self._block(q, ("health", m.group(1)),
-                                  ("services", m.group(1)), ("nodes", ""))
-                rows = store.health_service_nodes(
-                    m.group(1), tag=q.get("tag"),
-                    passing_only="passing" in q)
+                name = m.group(1)
+                if "cached" in q and srv.view_store is not None:
+                    # backend choice (rpcclient/health): Cache-Control
+                    # max-age rides the request-keyed agent cache; plain
+                    # ?cached rides the streaming materialized view
+                    tag = q.get("tag")
+                    passing = "passing" in q
+                    cc = self.headers.get("Cache-Control", "")
+                    m_age = re.search(r"max-age=(\d+)", cc)
+                    if m_age and "index" not in q:
+                        key = f"{name}\x00{tag or ''}\x00{passing}"
+                        rows, idx, hit = srv.agent_cache.get(
+                            "health_services", key,
+                            max_age=float(m_age.group(1)))
+                        out = [_health_json(r, store) for r in rows]
+                        self.send_response(200)
+                        payload = json.dumps(out).encode()
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(payload)))
+                        self.send_header("X-Consul-Index", str(idx))
+                        self.send_header("X-Cache",
+                                         "HIT" if hit else "MISS")
+                        self.end_headers()
+                        self.wfile.write(payload)
+                        return True
+                    view = srv.view_store.get(
+                        "health", name,
+                        lambda: (store.health_service_nodes(
+                            name, tag=tag, passing_only=passing),
+                            store.index),
+                        view_key=f"tag={tag}|passing={passing}")
+                    min_idx = int(q["index"]) if "index" in q else 0
+                    rows, idx = view.fetch(
+                        min_idx, timeout=_parse_wait(q.get("wait", "300s"))
+                        if "index" in q else 0.0)
+                    rows = rows or []
+                else:
+                    idx = self._block(q, ("health", name),
+                                      ("services", name), ("nodes", ""))
+                    rows = store.health_service_nodes(
+                        name, tag=q.get("tag"),
+                        passing_only="passing" in q)
                 out = [_health_json(r, store) for r in rows]
                 if "near" in q:
                     out = self._near_sort(q["near"], out,

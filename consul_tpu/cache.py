@@ -1,0 +1,168 @@
+"""Agent cache: request-keyed results with TTL + background refresh.
+
+The reference's agent/cache (cache.go:102 Cache, Get :316, watch.go:28
+Notify) fronts RPCs with a cache whose entries either expire on TTL or
+are kept fresh by a background blocking-query loop (refresh types —
+cache-types/*, e.g. health_services).  Serving `?cached` requests from
+this layer is what lets thousands of agents ride one server fleet.
+
+Same structure here: a type registry maps a type name to a fetch
+function `fetch(key, min_index, timeout) -> (value, index)` (usually a
+closure over the store that runs a blocking query); `get` returns the
+cached value immediately and — for refresh types — keeps a background
+loop long-polling for changes so the next read is already fresh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+FetchFn = Callable[[str, int, float], Tuple[Any, int]]
+
+
+@dataclass
+class _Type:
+    fetch: FetchFn
+    refresh: bool = False
+    ttl: float = 60.0             # entry lifetime without reads
+    refresh_timeout: float = 300.0
+
+
+@dataclass
+class _Entry:
+    value: Any = None
+    index: int = 0
+    fetched_at: float = 0.0
+    expires_at: float = 0.0
+    fetching: bool = False
+    hit: bool = False              # last get() was a cache hit
+    cond: threading.Condition = field(
+        default_factory=threading.Condition)
+    refresher: Optional[threading.Thread] = None
+    stop: bool = False
+
+
+class Cache:
+    def __init__(self):
+        self._types: Dict[str, _Type] = {}
+        self._entries: Dict[Tuple[str, str], _Entry] = {}
+        self._lock = threading.Lock()
+
+    def register_type(self, name: str, fetch: FetchFn,
+                      refresh: bool = False, ttl: float = 60.0,
+                      refresh_timeout: float = 300.0) -> None:
+        """RegisterType (cache.go:181): how to fetch one request type."""
+        self._types[name] = _Type(fetch, refresh, ttl, refresh_timeout)
+
+    # ------------------------------------------------------------------ get
+
+    def get(self, type_name: str, key: str,
+            max_age: Optional[float] = None) -> Tuple[Any, int, bool]:
+        """(value, index, cache_hit).  A miss fetches synchronously; a
+        refresh-type entry then stays fresh in the background.  `max_age`
+        forces a refetch when the entry is older (Cache-Control
+        semantics on ?cached requests)."""
+        t = self._types[type_name]
+        ekey = (type_name, key)
+        with self._lock:
+            # expired-entry sweep on access — entries must not accumulate
+            # for the process lifetime
+            now0 = time.time()
+            for k, e in list(self._entries.items()):
+                if e.expires_at and now0 > e.expires_at and k != ekey:
+                    with e.cond:
+                        e.stop = True
+                        e.cond.notify_all()
+                    del self._entries[k]
+            entry = self._entries.get(ekey)
+            if entry is None:
+                entry = _Entry()
+                self._entries[ekey] = entry
+        with entry.cond:
+            while True:
+                now = time.time()
+                fresh = entry.fetched_at > 0 and (
+                    max_age is None or now - entry.fetched_at <= max_age)
+                if fresh:
+                    entry.expires_at = now + t.ttl
+                    entry.hit = True
+                    self._ensure_refresher(t, ekey, entry)
+                    return entry.value, entry.index, True
+                if not entry.fetching:
+                    break
+                # another caller is refetching: wait, then RE-EVALUATE
+                # freshness (incl. max_age) — returning the pre-refetch
+                # value would violate the caller's bound
+                entry.cond.wait(1.0)
+            entry.fetching = True
+        try:
+            value, index = t.fetch(key, 0, 0.0)
+        finally:
+            with entry.cond:
+                entry.fetching = False
+                entry.cond.notify_all()
+        with entry.cond:
+            entry.value, entry.index = value, index
+            entry.fetched_at = time.time()
+            entry.expires_at = entry.fetched_at + t.ttl
+            entry.hit = False
+            self._ensure_refresher(t, ekey, entry)
+        return value, index, False
+
+    # ---------------------------------------------------------- background
+
+    def _ensure_refresher(self, t: _Type, ekey, entry: _Entry) -> None:
+        if not t.refresh or (entry.refresher is not None
+                             and entry.refresher.is_alive()):
+            return
+
+        def loop():
+            while True:
+                with entry.cond:
+                    if entry.stop or time.time() > entry.expires_at:
+                        entry.refresher = None
+                        return
+                    idx = entry.index
+                try:
+                    value, index = t.fetch(ekey[1], idx, t.refresh_timeout)
+                except Exception:
+                    time.sleep(1.0)       # fetch backoff (cache.go)
+                    continue
+                with entry.cond:
+                    if index > entry.index:
+                        entry.value, entry.index = value, index
+                    entry.fetched_at = time.time()
+                    entry.cond.notify_all()
+
+        entry.refresher = threading.Thread(target=loop, daemon=True)
+        entry.refresher.start()
+
+    def notify(self, type_name: str, key: str,
+               callback: Callable[[Any, int], None],
+               poll: float = 0.05) -> Callable[[], None]:
+        """Watch a cached request: `callback(value, index)` on each index
+        change (cache/watch.go:28 Notify).  Returns a cancel function."""
+        stop = threading.Event()
+
+        def loop():
+            last = -1
+            while not stop.is_set():
+                value, index, _ = self.get(type_name, key)
+                if index != last:
+                    last = index
+                    callback(value, index)
+                stop.wait(poll)
+
+        threading.Thread(target=loop, daemon=True).start()
+        return stop.set
+
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._entries.values())
+        for e in entries:
+            with e.cond:
+                e.stop = True
+                e.cond.notify_all()

@@ -148,16 +148,22 @@ class EventPublisher:
     # --------------------------------------------------------- subscription
 
     def subscribe(self, topic: str, key: Optional[str] = None,
-                  since_index: int = 0) -> Subscription:
+                  since_index: Optional[int] = 0) -> Subscription:
         """Follow `topic` (optionally one key) from `since_index`.
 
         Replays buffered batches newer than since_index; raises
         SnapshotRequired if the buffer no longer reaches back that far
-        (caller must take a fresh snapshot and resubscribe)."""
-        sub = _Sub(topic=topic, key=key, next_index=since_index,
+        (caller must take a fresh snapshot and resubscribe).
+        since_index=None subscribes TAIL-ONLY: no replay, no eviction
+        check — for consumers that snapshot state themselves right after
+        subscribing (submatview materializers)."""
+        sub = _Sub(topic=topic, key=key, next_index=since_index or 0,
                    cond=threading.Condition())
         with self._lock:
             buf = self._buffers.get(topic, ())
+            if since_index is None:
+                self._subs.append(sub)
+                return Subscription(self, sub)
             evicted = self._evicted_through.get(topic, 0)
             if since_index < evicted:
                 raise SnapshotRequired(

@@ -1,0 +1,151 @@
+"""Materialized views over the event stream — the streaming read path.
+
+The reference's submatview (materializer.go:47 Materializer, store.go
+Store) maintains client-side views fed by the gRPC event stream so a
+blocked `/v1/health/service/<name>?index=` is answered from materialized
+state — no query re-execution per wakeup, wakeups only on RELEVANT
+events.  Here the view subscribes to the store's EventPublisher on one
+(topic, key): snapshot once, then follow events; a SnapshotRequired
+reset re-snapshots (stream/publisher.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from consul_tpu.stream.publisher import SnapshotRequired
+
+
+class Materializer:
+    """One live view: snapshot + follow (materializer.go:47).
+
+    `snapshot_fn() -> (value, index)` reads current state from the
+    store; events on (topic, key) trigger re-materialization.  Events in
+    this framework carry (topic, key, index) — re-materialization re-runs
+    the snapshot function, which reads only the keyed slice (cheap), so
+    payload-carrying events are not required for correctness."""
+
+    def __init__(self, publisher, topic: str, key: Optional[str],
+                 snapshot_fn: Callable[[], Tuple[Any, int]]):
+        self.publisher = publisher
+        self.topic = topic
+        self.key = key
+        self.snapshot_fn = snapshot_fn
+        self._cond = threading.Condition()
+        self._value: Any = None
+        self._index = 0
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self.resets = 0               # SnapshotRequired re-snapshots
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        self._materialize()
+        self._thread = threading.Thread(target=self._follow, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self._sub is not None:
+            self._sub.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    _sub = None
+
+    def _materialize(self) -> None:
+        # subscribe FIRST (tail-only — no replay needed since the
+        # snapshot below reads current state), so no event between
+        # snapshot and subscribe can be missed and eviction history is
+        # irrelevant
+        self._sub = self.publisher.subscribe(self.topic, self.key,
+                                             since_index=None)
+        value, index = self.snapshot_fn()
+        with self._cond:
+            self._value, self._index = value, index
+            self._cond.notify_all()
+
+    def _follow(self) -> None:
+        while self._running:
+            try:
+                events = self._sub.events(timeout=1.0)
+            except SnapshotRequired:
+                if not self._running:
+                    return
+                self.resets += 1
+                self._materialize()
+                continue
+            if not events:
+                continue
+            top = max(e.index for e in events)
+            value, index = self.snapshot_fn()
+            with self._cond:
+                self._value = value
+                self._index = max(index, top, self._index)
+                self._cond.notify_all()
+
+    # -------------------------------------------------------------- serving
+
+    def fetch(self, min_index: int = 0,
+              timeout: float = 300.0) -> Tuple[Any, int]:
+        """Blocking read from the view: parks until index > min_index
+        (the submatview Store.Get contract)."""
+        deadline = time.time() + timeout
+        with self._cond:
+            while self._index <= min_index:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self._value, self._index
+
+
+class ViewStore:
+    """Shared view registry with idle expiry (submatview/store.go)."""
+
+    def __init__(self, publisher, idle_ttl: float = 120.0):
+        self.publisher = publisher
+        self.idle_ttl = idle_ttl
+        self._views: Dict[Tuple[str, str], Tuple[Materializer, float]] = {}
+        self._lock = threading.Lock()
+
+    _closed = False
+
+    def get(self, topic: str, key: str,
+            snapshot_fn: Callable[[], Tuple[Any, int]],
+            view_key: str = "") -> Materializer:
+        """`key` scopes the event subscription (service name); `view_key`
+        distinguishes views sharing a subscription but differing in
+        request shape (tag/passing filters) — the reference keys views by
+        the full request hash (submatview/store.go)."""
+        vkey = (topic, key or "", view_key)
+        now = time.time()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("view store closed")
+            # idle sweep on EVERY access, else a stable working set never
+            # expires its idle neighbors
+            for k, (view, last) in list(self._views.items()):
+                if k != vkey and now - last > self.idle_ttl:
+                    view.stop()
+                    del self._views[k]
+            hit = self._views.get(vkey)
+            if hit is not None:
+                self._views[vkey] = (hit[0], now)
+                return hit[0]
+            m = Materializer(self.publisher, topic, key, snapshot_fn)
+            m.start()
+            self._views[vkey] = (m, now)
+            return m
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            for m, _ in self._views.values():
+                m.stop()
+            self._views.clear()

@@ -1,0 +1,217 @@
+"""Agent cache + materialized views (the read-scaling stack).
+
+SURVEY #17/#18.  Reference: agent/cache/cache.go:102 (TTL + background
+blocking refresh), cache/watch.go:28 (Notify), submatview/materializer.go
+:47 (event-fed views), rpcclient/health (?cached backend choice).
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.cache import Cache
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.submatview import Materializer, ViewStore
+
+
+# ----------------------------------------------------------------- cache
+
+def test_cache_miss_then_hit():
+    calls = []
+
+    def fetch(key, min_index, timeout):
+        calls.append(key)
+        return f"value-{key}", len(calls)
+
+    c = Cache()
+    c.register_type("t", fetch)
+    v, idx, hit = c.get("t", "a")
+    assert (v, hit) == ("value-a", False)
+    v, idx, hit = c.get("t", "a")
+    assert (v, hit) == ("value-a", True)
+    assert calls == ["a"]               # second get served from cache
+
+
+def test_cache_max_age_forces_refetch():
+    calls = []
+
+    def fetch(key, min_index, timeout):
+        calls.append(key)
+        return len(calls), len(calls)
+
+    c = Cache()
+    c.register_type("t", fetch)
+    c.get("t", "a")
+    time.sleep(0.15)
+    v, _, hit = c.get("t", "a", max_age=0.1)
+    assert not hit and v == 2
+
+
+def test_cache_background_refresh_keeps_entry_fresh():
+    state = {"index": 1}
+    fetched = threading.Event()
+
+    def fetch(key, min_index, timeout):
+        # blocking-query shape: return when index advances past min_index
+        deadline = time.time() + min(timeout, 5.0)
+        while state["index"] <= min_index and time.time() < deadline:
+            time.sleep(0.01)
+        if min_index > 0:
+            fetched.set()
+        return f"v{state['index']}", state["index"]
+
+    c = Cache()
+    c.register_type("t", fetch, refresh=True, refresh_timeout=5.0)
+    v, idx, _ = c.get("t", "a")
+    assert v == "v1"
+    state["index"] = 2                  # a write lands
+    fetched.wait(5.0)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        v, idx, hit = c.get("t", "a")
+        if v == "v2":
+            break
+        time.sleep(0.05)
+    assert v == "v2" and hit            # refreshed in background
+    c.close()
+
+
+def test_cache_notify_fires_on_change():
+    state = {"index": 1}
+
+    def fetch(key, min_index, timeout):
+        deadline = time.time() + min(timeout, 5.0)
+        while state["index"] <= min_index and time.time() < deadline:
+            time.sleep(0.01)
+        return state["index"], state["index"]
+
+    c = Cache()
+    c.register_type("t", fetch, refresh=True, refresh_timeout=5.0)
+    seen = []
+    cancel = c.notify("t", "a", lambda v, i: seen.append(i))
+    deadline = time.time() + 5.0
+    while not seen and time.time() < deadline:
+        time.sleep(0.02)
+    state["index"] = 2
+    deadline = time.time() + 5.0
+    while 2 not in seen and time.time() < deadline:
+        time.sleep(0.02)
+    cancel()
+    assert 1 in seen and 2 in seen
+    c.close()
+
+
+# ----------------------------------------------------------------- views
+
+def test_materializer_follows_relevant_events_only():
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    snapshots = []
+
+    def snap():
+        snapshots.append(1)
+        return st.health_service_nodes("web"), st.index
+
+    m = Materializer(st.publisher, "health", "web", snap)
+    m.start()
+    try:
+        rows, idx = m.fetch()
+        assert len(rows) == 1
+        base_snaps = len(snapshots)
+        st.kv_set("unrelated", b"x")            # must NOT re-materialize
+        time.sleep(0.3)
+        assert len(snapshots) == base_snaps
+        st.register_check("n1", "c1", "chk", status="critical",
+                          service_id="web1")    # relevant: re-materialize
+        rows, idx2 = m.fetch(min_index=idx, timeout=5.0)
+        assert idx2 > idx
+        assert rows[0]["checks"][0]["status"] == "critical"
+    finally:
+        m.stop()
+
+
+def test_view_store_reuses_views():
+    st = StateStore()
+    st.register_service("n1", "web1", "web", port=80)
+    vs = ViewStore(st.publisher)
+    try:
+        a = vs.get("health", "web",
+                   lambda: (st.health_service_nodes("web"), st.index))
+        b = vs.get("health", "web",
+                   lambda: (st.health_service_nodes("web"), st.index))
+        assert a is b
+    finally:
+        vs.close()
+
+
+# --------------------------------------------------------------- HTTP e2e
+
+def test_http_cached_health_served_from_view():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=13))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        c = Client(a.http_address)
+        a.store.register_service("n2", "cweb1", "cweb", port=80)
+        out, idx, _ = c._call("GET", "/v1/health/service/cweb",
+                              {"cached": ""})
+        assert out and out[0]["Service"]["Service"] == "cweb"
+        # blocking ?cached read wakes on a relevant check flip
+        result = {}
+
+        def blocked():
+            o, i, _ = c._call("GET", "/v1/health/service/cweb",
+                              {"cached": "", "index": idx, "wait": "5s"})
+            result["rows"], result["idx"] = o, i
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.2)
+        a.store.register_check("n2", "cc1", "chk", status="warning",
+                               service_id="cweb1")
+        t.join(10.0)
+        assert result["idx"] > idx
+        assert result["rows"][0]["Checks"][0]["Status"] == "warning"
+    finally:
+        a.stop()
+
+
+def test_http_cached_with_max_age_and_filters():
+    """Cache-Control max-age rides the agent cache (X-Cache header);
+    ?cached&passing honors the health filter."""
+    import json
+    import urllib.request
+
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=21))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        a.store.register_service("n4", "f1", "filt", port=80)
+        a.store.register_service("n5", "f2", "filt", port=81)
+        a.store.register_check("n5", "cf", "c", status="critical",
+                               service_id="f2")
+
+        def get(path, cc=None):
+            req = urllib.request.Request(a.http_address + path)
+            if cc:
+                req.add_header("Cache-Control", cc)
+            r = urllib.request.urlopen(req, timeout=30)
+            return (json.loads(r.read()), r.headers.get("X-Cache"))
+
+        # ?cached&passing drops the critical instance (filter honored)
+        rows, _ = get("/v1/health/service/filt?cached&passing")
+        assert [x["Service"]["ID"] for x in rows] == ["f1"]
+
+        # max-age path: first MISS then HIT
+        rows, xc = get("/v1/health/service/filt?cached",
+                       cc="max-age=60")
+        assert xc == "MISS" and len(rows) == 2
+        rows, xc = get("/v1/health/service/filt?cached",
+                       cc="max-age=60")
+        assert xc == "HIT"
+    finally:
+        a.stop()
