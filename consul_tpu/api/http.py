@@ -31,6 +31,7 @@ import base64
 import json
 import re
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -201,7 +202,8 @@ def _make_handler(srv: ApiServer):
             return self.rfile.read(n) if n else b""
 
         def _send(self, obj, code: int = 200, raw: bytes | None = None,
-                  index: int | None = None, ctype: str | None = None):
+                  index: int | None = None, ctype: str | None = None,
+                  extra_headers: dict | None = None):
             payload = raw if raw is not None else json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", ctype or (
@@ -210,6 +212,8 @@ def _make_handler(srv: ApiServer):
             self.send_header("Content-Length", str(len(payload)))
             self.send_header("X-Consul-Index",
                              str(index if index is not None else store.index))
+            for k, v in (extra_headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
 
@@ -354,8 +358,12 @@ def _make_handler(srv: ApiServer):
             self._route("PUT")
 
         def _route(self, verb: str):
+            from consul_tpu import telemetry
+            import time as _time
+            t0 = _time.perf_counter()
             try:
                 path, q = self._q()
+                telemetry.incr_counter(("http", verb.lower()))
                 # token: X-Consul-Token header > Bearer > ?token= (the
                 # reference's header/QueryOptions order, agent/http.go
                 # parseToken)
@@ -368,6 +376,7 @@ def _make_handler(srv: ApiServer):
                 self.token = token
                 self.authz = srv.acl.resolve(token)
                 if self._dispatch(verb, path, q):
+                    telemetry.measure_since(("http", "latency"), t0)
                     return
                 self._err(404, f"no route {verb} {path}")
             except BrokenPipeError:
@@ -464,16 +473,86 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/agent/metrics" and verb == "GET":
                 if not self.authz.agent_read(srv.node_name):
                     return self._forbid()
-                gauges = [
+                from consul_tpu import telemetry
+                out = telemetry.default_registry().dump()
+                out["Gauges"] += [
                     {"Name": "consul.sim.tick", "Value": oracle.tick},
                     {"Name": "consul.catalog.index", "Value": store.index},
                 ]
                 if hasattr(oracle, "members_summary"):
                     ms = oracle.members_summary()
-                    gauges += [{"Name": f"consul.members.{k}", "Value": v}
-                               for k, v in ms.items()]
-                self._send({"Timestamp": "", "Gauges": gauges,
-                            "Counters": [], "Samples": []})
+                    out["Gauges"] += [
+                        {"Name": f"consul.members.{k}", "Value": v}
+                        for k, v in ms.items()]
+                self._send(out)
+                return True
+            if path == "/v1/agent/monitor" and verb == "GET":
+                # live log stream (logging/monitor/monitor.go): chunked
+                # lines until the client goes away
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
+                from consul_tpu.logging import (LEVELS, default_buffer,
+                                                level_of)
+                lvl = LEVELS.get(q.get("loglevel", "INFO").upper(), 2)
+                mon = None
+                try:
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+
+                    def chunk(data: bytes):
+                        self.wfile.write(f"{len(data):x}\r\n".encode()
+                                         + data + b"\r\n")
+                        self.wfile.flush()
+
+                    # replay BEFORE registering the live sink (no dupes;
+                    # the reference's monitor is best-effort on the gap)
+                    # and honor the requested level on the replay too
+                    for line in default_buffer().recent(64):
+                        if level_of(line) >= lvl:
+                            chunk(line.encode() + b"\n")
+                    mon = default_buffer().monitor(
+                        q.get("loglevel", "INFO"))
+                    deadline = time.time() + _parse_wait(
+                        q.get("wait", "30s"))
+                    while time.time() < deadline:
+                        for line in mon.lines(timeout=0.5):
+                            chunk(line.encode() + b"\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionError):
+                    pass
+                finally:
+                    if mon is not None:
+                        mon.stop()
+                return True
+            if path == "/v1/operator/autopilot/health" and verb == "GET":
+                if not self.authz.operator_read():
+                    return self._forbid()
+                ap = getattr(store, "autopilot", None)
+                if ap is None:
+                    self._err(400, "not a server-backed agent")
+                    return True
+                # match the clock driving tick(): virtual under the test
+                # cluster, wall-clock in live deployments
+                now = getattr(store.raft, "_now", None) or time.time()
+                servers = ap.server_health(now)
+                self._send({"Healthy": all(s["Healthy"] for s in servers),
+                            "FailureTolerance": ap.failure_tolerance(now),
+                            "Servers": servers})
+                return True
+            if path == "/v1/operator/raft/configuration" and verb == "GET":
+                if not self.authz.operator_read():
+                    return self._forbid()
+                raft = getattr(store, "raft", None)
+                if raft is None:
+                    self._err(400, "not a server-backed agent")
+                    return True
+                ids = [store.node_id] + list(raft.peers)
+                self._send({"Servers": [
+                    {"ID": i, "Node": i, "Leader": i == (raft.leader_id
+                     if not raft.is_leader() else store.node_id),
+                     "Voter": True} for i in ids]})
                 return True
             if path == "/v1/agent/services" and verb == "GET":
                 if srv.local is not None:
@@ -724,36 +803,32 @@ def _make_handler(srv: ApiServer):
                     passing = "passing" in q
                     cc = self.headers.get("Cache-Control", "")
                     m_age = re.search(r"max-age=(\d+)", cc)
+                    cache_state = None
                     if m_age and "index" not in q:
                         key = f"{name}\x00{tag or ''}\x00{passing}"
                         rows, idx, hit = srv.agent_cache.get(
                             "health_services", key,
                             max_age=float(m_age.group(1)))
-                        out = [_health_json(r, store) for r in rows]
-                        self.send_response(200)
-                        payload = json.dumps(out).encode()
-                        self.send_header("Content-Type",
-                                         "application/json")
-                        self.send_header("Content-Length",
-                                         str(len(payload)))
-                        self.send_header("X-Consul-Index", str(idx))
-                        self.send_header("X-Cache",
-                                         "HIT" if hit else "MISS")
-                        self.end_headers()
-                        self.wfile.write(payload)
-                        return True
-                    view = srv.view_store.get(
+                        rows = rows or []
+                        cache_state = "HIT" if hit else "MISS"
+                        # falls through to the shared tail: ?near sorting
+                        # and response conventions stay identical
+                    else:
+                        view = srv.view_store.get(
                         "health", name,
                         lambda: (store.health_service_nodes(
                             name, tag=tag, passing_only=passing),
                             store.index),
-                        view_key=f"tag={tag}|passing={passing}")
-                    min_idx = int(q["index"]) if "index" in q else 0
-                    rows, idx = view.fetch(
-                        min_idx, timeout=_parse_wait(q.get("wait", "300s"))
-                        if "index" in q else 0.0)
-                    rows = rows or []
+                            view_key=f"tag={tag}|passing={passing}")
+                        min_idx = int(q["index"]) if "index" in q else 0
+                        rows, idx = view.fetch(
+                            min_idx,
+                            timeout=_parse_wait(q.get("wait", "300s"))
+                            if "index" in q else 0.0)
+                        rows = rows or []
+                        cache_state = None
                 else:
+                    cache_state = None
                     idx = self._block(q, ("health", name),
                                       ("services", name), ("nodes", ""))
                     rows = store.health_service_nodes(
@@ -763,7 +838,8 @@ def _make_handler(srv: ApiServer):
                 if "near" in q:
                     out = self._near_sort(q["near"], out,
                                           key=lambda r: r["Node"]["Node"])
-                self._send(out, index=idx)
+                self._send(out, index=idx, extra_headers=(
+                    {"X-Cache": cache_state} if cache_state else None))
                 return True
             m = re.fullmatch(r"/v1/health/node/(.+)", path)
             if m and verb == "GET":

@@ -1,0 +1,88 @@
+"""Autopilot: server-health tracking + dead-server cleanup.
+
+The reference wires hashicorp/raft-autopilot (agent/consul/autopilot.go:67)
+to watch server health (stats_fetcher.go) and, when a server stays
+unhealthy past the stabilization window AND removing it cannot break
+quorum (failure tolerance > 0), automatically remove it from the raft
+configuration.  Same policy here, driven from the leader's tick: follower
+liveness comes from per-peer append-ack timestamps (raft.last_ack), and
+removal rides the replicated membership-change entry
+(consensus/raft.py remove_peer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+
+@dataclasses.dataclass
+class AutopilotConfig:
+    """Operator-tunable knobs (operator_autopilot_endpoint.go shapes)."""
+
+    cleanup_dead_servers: bool = True
+    last_contact_threshold: float = 0.2     # seconds without an ack = unhealthy
+    server_stabilization_time: float = 1.0  # unhealthy this long → removable
+
+
+class Autopilot:
+    def __init__(self, server, config: Optional[AutopilotConfig] = None):
+        self.server = server
+        self.config = config or AutopilotConfig()
+        self._unhealthy_since: Dict[str, float] = {}
+        self.removed: List[str] = []
+
+    # --------------------------------------------------------------- health
+
+    def server_health(self, now: float) -> List[dict]:
+        """Per-server health view (/v1/operator/autopilot/health shape).
+        Meaningful on the leader (followers lack ack state)."""
+        raft = self.server.raft
+        out = [{"ID": self.server.node_id, "Healthy": True,
+                "Leader": raft.is_leader(), "LastContact": 0.0,
+                "Voter": True}]
+        for p in raft.peers:
+            ack = raft.last_ack.get(p)
+            last = (now - ack) if ack is not None else float("inf")
+            out.append({
+                "ID": p, "Leader": False, "Voter": True,
+                "LastContact": round(last, 4) if last != float("inf")
+                else -1.0,
+                "Healthy": last <= self.config.last_contact_threshold,
+            })
+        return out
+
+    def failure_tolerance(self, now: float) -> int:
+        """How many more servers can fail before quorum loss."""
+        healthy = sum(1 for h in self.server_health(now) if h["Healthy"])
+        total = len(self.server.raft.peers) + 1
+        quorum = total // 2 + 1
+        return max(0, healthy - quorum)
+
+    # -------------------------------------------------------------- cleanup
+
+    def run(self, now: float) -> None:
+        """One autopilot pass — call from the leader's tick
+        (the reference's promoter loop)."""
+        raft = self.server.raft
+        if not raft.is_leader() or not self.config.cleanup_dead_servers:
+            return
+        health = {h["ID"]: h for h in self.server_health(now)}
+        for peer in list(raft.peers):
+            h = health.get(peer)
+            if h is None or h["Healthy"]:
+                self._unhealthy_since.pop(peer, None)
+                continue
+            since = self._unhealthy_since.setdefault(peer, now)
+            if now - since < self.config.server_stabilization_time:
+                continue
+            # only remove when the remaining cluster keeps quorum of the
+            # CURRENT configuration (dead-server cleanup guard)
+            if self.failure_tolerance(now) < 1:
+                continue
+            try:
+                raft.remove_peer(peer)
+                self.removed.append(peer)
+                self._unhealthy_since.pop(peer, None)
+            except Exception:
+                pass  # not leader anymore / racing change — retry next tick

@@ -100,15 +100,22 @@ class Cache:
             entry.fetching = True
         try:
             value, index = t.fetch(key, 0, 0.0)
-        finally:
+        except BaseException:
             with entry.cond:
                 entry.fetching = False
                 entry.cond.notify_all()
+            raise
         with entry.cond:
+            # store the result and clear `fetching` in ONE critical
+            # section: a waiter woken between them would see a stale
+            # fetched_at with fetching=False and start its own fetch,
+            # breaking single-flight into a thundering herd
             entry.value, entry.index = value, index
             entry.fetched_at = time.time()
             entry.expires_at = entry.fetched_at + t.ttl
             entry.hit = False
+            entry.fetching = False
+            entry.cond.notify_all()
             self._ensure_refresher(t, ekey, entry)
         return value, index, False
 

@@ -158,6 +158,8 @@ class RaftNode:
         # volatile
         self.state = FOLLOWER
         self.commit_index = 0
+        # leader-side follower liveness (autopilot server-health input)
+        self.last_ack: Dict[str, float] = {}
         self.last_applied = 0
         self.leader_id: Optional[str] = None
         self.next_index: Dict[str, int] = {}
@@ -234,8 +236,11 @@ class RaftNode:
 
     # ------------------------------------------------------------------ tick
 
+    _now = None
+
     def tick(self, now: float) -> None:
         with self._lock:
+            self._now = now
             if self._first_tick:
                 self._reset_election_timer(now)
                 self._first_tick = False
@@ -445,9 +450,14 @@ class RaftNode:
             if not ok else 0})
 
     def _on_append_reply(self, msg: dict) -> None:
+        import time as _time
         if self.state != LEADER or msg["term"] != self.current_term:
             return
         peer = msg["from"]
+        # wall-clock ack stamp (autopilot liveness); the tick clock is
+        # virtual in tests, so record both when available
+        self.last_ack[peer] = self._now if self._now is not None \
+            else _time.time()
         if msg["ok"]:
             self.match_index[peer] = max(self.match_index.get(peer, 0),
                                          msg["match_index"])
@@ -502,12 +512,34 @@ class RaftNode:
             ent = self.log[off]
             result = None
             if not ent.noop:
-                result = self.apply_fn(ent.cmd)
+                if isinstance(ent.cmd, dict) \
+                        and "__raft_remove_peer__" in ent.cmd:
+                    # replicated membership change (simplified joint
+                    # consensus: single-server removal, applied by every
+                    # node when the entry commits — raft §6)
+                    result = self._apply_remove_peer(
+                        ent.cmd["__raft_remove_peer__"])
+                else:
+                    result = self.apply_fn(ent.cmd)
             self.applied_index_log.append(self.last_applied)
             pend = self._pending.pop(self.last_applied, None)
             if pend is not None:
                 pend.result = result
                 pend.event.set()
+
+    def _apply_remove_peer(self, peer: str) -> dict:
+        if peer in self.peers:
+            self.peers.remove(peer)
+        self.next_index.pop(peer, None)
+        self.match_index.pop(peer, None)
+        self.last_ack.pop(peer, None)
+        return {"removed": peer}
+
+    def remove_peer(self, peer: str):
+        """Leader-proposed single-server removal (operator raft
+        remove-peer / autopilot dead-server cleanup).  Returns the
+        pending apply."""
+        return self.apply({"__raft_remove_peer__": peer})
 
     def _maybe_compact(self) -> None:
         if self.snapshot_fn is None:

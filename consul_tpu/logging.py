@@ -1,0 +1,141 @@
+"""Logging: leveled named loggers + ring buffer + live monitor streams.
+
+The reference uses hclog with named interceptable loggers
+(logging/names.go, logger.go), optional file sinks, and live log
+streaming over /v1/agent/monitor (logging/monitor/monitor.go: a monitor
+registers a sink, streams buffered+new lines to the client, drops the
+sink on disconnect).  Same shape: a process-wide LogBuffer holds the
+recent ring and fans new lines out to monitor subscriptions; `Logger`
+instances stamp level/name and feed it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Deque, List, Optional
+
+LEVELS = {"TRACE": 0, "DEBUG": 1, "INFO": 2, "WARN": 3, "ERROR": 4}
+
+
+def level_of(line: str) -> int:
+    """Parse the [LEVEL] tag of a formatted line (INFO when absent)."""
+    for name, lv in LEVELS.items():
+        if f"[{name}]" in line:
+            return lv
+    return 2
+
+
+class LogBuffer:
+    """Ring of recent lines + monitor fan-out (monitor/monitor.go)."""
+
+    def __init__(self, ring: int = 512):
+        self._lock = threading.Lock()
+        self._ring: Deque[str] = deque(maxlen=ring)
+        self._monitors: List["Monitor"] = []
+
+    def write(self, line: str) -> None:
+        with self._lock:
+            self._ring.append(line)
+            monitors = list(self._monitors)
+        for m in monitors:
+            m._push(line)
+
+    def recent(self, n: int = 512) -> List[str]:
+        with self._lock:
+            return list(self._ring)[-n:]
+
+    def monitor(self, level: str = "INFO") -> "Monitor":
+        m = Monitor(self, LEVELS.get(level.upper(), 2))
+        with self._lock:
+            self._monitors.append(m)
+        return m
+
+    def _drop(self, m: "Monitor") -> None:
+        with self._lock:
+            if m in self._monitors:
+                self._monitors.remove(m)
+
+
+class Monitor:
+    """One /v1/agent/monitor subscription: blocking line reader."""
+
+    def __init__(self, buf: LogBuffer, min_level: int):
+        self._buf = buf
+        self._min_level = min_level
+        self._cond = threading.Condition()
+        self._queue: Deque[str] = deque()
+        self._closed = False
+
+    def _push(self, line: str) -> None:
+        if level_of(line) < self._min_level:
+            return
+        with self._cond:
+            self._queue.append(line)
+            self._cond.notify_all()
+
+    def lines(self, timeout: float = 1.0) -> List[str]:
+        """Drain available lines, waiting up to `timeout` for the first."""
+        with self._cond:
+            if not self._queue and not self._closed:
+                self._cond.wait(timeout)
+            out = list(self._queue)
+            self._queue.clear()
+            return out
+
+    def stop(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._buf._drop(self)
+
+
+class Logger:
+    """Named leveled logger (hclog shape: `ts [LEVEL] name: msg`)."""
+
+    def __init__(self, name: str, buffer: Optional[LogBuffer] = None,
+                 level: str = "INFO",
+                 also: Optional[Callable[[str], None]] = None):
+        self.name = name
+        self.buffer = buffer if buffer is not None else default_buffer()
+        self.level = LEVELS.get(level.upper(), 2)
+        self.also = also
+
+    def named(self, suffix: str) -> "Logger":
+        return Logger(f"{self.name}.{suffix}", self.buffer)
+
+    def set_level(self, level: str) -> None:
+        self.level = LEVELS.get(level.upper(), 2)
+
+    def _log(self, level: str, msg: str, **kv) -> None:
+        if LEVELS[level] < self.level:
+            return
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        extra = "".join(f" {k}={v}" for k, v in kv.items())
+        line = f"{ts} [{level}] {self.name}: {msg}{extra}"
+        self.buffer.write(line)
+        if self.also is not None:
+            self.also(line)
+
+    def trace(self, msg, **kv):
+        self._log("TRACE", msg, **kv)
+
+    def debug(self, msg, **kv):
+        self._log("DEBUG", msg, **kv)
+
+    def info(self, msg, **kv):
+        self._log("INFO", msg, **kv)
+
+    def warn(self, msg, **kv):
+        self._log("WARN", msg, **kv)
+
+    def error(self, msg, **kv):
+        self._log("ERROR", msg, **kv)
+
+
+_default_buffer = LogBuffer()
+
+
+def default_buffer() -> LogBuffer:
+    return _default_buffer

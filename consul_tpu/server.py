@@ -66,6 +66,8 @@ class Server:
         self._ttl_reap_inflight: set = set()
         self._listener = None
         self._rpc_client = None
+        from consul_tpu.autopilot import Autopilot
+        self.autopilot = Autopilot(self)
 
     # --------------------------------------------------------------- rpc net
 
@@ -134,6 +136,8 @@ class Server:
             self._leader_duties(now)
 
     def _leader_duties(self, now: float) -> None:
+        # autopilot: server health + dead-server cleanup (autopilot.go:67)
+        self.autopilot.run(now)
         # session TTL sweep: propose destroys, don't block the tick thread
         for sid in self.store.peek_expired_sessions(now):
             if sid in self._ttl_reap_inflight:

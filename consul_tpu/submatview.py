@@ -39,6 +39,7 @@ class Materializer:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self.resets = 0               # SnapshotRequired re-snapshots
+        self._inflight = 0            # parked fetch()ers (sweep guard)
 
     # ------------------------------------------------------------ lifecycle
 
@@ -97,12 +98,16 @@ class Materializer:
         (the submatview Store.Get contract)."""
         deadline = time.time() + timeout
         with self._cond:
-            while self._index <= min_index:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
-            return self._value, self._index
+            self._inflight += 1
+            try:
+                while self._index <= min_index:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                return self._value, self._index
+            finally:
+                self._inflight -= 1
 
 
 class ViewStore:
@@ -129,9 +134,11 @@ class ViewStore:
             if self._closed:
                 raise RuntimeError("view store closed")
             # idle sweep on EVERY access, else a stable working set never
-            # expires its idle neighbors
+            # expires its idle neighbors; views with parked blocking
+            # readers are pinned (the reference refcounts views)
             for k, (view, last) in list(self._views.items()):
-                if k != vkey and now - last > self.idle_ttl:
+                if k != vkey and now - last > self.idle_ttl \
+                        and view._inflight == 0:
                     view.stop()
                     del self._views[k]
             hit = self._views.get(vkey)

@@ -1,0 +1,138 @@
+"""Telemetry: counters/gauges/samples with sink fan-out.
+
+The reference initializes armon/go-metrics with statsite/statsd/
+dogstatsd/prometheus/circonus sinks (lib/telemetry.go:21 TelemetryConfig,
+InitTelemetry) and instruments every subsystem (rpc.go:815, leader.go:196
+…), surfacing an in-memory aggregate at /v1/agent/metrics.  Same shape
+here: a process-wide Registry with incr_counter / set_gauge / add_sample,
+an in-memory aggregating sink serving the metrics endpoint, and an
+optional statsd UDP line sink.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+
+class _Sample:
+    __slots__ = ("count", "total", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def add(self, v: float) -> None:
+        self.count += 1
+        self.total += v
+        self.min = min(self.min, v)
+        self.max = max(self.max, v)
+
+
+class StatsdSink:
+    """Plain statsd line protocol over UDP (lib/telemetry.go statsd_addr)."""
+
+    def __init__(self, addr: str):
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+
+    def emit(self, kind: str, name: str, value: float) -> None:
+        suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
+        try:
+            self.sock.sendto(f"{name}:{value}|{suffix}".encode(), self.addr)
+        except OSError:
+            pass
+
+
+class Registry:
+    def __init__(self, prefix: str = "consul"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+        self._gauges: Dict[str, float] = {}
+        self._samples: Dict[str, _Sample] = {}
+        self._sinks: List[StatsdSink] = []
+
+    def add_statsd_sink(self, addr: str) -> None:
+        self._sinks.append(StatsdSink(addr))
+
+    def _name(self, parts) -> str:
+        if isinstance(parts, str):
+            return f"{self.prefix}.{parts}"
+        return ".".join([self.prefix, *parts])
+
+    def incr_counter(self, name, value: float = 1.0) -> None:
+        n = self._name(name)
+        with self._lock:
+            self._counters[n] += value
+        for s in self._sinks:
+            s.emit("counter", n, value)
+
+    def set_gauge(self, name, value: float) -> None:
+        n = self._name(name)
+        with self._lock:
+            self._gauges[n] = value
+        for s in self._sinks:
+            s.emit("gauge", n, value)
+
+    def add_sample(self, name, value: float) -> None:
+        n = self._name(name)
+        with self._lock:
+            self._samples.setdefault(n, _Sample()).add(value)
+        for s in self._sinks:
+            s.emit("sample", n, value * 1000.0)
+
+    def measure_since(self, name, t0: float) -> None:
+        self.add_sample(name, time.perf_counter() - t0)
+
+    # ---------------------------------------------------------------- dump
+
+    def dump(self) -> dict:
+        """/v1/agent/metrics shape (agent/agent_endpoint.go
+        AgentMetrics)."""
+        with self._lock:
+            return {
+                "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000",
+                                           time.gmtime()),
+                "Gauges": [{"Name": k, "Value": v}
+                           for k, v in sorted(self._gauges.items())],
+                "Counters": [{"Name": k, "Count": v}
+                             for k, v in sorted(self._counters.items())],
+                "Samples": [{"Name": k, "Count": s.count,
+                             "Sum": round(s.total, 6),
+                             "Min": round(s.min, 6),
+                             "Max": round(s.max, 6),
+                             "Mean": round(s.total / s.count, 6)
+                             if s.count else 0.0}
+                            for k, s in sorted(self._samples.items())],
+            }
+
+
+# process-wide default registry (go-metrics global pattern)
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default
+
+
+def incr_counter(name, value: float = 1.0) -> None:
+    _default.incr_counter(name, value)
+
+
+def set_gauge(name, value: float) -> None:
+    _default.set_gauge(name, value)
+
+
+def add_sample(name, value: float) -> None:
+    _default.add_sample(name, value)
+
+
+def measure_since(name, t0: float) -> None:
+    _default.measure_since(name, t0)

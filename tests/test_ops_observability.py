@@ -1,0 +1,203 @@
+"""Autopilot, telemetry, logging/monitor.
+
+SURVEY #36/#37/#38.  Reference: raft-autopilot wiring
+(agent/consul/autopilot.go:67), go-metrics telemetry (lib/telemetry.go),
+hclog + /v1/agent/monitor streaming (logging/monitor/monitor.go).
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from consul_tpu.autopilot import Autopilot, AutopilotConfig
+from consul_tpu.logging import LogBuffer, Logger
+from consul_tpu.server import ServerCluster
+from consul_tpu.telemetry import Registry
+
+
+# -------------------------------------------------------------- autopilot
+
+def test_autopilot_reports_health_and_tolerance():
+    c = ServerCluster(3, seed=2)
+    leader = c.wait_leader()
+    now = c.step(0.5)
+    health = leader.autopilot.server_health(now)
+    assert len(health) == 3
+    assert all(h["Healthy"] for h in health)
+    assert leader.autopilot.failure_tolerance(now) == 1
+
+
+def test_autopilot_removes_dead_server_keeping_quorum():
+    c = ServerCluster(5, seed=3)
+    leader = c.wait_leader()
+    victim = next(s for s in c.servers if s is not leader)
+    c.transport.isolate(victim.node_id)
+    # step past threshold + stabilization (virtual clock)
+    c.step(3.0)
+    assert victim.node_id in leader.autopilot.removed
+    assert victim.node_id not in leader.raft.peers
+    # follower configs converge too
+    c.step(1.0)
+    others = [s for s in c.servers
+              if s not in (leader, victim) and s.is_leader() is False]
+    for s in others:
+        assert victim.node_id not in s.raft.peers
+    # cluster still writes (step the virtual clock while the apply waits)
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            c.step(0.05)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=drive)
+    t.start()
+    try:
+        ok, _ = leader.kv_set("after-cleanup", b"1")
+        assert ok
+    finally:
+        stop.set()
+        t.join(5.0)
+
+
+def test_autopilot_never_breaks_quorum():
+    c = ServerCluster(3, seed=4)
+    leader = c.wait_leader()
+    followers = [s for s in c.servers if s is not leader]
+    for f in followers:
+        c.transport.isolate(f.node_id)
+    c.step(3.0)
+    # removing either would leave 1/2 reachable of a 2-node config →
+    # tolerance 0 → no removal (and leadership is lost anyway)
+    assert leader.autopilot.removed == []
+
+
+# -------------------------------------------------------------- telemetry
+
+def test_registry_counters_gauges_samples():
+    r = Registry(prefix="t")
+    r.incr_counter("reqs")
+    r.incr_counter("reqs", 2)
+    r.set_gauge(("pool", "size"), 7)
+    r.add_sample("lat", 0.25)
+    r.add_sample("lat", 0.75)
+    d = r.dump()
+    assert {"Name": "t.reqs", "Count": 3.0} in d["Counters"]
+    assert {"Name": "t.pool.size", "Value": 7} in d["Gauges"]
+    s = next(x for x in d["Samples"] if x["Name"] == "t.lat")
+    assert s["Count"] == 2 and s["Mean"] == 0.5
+
+
+def test_statsd_sink_emits_udp_lines():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    port = rx.getsockname()[1]
+    r = Registry(prefix="t")
+    r.add_statsd_sink(f"127.0.0.1:{port}")
+    r.incr_counter("hits")
+    data, _ = rx.recvfrom(1024)
+    assert data == b"t.hits:1.0|c"
+    rx.close()
+
+
+# ---------------------------------------------------------------- logging
+
+def test_logger_levels_and_ring():
+    buf = LogBuffer()
+    log = Logger("agent", buf, level="INFO")
+    log.debug("hidden")
+    log.info("visible", node="n1")
+    log.error("bad thing")
+    lines = buf.recent()
+    assert len(lines) == 2
+    assert "[INFO] agent: visible node=n1" in lines[0]
+
+
+def test_monitor_streams_new_lines_with_level_filter():
+    buf = LogBuffer()
+    log = Logger("x", buf, level="TRACE")
+    mon = buf.monitor(level="WARN")
+    log.info("nope")
+    log.warn("yep")
+    lines = mon.lines(timeout=2.0)
+    assert len(lines) == 1 and "yep" in lines[0]
+    mon.stop()
+    log.error("after close")        # no crash after unsubscribe
+
+
+# ------------------------------------------------------------ HTTP wiring
+
+def test_http_metrics_and_monitor():
+    import json
+    import urllib.request
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+    from consul_tpu.logging import Logger
+
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=17))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        r = urllib.request.urlopen(a.http_address + "/v1/agent/metrics",
+                                   timeout=30)
+        out = json.loads(r.read())
+        names = {g["Name"] for g in out["Gauges"]}
+        assert "consul.catalog.index" in names
+        # request counters flow from instrumentation
+        assert any(c["Name"].startswith("consul.http.")
+                   for c in out["Counters"])
+
+        # monitor: log a line mid-stream, see it arrive
+        got = {}
+
+        def read_monitor():
+            req = urllib.request.urlopen(
+                a.http_address + "/v1/agent/monitor?wait=2s", timeout=30)
+            got["body"] = req.read().decode()
+
+        t = threading.Thread(target=read_monitor)
+        t.start()
+        time.sleep(0.5)
+        Logger("test").info("hello-from-test")
+        t.join(15.0)
+        assert "hello-from-test" in got.get("body", "")
+    finally:
+        a.stop()
+
+
+def test_operator_endpoints_on_server_backed_api():
+    """/v1/operator/* serve real data when the ApiServer is backed by a
+    raft Server (and 400 on a plain agent store)."""
+    import json
+    import urllib.request
+    import urllib.error
+    from consul_tpu.api.http import ApiServer
+
+    c = ServerCluster(3, seed=9)
+    c.start(0.005)
+    try:
+        deadline = time.time() + 10
+        while c.leader() is None and time.time() < deadline:
+            time.sleep(0.05)
+        leader = c.leader()
+        api = ApiServer(leader, node_name=leader.node_id)
+        api.start()
+        try:
+            out = json.loads(urllib.request.urlopen(
+                api.address + "/v1/operator/autopilot/health",
+                timeout=30).read())
+            assert out["Healthy"] is True
+            assert len(out["Servers"]) == 3
+            assert out["FailureTolerance"] == 1
+            cfg = json.loads(urllib.request.urlopen(
+                api.address + "/v1/operator/raft/configuration",
+                timeout=30).read())
+            assert len(cfg["Servers"]) == 3
+            assert sum(s["Leader"] for s in cfg["Servers"]) == 1
+        finally:
+            api.stop()
+    finally:
+        c.stop()
