@@ -39,7 +39,8 @@ class Agent:
                 node_name=rc.node_name, http_port=rc.http_port,
                 dc=rc.datacenter, acl_enabled=rc.acl_enabled,
                 acl_default_policy=rc.acl_default_policy,
-                acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port)
+                acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port,
+                data_dir=rc.data_dir or None)
         a.runtime_config = rc
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
@@ -111,7 +112,8 @@ class Agent:
                  dc: str = "dc1", acl_enabled: bool = False,
                  acl_default_policy: str = "allow",
                  acl_down_policy: str = "extend-cache",
-                 dns_port: int = 0):
+                 dns_port: int = 0, data_dir: Optional[str] = None):
+        self.data_dir = data_dir
         from consul_tpu.acl import ACLResolver
         from consul_tpu.ae import StateSyncer
         from consul_tpu.checks import CheckManager
@@ -125,8 +127,11 @@ class Agent:
         # local state + AE: /v1/agent writes land here; the syncer pushes
         # to the catalog (reference split: agent/local + agent/ae vs
         # agent/consul catalog)
-        self.local = LocalState(node_name,
-                                on_change=lambda: self.syncer.trigger())
+        def _on_local_change():
+            self.syncer.trigger()
+            self._persist_local()
+
+        self.local = LocalState(node_name, on_change=_on_local_change)
         self.checks = CheckManager(self._check_notify)
         self.syncer = StateSyncer(
             self.local, self.store, interval=60.0,
@@ -169,8 +174,82 @@ class Agent:
 
     # ------------------------------------------------------------- lifecycle
 
+    # ----------------------------------------------------- local persistence
+    # service/check definitions survive restarts via data_dir files, the
+    # reference's persisted services/checks reload (agent/agent.go:533-541)
+
+    _persist_lock = None
+    _restoring = False
+
+    def _persist_local(self) -> None:
+        if not self.data_dir or self._restoring:
+            return
+        import json
+        import os
+        import tempfile
+        if self._persist_lock is None:
+            self._persist_lock = threading.Lock()
+        with self._persist_lock:
+            os.makedirs(self.data_dir, exist_ok=True)
+            state = {"services": self.local.services(),
+                     "checks": self.local.checks(),
+                     "check_definitions": dict(self.checks.definitions)}
+            # unique tmp per writer + atomic replace: concurrent
+            # registrations must not interleave on one tmp path
+            fd, tmp = tempfile.mkstemp(dir=self.data_dir,
+                                       prefix=".local_state.")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(state, f)
+                os.replace(tmp,
+                           os.path.join(self.data_dir, "local_state.json"))
+            except OSError:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+
+    def _restore_local(self) -> None:
+        if not self.data_dir:
+            return
+        import json
+        import os
+        path = os.path.join(self.data_dir, "local_state.json")
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return  # corrupt persistence must not block startup
+        # suppress per-entry rewrites while restoring (a crash mid-restore
+        # must not truncate the on-disk state to a partial set)
+        self._restoring = True
+        try:
+            for sid, svc in state.get("services", {}).items():
+                self.local.add_service(sid, svc["name"],
+                                       port=svc.get("port", 0),
+                                       tags=svc.get("tags") or [],
+                                       meta=svc.get("meta") or {},
+                                       address=svc.get("address", ""))
+            for cid, chk in state.get("checks", {}).items():
+                self.local.add_check(cid, chk.get("name", cid),
+                                     status=chk.get("status", "critical"),
+                                     service_id=chk.get("service_id", ""),
+                                     output=chk.get("output", ""))
+            # re-arm runners from persisted definitions — a restored TTL/
+            # HTTP check must keep EXECUTING, not freeze at its last
+            # status (agent/agent.go:533 re-arms CheckTypes)
+            for cid, defn in state.get("check_definitions", {}).items():
+                runner = self.checks.from_definition(cid, defn)
+                if runner is not None:
+                    self.checks.add(runner)
+        finally:
+            self._restoring = False
+
     def start(self, tick_seconds: float = 0.0,
               reconcile_interval: float = 0.5) -> None:
+        self._restore_local()
         self.store.register_node(self.node_name, "127.0.0.1")
         self.store.register_check(self.node_name, "serfHealth",
                                   "Serf Health Status", status="passing")

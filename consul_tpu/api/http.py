@@ -965,19 +965,26 @@ def _make_handler(srv: ApiServer):
                 # reference (snapshot_endpoint.go ACL check)
                 if not self.authz.acl_write():
                     return self._forbid()
-                snap = json.dumps(store.snapshot()).encode()
-                self._send(None, raw=snap)
+                from consul_tpu import snapshot as snapmod
+                state = store.snapshot()
+                self._send(None, raw=snapmod.write_archive(
+                    state, index=state.get("index", 0)))
                 return True
             if path == "/v1/snapshot" and verb == "PUT":
                 if not self.authz.acl_write():
                     return self._forbid()
-                snap = json.loads(self._body())
-                restored = StateStore.restore(snap)
-                with store._lock:
-                    store.__dict__.update(
-                        {k: v for k, v in restored.__dict__.items()
-                         if k not in ("_lock", "_cond")})
-                    store._cond.notify_all()
+                from consul_tpu import snapshot as snapmod
+                body = self._body()
+                try:
+                    state, _meta = snapmod.read_archive(body)
+                    # dry-run into a scratch store: schema problems must
+                    # surface BEFORE the live store is touched (the old
+                    # half-restored-state failure mode)
+                    StateStore.restore(state)
+                except (snapmod.SnapshotError, Exception) as e:
+                    self._err(400, f"invalid snapshot: {e}")
+                    return True
+                store.load_snapshot(state)
                 self._send(None)
                 return True
             return False

@@ -889,7 +889,18 @@ class StateStore:
             self._acl_bootstrap_index = snap.get("acl_bootstrap_index", 0)
             self._queries = copy.deepcopy(snap.get("queries", {}))
             self._intentions = copy.deepcopy(snap.get("intentions", {}))
+            # watch bookkeeping must rewind with the index, or restored-
+            # to-older stores report watch indexes beyond _index and
+            # blocking queries busy-loop returning immediately
+            self._topic_index = {}
+            self._topic_max = {}
+            self._topic_floor = {}
+            # restore abandons the old state: EVERY parked query wakes and
+            # re-reads (state_store.go:106-112 AbandonCh parity)
             self._cond.notify_all()
+            for w in self._waiters:
+                w.fired = True
+                w.cond.notify_all()
 
     @classmethod
     def restore(cls, snap: dict) -> "StateStore":

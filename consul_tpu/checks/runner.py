@@ -338,6 +338,9 @@ class CheckManager:
         self.notify = notify
         self._runners: dict[str, object] = {}
         self._lock = threading.Lock()
+        # check_id -> definition dict, for persistence/restart re-arming
+        # (the reference persists the full CheckType, agent/agent.go:533)
+        self.definitions: dict[str, dict] = {}
 
     def add(self, runner) -> None:
         with self._lock:
@@ -368,6 +371,7 @@ class CheckManager:
     def from_definition(self, check_id: str, defn: dict):
         """Build a runner from an HTTP-API check definition (the
         reference's structs.CheckType dispatch, agent/agent.go:2403)."""
+        self.definitions[check_id] = dict(defn)
         interval = defn.get("interval", 10.0)
         timeout = defn.get("timeout", 10.0)
         if defn.get("ttl"):

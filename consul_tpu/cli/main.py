@@ -189,13 +189,20 @@ def cmd_session(args) -> int:
 
 
 def cmd_snapshot(args) -> int:
+    from consul_tpu import snapshot as snapmod
     c = _client(args)
     if args.snapshot_cmd == "save":
         data = c.snapshot_save()
+        # verify the archive before declaring success (the reference
+        # re-reads + checksums on save, command/snapshot/save)
+        try:
+            state, meta = snapmod.read_archive(data)
+        except snapmod.SnapshotError as e:
+            print(f"Error verifying snapshot: {e}", file=sys.stderr)
+            return 1
         with open(args.file, "wb") as f:
             f.write(data)
-        print(f"Saved and verified snapshot to index "
-              f"{json.loads(data)['index']}")
+        print(f"Saved and verified snapshot to index {meta['Index']}")
         return 0
     if args.snapshot_cmd == "restore":
         with open(args.file, "rb") as f:
@@ -203,12 +210,17 @@ def cmd_snapshot(args) -> int:
         print("Restored snapshot")
         return 0
     if args.snapshot_cmd == "inspect":
-        snap = json.loads(open(args.file, "rb").read())
-        print(f"Index: {snap['index']}")
-        print(f"KV entries: {len(snap['kv'])}")
-        print(f"Nodes: {len(snap['nodes'])}")
-        print(f"Services: {len(snap['services'])}")
-        print(f"Sessions: {len(snap['sessions'])}")
+        try:
+            info = snapmod.inspect(open(args.file, "rb").read())
+        except snapmod.SnapshotError as e:
+            print(f"Error: {e}", file=sys.stderr)
+            return 1
+        print(f"Created:  {info['Meta'].get('CreatedAt', '')}")
+        print(f"Index:    {info['Meta']['Index']}")
+        print(f"Version:  {info['Meta']['Version']}")
+        print(f"Size:     {info['SizeBytes']}")
+        for table, count in sorted(info["Tables"].items()):
+            print(f"  {table}: {count}")
         return 0
     return 2
 

@@ -176,6 +176,7 @@ class RuntimeConfig:
     node_name: str = "node0"
     datacenter: str = "dc1"
     server: bool = True
+    data_dir: str = ""
     log_level: str = "INFO"
     http_port: int = 0
     dns_port: int = 0
@@ -329,6 +330,7 @@ class Builder:
             node_name=m.get("node_name", "node0"),
             datacenter=m.get("datacenter", "dc1"),
             server=bool(m.get("server", True)),
+            data_dir=str(m.get("data_dir", "") or ""),
             log_level=str(m.get("log_level", "INFO")).upper(),
             http_port=int(ports.get("http", 0) or 0),
             dns_port=int(ports.get("dns", 0) or 0),

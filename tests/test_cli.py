@@ -81,10 +81,11 @@ def test_rtt(run, agent):
 
 def test_snapshot_cli(run, tmp_path):
     run("kv", "put", "snap/k", "v")
-    f = tmp_path / "snap.json"
-    run("snapshot", "save", str(f))
+    f = tmp_path / "snap.tgz"
+    out = run("snapshot", "save", str(f))
+    assert "Saved and verified" in out
     out = run("snapshot", "inspect", str(f))
-    assert "KV entries:" in out
+    assert "kv:" in out and "Index:" in out
     run("snapshot", "restore", str(f))
 
 

@@ -1,0 +1,184 @@
+"""Snapshot archives + agent-local persistence.
+
+VERDICT r1 missing #9/#10, weak #8.  Reference: snapshot/snapshot.go:164
+(tar.gz + SHA-256 + raft meta, verify-before-restore), AbandonCh wakeups
+(state_store.go:106-112), persisted service/check reload
+(agent/agent.go:533-541).
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu import snapshot as snapmod
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+def test_archive_roundtrip_and_inspect():
+    st = StateStore()
+    st.kv_set("a/b", b"1")
+    st.register_service("n1", "s1", "web", port=80)
+    state = st.snapshot()
+    blob = snapmod.write_archive(state, index=state["index"])
+    state2, meta = snapmod.read_archive(blob)
+    assert meta["Index"] == state["index"]
+    st2 = StateStore.restore(state2)
+    assert st2.kv_get("a/b")["value"] == b"1"
+    info = snapmod.inspect(blob)
+    assert info["Tables"]["kv"] == 1
+
+
+def test_corrupt_archive_rejected():
+    blob = snapmod.write_archive({"index": 1, "kv": {}})
+    # flip one byte inside the gzip payload
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(snapmod.SnapshotError):
+        snapmod.read_archive(bytes(bad))
+    with pytest.raises(snapmod.SnapshotError):
+        snapmod.read_archive(b"not an archive at all")
+
+
+def test_tampered_state_fails_checksum():
+    import io
+    import tarfile
+    blob = snapmod.write_archive({"index": 1, "kv": {}})
+    # rebuild the tar with altered state.bin but original SHA256SUMS
+    src = tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz")
+    members = {m.name: src.extractfile(m).read()
+               for m in src.getmembers()}
+    members["state.bin"] = b'{"index": 999, "kv": {}}'
+    out = io.BytesIO()
+    with tarfile.open(fileobj=out, mode="w:gz") as tar:
+        for name, data in members.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+    with pytest.raises(snapmod.SnapshotError, match="checksum"):
+        snapmod.read_archive(out.getvalue())
+
+
+def test_http_snapshot_archive_and_restore_wakes_watchers():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=23))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        c = Client(a.http_address)
+        c.kv_put("snap/x", b"1")
+        blob = c.snapshot_save()
+        state, meta = snapmod.read_archive(blob)   # valid archive
+        c.kv_put("snap/x", b"2")
+
+        # a parked fine-grained watcher on an unrelated key must wake on
+        # restore (abandon semantics)
+        woke = {}
+
+        def waiter():
+            woke["idx"] = a.store.wait_on([("kv", "unrelated")],
+                                          a.store.index, timeout=10.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.2)
+        t0 = time.time()
+        c.snapshot_restore(blob)
+        t.join(5.0)
+        assert time.time() - t0 < 3.0, "restore did not wake watcher"
+        row, _ = c.kv_get("snap/x")
+        assert row["Value"] == b"1"                # rolled back
+
+        # corrupt restore: 400, state untouched
+        from consul_tpu.api.client import ApiError
+        with pytest.raises(ApiError) as e:
+            c.snapshot_restore(b"garbage")
+        assert e.value.code == 400
+        row, _ = c.kv_get("snap/x")
+        assert row["Value"] == b"1"
+    finally:
+        a.stop()
+
+
+def test_agent_persists_and_restores_local_state(tmp_path):
+    data_dir = str(tmp_path / "data")
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=24),
+              data_dir=data_dir)
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    a.local.add_service("p1", "persisted", port=9090)
+    a.local.add_check("pc1", "persisted check", status="passing",
+                      service_id="p1")
+    a.stop()
+
+    b = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=25),
+              data_dir=data_dir)
+    b.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        assert "p1" in b.local.services()
+        assert b.local.services()["p1"]["port"] == 9090
+        assert "pc1" in b.local.checks()
+        # and it syncs into the fresh catalog
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if b.store.service_nodes("persisted"):
+                break
+            time.sleep(0.1)
+        assert b.store.service_nodes("persisted")
+    finally:
+        b.stop()
+
+
+def test_restored_ttl_check_keeps_running(tmp_path):
+    """A persisted TTL check must re-arm its runner after restart — not
+    freeze at its last status (agent/agent.go:533 re-arming)."""
+    import json
+    import urllib.request
+
+    data_dir = str(tmp_path / "d2")
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=26),
+              data_dir=data_dir)
+    a.start(tick_seconds=0.0, reconcile_interval=0.2)
+    req = urllib.request.Request(
+        a.http_address + "/v1/agent/check/register",
+        data=json.dumps({"Name": "ttl1", "CheckID": "ttl1",
+                         "TTL": "0.5s"}).encode(), method="PUT")
+    urllib.request.urlopen(req, timeout=10)
+    urllib.request.urlopen(urllib.request.Request(
+        a.http_address + "/v1/agent/check/pass/ttl1", data=b"",
+        method="PUT"), timeout=10)
+    a.stop()
+
+    b = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=27),
+              data_dir=data_dir)
+    b.start(tick_seconds=0.0, reconcile_interval=0.2)
+    try:
+        assert "ttl1" in b.checks.definitions
+        # the re-armed TTL runner must EXPIRE the check (nobody renews)
+        deadline = time.time() + 10
+        status = None
+        while time.time() < deadline:
+            status = b.local.checks().get("ttl1", {}).get("status")
+            if status == "critical":
+                break
+            time.sleep(0.1)
+        assert status == "critical", "restored TTL check never expired"
+    finally:
+        b.stop()
+
+
+def test_restore_older_snapshot_resets_watch_indexes():
+    st = StateStore()
+    st.kv_set("w/1", b"a")
+    snap = st.snapshot()
+    for i in range(10):
+        st.kv_set("w/1", b"b")
+    st.load_snapshot(snap)
+    # watch bookkeeping rewound with the index: a blocking query parked
+    # at the restored index must actually park, not spin
+    assert st.watch_index([("kv", "w/1")]) <= st.index
