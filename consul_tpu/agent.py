@@ -283,6 +283,8 @@ class Agent:
         if self.api.view_store is not None:
             self.api.view_store.close()
         self.api.agent_cache.close()
+        if self.api._proxycfg is not None:
+            self.api._proxycfg.close()
         self.dns.stop()
         if self._reconcile_thread:
             self._reconcile_thread.join(timeout=5.0)

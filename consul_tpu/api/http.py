@@ -139,6 +139,14 @@ class ApiServer:
         return rows, self.store.index
 
     @property
+    def default_allow(self) -> bool:
+        """Intention/RBAC default: follows the ACL default policy when
+        ACLs are enabled, else allow (one definition - intentions check,
+        authorize, and xDS RBAC all share it)."""
+        return self.acl.default_policy == "allow" \
+            if getattr(self.acl, "enabled", False) else True
+
+    @property
     def ca(self):
         # double-checked under a lock: two concurrent first requests must
         # not build two CAManagers with different trust domains
@@ -148,6 +156,20 @@ class ApiServer:
                     from consul_tpu.connect.ca import CAManager
                     self._ca = CAManager(dc=self.dc)
         return self._ca
+
+    _proxycfg = None
+    _proxycfg_lock = threading.Lock()
+
+    @property
+    def proxycfg(self):
+        if self._proxycfg is None:
+            with self._proxycfg_lock:
+                if self._proxycfg is None:
+                    from consul_tpu.proxycfg import Manager
+                    self._proxycfg = Manager(
+                        self.store, self.ca,
+                        default_allow=self.default_allow)
+        return self._proxycfg
 
     def attach_router(self, router) -> None:
         """Join a federation: register this DC's surface and wire the
@@ -296,8 +318,43 @@ def _make_handler(srv: ApiServer):
         def _agent_register_service(self, sid: str, body: dict) -> None:
             """Write through local state + AE when wired; otherwise the
             store directly (structs.ServiceDefinition handling,
-            agent/agent_endpoint.go AgentRegisterService)."""
+            agent/agent_endpoint.go AgentRegisterService).  Sidecar
+            (Kind=connect-proxy) registrations carry their Proxy config
+            to the catalog directly — proxycfg discovers them there."""
             name = body.get("Name", sid)
+            if body.get("Kind") == "connect-proxy":
+                proxy_raw = body.get("Proxy") or {}
+                proxy = {
+                    "destination_service": proxy_raw.get(
+                        "DestinationServiceName", ""),
+                    "upstreams": [
+                        {"destination_name": u.get(
+                            "DestinationName", ""),
+                         "local_bind_port": u.get("LocalBindPort", 0),
+                         "local_bind_address": u.get(
+                             "LocalBindAddress", "127.0.0.1")}
+                        for u in proxy_raw.get("Upstreams") or []],
+                }
+                store.register_service(
+                    srv.node_name, sid, name,
+                    port=body.get("Port", 0),
+                    tags=body.get("Tags") or [],
+                    meta=body.get("Meta") or {},
+                    address=body.get("Address", ""),
+                    kind="connect-proxy", proxy=proxy)
+                # checks attached to the sidecar register store-side too
+                # (the early return must not drop them)
+                checks = list(body.get("Checks") or [])
+                if body.get("Check"):
+                    checks.append(body["Check"])
+                for i, chk in enumerate(checks):
+                    cid = chk.get("CheckID") or \
+                        f"service:{sid}" + (f":{i+1}" if i else "")
+                    store.register_check(
+                        srv.node_name, cid, chk.get("Name") or cid,
+                        status=chk.get("Status", "critical"),
+                        service_id=sid)
+                return
             if srv.local is not None:
                 srv.local.add_service(
                     sid, name, port=body.get("Port", 0),
@@ -615,7 +672,7 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.service_write(
                         svc["name"] if svc else sid):
                     return self._forbid()
-                if srv.local is not None:
+                if srv.local is not None and sid in srv.local.services():
                     if srv.checks is not None:
                         for cid, c in srv.local.checks().items():
                             if c["service_id"] == sid:
@@ -623,6 +680,9 @@ def _make_handler(srv: ApiServer):
                     srv.local.remove_service(sid)
                     srv.local.sync_changes(store)
                 else:
+                    # store-registered services (connect-proxy sidecars
+                    # bypass local state) deregister store-side — no
+                    # ghost proxies surviving their own deregistration
                     store.deregister_service(srv.node_name, sid)
                 self._send(None)
                 return True
@@ -956,7 +1016,8 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/query" or path.startswith("/v1/query/"):
                 return self._query(verb, path, q)
             if path.startswith("/v1/connect/") \
-                    or path.startswith("/v1/agent/connect/"):
+                    or path.startswith("/v1/agent/connect/") \
+                    or path.startswith("/v1/agent/xds/"):
                 return self._connect(verb, path, q)
             if path == "/v1/txn" and verb == "PUT":
                 return self._txn()
@@ -1356,10 +1417,9 @@ def _make_handler(srv: ApiServer):
                 dst_n = q.get("destination", "")
                 if not self.authz.service_read(dst_n):
                     return self._forbid()
-                default_allow = srv.acl.default_policy == "allow" \
-                    if getattr(srv.acl, "enabled", False) else True
                 ok, _reason = imod.authorize(
-                    store.intention_list(), src_n, dst_n, default_allow)
+                    store.intention_list(), src_n, dst_n,
+                    srv.default_allow)
                 self._send({"Allowed": ok})
                 return True
             m = re.fullmatch(r"/v1/connect/intentions/([^/]+)", path)
@@ -1402,6 +1462,26 @@ def _make_handler(srv: ApiServer):
                 store.intention_delete(m.group(1))
                 self._send(True)
                 return True
+            m = re.fullmatch(r"/v1/agent/xds/([^/]+)", path)
+            if m and verb == "GET":
+                # the xDS long-poll (delta.go:33 semantics over JSON/HTTP
+                # — see consul_tpu/xds.py docstring for the divergence)
+                state = srv.proxycfg.watch(m.group(1))
+                if state is None:
+                    self._err(404, "unknown proxy service id")
+                    return True
+                # authorize on the REGISTERED service name, not the raw
+                # id (parity with the other agent service endpoints)
+                if not self.authz.service_write(
+                        state.svc.get("name", m.group(1))):
+                    return self._forbid()
+                from consul_tpu import xds as xdsmod
+                min_v = int(q.get("version", 0) or 0)
+                wait = _parse_wait(q.get("wait", "300s")) \
+                    if "version" in q else 0.0
+                snap = state.fetch(min_v, timeout=wait)
+                self._send(xdsmod.snapshot_resources(snap))
+                return True
             if path == "/v1/connect/ca/roots" and verb == "GET":
                 roots = srv.ca.roots()
                 self._send({"ActiveRootID": next(
@@ -1413,7 +1493,15 @@ def _make_handler(srv: ApiServer):
                 # operator:write like CA config changes
                 if not self.authz.operator_write():
                     return self._forbid()
-                self._send({"ActiveRootID": srv.ca.rotate()})
+                new_root = srv.ca.rotate()
+                # rotation is a mesh-wide event: every proxy snapshot
+                # must re-sign its leaf without waiting for other churn
+                pub = getattr(store, "publisher", None)
+                if pub is not None:
+                    from consul_tpu.stream.publisher import Event
+                    pub.publish([Event(topic="ca", key="",
+                                       index=store.index)])
+                self._send({"ActiveRootID": new_root})
                 return True
             m = re.fullmatch(r"/v1/agent/connect/ca/leaf/([^/]+)", path)
             if m and verb == "GET":
@@ -1428,10 +1516,9 @@ def _make_handler(srv: ApiServer):
                     return self._forbid()
                 client_uri = body.get("ClientCertURI", "")
                 source = imod.spiffe_service(client_uri) or ""
-                default_allow = srv.acl.default_policy == "allow" \
-                    if getattr(srv.acl, "enabled", False) else True
                 ok, reason = imod.authorize(store.intention_list(),
-                                            source, target, default_allow)
+                                            source, target,
+                                            srv.default_allow)
                 self._send({"Authorized": ok, "Reason": reason})
                 return True
             return False

@@ -313,7 +313,10 @@ class StateStore:
 
     def register_service(self, node: str, service_id: str, name: str,
                          port: int = 0, tags: List[str] | None = None,
-                         meta: dict | None = None, address: str = "") -> int:
+                         meta: dict | None = None, address: str = "",
+                         kind: str = "", proxy: dict | None = None) -> int:
+        """`kind`/`proxy` carry the mesh shape (connect-proxy sidecars
+        with destination + upstreams — structs.NodeService Kind/Proxy)."""
         with self._lock:
             if node not in self._nodes:
                 self.register_node(node, address or "127.0.0.1")
@@ -324,6 +327,7 @@ class StateStore:
             self._services[key] = {
                 "name": name, "port": port, "tags": tags or [],
                 "meta": meta or {}, "address": address,
+                "kind": kind, "proxy": proxy or {},
                 "create_index": existing.get("create_index", idx),
                 "modify_index": idx,
             }
@@ -443,6 +447,8 @@ class StateStore:
                              "service_id": sid, "service_name": name,
                              "port": v["port"], "tags": v["tags"],
                              "service_address": v["address"],
+                             "kind": v.get("kind", ""),
+                             "proxy": v.get("proxy", {}),
                              "modify_index": v["modify_index"]})
             return rows
 

@@ -1,0 +1,197 @@
+"""proxycfg: per-proxy configuration snapshots for the mesh data plane.
+
+The reference's proxycfg manager (agent/proxycfg/manager.go:38, Watch
+:303, state machine state.go) assembles, per registered sidecar proxy, a
+ConfigSnapshot from many watches — CA roots, the service leaf, upstream
+health, intentions — and pushes a fresh snapshot to the xDS server on
+every relevant change.  Here each snapshot rebuilds from materialized
+sources when a relevant store event lands (health of an upstream,
+intention change) or the CA rotates, and `watch()` serves blocking
+fetches keyed by version, exactly the shape the xDS layer long-polls.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from consul_tpu.connect import intentions as imod
+
+
+class ConfigSnapshot:
+    """One proxy's full mesh view (proxycfg.ConfigSnapshot)."""
+
+    def __init__(self, proxy_id: str, service: str, upstreams: List[dict],
+                 roots: List[dict], leaf: dict,
+                 upstream_endpoints: Dict[str, List[dict]],
+                 intentions: List[dict], default_allow: bool,
+                 version: int):
+        self.proxy_id = proxy_id
+        self.service = service
+        self.upstreams = upstreams
+        self.roots = roots
+        self.leaf = leaf
+        self.upstream_endpoints = upstream_endpoints
+        self.intentions = intentions
+        self.default_allow = default_allow
+        self.version = version
+
+
+class ProxyState:
+    """Watch set + rebuild loop for one proxy (proxycfg/state.go)."""
+
+    def __init__(self, manager: "Manager", proxy_id: str, svc: dict):
+        self.manager = manager
+        self.proxy_id = proxy_id
+        self.svc = svc
+        self._cond = threading.Condition()
+        self._snapshot: Optional[ConfigSnapshot] = None
+        self._version = 0
+        self._subs = []
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._running = True
+        self._rebuild()
+        pub = self.manager.store.publisher
+        proxy = self.svc.get("proxy") or {}
+        # CA topic included: a root rotation must rebuild every proxy
+        # snapshot without waiting for unrelated churn
+        topics = [("intentions", None), ("ca", None)]
+        for up in proxy.get("upstreams") or []:
+            topics.append(("health", up.get("destination_name", "")))
+        self._subs = [pub.subscribe(t, k, since_index=None)
+                      for t, k in topics]
+        self._thread = threading.Thread(target=self._follow, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        for s in self._subs:
+            s.close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _follow(self) -> None:
+        from consul_tpu.stream.publisher import SnapshotRequired
+        while self._running:
+            fired = False
+            for s in self._subs:
+                try:
+                    if s.events(timeout=0.2):
+                        fired = True
+                except SnapshotRequired:
+                    if not self._running:
+                        return
+                    fired = True
+            if fired:
+                self._rebuild()
+
+    def _rebuild(self) -> None:
+        m = self.manager
+        proxy = self.svc.get("proxy") or {}
+        service = proxy.get("destination_service",
+                            self.svc.get("name", ""))
+        upstreams = proxy.get("upstreams") or []
+        endpoints: Dict[str, List[dict]] = {}
+        for up in upstreams:
+            name = up.get("destination_name", "")
+            rows = m.store.health_service_nodes(name)
+            eps = []
+            for r in rows:
+                if any(c["status"] == "critical" for c in r["checks"]):
+                    continue
+                s = r["service"]
+                eps.append({"address": s.get("service_address")
+                            or s.get("address", ""),
+                            "port": s.get("port", 0),
+                            "node": s.get("node", "")})
+            endpoints[name] = eps
+        relevant = imod.match_order(m.store.intention_list(), service,
+                                    "destination")
+        leaf = m.get_leaf(service)
+        with self._cond:
+            self._version += 1
+            self._snapshot = ConfigSnapshot(
+                proxy_id=self.proxy_id, service=service,
+                upstreams=upstreams, roots=m.ca.roots(), leaf=leaf,
+                upstream_endpoints=endpoints, intentions=relevant,
+                default_allow=m.default_allow, version=self._version)
+            self._cond.notify_all()
+
+    def fetch(self, min_version: int = 0,
+              timeout: float = 300.0) -> ConfigSnapshot:
+        deadline = time.time() + timeout
+        with self._cond:
+            while (self._snapshot is None
+                   or self._snapshot.version <= min_version):
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self._snapshot
+
+
+class Manager:
+    """Proxy registry (proxycfg.Manager): one ProxyState per registered
+    sidecar, created lazily from the catalog's connect-proxy services."""
+
+    def __init__(self, store, ca, default_allow: bool = True):
+        self.store = store
+        self.ca = ca
+        self.default_allow = default_allow
+        self._leaves: Dict[str, Tuple[str, dict]] = {}  # svc -> (root, leaf)
+        self._leaf_lock = threading.Lock()
+        self._states: Dict[str, ProxyState] = {}
+        self._lock = threading.Lock()
+
+    def get_leaf(self, service: str) -> dict:
+        """Cached leaf, re-signed when missing or the active root moved
+        (leader_connect_ca.go leaf rotation on root change)."""
+        active = self.ca.active.id
+        with self._leaf_lock:
+            hit = self._leaves.get(service)
+            if hit is not None and hit[0] == active:
+                return hit[1]
+            leaf = self.ca.sign_leaf(service)
+            self._leaves[service] = (active, leaf)
+            return leaf
+
+    def watch(self, proxy_id: str) -> Optional[ProxyState]:
+        """ProxyState for a registered connect-proxy service id
+        (Manager.Watch :303); None when no such proxy exists.  The
+        catalog is revalidated on every call: a re-registration with a
+        changed proxy config replaces the state (new watch set), a
+        deregistered proxy drops it."""
+        svc = self._find_proxy(proxy_id)
+        with self._lock:
+            st = self._states.get(proxy_id)
+            if svc is None:
+                if st is not None:
+                    st.stop()
+                    del self._states[proxy_id]
+                return None
+            if st is not None and st.svc.get("modify_index") == \
+                    svc.get("modify_index"):
+                return st
+            if st is not None:
+                st.stop()
+            st = ProxyState(self, proxy_id, svc)
+            st.start()
+            self._states[proxy_id] = st
+            return st
+
+    def _find_proxy(self, proxy_id: str) -> Optional[dict]:
+        for n in self.store.nodes():
+            for s in self.store.node_services(n["node"]):
+                if s["id"] == proxy_id and s.get("kind") == "connect-proxy":
+                    return s
+        return None
+
+    def close(self) -> None:
+        with self._lock:
+            for st in self._states.values():
+                st.stop()
+            self._states.clear()

@@ -1,0 +1,157 @@
+"""proxycfg snapshots + xDS resource generation.
+
+SURVEY #10/#31.  Reference: proxycfg manager (agent/proxycfg/manager.go:
+38, Watch :303), xDS server + resource generation (agent/xds/server.go:
+186, clusters.go, endpoints.go, listeners.go), RBAC from intentions.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=31))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    # upstream service + the web app + its sidecar proxy
+    a.store.register_service("n2", "db1", "db", port=5432)
+    req = urllib.request.Request(
+        a.http_address + "/v1/agent/service/register",
+        data=json.dumps({
+            "Name": "web-sidecar-proxy", "ID": "web-sidecar-proxy",
+            "Kind": "connect-proxy", "Port": 21000,
+            "Proxy": {"DestinationServiceName": "web",
+                      "Upstreams": [{"DestinationName": "db",
+                                     "LocalBindPort": 9191}]},
+        }).encode(), method="PUT")
+    urllib.request.urlopen(req, timeout=30)
+    yield a
+    a.stop()
+
+
+def _xds(a, proxy_id, version=None, wait=None):
+    qs = ""
+    if version is not None:
+        qs = f"?version={version}&wait={wait or '5s'}"
+    r = urllib.request.urlopen(
+        a.http_address + f"/v1/agent/xds/{proxy_id}" + qs, timeout=30)
+    return json.loads(r.read())
+
+
+def test_snapshot_has_all_resource_types(agent):
+    out = _xds(agent, "web-sidecar-proxy")
+    res = out["Resources"]
+    assert out["Service"] == "web"
+    names = {c["name"] for c in res["clusters"]}
+    assert {"local_app", "db"} <= names
+    eds = {e["cluster_name"]: e for e in res["endpoints"]}
+    eps = eds["db"]["endpoints"][0]["lb_endpoints"]
+    assert eps[0]["endpoint"]["address"]["socket_address"][
+        "port_value"] == 5432
+    lds = {l["name"]: l for l in res["listeners"]}
+    assert "public_listener" in lds
+    assert "db:9191" in lds
+    # inbound chain carries mTLS material from the CA
+    chain = lds["public_listener"]["filter_chains"][0]
+    assert "BEGIN CERTIFICATE" in chain["transport_socket"][
+        "common_tls_context"]["tls_certificates"][0]["certificate_chain"]
+    assert res["routes"]
+
+
+def test_upstream_health_change_bumps_version(agent):
+    out = _xds(agent, "web-sidecar-proxy")
+    v = int(out["VersionInfo"])
+    got = {}
+
+    def poll():
+        got["out"] = _xds(agent, "web-sidecar-proxy", version=v)
+
+    t = threading.Thread(target=poll)
+    t.start()
+    time.sleep(0.3)
+    agent.store.register_check("n2", "dbc", "db check",
+                               status="critical", service_id="db1")
+    t.join(15.0)
+    out2 = got["out"]
+    assert int(out2["VersionInfo"]) > v
+    eds = {e["cluster_name"]: e
+           for e in out2["Resources"]["endpoints"]}
+    assert eds["db"]["endpoints"][0]["lb_endpoints"] == []  # critical gone
+
+
+def test_intention_appears_as_rbac_rule(agent):
+    agent.store.intention_set("ix1", "evil", "web", "deny")
+    try:
+        deadline = time.time() + 5
+        rules = []
+        while time.time() < deadline:
+            out = _xds(agent, "web-sidecar-proxy")
+            rbac = out["Resources"]["listeners"][0]["filter_chains"][0][
+                "filters"][0]
+            rules = rbac["rules"]
+            if rules:
+                break
+            time.sleep(0.2)
+        assert any(r["action"] == "DENY" and "evil" in
+                   r["principals"][0]["authenticated"]["principal_name"][
+                       "safe_regex"]["regex"]
+                   for r in rules)
+    finally:
+        agent.store.intention_delete("ix1")
+
+
+def test_unknown_proxy_404(agent):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _xds(agent, "nope")
+    assert e.value.code == 404
+
+
+def test_ca_rotation_alone_refreshes_leaf(agent):
+    """Rotation must rebuild proxy snapshots with NO other churn — the
+    rotate endpoint publishes a CA event every proxy watches."""
+    import urllib.request as _rq
+    out = _xds(agent, "web-sidecar-proxy")
+    leaf1 = out["Resources"]["clusters"][1]["transport_socket"][
+        "common_tls_context"]["tls_certificates"][0]["certificate_chain"]
+    _rq.urlopen(_rq.Request(
+        agent.http_address + "/v1/connect/ca/rotate", data=b"",
+        method="PUT"), timeout=30)
+    deadline = time.time() + 10
+    leaf2 = leaf1
+    while time.time() < deadline and leaf2 == leaf1:
+        out2 = _xds(agent, "web-sidecar-proxy")
+        leaf2 = out2["Resources"]["clusters"][1]["transport_socket"][
+            "common_tls_context"]["tls_certificates"][0][
+            "certificate_chain"]
+        time.sleep(0.2)
+    assert leaf2 != leaf1, "leaf did not re-sign after CA rotation"
+    assert agent.api.ca.verify_leaf(leaf2)
+
+
+def test_sidecar_deregisters_cleanly(agent):
+    """A connect-proxy registered through the agent endpoint must also
+    DEregister through it (no ghost proxies)."""
+    import urllib.request as _rq
+    req = _rq.Request(
+        agent.http_address + "/v1/agent/service/register",
+        data=json.dumps({
+            "Name": "tmp-proxy", "ID": "tmp-proxy",
+            "Kind": "connect-proxy",
+            "Proxy": {"DestinationServiceName": "tmp"}}).encode(),
+        method="PUT")
+    _rq.urlopen(req, timeout=30)
+    assert _xds(agent, "tmp-proxy")["Service"] == "tmp"
+    _rq.urlopen(_rq.Request(
+        agent.http_address + "/v1/agent/service/deregister/tmp-proxy",
+        data=b"", method="PUT"), timeout=30)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _xds(agent, "tmp-proxy")
+    assert e.value.code == 404
