@@ -342,8 +342,10 @@ def _make_handler(srv: ApiServer):
                     meta=body.get("Meta") or {},
                     address=body.get("Address", ""),
                     kind="connect-proxy", proxy=proxy)
-                # checks attached to the sidecar register store-side too
-                # (the early return must not drop them)
+                # checks attached to the sidecar register store-side
+                # AND arm their runners, notifying the store directly
+                # (sidecars bypass local state, so runner results can't
+                # ride the AE path)
                 checks = list(body.get("Checks") or [])
                 if body.get("Check"):
                     checks.append(body["Check"])
@@ -354,6 +356,20 @@ def _make_handler(srv: ApiServer):
                         srv.node_name, cid, chk.get("Name") or cid,
                         status=chk.get("Status", "critical"),
                         service_id=sid)
+                    defn = _check_defn(chk)
+                    if srv.checks is not None and defn:
+                        runner = srv.checks.from_definition(cid, defn)
+                        if runner is not None:
+                            def _store_notify(check_id, status,
+                                              output=""):
+                                try:
+                                    store.update_check(
+                                        srv.node_name, check_id,
+                                        status, output=output)
+                                except KeyError:
+                                    pass
+                            runner.notify = _store_notify
+                            srv.checks.add(runner)
                 return
             if srv.local is not None:
                 srv.local.add_service(
@@ -665,10 +681,15 @@ def _make_handler(srv: ApiServer):
             m = re.fullmatch(r"/v1/agent/service/deregister/(.+)", path)
             if m and verb == "PUT":
                 sid = m.group(1)
-                svc = (srv.local.services().get(sid)
-                       if srv.local is not None else
-                       next((s for s in store.node_services(srv.node_name)
-                             if s["id"] == sid), None))
+                svc = srv.local.services().get(sid) \
+                    if srv.local is not None else None
+                if svc is None:
+                    # store-registered (connect-proxy) services aren't in
+                    # local state: resolve the NAME from the catalog so
+                    # ACL checks match registration
+                    svc = next((s for s in
+                                store.node_services(srv.node_name)
+                                if s["id"] == sid), None)
                 if not self.authz.service_write(
                         svc["name"] if svc else sid):
                     return self._forbid()

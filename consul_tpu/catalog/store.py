@@ -30,6 +30,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from consul_tpu.native_index import PrefixIndex
 from consul_tpu.stream.publisher import Event, EventPublisher
 
 # Fine-grained watch fan-in cap: past this many parked blocking queries the
@@ -68,9 +69,11 @@ class StateStore:
         # per-index watch channels state_store.go:102-120)
         self.publisher = EventPublisher()
         self._waiters: List[_Waiter] = []
-        # topic -> {key -> last commit index}; bounded per-topic scans for
-        # prefix watches (the review's fix for an unbounded flat dict)
-        self._topic_index: Dict[str, Dict[str, int]] = {}
+        # topic -> ordered key->index map (native C++ prefix index when
+        # buildable — the go-memdb radix-tree role; consul_tpu/
+        # native_index.py): prefix watch lookups are O(log n + m), not a
+        # full-topic scan
+        self._topic_index: Dict[str, object] = {}
         self._topic_max: Dict[str, int] = {}                # topic -> idx
         # compaction floor: when a topic's per-key map is compacted, keys
         # dropped resolve to this index (conservative — may cause a
@@ -114,17 +117,18 @@ class StateStore:
         self._index += 1
         idx = self._index
         for topic, key in events:
-            tmap = self._topic_index.setdefault(topic, {})
-            tmap[key] = idx
+            tmap = self._topic_index.get(topic)
+            if tmap is None:
+                tmap = self._topic_index[topic] = PrefixIndex()
+            tmap.set(key, idx)
             if self._topic_max.get(topic, 0) < idx:
                 self._topic_max[topic] = idx
             if len(tmap) > 65536:
-                # drop the older half; dropped keys resolve to the floor
-                cut = sorted(tmap.values())[len(tmap) // 2]
-                self._topic_floor[topic] = max(
-                    self._topic_floor.get(topic, 0), cut)
-                self._topic_index[topic] = {
-                    k: i for k, i in tmap.items() if i > cut}
+                # compact: drop the whole per-key map behind a coarse
+                # floor (one spurious wakeup per parked watcher of this
+                # topic; never a missed one) — the tombstone-GC analogue
+                self._topic_floor[topic] = self._topic_max[topic]
+                self._topic_index[topic] = PrefixIndex()
         self._cond.notify_all()
         for w in self._waiters:
             if w.fired:
@@ -150,14 +154,15 @@ class StateStore:
                     best = max(best, self._topic_max.get(wt, 0))
                 elif wt.endswith(":prefix"):
                     topic = wt[: -len(":prefix")]
-                    best = max(best, self._topic_floor.get(topic, 0))
-                    for k, i in self._topic_index.get(topic, {}).items():
-                        if k.startswith(wk):
-                            best = max(best, i)
+                    floor = self._topic_floor.get(topic, 0)
+                    tmap = self._topic_index.get(topic)
+                    pm = tmap.prefix_max(wk, 0) if tmap is not None else 0
+                    best = max(best, floor, pm)
                 else:
-                    best = max(best,
-                               self._topic_index.get(wt, {}).get(
-                                   wk, self._topic_floor.get(wt, 0)))
+                    floor = self._topic_floor.get(wt, 0)
+                    tmap = self._topic_index.get(wt)
+                    got = tmap.get(wk, floor) if tmap is not None else floor
+                    best = max(best, got)
             return best
 
     def wait_for(self, index: Optional[int], timeout: float = 300.0) -> int:
@@ -419,6 +424,15 @@ class StateStore:
     def nodes(self) -> List[dict]:
         with self._lock:
             return [dict(v, node=k) for k, v in sorted(self._nodes.items())]
+
+    def service_by_id(self, service_id: str) -> Optional[dict]:
+        """Single-pass (node, id) lookup — no per-node list builds (the
+        proxycfg watch path polls this per xDS request)."""
+        with self._lock:
+            for (n, sid), v in self._services.items():
+                if sid == service_id:
+                    return dict(v, id=sid, node=n)
+            return None
 
     def node_services(self, node: str) -> List[dict]:
         with self._lock:

@@ -1,0 +1,188 @@
+"""ctypes bridge to the native prefix index (native/prefix_index.cpp).
+
+The runtime around the TPU compute path is native where the reference's
+is: go-memdb's radix tree is the state store's ordered-index engine, and
+this module loads its C++ counterpart — building it on first use with
+the toolchain baked into the image — with a pure-Python fallback so the
+framework degrades gracefully where no compiler exists.
+
+`PrefixIndex` is the shared surface: set/delete/get plus prefix_max
+(per-prefix watch indexes), prefix_count, and sorted prefix_keys.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "prefix_index.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libprefix_index.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Build (once) + load the shared object; None when unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                     "-o", _SO + ".tmp", _SRC],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(_SO + ".tmp", _SO)
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.SubprocessError):
+            _build_failed = True
+            return None
+        lib.pfx_new.restype = ctypes.c_void_p
+        lib.pfx_free.argtypes = [ctypes.c_void_p]
+        lib.pfx_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+        lib.pfx_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pfx_del.restype = ctypes.c_int
+        lib.pfx_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64]
+        lib.pfx_get.restype = ctypes.c_int64
+        lib.pfx_len.argtypes = [ctypes.c_void_p]
+        lib.pfx_len.restype = ctypes.c_int64
+        lib.pfx_prefix_max.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                       ctypes.c_int64]
+        lib.pfx_prefix_max.restype = ctypes.c_int64
+        lib.pfx_prefix_count.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pfx_prefix_count.restype = ctypes.c_int64
+        lib.pfx_prefix_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64]
+        lib.pfx_prefix_keys.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+_bg_started = False
+
+
+def _ensure_building() -> None:
+    """Kick the build on a background thread: the first caller must not
+    pay (or hold locks across) a g++ compile — callers use the Python
+    fallback until the library is ready."""
+    global _bg_started
+    if _bg_started or _lib is not None or _build_failed:
+        return
+    _bg_started = True
+    threading.Thread(target=_load, daemon=True).start()
+
+
+class _NativePrefixIndex:
+    def __init__(self):
+        self._lib = _load()
+        self._h = self._lib.pfx_new()
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            lib.pfx_free(h)
+            self._h = None
+
+    def set(self, key: str, value: int) -> None:
+        self._lib.pfx_set(self._h, key.encode(), value)
+
+    def delete(self, key: str) -> bool:
+        return bool(self._lib.pfx_del(self._h, key.encode()))
+
+    def get(self, key: str, default: int = 0) -> int:
+        return self._lib.pfx_get(self._h, key.encode(), default)
+
+    def __len__(self) -> int:
+        return self._lib.pfx_len(self._h)
+
+    def prefix_max(self, prefix: str, default: int = 0) -> int:
+        return self._lib.pfx_prefix_max(self._h, prefix.encode(), default)
+
+    def prefix_count(self, prefix: str) -> int:
+        return self._lib.pfx_prefix_count(self._h, prefix.encode())
+
+    def prefix_keys(self, prefix: str, limit: int = 1 << 31) -> List[str]:
+        cap = 4096
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = self._lib.pfx_prefix_keys(self._h, prefix.encode(), buf,
+                                          cap, limit)
+            if n >= 0:
+                raw = buf.raw
+                out, pos = [], 0
+                for _ in range(n):
+                    end = raw.index(b"\x00", pos)
+                    out.append(raw[pos:end].decode())
+                    pos = end + 1
+                return out
+            cap *= 4
+
+
+class _PyPrefixIndex:
+    """Pure-Python fallback (no compiler in the environment)."""
+
+    def __init__(self):
+        self._d = {}
+
+    def set(self, key: str, value: int) -> None:
+        self._d[key] = value
+
+    def delete(self, key: str) -> bool:
+        return self._d.pop(key, None) is not None
+
+    def get(self, key: str, default: int = 0) -> int:
+        return self._d.get(key, default)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def prefix_max(self, prefix: str, default: int = 0) -> int:
+        best, any_ = default, False
+        for k, v in self._d.items():
+            if k.startswith(prefix) and (not any_ or v > best):
+                best, any_ = v, True
+        return best
+
+    def prefix_count(self, prefix: str) -> int:
+        return sum(1 for k in self._d if k.startswith(prefix))
+
+    def prefix_keys(self, prefix: str, limit: int = 1 << 31) -> List[str]:
+        return sorted(k for k in self._d
+                      if k.startswith(prefix))[:limit]
+
+
+def PrefixIndex():
+    """Factory: native when ALREADY built/loaded, Python otherwise (the
+    background build upgrades future instances; existing ones keep
+    working — both impls share one semantics)."""
+    if _lib is not None:
+        return _NativePrefixIndex()
+    try:
+        fresh = os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    except OSError:
+        fresh = False
+    if fresh:
+        # cheap load path: an up-to-date library exists, no compile
+        return _NativePrefixIndex() if native_available() \
+            else _PyPrefixIndex()
+    _ensure_building()
+    return _PyPrefixIndex()

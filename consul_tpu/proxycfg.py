@@ -18,6 +18,9 @@ from typing import Dict, List, Optional, Tuple
 
 from consul_tpu.connect import intentions as imod
 
+# re-sign margin: leaves refresh well before their notAfter
+_LEAF_REFRESH_FRACTION = 0.75
+
 
 class ConfigSnapshot:
     """One proxy's full mesh view (proxycfg.ConfigSnapshot)."""
@@ -41,13 +44,17 @@ class ConfigSnapshot:
 class ProxyState:
     """Watch set + rebuild loop for one proxy (proxycfg/state.go)."""
 
-    def __init__(self, manager: "Manager", proxy_id: str, svc: dict):
+    def __init__(self, manager: "Manager", proxy_id: str, svc: dict,
+                 start_version: int = 0):
         self.manager = manager
         self.proxy_id = proxy_id
         self.svc = svc
         self._cond = threading.Condition()
         self._snapshot: Optional[ConfigSnapshot] = None
-        self._version = 0
+        # versions survive state replacement: a long-poller parked on
+        # version N must see N+1 from the REPLACED state, not a restart
+        # at 1 it would read as no-change
+        self._version = start_version
         self._subs = []
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -69,6 +76,10 @@ class ProxyState:
 
     def stop(self) -> None:
         self._running = False
+        with self._cond:
+            # wake parked fetchers so they re-poll (and land on the
+            # replacement state) instead of sleeping out their wait
+            self._cond.notify_all()
         for s in self._subs:
             s.close()
         if self._thread is not None:
@@ -142,21 +153,26 @@ class Manager:
         self.store = store
         self.ca = ca
         self.default_allow = default_allow
-        self._leaves: Dict[str, Tuple[str, dict]] = {}  # svc -> (root, leaf)
+        # svc -> (root_id, leaf, refresh_deadline)
+        self._leaves: Dict[str, Tuple[str, dict, float]] = {}
         self._leaf_lock = threading.Lock()
         self._states: Dict[str, ProxyState] = {}
         self._lock = threading.Lock()
 
     def get_leaf(self, service: str) -> dict:
-        """Cached leaf, re-signed when missing or the active root moved
-        (leader_connect_ca.go leaf rotation on root change)."""
+        """Cached leaf, re-signed when missing, when the active root
+        moved, or when the leaf nears expiry (an agent outliving the
+        72h leaf TTL must not serve expired certs)."""
         active = self.ca.active.id
+        now = time.time()
         with self._leaf_lock:
             hit = self._leaves.get(service)
-            if hit is not None and hit[0] == active:
+            if hit is not None and hit[0] == active and now < hit[2]:
                 return hit[1]
             leaf = self.ca.sign_leaf(service)
-            self._leaves[service] = (active, leaf)
+            ttl_s = self.ca.leaf_ttl_hours * 3600.0
+            refresh_at = now + ttl_s * _LEAF_REFRESH_FRACTION
+            self._leaves[service] = (active, leaf, refresh_at)
             return leaf
 
     def watch(self, proxy_id: str) -> Optional[ProxyState]:
@@ -176,18 +192,19 @@ class Manager:
             if st is not None and st.svc.get("modify_index") == \
                     svc.get("modify_index"):
                 return st
+            start_version = st._version if st is not None else 0
             if st is not None:
                 st.stop()
-            st = ProxyState(self, proxy_id, svc)
+            st = ProxyState(self, proxy_id, svc,
+                            start_version=start_version)
             st.start()
             self._states[proxy_id] = st
             return st
 
     def _find_proxy(self, proxy_id: str) -> Optional[dict]:
-        for n in self.store.nodes():
-            for s in self.store.node_services(n["node"]):
-                if s["id"] == proxy_id and s.get("kind") == "connect-proxy":
-                    return s
+        s = self.store.service_by_id(proxy_id)
+        if s is not None and s.get("kind") == "connect-proxy":
+            return s
         return None
 
     def close(self) -> None:

@@ -13,9 +13,18 @@ mirror the reference.
 
 from __future__ import annotations
 
+import re
 from typing import Dict, List
 
 from consul_tpu.connect import intentions as imod
+
+
+def _principal_regex(source: str) -> str:
+    """SPIFFE principal matcher for an intention source: literal parts
+    regex-escaped, only the intention wildcard maps to `.*` — a dotted
+    service name must not match arbitrary characters."""
+    escaped = ".*".join(re.escape(p) for p in source.split("*"))
+    return (r"spiffe://[^/]+/ns/[^/]+/dc/[^/]+/svc/" + escaped)
 
 
 def clusters(snap) -> List[dict]:
@@ -38,8 +47,9 @@ def clusters(snap) -> List[dict]:
                 "name": "tls",
                 "sni": f"{name}.default.{_trust_domain(snap)}",
                 "common_tls_context": {
-                    "tls_certificates": [{"certificate_chain":
-                                          snap.leaf["CertPEM"]}],
+                    "tls_certificates": [{
+                        "certificate_chain": snap.leaf["CertPEM"],
+                        "private_key": snap.leaf["PrivateKeyPEM"]}],
                     "validation_context": {
                         "trusted_ca": "".join(
                             r["RootCert"] for r in snap.roots)},
@@ -73,9 +83,7 @@ def listeners(snap) -> List[dict]:
     rules = []
     for it in snap.intentions:
         principal = {"authenticated": {"principal_name": {
-            "safe_regex": {"regex":
-                           f"spiffe://[^/]+/ns/[^/]+/dc/[^/]+/svc/"
-                           f"{it['source'].replace('*', '.*')}"}}}}
+            "safe_regex": {"regex": _principal_regex(it["source"])}}}}
         rules.append({"action": it["action"].upper(),
                       "precedence": it["precedence"],
                       "principals": [principal]})
@@ -88,8 +96,9 @@ def listeners(snap) -> List[dict]:
                 "name": "tls",
                 "require_client_certificate": True,
                 "common_tls_context": {
-                    "tls_certificates": [{"certificate_chain":
-                                          snap.leaf["CertPEM"]}],
+                    "tls_certificates": [{
+                        "certificate_chain": snap.leaf["CertPEM"],
+                        "private_key": snap.leaf["PrivateKeyPEM"]}],
                     "validation_context": {
                         "trusted_ca": "".join(
                             r["RootCert"] for r in snap.roots)},

@@ -1,0 +1,118 @@
+// Ordered key → index map with prefix-range queries — the native engine
+// behind the state store's watch bookkeeping.
+//
+// Role parity: the reference's state store rides go-memdb's immutable
+// radix tree (go.mod:40), whose prefix-ordered iteration powers KV
+// list/keys scans and per-prefix watch indexes.  This framework's
+// Python store needed an O(keys-in-topic) scan per prefix watch lookup
+// (flagged in review); this C++ index answers prefix-max/count/list in
+// O(log n + m) over a sorted container.
+//
+// C ABI for ctypes (no pybind11 in the image — build brief).  Handles
+// are opaque; all strings are NUL-terminated UTF-8.  Thread safety is
+// the caller's job (the store already serializes under its lock).
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct PrefixIndex {
+    std::map<std::string, int64_t> entries;
+};
+
+// end-of-range key for a prefix: smallest string > every key with the
+// prefix (increment last byte; all-0xff prefixes fall back to end())
+std::map<std::string, int64_t>::const_iterator prefix_end(
+    const PrefixIndex* idx, const std::string& prefix) {
+    std::string hi = prefix;
+    while (!hi.empty()) {
+        auto& back = reinterpret_cast<unsigned char&>(hi.back());
+        if (back != 0xff) {
+            ++back;
+            return idx->entries.lower_bound(hi);
+        }
+        hi.pop_back();
+    }
+    return idx->entries.end();
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pfx_new() { return new PrefixIndex(); }
+
+void pfx_free(void* h) { delete static_cast<PrefixIndex*>(h); }
+
+void pfx_set(void* h, const char* key, int64_t value) {
+    static_cast<PrefixIndex*>(h)->entries[key] = value;
+}
+
+// returns 1 if the key existed
+int pfx_del(void* h, const char* key) {
+    return static_cast<PrefixIndex*>(h)->entries.erase(key) ? 1 : 0;
+}
+
+// returns value or `missing` when absent
+int64_t pfx_get(void* h, const char* key, int64_t missing) {
+    auto* idx = static_cast<PrefixIndex*>(h);
+    auto it = idx->entries.find(key);
+    return it == idx->entries.end() ? missing : it->second;
+}
+
+int64_t pfx_len(void* h) {
+    return static_cast<int64_t>(
+        static_cast<PrefixIndex*>(h)->entries.size());
+}
+
+// max value over keys with `prefix` ("" = all), or `missing` when none —
+// the per-prefix watch index (memdb WatchSet analogue)
+int64_t pfx_prefix_max(void* h, const char* prefix, int64_t missing) {
+    auto* idx = static_cast<PrefixIndex*>(h);
+    std::string p(prefix);
+    auto it = idx->entries.lower_bound(p);
+    auto end = p.empty() ? idx->entries.end() : prefix_end(idx, p);
+    int64_t best = missing;
+    bool any = false;
+    for (; it != end; ++it) {
+        if (!any || it->second > best) best = it->second;
+        any = true;
+    }
+    return any ? best : missing;
+}
+
+int64_t pfx_prefix_count(void* h, const char* prefix) {
+    auto* idx = static_cast<PrefixIndex*>(h);
+    std::string p(prefix);
+    auto it = idx->entries.lower_bound(p);
+    auto end = p.empty() ? idx->entries.end() : prefix_end(idx, p);
+    int64_t n = 0;
+    for (; it != end; ++it) ++n;
+    return n;
+}
+
+// write up to `cap` keys with `prefix` (sorted) into `out` as a single
+// NUL-joined buffer of size `out_cap`; returns the number written, or
+// -1 when the buffer is too small (caller grows and retries)
+int64_t pfx_prefix_keys(void* h, const char* prefix, char* out,
+                        int64_t out_cap, int64_t cap) {
+    auto* idx = static_cast<PrefixIndex*>(h);
+    std::string p(prefix);
+    auto it = idx->entries.lower_bound(p);
+    auto end = p.empty() ? idx->entries.end() : prefix_end(idx, p);
+    int64_t written = 0, used = 0;
+    for (; it != end && written < cap; ++it) {
+        int64_t need = static_cast<int64_t>(it->first.size()) + 1;
+        if (used + need > out_cap) return -1;
+        std::memcpy(out + used, it->first.c_str(), need);
+        used += need;
+        ++written;
+    }
+    return written;
+}
+
+}  // extern "C"

@@ -1,0 +1,92 @@
+"""Native C++ prefix index (the go-memdb radix-tree role).
+
+Build brief: runtime components are native where the reference's are.
+native/prefix_index.cpp compiles on first use (g++ baked into the
+image); the Python fallback keeps identical semantics.
+"""
+
+import pytest
+
+from consul_tpu.native_index import (
+    PrefixIndex, _PyPrefixIndex, native_available,
+)
+
+
+@pytest.fixture(params=["native", "python"])
+def index(request):
+    if request.param == "native":
+        if not native_available():
+            pytest.skip("no C++ toolchain")
+        return PrefixIndex()
+    return _PyPrefixIndex()
+
+
+def test_set_get_delete(index):
+    index.set("a/b", 5)
+    index.set("a/c", 9)
+    assert index.get("a/b") == 5
+    assert index.get("missing", -1) == -1
+    assert len(index) == 2
+    assert index.delete("a/b")
+    assert not index.delete("a/b")
+    assert len(index) == 1
+
+
+def test_prefix_max_and_count(index):
+    index.set("app/x", 3)
+    index.set("app/y", 7)
+    index.set("apz", 100)
+    index.set("other", 50)
+    assert index.prefix_max("app/") == 7
+    assert index.prefix_max("nope/", -1) == -1
+    assert index.prefix_max("") == 100
+    assert index.prefix_count("app/") == 2
+    assert index.prefix_count("") == 4
+
+
+def test_prefix_keys_sorted(index):
+    for k in ["b/2", "a/1", "b/1", "c"]:
+        index.set(k, 1)
+    assert index.prefix_keys("b/") == ["b/1", "b/2"]
+    assert index.prefix_keys("") == ["a/1", "b/1", "b/2", "c"]
+    assert index.prefix_keys("b/", limit=1) == ["b/1"]
+
+
+def test_prefix_boundary_no_bleed(index):
+    # "app" range must not include "apq" or "aq"
+    index.set("app", 1)
+    index.set("appz", 2)
+    index.set("apq", 3)
+    index.set("aq", 4)
+    assert index.prefix_max("app") == 2
+    assert index.prefix_count("app") == 2
+
+
+def test_0xff_prefix_edge(index):
+    hi = "\xff\xff"
+    index.set(hi + "a", 9)
+    index.set("zz", 1)
+    assert index.prefix_max(hi) == 9
+
+
+def test_large_key_set(index):
+    for i in range(5000):
+        index.set(f"k/{i:05d}", i)
+    assert index.prefix_count("k/") == 5000
+    assert index.prefix_max("k/0499") == 4999  # k/04990..k/04999
+    assert len(index.prefix_keys("k/000")) == 100
+
+
+def test_native_actually_builds():
+    assert native_available(), "g++ present in this image; must build"
+
+
+def test_store_uses_index_for_prefix_watches():
+    from consul_tpu.catalog.store import StateStore
+    st = StateStore()
+    st.kv_set("app/a", b"1")
+    st.kv_set("app/b", b"2")
+    st.kv_set("zzz", b"3")
+    assert st.watch_index([("kv:prefix", "app/")]) == 2
+    assert st.watch_index([("kv", "zzz")]) == 3
+    assert st.watch_index([("kv:prefix", "nope/")]) == 0
