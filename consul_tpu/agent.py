@@ -40,7 +40,8 @@ class Agent:
                 dc=rc.datacenter, acl_enabled=rc.acl_enabled,
                 acl_default_policy=rc.acl_default_policy,
                 acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port,
-                data_dir=rc.data_dir or None)
+                data_dir=rc.data_dir or None,
+                enable_remote_exec=rc.enable_remote_exec)
         a.runtime_config = rc
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
@@ -112,7 +113,8 @@ class Agent:
                  dc: str = "dc1", acl_enabled: bool = False,
                  acl_default_policy: str = "allow",
                  acl_down_policy: str = "extend-cache",
-                 dns_port: int = 0, data_dir: Optional[str] = None):
+                 dns_port: int = 0, data_dir: Optional[str] = None,
+                 enable_remote_exec: bool = False):
         self.data_dir = data_dir
         from consul_tpu.acl import ACLResolver
         from consul_tpu.ae import StateSyncer
@@ -160,6 +162,10 @@ class Agent:
                              port=dns_port,
                              authz=lambda: self.acl.resolve(None),
                              query_executor=_dns_query_exec)
+        from consul_tpu.remote_exec import RemoteExecutor
+        self.remote_exec = RemoteExecutor(self.store, self.oracle,
+                                          node_name,
+                                          enabled=enable_remote_exec)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 
@@ -254,6 +260,7 @@ class Agent:
         self.store.register_check(self.node_name, "serfHealth",
                                   "Serf Health Status", status="passing")
         self.syncer.start()
+        self.remote_exec.start()
         self.oracle.start(tick_seconds)
         self.api.start()
         self.dns.start()
@@ -274,6 +281,7 @@ class Agent:
 
     def stop(self) -> None:
         self._running = False
+        self.remote_exec.stop()
         self.checks.stop_all()
         self.syncer.stop()
         self.oracle.stop()

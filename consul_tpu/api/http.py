@@ -1040,6 +1040,31 @@ def _make_handler(srv: ApiServer):
                     or path.startswith("/v1/agent/connect/") \
                     or path.startswith("/v1/agent/xds/"):
                 return self._connect(verb, path, q)
+            if path == "/v1/exec" and verb == "PUT":
+                # initiator side of consul exec (remote_exec.go protocol
+                # over KV + events); agent:write like agent mutations
+                if not self.authz.agent_write(srv.node_name):
+                    return self._forbid()
+                from consul_tpu import remote_exec as rexec
+                body = json.loads(self._body() or b"{}")
+                session = rexec.fire_exec(
+                    store, oracle, body.get("Command", ""),
+                    origin=srv.node_name,
+                    wait=float(body.get("Wait", 30.0)))
+                self._send({"Session": session})
+                return True
+            m = re.fullmatch(r"/v1/exec/([^/]+)", path)
+            if m and verb == "GET":
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
+                from consul_tpu import remote_exec as rexec
+                res = rexec.collect_results(store, m.group(1))
+                self._send({node: {
+                    "Acked": r["acked"],
+                    "Output": base64.b64encode(r["output"]).decode(),
+                    "ExitCode": r["exit_code"]}
+                    for node, r in res.items()})
+                return True
             if path == "/v1/txn" and verb == "PUT":
                 return self._txn()
             if path == "/v1/snapshot" and verb == "GET":

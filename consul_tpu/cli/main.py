@@ -21,13 +21,18 @@ from consul_tpu.api.client import ApiError, Client
 from consul_tpu.version import VERSION
 
 
-def _client(args) -> Client:
+def _addr_token(args):
     addr = args.http_addr or os.environ.get("CONSUL_HTTP_ADDR",
                                             "http://127.0.0.1:8500")
     if not addr.startswith("http"):
         addr = "http://" + addr
     token = getattr(args, "token", None) or \
         os.environ.get("CONSUL_HTTP_TOKEN")
+    return addr, token
+
+
+def _client(args) -> Client:
+    addr, token = _addr_token(args)
     return Client(addr, token=token)
 
 
@@ -267,6 +272,157 @@ def cmd_leave(args) -> int:
     return 0
 
 
+def cmd_exec(args) -> int:
+    """consul exec (command/exec): run a command cluster-wide via KV +
+    events; waits a quiet period after the last response so slower
+    nodes aren't dropped, then cleans the session's KV prefix."""
+    c = _client(args)
+    body = json.dumps({"Command": args.command,
+                       "Wait": args.wait}).encode()
+    out = c._call("PUT", "/v1/exec", None, body)[0]
+    session = out["Session"]
+    deadline = time.time() + args.wait + 5
+    quiet_s = 1.0
+    done = {}
+    last_new = time.time()
+    try:
+        while time.time() < deadline:
+            res = c._call("GET", f"/v1/exec/{session}")[0]
+            for node, rec in res.items():
+                if rec.get("ExitCode") is not None and node not in done:
+                    done[node] = rec
+                    last_new = time.time()
+                    print(f"{node}: exit={rec['ExitCode']}")
+                    if rec.get("Output"):
+                        print("    " + base64.b64decode(
+                            rec["Output"]).decode(
+                            errors="replace").strip())
+            if done and time.time() - last_new > quiet_s:
+                break
+            time.sleep(0.3)
+    finally:
+        # initiator removes the session prefix (the reference cleans
+        # _rexec after the wait window) — exec must not grow KV forever
+        try:
+            c._call("DELETE", f"/v1/kv/_rexec/{session}/",
+                    {"recurse": ""})
+        except Exception:
+            pass
+    if not done:
+        print("no responses (is enable_remote_exec set?)",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_monitor(args) -> int:
+    """consul monitor (command/monitor): stream agent logs."""
+    import urllib.request
+    addr, token = _addr_token(args)
+    url = (f"{addr}/v1/agent/monitor"
+           f"?loglevel={args.log_level}&wait={args.wait}")
+    req = urllib.request.Request(url)
+    if token:
+        req.add_header("X-Consul-Token", token)
+    with urllib.request.urlopen(req, timeout=args.wait + 30) as resp:
+        while True:
+            chunk = resp.read(4096)
+            if not chunk:
+                break
+            sys.stdout.write(chunk.decode(errors="replace"))
+            sys.stdout.flush()
+    return 0
+
+
+def cmd_debug(args) -> int:
+    """consul debug (command/debug): capture a diagnostic archive FROM
+    THE AGENT over its HTTP API (metrics/self/members per interval +
+    host info from this process; the reference pulls from the agent's
+    debug endpoints too)."""
+    import io as _io
+    import tarfile
+    from consul_tpu.debug import host_info, thread_dump
+
+    c = _client(args)
+    buf = _io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, _io.BytesIO(data))
+
+        add("host.json", json.dumps(host_info(), indent=2).encode())
+        add("cli_threads.txt", thread_dump().encode())
+        try:
+            add("agent.json", json.dumps(
+                c._call("GET", "/v1/agent/self")[0], indent=2).encode())
+            add("members.json", json.dumps(
+                c._call("GET", "/v1/agent/members",
+                        {"limit": 1000})[0], indent=2).encode())
+            for i in range(args.intervals):
+                add(f"{i}/metrics.json", json.dumps(
+                    c._call("GET", "/v1/agent/metrics")[0],
+                    indent=2).encode())
+                if i < args.intervals - 1:
+                    time.sleep(args.interval)
+        except Exception as e:
+            add("capture_error.txt",
+                f"agent capture failed: {e}".encode())
+    blob = buf.getvalue()
+    with open(args.output, "wb") as f:
+        f.write(blob)
+    print(f"Saved debug archive: {args.output} ({len(blob)} bytes)")
+    return 0
+
+
+def cmd_operator(args) -> int:
+    """consul operator raft list-peers / autopilot state
+    (command/operator)."""
+    c = _client(args)
+    if args.operator_cmd == "raft":
+        cfg = c._call("GET", "/v1/operator/raft/configuration")[0]
+        print(f"{'Node':<12} {'ID':<12} {'Leader':<7} Voter")
+        for s in cfg["Servers"]:
+            print(f"{s['Node']:<12} {s['ID']:<12} "
+                  f"{str(s['Leader']).lower():<7} "
+                  f"{str(s['Voter']).lower()}")
+        return 0
+    if args.operator_cmd == "autopilot":
+        h = c._call("GET", "/v1/operator/autopilot/health")[0]
+        print(f"Healthy: {h['Healthy']}")
+        print(f"FailureTolerance: {h['FailureTolerance']}")
+        for s in h["Servers"]:
+            print(f"  {s['ID']}: healthy={s['Healthy']} "
+                  f"leader={s['Leader']} last_contact={s['LastContact']}")
+        return 0
+    return 2
+
+
+def cmd_reload(args) -> int:
+    """consul reload (command/reload): trigger a config reload."""
+    out = _client(args)._call("PUT", "/v1/agent/reload")[0]
+    print("Configuration reload triggered")
+    if out.get("reloaded"):
+        print("  reloaded: " + ", ".join(out["reloaded"]))
+    if out.get("restart_required"):
+        print("  restart required for: "
+              + ", ".join(out["restart_required"]))
+    return 0
+
+
+def cmd_validate(args) -> int:
+    """consul validate (command/validate): check config files parse."""
+    from consul_tpu import runtime_config as rcfg
+    try:
+        rcfg.load(files=[args.file])
+    except rcfg.ConfigError as e:
+        print(f"Config validation failed: {e}", file=sys.stderr)
+        return 1
+    print("Configuration is valid!")
+    return 0
+
+
 def cmd_agent(args) -> int:
     """Run an agent (command/agent) — oracle + store + HTTP API."""
     from consul_tpu.agent import Agent
@@ -493,6 +649,34 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("node")
     sp.set_defaults(fn=cmd_force_leave)
     sub.add_parser("leave").set_defaults(fn=cmd_leave)
+
+    sp = sub.add_parser("exec")
+    sp.add_argument("command")
+    sp.add_argument("-wait", type=float, default=10.0)
+    sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("monitor")
+    sp.add_argument("-log-level", default="INFO")
+    sp.add_argument("-wait", type=int, default=30)
+    sp.set_defaults(fn=cmd_monitor)
+
+    sp = sub.add_parser("debug")
+    sp.add_argument("-output", default="consul-debug.tar.gz")
+    sp.add_argument("-intervals", type=int, default=2)
+    sp.add_argument("-interval", type=float, default=0.5)
+    sp.set_defaults(fn=cmd_debug)
+
+    sp = sub.add_parser("operator")
+    osub = sp.add_subparsers(dest="operator_cmd", required=True)
+    osub.add_parser("raft")
+    osub.add_parser("autopilot")
+    sp.set_defaults(fn=cmd_operator)
+
+    sub.add_parser("reload").set_defaults(fn=cmd_reload)
+
+    sp = sub.add_parser("validate")
+    sp.add_argument("file")
+    sp.set_defaults(fn=cmd_validate)
 
     sp = sub.add_parser("agent")
     # None = not given, so explicit flags are distinguishable from

@@ -1,0 +1,121 @@
+"""Debug capture: one archive of everything an operator needs.
+
+The reference's `consul debug` (command/debug/debug.go:288-496) captures
+pprof profiles, metrics, logs, and host info into a tar archive over a
+sampling window.  Python has no pprof; the equivalents here are thread
+stack dumps (the goroutine-dump analogue), the telemetry registry,
+recent log lines, agent self/members, and host info — tarred with the
+same capture-window layout.
+
+Also home to the thread-leak checker (goleak analogue — the reference's
+agent/routine-leak-checker/leak_test.go asserts a full agent shutdown
+leaves no goroutines), used by tests and `consul-tpu debug`.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tarfile
+import threading
+import time
+import traceback
+from typing import Dict, List, Optional
+
+
+def thread_dump() -> str:
+    """All live thread stacks (the goroutine profile analogue)."""
+    out = []
+    frames = sys._current_frames()
+    for t in threading.enumerate():
+        out.append(f"--- {t.name} (daemon={t.daemon}, "
+                   f"alive={t.is_alive()}) ---")
+        frame = frames.get(t.ident)
+        if frame is not None:
+            out.extend(line.rstrip() for line in
+                       traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+def host_info() -> dict:
+    """Host facts (agent/debug/host.go's gopsutil capture, stdlib-only)."""
+    info = {"platform": sys.platform, "python": sys.version,
+            "pid": os.getpid(), "cpu_count": os.cpu_count()}
+    try:
+        la = os.getloadavg()
+        info["loadavg"] = {"1m": la[0], "5m": la[1], "15m": la[2]}
+    except (OSError, AttributeError):
+        pass
+    try:
+        import resource
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        info["max_rss_kb"] = ru.ru_maxrss
+    except ImportError:
+        pass
+    return info
+
+
+def capture(agent=None, intervals: int = 2,
+            interval_s: float = 0.5) -> bytes:
+    """Sampled debug archive (debug.go capture loop): per-interval
+    metrics + thread dumps, plus one-shot host/agent/log captures."""
+    from consul_tpu import telemetry
+    from consul_tpu.logging import default_buffer
+
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        def add(name: str, data: bytes):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        add("host.json", json.dumps(host_info(), indent=2).encode())
+        add("logs.txt", "\n".join(default_buffer().recent()).encode())
+        if agent is not None:
+            add("agent.json", json.dumps({
+                "node_name": agent.node_name,
+                "members_summary": agent.oracle.members_summary(),
+                "catalog_index": agent.store.index,
+            }, indent=2).encode())
+        for i in range(intervals):
+            add(f"{i}/metrics.json", json.dumps(
+                telemetry.default_registry().dump(), indent=2).encode())
+            add(f"{i}/threads.txt", thread_dump().encode())
+            if i < intervals - 1:
+                time.sleep(interval_s)
+    return buf.getvalue()
+
+
+class ThreadLeakChecker:
+    """goleak analogue: snapshot live threads, later assert no leaks.
+
+    Usage (tests):
+        chk = ThreadLeakChecker()
+        agent = Agent(...); agent.start(); agent.stop()
+        chk.assert_no_leaks()
+    """
+
+    def __init__(self):
+        self._before = {t.ident for t in threading.enumerate()}
+
+    def leaked(self, grace_s: float = 3.0) -> List[threading.Thread]:
+        """Threads alive now that weren't at construction, after letting
+        shutdowns drain for up to `grace_s`."""
+        deadline = time.time() + grace_s
+        while time.time() < deadline:
+            extra = [t for t in threading.enumerate()
+                     if t.ident not in self._before and t.is_alive()]
+            if not extra:
+                return []
+            time.sleep(0.1)
+        return [t for t in threading.enumerate()
+                if t.ident not in self._before and t.is_alive()]
+
+    def assert_no_leaks(self, grace_s: float = 3.0) -> None:
+        extra = self.leaked(grace_s)
+        if extra:
+            names = ", ".join(t.name for t in extra)
+            raise AssertionError(f"leaked threads: {names}")

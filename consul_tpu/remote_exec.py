@@ -1,0 +1,118 @@
+"""Remote exec: cluster-wide command execution over KV + events.
+
+The reference's `consul exec` (agent/remote_exec.go:121 handleRemoteExec;
+disabled by default, agent/config/default.go:46) coordinates through the
+KV store and a user event: the initiator writes a job spec under
+`_rexec/<session>/job`, fires a `consul:exec` event, and each agent that
+sees the event reads the spec, runs the command, and writes its output
+and exit code back under `_rexec/<session>/<node>/`.  Same protocol
+here, over this framework's KV + user-event layers.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import subprocess
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+EXEC_EVENT = "_rexec"
+PREFIX = "_rexec"
+
+
+class RemoteExecutor:
+    """Agent-side handler: watches for exec events and runs jobs
+    (handleRemoteExec).  Disabled by default like the reference."""
+
+    def __init__(self, store, oracle, node_name: str,
+                 enabled: bool = False, timeout: float = 30.0):
+        self.store = store
+        self.oracle = oracle
+        self.node_name = node_name
+        self.enabled = enabled
+        self.timeout = timeout
+        self._seen: set = set()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        if not self.enabled:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(0.2):
+            for ev in self.oracle.event_list():
+                if ev["name"] != EXEC_EVENT or ev["id"] in self._seen:
+                    continue
+                self._seen.add(ev["id"])
+                try:
+                    spec = json.loads(ev["payload"].decode())
+                    self._run_job(spec.get("Session", ""))
+                except Exception:
+                    # one malformed job (bad JSON spec, non-numeric
+                    # Wait, ...) must not kill the executor thread for
+                    # every future exec
+                    continue
+
+    def _run_job(self, session: str) -> None:
+        job = self.store.kv_get(f"{PREFIX}/{session}/job")
+        if job is None:
+            return
+        spec = json.loads(job["value"].decode())
+        cmd = spec.get("Command", "")
+        # ack before running (remote_exec.go writeAck)
+        self.store.kv_set(f"{PREFIX}/{session}/{self.node_name}/ack", b"")
+        try:
+            proc = subprocess.run(["/bin/sh", "-c", cmd],
+                                  capture_output=True,
+                                  timeout=spec.get("Wait", self.timeout))
+            out = proc.stdout + proc.stderr
+            code = proc.returncode
+        except subprocess.TimeoutExpired:
+            out, code = b"command timed out", -1
+        self.store.kv_set(f"{PREFIX}/{session}/{self.node_name}/out",
+                          out[:64 * 1024])
+        self.store.kv_set(f"{PREFIX}/{session}/{self.node_name}/exit",
+                          str(code).encode())
+
+
+def fire_exec(store, oracle, command: str, origin: str,
+              wait: float = 30.0) -> str:
+    """Initiator side (`consul exec`): write the job, fire the event;
+    returns the session id to poll results under."""
+    session = str(uuid.uuid4())
+    spec = json.dumps({"Command": command, "Wait": wait}).encode()
+    store.kv_set(f"{PREFIX}/{session}/job", spec)
+    oracle.fire_event(EXEC_EVENT,
+                      json.dumps({"Session": session}).encode(),
+                      origin=origin)
+    return session
+
+
+def collect_results(store, session: str) -> Dict[str, dict]:
+    """node -> {"acked", "output", "exit_code"} for a session."""
+    rows = store.kv_list(f"{PREFIX}/{session}/")
+    out: Dict[str, dict] = {}
+    for row in rows:
+        parts = row["key"].split("/")
+        if len(parts) != 4:
+            continue
+        _, _, node, kind = parts
+        rec = out.setdefault(node, {"acked": False, "output": b"",
+                                    "exit_code": None})
+        if kind == "ack":
+            rec["acked"] = True
+        elif kind == "out":
+            rec["output"] = row["value"]
+        elif kind == "exit":
+            rec["exit_code"] = int(row["value"] or b"-1")
+    return out

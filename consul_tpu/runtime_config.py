@@ -178,6 +178,7 @@ class RuntimeConfig:
     server: bool = True
     data_dir: str = ""
     log_level: str = "INFO"
+    enable_remote_exec: bool = False
     http_port: int = 0
     dns_port: int = 0
     # acl block (agent/config: acl{enabled, default_policy, down_policy,
@@ -331,6 +332,7 @@ class Builder:
             datacenter=m.get("datacenter", "dc1"),
             server=bool(m.get("server", True)),
             data_dir=str(m.get("data_dir", "") or ""),
+            enable_remote_exec=bool(m.get("enable_remote_exec", False)),
             log_level=str(m.get("log_level", "INFO")).upper(),
             http_port=int(ports.get("http", 0) or 0),
             dns_port=int(ports.get("dns", 0) or 0),
