@@ -44,6 +44,10 @@ class BuiltinCA:
         self.dc = dc
         self.leaf_ttl_hours = leaf_ttl_hours
         self.id = f"root-{serial}"
+        if (key_pem is None) != (cert_pem is None):
+            # a cert without its key (or vice versa) silently regenerating
+            # a surprise CA is the worst failure mode — refuse loudly
+            raise ValueError("CA cert and key must be supplied together")
         if key_pem is None:
             self._key = ec.generate_private_key(ec.SECP256R1())
             subject = x509.Name([
@@ -93,24 +97,24 @@ class BuiltinCA:
         return (f"spiffe://{self.trust_domain}/ns/default/dc/{self.dc}"
                 f"/svc/{service}")
 
-    def sign_leaf(self, service: str) -> Tuple[str, str]:
-        """(cert_pem, key_pem) for a service leaf with a SPIFFE URI SAN
-        (provider.go Sign; leaf shape connect/)."""
+    def sign(self, common_name: str, sans: list,
+             ttl: datetime.timedelta) -> Tuple[str, str]:
+        """Generic end-entity signing: ONE X.509 builder for every
+        caller (service leaves, agent/server TLS certs) so extensions
+        and key handling cannot drift between them."""
         key = ec.generate_private_key(ec.SECP256R1())
         now = _utcnow()
         cert = (
             x509.CertificateBuilder()
             .subject_name(x509.Name([
-                x509.NameAttribute(NameOID.COMMON_NAME, service)]))
+                x509.NameAttribute(NameOID.COMMON_NAME, common_name)]))
             .issuer_name(self._cert.subject)
             .public_key(key.public_key())
             .serial_number(x509.random_serial_number())
             .not_valid_before(now - _BACKDATE)
-            .not_valid_after(now + datetime.timedelta(
-                hours=self.leaf_ttl_hours))
-            .add_extension(x509.SubjectAlternativeName([
-                x509.UniformResourceIdentifier(self.spiffe_id(service))]),
-                critical=False)
+            .not_valid_after(now + ttl)
+            .add_extension(x509.SubjectAlternativeName(sans),
+                           critical=False)
             .add_extension(x509.BasicConstraints(ca=False,
                                                  path_length=None),
                            critical=True)
@@ -126,6 +130,14 @@ class BuiltinCA:
                     serialization.Encoding.PEM,
                     serialization.PrivateFormat.PKCS8,
                     serialization.NoEncryption()).decode())
+
+    def sign_leaf(self, service: str) -> Tuple[str, str]:
+        """(cert_pem, key_pem) for a service leaf with a SPIFFE URI SAN
+        (provider.go Sign; leaf shape connect/)."""
+        return self.sign(
+            service,
+            [x509.UniformResourceIdentifier(self.spiffe_id(service))],
+            datetime.timedelta(hours=self.leaf_ttl_hours))
 
     def verify_leaf(self, cert_pem: str) -> bool:
         """Does this leaf chain to our root (signature + validity)?"""

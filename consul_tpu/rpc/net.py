@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import socket
 import socketserver
+import ssl
 import struct
 import threading
 from typing import Callable, Dict, Optional, Tuple
@@ -65,12 +66,22 @@ class RpcListener:
 
     def __init__(self, deliver_fn: Callable[[dict], None],
                  handler: Callable[[str, dict], dict],
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 ssl_context: Optional[ssl.SSLContext] = None):
         outer = self
+        self.ssl_context = ssl_context
 
         class _Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
+                if outer.ssl_context is not None:
+                    # TLS upgrade per connection (tlsutil incoming);
+                    # handshake failures end this connection only
+                    try:
+                        sock = outer.ssl_context.wrap_socket(
+                            sock, server_side=True)
+                    except (ssl.SSLError, OSError):
+                        return
                 try:
                     while True:
                         frame = recv_frame(sock)
@@ -115,13 +126,18 @@ class RpcListener:
 
 class _ConnPool:
     """One pooled connection per address, mutex-serialized requests
-    (a miniature agent/pool/pool.go ConnPool)."""
+    (a miniature agent/pool/pool.go ConnPool), with optional TLS
+    upgrade on connect (pool.go TLS wrap)."""
 
-    def __init__(self, timeout: float = 5.0):
+    def __init__(self, timeout: float = 5.0,
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None):
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._locks: Dict[Tuple[str, int], threading.Lock] = {}
         self._lock = threading.Lock()
         self.timeout = timeout
+        self.ssl_context = ssl_context
+        self.server_hostname = server_hostname
 
     def _get_lock(self, addr) -> threading.Lock:
         with self._lock:
@@ -135,6 +151,9 @@ class _ConnPool:
             return sock
         sock = socket.create_connection(addr, timeout=self.timeout)
         sock.settimeout(self.timeout)
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_hostname=self.server_hostname or addr[0])
         self._conns[addr] = sock
         return sock
 
@@ -200,8 +219,10 @@ class _ConnPool:
 class RpcClient:
     """Request/response calls to a peer's RpcListener."""
 
-    def __init__(self, timeout: float = 5.0):
-        self._pool = _ConnPool(timeout)
+    def __init__(self, timeout: float = 5.0,
+                 ssl_context: Optional[ssl.SSLContext] = None,
+                 server_hostname: Optional[str] = None):
+        self._pool = _ConnPool(timeout, ssl_context, server_hostname)
         self._next_id = 0
         self._id_lock = threading.Lock()
 
@@ -234,6 +255,14 @@ class TcpTransport(Transport):
         self.addresses: Dict[str, Tuple[str, int]] = (
             addresses if addresses is not None else {})
         self._pool = _ConnPool(timeout)
+
+    def set_tls(self, ssl_context: ssl.SSLContext,
+                server_hostname: Optional[str] = None) -> None:
+        """Upgrade outgoing raft connections to TLS (RaftLayer over the
+        TLS'd server port).  Existing plaintext conns are dropped."""
+        self._pool.close()
+        self._pool.ssl_context = ssl_context
+        self._pool.server_hostname = server_hostname
 
     def send(self, target: str, msg: dict) -> None:
         addr = self.addresses.get(target)

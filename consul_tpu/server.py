@@ -66,20 +66,54 @@ class Server:
         self._ttl_reap_inflight: set = set()
         self._listener = None
         self._rpc_client = None
+        self.tls = None
         from consul_tpu.autopilot import Autopilot
         self.autopilot = Autopilot(self)
 
     # --------------------------------------------------------------- rpc net
 
-    def serve_rpc(self, host: str = "127.0.0.1", port: int = 0):
+    def serve_rpc(self, host: str = "127.0.0.1", port: int = 0,
+                  tls=None):
         """Bind the socket RPC listener (raft frames + forwarded applies)
         and advertise our address in the transport's address book.
-        Returns (host, port)."""
+        Returns (host, port).
+
+        `tls` is a tlsutil.Configurator: the listener upgrades incoming
+        connections (requiring client certs under verify_incoming), the
+        transport + forwarding client present this server's cert, and
+        auto_encrypt_sign RPCs mint agent certs off the same CA."""
         from consul_tpu.rpc import RpcClient, RpcListener
+        self.tls = tls
+        ssl_in = ssl_out = sni = None
+        if tls is not None:
+            cert, key = tls.sign_cert(self.node_id, server=True)
+            ssl_in = tls.incoming_context(cert, key)
+            ssl_out = tls.outgoing_context(cert, key)
+            sni = tls.server_sni() if tls.verify_server_hostname else None
         self._listener = RpcListener(self.raft.deliver, self._handle_rpc,
-                                     host=host, port=port)
+                                     host=host, port=port,
+                                     ssl_context=ssl_in)
         self._listener.start()
-        self._rpc_client = RpcClient()
+        self._bootstrap_listener = None
+        if tls is not None and tls.verify_incoming:
+            # the reference's insecure RPC server (server.go:240-247):
+            # ONE method, no client cert required — so a fresh agent can
+            # obtain its first cert at all
+            def _bootstrap_only(method, args):
+                if method != "auto_encrypt_sign":
+                    raise ValueError("bootstrap listener serves "
+                                     "auto_encrypt_sign only")
+                return self._handle_rpc(method, args)
+
+            boot_ctx = tls.bootstrap_context(cert, key)
+            self._bootstrap_listener = RpcListener(
+                lambda msg: None, _bootstrap_only, host=host,
+                ssl_context=boot_ctx)
+            self._bootstrap_listener.start()
+        self._rpc_client = RpcClient(ssl_context=ssl_out,
+                                     server_hostname=sni)
+        if ssl_out is not None and hasattr(self.transport, "set_tls"):
+            self.transport.set_tls(ssl_out, sni)
         if hasattr(self.transport, "addresses"):
             self.transport.addresses[self.node_id] = self._listener.addr
         return self._listener.addr
@@ -87,6 +121,9 @@ class Server:
     def close_rpc(self) -> None:
         if hasattr(self.transport, "addresses"):
             self.transport.addresses.pop(self.node_id, None)
+        if getattr(self, "_bootstrap_listener", None) is not None:
+            self._bootstrap_listener.stop()
+            self._bootstrap_listener = None
         if self._listener is not None:
             self._listener.stop()
             self._listener = None
@@ -117,6 +154,13 @@ class Server:
             return {"index": self.store.index}
         if method == "stats":
             return self.stats()
+        if method == "auto_encrypt_sign":
+            # agent bootstrap cert issuance (auto_encrypt_endpoint.go
+            # Sign): agents join TLS with a cert chained to the fleet CA
+            if self.tls is None:
+                raise ValueError("TLS not configured")
+            cert, key = self.tls.sign_cert(args.get("name", "agent"))
+            return {"cert": cert, "key": key, "ca": self.tls.ca_pem}
         raise ValueError(f"unknown rpc method {method}")
 
     def _remote_addr(self, node_id: str):

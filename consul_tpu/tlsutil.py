@@ -1,0 +1,132 @@
+"""tlsutil: the central TLS Configurator for RPC/HTTP.
+
+The reference funnels every TLS decision through one Configurator
+(tlsutil/config.go:177): incoming/outgoing contexts for RPC, HTTP, and
+gRPC, verify_incoming / verify_outgoing / verify_server_hostname knobs,
+and live CA updates for auto-TLS.  Same shape here over the stdlib `ssl`
+module, with certificates minted by the Connect CA machinery
+(connect/ca.py) when none are supplied — the auto-encrypt path
+(agent/consul/auto_encrypt_endpoint.go) signs agent certs from the same
+root so the whole fleet chains to one CA.
+
+Server identities carry the reference's DNS SAN convention
+(`server.<dc>.<domain>`) so verify_server_hostname can pin outgoing
+connections to real servers.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import ssl
+import tempfile
+import threading
+from typing import Optional, Tuple
+
+from cryptography import x509
+
+
+def _write_tmp(data: str) -> str:
+    fd, path = tempfile.mkstemp(suffix=".pem")
+    with os.fdopen(fd, "w") as f:
+        f.write(data)
+    return path
+
+
+class Configurator:
+    def __init__(self, dc: str = "dc1", domain: str = "consul",
+                 verify_incoming: bool = True,
+                 verify_outgoing: bool = True,
+                 verify_server_hostname: bool = False,
+                 ca_cert_pem: Optional[str] = None,
+                 ca_key_pem: Optional[str] = None):
+        from consul_tpu.connect.ca import BuiltinCA
+        self.dc = dc
+        self.domain = domain
+        self.verify_incoming = verify_incoming
+        self.verify_outgoing = verify_outgoing
+        self.verify_server_hostname = verify_server_hostname
+        self._lock = threading.Lock()
+        # the TLS CA: supplied or self-generated (auto-TLS)
+        self._ca = BuiltinCA(f"{dc}.{domain}", dc=dc,
+                             key_pem=ca_key_pem, cert_pem=ca_cert_pem)
+
+    # ----------------------------------------------------------------- CA
+
+    @property
+    def ca_pem(self) -> str:
+        return self._ca.cert_pem
+
+    def sign_cert(self, name: str,
+                  server: bool = False) -> Tuple[str, str]:
+        """(cert_pem, key_pem) for a node/agent; server certs carry the
+        `server.<dc>.<domain>` SAN (auto_encrypt_endpoint.go Sign).
+        Rides BuiltinCA.sign — one X.509 builder for the whole tree."""
+        sans = [x509.DNSName(name), x509.DNSName("localhost")]
+        if server:
+            sans.append(x509.DNSName(f"server.{self.dc}.{self.domain}"))
+        return self._ca.sign(name, sans, datetime.timedelta(days=365))
+
+    # ------------------------------------------------------------ contexts
+
+    def incoming_context(self, cert_pem: str,
+                         key_pem: str) -> ssl.SSLContext:
+        """Server side: presents `cert`, requires client certs when
+        verify_incoming (IncomingRPCConfig)."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        cert_f, key_f = _write_tmp(cert_pem), _write_tmp(key_pem)
+        ca_f = _write_tmp(self.ca_pem)
+        try:
+            ctx.load_cert_chain(cert_f, key_f)
+            ctx.load_verify_locations(ca_f)
+        finally:
+            for f in (cert_f, key_f, ca_f):
+                os.unlink(f)
+        ctx.verify_mode = ssl.CERT_REQUIRED if self.verify_incoming \
+            else ssl.CERT_NONE
+        return ctx
+
+    def bootstrap_context(self, cert_pem: str,
+                          key_pem: str) -> ssl.SSLContext:
+        """Server side for the INSECURE bootstrap listener: presents our
+        cert, never requires a client cert — the auto-encrypt endpoint
+        must be reachable by agents that have no cert yet (the
+        reference's insecure RPC server, server.go:240-247)."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        cert_f, key_f = _write_tmp(cert_pem), _write_tmp(key_pem)
+        try:
+            ctx.load_cert_chain(cert_f, key_f)
+        finally:
+            os.unlink(cert_f)
+            os.unlink(key_f)
+        ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def outgoing_context(self, cert_pem: Optional[str] = None,
+                         key_pem: Optional[str] = None) -> ssl.SSLContext:
+        """Client side: verifies the server against our CA; presents a
+        client cert when given (OutgoingRPCConfig).  Hostname pinning to
+        server.<dc>.<domain> when verify_server_hostname."""
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ca_f = _write_tmp(self.ca_pem)
+        try:
+            ctx.load_verify_locations(ca_f)
+        finally:
+            os.unlink(ca_f)
+        if cert_pem and key_pem:
+            cert_f, key_f = _write_tmp(cert_pem), _write_tmp(key_pem)
+            try:
+                ctx.load_cert_chain(cert_f, key_f)
+            finally:
+                os.unlink(cert_f)
+                os.unlink(key_f)
+        if self.verify_outgoing:
+            ctx.check_hostname = self.verify_server_hostname
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        else:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        return ctx
+
+    def server_sni(self) -> str:
+        return f"server.{self.dc}.{self.domain}"

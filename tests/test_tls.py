@@ -1,0 +1,140 @@
+"""TLS RPC boundary + auto-encrypt.
+
+SURVEY #33 (tlsutil Configurator), #32 (auto-encrypt cert issuance).
+Reference: tlsutil/config.go:177, agent/consul/auto_encrypt_endpoint.go.
+"""
+
+import socket
+import ssl
+import threading
+import time
+
+import pytest
+
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.rpc import RpcClient, RpcError, TcpTransport
+from consul_tpu.server import Server
+from consul_tpu.tlsutil import Configurator
+
+
+class TlsCluster:
+    def __init__(self, n=3, seed=0, verify_server_hostname=False):
+        self.tls = Configurator(
+            dc="dc1", verify_server_hostname=verify_server_hostname)
+        self.addresses = {}
+        ids = [f"server{i}" for i in range(n)]
+        self.servers = []
+        for i, nid in enumerate(ids):
+            t = TcpTransport(self.addresses)
+            s = Server(nid, ids, t, registry={},
+                       raft_config=RaftConfig(), seed=seed + i)
+            s.serve_rpc(tls=self.tls)
+            self.servers.append(s)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._running:
+            for s in self.servers:
+                s.tick(time.time())
+            time.sleep(0.01)
+
+    def wait_leader(self, max_s=15.0):
+        deadline = time.time() + max_s
+        while time.time() < deadline:
+            ls = [s for s in self.servers if s.is_leader()]
+            if len(ls) == 1:
+                return ls[0]
+            time.sleep(0.05)
+        raise RuntimeError("no leader over TLS")
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=5.0)
+        for s in self.servers:
+            s.close_rpc()
+
+
+@pytest.fixture()
+def tls_cluster():
+    c = TlsCluster(3, seed=31)
+    yield c
+    c.stop()
+
+
+def test_configurator_sign_and_verify():
+    tls = Configurator(dc="dc1")
+    cert, key = tls.sign_cert("server0", server=True)
+    assert "BEGIN CERTIFICATE" in cert and "PRIVATE KEY" in key
+    # server SAN convention for hostname pinning
+    from cryptography import x509
+    c = x509.load_pem_x509_certificate(cert.encode())
+    sans = c.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    assert "server.dc1.consul" in sans.get_values_for_type(x509.DNSName)
+
+
+def test_raft_replicates_over_tls(tls_cluster):
+    leader = tls_cluster.wait_leader()
+    follower = next(s for s in tls_cluster.servers if s is not leader)
+    ok, _ = follower.kv_set("sec", b"tls")       # forwarded over TLS
+    assert ok
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if all(s.store.kv_get("sec") for s in tls_cluster.servers):
+            break
+        time.sleep(0.05)
+    for s in tls_cluster.servers:
+        assert s.store.kv_get("sec")["value"] == b"tls"
+
+
+def test_plaintext_client_rejected(tls_cluster):
+    leader = tls_cluster.wait_leader()
+    addr = tls_cluster.addresses[leader.node_id]
+    plain = RpcClient(timeout=3.0)               # no TLS context
+    try:
+        with pytest.raises(RpcError):
+            plain.call(addr, "stats", {})
+    finally:
+        plain.close()
+
+
+def test_client_without_cert_rejected_when_verify_incoming(tls_cluster):
+    leader = tls_cluster.wait_leader()
+    addr = tls_cluster.addresses[leader.node_id]
+    # TLS but NO client certificate: verify_incoming must refuse it
+    ctx = tls_cluster.tls.outgoing_context()     # no cert/key loaded
+    anon = RpcClient(timeout=3.0, ssl_context=ctx)
+    try:
+        with pytest.raises(RpcError):
+            anon.call(addr, "stats", {})
+    finally:
+        anon.close()
+
+
+def test_auto_encrypt_issues_usable_cert(tls_cluster):
+    leader = tls_cluster.wait_leader()
+    addr = tls_cluster.addresses[leader.node_id]
+    # bootstrap: a CERTLESS agent hits the insecure bootstrap listener
+    # (it only has the CA) and gets its first cert — no chicken-and-egg
+    boot_addr = leader._bootstrap_listener.addr
+    boot = RpcClient(
+        ssl_context=tls_cluster.tls.outgoing_context())  # no client cert
+    try:
+        out = boot.call(boot_addr, "auto_encrypt_sign",
+                        {"name": "agent9"})
+        # and the bootstrap listener serves NOTHING else
+        with pytest.raises(RpcError):
+            boot.call(boot_addr, "stats", {})
+    finally:
+        boot.close()
+    assert "BEGIN CERTIFICATE" in out["cert"]
+    assert out["ca"] == tls_cluster.tls.ca_pem
+    agent = RpcClient(ssl_context=tls_cluster.tls.outgoing_context(
+        out["cert"], out["key"]))
+    try:
+        stats = agent.call(addr, "stats", {})
+        assert stats["state"] == "leader"
+    finally:
+        agent.close()
