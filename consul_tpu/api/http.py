@@ -516,6 +516,14 @@ def _make_handler(srv: ApiServer):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
             if path.startswith("/v1/acl"):
                 return self._acl(verb, path, q)
+            if path in ("/ui", "/ui/", "/", "") and verb == "GET":
+                # "" is "/" after the trailing-slash strip in _q()
+                # single-page dashboard (the reference serves its Ember
+                # app at /ui via agent/uiserver)
+                from consul_tpu.ui import PAGE
+                self._send(None, raw=PAGE.encode(),
+                           ctype="text/html; charset=utf-8")
+                return True
             if path == "/v1/status/leader" and verb == "GET":
                 self._send("127.0.0.1:8300")
                 return True

@@ -60,8 +60,18 @@ class GossipOracle:
             while self._running:
                 t0 = time.time()
                 self.advance(1)
+                # bound the device queue to one in-flight tick: a free-
+                # running pacer that only ever enqueues starves every
+                # reader's host transfer behind an unbounded queue.
+                # Block OUTSIDE the lock — readers need it while we wait
+                # on the device (a superseded array still bounds the
+                # queue).
+                state = self._state
+                jax.block_until_ready(state.swim.tick)
                 if tick_seconds > 0:
                     time.sleep(max(0.0, tick_seconds - (time.time() - t0)))
+                else:
+                    time.sleep(0)   # yield: readers need lock windows
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -67,13 +67,14 @@ class Server:
         self._listener = None
         self._rpc_client = None
         self.tls = None
+        self._bootstrap_token = None
         from consul_tpu.autopilot import Autopilot
         self.autopilot = Autopilot(self)
 
     # --------------------------------------------------------------- rpc net
 
     def serve_rpc(self, host: str = "127.0.0.1", port: int = 0,
-                  tls=None):
+                  tls=None, bootstrap_token: str = None):
         """Bind the socket RPC listener (raft frames + forwarded applies)
         and advertise our address in the transport's address book.
         Returns (host, port).
@@ -95,7 +96,11 @@ class Server:
                                      ssl_context=ssl_in)
         self._listener.start()
         self._bootstrap_listener = None
-        if tls is not None and tls.verify_incoming:
+        self._bootstrap_token = bootstrap_token
+        if tls is not None and tls.verify_incoming \
+                and bootstrap_token:
+            # secure by default: no bootstrap token configured means no
+            # unauthenticated cert-minting surface at all
             # the reference's insecure RPC server (server.go:240-247):
             # ONE method, no client cert required — so a fresh agent can
             # obtain its first cert at all
@@ -156,9 +161,15 @@ class Server:
             return self.stats()
         if method == "auto_encrypt_sign":
             # agent bootstrap cert issuance (auto_encrypt_endpoint.go
-            # Sign): agents join TLS with a cert chained to the fleet CA
+            # Sign — the reference gates this with an ACL token; a cert
+            # minted without ANY credential would turn network
+            # reachability into full RPC write access)
             if self.tls is None:
                 raise ValueError("TLS not configured")
+            token = args.get("token", "")
+            if not self._bootstrap_token \
+                    or token != self._bootstrap_token:
+                raise PermissionError("auto-encrypt: invalid token")
             cert, key = self.tls.sign_cert(args.get("name", "agent"))
             return {"cert": cert, "key": key, "ca": self.tls.ca_pem}
         raise ValueError(f"unknown rpc method {method}")

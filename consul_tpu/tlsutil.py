@@ -20,7 +20,6 @@ import datetime
 import os
 import ssl
 import tempfile
-import threading
 from typing import Optional, Tuple
 
 from cryptography import x509
@@ -46,7 +45,6 @@ class Configurator:
         self.verify_incoming = verify_incoming
         self.verify_outgoing = verify_outgoing
         self.verify_server_hostname = verify_server_hostname
-        self._lock = threading.Lock()
         # the TLS CA: supplied or self-generated (auto-TLS)
         self._ca = BuiltinCA(f"{dc}.{domain}", dc=dc,
                              key_pem=ca_key_pem, cert_pem=ca_cert_pem)

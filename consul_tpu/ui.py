@@ -1,0 +1,161 @@
+"""Web UI: a single-page dashboard served at /ui.
+
+The reference ships an Ember monorepo served by agent/uiserver; this
+framework serves a dependency-free single-file UI over the same /v1
+APIs: services with instance health, nodes, membership summary, the KV
+browser, intentions, and raft/autopilot state for server-backed agents.
+Live updates ride the blocking-query index the API already exposes.
+"""
+
+PAGE = """<!DOCTYPE html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>consul-tpu</title>
+<style>
+  :root { --bg:#0d1117; --panel:#161b22; --line:#30363d; --fg:#e6edf3;
+          --dim:#8b949e; --ok:#3fb950; --warn:#d29922; --crit:#f85149;
+          --acc:#58a6ff; }
+  * { box-sizing:border-box; }
+  body { margin:0; background:var(--bg); color:var(--fg);
+         font:14px/1.5 system-ui,sans-serif; }
+  header { display:flex; gap:16px; align-items:baseline;
+           padding:12px 20px; border-bottom:1px solid var(--line); }
+  header h1 { font-size:16px; margin:0; }
+  header .sub { color:var(--dim); font-size:12px; }
+  nav { display:flex; gap:4px; padding:8px 20px 0; }
+  nav button { background:none; border:none; color:var(--dim);
+               padding:6px 12px; cursor:pointer; font-size:13px;
+               border-bottom:2px solid transparent; }
+  nav button.on { color:var(--fg); border-color:var(--acc); }
+  main { padding:16px 20px; }
+  table { border-collapse:collapse; width:100%; }
+  th { text-align:left; color:var(--dim); font-weight:500;
+       font-size:12px; padding:6px 10px;
+       border-bottom:1px solid var(--line); }
+  td { padding:6px 10px; border-bottom:1px solid var(--line); }
+  .pill { display:inline-block; padding:1px 8px; border-radius:10px;
+          font-size:12px; }
+  .ok { background:#12381f; color:var(--ok); }
+  .warn { background:#3a2d10; color:var(--warn); }
+  .crit { background:#42181a; color:var(--crit); }
+  .dim { color:var(--dim); }
+  code { background:var(--panel); padding:1px 5px; border-radius:4px; }
+  .cards { display:flex; gap:12px; margin-bottom:16px; flex-wrap:wrap; }
+  .card { background:var(--panel); border:1px solid var(--line);
+          border-radius:8px; padding:10px 16px; min-width:110px; }
+  .card .n { font-size:22px; }
+  .card .l { color:var(--dim); font-size:12px; }
+</style>
+</head>
+<body>
+<header><h1>consul-tpu</h1>
+  <span class="sub" id="meta"></span></header>
+<nav id="nav"></nav>
+<main id="main">loading…</main>
+<script>
+const tabs = ["services","nodes","members","kv","intentions","operator"];
+let tab = location.hash.slice(1) || "services";
+const $ = (h) => { const d = document.createElement("div");
+                   d.innerHTML = h; return d; };
+const esc = (s) => String(s).replace(/[&<>"]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;"}[c]));
+const get = (p) => fetch(p).then(r => r.ok ? r.json() : null);
+function pill(st) {
+  const cls = st === "passing" || st === "alive" ? "ok"
+            : st === "warning" ? "warn" : "crit";
+  return `<span class="pill ${cls}">${esc(st)}</span>`;
+}
+async function renderServices() {
+  const svcs = await get("/v1/catalog/services") || {};
+  let rows = "";
+  for (const name of Object.keys(svcs)) {
+    const hs = await get(`/v1/health/service/${name}`) || [];
+    const inst = hs.map(h => {
+      const worst = (h.Checks || []).reduce((w, c) =>
+        c.Status === "critical" ? "critical"
+        : (c.Status === "warning" && w !== "critical") ? "warning" : w,
+        "passing");
+      return `${pill(worst)} ${esc(h.Node.Node)}:${h.Service.Port}`;
+    }).join("<br>");
+    rows += `<tr><td>${esc(name)}</td><td>${svcs[name].map(esc)
+      .join(", ") || '<span class="dim">—</span>'}</td>
+      <td>${inst || '<span class="dim">no instances</span>'}</td></tr>`;
+  }
+  return `<table><tr><th>Service</th><th>Tags</th>
+    <th>Instances</th></tr>${rows}</table>`;
+}
+async function renderNodes() {
+  const nodes = await get("/v1/catalog/nodes") || [];
+  return `<table><tr><th>Node</th><th>Address</th></tr>` +
+    nodes.map(n => `<tr><td>${esc(n.Node)}</td>
+      <td><code>${esc(n.Address)}</code></td></tr>`).join("") +
+    `</table>`;
+}
+async function renderMembers() {
+  const m = await get("/v1/agent/metrics") || {Gauges: []};
+  const g = Object.fromEntries(m.Gauges.map(x => [x.Name, x.Value]));
+  const cards = ["alive","failed","left","total"].map(k =>
+    `<div class="card"><div class="n">${g["consul.members."+k] ?? "—"}
+     </div><div class="l">${k}</div></div>`).join("");
+  const mem = await get("/v1/agent/members?limit=100") || [];
+  const statusNames = {1: "alive", 3: "left", 4: "failed"};
+  return `<div class="cards">${cards}</div>
+    <table><tr><th>Member</th><th>Status</th></tr>` +
+    mem.map(x => `<tr><td>${esc(x.Name)}</td>
+      <td>${pill(statusNames[x.Status] || String(x.Status))}
+      </td></tr>`).join("") + `</table>
+    <p class="dim">first 100 of ${g["consul.members.total"] ?? "?"}</p>`;
+}
+async function renderKV() {
+  // ONE recurse fetch — per-key GETs would race the 5s refresh
+  const rows = await get("/v1/kv/?recurse") || [];
+  return `<table><tr><th>Key</th><th>Value</th></tr>` +
+    rows.slice(0, 200).map(v => {
+      const val = v.Value ? atob(v.Value) : "";
+      return `<tr><td><code>${esc(v.Key)}</code></td>
+        <td>${esc(val.slice(0, 120))}</td></tr>`;
+    }).join("") + `</table>`;
+}
+async function renderIntentions() {
+  const its = await get("/v1/connect/intentions") || [];
+  return `<table><tr><th>Source</th><th>Destination</th><th>Action</th>
+    <th>Precedence</th></tr>` + its.map(i =>
+    `<tr><td>${esc(i.SourceName)}</td><td>${esc(i.DestinationName)}</td>
+     <td>${pill(i.Action === "allow" ? "passing" : "critical")}</td>
+     <td>${i.Precedence}</td></tr>`).join("") + `</table>`;
+}
+async function renderOperator() {
+  const cfg = await get("/v1/operator/raft/configuration");
+  if (!cfg) return `<p class="dim">not a server-backed agent</p>`;
+  const h = await get("/v1/operator/autopilot/health");
+  return `<table><tr><th>Server</th><th>Leader</th><th>Healthy</th></tr>`
+    + cfg.Servers.map(s => {
+      const hs = h && h.Servers.find(x => x.ID === s.ID);
+      return `<tr><td>${esc(s.Node)}</td>
+        <td>${s.Leader ? "★" : ""}</td>
+        <td>${hs ? pill(hs.Healthy ? "passing" : "critical") : "—"}
+        </td></tr>`;}).join("") + `</table>`;
+}
+const renderers = {services: renderServices, nodes: renderNodes,
+  members: renderMembers, kv: renderKV, intentions: renderIntentions,
+  operator: renderOperator};
+async function render() {
+  document.getElementById("nav").innerHTML = tabs.map(t =>
+    `<button class="${t === tab ? "on" : ""}"
+      onclick="location.hash='${t}'">${t}</button>`).join("");
+  const self = await get("/v1/agent/self");
+  if (self) document.getElementById("meta").textContent =
+    `${self.Config.NodeName} · ${self.Config.Datacenter} · ` +
+    `v${self.Config.Version}`;
+  document.getElementById("main").innerHTML =
+    await renderers[tab]() || "";
+}
+window.addEventListener("hashchange", () => {
+  tab = location.hash.slice(1) || "services"; render(); });
+render();
+setInterval(render, 5000);
+</script>
+</body>
+</html>
+"""

@@ -28,7 +28,7 @@ class TlsCluster:
             t = TcpTransport(self.addresses)
             s = Server(nid, ids, t, registry={},
                        raft_config=RaftConfig(), seed=seed + i)
-            s.serve_rpc(tls=self.tls)
+            s.serve_rpc(tls=self.tls, bootstrap_token="join-secret")
             self.servers.append(s)
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -122,8 +122,12 @@ def test_auto_encrypt_issues_usable_cert(tls_cluster):
     boot = RpcClient(
         ssl_context=tls_cluster.tls.outgoing_context())  # no client cert
     try:
+        # wrong/missing token refused (the reference gates AutoEncrypt
+        # behind an ACL token — reachability alone must not mint certs)
+        with pytest.raises(RpcError):
+            boot.call(boot_addr, "auto_encrypt_sign", {"name": "agent9"})
         out = boot.call(boot_addr, "auto_encrypt_sign",
-                        {"name": "agent9"})
+                        {"name": "agent9", "token": "join-secret"})
         # and the bootstrap listener serves NOTHING else
         with pytest.raises(RpcError):
             boot.call(boot_addr, "stats", {})

@@ -1,0 +1,25 @@
+"""Web UI smoke: the dashboard serves at /ui over the live API."""
+
+import urllib.request
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+def test_ui_served_and_references_live_endpoints():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=51))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        r = urllib.request.urlopen(a.http_address + "/ui", timeout=30)
+        assert r.status == 200
+        assert "text/html" in r.headers.get("Content-Type", "")
+        body = r.read().decode()
+        for endpoint in ("/v1/catalog/services", "/v1/agent/members",
+                         "/v1/connect/intentions", "/v1/kv/"):
+            assert endpoint in body
+        # root redirector serves too
+        r2 = urllib.request.urlopen(a.http_address + "/", timeout=30)
+        assert r2.status == 200
+    finally:
+        a.stop()
