@@ -301,7 +301,14 @@ class Agent:
 
     def reconcile(self) -> None:
         """serf→catalog reconciliation (leader.go:1234 handleAliveMember /
-        :1332 handleFailedMember / :1390 handleReapMember)."""
+        :1332 handleFailedMember / :1390 handleReapMember).
+
+        Standalone-agent shape only: when the backing store is a raft
+        Server with an attached oracle, the LEADER runs reconciliation
+        (server.py _reconcile_members) and this no-ops — two concurrent
+        reconcilers with different reap semantics must not race."""
+        if getattr(self.store, "_oracle", None) is not None:
+            return
         catalog_nodes = {n["node"] for n in self.store.nodes()}
         for m in self.oracle.members():
             name = m["name"]

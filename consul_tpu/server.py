@@ -190,6 +190,25 @@ class Server:
         if self.raft.is_leader():
             self._leader_duties(now)
 
+    def attach_oracle(self, oracle, reconcile_interval: float = 1.0,
+                      reap_timeout: float = 72 * 3600.0) -> None:
+        """Wire the gossip oracle so THIS raft leader runs serf→catalog
+        reconciliation (the reference's leaderLoop: reconcileMember
+        leader.go:1187, handleFailedMember :1332, reap :1390) — every
+        catalog mutation proposes through raft, so followers converge.
+        `reap_timeout`: failed members deregister after this long
+        (serf reconnect_timeout, 72h default)."""
+        self._oracle = oracle
+        self._reconcile_interval = reconcile_interval
+        self._reap_timeout = reap_timeout
+        self._last_reconcile = 0.0
+        self._failed_since = {}
+
+    _oracle = None
+    _reconcile_inflight = False
+    _reconcile_interval = 1.0
+    _last_reconcile = 0.0
+
     def _leader_duties(self, now: float) -> None:
         # autopilot: server health + dead-server cleanup (autopilot.go:67)
         self.autopilot.run(now)
@@ -205,6 +224,107 @@ class Server:
                 break
         self._ttl_reap_inflight &= set(
             s["id"] for s in self.store.session_list())
+        # serf→catalog reconcile + session-check invalidation, interval-
+        # gated and OFF the tick thread — leader-only + raft-proposed.
+        # Runs on a worker thread: members() may sync the device (first
+        # call compiles for seconds), the session scan is
+        # O(sessions x checks), and a stalled tick thread stops
+        # heartbeats → leadership churn (lib/routine.Manager role).
+        if now - self._last_reconcile >= self._reconcile_interval \
+                and not self._reconcile_inflight:
+            self._last_reconcile = now
+            self._reconcile_inflight = True
+
+            def work(now=now):
+                try:
+                    self._invalidate_sessions_on_checks(now)
+                    if self._oracle is not None:
+                        self._reconcile_members(now)
+                finally:
+                    self._reconcile_inflight = False
+
+            threading.Thread(target=work, daemon=True).start()
+
+    def _invalidate_sessions_on_checks(self, now: float) -> None:
+        for sess in self.store.session_list():
+            sid = sess["id"]
+            if sid in self._ttl_reap_inflight:
+                continue  # destroy already proposed, not yet applied
+            node_checks = {c["check_id"]: c["status"]
+                           for c in self.store.node_checks(sess["node"])}
+            for cid in sess.get("checks") or []:
+                if node_checks.get(cid) == "critical":
+                    try:
+                        # pin `now` at the proposer: replicas computing
+                        # lock-delay expiry from their own clocks would
+                        # diverge (store.py determinism invariant)
+                        self._leader_propose("session_destroy", sid=sid,
+                                             now=now)
+                        self._ttl_reap_inflight.add(sid)
+                    except NotLeaderError:
+                        return
+                    break
+
+    def _leader_propose(self, op: str, timeout: float = 2.0, **args):
+        """Propose on THIS node only — a deposed leader's worker must
+        abort, never forward its stale snapshot to the new leader
+        (raft_apply would forward)."""
+        pend = self.raft.apply({"op": op, "args": args})
+        pend.event.wait(timeout)
+        return pend.result
+
+    def _reconcile_members(self, now: float) -> None:
+        """handleAliveMember/handleFailedMember/handleReapMember
+        (leader.go:1234-1432) driven from oracle membership, with every
+        write a raft proposal."""
+        catalog = {n["node"] for n in self.store.nodes()}
+        try:
+            members = self._oracle.members()
+        except Exception:
+            return
+        member_names = {m["name"] for m in members}
+        # drop stale failed-timers for members no longer tracked: a
+        # deregistered-then-rejoining node must get a fresh reap window
+        for stale in set(self._failed_since) - (member_names & catalog):
+            self._failed_since.pop(stale, None)
+        for m in members:
+            if not self.raft.is_leader():
+                return  # deposed mid-loop: stop writing
+            name = m["name"]
+            if name not in catalog:
+                continue
+            checks = {c["check_id"]: c
+                      for c in self.store.node_checks(name)}
+            sh = checks.get("serfHealth")
+            try:
+                if m["status"] == "failed":
+                    since = self._failed_since.setdefault(name, now)
+                    if now - since >= self._reap_timeout:
+                        # reap: the member stayed failed past
+                        # reconnect_timeout — deregister entirely
+                        self._leader_propose("deregister_node", node=name)
+                        self._failed_since.pop(name, None)
+                    elif sh is None or sh["status"] != "critical":
+                        self._leader_propose(
+                            "register_check", node=name,
+                            check_id="serfHealth",
+                            name="Serf Health Status",
+                            status="critical",
+                            output="Agent not live or unreachable")
+                elif m["status"] == "left":
+                    self._failed_since.pop(name, None)
+                    self._leader_propose("deregister_node", node=name)
+                else:
+                    self._failed_since.pop(name, None)
+                    if sh is not None and sh["status"] != "passing":
+                        self._leader_propose(
+                            "register_check", node=name,
+                            check_id="serfHealth",
+                            name="Serf Health Status",
+                            status="passing",
+                            output="Agent alive and reachable")
+            except (NotLeaderError, NoLeaderError):
+                return
 
     # ------------------------------------------------------------ raft apply
 
