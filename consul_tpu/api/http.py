@@ -1048,6 +1048,60 @@ def _make_handler(srv: ApiServer):
                     or path.startswith("/v1/agent/connect/") \
                     or path.startswith("/v1/agent/xds/"):
                 return self._connect(verb, path, q)
+            if path == "/v1/config" and verb == "PUT":
+                # EnsureConfigEntry (config_endpoint.go Apply): writes
+                # need operator:write like mesh config in the reference
+                if not self.authz.operator_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                kind = (body.get("Kind") or "").lower()
+                name = body.get("Name", "")
+                entry = _lower_keys({k: v for k, v in body.items()
+                                     if k not in ("Kind", "Name")})
+                try:
+                    store.config_entry_set(kind, name, entry)
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send(True)
+                return True
+            m = re.fullmatch(r"/v1/config/([^/]+)/?([^/]*)", path)
+            if m and verb == "GET":
+                # reads gate on service:read of the entry name (the
+                # reference's config entry read ACLs); lists filter
+                idx = self._block(q, ("config", ""))
+                kind = m.group(1)
+                if m.group(2):
+                    if not self.authz.service_read(m.group(2)):
+                        return self._forbid()
+                    e = store.config_entry_get(kind, m.group(2))
+                    if e is None:
+                        self._err(404, "config entry not found")
+                        return True
+                    self._send(e, index=idx)
+                else:
+                    self._send(
+                        [e for e in store.config_entry_list(kind)
+                         if self.authz.service_read(e.get("name", ""))],
+                        index=idx)
+                return True
+            m = re.fullmatch(r"/v1/config/([^/]+)/([^/]+)", path)
+            if m and verb == "DELETE":
+                if not self.authz.operator_write():
+                    return self._forbid()
+                store.config_entry_delete(m.group(1), m.group(2))
+                self._send(True)
+                return True
+            m = re.fullmatch(r"/v1/discovery-chain/([^/]+)", path)
+            if m and verb == "GET":
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
+                from consul_tpu.discoverychain import compile_chain
+                idx = self._block(q, ("config", ""))
+                self._send({"Chain": compile_chain(store, m.group(1),
+                                                   dc=srv.dc)},
+                           index=idx)
+                return True
             if path == "/v1/exec" and verb == "PUT":
                 # initiator side of consul exec (remote_exec.go protocol
                 # over KV + events); agent:write like agent mutations
@@ -1684,6 +1738,29 @@ def _make_handler(srv: ApiServer):
             return sorted(rows, key=lambda r: pos.get(key(r), 1 << 30))
 
     return Handler
+
+
+def _snake(name: str) -> str:
+    """CamelCase → snake_case (PathPrefix → path_prefix)."""
+    out = []
+    for i, ch in enumerate(name):
+        if ch.isupper() and i > 0 and (not name[i - 1].isupper()
+                                       or (i + 1 < len(name)
+                                           and name[i + 1].islower())):
+            out.append("_")
+        out.append(ch.lower())
+    return "".join(out)
+
+
+def _lower_keys(obj):
+    """Config entries arrive in the reference's CamelCase JSON; the
+    store keeps snake_case (the HCL shape compile_chain reads)."""
+    if isinstance(obj, dict):
+        return {_snake(k) if isinstance(k, str) else k: _lower_keys(v)
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_lower_keys(x) for x in obj]
+    return obj
 
 
 def _check_defn(body: dict) -> dict:

@@ -100,6 +100,9 @@ class StateStore:
         # connect intentions: id -> {source, destination, action,
         # precedence, ...} (state/intention.go)
         self._intentions: Dict[str, dict] = {}
+        # centralized config entries: (kind, name) -> body
+        # (state/config_entry.go)
+        self._config_entries: Dict[Tuple[str, str], dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -752,6 +755,42 @@ class StateStore:
             del self._queries[qid]
             return idx
 
+    # -------------------------------------------------------- config entries
+    # CRUD mirrors state/config_entry.go (EnsureConfigEntry/ConfigEntry/
+    # ConfigEntries/DeleteConfigEntry); kinds are the L7 routing trio
+
+    def config_entry_set(self, kind: str, name: str, body: dict) -> int:
+        from consul_tpu.discoverychain import KINDS
+        if kind not in KINDS:
+            raise ValueError(f"unsupported config entry kind {kind!r}")
+        with self._lock:
+            idx = self._bump([("config", f"{kind}/{name}")])
+            existing = self._config_entries.get((kind, name), {})
+            self._config_entries[(kind, name)] = dict(
+                body, kind=kind, name=name,
+                create_index=existing.get("create_index", idx),
+                modify_index=idx)
+            return idx
+
+    def config_entry_get(self, kind: str, name: str) -> Optional[dict]:
+        with self._lock:
+            e = self._config_entries.get((kind, name))
+            return dict(e) if e else None
+
+    def config_entry_list(self, kind: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [dict(v) for (k, _n), v in
+                    sorted(self._config_entries.items())
+                    if kind is None or k == kind]
+
+    def config_entry_delete(self, kind: str, name: str) -> int:
+        with self._lock:
+            if (kind, name) not in self._config_entries:
+                return self._index
+            idx = self._bump([("config", f"{kind}/{name}")])
+            del self._config_entries[(kind, name)]
+            return idx
+
     # ------------------------------------------------------------ intentions
     # CRUD mirrors state/intention.go; precedence is computed at write so
     # match/check order is a pure read (structs.Intention UpdatePrecedence)
@@ -884,6 +923,9 @@ class StateStore:
                 "acl_bootstrap_index": self._acl_bootstrap_index,
                 "queries": copy.deepcopy(self._queries),
                 "intentions": copy.deepcopy(self._intentions),
+                "config_entries": {f"{k}\x00{n}": copy.deepcopy(v)
+                                   for (k, n), v in
+                                   self._config_entries.items()},
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -909,6 +951,9 @@ class StateStore:
             self._acl_bootstrap_index = snap.get("acl_bootstrap_index", 0)
             self._queries = copy.deepcopy(snap.get("queries", {}))
             self._intentions = copy.deepcopy(snap.get("intentions", {}))
+            self._config_entries = {
+                tuple(k.split("\x00")): copy.deepcopy(v)
+                for k, v in snap.get("config_entries", {}).items()}
             # watch bookkeeping must rewind with the index, or restored-
             # to-older stores report watch indexes beyond _index and
             # blocking queries busy-loop returning immediately

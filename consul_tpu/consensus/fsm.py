@@ -42,6 +42,8 @@ class ServerFSM:
             "query_delete": self._query_delete,
             "intention_set": self._intention_set,
             "intention_delete": self._intention_delete,
+            "config_entry_set": self._config_entry_set,
+            "config_entry_delete": self._config_entry_delete,
         }
 
     def apply(self, cmd: Dict[str, Any]) -> Any:
@@ -167,6 +169,16 @@ class ServerFSM:
 
     def _intention_delete(self, iid):
         return {"index": self.store.intention_delete(iid)}
+
+    def _config_entry_set(self, kind, name, body):
+        try:
+            return {"index": self.store.config_entry_set(kind, name,
+                                                         body)}
+        except ValueError as e:
+            return {"error": str(e), "index": self.store.index}
+
+    def _config_entry_delete(self, kind, name):
+        return {"index": self.store.config_entry_delete(kind, name)}
 
     def _acl_bootstrap(self, accessor, secret):
         ok, idx = self.store.acl_bootstrap(accessor, secret)

@@ -1,0 +1,109 @@
+"""Config entries + discovery-chain compilation (L7 routing).
+
+The reference's centralized config entries (service-router /
+service-splitter / service-resolver, agent/structs/config_entry.go)
+compile per service into a discovery chain
+(agent/consul/discoverychain/compile.go:57 Compile): a start node,
+router nodes with path/header matches, splitter nodes with weighted
+legs, and resolver nodes producing targets (optionally redirected or
+with failover).  The chain is what the xDS layer turns into routes/
+clusters; /v1/discovery-chain/<service> serves the compiled form.
+
+Compilation here follows the same node graph: router → splitter →
+resolver → target, with defaults synthesized for services that have no
+entries (the implicit chain).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+KINDS = ("service-router", "service-splitter", "service-resolver")
+
+
+def _entry(store, kind: str, name: str) -> Optional[dict]:
+    return store.config_entry_get(kind, name)
+
+
+def _resolver_node(store, service: str, chain: dict,
+                   depth: int = 0) -> str:
+    """Build (and register in chain) the resolver node for `service`,
+    following redirects (compile.go resolver handling).  Returns the
+    node id."""
+    nid = f"resolver:{service}"
+    if nid in chain["Nodes"] or depth > 8:   # redirect loop guard
+        return nid
+    res = _entry(store, "service-resolver", service) or {}
+    redirect = (res.get("redirect") or {}).get("service")
+    if redirect and redirect != service:
+        target = _resolver_node(store, redirect, chain, depth + 1)
+        chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
+                               "Redirect": redirect, "Resolver": target}
+        return nid
+    target = f"{service}.default.{chain['Datacenter']}"
+    failover = [
+        {"Service": f.get("service", service),
+         "Datacenters": f.get("datacenters") or []}
+        for f in (res.get("failover") or {}).values()
+    ] if isinstance(res.get("failover"), dict) else []
+    chain["Nodes"][nid] = {
+        "Type": "resolver", "Name": service,
+        "ConnectTimeout": res.get("connect_timeout", "5s"),
+        "Target": target,
+        "Failover": failover,
+    }
+    chain["Targets"][target] = {"Service": service,
+                                "Datacenter": chain["Datacenter"]}
+    return nid
+
+
+def _splitter_node(store, service: str, chain: dict) -> str:
+    split = _entry(store, "service-splitter", service)
+    if split is None:
+        return _resolver_node(store, service, chain)
+    nid = f"splitter:{service}"
+    if nid in chain["Nodes"]:
+        return nid
+    legs = []
+    for leg in split.get("splits") or []:
+        svc = leg.get("service", service)
+        legs.append({"Weight": leg.get("weight", 0),
+                     "Node": _resolver_node(store, svc, chain)})
+    chain["Nodes"][nid] = {"Type": "splitter", "Name": service,
+                           "Splits": legs}
+    return nid
+
+
+def compile_chain(store, service: str, dc: str = "dc1") -> dict:
+    """Compile `service`'s discovery chain (compile.go:57).
+
+    Output shape mirrors structs.CompiledDiscoveryChain: ServiceName,
+    StartNode, Nodes (router/splitter/resolver), Targets."""
+    chain: Dict = {"ServiceName": service, "Datacenter": dc,
+                   "Protocol": "tcp", "Nodes": {}, "Targets": {}}
+    router = _entry(store, "service-router", service)
+    if router is not None:
+        nid = f"router:{service}"
+        routes = []
+        for r in router.get("routes") or []:
+            match = r.get("match") or {}
+            dest = (r.get("destination") or {}).get("service", service)
+            routes.append({
+                "Match": {"PathPrefix": match.get("path_prefix", ""),
+                          "PathExact": match.get("path_exact", ""),
+                          "Header": match.get("header") or []},
+                "Node": _splitter_node(store, dest, chain),
+            })
+        # default catch-all to the service itself (compile.go appends
+        # the implicit default route)
+        routes.append({"Match": {"PathPrefix": "/"},
+                       "Node": _splitter_node(store, service, chain)})
+        chain["Nodes"][nid] = {"Type": "router", "Name": service,
+                               "Routes": routes}
+        chain["StartNode"] = nid
+        chain["Protocol"] = "http"
+    else:
+        chain["StartNode"] = _splitter_node(store, service, chain)
+        if f"splitter:{service}" in chain["Nodes"]:
+            chain["Protocol"] = "http"
+    return chain

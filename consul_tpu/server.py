@@ -258,9 +258,14 @@ class Server:
                         # pin `now` at the proposer: replicas computing
                         # lock-delay expiry from their own clocks would
                         # diverge (store.py determinism invariant)
-                        self._leader_propose("session_destroy", sid=sid,
-                                             now=now)
-                        self._ttl_reap_inflight.add(sid)
+                        result = self._leader_propose(
+                            "session_destroy", sid=sid, now=now)
+                        if result is not None:
+                            # only confirmed commits enter the dedup set
+                            # — a proposal lost to deposition would pin
+                            # the sid forever (destroy is idempotent, so
+                            # a timed-out retry next round is safe)
+                            self._ttl_reap_inflight.add(sid)
                     except NotLeaderError:
                         return
                     break
@@ -520,6 +525,17 @@ class Server:
 
     def intention_delete(self, iid):
         return self.raft_apply("intention_delete", iid=iid)["index"]
+
+    def config_entry_set(self, kind, name, body):
+        r = self.raft_apply("config_entry_set", kind=kind, name=name,
+                            body=body)
+        if "error" in r:
+            raise ValueError(r["error"])
+        return r["index"]
+
+    def config_entry_delete(self, kind, name):
+        return self.raft_apply("config_entry_delete", kind=kind,
+                               name=name)["index"]
 
     # ------------------------------------------------------------- read side
     # Stale reads hit the local replica directly; the HTTP layer decides.
