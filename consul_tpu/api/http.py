@@ -1056,6 +1056,11 @@ def _make_handler(srv: ApiServer):
                 body = json.loads(self._body() or b"{}")
                 kind = (body.get("Kind") or "").lower()
                 name = body.get("Name", "")
+                if not name:
+                    # an empty name would store an entry unreachable by
+                    # the single-entry GET/DELETE routes
+                    self._err(400, "config entry Name is required")
+                    return True
                 entry = _lower_keys({k: v for k, v in body.items()
                                      if k not in ("Kind", "Name")})
                 try:
@@ -1078,10 +1083,11 @@ def _make_handler(srv: ApiServer):
                     if e is None:
                         self._err(404, "config entry not found")
                         return True
-                    self._send(e, index=idx)
+                    self._send(_config_json(e), index=idx)
                 else:
                     self._send(
-                        [e for e in store.config_entry_list(kind)
+                        [_config_json(e)
+                         for e in store.config_entry_list(kind)
                          if self.authz.service_read(e.get("name", ""))],
                         index=idx)
                 return True
@@ -1740,6 +1746,18 @@ def _make_handler(srv: ApiServer):
     return Handler
 
 
+def _camel(obj):
+    """snake_case → CamelCase for config entry RESPONSES, so read-then-
+    write round-trips (the reference serves CamelCase JSON)."""
+    if isinstance(obj, dict):
+        return {("".join(p.capitalize() for p in k.split("_"))
+                 if isinstance(k, str) else k): _camel(v)
+                for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_camel(x) for x in obj]
+    return obj
+
+
 def _snake(name: str) -> str:
     """CamelCase → snake_case (PathPrefix → path_prefix)."""
     out = []
@@ -1761,6 +1779,19 @@ def _lower_keys(obj):
     if isinstance(obj, list):
         return [_lower_keys(x) for x in obj]
     return obj
+
+
+def _config_json(entry: dict) -> dict:
+    """Stored snake_case entry → the reference's CamelCase wire shape
+    (round-trippable through PUT /v1/config)."""
+    out = _camel({k: v for k, v in entry.items()
+                  if k not in ("kind", "name", "create_index",
+                               "modify_index")})
+    out["Kind"] = entry.get("kind", "")
+    out["Name"] = entry.get("name", "")
+    out["CreateIndex"] = entry.get("create_index", 0)
+    out["ModifyIndex"] = entry.get("modify_index", 0)
+    return out
 
 
 def _check_defn(body: dict) -> dict:

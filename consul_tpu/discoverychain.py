@@ -31,7 +31,18 @@ def _resolver_node(store, service: str, chain: dict,
     following redirects (compile.go resolver handling).  Returns the
     node id."""
     nid = f"resolver:{service}"
-    if nid in chain["Nodes"] or depth > 8:   # redirect loop guard
+    if nid in chain["Nodes"]:
+        return nid
+    if depth > 8:
+        # too-deep redirect chain: terminate with a plain resolver for
+        # this service rather than a dangling node reference (the
+        # reference errors; a black-holed pointer is the worst option)
+        target = f"{service}.default.{chain['Datacenter']}"
+        chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
+                               "Target": target, "Failover": [],
+                               "RedirectDepthExceeded": True}
+        chain["Targets"][target] = {"Service": service,
+                                    "Datacenter": chain["Datacenter"]}
         return nid
     res = _entry(store, "service-resolver", service) or {}
     redirect = (res.get("redirect") or {}).get("service")
@@ -88,10 +99,16 @@ def compile_chain(store, service: str, dc: str = "dc1") -> dict:
         for r in router.get("routes") or []:
             match = r.get("match") or {}
             dest = (r.get("destination") or {}).get("service", service)
+            headers = [{"Name": h.get("name", ""),
+                        "Exact": h.get("exact", ""),
+                        "Prefix": h.get("prefix", ""),
+                        "Present": bool(h.get("present", False)),
+                        "Regex": h.get("regex", "")}
+                       for h in match.get("header") or []]
             routes.append({
                 "Match": {"PathPrefix": match.get("path_prefix", ""),
                           "PathExact": match.get("path_exact", ""),
-                          "Header": match.get("header") or []},
+                          "Header": headers},
                 "Node": _splitter_node(store, dest, chain),
             })
         # default catch-all to the service itself (compile.go appends

@@ -357,9 +357,15 @@ class Server:
                 addr = self._remote_addr(leader or "")
                 if addr is not None:
                     try:
-                        return self._rpc_client.call(
+                        out = self._rpc_client.call(
                             addr, "apply", {"op": op, "args": args},
                             timeout=max(0.05, deadline - time.time()))
+                        if out is not None:
+                            return out
+                        # a None result means the remote apply raced a
+                        # deposition — retry within the deadline rather
+                        # than hand callers a non-dict
+                        last_err = RpcError("empty apply result")
                     except (RpcError, TimeoutError) as e:
                         last_err = e
                 time.sleep(0.01)

@@ -117,7 +117,12 @@ def test_http_config_and_chain_end_to_end():
             "Splits": [{"Weight": 80, "Service": "pay"},
                        {"Weight": 20, "Service": "pay-beta"}]})
         got = call("GET", "/v1/config/service-splitter/pay")
-        assert got["splits"][0]["weight"] == 80
+        assert got["Splits"][0]["Weight"] == 80
+        assert got["Kind"] == "service-splitter"
+        # read-then-write round-trips (consul config read | write)
+        assert call("PUT", "/v1/config",
+                    {k: v for k, v in got.items()
+                     if k not in ("CreateIndex", "ModifyIndex")})
         assert call("GET", "/v1/config/service-splitter")
 
         chain = call("GET", "/v1/discovery-chain/pay")["Chain"]
