@@ -1,0 +1,140 @@
+"""Auth methods: trusted-identity login → ACL token minting.
+
+The reference's auth-method stack (agent/consul/authmethod/, login at
+acl_endpoint.go Login/Logout): an auth method validates a bearer
+credential (Kubernetes SA JWT, OIDC/JWT), binding rules select which
+identities map to which ACL roles/policies, and a successful login mints
+a short-lived token deleted again by logout.
+
+Implemented method type: "jwt" with HS256 (HMAC) validation — stdlib
+only, no JOSE dependency.  Config: {"secret": ..., "bound_audiences":
+[...], "claim_mappings": {claim: var}}.  Binding-rule selectors are
+`key==value` conjunctions over the mapped claims; bind_name supports
+${var} interpolation like the reference's HIL templates.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import re
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class AuthError(Exception):
+    pass
+
+
+def _b64url_decode(s: str) -> bytes:
+    pad = "=" * (-len(s) % 4)
+    return base64.urlsafe_b64decode(s + pad)
+
+
+def b64url_encode(b: bytes) -> str:
+    return base64.urlsafe_b64encode(b).rstrip(b"=").decode()
+
+
+def make_jwt(claims: dict, secret: str) -> str:
+    """Test/ops helper: mint an HS256 JWT."""
+    header = b64url_encode(json.dumps({"alg": "HS256",
+                                       "typ": "JWT"}).encode())
+    payload = b64url_encode(json.dumps(claims).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = b64url_encode(hmac.new(secret.encode(), signing,
+                                 hashlib.sha256).digest())
+    return f"{header}.{payload}.{sig}"
+
+
+def validate_jwt(token: str, secret: str,
+                 bound_audiences: Optional[List[str]] = None) -> dict:
+    """HS256 validation → claims dict (authmethod/validator role)."""
+    parts = token.split(".")
+    if len(parts) != 3:
+        raise AuthError("malformed JWT")
+    header_raw, payload_raw, sig_raw = parts
+    try:
+        header = json.loads(_b64url_decode(header_raw))
+        claims = json.loads(_b64url_decode(payload_raw))
+        sig = _b64url_decode(sig_raw)
+    except (ValueError, json.JSONDecodeError):
+        raise AuthError("malformed JWT")
+    if header.get("alg") != "HS256":
+        raise AuthError(f"unsupported alg {header.get('alg')!r}")
+    signing = f"{header_raw}.{payload_raw}".encode()
+    want = hmac.new(secret.encode(), signing, hashlib.sha256).digest()
+    if not hmac.compare_digest(sig, want):
+        raise AuthError("invalid signature")
+    exp = claims.get("exp")
+    if exp is not None and time.time() > float(exp):
+        raise AuthError("token expired")
+    if bound_audiences:
+        aud = claims.get("aud")
+        auds = aud if isinstance(aud, list) else [aud]
+        if not any(a in bound_audiences for a in auds):
+            raise AuthError("audience not allowed")
+    return claims
+
+
+def map_claims(claims: dict, mappings: Dict[str, str]) -> Dict[str, str]:
+    """claim → selector variable projection (claim_mappings)."""
+    out = {}
+    for claim, var in (mappings or {}).items():
+        if claim in claims:
+            out[var] = str(claims[claim])
+    return out
+
+
+def selector_matches(selector: str, variables: Dict[str, str]) -> bool:
+    """`a==b and c==d` conjunctions over mapped variables (the
+    reference's bexpr selectors, minimal subset).  Empty = match all."""
+    if not selector.strip():
+        return True
+    for clause in selector.split(" and "):
+        m = re.fullmatch(r"\s*([\w.]+)\s*==\s*\"?([^\"]*)\"?\s*",
+                         clause)
+        if m is None:
+            return False
+        if variables.get(m.group(1)) != m.group(2):
+            return False
+    return True
+
+
+def interpolate(template: str, variables: Dict[str, str]) -> str:
+    """${var} interpolation in bind_name (HIL-lite)."""
+    return re.sub(r"\$\{([\w.]+)\}",
+                  lambda m: variables.get(m.group(1), ""), template)
+
+
+def login(store, method_name: str, bearer: str) -> Tuple[str, str, list]:
+    """Validate the bearer against the method, evaluate binding rules,
+    mint a token: returns (accessor, secret, policies).
+    (ACL.Login — acl_endpoint.go)."""
+    import uuid
+    method = store.auth_method_get(method_name)
+    if method is None:
+        raise AuthError(f"unknown auth method {method_name!r}")
+    cfg = method.get("config") or {}
+    if method.get("type") != "jwt":
+        raise AuthError(f"unsupported method type {method.get('type')!r}")
+    claims = validate_jwt(bearer, cfg.get("secret", ""),
+                          cfg.get("bound_audiences"))
+    variables = map_claims(claims, cfg.get("claim_mappings"))
+    policies: List[str] = []
+    for rule in store.binding_rule_list(method_name):
+        if not selector_matches(rule.get("selector", ""), variables):
+            continue
+        if rule.get("bind_type", "policy") == "policy":
+            name = interpolate(rule.get("bind_name", ""), variables)
+            if name:
+                policies.append(name)
+    if not policies:
+        raise AuthError("no binding rules matched the login identity")
+    accessor, secret = str(uuid.uuid4()), str(uuid.uuid4())
+    store.acl_token_set(accessor, secret, policies,
+                        description=f"token created via login: "
+                                    f"{method_name}",
+                        token_type="login", local=True)
+    return accessor, secret, policies

@@ -514,6 +514,10 @@ def _make_handler(srv: ApiServer):
             q.pop("dc", None)
             if path.startswith("/v1/kv/"):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
+            if path.startswith(("/v1/acl/login", "/v1/acl/logout",
+                                "/v1/acl/auth-method",
+                                "/v1/acl/binding-rule")):
+                return self._authmethods(verb, path, q)
             if path.startswith("/v1/acl"):
                 return self._acl(verb, path, q)
             if path in ("/ui", "/ui/", "/", "") and verb == "GET":
@@ -1056,6 +1060,8 @@ def _make_handler(srv: ApiServer):
                 body = json.loads(self._body() or b"{}")
                 kind = (body.get("Kind") or "").lower()
                 name = body.get("Name", "")
+                if not name and kind == "mesh":
+                    name = "mesh"     # MeshConfigEntry's implicit name
                 if not name:
                     # an empty name would store an entry unreachable by
                     # the single-entry GET/DELETE routes
@@ -1637,6 +1643,123 @@ def _make_handler(srv: ApiServer):
                 return True
             return False
 
+        # -------------------------------------------------- auth methods
+        # /v1/acl/auth-method*, /v1/acl/binding-rule*, /v1/acl/login,
+        # /v1/acl/logout (acl_endpoint.go Login/Logout; authmethod/)
+
+        def _authmethods(self, verb: str, path: str, q) -> bool:
+            import uuid as _uuid
+            from consul_tpu.acl import authmethod as am
+            if path == "/v1/acl/login" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                try:
+                    accessor, secret, pols = am.login(
+                        store, body.get("AuthMethod", ""),
+                        body.get("BearerToken", ""))
+                except am.AuthError as e:
+                    self._err(403, str(e))
+                    return True
+                self._send({"AccessorID": accessor, "SecretID": secret,
+                            "Policies": [{"Name": p} for p in pols],
+                            "AuthMethod": body.get("AuthMethod", "")})
+                return True
+            if path == "/v1/acl/logout" and verb == "PUT":
+                tok = store.acl_token_get_by_secret(self.token or "")
+                if tok is None or tok.get("type") != "login":
+                    self._err(403, "not a login token")
+                    return True
+                store.acl_token_delete(tok["accessor"])
+                srv.acl.invalidate(self.token)
+                self._send(True)
+                return True
+            if path == "/v1/acl/auth-method" and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                name = body.get("Name", "")
+                if not name:
+                    self._err(400, "auth method Name is required")
+                    return True
+                store.auth_method_set(
+                    name, body.get("Type", "jwt"),
+                    config=_lower_keys(body.get("Config") or {}),
+                    description=body.get("Description", ""))
+                self._send({"Name": name})
+                return True
+            if path == "/v1/acl/auth-methods" and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                self._send([_authmethod_json(e)
+                            for e in store.auth_method_list()])
+                return True
+            m = re.fullmatch(r"/v1/acl/auth-method/([^/]+)", path)
+            if m and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                e = store.auth_method_get(m.group(1))
+                if e is None:
+                    self._err(404, "auth method not found")
+                    return True
+                self._send(_authmethod_json(e))
+                return True
+            if m and verb == "PUT":
+                # update-by-path (consul acl auth-method update)
+                if not self.authz.acl_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                store.auth_method_set(
+                    m.group(1), body.get("Type", "jwt"),
+                    config=_lower_keys(body.get("Config") or {}),
+                    description=body.get("Description", ""))
+                self._send({"Name": m.group(1)})
+                return True
+            if m and verb == "DELETE":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                store.auth_method_delete(m.group(1))
+                self._send(True)
+                return True
+            if path == "/v1/acl/binding-rule" and verb == "PUT":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                rid = body.get("ID") or str(_uuid.uuid4())
+                try:
+                    store.binding_rule_set(
+                        rid, body.get("AuthMethod", ""),
+                        selector=body.get("Selector", ""),
+                        bind_type=body.get("BindType", "policy"),
+                        bind_name=body.get("BindName", ""))
+                except ValueError as e:
+                    self._err(400, str(e))
+                    return True
+                self._send({"ID": rid})
+                return True
+            if path == "/v1/acl/binding-rules" and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                self._send([_bindingrule_json(r) for r in
+                            store.binding_rule_list(q.get("authmethod"))])
+                return True
+            m = re.fullmatch(r"/v1/acl/binding-rule/([^/]+)", path)
+            if m and verb == "GET":
+                if not self.authz.acl_read():
+                    return self._forbid()
+                r = next((x for x in store.binding_rule_list()
+                          if x["id"] == m.group(1)), None)
+                if r is None:
+                    self._err(404, "binding rule not found")
+                    return True
+                self._send(_bindingrule_json(r))
+                return True
+            if m and verb == "DELETE":
+                if not self.authz.acl_write():
+                    return self._forbid()
+                store.binding_rule_delete(m.group(1))
+                self._send(True)
+                return True
+            return False
+
         # ------------------------------------------------------------- kv
 
         def _kv(self, verb: str, key: str, q) -> bool:
@@ -1748,11 +1871,16 @@ def _make_handler(srv: ApiServer):
 
 def _camel(obj):
     """snake_case → CamelCase for config entry RESPONSES, so read-then-
-    write round-trips (the reference serves CamelCase JSON)."""
+    write round-trips (the reference serves CamelCase JSON).  Values of
+    opaque keys pass through verbatim."""
     if isinstance(obj, dict):
-        return {("".join(p.capitalize() for p in k.split("_"))
-                 if isinstance(k, str) else k): _camel(v)
-                for k, v in obj.items()}
+        out = {}
+        for k, v in obj.items():
+            ck = "".join(p.capitalize() for p in k.split("_")) \
+                if isinstance(k, str) else k
+            out[ck] = v if (isinstance(k, str)
+                            and k in _OPAQUE_KEYS) else _camel(v)
+        return out
     if isinstance(obj, list):
         return [_camel(x) for x in obj]
     return obj
@@ -1770,15 +1898,43 @@ def _snake(name: str) -> str:
     return "".join(out)
 
 
-def _lower_keys(obj):
+# keys whose VALUES are opaque user maps: their inner keys must pass
+# through verbatim in both directions (proxy-defaults Config, Meta)
+_OPAQUE_KEYS = {"config", "meta"}
+
+
+def _lower_keys(obj, parent=None):
     """Config entries arrive in the reference's CamelCase JSON; the
-    store keeps snake_case (the HCL shape compile_chain reads)."""
+    store keeps snake_case (the HCL shape compile_chain reads).  Values
+    of opaque keys are preserved verbatim."""
     if isinstance(obj, dict):
-        return {_snake(k) if isinstance(k, str) else k: _lower_keys(v)
-                for k, v in obj.items()}
+        out = {}
+        for k, v in obj.items():
+            nk = _snake(k) if isinstance(k, str) else k
+            out[nk] = v if nk in _OPAQUE_KEYS else _lower_keys(v, nk)
+        return out
     if isinstance(obj, list):
-        return [_lower_keys(x) for x in obj]
+        return [_lower_keys(x, parent) for x in obj]
     return obj
+
+
+def _authmethod_json(e: dict) -> dict:
+    """CamelCase wire shape, round-trippable through PUT."""
+    return {"Name": e.get("name", ""), "Type": e.get("type", ""),
+            "Description": e.get("description", ""),
+            "Config": _camel(e.get("config") or {}),
+            "CreateIndex": e.get("create_index", 0),
+            "ModifyIndex": e.get("modify_index", 0)}
+
+
+def _bindingrule_json(r: dict) -> dict:
+    return {"ID": r.get("id", ""),
+            "AuthMethod": r.get("auth_method", ""),
+            "Selector": r.get("selector", ""),
+            "BindType": r.get("bind_type", "policy"),
+            "BindName": r.get("bind_name", ""),
+            "CreateIndex": r.get("create_index", 0),
+            "ModifyIndex": r.get("modify_index", 0)}
 
 
 def _config_json(entry: dict) -> dict:

@@ -103,6 +103,9 @@ class StateStore:
         # centralized config entries: (kind, name) -> body
         # (state/config_entry.go)
         self._config_entries: Dict[Tuple[str, str], dict] = {}
+        # auth methods + binding rules (state/acl.go auth method tables)
+        self._auth_methods: Dict[str, dict] = {}
+        self._binding_rules: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -755,6 +758,73 @@ class StateStore:
             del self._queries[qid]
             return idx
 
+    # ---------------------------------------------------------- auth methods
+    # CRUD mirrors state/acl.go ACLAuthMethod*/ACLBindingRule*
+
+    def auth_method_set(self, name: str, method_type: str,
+                        config: dict | None = None,
+                        description: str = "") -> int:
+        with self._lock:
+            idx = self._bump([("acl", f"authmethod:{name}")])
+            existing = self._auth_methods.get(name, {})
+            self._auth_methods[name] = {
+                "name": name, "type": method_type,
+                "config": config or {}, "description": description,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx}
+            return idx
+
+    def auth_method_get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            m = self._auth_methods.get(name)
+            return dict(m) if m else None
+
+    def auth_method_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for _k, v in sorted(self._auth_methods.items())]
+
+    def auth_method_delete(self, name: str) -> int:
+        with self._lock:
+            if name not in self._auth_methods:
+                return self._index
+            idx = self._bump([("acl", f"authmethod:{name}")])
+            del self._auth_methods[name]
+            for rid in [r for r, v in self._binding_rules.items()
+                        if v["auth_method"] == name]:
+                del self._binding_rules[rid]
+            return idx
+
+    def binding_rule_set(self, rid: str, auth_method: str,
+                         selector: str = "", bind_type: str = "policy",
+                         bind_name: str = "") -> int:
+        with self._lock:
+            if auth_method not in self._auth_methods:
+                raise ValueError(f"unknown auth method {auth_method!r}")
+            idx = self._bump([("acl", f"bindingrule:{rid}")])
+            existing = self._binding_rules.get(rid, {})
+            self._binding_rules[rid] = {
+                "id": rid, "auth_method": auth_method,
+                "selector": selector, "bind_type": bind_type,
+                "bind_name": bind_name,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx}
+            return idx
+
+    def binding_rule_list(self,
+                          auth_method: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            return [dict(v) for _k, v in sorted(self._binding_rules.items())
+                    if auth_method is None
+                    or v["auth_method"] == auth_method]
+
+    def binding_rule_delete(self, rid: str) -> int:
+        with self._lock:
+            if rid not in self._binding_rules:
+                return self._index
+            idx = self._bump([("acl", f"bindingrule:{rid}")])
+            del self._binding_rules[rid]
+            return idx
+
     # -------------------------------------------------------- config entries
     # CRUD mirrors state/config_entry.go (EnsureConfigEntry/ConfigEntry/
     # ConfigEntries/DeleteConfigEntry); kinds are the L7 routing trio
@@ -926,6 +996,8 @@ class StateStore:
                 "config_entries": {f"{k}\x00{n}": copy.deepcopy(v)
                                    for (k, n), v in
                                    self._config_entries.items()},
+                "auth_methods": copy.deepcopy(self._auth_methods),
+                "binding_rules": copy.deepcopy(self._binding_rules),
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -954,6 +1026,10 @@ class StateStore:
             self._config_entries = {
                 tuple(k.split("\x00")): copy.deepcopy(v)
                 for k, v in snap.get("config_entries", {}).items()}
+            self._auth_methods = copy.deepcopy(
+                snap.get("auth_methods", {}))
+            self._binding_rules = copy.deepcopy(
+                snap.get("binding_rules", {}))
             # watch bookkeeping must rewind with the index, or restored-
             # to-older stores report watch indexes beyond _index and
             # blocking queries busy-loop returning immediately

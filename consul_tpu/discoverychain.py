@@ -18,7 +18,12 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-KINDS = ("service-router", "service-splitter", "service-resolver")
+# kinds accepted by the config-entry store; the L7 trio compiles into
+# chains, the rest are stored/served for mesh-wide defaults
+# (structs/config_entry.go kinds)
+KINDS = ("service-router", "service-splitter", "service-resolver",
+         "service-defaults", "proxy-defaults", "mesh",
+         "ingress-gateway", "terminating-gateway")
 
 
 def _entry(store, kind: str, name: str) -> Optional[dict]:

@@ -1,0 +1,164 @@
+"""Auth methods: JWT validation, binding rules, login/logout.
+
+SURVEY row #28 tail ("no auth methods/OIDC").  Reference:
+agent/consul/authmethod/, ACL.Login/Logout (acl_endpoint.go), binding
+rule selectors + HIL bind-name templates.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.acl.authmethod import (
+    AuthError, interpolate, login, make_jwt, selector_matches,
+    validate_jwt,
+)
+from consul_tpu.agent import Agent
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+def test_jwt_roundtrip_and_validation():
+    tok = make_jwt({"sub": "svc-web", "aud": "consul"}, "s3cret")
+    claims = validate_jwt(tok, "s3cret", bound_audiences=["consul"])
+    assert claims["sub"] == "svc-web"
+    with pytest.raises(AuthError):
+        validate_jwt(tok, "wrong-secret")
+    with pytest.raises(AuthError):
+        validate_jwt(tok, "s3cret", bound_audiences=["other"])
+    with pytest.raises(AuthError):
+        validate_jwt("garbage", "s3cret")
+    expired = make_jwt({"sub": "x", "exp": time.time() - 10}, "s3cret")
+    with pytest.raises(AuthError):
+        validate_jwt(expired, "s3cret")
+
+
+def test_selector_and_interpolation():
+    vars_ = {"serviceaccount.name": "web", "ns": "prod"}
+    assert selector_matches('serviceaccount.name==web', vars_)
+    assert selector_matches('serviceaccount.name==web and ns==prod',
+                            vars_)
+    assert not selector_matches('ns==dev', vars_)
+    assert selector_matches('', vars_)
+    assert interpolate("svc-${serviceaccount.name}-rw", vars_) == \
+        "svc-web-rw"
+
+
+def _setup(store):
+    store.acl_policy_set("p-web", "web-rw",
+                         'service "web" { policy = "write" }')
+    store.auth_method_set("minikube", "jwt", config={
+        "secret": "k8s-secret", "bound_audiences": ["consul"],
+        "claim_mappings": {"sub": "serviceaccount.name"}})
+    store.binding_rule_set("r1", "minikube",
+                           selector="serviceaccount.name==web",
+                           bind_type="policy", bind_name="web-rw")
+
+
+def test_login_mints_token_with_bound_policies():
+    st = StateStore()
+    _setup(st)
+    bearer = make_jwt({"sub": "web", "aud": "consul"}, "k8s-secret")
+    accessor, secret, pols = login(st, "minikube", bearer)
+    assert pols == ["web-rw"]
+    tok = st.acl_token_get_by_secret(secret)
+    assert tok["type"] == "login" and tok["local"]
+
+    # identity with no matching rule is refused
+    other = make_jwt({"sub": "db", "aud": "consul"}, "k8s-secret")
+    with pytest.raises(AuthError):
+        login(st, "minikube", other)
+    # bad signature refused
+    with pytest.raises(AuthError):
+        login(st, "minikube", make_jwt({"sub": "web"}, "wrong"))
+
+
+def test_auth_method_delete_cascades_rules():
+    st = StateStore()
+    _setup(st)
+    st.auth_method_delete("minikube")
+    assert st.binding_rule_list() == []
+
+
+def test_http_login_logout_end_to_end():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=71),
+              acl_enabled=True, acl_default_policy="deny")
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        _setup(a.store)
+        base = a.http_address
+
+        def call(method, path, body=None, token=None):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode() if body else b"",
+                method=method)
+            if token:
+                req.add_header("X-Consul-Token", token)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read() or b"null")
+
+        bearer = make_jwt({"sub": "web", "aud": "consul"}, "k8s-secret")
+        out = call("PUT", "/v1/acl/login",
+                   {"AuthMethod": "minikube", "BearerToken": bearer})
+        secret = out["SecretID"]
+        assert out["Policies"] == [{"Name": "web-rw"}]
+
+        # the minted token carries real authority under default-deny
+        reg = call("PUT", "/v1/agent/service/register",
+                   {"Name": "web", "Port": 80}, token=secret)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call("PUT", "/v1/agent/service/register",
+                 {"Name": "db", "Port": 1}, token=secret)
+        assert e.value.code == 403
+
+        # logout deletes the token; it stops working
+        call("PUT", "/v1/acl/logout", token=secret)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call("PUT", "/v1/agent/service/register",
+                 {"Name": "web", "Port": 80}, token=secret)
+        assert e.value.code == 403
+    finally:
+        a.stop()
+
+
+def test_http_auth_method_roundtrip_and_opaque_config(tmp_path):
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=72))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode() if body else b"",
+                method=method)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read() or b"null")
+
+        call("PUT", "/v1/acl/auth-method",
+             {"Name": "rt", "Type": "jwt",
+              "Config": {"Secret": "s", "BoundAudiences": ["a"]}})
+        got = call("GET", "/v1/acl/auth-method/rt")
+        assert got["Name"] == "rt" and got["Type"] == "jwt"
+        # read-then-write round-trips (update-by-path route)
+        assert call("PUT", "/v1/acl/auth-method/rt",
+                    {k: v for k, v in got.items()
+                     if k not in ("CreateIndex", "ModifyIndex")})
+        # proxy-defaults opaque Config keys pass through VERBATIM
+        call("PUT", "/v1/config", {
+            "Kind": "proxy-defaults", "Name": "global",
+            "Config": {"envoy_prometheus_bind_addr": "0.0.0.0:9102"}})
+        pd = call("GET", "/v1/config/proxy-defaults/global")
+        assert pd["Config"] == {
+            "envoy_prometheus_bind_addr": "0.0.0.0:9102"}
+        # mesh kind writes with its implicit name
+        assert call("PUT", "/v1/config", {"Kind": "mesh"})
+        assert call("GET", "/v1/config/mesh/mesh")["Kind"] == "mesh"
+    finally:
+        a.stop()

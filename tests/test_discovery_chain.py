@@ -94,7 +94,11 @@ def test_config_entries_survive_snapshot():
 def test_unknown_kind_rejected():
     st = StateStore()
     with pytest.raises(ValueError):
-        st.config_entry_set("proxy-defaults", "global", {})
+        st.config_entry_set("no-such-kind", "global", {})
+    # mesh-wide default kinds store fine (structs config kinds)
+    st.config_entry_set("proxy-defaults", "global",
+                        {"config": {"protocol": "http"}})
+    assert st.config_entry_get("proxy-defaults", "global")
 
 
 def test_http_config_and_chain_end_to_end():
