@@ -61,6 +61,10 @@ def validate_jwt(token: str, secret: str,
         sig = _b64url_decode(sig_raw)
     except (ValueError, json.JSONDecodeError):
         raise AuthError("malformed JWT")
+    # attacker-shaped tokens must fail AUTH, not 500: enforce dict
+    # payloads and numeric exp before touching them
+    if not isinstance(header, dict) or not isinstance(claims, dict):
+        raise AuthError("malformed JWT")
     if header.get("alg") != "HS256":
         raise AuthError(f"unsupported alg {header.get('alg')!r}")
     signing = f"{header_raw}.{payload_raw}".encode()
@@ -68,8 +72,13 @@ def validate_jwt(token: str, secret: str,
     if not hmac.compare_digest(sig, want):
         raise AuthError("invalid signature")
     exp = claims.get("exp")
-    if exp is not None and time.time() > float(exp):
-        raise AuthError("token expired")
+    if exp is not None:
+        try:
+            expired = time.time() > float(exp)
+        except (TypeError, ValueError):
+            raise AuthError("malformed exp claim")
+        if expired:
+            raise AuthError("token expired")
     if bound_audiences:
         aud = claims.get("aud")
         auds = aud if isinstance(aud, list) else [aud]
@@ -103,9 +112,18 @@ def selector_matches(selector: str, variables: Dict[str, str]) -> bool:
 
 
 def interpolate(template: str, variables: Dict[str, str]) -> str:
-    """${var} interpolation in bind_name (HIL-lite)."""
-    return re.sub(r"\$\{([\w.]+)\}",
-                  lambda m: variables.get(m.group(1), ""), template)
+    """${var} interpolation in bind_name (HIL-lite).  A missing variable
+    raises — substituting "" would mint tokens bound to nonexistent
+    policy names (the reference fails login on unavailable vars)."""
+
+    def sub(m):
+        var = m.group(1)
+        if var not in variables:
+            raise AuthError(f"bind name variable ${{{var}}} not mapped "
+                            f"from the login identity")
+        return variables[var]
+
+    return re.sub(r"\$\{([\w.]+)\}", sub, template)
 
 
 def login(store, method_name: str, bearer: str) -> Tuple[str, str, list]:

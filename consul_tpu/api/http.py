@@ -78,6 +78,18 @@ class NullOracle:
     def sort_by_rtt(self, origin, names):
         return list(names)
 
+    def keyring_list(self):
+        return {"Keys": {}, "PrimaryKeys": {}, "NumNodes": 0}
+
+    def keyring_install(self, key):
+        pass
+
+    def keyring_use(self, key):
+        raise KeyError("no keyring")
+
+    def keyring_remove(self, key):
+        pass
+
 
 class ApiServer:
     """Threaded HTTP server bound to an ephemeral or fixed port.
@@ -610,6 +622,36 @@ def _make_handler(srv: ApiServer):
                 finally:
                     if mon is not None:
                         mon.stop()
+                return True
+            if path == "/v1/operator/keyring":
+                # gossip keyring management (operator_endpoint.go
+                # KeyringOperation; keyring:read/write ACLs)
+                if verb == "GET":
+                    if not self.authz.keyring_read():
+                        return self._forbid()
+                    self._send([dict(oracle.keyring_list(),
+                                     WAN=False, Datacenter=srv.dc)])
+                    return True
+                body = json.loads(self._body() or b"{}")
+                key = body.get("Key", "")
+                if not self.authz.keyring_write():
+                    return self._forbid()
+                # the dispatcher folds POST into PUT; the keyring verbs
+                # genuinely differ, so use the raw request method
+                raw_verb = self.command
+                try:
+                    if raw_verb == "POST":
+                        oracle.keyring_install(key)
+                    elif raw_verb == "PUT":
+                        oracle.keyring_use(key)
+                    elif raw_verb == "DELETE":
+                        oracle.keyring_remove(key)
+                    else:
+                        return False
+                except (KeyError, ValueError) as e:
+                    self._err(400, str(e))
+                    return True
+                self._send(None)
                 return True
             if path == "/v1/operator/autopilot/health" and verb == "GET":
                 if not self.authz.operator_read():
@@ -1703,9 +1745,14 @@ def _make_handler(srv: ApiServer):
                 self._send(_authmethod_json(e))
                 return True
             if m and verb == "PUT":
-                # update-by-path (consul acl auth-method update)
+                # update-by-path (consul acl auth-method update): a typo'd
+                # name silently creating a drifting duplicate is the
+                # failure mode the 404 prevents
                 if not self.authz.acl_write():
                     return self._forbid()
+                if store.auth_method_get(m.group(1)) is None:
+                    self._err(404, "auth method not found")
+                    return True
                 body = json.loads(self._body() or b"{}")
                 store.auth_method_set(
                     m.group(1), body.get("Type", "jwt"),
@@ -1899,11 +1946,12 @@ def _snake(name: str) -> str:
 
 
 # keys whose VALUES are opaque user maps: their inner keys must pass
-# through verbatim in both directions (proxy-defaults Config, Meta)
-_OPAQUE_KEYS = {"config", "meta"}
+# through verbatim in both directions (proxy-defaults Config, Meta,
+# auth-method claim mappings — claim names are IdP identifiers)
+_OPAQUE_KEYS = {"config", "meta", "claim_mappings"}
 
 
-def _lower_keys(obj, parent=None):
+def _lower_keys(obj):
     """Config entries arrive in the reference's CamelCase JSON; the
     store keeps snake_case (the HCL shape compile_chain reads).  Values
     of opaque keys are preserved verbatim."""
@@ -1911,10 +1959,10 @@ def _lower_keys(obj, parent=None):
         out = {}
         for k, v in obj.items():
             nk = _snake(k) if isinstance(k, str) else k
-            out[nk] = v if nk in _OPAQUE_KEYS else _lower_keys(v, nk)
+            out[nk] = v if nk in _OPAQUE_KEYS else _lower_keys(v)
         return out
     if isinstance(obj, list):
-        return [_lower_keys(x, parent) for x in obj]
+        return [_lower_keys(x) for x in obj]
     return obj
 
 

@@ -315,6 +315,34 @@ def cmd_exec(args) -> int:
     return 0
 
 
+def cmd_keyring(args) -> int:
+    """consul keyring (command/keyring): gossip key lifecycle."""
+    c = _client(args)
+    if args.list_keys:
+        rings = c._call("GET", "/v1/operator/keyring")[0]
+        for ring in rings:
+            print(f"{ring['Datacenter']} (LAN):")
+            for k, n in ring["Keys"].items():
+                print(f"  {k} [{n}/{ring['NumNodes']}]")
+        return 0
+    body = None
+    verb = None
+    if args.install:
+        verb, body = "POST", {"Key": args.install}
+    elif args.use:
+        verb, body = "PUT", {"Key": args.use}
+    elif args.remove:
+        verb, body = "DELETE", {"Key": args.remove}
+    else:
+        print("one of -list, -install, -use, -remove required",
+              file=sys.stderr)
+        return 2
+    c._call(verb, "/v1/operator/keyring", None,
+            json.dumps(body).encode())
+    print("Keyring operation completed")
+    return 0
+
+
 def cmd_monitor(args) -> int:
     """consul monitor (command/monitor): stream agent logs."""
     import urllib.request
@@ -654,6 +682,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("command")
     sp.add_argument("-wait", type=float, default=10.0)
     sp.set_defaults(fn=cmd_exec)
+
+    sp = sub.add_parser("keyring")
+    sp.add_argument("-list", dest="list_keys", action="store_true")
+    sp.add_argument("-install", default=None)
+    sp.add_argument("-use", default=None)
+    sp.add_argument("-remove", default=None)
+    sp.set_defaults(fn=cmd_keyring)
 
     sp = sub.add_parser("monitor")
     sp.add_argument("-log-level", default="INFO")

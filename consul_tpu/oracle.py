@@ -44,6 +44,11 @@ class GossipOracle:
         self._ids: Dict[str, int] = {v: k for k, v in self._names.items()}
         self._events: List[dict] = []           # host-side payload ring
         self._event_ring = 256                  # reference ring size
+        # gossip keyring (serf keyring: install/use/remove/list — the
+        # sim carries no ciphertext, but key lifecycle state is the
+        # operator surface, agent/keyring.go)
+        self._keyring: List[str] = []
+        self._primary_key: Optional[str] = None
         self._thread: Optional[threading.Thread] = None
         self._running = False
 
@@ -284,6 +289,35 @@ class GossipOracle:
             return float(events_model.coverage(
                 self.params.events, st.events, int(hit[0]),
                 st.swim.up, st.swim.member))
+
+    # --------------------------------------------------------------- keyring
+
+    def keyring_list(self) -> dict:
+        with self._lock:
+            return {"Keys": {k: self.sim.n_nodes for k in self._keyring},
+                    "PrimaryKeys": ({self._primary_key: self.sim.n_nodes}
+                                    if self._primary_key else {}),
+                    "NumNodes": self.sim.n_nodes}
+
+    def keyring_install(self, key: str) -> None:
+        with self._lock:
+            if key not in self._keyring:
+                self._keyring.append(key)
+            if self._primary_key is None:
+                self._primary_key = key
+
+    def keyring_use(self, key: str) -> None:
+        with self._lock:
+            if key not in self._keyring:
+                raise KeyError(f"key not installed")
+            self._primary_key = key
+
+    def keyring_remove(self, key: str) -> None:
+        with self._lock:
+            if key == self._primary_key:
+                raise ValueError("cannot remove the primary key")
+            if key in self._keyring:
+                self._keyring.remove(key)
 
     # ------------------------------------------------------------------ misc
 

@@ -162,3 +162,70 @@ def test_http_auth_method_roundtrip_and_opaque_config(tmp_path):
         assert call("GET", "/v1/config/mesh/mesh")["Kind"] == "mesh"
     finally:
         a.stop()
+
+
+def test_malformed_tokens_fail_auth_not_500():
+    from consul_tpu.acl.authmethod import b64url_encode
+    import hashlib
+    import hmac as _hmac
+
+    def signed(header, payload, secret="s"):
+        h = b64url_encode(json.dumps(header).encode())
+        p = b64url_encode(json.dumps(payload).encode())
+        sig = b64url_encode(_hmac.new(secret.encode(),
+                                      f"{h}.{p}".encode(),
+                                      hashlib.sha256).digest())
+        return f"{h}.{p}.{sig}"
+
+    from consul_tpu.acl.authmethod import AuthError, validate_jwt
+    with pytest.raises(AuthError):       # non-numeric exp
+        validate_jwt(signed({"alg": "HS256"}, {"exp": "abc"}), "s")
+    with pytest.raises(AuthError):       # array payload
+        validate_jwt(signed({"alg": "HS256"}, []) if False else
+                     signed({"alg": "HS256"}, {"a": 1}).rsplit(".", 2)[0]
+                     + "." + "WyJ4Il0" + ".x", "s")
+    with pytest.raises(AuthError):       # alg none
+        validate_jwt(signed({"alg": "none"}, {}), "s")
+
+
+def test_unmapped_bind_variable_fails_login():
+    st = StateStore()
+    st.acl_policy_set("p1", "svc-web-rw", "")
+    st.auth_method_set("m", "jwt", config={
+        "secret": "s", "claim_mappings": {"sub": "name"}})
+    st.binding_rule_set("r", "m", selector="",
+                        bind_name="svc-${missing.var}-rw")
+    bearer = make_jwt({"sub": "web"}, "s")
+    with pytest.raises(AuthError):
+        login(st, "m", bearer)
+
+
+def test_claim_mapping_keys_survive_camelcase_roundtrip():
+    import urllib.request
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=73))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path,
+                data=json.dumps(body).encode() if body else b"",
+                method=method)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read() or b"null")
+
+        call("PUT", "/v1/acl/auth-method", {
+            "Name": "oidc-ish", "Type": "jwt",
+            "Config": {"Secret": "x",
+                       "ClaimMappings": {"preferredUsername": "user"}}})
+        got = call("GET", "/v1/acl/auth-method/oidc-ish")
+        # claim names are IdP identifiers: NEVER case-rewritten
+        assert got["Config"]["ClaimMappings"] == {
+            "preferredUsername": "user"}
+        m = a.store.auth_method_get("oidc-ish")
+        assert m["config"]["claim_mappings"] == {
+            "preferredUsername": "user"}
+    finally:
+        a.stop()

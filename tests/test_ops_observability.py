@@ -201,3 +201,41 @@ def test_operator_endpoints_on_server_backed_api():
             api.stop()
     finally:
         c.stop()
+
+
+def test_keyring_lifecycle_http():
+    import json
+    import urllib.request
+    import urllib.error
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=81))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+
+        def call(verb, body=None):
+            req = urllib.request.Request(
+                base + "/v1/operator/keyring",
+                data=json.dumps(body).encode() if body else None,
+                method=verb)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read() or b"null")
+
+        call("POST", {"Key": "k1=="})
+        call("POST", {"Key": "k2=="})
+        rings = call("GET")
+        assert set(rings[0]["Keys"]) == {"k1==", "k2=="}
+        assert list(rings[0]["PrimaryKeys"]) == ["k1=="]
+        call("PUT", {"Key": "k2=="})           # use
+        assert list(call("GET")[0]["PrimaryKeys"]) == ["k2=="]
+        call("DELETE", {"Key": "k1=="})
+        assert set(call("GET")[0]["Keys"]) == {"k2=="}
+        # removing the primary key is refused
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call("DELETE", {"Key": "k2=="})
+        assert e.value.code == 400
+    finally:
+        a.stop()
