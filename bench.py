@@ -20,6 +20,7 @@ import numpy as np
 
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.models import serf, swim
+from consul_tpu.utils import hard_sync
 
 N = 1_000_000
 TARGET_S = 10.0
@@ -37,11 +38,15 @@ def main():
     s = serf.init_state(params)
     run = jax.jit(serf.run, static_argnums=(0, 2, 3))
 
-    # warm start: steady-state gossip + compile the exact timed shape
+    # warm start: steady-state gossip + compile the exact timed shape.
+    # HARD sync via host transfer — block_until_ready through the remote
+    # tunnel returns early, which silently folded the warm scan and the
+    # eager kill dispatch into the timed window
     s, _ = run(params, s, CHUNK, VICTIM)
-    jax.block_until_ready(s)
+    hard_sync(s)
 
     s = s.replace(swim=swim.kill(s.swim, VICTIM))
+    hard_sync(s.swim.up)   # fence the kill's OUTPUT, not a stale buffer
     t0 = time.time()
     ticks = 0
     frac = 0.0

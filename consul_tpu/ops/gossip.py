@@ -46,9 +46,10 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
     """
     fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
-    got = rolls.pull(serve, offsets[0])
-    for g in range(1, fanout):
-        got = got | rolls.pull(serve, offsets[g])
+    views = rolls.pull_multi(serve, offsets)
+    got = views[0]
+    for v in views[1:]:
+        got = got | v
     received = got & receiver_ok[:, None] & slot_active[None, :]
     newly = received & ~know
     new_know = know | newly

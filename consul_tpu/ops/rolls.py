@@ -27,15 +27,23 @@ def offsets(key, n: int, k: int) -> jnp.ndarray:
     return jax.random.randint(key, (k,), 1, n, dtype=jnp.int32)
 
 
-def pull(mat: jnp.ndarray, d) -> jnp.ndarray:
-    """Row view from each node's ring peer: out[i] = mat[(i + d) % N].
-
-    `d` may be traced.  Lowers to two dynamic slices over a doubled
-    buffer — sequential HBM traffic, no gather."""
+def pull_multi(mat: jnp.ndarray, offsets) -> list:
+    """k ring views sharing ONE doubled buffer: out[g][i] =
+    mat[(i + offsets[g]) % N].  Offsets may be traced.  Lowers to
+    dynamic slices over the doubled buffer — sequential HBM traffic, no
+    gather (and one copy of the lowering for every caller)."""
     n = mat.shape[0]
-    d = jnp.asarray(d, jnp.int32) % n
     doubled = jnp.concatenate([mat, mat], axis=0)
-    return jax.lax.dynamic_slice_in_dim(doubled, d, n, axis=0)
+    out = []
+    for g in range(len(offsets)):
+        d = jnp.asarray(offsets[g], jnp.int32) % n
+        out.append(jax.lax.dynamic_slice_in_dim(doubled, d, n, axis=0))
+    return out
+
+
+def pull(mat: jnp.ndarray, d) -> jnp.ndarray:
+    """Row view from each node's ring peer: out[i] = mat[(i + d) % N]."""
+    return pull_multi(mat, [d])[0]
 
 
 def push(mat: jnp.ndarray, d) -> jnp.ndarray:

@@ -72,7 +72,8 @@ class GossipOracle:
                 # on the device (a superseded array still bounds the
                 # queue).
                 state = self._state
-                jax.block_until_ready(state.swim.tick)
+                from consul_tpu.utils import hard_sync
+                hard_sync(state.swim.tick)
                 if tick_seconds > 0:
                     time.sleep(max(0.0, tick_seconds - (time.time() - t0)))
                 else:

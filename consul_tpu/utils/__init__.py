@@ -1,3 +1,4 @@
 from consul_tpu.utils import prng
+from consul_tpu.utils.sync import hard_sync
 
-__all__ = ["prng"]
+__all__ = ["prng", "hard_sync"]

@@ -1,0 +1,22 @@
+"""Device synchronization that actually synchronizes.
+
+`jax.block_until_ready` can return before remote-tunnel execution
+finishes (observed under the axon backend), silently folding unfinished
+device work into whatever the caller times next.  `hard_sync` forces a
+host transfer of (a leaf of) the value, which cannot complete before the
+producing computation has.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def hard_sync(tree) -> None:
+    """Block until every leaf of `tree` has materialized, via a host
+    transfer of each leaf's first element (tiny, but a true fence)."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        arr = np.asarray(leaf if getattr(leaf, "ndim", 0) == 0
+                         else leaf.ravel()[:1])
+        del arr

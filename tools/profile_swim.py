@@ -22,12 +22,13 @@ from consul_tpu.models import events, serf, swim, vivaldi
 
 
 def timeit(fn, *args, reps=20):
+    from consul_tpu.utils import hard_sync
     out = fn(*args)          # compile
-    jax.block_until_ready(out)
+    hard_sync(out)
     t0 = time.perf_counter()
     for _ in range(reps):
         out = fn(*args)
-    jax.block_until_ready(out)
+    hard_sync(out)           # block_until_ready lies over the tunnel
     return (time.perf_counter() - t0) / reps
 
 
