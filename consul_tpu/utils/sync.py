@@ -3,8 +3,14 @@
 `jax.block_until_ready` can return before remote-tunnel execution
 finishes (observed under the axon backend), silently folding unfinished
 device work into whatever the caller times next.  `hard_sync` forces a
-host transfer of (a leaf of) the value, which cannot complete before the
-producing computation has.
+host transfer, which cannot complete before the producing computation
+has.
+
+Cost model matters under a remote tunnel: every transfer pays an RTT.
+Outputs of ONE jit call complete atomically before any of them can
+transfer, so fencing a single leaf fences the whole call — the default.
+Pass all_leaves=True only when the tree mixes results from multiple
+dispatches.
 """
 
 from __future__ import annotations
@@ -13,10 +19,13 @@ import jax
 import numpy as np
 
 
-def hard_sync(tree) -> None:
-    """Block until every leaf of `tree` has materialized, via a host
-    transfer of each leaf's first element (tiny, but a true fence)."""
-    for leaf in jax.tree_util.tree_leaves(tree):
-        arr = np.asarray(leaf if getattr(leaf, "ndim", 0) == 0
-                         else leaf.ravel()[:1])
-        del arr
+def hard_sync(tree, all_leaves: bool = False) -> None:
+    """Block until `tree` has materialized via host transfer of one leaf
+    (or every leaf when they may come from different dispatches)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return
+    targets = leaves if all_leaves else leaves[-1:]
+    for leaf in targets:
+        np.asarray(leaf if getattr(leaf, "ndim", 0) == 0
+                   else leaf.ravel()[:1])

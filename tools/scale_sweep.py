@@ -1,0 +1,63 @@
+"""Membership-scale sweep: per-tick cost + convergence across N.
+
+The scaling story (SURVEY §5.7): detection latency grows ~log N while
+per-tick device cost grows linearly in state size.  This sweep measures
+both on the attached chip so regressions in either curve are visible.
+
+Usage: python tools/scale_sweep.py [Ns...]   (default 1e5 5e5 1e6 2e6)
+Prints one JSON line per N.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import numpy as np
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf, swim
+from consul_tpu.utils import hard_sync
+
+
+def sweep(n: int) -> dict:
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=n, rumor_slots=32,
+                                        alloc_cap=8, p_loss=0.01, seed=7))
+    s = serf.init_state(params)
+    run = jax.jit(serf.run, static_argnums=(0, 2, 3))
+    victim = n // 3
+    ticks = 250               # ONE compiled shape for warm/timed/converge
+    s, _ = run(params, s, ticks, victim)
+    hard_sync(s)
+    # per-tick cost (steady state)
+    t0 = time.perf_counter()
+    s2, _ = run(params, s, ticks, victim)
+    hard_sync(s2)
+    per_tick_ms = (time.perf_counter() - t0) / ticks * 1000
+    # convergence after a crash
+    s = s.replace(swim=swim.kill(s.swim, victim))
+    hard_sync(s.swim.up)
+    t0 = time.time()
+    s, fr = run(params, s, ticks, victim)
+    fr = np.asarray(fr)
+    wall = time.time() - t0
+    conv_tick = int(np.argmax(fr > 0.999)) + 1 if (fr > 0.999).any() \
+        else -1
+    return {"n_nodes": n, "per_tick_ms": round(per_tick_ms, 3),
+            "convergence_ticks": conv_tick,
+            "convergence_wall_s": round(wall, 3),
+            "converged": bool((fr > 0.999).any())}
+
+
+def main():
+    ns = [int(float(x)) for x in sys.argv[1:]] or \
+        [100_000, 500_000, 1_000_000, 2_000_000]
+    for n in ns:
+        print(json.dumps(sweep(n)))
+
+
+if __name__ == "__main__":
+    main()
