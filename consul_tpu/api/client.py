@@ -78,15 +78,23 @@ class Client:
         return row, idx
 
     def kv_list(self, prefix: str) -> List[dict]:
+        return self.kv_list_blocking(prefix)[0]
+
+    def kv_list_blocking(self, prefix: str, index: Optional[int] = None,
+                         wait: Optional[str] = None):
+        """Recurse read returning (rows, index) — the watch-loop shape
+        (one return type; kv_list is the rows-only convenience)."""
         try:
-            out, _, _ = self._call("GET", f"/v1/kv/{prefix}", {"recurse": ""})
+            out, idx, _ = self._call("GET", f"/v1/kv/{prefix}",
+                                     {"recurse": "", "index": index,
+                                      "wait": wait})
         except ApiError as e:
             if e.code == 404:
-                return []
+                return [], 0
             raise
         for row in out:
             row["Value"] = base64.b64decode(row["Value"]) if row["Value"] else b""
-        return out
+        return out, idx
 
     def kv_keys(self, prefix: str, separator: str = "") -> List[str]:
         try:

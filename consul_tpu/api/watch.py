@@ -1,0 +1,153 @@
+"""Watch plans: long-poll loops over every watchable query type.
+
+The reference's watch package (api/watch/watch.go:21 Parse, :132 the
+per-type watcher funcs) drives blocking queries in a loop and invokes a
+handler on every index change; `consul watch` and the agent's `watches`
+config both ride it.  Types: key, keyprefix, services, nodes, service,
+checks, event.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+Handler = Callable[[int, Any], None]
+
+
+class WatchPlan:
+    def __init__(self, client, watch_type: str, wait: str = "30s",
+                 **params: Any):
+        if watch_type not in WATCH_FUNCS:
+            raise ValueError(
+                f"unsupported watch type {watch_type!r}; "
+                f"one of {sorted(WATCH_FUNCS)}")
+        missing = [r for r in REQUIRED_PARAMS[watch_type]
+                   if not params.get(r)]
+        if missing:
+            raise ValueError(
+                f"watch type {watch_type!r} requires "
+                f"{', '.join('-' + m for m in missing)}")
+        self.client = client
+        self.type = watch_type
+        self.params = params
+        self.wait = wait
+        self._stop = threading.Event()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, handler: Handler,
+            max_events: Optional[int] = None) -> int:
+        """Blocking loop: handler(index, result) on each change; returns
+        the number of events delivered."""
+        fetch = WATCH_FUNCS[self.type]
+        index: Optional[int] = None
+        delivered = 0
+        last = object()
+        while not self._stop.is_set():
+            result, new_index = fetch(self.client, index, self.wait,
+                                      self.params)
+            # a wait timeout returns the advanced GLOBAL index, so index
+            # motion alone is not a change — the result must differ
+            changed = index is None or result != last
+            index = new_index
+            if new_index <= 0:
+                # nothing to block on server-side (nonexistent key):
+                # pace the poll instead of hot-looping
+                self._stop.wait(min(_parse_wait_s(self.wait), 1.0))
+            if changed:
+                last = result
+                handler(new_index, result)
+                delivered += 1
+                if max_events is not None and delivered >= max_events:
+                    return delivered
+        return delivered
+
+
+# ------------------------------------------------------------ type funcs
+
+def _key(client, index, wait, p) -> Tuple[Any, int]:
+    row, idx = client.kv_get(p["key"], index=index, wait=wait)
+    if row is None:
+        return None, idx
+    value = row.get("Value")
+    # empty value decodes to "" — only a MISSING row maps to None
+    return {"Key": p["key"],
+            "Value": value.decode(errors="replace")
+            if value is not None else ""}, idx
+
+
+def _keyprefix(client, index, wait, p) -> Tuple[Any, int]:
+    rows, idx = client.kv_list_blocking(p["prefix"], index=index,
+                                        wait=wait)
+    return ([{"Key": r["Key"],
+              "Value": r["Value"].decode(errors="replace")
+              if r.get("Value") else None} for r in rows], idx)
+
+
+def _services(client, index, wait, p) -> Tuple[Any, int]:
+    out, idx, _ = client._call("GET", "/v1/catalog/services",
+                               {"index": index, "wait": wait})
+    return out, idx
+
+
+def _nodes(client, index, wait, p) -> Tuple[Any, int]:
+    out, idx, _ = client._call("GET", "/v1/catalog/nodes",
+                               {"index": index, "wait": wait})
+    return out, idx
+
+
+def _service(client, index, wait, p) -> Tuple[Any, int]:
+    out, idx, _ = client._call(
+        "GET", f"/v1/health/service/{p['service']}",
+        {"index": index, "wait": wait,
+         "tag": p.get("tag"),
+         "passing": "" if p.get("passing") else None})
+    return out, idx
+
+
+def _checks(client, index, wait, p) -> Tuple[Any, int]:
+    state = p.get("state", "any")
+    out, idx, _ = client._call("GET", f"/v1/health/state/{state}",
+                               {"index": index, "wait": wait})
+    return out, idx
+
+
+def _event(client, index, wait, p) -> Tuple[Any, int]:
+    # user events carry no blocking index in the oracle ring: poll and
+    # synthesize an index from the newest event id (watch.go's event
+    # watch also tracks its own high-water mark)
+    import time as _time
+    out, _idx, _ = client._call("GET", "/v1/event/list",
+                                {"name": p.get("name")})
+    top = max((int(e["ID"]) for e in out), default=0)
+    if index is not None and top <= index:
+        _time.sleep(min(_parse_wait_s(wait), 1.0))
+    return out, top
+
+
+def _parse_wait_s(wait: str) -> float:
+    import re
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", wait)
+    if not m:
+        return 1.0
+    scale = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+    return float(m.group(1)) * scale[m.group(2) or "s"]
+
+
+# per-type required parameters (Parse-time validation, watch.go:21)
+REQUIRED_PARAMS: Dict[str, tuple] = {
+    "key": ("key",), "keyprefix": ("prefix",), "service": ("service",),
+    "services": (), "nodes": (), "checks": (), "event": (),
+}
+
+WATCH_FUNCS: Dict[str, Callable] = {
+    "key": _key,
+    "keyprefix": _keyprefix,
+    "services": _services,
+    "nodes": _nodes,
+    "service": _service,
+    "checks": _checks,
+    "event": _event,
+}

@@ -245,19 +245,28 @@ def cmd_lock(args) -> int:
 
 
 def cmd_watch(args) -> int:
-    """consul watch -type=key (command/watch, api/watch/watch.go:21)."""
+    """consul watch over every plan type (command/watch,
+    api/watch/watch.go:21,132)."""
+    from consul_tpu.api.watch import WatchPlan
     c = _client(args)
-    idx = None
-    n = 0
-    while True:
-        row, idx = c.kv_get(args.key, index=idx, wait=args.wait)
-        print(json.dumps({"Key": args.key,
-                          "Value": row["Value"].decode(errors="replace")
-                          if row else None, "Index": idx}))
+    params = {k: v for k, v in {
+        "key": args.key, "prefix": args.prefix,
+        "service": args.service, "tag": args.tag,
+        "state": args.state, "name": args.name,
+        "passing": args.passing}.items() if v}
+    try:
+        plan = WatchPlan(c, args.type, wait=args.wait, **params)
+    except ValueError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+
+    def handler(index, result):
+        print(json.dumps({"Index": index, "Result": result}))
         sys.stdout.flush()
-        n += 1
-        if args.once or (args.max_events and n >= args.max_events):
-            return 0
+
+    plan.run(handler,
+             max_events=1 if args.once else (args.max_events or None))
+    return 0
 
 
 def cmd_force_leave(args) -> int:
@@ -667,7 +676,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.set_defaults(fn=cmd_lock)
 
     sp = sub.add_parser("watch")
-    sp.add_argument("-key", required=True)
+    sp.add_argument("-type", default="key",
+                    choices=["key", "keyprefix", "services", "nodes",
+                             "service", "checks", "event"])
+    sp.add_argument("-key", default=None)
+    sp.add_argument("-prefix", default=None)
+    sp.add_argument("-service", default=None)
+    sp.add_argument("-tag", default=None)
+    sp.add_argument("-state", default=None)
+    sp.add_argument("-name", default=None)
+    sp.add_argument("-passing", action="store_true")
     sp.add_argument("-wait", default="60s")
     sp.add_argument("-once", action="store_true")
     sp.add_argument("--max-events", type=int, default=0)

@@ -1,0 +1,81 @@
+"""Watch plans over every query type (api/watch/watch.go parity)."""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.api.watch import WatchPlan
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=91))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    yield a
+    a.stop()
+
+
+def _collect(plan, n, trigger=None, delay=0.3):
+    got = []
+    t = threading.Thread(
+        target=lambda: plan.run(lambda i, r: got.append((i, r)),
+                                max_events=n))
+    t.start()
+    if trigger is not None:
+        time.sleep(delay)
+        trigger()
+    t.join(15.0)
+    plan.stop()
+    return got
+
+
+def test_key_watch_fires_on_change(agent):
+    c = Client(agent.http_address)
+    c.kv_put("w/k1", b"v1")
+    plan = WatchPlan(c, "key", wait="5s", key="w/k1")
+    got = _collect(plan, 2, trigger=lambda: c.kv_put("w/k1", b"v2"))
+    assert len(got) == 2
+    assert got[0][1]["Value"] == "v1"
+    assert got[1][1]["Value"] == "v2"
+
+
+def test_keyprefix_watch(agent):
+    c = Client(agent.http_address)
+    c.kv_put("wp/a", b"1")
+    plan = WatchPlan(c, "keyprefix", wait="5s", prefix="wp/")
+    got = _collect(plan, 2, trigger=lambda: c.kv_put("wp/b", b"2"))
+    assert len(got) == 2
+    assert {r["Key"] for r in got[1][1]} == {"wp/a", "wp/b"}
+
+
+def test_service_watch(agent):
+    c = Client(agent.http_address)
+    agent.store.register_service("n1", "ws1", "watched", port=80)
+    plan = WatchPlan(c, "service", wait="5s", service="watched")
+    got = _collect(plan, 2, trigger=lambda: agent.store.register_check(
+        "n1", "wc", "c", status="critical", service_id="ws1"))
+    assert len(got) == 2
+    assert got[1][1][0]["Checks"][0]["Status"] == "critical"
+
+
+def test_services_and_nodes_watch(agent):
+    c = Client(agent.http_address)
+    plan = WatchPlan(c, "services", wait="5s")
+    got = _collect(plan, 2, trigger=lambda: agent.store.register_service(
+        "n2", "nsvc1", "new-svc", port=1))
+    assert "new-svc" in got[1][1]
+
+    plan = WatchPlan(c, "nodes", wait="5s")
+    got = _collect(plan, 2, trigger=lambda: agent.store.register_node(
+        "brand-new-node", "10.9.9.9"))
+    assert any(n["Node"] == "brand-new-node" for n in got[1][1])
+
+
+def test_unknown_type_rejected():
+    with pytest.raises(ValueError):
+        WatchPlan(None, "nope")

@@ -46,9 +46,14 @@ def sweep(n: int) -> dict:
     wall = time.time() - t0
     conv_tick = int(np.argmax(fr > 0.999)) + 1 if (fr > 0.999).any() \
         else -1
+    # the scan always runs the full `ticks`; time-to-convergence is the
+    # honest headline (conv_tick x measured per-tick cost)
+    conv_wall = round(conv_tick * per_tick_ms / 1000.0, 3) \
+        if conv_tick > 0 else -1.0
     return {"n_nodes": n, "per_tick_ms": round(per_tick_ms, 3),
             "convergence_ticks": conv_tick,
-            "convergence_wall_s": round(wall, 3),
+            "convergence_wall_s": conv_wall,
+            "scan_wall_s": round(wall, 3),
             "converged": bool((fr > 0.999).any())}
 
 
