@@ -16,7 +16,10 @@ import threading
 from typing import Optional, Tuple
 
 
-class AclReplicator:
+class Replicator:
+    """Shared rate-limited round loop (replication.go Replicator):
+    subclasses implement run_once() -> (upserts, deletes)."""
+
     def __init__(self, primary_store, secondary_store,
                  interval: float = 30.0):
         self.primary = primary_store
@@ -25,6 +28,33 @@ class AclReplicator:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_round: Tuple[int, int] = (0, 0)  # (upserts, deletes)
+
+    def run_once(self) -> Tuple[int, int]:  # pragma: no cover
+        raise NotImplementedError
+
+    def start(self) -> None:
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.run_once()
+                except Exception:
+                    pass  # rate-limited retry next round (replication.go)
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            if not self._thread.is_alive():
+                self._thread = None
+
+
+class AclReplicator(Replicator):
 
     # ------------------------------------------------------------ one round
 
@@ -75,25 +105,30 @@ class AclReplicator:
         self.last_round = (ups, dels)
         return ups, dels
 
-    # ------------------------------------------------------------ lifecycle
 
-    def start(self) -> None:
-        self._stop.clear()
 
-        def loop():
-            while not self._stop.is_set():
-                try:
-                    self.run_once()
-                except Exception:
-                    pass  # rate-limited retry next round (replication.go)
-                self._stop.wait(self.interval)
+class FederationStateReplicator(Replicator):
+    """Primary → secondary federation-state sync
+    (agent/consul/federation_state_replication.go): each round lists the
+    primary's per-DC gateway states and upserts/deletes by content, the
+    same shape as ACL replication."""
 
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            if not self._thread.is_alive():
-                self._thread = None
+    def run_once(self):
+        ups = dels = 0
+        prim = {f["datacenter"]: f
+                for f in self.primary.federation_state_list()}
+        sec = {f["datacenter"]: f
+               for f in self.secondary.federation_state_list()}
+        for dc in set(sec) - set(prim):
+            self.secondary.federation_state_delete(dc)
+            dels += 1
+        for dc, st in prim.items():
+            mine = sec.get(dc)
+            if mine is None \
+                    or mine["mesh_gateways"] != st["mesh_gateways"] \
+                    or mine.get("updated") != st.get("updated"):
+                self.secondary.federation_state_set(
+                    dc, st["mesh_gateways"], st.get("updated", ""))
+                ups += 1
+        self.last_round = (ups, dels)
+        return ups, dels

@@ -623,6 +623,43 @@ def _make_handler(srv: ApiServer):
                     if mon is not None:
                         mon.stop()
                 return True
+            if path == "/v1/internal/federation-states" and verb == "GET":
+                # per-DC mesh gateway lists (federation_state_endpoint)
+                if not self.authz.operator_read():
+                    return self._forbid()
+                idx = self._block(q, ("federation", ""))
+                self._send([{
+                    "Datacenter": f["datacenter"],
+                    "MeshGateways": f["mesh_gateways"],
+                    "UpdatedAt": f.get("updated", ""),
+                    "ModifyIndex": f.get("modify_index", 0)}
+                    for f in store.federation_state_list()], index=idx)
+                return True
+            m = re.fullmatch(r"/v1/internal/federation-state/([^/]+)",
+                             path)
+            if m and verb == "GET":
+                if not self.authz.operator_read():
+                    return self._forbid()
+                idx = self._block(q, ("federation", m.group(1)))
+                f = store.federation_state_get(m.group(1))
+                if f is None:
+                    self._err(404, "no federation state")
+                    return True
+                self._send({"Datacenter": f["datacenter"],
+                            "MeshGateways": f["mesh_gateways"],
+                            "UpdatedAt": f.get("updated", ""),
+                            "ModifyIndex": f.get("modify_index", 0)},
+                           index=idx)
+                return True
+            if m and verb == "PUT":
+                if not self.authz.operator_write():
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                store.federation_state_set(
+                    m.group(1), body.get("MeshGateways") or [],
+                    body.get("UpdatedAt", ""))
+                self._send(True)
+                return True
             if path == "/v1/operator/keyring":
                 # gossip keyring management (operator_endpoint.go
                 # KeyringOperation; keyring:read/write ACLs)

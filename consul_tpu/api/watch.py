@@ -45,9 +45,20 @@ class WatchPlan:
         index: Optional[int] = None
         delivered = 0
         last = object()
+        backoff = 0.5
         while not self._stop.is_set():
-            result, new_index = fetch(self.client, index, self.wait,
-                                      self.params)
+            try:
+                result, new_index = fetch(self.client, index, self.wait,
+                                          self.params)
+                backoff = 0.5
+            except Exception:
+                # transient failure (agent restart, momentary 500): the
+                # reference's watch loop retries with backoff instead of
+                # dying (watch.go run loop)
+                if self._stop.wait(backoff):
+                    break
+                backoff = min(backoff * 2, 30.0)
+                continue
             # a wait timeout returns the advanced GLOBAL index, so index
             # motion alone is not a change — the result must differ
             changed = index is None or result != last
@@ -83,7 +94,8 @@ def _keyprefix(client, index, wait, p) -> Tuple[Any, int]:
                                         wait=wait)
     return ([{"Key": r["Key"],
               "Value": r["Value"].decode(errors="replace")
-              if r.get("Value") else None} for r in rows], idx)
+              if r.get("Value") is not None else ""}
+             for r in rows], idx)
 
 
 def _services(client, index, wait, p) -> Tuple[Any, int]:

@@ -106,6 +106,9 @@ class StateStore:
         # auth methods + binding rules (state/acl.go auth method tables)
         self._auth_methods: Dict[str, dict] = {}
         self._binding_rules: Dict[str, dict] = {}
+        # federation states: dc -> mesh gateway endpoints
+        # (state/federation_state.go)
+        self._federation_states: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -758,6 +761,40 @@ class StateStore:
             del self._queries[qid]
             return idx
 
+    # ------------------------------------------------------ federation states
+    # per-DC mesh gateway lists replicated from the primary
+    # (state/federation_state.go FederationStateSet/Get/List)
+
+    def federation_state_set(self, dc: str, gateways: List[dict],
+                             updated: str = "") -> int:
+        with self._lock:
+            idx = self._bump([("federation", dc)])
+            existing = self._federation_states.get(dc, {})
+            self._federation_states[dc] = {
+                "datacenter": dc, "mesh_gateways": list(gateways),
+                "updated": updated,
+                "create_index": existing.get("create_index", idx),
+                "modify_index": idx}
+            return idx
+
+    def federation_state_get(self, dc: str) -> Optional[dict]:
+        with self._lock:
+            f = self._federation_states.get(dc)
+            return dict(f) if f else None
+
+    def federation_state_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v) for _k, v in
+                    sorted(self._federation_states.items())]
+
+    def federation_state_delete(self, dc: str) -> int:
+        with self._lock:
+            if dc not in self._federation_states:
+                return self._index
+            idx = self._bump([("federation", dc)])
+            del self._federation_states[dc]
+            return idx
+
     # ---------------------------------------------------------- auth methods
     # CRUD mirrors state/acl.go ACLAuthMethod*/ACLBindingRule*
 
@@ -998,6 +1035,8 @@ class StateStore:
                                    self._config_entries.items()},
                 "auth_methods": copy.deepcopy(self._auth_methods),
                 "binding_rules": copy.deepcopy(self._binding_rules),
+                "federation_states": copy.deepcopy(
+                    self._federation_states),
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -1030,6 +1069,8 @@ class StateStore:
                 snap.get("auth_methods", {}))
             self._binding_rules = copy.deepcopy(
                 snap.get("binding_rules", {}))
+            self._federation_states = copy.deepcopy(
+                snap.get("federation_states", {}))
             # watch bookkeeping must rewind with the index, or restored-
             # to-older stores report watch indexes beyond _index and
             # blocking queries busy-loop returning immediately

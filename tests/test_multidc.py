@@ -129,3 +129,47 @@ def test_acl_token_replication_primary_to_secondary():
     assert ups == 1 and dels == 1
     assert secondary.acl_token_get("acc1") is None
     assert "write" in secondary.acl_policy_get("p1")["rules"]
+
+
+def test_federation_state_replication_and_http():
+    """Federation states: per-DC mesh gateway lists replicate primary →
+    secondary (federation_state_replication.go) and serve over HTTP."""
+    import json
+    import urllib.request
+    from consul_tpu.acl.replication import FederationStateReplicator
+
+    primary, secondary = StateStore(), StateStore()
+    primary.federation_state_set("dc1", [
+        {"Address": "10.0.0.1", "Port": 443}])
+    primary.federation_state_set("dc2", [
+        {"Address": "10.1.0.1", "Port": 443}])
+    rep = FederationStateReplicator(primary, secondary, interval=999)
+    assert rep.run_once() == (2, 0)
+    assert rep.run_once() == (0, 0)              # converged
+    primary.federation_state_delete("dc2")
+    primary.federation_state_set("dc1", [
+        {"Address": "10.0.0.9", "Port": 443}])
+    ups, dels = rep.run_once()
+    assert (ups, dels) == (1, 1)
+    assert secondary.federation_state_get("dc2") is None
+    assert secondary.federation_state_get("dc1")["mesh_gateways"][0][
+        "Address"] == "10.0.0.9"
+
+    # HTTP surface
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=99))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+        req = urllib.request.Request(
+            base + "/v1/internal/federation-state/dc7",
+            data=json.dumps({"MeshGateways": [
+                {"Address": "10.7.0.1", "Port": 8443}]}).encode(),
+            method="PUT")
+        urllib.request.urlopen(req, timeout=30)
+        out = json.loads(urllib.request.urlopen(
+            base + "/v1/internal/federation-states", timeout=30).read())
+        assert out[0]["Datacenter"] == "dc7"
+        assert out[0]["MeshGateways"][0]["Port"] == 8443
+    finally:
+        a.stop()
