@@ -1,0 +1,159 @@
+"""End-to-end mesh scenario — the envoy `case-*` integration analogue.
+
+The reference's integration tier (test/integration/connect/envoy/,
+SURVEY §4.7) drives whole scenarios: services + sidecars + intentions +
+L7 config + failover, asserting the data plane's view. This scenario
+exercises the same composition against one live agent:
+
+  1. Two app services (web → upstream api) with sidecar proxies.
+  2. xDS serves the mesh config; intentions flip the RBAC.
+  3. An L7 splitter cants traffic to a canary; the chain compiles.
+  4. The api instance fails; prepared-query failover finds the peer DC.
+  5. ACL lockdown: a login-minted token sees exactly its slice.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.acl.authmethod import make_jwt
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.router import DcHandle, WanRouter
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    primary = Agent(GossipConfig.lan(),
+                    SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0,
+                              seed=101), node_name="mesh-1", dc="dc1")
+    primary.start(tick_seconds=0.0, reconcile_interval=0.5)
+    backup = Agent(GossipConfig.lan(),
+                   SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0,
+                             seed=102), node_name="mesh-2", dc="dc2")
+    backup.start(tick_seconds=0.0, reconcile_interval=0.5)
+    r1, r2 = WanRouter("dc1"), WanRouter("dc2")
+    primary.join_wan(r1)
+    backup.join_wan(r2)
+    h2 = DcHandle("dc2", backup.store,
+                  query_executor=backup.api.query_executor)
+    h2.http_address = backup.http_address
+    r1.register(h2)
+    yield primary, backup
+    primary.stop()
+    backup.stop()
+
+
+def _call(base, method, path, body=None, token=None):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode() if body else None,
+        method=method)
+    if token:
+        req.add_header("X-Consul-Token", token)
+    return json.loads(
+        urllib.request.urlopen(req, timeout=30).read() or b"null")
+
+
+def test_full_mesh_scenario(mesh):
+    primary, backup = mesh
+    base = primary.http_address
+
+    # 1. services + sidecar
+    primary.store.register_service("mesh-1", "web1", "web", port=8080)
+    primary.store.register_service("mesh-1", "api1", "api", port=9090)
+    _call(base, "PUT", "/v1/agent/service/register", {
+        "Name": "web-proxy", "ID": "web-proxy",
+        "Kind": "connect-proxy", "Port": 21000,
+        "Proxy": {"DestinationServiceName": "web",
+                  "Upstreams": [{"DestinationName": "api",
+                                 "LocalBindPort": 9191}]}})
+
+    # 2. xDS snapshot + intention-driven RBAC flip
+    xds = _call(base, "GET", "/v1/agent/xds/web-proxy")
+    assert {"local_app", "api"} <= {c["name"]
+                                    for c in xds["Resources"]["clusters"]}
+    rbac = xds["Resources"]["listeners"][0]["filter_chains"][0][
+        "filters"][0]
+    assert rbac["rules"] == []
+    _call(base, "PUT", "/v1/connect/intentions", {
+        "SourceName": "evil", "DestinationName": "web",
+        "Action": "deny"})
+    deadline = time.time() + 10
+    rules = []
+    while time.time() < deadline and not rules:
+        xds = _call(base, "GET", "/v1/agent/xds/web-proxy")
+        rules = xds["Resources"]["listeners"][0]["filter_chains"][0][
+            "filters"][0]["rules"]
+        time.sleep(0.2)
+    assert rules and rules[0]["action"] == "DENY"
+    uri = "spiffe://x.consul/ns/default/dc/dc1/svc/evil"
+    authz = _call(base, "PUT", "/v1/agent/connect/authorize",
+                  {"Target": "web", "ClientCertURI": uri})
+    assert not authz["Authorized"]
+
+    # 3. L7 canary splitter compiles into the chain
+    _call(base, "PUT", "/v1/config", {
+        "Kind": "service-splitter", "Name": "api",
+        "Splits": [{"Weight": 90, "Service": "api"},
+                   {"Weight": 10, "Service": "api-canary"}]})
+    chain = _call(base, "GET", "/v1/discovery-chain/api")["Chain"]
+    assert chain["StartNode"] == "splitter:api"
+    weights = [s["Weight"] for s in
+               chain["Nodes"]["splitter:api"]["Splits"]]
+    assert weights == [90, 10]
+
+    # 4. local api fails; prepared query fails over to dc2
+    backup.store.register_service("mesh-2", "api-b", "api", port=9090)
+    qid = _call(base, "PUT", "/v1/query", {
+        "Name": "api-anywhere", "Service": {
+            "Service": "api",
+            "Failover": {"Datacenters": ["dc2"]}}})["ID"]
+    res = _call(base, "GET", "/v1/query/api-anywhere/execute")
+    assert res["Datacenter"] == "dc1"          # healthy locally
+    primary.store.register_check("mesh-1", "apic", "api check",
+                                 status="critical", service_id="api1")
+    res = _call(base, "GET", "/v1/query/api-anywhere/execute")
+    assert res["Datacenter"] == "dc2"          # failed over
+    assert res["Nodes"][0]["Node"] == "mesh-2"
+    _call(base, "DELETE", f"/v1/query/{qid}")
+
+
+def test_acl_login_scoped_view(mesh):
+    primary, _ = mesh
+    st = primary.store
+    # enable enforcement on the live resolver
+    primary.acl.enabled = True
+    primary.acl.default_policy = "deny"
+    primary.acl.invalidate()
+    try:
+        st.acl_policy_set("pw", "web-only",
+                          'service "web" { policy = "read" }\n'
+                          'node_prefix "" { policy = "read" }')
+        st.auth_method_set("mesh-sso", "jwt", config={
+            "secret": "sso", "claim_mappings": {"sub": "team"}})
+        st.binding_rule_set("br", "mesh-sso", selector="team==frontend",
+                            bind_name="web-only")
+        base = primary.http_address
+        out = _call(base, "PUT", "/v1/acl/login", {
+            "AuthMethod": "mesh-sso",
+            "BearerToken": make_jwt({"sub": "frontend"}, "sso")})
+        tok = out["SecretID"]
+        # the login token sees web but not the rest of the mesh config
+        rows = _call(base, "GET", "/v1/health/service/web", token=tok)
+        assert rows
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(base, "GET", "/v1/health/service/api", token=tok)
+        assert e.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _call(base, "PUT", "/v1/connect/intentions", {
+                "SourceName": "x", "DestinationName": "y",
+                "Action": "allow"}, token=tok)
+        assert e.value.code == 403
+        _call(base, "PUT", "/v1/acl/logout", token=tok)
+    finally:
+        primary.acl.enabled = False
+        primary.acl.invalidate()
