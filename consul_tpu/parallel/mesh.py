@@ -23,11 +23,58 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
+DC_AXIS = "dc"
 
 
 def make_mesh(devices: Iterable[jax.Device] | None = None) -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(devs, (NODE_AXIS,))
+
+
+def make_wan_mesh(devices: Iterable[jax.Device] | None = None,
+                  n_dcs: int = 2) -> Mesh:
+    """2-D mesh for the federation model: the vmapped per-DC batch axis
+    shards over `dc` (the multi-slice/DCN analogue) and each DC's node
+    axis over `nodes` (intra-slice ICI) — the dp x tp layout of this
+    framework's scaling story (SURVEY §2.2 cross-DC sharding)."""
+    import numpy as _np
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) % n_dcs != 0:
+        raise ValueError(f"{len(devs)} devices not divisible by "
+                         f"{n_dcs} dc shards")
+    grid = _np.array(devs).reshape(n_dcs, len(devs) // n_dcs)
+    return Mesh(grid, (DC_AXIS, NODE_AXIS))
+
+
+def wan_state_sharding(state, mesh: Mesh):
+    """NamedSharding pytree for a WanState: LAN leaves are [D, N, ...]
+    (dc-batched, node-sharded); WAN-pool leaves are [S, ...] sharded on
+    nodes; tiny tables replicate."""
+    n_dc = mesh.shape[DC_AXIS]
+    n_node = mesh.shape[NODE_AXIS]
+
+    def lan_spec(leaf):
+        if leaf.ndim >= 2 and leaf.shape[0] == n_dc \
+                and leaf.shape[1] % n_node == 0 \
+                and leaf.shape[1] > n_node:
+            return NamedSharding(mesh, P(DC_AXIS, NODE_AXIS))
+        if leaf.ndim >= 1 and leaf.shape[0] == n_dc:
+            return NamedSharding(mesh, P(DC_AXIS))
+        return NamedSharding(mesh, P())
+
+    def wan_spec(leaf):
+        if leaf.ndim >= 1 and leaf.shape[0] % n_node == 0 \
+                and leaf.shape[0] > n_node:
+            return NamedSharding(mesh, P(NODE_AXIS))
+        return NamedSharding(mesh, P())
+
+    import jax.tree_util as jtu
+    return type(state)(
+        lan=jtu.tree_map(lan_spec, state.lan),
+        wan=jtu.tree_map(wan_spec, state.wan),
+        bridged=NamedSharding(mesh, P(DC_AXIS)),
+        bridged_ptr=NamedSharding(mesh, P(DC_AXIS)),
+    )
 
 
 def state_sharding(state, mesh: Mesh):
