@@ -262,7 +262,14 @@ def _make_handler(srv: ApiServer):
             if "consistent" in q and hasattr(store, "consistent_index"):
                 idx = store.consistent_index()
                 if store.index < idx:
-                    store.wait_for(idx - 1, timeout=5.0)
+                    got = store.wait_for(idx - 1, timeout=5.0)
+                    if got < idx:
+                        # serving a stale read after an acked write is
+                        # the violation ?consistent excludes: fail loud
+                        # (consistentRead errors; clients retry on 500)
+                        raise RuntimeError(
+                            "consistent read: replica catch-up timed "
+                            "out")
 
         def _block(self, q, *watches) -> int:
             """Honor ?index/?wait before evaluating the read.

@@ -55,26 +55,30 @@ def wan_state_sharding(state, mesh: Mesh):
 
     def lan_spec(leaf):
         if leaf.ndim >= 2 and leaf.shape[0] == n_dc \
-                and leaf.shape[1] % n_node == 0 \
-                and leaf.shape[1] > n_node:
+                and _node_shardable(leaf.shape[1], n_node):
             return NamedSharding(mesh, P(DC_AXIS, NODE_AXIS))
         if leaf.ndim >= 1 and leaf.shape[0] == n_dc:
             return NamedSharding(mesh, P(DC_AXIS))
         return NamedSharding(mesh, P())
 
     def wan_spec(leaf):
-        if leaf.ndim >= 1 and leaf.shape[0] % n_node == 0 \
-                and leaf.shape[0] > n_node:
+        if leaf.ndim >= 1 and _node_shardable(leaf.shape[0], n_node):
             return NamedSharding(mesh, P(NODE_AXIS))
         return NamedSharding(mesh, P())
 
-    import jax.tree_util as jtu
     return type(state)(
-        lan=jtu.tree_map(lan_spec, state.lan),
-        wan=jtu.tree_map(wan_spec, state.wan),
+        lan=jax.tree_util.tree_map(lan_spec, state.lan),
+        wan=jax.tree_util.tree_map(wan_spec, state.wan),
         bridged=NamedSharding(mesh, P(DC_AXIS)),
         bridged_ptr=NamedSharding(mesh, P(DC_AXIS)),
     )
+
+
+def _node_shardable(dim: int, n_shards: int) -> bool:
+    """One predicate for 'this axis is the node axis': divisible AND
+    large relative to the shard count — slot/event tables (U, E ~ 8-32)
+    must replicate, not collect all-gathers, even when divisible."""
+    return dim % n_shards == 0 and dim >= 4 * n_shards
 
 
 def state_sharding(state, mesh: Mesh):
@@ -83,7 +87,7 @@ def state_sharding(state, mesh: Mesh):
     n_shards = mesh.shape[NODE_AXIS]
 
     def spec(leaf):
-        if leaf.ndim >= 1 and leaf.shape[0] % n_shards == 0 and leaf.shape[0] > n_shards:
+        if leaf.ndim >= 1 and _node_shardable(leaf.shape[0], n_shards):
             return NamedSharding(mesh, P(NODE_AXIS))
         return NamedSharding(mesh, P())
 
