@@ -141,6 +141,10 @@ class Agent:
         self.api = ApiServer(self.store, self.oracle, node_name=node_name,
                              port=http_port, dc=dc, acl_resolver=self.acl,
                              local=self.local, checks=self.checks)
+        if data_dir:
+            # persistent agent-token slots (agent/token persistence)
+            from consul_tpu.token_store import TokenStore
+            self.api.tokens = TokenStore(data_dir=data_dir)
         # DNS frontend on its own ephemeral (or fixed) port; rides the
         # same store/oracle (agent/agent.go:601 listenAndServeDNS)
         from consul_tpu.dns import DNSServer
@@ -158,9 +162,13 @@ class Agent:
                 return None
             return [{"service": s} for s in res["Nodes"]]
 
+        # DNS runs under the agent's default-token slot (falls back to
+        # anonymous when unset) — a runtime token update via
+        # /v1/agent/token/default takes effect on the next query
         self.dns = DNSServer(self.store, self.oracle, node_name=node_name,
                              port=dns_port,
-                             authz=lambda: self.acl.resolve(None),
+                             authz=lambda: self.acl.resolve(
+                                 self.api.tokens.user_token() or None),
                              query_executor=_dns_query_exec)
         from consul_tpu.remote_exec import RemoteExecutor
         self.remote_exec = RemoteExecutor(self.store, self.oracle,
@@ -265,6 +273,14 @@ class Agent:
         self.api.start()
         self.dns.start()
         self._running = True
+        # warm the members/down-mask computation in THIS thread before the
+        # reconcile thread exists: its first evaluation is an XLA compile
+        # (~tens of seconds on a tunneled TPU), and a daemon thread stuck
+        # mid-compile at interpreter exit aborts the TPU runtime
+        try:
+            self.oracle.members(limit=1)
+        except Exception:
+            pass
 
         def reconcile_loop():
             while self._running:
@@ -295,7 +311,9 @@ class Agent:
             self.api._proxycfg.close()
         self.dns.stop()
         if self._reconcile_thread:
-            self._reconcile_thread.join(timeout=5.0)
+            # compile-scale headroom: exiting while the thread is inside
+            # an XLA compile tears down libtpu mid-call and aborts
+            self._reconcile_thread.join(timeout=60.0)
 
     # ------------------------------------------------------------- reconcile
 

@@ -19,6 +19,7 @@ class ApiError(Exception):
     def __init__(self, code: int, body: str):
         super().__init__(f"HTTP {code}: {body}")
         self.code = code
+        self.body = body
 
 
 class Client:
@@ -113,16 +114,19 @@ class Client:
 
     # --------------------------------------------------------------- catalog
 
-    def catalog_nodes(self, near: Optional[str] = None) -> List[dict]:
-        return self._call("GET", "/v1/catalog/nodes", {"near": near})[0]
+    def catalog_nodes(self, near: Optional[str] = None,
+                      filter: Optional[str] = None) -> List[dict]:
+        return self._call("GET", "/v1/catalog/nodes",
+                          {"near": near, "filter": filter})[0]
 
     def catalog_services(self) -> Dict[str, List[str]]:
         return self._call("GET", "/v1/catalog/services")[0]
 
     def catalog_service(self, name: str, tag: Optional[str] = None,
-                        near: Optional[str] = None) -> List[dict]:
+                        near: Optional[str] = None,
+                        filter: Optional[str] = None) -> List[dict]:
         return self._call("GET", f"/v1/catalog/service/{name}",
-                          {"tag": tag, "near": near})[0]
+                          {"tag": tag, "near": near, "filter": filter})[0]
 
     def catalog_register(self, node: str, address: str,
                          service: Optional[dict] = None,
@@ -149,8 +153,10 @@ class Client:
                        tag: Optional[str] = None,
                        near: Optional[str] = None,
                        index: Optional[int] = None,
-                       wait: Optional[str] = None) -> Tuple[List[dict], int]:
-        params = {"tag": tag, "near": near, "index": index, "wait": wait}
+                       wait: Optional[str] = None,
+                       filter: Optional[str] = None) -> Tuple[List[dict], int]:
+        params = {"tag": tag, "near": near, "index": index, "wait": wait,
+                  "filter": filter}
         if passing:
             params["passing"] = ""
         out, idx, _ = self._call("GET", f"/v1/health/service/{name}", params)
@@ -196,6 +202,50 @@ class Client:
     def agent_force_leave(self, node: str) -> None:
         self._call("PUT", f"/v1/agent/force-leave/{node}")
 
+    def agent_maintenance(self, enable: bool, reason: str = "") -> None:
+        self._call("PUT", "/v1/agent/maintenance",
+                   {"enable": "true" if enable else "false",
+                    "reason": reason or None})
+
+    def agent_service_maintenance(self, service_id: str, enable: bool,
+                                  reason: str = "") -> None:
+        self._call("PUT", f"/v1/agent/service/maintenance/{service_id}",
+                   {"enable": "true" if enable else "false",
+                    "reason": reason or None})
+
+    def agent_token_update(self, slot: str, token_value: str) -> None:
+        self._call("PUT", f"/v1/agent/token/{slot}", None,
+                   json.dumps({"Token": token_value}).encode())
+
+    def agent_join(self, address: str) -> None:
+        self._call("PUT", f"/v1/agent/join/{address}")
+
+    def agent_host(self) -> dict:
+        return self._call("GET", "/v1/agent/host")[0]
+
+    def agent_health_service_by_id(self, service_id: str) -> dict:
+        # 429 (warning) / 503 (critical, maintenance) still carry the
+        # aggregated JSON body (agent_endpoint.go AgentHealthServiceByID)
+        try:
+            return self._call(
+                "GET", f"/v1/agent/health/service/id/{service_id}")[0]
+        except ApiError as e:
+            if e.code in (429, 503):
+                return json.loads(e.body)
+            raise
+
+    def agent_health_service_by_name(self, name: str) -> List[dict]:
+        try:
+            return self._call(
+                "GET", f"/v1/agent/health/service/name/{name}")[0]
+        except ApiError as e:
+            if e.code in (429, 503):
+                return json.loads(e.body)
+            raise
+
+    def catalog_datacenters(self) -> List[str]:
+        return self._call("GET", "/v1/catalog/datacenters")[0]
+
     # -------------------------------------------------------------- sessions
 
     def session_create(self, node: Optional[str] = None, ttl: str = "",
@@ -225,6 +275,14 @@ class Client:
 
     def coordinate_node(self, node: str) -> List[dict]:
         return self._call("GET", f"/v1/coordinate/node/{node}")[0]
+
+    def coordinate_update(self, node: str, coord: dict) -> bool:
+        return self._call("PUT", "/v1/coordinate/update", None,
+                          json.dumps({"Node": node,
+                                      "Coord": coord}).encode())[0]
+
+    def coordinate_datacenters(self) -> List[dict]:
+        return self._call("GET", "/v1/coordinate/datacenters")[0]
 
     # --------------------------------------------------------------- events
 

@@ -37,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from consul_tpu.acl.resolver import ACLResolver
+from consul_tpu.bexpr import BexprError
 from consul_tpu.catalog.store import StateStore
 from consul_tpu.oracle import GossipOracle
 from consul_tpu.version import VERSION
@@ -119,6 +120,10 @@ class ApiServer:
         from consul_tpu.prepared_query import QueryExecutor
         self.query_executor = QueryExecutor(
             self.store, self.oracle, node_name=node_name, dc=dc)
+        # runtime-updatable agent tokens (agent/token/store.go); Agent
+        # rebinds this with a persistent store when it has a data_dir
+        from consul_tpu.token_store import TokenStore
+        self.tokens = TokenStore()
         # set by Agent.from_config: PUT /v1/agent/reload re-reads config
         self.reload_fn = None
         # multi-DC: a WanRouter enables ?dc= forwarding + query failover
@@ -292,6 +297,19 @@ def _make_handler(srv: ApiServer):
             self._err(403, "Permission denied")
             return True
 
+        def _filtered(self, q, rows):
+            """?filter= expression filtering over the response rows
+            (go-bexpr; parseFilter callers in agent/agent_endpoint.go,
+            catalog/health endpoints).  The expression was pre-compiled
+            in _dispatch so malformed filters 400 BEFORE any blocking
+            wait, not after it."""
+            flt = self._filter
+            if flt is None:
+                return rows
+            if isinstance(rows, dict):
+                return {k: v for k, v in rows.items() if flt(v)}
+            return [r for r in rows if flt(r)]
+
         def _check_update_allowed(self, check_id: str) -> bool:
             """A service check is writable with service:write on its
             service (vetCheckUpdate, agent/acl.go)."""
@@ -331,6 +349,64 @@ def _make_handler(srv: ApiServer):
             sess = store.session_info(sid)
             return self.authz.session_write(
                 sess["node"] if sess else srv.node_name)
+
+        def _node_maintenance(self, enable: bool, reason: str) -> None:
+            """Reserved `_node_maintenance` critical check toggles the
+            whole node out of DNS/health results
+            (agent.EnableNodeMaintenance / DisableNodeMaintenance)."""
+            cid = "_node_maintenance"
+            if enable:
+                if srv.local is not None:
+                    srv.local.add_check(cid, "Node Maintenance Mode",
+                                        status="critical", output=reason)
+                    srv.local.sync_changes(store)
+                else:
+                    store.register_check(srv.node_name, cid,
+                                         "Node Maintenance Mode",
+                                         status="critical", output=reason)
+            else:
+                if srv.local is not None and cid in srv.local.checks():
+                    srv.local.remove_check(cid)
+                    srv.local.sync_changes(store)
+                else:
+                    store.deregister_check(srv.node_name, cid)
+
+        def _service_maintenance(self, sid: str, enable: bool,
+                                 reason: str) -> None:
+            cid = f"_service_maintenance:{sid}"
+            if enable:
+                if srv.local is not None and \
+                        sid in srv.local.services():
+                    srv.local.add_check(cid, "Service Maintenance Mode",
+                                        status="critical", service_id=sid,
+                                        output=reason)
+                    srv.local.sync_changes(store)
+                else:
+                    store.register_check(srv.node_name, cid,
+                                         "Service Maintenance Mode",
+                                         status="critical",
+                                         service_id=sid, output=reason)
+            else:
+                if srv.local is not None and cid in srv.local.checks():
+                    srv.local.remove_check(cid)
+                    srv.local.sync_changes(store)
+                else:
+                    store.deregister_check(srv.node_name, cid)
+
+        def _aggregate_service_status(self, sid: str) -> str:
+            """Worst-of aggregation over node + service checks
+            (agent_endpoint.go AgentHealthServiceByID): maintenance
+            trumps critical trumps warning trumps passing."""
+            st = "passing"
+            for c in store.node_checks(srv.node_name):
+                if c["service_id"] not in ("", sid):
+                    continue
+                cid = c["check_id"]
+                if cid == "_node_maintenance" or \
+                        cid == f"_service_maintenance:{sid}":
+                    return "maintenance"
+                st = _worse_status(st, c["status"])
+            return st
 
         # ------------------------------------------- agent-endpoint helpers
 
@@ -466,13 +542,22 @@ def _make_handler(srv: ApiServer):
                         token = auth[len("Bearer "):].strip()
                 token = token or q.get("token")
                 self.token = token
-                self.authz = srv.acl.resolve(token)
+                # tokenless requests run under the agent's default-token
+                # slot before falling to anonymous (parseToken order:
+                # request token > agent default token > anonymous)
+                self.authz = srv.acl.resolve(
+                    token or srv.tokens.user_token() or None)
                 if self._dispatch(verb, path, q):
                     telemetry.measure_since(("http", "latency"), t0)
                     return
                 self._err(404, f"no route {verb} {path}")
             except BrokenPipeError:
                 pass
+            except BexprError as e:
+                try:
+                    self._err(400, f"invalid filter: {e}")
+                except Exception:
+                    pass
             except Exception as e:  # pragma: no cover
                 try:
                     self._err(500, f"{type(e).__name__}: {e}")
@@ -523,6 +608,13 @@ def _make_handler(srv: ApiServer):
                            "/v1/event/", "/v1/txn")
 
         def _dispatch(self, verb: str, path: str, q) -> bool:
+            # compile ?filter= up front: a malformed expression must 400
+            # immediately, not after a 5-minute blocking wait
+            if "filter" in q:
+                from consul_tpu.bexpr import compile_filter
+                self._filter = compile_filter(q["filter"])
+            else:
+                self._filter = None
             if q.get("dc") not in (None, "", srv.dc) \
                     and path.startswith(self._DC_FORWARDABLE):
                 if srv.router is None:
@@ -712,6 +804,39 @@ def _make_handler(srv: ApiServer):
                             "FailureTolerance": ap.failure_tolerance(now),
                             "Servers": servers})
                 return True
+            if path == "/v1/operator/autopilot/configuration":
+                ap = getattr(store, "autopilot", None)
+                if ap is None:
+                    self._err(400, "not a server-backed agent")
+                    return True
+                if verb == "GET":
+                    if not self.authz.operator_read():
+                        return self._forbid()
+                    c = ap.config
+                    self._send({
+                        "CleanupDeadServers": c.cleanup_dead_servers,
+                        "LastContactThreshold":
+                            f"{c.last_contact_threshold}s",
+                        "ServerStabilizationTime":
+                            f"{c.server_stabilization_time}s",
+                    })
+                    return True
+                if verb == "PUT":
+                    if not self.authz.operator_write():
+                        return self._forbid()
+                    body = json.loads(self._body() or b"{}")
+                    c = ap.config
+                    if "CleanupDeadServers" in body:
+                        c.cleanup_dead_servers = \
+                            bool(body["CleanupDeadServers"])
+                    if "LastContactThreshold" in body:
+                        c.last_contact_threshold = _parse_wait(
+                            str(body["LastContactThreshold"]))
+                    if "ServerStabilizationTime" in body:
+                        c.server_stabilization_time = _parse_wait(
+                            str(body["ServerStabilizationTime"]))
+                    self._send(True)
+                    return True
             if path == "/v1/operator/raft/configuration" and verb == "GET":
                 if not self.authz.operator_read():
                     return self._forbid()
@@ -739,7 +864,7 @@ def _make_handler(srv: ApiServer):
                                      "Meta": s["meta"]}
                            for s in store.node_services(srv.node_name)
                            if self.authz.service_read(s["name"])}
-                self._send(out)
+                self._send(self._filtered(q, out))
                 return True
             if path == "/v1/agent/checks" and verb == "GET":
                 def _chk_visible(service_id: str) -> bool:
@@ -766,7 +891,155 @@ def _make_handler(srv: ApiServer):
                     out = {c["check_id"]: _check_json(c, srv.node_name)
                            for c in store.node_checks(srv.node_name)
                            if _chk_visible(c["service_id"])}
-                self._send(out)
+                self._send(self._filtered(q, out))
+                return True
+            if path == "/v1/agent/maintenance" and verb == "PUT":
+                # node maintenance mode registers/clears the reserved
+                # critical check (agent.EnableNodeMaintenance,
+                # agent/agent.go _node_maintenance)
+                if not self.authz.node_write(srv.node_name):
+                    return self._forbid()
+                self._node_maintenance(
+                    q.get("enable", "").lower() == "true",
+                    q.get("reason") or (
+                        "Maintenance mode is enabled for this node, "
+                        "but no reason was provided. This is a default "
+                        "message."))
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/service/maintenance/(.+)", path)
+            if m and verb == "PUT":
+                sid = m.group(1)
+                svc = srv.local.services().get(sid) \
+                    if srv.local is not None else None
+                if svc is None:
+                    svc_row = next((s for s in
+                                    store.node_services(srv.node_name)
+                                    if s["id"] == sid), None)
+                    name = svc_row["name"] if svc_row else None
+                else:
+                    name = svc["name"]
+                if name is None:
+                    self._err(404, f"unknown service id {sid!r}")
+                    return True
+                if not self.authz.service_write(name):
+                    return self._forbid()
+                self._service_maintenance(
+                    sid, q.get("enable", "").lower() == "true",
+                    q.get("reason") or (
+                        "Maintenance mode is enabled for this service, "
+                        "but no reason was provided. This is a default "
+                        "message."))
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/token/(.+)", path)
+            if m and verb == "PUT":
+                # runtime agent-token update (agent/token/store.go;
+                # agent_endpoint.go AgentToken requires agent:write)
+                if not self.authz.agent_write(srv.node_name):
+                    return self._forbid()
+                body = json.loads(self._body() or b"{}")
+                if not srv.tokens.set(m.group(1),
+                                      body.get("Token", ""),
+                                      from_api=True):
+                    self._err(404, f"unknown token slot {m.group(1)!r}")
+                    return True
+                self._send(None)
+                return True
+            m = re.fullmatch(r"/v1/agent/join/(.+)", path)
+            if m and verb == "PUT":
+                # idempotent join: the sim's membership is tensor-state,
+                # so joining an already-known member revives it; unknown
+                # addresses have no socket to dial (agent_endpoint.go
+                # AgentJoin requires agent:write)
+                if not self.authz.agent_write(srv.node_name):
+                    return self._forbid()
+                target = m.group(1)
+                try:
+                    oracle.node_id(target)   # O(1), no member dump
+                except KeyError:
+                    self._err(500, f"join failed: no sim member "
+                              f"{target!r}")
+                    return True
+                # unconditional: revive of an alive member is a no-op,
+                # and the members snapshot may be up to 1s stale
+                oracle.revive(target)
+                self._send(None)
+                return True
+            if path == "/v1/agent/host" and verb == "GET":
+                # host diagnostics (agent/debug/host.go; requires
+                # operator:read like AgentHost)
+                if not self.authz.operator_read():
+                    return self._forbid()
+                import os as _os
+                import platform as _platform
+                try:
+                    load = _os.getloadavg()
+                except OSError:  # pragma: no cover
+                    load = (0.0, 0.0, 0.0)
+                mem_total = mem_free = 0
+                try:
+                    with open("/proc/meminfo") as f:
+                        for line in f:
+                            if line.startswith("MemTotal:"):
+                                mem_total = int(line.split()[1]) * 1024
+                            elif line.startswith("MemAvailable:"):
+                                mem_free = int(line.split()[1]) * 1024
+                except OSError:  # pragma: no cover
+                    pass
+                self._send({
+                    "Host": {"OS": _platform.system().lower(),
+                             "Platform": _platform.platform(),
+                             "Hostname": _platform.node(),
+                             "KernelVersion": _platform.release()},
+                    "CPU": {"Cores": _os.cpu_count() or 0,
+                            "LoadAvg1": load[0], "LoadAvg5": load[1],
+                            "LoadAvg15": load[2]},
+                    "Memory": {"Total": mem_total,
+                               "Available": mem_free},
+                    "CollectionTime": int(time.time() * 1e9),
+                })
+                return True
+            m = re.fullmatch(r"/v1/agent/health/service/name/(.+)", path)
+            if m and verb == "GET":
+                name = m.group(1)
+                if not self.authz.service_read(name):
+                    return self._forbid()
+                out, worst = [], "passing"
+                for s in store.node_services(srv.node_name):
+                    if s["name"] != name:
+                        continue
+                    st = self._aggregate_service_status(s["id"])
+                    worst = _worse_status(worst, st)
+                    out.append({"AggregatedStatus": st,
+                                "Service": {"ID": s["id"],
+                                            "Service": s["name"],
+                                            "Port": s["port"],
+                                            "Tags": s["tags"]}})
+                if not out:
+                    # empty-but-200 would read as healthy to rollout
+                    # gates (reference: 404 ServiceNotFound)
+                    self._err(404, f"ServiceName {name!r} Not Found")
+                    return True
+                self._send(out, code=_health_http_code(worst))
+                return True
+            m = re.fullmatch(r"/v1/agent/health/service/id/(.+)", path)
+            if m and verb == "GET":
+                sid = m.group(1)
+                svc = next((s for s in store.node_services(srv.node_name)
+                            if s["id"] == sid), None)
+                if svc is None:
+                    self._err(404, f"unknown service id {sid!r}")
+                    return True
+                if not self.authz.service_read(svc["name"]):
+                    return self._forbid()
+                st = self._aggregate_service_status(sid)
+                self._send({"AggregatedStatus": st,
+                            "Service": {"ID": sid,
+                                        "Service": svc["name"],
+                                        "Port": svc["port"],
+                                        "Tags": svc["tags"]}},
+                           code=_health_http_code(st))
                 return True
             if path == "/v1/agent/service/register" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
@@ -919,6 +1192,12 @@ def _make_handler(srv: ApiServer):
                     store.deregister_node(node)
                 self._send(True)
                 return True
+            if path == "/v1/catalog/datacenters" and verb == "GET":
+                # WAN-distance-sorted DC list (catalog_endpoint.go
+                # ListDatacenters via router.GetDatacentersByDistance)
+                self._send(srv.router.datacenters()
+                           if srv.router is not None else [srv.dc])
+                return True
             if path == "/v1/catalog/nodes" and verb == "GET":
                 idx = self._block(q, ("nodes", ""))
                 rows = [{"Node": n["node"], "ID": n["id"],
@@ -926,6 +1205,7 @@ def _make_handler(srv: ApiServer):
                          "ModifyIndex": n["modify_index"]}
                         for n in store.nodes()
                         if self.authz.node_read(n["node"])]
+                rows = self._filtered(q, rows)
                 if "near" in q:
                     rows = self._near_sort(q["near"], rows,
                                            key=lambda r: r["Node"])
@@ -943,7 +1223,8 @@ def _make_handler(srv: ApiServer):
                 idx = self._block(q, ("services", m.group(1)),
                                   ("nodes", ""))
                 rows = store.service_nodes(m.group(1), tag=q.get("tag"))
-                out = [_catalog_service_json(r) for r in rows]
+                out = self._filtered(q, [_catalog_service_json(r)
+                                         for r in rows])
                 if "near" in q:
                     out = self._near_sort(q["near"], out,
                                           key=lambda r: r["Node"])
@@ -1013,7 +1294,8 @@ def _make_handler(srv: ApiServer):
                     rows = store.health_service_nodes(
                         name, tag=q.get("tag"),
                         passing_only="passing" in q)
-                out = [_health_json(r, store) for r in rows]
+                out = self._filtered(q, [_health_json(r, store)
+                                         for r in rows])
                 if "near" in q:
                     out = self._near_sort(q["near"], out,
                                           key=lambda r: r["Node"]["Node"])
@@ -1025,19 +1307,21 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.node_read(m.group(1)):
                     return self._forbid()  # before blocking: no stall/leak
                 idx = self._block(q, ("nodechecks", m.group(1)))
-                self._send([_check_json(c, c.get("node", m.group(1)))
-                            for c in store.node_checks(m.group(1))
-                            if self._check_visible(m.group(1), c)],
+                self._send(self._filtered(q, [
+                    _check_json(c, c.get("node", m.group(1)))
+                    for c in store.node_checks(m.group(1))
+                    if self._check_visible(m.group(1), c)]),
                            index=idx)
                 return True
             m = re.fullmatch(r"/v1/health/state/(.+)", path)
             if m and verb == "GET":
                 idx = self._block(q, ("nodechecks", ""))
                 svc_cache: dict = {}
-                self._send([_check_json(c, c["node"])
-                            for c in store.checks_in_state(m.group(1))
-                            if self.authz.node_read(c["node"])
-                            and self._check_visible(c["node"], c, svc_cache)],
+                self._send(self._filtered(q, [
+                    _check_json(c, c["node"])
+                    for c in store.checks_in_state(m.group(1))
+                    if self.authz.node_read(c["node"])
+                    and self._check_visible(c["node"], c, svc_cache)]),
                            index=idx)
                 return True
             if path == "/v1/session/create" and verb == "PUT":
@@ -1088,15 +1372,41 @@ def _make_handler(srv: ApiServer):
                             and self.authz.session_read(s["node"])])
                 return True
             if path == "/v1/coordinate/nodes" and verb == "GET":
-                out = []
+                out, seen = [], set()
                 for mem in oracle.members():
                     if mem["status"] != "alive":
                         continue
                     if not self.authz.node_read(mem["name"]):
                         continue  # aclFilter on coordinates
                     c = oracle.coordinate(mem["name"])
+                    seen.add(mem["name"])
                     out.append(_coord_json(c, srv.dc))
+                # externally-pushed coordinates (PUT /v1/coordinate/
+                # update) for nodes outside the sim
+                for row in store.coordinate_list():
+                    if row["node"] in seen or \
+                            not self.authz.node_read(row["node"]):
+                        continue
+                    out.append({"Node": row["node"], "Segment": "",
+                                "Coord": row["coord"]})
                 self._send(out)
+                return True
+            if path == "/v1/coordinate/update" and verb == "PUT":
+                # Coordinate.Update: raft-applied batch write
+                # (coordinate_endpoint.go:117; batched :63-113)
+                body = json.loads(self._body() or b"{}")
+                node = body.get("Node", srv.node_name)
+                if not self.authz.node_write(node):
+                    return self._forbid()
+                idx = store.coordinate_batch_update(
+                    [{"node": node, "coord": body.get("Coord") or {}}])
+                self._send(True, index=idx)
+                return True
+            if path == "/v1/coordinate/datacenters" and verb == "GET":
+                dcs = srv.router.datacenters() if srv.router is not None \
+                    else [srv.dc]
+                self._send([{"Datacenter": d, "AreaID": "wan",
+                             "Coordinates": []} for d in dcs])
                 return True
             m = re.fullmatch(r"/v1/coordinate/node/(.+)", path)
             if m and verb == "GET":
@@ -1106,7 +1416,12 @@ def _make_handler(srv: ApiServer):
                 try:
                     c = oracle.coordinate(m.group(1))
                 except KeyError:
-                    self._send([])
+                    row = store.coordinate_get(m.group(1))
+                    if row is None:
+                        self._send([])
+                        return True
+                    self._send([{"Node": row["node"], "Segment": "",
+                                 "Coord": row["coord"]}])
                     return True
                 self._send([_coord_json(c, srv.dc)])
                 return True
@@ -1695,6 +2010,29 @@ def _make_handler(srv: ApiServer):
                     "TrustDomain": srv.ca.trust_domain,
                     "Roots": roots})
                 return True
+            if path == "/v1/connect/ca/configuration":
+                # CA provider config (connect_ca_endpoint.go
+                # ConnectCAConfiguration*)
+                if verb == "GET":
+                    if not self.authz.operator_read():
+                        return self._forbid()
+                    self._send({"Provider": "consul",
+                                "Config": {
+                                    "LeafCertTTL":
+                                        f"{srv.ca.leaf_ttl_hours}h",
+                                    "TrustDomain": srv.ca.trust_domain,
+                                }})
+                    return True
+                if verb == "PUT":
+                    if not self.authz.operator_write():
+                        return self._forbid()
+                    body = json.loads(self._body() or b"{}")
+                    cfg = body.get("Config") or {}
+                    if "LeafCertTTL" in cfg:
+                        ttl_s = _parse_wait(str(cfg["LeafCertTTL"]))
+                        srv.ca.leaf_ttl_hours = max(1, int(ttl_s // 3600))
+                    self._send(True)
+                    return True
             if path == "/v1/connect/ca/rotate" and verb == "PUT":
                 # operator:write like CA config changes
                 if not self.authz.operator_write():
@@ -2040,6 +2378,20 @@ def _config_json(entry: dict) -> dict:
     out["CreateIndex"] = entry.get("create_index", 0)
     out["ModifyIndex"] = entry.get("modify_index", 0)
     return out
+
+
+_STATUS_RANK = {"passing": 0, "warning": 1, "critical": 2,
+                "maintenance": 3}
+
+
+def _worse_status(a: str, b: str) -> str:
+    return a if _STATUS_RANK.get(a, 0) >= _STATUS_RANK.get(b, 0) else b
+
+
+def _health_http_code(status: str) -> int:
+    """AgentHealthService* response codes (agent_endpoint.go): passing
+    200, warning 429, critical/maintenance 503."""
+    return {"passing": 200, "warning": 429}.get(status, 503)
 
 
 def _check_defn(body: dict) -> dict:

@@ -1,0 +1,398 @@
+"""Boolean filter expressions for `?filter=` query parameters.
+
+The reference filters most list endpoints through hashicorp/go-bexpr
+(wired in agent/http.go parseFilter callers, e.g. agent_endpoint.go
+AgentServices/AgentChecks, catalog and health endpoints).  This module
+implements the same expression grammar over the JSON-shaped dicts this
+framework's API returns:
+
+  selector  := Ident ('.' Ident | '["key"]')*
+  compare   := selector ('=='|'!='|'contains'|'not contains'|
+                         'matches'|'not matches') value
+             | value ('in'|'not in') selector
+             | selector 'is empty' | selector 'is not empty'
+  logical   := 'and' / 'or' / 'not' / parentheses
+
+Values are double/backtick-quoted strings, numbers, or bare words.
+Comparisons coerce the literal to the field's type (int/float/bool)
+before comparing, like bexpr's reflection-driven coercion.  A selector
+that walks off the data (unknown key) evaluates as an empty value —
+`is empty` is true, every match is false — so heterogeneous rows (node
+meta maps and the like) filter cleanly instead of erroring the request.
+
+Parse errors raise BexprError; HTTP callers turn that into 400 the way
+the reference rejects malformed filters.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Tuple
+
+__all__ = ["BexprError", "compile_filter", "Filter"]
+
+
+class BexprError(ValueError):
+    """Malformed filter expression (400 Bad Request at the API)."""
+
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<lparen>\() |
+      (?P<rparen>\)) |
+      (?P<op>==|!=) |
+      (?P<string>"(?:[^"\\]|\\.)*"|`[^`]*`) |
+      (?P<number>-?\d+(?:\.\d+)?(?!\w)) |
+      (?P<dot>\.) |
+      (?P<lbracket>\[) |
+      (?P<rbracket>\]) |
+      (?P<word>[A-Za-z_][A-Za-z0-9_-]*)
+    )""", re.VERBOSE)
+
+# words that terminate a selector / act as operators
+_KEYWORDS = {"and", "or", "not", "in", "contains", "matches", "is",
+             "empty"}
+
+
+class _Token:
+    __slots__ = ("kind", "text")
+
+    def __init__(self, kind: str, text: str):
+        self.kind = kind
+        self.text = text
+
+    def __repr__(self):  # pragma: no cover
+        return f"<{self.kind}:{self.text}>"
+
+
+def _tokenize(src: str) -> List[_Token]:
+    out: List[_Token] = []
+    pos = 0
+    while pos < len(src):
+        m = _TOKEN_RE.match(src, pos)
+        if m is None:
+            rest = src[pos:].strip()
+            if not rest:
+                break
+            raise BexprError(f"invalid token at: {rest[:20]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        text = m.group()
+        if kind is None or not text.strip():
+            continue
+        out.append(_Token(kind, text.strip()))
+    return out
+
+
+def _unquote(text: str) -> str:
+    if text.startswith("`"):
+        return text[1:-1]
+    body = text[1:-1]
+    return re.sub(r"\\(.)", r"\1", body)
+
+
+class _EMPTY:
+    """Sentinel: selector walked off the data."""
+
+
+EMPTY = _EMPTY()
+
+
+class _Node:
+    def eval(self, data: Any) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+
+class _And(_Node):
+    def __init__(self, parts):
+        self.parts = parts
+
+    def eval(self, data):
+        return all(p.eval(data) for p in self.parts)
+
+
+class _Or(_Node):
+    def __init__(self, parts):
+        self.parts = parts
+
+    def eval(self, data):
+        return any(p.eval(data) for p in self.parts)
+
+
+class _Not(_Node):
+    def __init__(self, inner):
+        self.inner = inner
+
+    def eval(self, data):
+        return not self.inner.eval(data)
+
+
+def _walk(data: Any, path: List[str]) -> Any:
+    cur = data
+    for seg in path:
+        if isinstance(cur, dict):
+            if seg in cur:
+                cur = cur[seg]
+                continue
+            # case-insensitive fallback: our JSON uses CamelCase but
+            # filters written against the reference docs sometimes use
+            # the Go field name with different casing
+            low = seg.lower()
+            for k in cur:
+                if isinstance(k, str) and k.lower() == low:
+                    cur = cur[k]
+                    break
+            else:
+                return EMPTY
+        elif isinstance(cur, (list, tuple)):
+            try:
+                cur = cur[int(seg)]
+            except (ValueError, IndexError):
+                return EMPTY
+        else:
+            return EMPTY
+    return cur
+
+
+def _coerce(literal: str, field: Any) -> Any:
+    """Coerce the string literal toward the field's runtime type."""
+    if isinstance(field, bool):
+        if literal.lower() in ("true", "false"):
+            return literal.lower() == "true"
+        return literal
+    if isinstance(field, int) and not isinstance(field, bool):
+        try:
+            return int(literal)
+        except ValueError:
+            return literal
+    if isinstance(field, float):
+        try:
+            return float(literal)
+        except ValueError:
+            return literal
+    return literal
+
+
+def _is_empty(v: Any) -> bool:
+    if v is EMPTY or v is None:
+        return True
+    if isinstance(v, (str, list, tuple, dict)):
+        return len(v) == 0
+    return False
+
+
+class _Match(_Node):
+    """selector <op> value (or value in selector)."""
+
+    def __init__(self, path: List[str], op: str, literal: Optional[str]):
+        self.path = path
+        self.op = op
+        self.literal = literal
+        if op in ("matches", "not matches") and literal is not None:
+            try:
+                self.rx = re.compile(literal)
+            except re.error as e:
+                raise BexprError(f"bad regex {literal!r}: {e}") from None
+
+    def eval(self, data):
+        field = _walk(data, self.path)
+        op = self.op
+        if op == "is empty":
+            return _is_empty(field)
+        if op == "is not empty":
+            return not _is_empty(field)
+        lit = self.literal
+        if op in ("in", "not in", "contains", "not contains"):
+            if isinstance(field, dict):
+                hit = lit in field
+            elif isinstance(field, (list, tuple)):
+                hit = any(str(x) == lit or x == _coerce(lit, x)
+                          for x in field)
+            elif isinstance(field, str):
+                hit = lit in field
+            else:
+                hit = False
+            return hit if op in ("in", "contains") else not hit
+        if op in ("matches", "not matches"):
+            hit = isinstance(field, str) and bool(self.rx.search(field))
+            return hit if op == "matches" else not hit
+        # == / !=
+        if field is EMPTY:
+            eq = False
+        else:
+            want = _coerce(lit, field)
+            eq = field == want or str(field) == lit
+        return eq if op == "==" else not eq
+
+
+class _Parser:
+    def __init__(self, tokens: List[_Token]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self) -> Optional[_Token]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> _Token:
+        t = self.peek()
+        if t is None:
+            raise BexprError("unexpected end of expression")
+        self.i += 1
+        return t
+
+    def expect_word(self, *words: str) -> str:
+        t = self.next()
+        if t.kind != "word" or t.text.lower() not in words:
+            raise BexprError(f"expected {'/'.join(words)}, got {t.text!r}")
+        return t.text.lower()
+
+    # ---------------------------------------------------------- grammar
+
+    def parse(self) -> _Node:
+        node = self.or_expr()
+        if self.peek() is not None:
+            raise BexprError(f"trailing input at {self.peek().text!r}")
+        return node
+
+    def or_expr(self) -> _Node:
+        parts = [self.and_expr()]
+        while (t := self.peek()) and t.kind == "word" \
+                and t.text.lower() == "or":
+            self.next()
+            parts.append(self.and_expr())
+        return parts[0] if len(parts) == 1 else _Or(parts)
+
+    def and_expr(self) -> _Node:
+        parts = [self.unary()]
+        while (t := self.peek()) and t.kind == "word" \
+                and t.text.lower() == "and":
+            self.next()
+            parts.append(self.unary())
+        return parts[0] if len(parts) == 1 else _And(parts)
+
+    def unary(self) -> _Node:
+        t = self.peek()
+        if t is None:
+            raise BexprError("unexpected end of expression")
+        if t.kind == "word" and t.text.lower() == "not":
+            self.next()
+            return _Not(self.unary())
+        if t.kind == "lparen":
+            self.next()
+            node = self.or_expr()
+            tt = self.next()
+            if tt.kind != "rparen":
+                raise BexprError("missing )")
+            return node
+        return self.match()
+
+    def selector(self) -> List[str]:
+        path: List[str] = []
+        t = self.next()
+        if t.kind == "string":
+            path.append(_unquote(t.text))
+        elif t.kind == "word" and t.text.lower() not in _KEYWORDS:
+            path.append(t.text)
+        else:
+            raise BexprError(f"expected selector, got {t.text!r}")
+        while (nt := self.peek()) is not None:
+            if nt.kind == "dot":
+                self.next()
+                seg = self.next()
+                if seg.kind == "word":
+                    path.append(seg.text)
+                elif seg.kind == "string":
+                    path.append(_unquote(seg.text))
+                elif seg.kind == "number":
+                    path.append(seg.text)
+                else:
+                    raise BexprError(
+                        f"bad selector segment {seg.text!r}")
+            elif nt.kind == "lbracket":
+                self.next()
+                seg = self.next()
+                if seg.kind not in ("string", "word", "number"):
+                    raise BexprError(
+                        f"bad index segment {seg.text!r}")
+                path.append(_unquote(seg.text)
+                            if seg.kind == "string" else seg.text)
+                if self.next().kind != "rbracket":
+                    raise BexprError("missing ]")
+            else:
+                break
+        return path
+
+    def match(self) -> _Node:
+        t = self.peek()
+        # literal-first form: "value" in Selector / 3 in Selector
+        if t is not None and t.kind in ("string", "number"):
+            save = self.i
+            lit_tok = self.next()
+            nt = self.peek()
+            if nt is not None and nt.kind == "word" \
+                    and nt.text.lower() in ("in", "not"):
+                neg = False
+                if nt.text.lower() == "not":
+                    self.next()
+                    self.expect_word("in")
+                    neg = True
+                else:
+                    self.next()
+                lit = _unquote(lit_tok.text) \
+                    if lit_tok.kind == "string" else lit_tok.text
+                path = self.selector()
+                return _Match(path, "not in" if neg else "in", lit)
+            self.i = save
+        path = self.selector()
+        t = self.next()
+        if t.kind == "op":
+            return _Match(path, t.text, self.value())
+        if t.kind == "word":
+            w = t.text.lower()
+            if w == "contains":
+                return _Match(path, "contains", self.value())
+            if w == "matches":
+                return _Match(path, "matches", self.value())
+            if w == "is":
+                nt = self.next()
+                if nt.kind == "word" and nt.text.lower() == "empty":
+                    return _Match(path, "is empty", None)
+                if nt.kind == "word" and nt.text.lower() == "not":
+                    self.expect_word("empty")
+                    return _Match(path, "is not empty", None)
+                raise BexprError(f"expected empty, got {nt.text!r}")
+            if w == "not":
+                w2 = self.expect_word("contains", "matches", "in")
+                if w2 == "in":
+                    raise BexprError("'not in' takes the literal first")
+                return _Match(path, f"not {w2}", self.value())
+        raise BexprError(f"expected operator, got {t.text!r}")
+
+    def value(self) -> str:
+        t = self.next()
+        if t.kind == "string":
+            return _unquote(t.text)
+        if t.kind in ("number", "word"):
+            return t.text
+        raise BexprError(f"expected value, got {t.text!r}")
+
+
+class Filter:
+    """Compiled filter; callable on one row, plus a list helper."""
+
+    def __init__(self, root: _Node, src: str):
+        self._root = root
+        self.src = src
+
+    def __call__(self, row: Any) -> bool:
+        return self._root.eval(row)
+
+    def filter(self, rows):
+        return [r for r in rows if self._root.eval(r)]
+
+
+def compile_filter(src: str) -> Filter:
+    toks = _tokenize(src)
+    if not toks:
+        raise BexprError("empty filter expression")
+    return Filter(_Parser(toks).parse(), src)

@@ -109,6 +109,10 @@ class StateStore:
         # federation states: dc -> mesh gateway endpoints
         # (state/federation_state.go)
         self._federation_states: Dict[str, dict] = {}
+        # pushed network coordinates: node -> coord dict
+        # (state/coordinate.go).  Sim nodes read theirs from the oracle;
+        # external agents land here via PUT /v1/coordinate/update.
+        self._coordinates: Dict[str, dict] = {}
 
     # ------------------------------------------------------------------ core
 
@@ -762,6 +766,32 @@ class StateStore:
             return idx
 
     # ------------------------------------------------------ federation states
+    # pushed network coordinates (agent/consul/state/coordinate.go;
+    # batched writes coordinate_endpoint.go:63-113)
+
+    def coordinate_batch_update(self, updates: List[dict]) -> int:
+        """Apply a batch of {node, coord} updates (the reference stages
+        Coordinate.Update calls and raft-applies batches of 128×5)."""
+        with self._lock:
+            idx = self._bump([("coordinates", u["node"])
+                              for u in updates])
+            for u in updates:
+                self._coordinates[u["node"]] = {
+                    "coord": dict(u["coord"]),
+                    "modify_index": idx,
+                }
+            return idx
+
+    def coordinate_get(self, node: str) -> Optional[dict]:
+        with self._lock:
+            c = self._coordinates.get(node)
+            return dict(c, node=node) if c else None
+
+    def coordinate_list(self) -> List[dict]:
+        with self._lock:
+            return [dict(v, node=k)
+                    for k, v in sorted(self._coordinates.items())]
+
     # per-DC mesh gateway lists replicated from the primary
     # (state/federation_state.go FederationStateSet/Get/List)
 
@@ -1037,6 +1067,7 @@ class StateStore:
                 "binding_rules": copy.deepcopy(self._binding_rules),
                 "federation_states": copy.deepcopy(
                     self._federation_states),
+                "coordinates": copy.deepcopy(self._coordinates),
             }
 
     def load_snapshot(self, snap: dict) -> None:
@@ -1071,6 +1102,8 @@ class StateStore:
                 snap.get("binding_rules", {}))
             self._federation_states = copy.deepcopy(
                 snap.get("federation_states", {}))
+            self._coordinates = copy.deepcopy(
+                snap.get("coordinates", {}))
             # watch bookkeeping must rewind with the index, or restored-
             # to-older stores report watch indexes beyond _index and
             # blocking queries busy-loop returning immediately

@@ -281,6 +281,55 @@ def cmd_leave(args) -> int:
     return 0
 
 
+def cmd_maint(args) -> int:
+    """consul maint (command/maint): toggle node or service
+    maintenance mode via the reserved critical checks."""
+    c = _client(args)
+    if args.enable and args.disable:
+        print("Only one of -enable or -disable may be provided",
+              file=sys.stderr)
+        return 1
+    if not args.enable and not args.disable:
+        # no flags: show current maintenance state
+        checks = c._call("GET", "/v1/agent/checks")[0]
+        rows = [chk for cid, chk in checks.items()
+                if cid == "_node_maintenance"
+                or cid.startswith("_service_maintenance:")]
+        if not rows:
+            print("Node and all services are in normal mode")
+            return 0
+        for chk in rows:
+            scope = "node" if chk["CheckID"] == "_node_maintenance" \
+                else f"service {chk['ServiceID']}"
+            print(f"{scope}: maintenance enabled "
+                  f"(reason: {chk.get('Output', '')})")
+        return 0
+    enable = bool(args.enable)
+    if args.service:
+        c.agent_service_maintenance(args.service, enable,
+                                    reason=args.reason or "")
+        print(f"Service maintenance {'enabled' if enable else 'disabled'}"
+              f" for {args.service}")
+    else:
+        c.agent_maintenance(enable, reason=args.reason or "")
+        print(f"Node maintenance "
+              f"{'enabled' if enable else 'disabled'}")
+    return 0
+
+
+def cmd_join(args) -> int:
+    c = _client(args)
+    ok = 0
+    for addr in args.address:
+        try:
+            c.agent_join(addr)
+            ok += 1
+        except Exception as e:
+            print(f"Error joining {addr}: {e}", file=sys.stderr)
+    print(f"Successfully joined cluster by contacting {ok} nodes.")
+    return 0 if ok else 1
+
+
 def cmd_exec(args) -> int:
     """consul exec (command/exec): run a command cluster-wide via KV +
     events; waits a quiet period after the last response so slower
@@ -695,6 +744,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("node")
     sp.set_defaults(fn=cmd_force_leave)
     sub.add_parser("leave").set_defaults(fn=cmd_leave)
+
+    sp = sub.add_parser("maint")
+    sp.add_argument("-enable", action="store_true")
+    sp.add_argument("-disable", action="store_true")
+    sp.add_argument("-reason", default="")
+    sp.add_argument("-service", default="")
+    sp.set_defaults(fn=cmd_maint)
+
+    sp = sub.add_parser("join")
+    sp.add_argument("address", nargs="+")
+    sp.set_defaults(fn=cmd_join)
 
     sp = sub.add_parser("exec")
     sp.add_argument("command")

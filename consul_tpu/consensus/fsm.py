@@ -44,6 +44,7 @@ class ServerFSM:
             "intention_delete": self._intention_delete,
             "config_entry_set": self._config_entry_set,
             "config_entry_delete": self._config_entry_delete,
+            "coordinate_batch_update": self._coordinate_batch_update,
         }
 
     def apply(self, cmd: Dict[str, Any]) -> Any:
@@ -179,6 +180,9 @@ class ServerFSM:
 
     def _config_entry_delete(self, kind, name):
         return {"index": self.store.config_entry_delete(kind, name)}
+
+    def _coordinate_batch_update(self, updates):
+        return {"index": self.store.coordinate_batch_update(updates)}
 
     def _acl_bootstrap(self, accessor, secret):
         ok, idx = self.store.acl_bootstrap(accessor, secret)

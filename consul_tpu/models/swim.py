@@ -653,7 +653,35 @@ def kill(s: SwimState, node: int) -> SwimState:
 
 
 def revive(s: SwimState, node: int) -> SwimState:
+    """Bring the process back up WITHOUT a rejoin: only heals if the
+    death was never committed (inside the suspicion window).  A node the
+    cluster already declared dead must `rejoin` instead."""
     return s.replace(up=s.up.at[node].set(True))
+
+
+def rejoin(params: SwimParams, s: SwimState, node: int) -> SwimState:
+    """Restart + rejoin after a committed death (memberlist's
+    rejoin-with-higher-incarnation; serf snapshot rejoin
+    agent/consul/server_serf.go:169-172): the node comes back with a
+    bumped incarnation, its committed dead/left state clears, lingering
+    dead/left rumors about it deactivate (they would recommit the death
+    on expiry), and it originates an alive rumor that refutes the stale
+    belief cluster-wide."""
+    inc = s.incarnation.at[node].add(1)
+    stale = s.r_active & (s.r_subject == node) & \
+        ((s.r_kind == DEAD) | (s.r_kind == LEFT) | (s.r_kind == SUSPECT))
+    s = s.replace(
+        up=s.up.at[node].set(True),
+        member=s.member.at[node].set(True),
+        committed_dead=s.committed_dead.at[node].set(False),
+        committed_left=s.committed_left.at[node].set(False),
+        incarnation=inc,
+        r_active=s.r_active & ~stale,
+    )
+    want = jnp.zeros((params.n_nodes,), jnp.int32).at[node].set(1)
+    row_subject = jnp.where(jnp.arange(params.n_nodes) == node, node,
+                            _NEG)
+    return _originate(params, s, want, ALIVE, inc, row_subject)
 
 
 def leave(params: SwimParams, s: SwimState, node: int) -> SwimState:

@@ -187,10 +187,13 @@ class GossipOracle:
                 swim=swim.kill(self._state.swim, self.node_id(name)))
 
     def revive(self, name: str) -> None:
+        """Restart + rejoin: heals even a committed death (the node comes
+        back with a higher incarnation and refutes — memberlist rejoin)."""
         with self._lock:
             self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
-                swim=swim.revive(self._state.swim, self.node_id(name)))
+                swim=swim.rejoin(self.params.swim, self._state.swim,
+                                 self.node_id(name)))
 
     def leave(self, name: str) -> None:
         with self._lock:

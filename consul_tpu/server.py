@@ -543,6 +543,10 @@ class Server:
         return self.raft_apply("config_entry_delete", kind=kind,
                                name=name)["index"]
 
+    def coordinate_batch_update(self, updates):
+        return self.raft_apply("coordinate_batch_update",
+                               updates=updates)["index"]
+
     # ------------------------------------------------------------- read side
     # Stale reads hit the local replica directly; the HTTP layer decides.
 

@@ -1,0 +1,106 @@
+"""Runtime-updatable agent tokens (the reference's agent/token/store.go).
+
+Four token slots drive which identity the agent itself uses:
+
+  default        — requests with no explicit token (also the DNS token)
+  agent          — the agent's own ops: catalog AE sync, check updates
+  agent_recovery — emergency local access (agent_master in older configs)
+  replication    — secondary-DC replicators
+
+`PUT /v1/agent/token/<slot>` updates a slot at runtime; when a
+`data_dir` is wired the slots persist across restarts (store.go
+persistence + Load).  Consumers (the HTTP token fallback, the DNS
+authorizer) read through the store on every use, so an update takes
+effect immediately — no subscription machinery needed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+# accepted slot aliases → canonical name (token/store.go's
+# agent_master/agent_recovery duality)
+_ALIASES = {
+    "default": "default",
+    "agent": "agent",
+    "agent_master": "agent_recovery",
+    "agent_recovery": "agent_recovery",
+    "replication": "replication",
+}
+
+
+class TokenStore:
+    def __init__(self, data_dir: Optional[str] = None):
+        self._lock = threading.Lock()
+        self._tokens: Dict[str, str] = {
+            "default": "", "agent": "", "agent_recovery": "",
+            "replication": ""}
+        # slots set from config files are not persisted; only API
+        # updates are (store.go WithPersistenceLock semantics)
+        self._from_api: set = set()
+        self.data_dir = data_dir
+        if data_dir:
+            self._load()
+
+    # ------------------------------------------------------------ access
+
+    @staticmethod
+    def canonical(slot: str) -> Optional[str]:
+        return _ALIASES.get(slot)
+
+    def get(self, slot: str) -> str:
+        name = _ALIASES.get(slot, slot)
+        with self._lock:
+            return self._tokens.get(name, "")
+
+    def user_token(self) -> str:
+        return self.get("default")
+
+    def agent_token(self) -> str:
+        """Agent ops fall back to the default token when no agent token
+        is set (store.go AgentToken fallback)."""
+        with self._lock:
+            return self._tokens["agent"] or self._tokens["default"]
+
+    def replication_token(self) -> str:
+        return self.get("replication")
+
+    def set(self, slot: str, token: str, from_api: bool = False) -> bool:
+        name = _ALIASES.get(slot)
+        if name is None:
+            return False
+        with self._lock:
+            self._tokens[name] = token
+            if from_api:
+                self._from_api.add(name)
+                self._persist()
+        return True
+
+    # ------------------------------------------------------- persistence
+
+    def _path(self) -> str:
+        return os.path.join(self.data_dir, "acl-tokens.json")
+
+    def _persist(self) -> None:
+        if not self.data_dir:
+            return
+        os.makedirs(self.data_dir, exist_ok=True)
+        data = {name: self._tokens[name] for name in self._from_api}
+        tmp = self._path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f)
+        os.replace(tmp, self._path())
+
+    def _load(self) -> None:
+        try:
+            with open(self._path()) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            return
+        for name, token in data.items():
+            if name in self._tokens:
+                self._tokens[name] = token
+                self._from_api.add(name)

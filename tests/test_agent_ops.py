@@ -1,0 +1,206 @@
+"""Agent operations surface: maintenance mode, token store, join,
+host info, coordinate pushes, datacenter listings, operator configs.
+
+Reference behaviors: agent.EnableNodeMaintenance (agent/agent.go),
+agent/token/store.go, coordinate_endpoint.go, operator endpoints.
+"""
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.cli.main import main
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.token_store import TokenStore
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=21))
+    a.start(tick_seconds=0.0, reconcile_interval=0.1)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+# ------------------------------------------------------------- maintenance
+
+def test_node_maintenance_roundtrip(client):
+    client.agent_maintenance(True, reason="upgrading kernel")
+    checks = client._call("GET", "/v1/agent/checks")[0]
+    assert "_node_maintenance" in checks
+    assert checks["_node_maintenance"]["Status"] == "critical"
+    assert "upgrading kernel" in checks["_node_maintenance"]["Output"]
+    client.agent_maintenance(False)
+    checks = client._call("GET", "/v1/agent/checks")[0]
+    assert "_node_maintenance" not in checks
+
+
+def test_service_maintenance_and_aggregated_health(client):
+    client.agent_service_register("mweb", service_id="mweb1", port=80,
+                                  check={"Name": "alive",
+                                         "Status": "passing"})
+    out = client.agent_health_service_by_id("mweb1")
+    assert out["AggregatedStatus"] == "passing"
+    client.agent_service_maintenance("mweb1", True, reason="redeploy")
+    out = client.agent_health_service_by_id("mweb1")
+    assert out["AggregatedStatus"] == "maintenance"
+    rows = client.agent_health_service_by_name("mweb")
+    assert rows[0]["AggregatedStatus"] == "maintenance"
+    client.agent_service_maintenance("mweb1", False)
+    out = client.agent_health_service_by_id("mweb1")
+    assert out["AggregatedStatus"] == "passing"
+
+
+def test_service_maintenance_unknown_id_404(client):
+    with pytest.raises(ApiError) as ei:
+        client.agent_service_maintenance("no-such-svc", True)
+    assert ei.value.code == 404
+
+
+def test_maint_cli(agent, capsys):
+    assert main(["-http-addr", agent.http_address, "maint"]) == 0
+    assert "normal mode" in capsys.readouterr().out
+    assert main(["-http-addr", agent.http_address, "maint",
+                 "-enable", "-reason", "cli test"]) == 0
+    capsys.readouterr()
+    assert main(["-http-addr", agent.http_address, "maint"]) == 0
+    out = capsys.readouterr().out
+    assert "node: maintenance enabled" in out
+    assert "cli test" in out
+    assert main(["-http-addr", agent.http_address, "maint",
+                 "-disable"]) == 0
+
+
+# ------------------------------------------------------------- token store
+
+def test_token_store_slots_and_fallback(tmp_path):
+    ts = TokenStore(data_dir=str(tmp_path))
+    assert ts.agent_token() == ""
+    ts.set("default", "tok-default", from_api=True)
+    # agent slot falls back to default until set (store.go AgentToken)
+    assert ts.agent_token() == "tok-default"
+    ts.set("agent", "tok-agent", from_api=True)
+    assert ts.agent_token() == "tok-agent"
+    # agent_master aliases agent_recovery
+    ts.set("agent_master", "tok-rec", from_api=True)
+    assert ts.get("agent_recovery") == "tok-rec"
+    # persistence: a fresh store over the same dir reloads API-set slots
+    ts2 = TokenStore(data_dir=str(tmp_path))
+    assert ts2.get("default") == "tok-default"
+    assert ts2.agent_token() == "tok-agent"
+
+
+def test_agent_token_route(client, agent):
+    client.agent_token_update("default", "runtime-token")
+    assert agent.api.tokens.user_token() == "runtime-token"
+    client.agent_token_update("default", "")
+    assert agent.api.tokens.user_token() == ""
+    with pytest.raises(ApiError) as ei:
+        client.agent_token_update("bogus_slot", "x")
+    assert ei.value.code == 404
+
+
+# ---------------------------------------------------------------- join
+
+def test_agent_join_revives_failed_member(client, agent):
+    import time
+    agent.oracle.kill("node3")
+    # the oracle's members snapshot is up to 1s stale: advance and poll
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        agent.oracle.advance(100)
+        time.sleep(0.25)
+        if agent.oracle.status("node3") != "alive":
+            break
+    assert agent.oracle.status("node3") != "alive"
+    client.agent_join("node3")
+    # the alive refutation needs gossip rounds to re-disseminate
+    deadline = time.time() + 10.0
+    while time.time() < deadline and \
+            agent.oracle.status("node3") != "alive":
+        agent.oracle.advance(100)
+        time.sleep(0.25)
+    assert agent.oracle.status("node3") == "alive"
+    with pytest.raises(ApiError):
+        client.agent_join("not-a-member")
+
+
+# ------------------------------------------------------------- host info
+
+def test_agent_host(client):
+    out = client.agent_host()
+    assert out["CPU"]["Cores"] >= 1
+    assert out["Memory"]["Total"] > 0
+    assert out["Host"]["OS"] == "linux"
+
+
+# ---------------------------------------------------- datacenters, coords
+
+def test_catalog_and_coordinate_datacenters(client):
+    assert client.catalog_datacenters() == ["dc1"]
+    dcs = client.coordinate_datacenters()
+    assert dcs[0]["Datacenter"] == "dc1"
+    assert dcs[0]["AreaID"] == "wan"
+
+
+def test_coordinate_update_external_node(client):
+    coord = {"Vec": [0.1] * 8, "Error": 1.5, "Adjustment": 0.0,
+             "Height": 1e-5}
+    assert client.coordinate_update("external-agent", coord)
+    rows = client.coordinate_node("external-agent")
+    assert rows and rows[0]["Coord"]["Vec"] == [0.1] * 8
+    # merged into the full listing alongside sim nodes
+    all_rows = client.coordinate_nodes()
+    names = {r["Node"] for r in all_rows}
+    assert "external-agent" in names and "node0" in names
+
+
+# ------------------------------------------------------- operator configs
+
+def test_autopilot_configuration_requires_server(client):
+    with pytest.raises(ApiError) as ei:
+        client._call("GET", "/v1/operator/autopilot/configuration")
+    assert ei.value.code == 400
+
+
+def test_ca_configuration_roundtrip(client):
+    out = client._call("GET", "/v1/connect/ca/configuration")[0]
+    assert out["Provider"] == "consul"
+    assert out["Config"]["LeafCertTTL"] == "72h"
+    client._call("PUT", "/v1/connect/ca/configuration", None,
+                 b'{"Config": {"LeafCertTTL": "24h"}}')
+    out = client._call("GET", "/v1/connect/ca/configuration")[0]
+    assert out["Config"]["LeafCertTTL"] == "24h"
+
+
+def test_agent_health_unknown_name_404(client):
+    with pytest.raises(ApiError) as ei:
+        client._call("GET", "/v1/agent/health/service/name/nope-svc")
+    assert ei.value.code == 404
+
+
+def test_blank_maintenance_reason_gets_default(client):
+    client._call("PUT", "/v1/agent/maintenance",
+                 {"enable": "true", "reason": ""})
+    checks = client._call("GET", "/v1/agent/checks")[0]
+    assert "no reason was provided" in \
+        checks["_node_maintenance"]["Output"]
+    client.agent_maintenance(False)
+
+
+def test_malformed_filter_fails_fast_on_blocking_query(client):
+    """A bad ?filter= must 400 immediately even with ?index/?wait."""
+    import time
+    idx = client._call("GET", "/v1/catalog/nodes")[1]
+    t0 = time.time()
+    with pytest.raises(ApiError) as ei:
+        client._call("GET", "/v1/catalog/nodes",
+                     {"index": idx, "wait": "30s", "filter": "Node =="})
+    assert ei.value.code == 400
+    assert time.time() - t0 < 5.0

@@ -1,0 +1,108 @@
+"""Filter expression language (?filter=) — go-bexpr analogue.
+
+Grammar/semantics mirror hashicorp/go-bexpr as used by the reference's
+list endpoints (agent/agent_endpoint.go AgentServices filter wiring).
+"""
+
+import pytest
+
+from consul_tpu.bexpr import BexprError, compile_filter
+
+
+ROW = {
+    "Node": "web-1",
+    "Address": "10.0.0.5",
+    "Service": {
+        "Service": "web",
+        "Tags": ["primary", "v2"],
+        "Port": 8080,
+        "Meta": {"env": "prod"},
+        "Connect": {"Native": False},
+    },
+    "Checks": [
+        {"Status": "passing", "Name": "serf"},
+        {"Status": "warning", "Name": "mem"},
+    ],
+}
+
+
+def f(expr):
+    return compile_filter(expr)(ROW)
+
+
+def test_equality_and_inequality():
+    assert f('Node == "web-1"')
+    assert not f('Node == "web-2"')
+    assert f('Node != "web-2"')
+    assert f('Service.Service == "web"')
+
+
+def test_numeric_and_bool_coercion():
+    assert f("Service.Port == 8080")
+    assert not f("Service.Port == 8081")
+    assert f("Service.Connect.Native == false")
+    assert not f("Service.Connect.Native == true")
+
+
+def test_contains_and_in_on_lists():
+    assert f('Service.Tags contains "primary"')
+    assert not f('Service.Tags contains "secondary"')
+    assert f('"v2" in Service.Tags')
+    assert f('"v3" not in Service.Tags')
+    assert not f('"v2" not in Service.Tags')
+
+
+def test_in_on_maps_and_strings():
+    assert f('"env" in Service.Meta')
+    assert not f('"region" in Service.Meta')
+    assert f('"10.0" in Address')
+
+
+def test_is_empty():
+    assert not f("Service.Tags is empty")
+    assert f("Service.Tags is not empty")
+    # unknown selector counts as empty rather than erroring the request
+    assert f("Service.Nope is empty")
+    assert not f('Service.Nope == "x"')
+
+
+def test_matches_regex():
+    assert f('Node matches "^web-[0-9]+$"')
+    assert not f('Node matches "^db-"')
+    assert f('Node not matches "^db-"')
+
+
+def test_logical_operators_and_parens():
+    assert f('Node == "web-1" and Service.Port == 8080')
+    assert not f('Node == "web-1" and Service.Port == 1')
+    assert f('Node == "nope" or Service.Service == "web"')
+    assert f('not (Node == "nope")')
+    assert f('(Node == "nope" or Node == "web-1") and '
+             'Service.Tags contains "v2"')
+
+
+def test_list_index_and_bracket_selectors():
+    assert f('Checks.0.Status == "passing"')
+    assert f('Service.Meta["env"] == "prod"')
+    assert f('Service["Tags"] contains "primary"')
+
+
+def test_case_insensitive_selector_fallback():
+    assert f('service.port == 8080')
+
+
+def test_parse_errors():
+    for bad in ("", "Node ==", "== x", "Node === \"y\"",
+                "(Node == \"x\"", "Node in", "Node is full"):
+        with pytest.raises(BexprError):
+            compile_filter(bad)
+
+
+def test_filter_list_helper():
+    rows = [
+        {"Status": "passing"},
+        {"Status": "critical"},
+        {"Status": "passing"},
+    ]
+    flt = compile_filter('Status == "passing"')
+    assert len(flt.filter(rows)) == 2

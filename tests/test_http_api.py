@@ -158,3 +158,34 @@ def test_snapshot_save_restore(client):
     client.kv_put("snap/x", b"clobbered")
     client.snapshot_restore(snap)
     assert client.kv_get("snap/x")[0]["Value"] == b"keep"
+
+
+def test_filter_expressions(client):
+    """?filter= bexpr filtering on catalog/health/agent endpoints
+    (go-bexpr; parseFilter wiring in agent/agent_endpoint.go)."""
+    client.agent_service_register("fweb", service_id="fweb1", port=8080,
+                                  tags=["primary"])
+    client.agent_service_register("fweb", service_id="fweb2", port=8081,
+                                  tags=["secondary"])
+    rows = client.catalog_service("fweb",
+                                  filter='ServicePort == 8080')
+    assert [r["ServiceID"] for r in rows] == ["fweb1"]
+    rows = client.catalog_service(
+        "fweb", filter='ServiceTags contains "secondary"')
+    assert [r["ServiceID"] for r in rows] == ["fweb2"]
+    health, _ = client.health_service(
+        "fweb", filter='Service.Port == 8081')
+    assert [h["Service"]["ID"] for h in health] == ["fweb2"]
+    # node filtering
+    nodes = client.catalog_nodes(filter='Node == "node0"')
+    assert [n["Node"] for n in nodes] == ["node0"]
+    assert client.catalog_nodes(filter='Node == "no-such"') == []
+    # agent services endpoint takes the same expressions
+    out = client._call("GET", "/v1/agent/services",
+                       {"filter": 'Service == "fweb" and Port == 8080'})[0]
+    assert list(out) == ["fweb1"]
+    # malformed filter is a 400, not a 500
+    from consul_tpu.api.client import ApiError
+    with pytest.raises(ApiError) as ei:
+        client.catalog_nodes(filter='Node ==')
+    assert ei.value.code == 400

@@ -98,3 +98,23 @@ def test_timer_formulas_match_memberlist():
     assert g.suspicion_min_ticks(1000) == 4 * 3 * 5
     w = GossipConfig.wan()
     assert w.probe_period_ticks == 10  # 5s probe / 0.5s gossip
+
+
+def test_rejoin_after_committed_death():
+    """A node the cluster declared dead rejoins with a higher
+    incarnation and the stale belief clears cluster-wide (memberlist
+    rejoin; serf snapshot rejoin server_serf.go:169-172)."""
+    params, s = make(128, p_loss=0.0)
+    s, _ = run_n(params, s, 20)
+    inc_before = int(s.incarnation[9])
+    s = swim.kill(s, 9)
+    s, frac = run_n(params, s, 400, monitor=9)
+    assert np.asarray(frac)[-1] > 0.99
+    assert bool(s.committed_dead[9])
+    s = swim.rejoin(params, s, 9)
+    assert not bool(s.committed_dead[9])
+    assert int(s.incarnation[9]) == inc_before + 1
+    s, frac = run_n(params, s, 200, monitor=9)
+    assert np.asarray(frac)[-1] < 0.01, "alive refutation did not spread"
+    assert not bool(s.committed_dead[9])
+    assert bool(s.up[9]) and bool(s.member[9])
