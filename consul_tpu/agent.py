@@ -43,6 +43,8 @@ class Agent:
                 data_dir=rc.data_dir or None,
                 enable_remote_exec=rc.enable_remote_exec)
         a.runtime_config = rc
+        a.api.wan_fed_via_gateways = \
+            rc.connect_mesh_gateway_wan_federation
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
         a._apply_reloadable(rc)

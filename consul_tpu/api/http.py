@@ -129,6 +129,10 @@ class ApiServer:
         # multi-DC: a WanRouter enables ?dc= forwarding + query failover
         # (agent/consul/rpc.go:658 forwardDC)
         self.router = None
+        # wanfed: when on, ?dc= forwarding dials the target DC's mesh
+        # gateway from replicated federation states instead of a direct
+        # route (consul_tpu/wanfed.py; wanfed.go:39)
+        self.wan_fed_via_gateways = False
         # Connect CA (lazy: cert generation costs entropy/CPU at boot)
         self._ca = None
         self._ca_lock = threading.Lock()
@@ -184,7 +188,7 @@ class ApiServer:
                 if self._proxycfg is None:
                     from consul_tpu.proxycfg import Manager
                     self._proxycfg = Manager(
-                        self.store, self.ca,
+                        self.store, self.ca, dc=self.dc,
                         default_allow=self.default_allow)
         return self._proxycfg
 
@@ -210,7 +214,10 @@ class ApiServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self.httpd.shutdown()
+        # shutdown() handshakes with serve_forever — calling it on a
+        # never-started server parks forever on the internal event
+        if self._thread is not None:
+            self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:
             self._thread.join(timeout=5.0)
@@ -417,7 +424,12 @@ def _make_handler(srv: ApiServer):
             (Kind=connect-proxy) registrations carry their Proxy config
             to the catalog directly — proxycfg discovers them there."""
             name = body.get("Name", sid)
-            if body.get("Kind") == "connect-proxy":
+            if body.get("Kind") in ("connect-proxy", "mesh-gateway",
+                                    "ingress-gateway",
+                                    "terminating-gateway"):
+                # mesh data-plane services (sidecars + the three gateway
+                # kinds) register store-side with Kind/Proxy intact —
+                # proxycfg discovers them in the catalog
                 proxy_raw = body.get("Proxy") or {}
                 proxy = {
                     "destination_service": proxy_raw.get(
@@ -436,7 +448,7 @@ def _make_handler(srv: ApiServer):
                     tags=body.get("Tags") or [],
                     meta=body.get("Meta") or {},
                     address=body.get("Address", ""),
-                    kind="connect-proxy", proxy=proxy)
+                    kind=body["Kind"], proxy=proxy)
                 # checks attached to the sidecar register store-side
                 # AND arm their runners, notifying the store directly
                 # (sidecars bypass local state, so runner results can't
@@ -574,12 +586,22 @@ def _make_handler(srv: ApiServer):
             import urllib.request
             from consul_tpu.router import NoPathError
             dc = q.pop("dc")
-            try:
-                handle = srv.router.handle(dc)
-            except NoPathError as e:
-                self._err(500, str(e))
-                return True
-            addr = getattr(handle, "http_address", None)
+            addr = None
+            if srv.wan_fed_via_gateways:
+                # wanfed: the remote DC is reachable only through its
+                # mesh gateway, located from replicated federation
+                # states (wanfed.go; gateway_locator.go)
+                from consul_tpu.wanfed import gateway_address
+                gw = gateway_address(store, dc)
+                if gw is not None:
+                    addr = f"http://{gw[0]}:{gw[1]}"
+            if addr is None and srv.router is not None:
+                try:
+                    handle = srv.router.handle(dc)
+                except NoPathError as e:
+                    self._err(500, str(e))
+                    return True
+                addr = getattr(handle, "http_address", None)
             if addr is None:
                 self._err(500, f"No path to datacenter: {dc!r}")
                 return True
@@ -617,7 +639,7 @@ def _make_handler(srv: ApiServer):
                 self._filter = None
             if q.get("dc") not in (None, "", srv.dc) \
                     and path.startswith(self._DC_FORWARDABLE):
-                if srv.router is None:
+                if srv.router is None and not srv.wan_fed_via_gateways:
                     self._err(500,
                               f"No path to datacenter: {q['dc']!r}")
                     return True
@@ -1230,6 +1252,30 @@ def _make_handler(srv: ApiServer):
                                           key=lambda r: r["Node"])
                 self._send(out, index=idx)
                 return True
+            m = re.fullmatch(r"/v1/catalog/gateway-services/(.+)", path)
+            if m and verb == "GET":
+                # services bound to a gateway via its config entry
+                # (catalog_endpoint.go GatewayServices)
+                gw = m.group(1)
+                if not self.authz.service_read(gw):
+                    return self._forbid()
+                from consul_tpu import gateways as gmod
+                idx = self._block(q, ("config", ""))
+                rows = [r for r in gmod.gateway_services(store, gw)
+                        if r["Service"] == gmod.WILDCARD
+                        or self.authz.service_read(r["Service"])]
+                self._send(rows, index=idx)
+                return True
+            m = re.fullmatch(r"/v1/catalog/connect/(.+)", path)
+            if m and verb == "GET":
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
+                idx = self._block(q, ("services", ""), ("nodes", ""))
+                rows = store.connect_service_nodes(m.group(1))
+                self._send(self._filtered(
+                    q, [_catalog_service_json(r) for r in rows]),
+                    index=idx)
+                return True
             m = re.fullmatch(r"/v1/catalog/node/(.+)", path)
             if m and verb == "GET":
                 node = m.group(1)
@@ -1301,6 +1347,40 @@ def _make_handler(srv: ApiServer):
                                           key=lambda r: r["Node"]["Node"])
                 self._send(out, index=idx, extra_headers=(
                     {"X-Cache": cache_state} if cache_state else None))
+                return True
+            m = re.fullmatch(r"/v1/health/connect/(.+)", path)
+            if m and verb == "GET":
+                # mesh-capable (sidecar) instances of the service
+                # (health_endpoint.go Connect=true path)
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
+                idx = self._block(q, ("health", ""), ("nodes", ""))
+                rows = store.health_connect_nodes(
+                    m.group(1), passing_only="passing" in q)
+                self._send(self._filtered(
+                    q, [_health_json(r, store) for r in rows]),
+                    index=idx)
+                return True
+            m = re.fullmatch(r"/v1/health/ingress/(.+)", path)
+            if m and verb == "GET":
+                # ingress gateways exposing the service: health rows of
+                # the GATEWAY instances (health_endpoint.go Ingress=true)
+                if not self.authz.service_read(m.group(1)):
+                    return self._forbid()
+                from consul_tpu import gateways as gmod
+                idx = self._block(q, ("config", ""), ("health", ""))
+                out, seen_gw = [], set()
+                for row in gmod.ingress_gateways_for(store, m.group(1)):
+                    gw_name = row["Gateway"]
+                    # one health row set per gateway even when the
+                    # service is bound on several of its listeners
+                    if gw_name in seen_gw or \
+                            not self.authz.service_read(gw_name):
+                        continue
+                    seen_gw.add(gw_name)
+                    out += [_health_json(r, store) for r in
+                            store.health_service_nodes(gw_name)]
+                self._send(out, index=idx)
                 return True
             m = re.fullmatch(r"/v1/health/node/(.+)", path)
             if m and verb == "GET":
@@ -2471,11 +2551,26 @@ def _kv_json(e: dict) -> dict:
 
 
 def _catalog_service_json(r: dict) -> dict:
-    return {"Node": r["node"], "Address": r["address"],
-            "ServiceID": r["service_id"], "ServiceName": r["service_name"],
-            "ServiceTags": r["tags"], "ServicePort": r["port"],
-            "ServiceAddress": r["service_address"],
-            "ModifyIndex": r["modify_index"]}
+    out = {"Node": r["node"], "Address": r["address"],
+           "ServiceID": r["service_id"], "ServiceName": r["service_name"],
+           "ServiceTags": r["tags"], "ServicePort": r["port"],
+           "ServiceAddress": r["service_address"],
+           "ModifyIndex": r["modify_index"]}
+    # mesh rows carry their kind + proxy config (structs.ServiceNode
+    # ServiceKind/ServiceProxy) — /v1/catalog/connect is useless without
+    # the proxy's destination
+    if r.get("kind"):
+        proxy = r.get("proxy") or {}
+        out["ServiceKind"] = r["kind"]
+        out["ServiceProxy"] = {
+            "DestinationServiceName": proxy.get(
+                "destination_service", ""),
+            "Upstreams": [
+                {"DestinationName": u.get("destination_name", ""),
+                 "LocalBindPort": u.get("local_bind_port", 0)}
+                for u in proxy.get("upstreams") or []],
+        }
+    return out
 
 
 def _check_json(c: dict, node: str) -> dict:

@@ -479,6 +479,48 @@ class StateStore:
                              "modify_index": v["modify_index"]})
             return rows
 
+    def connect_service_nodes(self, name: str) -> List[dict]:
+        """Mesh-capable instances for `name`: sidecar proxies whose
+        destination is `name` (Catalog.ServiceNodes with Connect=true —
+        agent/consul/state/catalog.go serviceNodesConnect)."""
+        with self._lock:
+            rows = []
+            for (node, sid), v in sorted(self._services.items()):
+                if v.get("kind") != "connect-proxy":
+                    continue
+                dest = (v.get("proxy") or {}).get(
+                    "destination_service", "")
+                if dest != name:
+                    continue
+                nrec = self._nodes.get(node, {})
+                rows.append({"node": node,
+                             "address": nrec.get("address", ""),
+                             "service_id": sid,
+                             "service_name": v["name"],
+                             "port": v["port"], "tags": v["tags"],
+                             "service_address": v["address"],
+                             "kind": v.get("kind", ""),
+                             "proxy": v.get("proxy", {}),
+                             "modify_index": v["modify_index"]})
+            return rows
+
+    def health_connect_nodes(self, name: str,
+                             passing_only: bool = False) -> List[dict]:
+        """health_service_nodes over the connect (proxy) instances
+        (Health.ServiceNodes Connect=true, health_endpoint.go)."""
+        with self._lock:
+            rows = []
+            for svc in self.connect_service_nodes(name):
+                node, sid = svc["node"], svc["service_id"]
+                checks = [dict(c, check_id=cid, node=n)
+                          for (n, cid), c in sorted(self._checks.items())
+                          if n == node and c["service_id"] in ("", sid)]
+                if passing_only and any(c["status"] != "passing"
+                                        for c in checks):
+                    continue
+                rows.append({"service": svc, "checks": checks})
+            return rows
+
     def health_service_nodes(self, name: str, tag: Optional[str] = None,
                              passing_only: bool = False) -> List[dict]:
         """GET /v1/health/service/<name> (agent/consul/health_endpoint.go:174):
@@ -900,6 +942,22 @@ class StateStore:
         from consul_tpu.discoverychain import KINDS
         if kind not in KINDS:
             raise ValueError(f"unsupported config entry kind {kind!r}")
+        if kind == "ingress-gateway":
+            # tcp carries no routing discriminator: exactly one service
+            # per tcp listener (structs/config_entry_gateways.go
+            # validation); a wildcard cannot be a tcp target either
+            for li in body.get("listeners") or []:
+                svcs = li.get("services") or []
+                if li.get("protocol", "tcp") == "tcp":
+                    if len(svcs) != 1:
+                        raise ValueError(
+                            f"ingress tcp listener on port "
+                            f"{li.get('port', 0)} must have exactly "
+                            f"one service, got {len(svcs)}")
+                    if svcs[0].get("name", "") == "*":
+                        raise ValueError(
+                            "ingress tcp listener cannot bind the "
+                            "wildcard service")
         with self._lock:
             idx = self._bump([("config", f"{kind}/{name}")])
             existing = self._config_entries.get((kind, name), {})

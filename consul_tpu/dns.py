@@ -217,7 +217,9 @@ class DNSServer:
 
     def stop(self) -> None:
         for srv in (self.udp, self.tcp):
-            srv.shutdown()
+            # shutdown() parks forever unless serve_forever is running
+            if self._threads:
+                srv.shutdown()
             srv.server_close()
         for t in self._threads:
             t.join(timeout=2.0)

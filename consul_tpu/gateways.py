@@ -1,0 +1,122 @@
+"""Gateway wiring: ingress / terminating / mesh gateway catalog views.
+
+The reference derives a GatewayServices mapping from the
+`ingress-gateway` and `terminating-gateway` config entries
+(agent/consul/state/config_entry.go gateway-services table,
+catalog_endpoint.go GatewayServices) and feeds it to proxycfg's
+per-kind snapshot assembly (agent/proxycfg/state.go).  This module
+derives the same mapping on demand from the config-entry store — the
+store stays schema-light, the mapping is pure function of entries.
+
+Config entry shapes (lower-cased keys, matching config_entry_set):
+
+  ingress-gateway:     {"listeners": [{"port": 8080, "protocol": "http",
+                         "services": [{"name": "web", "hosts": [...]}]}]}
+  terminating-gateway: {"services": [{"name": "legacy", "ca_file": ...,
+                         "sni": ...}]}
+
+A `{"name": "*"}` service entry is the wildcard: the gateway exposes
+every service (structs.WildcardSpecifier).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+WILDCARD = "*"
+
+GATEWAY_KINDS = ("mesh-gateway", "ingress-gateway",
+                 "terminating-gateway")
+
+
+def gateway_services(store, gateway_name: str) -> List[dict]:
+    """All services bound to `gateway_name`, in the
+    /v1/catalog/gateway-services/<gw> row shape."""
+    out: List[dict] = []
+    ent = store.config_entry_get("ingress-gateway", gateway_name)
+    if ent is not None:
+        for lst in ent.get("listeners") or []:
+            for s in lst.get("services") or []:
+                out.append({
+                    "Gateway": gateway_name,
+                    "Service": s.get("name", ""),
+                    "GatewayKind": "ingress-gateway",
+                    "Port": lst.get("port", 0),
+                    "Protocol": lst.get("protocol", "tcp"),
+                    "Hosts": s.get("hosts") or [],
+                })
+    ent = store.config_entry_get("terminating-gateway", gateway_name)
+    if ent is not None:
+        for s in ent.get("services") or []:
+            out.append({
+                "Gateway": gateway_name,
+                "Service": s.get("name", ""),
+                "GatewayKind": "terminating-gateway",
+                "CAFile": s.get("ca_file", ""),
+                "CertFile": s.get("cert_file", ""),
+                "KeyFile": s.get("key_file", ""),
+                "SNI": s.get("sni", ""),
+            })
+    return out
+
+
+def _bound_services(store, row_filter) -> List[dict]:
+    """Scan every gateway config entry; keep rows row_filter accepts."""
+    rows = []
+    for ent in store.config_entry_list("ingress-gateway") + \
+            store.config_entry_list("terminating-gateway"):
+        for row in gateway_services(store, ent["name"]):
+            if row_filter(row):
+                rows.append(row)
+    return rows
+
+
+def ingress_gateways_for(store, service: str) -> List[dict]:
+    """Ingress gateways exposing `service` (state ServiceGateways used
+    by /v1/health/ingress/<svc>).  Wildcard listeners match any."""
+    return _bound_services(
+        store, lambda r: r["GatewayKind"] == "ingress-gateway"
+        and r["Service"] in (service, WILDCARD))
+
+
+def terminating_gateways_for(store, service: str) -> List[dict]:
+    return _bound_services(
+        store, lambda r: r["GatewayKind"] == "terminating-gateway"
+        and r["Service"] in (service, WILDCARD))
+
+
+def resolve_wildcard(store, rows: List[dict]) -> List[dict]:
+    """Expand `*` rows into one row per registered service name,
+    excluding connect proxies and other gateways (the reference's
+    wildcard expansion skips Kind != typical).
+
+    Explicit bindings win over wildcard expansion, and duplicates are
+    dropped — a service bound both ways must yield ONE row (one SNI
+    filter chain; Envoy rejects duplicate filter-chain matches)."""
+    out: List[dict] = []
+    seen = set()
+
+    def key(r, svc):
+        return (r["Gateway"], r["GatewayKind"], svc, r.get("Port", 0))
+
+    # explicit rows first: they carry per-service settings (sni,
+    # ca_file) the wildcard defaults would otherwise mask
+    for row in rows:
+        if row["Service"] == WILDCARD:
+            continue
+        if key(row, row["Service"]) not in seen:
+            seen.add(key(row, row["Service"]))
+            out.append(row)
+    for row in rows:
+        if row["Service"] != WILDCARD:
+            continue
+        for name in store.services():
+            kinds = {s.get("kind", "")
+                     for s in store.service_nodes(name)}
+            if kinds - {""}:
+                continue  # proxies/gateways are not exposable targets
+            if key(row, name) in seen:
+                continue
+            seen.add(key(row, name))
+            out.append(dict(row, Service=name))
+    return out

@@ -23,13 +23,27 @@ _LEAF_REFRESH_FRACTION = 0.75
 
 
 class ConfigSnapshot:
-    """One proxy's full mesh view (proxycfg.ConfigSnapshot)."""
+    """One proxy's full mesh view (proxycfg.ConfigSnapshot).
+
+    `kind` selects the per-kind extras (proxycfg's
+    configSnapshotConnectProxy / MeshGateway / TerminatingGateway /
+    IngressGateway unions):
+      mesh-gateway:        mesh_endpoints (local svc -> endpoints),
+                           federation_states (remote dc -> gateways)
+      terminating-gateway: gateway_services rows + per-service leaves
+      ingress-gateway:     listeners from the config entry
+    """
 
     def __init__(self, proxy_id: str, service: str, upstreams: List[dict],
                  roots: List[dict], leaf: dict,
                  upstream_endpoints: Dict[str, List[dict]],
                  intentions: List[dict], default_allow: bool,
-                 version: int):
+                 version: int, kind: str = "connect-proxy",
+                 gateway_services: Optional[List[dict]] = None,
+                 service_leaves: Optional[Dict[str, dict]] = None,
+                 mesh_endpoints: Optional[Dict[str, List[dict]]] = None,
+                 federation_states: Optional[List[dict]] = None,
+                 listeners: Optional[List[dict]] = None):
         self.proxy_id = proxy_id
         self.service = service
         self.upstreams = upstreams
@@ -39,6 +53,12 @@ class ConfigSnapshot:
         self.intentions = intentions
         self.default_allow = default_allow
         self.version = version
+        self.kind = kind
+        self.gateway_services = gateway_services or []
+        self.service_leaves = service_leaves or {}
+        self.mesh_endpoints = mesh_endpoints or {}
+        self.federation_states = federation_states or []
+        self.listeners = listeners or []
 
 
 class ProxyState:
@@ -56,6 +76,9 @@ class ProxyState:
         # at 1 it would read as no-change
         self._version = start_version
         self._subs = []
+        # ingress/terminating gateways: per-bound-service health subs,
+        # resynced after each rebuild as bindings change
+        self._health_subs: Dict[str, object] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
 
@@ -64,13 +87,31 @@ class ProxyState:
         self._rebuild()
         pub = self.manager.store.publisher
         proxy = self.svc.get("proxy") or {}
+        kind = self.svc.get("kind", "connect-proxy")
         # CA topic included: a root rotation must rebuild every proxy
         # snapshot without waiting for unrelated churn
         topics = [("intentions", None), ("ca", None)]
-        for up in proxy.get("upstreams") or []:
-            topics.append(("health", up.get("destination_name", "")))
+        if kind == "connect-proxy":
+            for up in proxy.get("upstreams") or []:
+                topics.append(("health", up.get("destination_name", "")))
+        elif kind == "mesh-gateway":
+            # a mesh gateway genuinely fronts every local service and
+            # every remote DC: topic-wide health + federation watches
+            # are its real dependency set (proxycfg/state.go mesh-gw)
+            topics += [("config", None), ("health", None),
+                       ("federation", None)]
+        else:
+            # ingress/terminating: bindings live in THIS gateway's own
+            # config entry; endpoint health is per bound service, and
+            # _sync_health_subs re-keys those after every rebuild —
+            # unrelated config writes or check flaps elsewhere must not
+            # re-run the full snapshot scan
+            gw_kind = kind
+            topics += [("config", f"{gw_kind}/{self.svc.get('name', '')}"),
+                       ("services", None)]
         self._subs = [pub.subscribe(t, k, since_index=None)
                       for t, k in topics]
+        self._sync_health_subs()
         self._thread = threading.Thread(target=self._follow, daemon=True)
         self._thread.start()
 
@@ -80,16 +121,36 @@ class ProxyState:
             # wake parked fetchers so they re-poll (and land on the
             # replacement state) instead of sleeping out their wait
             self._cond.notify_all()
-        for s in self._subs:
+        for s in list(self._subs) + list(self._health_subs.values()):
             s.close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+
+    def _sync_health_subs(self) -> None:
+        """Re-key per-service health subscriptions to the gateway's
+        CURRENT bound services (bindings change with its config entry;
+        a stale watch set would miss new services or churn on dropped
+        ones).  Runs in whichever thread just rebuilt — the follow loop
+        snapshots the sub lists, so mutation here is safe."""
+        kind = self.svc.get("kind", "connect-proxy")
+        if kind not in ("ingress-gateway", "terminating-gateway"):
+            return
+        snap = self._snapshot
+        want = {row["Service"] for row in
+                (snap.gateway_services if snap is not None else [])}
+        pub = self.manager.store.publisher
+        for svc in list(self._health_subs):
+            if svc not in want:
+                self._health_subs.pop(svc).close()
+        for svc in want - set(self._health_subs):
+            self._health_subs[svc] = pub.subscribe(
+                "health", svc, since_index=None)
 
     def _follow(self) -> None:
         from consul_tpu.stream.publisher import SnapshotRequired
         while self._running:
             fired = False
-            for s in self._subs:
+            for s in list(self._subs) + list(self._health_subs.values()):
                 try:
                     if s.events(timeout=0.2):
                         fired = True
@@ -100,26 +161,37 @@ class ProxyState:
             if fired:
                 self._rebuild()
 
+    def _healthy_endpoints(self, name: str) -> List[dict]:
+        rows = self.manager.store.health_service_nodes(name)
+        eps = []
+        for r in rows:
+            if any(c["status"] == "critical" for c in r["checks"]):
+                continue
+            s = r["service"]
+            eps.append({"address": s.get("service_address")
+                        or s.get("address", ""),
+                        "port": s.get("port", 0),
+                        "node": s.get("node", "")})
+        return eps
+
     def _rebuild(self) -> None:
+        kind = self.svc.get("kind", "connect-proxy")
+        if kind in ("mesh-gateway", "ingress-gateway",
+                    "terminating-gateway"):
+            self._rebuild_gateway(kind)
+        else:
+            self._rebuild_connect_proxy()
+
+    def _rebuild_connect_proxy(self) -> None:
         m = self.manager
         proxy = self.svc.get("proxy") or {}
         service = proxy.get("destination_service",
                             self.svc.get("name", ""))
         upstreams = proxy.get("upstreams") or []
-        endpoints: Dict[str, List[dict]] = {}
-        for up in upstreams:
-            name = up.get("destination_name", "")
-            rows = m.store.health_service_nodes(name)
-            eps = []
-            for r in rows:
-                if any(c["status"] == "critical" for c in r["checks"]):
-                    continue
-                s = r["service"]
-                eps.append({"address": s.get("service_address")
-                            or s.get("address", ""),
-                            "port": s.get("port", 0),
-                            "node": s.get("node", "")})
-            endpoints[name] = eps
+        endpoints = {up.get("destination_name", ""):
+                     self._healthy_endpoints(
+                         up.get("destination_name", ""))
+                     for up in upstreams}
         relevant = imod.match_order(m.store.intention_list(), service,
                                     "destination")
         leaf = m.get_leaf(service)
@@ -131,6 +203,67 @@ class ProxyState:
                 upstream_endpoints=endpoints, intentions=relevant,
                 default_allow=m.default_allow, version=self._version)
             self._cond.notify_all()
+
+    def _rebuild_gateway(self, kind: str) -> None:
+        """Per-kind gateway snapshot (proxycfg/state.go
+        initialize/handleUpdate for MeshGateway / TerminatingGateway /
+        IngressGateway)."""
+        from consul_tpu import gateways as gmod
+        m = self.manager
+        gw_name = self.svc.get("name", "")
+        endpoints: Dict[str, List[dict]] = {}
+        bound: List[dict] = []
+        service_leaves: Dict[str, dict] = {}
+        mesh_endpoints: Dict[str, List[dict]] = {}
+        federation: List[dict] = []
+        listeners: List[dict] = []
+        intentions: List[dict] = []
+        if kind == "mesh-gateway":
+            # every local connect-capable service is routable through
+            # the mesh gateway by SNI; remote DCs resolve through their
+            # federation-state gateway lists (state.go mesh-gw watches)
+            for name in m.store.services():
+                kinds = {s.get("kind", "")
+                         for s in m.store.service_nodes(name)}
+                if kinds - {""}:
+                    continue
+                mesh_endpoints[name] = self._healthy_endpoints(name)
+            federation = [f for f in m.store.federation_state_list()
+                          if f["datacenter"] != m.dc]
+        elif kind == "terminating-gateway":
+            bound = gmod.resolve_wildcard(
+                m.store, gmod.gateway_services(m.store, gw_name))
+            for row in bound:
+                svc = row["Service"]
+                endpoints[svc] = self._healthy_endpoints(svc)
+                # the terminating gateway presents a mesh identity for
+                # each service it fronts (leader_connect_ca leaf per
+                # GatewayService)
+                service_leaves[svc] = m.get_leaf(svc)
+                intentions += imod.match_order(
+                    m.store.intention_list(), svc, "destination")
+        elif kind == "ingress-gateway":
+            ent = m.store.config_entry_get("ingress-gateway", gw_name)
+            listeners = (ent.get("listeners") or []) if ent else []
+            bound = gmod.resolve_wildcard(
+                m.store, gmod.gateway_services(m.store, gw_name))
+            for row in bound:
+                endpoints[row["Service"]] = \
+                    self._healthy_endpoints(row["Service"])
+        leaf = m.get_leaf(gw_name)
+        with self._cond:
+            self._version += 1
+            self._snapshot = ConfigSnapshot(
+                proxy_id=self.proxy_id, service=gw_name,
+                upstreams=[], roots=m.ca.roots(), leaf=leaf,
+                upstream_endpoints=endpoints, intentions=intentions,
+                default_allow=m.default_allow, version=self._version,
+                kind=kind, gateway_services=bound,
+                service_leaves=service_leaves,
+                mesh_endpoints=mesh_endpoints,
+                federation_states=federation, listeners=listeners)
+            self._cond.notify_all()
+        self._sync_health_subs()
 
     def fetch(self, min_version: int = 0,
               timeout: float = 300.0) -> ConfigSnapshot:
@@ -149,9 +282,11 @@ class Manager:
     """Proxy registry (proxycfg.Manager): one ProxyState per registered
     sidecar, created lazily from the catalog's connect-proxy services."""
 
-    def __init__(self, store, ca, default_allow: bool = True):
+    def __init__(self, store, ca, default_allow: bool = True,
+                 dc: Optional[str] = None):
         self.store = store
         self.ca = ca
+        self.dc = dc or getattr(ca, "dc", "dc1")
         self.default_allow = default_allow
         # svc -> (root_id, leaf, refresh_deadline)
         self._leaves: Dict[str, Tuple[str, dict, float]] = {}
@@ -203,7 +338,9 @@ class Manager:
 
     def _find_proxy(self, proxy_id: str) -> Optional[dict]:
         s = self.store.service_by_id(proxy_id)
-        if s is not None and s.get("kind") == "connect-proxy":
+        if s is not None and s.get("kind") in (
+                "connect-proxy", "mesh-gateway", "ingress-gateway",
+                "terminating-gateway"):
             return s
         return None
 

@@ -192,6 +192,10 @@ class RuntimeConfig:
     gossip_wan: Tuple[Tuple[str, Any], ...] = ()
     # sim sizing (the TPU pool)
     sim: Tuple[Tuple[str, Any], ...] = ()
+    # connect{enable_mesh_gateway_wan_federation}: route cross-DC
+    # requests through mesh gateways from replicated federation states
+    # (agent/consul/wanfed; config runtime.go ConnectMeshGatewayWANFederationEnabled)
+    connect_mesh_gateway_wan_federation: bool = False
     # dns_config{only_passing, node_ttl, service_ttl, domain}
     dns_only_passing: bool = False
     dns_node_ttl: int = 0
@@ -340,6 +344,9 @@ class Builder:
             acl_default_policy=dp,
             acl_down_policy=down,
             acl_agent_token=tokens.get("agent", ""),
+            connect_mesh_gateway_wan_federation=bool(
+                (m.get("connect") or {}).get(
+                    "enable_mesh_gateway_wan_federation", False)),
             gossip_lan=gossip_block("gossip_lan"),
             gossip_wan=gossip_block("gossip_wan"),
             sim=tuple(sorted(sim.items())),

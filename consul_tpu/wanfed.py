@@ -1,0 +1,126 @@
+"""WAN federation through mesh gateways (wanfed).
+
+The reference can route ALL cross-DC traffic — WAN gossip and RPC —
+through mesh gateways instead of requiring every server to reach every
+remote server directly (agent/consul/wanfed/wanfed.go:39 NewTransport,
+gateway_locator.go, config `connect.enable_mesh_gateway_wan_federation`).
+Remote DCs are then addressed by their gateways, which are discovered
+from replicated federation states.
+
+Host-side equivalent here:
+
+  * `MeshGatewayForwarder` — the gateway's federation data plane: a TCP
+    listener that splices every accepted connection to the local DC's
+    serving address (the reference's gateway does the same forwarding
+    via SNI/ALPN routing; a single local target suffices because one
+    handle fronts each DC here).
+  * `gateway_address(store, dc)` — the GatewayLocator: pick the target
+    DC's gateway from the LOCAL store's replicated federation states.
+  * The HTTP layer's ?dc= forwarding consults the locator when
+    `wan_fed_via_gateways` is on, so dc1 reaches dc2 with NO direct
+    route to dc2's servers — only dc2's gateway is dialed.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional, Tuple
+
+
+class MeshGatewayForwarder:
+    """Federation data plane of one mesh gateway: accept → connect to
+    the local serving address → splice bytes both ways until either
+    side closes."""
+
+    def __init__(self, target_host: str, target_port: int,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.target = (target_host, target_port)
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.host, self.port = self._lsock.getsockname()
+        self._running = False
+        self._accept_thread: Optional[threading.Thread] = None
+        # live splice threads, joined on stop so no pump outlives us
+        self._pumps: list = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        for t in self._pumps:
+            t.join(timeout=2.0)
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    # ------------------------------------------------------------- data path
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return  # listener closed
+            try:
+                upstream = socket.create_connection(self.target,
+                                                    timeout=10.0)
+            except OSError:
+                conn.close()
+                continue
+            # prune finished pumps first: a long-lived gateway must not
+            # accumulate two Thread objects per connection forever
+            self._pumps = [t for t in self._pumps if t.is_alive()]
+            for a, b in ((conn, upstream), (upstream, conn)):
+                t = threading.Thread(target=self._pump, args=(a, b),
+                                     daemon=True)
+                t.start()
+                self._pumps.append(t)
+
+    @staticmethod
+    def _pump(src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                data = src.recv(65536)
+                if not data:
+                    break
+                dst.sendall(data)
+        except OSError:
+            pass
+        finally:
+            # half-close so the peer's pump drains and exits too
+            for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+                try:
+                    s.shutdown(how)
+                except OSError:
+                    pass
+
+
+def gateway_address(store, dc: str) -> Optional[Tuple[str, int]]:
+    """GatewayLocator: the first known mesh gateway of `dc` from the
+    locally replicated federation states (gateway_locator.go picks from
+    fallback + primary gateways; federation states replicate DC→gateway
+    lists)."""
+    fs = store.federation_state_get(dc)
+    if not fs:
+        return None
+    for gw in fs.get("mesh_gateways", []):
+        addr, port = gw.get("address", ""), gw.get("port", 0)
+        if addr and port:
+            return (addr, port)
+    return None

@@ -151,17 +151,210 @@ def _trust_domain(snap) -> str:
     return "consul"
 
 
+# ---------------------------------------------------------------------------
+# gateway resource generation (agent/xds listeners/clusters per kind:
+# makeMeshGatewayListener, makeTerminatingGatewayListener,
+# makeIngressGatewayListeners)
+# ---------------------------------------------------------------------------
+
+def _eds_cluster(name: str, eps: List[dict]) -> List[dict]:
+    return [
+        {"@type": "envoy.config.cluster.v3.Cluster", "name": name,
+         "type": "EDS", "connect_timeout": "5s"},
+        {"@type": "envoy.config.endpoint.v3.ClusterLoadAssignment",
+         "cluster_name": name,
+         "endpoints": [{"lb_endpoints": [
+             {"endpoint": {"address": {"socket_address": {
+                 "address": e["address"] or "127.0.0.1",
+                 "port_value": e["port"]}}}} for e in eps]}]},
+    ]
+
+
+def mesh_gateway_resources(snap) -> dict:
+    """SNI-routed L4 gateway: local services by their mesh SNI, remote
+    DCs by a wildcard `*.<dc>` SNI toward that DC's gateways (the
+    reference's mesh-gateway listener + cluster-per-dc shape)."""
+    td = _trust_domain(snap)
+    cl, eds, chains = [], [], []
+    for svc, eps in sorted(snap.mesh_endpoints.items()):
+        cname = f"local.{svc}"
+        c, e = _eds_cluster(cname, eps)
+        cl.append(c)
+        eds.append(e)
+        chains.append({
+            "filter_chain_match": {
+                "server_names": [f"{svc}.default.{td}"]},
+            "filters": [{"name": "envoy.filters.network.sni_cluster"},
+                        {"name": "envoy.filters.network.tcp_proxy",
+                         "cluster": cname}],
+        })
+    for fed in snap.federation_states:
+        dc = fed["datacenter"]
+        cname = f"dc.{dc}"
+        gw_eps = [{"address": g.get("address", ""),
+                   "port": g.get("port", 0)}
+                  for g in fed.get("mesh_gateways", [])]
+        c, e = _eds_cluster(cname, gw_eps)
+        cl.append(c)
+        eds.append(e)
+        chains.append({
+            "filter_chain_match": {"server_names": [f"*.{dc}"]},
+            "filters": [{"name": "envoy.filters.network.sni_cluster"},
+                        {"name": "envoy.filters.network.tcp_proxy",
+                         "cluster": cname}],
+        })
+    listener = {
+        "@type": "envoy.config.listener.v3.Listener",
+        "name": "mesh_gateway",
+        "traffic_direction": "UNSPECIFIED",
+        "listener_filters": [
+            {"name": "envoy.filters.listener.tls_inspector"}],
+        "filter_chains": chains,
+    }
+    return {"clusters": cl, "endpoints": eds, "listeners": [listener],
+            "routes": []}
+
+
+def terminating_gateway_resources(snap) -> dict:
+    """TLS-terminating gateway: one SNI filter chain per bound service,
+    presenting that service's mesh leaf inward and proxying to the
+    real (non-mesh) instances, with per-service RBAC from intentions."""
+    cl, eds, chains = [], [], []
+    td = _trust_domain(snap)
+    for row in snap.gateway_services:
+        svc = row["Service"]
+        cname = f"term.{svc}"
+        c, e = _eds_cluster(cname, snap.upstream_endpoints.get(svc, []))
+        cl.append(c)
+        eds.append(e)
+        leaf = snap.service_leaves.get(svc) or snap.leaf
+        rules = [{"action": it["action"].upper(),
+                  "precedence": it["precedence"],
+                  "principals": [{"authenticated": {"principal_name": {
+                      "safe_regex": {"regex":
+                                     _principal_regex(it["source"])}}}}]}
+                 for it in snap.intentions
+                 if it["destination"] in (svc, "*")]
+        chains.append({
+            "filter_chain_match": {
+                "server_names": [f"{svc}.default.{td}"]},
+            "transport_socket": {
+                "name": "tls", "require_client_certificate": True,
+                "common_tls_context": {
+                    "tls_certificates": [{
+                        "certificate_chain": leaf["CertPEM"],
+                        "private_key": leaf["PrivateKeyPEM"]}],
+                    "validation_context": {"trusted_ca": "".join(
+                        r["RootCert"] for r in snap.roots)}},
+            },
+            "filters": [
+                {"name": "envoy.filters.network.rbac", "rules": rules,
+                 "default_action": "ALLOW" if snap.default_allow
+                 else "DENY"},
+                {"name": "envoy.filters.network.tcp_proxy",
+                 "cluster": cname}],
+        })
+    listener = {
+        "@type": "envoy.config.listener.v3.Listener",
+        "name": "terminating_gateway",
+        "traffic_direction": "INBOUND",
+        "listener_filters": [
+            {"name": "envoy.filters.listener.tls_inspector"}],
+        "filter_chains": chains,
+    }
+    return {"clusters": cl, "endpoints": eds, "listeners": [listener],
+            "routes": []}
+
+
+def ingress_gateway_resources(snap) -> dict:
+    """North-south entry: one listener per configured port; http
+    listeners route by host to bound-service clusters, tcp listeners
+    proxy straight through (makeIngressGatewayListeners).
+
+    Listeners are built from the RESOLVED gateway_services rows (not
+    the raw config) so a wildcard binding expands to real per-service
+    routes/clusters instead of a nonexistent `ingress.*` target."""
+    cl, eds, lst, rts = [], [], [], []
+    seen = set()
+    by_port: Dict[int, List[dict]] = {}
+    for row in snap.gateway_services:
+        svc = row["Service"]
+        by_port.setdefault(row.get("Port", 0), []).append(row)
+        if svc in seen:
+            continue
+        seen.add(svc)
+        c, e = _eds_cluster(f"ingress.{svc}",
+                            snap.upstream_endpoints.get(svc, []))
+        cl.append(c)
+        eds.append(e)
+    for li in snap.listeners:
+        port = li.get("port", 0)
+        proto = li.get("protocol", "tcp")
+        rows = by_port.get(port, [])
+        name = f"ingress:{port}"
+        if proto == "tcp":
+            # tcp carries no routing discriminator: exactly one bound
+            # service is servable (the reference validates this at the
+            # config entry); zero services → no listener to emit
+            if not rows:
+                continue
+            lst.append({
+                "@type": "envoy.config.listener.v3.Listener",
+                "name": name, "traffic_direction": "OUTBOUND",
+                "address": {"socket_address": {
+                    "address": "0.0.0.0", "port_value": port}},
+                "filter_chains": [{"filters": [
+                    {"name": "envoy.filters.network.tcp_proxy",
+                     "cluster": f"ingress.{rows[0]['Service']}"}]}],
+            })
+        else:
+            vhosts = []
+            for row in rows:
+                svc = row["Service"]
+                domains = row.get("Hosts") or [f"{svc}.ingress.*", svc]
+                vhosts.append({
+                    "name": svc, "domains": domains,
+                    "routes": [{"match": {"prefix": "/"},
+                                "route": {"cluster":
+                                          f"ingress.{svc}"}}]})
+            rts.append({
+                "@type": "envoy.config.route.v3.RouteConfiguration",
+                "name": name, "virtual_hosts": vhosts})
+            lst.append({
+                "@type": "envoy.config.listener.v3.Listener",
+                "name": name, "traffic_direction": "OUTBOUND",
+                "address": {"socket_address": {
+                    "address": "0.0.0.0", "port_value": port}},
+                "filter_chains": [{"filters": [
+                    {"name":
+                     "envoy.filters.network.http_connection_manager",
+                     "rds_route_config_name": name}]}],
+            })
+    return {"clusters": cl, "endpoints": eds, "listeners": lst,
+            "routes": rts}
+
+
 def snapshot_resources(snap) -> dict:
     """Full ADS payload for one proxy version (DeltaAggregatedResources
-    response analogue)."""
-    return {
-        "VersionInfo": str(snap.version),
-        "ProxyID": snap.proxy_id,
-        "Service": snap.service,
-        "Resources": {
+    response analogue); gateway kinds get their own resource shapes."""
+    kind = getattr(snap, "kind", "connect-proxy")
+    if kind == "mesh-gateway":
+        res = mesh_gateway_resources(snap)
+    elif kind == "terminating-gateway":
+        res = terminating_gateway_resources(snap)
+    elif kind == "ingress-gateway":
+        res = ingress_gateway_resources(snap)
+    else:
+        res = {
             "clusters": clusters(snap),
             "endpoints": endpoints(snap),
             "listeners": listeners(snap),
             "routes": routes(snap),
-        },
+        }
+    return {
+        "VersionInfo": str(snap.version),
+        "ProxyID": snap.proxy_id,
+        "Service": snap.service,
+        "Kind": kind,
+        "Resources": res,
     }

@@ -1,0 +1,251 @@
+"""Gateway kinds: ingress / terminating / mesh.
+
+Reference: gateway-services mapping (agent/consul/state/config_entry.go,
+catalog_endpoint.go GatewayServices), per-kind proxycfg snapshots
+(agent/proxycfg/state.go), per-kind xDS listeners/clusters
+(agent/xds/listeners.go makeMeshGatewayListener /
+makeTerminatingGatewayListener / makeIngressGatewayListeners), and the
+connect/ingress health views (health_endpoint.go).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+def _register(a, body):
+    req = urllib.request.Request(
+        a.http_address + "/v1/agent/service/register",
+        data=json.dumps(body).encode(), method="PUT")
+    urllib.request.urlopen(req, timeout=30)
+
+
+def _xds(a, proxy_id):
+    r = urllib.request.urlopen(
+        a.http_address + f"/v1/agent/xds/{proxy_id}", timeout=30)
+    return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=41))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    c = Client(a.http_address)
+    # plain services
+    a.store.register_service("n1", "web1", "web", port=8080)
+    a.store.register_service("n2", "legacy1", "legacy", port=9000)
+    # a sidecar for web (mesh-capable instance)
+    _register(a, {"Name": "web-sidecar-proxy", "Kind": "connect-proxy",
+                  "Port": 21000,
+                  "Proxy": {"DestinationServiceName": "web"}})
+    # gateway registrations
+    _register(a, {"Name": "ingress-gw", "Kind": "ingress-gateway",
+                  "Port": 8443})
+    _register(a, {"Name": "term-gw", "Kind": "terminating-gateway",
+                  "Port": 8444})
+    _register(a, {"Name": "mesh-gw", "Kind": "mesh-gateway",
+                  "Port": 8445})
+    # config entries binding services to the gateways
+    c._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "ingress-gateway", "Name": "ingress-gw",
+        "Listeners": [{"Port": 8443, "Protocol": "http",
+                       "Services": [{"Name": "web"}]},
+                      {"Port": 9443, "Protocol": "tcp",
+                       "Services": [{"Name": "legacy"}]}],
+    }).encode())
+    c._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "terminating-gateway", "Name": "term-gw",
+        "Services": [{"Name": "legacy"}],
+    }).encode())
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+def test_gateway_services_mapping(client):
+    rows = client._call("GET",
+                        "/v1/catalog/gateway-services/ingress-gw")[0]
+    assert {(r["Service"], r["Port"]) for r in rows} == \
+        {("web", 8443), ("legacy", 9443)}
+    assert all(r["GatewayKind"] == "ingress-gateway" for r in rows)
+    rows = client._call("GET",
+                        "/v1/catalog/gateway-services/term-gw")[0]
+    assert [r["Service"] for r in rows] == ["legacy"]
+    assert rows[0]["GatewayKind"] == "terminating-gateway"
+
+
+def test_catalog_and_health_connect(client):
+    rows = client._call("GET", "/v1/catalog/connect/web")[0]
+    assert [r["ServiceName"] for r in rows] == ["web-sidecar-proxy"]
+    health = client._call("GET", "/v1/health/connect/web")[0]
+    assert health and health[0]["Service"]["Service"] == \
+        "web-sidecar-proxy"
+    # a service with no sidecar has no connect instances
+    assert client._call("GET", "/v1/health/connect/legacy")[0] == []
+
+
+def test_health_ingress(client):
+    rows = client._call("GET", "/v1/health/ingress/web")[0]
+    assert rows and rows[0]["Service"]["Service"] == "ingress-gw"
+    assert client._call("GET", "/v1/health/ingress/unbound")[0] == []
+
+
+def test_ingress_gateway_xds(agent):
+    out = _xds(agent, "ingress-gw")
+    assert out["Kind"] == "ingress-gateway"
+    res = out["Resources"]
+    lnames = {l["name"] for l in res["listeners"]}
+    assert lnames == {"ingress:8443", "ingress:9443"}
+    cnames = {c["name"] for c in res["clusters"]}
+    assert {"ingress.web", "ingress.legacy"} <= cnames
+    # http listener routes by host; tcp proxies straight through
+    routes = {r["name"]: r for r in res["routes"]}
+    vh = routes["ingress:8443"]["virtual_hosts"][0]
+    assert vh["routes"][0]["route"]["cluster"] == "ingress.web"
+    eds = {e["cluster_name"]: e for e in res["endpoints"]}
+    port = eds["ingress.web"]["endpoints"][0]["lb_endpoints"][0][
+        "endpoint"]["address"]["socket_address"]["port_value"]
+    assert port == 8080
+
+
+def test_terminating_gateway_xds(agent):
+    out = _xds(agent, "term-gw")
+    assert out["Kind"] == "terminating-gateway"
+    res = out["Resources"]
+    assert [c["name"] for c in res["clusters"]] == ["term.legacy"]
+    chains = res["listeners"][0]["filter_chains"]
+    assert len(chains) == 1
+    sni = chains[0]["filter_chain_match"]["server_names"][0]
+    assert sni.startswith("legacy.default.")
+    # gateway presents a leaf FOR the fronted service
+    cert = chains[0]["transport_socket"]["common_tls_context"][
+        "tls_certificates"][0]["certificate_chain"]
+    assert "BEGIN CERTIFICATE" in cert
+    eds = {e["cluster_name"]: e for e in res["endpoints"]}
+    port = eds["term.legacy"]["endpoints"][0]["lb_endpoints"][0][
+        "endpoint"]["address"]["socket_address"]["port_value"]
+    assert port == 9000
+
+
+def test_mesh_gateway_xds_local_and_federation(agent):
+    # remote-DC federation state: dc2's gateways reachable by *.dc2 SNI
+    agent.store.federation_state_set(
+        "dc2", [{"address": "10.9.9.9", "port": 443}])
+    out = _xds(agent, "mesh-gw")
+    assert out["Kind"] == "mesh-gateway"
+    res = out["Resources"]
+    cnames = {c["name"] for c in res["clusters"]}
+    assert {"local.web", "local.legacy", "dc.dc2"} <= cnames
+    chains = res["listeners"][0]["filter_chains"]
+    sni_map = {c["filter_chain_match"]["server_names"][0] for c in chains}
+    assert any(s.startswith("web.default.") for s in sni_map)
+    assert "*.dc2" in sni_map
+    eds = {e["cluster_name"]: e for e in res["endpoints"]}
+    gw_ep = eds["dc.dc2"]["endpoints"][0]["lb_endpoints"][0][
+        "endpoint"]["address"]["socket_address"]
+    assert (gw_ep["address"], gw_ep["port_value"]) == ("10.9.9.9", 443)
+
+
+def test_gateway_snapshot_tracks_config_changes(agent, client):
+    """Binding a new service to the terminating gateway rebuilds its
+    snapshot (config-topic watch) without unrelated churn."""
+    out1 = _xds(agent, "term-gw")
+    client._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "terminating-gateway", "Name": "term-gw",
+        "Services": [{"Name": "legacy"}, {"Name": "web"}],
+    }).encode())
+    import time
+    deadline = time.time() + 5.0
+    names = set()
+    while time.time() < deadline:
+        out2 = _xds(agent, "term-gw")
+        names = {c["name"] for c in out2["Resources"]["clusters"]}
+        if "term.web" in names:
+            break
+        time.sleep(0.2)
+    assert {"term.legacy", "term.web"} <= names
+    assert int(out2["VersionInfo"]) > int(out1["VersionInfo"])
+
+
+def test_wildcard_terminating_gateway(agent, client):
+    _register(agent, {"Name": "term-all", "Kind": "terminating-gateway",
+                      "Port": 8446})
+    client._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "terminating-gateway", "Name": "term-all",
+        "Services": [{"Name": "*"}],
+    }).encode())
+    out = _xds(agent, "term-all")
+    names = {c["name"] for c in out["Resources"]["clusters"]}
+    # wildcard expands to the plain services only (no proxies/gateways)
+    assert {"term.web", "term.legacy"} <= names
+    assert not any(n.endswith("-proxy") or "gw" in n for n in names)
+
+
+def test_catalog_connect_carries_proxy_fields(client):
+    rows = client._call("GET", "/v1/catalog/connect/web")[0]
+    assert rows[0]["ServiceKind"] == "connect-proxy"
+    assert rows[0]["ServiceProxy"]["DestinationServiceName"] == "web"
+
+
+def test_ingress_tcp_listener_validation(client):
+    from consul_tpu.api.client import ApiError
+    # zero and multiple services on a tcp listener are config errors
+    for services in ([], [{"Name": "a"}, {"Name": "b"}],
+                     [{"Name": "*"}]):
+        with pytest.raises(ApiError) as ei:
+            client._call("PUT", "/v1/config", None, json.dumps({
+                "Kind": "ingress-gateway", "Name": "bad-gw",
+                "Listeners": [{"Port": 7000, "Protocol": "tcp",
+                               "Services": services}],
+            }).encode())
+        assert ei.value.code == 400
+
+
+def test_wildcard_plus_explicit_binding_dedups(agent, client):
+    """A service bound both explicitly and via '*' yields ONE filter
+    chain (Envoy rejects duplicate filter-chain matches)."""
+    client._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "terminating-gateway", "Name": "term-all",
+        "Services": [{"Name": "*"}, {"Name": "legacy", "SNI": "x"}],
+    }).encode())
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        out = _xds(agent, "term-all")
+        chains = out["Resources"]["listeners"][0]["filter_chains"]
+        snis = [c["filter_chain_match"]["server_names"][0]
+                for c in chains]
+        if len(snis) == len(set(snis)) and any(
+                s.startswith("legacy.") for s in snis):
+            break
+        time.sleep(0.2)
+    assert len(snis) == len(set(snis)), f"duplicate chains: {snis}"
+
+
+def test_wildcard_http_ingress_routes_expand(agent, client):
+    """Wildcard http listeners route to per-service clusters, never to
+    a literal 'ingress.*' target."""
+    _register(agent, {"Name": "wild-gw", "Kind": "ingress-gateway",
+                      "Port": 8447})
+    client._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "ingress-gateway", "Name": "wild-gw",
+        "Listeners": [{"Port": 8448, "Protocol": "http",
+                       "Services": [{"Name": "*"}]}],
+    }).encode())
+    out = _xds(agent, "wild-gw")
+    routes = {r["name"]: r for r in out["Resources"]["routes"]}
+    clusters = {c["route"]["cluster"]
+                for vh in routes["ingress:8448"]["virtual_hosts"]
+                for c in vh["routes"]}
+    assert "ingress.*" not in clusters
+    assert {"ingress.web", "ingress.legacy"} <= clusters

@@ -1,0 +1,131 @@
+"""WAN federation through mesh gateways (wanfed).
+
+Reference: agent/consul/wanfed/wanfed.go:39 (gateway-routed federation
+transport), gateway_locator.go (locating the remote DC's gateways from
+federation states), config connect.enable_mesh_gateway_wan_federation.
+
+The decisive property: dc1 reaches dc2 WITHOUT any direct route — only
+dc2's mesh gateway address (from locally replicated federation states)
+is ever dialed.
+"""
+
+import json
+import socket
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.wanfed import MeshGatewayForwarder, gateway_address
+
+
+@pytest.fixture(scope="module")
+def wanfed_pair():
+    """dc1 + dc2 agents; dc2 fronted by a gateway forwarder; dc1 knows
+    dc2 ONLY via federation states (no WanRouter handle at all)."""
+    a1 = Agent(GossipConfig.lan(),
+               SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=51),
+               node_name="dc1-n0", dc="dc1")
+    a1.start(tick_seconds=0.0, reconcile_interval=0.5)
+    a2 = Agent(GossipConfig.lan(),
+               SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=52),
+               node_name="dc2-n0", dc="dc2")
+    a2.start(tick_seconds=0.0, reconcile_interval=0.5)
+    # dc2's mesh gateway data plane: forwards to dc2's serving address
+    gw = MeshGatewayForwarder("127.0.0.1", a2.api.port)
+    gw.start()
+    # dc1 learns dc2's gateway via (replicated) federation states
+    a1.store.federation_state_set(
+        "dc2", [{"address": gw.host, "port": gw.port}])
+    a1.api.wan_fed_via_gateways = True
+    yield a1, a2, gw
+    gw.stop()
+    a1.stop()
+    a2.stop()
+
+
+def test_forwarder_splices_tcp(wanfed_pair):
+    _, a2, gw = wanfed_pair
+    # raw HTTP through the gateway reaches dc2's API
+    with socket.create_connection(gw.address, timeout=10) as s:
+        s.sendall(b"GET /v1/status/leader HTTP/1.1\r\n"
+                  b"Host: x\r\nConnection: close\r\n\r\n")
+        data = b""
+        while True:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+    assert b"200" in data.split(b"\r\n", 1)[0]
+
+
+def test_dc_forward_rides_the_gateway(wanfed_pair):
+    a1, a2, _ = wanfed_pair
+    c1 = Client(a1.http_address)
+    # no direct route exists (router is None); only the gateway path
+    assert a1.api.router is None
+    ok, _, _ = c1._call("PUT", "/v1/kv/fedkey", {"dc": "dc2"},
+                        b"via-gateway")
+    assert a2.store.kv_get("fedkey")["value"] == b"via-gateway"
+    out, _, _ = c1._call("GET", "/v1/kv/fedkey", {"dc": "dc2"})
+    assert out[0]["Key"] == "fedkey"
+
+
+def test_catalog_query_through_gateway(wanfed_pair):
+    a1, a2, _ = wanfed_pair
+    a2.store.register_service("dc2-n3", "gsvc1", "gateway-svc", port=7777)
+    c1 = Client(a1.http_address)
+    out, _, _ = c1._call("GET", "/v1/catalog/service/gateway-svc",
+                         {"dc": "dc2"})
+    assert out and out[0]["ServicePort"] == 7777
+
+
+def test_unknown_dc_without_federation_state(wanfed_pair):
+    a1, _, _ = wanfed_pair
+    c1 = Client(a1.http_address)
+    with pytest.raises(ApiError) as ei:
+        c1._call("GET", "/v1/kv/x", {"dc": "dc9"})
+    assert ei.value.code == 500
+    assert "No path to datacenter" in str(ei.value)
+
+
+def test_gateway_locator_prefers_first_routable(wanfed_pair):
+    a1, _, gw = wanfed_pair
+    assert gateway_address(a1.store, "dc2") == (gw.host, gw.port)
+    assert gateway_address(a1.store, "dc9") is None
+    # entries with no address are skipped
+    a1.store.federation_state_set(
+        "dc3", [{"address": "", "port": 0},
+                {"address": "10.1.1.1", "port": 443}])
+    assert gateway_address(a1.store, "dc3") == ("10.1.1.1", 443)
+
+
+def test_gateway_down_fails_loud(wanfed_pair):
+    a1, a2, _ = wanfed_pair
+    # point dc4 at a dead port: the hop must error, not hang
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()
+    a1.store.federation_state_set(
+        "dc4", [{"address": "127.0.0.1", "port": port}])
+    c1 = Client(a1.http_address)
+    with pytest.raises(ApiError):
+        c1._call("GET", "/v1/kv/x", {"dc": "dc4"}, timeout=30.0)
+
+
+def test_config_flag_enables_wanfed(tmp_path):
+    cfg = tmp_path / "wanfed.json"
+    cfg.write_text(json.dumps({
+        "datacenter": "dc7",
+        "connect": {"enable_mesh_gateway_wan_federation": True},
+        "sim": {"n_nodes": 8, "rumor_slots": 8},
+    }))
+    a = Agent.from_config(config_files=[str(cfg)])
+    try:
+        assert a.api.wan_fed_via_gateways is True
+        assert a.runtime_config.connect_mesh_gateway_wan_federation
+    finally:
+        a.stop()   # never started: stop must not hang (shutdown guard)
