@@ -41,7 +41,8 @@ class Agent:
                 acl_default_policy=rc.acl_default_policy,
                 acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port,
                 data_dir=rc.data_dir or None,
-                enable_remote_exec=rc.enable_remote_exec)
+                enable_remote_exec=rc.enable_remote_exec,
+                segments=rc.segment_pools())
         a.runtime_config = rc
         a.api.wan_fed_via_gateways = \
             rc.connect_mesh_gateway_wan_federation
@@ -116,13 +117,21 @@ class Agent:
                  acl_default_policy: str = "allow",
                  acl_down_policy: str = "extend-cache",
                  dns_port: int = 0, data_dir: Optional[str] = None,
-                 enable_remote_exec: bool = False):
+                 enable_remote_exec: bool = False, segments=None):
         self.data_dir = data_dir
         from consul_tpu.acl import ACLResolver
         from consul_tpu.ae import StateSyncer
         from consul_tpu.checks import CheckManager
         from consul_tpu.local import LocalState
-        self.oracle = GossipOracle(gossip, sim)
+        if segments:
+            # multi-segment LAN: one device pool per segment, this
+            # agent (server-shaped) bridges all of them (SURVEY §2.2;
+            # segment_oss.go).  `segments` maps name -> (GossipConfig,
+            # SimConfig); "" is the default segment.
+            from consul_tpu.segments import SegmentedOracle
+            self.oracle = SegmentedOracle(segments)
+        else:
+            self.oracle = GossipOracle(gossip, sim)
         self.store = StateStore()
         self.node_name = node_name
         self.acl = ACLResolver(self.store, enabled=acl_enabled,
@@ -274,6 +283,10 @@ class Agent:
         self.oracle.start(tick_seconds)
         self.api.start()
         self.dns.start()
+        # usage gauges (agent/consul/usagemetrics wired server.go:568)
+        from consul_tpu.usagemetrics import UsageReporter
+        self.usage = UsageReporter(self.store)
+        self.usage.start()
         self._running = True
         # warm the members/down-mask computation in THIS thread before the
         # reconcile thread exists: its first evaluation is an XLA compile
@@ -299,6 +312,8 @@ class Agent:
 
     def stop(self) -> None:
         self._running = False
+        if getattr(self, "usage", None) is not None:
+            self.usage.stop()
         self.remote_exec.stop()
         self.checks.stop_all()
         self.syncer.stop()

@@ -170,8 +170,9 @@ class Client:
     def agent_self(self) -> dict:
         return self._call("GET", "/v1/agent/self")[0]
 
-    def agent_members(self) -> List[dict]:
-        return self._call("GET", "/v1/agent/members")[0]
+    def agent_members(self, segment: Optional[str] = None) -> List[dict]:
+        return self._call("GET", "/v1/agent/members",
+                          {"segment": segment})[0]
 
     def agent_service_register(self, name: str, service_id: Optional[str] = None,
                                port: int = 0, tags: List[str] | None = None,

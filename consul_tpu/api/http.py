@@ -680,13 +680,33 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/agent/members" and verb == "GET":
                 # aclFilter: members filter by node:read, not 403.
                 # ?limit/?offset paginate (the sim targets N where a full
-                # dump is not servable)
+                # dump is not servable); ?segment= restricts to one LAN
+                # segment pool (agent_endpoint.go AgentMembers segment)
                 limit = max(0, int(q["limit"])) if "limit" in q else None
                 offset = max(0, int(q.get("offset", 0) or 0))
-                self._send([_member_json(m)
-                            for m in oracle.members(limit=limit,
-                                                    offset=offset)
-                            if self.authz.node_read(m["name"])])
+                kwargs = {"limit": limit, "offset": offset}
+                if "segment" in q:
+                    if not hasattr(oracle, "segments"):
+                        self._err(400, "agent has no network segments")
+                        return True
+                    kwargs["segment"] = q["segment"]
+                try:
+                    rows = oracle.members(**kwargs)
+                except KeyError as e:
+                    self._err(400, f"unknown segment: {e}")
+                    return True
+                self._send(self._filtered(
+                    q, [_member_json(m) for m in rows
+                        if self.authz.node_read(m["name"])]))
+                return True
+            if path == "/v1/operator/segment" and verb == "GET":
+                # LAN segment listing (enterprise operator/segment)
+                if not self.authz.operator_read():
+                    return self._forbid()
+                segs = oracle.segments() if hasattr(oracle, "segments") \
+                    else [""]
+                self._send(["<default>" if s == "" else s
+                            for s in segs])
                 return True
             if path == "/v1/agent/metrics" and verb == "GET":
                 if not self.authz.agent_read(srv.node_name):
@@ -2536,10 +2556,13 @@ def _token_json(t: dict, store, secret: bool = True) -> dict:
 
 def _member_json(m: dict) -> dict:
     status_code = {"alive": 1, "leaving": 2, "left": 3, "failed": 4}
+    tags = {"role": "node", "incarnation": str(m["incarnation"])}
+    if "segment" in m:
+        tags["segment"] = m["segment"]   # serf segment tag
     return {"Name": m["name"], "Addr": f"10.{(m['id'] >> 16) & 255}."
             f"{(m['id'] >> 8) & 255}.{m['id'] & 255}",
             "Port": 8301, "Status": status_code.get(m["status"], 0),
-            "Tags": {"role": "node", "incarnation": str(m["incarnation"])}}
+            "Tags": tags}
 
 
 def _kv_json(e: dict) -> dict:

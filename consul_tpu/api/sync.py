@@ -1,0 +1,237 @@
+"""Distributed Lock and Semaphore over sessions + KV.
+
+Client-side coordination primitives mirroring the reference's
+api/lock.go (Lock/Unlock/Destroy with session heartbeat semantics) and
+api/semaphore.go (N-holder semaphore: per-contender session keys plus a
+CAS-guarded coordination key holding the holder set).
+
+Both block on KV blocking queries rather than polling hot: losing a
+race parks on `?index=` until the lock prefix changes.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+# reference defaults (api/lock.go:32-43, semaphore.go:30-41)
+DEFAULT_SESSION_TTL = "15s"
+LOCK_FLAG = 0x2DDCCD18
+SEMAPHORE_FLAG = 0xE0F69A2BAA414DE0
+
+
+class LockError(Exception):
+    pass
+
+
+class Lock:
+    """Mutual exclusion on one KV key (api/lock.go)."""
+
+    def __init__(self, client, key: str, value: bytes = b"",
+                 session_ttl: str = DEFAULT_SESSION_TTL,
+                 retry_time: float = 5.0):
+        self.client = client
+        self.key = key
+        self.value = value
+        self.session_ttl = session_ttl
+        # pause between acquire retries inside a lock-delay window
+        # (api/lock.go DefaultLockRetryTime) — without it the delay
+        # window becomes a full-speed kv_put/kv_get hot loop
+        self.retry_time = retry_time
+        self.session: Optional[str] = None
+
+    @property
+    def held(self) -> bool:
+        return self.session is not None
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        """Take the lock; blocks (KV watch, not hot polling) until held
+        or `timeout`.  Returns False on timeout / non-blocking miss."""
+        if self.held:
+            raise LockError("lock already held by this handle")
+        sid = self.client.session_create(ttl=self.session_ttl)
+        deadline = None if timeout is None else time.time() + timeout
+        try:
+            while True:
+                if self.client.kv_put(self.key, self.value,
+                                      flags=LOCK_FLAG, acquire=sid):
+                    self.session = sid
+                    return True
+                if not blocking:
+                    break
+                row, idx = self.client.kv_get(self.key)
+                if row is not None and not row.get("Session"):
+                    # free key yet acquire failed → lock-delay window:
+                    # back off before retrying (DefaultLockRetryTime)
+                    pause = self.retry_time
+                    if deadline is not None:
+                        pause = min(pause,
+                                    max(0.0, deadline - time.time()))
+                        if pause <= 0:
+                            break
+                    time.sleep(pause)
+                    continue
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    break
+                wait = "10s" if remaining is None \
+                    else f"{max(1, int(remaining))}s"
+                self.client.kv_get(self.key, index=idx, wait=wait)
+                if deadline is not None and time.time() >= deadline:
+                    break
+            self.client.session_destroy(sid)
+            return False
+        except Exception:
+            self.client.session_destroy(sid)
+            raise
+
+    def release(self) -> None:
+        """Unlock (api/lock.go Unlock): release the key, keep it."""
+        if not self.held:
+            raise LockError("lock not held")
+        sid, self.session = self.session, None
+        self.client.kv_put(self.key, b"", release=sid)
+        self.client.session_destroy(sid)
+
+    def destroy(self) -> None:
+        """Delete the lock key if free (api/lock.go Destroy)."""
+        if self.held:
+            raise LockError("release before destroy")
+        row, _ = self.client.kv_get(self.key)
+        if row is not None and not row.get("Session"):
+            self.client.kv_delete(self.key)
+
+    def __enter__(self) -> "Lock":
+        if not self.acquire():
+            raise LockError(f"could not acquire {self.key!r}")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class Semaphore:
+    """N-holder semaphore on a KV prefix (api/semaphore.go).
+
+    Layout: `<prefix>/<session>` contender keys (session-bound) and
+    `<prefix>/.lock` — a CAS-guarded JSON {"Limit": N, "Holders": [...]}
+    coordination document."""
+
+    def __init__(self, client, prefix: str, limit: int,
+                 value: bytes = b"", session_ttl: str = DEFAULT_SESSION_TTL):
+        if limit < 1:
+            raise ValueError("semaphore limit must be >= 1")
+        self.client = client
+        self.prefix = prefix.rstrip("/")
+        self.limit = limit
+        self.value = value
+        self.session_ttl = session_ttl
+        self.session: Optional[str] = None
+
+    # ----------------------------------------------------------- internals
+
+    @property
+    def _lock_key(self) -> str:
+        return f"{self.prefix}/.lock"
+
+    def _contender_key(self, sid: str) -> str:
+        return f"{self.prefix}/{sid}"
+
+    def _live_contenders(self) -> List[str]:
+        rows = self.client.kv_list(f"{self.prefix}/")
+        return [r["Session"] for r in rows
+                if r.get("Session")
+                and not r["Key"].endswith("/.lock")]
+
+    def _read_doc(self):
+        row, idx = self.client.kv_get(self._lock_key)
+        if row is None:
+            return {"Limit": self.limit, "Holders": []}, 0, idx
+        doc = json.loads(row["Value"] or b"{}")
+        doc.setdefault("Holders", [])
+        return doc, row["ModifyIndex"], idx
+
+    # ------------------------------------------------------------- public
+
+    @property
+    def held(self) -> bool:
+        return self.session is not None
+
+    def acquire(self, blocking: bool = True,
+                timeout: Optional[float] = None) -> bool:
+        if self.held:
+            raise LockError("semaphore already held by this handle")
+        sid = self.client.session_create(ttl=self.session_ttl)
+        # contender key binds our liveness to the session: if we die,
+        # the session invalidation deletes it and others prune us
+        if not self.client.kv_put(self._contender_key(sid), self.value,
+                                  flags=SEMAPHORE_FLAG, acquire=sid):
+            self.client.session_destroy(sid)
+            raise LockError("could not create contender entry")
+        deadline = None if timeout is None else time.time() + timeout
+        try:
+            while True:
+                doc, cas, idx = self._read_doc()
+                live = set(self._live_contenders())
+                # prune dead holders (semaphore.go pruneDeadHolders)
+                holders = [h for h in doc["Holders"] if h in live]
+                if len(holders) < doc.get("Limit", self.limit):
+                    holders.append(sid)
+                    new = json.dumps(
+                        {"Limit": doc.get("Limit", self.limit),
+                         "Holders": holders}).encode()
+                    if self.client.kv_put(self._lock_key, new, cas=cas):
+                        self.session = sid
+                        return True
+                    continue      # CAS race: re-read and retry
+                if not blocking:
+                    break
+                remaining = None if deadline is None \
+                    else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    break
+                wait = "10s" if remaining is None \
+                    else f"{max(1, int(remaining))}s"
+                self.client.kv_list_blocking(f"{self.prefix}/",
+                                             index=idx, wait=wait)
+                if deadline is not None and time.time() >= deadline:
+                    break
+            self.client.kv_delete(self._contender_key(sid))
+            self.client.session_destroy(sid)
+            return False
+        except Exception:
+            # best-effort contender cleanup: session release alone
+            # leaves the orphan key in KV forever
+            try:
+                self.client.kv_delete(self._contender_key(sid))
+            except Exception:
+                pass
+            self.client.session_destroy(sid)
+            raise
+
+    def release(self) -> None:
+        if not self.held:
+            raise LockError("semaphore not held")
+        sid, self.session = self.session, None
+        # drop ourselves from the holder doc under CAS
+        while True:
+            doc, cas, _ = self._read_doc()
+            if sid not in doc["Holders"]:
+                break
+            doc["Holders"] = [h for h in doc["Holders"] if h != sid]
+            if self.client.kv_put(self._lock_key,
+                                  json.dumps(doc).encode(), cas=cas):
+                break
+        self.client.kv_delete(self._contender_key(sid))
+        self.client.session_destroy(sid)
+
+    def __enter__(self) -> "Semaphore":
+        if not self.acquire():
+            raise LockError(f"could not acquire {self.prefix!r}")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()

@@ -1,0 +1,75 @@
+"""Auto-config client: JWT-authorized bootstrap of a fresh agent.
+
+The reference's auto-config flow (agent/auto-config/auto_config.go
+InitialConfiguration; server side auto_config_endpoint.go): a new
+client agent knows only (a) a server address and (b) an *intro token*
+(a JWT from its platform, e.g. a Kubernetes service account).  It calls
+AutoConfig.InitialConfiguration over the server's insecure bootstrap
+port; the server validates the JWT against a configured auth method,
+mints an ACL token through binding rules, and returns runtime-config
+fields plus TLS material.  The client persists the response
+(agent/auto-config/persist.go) and applies it on every later start.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional, Tuple
+
+PERSIST_FILE = "auto-config.json"
+
+
+def initial_configuration(addr: Tuple[str, int], jwt: str,
+                          node_name: str = "agent",
+                          ssl_context=None,
+                          server_hostname: Optional[str] = None,
+                          data_dir: Optional[str] = None,
+                          timeout: float = 10.0) -> dict:
+    """Fetch (and optionally persist) the pushed configuration.
+
+    `addr` is the server's bootstrap (or main RPC) address;
+    `ssl_context` the anonymous client context for the bootstrap
+    listener (tlsutil.anonymous_context) or None for plaintext RPC."""
+    from consul_tpu.rpc import RpcClient
+    client = RpcClient(ssl_context=ssl_context,
+                       server_hostname=server_hostname, timeout=timeout)
+    out = client.call(addr, "auto_config",
+                      {"jwt": jwt, "node_name": node_name})
+    if data_dir:
+        persist(data_dir, out)
+    return out
+
+
+def persist(data_dir: str, response: dict) -> None:
+    """Atomic write of the bootstrap response (persist.go)."""
+    os.makedirs(data_dir, exist_ok=True)
+    tmp = os.path.join(data_dir, PERSIST_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(response, f)
+    os.replace(tmp, os.path.join(data_dir, PERSIST_FILE))
+
+
+def load_persisted(data_dir: str) -> Optional[dict]:
+    """Previously persisted bootstrap response, or None (corrupt or
+    missing files must not block startup — the caller re-bootstraps)."""
+    try:
+        with open(os.path.join(data_dir, PERSIST_FILE)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def bootstrap_or_load(addr, jwt: str, data_dir: str,
+                      node_name: str = "agent", ssl_context=None,
+                      server_hostname: Optional[str] = None) -> dict:
+    """Start-up entry: reuse the persisted config when present, else
+    perform the initial RPC and persist (auto_config.go
+    readPersistedAutoConfig → InitialConfiguration fallback)."""
+    cached = load_persisted(data_dir)
+    if cached is not None:
+        return cached
+    return initial_configuration(addr, jwt, node_name=node_name,
+                                 ssl_context=ssl_context,
+                                 server_hostname=server_hostname,
+                                 data_dir=data_dir)

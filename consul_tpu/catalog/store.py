@@ -479,6 +479,64 @@ class StateStore:
                              "modify_index": v["modify_index"]})
             return rows
 
+    def service_kind_map(self) -> Dict[str, set]:
+        """{service name -> set of kinds} in ONE table pass — wildcard
+        gateway expansion and mesh-gateway rebuilds must not pay a
+        per-name table scan."""
+        with self._lock:
+            kinds: Dict[str, set] = {}
+            for (_node, _sid), v in self._services.items():
+                kinds.setdefault(v["name"], set()).add(
+                    v.get("kind", ""))
+            return kinds
+
+    def healthy_plain_endpoints(self) -> Dict[str, List[dict]]:
+        """One-pass {plain service -> healthy endpoints}: the
+        mesh-gateway snapshot input (every kind-less service, instances
+        with no critical check).  Services whose instances are all
+        critical still appear, with an empty list."""
+        with self._lock:
+            crit_node, crit_svc = set(), set()
+            for (n, _cid), c in self._checks.items():
+                if c["status"] == "critical":
+                    if c["service_id"]:
+                        crit_svc.add((n, c["service_id"]))
+                    else:
+                        crit_node.add(n)
+            kinds: Dict[str, set] = {}
+            for (_node, _sid), v in self._services.items():
+                kinds.setdefault(v["name"], set()).add(
+                    v.get("kind", ""))
+            out: Dict[str, List[dict]] = {}
+            for (node, sid), v in sorted(self._services.items()):
+                name = v["name"]
+                if kinds[name] - {""}:
+                    continue       # proxies/gateways are not targets
+                out.setdefault(name, [])
+                if node in crit_node or (node, sid) in crit_svc:
+                    continue
+                out[name].append({
+                    "address": v["address"]
+                    or self._nodes.get(node, {}).get("address", ""),
+                    "port": v["port"], "node": node})
+            return out
+
+    def usage(self) -> dict:
+        """One-pass usage counters (usagemetrics getUsage)."""
+        with self._lock:
+            names = set()
+            connect = 0
+            for v in self._services.values():
+                names.add(v["name"])
+                if v.get("kind") == "connect-proxy":
+                    connect += 1
+            return {"nodes": len(self._nodes),
+                    "services": len(names),
+                    "service_instances": len(self._services),
+                    "kv_entries": len(self._kv),
+                    "sessions": len(self._sessions),
+                    "connect_instances": connect}
+
     def connect_service_nodes(self, name: str) -> List[dict]:
         """Mesh-capable instances for `name`: sidecar proxies whose
         destination is `name` (Catalog.ServiceNodes with Connect=true —

@@ -48,7 +48,8 @@ def cmd_keygen(args) -> int:
 
 def cmd_members(args) -> int:
     status_names = {1: "alive", 2: "leaving", 3: "left", 4: "failed"}
-    rows = _client(args).agent_members()
+    rows = _client(args).agent_members(
+        segment=getattr(args, "segment", None) or None)
     print(f"{'Node':<20}{'Address':<22}{'Status':<10}Tags")
     for m in rows:
         if args.status and status_names.get(m["Status"]) != args.status:
@@ -651,6 +652,7 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("keygen").set_defaults(fn=cmd_keygen)
     sp = sub.add_parser("members")
     sp.add_argument("-status", default=None)
+    sp.add_argument("-segment", default=None)
     sp.set_defaults(fn=cmd_members)
     sub.add_parser("info").set_defaults(fn=cmd_info)
 

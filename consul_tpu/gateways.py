@@ -79,12 +79,6 @@ def ingress_gateways_for(store, service: str) -> List[dict]:
         and r["Service"] in (service, WILDCARD))
 
 
-def terminating_gateways_for(store, service: str) -> List[dict]:
-    return _bound_services(
-        store, lambda r: r["GatewayKind"] == "terminating-gateway"
-        and r["Service"] in (service, WILDCARD))
-
-
 def resolve_wildcard(store, rows: List[dict]) -> List[dict]:
     """Expand `*` rows into one row per registered service name,
     excluding connect proxies and other gateways (the reference's
@@ -107,12 +101,13 @@ def resolve_wildcard(store, rows: List[dict]) -> List[dict]:
         if key(row, row["Service"]) not in seen:
             seen.add(key(row, row["Service"]))
             out.append(row)
+    kind_map = None
     for row in rows:
         if row["Service"] != WILDCARD:
             continue
-        for name in store.services():
-            kinds = {s.get("kind", "")
-                     for s in store.service_nodes(name)}
+        if kind_map is None:
+            kind_map = store.service_kind_map()   # one pass, lazily
+        for name, kinds in sorted(kind_map.items()):
             if kinds - {""}:
                 continue  # proxies/gateways are not exposable targets
             if key(row, name) in seen:

@@ -114,7 +114,9 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         suspicion_min_ticks=gossip.suspicion_min_ticks(n),
         suspicion_max_ticks=gossip.suspicion_max_ticks(n),
         confirm_k=gossip.confirm_k(),
-        alloc_cap=sim.alloc_cap,
+        # clamp: top_k(k=alloc_cap) over [N] wants — tiny pools (e.g.
+        # per-segment sims) must not exceed their own node count
+        alloc_cap=min(sim.alloc_cap, sim.n_nodes),
         expiry_gossip_ticks=spread,
         expiry_suspect_ticks=gossip.suspicion_max_ticks(n) + spread,
         p_loss=sim.p_loss,

@@ -221,13 +221,10 @@ class ProxyState:
         if kind == "mesh-gateway":
             # every local connect-capable service is routable through
             # the mesh gateway by SNI; remote DCs resolve through their
-            # federation-state gateway lists (state.go mesh-gw watches)
-            for name in m.store.services():
-                kinds = {s.get("kind", "")
-                         for s in m.store.service_nodes(name)}
-                if kinds - {""}:
-                    continue
-                mesh_endpoints[name] = self._healthy_endpoints(name)
+            # federation-state gateway lists (state.go mesh-gw watches).
+            # One locked table pass — this rebuild runs on every health
+            # event, so per-name scans would be quadratic under churn
+            mesh_endpoints = m.store.healthy_plain_endpoints()
             federation = [f for f in m.store.federation_state_list()
                           if f["datacenter"] != m.dc]
         elif kind == "terminating-gateway":

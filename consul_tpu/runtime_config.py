@@ -192,6 +192,9 @@ class RuntimeConfig:
     gossip_wan: Tuple[Tuple[str, Any], ...] = ()
     # sim sizing (the TPU pool)
     sim: Tuple[Tuple[str, Any], ...] = ()
+    # segments[{name, sim{...}}]: additional LAN gossip segments beyond
+    # the default; each is its own pool (segment_oss.go; SURVEY §2.2)
+    segments: Tuple[Tuple[str, Any], ...] = ()
     # connect{enable_mesh_gateway_wan_federation}: route cross-DC
     # requests through mesh gateways from replicated federation states
     # (agent/consul/wanfed; config runtime.go ConnectMeshGatewayWANFederationEnabled)
@@ -217,6 +220,20 @@ class RuntimeConfig:
         from consul_tpu.config import SimConfig
         over = dict(self.sim)
         return SimConfig(**over) if over else SimConfig()
+
+    def segment_pools(self):
+        """{segment -> (GossipConfig, SimConfig)} for SegmentedOracle;
+        None when no extra segments are configured.  The default
+        segment "" always carries the main gossip/sim config."""
+        if not self.segments:
+            return None
+        from consul_tpu.config import SimConfig
+        pools = {"": (self.gossip_config(), self.sim_config())}
+        for name, sim_over in self.segments:
+            over = dict(sim_over)
+            pools[name] = (self.gossip_config(),
+                           SimConfig(**over) if over else SimConfig())
+        return pools
 
 
 _DURATION = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)$")
@@ -313,6 +330,23 @@ class Builder:
         if bad:
             raise ConfigError(f"sim: unknown keys {sorted(bad)}")
 
+        seg_out = []
+        seg_names = set()
+        for seg in m.get("segments") or []:
+            name = seg.get("name", "")
+            if not name:
+                raise ConfigError("segment missing name (the default "
+                                  "segment needs no entry)")
+            if name in seg_names:
+                raise ConfigError(f"duplicate segment {name!r}")
+            seg_names.add(name)
+            seg_sim = seg.get("sim") or {}
+            bad = set(seg_sim) - self._SIM_KEYS
+            if bad:
+                raise ConfigError(
+                    f"segment {name!r} sim: unknown keys {sorted(bad)}")
+            seg_out.append((name, tuple(sorted(seg_sim.items()))))
+
         dp = acl.get("default_policy", "allow")
         if dp not in ("allow", "deny"):
             raise ConfigError(f"acl.default_policy must be allow|deny, "
@@ -350,6 +384,7 @@ class Builder:
             gossip_lan=gossip_block("gossip_lan"),
             gossip_wan=gossip_block("gossip_wan"),
             sim=tuple(sorted(sim.items())),
+            segments=tuple(seg_out),
             dns_only_passing=bool(dnscfg.get("only_passing", False)),
             dns_node_ttl=int(_seconds(dnscfg.get("node_ttl", 0)) or 0),
             dns_service_ttl=int(_seconds(dnscfg.get("service_ttl", 0)) or 0),

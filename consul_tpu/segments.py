@@ -1,0 +1,212 @@
+"""Network segments: LAN gossip sharded into isolated pools.
+
+The reference shards the LAN gossip plane into *network segments* —
+each segment is its own serf pool with its own port, clients join
+exactly one segment, servers join all of them and bridge
+(agent/consul/segment_oss.go, server.go:254-258 segmentLAN, flooding
+agent/consul/flood.go:12-27; SURVEY §2.2).  Failure detection and event
+dissemination stay segment-local; the servers' catalog is the global
+view.
+
+TPU mapping: one device-resident serf pool (GossipOracle) per segment —
+the pools are independent SWIM/serf tensor sims, exactly like the
+reference's per-segment serf instances.  The SegmentedOracle presents
+the combined membership as ONE oracle-shaped surface (members/status/
+kill/revive/events/keyring), so the Agent/HTTP layers work unchanged;
+`?segment=` filters where the reference filters.
+
+Default segment name is "" (the reference's unnamed default segment
+`<default>`); user events fire into every segment because servers
+re-broadcast them across segments (flood.go's role for joins; events
+ride the servers' WAN/bridge path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.oracle import GossipOracle
+
+DEFAULT_SEGMENT = ""
+
+
+class SegmentedOracle:
+    """Oracle-shaped facade over one GossipOracle per segment."""
+
+    def __init__(self, segments: Dict[str, Tuple[GossipConfig,
+                                                 SimConfig]]):
+        if not segments:
+            raise ValueError("at least one segment required")
+        self.pools: Dict[str, GossipOracle] = {}
+        for seg, (gossip, sim) in segments.items():
+            prefix = f"{seg}-node" if seg else "node"
+            self.pools[seg] = GossipOracle(gossip, sim,
+                                           node_prefix=prefix)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, tick_seconds: float = 0.0) -> None:
+        for p in self.pools.values():
+            p.start(tick_seconds)
+
+    def stop(self) -> None:
+        for p in self.pools.values():
+            p.stop()
+
+    def advance(self, n_ticks: int = 1) -> None:
+        for p in self.pools.values():
+            p.advance(n_ticks)
+
+    # ------------------------------------------------------------- identity
+
+    def segments(self) -> List[str]:
+        return sorted(self.pools)
+
+    def _pool_of(self, name: str) -> Tuple[str, GossipOracle]:
+        for seg, p in self.pools.items():
+            if name in p._ids:
+                return seg, p
+        raise KeyError(name)
+
+    def node_id(self, name: str) -> int:
+        return self._pool_of(name)[1].node_id(name)
+
+    # ----------------------------------------------------------- membership
+
+    def members(self, limit: Optional[int] = None, offset: int = 0,
+                segment: Optional[str] = None) -> List[dict]:
+        """Combined member list; `segment` restricts to one pool (the
+        reference's ?segment= filter / members -segment).  Pagination
+        spans pools in sorted-segment order."""
+        if segment is not None:
+            if segment not in self.pools:
+                raise KeyError(f"unknown segment {segment!r}")
+            rows = self.pools[segment].members(limit=limit,
+                                               offset=offset)
+            return [dict(r, segment=segment) for r in rows]
+        out: List[dict] = []
+        remaining_offset = max(0, offset)
+        budget = limit
+        for seg in sorted(self.pools):
+            p = self.pools[seg]
+            n = p.n_nodes
+            if remaining_offset >= n:
+                remaining_offset -= n
+                continue
+            take = None if budget is None else budget
+            rows = p.members(limit=take, offset=remaining_offset)
+            out += [dict(r, segment=seg) for r in rows]
+            remaining_offset = 0
+            if budget is not None:
+                budget -= len(rows)
+                if budget <= 0:
+                    break
+        return out
+
+    def members_summary(self) -> Dict[str, int]:
+        total: Dict[str, int] = {"alive": 0, "failed": 0, "left": 0,
+                                 "total": 0}
+        for p in self.pools.values():
+            for k, v in p.members_summary().items():
+                total[k] = total.get(k, 0) + v
+        return total
+
+    def status(self, name: str) -> str:
+        return self._pool_of(name)[1].status(name)
+
+    def believed_down_fraction(self, name: str) -> float:
+        return self._pool_of(name)[1].believed_down_fraction(name)
+
+    def kill(self, name: str) -> None:
+        self._pool_of(name)[1].kill(name)
+
+    def revive(self, name: str) -> None:
+        self._pool_of(name)[1].revive(name)
+
+    def leave(self, name: str) -> None:
+        self._pool_of(name)[1].leave(name)
+
+    # ---------------------------------------------------------- coordinates
+    # Coordinates are per-segment planes (lib/rtt.go CoordinateSet keyed
+    # by segment): cross-segment distances are undefined.
+
+    def coordinate(self, name: str) -> dict:
+        seg, p = self._pool_of(name)
+        return dict(p.coordinate(name), segment=seg)
+
+    def rtt(self, a: str, b: str) -> float:
+        seg_a, pa = self._pool_of(a)
+        seg_b, _ = self._pool_of(b)
+        if seg_a != seg_b:
+            raise KeyError(
+                f"nodes {a!r}/{b!r} are in different segments "
+                f"({seg_a!r} vs {seg_b!r}): no shared coordinate plane")
+        return pa.rtt(a, b)
+
+    def sort_by_rtt(self, origin: str, names: List[str]) -> List[str]:
+        """Same-segment names sort by coordinate distance; foreign-
+        segment names keep their order at the tail (Intersect returns
+        zero distance only for comparable planes)."""
+        try:
+            seg, pool = self._pool_of(origin)
+        except KeyError:
+            return list(names)
+        local = [n for n in names if n in pool._ids]
+        foreign = [n for n in names if n not in pool._ids]
+        return pool.sort_by_rtt(origin, local) + foreign
+
+    # --------------------------------------------------------------- events
+
+    def fire_event(self, name: str, payload: bytes, origin: str) -> str:
+        """User events reach every segment (servers re-broadcast across
+        the pools they bridge)."""
+        ids = []
+        for seg in sorted(self.pools):
+            p = self.pools[seg]
+            org = origin if origin in p._ids else \
+                p.node_name(0)
+            ids.append(p.fire_event(name, payload, origin=org))
+        return ids[0] if ids else "0"
+
+    def event_list(self) -> List[dict]:
+        # the default segment's ring is authoritative for listing (every
+        # event was fired into all pools)
+        first = sorted(self.pools)[0]
+        return self.pools[first].event_list()
+
+    def event_coverage(self, event_id) -> float:
+        vals = [p.event_coverage(event_id) for p in self.pools.values()]
+        return min(vals) if vals else 0.0
+
+    # -------------------------------------------------------------- keyring
+    # one keyring for the whole cluster (keyring ops broadcast to every
+    # segment pool, agent/keyring.go)
+
+    def keyring_list(self) -> dict:
+        first = sorted(self.pools)[0]
+        out = self.pools[first].keyring_list()
+        out["NumNodes"] = self.n_nodes
+        return out
+
+    def keyring_install(self, key: str) -> None:
+        for p in self.pools.values():
+            p.keyring_install(key)
+
+    def keyring_use(self, key: str) -> None:
+        for p in self.pools.values():
+            p.keyring_use(key)
+
+    def keyring_remove(self, key: str) -> None:
+        for p in self.pools.values():
+            p.keyring_remove(key)
+
+    # ----------------------------------------------------------------- misc
+
+    @property
+    def tick(self) -> int:
+        return max(p.tick for p in self.pools.values())
+
+    @property
+    def n_nodes(self) -> int:
+        return sum(p.n_nodes for p in self.pools.values())

@@ -68,6 +68,11 @@ class Server:
         self._rpc_client = None
         self.tls = None
         self._bootstrap_token = None
+        # auto-config: auth-method name that validates intro JWTs
+        # (None/empty = disabled) and the config fields pushed to
+        # bootstrapping clients (auto_config_endpoint.go)
+        self.auto_config_method: Optional[str] = None
+        self.auto_config_settings: Dict[str, Any] = {}
         from consul_tpu.autopilot import Autopilot
         self.autopilot = Autopilot(self)
 
@@ -105,9 +110,14 @@ class Server:
             # ONE method, no client cert required — so a fresh agent can
             # obtain its first cert at all
             def _bootstrap_only(method, args):
-                if method != "auto_encrypt_sign":
+                # the insecure listener's whole surface: first-cert
+                # issuance + JWT-authorized config push (server.go:
+                # 240-247 registers exactly AutoEncrypt.Sign +
+                # AutoConfig.InitialConfiguration)
+                if method not in ("auto_encrypt_sign", "auto_config"):
                     raise ValueError("bootstrap listener serves "
-                                     "auto_encrypt_sign only")
+                                     "auto_encrypt_sign/auto_config "
+                                     "only")
                 return self._handle_rpc(method, args)
 
             boot_ctx = tls.bootstrap_context(cert, key)
@@ -172,6 +182,35 @@ class Server:
                 raise PermissionError("auto-encrypt: invalid token")
             cert, key = self.tls.sign_cert(args.get("name", "agent"))
             return {"cert": cert, "key": key, "ca": self.tls.ca_pem}
+        if method == "auto_config":
+            # JWT-authorized client bootstrap (AutoConfig.
+            # InitialConfiguration, agent/consul/auto_config_endpoint.go):
+            # the intro JWT validates against the configured auth
+            # method, binding rules mint the agent's ACL token (the
+            # write replicates through raft), and the response carries
+            # runtime-config fields + TLS material
+            from consul_tpu.acl.authmethod import AuthError, login
+            if not self.auto_config_method:
+                raise PermissionError("auto-config not enabled")
+            try:
+                accessor, secret, policies = login(
+                    self, self.auto_config_method,
+                    args.get("jwt", ""))
+            except AuthError as e:
+                raise PermissionError(f"auto-config: {e}") from None
+            node = args.get("node_name", "agent")
+            out = {
+                "accessor": accessor,
+                "token": secret,
+                "policies": policies,
+                "config": dict(self.auto_config_settings,
+                               node_name=node),
+            }
+            if self.tls is not None:
+                cert, key = self.tls.sign_cert(node)
+                out["cert"], out["key"] = cert, key
+                out["ca"] = self.tls.ca_pem
+            return out
         raise ValueError(f"unknown rpc method {method}")
 
     def _remote_addr(self, node_id: str):

@@ -1,0 +1,62 @@
+"""Usage metrics: periodic state-store gauges.
+
+The reference emits `consul.state.*` gauges (node/service/service-
+instance/KV counts) from a usage-metrics reporter wired on every server
+(agent/consul/usagemetrics/, server.go:568-587).  Same role here: a
+UsageReporter samples the store on an interval and publishes gauges
+through the telemetry registry, so /v1/agent/metrics and any statsd
+sink see catalog growth without a store scan per request.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from consul_tpu import telemetry
+
+
+def snapshot_usage(store) -> dict:
+    """One sample of the usage gauges (usagemetrics.go getUsage) — a
+    single locked table pass (store.usage), never per-name scans."""
+    return store.usage()
+
+
+class UsageReporter:
+    """Background sampler → telemetry gauges (usagemetrics.Run)."""
+
+    def __init__(self, store, interval: float = 10.0,
+                 registry: Optional[telemetry.Registry] = None):
+        self.store = store
+        self.interval = interval
+        self.registry = registry or telemetry.default_registry()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def emit_once(self) -> dict:
+        usage = snapshot_usage(self.store)
+        for key, val in usage.items():
+            self.registry.set_gauge(("state", key), float(val))
+        return usage
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.emit_once()
+                except Exception:
+                    pass   # a transient store error must not kill the loop
+
+        self.emit_once()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

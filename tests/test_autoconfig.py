@@ -1,0 +1,159 @@
+"""Auto-config: JWT-authorized bootstrap of a fresh agent.
+
+Reference: agent/auto-config/auto_config.go InitialConfiguration,
+agent/consul/auto_config_endpoint.go (server side), persist.go
+(client persistence).  SURVEY #32.
+"""
+
+import time
+
+import pytest
+
+from consul_tpu import autoconf
+from consul_tpu.acl.authmethod import make_jwt
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.rpc import RpcClient, RpcError, TcpTransport
+from consul_tpu.server import Server
+from consul_tpu.tlsutil import Configurator
+
+
+class _Cluster:
+    """Socket-RPC cluster with a background tick thread (raft needs
+    ticking while the bootstrap RPC waits on its apply)."""
+
+    def __init__(self, n=3, seed=91, tls=None):
+        import threading
+        self.addresses = {}
+        ids = [f"server{i}" for i in range(n)]
+        self.servers = []
+        for i, nid in enumerate(ids):
+            t = TcpTransport(self.addresses)
+            s = Server(nid, ids, t, registry={},
+                       raft_config=RaftConfig(), seed=seed + i)
+            s.serve_rpc(tls=tls,
+                        bootstrap_token="join-secret" if tls else None)
+            self.servers.append(s)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._running:
+            for s in self.servers:
+                s.tick(time.time())
+            time.sleep(0.01)
+
+    def wait_leader(self, max_s=15.0):
+        deadline = time.time() + max_s
+        while time.time() < deadline:
+            ls = [s for s in self.servers if s.is_leader()]
+            if len(ls) == 1:
+                return ls[0]
+            time.sleep(0.05)
+        raise RuntimeError("no leader")
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=5.0)
+        for s in self.servers:
+            s.close_rpc()
+
+
+def _enable_autoconfig(leader):
+    """Auth method 'auto-config' + a binding rule minting agent
+    policy tokens for JWTs asserting node_type=client."""
+    leader.store.acl_policy_set("p-agent", "agent-policy",
+                                'node_prefix "" { policy = "write" }')
+    leader.store.auth_method_set(
+        "auto-config", "jwt",
+        config={"secret": "intro-secret",
+                "claim_mappings": {"node_type": "node_type"}})
+    leader.store.binding_rule_set(
+        "br1", "auto-config",
+        selector="node_type==client",
+        bind_type="policy", bind_name="agent-policy")
+    leader.auto_config_method = "auto-config"
+    leader.auto_config_settings = {
+        "datacenter": "dc1",
+        "acl": {"enabled": True, "default_policy": "deny"},
+    }
+
+
+@pytest.fixture()
+def plain_cluster():
+    c = _Cluster()
+    leader = c.wait_leader()
+    _enable_autoconfig(leader)
+    yield c.servers, c.addresses, leader
+    c.stop()
+
+
+def test_initial_configuration_plain(plain_cluster, tmp_path):
+    servers, addresses, leader = plain_cluster
+    jwt = make_jwt({"node_type": "client"}, "intro-secret")
+    out = autoconf.initial_configuration(
+        addresses[leader.node_id], jwt, node_name="client7",
+        data_dir=str(tmp_path))
+    assert out["config"]["datacenter"] == "dc1"
+    assert out["config"]["node_name"] == "client7"
+    assert out["config"]["acl"]["default_policy"] == "deny"
+    assert out["policies"] == ["agent-policy"]
+    # the minted token replicated through raft and resolves
+    time.sleep(0.3)
+    tok = leader.store.acl_token_get_by_secret(out["token"])
+    assert tok is not None
+    # persisted round-trip + reuse without a second RPC
+    cached = autoconf.load_persisted(str(tmp_path))
+    assert cached["token"] == out["token"]
+    again = autoconf.bootstrap_or_load(
+        ("0.0.0.0", 1), "irrelevant", str(tmp_path))  # addr never dialed
+    assert again["token"] == out["token"]
+
+
+def test_bad_jwt_rejected(plain_cluster):
+    _, addresses, leader = plain_cluster
+    for bad in (make_jwt({"node_type": "client"}, "wrong-secret"),
+                make_jwt({"node_type": "server"}, "intro-secret"),
+                "garbage"):
+        with pytest.raises(RpcError):
+            autoconf.initial_configuration(
+                addresses[leader.node_id], bad)
+
+
+def test_disabled_by_default():
+    c = _Cluster(seed=97)
+    leader = c.wait_leader()
+    try:
+        jwt = make_jwt({"node_type": "client"}, "intro-secret")
+        with pytest.raises(RpcError):
+            autoconf.initial_configuration(
+                c.addresses[leader.node_id], jwt)
+    finally:
+        c.stop()
+
+
+def test_auto_config_over_bootstrap_listener(tmp_path):
+    """The certless bootstrap listener serves auto_config: a fresh
+    agent with only the CA + an intro JWT gets token AND certs."""
+    tls = Configurator(dc="dc1")
+    c = _Cluster(seed=101, tls=tls)
+    leader = c.wait_leader()
+    _enable_autoconfig(leader)
+    try:
+        boot_addr = leader._bootstrap_listener.addr
+        jwt = make_jwt({"node_type": "client"}, "intro-secret")
+        out = autoconf.initial_configuration(
+            boot_addr, jwt, node_name="client9",
+            ssl_context=tls.outgoing_context())   # CA only, no cert
+        assert "BEGIN CERTIFICATE" in out["cert"]
+        assert out["ca"] == tls.ca_pem
+        # the issued cert dials the SECURE listener successfully
+        agent = RpcClient(ssl_context=tls.outgoing_context(
+            out["cert"], out["key"]))
+        try:
+            stats = agent.call(c.addresses[leader.node_id], "stats", {})
+            assert stats["node_id"] == leader.node_id
+        finally:
+            agent.close()
+    finally:
+        c.stop()

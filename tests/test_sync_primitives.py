@@ -1,0 +1,141 @@
+"""Client-side Lock and Semaphore + usage metrics gauges.
+
+Reference: api/lock.go (Lock/Unlock/Destroy), api/semaphore.go
+(N-holder semaphore with contender keys + CAS'd holder doc),
+agent/consul/usagemetrics/ (state gauges).
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.api.sync import Lock, LockError, Semaphore
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.usagemetrics import UsageReporter, snapshot_usage
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=111))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+def test_lock_mutual_exclusion(client, agent):
+    l1 = Lock(client, "locks/le")
+    l2 = Lock(Client(agent.http_address), "locks/le")
+    assert l1.acquire()
+    assert l1.held
+    assert not l2.acquire(blocking=False)
+    l1.release()
+    assert l2.acquire(blocking=False)
+    l2.release()
+
+
+def test_lock_blocking_handoff(client, agent):
+    l1 = Lock(client, "locks/handoff")
+    l2 = Lock(Client(agent.http_address), "locks/handoff")
+    assert l1.acquire()
+    got = {}
+
+    def waiter():
+        got["ok"] = l2.acquire(timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()          # parked on the KV watch, not failed
+    l1.release()
+    t.join(timeout=10.0)
+    assert got.get("ok") is True
+    l2.release()
+
+
+def test_lock_context_manager_and_destroy(client):
+    with Lock(client, "locks/ctx") as lk:
+        assert lk.held
+    assert not lk.held
+    lk.destroy()
+    row, _ = client.kv_get("locks/ctx")
+    assert row is None
+
+
+def test_lock_double_acquire_is_error(client):
+    lk = Lock(client, "locks/dbl")
+    assert lk.acquire()
+    with pytest.raises(LockError):
+        lk.acquire()
+    lk.release()
+
+
+def test_semaphore_limits_holders(client, agent):
+    sems = [Semaphore(Client(agent.http_address), "sem/pool", 2)
+            for _ in range(3)]
+    assert sems[0].acquire()
+    assert sems[1].acquire()
+    assert not sems[2].acquire(blocking=False)
+    sems[0].release()
+    assert sems[2].acquire(blocking=False)
+    sems[1].release()
+    sems[2].release()
+
+
+def test_semaphore_blocking_handoff(client, agent):
+    s1 = Semaphore(client, "sem/one", 1)
+    s2 = Semaphore(Client(agent.http_address), "sem/one", 1)
+    assert s1.acquire()
+    got = {}
+
+    def waiter():
+        got["ok"] = s2.acquire(timeout=10.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.3)
+    s1.release()
+    t.join(timeout=10.0)
+    assert got.get("ok") is True
+    s2.release()
+
+
+def test_semaphore_prunes_dead_holder(client, agent):
+    """A holder whose session dies is pruned by the next contender
+    (semaphore.go pruneDeadHolders)."""
+    s1 = Semaphore(client, "sem/prune", 1)
+    assert s1.acquire()
+    # simulate holder death: destroy its session out from under it
+    client.session_destroy(s1.session)
+    s2 = Semaphore(Client(agent.http_address), "sem/prune", 1)
+    assert s2.acquire(timeout=10.0)
+    s2.release()
+    s1.session = None   # handle cleanup without double-destroy
+
+
+def test_usage_metrics_gauges(agent):
+    agent.store.register_service("n3", "um1", "usage-svc", port=1)
+    agent.store.kv_set("usage/key", b"v")
+    usage = snapshot_usage(agent.store)
+    assert usage["nodes"] >= 1
+    assert usage["services"] >= 1
+    assert usage["kv_entries"] >= 1
+    rep = UsageReporter(agent.store, interval=0.05)
+    rep.start()
+    try:
+        time.sleep(0.2)
+        from consul_tpu import telemetry
+        dump = telemetry.default_registry().dump()
+        names = {g["Name"]: g["Value"] for g in dump["Gauges"]}
+        assert names.get("consul.state.nodes", 0) >= 1
+        assert names.get("consul.state.kv_entries", 0) >= 1
+    finally:
+        rep.stop()
