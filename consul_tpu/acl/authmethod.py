@@ -6,11 +6,15 @@ credential (Kubernetes SA JWT, OIDC/JWT), binding rules select which
 identities map to which ACL roles/policies, and a successful login mints
 a short-lived token deleted again by logout.
 
-Implemented method type: "jwt" with HS256 (HMAC) validation — stdlib
-only, no JOSE dependency.  Config: {"secret": ..., "bound_audiences":
-[...], "claim_mappings": {claim: var}}.  Binding-rule selectors are
-`key==value` conjunctions over the mapped claims; bind_name supports
-${var} interpolation like the reference's HIL templates.
+Implemented method type: "jwt" with HS256 (HMAC, stdlib) and RS256
+(RSA-PKCS1v15/SHA-256 via cryptography) validation — no JOSE
+dependency.  Config: {"secret": ...} for HS256 and/or
+{"jwt_validation_pubkeys": [PEM, ...]} for RS256 (the reference's
+locally-configured JWT mode, agent/consul/authmethod/jwtauth), plus
+{"bound_audiences": [...], "claim_mappings": {claim: var}}.
+Binding-rule selectors are `key==value` conjunctions over the mapped
+claims; bind_name supports ${var} interpolation like the reference's
+HIL templates.
 """
 
 from __future__ import annotations
@@ -48,9 +52,44 @@ def make_jwt(claims: dict, secret: str) -> str:
     return f"{header}.{payload}.{sig}"
 
 
+def make_jwt_rs256(claims: dict, private_key_pem: str) -> str:
+    """Test/ops helper: mint an RS256 JWT from a PEM private key."""
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+    key = serialization.load_pem_private_key(private_key_pem.encode(),
+                                             password=None)
+    header = b64url_encode(json.dumps({"alg": "RS256",
+                                       "typ": "JWT"}).encode())
+    payload = b64url_encode(json.dumps(claims).encode())
+    signing = f"{header}.{payload}".encode()
+    sig = key.sign(signing, padding.PKCS1v15(), hashes.SHA256())
+    return f"{header}.{payload}.{b64url_encode(sig)}"
+
+
+def _verify_rs256(signing: bytes, sig: bytes,
+                  pubkeys: List[str]) -> bool:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import padding
+    for pem in pubkeys:
+        try:
+            pub = serialization.load_pem_public_key(pem.encode())
+            pub.verify(sig, signing, padding.PKCS1v15(),
+                       hashes.SHA256())
+            return True
+        except (InvalidSignature, ValueError):
+            continue
+    return False
+
+
 def validate_jwt(token: str, secret: str,
-                 bound_audiences: Optional[List[str]] = None) -> dict:
-    """HS256 validation → claims dict (authmethod/validator role)."""
+                 bound_audiences: Optional[List[str]] = None,
+                 pubkeys: Optional[List[str]] = None) -> dict:
+    """JWT validation → claims dict (authmethod/validator role).
+
+    The accepted algorithm follows the CONFIGURED trust material, never
+    the attacker-controlled header: a secret admits HS256, pubkeys
+    admit RS256 (jwtauth's locally-configured validation)."""
     parts = token.split(".")
     if len(parts) != 3:
         raise AuthError("malformed JWT")
@@ -65,12 +104,19 @@ def validate_jwt(token: str, secret: str,
     # payloads and numeric exp before touching them
     if not isinstance(header, dict) or not isinstance(claims, dict):
         raise AuthError("malformed JWT")
-    if header.get("alg") != "HS256":
-        raise AuthError(f"unsupported alg {header.get('alg')!r}")
+    alg = header.get("alg")
     signing = f"{header_raw}.{payload_raw}".encode()
-    want = hmac.new(secret.encode(), signing, hashlib.sha256).digest()
-    if not hmac.compare_digest(sig, want):
-        raise AuthError("invalid signature")
+    if alg == "HS256" and secret:
+        want = hmac.new(secret.encode(), signing,
+                        hashlib.sha256).digest()
+        if not hmac.compare_digest(sig, want):
+            raise AuthError("invalid signature")
+    elif alg == "RS256" and pubkeys:
+        if not _verify_rs256(signing, sig, pubkeys):
+            raise AuthError("invalid signature")
+    else:
+        raise AuthError(f"unsupported alg {alg!r} for configured "
+                        f"trust material")
     exp = claims.get("exp")
     if exp is not None:
         try:
@@ -138,7 +184,8 @@ def login(store, method_name: str, bearer: str) -> Tuple[str, str, list]:
     if method.get("type") != "jwt":
         raise AuthError(f"unsupported method type {method.get('type')!r}")
     claims = validate_jwt(bearer, cfg.get("secret", ""),
-                          cfg.get("bound_audiences"))
+                          cfg.get("bound_audiences"),
+                          pubkeys=cfg.get("jwt_validation_pubkeys"))
     variables = map_claims(claims, cfg.get("claim_mappings"))
     policies: List[str] = []
     for rule in store.binding_rule_list(method_name):

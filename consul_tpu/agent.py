@@ -46,6 +46,7 @@ class Agent:
         a.runtime_config = rc
         a.api.wan_fed_via_gateways = \
             rc.connect_mesh_gateway_wan_federation
+        a.api.enable_debug = rc.enable_debug
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
         a._apply_reloadable(rc)

@@ -133,6 +133,9 @@ class ApiServer:
         # gateway from replicated federation states instead of a direct
         # route (consul_tpu/wanfed.py; wanfed.go:39)
         self.wan_fed_via_gateways = False
+        # /debug/pprof analogues served only when explicitly enabled
+        # (agent/http.go enable_debug gate)
+        self.enable_debug = False
         # Connect CA (lazy: cert generation costs entropy/CPU at boot)
         self._ca = None
         self._ca_lock = threading.Lock()
@@ -660,6 +663,29 @@ def _make_handler(srv: ApiServer):
                 from consul_tpu.ui import PAGE
                 self._send(None, raw=PAGE.encode(),
                            ctype="text/html; charset=utf-8")
+                return True
+            if path.startswith("/debug/pprof") and verb == "GET":
+                # profiling surface (agent/http.go installs pprof under
+                # enable_debug; ACL-gated on operator:read)
+                if not srv.enable_debug:
+                    self._err(404, "debug endpoints disabled "
+                              "(enable_debug)")
+                    return True
+                if not self.authz.operator_read():
+                    return self._forbid()
+                from consul_tpu import debug as dbg
+                if path == "/debug/pprof/goroutine":
+                    self._send(None, raw=dbg.thread_dump().encode(),
+                               ctype="text/plain; charset=utf-8")
+                    return True
+                if path == "/debug/pprof/profile":
+                    secs = min(30.0, float(q.get("seconds", 1) or 1))
+                    self._send(dbg.sample_profile(seconds=secs))
+                    return True
+                if path == "/debug/pprof/heap":
+                    self._send(dbg.heap_snapshot())
+                    return True
+                self._err(404, f"no pprof route {path}")
                 return True
             if path == "/v1/status/leader" and verb == "GET":
                 self._send("127.0.0.1:8300")
@@ -1367,6 +1393,96 @@ def _make_handler(srv: ApiServer):
                                           key=lambda r: r["Node"]["Node"])
                 self._send(out, index=idx, extra_headers=(
                     {"X-Cache": cache_state} if cache_state else None))
+                return True
+            m = re.fullmatch(r"/v1/health/checks/(.+)", path)
+            if m and verb == "GET":
+                # all checks of a service's instances
+                # (health_endpoint.go ServiceChecks)
+                name = m.group(1)
+                if not self.authz.service_read(name):
+                    return self._forbid()
+                idx = self._block(q, ("health", name))
+                out = []
+                for r in store.health_service_nodes(name):
+                    out += [_check_json(c, c.get("node", ""))
+                            for c in r["checks"]
+                            if c.get("service_id")]
+                self._send(self._filtered(q, out), index=idx)
+                return True
+            if path == "/v1/internal/ui/nodes" and verb == "GET":
+                # UI summary: one row per node with check counts
+                # (agent/ui_endpoint.go UINodes)
+                idx = self._block(q, ("nodes", ""), ("nodechecks", ""))
+                out = []
+                for n in store.nodes():
+                    if not self.authz.node_read(n["node"]):
+                        continue
+                    checks = store.node_checks(n["node"])
+                    out.append({
+                        "Node": n["node"], "Address": n["address"],
+                        "Checks": {
+                            "passing": sum(1 for c in checks
+                                           if c["status"] == "passing"),
+                            "warning": sum(1 for c in checks
+                                           if c["status"] == "warning"),
+                            "critical": sum(1 for c in checks
+                                            if c["status"] ==
+                                            "critical")},
+                    })
+                self._send(self._filtered(q, out), index=idx)
+                return True
+            if path == "/v1/internal/ui/services" and verb == "GET":
+                # UI summary: one row per service name with instance +
+                # check rollups and kind (agent/ui_endpoint.go
+                # UIServices)
+                idx = self._block(q, ("services", ""),
+                                  ("nodechecks", ""))
+                kind_map = store.service_kind_map()
+                out = []
+                for name, tags in store.services().items():
+                    if not self.authz.service_read(name):
+                        continue
+                    rows = store.health_service_nodes(name)
+                    statuses = [
+                        ("critical" if any(c["status"] == "critical"
+                                           for c in r["checks"])
+                         else "warning" if any(c["status"] == "warning"
+                                               for c in r["checks"])
+                         else "passing") for r in rows]
+                    kinds = kind_map.get(name, {""}) - {""}
+                    out.append({
+                        "Name": name, "Tags": tags,
+                        "Kind": next(iter(kinds)) if kinds else "",
+                        "InstanceCount": len(rows),
+                        "ChecksPassing": statuses.count("passing"),
+                        "ChecksWarning": statuses.count("warning"),
+                        "ChecksCritical": statuses.count("critical"),
+                    })
+                self._send(self._filtered(q, out), index=idx)
+                return True
+            m = re.fullmatch(
+                r"/v1/internal/ui/gateway-services-nodes/(.+)", path)
+            if m and verb == "GET":
+                # services behind a gateway, with their health rows
+                # (agent/ui_endpoint.go UIGatewayServicesNodes)
+                gw = m.group(1)
+                if not self.authz.service_read(gw):
+                    return self._forbid()
+                from consul_tpu import gateways as gmod
+                idx = self._block(q, ("config", ""), ("health", ""))
+                rows = gmod.resolve_wildcard(
+                    store, gmod.gateway_services(store, gw))
+                out = []
+                seen = set()
+                for row in rows:
+                    svc = row["Service"]
+                    if svc in seen or \
+                            not self.authz.service_read(svc):
+                        continue
+                    seen.add(svc)
+                    out += [_health_json(r, store) for r in
+                            store.health_service_nodes(svc)]
+                self._send(out, index=idx)
                 return True
             m = re.fullmatch(r"/v1/health/connect/(.+)", path)
             if m and verb == "GET":
@@ -2559,7 +2675,13 @@ def _member_json(m: dict) -> dict:
     tags = {"role": "node", "incarnation": str(m["incarnation"])}
     if "segment" in m:
         tags["segment"] = m["segment"]   # serf segment tag
-    return {"Name": m["name"], "Addr": f"10.{(m['id'] >> 16) & 255}."
+    # addr_ns (segment index) namespaces the synthetic address —
+    # per-pool ids restart at 0, so segmented members would otherwise
+    # collide on Addr:Port
+    ns = m.get("addr_ns", 0)
+    octet2 = (ns * 64 + ((m["id"] >> 16) & 63)) & 255
+    return {"Name": m["name"],
+            "Addr": f"10.{octet2}."
             f"{(m['id'] >> 8) & 255}.{m['id'] & 255}",
             "Port": 8301, "Status": status_code.get(m["status"], 0),
             "Tags": tags}

@@ -25,6 +25,52 @@ class LockError(Exception):
     pass
 
 
+class _SessionHeartbeat:
+    """Background session renewal at TTL/2 (api/lock.go renewSession /
+    session.RenewPeriodic): without it the leader's TTL reaper destroys
+    the session mid-hold — the lock silently releases while the handle
+    still reports held, and a parked waiter's own session dies so its
+    acquire loop can never succeed."""
+
+    def __init__(self, client, sid: str, ttl: str):
+        import threading
+        self.client = client
+        self.sid = sid
+        period = max(0.5, _ttl_seconds(ttl) / 2.0)
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(period):
+                try:
+                    self.client.session_renew(self.sid)
+                except Exception:
+                    return   # session gone: holder must re-acquire
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+
+
+def _ttl_seconds(ttl: str) -> float:
+    import re
+    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", ttl)
+    if not m:
+        return 15.0
+    scale = {"ms": 1e-3, "s": 1.0, "m": 60.0,
+             "h": 3600.0}[m.group(2) or "s"]
+    return float(m.group(1)) * scale
+
+
+def _wait_str(remaining: Optional[float], default: str = "10s") -> str:
+    """Blocking-wait duration honoring sub-second budgets."""
+    if remaining is None:
+        return default
+    return f"{max(0.05, remaining):.3f}s"
+
+
 class Lock:
     """Mutual exclusion on one KV key (api/lock.go)."""
 
@@ -52,12 +98,14 @@ class Lock:
         if self.held:
             raise LockError("lock already held by this handle")
         sid = self.client.session_create(ttl=self.session_ttl)
+        hb = _SessionHeartbeat(self.client, sid, self.session_ttl)
         deadline = None if timeout is None else time.time() + timeout
         try:
             while True:
                 if self.client.kv_put(self.key, self.value,
                                       flags=LOCK_FLAG, acquire=sid):
                     self.session = sid
+                    self._heartbeat = hb
                     return True
                 if not blocking:
                     break
@@ -77,14 +125,15 @@ class Lock:
                     else deadline - time.time()
                 if remaining is not None and remaining <= 0:
                     break
-                wait = "10s" if remaining is None \
-                    else f"{max(1, int(remaining))}s"
-                self.client.kv_get(self.key, index=idx, wait=wait)
+                self.client.kv_get(self.key, index=idx,
+                                   wait=_wait_str(remaining))
                 if deadline is not None and time.time() >= deadline:
                     break
+            hb.stop()
             self.client.session_destroy(sid)
             return False
         except Exception:
+            hb.stop()
             self.client.session_destroy(sid)
             raise
 
@@ -93,6 +142,10 @@ class Lock:
         if not self.held:
             raise LockError("lock not held")
         sid, self.session = self.session, None
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.stop()
+            self._heartbeat = None
         self.client.kv_put(self.key, b"", release=sid)
         self.client.session_destroy(sid)
 
@@ -171,6 +224,7 @@ class Semaphore:
                                   flags=SEMAPHORE_FLAG, acquire=sid):
             self.client.session_destroy(sid)
             raise LockError("could not create contender entry")
+        hb = _SessionHeartbeat(self.client, sid, self.session_ttl)
         deadline = None if timeout is None else time.time() + timeout
         try:
             while True:
@@ -185,6 +239,7 @@ class Semaphore:
                          "Holders": holders}).encode()
                     if self.client.kv_put(self._lock_key, new, cas=cas):
                         self.session = sid
+                        self._heartbeat = hb
                         return True
                     continue      # CAS race: re-read and retry
                 if not blocking:
@@ -193,18 +248,19 @@ class Semaphore:
                     else deadline - time.time()
                 if remaining is not None and remaining <= 0:
                     break
-                wait = "10s" if remaining is None \
-                    else f"{max(1, int(remaining))}s"
                 self.client.kv_list_blocking(f"{self.prefix}/",
-                                             index=idx, wait=wait)
+                                             index=idx,
+                                             wait=_wait_str(remaining))
                 if deadline is not None and time.time() >= deadline:
                     break
+            hb.stop()
             self.client.kv_delete(self._contender_key(sid))
             self.client.session_destroy(sid)
             return False
         except Exception:
             # best-effort contender cleanup: session release alone
             # leaves the orphan key in KV forever
+            hb.stop()
             try:
                 self.client.kv_delete(self._contender_key(sid))
             except Exception:
@@ -216,6 +272,10 @@ class Semaphore:
         if not self.held:
             raise LockError("semaphore not held")
         sid, self.session = self.session, None
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None:
+            hb.stop()
+            self._heartbeat = None
         # drop ourselves from the holder doc under CAS
         while True:
             doc, cas, _ = self._read_doc()

@@ -34,8 +34,11 @@ def initial_configuration(addr: Tuple[str, int], jwt: str,
     from consul_tpu.rpc import RpcClient
     client = RpcClient(ssl_context=ssl_context,
                        server_hostname=server_hostname, timeout=timeout)
-    out = client.call(addr, "auto_config",
-                      {"jwt": jwt, "node_name": node_name})
+    try:
+        out = client.call(addr, "auto_config",
+                          {"jwt": jwt, "node_name": node_name})
+    finally:
+        client.close()   # one-shot bootstrap: don't leak the pool
     if data_dir:
         persist(data_dir, out)
     return out

@@ -39,6 +39,55 @@ def thread_dump() -> str:
     return "\n".join(out)
 
 
+def sample_profile(seconds: float = 1.0,
+                   interval: float = 0.01) -> dict:
+    """Statistical CPU profile across ALL threads: sample
+    sys._current_frames() every `interval`, aggregate by
+    (file, line, function).  The /debug/pprof/profile analogue —
+    cProfile only sees the calling thread, which is useless for a
+    threaded server; wall-clock sampling sees every thread."""
+    counts: Dict[str, int] = {}
+    samples = 0
+    deadline = time.monotonic() + max(0.05, seconds)
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for ident, frame in sys._current_frames().items():
+            if ident == me:
+                continue    # the sampler itself is noise
+            f = frame
+            key = (f"{f.f_code.co_filename}:{f.f_lineno} "
+                   f"{f.f_code.co_name}")
+            counts[key] = counts.get(key, 0) + 1
+        samples += 1
+        time.sleep(interval)
+    top = sorted(counts.items(), key=lambda kv: -kv[1])[:50]
+    return {"Seconds": seconds, "Samples": samples,
+            # AvgThreads: mean number of threads observed at the site
+            # per sweep (can exceed 1.0 when several threads share it)
+            "Top": [{"Site": site, "Count": c,
+                     "AvgThreads": c / max(1, samples)}
+                    for site, c in top]}
+
+
+_tracemalloc_started = False
+
+
+def heap_snapshot(top: int = 30) -> dict:
+    """Allocation snapshot via tracemalloc (the heap profile analogue).
+    First call starts tracing — deltas show up from the second call."""
+    global _tracemalloc_started
+    import tracemalloc
+    if not _tracemalloc_started:
+        tracemalloc.start()
+        _tracemalloc_started = True
+        return {"Started": True, "Top": []}
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    return {"Started": False,
+            "Top": [{"Site": str(s.traceback[0]), "SizeBytes": s.size,
+                     "Count": s.count} for s in stats]}
+
+
 def host_info() -> dict:
     """Host facts (agent/debug/host.go's gopsutil capture, stdlib-only)."""
     info = {"platform": sys.platform, "python": sys.version,

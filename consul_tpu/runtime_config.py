@@ -179,6 +179,7 @@ class RuntimeConfig:
     data_dir: str = ""
     log_level: str = "INFO"
     enable_remote_exec: bool = False
+    enable_debug: bool = False
     http_port: int = 0
     dns_port: int = 0
     # acl block (agent/config: acl{enabled, default_policy, down_policy,
@@ -371,6 +372,7 @@ class Builder:
             server=bool(m.get("server", True)),
             data_dir=str(m.get("data_dir", "") or ""),
             enable_remote_exec=bool(m.get("enable_remote_exec", False)),
+            enable_debug=bool(m.get("enable_debug", False)),
             log_level=str(m.get("log_level", "INFO")).upper(),
             http_port=int(ports.get("http", 0) or 0),
             dns_port=int(ports.get("dns", 0) or 0),

@@ -79,24 +79,28 @@ class SegmentedOracle:
         """Combined member list; `segment` restricts to one pool (the
         reference's ?segment= filter / members -segment).  Pagination
         spans pools in sorted-segment order."""
+        order = sorted(self.pools)
         if segment is not None:
             if segment not in self.pools:
                 raise KeyError(f"unknown segment {segment!r}")
+            ns = order.index(segment)
             rows = self.pools[segment].members(limit=limit,
                                                offset=offset)
-            return [dict(r, segment=segment) for r in rows]
+            return [dict(r, segment=segment, addr_ns=ns) for r in rows]
         out: List[dict] = []
         remaining_offset = max(0, offset)
         budget = limit
-        for seg in sorted(self.pools):
+        for ns, seg in enumerate(order):
             p = self.pools[seg]
             n = p.n_nodes
             if remaining_offset >= n:
                 remaining_offset -= n
                 continue
-            take = None if budget is None else budget
-            rows = p.members(limit=take, offset=remaining_offset)
-            out += [dict(r, segment=seg) for r in rows]
+            rows = p.members(limit=budget, offset=remaining_offset)
+            # addr_ns namespaces the synthetic member address: per-pool
+            # ids restart at 0, so without it node0 and alpha-node0
+            # would collide on the same Addr
+            out += [dict(r, segment=seg, addr_ns=ns) for r in rows]
             remaining_offset = 0
             if budget is not None:
                 budget -= len(rows)

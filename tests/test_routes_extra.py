@@ -1,0 +1,133 @@
+"""Extra API surface: /v1/health/checks, internal UI summaries,
+/debug/pprof analogues, RS256 auth methods.
+
+Reference: health_endpoint.go ServiceChecks, agent/ui_endpoint.go
+(UINodes/UIServices/UIGatewayServicesNodes), agent/http.go enable_debug
+pprof install, agent/consul/authmethod/jwtauth (pubkey JWT validation).
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=151))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    a.store.register_service("n1", "web1", "web", port=80)
+    a.store.register_check("n1", "web-check", "web alive",
+                           status="passing", service_id="web1")
+    a.store.register_check("n1", "mem", "memory", status="warning")
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+def test_health_checks_by_service(client):
+    out = client._call("GET", "/v1/health/checks/web")[0]
+    assert [c["CheckID"] for c in out] == ["web-check"]
+    assert out[0]["ServiceID"] == "web1"
+
+
+def test_internal_ui_nodes(client):
+    out = client._call("GET", "/v1/internal/ui/nodes")[0]
+    row = next(r for r in out if r["Node"] == "n1")
+    assert row["Checks"]["passing"] >= 1
+    assert row["Checks"]["warning"] >= 1
+
+
+def test_internal_ui_services(client):
+    out = client._call("GET", "/v1/internal/ui/services")[0]
+    row = next(r for r in out if r["Name"] == "web")
+    assert row["InstanceCount"] == 1
+    # node-level warning check degrades the instance rollup
+    assert row["ChecksWarning"] == 1
+    assert row["Kind"] == ""
+
+
+def test_internal_ui_gateway_services_nodes(client, agent):
+    urllib.request.urlopen(urllib.request.Request(
+        agent.http_address + "/v1/agent/service/register",
+        data=json.dumps({"Name": "uigw",
+                         "Kind": "terminating-gateway"}).encode(),
+        method="PUT"), timeout=30)
+    client._call("PUT", "/v1/config", None, json.dumps({
+        "Kind": "terminating-gateway", "Name": "uigw",
+        "Services": [{"Name": "web"}]}).encode())
+    out = client._call(
+        "GET", "/v1/internal/ui/gateway-services-nodes/uigw")[0]
+    assert out and out[0]["Service"]["Service"] == "web"
+
+
+def test_pprof_gated_by_enable_debug(client, agent):
+    with pytest.raises(ApiError) as ei:
+        client._call("GET", "/debug/pprof/goroutine")
+    assert ei.value.code == 404
+    agent.api.enable_debug = True
+    try:
+        _, _, raw = client._call("GET", "/debug/pprof/goroutine")
+        assert b"MainThread" in raw
+        prof = client._call("GET", "/debug/pprof/profile",
+                            {"seconds": "0.2"})[0]
+        assert prof["Samples"] > 0
+        heap1 = client._call("GET", "/debug/pprof/heap")[0]
+        heap2 = client._call("GET", "/debug/pprof/heap")[0]
+        assert heap1["Started"] is True
+        assert heap2["Top"]          # second call has a snapshot
+    finally:
+        agent.api.enable_debug = False
+
+
+def test_rs256_auth_method_login(client, agent):
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from consul_tpu.acl.authmethod import (AuthError, make_jwt_rs256,
+                                           validate_jwt)
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    priv = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption()).decode()
+    pub = key.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+    jwt = make_jwt_rs256({"sub": "svc-ci", "node_type": "ci"}, priv)
+    claims = validate_jwt(jwt, "", pubkeys=[pub])
+    assert claims["sub"] == "svc-ci"
+    # wrong key rejected
+    other = rsa.generate_private_key(public_exponent=65537,
+                                     key_size=2048)
+    opub = other.public_key().public_bytes(
+        serialization.Encoding.PEM,
+        serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+    with pytest.raises(AuthError):
+        validate_jwt(jwt, "", pubkeys=[opub])
+    # HS256 token cannot sneak through a pubkey-configured validator
+    from consul_tpu.acl.authmethod import make_jwt
+    with pytest.raises(AuthError):
+        validate_jwt(make_jwt({"sub": "x"}, "s"), "", pubkeys=[pub])
+    # end-to-end login through the store
+    agent.store.acl_policy_set("p-ci", "ci-policy",
+                               'service_prefix "" { policy = "read" }')
+    agent.store.auth_method_set(
+        "jwt-rs", "jwt",
+        config={"jwt_validation_pubkeys": [pub],
+                "claim_mappings": {"node_type": "node_type"}})
+    agent.store.binding_rule_set(
+        "brrs", "jwt-rs", selector="node_type==ci",
+        bind_type="policy", bind_name="ci-policy")
+    from consul_tpu.acl.authmethod import login
+    accessor, secret, policies = login(agent.store, "jwt-rs", jwt)
+    assert policies == ["ci-policy"]
+    assert agent.store.acl_token_get_by_secret(secret) is not None

@@ -135,3 +135,10 @@ def test_unsegmented_agent_rejects_segment_param():
         assert ei.value.code == 400
     finally:
         a.stop()
+
+
+def test_member_addresses_unique_across_segments(seg_agent):
+    c = Client(seg_agent.http_address)
+    rows = c.agent_members()
+    addrs = [(m["Addr"], m["Port"]) for m in rows]
+    assert len(addrs) == len(set(addrs)), "Addr collision across pools"

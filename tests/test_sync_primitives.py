@@ -139,3 +139,31 @@ def test_usage_metrics_gauges(agent):
         assert names.get("consul.state.kv_entries", 0) >= 1
     finally:
         rep.stop()
+
+
+def test_lock_session_renewed_past_ttl(client, agent):
+    """A lock held longer than its session TTL stays held: the
+    heartbeat renews at TTL/2 (api/lock.go renewSession)."""
+    lk = Lock(client, "locks/renew", session_ttl="1s")
+    assert lk.acquire()
+    deadline = time.time() + 2.5     # 2.5x the TTL
+    while time.time() < deadline:
+        agent.store.expire_sessions()
+        time.sleep(0.2)
+    # session still live, key still ours
+    assert agent.store.session_info(lk.session) is not None
+    row, _ = client.kv_get("locks/renew")
+    assert row["Session"] == lk.session
+    contender = Lock(Client(agent.http_address), "locks/renew")
+    assert not contender.acquire(blocking=False)
+    lk.release()
+
+
+def test_lock_subsecond_timeout_respected(client, agent):
+    l1 = Lock(client, "locks/subsec")
+    assert l1.acquire()
+    l2 = Lock(Client(agent.http_address), "locks/subsec")
+    t0 = time.time()
+    assert not l2.acquire(timeout=0.3)
+    assert time.time() - t0 < 0.9    # not rounded up to 1s+
+    l1.release()
