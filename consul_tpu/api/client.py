@@ -419,3 +419,77 @@ class Client:
             if e.code == 404:
                 return None
             raise
+
+    # --------------------------------------------------------- config entries
+
+    def config_write(self, entry: dict) -> bool:
+        """PUT /v1/config (api/config_entry.go ConfigEntries.Set)."""
+        return bool(self._call("PUT", "/v1/config", None,
+                               json.dumps(entry).encode())[0])
+
+    def config_read(self, kind: str, name: str) -> dict:
+        return self._call("GET", f"/v1/config/{kind}/{name}")[0]
+
+    def config_list(self, kind: str) -> List[dict]:
+        return self._call("GET", f"/v1/config/{kind}")[0]
+
+    def config_delete(self, kind: str, name: str) -> bool:
+        return bool(self._call("DELETE",
+                               f"/v1/config/{kind}/{name}")[0])
+
+    # -------------------------------------------------------------- intentions
+
+    def intention_create(self, source: str, destination: str,
+                         action: str = "allow",
+                         description: str = "") -> str:
+        out = self._call("PUT", "/v1/connect/intentions", None,
+                         json.dumps({"SourceName": source,
+                                     "DestinationName": destination,
+                                     "Action": action,
+                                     "Description": description}).encode())
+        return out[0]["ID"]
+
+    def intention_list(self) -> List[dict]:
+        return self._call("GET", "/v1/connect/intentions")[0]
+
+    def intention_delete(self, iid: str) -> bool:
+        return bool(self._call("DELETE",
+                               f"/v1/connect/intentions/{iid}")[0])
+
+    def intention_check(self, source: str, destination: str) -> bool:
+        out = self._call("GET", "/v1/connect/intentions/check",
+                         {"source": source, "destination": destination})
+        return bool(out[0].get("Allowed"))
+
+    def intention_match(self, by: str, name: str) -> dict:
+        return self._call("GET", "/v1/connect/intentions/match",
+                          {"by": by, "name": name})[0]
+
+    # -------------------------------------------------------------- connect ca
+
+    def connect_ca_roots(self) -> dict:
+        return self._call("GET", "/v1/connect/ca/roots")[0]
+
+    def connect_ca_rotate(self) -> dict:
+        return self._call("PUT", "/v1/connect/ca/rotate")[0]
+
+    def connect_ca_config(self) -> dict:
+        return self._call("GET", "/v1/connect/ca/configuration")[0]
+
+    def connect_ca_set_config(self, config: dict) -> bool:
+        return bool(self._call("PUT", "/v1/connect/ca/configuration",
+                               None, json.dumps(config).encode())[0])
+
+    # ------------------------------------------------------------ login/logout
+
+    def acl_login(self, auth_method: str, bearer_token: str,
+                  meta: Optional[dict] = None) -> dict:
+        """PUT /v1/acl/login → the minted token (acl_endpoint.go
+        Login)."""
+        return self._call("PUT", "/v1/acl/login", None, json.dumps(
+            {"AuthMethod": auth_method, "BearerToken": bearer_token,
+             "Meta": meta or {}}).encode())[0]
+
+    def acl_logout(self) -> bool:
+        """PUT /v1/acl/logout under this client's token."""
+        return bool(self._call("PUT", "/v1/acl/logout")[0])

@@ -44,11 +44,8 @@ from consul_tpu.version import VERSION
 
 
 def _parse_wait(val: str) -> float:
-    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", val)
-    if not m:
-        return 10.0
-    scale = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}[m.group(2) or "s"]
-    return float(m.group(1)) * scale
+    from consul_tpu.utils.duration import parse_duration
+    return parse_duration(val, 10.0)
 
 
 class NullOracle:
@@ -2675,11 +2672,15 @@ def _member_json(m: dict) -> dict:
     tags = {"role": "node", "incarnation": str(m["incarnation"])}
     if "segment" in m:
         tags["segment"] = m["segment"]   # serf segment tag
-    # addr_ns (segment index) namespaces the synthetic address —
-    # per-pool ids restart at 0, so segmented members would otherwise
-    # collide on Addr:Port
-    ns = m.get("addr_ns", 0)
-    octet2 = (ns * 64 + ((m["id"] >> 16) & 63)) & 255
+    # addr_ns (segment index) namespaces the synthetic address: per-
+    # pool ids restart at 0, so segmented members would otherwise
+    # collide on Addr:Port.  Unsegmented pools keep the full 24-bit id
+    # space; segmented pools get 256 segments x 64k nodes of unique
+    # addresses (beyond that the NAME remains the identity).
+    if "addr_ns" in m:
+        octet2 = m["addr_ns"] & 255
+    else:
+        octet2 = (m["id"] >> 16) & 255
     return {"Name": m["name"],
             "Addr": f"10.{octet2}."
             f"{(m['id'] >> 8) & 255}.{m['id'] & 255}",

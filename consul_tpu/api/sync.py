@@ -30,21 +30,48 @@ class _SessionHeartbeat:
     session.RenewPeriodic): without it the leader's TTL reaper destroys
     the session mid-hold — the lock silently releases while the handle
     still reports held, and a parked waiter's own session dies so its
-    acquire loop can never succeed."""
+    acquire loop can never succeed.
+
+    Transient renew errors (connection reset, a 500 during leader
+    election) are retried up to the TTL budget; only a definitive
+    session-not-found — or retries exhausted — marks the hold LOST,
+    which flips the owning handle's `held` to False (the reference
+    closes lockSession/leaderCh for the same reason: the holder must
+    learn it no longer owns the lock)."""
 
     def __init__(self, client, sid: str, ttl: str):
         import threading
         self.client = client
         self.sid = sid
-        period = max(0.5, _ttl_seconds(ttl) / 2.0)
+        ttl_s = _ttl_seconds(ttl)
+        period = max(0.5, ttl_s / 2.0)
+        # a renewal must land within one TTL; past that the reaper may
+        # already have fired, so the hold can no longer be trusted
+        max_failures = max(2, int(ttl_s / max(0.25, period / 2)) )
+        self.lost = threading.Event()
         self._stop = threading.Event()
 
         def loop():
-            while not self._stop.wait(period):
+            failures = 0
+            wait = period
+            while not self._stop.wait(wait):
                 try:
-                    self.client.session_renew(self.sid)
-                except Exception:
-                    return   # session gone: holder must re-acquire
+                    renewed = self.client.session_renew(self.sid)
+                    if not renewed:
+                        self.lost.set()
+                        return
+                    failures = 0
+                    wait = period
+                except Exception as e:
+                    from consul_tpu.api.client import ApiError
+                    if isinstance(e, ApiError) and e.code == 404:
+                        self.lost.set()    # session reaped: definitive
+                        return
+                    failures += 1
+                    if failures >= max_failures:
+                        self.lost.set()
+                        return
+                    wait = max(0.25, period / 2)   # hurried retry
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
@@ -55,13 +82,8 @@ class _SessionHeartbeat:
 
 
 def _ttl_seconds(ttl: str) -> float:
-    import re
-    m = re.fullmatch(r"(\d+(?:\.\d+)?)(ms|s|m|h)?", ttl)
-    if not m:
-        return 15.0
-    scale = {"ms": 1e-3, "s": 1.0, "m": 60.0,
-             "h": 3600.0}[m.group(2) or "s"]
-    return float(m.group(1)) * scale
+    from consul_tpu.utils.duration import parse_duration
+    return parse_duration(ttl, 15.0)
 
 
 def _wait_str(remaining: Optional[float], default: str = "10s") -> str:
@@ -89,6 +111,11 @@ class Lock:
 
     @property
     def held(self) -> bool:
+        """False once the heartbeat reports the session lost — the
+        holder must not keep acting as owner after the reaper fired."""
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None and hb.lost.is_set():
+            return False
         return self.session is not None
 
     def acquire(self, blocking: bool = True,
@@ -138,16 +165,23 @@ class Lock:
             raise
 
     def release(self) -> None:
-        """Unlock (api/lock.go Unlock): release the key, keep it."""
-        if not self.held:
+        """Unlock (api/lock.go Unlock): release the key, keep it.
+        A LOST hold (session reaped under us) still cleans up quietly —
+        __exit__ must not mask the caller's exception with LockError."""
+        if self.session is None:
             raise LockError("lock not held")
         sid, self.session = self.session, None
         hb = getattr(self, "_heartbeat", None)
+        lost = hb is not None and hb.lost.is_set()
         if hb is not None:
             hb.stop()
             self._heartbeat = None
-        self.client.kv_put(self.key, b"", release=sid)
-        self.client.session_destroy(sid)
+        if not lost:
+            self.client.kv_put(self.key, b"", release=sid)
+        try:
+            self.client.session_destroy(sid)
+        except Exception:
+            pass   # already reaped
 
     def destroy(self) -> None:
         """Delete the lock key if free (api/lock.go Destroy)."""
@@ -211,6 +245,9 @@ class Semaphore:
 
     @property
     def held(self) -> bool:
+        hb = getattr(self, "_heartbeat", None)
+        if hb is not None and hb.lost.is_set():
+            return False
         return self.session is not None
 
     def acquire(self, blocking: bool = True,
@@ -269,14 +306,15 @@ class Semaphore:
             raise
 
     def release(self) -> None:
-        if not self.held:
+        if self.session is None:
             raise LockError("semaphore not held")
         sid, self.session = self.session, None
         hb = getattr(self, "_heartbeat", None)
         if hb is not None:
             hb.stop()
             self._heartbeat = None
-        # drop ourselves from the holder doc under CAS
+        # drop ourselves from the holder doc under CAS (needed even
+        # after a lost session: the doc entry is ours to prune)
         while True:
             doc, cas, _ = self._read_doc()
             if sid not in doc["Holders"]:
@@ -286,7 +324,10 @@ class Semaphore:
                                   json.dumps(doc).encode(), cas=cas):
                 break
         self.client.kv_delete(self._contender_key(sid))
-        self.client.session_destroy(sid)
+        try:
+            self.client.session_destroy(sid)
+        except Exception:
+            pass   # already reaped
 
     def __enter__(self) -> "Semaphore":
         if not self.acquire():

@@ -282,6 +282,162 @@ def cmd_leave(args) -> int:
     return 0
 
 
+def cmd_config(args) -> int:
+    """consul config (command/config): centralized config entries."""
+    c = _client(args)
+    if args.config_cmd == "write":
+        if args.file == "-":
+            entry = json.loads(sys.stdin.read())
+        else:
+            with open(args.file) as f:
+                entry = json.loads(f.read())
+        c.config_write(entry)
+        print(f"Config entry written: "
+              f"{entry.get('Kind')}/{entry.get('Name')}")
+        return 0
+    if args.config_cmd == "read":
+        print(json.dumps(c.config_read(args.kind, args.name), indent=2))
+        return 0
+    if args.config_cmd == "list":
+        for e in c.config_list(args.kind):
+            print(e.get("Name", ""))
+        return 0
+    if args.config_cmd == "delete":
+        c.config_delete(args.kind, args.name)
+        print(f"Config entry deleted: {args.kind}/{args.name}")
+        return 0
+    return 1
+
+
+def cmd_intention(args) -> int:
+    """consul intention (command/intention)."""
+    c = _client(args)
+    if args.intention_cmd == "create":
+        action = "deny" if args.deny else "allow"
+        iid = c.intention_create(args.source, args.destination, action)
+        print(f"Created: {args.source} => {args.destination} "
+              f"({action}) id={iid}")
+        return 0
+    if args.intention_cmd == "list":
+        for it in c.intention_list():
+            print(f"{it['ID']}  {it['SourceName']} => "
+                  f"{it['DestinationName']}  {it['Action']}")
+        return 0
+    if args.intention_cmd == "check":
+        allowed = c.intention_check(args.source, args.destination)
+        print("Allowed" if allowed else "Denied")
+        return 0 if allowed else 2
+    if args.intention_cmd == "delete":
+        c.intention_delete(args.id)
+        print(f"Deleted: {args.id}")
+        return 0
+    if args.intention_cmd == "match":
+        out = c.intention_match(args.by, args.name)
+        for rows in out.values():
+            for it in rows:
+                print(f"{it['SourceName']} => "
+                      f"{it['DestinationName']}  {it['Action']}")
+        return 0
+    return 1
+
+
+def cmd_connect(args) -> int:
+    """consul connect ca (command/connect/ca)."""
+    c = _client(args)
+    if args.ca_cmd == "roots":
+        out = c.connect_ca_roots()
+        for r in out["Roots"]:
+            mark = "*" if r["Active"] else " "
+            print(f"{mark} {r['ID']}")
+        return 0
+    if args.ca_cmd == "rotate":
+        out = c.connect_ca_rotate()
+        print(f"Rotated: active root {out['ActiveRootID']}")
+        return 0
+    if args.ca_cmd == "get-config":
+        print(json.dumps(c.connect_ca_config(), indent=2))
+        return 0
+    if args.ca_cmd == "set-config":
+        with (sys.stdin if args.config_file == "-"
+              else open(args.config_file)) as f:
+            c.connect_ca_set_config(json.loads(f.read()))
+        print("Configuration updated")
+        return 0
+    return 1
+
+
+def cmd_login(args) -> int:
+    """consul login (command/login): bearer JWT → ACL token sink."""
+    c = _client(args)
+    with (sys.stdin if args.bearer_token_file == "-"
+          else open(args.bearer_token_file)) as f:
+        bearer = f.read().strip()
+    out = c.acl_login(args.method, bearer)
+    secret = out.get("SecretID", "")
+    if args.token_sink_file:
+        import os
+        # 0600: the sink holds a live credential (the reference writes
+        # token sinks with restrictive perms)
+        fd = os.open(args.token_sink_file,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(secret)
+        print(f"Token written to {args.token_sink_file}")
+    else:
+        print(secret)
+    return 0
+
+
+def cmd_logout(args) -> int:
+    """consul logout: destroy the login token in use."""
+    _client(args).acl_logout()
+    print("Logged out")
+    return 0
+
+
+def cmd_tls(args) -> int:
+    """consul tls ca|cert create (command/tls): local PKI material."""
+    from consul_tpu.tlsutil import Configurator
+    import os
+    if args.tls_cmd == "ca":
+        tls = Configurator(dc=args.dc)
+        with open("consul-agent-ca.pem", "w") as f:
+            f.write(tls.ca_pem)
+        with open("consul-agent-ca-key.pem", "w") as f:
+            f.write(tls.ca_key_pem)
+        print("==> Saved consul-agent-ca.pem")
+        print("==> Saved consul-agent-ca-key.pem")
+        return 0
+    if args.tls_cmd == "cert":
+        if not (os.path.exists("consul-agent-ca.pem")
+                and os.path.exists("consul-agent-ca-key.pem")):
+            print("CA files not found: run `tls ca create` first",
+                  file=sys.stderr)
+            return 1
+        with open("consul-agent-ca.pem") as f:
+            ca_pem = f.read()
+        with open("consul-agent-ca-key.pem") as f:
+            ca_key = f.read()
+        tls = Configurator(dc=args.dc, ca_cert_pem=ca_pem,
+                           ca_key_pem=ca_key)
+        name = args.name or ("server" if args.server else "client")
+        cert, key = tls.sign_cert(name, server=args.server)
+        role = "server" if args.server else "client"
+        # increment like the reference: never clobber an issued pair
+        i = 0
+        while os.path.exists(f"{args.dc}-{role}-consul-{i}.pem"):
+            i += 1
+        base = f"{args.dc}-{role}-consul-{i}"
+        with open(f"{base}.pem", "w") as f:
+            f.write(cert)
+        with open(f"{base}-key.pem", "w") as f:
+            f.write(key)
+        print(f"==> Saved {base}.pem")
+        print(f"==> Saved {base}-key.pem")
+        return 0
+    return 1
+
+
 def cmd_maint(args) -> int:
     """consul maint (command/maint): toggle node or service
     maintenance mode via the reserved critical checks."""
@@ -746,6 +902,71 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("node")
     sp.set_defaults(fn=cmd_force_leave)
     sub.add_parser("leave").set_defaults(fn=cmd_leave)
+
+    sp = sub.add_parser("config")
+    csub = sp.add_subparsers(dest="config_cmd", required=True)
+    x = csub.add_parser("write")
+    x.add_argument("file")
+    x = csub.add_parser("read")
+    x.add_argument("-kind", required=True)
+    x.add_argument("-name", required=True)
+    x = csub.add_parser("list")
+    x.add_argument("-kind", required=True)
+    x = csub.add_parser("delete")
+    x.add_argument("-kind", required=True)
+    x.add_argument("-name", required=True)
+    sp.set_defaults(fn=cmd_config)
+
+    sp = sub.add_parser("intention")
+    isub = sp.add_subparsers(dest="intention_cmd", required=True)
+    x = isub.add_parser("create")
+    x.add_argument("source")
+    x.add_argument("destination")
+    x.add_argument("-deny", action="store_true")
+    x = isub.add_parser("check")
+    x.add_argument("source")
+    x.add_argument("destination")
+    x = isub.add_parser("delete")
+    x.add_argument("id")
+    x = isub.add_parser("match")
+    x.add_argument("-by", default="destination",
+                   choices=["source", "destination"])
+    x.add_argument("name")
+    isub.add_parser("list")
+    sp.set_defaults(fn=cmd_intention)
+
+    sp = sub.add_parser("connect")
+    cosub = sp.add_subparsers(dest="connect_cmd", required=True)
+    ca = cosub.add_parser("ca")
+    casub = ca.add_subparsers(dest="ca_cmd", required=True)
+    casub.add_parser("roots")
+    casub.add_parser("rotate")
+    casub.add_parser("get-config")
+    x = casub.add_parser("set-config")
+    x.add_argument("-config-file", dest="config_file", default="-")
+    sp.set_defaults(fn=cmd_connect)
+
+    sp = sub.add_parser("login")
+    sp.add_argument("-method", required=True)
+    sp.add_argument("-bearer-token-file", dest="bearer_token_file",
+                    required=True)
+    sp.add_argument("-token-sink-file", dest="token_sink_file",
+                    default="")
+    sp.set_defaults(fn=cmd_login)
+
+    sub.add_parser("logout").set_defaults(fn=cmd_logout)
+
+    sp = sub.add_parser("tls")
+    tsub = sp.add_subparsers(dest="tls_cmd", required=True)
+    x = tsub.add_parser("ca")
+    x.add_argument("tls_action", choices=["create"])
+    x.add_argument("-dc", default="dc1")
+    x = tsub.add_parser("cert")
+    x.add_argument("tls_action", choices=["create"])
+    x.add_argument("-dc", default="dc1")
+    x.add_argument("-server", action="store_true")
+    x.add_argument("-name", default="")
+    sp.set_defaults(fn=cmd_tls)
 
     sp = sub.add_parser("maint")
     sp.add_argument("-enable", action="store_true")

@@ -55,6 +55,10 @@ class Configurator:
     def ca_pem(self) -> str:
         return self._ca.cert_pem
 
+    @property
+    def ca_key_pem(self) -> str:
+        return self._ca.key_pem
+
     def sign_cert(self, name: str,
                   server: bool = False) -> Tuple[str, str]:
         """(cert_pem, key_pem) for a node/agent; server certs carry the

@@ -1,0 +1,23 @@
+"""One duration parser for the whole tree ("10s"/"1.5m"/"500ms").
+
+The HTTP layer's ?wait= parsing and the client-side session TTLs both
+speak Go duration strings; a single implementation keeps them from
+drifting (lib parseWait / time.ParseDuration role)."""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+_DURATION = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h)?")
+
+_SCALE = {"ms": 1e-3, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def parse_duration(val: Any, default: float) -> float:
+    """Seconds from a duration string; bare numbers mean seconds;
+    anything unparsable yields `default`."""
+    m = _DURATION.fullmatch(str(val))
+    if not m:
+        return default
+    return float(m.group(1)) * _SCALE[m.group(2) or "s"]

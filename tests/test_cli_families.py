@@ -1,0 +1,116 @@
+"""CLI families added in round 2: config, intention, connect ca,
+login/logout, tls, plus the client methods backing them.
+
+Reference: command/config, command/intention, command/connect/ca,
+command/login, command/logout, command/tls.
+"""
+
+import json
+import os
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.cli.main import main
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=181))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def run(agent, capsys):
+    def _run(*argv, rc=0):
+        code = main(["-http-addr", agent.http_address, *argv])
+        out = capsys.readouterr()
+        assert code == rc, f"exit {code}: {out.err or out.out}"
+        return out.out
+    return _run
+
+
+def test_config_family(run, tmp_path):
+    entry = tmp_path / "defaults.json"
+    entry.write_text(json.dumps({
+        "Kind": "service-defaults", "Name": "cweb",
+        "Protocol": "http"}))
+    assert "service-defaults/cweb" in run("config", "write", str(entry))
+    out = json.loads(run("config", "read", "-kind", "service-defaults",
+                         "-name", "cweb"))
+    assert out["Protocol"] == "http"
+    assert "cweb" in run("config", "list", "-kind", "service-defaults")
+    run("config", "delete", "-kind", "service-defaults", "-name", "cweb")
+    assert "cweb" not in run("config", "list", "-kind",
+                             "service-defaults")
+
+
+def test_intention_family(run):
+    out = run("intention", "create", "cli-web", "cli-db")
+    assert "cli-web => cli-db (allow)" in out
+    iid = out.strip().split("id=")[1]
+    assert "cli-web => cli-db" in run("intention", "list")
+    assert "Allowed" in run("intention", "check", "cli-web", "cli-db")
+    run("intention", "create", "evil", "cli-db", "-deny")
+    assert "Denied" in run("intention", "check", "evil", "cli-db",
+                           rc=2)
+    assert "cli-web" in run("intention", "match", "cli-db")
+    run("intention", "delete", iid)
+    assert "cli-web => cli-db" not in run("intention", "list")
+
+
+def test_connect_ca_family(run):
+    roots = run("connect", "ca", "roots")
+    assert "*" in roots             # an active root is marked
+    cfg = json.loads(run("connect", "ca", "get-config"))
+    assert cfg["Provider"] == "consul"
+    out = run("connect", "ca", "rotate")
+    assert "active root" in out
+
+
+def test_login_logout_family(run, agent, tmp_path):
+    from consul_tpu.acl.authmethod import make_jwt
+    agent.store.acl_policy_set("p-cli", "cli-policy",
+                               'key_prefix "" { policy = "read" }')
+    agent.store.auth_method_set(
+        "cli-jwt", "jwt",
+        config={"secret": "cli-secret",
+                "claim_mappings": {"team": "team"}})
+    agent.store.binding_rule_set(
+        "br-cli", "cli-jwt", selector="team==ops",
+        bind_type="policy", bind_name="cli-policy")
+    bearer = tmp_path / "jwt.txt"
+    bearer.write_text(make_jwt({"team": "ops"}, "cli-secret"))
+    sink = tmp_path / "token.txt"
+    run("login", "-method", "cli-jwt",
+        "-bearer-token-file", str(bearer),
+        "-token-sink-file", str(sink))
+    secret = sink.read_text()
+    assert agent.store.acl_token_get_by_secret(secret) is not None
+    # logout destroys the login token
+    assert main(["-http-addr", agent.http_address, "-token", secret,
+                 "logout"]) == 0
+    assert agent.store.acl_token_get_by_secret(secret) is None
+
+
+def test_tls_family(run, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run("tls", "ca", "create")
+    assert os.path.exists("consul-agent-ca.pem")
+    assert os.path.exists("consul-agent-ca-key.pem")
+    run("tls", "cert", "create", "-server")
+    assert os.path.exists("dc1-server-consul-0.pem")
+    # the issued cert chains to the created CA
+    from cryptography import x509
+    from cryptography.hazmat.primitives.asymmetric import ec
+    ca = x509.load_pem_x509_certificate(
+        open("consul-agent-ca.pem", "rb").read())
+    cert = x509.load_pem_x509_certificate(
+        open("dc1-server-consul-0.pem", "rb").read())
+    ca.public_key().verify(cert.signature, cert.tbs_certificate_bytes,
+                           ec.ECDSA(cert.signature_hash_algorithm))

@@ -167,3 +167,17 @@ def test_lock_subsecond_timeout_respected(client, agent):
     assert not l2.acquire(timeout=0.3)
     assert time.time() - t0 < 0.9    # not rounded up to 1s+
     l1.release()
+
+
+def test_lost_session_flips_held(client, agent):
+    """When the session dies under the holder (reaper/manual destroy),
+    the heartbeat marks the hold lost and held goes False — no silent
+    split-brain ownership."""
+    lk = Lock(client, "locks/lost", session_ttl="1s")
+    assert lk.acquire()
+    client.session_destroy(lk.session)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and lk.held:
+        time.sleep(0.2)
+    assert not lk.held
+    lk.release()    # cleanup after loss must not raise
