@@ -133,6 +133,9 @@ class ApiServer:
         # /debug/pprof analogues served only when explicitly enabled
         # (agent/http.go enable_debug gate)
         self.enable_debug = False
+        # guards the per-proxy xDS delta payload caches: handler
+        # threads race on insert/evict (ThreadingHTTPServer)
+        self._xds_cache_lock = threading.Lock()
         # Connect CA (lazy: cert generation costs entropy/CPU at boot)
         self._ca = None
         self._ca_lock = threading.Lock()
@@ -2214,7 +2217,34 @@ def _make_handler(srv: ApiServer):
                 wait = _parse_wait(q.get("wait", "300s")) \
                     if "version" in q else 0.0
                 snap = state.fetch(min_v, timeout=wait)
-                self._send(xdsmod.snapshot_resources(snap))
+                payload = xdsmod.snapshot_resources(snap)
+                # incremental mode (?delta): cache recent payloads per
+                # proxy and ship only changed/removed resources when
+                # the client's version is still in the window
+                # (DeltaAggregatedResources, delta.go:33)
+                with srv._xds_cache_lock:
+                    cache = getattr(state, "_payload_cache", None)
+                    if cache is None:
+                        cache = state._payload_cache = {}
+                    cache[snap.version] = payload["Resources"]
+                    for old in sorted(cache):
+                        if len(cache) <= 8:
+                            break
+                        del cache[old]
+                    prev = cache.get(min_v) if "delta" in q \
+                        and min_v != snap.version else None
+                if prev is not None:
+                    self._send({
+                        "VersionInfo": payload["VersionInfo"],
+                        "FromVersion": str(min_v),
+                        "ProxyID": payload["ProxyID"],
+                        "Service": payload["Service"],
+                        "Kind": payload["Kind"],
+                        "Delta": xdsmod.delta(prev,
+                                              payload["Resources"]),
+                    })
+                    return True
+                self._send(payload)
                 return True
             if path == "/v1/connect/ca/roots" and verb == "GET":
                 roots = srv.ca.roots()

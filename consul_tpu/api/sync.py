@@ -45,9 +45,12 @@ class _SessionHeartbeat:
         self.sid = sid
         ttl_s = _ttl_seconds(ttl)
         period = max(0.5, ttl_s / 2.0)
-        # a renewal must land within one TTL; past that the reaper may
-        # already have fired, so the hold can no longer be trusted
-        max_failures = max(2, int(ttl_s / max(0.25, period / 2)) )
+        retry = max(0.25, period / 2.0)
+        # loss must be declared BEFORE the reaper can fire: first failed
+        # attempt lands at last_renew + period, each hurried retry adds
+        # `retry`, so 2 failures marks lost at period + retry = 0.75*ttl
+        # < ttl — never a window where held=True past the reap point
+        max_failures = 2
         self.lost = threading.Event()
         self._stop = threading.Event()
 
@@ -71,7 +74,7 @@ class _SessionHeartbeat:
                     if failures >= max_failures:
                         self.lost.set()
                         return
-                    wait = max(0.25, period / 2)   # hurried retry
+                    wait = retry                   # hurried retry
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -358,9 +358,13 @@ def cmd_connect(args) -> int:
         print(json.dumps(c.connect_ca_config(), indent=2))
         return 0
     if args.ca_cmd == "set-config":
-        with (sys.stdin if args.config_file == "-"
-              else open(args.config_file)) as f:
-            c.connect_ca_set_config(json.loads(f.read()))
+        # never close sys.stdin: main() is called in-process
+        if args.config_file == "-":
+            cfg = json.loads(sys.stdin.read())
+        else:
+            with open(args.config_file) as f:
+                cfg = json.loads(f.read())
+        c.connect_ca_set_config(cfg)
         print("Configuration updated")
         return 0
     return 1
@@ -369,9 +373,11 @@ def cmd_connect(args) -> int:
 def cmd_login(args) -> int:
     """consul login (command/login): bearer JWT → ACL token sink."""
     c = _client(args)
-    with (sys.stdin if args.bearer_token_file == "-"
-          else open(args.bearer_token_file)) as f:
-        bearer = f.read().strip()
+    if args.bearer_token_file == "-":
+        bearer = sys.stdin.read().strip()   # don't close stdin
+    else:
+        with open(args.bearer_token_file) as f:
+            bearer = f.read().strip()
     out = c.acl_login(args.method, bearer)
     secret = out.get("SecretID", "")
     if args.token_sink_file:
@@ -400,6 +406,12 @@ def cmd_tls(args) -> int:
     from consul_tpu.tlsutil import Configurator
     import os
     if args.tls_cmd == "ca":
+        # refuse to clobber: every issued cert chains to THIS keypair
+        # (the reference errors with "file ... already exists")
+        for path in ("consul-agent-ca.pem", "consul-agent-ca-key.pem"):
+            if os.path.exists(path):
+                print(f"file {path} already exists", file=sys.stderr)
+                return 1
         tls = Configurator(dc=args.dc)
         with open("consul-agent-ca.pem", "w") as f:
             f.write(tls.ca_pem)

@@ -54,7 +54,8 @@ PAGE = """<!DOCTYPE html>
 <nav id="nav"></nav>
 <main id="main">loading…</main>
 <script>
-const tabs = ["services","nodes","members","kv","intentions","operator"];
+const tabs = ["services","nodes","members","kv","intentions","mesh",
+              "operator"];
 let tab = location.hash.slice(1) || "services";
 const $ = (h) => { const d = document.createElement("div");
                    d.innerHTML = h; return d; };
@@ -67,30 +68,67 @@ function pill(st) {
   return `<span class="pill ${cls}">${esc(st)}</span>`;
 }
 async function renderServices() {
-  const svcs = await get("/v1/catalog/services") || {};
-  let rows = "";
-  for (const name of Object.keys(svcs)) {
-    const hs = await get(`/v1/health/service/${name}`) || [];
-    const inst = hs.map(h => {
-      const worst = (h.Checks || []).reduce((w, c) =>
-        c.Status === "critical" ? "critical"
-        : (c.Status === "warning" && w !== "critical") ? "warning" : w,
-        "passing");
-      return `${pill(worst)} ${esc(h.Node.Node)}:${h.Service.Port}`;
-    }).join("<br>");
-    rows += `<tr><td>${esc(name)}</td><td>${svcs[name].map(esc)
-      .join(", ") || '<span class="dim">—</span>'}</td>
-      <td>${inst || '<span class="dim">no instances</span>'}</td></tr>`;
-  }
-  return `<table><tr><th>Service</th><th>Tags</th>
-    <th>Instances</th></tr>${rows}</table>`;
+  // ONE summary call (/v1/internal/ui/services) — the N+1 per-service
+  // health fetches would hammer the agent on every 5s refresh
+  const rows = await get("/v1/internal/ui/services") || [];
+  return `<table><tr><th>Service</th><th>Kind</th><th>Tags</th>
+    <th>Instances</th><th>Health</th></tr>` + rows.map(s => {
+    const health = [
+      s.ChecksPassing ? `${pill("passing")} ${s.ChecksPassing}` : "",
+      s.ChecksWarning ? `${pill("warning")} ${s.ChecksWarning}` : "",
+      s.ChecksCritical ? `${pill("critical")} ${s.ChecksCritical}` : "",
+    ].filter(Boolean).join(" ");
+    return `<tr><td>${esc(s.Name)}</td>
+      <td>${esc(s.Kind) || '<span class="dim">—</span>'}</td>
+      <td>${(s.Tags || []).map(esc).join(", ")
+            || '<span class="dim">—</span>'}</td>
+      <td>${s.InstanceCount}</td>
+      <td>${health || '<span class="dim">no checks</span>'}</td>
+      </tr>`;}).join("") + `</table>`;
 }
 async function renderNodes() {
-  const nodes = await get("/v1/catalog/nodes") || [];
-  return `<table><tr><th>Node</th><th>Address</th></tr>` +
-    nodes.map(n => `<tr><td>${esc(n.Node)}</td>
-      <td><code>${esc(n.Address)}</code></td></tr>`).join("") +
-    `</table>`;
+  const nodes = await get("/v1/internal/ui/nodes") || [];
+  return `<table><tr><th>Node</th><th>Address</th><th>Checks</th></tr>`
+    + nodes.map(n => {
+      const c = n.Checks || {};
+      const health = [
+        c.passing ? `${pill("passing")} ${c.passing}` : "",
+        c.warning ? `${pill("warning")} ${c.warning}` : "",
+        c.critical ? `${pill("critical")} ${c.critical}` : "",
+      ].filter(Boolean).join(" ");
+      return `<tr><td>${esc(n.Node)}</td>
+      <td><code>${esc(n.Address)}</code></td>
+      <td>${health || '<span class="dim">—</span>'}</td></tr>`;
+    }).join("") + `</table>`;
+}
+async function renderMesh() {
+  const svcs = await get("/v1/internal/ui/services") || [];
+  const gws = svcs.filter(s =>
+    (s.Kind || "").indexOf("gateway") >= 0);
+  let html = "";
+  if (gws.length) {
+    // one PARALLEL round-trip for all gateways (no serial N+1)
+    const bounds = await Promise.all(gws.map(gw =>
+      get(`/v1/catalog/gateway-services/${gw.Name}`)));
+    const rows = gws.map((gw, i) =>
+      `<tr><td>${esc(gw.Name)}</td><td>${esc(gw.Kind)}</td>
+        <td>${(bounds[i] || []).map(b => esc(b.Service)).join(", ")
+              || '<span class="dim">—</span>'}</td></tr>`).join("");
+    html += `<h3>Gateways</h3><table><tr><th>Gateway</th><th>Kind</th>
+      <th>Bound services</th></tr>${rows}</table>`;
+  } else {
+    html += `<p class="dim">no gateways registered</p>`;
+  }
+  const roots = await get("/v1/connect/ca/roots");
+  if (roots) {
+    html += `<h3>CA roots</h3><table><tr><th>Root</th><th>Active</th>
+      </tr>` + roots.Roots.map(r => `<tr><td><code>${esc(r.ID)}</code>
+      </td><td>${r.Active ? "★" : ""}</td></tr>`).join("")
+      + `</table>
+      <p class="dim">trust domain <code>${esc(roots.TrustDomain)}
+      </code></p>`;
+  }
+  return html;
 }
 async function renderMembers() {
   const m = await get("/v1/agent/metrics") || {Gauges: []};
@@ -100,9 +138,13 @@ async function renderMembers() {
      </div><div class="l">${k}</div></div>`).join("");
   const mem = await get("/v1/agent/members?limit=100") || [];
   const statusNames = {1: "alive", 3: "left", 4: "failed"};
+  const anySeg = mem.some(x => x.Tags && x.Tags.segment);
   return `<div class="cards">${cards}</div>
-    <table><tr><th>Member</th><th>Status</th></tr>` +
+    <table><tr><th>Member</th>${anySeg ? "<th>Segment</th>" : ""}
+    <th>Status</th></tr>` +
     mem.map(x => `<tr><td>${esc(x.Name)}</td>
+      ${anySeg ? `<td>${esc((x.Tags && x.Tags.segment) || "")
+        || '<span class="dim">&lt;default&gt;</span>'}</td>` : ""}
       <td>${pill(statusNames[x.Status] || String(x.Status))}
       </td></tr>`).join("") + `</table>
     <p class="dim">first 100 of ${g["consul.members.total"] ?? "?"}</p>`;
@@ -139,7 +181,7 @@ async function renderOperator() {
 }
 const renderers = {services: renderServices, nodes: renderNodes,
   members: renderMembers, kv: renderKV, intentions: renderIntentions,
-  operator: renderOperator};
+  mesh: renderMesh, operator: renderOperator};
 async function render() {
   document.getElementById("nav").innerHTML = tabs.map(t =>
     `<button class="${t === tab ? "on" : ""}"

@@ -334,6 +334,30 @@ def ingress_gateway_resources(snap) -> dict:
             "routes": rts}
 
 
+# resource identity per type (delta.go tracks resources by name so an
+# update ships only what changed)
+_DELTA_KEYS = {"clusters": "name", "endpoints": "cluster_name",
+               "listeners": "name", "routes": "name"}
+
+
+def delta(prev_resources: dict, new_resources: dict) -> dict:
+    """Per-resource diff between two payload versions
+    (DeltaAggregatedResources semantics: changed resources in full,
+    removed resources by name)."""
+    changed, removed = {}, {}
+    for rtype, keyf in _DELTA_KEYS.items():
+        old = {r[keyf]: r for r in prev_resources.get(rtype, [])}
+        new = {r[keyf]: r for r in new_resources.get(rtype, [])}
+        ch = [r for k, r in new.items()
+              if k not in old or old[k] != r]
+        rm = sorted(k for k in old if k not in new)
+        if ch:
+            changed[rtype] = ch
+        if rm:
+            removed[rtype] = rm
+    return {"Changed": changed, "Removed": removed}
+
+
 def snapshot_resources(snap) -> dict:
     """Full ADS payload for one proxy version (DeltaAggregatedResources
     response analogue); gateway kinds get their own resource shapes."""

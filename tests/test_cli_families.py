@@ -114,3 +114,15 @@ def test_tls_family(run, tmp_path, monkeypatch):
         open("dc1-server-consul-0.pem", "rb").read())
     ca.public_key().verify(cert.signature, cert.tbs_certificate_bytes,
                            ec.ECDSA(cert.signature_hash_algorithm))
+
+
+def test_tls_ca_create_refuses_overwrite(run, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run("tls", "ca", "create")
+    # a second create must refuse: issued certs chain to the first CA
+    run("tls", "ca", "create", rc=1)
+    # cert files increment instead of clobbering
+    run("tls", "cert", "create", "-server")
+    run("tls", "cert", "create", "-server")
+    assert os.path.exists("dc1-server-consul-0.pem")
+    assert os.path.exists("dc1-server-consul-1.pem")

@@ -155,3 +155,53 @@ def test_sidecar_deregisters_cleanly(agent):
     with pytest.raises(urllib.error.HTTPError) as e:
         _xds(agent, "tmp-proxy")
     assert e.value.code == 404
+
+
+def test_delta_poll_ships_only_changed_resources(agent):
+    """?delta&version=N returns changed/removed resources only
+    (DeltaAggregatedResources semantics, agent/xds/delta.go:33)."""
+    # earlier tests may have left db1 critical: restore it to passing
+    # and wait for the snapshot to show a non-empty endpoint set, so
+    # the critical flip below actually CHANGES the EDS resource
+    try:
+        agent.store.update_check("n2", "dbc", "passing")
+    except KeyError:
+        pass
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        out = _xds(agent, "web-sidecar-proxy")
+        eds = {e["cluster_name"]: e
+               for e in out["Resources"]["endpoints"]}
+        if eds["db"]["endpoints"][0]["lb_endpoints"]:
+            break
+        time.sleep(0.2)
+    v = int(out["VersionInfo"])
+    # flip upstream health: endpoints change, listeners/routes do not
+    agent.store.register_check("n2", "dbc2", "db check 2",
+                               status="critical", service_id="db1")
+    deadline = time.time() + 10.0
+    body = None
+    while time.time() < deadline:
+        r = urllib.request.urlopen(
+            agent.http_address +
+            f"/v1/agent/xds/web-sidecar-proxy?delta&version={v}&wait=2s",
+            timeout=30)
+        body = json.loads(r.read())
+        if "Delta" in body and int(body["VersionInfo"]) > v:
+            break
+        time.sleep(0.2)
+    agent.store.update_check("n2", "dbc2", "passing")
+    assert body is not None and "Delta" in body, body
+    assert body["FromVersion"] == str(v)
+    delta = body["Delta"]
+    assert "endpoints" in delta["Changed"]
+    assert "listeners" not in delta["Changed"]
+    assert "routes" not in delta["Changed"]
+    # a client with an evicted/unknown version gets a FULL payload
+    # (wait short: a too-new version long-polls by design)
+    r = urllib.request.urlopen(
+        agent.http_address +
+        "/v1/agent/xds/web-sidecar-proxy?delta&version=999999&wait=1s",
+        timeout=30)
+    full = json.loads(r.read())
+    assert "Resources" in full and "Delta" not in full

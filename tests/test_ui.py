@@ -15,8 +15,12 @@ def test_ui_served_and_references_live_endpoints():
         assert r.status == 200
         assert "text/html" in r.headers.get("Content-Type", "")
         body = r.read().decode()
-        for endpoint in ("/v1/catalog/services", "/v1/agent/members",
-                         "/v1/connect/intentions", "/v1/kv/"):
+        for endpoint in ("/v1/internal/ui/services",
+                         "/v1/internal/ui/nodes",
+                         "/v1/agent/members",
+                         "/v1/connect/intentions", "/v1/kv/",
+                         "/v1/catalog/gateway-services",
+                         "/v1/connect/ca/roots"):
             assert endpoint in body
         # root redirector serves too
         r2 = urllib.request.urlopen(a.http_address + "/", timeout=30)
