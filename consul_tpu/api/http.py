@@ -152,7 +152,14 @@ class ApiServer:
             lambda key, min_index, timeout: self._fetch_health(key),
             ttl=600.0)
         handler = _make_handler(self)
-        self.httpd = ThreadingHTTPServer((host, port), handler)
+
+        class _Httpd(ThreadingHTTPServer):
+            # default backlog of 5 resets concurrent clients under
+            # load (the KV bench drives 32+ connections at once)
+            request_queue_size = 256
+            daemon_threads = True
+
+        self.httpd = _Httpd((host, port), handler)
         self.port = self.httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
 
@@ -231,6 +238,10 @@ def _make_handler(srv: ApiServer):
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        # Nagle + delayed-ACK between request and response writes adds
+        # ~40ms per keep-alive round-trip; small-RPC servers always
+        # disable it (the reference's net/http does the same)
+        disable_nagle_algorithm = True
 
         def log_message(self, *a):  # quiet
             pass

@@ -169,6 +169,7 @@ class RaftNode:
         self._last_contact = -1e18      # last valid leader contact (for pre-vote)
         self._election_deadline = 0.0
         self._heartbeat_due = 0.0
+        self._needs_bcast = False
         self._inbox: List[dict] = []
         self._lock = threading.RLock()
         self._pending: Dict[int, _Pending] = {}   # log index -> waiter
@@ -218,7 +219,18 @@ class RaftNode:
 
     def apply(self, cmd: Any, noop: bool = False) -> _Pending:
         """Leader-only append; returns a waiter resolved at FSM apply
-        (raftApply — agent/consul/rpc.go:730)."""
+        (raftApply — agent/consul/rpc.go:730).
+
+        Entries replicate on the NEXT tick, not the next heartbeat
+        (the reference's replication goroutines fire on notify; the
+        heartbeat is only the idle keepalive) — waiting out
+        heartbeat_interval would put a 50ms floor under every write.
+        Deliberately tick-driven rather than sending here: it keeps
+        apply() deterministic (no wall-clock branch perturbing seeded
+        message traces) and keeps blocking network I/O off the client
+        write path (a send to a partitioned peer would otherwise hold
+        the raft lock for the full connect timeout).  Concurrent
+        appliers batch into the single per-tick append."""
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
@@ -227,6 +239,7 @@ class RaftNode:
             pend = _Pending()
             self._pending[idx] = pend
             self.match_index[self.node_id] = idx
+            self._needs_bcast = True
             return pend
 
     def barrier(self) -> _Pending:
@@ -250,7 +263,8 @@ class RaftNode:
             if self.state in (FOLLOWER, CANDIDATE):
                 if now >= self._election_deadline:
                     self._start_election(now)
-            if self.state == LEADER and now >= self._heartbeat_due:
+            if self.state == LEADER and (now >= self._heartbeat_due
+                                         or self._needs_bcast):
                 self._broadcast_append(now)
             self._advance_commit()
             self._apply_committed()
@@ -332,6 +346,7 @@ class RaftNode:
                 fn(True)
 
     def _broadcast_append(self, now: float) -> None:
+        self._needs_bcast = False
         self._heartbeat_due = now + self.cfg.heartbeat_interval
         for p in self.peers:
             self._send_append(p)

@@ -1,0 +1,72 @@
+"""One replicated server per OS PROCESS: raft over TCP + HTTP serving.
+
+This is the deployment shape of the reference (one `consul agent
+-server` process per box, SURVEY §3.1): N processes, each with its own
+GIL/cores, raft frames and leader-forwarded writes over real sockets
+(consul_tpu/rpc), HTTP on a per-server port.  Used by
+tools/kv_bench.py --cluster to measure the multi-process scale-out the
+reference benched behind an nginx LB (bench/results-0.7.1.md:184-193),
+and runnable standalone:
+
+  python tools/server_proc.py --node server0 \
+      --peers server0=127.0.0.1:7101,server1=127.0.0.1:7102,... \
+      --http-port 7201
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+
+def parse_peers(spec: str):
+    out = {}
+    for part in spec.split(","):
+        name, addr = part.split("=", 1)
+        host, port = addr.rsplit(":", 1)
+        out[name] = (host, int(port))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--node", required=True)
+    ap.add_argument("--peers", required=True,
+                    help="name=host:port,name=host:port,...")
+    ap.add_argument("--http-port", type=int, required=True)
+    ap.add_argument("--tick", type=float, default=0.002)
+    args = ap.parse_args()
+
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.consensus.raft import RaftConfig
+    from consul_tpu.rpc import TcpTransport
+    from consul_tpu.server import Server
+
+    addresses = parse_peers(args.peers)
+    my_rpc = addresses[args.node]
+    transport = TcpTransport(addresses)
+    import zlib
+    # crc32, not hash(): PYTHONHASHSEED randomizes str hash per
+    # process, which would make election jitter unreproducible
+    server = Server(args.node, sorted(addresses), transport,
+                    registry={}, raft_config=RaftConfig(),
+                    seed=zlib.crc32(args.node.encode()) & 0xFFFF)
+    server.serve_rpc(host=my_rpc[0], port=my_rpc[1])
+    api = ApiServer(server, node_name=args.node, port=args.http_port)
+    api.start()
+    print(f"server {args.node} rpc={my_rpc} "
+          f"http={api.address}", flush=True)
+    try:
+        while True:
+            server.tick(time.time())
+            time.sleep(args.tick)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        api.stop()
+        server.close_rpc()
+
+
+if __name__ == "__main__":
+    main()
