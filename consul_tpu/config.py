@@ -74,6 +74,8 @@ class SimConfig:
     """Simulator sizing + environment model (static; hashable for jit)."""
 
     n_nodes: int = 1024
+    n_initial: int = 0             # members at t=0 (0 = all N; less
+                                   # leaves free slots for elastic join)
     rumor_slots: int = 32          # U: max concurrently-active rumors
     alloc_cap: int = 8             # max new rumors allocated per tick per kind
     p_loss: float = 0.01           # per-leg UDP message loss probability

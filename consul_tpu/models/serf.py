@@ -56,8 +56,10 @@ class ClusterState:
     events: events.EventState
 
 
-def init_state(params: SerfParams, key=None) -> ClusterState:
-    return ClusterState(swim=swim.init_state(params.swim, key),
+def init_state(params: SerfParams, key=None,
+               n_initial: int = 0) -> ClusterState:
+    return ClusterState(swim=swim.init_state(params.swim, key,
+                                             n_initial=n_initial),
                         coords=vivaldi.init_state(params.vivaldi),
                         events=events.init_state(params.events))
 

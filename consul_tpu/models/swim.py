@@ -154,15 +154,25 @@ class SwimState:
     sends_left: jnp.ndarray      # [N, U] int8
 
 
-def init_state(params: SwimParams, key=None) -> SwimState:
+def init_state(params: SwimParams, key=None,
+               n_initial: int = 0) -> SwimState:
+    """`n_initial` > 0 starts the pool sparsely populated: ids beyond
+    it are unprovisioned (not members, not up) until `rejoin` brings
+    them in — elastic membership over a fixed device allocation
+    (SURVEY §5.3: joins/leaves at runtime; the oracle docstring's
+    sparse 1M-slot pool)."""
     n, u = params.n_nodes, params.rumor_slots
+    if n_initial < 0 or n_initial > n:
+        raise ValueError(f"n_initial={n_initial} outside [0, {n}]")
     if key is None:
         key = jax.random.PRNGKey(params.seed ^ 0x5EEDF00D)
     coords = jax.random.uniform(key, (n, 2), jnp.float32) * 30.0
+    present = jnp.ones((n,), bool) if not n_initial \
+        else jnp.arange(n) < n_initial
     return SwimState(
         tick=jnp.int32(0),
-        up=jnp.ones((n,), bool),
-        member=jnp.ones((n,), bool),
+        up=present,
+        member=present,
         incarnation=jnp.zeros((n,), jnp.int32),
         coords=coords,
         committed_dead=jnp.zeros((n,), bool),
@@ -407,7 +417,12 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
                                  (n, params.indirect_checks))
     ack = direct_ack | (t_up & jnp.any(relay_ok & legs4, axis=-1))
 
-    failed = prober & ~skip & ~ack
+    # a target outside the membership (never provisioned, or left) is
+    # not probed at all — memberlist only probes its member list; without
+    # this gate a sparse pool suspects and eventually commits phantom
+    # deaths for every free slot, saturating the rumor table
+    t_member = rolls.pull(s.member, d)
+    failed = prober & ~skip & ~ack & t_member
     # per-subject suspector count: the shift is a bijection — exactly one
     # prober per subject per round (cnt in {0,1}), like memberlist's ring
     cnt = rolls.push(failed, d).astype(jnp.int32)

@@ -35,13 +35,20 @@ class GossipOracle:
         self.gossip = gossip or GossipConfig.lan()
         self.sim = sim or SimConfig(n_nodes=64, rumor_slots=16)
         self.params = serf.make_params(self.gossip, self.sim)
-        self._state = serf.init_state(self.params)
+        self._state = serf.init_state(self.params,
+                                      n_initial=self.sim.n_initial)
         self._lock = threading.RLock()
         self._step = jax.jit(serf.step, static_argnums=0)
         self._node_prefix = node_prefix
         self._names: Dict[int, str] = {
             i: f"{node_prefix}{i}" for i in range(self.sim.n_nodes)}
         self._ids: Dict[str, int] = {v: k for k, v in self._names.items()}
+        # provisioned = ids that ever joined; never-joined slots of a
+        # sparse pool (n_initial < n) must not appear as phantom "left"
+        # members in listings (0 decodes to all-N exactly as in
+        # swim.init_state — single sentinel convention)
+        n_init = self.sim.n_initial or self.sim.n_nodes
+        self._provisioned = np.arange(self.sim.n_nodes) < n_init
         self._events: List[dict] = []           # host-side payload ring
         self._event_ring = 256                  # reference ring size
         # gossip keyring (serf keyring: install/use/remove/list — the
@@ -98,7 +105,14 @@ class GossipOracle:
     # -------------------------------------------------------------- identity
 
     def node_id(self, name: str) -> int:
-        return self._ids[name]
+        """Resolve a PROVISIONED member's id; never-joined default
+        names of a sparse pool don't resolve (they would read as
+        phantom 'left' members — listings hide them, so point lookups
+        must too)."""
+        i = self._ids[name]
+        if not self._provisioned[i]:
+            raise KeyError(name)
+        return i
 
     def node_name(self, node_id: int) -> str:
         return self._names.get(node_id, f"{self._node_prefix}{node_id}")
@@ -140,23 +154,25 @@ class GossipOracle:
         the full status computation is vectorized numpy on a cached
         snapshot, so this works at the N the sim targets."""
         status, inc, up = self._members_host()
-        n = len(status)
+        ids = np.flatnonzero(self._provisioned)
+        n = len(ids)
         offset = max(0, offset)
         end = n if limit is None else min(offset + max(0, limit), n)
         names = self._STATUS_NAMES
-        return [{"name": self.node_name(i), "id": i,
+        return [{"name": self.node_name(i), "id": int(i),
                  "status": names[status[i]], "incarnation": int(inc[i]),
                  "actually_up": bool(up[i])}
-                for i in range(offset, end)]
+                for i in ids[offset:end]]
 
     def members_summary(self) -> Dict[str, int]:
         """Counts by status — O(N) numpy, no per-node dicts; serves the
         /v1/agent/metrics membership gauges (the reference's usage
         metrics role, agent/consul/usagemetrics/)."""
         status, _, _ = self._members_host()
-        counts = np.bincount(status, minlength=3)
+        counts = np.bincount(status[self._provisioned], minlength=3)
         return {"alive": int(counts[0]), "failed": int(counts[1]),
-                "left": int(counts[2]), "total": len(status)}
+                "left": int(counts[2]),
+                "total": int(self._provisioned.sum())}
 
     def _oracle_down_mask(self) -> jnp.ndarray:
         """Nodes the cluster (majority view) considers failed: committed dead
@@ -201,6 +217,32 @@ class GossipOracle:
             self._state = self._state.replace(
                 swim=swim.leave(self.params.swim, self._state.swim,
                                 self.node_id(name)))
+
+    def spawn(self, name: Optional[str] = None) -> str:
+        """Elastic join of a NEW node: claim the first unprovisioned
+        slot (SimConfig.n_initial leaves free ids), optionally name
+        it, and rejoin it into the pool (memberlist Join — the cluster
+        learns of it via the alive rumor).  Raises RuntimeError when
+        the pool is full."""
+        with self._lock:
+            # validate BEFORE claiming: a rejected name must not leak
+            # the slot it would have taken
+            if name is not None and name in self._ids:
+                raise ValueError(f"node name {name!r} in use")
+            free = np.flatnonzero(~self._provisioned)
+            if len(free) == 0:
+                raise RuntimeError("pool full: no unprovisioned slots")
+            i = int(free[0])
+            self._provisioned[i] = True
+            if name is not None:
+                old = self._names[i]
+                self._ids.pop(old, None)
+                self._names[i] = name
+                self._ids[name] = i
+            self.__dict__.pop("_member_snap", None)
+            self._state = self._state.replace(
+                swim=swim.rejoin(self.params.swim, self._state.swim, i))
+            return self._names[i]
 
     # ----------------------------------------------------------- coordinates
 

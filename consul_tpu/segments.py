@@ -92,7 +92,9 @@ class SegmentedOracle:
         budget = limit
         for ns, seg in enumerate(order):
             p = self.pools[seg]
-            n = p.n_nodes
+            # provisioned count, not slot count: sparse pools list only
+            # members that ever joined, and page math must match
+            n = int(p._provisioned.sum())
             if remaining_offset >= n:
                 remaining_offset -= n
                 continue

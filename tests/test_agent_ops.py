@@ -204,3 +204,27 @@ def test_malformed_filter_fails_fast_on_blocking_query(client):
                      {"index": idx, "wait": "30s", "filter": "Node =="})
     assert ei.value.code == 400
     assert time.time() - t0 < 5.0
+
+
+def test_oracle_spawn_elastic_join():
+    from consul_tpu.oracle import GossipOracle
+    from consul_tpu.config import GossipConfig, SimConfig
+    o = GossipOracle(GossipConfig.lan(),
+                     SimConfig(n_nodes=16, n_initial=12, rumor_slots=8,
+                               p_loss=0.0, seed=231))
+    # phantom-free listing: only provisioned members appear
+    assert len(o.members()) == 12
+    assert o.members_summary()["total"] == 12
+    name = o.spawn("fresh-node")
+    assert name == "fresh-node"
+    o.advance(150)
+    assert o.status("fresh-node") == "alive"
+    assert len(o.members()) == 13
+    # names must stay unique
+    with pytest.raises(ValueError):
+        o.spawn("fresh-node")
+    # capacity bound: 16 slots, 13 used -> 3 more spawns then full
+    for _ in range(3):
+        o.spawn()
+    with pytest.raises(RuntimeError):
+        o.spawn()

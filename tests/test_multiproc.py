@@ -1,0 +1,126 @@
+"""Multi-process deployment: one server per OS process over TCP.
+
+The reference's deployment unit is one `consul agent -server` process
+per box (SURVEY §3.1); tools/server_proc.py is that shape here.  This
+test spins a real 3-process cluster (raft frames + leader-forwarded
+writes over sockets, HTTP per server), proves replication, kills the
+leader, and proves writes recover — the process-boundary analogue of
+the in-process ServerCluster tests.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+RPC_BASE, HTTP_BASE = 7901, 7911
+
+
+def _put(addr, key, value):
+    req = urllib.request.Request(addr + f"/v1/kv/{key}", data=value,
+                                 method="PUT")
+    return urllib.request.urlopen(req, timeout=5)
+
+
+def _get(addr, key, params=""):
+    return urllib.request.urlopen(addr + f"/v1/kv/{key}{params}",
+                                  timeout=10).read()
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    peers = ",".join(f"server{i}=127.0.0.1:{RPC_BASE + i}"
+                     for i in range(3))
+    procs, addresses = [], []
+    for i in range(3):
+        procs.append(subprocess.Popen(
+            [sys.executable, "tools/server_proc.py",
+             "--node", f"server{i}", "--peers", peers,
+             "--http-port", str(HTTP_BASE + i)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            cwd="."))
+        addresses.append(f"http://127.0.0.1:{HTTP_BASE + i}")
+    # ready once a leader exists (writes forward from any server)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            _put(addresses[0], "ready", b"1")
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        for p in procs:
+            p.terminate()
+        pytest.fail("3-process cluster never elected a leader")
+    yield addresses, procs
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _leader_index(addresses):
+    for i, a in enumerate(addresses):
+        try:
+            cfg = json.loads(urllib.request.urlopen(
+                a + "/v1/operator/raft/configuration",
+                timeout=5).read())
+        except Exception:
+            continue
+        if f"server{i}" in {s["ID"] for s in cfg["Servers"]
+                            if s["Leader"]}:
+            return i
+    return None
+
+
+def test_write_replicates_across_processes(cluster):
+    addresses, _ = cluster
+    _put(addresses[0], "mp/key", b"val")
+    for a in addresses:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                if b"mp/key" in _get(a, "mp/key"):
+                    break
+            except urllib.error.HTTPError:
+                pass
+            time.sleep(0.2)
+        else:
+            pytest.fail(f"replication never reached {a}")
+
+
+def test_follower_forwards_writes(cluster):
+    addresses, _ = cluster
+    li = _leader_index(addresses)
+    assert li is not None
+    follower = addresses[(li + 1) % 3]
+    _put(follower, "mp/fwd", b"forwarded")
+    assert b"mp/fwd" in _get(addresses[li], "mp/fwd", "?consistent")
+
+
+def test_leader_kill_failover(cluster):
+    addresses, procs = cluster
+    li = _leader_index(addresses)
+    assert li is not None
+    procs[li].terminate()
+    procs[li].wait(timeout=10)
+    survivors = [a for i, a in enumerate(addresses) if i != li]
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            _put(survivors[0], "mp/after", b"recovered")
+            break
+        except Exception:
+            time.sleep(0.5)
+    else:
+        pytest.fail("writes never recovered after leader kill")
+    # consistent read barriers against the NEW leader
+    assert b"mp/after" in _get(survivors[1], "mp/after",
+                               "?consistent")

@@ -118,3 +118,29 @@ def test_rejoin_after_committed_death():
     assert np.asarray(frac)[-1] < 0.01, "alive refutation did not spread"
     assert not bool(s.committed_dead[9])
     assert bool(s.up[9]) and bool(s.member[9])
+
+
+def test_sparse_pool_elastic_join():
+    """A pool allocated for N can start with fewer members; a new node
+    joins a free slot via rejoin and the cluster learns of it
+    (SURVEY §5.3 elastic membership; memberlist Join)."""
+    params, _ = make(64, p_loss=0.0)
+    s = swim.init_state(params, n_initial=48)
+    assert int(np.asarray(s.member).sum()) == 48
+    # run WELL past the Lifeguard suspicion timeout: unprovisioned
+    # slots must never be suspected, let alone committed dead, and the
+    # rumor table must not fill with phantom suspicions
+    s, _ = run_n(params, s, 400)
+    assert int(np.asarray(s.committed_dead).sum()) == 0
+    assert int(np.asarray(
+        s.r_active & (s.r_kind == swim.SUSPECT)).sum()) == 0
+    s = swim.rejoin(params, s, 50)        # claim slot 50
+    assert bool(s.member[50]) and bool(s.up[50])
+    s, _ = run_n(params, s, 120)
+    assert int(np.asarray(s.member).sum()) == 49
+    assert not bool(s.committed_dead[50])
+    # a real crash in the sparse pool still detects
+    s = swim.kill(s, 5)
+    s, frac = run_n(params, s, 400, monitor=5)
+    assert np.asarray(frac)[-1] > 0.99
+    assert bool(s.committed_dead[5])
