@@ -1,0 +1,203 @@
+"""Gossip delegate socket: `-gossip-backend=tpu-sim` for external agents.
+
+SURVEY §5.8/§7.6's build target: a bridge exposing the memberlist
+Transport/Delegate-shaped interface so an agent written in ANY language
+(the reference's Go agent included) can delegate its gossip plane to
+the device-resident pool instead of running its own SWIM sockets.
+
+The protocol is deliberately language-neutral — newline-delimited JSON
+over TCP, one request/response pair per line:
+
+  {"id": 1, "method": "members", "params": {"limit": 100}}\n
+  {"id": 1, "result": [...]}\n
+
+Surface (the Delegate/Transport method set, memberlist delegate.go +
+serf's event/coordinate extensions):
+
+  node_meta        → agent tags (Delegate.NodeMeta)
+  members          → member list w/ statuses (memberlist.Members)
+  status           → one member's status
+  join             → join a NEW node into the pool (Memberlist.Join;
+                     oracle.spawn) or revive a known one
+  leave            → graceful leave (Serf.Leave)
+  notify_msg       → user message in (Delegate.NotifyMsg → user event)
+  get_broadcasts   → user events out (Delegate.GetBroadcasts: the
+                     host-side event ring since a cursor)
+  local_state      → membership summary (Delegate.LocalState push/pull)
+  coordinate       → Vivaldi coordinate (serf.GetCoordinate)
+  rtt              → coordinate distance between two members
+  ping             → liveness/round-trip of the bridge itself
+
+Fault-injection methods (kill) are NOT exposed here: a delegate client
+is an agent, not the test harness.
+
+Latency note: the FIRST join/leave at a given pool shape pays the XLA
+compile of the rejoin computation (~tens of seconds on a tunneled
+chip); subsequent calls are ~50ms.  Clients should use a generous
+timeout on their first mutating call, like first-compile anywhere in
+the framework.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+from typing import Optional, Tuple
+
+
+class DelegateServer:
+    def __init__(self, oracle, node_meta: Optional[dict] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.oracle = oracle
+        self.node_meta = node_meta or {"backend": "tpu-sim"}
+        self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(16)
+        self.host, self.port = self._lsock.getsockname()
+        self._running = False
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conn_threads: list = []
+
+    # ------------------------------------------------------------ lifecycle
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def start(self) -> None:
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5.0)
+        for t in self._conn_threads:
+            t.join(timeout=2.0)
+
+    # ------------------------------------------------------------- serving
+
+    def _accept(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._conn_threads = [x for x in self._conn_threads
+                                  if x.is_alive()] + [t]
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        try:
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if not line.strip():
+                        continue
+                    conn.sendall(self._handle_line(line) + b"\n")
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _handle_line(self, line: bytes) -> bytes:
+        try:
+            req = json.loads(line)
+            rid = req.get("id")
+            result = self._dispatch(req.get("method", ""),
+                                    req.get("params") or {})
+            return json.dumps({"id": rid, "result": result}).encode()
+        except Exception as e:
+            rid = None
+            try:
+                rid = json.loads(line).get("id")
+            except Exception:
+                pass
+            return json.dumps({"id": rid,
+                               "error": f"{type(e).__name__}: {e}"
+                               }).encode()
+
+    # ------------------------------------------------------------ dispatch
+
+    def _dispatch(self, method: str, p: dict):
+        o = self.oracle
+        if method == "ping":
+            return {"tick": int(o.tick)}
+        if method == "node_meta":
+            return self.node_meta
+        if method == "members":
+            kwargs = {"limit": p.get("limit"),
+                      "offset": p.get("offset", 0)}
+            if p.get("segment") is not None and \
+                    hasattr(o, "segments"):
+                kwargs["segment"] = p["segment"]
+            return [{"Name": m["name"], "Status": m["status"],
+                     "Incarnation": m["incarnation"]}
+                    for m in o.members(**kwargs)]
+        if method == "status":
+            return {"Name": p["name"], "Status": o.status(p["name"])}
+        if method == "join":
+            name = p.get("name", "")
+            try:
+                o.node_id(name)
+            except KeyError:
+                if hasattr(o, "spawn"):
+                    return {"Joined": o.spawn(name or None)}
+                raise
+            o.revive(name)
+            return {"Joined": name}
+        if method == "leave":
+            o.leave(p["name"])
+            return True
+        if method == "notify_msg":
+            payload = base64.b64decode(p.get("payload_b64", ""))
+            origin = p.get("origin", "")
+            try:
+                o.node_id(origin)
+            except KeyError:
+                # an external agent isn't a pool member: inject the
+                # event through the first provisioned member (the
+                # bridge node plays the reference agent's role of
+                # originating the serf broadcast)
+                first = o.members(limit=1)
+                if not first:
+                    raise ValueError("empty pool: no origin for event")
+                origin = first[0]["name"]
+            eid = o.fire_event(p.get("name", "msg"), payload,
+                               origin=origin)
+            return {"ID": str(eid)}
+        if method == "get_broadcasts":
+            since = int(p.get("since", 0))
+            out = []
+            for e in o.event_list():
+                if int(e["id"]) <= since:
+                    continue
+                out.append({"ID": int(e["id"]), "Name": e["name"],
+                            "PayloadB64": base64.b64encode(
+                                e["payload"]).decode(),
+                            "LTime": e["ltime"]})
+            return out
+        if method == "local_state":
+            return o.members_summary()
+        if method == "coordinate":
+            return o.coordinate(p["name"])
+        if method == "rtt":
+            return {"Seconds": o.rtt(p["a"], p["b"])}
+        raise ValueError(f"unknown method {method!r}")

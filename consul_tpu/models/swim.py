@@ -159,8 +159,15 @@ def init_state(params: SwimParams, key=None,
     """`n_initial` > 0 starts the pool sparsely populated: ids beyond
     it are unprovisioned (not members, not up) until `rejoin` brings
     them in — elastic membership over a fixed device allocation
-    (SURVEY §5.3: joins/leaves at runtime; the oracle docstring's
-    sparse 1M-slot pool)."""
+    (SURVEY §5.3: joins/leaves at runtime).
+
+    Sizing guidance: the probe ring is drawn over ALL N slots, so a
+    probe landing on an unprovisioned slot is a skipped round and
+    detection latency inflates by roughly n_nodes/members.  Sparse
+    pools are for growth HEADROOM (e.g. 50-90% full), not for running
+    1k members in a 1M-slot pool; size n_nodes near expected peak
+    membership.  (A member-prefix ring would fix this but costs the
+    gather the ring-rotation design exists to avoid.)"""
     n, u = params.n_nodes, params.rumor_slots
     if n_initial < 0 or n_initial > n:
         raise ValueError(f"n_initial={n_initial} outside [0, {n}]")

@@ -225,24 +225,41 @@ class GossipOracle:
         learns of it via the alive rumor).  Raises RuntimeError when
         the pool is full."""
         with self._lock:
-            # validate BEFORE claiming: a rejected name must not leak
-            # the slot it would have taken
+            i = None
             if name is not None and name in self._ids:
-                raise ValueError(f"node name {name!r} in use")
-            free = np.flatnonzero(~self._provisioned)
-            if len(free) == 0:
-                raise RuntimeError("pool full: no unprovisioned slots")
-            i = int(free[0])
-            self._provisioned[i] = True
-            if name is not None:
+                j = self._ids[name]
+                if self._provisioned[j]:
+                    raise ValueError(f"node name {name!r} in use")
+                # the default name of an unprovisioned slot claims THAT
+                # slot — otherwise the name would be simultaneously
+                # "nonexistent" (node_id) and "taken" (here)
+                i = j
+            if i is None:
+                free = np.flatnonzero(~self._provisioned)
+                if len(free) == 0:
+                    raise RuntimeError(
+                        "pool full: no unprovisioned slots")
+                i = int(free[0])
+            if name is not None and self._names[i] != name:
                 old = self._names[i]
                 self._ids.pop(old, None)
                 self._names[i] = name
                 self._ids[name] = i
+            # invalidation discipline (_members_host comment): drop the
+            # snapshot and update device state BEFORE flipping the
+            # provisioned mask — a concurrent reader pairing the OLD
+            # mask with the new snapshot merely misses the new node,
+            # never reports it as a phantom "left"
             self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
                 swim=swim.rejoin(self.params.swim, self._state.swim, i))
+            self._provisioned[i] = True
             return self._names[i]
+
+    @property
+    def provisioned_count(self) -> int:
+        """Members that ever joined (the listing length)."""
+        return int(self._provisioned.sum())
 
     # ----------------------------------------------------------- coordinates
 

@@ -94,7 +94,7 @@ class SegmentedOracle:
             p = self.pools[seg]
             # provisioned count, not slot count: sparse pools list only
             # members that ever joined, and page math must match
-            n = int(p._provisioned.sum())
+            n = p.provisioned_count
             if remaining_offset >= n:
                 remaining_offset -= n
                 continue

@@ -1,0 +1,121 @@
+// Native delegate client: proves a non-Python agent can delegate its
+// gossip plane to the TPU sim over the delegate socket
+// (consul_tpu/delegate.py — the `-gossip-backend=tpu-sim` bridge,
+// SURVEY §5.8/§7.6; the reference's equivalent consumer is a Go agent
+// holding memberlist Transport/Delegate interfaces).
+//
+// Usage: delegate_client <port> <command> [args...]
+//   ping                     round-trip the bridge
+//   members <limit>          first N members
+//   join <name>              join a new/known node
+//   status <name>            one member's status
+//   fire <name> <payload>    user event in (NotifyMsg)
+//   summary                  LocalState membership summary
+//
+// Output: the raw JSON result line (the test asserts on it).  No JSON
+// library on purpose — requests are assembled with minimal escaping and
+// responses are passed through; the point is the wire protocol, not
+// client-side parsing.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+static std::string b64(const std::string& in) {
+    static const char* t =
+        "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+    std::string out;
+    size_t i = 0;
+    while (i + 2 < in.size()) {
+        unsigned v = (unsigned char)in[i] << 16 |
+                     (unsigned char)in[i + 1] << 8 |
+                     (unsigned char)in[i + 2];
+        out += t[v >> 18]; out += t[(v >> 12) & 63];
+        out += t[(v >> 6) & 63]; out += t[v & 63];
+        i += 3;
+    }
+    if (i + 1 == in.size()) {
+        unsigned v = (unsigned char)in[i] << 16;
+        out += t[v >> 18]; out += t[(v >> 12) & 63]; out += "==";
+    } else if (i + 2 == in.size()) {
+        unsigned v = (unsigned char)in[i] << 16 |
+                     (unsigned char)in[i + 1] << 8;
+        out += t[v >> 18]; out += t[(v >> 12) & 63];
+        out += t[(v >> 6) & 63]; out += '=';
+    }
+    return out;
+}
+
+int main(int argc, char** argv) {
+    if (argc < 3) {
+        std::fprintf(stderr, "usage: %s <port> <command> [args]\n",
+                     argv[0]);
+        return 2;
+    }
+    int port = std::atoi(argv[1]);
+    std::string cmd = argv[2];
+
+    std::string req;
+    if (cmd == "ping") {
+        req = R"({"id": 1, "method": "ping"})";
+    } else if (cmd == "members") {
+        req = std::string(R"({"id": 1, "method": "members", )") +
+              R"("params": {"limit": )" + (argc > 3 ? argv[3] : "10") +
+              "}}";
+    } else if (cmd == "join") {
+        req = std::string(R"({"id": 1, "method": "join", )") +
+              R"("params": {"name": ")" + argv[3] + R"("}})";
+    } else if (cmd == "status") {
+        req = std::string(R"({"id": 1, "method": "status", )") +
+              R"("params": {"name": ")" + argv[3] + R"("}})";
+    } else if (cmd == "fire") {
+        req = std::string(R"({"id": 1, "method": "notify_msg", )") +
+              R"("params": {"name": ")" + argv[3] +
+              R"(", "payload_b64": ")" + b64(argv[4]) +
+              R"(", "origin": "native-client"}})";
+    } else if (cmd == "summary") {
+        req = R"({"id": 1, "method": "local_state"})";
+    } else {
+        std::fprintf(stderr, "unknown command %s\n", cmd.c_str());
+        return 2;
+    }
+    req += "\n";
+
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) { perror("socket"); return 1; }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    inet_pton(AF_INET, "127.0.0.1", &addr.sin_addr);
+    if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        perror("connect");
+        return 1;
+    }
+    size_t off = 0;
+    while (off < req.size()) {
+        ssize_t k = write(fd, req.data() + off, req.size() - off);
+        if (k <= 0) { perror("write"); return 1; }
+        off += (size_t)k;
+    }
+    std::string resp;
+    char buf[65536];
+    while (resp.find('\n') == std::string::npos) {
+        ssize_t k = read(fd, buf, sizeof(buf));
+        if (k <= 0) break;
+        resp.append(buf, (size_t)k);
+    }
+    close(fd);
+    size_t nl = resp.find('\n');
+    if (nl != std::string::npos) resp.resize(nl);
+    std::printf("%s\n", resp.c_str());
+    // exit 1 when the bridge reported an error
+    return resp.find("\"error\"") != std::string::npos ? 1 : 0;
+}

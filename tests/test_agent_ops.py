@@ -228,3 +228,18 @@ def test_oracle_spawn_elastic_join():
         o.spawn()
     with pytest.raises(RuntimeError):
         o.spawn()
+
+
+def test_spawn_default_name_of_unprovisioned_slot():
+    """The default name of an unprovisioned slot is claimable — it must
+    not be simultaneously 'nonexistent' (node_id) and 'taken' (spawn)."""
+    from consul_tpu.oracle import GossipOracle
+    from consul_tpu.config import GossipConfig, SimConfig
+    o = GossipOracle(GossipConfig.lan(),
+                     SimConfig(n_nodes=16, n_initial=12, rumor_slots=8,
+                               p_loss=0.0, seed=232))
+    with pytest.raises(KeyError):
+        o.node_id("node13")
+    assert o.spawn("node13") == "node13"
+    assert o.node_id("node13") == 13
+    assert o.provisioned_count == 13

@@ -1,0 +1,142 @@
+"""Gossip delegate socket: external agents riding the TPU sim.
+
+Reference target (SURVEY §5.8/§7.6, BASELINE north star): a bridge
+exposing memberlist's Transport/Delegate-shaped surface so an external
+agent — the `-gossip-backend=tpu-sim` consumer — delegates its gossip
+plane to the device pool.  Tested twice: over a plain Python socket
+client, and through the NATIVE C++ client (native/delegate_client.cpp)
+to prove the protocol is language-neutral.
+"""
+
+import base64
+import json
+import os
+import socket
+import subprocess
+
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.delegate import DelegateServer
+from consul_tpu.oracle import GossipOracle
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+
+@pytest.fixture(scope="module")
+def bridge():
+    oracle = GossipOracle(GossipConfig.lan(),
+                          SimConfig(n_nodes=32, n_initial=24,
+                                    rumor_slots=16, p_loss=0.0,
+                                    seed=251))
+    srv = DelegateServer(oracle, node_meta={"backend": "tpu-sim",
+                                            "dc": "dc1"})
+    srv.start()
+    yield srv, oracle
+    srv.stop()
+
+
+def call(srv, method, params=None, rid=1):
+    with socket.create_connection(srv.address, timeout=10) as s:
+        s.sendall(json.dumps({"id": rid, "method": method,
+                              "params": params or {}}).encode() + b"\n")
+        buf = b""
+        while b"\n" not in buf:
+            buf += s.recv(65536)
+    return json.loads(buf.split(b"\n", 1)[0])
+
+
+def test_ping_and_node_meta(bridge):
+    srv, _ = bridge
+    out = call(srv, "ping")
+    assert out["id"] == 1 and "tick" in out["result"]
+    assert call(srv, "node_meta")["result"]["backend"] == "tpu-sim"
+
+
+def test_members_and_status(bridge):
+    srv, _ = bridge
+    rows = call(srv, "members", {"limit": 100})["result"]
+    assert len(rows) == 24
+    assert all(r["Status"] == "alive" for r in rows)
+    st = call(srv, "status", {"name": "node3"})["result"]
+    assert st == {"Name": "node3", "Status": "alive"}
+
+
+def test_join_spawns_new_member(bridge):
+    srv, oracle = bridge
+    out = call(srv, "join", {"name": "ext-agent-1"})["result"]
+    assert out["Joined"] == "ext-agent-1"
+    oracle.advance(150)
+    assert call(srv, "status",
+                {"name": "ext-agent-1"})["result"]["Status"] == "alive"
+    assert len(call(srv, "members", {"limit": 100})["result"]) == 25
+
+
+def test_notify_msg_and_broadcasts(bridge):
+    srv, oracle = bridge
+    payload = base64.b64encode(b"deploy v42").decode()
+    out = call(srv, "notify_msg", {"name": "deploy",
+                                   "payload_b64": payload,
+                                   "origin": "node0"})["result"]
+    oracle.advance(100)
+    bcasts = call(srv, "get_broadcasts", {"since": 0})["result"]
+    assert any(b["Name"] == "deploy"
+               and base64.b64decode(b["PayloadB64"]) == b"deploy v42"
+               for b in bcasts)
+    # cursor semantics: nothing new past the last id
+    last = max(b["ID"] for b in bcasts)
+    assert call(srv, "get_broadcasts",
+                {"since": last})["result"] == []
+
+
+def test_errors_are_responses_not_disconnects(bridge):
+    srv, _ = bridge
+    out = call(srv, "status", {"name": "no-such"})
+    assert "error" in out and "KeyError" in out["error"]
+    out = call(srv, "frobnicate")
+    assert "error" in out
+    # the connection still serves after an error line
+    assert call(srv, "ping")["result"]["tick"] >= 0
+
+
+def _build_native_client(tmp_path_factory):
+    src = os.path.join(NATIVE_DIR, "delegate_client.cpp")
+    exe = os.path.join(NATIVE_DIR, "delegate_client")
+    if not os.path.exists(exe) or \
+            os.path.getmtime(exe) < os.path.getmtime(src):
+        subprocess.run(["g++", "-O2", "-std=c++17", "-o", exe, src],
+                       check=True, capture_output=True, timeout=120)
+    return exe
+
+
+def test_native_client_end_to_end(bridge, tmp_path_factory):
+    """A compiled C++ agent drives the bridge: join, members, event."""
+    srv, oracle = bridge
+    try:
+        exe = _build_native_client(tmp_path_factory)
+    except (subprocess.SubprocessError, OSError) as e:
+        pytest.skip(f"no native toolchain: {e}")
+    port = str(srv.port)
+
+    def run(*args):
+        out = subprocess.run([exe, port, *args], capture_output=True,
+                             timeout=30)
+        assert out.returncode == 0, out.stdout + out.stderr
+        return json.loads(out.stdout)
+
+    assert "tick" in run("ping")["result"]
+    assert run("join", "native-agent")["result"]["Joined"] == \
+        "native-agent"
+    oracle.advance(150)
+    assert run("status", "native-agent")["result"]["Status"] == "alive"
+    names = {r["Name"] for r in run("members", "100")["result"]}
+    assert "native-agent" in names
+    run("fire", "native-event", "hello from c++")
+    oracle.advance(100)
+    summary = run("summary")["result"]
+    assert summary["alive"] >= 25
+    # error surfaces as exit 1 + error line
+    out = subprocess.run([exe, port, "status", "missing-node"],
+                         capture_output=True, timeout=30)
+    assert out.returncode == 1 and b"error" in out.stdout

@@ -17,7 +17,18 @@ import urllib.request
 
 import pytest
 
-RPC_BASE, HTTP_BASE = 7901, 7911
+def _free_ports(n):
+    """Ephemeral ports from the OS (momentarily-racy but far safer
+    than fixed ports: parallel runs / leaked servers cannot collide)."""
+    import socket
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
 
 
 def _put(addr, key, value):
@@ -31,19 +42,32 @@ def _get(addr, key, params=""):
                                   timeout=10).read()
 
 
+def _kill_all(procs):
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait(timeout=10)
+
+
 @pytest.fixture(scope="module")
 def cluster():
-    peers = ",".join(f"server{i}=127.0.0.1:{RPC_BASE + i}"
+    rpc_ports = _free_ports(3)
+    http_ports = _free_ports(3)
+    peers = ",".join(f"server{i}=127.0.0.1:{rpc_ports[i]}"
                      for i in range(3))
     procs, addresses = [], []
     for i in range(3):
         procs.append(subprocess.Popen(
             [sys.executable, "tools/server_proc.py",
              "--node", f"server{i}", "--peers", peers,
-             "--http-port", str(HTTP_BASE + i)],
+             "--http-port", str(http_ports[i])],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             cwd="."))
-        addresses.append(f"http://127.0.0.1:{HTTP_BASE + i}")
+        addresses.append(f"http://127.0.0.1:{http_ports[i]}")
     # ready once a leader exists (writes forward from any server)
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -53,17 +77,10 @@ def cluster():
         except Exception:
             time.sleep(0.5)
     else:
-        for p in procs:
-            p.terminate()
+        _kill_all(procs)
         pytest.fail("3-process cluster never elected a leader")
     yield addresses, procs
-    for p in procs:
-        p.terminate()
-    for p in procs:
-        try:
-            p.wait(timeout=10)
-        except subprocess.TimeoutExpired:
-            p.kill()
+    _kill_all(procs)
 
 
 def _leader_index(addresses):
