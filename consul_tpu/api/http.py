@@ -699,9 +699,30 @@ def _make_handler(srv: ApiServer):
                 self._err(404, f"no pprof route {path}")
                 return True
             if path == "/v1/status/leader" and verb == "GET":
+                # real raft state when server-backed (Status.Leader);
+                # the standalone-agent default keeps the classic shape
+                raft = getattr(store, "raft", None)
+                if raft is not None:
+                    # Server.leader_id owns the self-vs-remote fold
+                    lid = store.leader_id
+                    addrs = getattr(store.transport, "addresses", {}) \
+                        if hasattr(store, "transport") else {}
+                    addr = addrs.get(lid)
+                    self._send(f"{addr[0]}:{addr[1]}" if addr
+                               else (f"{lid}:8300" if lid else ""))
+                    return True
                 self._send("127.0.0.1:8300")
                 return True
             if path == "/v1/status/peers" and verb == "GET":
+                raft = getattr(store, "raft", None)
+                if raft is not None:
+                    ids = [store.node_id] + list(raft.peers)
+                    addrs = getattr(store.transport, "addresses", {}) \
+                        if hasattr(store, "transport") else {}
+                    self._send([
+                        f"{addrs[i][0]}:{addrs[i][1]}" if i in addrs
+                        else f"{i}:8300" for i in sorted(set(ids))])
+                    return True
                 self._send(["127.0.0.1:8300"])
                 return True
             if path == "/v1/agent/self" and verb == "GET":

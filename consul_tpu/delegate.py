@@ -60,6 +60,8 @@ class DelegateServer:
         self._running = False
         self._accept_thread: Optional[threading.Thread] = None
         self._conn_threads: list = []
+        self._conns: list = []
+        self._conn_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -79,6 +81,19 @@ class DelegateServer:
             self._lsock.close()
         except OSError:
             pass
+        # close LIVE connections too: a stopped server must not keep
+        # answering parked clients (and their recv()s must unblock)
+        with self._conn_lock:
+            conns, self._conns = self._conns, []
+        for c in conns:
+            try:
+                c.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
         for t in self._conn_threads:
@@ -93,6 +108,9 @@ class DelegateServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conn_lock:
+                self._conns = [c for c in self._conns
+                               if c.fileno() >= 0] + [conn]
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()

@@ -322,11 +322,19 @@ class GossipOracle:
 
     # ---------------------------------------------------------------- events
 
+    _event_seq = 0
+
     def fire_event(self, name: str, payload: bytes, origin: str) -> str:
         """UserEvent (agent/user_event.go:23): host keeps the payload ring,
-        the device disseminates the id."""
+        the device disseminates the id.
+
+        Ids come from a monotonic counter, NOT the ring length — once
+        the 256-entry ring trims, a length-derived id would repeat
+        forever and any since-cursor consumer (delegate
+        get_broadcasts) would go permanently silent."""
         with self._lock:
-            eid = len(self._events) + 1
+            self._event_seq += 1
+            eid = self._event_seq
             self._state = serf.fire_event(self.params, self._state,
                                           self.node_id(origin), eid)
             ltime = int(self._state.events.e_ltime[

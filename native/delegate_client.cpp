@@ -60,6 +60,14 @@ int main(int argc, char** argv) {
     }
     int port = std::atoi(argv[1]);
     std::string cmd = argv[2];
+    // commands taking operands must have them — argv[3]/argv[4] are
+    // NULL past argc and std::string(NULL) is undefined behavior
+    int need = (cmd == "join" || cmd == "status") ? 4
+               : (cmd == "fire") ? 5 : 3;
+    if (argc < need) {
+        std::fprintf(stderr, "%s: missing argument(s)\n", cmd.c_str());
+        return 2;
+    }
 
     std::string req;
     if (cmd == "ping") {

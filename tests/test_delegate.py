@@ -100,21 +100,22 @@ def test_errors_are_responses_not_disconnects(bridge):
     assert call(srv, "ping")["result"]["tick"] >= 0
 
 
-def _build_native_client(tmp_path_factory):
+def _build_native_client(tmp_path):
+    """Always build fresh into the test's tmp dir: a stale or
+    foreign-platform binary lying around must never be executed
+    (checkout mtimes defeat mtime-based staleness checks)."""
     src = os.path.join(NATIVE_DIR, "delegate_client.cpp")
-    exe = os.path.join(NATIVE_DIR, "delegate_client")
-    if not os.path.exists(exe) or \
-            os.path.getmtime(exe) < os.path.getmtime(src):
-        subprocess.run(["g++", "-O2", "-std=c++17", "-o", exe, src],
-                       check=True, capture_output=True, timeout=120)
+    exe = os.path.join(str(tmp_path), "delegate_client")
+    subprocess.run(["g++", "-O2", "-std=c++17", "-o", exe, src],
+                   check=True, capture_output=True, timeout=120)
     return exe
 
 
-def test_native_client_end_to_end(bridge, tmp_path_factory):
+def test_native_client_end_to_end(bridge, tmp_path):
     """A compiled C++ agent drives the bridge: join, members, event."""
     srv, oracle = bridge
     try:
-        exe = _build_native_client(tmp_path_factory)
+        exe = _build_native_client(tmp_path)
     except (subprocess.SubprocessError, OSError) as e:
         pytest.skip(f"no native toolchain: {e}")
     port = str(srv.port)

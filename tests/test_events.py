@@ -59,3 +59,24 @@ def test_dead_node_does_not_learn_event():
     cov = float(events.coverage(params.events, s.events, 0,
                                 s.swim.up, s.swim.member))
     assert cov > 0.999
+
+
+def test_event_ids_monotonic_past_ring_wrap():
+    """Ids must keep increasing after the 256-entry ring trims —
+    a length-derived id would repeat forever and break since-cursor
+    consumers (delegate get_broadcasts)."""
+    from consul_tpu.config import GossipConfig, SimConfig
+    from consul_tpu.oracle import GossipOracle
+    o = GossipOracle(GossipConfig.lan(),
+                     SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0,
+                               seed=281))
+    last = 0
+    for i in range(300):
+        eid = int(o.fire_event(f"e{i}", b"", origin="node0"))
+        assert eid > last, f"id regressed at {i}: {eid} <= {last}"
+        last = eid
+    ring = o.event_list()
+    assert len(ring) == 256
+    ids = [e["id"] for e in ring]
+    assert ids == sorted(ids) and len(set(ids)) == len(ids)
+    assert ids[-1] == 300

@@ -141,3 +141,16 @@ def test_leader_kill_failover(cluster):
     # consistent read barriers against the NEW leader
     assert b"mp/after" in _get(survivors[1], "mp/after",
                                "?consistent")
+
+
+def test_status_leader_reports_real_raft_state(cluster):
+    addresses, _ = cluster
+    li = _leader_index(addresses)
+    if li is None:
+        pytest.skip("leader moved mid-test")
+    leader_str = json.loads(urllib.request.urlopen(
+        addresses[li] + "/v1/status/leader", timeout=5).read())
+    assert leader_str and leader_str != "127.0.0.1:8300"
+    peers = json.loads(urllib.request.urlopen(
+        addresses[li] + "/v1/status/peers", timeout=5).read())
+    assert len(peers) >= 2
