@@ -47,6 +47,8 @@ class Agent:
         a.api.wan_fed_via_gateways = \
             rc.connect_mesh_gateway_wan_federation
         a.api.enable_debug = rc.enable_debug
+        a.api.kv_max_value_size = rc.kv_max_value_size
+        a.api.txn_max_ops = rc.txn_max_ops
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
         a._apply_reloadable(rc)
@@ -65,6 +67,11 @@ class Agent:
         self.dns.node_ttl = rc.dns_node_ttl
         self.dns.service_ttl = rc.dns_service_ttl
         self.dns.domain = rc.dns_domain.rstrip(".").lower()
+        from consul_tpu.dns import parse_recursor
+        # build-then-assign: concurrent queries must never observe a
+        # half-populated recursor list mid-reload
+        self.dns.recursors = [parse_recursor(r) for r in rc.recursors]
+        self.dns.recursor_timeout = rc.dns_recursor_timeout
         new_sids, new_cids = set(), set()
         for svc in rc.services:
             name = svc.get("Name") or svc.get("name")

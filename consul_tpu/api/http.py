@@ -133,6 +133,12 @@ class ApiServer:
         # /debug/pprof analogues served only when explicitly enabled
         # (agent/http.go enable_debug gate)
         self.enable_debug = False
+        # pre-raft payload guards: 512 KiB KV value cap
+        # (kv_max_value_size, performance.mdx:149) and 64-op txn cap
+        # (agent/txn_endpoint.go maxTxnOps); both reject with 413
+        # BEFORE anything reaches the replicated log
+        self.kv_max_value_size = 512 * 1024
+        self.txn_max_ops = 64
         # guards the per-proxy xDS delta payload caches: handler
         # threads race on insert/evict (ThreadingHTTPServer)
         self._xds_cache_lock = threading.Lock()
@@ -2502,8 +2508,13 @@ def _make_handler(srv: ApiServer):
             if verb == "PUT":
                 if not self.authz.key_write(key):
                     return self._forbid()
+                body = self._body()
+                if len(body) > srv.kv_max_value_size:
+                    self._err(413, "Request body too large: value size "
+                                   f"exceeds {srv.kv_max_value_size} limit")
+                    return True
                 ok, idx = store.kv_set(
-                    key, self._body(),
+                    key, body,
                     flags=int(q.get("flags", 0)),
                     cas=int(q["cas"]) if "cas" in q else None,
                     acquire=q.get("acquire"), release=q.get("release"))
@@ -2524,40 +2535,139 @@ def _make_handler(srv: ApiServer):
 
         def _txn(self) -> bool:
             body = json.loads(self._body() or b"[]")
+            if len(body) > srv.txn_max_ops:
+                # maxTxnOps guard (agent/txn_endpoint.go:16 / :66)
+                self._err(413, f"transaction contains too many operations "
+                               f"({len(body)} > {srv.txn_max_ops})")
+                return True
             ops = []
-            for item in body:
+            try:
+              for item in body:
                 kv = item.get("KV")
-                if not kv:
-                    self._err(400, "only KV txn ops supported")
+                node = item.get("Node")
+                svc = item.get("Service")
+                chk = item.get("Check")
+                ses = item.get("Session")
+                if kv:
+                    verb = kv["Verb"]
+                    op = {"verb": verb, "key": kv["Key"]}
+                    if "Value" in kv and kv["Value"] is not None:
+                        op["value"] = base64.b64decode(kv["Value"])
+                        if len(op["value"]) > srv.kv_max_value_size:
+                            self._err(413, "value size exceeds "
+                                           f"{srv.kv_max_value_size} limit")
+                            return True
+                    if "Index" in kv:
+                        op["index"] = kv["Index"]
+                    if "Session" in kv:
+                        op["session"] = kv["Session"]
+                    if "Flags" in kv:
+                        op["flags"] = kv["Flags"]
+                elif node:
+                    n = node.get("Node") or {}
+                    op = {"verb": "node-" + node["Verb"],
+                          "node": n.get("Node") or node.get("NodeName"),
+                          "address": n.get("Address", ""),
+                          "meta": n.get("Meta")}
+                    if "Index" in node:
+                        op["index"] = node["Index"]
+                elif svc:
+                    s = svc.get("Service") or {}
+                    op = {"verb": "service-" + svc["Verb"],
+                          "node": svc.get("Node"),
+                          "service_id": s.get("ID") or s.get("Service"),
+                          "name": s.get("Service") or s.get("ID"),
+                          "port": s.get("Port", 0),
+                          "tags": s.get("Tags"), "meta": s.get("Meta"),
+                          "address": s.get("Address", "")}
+                    if "Index" in svc:
+                        op["index"] = svc["Index"]
+                elif chk:
+                    c = chk.get("Check") or {}
+                    op = {"verb": "check-" + chk["Verb"],
+                          "node": c.get("Node"),
+                          "check_id": c.get("CheckID") or c.get("Name"),
+                          "name": c.get("Name") or c.get("CheckID"),
+                          "status": c.get("Status", "critical"),
+                          "service_id": c.get("ServiceID", ""),
+                          "output": c.get("Output", "")}
+                    if "Index" in chk:
+                        op["index"] = chk["Index"]
+                elif ses:
+                    s = ses.get("Session") or {}
+                    ttl = s.get("TTL", 0.0)
+                    if isinstance(ttl, str):
+                        ttl = _parse_wait(ttl)   # "30s" like /v1/session
+                    op = {"verb": "session-" + ses["Verb"],
+                          "node": s.get("Node", srv.node_name),
+                          "ttl": float(ttl),
+                          "behavior": s.get("Behavior", "release"),
+                          "session": s.get("ID", "")}
+                else:
+                    self._err(400, "unknown txn op type (want KV/Node/"
+                                   "Service/Check/Session)")
                     return True
-                verb = kv["Verb"]
-                op = {"verb": verb, "key": kv["Key"]}
-                if "Value" in kv and kv["Value"] is not None:
-                    op["value"] = base64.b64decode(kv["Value"])
-                if "Index" in kv:
-                    op["index"] = kv["Index"]
-                if "Session" in kv:
-                    op["session"] = kv["Session"]
-                if "Flags" in kv:
-                    op["flags"] = kv["Flags"]
                 ops.append(op)
+            except (ValueError, KeyError, TypeError) as e:
+                # missing Verb/Key, bad base64, bad TTL string — client
+                # errors, not 500s
+                self._err(400, f"malformed txn op: {e}")
+                return True
             for op in ops:
-                need_read = op["verb"] in ("get", "check-index")
-                allowed = self.authz.key_read(op["key"]) if need_read \
-                    else self.authz.key_write(op["key"])
-                if not allowed:
+                verb = op["verb"]
+                if verb.startswith("node-"):
+                    ok = self.authz.node_read(op["node"]) \
+                        if verb == "node-get" \
+                        else self.authz.node_write(op["node"])
+                elif verb.startswith("service-"):
+                    # authorize on the REGISTERED name when the row
+                    # exists — the client may have supplied only the ID
+                    reg = store.node_service(op["node"],
+                                             op["service_id"]) \
+                        if op.get("node") and op.get("service_id") else None
+                    svc_name = reg["name"] if reg else op["name"]
+                    ok = self.authz.service_read(svc_name) \
+                        if verb == "service-get" \
+                        else self.authz.service_write(svc_name)
+                elif verb.startswith("check-"):
+                    ok = self.authz.node_read(op["node"]) \
+                        if verb == "check-get" \
+                        else self.authz.node_write(op["node"])
+                elif verb == "session-destroy":
+                    ok = self._session_node_write(op["session"])
+                elif verb.startswith("session-"):
+                    ok = self.authz.session_write(op["node"])
+                else:
+                    need_read = verb in ("get", "check-index")
+                    ok = self.authz.key_read(op["key"]) if need_read \
+                        else self.authz.key_write(op["key"])
+                if not ok:
                     return self._forbid()
-            ok, results, idx = store.txn(ops)
+            try:
+                ok, results, idx = store.txn(ops)
+            except (ValueError, KeyError, TypeError) as e:
+                # bad verb, unknown node for session-create, malformed
+                # field types — validation errors, not server faults
+                self._err(400, f"{type(e).__name__}: {e}")
+                return True
             if not ok:
                 self._send({"Results": None,
                             "Errors": [{"OpIndex": len(results) - 1 if results else 0,
                                         "What": "txn op failed"}]}, code=409)
                 return True
             out = []
-            for op in ops:
-                if op["verb"] == "get":
-                    e = store.kv_get(op["key"])
-                    out.append({"KV": _kv_json(e) if e else None})
+            for op, res in zip(ops, results):
+                v = op["verb"]
+                if v == "get":
+                    out.append({"KV": _kv_json(res) if res else None})
+                elif v == "node-get":
+                    out.append({"Node": res})
+                elif v == "service-get":
+                    out.append({"Service": res})
+                elif v == "check-get":
+                    out.append({"Check": res})
+                elif v == "session-create":
+                    out.append({"Session": {"ID": res}})
             self._send({"Results": out, "Errors": None}, index=idx)
             return True
 

@@ -447,6 +447,13 @@ class StateStore:
                     return dict(v, id=sid, node=n)
             return None
 
+    def node_service(self, node: str, service_id: str) -> Optional[dict]:
+        """Exact (node, id) row — the txn ACL path resolves the
+        REGISTERED service name from it, not the client-supplied one."""
+        with self._lock:
+            v = self._services.get((node, service_id))
+            return dict(v, id=service_id, node=node) if v else None
+
     def node_services(self, node: str) -> List[dict]:
         with self._lock:
             return [dict(v, id=sid, node=n)
@@ -1102,7 +1109,10 @@ class StateStore:
         """Atomic multi-op (Txn.Apply — agent/consul/txn_endpoint.go:142).
 
         Each op: {"verb": ..., ...args}.  All-or-nothing: state mutates only
-        if every op succeeds."""
+        if every op succeeds.  Beyond the KV verbs, catalog
+        (node-/service-/check-) and session verbs apply atomically too,
+        matching the reference's full TxnOp union (structs Txn*Op;
+        agent/consul/state/txn.go dispatch)."""
         import copy
         with self._lock:
             snapshot = (copy.deepcopy(self._kv),
@@ -1110,11 +1120,32 @@ class StateStore:
                         copy.deepcopy(self._nodes),
                         copy.deepcopy(self._services),
                         copy.deepcopy(self._checks),
+                        copy.deepcopy(self._sessions),
                         self._index)
             results: List[Any] = []
             ok = True
-            for op in ops:
+            try:
+                ok = self._txn_ops_locked(ops, results)
+            except Exception:
+                (self._kv, self._kv_delete_index, self._nodes,
+                 self._services, self._checks, self._sessions,
+                 self._index) = snapshot
+                raise
+            if not ok:
+                (self._kv, self._kv_delete_index, self._nodes,
+                 self._services, self._checks, self._sessions,
+                 self._index) = snapshot
+                return False, results, self._index
+            return True, results, self._index
+
+    def _txn_ops_locked(self, ops: List[dict],
+                        results: List[Any]) -> bool:
+        """Apply ops under the held lock, appending per-op results;
+        False on the first failed op (caller rolls back)."""
+        import copy
+        for op in ops:
                 verb = op["verb"]
+                good = True
                 if verb == "set":
                     good, _ = self.kv_set(op["key"], op["value"],
                                           op.get("flags", 0))
@@ -1126,9 +1157,13 @@ class StateStore:
                 elif verb == "delete-cas":
                     good, _ = self.kv_delete(op["key"], cas=op["index"])
                 elif verb == "get":
+                    # a get on a missing entry ABORTS the txn (the
+                    # reference's TxnKVOp Get returns "key not found"
+                    # and rolls back — state/txn.go KVSGet path)
                     res = self.kv_get(op["key"])
-                    good = res is not None
                     results.append(res)
+                    if res is None:
+                        return False
                     continue
                 elif verb == "check-index":
                     e = self.kv_get(op["key"])
@@ -1136,17 +1171,93 @@ class StateStore:
                 elif verb == "lock":
                     good, _ = self.kv_set(op["key"], op["value"],
                                           acquire=op["session"])
+                # --- catalog verbs (TxnNodeOp / TxnServiceOp / TxnCheckOp)
+                elif verb == "node-get":
+                    row = self._nodes.get(op["node"])
+                    results.append(dict(row, node=op["node"])
+                                   if row else None)
+                    if row is None:
+                        return False
+                    continue
+                elif verb in ("node-set", "node-cas"):
+                    if verb == "node-cas":
+                        row = self._nodes.get(op["node"])
+                        if row is None or \
+                                row["modify_index"] != op.get("index", 0):
+                            good = False
+                    if good:
+                        self.register_node(op["node"], op["address"],
+                                           meta=op.get("meta"))
+                elif verb == "node-delete":
+                    good = op["node"] in self._nodes
+                    if good:
+                        self.deregister_node(op["node"])
+                elif verb == "service-get":
+                    row = self._services.get((op["node"], op["service_id"]))
+                    results.append(copy.deepcopy(row) if row else None)
+                    if row is None:
+                        return False
+                    continue
+                elif verb in ("service-set", "service-cas"):
+                    if verb == "service-cas":
+                        row = self._services.get(
+                            (op["node"], op["service_id"]))
+                        if row is None or \
+                                row["modify_index"] != op.get("index", 0):
+                            good = False
+                    if good:
+                        self.register_service(
+                            op["node"], op["service_id"],
+                            op.get("name", op["service_id"]),
+                            port=op.get("port", 0),
+                            tags=op.get("tags"), meta=op.get("meta"),
+                            address=op.get("address", ""))
+                elif verb == "service-delete":
+                    good = (op["node"], op["service_id"]) in self._services
+                    if good:
+                        self.deregister_service(op["node"],
+                                                op["service_id"])
+                elif verb == "check-get":
+                    row = self._checks.get((op["node"], op["check_id"]))
+                    results.append(copy.deepcopy(row) if row else None)
+                    if row is None:
+                        return False
+                    continue
+                elif verb in ("check-set", "check-cas"):
+                    if verb == "check-cas":
+                        row = self._checks.get((op["node"], op["check_id"]))
+                        if row is None or \
+                                row["modify_index"] != op.get("index", 0):
+                            good = False
+                    if good:
+                        self.register_check(
+                            op["node"], op["check_id"],
+                            op.get("name", op["check_id"]),
+                            status=op.get("status", "critical"),
+                            service_id=op.get("service_id", ""),
+                            output=op.get("output", ""))
+                elif verb == "check-delete":
+                    good = (op["node"], op["check_id"]) in self._checks
+                    if good:
+                        self.deregister_check(op["node"], op["check_id"])
+                # --- session verbs
+                elif verb == "session-create":
+                    sid, _ = self.session_create(
+                        op["node"], ttl=op.get("ttl", 0.0),
+                        behavior=op.get("behavior", "release"),
+                        sid=op.get("sid"))
+                    results.append(sid)
+                    continue
+                elif verb == "session-destroy":
+                    good = op["session"] in self._sessions
+                    if good:
+                        self.session_destroy(op["session"])
                 else:
                     raise ValueError(f"unknown txn verb {verb}")
                 results.append(good)
                 if not good:
-                    ok = False
-                    break
-            if not ok:
-                (self._kv, self._kv_delete_index, self._nodes,
-                 self._services, self._checks, self._index) = snapshot
-                return False, results, self._index
-            return True, results, self._index
+                    return False
+        return True
 
     # -------------------------------------------------------- snapshot/restore
 

@@ -31,11 +31,12 @@ serf's event/coordinate extensions):
 Fault-injection methods (kill) are NOT exposed here: a delegate client
 is an agent, not the test harness.
 
-Latency note: the FIRST join/leave at a given pool shape pays the XLA
+Latency note: the first join/leave at a given pool shape pays the XLA
 compile of the rejoin computation (~tens of seconds on a tunneled
-chip); subsequent calls are ~50ms.  Clients should use a generous
-timeout on their first mutating call, like first-compile anywhere in
-the framework.
+chip).  `start()` therefore precompiles the mutating kernels via
+`oracle.warmup()` BEFORE accepting connections, so no client request
+ever eats a compile; pass `start(warmup=False)` to skip (tests with
+tiny pools).
 """
 
 from __future__ import annotations
@@ -69,7 +70,12 @@ class DelegateServer:
     def address(self) -> Tuple[str, int]:
         return (self.host, self.port)
 
-    def start(self) -> None:
+    def start(self, warmup: bool = True) -> None:
+        # Precompile the mutating kernels BEFORE accepting: a client's
+        # first join/leave must not eat the XLA compile inside its own
+        # request timeout (memberlist-shaped consumers use ~seconds).
+        if warmup and hasattr(self.oracle, "warmup"):
+            self.oracle.warmup()
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept,
                                                daemon=True)

@@ -90,6 +90,20 @@ def parse_query(data: bytes) -> Tuple[int, int, str, int]:
     return txn_id, flags, name.lower(), qtype
 
 
+def parse_recursor(addr: str) -> Tuple[str, int]:
+    """'1.2.3.4', 'host:53', '::1', '[::1]:53' → (host, port); default
+    port 53 (agent/dns.go:251 recursor address normalization)."""
+    addr = addr.strip()
+    if addr.startswith("["):
+        host, _, rest = addr[1:].partition("]")
+        p = rest.lstrip(":")
+        return host, int(p) if p else 53
+    if addr.count(":") > 1:          # bare IPv6 literal
+        return addr, 53
+    host, _, p = addr.partition(":")
+    return host, int(p) if p else 53
+
+
 class RR:
     def __init__(self, name: str, rtype: int, rdata: bytes, ttl: int = 0):
         self.name = name
@@ -158,7 +172,9 @@ class DNSServer:
                  port: int = 0, only_passing: bool = False,
                  node_ttl: int = 0, service_ttl: int = 0,
                  query_executor: Optional[Callable[[str], list]] = None,
-                 authz: Optional[Callable[[], object]] = None):
+                 authz: Optional[Callable[[], object]] = None,
+                 recursors: Optional[List[str]] = None,
+                 recursor_timeout: float = 2.0):
         self.store = store
         self.oracle = oracle
         self.node_name = node_name
@@ -171,6 +187,12 @@ class DNSServer:
         # like the reference (DNS rides the RPC/ACL path with the agent
         # token) — `authz` returns that resolved Authorizer per query
         self.authz = authz
+        # Upstream recursors for out-of-zone names (agent/dns.go:251
+        # validation, :437 handleRecurse): "host" or "host:port" strings,
+        # tried in order; first well-formed reply wins.
+        self.recursors: List[Tuple[str, int]] = [
+            parse_recursor(r) for r in recursors or []]
+        self.recursor_timeout = recursor_timeout
         self._tls = threading.local()
 
         outer = self
@@ -232,11 +254,23 @@ class DNSServer:
             txn_id, flags, qname, qtype = parse_query(data)
         except ValueError:
             return None
+        # Out-of-zone names go to the configured recursors verbatim
+        # (agent/dns.go:437 handleRecurse); with none configured the
+        # resolver falls through to REFUSED below.
+        name = qname.rstrip(".")
+        arpa = name.endswith(".in-addr.arpa") or name.endswith(".ip6.arpa")
+        in_zone = (name == self.domain
+                   or name.endswith("." + self.domain) or arpa)
+        if not in_zone and self.recursors:
+            return self._recurse(data, txn_id, qname, qtype, udp)
         try:
             answers, rcode = self.resolve(qname, qtype)
         except Exception:
             return build_response(0xFFFF & txn_id, qname, qtype, [],
                                   rcode=SERVFAIL)
+        if arpa and rcode == NXDOMAIN and not answers and self.recursors:
+            # unknown reverse names also recurse (dns.go handlePtr tail)
+            return self._recurse(data, txn_id, qname, qtype, udp)
         tc = False
         if udp and answers:
             kept = list(answers)
@@ -250,6 +284,50 @@ class DNSServer:
             authority = [self.soa()]
         return build_response(txn_id, qname, qtype, answers,
                               authority=authority, rcode=rcode, tc=tc)
+
+    def _recurse(self, packet: bytes, txn_id: int, qname: str, qtype: int,
+                 udp: bool) -> bytes:
+        """Forward the original packet to each recursor in order and
+        relay the first reply whose id matches; all-fail answers
+        SERVFAIL with RA set (agent/dns.go:437-500)."""
+        for host, port in self.recursors:
+            try:
+                if udp:
+                    s = socket.socket(socket.AF_INET6 if ":" in host
+                                      else socket.AF_INET,
+                                      socket.SOCK_DGRAM)
+                    try:
+                        s.settimeout(self.recursor_timeout)
+                        s.sendto(packet, (host, port))
+                        resp, _ = s.recvfrom(4096)
+                    finally:
+                        s.close()
+                else:
+                    with socket.create_connection(
+                            (host, port),
+                            timeout=self.recursor_timeout) as s:
+                        s.sendall(struct.pack(">H", len(packet)) + packet)
+                        raw = s.recv(2)
+                        if len(raw) < 2:
+                            continue
+                        (ln,) = struct.unpack(">H", raw)
+                        resp = b""
+                        while len(resp) < ln:
+                            chunk = s.recv(ln - len(resp))
+                            if not chunk:
+                                break
+                            resp += chunk
+                        if len(resp) < ln:
+                            continue   # truncated mid-body: next recursor
+                if len(resp) >= 12 and resp[:2] == packet[:2]:
+                    out = bytearray(resp)
+                    out[2] |= 0x80   # QR: this is a response
+                    out[3] |= 0x80   # RA: recursion was available
+                    return bytes(out)
+            except OSError:
+                continue
+        return build_response(txn_id, qname, qtype, [], rcode=SERVFAIL,
+                              aa=False, rd=True)
 
     def soa(self) -> RR:
         idx = getattr(self.store, "index", 0)

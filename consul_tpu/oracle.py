@@ -102,6 +102,21 @@ class GossipOracle:
                 s = self._step(self.params, s)
             self._state = s
 
+    def warmup(self) -> None:
+        """Precompile the mutating kernels (rejoin/leave/kill + a tick)
+        at the current pool shape, discarding results.  A delegate
+        client's first join/leave otherwise pays the XLA compile
+        (~tens of seconds tunneled) inside ITS request timeout and
+        fails the call; the bridge triggers this before accepting."""
+        import jax
+        with self._lock:
+            s = self._state
+            for out in (swim.rejoin(self.params.swim, s.swim, 0),
+                        swim.leave(self.params.swim, s.swim, 0),
+                        swim.kill(s.swim, 0),
+                        self._step(self.params, s)):
+                jax.block_until_ready(out)
+
     # -------------------------------------------------------------- identity
 
     def node_id(self, name: str) -> int:

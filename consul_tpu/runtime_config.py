@@ -30,7 +30,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 # RuntimeConfig FIELD names that reload applies without a restart
 RELOADABLE = {"log_level", "services", "checks", "dns_only_passing",
-              "dns_node_ttl", "dns_service_ttl", "dns_domain"}
+              "dns_node_ttl", "dns_service_ttl", "dns_domain",
+              "recursors", "dns_recursor_timeout"}
 
 
 class ConfigError(Exception):
@@ -205,6 +206,13 @@ class RuntimeConfig:
     dns_node_ttl: int = 0
     dns_service_ttl: int = 0
     dns_domain: str = "consul."
+    # recursors[]: upstreams for out-of-zone names (agent/dns.go:251)
+    recursors: Tuple[str, ...] = ()
+    dns_recursor_timeout: float = 2.0
+    # limits{kv_max_value_size, txn_max_ops} (config runtime.go
+    # KVMaxValueSize; txn_endpoint.go maxTxnOps)
+    kv_max_value_size: int = 512 * 1024
+    txn_max_ops: int = 64
     # static service/check definitions (lists of dicts, agent JSON shapes)
     services: Tuple[dict, ...] = ()
     checks: Tuple[dict, ...] = ()
@@ -391,6 +399,13 @@ class Builder:
             dns_node_ttl=int(_seconds(dnscfg.get("node_ttl", 0)) or 0),
             dns_service_ttl=int(_seconds(dnscfg.get("service_ttl", 0)) or 0),
             dns_domain=str(dnscfg.get("domain", "consul.")),
+            recursors=tuple(str(r) for r in m.get("recursors") or []),
+            kv_max_value_size=int((m.get("limits") or {}).get(
+                "kv_max_value_size", 512 * 1024)),
+            txn_max_ops=int((m.get("limits") or {}).get(
+                "txn_max_ops", 64)),
+            dns_recursor_timeout=float(
+                _seconds(dnscfg.get("recursor_timeout", 2.0)) or 2.0),
             services=tuple(m.get("services") or []),
             checks=tuple(m.get("checks") or []),
             raw=freeze({k: json.dumps(v, sort_keys=True)

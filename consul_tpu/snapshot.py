@@ -16,6 +16,7 @@ import io
 import json
 import tarfile
 import time
+import zlib
 from typing import Optional, Tuple
 
 from consul_tpu.version import VERSION
@@ -49,16 +50,20 @@ def write_archive(state: dict, index: int = 0, term: int = 0) -> bytes:
 def read_archive(blob: bytes) -> Tuple[dict, dict]:
     """(state, meta) after integrity verification; raises SnapshotError
     on a corrupt or tampered archive (snapshot.go Verify)."""
-    try:
-        tar = tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz")
-    except (tarfile.TarError, OSError) as e:
-        raise SnapshotError(f"not a snapshot archive: {e}")
+    # Decompression errors can surface at open() (bad gzip header), at
+    # getmembers() (bad tar header), or at read() (gzip CRC trailer) —
+    # all three must map to SnapshotError, so the whole walk sits inside
+    # one handler.  zlib.error covers truncated deflate streams that
+    # escape the gzip wrapper.
     members = {}
-    with tar:
-        for m in tar.getmembers():
-            f = tar.extractfile(m)
-            if f is not None:
-                members[m.name] = f.read()
+    try:
+        with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+            for m in tar.getmembers():
+                f = tar.extractfile(m)
+                if f is not None:
+                    members[m.name] = f.read()
+    except (tarfile.TarError, OSError, EOFError, zlib.error) as e:
+        raise SnapshotError(f"not a snapshot archive: {e}")
     for required in ("meta.json", "state.bin", "SHA256SUMS"):
         if required not in members:
             raise SnapshotError(f"archive missing {required}")

@@ -198,3 +198,104 @@ def test_agent_wires_dns():
         assert r["an"] == 1
     finally:
         a.stop()
+
+
+# ------------------------------------------------------- recursion (r3)
+
+class _FakeRecursor:
+    """Minimal upstream: answers every A query with 9.9.9.9."""
+
+    def __init__(self):
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.bind(("127.0.0.1", 0))
+        self.port = self.sock.getsockname()[1]
+        self.seen = []
+        import threading
+        self.t = threading.Thread(target=self._serve, daemon=True)
+        self.t.start()
+
+    def _serve(self):
+        while True:
+            try:
+                data, addr = self.sock.recvfrom(4096)
+            except OSError:
+                return
+            txn, flags, name, qtype = parse_query(data)
+            self.seen.append(name)
+            from consul_tpu.dns import RR, a_rdata, build_response
+            resp = build_response(txn, name, qtype,
+                                  [RR(name, A, a_rdata("9.9.9.9"), 30)],
+                                  aa=False, rd=True)
+            self.sock.sendto(resp, addr)
+
+    def close(self):
+        self.sock.close()
+
+
+def test_out_of_zone_recurses_to_upstream():
+    up = _FakeRecursor()
+    st = StateStore()
+    srv = DNSServer(st, None, port=0,
+                    recursors=[f"127.0.0.1:{up.port}"])
+    srv.start()
+    try:
+        r = udp_ask(srv.port, "example.com", A)
+        assert r["rcode"] == 0
+        assert r["an"] == 1
+        assert r["records"][0][3] == socket.inet_aton("9.9.9.9")
+        assert r["flags"] & 0x0080          # RA set on relayed answer
+        assert up.seen == ["example.com"]
+    finally:
+        srv.stop()
+        up.close()
+
+
+def test_recursor_failover_and_servfail():
+    # first recursor is a dead port; second answers
+    up = _FakeRecursor()
+    st = StateStore()
+    dead = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    dead.bind(("127.0.0.1", 0))
+    dead_port = dead.getsockname()[1]
+    dead.close()   # nothing listens here now
+    srv = DNSServer(st, None, port=0, recursor_timeout=0.3,
+                    recursors=[f"127.0.0.1:{dead_port}",
+                               f"127.0.0.1:{up.port}"])
+    srv.start()
+    try:
+        r = udp_ask(srv.port, "fail.over.test", A)
+        assert r["rcode"] == 0 and r["an"] == 1
+    finally:
+        srv.stop()
+        up.close()
+
+    # all recursors dead -> SERVFAIL
+    srv2 = DNSServer(st, None, port=0, recursor_timeout=0.2,
+                     recursors=[f"127.0.0.1:{dead_port}"])
+    srv2.start()
+    try:
+        r = udp_ask(srv2.port, "dead.test", A)
+        assert r["rcode"] == 2              # SERVFAIL
+    finally:
+        srv2.stop()
+
+
+def test_no_recursors_still_refused(dns):
+    r = udp_ask(dns.port, "example.org", A)
+    assert r["rcode"] == 5                  # REFUSED
+
+
+def test_recursors_via_runtime_config(tmp_path):
+    up = _FakeRecursor()
+    cfg = tmp_path / "a.json"
+    cfg.write_text('{"recursors": ["127.0.0.1:%d"], '
+                   '"sim": {"n_nodes": 8, "rumor_slots": 8}}' % up.port)
+    from consul_tpu.agent import Agent
+    a = Agent.from_config(config_files=[str(cfg)])
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        r = udp_ask(a.dns.port, "configured.example", A)
+        assert r["rcode"] == 0 and r["an"] == 1
+    finally:
+        a.stop()
+        up.close()

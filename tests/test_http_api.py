@@ -189,3 +189,102 @@ def test_filter_expressions(client):
     with pytest.raises(ApiError) as ei:
         client.catalog_nodes(filter='Node ==')
     assert ei.value.code == 400
+
+
+def test_txn_catalog_session_verbs(client):
+    """Full TxnOp union (agent/consul/txn_endpoint.go:142): catalog and
+    session ops apply atomically alongside KV."""
+    import base64
+    out = client.txn([
+        {"Node": {"Verb": "set",
+                  "Node": {"Node": "txn-n1", "Address": "10.9.9.1"}}},
+        {"Service": {"Verb": "set", "Node": "txn-n1",
+                     "Service": {"ID": "txn-s1", "Service": "txn-web",
+                                 "Port": 8080}}},
+        {"Check": {"Verb": "set",
+                   "Check": {"Node": "txn-n1", "CheckID": "txn-c1",
+                             "Status": "passing",
+                             "ServiceID": "txn-s1"}}},
+        {"KV": {"Verb": "set", "Key": "txn/k",
+                "Value": base64.b64encode(b"v").decode()}},
+    ])
+    assert out["Errors"] is None
+    rows = client.catalog_service("txn-web")
+    assert rows and rows[0]["ServicePort"] == 8080
+
+    # get verbs return rows
+    out = client.txn([
+        {"Node": {"Verb": "get", "Node": {"Node": "txn-n1"}}},
+        {"Service": {"Verb": "get", "Node": "txn-n1",
+                     "Service": {"ID": "txn-s1"}}},
+        {"Check": {"Verb": "get",
+                   "Check": {"Node": "txn-n1", "CheckID": "txn-c1"}}},
+    ])
+    assert out["Errors"] is None
+    assert out["Results"][0]["Node"]["address"] == "10.9.9.1"
+
+    # a failing catalog CAS rolls back the KV write in the same txn
+    out = client.txn([
+        {"KV": {"Verb": "set", "Key": "txn/rollback",
+                "Value": base64.b64encode(b"x").decode()}},
+        {"Service": {"Verb": "cas", "Node": "txn-n1", "Index": 999999,
+                     "Service": {"ID": "txn-s1", "Service": "txn-web",
+                                 "Port": 1}}},
+    ])
+    assert out["Errors"]
+    assert client.kv_get("txn/rollback")[0] is None
+    # original service untouched
+    assert client.catalog_service("txn-web")[0]["ServicePort"] == 8080
+
+    # delete verbs clean up
+    out = client.txn([
+        {"Check": {"Verb": "delete",
+                   "Check": {"Node": "txn-n1", "CheckID": "txn-c1"}}},
+        {"Service": {"Verb": "delete", "Node": "txn-n1",
+                     "Service": {"ID": "txn-s1"}}},
+        {"Node": {"Verb": "delete", "Node": {"Node": "txn-n1"}}},
+    ])
+    assert out["Errors"] is None
+    assert client.catalog_service("txn-web") == []
+
+
+def test_txn_session_create_destroy(client):
+    out = client.txn([
+        {"Session": {"Verb": "create",
+                     "Session": {"Node": "node0", "TTL": 30.0}}},
+    ])
+    assert out["Errors"] is None
+    sid = out["Results"][0]["Session"]["ID"]
+    assert sid
+    out = client.txn([
+        {"Session": {"Verb": "destroy", "Session": {"ID": sid}}},
+    ])
+    assert out["Errors"] is None
+
+
+def test_kv_value_size_limit(client, agent):
+    """512 KiB pre-raft cap (performance.mdx:149): oversized PUTs and
+    txn values answer 413 and never reach the store."""
+    from consul_tpu.api.client import ApiError
+    big = b"x" * (512 * 1024 + 1)
+    with pytest.raises(ApiError) as e:
+        client.kv_put("big/k", big)
+    assert e.value.code == 413
+    assert client.kv_get("big/k")[0] is None
+    # exactly at the limit is accepted
+    assert client.kv_put("big/ok", b"x" * (512 * 1024))
+
+    import base64
+    with pytest.raises(ApiError) as e:
+        client.txn([{"KV": {"Verb": "set", "Key": "big/t",
+                            "Value": base64.b64encode(big).decode()}}])
+    assert e.value.code == 413
+
+    # txn op-count cap (maxTxnOps = 64)
+    ops = [{"KV": {"Verb": "set", "Key": f"many/{i}",
+                   "Value": base64.b64encode(b"1").decode()}}
+           for i in range(65)]
+    with pytest.raises(ApiError) as e:
+        client.txn(ops)
+    assert e.value.code == 413
+    client.kv_delete("big/", recurse=True)

@@ -34,11 +34,33 @@ def test_archive_roundtrip_and_inspect():
 
 def test_corrupt_archive_rejected():
     blob = snapmod.write_archive({"index": 1, "kv": {}})
-    # flip one byte inside the gzip payload
-    bad = bytearray(blob)
-    bad[len(bad) // 2] ^= 0xFF
+    # Corrupt deterministically at BOTH failure surfaces: a gzip header
+    # byte (fails at open) and a deflate-payload byte near the end
+    # (fails at member read / CRC check).  Both must map to
+    # SnapshotError — the payload case regressed once when member reads
+    # sat outside the error handler.
+    header_bad = bytearray(blob)
+    header_bad[3] ^= 0xFF          # gzip FLG byte
     with pytest.raises(snapmod.SnapshotError):
-        snapmod.read_archive(bytes(bad))
+        snapmod.read_archive(bytes(header_bad))
+    payload_bad = bytearray(blob)
+    payload_bad[len(blob) // 3] ^= 0xFF   # mid-stream deflate byte
+    with pytest.raises(snapmod.SnapshotError):
+        snapmod.read_archive(bytes(payload_bad))
+    # Every single-byte flip must either raise SnapshotError or decode
+    # to the EXACT original state (flips in gzip tail padding that tar
+    # never reads are harmless).  Any other exception type, or silently
+    # altered data, fails the test.
+    good_state, good_meta = snapmod.read_archive(blob)
+    for pos in range(0, len(blob)):
+        b = bytearray(blob)
+        b[pos] ^= 0xFF
+        try:
+            state, meta = snapmod.read_archive(bytes(b))
+        except snapmod.SnapshotError:
+            continue   # expected
+        assert state == good_state and meta == good_meta, (
+            f"byte flip at {pos} silently altered the decoded snapshot")
     with pytest.raises(snapmod.SnapshotError):
         snapmod.read_archive(b"not an archive at all")
 
