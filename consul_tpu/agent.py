@@ -40,6 +40,7 @@ class Agent:
                 dc=rc.datacenter, acl_enabled=rc.acl_enabled,
                 acl_default_policy=rc.acl_default_policy,
                 acl_down_policy=rc.acl_down_policy, dns_port=rc.dns_port,
+                grpc_port=rc.grpc_port if rc.grpc_port >= 0 else None,
                 data_dir=rc.data_dir or None,
                 enable_remote_exec=rc.enable_remote_exec,
                 segments=rc.segment_pools())
@@ -125,7 +126,8 @@ class Agent:
                  acl_default_policy: str = "allow",
                  acl_down_policy: str = "extend-cache",
                  dns_port: int = 0, data_dir: Optional[str] = None,
-                 enable_remote_exec: bool = False, segments=None):
+                 enable_remote_exec: bool = False, segments=None,
+                 grpc_port: Optional[int] = None):
         self.data_dir = data_dir
         from consul_tpu.acl import ACLResolver
         from consul_tpu.ae import StateSyncer
@@ -193,6 +195,17 @@ class Agent:
         self.remote_exec = RemoteExecutor(self.store, self.oracle,
                                           node_name,
                                           enabled=enable_remote_exec)
+        # gRPC ADS control plane (ports.grpc; agent/xds/server.go:186):
+        # None disables; 0 binds an ephemeral port.  Tokens arrive as
+        # x-consul-token metadata and must grant service:write on the
+        # proxied service, like the HTTP xDS route.
+        self.xds_grpc = None
+        if grpc_port is not None:
+            from consul_tpu.xds_grpc import XdsGrpcServer
+            self.xds_grpc = XdsGrpcServer(
+                self.api.proxycfg, port=grpc_port,
+                authorize=lambda token, svc: self.acl.resolve(
+                    token or None).service_write(svc))
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 
@@ -291,6 +304,8 @@ class Agent:
         self.oracle.start(tick_seconds)
         self.api.start()
         self.dns.start()
+        if self.xds_grpc is not None:
+            self.xds_grpc.start()
         # usage gauges (agent/consul/usagemetrics wired server.go:568)
         from consul_tpu.usagemetrics import UsageReporter
         self.usage = UsageReporter(self.store)
@@ -322,6 +337,9 @@ class Agent:
         self._running = False
         if getattr(self, "usage", None) is not None:
             self.usage.stop()
+        if self.xds_grpc is not None:
+            # before proxycfg close: live ADS streams hold ProxyStates
+            self.xds_grpc.stop()
         self.remote_exec.stop()
         self.checks.stop_all()
         self.syncer.stop()

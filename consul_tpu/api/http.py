@@ -33,6 +33,7 @@ import re
 import threading
 import time
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -2534,7 +2535,14 @@ def _make_handler(srv: ApiServer):
             return False
 
         def _txn(self) -> bool:
-            body = json.loads(self._body() or b"[]")
+            try:
+                body = json.loads(self._body() or b"[]")
+            except ValueError as e:
+                self._err(400, f"invalid txn body: {e}")
+                return True
+            if not isinstance(body, list):
+                self._err(400, "txn body must be an array of ops")
+                return True
             if len(body) > srv.txn_max_ops:
                 # maxTxnOps guard (agent/txn_endpoint.go:16 / :66)
                 self._err(413, f"transaction contains too many operations "
@@ -2569,6 +2577,15 @@ def _make_handler(srv: ApiServer):
                           "node": n.get("Node") or node.get("NodeName"),
                           "address": n.get("Address", ""),
                           "meta": n.get("Meta")}
+                    if op["verb"] in ("node-set", "node-cas"):
+                        # fix the node uuid HERE (the proposer): raft
+                        # replicas applying this op must not each mint
+                        # their own (fsm proposer-fixed-ids rule)
+                        existing = store.node_get(op["node"]) \
+                            if op["node"] else None
+                        op["node_id"] = n.get("ID") or (
+                            existing or {}).get("id") or \
+                            str(uuid.uuid4())
                     if "Index" in node:
                         op["index"] = node["Index"]
                 elif svc:
@@ -2603,14 +2620,20 @@ def _make_handler(srv: ApiServer):
                           "ttl": float(ttl),
                           "behavior": s.get("Behavior", "release"),
                           "session": s.get("ID", "")}
+                    if ses["Verb"] == "create":
+                        # sid + wall clock fixed at the proposer so
+                        # raft replicas apply the identical session
+                        op["sid"] = s.get("ID") or str(uuid.uuid4())
+                        op["now"] = time.time()
                 else:
                     self._err(400, "unknown txn op type (want KV/Node/"
                                    "Service/Check/Session)")
                     return True
                 ops.append(op)
-            except (ValueError, KeyError, TypeError) as e:
-                # missing Verb/Key, bad base64, bad TTL string — client
-                # errors, not 500s
+            except (ValueError, KeyError, TypeError,
+                    AttributeError) as e:
+                # missing Verb/Key, bad base64, bad TTL string, non-dict
+                # ops — client errors, not 500s
                 self._err(400, f"malformed txn op: {e}")
                 return True
             for op in ops:
@@ -2626,9 +2649,17 @@ def _make_handler(srv: ApiServer):
                                              op["service_id"]) \
                         if op.get("node") and op.get("service_id") else None
                     svc_name = reg["name"] if reg else op["name"]
-                    ok = self.authz.service_read(svc_name) \
-                        if verb == "service-get" \
-                        else self.authz.service_write(svc_name)
+                    if verb == "service-get":
+                        ok = self.authz.service_read(svc_name)
+                    else:
+                        ok = self.authz.service_write(svc_name)
+                        # a set that RENAMES the service needs write on
+                        # the new name too, or a token scoped to the old
+                        # name could register arbitrary services
+                        if ok and verb in ("service-set",
+                                           "service-cas") and \
+                                op["name"] != svc_name:
+                            ok = self.authz.service_write(op["name"])
                 elif verb.startswith("check-"):
                     ok = self.authz.node_read(op["node"]) \
                         if verb == "check-get" \

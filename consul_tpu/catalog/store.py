@@ -90,6 +90,9 @@ class StateStore:
         # sessions: id -> dict(node, ttl, behavior, create_index, expires, lock_delay)
         self._sessions: Dict[str, dict] = {}
         self._lock_delays: Dict[str, float] = {}           # key -> until ts
+        # non-None while a txn is applying: _bump defers its effects
+        # here so an abort publishes/wakes nothing (list of (idx, events))
+        self._txn_events: Optional[list] = None
         # ACL tables (agent/consul/state/acl.go): policies by id, tokens by
         # accessor id; bootstrap is one-shot guarded by a reset index
         self._acl_policies: Dict[str, dict] = {}
@@ -129,6 +132,19 @@ class StateStore:
         legacy coarse write: it wakes every waiter (conservative)."""
         self._index += 1
         idx = self._index
+        if self._txn_events is not None:
+            # mid-transaction: defer every externally visible effect
+            # (topic indexes, waiter wakeups, stream events) until
+            # commit — an aborted txn must leave no phantom watch
+            # indexes and publish nothing (state/txn.go applies against
+            # a txn that only commits as a unit)
+            self._txn_events.append((idx, list(events)))
+            return idx
+        self._apply_bump_effects(idx, events)
+        return idx
+
+    def _apply_bump_effects(self, idx: int,
+                            events: Sequence[Tuple[str, str]]) -> None:
         for topic, key in events:
             tmap = self._topic_index.get(topic)
             if tmap is None:
@@ -153,7 +169,6 @@ class StateStore:
         if events:
             self.publisher.publish([Event(topic=t, key=k, index=idx)
                                     for t, k in events])
-        return idx
 
     def watch_index(self, watches: Sequence[Tuple[str, str]]) -> int:
         """Highest commit index that touched any of `watches`.
@@ -433,6 +448,11 @@ class StateStore:
                         if k[0] == node and c["service_id"] == service_id]:
                 del self._checks[key]
             return idx
+
+    def node_get(self, node: str) -> Optional[dict]:
+        with self._lock:
+            v = self._nodes.get(node)
+            return dict(v, node=node) if v else None
 
     def nodes(self) -> List[dict]:
         with self._lock:
@@ -1121,21 +1141,27 @@ class StateStore:
                         copy.deepcopy(self._services),
                         copy.deepcopy(self._checks),
                         copy.deepcopy(self._sessions),
+                        dict(self._lock_delays),
                         self._index)
             results: List[Any] = []
             ok = True
+            self._txn_events = []
             try:
                 ok = self._txn_ops_locked(ops, results)
             except Exception:
+                self._txn_events = None
                 (self._kv, self._kv_delete_index, self._nodes,
                  self._services, self._checks, self._sessions,
-                 self._index) = snapshot
+                 self._lock_delays, self._index) = snapshot
                 raise
+            deferred, self._txn_events = self._txn_events, None
             if not ok:
                 (self._kv, self._kv_delete_index, self._nodes,
                  self._services, self._checks, self._sessions,
-                 self._index) = snapshot
+                 self._lock_delays, self._index) = snapshot
                 return False, results, self._index
+            for idx, events in deferred:
+                self._apply_bump_effects(idx, events)
             return True, results, self._index
 
     def _txn_ops_locked(self, ops: List[dict],
@@ -1186,8 +1212,11 @@ class StateStore:
                                 row["modify_index"] != op.get("index", 0):
                             good = False
                     if good:
+                        # node_id fixed at the proposer (http txn) so
+                        # raft replicas don't each mint a uuid
                         self.register_node(op["node"], op["address"],
-                                           meta=op.get("meta"))
+                                           meta=op.get("meta"),
+                                           node_id=op.get("node_id"))
                 elif verb == "node-delete":
                     good = op["node"] in self._nodes
                     if good:
@@ -1242,10 +1271,13 @@ class StateStore:
                         self.deregister_check(op["node"], op["check_id"])
                 # --- session verbs
                 elif verb == "session-create":
+                    # sid + clock fixed at the proposer: every raft
+                    # replica must apply the identical session (the
+                    # fsm.py proposer-fixed-ids discipline)
                     sid, _ = self.session_create(
                         op["node"], ttl=op.get("ttl", 0.0),
                         behavior=op.get("behavior", "release"),
-                        sid=op.get("sid"))
+                        sid=op.get("sid"), now=op.get("now"))
                     results.append(sid)
                     continue
                 elif verb == "session-destroy":

@@ -43,7 +43,9 @@ class ConfigSnapshot:
                  service_leaves: Optional[Dict[str, dict]] = None,
                  mesh_endpoints: Optional[Dict[str, List[dict]]] = None,
                  federation_states: Optional[List[dict]] = None,
-                 listeners: Optional[List[dict]] = None):
+                 listeners: Optional[List[dict]] = None,
+                 port: int = 0, bind_address: str = "",
+                 local_port: int = 0):
         self.proxy_id = proxy_id
         self.service = service
         self.upstreams = upstreams
@@ -59,6 +61,12 @@ class ConfigSnapshot:
         self.mesh_endpoints = mesh_endpoints or {}
         self.federation_states = federation_states or []
         self.listeners = listeners or []
+        # bind surface of the proxy itself (registration port) and the
+        # local app port behind it — Envoy listener addresses and the
+        # local_app load assignment need real sockets to be valid
+        self.port = port
+        self.bind_address = bind_address
+        self.local_port = local_port
 
 
 class ProxyState:
@@ -201,7 +209,10 @@ class ProxyState:
                 proxy_id=self.proxy_id, service=service,
                 upstreams=upstreams, roots=m.ca.roots(), leaf=leaf,
                 upstream_endpoints=endpoints, intentions=relevant,
-                default_allow=m.default_allow, version=self._version)
+                default_allow=m.default_allow, version=self._version,
+                port=self.svc.get("port", 0),
+                bind_address=self.svc.get("address", ""),
+                local_port=proxy.get("local_service_port", 0))
             self._cond.notify_all()
 
     def _rebuild_gateway(self, kind: str) -> None:
@@ -258,7 +269,9 @@ class ProxyState:
                 kind=kind, gateway_services=bound,
                 service_leaves=service_leaves,
                 mesh_endpoints=mesh_endpoints,
-                federation_states=federation, listeners=listeners)
+                federation_states=federation, listeners=listeners,
+                port=self.svc.get("port", 0),
+                bind_address=self.svc.get("address", ""))
             self._cond.notify_all()
         self._sync_health_subs()
 

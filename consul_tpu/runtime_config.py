@@ -183,6 +183,9 @@ class RuntimeConfig:
     enable_debug: bool = False
     http_port: int = 0
     dns_port: int = 0
+    # ports.grpc: the gRPC ADS/xDS listener; -1 disabled (the
+    # reference's convention), 0 ephemeral (config GRPCPort)
+    grpc_port: int = -1
     # acl block (agent/config: acl{enabled, default_policy, down_policy,
     # tokens{agent, default}})
     acl_enabled: bool = False
@@ -308,6 +311,8 @@ class Builder:
             ports["http"] = src.pop("http_port")
         if "dns_port" in src:
             ports["dns"] = src.pop("dns_port")
+        if "grpc_port" in src:
+            ports["grpc"] = src.pop("grpc_port")
         if ports:
             src["ports"] = {**src.get("ports", {}), **ports}
         self._sources.append(src)
@@ -370,6 +375,15 @@ class Builder:
             if not (chk.get("Name") or chk.get("name")
                     or chk.get("CheckID") or chk.get("id")):
                 raise ConfigError("check definition missing name/id")
+        for r in m.get("recursors") or []:
+            # validate HERE (agent/dns.go:251 stance): a malformed
+            # recursor must fail the load/reload atomically, not blow
+            # up mid-apply after other fields were already mutated
+            from consul_tpu.dns import parse_recursor
+            try:
+                parse_recursor(str(r))
+            except (ValueError, TypeError):
+                raise ConfigError(f"invalid recursor address {r!r}")
 
         def freeze(d):
             return tuple(sorted(d.items()))
@@ -384,6 +398,7 @@ class Builder:
             log_level=str(m.get("log_level", "INFO")).upper(),
             http_port=int(ports.get("http", 0) or 0),
             dns_port=int(ports.get("dns", 0) or 0),
+            grpc_port=int(ports.get("grpc", -1)),
             acl_enabled=bool(acl.get("enabled", False)),
             acl_default_policy=dp,
             acl_down_policy=down,

@@ -1,22 +1,38 @@
-"""xDS resource generation: ConfigSnapshot → Envoy-shaped config.
+"""xDS resource generation: ConfigSnapshot → Envoy v3 config.
 
 The reference's xDS server (agent/xds/server.go:186, delta.go:33) speaks
 gRPC ADS to Envoy, generating Clusters, ClusterLoadAssignments,
 Listeners, and Routes (+ RBAC filters from intentions) per proxy
-snapshot.  This framework generates the same resource set as plain JSON
-dicts in Envoy's v3 field shapes and serves them over HTTP long-poll
-(GET /v1/agent/xds/<proxy_id>?version=&wait=) — a deliberate divergence:
-the control-plane protocol is JSON/HTTP instead of protobuf/gRPC, but
-the resource content and update semantics (version-gated delta polls)
-mirror the reference.
+snapshot (agent/xds/clusters.go, endpoints.go, listeners.go, routes.go,
+rbac.go).
+
+This module generates the same resource set as JSON dicts in STRICT
+Envoy v3 shapes — every nested extension rides in a `typed_config`
+google.protobuf.Any with its canonical `@type`, certificates ride in
+core.v3.DataSource, and intentions compile to config.rbac.v3 policies —
+so each resource parses losslessly into the protobuf messages under
+consul_tpu/xdsproto (see xds_pb.from_dict).  Two frontends serve them:
+
+  * consul_tpu/xds_grpc.py — real gRPC ADS (StreamAggregatedResources /
+    DeltaAggregatedResources), protobuf on the wire: what a stock Envoy
+    consumes.
+  * GET /v1/agent/xds/<proxy_id> — the same resources as JSON over HTTP
+    long-poll, kept for debuggability and the CLI.
 """
 
 from __future__ import annotations
 
 import re
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from consul_tpu.connect import intentions as imod
+
+T = "type.googleapis.com/"
+
+# default public listener port when the proxy registration carries none
+# (the reference registers sidecars at 21000+; connect proxy config
+# sidecar_service defaults)
+DEFAULT_PUBLIC_PORT = 20000
 
 
 def _principal_regex(source: str) -> str:
@@ -27,34 +43,170 @@ def _principal_regex(source: str) -> str:
     return (r"spiffe://[^/]+/ns/[^/]+/dc/[^/]+/svc/" + escaped)
 
 
+def _principal(source: str) -> dict:
+    return {"authenticated": {"principal_name": {
+        "safe_regex": {"regex": _principal_regex(source)}}}}
+
+
+def _duration(seconds: float) -> str:
+    return f"{seconds:g}s"
+
+
+def _data_source(pem: str) -> dict:
+    return {"inline_string": pem}
+
+
+def _common_tls_context(leaf: dict, roots: List[dict]) -> dict:
+    return {
+        "tls_certificates": [{
+            "certificate_chain": _data_source(leaf["CertPEM"]),
+            "private_key": _data_source(leaf["PrivateKeyPEM"])}],
+        "validation_context": {
+            "trusted_ca": _data_source(
+                "".join(r["RootCert"] for r in roots))},
+    }
+
+
+def _upstream_tls(leaf: dict, roots: List[dict], sni: str) -> dict:
+    return {"name": "tls", "typed_config": {
+        "@type": T + "envoy.extensions.transport_sockets.tls.v3."
+                     "UpstreamTlsContext",
+        "sni": sni,
+        "common_tls_context": _common_tls_context(leaf, roots)}}
+
+
+def _downstream_tls(leaf: dict, roots: List[dict]) -> dict:
+    return {"name": "tls", "typed_config": {
+        "@type": T + "envoy.extensions.transport_sockets.tls.v3."
+                     "DownstreamTlsContext",
+        "require_client_certificate": True,
+        "common_tls_context": _common_tls_context(leaf, roots)}}
+
+
+def _tcp_proxy(stat_prefix: str, cluster: str) -> dict:
+    return {"name": "envoy.filters.network.tcp_proxy", "typed_config": {
+        "@type": T + "envoy.extensions.filters.network.tcp_proxy.v3."
+                     "TcpProxy",
+        "stat_prefix": stat_prefix, "cluster": cluster}}
+
+
+def _sni_cluster() -> dict:
+    return {"name": "envoy.filters.network.sni_cluster", "typed_config": {
+        "@type": T + "envoy.extensions.filters.network.sni_cluster.v3."
+                     "SniCluster"}}
+
+
+def _tls_inspector() -> dict:
+    return {"name": "envoy.filters.listener.tls_inspector",
+            "typed_config": {
+                "@type": T + "envoy.extensions.filters.listener."
+                             "tls_inspector.v3.TlsInspector"}}
+
+
+def _address(host: str, port: int) -> dict:
+    return {"socket_address": {"address": host, "port_value": port}}
+
+
+def _ads_config_source() -> dict:
+    return {"ads": {}, "resource_api_version": "V3"}
+
+
+def rbac_rules(intentions: List[dict], default_allow: bool) -> dict:
+    """Compile L4 intentions into one config.rbac.v3.RBAC message
+    (agent/xds/rbac.go makeRBACRules).
+
+    Envoy RBAC has a single action, so mixed allow/deny intention sets
+    flatten the way the reference does: with default-deny the filter
+    ALLOWs each allow-intention source minus any higher-precedence deny
+    that also matches (not_id exclusion); with default-allow the filter
+    DENYs each deny source minus higher-precedence allows.  Policy keys
+    are precedence-ordered `consul-intentions-layer4-<n>` so the
+    compiled order stays inspectable."""
+    want = "deny" if default_allow else "allow"
+    ordered = sorted(intentions, key=lambda it: -it["precedence"])
+    policies = {}
+    n = 0
+    for i, it in enumerate(ordered):
+        if it["action"] != want:
+            continue
+        principal = _principal(it["source"])
+        # higher-precedence intentions of the OPPOSITE action punch
+        # holes in this policy
+        excl = [_principal(o["source"]) for o in ordered[:i]
+                if o["action"] != want]
+        if excl:
+            notp = excl[0] if len(excl) == 1 else \
+                {"or_ids": {"ids": excl}}
+            principal = {"and_ids": {"ids": [
+                principal, {"not_id": notp}]}}
+        policies[f"consul-intentions-layer4-{n}"] = {
+            "permissions": [{"any": True}],
+            "principals": [principal]}
+        n += 1
+    return {"action": "ALLOW" if want == "allow" else "DENY",
+            "policies": policies}
+
+
+def _rbac_filter(intentions: List[dict], default_allow: bool,
+                 stat_prefix: str = "connect_authz") -> dict:
+    return {"name": "envoy.filters.network.rbac", "typed_config": {
+        "@type": T + "envoy.extensions.filters.network.rbac.v3.RBAC",
+        "stat_prefix": stat_prefix,
+        "rules": rbac_rules(intentions, default_allow)}}
+
+
+def _http_connection_manager(stat_prefix: str,
+                             route_config_name: str) -> dict:
+    return {"name": "envoy.filters.network.http_connection_manager",
+            "typed_config": {
+                "@type": T + "envoy.extensions.filters.network."
+                             "http_connection_manager.v3."
+                             "HttpConnectionManager",
+                "stat_prefix": stat_prefix,
+                "rds": {"config_source": _ads_config_source(),
+                        "route_config_name": route_config_name},
+                "http_filters": [{
+                    "name": "envoy.filters.http.router",
+                    "typed_config": {
+                        "@type": T + "envoy.extensions.filters.http."
+                                     "router.v3.Router"}}]}}
+
+
+def _load_assignment(name: str, eps: List[dict]) -> dict:
+    return {
+        "cluster_name": name,
+        "endpoints": [{"lb_endpoints": [
+            {"endpoint": {"address": _address(
+                e["address"] or "127.0.0.1", e["port"])}}
+            for e in eps]}],
+    }
+
+
 def clusters(snap) -> List[dict]:
     """CDS: one cluster per upstream + the local app cluster
-    (agent/xds/clusters.go)."""
+    (agent/xds/clusters.go makeUpstreamCluster/makeAppCluster)."""
     out = [{
-        "@type": "envoy.config.cluster.v3.Cluster",
+        "@type": T + "envoy.config.cluster.v3.Cluster",
         "name": "local_app",
         "type": "STATIC",
-        "connect_timeout": "5s",
+        "connect_timeout": _duration(5),
+        "load_assignment": _load_assignment("local_app", [
+            {"address": "127.0.0.1",
+             "port": getattr(snap, "local_port", 0) or 0}]),
     }]
     for up in snap.upstreams:
         name = up.get("destination_name", "")
         out.append({
-            "@type": "envoy.config.cluster.v3.Cluster",
+            "@type": T + "envoy.config.cluster.v3.Cluster",
             "name": name,
             "type": "EDS",
-            "connect_timeout": "5s",
-            "transport_socket": {
-                "name": "tls",
-                "sni": f"{name}.default.{_trust_domain(snap)}",
-                "common_tls_context": {
-                    "tls_certificates": [{
-                        "certificate_chain": snap.leaf["CertPEM"],
-                        "private_key": snap.leaf["PrivateKeyPEM"]}],
-                    "validation_context": {
-                        "trusted_ca": "".join(
-                            r["RootCert"] for r in snap.roots)},
-                },
-            },
+            "eds_cluster_config": {
+                "eds_config": _ads_config_source(),
+                "service_name": name},
+            "connect_timeout": _duration(5),
+            "transport_socket": _upstream_tls(
+                snap.leaf, snap.roots,
+                f"{name}.default.{_trust_domain(snap)}"),
         })
     return out
 
@@ -64,53 +216,29 @@ def endpoints(snap) -> List[dict]:
     (agent/xds/endpoints.go)."""
     out = []
     for name, eps in snap.upstream_endpoints.items():
-        out.append({
-            "@type": "envoy.config.endpoint.v3.ClusterLoadAssignment",
-            "cluster_name": name,
-            "endpoints": [{
-                "lb_endpoints": [{
-                    "endpoint": {"address": {"socket_address": {
-                        "address": e["address"] or "127.0.0.1",
-                        "port_value": e["port"]}}}}
-                    for e in eps]}],
-        })
+        out.append(dict(
+            {"@type": T + "envoy.config.endpoint.v3."
+                          "ClusterLoadAssignment"},
+            **_load_assignment(name, eps)))
     return out
 
 
 def listeners(snap) -> List[dict]:
-    """LDS: the public (inbound, mTLS + RBAC from intentions) listener and
-    one outbound listener per upstream (agent/xds/listeners.go)."""
-    rules = []
-    for it in snap.intentions:
-        principal = {"authenticated": {"principal_name": {
-            "safe_regex": {"regex": _principal_regex(it["source"])}}}}
-        rules.append({"action": it["action"].upper(),
-                      "precedence": it["precedence"],
-                      "principals": [principal]})
+    """LDS: the public (inbound, mTLS + RBAC from intentions) listener
+    and one outbound listener per upstream (agent/xds/listeners.go
+    makePublicListener/makeUpstreamListener)."""
     public = {
-        "@type": "envoy.config.listener.v3.Listener",
+        "@type": T + "envoy.config.listener.v3.Listener",
         "name": "public_listener",
         "traffic_direction": "INBOUND",
+        "address": _address(
+            getattr(snap, "bind_address", "") or "0.0.0.0",
+            getattr(snap, "port", 0) or DEFAULT_PUBLIC_PORT),
         "filter_chains": [{
-            "transport_socket": {
-                "name": "tls",
-                "require_client_certificate": True,
-                "common_tls_context": {
-                    "tls_certificates": [{
-                        "certificate_chain": snap.leaf["CertPEM"],
-                        "private_key": snap.leaf["PrivateKeyPEM"]}],
-                    "validation_context": {
-                        "trusted_ca": "".join(
-                            r["RootCert"] for r in snap.roots)},
-                },
-            },
+            "transport_socket": _downstream_tls(snap.leaf, snap.roots),
             "filters": [
-                {"name": "envoy.filters.network.rbac",
-                 "rules": rules,
-                 "default_action": "ALLOW" if snap.default_allow
-                 else "DENY"},
-                {"name": "envoy.filters.network.tcp_proxy",
-                 "cluster": "local_app"},
+                _rbac_filter(snap.intentions, snap.default_allow),
+                _tcp_proxy("public_listener", "local_app"),
             ],
         }],
     }
@@ -118,15 +246,14 @@ def listeners(snap) -> List[dict]:
     for up in snap.upstreams:
         name = up.get("destination_name", "")
         out.append({
-            "@type": "envoy.config.listener.v3.Listener",
+            "@type": T + "envoy.config.listener.v3.Listener",
             "name": f"{name}:{up.get('local_bind_port', 0)}",
             "traffic_direction": "OUTBOUND",
-            "address": {"socket_address": {
-                "address": up.get("local_bind_address", "127.0.0.1"),
-                "port_value": up.get("local_bind_port", 0)}},
+            "address": _address(
+                up.get("local_bind_address", "127.0.0.1"),
+                up.get("local_bind_port", 0)),
             "filter_chains": [{"filters": [
-                {"name": "envoy.filters.network.tcp_proxy",
-                 "cluster": name}]}],
+                _tcp_proxy(f"upstream.{name}", name)]}],
         })
     return out
 
@@ -135,7 +262,7 @@ def routes(snap) -> List[dict]:
     """RDS: trivial catch-all route to the local app (the L4 default;
     discovery-chain L7 routing layers on top in the reference)."""
     return [{
-        "@type": "envoy.config.route.v3.RouteConfiguration",
+        "@type": T + "envoy.config.route.v3.RouteConfiguration",
         "name": "public_route",
         "virtual_hosts": [{"name": "default", "domains": ["*"],
                            "routes": [{"match": {"prefix": "/"},
@@ -159,15 +286,19 @@ def _trust_domain(snap) -> str:
 
 def _eds_cluster(name: str, eps: List[dict]) -> List[dict]:
     return [
-        {"@type": "envoy.config.cluster.v3.Cluster", "name": name,
-         "type": "EDS", "connect_timeout": "5s"},
-        {"@type": "envoy.config.endpoint.v3.ClusterLoadAssignment",
-         "cluster_name": name,
-         "endpoints": [{"lb_endpoints": [
-             {"endpoint": {"address": {"socket_address": {
-                 "address": e["address"] or "127.0.0.1",
-                 "port_value": e["port"]}}}} for e in eps]}]},
+        {"@type": T + "envoy.config.cluster.v3.Cluster", "name": name,
+         "type": "EDS",
+         "eds_cluster_config": {"eds_config": _ads_config_source(),
+                                "service_name": name},
+         "connect_timeout": _duration(5)},
+        dict({"@type": T + "envoy.config.endpoint.v3."
+                           "ClusterLoadAssignment"},
+             **_load_assignment(name, eps)),
     ]
+
+
+def _gateway_port(snap, default: int) -> int:
+    return getattr(snap, "port", 0) or default
 
 
 def mesh_gateway_resources(snap) -> dict:
@@ -184,9 +315,8 @@ def mesh_gateway_resources(snap) -> dict:
         chains.append({
             "filter_chain_match": {
                 "server_names": [f"{svc}.default.{td}"]},
-            "filters": [{"name": "envoy.filters.network.sni_cluster"},
-                        {"name": "envoy.filters.network.tcp_proxy",
-                         "cluster": cname}],
+            "filters": [_sni_cluster(),
+                        _tcp_proxy(f"mesh_gateway_local.{svc}", cname)],
         })
     for fed in snap.federation_states:
         dc = fed["datacenter"]
@@ -199,16 +329,15 @@ def mesh_gateway_resources(snap) -> dict:
         eds.append(e)
         chains.append({
             "filter_chain_match": {"server_names": [f"*.{dc}"]},
-            "filters": [{"name": "envoy.filters.network.sni_cluster"},
-                        {"name": "envoy.filters.network.tcp_proxy",
-                         "cluster": cname}],
+            "filters": [_sni_cluster(),
+                        _tcp_proxy(f"mesh_gateway_remote.{dc}", cname)],
         })
     listener = {
-        "@type": "envoy.config.listener.v3.Listener",
+        "@type": T + "envoy.config.listener.v3.Listener",
         "name": "mesh_gateway",
         "traffic_direction": "UNSPECIFIED",
-        "listener_filters": [
-            {"name": "envoy.filters.listener.tls_inspector"}],
+        "address": _address("0.0.0.0", _gateway_port(snap, 8443)),
+        "listener_filters": [_tls_inspector()],
         "filter_chains": chains,
     }
     return {"clusters": cl, "endpoints": eds, "listeners": [listener],
@@ -228,38 +357,23 @@ def terminating_gateway_resources(snap) -> dict:
         cl.append(c)
         eds.append(e)
         leaf = snap.service_leaves.get(svc) or snap.leaf
-        rules = [{"action": it["action"].upper(),
-                  "precedence": it["precedence"],
-                  "principals": [{"authenticated": {"principal_name": {
-                      "safe_regex": {"regex":
-                                     _principal_regex(it["source"])}}}}]}
-                 for it in snap.intentions
+        rules = [it for it in snap.intentions
                  if it["destination"] in (svc, "*")]
         chains.append({
             "filter_chain_match": {
                 "server_names": [f"{svc}.default.{td}"]},
-            "transport_socket": {
-                "name": "tls", "require_client_certificate": True,
-                "common_tls_context": {
-                    "tls_certificates": [{
-                        "certificate_chain": leaf["CertPEM"],
-                        "private_key": leaf["PrivateKeyPEM"]}],
-                    "validation_context": {"trusted_ca": "".join(
-                        r["RootCert"] for r in snap.roots)}},
-            },
+            "transport_socket": _downstream_tls(leaf, snap.roots),
             "filters": [
-                {"name": "envoy.filters.network.rbac", "rules": rules,
-                 "default_action": "ALLOW" if snap.default_allow
-                 else "DENY"},
-                {"name": "envoy.filters.network.tcp_proxy",
-                 "cluster": cname}],
+                _rbac_filter(rules, snap.default_allow,
+                             stat_prefix=f"terminating_gateway.{svc}"),
+                _tcp_proxy(f"terminating_gateway.{svc}", cname)],
         })
     listener = {
-        "@type": "envoy.config.listener.v3.Listener",
+        "@type": T + "envoy.config.listener.v3.Listener",
         "name": "terminating_gateway",
         "traffic_direction": "INBOUND",
-        "listener_filters": [
-            {"name": "envoy.filters.listener.tls_inspector"}],
+        "address": _address("0.0.0.0", _gateway_port(snap, 8443)),
+        "listener_filters": [_tls_inspector()],
         "filter_chains": chains,
     }
     return {"clusters": cl, "endpoints": eds, "listeners": [listener],
@@ -299,13 +413,12 @@ def ingress_gateway_resources(snap) -> dict:
             if not rows:
                 continue
             lst.append({
-                "@type": "envoy.config.listener.v3.Listener",
+                "@type": T + "envoy.config.listener.v3.Listener",
                 "name": name, "traffic_direction": "OUTBOUND",
-                "address": {"socket_address": {
-                    "address": "0.0.0.0", "port_value": port}},
+                "address": _address("0.0.0.0", port),
                 "filter_chains": [{"filters": [
-                    {"name": "envoy.filters.network.tcp_proxy",
-                     "cluster": f"ingress.{rows[0]['Service']}"}]}],
+                    _tcp_proxy(name,
+                               f"ingress.{rows[0]['Service']}")]}],
             })
         else:
             vhosts = []
@@ -318,17 +431,14 @@ def ingress_gateway_resources(snap) -> dict:
                                 "route": {"cluster":
                                           f"ingress.{svc}"}}]})
             rts.append({
-                "@type": "envoy.config.route.v3.RouteConfiguration",
+                "@type": T + "envoy.config.route.v3.RouteConfiguration",
                 "name": name, "virtual_hosts": vhosts})
             lst.append({
-                "@type": "envoy.config.listener.v3.Listener",
+                "@type": T + "envoy.config.listener.v3.Listener",
                 "name": name, "traffic_direction": "OUTBOUND",
-                "address": {"socket_address": {
-                    "address": "0.0.0.0", "port_value": port}},
+                "address": _address("0.0.0.0", port),
                 "filter_chains": [{"filters": [
-                    {"name":
-                     "envoy.filters.network.http_connection_manager",
-                     "rds_route_config_name": name}]}],
+                    _http_connection_manager(name, name)]}],
             })
     return {"clusters": cl, "endpoints": eds, "listeners": lst,
             "routes": rts}
@@ -338,6 +448,14 @@ def ingress_gateway_resources(snap) -> dict:
 # update ships only what changed)
 _DELTA_KEYS = {"clusters": "name", "endpoints": "cluster_name",
                "listeners": "name", "routes": "name"}
+
+# canonical Envoy v3 type URLs per resource group (the ADS contract)
+TYPE_URLS = {
+    "clusters": T + "envoy.config.cluster.v3.Cluster",
+    "endpoints": T + "envoy.config.endpoint.v3.ClusterLoadAssignment",
+    "listeners": T + "envoy.config.listener.v3.Listener",
+    "routes": T + "envoy.config.route.v3.RouteConfiguration",
+}
 
 
 def delta(prev_resources: dict, new_resources: dict) -> dict:

@@ -128,8 +128,9 @@ def test_terminating_gateway_xds(agent):
     sni = chains[0]["filter_chain_match"]["server_names"][0]
     assert sni.startswith("legacy.default.")
     # gateway presents a leaf FOR the fronted service
-    cert = chains[0]["transport_socket"]["common_tls_context"][
-        "tls_certificates"][0]["certificate_chain"]
+    cert = chains[0]["transport_socket"]["typed_config"][
+        "common_tls_context"]["tls_certificates"][0][
+        "certificate_chain"]["inline_string"]
     assert "BEGIN CERTIFICATE" in cert
     eds = {e["cluster_name"]: e for e in res["endpoints"]}
     port = eds["term.legacy"]["endpoints"][0]["lb_endpoints"][0][

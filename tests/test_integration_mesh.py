@@ -78,18 +78,18 @@ def test_full_mesh_scenario(mesh):
                                     for c in xds["Resources"]["clusters"]}
     rbac = xds["Resources"]["listeners"][0]["filter_chains"][0][
         "filters"][0]
-    assert rbac["rules"] == []
+    assert rbac["typed_config"]["rules"].get("policies", {}) == {}
     _call(base, "PUT", "/v1/connect/intentions", {
         "SourceName": "evil", "DestinationName": "web",
         "Action": "deny"})
     deadline = time.time() + 10
-    rules = []
-    while time.time() < deadline and not rules:
+    rules = {}
+    while time.time() < deadline and not rules.get("policies"):
         xds = _call(base, "GET", "/v1/agent/xds/web-proxy")
         rules = xds["Resources"]["listeners"][0]["filter_chains"][0][
-            "filters"][0]["rules"]
+            "filters"][0]["typed_config"]["rules"]
         time.sleep(0.2)
-    assert rules and rules[0]["action"] == "DENY"
+    assert rules.get("policies") and rules["action"] == "DENY"
     uri = "spiffe://x.consul/ns/default/dc/dc1/svc/evil"
     authz = _call(base, "PUT", "/v1/agent/connect/authorize",
                   {"Target": "web", "ClientCertURI": uri})

@@ -62,7 +62,8 @@ def test_snapshot_has_all_resource_types(agent):
     # inbound chain carries mTLS material from the CA
     chain = lds["public_listener"]["filter_chains"][0]
     assert "BEGIN CERTIFICATE" in chain["transport_socket"][
-        "common_tls_context"]["tls_certificates"][0]["certificate_chain"]
+        "typed_config"]["common_tls_context"]["tls_certificates"][0][
+        "certificate_chain"]["inline_string"]
     assert res["routes"]
 
 
@@ -91,19 +92,23 @@ def test_intention_appears_as_rbac_rule(agent):
     agent.store.intention_set("ix1", "evil", "web", "deny")
     try:
         deadline = time.time() + 5
-        rules = []
+        policies = {}
+        rules = {}
         while time.time() < deadline:
             out = _xds(agent, "web-sidecar-proxy")
             rbac = out["Resources"]["listeners"][0]["filter_chains"][0][
                 "filters"][0]
-            rules = rbac["rules"]
-            if rules:
+            rules = rbac["typed_config"]["rules"]
+            policies = rules.get("policies", {})
+            if policies:
                 break
             time.sleep(0.2)
-        assert any(r["action"] == "DENY" and "evil" in
-                   r["principals"][0]["authenticated"]["principal_name"][
-                       "safe_regex"]["regex"]
-                   for r in rules)
+        # default-allow + a deny intention compiles to a DENY-action
+        # RBAC whose policy principal matches the evil source
+        assert rules["action"] == "DENY"
+        assert any("evil" in p["principals"][0]["authenticated"][
+            "principal_name"]["safe_regex"]["regex"]
+            for p in policies.values())
     finally:
         agent.store.intention_delete("ix1")
 
@@ -118,9 +123,13 @@ def test_ca_rotation_alone_refreshes_leaf(agent):
     """Rotation must rebuild proxy snapshots with NO other churn — the
     rotate endpoint publishes a CA event every proxy watches."""
     import urllib.request as _rq
+    def _leaf(payload):
+        return payload["Resources"]["clusters"][1]["transport_socket"][
+            "typed_config"]["common_tls_context"]["tls_certificates"][
+            0]["certificate_chain"]["inline_string"]
+
     out = _xds(agent, "web-sidecar-proxy")
-    leaf1 = out["Resources"]["clusters"][1]["transport_socket"][
-        "common_tls_context"]["tls_certificates"][0]["certificate_chain"]
+    leaf1 = _leaf(out)
     _rq.urlopen(_rq.Request(
         agent.http_address + "/v1/connect/ca/rotate", data=b"",
         method="PUT"), timeout=30)
@@ -128,9 +137,7 @@ def test_ca_rotation_alone_refreshes_leaf(agent):
     leaf2 = leaf1
     while time.time() < deadline and leaf2 == leaf1:
         out2 = _xds(agent, "web-sidecar-proxy")
-        leaf2 = out2["Resources"]["clusters"][1]["transport_socket"][
-            "common_tls_context"]["tls_certificates"][0][
-            "certificate_chain"]
+        leaf2 = _leaf(out2)
         time.sleep(0.2)
     assert leaf2 != leaf1, "leaf did not re-sign after CA rotation"
     assert agent.api.ca.verify_leaf(leaf2)

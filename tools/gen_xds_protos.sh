@@ -1,0 +1,16 @@
+#!/bin/sh
+# Regenerate the committed protobuf modules for the envoy v3 xDS subset.
+# Requires protoc (baked in); output goes to consul_tpu/xdsproto/gen and
+# is imported via consul_tpu/xds_pb.py's sys.path shim.
+set -e
+cd "$(dirname "$0")/.."
+SRC=consul_tpu/xdsproto
+OUT=$SRC/gen
+rm -rf "$OUT"
+mkdir -p "$OUT"
+find "$SRC" -name '*.proto' | while read -r f; do
+  protoc -I "$SRC" -I /usr/include --python_out="$OUT" "${f#$SRC/}"
+done
+# package markers so the generated tree imports cleanly
+find "$OUT" -type d -exec touch {}/__init__.py \;
+echo "generated $(find "$OUT" -name '*_pb2.py' | wc -l) modules"
