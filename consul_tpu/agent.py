@@ -202,10 +202,24 @@ class Agent:
         self.xds_grpc = None
         if grpc_port is not None:
             from consul_tpu.xds_grpc import XdsGrpcServer
+
+            def _sub_authz(token, topic, key):
+                a = self.acl.resolve(token or None)
+                if topic == "health" or topic == "services":
+                    return a.service_read(key or "")
+                if topic == "kv":
+                    return a.key_read(key or "")
+                if topic == "intentions":
+                    return a.intention_read(key or "*")
+                if topic == "nodes":
+                    return a.node_read(key or "")
+                return a.operator_read()
+
             self.xds_grpc = XdsGrpcServer(
                 self.api.proxycfg, port=grpc_port,
                 authorize=lambda token, svc: self.acl.resolve(
-                    token or None).service_write(svc))
+                    token or None).service_write(svc),
+                subscribe_authorize=_sub_authz)
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 

@@ -283,6 +283,138 @@ class AdsServicer:
                 url, changed, removed, version, nonce)
 
 
+SUBSCRIBE_SERVICE = "consultpu.stream.v1.StateChangeSubscription"
+
+
+class SubscribeServicer:
+    """gRPC snapshot-then-follow event streams (the reference's
+    pbsubscribe Subscribe role, proto/pbsubscribe/subscribe.proto:14,
+    agent/rpc/subscribe): a subscriber gets the materialized current
+    state for its (topic, key), an end_of_snapshot marker, then live
+    events; falling off the publisher buffer sends
+    new_snapshot_to_follow and restarts the cycle.
+
+    Frame contract: every data frame's payload is a JSON ARRAY — the
+    full materialized row set for that frame's (topic, key) — in both
+    the snapshot and live phases, so clients parse uniformly and a
+    frame REPLACES their view of that key (empty array = gone)."""
+
+    TOPICS = ("health", "services", "kv", "intentions", "nodes")
+
+    def __init__(self, store,
+                 authorize: Optional[Callable[[str, str, str], bool]]
+                 = None):
+        self.store = store
+        self.authorize = authorize
+
+    def _rows(self, topic: str, key: str):
+        """Materialized rows for one (topic, key); key=\"\" = whole
+        topic."""
+        st = self.store
+        if topic == "health":
+            if key:
+                names = [key]
+            else:
+                names = sorted(st.services())
+            return [{"Key": n,
+                     "Rows": [{"Service": r["service"],
+                               "Checks": r["checks"]}
+                              for r in st.health_service_nodes(n)]}
+                    for n in names]
+        if topic == "services":
+            return [{"Key": key, "Rows": [st.services()]}]
+        if topic == "kv":
+            import base64
+            return [{"Key": key, "Rows": [
+                {"Key": e["key"], "Flags": e["flags"],
+                 "Value": base64.b64encode(e["value"]).decode(),
+                 "ModifyIndex": e["modify_index"],
+                 "Session": e.get("session", "")}
+                for e in st.kv_list(key)]}]
+        if topic == "intentions":
+            return [{"Key": key, "Rows": st.intention_list()}]
+        if topic == "nodes":
+            rows = st.nodes()
+            if key:
+                rows = [r for r in rows if r["node"] == key]
+            return [{"Key": key, "Rows": rows}]
+        return []
+
+    def subscribe(self, request, context):
+        import json as _json
+        from consul_tpu.stream.publisher import SnapshotRequired
+        topic, key = request.topic, request.key
+        if topic not in self.TOPICS:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"unsupported topic {topic!r} "
+                          f"(want one of {', '.join(self.TOPICS)})")
+        if self.authorize is not None:
+            md = dict(context.invocation_metadata() or ())
+            token = request.token or md.get("x-consul-token", "")
+            if not self.authorize(token, topic, key):
+                context.abort(grpc.StatusCode.PERMISSION_DENIED,
+                              f"read denied on {topic}/{key}")
+        pub = self.store.publisher
+        resume_from = int(request.index) or None
+        while context.is_active():
+            # subscribe FIRST, snapshot second: no event between the
+            # two can be missed (submatview discipline).  A resume
+            # index replays history instead of re-snapshotting; if the
+            # buffer already evicted it, SnapshotRequired falls through
+            # to a fresh snapshot cycle below.
+            try:
+                sub = pub.subscribe(topic, key or None,
+                                    since_index=resume_from)
+            except SnapshotRequired:
+                resume_from = None
+                continue
+            try:
+                if resume_from is None:
+                    idx = self.store.index
+                    for group in self._rows(topic, key):
+                        yield xds_pb.StreamEvent(
+                            index=idx, topic=topic, key=group["Key"],
+                            payload=_json.dumps(
+                                group["Rows"],
+                                default=_bytes_safe).encode())
+                    yield xds_pb.StreamEvent(
+                        index=idx, topic=topic, key=key,
+                        end_of_snapshot=True)
+                while context.is_active():
+                    try:
+                        batch = sub.events(timeout=1.0)
+                    except SnapshotRequired:
+                        yield xds_pb.StreamEvent(
+                            topic=topic, key=key,
+                            new_snapshot_to_follow=True)
+                        resume_from = None
+                        break
+                    # one frame per distinct key in the batch: N events
+                    # on the same key materialize once, not N times
+                    seen = {}
+                    for ev in batch:
+                        seen[(ev.topic, ev.key)] = ev
+                    for (t, k), ev in seen.items():
+                        for group in self._rows(topic, key or k):
+                            yield xds_pb.StreamEvent(
+                                index=ev.index, topic=t,
+                                key=group["Key"], op=ev.op,
+                                payload=_json.dumps(
+                                    group["Rows"],
+                                    default=_bytes_safe).encode())
+                else:
+                    return
+            finally:
+                sub.close()
+
+
+def _bytes_safe(o):
+    if isinstance(o, (bytes, bytearray)):
+        import base64
+        return base64.b64encode(bytes(o)).decode()
+    raise TypeError(f"unserializable {type(o)}")
+
+
 class XdsGrpcServer:
     """The listening gRPC server; generic handlers bind the two ADS
     methods on their canonical paths so no generated service stubs are
@@ -291,10 +423,20 @@ class XdsGrpcServer:
 
     def __init__(self, manager, host: str = "127.0.0.1", port: int = 0,
                  authorize: Optional[Callable[[str, str], bool]] = None,
-                 server_credentials=None, max_workers: int = 16):
+                 subscribe_authorize: Optional[
+                     Callable[[str, str, str], bool]] = None,
+                 server_credentials=None, max_workers: int = 64):
         self.servicer = AdsServicer(manager, authorize=authorize)
+        self.subscribe_servicer = SubscribeServicer(
+            manager.store, authorize=subscribe_authorize)
+        # Every ADS/Subscribe stream pins one worker thread for its
+        # whole life (sync gRPC), so the pool bounds concurrent
+        # streams.  maximum_concurrent_rpcs makes overflow fail FAST
+        # with RESOURCE_EXHAUSTED instead of queueing behind parked
+        # streams forever.
         self._server = grpc.server(
-            futures.ThreadPoolExecutor(max_workers=max_workers))
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            maximum_concurrent_rpcs=max_workers)
         handlers = {
             "StreamAggregatedResources": grpc.stream_stream_rpc_method_handler(
                 self.servicer.stream_aggregated_resources,
@@ -305,8 +447,16 @@ class XdsGrpcServer:
                 request_deserializer=xds_pb.DeltaDiscoveryRequest.FromString,
                 response_serializer=xds_pb.DeltaDiscoveryResponse.SerializeToString),
         }
+        sub_handlers = {
+            "Subscribe": grpc.unary_stream_rpc_method_handler(
+                self.subscribe_servicer.subscribe,
+                request_deserializer=xds_pb.SubscribeRequest.FromString,
+                response_serializer=xds_pb.StreamEvent.SerializeToString),
+        }
         self._server.add_generic_rpc_handlers(
-            (grpc.method_handlers_generic_handler(SERVICE, handlers),))
+            (grpc.method_handlers_generic_handler(SERVICE, handlers),
+             grpc.method_handlers_generic_handler(SUBSCRIBE_SERVICE,
+                                                  sub_handlers)))
         addr = f"{host}:{port}"
         if server_credentials is not None:
             self.port = self._server.add_secure_port(

@@ -64,6 +64,11 @@ DiscoveryResponse = discovery_pb2.DiscoveryResponse
 DeltaDiscoveryRequest = discovery_pb2.DeltaDiscoveryRequest
 DeltaDiscoveryResponse = discovery_pb2.DeltaDiscoveryResponse
 
+from consultpu.stream.v1 import subscribe_pb2 as _subscribe_pb2  # noqa: E402
+
+SubscribeRequest = _subscribe_pb2.SubscribeRequest
+StreamEvent = _subscribe_pb2.StreamEvent
+
 
 def from_dict(resource: dict):
     """One xds.py resource dict (with its top-level "@type") → typed

@@ -338,3 +338,119 @@ def test_agent_wires_grpc_port_and_acl(tmp_path):
             s.close()
     finally:
         a.stop()
+
+
+def test_grpc_subscribe_snapshot_then_follow(agent, ads):
+    """gRPC event streams (the pbsubscribe Subscribe role,
+    proto/pbsubscribe/subscribe.proto:14): snapshot rows, an
+    end_of_snapshot marker, then live pushes on state change."""
+    ch = grpc.insecure_channel(ads.address)
+    try:
+        rpc = ch.unary_stream(
+            "/consultpu.stream.v1.StateChangeSubscription/Subscribe",
+            request_serializer=xds_pb.SubscribeRequest.SerializeToString,
+            response_deserializer=xds_pb.StreamEvent.FromString)
+        call = rpc(xds_pb.SubscribeRequest(topic="health", key="db"))
+        it = iter(call)
+
+        def nxt(timeout=10.0):
+            box = {}
+
+            def pull():
+                try:
+                    box["m"] = next(it)
+                except Exception as e:
+                    box["err"] = e
+            t = threading.Thread(target=pull, daemon=True)
+            t.start()
+            t.join(timeout)
+            assert "m" in box, box.get("err", "no event within timeout")
+            return box["m"]
+
+        # snapshot frames (payload = full row ARRAY per key) then
+        # the boundary marker
+        saw_snapshot_rows = 0
+        while True:
+            ev = nxt()
+            if ev.end_of_snapshot:
+                break
+            rows = json.loads(ev.payload)
+            assert isinstance(rows, list)
+            saw_snapshot_rows += len(rows)
+            assert all(r["Service"]["service_name"] == "db"
+                       for r in rows)
+        assert saw_snapshot_rows >= 1
+        # live follow: a health flip pushes an event
+        agent.store.register_check("n2", "dbc2", "db check2",
+                                   status="critical", service_id="db1")
+        ev = nxt()
+        assert ev.topic == "health" and not ev.end_of_snapshot
+        rows = json.loads(ev.payload)
+        # the stream ships full health state (checks included) and the
+        # subscriber filters — pbsubscribe ServiceHealth semantics
+        db1 = next(r for r in rows
+                   if r["Service"]["service_id"] == "db1")
+        assert any(c["status"] == "critical" for c in db1["Checks"])
+        call.cancel()
+    finally:
+        ch.close()
+
+
+def test_grpc_subscribe_whole_topic_and_resume(agent, ads):
+    """key=\"\" snapshots the WHOLE topic (pre-existing state included);
+    a resume index replays history instead of re-snapshotting."""
+    ch = grpc.insecure_channel(ads.address)
+    try:
+        rpc = ch.unary_stream(
+            "/consultpu.stream.v1.StateChangeSubscription/Subscribe",
+            request_serializer=xds_pb.SubscribeRequest.SerializeToString,
+            response_deserializer=xds_pb.StreamEvent.FromString)
+
+        def drain_snapshot(call, timeout=10.0):
+            frames = []
+            it = iter(call)
+            while True:
+                box = {}
+
+                def pull():
+                    try:
+                        box["m"] = next(it)
+                    except Exception as e:
+                        box["err"] = e
+                t = threading.Thread(target=pull, daemon=True)
+                t.start()
+                t.join(timeout)
+                assert "m" in box, box.get("err")
+                if box["m"].end_of_snapshot:
+                    return frames, it
+                frames.append(box["m"])
+
+        call = rpc(xds_pb.SubscribeRequest(topic="health", key=""))
+        frames, it = drain_snapshot(call)
+        keys = {f.key for f in frames}
+        assert "db" in keys, f"whole-topic snapshot missed db: {keys}"
+        last_index = max(f.index for f in frames)
+        call.cancel()
+
+        # resume: no snapshot frames, straight to live after a change
+        call2 = rpc(xds_pb.SubscribeRequest(topic="health", key="db",
+                                            index=last_index))
+        it2 = iter(call2)
+        agent.store.register_check("n2", "dbr", "resume check",
+                                   status="passing", service_id="db1")
+        box = {}
+
+        def pull2():
+            try:
+                box["m"] = next(it2)
+            except Exception as e:
+                box["err"] = e
+        t = threading.Thread(target=pull2, daemon=True)
+        t.start()
+        t.join(10.0)
+        assert "m" in box, box.get("err")
+        assert not box["m"].end_of_snapshot          # no snapshot cycle
+        assert json.loads(box["m"].payload)          # live data frame
+        call2.cancel()
+    finally:
+        ch.close()
