@@ -135,7 +135,8 @@ class RaftNode:
                  apply_fn: Callable[[Any], Any],
                  snapshot_fn: Optional[Callable[[], Any]] = None,
                  restore_fn: Optional[Callable[[Any], None]] = None,
-                 config: Optional[RaftConfig] = None, seed: int = 0):
+                 config: Optional[RaftConfig] = None, seed: int = 0,
+                 store=None):
         self.node_id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.transport = transport
@@ -143,7 +144,16 @@ class RaftNode:
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.cfg = config or RaftConfig()
-        self._rng = random.Random(hash((node_id, seed)) & 0xFFFFFFFF)
+        # crc32, not hash(): PYTHONHASHSEED salts str hashing per
+        # process, which would make election jitter unreproducible
+        # across runs no matter what seed the caller fixes
+        import zlib
+        self._rng = random.Random(
+            zlib.crc32(f"{node_id}:{seed}".encode()) & 0xFFFFFFFF)
+        # optional DurableLog (consensus/logstore.py): the raft-boltdb
+        # role — entries/term/vote/snapshots fsync BEFORE this node
+        # acknowledges them (server.go:728)
+        self.store = store
 
         # persistent state
         self.current_term = 0
@@ -176,6 +186,53 @@ class RaftNode:
         self._leader_observers: List[Callable[[bool], None]] = []
         self.applied_index_log: List[int] = []    # for tests/metrics
         self._first_tick = True
+        # AFTER the volatile block: boot recovery sets last_applied/
+        # commit_index to the snapshot horizon and must not be
+        # clobbered by the zero-inits above
+        if store is not None:
+            self._boot_from_store()
+
+    def _boot_from_store(self) -> None:
+        """Crash recovery: rebuild term/vote/log/snapshot from disk.
+        Entries above the snapshot base stay UNCOMMITTED until a leader
+        re-establishes commit_index — standard raft boot."""
+        state = self.store.load()
+        if state is None:
+            return
+        self.current_term = state["term"]
+        self.voted_for = state["voted_for"]
+        self.log_base = state["base"]
+        self.log_base_term = state["base_term"]
+        if state["snapshot"] is not None:
+            self.snapshot_data = state["snapshot"]
+            self.snap_index = state["snap_index"]
+            self.snap_term = state["snap_term"]
+            if self.restore_fn is not None:
+                self.restore_fn(state["snapshot"])
+        # contiguous run from base+1; a gap means the WAL lost frames
+        # (shouldn't happen, but a hole must not fake consistency)
+        idx = self.log_base
+        while (idx + 1) in state["entries"]:
+            idx += 1
+            term, cmd, noop = state["entries"][idx]
+            self.log.append(_Entry(term, cmd, noop))
+        # the FSM is restored through snap_index; log entries between
+        # log_base and snap_index are the already-applied catch-up
+        # window kept for lagging peers
+        self.commit_index = max(self.log_base, self.snap_index)
+        self.last_applied = self.commit_index
+
+    def _persist_term_vote(self) -> None:
+        if self.store is not None:
+            self.store.set_term_vote(self.current_term, self.voted_for)
+
+    def _persist_entry(self, idx: int, e: "_Entry") -> None:
+        if self.store is not None:
+            self.store.append(idx, e.term, e.cmd, e.noop)
+
+    def _persist_sync(self) -> None:
+        if self.store is not None:
+            self.store.sync()
 
     # -------------------------------------------------------------- log math
 
@@ -234,8 +291,13 @@ class RaftNode:
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
-            self.log.append(_Entry(self.current_term, cmd, noop))
+            ent = _Entry(self.current_term, cmd, noop)
+            self.log.append(ent)
             idx = self.last_log_index
+            # WAL append now, fsync deferred to the commit decision
+            # (_advance_commit) — one group-commit fsync per tick
+            # covers every write batched into it
+            self._persist_entry(idx, ent)
             pend = _Pending()
             self._pending[idx] = pend
             self.match_index[self.node_id] = idx
@@ -282,6 +344,7 @@ class RaftNode:
         if term > self.current_term:
             self.current_term = term
             self.voted_for = None
+            self._persist_term_vote()
         self._reset_election_timer(now)
         if was_leader:
             self._fail_pending(NotLeaderError(self.leader_id))
@@ -316,6 +379,9 @@ class RaftNode:
         self.state = CANDIDATE
         self.current_term += 1
         self.voted_for = self.node_id
+        # durable BEFORE any request_vote leaves: a crashed-and-
+        # restarted candidate must not double-vote in this term
+        self._persist_term_vote()
         self._votes = {self.node_id}
         self._prevotes = set()
         self.leader_id = None
@@ -338,7 +404,9 @@ class RaftNode:
             self.match_index = {p: 0 for p in self.peers}
             self.match_index[self.node_id] = self.last_log_index
             # no-op barrier commits this term (Raft §8 / leader.go:306)
-            self.log.append(_Entry(self.current_term, None, True))
+            barrier = _Entry(self.current_term, None, True)
+            self.log.append(barrier)
+            self._persist_entry(self.last_log_index, barrier)
             self.match_index[self.node_id] = self.last_log_index
             self._heartbeat_due = now
             self._broadcast_append(now)
@@ -422,6 +490,9 @@ class RaftNode:
             if up_to_date and self.voted_for in (None, msg["from"]):
                 grant = True
                 self.voted_for = msg["from"]
+                # vote durable BEFORE the reply leaves (Raft
+                # persistent-state rule)
+                self._persist_term_vote()
                 self._reset_election_timer(now)
         self.transport.send(msg["from"], {
             "type": "vote_reply", "from": self.node_id,
@@ -449,13 +520,20 @@ class RaftNode:
                         continue            # already snapshotted
                     if have is not None and have != ent["term"]:
                         del self.log[idx - self.log_base - 1:]
+                        if self.store is not None:
+                            self.store.truncate_from(idx)
                         have = None
                     if have is None:
-                        self.log.append(_Entry(ent["term"], ent["cmd"],
-                                               ent.get("noop", False)))
+                        e = _Entry(ent["term"], ent["cmd"],
+                                   ent.get("noop", False))
+                        self.log.append(e)
+                        self._persist_entry(idx, e)
                 if msg["leader_commit"] > self.commit_index:
                     self.commit_index = min(msg["leader_commit"],
                                             self.last_log_index)
+                # fsync BEFORE the ok reply: the leader counts this
+                # follower's match toward quorum on receipt
+                self._persist_sync()
         self.transport.send(msg["from"], {
             "type": "append_reply", "from": self.node_id,
             "term": self.current_term, "ok": ok,
@@ -501,6 +579,12 @@ class RaftNode:
                 self.log = []
                 self.commit_index = max(self.commit_index, self.log_base)
                 self.last_applied = max(self.last_applied, self.log_base)
+                if self.store is not None:
+                    # durable before the ack: the leader stops
+                    # re-sending once it sees last_index
+                    self.store.save_snapshot(
+                        msg["last_index"], msg["last_term"],
+                        msg["data"], {})
         self.transport.send(msg["from"], {
             "type": "snapshot_reply", "from": self.node_id,
             "term": self.current_term, "last_index": self.last_applied})
@@ -508,6 +592,9 @@ class RaftNode:
     def _advance_commit(self) -> None:
         if self.state != LEADER:
             return
+        # group commit: everything appended this tick hits disk in one
+        # fsync before the leader's own match counts toward quorum
+        self._persist_sync()
         matches = sorted(self.match_index.values(), reverse=True)
         quorum = (len(self.peers) + 1) // 2 + 1
         if len(matches) < quorum:
@@ -572,6 +659,17 @@ class RaftNode:
         self.log = self.log[keep_from - self.log_base:]
         self.log_base = keep_from
         self.log_base_term = new_base_term
+        if self.store is not None:
+            # base trails the snapshot by the catch-up window so a
+            # restart can still serve cheap appends to laggards; the
+            # store only rewrites the WAL when it holds enough dead
+            # records to be worth it (bounded compaction stall)
+            live = {self.log_base + 1 + i: (e.term, e.cmd, e.noop)
+                    for i, e in enumerate(self.log)}
+            self.store.save_snapshot(self.snap_index, self.snap_term,
+                                     self.snapshot_data, live,
+                                     base=self.log_base,
+                                     base_term=self.log_base_term)
 
     # ------------------------------------------------------------- stats API
 

@@ -48,18 +48,27 @@ class NoLeaderError(Exception):
 class Server:
     def __init__(self, node_id: str, peers: List[str], transport: Transport,
                  registry: Dict[str, "Server"],
-                 raft_config: Optional[RaftConfig] = None, seed: int = 0):
+                 raft_config: Optional[RaftConfig] = None, seed: int = 0,
+                 data_dir: Optional[str] = None):
         self.node_id = node_id
         self.transport = transport
         self.store = StateStore()
         self.fsm = ServerFSM(self.store)
         self.registry = registry
+        # data_dir → durable raft log + vote + snapshots (the
+        # raft-boltdb + FileSnapshotStore role, server.go:728): a
+        # kill -9 of the whole fleet recovers to the last commit
+        durable = None
+        if data_dir:
+            from consul_tpu.consensus.logstore import DurableLog
+            import os
+            durable = DurableLog(os.path.join(data_dir, "raft"))
         self.raft = RaftNode(
             node_id, peers, transport,
             apply_fn=self.fsm.apply,
             snapshot_fn=self.store.snapshot,
             restore_fn=self.store.load_snapshot,
-            config=raft_config, seed=seed)
+            config=raft_config, seed=seed, store=durable)
         if hasattr(transport, "register"):
             transport.register(self.raft)
         registry[node_id] = self

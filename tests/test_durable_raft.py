@@ -1,0 +1,299 @@
+"""Durable raft state: WAL + vote/term + snapshots survive crashes.
+
+VERDICT r2 missing #2 / next #2.  Reference: raft-boltdb log + vote
+persistence (agent/consul/server.go:728) + FileSnapshotStore — a whole
+fleet can be kill -9'd and recover to the last committed write, not the
+last operator snapshot.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.consensus.logstore import DurableLog
+from consul_tpu.consensus.raft import InMemTransport, RaftConfig, RaftNode
+
+
+# ----------------------------------------------------------- log store unit
+
+def test_wal_roundtrip(tmp_path):
+    d = str(tmp_path / "r")
+    log = DurableLog(d)
+    assert log.load() is None          # fresh dir
+    log.set_term_vote(3, "n2")
+    log.append(1, 1, {"op": "a"})
+    log.append(2, 3, {"op": "b"}, noop=False)
+    log.sync()
+    log.close()
+
+    log2 = DurableLog(d)
+    st = log2.load()
+    assert st["term"] == 3 and st["voted_for"] == "n2"
+    assert st["entries"][1] == (1, {"op": "a"}, False)
+    assert st["entries"][2] == (3, {"op": "b"}, False)
+    assert st["base"] == 0 and st["snapshot"] is None
+    log2.close()
+
+
+def test_wal_truncate_and_snapshot(tmp_path):
+    d = str(tmp_path / "r")
+    log = DurableLog(d)
+    for i in range(1, 6):
+        log.append(i, 1, {"i": i})
+    log.truncate_from(4)               # conflict removed 4,5
+    log.append(4, 2, {"i": "4b"})
+    log.sync()
+    # compaction: snapshot through 3, live window {4}
+    log.save_snapshot(3, 1, {"state": "s3"},
+                      {4: (2, {"i": "4b"}, False)})
+    log.close()
+
+    st = DurableLog(d).load()
+    assert st["base"] == 3 and st["base_term"] == 1
+    assert st["snapshot"] == {"state": "s3"}
+    assert list(st["entries"]) == [4]
+    assert st["entries"][4] == (2, {"i": "4b"}, False)
+
+
+def test_wal_torn_tail_recovers(tmp_path):
+    d = str(tmp_path / "r")
+    log = DurableLog(d)
+    log.append(1, 1, {"op": "good"})
+    log.sync()
+    log.close()
+    # simulate a crash mid-append: valid frame + torn partial frame
+    with open(os.path.join(d, "wal.log"), "ab") as f:
+        blob = json.dumps({"t": "e", "i": 2, "tm": 1,
+                           "c": {"op": "torn"}}).encode()
+        f.write(struct.pack(">I", len(blob)) + blob[: len(blob) // 2])
+    log2 = DurableLog(d)
+    st = log2.load()
+    assert list(st["entries"]) == [1]   # torn record dropped
+    log2.close()
+    # and the file was truncated so future appends are clean
+    log3 = DurableLog(d)
+    log3.append(2, 1, {"op": "retry"})
+    log3.sync()
+    log3.close()
+    log4 = DurableLog(d)
+    st = log4.load()
+    assert st["entries"][2] == (1, {"op": "retry"}, False)
+    log4.close()
+
+
+# ----------------------------------------- in-process raft crash-restart
+
+def _step(nodes, now, dt=0.01, n=200, until=None):
+    for _ in range(n):
+        now += dt
+        for node in nodes:
+            node.tick(now)
+        if until is not None and until():
+            break
+    return now
+
+
+def _mk_cluster(tmp_path, applied):
+    transport = InMemTransport(seed=1)
+    nodes = []
+    for i in range(3):
+        nid = f"n{i}"
+        store = DurableLog(str(tmp_path / nid))
+        node = RaftNode(
+            nid, ["n0", "n1", "n2"], transport,
+            apply_fn=lambda cmd, nid=nid: applied[nid].append(cmd),
+            snapshot_fn=lambda nid=nid: {"applied": list(applied[nid])},
+            restore_fn=lambda data, nid=nid: (
+                applied[nid].clear(),
+                applied[nid].extend(data["applied"])),
+            config=RaftConfig(), seed=7, store=store)
+        transport.register(node)
+        nodes.append(node)
+    return transport, nodes
+
+
+def test_full_cluster_crash_recovers_committed_log(tmp_path):
+    applied = {f"n{i}": [] for i in range(3)}
+    transport, nodes = _mk_cluster(tmp_path, applied)
+    now = _step(nodes, 0.0,
+                until=lambda: any(n.is_leader() for n in nodes))
+    leader = next(n for n in nodes if n.is_leader())
+    pends = [leader.apply({"cmd": i}) for i in range(5)]
+    now = _step(nodes, now, until=lambda: all(
+        p.event.is_set() for p in pends))
+    assert applied[leader.node_id] == [{"cmd": i} for i in range(5)]
+    term_before = leader.current_term
+
+    # "kill -9" everyone: drop the objects, close the stores
+    for n in nodes:
+        n.store.close()
+    del nodes, leader, transport
+
+    applied2 = {f"n{i}": [] for i in range(3)}
+    transport2, nodes2 = _mk_cluster(tmp_path, applied2)
+    # boot state: terms/logs recovered from disk
+    for n in nodes2:
+        assert n.current_term >= term_before
+        assert n.last_log_index >= 5
+    now = _step(nodes2, 0.0,
+                until=lambda: any(n.is_leader() for n in nodes2))
+    leader2 = next(n for n in nodes2 if n.is_leader())
+    # the new leader's barrier commits the recovered log -> every node
+    # re-applies all five commands
+    now = _step(nodes2, now, until=lambda: all(
+        [{"cmd": i} for i in range(5)] ==
+        [c for c in applied2[f"n{j}"] if c is not None]
+        for j in range(3)))
+    for j in range(3):
+        assert [c for c in applied2[f"n{j}"] if c is not None] == \
+            [{"cmd": i} for i in range(5)]
+    # and new writes land on top of the recovered log
+    p = leader2.apply({"cmd": "post-crash"})
+    _step(nodes2, now, until=p.event.is_set)
+    assert p.result is None or True
+    assert {"cmd": "post-crash"} in applied2[leader2.node_id]
+    for n in nodes2:
+        n.store.close()
+
+
+def test_vote_survives_crash(tmp_path):
+    """A restarted node must remember its vote: no double-voting in
+    the same term (Raft persistent-state rule)."""
+    applied = {f"n{i}": [] for i in range(3)}
+    transport, nodes = _mk_cluster(tmp_path, applied)
+    _step(nodes, 0.0, until=lambda: any(n.is_leader() for n in nodes))
+    voter = nodes[0]
+    term, voted = voter.current_term, voter.voted_for
+    voter.store.close()
+    st = DurableLog(str(tmp_path / "n0")).load()
+    assert st["term"] == term and st["voted_for"] == voted
+    for n in nodes[1:]:
+        n.store.close()
+
+
+# ------------------------------------------------- multi-process kill -9
+
+def _free_ports(n):
+    import socket
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _put(addr, key, value):
+    req = urllib.request.Request(addr + f"/v1/kv/{key}", data=value,
+                                 method="PUT")
+    return urllib.request.urlopen(req, timeout=5)
+
+
+def _get(addr, key, params=""):
+    return urllib.request.urlopen(addr + f"/v1/kv/{key}{params}",
+                                  timeout=15).read()
+
+
+def _spawn(i, peers, http_ports, data_dirs):
+    return subprocess.Popen(
+        [sys.executable, "tools/server_proc.py",
+         "--node", f"server{i}", "--peers", peers,
+         "--http-port", str(http_ports[i]),
+         "--data-dir", data_dirs[i]],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, cwd=".")
+
+
+def test_multiproc_kill9_all_recovers_every_write(tmp_path):
+    """The VERDICT #2 'done' case: kill -9 all three server processes,
+    restart on the same data dirs, read back every committed write."""
+    rpc_ports = _free_ports(3)
+    http_ports = _free_ports(3)
+    peers = ",".join(f"server{i}=127.0.0.1:{rpc_ports[i]}"
+                     for i in range(3))
+    data_dirs = [str(tmp_path / f"s{i}") for i in range(3)]
+    procs = [_spawn(i, peers, http_ports, data_dirs)
+             for i in range(3)]
+    addresses = [f"http://127.0.0.1:{p}" for p in http_ports]
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                _put(addresses[0], "boot", b"1")
+                break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            pytest.fail("cluster never elected a leader")
+        for i in range(10):
+            _put(addresses[i % 3], f"crash/k{i}", f"v{i}".encode())
+
+        # SIGKILL everything: no graceful shutdown, no snapshot
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=10)
+
+        procs = [_spawn(i, peers, http_ports, data_dirs)
+                 for i in range(3)]
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                _put(addresses[0], "reborn", b"1")
+                break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            pytest.fail("cluster never recovered after kill -9")
+        for i in range(10):
+            out = _get(addresses[(i + 1) % 3], f"crash/k{i}",
+                       "?consistent")
+            assert f"v{i}".encode() in __import__("base64").b64decode(
+                json.loads(out)[0]["Value"])
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+
+
+def test_datadir_flock_rejects_second_process(tmp_path):
+    from consul_tpu.consensus.logstore import DataDirLockedError
+    d = str(tmp_path / "locked")
+    log = DurableLog(d)
+    # flock is per-process via separate fds... a second open in the
+    # SAME process also conflicts because we use LOCK_NB on a new fd
+    with pytest.raises(DataDirLockedError):
+        DurableLog(d)
+    log.close()
+    log2 = DurableLog(d)               # released on close
+    log2.close()
+
+
+def test_compaction_base_trails_snapshot(tmp_path):
+    """The catch-up window behind a snapshot survives restart: base <
+    snap_index, entries in between still on disk."""
+    d = str(tmp_path / "trail")
+    log = DurableLog(d)
+    for i in range(1, 11):
+        log.append(i, 1, {"i": i})
+    log.sync()
+    # snapshot through 8, keep base at 5 (trailing window 6..8)
+    live = {i: (1, {"i": i}, False) for i in range(6, 11)}
+    log.save_snapshot(8, 1, {"s": 8}, live, base=5, base_term=1)
+    log.close()
+    st = DurableLog(d).load()
+    assert st["base"] == 5 and st["snap_index"] == 8
+    assert sorted(st["entries"]) == [6, 7, 8, 9, 10]
+    assert st["snapshot"] == {"s": 8}

@@ -36,6 +36,9 @@ def main():
                     help="name=host:port,name=host:port,...")
     ap.add_argument("--http-port", type=int, required=True)
     ap.add_argument("--tick", type=float, default=0.002)
+    ap.add_argument("--data-dir", default=None,
+                    help="durable raft log/vote/snapshots; restart on "
+                         "the same dir recovers every committed write")
     args = ap.parse_args()
 
     from consul_tpu.api.http import ApiServer
@@ -51,7 +54,8 @@ def main():
     # process, which would make election jitter unreproducible
     server = Server(args.node, sorted(addresses), transport,
                     registry={}, raft_config=RaftConfig(),
-                    seed=zlib.crc32(args.node.encode()) & 0xFFFF)
+                    seed=zlib.crc32(args.node.encode()) & 0xFFFF,
+                    data_dir=args.data_dir)
     server.serve_rpc(host=my_rpc[0], port=my_rpc[1])
     api = ApiServer(server, node_name=args.node, port=args.http_port)
     api.start()
