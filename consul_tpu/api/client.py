@@ -470,6 +470,26 @@ class Client:
     def connect_ca_roots(self) -> dict:
         return self._call("GET", "/v1/connect/ca/roots")[0]
 
+    def connect_ca_leaf(self, service: str) -> dict:
+        """GET /v1/agent/connect/ca/leaf/<service> — the agent-cached
+        CA-issued leaf for a service (agent_endpoint.go leaf cert)."""
+        return self._call("GET",
+                          f"/v1/agent/connect/ca/leaf/{service}")[0]
+
+    def connect_authorize(self, target: str,
+                          client_cert_uri: str) -> dict:
+        """PUT /v1/agent/connect/authorize (agent_endpoint.go
+        ConnectAuthorize): may this client URI reach `target`?"""
+        return self._call(
+            "PUT", "/v1/agent/connect/authorize", None,
+            json.dumps({"Target": target,
+                        "ClientCertURI": client_cert_uri}).encode())[0]
+
+    def health_connect(self, name: str) -> list:
+        """GET /v1/health/connect/<name> — mesh-reachable (proxy)
+        endpoints for a service."""
+        return self._call("GET", f"/v1/health/connect/{name}")[0]
+
     def connect_ca_rotate(self) -> dict:
         return self._call("PUT", "/v1/connect/ca/rotate")[0]
 

@@ -455,6 +455,8 @@ def _make_handler(srv: ApiServer):
                 proxy = {
                     "destination_service": proxy_raw.get(
                         "DestinationServiceName", ""),
+                    "local_service_port": proxy_raw.get(
+                        "LocalServicePort", 0),
                     "upstreams": [
                         {"destination_name": u.get(
                             "DestinationName", ""),

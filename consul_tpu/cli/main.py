@@ -342,8 +342,32 @@ def cmd_intention(args) -> int:
 
 
 def cmd_connect(args) -> int:
-    """consul connect ca (command/connect/ca)."""
+    """consul connect ca|proxy (command/connect/ca, command/connect/proxy)."""
     c = _client(args)
+    if args.connect_cmd == "proxy":
+        from consul_tpu.connect.proxy import ApiProxy
+        ups = []
+        for spec in args.upstream or []:
+            name, _, port = spec.partition(":")
+            ups.append((name, int(port or 0)))
+        host, _, lp = (args.listen or "127.0.0.1:0").partition(":")
+        proxy = ApiProxy(c, args.service,
+                         listen=(host or "127.0.0.1", int(lp or 0)),
+                         local_app_port=args.local_app_port,
+                         upstreams=ups)
+        proxy.start()
+        print(f"proxy for {args.service}: public "
+              f"127.0.0.1:{proxy.public.port}" + "".join(
+                  f", upstream {n} -> 127.0.0.1:{u.port}"
+                  for (n, _), u in zip(ups, proxy.upstreams)),
+              flush=True)
+        import time as _t
+        try:
+            while True:
+                _t.sleep(1.0)
+        except KeyboardInterrupt:
+            proxy.stop()
+        return 0
     if args.ca_cmd == "roots":
         out = c.connect_ca_roots()
         for r in out["Roots"]:
@@ -956,6 +980,14 @@ def build_parser() -> argparse.ArgumentParser:
     casub.add_parser("get-config")
     x = casub.add_parser("set-config")
     x.add_argument("-config-file", dest="config_file", default="-")
+    px = cosub.add_parser("proxy")
+    px.add_argument("-service", required=True)
+    px.add_argument("-listen", default="127.0.0.1:0",
+                    help="public mTLS listener host:port")
+    px.add_argument("-local-app-port", dest="local_app_port",
+                    type=int, default=0)
+    px.add_argument("-upstream", action="append",
+                    help="name:local_bind_port (repeatable)")
     sp.set_defaults(fn=cmd_connect)
 
     sp = sub.add_parser("login")

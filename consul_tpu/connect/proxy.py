@@ -1,0 +1,440 @@
+"""Built-in L4 Connect proxy: mTLS termination + intention enforcement.
+
+The reference ships a managed sidecar proxy (connect/proxy/listener.go)
+and a Connect-native SDK (connect/service.go) so a mesh works with no
+Envoy at all: the public listener terminates TLS with the service's
+CA-issued leaf, REQUIRES a client certificate chaining to the mesh
+roots, reads the peer's spiffe:// URI SAN, asks the intention graph
+whether that source may reach this destination, and only then pipes
+bytes to the local application.  Upstream listeners do the reverse:
+accept plaintext from the local app, dial the target's public listener
+with our leaf, and verify the server presented the EXPECTED service
+identity (not just any valid mesh cert) before forwarding.
+
+This module is that data plane.  Certificates come from callables so a
+CA rotation picks up new leaves/roots on the next connection without
+restarting listeners (the reference's proxy watches leaf/root updates
+the same way).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import ssl
+import tempfile
+import threading
+from typing import Callable, List, Optional, Tuple
+
+from cryptography import x509
+
+from consul_tpu.connect import intentions as imod
+
+_COPY_CHUNK = 65536
+
+
+def _pipe(a: socket.socket, b: socket.socket) -> None:
+    """Bidirectional byte pump; returns when either side closes."""
+
+    def one_way(src, dst):
+        try:
+            while True:
+                chunk = src.recv(_COPY_CHUNK)
+                if not chunk:
+                    break
+                dst.sendall(chunk)
+        except OSError:
+            pass
+        finally:
+            # half-close so the peer's read loop ends too
+            for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
+                try:
+                    s.shutdown(how)
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=one_way, args=(a, b), daemon=True)
+    t.start()
+    one_way(b, a)
+    t.join(timeout=5.0)
+
+
+def peer_spiffe_uri(tls_sock: ssl.SSLSocket) -> Optional[str]:
+    """The spiffe:// URI SAN from the peer's (already chain-verified)
+    certificate."""
+    der = tls_sock.getpeercert(binary_form=True)
+    if not der:
+        return None
+    cert = x509.load_der_x509_certificate(der)
+    try:
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+    except x509.ExtensionNotFound:
+        return None
+    for uri in sans.get_values_for_type(x509.UniformResourceIdentifier):
+        if uri.startswith("spiffe://"):
+            return uri
+    return None
+
+
+class _CertFiles:
+    """python-ssl needs cert/key as FILES; cache them per-material so
+    each rotation writes once, not per connection."""
+
+    def __init__(self):
+        self._dir = tempfile.mkdtemp(prefix="connect-proxy-")
+        self._cached: Tuple[str, str] = ("", "")
+        self._paths = (os.path.join(self._dir, "cert.pem"),
+                       os.path.join(self._dir, "key.pem"))
+        self._lock = threading.Lock()
+
+    def paths(self, cert_pem: str, key_pem: str) -> Tuple[str, str]:
+        with self._lock:
+            if (cert_pem, key_pem) != self._cached:
+                cpath, kpath = self._paths
+                fd = os.open(kpath, os.O_CREAT | os.O_WRONLY
+                             | os.O_TRUNC, 0o600)
+                with os.fdopen(fd, "w") as f:
+                    f.write(key_pem)
+                with open(cpath, "w") as f:
+                    f.write(cert_pem)
+                self._cached = (cert_pem, key_pem)
+            return self._paths
+
+
+class TlsMaterial:
+    """SSL contexts rebuilt when the leaf/roots change (rotation-safe).
+
+    `leaf_fn() -> {"CertPEM","PrivateKeyPEM",...}`,
+    `roots_fn() -> [{"RootCert",...}]` — the same shapes CAManager and
+    the proxycfg snapshot carry."""
+
+    def __init__(self, leaf_fn: Callable[[], dict],
+                 roots_fn: Callable[[], List[dict]]):
+        self.leaf_fn = leaf_fn
+        self.roots_fn = roots_fn
+        self._files = _CertFiles()
+        self._lock = threading.Lock()
+        self._cache = {}        # (kind, material-key) -> context
+
+    def _material(self):
+        leaf = self.leaf_fn()
+        roots = "".join(r["RootCert"] for r in self.roots_fn())
+        return leaf, roots
+
+    def _context(self, kind: str) -> ssl.SSLContext:
+        leaf, roots = self._material()
+        material = (leaf["CertPEM"], roots)
+        with self._lock:
+            hit = self._cache.get(kind)
+            if hit is not None and hit[0] == material:
+                return hit[1]
+            cpath, kpath = self._files.paths(leaf["CertPEM"],
+                                             leaf["PrivateKeyPEM"])
+            if kind == "server":
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            else:
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                # identity is the URI SAN, checked explicitly against
+                # the expected SPIFFE id — hostname rules don't apply
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_REQUIRED
+            ctx.load_cert_chain(cpath, kpath)
+            ctx.load_verify_locations(cadata=roots)
+            # per-kind slot: server/client contexts coexist; a rotation
+            # replaces only the rebuilt kind's stale entry
+            self._cache[kind] = (material, ctx)
+            return ctx
+
+    def server_context(self) -> ssl.SSLContext:
+        return self._context("server")
+
+    def client_context(self) -> ssl.SSLContext:
+        return self._context("client")
+
+
+class _Listener:
+    """Shared accept-loop scaffolding: bind, per-connection serve
+    threads, clean shutdown.  Subclasses implement _serve(conn)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind((host, port))
+        self._lsock.listen(64)
+        self.port = self._lsock.getsockname()[1]
+        self._running = False
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        self._running = True
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._lsock.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._lsock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+            self._threads = [x for x in self._threads if x.is_alive()]
+
+    def _serve(self, conn: socket.socket) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class PublicListener(_Listener):
+    """Inbound side (connect/proxy/listener.go NewPublicListener):
+    mTLS-terminate, authorize the peer SPIFFE id against intentions,
+    pipe to the local app."""
+
+    def __init__(self, tls: TlsMaterial,
+                 authorize: Callable[[str], Tuple[bool, str]],
+                 app_addr: Tuple[str, int],
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.tls = tls
+        self.authorize = authorize
+        self.app_addr = app_addr
+        # observability: how many conns each decision saw
+        self.stats = {"allowed": 0, "denied": 0, "tls_failed": 0}
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            try:
+                tls_conn = self.tls.server_context().wrap_socket(
+                    conn, server_side=True)
+            except (ssl.SSLError, OSError):
+                # no/bad client cert: refused before any app byte
+                self.stats["tls_failed"] += 1
+                conn.close()
+                return
+            uri = peer_spiffe_uri(tls_conn)
+            ok, _reason = self.authorize(uri or "")
+            if not ok:
+                self.stats["denied"] += 1
+                tls_conn.close()
+                return
+            self.stats["allowed"] += 1
+            try:
+                app = socket.create_connection(self.app_addr,
+                                               timeout=10)
+            except OSError:
+                tls_conn.close()
+                return
+            _pipe(tls_conn, app)
+            tls_conn.close()
+            app.close()
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class UpstreamListener(_Listener):
+    """Outbound side (proxy upstream listener): local plaintext in,
+    mTLS to the target's public listener out, server identity pinned
+    to the expected SPIFFE id."""
+
+    def __init__(self, tls: TlsMaterial, expect_uri: str,
+                 resolve: Callable[[], Optional[Tuple[str, int]]],
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host, port)
+        self.tls = tls
+        self.expect_uri = expect_uri
+        self.resolve = resolve
+        self.stats = {"connected": 0, "identity_mismatch": 0,
+                      "no_endpoint": 0}
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            target = self.resolve()
+            if target is None:
+                self.stats["no_endpoint"] += 1
+                conn.close()
+                return
+            try:
+                raw = socket.create_connection(target, timeout=10)
+                tls_conn = self.tls.client_context().wrap_socket(raw)
+            except (ssl.SSLError, OSError):
+                conn.close()
+                return
+            # the chain verified against mesh roots; now pin the
+            # IDENTITY: any valid mesh cert is not enough, it must be
+            # the service we meant to reach (connect/tls.go verify)
+            uri = peer_spiffe_uri(tls_conn)
+            if uri != self.expect_uri:
+                self.stats["identity_mismatch"] += 1
+                tls_conn.close()
+                conn.close()
+                return
+            self.stats["connected"] += 1
+            _pipe(conn, tls_conn)
+            tls_conn.close()
+            conn.close()
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class ApiProxy:
+    """Standalone data plane driven purely by the agent HTTP API — the
+    `consul connect proxy` process shape (command/connect/proxy): runs
+    in its own process, fetches the leaf + roots from the agent,
+    authorizes inbound peers via /v1/agent/connect/authorize, and
+    resolves upstreams via /v1/health/connect.  Leaf/root fetches are
+    cached briefly so the per-connection path doesn't hammer the
+    agent."""
+
+    def __init__(self, client, service: str,
+                 listen: Tuple[str, int] = ("127.0.0.1", 0),
+                 local_app_port: int = 0,
+                 upstreams: Optional[List[Tuple[str, int]]] = None,
+                 cache_seconds: float = 30.0):
+        self.client = client
+        self.service = service
+        self._cache_s = cache_seconds
+        self._cached = {}       # kind -> (expires, value)
+        self._cache_lock = threading.Lock()
+
+        def cached(kind, fetch):
+            import time as _t
+            with self._cache_lock:
+                hit = self._cached.get(kind)
+                if hit is not None and _t.time() < hit[0]:
+                    return hit[1]
+            val = fetch()
+            with self._cache_lock:
+                self._cached[kind] = (_t.time() + self._cache_s, val)
+            return val
+
+        self.tls = TlsMaterial(
+            lambda: cached("leaf",
+                           lambda: client.connect_ca_leaf(service)),
+            lambda: cached("roots",
+                           lambda: client.connect_ca_roots()["Roots"]))
+
+        def authorize(uri: str) -> Tuple[bool, str]:
+            out = client.connect_authorize(service, uri)
+            return bool(out.get("Authorized")), out.get("Reason", "")
+
+        self.public = PublicListener(
+            self.tls, authorize,
+            app_addr=("127.0.0.1", local_app_port),
+            host=listen[0], port=listen[1])
+        self.upstreams: List[UpstreamListener] = []
+        if upstreams:
+            # expected identities come from the trust domain + dc, not
+            # from signing leaves for services we don't own
+            td = client.connect_ca_roots().get("TrustDomain", "consul")
+            dc = client.agent_self()["Config"].get("Datacenter", "dc1")
+        for name, bind_port in upstreams or []:
+            def resolve(name=name):
+                rows = cached(f"eps:{name}",
+                              lambda: self.client.health_connect(name))
+                for r in rows:
+                    if any(c.get("Status") == "critical"
+                           for c in r.get("Checks", [])):
+                        continue
+                    s = r["Service"]
+                    return (s.get("Address")
+                            or r.get("Node", {}).get("Address")
+                            or "127.0.0.1", s.get("Port", 0))
+                return None
+
+            expect = (f"spiffe://{td}/ns/default/dc/{dc}/svc/{name}")
+            self.upstreams.append(UpstreamListener(
+                self.tls, expect, resolve, port=bind_port))
+
+    def start(self) -> None:
+        self.public.start()
+        for u in self.upstreams:
+            u.start()
+
+    def stop(self) -> None:
+        self.public.stop()
+        for u in self.upstreams:
+            u.stop()
+
+
+class SidecarProxy:
+    """One service's sidecar: public listener + one upstream listener
+    per configured upstream, driven by the agent's proxycfg snapshot
+    (the managed-proxy shape, connect/proxy/proxy.go)."""
+
+    def __init__(self, agent, proxy_id: str,
+                 host: str = "127.0.0.1"):
+        state = agent.api.proxycfg.watch(proxy_id)
+        if state is None:
+            raise ValueError(f"unknown proxy service id {proxy_id!r}")
+        self._state = state
+        snap = state.fetch(0, timeout=5.0)
+        self.service = snap.service
+        manager = agent.api.proxycfg
+
+        def leaf_fn():
+            return manager.get_leaf(self.service)
+
+        def roots_fn():
+            return manager.ca.roots()
+
+        self.tls = TlsMaterial(leaf_fn, roots_fn)
+
+        def authorize(uri: str) -> Tuple[bool, str]:
+            source = imod.spiffe_service(uri) or ""
+            fresh = self._state.fetch(0, timeout=0.0)
+            return imod.authorize(
+                fresh.intentions if fresh else [], source,
+                self.service,
+                fresh.default_allow if fresh else True)
+
+        self.public = PublicListener(
+            self.tls, authorize,
+            app_addr=(host, snap.local_port or 0),
+            host=host,
+            port=snap.port or 0)
+        self.upstreams: List[UpstreamListener] = []
+        ca = manager.ca
+        for up in snap.upstreams:
+            name = up.get("destination_name", "")
+
+            def resolve(name=name):
+                # endpoints are the destination's sidecar public
+                # listeners (health connect rows via proxycfg)
+                fresh = self._state.fetch(0, timeout=0.0)
+                eps = (fresh.upstream_endpoints.get(name, [])
+                       if fresh else [])
+                if eps:
+                    return (eps[0]["address"] or host, eps[0]["port"])
+                return None
+
+            self.upstreams.append(UpstreamListener(
+                self.tls, ca.active.spiffe_id(name), resolve,
+                host=up.get("local_bind_address", host) or host,
+                port=up.get("local_bind_port", 0)))
+
+    def start(self) -> None:
+        self.public.start()
+        for u in self.upstreams:
+            u.start()
+
+    def stop(self) -> None:
+        self.public.stop()
+        for u in self.upstreams:
+            u.stop()

