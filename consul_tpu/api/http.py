@@ -2300,11 +2300,13 @@ def _make_handler(srv: ApiServer):
                 if verb == "GET":
                     if not self.authz.operator_read():
                         return self._forbid()
-                    self._send({"Provider": "consul",
+                    self._send({"Provider": srv.ca.provider_name,
                                 "Config": {
                                     "LeafCertTTL":
                                         f"{srv.ca.leaf_ttl_hours}h",
                                     "TrustDomain": srv.ca.trust_domain,
+                                    "CSRMaxPerSecond":
+                                        srv.ca.csr_max_per_second,
                                 }})
                     return True
                 if verb == "PUT":
@@ -2312,9 +2314,42 @@ def _make_handler(srv: ApiServer):
                         return self._forbid()
                     body = json.loads(self._body() or b"{}")
                     cfg = body.get("Config") or {}
-                    if "LeafCertTTL" in cfg:
-                        ttl_s = _parse_wait(str(cfg["LeafCertTTL"]))
-                        srv.ca.leaf_ttl_hours = max(1, int(ttl_s // 3600))
+                    # VALIDATE everything before mutating anything: a
+                    # rejected request must not leave half the config
+                    # applied (UpdateConfiguration is transactional)
+                    try:
+                        ttl_h = max(1, int(_parse_wait(
+                            str(cfg["LeafCertTTL"])) // 3600)) \
+                            if "LeafCertTTL" in cfg else None
+                        csr_rate = float(cfg["CSRMaxPerSecond"]) \
+                            if "CSRMaxPerSecond" in cfg else None
+                    except (ValueError, TypeError) as e:
+                        self._err(400, f"invalid CA config: {e}")
+                        return True
+                    provider = body.get("Provider")
+                    # a same-provider update with NEW root material is
+                    # a rotation too (external root replaced)
+                    switch = provider and (
+                        provider != srv.ca.provider_name
+                        or (cfg.get("RootCert")
+                            and cfg["RootCert"]
+                            != srv.ca.active.cert_pem))
+                    if switch:
+                        try:
+                            srv.ca.set_provider(provider, cfg)
+                        except ValueError as e:
+                            self._err(400, str(e))
+                            return True
+                        pub = getattr(store, "publisher", None)
+                        if pub is not None:
+                            from consul_tpu.stream.publisher import \
+                                Event
+                            pub.publish([Event(topic="ca", key="",
+                                               index=store.index)])
+                    if ttl_h is not None:
+                        srv.ca.leaf_ttl_hours = ttl_h
+                    if csr_rate is not None:
+                        srv.ca.csr_max_per_second = csr_rate
                     self._send(True)
                     return True
             if path == "/v1/connect/ca/rotate" and verb == "PUT":
@@ -2335,7 +2370,11 @@ def _make_handler(srv: ApiServer):
             if m and verb == "GET":
                 if not self.authz.service_write(m.group(1)):
                     return self._forbid()
-                self._send(srv.ca.sign_leaf(m.group(1)))
+                from consul_tpu.connect.ca import CARateLimitError
+                try:
+                    self._send(srv.ca.sign_leaf(m.group(1)))
+                except CARateLimitError as e:
+                    self._err(429, str(e))   # Too Many Requests
                 return True
             if path == "/v1/agent/connect/authorize" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")

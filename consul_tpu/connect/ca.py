@@ -1,16 +1,24 @@
-"""Connect certificate authority: builtin provider + rotation manager.
+"""Connect certificate authority: pluggable providers + rotation manager.
 
 The reference's CA stack: a pluggable Provider interface
-(agent/connect/ca/provider.go:58 — builtin "consul" provider generates
-and stores its own root), leaf signing with URI SANs carrying SPIFFE ids
-(connect/), and a CAManager on the leader driving root generation and
-rotation with the old root kept in the trust bundle until its leaves age
-out (agent/consul/leader_connect_ca.go:53).
+(agent/connect/ca/provider.go:58) with a builtin "consul" provider that
+generates its own root plus external providers (Vault
+provider_vault.go, AWS ACM-PCA provider_aws.go) whose root material
+comes from outside; leaf signing with URI SANs carrying SPIFFE ids
+(connect/); a CAManager on the leader driving root generation and
+rotation with the old root kept in the trust bundle until its leaves
+age out (agent/consul/leader_connect_ca.go:53), CROSS-SIGNING the new
+root with the old one during provider/root switches so in-flight
+leaves validate through either path; and a leaf-CSR rate limiter
+protecting the servers (agent/consul/server.go:148 csrRateLimiter).
 
-Real X.509 via `cryptography`: EC P-256 keys, self-signed roots, leaf
-certs with spiffe:// URI SANs.  CA state (roots + active id) serializes
-to a plain dict so it can replicate through the FSM like the reference's
-raft-backed CA tables.
+Here: `CAProvider` is the interface; `BuiltinCA` self-generates
+(the "consul" provider), `ExternalCA` wraps operator-supplied root
+material (the Vault/ACM shape without egress — the secret key arrives
+via config instead of a Vault read).  Real X.509 via `cryptography`:
+EC P-256 keys, self-signed roots, leaf certs with spiffe:// URI SANs.
+CA state serializes to a plain dict so it can replicate through the
+FSM like the reference's raft-backed CA tables.
 """
 
 from __future__ import annotations
@@ -32,8 +40,41 @@ def _utcnow() -> datetime.datetime:
     return datetime.datetime.now(datetime.timezone.utc)
 
 
-class BuiltinCA:
+class CARateLimitError(Exception):
+    """Leaf CSR rate exceeded (server.go:148 csrRateLimiter; callers
+    surface 429)."""
+
+
+class CAProvider:
+    """The Provider interface (agent/connect/ca/provider.go:58).
+
+    Concrete providers supply root material and signing; the manager
+    owns rotation, cross-signing orchestration, bundles, and rate
+    limits.  Required surface:
+
+      name            class attr, the config `Provider` string
+      id              active root id
+      cert_pem        active root certificate
+      trust_domain / dc / leaf_ttl_hours
+      sign(common_name, sans, ttl) -> (cert_pem, key_pem)
+      sign_leaf(service) -> (cert_pem, key_pem)
+      verify_leaf(cert_pem) -> bool
+      cross_sign(cert_pem) -> pem   (re-issue the given CA cert under
+                                     OUR key: the bridge old→new roots
+                                     ride during rotation)
+      supports_cross_signing() -> bool
+    """
+
+    name = "abstract"
+
+    def supports_cross_signing(self) -> bool:
+        return True
+
+
+class BuiltinCA(CAProvider):
     """The builtin ("consul") CA provider: one EC root, leaf signing."""
+
+    name = "consul"
 
     def __init__(self, trust_domain: str, dc: str = "dc1",
                  root_ttl_days: int = 3650, leaf_ttl_hours: int = 72,
@@ -63,8 +104,12 @@ class BuiltinCA:
                 .not_valid_before(now - _BACKDATE)
                 .not_valid_after(now + datetime.timedelta(
                     days=root_ttl_days))
+                # no pathLenConstraint: the root must be able to issue
+                # the CA=true cross-signed bridge during rotation
+                # (path_length=0 would make RFC 5280 validators reject
+                # leaf -> bridge -> root chains)
                 .add_extension(x509.BasicConstraints(ca=True,
-                                                     path_length=0),
+                                                     path_length=None),
                                critical=True)
                 .add_extension(x509.SubjectAlternativeName([
                     x509.UniformResourceIdentifier(
@@ -150,6 +195,65 @@ class BuiltinCA:
         return (leaf.not_valid_before_utc <= now
                 <= leaf.not_valid_after_utc)
 
+    def cross_sign(self, cert_pem: str) -> str:
+        """Re-issue another CA's certificate under OUR key (same
+        subject + public key, issuer = us): trust in the old root
+        transitively covers leaves of the new one during rotation
+        (provider.go CrossSignCA)."""
+        other = x509.load_pem_x509_certificate(cert_pem.encode())
+        now = _utcnow()
+        cross = (
+            x509.CertificateBuilder()
+            .subject_name(other.subject)
+            .issuer_name(self._cert.subject)
+            .public_key(other.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _BACKDATE)
+            .not_valid_after(other.not_valid_after_utc)
+            .add_extension(x509.BasicConstraints(ca=True,
+                                                 path_length=0),
+                           critical=True)
+            .sign(self._key, hashes.SHA256())
+        )
+        return cross.public_bytes(serialization.Encoding.PEM).decode()
+
+
+class ExternalCA(BuiltinCA):
+    """Operator-supplied root material (the Vault / ACM-PCA provider
+    shape, provider_vault.go — minus the network fetch: in a no-egress
+    environment the root cert+key arrive via the CA config instead of
+    a Vault read).  Everything else (signing, verification,
+    cross-signing) is the common X.509 machinery."""
+
+    name = "external"
+
+    def __init__(self, trust_domain: str, cert_pem: str, key_pem: str,
+                 dc: str = "dc1", leaf_ttl_hours: int = 72,
+                 serial: int = 1):
+        if not cert_pem or not key_pem:
+            raise ValueError(
+                "external CA requires RootCert and PrivateKey")
+        super().__init__(trust_domain, dc=dc,
+                         leaf_ttl_hours=leaf_ttl_hours, serial=serial,
+                         key_pem=key_pem, cert_pem=cert_pem)
+        # fail at CONFIG time, not at the first mesh-wide handshake
+        # failure: the key must actually match the certificate and the
+        # certificate must be a CA
+        if self._cert.public_key().public_numbers() != \
+                self._key.public_key().public_numbers():
+            raise ValueError(
+                "external CA private key does not match RootCert")
+        try:
+            bc = self._cert.extensions.get_extension_for_class(
+                x509.BasicConstraints).value
+        except x509.ExtensionNotFound:
+            raise ValueError("external RootCert has no "
+                             "BasicConstraints extension")
+        if not bc.ca:
+            raise ValueError("external RootCert is not a CA "
+                             "certificate")
+        self.id = f"external-{serial}"
+
 
 class CAManager:
     """Root lifecycle on the leader (leader_connect_ca.go:53): initialize,
@@ -157,16 +261,24 @@ class CAManager:
     trust bundle so in-flight leaves stay verifiable."""
 
     def __init__(self, trust_domain: Optional[str] = None, dc: str = "dc1",
-                 leaf_ttl_hours: int = 72):
+                 leaf_ttl_hours: int = 72,
+                 csr_max_per_second: float = 50.0):
         self.trust_domain = trust_domain or \
             f"{uuid.uuid4()}.consul"
         self.dc = dc
         self.leaf_ttl_hours = leaf_ttl_hours
         self._lock = threading.Lock()
         self._serial = 1
-        self._roots: List[BuiltinCA] = [
+        self._roots: List[CAProvider] = [
             BuiltinCA(self.trust_domain, dc, serial=1,
                       leaf_ttl_hours=leaf_ttl_hours)]
+        # cross-signed bridge certs per root id (rotation trust path)
+        self._cross_signed: Dict[str, str] = {}
+        # leaf-CSR token bucket (server.go:148 csrRateLimiter);
+        # <= 0 disables
+        self.csr_max_per_second = csr_max_per_second
+        self._csr_tokens = csr_max_per_second
+        self._csr_stamp = 0.0
 
     # -------------------------------------------------------------- roots
 
@@ -176,28 +288,94 @@ class CAManager:
             return self._roots[-1]
 
     def roots(self) -> List[dict]:
-        """Trust bundle (GET /v1/connect/ca/roots shape)."""
+        """Trust bundle (GET /v1/connect/ca/roots shape); rotated-in
+        roots carry the cross-signed bridge cert when one exists."""
         with self._lock:
             active_id = self._roots[-1].id
-            return [{"ID": r.id, "Name": f"Consul CA {i + 1}",
-                     "RootCert": r.cert_pem,
-                     "Active": r.id == active_id}
-                    for i, r in enumerate(self._roots)]
+            out = []
+            for i, r in enumerate(self._roots):
+                row = {"ID": r.id, "Name": f"Consul CA {i + 1}",
+                       "RootCert": r.cert_pem,
+                       "Active": r.id == active_id}
+                if r.id in self._cross_signed:
+                    row["IntermediateCerts"] = [
+                        self._cross_signed[r.id]]
+                out.append(row)
+            return out
+
+    @property
+    def provider_name(self) -> str:
+        return self.active.name
 
     def rotate(self) -> str:
-        """Generate + activate a new root; prior roots stay in the bundle
-        (rotation keeps old leaves verifiable — leader_connect_ca.go)."""
+        """Generate + activate a new builtin root; prior roots stay in
+        the bundle (rotation keeps old leaves verifiable —
+        leader_connect_ca.go)."""
         with self._lock:
             self._serial += 1
-            self._roots.append(BuiltinCA(self.trust_domain, self.dc,
-                                         serial=self._serial,
-                                         leaf_ttl_hours=self.leaf_ttl_hours))
-            return self._roots[-1].id
+            new = BuiltinCA(self.trust_domain, self.dc,
+                            serial=self._serial,
+                            leaf_ttl_hours=self.leaf_ttl_hours)
+            self._activate_locked(new)
+            return new.id
+
+    def set_provider(self, provider: str, config: dict) -> str:
+        """Switch the active provider (PUT /v1/connect/ca/configuration
+        — leader_connect_ca.go UpdateConfiguration): the outgoing
+        active root cross-signs the incoming one when it can, so
+        leaves already issued keep a trust path through either root
+        until they age out."""
+        with self._lock:
+            self._serial += 1
+            if provider in ("consul", "builtin"):
+                new: CAProvider = BuiltinCA(
+                    self.trust_domain, self.dc, serial=self._serial,
+                    leaf_ttl_hours=self.leaf_ttl_hours)
+            elif provider == "external":
+                new = ExternalCA(
+                    self.trust_domain,
+                    cert_pem=config.get("RootCert", ""),
+                    key_pem=config.get("PrivateKey", ""),
+                    dc=self.dc, serial=self._serial,
+                    leaf_ttl_hours=self.leaf_ttl_hours)
+            else:
+                raise ValueError(f"unknown CA provider {provider!r}")
+            self._activate_locked(new)
+            return new.id
+
+    def _activate_locked(self, new: CAProvider) -> None:
+        old = self._roots[-1]
+        if old.supports_cross_signing():
+            self._cross_signed[new.id] = old.cross_sign(new.cert_pem)
+        self._roots.append(new)
 
     # ------------------------------------------------------------- leaves
 
+    def _take_csr_token(self) -> None:
+        """Token bucket refilled at csr_max_per_second; raises
+        CARateLimitError when drained (server.go:148 — a leaf-signing
+        stampede must not starve raft/rpc)."""
+        import time as _time
+        if self.csr_max_per_second <= 0:
+            return
+        now = _time.monotonic()
+        rate = self.csr_max_per_second
+        # burst floor of 1: fractional rates (0.5 = one per 2s) must
+        # still accumulate a whole token, not block forever
+        self._csr_tokens = min(
+            max(rate, 1.0),
+            self._csr_tokens + (now - self._csr_stamp) * rate)
+        self._csr_stamp = now
+        if self._csr_tokens < 1.0:
+            raise CARateLimitError(
+                "connect CSR rate limit exceeded "
+                f"({rate:g}/s)")
+        self._csr_tokens -= 1.0
+
     def sign_leaf(self, service: str) -> dict:
-        ca = self.active
+        with self._lock:
+            self._take_csr_token()
+            ca = self._roots[-1]
         cert, key = ca.sign_leaf(service)
         return {"SerialNumber": "", "CertPEM": cert, "PrivateKeyPEM": key,
                 "Service": service,

@@ -12,6 +12,7 @@ fetches keyed by version, exactly the shape the xDS layer long-polls.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -167,7 +168,16 @@ class ProxyState:
                         return
                     fired = True
             if fired:
-                self._rebuild()
+                try:
+                    self._rebuild()
+                except Exception:
+                    # a transient failure (CSR rate pressure, store
+                    # contention) must not kill the follow thread and
+                    # freeze this proxy's snapshot forever; the next
+                    # event retries
+                    logging.getLogger("consul_tpu.proxycfg").warning(
+                        "proxy %s rebuild failed; will retry",
+                        self.proxy_id, exc_info=True)
 
     def _connect_endpoints(self, name: str) -> List[dict]:
         """Mesh-reachable endpoints for upstream `name`: the healthy
@@ -337,7 +347,16 @@ class Manager:
             hit = self._leaves.get(service)
             if hit is not None and hit[0] == active and now < hit[2]:
                 return hit[1]
-            leaf = self.ca.sign_leaf(service)
+            from consul_tpu.connect.ca import CARateLimitError
+            try:
+                leaf = self.ca.sign_leaf(service)
+            except CARateLimitError:
+                if hit is not None:
+                    # serve the stale-but-valid leaf under CSR
+                    # pressure rather than failing the snapshot
+                    # (the reference's leaf cache behaves the same)
+                    return hit[1]
+                raise
             ttl_s = self.ca.leaf_ttl_hours * 3600.0
             refresh_at = now + ttl_s * _LEAF_REFRESH_FRACTION
             self._leaves[service] = (active, leaf, refresh_at)

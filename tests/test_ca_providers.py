@@ -1,0 +1,212 @@
+"""CA provider interface: external provider, cross-sign rotation,
+CSR rate limit.
+
+VERDICT r2 missing #4 / next #6.  Reference: provider interface
+(agent/connect/ca/provider.go:58), Vault/ACM providers
+(provider_vault.go, provider_aws.go), cross-signing during root
+switches (leader_connect_ca.go), csrRateLimiter
+(agent/consul/server.go:148).
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+from cryptography import x509
+
+from consul_tpu.connect.ca import (
+    BuiltinCA, CAManager, CARateLimitError, ExternalCA,
+)
+
+
+def _external_material(trust_domain="ext.consul"):
+    """Operator-supplied root material (what Vault would hold)."""
+    src = BuiltinCA(trust_domain, serial=99)
+    return src.cert_pem, src.key_pem
+
+
+def test_external_provider_signs_verifiable_leaves():
+    cert, key = _external_material()
+    ext = ExternalCA("ext.consul", cert_pem=cert, key_pem=key)
+    leaf_pem, _ = ext.sign_leaf("web")
+    assert ext.verify_leaf(leaf_pem)
+    leaf = x509.load_pem_x509_certificate(leaf_pem.encode())
+    sans = leaf.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName).value
+    uris = sans.get_values_for_type(x509.UniformResourceIdentifier)
+    assert uris == [ext.spiffe_id("web")]
+
+
+def test_external_provider_requires_material():
+    with pytest.raises(ValueError):
+        ExternalCA("ext.consul", cert_pem="", key_pem="")
+
+
+def test_provider_switch_cross_signs_and_keeps_old_leaves():
+    """builtin -> external without breaking existing leaves (the
+    VERDICT 'done' criterion)."""
+    mgr = CAManager(trust_domain="rot.consul")
+    old_leaf = mgr.sign_leaf("web")
+    assert mgr.provider_name == "consul"
+
+    cert, key = _external_material("rot.consul")
+    new_id = mgr.set_provider("external", {"RootCert": cert,
+                                           "PrivateKey": key})
+    assert mgr.provider_name == "external"
+    assert new_id.startswith("external-")
+
+    # old leaves still verify (old root stays in the bundle)
+    assert mgr.verify_leaf(old_leaf["CertPEM"])
+    # new leaves come from the external root
+    new_leaf = mgr.sign_leaf("web")
+    assert mgr.active.verify_leaf(new_leaf["CertPEM"])
+    # the bundle carries a cross-signed bridge: the NEW root's cert
+    # re-issued under the OLD root's key, verifiable by the old root
+    roots = mgr.roots()
+    active_row = next(r for r in roots if r["Active"])
+    assert active_row["ID"] == new_id
+    bridge_pems = active_row.get("IntermediateCerts") or []
+    assert bridge_pems, "no cross-signed bridge in the bundle"
+    bridge = x509.load_pem_x509_certificate(bridge_pems[0].encode())
+    old_root = x509.load_pem_x509_certificate(
+        roots[0]["RootCert"].encode())
+    bridge.verify_directly_issued_by(old_root)   # raises on mismatch
+    # and the bridge carries the new root's public key
+    new_root = x509.load_pem_x509_certificate(
+        active_row["RootCert"].encode())
+    assert bridge.public_key().public_numbers() == \
+        new_root.public_key().public_numbers()
+
+
+def test_switch_back_to_builtin():
+    mgr = CAManager(trust_domain="back.consul")
+    cert, key = _external_material("back.consul")
+    mgr.set_provider("external", {"RootCert": cert, "PrivateKey": key})
+    ext_leaf = mgr.sign_leaf("db")
+    mgr.set_provider("consul", {})
+    assert mgr.provider_name == "consul"
+    assert mgr.verify_leaf(ext_leaf["CertPEM"])   # still in bundle
+
+
+def test_csr_rate_limit():
+    mgr = CAManager(trust_domain="rl.consul", csr_max_per_second=2.0)
+    mgr._csr_tokens = 2.0                 # full bucket, frozen clock
+    import time
+    mgr._csr_stamp = time.monotonic()
+    mgr.sign_leaf("a")
+    mgr.sign_leaf("b")
+    with pytest.raises(CARateLimitError):
+        mgr.sign_leaf("c")
+    # refill restores service
+    mgr._csr_stamp -= 1.0
+    mgr.sign_leaf("d")
+
+
+def test_http_provider_switch_and_429(tmp_path):
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=61))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+        # force CA creation + grab the trust domain
+        roots = json.loads(urllib.request.urlopen(
+            base + "/v1/connect/ca/roots", timeout=10).read())
+        td = roots["TrustDomain"]
+        cert, key = _external_material(td)
+        body = json.dumps({"Provider": "external",
+                           "Config": {"RootCert": cert,
+                                      "PrivateKey": key}}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/connect/ca/configuration", data=body,
+            method="PUT"), timeout=10)
+        cfg = json.loads(urllib.request.urlopen(
+            base + "/v1/connect/ca/configuration", timeout=10).read())
+        assert cfg["Provider"] == "external"
+        # leaf minted under the new provider
+        leaf = json.loads(urllib.request.urlopen(
+            base + "/v1/agent/connect/ca/leaf/web", timeout=10).read())
+        assert a.api.ca.active.verify_leaf(leaf["CertPEM"])
+
+        # throttle to zero bucket -> 429 on the leaf endpoint
+        a.api.ca.csr_max_per_second = 1.0
+        a.api.ca._csr_tokens = 0.0
+        import time
+        a.api.ca._csr_stamp = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                base + "/v1/agent/connect/ca/leaf/other", timeout=10)
+        assert e.value.code == 429
+    finally:
+        a.stop()
+
+
+def test_fractional_csr_rate_still_serves():
+    """0.5/s means one per 2s, not a permanent block."""
+    import time
+    mgr = CAManager(trust_domain="frac.consul", csr_max_per_second=0.5)
+    mgr._csr_tokens = 1.0
+    mgr._csr_stamp = time.monotonic()
+    mgr.sign_leaf("a")                         # consumes the token
+    with pytest.raises(CARateLimitError):
+        mgr.sign_leaf("b")
+    mgr._csr_stamp -= 2.5                      # 2.5s elapse -> 1.25 tok
+    mgr.sign_leaf("c")
+
+
+def test_external_rejects_mismatched_key():
+    cert, _ = _external_material("m1.consul")
+    _, other_key = _external_material("m2.consul")
+    with pytest.raises(ValueError, match="does not match"):
+        ExternalCA("m1.consul", cert_pem=cert, key_pem=other_key)
+
+
+def test_external_rejects_non_ca_cert():
+    src = BuiltinCA("nonca.consul")
+    leaf_pem, leaf_key = src.sign_leaf("not-a-ca")
+    with pytest.raises(ValueError, match="not a CA"):
+        ExternalCA("nonca.consul", cert_pem=leaf_pem, key_pem=leaf_key)
+
+
+def test_same_provider_new_root_material_rotates(tmp_path):
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=62))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+        td = json.loads(urllib.request.urlopen(
+            base + "/v1/connect/ca/roots",
+            timeout=10).read())["TrustDomain"]
+
+        def switch(cert, key):
+            body = json.dumps({"Provider": "external",
+                               "Config": {"RootCert": cert,
+                                          "PrivateKey": key}}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/connect/ca/configuration", data=body,
+                method="PUT"), timeout=10)
+
+        c1, k1 = _external_material(td)
+        switch(c1, k1)
+        id1 = a.api.ca.active.id
+        c2, k2 = _external_material(td)
+        switch(c2, k2)                 # same provider, NEW material
+        assert a.api.ca.active.id != id1
+        assert a.api.ca.active.cert_pem == c2
+
+        # bad config rejected WITHOUT side effects
+        ttl_before = a.api.ca.leaf_ttl_hours
+        body = json.dumps({"Provider": "vault",
+                           "Config": {"LeafCertTTL": "1h"}}).encode()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(urllib.request.Request(
+                base + "/v1/connect/ca/configuration", data=body,
+                method="PUT"), timeout=10)
+        assert e.value.code == 400
+        assert a.api.ca.leaf_ttl_hours == ttl_before
+    finally:
+        a.stop()
