@@ -50,6 +50,9 @@ class Agent:
         a.api.enable_debug = rc.enable_debug
         a.api.kv_max_value_size = rc.kv_max_value_size
         a.api.txn_max_ops = rc.txn_max_ops
+        if rc.encrypt and hasattr(a.oracle, "keyring_install"):
+            # `encrypt` preloads the gossip keyring (agent/keyring.go)
+            a.oracle.keyring_install(rc.encrypt)
         a._config_sources = (tuple(config_files), tuple(config_dirs),
                              dict(flags))
         a._apply_reloadable(rc)

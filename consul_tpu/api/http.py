@@ -2327,6 +2327,8 @@ def _make_handler(srv: ApiServer):
                         self._err(400, f"invalid CA config: {e}")
                         return True
                     provider = body.get("Provider")
+                    if provider == "builtin":
+                        provider = "consul"   # set_provider's alias
                     # a same-provider update with NEW root material is
                     # a rotation too (external root replaced)
                     switch = provider and (
@@ -2337,7 +2339,9 @@ def _make_handler(srv: ApiServer):
                     if switch:
                         try:
                             srv.ca.set_provider(provider, cfg)
-                        except ValueError as e:
+                        except (ValueError, TypeError) as e:
+                            # TypeError: e.g. an encrypted PKCS8 key
+                            # from the cryptography loaders
                             self._err(400, str(e))
                             return True
                         pub = getattr(store, "publisher", None)

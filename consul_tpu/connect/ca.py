@@ -252,6 +252,11 @@ class ExternalCA(BuiltinCA):
         if not bc.ca:
             raise ValueError("external RootCert is not a CA "
                              "certificate")
+        now = _utcnow()
+        if not (self._cert.not_valid_before_utc <= now
+                <= self._cert.not_valid_after_utc):
+            raise ValueError("external RootCert is outside its "
+                             "validity window")
         self.id = f"external-{serial}"
 
 

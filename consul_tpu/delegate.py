@@ -53,6 +53,17 @@ class DelegateServer:
                  host: str = "127.0.0.1", port: int = 0):
         self.oracle = oracle
         self.node_meta = node_meta or {"backend": "tpu-sim"}
+        # gossip-plane encryption (memberlist SecretKey role): when the
+        # oracle's keyring holds keys, every frame on this socket must
+        # be AES-GCM encrypted; rotation via `keyring install/use/
+        # remove` takes effect per-frame (consul_tpu/gossip_crypto.py)
+        from consul_tpu.gossip_crypto import (
+            GossipCodec, oracle_keyring_fn,
+        )
+        if hasattr(oracle, "keyring_list"):
+            self.codec = GossipCodec(oracle_keyring_fn(oracle))
+        else:
+            self.codec = GossipCodec(lambda: (None, []))
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -124,6 +135,7 @@ class DelegateServer:
                                   if x.is_alive()] + [t]
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        from consul_tpu.gossip_crypto import DecryptError
         buf = b""
         try:
             while True:
@@ -135,7 +147,15 @@ class DelegateServer:
                     line, buf = buf.split(b"\n", 1)
                     if not line.strip():
                         continue
-                    conn.sendall(self._handle_line(line) + b"\n")
+                    try:
+                        plain = self.codec.decrypt_line(line)
+                    except DecryptError:
+                        # wrong/missing key: drop the CONNECTION, not
+                        # just the frame — memberlist treats such a
+                        # peer as outside the cluster
+                        return
+                    out = self._handle_line(plain)
+                    conn.sendall(self.codec.encrypt_line(out) + b"\n")
         except OSError:
             pass
         finally:

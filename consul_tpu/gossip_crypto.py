@@ -1,0 +1,116 @@
+"""Gossip-plane payload encryption: the memberlist SecretKey role.
+
+The reference encrypts every gossip packet with AES-GCM keyed from the
+serf keyring (memberlist security.go; agent/keyring.go loads/persists
+the keys; `consul keyring` rotates them).  Rotation is three-phase:
+install the new key everywhere (decrypt-only), `use` it (becomes the
+encrypt key), remove the old one — at every instant each node can
+decrypt traffic encrypted under ANY installed key.
+
+Here the network gossip surface is the delegate socket
+(consul_tpu/delegate.py) — external agents delegating their gossip
+plane to the device pool — plus the user-event payloads that ride it.
+`GossipCodec` implements the same keyring semantics over AES-GCM:
+encrypt under the primary key, decrypt by trying every installed key.
+
+Frame format (one line on the delegate socket):
+
+    ENC:<base64(version(1) | nonce(12) | ciphertext+tag)>
+
+Version 0 is AES-GCM.  Keys are 16/24/32 raw bytes, carried base64
+(the `consul keygen` shape).
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+from typing import List, Optional
+
+from cryptography.exceptions import InvalidTag
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+_VERSION = 0
+PREFIX = b"ENC:"
+
+
+class DecryptError(Exception):
+    """No installed key decrypts this frame (memberlist's
+    'no installed keys could decrypt the message')."""
+
+
+def _decode_key(key_b64: str) -> bytes:
+    raw = base64.b64decode(key_b64)
+    if len(raw) not in (16, 24, 32):
+        raise ValueError(
+            f"gossip key must be 16/24/32 bytes, got {len(raw)}")
+    return raw
+
+
+class GossipCodec:
+    """Encrypt-with-primary / decrypt-with-any over a live keyring.
+
+    `keyring_fn() -> (primary_b64 | None, [installed_b64...])` reads
+    the CURRENT keys per call, so `keyring use`/`install`/`remove`
+    take effect on the next frame with no restart (keyring.go)."""
+
+    def __init__(self, keyring_fn):
+        self.keyring_fn = keyring_fn
+
+    @property
+    def enabled(self) -> bool:
+        primary, _ = self.keyring_fn()
+        return primary is not None
+
+    def encrypt_line(self, line: bytes) -> bytes:
+        primary, _ = self.keyring_fn()
+        if primary is None:
+            return line
+        key = _decode_key(primary)
+        nonce = os.urandom(12)
+        blob = bytes([_VERSION]) + nonce + AESGCM(key).encrypt(
+            nonce, line, None)
+        return PREFIX + base64.b64encode(blob)
+
+    def decrypt_line(self, line: bytes) -> bytes:
+        """Inverse of encrypt_line.  With encryption enabled a
+        plaintext line is REJECTED (memberlist drops unencrypted
+        packets when a keyring is loaded); with it disabled an ENC:
+        frame is rejected too (we couldn't read it)."""
+        primary, installed = self.keyring_fn()
+        if not line.startswith(PREFIX):
+            if primary is not None:
+                raise DecryptError(
+                    "plaintext frame rejected: gossip encryption is "
+                    "enabled")
+            return line
+        if primary is None:
+            raise DecryptError(
+                "encrypted frame but no gossip keys installed")
+        try:
+            blob = base64.b64decode(line[len(PREFIX):])
+        except ValueError:
+            raise DecryptError("malformed encrypted frame")
+        if len(blob) < 1 + 12 + 16 or blob[0] != _VERSION:
+            raise DecryptError("malformed encrypted frame")
+        nonce, ct = blob[1:13], blob[13:]
+        for key_b64 in installed:
+            try:
+                return AESGCM(_decode_key(key_b64)).decrypt(
+                    nonce, ct, None)
+            except (InvalidTag, ValueError):
+                continue
+        raise DecryptError("no installed keys could decrypt the frame")
+
+
+def oracle_keyring_fn(oracle):
+    """Adapter: any oracle exposing keyring_list() → (primary,
+    installed).  Works for GossipOracle AND SegmentedOracle (whose
+    keys live in per-segment pools) — the generic surface is the
+    listing, not private attrs."""
+
+    def fn():
+        keys = oracle.keyring_list()
+        primary = next(iter(keys.get("PrimaryKeys") or {}), None)
+        return primary, list(keys.get("Keys") or {})
+    return fn

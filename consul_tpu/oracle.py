@@ -116,6 +116,13 @@ class GossipOracle:
                         swim.kill(s.swim, 0),
                         self._step(self.params, s)):
                 jax.block_until_ready(out)
+        # the members/down-mask computation is every client's FIRST
+        # read — compile it too (drops the snapshot cache afterwards
+        # so the call is state-accurate later)
+        try:
+            self.members(limit=1)
+        except Exception:
+            pass
 
     # -------------------------------------------------------------- identity
 

@@ -351,16 +351,30 @@ class Manager:
             try:
                 leaf = self.ca.sign_leaf(service)
             except CARateLimitError:
-                if hit is not None:
-                    # serve the stale-but-valid leaf under CSR
+                if hit is not None and self._leaf_still_valid(hit[1]):
+                    # serve the stale-but-VALID leaf under CSR
                     # pressure rather than failing the snapshot
-                    # (the reference's leaf cache behaves the same)
+                    # (the reference's leaf cache behaves the same);
+                    # an expired cert would just move the failure to
+                    # every handshake
                     return hit[1]
                 raise
             ttl_s = self.ca.leaf_ttl_hours * 3600.0
             refresh_at = now + ttl_s * _LEAF_REFRESH_FRACTION
             self._leaves[service] = (active, leaf, refresh_at)
             return leaf
+
+    @staticmethod
+    def _leaf_still_valid(leaf: dict) -> bool:
+        import datetime
+        from cryptography import x509
+        try:
+            cert = x509.load_pem_x509_certificate(
+                leaf["CertPEM"].encode())
+        except Exception:
+            return False
+        return cert.not_valid_after_utc > datetime.datetime.now(
+            datetime.timezone.utc)
 
     def watch(self, proxy_id: str) -> Optional[ProxyState]:
         """ProxyState for a registered connect-proxy service id

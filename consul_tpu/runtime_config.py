@@ -186,6 +186,9 @@ class RuntimeConfig:
     # ports.grpc: the gRPC ADS/xDS listener; -1 disabled (the
     # reference's convention), 0 ephemeral (config GRPCPort)
     grpc_port: int = -1
+    # encrypt: base64 gossip key preloaded into the keyring at boot
+    # (agent/keyring.go loadKeyringFile / config `encrypt`)
+    encrypt: str = ""
     # acl block (agent/config: acl{enabled, default_policy, down_policy,
     # tokens{agent, default}})
     acl_enabled: bool = False
@@ -375,6 +378,15 @@ class Builder:
             if not (chk.get("Name") or chk.get("name")
                     or chk.get("CheckID") or chk.get("id")):
                 raise ConfigError("check definition missing name/id")
+        if m.get("encrypt"):
+            # a malformed gossip key must fail the boot, not silently
+            # wedge the delegate socket later (agent startup validates
+            # the encrypt key the same way)
+            from consul_tpu.gossip_crypto import _decode_key
+            try:
+                _decode_key(str(m["encrypt"]))
+            except (ValueError, TypeError) as e:
+                raise ConfigError(f"invalid encrypt key: {e}")
         for r in m.get("recursors") or []:
             # validate HERE (agent/dns.go:251 stance): a malformed
             # recursor must fail the load/reload atomically, not blow
@@ -399,6 +411,7 @@ class Builder:
             http_port=int(ports.get("http", 0) or 0),
             dns_port=int(ports.get("dns", 0) or 0),
             grpc_port=int(ports.get("grpc", -1)),
+            encrypt=str(m.get("encrypt", "") or ""),
             acl_enabled=bool(acl.get("enabled", False)),
             acl_default_policy=dp,
             acl_down_policy=down,

@@ -16,16 +16,25 @@
 // library on purpose — requests are assembled with minimal escaping and
 // responses are passed through; the point is the wire protocol, not
 // client-side parsing.
+//
+// Gossip encryption: when DELEGATE_ENCRYPT_KEY holds a base64 gossip
+// key (the `consul keygen` shape), every frame is AES-GCM wrapped as
+// ENC:<b64(version|nonce|ct+tag)> — the memberlist SecretKey wire the
+// bridge enforces once its keyring is loaded (gossip_aes.h).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
+
+#include "gossip_aes.h"
 
 static std::string b64(const std::string& in) {
     static const char* t =
@@ -51,6 +60,75 @@ static std::string b64(const std::string& in) {
     }
     return out;
 }
+
+static int b64val(char c) {
+    if (c >= 'A' && c <= 'Z') return c - 'A';
+    if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+    if (c >= '0' && c <= '9') return c - '0' + 52;
+    if (c == '+') return 62;
+    if (c == '/') return 63;
+    return -1;
+}
+
+static bool b64decode(const std::string& in, std::string& out) {
+    int buf = 0, bits = 0;
+    for (char c : in) {
+        if (c == '=' || c == '\n' || c == '\r') continue;
+        int v = b64val(c);
+        if (v < 0) return false;
+        buf = (buf << 6) | v;
+        bits += 6;
+        if (bits >= 8) {
+            bits -= 8;
+            out += (char)((buf >> bits) & 0xff);
+        }
+    }
+    return true;
+}
+
+// ENC: framing (gossip_crypto.py) around one line, both directions
+struct Codec {
+    bool enabled = false;
+    gossipaes::Gcm gcm;
+
+    bool init_from_env() {
+        const char* k = std::getenv("DELEGATE_ENCRYPT_KEY");
+        if (!k || !*k) return true;            // plaintext mode
+        std::string raw;
+        if (!b64decode(k, raw)) return false;
+        if (!gcm.init((const uint8_t*)raw.data(), raw.size()))
+            return false;
+        enabled = true;
+        return true;
+    }
+
+    bool seal(const std::string& line, std::string& out) const {
+        if (!enabled) { out = line; return true; }
+        uint8_t nonce[12];
+        int fd = open("/dev/urandom", O_RDONLY);
+        if (fd < 0 || read(fd, nonce, 12) != 12) {
+            if (fd >= 0) close(fd);
+            return false;
+        }
+        close(fd);
+        std::string blob("\0", 1);             // version 0
+        blob.append((const char*)nonce, 12);
+        blob += gcm.encrypt(nonce, line);
+        out = "ENC:" + b64(blob);
+        return true;
+    }
+
+    bool open_frame(const std::string& frame, std::string& out) const {
+        if (!enabled) { out = frame; return true; }
+        if (frame.rfind("ENC:", 0) != 0) return false;
+        std::string blob;
+        if (!b64decode(frame.substr(4), blob)) return false;
+        if (blob.size() < 1 + 12 + 16 || blob[0] != 0) return false;
+        uint8_t nonce[12];
+        std::memcpy(nonce, blob.data() + 1, 12);
+        return gcm.decrypt(nonce, blob.substr(13), out);
+    }
+};
 
 int main(int argc, char** argv) {
     if (argc < 3) {
@@ -93,6 +171,21 @@ int main(int argc, char** argv) {
         std::fprintf(stderr, "unknown command %s\n", cmd.c_str());
         return 2;
     }
+    Codec codec;
+    if (!codec.init_from_env()) {
+        std::fprintf(stderr,
+                     "invalid DELEGATE_ENCRYPT_KEY (want base64 "
+                     "16/24/32-byte key)\n");
+        return 2;
+    }
+    {
+        std::string sealed;
+        if (!codec.seal(req, sealed)) {
+            std::fprintf(stderr, "frame encryption failed\n");
+            return 1;
+        }
+        req = sealed;
+    }
     req += "\n";
 
     int fd = socket(AF_INET, SOCK_STREAM, 0);
@@ -123,7 +216,19 @@ int main(int argc, char** argv) {
     close(fd);
     size_t nl = resp.find('\n');
     if (nl != std::string::npos) resp.resize(nl);
-    std::printf("%s\n", resp.c_str());
+    if (resp.empty()) {
+        // the bridge answers every well-formed frame; silence means it
+        // dropped us (encryption mismatch or server gone)
+        std::fprintf(stderr,
+                     "bridge dropped the connection (key mismatch?)\n");
+        return 1;
+    }
+    std::string plain;
+    if (!codec.open_frame(resp, plain)) {
+        std::fprintf(stderr, "could not decrypt bridge response\n");
+        return 1;
+    }
+    std::printf("%s\n", plain.c_str());
     // exit 1 when the bridge reported an error
-    return resp.find("\"error\"") != std::string::npos ? 1 : 0;
+    return plain.find("\"error\"") != std::string::npos ? 1 : 0;
 }

@@ -1,0 +1,224 @@
+// Self-contained AES-GCM for the native delegate client — the
+// memberlist SecretKey wire (consul_tpu/gossip_crypto.py frame format:
+// "ENC:" + base64(version(1)|nonce(12)|ciphertext+tag(16))).
+//
+// No OpenSSL in the image, so this is a from-the-spec implementation
+// (FIPS 197 AES encrypt path + NIST SP 800-38D GCM with 12-byte IVs).
+// Bit-serial GF(2^128) GHASH: slow but frames are tiny and the client
+// is a test/CLI tool, not a data plane.  Cross-validated against the
+// Python AESGCM codec by the delegate round-trip tests.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace gossipaes {
+
+static const uint8_t SBOX[256] = {
+    0x63,0x7c,0x77,0x7b,0xf2,0x6b,0x6f,0xc5,0x30,0x01,0x67,0x2b,
+    0xfe,0xd7,0xab,0x76,0xca,0x82,0xc9,0x7d,0xfa,0x59,0x47,0xf0,
+    0xad,0xd4,0xa2,0xaf,0x9c,0xa4,0x72,0xc0,0xb7,0xfd,0x93,0x26,
+    0x36,0x3f,0xf7,0xcc,0x34,0xa5,0xe5,0xf1,0x71,0xd8,0x31,0x15,
+    0x04,0xc7,0x23,0xc3,0x18,0x96,0x05,0x9a,0x07,0x12,0x80,0xe2,
+    0xeb,0x27,0xb2,0x75,0x09,0x83,0x2c,0x1a,0x1b,0x6e,0x5a,0xa0,
+    0x52,0x3b,0xd6,0xb3,0x29,0xe3,0x2f,0x84,0x53,0xd1,0x00,0xed,
+    0x20,0xfc,0xb1,0x5b,0x6a,0xcb,0xbe,0x39,0x4a,0x4c,0x58,0xcf,
+    0xd0,0xef,0xaa,0xfb,0x43,0x4d,0x33,0x85,0x45,0xf9,0x02,0x7f,
+    0x50,0x3c,0x9f,0xa8,0x51,0xa3,0x40,0x8f,0x92,0x9d,0x38,0xf5,
+    0xbc,0xb6,0xda,0x21,0x10,0xff,0xf3,0xd2,0xcd,0x0c,0x13,0xec,
+    0x5f,0x97,0x44,0x17,0xc4,0xa7,0x7e,0x3d,0x64,0x5d,0x19,0x73,
+    0x60,0x81,0x4f,0xdc,0x22,0x2a,0x90,0x88,0x46,0xee,0xb8,0x14,
+    0xde,0x5e,0x0b,0xdb,0xe0,0x32,0x3a,0x0a,0x49,0x06,0x24,0x5c,
+    0xc2,0xd3,0xac,0x62,0x91,0x95,0xe4,0x79,0xe7,0xc8,0x37,0x6d,
+    0x8d,0xd5,0x4e,0xa9,0x6c,0x56,0xf4,0xea,0x65,0x7a,0xae,0x08,
+    0xba,0x78,0x25,0x2e,0x1c,0xa6,0xb4,0xc6,0xe8,0xdd,0x74,0x1f,
+    0x4b,0xbd,0x8b,0x8a,0x70,0x3e,0xb5,0x66,0x48,0x03,0xf6,0x0e,
+    0x61,0x35,0x57,0xb9,0x86,0xc1,0x1d,0x9e,0xe1,0xf8,0x98,0x11,
+    0x69,0xd9,0x8e,0x94,0x9b,0x1e,0x87,0xe9,0xce,0x55,0x28,0xdf,
+    0x8c,0xa1,0x89,0x0d,0xbf,0xe6,0x42,0x68,0x41,0x99,0x2d,0x0f,
+    0xb0,0x54,0xbb,0x16};
+
+static const uint8_t RCON[11] = {0x00, 0x01, 0x02, 0x04, 0x08, 0x10,
+                                 0x20, 0x40, 0x80, 0x1b, 0x36};
+
+struct Aes {
+    // round keys: up to 15 rounds * 16 bytes
+    uint8_t rk[15 * 16];
+    int rounds;
+
+    // FIPS 197 §5.2 key expansion; key_len in {16, 24, 32}
+    bool init(const uint8_t* key, size_t key_len) {
+        int nk = (int)key_len / 4;
+        if (nk != 4 && nk != 6 && nk != 8) return false;
+        rounds = nk + 6;
+        int total_words = 4 * (rounds + 1);
+        uint8_t* w = rk;
+        std::memcpy(w, key, key_len);
+        for (int i = nk; i < total_words; i++) {
+            uint8_t t[4];
+            std::memcpy(t, w + 4 * (i - 1), 4);
+            if (i % nk == 0) {
+                uint8_t tmp = t[0];           // RotWord
+                t[0] = SBOX[t[1]] ^ RCON[i / nk];
+                t[1] = SBOX[t[2]];
+                t[2] = SBOX[t[3]];
+                t[3] = SBOX[tmp];
+            } else if (nk == 8 && i % nk == 4) {
+                for (int j = 0; j < 4; j++) t[j] = SBOX[t[j]];
+            }
+            for (int j = 0; j < 4; j++)
+                w[4 * i + j] = w[4 * (i - nk) + j] ^ t[j];
+        }
+        return true;
+    }
+
+    static uint8_t xtime(uint8_t x) {
+        return (uint8_t)((x << 1) ^ ((x >> 7) * 0x1b));
+    }
+
+    // encrypt one 16-byte block in place (FIPS 197 §5.1)
+    void encrypt_block(uint8_t s[16]) const {
+        auto add_rk = [&](int r) {
+            for (int i = 0; i < 16; i++) s[i] ^= rk[16 * r + i];
+        };
+        auto sub_shift = [&]() {
+            uint8_t t[16];
+            // SubBytes + ShiftRows fused (column-major state layout:
+            // byte i is row i%4, col i/4)
+            for (int c = 0; c < 4; c++)
+                for (int r = 0; r < 4; r++)
+                    t[4 * c + r] = SBOX[s[4 * ((c + r) % 4) + r]];
+            std::memcpy(s, t, 16);
+        };
+        add_rk(0);
+        for (int round = 1; round < rounds; round++) {
+            sub_shift();
+            for (int c = 0; c < 4; c++) {        // MixColumns
+                uint8_t* col = s + 4 * c;
+                uint8_t a0 = col[0], a1 = col[1], a2 = col[2],
+                        a3 = col[3];
+                uint8_t all = (uint8_t)(a0 ^ a1 ^ a2 ^ a3);
+                col[0] = (uint8_t)(a0 ^ all ^ xtime((uint8_t)(a0 ^ a1)));
+                col[1] = (uint8_t)(a1 ^ all ^ xtime((uint8_t)(a1 ^ a2)));
+                col[2] = (uint8_t)(a2 ^ all ^ xtime((uint8_t)(a2 ^ a3)));
+                col[3] = (uint8_t)(a3 ^ all ^ xtime((uint8_t)(a3 ^ a0)));
+            }
+            add_rk(round);
+        }
+        sub_shift();
+        add_rk(rounds);
+    }
+};
+
+// GF(2^128) multiply, bit-serial (SP 800-38D §6.3)
+inline void gf_mult(const uint8_t X[16], const uint8_t Y[16],
+                    uint8_t out[16]) {
+    uint8_t V[16], Z[16] = {0};
+    std::memcpy(V, Y, 16);
+    for (int i = 0; i < 128; i++) {
+        if ((X[i / 8] >> (7 - i % 8)) & 1)
+            for (int j = 0; j < 16; j++) Z[j] ^= V[j];
+        int lsb = V[15] & 1;
+        for (int j = 15; j > 0; j--)
+            V[j] = (uint8_t)((V[j] >> 1) | (V[j - 1] << 7));
+        V[0] >>= 1;
+        if (lsb) V[0] ^= 0xe1;
+    }
+    std::memcpy(out, Z, 16);
+}
+
+struct Gcm {
+    Aes aes;
+    uint8_t H[16];
+
+    bool init(const uint8_t* key, size_t key_len) {
+        if (!aes.init(key, key_len)) return false;
+        std::memset(H, 0, 16);
+        aes.encrypt_block(H);
+        return true;
+    }
+
+    static void inc32(uint8_t b[16]) {
+        for (int i = 15; i >= 12; i--)
+            if (++b[i]) break;
+    }
+
+    void ghash(const uint8_t* data, size_t len, uint8_t Y[16]) const {
+        for (size_t off = 0; off < len; off += 16) {
+            uint8_t block[16] = {0};
+            size_t n = len - off < 16 ? len - off : 16;
+            std::memcpy(block, data + off, n);
+            for (int j = 0; j < 16; j++) Y[j] ^= block[j];
+            uint8_t t[16];
+            gf_mult(Y, H, t);
+            std::memcpy(Y, t, 16);
+        }
+    }
+
+    void tag_for(const uint8_t j0[16], const std::string& ct,
+                 uint8_t tag[16]) const {
+        uint8_t Y[16] = {0};
+        ghash((const uint8_t*)ct.data(), ct.size(), Y);
+        uint8_t lens[16] = {0};                 // len(A)=0 || len(C)
+        uint64_t cbits = (uint64_t)ct.size() * 8;
+        for (int i = 0; i < 8; i++)
+            lens[15 - i] = (uint8_t)(cbits >> (8 * i));
+        for (int j = 0; j < 16; j++) Y[j] ^= lens[j];
+        uint8_t t[16];
+        gf_mult(Y, H, t);
+        uint8_t ek[16];
+        std::memcpy(ek, j0, 16);
+        aes.encrypt_block(ek);
+        for (int j = 0; j < 16; j++) tag[j] = t[j] ^ ek[j];
+    }
+
+    void ctr(const uint8_t j0[16], const std::string& in,
+             std::string& out) const {
+        uint8_t ctr_block[16];
+        std::memcpy(ctr_block, j0, 16);
+        out.resize(in.size());
+        for (size_t off = 0; off < in.size(); off += 16) {
+            inc32(ctr_block);
+            uint8_t ks[16];
+            std::memcpy(ks, ctr_block, 16);
+            aes.encrypt_block(ks);
+            size_t n = in.size() - off < 16 ? in.size() - off : 16;
+            for (size_t j = 0; j < n; j++)
+                out[off + j] = (char)(in[off + j] ^ ks[j]);
+        }
+    }
+
+    // nonce must be 12 bytes; returns ciphertext||tag
+    std::string encrypt(const uint8_t nonce[12],
+                        const std::string& plain) const {
+        uint8_t j0[16] = {0};
+        std::memcpy(j0, nonce, 12);
+        j0[15] = 1;
+        std::string ct;
+        ctr(j0, plain, ct);
+        uint8_t tag[16];
+        tag_for(j0, ct, tag);
+        return ct + std::string((const char*)tag, 16);
+    }
+
+    // in = ciphertext||tag; false on tag mismatch
+    bool decrypt(const uint8_t nonce[12], const std::string& in,
+                 std::string& plain) const {
+        if (in.size() < 16) return false;
+        std::string ct = in.substr(0, in.size() - 16);
+        uint8_t j0[16] = {0};
+        std::memcpy(j0, nonce, 12);
+        j0[15] = 1;
+        uint8_t want[16];
+        tag_for(j0, ct, want);
+        uint8_t diff = 0;
+        for (int i = 0; i < 16; i++)
+            diff |= (uint8_t)(want[i] ^ (uint8_t)in[ct.size() + i]);
+        if (diff) return false;
+        ctr(j0, ct, plain);
+        return true;
+    }
+};
+
+}  // namespace gossipaes

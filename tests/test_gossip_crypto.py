@@ -1,0 +1,217 @@
+"""Gossip-plane encryption: keyring keys protect the delegate socket.
+
+VERDICT r2 missing #6 / next #9.  Reference: memberlist SecretKey
+(security.go AES-GCM packet encryption), agent/keyring.go (load /
+install / use / remove), three-phase rotation where every node can
+decrypt under any installed key.
+"""
+
+import base64
+import json
+import os
+import socket
+
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.delegate import DelegateServer
+from consul_tpu.gossip_crypto import DecryptError, GossipCodec
+from consul_tpu.oracle import GossipOracle
+
+K1 = base64.b64encode(b"0123456789abcdef").decode()          # 16B
+K2 = base64.b64encode(os.urandom(32)).decode()               # 32B
+
+
+# ----------------------------------------------------------------- codec
+
+def test_codec_roundtrip_and_wrong_key():
+    ring = {"primary": K1, "keys": [K1]}
+    codec = GossipCodec(lambda: (ring["primary"], ring["keys"]))
+    frame = codec.encrypt_line(b'{"id":1}')
+    assert frame.startswith(b"ENC:")
+    assert codec.decrypt_line(frame) == b'{"id":1}'
+    # another keyring cannot read it
+    other = GossipCodec(lambda: (K2, [K2]))
+    with pytest.raises(DecryptError):
+        other.decrypt_line(frame)
+    # plaintext rejected while enabled
+    with pytest.raises(DecryptError):
+        codec.decrypt_line(b'{"id":2}')
+    # disabled codec passes plaintext, rejects ciphertext
+    off = GossipCodec(lambda: (None, []))
+    assert off.decrypt_line(b"plain") == b"plain"
+    with pytest.raises(DecryptError):
+        off.decrypt_line(frame)
+
+
+def test_codec_three_phase_rotation():
+    """install k2 (decrypt-only) -> use k2 -> remove k1: frames under
+    the outgoing key stay readable until it's removed."""
+    ring = {"primary": K1, "keys": [K1]}
+    codec = GossipCodec(lambda: (ring["primary"], ring["keys"]))
+    old_frame = codec.encrypt_line(b"old")
+    ring["keys"] = [K1, K2]                      # install
+    assert codec.decrypt_line(old_frame) == b"old"
+    ring["primary"] = K2                         # use
+    new_frame = codec.encrypt_line(b"new")
+    assert codec.decrypt_line(old_frame) == b"old"   # still readable
+    assert codec.decrypt_line(new_frame) == b"new"
+    ring["keys"] = [K2]                          # remove old
+    with pytest.raises(DecryptError):
+        codec.decrypt_line(old_frame)
+    assert codec.decrypt_line(new_frame) == b"new"
+
+
+def test_bad_key_length_rejected():
+    bad = base64.b64encode(b"short").decode()
+    codec = GossipCodec(lambda: (bad, [bad]))
+    with pytest.raises(ValueError):
+        codec.encrypt_line(b"x")
+
+
+# -------------------------------------------------------- delegate socket
+
+@pytest.fixture(scope="module")
+def oracle():
+    o = GossipOracle(GossipConfig.lan(),
+                     SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0,
+                               seed=71))
+    yield o
+
+
+def _call_raw(addr, codec, method, params=None, rid=1):
+    line = json.dumps({"id": rid, "method": method,
+                       "params": params or {}}).encode()
+    with socket.create_connection(addr, timeout=10) as s:
+        s.sendall(codec.encrypt_line(line) + b"\n")
+        s.settimeout(10)
+        buf = b""
+        while b"\n" not in buf:
+            chunk = s.recv(65536)
+            if not chunk:
+                return None                       # server dropped us
+            buf += chunk
+    return json.loads(codec.decrypt_line(buf.split(b"\n")[0]))
+
+
+def test_delegate_socket_encrypted_end_to_end(oracle):
+    oracle.keyring_install(K1)
+    try:
+        srv = DelegateServer(oracle)
+        srv.start(warmup=False)
+        try:
+            codec = GossipCodec(lambda: (K1, [K1]))
+            out = _call_raw(srv.address, codec, "members",
+                            {"limit": 3})
+            assert len(out["result"]) == 3
+
+            # plaintext client: dropped without an answer
+            plain = GossipCodec(lambda: (None, []))
+            assert _call_raw(srv.address, plain, "ping") is None
+
+            # wrong-key client: dropped too
+            wrong = GossipCodec(lambda: (K2, [K2]))
+            assert _call_raw(srv.address, wrong, "ping") is None
+        finally:
+            srv.stop()
+    finally:
+        # reset keyring for other tests sharing the oracle
+        oracle.keyring_install(K2)
+        oracle.keyring_use(K2)
+        oracle.keyring_remove(K1)
+        oracle._primary_key = None
+        oracle._keyring.clear()
+
+
+def test_delegate_rotation_live(oracle):
+    """Keys rotated through the oracle keyring take effect per-frame
+    on the live socket — no bridge restart."""
+    oracle.keyring_install(K1)
+    srv = DelegateServer(oracle)
+    srv.start(warmup=False)
+    try:
+        c1 = GossipCodec(lambda: (K1, [K1]))
+        assert _call_raw(srv.address, c1, "ping")["result"]
+        oracle.keyring_install(K2)
+        oracle.keyring_use(K2)
+        # old key still decrypts inbound (installed), server answers
+        # under the NEW primary — a both-keys client keeps working
+        both = GossipCodec(lambda: (K1, [K1, K2]))
+        assert _call_raw(srv.address, both, "ping")["result"]
+        oracle.keyring_remove(K1)
+        # now the old-key-only client is out of the cluster
+        assert _call_raw(srv.address, c1, "ping") is None
+        c2 = GossipCodec(lambda: (K2, [K2]))
+        assert _call_raw(srv.address, c2, "ping")["result"]
+    finally:
+        srv.stop()
+        oracle._primary_key = None
+        oracle._keyring.clear()
+
+
+def test_agent_encrypt_config(tmp_path):
+    from consul_tpu.agent import Agent
+    cfg = tmp_path / "a.json"
+    cfg.write_text(json.dumps({
+        "encrypt": K1,
+        "sim": {"n_nodes": 8, "rumor_slots": 8},
+    }))
+    a = Agent.from_config(config_files=[str(cfg)])
+    try:
+        keys = a.oracle.keyring_list()
+        assert K1 in keys["Keys"]
+        assert K1 in keys["PrimaryKeys"]
+    finally:
+        pass  # never started; nothing to stop
+
+
+# ------------------------------------------------ native C++ interop
+
+def test_native_client_speaks_encrypted_frames(oracle, tmp_path):
+    """The C++ delegate client's from-spec AES-GCM interoperates with
+    the Python codec over the live encrypted bridge."""
+    import subprocess
+    src = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "native", "delegate_client.cpp")
+    exe = str(tmp_path / "delegate_client")
+    try:
+        subprocess.run(["g++", "-O2", "-std=c++17", "-o", exe, src],
+                       check=True, capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, OSError) as e:
+        pytest.skip(f"no native toolchain: {e}")
+
+    oracle.keyring_install(K1)
+    srv = DelegateServer(oracle)
+    srv.start(warmup=False)
+    try:
+        env = dict(os.environ, DELEGATE_ENCRYPT_KEY=K1)
+        out = subprocess.run([exe, str(srv.port), "ping"],
+                             capture_output=True, timeout=30, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert b"tick" in out.stdout
+
+        # 32-byte key too (AES-256 path)
+        oracle.keyring_install(K2)
+        oracle.keyring_use(K2)
+        env = dict(os.environ, DELEGATE_ENCRYPT_KEY=K2)
+        out = subprocess.run([exe, str(srv.port), "members", "3"],
+                             capture_output=True, timeout=30, env=env)
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert b"Name" in out.stdout
+
+        # wrong key: loud failure, not silence
+        env = dict(os.environ, DELEGATE_ENCRYPT_KEY=base64.b64encode(
+            os.urandom(16)).decode())
+        out = subprocess.run([exe, str(srv.port), "ping"],
+                             capture_output=True, timeout=30, env=env)
+        assert out.returncode == 1
+        assert b"key mismatch" in out.stderr
+
+        # plaintext client against encrypted bridge: loud failure
+        out = subprocess.run([exe, str(srv.port), "ping"],
+                             capture_output=True, timeout=30)
+        assert out.returncode != 0
+    finally:
+        srv.stop()
+        oracle._primary_key = None
+        oracle._keyring.clear()
