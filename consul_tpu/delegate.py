@@ -155,7 +155,13 @@ class DelegateServer:
                         # peer as outside the cluster
                         return
                     out = self._handle_line(plain)
-                    conn.sendall(self.codec.encrypt_line(out) + b"\n")
+                    try:
+                        frame = self.codec.encrypt_line(out)
+                    except ValueError:
+                        # malformed primary key mid-rotation: a
+                        # controlled drop, not a thread traceback
+                        return
+                    conn.sendall(frame + b"\n")
         except OSError:
             pass
         finally:

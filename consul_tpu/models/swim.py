@@ -114,9 +114,11 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         suspicion_min_ticks=gossip.suspicion_min_ticks(n),
         suspicion_max_ticks=gossip.suspicion_max_ticks(n),
         confirm_k=gossip.confirm_k(),
-        # clamp: top_k(k=alloc_cap) over [N] wants — tiny pools (e.g.
-        # per-segment sims) must not exceed their own node count
-        alloc_cap=min(sim.alloc_cap, sim.n_nodes),
+        # clamp: top_k(k=alloc_cap) runs over [N] wants AND [U] free
+        # slots — tiny pools (e.g.
+        # per-segment sims) must not exceed their own node count, and
+        # the free-slot top_k must not exceed the slot table
+        alloc_cap=min(sim.alloc_cap, sim.n_nodes, sim.rumor_slots),
         expiry_gossip_ticks=spread,
         expiry_suspect_ticks=gossip.suspicion_max_ticks(n) + spread,
         p_loss=sim.p_loss,
@@ -338,6 +340,28 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
     """
     a = params.alloc_cap
     u = params.rumor_slots
+    # Pressure eviction (memberlist's broadcast queue drops the most-
+    # retransmitted broadcasts on overflow, lib/serf/serf.go:20-24):
+    # when demand exceeds the free slots, release slots that are
+    # already fully disseminated (>=99.5% of live members carry them)
+    # ahead of their nominal lifetime.  Commit bookkeeping runs
+    # exactly as at natural expiry.  SUSPECT slots are NEVER evicted:
+    # a suspicion must live out its timeout to convert to dead —
+    # evicting a fully-covered suspect would reset its per-holder
+    # timers on reallocation and livelock the whole table.
+    demand = jnp.sum(want_score > 0)
+    free = jnp.sum(~s.r_active)
+
+    def evict(st):
+        live = st.up & st.member
+        n_live = jnp.maximum(jnp.sum(live), 1)
+        coverage = jnp.sum(st.know & live[:, None],
+                           axis=0).astype(jnp.float32) / n_live
+        done = st.r_active & (coverage >= 0.995) \
+            & (st.r_kind != SUSPECT)
+        return _release(st, done, coverage)
+
+    s = jax.lax.cond(demand > free, evict, lambda st: st, s)
     score, subjects = jax.lax.top_k(want_score, a)
     free_score, slots = jax.lax.top_k(jnp.where(s.r_active, 0, 1) *
                                       (u - jnp.arange(u, dtype=jnp.int32)), a)
@@ -602,6 +626,14 @@ def _expire(params: SwimParams, s: SwimState) -> SwimState:
                        axis=0).astype(jnp.float32) / n_live      # [U]
     done = s.r_active & (age >= life) \
         & ((coverage >= 0.995) | (age >= 4 * life))
+    return _release(s, done, coverage)
+
+
+def _release(s: SwimState, done: jnp.ndarray,
+             coverage: jnp.ndarray) -> SwimState:
+    """Free the `done` slots, committing beliefs a majority heard
+    (shared by natural expiry and pressure eviction — the commit rules
+    must be identical on both paths)."""
     commit_ok = coverage >= 0.5
     commit_dead = done & (s.r_kind == DEAD) & commit_ok
     commit_left = done & (s.r_kind == LEFT) & commit_ok
@@ -670,6 +702,45 @@ def run(params: SwimParams, s: SwimState, n_ticks: int,
 # ---------------------------------------------------------------------------
 # fault injection / membership control (ground truth)
 # ---------------------------------------------------------------------------
+
+def kill_mask(s: SwimState, mask: jnp.ndarray) -> SwimState:
+    """Correlated failure: every node in `mask` ([N] bool) crashes in
+    the same tick — the rack-scale event that pressures the rumor
+    table (SURVEY §5.3; a single kill() never exercises slot
+    contention)."""
+    return s.replace(up=s.up & ~mask)
+
+
+def mass_detection_stats(params: SwimParams, s: SwimState,
+                         victim_mask: jnp.ndarray):
+    """(recall, false_positives) for a correlated-failure experiment.
+
+    A subject counts as cluster-detected when its death is committed
+    OR an active dead/left rumor for it reaches >=99% of live members
+    — the same thresholds the convergence bench uses, but evaluated
+    for EVERY victim at once in rumor space (an [N, V] belief matrix
+    would be O(N^2) at 1M nodes).
+
+      recall          fraction of victims cluster-detected
+      false_positives live members the cluster believes down
+    """
+    n = params.n_nodes
+    live = s.up & s.member
+    n_live = jnp.maximum(jnp.sum(live), 1)
+    coverage = jnp.sum(s.know & live[:, None],
+                       axis=0).astype(jnp.float32) / n_live       # [U]
+    dead_sl = s.r_active & ((s.r_kind == DEAD) | (s.r_kind == LEFT)) \
+        & (coverage >= 0.99)
+    rumor_detected = jnp.zeros((n,), bool).at[
+        jnp.where(dead_sl, s.r_subject, 0)].max(dead_sl)
+    believed_down = s.committed_dead | s.committed_left \
+        | rumor_detected
+    victims = victim_mask & s.member
+    recall = jnp.sum(believed_down & victims) / \
+        jnp.maximum(jnp.sum(victims), 1)
+    false_pos = jnp.sum(believed_down & live)
+    return recall, false_pos
+
 
 def kill(s: SwimState, node: int) -> SwimState:
     """Crash a node (fail-stop).  The detector must discover this."""

@@ -117,12 +117,13 @@ class GossipOracle:
                         self._step(self.params, s)):
                 jax.block_until_ready(out)
         # the members/down-mask computation is every client's FIRST
-        # read — compile it too (drops the snapshot cache afterwards
-        # so the call is state-accurate later)
+        # read — compile it too, then drop the snapshot it cached so
+        # later reads re-evaluate against current state
         try:
             self.members(limit=1)
         except Exception:
             pass
+        self.__dict__.pop("_member_snap", None)
 
     # -------------------------------------------------------------- identity
 
@@ -393,6 +394,11 @@ class GossipOracle:
                     "NumNodes": self.sim.n_nodes}
 
     def keyring_install(self, key: str) -> None:
+        # validate BEFORE storing: a malformed key that became primary
+        # would wedge the delegate socket (no client could ever form a
+        # frame the codec accepts) — same check as boot-time `encrypt`
+        from consul_tpu.gossip_crypto import _decode_key
+        _decode_key(key)
         with self._lock:
             if key not in self._keyring:
                 self._keyring.append(key)

@@ -1,0 +1,84 @@
+"""Rumor-table saturation under correlated failure.
+
+VERDICT r2 weak #3 / next #4: with U slots and alloc_cap per probe
+round, killing many nodes at once must still converge — the pressure
+eviction policy (swim._originate, memberlist broadcast-queue overflow
+semantics) releases fully-disseminated slots early instead of starving
+new suspicions behind them.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from consul_tpu import GossipConfig, SimConfig, swim
+
+
+def _params(n=512, slots=8):
+    return swim.make_params(
+        GossipConfig.lan(),
+        SimConfig(n_nodes=n, rumor_slots=slots, p_loss=0.0, seed=13))
+
+
+def test_mass_kill_exceeding_slot_table_converges():
+    """Kill 4x more nodes than rumor slots in one tick: every death
+    must still commit (slot recycling + pressure eviction)."""
+    params = _params(n=512, slots=8)
+    s = swim.init_state(params)
+    s, _ = swim.run(params, s, 25)
+    rng = np.random.default_rng(3)
+    victims = rng.choice(512, size=32, replace=False)
+    mask = np.zeros((512,), bool)
+    mask[victims] = True
+    mask_d = jnp.asarray(mask)
+    s = swim.kill_mask(s, mask_d)
+    rec = 0.0
+    for _ in range(40):
+        s, _ = swim.run(params, s, 100)
+        rec, fp = swim.mass_detection_stats(params, s, mask_d)
+        if float(rec) >= 0.999:
+            break
+    assert float(rec) >= 0.999, f"recall stalled at {float(rec):.3f}"
+    assert int(fp) == 0, f"{int(fp)} live nodes believed down"
+    # and the ground-truth commit bits agree
+    committed = np.asarray(s.committed_dead)
+    assert committed[victims].all()
+
+
+def test_pressure_eviction_preserves_commit_rules():
+    """Eviction only releases fully-covered slots; a rumor that has
+    NOT spread keeps its slot (no premature commit of unheard
+    beliefs)."""
+    params = _params(n=256, slots=4)
+    s = swim.init_state(params)
+    s, _ = swim.run(params, s, 25)
+    # kill slots+4 nodes: demand will exceed the table repeatedly
+    rng = np.random.default_rng(5)
+    victims = rng.choice(256, size=8, replace=False)
+    mask = np.zeros((256,), bool)
+    mask[victims] = True
+    s = swim.kill_mask(s, jnp.asarray(mask))
+    saw_full_table = False
+    for _ in range(60):
+        s, _ = swim.run(params, s, 50)
+        if int(jnp.sum(s.r_active)) == 4:
+            saw_full_table = True
+        rec, fp = swim.mass_detection_stats(params, s,
+                                            jnp.asarray(mask))
+        assert int(fp) == 0
+        if float(rec) >= 0.999:
+            break
+    assert float(rec) >= 0.999
+    assert saw_full_table, "table never saturated; test too weak"
+
+
+def test_single_victim_path_unchanged():
+    """The pressure path must not perturb the single-victim bench
+    behavior (no eviction triggers when the table is idle)."""
+    params = _params(n=1024, slots=16)
+    s = swim.init_state(params)
+    s, _ = swim.run(params, s, 25)
+    s = swim.kill(s, 123)
+    s, frac = swim.run(params, s, 600, 123)
+    frac = np.asarray(frac)
+    assert frac[-1] >= 0.99
+    assert int(np.argmax(frac > 0.99)) < 300

@@ -116,9 +116,6 @@ def test_delegate_socket_encrypted_end_to_end(oracle):
             srv.stop()
     finally:
         # reset keyring for other tests sharing the oracle
-        oracle.keyring_install(K2)
-        oracle.keyring_use(K2)
-        oracle.keyring_remove(K1)
         oracle._primary_key = None
         oracle._keyring.clear()
 

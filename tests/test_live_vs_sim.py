@@ -1,0 +1,71 @@
+"""Live-vs-sim detection-latency validation (SURVEY §7.6, VERDICT #5).
+
+A real multi-agent UDP pool (tools/live_swim.py) and the device
+simulator run the same GossipConfig tuning at the same N; one crash
+each; the sim's detection-time quantiles must land within a band of
+the live pool's.  The live pool uses wall-clock timers, so this test
+runs tens of seconds by design.
+"""
+
+import sys
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, ".")
+
+from consul_tpu import GossipConfig, SimConfig, swim  # noqa: E402
+from tools.live_swim import start_pool  # noqa: E402
+
+N = 24
+BAND = (0.3, 3.0)
+
+
+def test_live_and_sim_agree_on_detection_latency():
+    cfg = GossipConfig.lan()
+    agents = start_pool(N, cfg, seed=9)
+    try:
+        time.sleep(3.0)
+        victim = agents[N // 2]
+        t0 = time.time()
+        victim.crash()
+        survivors = [a for a in agents if a is not victim]
+        deadline = t0 + 90
+        while time.time() < deadline:
+            if all(victim.name in a.death_observed
+                   for a in survivors):
+                break
+            time.sleep(0.25)
+        lat = sorted(a.death_observed[victim.name] - t0
+                     for a in survivors
+                     if victim.name in a.death_observed)
+    finally:
+        for a in agents:
+            try:
+                a.stop()
+            except OSError:
+                pass
+    assert len(lat) == len(survivors), \
+        f"live pool detected only {len(lat)}/{len(survivors)}"
+    live_t50 = lat[len(lat) // 2]
+    live_t99 = lat[-1]
+
+    params = swim.make_params(cfg, SimConfig(
+        n_nodes=N, rumor_slots=16, p_loss=0.0, seed=9))
+    s = swim.init_state(params)
+    s, _ = swim.run(params, s, 25)
+    s = swim.kill(s, N // 2)
+    s, frac = swim.run(params, s, 1024, N // 2)
+    frac = np.asarray(frac)
+    assert frac[-1] >= 0.99
+
+    tick_s = cfg.gossip_interval
+    sim_t50 = (np.argmax(frac >= 0.5) + 1) * tick_s
+    sim_t99 = (np.argmax(frac >= 0.99) + 1) * tick_s
+    for sim_q, live_q, name in ((sim_t50, live_t50, "t50"),
+                                (sim_t99, live_t99, "t99")):
+        ratio = sim_q / live_q
+        assert BAND[0] <= ratio <= BAND[1], (
+            f"{name}: sim {sim_q:.1f}s vs live {live_q:.1f}s "
+            f"(ratio {ratio:.2f} outside {BAND})")

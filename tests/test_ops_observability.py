@@ -224,18 +224,26 @@ def test_keyring_lifecycle_http():
             return json.loads(
                 urllib.request.urlopen(req, timeout=30).read() or b"null")
 
-        call("POST", {"Key": "k1=="})
-        call("POST", {"Key": "k2=="})
+        import base64
+        k1 = base64.b64encode(b"0123456789abcdef").decode()
+        k2 = base64.b64encode(b"fedcba9876543210").decode()
+        call("POST", {"Key": k1})
+        call("POST", {"Key": k2})
         rings = call("GET")
-        assert set(rings[0]["Keys"]) == {"k1==", "k2=="}
-        assert list(rings[0]["PrimaryKeys"]) == ["k1=="]
-        call("PUT", {"Key": "k2=="})           # use
-        assert list(call("GET")[0]["PrimaryKeys"]) == ["k2=="]
-        call("DELETE", {"Key": "k1=="})
-        assert set(call("GET")[0]["Keys"]) == {"k2=="}
+        assert set(rings[0]["Keys"]) == {k1, k2}
+        assert list(rings[0]["PrimaryKeys"]) == [k1]
+        call("PUT", {"Key": k2})               # use
+        assert list(call("GET")[0]["PrimaryKeys"]) == [k2]
+        call("DELETE", {"Key": k1})
+        assert set(call("GET")[0]["Keys"]) == {k2}
         # removing the primary key is refused
         with pytest.raises(urllib.error.HTTPError) as e:
-            call("DELETE", {"Key": "k2=="})
+            call("DELETE", {"Key": k2})
+        assert e.value.code == 400
+        # a malformed key is refused at install (it would wedge the
+        # encrypted delegate socket if it ever became primary)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call("POST", {"Key": "bogus!"})
         assert e.value.code == 400
     finally:
         a.stop()

