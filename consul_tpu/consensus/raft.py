@@ -186,6 +186,11 @@ class RaftNode:
         self._leader_observers: List[Callable[[bool], None]] = []
         self.applied_index_log: List[int] = []    # for tests/metrics
         self._first_tick = True
+        # optional wakeup hook: drivers park between ticks and a write
+        # or inbound frame should not wait out the sleep (the
+        # reference's replication goroutines fire on notify; timers
+        # still ride the periodic tick)
+        self.on_activity: Optional[Callable[[], None]] = None
         # AFTER the volatile block: boot recovery sets last_applied/
         # commit_index to the snapshot horizon and must not be
         # clobbered by the zero-inits above
@@ -264,6 +269,9 @@ class RaftNode:
     def deliver(self, msg: dict) -> None:
         with self._lock:
             self._inbox.append(msg)
+        cb = self.on_activity
+        if cb is not None:
+            cb()
 
     def is_leader(self) -> bool:
         with self._lock:
@@ -302,7 +310,10 @@ class RaftNode:
             self._pending[idx] = pend
             self.match_index[self.node_id] = idx
             self._needs_bcast = True
-            return pend
+        cb = self.on_activity
+        if cb is not None:
+            cb()
+        return pend
 
     def barrier(self) -> _Pending:
         """Commit a no-op in the current term — leader barrier before

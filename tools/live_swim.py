@@ -46,7 +46,6 @@ class LiveAgent:
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self._lock = threading.Lock()
-        self._awaiting: Dict[str, Tuple[float, bool]] = {}
         # deterministic-ish phase spread so probes don't align
         self._next_probe = time.time() + self.rng.uniform(
             0, cfg.probe_interval)
@@ -146,8 +145,11 @@ class LiveAgent:
             elif m["state"] == SUSPECT and inc >= m["inc"]:
                 m["confirms"].add(frm)
         elif state == DEAD:
-            if m["state"] != DEAD:
-                m["state"] = DEAD
+            # incarnation-guarded like memberlist deadNode: a stale
+            # DEAD must not override a newer refutation (and the
+            # recorded inc lets a future higher-inc ALIVE resurrect)
+            if m["state"] != DEAD and inc >= m["inc"]:
+                m.update(state=DEAD, inc=inc)
                 self.death_observed[about] = time.time()
                 self._enqueue({"about": about, "state": DEAD,
                                "inc": inc})
@@ -237,24 +239,30 @@ class LiveAgent:
                              "seq": msg["seq"],
                              "gossip": self._piggyback()})
         elif t == "ping_req":
-            # indirect probe on behalf of the requester
+            # indirect probe on behalf of the requester; relays keyed
+            # by seq so concurrent requesters through this helper
+            # don't clobber each other
             target = msg["target"]
             m = self.members.get(target)
             if m is not None:
                 self._send(m["addr"],
                            {"t": "ping", "from": self.name,
                             "seq": msg["seq"], "gossip": []})
-                self._relay_to = (msg["seq"], tuple(src))
+                relays = getattr(self, "_relays", None)
+                if relays is None:
+                    relays = self._relays = {}
+                relays[msg["seq"]] = tuple(src)
+                if len(relays) > 64:
+                    relays.pop(next(iter(relays)))
         elif t == "ack":
             seq = msg["seq"]
             ps = getattr(self, "_probe_state", None)
             if ps is not None and ps["seq"] == seq:
                 ps["acked"] = True
-            relay = getattr(self, "_relay_to", None)
-            if relay is not None and relay[0] == seq:
-                self._send(relay[1], {"t": "ack", "from": self.name,
-                                      "seq": seq, "gossip": []})
-                self._relay_to = None
+            relay = getattr(self, "_relays", {}).pop(seq, None)
+            if relay is not None:
+                self._send(relay, {"t": "ack", "from": self.name,
+                                   "seq": seq, "gossip": []})
 
     def _check_timers(self, now: float) -> None:
         # probe state machine: direct timeout -> indirect probes ->

@@ -96,7 +96,9 @@ def main():
     live_t99 = lat[int(len(lat) * 0.99)] if lat else None
     live_frac_detected = len(lat) / n_surv
     print(f"live: {len(lat)}/{n_surv} detected, "
-          f"t50={live_t50:.2f}s t99={live_t99:.2f}s", flush=True)
+          f"t50={live_t50 if live_t50 is None else round(live_t50, 2)}s"
+          f" t99={live_t99 if live_t99 is None else round(live_t99, 2)}"
+          "s", flush=True)
 
     print("device sim at the same tuning...", flush=True)
     frac, tick_s = run_sim(args.nodes, args.seed, max_ticks=1024)

@@ -61,10 +61,17 @@ def main():
     api.start()
     print(f"server {args.node} rpc={my_rpc} "
           f"http={api.address}", flush=True)
+    import threading
+    wake = threading.Event()
+    server.raft.on_activity = wake.set
     try:
         while True:
             server.tick(time.time())
-            time.sleep(args.tick)
+            # event-driven: a client write or inbound raft frame wakes
+            # the loop immediately instead of waiting out the sleep;
+            # idle loops still tick at the base interval for timers
+            wake.wait(timeout=args.tick)
+            wake.clear()
     except KeyboardInterrupt:
         pass
     finally:
