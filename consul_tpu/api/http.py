@@ -154,10 +154,7 @@ class ApiServer:
         self.view_store = ViewStore(pub) if pub is not None else None
         from consul_tpu.cache import Cache as AgentCache
         self.agent_cache = AgentCache()
-        self.agent_cache.register_type(
-            "health_services",
-            lambda key, min_index, timeout: self._fetch_health(key),
-            ttl=600.0)
+        self._register_cache_types()
         handler = _make_handler(self)
 
         class _Httpd(ThreadingHTTPServer):
@@ -175,6 +172,93 @@ class ApiServer:
         rows = self.store.health_service_nodes(
             name, tag=tag or None, passing_only=passing == "True")
         return rows, self.store.index
+
+    def _register_cache_types(self) -> None:
+        """The typed cache registry (agent/cache-types/: the reference
+        registers 23 entries — discovery chain, CA leaf/roots,
+        intention match, gateway services, catalog reads...).  Each
+        fetcher returns (value, index); the Cache layers TTL,
+        background refresh, and Cache-Control max-age semantics on
+        top.  Keys are the request discriminators, '\\x00'-joined."""
+        reg = self.agent_cache.register_type
+        st = self.store
+
+        reg("health_services",
+            lambda key, mi, t: self._fetch_health(key), ttl=600.0)
+        reg("catalog_services",
+            lambda key, mi, t: (st.services(), st.index), ttl=600.0)
+        reg("catalog_service_nodes",
+            lambda key, mi, t: (st.service_nodes(key), st.index),
+            ttl=600.0)
+        reg("catalog_nodes",
+            lambda key, mi, t: (st.nodes(), st.index), ttl=600.0)
+        reg("node_services",
+            lambda key, mi, t: (st.node_services(key), st.index),
+            ttl=600.0)
+        reg("health_connect",
+            lambda key, mi, t: (st.health_connect_nodes(key),
+                                st.index), ttl=600.0)
+        reg("health_checks",
+            lambda key, mi, t: (
+                [c for r in st.health_service_nodes(key)
+                 for c in r["checks"] if c.get("service_id")],
+                st.index), ttl=600.0)
+        reg("connect_ca_roots",
+            lambda key, mi, t: (self.ca.roots(), st.index), ttl=600.0)
+        # leaf certs route through proxycfg's leaf cache so a fetch
+        # never re-signs while the cached cert is fresh (the reference
+        # ConnectCALeaf type blocks on rotation the same way)
+        reg("connect_ca_leaf",
+            lambda key, mi, t: (self.proxycfg.get_leaf(key), st.index),
+            ttl=3600.0)
+
+        def _fetch_intention_match(key, mi, t):
+            from consul_tpu.connect import intentions as imod
+            # maxsplit: a NUL smuggled into the service name must not
+            # blow up the unpack (the name is opaque past the first
+            # separator)
+            by, name = key.split("\x00", 1)
+            return (imod.match_order(st.intention_list(), name, by),
+                    st.index)
+
+        reg("intention_match", _fetch_intention_match, ttl=600.0)
+
+        def _fetch_chain(key, mi, t):
+            from consul_tpu.discoverychain import compile_chain
+            return compile_chain(st, key, dc=self.dc), st.index
+
+        reg("discovery_chain", _fetch_chain, ttl=600.0)
+
+        def _fetch_gateway_services(key, mi, t):
+            from consul_tpu import gateways as gmod
+            return gmod.gateway_services(st, key), st.index
+
+        reg("gateway_services", _fetch_gateway_services, ttl=600.0)
+        reg("federation_states",
+            lambda key, mi, t: (st.federation_state_list(), st.index),
+            ttl=600.0)
+        reg("config_entries",
+            lambda key, mi, t: (st.config_entry_list(key or None),
+                                st.index), ttl=600.0)
+
+    def cached_read(self, type_name: str, key: str, headers, q):
+        """(value, index, 'HIT'|'MISS') when the request OPTED INTO
+        cached serving (?cached + Cache-Control max-age — a bare
+        max-age header is a generic HTTP idiom, not consent to stale
+        agent-cache data); None → serve the normal path.  Blocking
+        (?index) and ?consistent requests always take the live path —
+        a consistent read served from cache would readmit exactly the
+        staleness the flag excludes (the reference rejects
+        cached+consistent as conflicting)."""
+        if "cached" not in q or "index" in q or "consistent" in q:
+            return None
+        cc = headers.get("Cache-Control", "")
+        m = re.search(r"max-age=(\d+)", cc)
+        if not m:
+            return None
+        val, idx, hit = self.agent_cache.get(
+            type_name, key, max_age=float(m.group(1)))
+        return val, idx, ("HIT" if hit else "MISS")
 
     @property
     def default_allow(self) -> bool:
@@ -835,13 +919,16 @@ def _make_handler(srv: ApiServer):
                 # per-DC mesh gateway lists (federation_state_endpoint)
                 if not self.authz.operator_read():
                     return self._forbid()
-                idx = self._block(q, ("federation", ""))
+                feds, idx, state = self._cache_or_live(
+                    "federation_states", "", q,
+                    store.federation_state_list, ("federation", ""))
                 self._send([{
                     "Datacenter": f["datacenter"],
                     "MeshGateways": f["mesh_gateways"],
                     "UpdatedAt": f.get("updated", ""),
                     "ModifyIndex": f.get("modify_index", 0)}
-                    for f in store.federation_state_list()], index=idx)
+                    for f in feds], index=idx,
+                    extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/internal/federation-state/([^/]+)",
                              path)
@@ -1308,36 +1395,46 @@ def _make_handler(srv: ApiServer):
                            if srv.router is not None else [srv.dc])
                 return True
             if path == "/v1/catalog/nodes" and verb == "GET":
-                idx = self._block(q, ("nodes", ""))
+                raw_nodes, idx, state = self._cache_or_live(
+                    "catalog_nodes", "", q, store.nodes,
+                    ("nodes", ""))
                 rows = [{"Node": n["node"], "ID": n["id"],
                          "Address": n["address"], "Meta": n["meta"],
                          "ModifyIndex": n["modify_index"]}
-                        for n in store.nodes()
+                        for n in raw_nodes
                         if self.authz.node_read(n["node"])]
                 rows = self._filtered(q, rows)
                 if "near" in q:
                     rows = self._near_sort(q["near"], rows,
                                            key=lambda r: r["Node"])
-                self._send(rows, index=idx)
+                self._send(rows, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/catalog/services" and verb == "GET":
-                idx = self._block(q, ("services", ""))
-                self._send({k: v for k, v in store.services().items()
-                            if self.authz.service_read(k)}, index=idx)
+                services, idx, state = self._cache_or_live(
+                    "catalog_services", "", q, store.services,
+                    ("services", ""))
+                self._send({k: v for k, v in services.items()
+                            if self.authz.service_read(k)}, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/catalog/service/(.+)", path)
             if m and verb == "GET":
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
-                idx = self._block(q, ("services", m.group(1)),
-                                  ("nodes", ""))
-                rows = store.service_nodes(m.group(1), tag=q.get("tag"))
+                rows, idx, state = self._cache_or_live(
+                    "catalog_service_nodes", m.group(1), q,
+                    lambda: store.service_nodes(m.group(1),
+                                                tag=q.get("tag")),
+                    ("services", m.group(1)), ("nodes", ""),
+                    cacheable=not q.get("tag"))
                 out = self._filtered(q, [_catalog_service_json(r)
                                          for r in rows])
                 if "near" in q:
                     out = self._near_sort(q["near"], out,
                                           key=lambda r: r["Node"])
-                self._send(out, index=idx)
+                self._send(out, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/catalog/gateway-services/(.+)", path)
             if m and verb == "GET":
@@ -1347,11 +1444,15 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.service_read(gw):
                     return self._forbid()
                 from consul_tpu import gateways as gmod
-                idx = self._block(q, ("config", ""))
-                rows = [r for r in gmod.gateway_services(store, gw)
+                raw, idx, state = self._cache_or_live(
+                    "gateway_services", gw, q,
+                    lambda: gmod.gateway_services(store, gw),
+                    ("config", ""))
+                rows = [r for r in raw
                         if r["Service"] == gmod.WILDCARD
                         or self.authz.service_read(r["Service"])]
-                self._send(rows, index=idx)
+                self._send(rows, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/catalog/connect/(.+)", path)
             if m and verb == "GET":
@@ -1374,14 +1475,18 @@ def _make_handler(srv: ApiServer):
                 if nrec is None:
                     self._send(None, index=idx)
                     return True
+                node_svcs, _i, state = self._cache_or_live(
+                    "node_services", node, q,
+                    lambda: store.node_services(node))
                 svcs = {s["id"]: {"ID": s["id"], "Service": s["name"],
                                   "Tags": s["tags"], "Port": s["port"],
                                   "Meta": s["meta"]}
-                        for s in store.node_services(node)
+                        for s in node_svcs
                         if self.authz.service_read(s["name"])}
                 self._send({"Node": {"Node": node, "Address": nrec["address"],
                                      "Meta": nrec["meta"]},
-                            "Services": svcs}, index=idx)
+                            "Services": svcs}, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/health/service/(.+)", path)
             if m and verb == "GET":
@@ -1394,18 +1499,15 @@ def _make_handler(srv: ApiServer):
                     # ?cached rides the streaming materialized view
                     tag = q.get("tag")
                     passing = "passing" in q
-                    cc = self.headers.get("Cache-Control", "")
-                    m_age = re.search(r"max-age=(\d+)", cc)
-                    cache_state = None
-                    if m_age and "index" not in q:
-                        key = f"{name}\x00{tag or ''}\x00{passing}"
-                        rows, idx, hit = srv.agent_cache.get(
-                            "health_services", key,
-                            max_age=float(m_age.group(1)))
+                    hit = srv.cached_read(
+                        "health_services",
+                        f"{name}\x00{tag or ''}\x00{passing}",
+                        self.headers, q)
+                    if hit is not None:
+                        rows, idx, cache_state = hit
                         rows = rows or []
-                        cache_state = "HIT" if hit else "MISS"
-                        # falls through to the shared tail: ?near sorting
-                        # and response conventions stay identical
+                        # falls through to the shared tail: ?near
+                        # sorting and response conventions identical
                     else:
                         view = srv.view_store.get(
                         "health", name,
@@ -1442,13 +1544,19 @@ def _make_handler(srv: ApiServer):
                 name = m.group(1)
                 if not self.authz.service_read(name):
                     return self._forbid()
-                idx = self._block(q, ("health", name))
-                out = []
-                for r in store.health_service_nodes(name):
-                    out += [_check_json(c, c.get("node", ""))
+
+                def _live_checks():
+                    return [c for r in store.health_service_nodes(name)
                             for c in r["checks"]
                             if c.get("service_id")]
-                self._send(self._filtered(q, out), index=idx)
+
+                checks, idx, state = self._cache_or_live(
+                    "health_checks", name, q, _live_checks,
+                    ("health", name))
+                out = [_check_json(c, c.get("node", ""))
+                       for c in checks]
+                self._send(self._filtered(q, out), index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/internal/ui/nodes" and verb == "GET":
                 # UI summary: one row per node with check counts
@@ -1531,12 +1639,16 @@ def _make_handler(srv: ApiServer):
                 # (health_endpoint.go Connect=true path)
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
-                idx = self._block(q, ("health", ""), ("nodes", ""))
-                rows = store.health_connect_nodes(
-                    m.group(1), passing_only="passing" in q)
+                rows, idx, state = self._cache_or_live(
+                    "health_connect", m.group(1), q,
+                    lambda: store.health_connect_nodes(
+                        m.group(1), passing_only="passing" in q),
+                    ("health", ""), ("nodes", ""),
+                    cacheable="passing" not in q)
                 self._send(self._filtered(
                     q, [_health_json(r, store) for r in rows]),
-                    index=idx)
+                    index=idx,
+                    extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/health/ingress/(.+)", path)
             if m and verb == "GET":
@@ -1738,22 +1850,26 @@ def _make_handler(srv: ApiServer):
             if m and verb == "GET":
                 # reads gate on service:read of the entry name (the
                 # reference's config entry read ACLs); lists filter
-                idx = self._block(q, ("config", ""))
                 kind = m.group(1)
-                if m.group(2):
-                    if not self.authz.service_read(m.group(2)):
-                        return self._forbid()
-                    e = store.config_entry_get(kind, m.group(2))
-                    if e is None:
-                        self._err(404, "config entry not found")
-                        return True
-                    self._send(_config_json(e), index=idx)
-                else:
+                if not m.group(2):
+                    entries, idx, state = self._cache_or_live(
+                        "config_entries", kind, q,
+                        lambda: store.config_entry_list(kind),
+                        ("config", ""))
                     self._send(
-                        [_config_json(e)
-                         for e in store.config_entry_list(kind)
+                        [_config_json(e) for e in entries
                          if self.authz.service_read(e.get("name", ""))],
-                        index=idx)
+                        index=idx,
+                        extra_headers=self._cache_headers(state))
+                    return True
+                idx = self._block(q, ("config", ""))
+                if not self.authz.service_read(m.group(2)):
+                    return self._forbid()
+                e = store.config_entry_get(kind, m.group(2))
+                if e is None:
+                    self._err(404, "config entry not found")
+                    return True
+                self._send(_config_json(e), index=idx)
                 return True
             m = re.fullmatch(r"/v1/config/([^/]+)/([^/]+)", path)
             if m and verb == "DELETE":
@@ -1767,10 +1883,13 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
                 from consul_tpu.discoverychain import compile_chain
-                idx = self._block(q, ("config", ""))
-                self._send({"Chain": compile_chain(store, m.group(1),
-                                                   dc=srv.dc)},
-                           index=idx)
+                chain, idx, state = self._cache_or_live(
+                    "discovery_chain", m.group(1), q,
+                    lambda: compile_chain(store, m.group(1),
+                                          dc=srv.dc),
+                    ("config", ""))
+                self._send({"Chain": chain}, index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/exec" and verb == "PUT":
                 # initiator side of consul exec (remote_exec.go protocol
@@ -2185,10 +2304,14 @@ def _make_handler(srv: ApiServer):
                     return True
                 if not self.authz.intention_read(name):
                     return self._forbid()
-                idx = self._block(q, ("intentions", ""))
-                rows = imod.match_order(store.intention_list(), name, by)
+                rows, idx, state = self._cache_or_live(
+                    "intention_match", f"{by}\x00{name}", q,
+                    lambda: imod.match_order(store.intention_list(),
+                                             name, by),
+                    ("intentions", ""))
                 self._send({name: [self._intention_json(i) for i in rows]},
-                           index=idx)
+                           index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/connect/intentions/check" and verb == "GET":
                 src_n = q.get("source", "")
@@ -2288,11 +2411,13 @@ def _make_handler(srv: ApiServer):
                 self._send(payload)
                 return True
             if path == "/v1/connect/ca/roots" and verb == "GET":
-                roots = srv.ca.roots()
+                roots, _idx, state = self._cache_or_live(
+                    "connect_ca_roots", "", q, srv.ca.roots)
                 self._send({"ActiveRootID": next(
                     (r["ID"] for r in roots if r["Active"]), ""),
                     "TrustDomain": srv.ca.trust_domain,
-                    "Roots": roots})
+                    "Roots": roots},
+                    extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/connect/ca/configuration":
                 # CA provider config (connect_ca_endpoint.go
@@ -2376,7 +2501,11 @@ def _make_handler(srv: ApiServer):
                     return self._forbid()
                 from consul_tpu.connect.ca import CARateLimitError
                 try:
-                    self._send(srv.ca.sign_leaf(m.group(1)))
+                    leaf, _idx, state = self._cache_or_live(
+                        "connect_ca_leaf", m.group(1), q,
+                        lambda: srv.proxycfg.get_leaf(m.group(1)))
+                    self._send(leaf,
+                               extra_headers=self._cache_headers(state))
                 except CARateLimitError as e:
                     self._err(429, str(e))   # Too Many Requests
                 return True
@@ -2746,6 +2875,24 @@ def _make_handler(srv: ApiServer):
                     out.append({"Session": {"ID": res}})
             self._send({"Results": out, "Errors": None}, index=idx)
             return True
+
+        def _cache_or_live(self, type_name, key, q, live_fn, *watches,
+                           cacheable=True):
+            """(value, index, cache_state): the shared tail for every
+            typed-cache route — cached_read's gate decides, the live
+            branch blocks on `watches` like an uncached request.
+            `cacheable=False` forces the live path (query variants the
+            typed key doesn't discriminate, e.g. ?tag / ?passing)."""
+            hit = srv.cached_read(type_name, key, self.headers, q) \
+                if cacheable else None
+            if hit is not None:
+                return hit
+            idx = self._block(q, *watches) if watches else None
+            return live_fn(), idx, None
+
+        @staticmethod
+        def _cache_headers(state):
+            return {"X-Cache": state} if state else None
 
         def _near_sort(self, origin: str, rows, key):
             names = [key(r) for r in rows]

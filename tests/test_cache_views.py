@@ -215,3 +215,61 @@ def test_http_cached_with_max_age_and_filters():
         assert xc == "HIT"
     finally:
         a.stop()
+
+
+def test_typed_cache_registry_covers_core_reads():
+    """The typed entry set (agent/cache-types/ role): every registered
+    fetcher serves a real read, and the max-age path answers HIT on
+    repeat across representative endpoints."""
+    import urllib.request
+
+    from consul_tpu.agent import Agent
+    from consul_tpu.config import GossipConfig, SimConfig
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=91))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        a.store.register_service("n1", "w1", "web", port=80)
+        a.store.intention_set("i1", "a", "web", "allow")
+        types = set(a.api.agent_cache._types)
+        assert {"health_services", "catalog_services",
+                "catalog_service_nodes", "catalog_nodes",
+                "node_services", "health_connect", "health_checks",
+                "connect_ca_roots", "connect_ca_leaf",
+                "intention_match", "discovery_chain",
+                "gateway_services", "federation_states",
+                "config_entries"} <= types
+
+        def get(path, headers=None):
+            req = urllib.request.Request(
+                a.http_address + path, headers=headers or {})
+            r = urllib.request.urlopen(req, timeout=15)
+            return r.headers.get("X-Cache"), r.read()
+
+        cc = {"Cache-Control": "max-age=60"}
+        for path in ("/v1/catalog/services",
+                     "/v1/catalog/service/web",
+                     "/v1/catalog/nodes",
+                     "/v1/catalog/node/node0",
+                     "/v1/connect/ca/roots",
+                     "/v1/health/checks/web",
+                     "/v1/discovery-chain/web",
+                     "/v1/connect/intentions/match?name=web"
+                     "&by=destination"):
+            sep = "&" if "?" in path else "?"
+            s1, _ = get(path + sep + "cached", cc)
+            s2, body = get(path + sep + "cached", cc)
+            assert s1 == "MISS" and s2 == "HIT", (path, s1, s2)
+            assert body
+        # caching is OPT-IN: a bare max-age header without ?cached
+        # takes the live path (no X-Cache), and so does ?consistent
+        s, _ = get("/v1/catalog/services", cc)
+        assert s is None
+        s, _ = get("/v1/catalog/services?cached&consistent", cc)
+        assert s is None
+        # plain requests keep the live path too
+        r = urllib.request.urlopen(
+            a.http_address + "/v1/catalog/services", timeout=15)
+        assert r.headers.get("X-Cache") is None
+    finally:
+        a.stop()
