@@ -120,6 +120,36 @@ class _Entry:
     noop: bool = False
 
 
+# oversized commands split into per-entry chunks before the log (the
+# reference wraps raft with go-raftchunking at rpc.go:763-792 so one
+# huge apply — e.g. a 64-op txn of 512KiB values — can't monopolize an
+# AppendEntries round or blow past transport frames)
+CHUNK_BYTES = 256 * 1024
+
+
+def _roughly_big(cmd, budget: int = CHUNK_BYTES) -> bool:
+    """Cheap size walk with early exit: small commands (the hot write
+    path) must not pay a throwaway json.dumps just to be measured."""
+    stack = [cmd]
+    total = 0
+    while stack:
+        o = stack.pop()
+        if isinstance(o, str):
+            total += len(o)
+        elif isinstance(o, (bytes, bytearray)):
+            total += len(o)
+        elif isinstance(o, dict):
+            stack.extend(o.keys())
+            stack.extend(o.values())
+        elif isinstance(o, (list, tuple)):
+            stack.extend(o)
+        else:
+            total += 8
+        if total > budget:
+            return True
+    return False
+
+
 @dataclass
 class _Pending:
     event: threading.Event = field(default_factory=threading.Event)
@@ -181,6 +211,7 @@ class RaftNode:
         self._heartbeat_due = 0.0
         self._needs_bcast = False
         self._inbox: List[dict] = []
+        self._chunk_buf: Dict[str, list] = {}   # gid -> b64 parts
         self._lock = threading.RLock()
         self._pending: Dict[int, _Pending] = {}   # log index -> waiter
         self._leader_observers: List[Callable[[bool], None]] = []
@@ -212,8 +243,7 @@ class RaftNode:
             self.snapshot_data = state["snapshot"]
             self.snap_index = state["snap_index"]
             self.snap_term = state["snap_term"]
-            if self.restore_fn is not None:
-                self.restore_fn(state["snapshot"])
+            self._unwrap_restore(state["snapshot"])
         # contiguous run from base+1; a gap means the WAL lost frames
         # (shouldn't happen, but a hole must not fake consistency)
         idx = self.log_base
@@ -296,16 +326,42 @@ class RaftNode:
         write path (a send to a partitioned peer would otherwise hold
         the raft lock for the full connect timeout).  Concurrent
         appliers batch into the single per-tick append."""
+        entries = [cmd]
+        if not noop and cmd is not None and _roughly_big(cmd):
+            # Only commands the cheap walk flags as large pay the
+            # serialization probe; chunked applies are JSON-round-
+            # tripped, which matches what the socket transport does to
+            # EVERY command anyway (rpc/net.py JSON frames).  Byte-
+            # accurate split over the UTF-8 encoding (character counts
+            # under-measure non-ASCII by up to 4x).
+            import base64 as _b64
+            import json as _json
+            import uuid as _uuid
+            try:
+                blob = _json.dumps(cmd).encode()
+            except (TypeError, ValueError):
+                blob = b""          # non-JSON cmd: in-memory path only
+            if len(blob) > CHUNK_BYTES:
+                gid = str(_uuid.uuid4())
+                parts = [blob[i:i + CHUNK_BYTES]
+                         for i in range(0, len(blob), CHUNK_BYTES)]
+                entries = [{"__chunk__": {
+                    "id": gid, "seq": i, "total": len(parts),
+                    "data": _b64.b64encode(p).decode()}}
+                    for i, p in enumerate(parts)]
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
-            ent = _Entry(self.current_term, cmd, noop)
-            self.log.append(ent)
-            idx = self.last_log_index
-            # WAL append now, fsync deferred to the commit decision
-            # (_advance_commit) — one group-commit fsync per tick
-            # covers every write batched into it
-            self._persist_entry(idx, ent)
+            for e_cmd in entries:
+                ent = _Entry(self.current_term, e_cmd, noop)
+                self.log.append(ent)
+                idx = self.last_log_index
+                # WAL append now, fsync deferred to the commit decision
+                # (_advance_commit) — one group-commit fsync per tick
+                # covers every write batched into it
+                self._persist_entry(idx, ent)
+            # the waiter resolves when the FINAL chunk (or the single
+            # entry) applies
             pend = _Pending()
             self._pending[idx] = pend
             self.match_index[self.node_id] = idx
@@ -580,8 +636,7 @@ class RaftNode:
             self._last_contact = now
             self._reset_election_timer(now)
             if msg["last_index"] > self.last_applied:
-                if self.restore_fn is not None:
-                    self.restore_fn(msg["data"])
+                self._unwrap_restore(msg["data"])
                 self.snapshot_data = msg["data"]
                 self.log_base = msg["last_index"]
                 self.log_base_term = msg["last_term"]
@@ -629,7 +684,9 @@ class RaftNode:
             ent = self.log[off]
             result = None
             if not ent.noop:
-                if isinstance(ent.cmd, dict) \
+                if isinstance(ent.cmd, dict) and "__chunk__" in ent.cmd:
+                    result = self._apply_chunk(ent.cmd["__chunk__"])
+                elif isinstance(ent.cmd, dict) \
                         and "__raft_remove_peer__" in ent.cmd:
                     # replicated membership change (simplified joint
                     # consensus: single-server removal, applied by every
@@ -643,6 +700,60 @@ class RaftNode:
             if pend is not None:
                 pend.result = result
                 pend.event.set()
+
+    def _apply_chunk(self, chunk: dict):
+        """Reassemble chunked commands in log order; the FULL command
+        applies exactly when its final chunk commits (every replica
+        sees the identical sequence, so reassembly is deterministic).
+        A seq-0 chunk resets its group; abandoned partial groups (a
+        deposed leader's truncated tail) are evicted once more than
+        _CHUNK_GROUP_CAP groups accumulate — they can never complete,
+        and each can hold megabytes."""
+        gid = chunk["id"]
+        if chunk["seq"] == 0:
+            self._chunk_buf[gid] = []
+            while len(self._chunk_buf) > self._CHUNK_GROUP_CAP:
+                oldest = next(iter(self._chunk_buf))
+                if oldest == gid:
+                    break
+                del self._chunk_buf[oldest]
+        buf = self._chunk_buf.setdefault(gid, [])
+        if chunk["seq"] != len(buf):
+            # out-of-order fragment from a truncated group: drop it;
+            # the proposer's retry arrives under a FRESH group id
+            self._chunk_buf.pop(gid, None)
+            return None
+        buf.append(chunk["data"])
+        if len(buf) < chunk["total"]:
+            return None
+        import base64 as _b64
+        import json as _json
+        self._chunk_buf.pop(gid, None)
+        blob = b"".join(_b64.b64decode(p) for p in buf)
+        return self.apply_fn(_json.loads(blob.decode()))
+
+    _CHUNK_GROUP_CAP = 8
+
+    # Chunk reassembly state MUST ride snapshots (go-raftchunking
+    # stores it in the FSM for the same reason): a snapshot horizon
+    # landing mid-group would otherwise make a restored replica drop
+    # the group's tail and silently never apply a command every other
+    # replica applied.
+    def _wrap_snapshot(self):
+        return {"__fsm__": self.snapshot_fn(),
+                "__chunks__": {k: list(v)
+                               for k, v in self._chunk_buf.items()}}
+
+    def _unwrap_restore(self, data) -> None:
+        if isinstance(data, dict) and "__fsm__" in data:
+            self._chunk_buf = {k: list(v)
+                               for k, v in data["__chunks__"].items()}
+            if self.restore_fn is not None:
+                self.restore_fn(data["__fsm__"])
+        else:
+            self._chunk_buf = {}
+            if self.restore_fn is not None:
+                self.restore_fn(data)
 
     def _apply_remove_peer(self, peer: str) -> dict:
         if peer in self.peers:
@@ -667,7 +778,7 @@ class RaftNode:
         keep_from = self.last_applied - self.cfg.snapshot_trailing
         if keep_from <= self.log_base:
             return
-        self.snapshot_data = self.snapshot_fn()
+        self.snapshot_data = self._wrap_snapshot()
         self.snap_index = self.last_applied
         self.snap_term = self._term_at(self.last_applied) or 0
         new_base_term = self._term_at(keep_from) or self.log_base_term
