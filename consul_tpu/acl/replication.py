@@ -107,6 +107,37 @@ class AclReplicator(Replicator):
 
 
 
+class ConfigEntryReplicator(Replicator):
+    """Primary → secondary config-entry sync
+    (agent/consul/config_replication.go): mesh routing config
+    (resolvers/routers/splitters/gateway bindings/proxy-defaults)
+    written in the primary DC must converge to every secondary, same
+    content-diff round shape as the other replicators."""
+
+    def run_once(self):
+        ups = dels = 0
+
+        def strip(e):
+            return {k: v for k, v in e.items()
+                    if k not in ("create_index", "modify_index")}
+
+        prim = {(e["kind"], e["name"]): strip(e)
+                for e in self.primary.config_entry_list()}
+        sec = {(e["kind"], e["name"]): strip(e)
+               for e in self.secondary.config_entry_list()}
+        for (kind, name) in set(sec) - set(prim):
+            self.secondary.config_entry_delete(kind, name)
+            dels += 1
+        for (kind, name), body in prim.items():
+            if sec.get((kind, name)) != body:
+                self.secondary.config_entry_set(
+                    kind, name, {k: v for k, v in body.items()
+                                 if k not in ("kind", "name")})
+                ups += 1
+        self.last_round = (ups, dels)
+        return ups, dels
+
+
 class FederationStateReplicator(Replicator):
     """Primary → secondary federation-state sync
     (agent/consul/federation_state_replication.go): each round lists the

@@ -223,6 +223,7 @@ class Agent:
                 authorize=lambda token, svc: self.acl.resolve(
                     token or None).service_write(svc),
                 subscribe_authorize=_sub_authz)
+            self.api.grpc_port = self.xds_grpc.port
         self._reconcile_thread: Optional[threading.Thread] = None
         self._running = False
 

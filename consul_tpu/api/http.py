@@ -134,6 +134,10 @@ class ApiServer:
         # /debug/pprof analogues served only when explicitly enabled
         # (agent/http.go enable_debug gate)
         self.enable_debug = False
+        # the agent's gRPC ADS port when one is bound (-1 = disabled);
+        # surfaced via /v1/agent/self so `connect envoy -bootstrap`
+        # can point a stock Envoy at it
+        self.grpc_port = -1
         # pre-raft payload guards: 512 KiB KV value cap
         # (kv_max_value_size, performance.mdx:149) and 64-op txn cap
         # (agent/txn_endpoint.go maxTxnOps); both reject with 413
@@ -825,6 +829,9 @@ def _make_handler(srv: ApiServer):
                                        "Datacenter": srv.dc,
                                        "Server": True,
                                        "Version": VERSION},
+                            "DebugConfig": {
+                                "GRPCPort": srv.grpc_port},
+                            "xDS": {"Port": srv.grpc_port},
                             "Stats": {"sim_tick": oracle.tick,
                                       "sim_nodes": oracle.n_nodes}})
                 return True

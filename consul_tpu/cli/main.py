@@ -344,6 +344,78 @@ def cmd_intention(args) -> int:
 def cmd_connect(args) -> int:
     """consul connect ca|proxy (command/connect/ca, command/connect/proxy)."""
     c = _client(args)
+    if args.connect_cmd == "envoy":
+        # `consul connect envoy -bootstrap` (command/connect/envoy):
+        # emit the envoy v3 bootstrap that attaches a STOCK envoy to
+        # this agent's gRPC ADS — node.id carries the sidecar service
+        # id, the xds cluster dials the agent's GRPC port over HTTP/2.
+        if not args.bootstrap:
+            print("only -bootstrap mode is supported (no envoy binary "
+                  "is shipped); pass -bootstrap", file=sys.stderr)
+            return 1
+        if bool(args.sidecar_for) == bool(args.proxy_id):
+            print("exactly one of -proxy-id or -sidecar-for is "
+                  "required", file=sys.stderr)
+            return 1
+        me = c.agent_self()
+        grpc_port = (me.get("xDS") or {}).get("Port", -1)
+        if grpc_port is None or grpc_port < 0:
+            print("agent has no gRPC xDS listener (set ports.grpc)",
+                  file=sys.stderr)
+            return 1
+        if args.sidecar_for:
+            # resolve the SERVICE name to its registered sidecar
+            # proxy (the reference scans local services for a
+            # connect-proxy whose destination matches)
+            rows = c.health_connect(args.sidecar_for)
+            if not rows:
+                print(f"no sidecar proxy registered for service "
+                      f"{args.sidecar_for!r}", file=sys.stderr)
+                return 1
+            proxy_id = rows[0]["Service"]["ID"]
+            cluster = args.sidecar_for
+        else:
+            proxy_id = args.proxy_id
+            cluster = proxy_id
+        bootstrap = {
+            "node": {"id": proxy_id, "cluster": cluster,
+                     "metadata": {"namespace": "default",
+                                  "envoy_version": "1.20.0"}},
+            "static_resources": {"clusters": [{
+                "name": "consul_xds",
+                "type": "STATIC",
+                "connect_timeout": "1s",
+                "typed_extension_protocol_options": {
+                    "envoy.extensions.upstreams.http.v3."
+                    "HttpProtocolOptions": {
+                        "@type": "type.googleapis.com/envoy.extensions"
+                                 ".upstreams.http.v3."
+                                 "HttpProtocolOptions",
+                        "explicit_http_config": {
+                            "http2_protocol_options": {}}}},
+                "load_assignment": {
+                    "cluster_name": "consul_xds",
+                    "endpoints": [{"lb_endpoints": [{"endpoint": {
+                        "address": {"socket_address": {
+                            "address": "127.0.0.1",
+                            "port_value": grpc_port}}}}]}]},
+            }]},
+            "dynamic_resources": {
+                "lds_config": {"ads": {},
+                               "resource_api_version": "V3"},
+                "cds_config": {"ads": {},
+                               "resource_api_version": "V3"},
+                "ads_config": {
+                    "api_type": "GRPC",
+                    "transport_api_version": "V3",
+                    "grpc_services": [{"envoy_grpc": {
+                        "cluster_name": "consul_xds"}}]}},
+            "admin": {"address": {"socket_address": {
+                "address": "127.0.0.1",
+                "port_value": args.admin_bind}}},
+        }
+        print(json.dumps(bootstrap, indent=2))
+        return 0
     if args.connect_cmd == "proxy":
         from consul_tpu.connect.proxy import ApiProxy
         ups = []
@@ -980,6 +1052,14 @@ def build_parser() -> argparse.ArgumentParser:
     casub.add_parser("get-config")
     x = casub.add_parser("set-config")
     x.add_argument("-config-file", dest="config_file", default="-")
+    ev = cosub.add_parser("envoy")
+    ev.add_argument("-sidecar-for", dest="sidecar_for", default="")
+    ev.add_argument("-proxy-id", dest="proxy_id", default="")
+    ev.add_argument("-admin-bind", dest="admin_bind", type=int,
+                    default=19000)
+    ev.add_argument("-bootstrap", action="store_true",
+                    help="print the bootstrap and exit (the only mode "
+                         "— no envoy binary is shipped)")
     px = cosub.add_parser("proxy")
     px.add_argument("-service", required=True)
     px.add_argument("-listen", default="127.0.0.1:0",

@@ -173,3 +173,32 @@ def test_federation_state_replication_and_http():
         assert out[0]["MeshGateways"][0]["Port"] == 8443
     finally:
         a.stop()
+
+
+def test_config_entry_replication():
+    """Primary-DC mesh config converges to secondaries
+    (config_replication.go role)."""
+    from consul_tpu.acl.replication import ConfigEntryReplicator
+    from consul_tpu.catalog.store import StateStore
+    primary, secondary = StateStore(), StateStore()
+    primary.config_entry_set("service-resolver", "web",
+                             {"default_subset": "v1"})
+    primary.config_entry_set("service-splitter", "api", {
+        "splits": [{"weight": 100, "service": "api"}]})
+    secondary.config_entry_set("service-resolver", "stale",
+                               {"default_subset": "old"})
+    rep = ConfigEntryReplicator(primary, secondary, interval=999)
+    ups, dels = rep.run_once()
+    assert ups == 2 and dels == 1
+    assert secondary.config_entry_get(
+        "service-resolver", "web")["default_subset"] == "v1"
+    assert secondary.config_entry_get(
+        "service-resolver", "stale") is None
+    # steady state: no-op rounds
+    assert rep.run_once() == (0, 0)
+    # an update in the primary re-replicates
+    primary.config_entry_set("service-resolver", "web",
+                             {"default_subset": "v2"})
+    assert rep.run_once() == (1, 0)
+    assert secondary.config_entry_get(
+        "service-resolver", "web")["default_subset"] == "v2"

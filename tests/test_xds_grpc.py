@@ -454,3 +454,60 @@ def test_grpc_subscribe_whole_topic_and_resume(agent, ads):
         call2.cancel()
     finally:
         ch.close()
+
+
+def test_connect_envoy_bootstrap_cli(tmp_path):
+    """`consul connect envoy -bootstrap` emits an envoy v3 bootstrap
+    whose ADS cluster dials this agent's live gRPC listener
+    (command/connect/envoy role)."""
+    import io
+    from contextlib import redirect_stdout
+
+    from consul_tpu.cli.main import main as cli_main
+    cfg = tmp_path / "a.json"
+    cfg.write_text(json.dumps({
+        "ports": {"grpc": 0},
+        "sim": {"n_nodes": 8, "rumor_slots": 8}}))
+    a = Agent.from_config(config_files=[str(cfg)])
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        # -sidecar-for resolves the SERVICE name to its registered
+        # sidecar proxy (the reference's local-service scan)
+        a.store.register_service(
+            "node0", "web-sidecar-proxy", "web-sidecar-proxy",
+            port=21000, kind="connect-proxy",
+            proxy={"destination_service": "web"})
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = cli_main(["-http-addr", a.http_address, "connect",
+                           "envoy", "-sidecar-for", "web",
+                           "-bootstrap"])
+        assert rc == 0
+        boot = json.loads(buf.getvalue())
+        assert boot["node"]["id"] == "web-sidecar-proxy"
+        assert boot["node"]["cluster"] == "web"
+        # flag validation: no -bootstrap / no target / both targets
+        assert cli_main(["-http-addr", a.http_address, "connect",
+                         "envoy", "-proxy-id", "x"]) == 1
+        assert cli_main(["-http-addr", a.http_address, "connect",
+                         "envoy", "-bootstrap"]) == 1
+        sa = boot["static_resources"]["clusters"][0][
+            "load_assignment"]["endpoints"][0]["lb_endpoints"][0][
+            "endpoint"]["address"]["socket_address"]
+        assert sa["port_value"] == a.xds_grpc.port
+        ads = boot["dynamic_resources"]["ads_config"]
+        assert ads["api_type"] == "GRPC"
+        assert ads["grpc_services"][0]["envoy_grpc"][
+            "cluster_name"] == "consul_xds"
+        # the advertised port really serves ADS: complete a handshake
+        s = _Stream(f"127.0.0.1:{sa['port_value']}",
+                    "StreamAggregatedResources",
+                    xds_pb.DiscoveryRequest, xds_pb.DiscoveryResponse)
+        try:
+            s.send(_req(CDS))
+            resp = s.recv()
+            assert resp.type_url == CDS
+        finally:
+            s.close()
+    finally:
+        a.stop()
