@@ -81,6 +81,27 @@ class AdsServicer:
         self.manager = manager
         self.authorize = authorize
         self.poll_interval = poll_interval
+        # one generated payload per snapshot OBJECT: four type pushes
+        # per update (and every stream on the same proxy) share it
+        # instead of regenerating the full resource set.  Keyed weakly
+        # on the snapshot itself — (proxy_id, version) tuples would
+        # collide when a proxy deregisters and re-registers (the new
+        # ProxyState restarts version numbering), serving the OLD
+        # registration's config; the weak map can't collide and GC
+        # evicts entries exactly when their snapshot is replaced.
+        import weakref
+        self._payload_cache = weakref.WeakKeyDictionary()
+        self._payload_lock = threading.Lock()
+
+    def _payload(self, st: "_StreamState", snap) -> dict:
+        with self._payload_lock:
+            hit = self._payload_cache.get(snap)
+            if hit is not None:
+                return hit
+        payload = xdsmod.snapshot_resources(snap)["Resources"]
+        with self._payload_lock:
+            self._payload_cache[snap] = payload
+        return payload
 
     # ------------------------------------------------------------ plumbing
 
@@ -184,7 +205,7 @@ class AdsServicer:
         snap = st.watch.fetch(0, timeout=0.0)
         if snap is None:
             return
-        payload = xdsmod.snapshot_resources(snap)["Resources"]
+        payload = self._payload(st, snap)
         for url in urls:
             names = (names_override or {}).get(
                 url, st.sent.get(url, (0, "", ()))[2])
@@ -256,7 +277,7 @@ class AdsServicer:
         snap = st.watch.fetch(0, timeout=0.0)
         if snap is None:
             return
-        payload = xdsmod.snapshot_resources(snap)["Resources"]
+        payload = self._payload(st, snap)
         version = str(snap.version)
         for url in urls:
             have = held.setdefault(url, {})
