@@ -90,6 +90,41 @@ def parse_query(data: bytes) -> Tuple[int, int, str, int]:
     return txn_id, flags, name.lower(), qtype
 
 
+OPT = 41
+
+
+def edns_udp_size(data: bytes) -> Optional[int]:
+    """The EDNS0 advertised UDP payload size from the query's OPT
+    pseudo-record, or None when the client sent none (RFC 6891; the
+    reference honors it via miekg/dns SetEdns0 — truncation budgets
+    scale to what the resolver can actually receive)."""
+    try:
+        txn_id, flags, qd, an, ns, ar = struct.unpack(">HHHHHH",
+                                                      data[:12])
+        if ar < 1:
+            return None
+        off = 12
+        for _ in range(qd):
+            _, off = decode_name(data, off)
+            off += 4
+        for _ in range(an + ns):
+            _, off = decode_name(data, off)
+            _t, _c, _ttl, rdlen = struct.unpack(
+                ">HHIH", data[off:off + 10])
+            off += 10 + rdlen
+        for _ in range(ar):
+            _, off = decode_name(data, off)
+            rtype, klass, _ttl, rdlen = struct.unpack(
+                ">HHIH", data[off:off + 10])
+            off += 10 + rdlen
+            if rtype == OPT:
+                # CLASS field carries the payload size for OPT
+                return max(512, min(int(klass), 65535))
+    except (struct.error, ValueError):
+        return None
+    return None
+
+
 def parse_recursor(addr: str) -> Tuple[str, int]:
     """'1.2.3.4', 'host:53', '::1', '[::1]:53' → (host, port); default
     port 53 (agent/dns.go:251 recursor address normalization)."""
@@ -273,9 +308,12 @@ class DNSServer:
             return self._recurse(data, txn_id, qname, qtype, udp)
         tc = False
         if udp and answers:
+            # EDNS0: a client advertising a bigger receive buffer gets
+            # a bigger truncation budget (agent/dns.go setEDNS role)
+            budget = edns_udp_size(data) or UDP_BUDGET
             kept = list(answers)
             while kept and 12 + len(encode_name(qname)) + 4 + sum(
-                    len(r.pack()) for r in kept) > UDP_BUDGET:
+                    len(r.pack()) for r in kept) > budget:
                 kept.pop()
                 tc = True
             answers = kept
