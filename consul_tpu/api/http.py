@@ -2810,6 +2810,22 @@ def _make_handler(srv: ApiServer):
                     self._err(400, "unknown txn op type (want KV/Node/"
                                    "Service/Check/Session)")
                     return True
+                # a None/empty name must not reach the store (it would
+                # mint a None-keyed catalog row) — the reference's
+                # txn_endpoint rejects these before building the op.
+                # Scoped to the typed branches: KV verbs share the
+                # "check-" namespace (e.g. check-index) and must not
+                # trip these guards.
+                if (node or svc or chk) and not op.get("node"):
+                    self._err(400, f"txn {op['verb']} op missing "
+                                   "node name")
+                    return True
+                if svc and not op.get("service_id"):
+                    self._err(400, "txn service op missing service ID/name")
+                    return True
+                if chk and not op.get("check_id"):
+                    self._err(400, "txn check op missing check ID/name")
+                    return True
                 ops.append(op)
             except (ValueError, KeyError, TypeError,
                     AttributeError) as e:
@@ -2819,7 +2835,16 @@ def _make_handler(srv: ApiServer):
                 return True
             for op in ops:
                 verb = op["verb"]
-                if verb.startswith("node-"):
+                if "key" in op:
+                    # KV ops first: KV verbs share the "check-"
+                    # namespace (check-index, check-session, check-
+                    # not-exists) and must not hit the Check branch
+                    need_read = verb in ("get", "get-tree",
+                                         "check-index", "check-session",
+                                         "check-not-exists")
+                    ok = self.authz.key_read(op["key"]) if need_read \
+                        else self.authz.key_write(op["key"])
+                elif verb.startswith("node-"):
                     ok = self.authz.node_read(op["node"]) \
                         if verb == "node-get" \
                         else self.authz.node_write(op["node"])
@@ -2849,10 +2874,9 @@ def _make_handler(srv: ApiServer):
                     ok = self._session_node_write(op["session"])
                 elif verb.startswith("session-"):
                     ok = self.authz.session_write(op["node"])
-                else:
-                    need_read = verb in ("get", "check-index")
-                    ok = self.authz.key_read(op["key"]) if need_read \
-                        else self.authz.key_write(op["key"])
+                else:          # every op shape above is exhaustive
+                    self._err(400, f"unknown txn verb {verb!r}")
+                    return True
                 if not ok:
                     return self._forbid()
             try:

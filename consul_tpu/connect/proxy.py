@@ -223,7 +223,15 @@ class PublicListener(_Listener):
                 conn.close()
                 return
             uri = peer_spiffe_uri(tls_conn)
-            ok, _reason = self.authorize(uri or "")
+            if uri is None:
+                # a mesh-root-signed cert with NO spiffe:// URI SAN is
+                # unidentifiable — reject outright rather than letting
+                # default-allow intentions admit source "" (the
+                # reference's connect authz errors on such certs)
+                self.stats["denied"] += 1
+                tls_conn.close()
+                return
+            ok, _reason = self.authorize(uri)
             if not ok:
                 self.stats["denied"] += 1
                 tls_conn.close()

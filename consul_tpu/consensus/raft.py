@@ -43,6 +43,13 @@ class NotLeaderError(Exception):
         self.leader = leader
 
 
+class ChunkLostError(Exception):
+    """A chunked command's group was dropped before its final fragment
+    applied (out-of-order fragment after truncation, or cap eviction).
+    Surfaced through the pending waiter's error slot so the proposer
+    retries instead of reading None as a successful apply."""
+
+
 @dataclass
 class RaftConfig:
     election_timeout: Tuple[float, float] = (0.15, 0.30)  # seconds, jittered
@@ -698,7 +705,10 @@ class RaftNode:
             self.applied_index_log.append(self.last_applied)
             pend = self._pending.pop(self.last_applied, None)
             if pend is not None:
-                pend.result = result
+                if isinstance(result, Exception):
+                    pend.error = result
+                else:
+                    pend.result = result
                 pend.event.set()
 
     def _apply_chunk(self, chunk: dict):
@@ -718,11 +728,17 @@ class RaftNode:
                     break
                 del self._chunk_buf[oldest]
         buf = self._chunk_buf.setdefault(gid, [])
+        final = chunk["seq"] == chunk["total"] - 1
         if chunk["seq"] != len(buf):
             # out-of-order fragment from a truncated group: drop it;
-            # the proposer's retry arrives under a FRESH group id
+            # the proposer's retry arrives under a FRESH group id.
+            # The proposer's waiter sits on the FINAL chunk's index —
+            # if that's the fragment we're dropping, it must see an
+            # error, not a None-as-success (silently lost ack).
             self._chunk_buf.pop(gid, None)
-            return None
+            return ChunkLostError(
+                f"chunk group {gid} dropped at seq {chunk['seq']}"
+            ) if final else None
         buf.append(chunk["data"])
         if len(buf) < chunk["total"]:
             return None

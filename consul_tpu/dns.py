@@ -336,8 +336,13 @@ class DNSServer:
                                       socket.SOCK_DGRAM)
                     try:
                         s.settimeout(self.recursor_timeout)
-                        s.sendto(packet, (host, port))
-                        resp, _ = s.recvfrom(4096)
+                        # connect() so the kernel filters datagrams by
+                        # peer address — an off-path reply spoofed from
+                        # another source can't be relayed (miekg/dns
+                        # clients connect the same way)
+                        s.connect((host, port))
+                        s.send(packet)
+                        resp = s.recv(4096)
                     finally:
                         s.close()
                 else:

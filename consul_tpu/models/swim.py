@@ -44,8 +44,15 @@ Known simplifications vs memberlist (documented, to refine):
   * probe/gossip peers are ring neighbors at shared random offsets rather
     than per-node-independent uniform draws (same expected fanout, same
     exponential spread; memberlist's own probe order is a shuffled ring);
-  * a rumor's payload always fits the packet (U is small);
-  * `dead` is terminal per subject — no rejoin-with-higher-incarnation yet.
+  * a rumor's payload always fits the packet (U is small).
+
+No-longer-simplifications (capabilities the kernel now has):
+  * rejoin-with-higher-incarnation: `rejoin()` revives a dead subject
+    when it returns with a higher incarnation (memberlist aliveNode on
+    a dead entry) — tested in tests/test_swim.py;
+  * rumor-slot pressure eviction: under slot exhaustion, fully-spread
+    and lowest-priority rumors are evicted first, and SUSPECT slots are
+    never evicted (eviction there would livelock refutation).
 """
 
 from __future__ import annotations

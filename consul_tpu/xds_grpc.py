@@ -140,10 +140,15 @@ class AdsServicer:
 
     def _watcher(self, st: _StreamState, q: "queue.Queue",
                  stop: threading.Event):
-        """Post a token whenever the proxy snapshot version moves."""
+        """Post a token whenever the proxy snapshot version moves.
+
+        Fetches in short slices (not poll_interval-long blocks) so the
+        thread notices stop.set() within ~1s of stream close instead of
+        pinning the ProxyState for up to poll_interval."""
         version = 0
+        slice_s = min(1.0, self.poll_interval)
         while not stop.is_set():
-            snap = st.watch.fetch(version, timeout=self.poll_interval)
+            snap = st.watch.fetch(version, timeout=slice_s)
             if snap is None:
                 continue
             if snap.version > version:

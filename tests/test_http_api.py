@@ -114,6 +114,38 @@ def test_txn_atomicity(client):
     assert client.kv_get("t/a")[0] is None
 
 
+def test_txn_validation_and_kv_check_index(client):
+    """Typed txn ops with a missing name 400 before reaching the store
+    (txn_endpoint validation); the KV verb check-index — which shares
+    the 'check-' prefix with Check ops — still works over HTTP."""
+    import base64
+    from consul_tpu.api.client import ApiError
+    # KV check-index must not be misread as a Check op
+    client.kv_put("t/ci", b"x")
+    row, idx = client.kv_get("t/ci")
+    out = client.txn([
+        {"KV": {"Verb": "check-index", "Key": "t/ci",
+                "Index": row["ModifyIndex"]}},
+        {"KV": {"Verb": "set", "Key": "t/ci2",
+                "Value": base64.b64encode(b"y").decode()}},
+    ])
+    assert not out.get("Errors")
+    # node/service/check ops without a name are client errors, and the
+    # store never sees a None-keyed row
+    for bad in (
+        {"Node": {"Verb": "set", "Node": {"Address": "10.0.0.9"}}},
+        {"Service": {"Verb": "set", "Node": "txn-n1", "Service": {}}},
+        {"Check": {"Verb": "set", "Check": {"Node": "txn-n1"}}},
+    ):
+        try:
+            client.txn([bad])
+        except ApiError as e:
+            assert e.code == 400
+        else:
+            raise AssertionError(f"txn op {bad} should 400")
+    assert all(n["Node"] is not None for n in client.catalog_nodes())
+
+
 def test_events_fire_and_coverage(client, agent):
     ev = client.event_fire("deploy", b"v2.0")
     agent.oracle.advance(20)
