@@ -96,11 +96,17 @@ class AclReplicator(Replicator):
             if mine is None or mine["secret"] != tok["secret"] \
                     or mine["policies"] != tok["policies"] \
                     or mine.get("type") != tok.get("type") \
-                    or mine.get("description") != tok.get("description"):
+                    or mine.get("description") != tok.get("description") \
+                    or (mine.get("service_identities") or []) != \
+                    (tok.get("service_identities") or []) \
+                    or (mine.get("node_identities") or []) != \
+                    (tok.get("node_identities") or []):
                 self.secondary.acl_token_set(
                     acc, tok["secret"], tok.get("policies") or [],
                     tok.get("description", ""),
-                    token_type=tok.get("type", "client"), local=False)
+                    token_type=tok.get("type", "client"), local=False,
+                    service_identities=tok.get("service_identities"),
+                    node_identities=tok.get("node_identities"))
                 ups += 1
         self.last_round = (ups, dels)
         return ups, dels

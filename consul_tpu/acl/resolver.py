@@ -21,6 +21,40 @@ from consul_tpu.acl.authorizer import (
 
 ANONYMOUS_ACCESSOR = "00000000-0000-0000-0000-000000000002"
 
+# Synthetic-policy templates for token identities, matching the
+# reference byte-for-semantics (agent/structs/acl_oss.go
+# aclPolicyTemplateServiceIdentity / aclPolicyTemplateNodeIdentity):
+# a service identity may register the service and its sidecar and read
+# the rest of the catalog (which also grants intention read via the
+# service-read mapping); a node identity may register its node and
+# read services for anti-entropy diffing.
+_SERVICE_IDENTITY_RULES = (
+    'service "{0}" {{ policy = "write" }}\n'
+    'service "{0}-sidecar-proxy" {{ policy = "write" }}\n'
+    'service_prefix "" {{ policy = "read" }}\n'
+    'node_prefix "" {{ policy = "read" }}\n')
+_NODE_IDENTITY_RULES = (
+    'node "{0}" {{ policy = "write" }}\n'
+    'service_prefix "" {{ policy = "read" }}\n')
+
+
+def synthetic_identity_rules(token: dict, dc: str) -> str:
+    """Policy text synthesized from a token's service/node identities,
+    scoped to `dc` (ServiceIdentity.Datacenters filters; a
+    NodeIdentity is valid only in its own datacenter —
+    agent/structs/acl.go:144,199)."""
+    parts = []
+    for si in token.get("service_identities") or []:
+        dcs = si.get("datacenters") or []
+        if dcs and dc not in dcs:
+            continue
+        parts.append(_SERVICE_IDENTITY_RULES.format(si["service_name"]))
+    for ni in token.get("node_identities") or []:
+        if ni.get("datacenter") and ni["datacenter"] != dc:
+            continue
+        parts.append(_NODE_IDENTITY_RULES.format(ni["node_name"]))
+    return "".join(parts)
+
 
 class ResolveError(Exception):
     """Authority unreachable (the reference's RPC error path)."""
@@ -31,15 +65,19 @@ class ACLResolver:
                  default_policy: str = "allow",
                  down_policy: str = "extend-cache",
                  ttl: float = 30.0,
-                 fetch: Optional[Callable[[str], Optional[dict]]] = None):
+                 fetch: Optional[Callable[[str], Optional[dict]]] = None,
+                 dc: str = "dc1"):
         """`store` is any object with acl_token_get_by_secret /
         acl_policy_get; `fetch` overrides token lookup (e.g. an RPC to the
-        primary DC) and may raise ResolveError."""
+        primary DC) and may raise ResolveError.  `dc` scopes identity
+        synthetic policies (datacenter-limited identities grant nothing
+        outside their datacenters)."""
         self.store = store
         self.enabled = enabled
         self.default_policy = default_policy
         self.down_policy = down_policy
         self.ttl = ttl
+        self.dc = dc
         self._fetch = fetch or self._local_fetch
         self._cache: Dict[str, Tuple[float, Authorizer]] = {}
         self._lock = threading.Lock()
@@ -57,6 +95,15 @@ class ACLResolver:
         if token.get("type") == "management":
             return ManagementAuthorizer()
         rules = []
+        synthetic = synthetic_identity_rules(token, self.dc)
+        if synthetic:
+            try:
+                rules.extend(policy_mod.parse(synthetic))
+            except policy_mod.PolicyError:
+                # a malformed identity name that slipped past creation
+                # validation must fail closed (grant nothing), not 500
+                # every request from this token
+                pass
         for pid in token.get("policies", []):
             pol = self.store.acl_policy_get(pid) or \
                 self.store.acl_policy_get_by_name(pid)
@@ -82,7 +129,9 @@ class ACLResolver:
             # operators can grant e.g. DNS read to anonymous), else the
             # bare default policy
             anon = self.store.acl_token_get(ANONYMOUS_ACCESSOR)
-            if anon and anon.get("policies"):
+            if anon and (anon.get("policies")
+                         or anon.get("service_identities")
+                         or anon.get("node_identities")):
                 return self._compile(anon)
             return self._default_authorizer()
         now = time.time()

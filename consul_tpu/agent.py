@@ -149,7 +149,7 @@ class Agent:
         self.node_name = node_name
         self.acl = ACLResolver(self.store, enabled=acl_enabled,
                                default_policy=acl_default_policy,
-                               down_policy=acl_down_policy)
+                               down_policy=acl_down_policy, dc=dc)
         # local state + AE: /v1/agent writes land here; the syncer pushes
         # to the catalog (reference split: agent/local + agent/ae vs
         # agent/consul catalog)

@@ -353,9 +353,15 @@ class Client:
         return bool(self._call("DELETE", f"/v1/acl/policy/{pid}")[0])
 
     def acl_token_create(self, policies: List[str] | None = None,
-                         description: str = "") -> dict:
+                         description: str = "",
+                         service_identities: List[dict] | None = None,
+                         node_identities: List[dict] | None = None) -> dict:
         body = {"Policies": [{"Name": p} for p in (policies or [])],
                 "Description": description}
+        if service_identities:
+            body["ServiceIdentities"] = service_identities
+        if node_identities:
+            body["NodeIdentities"] = node_identities
         return self._call("PUT", "/v1/acl/token", None,
                           json.dumps(body).encode())[0]
 

@@ -2066,10 +2066,44 @@ def _make_handler(srv: ApiServer):
                     or str(_uuid.uuid4())
                 policies = [p.get("ID") or p.get("Name")
                             for p in body.get("Policies", [])]
+                # identity grants (structs.ACLServiceIdentity /
+                # ACLNodeIdentity, agent/structs/acl.go:141,193).
+                # Names are interpolated into synthetic policy HCL, so
+                # they must match the reference's strict charset
+                # (isValidServiceIdentityName — lowercase alnum/dash/
+                # underscore only); anything looser is rule injection.
+                _ident_re = re.compile(
+                    r"^[a-z0-9]([a-z0-9_-]*[a-z0-9])?$")
+                sids, nids = [], []
+                for si in body.get("ServiceIdentities") or []:
+                    name_ = (si or {}).get("ServiceName", "")
+                    if not _ident_re.fullmatch(name_ or ""):
+                        self._err(400, "ServiceIdentities require a "
+                                       "literal lowercase ServiceName "
+                                       "(alnum, dash, underscore)")
+                        return True
+                    sids.append({"service_name": name_,
+                                 "datacenters":
+                                     si.get("Datacenters") or []})
+                for ni in body.get("NodeIdentities") or []:
+                    name_ = (ni or {}).get("NodeName", "")
+                    if not _ident_re.fullmatch(name_ or ""):
+                        self._err(400, "NodeIdentities require a "
+                                       "literal lowercase NodeName "
+                                       "(alnum, dash, underscore)")
+                        return True
+                    if not ni.get("Datacenter"):
+                        self._err(400, "NodeIdentities require a "
+                                       "Datacenter")
+                        return True
+                    nids.append({"node_name": name_,
+                                 "datacenter": ni["Datacenter"]})
                 store.acl_token_set(accessor, secret, policies,
                                     body.get("Description", ""),
                                     token_type=existing.get("type", "client"),
-                                    local=body.get("Local", False))
+                                    local=body.get("Local", False),
+                                    service_identities=sids,
+                                    node_identities=nids)
                 srv.acl.invalidate()
                 self._send(_token_json(store.acl_token_get(accessor), store))
                 return True
@@ -2089,9 +2123,11 @@ def _make_handler(srv: ApiServer):
                     self._err(404, "token not found")
                     return True
                 accessor, secret = str(_uuid.uuid4()), str(_uuid.uuid4())
-                store.acl_token_set(accessor, secret, src["policies"],
-                                    src["description"], src["type"],
-                                    src["local"])
+                store.acl_token_set(
+                    accessor, secret, src["policies"],
+                    src["description"], src["type"], src["local"],
+                    service_identities=src.get("service_identities"),
+                    node_identities=src.get("node_identities"))
                 self._send(_token_json(store.acl_token_get(accessor), store))
                 return True
             m = re.fullmatch(r"/v1/acl/token/([^/]+)", path)
@@ -3088,6 +3124,18 @@ def _token_json(t: dict, store, secret: bool = True) -> dict:
            "Policies": policies, "Local": t["local"],
            "Type": t["type"],
            "CreateIndex": t["create_index"], "ModifyIndex": t["modify_index"]}
+    sids = t.get("service_identities") or []
+    if sids:
+        out["ServiceIdentities"] = [
+            dict({"ServiceName": s["service_name"]},
+                 **({"Datacenters": s["datacenters"]}
+                    if s.get("datacenters") else {}))
+            for s in sids]
+    nids = t.get("node_identities") or []
+    if nids:
+        out["NodeIdentities"] = [{"NodeName": n["node_name"],
+                                  "Datacenter": n["datacenter"]}
+                                 for n in nids]
     if secret:
         out["SecretID"] = t["secret"]
     return out

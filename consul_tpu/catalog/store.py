@@ -784,7 +784,13 @@ class StateStore:
     def acl_token_set(self, accessor: str, secret: str,
                       policies: List[str] | None = None,
                       description: str = "", token_type: str = "client",
-                      local: bool = False) -> int:
+                      local: bool = False,
+                      service_identities: List[dict] | None = None,
+                      node_identities: List[dict] | None = None) -> int:
+        """Identities are the high-level grants real deployments mint
+        per-sidecar/per-agent tokens with (structs.ACLServiceIdentity
+        agent/structs/acl.go:141, ACLNodeIdentity :193); the resolver
+        synthesizes their policies at compile time."""
         with self._lock:
             idx = self._bump([("acl", f"token:{accessor}")])
             existing = self._acl_tokens.get(accessor, {})
@@ -792,6 +798,8 @@ class StateStore:
                 "secret": secret, "policies": policies or [],
                 "description": description, "type": token_type,
                 "local": local,
+                "service_identities": service_identities or [],
+                "node_identities": node_identities or [],
                 "create_index": existing.get("create_index", idx),
                 "modify_index": idx,
             }

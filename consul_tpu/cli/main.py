@@ -855,10 +855,35 @@ def cmd_acl(args) -> int:
             return 0
     if sub == "token":
         if obj == "create":
+            # -service-identity web / web:dc1,dc2 and
+            # -node-identity n1:dc1 (command/acl/token/create flags)
+            sids = []
+            for spec in args.service_identity or []:
+                name, _, dcs = spec.partition(":")
+                sids.append(dict(
+                    {"ServiceName": name},
+                    **({"Datacenters": dcs.split(",")} if dcs else {})))
+            nids = []
+            for spec in args.node_identity or []:
+                name, _, dc = spec.partition(":")
+                if not dc:
+                    print("-node-identity requires NAME:DATACENTER",
+                          file=sys.stderr)
+                    return 1
+                nids.append({"NodeName": name, "Datacenter": dc})
             out = c.acl_token_create(args.policy_name or [],
-                                     args.description or "")
+                                     args.description or "",
+                                     service_identities=sids or None,
+                                     node_identities=nids or None)
             print(f"AccessorID:   {out['AccessorID']}")
             print(f"SecretID:     {out['SecretID']}")
+            for s in out.get("ServiceIdentities") or []:
+                print(f"Service Identity: {s['ServiceName']}"
+                      + (f" ({', '.join(s['Datacenters'])})"
+                         if s.get("Datacenters") else ""))
+            for n in out.get("NodeIdentities") or []:
+                print(f"Node Identity: {n['NodeName']} "
+                      f"({n['Datacenter']})")
             return 0
         if obj == "list":
             for t in c.acl_token_list():
@@ -866,6 +891,11 @@ def cmd_acl(args) -> int:
                 print(f"Description:  {t['Description']}")
                 print(f"Policies:     "
                       f"{', '.join(p['Name'] for p in t['Policies'])}")
+                for s in t.get("ServiceIdentities") or []:
+                    print(f"Service Identity: {s['ServiceName']}")
+                for n in t.get("NodeIdentities") or []:
+                    print(f"Node Identity: {n['NodeName']} "
+                          f"({n['Datacenter']})")
                 print()
             return 0
         if obj == "read":
@@ -906,6 +936,10 @@ def build_parser() -> argparse.ArgumentParser:
     x = toksub.add_parser("create")
     x.add_argument("-policy-name", action="append")
     x.add_argument("-description", default="")
+    x.add_argument("-service-identity", action="append",
+                   dest="service_identity")
+    x.add_argument("-node-identity", action="append",
+                   dest="node_identity")
     toksub.add_parser("list")
     for name in ("read", "delete"):
         x = toksub.add_parser(name)

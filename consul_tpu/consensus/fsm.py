@@ -144,9 +144,12 @@ class ServerFSM:
         return {"index": self.store.acl_policy_delete(pid)}
 
     def _acl_token_set(self, accessor, secret, policies=None,
-                       description="", token_type="client", local=False):
+                       description="", token_type="client", local=False,
+                       service_identities=None, node_identities=None):
         return {"index": self.store.acl_token_set(
-            accessor, secret, policies, description, token_type, local)}
+            accessor, secret, policies, description, token_type, local,
+            service_identities=service_identities,
+            node_identities=node_identities)}
 
     def _acl_token_delete(self, accessor):
         return {"index": self.store.acl_token_delete(accessor)}

@@ -1,0 +1,77 @@
+"""Envoy version gating for the ADS server.
+
+The reference rejects ADS streams from Envoy builds it does not
+support before serving them any config
+(agent/xds/envoy_versioning.go determineSupportedProxyFeatures,
+called on stream start at agent/xds/server.go:360 / delta.go:177):
+the announced `node.user_agent_build_version` is compared against a
+minimum mainline version plus a denylist of broken point releases.
+Serving an unsupported proxy risks silent misconfiguration — failing
+the stream with a clear reason is strictly better.
+
+Custom builds that announce no version (or a non-envoy user agent)
+pass through ungated, matching the reference's nil-version behavior.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+# Oldest supported mainline (proxysupport.EnvoyVersions floor — the
+# reference pins 1.15.0 for the Envoy generation this API targets).
+MIN_SUPPORTED = (1, 15, 0)
+
+# Specific point releases rejected with an upgrade hint even though
+# their mainline is supported (envoy_versioning.go
+# specificUnsupportedVersions shape; empty in the reference at this
+# vintage, populated here the same way when needed).
+SPECIFIC_UNSUPPORTED: dict = {}
+
+
+def version_from_node(node) -> Optional[Tuple[int, int, int]]:
+    """(major, minor, patch) announced by an envoy node, or None for
+    custom/ancient builds with no parseable version
+    (determineEnvoyVersionFromNode)."""
+    if node is None:
+        return None
+    if getattr(node, "user_agent_name", "") != "envoy":
+        return None
+    which = None
+    try:
+        which = node.WhichOneof("user_agent_version_type")
+    except Exception:
+        pass
+    if which == "user_agent_build_version":
+        v = node.user_agent_build_version.version
+        return (v.major_number, v.minor_number, v.patch)
+    if which == "user_agent_version":
+        # tolerate build suffixes ("1.14.9-dev"): leading digits of
+        # each dotted part; a part with no digits at all is unparseable
+        import re as _re
+        nums = []
+        for part in node.user_agent_version.split(".")[:3]:
+            m = _re.match(r"\d+", part)
+            if m is None:
+                return None
+            nums.append(int(m.group()))
+        if not nums:
+            return None
+        return tuple(nums + [0] * (3 - len(nums)))  # type: ignore
+    return None
+
+
+def check_supported(node) -> Optional[str]:
+    """None when the announced version is servable; otherwise the
+    rejection reason the stream should fail with."""
+    v = version_from_node(node)
+    if v is None:
+        return None
+    if v < MIN_SUPPORTED:
+        return (f"Envoy {v[0]}.{v[1]}.{v[2]} is too old and is not "
+                f"supported by this control plane (minimum "
+                f"{'.'.join(map(str, MIN_SUPPORTED))})")
+    hint = SPECIFIC_UNSUPPORTED.get(v)
+    if hint:
+        return (f"Envoy {v[0]}.{v[1]}.{v[2]} is an unsupported point "
+                f"release ({hint})")
+    return None

@@ -546,11 +546,14 @@ class Server:
         return self.raft_apply("acl_policy_delete", pid=pid)["index"]
 
     def acl_token_set(self, accessor, secret, policies=None, description="",
-                      token_type="client", local=False):
+                      token_type="client", local=False,
+                      service_identities=None, node_identities=None):
         return self.raft_apply(
             "acl_token_set", accessor=accessor, secret=secret,
             policies=policies, description=description,
-            token_type=token_type, local=local)["index"]
+            token_type=token_type, local=local,
+            service_identities=service_identities,
+            node_identities=node_identities)["index"]
 
     def acl_token_delete(self, accessor):
         return self.raft_apply("acl_token_delete", accessor=accessor)["index"]

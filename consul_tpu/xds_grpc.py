@@ -114,6 +114,15 @@ class AdsServicer:
         if not pid:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT,
                           "node.id required (proxy service id)")
+        # version gate BEFORE serving any resource: an unsupported
+        # envoy build announced in node metadata fails the stream with
+        # the reason (envoy_versioning.go, server.go:360)
+        from consul_tpu import envoy_versioning
+        reason = envoy_versioning.check_supported(node)
+        if reason is not None:
+            logging.getLogger("consul_tpu.xds").warning(
+                "rejecting ADS stream from %s: %s", pid, reason)
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, reason)
         watch = self.manager.watch(pid)
         if watch is None:
             context.abort(grpc.StatusCode.NOT_FOUND,

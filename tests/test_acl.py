@@ -424,3 +424,88 @@ def test_anonymous_token_policies_grant_dns_read(acl_agent):
             "anonymous-token read policy did not re-enable DNS"
     finally:
         st.acl_token_delete(ANONYMOUS_ACCESSOR)
+
+
+# ------------------------------------------- service / node identities
+
+def test_service_identity_token_runs_a_sidecar(acl_agent):
+    """The round-4 'done' bar (VERDICT #3): a sidecar registers itself
+    AND fetches its leaf certificate using ONLY a service-identity
+    token — no hand-written policy (structs.ACLServiceIdentity,
+    agent/structs/acl.go:141; synthetic rules acl_oss.go)."""
+    a = acl_agent
+    a.store.acl_token_set("root-acc", "root-sec", [],
+                          token_type="management")
+    a.acl.invalidate()
+    root = Client(a.http_address, token="root-sec")
+    out = root.acl_token_create(
+        service_identities=[{"ServiceName": "web"}],
+        description="web sidecar token")
+    assert out["ServiceIdentities"] == [{"ServiceName": "web"}]
+    web = Client(a.http_address, token=out["SecretID"])
+
+    # register the service and its sidecar (service:write on web and
+    # web-sidecar-proxy, both granted synthetically)
+    def _register(c, body):
+        c._call("PUT", "/v1/agent/service/register", None,
+                json.dumps(body).encode())
+    _register(web, {"Name": "web", "ID": "web-1", "Port": 8080})
+    _register(web, {
+        "Name": "web-sidecar-proxy", "ID": "web-sidecar-proxy",
+        "Kind": "connect-proxy", "Port": 21000,
+        "Proxy": {"DestinationServiceName": "web"}})
+    # fetch the leaf (service:write on web gates ca/leaf)
+    leaf = web._call("GET", "/v1/agent/connect/ca/leaf/web")[0]
+    assert "CertPEM" in leaf and "web" in leaf["ServiceURI"]
+    # read the catalog (service_prefix/node_prefix read)
+    assert isinstance(web.catalog_services(), dict)
+    # ...but NOT write anything else
+    with pytest.raises(ApiError) as e:
+        web.kv_put("app/x", b"1")
+    assert e.value.code == 403
+    with pytest.raises(ApiError) as e:
+        _register(web, {"Name": "db", "ID": "db-1", "Port": 1})
+    assert e.value.code == 403
+    # token JSON round-trips the identity
+    t = root.acl_token_read(out["AccessorID"])
+    assert t["ServiceIdentities"] == [{"ServiceName": "web"}]
+
+
+def test_node_identity_and_dc_scoping(acl_agent):
+    """NodeIdentity grants node:write in ITS datacenter only; a
+    ServiceIdentity limited to another datacenter grants nothing here
+    (agent/structs/acl.go:193 Datacenter fields)."""
+    a = acl_agent
+    a.store.acl_token_set("root-acc2", "root-sec2", [],
+                          token_type="management")
+    a.acl.invalidate()
+    root = Client(a.http_address, token="root-sec2")
+    out = root.acl_token_create(
+        node_identities=[{"NodeName": "edge-7", "Datacenter": "dc1"}])
+    node = Client(a.http_address, token=out["SecretID"])
+    assert node.catalog_register("edge-7", "10.0.0.77")
+    with pytest.raises(ApiError) as e:
+        node.catalog_register("other-node", "10.0.0.78")
+    assert e.value.code == 403
+
+    # identity scoped to dc2 is inert in this dc1 agent
+    out2 = root.acl_token_create(
+        service_identities=[{"ServiceName": "web",
+                             "Datacenters": ["dc2"]}])
+    foreign = Client(a.http_address, token=out2["SecretID"])
+    with pytest.raises(ApiError) as e:
+        foreign._call("GET", "/v1/agent/connect/ca/leaf/web")
+    assert e.value.code == 403
+
+    # malformed identities are client errors — including HCL-injection
+    # attempts (names are interpolated into synthetic policy text, so
+    # the charset is strict: isValidServiceIdentityName)
+    for bad in ("*", 'a" { policy = "write" } key_prefix "',
+                "Upper", "has space", ""):
+        with pytest.raises(ApiError) as e:
+            root.acl_token_create(
+                service_identities=[{"ServiceName": bad}])
+        assert e.value.code == 400, bad
+    with pytest.raises(ApiError) as e:
+        root.acl_token_create(node_identities=[{"NodeName": "n"}])
+    assert e.value.code == 400

@@ -511,3 +511,51 @@ def test_connect_envoy_bootstrap_cli(tmp_path):
             s.close()
     finally:
         a.stop()
+
+
+def test_envoy_version_gating(ads):
+    """An Envoy build older than the supported floor announced in
+    node.user_agent_build_version fails the stream with a clear reason
+    BEFORE any resource is served; supported and version-less nodes
+    pass (agent/xds/envoy_versioning.go, server.go:360)."""
+    # too old: 1.12.2 < 1.15.0 floor
+    s = _sotw_stream(ads)
+    r = _req("type.googleapis.com/envoy.config.cluster.v3.Cluster")
+    r.node.user_agent_name = "envoy"
+    v = r.node.user_agent_build_version.version
+    v.major_number, v.minor_number, v.patch = 1, 12, 2
+    s.send(r)
+    with pytest.raises(grpc.RpcError) as e:
+        s.recv()
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "too old" in e.value.details()
+    s.close()
+
+    # supported build: stream serves
+    s = _sotw_stream(ads)
+    r = _req("type.googleapis.com/envoy.config.cluster.v3.Cluster")
+    r.node.user_agent_name = "envoy"
+    v = r.node.user_agent_build_version.version
+    v.major_number, v.minor_number, v.patch = 1, 18, 3
+    s.send(r)
+    resp = s.recv()
+    assert resp.resources
+    s.close()
+
+    # version-less custom build: ungated (reference nil-version path)
+    s = _sotw_stream(ads)
+    s.send(_req("type.googleapis.com/envoy.config.cluster.v3.Cluster"))
+    resp = s.recv()
+    assert resp.resources
+    s.close()
+
+    # legacy string version field gates the same way
+    s = _sotw_stream(ads)
+    r = _req("type.googleapis.com/envoy.config.cluster.v3.Cluster")
+    r.node.user_agent_name = "envoy"
+    r.node.user_agent_version = "1.14.9"
+    s.send(r)
+    with pytest.raises(grpc.RpcError) as e:
+        s.recv()
+    assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    s.close()
