@@ -39,7 +39,15 @@ def test_mass_kill_exceeding_slot_table_converges():
             break
     assert float(rec) >= 0.999, f"recall stalled at {float(rec):.3f}"
     assert int(fp) == 0, f"{int(fp)} live nodes believed down"
-    # and the ground-truth commit bits agree
+    # the commit bits lag recall by a rumor lifetime (commit happens
+    # when a fully-covered dead rumor RELEASES its slot); since dense
+    # detection made recall much faster than slot turnover, run the
+    # expiry out before asserting ground truth
+    for _ in range(40):
+        committed = np.asarray(s.committed_dead)
+        if committed[victims].all():
+            break
+        s, _ = swim.run(params, s, 100)
     committed = np.asarray(s.committed_dead)
     assert committed[victims].all()
 
