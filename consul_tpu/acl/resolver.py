@@ -119,10 +119,15 @@ class ACLResolver:
             rules, default_policy="deny"
             if self.default_policy != "allow" else "write")
 
+    _MGMT = None     # shared allow-all: resolve() runs per request on
+    #                  the KV hot path; allocating one per call costs
+
     def resolve(self, secret: Optional[str]) -> Authorizer:
         if not self.enabled:
             # ACLs off: nothing is enforced, including ACL endpoints
-            return ManagementAuthorizer()
+            if ACLResolver._MGMT is None:
+                ACLResolver._MGMT = ManagementAuthorizer()
+            return ACLResolver._MGMT
         if not secret:
             # tokenless requests run as the anonymous token when one
             # exists (the reference resolves ANONYMOUS_ACCESSOR so

@@ -17,11 +17,14 @@ processes and the load generators all share one CPU, so --cluster
 throughput is a functional demonstration there, not a scaling
 measurement; the standalone numbers are the per-core comparison.
 
-Measured on the round-3 rig (1 core; BENCH_kv.json): standalone PUT
-~2.9k req/s, GET ~3.8k req/s vs the reference's 3.8k/7.5k on 8x2GHz
-cores per server; cluster quorum-write ~700 req/s with all three
-server processes AND the load generators sharing the single core
-(the reference's ~3.8k came from 24 dedicated server cores — per
+Measured on the round-4 rig (1 core; BENCH_kv.json): standalone PUT
+~6.3k req/s (1.66x the reference's absolute 3,779.9) and GET ~7.6k
+req/s (1.01x the absolute 7,524.9 — which the reference produced on
+8x2GHz cores per server), after the fastfront server core
+(consul_tpu/api/fastfront.py) replaced http.server's per-request
+machinery on the KV hot path; cluster quorum-write ~800 req/s with
+all three server processes AND the load generators sharing the single
+core (the reference's ~3.8k came from 24 dedicated server cores — per
 server-core this path sustains several times its ~157 req/s).
 """
 
@@ -34,7 +37,7 @@ import time
 sys.path.insert(0, ".")
 
 
-def _load_proc(addresses, per, conns, verb, body, q):
+def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
     """One load-generator PROCESS running `conns` connection threads.
     Load generation lives outside the server process so the server
     keeps its own GIL (the reference bench used a separate loadgen
@@ -65,6 +68,11 @@ def _load_proc(addresses, per, conns, verb, body, q):
 
     threads = [threading.Thread(target=worker, args=(w,), daemon=True)
                for w in range(conns)]
+    if barrier is not None:
+        # spawn-context children pay interpreter startup; that must
+        # not land inside anyone's measured window.  Bounded: a sibling
+        # dying pre-barrier must fail the bench, not hang it.
+        barrier.wait(timeout=120)
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -73,23 +81,40 @@ def _load_proc(addresses, per, conns, verb, body, q):
     q.put((time.perf_counter() - t0, errors[:3]))
 
 
-def drive(addresses, n_ops, conns, verb, body=None, procs=4):
+def drive(addresses, n_ops, conns, verb, body=None, procs=1):
     """`procs` load processes × (conns//procs) connections each,
-    spread over `addresses` (one or several servers)."""
+    spread over `addresses` (one or several servers).
+
+    Loadgen uses the SPAWN context: forking the jax-initialized bench
+    parent hands every load child a broken copy of the TPU runtime
+    state (os.fork + threads), which measurably throttles the
+    generators and understates the server (~20-30% on this rig).  A
+    spawned child imports only this module — no jax.
+
+    Default is ONE loadgen process (the reference bench drove from a
+    single `boom` box too): on a 1-core rig every extra loadgen
+    process preempts the server it is measuring — measured here,
+    procs 1/2/4 give GET 7.7k/6.1k/4.4k against the identical
+    server."""
     import multiprocessing as mp
     if isinstance(addresses, str):
         addresses = [addresses]
-    ctx = mp.get_context("fork")
+    ctx = mp.get_context("spawn")
     per_conn = max(1, n_ops // conns)
     conns_per_proc = max(1, conns // procs)
     q = ctx.Queue()
+    barrier = ctx.Barrier(procs + 1)
     ps = [ctx.Process(target=_load_proc,
                       args=(addresses, per_conn, conns_per_proc, verb,
-                            body, q), daemon=True)
+                            body, q, barrier), daemon=True)
           for _ in range(procs)]
-    t0 = time.perf_counter()
     for p in ps:
         p.start()
+    # all children imported + ready; bounded so a child that dies
+    # during interpreter start raises BrokenBarrierError instead of
+    # hanging the bench
+    barrier.wait(timeout=120)
+    t0 = time.perf_counter()
     results = [q.get(timeout=300) for _ in ps]
     for p in ps:
         p.join(timeout=30)
