@@ -66,6 +66,97 @@ def make_jwt_rs256(claims: dict, private_key_pem: str) -> str:
     return f"{header}.{payload}.{b64url_encode(sig)}"
 
 
+def jwk_to_pem(jwk: dict) -> Optional[str]:
+    """One RSA JWK → PEM public key (RFC 7517/7518 n/e members).  The
+    reference validates against JWKS documents through go-sso
+    (internal/go-sso/oidcauth/oidcjwt.go); this is the same math with
+    cryptography primitives."""
+    if jwk.get("kty") != "RSA":
+        return None
+    try:
+        from cryptography.hazmat.primitives import serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        n = int.from_bytes(_b64url_decode(jwk["n"]), "big")
+        e = int.from_bytes(_b64url_decode(jwk["e"]), "big")
+        pub = rsa.RSAPublicNumbers(e, n).public_key()
+        return pub.public_bytes(
+            serialization.Encoding.PEM,
+            serialization.PublicFormat.SubjectPublicKeyInfo).decode()
+    except (KeyError, ValueError):
+        return None
+
+
+def pem_to_jwk(public_key_pem: str, kid: str) -> dict:
+    """Test/ops helper: PEM public key → RSA JWK with `kid` (what an
+    IdP's jwks_uri would serve)."""
+    from cryptography.hazmat.primitives import serialization
+    pub = serialization.load_pem_public_key(public_key_pem.encode())
+    nums = pub.public_numbers()
+
+    def be(i: int) -> str:
+        return b64url_encode(i.to_bytes((i.bit_length() + 7) // 8,
+                                        "big"))
+
+    return {"kty": "RSA", "use": "sig", "alg": "RS256", "kid": kid,
+            "n": be(nums.n), "e": be(nums.e)}
+
+
+# login-hot-path caches: JWKS documents convert to PEMs once per
+# document identity (file mtime / content hash), and PEMs load into
+# key objects once (bounded; cleared wholesale when full)
+_jwks_pem_cache: Dict[tuple, List[str]] = {}
+
+
+def jwks_pubkeys(cfg: dict, kid: Optional[str]) -> List[str]:
+    """PEM keys from the method's JWKS trust material.  A token
+    carrying a `kid` matches ONLY that kid — an unknown kid FAILS
+    rather than brute-forcing every key (go-sso's keyset lookup
+    semantics); kid-less tokens try all keys.  Key ROTATION is the IdP
+    publishing a new kid and the operator updating the document
+    (jwks_url fetching needs egress, which this rig blocks; the
+    document itself rides config as `jwks_document` (dict or JSON
+    string) or `jwks_file` (path))."""
+    doc = cfg.get("jwks_document")
+    cache_key = None
+    if isinstance(doc, str):
+        try:
+            doc = json.loads(doc)
+        except ValueError:
+            raise AuthError("malformed jwks_document")
+    if doc is None and cfg.get("jwks_file"):
+        path = cfg["jwks_file"]
+        try:
+            import os
+            mtime = os.stat(path).st_mtime_ns
+            cache_key = ("file", path, mtime, kid)
+            hit = _jwks_pem_cache.get(cache_key)
+            if hit is not None:
+                return hit
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            raise AuthError(f"jwks_file unreadable: {e}")
+    if not isinstance(doc, dict):
+        return []
+    if cache_key is None:
+        cache_key = ("doc", json.dumps(doc, sort_keys=True), kid)
+        hit = _jwks_pem_cache.get(cache_key)
+        if hit is not None:
+            return hit
+    keys = doc.get("keys") or []
+    if kid is not None:
+        keys = [k for k in keys if k.get("kid") == kid]
+    pems = [pem for pem in (jwk_to_pem(k) for k in keys)
+            if pem is not None]
+    if len(_jwks_pem_cache) > 256:
+        _jwks_pem_cache.clear()
+    _jwks_pem_cache[cache_key] = pems
+    return pems
+
+
+_pem_key_cache: Dict[str, object] = {}
+
+
 def _verify_rs256(signing: bytes, sig: bytes,
                   pubkeys: List[str]) -> bool:
     from cryptography.exceptions import InvalidSignature
@@ -73,7 +164,12 @@ def _verify_rs256(signing: bytes, sig: bytes,
     from cryptography.hazmat.primitives.asymmetric import padding
     for pem in pubkeys:
         try:
-            pub = serialization.load_pem_public_key(pem.encode())
+            pub = _pem_key_cache.get(pem)
+            if pub is None:
+                pub = serialization.load_pem_public_key(pem.encode())
+                if len(_pem_key_cache) > 256:
+                    _pem_key_cache.clear()
+                _pem_key_cache[pem] = pub
             pub.verify(sig, signing, padding.PKCS1v15(),
                        hashes.SHA256())
             return True
@@ -84,12 +180,16 @@ def _verify_rs256(signing: bytes, sig: bytes,
 
 def validate_jwt(token: str, secret: str,
                  bound_audiences: Optional[List[str]] = None,
-                 pubkeys: Optional[List[str]] = None) -> dict:
+                 pubkeys: Optional[List[str]] = None,
+                 jwks_cfg: Optional[dict] = None,
+                 bound_issuer: str = "") -> dict:
     """JWT validation → claims dict (authmethod/validator role).
 
     The accepted algorithm follows the CONFIGURED trust material, never
-    the attacker-controlled header: a secret admits HS256, pubkeys
-    admit RS256 (jwtauth's locally-configured validation)."""
+    the attacker-controlled header: a secret admits HS256, pubkeys or a
+    JWKS document admit RS256 (jwtauth's locally-configured validation
+    + go-sso's JWKS mode; the token's `kid` selects the JWKS key, so
+    rotation is just publishing the new kid)."""
     parts = token.split(".")
     if len(parts) != 3:
         raise AuthError("malformed JWT")
@@ -106,13 +206,16 @@ def validate_jwt(token: str, secret: str,
         raise AuthError("malformed JWT")
     alg = header.get("alg")
     signing = f"{header_raw}.{payload_raw}".encode()
+    rsa_keys = list(pubkeys or [])
+    if jwks_cfg is not None:
+        rsa_keys += jwks_pubkeys(jwks_cfg, header.get("kid"))
     if alg == "HS256" and secret:
         want = hmac.new(secret.encode(), signing,
                         hashlib.sha256).digest()
         if not hmac.compare_digest(sig, want):
             raise AuthError("invalid signature")
-    elif alg == "RS256" and pubkeys:
-        if not _verify_rs256(signing, sig, pubkeys):
+    elif alg == "RS256" and rsa_keys:
+        if not _verify_rs256(signing, sig, rsa_keys):
             raise AuthError("invalid signature")
     else:
         raise AuthError(f"unsupported alg {alg!r} for configured "
@@ -125,6 +228,8 @@ def validate_jwt(token: str, secret: str,
             raise AuthError("malformed exp claim")
         if expired:
             raise AuthError("token expired")
+    if bound_issuer and claims.get("iss") != bound_issuer:
+        raise AuthError("issuer not allowed")
     if bound_audiences:
         aud = claims.get("aud")
         auds = aud if isinstance(aud, list) else [aud]
@@ -172,20 +277,40 @@ def interpolate(template: str, variables: Dict[str, str]) -> str:
     return re.sub(r"\$\{([\w.]+)\}", sub, template)
 
 
-def login(store, method_name: str, bearer: str) -> Tuple[str, str, list]:
+def login(store, method_name: str, bearer: str,
+          _code_flow: bool = False,
+          _expected_nonce: str = "") -> Tuple[str, str, list]:
     """Validate the bearer against the method, evaluate binding rules,
     mint a token: returns (accessor, secret, policies).
-    (ACL.Login — acl_endpoint.go)."""
+    (ACL.Login — acl_endpoint.go).
+
+    Method types: "jwt" (HS256 secret / RS256 PEM keys / RS256 JWKS
+    document) logs in directly; "oidc" is ONLY reachable through the
+    code flow (/v1/acl/oidc/auth-url + /callback, which call with
+    _code_flow=True) — the reference's ACL.Login rejects oidc methods
+    the same way, or the single-use-state/redirect/nonce controls
+    would be a decorative side door.  `_expected_nonce` binds the ID
+    token's nonce claim to the auth-url request's ClientNonce
+    (go-sso's code-injection defense)."""
     import uuid
     method = store.auth_method_get(method_name)
     if method is None:
         raise AuthError(f"unknown auth method {method_name!r}")
     cfg = method.get("config") or {}
-    if method.get("type") != "jwt":
-        raise AuthError(f"unsupported method type {method.get('type')!r}")
+    mtype = method.get("type")
+    allowed = ("jwt", "oidc") if _code_flow else ("jwt",)
+    if mtype not in allowed:
+        raise AuthError(f"auth method type {mtype!r} cannot login "
+                        f"via this endpoint")
     claims = validate_jwt(bearer, cfg.get("secret", ""),
                           cfg.get("bound_audiences"),
-                          pubkeys=cfg.get("jwt_validation_pubkeys"))
+                          pubkeys=cfg.get("jwt_validation_pubkeys"),
+                          jwks_cfg=cfg,
+                          bound_issuer=cfg.get("bound_issuer", ""))
+    if _expected_nonce and \
+            claims.get("nonce") != _expected_nonce:
+        raise AuthError("ID token nonce does not match the login "
+                        "request")
     variables = map_claims(claims, cfg.get("claim_mappings"))
     policies: List[str] = []
     for rule in store.binding_rule_list(method_name):

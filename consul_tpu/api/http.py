@@ -134,6 +134,15 @@ class ApiServer:
         # /debug/pprof analogues served only when explicitly enabled
         # (agent/http.go enable_debug gate)
         self.enable_debug = False
+        # OIDC code-flow plumbing (ssoauth shape): auth-url mints a
+        # single-use state; callback exchanges the code for an ID token
+        # through `oidc_token_fetcher` — INJECTABLE because the real
+        # exchange is an HTTPS POST to the IdP's token endpoint, which
+        # this rig's zero-egress policy blocks (tests inject a local
+        # fetcher; production would set one that can reach the IdP)
+        self.oidc_token_fetcher = None
+        self._oidc_states: dict = {}
+        self._oidc_lock = threading.Lock()
         # the agent's gRPC ADS port when one is bound (-1 = disabled);
         # surfaced via /v1/agent/self so `connect envoy -bootstrap`
         # can point a stock Envoy at it
@@ -759,7 +768,8 @@ def _make_handler(srv: ApiServer):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
             if path.startswith(("/v1/acl/login", "/v1/acl/logout",
                                 "/v1/acl/auth-method",
-                                "/v1/acl/binding-rule")):
+                                "/v1/acl/binding-rule",
+                                "/v1/acl/oidc/")):
                 return self._authmethods(verb, path, q)
             if path.startswith("/v1/acl"):
                 return self._acl(verb, path, q)
@@ -2584,6 +2594,94 @@ def _make_handler(srv: ApiServer):
                 self._send({"AccessorID": accessor, "SecretID": secret,
                             "Policies": [{"Name": p} for p in pols],
                             "AuthMethod": body.get("AuthMethod", "")})
+                return True
+            if path == "/v1/acl/oidc/auth-url" and verb == "PUT":
+                # ssoauth: build the IdP authorization URL + single-use
+                # state for the browser code flow (the flow's REDIRECT
+                # leg runs in the user's browser against the IdP, not
+                # through this agent)
+                body = json.loads(self._body() or b"{}")
+                method = store.auth_method_get(
+                    body.get("AuthMethod", ""))
+                if method is None or method.get("type") != "oidc":
+                    self._err(400, "AuthMethod must name an oidc-type "
+                                   "auth method")
+                    return True
+                cfg = method.get("config") or {}
+                redirect = body.get("RedirectURI", "")
+                # "AllowedRedirectURIs" snake-cases to
+                # allowed_redirect_ur_is (trailing plural acronym);
+                # accept both spellings rather than perturbing the
+                # global CamelCase converter's round-trip behavior
+                allowed = (cfg.get("allowed_redirect_uris")
+                           or cfg.get("allowed_redirect_ur_is") or [])
+                if redirect not in allowed:
+                    self._err(400, f"unauthorized RedirectURI "
+                                   f"{redirect!r}")
+                    return True
+                state = str(_uuid.uuid4())
+                with srv._oidc_lock:
+                    # single-use states with a 10-minute shelf life;
+                    # capped — this endpoint is unauthenticated, so an
+                    # unbounded map is a trivial memory DoS (oldest
+                    # outstanding states evict first)
+                    now = time.time()
+                    srv._oidc_states = {
+                        k: v for k, v in srv._oidc_states.items()
+                        if v["expires"] > now}
+                    while len(srv._oidc_states) >= 1024:
+                        srv._oidc_states.pop(
+                            next(iter(srv._oidc_states)))
+                    srv._oidc_states[state] = {
+                        "method": method["name"],
+                        "redirect_uri": redirect,
+                        "nonce": body.get("ClientNonce", ""),
+                        "expires": now + 600.0}
+                auth_ep = cfg.get("oidc_authorization_endpoint") or \
+                    (cfg.get("oidc_discovery_url", "").rstrip("/")
+                     + "/authorize")
+                qs = urllib.parse.urlencode({
+                    "response_type": "code",
+                    "client_id": cfg.get("oidc_client_id", ""),
+                    "redirect_uri": redirect,
+                    "scope": " ".join(["openid"]
+                                      + (cfg.get("oidc_scopes") or [])),
+                    "state": state,
+                    "nonce": body.get("ClientNonce", "")})
+                self._send({"AuthURL": f"{auth_ep}?{qs}"})
+                return True
+            if path == "/v1/acl/oidc/callback" and verb == "PUT":
+                body = json.loads(self._body() or b"{}")
+                state = body.get("State", "")
+                with srv._oidc_lock:
+                    st = srv._oidc_states.pop(state, None)
+                if st is None or st["expires"] < time.time():
+                    self._err(403, "unknown or expired OIDC state")
+                    return True
+                if srv.oidc_token_fetcher is None:
+                    self._err(503,
+                              "OIDC code exchange needs egress to the "
+                              "IdP token endpoint; no token fetcher is "
+                              "configured on this agent")
+                    return True
+                method = store.auth_method_get(st["method"])
+                if method is None:
+                    self._err(400, "auth method removed mid-flow")
+                    return True
+                try:
+                    id_token = srv.oidc_token_fetcher(
+                        method.get("config") or {},
+                        body.get("Code", ""), st["redirect_uri"])
+                    accessor, secret, pols = am.login(
+                        store, st["method"], id_token,
+                        _code_flow=True,
+                        _expected_nonce=st["nonce"])
+                except am.AuthError as e:
+                    self._err(403, str(e))
+                    return True
+                self._send({"AccessorID": accessor, "SecretID": secret,
+                            "Policies": [{"Name": p} for p in pols],
+                            "AuthMethod": st["method"]})
                 return True
             if path == "/v1/acl/logout" and verb == "PUT":
                 tok = store.acl_token_get_by_secret(self.token or "")
