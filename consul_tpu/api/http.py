@@ -185,6 +185,50 @@ class ApiServer:
             name, tag=tag or None, passing_only=passing == "True")
         return rows, self.store.index
 
+    def _ui_services_summary(self):
+        """Pre-ACL per-service summary rows (agent/ui_endpoint.go
+        UIServices / cache-types service_dump role); the route filters
+        by the requester's authorizer after the cache."""
+        st = self.store
+        kind_map = st.service_kind_map()
+        out = []
+        for name, tags in st.services().items():
+            rows = st.health_service_nodes(name)
+            statuses = [
+                ("critical" if any(c["status"] == "critical"
+                                   for c in r["checks"])
+                 else "warning" if any(c["status"] == "warning"
+                                       for c in r["checks"])
+                 else "passing") for r in rows]
+            kinds = kind_map.get(name, {""}) - {""}
+            out.append({
+                "Name": name, "Tags": tags,
+                "Kind": next(iter(kinds)) if kinds else "",
+                "InstanceCount": len(rows),
+                "ChecksPassing": statuses.count("passing"),
+                "ChecksWarning": statuses.count("warning"),
+                "ChecksCritical": statuses.count("critical"),
+            })
+        return out
+
+    def _ui_nodes_summary(self):
+        """Pre-ACL per-node summary rows (UINodes role)."""
+        st = self.store
+        out = []
+        for n in st.nodes():
+            checks = st.node_checks(n["node"])
+            out.append({
+                "Node": n["node"], "Address": n["address"],
+                "Checks": {
+                    "passing": sum(1 for c in checks
+                                   if c["status"] == "passing"),
+                    "warning": sum(1 for c in checks
+                                   if c["status"] == "warning"),
+                    "critical": sum(1 for c in checks
+                                    if c["status"] == "critical")},
+            })
+        return out
+
     def _register_cache_types(self) -> None:
         """The typed cache registry (agent/cache-types/: the reference
         registers 23 entries — discovery chain, CA leaf/roots,
@@ -252,6 +296,37 @@ class ApiServer:
         reg("config_entries",
             lambda key, mi, t: (st.config_entry_list(key or None),
                                 st.index), ttl=600.0)
+        # round-4 batch: the remaining reference cache types
+        # (agent/cache-types/) so ?cached is uniform across routes —
+        # every fetcher returns PRE-ACL data; the route applies the
+        # requester's filter after the cache, so entries are shareable
+        # across tokens exactly like the reference's
+        reg("catalog_datacenters",
+            lambda key, mi, t: (
+                self.router.datacenters() if self.router is not None
+                else [self.dc], st.index), ttl=600.0)
+        reg("service_dump",
+            lambda key, mi, t: (self._ui_services_summary(), st.index),
+            ttl=600.0)
+        reg("node_dump",
+            lambda key, mi, t: (self._ui_nodes_summary(), st.index),
+            ttl=600.0)
+        reg("checks_in_state",
+            lambda key, mi, t: (st.checks_in_state(key), st.index),
+            ttl=600.0)
+        reg("intention_list",
+            lambda key, mi, t: (st.intention_list(), st.index),
+            ttl=600.0)
+
+        def _fetch_prepared_query(key, mi, t):
+            # rsplit: the NAME is opaque and may contain a smuggled
+            # NUL — only the trailing discriminators are ours
+            name, limit, near = key.rsplit("\x00", 2)
+            res = self.query_executor.execute(
+                name, limit=int(limit or 0), near=near or None)
+            return res, st.index
+
+        reg("prepared_query", _fetch_prepared_query, ttl=600.0)
 
     def cached_read(self, type_name: str, key: str, headers, q):
         """(value, index, 'HIT'|'MISS') when the request OPTED INTO
@@ -1406,9 +1481,14 @@ def _make_handler(srv: ApiServer):
                 return True
             if path == "/v1/catalog/datacenters" and verb == "GET":
                 # WAN-distance-sorted DC list (catalog_endpoint.go
-                # ListDatacenters via router.GetDatacentersByDistance)
-                self._send(srv.router.datacenters()
-                           if srv.router is not None else [srv.dc])
+                # ListDatacenters via router.GetDatacentersByDistance;
+                # cached: cache-types/catalog_datacenters.go)
+                dcs, _idx, state = self._cache_or_live(
+                    "catalog_datacenters", "", q,
+                    lambda: (srv.router.datacenters()
+                             if srv.router is not None else [srv.dc]))
+                self._send(dcs,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/catalog/nodes" and verb == "GET":
                 raw_nodes, idx, state = self._cache_or_live(
@@ -1576,54 +1656,26 @@ def _make_handler(srv: ApiServer):
                 return True
             if path == "/v1/internal/ui/nodes" and verb == "GET":
                 # UI summary: one row per node with check counts
-                # (agent/ui_endpoint.go UINodes)
-                idx = self._block(q, ("nodes", ""), ("nodechecks", ""))
-                out = []
-                for n in store.nodes():
-                    if not self.authz.node_read(n["node"]):
-                        continue
-                    checks = store.node_checks(n["node"])
-                    out.append({
-                        "Node": n["node"], "Address": n["address"],
-                        "Checks": {
-                            "passing": sum(1 for c in checks
-                                           if c["status"] == "passing"),
-                            "warning": sum(1 for c in checks
-                                           if c["status"] == "warning"),
-                            "critical": sum(1 for c in checks
-                                            if c["status"] ==
-                                            "critical")},
-                    })
-                self._send(self._filtered(q, out), index=idx)
+                # (agent/ui_endpoint.go UINodes; cached via node_dump)
+                rows, idx, state = self._cache_or_live(
+                    "node_dump", "", q, srv._ui_nodes_summary,
+                    ("nodes", ""), ("nodechecks", ""))
+                out = [r for r in rows
+                       if self.authz.node_read(r["Node"])]
+                self._send(self._filtered(q, out), index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/internal/ui/services" and verb == "GET":
                 # UI summary: one row per service name with instance +
                 # check rollups and kind (agent/ui_endpoint.go
-                # UIServices)
-                idx = self._block(q, ("services", ""),
-                                  ("nodechecks", ""))
-                kind_map = store.service_kind_map()
-                out = []
-                for name, tags in store.services().items():
-                    if not self.authz.service_read(name):
-                        continue
-                    rows = store.health_service_nodes(name)
-                    statuses = [
-                        ("critical" if any(c["status"] == "critical"
-                                           for c in r["checks"])
-                         else "warning" if any(c["status"] == "warning"
-                                               for c in r["checks"])
-                         else "passing") for r in rows]
-                    kinds = kind_map.get(name, {""}) - {""}
-                    out.append({
-                        "Name": name, "Tags": tags,
-                        "Kind": next(iter(kinds)) if kinds else "",
-                        "InstanceCount": len(rows),
-                        "ChecksPassing": statuses.count("passing"),
-                        "ChecksWarning": statuses.count("warning"),
-                        "ChecksCritical": statuses.count("critical"),
-                    })
-                self._send(self._filtered(q, out), index=idx)
+                # UIServices; cached via the service_dump type)
+                rows, idx, state = self._cache_or_live(
+                    "service_dump", "", q, srv._ui_services_summary,
+                    ("services", ""), ("nodechecks", ""))
+                out = [r for r in rows
+                       if self.authz.service_read(r["Name"])]
+                self._send(self._filtered(q, out), index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(
                 r"/v1/internal/ui/gateway-services-nodes/(.+)", path)
@@ -1700,14 +1752,17 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/health/state/(.+)", path)
             if m and verb == "GET":
-                idx = self._block(q, ("nodechecks", ""))
+                checks, idx, state = self._cache_or_live(
+                    "checks_in_state", m.group(1), q,
+                    lambda: store.checks_in_state(m.group(1)),
+                    ("nodechecks", ""))
                 svc_cache: dict = {}
                 self._send(self._filtered(q, [
-                    _check_json(c, c["node"])
-                    for c in store.checks_in_state(m.group(1))
+                    _check_json(c, c["node"]) for c in checks
                     if self.authz.node_read(c["node"])
                     and self._check_visible(c["node"], c, svc_cache)]),
-                           index=idx)
+                           index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/session/create" and verb == "PUT":
                 body = json.loads(self._body() or b"{}")
@@ -2241,9 +2296,17 @@ def _make_handler(srv: ApiServer):
                 return True
             m = re.fullmatch(r"/v1/query/([^/]+)/execute", path)
             if m and verb == "GET":
-                res = srv.query_executor.execute(
-                    m.group(1), limit=int(q.get("limit", 0) or 0),
-                    near=q.get("near"))
+                # ?cached rides the prepared_query type
+                # (cache-types/prepared_query.go); the key carries the
+                # execute discriminators
+                ck = "\x00".join((m.group(1),
+                                  str(int(q.get("limit", 0) or 0)),
+                                  q.get("near") or ""))
+                res, _idx, state = self._cache_or_live(
+                    "prepared_query", ck, q,
+                    lambda: srv.query_executor.execute(
+                        m.group(1), limit=int(q.get("limit", 0) or 0),
+                        near=q.get("near")))
                 if res is None:
                     self._err(404, "query not found")
                     return True
@@ -2253,7 +2316,8 @@ def _make_handler(srv: ApiServer):
                 self._send({"Service": res["Service"], "Nodes": nodes,
                             "DNS": {"TTL": res["DNS"].get("ttl", "")},
                             "Datacenter": res["Datacenter"],
-                            "Failovers": res["Failovers"]})
+                            "Failovers": res["Failovers"]},
+                           extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(r"/v1/query/([^/]+)/explain", path)
             if m and verb == "GET":
@@ -2342,11 +2406,13 @@ def _make_handler(srv: ApiServer):
                 self._send({"ID": iid})
                 return True
             if path == "/v1/connect/intentions" and verb == "GET":
-                idx = self._block(q, ("intentions", ""))
-                self._send([self._intention_json(i)
-                            for i in store.intention_list()
+                rows, idx, state = self._cache_or_live(
+                    "intention_list", "", q, store.intention_list,
+                    ("intentions", ""))
+                self._send([self._intention_json(i) for i in rows
                             if self.authz.intention_read(i["destination"])],
-                           index=idx)
+                           index=idx,
+                           extra_headers=self._cache_headers(state))
                 return True
             if path == "/v1/connect/intentions/match" and verb == "GET":
                 name = q.get("name", "")

@@ -342,41 +342,93 @@ class SubscribeServicer:
         self.store = store
         self.authorize = authorize
 
-    def _rows(self, topic: str, key: str):
-        """Materialized rows for one (topic, key); key=\"\" = whole
-        topic."""
+    def _materialize(self, topic: str, key: str):
+        """Current state of (topic, key) as typed per-entity frames:
+        {entity_id: (frame_key, payload_field, message)}.  The
+        subscribe loop DIFFS consecutive materializations, so live
+        frames are per-entity deltas (pbsubscribe ServiceHealthUpdate
+        role), never keyset re-dumps; key=\"\" = whole topic."""
         st = self.store
+        out = {}
         if topic == "health":
-            if key:
-                names = [key]
-            else:
-                names = sorted(st.services())
-            return [{"Key": n,
-                     "Rows": [{"Service": r["service"],
-                               "Checks": r["checks"]}
-                              for r in st.health_service_nodes(n)]}
-                    for n in names]
-        if topic == "services":
-            return [{"Key": key, "Rows": [st.services()]}]
-        if topic == "kv":
-            import base64
-            return [{"Key": key, "Rows": [
-                {"Key": e["key"], "Flags": e["flags"],
-                 "Value": base64.b64encode(e["value"]).decode(),
-                 "ModifyIndex": e["modify_index"],
-                 "Session": e.get("session", "")}
-                for e in st.kv_list(key)]}]
-        if topic == "intentions":
-            return [{"Key": key, "Rows": st.intention_list()}]
-        if topic == "nodes":
-            rows = st.nodes()
-            if key:
-                rows = [r for r in rows if r["node"] == key]
-            return [{"Key": key, "Rows": rows}]
-        return []
+            names = [key] if key else sorted(st.services())
+            for n in names:
+                for r in st.health_service_nodes(n):
+                    s = r["service"]
+                    inst = xds_pb.ServiceInstance(
+                        node=s["node"], address=s["address"],
+                        service_id=s["service_id"], service=n,
+                        port=s["port"],
+                        service_address=s["service_address"],
+                        kind=s.get("kind") or "",
+                        checks=[xds_pb.Check(
+                            check_id=c["check_id"], name=c["name"],
+                            status=c["status"],
+                            service_id=c.get("service_id", ""),
+                            output=c.get("output", ""),
+                            node=c.get("node", ""))
+                            for c in r["checks"]])
+                    out[f"h|{n}|{s['node']}|{s['service_id']}"] = (
+                        n, "service_health",
+                        xds_pb.ServiceHealthUpdate(op="register",
+                                                   instance=inst))
+        elif topic == "services":
+            for name, tags in sorted(st.services().items()):
+                out[f"s|{name}"] = (name, "service_list",
+                                    xds_pb.ServiceListUpdate(
+                                        op="update", name=name,
+                                        tags=list(tags)))
+        elif topic == "kv":
+            for e in st.kv_list(key):
+                out[f"k|{e['key']}"] = (e["key"], "kv", xds_pb.KVUpdate(
+                    op="update", key=e["key"], value=e["value"],
+                    flags=e["flags"], modify_index=e["modify_index"],
+                    session=e.get("session") or ""))
+        elif topic == "intentions":
+            for it in st.intention_list():
+                out[f"i|{it['id']}"] = (it["id"], "intention",
+                                        xds_pb.IntentionUpdate(
+                    op="update", id=it["id"], source=it["source"],
+                    destination=it["destination"], action=it["action"],
+                    precedence=it["precedence"]))
+        elif topic == "nodes":
+            for r in st.nodes():
+                if key and r["node"] != key:
+                    continue
+                out[f"n|{r['node']}"] = (r["node"], "node_update",
+                                         xds_pb.NodeUpdate(
+                    op="update", node=r["node"],
+                    address=r["address"]))
+        return out
+
+    _DELETE_OP = {"service_health": "deregister"}
+
+    def _diff_frames(self, topic, prev, cur, index):
+        """Typed delta frames between two materializations: one frame
+        per added/changed entity, one tombstone per removed entity."""
+        frames = []
+        for eid, (fkey, field, msg) in cur.items():
+            old = prev.get(eid)
+            if old is not None and \
+                    old[2].SerializeToString(deterministic=True) == \
+                    msg.SerializeToString(deterministic=True):
+                continue
+            frames.append(xds_pb.StreamEvent(
+                index=index, topic=topic, key=fkey,
+                op=getattr(msg, "op", "update") or "update",
+                **{field: msg}))
+        for eid, (fkey, field, msg) in prev.items():
+            if eid in cur:
+                continue
+            tomb = type(msg)()
+            tomb.CopyFrom(msg)
+            tomb.op = self._DELETE_OP.get(field, "delete")
+            frames.append(xds_pb.StreamEvent(
+                index=index, topic=topic, key=fkey, op=tomb.op,
+                **{field: tomb}))
+        return frames
 
     def subscribe(self, request, context):
-        import json as _json
         from consul_tpu.stream.publisher import SnapshotRequired
         topic, key = request.topic, request.key
         if topic not in self.TOPICS:
@@ -394,28 +446,58 @@ class SubscribeServicer:
         while context.is_active():
             # subscribe FIRST, snapshot second: no event between the
             # two can be missed (submatview discipline).  A resume
-            # index replays history instead of re-snapshotting; if the
-            # buffer already evicted it, SnapshotRequired falls through
-            # to a fresh snapshot cycle below.
+            # index replays history; since event frames carry no
+            # payload history to diff against, ANY change past the
+            # client's index makes its view unverifiable → reset with
+            # new_snapshot_to_follow (the reference's stale-view
+            # semantics, stream/subscription.go forceClose).
+            view = {}
+            if resume_from is not None:
+                # seed the diff base BEFORE subscribing: an event
+                # landing in the gap shows up in the replay check below
+                view = self._materialize(topic, key)
             try:
-                sub = pub.subscribe(topic, key or None,
+                # kv keys are PREFIXES (like /v1/kv recurse), but the
+                # publisher matches event keys exactly — follow the
+                # whole topic and let the materialize/diff scope to the
+                # prefix (an out-of-prefix write diffs to zero frames)
+                sub_key = None if topic == "kv" else (key or None)
+                sub = pub.subscribe(topic, sub_key,
                                     since_index=resume_from)
             except SnapshotRequired:
                 resume_from = None
                 continue
+            stale_resume = False
             try:
-                if resume_from is None:
-                    idx = self.store.index
-                    for group in self._rows(topic, key):
+                if resume_from is not None:
+                    try:
+                        pending = sub.events(timeout=0.0)
+                        if topic == "kv" and key:
+                            # whole-topic sub for a prefix watch:
+                            # out-of-prefix writes don't stale THIS
+                            # client's view
+                            pending = [e for e in pending
+                                       if e.key.startswith(key)]
+                    except SnapshotRequired:
+                        pending = [True]
+                    if pending:
                         yield xds_pb.StreamEvent(
-                            index=idx, topic=topic, key=group["Key"],
-                            payload=_json.dumps(
-                                group["Rows"],
-                                default=_bytes_safe).encode())
+                            topic=topic, key=key,
+                            new_snapshot_to_follow=True)
+                        resume_from = None
+                        stale_resume = True
+                if not stale_resume and resume_from is None:
+                    idx = self.store.index
+                    view = self._materialize(topic, key)
+                    for eid, (fkey, field, msg) in view.items():
+                        yield xds_pb.StreamEvent(
+                            index=idx, topic=topic, key=fkey,
+                            op=getattr(msg, "op", "update"),
+                            **{field: msg})
                     yield xds_pb.StreamEvent(
                         index=idx, topic=topic, key=key,
                         end_of_snapshot=True)
-                while context.is_active():
+                while not stale_resume and context.is_active():
                     try:
                         batch = sub.events(timeout=1.0)
                     except SnapshotRequired:
@@ -424,30 +506,23 @@ class SubscribeServicer:
                             new_snapshot_to_follow=True)
                         resume_from = None
                         break
-                    # one frame per distinct key in the batch: N events
-                    # on the same key materialize once, not N times
-                    seen = {}
-                    for ev in batch:
-                        seen[(ev.topic, ev.key)] = ev
-                    for (t, k), ev in seen.items():
-                        for group in self._rows(topic, key or k):
-                            yield xds_pb.StreamEvent(
-                                index=ev.index, topic=t,
-                                key=group["Key"], op=ev.op,
-                                payload=_json.dumps(
-                                    group["Rows"],
-                                    default=_bytes_safe).encode())
+                    if not batch:
+                        continue
+                    # N raw events collapse into ONE diff against the
+                    # last shipped view: each changed entity yields
+                    # exactly one typed delta frame
+                    idx = max(ev.index for ev in batch)
+                    cur = self._materialize(topic, key)
+                    for frame in self._diff_frames(topic, view, cur,
+                                                   idx):
+                        yield frame
+                    view = cur
                 else:
-                    return
+                    if stale_resume:
+                        continue     # outer loop: fresh snapshot cycle
+                    return           # client went away
             finally:
                 sub.close()
-
-
-def _bytes_safe(o):
-    if isinstance(o, (bytes, bytearray)):
-        import base64
-        return base64.b64encode(bytes(o)).decode()
-    raise TypeError(f"unserializable {type(o)}")
 
 
 class XdsGrpcServer:

@@ -68,6 +68,13 @@ from consultpu.stream.v1 import subscribe_pb2 as _subscribe_pb2  # noqa: E402
 
 SubscribeRequest = _subscribe_pb2.SubscribeRequest
 StreamEvent = _subscribe_pb2.StreamEvent
+Check = _subscribe_pb2.Check
+ServiceInstance = _subscribe_pb2.ServiceInstance
+ServiceHealthUpdate = _subscribe_pb2.ServiceHealthUpdate
+ServiceListUpdate = _subscribe_pb2.ServiceListUpdate
+KVUpdate = _subscribe_pb2.KVUpdate
+IntentionUpdate = _subscribe_pb2.IntentionUpdate
+NodeUpdate = _subscribe_pb2.NodeUpdate
 
 
 def from_dict(resource: dict):

@@ -238,13 +238,27 @@ def test_typed_cache_registry_covers_core_reads():
                 "connect_ca_roots", "connect_ca_leaf",
                 "intention_match", "discovery_chain",
                 "gateway_services", "federation_states",
-                "config_entries"} <= types
+                "config_entries",
+                # round-4 batch (VERDICT r3 missing #7): the remaining
+                # reference cache types so ?cached is uniform
+                "catalog_datacenters", "service_dump", "node_dump",
+                "checks_in_state", "intention_list",
+                "prepared_query"} <= types
 
         def get(path, headers=None):
             req = urllib.request.Request(
                 a.http_address + path, headers=headers or {})
             r = urllib.request.urlopen(req, timeout=15)
             return r.headers.get("X-Cache"), r.read()
+
+        # a prepared query for the ?cached execute path
+        import json as _json
+        req = urllib.request.Request(
+            a.http_address + "/v1/query",
+            data=_json.dumps({"Name": "qc", "Service":
+                              {"Service": "web"}}).encode(),
+            method="PUT")
+        urllib.request.urlopen(req, timeout=15)
 
         cc = {"Cache-Control": "max-age=60"}
         for path in ("/v1/catalog/services",
@@ -255,7 +269,13 @@ def test_typed_cache_registry_covers_core_reads():
                      "/v1/health/checks/web",
                      "/v1/discovery-chain/web",
                      "/v1/connect/intentions/match?name=web"
-                     "&by=destination"):
+                     "&by=destination",
+                     "/v1/catalog/datacenters",
+                     "/v1/internal/ui/services",
+                     "/v1/internal/ui/nodes",
+                     "/v1/health/state/passing",
+                     "/v1/connect/intentions",
+                     "/v1/query/qc/execute"):
             sep = "&" if "?" in path else "?"
             s1, _ = get(path + sep + "cached", cc)
             s2, body = get(path + sep + "cached", cc)
