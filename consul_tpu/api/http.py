@@ -954,6 +954,25 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.agent_read(srv.node_name):
                     return self._forbid()
                 from consul_tpu import telemetry
+                if q.get("format") == "prometheus":
+                    # the reference serves text exposition when
+                    # prometheus retention is on (agent_endpoint.go
+                    # AgentMetrics + lib/telemetry.go PrometheusOpts).
+                    # The live gauges append as TEXT — rendering a
+                    # scrape must not mutate the shared registry (or
+                    # later JSON dumps would carry stale duplicates
+                    # and sinks would see scrape side effects)
+                    reg = telemetry.default_registry()
+                    extra = (
+                        "# TYPE consul_sim_tick gauge\n"
+                        f"consul_sim_tick {int(oracle.tick)}\n"
+                        "# TYPE consul_catalog_index gauge\n"
+                        f"consul_catalog_index {store.index}\n")
+                    self._send(None,
+                               raw=(reg.prometheus() + extra).encode(),
+                               ctype="text/plain; version=0.0.4; "
+                                     "charset=utf-8")
+                    return True
                 out = telemetry.default_registry().dump()
                 out["Gauges"] += [
                     {"Name": "consul.sim.tick", "Value": oracle.tick},

@@ -500,6 +500,7 @@ class StateStore:
                 rows.append({"node": node, "address": nrec.get("address", ""),
                              "service_id": sid, "service_name": name,
                              "port": v["port"], "tags": v["tags"],
+                             "meta": v.get("meta", {}),
                              "service_address": v["address"],
                              "kind": v.get("kind", ""),
                              "proxy": v.get("proxy", {}),
@@ -583,6 +584,7 @@ class StateStore:
                              "service_id": sid,
                              "service_name": v["name"],
                              "port": v["port"], "tags": v["tags"],
+                             "meta": v.get("meta", {}),
                              "service_address": v["address"],
                              "kind": v.get("kind", ""),
                              "proxy": v.get("proxy", {}),

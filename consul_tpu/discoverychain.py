@@ -51,20 +51,33 @@ def service_protocol(store, service: str) -> str:
     return "tcp"
 
 
-def _add_target(chain: dict, service: str, dc: Optional[str] = None) -> str:
+def _add_target(chain: dict, service: str, dc: Optional[str] = None,
+                subset: str = "", subset_def: Optional[dict] = None) -> str:
+    """Register a chain target.  Subset targets prefix the id the way
+    the reference's SNI names do (`<subset>.<service>.<ns>.<dc>` —
+    connect.ServiceSNI), carrying the subset's filter/only_passing so
+    endpoint resolution can apply them (ServiceResolverSubset,
+    structs/config_entry_discoverychain.go:687)."""
     dc = dc or chain["Datacenter"]
-    tid = f"{service}.default.{dc}"
-    chain["Targets"].setdefault(tid, {"Service": service,
-                                      "Datacenter": dc})
+    tid = f"{subset}.{service}.default.{dc}" if subset \
+        else f"{service}.default.{dc}"
+    tgt = {"Service": service, "Datacenter": dc}
+    if subset:
+        tgt["Subset"] = subset
+        sd = subset_def or {}
+        tgt["Filter"] = sd.get("filter", "")
+        tgt["OnlyPassing"] = bool(sd.get("only_passing", False))
+    chain["Targets"].setdefault(tid, tgt)
     return tid
 
 
 def _resolver_node(store, service: str, chain: dict,
-                   depth: int = 0) -> str:
-    """Build (and register in chain) the resolver node for `service`,
-    following redirects (compile.go resolver handling).  Returns the
-    node id."""
-    nid = f"resolver:{service}"
+                   depth: int = 0, subset: str = "") -> str:
+    """Build (and register in chain) the resolver node for
+    (`service`, `subset`), following redirects (compile.go resolver
+    handling).  Returns the node id."""
+    nid = f"resolver:{subset}.{service}" if subset \
+        else f"resolver:{service}"
     if nid in chain["Nodes"]:
         return nid
     if depth > 8:
@@ -77,29 +90,58 @@ def _resolver_node(store, service: str, chain: dict,
                                "RedirectDepthExceeded": True}
         return nid
     res = _entry(store, "service-resolver", service) or {}
-    redirect = (res.get("redirect") or {}).get("service")
+    red = res.get("redirect") or {}
+    redirect = red.get("service")
     if redirect and redirect != service:
-        target = _resolver_node(store, redirect, chain, depth + 1)
+        # the redirect's own service_subset wins; else the caller's
+        # requested subset follows through the indirection
+        target = _resolver_node(
+            store, redirect, chain, depth + 1,
+            subset=red.get("service_subset") or subset)
         chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
                                "Redirect": redirect, "Resolver": target}
         return nid
-    target = _add_target(chain, service)
-    # failover legs become REAL targets: other services in this dc
-    # and/or the same service in other datacenters, ordered — the xDS
-    # layer emits them as priority>0 endpoint groups
-    # (compile.go rewriteFailover → envoy priority failover)
+    subsets = res.get("subsets") or {}
+    want_subset = subset or res.get("default_subset", "")
+    if want_subset and want_subset not in subsets:
+        want_subset = ""          # unknown subset: unnamed default
+    target = _add_target(chain, service, subset=want_subset,
+                         subset_def=subsets.get(want_subset))
+    # failover legs become REAL targets: other services/subsets in
+    # this dc and/or the same service in other datacenters, ordered —
+    # the xDS layer emits them as priority>0 endpoint groups
+    # (compile.go rewriteFailover → envoy priority failover).  The
+    # map is keyed by subset; "*" is the any-subset wildcard.
     failover_targets: List[str] = []
     fo = res.get("failover")
     if isinstance(fo, dict):
-        # "*" applies to every subset; named-subset keys fold in order
-        for f in fo.values():
+        # an exact subset key OVERRIDES the "*" wildcard — the
+        # wildcard covers only subsets with no explicit entry
+        if want_subset in fo:
+            applicable = [fo[want_subset]]
+        elif "*" in fo:
+            applicable = [fo["*"]]
+        else:
+            applicable = []
+        for f in applicable:
             fsvc = f.get("service") or service
             dcs = f.get("datacenters") or []
+            fres = _entry(store, "service-resolver", fsvc) or {} \
+                if fsvc != service else res
+            # empty service_subset → the target service's DEFAULT
+            # subset (ServiceResolverFailover.ServiceSubset semantics)
+            fsub = f.get("service_subset") \
+                or fres.get("default_subset", "")
+            if fsub not in (fres.get("subsets") or {}):
+                fsub = ""
+            fdef = (fres.get("subsets") or {}).get(fsub)
             if dcs:
                 for dc in dcs:
-                    failover_targets.append(_add_target(chain, fsvc, dc))
-            elif fsvc != service:
-                failover_targets.append(_add_target(chain, fsvc))
+                    failover_targets.append(_add_target(
+                        chain, fsvc, dc, subset=fsub, subset_def=fdef))
+            elif fsvc != service or fsub:
+                failover_targets.append(_add_target(
+                    chain, fsvc, subset=fsub, subset_def=fdef))
     chain["Nodes"][nid] = {
         "Type": "resolver", "Name": service,
         "ConnectTimeout": res.get("connect_timeout", "5s"),
@@ -110,7 +152,13 @@ def _resolver_node(store, service: str, chain: dict,
     return nid
 
 
-def _splitter_node(store, service: str, chain: dict) -> str:
+def _splitter_node(store, service: str, chain: dict,
+                   subset: str = "") -> str:
+    # an EXPLICITLY requested subset pins the resolver for that subset
+    # — the service's splitter applies only to unpinned traffic
+    # (compile.go getSplitterOrResolverNode subset handling)
+    if subset:
+        return _resolver_node(store, service, chain, subset=subset)
     split = _entry(store, "service-splitter", service)
     if split is None:
         return _resolver_node(store, service, chain)
@@ -121,7 +169,9 @@ def _splitter_node(store, service: str, chain: dict) -> str:
     for leg in split.get("splits") or []:
         svc = leg.get("service", service)
         legs.append({"Weight": leg.get("weight", 0),
-                     "Node": _resolver_node(store, svc, chain)})
+                     "Node": _resolver_node(
+                         store, svc, chain,
+                         subset=leg.get("service_subset", ""))})
     chain["Nodes"][nid] = {"Type": "splitter", "Name": service,
                            "Splits": legs}
     return nid
@@ -185,7 +235,9 @@ def compile_chain(store, service: str, dc: str = "dc1") -> dict:
                     "RetryOnStatusCodes": list(
                         dest_def.get("retry_on_status_codes") or []),
                 },
-                "Node": _splitter_node(store, dest, chain),
+                "Node": _splitter_node(
+                    store, dest, chain,
+                    subset=dest_def.get("service_subset", "")),
             })
         # default catch-all to the service itself (compile.go appends
         # the implicit default route)
@@ -209,11 +261,13 @@ def is_default_chain(chain: dict) -> bool:
     CompiledDiscoveryChain.IsDefault(), which gates whether the xDS
     layer emits plain upstream resources or chain resources."""
     start = chain["Nodes"].get(chain.get("StartNode", ""), {})
+    targets = chain["Targets"]
     return (chain.get("Protocol", "tcp") not in ("http", "http2", "grpc")
             and start.get("Type") == "resolver"
             and start.get("Redirect") is None
             and not start.get("Failover")
-            and len(chain["Targets"]) == 1)
+            and len(targets) == 1
+            and not next(iter(targets.values())).get("Subset"))
 
 
 def chain_target_services(chain: dict) -> List[str]:

@@ -206,13 +206,26 @@ class ProxyState:
                         "proxy %s rebuild failed; will retry",
                         self.proxy_id, exc_info=True)
 
-    def _connect_endpoints(self, name: str) -> List[dict]:
+    def _connect_endpoints(self, name: str,
+                           target: Optional[dict] = None) -> List[dict]:
         """Mesh-reachable endpoints for upstream `name`: the healthy
         sidecar PROXIES fronting it (health connect semantics — the
         reference's UpstreamEndpoints point at proxies, not apps);
         Connect-native services with no proxy fall back to their own
-        instances."""
+        instances.
+
+        A chain `target` carrying a Subset applies the subset's bexpr
+        filter + only_passing (ServiceResolverSubset).  Divergence
+        note: the filter evaluates against the CONNECT row (the
+        sidecar, falling back to the instance for proxy-less
+        services) — tag/meta the sidecar like its app to subset a
+        proxied service; the reference filters app instances and maps
+        to their sidecars."""
         rows = self.manager.store.health_connect_nodes(name)
+        native = not rows
+        if native:
+            rows = self.manager.store.health_service_nodes(name)
+        rows = self._subset_filter(rows, target)
         eps = []
         for r in rows:
             if any(c["status"] == "critical" for c in r["checks"]):
@@ -222,12 +235,43 @@ class ProxyState:
                         or s.get("address", ""),
                         "port": s.get("port", 0),
                         "node": s.get("node", "")})
-        if rows:
-            # proxies exist for this service: all-unhealthy means NO
-            # endpoint, never a silent downgrade to the plaintext app
-            # ports (a TLS hello at the app would just confuse it)
-            return eps
-        return self._healthy_endpoints(name)
+        # proxies exist for this service: all-unhealthy means NO
+        # endpoint, never a silent downgrade to the plaintext app
+        # ports (a TLS hello at the app would just confuse it)
+        return eps
+
+    @staticmethod
+    def _subset_filter(rows: List[dict],
+                       target: Optional[dict]) -> List[dict]:
+        if not target or not target.get("Subset"):
+            return rows
+        if target.get("OnlyPassing"):
+            rows = [r for r in rows
+                    if all(c["status"] == "passing"
+                           for c in r["checks"])]
+        expr = target.get("Filter") or ""
+        if not expr:
+            return rows
+        from consul_tpu.bexpr import BexprError, compile_filter
+        try:
+            flt = compile_filter(expr)
+        except BexprError:
+            return []     # a broken subset filter selects nothing
+        out = []
+        for r in rows:
+            s = r["service"]
+            shaped = {"Service": {"Meta": s.get("meta", {}),
+                                  "Tags": s.get("tags", []),
+                                  "ID": s.get("service_id", ""),
+                                  "Service": s.get("service_name", ""),
+                                  "Port": s.get("port", 0)},
+                      "Node": s.get("node", "")}
+            try:
+                if flt(shaped):
+                    out.append(r)
+            except BexprError:
+                continue
+        return out
 
     def _healthy_endpoints(self, name: str) -> List[dict]:
         rows = self.manager.store.health_service_nodes(name)
@@ -282,7 +326,7 @@ class ProxyState:
                         tgt["Datacenter"])
                 else:
                     chain_eps[tid] = self._connect_endpoints(
-                        tgt["Service"])
+                        tgt["Service"], target=tgt)
         relevant = imod.match_order(m.store.intention_list(), service,
                                     "destination")
         leaf = m.get_leaf(service)

@@ -50,6 +50,67 @@ class StatsdSink:
             pass
 
 
+class DogstatsdSink(StatsdSink):
+    """Datadog's statsd dialect: the same line protocol plus |#tags
+    (lib/telemetry.go dogstatsd_addr / dogstatsd_tags)."""
+
+    def __init__(self, addr: str, tags: Optional[List[str]] = None):
+        super().__init__(addr)
+        self._suffix = ("|#" + ",".join(tags)) if tags else ""
+
+    def emit(self, kind: str, name: str, value: float) -> None:
+        suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
+        try:
+            self.sock.sendto(
+                f"{name}:{value}|{suffix}{self._suffix}".encode(),
+                self.addr)
+        except OSError:
+            pass
+
+
+class StatsiteSink:
+    """statsite speaks the statsd line protocol over TCP
+    (lib/telemetry.go statsite_addr).  Lines flush through a bounded
+    queue + background writer so metric EMISSION never blocks the hot
+    path on an unreachable collector (go-metrics' statsite sink
+    buffers through a channel the same way); overflow drops lines."""
+
+    _QUEUE_CAP = 4096
+
+    def __init__(self, addr: str):
+        import queue as _queue
+        host, _, port = addr.rpartition(":")
+        self.addr = (host or "127.0.0.1", int(port))
+        self._q: "_queue.Queue[bytes]" = _queue.Queue(self._QUEUE_CAP)
+        self._sock: Optional[socket.socket] = None
+        threading.Thread(target=self._flush_loop, daemon=True).start()
+
+    def emit(self, kind: str, name: str, value: float) -> None:
+        import queue as _queue
+        suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
+        try:
+            self._q.put_nowait(f"{name}:{value}|{suffix}\n".encode())
+        except _queue.Full:
+            pass                      # collector down: shed, don't stall
+
+    def _flush_loop(self) -> None:
+        import time as _time
+        while True:
+            line = self._q.get()
+            try:
+                if self._sock is None:
+                    self._sock = socket.create_connection(self.addr,
+                                                          timeout=1.0)
+                self._sock.sendall(line)
+            except OSError:
+                try:
+                    if self._sock is not None:
+                        self._sock.close()
+                finally:
+                    self._sock = None
+                _time.sleep(0.5)      # backoff before the next dial
+
+
 class Registry:
     def __init__(self, prefix: str = "consul"):
         self.prefix = prefix
@@ -61,6 +122,13 @@ class Registry:
 
     def add_statsd_sink(self, addr: str) -> None:
         self._sinks.append(StatsdSink(addr))
+
+    def add_dogstatsd_sink(self, addr: str,
+                           tags: Optional[List[str]] = None) -> None:
+        self._sinks.append(DogstatsdSink(addr, tags))
+
+    def add_statsite_sink(self, addr: str) -> None:
+        self._sinks.append(StatsiteSink(addr))
 
     def _name(self, parts) -> str:
         if isinstance(parts, str):
@@ -112,6 +180,44 @@ class Registry:
                              if s.count else 0.0}
                             for k, s in sorted(self._samples.items())],
             }
+
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (the PrometheusOpts role,
+        lib/telemetry.go:200; served at /v1/agent/metrics
+        ?format=prometheus like the reference's
+        agent_endpoint.go AgentMetrics prometheus handler).
+
+        Names sanitize '.'/'-' to '_'; counters map to `counter`,
+        gauges to `gauge`, and samples expose the go-metrics summary
+        shape as _count/_sum plus min/max gauges (quantile streams
+        aren't tracked; min/max is what the in-memory sink has)."""
+
+        def san(n: str) -> str:
+            return "".join(c if c.isalnum() or c == "_" else "_"
+                           for c in n)
+
+        with self._lock:
+            out = []
+            for k, v in sorted(self._counters.items()):
+                n = san(k)
+                out.append(f"# TYPE {n} counter")
+                out.append(f"{n} {v:g}")
+            for k, v in sorted(self._gauges.items()):
+                n = san(k)
+                out.append(f"# TYPE {n} gauge")
+                out.append(f"{n} {v:g}")
+            for k, s in sorted(self._samples.items()):
+                n = san(k)
+                out.append(f"# TYPE {n} summary")
+                out.append(f"{n}_sum {s.total:g}")
+                out.append(f"{n}_count {s.count}")
+                if s.count:
+                    out.append(f"# TYPE {n}_min gauge")
+                    out.append(f"{n}_min {s.min:g}")
+                    out.append(f"# TYPE {n}_max gauge")
+                    out.append(f"{n}_max {s.max:g}")
+            return "\n".join(out) + "\n"
 
 
 # process-wide default registry (go-metrics global pattern)

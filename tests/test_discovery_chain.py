@@ -179,3 +179,121 @@ def test_service_defaults_protocol_promotes_chain():
     chain = compile_chain(st, "web")
     assert chain["Protocol"] == "http"
     assert not is_default_chain(chain)
+
+
+def test_resolver_subsets_compile_to_targets():
+    """ServiceResolverSubset (config_entry_discoverychain.go:687):
+    default_subset picks the resolver's primary target; splitter legs
+    and failover entries address subsets; subset targets carry their
+    filter/only_passing for endpoint resolution and prefix the target
+    id the way the reference's SNI names do."""
+    st = StateStore()
+    st.config_entry_set("service-resolver", "web", {
+        "default_subset": "v1",
+        "subsets": {
+            "v1": {"filter": "Service.Meta.version == v1",
+                   "only_passing": True},
+            "v2": {"filter": "Service.Meta.version == v2"}},
+        "failover": {"*": {"service_subset": "v2"}}})
+    chain = compile_chain(st, "web")
+    node = chain["Nodes"]["resolver:web"]
+    assert node["Target"] == "v1.web.default.dc1"
+    t1 = chain["Targets"]["v1.web.default.dc1"]
+    assert t1["Subset"] == "v1" and t1["OnlyPassing"]
+    assert t1["Filter"] == "Service.Meta.version == v1"
+    assert node["Failover"]["Targets"] == ["v2.web.default.dc1"]
+    from consul_tpu.discoverychain import is_default_chain
+    assert not is_default_chain(chain)
+
+    # splitter legs select subsets
+    st.config_entry_set("service-splitter", "web", {"splits": [
+        {"weight": 50, "service": "web", "service_subset": "v1"},
+        {"weight": 50, "service": "web", "service_subset": "v2"}]})
+    chain = compile_chain(st, "web")
+    legs = chain["Nodes"]["splitter:web"]["Splits"]
+    assert [l["Node"] for l in legs] == ["resolver:v1.web",
+                                        "resolver:v2.web"]
+    assert "v2.web.default.dc1" in chain["Targets"]
+
+
+def test_subset_endpoints_filtered_by_meta():
+    """proxycfg applies the subset's bexpr filter + only_passing when
+    resolving a subset target's endpoints."""
+    from consul_tpu.proxycfg import ProxyState
+    st = StateStore()
+    st.register_node("n1", "10.0.0.1")
+    st.register_node("n2", "10.0.0.2")
+    st.register_service("n1", "w1", "web", port=81,
+                        meta={"version": "v1"})
+    st.register_service("n2", "w2", "web", port=82,
+                        meta={"version": "v2"})
+
+    class _M:
+        store = st
+    ps = ProxyState.__new__(ProxyState)
+    ps.manager = _M()
+    tgt = {"Subset": "v1", "Filter": "Service.Meta.version == v1",
+           "OnlyPassing": False, "Service": "web",
+           "Datacenter": "dc1"}
+    eps = ps._connect_endpoints("web", target=tgt)
+    assert [e["port"] for e in eps] == [81]
+    # no subset: both instances
+    assert len(ps._connect_endpoints("web")) == 2
+    # broken filter selects nothing (fail closed)
+    bad = dict(tgt, Filter="=== nonsense ((")
+    assert ps._connect_endpoints("web", target=bad) == []
+
+
+def test_subset_precedence_rules():
+    """Reviewer regressions (round 4): an explicit service_subset pins
+    past the destination's splitter; an exact failover key overrides
+    the '*' wildcard; redirects forward the requested subset (and a
+    redirect's own service_subset wins)."""
+    st = StateStore()
+    st.config_entry_set("service-resolver", "web", {
+        "subsets": {"v1": {"filter": "Service.Meta.version == v1"},
+                    "v2": {"filter": "Service.Meta.version == v2"}},
+        "failover": {"v1": {"datacenters": ["dc2"]},
+                     "*": {"service": "backup"}}})
+    st.config_entry_set("service-splitter", "web", {"splits": [
+        {"weight": 90, "service": "web"},
+        {"weight": 10, "service": "web", "service_subset": "v2"}]})
+    st.config_entry_set("service-router", "api", {"routes": [
+        {"match": {"http": {"path_prefix": "/pinned"}},
+         "destination": {"service": "web", "service_subset": "v2"}}]})
+    chain = compile_chain(st, "api")
+    pinned = chain["Nodes"]["router:api"]["Routes"][0]["Node"]
+    # explicit subset bypasses web's splitter
+    assert pinned == "resolver:v2.web"
+    # exact failover key beats the wildcard: v1 fails to dc2 only
+    v1 = chain["Nodes"].get("resolver:v1.web")
+    if v1 is None:
+        chain2 = compile_chain(st, "web")
+        # build v1 resolver through a direct splitter leg
+        st.config_entry_set("service-splitter", "web", {"splits": [
+            {"weight": 100, "service": "web", "service_subset": "v1"}]})
+        chain2 = compile_chain(st, "web")
+        v1 = chain2["Nodes"]["resolver:v1.web"]
+    # an empty failover service_subset targets the service's DEFAULT
+    # subset (unnamed here), not the current one — the reference's
+    # ServiceResolverFailover.ServiceSubset field semantics
+    assert v1["Failover"]["Targets"] == ["web.default.dc2"]
+    assert all("backup" not in t for t in v1["Failover"]["Targets"])
+
+    # redirect forwards the requested subset...
+    st2 = StateStore()
+    st2.config_entry_set("service-resolver", "old",
+                         {"redirect": {"service": "new"}})
+    st2.config_entry_set("service-resolver", "new", {
+        "subsets": {"v1": {"filter": "Service.Meta.version == v1"}}})
+    st2.config_entry_set("service-splitter", "top", {"splits": [
+        {"weight": 100, "service": "old", "service_subset": "v1"}]})
+    chain = compile_chain(st2, "top")
+    assert "v1.new.default.dc1" in chain["Targets"]
+    # ...and the redirect's own service_subset wins outright
+    st2.config_entry_set("service-resolver", "old2",
+                         {"redirect": {"service": "new",
+                                       "service_subset": "v1"}})
+    chain = compile_chain(st2, "old2")
+    assert chain["Nodes"]["resolver:old2"]["Resolver"] == \
+        "resolver:v1.new"
