@@ -31,6 +31,7 @@ import socket
 import threading
 import urllib.parse
 from typing import Optional
+from consul_tpu.utils.net import shutdown_and_close
 
 # query params that force the legacy path for /v1/kv (blocking reads,
 # recursion, listings, cross-dc, filtered or cached semantics)
@@ -105,25 +106,27 @@ class FastKVServer:
         finally:
             self._shutdown_done.set()
 
+    def _close_listener(self) -> None:
+        shutdown_and_close(self._sock)
+
     def shutdown(self) -> None:
         self._running = False
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._close_listener()
         self._shutdown_done.wait(5.0)
 
     def server_close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._close_listener()
 
     # --------------------------------------------------------- connection
+
+    _IDLE_TIMEOUT = 300.0   # reap abandoned keep-alive connections:
+    #                         a parked thread per dead client would
+    #                         accumulate across a long-lived agent
 
     def _serve_conn(self, conn: socket.socket, addr) -> None:
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.settimeout(self._IDLE_TIMEOUT)
             buf = b""
             while True:
                 # read one request head (bounded: http.server caps the

@@ -29,6 +29,7 @@ from typing import Callable, List, Optional, Tuple
 from cryptography import x509
 
 from consul_tpu.connect import intentions as imod
+from consul_tpu.utils.net import shutdown_and_close
 
 _COPY_CHUNK = 65536
 
@@ -175,10 +176,7 @@ class _Listener:
 
     def stop(self) -> None:
         self._running = False
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
 
     def _accept_loop(self) -> None:
         while self._running:

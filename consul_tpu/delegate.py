@@ -44,6 +44,8 @@ from __future__ import annotations
 import base64
 import json
 import socket
+
+from consul_tpu.utils.net import shutdown_and_close
 import threading
 from typing import Optional, Tuple
 
@@ -94,10 +96,7 @@ class DelegateServer:
 
     def stop(self) -> None:
         self._running = False
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
         # close LIVE connections too: a stopped server must not keep
         # answering parked clients (and their recv()s must unblock)
         with self._conn_lock:

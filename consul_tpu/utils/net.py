@@ -1,0 +1,24 @@
+"""Small socket helpers shared across the runtime's listeners."""
+
+from __future__ import annotations
+
+import socket
+
+
+def shutdown_and_close(sock: socket.socket) -> None:
+    """Wake any thread parked in accept()/recv() on `sock`, then close.
+
+    close() alone does NOT wake a thread already blocked in accept():
+    the orphan keeps the fd slot and, once the number is reused by an
+    unrelated socket (ssl/grpc), accepts on IT — native-level
+    corruption that surfaces as interpreter segfaults long after the
+    leak.  shutdown(SHUT_RDWR) wakes the parked thread first (Linux
+    semantics; the ENOTCONN some platforms raise is swallowed)."""
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass

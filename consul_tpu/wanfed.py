@@ -24,6 +24,8 @@ Host-side equivalent here:
 from __future__ import annotations
 
 import socket
+
+from consul_tpu.utils.net import shutdown_and_close
 import threading
 from typing import Optional, Tuple
 
@@ -56,10 +58,7 @@ class MeshGatewayForwarder:
 
     def stop(self) -> None:
         self._running = False
-        try:
-            self._lsock.close()
-        except OSError:
-            pass
+        shutdown_and_close(self._lsock)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
         for t in self._pumps:

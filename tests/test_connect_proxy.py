@@ -64,7 +64,8 @@ class EchoServer:
                              daemon=True).start()
 
     def close(self):
-        self.sock.close()
+        from consul_tpu.utils.net import shutdown_and_close
+        shutdown_and_close(self.sock)
 
 
 def _register(agent, body):

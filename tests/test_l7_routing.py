@@ -74,7 +74,8 @@ class HttpEcho:
                 pass
 
     def close(self):
-        self.sock.close()
+        from consul_tpu.utils.net import shutdown_and_close
+        shutdown_and_close(self.sock)
 
 
 def _put(base, path, body):

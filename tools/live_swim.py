@@ -71,12 +71,18 @@ class LiveAgent:
         self._running = False
         if self._thread:
             self._thread.join(timeout=2.0)
-        self.sock.close()
+        if not (self._thread and self._thread.is_alive()):
+            self.sock.close()
+        # else: the loop thread owns the close (closing under a live
+        # recvfrom risks the freed fd number being reused by an
+        # unrelated socket before the thread wakes)
 
     def crash(self) -> None:
-        """kill -9 equivalent: stop answering, keep nothing."""
+        """kill -9 equivalent: stop answering, keep nothing.  The loop
+        thread notices within one socket timeout and closes its own
+        socket — closing HERE under the parked recvfrom would race fd
+        reuse."""
         self._running = False
-        self.sock.close()
 
     # ------------------------------------------------------------ helpers
 
@@ -157,6 +163,15 @@ class LiveAgent:
     # --------------------------------------------------------------- loop
 
     def _loop(self) -> None:
+        try:
+            self._run_loop()
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _run_loop(self) -> None:
         while self._running:
             now = time.time()
             try:
@@ -168,6 +183,10 @@ class LiveAgent:
                 return
             except ValueError:
                 pass
+            if not self._running:
+                # crash() landed while we were parked in recvfrom: a
+                # dead agent must not ORIGINATE one last probe/gossip
+                return
             if now >= self._next_probe:
                 self._next_probe = now + self.cfg.probe_interval
                 self._probe()
@@ -230,6 +249,8 @@ class LiveAgent:
         return out[:12]
 
     def _on_packet(self, msg: dict, src) -> None:
+        if not self._running:
+            return        # a crashed agent answers NOTHING, instantly
         t = msg.get("t")
         frm = msg.get("from", "")
         for g in msg.get("gossip", []):
