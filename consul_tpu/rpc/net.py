@@ -24,6 +24,7 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 from consul_tpu.consensus.raft import Transport
+from consul_tpu.utils.net import shutdown_and_close
 
 _MAX_FRAME = 64 << 20  # 64 MiB: snapshots ride InstallSnapshot frames
 
@@ -72,16 +73,29 @@ class RpcListener:
         self.ssl_context = ssl_context
 
         class _Handler(socketserver.BaseRequestHandler):
+            # a byteless client must not park the handler inside the
+            # TLS handshake forever: wrap_socket DETACHES the fd from
+            # the accepted socket, so no external shutdown can reach
+            # an in-flight handshake — a timeout is the only bound
+            HANDSHAKE_TIMEOUT = 10.0
+
             def handle(self):
                 sock = self.request
                 if outer.ssl_context is not None:
                     # TLS upgrade per connection (tlsutil incoming);
                     # handshake failures end this connection only
                     try:
+                        sock.settimeout(self.HANDSHAKE_TIMEOUT)
                         sock = outer.ssl_context.wrap_socket(
                             sock, server_side=True)
+                        sock.settimeout(None)
                     except (ssl.SSLError, OSError):
                         return
+                # register so stop() can WAKE this reader: daemon
+                # threads parked in recv on established conns outlive
+                # server_close and ride reused fd numbers otherwise
+                with outer._live_lock:
+                    outer._live.add(sock)
                 try:
                     while True:
                         frame = recv_frame(sock)
@@ -100,9 +114,14 @@ class RpcListener:
                             send_frame(sock, resp)
                 except (ConnectionError, ValueError, OSError):
                     return
+                finally:
+                    with outer._live_lock:
+                        outer._live.discard(sock)
 
         self.deliver_fn = deliver_fn
         self.handler = handler
+        self._live: set = set()
+        self._live_lock = threading.Lock()
         self.server = socketserver.ThreadingTCPServer((host, port), _Handler,
                                                       bind_and_activate=False)
         self.server.allow_reuse_address = True
@@ -120,6 +139,13 @@ class RpcListener:
     def stop(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        # wake every parked per-connection reader: their daemon
+        # threads otherwise idle in (ssl) recv until the peer closes,
+        # holding fd slots the kernel will reuse
+        with self._live_lock:
+            live = list(self._live)
+        for sock in live:
+            shutdown_and_close(sock)
         if self._thread:
             self._thread.join(timeout=5.0)
 
@@ -160,10 +186,7 @@ class _ConnPool:
     def _drop(self, addr) -> None:
         sock = self._conns.pop(addr, None)
         if sock is not None:
-            try:
-                sock.close()
-            except OSError:
-                pass
+            shutdown_and_close(sock)
 
     def oneway(self, addr, obj: dict) -> None:
         """Fire-and-forget (raft frames).  Errors drop the connection."""

@@ -210,6 +210,7 @@ class _FakeRecursor:
         self.sock.bind(("127.0.0.1", 0))
         self.port = self.sock.getsockname()[1]
         self.seen = []
+        self._closing = False
         import threading
         self.t = threading.Thread(target=self._serve, daemon=True)
         self.t.start()
@@ -220,6 +221,8 @@ class _FakeRecursor:
                 data, addr = self.sock.recvfrom(4096)
             except OSError:
                 return
+            if self._closing or not data:
+                return
             txn, flags, name, qtype = parse_query(data)
             self.seen.append(name)
             from consul_tpu.dns import RR, a_rdata, build_response
@@ -229,6 +232,19 @@ class _FakeRecursor:
             self.sock.sendto(resp, addr)
 
     def close(self):
+        # close() alone does NOT wake the thread parked in recvfrom:
+        # the orphan keeps the fd slot until the kernel reuses the
+        # number for an unrelated fd (XLA pipes, sockets) and then
+        # reads from THAT — native corruption crashing far away.
+        # Wake it with a self-datagram, join, then close.
+        self._closing = True
+        try:
+            w = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            w.sendto(b"", ("127.0.0.1", self.port))
+            w.close()
+        except OSError:
+            pass
+        self.t.join(timeout=2.0)
         self.sock.close()
 
 
