@@ -17,7 +17,11 @@ Prints one JSON line per p_loss.
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import jax
 import numpy as np
