@@ -79,10 +79,34 @@ def _clusters_for_node(chain: dict, node_id: str) -> List[Tuple[int, str]]:
     return []
 
 
+def _lb_for_node(chain: dict, node_id: str) -> Optional[dict]:
+    """The landing resolver's LoadBalancer policy.  A splitter's legs
+    must AGREE on the policy for it to apply to the (single) route
+    action — the reference rejects divergent-leg LB at config-entry
+    validation; since local entries aren't validated that way here,
+    divergence resolves to NO policy rather than silently hashing one
+    leg's share under another leg's rules."""
+    node = _resolve_to_resolver(chain, node_id)
+    if node is None:
+        return None
+    if node.get("Type") == "splitter":
+        lbs = []
+        for leg in node.get("Splits") or []:
+            res = _resolve_to_resolver(chain, leg["Node"])
+            if res is None:
+                return None
+            lbs.append(res.get("LoadBalancer") or None)
+        if not lbs or any(lb != lbs[0] for lb in lbs):
+            return None
+        return lbs[0]
+    return node.get("LoadBalancer") or None
+
+
 def route_table(chain: dict) -> List[dict]:
     """Normalized route list, evaluated (and emitted) in order:
     [{"match": <chain Match dict>, "clusters": [(weight, target_id)],
-      "prefix_rewrite": str, "timeout": float seconds, "retry": dict}].
+      "prefix_rewrite": str, "timeout": float seconds, "retry": dict,
+      "lb": <resolver LoadBalancer dict or None>}].
     """
     start = chain["Nodes"].get(chain.get("StartNode", ""))
     if start is None:
@@ -104,12 +128,14 @@ def route_table(chain: dict) -> List[dict]:
                 "prefix_rewrite": dest.get("PrefixRewrite", ""),
                 "timeout": _parse_duration(dest.get("RequestTimeout")),
                 "retry": retry,
+                "lb": _lb_for_node(chain, r["Node"]),
             })
     else:
         out.append({
             "match": {"PathPrefix": "/"},
             "clusters": _clusters_for_node(chain, chain["StartNode"]),
             "prefix_rewrite": "", "timeout": 0.0, "retry": {},
+            "lb": _lb_for_node(chain, chain["StartNode"]),
         })
     return out
 

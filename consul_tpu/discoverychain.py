@@ -148,6 +148,10 @@ def _resolver_node(store, service: str, chain: dict,
         "Target": target,
         "Failover": ({"Targets": failover_targets}
                      if failover_targets else None),
+        # load-balancing policy rides the resolver
+        # (structs.LoadBalancer, config_entry_discoverychain.go:1097;
+        # consumed by injectLBToCluster/injectLBToRouteAction)
+        "LoadBalancer": res.get("load_balancer") or None,
     }
     return nid
 

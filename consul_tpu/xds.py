@@ -249,7 +249,7 @@ def clusters(snap) -> List[dict]:
                 continue
             emitted.add(cname)
             svc = chain["Targets"][tid]["Service"]
-            out.append({
+            cluster = {
                 "@type": T + "envoy.config.cluster.v3.Cluster",
                 "name": cname,
                 "type": "EDS",
@@ -261,8 +261,78 @@ def clusters(snap) -> List[dict]:
                         node.get("ConnectTimeout")) or 5),
                 "transport_socket": _upstream_tls(
                     snap.leaf, snap.roots, f"{svc}.default.{td}"),
-            })
+            }
+            _inject_lb_to_cluster(node.get("LoadBalancer"), cluster)
+            out.append(cluster)
     return out
+
+
+_LB_POLICIES = {"": None, "round_robin": "ROUND_ROBIN",
+                "least_request": "LEAST_REQUEST",
+                "ring_hash": "RING_HASH", "random": "RANDOM",
+                "maglev": "MAGLEV"}
+
+
+def _inject_lb_to_cluster(lb: Optional[dict], cluster: dict) -> None:
+    """Resolver LoadBalancer → envoy cluster lb_policy + per-policy
+    config (agent/xds/clusters.go injectLBToCluster)."""
+    if not lb:
+        return
+    policy = _LB_POLICIES.get(str(lb.get("policy", "")).lower())
+    if policy is None:
+        return
+    cluster["lb_policy"] = policy
+    if policy == "RING_HASH":
+        rh = lb.get("ring_hash_config") or {}
+        cfg = {}
+        if rh.get("minimum_ring_size"):
+            cfg["minimum_ring_size"] = int(rh["minimum_ring_size"])
+        if rh.get("maximum_ring_size"):
+            cfg["maximum_ring_size"] = int(rh["maximum_ring_size"])
+        if cfg:
+            cluster["ring_hash_lb_config"] = cfg
+    elif policy == "LEAST_REQUEST":
+        lr = lb.get("least_request_config") or {}
+        if lr.get("choice_count"):
+            cluster["least_request_lb_config"] = {
+                "choice_count": int(lr["choice_count"])}
+
+
+def _inject_lb_to_route_action(lb: Optional[dict],
+                               action: dict) -> None:
+    """Hash policies for hash-based LB → RouteAction.hash_policy
+    (agent/xds/routes.go injectLBToRouteAction — which only injects
+    for ring_hash/maglev; other policies never emit hash_policy)."""
+    if not lb or str(lb.get("policy", "")).lower() not in (
+            "ring_hash", "maglev"):
+        return
+    policies = []
+    for hp in lb.get("hash_policies") or []:
+        if hp.get("source_ip"):
+            pol: dict = {"connection_properties": {"source_ip": True}}
+        else:
+            field = str(hp.get("field", "")).lower()
+            value = hp.get("field_value", "")
+            if field == "header":
+                pol = {"header": {"header_name": value}}
+            elif field == "cookie":
+                ck = hp.get("cookie_config") or {}
+                cookie = {"name": value}
+                if ck.get("ttl"):
+                    cookie["ttl"] = _duration(
+                        l7._parse_duration(ck["ttl"]))
+                if ck.get("path"):
+                    cookie["path"] = ck["path"]
+                pol = {"cookie": cookie}
+            elif field == "query_parameter":
+                pol = {"query_parameter": {"name": value}}
+            else:
+                continue
+        if hp.get("terminal"):
+            pol["terminal"] = True
+        policies.append(pol)
+    if policies:
+        action["hash_policy"] = policies
 
 
 def endpoints(snap) -> List[dict]:
@@ -446,6 +516,7 @@ def _envoy_route_action(route: dict, td: str) -> dict:
         if retry.get("num_retries"):
             rp["num_retries"] = retry["num_retries"]
         action["retry_policy"] = rp
+    _inject_lb_to_route_action(route.get("lb"), action)
     return action
 
 

@@ -130,8 +130,23 @@ def _l7_chain_snapshot():
         ("service-splitter", "api"): {"splits": [
             {"weight": 90.5, "service": "api"},
             {"weight": 9.5, "service": "api-canary"}]},
+        # legs must AGREE on LB for it to reach the route action
+        ("service-resolver", "api-canary"): {"load_balancer": {
+            "policy": "ring_hash",
+            "ring_hash_config": {"minimum_ring_size": 1024},
+            "hash_policies": [
+                {"field": "header", "field_value": "x-user",
+                 "terminal": True},
+                {"source_ip": True}]}},
         ("service-resolver", "api"): {"failover": {
-            "*": {"datacenters": ["dc2"]}}},
+            "*": {"datacenters": ["dc2"]}},
+            "load_balancer": {
+                "policy": "ring_hash",
+                "ring_hash_config": {"minimum_ring_size": 1024},
+                "hash_policies": [
+                    {"field": "header", "field_value": "x-user",
+                     "terminal": True},
+                    {"source_ip": True}]}},
     })
     chain = compile_chain(store, "api", dc="dc1")
     return ConfigSnapshot(
@@ -264,3 +279,15 @@ def test_l7_chain_rds_weighted_clusters():
     assert [g.get("priority", 0) for g in groups] == [0, 1]
     fo_ep = groups[1]["lb_endpoints"][0]["endpoint"]["address"]
     assert fo_ep["socket_address"]["address"] == "10.9.9.9"
+    # LoadBalancer rides the resolver: cluster lb_policy + config
+    # (injectLBToCluster) and hash policies on the route action
+    # (injectLBToRouteAction)
+    byname = {c["name"]: c for c in res["clusters"]}
+    api_cluster = byname[f"api.default.dc1.internal.{td}"]
+    assert api_cluster["lb_policy"] == "RING_HASH"
+    assert api_cluster["ring_hash_lb_config"] == {
+        "minimum_ring_size": 1024}
+    hp = default_route["route"]["hash_policy"]
+    assert hp[0] == {"header": {"header_name": "x-user"},
+                     "terminal": True}
+    assert hp[1] == {"connection_properties": {"source_ip": True}}
