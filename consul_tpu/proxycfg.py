@@ -124,14 +124,18 @@ class ProxyState:
             # are its real dependency set (proxycfg/state.go mesh-gw)
             topics += [("config", None), ("health", None),
                        ("federation", None)]
+        elif kind == "ingress-gateway":
+            # ingress consumes bound services' DISCOVERY CHAINS, so any
+            # router/splitter/resolver write must rebuild — topic-wide
+            # config sub (plus services for wildcard binding changes)
+            topics += [("config", None), ("services", None)]
         else:
-            # ingress/terminating: bindings live in THIS gateway's own
-            # config entry; endpoint health is per bound service, and
+            # terminating: bindings live in THIS gateway's own config
+            # entry; endpoint health is per bound service, and
             # _sync_health_subs re-keys those after every rebuild —
             # unrelated config writes or check flaps elsewhere must not
             # re-run the full snapshot scan
-            gw_kind = kind
-            topics += [("config", f"{gw_kind}/{self.svc.get('name', '')}"),
+            topics += [("config", f"{kind}/{self.svc.get('name', '')}"),
                        ("services", None)]
         self._subs = [pub.subscribe(t, k, since_index=None)
                       for t, k in topics]
@@ -174,6 +178,11 @@ class ProxyState:
         else:
             want = {row["Service"] for row in
                     (snap.gateway_services if snap is not None else [])}
+            if kind == "ingress-gateway":
+                # chain split/failover targets of bound services
+                from consul_tpu import discoverychain as dchain
+                for chain in (snap.chains if snap else {}).values():
+                    want |= set(dchain.chain_target_services(chain))
         pub = self.manager.store.publisher
         for svc in list(self._health_subs):
             if svc not in want:
@@ -273,8 +282,10 @@ class ProxyState:
                 continue
         return out
 
-    def _healthy_endpoints(self, name: str) -> List[dict]:
+    def _healthy_endpoints(self, name: str,
+                           target: Optional[dict] = None) -> List[dict]:
         rows = self.manager.store.health_service_nodes(name)
+        rows = self._subset_filter(rows, target)
         eps = []
         for r in rows:
             if any(c["status"] == "critical" for c in r["checks"]):
@@ -366,6 +377,8 @@ class ProxyState:
         federation: List[dict] = []
         listeners: List[dict] = []
         intentions: List[dict] = []
+        gw_chains: Dict[str, dict] = {}
+        gw_chain_eps: Dict[str, List[dict]] = {}
         if kind == "mesh-gateway":
             # every local connect-capable service is routable through
             # the mesh gateway by SNI; remote DCs resolve through their
@@ -388,13 +401,28 @@ class ProxyState:
                 intentions += imod.match_order(
                     m.store.intention_list(), svc, "destination")
         elif kind == "ingress-gateway":
+            from consul_tpu import discoverychain as dchain
             ent = m.store.config_entry_get("ingress-gateway", gw_name)
             listeners = (ent.get("listeners") or []) if ent else []
             bound = gmod.resolve_wildcard(
                 m.store, gmod.gateway_services(m.store, gw_name))
             for row in bound:
-                endpoints[row["Service"]] = \
-                    self._healthy_endpoints(row["Service"])
+                svc = row["Service"]
+                endpoints[svc] = self._healthy_endpoints(svc)
+                # bound services with L7 chains route through the
+                # chain's targets (IngressGateway.DiscoveryChain role)
+                chain = dchain.compile_chain(m.store, svc, dc=m.dc)
+                gw_chains[svc] = chain
+                for tid, tgt in chain["Targets"].items():
+                    if tid in gw_chain_eps:
+                        continue
+                    if tgt["Datacenter"] != m.dc:
+                        gw_chain_eps[tid] = \
+                            self._remote_dc_endpoints(
+                                tgt["Datacenter"])
+                    else:
+                        gw_chain_eps[tid] = self._healthy_endpoints(
+                            tgt["Service"], target=tgt)
         leaf = m.get_leaf(gw_name)
         with self._cond:
             self._version += 1
@@ -408,7 +436,8 @@ class ProxyState:
                 mesh_endpoints=mesh_endpoints,
                 federation_states=federation, listeners=listeners,
                 port=self.svc.get("port", 0),
-                bind_address=self.svc.get("address", ""))
+                bind_address=self.svc.get("address", ""),
+                chains=gw_chains, chain_endpoints=gw_chain_eps)
             self._cond.notify_all()
         self._sync_health_subs()
 

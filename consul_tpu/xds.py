@@ -520,21 +520,31 @@ def _envoy_route_action(route: dict, td: str) -> dict:
     return action
 
 
-def chain_route_config(name: str, chain: dict, td: str) -> dict:
-    """One upstream's RouteConfiguration from its compiled chain
-    (routes.go:248 makeUpstreamRouteForDiscoveryChain): a single
-    wildcard virtual host whose routes mirror the chain's router node
-    (or a single default route for splitter/resolver starts)."""
+def chain_virtual_host(name: str, chain: dict, td: str,
+                       domains: Optional[List[str]] = None) -> dict:
+    """One virtual host whose routes mirror the chain's router node
+    (or a single default route for splitter/resolver starts) —
+    makeUpstreamRouteForDiscoveryChain (routes.go:248); shared by the
+    connect-proxy RDS and the ingress-gateway vhosts."""
     routes_out = []
     for route in l7.route_table(chain):
         routes_out.append({
             "match": _envoy_route_match(route["match"]),
             "route": _envoy_route_action(route, td)})
+    return {"name": name, "domains": domains or ["*"],
+            "routes": routes_out}
+
+
+def chain_route_config(name: str, chain: dict, td: str) -> dict:
+    """One upstream's RouteConfiguration from its compiled chain
+    (routesForConnectProxy, routes.go:44)."""
     return {
         "@type": T + "envoy.config.route.v3.RouteConfiguration",
         "name": name,
-        "virtual_hosts": [{"name": name, "domains": ["*"],
-                           "routes": routes_out}],
+        "virtual_hosts": [chain_virtual_host(name, chain, td)],
+        # ValidateClusters defaults false over RDS; the reference
+        # re-sets true to prevent null-routing (routes.go:59)
+        "validate_clusters": True,
     }
 
 
@@ -673,20 +683,63 @@ def terminating_gateway_resources(snap) -> dict:
 def ingress_gateway_resources(snap) -> dict:
     """North-south entry: one listener per configured port; http
     listeners route by host to bound-service clusters, tcp listeners
-    proxy straight through (makeIngressGatewayListeners).
+    proxy straight through (makeIngressGatewayListeners).  Bound
+    services with a non-default L7 chain get the CHAIN's virtual host
+    and per-target clusters instead of the plain single-cluster route
+    (routesForIngressGateway, routes.go:160).
 
     Listeners are built from the RESOLVED gateway_services rows (not
     the raw config) so a wildcard binding expands to real per-service
     routes/clusters instead of a nonexistent `ingress.*` target."""
+    td = _trust_domain(snap)
     cl, eds, lst, rts = [], [], [], []
     seen = set()
+    emitted = set()
     by_port: Dict[int, List[dict]] = {}
+    chains = getattr(snap, "chains", {})
+    ceps = getattr(snap, "chain_endpoints", {})
+
+    def _lb_eps(tid):
+        return [{"endpoint": {"address": _address(
+            e["address"] or "127.0.0.1", e["port"])}}
+            for e in ceps.get(tid, [])]
+
     for row in snap.gateway_services:
         svc = row["Service"]
         by_port.setdefault(row.get("Port", 0), []).append(row)
         if svc in seen:
             continue
         seen.add(svc)
+        chain = chains.get(svc)
+        if chain is not None and not dchain.is_default_chain(chain):
+            for node in _chain_resolver_nodes(chain):
+                tid = node["Target"]
+                cname = chain_cluster_name(tid, td)
+                if cname in emitted:
+                    continue
+                emitted.add(cname)
+                c = {"@type": T + "envoy.config.cluster.v3.Cluster",
+                     "name": cname, "type": "EDS",
+                     "eds_cluster_config": {
+                         "eds_config": _ads_config_source(),
+                         "service_name": cname},
+                     "connect_timeout": _duration(
+                         l7._parse_duration(
+                             node.get("ConnectTimeout")) or 5)}
+                _inject_lb_to_cluster(node.get("LoadBalancer"), c)
+                cl.append(c)
+                # failover targets ride as priority>0 groups, same as
+                # the connect-proxy endpoints() contract
+                groups = [{"priority": 0, "lb_endpoints": _lb_eps(tid)}]
+                fo = node.get("Failover") or {}
+                for i, ftid in enumerate(fo.get("Targets") or []):
+                    groups.append({"priority": i + 1,
+                                   "lb_endpoints": _lb_eps(ftid)})
+                eds.append({"@type": T + "envoy.config.endpoint.v3."
+                                         "ClusterLoadAssignment",
+                            "cluster_name": cname,
+                            "endpoints": groups})
+            continue
         c, e = _eds_cluster(f"ingress.{svc}",
                             snap.upstream_endpoints.get(svc, []))
         cl.append(c)
@@ -702,27 +755,47 @@ def ingress_gateway_resources(snap) -> dict:
             # config entry); zero services → no listener to emit
             if not rows:
                 continue
+            tcp_svc = rows[0]["Service"]
+            tcp_chain = chains.get(tcp_svc)
+            if tcp_chain is not None and \
+                    not dchain.is_default_chain(tcp_chain):
+                # a non-default tcp chain replaced ingress.<svc> with
+                # per-target clusters: proxy to the start resolver's
+                # target (same shape as the connect-proxy listeners)
+                start = l7._resolve_to_resolver(
+                    tcp_chain, tcp_chain["StartNode"])
+                tcp_cluster = chain_cluster_name(start["Target"], td) \
+                    if start and start.get("Target") \
+                    else f"ingress.{tcp_svc}"
+            else:
+                tcp_cluster = f"ingress.{tcp_svc}"
             lst.append({
                 "@type": T + "envoy.config.listener.v3.Listener",
                 "name": name, "traffic_direction": "OUTBOUND",
                 "address": _address("0.0.0.0", port),
                 "filter_chains": [{"filters": [
-                    _tcp_proxy(name,
-                               f"ingress.{rows[0]['Service']}")]}],
+                    _tcp_proxy(name, tcp_cluster)]}],
             })
         else:
             vhosts = []
             for row in rows:
                 svc = row["Service"]
                 domains = row.get("Hosts") or [f"{svc}.ingress.*", svc]
-                vhosts.append({
-                    "name": svc, "domains": domains,
-                    "routes": [{"match": {"prefix": "/"},
-                                "route": {"cluster":
-                                          f"ingress.{svc}"}}]})
+                chain = chains.get(svc)
+                if chain is not None and \
+                        not dchain.is_default_chain(chain):
+                    vhosts.append(chain_virtual_host(
+                        svc, chain, td, domains=domains))
+                else:
+                    vhosts.append({
+                        "name": svc, "domains": domains,
+                        "routes": [{"match": {"prefix": "/"},
+                                    "route": {"cluster":
+                                              f"ingress.{svc}"}}]})
             rts.append({
                 "@type": T + "envoy.config.route.v3.RouteConfiguration",
-                "name": name, "virtual_hosts": vhosts})
+                "name": name, "virtual_hosts": vhosts,
+                "validate_clusters": True})
             lst.append({
                 "@type": T + "envoy.config.listener.v3.Listener",
                 "name": name, "traffic_direction": "OUTBOUND",

@@ -213,6 +213,93 @@ def test_golden_resources_parse_as_typed_protobufs(name):
     assert count > 0
 
 
+def test_ingress_gateway_consumes_chains():
+    """A bound service with a non-default L7 chain gets the CHAIN's
+    virtual host (weighted clusters) and per-target SNI clusters on
+    the ingress listener (routesForIngressGateway, routes.go:160)."""
+    from consul_tpu.discoverychain import compile_chain
+    store = _FakeConfigStore({
+        ("service-splitter", "web"): {"splits": [
+            {"weight": 80, "service": "web"},
+            {"weight": 20, "service": "web-canary"}]},
+    })
+    chain = compile_chain(store, "web", dc="dc1")
+    snap = ConfigSnapshot(
+        proxy_id="ingress-gw", service="ingress-gw", upstreams=[],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"web": [
+            {"address": "10.0.0.5", "port": 8080, "node": "n1"}]},
+        intentions=[], default_allow=True, version=6,
+        kind="ingress-gateway",
+        gateway_services=[{"Gateway": "ingress-gw", "Service": "web",
+                           "GatewayKind": "ingress-gateway",
+                           "Port": 8443, "Protocol": "http",
+                           "Hosts": []}],
+        listeners=[{"port": 8443, "protocol": "http",
+                    "services": [{"name": "web"}]}],
+        chains={"web": chain},
+        chain_endpoints={
+            "web.default.dc1": [{"address": "10.0.0.5", "port": 8080,
+                                 "node": "n1"}],
+            "web-canary.default.dc1": [
+                {"address": "10.0.0.6", "port": 8081, "node": "n2"}]})
+    res = xds.snapshot_resources(snap)["Resources"]
+    td = "golden.consul"
+    cnames = {c["name"] for c in res["clusters"]}
+    assert f"web.default.dc1.internal.{td}" in cnames
+    assert f"web-canary.default.dc1.internal.{td}" in cnames
+    assert "ingress.web" not in cnames          # chain replaces it
+    vh = res["routes"][0]["virtual_hosts"][0]
+    wc = vh["routes"][-1]["route"]["weighted_clusters"]
+    weights = {c["name"]: c["weight"] for c in wc["clusters"]}
+    assert weights[f"web.default.dc1.internal.{td}"] == 8000
+    assert weights[f"web-canary.default.dc1.internal.{td}"] == 2000
+    assert res["routes"][0]["validate_clusters"] is True
+    from consul_tpu import xds_pb
+    for group in ("clusters", "endpoints", "listeners", "routes"):
+        for r in res[group]:
+            xds_pb.from_dict(r)
+
+
+def test_ingress_tcp_chain_routes_to_chain_cluster():
+    """A tcp-bound service with a non-default chain must tcp_proxy to
+    the chain's start-target cluster — the plain ingress.<svc> cluster
+    is no longer emitted for it (reviewer regression, round 4)."""
+    from consul_tpu.discoverychain import compile_chain
+    store = _FakeConfigStore({
+        ("service-resolver", "legacy"): {"failover": {
+            "*": {"datacenters": ["dc2"]}}},
+    })
+    chain = compile_chain(store, "legacy", dc="dc1")
+    snap = ConfigSnapshot(
+        proxy_id="ingress-gw", service="ingress-gw", upstreams=[],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF, upstream_endpoints={},
+        intentions=[], default_allow=True, version=7,
+        kind="ingress-gateway",
+        gateway_services=[{"Gateway": "ingress-gw",
+                           "Service": "legacy",
+                           "GatewayKind": "ingress-gateway",
+                           "Port": 9443, "Protocol": "tcp",
+                           "Hosts": []}],
+        listeners=[{"port": 9443, "protocol": "tcp",
+                    "services": [{"name": "legacy"}]}],
+        chains={"legacy": chain},
+        chain_endpoints={
+            "legacy.default.dc1": [{"address": "10.0.0.7",
+                                    "port": 9000, "node": "n2"}],
+            "legacy.default.dc2": [{"address": "10.9.9.9",
+                                    "port": 443, "node": ""}]})
+    res = xds.snapshot_resources(snap)["Resources"]
+    td = "golden.consul"
+    cname = f"legacy.default.dc1.internal.{td}"
+    assert {c["name"] for c in res["clusters"]} == {cname}
+    tcp = res["listeners"][0]["filter_chains"][0]["filters"][0]
+    assert tcp["typed_config"]["cluster"] == cname
+    # failover rides EDS as a priority-1 group here too
+    groups = res["endpoints"][0]["endpoints"]
+    assert [g.get("priority", 0) for g in groups] == [0, 1]
+
+
 def test_shared_chain_targets_emit_once():
     """Two upstreams whose chains route to the same target must not
     produce duplicate CDS/EDS resource names (envoy NACKs a push with
