@@ -209,6 +209,61 @@ def select_route(table: List[dict], method: str, path: str,
     return None
 
 
+def hash_key(lb: Optional[dict], method: str, path: str,
+             headers: Dict[str, str], query: Dict[str, str],
+             peer_ip: str) -> Optional[str]:
+    """The request's sticky-hash key under a ring_hash/maglev
+    LoadBalancer's hash policies, or None when hashing does not apply
+    (no LB, non-hash policy, or nothing matched).  Policies evaluate
+    in order and combine; a `terminal` policy that produced a value
+    short-circuits — envoy's HashPolicy semantics, which the emitted
+    RDS config asks a real Envoy to apply identically."""
+    if not lb or str(lb.get("policy", "")).lower() not in (
+            "ring_hash", "maglev"):
+        return None
+    parts = []
+    for hp in lb.get("hash_policies") or []:
+        val = None
+        if hp.get("source_ip"):
+            val = peer_ip
+        else:
+            field = str(hp.get("field", "")).lower()
+            name = hp.get("field_value", "")
+            if field == "header":
+                val = headers.get(name.lower())
+            elif field == "query_parameter":
+                val = query.get(name)
+            elif field == "cookie":
+                cookies = headers.get("cookie", "")
+                for part in cookies.split(";"):
+                    k, _, v = part.strip().partition("=")
+                    if k == name:
+                        val = v
+                        break
+        if val is not None:
+            parts.append(val)
+            if hp.get("terminal"):
+                break
+    return "|".join(parts) if parts else None
+
+
+def pick_endpoint(eps: List, key: Optional[str]) -> List:
+    """Order candidate endpoints for a request: hashed requests get a
+    rendezvous-hash order (same key → same endpoint, minimal movement
+    when the endpoint set changes), unhashed requests keep the list
+    order.  Returns the FULL ordered list so connect failures fall
+    through to the next choice."""
+    if key is None or len(eps) <= 1:
+        return list(eps)
+    import hashlib
+
+    def score(e):
+        return hashlib.sha256(
+            f"{key}|{e}".encode()).digest()
+
+    return sorted(eps, key=score, reverse=True)
+
+
 def pick_cluster(clusters: List[Tuple[int, str]],
                  roll: float) -> Optional[str]:
     """Weighted pick; `roll` ∈ [0,1) comes from the caller's RNG so

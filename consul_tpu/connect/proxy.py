@@ -327,11 +327,16 @@ class HttpUpstreamListener(_Listener):
                                           Optional[Tuple[str, int]]],
                  expect_uri: Callable[[str], str],
                  host: str = "127.0.0.1", port: int = 0,
-                 rng=None):
+                 rng=None,
+                 resolve_groups: Optional[Callable[
+                     [str], List[List[Tuple[str, int]]]]] = None):
         super().__init__(host, port)
         self.tls = tls
         self.table_fn = table_fn
         self.resolve_target = resolve_target
+        # priority-ordered endpoint GROUPS (primary, failover...) for
+        # hash-based sticky selection within each priority level
+        self.resolve_groups = resolve_groups
         self.expect_uri = expect_uri
         import random
         self._rng = rng if rng is not None else random.Random()
@@ -417,7 +422,16 @@ class HttpUpstreamListener(_Listener):
                 out_path = pr + path[len(route["match"]["PathPrefix"]):]
             elif pr and route["match"].get("PathExact"):
                 out_path = pr
-            tls_conn = self._dial(target, route)
+            # sticky hashing (ring_hash/maglev): the same hash-policy
+            # key always orders the same endpoint first — the builtin
+            # proxy honoring what the emitted RDS asks of a real Envoy
+            try:
+                peer_ip = conn.getpeername()[0]
+            except OSError:
+                peer_ip = ""
+            key = l7.hash_key(route.get("lb"), method, path, headers,
+                              query, peer_ip)
+            tls_conn = self._dial(target, route, key)
             if tls_conn is None:
                 self._respond(conn, 503, "No Healthy Upstream")
                 conn.close()
@@ -444,32 +458,43 @@ class HttpUpstreamListener(_Listener):
             except OSError:
                 pass
 
-    def _dial(self, target: str, route: dict):
+    def _dial(self, target: str, route: dict, key=None):
         """mTLS to the picked target with identity pinning; retries
         connect failures when the route's retry policy asks
-        (routes.go RetryPolicy connect-failure)."""
+        (routes.go RetryPolicy connect-failure).  A sticky-hash `key`
+        orders candidates within each priority group via rendezvous
+        hashing (connect/l7.py pick_endpoint)."""
+        from consul_tpu.connect import l7
         attempts = 1 + int((route.get("retry") or {}).get(
             "num_retries", 0) or 0)
         for _ in range(attempts):
-            ep = self.resolve_target(target)
-            if ep is None:
+            if self.resolve_groups is not None:
+                candidates = [ep for group in
+                              self.resolve_groups(target)
+                              for ep in l7.pick_endpoint(group, key)]
+            else:
+                ep = self.resolve_target(target)
+                candidates = [ep] if ep is not None else []
+            if not candidates:
                 self.stats["no_endpoint"] += 1
                 continue
-            try:
-                raw = socket.create_connection(ep, timeout=10)
-                tls_conn = self.tls.client_context().wrap_socket(raw)
-            except (ssl.SSLError, OSError):
-                self.stats["no_endpoint"] += 1
-                continue
-            uri = peer_spiffe_uri(tls_conn)
-            allowed = self.expect_uri(target)
+            allowed = self.expect_uri(target)   # constant per call
             if isinstance(allowed, str):
                 allowed = (allowed,)
-            if uri not in allowed:
-                self.stats["identity_mismatch"] += 1
-                tls_conn.close()
-                continue
-            return tls_conn
+            for ep in candidates:
+                try:
+                    raw = socket.create_connection(ep, timeout=10)
+                    tls_conn = self.tls.client_context().wrap_socket(
+                        raw)
+                except (ssl.SSLError, OSError):
+                    self.stats["no_endpoint"] += 1
+                    continue
+                uri = peer_spiffe_uri(tls_conn)
+                if uri not in allowed:
+                    self.stats["identity_mismatch"] += 1
+                    tls_conn.close()
+                    continue
+                return tls_conn
         return None
 
 
@@ -627,17 +652,21 @@ class SidecarProxy:
                                 break
                     return tids, ch
 
-                def resolve_target(tid, name=name):
+                def resolve_groups(tid, name=name):
+                    # priority-ordered endpoint groups (primary, then
+                    # failover legs) for sticky-hash selection
                     fresh = self._state.fetch(0, timeout=0.0)
                     if fresh is None:
-                        return None
+                        return []
                     tids, _ = _failover_tids(fresh, tid, name)
+                    groups = []
                     for t in tids:
                         eps = fresh.chain_endpoints.get(t, [])
                         if eps:
-                            return (eps[0]["address"] or host,
-                                    eps[0]["port"])
-                    return None
+                            groups.append([
+                                (e["address"] or host, e["port"])
+                                for e in eps])
+                    return groups
 
                 def expect_uri(tid, name=name):
                     # every identity the resolver can legitimately land
@@ -654,9 +683,18 @@ class SidecarProxy:
                             svcs.append(svc)
                     return tuple(ca.active.spiffe_id(s) for s in svcs)
 
+                def resolve_target(tid, _groups=resolve_groups):
+                    # single-endpoint form DERIVED from the groups so
+                    # the two can never drift
+                    for group in _groups(tid):
+                        if group:
+                            return group[0]
+                    return None
+
                 self.upstreams.append(HttpUpstreamListener(
                     self.tls, table_fn, resolve_target, expect_uri,
-                    host=bind_host, port=bind_port))
+                    host=bind_host, port=bind_port,
+                    resolve_groups=resolve_groups))
                 continue
 
             # L4 mode: single expected identity; a non-default TCP

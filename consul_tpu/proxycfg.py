@@ -127,8 +127,11 @@ class ProxyState:
         elif kind == "ingress-gateway":
             # ingress consumes bound services' DISCOVERY CHAINS, so any
             # router/splitter/resolver write must rebuild — topic-wide
-            # config sub (plus services for wildcard binding changes)
-            topics += [("config", None), ("services", None)]
+            # config sub (plus services for wildcard binding changes,
+            # and federation because cross-dc failover targets resolve
+            # through remote mesh gateways)
+            topics += [("config", None), ("services", None),
+                       ("federation", None)]
         else:
             # terminating: bindings live in THIS gateway's own config
             # entry; endpoint health is per bound service, and
@@ -408,6 +411,10 @@ class ProxyState:
                 m.store, gmod.gateway_services(m.store, gw_name))
             for row in bound:
                 svc = row["Service"]
+                # one row per (service, port): a service bound to N
+                # listeners must not recompile/rescan N times
+                if svc in gw_chains:
+                    continue
                 endpoints[svc] = self._healthy_endpoints(svc)
                 # bound services with L7 chains route through the
                 # chain's targets (IngressGateway.DiscoveryChain role)

@@ -704,6 +704,19 @@ def ingress_gateway_resources(snap) -> dict:
             e["address"] or "127.0.0.1", e["port"])}}
             for e in ceps.get(tid, [])]
 
+    # a tcp listener can only ride a chain whose start resolves to a
+    # concrete resolver target; a router/splitter-start (http) chain
+    # bound to a tcp port falls back to the plain cluster — never a
+    # reference to a cluster that was not emitted
+    tcp_bound = {r["Service"] for r in snap.gateway_services
+                 if str(r.get("Protocol", "tcp")).lower() == "tcp"}
+
+    def _tcp_chain_cluster(chain) -> Optional[str]:
+        start = l7._resolve_to_resolver(chain, chain["StartNode"])
+        if start is not None and start.get("Target"):
+            return chain_cluster_name(start["Target"], td)
+        return None
+
     for row in snap.gateway_services:
         svc = row["Service"]
         by_port.setdefault(row.get("Port", 0), []).append(row)
@@ -712,6 +725,13 @@ def ingress_gateway_resources(snap) -> dict:
         seen.add(svc)
         chain = chains.get(svc)
         if chain is not None and not dchain.is_default_chain(chain):
+            if svc in tcp_bound and _tcp_chain_cluster(chain) is None:
+                # keep the plain cluster alive for the tcp binding
+                c, e = _eds_cluster(
+                    f"ingress.{svc}",
+                    snap.upstream_endpoints.get(svc, []))
+                cl.append(c)
+                eds.append(e)
             for node in _chain_resolver_nodes(chain):
                 tid = node["Target"]
                 cname = chain_cluster_name(tid, td)
@@ -757,17 +777,16 @@ def ingress_gateway_resources(snap) -> dict:
                 continue
             tcp_svc = rows[0]["Service"]
             tcp_chain = chains.get(tcp_svc)
+            tcp_cluster = None
             if tcp_chain is not None and \
                     not dchain.is_default_chain(tcp_chain):
                 # a non-default tcp chain replaced ingress.<svc> with
                 # per-target clusters: proxy to the start resolver's
-                # target (same shape as the connect-proxy listeners)
-                start = l7._resolve_to_resolver(
-                    tcp_chain, tcp_chain["StartNode"])
-                tcp_cluster = chain_cluster_name(start["Target"], td) \
-                    if start and start.get("Target") \
-                    else f"ingress.{tcp_svc}"
-            else:
+                # target (same shape as the connect-proxy listeners);
+                # http-start chains fall back to the plain cluster the
+                # cluster loop kept alive for this exact case
+                tcp_cluster = _tcp_chain_cluster(tcp_chain)
+            if tcp_cluster is None:
                 tcp_cluster = f"ingress.{tcp_svc}"
             lst.append({
                 "@type": T + "envoy.config.listener.v3.Listener",

@@ -275,3 +275,113 @@ def test_http_failover_when_primary_leg_empties(mesh):
         urllib.request.urlopen(urllib.request.Request(
             base + "/v1/config/service-resolver/api",
             method="DELETE"), timeout=30)
+
+
+def test_hash_key_and_rendezvous_endpoint_order():
+    """connect/l7.py sticky hashing: hash policies build the key the
+    way envoy's HashPolicy semantics do (terminal short-circuit,
+    source_ip, cookie parsing), and rendezvous ordering is stable per
+    key while spreading across keys."""
+    from consul_tpu.connect import l7
+    lb = {"policy": "ring_hash", "hash_policies": [
+        {"field": "header", "field_value": "x-user", "terminal": True},
+        {"source_ip": True}]}
+    k1 = l7.hash_key(lb, "GET", "/", {"x-user": "alice"}, {}, "1.2.3.4")
+    assert k1 == "alice"                       # terminal short-circuit
+    k2 = l7.hash_key(lb, "GET", "/", {}, {}, "1.2.3.4")
+    assert k2 == "1.2.3.4"                     # falls to source_ip
+    # cookies parse from the header
+    lbc = {"policy": "maglev", "hash_policies": [
+        {"field": "cookie", "field_value": "sess"}]}
+    assert l7.hash_key(lbc, "GET", "/", {"cookie": "a=1; sess=s42"},
+                       {}, "") == "s42"
+    # non-hash policies never produce a key
+    assert l7.hash_key({"policy": "least_request",
+                        "hash_policies": [{"source_ip": True}]},
+                       "GET", "/", {}, {}, "9.9.9.9") is None
+    eps = [("10.0.0.1", 1), ("10.0.0.2", 2), ("10.0.0.3", 3)]
+    order_a = l7.pick_endpoint(eps, "alice")
+    assert l7.pick_endpoint(eps, "alice") == order_a    # stable
+    assert sorted(order_a) == sorted(eps)               # permutation
+    firsts = {l7.pick_endpoint(eps, f"user-{i}")[0] for i in range(40)}
+    assert len(firsts) >= 2                    # spreads across keys
+    assert l7.pick_endpoint(eps, None) == eps  # unhashed: list order
+
+
+def test_ring_hash_sticky_endpoint_selection(mesh):
+    """End-to-end stickiness: with a ring_hash resolver on `api`, the
+    same x-user header always lands on the same backend instance while
+    different users spread (the builtin proxy honoring the policy the
+    emitted RDS asks of a real Envoy).  The module's splitter is
+    removed first — weighted-cluster choice is random PER REQUEST in
+    envoy semantics too, so hashing is only observable within one
+    cluster.  Spins up two fresh instances+sidecars; cleans up."""
+    a, web_proxy, stable, canary = mesh
+    base = a.http_address
+
+    def _del(path):
+        urllib.request.urlopen(urllib.request.Request(
+            base + path, method="PUT" if "deregister" in path
+            else "DELETE"), timeout=30)
+
+    _del("/v1/config/service-splitter/api")
+    _put(base, "/v1/config", {"Kind": "service-defaults",
+                              "Name": "api", "Protocol": "http"})
+    _put(base, "/v1/config", {
+        "Kind": "service-resolver", "Name": "api",
+        "LoadBalancer": {"Policy": "ring_hash", "HashPolicies": [
+            {"Field": "header", "FieldValue": "x-user"}]}})
+    extras, proxies, ids = [], [], []
+    for i in (2, 3):
+        echo = HttpEcho(f"api-inst{i}")
+        extras.append(echo)
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        _put(base, "/v1/agent/service/register",
+             {"Name": "api", "ID": f"api-{i}", "Port": echo.port})
+        _put(base, "/v1/agent/service/register", {
+            "Name": f"api-sc{i}-proxy", "ID": f"api-sc{i}-proxy",
+            "Kind": "connect-proxy", "Port": p,
+            "Proxy": {"DestinationServiceName": "api",
+                      "LocalServicePort": echo.port}})
+        ids += [f"api-{i}", f"api-sc{i}-proxy"]
+        sp = SidecarProxy(a, f"api-sc{i}-proxy")
+        sp.start()
+        proxies.append(sp)
+    lst = web_proxy.upstreams[0]
+    try:
+        # wait until the api target has BOTH fresh endpoints and the
+        # single-route table carries the LB policy
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            snap = web_proxy._state.fetch(0, timeout=0.0)
+            eps = (snap.chain_endpoints.get("api.default.dc1", [])
+                   if snap else [])
+            table = lst.table_fn()
+            if len(eps) >= 2 and len(table) == 1 \
+                    and table[0].get("lb"):
+                break
+            time.sleep(0.2)
+        assert len(eps) >= 2, eps
+        # same user -> same backend, across many requests
+        for user in ("alice", "bob", "carol"):
+            who = {_get_through(lst.port, "/",
+                                {"x-user": user})["who"]
+                   for _ in range(6)}
+            assert len(who) == 1, (user, who)
+        # different users spread across instances eventually
+        firsts = {_get_through(lst.port, "/",
+                               {"x-user": f"u{i}"})["who"]
+                  for i in range(16)}
+        assert len(firsts) >= 2, firsts
+    finally:
+        for sp in proxies:
+            sp.stop()
+        for echo in extras:
+            echo.close()
+        for sid in ids:
+            _del(f"/v1/agent/service/deregister/{sid}")
+        _del("/v1/config/service-resolver/api")
+        _del("/v1/config/service-defaults/api")

@@ -378,3 +378,39 @@ def test_l7_chain_rds_weighted_clusters():
     assert hp[0] == {"header": {"header_name": "x-user"},
                      "terminal": True}
     assert hp[1] == {"connection_properties": {"source_ip": True}}
+
+
+def test_ingress_tcp_listener_with_http_chain_keeps_plain_cluster():
+    """A router/splitter-start (http) chain bound to a TCP listener
+    cannot ride the chain — the plain ingress.<svc> cluster must stay
+    alive and the tcp_proxy must reference IT, never a cluster that
+    was not emitted (reviewer regression, round 4)."""
+    from consul_tpu.discoverychain import compile_chain
+    store = _FakeConfigStore({
+        ("service-splitter", "web"): {"splits": [
+            {"weight": 50, "service": "web"},
+            {"weight": 50, "service": "web-canary"}]},
+    })
+    chain = compile_chain(store, "web", dc="dc1")
+    snap = ConfigSnapshot(
+        proxy_id="ingress-gw", service="ingress-gw", upstreams=[],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"web": [
+            {"address": "10.0.0.5", "port": 8080, "node": "n1"}]},
+        intentions=[], default_allow=True, version=8,
+        kind="ingress-gateway",
+        gateway_services=[{"Gateway": "ingress-gw", "Service": "web",
+                           "GatewayKind": "ingress-gateway",
+                           "Port": 9443, "Protocol": "tcp",
+                           "Hosts": []}],
+        listeners=[{"port": 9443, "protocol": "tcp",
+                    "services": [{"name": "web"}]}],
+        chains={"web": chain},
+        chain_endpoints={"web.default.dc1": [],
+                         "web-canary.default.dc1": []})
+    res = xds.snapshot_resources(snap)["Resources"]
+    cnames = {c["name"] for c in res["clusters"]}
+    assert "ingress.web" in cnames
+    tcp = res["listeners"][0]["filter_chains"][0]["filters"][0]
+    assert tcp["typed_config"]["cluster"] == "ingress.web"
+    assert tcp["typed_config"]["cluster"] in cnames
