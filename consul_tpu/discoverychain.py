@@ -99,7 +99,12 @@ def _resolver_node(store, service: str, chain: dict,
             store, redirect, chain, depth + 1,
             subset=red.get("service_subset") or subset)
         chain["Nodes"][nid] = {"Type": "resolver", "Name": service,
-                               "Redirect": redirect, "Resolver": target}
+                               "Redirect": redirect, "Resolver": target,
+                               # the svc's OWN entry's LB stays visible
+                               # (terminating gateways read it without
+                               # chasing the redirect — routes.go:71)
+                               "LoadBalancer":
+                                   res.get("load_balancer") or None}
         return nid
     subsets = res.get("subsets") or {}
     want_subset = subset or res.get("default_subset", "")

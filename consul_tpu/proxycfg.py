@@ -133,13 +133,11 @@ class ProxyState:
             topics += [("config", None), ("services", None),
                        ("federation", None)]
         else:
-            # terminating: bindings live in THIS gateway's own config
-            # entry; endpoint health is per bound service, and
-            # _sync_health_subs re-keys those after every rebuild —
-            # unrelated config writes or check flaps elsewhere must not
-            # re-run the full snapshot scan
-            topics += [("config", f"{kind}/{self.svc.get('name', '')}"),
-                       ("services", None)]
+            # terminating: bound services' protocols (service-defaults)
+            # and resolvers (LB) shape the filter chains, so config
+            # writes anywhere must rebuild, like ingress; endpoint
+            # health stays per bound service via _sync_health_subs
+            topics += [("config", None), ("services", None)]
         self._subs = [pub.subscribe(t, k, since_index=None)
                       for t, k in topics]
         self._sync_health_subs()
@@ -392,8 +390,13 @@ class ProxyState:
             federation = [f for f in m.store.federation_state_list()
                           if f["datacenter"] != m.dc]
         elif kind == "terminating-gateway":
+            from consul_tpu import discoverychain as dchain
             bound = gmod.resolve_wildcard(
                 m.store, gmod.gateway_services(m.store, gw_name))
+            # ONE intention-table pass for all bound services — this
+            # rebuild fires on every config write (same hoist rationale
+            # as the mesh-gateway branch)
+            all_intentions = m.store.intention_list()
             for row in bound:
                 svc = row["Service"]
                 endpoints[svc] = self._healthy_endpoints(svc)
@@ -402,7 +405,12 @@ class ProxyState:
                 # GatewayService)
                 service_leaves[svc] = m.get_leaf(svc)
                 intentions += imod.match_order(
-                    m.store.intention_list(), svc, "destination")
+                    all_intentions, svc, "destination")
+                # the chain carries the service's protocol + resolver
+                # LB, which decide http-vs-tcp filter chains and route
+                # emission (TerminatingGateway.ServiceResolvers role)
+                gw_chains[svc] = dchain.compile_chain(m.store, svc,
+                                                      dc=m.dc)
         elif kind == "ingress-gateway":
             from consul_tpu import discoverychain as dchain
             ent = m.store.config_entry_get("ingress-gateway", gw_name)

@@ -647,26 +647,62 @@ def mesh_gateway_resources(snap) -> dict:
 def terminating_gateway_resources(snap) -> dict:
     """TLS-terminating gateway: one SNI filter chain per bound service,
     presenting that service's mesh leaf inward and proxying to the
-    real (non-mesh) instances, with per-service RBAC from intentions."""
-    cl, eds, chains = [], [], []
+    real (non-mesh) instances, with per-service RBAC from intentions.
+
+    HTTP-protocol services get an HTTP connection manager + a named
+    default RouteConfiguration with auto_host_rewrite and the
+    resolver's LB policy (routesFromSnapshotTerminatingGateway,
+    agent/xds/routes.go:71 + makeNamedDefaultRouteWithLB)."""
+    cl, eds, fchains, rts = [], [], [], []
     td = _trust_domain(snap)
+    svc_chains = getattr(snap, "chains", {})
     for row in snap.gateway_services:
         svc = row["Service"]
         cname = f"term.{svc}"
+        chain = svc_chains.get(svc)
+        lb = None
+        http_like = False
+        if chain is not None:
+            http_like = chain.get("Protocol") in ("http", "http2",
+                                                  "grpc")
+            # the service's OWN resolver node, never redirect-chased:
+            # term endpoints stay on the original service, so a
+            # redirected target's LB must not apply here
+            own = chain["Nodes"].get(f"resolver:{svc}") or {}
+            lb = own.get("LoadBalancer")
         c, e = _eds_cluster(cname, snap.upstream_endpoints.get(svc, []))
+        _inject_lb_to_cluster(lb, c)
         cl.append(c)
         eds.append(e)
         leaf = snap.service_leaves.get(svc) or snap.leaf
         rules = [it for it in snap.intentions
                  if it["destination"] in (svc, "*")]
-        chains.append({
+        if http_like:
+            action: Dict = {"cluster": cname,
+                            "auto_host_rewrite": True}
+            _inject_lb_to_route_action(lb, action)
+            rts.append({
+                "@type": T + "envoy.config.route.v3."
+                             "RouteConfiguration",
+                "name": cname,
+                "virtual_hosts": [{"name": cname, "domains": ["*"],
+                                   "routes": [{
+                                       "match": {"prefix": "/"},
+                                       "route": action}]}],
+                "validate_clusters": True})
+            app_filters = [_http_connection_manager(
+                f"terminating_gateway.{svc}", cname)]
+        else:
+            app_filters = [_tcp_proxy(f"terminating_gateway.{svc}",
+                                      cname)]
+        fchains.append({
             "filter_chain_match": {
                 "server_names": [f"{svc}.default.{td}"]},
             "transport_socket": _downstream_tls(leaf, snap.roots),
             "filters": [
                 _rbac_filter(rules, snap.default_allow,
-                             stat_prefix=f"terminating_gateway.{svc}"),
-                _tcp_proxy(f"terminating_gateway.{svc}", cname)],
+                             stat_prefix=f"terminating_gateway.{svc}")]
+            + app_filters,
         })
     listener = {
         "@type": T + "envoy.config.listener.v3.Listener",
@@ -674,10 +710,10 @@ def terminating_gateway_resources(snap) -> dict:
         "traffic_direction": "INBOUND",
         "address": _address("0.0.0.0", _gateway_port(snap, 8443)),
         "listener_filters": [_tls_inspector()],
-        "filter_chains": chains,
+        "filter_chains": fchains,
     }
     return {"clusters": cl, "endpoints": eds, "listeners": [listener],
-            "routes": []}
+            "routes": rts}
 
 
 def ingress_gateway_resources(snap) -> dict:

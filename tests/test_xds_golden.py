@@ -414,3 +414,52 @@ def test_ingress_tcp_listener_with_http_chain_keeps_plain_cluster():
     tcp = res["listeners"][0]["filter_chains"][0]["filters"][0]
     assert tcp["typed_config"]["cluster"] == "ingress.web"
     assert tcp["typed_config"]["cluster"] in cnames
+
+
+def test_terminating_gateway_http_service_routes():
+    """An http-protocol bound service gets an HTTP connection manager
+    filter chain (behind the RBAC filter) and a named default
+    RouteConfiguration with auto_host_rewrite + the resolver's LB
+    (routesFromSnapshotTerminatingGateway, routes.go:71)."""
+    from consul_tpu.discoverychain import compile_chain
+    store = _FakeConfigStore({
+        ("service-defaults", "legacy"): {"protocol": "http"},
+        ("service-resolver", "legacy"): {"load_balancer": {
+            "policy": "maglev", "hash_policies": [
+                {"field": "header", "field_value": "x-tenant"}]}},
+    })
+    snap = ConfigSnapshot(
+        proxy_id="term-gw", service="term-gw", upstreams=[],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"legacy": [
+            {"address": "10.0.0.7", "port": 9000, "node": "n2"}]},
+        intentions=[], default_allow=True, version=9,
+        kind="terminating-gateway",
+        gateway_services=[{"Gateway": "term-gw", "Service": "legacy",
+                           "GatewayKind": "terminating-gateway",
+                           "CAFile": "", "CertFile": "",
+                           "KeyFile": "", "SNI": ""}],
+        service_leaves={"legacy": FAKE_LEAF},
+        chains={"legacy": compile_chain(store, "legacy", dc="dc1")})
+    res = xds.snapshot_resources(snap)["Resources"]
+    # cluster carries the LB policy
+    c = next(c for c in res["clusters"] if c["name"] == "term.legacy")
+    assert c["lb_policy"] == "MAGLEV"
+    # filter chain: RBAC then HCM with rds -> term.legacy
+    filters = res["listeners"][0]["filter_chains"][0]["filters"]
+    assert filters[0]["name"] == "envoy.filters.network.rbac"
+    assert filters[1]["name"] == \
+        "envoy.filters.network.http_connection_manager"
+    assert filters[1]["typed_config"]["rds"][
+        "route_config_name"] == "term.legacy"
+    # named default route with auto_host_rewrite + hash policy
+    rt = next(r for r in res["routes"] if r["name"] == "term.legacy")
+    action = rt["virtual_hosts"][0]["routes"][0]["route"]
+    assert action["cluster"] == "term.legacy"
+    assert action["auto_host_rewrite"] is True
+    assert action["hash_policy"][0] == {
+        "header": {"header_name": "x-tenant"}}
+    from consul_tpu import xds_pb
+    for group in ("clusters", "endpoints", "listeners", "routes"):
+        for r in res[group]:
+            xds_pb.from_dict(r)
