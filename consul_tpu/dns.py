@@ -255,13 +255,34 @@ class DNSServer:
                 if resp is not None:
                     self.request.sendall(struct.pack(">H", len(resp)) + resp)
 
-        self.udp = socketserver.ThreadingUDPServer((host, port), UdpHandler)
-        self.port = self.udp.server_address[1]
-        self.tcp = socketserver.ThreadingTCPServer(
-            (host, self.port), TcpHandler, bind_and_activate=False)
-        self.tcp.allow_reuse_address = True
-        self.tcp.server_bind()
-        self.tcp.server_activate()
+        # DNS convention: UDP and TCP share one port.  With port=0 the
+        # kernel picks the UDP port first and the matching TCP bind can
+        # lose a race to another process on a busy box — retry with a
+        # fresh ephemeral pair instead of failing agent startup.
+        last_err: Optional[OSError] = None
+        for _ in range(8):
+            self.udp = socketserver.ThreadingUDPServer((host, port),
+                                                       UdpHandler)
+            self.port = self.udp.server_address[1]
+            try:
+                self.tcp = socketserver.ThreadingTCPServer(
+                    (host, self.port), TcpHandler,
+                    bind_and_activate=False)
+                self.tcp.allow_reuse_address = True
+                self.tcp.server_bind()
+                self.tcp.server_activate()
+                break
+            except OSError as e:
+                # the UDP socket must not leak even when the TCP
+                # CONSTRUCTOR itself fails (e.g. fd exhaustion)
+                last_err = e
+                if getattr(self, "tcp", None) is not None:
+                    self.tcp.server_close()
+                self.udp.server_close()
+                if port != 0:
+                    raise        # a FIXED port conflict is fatal
+        else:
+            raise last_err       # eight ephemeral pairs taken: give up
         self._threads: List[threading.Thread] = []
 
     # ------------------------------------------------------------ lifecycle
