@@ -30,6 +30,11 @@ class GossipConfig:
     suspicion_mult: int = 4
     suspicion_max_timeout_mult: int = 6
     retransmit_mult: int = 4
+    # piggyback packet capacity (memberlist UDPBufferSize=1400; an encoded
+    # suspect/dead message — type byte, node name, incarnation, from — plus
+    # compound-message framing is ~40 bytes)
+    udp_packet_bytes: int = 1400
+    gossip_msg_bytes: int = 40
 
     @classmethod
     def lan(cls) -> "GossipConfig":
@@ -67,6 +72,13 @@ class GossipConfig:
     def confirm_k(self) -> int:
         """Expected independent suspicion confirmations (Lifeguard)."""
         return max(1, self.suspicion_mult - 2)
+
+    def packet_msgs(self) -> int:
+        """Distinct piggybacked gossip messages per UDP packet — the
+        per-contact transfer capacity that bounds mass-event
+        dissemination (memberlist packs broadcasts into each packet up
+        to UDPBufferSize)."""
+        return max(1, self.udp_packet_bytes // self.gossip_msg_bytes)
 
 
 @dataclasses.dataclass(frozen=True)

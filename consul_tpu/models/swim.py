@@ -62,13 +62,23 @@ No-longer-simplifications (capabilities the kernel now has):
   * correlated-kill timing fidelity: suspicion TIMING is dense per
     subject (sus_start/sus_confirm), so V simultaneous deaths run V
     concurrent timers — validated against a real UDP pool at 96 nodes
-    with 8 simultaneous victims (LIVE_VS_SIM.json multi_victim) and
+    with simultaneous victims (LIVE_VS_SIM.json multi_victim) and
     derived against memberlist math at 1M (BENCH_correlated.json
-    derivation block).  Remaining known distortion: DISTINCT concurrent
-    dead rumors cap at U slots ([N,U] memory), so kills far above U
-    (e.g. 1% of 1M on a 256-slot table) drain in ceil(V/U) waves and
-    overstate convergence time ~3x vs the memberlist packet-capacity
-    estimate — stated in the bench artifact, not hidden.
+    derivation block);
+  * mass-event dissemination (kills far above U): expired subjects
+    that cannot win a dead slot enter the BULK death channel
+    (bulk_member/bulk_heard) — exact per node, mean-field per subject
+    — where each ring contact transfers at most `packet_msgs` deaths
+    (memberlist's per-packet piggyback capacity, ~1400B/40B), so V>>U
+    drains at aggregate packet bandwidth, T_99.5 ~ V*ln(200)/(g*P)
+    gossip ticks, instead of in ceil(V/U) slot-turnover waves (the
+    reference's per-node broadcast queues are >=4096 deep,
+    lib/serf/serf.go:20-24 — no wave structure exists there).  Which
+    particular deaths an observer has heard is not tracked per subject
+    (that matrix is the O(N*V) the design avoids); belief queries for
+    bulk subjects are expectations over the uniform piggyback
+    selection, and commit to the dead baseline happens at the same
+    99.5% coverage bar as the slot channel.
 """
 
 from __future__ import annotations
@@ -116,6 +126,7 @@ class SwimParams:
     expiry_suspect_ticks: int  # lifetime of suspect rumors (> max timeout)
     p_loss: float
     rtt_base_ms: float
+    packet_msgs: int           # piggyback msgs per UDP packet (bulk channel)
     seed: int
 
 
@@ -146,6 +157,7 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         expiry_suspect_ticks=gossip.suspicion_max_ticks(n) + spread,
         p_loss=sim.p_loss,
         rtt_base_ms=sim.rtt_base_ms,
+        packet_msgs=gossip.packet_msgs(),
         seed=sim.seed,
     )
 
@@ -187,6 +199,18 @@ class SwimState:
     # only guarantees when the first holder declares death.
     sus_start: jnp.ndarray       # [N] int32: first failed-probe tick, -1=none
     sus_confirm: jnp.ndarray     # [N] int32: independent confirmations
+    # --- bulk death channel (mass-event dissemination) ---
+    # When V suspicion-expired subjects exceed free rumor slots, the
+    # overflow disseminates here: exact per NODE, mean-field per SUBJECT.
+    # bulk_heard[i] = how many of the current bulk deaths node i has
+    # heard; per ring contact a sender transfers at most `packet_msgs`
+    # of them (memberlist's per-packet piggyback capacity), so V >> U
+    # drains at aggregate packet bandwidth — no ceil(V/U) wave
+    # structure (per-node broadcast queues are >=4096 deep in the
+    # reference, lib/serf/serf.go:20-24).
+    bulk_member: jnp.ndarray     # [N] bool: subject is in the bulk channel
+    bulk_heard: jnp.ndarray      # [N] float32: expected bulk deaths heard
+    bulk_cov: jnp.ndarray        # [N] float32: per-SUBJECT coverage estimate
 
 
 def init_state(params: SwimParams, key=None,
@@ -231,6 +255,9 @@ def init_state(params: SwimParams, key=None,
         sends_left=jnp.zeros((n, u), jnp.int8),
         sus_start=jnp.full((n,), -1, jnp.int32),
         sus_confirm=jnp.zeros((n,), jnp.int32),
+        bulk_member=jnp.zeros((n,), bool),
+        bulk_heard=jnp.zeros((n,), jnp.float32),
+        bulk_cov=jnp.zeros((n,), jnp.float32),
     )
 
 
@@ -326,6 +353,11 @@ def _believes_down_shift(params: SwimParams, s: SwimState, maps,
     refuted = (av >= 0) & (a_inc > s_inc) & _row_gather(s.know, a_slot)
     refuted |= s_inc < rolls.pull(s.committed_inc, shift)
     down |= expired & ~refuted
+    # bulk-channel subjects are past their suspicion timeout and
+    # awaiting only dissemination — probers skip them (memberlist nodes
+    # that marked X dead stop probing X; here the skip is global one
+    # detection-latency ahead of per-observer hearing, documented)
+    down |= rolls.pull(s.bulk_member, shift)
     return down
 
 
@@ -354,7 +386,11 @@ def believed_down_fraction(params: SwimParams, s: SwimState, subject: int) -> jn
     down_i |= jnp.any(s.know & is_s[None, :] & age_ok & ~refuted, axis=1)
 
     observer = s.up & s.member & (jnp.arange(n) != subject)
-    return jnp.sum(down_i & observer) / jnp.maximum(jnp.sum(observer), 1)
+    frac = jnp.sum(down_i & observer) / jnp.maximum(jnp.sum(observer), 1)
+    # bulk-channel subject: its own mean-field coverage estimate is the
+    # expected fraction of observers that heard its death
+    return jnp.maximum(frac, jnp.where(s.bulk_member[subject],
+                                       s.bulk_cov[subject], 0.0))
 
 
 # ---------------------------------------------------------------------------
@@ -655,17 +691,40 @@ def _dense_suspicion_expiry(params: SwimParams, s: SwimState,
     prober_live = rolls.push(s.up & s.member, shift)              # [N]
     want = jnp.where(expired & (dead_of < 0) & (left_of < 0)
                      & (suspect_of < 0) & ~s.committed_dead
-                     & prober_live, 1, 0)
+                     & ~s.bulk_member & prober_live, 1, 0)
     target = (jnp.arange(n, dtype=jnp.int32) + shift) % n
     # row i's probe target this round is (i+shift)%N: seed the dead
     # rumor at the prober rows whose subject wants one (pull = ring
     # rotation, no gather)
     row_subject = jnp.where(rolls.pull(want, shift) > 0, target, -1)
     s = _originate(params, s, want, DEAD, s.incarnation, row_subject)
-    # clear: refuted, or a dead rumor now exists / death committed
+    # overflow: expired subjects that could not win a dead slot THIS
+    # round enter the bulk channel immediately — their timer already
+    # ran out; making them wait for slot turnover is exactly the wave
+    # artifact (memberlist enqueues every dead broadcast at once).
+    # Seed: this round's prober is the first knower.
     _, dead_of2, left_of2, _ = _maps(params, s)
+    overflow = (want > 0) & (dead_of2 < 0)
+    bulk_member = s.bulk_member | overflow
+    # row i probes (i+shift)%N, and want>0 already requires the prober
+    # live, so the pulled overflow mask IS the live seeding rows.
+    # Clamp stale heard mass first: after the previous event fully
+    # committed (or a revive withdrew the last subject) the channel is
+    # empty and heard counts must restart from zero.
+    v_prev = jnp.sum(s.bulk_member).astype(jnp.float32)
+    seeded = rolls.pull(overflow, shift)
+    bulk_heard = jnp.minimum(
+        jnp.minimum(s.bulk_heard, v_prev) + seeded.astype(jnp.float32),
+        jnp.sum(bulk_member).astype(jnp.float32))
+    # per-subject coverage starts at one knower (the prober)
+    n_live_f = jnp.maximum(jnp.sum(s.up & s.member), 1).astype(jnp.float32)
+    bulk_cov = jnp.where(overflow, 1.0 / n_live_f, s.bulk_cov)
+    s = s.replace(bulk_member=bulk_member, bulk_heard=bulk_heard,
+                  bulk_cov=bulk_cov)
+    # clear: refuted, or a dead rumor now exists / death committed /
+    # subject handed to the bulk channel
     done = refute | s.committed_dead | s.committed_left \
-        | (dead_of2 >= 0) | (left_of2 >= 0) | ~s.member
+        | (dead_of2 >= 0) | (left_of2 >= 0) | ~s.member | bulk_member
     return s.replace(
         sus_start=jnp.where(done, -1, s.sus_start),
         sus_confirm=jnp.where(done, 0, s.sus_confirm))
@@ -735,6 +794,82 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
     learn_tick = jnp.where(res.newly, tick, s.learn_tick)
     return s.replace(know=res.know, learn_tick=learn_tick,
                      sends_left=res.sends_left)
+
+
+def _bulk_disseminate(params: SwimParams, s: SwimState) -> SwimState:
+    """Advance the bulk death channel one gossip tick.
+
+    Two coupled marginals of the (untracked) node x subject knowledge
+    matrix evolve:
+
+    NODE marginal `bulk_heard[i]` (exact ring contacts): per contact a
+    live sender piggybacks at most `packet_msgs` bulk deaths into the
+    packet (memberlist packs its broadcast queue least-retransmitted-
+    first into each 1400-byte UDP packet, so from the receiver's view
+    the selection is ~uniform over the V in flight); the receiver's
+    expected novel messages per packet are supply * (1 - heard/V) —
+    the hypergeometric mean — discounted by packet loss.
+
+    SUBJECT marginal `bulk_cov[j]` (mean-field logistic): a non-knower
+    learns death j this tick with probability
+    1 - (1 - cov_j * sel * p_ok)^g, where sel = min(1, P/mean_supply)
+    is the chance j fits in a packet and g the contacts per tick.
+    While carriers are scarce (supply < P) sel=1 — the epidemic ramp;
+    once supply saturates, sel = P/V and the drain integrates to the
+    aggregate packet-capacity estimate T_99.5 ~ V*ln(200)/(g*P)
+    gossip ticks — the memberlist math in BENCH_correlated.json.
+    Tracking coverage PER SUBJECT is what lets stragglers that enter
+    late carry their own clock instead of inheriting the aggregate's
+    (commit and detection would otherwise fire the tick they enter).
+
+    Per-rumor retransmit-limit exhaustion is not modeled (limit *
+    carriers >> V*N deliveries; queues are >=4096 deep)."""
+    n = params.n_nodes
+    key = prng.tick_key(params.seed, s.tick, 4)
+    offs = rolls.offsets(key, n, params.gossip_nodes)
+    v = jnp.maximum(jnp.sum(s.bulk_member).astype(jnp.float32), 1.0)
+    cap = jnp.float32(params.packet_msgs)
+    p_ok = jnp.float32(1.0 - params.p_loss)
+    recv = s.up & s.member
+    # clamp: a revive() withdrawal mid-flight shrinks V below already-
+    # accumulated heard counts (mean-field has no per-subject deduction)
+    heard = jnp.minimum(s.bulk_heard, v)
+    supply_src = jnp.where(s.up, heard, 0.0)
+    n_up = jnp.maximum(jnp.sum(s.up), 1).astype(jnp.float32)
+    mean_supply = jnp.sum(supply_src) / n_up
+    views = rolls.pull_multi(supply_src, offs)     # one doubled buffer
+    for view in views:
+        supply = jnp.minimum(view, cap)
+        novelty = 1.0 - heard / v
+        heard = jnp.where(recv,
+                          jnp.minimum(heard + supply * novelty * p_ok, v),
+                          heard)
+    # subject marginal: g contacts, each carrying j w.p. cov*sel*p_ok
+    sel = jnp.minimum(1.0, cap / jnp.maximum(mean_supply, 1.0))
+    cov = s.bulk_cov
+    p_learn = 1.0 - (1.0 - jnp.clip(cov * sel * p_ok, 0.0, 1.0)) \
+        ** params.gossip_nodes
+    cov = jnp.where(s.bulk_member,
+                    jnp.clip(cov + (1.0 - cov) * p_learn, 0.0, 1.0),
+                    0.0)
+    return s.replace(bulk_heard=heard, bulk_cov=cov)
+
+
+def _bulk_commit(params: SwimParams, s: SwimState) -> SwimState:
+    """Commit bulk subjects whose OWN coverage estimate reached the
+    same 99.5% bar the slot channel uses, deduct their mass from the
+    node marginal, and free their entries.  Per-subject coverage makes
+    this a rolling commit: stragglers keep their own clock, and
+    sustained churn can never starve fully-disseminated deaths."""
+    done = s.bulk_member & (s.bulk_cov >= 0.995)
+    removed = jnp.sum(jnp.where(done, s.bulk_cov, 0.0))
+    v_new = jnp.sum(s.bulk_member & ~done).astype(jnp.float32)
+    heard = jnp.clip(s.bulk_heard - removed, 0.0, v_new)
+    return s.replace(
+        committed_dead=s.committed_dead | done,
+        bulk_member=s.bulk_member & ~done,
+        bulk_heard=heard,
+        bulk_cov=jnp.where(done, 0.0, s.bulk_cov))
 
 
 def _expire(params: SwimParams, s: SwimState) -> SwimState:
@@ -809,6 +944,12 @@ def step_with_obs(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs
     s, obs = jax.lax.cond(do_probe, probe_branch,
                           lambda st: (st, _empty_obs(params)), s)
     s = _disseminate(params, s)
+    # bulk channel: active only during mass events — skip its ring
+    # pulls and reductions entirely in the steady state
+    s = jax.lax.cond(
+        jnp.any(s.bulk_member),
+        lambda st: _bulk_commit(params, _bulk_disseminate(params, st)),
+        lambda st: st, s)
     return s.replace(tick=s.tick + 1), obs
 
 
@@ -865,8 +1006,10 @@ def mass_detection_stats(params: SwimParams, s: SwimState,
         & (coverage >= 0.99)
     rumor_detected = jnp.zeros((n,), bool).at[
         jnp.where(dead_sl, s.r_subject, 0)].max(dead_sl)
+    # bulk-channel subjects: detected once their OWN coverage estimate
+    # reaches the same 99% bar
     believed_down = s.committed_dead | s.committed_left \
-        | rumor_detected
+        | rumor_detected | (s.bulk_member & (s.bulk_cov >= 0.99))
     victims = victim_mask & s.member
     recall = jnp.sum(believed_down & victims) / \
         jnp.maximum(jnp.sum(victims), 1)
@@ -882,8 +1025,11 @@ def kill(s: SwimState, node: int) -> SwimState:
 def revive(s: SwimState, node: int) -> SwimState:
     """Bring the process back up WITHOUT a rejoin: only heals if the
     death was never committed (inside the suspicion window).  A node the
-    cluster already declared dead must `rejoin` instead."""
-    return s.replace(up=s.up.at[node].set(True))
+    cluster already declared dead must `rejoin` instead.  A bulk-channel
+    entry is withdrawn (mean-field has no per-subject refutation)."""
+    return s.replace(up=s.up.at[node].set(True),
+                     bulk_member=s.bulk_member.at[node].set(False),
+                     bulk_cov=s.bulk_cov.at[node].set(0.0))
 
 
 def rejoin(params: SwimParams, s: SwimState, node: int) -> SwimState:
@@ -904,6 +1050,8 @@ def rejoin(params: SwimParams, s: SwimState, node: int) -> SwimState:
         committed_left=s.committed_left.at[node].set(False),
         incarnation=inc,
         r_active=s.r_active & ~stale,
+        bulk_member=s.bulk_member.at[node].set(False),
+        bulk_cov=s.bulk_cov.at[node].set(0.0),
     )
     want = jnp.zeros((params.n_nodes,), jnp.int32).at[node].set(1)
     row_subject = jnp.where(jnp.arange(params.n_nodes) == node, node,
