@@ -28,6 +28,7 @@ response carries X-Consul-Index (agent/consul/rpc.go:806 blockingQuery).
 from __future__ import annotations
 
 import base64
+import hashlib
 import json
 import re
 import threading
@@ -41,6 +42,7 @@ from consul_tpu.acl.resolver import ACLResolver
 from consul_tpu.bexpr import BexprError
 from consul_tpu.catalog.store import StateStore
 from consul_tpu.oracle import GossipOracle
+from consul_tpu import servicemgr
 from consul_tpu.version import VERSION
 
 
@@ -290,6 +292,18 @@ class ApiServer:
             return gmod.gateway_services(st, key), st.index
 
         reg("gateway_services", _fetch_gateway_services, ttl=600.0)
+
+        def _fetch_resolved_config(key, mi, t):
+            # key = service name [\x00 upstream,...] — the central
+            # defaults merge the ServiceManager consumes
+            # (agent/cache-types/resolved_service_config.go)
+            parts = key.split("\x00")
+            ups = tuple(u for u in parts[1:] if u)
+            return (servicemgr.resolve_service_config(
+                st, parts[0], ups), st.index)
+
+        reg("resolved_service_config", _fetch_resolved_config,
+            ttl=600.0)
         reg("federation_states",
             lambda key, mi, t: (st.federation_state_list(), st.index),
             ttl=600.0)
@@ -621,13 +635,29 @@ def _make_handler(srv: ApiServer):
                                     "terminating-gateway"):
                 # mesh data-plane services (sidecars + the three gateway
                 # kinds) register store-side with Kind/Proxy intact —
-                # proxycfg discovers them in the catalog
+                # proxycfg discovers them in the catalog.  The full
+                # proxy surface is kept (config/mode/transparent_proxy/
+                # expose — structs.ConnectProxyConfig) so the
+                # ServiceManager merge and the expose/tproxy listener
+                # shapes have their inputs.
                 proxy_raw = body.get("Proxy") or {}
                 proxy = {
                     "destination_service": proxy_raw.get(
                         "DestinationServiceName", ""),
+                    "destination_service_id": proxy_raw.get(
+                        "DestinationServiceID", ""),
+                    "local_service_address": proxy_raw.get(
+                        "LocalServiceAddress", "127.0.0.1"),
                     "local_service_port": proxy_raw.get(
                         "LocalServicePort", 0),
+                    "config": proxy_raw.get("Config") or {},
+                    "mode": proxy_raw.get("Mode", ""),
+                    "transparent_proxy": _lower_keys(
+                        proxy_raw.get("TransparentProxy") or {}),
+                    "expose": _lower_keys(proxy_raw.get("Expose")
+                                          or {}),
+                    "mesh_gateway": _lower_keys(
+                        proxy_raw.get("MeshGateway") or {}),
                     "upstreams": [
                         {"destination_name": u.get(
                             "DestinationName", ""),
@@ -659,16 +689,29 @@ def _make_handler(srv: ApiServer):
                         service_id=sid)
                     defn = _check_defn(chk)
                     if srv.checks is not None and defn:
+                        def _store_notify(check_id, status,
+                                          output=""):
+                            try:
+                                store.update_check(
+                                    srv.node_name, check_id,
+                                    status, output=output)
+                            except KeyError:
+                                pass
+                        if defn.get("alias_node") or \
+                                defn.get("alias_service"):
+                            # sidecar alias-of-parent check (the
+                            # second default check sidecar_service.go
+                            # attaches) — mirrors the parent's
+                            # aggregate status store-side
+                            from consul_tpu.checks import CheckAlias
+                            srv.checks.add(CheckAlias(
+                                cid, _store_notify, store,
+                                defn.get("alias_node")
+                                or srv.node_name,
+                                defn.get("alias_service", "")))
+                            continue
                         runner = srv.checks.from_definition(cid, defn)
                         if runner is not None:
-                            def _store_notify(check_id, status,
-                                              output=""):
-                                try:
-                                    store.update_check(
-                                        srv.node_name, check_id,
-                                        status, output=output)
-                                except KeyError:
-                                    pass
                             runner.notify = _store_notify
                             srv.checks.add(runner)
                 return
@@ -691,6 +734,57 @@ def _make_handler(srv: ApiServer):
                 self._agent_register_check(cid, chk, sid)
             if srv.local is not None:
                 srv.local.sync_changes(store)
+            # connect.sidecar_service {}: expand into a fully-defaulted
+            # connect-proxy registration with an allocated port
+            # (agent/sidecar_service.go:12) and register it like any
+            # other sidecar
+            expanded = servicemgr.expand_sidecar(
+                body, store.node_services(srv.node_name))
+            if expanded is not None:
+                s_sid, s_body = expanded
+                self._agent_register_service(s_sid, s_body)
+
+        def _agent_service_json(self, sid: str, row: dict,
+                                resolved: dict | None = None) -> dict:
+            """One agent service in the reference's api.AgentService
+            wire shape, with a connect-proxy's config RESOLVED against
+            central defaults (service_manager.go merge) and a
+            ContentHash over the rendered definition (AgentService
+            hash-blocking)."""
+            out = {
+                "ID": sid,
+                "Service": row["name"],
+                "Tags": row.get("tags") or [],
+                "Meta": row.get("meta") or {},
+                "Port": row.get("port", 0),
+                "Address": row.get("address", ""),
+                "Datacenter": srv.dc,
+            }
+            kind = row.get("kind", "")
+            if kind:
+                out["Kind"] = kind
+            proxy = row.get("proxy") or {}
+            if kind in ("connect-proxy", "ingress-gateway",
+                        "terminating-gateway", "mesh-gateway"):
+                dest = proxy.get("destination_service", "")
+                merged = servicemgr.merged_proxy(
+                    store, proxy, dest or row["name"], resolved)
+                out["Proxy"] = _proxy_json(merged)
+            out["ContentHash"] = hashlib.sha256(
+                json.dumps(out, sort_keys=True).encode()
+            ).hexdigest()[:16]
+            return out
+
+        def _drop_service_runners(self, sid: str) -> None:
+            """Stop check runners armed for a STORE-side service before
+            its rows go away (the local-state path removes its own;
+            without this, sidecar TCP/alias runners outlive their
+            service and poll a deregistered target forever)."""
+            if srv.checks is None:
+                return
+            for c in store.node_checks(srv.node_name):
+                if c.get("service_id") == sid:
+                    srv.checks.remove(c["check_id"])
 
         def _agent_register_check(self, cid: str, body: dict,
                                   service_id: str = "") -> None:
@@ -1213,6 +1307,62 @@ def _make_handler(srv: ApiServer):
                         "message."))
                 self._send(None)
                 return True
+            m = re.fullmatch(r"/v1/agent/service/([^/]+)", path)
+            if m and verb == "GET" and m.group(1) not in (
+                    "register", "maintenance"):
+                # blocking agent-local service view with RESOLVED proxy
+                # config — the endpoint `consul connect envoy`
+                # bootstraps from (agent/http_register.go:43,
+                # agent/agent_endpoint.go AgentService).  Blocks on
+                # ?hash= like the reference (hash of the rendered
+                # definition, not a raft index: agent-local state has
+                # none).
+                sid = m.group(1)
+
+                def _render():
+                    row = next((s for s in
+                                store.node_services(srv.node_name)
+                                if s["id"] == sid), None)
+                    if row is None:
+                        return None
+                    resolved = None
+                    dest = (row.get("proxy") or {}).get(
+                        "destination_service") or row["name"]
+                    hit = srv.cached_read("resolved_service_config",
+                                          dest, self.headers, q) \
+                        if row.get("kind") else None
+                    if hit is not None:
+                        resolved = hit[0]
+                    return self._agent_service_json(sid, row, resolved)
+
+                body0 = _render()
+                if body0 is None:
+                    self._err(404, f"unknown service id {sid!r}")
+                    return True
+                if not self.authz.service_read(body0["Service"]):
+                    return self._forbid()
+                if "hash" in q:
+                    deadline = time.time() + min(
+                        _parse_wait(q.get("wait", "300s")), 600.0)
+                    while time.time() < deadline:
+                        # snapshot the index BEFORE rendering so a
+                        # write landing mid-render wakes the wait;
+                        # wait_for(idx0) parks only while
+                        # _index <= idx0 (one new write suffices)
+                        idx0 = store.index
+                        body0 = _render()
+                        if body0 is None or \
+                                body0["ContentHash"] != q["hash"]:
+                            break
+                        store.wait_for(idx0,
+                                       timeout=deadline - time.time())
+                    if body0 is None:
+                        self._err(404, f"unknown service id {sid!r}")
+                        return True
+                self._send(body0,
+                           extra_headers={"X-Consul-ContentHash":
+                                          body0["ContentHash"]})
+                return True
             m = re.fullmatch(r"/v1/agent/service/maintenance/(.+)", path)
             if m and verb == "PUT":
                 sid = m.group(1)
@@ -1381,7 +1531,15 @@ def _make_handler(srv: ApiServer):
                     # store-registered services (connect-proxy sidecars
                     # bypass local state) deregister store-side — no
                     # ghost proxies surviving their own deregistration
+                    self._drop_service_runners(sid)
                     store.deregister_service(srv.node_name, sid)
+                # an auto-registered sidecar (connect.sidecar_service)
+                # leaves with its parent (agent removeService cascade)
+                scid = servicemgr.sidecar_id_for(sid)
+                if any(s["id"] == scid
+                       for s in store.node_services(srv.node_name)):
+                    self._drop_service_runners(scid)
+                    store.deregister_service(srv.node_name, scid)
                 self._send(None)
                 return True
             if path == "/v1/agent/check/register" and verb == "PUT":
@@ -3170,6 +3328,36 @@ def _camel(obj):
     if isinstance(obj, list):
         return [_camel(x) for x in obj]
     return obj
+
+
+def _proxy_json(proxy: dict) -> dict:
+    """Stored snake_case proxy block → the reference's CamelCase
+    structs.ConnectProxyConfig wire shape.  The opaque Config map
+    passes through verbatim."""
+    out = {
+        "DestinationServiceName": proxy.get("destination_service", ""),
+        "DestinationServiceID": proxy.get("destination_service_id",
+                                          ""),
+        "LocalServiceAddress": proxy.get("local_service_address",
+                                         "127.0.0.1"),
+        "LocalServicePort": proxy.get("local_service_port", 0),
+        "Config": proxy.get("config") or {},
+        "Upstreams": [
+            {"DestinationName": u.get("destination_name", ""),
+             "LocalBindPort": u.get("local_bind_port", 0),
+             "LocalBindAddress": u.get("local_bind_address",
+                                       "127.0.0.1")}
+            for u in proxy.get("upstreams") or []],
+    }
+    if proxy.get("mode"):
+        out["Mode"] = proxy["mode"]
+    if proxy.get("transparent_proxy"):
+        out["TransparentProxy"] = _camel(proxy["transparent_proxy"])
+    if proxy.get("expose"):
+        out["Expose"] = _camel(proxy["expose"])
+    if proxy.get("mesh_gateway"):
+        out["MeshGateway"] = _camel(proxy["mesh_gateway"])
+    return out
 
 
 def _snake(name: str) -> str:

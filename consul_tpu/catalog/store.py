@@ -375,6 +375,13 @@ class StateStore:
             svc = self._services.get((node, service_id))
             if svc:
                 ev.append(("health", svc["name"]))
+                # a sidecar's check gates its DESTINATION's connect
+                # rows (health_connect_nodes folds proxy checks into
+                # the app's health) — wake the app's health watchers
+                dest = (svc.get("proxy") or {}).get(
+                    "destination_service")
+                if svc.get("kind") == "connect-proxy" and dest:
+                    ev.append(("health", dest))
         else:
             for (n, _sid), v in self._services.items():
                 if n == node:

@@ -120,6 +120,7 @@ class SwimParams:
     retransmit_limit: int
     suspicion_min_ticks: int
     suspicion_max_ticks: int
+    declare_lag_ticks: int     # probe-cycle completion before suspect
     confirm_k: int
     alloc_cap: int
     expiry_gossip_ticks: int   # lifetime of alive/dead/left rumors
@@ -147,6 +148,15 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         retransmit_limit=limit,
         suspicion_min_ticks=gossip.suspicion_min_ticks(n),
         suspicion_max_ticks=gossip.suspicion_max_ticks(n),
+        # memberlist's probeNode declares suspect only after the FULL
+        # probe cycle — direct ping (probe_timeout) then indirect
+        # probes (another probe_timeout) — not at probe start.  The
+        # sim's timers are anchored at the probe tick, so the cycle
+        # length is added to every suspicion timeout; without it the
+        # sim ran a systematic ~probe_interval fast vs the live pool
+        # (LIVE_VS_SIM r4: ratios 0.70-0.87).
+        declare_lag_ticks=math.ceil(2 * gossip.probe_timeout
+                                    / gossip.gossip_interval),
         confirm_k=gossip.confirm_k(),
         # clamp: top_k(k=alloc_cap) runs over [N] wants AND [U] free
         # slots — tiny pools (e.g.
@@ -312,13 +322,16 @@ def _table_lookup(vec_u: jnp.ndarray, cols: jnp.ndarray):
 def _suspicion_timeout_ticks(params: SwimParams, confirm: jnp.ndarray) -> jnp.ndarray:
     """Lifeguard: timer decays from max to min as confirmations arrive.
 
-    timeout = max - (max - min) * log(c+1)/log(k+1), floored at min.
+    timeout = max - (max - min) * log(c+1)/log(k+1), floored at min,
+    plus the probe-cycle declare lag (timers here anchor at the probe
+    tick; memberlist's suspect state begins a full probe cycle later).
     """
     mn = jnp.float32(params.suspicion_min_ticks)
     mx = jnp.float32(params.suspicion_max_ticks)
     frac = jnp.log(confirm.astype(jnp.float32) + 1.0) / math.log(params.confirm_k + 1.0)
     t = mx - (mx - mn) * jnp.clip(frac, 0.0, 1.0)
-    return jnp.ceil(jnp.maximum(t, mn)).astype(jnp.int32)
+    return jnp.ceil(jnp.maximum(t, mn)).astype(jnp.int32) \
+        + params.declare_lag_ticks
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,198 @@
+"""ServiceManager: central-config resolution + sidecar auto-registration.
+
+The reference merges `service-defaults` / `proxy-defaults` config
+entries into every locally-registered service as it registers
+(agent/service_manager.go:19, agent/consul/config_endpoint.go
+ResolveServiceConfig), serves the resolved view at the blocking
+`GET /v1/agent/service/:id` endpoint `consul connect envoy` bootstraps
+from (agent/http_register.go:43, agent/agent_endpoint.go AgentService),
+and expands a nested `connect.sidecar_service {}` stanza into a fully
+defaulted connect-proxy registration with a port allocated from
+[sidecar_min_port, sidecar_max_port] (agent/sidecar_service.go:12).
+
+This module is the store-functional core of that layer; the HTTP
+routes in api/http.py call into it, and the `resolved_service_config`
+cache type (agent/cache-types/resolved_service_config.go) wraps
+`resolve_service_config`.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+from consul_tpu.discoverychain import service_protocol
+
+# the reference's default sidecar port range (agent/config/default.go
+# sidecar_min_port/sidecar_max_port)
+SIDECAR_MIN_PORT = 21000
+SIDECAR_MAX_PORT = 21255
+
+
+def sidecar_id_for(parent_id: str) -> str:
+    """agent/sidecar_service.go sidecarIDForService."""
+    return f"{parent_id}-sidecar-proxy"
+
+
+def resolve_service_config(store, service: str,
+                           upstreams: Tuple[str, ...] = ()) -> dict:
+    """Resolved central config for `service` — the merge of
+    proxy-defaults (global) under service-defaults (per-service), plus
+    per-upstream protocols (ConfigEntry.ResolveServiceConfig,
+    agent/consul/config_endpoint.go; structs.ServiceConfigResponse).
+
+    Wire-shape (CamelCase) like the reference response; the opaque
+    proxy-defaults Config map passes through verbatim.
+    """
+    pd = store.config_entry_get("proxy-defaults", "global") or {}
+    sd = store.config_entry_get("service-defaults", service) or {}
+    cfg = dict(pd.get("config") or {})
+    proto = sd.get("protocol") or cfg.get("protocol") or "tcp"
+    cfg["protocol"] = str(proto).lower()
+    mode = sd.get("mode") or pd.get("mode") or ""
+    # transparent_proxy settings ride with whichever entry set them;
+    # service-defaults wins (config_entry.go:89,254)
+    tproxy = sd.get("transparent_proxy") or pd.get("transparent_proxy") \
+        or {}
+    out = {
+        "ProxyConfig": cfg,
+        "Mode": mode,
+        "TransparentProxy": dict(tproxy),
+        "MeshGateway": dict(sd.get("mesh_gateway")
+                            or pd.get("mesh_gateway") or {}),
+        "Expose": copy.deepcopy(sd.get("expose") or pd.get("expose")
+                                or {}),
+        "UpstreamConfigs": {},
+    }
+    # per-upstream defaults: the upstream's own protocol, overlaid with
+    # this service's service-defaults upstream_config overrides
+    # (structs.UpstreamConfiguration)
+    uc = sd.get("upstream_config") or {}
+    uc_defaults = uc.get("defaults") or {}
+    uc_over = {o.get("name", ""): o for o in uc.get("overrides") or []}
+    for up in upstreams:
+        entry = {"Protocol": service_protocol(store, up)}
+        for src in (uc_defaults, uc_over.get(up, {})):
+            for k, v in src.items():
+                if k == "name":
+                    continue
+                entry[_camel_key(k)] = v
+        out["UpstreamConfigs"][up] = entry
+    return out
+
+
+def _camel_key(k: str) -> str:
+    return "".join(p.capitalize() or "_" for p in k.split("_"))
+
+
+def merged_proxy(store, proxy: dict, service_name: str,
+                 resolved: Optional[dict] = None) -> dict:
+    """A connect-proxy registration's snake_case `proxy` dict with the
+    central defaults for its DESTINATION service merged underneath
+    (registration wins — service_manager.go mergeServiceConfig).
+
+    Adds/normalizes: config (map), mode, transparent_proxy, expose,
+    mesh_gateway.  The store keeps the raw registration; this merged
+    view is what proxycfg / xDS / the agent endpoint consume.
+    `resolved` short-circuits the central lookup (the
+    resolved_service_config cache type feeds it on ?cached reads).
+    """
+    if resolved is None:
+        resolved = resolve_service_config(store, service_name)
+    out = dict(proxy)
+    cfg = dict(resolved["ProxyConfig"])
+    cfg.update(proxy.get("config") or {})
+    out["config"] = cfg
+    if not out.get("mode"):
+        out["mode"] = resolved["Mode"]
+    if not out.get("transparent_proxy"):
+        out["transparent_proxy"] = resolved["TransparentProxy"]
+    if not out.get("expose"):
+        out["expose"] = _snake_expose(resolved["Expose"])
+    if not out.get("mesh_gateway"):
+        out["mesh_gateway"] = resolved["MeshGateway"]
+    return out
+
+
+def _snake_expose(expose: dict) -> dict:
+    """Expose blocks arrive from config entries already snake_case;
+    pass through (helper exists so callers are explicit about shape)."""
+    return copy.deepcopy(expose) if expose else {}
+
+
+def allocate_sidecar_port(node_services: List[dict], sid: str = "",
+                          min_port: int = SIDECAR_MIN_PORT,
+                          max_port: int = SIDECAR_MAX_PORT) -> int:
+    """Port for sidecar `sid`: an existing registration under the same
+    id KEEPS its port (re-registration must not drift the listener),
+    otherwise the first port in the range no service on this node
+    claims (sidecarServiceFromNodeService port scan,
+    agent/sidecar_service.go:97)."""
+    for s in node_services:
+        if sid and s.get("id") == sid and \
+                min_port <= s.get("port", 0) <= max_port:
+            return s["port"]
+    used = {s.get("port", 0) for s in node_services}
+    for p in range(min_port, max_port + 1):
+        if p not in used:
+            return p
+    raise ValueError(
+        f"no free sidecar port in [{min_port}, {max_port}]")
+
+
+def expand_sidecar(body: dict, node_services: List[dict],
+                   min_port: int = SIDECAR_MIN_PORT,
+                   max_port: int = SIDECAR_MAX_PORT
+                   ) -> Optional[Tuple[str, dict]]:
+    """Expand `Connect.SidecarService` of a CamelCase registration body
+    into a full connect-proxy registration (sid, body), or None when no
+    stanza is present (agent/sidecar_service.go:12
+    sidecarServiceFromNodeService).
+
+    Defaults filled: ID/Name from the parent, port allocated from the
+    sidecar range, Proxy.DestinationService* -> parent,
+    LocalServicePort -> parent port, and the reference's two default
+    checks (TCP on the proxy port + alias of the parent) unless the
+    stanza carries its own.
+    """
+    connect = body.get("Connect") or {}
+    stanza = connect.get("SidecarService")
+    if stanza is None:
+        return None
+    stanza = dict(stanza)
+    parent_id = body.get("ID") or body.get("Name")
+    parent_name = body.get("Name", parent_id)
+    sid = stanza.get("ID") or sidecar_id_for(parent_id)
+    name = stanza.get("Name") or f"{parent_name}-sidecar-proxy"
+    port = stanza.get("Port") or allocate_sidecar_port(
+        node_services, sid, min_port, max_port)
+    proxy = dict(stanza.get("Proxy") or {})
+    proxy.setdefault("DestinationServiceName", parent_name)
+    proxy.setdefault("DestinationServiceID", parent_id)
+    proxy.setdefault("LocalServiceAddress", "127.0.0.1")
+    if not proxy.get("LocalServicePort"):
+        proxy["LocalServicePort"] = body.get("Port", 0)
+    checks = stanza.get("Checks") or stanza.get("Check")
+    if not checks:
+        checks = [
+            {"Name": "Connect Sidecar Listening",
+             "CheckID": f"sidecar-listening:{sid}",
+             "TCP": f"127.0.0.1:{port}", "Interval": "10s"},
+            {"Name": f"Connect Sidecar Aliasing {parent_id}",
+             "CheckID": f"sidecar-alias:{sid}",
+             "AliasService": parent_id},
+        ]
+    elif isinstance(checks, dict):
+        checks = [checks]
+    out = {
+        "Kind": "connect-proxy",
+        "ID": sid,
+        "Name": name,
+        "Port": port,
+        "Address": stanza.get("Address", body.get("Address", "")),
+        "Tags": stanza.get("Tags") or list(body.get("Tags") or []),
+        "Meta": stanza.get("Meta") or dict(body.get("Meta") or {}),
+        "Proxy": proxy,
+        "Checks": checks,
+    }
+    return sid, out

@@ -1,0 +1,276 @@
+"""ServiceManager layer: central-config merge + sidecar
+auto-registration + the blocking resolved-service agent endpoint.
+
+Reference behavior: agent/service_manager.go:19 (merge
+service-defaults/proxy-defaults into registrations),
+agent/sidecar_service.go:12 (connect.sidecar_service expansion with
+port allocation), agent/agent_endpoint.go AgentService
+(GET /v1/agent/service/:id with ContentHash blocking),
+agent/cache-types/resolved_service_config.go.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu import servicemgr
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=16, rumor_slots=8, p_loss=0.0, seed=4))
+    a.start(tick_seconds=0.0, reconcile_interval=0.1)
+    yield a
+    a.stop()
+
+
+def _call(agent, method, path, body=None):
+    base = agent.http_address
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(base + path, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        raw = resp.read()
+        return json.loads(raw) if raw and raw != b"null" else None
+
+
+def test_sidecar_service_expansion_with_port_allocation(agent):
+    """Registering a service with an EMPTY sidecar_service stanza
+    produces a fully-defaulted connect-proxy on an allocated port."""
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "web", "Port": 8080,
+        "Connect": {"SidecarService": {}}})
+    svcs = agent.store.node_services(agent.node_name)
+    sc = next(s for s in svcs if s["id"] == "web-sidecar-proxy")
+    assert sc["kind"] == "connect-proxy"
+    assert sc["name"] == "web-sidecar-proxy"
+    assert servicemgr.SIDECAR_MIN_PORT <= sc["port"] \
+        <= servicemgr.SIDECAR_MAX_PORT
+    assert sc["proxy"]["destination_service"] == "web"
+    assert sc["proxy"]["local_service_port"] == 8080
+    # the two default checks exist (TCP listening + alias)
+    checks = {c["check_id"] for c in
+              agent.store.node_checks(agent.node_name)}
+    assert "sidecar-listening:web-sidecar-proxy" in checks
+    assert "sidecar-alias:web-sidecar-proxy" in checks
+    # re-registration keeps the SAME port (no listener drift)
+    port0 = sc["port"]
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "web", "Port": 8080,
+        "Connect": {"SidecarService": {}}})
+    sc2 = next(s for s in agent.store.node_services(agent.node_name)
+               if s["id"] == "web-sidecar-proxy")
+    assert sc2["port"] == port0
+    # second service allocates the NEXT port
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "api", "Port": 8081,
+        "Connect": {"SidecarService": {}}})
+    sc3 = next(s for s in agent.store.node_services(agent.node_name)
+               if s["id"] == "api-sidecar-proxy")
+    assert sc3["port"] != port0
+
+
+def test_agent_service_endpoint_serves_resolved_config(agent):
+    """GET /v1/agent/service/:id returns the sidecar's proxy config
+    MERGED with proxy-defaults/service-defaults (the view `connect
+    envoy` bootstraps from)."""
+    _call(agent, "PUT", "/v1/config", {
+        "Kind": "proxy-defaults", "Name": "global",
+        "Config": {"protocol": "http",
+                   "envoy_prometheus_bind_addr": "0.0.0.0:9102"}})
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "billing", "Port": 9000,
+        "Connect": {"SidecarService": {}}})
+    got = _call(agent, "GET",
+                "/v1/agent/service/billing-sidecar-proxy")
+    assert got["Kind"] == "connect-proxy"
+    assert got["Service"] == "billing-sidecar-proxy"
+    assert got["ContentHash"]
+    # central defaults merged under the (empty) registration config
+    assert got["Proxy"]["Config"]["protocol"] == "http"
+    assert got["Proxy"]["Config"]["envoy_prometheus_bind_addr"] == \
+        "0.0.0.0:9102"
+    assert got["Proxy"]["DestinationServiceName"] == "billing"
+    assert got["Proxy"]["LocalServicePort"] == 9000
+    # service-defaults overrides proxy-defaults for ITS service
+    _call(agent, "PUT", "/v1/config", {
+        "Kind": "service-defaults", "Name": "billing",
+        "Protocol": "grpc"})
+    got2 = _call(agent, "GET",
+                 "/v1/agent/service/billing-sidecar-proxy")
+    assert got2["Proxy"]["Config"]["protocol"] == "grpc"
+    assert got2["ContentHash"] != got["ContentHash"]
+    # ?cached rides the resolved_service_config cache type
+    req = urllib.request.Request(
+        agent.http_address
+        + "/v1/agent/service/billing-sidecar-proxy?cached",
+        headers={"Cache-Control": "max-age=30"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        got3 = json.loads(resp.read())
+    assert got3["Proxy"]["Config"]["protocol"] == "grpc"
+
+
+def test_agent_service_hash_blocking_wakes_on_change(agent):
+    """?hash= parks until the rendered definition changes."""
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "stock", "Port": 9100,
+        "Connect": {"SidecarService": {}}})
+    got = _call(agent, "GET", "/v1/agent/service/stock-sidecar-proxy")
+    h = got["ContentHash"]
+    out = {}
+
+    def block():
+        out["r"] = _call(
+            agent, "GET",
+            f"/v1/agent/service/stock-sidecar-proxy?hash={h}&wait=10s")
+
+    t = threading.Thread(target=block)
+    t.start()
+    time.sleep(0.3)
+    assert t.is_alive()            # parked on the unchanged hash
+    # http2 is distinct from any protocol earlier tests may have set
+    # globally — the rendered definition MUST change, or the park
+    # correctly holds to its deadline
+    _call(agent, "PUT", "/v1/config", {
+        "Kind": "service-defaults", "Name": "stock",
+        "Protocol": "http2"})
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert out["r"]["ContentHash"] != h
+    assert out["r"]["Proxy"]["Config"]["protocol"] == "http2"
+
+
+def test_sidecar_deregisters_with_parent(agent):
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "tmp", "Port": 9200,
+        "Connect": {"SidecarService": {}}})
+    assert any(s["id"] == "tmp-sidecar-proxy"
+               for s in agent.store.node_services(agent.node_name))
+    _call(agent, "PUT", "/v1/agent/service/deregister/tmp")
+    ids = {s["id"] for s in agent.store.node_services(agent.node_name)}
+    assert "tmp" not in ids
+    assert "tmp-sidecar-proxy" not in ids
+
+
+def test_sidecar_stanza_overrides(agent):
+    """Explicit stanza fields (port, upstreams, checks) win over the
+    defaults (sidecar_service.go override handling)."""
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "pay", "Port": 9300,
+        "Connect": {"SidecarService": {
+            "Port": 21250,
+            "Proxy": {"Upstreams": [
+                {"DestinationName": "billing",
+                 "LocalBindPort": 10101}]},
+            "Checks": [{"Name": "custom", "CheckID": "pay-custom",
+                        "TTL": "60s"}]}}})
+    sc = next(s for s in agent.store.node_services(agent.node_name)
+              if s["id"] == "pay-sidecar-proxy")
+    assert sc["port"] == 21250
+    ups = sc["proxy"]["upstreams"]
+    assert ups and ups[0]["destination_name"] == "billing" \
+        and ups[0]["local_bind_port"] == 10101
+    checks = {c["check_id"] for c in
+              agent.store.node_checks(agent.node_name)}
+    assert "pay-custom" in checks
+    assert "sidecar-listening:pay-sidecar-proxy" not in checks
+
+
+def test_resolve_service_config_upstream_protocols(agent):
+    """resolve_service_config carries per-upstream protocols +
+    upstream_config overrides (ResolveServiceConfig upstream legs)."""
+    st = agent.store
+    st.config_entry_set("service-defaults", "db", {"protocol": "tcp"})
+    st.config_entry_set("service-defaults", "webapp", {
+        "protocol": "http",
+        "upstream_config": {
+            "defaults": {"connect_timeout_ms": 5000},
+            "overrides": [{"name": "db",
+                           "passive_health_check": {
+                               "interval": "10s"}}]}})
+    out = servicemgr.resolve_service_config(st, "webapp",
+                                            ("db", "billing"))
+    assert out["ProxyConfig"]["protocol"] == "http"
+    assert out["UpstreamConfigs"]["db"]["Protocol"] == "tcp"
+    assert out["UpstreamConfigs"]["db"]["ConnectTimeoutMs"] == 5000
+    assert out["UpstreamConfigs"]["db"]["PassiveHealthCheck"] == {
+        "interval": "10s"}
+    # billing has service-defaults grpc from the earlier test; its
+    # protocol must reflect that, plus the defaults block
+    assert out["UpstreamConfigs"]["billing"]["ConnectTimeoutMs"] == 5000
+
+
+def test_auto_registered_sidecars_serve_traffic():
+    """The full VERDICT-criterion loop: register two services with
+    empty sidecar_service stanzas + upstream; start the built-in data
+    plane on the AUTO-registered proxies; bytes flow over mTLS."""
+    import socket
+
+    from consul_tpu.connect.proxy import SidecarProxy
+    from tests.test_connect_proxy import EchoServer
+
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=6))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    echo = EchoServer()
+    try:
+        _call(a, "PUT", "/v1/agent/service/register", {
+            "Name": "db", "Port": echo.port,
+            "Connect": {"SidecarService": {}}})
+        _call(a, "PUT", "/v1/agent/service/register", {
+            "Name": "web", "Port": 0,
+            "Connect": {"SidecarService": {
+                "Proxy": {"Upstreams": [
+                    {"DestinationName": "db",
+                     "LocalBindPort": 0}]}}}})
+        db_proxy = SidecarProxy(a, "db-sidecar-proxy")
+        web_proxy = SidecarProxy(a, "web-sidecar-proxy")
+        db_proxy.start()
+        web_proxy.start()
+        try:
+            # the default sidecar-listening TCP check first ran before
+            # the proxy was up; wait for its 10s re-check to mark the
+            # db sidecar passing (the real `connect proxy` bootstrap
+            # sequence: register -> start -> health catches up)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                checks = {c["check_id"]: c["status"] for c in
+                          a.store.node_checks(a.node_name)}
+                if checks.get(
+                        "sidecar-listening:db-sidecar-proxy") == \
+                        "passing":
+                    break
+                time.sleep(0.25)
+            else:
+                raise AssertionError(
+                    "db sidecar listening check never passed")
+            # the web snapshot rebuild trails the check flip by a
+            # moment (event-driven, ~sub-second); dial with retry like
+            # any mesh client riding eventual consistency
+            up_port = web_proxy.upstreams[0].port
+            got = b""
+            deadline = time.time() + 15
+            while time.time() < deadline:
+                try:
+                    with socket.create_connection(
+                            ("127.0.0.1", up_port), timeout=5) as s:
+                        s.sendall(b"ping")
+                        s.settimeout(5)
+                        got = s.recv(4096)
+                        if got:
+                            break
+                except OSError:
+                    pass
+                time.sleep(0.5)
+            assert got == b"echo:ping"
+        finally:
+            web_proxy.stop()
+            db_proxy.stop()
+    finally:
+        echo.close()
+        a.stop()

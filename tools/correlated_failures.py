@@ -32,7 +32,7 @@ def main():
     ap.add_argument("--nodes", type=int, default=1_000_000)
     ap.add_argument("--fractions", type=float, nargs="+",
                     default=[0.001, 0.01])
-    ap.add_argument("--rumor-slots", type=int, default=32)
+    ap.add_argument("--rumor-slots", type=int, nargs="+", default=[32])
     ap.add_argument("--max-ticks", type=int, default=4096)
     ap.add_argument("--chunk", type=int, default=256,
                     help="ticks per device scan between host checks")
@@ -46,75 +46,151 @@ def main():
 
     from consul_tpu import GossipConfig, SimConfig, swim
 
-    params = swim.make_params(
-        GossipConfig.lan(),
-        SimConfig(n_nodes=args.nodes, rumor_slots=args.rumor_slots,
-                  p_loss=0.01, seed=args.seed))
-    tick_s = GossipConfig.lan().gossip_interval
-
-    @jax.jit
-    def warm(s):
-        return swim.run(params, s, 25)[0]
-
-    def run_chunk(s, n, mask):
-        def body(st, _):
-            st = swim.step(params, st)
-            rec, fp = swim.mass_detection_stats(params, st, mask)
-            return st, (rec, fp)
-        return jax.lax.scan(body, s, None, length=n)
-
-    run_chunk = jax.jit(run_chunk, static_argnums=(1,))
-
+    gossip = GossipConfig.lan()
+    tick_s = gossip.gossip_interval
     results = []
-    for frac in args.fractions:
-        k = max(1, int(args.nodes * frac))
-        s = swim.init_state(params)
-        s = warm(s)
-        rng = np.random.default_rng(args.seed)
-        victims = rng.choice(args.nodes, size=k, replace=False)
-        mask = np.zeros((args.nodes,), bool)
-        mask[victims] = True
-        mask_d = jnp.asarray(mask)
-        s = swim.kill_mask(s, mask_d)
+    for slots in args.rumor_slots:
+        params = swim.make_params(
+            gossip,
+            SimConfig(n_nodes=args.nodes, rumor_slots=slots,
+                      p_loss=0.01, seed=args.seed))
 
-        t0 = time.time()
-        ticks = 0
-        rec_curve, fp_curve = [], []
-        conv_tick = None
-        while ticks < args.max_ticks:
-            s, (rec, fp) = run_chunk(s, args.chunk, mask_d)
-            rec = np.asarray(rec)
-            fp = np.asarray(fp)
-            rec_curve.extend(rec.tolist())
-            fp_curve.extend(fp.tolist())
-            ticks += args.chunk
-            if conv_tick is None and (rec >= 0.99).any():
-                conv_tick = ticks - args.chunk + int(
-                    np.argmax(rec >= 0.99)) + 1
-            if rec[-1] >= 0.999:
-                break
-        wall = time.time() - t0
-        final_rec = rec_curve[-1]
-        max_fp = max(fp_curve)
-        row = {
-            "nodes": args.nodes, "killed": k, "fraction": frac,
-            "rumor_slots": args.rumor_slots,
-            "recall_final": float(final_rec),
-            "conv_ticks_99": conv_tick,
-            "conv_seconds_99": (conv_tick * tick_s
-                                if conv_tick else None),
-            "false_positives_max": int(max_fp),
-            "ticks_run": ticks, "wall_seconds": round(wall, 2),
-        }
-        results.append(row)
-        print(json.dumps({
-            "metric": "correlated_failure_recall99_s",
-            "value": row["conv_seconds_99"], "unit": "s",
-            "detail": row}), flush=True)
+        @jax.jit
+        def warm(s):
+            return swim.run(params, s, 25)[0]
 
+        def run_chunk(s, n, mask):
+            def body(st, _):
+                st = swim.step(params, st)
+                rec, fp = swim.mass_detection_stats(params, st, mask)
+                return st, (rec, fp)
+            return jax.lax.scan(body, s, None, length=n)
+
+        run_chunk = jax.jit(run_chunk, static_argnums=(1,))
+
+        for frac in args.fractions:
+            k = max(1, int(args.nodes * frac))
+            s = swim.init_state(params)
+            s = warm(s)
+            rng = np.random.default_rng(args.seed)
+            victims = rng.choice(args.nodes, size=k, replace=False)
+            mask = np.zeros((args.nodes,), bool)
+            mask[victims] = True
+            mask_d = jnp.asarray(mask)
+            s = swim.kill_mask(s, mask_d)
+
+            t0 = time.time()
+            ticks = 0
+            rec_curve, fp_curve = [], []
+            conv_tick = None
+            while ticks < args.max_ticks:
+                s, (rec, fp) = run_chunk(s, args.chunk, mask_d)
+                rec = np.asarray(rec)
+                fp = np.asarray(fp)
+                rec_curve.extend(rec.tolist())
+                fp_curve.extend(fp.tolist())
+                ticks += args.chunk
+                if conv_tick is None and (rec >= 0.99).any():
+                    conv_tick = ticks - args.chunk + int(
+                        np.argmax(rec >= 0.99)) + 1
+                if rec[-1] >= 0.999:
+                    break
+            wall = time.time() - t0
+            final_rec = rec_curve[-1]
+            max_fp = max(fp_curve)
+            row = {
+                "nodes": args.nodes, "killed": k, "fraction": frac,
+                "rumor_slots": slots,
+                "recall_final": float(final_rec),
+                "conv_ticks_99": conv_tick,
+                "conv_seconds_99": (conv_tick * tick_s
+                                    if conv_tick else None),
+                "false_positives_max": int(max_fp),
+                "ticks_run": ticks, "wall_seconds": round(wall, 2),
+            }
+            results.append(row)
+            print(json.dumps({
+                "metric": "correlated_failure_recall99_s",
+                "value": row["conv_seconds_99"], "unit": "s",
+                "detail": row}), flush=True)
+
+    import math as _math
+    g, cap = gossip.gossip_nodes, gossip.packet_msgs()
+    ln200 = _math.log(200.0)
+    n_log10 = _math.log10(args.nodes)
+    # memberlist's suspicion FLOOR: mult x log10(N) x probe_interval
+    # (the Lifeguard timer starts at suspicion_max_timeout_mult x this
+    # and decays to it with confirmations — a mass kill confirms every
+    # victim within a few probe rounds, so the floor plus the probe-
+    # cycle declare lag is the realized detection time)
+    detect_s = gossip.suspicion_mult * n_log10 * gossip.probe_interval \
+        + 2 * gossip.probe_timeout
+    ramp_s = _math.log2(args.nodes) * tick_s
+
+    def drain_s(v):
+        return v * ln200 / (g * cap) * tick_s
+
+    def pred(v):
+        # drain overlaps detection partially (the first U deaths ride
+        # the exact slot channel while dense timers still run): band
+        # from half-overlapped to fully-serial
+        lo = detect_s + 0.5 * drain_s(v)
+        hi = detect_s + drain_s(v) + ramp_s
+        return f"~{lo:.0f}-{hi:.0f}s"
+
+    derivation = {
+        "suspicion_s": (
+            "memberlist suspicion floor = suspicion_mult x log10(N) x "
+            f"probe_interval = {gossip.suspicion_mult} x {n_log10:.1f} "
+            f"x {gossip.probe_interval}s = "
+            f"{detect_s - 2 * gossip.probe_timeout:.0f}s at "
+            f"N={args.nodes} (options.mdx:1509-1532); the Lifeguard "
+            f"timer starts {gossip.suspicion_max_timeout_mult}x higher "
+            "and decays to the floor as confirmations arrive — a mass "
+            "kill confirms every victim within a few probe rounds, so "
+            f"realized detection ~= floor + probe-cycle lag = "
+            f"{detect_s:.0f}s (dense per-subject timers)"),
+        "dissemination_s": (
+            "v3: kills above the U-slot table drain through the BULK "
+            "channel at aggregate packet capacity — per gossip interval "
+            f"each node receives ~{g} packets of <= {cap} piggybacked "
+            "messages, so remaining unheard deaths decay as dR/dt = "
+            f"g*P*(1-R/V): T_99.5 ~= V*ln(200)/({g}*{cap}) intervals "
+            f"x {tick_s}s, plus a ~log2(N) epidemic ramp. No ceil(V/U) "
+            "wave structure remains (the r4 distortion this round "
+            "removed)"),
+        "predicted_1k_s": (
+            f"detect {detect_s:.0f} + drain {drain_s(1000):.0f} "
+            f"(half-to-fully serial) + ramp {ramp_s:.0f} => "
+            f"{pred(1000)}"),
+        "predicted_10k_s": (
+            f"detect {detect_s:.0f} + drain {drain_s(10000):.0f} "
+            f"(half-to-fully serial) + ramp {ramp_s:.0f} => "
+            f"{pred(10000)}; memberlist aggregate-capacity estimate "
+            "~2-4 min — within ~1.5x either way"),
+        "capacity_note": (
+            "the [N,U] exact table still carries the first U deaths "
+            "with per-subject refutation; only the overflow rides the "
+            "bulk channel (node-exact heard counts, mean-field "
+            "per-subject coverage). Slot count no longer shapes "
+            "convergence time, only which channel carries a rumor."),
+    }
     with open(args.out, "w") as f:
         json.dump({"results": results,
-                   "gossip_interval_s": tick_s}, f, indent=2)
+                   "gossip_interval_s": tick_s,
+                   "v3_fix": (
+                       "bulk death channel (swim.bulk_member/bulk_heard/"
+                       "bulk_cov + _bulk_disseminate/_bulk_commit): "
+                       "suspicion-expired subjects that cannot win a "
+                       "dead slot disseminate via per-node packet "
+                       "budgets — V >> U converges per memberlist "
+                       "packet-capacity math, not in ceil(V/U) waves"),
+                   "derivation": derivation,
+                   "previous_rounds": {
+                       "r3_1k_32slots_s": 902.2,
+                       "r4_1k_32slots_s": 126.4,
+                       "r4_1k_256slots_s": 78.4,
+                       "r4_10k_256slots_s": 680.4}}, f, indent=2)
     print(f"wrote {args.out}", flush=True)
 
 
