@@ -251,6 +251,104 @@ class PublicListener(_Listener):
                 pass
 
 
+def _read_http_head(conn: socket.socket, cap: int,
+                    on_bad=None) -> Optional[tuple]:
+    """Accumulate one HTTP request head off `conn` up to `cap` bytes.
+    Returns (head, body_start) or None after answering 431/closing —
+    shared by every plaintext-HTTP listener so framing limits cannot
+    diverge between them.  `on_bad` is called once when the cap trips
+    (stats hook)."""
+    buf = b""
+    while b"\r\n\r\n" not in buf:
+        if len(buf) > cap:
+            if on_bad is not None:
+                on_bad()
+            _http_respond(conn, 431, "Request Header Too Large")
+            conn.close()
+            return None
+        try:
+            chunk = conn.recv(4096)
+        except OSError:
+            conn.close()
+            return None
+        if not chunk:
+            conn.close()
+            return None
+        buf += chunk
+    head, _, body_start = buf.partition(b"\r\n\r\n")
+    return head, body_start
+
+
+def _http_respond(conn, code: int, reason: str) -> None:
+    body = f"{code} {reason}\n".encode()
+    try:
+        conn.sendall(
+            f"HTTP/1.1 {code} {reason}\r\n"
+            f"content-length: {len(body)}\r\n"
+            f"connection: close\r\n\r\n".encode() + body)
+    except OSError:
+        pass
+
+
+class ExposeListener(_Listener):
+    """Expose-path listener: PLAINTEXT HTTP on its own port, no mTLS,
+    no intention RBAC — the escape hatch that lets non-mesh callers
+    (HTTP health checks, metrics scrapers) reach specific paths of a
+    Connect-only app (Expose.Paths,
+    agent/structs/connect_proxy_config.go:198,551; the xDS shape is
+    the exposed_path_* listener in xds.listeners).
+
+    Only requests whose path EXACTLY matches an exposed path forward
+    to 127.0.0.1:local_path_port; everything else gets 404 before any
+    app byte."""
+
+    def __init__(self, paths: dict, host: str = "127.0.0.1",
+                 port: int = 0):
+        super().__init__(host, port)
+        # path -> local_path_port for THIS listener port
+        self.paths = dict(paths)
+        self.stats = {"allowed": 0, "denied": 0}
+
+    _HEAD_CAP = 64 * 1024
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10)
+            got = _read_http_head(conn, self._HEAD_CAP)
+            if got is None:
+                return
+            head, body = got
+            parsed = HttpUpstreamListener._parse_head(head)
+            if parsed is None:
+                _http_respond(conn, 400, "Bad Request")
+                conn.close()
+                return
+            _method, path, _qs, _headers, _query, _proto = parsed
+            lpp = self.paths.get(path)
+            if lpp is None:
+                self.stats["denied"] += 1
+                _http_respond(conn, 404, "Not Found")
+                conn.close()
+                return
+            self.stats["allowed"] += 1
+            try:
+                app = socket.create_connection(("127.0.0.1", lpp),
+                                               timeout=10)
+            except OSError:
+                _http_respond(conn, 502, "Bad Gateway")
+                conn.close()
+                return
+            app.sendall(head + b"\r\n\r\n" + body)
+            _pipe(conn, app)
+            conn.close()
+            app.close()
+        except Exception:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
 class UpstreamListener(_Listener):
     """Outbound side (proxy upstream listener): local plaintext in,
     mTLS to the target's public listener out, server identity pinned
@@ -373,34 +471,20 @@ class HttpUpstreamListener(_Listener):
         except ValueError:
             return None
 
-    @staticmethod
-    def _respond(conn, code: int, reason: str) -> None:
-        body = f"{code} {reason}\n".encode()
-        try:
-            conn.sendall(
-                f"HTTP/1.1 {code} {reason}\r\n"
-                f"content-length: {len(body)}\r\n"
-                f"connection: close\r\n\r\n".encode() + body)
-        except OSError:
-            pass
+    _respond = staticmethod(_http_respond)
 
     def _serve(self, conn: socket.socket) -> None:
         from consul_tpu.connect import l7
         try:
             conn.settimeout(10)
-            buf = b""
-            while b"\r\n\r\n" not in buf:
-                if len(buf) > self._HEAD_CAP:
-                    self.stats["bad_request"] += 1
-                    self._respond(conn, 431, "Request Header Too Large")
-                    conn.close()
-                    return
-                chunk = conn.recv(_COPY_CHUNK)
-                if not chunk:
-                    conn.close()
-                    return
-                buf += chunk
-            head, _, body_start = buf.partition(b"\r\n\r\n")
+
+            def _on_bad():
+                self.stats["bad_request"] += 1
+
+            got = _read_http_head(conn, self._HEAD_CAP, on_bad=_on_bad)
+            if got is None:
+                return
+            head, body_start = got
             parsed = self._parse_head(head)
             if parsed is None:
                 self.stats["bad_request"] += 1
@@ -614,6 +698,20 @@ class SidecarProxy:
             app_addr=(host, snap.local_port or 0),
             host=host,
             port=snap.port or 0)
+        # expose paths: one plaintext listener per distinct
+        # listener_port, each serving the exact paths bound to it
+        self.exposed: List[ExposeListener] = []
+        by_port: dict = {}
+        for p in (getattr(snap, "expose", None) or {}).get("paths") \
+                or []:
+            path = p.get("path", "")
+            lport = p.get("listener_port", 0)
+            lpp = p.get("local_path_port", 0)
+            if path and lport and lpp:
+                by_port.setdefault(lport, {})[path] = lpp
+        for lport, paths in sorted(by_port.items()):
+            self.exposed.append(ExposeListener(paths, host=host,
+                                               port=lport))
         self.upstreams: List[_Listener] = []
         ca = manager.ca
         from consul_tpu import discoverychain as dchain
@@ -743,10 +841,14 @@ class SidecarProxy:
 
     def start(self) -> None:
         self.public.start()
+        for e in self.exposed:
+            e.start()
         for u in self.upstreams:
             u.start()
 
     def stop(self) -> None:
         self.public.stop()
+        for e in self.exposed:
+            e.stop()
         for u in self.upstreams:
             u.stop()

@@ -48,7 +48,9 @@ class ConfigSnapshot:
                  port: int = 0, bind_address: str = "",
                  local_port: int = 0,
                  chains: Optional[Dict[str, dict]] = None,
-                 chain_endpoints: Optional[Dict[str, List[dict]]] = None):
+                 chain_endpoints: Optional[Dict[str, List[dict]]] = None,
+                 expose: Optional[dict] = None, mode: str = "",
+                 transparent_proxy: Optional[dict] = None):
         self.proxy_id = proxy_id
         self.service = service
         self.upstreams = upstreams
@@ -75,6 +77,13 @@ class ConfigSnapshot:
         # WatchedUpstreamEndpoints)
         self.chains = chains or {}
         self.chain_endpoints = chain_endpoints or {}
+        # operational proxy surface, already merged with central
+        # defaults (structs.ConnectProxyConfig Expose / Mode /
+        # TransparentProxy — agent/structs/connect_proxy_config.go:198,
+        # config_entry.go:89)
+        self.expose = expose or {}
+        self.mode = mode
+        self.transparent_proxy = transparent_proxy or {}
 
 
 class ProxyState:
@@ -308,10 +317,16 @@ class ProxyState:
 
     def _rebuild_connect_proxy(self) -> None:
         from consul_tpu import discoverychain as dchain
+        from consul_tpu import servicemgr
         m = self.manager
-        proxy = self.svc.get("proxy") or {}
-        service = proxy.get("destination_service",
-                            self.svc.get("name", ""))
+        raw_proxy = self.svc.get("proxy") or {}
+        service = raw_proxy.get("destination_service",
+                                self.svc.get("name", ""))
+        # ServiceManager merge: central proxy-defaults/service-defaults
+        # land in every snapshot (mode, expose, transparent_proxy,
+        # config) with the registration winning — the ("config", None)
+        # watch already rebuilds on central-entry changes
+        proxy = servicemgr.merged_proxy(m.store, raw_proxy, service)
         upstreams = proxy.get("upstreams") or []
         endpoints = {up.get("destination_name", ""):
                      self._connect_endpoints(
@@ -352,7 +367,11 @@ class ProxyState:
                 port=self.svc.get("port", 0),
                 bind_address=self.svc.get("address", ""),
                 local_port=proxy.get("local_service_port", 0),
-                chains=chains, chain_endpoints=chain_eps)
+                chains=chains, chain_endpoints=chain_eps,
+                expose=proxy.get("expose") or {},
+                mode=proxy.get("mode", ""),
+                transparent_proxy=proxy.get("transparent_proxy")
+                or {})
             self._cond.notify_all()
         self._sync_health_subs()
 

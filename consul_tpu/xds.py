@@ -201,6 +201,26 @@ def _upstream_chain(snap, name: str) -> Optional[dict]:
     return chain
 
 
+def _upstream_filters(snap, name: str, td: str) -> List[dict]:
+    """Network filters for one upstream — shared by the explicit-bind
+    outbound listeners and the transparent-proxy filter chains so the
+    two can never diverge (listeners.go makeUpstreamListener)."""
+    chain = _upstream_chain(snap, name)
+    if chain is not None and chain.get("Protocol") in (
+            "http", "http2", "grpc"):
+        # L7 chain: HTTP connection manager + RDS route named for
+        # the upstream (listeners.go makeListener w/ chain)
+        return [_http_connection_manager(f"upstream.{name}", name)]
+    if chain is not None:
+        # tcp chain with a redirect/failover: tcp_proxy straight to
+        # the start resolver's target cluster
+        start = l7._resolve_to_resolver(chain, chain["StartNode"])
+        cname = chain_cluster_name(start["Target"], td) \
+            if start and start.get("Target") else name
+        return [_tcp_proxy(f"upstream.{name}", cname)]
+    return [_tcp_proxy(f"upstream.{name}", name)]
+
+
 def _chain_resolver_nodes(chain: dict) -> List[dict]:
     return [n for n in chain["Nodes"].values()
             if n.get("Type") == "resolver" and n.get("Target")]
@@ -222,6 +242,36 @@ def clusters(snap) -> List[dict]:
             {"address": "127.0.0.1",
              "port": getattr(snap, "local_port", 0) or 0}]),
     }]
+    # expose-path clusters: plaintext STATIC clusters to the app's
+    # exposed ports (one per distinct local_path_port)
+    seen_expose = set()
+    for p in (getattr(snap, "expose", None) or {}).get("paths") or []:
+        lpp = p.get("local_path_port", 0)
+        # same admission rule as the listener side — a half-specified
+        # entry must not emit an orphan cluster (or, worse, a listener
+        # routing to a cluster that was never emitted)
+        if not (p.get("path") and p.get("listener_port") and lpp) \
+                or lpp in seen_expose:
+            continue
+        seen_expose.add(lpp)
+        out.append({
+            "@type": T + "envoy.config.cluster.v3.Cluster",
+            "name": f"exposed_cluster_{lpp}",
+            "type": "STATIC",
+            "connect_timeout": _duration(5),
+            "load_assignment": _load_assignment(
+                f"exposed_cluster_{lpp}",
+                [{"address": "127.0.0.1", "port": lpp}]),
+        })
+    # transparent mode: the original-destination passthrough cluster
+    if getattr(snap, "mode", "") == "transparent":
+        out.append({
+            "@type": T + "envoy.config.cluster.v3.Cluster",
+            "name": "original-destination",
+            "type": "ORIGINAL_DST",
+            "lb_policy": "CLUSTER_PROVIDED",
+            "connect_timeout": _duration(5),
+        })
     emitted = set()     # two chains sharing a target must not emit a
     for up in snap.upstreams:  # duplicate name (envoy NACKs the push)
         name = up.get("destination_name", "")
@@ -403,24 +453,111 @@ def listeners(snap) -> List[dict]:
     }
     out = [public]
     td = _trust_domain(snap)
+    # expose paths: plaintext HTTP listeners that bypass mTLS + RBAC so
+    # non-mesh callers (HTTP health checks) can reach specific app
+    # paths (agent/structs/connect_proxy_config.go:198,551; consumed in
+    # agent/xds/listeners.go expose handling).  Paths sharing a
+    # listener_port fold into ONE listener (a second bind on the same
+    # port would be NACKed) — the same grouping the builtin proxy's
+    # ExposeListener does.
+    expose_by_port: Dict[int, dict] = {}
+    for p in (getattr(snap, "expose", None) or {}).get("paths") or []:
+        path = p.get("path", "")
+        lport = p.get("listener_port", 0)
+        lpp = p.get("local_path_port", 0)
+        if path and lport and lpp:
+            expose_by_port.setdefault(lport, {})[path] = lpp
+    for lport, paths in sorted(expose_by_port.items()):
+        slug = "_".join(p.strip("/").replace("/", "_")
+                        for p in sorted(paths))
+        hcm = {
+            "name": "envoy.filters.network.http_connection_manager",
+            "typed_config": {
+                "@type": T + "envoy.extensions.filters.network."
+                             "http_connection_manager.v3."
+                             "HttpConnectionManager",
+                "stat_prefix": f"exposed_path_{slug}",
+                "route_config": {
+                    "name": f"exposed_path_route_{slug}_{lport}",
+                    "virtual_hosts": [{
+                        "name": f"exposed_path_route_{slug}_{lport}",
+                        "domains": ["*"],
+                        "routes": [{
+                            "match": {"path": path},
+                            "route": {"cluster":
+                                      f"exposed_cluster_{lpp}"},
+                        } for path, lpp in sorted(paths.items())],
+                    }],
+                },
+                "http_filters": [{
+                    "name": "envoy.filters.http.router",
+                    "typed_config": {
+                        "@type": T + "envoy.extensions.filters.http."
+                                     "router.v3.Router"}}],
+            },
+        }
+        out.append({
+            "@type": T + "envoy.config.listener.v3.Listener",
+            "name": f"exposed_path_{slug}:{lport}",
+            "traffic_direction": "INBOUND",
+            "address": _address(
+                getattr(snap, "bind_address", "") or "0.0.0.0", lport),
+            "filter_chains": [{"filters": [hcm]}],
+        })
+    # transparent-proxy mode: one outbound listener captures all
+    # upstream traffic (iptables REDIRECT to outbound_listener_port in
+    # the reference; a host-level stand-in on this rig), original-dst
+    # restored by the listener filter, per-upstream filter chains
+    # matched on the upstream's known endpoint addresses, everything
+    # else passed through at the original destination
+    # (agent/structs/config_entry.go:89, config_entry_mesh.go:11)
+    if getattr(snap, "mode", "") == "transparent":
+        oport = (getattr(snap, "transparent_proxy", None) or {}).get(
+            "outbound_listener_port") or 15001
+        tchains = []
+        seen_matches = set()
+        for up in snap.upstreams:
+            name = up.get("destination_name", "")
+            filters = _upstream_filters(snap, name, td)
+            addrs = tuple(sorted({
+                e.get("address", "")
+                for e in getattr(snap, "upstream_endpoints",
+                                 {}).get(name, [])
+                if e.get("address")}))
+            # two chains with identical matching rules NACK the
+            # listener; colocated upstreams (same endpoint IPs, or
+            # both with no known addresses) are indistinguishable
+            # without per-service virtual IPs — first upstream wins,
+            # the rest ride passthrough at the original destination
+            if addrs in seen_matches:
+                continue
+            seen_matches.add(addrs)
+            if addrs:
+                tchains.append({
+                    "filter_chain_match": {"prefix_ranges": [
+                        {"address_prefix": a, "prefix_len": 32}
+                        for a in addrs]},
+                    "filters": filters})
+            else:
+                tchains.append({"filters": filters})
+        out.append({
+            "@type": T + "envoy.config.listener.v3.Listener",
+            "name": f"outbound_listener:127.0.0.1:{oport}",
+            "traffic_direction": "OUTBOUND",
+            "address": _address("127.0.0.1", oport),
+            "listener_filters": [
+                {"name": "envoy.filters.listener.original_dst"}],
+            "filter_chains": tchains,
+            # unmatched destinations pass through at their original
+            # address (Envoy picks the default chain when no
+            # filter_chain_match hits)
+            "default_filter_chain": {"filters": [
+                _tcp_proxy("upstream.passthrough",
+                           "original-destination")]},
+        })
     for up in snap.upstreams:
         name = up.get("destination_name", "")
-        chain = _upstream_chain(snap, name)
-        if chain is not None and chain.get("Protocol") in (
-                "http", "http2", "grpc"):
-            # L7 chain: HTTP connection manager + RDS route named for
-            # the upstream (listeners.go makeListener w/ chain)
-            filters = [_http_connection_manager(
-                f"upstream.{name}", name)]
-        elif chain is not None:
-            # tcp chain with a redirect/failover: tcp_proxy straight to
-            # the start resolver's target cluster
-            start = l7._resolve_to_resolver(chain, chain["StartNode"])
-            cname = chain_cluster_name(start["Target"], td) \
-                if start and start.get("Target") else name
-            filters = [_tcp_proxy(f"upstream.{name}", cname)]
-        else:
-            filters = [_tcp_proxy(f"upstream.{name}", name)]
+        filters = _upstream_filters(snap, name, td)
         out.append({
             "@type": T + "envoy.config.listener.v3.Listener",
             "name": f"{name}:{up.get('local_bind_port', 0)}",

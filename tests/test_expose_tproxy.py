@@ -1,0 +1,258 @@
+"""Expose paths + transparent-proxy plumbing (VERDICT r4 next #3).
+
+Expose.Paths route non-mTLS callers through a dedicated plaintext
+listener to specific app paths
+(agent/structs/connect_proxy_config.go:198,551; agent/xds/listeners.go
+expose handling) — concretely, an HTTP health check against a
+Connect-only service can only pass through one.  TransparentProxy mode
+plumbs registration/central config through the snapshot into the
+outbound-listener xDS shape (agent/structs/config_entry.go:89,
+config_entry_mesh.go:11); its golden lives in test_xds_golden.py.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.connect.proxy import SidecarProxy
+
+
+class HealthApp:
+    """Tiny HTTP app with /health + /secret endpoints."""
+
+    def __init__(self):
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):              # noqa: N802
+                body = b"ok" if self.path.startswith("/health") \
+                    else b"secret-data"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def _call(agent, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(agent.http_address + path, data=data,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        raw = resp.read()
+        return json.loads(raw) if raw and raw != b"null" else None
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def rig():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=7))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    app = HealthApp()
+    expose_port = _free_port()
+    _call(a, "PUT", "/v1/agent/service/register", {
+        "Name": "api", "Port": app.port,
+        "Connect": {"SidecarService": {
+            "Proxy": {"Expose": {"Paths": [
+                {"Path": "/health", "LocalPathPort": app.port,
+                 "ListenerPort": expose_port,
+                 "Protocol": "http"}]}}}}})
+    proxy = SidecarProxy(a, "api-sidecar-proxy")
+    proxy.start()
+    yield a, app, proxy, expose_port
+    proxy.stop()
+    app.close()
+    a.stop()
+
+
+def test_exposed_path_reachable_without_mtls(rig):
+    a, app, proxy, expose_port = rig
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{expose_port}/health",
+            timeout=10) as r:
+        assert r.status == 200
+        assert r.read() == b"ok"
+    assert proxy.exposed and proxy.exposed[0].stats["allowed"] >= 1
+
+
+def test_non_exposed_path_gets_404(rig):
+    a, app, proxy, expose_port = rig
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{expose_port}/secret", timeout=10)
+    assert e.value.code == 404
+
+
+def test_public_listener_still_requires_mtls(rig):
+    """The expose escape hatch must not weaken the mesh port."""
+    a, app, proxy, expose_port = rig
+    with socket.create_connection(("127.0.0.1", proxy.public.port),
+                                  timeout=5) as s:
+        s.sendall(b"GET /health HTTP/1.1\r\n\r\n")
+        s.settimeout(5)
+        try:
+            got = s.recv(1024)
+        except OSError:
+            got = b""
+    assert b"ok" not in got
+
+
+def test_http_health_check_passes_via_exposed_path(rig):
+    """THE acceptance criterion: an HTTP check against a Connect-only
+    service passes only through the exposed path."""
+    a, app, proxy, expose_port = rig
+    _call(a, "PUT", "/v1/agent/check/register", {
+        "Name": "api-health", "CheckID": "api-health",
+        "HTTP": f"http://127.0.0.1:{expose_port}/health",
+        "Interval": "1s"})
+    deadline = time.time() + 15
+    status = None
+    while time.time() < deadline:
+        status = next((c["status"] for c in
+                       a.store.node_checks(a.node_name)
+                       if c["check_id"] == "api-health"), None)
+        if status == "passing":
+            break
+        time.sleep(0.5)
+    assert status == "passing"
+
+
+def test_expose_from_central_proxy_defaults():
+    """Expose set in proxy-defaults (not the registration) reaches the
+    snapshot through the ServiceManager merge."""
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=8))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        _call(a, "PUT", "/v1/config", {
+            "Kind": "proxy-defaults", "Name": "global",
+            "Expose": {"Paths": [
+                {"Path": "/ping", "LocalPathPort": 9001,
+                 "ListenerPort": 21700, "Protocol": "http"}]}})
+        _call(a, "PUT", "/v1/agent/service/register", {
+            "Name": "svc", "Port": 9001,
+            "Connect": {"SidecarService": {}}})
+        state = a.api.proxycfg.watch("svc-sidecar-proxy")
+        snap = state.fetch(0, timeout=5.0)
+        paths = (snap.expose or {}).get("paths") or []
+        assert paths and paths[0]["path"] == "/ping"
+        assert paths[0]["listener_port"] == 21700
+        # and the xDS view carries the exposed listener + cluster
+        from consul_tpu import xds
+        names = [ln["name"] for ln in xds.listeners(snap)]
+        assert "exposed_path_ping:21700" in names
+        cnames = [c["name"] for c in xds.clusters(snap)]
+        assert "exposed_cluster_9001" in cnames
+    finally:
+        a.stop()
+
+
+def test_tproxy_mode_from_central_config():
+    """Mode=transparent in proxy-defaults produces the outbound
+    listener + original-destination cluster in the xDS view."""
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=9))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        _call(a, "PUT", "/v1/config", {
+            "Kind": "proxy-defaults", "Name": "global",
+            "Mode": "transparent",
+            "TransparentProxy": {"OutboundListenerPort": 15001}})
+        _call(a, "PUT", "/v1/agent/service/register", {
+            "Name": "tp", "Port": 9002,
+            "Connect": {"SidecarService": {
+                "Proxy": {"Upstreams": [
+                    {"DestinationName": "db",
+                     "LocalBindPort": 9292}]}}}})
+        state = a.api.proxycfg.watch("tp-sidecar-proxy")
+        snap = state.fetch(0, timeout=5.0)
+        assert snap.mode == "transparent"
+        from consul_tpu import xds, xds_pb
+        lns = xds.listeners(snap)
+        ob = next(ln for ln in lns
+                  if ln["name"].startswith("outbound_listener:"))
+        assert ob["address"]["socket_address"]["port_value"] == 15001
+        assert ob["listener_filters"][0]["name"] == \
+            "envoy.filters.listener.original_dst"
+        assert "default_filter_chain" in ob
+        xds_pb.from_dict(ob)            # typed-decode clean
+        cn = [c["name"] for c in xds.clusters(snap)]
+        assert "original-destination" in cn
+    finally:
+        a.stop()
+
+
+def test_expose_paths_sharing_listener_port_fold_into_one_listener():
+    """Two paths on one listener_port must produce ONE xDS listener
+    with both routes (a second bind on the same port would NACK), and
+    half-specified entries are dropped on both the listener and
+    cluster sides."""
+    from consul_tpu import xds
+    from consul_tpu.proxycfg import ConfigSnapshot
+    from tests.test_xds_golden import FAKE_LEAF, FAKE_ROOTS
+    snap = ConfigSnapshot(
+        proxy_id="p", service="s", upstreams=[], roots=FAKE_ROOTS,
+        leaf=FAKE_LEAF, upstream_endpoints={}, intentions=[],
+        default_allow=True, version=1,
+        expose={"paths": [
+            {"path": "/health", "local_path_port": 8080,
+             "listener_port": 21500},
+            {"path": "/ready", "local_path_port": 8080,
+             "listener_port": 21500},
+            {"path": "/broken", "listener_port": 21501}]})  # no lpp
+    lns = [ln for ln in xds.listeners(snap)
+           if ln["name"].startswith("exposed_path_")]
+    assert len(lns) == 1
+    routes = lns[0]["filter_chains"][0]["filters"][0][
+        "typed_config"]["route_config"]["virtual_hosts"][0]["routes"]
+    assert {r["match"]["path"] for r in routes} == {"/health",
+                                                    "/ready"}
+    cns = [c["name"] for c in xds.clusters(snap)
+           if c["name"].startswith("exposed_cluster_")]
+    assert cns == ["exposed_cluster_8080"]
+
+
+def test_tproxy_colocated_upstreams_dedupe_filter_chains():
+    """Upstreams sharing an endpoint address set collapse to one
+    filter chain (identical matches would NACK the listener)."""
+    from consul_tpu import xds
+    from consul_tpu.proxycfg import ConfigSnapshot
+    from tests.test_xds_golden import FAKE_LEAF, FAKE_ROOTS
+    snap = ConfigSnapshot(
+        proxy_id="p", service="s",
+        upstreams=[{"destination_name": "db", "local_bind_port": 1},
+                   {"destination_name": "cache",
+                    "local_bind_port": 2}],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={
+            "db": [{"address": "10.0.0.5", "port": 1, "node": ""}],
+            "cache": [{"address": "10.0.0.5", "port": 2, "node": ""}]},
+        intentions=[], default_allow=True, version=1,
+        mode="transparent")
+    ob = next(ln for ln in xds.listeners(snap)
+              if ln["name"].startswith("outbound_listener:"))
+    assert len(ob["filter_chains"]) == 1

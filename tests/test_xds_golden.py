@@ -170,12 +170,37 @@ def _l7_chain_snapshot():
         })
 
 
+def _expose_tproxy_snapshot():
+    """Expose.Paths + TransparentProxy mode: plaintext exposed-path
+    listeners/clusters bypassing mTLS (connect_proxy_config.go:198,551)
+    and the tproxy outbound listener capturing upstream traffic with
+    original-dst passthrough (config_entry.go:89,
+    config_entry_mesh.go:11; agent/xds/listeners.go)."""
+    return ConfigSnapshot(
+        proxy_id="web-sidecar-proxy", service="web",
+        upstreams=[{"destination_name": "db", "local_bind_port": 9191,
+                    "local_bind_address": "127.0.0.1"}],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"db": [
+            {"address": "10.0.0.5", "port": 5432, "node": "n2"}]},
+        intentions=[], default_allow=True, version=9,
+        local_port=8080,
+        expose={"paths": [
+            {"path": "/health", "local_path_port": 8080,
+             "listener_port": 21500, "protocol": "http"},
+            {"path": "/metrics", "local_path_port": 9102,
+             "listener_port": 21501, "protocol": "http"}]},
+        mode="transparent",
+        transparent_proxy={"outbound_listener_port": 15001})
+
+
 CASES = {
     "sidecar": _sidecar_snapshot,
     "mesh_gateway": _mesh_gateway_snapshot,
     "terminating_gateway": _terminating_gateway_snapshot,
     "ingress_gateway": _ingress_gateway_snapshot,
     "l7_chain": _l7_chain_snapshot,
+    "expose_tproxy": _expose_tproxy_snapshot,
 }
 
 
