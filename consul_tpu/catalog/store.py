@@ -575,16 +575,42 @@ class StateStore:
     def connect_service_nodes(self, name: str) -> List[dict]:
         """Mesh-capable instances for `name`: sidecar proxies whose
         destination is `name` (Catalog.ServiceNodes with Connect=true —
-        agent/consul/state/catalog.go serviceNodesConnect)."""
+        agent/consul/state/catalog.go serviceNodesConnect).
+
+        Each row carries the APP instance it fronts under `app`
+        (id/tags/meta/port of the destination service on the same
+        node) — subset bexpr filters evaluate against the app row, as
+        the reference's CheckConnectServiceNodes filters actual
+        service instances and maps to their sidecars."""
         with self._lock:
+            # one linear pass builds the app index the rows resolve
+            # against: first non-proxy instance per (node, service
+            # name) — the fallback when a registration omits
+            # destination_service_id
+            first_app: Dict[Tuple[str, str], Tuple[str, dict]] = {}
+            for (node, sid), v in sorted(self._services.items()):
+                if not v.get("kind") and \
+                        (node, v["name"]) not in first_app:
+                    first_app[(node, v["name"])] = (sid, v)
             rows = []
             for (node, sid), v in sorted(self._services.items()):
                 if v.get("kind") != "connect-proxy":
                     continue
-                dest = (v.get("proxy") or {}).get(
-                    "destination_service", "")
+                proxy = v.get("proxy") or {}
+                dest = proxy.get("destination_service", "")
                 if dest != name:
                     continue
+                dest_id = proxy.get("destination_service_id", "")
+                app = self._services.get((node, dest_id)) \
+                    if dest_id else None
+                # a mis-set id (another sidecar, a different service)
+                # must not attach an unrelated record's metadata
+                if app is not None and (app.get("kind")
+                                        or app["name"] != dest):
+                    app = None
+                if app is None:
+                    dest_id, app = first_app.get((node, dest),
+                                                 ("", None))
                 nrec = self._nodes.get(node, {})
                 rows.append({"node": node,
                              "address": nrec.get("address", ""),
@@ -595,6 +621,12 @@ class StateStore:
                              "service_address": v["address"],
                              "kind": v.get("kind", ""),
                              "proxy": v.get("proxy", {}),
+                             "app": ({"id": dest_id,
+                                      "service_name": app["name"],
+                                      "tags": app.get("tags", []),
+                                      "meta": app.get("meta", {}),
+                                      "port": app.get("port", 0)}
+                                     if app is not None else None),
                              "modify_index": v["modify_index"]})
             return rows
 

@@ -700,16 +700,11 @@ class SidecarProxy:
             port=snap.port or 0)
         # expose paths: one plaintext listener per distinct
         # listener_port, each serving the exact paths bound to it
+        # (grouping/admission shared with the xDS view)
+        from consul_tpu.servicemgr import expose_paths_by_port
         self.exposed: List[ExposeListener] = []
-        by_port: dict = {}
-        for p in (getattr(snap, "expose", None) or {}).get("paths") \
-                or []:
-            path = p.get("path", "")
-            lport = p.get("listener_port", 0)
-            lpp = p.get("local_path_port", 0)
-            if path and lport and lpp:
-                by_port.setdefault(lport, {})[path] = lpp
-        for lport, paths in sorted(by_port.items()):
+        for lport, paths in sorted(expose_paths_by_port(
+                getattr(snap, "expose", None)).items()):
             self.exposed.append(ExposeListener(paths, host=host,
                                                port=lport))
         self.upstreams: List[_Listener] = []

@@ -234,12 +234,12 @@ class ProxyState:
         instances.
 
         A chain `target` carrying a Subset applies the subset's bexpr
-        filter + only_passing (ServiceResolverSubset).  Divergence
-        note: the filter evaluates against the CONNECT row (the
-        sidecar, falling back to the instance for proxy-less
-        services) — tag/meta the sidecar like its app to subset a
-        proxied service; the reference filters app instances and maps
-        to their sidecars."""
+        filter + only_passing (ServiceResolverSubset).  The filter
+        evaluates against the APP instance a sidecar fronts (the row's
+        attached `app` record; the instance itself for proxy-less
+        services) and the match maps to the sidecar's address — the
+        reference's CheckConnectServiceNodes semantics
+        (agent/consul/state/catalog.go)."""
         rows = self.manager.store.health_connect_nodes(name)
         native = not rows
         if native:
@@ -279,11 +279,21 @@ class ProxyState:
         out = []
         for r in rows:
             s = r["service"]
-            shaped = {"Service": {"Meta": s.get("meta", {}),
-                                  "Tags": s.get("tags", []),
-                                  "ID": s.get("service_id", ""),
-                                  "Service": s.get("service_name", ""),
-                                  "Port": s.get("port", 0)},
+            # sidecar rows filter against the APP instance they front
+            # (connect_service_nodes attaches it): the reference's
+            # CheckConnectServiceNodes evaluates actual service
+            # instances and maps to their sidecars — a deployment that
+            # tags apps but not sidecars must still subset correctly
+            app = s.get("app")
+            src = app if app is not None else s
+            shaped = {"Service": {"Meta": src.get("meta", {}),
+                                  "Tags": src.get("tags", []),
+                                  "ID": (src.get("id", "")
+                                         if app is not None else
+                                         s.get("service_id", "")),
+                                  "Service": src.get("service_name",
+                                                     ""),
+                                  "Port": src.get("port", 0)},
                       "Node": s.get("node", "")}
             try:
                 if flt(shaped):

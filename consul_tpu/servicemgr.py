@@ -120,6 +120,23 @@ def _snake_expose(expose: dict) -> dict:
     return copy.deepcopy(expose) if expose else {}
 
 
+def expose_paths_by_port(expose: Optional[dict]
+                         ) -> Dict[int, Dict[str, int]]:
+    """{listener_port: {path: local_path_port}} over the
+    fully-specified Expose.Paths entries — THE admission + grouping
+    rule, shared by xds.listeners, xds.clusters, and the builtin
+    ExposeListener so a half-specified entry (or two paths on one
+    port) can never make the three diverge."""
+    out: Dict[int, Dict[str, int]] = {}
+    for p in (expose or {}).get("paths") or []:
+        path = p.get("path", "")
+        lport = p.get("listener_port", 0)
+        lpp = p.get("local_path_port", 0)
+        if path and lport and lpp:
+            out.setdefault(lport, {})[path] = lpp
+    return out
+
+
 def allocate_sidecar_port(node_services: List[dict], sid: str = "",
                           min_port: int = SIDECAR_MIN_PORT,
                           max_port: int = SIDECAR_MAX_PORT) -> int:

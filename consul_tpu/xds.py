@@ -244,16 +244,12 @@ def clusters(snap) -> List[dict]:
     }]
     # expose-path clusters: plaintext STATIC clusters to the app's
     # exposed ports (one per distinct local_path_port)
-    seen_expose = set()
-    for p in (getattr(snap, "expose", None) or {}).get("paths") or []:
-        lpp = p.get("local_path_port", 0)
-        # same admission rule as the listener side — a half-specified
-        # entry must not emit an orphan cluster (or, worse, a listener
-        # routing to a cluster that was never emitted)
-        if not (p.get("path") and p.get("listener_port") and lpp) \
-                or lpp in seen_expose:
-            continue
-        seen_expose.add(lpp)
+    from consul_tpu.servicemgr import expose_paths_by_port
+    expose_lpps = sorted({
+        lpp for paths in expose_paths_by_port(
+            getattr(snap, "expose", None)).values()
+        for lpp in paths.values()})
+    for lpp in expose_lpps:
         out.append({
             "@type": T + "envoy.config.cluster.v3.Cluster",
             "name": f"exposed_cluster_{lpp}",
@@ -460,14 +456,9 @@ def listeners(snap) -> List[dict]:
     # listener_port fold into ONE listener (a second bind on the same
     # port would be NACKed) — the same grouping the builtin proxy's
     # ExposeListener does.
-    expose_by_port: Dict[int, dict] = {}
-    for p in (getattr(snap, "expose", None) or {}).get("paths") or []:
-        path = p.get("path", "")
-        lport = p.get("listener_port", 0)
-        lpp = p.get("local_path_port", 0)
-        if path and lport and lpp:
-            expose_by_port.setdefault(lport, {})[path] = lpp
-    for lport, paths in sorted(expose_by_port.items()):
+    from consul_tpu.servicemgr import expose_paths_by_port
+    for lport, paths in sorted(expose_paths_by_port(
+            getattr(snap, "expose", None)).items()):
         slug = "_".join(p.strip("/").replace("/", "_")
                         for p in sorted(paths))
         hcm = {
@@ -524,22 +515,22 @@ def listeners(snap) -> List[dict]:
                 for e in getattr(snap, "upstream_endpoints",
                                  {}).get(name, [])
                 if e.get("address")}))
-            # two chains with identical matching rules NACK the
-            # listener; colocated upstreams (same endpoint IPs, or
-            # both with no known addresses) are indistinguishable
-            # without per-service virtual IPs — first upstream wins,
-            # the rest ride passthrough at the original destination
-            if addrs in seen_matches:
+            # no known addresses -> no chain: a criteria-less filter
+            # chain would act as a catch-all and shadow the default
+            # passthrough, capturing ALL outbound traffic into this
+            # upstream's cluster at bootstrap; such traffic rides
+            # passthrough at the original destination until endpoints
+            # resolve.  Identical match sets NACK the listener;
+            # colocated upstreams are indistinguishable without
+            # per-service virtual IPs — first upstream wins.
+            if not addrs or addrs in seen_matches:
                 continue
             seen_matches.add(addrs)
-            if addrs:
-                tchains.append({
-                    "filter_chain_match": {"prefix_ranges": [
-                        {"address_prefix": a, "prefix_len": 32}
-                        for a in addrs]},
-                    "filters": filters})
-            else:
-                tchains.append({"filters": filters})
+            tchains.append({
+                "filter_chain_match": {"prefix_ranges": [
+                    {"address_prefix": a, "prefix_len": 32}
+                    for a in addrs]},
+                "filters": filters})
         out.append({
             "@type": T + "envoy.config.listener.v3.Listener",
             "name": f"outbound_listener:127.0.0.1:{oport}",
