@@ -86,6 +86,9 @@ class FastKVServer:
         self.server_address = self._sock.getsockname()
         self._running = False
         self._shutdown_done = threading.Event()
+        # pre-set: shutdown() must not block 5s when serve_forever was
+        # never started (only its finally would otherwise set this)
+        self._shutdown_done.set()
         # (key, modify_index, has_session) -> serialized GET payload;
         # benign races (GIL dict ops), cleared wholesale past 4096 rows
         self._row_cache: dict = {}
@@ -94,6 +97,7 @@ class FastKVServer:
 
     def serve_forever(self) -> None:
         self._running = True
+        self._shutdown_done.clear()
         try:
             while self._running:
                 try:
@@ -157,7 +161,7 @@ class FastKVServer:
                     conn.sendall(b"HTTP/1.1 400 Bad Request\r\n"
                                  b"Content-Length: 0\r\n\r\n")
                     return
-                clen = 0
+                clen = None
                 token = None
                 expect_100 = False
                 want_close = version == "HTTP/1.0"
@@ -165,10 +169,27 @@ class FastKVServer:
                     k, _, v = hline.partition(b":")
                     kl = k.lower()
                     if kl == b"content-length":
-                        try:
-                            clen = int(v.strip())
-                        except ValueError:
-                            clen = 0
+                        # strict digits only: int() also accepts
+                        # "+4"/"4_2", which a stricter front proxy
+                        # would frame differently (smuggling vector)
+                        sv = v.strip()
+                        this_len = int(sv) if sv.isdigit() else -1
+                        if this_len < 0 or (clen is not None
+                                            and clen != this_len):
+                            # malformed or conflicting duplicates:
+                            # framing could desync on keep-alive
+                            conn.sendall(
+                                b"HTTP/1.1 400 Bad Request\r\n"
+                                b"Content-Length: 0\r\n\r\n")
+                            return
+                        clen = this_len
+                    elif kl == b"transfer-encoding":
+                        # chunked bodies would be re-parsed as the next
+                        # request head; refuse rather than desync
+                        conn.sendall(
+                            b"HTTP/1.1 501 Not Implemented\r\n"
+                            b"Content-Length: 0\r\n\r\n")
+                        return
                     elif kl == b"x-consul-token":
                         token = v.strip().decode("latin-1")
                     elif kl == b"authorization":
@@ -183,6 +204,8 @@ class FastKVServer:
                             want_close = False
                     elif kl == b"expect":
                         expect_100 = b"100-continue" in v.strip().lower()
+                if clen is None:
+                    clen = 0
                 if clen > self._BODY_CAP:
                     # absurd Content-Length must not buffer before the
                     # per-route size checks can see it

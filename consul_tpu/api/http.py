@@ -2863,23 +2863,44 @@ def _make_handler(srv: ApiServer):
                                    f"{redirect!r}")
                     return True
                 state = str(_uuid.uuid4())
+                try:
+                    src_ip = self.client_address[0]
+                except (AttributeError, IndexError, TypeError):
+                    src_ip = ""
+                table_full = False
                 with srv._oidc_lock:
                     # single-use states with a 10-minute shelf life;
                     # capped — this endpoint is unauthenticated, so an
-                    # unbounded map is a trivial memory DoS (oldest
-                    # outstanding states evict first)
+                    # unbounded map is a trivial memory DoS.  One
+                    # source IP holds at most 64 live states and past
+                    # that evicts only its OWN oldest (a flooder can
+                    # never flush another source's in-flight login;
+                    # NAT'd users share a budget but keep the old
+                    # evict-within-budget behavior).  A full global
+                    # table answers 429 rather than evicting anyone.
                     now = time.time()
                     srv._oidc_states = {
                         k: v for k, v in srv._oidc_states.items()
                         if v["expires"] > now}
-                    while len(srv._oidc_states) >= 1024:
-                        srv._oidc_states.pop(
-                            next(iter(srv._oidc_states)))
-                    srv._oidc_states[state] = {
-                        "method": method["name"],
-                        "redirect_uri": redirect,
-                        "nonce": body.get("ClientNonce", ""),
-                        "expires": now + 600.0}
+                    mine = [k for k, v in srv._oidc_states.items()
+                            if v.get("src") == src_ip]
+                    if len(mine) >= 64:
+                        srv._oidc_states.pop(mine[0], None)
+                    elif len(srv._oidc_states) >= 1024:
+                        table_full = True
+                    if not table_full:
+                        srv._oidc_states[state] = {
+                            "method": method["name"],
+                            "redirect_uri": redirect,
+                            "nonce": body.get("ClientNonce", ""),
+                            "src": src_ip,
+                            "expires": now + 600.0}
+                if table_full:
+                    # socket I/O stays outside the lock: a stalled
+                    # client must not wedge every other login
+                    self._err(429, "too many outstanding OIDC "
+                                   "login states; retry later")
+                    return True
                 auth_ep = cfg.get("oidc_authorization_endpoint") or \
                     (cfg.get("oidc_discovery_url", "").rstrip("/")
                      + "/authorize")

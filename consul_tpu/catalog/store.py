@@ -584,14 +584,15 @@ class StateStore:
         service instances and maps to their sidecars."""
         with self._lock:
             # one linear pass builds the app index the rows resolve
-            # against: first non-proxy instance per (node, service
+            # against: ALL non-proxy instances per (node, service
             # name) — the fallback when a registration omits
             # destination_service_id
-            first_app: Dict[Tuple[str, str], Tuple[str, dict]] = {}
+            node_apps: Dict[Tuple[str, str],
+                            List[Tuple[str, dict]]] = {}
             for (node, sid), v in sorted(self._services.items()):
-                if not v.get("kind") and \
-                        (node, v["name"]) not in first_app:
-                    first_app[(node, v["name"])] = (sid, v)
+                if not v.get("kind"):
+                    node_apps.setdefault((node, v["name"]),
+                                         []).append((sid, v))
             rows = []
             for (node, sid), v in sorted(self._services.items()):
                 if v.get("kind") != "connect-proxy":
@@ -609,8 +610,22 @@ class StateStore:
                                         or app["name"] != dest):
                     app = None
                 if app is None:
-                    dest_id, app = first_app.get((node, dest),
-                                                 ("", None))
+                    candidates = node_apps.get((node, dest), [])
+                    # the auto-registration naming convention pairs
+                    # "<app-id>-sidecar-proxy" to its app even when
+                    # the id field was stripped
+                    by_name = [(aid, a) for aid, a in candidates
+                               if sid == f"{aid}-sidecar-proxy"]
+                    if by_name:
+                        dest_id, app = by_name[0]
+                    elif len(candidates) == 1:
+                        # unambiguous: the node's only instance
+                        dest_id, app = candidates[0]
+                    else:
+                        # several instances, none claimable: attaching
+                        # an arbitrary one would steer subset traffic
+                        # to the wrong sidecar — attach none
+                        dest_id, app = "", None
                 nrec = self._nodes.get(node, {})
                 rows.append({"node": node,
                              "address": nrec.get("address", ""),

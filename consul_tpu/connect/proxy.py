@@ -30,6 +30,7 @@ from cryptography import x509
 
 from consul_tpu.connect import intentions as imod
 from consul_tpu.utils.net import shutdown_and_close
+from consul_tpu.servicemgr import expose_paths_by_port
 
 _COPY_CHUNK = 65536
 
@@ -525,8 +526,14 @@ class HttpUpstreamListener(_Listener):
                 self.target_counts.get(target, 0) + 1
             full = out_path + ("?" + qs if qs else "")
             first, _, rest_head = head.decode("latin-1").partition("\r\n")
-            new_head = f"{method} {full} {proto}\r\n{rest_head}" \
-                .encode("latin-1")
+            # this relay is one-request-per-connection: force the
+            # upstream to close after responding, or a keep-alive
+            # upstream holds the relay open until the idle timeout
+            kept = [ln for ln in rest_head.split("\r\n") if ln
+                    and not ln.lower().startswith("connection:")]
+            kept.append("connection: close")
+            new_head = (f"{method} {full} {proto}\r\n"
+                        + "\r\n".join(kept)).encode("latin-1")
             try:
                 tls_conn.sendall(new_head + b"\r\n\r\n" + body_start)
             except OSError:
@@ -701,7 +708,6 @@ class SidecarProxy:
         # expose paths: one plaintext listener per distinct
         # listener_port, each serving the exact paths bound to it
         # (grouping/admission shared with the xDS view)
-        from consul_tpu.servicemgr import expose_paths_by_port
         self.exposed: List[ExposeListener] = []
         for lport, paths in sorted(expose_paths_by_port(
                 getattr(snap, "expose", None)).items()):

@@ -335,10 +335,10 @@ async function renderIntentions() {
     `<tr><td>${esc(i.SourceName)}</td><td>${esc(i.DestinationName)}</td>
      <td>${pill(i.Action)}</td>
      <td>${i.Precedence}</td>
-     <td><a onclick="intentionFlip('${esc(i.ID)}',
-            '${i.Action === "allow" ? "deny" : "allow"}')">
+     <td><a data-iop="flip" data-id="${esc(i.ID)}"
+            data-action="${i.Action === "allow" ? "deny" : "allow"}">
           flip</a> ·
-         <a onclick="intentionDelete('${esc(i.ID)}')">delete</a>
+         <a data-iop="delete" data-id="${esc(i.ID)}">delete</a>
      </td></tr>`).join("") + `</table>`};
 }
 async function intentionCreate() {
@@ -532,6 +532,15 @@ async function render() {
     // must never wipe in-progress input
     setTimeout(() => { if (gen === myGen) render(); }, 7000);
 }
+// delegated handler for row actions: IDs travel as data-* attributes
+// (read back via dataset, so no server value is ever parsed as JS)
+document.getElementById("main").addEventListener("click", (ev) => {
+  const a = ev.target.closest("a[data-iop]");
+  if (!a) return;
+  if (a.dataset.iop === "flip")
+    intentionFlip(a.dataset.id, a.dataset.action);
+  else if (a.dataset.iop === "delete") intentionDelete(a.dataset.id);
+});
 window.addEventListener("hashchange", render);
 render();
 </script>

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 from consul_tpu import discoverychain as dchain
 from consul_tpu.connect import intentions as imod
 from consul_tpu.connect import l7
+from consul_tpu.servicemgr import expose_paths_by_port
 
 T = "type.googleapis.com/"
 
@@ -244,7 +245,6 @@ def clusters(snap) -> List[dict]:
     }]
     # expose-path clusters: plaintext STATIC clusters to the app's
     # exposed ports (one per distinct local_path_port)
-    from consul_tpu.servicemgr import expose_paths_by_port
     expose_lpps = sorted({
         lpp for paths in expose_paths_by_port(
             getattr(snap, "expose", None)).values()
@@ -456,7 +456,6 @@ def listeners(snap) -> List[dict]:
     # listener_port fold into ONE listener (a second bind on the same
     # port would be NACKed) — the same grouping the builtin proxy's
     # ExposeListener does.
-    from consul_tpu.servicemgr import expose_paths_by_port
     for lport, paths in sorted(expose_paths_by_port(
             getattr(snap, "expose", None)).items()):
         slug = "_".join(p.strip("/").replace("/", "_")

@@ -417,3 +417,77 @@ def test_oidc_flow_offline_with_injected_fetcher():
         assert res["SecretID"]
     finally:
         a.stop()
+
+
+def test_oidc_auth_url_flood_cannot_flush_other_logins():
+    """The unauthenticated auth-url endpoint must not let one source
+    flush other users' in-flight login states: past 64 outstanding
+    states a source evicts only its OWN oldest, and a globally full
+    table answers 429 instead of evicting anyone."""
+    from consul_tpu.acl.authmethod import pem_to_jwk
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=78))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+        _, pub = _rsa_pair()
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode()
+                if body is not None else None, method=method)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read()
+                or b"null")
+
+        def mint():
+            out = call("PUT", "/v1/acl/oidc/auth-url", {
+                "AuthMethod": "sso",
+                "RedirectURI": "http://localhost/cb"})
+            return urllib.parse.parse_qs(urllib.parse.urlparse(
+                out["AuthURL"]).query)["state"][0]
+
+        def callback_code(state):
+            try:
+                call("PUT", "/v1/acl/oidc/callback",
+                     {"State": state, "Code": "c0"})
+            except urllib.error.HTTPError as e:
+                return e.code   # 503 = state recognized (egress
+                #                 blocked); 403 = unknown state
+            return 200
+
+        call("PUT", "/v1/acl/auth-method", {
+            "Name": "sso", "Type": "oidc", "Config": {
+                "OIDCDiscoveryURL": "https://idp.example",
+                "OIDCClientID": "consul-ui",
+                "AllowedRedirectURIs": ["http://localhost/cb"],
+                "JWKSDocument": {"keys": [pem_to_jwk(pub, "k1")]}}})
+        # another "user" (different source) with a login in flight:
+        # the flood below must never evict it
+        other = str(__import__("uuid").uuid4())
+        with a.api._oidc_lock:
+            a.api._oidc_states[other] = {
+                "method": "sso", "redirect_uri": "http://localhost/cb",
+                "nonce": "", "src": "10.9.9.9",
+                "expires": time.time() + 600.0}
+        states = [mint() for _ in range(64)]
+        # 65th from the same source self-evicts: succeeds, and only
+        # this source's OLDEST state dies
+        extra = mint()
+        assert callback_code(states[0]) == 403      # own oldest gone
+        assert callback_code(states[1]) == 503      # own 2nd alive
+        assert callback_code(extra) == 503          # new one alive
+        assert callback_code(other) == 503          # other user alive
+        # globally full table: 429, nobody evicted
+        with a.api._oidc_lock:
+            now = time.time()
+            for i in range(1100):
+                a.api._oidc_states[f"fake-{i}"] = {
+                    "method": "sso", "redirect_uri": "x", "nonce": "",
+                    "src": f"10.0.{i % 250}.{i // 250}",
+                    "expires": now + 600.0}
+        with pytest.raises(urllib.error.HTTPError) as e:
+            mint()
+        assert e.value.code == 429
+    finally:
+        a.stop()

@@ -320,3 +320,77 @@ def test_kv_value_size_limit(client, agent):
         client.txn(ops)
     assert e.value.code == 413
     client.kv_delete("big/", recurse=True)
+
+
+def test_fastfront_rejects_chunked_transfer_encoding(agent):
+    """A chunked body would desync the hand-rolled framing on
+    keep-alive; the fast front refuses it outright (501) instead of
+    re-parsing body bytes as the next request head."""
+    import socket
+    import urllib.parse as _up
+    u = _up.urlparse(agent.http_address)
+    host, port = u.hostname, u.port
+    s = socket.create_connection((host, port), timeout=5)
+    try:
+        s.sendall(b"PUT /v1/kv/chunky HTTP/1.1\r\n"
+                  b"Host: x\r\n"
+                  b"Transfer-Encoding: chunked\r\n\r\n"
+                  b"5\r\nhello\r\n0\r\n\r\n")
+        resp = s.recv(65536)
+        assert resp.startswith(b"HTTP/1.1 501")
+    finally:
+        s.close()
+
+
+def test_fastfront_rejects_conflicting_content_length(agent):
+    """Duplicate Content-Length headers that disagree are a request-
+    smuggling primitive; the fast front answers 400 before dispatch."""
+    import socket
+    import urllib.parse as _up
+    u = _up.urlparse(agent.http_address)
+    host, port = u.hostname, u.port
+    s = socket.create_connection((host, port), timeout=5)
+    try:
+        s.sendall(b"PUT /v1/kv/duplen HTTP/1.1\r\n"
+                  b"Host: x\r\n"
+                  b"Content-Length: 4\r\n"
+                  b"Content-Length: 2\r\n\r\n"
+                  b"abcd")
+        resp = s.recv(65536)
+        assert resp.startswith(b"HTTP/1.1 400")
+    finally:
+        s.close()
+
+
+def test_fastfront_duplicate_equal_content_length_ok(agent, client):
+    """Agreeing duplicates are harmless and must keep working."""
+    import socket
+    import urllib.parse as _up
+    u = _up.urlparse(agent.http_address)
+    host, port = u.hostname, u.port
+    s = socket.create_connection((host, port), timeout=5)
+    try:
+        s.sendall(b"PUT /v1/kv/duplen2 HTTP/1.1\r\n"
+                  b"Host: x\r\n"
+                  b"Content-Length: 4\r\n"
+                  b"Content-Length: 4\r\n\r\n"
+                  b"abcd")
+        resp = s.recv(65536)
+        assert resp.startswith(b"HTTP/1.1 200")
+    finally:
+        s.close()
+    row, _ = client.kv_get("duplen2")
+    assert row["Value"] == b"abcd"
+
+
+def test_fastfront_shutdown_without_serve(agent):
+    """shutdown() on a server whose accept loop never ran returns
+    immediately (the done event is pre-set), instead of waiting the
+    full 5 s grace."""
+    import time as _t
+    from consul_tpu.api.fastfront import FastKVServer
+    srv = FastKVServer(("127.0.0.1", 0), object, None)
+    t0 = _t.perf_counter()
+    srv.shutdown()
+    assert _t.perf_counter() - t0 < 1.0
+    srv.server_close()

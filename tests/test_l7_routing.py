@@ -33,6 +33,7 @@ class HttpEcho:
 
     def __init__(self, name: str):
         self.name = name
+        self.last_head = b""
         self.sock = socket.socket()
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind(("127.0.0.1", 0))
@@ -58,6 +59,7 @@ class HttpEcho:
                 if not chunk:
                     return
                 buf += chunk
+            self.last_head = buf.split(b"\r\n\r\n", 1)[0]
             line = buf.split(b"\r\n", 1)[0].decode("latin-1")
             _, path, _ = line.split(" ", 2)
             body = json.dumps({"who": self.name, "path": path}).encode()
@@ -243,6 +245,39 @@ def test_xds_rds_serves_the_same_table(mesh):
     assert weights == [1000, 9000]
 
 
+
+def test_relay_forces_connection_close_toward_upstream(mesh):
+    """The one-request-per-connection relay must not let a keep-alive
+    client header ride through: the upstream sees connection: close,
+    so it releases the relay instead of parking it until the idle
+    timeout."""
+    a, web_proxy, stable, canary = mesh
+    port = web_proxy.upstreams[0].port
+    # raw socket: urllib force-rewrites Connection to close, which
+    # would make this test pass with no rewrite in the relay at all
+    for _ in range(20):   # enough rolls to land on each leg
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(b"GET / HTTP/1.1\r\nHost: api\r\n"
+                      b"Connection: keep-alive\r\n\r\n")
+            buf = b""
+            while b"}" not in buf:      # echo body is one JSON object
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            assert b"200" in buf.split(b"\r\n", 1)[0], buf[:80]
+        finally:
+            s.close()
+    for echo in (stable, canary):
+        if not echo.last_head:
+            continue
+        hdrs = [ln.lower() for ln in
+                echo.last_head.decode("latin-1").split("\r\n")[1:]]
+        conns = [h for h in hdrs if h.startswith("connection:")]
+        assert conns == ["connection: close"], conns
+
+
 def test_http_failover_when_primary_leg_empties(mesh):
     """A resolver failover leg carries traffic when the primary
     target's endpoints vanish — the Python data plane honoring the
@@ -385,3 +420,4 @@ def test_ring_hash_sticky_endpoint_selection(mesh):
             _del(f"/v1/agent/service/deregister/{sid}")
         _del("/v1/config/service-resolver/api")
         _del("/v1/config/service-defaults/api")
+

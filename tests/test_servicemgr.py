@@ -274,3 +274,48 @@ def test_auto_registered_sidecars_serve_traffic():
     finally:
         echo.close()
         a.stop()
+
+
+def test_app_check_failure_propagates_through_alias_to_connect(agent):
+    """A critical check on the APP instance must take its sidecar out
+    of the connect endpoint set.  The state-level join carries only
+    the sidecar's own checks (the reference's parseCheckServiceNodes
+    does the same); exclusion flows through the auto-registered alias
+    check (agent/sidecar_service.go default checks), so the sidecar
+    goes critical when its app does."""
+    _call(agent, "PUT", "/v1/agent/service/register", {
+        "Name": "pay", "ID": "pay-1", "Port": 8181,
+        "Check": {"CheckID": "pay-ttl", "TTL": "60s"},
+        "Connect": {"SidecarService": {}}})
+    # TTL starts passing
+    _call(agent, "PUT", "/v1/agent/check/pass/pay-ttl")
+
+    def alias_status():
+        rows = _call(agent, "GET", "/v1/health/connect/pay") or []
+        for r in rows:
+            if r["Service"]["ID"] != "pay-1-sidecar-proxy":
+                continue
+            for c in r["Checks"]:
+                if c["CheckID"] == \
+                        "sidecar-alias:pay-1-sidecar-proxy":
+                    return c["Status"]
+        return None
+
+    # precondition: the alias check tracked the app's PASSING TTL —
+    # without this, the later critical assertion could pass because
+    # the alias was critical from the start
+    deadline = time.time() + 15
+    while time.time() < deadline and alias_status() != "passing":
+        time.sleep(0.2)
+    assert alias_status() == "passing"
+    # fail the APP's check; the sidecar's alias check must follow
+    _call(agent, "PUT", "/v1/agent/check/fail/pay-ttl")
+    deadline = time.time() + 15
+    while time.time() < deadline and alias_status() != "critical":
+        time.sleep(0.2)
+    assert alias_status() == "critical"
+    # and ?passing excludes the sidecar entirely
+    rows = _call(agent, "GET", "/v1/health/connect/pay?passing") or []
+    assert all(r["Service"]["ID"] != "pay-1-sidecar-proxy"
+               for r in rows)
+    _call(agent, "PUT", "/v1/agent/check/pass/pay-ttl")

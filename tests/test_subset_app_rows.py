@@ -145,3 +145,58 @@ def test_subset_steering_through_eds_and_data_plane():
         v1.close()
         v2.close()
         a.stop()
+
+
+def test_same_node_multi_instance_pairing_without_dest_id():
+    """One node hosts TWO instances of the same service, each fronted
+    by a sidecar registered WITHOUT destination_service_id.  The
+    "<app-id>-sidecar-proxy" naming convention pairs each sidecar to
+    its own app; a sidecar that matches neither convention nor a
+    unique instance attaches no app record at all (misattaching the
+    alphabetically-first app would steer v1-subset traffic to the
+    sidecar fronting the v2 app)."""
+    from consul_tpu.proxycfg import ProxyState
+    st = StateStore()
+    st.register_node("n1", "10.0.0.1")
+    st.register_service("n1", "api-1", "api", port=81,
+                        meta={"version": "v1"})
+    st.register_service("n1", "api-2", "api", port=82,
+                        meta={"version": "v2"})
+    for app_id, pport in (("api-1", 21001), ("api-2", 21002)):
+        st.register_service(
+            "n1", f"{app_id}-sidecar-proxy", "api-sidecar-proxy",
+            port=pport, kind="connect-proxy",
+            proxy={"destination_service": "api",
+                   "local_service_port": 80})   # no dest id!
+    rows = {r["service_id"]: r for r in st.connect_service_nodes("api")}
+    assert rows["api-1-sidecar-proxy"]["app"]["id"] == "api-1"
+    assert rows["api-2-sidecar-proxy"]["app"]["id"] == "api-2"
+
+    class _M:
+        store = st
+    ps = ProxyState.__new__(ProxyState)
+    ps.manager = _M()
+    for ver, port in (("v1", 21001), ("v2", 21002)):
+        tgt = {"Subset": ver,
+               "Filter": f"Service.Meta.version == {ver}",
+               "OnlyPassing": False, "Service": "api",
+               "Datacenter": "dc1"}
+        assert [e["port"] for e in
+                ps._connect_endpoints("api", target=tgt)] == [port]
+
+    # an unpaired extra sidecar on the same node: ambiguous -> no app
+    st.register_service(
+        "n1", "extra-proxy", "api-sidecar-proxy", port=21003,
+        kind="connect-proxy",
+        proxy={"destination_service": "api"})
+    rows = {r["service_id"]: r for r in st.connect_service_nodes("api")}
+    assert rows["extra-proxy"]["app"] is None
+    # single-instance nodes still pair unambiguously with no naming hint
+    st.register_node("n2", "10.0.0.2")
+    st.register_service("n2", "api-9", "api", port=89,
+                        meta={"version": "v9"})
+    st.register_service("n2", "oddly-named", "api-sidecar-proxy",
+                        port=21009, kind="connect-proxy",
+                        proxy={"destination_service": "api"})
+    rows = {r["service_id"]: r for r in st.connect_service_nodes("api")}
+    assert rows["oddly-named"]["app"]["id"] == "api-9"
