@@ -35,6 +35,11 @@ class GossipConfig:
     # compound-message framing is ~40 bytes)
     udp_packet_bytes: int = 1400
     gossip_msg_bytes: int = 40
+    # Lifeguard Local Health Awareness: a node's probe interval and
+    # timeout stretch by (health score + 1), score in
+    # [0, awareness_max_multiplier - 1].  0 disables the component
+    # (memberlist AwarenessMaxMultiplier, default 8).
+    awareness_max_multiplier: int = 8
 
     @classmethod
     def lan(cls) -> "GossipConfig":
@@ -91,6 +96,12 @@ class SimConfig:
     rumor_slots: int = 32          # U: max concurrently-active rumors
     alloc_cap: int = 8             # max new rumors allocated per tick per kind
     p_loss: float = 0.01           # per-leg UDP message loss probability
+    # locally-degraded nodes (Lifeguard's motivating scenario: a bad
+    # NIC/overloaded host causing ITS probes to fail and suspect
+    # healthy peers): a deterministic `degraded_frac` of nodes lose
+    # each of their OWN legs with `degraded_loss` instead of p_loss
+    degraded_frac: float = 0.0
+    degraded_loss: float = 0.0
     rtt_base_ms: float = 0.5       # min one-way latency
     rtt_spread_ms: float = 30.0    # scale of the coordinate space (ms)
     coord_dims: int = 2            # ground-truth latency-space dims

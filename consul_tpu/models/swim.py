@@ -65,6 +65,17 @@ No-longer-simplifications (capabilities the kernel now has):
     with simultaneous victims (LIVE_VS_SIM.json multi_victim) and
     derived against memberlist math at 1M (BENCH_correlated.json
     derivation block);
+  * Lifeguard Local Health Awareness + NACK (gossip.mdx:45-60; the
+    Lifeguard paper's LHA-Probe): each node carries a health score
+    ([N] awareness) fed by its own probe outcomes — acked probe -1,
+    failed probe charged only as far as live relays' NACKs failed to
+    come back (leg-resolved indirect probes: a relay that reached the
+    origin but not the target returns a NACK), having to refute a
+    suspicion of itself +1.  The score stretches that node's probe
+    rate and timeout by (score+1), so probers with degraded
+    connectivity originate fewer and slower suspicions — measurably
+    fewer false suspicions at p_loss 0.10-0.20 (tools/f1_harness.py
+    --lha sweep).  awareness_max_multiplier=0 disables;
   * mass-event dissemination (kills far above U): expired subjects
     that cannot win a dead slot enter the BULK death channel
     (bulk_member/bulk_heard) — exact per node, mean-field per subject
@@ -128,6 +139,9 @@ class SwimParams:
     p_loss: float
     rtt_base_ms: float
     packet_msgs: int           # piggyback msgs per UDP packet (bulk channel)
+    awareness_max: int         # Lifeguard LHA score cap+1 (0 disables)
+    degraded_frac: float       # fraction of nodes with degraded legs
+    degraded_loss: float       # their per-leg loss (vs p_loss)
     seed: int
 
 
@@ -168,6 +182,9 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         p_loss=sim.p_loss,
         rtt_base_ms=sim.rtt_base_ms,
         packet_msgs=gossip.packet_msgs(),
+        awareness_max=gossip.awareness_max_multiplier,
+        degraded_frac=sim.degraded_frac,
+        degraded_loss=sim.degraded_loss,
         seed=sim.seed,
     )
 
@@ -221,6 +238,16 @@ class SwimState:
     bulk_member: jnp.ndarray     # [N] bool: subject is in the bulk channel
     bulk_heard: jnp.ndarray      # [N] float32: expected bulk deaths heard
     bulk_cov: jnp.ndarray        # [N] float32: per-SUBJECT coverage estimate
+    # --- Lifeguard Local Health Awareness (gossip.mdx:45-60) ---
+    # Each node judges its OWN health from probe outcomes: failed
+    # probes whose relays did not NACK (our receive path is suspect)
+    # raise the score; acked probes lower it; refuting a suspicion of
+    # ourselves raises it.  The score stretches the node's probe rate
+    # and timeout by (score+1), so a degraded prober originates fewer
+    # (and slower-declared) suspicions — the false-positive damper.
+    awareness: jnp.ndarray       # [N] int32 health score, [0, max-1]
+    sus_count: jnp.ndarray       # [N] int32: suspicion starts per subject
+    #                               (diagnostic: false-suspicion counting)
 
 
 def init_state(params: SwimParams, key=None,
@@ -268,6 +295,8 @@ def init_state(params: SwimParams, key=None,
         bulk_member=jnp.zeros((n,), bool),
         bulk_heard=jnp.zeros((n,), jnp.float32),
         bulk_cov=jnp.zeros((n,), jnp.float32),
+        awareness=jnp.zeros((n,), jnp.int32),
+        sus_count=jnp.zeros((n,), jnp.int32),
     )
 
 
@@ -508,29 +537,70 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
     n = params.n_nodes
     tick = s.tick
     kt = prng.tick_key(params.seed, tick, 1)
-    k_off, k_direct, k_leg, k_rtt = jax.random.split(kt, 4)
+    k_off, k_direct, k_leg, k_rtt, k_lha = jax.random.split(kt, 5)
     offs = rolls.offsets(k_off, n, 1 + params.indirect_checks)
     d = offs[0]
 
     maps = _maps(params, s)
-    prober = s.up & s.member
     live = s.up & s.member
+    # Lifeguard LHA: a node with health score h probes at 1/(h+1) of
+    # the base rate and waits (h+1)x the base timeout (memberlist
+    # scales its probe ticker and timeout by the awareness score).
+    # The rate stretch is realized probabilistically per round —
+    # same expected rate, no cross-node phase alignment.
+    if params.awareness_max > 0:
+        score = jnp.clip(s.awareness, 0, params.awareness_max - 1)
+        mult = (score + 1).astype(jnp.float32)
+        lha_go = jax.random.uniform(k_lha, (n,)) * mult < 1.0
+    else:
+        mult = jnp.ones((n,), jnp.float32)
+        lha_go = jnp.ones((n,), bool)
+    prober = live & lha_go
     skip = _believes_down_shift(params, s, maps, d, tick)
     t_up = rolls.pull(live, d)
 
-    # direct probe: two UDP legs + RTT under probe_timeout
+    # per-node leg delivery rate: a degraded node (Lifeguard's bad-NIC
+    # scenario) loses each of ITS legs at degraded_loss; a leg between
+    # i and j delivers at the WORSE endpoint's rate, min(ok_i, ok_j) —
+    # normal-normal legs keep exactly the baseline p_loss semantics
+    if params.degraded_frac > 0.0:
+        h = (jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(2654435761)
+             + jnp.uint32(params.seed))
+        degraded = (h.astype(jnp.float32) / jnp.float32(2 ** 32)) \
+            < params.degraded_frac
+        ok_node = jnp.where(degraded, 1.0 - params.degraded_loss,
+                            1.0 - params.p_loss)
+    else:
+        ok_node = jnp.full((n,), 1.0 - params.p_loss, jnp.float32)
+
+    # direct probe: two UDP legs + RTT under the (LHA-scaled) timeout
     rtt = jnp.linalg.norm(s.coords - rolls.pull(s.coords, d), axis=-1) \
         + params.rtt_base_ms
     rtt = rtt * (1.0 + jax.random.exponential(k_rtt, (n,)) * 0.1)
-    legs_ok = jax.random.bernoulli(k_direct, (1.0 - params.p_loss) ** 2, (n,))
-    direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms)
+    ok_t = rolls.pull(ok_node, d)
+    legs_ok = jax.random.uniform(k_direct, (n,)) \
+        < jnp.minimum(ok_node, ok_t) ** 2
+    direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms * mult)
 
-    # k indirect probes through ring relays (4 UDP legs each)
+    # k indirect probes through ring relays, leg-resolved so relays
+    # can NACK (Lifeguard): origin->relay (l1), relay<->target (l23),
+    # relay->origin return (l4 — carries the ack, or the NACK when the
+    # relay reached the origin but could not reach the target)
+    kA, kB, kC = jax.random.split(k_leg, 3)
+    shape = (n, params.indirect_checks)
+    ok_r = jnp.stack([rolls.pull(ok_node, offs[1 + k])
+                      for k in range(params.indirect_checks)], axis=-1)
+    uA = jax.random.uniform(kA, shape)
+    uB = jax.random.uniform(kB, shape)
+    uC = jax.random.uniform(kC, shape)
+    l1 = uA < jnp.minimum(ok_node[:, None], ok_r)
+    l23 = uB < jnp.minimum(ok_r, ok_t[:, None]) ** 2
+    l4 = uC < jnp.minimum(ok_r, ok_node[:, None])
     relay_ok = jnp.stack([rolls.pull(live, offs[1 + k])
                           for k in range(params.indirect_checks)], axis=-1)
-    legs4 = jax.random.bernoulli(k_leg, (1.0 - params.p_loss) ** 4,
-                                 (n, params.indirect_checks))
-    ack = direct_ack | (t_up & jnp.any(relay_ok & legs4, axis=-1))
+    ind_ack = relay_ok & l1 & (t_up[:, None] & l23) & l4
+    nacked = relay_ok & l1 & ~(t_up[:, None] & l23) & l4
+    ack = direct_ack | jnp.any(ind_ack, axis=-1)
 
     # a target outside the membership (never provisioned, or left) is
     # not probed at all — memberlist only probes its member list; without
@@ -538,6 +608,23 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
     # deaths for every free slot, saturating the rumor table
     t_member = rolls.pull(s.member, d)
     failed = prober & ~skip & ~ack & t_member
+    # Lifeguard self-awareness update (memberlist probeNode): an acked
+    # probe is evidence of our own health (-1); a failed probe is
+    # charged to US as far as the k expected relay NACKs did not come
+    # back — when every relay NACKed, the target (not the prober) is
+    # the problem and the delta is 0.  ALL k sent indirect probes
+    # count as NACK-expected: the prober cannot tell a dead relay from
+    # its own lost legs, so either raises its score (exactly
+    # memberlist's expectedNacks accounting).
+    if params.awareness_max > 0:
+        probed = prober & ~skip & t_member
+        k = params.indirect_checks
+        nack_count = jnp.sum(nacked, axis=-1).astype(jnp.int32)
+        delta_fail = (k - nack_count) if k > 0 else 1
+        delta = jnp.where(probed & ack, -1,
+                          jnp.where(failed, delta_fail, 0))
+        s = s.replace(awareness=jnp.clip(
+            s.awareness + delta, 0, params.awareness_max - 1))
     # per-subject suspector count: the shift is a bijection — exactly one
     # prober per subject per round (cnt in {0,1}), like memberlist's ring
     cnt = rolls.push(failed, d).astype(jnp.int32)
@@ -572,7 +659,8 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
         start_new, 1,
         jnp.where(suspected & (s.sus_start >= 0),
                   jnp.minimum(s.sus_confirm + cnt, 64), s.sus_confirm))
-    s = s.replace(sus_start=sus_start, sus_confirm=sus_confirm)
+    s = s.replace(sus_start=sus_start, sus_confirm=sus_confirm,
+                  sus_count=s.sus_count + start_new.astype(jnp.int32))
 
     # (c) originate new suspect rumors for subjects with no existing
     # rumor (belief spread + refutation channel; timing no longer
@@ -770,6 +858,16 @@ def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     # bump incarnation above the suspected one
     inc = s.incarnation.at[jnp.where(need, subj, 0)].max(
         jnp.where(need, s.r_inc + 1, _NEG))
+    # Lifeguard: having to refute means our liveness was in doubt —
+    # the refuter charges its own health score +1 (memberlist
+    # suspectNode on self)
+    awareness = s.awareness
+    if params.awareness_max > 0:
+        awareness = jnp.clip(
+            awareness.at[jnp.where(need, subj, 0)].add(
+                jnp.where(need, 1, 0)),
+            0, params.awareness_max - 1)
+    s = s.replace(awareness=awareness)
     # convert the suspect slot: alive(inc+1) broadcast seeded at the
     # subject, full retransmit budget
     onehot_subj = (jnp.arange(n)[:, None] == subj[None, :])      # [N, U]

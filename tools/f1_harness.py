@@ -23,6 +23,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+import dataclasses
+
 import jax
 import numpy as np
 
@@ -30,14 +32,20 @@ from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.models import swim
 
 
-def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7):
-    params = swim.make_params(GossipConfig.lan(),
+def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7,
+            lha: bool = True, degraded=(0.0, 0.0)):
+    gossip = GossipConfig.lan() if lha else dataclasses.replace(
+        GossipConfig.lan(), awareness_max_multiplier=0)
+    params = swim.make_params(gossip,
                               SimConfig(n_nodes=n, rumor_slots=32,
                                         alloc_cap=8, p_loss=p_loss,
+                                        degraded_frac=degraded[0],
+                                        degraded_loss=degraded[1],
                                         seed=seed))
     s = swim.init_state(params)
     run = jax.jit(swim.run, static_argnums=(0, 2, 3))
     s, _ = run(params, s, 25, None)                      # steady state
+    sus_base = np.asarray(s.sus_count).copy()            # warmup baseline
     victims = list(range(3, 3 + kills * 7, 7))[:kills]
     for v in victims:
         s = swim.kill(s, v)
@@ -46,6 +54,13 @@ def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7):
     up = np.asarray(s.up)
     committed = np.asarray(s.committed_dead)
     false_commits = int((committed & up).sum())
+    # false suspicions: suspicion timers STARTED on subjects that were
+    # alive the whole run (excludes warmup churn) — the observable
+    # Lifeguard's LHA exists to reduce (gossip.mdx:45-60)
+    sus_delta = np.asarray(s.sus_count) - sus_base
+    vm = np.zeros(n, bool)
+    vm[victims] = True
+    false_suspicions = int(sus_delta[~vm].sum())
 
     tp = 0
     for v in victims:
@@ -67,15 +82,37 @@ def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7):
     precision = tp / max(tp + fp, 1)
     recall = tp / max(len(victims), 1)
     f1 = 2 * precision * recall / max(precision + recall, 1e-9)
-    return {"p_loss": p_loss, "n": n, "kills": kills,
+    return {"p_loss": p_loss, "n": n, "kills": kills, "lha": lha,
             "recall": round(recall, 4), "precision": round(precision, 4),
-            "f1": round(f1, 4), "false_commits": false_commits}
+            "f1": round(f1, 4), "false_commits": false_commits,
+            "false_suspicions": false_suspicions}
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
-    kills = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    ticks = int(sys.argv[3]) if len(sys.argv) > 3 else 900
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(args[0]) if len(args) > 0 else 4096
+    kills = int(args[1]) if len(args) > 1 else 8
+    ticks = int(args[2]) if len(args) > 2 else 900
+    if "--lha" in sys.argv[1:]:
+        # LHA on/off comparison at the lossy end (VERDICT r4 #5): the
+        # observable is false suspicions of always-live subjects.
+        # Two regimes: uniform loss (every node equally lossy — LHA
+        # helps modestly, scores hover near 0 because acked probes
+        # decay them), and Lifeguard's motivating one: a few LOCALLY
+        # degraded nodes whose own legs drop 30-40% — LHA throttles
+        # exactly those probers.
+        for p_loss in (0.10, 0.15, 0.20):
+            for lha in (False, True):
+                print(json.dumps(run_one(n, kills, ticks, p_loss,
+                                         lha=lha)))
+        for dfrac, dloss in ((0.05, 0.30), (0.05, 0.40)):
+            for lha in (False, True):
+                row = run_one(n, kills, ticks, 0.02, lha=lha,
+                              degraded=(dfrac, dloss))
+                row["degraded_frac"] = dfrac
+                row["degraded_loss"] = dloss
+                print(json.dumps(row))
+        return
     for p_loss in (0.02, 0.05, 0.10):
         print(json.dumps(run_one(n, kills, ticks, p_loss)))
 
