@@ -319,6 +319,34 @@ class RaftNode:
         (agent/consul/leader.go:64)."""
         self._leader_observers.append(fn)
 
+    @staticmethod
+    def _expand_entries(cmd: Any, noop: bool) -> list:
+        """One command -> its log entry payloads (chunked when big)."""
+        if noop or cmd is None or not _roughly_big(cmd):
+            return [cmd]
+        # Only commands the cheap walk flags as large pay the
+        # serialization probe; chunked applies are JSON-round-
+        # tripped, which matches what the socket transport does to
+        # EVERY command anyway (rpc/net.py JSON frames).  Byte-
+        # accurate split over the UTF-8 encoding (character counts
+        # under-measure non-ASCII by up to 4x).
+        import base64 as _b64
+        import json as _json
+        import uuid as _uuid
+        try:
+            blob = _json.dumps(cmd).encode()
+        except (TypeError, ValueError):
+            blob = b""          # non-JSON cmd: in-memory path only
+        if len(blob) <= CHUNK_BYTES:
+            return [cmd]
+        gid = str(_uuid.uuid4())
+        parts = [blob[i:i + CHUNK_BYTES]
+                 for i in range(0, len(blob), CHUNK_BYTES)]
+        return [{"__chunk__": {
+            "id": gid, "seq": i, "total": len(parts),
+            "data": _b64.b64encode(p).decode()}}
+            for i, p in enumerate(parts)]
+
     def apply(self, cmd: Any, noop: bool = False) -> _Pending:
         """Leader-only append; returns a waiter resolved at FSM apply
         (raftApply — agent/consul/rpc.go:730).
@@ -333,50 +361,40 @@ class RaftNode:
         write path (a send to a partitioned peer would otherwise hold
         the raft lock for the full connect timeout).  Concurrent
         appliers batch into the single per-tick append."""
-        entries = [cmd]
-        if not noop and cmd is not None and _roughly_big(cmd):
-            # Only commands the cheap walk flags as large pay the
-            # serialization probe; chunked applies are JSON-round-
-            # tripped, which matches what the socket transport does to
-            # EVERY command anyway (rpc/net.py JSON frames).  Byte-
-            # accurate split over the UTF-8 encoding (character counts
-            # under-measure non-ASCII by up to 4x).
-            import base64 as _b64
-            import json as _json
-            import uuid as _uuid
-            try:
-                blob = _json.dumps(cmd).encode()
-            except (TypeError, ValueError):
-                blob = b""          # non-JSON cmd: in-memory path only
-            if len(blob) > CHUNK_BYTES:
-                gid = str(_uuid.uuid4())
-                parts = [blob[i:i + CHUNK_BYTES]
-                         for i in range(0, len(blob), CHUNK_BYTES)]
-                entries = [{"__chunk__": {
-                    "id": gid, "seq": i, "total": len(parts),
-                    "data": _b64.b64encode(p).decode()}}
-                    for i, p in enumerate(parts)]
+        return self.apply_many([cmd], noop=noop)[0]
+
+    def apply_many(self, cmds: list, noop: bool = False) -> list:
+        """Group commit: append a whole batch of commands under ONE
+        lock acquisition, one broadcast flag, and (durably) the shared
+        per-tick fsync — returning a waiter per command.  This is the
+        leader half of quorum-write batching: a forwarding follower
+        coalesces its concurrent applies into one apply_batch RPC
+        (server.py), and the batch lands here as one raft round."""
+        batches = [self._expand_entries(c, noop) for c in cmds]
+        pends = []
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
-            for e_cmd in entries:
-                ent = _Entry(self.current_term, e_cmd, noop)
-                self.log.append(ent)
-                idx = self.last_log_index
-                # WAL append now, fsync deferred to the commit decision
-                # (_advance_commit) — one group-commit fsync per tick
-                # covers every write batched into it
-                self._persist_entry(idx, ent)
-            # the waiter resolves when the FINAL chunk (or the single
-            # entry) applies
-            pend = _Pending()
-            self._pending[idx] = pend
-            self.match_index[self.node_id] = idx
+            for entries in batches:
+                for e_cmd in entries:
+                    ent = _Entry(self.current_term, e_cmd, noop)
+                    self.log.append(ent)
+                    idx = self.last_log_index
+                    # WAL append now, fsync deferred to the commit
+                    # decision (_advance_commit) — one group-commit
+                    # fsync per tick covers every write batched into it
+                    self._persist_entry(idx, ent)
+                # the waiter resolves when the FINAL chunk (or the
+                # single entry) applies
+                pend = _Pending()
+                self._pending[idx] = pend
+                pends.append(pend)
+            self.match_index[self.node_id] = self.last_log_index
             self._needs_bcast = True
         cb = self.on_activity
         if cb is not None:
             cb()
-        return pend
+        return pends
 
     def barrier(self) -> _Pending:
         """Commit a no-op in the current term — leader barrier before
@@ -629,8 +647,18 @@ class RaftNode:
             self.match_index[peer] = max(self.match_index.get(peer, 0),
                                          msg["match_index"])
             self.next_index[peer] = self.match_index[peer] + 1
-            if self.next_index[peer] <= self.last_log_index:
-                self._send_append(peer)     # keep streaming backlog
+            behind = self.last_log_index - self.match_index[peer]
+            if behind >= self.cfg.max_append_entries:
+                # genuine catch-up (restart, slow link): stream full
+                # batches without waiting out the tick
+                self._send_append(peer)
+            elif behind > 0:
+                # a small tail that arrived since the last send: fold
+                # it into the next tick's single broadcast.  Replying
+                # per-ack here caused an append-per-ack ping-pong
+                # under concurrent writers (~6 messages per command);
+                # group commit batches them at tick cadence instead.
+                self._needs_bcast = True
         else:
             self.next_index[peer] = max(1, msg.get("hint_index", 1))
             self._send_append(peer)

@@ -75,6 +75,13 @@ class Server:
         self._ttl_reap_inflight: set = set()
         self._listener = None
         self._rpc_client = None
+        # forward coalescer (group commit for quorum writes): applies
+        # that must travel to a remote leader queue here and drain as
+        # one apply_batch RPC per round trip
+        self._fwd_cv = threading.Condition()
+        self._fwd_q: list = []
+        self._fwd_thread = None
+        self._fwd_running = False
         self.tls = None
         self._bootstrap_token = None
         # auto-config: auth-method name that validates intro JWTs
@@ -151,9 +158,113 @@ class Server:
         if self._listener is not None:
             self._listener.stop()
             self._listener = None
+        with self._fwd_cv:
+            self._fwd_running = False
+            self._fwd_cv.notify_all()
+        if self._fwd_thread is not None:
+            self._fwd_thread.join(timeout=2.0)
+            self._fwd_thread = None
         if self._rpc_client is not None:
             self._rpc_client.close()
             self._rpc_client = None
+
+    # ---------------------------------------------------- forward coalescer
+
+    _FWD_MAX_BATCH = 128
+
+    def _forward_apply(self, op: str, args: dict, timeout: float):
+        """Queue one apply for the remote leader and wait.  A single
+        forwarder thread drains the queue, sending everything queued as
+        ONE apply_batch RPC — concurrent writers on this server cost
+        one forwarded round trip and one raft append round between
+        them (group commit), instead of a socket RPC each."""
+        from consul_tpu.rpc import RpcError
+        item = {"op": op, "args": args, "event": threading.Event(),
+                "result": None, "error": None,
+                "deadline": time.time() + timeout}
+        with self._fwd_cv:
+            if not self._fwd_running:
+                self._fwd_running = True
+                self._fwd_thread = threading.Thread(
+                    target=self._forward_loop, daemon=True,
+                    name=f"fwd-{self.node_id}")
+                self._fwd_thread.start()
+            self._fwd_q.append(item)
+            self._fwd_cv.notify()
+        if not item["event"].wait(timeout):
+            raise TimeoutError(f"forwarded apply {op} timed out")
+        if item["error"] is not None:
+            err = item["error"]
+            raise err if isinstance(err, Exception) else RpcError(err)
+        return item["result"]
+
+    def _forward_loop(self) -> None:
+        from consul_tpu.rpc import RpcError
+        while True:
+            with self._fwd_cv:
+                while not self._fwd_q and self._fwd_running:
+                    self._fwd_cv.wait(0.5)
+                if not self._fwd_running and not self._fwd_q:
+                    return
+                items = self._fwd_q[:self._FWD_MAX_BATCH]
+                del self._fwd_q[:self._FWD_MAX_BATCH]
+            # an item whose caller already timed out (and was told the
+            # write FAILED) must not be transmitted on its behalf —
+            # that would widen the failed-but-later-applied ambiguity
+            # window beyond the caller's own budget
+            now = time.time()
+            stale = [it for it in items if it["deadline"] <= now]
+            for it in stale:
+                it["error"] = TimeoutError("forward abandoned: caller "
+                                           "deadline passed")
+                it["event"].set()
+            items = [it for it in items if it["deadline"] > now]
+            if not items:
+                continue
+            # RPC budget: the longest remaining caller deadline (a
+            # near-expired caller must not sink the whole batch; its
+            # own event.wait still returns on ITS deadline, and the
+            # ambiguity window is bounded by the in-batch spread)
+            budget = min(10.0, max(0.05, max(it["deadline"]
+                                             for it in items) - now))
+            # leader resolved at drain time: a change between enqueue
+            # and send surfaces as an error and the caller's
+            # raft_apply retry loop re-resolves
+            addr = self._remote_addr(self.leader_id or "")
+            client = self._rpc_client
+            if addr is None or client is None:
+                err = NoLeaderError("no leader address to forward to")
+                for it in items:
+                    it["error"] = err
+                    it["event"].set()
+                continue
+            try:
+                if len(items) == 1:
+                    it = items[0]
+                    it["result"] = client.call(
+                        addr, "apply",
+                        {"op": it["op"], "args": it["args"]},
+                        timeout=budget)
+                    it["event"].set()
+                    continue
+                out = client.call(
+                    addr, "apply_batch",
+                    {"items": [{"op": it["op"], "args": it["args"]}
+                               for it in items]},
+                    timeout=budget)
+                results = (out or {}).get("results") or []
+                errors = (out or {}).get("errors") or []
+                for i, it in enumerate(items):
+                    it["result"] = results[i] if i < len(results) \
+                        else None
+                    e = errors[i] if i < len(errors) else None
+                    it["error"] = RpcError(e) if e else None
+                    it["event"].set()
+            except Exception as e:
+                for it in items:
+                    if not it["event"].is_set():
+                        it["error"] = e
+                        it["event"].set()
 
     def _handle_rpc(self, method: str, args: dict):
         """Server-side forwarded calls (the RPC endpoints the mux routes
@@ -169,6 +280,31 @@ class Server:
             if pend.error is not None:
                 raise pend.error
             return pend.result
+        if method == "apply_batch":
+            # group commit for forwarded writes: one raft append round
+            # for the whole batch, per-item results/errors (the
+            # reference batches at the msgpack chunking layer;
+            # coalescing concurrent forwards is the same lever)
+            if not self.raft.is_leader():
+                raise NotLeaderError(self.raft.leader_id)
+            pends = self.raft.apply_many(
+                [{"op": it["op"], "args": it.get("args") or {}}
+                 for it in args["items"]])
+            deadline = time.time() + 5.0
+            results, errors = [], []
+            for pend in pends:
+                if not pend.event.wait(max(0.0,
+                                           deadline - time.time())):
+                    results.append(None)
+                    errors.append("apply timed out")
+                elif pend.error is not None:
+                    results.append(None)
+                    errors.append(f"{type(pend.error).__name__}: "
+                                  f"{pend.error}")
+                else:
+                    results.append(pend.result)
+                    errors.append(None)
+            return {"results": results, "errors": errors}
         if method == "barrier":
             if not self.raft.is_leader():
                 raise NotLeaderError(self.raft.leader_id)
@@ -400,13 +536,14 @@ class Server:
             target = self if self.raft.is_leader() else \
                 self.registry.get(leader or "")
             if target is None:
-                # leader not in-process: forward over the socket RPC,
-                # bounded by the caller's remaining budget
-                addr = self._remote_addr(leader or "")
-                if addr is not None:
+                # leader not in-process: forward over the socket RPC
+                # through the coalescer (concurrent applies batch into
+                # one apply_batch round), bounded by the caller's
+                # remaining budget
+                if self._remote_addr(leader or "") is not None:
                     try:
-                        out = self._rpc_client.call(
-                            addr, "apply", {"op": op, "args": args},
+                        out = self._forward_apply(
+                            op, args,
                             timeout=max(0.05, deadline - time.time()))
                         if out is not None:
                             return out
@@ -414,7 +551,8 @@ class Server:
                         # deposition — retry within the deadline rather
                         # than hand callers a non-dict
                         last_err = RpcError("empty apply result")
-                    except (RpcError, TimeoutError) as e:
+                    except (RpcError, TimeoutError,
+                            NoLeaderError) as e:
                         last_err = e
                 time.sleep(0.01)
                 continue

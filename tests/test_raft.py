@@ -150,3 +150,40 @@ def test_five_node_cluster_majority_commit():
            if n not in dark and i != lead.node_id]
     for i in lit:
         assert "quorum-write" in c.applied[i]
+
+
+def test_apply_many_group_commit():
+    """apply_many appends a whole batch under one lock/broadcast and
+    resolves a waiter per command with per-command results."""
+    from consul_tpu.consensus.raft import InMemTransport, RaftConfig, RaftNode
+    net = InMemTransport()
+    applied = {"a": [], "b": [], "c": []}
+    nodes = {}
+    for nid in ("a", "b", "c"):
+        nodes[nid] = RaftNode(
+            nid, ["a", "b", "c"], net,
+            apply_fn=(lambda nid: lambda cmd:
+                      (applied[nid].append(cmd), cmd["v"] * 10)[1])(nid),
+            config=RaftConfig(), seed=hash(nid) & 0xFF)
+        net.register(nodes[nid])
+    now = 0.0
+    leader = None
+    while leader is None and now < 10.0:
+        now += 0.01
+        for n in nodes.values():
+            n.tick(now)
+        leaders = [n for n in nodes.values() if n.is_leader()]
+        leader = leaders[0] if len(leaders) == 1 else None
+    assert leader is not None
+    pends = leader.apply_many([{"v": i} for i in range(10)])
+    for _ in range(50):
+        now += 0.01
+        for n in nodes.values():
+            n.tick(now)
+    for i, p in enumerate(pends):
+        assert p.event.is_set()
+        assert p.error is None
+        assert p.result == i * 10
+    # every replica applied the batch in order
+    for nid in ("a", "b", "c"):
+        assert [c["v"] for c in applied[nid]] == list(range(10))

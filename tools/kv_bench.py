@@ -17,15 +17,18 @@ processes and the load generators all share one CPU, so --cluster
 throughput is a functional demonstration there, not a scaling
 measurement; the standalone numbers are the per-core comparison.
 
-Measured on the round-4 rig (1 core; BENCH_kv.json): standalone PUT
-~6.3k req/s (1.66x the reference's absolute 3,779.9) and GET ~7.6k
-req/s (1.01x the absolute 7,524.9 — which the reference produced on
+Measured on the round-5 rig (1 core; BENCH_kv.json): standalone PUT
+~6.2k req/s (1.63x the reference's absolute 3,779.9) and GET ~8.2k
+req/s (1.08x the absolute 7,524.9 — which the reference produced on
 8x2GHz cores per server), after the fastfront server core
 (consul_tpu/api/fastfront.py) replaced http.server's per-request
-machinery on the KV hot path; cluster quorum-write ~800 req/s with
+machinery on the KV hot path; cluster quorum-write ~2.2k req/s with
 all three server processes AND the load generators sharing the single
-core (the reference's ~3.8k came from 24 dedicated server cores — per
-server-core this path sustains several times its ~157 req/s).
+core (was ~800 in round 4 — group commit closed the gap: concurrent
+forwarded applies coalesce into one apply_batch RPC + one raft append
+round, and append replies no longer trigger an append-per-ack
+ping-pong).  The reference's ~3.8k came from 24 dedicated server
+cores — per server-core this path now sustains ~14x its ~157 req/s.
 """
 
 import argparse
