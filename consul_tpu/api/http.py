@@ -304,6 +304,23 @@ class ApiServer:
 
         reg("resolved_service_config", _fetch_resolved_config,
             ttl=600.0)
+
+        def _fetch_intention_upstreams(key, mi, t):
+            # services `key` may dial per intentions — what a
+            # transparent proxy must watch
+            # (agent/cache-types/intention_upstreams.go)
+            return ([e["name"] for e in st.intention_topology(
+                key, downstreams=False,
+                default_allow=self.default_allow)], st.index)
+
+        reg("intention_upstreams", _fetch_intention_upstreams,
+            ttl=600.0)
+
+        def _fetch_service_topology(key, mi, t):
+            return (st.service_topology(
+                key, default_allow=self.default_allow), st.index)
+
+        reg("service_topology", _fetch_service_topology, ttl=600.0)
         reg("federation_states",
             lambda key, mi, t: (st.federation_state_list(), st.index),
             ttl=600.0)
@@ -1852,6 +1869,96 @@ def _make_handler(srv: ApiServer):
                 out = [r for r in rows
                        if self.authz.service_read(r["Name"])]
                 self._send(self._filtered(q, out), index=idx,
+                           extra_headers=self._cache_headers(state))
+                return True
+            m = re.fullmatch(
+                r"/v1/internal/ui/service-topology/(.+)", path)
+            if m and verb == "GET":
+                # upstream/downstream topology with intention
+                # decisions (agent/http_register.go:104,
+                # agent/ui_endpoint.go UIServiceTopology; derivation
+                # catalog/store.py service_topology)
+                svc = urllib.parse.unquote(m.group(1))
+                if not self.authz.service_read(svc):
+                    return self._forbid()
+                topo, idx, state = self._cache_or_live(
+                    "service_topology", svc, q,
+                    lambda: store.service_topology(
+                        svc, default_allow=srv.default_allow),
+                    ("services", ""), ("intentions", ""),
+                    ("nodechecks", ""))
+
+                def summarize(edge):
+                    # ServiceTopologySummary: health rollup + the
+                    # intention decision for the edge
+                    rows = store.health_service_nodes(edge["name"])
+                    counts = {"passing": 0, "warning": 0,
+                              "critical": 0}
+                    for r in rows:
+                        worst = "passing"
+                        for c in r["checks"]:
+                            s = c["status"]
+                            if s == "critical":
+                                worst = "critical"
+                            elif s == "warning" and \
+                                    worst != "critical":
+                                worst = "warning"
+                        counts[worst] += 1
+                    d = edge["decision"]
+                    return {
+                        "Name": edge["name"],
+                        "Datacenter": srv.dc,
+                        "InstanceCount": len(rows),
+                        "ChecksPassing": counts["passing"],
+                        "ChecksWarning": counts["warning"],
+                        "ChecksCritical": counts["critical"],
+                        "Source": edge["source"],
+                        "Intention": {
+                            "Allowed": d["Allowed"],
+                            "HasPermissions": d["HasPermissions"],
+                            "HasExact": d["HasExact"],
+                            "ExternalSource": d["ExternalSource"],
+                            "DefaultAllow": srv.default_allow,
+                        }}
+
+                # one ACL check per distinct edge name (edges repeat
+                # across the filters below)
+                readable = {e["name"]: self.authz.service_read(
+                    e["name"]) for e in (topo["upstreams"]
+                                         + topo["downstreams"])}
+                self._send({
+                    "Protocol": topo["protocol"],
+                    "TransparentProxy": topo["transparent_proxy"],
+                    "Upstreams": [
+                        summarize(e) for e in topo["upstreams"]
+                        if readable[e["name"]]],
+                    "Downstreams": [
+                        summarize(e) for e in topo["downstreams"]
+                        if readable[e["name"]]],
+                    "FilteredByACLs": not all(readable.values()),
+                }, index=idx,
+                    extra_headers=self._cache_headers(state))
+                return True
+            m = re.fullmatch(
+                r"/v1/internal/intention-upstreams/(.+)", path)
+            if m and verb == "GET":
+                # service names `svc` may dial per intentions — what a
+                # transparent proxy watches
+                # (agent/cache-types/intention_upstreams.go, served by
+                # Internal.IntentionUpstreams)
+                svc = urllib.parse.unquote(m.group(1))
+                if not self.authz.service_read(svc):
+                    return self._forbid()
+                names, idx, state = self._cache_or_live(
+                    "intention_upstreams", svc, q,
+                    lambda: [e["name"] for e in
+                             store.intention_topology(
+                                 svc, downstreams=False,
+                                 default_allow=srv.default_allow)],
+                    ("intentions", ""), ("services", ""))
+                self._send([n for n in names
+                            if self.authz.service_read(n)],
+                           index=idx,
                            extra_headers=self._cache_headers(state))
                 return True
             m = re.fullmatch(

@@ -1180,6 +1180,127 @@ class StateStore:
         return sorted(rows, key=lambda r: (-r["precedence"],
                                            r["destination"], r["source"]))
 
+    def intention_topology(self, name: str, downstreams: bool = False,
+                           default_allow: bool = False) -> List[dict]:
+        """Candidate services `name` may dial (upstreams) or that may
+        dial `name` (downstreams), inferred from intentions + the ACL
+        default (state/intention.go IntentionTopology:944,
+        intentionTopologyTxn:965; backs the intention_upstreams cache
+        type agent/cache-types/intention_upstreams.go).
+
+        Every catalog service (non-proxy, non-gateway) is a candidate;
+        the decision evaluates the intentions that match `name` on the
+        source side (dest side for downstreams) against the candidate,
+        like the reference's per-candidate IntentionDecision.  Returns
+        [{name, allowed, has_exact}] for allowed candidates only.
+        """
+        from consul_tpu.connect import intentions as imod
+        with self._lock:
+            ints = [dict(v) for v in self._intentions.values()]
+            candidates = sorted({
+                v["name"] for v in self._services.values()
+                if not v.get("kind") and v["name"] != name})
+        match_by = "destination" if downstreams else "source"
+        matched = [i for i in ints
+                   if i[match_by] in (imod.WILDCARD, name)]
+        out = []
+        for cand in candidates:
+            src, dst = (cand, name) if downstreams else (name, cand)
+            allowed, _ = imod.authorize(matched, src, dst,
+                                        default_allow)
+            if not allowed:
+                continue
+            has_exact = any(i["source"] == src
+                            and i["destination"] == dst
+                            for i in matched)
+            out.append({"name": cand, "allowed": True,
+                        "has_exact": has_exact})
+        return out
+
+    def service_topology(self, name: str,
+                         default_allow: bool = False) -> dict:
+        """Upstream/downstream topology of a mesh service
+        (state/catalog.go ServiceTopology:2870, served by
+        Internal.ServiceTopology and /v1/internal/ui/service-topology).
+
+        Upstreams come from the proxy registrations fronting `name`
+        (source "registration"); when any of those proxies runs in
+        transparent mode, intention-derived candidates join with
+        source "specific-intention"/"default-allow".  Downstreams are
+        the services whose proxies list `name` as an upstream, plus
+        intention-derived ones for downstreams that run transparent
+        proxies.  Each edge carries its intention decision (our
+        intentions are L4 action-only, so HasPermissions is always
+        False).
+        """
+        from consul_tpu.connect import intentions as imod
+        from consul_tpu.discoverychain import service_protocol
+        with self._lock:
+            ints = [dict(v) for v in self._intentions.values()]
+            proxies = [v for v in self._services.values()
+                       if v.get("kind") == "connect-proxy"]
+        ups: Dict[str, str] = {}
+        downs: Dict[str, str] = {}
+        tproxy_of: Dict[str, bool] = {}
+        my_modes: List[str] = []
+        for v in proxies:
+            p = v.get("proxy") or {}
+            dest = p.get("destination_service", "")
+            mode = p.get("mode") or ""
+            if mode == "transparent":
+                tproxy_of[dest] = True
+            if dest == name:
+                my_modes.append(mode)
+                for u in p.get("upstreams") or []:
+                    un = u.get("destination_name", "")
+                    if un and un != name:
+                        ups[un] = "registration"
+            else:
+                for u in p.get("upstreams") or []:
+                    if u.get("destination_name") == name and dest:
+                        downs[dest] = "registration"
+        has_tproxy = any(m == "transparent" for m in my_modes)
+        fully_tproxy = bool(my_modes) and all(
+            m == "transparent" for m in my_modes)
+        # intention-inferred edges only apply where traffic is
+        # captured implicitly (transparent mode) — the reference drops
+        # non-registration upstreams when the target has no tproxy
+        # instance (catalog.go:3002) and non-registration downstreams
+        # whose OWN proxies aren't transparent (:3104)
+        if has_tproxy:
+            for e in self.intention_topology(name, False,
+                                             default_allow):
+                ups.setdefault(e["name"],
+                               "specific-intention" if e["has_exact"]
+                               else "default-allow")
+        for e in self.intention_topology(name, True, default_allow):
+            if tproxy_of.get(e["name"]):
+                downs.setdefault(e["name"],
+                                 "specific-intention"
+                                 if e["has_exact"] else "default-allow")
+
+        def decision(src: str, dst: str) -> dict:
+            allowed, _ = imod.authorize(ints, src, dst, default_allow)
+            return {"Allowed": allowed,
+                    "HasPermissions": False,
+                    "HasExact": any(i["source"] == src
+                                    and i["destination"] == dst
+                                    for i in ints),
+                    "ExternalSource": ""}
+
+        return {
+            "protocol": service_protocol(self, name),
+            "transparent_proxy": fully_tproxy,
+            "upstreams": [
+                {"name": n, "source": srcof,
+                 "decision": decision(name, n)}
+                for n, srcof in sorted(ups.items())],
+            "downstreams": [
+                {"name": n, "source": srcof,
+                 "decision": decision(n, name)}
+                for n, srcof in sorted(downs.items())],
+        }
+
     def intention_delete(self, iid: str) -> int:
         with self._lock:
             v = self._intentions.pop(iid, None)

@@ -55,6 +55,13 @@ PAGE = """<!DOCTYPE html>
   .warn { background:#3a2d10; color:var(--warn); }
   .crit { background:#42181a; color:var(--crit); }
   .dim { color:var(--dim); }
+  .ok { color:var(--ok); } .bad { color:var(--crit); }
+  .topo { display:flex; gap:24px; align-items:flex-start; }
+  .topo > div { flex:1; }
+  .tpself { flex:0 0 auto; align-self:center; }
+  .tpnode { border:1px solid var(--line); border-radius:8px;
+            background:var(--panel); padding:8px 12px;
+            margin:6px 0; }
   code { background:var(--panel); padding:1px 5px; border-radius:4px; }
   .cards { display:flex; gap:12px; margin-bottom:16px; flex-wrap:wrap; }
   .card { background:var(--panel); border:1px solid var(--line);
@@ -92,7 +99,7 @@ PAGE = """<!DOCTYPE html>
 <main id="main">loading…</main>
 <script>
 const tabs = ["services","nodes","members","kv","intentions","acl",
-              "mesh","operator"];
+              "mesh","operator","metrics"];
 let gen = 0;                         // render generation (watch cancel)
 const esc = (s) => String(s ?? "").replace(/[&<>"'\\\\]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;",
@@ -195,6 +202,44 @@ async function renderServiceDetail(name) {
       <span class="dim">(protocol ${esc(ch.Protocol)})</span></h3>
       <table><tr><th>Node</th><th>Type</th><th>Detail</th></tr>
       ${nodes}</table>`;
+  }
+  // topology: upstream -> svc -> downstream columns with intention
+  // allow/deny coloring (the reference UI's topology view backed by
+  // /v1/internal/ui/service-topology, agent/ui_endpoint.go)
+  const topo = await get(`/v1/internal/ui/service-topology/` +
+                         encodeURIComponent(name));
+  if (topo && ((topo.Upstreams || []).length ||
+               (topo.Downstreams || []).length)) {
+    const cell = (s, dir) => {
+      const ok = (s.Intention || {}).Allowed;
+      const health = s.ChecksCritical ? "critical"
+        : s.ChecksWarning ? "warning" : "passing";
+      return `<div class="tpnode">
+        <a href="#service/${encodeURIComponent(s.Name)}">
+          ${esc(s.Name)}</a> ${pill(health)}<br>
+        <span class="dim">${s.InstanceCount} inst ·
+          ${esc(s.Source || "")}</span><br>
+        ${ok ? `<span class="ok">→ allowed</span>`
+             : `<span class="bad">→ denied</span>`}
+        ${(s.Intention || {}).HasExact ?
+          `<span class="dim">(intention)</span>` :
+          `<span class="dim">(default)</span>`}
+      </div>`;
+    };
+    html += `<h3>topology
+      <span class="dim">(protocol ${esc(topo.Protocol)}${
+        topo.TransparentProxy ? " · transparent proxy" : ""})</span>
+      </h3>
+      <div class="topo">
+       <div><h4>upstreams</h4>${(topo.Upstreams || [])
+         .map(s => cell(s, "up")).join("") || `<span class="dim">
+         none</span>`}</div>
+       <div class="tpself"><h4>&nbsp;</h4><div class="tpnode">
+         <b>${esc(name)}</b></div></div>
+       <div><h4>downstreams</h4>${(topo.Downstreams || [])
+         .map(s => cell(s, "down")).join("") || `<span class="dim">
+         none</span>`}</div>
+      </div>`;
   }
   return {watch: `/v1/health/service/${encodeURIComponent(name)}`,
           html};
@@ -472,6 +517,74 @@ async function renderOperator() {
         </td></tr>`;}).join("") + `</table>`};
 }
 
+/* ----------------------------- metrics ------------------------------ */
+// counter history across refreshes: name -> [{t, count}] ring (the
+// reference's metrics-proxy role scoped to THIS agent's
+// /v1/agent/metrics — http_register.go:98)
+const mHist = {};
+function mRecord(counters) {
+  const t = Date.now() / 1000;
+  for (const c of counters) {
+    const h = mHist[c.Name] = mHist[c.Name] || [];
+    h.push({t, count: c.Count});
+    if (h.length > 60) h.shift();
+  }
+}
+function mRate(name) {
+  const h = mHist[name] || [];
+  if (h.length < 2) return null;
+  const a = h[h.length - 2], b = h[h.length - 1];
+  // clamp at 0: a counter reset (agent restart) is not a negative rate
+  return b.t > a.t ? Math.max(0, (b.count - a.count) / (b.t - a.t))
+                   : null;
+}
+function spark(name) {
+  const h = mHist[name] || [];
+  if (h.length < 3) return "";
+  const rates = [];
+  for (let i = 1; i < h.length; i++)
+    rates.push(h[i].t > h[i-1].t ?
+      Math.max(0, (h[i].count - h[i-1].count) /
+                  (h[i].t - h[i-1].t)) : 0);
+  const mx = Math.max(...rates, 1e-9);
+  const pts = rates.map((r, i) =>
+    `${(i / (rates.length - 1)) * 96 + 2},` +
+    `${18 - (r / mx) * 16}`).join(" ");
+  return `<svg width="100" height="20" class="spark">
+    <polyline points="${pts}" fill="none"
+      stroke="var(--acc)" stroke-width="1.5"/></svg>`;
+}
+async function renderMetrics() {
+  const m = await get("/v1/agent/metrics");
+  if (!m) return {html: `<p class="dim">metrics unavailable</p>`};
+  mRecord(m.Counters || []);
+  const fmt = (v) => v == null ? `<span class="dim">—</span>`
+    : v >= 100 ? v.toFixed(0) : v.toFixed(2);
+  let html = `<p class="dim">sampled ${esc(m.Timestamp)} ·
+    refreshes every 7s ·
+    <a href="/v1/agent/metrics?format=prometheus">prometheus text</a>
+    </p>`;
+  html += `<h3>counters</h3>
+    <table><tr><th>Name</th><th>Count</th><th>Rate/s</th>
+    <th>Trend</th></tr>` + (m.Counters || []).map(c =>
+    `<tr><td><code>${esc(c.Name)}</code></td><td>${c.Count}</td>
+     <td>${fmt(mRate(c.Name))}</td>
+     <td>${spark(c.Name)}</td></tr>`).join("") + `</table>`;
+  if ((m.Gauges || []).length)
+    html += `<h3>gauges</h3><table><tr><th>Name</th><th>Value</th>
+      </tr>` + m.Gauges.map(g =>
+      `<tr><td><code>${esc(g.Name)}</code></td><td>${g.Value}</td>
+       </tr>`).join("") + `</table>`;
+  if ((m.Samples || []).length)
+    html += `<h3>samples <span class="dim">(ms)</span></h3>
+      <table><tr><th>Name</th><th>Count</th><th>Mean</th><th>Min</th>
+      <th>Max</th></tr>` + m.Samples.map(s =>
+      `<tr><td><code>${esc(s.Name)}</code></td><td>${s.Count}</td>
+       <td>${s.Mean}</td><td>${s.Min}</td><td>${s.Max}</td>
+       </tr>`).join("") + `</table>`;
+  return {html};
+}
+
 /* ------------------------------ router ------------------------------ */
 const views = {
   services: () => renderServices(),
@@ -487,6 +600,7 @@ const views = {
             : renderACL(),
   mesh: () => renderMesh(),
   operator: () => renderOperator(),
+  metrics: () => renderMetrics(),
 };
 async function liveWatch(url, myGen) {
   // blocking-query loop: ride X-Consul-Index so the view re-renders

@@ -1,0 +1,151 @@
+"""Service topology: upstream/downstream derivation from proxy
+registrations + intentions (VERDICT r4 #6).
+
+Reference behavior: agent/consul/state/catalog.go ServiceTopology:2870
+(registration upstreams/downstreams, tproxy-gated intention edges),
+state/intention.go IntentionTopology:944 (candidate decisions),
+agent/ui_endpoint.go UIServiceTopology + agent/http_register.go:104,
+agent/cache-types/intention_upstreams.go.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from consul_tpu.agent import Agent
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.config import GossipConfig, SimConfig
+
+
+def _mesh_store():
+    st = StateStore()
+    st.register_node("n1", "10.0.0.1")
+    for svc, port in (("web", 80), ("api", 81), ("db", 82),
+                      ("billing", 83)):
+        st.register_service("n1", f"{svc}-1", svc, port=port)
+    # web's sidecar lists api as an upstream (registration edge)
+    st.register_service(
+        "n1", "web-sidecar-proxy", "web-sidecar-proxy", port=21000,
+        kind="connect-proxy",
+        proxy={"destination_service": "web",
+               "destination_service_id": "web-1",
+               "upstreams": [{"destination_name": "api"}]})
+    st.register_service(
+        "n1", "api-sidecar-proxy", "api-sidecar-proxy", port=21001,
+        kind="connect-proxy",
+        proxy={"destination_service": "api",
+               "destination_service_id": "api-1"})
+    return st
+
+
+def test_registration_edges_and_decisions():
+    st = _mesh_store()
+    st.intention_set("i1", "web", "api", "allow")
+    topo = st.service_topology("api", default_allow=False)
+    downs = {e["name"]: e for e in topo["downstreams"]}
+    assert "web" in downs
+    assert downs["web"]["source"] == "registration"
+    assert downs["web"]["decision"]["Allowed"] is True
+    assert downs["web"]["decision"]["HasExact"] is True
+    # flip to deny: edge remains (it IS registered) but decision flips
+    st.intention_set("i1", "web", "api", "deny")
+    topo = st.service_topology("api", default_allow=False)
+    downs = {e["name"]: e for e in topo["downstreams"]}
+    assert downs["web"]["decision"]["Allowed"] is False
+    # web's upstream view mirrors it
+    topo = st.service_topology("web", default_allow=False)
+    ups = {e["name"]: e for e in topo["upstreams"]}
+    assert ups["api"]["source"] == "registration"
+    assert ups["api"]["decision"]["Allowed"] is False
+
+
+def test_intention_edges_gated_by_transparent_proxy():
+    st = _mesh_store()
+    st.intention_set("i2", "api", "db", "allow")
+    # api's proxy is NOT transparent: the intention-derived upstream
+    # is dropped (catalog.go:3002)
+    topo = st.service_topology("api", default_allow=False)
+    assert all(e["name"] != "db" for e in topo["upstreams"])
+    # make api's proxy transparent: the edge appears
+    st.register_service(
+        "n1", "api-sidecar-proxy", "api-sidecar-proxy", port=21001,
+        kind="connect-proxy",
+        proxy={"destination_service": "api",
+               "destination_service_id": "api-1",
+               "mode": "transparent"})
+    topo = st.service_topology("api", default_allow=False)
+    ups = {e["name"]: e for e in topo["upstreams"]}
+    assert ups["db"]["source"] == "specific-intention"
+    assert topo["transparent_proxy"] is True
+    # db's downstream view shows api (api runs transparent)
+    topo = st.service_topology("db", default_allow=False)
+    downs = {e["name"]: e for e in topo["downstreams"]}
+    assert downs["api"]["source"] == "specific-intention"
+
+
+def test_intention_topology_default_and_wildcard():
+    st = _mesh_store()
+    # default deny: nothing without intentions
+    assert st.intention_topology("web", default_allow=False) == []
+    # default allow: every other app service is a candidate
+    names = {e["name"] for e in
+             st.intention_topology("web", default_allow=True)}
+    assert names == {"api", "db", "billing"}
+    # a */* deny overrides the ACL default (intention.go wildcard)
+    st.intention_set("iw", "*", "*", "deny")
+    assert st.intention_topology("web", default_allow=True) == []
+    st.intention_delete("iw")
+    # specific allow under default deny
+    st.intention_set("ix", "web", "db", "allow")
+    out = st.intention_topology("web", default_allow=False)
+    assert [e["name"] for e in out] == ["db"]
+    assert out[0]["has_exact"] is True
+
+
+def test_http_topology_and_intention_upstreams_routes():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=21))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+
+        def call(method, path, body=None):
+            req = urllib.request.Request(
+                base + path, data=json.dumps(body).encode()
+                if body is not None else None, method=method)
+            return json.loads(
+                urllib.request.urlopen(req, timeout=30).read()
+                or b"null")
+
+        call("PUT", "/v1/agent/service/register",
+             {"Name": "api", "ID": "api-1", "Port": 8181,
+              "Connect": {"SidecarService": {}}})
+        call("PUT", "/v1/agent/service/register", {
+            "Name": "web", "ID": "web-1", "Port": 8080,
+            "Connect": {"SidecarService": {"Proxy": {"Upstreams": [
+                {"DestinationName": "api"}]}}}})
+        call("PUT", "/v1/connect/intentions",
+             {"SourceName": "web", "DestinationName": "api",
+              "Action": "allow"})
+        topo = call("GET", "/v1/internal/ui/service-topology/api")
+        downs = {d["Name"]: d for d in topo["Downstreams"]}
+        assert "web" in downs
+        d = downs["web"]
+        assert d["Intention"]["Allowed"] is True
+        assert d["Intention"]["HasExact"] is True
+        assert d["Source"] == "registration"
+        assert d["InstanceCount"] >= 1
+        topo = call("GET", "/v1/internal/ui/service-topology/web")
+        upnames = [u["Name"] for u in topo["Upstreams"]]
+        assert upnames == ["api"]
+        # intention-upstreams: web may dial api per the intention
+        out = call("GET", "/v1/internal/intention-upstreams/web")
+        assert "api" in out
+        # the UI service page renders the topology section
+        html = urllib.request.urlopen(
+            base + "/ui/", timeout=10).read().decode()
+        assert "service-topology" in html and "tpnode" in html
+    finally:
+        a.stop()
