@@ -50,7 +50,8 @@ class ConfigSnapshot:
                  chains: Optional[Dict[str, dict]] = None,
                  chain_endpoints: Optional[Dict[str, List[dict]]] = None,
                  expose: Optional[dict] = None, mode: str = "",
-                 transparent_proxy: Optional[dict] = None):
+                 transparent_proxy: Optional[dict] = None,
+                 opaque_config: Optional[dict] = None):
         self.proxy_id = proxy_id
         self.service = service
         self.upstreams = upstreams
@@ -84,6 +85,11 @@ class ConfigSnapshot:
         self.expose = expose or {}
         self.mode = mode
         self.transparent_proxy = transparent_proxy or {}
+        # the registration's opaque Proxy.Config merged with central
+        # proxy-defaults (xDS escape hatches live here —
+        # agent/xds/config.go:28,34 envoy_public_listener_json /
+        # envoy_local_cluster_json)
+        self.opaque_config = opaque_config or {}
 
 
 class ProxyState:
@@ -381,7 +387,8 @@ class ProxyState:
                 expose=proxy.get("expose") or {},
                 mode=proxy.get("mode", ""),
                 transparent_proxy=proxy.get("transparent_proxy")
-                or {})
+                or {},
+                opaque_config=proxy.get("config") or {})
             self._cond.notify_all()
         self._sync_health_subs()
 

@@ -227,6 +227,34 @@ def _chain_resolver_nodes(chain: dict) -> List[dict]:
             if n.get("Type") == "resolver" and n.get("Target")]
 
 
+def _escape_hatch(snap, key: str, type_name: str) -> Optional[dict]:
+    """Per-proxy resource override ("escape hatch",
+    agent/xds/config.go:28,34): the operator supplies a COMPLETE
+    resource as a JSON string in the proxy's opaque config
+    (envoy_public_listener_json / envoy_local_cluster_json); it
+    replaces the generated resource wholesale, like the reference's
+    makeListenerFromUserConfig (agent/xds/listeners.go:629).
+
+    Malformed JSON raises — the reference fails xDS generation for the
+    proxy rather than silently shipping the generated resource the
+    operator asked to replace."""
+    import json as _json
+    raw = (getattr(snap, "opaque_config", None) or {}).get(key)
+    if not raw:
+        return None
+    if isinstance(raw, dict):
+        res = dict(raw)       # already-parsed map form is accepted
+    else:
+        try:
+            res = _json.loads(raw)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"invalid {key}: {e}") from None
+        if not isinstance(res, dict):
+            raise ValueError(f"invalid {key}: expected an object")
+    res.setdefault("@type", T + type_name)
+    return res
+
+
 def clusters(snap) -> List[dict]:
     """CDS: one cluster per upstream + the local app cluster
     (agent/xds/clusters.go makeUpstreamCluster/makeAppCluster).
@@ -234,7 +262,7 @@ def clusters(snap) -> List[dict]:
     cluster per chain RESOLVER target
     (makeUpstreamClustersForDiscoveryChain)."""
     td = _trust_domain(snap)
-    out = [{
+    local_app = {
         "@type": T + "envoy.config.cluster.v3.Cluster",
         "name": "local_app",
         "type": "STATIC",
@@ -242,7 +270,10 @@ def clusters(snap) -> List[dict]:
         "load_assignment": _load_assignment("local_app", [
             {"address": "127.0.0.1",
              "port": getattr(snap, "local_port", 0) or 0}]),
-    }]
+    }
+    override = _escape_hatch(snap, "envoy_local_cluster_json",
+                             "envoy.config.cluster.v3.Cluster")
+    out = [override if override is not None else local_app]
     # expose-path clusters: plaintext STATIC clusters to the app's
     # exposed ports (one per distinct local_path_port)
     expose_lpps = sorted({
@@ -447,7 +478,9 @@ def listeners(snap) -> List[dict]:
             ],
         }],
     }
-    out = [public]
+    override = _escape_hatch(snap, "envoy_public_listener_json",
+                             "envoy.config.listener.v3.Listener")
+    out = [override if override is not None else public]
     td = _trust_domain(snap)
     # expose paths: plaintext HTTP listeners that bypass mTLS + RBAC so
     # non-mesh callers (HTTP health checks) can reach specific app

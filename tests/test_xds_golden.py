@@ -194,6 +194,47 @@ def _expose_tproxy_snapshot():
         transparent_proxy={"outbound_listener_port": 15001})
 
 
+def _escape_hatch_snapshot():
+    """Per-proxy resource overrides (agent/xds/config.go:28,34): the
+    operator's envoy_public_listener_json / envoy_local_cluster_json
+    replace the generated public listener and local_app cluster
+    wholesale, and the result still decodes as typed envoy protobufs
+    (NACK-free)."""
+    return ConfigSnapshot(
+        proxy_id="web-sidecar-proxy", service="web",
+        upstreams=[{"destination_name": "db", "local_bind_port": 9191,
+                    "local_bind_address": "127.0.0.1"}],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"db": [
+            {"address": "10.0.0.5", "port": 5432, "node": "n2"}]},
+        intentions=[], default_allow=True, version=12,
+        local_port=8080,
+        opaque_config={
+            "envoy_public_listener_json": json.dumps({
+                "name": "custom_public",
+                "address": {"socket_address": {
+                    "address": "0.0.0.0", "port_value": 19000}},
+                "filter_chains": [{"filters": [{
+                    "name": "envoy.filters.network.tcp_proxy",
+                    "typed_config": {
+                        "@type": "type.googleapis.com/envoy.extensions"
+                                 ".filters.network.tcp_proxy.v3"
+                                 ".TcpProxy",
+                        "stat_prefix": "custom",
+                        "cluster": "local_app"}}]}]}),
+            "envoy_local_cluster_json": json.dumps({
+                "name": "local_app",
+                "type": "STRICT_DNS",
+                "connect_timeout": "2.500s",
+                "load_assignment": {
+                    "cluster_name": "local_app",
+                    "endpoints": [{"lb_endpoints": [{
+                        "endpoint": {"address": {"socket_address": {
+                            "address": "app.internal",
+                            "port_value": 8080}}}}]}]}}),
+        })
+
+
 CASES = {
     "sidecar": _sidecar_snapshot,
     "mesh_gateway": _mesh_gateway_snapshot,
@@ -201,6 +242,7 @@ CASES = {
     "ingress_gateway": _ingress_gateway_snapshot,
     "l7_chain": _l7_chain_snapshot,
     "expose_tproxy": _expose_tproxy_snapshot,
+    "escape_hatch": _escape_hatch_snapshot,
 }
 
 
