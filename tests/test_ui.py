@@ -156,3 +156,23 @@ def test_ui_live_watch_blocking_semantics(agent):
     t.join(timeout=15)
     assert done and done["idx"] > idx and done["t"] < 8.0
     _call(base, "DELETE", f"/v1/connect/intentions/{out['ID']}")
+
+
+def test_ui_metrics_tab(agent):
+    """The metrics tab surfaces /v1/agent/metrics (counters with
+    rates + sparklines, gauges, samples) and links the prometheus
+    exposition — the reference's metrics-proxy role scoped to the
+    local agent (agent/http_register.go:98)."""
+    base = agent.http_address
+    html = urllib.request.urlopen(base + "/ui/", timeout=10) \
+        .read().decode()
+    assert '"metrics"' in html                  # tab registered
+    assert "renderMetrics" in html
+    assert "format=prometheus" in html
+    # the data source the tab reads is live and carries counters
+    m = json.loads(urllib.request.urlopen(
+        base + "/v1/agent/metrics", timeout=10).read())
+    assert isinstance(m["Counters"], list)
+    # at least the http counters exist after our own requests
+    names = {c["Name"] for c in m["Counters"]}
+    assert any("http" in n for n in names), names
