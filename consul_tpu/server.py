@@ -82,6 +82,7 @@ class Server:
         self._fwd_q: list = []
         self._fwd_thread = None
         self._fwd_running = False
+        self._fwd_closed = False
         self.tls = None
         self._bootstrap_token = None
         # auto-config: auth-method name that validates intro JWTs
@@ -160,6 +161,9 @@ class Server:
             self._listener = None
         with self._fwd_cv:
             self._fwd_running = False
+            # a write racing stop() must not resurrect the forwarder
+            # (it would spin forever with nothing left to join it)
+            self._fwd_closed = True
             self._fwd_cv.notify_all()
         if self._fwd_thread is not None:
             self._fwd_thread.join(timeout=2.0)
@@ -183,6 +187,8 @@ class Server:
                 "result": None, "error": None,
                 "deadline": time.time() + timeout}
         with self._fwd_cv:
+            if self._fwd_closed:
+                raise NoLeaderError("server RPC is closed")
             if not self._fwd_running:
                 self._fwd_running = True
                 self._fwd_thread = threading.Thread(
