@@ -95,6 +95,29 @@ class Authorizer:
     def node_read(self, name: str) -> bool:
         return self._allow("node", name, READ)
 
+    def _read_all(self, resource: str) -> bool:
+        """True iff EVERY possible name of `resource` resolves to >=
+        read (the reference's ServiceReadAll/NodeReadAll,
+        acl/authorizer.go).  The resolution function is piecewise
+        constant with breakpoints at rule names, so probing each rule's
+        name, a point just inside each prefix region, and the
+        no-rule-matches default region covers the whole domain — a
+        broad prefix grant with one explicit deny correctly fails."""
+        probes = {"\x00__default_region__"}
+        for r in self._rules:
+            if r.resource != resource:
+                continue
+            probes.add(r.name)
+            if not r.exact:
+                probes.add(r.name + "\x00")
+        return all(self._allow(resource, n, READ) for n in probes)
+
+    def service_read_all(self) -> bool:
+        return self._read_all("service")
+
+    def node_read_all(self) -> bool:
+        return self._read_all("node")
+
     def node_write(self, name: str) -> bool:
         return self._allow("node", name, WRITE)
 

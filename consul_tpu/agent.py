@@ -76,6 +76,12 @@ class Agent:
         # half-populated recursor list mid-reload
         self.dns.recursors = [parse_recursor(r) for r in rc.recursors]
         self.dns.recursor_timeout = rc.dns_recursor_timeout
+        # ui_config.metrics_proxy is reloadable (the reference stores
+        # it in an atomic.Value for exactly this — ui_endpoint.go:591)
+        import json as _json
+        self.api.ui_metrics_proxy = _json.loads(
+            rc.ui_metrics_proxy_json) if rc.ui_metrics_proxy_json \
+            else {}
         new_sids, new_cids = set(), set()
         for svc in rc.services:
             name = svc.get("Name") or svc.get("name")

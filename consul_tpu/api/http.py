@@ -154,6 +154,9 @@ class ApiServer:
         # (agent/txn_endpoint.go maxTxnOps); both reject with 413
         # BEFORE anything reaches the replicated log
         self.kv_max_value_size = 512 * 1024
+        # ui_config.metrics_proxy (reloadable): {base_url,
+        # path_allowlist, add_headers} — empty dict = disabled
+        self.ui_metrics_proxy: dict = {}
         self.txn_max_ops = 64
         # guards the per-proxy xDS delta payload caches: handler
         # threads race on insert/evict (ThreadingHTTPServer)
@@ -1870,6 +1873,91 @@ def _make_handler(srv: ApiServer):
                        if self.authz.service_read(r["Name"])]
                 self._send(self._filtered(q, out), index=idx,
                            extra_headers=self._cache_headers(state))
+                return True
+            if path.startswith("/v1/internal/ui/metrics-proxy/") \
+                    and verb == "GET":
+                # reverse proxy to the configured metrics provider
+                # (agent/http_register.go:98, agent/ui_endpoint.go
+                # UIMetricsProxy): path under the prefix appends to
+                # base_url, is normalized against traversal, and must
+                # match the allowlist exactly; the caller's token never
+                # leaves this agent; add_headers are injected (e.g.
+                # provider auth).  Requires read on all nodes+services
+                # like the reference (metrics can leak their names).
+                cfg = srv.ui_metrics_proxy or {}
+                if not cfg.get("base_url"):
+                    self._err(404, "Metrics proxy is not enabled")
+                    return True
+                if not (self.authz.node_read_all()
+                        and self.authz.service_read_all()):
+                    return self._forbid()
+                import posixpath
+                import urllib.error
+                import urllib.request
+                # allowlist applies to the SUB-path (normalized
+                # against traversal) BEFORE joining base_url, so a
+                # base_url with its own path prefix
+                # (http://prom:9090/prometheus) still works
+                sub = posixpath.normpath(
+                    path[len("/v1/internal/ui/metrics-proxy"):])
+                if sub not in (cfg.get("path_allowlist") or []):
+                    self._err(403, f"path {sub!r} is not in the "
+                                   f"metrics proxy allowlist")
+                    return True
+                url = cfg["base_url"] + sub
+                # rebuild the query from the RAW string so repeated
+                # params (prometheus match[]=a&match[]=b) survive; the
+                # caller's ACL token must not reach the provider on
+                # ANY auth path (?token= included)
+                raw_q = urllib.parse.urlparse(self.path).query
+                pairs = [(k, v) for k, v in urllib.parse.parse_qsl(
+                    raw_q, keep_blank_values=True) if k != "token"]
+                qs = urllib.parse.urlencode(pairs)
+                if qs:
+                    url += "?" + qs
+                req = urllib.request.Request(url, method="GET")
+                for h in cfg.get("add_headers") or []:
+                    req.add_header(h["name"], h["value"])
+
+                class _NoRedirect(urllib.request.HTTPRedirectHandler):
+                    # following a provider redirect would re-send the
+                    # configured auth header to an arbitrary host
+                    # OUTSIDE the allowlist (SSRF + credential
+                    # forwarding); refuse instead
+                    def redirect_request(self, *a, **kw):
+                        return None
+
+                opener = urllib.request.build_opener(_NoRedirect())
+                cap = 4 * 1024 * 1024
+                try:
+                    with opener.open(req, timeout=10) as r:
+                        body = r.read(cap + 1)
+                        if len(body) > cap:
+                            # a silently truncated 200 would hand the
+                            # UI a cut-off JSON body
+                            self._err(502, "metrics provider response "
+                                           "exceeds the 4 MiB proxy "
+                                           "cap")
+                            return True
+                        ctype = r.headers.get(
+                            "Content-Type", "application/json")
+                except urllib.error.HTTPError as e:
+                    if 300 <= e.code < 400:
+                        self._err(502, "metrics provider answered a "
+                                       "redirect; refusing to follow")
+                    else:
+                        self._err(e.code,
+                                  f"metrics provider: {e.reason}")
+                    return True
+                except (urllib.error.URLError, OSError) as e:
+                    self._err(502, f"metrics provider unreachable: "
+                                   f"{e}")
+                    return True
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
                 return True
             m = re.fullmatch(
                 r"/v1/internal/ui/service-topology/(.+)", path)

@@ -29,7 +29,8 @@ import re
 from typing import Any, Dict, List, Optional, Tuple
 
 # RuntimeConfig FIELD names that reload applies without a restart
-RELOADABLE = {"log_level", "services", "checks", "dns_only_passing",
+RELOADABLE = {"ui_metrics_proxy_json",
+              "log_level", "services", "checks", "dns_only_passing",
               "dns_node_ttl", "dns_service_ttl", "dns_domain",
               "recursors", "dns_recursor_timeout"}
 
@@ -219,6 +220,10 @@ class RuntimeConfig:
     # KVMaxValueSize; txn_endpoint.go maxTxnOps)
     kv_max_value_size: int = 512 * 1024
     txn_max_ops: int = 64
+    # ui_config.metrics_proxy (config/config.go:837 RawUIMetricsProxy):
+    # {base_url, path_allowlist, add_headers:[{name,value}]}, frozen as
+    # JSON so the config stays hashable.  Empty = proxy disabled.
+    ui_metrics_proxy_json: str = ""
     # static service/check definitions (lists of dicts, agent JSON shapes)
     services: Tuple[dict, ...] = ()
     checks: Tuple[dict, ...] = ()
@@ -432,6 +437,8 @@ class Builder:
                 "kv_max_value_size", 512 * 1024)),
             txn_max_ops=int((m.get("limits") or {}).get(
                 "txn_max_ops", 64)),
+            ui_metrics_proxy_json=_metrics_proxy_json(
+                (m.get("ui_config") or {}).get("metrics_proxy") or {}),
             dns_recursor_timeout=float(
                 _seconds(dnscfg.get("recursor_timeout", 2.0)) or 2.0),
             services=tuple(m.get("services") or []),
@@ -439,6 +446,27 @@ class Builder:
             raw=freeze({k: json.dumps(v, sort_keys=True)
                         for k, v in m.items()}),
         )
+
+
+def _metrics_proxy_json(mp: dict) -> str:
+    """Normalize ui_config.metrics_proxy; the prometheus default
+    allowlist applies when a base_url is set with no explicit list
+    (config/builder.go:1117-1122)."""
+    base = str(mp.get("base_url", "") or "")
+    if not base:
+        return ""
+    raw_allow = mp.get("path_allowlist")
+    if raw_allow is None:
+        # prometheus default ONLY when unset — an explicit [] is an
+        # operator locking the proxy down, not asking for defaults
+        raw_allow = ["/api/v1/query", "/api/v1/query_range"]
+    allow = [str(p) for p in raw_allow]
+    headers = [{"name": str(h.get("name", "")),
+                "value": str(h.get("value", ""))}
+               for h in mp.get("add_headers") or [] if h.get("name")]
+    return json.dumps({"base_url": base.rstrip("/"),
+                       "path_allowlist": allow,
+                       "add_headers": headers}, sort_keys=True)
 
 
 def load(files: List[str] = (), dirs: List[str] = (),

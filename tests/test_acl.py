@@ -509,3 +509,36 @@ def test_node_identity_and_dc_scoping(acl_agent):
     with pytest.raises(ApiError) as e:
         root.acl_token_create(node_identities=[{"NodeName": "n"}])
     assert e.value.code == 400
+
+
+def test_read_all_semantics():
+    """service_read_all/node_read_all (the reference's
+    ServiceReadAll/NodeReadAll): a broad prefix grant with one
+    explicit deny is NOT read-all; clean broad grants are."""
+    from consul_tpu.acl.authorizer import (Authorizer,
+                                           ManagementAuthorizer)
+    from consul_tpu.acl.policy import parse
+
+    def authz(hcl, default="deny"):
+        return Authorizer(parse(hcl), default_policy=default)
+
+    # broad prefix read -> read-all
+    a = authz('service_prefix "" { policy = "read" }')
+    assert a.service_read_all()
+    # broad grant + one explicit deny -> NOT read-all
+    a = authz('service_prefix "" { policy = "read" }\n'
+              'service "payments" { policy = "deny" }')
+    assert not a.service_read_all()
+    assert a.service_read("web") and not a.service_read("payments")
+    # a deny on a sub-PREFIX also breaks read-all
+    a = authz('service_prefix "" { policy = "read" }\n'
+              'service_prefix "secret-" { policy = "deny" }')
+    assert not a.service_read_all()
+    # permissive default (allow_all maps default-allow to write)
+    assert authz("", default="write").node_read_all()
+    # default deny with no rules -> not read-all
+    assert not authz("", default="deny").node_read_all()
+    # write rules imply read
+    a = authz('node_prefix "" { policy = "write" }')
+    assert a.node_read_all()
+    assert ManagementAuthorizer().service_read_all()

@@ -187,3 +187,24 @@ def test_reload_removes_dropped_service(tmp_path):
                                    for s in a.local.services().values()}
     finally:
         a.stop()
+
+
+def test_ui_metrics_proxy_config(tmp_path):
+    """ui_config.metrics_proxy parses with the prometheus default
+    allowlist when none is given (config/builder.go:1117-1122)."""
+    import json as _json
+    f = tmp_path / "ui.json"
+    f.write_text(_json.dumps({
+        "ui_config": {"metrics_proxy": {
+            "base_url": "http://127.0.0.1:9090/",
+            "add_headers": [{"name": "Authorization",
+                             "value": "Bearer x"}]}}}))
+    rc = rcfg.load(files=[str(f)])
+    mp = _json.loads(rc.ui_metrics_proxy_json)
+    assert mp["base_url"] == "http://127.0.0.1:9090"
+    assert mp["path_allowlist"] == ["/api/v1/query",
+                                    "/api/v1/query_range"]
+    assert mp["add_headers"][0]["name"] == "Authorization"
+    # no base_url = disabled
+    rc2 = rcfg.load()
+    assert rc2.ui_metrics_proxy_json == ""

@@ -176,3 +176,142 @@ def test_ui_metrics_tab(agent):
     # at least the http counters exist after our own requests
     names = {c["Name"] for c in m["Counters"]}
     assert any("http" in n for n in names), names
+
+
+def test_ui_metrics_proxy(agent):
+    """/v1/internal/ui/metrics-proxy/ (agent/http_register.go:98,
+    ui_endpoint.go UIMetricsProxy): path under the prefix appends to
+    the configured base_url, normalizes against traversal, must match
+    the allowlist exactly, injects add_headers, and never forwards the
+    caller's token."""
+    import http.server
+    import threading
+
+    seen = {}
+
+    class FakeProm(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            seen["path"] = self.path
+            seen["auth"] = self.headers.get("Authorization")
+            seen["token"] = self.headers.get("X-Consul-Token")
+            body = b'{"status":"success","data":{"result":[]}}'
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    prom = http.server.HTTPServer(("127.0.0.1", 0), FakeProm)
+    threading.Thread(target=prom.serve_forever, daemon=True).start()
+    base = agent.http_address
+    try:
+        # disabled -> 404
+        try:
+            urllib.request.urlopen(
+                base + "/v1/internal/ui/metrics-proxy/api/v1/query",
+                timeout=10)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        agent.api.ui_metrics_proxy = {
+            "base_url": f"http://127.0.0.1:{prom.server_address[1]}",
+            "path_allowlist": ["/api/v1/query", "/api/v1/query_range"],
+            "add_headers": [{"name": "Authorization",
+                             "value": "Bearer prom-secret"}]}
+        # allowed path proxies; provider sees add_headers, NOT tokens
+        req = urllib.request.Request(
+            base + "/v1/internal/ui/metrics-proxy/api/v1/query"
+                   "?query=up")
+        req.add_header("X-Consul-Token", "caller-token")
+        out = json.loads(urllib.request.urlopen(req, timeout=10)
+                         .read())
+        assert out["status"] == "success"
+        assert seen["path"] == "/api/v1/query?query=up"
+        assert seen["auth"] == "Bearer prom-secret"
+        assert seen["token"] is None
+        # ?token= auth path: the ACL secret must not reach the
+        # provider as a query param either
+        urllib.request.urlopen(
+            base + "/v1/internal/ui/metrics-proxy/api/v1/query"
+                   "?query=up&token=secret-acl", timeout=10).read()
+        assert "token" not in seen["path"], seen["path"]
+        # repeated params (prometheus match[]) survive the rebuild
+        urllib.request.urlopen(
+            base + "/v1/internal/ui/metrics-proxy/api/v1/query"
+                   "?match%5B%5D=up&match%5B%5D=node_load1",
+            timeout=10).read()
+        assert seen["path"].count("match%5B%5D") == 2, seen["path"]
+        # path outside the allowlist -> 403, even via traversal
+        for p in ("api/v1/admin", "api/v1/query/../admin"):
+            try:
+                urllib.request.urlopen(
+                    base + "/v1/internal/ui/metrics-proxy/" + p,
+                    timeout=10)
+                assert False, f"expected 403 for {p}"
+            except urllib.error.HTTPError as e:
+                assert e.code == 403, (p, e.code)
+        # a base_url carrying its own path prefix still works: the
+        # allowlist applies to the SUB-path, not the joined path
+        agent.api.ui_metrics_proxy = dict(
+            agent.api.ui_metrics_proxy,
+            base_url=f"http://127.0.0.1:{prom.server_address[1]}"
+                     "/prometheus")
+        urllib.request.urlopen(
+            base + "/v1/internal/ui/metrics-proxy/api/v1/query",
+            timeout=10).read()
+        assert seen["path"] == "/prometheus/api/v1/query"
+        # an explicit empty allowlist denies everything
+        agent.api.ui_metrics_proxy = dict(
+            agent.api.ui_metrics_proxy, path_allowlist=[])
+        try:
+            urllib.request.urlopen(
+                base + "/v1/internal/ui/metrics-proxy/api/v1/query",
+                timeout=10)
+            assert False, "expected 403"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+    finally:
+        agent.api.ui_metrics_proxy = {}
+        prom.shutdown()
+        prom.server_close()
+
+
+def test_ui_metrics_proxy_refuses_redirects(agent):
+    """A provider redirect would re-send the configured auth header to
+    an arbitrary host outside the allowlist (SSRF); the proxy refuses
+    with 502 instead of following."""
+    import http.server
+    import threading
+
+    class Redirector(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(302)
+            self.send_header("Location", "http://127.0.0.1:1/steal")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    prom = http.server.HTTPServer(("127.0.0.1", 0), Redirector)
+    threading.Thread(target=prom.serve_forever, daemon=True).start()
+    base = agent.http_address
+    try:
+        agent.api.ui_metrics_proxy = {
+            "base_url": f"http://127.0.0.1:{prom.server_address[1]}",
+            "path_allowlist": ["/api/v1/query"]}
+        try:
+            urllib.request.urlopen(
+                base + "/v1/internal/ui/metrics-proxy/api/v1/query",
+                timeout=10)
+            assert False, "expected 502"
+        except urllib.error.HTTPError as e:
+            assert e.code == 502
+            assert b"redirect" in e.read()
+    finally:
+        agent.api.ui_metrics_proxy = {}
+        prom.shutdown()
+        prom.server_close()
