@@ -33,7 +33,9 @@ import json
 import re
 import threading
 import time
+import urllib.error
 import urllib.parse
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler
 from typing import Optional
@@ -1892,8 +1894,6 @@ def _make_handler(srv: ApiServer):
                         and self.authz.service_read_all()):
                     return self._forbid()
                 import posixpath
-                import urllib.error
-                import urllib.request
                 # allowlist applies to the SUB-path (normalized
                 # against traversal) BEFORE joining base_url, so a
                 # base_url with its own path prefix
@@ -1969,12 +1969,21 @@ def _make_handler(srv: ApiServer):
                 svc = urllib.parse.unquote(m.group(1))
                 if not self.authz.service_read(svc):
                     return self._forbid()
+                kind = q.get("kind", "")
+                if kind not in ("", "ingress-gateway"):
+                    # the reference 400s other kinds
+                    # (ui_endpoint.go UIServiceTopology)
+                    self._err(400, f"Unsupported service kind "
+                                   f"{kind!r}")
+                    return True
                 topo, idx, state = self._cache_or_live(
                     "service_topology", svc, q,
                     lambda: store.service_topology(
-                        svc, default_allow=srv.default_allow),
+                        svc, default_allow=srv.default_allow,
+                        kind=kind),
                     ("services", ""), ("intentions", ""),
-                    ("nodechecks", ""))
+                    ("nodechecks", ""), ("config", ""),
+                    cacheable=(kind == ""))
 
                 def summarize(edge):
                     # ServiceTopologySummary: health rollup + the

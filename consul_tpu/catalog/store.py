@@ -1218,7 +1218,8 @@ class StateStore:
         return out
 
     def service_topology(self, name: str,
-                         default_allow: bool = False) -> dict:
+                         default_allow: bool = False,
+                         kind: str = "") -> dict:
         """Upstream/downstream topology of a mesh service
         (state/catalog.go ServiceTopology:2870, served by
         Internal.ServiceTopology and /v1/internal/ui/service-topology).
@@ -1235,6 +1236,39 @@ class StateStore:
         """
         from consul_tpu.connect import intentions as imod
         from consul_tpu.discoverychain import service_protocol
+        if kind == "ingress-gateway":
+            # an ingress gateway's upstreams are the services its
+            # config entry binds (catalog.go ServiceTopology
+            # ServiceKindIngressGateway; gateway-services mapping);
+            # external traffic means no mesh downstreams
+            from consul_tpu import gateways as gmod
+            with self._lock:
+                ints = [dict(v) for v in self._intentions.values()]
+            # per-kind bindings only: a same-named terminating gateway
+            # must not leak its services into the ingress view
+            bound = gmod.resolve_wildcard(
+                self, [r for r in gmod.gateway_services(self, name)
+                       if r.get("GatewayKind") == "ingress-gateway"])
+            ups = sorted({r["Service"] for r in bound
+                          if r.get("Service")})
+
+            def gw_decision(dst: str) -> dict:
+                allowed, _ = imod.authorize(ints, name, dst,
+                                            default_allow)
+                return {"Allowed": allowed, "HasPermissions": False,
+                        "HasExact": any(i["source"] == name
+                                        and i["destination"] == dst
+                                        for i in ints),
+                        "ExternalSource": ""}
+
+            return {
+                "protocol": service_protocol(self, name),
+                "transparent_proxy": False,
+                "upstreams": [{"name": n, "source": "routing-config",
+                               "decision": gw_decision(n)}
+                              for n in ups],
+                "downstreams": [],
+            }
         with self._lock:
             ints = [dict(v) for v in self._intentions.values()]
             proxies = [v for v in self._services.values()

@@ -143,9 +143,37 @@ def test_http_topology_and_intention_upstreams_routes():
         # intention-upstreams: web may dial api per the intention
         out = call("GET", "/v1/internal/intention-upstreams/web")
         assert "api" in out
+        # unsupported kinds 400 like the reference
+        try:
+            call("GET", "/v1/internal/ui/service-topology/api"
+                        "?kind=connect-proxy")
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
         # the UI service page renders the topology section
         html = urllib.request.urlopen(
             base + "/ui/", timeout=10).read().decode()
         assert "service-topology" in html and "tpnode" in html
     finally:
         a.stop()
+
+
+def test_ingress_gateway_topology_kind():
+    """?kind=ingress-gateway: the gateway's upstreams are its bound
+    services (source routing-config), with intention decisions; no
+    mesh downstreams (catalog.go ServiceTopology ingress branch)."""
+    st = _mesh_store()
+    st.config_entry_set("ingress-gateway", "igw", {
+        "kind": "ingress-gateway", "name": "igw",
+        "listeners": [{"port": 8080, "protocol": "http",
+                       "services": [{"name": "api"},
+                                    {"name": "db"}]}]})
+    st.intention_set("ig1", "igw", "api", "allow")
+    topo = st.service_topology("igw", default_allow=False,
+                               kind="ingress-gateway")
+    ups = {e["name"]: e for e in topo["upstreams"]}
+    assert set(ups) == {"api", "db"}
+    assert all(e["source"] == "routing-config" for e in ups.values())
+    assert ups["api"]["decision"]["Allowed"] is True
+    assert ups["db"]["decision"]["Allowed"] is False
+    assert topo["downstreams"] == []
