@@ -205,6 +205,7 @@ class Server:
         return item["result"]
 
     def _forward_loop(self) -> None:
+        from consul_tpu import telemetry
         from consul_tpu.rpc import RpcError
         while True:
             with self._fwd_cv:
@@ -244,6 +245,9 @@ class Server:
                     it["error"] = err
                     it["event"].set()
                 continue
+            telemetry.incr_counter(("rpc", "forward", "rounds"))
+            telemetry.incr_counter(("rpc", "forward", "items"),
+                                   len(items))
             try:
                 if len(items) == 1:
                     it = items[0]

@@ -9,6 +9,7 @@ agent/cache-types/intention_upstreams.go.
 """
 
 import json
+import time
 import urllib.error
 import urllib.request
 
@@ -17,6 +18,14 @@ import pytest
 from consul_tpu.agent import Agent
 from consul_tpu.catalog.store import StateStore
 from consul_tpu.config import GossipConfig, SimConfig
+
+
+def _call(base, method, path, body=None):
+    """One HTTP request against a live agent; returns the response."""
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode()
+        if body is not None else None, method=method)
+    return urllib.request.urlopen(req, timeout=30)
 
 
 def _mesh_store():
@@ -112,12 +121,8 @@ def test_http_topology_and_intention_upstreams_routes():
         base = a.http_address
 
         def call(method, path, body=None):
-            req = urllib.request.Request(
-                base + path, data=json.dumps(body).encode()
-                if body is not None else None, method=method)
-            return json.loads(
-                urllib.request.urlopen(req, timeout=30).read()
-                or b"null")
+            return json.loads(_call(base, method, path, body).read()
+                              or b"null")
 
         call("PUT", "/v1/agent/service/register",
              {"Name": "api", "ID": "api-1", "Port": 8181,
@@ -177,3 +182,46 @@ def test_ingress_gateway_topology_kind():
     assert ups["api"]["decision"]["Allowed"] is True
     assert ups["db"]["decision"]["Allowed"] is False
     assert topo["downstreams"] == []
+
+
+def test_topology_blocking_query_wakes_on_intention_change():
+    """The topology route's watch set includes the intentions topic:
+    a parked ?index= long-poll wakes when an intention flips (the
+    UI's live-update path for the topology section)."""
+    import threading
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0,
+                        seed=22))
+    a.start(tick_seconds=0.0, reconcile_interval=0.5)
+    try:
+        base = a.http_address
+
+        def call(method, path, body=None):
+            return _call(base, method, path, body)
+
+        call("PUT", "/v1/agent/service/register",
+             {"Name": "api", "ID": "api-1", "Port": 8181,
+              "Connect": {"SidecarService": {}}})
+        r = call("GET", "/v1/internal/ui/service-topology/api")
+        idx = int(r.headers["X-Consul-Index"])
+        r.read()
+        done = {}
+        t0 = time.time()
+
+        def poll():
+            rr = call("GET", "/v1/internal/ui/service-topology/api"
+                             f"?index={idx}&wait=10s")
+            done["idx"] = int(rr.headers["X-Consul-Index"])
+            done["t"] = time.time() - t0
+            rr.read()
+
+        th = threading.Thread(target=poll)
+        th.start()
+        time.sleep(0.3)
+        call("PUT", "/v1/connect/intentions",
+             {"SourceName": "web", "DestinationName": "api",
+              "Action": "deny"})
+        th.join(timeout=15)
+        assert done and done["idx"] > idx and done["t"] < 8.0, done
+    finally:
+        a.stop()
