@@ -685,7 +685,11 @@ def _make_handler(srv: ApiServer):
                             "DestinationName", ""),
                          "local_bind_port": u.get("LocalBindPort", 0),
                          "local_bind_address": u.get(
-                             "LocalBindAddress", "127.0.0.1")}
+                             "LocalBindAddress", "127.0.0.1"),
+                         # opaque per-upstream config (escape hatches
+                         # envoy_listener_json/envoy_cluster_json ride
+                         # here — agent/xds/config.go)
+                         "config": u.get("Config") or {}}
                         for u in proxy_raw.get("Upstreams") or []],
                 }
                 store.register_service(
@@ -3571,7 +3575,11 @@ def _proxy_json(proxy: dict) -> dict:
             {"DestinationName": u.get("destination_name", ""),
              "LocalBindPort": u.get("local_bind_port", 0),
              "LocalBindAddress": u.get("local_bind_address",
-                                       "127.0.0.1")}
+                                       "127.0.0.1"),
+             # the opaque per-upstream Config (escape hatches) must
+             # round-trip: read-modify-write registration flows would
+             # otherwise silently drop it
+             **({"Config": u["config"]} if u.get("config") else {})}
             for u in proxy.get("upstreams") or []],
     }
     if proxy.get("mode"):

@@ -111,6 +111,31 @@ def merged_proxy(store, proxy: dict, service_name: str,
         out["expose"] = _snake_expose(resolved["Expose"])
     if not out.get("mesh_gateway"):
         out["mesh_gateway"] = resolved["MeshGateway"]
+    # per-upstream central defaults/overrides (service-defaults
+    # upstream_config, structs.UpstreamConfiguration) merge UNDER each
+    # upstream's own opaque config — this is how centrally-set
+    # escape hatches (envoy_listener_json/envoy_cluster_json) and
+    # limits reach xDS without touching every registration.  Snake
+    # keys here (the consumers read snake); the CamelCase view lives
+    # in resolve_service_config's UpstreamConfigs.
+    sd = store.config_entry_get("service-defaults", service_name) or {}
+    uc = sd.get("upstream_config") or {}
+    uc_defaults = {k: v for k, v in (uc.get("defaults") or {}).items()
+                   if k != "name"}
+    uc_over = {o.get("name", ""): {k: v for k, v in o.items()
+                                   if k != "name"}
+               for o in uc.get("overrides") or []}
+    if uc_defaults or uc_over:
+        merged_ups = []
+        for up in out.get("upstreams") or []:
+            up = dict(up)               # never mutate the store's row
+            central = dict(uc_defaults)
+            central.update(uc_over.get(
+                up.get("destination_name", ""), {}))
+            central.update(up.get("config") or {})   # registration wins
+            up["config"] = central
+            merged_ups.append(up)
+        out["upstreams"] = merged_ups
     return out
 
 

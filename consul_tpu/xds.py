@@ -227,19 +227,19 @@ def _chain_resolver_nodes(chain: dict) -> List[dict]:
             if n.get("Type") == "resolver" and n.get("Target")]
 
 
-def _escape_hatch(snap, key: str, type_name: str) -> Optional[dict]:
-    """Per-proxy resource override ("escape hatch",
-    agent/xds/config.go:28,34): the operator supplies a COMPLETE
-    resource as a JSON string in the proxy's opaque config
-    (envoy_public_listener_json / envoy_local_cluster_json); it
-    replaces the generated resource wholesale, like the reference's
-    makeListenerFromUserConfig (agent/xds/listeners.go:629).
+def _escape_from_cfg(cfg: dict, key: str,
+                     type_name: str) -> Optional[dict]:
+    """Resource override ("escape hatch", agent/xds/config.go): the
+    operator supplies a COMPLETE resource as a JSON string in an
+    opaque config map; it replaces the generated resource wholesale,
+    like the reference's makeListenerFromUserConfig
+    (agent/xds/listeners.go:629).
 
     Malformed JSON raises — the reference fails xDS generation for the
     proxy rather than silently shipping the generated resource the
     operator asked to replace."""
     import json as _json
-    raw = (getattr(snap, "opaque_config", None) or {}).get(key)
+    raw = (cfg or {}).get(key)
     if not raw:
         return None
     if isinstance(raw, dict):
@@ -253,6 +253,21 @@ def _escape_hatch(snap, key: str, type_name: str) -> Optional[dict]:
             raise ValueError(f"invalid {key}: expected an object")
     res.setdefault("@type", T + type_name)
     return res
+
+
+def _escape_hatch(snap, key: str, type_name: str) -> Optional[dict]:
+    """Per-PROXY hatch (envoy_public_listener_json /
+    envoy_local_cluster_json in Proxy.Config)."""
+    return _escape_from_cfg(getattr(snap, "opaque_config", None) or {},
+                            key, type_name)
+
+
+def _upstream_escape(up: dict, key: str,
+                     type_name: str) -> Optional[dict]:
+    """Per-UPSTREAM hatch (envoy_listener_json / envoy_cluster_json in
+    the upstream's opaque Config — consumed at listeners.go:102 /
+    clusters.go makeClusterFromUserConfig)."""
+    return _escape_from_cfg(up.get("config") or {}, key, type_name)
 
 
 def clusters(snap) -> List[dict]:
@@ -304,9 +319,23 @@ def clusters(snap) -> List[dict]:
         name = up.get("destination_name", "")
         chain = _upstream_chain(snap, name)
         if chain is None:
-            if name in emitted:
+            # the cluster hatch only applies on the DEFAULT chain —
+            # with a real discovery chain the generated per-target
+            # clusters win (clusters.go: EnvoyClusterJSON is honored
+            # iff chain.IsDefault).  Dedup on the name the resource
+            # actually DECLARES: two clusters sharing a name would
+            # NACK the whole push.
+            override = _upstream_escape(
+                up, "envoy_cluster_json",
+                "envoy.config.cluster.v3.Cluster")
+            cname_out = override.get("name", name) \
+                if override is not None else name
+            if cname_out in emitted:
                 continue
-            emitted.add(name)
+            emitted.add(cname_out)
+            if override is not None:
+                out.append(override)
+                continue
             out.append({
                 "@type": T + "envoy.config.cluster.v3.Cluster",
                 "name": name,
@@ -580,6 +609,14 @@ def listeners(snap) -> List[dict]:
         })
     for up in snap.upstreams:
         name = up.get("destination_name", "")
+        # per-upstream listener hatch replaces the generated listener
+        # wholesale (listeners.go:102 makeListenerFromUserConfig)
+        override = _upstream_escape(
+            up, "envoy_listener_json",
+            "envoy.config.listener.v3.Listener")
+        if override is not None:
+            out.append(override)
+            continue
         filters = _upstream_filters(snap, name, td)
         out.append({
             "@type": T + "envoy.config.listener.v3.Listener",

@@ -319,3 +319,34 @@ def test_app_check_failure_propagates_through_alias_to_connect(agent):
     assert all(r["Service"]["ID"] != "pay-1-sidecar-proxy"
                for r in rows)
     _call(agent, "PUT", "/v1/agent/check/pass/pay-ttl")
+
+
+def test_central_upstream_config_reaches_merged_proxy():
+    """service-defaults upstream_config defaults/overrides merge UNDER
+    each upstream's own opaque config (registration wins) — the path
+    that lets centrally-set per-upstream escape hatches reach xDS
+    (service_manager.go mergeServiceConfig / upstream_config)."""
+    from consul_tpu.catalog.store import StateStore
+    st = StateStore()
+    st.config_entry_set("service-defaults", "web", {
+        "kind": "service-defaults", "name": "web",
+        "upstream_config": {
+            "defaults": {"connect_timeout_ms": 1500},
+            "overrides": [
+                {"name": "cache",
+                 "envoy_cluster_json": "{\"name\":\"cache\"}"},
+                {"name": "db", "connect_timeout_ms": 9000}]}})
+    proxy = {
+        "destination_service": "web",
+        "upstreams": [
+            {"destination_name": "cache", "local_bind_port": 9192},
+            {"destination_name": "db", "local_bind_port": 9193,
+             "config": {"connect_timeout_ms": 250}}]}   # reg wins
+    out = servicemgr.merged_proxy(st, proxy, "web")
+    ups = {u["destination_name"]: u for u in out["upstreams"]}
+    assert ups["cache"]["config"]["envoy_cluster_json"] == \
+        "{\"name\":\"cache\"}"
+    assert ups["cache"]["config"]["connect_timeout_ms"] == 1500
+    assert ups["db"]["config"]["connect_timeout_ms"] == 250
+    # the store's own row was not mutated
+    assert "config" not in proxy["upstreams"][0]

@@ -202,8 +202,41 @@ def _escape_hatch_snapshot():
     (NACK-free)."""
     return ConfigSnapshot(
         proxy_id="web-sidecar-proxy", service="web",
-        upstreams=[{"destination_name": "db", "local_bind_port": 9191,
-                    "local_bind_address": "127.0.0.1"}],
+        upstreams=[
+            {"destination_name": "db", "local_bind_port": 9191,
+             "local_bind_address": "127.0.0.1"},
+            # per-UPSTREAM hatches (agent/xds/config.go): this
+            # upstream's listener AND (default-chain) cluster are
+            # operator-supplied wholesale
+            {"destination_name": "cache", "local_bind_port": 9192,
+             "local_bind_address": "127.0.0.1",
+             "config": {
+                 "envoy_listener_json": json.dumps({
+                     "name": "custom_cache_listener",
+                     "address": {"socket_address": {
+                         "address": "127.0.0.1",
+                         "port_value": 9192}},
+                     "filter_chains": [{"filters": [{
+                         "name":
+                             "envoy.filters.network.tcp_proxy",
+                         "typed_config": {
+                             "@type": "type.googleapis.com/envoy"
+                                      ".extensions.filters.network"
+                                      ".tcp_proxy.v3.TcpProxy",
+                             "stat_prefix": "custom_cache",
+                             "cluster": "cache"}}]}]}),
+                 "envoy_cluster_json": json.dumps({
+                     "name": "cache",
+                     "type": "LOGICAL_DNS",
+                     "connect_timeout": "1s",
+                     "load_assignment": {
+                         "cluster_name": "cache",
+                         "endpoints": [{"lb_endpoints": [{
+                             "endpoint": {"address": {
+                                 "socket_address": {
+                                     "address": "cache.internal",
+                                     "port_value": 6379}}}}]}]}})}},
+        ],
         roots=FAKE_ROOTS, leaf=FAKE_LEAF,
         upstream_endpoints={"db": [
             {"address": "10.0.0.5", "port": 5432, "node": "n2"}]},
