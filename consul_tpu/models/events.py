@@ -42,6 +42,7 @@ class EventParams:
     gossip_nodes: int = 3
     retransmit_limit: int = 16
     expiry_ticks: int = 64
+    p_loss: float = 0.0
     seed: int = 0
 
 
@@ -51,6 +52,7 @@ def make_params(gossip: GossipConfig, sim: SimConfig,
     spread = max(8, 4 * math.ceil(math.log2(sim.n_nodes + 1)))
     return EventParams(
         n_nodes=sim.n_nodes,
+        p_loss=sim.p_loss,
         event_slots=event_slots,
         gossip_nodes=gossip.gossip_nodes,
         retransmit_limit=gossip.retransmit_limit(sim.n_nodes),
@@ -139,7 +141,10 @@ def step(params: EventParams, s: EventState, up: jnp.ndarray,
                                      sender_ok=up, receiver_ok=up & member,
                                      slot_active=s.e_active,
                                      retransmit_limit=min(
-                                         params.retransmit_limit, 127))
+                                         params.retransmit_limit, 127),
+                                     p_loss=params.p_loss,
+                                     key=prng.tick_key(params.seed,
+                                                       s.tick, 6))
         deliver_tick = jnp.where(res.newly, s.tick, s.deliver_tick)
         # Lamport witness: clock jumps past the max ltime delivered this tick
         seen = jnp.where(res.newly, s.e_ltime[None, :], 0)

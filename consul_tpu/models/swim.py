@@ -901,7 +901,9 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
                                  sender_ok=s.up,
                                  receiver_ok=s.up & s.member,
                                  slot_active=s.r_active,
-                                 retransmit_limit=params.retransmit_limit)
+                                 retransmit_limit=params.retransmit_limit,
+                                 p_loss=params.p_loss,
+                                 key=prng.tick_key(params.seed, tick, 5))
     learn_tick = jnp.where(res.newly, tick, s.learn_tick)
     return s.replace(know=res.know, learn_tick=learn_tick,
                      sends_left=res.sends_left)

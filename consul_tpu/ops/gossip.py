@@ -21,8 +21,9 @@ serving budget reproduces push's bounded per-node transmission count
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
+import jax
 import jax.numpy as jnp
 
 from consul_tpu.ops import rolls
@@ -37,16 +38,27 @@ class GossipResult(NamedTuple):
 def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
                 sends_left: jnp.ndarray, sender_ok: jnp.ndarray,
                 receiver_ok: jnp.ndarray, slot_active: jnp.ndarray,
-                retransmit_limit: int) -> GossipResult:
+                retransmit_limit: int,
+                p_loss: float = 0.0,
+                key: Optional[jnp.ndarray] = None) -> GossipResult:
     """One fanout round.
 
     offsets: [G] int32 ring offsets shared by all nodes this tick (node i
     pulls from (i + offsets[g]) % N); sender_ok/receiver_ok: [N] bool;
     slot_active: [S] bool.
+
+    `p_loss` (with `key`) drops whole CONTACTS: gossip piggybacks on
+    one UDP packet per peer per tick, so loss is per (receiver,
+    contact) — all slots in the packet vanish together (memberlist's
+    gossip() sends one compound packet per selected peer).
     """
     fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
     views = rolls.pull_multi(serve, offsets)
+    if p_loss > 0.0 and key is not None:
+        ok = jax.random.bernoulli(key, 1.0 - p_loss,
+                                  (know.shape[0], fanout))       # [N, G]
+        views = [v & ok[:, g:g + 1] for g, v in enumerate(views)]
     got = views[0]
     for v in views[1:]:
         got = got | v

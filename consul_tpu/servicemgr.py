@@ -66,16 +66,23 @@ def resolve_service_config(store, service: str,
     }
     # per-upstream defaults: the upstream's own protocol, overlaid with
     # this service's service-defaults upstream_config overrides
-    # (structs.UpstreamConfiguration)
+    # (structs.UpstreamConfiguration).  The RAW snake block also rides
+    # along (upstream-list independent) so merged_proxy can merge the
+    # SAME central data per upstream without re-querying the store —
+    # cached reads must not mix a stale proxy view with live upstream
+    # config.
     uc = sd.get("upstream_config") or {}
-    uc_defaults = uc.get("defaults") or {}
-    uc_over = {o.get("name", ""): o for o in uc.get("overrides") or []}
+    uc_defaults = {k: v for k, v in (uc.get("defaults") or {}).items()
+                   if k != "name"}
+    uc_over = {o.get("name", ""): {k: v for k, v in o.items()
+                                   if k != "name"}
+               for o in uc.get("overrides") or []}
+    out["UpstreamConfigRaw"] = {"defaults": uc_defaults,
+                                "overrides": uc_over}
     for up in upstreams:
         entry = {"Protocol": service_protocol(store, up)}
         for src in (uc_defaults, uc_over.get(up, {})):
             for k, v in src.items():
-                if k == "name":
-                    continue
                 entry[_camel_key(k)] = v
         out["UpstreamConfigs"][up] = entry
     return out
@@ -116,15 +123,12 @@ def merged_proxy(store, proxy: dict, service_name: str,
     # upstream's own opaque config — this is how centrally-set
     # escape hatches (envoy_listener_json/envoy_cluster_json) and
     # limits reach xDS without touching every registration.  Snake
-    # keys here (the consumers read snake); the CamelCase view lives
-    # in resolve_service_config's UpstreamConfigs.
-    sd = store.config_entry_get("service-defaults", service_name) or {}
-    uc = sd.get("upstream_config") or {}
-    uc_defaults = {k: v for k, v in (uc.get("defaults") or {}).items()
-                   if k != "name"}
-    uc_over = {o.get("name", ""): {k: v for k, v in o.items()
-                                   if k != "name"}
-               for o in uc.get("overrides") or []}
+    # keys here (the consumers read snake); the data comes from the
+    # SAME resolved view as the proxy-level merge above, so a cached
+    # read stays internally consistent.
+    raw = resolved.get("UpstreamConfigRaw") or {}
+    uc_defaults = raw.get("defaults") or {}
+    uc_over = raw.get("overrides") or {}
     if uc_defaults or uc_over:
         merged_ups = []
         for up in out.get("upstreams") or []:
