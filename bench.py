@@ -72,6 +72,13 @@ def main():
     tp = 1 if ok else 0
     precision = tp / max(tp + false_commits, 1)
     f1 = 2 * precision * tp / max(precision + tp, 1e-9)
+    # device-side sim counters (swim.METRIC_NAMES): accumulated inside
+    # the jitted tick, fetched HERE — one readback AFTER the timed
+    # window, so telemetry costs the bench nothing
+    mvec = np.asarray(jax.jit(serf.metrics_vector,
+                              static_argnums=0)(params, s))
+    sim_counters = {name: round(float(v), 4)
+                    for name, v in zip(swim.METRIC_NAMES, mvec)}
     print(json.dumps({
         "metric": "serf_1M_node_crash_convergence_wallclock",
         "value": round(wall, 3),
@@ -79,6 +86,7 @@ def main():
         "vs_baseline": round(TARGET_S / wall, 3) if ok else 0.0,
         "f1": round(f1, 4),
         "false_commits": false_commits,
+        "sim_counters": sim_counters,
     }))
     if not ok:
         print(f"# did not converge: frac={frac} after {ticks} ticks", file=sys.stderr)

@@ -13,7 +13,10 @@ from __future__ import annotations
 import math
 import random
 import threading
+import time
 from typing import Callable, Optional
+
+from consul_tpu import telemetry
 
 SCALE_THRESHOLD = 128          # ae.go:27 scaleThreshold
 DEFAULT_SYNC_INTERVAL = 60.0   # config SyncFrequency equivalent
@@ -80,8 +83,13 @@ class StateSyncer:
 
     def sync_full_now(self) -> int:
         """One blocking full pass (Agent.StartSync's initial sync)."""
+        t0 = time.perf_counter()
         n = self.local.sync_full(self.catalog)
         self.syncs_full += 1
+        # consul.ae.sync{type=full}: the anti-entropy pass the reference
+        # times in agent/ae (StateSyncer full vs triggered partial)
+        telemetry.measure_since(("ae", "sync"), t0,
+                                labels={"type": "full"})
         return n
 
     # ------------------------------------------------------------------ loop
@@ -105,9 +113,13 @@ class StateSyncer:
                     self.sync_full_now()
                     next_full = now + self.full_interval()
                 elif triggered:
+                    t0 = time.perf_counter()
                     self.local.update_sync_state(self.catalog)
                     self.local.sync_changes(self.catalog)
                     self.syncs_partial += 1
+                    telemetry.measure_since(("ae", "sync"), t0,
+                                            labels={"type": "partial"})
             except Exception:
                 self.failures += 1
+                telemetry.incr_counter(("ae", "sync_failed"))
                 next_full = min(next_full, now + self.retry_fail_interval)

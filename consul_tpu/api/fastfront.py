@@ -163,6 +163,7 @@ class FastKVServer:
                     return
                 clen = None
                 token = None
+                trace_id = None
                 expect_100 = False
                 want_close = version == "HTTP/1.0"
                 for hline in head[line_end + 2:-4].split(b"\r\n"):
@@ -192,6 +193,11 @@ class FastKVServer:
                         return
                     elif kl == b"x-consul-token":
                         token = v.strip().decode("latin-1")
+                    elif kl == b"x-consul-trace-id":
+                        # explicit tracing only on the hot path: an
+                        # untraced KV op pays zero span overhead, a
+                        # traced one records like the legacy front
+                        trace_id = v.strip().decode("latin-1")
                     elif kl == b"authorization":
                         av = v.strip().decode("latin-1")
                         if token is None and av.startswith("Bearer "):
@@ -238,7 +244,8 @@ class FastKVServer:
                     request_bytes = b"\r\n".join(kept) + b"\r\n\r\n" \
                         + body
 
-                handled = self._try_hot(conn, verb, target, token, body)
+                handled = self._try_hot(conn, verb, target, token, body,
+                                        trace_id=trace_id)
                 if not handled:
                     self._fallback(conn, addr, request_bytes)
                 if want_close:
@@ -263,7 +270,8 @@ class FastKVServer:
     # ----------------------------------------------------------- hot path
 
     def _try_hot(self, conn, verb: str, target: str,
-                 token: Optional[str], body: bytes) -> bool:
+                 token: Optional[str], body: bytes,
+                 trace_id: Optional[str] = None) -> bool:
         if not target.startswith("/v1/kv/"):
             return False
         srv = self._api
@@ -289,7 +297,18 @@ class FastKVServer:
         except ValueError:
             return False
         t0 = _time.perf_counter()
+        wall0 = _time.time()
         telemetry.incr_counter(("http", verb.lower()))
+        ttok = None
+        if trace_id:
+            # bind the request trace so a server-backed kv_set's
+            # forwarded apply carries it to the leader; garbage ids
+            # are dropped (trace.sanitize_id), not minted-over — the
+            # untraced hot path must stay span-free
+            from consul_tpu import trace
+            trace_id = trace.sanitize_id(trace_id)
+            if trace_id:
+                ttok = trace.set_current(trace_id)
         try:
             authz = srv.acl.resolve(token or q.get("token")
                                     or srv.tokens.user_token() or None)
@@ -343,6 +362,13 @@ class FastKVServer:
             return True
         finally:
             telemetry.measure_since(("http", "latency"), t0)
+            if trace_id:
+                from consul_tpu import trace
+                if ttok is not None:
+                    trace.reset(ttok)
+                trace.record("http.request", trace_id, wall0,
+                             _time.perf_counter() - t0,
+                             verb=verb, path=path, fast=True)
 
     # ------------------------------------------------------------ writers
 

@@ -520,6 +520,12 @@ def _make_handler(srv: ApiServer):
             (blockingQuery, agent/consul/rpc.go:806)."""
             self._consistent(q)
             if "index" in q:
+                # consul.rpc.query counts CLIENT blocking queries, one
+                # per request (rpc.go:815) — counted here rather than
+                # in store.wait_* so internal waits (consistent-read
+                # catch-up, hash-watch wakeups) don't inflate it
+                from consul_tpu import telemetry
+                telemetry.incr_counter(("rpc", "query"))
                 wait = _parse_wait(q.get("wait", "300s"))
                 if watches:
                     return store.wait_on(watches, int(q["index"]),
@@ -852,11 +858,22 @@ def _make_handler(srv: ApiServer):
             self._route("PUT")
 
         def _route(self, verb: str):
-            from consul_tpu import telemetry
+            from consul_tpu import telemetry, trace
             import time as _time
             t0 = _time.perf_counter()
+            wall0 = _time.time()
+            # trace: minted here at the API entry point unless the
+            # caller (another agent's ?dc= hop, or an instrumented
+            # client) already carries a VALID one — the ID then rides
+            # leader forwarding and blocking-query retries unchanged
+            tid = trace.sanitize_id(
+                self.headers.get("X-Consul-Trace-Id")) \
+                or trace.new_trace_id()
+            ttok = trace.set_current(tid)
+            tpath = "<parse-error>"
             try:
                 path, q = self._q()
+                tpath = path
                 telemetry.incr_counter(("http", verb.lower()))
                 # token: X-Consul-Token header > Bearer > ?token= (the
                 # reference's header/QueryOptions order, agent/http.go
@@ -889,6 +906,11 @@ def _make_handler(srv: ApiServer):
                     self._err(500, f"{type(e).__name__}: {e}")
                 except Exception:
                     pass
+            finally:
+                trace.record("http.request", tid, wall0,
+                             _time.perf_counter() - t0,
+                             verb=verb, path=tpath)
+                trace.reset(ttok)
 
         # ---------------------------------------------------------- dispatch
 
@@ -926,6 +948,14 @@ def _make_handler(srv: ApiServer):
             req = urllib.request.Request(url, data=body, method=verb)
             if self.token:
                 req.add_header("X-Consul-Token", self.token)
+            # consul.rpc.cross-dc (rpc.go forwardDC's metric) + trace
+            # propagation so the remote DC's spans join this trace
+            from consul_tpu import telemetry, trace
+            telemetry.incr_counter(("rpc", "cross-dc"),
+                                   labels={"dc": dc})
+            tid = trace.current_trace()
+            if tid:
+                req.add_header("X-Consul-Trace-Id", tid)
             try:
                 with urllib.request.urlopen(req, timeout=330.0) as resp:
                     raw = resp.read()
@@ -1070,10 +1100,30 @@ def _make_handler(srv: ApiServer):
                 self._send(["<default>" if s == "" else s
                             for s in segs])
                 return True
+            if path == "/v1/agent/traces" and verb == "GET":
+                # the trace-span ring buffer (consul_tpu/trace.py):
+                # operator surface for `consul-tpu debug` and ad-hoc
+                # "where did this write go" queries
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
+                from consul_tpu import trace
+                limit = int(q["limit"]) if "limit" in q else None
+                self._send(trace.dump(limit=limit,
+                                      trace_id=q.get("trace_id")))
+                return True
             if path == "/v1/agent/metrics" and verb == "GET":
                 if not self.authz.agent_read(srv.node_name):
                     return self._forbid()
                 from consul_tpu import telemetry
+                # a metrics scrape IS a host-sync checkpoint: pull the
+                # device-side sim counters accumulated inside the jitted
+                # tick into consul.serf.* gauges (one fetch, no per-tick
+                # host round-trips)
+                if hasattr(oracle, "publish_sim_metrics"):
+                    try:
+                        oracle.publish_sim_metrics()
+                    except Exception:
+                        pass      # metrics must serve even mid-compile
                 if q.get("format") == "prometheus":
                     # the reference serves text exposition when
                     # prometheus retention is on (agent_endpoint.go

@@ -69,6 +69,12 @@ class StateStore:
         # per-index watch channels state_store.go:102-120)
         self.publisher = EventPublisher()
         self._waiters: List[_Waiter] = []
+        # parked blocking queries right now (coarse + fine), feeding the
+        # consul.rpc.queries_blocking gauge (rpc.go's queriesBlocking).
+        # Guarded by its own lock so gauge publication is ordered
+        # WITHOUT holding the store lock across sink I/O.
+        self._blocked = 0
+        self._blocked_lock = threading.Lock()
         # topic -> ordered key->index map (native C++ prefix index when
         # buildable — the go-memdb radix-tree role; consul_tpu/
         # native_index.py): prefix watch lookups are O(log n + m), not a
@@ -201,15 +207,20 @@ class StateStore:
         return, wait capped by timeout.  This is the coarse (any-write)
         wakeup; prefer `wait_on` with watch specs."""
         deadline = time.time() + timeout
-        with self._lock:
-            if index is None or index <= 0:
+        if index is None or index <= 0:
+            with self._lock:
                 return self._index
-            while self._index <= index:
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
-            return self._index
+        self._query_metrics()
+        try:
+            with self._lock:
+                while self._index <= index:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(remaining)
+                return self._index
+        finally:
+            self._query_metrics(-1)
 
     def wait_on(self, watches: Sequence[Tuple[str, str]],
                 index: Optional[int], timeout: float = 300.0) -> int:
@@ -219,31 +230,53 @@ class StateStore:
         health watcher.  Falls back to coarse wait past WATCH_LIMIT parked
         waiters (state_store.go:87-97).  Returns the current store index."""
         deadline = time.time() + timeout
-        with self._lock:
-            # index<=0 is non-blocking by contract (X-Consul-Index starts
-            # at 1; blockingQuery treats MinQueryIndex 0 as immediate)
-            if index is None or index <= 0 or not watches:
+        # index<=0 is non-blocking by contract (X-Consul-Index starts
+        # at 1; blockingQuery treats MinQueryIndex 0 as immediate)
+        if index is None or index <= 0 or not watches:
+            with self._lock:
                 return self._index
-            if self.watch_index(watches) > index:
+        self._query_metrics()
+        try:
+            with self._lock:
+                if self.watch_index(watches) > index:
+                    return self._index
+                if len(self._waiters) >= WATCH_LIMIT:
+                    while self._index <= index:
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            break
+                        self._cond.wait(remaining)
+                    return self._index
+                w = _Waiter(self._lock, list(watches))
+                self._waiters.append(w)
+                try:
+                    while not w.fired:
+                        remaining = deadline - time.time()
+                        if remaining <= 0:
+                            break
+                        w.cond.wait(remaining)
+                finally:
+                    self._waiters.remove(w)
                 return self._index
-            if len(self._waiters) >= WATCH_LIMIT:
-                while self._index <= index:
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        break
-                    self._cond.wait(remaining)
-                return self._index
-            w = _Waiter(self._lock, list(watches))
-            self._waiters.append(w)
-            try:
-                while not w.fired:
-                    remaining = deadline - time.time()
-                    if remaining <= 0:
-                        break
-                    w.cond.wait(remaining)
-            finally:
-                self._waiters.remove(w)
-            return self._index
+        finally:
+            self._query_metrics(-1)
+
+    def _query_metrics(self, delta: int = 1) -> None:
+        """Refresh the parked-queries gauge (consul.rpc.queries_blocking,
+        rpc.go's queriesBlocking) on wait entry/exit.  Publication
+        happens under _blocked_lock so concurrent exits can't land a
+        stale value out of order and wedge the gauge — and never under
+        the STORE lock, so sink emission (UDP sendto per configured
+        sink) can't serialize kv traffic behind syscalls.  The
+        consul.rpc.query COUNTER lives at the HTTP blockingQuery layer
+        (api/http.py _block): counting here would tally internal waits
+        (consistent-read catch-up, hash-watch wakeups) as client
+        queries."""
+        from consul_tpu import telemetry
+        with self._blocked_lock:
+            self._blocked += delta
+            telemetry.set_gauge(("rpc", "queries_blocking"),
+                                float(self._blocked))
 
     # -------------------------------------------------------------------- KV
 

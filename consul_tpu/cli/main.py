@@ -715,8 +715,18 @@ def cmd_debug(args) -> int:
                 add(f"{i}/metrics.json", json.dumps(
                     c._call("GET", "/v1/agent/metrics")[0],
                     indent=2).encode())
+                # prometheus exposition snapshot (the reference debug
+                # archive captures the scrape format too)
+                _, _, prom_raw = c._call("GET", "/v1/agent/metrics",
+                                         {"format": "prometheus"})
+                add(f"{i}/metrics.prom", prom_raw or b"")
                 if i < args.intervals - 1:
                     time.sleep(args.interval)
+            # the agent's trace-span ring buffer (one trace id follows
+            # a forwarded write follower → leader → apply)
+            add("trace.json", json.dumps(
+                c._call("GET", "/v1/agent/traces")[0],
+                indent=2).encode())
         except Exception as e:
             add("capture_error.txt",
                 f"agent capture failed: {e}".encode())

@@ -26,8 +26,11 @@ from __future__ import annotations
 
 import random
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from consul_tpu import telemetry
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -162,6 +165,9 @@ class _Pending:
     event: threading.Event = field(default_factory=threading.Event)
     result: Any = None
     error: Optional[Exception] = None
+    # proposal wall-stamp: consul.raft.commitTime measures append → FSM
+    # apply (the reference's raft commitTime timer)
+    t0: float = field(default_factory=_time.perf_counter)
 
 
 class RaftNode:
@@ -221,6 +227,12 @@ class RaftNode:
         self._chunk_buf: Dict[str, list] = {}   # gid -> b64 parts
         self._lock = threading.RLock()
         self._pending: Dict[int, _Pending] = {}   # log index -> waiter
+        # telemetry staging: helpers that run under self._lock append
+        # (kind, name, value) here and tick()/apply_many() flush AFTER
+        # releasing it — sink emission (UDP sendto per configured sink)
+        # must never serialize raft progress behind syscalls (the same
+        # rule catalog/store.py applies to its blocking-query metrics)
+        self._metrics_buf: List[tuple] = []
         self._leader_observers: List[Callable[[bool], None]] = []
         self.applied_index_log: List[int] = []    # for tests/metrics
         self._first_tick = True
@@ -314,6 +326,20 @@ class RaftNode:
         with self._lock:
             return self.state == LEADER
 
+    def _flush_metrics(self) -> None:
+        """Emit staged metrics; call with the raft lock RELEASED."""
+        with self._lock:
+            if not self._metrics_buf:
+                return
+            buf, self._metrics_buf = self._metrics_buf, []
+        for kind, name, value in buf:
+            if kind == "c":
+                telemetry.incr_counter(name, value)
+            elif kind == "g":
+                telemetry.set_gauge(name, value)
+            else:
+                telemetry.add_sample(name, value)
+
     def add_leader_observer(self, fn: Callable[[bool], None]) -> None:
         """Mirror of raft's LeaderCh feeding monitorLeadership
         (agent/consul/leader.go:64)."""
@@ -375,6 +401,13 @@ class RaftNode:
         with self._lock:
             if self.state != LEADER:
                 raise NotLeaderError(self.leader_id)
+            if not noop:
+                # consul.raft.apply: rate of ACCEPTED raft applies
+                # (rpc.go:730 raftApply's metric) — counted after the
+                # leadership check so a NotLeaderError + retry at the
+                # real leader doesn't double-count the write
+                self._metrics_buf.append(
+                    ("c", ("raft", "apply"), float(len(cmds))))
             for entries in batches:
                 for e_cmd in entries:
                     ent = _Entry(self.current_term, e_cmd, noop)
@@ -391,6 +424,7 @@ class RaftNode:
                 pends.append(pend)
             self.match_index[self.node_id] = self.last_log_index
             self._needs_bcast = True
+        self._flush_metrics()
         cb = self.on_activity
         if cb is not None:
             cb()
@@ -423,6 +457,7 @@ class RaftNode:
             self._advance_commit()
             self._apply_committed()
             self._maybe_compact()
+        self._flush_metrics()
 
     # -------------------------------------------------------------- internal
 
@@ -469,6 +504,7 @@ class RaftNode:
         if len(self._prevotes) * 2 <= len(self.peers) + 1:
             return
         self.state = CANDIDATE
+        self._metrics_buf.append(("c", ("raft", "state", "candidate"), 1.0))
         self.current_term += 1
         self.voted_for = self.node_id
         # durable BEFORE any request_vote leaves: a crashed-and-
@@ -490,6 +526,8 @@ class RaftNode:
             return
         if len(self._votes) * 2 > len(self.peers) + 1:
             self.state = LEADER
+            self._metrics_buf.append(("c", ("raft", "state", "leader"),
+                                      1.0))
             self.leader_id = self.node_id
             nxt = self.last_log_index + 1
             self.next_index = {p: nxt for p in self.peers}
@@ -508,6 +546,18 @@ class RaftNode:
     def _broadcast_append(self, now: float) -> None:
         self._needs_bcast = False
         self._heartbeat_due = now + self.cfg.heartbeat_interval
+        if self.peers and self.last_ack:
+            # consul.raft.leader.lastContact: ms since this leader last
+            # heard from its median follower (the hashicorp/raft leader
+            # lease gauge); sampled at heartbeat cadence, same tick
+            # clock as the acks so virtual-time tests stay coherent
+            acks = sorted(self.last_ack.get(p, -1e18) for p in self.peers)
+            quorum_ack = acks[len(acks) // 2]
+            age_ms = max(0.0, (now - quorum_ack) * 1000.0)
+            if age_ms < 1e12:         # no contact yet: skip the sentinel
+                self._metrics_buf.append(
+                    ("g", ("raft", "leader", "lastContact"),
+                     round(age_ms, 3)))
         for p in self.peers:
             self._send_append(p)
 
@@ -719,6 +769,7 @@ class RaftNode:
             ent = self.log[off]
             result = None
             if not ent.noop:
+                t0 = _time.perf_counter()
                 if isinstance(ent.cmd, dict) and "__chunk__" in ent.cmd:
                     result = self._apply_chunk(ent.cmd["__chunk__"])
                 elif isinstance(ent.cmd, dict) \
@@ -730,9 +781,17 @@ class RaftNode:
                         ent.cmd["__raft_remove_peer__"])
                 else:
                     result = self.apply_fn(ent.cmd)
+                self._metrics_buf.append(
+                    ("s", ("raft", "fsm", "apply"),
+                     _time.perf_counter() - t0))
             self.applied_index_log.append(self.last_applied)
             pend = self._pending.pop(self.last_applied, None)
             if pend is not None:
+                # append → quorum commit → FSM apply latency, observed
+                # only at the proposer (it owns the waiter)
+                self._metrics_buf.append(
+                    ("s", ("raft", "commitTime"),
+                     _time.perf_counter() - pend.t0))
                 if isinstance(result, Exception):
                     pend.error = result
                 else:

@@ -109,8 +109,9 @@ def host_info() -> dict:
 def capture(agent=None, intervals: int = 2,
             interval_s: float = 0.5) -> bytes:
     """Sampled debug archive (debug.go capture loop): per-interval
-    metrics + thread dumps, plus one-shot host/agent/log captures."""
-    from consul_tpu import telemetry
+    metrics (JSON + prometheus exposition) + thread dumps, plus
+    one-shot host/agent/log captures and the trace-span ring buffer."""
+    from consul_tpu import telemetry, trace
     from consul_tpu.logging import default_buffer
 
     buf = io.BytesIO()
@@ -124,17 +125,29 @@ def capture(agent=None, intervals: int = 2,
         add("host.json", json.dumps(host_info(), indent=2).encode())
         add("logs.txt", "\n".join(default_buffer().recent()).encode())
         if agent is not None:
+            # pull the device-side sim counters into the registry so
+            # the metrics snapshots below carry consul.serf.* too
+            if hasattr(agent.oracle, "publish_sim_metrics"):
+                try:
+                    agent.oracle.publish_sim_metrics()
+                except Exception:
+                    pass
             add("agent.json", json.dumps({
                 "node_name": agent.node_name,
                 "members_summary": agent.oracle.members_summary(),
                 "catalog_index": agent.store.index,
             }, indent=2).encode())
         for i in range(intervals):
+            reg = telemetry.default_registry()
             add(f"{i}/metrics.json", json.dumps(
-                telemetry.default_registry().dump(), indent=2).encode())
+                reg.dump(), indent=2).encode())
+            add(f"{i}/metrics.prom", reg.prometheus().encode())
             add(f"{i}/threads.txt", thread_dump().encode())
             if i < intervals - 1:
                 time.sleep(interval_s)
+        # the span ring LAST: it then includes spans recorded during
+        # the capture window itself
+        add("trace.json", json.dumps(trace.dump(), indent=2).encode())
     return buf.getvalue()
 
 

@@ -82,6 +82,12 @@ def step(params: SerfParams, s: ClusterState) -> ClusterState:
     return ClusterState(swim=sw, coords=coords, events=ev)
 
 
+def metrics_vector(params: SerfParams, s: ClusterState) -> jnp.ndarray:
+    """Device-side telemetry for the whole pool (swim.METRIC_NAMES
+    order) — the consul.serf.* gauge source, one transfer per scrape."""
+    return swim.metrics_vector(params.swim, s.swim)
+
+
 def fire_event(params: SerfParams, s: ClusterState, origin: int,
                event_id: int) -> ClusterState:
     """Fire a user event (reference agent/user_event.go:23 UserEvent)."""

@@ -115,6 +115,21 @@ SUSPECT = 1
 DEAD = 2
 LEFT = 3
 
+# Device-side cumulative tick counters (SwimState.ctr slots).  These are
+# the consul.serf.* / consul.memberlist.* instrumentation of the
+# reference (serf metrics in lib/serf, memberlist probeNode/gossip
+# timers) recast for the sim: accumulated INSIDE the jitted tick as
+# scalar reductions, fetched only at host-sync checkpoints
+# (metrics_vector) — zero extra host round-trips in the hot loop.
+CTR_PROBES_SENT = 0     # direct probes attempted this tick
+CTR_PROBE_ACKS = 1      # probes acked (direct or via relay)
+CTR_PROBE_FAILS = 2     # probe acks lost (full round failed)
+CTR_SUSPICIONS = 3      # dense suspicion timers started
+CTR_GOSSIP_DELIVERED = 4  # newly-learned (node, rumor) cells
+CTR_GOSSIP_SERVED = 5   # piggyback cell transmissions attempted
+CTR_GOSSIP_LOST = 6     # piggyback cell transmissions lost (same units)
+CTR_N = 7
+
 _NEG = _np.int32(-1)  # host-side: keep module import free of backend init
 
 
@@ -248,6 +263,16 @@ class SwimState:
     awareness: jnp.ndarray       # [N] int32 health score, [0, max-1]
     sus_count: jnp.ndarray       # [N] int32: suspicion starts per subject
     #                               (diagnostic: false-suspicion counting)
+    # --- device-side telemetry counters (CTR_* slots above) ---
+    # Cumulative f32 — tiny [CTR_N] vector, replicated under sharding
+    # (parallel/mesh.py _node_shardable rejects it), read back only at
+    # host-sync checkpoints.  float32 gives ~7 significant digits:
+    # past 2^24 a counter is accurate RELATIVELY (~1e-7 — adds below
+    # that fraction of the running total round away), which is the
+    # operator-telemetry contract (go-metrics sinks are float32 too);
+    # int32 would overflow outright at 1M-node gossip volumes and x64
+    # is disabled in this rig.
+    ctr: jnp.ndarray             # [CTR_N] float32
 
 
 def init_state(params: SwimParams, key=None,
@@ -297,6 +322,7 @@ def init_state(params: SwimParams, key=None,
         bulk_cov=jnp.zeros((n,), jnp.float32),
         awareness=jnp.zeros((n,), jnp.int32),
         sus_count=jnp.zeros((n,), jnp.int32),
+        ctr=jnp.zeros((CTR_N,), jnp.float32),
     )
 
 
@@ -662,6 +688,18 @@ def _probe_round(params: SwimParams, s: SwimState) -> Tuple[SwimState, ProbeObs]
     s = s.replace(sus_start=sus_start, sus_confirm=sus_confirm,
                   sus_count=s.sus_count + start_new.astype(jnp.int32))
 
+    # device-side probe counters (consul.serf.probe.* / memberlist
+    # probeNode): scalar reductions folded into the jitted round
+    probed = prober & ~skip & t_member
+    f32 = jnp.float32
+    s = s.replace(ctr=s.ctr
+                  .at[CTR_PROBES_SENT].add(jnp.sum(probed).astype(f32))
+                  .at[CTR_PROBE_ACKS].add(
+                      jnp.sum(probed & ack).astype(f32))
+                  .at[CTR_PROBE_FAILS].add(jnp.sum(failed).astype(f32))
+                  .at[CTR_SUSPICIONS].add(
+                      jnp.sum(start_new).astype(f32)))
+
     # (c) originate new suspect rumors for subjects with no existing
     # rumor (belief spread + refutation channel; timing no longer
     # depends on winning a slot)
@@ -905,8 +943,13 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
                                  p_loss=params.p_loss,
                                  key=prng.tick_key(params.seed, tick, 5))
     learn_tick = jnp.where(res.newly, tick, s.learn_tick)
+    # consul.serf.gossip.* device counters (memberlist gossip timer's
+    # accounting): the op already computed the reductions
+    ctr = (s.ctr.at[CTR_GOSSIP_DELIVERED].add(res.delivered)
+           .at[CTR_GOSSIP_SERVED].add(res.served)
+           .at[CTR_GOSSIP_LOST].add(res.lost))
     return s.replace(know=res.know, learn_tick=learn_tick,
-                     sends_left=res.sends_left)
+                     sends_left=res.sends_left, ctr=ctr)
 
 
 def _bulk_disseminate(params: SwimParams, s: SwimState) -> SwimState:
@@ -1083,6 +1126,64 @@ def run(params: SwimParams, s: SwimState, n_ticks: int,
         return st, believed_down_fraction(params, st, monitor_subject)
 
     return jax.lax.scan(body, s, None, length=n_ticks)
+
+
+# ---------------------------------------------------------------------------
+# device-side metrics summary (host-sync checkpoint surface)
+# ---------------------------------------------------------------------------
+
+# Order matches metrics_vector's stack.  Cumulative counters come from
+# SwimState.ctr; the rest are instantaneous gauges derived on device so
+# ONE host transfer serves the whole scrape.
+METRIC_NAMES = (
+    "probe.sent", "probe.acked", "probe.failed", "suspicion.started",
+    "gossip.delivered", "gossip.served", "gossip.lost",
+    "queue.alive", "queue.suspect", "queue.dead", "queue.left",
+    "queue.depth", "slot.utilization", "convergence.fraction",
+    "members.alive", "members.failed_committed", "members.left_committed",
+    "bulk.pending", "bulk.coverage", "awareness.mean", "tick",
+)
+
+
+def metrics_vector(params: SwimParams, s: SwimState) -> jnp.ndarray:
+    """One [len(METRIC_NAMES)] f32 vector of sim telemetry (jit this).
+
+    Called only at host-sync checkpoints (a metrics scrape, a bench
+    readback) — NEVER per tick: the per-tick accumulation lives in
+    SwimState.ctr, and the gauges here are reductions over state the
+    device already holds, so the scrape costs one small transfer."""
+    f32 = jnp.float32
+    live = s.up & s.member
+    n_live = jnp.maximum(jnp.sum(live), 1).astype(f32)
+    active = s.r_active
+    n_active = jnp.maximum(jnp.sum(active), 1).astype(f32)
+    live_cells = n_live * n_active
+    know_live = s.know & live[:, None] & active[None, :]
+    # piggyback-slot utilization: fraction of (live member, active
+    # rumor) cells still queued for transmit (sends budget left)
+    util = jnp.sum(know_live & (s.sends_left > 0)).astype(f32) / live_cells
+    # convergence: mean coverage of the active rumor table
+    conv = jnp.sum(know_live).astype(f32) / live_cells
+    n_bulk = jnp.sum(s.bulk_member).astype(f32)
+    bulk_cov = jnp.sum(jnp.where(s.bulk_member, s.bulk_cov, 0.0)) \
+        / jnp.maximum(n_bulk, 1.0)
+    gauges = jnp.stack([
+        jnp.sum(active & (s.r_kind == ALIVE)).astype(f32),
+        jnp.sum(active & (s.r_kind == SUSPECT)).astype(f32),
+        jnp.sum(active & (s.r_kind == DEAD)).astype(f32),
+        jnp.sum(active & (s.r_kind == LEFT)).astype(f32),
+        jnp.sum(active).astype(f32),
+        util,
+        conv,
+        jnp.sum(live).astype(f32),
+        jnp.sum(s.committed_dead).astype(f32),
+        jnp.sum(s.committed_left).astype(f32),
+        n_bulk,
+        bulk_cov,
+        jnp.sum(jnp.where(live, s.awareness, 0)).astype(f32) / n_live,
+        s.tick.astype(f32),
+    ])
+    return jnp.concatenate([s.ctr, gauges])
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,13 @@ class GossipResult(NamedTuple):
     know: jnp.ndarray        # [N, S] bool
     sends_left: jnp.ndarray  # [N, S] int8
     newly: jnp.ndarray       # [N, S] bool — learned this tick
+    # device-side tick counters (scalar f32, summed on device so the
+    # host fetches them only at sync checkpoints — never per tick).
+    # served and lost share TRANSMISSION units (queued cell x ring
+    # contact), so lost/served is a per-transmission loss rate:
+    delivered: jnp.ndarray   # newly-learned (node, slot) cells
+    served: jnp.ndarray      # cell transmissions attempted
+    lost: jnp.ndarray        # cell transmissions dropped to loss
 
 
 def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
@@ -55,9 +62,22 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
     fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
     views = rolls.pull_multi(serve, offsets)
+    # per-carrier queued-cell count, reduced ONCE and rotated as a 1-D
+    # vector where per-contact accounting needs it — per-view [N, S]
+    # reductions measurably broke the slice+mask fusion (~35%/tick).
+    # Row-permutation commutes with row-wise reductions.
+    cells = jnp.sum(serve, axis=1).astype(jnp.float32)           # [N]
+    served = jnp.sum(cells) * fanout      # cell transmissions attempted
+    lost = jnp.float32(0)
     if p_loss > 0.0 and key is not None:
         ok = jax.random.bernoulli(key, 1.0 - p_loss,
                                   (know.shape[0], fanout))       # [N, G]
+        # count lost in the SAME transmission units: the queued cells
+        # of each dropped contact (a lost packet from a sender with
+        # nothing queued never held gossip — counting it would make
+        # lost incomparable to served in sparse/half-dead pools)
+        carried = jnp.stack(rolls.pull_multi(cells, offsets), axis=1)
+        lost = jnp.sum(jnp.where(ok, 0.0, carried))
         views = [v & ok[:, g:g + 1] for g, v in enumerate(views)]
     got = views[0]
     for v in views[1:]:
@@ -73,4 +93,6 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
                                     jnp.maximum(sends_left - jnp.int8(fanout),
                                                 jnp.int8(0)),
                                     sends_left))
-    return GossipResult(know=new_know, sends_left=new_sends, newly=newly)
+    return GossipResult(know=new_know, sends_left=new_sends, newly=newly,
+                        delivered=jnp.sum(newly).astype(jnp.float32),
+                        served=served, lost=lost)

@@ -39,6 +39,7 @@ class GossipOracle:
                                       n_initial=self.sim.n_initial)
         self._lock = threading.RLock()
         self._step = jax.jit(serf.step, static_argnums=0)
+        self._metrics_fn = jax.jit(serf.metrics_vector, static_argnums=0)
         self._node_prefix = node_prefix
         self._names: Dict[int, str] = {
             i: f"{node_prefix}{i}" for i in range(self.sim.n_nodes)}
@@ -114,7 +115,13 @@ class GossipOracle:
             for out in (swim.rejoin(self.params.swim, s.swim, 0),
                         swim.leave(self.params.swim, s.swim, 0),
                         swim.kill(s.swim, 0),
-                        self._step(self.params, s)):
+                        self._step(self.params, s),
+                        # the metrics summary too: the FIRST /v1/agent/
+                        # metrics scrape otherwise pays this compile
+                        # inside its HTTP request while holding the
+                        # oracle lock (blocking every tick/join behind
+                        # it for the compile duration)
+                        self._metrics_fn(self.params, s)):
                 jax.block_until_ready(out)
         # the members/down-mask computation is every client's FIRST
         # read — compile it too, then drop the snapshot it cached so
@@ -417,6 +424,32 @@ class GossipOracle:
                 raise ValueError("cannot remove the primary key")
             if key in self._keyring:
                 self._keyring.remove(key)
+
+    # --------------------------------------------------------------- metrics
+
+    def sim_metrics(self) -> Dict[str, float]:
+        """Device-side sim telemetry as {name: value} (swim.METRIC_NAMES).
+
+        This is a host-sync CHECKPOINT: one jitted reduction over state
+        the device already holds, one small transfer — the per-tick
+        accumulation rides SwimState.ctr inside the step, so the hot
+        loop never pays a host round-trip for metrics."""
+        with self._lock:
+            vec = self._metrics_fn(self.params, self._state)
+        vals = np.asarray(vec)
+        return {name: float(v)
+                for name, v in zip(swim.METRIC_NAMES, vals)}
+
+    def publish_sim_metrics(self, registry=None) -> Dict[str, float]:
+        """Surface sim_metrics() as consul.serf.* gauges (the reference's
+        serf/memberlist go-metrics names land under consul.serf/
+        consul.memberlist; the sim's single pool maps to consul.serf)."""
+        from consul_tpu import telemetry
+        reg = registry or telemetry.default_registry()
+        m = self.sim_metrics()
+        for name, v in m.items():
+            reg.set_gauge(("serf",) + tuple(name.split(".")), v)
+        return m
 
     # ------------------------------------------------------------------ misc
 

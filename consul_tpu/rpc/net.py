@@ -21,12 +21,21 @@ import socketserver
 import ssl
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
+from consul_tpu import telemetry, trace
 from consul_tpu.consensus.raft import Transport
 from consul_tpu.utils.net import shutdown_and_close
 
 _MAX_FRAME = 64 << 20  # 64 MiB: snapshots ride InstallSnapshot frames
+
+# the server-side endpoint table (server.py _handle_rpc): RPC metrics
+# label by method, and the label value must come from THIS fixed set —
+# labeling with the raw client-supplied string would let any peer mint
+# unbounded registry entries with random method names
+_KNOWN_METHODS = frozenset({"apply", "apply_batch", "barrier", "stats",
+                            "auto_encrypt_sign", "auto_config"})
 
 
 class RpcError(Exception):
@@ -96,6 +105,7 @@ class RpcListener:
                 # server_close and ride reused fd numbers otherwise
                 with outer._live_lock:
                     outer._live.add(sock)
+                raft_handed = False
                 try:
                     while True:
                         frame = recv_frame(sock)
@@ -103,14 +113,48 @@ class RpcListener:
                             return
                         kind = frame.get("type")
                         if kind == "raft":
+                            if not raft_handed:
+                                # consul.rpc.raft_handoff: counted once
+                                # per CONNECTION carrying raft traffic
+                                # (rpc.go:130's mux hands the conn off
+                                # once), not per frame — per-frame
+                                # counting tracked heartbeat volume and
+                                # taxed every delivery with registry
+                                # work
+                                raft_handed = True
+                                telemetry.incr_counter(
+                                    ("rpc", "raft_handoff"))
                             outer.deliver_fn(frame["msg"])
                         elif kind == "rpc":
+                            method = frame.get("method", "")
+                            # consul.rpc.request + latency, labeled by
+                            # method (rpc.go:815's per-request metric);
+                            # unknown/garbage method names collapse to
+                            # one "other" label so a hostile peer can't
+                            # inflate registry cardinality
+                            mlabel = {"method": method
+                                      if method in _KNOWN_METHODS
+                                      else "other"}
+                            telemetry.incr_counter(("rpc", "request"),
+                                                   labels=mlabel)
+                            t0 = time.perf_counter()
+                            tid = frame.get("trace")
+                            tok = trace.set_current(tid) if tid else None
                             resp = {"type": "resp", "id": frame.get("id")}
                             try:
                                 resp["result"] = outer.handler(
-                                    frame["method"], frame.get("args") or {})
+                                    method, frame.get("args") or {})
                             except Exception as e:
+                                telemetry.incr_counter(
+                                    ("rpc", "request_error"),
+                                    labels=mlabel)
                                 resp["error"] = f"{type(e).__name__}: {e}"
+                            finally:
+                                if tok is not None:
+                                    trace.reset(tok)
+                                telemetry.measure_since(
+                                    ("rpc", "request_time"), t0,
+                                    labels=mlabel)
                             send_frame(sock, resp)
                 except (ConnectionError, ValueError, OSError):
                     return
@@ -254,9 +298,19 @@ class RpcClient:
         with self._id_lock:
             self._next_id += 1
             rid = self._next_id
-        resp = self._pool.call(tuple(addr), {"type": "rpc", "id": rid,
-                                             "method": method, "args": args},
-                               timeout=timeout)
+        obj = {"type": "rpc", "id": rid, "method": method, "args": args}
+        # propagate the caller's trace id on the frame envelope (never
+        # inside args — forwarded applies' args become raft commands
+        # and must stay byte-identical across replicas)
+        tid = trace.current_trace()
+        if tid:
+            obj["trace"] = tid
+        t0 = time.perf_counter()
+        try:
+            resp = self._pool.call(tuple(addr), obj, timeout=timeout)
+        finally:
+            telemetry.measure_since(("rpc", "client", "request_time"), t0,
+                                    labels={"method": method})
         if resp.get("error"):
             raise RpcError(resp["error"])
         return resp.get("result")

@@ -182,9 +182,11 @@ class Server:
         ONE apply_batch RPC — concurrent writers on this server cost
         one forwarded round trip and one raft append round between
         them (group commit), instead of a socket RPC each."""
+        from consul_tpu import trace
         from consul_tpu.rpc import RpcError
         item = {"op": op, "args": args, "event": threading.Event(),
                 "result": None, "error": None,
+                "trace": trace.current_trace(),
                 "deadline": time.time() + timeout}
         with self._fwd_cv:
             if self._fwd_closed:
@@ -197,7 +199,12 @@ class Server:
                 self._fwd_thread.start()
             self._fwd_q.append(item)
             self._fwd_cv.notify()
-        if not item["event"].wait(timeout):
+        # the forwarded leg of the write, follower-side (ForwardRPC):
+        # one span per caller covering queue + socket round trip
+        with trace.span("rpc.forward", trace_id=item["trace"] or "",
+                        op=op, node=self.node_id):
+            done = item["event"].wait(timeout)
+        if not done:
             raise TimeoutError(f"forwarded apply {op} timed out")
         if item["error"] is not None:
             err = item["error"]
@@ -253,13 +260,15 @@ class Server:
                     it = items[0]
                     it["result"] = client.call(
                         addr, "apply",
-                        {"op": it["op"], "args": it["args"]},
+                        {"op": it["op"], "args": it["args"],
+                         "trace": it["trace"]},
                         timeout=budget)
                     it["event"].set()
                     continue
                 out = client.call(
                     addr, "apply_batch",
-                    {"items": [{"op": it["op"], "args": it["args"]}
+                    {"items": [{"op": it["op"], "args": it["args"],
+                                "trace": it["trace"]}
                                for it in items]},
                     timeout=budget)
                 results = (out or {}).get("results") or []
@@ -279,14 +288,24 @@ class Server:
     def _handle_rpc(self, method: str, args: dict):
         """Server-side forwarded calls (the RPC endpoints the mux routes
         to, agent/consul/rpc.go:130).  'apply' rejects at a non-leader —
-        the caller targeted us as leader; re-forwarding could loop."""
+        the caller targeted us as leader; re-forwarding could loop.
+
+        New methods must also enter rpc/net.py _KNOWN_METHODS (the
+        per-method metric label allowlist) — test_rpc enforces the
+        pairing."""
+        from consul_tpu import trace
         if method == "apply":
             if not self.raft.is_leader():
                 raise NotLeaderError(self.raft.leader_id)
-            pend = self.raft.apply({"op": args["op"],
-                                    "args": args.get("args") or {}})
-            if not pend.event.wait(5.0):
-                raise TimeoutError("apply timed out")
+            # the leader leg of a forwarded write: the span's trace id
+            # arrived on the RPC envelope, so follower → leader → apply
+            # reads as one trace in the ring buffer
+            with trace.span("leader.apply", trace_id=args.get("trace"),
+                            op=args.get("op"), node=self.node_id):
+                pend = self.raft.apply({"op": args["op"],
+                                        "args": args.get("args") or {}})
+                if not pend.event.wait(5.0):
+                    raise TimeoutError("apply timed out")
             if pend.error is not None:
                 raise pend.error
             return pend.result
@@ -297,6 +316,7 @@ class Server:
             # coalescing concurrent forwards is the same lever)
             if not self.raft.is_leader():
                 raise NotLeaderError(self.raft.leader_id)
+            t_wall, t0 = time.time(), time.perf_counter()
             pends = self.raft.apply_many(
                 [{"op": it["op"], "args": it.get("args") or {}}
                  for it in args["items"]])
@@ -314,6 +334,13 @@ class Server:
                 else:
                     results.append(pend.result)
                     errors.append(None)
+            # one leader.apply span per batched item, each under ITS
+            # caller's trace id (the shared wait is the group commit)
+            dur = time.perf_counter() - t0
+            for it in args["items"]:
+                trace.record("leader.apply", it.get("trace"), t_wall,
+                             dur, op=it.get("op"), node=self.node_id,
+                             batched=len(args["items"]))
             return {"results": results, "errors": errors}
         if method == "barrier":
             if not self.raft.is_leader():
@@ -430,12 +457,17 @@ class Server:
             self._reconcile_inflight = True
 
             def work(now=now):
+                from consul_tpu import telemetry
+                t0 = time.perf_counter()
                 try:
                     self._invalidate_sessions_on_checks(now)
                     if self._oracle is not None:
                         self._reconcile_members(now)
                 finally:
                     self._reconcile_inflight = False
+                    # consul.leader.reconcile: the serf→catalog sweep
+                    # duration (leader.go:196's leaderLoop timers)
+                    telemetry.measure_since(("leader", "reconcile"), t0)
 
             threading.Thread(target=work, daemon=True).start()
 

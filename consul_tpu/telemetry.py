@@ -1,48 +1,98 @@
-"""Telemetry: counters/gauges/samples with sink fan-out.
+"""Telemetry: counters/gauges/samples with labels, quantiles, sink fan-out.
 
 The reference initializes armon/go-metrics with statsite/statsd/
 dogstatsd/prometheus/circonus sinks (lib/telemetry.go:21 TelemetryConfig,
 InitTelemetry) and instruments every subsystem (rpc.go:815, leader.go:196
 …), surfacing an in-memory aggregate at /v1/agent/metrics.  Same shape
-here: a process-wide Registry with incr_counter / set_gauge / add_sample,
-an in-memory aggregating sink serving the metrics endpoint, and an
-optional statsd UDP line sink.
+here: a process-wide Registry with incr_counter / set_gauge / add_sample
+(each taking optional go-metrics-style labels), an in-memory aggregating
+sink serving the metrics endpoint, and optional statsd-family line sinks.
+
+Samples carry streaming P50/P90/P99 via a fixed-size reservoir (the
+go-metrics AggregateSample + prometheus summary role): bounded memory
+per metric, quantiles computed only at dump/scrape time — nothing on the
+emission hot path beyond one reservoir slot write.
 """
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
+import zlib
 from collections import defaultdict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# label normal form: sorted tuple of (key, value) string pairs — hashable,
+# deterministic, order-insensitive (go-metrics Label slices, order-free)
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels) -> LabelKey:
+    if not labels:
+        return ()
+    if isinstance(labels, dict):
+        items = labels.items()
+    else:
+        items = labels
+    return tuple(sorted((str(k), str(v)) for k, v in items))
 
 
 class _Sample:
-    __slots__ = ("count", "total", "min", "max")
+    """Aggregate + fixed-size reservoir (Vitter's algorithm R).
+
+    The reservoir is the "small fixed-size estimator" behind the
+    P50/P90/P99 summaries: uniform over the whole stream, RESERVOIR
+    floats of memory regardless of count.  Seeded RNG per instance so
+    dumps are reproducible run-to-run."""
+
+    __slots__ = ("count", "total", "min", "max", "_res", "_rng")
+
+    RESERVOIR = 256
 
     def __init__(self):
         self.count = 0
         self.total = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self._res: List[float] = []
+        self._rng = random.Random(0x5EED)
 
     def add(self, v: float) -> None:
         self.count += 1
         self.total += v
         self.min = min(self.min, v)
         self.max = max(self.max, v)
+        if len(self._res) < self.RESERVOIR:
+            self._res.append(v)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.RESERVOIR:
+                self._res[j] = v
+
+    def quantiles(self, qs=(0.5, 0.9, 0.99)) -> List[float]:
+        """Nearest-rank quantiles over the reservoir (exact while
+        count <= RESERVOIR, a uniform estimate beyond)."""
+        if not self._res:
+            return [0.0 for _ in qs]
+        s = sorted(self._res)
+        n = len(s)
+        return [s[min(n - 1, max(0, int(q * n)))] for q in qs]
 
 
 class StatsdSink:
-    """Plain statsd line protocol over UDP (lib/telemetry.go statsd_addr)."""
+    """Plain statsd line protocol over UDP (lib/telemetry.go statsd_addr).
+    The plain protocol has no label dialect — labels are dropped, like
+    go-metrics' statsd sink flattening."""
 
     def __init__(self, addr: str):
         host, _, port = addr.rpartition(":")
         self.addr = (host or "127.0.0.1", int(port))
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
 
-    def emit(self, kind: str, name: str, value: float) -> None:
+    def emit(self, kind: str, name: str, value: float,
+             labels: LabelKey = ()) -> None:
         suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
         try:
             self.sock.sendto(f"{name}:{value}|{suffix}".encode(), self.addr)
@@ -52,17 +102,21 @@ class StatsdSink:
 
 class DogstatsdSink(StatsdSink):
     """Datadog's statsd dialect: the same line protocol plus |#tags
-    (lib/telemetry.go dogstatsd_addr / dogstatsd_tags)."""
+    (lib/telemetry.go dogstatsd_addr / dogstatsd_tags).  Per-metric
+    labels append after the configured global tags."""
 
     def __init__(self, addr: str, tags: Optional[List[str]] = None):
         super().__init__(addr)
-        self._suffix = ("|#" + ",".join(tags)) if tags else ""
+        self._tags = list(tags) if tags else []
 
-    def emit(self, kind: str, name: str, value: float) -> None:
+    def emit(self, kind: str, name: str, value: float,
+             labels: LabelKey = ()) -> None:
         suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
+        tags = self._tags + [f"{k}:{v}" for k, v in labels]
+        tail = ("|#" + ",".join(tags)) if tags else ""
         try:
             self.sock.sendto(
-                f"{name}:{value}|{suffix}{self._suffix}".encode(),
+                f"{name}:{value}|{suffix}{tail}".encode(),
                 self.addr)
         except OSError:
             pass
@@ -73,7 +127,11 @@ class StatsiteSink:
     (lib/telemetry.go statsite_addr).  Lines flush through a bounded
     queue + background writer so metric EMISSION never blocks the hot
     path on an unreachable collector (go-metrics' statsite sink
-    buffers through a channel the same way); overflow drops lines."""
+    buffers through a channel the same way); overflow drops lines.
+
+    A sendall failure mid-line does NOT lose the line: the writer
+    redials and retries once, then requeues it (dropping only if the
+    queue is full) — a collector restart costs reordering, not data."""
 
     _QUEUE_CAP = 4096
 
@@ -85,7 +143,8 @@ class StatsiteSink:
         self._sock: Optional[socket.socket] = None
         threading.Thread(target=self._flush_loop, daemon=True).start()
 
-    def emit(self, kind: str, name: str, value: float) -> None:
+    def emit(self, kind: str, name: str, value: float,
+             labels: LabelKey = ()) -> None:
         import queue as _queue
         suffix = {"counter": "c", "gauge": "g", "sample": "ms"}[kind]
         try:
@@ -93,31 +152,51 @@ class StatsiteSink:
         except _queue.Full:
             pass                      # collector down: shed, don't stall
 
+    def _try_send(self, line: bytes) -> bool:
+        try:
+            if self._sock is None:
+                self._sock = socket.create_connection(self.addr,
+                                                      timeout=1.0)
+            self._sock.sendall(line)
+            return True
+        except OSError:
+            try:
+                if self._sock is not None:
+                    self._sock.close()
+            finally:
+                self._sock = None
+            return False
+
     def _flush_loop(self) -> None:
+        import queue as _queue
         import time as _time
         while True:
             line = self._q.get()
+            if self._try_send(line):
+                continue
+            # redial once: a collector restart between lines shows up
+            # as exactly one failed sendall on the stale socket
+            if self._try_send(line):
+                continue
+            # still down: requeue the in-flight line so it survives the
+            # outage (tail position — statsd lines are independent),
+            # then back off before the next dial
             try:
-                if self._sock is None:
-                    self._sock = socket.create_connection(self.addr,
-                                                          timeout=1.0)
-                self._sock.sendall(line)
-            except OSError:
-                try:
-                    if self._sock is not None:
-                        self._sock.close()
-                finally:
-                    self._sock = None
-                _time.sleep(0.5)      # backoff before the next dial
+                self._q.put_nowait(line)
+            except _queue.Full:
+                pass
+            _time.sleep(0.5)
 
 
 class Registry:
     def __init__(self, prefix: str = "consul"):
         self.prefix = prefix
         self._lock = threading.Lock()
-        self._counters: Dict[str, float] = defaultdict(float)
-        self._gauges: Dict[str, float] = {}
-        self._samples: Dict[str, _Sample] = {}
+        # keyed by (full_name, labels) — the go-metrics flattened key
+        self._counters: Dict[Tuple[str, LabelKey], float] = \
+            defaultdict(float)
+        self._gauges: Dict[Tuple[str, LabelKey], float] = {}
+        self._samples: Dict[Tuple[str, LabelKey], _Sample] = {}
         self._sinks: List[StatsdSink] = []
 
     def add_statsd_sink(self, addr: str) -> None:
@@ -135,89 +214,209 @@ class Registry:
             return f"{self.prefix}.{parts}"
         return ".".join([self.prefix, *parts])
 
-    def incr_counter(self, name, value: float = 1.0) -> None:
+    def incr_counter(self, name, value: float = 1.0, labels=None) -> None:
         n = self._name(name)
+        lk = _labels_key(labels)
         with self._lock:
-            self._counters[n] += value
+            self._counters[(n, lk)] += value
         for s in self._sinks:
-            s.emit("counter", n, value)
+            s.emit("counter", n, value, lk)
 
-    def set_gauge(self, name, value: float) -> None:
+    def set_gauge(self, name, value: float, labels=None) -> None:
         n = self._name(name)
+        lk = _labels_key(labels)
         with self._lock:
-            self._gauges[n] = value
+            self._gauges[(n, lk)] = value
         for s in self._sinks:
-            s.emit("gauge", n, value)
+            s.emit("gauge", n, value, lk)
 
-    def add_sample(self, name, value: float) -> None:
+    def add_sample(self, name, value: float, labels=None) -> None:
         n = self._name(name)
+        lk = _labels_key(labels)
         with self._lock:
-            self._samples.setdefault(n, _Sample()).add(value)
+            self._samples.setdefault((n, lk), _Sample()).add(value)
         for s in self._sinks:
-            s.emit("sample", n, value * 1000.0)
+            s.emit("sample", n, value * 1000.0, lk)
 
-    def measure_since(self, name, t0: float) -> None:
-        self.add_sample(name, time.perf_counter() - t0)
+    def measure_since(self, name, t0: float, labels=None) -> None:
+        self.add_sample(name, time.perf_counter() - t0, labels=labels)
 
     # ---------------------------------------------------------------- dump
 
+    @staticmethod
+    def _finite(v: float) -> float:
+        """JSON-safe: json.dumps of Infinity/NaN is invalid JSON for
+        every spec-compliant consumer (allow_nan defaults on, but the
+        output breaks jq/browsers); clamp degenerate aggregates."""
+        return v if v == v and abs(v) != float("inf") else 0.0
+
     def dump(self) -> dict:
         """/v1/agent/metrics shape (agent/agent_endpoint.go
-        AgentMetrics)."""
+        AgentMetrics).  Unlabeled entries keep the classic two-key
+        shape; labeled entries add a "Labels" object (the go-metrics
+        DisplayMetrics Labels field).  Samples carry the reservoir
+        quantiles alongside the aggregate."""
+
+        def ent(k: Tuple[str, LabelKey], **fields) -> dict:
+            d = {"Name": k[0], **fields}
+            if k[1]:
+                d["Labels"] = dict(k[1])
+            return d
+
         with self._lock:
+            samples = []
+            for k, s in sorted(self._samples.items()):
+                p50, p90, p99 = s.quantiles()
+                samples.append(ent(
+                    k, Count=s.count,
+                    Sum=round(self._finite(s.total), 6),
+                    Min=round(self._finite(s.min), 6),
+                    Max=round(self._finite(s.max), 6),
+                    Mean=round(self._finite(s.total / s.count)
+                               if s.count else 0.0, 6),
+                    P50=round(self._finite(p50), 6),
+                    P90=round(self._finite(p90), 6),
+                    P99=round(self._finite(p99), 6)))
             return {
                 "Timestamp": time.strftime("%Y-%m-%d %H:%M:%S +0000",
                                            time.gmtime()),
-                "Gauges": [{"Name": k, "Value": v}
+                "Gauges": [ent(k, Value=v)
                            for k, v in sorted(self._gauges.items())],
-                "Counters": [{"Name": k, "Count": v}
+                "Counters": [ent(k, Count=v)
                              for k, v in sorted(self._counters.items())],
-                "Samples": [{"Name": k, "Count": s.count,
-                             "Sum": round(s.total, 6),
-                             "Min": round(s.min, 6),
-                             "Max": round(s.max, 6),
-                             "Mean": round(s.total / s.count, 6)
-                             if s.count else 0.0}
-                            for k, s in sorted(self._samples.items())],
+                "Samples": samples,
             }
 
+    # ---------------------------------------------------------- prometheus
+
+    @staticmethod
+    def _sanitize(n: str) -> str:
+        return "".join(c if c.isalnum() or c == "_" else "_" for c in n)
+
+    def _expo_names(self, kinds_names: Iterable[Tuple[str, str]],
+                    reserve: Iterable[str] = ()
+                    ) -> Dict[Tuple[str, str], str]:
+        """Deterministic collision-free exposition names, keyed by
+        (kind, name).
+
+        Sanitizing '.'/'-' to '_' can map two distinct metric names to
+        one exposition name (consul.rpc.cross-dc vs consul.rpc.cross_dc),
+        and one raw name registered as two kinds collides with itself —
+        either way duplicate `# TYPE` blocks are invalid exposition.
+        The first entry in sorted order keeps the plain sanitized form;
+        later colliders get a stable crc32 suffix (of the name for a
+        name collision, of kind:name for a cross-kind one).  The
+        allocation is deterministic for a given live metric set — a
+        late-registering collider that sorts earlier will claim the
+        plain name on the NEXT scrape (restart-stable beats within-run
+        stable; colliding names are a bug `tools/metrics_audit.py`
+        exists to catch).
+
+        `reserve`: exposition names claimed out-of-band (a summary's
+        _sum/_count/_min/_max companions) — a real metric landing on
+        one gets suffixed instead of splitting the companion series."""
+        out: Dict[Tuple[str, str], str] = {}
+        taken: Dict[str, Tuple[str, str]] = {
+            r: ("#reserved", r) for r in reserve}
+        for kind, name in sorted(set(kinds_names),
+                                 key=lambda kn: (kn[1], kn[0])):
+            san = self._sanitize(name)
+            if san in taken and taken[san] != (kind, name):
+                tag = name if taken[san][1] != name else f"{kind}:{name}"
+                san = f"{san}_{zlib.crc32(tag.encode()) & 0xFFFFFFFF:08x}"
+            taken.setdefault(san, (kind, name))
+            out[(kind, name)] = san
+        return out
+
+    @staticmethod
+    def _labels_expo(lk: LabelKey, extra: Tuple[Tuple[str, str], ...] = ()
+                     ) -> str:
+        pairs = lk + extra
+        if not pairs:
+            return ""
+        body = ",".join(
+            '%s="%s"' % (Registry._sanitize(k),
+                         v.replace("\\", "\\\\").replace('"', '\\"'))
+            for k, v in pairs)
+        return "{" + body + "}"
 
     def prometheus(self) -> str:
         """Prometheus text exposition (the PrometheusOpts role,
         lib/telemetry.go:200; served at /v1/agent/metrics
-        ?format=prometheus like the reference's
-        agent_endpoint.go AgentMetrics prometheus handler).
+        ?format=prometheus like the reference's agent_endpoint.go
+        AgentMetrics prometheus handler).
 
-        Names sanitize '.'/'-' to '_'; counters map to `counter`,
-        gauges to `gauge`, and samples expose the go-metrics summary
-        shape as _count/_sum plus min/max gauges (quantile streams
-        aren't tracked; min/max is what the in-memory sink has)."""
-
-        def san(n: str) -> str:
-            return "".join(c if c.isalnum() or c == "_" else "_"
-                           for c in n)
-
+        Names sanitize '.'/'-' to '_' with deterministic collision
+        suffixes (one `# TYPE` block per exposition name); labels render
+        as {k="v"}; samples expose the full summary shape —
+        _sum/_count plus quantile series and min/max gauges."""
         with self._lock:
-            out = []
-            for k, v in sorted(self._counters.items()):
-                n = san(k)
-                out.append(f"# TYPE {n} counter")
-                out.append(f"{n} {v:g}")
-            for k, v in sorted(self._gauges.items()):
-                n = san(k)
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            samples = {k: (s.count, s.total, s.min, s.max, s.quantiles())
+                       for k, s in self._samples.items()}
+
+        # min/max companions (the in-memory sink's extra aggregate),
+        # keyed by their OWNING sample — exposition names derive from
+        # the summary's allocation below
+        mins: Dict[Tuple[str, LabelKey], float] = {}
+        maxs: Dict[Tuple[str, LabelKey], float] = {}
+        for k, (count, _, mn, mx, _) in samples.items():
+            if count:
+                mins[k] = mn
+                maxs[k] = mx
+
+        # one namespace across kinds: a counter and a gauge landing on
+        # the same exposition name is a collision too (even when the
+        # raw metric names are identical).  Reserve every summary's
+        # companion names (_sum/_count data lines, _min/_max gauges) so
+        # a real metric that sanitizes onto one gets suffixed instead
+        # of emitting a duplicate/conflicting TYPE block.
+        reserve = [self._sanitize(k[0]) + suffix
+                   for k in samples
+                   for suffix in ("_sum", "_count", "_min", "_max")]
+        expo = self._expo_names(
+            [("counter", k[0]) for k in counters]
+            + [("gauge", k[0]) for k in gauges]
+            + [("summary", k[0]) for k in samples],
+            reserve=reserve)
+
+        out = []
+
+        def series(kind: str, data: dict, fmt) -> None:
+            by_name: Dict[str, list] = defaultdict(list)
+            for (name, lk), v in data.items():
+                by_name[expo[(kind, name)]].append((lk, v))
+            for n in sorted(by_name):
+                out.append(f"# TYPE {n} {kind}")
+                for lk, v in sorted(by_name[n]):
+                    fmt(n, lk, v)
+
+        series("counter", counters,
+               lambda n, lk, v: out.append(
+                   f"{n}{self._labels_expo(lk)} {v:g}"))
+        series("gauge", gauges,
+               lambda n, lk, v: out.append(
+                   f"{n}{self._labels_expo(lk)} {v:g}"))
+
+        def fmt_sample(n, lk, v):
+            count, total, mn, mx, (p50, p90, p99) = v
+            for q, qv in (("0.5", p50), ("0.9", p90), ("0.99", p99)):
+                out.append(f"{n}{self._labels_expo(lk, (('quantile', q),))}"
+                           f" {qv:g}")
+            out.append(f"{n}_sum{self._labels_expo(lk)} {total:g}")
+            out.append(f"{n}_count{self._labels_expo(lk)} {count}")
+
+        series("summary", samples, fmt_sample)
+        for suffix, table in (("_min", mins), ("_max", maxs)):
+            by_name: Dict[str, list] = defaultdict(list)
+            for (name, lk), v in table.items():
+                by_name[expo[("summary", name)] + suffix].append((lk, v))
+            for n in sorted(by_name):
                 out.append(f"# TYPE {n} gauge")
-                out.append(f"{n} {v:g}")
-            for k, s in sorted(self._samples.items()):
-                n = san(k)
-                out.append(f"# TYPE {n} summary")
-                out.append(f"{n}_sum {s.total:g}")
-                out.append(f"{n}_count {s.count}")
-                if s.count:
-                    out.append(f"# TYPE {n}_min gauge")
-                    out.append(f"{n}_min {s.min:g}")
-                    out.append(f"# TYPE {n}_max gauge")
-                    out.append(f"{n}_max {s.max:g}")
-            return "\n".join(out) + "\n"
+                for lk, v in sorted(by_name[n]):
+                    out.append(f"{n}{self._labels_expo(lk)} {v:g}")
+        return "\n".join(out) + "\n"
 
 
 # process-wide default registry (go-metrics global pattern)
@@ -228,17 +427,17 @@ def default_registry() -> Registry:
     return _default
 
 
-def incr_counter(name, value: float = 1.0) -> None:
-    _default.incr_counter(name, value)
+def incr_counter(name, value: float = 1.0, labels=None) -> None:
+    _default.incr_counter(name, value, labels=labels)
 
 
-def set_gauge(name, value: float) -> None:
-    _default.set_gauge(name, value)
+def set_gauge(name, value: float, labels=None) -> None:
+    _default.set_gauge(name, value, labels=labels)
 
 
-def add_sample(name, value: float) -> None:
-    _default.add_sample(name, value)
+def add_sample(name, value: float, labels=None) -> None:
+    _default.add_sample(name, value, labels=labels)
 
 
-def measure_since(name, t0: float) -> None:
-    _default.measure_since(name, t0)
+def measure_since(name, t0: float, labels=None) -> None:
+    _default.measure_since(name, t0, labels=labels)

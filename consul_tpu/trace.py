@@ -1,0 +1,122 @@
+"""Trace spans: request-scoped IDs propagated across the RPC boundary.
+
+The reference leans on go-metrics + hclog for causality; what operators
+actually need from `consul debug` is "where did THIS write spend its
+time" — so this module mints a trace ID at the HTTP/RPC entry point,
+carries it through leader forwarding and blocking-query retries, and
+records completed spans into a process-wide ring buffer that rides the
+debug archive (debug.py capture) next to the thread dumps.
+
+Design constraints, deliberate:
+
+  * **Zero-dependency, bounded memory.**  A deque ring (SPAN_RING
+    entries) guarded by one lock; a span record is a small dict.
+  * **Explicit propagation across threads/sockets.**  A contextvar
+    carries the current trace ID within a request thread; crossing the
+    forward coalescer or a socket RPC attaches the ID to the envelope
+    (never to the replicated raft command — payloads must stay
+    byte-identical across replicas).
+  * **Always-on but cheap.**  One perf_counter pair + one deque append
+    per span; no sampling machinery until profiles say otherwise.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+import uuid
+from collections import deque
+from contextlib import contextmanager
+from typing import List, Optional
+
+SPAN_RING = 2048
+
+_ring: deque = deque(maxlen=SPAN_RING)
+_lock = threading.Lock()
+_current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "consul_tpu_trace_id", default=None)
+
+
+def new_trace_id() -> str:
+    """128-bit random, hex — the X-Consul-Trace-Id wire form."""
+    return uuid.uuid4().hex
+
+
+_ID_MAX = 64
+_ID_CHARS = frozenset("0123456789abcdefABCDEF-")
+
+
+def sanitize_id(raw: Optional[str]) -> Optional[str]:
+    """Validate a client-supplied trace id: hex/hyphen, <= 64 chars
+    (new_trace_id's form, or a dashed UUID).  Anything else returns
+    None so the caller mints a fresh id — an unbounded header must not
+    occupy ring slots, RPC envelopes, and debug archives cluster-wide
+    (the rpc method-label allowlist applies the same rule)."""
+    if not raw or len(raw) > _ID_MAX:
+        return None
+    return raw if all(c in _ID_CHARS for c in raw) else None
+
+
+def current_trace() -> Optional[str]:
+    return _current.get()
+
+
+def set_current(trace_id: Optional[str]):
+    """Bind the thread/task-local current trace; returns the reset
+    token (pass to `reset`)."""
+    return _current.set(trace_id)
+
+
+def reset(token) -> None:
+    _current.reset(token)
+
+
+def record(name: str, trace_id: Optional[str], start_wall: float,
+           dur_s: float, **attrs) -> None:
+    """Append one completed span.  `attrs` values must be JSON-safe
+    scalars (they ride /v1/agent/traces and the debug archive)."""
+    rec = {
+        "trace_id": trace_id or "",
+        "name": name,
+        "start": round(start_wall, 6),
+        "dur_ms": round(dur_s * 1000.0, 3),
+        "thread": threading.current_thread().name,
+    }
+    if attrs:
+        rec["attrs"] = {k: v for k, v in attrs.items() if v is not None}
+    with _lock:
+        _ring.append(rec)
+
+
+@contextmanager
+def span(name: str, trace_id: Optional[str] = None, **attrs):
+    """Record a span around the body.  trace_id defaults to the
+    contextvar-bound current trace (empty string if none — spans
+    without a trace still land in the ring for profiling)."""
+    tid = trace_id if trace_id is not None else _current.get()
+    wall = time.time()
+    t0 = time.perf_counter()
+    try:
+        yield tid
+    finally:
+        record(name, tid, wall, time.perf_counter() - t0, **attrs)
+
+
+def dump(limit: Optional[int] = None,
+         trace_id: Optional[str] = None) -> List[dict]:
+    """Snapshot of the ring, oldest first; optionally filtered to one
+    trace and/or capped to the newest `limit` records."""
+    with _lock:
+        out = list(_ring)
+    if trace_id:
+        out = [r for r in out if r["trace_id"] == trace_id]
+    if limit is not None and limit >= 0:
+        # out[-0:] is the WHOLE list — limit=0 must mean zero records
+        out = out[-limit:] if limit else []
+    return out
+
+
+def clear() -> None:
+    with _lock:
+        _ring.clear()

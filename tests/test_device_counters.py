@@ -1,0 +1,134 @@
+"""Device-side sim telemetry: counters accumulated inside the jitted
+tick, one-transfer summaries, oracle gauge publication, and the
+metrics_audit naming/cardinality gates.
+"""
+
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf, swim
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+
+def _pool(n=32, seed=3, p_loss=0.05):
+    params = serf.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=n, rumor_slots=8,
+                                        p_loss=p_loss, seed=seed))
+    return params, serf.init_state(params)
+
+
+def test_counters_accumulate_inside_jitted_step():
+    params, s = _pool()
+    assert np.asarray(s.swim.ctr).sum() == 0.0
+    step = jax.jit(serf.step, static_argnums=0)
+    for _ in range(3 * params.swim.probe_period_ticks):
+        s = step(params, s)
+    ctr = np.asarray(s.swim.ctr)
+    # every probe round sends ~N direct probes; most ack in a healthy pool
+    assert ctr[swim.CTR_PROBES_SENT] > 0
+    assert ctr[swim.CTR_PROBE_ACKS] > 0
+    assert ctr[swim.CTR_PROBE_ACKS] <= ctr[swim.CTR_PROBES_SENT]
+    # cumulative: another tick never decreases any counter
+    before = ctr.copy()
+    s = step(params, s)
+    after = np.asarray(s.swim.ctr)
+    assert (after >= before).all()
+
+
+def test_kill_shows_up_in_failure_counters_and_queue_gauges():
+    params, s = _pool(p_loss=0.0)
+    step = jax.jit(serf.step, static_argnums=0)
+    mfn = jax.jit(serf.metrics_vector, static_argnums=0)
+    for _ in range(2 * params.swim.probe_period_ticks):
+        s = step(params, s)
+    s = s.replace(swim=swim.kill(s.swim, 5))
+    for _ in range(6 * params.swim.probe_period_ticks):
+        s = step(params, s)
+    m = dict(zip(swim.METRIC_NAMES, np.asarray(mfn(params, s))))
+    assert m["probe.failed"] >= 1
+    assert m["suspicion.started"] >= 1
+    # the suspicion (or its dead conversion) occupies the rumor table
+    assert m["queue.suspect"] + m["queue.dead"] >= 1
+    assert m["queue.depth"] >= m["queue.suspect"]
+    assert m["members.alive"] == 31
+    assert 0.0 <= m["convergence.fraction"] <= 1.0
+    assert 0.0 <= m["slot.utilization"] <= 1.0
+
+
+def test_metrics_vector_matches_names_and_is_one_transfer():
+    params, s = _pool(n=16)
+    vec = jax.jit(serf.metrics_vector, static_argnums=0)(params, s)
+    assert vec.shape == (len(swim.METRIC_NAMES),)
+    vals = np.asarray(vec)          # single host fetch for the scrape
+    assert np.isfinite(vals).all()
+    m = dict(zip(swim.METRIC_NAMES, vals))
+    assert m["members.alive"] == 16.0
+    assert m["tick"] == 0.0
+
+
+def test_gossip_dissemination_counters_flow():
+    params, s = _pool(n=32, p_loss=0.2)
+    step = jax.jit(serf.step, static_argnums=0)
+    # a leave originates a rumor → dissemination serves/delivers it
+    s = s.replace(swim=swim.leave(params.swim, s.swim, 7))
+    for _ in range(8):
+        s = step(params, s)
+    ctr = np.asarray(s.swim.ctr)
+    assert ctr[swim.CTR_GOSSIP_SERVED] > 0
+    assert ctr[swim.CTR_GOSSIP_DELIVERED] > 0
+    # lossy contacts are counted too (p_loss=0.2 over 32*2*8 contacts)
+    assert ctr[swim.CTR_GOSSIP_LOST] > 0
+
+
+def test_oracle_publishes_serf_gauges():
+    from consul_tpu.oracle import GossipOracle
+    from consul_tpu.telemetry import Registry
+
+    o = GossipOracle(GossipConfig.lan(),
+                     SimConfig(n_nodes=16, rumor_slots=8, seed=9))
+    o.advance(2 * o.params.swim.probe_period_ticks)
+    reg = Registry(prefix="consul")
+    m = o.publish_sim_metrics(registry=reg)
+    assert m["probe.sent"] > 0
+    names = {g["Name"] for g in reg.dump()["Gauges"]}
+    assert "consul.serf.probe.sent" in names
+    assert "consul.serf.queue.depth" in names
+    assert "consul.serf.convergence.fraction" in names
+    # publication is idempotent and cheap to repeat (host-sync only)
+    o.publish_sim_metrics(registry=reg)
+
+
+def test_metrics_audit_checks():
+    from metrics_audit import (audit_cardinality, audit_names,
+                               audit_prometheus)
+
+    good = {"Counters": [{"Name": "consul.rpc.request",
+                          "Labels": {"method": "apply"}}],
+            "Gauges": [{"Name": "consul.raft.leader.lastContact"}],
+            "Samples": [{"Name": "consul.ae.sync"}]}
+    assert audit_names(good) == []
+    assert audit_cardinality(good) == []
+
+    bad = {"Counters": [{"Name": "no_prefix.thing"},
+                        {"Name": "consul.bad name"}],
+           "Gauges": [], "Samples": []}
+    assert len(audit_names(bad)) == 2
+
+    # unbounded label cardinality: one metric, many label sets
+    wide = {"Counters": [{"Name": "consul.x",
+                          "Labels": {"req": str(i)}}
+                         for i in range(100)],
+            "Gauges": [], "Samples": []}
+    assert audit_cardinality(wide, max_sets=64)
+
+    assert audit_prometheus("# TYPE a counter\na 1\n"
+                            "# TYPE a gauge\na 2\n")
+    assert audit_prometheus("# TYPE a counter\na 1\n"
+                            "# TYPE b gauge\nb 2\n") == []

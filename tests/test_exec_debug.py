@@ -120,5 +120,17 @@ def test_cli_exec_and_operator(tmp_path):
         dbg = tmp_path / "dbg.tgz"
         rc, out = run("debug", "-output", str(dbg))
         assert rc == 0 and dbg.exists()
+        # the archive carries a prometheus snapshot + the trace ring
+        # (acceptance shape of the observability PR)
+        with tarfile.open(dbg, "r:gz") as tar:
+            names = set(tar.getnames())
+            assert "capture_error.txt" not in names, \
+                tar.extractfile("capture_error.txt").read()
+            assert "0/metrics.prom" in names
+            assert "trace.json" in names
+            prom = tar.extractfile("0/metrics.prom").read().decode()
+            assert "# TYPE consul_http_get counter" in prom
+            spans = json.loads(tar.extractfile("trace.json").read())
+            assert any(s["name"] == "http.request" for s in spans)
     finally:
         a.stop()

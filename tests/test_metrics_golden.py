@@ -1,0 +1,142 @@
+"""Golden + shape tests for the upgraded telemetry registry.
+
+Satellites of the observability PR: a byte-exact golden of the
+prometheus text exposition (labels, quantile series, deterministic
+sanitize-collision suffixes, unique # TYPE blocks), the dump() summary
+shape (quantiles present, JSON-safe), and the live
+/v1/agent/metrics?format=prometheus endpoint structure.
+"""
+
+import json
+import os
+
+from consul_tpu.telemetry import Registry
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden",
+                      "metrics_prometheus.txt")
+
+
+def _build_registry() -> Registry:
+    """Deterministic fixture: labeled counters, a sanitize collision
+    (cross-dc vs cross_dc), gauges, and a 100-point latency stream
+    (inside the reservoir, so quantiles are exact)."""
+    r = Registry(prefix="consul")
+    r.incr_counter(("rpc", "request"), 3.0, labels={"method": "apply"})
+    r.incr_counter(("rpc", "request"), 1.0, labels={"method": "stats"})
+    r.incr_counter(("rpc", "cross-dc"), 2.0, labels={"dc": "dc2"})
+    r.incr_counter(("rpc", "cross_dc"), 5.0)      # sanitize collision
+    r.incr_counter(("http", "get"), 4.0)
+    r.set_gauge(("raft", "leader", "lastContact"), 12.5)
+    r.set_gauge(("rpc", "queries_blocking"), 2.0)
+    for v in range(1, 101):
+        r.add_sample(("raft", "commitTime"), v / 1000.0)
+    r.add_sample(("ae", "sync"), 0.5, labels={"type": "full"})
+    return r
+
+
+def test_prometheus_exposition_matches_golden():
+    got = _build_registry().prometheus()
+    with open(GOLDEN) as f:
+        want = f.read()
+    assert got == want
+
+
+def test_prometheus_type_blocks_unique_and_collisions_disambiguated():
+    text = _build_registry().prometheus()
+    types = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE ")]
+    assert len(types) == len(set(types)), "duplicate # TYPE blocks"
+    # sorted-first name keeps the plain form; the collider gets a
+    # deterministic crc suffix
+    assert "consul_rpc_cross_dc{dc=\"dc2\"} 2" in text
+    assert "# TYPE consul_rpc_cross_dc_f2d13e79 counter" in text
+    # quantile series present for summaries
+    assert 'consul_raft_commitTime{quantile="0.5"} 0.051' in text
+    assert 'consul_raft_commitTime{quantile="0.99"} 0.1' in text
+    # labeled summary merges its labels with the quantile label
+    assert 'consul_ae_sync{type="full",quantile="0.9"} 0.5' in text
+
+
+def test_dump_shape_quantiles_and_json_safe():
+    d = _build_registry().dump()
+    s = next(x for x in d["Samples"]
+             if x["Name"] == "consul.raft.commitTime")
+    # exact nearest-rank over 100 in-reservoir points
+    assert s["P50"] == 0.051 and s["P90"] == 0.091 and s["P99"] == 0.1
+    assert s["Count"] == 100 and s["Min"] == 0.001 and s["Max"] == 0.1
+    # labeled entries carry Labels; unlabeled keep the classic shape
+    labeled = next(x for x in d["Samples"]
+                   if x["Name"] == "consul.ae.sync")
+    assert labeled["Labels"] == {"type": "full"}
+    assert "Labels" not in s
+    assert {"Name": "consul.http.get", "Count": 4.0} in d["Counters"]
+    # strict JSON (no Infinity/NaN anywhere — jq/browser safe)
+    json.dumps(d, allow_nan=False)
+
+
+def test_labeled_series_aggregate_independently():
+    r = Registry(prefix="t")
+    r.incr_counter("reqs", 1.0, labels={"m": "a"})
+    r.incr_counter("reqs", 1.0, labels={"m": "a"})
+    r.incr_counter("reqs", 5.0, labels={"m": "b"})
+    r.incr_counter("reqs", 7.0)
+    d = d0 = {(c["Name"], tuple(sorted((c.get("Labels") or {}).items()))):
+              c["Count"] for c in r.dump()["Counters"]}
+    assert d[("t.reqs", (("m", "a"),))] == 2.0
+    assert d[("t.reqs", (("m", "b"),))] == 5.0
+    assert d[("t.reqs", ())] == 7.0
+    # label order is normalized — {a,b} and {b,a} are one series
+    r.set_gauge("g", 1.0, labels={"x": "1", "y": "2"})
+    r.set_gauge("g", 3.0, labels={"y": "2", "x": "1"})
+    gauges = [g for g in r.dump()["Gauges"] if g["Name"] == "t.g"]
+    assert len(gauges) == 1 and gauges[0]["Value"] == 3.0
+
+
+def test_reservoir_is_bounded_and_still_estimates():
+    from consul_tpu.telemetry import _Sample
+    s = _Sample()
+    for v in range(10_000):
+        s.add(float(v))
+    assert len(s._res) == _Sample.RESERVOIR
+    p50, p90, p99 = s.quantiles()
+    # a uniform stream 0..9999: generous tolerance for the estimator
+    assert 3000 < p50 < 7000
+    assert p90 > p50 and p99 >= p90
+
+
+def test_live_prometheus_endpoint_structure():
+    """/v1/agent/metrics?format=prometheus over an ApiServer (plain
+    store + NullOracle — no sim device needed): parseable exposition,
+    unique TYPE blocks, summary quantiles present."""
+    import sys
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from metrics_audit import audit_prometheus
+
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.catalog.store import StateStore
+
+    api = ApiServer(StateStore(), node_name="golden")
+    api.start()
+    try:
+        # bump an http counter + latency summary, then scrape
+        urllib.request.urlopen(api.address + "/v1/agent/self",
+                               timeout=15).read()
+        body = urllib.request.urlopen(
+            api.address + "/v1/agent/metrics?format=prometheus",
+            timeout=15).read().decode()
+        assert audit_prometheus(body) == []
+        assert "# TYPE consul_http_get counter" in body
+        assert "consul_catalog_index" in body
+        assert 'consul_http_latency{quantile="0.5"}' in body
+        assert "consul_http_latency_count" in body
+        # JSON dump remains strict-JSON over the wire
+        out = json.loads(urllib.request.urlopen(
+            api.address + "/v1/agent/metrics", timeout=15).read())
+        sample = next(x for x in out["Samples"]
+                      if x["Name"] == "consul.http.latency")
+        assert {"P50", "P90", "P99"} <= set(sample)
+    finally:
+        api.stop()

@@ -39,6 +39,25 @@ def _consistent_get(client, key, budget=20.0):
                 raise
 
 
+def test_rpc_metric_allowlist_tracks_dispatcher():
+    """_KNOWN_METHODS (the rpc metric label allowlist) must stay in
+    lockstep with the methods server.py's _handle_rpc dispatches — a
+    new RPC method added without updating the set would silently lose
+    its per-method metrics into the 'other' label."""
+    import inspect
+    import re
+
+    from consul_tpu import server as server_mod
+    from consul_tpu.rpc.net import _KNOWN_METHODS
+
+    src = inspect.getsource(server_mod.Server._handle_rpc)
+    served = set(re.findall(r'method == "([a-z_]+)"', src))
+    assert served, "no dispatch patterns found in _handle_rpc"
+    assert served == _KNOWN_METHODS, (
+        f"dispatcher-only: {served - _KNOWN_METHODS}, "
+        f"allowlist-only: {_KNOWN_METHODS - served}")
+
+
 def test_frame_roundtrip():
     a, b = socket.socketpair()
     send_frame(a, {"type": "rpc", "id": 1, "method": "x",

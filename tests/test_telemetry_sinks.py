@@ -1,0 +1,119 @@
+"""UDP/TCP sink behavior: line formats, tags, and hot-path isolation.
+
+Satellites of the observability PR: loopback-socket assertions on the
+statsd/dogstatsd line protocol (incl. |#tags), proof that an
+unreachable statsite collector never blocks incr_counter, and the
+StatsiteSink in-flight-line requeue across a collector restart.
+"""
+
+import socket
+import time
+
+from consul_tpu.telemetry import Registry
+
+
+def _udp_rx():
+    rx = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    rx.bind(("127.0.0.1", 0))
+    rx.settimeout(5.0)
+    return rx, rx.getsockname()[1]
+
+
+def test_statsd_line_format_all_kinds():
+    rx, port = _udp_rx()
+    r = Registry(prefix="t")
+    r.add_statsd_sink(f"127.0.0.1:{port}")
+    r.incr_counter("hits", 2.0)
+    r.set_gauge(("pool", "size"), 7)
+    r.add_sample("lat", 0.25)          # samples emit ms on the wire
+    lines = sorted(rx.recvfrom(512)[0] for _ in range(3))
+    assert lines == [b"t.hits:2.0|c", b"t.lat:250.0|ms",
+                     b"t.pool.size:7|g"]
+    # labels are dropped on the plain protocol, never mangled into it
+    r.incr_counter("hits", labels={"dc": "dc1"})
+    assert rx.recvfrom(512)[0] == b"t.hits:1.0|c"
+    rx.close()
+
+
+def test_dogstatsd_global_tags_and_per_metric_labels():
+    rx, port = _udp_rx()
+    r = Registry(prefix="t")
+    r.add_dogstatsd_sink(f"127.0.0.1:{port}", tags=["dc:dc1"])
+    r.incr_counter("reqs")
+    assert rx.recvfrom(512)[0] == b"t.reqs:1.0|c|#dc:dc1"
+    # per-metric labels append after the configured globals
+    r.incr_counter("reqs", labels={"method": "apply"})
+    assert rx.recvfrom(512)[0] == b"t.reqs:1.0|c|#dc:dc1,method:apply"
+    # no globals → labels alone
+    r2 = Registry(prefix="t")
+    r2.add_dogstatsd_sink(f"127.0.0.1:{port}")
+    r2.set_gauge("depth", 3, labels={"q": "fwd"})
+    assert rx.recvfrom(512)[0] == b"t.depth:3|g|#q:fwd"
+    rx.close()
+
+
+def test_unreachable_statsite_never_blocks_emission():
+    """The whole point of the queue + background writer: a collector
+    that is down (connection refused, or worse a blackhole) must cost
+    the instrumented hot path nothing."""
+    # grab a port nobody listens on
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    r = Registry(prefix="t")
+    r.add_statsite_sink(f"127.0.0.1:{port}")
+    t0 = time.perf_counter()
+    for _ in range(2000):
+        r.incr_counter("hot")
+    elapsed = time.perf_counter() - t0
+    # 2000 emissions must complete in far less than one dial timeout —
+    # they only touch the in-memory queue (generous CI bound)
+    assert elapsed < 1.0, f"incr_counter blocked: {elapsed:.3f}s"
+
+
+def test_statsite_requeues_inflight_line_across_restart():
+    """A sendall failure must not silently drop the in-flight line:
+    the writer redials/retries and requeues, so the line arrives once
+    the collector comes back."""
+    ls = socket.socket()
+    ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls.bind(("127.0.0.1", 0))
+    port = ls.getsockname()[1]
+    ls.listen(1)
+
+    r = Registry(prefix="t")
+    r.add_statsite_sink(f"127.0.0.1:{port}")
+    r.incr_counter("first")
+    conn, _ = ls.accept()
+    conn.settimeout(5.0)
+    assert conn.recv(512) == b"t.first:1.0|c\n"
+
+    # hard-kill the collector: RST the live conn and close the listener
+    conn.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                    b"\x01\x00\x00\x00\x00\x00\x00\x00")
+    conn.close()
+    ls.close()
+    time.sleep(0.1)
+    r.set_gauge("survivor", 9)     # lands while the collector is down
+
+    # collector restarts on the same port; the requeued line must
+    # eventually flush (writer backs off 0.5s between dials)
+    ls2 = socket.socket()
+    ls2.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    ls2.bind(("127.0.0.1", port))
+    ls2.listen(1)
+    ls2.settimeout(10.0)
+    conn2, _ = ls2.accept()
+    conn2.settimeout(10.0)
+    got = b""
+    deadline = time.time() + 10.0
+    while b"t.survivor:9|g\n" not in got and time.time() < deadline:
+        chunk = conn2.recv(512)
+        if not chunk:
+            break
+        got += chunk
+    assert b"t.survivor:9|g\n" in got, got
+    conn2.close()
+    ls2.close()

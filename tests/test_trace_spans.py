@@ -1,0 +1,194 @@
+"""Trace-span facility: ring buffer, HTTP minting, cross-socket
+propagation (one trace ID spanning follower → leader → apply), and the
+debug-archive capture.
+"""
+
+import io
+import json
+import socket
+import tarfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu import trace
+from consul_tpu.consensus.raft import RaftConfig
+from consul_tpu.rpc import TcpTransport
+from consul_tpu.server import Server
+
+
+# ------------------------------------------------------------- primitives
+
+def test_span_ring_records_and_filters():
+    trace.clear()
+    tid = trace.new_trace_id()
+    with trace.span("unit.op", trace_id=tid, op="kv_set"):
+        pass
+    with trace.span("unit.other", trace_id=trace.new_trace_id()):
+        pass
+    spans = trace.dump(trace_id=tid)
+    assert [s["name"] for s in spans] == ["unit.op"]
+    assert spans[0]["attrs"]["op"] == "kv_set"
+    assert spans[0]["dur_ms"] >= 0.0
+    # the ring serializes (it rides /v1/agent/traces + debug archives)
+    json.dumps(trace.dump(), allow_nan=False)
+    # limit caps to the newest records
+    assert len(trace.dump(limit=1)) == 1
+
+
+def test_contextvar_binding_and_reset():
+    trace.clear()
+    assert trace.current_trace() is None
+    tok = trace.set_current("abc123")
+    try:
+        assert trace.current_trace() == "abc123"
+        with trace.span("inherits") as tid:
+            assert tid == "abc123"
+    finally:
+        trace.reset(tok)
+    assert trace.current_trace() is None
+    assert trace.dump(trace_id="abc123")[0]["name"] == "inherits"
+
+
+def test_client_trace_ids_are_validated():
+    """A client-supplied X-Consul-Trace-Id is only honored in the
+    hex/hyphen <=64-char wire form — garbage (or a 60KB header) must
+    not occupy ring slots and RPC envelopes cluster-wide."""
+    assert trace.sanitize_id("feedbeef" * 4) == "feedbeef" * 4
+    assert trace.sanitize_id("b4a2-11ee") == "b4a2-11ee"
+    assert trace.sanitize_id("") is None
+    assert trace.sanitize_id(None) is None
+    assert trace.sanitize_id("x" * 65) is None
+    assert trace.sanitize_id("not hex!") is None
+    assert trace.sanitize_id("A" * 70000) is None
+
+
+def test_ring_is_bounded():
+    trace.clear()
+    for i in range(trace.SPAN_RING + 50):
+        trace.record("flood", "t", time.time(), 0.0, i=i)
+    assert len(trace.dump()) == trace.SPAN_RING
+
+
+# ------------------------------------- forwarded write over real sockets
+
+class _TcpCluster:
+    """Socket-backed trio (the test_rpc.py pattern): a follower's write
+    forwards over the RPC port, so the trace must cross a real frame."""
+
+    def __init__(self, n=3, seed=11):
+        self.addresses = {}
+        ids = [f"server{i}" for i in range(n)]
+        self.servers = []
+        for i, nid in enumerate(ids):
+            transport = TcpTransport(self.addresses)
+            s = Server(nid, ids, transport, registry={},
+                       raft_config=RaftConfig(), seed=seed + i)
+            s.serve_rpc()
+            self.servers.append(s)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while self._running:
+            for s in self.servers:
+                s.tick(time.time())
+            time.sleep(0.01)
+
+    def wait_leader(self, max_s=10.0):
+        deadline = time.time() + max_s
+        while time.time() < deadline:
+            leaders = [s for s in self.servers if s.is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.05)
+        raise RuntimeError("no leader")
+
+    def stop(self):
+        self._running = False
+        self._thread.join(timeout=5.0)
+        for s in self.servers:
+            s.close_rpc()
+
+
+def test_forwarded_write_single_trace_follower_leader_apply():
+    c = _TcpCluster(3, seed=11)
+    try:
+        leader = c.wait_leader()
+        follower = next(s for s in c.servers if s is not leader)
+        trace.clear()
+        tid = trace.new_trace_id()
+        tok = trace.set_current(tid)
+        try:
+            ok, _ = follower.kv_set("traced", b"x")   # socket ForwardRPC
+        finally:
+            trace.reset(tok)
+        assert ok
+        spans = trace.dump(trace_id=tid)
+        names = {s["name"] for s in spans}
+        # the acceptance shape: ONE trace id spanning the follower's
+        # forward leg and the leader's apply leg
+        assert "rpc.forward" in names, spans
+        assert "leader.apply" in names, spans
+        fwd = next(s for s in spans if s["name"] == "rpc.forward")
+        app = next(s for s in spans if s["name"] == "leader.apply")
+        assert fwd["attrs"]["node"] == follower.node_id
+        assert app["attrs"]["node"] == leader.node_id
+        assert fwd["attrs"]["op"] == app["attrs"]["op"] == "kv_set"
+    finally:
+        c.stop()
+
+
+# ----------------------------------------------- HTTP minting + endpoint
+
+def test_http_mints_trace_and_serves_ring():
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.catalog.store import StateStore
+
+    api = ApiServer(StateStore(), node_name="tracer")
+    api.start()
+    try:
+        trace.clear()
+        # caller-supplied id is honored end to end
+        req = urllib.request.Request(api.address + "/v1/agent/self")
+        req.add_header("X-Consul-Trace-Id", "feedbeef" * 4)
+        urllib.request.urlopen(req, timeout=15).read()
+        spans = json.loads(urllib.request.urlopen(
+            api.address + "/v1/agent/traces?trace_id=" + "feedbeef" * 4,
+            timeout=15).read())
+        assert any(s["name"] == "http.request"
+                   and s["attrs"]["path"] == "/v1/agent/self"
+                   for s in spans)
+        # a bare request gets a minted id (non-empty trace_id)
+        urllib.request.urlopen(api.address + "/v1/status/leader",
+                               timeout=15).read()
+        allspans = json.loads(urllib.request.urlopen(
+            api.address + "/v1/agent/traces", timeout=15).read())
+        minted = [s for s in allspans
+                  if s.get("attrs", {}).get("path") == "/v1/status/leader"]
+        assert minted and all(len(s["trace_id"]) == 32 for s in minted)
+    finally:
+        api.stop()
+
+
+# ------------------------------------------------------- debug archive
+
+def test_debug_capture_includes_prometheus_and_traces():
+    from consul_tpu import debug, telemetry
+
+    telemetry.incr_counter(("http", "get"))
+    trace.clear()
+    with trace.span("capture.window", trace_id="t1"):
+        pass
+    blob = debug.capture(intervals=1, interval_s=0.0)
+    with tarfile.open(fileobj=io.BytesIO(blob), mode="r:gz") as tar:
+        names = tar.getnames()
+        assert "0/metrics.prom" in names
+        assert "trace.json" in names
+        prom = tar.extractfile("0/metrics.prom").read().decode()
+        assert "# TYPE consul_http_get counter" in prom
+        spans = json.loads(tar.extractfile("trace.json").read())
+        assert any(s["name"] == "capture.window" for s in spans)

@@ -1,0 +1,162 @@
+"""Metrics audit: naming conventions + label cardinality gate.
+
+Runs a short sim + in-process cluster to light up every instrumented
+hot path (raft, rpc forwarding, blocking queries, AE, the device-side
+serf counters), dumps the process registry, and FAILS on:
+
+  * naming-convention violations — every metric must be
+    `consul.<part>.<part>...` with parts in [A-Za-z0-9_-] (the
+    go-metrics dotted form; camelCase like commitTime/lastContact is
+    Consul-shaped and allowed);
+  * unbounded label cardinality — more than MAX_LABEL_SETS distinct
+    label sets on one metric name means someone put a per-request or
+    per-node value in a label (the prometheus cardinality foot-gun);
+  * invalid prometheus exposition — duplicate `# TYPE` blocks (the
+    sanitize-collision regression this PR fixed).
+
+Usage: JAX_PLATFORMS=cpu python tools/metrics_audit.py
+Exit 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+import time
+from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+NAME_RE = re.compile(r"^consul(\.[A-Za-z0-9_-]+)+$")
+MAX_LABEL_SETS = 64
+MAX_LABELS_PER_METRIC = 8
+
+
+def audit_names(dump: dict) -> List[str]:
+    """Naming-convention violations in a Registry.dump()."""
+    out = []
+    for section in ("Counters", "Gauges", "Samples"):
+        for row in dump.get(section, []):
+            name = row.get("Name", "")
+            if not NAME_RE.match(name):
+                out.append(f"bad metric name ({section.lower()}): "
+                           f"{name!r} does not match {NAME_RE.pattern}")
+    return out
+
+
+def audit_cardinality(dump: dict,
+                      max_sets: int = MAX_LABEL_SETS) -> List[str]:
+    """Label-cardinality violations: distinct label sets per name."""
+    sets: dict = {}
+    out = []
+    for section in ("Counters", "Gauges", "Samples"):
+        for row in dump.get(section, []):
+            labels = row.get("Labels") or {}
+            if len(labels) > MAX_LABELS_PER_METRIC:
+                out.append(f"too many labels on {row['Name']!r}: "
+                           f"{len(labels)} > {MAX_LABELS_PER_METRIC}")
+            key = (section, row["Name"])
+            sets.setdefault(key, set()).add(
+                tuple(sorted(labels.items())))
+    for (section, name), variants in sorted(sets.items()):
+        if len(variants) > max_sets:
+            out.append(f"unbounded label cardinality on {name!r}: "
+                       f"{len(variants)} label sets > {max_sets}")
+    return out
+
+
+def audit_prometheus(text: str) -> List[str]:
+    """Exposition-format violations: duplicate # TYPE blocks."""
+    seen: dict = {}
+    out = []
+    for line in text.splitlines():
+        if not line.startswith("# TYPE "):
+            continue
+        _, _, rest = line.partition("# TYPE ")
+        parts = rest.split()
+        if len(parts) != 2:
+            out.append(f"malformed TYPE line: {line!r}")
+            continue
+        name, kind = parts
+        if name in seen:
+            out.append(f"duplicate # TYPE block for {name!r} "
+                       f"({seen[name]} then {kind})")
+        seen[name] = kind
+    return out
+
+
+def _exercise() -> None:
+    """Light up the instrumented paths: a raft cluster with writes +
+    blocking queries, an AE pass, and the device-side sim counters."""
+    import threading
+
+    from consul_tpu.oracle import GossipOracle
+    from consul_tpu.config import GossipConfig, SimConfig
+    from consul_tpu.server import ServerCluster
+
+    oracle = GossipOracle(GossipConfig.lan(),
+                          SimConfig(n_nodes=32, rumor_slots=8,
+                                    p_loss=0.05, seed=3))
+    oracle.advance(12)
+    oracle.kill("node3")
+    oracle.advance(12)
+    oracle.publish_sim_metrics()
+
+    c = ServerCluster(3, seed=5)
+    leader = c.wait_leader()
+    follower = next(s for s in c.servers if s is not leader)
+    stop = threading.Event()
+
+    def drive():
+        while not stop.is_set():
+            c.step(0.05)
+            time.sleep(0.001)
+
+    t = threading.Thread(target=drive, daemon=True)
+    t.start()
+    try:
+        for i in range(4):
+            ok, _ = follower.kv_set(f"audit/{i}", b"v")
+            assert ok
+        # a blocking query that times out quickly (query counter +
+        # queries_blocking gauge)
+        leader.store.wait_for(leader.store.index, timeout=0.1)
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+
+    # AE: one full-sync pass over a local state
+    from consul_tpu.ae import StateSyncer
+    from consul_tpu.catalog.store import StateStore
+    from consul_tpu.local import LocalState
+    store = StateStore()
+    local = LocalState("audit-node", "127.0.0.1")
+    StateSyncer(local, store).sync_full_now()
+
+
+def main() -> int:
+    from consul_tpu import telemetry
+
+    _exercise()
+    reg = telemetry.default_registry()
+    dump = reg.dump()
+    violations = (audit_names(dump)
+                  + audit_cardinality(dump)
+                  + audit_prometheus(reg.prometheus()))
+    n = (len(dump["Counters"]) + len(dump["Gauges"])
+         + len(dump["Samples"]))
+    if violations:
+        for v in violations:
+            print(f"VIOLATION: {v}", file=sys.stderr)
+        print(f"metrics_audit: {len(violations)} violation(s) "
+              f"across {n} series", file=sys.stderr)
+        return 1
+    print(f"metrics_audit: OK — {n} series, names conform, "
+          f"label cardinality bounded, exposition valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
