@@ -1230,9 +1230,18 @@ class StateStore:
         from consul_tpu.connect import intentions as imod
         with self._lock:
             ints = [dict(v) for v in self._intentions.values()]
+            # candidates are plain (non-proxy, non-gateway) services —
+            # EXCEPT in the downstreams direction, where ingress
+            # gateways may dial the service and must appear (the
+            # reference's intentionTopologyTxn includes
+            # ServiceKindIngressGateway iff downstreams,
+            # state/intention.go:1009; ADVICE r5)
             candidates = sorted({
                 v["name"] for v in self._services.values()
-                if not v.get("kind") and v["name"] != name})
+                if (not v.get("kind")
+                    or (downstreams
+                        and v.get("kind") == "ingress-gateway"))
+                and v["name"] != name})
         match_by = "destination" if downstreams else "source"
         matched = [i for i in ints
                    if i[match_by] in (imod.WILDCARD, name)]

@@ -72,7 +72,7 @@ class EventState:
     e_start: jnp.ndarray     # [E] int32 origin tick
     know: jnp.ndarray        # [N, E] bool
     deliver_tick: jnp.ndarray  # [N, E] int32 first-delivery tick
-    sends_left: jnp.ndarray  # [N, E] int32
+    sends_left: jnp.ndarray  # [N, E] int8
 
 
 def init_state(params: EventParams) -> EventState:

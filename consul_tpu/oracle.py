@@ -38,6 +38,12 @@ class GossipOracle:
         self._state = serf.init_state(self.params,
                                       n_initial=self.sim.n_initial)
         self._lock = threading.RLock()
+        # deliberately NOT donate_argnums: oracle readers (members
+        # snapshots, the pacer's hard_sync, metrics scrapes) hold
+        # references to self._state across advance() calls from other
+        # threads; donation would delete those buffers under them.
+        # The bench and the batch tools own their state exclusively and
+        # DO donate (bench.py, tools/profile_swim.py).
         self._step = jax.jit(serf.step, static_argnums=0)
         self._metrics_fn = jax.jit(serf.metrics_vector, static_argnums=0)
         self._node_prefix = node_prefix

@@ -45,6 +45,32 @@ class NoLeaderError(Exception):
     """No leader available within the retry budget (structs.ErrNoLeader)."""
 
 
+_APPLY_TRANSIT_MARGIN = 0.25
+
+
+def _apply_wait_budget(args: dict, default: float = 5.0,
+                       cap: float = 10.0) -> float:
+    """Commit-wait for a forwarded apply, derived from the CALLER's
+    remaining RPC budget (shipped as `budget` by the forward
+    coalescer, which grants up to 10 s).  A transit margin is reserved
+    off the shipped budget so the DEFINITIVE response (success or the
+    leader's own timeout error) still reaches the caller before its
+    client.call deadline — waiting the full budget would hand a
+    near-deadline commit to a caller that already gave up, exactly the
+    ambiguity this path exists to narrow.  Falls back to the historic
+    5 s for callers that don't ship a budget; never below 50 ms or
+    above the coalescer's own cap."""
+    import math
+    try:
+        b = float(args["budget"])
+        if not math.isfinite(b):     # json accepts NaN/Infinity
+            raise ValueError(b)
+        b -= _APPLY_TRANSIT_MARGIN
+    except (KeyError, TypeError, ValueError):
+        b = default
+    return min(cap, max(0.05, b))
+
+
 class Server:
     def __init__(self, node_id: str, peers: List[str], transport: Transport,
                  registry: Dict[str, "Server"],
@@ -258,10 +284,15 @@ class Server:
             try:
                 if len(items) == 1:
                     it = items[0]
+                    # ship the remaining RPC budget with the call: the
+                    # leader waits for commit up to the CALLER's
+                    # deadline, not a fixed server-side constant —
+                    # narrowing the window where a caller is told
+                    # "timed out" for a write that later applies
                     it["result"] = client.call(
                         addr, "apply",
                         {"op": it["op"], "args": it["args"],
-                         "trace": it["trace"]},
+                         "trace": it["trace"], "budget": budget},
                         timeout=budget)
                     it["event"].set()
                     continue
@@ -269,7 +300,8 @@ class Server:
                     addr, "apply_batch",
                     {"items": [{"op": it["op"], "args": it["args"],
                                 "trace": it["trace"]}
-                               for it in items]},
+                               for it in items],
+                     "budget": budget},
                     timeout=budget)
                 results = (out or {}).get("results") or []
                 errors = (out or {}).get("errors") or []
@@ -297,14 +329,19 @@ class Server:
         if method == "apply":
             if not self.raft.is_leader():
                 raise NotLeaderError(self.raft.leader_id)
-            # the leader leg of a forwarded write: the span's trace id
-            # arrived on the RPC envelope, so follower → leader → apply
-            # reads as one trace in the ring buffer
+            # wait for commit as long as the CALLER still has RPC
+            # budget (the coalescer ships its remaining deadline in
+            # `budget`, granted up to 10 s) — a fixed 5.0 s here
+            # reported "apply timed out" to callers that still had
+            # budget, widening the failed-but-later-applied ambiguity
+            # window (ADVICE r5).  Clamped: a missing/garbage budget
+            # falls back to the old constant, never waits > 10 s.
+            wait_s = _apply_wait_budget(args)
             with trace.span("leader.apply", trace_id=args.get("trace"),
                             op=args.get("op"), node=self.node_id):
                 pend = self.raft.apply({"op": args["op"],
                                         "args": args.get("args") or {}})
-                if not pend.event.wait(5.0):
+                if not pend.event.wait(wait_s):
                     raise TimeoutError("apply timed out")
             if pend.error is not None:
                 raise pend.error
@@ -320,7 +357,10 @@ class Server:
             pends = self.raft.apply_many(
                 [{"op": it["op"], "args": it.get("args") or {}}
                  for it in args["items"]])
-            deadline = time.time() + 5.0
+            # group-commit wait bounded by the batch's shipped RPC
+            # budget (= the longest remaining caller deadline), not a
+            # fixed 5.0 s — see the "apply" branch note
+            deadline = time.time() + _apply_wait_budget(args)
             results, errors = [], []
             for pend in pends:
                 if not pend.event.wait(max(0.0,

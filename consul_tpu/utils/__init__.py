@@ -1,4 +1,4 @@
 from consul_tpu.utils import prng
-from consul_tpu.utils.sync import hard_sync
+from consul_tpu.utils.sync import donation, hard_sync
 
-__all__ = ["prng", "hard_sync"]
+__all__ = ["prng", "hard_sync", "donation"]

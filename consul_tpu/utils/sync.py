@@ -19,6 +19,18 @@ import jax
 import numpy as np
 
 
+def donation(*argnums: int) -> tuple:
+    """`donate_argnums` for a state-carry jit, gated off the CPU backend.
+
+    Donating the SwimState/ClusterState carry lets XLA update the
+    [N]-shaped state arrays in place instead of double-buffering
+    1M-row tensors in HBM; the CPU backend ignores donation and warns
+    on every call, so the gate keeps test logs clean.  Only donate when
+    the caller owns its state exclusively and always rebinds to the
+    output (bench/tool loops do; the oracle does NOT — see oracle.py)."""
+    return tuple(argnums) if jax.default_backend() != "cpu" else ()
+
+
 def hard_sync(tree, all_leaves: bool = False) -> None:
     """Block until `tree` has materialized via host transfer of one leaf
     (or every leaf when they may come from different dispatches)."""

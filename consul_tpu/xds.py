@@ -314,8 +314,17 @@ def clusters(snap) -> List[dict]:
             "lb_policy": "CLUSTER_PROVIDED",
             "connect_timeout": _duration(5),
         })
-    emitted = set()     # two chains sharing a target must not emit a
-    for up in snap.upstreams:  # duplicate name (envoy NACKs the push)
+    emitted = {}        # cluster name -> index in `out`: two chains
+    #                     sharing a target must not emit a duplicate
+    #                     name (envoy NACKs the push)
+    overridden = set()  # names whose emitted resource came from an
+    #                     operator override (an override beats a
+    #                     generated cluster; first override wins)
+    default_generated = set()   # names emitted by the DEFAULT-chain
+    #                     generated branch — the only ones an override
+    #                     may replace (a non-default chain's clusters
+    #                     always win, clusters.go chain.IsDefault)
+    for up in snap.upstreams:
         name = up.get("destination_name", "")
         chain = _upstream_chain(snap, name)
         if chain is None:
@@ -324,18 +333,37 @@ def clusters(snap) -> List[dict]:
             # clusters win (clusters.go: EnvoyClusterJSON is honored
             # iff chain.IsDefault).  Dedup on the name the resource
             # actually DECLARES: two clusters sharing a name would
-            # NACK the whole push.
+            # NACK the whole push — but an operator override must be
+            # checked BEFORE the dedup set: when an earlier upstream
+            # already emitted the generated cluster under the same
+            # name, the override REPLACES it instead of being
+            # silently dropped (ADVICE r5).
             override = _upstream_escape(
                 up, "envoy_cluster_json",
                 "envoy.config.cluster.v3.Cluster")
             cname_out = override.get("name", name) \
                 if override is not None else name
-            if cname_out in emitted:
-                continue
-            emitted.add(cname_out)
+            prev = emitted.get(cname_out)
             if override is not None:
+                if prev is not None:
+                    # replace ONLY a default-branch generated cluster;
+                    # a name owned by a discovery-chain cluster (or an
+                    # earlier override) keeps it — operator JSON on a
+                    # default chain must never hijack another
+                    # upstream's chain output
+                    if cname_out in default_generated:
+                        out[prev] = override
+                        overridden.add(cname_out)
+                        default_generated.discard(cname_out)
+                    continue
+                emitted[cname_out] = len(out)
+                overridden.add(cname_out)
                 out.append(override)
                 continue
+            if prev is not None:
+                continue
+            emitted[cname_out] = len(out)
+            default_generated.add(cname_out)
             out.append({
                 "@type": T + "envoy.config.cluster.v3.Cluster",
                 "name": name,
@@ -351,9 +379,10 @@ def clusters(snap) -> List[dict]:
         for node in _chain_resolver_nodes(chain):
             tid = node["Target"]
             cname = chain_cluster_name(tid, td)
-            if cname in emitted:
-                continue
-            emitted.add(cname)
+            prev = emitted.get(cname)
+            if prev is not None and cname not in overridden \
+                    and cname not in default_generated:
+                continue   # another chain already owns the name
             svc = chain["Targets"][tid]["Service"]
             cluster = {
                 "@type": T + "envoy.config.cluster.v3.Cluster",
@@ -369,7 +398,18 @@ def clusters(snap) -> List[dict]:
                     snap.leaf, snap.roots, f"{svc}.default.{td}"),
             }
             _inject_lb_to_cluster(node.get("LoadBalancer"), cluster)
-            out.append(cluster)
+            if prev is not None:
+                # a chain cluster always wins its name back from an
+                # operator override or a default-branch generated
+                # cluster that claimed it EARLIER in the upstream list
+                # (clusters.go: EnvoyClusterJSON is honored only iff
+                # chain.IsDefault — ordering must not change that)
+                out[prev] = cluster
+                overridden.discard(cname)
+                default_generated.discard(cname)
+            else:
+                emitted[cname] = len(out)
+                out.append(cluster)
     return out
 
 

@@ -133,3 +133,27 @@ def test_txn_atomicity_through_raft(cluster):
     for s in cluster.servers:
         assert s.store.kv_get("t1") is None
         assert s.store.kv_get("t2") is None
+
+
+def test_apply_wait_budget_derived_from_caller_rpc_budget():
+    """The leader's commit-wait for forwarded applies tracks the
+    CALLER's remaining RPC budget (shipped by the forward coalescer as
+    `budget`) minus a transit margin — the definitive response must
+    beat the caller's client.call deadline — clamped to [50 ms, 10 s];
+    absent or malformed budgets fall back to the historic 5 s
+    (ADVICE r5)."""
+    from consul_tpu.server import (_APPLY_TRANSIT_MARGIN,
+                                   _apply_wait_budget)
+    m = _APPLY_TRANSIT_MARGIN
+    assert _apply_wait_budget({}) == 5.0
+    assert _apply_wait_budget({"budget": None}) == 5.0
+    assert _apply_wait_budget({"budget": "junk"}) == 5.0
+    # json.loads accepts the NaN/Infinity literals — non-finite budgets
+    # are malformed, not a license to wait 50 ms (or forever)
+    assert _apply_wait_budget({"budget": float("nan")}) == 5.0
+    assert _apply_wait_budget({"budget": float("inf")}) == 5.0
+    assert abs(_apply_wait_budget({"budget": 8.2}) - (8.2 - m)) < 1e-9
+    # the server's wait always undercuts the shipped budget
+    assert _apply_wait_budget({"budget": 10.0}) < 10.0
+    assert _apply_wait_budget({"budget": 60.0}) == 10.0
+    assert _apply_wait_budget({"budget": 0.001}) == 0.05

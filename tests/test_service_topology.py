@@ -113,6 +113,33 @@ def test_intention_topology_default_and_wildcard():
     assert out[0]["has_exact"] is True
 
 
+def test_intention_topology_downstreams_includes_ingress_gateways():
+    """intentionTopologyTxn includes ServiceKindIngressGateway in the
+    candidate set iff downstreams=true (state/intention.go:1009): an
+    ingress gateway may DIAL the service, so it belongs in the
+    downstream view — but it is never a candidate upstream (ADVICE
+    r5)."""
+    st = _mesh_store()
+    st.register_service("n1", "igw-1", "igw", port=8443,
+                        kind="ingress-gateway")
+    # downstreams: the ingress gateway is a candidate under default
+    # allow, alongside the app services
+    names = {e["name"] for e in
+             st.intention_topology("web", downstreams=True,
+                                   default_allow=True)}
+    assert "igw" in names
+    # a specific allow intention surfaces it under default deny too
+    st.intention_set("ig", "igw", "web", "allow")
+    out = st.intention_topology("web", downstreams=True,
+                                default_allow=False)
+    assert [e["name"] for e in out] == ["igw"]
+    assert out[0]["has_exact"] is True
+    # upstreams direction: gateways are NOT candidates web may dial
+    names_up = {e["name"] for e in
+                st.intention_topology("web", default_allow=True)}
+    assert "igw" not in names_up
+
+
 def test_http_topology_and_intention_upstreams_routes():
     a = Agent(GossipConfig.lan(),
               SimConfig(n_nodes=8, rumor_slots=8, p_loss=0.0, seed=21))

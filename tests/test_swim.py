@@ -166,6 +166,28 @@ def test_lifeguard_awareness_tracks_own_health():
     assert int(jnp.sum(sl2.awareness)) < before
 
 
+def test_awareness_delta_zero_on_failed_probe_without_indirect_checks():
+    """memberlist's expectedNacks accounting (ADVICE r5): with
+    indirect_checks=0 no NACKs are ever expected, so a failed probe
+    carries no self-health evidence — the prober's awareness score must
+    stay 0 (the old code charged a flat +1, over-penalizing k=0
+    configurations)."""
+    import dataclasses
+    gossip = dataclasses.replace(GossipConfig.lan(), indirect_checks=0)
+    params = swim.make_params(
+        gossip, SimConfig(n_nodes=64, rumor_slots=16, p_loss=0.0, seed=1))
+    s = swim.init_state(params)
+    s, _ = run_n(params, s, 20)
+    assert int(jnp.sum(s.awareness)) == 0
+    s = swim.kill(s, 7)
+    s, _ = run_n(params, s, 120)
+    # probes of the dead node fail every round, but with no indirect
+    # probes in flight the failure is not evidence about the PROBER
+    assert int(jnp.sum(s.awareness)) == 0
+    # and detection itself still proceeds without indirect checks
+    assert bool(s.committed_dead[7]) or bool(jnp.any(s.r_active))
+
+
 def test_lifeguard_reduces_false_suspicions_under_loss():
     """The VERDICT r4 #5 bar: measurably fewer suspicion starts on
     always-live subjects at p_loss 0.15 with LHA on vs off (same seed,

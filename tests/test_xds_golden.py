@@ -268,6 +268,48 @@ def _escape_hatch_snapshot():
         })
 
 
+def _escape_hatch_dedup_snapshot():
+    """Two upstreams sharing a destination: the FIRST emits the
+    generated cluster, and the SECOND carries an envoy_cluster_json
+    override declaring the same name — the override must REPLACE the
+    generated cluster instead of being dropped by the dedup set
+    (ADVICE r5; clusters.go honors EnvoyClusterJSON on the default
+    chain).  A third upstream with a second override for the same name
+    keeps the first override."""
+    override = json.dumps({
+        "name": "cache",
+        "type": "LOGICAL_DNS",
+        "connect_timeout": "1s",
+        "load_assignment": {
+            "cluster_name": "cache",
+            "endpoints": [{"lb_endpoints": [{
+                "endpoint": {"address": {"socket_address": {
+                    "address": "cache.internal",
+                    "port_value": 6379}}}}]}]}})
+    losing = json.dumps({"name": "cache", "type": "STRICT_DNS",
+                         "connect_timeout": "9s"})
+    return ConfigSnapshot(
+        proxy_id="web-sidecar-proxy", service="web",
+        upstreams=[
+            # generated cluster "cache" lands in the dedup set first
+            {"destination_name": "cache", "local_bind_port": 9201,
+             "local_bind_address": "127.0.0.1"},
+            # the override arrives later and must still replace it
+            {"destination_name": "cache", "local_bind_port": 9202,
+             "local_bind_address": "127.0.0.1",
+             "config": {"envoy_cluster_json": override}},
+            # a SECOND override for the same declared name: first wins
+            {"destination_name": "cache", "local_bind_port": 9203,
+             "local_bind_address": "127.0.0.1",
+             "config": {"envoy_cluster_json": losing}},
+        ],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={"cache": [
+            {"address": "10.0.0.9", "port": 6379, "node": "n3"}]},
+        intentions=[], default_allow=True, version=13,
+        local_port=8080)
+
+
 CASES = {
     "sidecar": _sidecar_snapshot,
     "mesh_gateway": _mesh_gateway_snapshot,
@@ -276,7 +318,87 @@ CASES = {
     "l7_chain": _l7_chain_snapshot,
     "expose_tproxy": _expose_tproxy_snapshot,
     "escape_hatch": _escape_hatch_snapshot,
+    "escape_hatch_dedup": _escape_hatch_dedup_snapshot,
 }
+
+
+def test_upstream_override_cannot_hijack_chain_cluster():
+    """The replace path is scoped to DEFAULT-branch generated clusters:
+    operator JSON on one upstream must never substitute a cluster that
+    a discovery CHAIN emitted for another upstream (the reference
+    honors EnvoyClusterJSON only iff chain.IsDefault)."""
+    from consul_tpu.discoverychain import compile_chain
+    store = _FakeConfigStore({
+        ("service-splitter", "api"): {"splits": [
+            {"weight": 80, "service": "api"},
+            {"weight": 20, "service": "api-canary"}]},
+    })
+    chain = compile_chain(store, "api", dc="dc1")
+    endpoints = {
+        "api.default.dc1": [
+            {"address": "10.0.0.5", "port": 8443, "node": "n2"}],
+        "api-canary.default.dc1": [
+            {"address": "10.0.0.6", "port": 8444, "node": "n3"}],
+    }
+    def snap(extra_upstreams):
+        return ConfigSnapshot(
+            proxy_id="web-sidecar-proxy", service="web",
+            upstreams=[{"destination_name": "api",
+                        "local_bind_port": 9191,
+                        "local_bind_address": "127.0.0.1"}]
+            + extra_upstreams,
+            roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+            upstream_endpoints={}, intentions=[], default_allow=True,
+            version=14, chains={"api": chain},
+            chain_endpoints=endpoints, local_port=8080)
+
+    chain_clusters = [
+        c["name"] for c in
+        xds.snapshot_resources(snap([]))["Resources"]["clusters"]
+        if c["name"].startswith("api.")]
+    assert chain_clusters
+    target = chain_clusters[0]
+    evil = json.dumps({"name": target, "type": "STATIC",
+                       "connect_timeout": "9s"})
+    hijacker = {"destination_name": "other", "local_bind_port": 9192,
+                "local_bind_address": "127.0.0.1",
+                "config": {"envoy_cluster_json": evil}}
+    got = [c for c in
+           xds.snapshot_resources(snap([hijacker]))["Resources"]["clusters"]
+           if c["name"] == target]
+    assert len(got) == 1
+    assert got[0]["type"] == "EDS"        # the chain cluster survives
+
+    # ...in EITHER upstream order: an override emitted BEFORE the chain
+    # upstream must also lose the name back to the chain cluster
+    first = ConfigSnapshot(
+        proxy_id="web-sidecar-proxy", service="web",
+        upstreams=[hijacker,
+                   {"destination_name": "api", "local_bind_port": 9191,
+                    "local_bind_address": "127.0.0.1"}],
+        roots=FAKE_ROOTS, leaf=FAKE_LEAF,
+        upstream_endpoints={}, intentions=[], default_allow=True,
+        version=14, chains={"api": chain},
+        chain_endpoints=endpoints, local_port=8080)
+    clusters = xds.snapshot_resources(first)["Resources"]["clusters"]
+    got = [c for c in clusters if c["name"] == target]
+    assert len(got) == 1
+    assert got[0]["type"] == "EDS"
+    # and no duplicate names anywhere in the push (envoy would NACK)
+    names = [c["name"] for c in clusters]
+    assert len(names) == len(set(names))
+
+
+def test_upstream_override_replaces_earlier_generated_cluster():
+    """Behavioral pin on top of the golden: exactly ONE 'cache'
+    cluster survives, it is the operator's LOGICAL_DNS override (not
+    the generated EDS cluster, not the later losing override)."""
+    res = xds.snapshot_resources(_escape_hatch_dedup_snapshot())
+    clusters = [c for c in res["Resources"]["clusters"]
+                if c.get("name") == "cache"]
+    assert len(clusters) == 1
+    assert clusters[0]["type"] == "LOGICAL_DNS"
+    assert clusters[0]["connect_timeout"] == "1s"
 
 
 @pytest.mark.parametrize("name", sorted(CASES))

@@ -40,11 +40,14 @@ def main():
     ap.add_argument("--out", default="BENCH_correlated.json")
     args = ap.parse_args()
 
+    from functools import partial
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from consul_tpu import GossipConfig, SimConfig, swim
+    from consul_tpu.utils import donation
 
     gossip = GossipConfig.lan()
     tick_s = gossip.gossip_interval
@@ -55,7 +58,7 @@ def main():
             SimConfig(n_nodes=args.nodes, rumor_slots=slots,
                       p_loss=0.01, seed=args.seed))
 
-        @jax.jit
+        @partial(jax.jit, donate_argnums=donation(0))
         def warm(s):
             return swim.run(params, s, 25)[0]
 
@@ -66,7 +69,10 @@ def main():
                 return st, (rec, fp)
             return jax.lax.scan(body, s, None, length=n)
 
-        run_chunk = jax.jit(run_chunk, static_argnums=(1,))
+        # donate only the state carry (arg 0); the victim mask is reused
+        # across every chunk of the drain loop
+        run_chunk = jax.jit(run_chunk, static_argnums=(1,),
+                            donate_argnums=donation(0))
 
         for frac in args.fractions:
             k = max(1, int(args.nodes * frac))

@@ -43,7 +43,9 @@ def run_one(n: int, kills: int, ticks: int, p_loss: float, seed: int = 7,
                                         degraded_loss=degraded[1],
                                         seed=seed))
     s = swim.init_state(params)
-    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    from consul_tpu.utils import donation
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3),
+                  donate_argnums=donation(1))
     s, _ = run(params, s, 25, None)                      # steady state
     sus_base = np.asarray(s.sus_count).copy()            # warmup baseline
     victims = list(range(3, 3 + kills * 7, 7))[:kills]

@@ -43,7 +43,9 @@ def main():
                           alloc_cap=8, p_loss=args.p_loss,
                           seed=args.seed))
     s = swim.init_state(params)
-    run = jax.jit(swim.run, static_argnums=(0, 2, 3))
+    from consul_tpu.utils import donation
+    run = jax.jit(swim.run, static_argnums=(0, 2, 3),
+                  donate_argnums=donation(1))
     s, _ = run(params, s, 50, None)        # steady state + compile
     hard_sync(s.up)
 
