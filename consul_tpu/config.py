@@ -106,3 +106,9 @@ class SimConfig:
     rtt_spread_ms: float = 30.0    # scale of the coordinate space (ms)
     coord_dims: int = 2            # ground-truth latency-space dims
     seed: int = 0
+    # nemesis hooks (consul_tpu/chaos.py): compiles the per-node
+    # partition-group and delivery-rate masks into the tick so a
+    # host-side fault schedule can evolve them BETWEEN device scans
+    # without recompiles.  Off by default: the hot path carries zero
+    # extra work unless a chaos run asks for it.
+    chaos: bool = False

@@ -24,6 +24,7 @@ boltdb log + snapshot store collapse into one object here).
 
 from __future__ import annotations
 
+import heapq
 import random
 import threading
 import time as _time
@@ -78,7 +79,17 @@ class Transport:
 class InMemTransport(Transport):
     """Process-local message bus with partition + loss injection — the
     freeport/in-process-cluster trick of the reference's tests
-    (agent/consul/server_test.go:116-122) without sockets."""
+    (agent/consul/server_test.go:116-122) without sockets.
+
+    Fault surface (driven by consul_tpu/chaos.py's nemesis): the
+    original ad-hoc hooks (`partition`/`heal`/`isolate`, scalar
+    `p_loss`) remain, and an optional `injector` generalizes them into
+    a schedule: each send consults `injector.on_send(src, dst, msg,
+    now)` for a list of delivery delays (empty = dropped, one 0.0 =
+    deliver now, several = duplicates, positive = delayed/reordered).
+    Delayed frames queue on the transport and flush when the harness
+    calls `advance(now)` each tick — delivery stays tick-synchronous
+    and fully deterministic under a seeded injector."""
 
     def __init__(self, seed: int = 0):
         self._nodes: Dict[str, "RaftNode"] = {}
@@ -86,10 +97,37 @@ class InMemTransport(Transport):
         self._cut: set = set()          # directed (src, dst) pairs down
         self.p_loss = 0.0
         self._rng = random.Random(seed)
+        self.injector = None            # chaos.LinkInjector-shaped
+        self._now = 0.0
+        self._seq = 0                   # FIFO tiebreak for equal due times
+        self._pending: List[tuple] = []  # heap of (due, seq, dst, msg)
 
     def register(self, node: "RaftNode") -> None:
         with self._lock:
             self._nodes[node.node_id] = node
+
+    def unregister(self, node_id: str) -> None:
+        """A crashed node stops receiving (its queued frames drop with
+        it, like frames in a dead process's socket buffer)."""
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self._pending = [p for p in self._pending if p[2] != node_id]
+            heapq.heapify(self._pending)
+
+    def advance(self, now: float) -> None:
+        """Deliver every delayed frame that has come due.  The chaos
+        harness calls this once per tick step; transports without an
+        injector never queue, so plain clusters need not call it."""
+        due = []
+        with self._lock:
+            self._now = now
+            while self._pending and self._pending[0][0] <= now:
+                _, _, dst, msg = heapq.heappop(self._pending)
+                node = self._nodes.get(dst)
+                if node is not None:
+                    due.append((node, msg))
+        for node, msg in due:
+            node.deliver(msg)
 
     def partition(self, a: str, b: str, bidir: bool = True) -> None:
         with self._lock:
@@ -119,6 +157,22 @@ class InMemTransport(Transport):
             if self.p_loss and self._rng.random() < self.p_loss:
                 return
             node = self._nodes.get(target)
+            if self.injector is not None:
+                plan = self.injector.on_send(msg["from"], target, msg,
+                                             self._now)
+                if plan is not None:
+                    deliver_now = False
+                    for delay in plan:
+                        if delay <= 0.0:
+                            deliver_now = True       # at most one copy
+                        else:
+                            self._seq += 1
+                            heapq.heappush(
+                                self._pending,
+                                (self._now + delay, self._seq, target,
+                                 msg))
+                    if not deliver_now:
+                        return
         if node is not None:
             node.deliver(msg)
 

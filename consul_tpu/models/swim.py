@@ -158,6 +158,10 @@ class SwimParams:
     degraded_frac: float       # fraction of nodes with degraded legs
     degraded_loss: float       # their per-leg loss (vs p_loss)
     seed: int
+    # nemesis masks compiled in (chaos.py): when True the tick consults
+    # the per-node chaos_grp / chaos_ok state fields on every leg.
+    # Static so the default (False) build keeps the hot path untouched.
+    chaos: bool = False
 
 
 def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
@@ -201,6 +205,7 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         degraded_frac=sim.degraded_frac,
         degraded_loss=sim.degraded_loss,
         seed=sim.seed,
+        chaos=sim.chaos,
     )
 
 
@@ -277,6 +282,18 @@ class SwimState:
     awareness: jnp.ndarray       # [N] int32 health score, [0, max-1]
     sus_count: jnp.ndarray       # [N] int32: suspicion starts per subject
     #                               (diagnostic: false-suspicion counting)
+    # --- nemesis fault masks (consul_tpu/chaos.py) ---
+    # Evolved on a HOST-side schedule between device scans (plain
+    # state fields, so updating them never recompiles the tick) and
+    # consumed only when params.chaos is set.  chaos_grp partitions
+    # the pool: a leg delivers only between same-group endpoints
+    # (group 0 = everyone, the healed default).  chaos_ok is a
+    # per-node delivery-rate multiplier in [0, 1] (1 = healthy): a leg
+    # between i and j delivers with ok_i * ok_j on top of the baseline
+    # loss — loss bursts set it globally, asymmetric degradation sets
+    # it per node.
+    chaos_grp: jnp.ndarray       # [N] int16 partition group id
+    chaos_ok: jnp.ndarray        # [N] float32 delivery multiplier
     # --- device-side telemetry counters (CTR_* slots above) ---
     # Cumulative f32 — tiny [CTR_N] vector, replicated under sharding
     # (parallel/mesh.py _node_shardable rejects it), read back only at
@@ -337,6 +354,8 @@ def init_state(params: SwimParams, key=None,
         bulk_cov=jnp.zeros((n,), jnp.float32),
         awareness=jnp.zeros((n,), jnp.int8),
         sus_count=jnp.zeros((n,), jnp.int32),
+        chaos_grp=jnp.zeros((n,), jnp.int16),
+        chaos_ok=jnp.ones((n,), jnp.float32),
         ctr=jnp.zeros((CTR_N,), jnp.float32),
     )
 
@@ -675,6 +694,13 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
                             1.0 - params.p_loss)
     else:
         ok_node = jnp.full((n,), 1.0 - params.p_loss, jnp.float32)
+    if params.chaos:
+        # nemesis: per-node delivery multiplier folds into the leg
+        # rate; partition groups gate each leg pairwise (a leg only
+        # exists between same-group endpoints)
+        ok_node = ok_node * s.chaos_ok
+        grp = s.chaos_grp
+        same_t = grp == rolls.pull(grp, d)          # origin <-> target
 
     # direct probe: two UDP legs + RTT under the (LHA-scaled) timeout
     rtt = jnp.linalg.norm(s.coords - rolls.pull(s.coords, d), axis=-1) \
@@ -683,6 +709,8 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
     ok_t = rolls.pull(ok_node, d)
     legs_ok = jax.random.uniform(k_direct, (n,)) \
         < jnp.minimum(ok_node, ok_t) ** 2
+    if params.chaos:
+        legs_ok &= same_t
     direct_ack = t_up & legs_ok & (2.0 * rtt < params.probe_timeout_ms * mult)
 
     # k indirect probes through ring relays, leg-resolved so relays
@@ -702,6 +730,17 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
         l1 = uA < jnp.minimum(ok_node[:, None], ok_r)
         l23 = uB < jnp.minimum(ok_r, ok_t[:, None]) ** 2
         l4 = uC < jnp.minimum(ok_r, ok_node[:, None])
+        if params.chaos:
+            # partition gating per leg: origin<->relay and
+            # relay<->target must each be same-group
+            rgrp = jnp.stack([rolls.pull(grp, offs[1 + k])
+                              for k in range(params.indirect_checks)],
+                             axis=-1)
+            same_r = rgrp == grp[:, None]
+            same_rt = rgrp == rolls.pull(grp, d)[:, None]
+            l1 &= same_r
+            l4 &= same_r
+            l23 &= same_rt
         relay_ok = jnp.stack([rolls.pull(live, offs[1 + k])
                               for k in range(params.indirect_checks)],
                              axis=-1)
@@ -958,6 +997,18 @@ def _dense_suspicion_expiry(params: SwimParams, s: SwimState,
     dead_of2 = _map_add(dead_of, *alloc)   # patched, not rebuilt
     left_of2 = left_of                     # nothing adds LEFT this tick
     overflow = (want > 0) & (dead_of2 < 0)
+    if params.chaos:
+        # Nemesis builds disable the bulk overflow: its subject
+        # marginal is a MEAN-FIELD coverage estimate that is not
+        # partition-aware (a death seeded inside one partition group
+        # would estimate its way to the commit bar even though the
+        # other group can never hear it — exactly the false commit the
+        # invariant checkers exist to catch).  Expired subjects retry
+        # for dead slots each round with their timer intact (slot
+        # turnover + pressure eviction carries them); chaos runs are
+        # moderate-N correctness checks, and mass-event DISSEMINATION
+        # fidelity stays the default build's concern.
+        overflow = jnp.zeros_like(overflow)
     bulk_member = s.bulk_member | overflow
     # row i probes (i+shift)%N, and want>0 already requires the prober
     # live, so the pulled overflow mask IS the live seeding rows.
@@ -999,13 +1050,23 @@ def _refutation(params: SwimParams, s: SwimState) -> SwimState:
     ticks).  Refutation normally lands within ~1 probe round of the
     subject hearing the suspicion — two orders of magnitude inside the
     suspicion timeout — so the affected population is the rare holder
-    that expired during that window.  All index work is [U]-space."""
+    that expired during that window.  All index work is [U]-space.
+
+    DEAD rumors refute the same way (memberlist aliveNode on a dead
+    entry: a node that learns it has been declared dead rejoins with a
+    higher incarnation).  This is the partition-heal path the nemesis
+    exercises: a suspicion that expired INSIDE a partition converts to
+    a dead rumor the moment the partition heals, and without dead-
+    refutation the rumor would spread to full coverage and commit a
+    live, reachable node's death — the subject refutes it within ~1
+    gossip round of hearing it instead."""
     u = params.rumor_slots
     n = params.n_nodes
-    is_suspect = s.r_active & (s.r_kind == SUSPECT)
+    refutable = s.r_active & ((s.r_kind == SUSPECT)
+                              | (s.r_kind == DEAD))
     subj = s.r_subject
     subject_knows = s.know[subj, jnp.arange(u)]                  # [U]
-    need = is_suspect & subject_knows & s.up[subj] & s.member[subj] \
+    need = refutable & subject_knows & s.up[subj] & s.member[subj] \
         & (s.r_inc >= s.incarnation[subj])
     # bump incarnation above the suspected one
     inc = s.incarnation.at[jnp.where(need, subj, 0)].max(
@@ -1056,7 +1117,10 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
                                  slot_active=s.r_active,
                                  retransmit_limit=params.retransmit_limit,
                                  p_loss=params.p_loss,
-                                 key=prng.tick_key(params.seed, tick, 5))
+                                 key=prng.tick_key(params.seed, tick, 5),
+                                 group=s.chaos_grp if params.chaos else None,
+                                 node_ok=s.chaos_ok if params.chaos
+                                 else None)
     learn_tick = jnp.where(res.newly, tick.astype(jnp.int16), s.learn_tick)
     # consul.serf.gossip.* device counters (memberlist gossip timer's
     # accounting): the op already computed the reductions
@@ -1109,6 +1173,13 @@ def _bulk_disseminate(params: SwimParams, s: SwimState) -> SwimState:
     n_up = jnp.maximum(jnp.sum(s.up), 1).astype(jnp.float32)
     mean_supply = jnp.sum(supply_src) / n_up
     views = rolls.pull_multi(supply_src, offs)     # one doubled buffer
+    if params.chaos:
+        # nemesis: cross-group contacts carry nothing; degraded
+        # endpoints scale the transfer by the pairwise delivery rate
+        gviews = rolls.pull_multi(s.chaos_grp, offs)
+        okviews = rolls.pull_multi(s.chaos_ok, offs)
+        views = [jnp.where(gv == s.chaos_grp, v * ov * s.chaos_ok, 0.0)
+                 for v, gv, ov in zip(views, gviews, okviews)]
     for view in views:
         supply = jnp.minimum(view, cap)
         novelty = 1.0 - heard / v
@@ -1364,14 +1435,48 @@ def kill(s: SwimState, node: int) -> SwimState:
     return s.replace(up=s.up.at[node].set(False))
 
 
+def revive_mask(s: SwimState, mask: jnp.ndarray) -> SwimState:
+    """Flap restart: every node in `mask` ([N] bool) comes back up with
+    a bumped incarnation when stale suspect/dead rumors about it are
+    still in flight, so those rumors can neither expire into a
+    committed death nor re-suspect it at the old incarnation
+    (memberlist aliveNode on a suspect/dead entry: the returning node
+    refutes with inc+1).  The in-flight stale slots are withdrawn here
+    — the state-surgery equivalent of the refutation the live node
+    would broadcast within ~1 probe round, exercised by the
+    kill_mask-then-revive flap path (chaos.py crash/restart nemesis).
+    A COMMITTED death still requires `rejoin` (it must re-originate an
+    alive rumor cluster-wide); dense suspicion timers and bulk-channel
+    entries for revived nodes reset (mean-field has no per-subject
+    refutation)."""
+    mask = jnp.asarray(mask, bool)
+    stale = s.r_active & mask[s.r_subject] \
+        & ((s.r_kind == SUSPECT) | (s.r_kind == DEAD))
+    # rejoin incarnation: strictly above every stale rumor's, so the
+    # next suspicion of this node starts a FRESH refutable lifecycle
+    bump = jnp.zeros_like(s.incarnation).at[
+        jnp.where(stale, s.r_subject, 0)].max(
+        jnp.where(stale, s.r_inc + 1, 0))
+    return s.replace(
+        up=s.up | mask,
+        incarnation=jnp.maximum(s.incarnation, bump),
+        r_active=s.r_active & ~stale,
+        know=s.know & ~stale[None, :],
+        sends_left=jnp.where(stale[None, :], jnp.int8(0), s.sends_left),
+        sus_start=jnp.where(mask, -1, s.sus_start),
+        sus_confirm=jnp.where(mask, jnp.int8(0), s.sus_confirm),
+        bulk_member=s.bulk_member & ~mask,
+        bulk_cov=jnp.where(mask, 0.0, s.bulk_cov))
+
+
 def revive(s: SwimState, node: int) -> SwimState:
-    """Bring the process back up WITHOUT a rejoin: only heals if the
-    death was never committed (inside the suspicion window).  A node the
-    cluster already declared dead must `rejoin` instead.  A bulk-channel
-    entry is withdrawn (mean-field has no per-subject refutation)."""
-    return s.replace(up=s.up.at[node].set(True),
-                     bulk_member=s.bulk_member.at[node].set(False),
-                     bulk_cov=s.bulk_cov.at[node].set(0.0))
+    """Bring the process back up after a flap (kill/kill_mask then
+    restart inside the suspicion/dissemination window): the node
+    rejoins with a bumped incarnation whenever stale death rumors are
+    in flight — see revive_mask.  A node the cluster already declared
+    dead (committed) must `rejoin` instead."""
+    n = s.up.shape[0]
+    return revive_mask(s, jnp.arange(n) == node)
 
 
 def rejoin(params: SwimParams, s: SwimState, node: int) -> SwimState:
@@ -1392,6 +1497,12 @@ def rejoin(params: SwimParams, s: SwimState, node: int) -> SwimState:
         committed_left=s.committed_left.at[node].set(False),
         incarnation=inc,
         r_active=s.r_active & ~stale,
+        # the deactivated slots' knowledge cells must clear with them:
+        # a later _originate reusing the slot ORs new cells into know,
+        # and stale set bits would hand the fresh rumor phantom
+        # carriers (and phantom coverage at commit time)
+        know=s.know & ~stale[None, :],
+        sends_left=jnp.where(stale[None, :], jnp.int8(0), s.sends_left),
         bulk_member=s.bulk_member.at[node].set(False),
         bulk_cov=s.bulk_cov.at[node].set(0.0),
     )

@@ -47,7 +47,9 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
                 receiver_ok: jnp.ndarray, slot_active: jnp.ndarray,
                 retransmit_limit: int,
                 p_loss: float = 0.0,
-                key: Optional[jnp.ndarray] = None) -> GossipResult:
+                key: Optional[jnp.ndarray] = None,
+                group: Optional[jnp.ndarray] = None,
+                node_ok: Optional[jnp.ndarray] = None) -> GossipResult:
     """One fanout round.
 
     offsets: [G] int32 ring offsets shared by all nodes this tick (node i
@@ -58,6 +60,13 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
     one UDP packet per peer per tick, so loss is per (receiver,
     contact) — all slots in the packet vanish together (memberlist's
     gossip() sends one compound packet per selected peer).
+
+    Nemesis hooks (chaos.py; both default None = the fast path):
+    `group` [N] int partition ids — a contact only exists between
+    same-group endpoints; `node_ok` [N] float32 per-node delivery
+    multiplier — a contact between i and j delivers with
+    (1 - p_loss) * ok_i * ok_j (degraded endpoints tax the whole
+    packet, like a lossy NIC taxes every leg it carries).
     """
     fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
@@ -69,7 +78,26 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
     cells = jnp.sum(serve, axis=1).astype(jnp.float32)           # [N]
     served = jnp.sum(cells) * fanout      # cell transmissions attempted
     lost = jnp.float32(0)
-    if p_loss > 0.0 and key is not None:
+    chaotic = group is not None or node_ok is not None
+    if chaotic and key is not None:
+        n = know.shape[0]
+        p_ok = jnp.full((n, fanout), 1.0 - p_loss, jnp.float32)
+        if node_ok is not None:
+            senders = jnp.stack(rolls.pull_multi(node_ok, offsets),
+                                axis=1)                          # [N, G]
+            p_ok = p_ok * node_ok[:, None] * senders
+        ok = jax.random.uniform(key, (n, fanout)) < p_ok
+        if group is not None:
+            gviews = jnp.stack(rolls.pull_multi(group, offsets), axis=1)
+            # a severed link is a partition, not loss: it neither
+            # delivers nor counts against the loss telemetry
+            ok &= gviews == group[:, None]
+        carried = jnp.stack(rolls.pull_multi(cells, offsets), axis=1)
+        if group is not None:
+            carried = jnp.where(gviews == group[:, None], carried, 0.0)
+        lost = jnp.sum(jnp.where(ok, 0.0, carried))
+        views = [v & ok[:, g:g + 1] for g, v in enumerate(views)]
+    elif p_loss > 0.0 and key is not None:
         ok = jax.random.bernoulli(key, 1.0 - p_loss,
                                   (know.shape[0], fanout))       # [N, G]
         # count lost in the SAME transmission units: the queued cells

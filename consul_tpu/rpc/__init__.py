@@ -10,5 +10,6 @@ pooled one-connection-per-peer client.
 """
 
 from consul_tpu.rpc.net import (  # noqa: F401
-    RpcClient, RpcError, RpcListener, TcpTransport, recv_frame, send_frame,
+    FaultyTcpTransport, NetFaultSchedule, RpcClient, RpcError, RpcListener,
+    TcpTransport, recv_frame, send_frame,
 )

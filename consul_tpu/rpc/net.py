@@ -16,6 +16,7 @@ base64 in the command layer), so no extra codec is needed.
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import ssl
@@ -208,6 +209,13 @@ class _ConnPool:
         self.timeout = timeout
         self.ssl_context = ssl_context
         self.server_hostname = server_hostname
+        # reconnect cooldown per address (see oneway): fire-and-forget
+        # sends inside the window drop instead of re-dialing a peer
+        # that just refused — the jittered-backoff half of the retry
+        # policy, kept OFF the sender's thread (a raft tick thread
+        # sleeping inline would stall every peer behind the dead one)
+        self._down_until: Dict[Tuple[str, int], float] = {}
+        self._last_cooldown: Dict[Tuple[str, int], float] = {}
 
     def _get_lock(self, addr) -> threading.Lock:
         with self._lock:
@@ -232,19 +240,58 @@ class _ConnPool:
         if sock is not None:
             shutdown_and_close(sock)
 
+    # bounded reconnect policy (the reference pool's acquire/retry
+    # stance): a dead pooled socket is evicted and the send retried a
+    # bounded number of times immediately (a severed-but-listening
+    # peer reconnects on the spot); on exhaustion the address enters a
+    # jittered reconnect COOLDOWN during which further fire-and-forget
+    # sends drop without dialing — the backoff lives in the pool's
+    # state, never as a sleep on the sender's thread (a raft tick
+    # thread sleeping inline would stall every peer behind the dead
+    # one, and raft re-sends on its own cadence anyway)
+    ONEWAY_ATTEMPTS = 3
+    COOLDOWN_BASE_S = 0.1
+    COOLDOWN_CAP_S = 1.0
+
     def oneway(self, addr, obj: dict) -> None:
-        """Fire-and-forget (raft frames).  Errors drop the connection."""
+        """Fire-and-forget (raft frames).  Errors evict the pooled
+        socket and retry within the bounded policy above; on
+        exhaustion the frame drops, the address cools down, and
+        consul.rpc.failed counts it."""
         lock = self._get_lock(addr)
         with lock:
-            try:
-                send_frame(self._connect(addr), obj)
-            except OSError:
-                self._drop(addr)
-                # one reconnect attempt — the raft engine re-sends anyway
+            until = self._down_until.get(addr, 0.0)
+            if until > time.monotonic():
+                telemetry.incr_counter(("rpc", "failed"),
+                                       labels={"kind": "oneway"})
+                return
+            for attempt in range(self.ONEWAY_ATTEMPTS):
+                fresh_dial = addr not in self._conns
                 try:
                     send_frame(self._connect(addr), obj)
+                    self._down_until.pop(addr, None)
+                    self._last_cooldown.pop(addr, None)
+                    return
                 except OSError:
-                    self._drop(addr)
+                    self._drop(addr)       # evict the dead socket
+                    if fresh_dial:
+                        # a FRESH dial failed: more dials this call
+                        # can only re-pay the connect timeout (a
+                        # black-holed peer costs the full 5 s per SYN,
+                        # not a fast RST) — stop and cool down.  The
+                        # retry chain exists for STALE pooled sockets,
+                        # whose send failures are immediate.
+                        break
+            # jittered, capped exponential cooldown: doubles while the
+            # peer stays dark, resets on the first successful send
+            prev = self._down_until.get(addr)
+            base = self.COOLDOWN_BASE_S if prev is None else \
+                min(self.COOLDOWN_CAP_S, 2.0 * self._last_cooldown.get(
+                    addr, self.COOLDOWN_BASE_S))
+            self._last_cooldown[addr] = base
+            self._down_until[addr] = time.monotonic() \
+                + base * (0.5 + random.random())
+        telemetry.incr_counter(("rpc", "failed"), labels={"kind": "oneway"})
 
     def call(self, addr, obj: dict,
              timeout: Optional[float] = None) -> dict:
@@ -265,6 +312,8 @@ class _ConnPool:
                         break
             except OSError as e:
                 self._drop(addr)
+                telemetry.incr_counter(("rpc", "failed"),
+                                       labels={"kind": "call"})
                 raise RpcError(f"rpc to {addr} failed: {e}") from e
             finally:
                 if timeout is not None:
@@ -274,6 +323,8 @@ class _ConnPool:
                         pass
             if resp is None:
                 self._drop(addr)
+                telemetry.incr_counter(("rpc", "failed"),
+                                       labels={"kind": "call"})
                 raise RpcError(f"rpc to {addr}: connection closed")
             return resp
 
@@ -349,3 +400,102 @@ class TcpTransport(Transport):
 
     def close(self) -> None:
         self._pool.close()
+
+
+class NetFaultSchedule:
+    """Seeded fault decisions for the live TCP path (the nemesis's
+    third layer, chaos.py).  Each outgoing frame asks `decide(target)`
+    for an action:
+
+        "pass"            send normally
+        "drop"            swallow the frame (raft re-sends)
+        "sever"           evict the pooled connection AND drop — the
+                          next frame reconnects (connection-reset
+                          injection; exercises _ConnPool's bounded
+                          retry path)
+        ("delay", s)      sleep s before sending (head-of-line delay on
+                          the pooled conn — frames behind it queue,
+                          like a stalled kernel buffer)
+
+    Targets in `cut` are hard-partitioned (every frame severs).  The
+    decision STREAM is deterministic (one seeded RNG consumed in call
+    order under a lock); with concurrent senders the interleaving is
+    the scheduler's, which is as deterministic as a live socket path
+    gets — the virtual-time layers carry the bit-reproducibility
+    guarantee."""
+
+    def __init__(self, seed: int = 0, drop_p: float = 0.0,
+                 sever_p: float = 0.0, delay_p: float = 0.0,
+                 delay_range: Tuple[float, float] = (0.005, 0.05)):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.drop_p = drop_p
+        self.sever_p = sever_p
+        self.delay_p = delay_p
+        self.delay_range = delay_range
+        self.cut: set = set()           # node_ids hard-partitioned
+
+    def partition(self, *targets: str) -> None:
+        with self._lock:
+            self.cut.update(targets)
+
+    def heal(self, *targets: str) -> None:
+        with self._lock:
+            if targets:
+                self.cut.difference_update(targets)
+            else:
+                self.cut.clear()
+
+    def calm(self) -> None:
+        """End probabilistic faults (partitions persist until heal)."""
+        with self._lock:
+            self.drop_p = self.sever_p = self.delay_p = 0.0
+
+    def decide(self, target: str):
+        with self._lock:
+            if target in self.cut:
+                return "sever"
+            r = self._rng.random()
+            if r < self.sever_p:
+                return "sever"
+            r -= self.sever_p
+            if r < self.drop_p:
+                return "drop"
+            r -= self.drop_p
+            if r < self.delay_p:
+                lo, hi = self.delay_range
+                return ("delay", lo + self._rng.random() * (hi - lo))
+            return "pass"
+
+
+class FaultyTcpTransport(TcpTransport):
+    """TcpTransport that routes every outgoing raft frame through a
+    NetFaultSchedule — the socket-path injector of the nemesis engine
+    (chaos.py drives all three layers through the same scenario API).
+    Severing evicts the pooled connection via the pool's own eviction,
+    so the next healthy frame exercises the reconnect/backoff path the
+    way a real RST would."""
+
+    def __init__(self, faults: NetFaultSchedule,
+                 addresses: Optional[Dict[str, Tuple[str, int]]] = None,
+                 timeout: float = 5.0):
+        super().__init__(addresses, timeout)
+        self.faults = faults
+
+    def sever(self, target: str) -> None:
+        """Drop the pooled connection to `target` now (one-shot)."""
+        addr = self.addresses.get(target)
+        if addr is not None:
+            with self._pool._lock:
+                self._pool._drop(tuple(addr))
+
+    def send(self, target: str, msg: dict) -> None:
+        act = self.faults.decide(target)
+        if act == "drop":
+            return
+        if act == "sever":
+            self.sever(target)
+            return
+        if isinstance(act, tuple) and act[0] == "delay":
+            time.sleep(act[1])
+        super().send(target, msg)

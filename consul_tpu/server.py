@@ -29,6 +29,7 @@ agent/pool/pool.go:542).
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 import uuid
@@ -201,6 +202,32 @@ class Server:
     # ---------------------------------------------------- forward coalescer
 
     _FWD_MAX_BATCH = 128
+    # Consul's rpcHoldTimeout (agent/consul/config.go RPCHoldTimeout,
+    # 7s): during a leader election, forwarded RPCs are HELD and
+    # retried rather than failed into the election window
+    _RPC_HOLD_TIMEOUT = 7.0
+
+    def _hold_for_leader(self, budget_s: float) -> bool:
+        """rpcHoldTimeout behavior for a forwarded apply that lands
+        mid-election: hold (bounded by the caller's remaining budget
+        and the 7 s cap) until leadership settles.  Returns True when
+        THIS node emerged as leader (serve the apply); False when a
+        leader settled elsewhere (bounce with the fresh hint — the
+        caller re-forwards, re-forwarding from here could loop) or the
+        cluster stayed leaderless past the hold."""
+        deadline = time.time() + max(0.0, min(budget_s,
+                                              self._RPC_HOLD_TIMEOUT))
+        backoff = 0.005
+        while True:
+            if self.raft.is_leader():
+                return True
+            lid = self.raft.leader_id
+            if lid is not None and lid != self.node_id:
+                return False
+            if time.time() >= deadline:
+                return False
+            time.sleep(backoff * (0.5 + random.random()))
+            backoff = min(backoff * 2.0, 0.05)
 
     def _forward_apply(self, op: str, args: dict, timeout: float):
         """Queue one apply for the remote leader and wait.  A single
@@ -273,10 +300,27 @@ class Server:
             addr = self._remote_addr(self.leader_id or "")
             client = self._rpc_client
             if addr is None or client is None:
+                # ErrNoLeader mid-election: hold and retry with
+                # jittered backoff inside each caller's remaining
+                # budget (rpcHoldTimeout) instead of failing the batch
+                # into the election window.  Callers whose budget ran
+                # out (or a closing server) fail now.
+                now = time.time()
+                with self._fwd_cv:
+                    closing = self._fwd_closed or not self._fwd_running
                 err = NoLeaderError("no leader address to forward to")
+                keep = []
                 for it in items:
-                    it["error"] = err
-                    it["event"].set()
+                    if closing or it["deadline"] - now <= 0.05 \
+                            or client is None:
+                        it["error"] = err
+                        it["event"].set()
+                    else:
+                        keep.append(it)
+                if keep:
+                    time.sleep(0.02 * (0.5 + random.random()))
+                    with self._fwd_cv:
+                        self._fwd_q[:0] = keep
                 continue
             telemetry.incr_counter(("rpc", "forward", "rounds"))
             telemetry.incr_counter(("rpc", "forward", "items"),
@@ -327,7 +371,9 @@ class Server:
         pairing."""
         from consul_tpu import trace
         if method == "apply":
-            if not self.raft.is_leader():
+            t_in = time.time()
+            if not self.raft.is_leader() \
+                    and not self._hold_for_leader(_apply_wait_budget(args)):
                 raise NotLeaderError(self.raft.leader_id)
             # wait for commit as long as the CALLER still has RPC
             # budget (the coalescer ships its remaining deadline in
@@ -336,7 +382,11 @@ class Server:
             # budget, widening the failed-but-later-applied ambiguity
             # window (ADVICE r5).  Clamped: a missing/garbage budget
             # falls back to the old constant, never waits > 10 s.
-            wait_s = _apply_wait_budget(args)
+            # Whatever the election hold consumed comes OFF the wait:
+            # hold + commit-wait together must fit the caller's budget
+            # or the definitive response lands after it hung up.
+            wait_s = max(0.05,
+                         _apply_wait_budget(args) - (time.time() - t_in))
             with trace.span("leader.apply", trace_id=args.get("trace"),
                             op=args.get("op"), node=self.node_id):
                 pend = self.raft.apply({"op": args["op"],
@@ -351,16 +401,22 @@ class Server:
             # for the whole batch, per-item results/errors (the
             # reference batches at the msgpack chunking layer;
             # coalescing concurrent forwards is the same lever)
-            if not self.raft.is_leader():
+            t_in = time.time()
+            if not self.raft.is_leader() \
+                    and not self._hold_for_leader(_apply_wait_budget(args)):
                 raise NotLeaderError(self.raft.leader_id)
             t_wall, t0 = time.time(), time.perf_counter()
             pends = self.raft.apply_many(
                 [{"op": it["op"], "args": it.get("args") or {}}
                  for it in args["items"]])
             # group-commit wait bounded by the batch's shipped RPC
-            # budget (= the longest remaining caller deadline), not a
-            # fixed 5.0 s — see the "apply" branch note
-            deadline = time.time() + _apply_wait_budget(args)
+            # budget (= the longest remaining caller deadline) MINUS
+            # whatever the election hold consumed, floored like the
+            # "apply" branch so a budget-eating hold still leaves the
+            # appended batch a sliver to commit rather than reporting
+            # instant timeouts for entries already in the log
+            deadline = time.time() + max(
+                0.05, _apply_wait_budget(args) - (time.time() - t_in))
             results, errors = [], []
             for pend in pends:
                 if not pend.event.wait(max(0.0,
@@ -613,6 +669,17 @@ class Server:
         from consul_tpu.rpc import RpcError
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
+        # jittered exponential backoff across leader-change retries
+        # (the reference's retry loop under rpcHoldTimeout): flat
+        # 10 ms polling hammered the deposed leader during elections
+        backoff = 0.005
+
+        def _pause():
+            nonlocal backoff
+            time.sleep(min(backoff * (0.5 + random.random()),
+                           max(0.0, deadline - time.time())))
+            backoff = min(backoff * 2.0, 0.05)
+
         while time.time() < deadline:
             leader = self.leader_id
             target = self if self.raft.is_leader() else \
@@ -636,13 +703,13 @@ class Server:
                     except (RpcError, TimeoutError,
                             NoLeaderError) as e:
                         last_err = e
-                time.sleep(0.01)
+                _pause()
                 continue
             try:
                 pend = target.raft.apply({"op": op, "args": args})
             except NotLeaderError as e:
                 last_err = e
-                time.sleep(0.01)
+                _pause()
                 continue
             if pend.event.wait(max(0.0, deadline - time.time())):
                 if pend.error is not None:

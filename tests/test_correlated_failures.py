@@ -19,12 +19,21 @@ def _params(n=512, slots=8):
         SimConfig(n_nodes=n, rumor_slots=slots, p_loss=0.0, seed=13))
 
 
+# jit-cached chunk runner: the bare swim.run RETRACES the whole step
+# graph on every call — across this file's convergence loops that was
+# the dominant cost of the whole module (chaos.compiled_swim_run
+# caches one traced executable per (params, ticks, monitor)).
+def _run(params, s, ticks, monitor=None):
+    from consul_tpu.chaos import compiled_swim_run
+    return compiled_swim_run(params, ticks, monitor)(s)
+
+
 def test_mass_kill_exceeding_slot_table_converges():
     """Kill 4x more nodes than rumor slots in one tick: every death
     must still commit (slot recycling + pressure eviction)."""
     params = _params(n=512, slots=8)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     rng = np.random.default_rng(3)
     victims = rng.choice(512, size=32, replace=False)
     mask = np.zeros((512,), bool)
@@ -33,7 +42,7 @@ def test_mass_kill_exceeding_slot_table_converges():
     s = swim.kill_mask(s, mask_d)
     rec = 0.0
     for _ in range(40):
-        s, _ = swim.run(params, s, 100)
+        s, _ = _run(params, s, 100)
         rec, fp = swim.mass_detection_stats(params, s, mask_d)
         if float(rec) >= 0.999:
             break
@@ -47,7 +56,7 @@ def test_mass_kill_exceeding_slot_table_converges():
         committed = np.asarray(s.committed_dead)
         if committed[victims].all():
             break
-        s, _ = swim.run(params, s, 100)
+        s, _ = _run(params, s, 100)
     committed = np.asarray(s.committed_dead)
     assert committed[victims].all()
 
@@ -58,7 +67,7 @@ def test_pressure_eviction_preserves_commit_rules():
     beliefs)."""
     params = _params(n=256, slots=4)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     # kill slots+4 nodes: demand will exceed the table repeatedly
     rng = np.random.default_rng(5)
     victims = rng.choice(256, size=8, replace=False)
@@ -67,7 +76,7 @@ def test_pressure_eviction_preserves_commit_rules():
     s = swim.kill_mask(s, jnp.asarray(mask))
     saw_full_table = False
     for _ in range(60):
-        s, _ = swim.run(params, s, 50)
+        s, _ = _run(params, s, 50)
         if int(jnp.sum(s.r_active)) == 4:
             saw_full_table = True
         rec, fp = swim.mass_detection_stats(params, s,
@@ -84,9 +93,9 @@ def test_single_victim_path_unchanged():
     behavior (no eviction triggers when the table is idle)."""
     params = _params(n=1024, slots=16)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     s = swim.kill(s, 123)
-    s, frac = swim.run(params, s, 600, 123)
+    s, frac = _run(params, s, 600, 123)
     frac = np.asarray(frac)
     assert frac[-1] >= 0.99
     assert int(np.argmax(frac > 0.99)) < 300
@@ -98,7 +107,7 @@ def test_bulk_channel_engages_and_drains_without_waves():
     VERDICT r4 next #1."""
     params = _params(n=512, slots=4)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     rng = np.random.default_rng(11)
     victims = rng.choice(512, size=64, replace=False)   # 16x the table
     mask = np.zeros((512,), bool)
@@ -111,7 +120,7 @@ def test_bulk_channel_engages_and_drains_without_waves():
     # small chunks: the drain is fast enough that a 50-tick sampling
     # interval can miss the channel's whole occupancy window
     for _ in range(400):
-        s, _ = swim.run(params, s, 5)
+        s, _ = _run(params, s, 5)
         ticks += 5
         saw_bulk = saw_bulk or int(jnp.sum(s.bulk_member)) > 0
         rec, fp = swim.mass_detection_stats(params, s, mask_d)
@@ -132,7 +141,7 @@ def test_bulk_channel_engages_and_drains_without_waves():
     for _ in range(40):
         if np.asarray(s.committed_dead)[victims].all():
             break
-        s, _ = swim.run(params, s, 50)
+        s, _ = _run(params, s, 50)
     assert np.asarray(s.committed_dead)[victims].all()
 
 
@@ -141,14 +150,14 @@ def test_bulk_channel_idle_for_small_kills():
     exact per-subject path (with refutation) stays authoritative."""
     params = _params(n=512, slots=32)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     rng = np.random.default_rng(7)
     victims = rng.choice(512, size=4, replace=False)
     mask = np.zeros((512,), bool)
     mask[victims] = True
     s = swim.kill_mask(s, jnp.asarray(mask))
     for _ in range(12):
-        s, _ = swim.run(params, s, 50)
+        s, _ = _run(params, s, 50)
         assert int(jnp.sum(s.bulk_member)) == 0
         rec, _ = swim.mass_detection_stats(params, s, jnp.asarray(mask))
         if float(rec) >= 0.999:
@@ -165,14 +174,14 @@ def test_revive_withdraws_bulk_entry():
     against the sampler."""
     params = _params(n=256, slots=2)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     node = 42
     s = s.replace(up=s.up.at[node].set(False),
                   bulk_member=s.bulk_member.at[node].set(True),
                   bulk_heard=s.bulk_heard + 0.5)   # mid-dissemination
     s = swim.revive(s, node)
     assert not bool(s.bulk_member[node])
-    s, _ = swim.run(params, s, 600)
+    s, _ = _run(params, s, 600)
     assert not bool(s.committed_dead[node])
     assert bool(s.up[node])
 
@@ -183,7 +192,7 @@ def test_bulk_straggler_keeps_own_clock():
     spread subjects — per-subject coverage carries its own clock."""
     params = _params(n=512, slots=4)
     s = swim.init_state(params)
-    s, _ = swim.run(params, s, 25)
+    s, _ = _run(params, s, 25)
     # seed a mature channel: 50 subjects at ~full coverage
     rng = np.random.default_rng(21)
     old = rng.choice(512, size=50, replace=False)
@@ -211,12 +220,51 @@ def test_bulk_straggler_keeps_own_clock():
     assert float(swim.believed_down_fraction(
         params, s, straggler)) < 0.05
     # old subjects commit without waiting on the straggler...
-    s, _ = swim.run(params, s, 200)
+    s, _ = _run(params, s, 200)
     assert np.asarray(s.committed_dead)[old].all(), \
         "rolling commit starved by the straggler"
     # ...and the straggler converges on its own schedule
     for _ in range(10):
         if bool(s.committed_dead[straggler]):
             break
-        s, _ = swim.run(params, s, 100)
+        s, _ = _run(params, s, 100)
     assert bool(s.committed_dead[straggler])
+
+
+def test_flap_revive_rejoins_with_bumped_incarnation():
+    """ISSUE 3 satellite: a node revived via kill_mask-then-revive
+    flapping rejoins with a BUMPED incarnation and the stale in-flight
+    suspect/dead rumors about it are withdrawn — a death rumor from
+    the flap window must never (re)commit it."""
+    params = _params(n=512, slots=8)
+    s = swim.init_state(params)
+    s, _ = _run(params, s, 25)
+    node = 100
+    mask = np.zeros(512, bool)
+    mask[node] = True
+    s = swim.kill_mask(s, jnp.asarray(mask))
+    # run until the death rumor itself is airborne (worst flap window)
+    stale = None
+    for _ in range(40):
+        s, _ = _run(params, s, 25)
+        stale = np.asarray(s.r_active) \
+            & (np.asarray(s.r_kind) == swim.DEAD) \
+            & (np.asarray(s.r_subject) == node)
+        if stale.any():
+            break
+        if bool(s.committed_dead[node]):
+            break
+    assert stale is not None and stale.any(), \
+        "setup: no dead rumor before commit"
+    inc_before = int(s.incarnation[node])
+    s = swim.revive(s, node)
+    # rejoined ABOVE the stale rumor's incarnation...
+    assert int(s.incarnation[node]) > inc_before
+    # ...the stale slots are withdrawn with their knowledge cells...
+    assert not (np.asarray(s.r_active) & stale).any()
+    assert not np.asarray(s.know)[:, np.flatnonzero(stale)].any()
+    # ...and the flapped death can never re-commit
+    for _ in range(20):
+        s, _ = _run(params, s, 100)
+        assert not bool(s.committed_dead[node]), "flap death recommitted"
+    assert bool(s.up[node]) and bool(s.member[node])

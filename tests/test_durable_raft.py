@@ -393,3 +393,69 @@ def test_non_ascii_chunks_split_by_bytes(tmp_path):
         if isinstance(e.cmd, dict) and "__chunk__" in e.cmd:
             raw = base64.b64decode(e.cmd["__chunk__"]["data"])
             assert len(raw) <= CHUNK_BYTES
+
+
+def test_restart_under_partition_rejoins_without_fork(tmp_path):
+    """ISSUE 3 satellite: a node that crashes AND restarts from its
+    durable log while partitioned away must neither lose nor fork
+    committed entries — on heal it catches up to exactly the
+    cluster's committed sequence."""
+    applied = {f"n{i}": [] for i in range(3)}
+    transport, nodes = _mk_cluster(tmp_path, applied)
+    now = _step(nodes, 0.0,
+                until=lambda: any(n.is_leader() for n in nodes))
+    leader = next(n for n in nodes if n.is_leader())
+    pends = [leader.apply({"cmd": i}) for i in range(5)]
+    now = _step(nodes, now, until=lambda: all(
+        p.event.is_set() for p in pends))
+
+    victim = next(n for n in nodes if not n.is_leader())
+    vid = victim.node_id
+    transport.isolate(vid)
+    # commits continue on the majority side
+    pends = [leader.apply({"cmd": i}) for i in range(5, 8)]
+    now = _step(nodes, now, until=lambda: all(
+        p.event.is_set() for p in pends))
+
+    # kill -9 the partitioned node and restart it from its durable
+    # log — still partitioned
+    victim.store.close()
+    transport.unregister(vid)
+    nodes.remove(victim)
+    applied[vid] = []
+    store = DurableLog(str(tmp_path / vid))
+    restarted = RaftNode(
+        vid, ["n0", "n1", "n2"], transport,
+        apply_fn=lambda cmd, nid=vid: applied[nid].append(cmd),
+        snapshot_fn=lambda nid=vid: {"applied": list(applied[nid])},
+        restore_fn=lambda data, nid=vid: (
+            applied[nid].clear(),
+            applied[nid].extend(data["applied"])),
+        config=RaftConfig(), seed=7, store=store)
+    transport.register(restarted)
+    nodes.append(restarted)
+    # its durable log held the first five committed entries
+    assert restarted.last_log_index >= 5
+    now = _step(nodes, now, n=100)
+    # partitioned: it must not fabricate progress (pre-vote keeps it
+    # from bumping terms, boot keeps uncommitted state uncommitted)
+    assert not restarted.is_leader()
+    got = [c for c in applied[vid] if c is not None]
+    want = [c for c in applied[leader.node_id] if c is not None]
+    assert got == want[:len(got)], "restarted node forked the log"
+
+    transport.heal()
+    expect = [{"cmd": i} for i in range(8)]
+    now = _step(nodes, now, n=600, until=lambda: all(
+        [c for c in applied[f"n{j}"] if c is not None] == expect
+        for j in range(3)))
+    for j in range(3):
+        assert [c for c in applied[f"n{j}"] if c is not None] == \
+            expect, f"n{j} lost or forked committed entries"
+    # and the healed cluster still accepts writes on top
+    lead2 = next(n for n in nodes if n.is_leader())
+    p = lead2.apply({"cmd": "post-heal"})
+    _step(nodes, now, until=p.event.is_set)
+    assert {"cmd": "post-heal"} in applied[lead2.node_id]
+    for n in nodes:
+        n.store.close()

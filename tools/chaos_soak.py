@@ -1,0 +1,140 @@
+"""Chaos soak runner: replay nemesis scenario suites, verify the
+cross-layer safety invariants, print the reproducing seed on any
+violation (ISSUE 3 tentpole).
+
+    python tools/chaos_soak.py                    # full soak, all
+                                                  # scenarios, emits
+                                                  # CHAOS_r01.json
+    python tools/chaos_soak.py --seed 42          # same suite, seed 42
+    python tools/chaos_soak.py --scenario partition_heal --seed 13
+    python tools/chaos_soak.py --check            # tier-1 smoke: fixed
+                                                  # seeds, small N,
+                                                  # virtual-time
+                                                  # scenarios + a
+                                                  # determinism
+                                                  # double-run
+
+Every scenario is driven from ONE printed seed: the raft layers run on
+virtual time with seeded RNGs (message-level faults flush through
+InMemTransport.advance), the SWIM layer's fault masks evolve between
+jitted device scans, so a report row is bit-reproducible via the
+printed `repro` command.  Any invariant violation prints a one-line
+
+    python tools/chaos_soak.py --seed <s> --scenario <name>
+
+reproducer and exits non-zero.  `--check` gates in tier-1 next to
+`bench_guard --check` (tests/test_chaos.py runs it as a subprocess).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ARTIFACT = os.path.join(REPO, "CHAOS_r01.json")
+CHECK_SEED = 7
+
+
+def _enable_compilation_cache() -> None:
+    """Persistent XLA cache — the same helper bench.py installs, so
+    the soak, the smoke, and the bench share one cache policy."""
+    from bench import enable_compilation_cache
+    enable_compilation_cache()
+
+
+def run_suite(names, seed: int, soak: bool) -> list:
+    from consul_tpu import chaos
+    rows = []
+    for name in names:
+        t0 = time.time()
+        row = chaos.run_scenario(name, seed, soak=soak)
+        row["wall_s"] = round(time.time() - t0, 2)
+        rows.append(row)
+        print(json.dumps({k: row[k] for k in
+                          ("scenario", "seed", "ok", "digest",
+                           "wall_s")}))
+        for v in row["violations"]:
+            print(f"VIOLATION [{name}]: {v}", file=sys.stderr)
+            print(f"  reproduce: {row['repro']}", file=sys.stderr)
+    return rows
+
+
+def run_check() -> int:
+    """Tier-1 smoke: the virtual-time scenario set at small scale with
+    a fixed seed, plus a bit-reproducibility double-run."""
+    from consul_tpu import chaos
+    rows = run_suite(chaos.CHECK_SCENARIOS, CHECK_SEED, soak=False)
+    failures = [f"{r['scenario']}: {v}" for r in rows if not r["ok"]
+                for v in r["violations"]]
+    # determinism: the same seed must reproduce the same end state
+    again = chaos.run_scenario("partition_heal", CHECK_SEED, soak=False)
+    first = next(r for r in rows if r["scenario"] == "partition_heal")
+    deterministic = again["digest"] == first["digest"]
+    if not deterministic:
+        failures.append(
+            f"partition_heal not reproducible from seed {CHECK_SEED}: "
+            f"{first['digest']} vs {again['digest']}")
+    out = {"mode": "check", "seed": CHECK_SEED,
+           "scenarios": [r["scenario"] for r in rows],
+           "deterministic": deterministic,
+           "ok": not failures, "failures": failures}
+    print(json.dumps(out))
+    return 1 if failures else 0
+
+
+def run_soak(names, seed: int, out_path: str) -> int:
+    from consul_tpu import chaos
+    rows = run_suite(names, seed, soak=True)
+    report = {
+        "suite": "chaos_soak",
+        "seed": seed,
+        "date": time.strftime("%Y-%m-%d"),
+        "ok": all(r["ok"] for r in rows),
+        "scenarios": rows,
+        "invariants": [
+            "election safety (<=1 leader per term)",
+            "committed-entry durability across crash-restart",
+            "linearizable KV register (client histories)",
+            "no committed death of a reachable live node",
+            "re-convergence within tick budget after heal",
+        ],
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+    print(f"wrote {out_path} ok={report['ok']}")
+    return 0 if report["ok"] else 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scenario", default=None,
+                    help="run one scenario (default: the full suite)")
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 smoke: fixed seeds, small N, "
+                         "virtual-time scenarios only")
+    ap.add_argument("--out", default=ARTIFACT)
+    args = ap.parse_args()
+    _enable_compilation_cache()
+    from consul_tpu import chaos
+    if args.check:
+        sys.exit(run_check())
+    if args.scenario is not None:
+        if args.scenario not in chaos.SCENARIOS:
+            ap.error(f"unknown scenario {args.scenario!r}; one of "
+                     f"{sorted(chaos.SCENARIOS)}")
+        rows = run_suite([args.scenario], args.seed, soak=False)
+        sys.exit(0 if all(r["ok"] for r in rows) else 1)
+    sys.exit(run_soak(list(chaos.SCENARIOS), args.seed, args.out))
+
+
+if __name__ == "__main__":
+    main()
