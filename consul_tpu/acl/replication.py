@@ -13,24 +13,75 @@ carve-out).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Tuple
 
 
 class Replicator:
     """Shared rate-limited round loop (replication.go Replicator):
-    subclasses implement run_once() -> (upserts, deletes)."""
+    subclasses implement run_once() -> (upserts, deletes).  Round
+    outcomes feed the status surface GET /v1/acl/replication serves
+    (acl_endpoint.go ACLReplicationStatus)."""
+
+    # the reference reports which payload class replicates
+    replication_type = "tokens"
 
     def __init__(self, primary_store, secondary_store,
-                 interval: float = 30.0):
+                 interval: float = 30.0, source_dc: str = "dc1"):
         self.primary = primary_store
         self.secondary = secondary_store
         self.interval = interval
+        self.source_dc = source_dc
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_round: Tuple[int, int] = (0, 0)  # (upserts, deletes)
+        # status (acl_replication.go updateACLReplicationStatus)
+        self.last_success: Optional[float] = None
+        self.last_error: Optional[float] = None
+        self.last_error_message: Optional[str] = None
+        self.replicated_index = 0
+        self.rounds = 0
 
     def run_once(self) -> Tuple[int, int]:  # pragma: no cover
         raise NotImplementedError
+
+    def run_round(self) -> Tuple[int, int]:
+        """run_once plus status bookkeeping; the loop and the tests
+        both drive rounds through here."""
+        try:
+            out = self.run_once()
+        except Exception as e:
+            self.last_error = time.time()
+            self.last_error_message = f"{type(e).__name__}: {e}"
+            raise
+        self.rounds += 1
+        self.last_success = time.time()
+        self.replicated_index = getattr(self.primary, "index", 0)
+        return out
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive() \
+            and not self._stop.is_set()
+
+    def status(self) -> dict:
+        """ACLReplicationStatus shape (agent/structs/acl.go)."""
+
+        def stamp(t):
+            return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                 time.gmtime(t)) if t else None
+
+        return {
+            "Enabled": True,
+            "Running": self.running,
+            "SourceDatacenter": self.source_dc,
+            "ReplicationType": self.replication_type,
+            "ReplicatedIndex": self.replicated_index,
+            "ReplicatedTokenIndex": self.replicated_index,
+            "LastSuccess": stamp(self.last_success),
+            "LastError": stamp(self.last_error),
+            "LastErrorMessage": self.last_error_message,
+        }
 
     def start(self) -> None:
         self._stop.clear()
@@ -38,7 +89,7 @@ class Replicator:
         def loop():
             while not self._stop.is_set():
                 try:
-                    self.run_once()
+                    self.run_round()
                 except Exception:
                     pass  # rate-limited retry next round (replication.go)
                 self._stop.wait(self.interval)
@@ -120,6 +171,8 @@ class ConfigEntryReplicator(Replicator):
     written in the primary DC must converge to every secondary, same
     content-diff round shape as the other replicators."""
 
+    replication_type = "config-entries"
+
     def run_once(self):
         ups = dels = 0
 
@@ -149,6 +202,8 @@ class FederationStateReplicator(Replicator):
     (agent/consul/federation_state_replication.go): each round lists the
     primary's per-DC gateway states and upserts/deletes by content, the
     same shape as ACL replication."""
+
+    replication_type = "federation-states"
 
     def run_once(self):
         ups = dels = 0

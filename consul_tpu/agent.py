@@ -256,7 +256,8 @@ class Agent:
             return
         import json
         import os
-        import tempfile
+
+        from consul_tpu import storage
         if self._persist_lock is None:
             self._persist_lock = threading.Lock()
         with self._persist_lock:
@@ -264,20 +265,14 @@ class Agent:
             state = {"services": self.local.services(),
                      "checks": self.local.checks(),
                      "check_definitions": dict(self.checks.definitions)}
-            # unique tmp per writer + atomic replace: concurrent
-            # registrations must not interleave on one tmp path
-            fd, tmp = tempfile.mkstemp(dir=self.data_dir,
-                                       prefix=".local_state.")
             try:
-                with os.fdopen(fd, "w") as f:
-                    json.dump(state, f)
-                os.replace(tmp,
-                           os.path.join(self.data_dir, "local_state.json"))
+                # unique tmp per writer + atomic replace (the storage
+                # seam): concurrent registrations must not interleave
+                storage.atomic_replace(
+                    os.path.join(self.data_dir, "local_state.json"),
+                    json.dumps(state).encode())
             except OSError:
-                try:
-                    os.unlink(tmp)
-                except OSError:
-                    pass
+                pass    # best-effort persistence, like the reference
 
     def _restore_local(self) -> None:
         if not self.data_dir:

@@ -128,6 +128,10 @@ class ApiServer:
         self.tokens = TokenStore()
         # set by Agent.from_config: PUT /v1/agent/reload re-reads config
         self.reload_fn = None
+        # secondary-DC wiring: an acl.replication.Replicator whose
+        # status GET /v1/acl/replication reports (None = replication
+        # not enabled on this agent)
+        self.acl_replicator = None
         # multi-DC: a WanRouter enables ?dc= forwarding + query failover
         # (agent/consul/rpc.go:658 forwardDC)
         self.router = None
@@ -2446,6 +2450,14 @@ def _make_handler(srv: ApiServer):
                     # half-restored-state failure mode)
                     StateStore.restore(state)
                 except (snapmod.SnapshotError, Exception) as e:
+                    # refuse-before-touch + surface it: a tampered or
+                    # bit-flipped archive must never reach the store,
+                    # and ops must see that it was rejected (the same
+                    # consul.raft.recovery.* family the WAL loader
+                    # bumps on disk corruption)
+                    from consul_tpu import telemetry
+                    telemetry.incr_counter(
+                        ("raft", "recovery", "snapshot_rejected"))
                     self._err(400, f"invalid snapshot: {e}")
                     return True
                 store.load_snapshot(state)
@@ -2459,6 +2471,22 @@ def _make_handler(srv: ApiServer):
             """/v1/acl/* (agent/acl_endpoint.go; route table
             agent/http_register.go:4-30)."""
             import uuid as _uuid
+            if path == "/v1/acl/replication" and verb == "GET":
+                # replication status (ACLReplicationStatus): readable
+                # without a token in the reference — operators probe
+                # it to debug secondary-DC lag
+                rep = srv.acl_replicator
+                if rep is None:
+                    self._send({"Enabled": False, "Running": False,
+                                "SourceDatacenter": "",
+                                "ReplicationType": "",
+                                "ReplicatedIndex": 0,
+                                "ReplicatedTokenIndex": 0,
+                                "LastSuccess": None, "LastError": None,
+                                "LastErrorMessage": None})
+                    return True
+                self._send(rep.status())
+                return True
             if path == "/v1/acl/bootstrap" and verb == "PUT":
                 accessor, secret = str(_uuid.uuid4()), str(_uuid.uuid4())
                 ok, idx = store.acl_bootstrap(accessor, secret)

@@ -47,10 +47,9 @@ def initial_configuration(addr: Tuple[str, int], jwt: str,
 def persist(data_dir: str, response: dict) -> None:
     """Atomic write of the bootstrap response (persist.go)."""
     os.makedirs(data_dir, exist_ok=True)
-    tmp = os.path.join(data_dir, PERSIST_FILE + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(response, f)
-    os.replace(tmp, os.path.join(data_dir, PERSIST_FILE))
+    from consul_tpu import storage
+    storage.atomic_replace(os.path.join(data_dir, PERSIST_FILE),
+                           json.dumps(response).encode())
 
 
 def load_persisted(data_dir: str) -> Optional[dict]:

@@ -6,10 +6,17 @@ guarantees *under* message loss and local degradation, and Consul's own
 partition tests shut sockets down mid-write — yet until this module the
 repo's only fault surfaces were a scalar `p_loss`, ad-hoc
 `partition()`/`isolate()` hooks, and one blind TCP reconnect.  The
-nemesis drives seeded, scenario-shaped fault timelines through THREE
+nemesis drives seeded, scenario-shaped fault timelines through FOUR
 layers with one API and checks the safety properties that must survive
 them:
 
+  layer 0  the disk (consensus/logstore.py through the
+           consul_tpu/storage.py seam): `FaultyStorage` models the
+           page-cache/durable split and injects torn writes, lost and
+           failing fsyncs, ENOSPC, rename reordering, and seeded bit
+           rot; `run_crash_matrix` crashes at EVERY I/O boundary of a
+           write/compact/snapshot/restart trace and checks recovery
+           against a durable-prefix model (tools/crash_matrix.py);
   layer 1  in-memory raft transport (consensus/raft.py InMemTransport):
            partitions/heals via the generalized cut hooks, plus a
            message-level `LinkInjector` (loss, delay, duplication,
@@ -27,6 +34,12 @@ them:
 
 Invariant checkers:
 
+  WAL recovery         recovered storage equals the replay of SOME
+                       durable prefix at least as new as everything
+                       acked (WalModel + check_wal_recovery: acked
+                       present/in order/once, term-vote monotone past
+                       acks, no resurrection of acked truncations,
+                       corruption detected never replayed)
   election safety      at most one raft leader per term, ever
                        (Raft §5.2; ElectionSafetyChecker)
   committed durability acked writes survive crash-restart-from-
@@ -41,20 +54,27 @@ Invariant checkers:
                        heal (SwimChaosHarness)
 
 `tools/chaos_soak.py` replays scenario suites built on these pieces,
-prints the reproducing seed on any violation, and emits CHAOS_r01.json;
-its `--check` mode is the fixed-seed tier-1 smoke.
+prints the reproducing seed on any violation, and emits CHAOS_r02.json;
+its `--check` mode is the fixed-seed tier-1 smoke (network scenarios
+plus the bounded storage-nemesis set).
 """
 
 from __future__ import annotations
 
+import errno
 import hashlib
 import json
 import math
 import os
 import random
+import struct
+import tempfile
+import zlib
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, BinaryIO, Callable, Dict, List, Optional, Tuple
 
+from consul_tpu import storage
+from consul_tpu.consensus.logstore import DurableLog
 from consul_tpu.consensus.raft import (
     LEADER, InMemTransport, NotLeaderError, RaftConfig, RaftNode,
 )
@@ -124,6 +144,572 @@ class LinkInjector:
         if rule.dup_p and rng.random() < rule.dup_p:
             plan.append(lo + rng.random() * (hi - lo))
         return plan
+
+
+# ---------------------------------------------------------------------------
+# layer 0: the storage nemesis — a deterministic disk between the WAL
+# and the bytes that survive a crash
+# ---------------------------------------------------------------------------
+
+
+class SimulatedCrash(BaseException):
+    """Raised by FaultyStorage when the scheduled crash point arrives —
+    BaseException so no storage-layer handler can swallow the `power
+    loss` on its way out of the I/O stack."""
+
+    def __init__(self, op_index: int, kind: str, path: str):
+        super().__init__(f"simulated crash at I/O op {op_index} "
+                         f"({kind} {os.path.basename(path)})")
+        self.op_index = op_index
+        self.kind = kind
+        self.path = path
+
+
+class FaultyStorage(storage.StorageOps):
+    """The storage seam with a disk model underneath: real files carry
+    the PAGE-CACHE view (what the running process reads back), while a
+    shadow map carries the DURABLE view (what survives power loss).
+    Writes land only in the cache; fsync promotes a file's cache to
+    durable; rename is visible immediately but durable only at the
+    parent-dir fsync.  `crash()` collapses the cache: every file
+    reverts to its durable bytes — plus, under the torn-write model, a
+    seeded prefix of its unsynced tail, the way a page cache drains
+    partially — and injectable faults betray the contract on the way:
+
+      lose_next_fsyncs   N fsyncs return success without persisting
+                         (a lying disk / ignored barrier)
+      fail_next_fsyncs   N fsyncs raise EIO (and persist nothing)
+      enospc             every write raises ENOSPC
+      enospc_after_writes  arm enospc after N more successful writes
+      torn               crash keeps a seeded partial unsynced tail
+      rename_reorder     crash commits un-fsynced renames while the
+                         renamed file's data may be lost (journal
+                         metadata outran the data blocks)
+      corrupt_on_crash   basenames that get one seeded bit flipped in
+                         their durable bytes at crash (bit rot)
+
+    Every durable-relevant call is one numbered I/O boundary;
+    `crash_at=k` raises SimulatedCrash in place of boundary k, which is
+    how tools/crash_matrix.py enumerates every cut of a trace.  All
+    randomness (tear lengths, flip positions) comes from per-file RNGs
+    derived from the seed, so a (seed, crash_at) pair is a complete
+    reproducer."""
+
+    def __init__(self, seed: int = 0, crash_at: Optional[int] = None,
+                 torn: bool = False, rename_reorder: bool = False,
+                 corrupt_on_crash: Tuple[str, ...] = ()):
+        self.seed = seed
+        self.crash_at = crash_at
+        self.torn = torn
+        self.rename_reorder = rename_reorder
+        self.corrupt_on_crash = tuple(corrupt_on_crash)
+        self.lose_next_fsyncs = 0
+        self.fail_next_fsyncs = 0
+        self.enospc = False
+        self.enospc_after_writes: Optional[int] = None
+        self.op_count = 0
+        self.oplog: List[Tuple[str, str]] = []
+        self.files: Dict[str, bytes] = {}      # durable view
+        self.flips: List[Tuple[str, int, int]] = []
+        self._pending: List[Tuple[str, str]] = []   # un-fsynced renames
+        self._paths: Dict[int, str] = {}       # id(handle) -> path
+        self._handles: List[BinaryIO] = []
+        self._tracked: set = set()
+        self._tmp_n = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    def _op(self, kind: str, path: str) -> int:
+        i = self.op_count
+        self.op_count += 1
+        self.oplog.append((kind, os.path.basename(path)))
+        if self.crash_at is not None and i >= self.crash_at:
+            raise SimulatedCrash(i, kind, path)
+        return i
+
+    def _file_rng(self, path: str) -> random.Random:
+        return random.Random(
+            (self.seed << 32)
+            ^ zlib.crc32(os.path.basename(path).encode()))
+
+    def _register(self, f: BinaryIO, path: str) -> BinaryIO:
+        self._paths[id(f)] = path
+        self._handles.append(f)
+        self._tracked.add(path)
+        return f
+
+    def _path_of(self, f: BinaryIO) -> str:
+        return self._paths.get(id(f)) or f.name
+
+    # -------------------------------------------------------------- handles
+
+    def open_append(self, path: str) -> BinaryIO:
+        # unbuffered: the cache view must reflect every seam write
+        # immediately, or tear lengths depend on libc buffer timing
+        return self._register(open(path, "ab", buffering=0), path)
+
+    def open_rw(self, path: str) -> BinaryIO:
+        return self._register(open(path, "r+b", buffering=0), path)
+
+    def create_tmp(self, directory: str,
+                   prefix: str) -> Tuple[BinaryIO, str]:
+        # deterministic names: tmp paths feed the durable map and the
+        # per-file RNGs, so mkstemp randomness would leak into digests
+        self._tmp_n += 1
+        tmp = os.path.join(directory, f"{prefix}{self._tmp_n:06d}")
+        return self._register(open(tmp, "wb", buffering=0), tmp), tmp
+
+    # ---------------------------------------------------------- durable ops
+
+    def write(self, f: BinaryIO, data: bytes) -> None:
+        path = self._path_of(f)
+        self._op("write", path)
+        if self.enospc_after_writes is not None:
+            if self.enospc_after_writes <= 0:
+                self.enospc = True
+            else:
+                self.enospc_after_writes -= 1
+        if self.enospc:
+            raise OSError(errno.ENOSPC, "No space left on device")
+        f.write(data)
+
+    def fsync(self, f: BinaryIO) -> None:
+        path = self._path_of(f)
+        self._op("fsync", path)
+        f.flush()
+        if self.fail_next_fsyncs > 0:
+            self.fail_next_fsyncs -= 1
+            raise OSError(errno.EIO, "Input/output error")
+        if self.lose_next_fsyncs > 0:
+            self.lose_next_fsyncs -= 1
+            return                      # the disk lied: nothing durable
+        try:
+            with open(path, "rb") as r:
+                self.files[path] = r.read()
+        except FileNotFoundError:
+            pass
+
+    def truncate(self, f: BinaryIO, size: int) -> None:
+        self._op("truncate", self._path_of(f))
+        f.truncate(size)
+
+    def replace(self, src: str, dst: str) -> None:
+        self._op("replace", dst)
+        storage.StorageOps.replace(self, src, dst)
+        self._tracked.add(dst)
+        self._pending.append((src, dst))
+
+    def fsync_dir(self, directory: str) -> None:
+        self._op("fsync_dir", directory)
+        still = []
+        for src, dst in self._pending:
+            if os.path.dirname(dst) == directory:
+                # the rename journals: dst durably takes src's DURABLE
+                # bytes (un-fsynced src data does not ride along)
+                self.files[dst] = self.files.pop(src, b"")
+            else:
+                still.append((src, dst))
+        self._pending = still
+
+    # ------------------------------------------------------------ the crash
+
+    def crash(self) -> None:
+        """Power loss: collapse the cache to the durable view and
+        materialize it onto the real files, applying the armed
+        betrayals (torn tails, reordered renames, bit rot).  The model
+        stays usable afterwards — its durable map is the new disk."""
+        for f in self._handles:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._handles.clear()
+        self._paths.clear()
+        survivors = dict(self.files)
+        # a path touched by an un-fsynced rename holds a DIFFERENT
+        # inode than its durable bytes — torn-tail extension across
+        # inodes would fabricate impossible disk states
+        renamed = {p for pair in self._pending for p in pair}
+        if self.rename_reorder:
+            for src, dst in self._pending:
+                survivors[dst] = survivors.pop(src, b"")
+        self._pending.clear()
+        final: Dict[str, bytes] = {}
+        for path in sorted(self._tracked):
+            base = survivors.get(path)
+            try:
+                with open(path, "rb") as r:
+                    real = r.read()
+            except FileNotFoundError:
+                real = None
+            out = base
+            if self.torn and real is not None and path not in renamed:
+                pre = base if base is not None else b""
+                if len(real) > len(pre) and real[:len(pre)] == pre:
+                    tail = real[len(pre):]
+                    k = self._file_rng(path).randint(0, len(tail))
+                    if base is not None or k > 0:
+                        out = pre + tail[:k]
+            if out is not None:
+                final[path] = out
+        for name in self.corrupt_on_crash:
+            for path, blob in final.items():
+                if os.path.basename(path) == name and blob:
+                    rng = self._file_rng(path + "#rot")
+                    pos = rng.randrange(len(blob))
+                    bit = 1 << rng.randrange(8)
+                    final[path] = (blob[:pos]
+                                   + bytes([blob[pos] ^ bit])
+                                   + blob[pos + 1:])
+                    self.flips.append((name, pos, bit))
+        for path in sorted(self._tracked):
+            if path in final:
+                with open(path, "wb") as w:
+                    w.write(final[path])
+            else:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        self.files = final
+        self._tracked = set(final)
+
+
+# ---------------------------------------------------------------------------
+# storage recovery model: `recovered state == replay of SOME durable
+# prefix at least as new as everything acked`
+# ---------------------------------------------------------------------------
+
+
+class WalModel:
+    """Ground truth for one DurableLog's trace.  The driver mirrors
+    every logical WAL record / meta write / snapshot write into this
+    model (note_* BEFORE the call, ack_* after it returns), and the
+    checker then verifies that the recovered state equals the replay
+    of some legal durable cut:
+
+      WAL    ∃ j >= acked-floor with replay(records[:j]) == recovered
+             (mid-rewrite crashes add the would-be rewritten file as a
+             second candidate list)
+      meta   recovered (term, vote) ∈ states from the last acked one
+             onward — term/vote never move backwards past an ack
+      snap   recovered (index, term, data) likewise
+
+    That one containment check subsumes PR 3's durability invariants
+    at this layer: acked entries present (cut >= floor), in order and
+    once (replay equality), no resurrection of acked truncations
+    (records after the trunc are inside every legal cut), and no
+    garbage (nothing outside the model ever compares equal)."""
+
+    def __init__(self):
+        self.records: List[tuple] = []
+        self.acked = 0
+        self.alt: Optional[List[tuple]] = None
+        self.meta_states: List[tuple] = [(0, None)]
+        self.meta_acked = 0
+        self.snap_states: List[tuple] = [(0, 0, None)]
+        self.snap_acked = 0
+
+    # WAL records ----------------------------------------------------------
+
+    def note_entry(self, idx: int, term: int, cmd,
+                   noop: bool = False) -> None:
+        self.records.append(("e", idx, term, cmd, noop))
+
+    def note_trunc(self, idx: int) -> None:
+        self.records.append(("trunc", idx))
+
+    def note_base(self, idx: int, term: int) -> None:
+        self.records.append(("base", idx, term))
+
+    def rollback_record(self) -> None:
+        """The write raised (ENOSPC) before the frame hit the file."""
+        self.records.pop()
+
+    def ack_wal(self) -> None:
+        self.acked = len(self.records)
+
+    # meta / snap ----------------------------------------------------------
+
+    def begin_meta(self, term: int, vote) -> None:
+        self.meta_states.append((term, vote))
+
+    def ack_meta(self) -> None:
+        self.meta_acked = len(self.meta_states) - 1
+
+    def begin_snap(self, index: int, term: int, data) -> None:
+        self.snap_states.append((index, term, data))
+
+    def ack_snap(self) -> None:
+        self.snap_acked = len(self.snap_states) - 1
+
+    # rewrite --------------------------------------------------------------
+
+    def begin_rewrite(self, new_records: List[tuple]) -> None:
+        self.alt = new_records
+
+    def end_rewrite(self, rewrote: bool) -> None:
+        if rewrote:
+            self.records = list(self.alt)
+            self.acked = len(self.records)
+        self.alt = None
+
+
+def _model_replay(records: List[tuple], snap_index: Optional[int],
+                  snap_term: int) -> Tuple[int, int, dict]:
+    """Mirror DurableLog.load()'s WAL semantics over logical records."""
+    base, base_term = 0, 0
+    entries: Dict[int, tuple] = {}
+    for r in records:
+        if r[0] == "e":
+            entries[r[1]] = (r[2], r[3], r[4])
+        elif r[0] == "trunc":
+            for i in [i for i in entries if i >= r[1]]:
+                del entries[i]
+        elif r[0] == "base":
+            if r[1] >= base:
+                base, base_term = r[1], r[2]
+    if snap_index is not None and base == 0:
+        base, base_term = snap_index, snap_term
+    for i in [i for i in entries if i <= base]:
+        del entries[i]
+    return base, base_term, entries
+
+
+def check_wal_recovery(recovered: Optional[dict], model: WalModel,
+                       lenient: frozenset = frozenset()) -> List[str]:
+    """Recovery invariant check; `lenient` relaxes the acked floor for
+    components a scenario deliberately corrupted ('wal', 'meta',
+    'snap' — e.g. bit rot on snap.json legitimately falls back one
+    generation)."""
+    out = []
+    if recovered is None:
+        if (model.acked or model.meta_acked or model.snap_acked):
+            return ["recovery: acked state exists but the directory "
+                    "loaded as fresh"]
+        return []
+    got_meta = (recovered["term"], recovered["voted_for"])
+    allowed = model.meta_states if "meta" in lenient \
+        else model.meta_states[model.meta_acked:]
+    if got_meta not in allowed:
+        out.append(f"meta: recovered term/vote {got_meta} not in the "
+                   f"legal set {allowed} (term/vote moved backwards "
+                   f"past an acked write)")
+    got_snap = (recovered["snap_index"], recovered["snap_term"],
+                recovered["snapshot"])
+    allowed_s = model.snap_states if "snap" in lenient \
+        else model.snap_states[model.snap_acked:]
+    if got_snap not in allowed_s:
+        out.append(f"snap: recovered snapshot index "
+                   f"{recovered['snap_index']} not in the legal set "
+                   f"{[s[0] for s in allowed_s]}")
+    snap_idx = recovered["snap_index"] if recovered["snapshot"] is not None \
+        else None
+    candidates = [(model.records,
+                   0 if "wal" in lenient else model.acked)]
+    if model.alt is not None:
+        candidates.append((model.alt,
+                           0 if "wal" in lenient else len(model.alt)))
+    for recs, floor in candidates:
+        for j in range(floor, len(recs) + 1):
+            b, bt, ents = _model_replay(recs[:j], snap_idx,
+                                        recovered["snap_term"])
+            if (b == recovered["base"] and bt == recovered["base_term"]
+                    and ents == recovered["entries"]):
+                return out
+    out.append(
+        f"wal: recovered entries {sorted(recovered['entries'])} "
+        f"(base {recovered['base']}) match no legal durable prefix — "
+        f"acked entries lost, resurrected, reordered, or corrupt "
+        f"bytes replayed")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# crash-point trace + matrix
+# ---------------------------------------------------------------------------
+
+
+def _drive_wal_trace(directory: str, fs: FaultyStorage, seed: int,
+                     steps: int, model: WalModel, holder: dict,
+                     rewrite_threshold: int = 14) -> None:
+    """One seeded write/compact/snapshot/restart trace against a
+    DurableLog on `fs`.  The trace script depends only on `seed`, so
+    every crash_at cell of the matrix cuts the SAME op sequence.
+    `holder['log']` always carries the live DurableLog so the caller
+    can abort() it when SimulatedCrash unwinds."""
+    rng = random.Random(seed ^ 0x5EED)
+    log = holder["log"] = DurableLog(directory,
+                                     rewrite_threshold=rewrite_threshold,
+                                     io=fs)
+    log.load()
+    term, vote = 1, None
+    model.begin_meta(term, vote)
+    log.set_term_vote(term, vote)
+    model.ack_meta()
+    next_idx, base, base_term, val = 1, 0, 0, 0
+    all_ents: Dict[int, tuple] = {}    # idx -> (term, cmd, noop), never
+    #                                    pruned by compaction
+    for _ in range(steps):
+        r = rng.random()
+        if r < 0.52 or next_idx <= 3:
+            for _ in range(rng.randint(1, 3)):
+                cmd = f"v{val}"
+                val += 1
+                model.note_entry(next_idx, term, cmd)
+                try:
+                    log.append(next_idx, term, cmd)
+                except OSError:
+                    model.rollback_record()
+                    continue
+                all_ents[next_idx] = (term, cmd, False)
+                next_idx += 1
+            log.sync()
+            model.ack_wal()
+        elif r < 0.62:
+            term += 1
+            vote = rng.choice(["n0", "n1", None])
+            model.begin_meta(term, vote)
+            try:
+                log.set_term_vote(term, vote)
+            except OSError:
+                continue
+            model.ack_meta()
+        elif r < 0.74 and next_idx - 1 > base + 1:
+            # conflict resolution: truncate a suffix, re-append under
+            # a bumped term (the deposed-leader shape)
+            j = rng.randint(base + 2, next_idx - 1)
+            model.note_trunc(j)
+            try:
+                log.truncate_from(j)
+            except OSError:
+                model.rollback_record()
+                continue
+            for i in range(j, next_idx):
+                all_ents.pop(i, None)
+            next_idx = j
+            term += 1
+            cmd = f"v{val}"
+            val += 1
+            model.note_entry(next_idx, term, cmd)
+            try:
+                log.append(next_idx, term, cmd)
+            except OSError:
+                model.rollback_record()
+                log.sync()
+                model.ack_wal()
+                continue
+            all_ents[next_idx] = (term, cmd, False)
+            next_idx += 1
+            log.sync()
+            model.ack_wal()
+        elif r < 0.90 and next_idx - 1 > base + 4:
+            # compact: snapshot the applied prefix, base trails it
+            snap_idx = next_idx - 1 - rng.randint(0, 2)
+            new_base = max(base, snap_idx - rng.randint(0, 2))
+            if snap_idx <= base:
+                continue
+            snap_term = all_ents[snap_idx][0]
+            nb_term = all_ents[new_base][0] if new_base in all_ents \
+                else base_term
+            data = {"log": [all_ents[i][1]
+                            for i in sorted(all_ents) if i <= snap_idx]}
+            live = {i: all_ents[i] for i in all_ents if i > new_base}
+            model.begin_snap(snap_idx, snap_term, data)
+            model.note_base(new_base, nb_term)
+            will_rewrite = (log._records_since_rewrite + 1
+                            >= log.rewrite_threshold)
+            if will_rewrite:
+                model.begin_rewrite(
+                    [("base", new_base, nb_term)]
+                    + [("e", i, *live[i]) for i in sorted(live)
+                       if i > new_base])
+            try:
+                res = log.save_snapshot(snap_idx, snap_term, data, live,
+                                        base=new_base, base_term=nb_term)
+            except OSError:
+                model.rollback_record()     # the base frame never wrote
+                model.end_rewrite(False)
+                continue
+            model.ack_snap()
+            model.ack_wal()
+            model.end_rewrite(res["rewrote"])
+            base, base_term = new_base, nb_term
+        else:
+            # process restart (no power loss): the page cache — the
+            # real files — survives; only the fds drop
+            log.abort()
+            log = holder["log"] = DurableLog(
+                directory, rewrite_threshold=rewrite_threshold, io=fs)
+            log.load()
+
+
+def run_crash_matrix(seed: int, steps: int = 14, torn: bool = True,
+                     stride: int = 1, tmp: Optional[str] = None,
+                     crash_at: Optional[int] = None,
+                     rewrite_threshold: int = 14) -> dict:
+    """Enumerate every I/O boundary of the seeded trace, crash at each
+    one, restart from the surviving bytes, and check recovery.  Pass
+    `crash_at` to replay a single cell (the printed reproducer)."""
+
+    def one_cell(k: Optional[int]) -> Tuple[List[str], str]:
+        with tempfile.TemporaryDirectory(dir=tmp) as d:
+            cell_seed = seed if k is None \
+                else (seed * 1000003 + k) & 0xFFFFFFFF
+            fs = FaultyStorage(seed=cell_seed, crash_at=k, torn=torn)
+            model = WalModel()
+            holder: dict = {}
+            try:
+                _drive_wal_trace(d, fs, seed, steps, model, holder,
+                                 rewrite_threshold)
+            except SimulatedCrash:
+                pass
+            if holder.get("log") is not None:
+                holder["log"].abort()
+            fs.crash()
+            rec = DurableLog(d)
+            st = rec.load()
+            rec.close()
+            digest = hashlib.sha256(json.dumps(
+                {"st": None if st is None else
+                 {"term": st["term"], "base": st["base"],
+                  "entries": sorted(st["entries"].items())},
+                 }, sort_keys=True, default=str).encode()
+            ).hexdigest()[:8]
+            return check_wal_recovery(st, model), digest
+
+    # pass 0: record the full op trace (no crash) to size the matrix
+    with tempfile.TemporaryDirectory(dir=tmp) as d:
+        fs = FaultyStorage(seed=seed)
+        model = WalModel()
+        holder = {}
+        _drive_wal_trace(d, fs, seed, steps, model, holder,
+                         rewrite_threshold)
+        holder["log"].close()
+        n_ops = fs.op_count
+        kinds = {}
+        for kind, _ in fs.oplog:
+            kinds[kind] = kinds.get(kind, 0) + 1
+    cells = [crash_at] if crash_at is not None \
+        else list(range(0, n_ops, stride)) + [n_ops]
+    violations: List[str] = []
+    digests: List[str] = []
+    for k in cells:
+        vs, digest = one_cell(k if k < n_ops else None)
+        digests.append(digest)
+        for v in vs:
+            # the reproducer must replay the IDENTICAL run: torn mode
+            # and rewrite threshold both change the op sequence/model
+            torn_flag = " --torn" if torn else " --clean"
+            violations.append(
+                f"crash_at={k}: {v} [reproduce: python "
+                f"tools/crash_matrix.py --seed {seed} --steps {steps}"
+                f"{torn_flag} --rewrite-threshold {rewrite_threshold}"
+                f" --crash-at {k}]")
+    return {"boundaries": n_ops, "cells": len(cells),
+            "op_kinds": kinds, "violations": violations,
+            "digest": hashlib.sha256(
+                "".join(digests).encode()).hexdigest()[:16]}
 
 
 # ---------------------------------------------------------------------------
@@ -314,7 +900,9 @@ class RaftChaosHarness:
 
     def __init__(self, n: int = 3, seed: int = 0,
                  data_root: Optional[str] = None,
-                 config: Optional[RaftConfig] = None):
+                 config: Optional[RaftConfig] = None,
+                 storage_factory: Optional[
+                     Callable[[str], storage.StorageOps]] = None):
         self.seed = seed
         self.transport = InMemTransport(seed=seed)
         self.injector = LinkInjector(seed ^ 0x9E3779B9)
@@ -322,6 +910,11 @@ class RaftChaosHarness:
         self.cfg = config or RaftConfig()
         self.data_root = data_root
         self.durable = data_root is not None
+        # per-node storage seam (FaultyStorage for the disk nemesis);
+        # instances persist across crash/restart — their durable map
+        # IS the node's disk
+        self.storage_factory = storage_factory
+        self._ios: Dict[str, storage.StorageOps] = {}
         self.ids = [f"n{i}" for i in range(n)]
         self.logs: Dict[str, list] = {nid: [] for nid in self.ids}
         self.value: Dict[str, Any] = {nid: None for nid in self.ids}
@@ -342,8 +935,13 @@ class RaftChaosHarness:
     def _mk_node(self, nid: str) -> RaftNode:
         store = None
         if self.durable:
-            from consul_tpu.consensus.logstore import DurableLog
-            store = DurableLog(os.path.join(self.data_root, nid))
+            io = None
+            if self.storage_factory is not None:
+                if nid not in self._ios:
+                    self._ios[nid] = self.storage_factory(nid)
+                io = self._ios[nid]
+            store = DurableLog(os.path.join(self.data_root, nid),
+                               io=io)
 
         def apply_fn(cmd, nid=nid):
             v = cmd["v"]
@@ -365,11 +963,18 @@ class RaftChaosHarness:
         return node
 
     def crash(self, nid: str) -> None:
-        """kill -9: the node object drops, queued frames drop with it;
-        only its DurableLog (when data_root is set) survives."""
+        """kill -9: the node object drops, queued frames drop with it,
+        and un-synced WAL bytes stay wherever the page cache left them
+        (abort, not close — a real SIGKILL doesn't flush).  Under a
+        FaultyStorage the crash also collapses the simulated page
+        cache, tearing/losing whatever the fault schedule dictates;
+        only durable bytes greet the restart."""
         node = self.nodes[nid]
         if node.store is not None:
-            node.store.close()
+            node.store.abort()
+        io = self._ios.get(nid)
+        if io is not None and hasattr(io, "crash"):
+            io.crash()
         self.transport.unregister(nid)
         self.alive[nid] = False
 
@@ -1029,6 +1634,279 @@ def scenario_tcp_flaky(seed: int, tmp: Optional[str] = None,
                    {"acked": len(acked)})
 
 
+# ------------------------------------------------------- storage nemesis
+
+
+def scenario_crash_matrix(seed: int, tmp: Optional[str] = None,
+                          soak: bool = False) -> dict:
+    """The exhaustive cut: crash at EVERY I/O boundary of a seeded
+    write/compact/snapshot/restart trace (clean cuts — the page cache
+    drains nothing extra) and prove recovery at each one."""
+    res = run_crash_matrix(seed, steps=36 if soak else 18, torn=False,
+                           tmp=tmp, rewrite_threshold=12)
+    detail = {k: res[k] for k in ("boundaries", "cells", "op_kinds",
+                                  "digest")}
+    return _report("crash_matrix", seed, res["violations"], detail)
+
+
+def scenario_disk_torn(seed: int, tmp: Optional[str] = None,
+                       soak: bool = False) -> dict:
+    """Torn writes: every crash keeps a seeded partial prefix of the
+    unsynced tail.  Layer 0 runs the full boundary matrix under the
+    torn model; then a raft cluster on torn disks eats a follower and
+    a leader kill -9 — every acked write must survive and histories
+    must linearize (fsync-before-ack is the property under test)."""
+    res = run_crash_matrix(seed, steps=30 if soak else 16, torn=True,
+                           tmp=tmp, rewrite_threshold=12)
+    violations = list(res["violations"])
+    detail: dict = {"matrix": {k: res[k] for k in
+                               ("boundaries", "cells", "digest")}}
+    with tempfile.TemporaryDirectory(dir=tmp) as d:
+        h = RaftChaosHarness(
+            n=3, seed=seed, data_root=d,
+            storage_factory=lambda nid: FaultyStorage(
+                seed ^ zlib.crc32(nid.encode()), torn=True))
+        h.step(1.0)
+        _drive(h, 0.8)
+        follower = next(i for i in h.ids
+                        if not h.nodes[i].is_leader())
+        h.crash(follower)
+        _drive(h, 0.8)
+        h.restart(follower)
+        _drive(h, 0.8)
+        leader = h._leader()
+        if leader is not None:
+            h.crash(leader.node_id)
+            _drive(h, 1.2)
+            h.restart(leader.node_id)
+        _drive(h, 0.8)
+        h.settle()
+        violations += h.violations()
+        detail["raft"] = h.digest_detail()
+    return _report("disk_torn", seed, violations, detail)
+
+
+def scenario_fsync_lost(seed: int, tmp: Optional[str] = None,
+                        soak: bool = False) -> dict:
+    """A lying disk: fsync returns success without persisting.  No WAL
+    can keep the durability promise on such hardware — what MUST still
+    hold is prefix consistency: recovery yields a clean, checksummed
+    prefix of the honestly-acked records (the floor from before the
+    lies began), never a hole, a reorder, or garbage."""
+    with tempfile.TemporaryDirectory(dir=tmp) as d:
+        fs = FaultyStorage(seed, torn=True)
+        model = WalModel()
+        log = DurableLog(d, rewrite_threshold=999, io=fs)
+        log.load()
+        model.begin_meta(1, None)
+        log.set_term_vote(1, None)
+        model.ack_meta()
+        idx = 1
+        for i in range(12 if soak else 8):
+            model.note_entry(idx, 1, f"v{idx}")
+            log.append(idx, 1, f"v{idx}")
+            idx += 1
+            if i % 2:
+                log.sync()
+                model.ack_wal()
+        log.sync()
+        model.ack_wal()
+        honest_floor = model.acked
+        fs.lose_next_fsyncs = 10 ** 9
+        for _ in range(10 if soak else 6):
+            model.note_entry(idx, 2, f"v{idx}")
+            log.append(idx, 2, f"v{idx}")
+            idx += 1
+            log.sync()          # the node believes this acked; it lied
+        log.abort()
+        fs.crash()
+        rec = DurableLog(d)
+        st = rec.load()
+        rec.close()
+        violations = check_wal_recovery(st, model)
+        detail = {"honest_floor": honest_floor,
+                  "written": len(model.records),
+                  "recovered_top": max(st["entries"], default=0)
+                  if st else 0,
+                  "recovery": st["recovery"] if st else None}
+    return _report("fsync_lost", seed, violations, detail)
+
+
+def scenario_enospc(seed: int, tmp: Optional[str] = None,
+                    soak: bool = False) -> dict:
+    """Disk full: appends and term/vote writes fail loudly (never
+    acked, never clobbering what's there), a compaction whose WAL
+    rewrite hits ENOSPC mid-stream abandons the rewrite and keeps the
+    old WAL complete, and after space returns everything acked — on
+    both sides of the outage — survives a crash."""
+    with tempfile.TemporaryDirectory(dir=tmp) as d:
+        fs = FaultyStorage(seed)
+        model = WalModel()
+        log = DurableLog(d, rewrite_threshold=6, io=fs)
+        log.load()
+        model.begin_meta(1, None)
+        log.set_term_vote(1, None)
+        model.ack_meta()
+        idx = 1
+        failures = 0
+
+        def put(n: int, term: int) -> None:
+            nonlocal idx, failures
+            for _ in range(n):
+                model.note_entry(idx, term, f"v{idx}")
+                try:
+                    log.append(idx, term, f"v{idx}")
+                except OSError:
+                    model.rollback_record()
+                    failures += 1
+                    continue
+                idx += 1
+            log.sync()
+            model.ack_wal()
+
+        put(8, 1)
+        fs.enospc = True
+        put(4, 1)                       # all fail; none acked
+        model.begin_meta(2, "n1")
+        try:
+            log.set_term_vote(2, "n1")  # must fail without clobbering
+        except OSError:
+            failures += 1
+        else:
+            model.ack_meta()
+        fs.enospc = False
+        put(6 if soak else 4, 1)
+        # compaction whose rewrite runs out of disk mid-stream: the
+        # snap + base record land (2 writes), the rewrite's first
+        # write trips ENOSPC — old WAL must stay complete
+        snap_idx = idx - 3
+        nbase = snap_idx - 1
+        data = {"log": [f"v{i}" for i in range(1, snap_idx + 1)]}
+        live = {i: (1, f"v{i}", False) for i in range(nbase + 1, idx)}
+        model.begin_snap(snap_idx, 1, data)
+        model.note_base(nbase, 1)
+        model.begin_rewrite([("base", nbase, 1)]
+                            + [("e", i, *live[i]) for i in sorted(live)
+                               if i > nbase])
+        fs.enospc_after_writes = 2
+        res = log.save_snapshot(snap_idx, 1, data, live, base=nbase,
+                                base_term=1)
+        rewrite_survived = not res["rewrote"]
+        model.ack_snap()
+        model.ack_wal()
+        model.end_rewrite(res["rewrote"])
+        fs.enospc = False
+        fs.enospc_after_writes = None
+        put(4, 1)
+        log.abort()
+        fs.crash()
+        rec = DurableLog(d)
+        st = rec.load()
+        rec.close()
+        violations = check_wal_recovery(st, model)
+        if not failures:
+            violations.append("enospc: no write ever failed — the "
+                              "fault was not injected")
+        if not rewrite_survived:
+            violations.append("enospc: WAL rewrite claimed success "
+                              "on a full disk")
+        detail = {"failures": failures, "acked": model.acked,
+                  "recovered_top": max(st["entries"], default=0)
+                  if st else 0}
+    return _report("enospc", seed, violations, detail)
+
+
+def scenario_bit_rot(seed: int, tmp: Optional[str] = None,
+                     soak: bool = False) -> dict:
+    """One flipped bit in wal.log, snap.json, or meta.json.  The CRC
+    layer must DETECT every flip (never replay rot as committed
+    state): the WAL quarantines at exactly the bad frame, the checked
+    files fall back one generation — and in every case recovery still
+    equals a legal prefix of what was written."""
+    from consul_tpu.consensus.logstore import PersistentStateCorruptError
+    violations: List[str] = []
+    detail: dict = {}
+    for target, relax in (("wal.log", "wal"), ("snap.json", "snap"),
+                          ("meta.json", "meta")):
+        with tempfile.TemporaryDirectory(dir=tmp) as d:
+            fs = FaultyStorage(seed ^ zlib.crc32(target.encode()),
+                               corrupt_on_crash=(target,))
+            model = WalModel()
+            log = DurableLog(d, rewrite_threshold=999, io=fs)
+            log.load()
+            for t, v in ((1, None), (2, "n1")):   # meta.prev exists
+                model.begin_meta(t, v)
+                log.set_term_vote(t, v)
+                model.ack_meta()
+            idx = 1
+            for _ in range(10):
+                model.note_entry(idx, 2, f"v{idx}")
+                log.append(idx, 2, f"v{idx}")
+                idx += 1
+            log.sync()
+            model.ack_wal()
+            # two compactions so snap.prev exists AND its fallback
+            # still meets the surviving base (no applied-state hole)
+            for snap_idx, nbase in ((6, 6), (8, 6)):
+                data = {"log": [f"v{i}"
+                                for i in range(1, snap_idx + 1)]}
+                live = {i: (2, f"v{i}", False)
+                        for i in range(nbase + 1, idx)}
+                model.begin_snap(snap_idx, 2, data)
+                model.note_base(nbase, 2)
+                log.save_snapshot(snap_idx, 2, data, live, base=nbase,
+                                  base_term=2)
+                model.ack_snap()
+                model.ack_wal()
+            for _ in range(4):
+                model.note_entry(idx, 2, f"v{idx}")
+                log.append(idx, 2, f"v{idx}")
+                idx += 1
+            log.sync()
+            model.ack_wal()
+            log.abort()
+            fs.crash()
+            rec = DurableLog(d)
+            refused = False
+            try:
+                st = rec.load()
+            except PersistentStateCorruptError:
+                # rotted term/vote: fail-stop IS the safe outcome —
+                # rewinding a vote could elect two leaders in one term
+                st = None
+                refused = True
+            rec.close()
+            if target == "meta.json":
+                detected = refused
+                if not refused:
+                    violations.append(
+                        "[meta.json] rotted term/vote did NOT fail "
+                        "stop — a rewound vote can double-vote "
+                        f"(flips={fs.flips})")
+            else:
+                violations += [f"[{target}] {v}" for v in
+                               check_wal_recovery(st, model,
+                                                  lenient=frozenset(
+                                                      (relax,)))]
+                r = st["recovery"] if st else {}
+                detected = {
+                    "wal.log": r.get("corrupt_frame", 0)
+                    + r.get("torn_tail", 0) >= 1,
+                    "snap.json": r.get("snap_fallback")
+                    or r.get("snap_lost"),
+                }[target]
+                if not detected:
+                    violations.append(
+                        f"[{target}] bit rot was NOT detected — "
+                        f"corruption replayed silently "
+                        f"(flips={fs.flips})")
+            detail[target] = {"flips": fs.flips, "refused": refused,
+                              "recovered_top": max(st["entries"],
+                                                   default=0)
+                              if st else 0}
+    return _report("bit_rot", seed, violations, detail)
+
+
 SCENARIOS = {
     "partition_heal": scenario_partition_heal,
     "crash_restart": scenario_crash_restart,
@@ -1037,13 +1915,21 @@ SCENARIOS = {
     "clock_skew": scenario_clock_skew,
     "link_chaos": scenario_link_chaos,
     "tcp_flaky": scenario_tcp_flaky,
+    "crash_matrix": scenario_crash_matrix,
+    "disk_torn": scenario_disk_torn,
+    "fsync_lost": scenario_fsync_lost,
+    "bit_rot": scenario_bit_rot,
+    "enospc": scenario_enospc,
 }
 
 # the fixed-seed tier-1 smoke set: every virtual-time scenario (the
 # wall-clock tcp_flaky rides the full soak, its transport is unit-
-# tested in tests/test_chaos.py)
+# tested in tests/test_chaos.py), plus the bounded storage-nemesis
+# smoke — small traces, every boundary of them
 CHECK_SCENARIOS = ("partition_heal", "crash_restart", "loss_burst",
-                   "asym_degradation", "clock_skew", "link_chaos")
+                   "asym_degradation", "clock_skew", "link_chaos",
+                   "crash_matrix", "disk_torn", "fsync_lost",
+                   "bit_rot", "enospc")
 
 
 def run_scenario(name: str, seed: int, tmp: Optional[str] = None,

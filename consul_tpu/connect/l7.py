@@ -278,3 +278,50 @@ def pick_cluster(clusters: List[Tuple[int, str]],
         if point < acc:
             return target
     return clusters[-1][1]
+
+
+def strip_hop_headers(header_lines: List[str],
+                      connection_value: str) -> List[str]:
+    """Drop hop-by-hop headers before forwarding (RFC 7230 §6.1):
+    `Connection` itself, every header its token list NOMINATES for
+    this hop, and `Keep-Alive` whether nominated or not — a forwarded
+    `Connection: keep-alive, x-foo` must not leak X-Foo upstream as if
+    it were end-to-end (ADVICE r5).  End-to-end headers pass through
+    untouched; the relay appends its own Connection header after."""
+    drop = {t.strip().lower()
+            for t in (connection_value or "").split(",") if t.strip()}
+    drop |= {"connection", "keep-alive"}
+    return [ln for ln in header_lines if ln
+            and ln.partition(":")[0].strip().lower() not in drop]
+
+
+def parse_http_head(head: bytes):
+    """Parse an HTTP/1.1 request head into (method, path, qs, headers,
+    query, proto), or None on a malformed request line.  Repeated
+    field lines combine as a comma list (RFC 7230 §3.2.2) — last-wins
+    would let tokens nominated by an EARLIER Connection header dodge
+    strip_hop_headers.  Lives here (not the TLS-heavy proxy module) so
+    the parsing rules unit-test anywhere."""
+    try:
+        text = head.decode("latin-1")
+        request_line, _, rest = text.partition("\r\n")
+        method, full_path, proto = request_line.split(" ", 2)
+        headers: Dict[str, str] = {}
+        for line in rest.split("\r\n"):
+            if not line:
+                continue
+            k, _, v = line.partition(":")
+            k = k.strip().lower()
+            if k in headers:
+                headers[k] = f"{headers[k]}, {v.strip()}"
+            else:
+                headers[k] = v.strip()
+        path, _, qs = full_path.partition("?")
+        query: Dict[str, str] = {}
+        for pair in qs.split("&"):
+            if pair:
+                k, _, v = pair.partition("=")
+                query[k] = v
+        return method, path, qs, headers, query, proto
+    except ValueError:
+        return None

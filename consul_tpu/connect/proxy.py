@@ -452,25 +452,12 @@ class HttpUpstreamListener(_Listener):
 
     @staticmethod
     def _parse_head(head: bytes):
-        try:
-            text = head.decode("latin-1")
-            request_line, _, rest = text.partition("\r\n")
-            method, full_path, proto = request_line.split(" ", 2)
-            headers = {}
-            for line in rest.split("\r\n"):
-                if not line:
-                    continue
-                k, _, v = line.partition(":")
-                headers[k.strip().lower()] = v.strip()
-            path, _, qs = full_path.partition("?")
-            query = {}
-            for pair in qs.split("&"):
-                if pair:
-                    k, _, v = pair.partition("=")
-                    query[k] = v
-            return method, path, qs, headers, query, proto
-        except ValueError:
-            return None
+        # connect/l7.py parse_http_head: repeated field lines combine
+        # per RFC 7230 §3.2.2 so a split Connection header can't dodge
+        # the hop-by-hop strip; parsing lives next to the route table
+        # it feeds (and unit-tests without the TLS stack)
+        from consul_tpu.connect import l7
+        return l7.parse_http_head(head)
 
     _respond = staticmethod(_http_respond)
 
@@ -526,11 +513,13 @@ class HttpUpstreamListener(_Listener):
                 self.target_counts.get(target, 0) + 1
             full = out_path + ("?" + qs if qs else "")
             first, _, rest_head = head.decode("latin-1").partition("\r\n")
-            # this relay is one-request-per-connection: force the
-            # upstream to close after responding, or a keep-alive
-            # upstream holds the relay open until the idle timeout
-            kept = [ln for ln in rest_head.split("\r\n") if ln
-                    and not ln.lower().startswith("connection:")]
+            # hop-by-hop stripping (l7.strip_hop_headers): Connection
+            # itself plus everything its token list nominates, plus
+            # keep-alive.  Then force close: this relay is one-
+            # request-per-connection, and a keep-alive upstream would
+            # hold it open until the idle timeout.
+            kept = l7.strip_hop_headers(rest_head.split("\r\n"),
+                                        headers.get("connection", ""))
             kept.append("connection: close")
             new_head = (f"{method} {full} {proto}\r\n"
                         + "\r\n".join(kept)).encode("latin-1")

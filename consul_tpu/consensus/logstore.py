@@ -1,4 +1,5 @@
-"""Durable raft state: write-ahead log + vote/term + snapshot on disk.
+"""Durable raft state: checksummed write-ahead log + vote/term +
+snapshot on disk.
 
 The raft-boltdb role (reference agent/consul/server.go:728
 `raftboltdb.NewBoltStore(.../raft.db)` plus the FileSnapshotStore two
@@ -12,22 +13,46 @@ Layout under one directory:
   LOCK        flock'd for the process lifetime — two processes on one
               data dir fail fast instead of interleaving WAL frames
               (raft-boltdb locks raft.db the same way)
-  meta.json   {"term": T, "voted_for": ...}       atomic tmp+rename
-  snap.json   {"index": N, "term": T, "data": .}  atomic tmp+rename
+  meta.json   {"term": T, "voted_for": ...}       checked, atomic
+  snap.json   {"index": N, "term": T, "data": .}  checked, atomic
+  *.prev      the previous generation of a checked file — the fallback
+              when a crash or a reordering disk corrupts the current
   wal.log     framed JSON records, append-only:
                 {"t":"e","i":idx,"tm":term,"c":cmd,"n":noop}  entry
                 {"t":"trunc","i":idx}     delete entries >= idx
                 {"t":"base","i":N,"tm":T} log window base moved
 
+WAL frame format v2: `b"W2" | len:u32 | crc32:u32 | payload` — the CRC
+covers the payload, so single-bit rot is detected instead of replaying
+as committed state.  v1 frames (`len:u32 | payload`, written before
+this format existed) are still read: the magic can't collide with a v1
+length prefix because record payloads are far below 2^24 bytes, so the
+first byte of a v1 frame is always 0x00.  Replay stops at the first
+bad frame and truncates there — a TORN tail (short frame) was never
+acked and is dropped silently; a CORRUPT frame (checksum mismatch) is
+quarantined at exactly that frame, never earlier, so every record
+acked before the rot survives, and the loss is surfaced through the
+`consul.raft.recovery.*` counters and the load() recovery report
+rather than silently replayed.
+
+meta.json / snap.json are wrapped as {"v":2,"crc":...,"data":...} and
+rotated through a `.prev` generation on every write: if the current
+file fails its checksum (bit rot, or a rename that outran its data on
+a reordering disk) the previous generation is used and the fallback is
+counted.  Plain pre-v2 JSON files load unchecked (backward compat).
+
 The log window base can trail the snapshot index by snapshot_trailing
 entries (raft keeps a catch-up window behind each snapshot), so `base`
-records and snap.json carry independent horizons.  The WAL is replayed
-on load; entries <= base are dropped (their effect lives in snap.json).
-Compaction appends a cheap base record each time and only REWRITES the
-WAL once it holds ~rewrite_threshold dead records, bounding both disk
-growth and the time spent inside a single compaction.  Torn tails (a
-crash mid-append) are detected by the length prefix and truncated away
-— everything before the tear was already fsynced and survives.
+records and snap.json carry independent horizons.  Compaction appends
+a cheap base record each time and only REWRITES the WAL once it holds
+~rewrite_threshold dead records; a failed rewrite (ENOSPC) keeps the
+old WAL intact and retries at the next compaction.
+
+Every file operation goes through the `consul_tpu.storage` seam so the
+storage nemesis (chaos.FaultyStorage) can inject torn writes, lost and
+failing fsyncs, ENOSPC, and rename reordering deterministically —
+tools/crash_matrix.py proves recovery at every one of these I/O
+boundaries.
 """
 
 from __future__ import annotations
@@ -36,41 +61,65 @@ import fcntl
 import json
 import os
 import struct
-import tempfile
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
+from consul_tpu import storage, telemetry
 
-def _atomic_write(path: str, obj: Any) -> None:
-    d = os.path.dirname(path)
-    fd, tmp = tempfile.mkstemp(dir=d, prefix=".tmp-")
+WAL_MAGIC = b"W2"
+
+
+def _dump_checked(obj: Any) -> bytes:
+    """Serialize with an embedded CRC32 over the canonical payload."""
+    payload = json.dumps(obj, sort_keys=True).encode()
+    return json.dumps({"v": 2, "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+                       "data": obj}, sort_keys=True).encode()
+
+
+def _parse_checked(blob: bytes) -> Tuple[Any, str]:
+    """(data, status) where status is 'ok' (v2, checksum good), 'v1'
+    (pre-checksum plain JSON, accepted unchecked), or 'corrupt'."""
     try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(json.dumps(obj).encode())
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, path)
-        dirfd = os.open(d, os.O_RDONLY)
-        try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
+        rec = json.loads(blob)
+    except ValueError:
+        return None, "corrupt"
+    if isinstance(rec, dict) and rec.get("v") == 2 and "crc" in rec \
+            and "data" in rec:
+        payload = json.dumps(rec["data"], sort_keys=True).encode()
+        if zlib.crc32(payload) & 0xFFFFFFFF == rec["crc"]:
+            return rec["data"], "ok"
+        return None, "corrupt"
+    if isinstance(rec, dict) and "crc" in rec and "data" in rec:
+        # a v1 file never carried these keys: this is a v2 envelope
+        # whose version/crc fields themselves rotted — not legacy data
+        return None, "corrupt"
+    return rec, "v1"
 
 
 class DataDirLockedError(Exception):
     """Another live process holds this raft data directory."""
 
 
+class StorageCorruptionError(Exception):
+    """A just-written durable file failed its read-back verification."""
+
+
+class PersistentStateCorruptError(Exception):
+    """meta.json (term/vote) failed its checksum on BOTH generations,
+    or rotted after being acked.  Unlike snapshots and log entries —
+    which replication repairs — a rewound vote can elect two leaders
+    in one term (Raft's persistent-state rule), so the only safe
+    answers are fail-stop or operator-driven fresh rejoin (wipe the
+    data dir)."""
+
+
 class DurableLog:
     """One raft node's persistent state under `directory`."""
 
-    def __init__(self, directory: str, rewrite_threshold: int = 8192):
+    def __init__(self, directory: str, rewrite_threshold: int = 8192,
+                 io: Optional[storage.StorageOps] = None):
         self.dir = directory
+        self.io = io or storage.OS
         os.makedirs(directory, exist_ok=True)
         # exclusive dir lock FIRST: a second process must fail loudly
         # before it can interleave a single WAL byte
@@ -86,97 +135,229 @@ class DurableLog:
         self._wal_path = os.path.join(directory, "wal.log")
         self._meta_path = os.path.join(directory, "meta.json")
         self._snap_path = os.path.join(directory, "snap.json")
-        self._wal = open(self._wal_path, "ab")
+        self._wal = self.io.open_append(self._wal_path)
         self._dirty = False
         self.rewrite_threshold = rewrite_threshold
         self._records_since_rewrite = 0
+        # filled by load(): what recovery had to repair/fall back on
+        self.recovery: Dict[str, Any] = {}
 
     # ------------------------------------------------------------ recovery
+
+    def _load_checked(self, path: str,
+                      validate=None) -> Tuple[Any, bool, bool]:
+        """(data, corrupt_primary, used_prev): read a checked file,
+        falling back to its previous generation when the current one
+        is missing mid-rotation, fails its checksum, or fails the
+        caller's shape validator (rot inside an unchecked v1 file)."""
+        corrupt = False
+        for p, is_prev in ((path, False), (path + ".prev", True)):
+            try:
+                with self.io.open_read(p) as f:
+                    blob = f.read()
+            except FileNotFoundError:
+                continue
+            data, status = _parse_checked(blob)
+            if status != "corrupt" and (validate is None
+                                        or validate(data)):
+                return data, corrupt, is_prev
+            corrupt = True
+        return None, corrupt, False
 
     def load(self) -> Optional[dict]:
         """Replay persisted state; None when this directory is fresh.
 
         Returns {"term", "voted_for", "base", "base_term",
         "snap_index", "snap_term", "snapshot" (or None),
-        "entries": {idx: (term, cmd, noop)}}."""
-        have_meta = os.path.exists(self._meta_path)
-        meta = {"term": 0, "voted_for": None}
-        if have_meta:
-            with open(self._meta_path, "rb") as f:
-                meta = json.loads(f.read())
-        snap = None
-        if os.path.exists(self._snap_path):
-            with open(self._snap_path, "rb") as f:
-                snap = json.loads(f.read())
+        "entries": {idx: (term, cmd, noop)}, "recovery": {...}}.
+        The "recovery" dict reports what load() had to repair —
+        torn_tail / corrupt_frame counts, meta/snap generation
+        fallbacks — and the same facts land on the
+        consul.raft.recovery.* counters."""
+        rec: Dict[str, Any] = {
+            "torn_tail": 0, "corrupt_frame": 0, "v1_frames": 0,
+            "dropped_bytes": 0, "meta_fallback": False,
+            "meta_lost": False, "snap_fallback": False,
+            "snap_lost": False,
+        }
+        meta, m_corrupt, m_prev = self._load_checked(
+            self._meta_path,
+            validate=lambda d: isinstance(d, dict) and "term" in d)
+        have_meta = meta is not None
+        rec["meta_fallback"] = m_prev and not m_corrupt
+        rec["meta_lost"] = m_corrupt
+        if m_corrupt:
+            # A MISSING current generation is a crash mid-rotation: the
+            # in-flight state was never acked (set_term_vote persists
+            # BEFORE any message leaves), so .prev is the truth and the
+            # fallback above is safe.  A current generation that fails
+            # its CHECKSUM is different: it was fully written and acked
+            # before it rotted, so rewinding to .prev could re-vote in
+            # a term this node already voted in — two leaders, one
+            # term.  Fail stop; the operator wipes the dir and the
+            # node rejoins fresh (raft-boltdb/etcd take the same
+            # stance on corrupt vote state).
+            telemetry.incr_counter(("raft", "recovery", "meta_lost"))
+            raise PersistentStateCorruptError(
+                f"{self._meta_path} failed checksum verification; "
+                f"term/vote cannot be trusted — wipe the data dir to "
+                f"rejoin as a fresh node")
+        if meta is None:
+            meta = {"term": 0, "voted_for": None}
+        snap, s_corrupt, s_prev = self._load_checked(
+            self._snap_path,
+            validate=lambda d: isinstance(d, dict) and "index" in d
+            and "term" in d and "data" in d)
+        rec["snap_fallback"] = s_prev
+        rec["snap_lost"] = s_corrupt and snap is None
         snap_index = snap["index"] if snap else 0
         snap_term = snap["term"] if snap else 0
         base, base_term = 0, 0
         entries: Dict[int, Tuple[int, Any, bool]] = {}
         wal_records = 0
-        for rec in self._replay_wal():
+        for r in self._replay_wal(rec):
             wal_records += 1
-            t = rec["t"]
+            t = r["t"]
             if t == "e":
-                entries[rec["i"]] = (rec["tm"], rec["c"],
-                                     rec.get("n", False))
+                entries[r["i"]] = (r["tm"], r["c"], r.get("n", False))
             elif t == "trunc":
-                for i in [i for i in entries if i >= rec["i"]]:
+                for i in [i for i in entries if i >= r["i"]]:
                     del entries[i]
             elif t == "base":
-                if rec["i"] >= base:
-                    base, base_term = rec["i"], rec["tm"]
+                if r["i"] >= base:
+                    base, base_term = r["i"], r["tm"]
         if snap is not None and base == 0:
             # snapshot without any base record (install path)
             base, base_term = snap_index, snap_term
         for i in [i for i in entries if i <= base]:
             del entries[i]
         self._records_since_rewrite = wal_records
+        self.recovery = rec
+        self._emit_recovery(rec)
         if not have_meta and not entries and snap is None \
-                and wal_records == 0:
+                and wal_records == 0 and not m_corrupt and not s_corrupt:
             return None
         return {"term": meta["term"], "voted_for": meta["voted_for"],
                 "base": base, "base_term": base_term,
                 "snap_index": snap_index, "snap_term": snap_term,
                 "snapshot": snap["data"] if snap else None,
-                "entries": entries}
+                "entries": entries, "recovery": rec}
 
-    def _replay_wal(self):
-        """Yield WAL records, truncating a torn tail in place."""
+    @staticmethod
+    def _emit_recovery(rec: dict) -> None:
+        """Surface recovery outcomes: ops alert on corrupt_frame /
+        *_fallback the way the reference alerts on raft-wal repairs."""
+        clean = True
+        for key in ("torn_tail", "corrupt_frame"):
+            if rec[key]:
+                telemetry.incr_counter(("raft", "recovery", key),
+                                       float(rec[key]))
+                clean = False
+        for key in ("meta_fallback", "meta_lost", "snap_fallback",
+                    "snap_lost"):
+            if rec[key]:
+                telemetry.incr_counter(("raft", "recovery", key))
+                clean = False
+        if clean:
+            telemetry.incr_counter(("raft", "recovery", "clean"))
+
+    def _replay_wal(self, rec: dict):
+        """Yield WAL records, truncating the tail at the first torn or
+        corrupt frame.  Truncation never cuts EARLIER than the bad
+        frame: every record acked before it survives quarantine."""
         try:
-            f = open(self._wal_path, "rb")
+            f = self.io.open_read(self._wal_path)
         except FileNotFoundError:
             return
         good = 0
+        reason = None
         with f:
             while True:
-                head = f.read(4)
-                if len(head) < 4:
+                head = f.read(2)
+                if len(head) < 2:
+                    if head:
+                        reason = "torn_tail"
                     break
-                (ln,) = struct.unpack(">I", head)
-                blob = f.read(ln)
-                if len(blob) < ln:
-                    break                      # torn mid-record
-                try:
-                    rec = json.loads(blob)
-                except ValueError:
-                    break                      # torn inside the json
+                if head == WAL_MAGIC:
+                    hdr = f.read(8)
+                    if len(hdr) < 8:
+                        reason = "torn_tail"
+                        break
+                    ln, crc = struct.unpack(">II", hdr)
+                    blob = f.read(ln)
+                    if len(blob) < ln:
+                        reason = "torn_tail"
+                        break
+                    if zlib.crc32(blob) & 0xFFFFFFFF != crc:
+                        reason = "corrupt_frame"  # rot, not a tear
+                        break
+                    try:
+                        r = json.loads(blob)
+                    except ValueError:
+                        reason = "corrupt_frame"
+                        break
+                    if not isinstance(r, dict) or "t" not in r:
+                        reason = "corrupt_frame"
+                        break
+                elif head[0] != 0:
+                    # neither v2 magic nor a plausible v1 frame: v1
+                    # length prefixes always start 0x00 (payloads are
+                    # far below 2^24), so this is a v2 header whose
+                    # MAGIC rotted — acked-data corruption, not a tear
+                    reason = "corrupt_frame"
+                    break
+                else:
+                    # v1 frame: bare u32 length + JSON payload (no
+                    # checksum — the format this PR retired)
+                    rest = f.read(2)
+                    if len(rest) < 2:
+                        reason = "torn_tail"
+                        break
+                    (ln,) = struct.unpack(">I", head + rest)
+                    blob = f.read(ln)
+                    if len(blob) < ln:
+                        reason = "torn_tail"
+                        break
+                    try:
+                        r = json.loads(blob)
+                    except ValueError:
+                        # a v1 tear and v1 rot are indistinguishable
+                        reason = "torn_tail"
+                        break
+                    if not isinstance(r, dict) or "t" not in r:
+                        reason = "torn_tail"
+                        break
+                    rec["v1_frames"] += 1
                 good = f.tell()
-                yield rec
-        size = os.path.getsize(self._wal_path)
+                yield r
+        size = self.io.getsize(self._wal_path)
         if good != size:
-            # crash mid-append: drop the tear (it was never acked)
+            rec[reason or "torn_tail"] += 1
+            rec["dropped_bytes"] += size - good
+            # quarantine in place: everything before the bad frame was
+            # fsynced in file order and survives
             self._wal.close()
-            with open(self._wal_path, "r+b") as f:
-                f.truncate(good)
-                f.flush()
-                os.fsync(f.fileno())
-            self._wal = open(self._wal_path, "ab")
+            f = self.io.open_rw(self._wal_path)
+            with f:
+                self.io.truncate(f, good)
+                self.io.fsync(f)
+            self._wal = self.io.open_append(self._wal_path)
 
     # ------------------------------------------------------------- writes
 
-    def _frame(self, rec: dict) -> None:
+    @staticmethod
+    def _encode_frame(rec: dict) -> bytes:
+        """The ONE place the v2 frame encoding lives — _frame and the
+        compaction rewrite must never diverge, or a rewrite would
+        produce a WAL replay truncates at frame one."""
         blob = json.dumps(rec).encode()
-        self._wal.write(struct.pack(">I", len(blob)) + blob)
+        return WAL_MAGIC + struct.pack(
+            ">II", len(blob), zlib.crc32(blob) & 0xFFFFFFFF) + blob
+
+    def _frame(self, rec: dict) -> None:
+        # one write() per frame: the torn-write model (and the page
+        # cache) tears BETWEEN writes far more often than inside one
+        self.io.write(self._wal, self._encode_frame(rec))
         self._dirty = True
         self._records_since_rewrite += 1
 
@@ -195,64 +376,112 @@ class DurableLog:
         match-index count)."""
         if not self._dirty:
             return
-        self._wal.flush()
-        os.fsync(self._wal.fileno())
+        self.io.fsync(self._wal)
         self._dirty = False
+
+    def _atomic_checked(self, path: str, obj: Any) -> None:
+        """Checked tmp-write + generation rotation + rename + dir
+        fsync.  Between the two renames the current file is briefly
+        absent; load() falls back to `.prev` through that window AND
+        through the corruption a reordering disk can leave behind."""
+        blob = _dump_checked(obj)
+        f, tmp = self.io.create_tmp(self.dir, ".tmp-")
+        try:
+            with f:
+                self.io.write(f, blob)
+                self.io.fsync(f)
+            if self.io.exists(path) and self._verify_current(path):
+                # rotate ONLY a generation that still passes its
+                # checksum: rotating a rotted current file would
+                # clobber the one good .prev with garbage right before
+                # a crash window could need it (recovery-heal rewrite)
+                self.io.replace(path, path + ".prev")
+            self.io.replace(tmp, path)
+            self.io.fsync_dir(self.dir)
+        except BaseException:
+            try:
+                self.io.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def _verify_current(self, path: str) -> bool:
+        try:
+            with self.io.open_read(path) as f:
+                return _parse_checked(f.read())[1] != "corrupt"
+        except OSError:
+            return False
 
     def set_term_vote(self, term: int, voted_for: Optional[str]) -> None:
         """Durable BEFORE any message carrying the new term/vote leaves
         this node (Raft's persistent-state rule)."""
-        _atomic_write(self._meta_path, {"term": term,
-                                        "voted_for": voted_for})
+        self._atomic_checked(self._meta_path, {"term": term,
+                                               "voted_for": voted_for})
 
     def save_snapshot(self, snap_index: int, snap_term: int, data: Any,
                       live_entries: Dict[int, Tuple[int, Any, bool]],
                       base: Optional[int] = None,
-                      base_term: Optional[int] = None) -> None:
+                      base_term: Optional[int] = None) -> dict:
         """Persist a snapshot and move the log window base (defaults to
         the snapshot index — the InstallSnapshot shape; compaction
         passes a trailing base so the catch-up window survives
-        restarts).
+        restarts).  Returns {"rewrote": bool} for harnesses that track
+        the WAL's physical identity.
 
         Cheap path: snap.json + one appended base record (two fsyncs).
         The WAL is only REWRITTEN to the live window once it carries
-        ~rewrite_threshold records, so a single compaction never stalls
-        the tick thread on an unbounded rewrite."""
+        ~rewrite_threshold records; a rewrite that fails mid-way
+        (ENOSPC) is abandoned — the old WAL is still complete, so the
+        node keeps appending and retries at the next compaction."""
         if base is None:
             base, base_term = snap_index, snap_term
-        _atomic_write(self._snap_path,
-                      {"index": snap_index, "term": snap_term,
-                       "data": data})
+        self._atomic_checked(self._snap_path,
+                             {"index": snap_index, "term": snap_term,
+                              "data": data})
+        # verify-before-ack: the snapshot is about to anchor recovery,
+        # so prove the bytes on disk parse + checksum before the base
+        # record makes the log window depend on them
+        got, corrupt, used_prev = self._load_checked(self._snap_path)
+        if got is None or used_prev or got.get("index") != snap_index:
+            raise StorageCorruptionError(
+                f"snapshot {snap_index} failed read-back verification")
         self._frame({"t": "base", "i": base, "tm": base_term})
         self.sync()
         if self._records_since_rewrite < self.rewrite_threshold:
-            return
-        fd, tmp = tempfile.mkstemp(dir=self.dir, prefix=".wal-")
-        n = 1
-        with os.fdopen(fd, "wb") as f:
-            rec = json.dumps({"t": "base", "i": base,
-                              "tm": base_term}).encode()
-            f.write(struct.pack(">I", len(rec)) + rec)
-            for i in sorted(live_entries):
-                if i <= base:
-                    continue
-                tm, cmd, noop = live_entries[i]
-                blob = json.dumps({"t": "e", "i": i, "tm": tm,
-                                   "c": cmd, "n": noop}).encode()
-                f.write(struct.pack(">I", len(blob)) + blob)
-                n += 1
-            f.flush()
-            os.fsync(f.fileno())
-        self._wal.close()
-        os.replace(tmp, self._wal_path)
-        dirfd = os.open(self.dir, os.O_RDONLY)
+            return {"rewrote": False}
         try:
-            os.fsync(dirfd)
-        finally:
-            os.close(dirfd)
-        self._wal = open(self._wal_path, "ab")
+            f, tmp = self.io.create_tmp(self.dir, ".wal-")
+        except OSError:
+            return {"rewrote": False}
+        n = 1
+        try:
+            with f:
+                self.io.write(f, self._encode_frame(
+                    {"t": "base", "i": base, "tm": base_term}))
+                for i in sorted(live_entries):
+                    if i <= base:
+                        continue
+                    tm, cmd, noop = live_entries[i]
+                    self.io.write(f, self._encode_frame(
+                        {"t": "e", "i": i, "tm": tm, "c": cmd,
+                         "n": noop}))
+                    n += 1
+                self.io.fsync(f)
+        except OSError:
+            # disk full mid-rewrite: the old WAL is untouched — drop
+            # the partial tmp and carry on, retry next compaction
+            try:
+                self.io.unlink(tmp)
+            except OSError:
+                pass
+            return {"rewrote": False}
+        self._wal.close()
+        self.io.replace(tmp, self._wal_path)
+        self.io.fsync_dir(self.dir)
+        self._wal = self.io.open_append(self._wal_path)
         self._dirty = False
         self._records_since_rewrite = n
+        return {"rewrote": True}
 
     def close(self) -> None:
         self.sync()
@@ -261,3 +490,16 @@ class DurableLog:
             fcntl.flock(self._lockfd, fcntl.LOCK_UN)
         finally:
             os.close(self._lockfd)
+
+    def abort(self) -> None:
+        """kill -9 for tests: drop the fds WITHOUT syncing — pending
+        WAL bytes stay wherever the page cache left them, and the
+        flock releases so a restarted instance can take the dir."""
+        try:
+            self._wal.close()
+        except OSError:
+            pass
+        try:
+            os.close(self._lockfd)
+        except OSError:
+            pass

@@ -317,6 +317,24 @@ class RaftNode:
             self.snap_index = state["snap_index"]
             self.snap_term = state["snap_term"]
             self._unwrap_restore(state["snapshot"])
+        if self.log_base > self.snap_index:
+            # the WAL window assumes a NEWER snapshot than the one
+            # that survived recovery (snap.json fell back a generation
+            # or was lost to rot): entries in (snap_index, log_base]
+            # are gone, so serving the window would fake applied state
+            # with a silent hole — the storage nemesis catches this as
+            # a fork.  Drop the window back to the snapshot horizon
+            # and heal the disk; the leader's next append fails its
+            # consistency check and replication (or InstallSnapshot)
+            # repairs the tail.
+            self._metrics_buf.append(
+                ("c", ("raft", "recovery", "wal_window_dropped"), 1.0))
+            self.log_base = self.snap_index
+            self.log_base_term = self.snap_term
+            state["entries"] = {}
+            self.store.truncate_from(self.snap_index + 1)
+            self.store.save_snapshot(self.snap_index, self.snap_term,
+                                     self.snapshot_data, {})
         # contiguous run from base+1; a gap means the WAL lost frames
         # (shouldn't happen, but a hole must not fake consistency)
         idx = self.log_base

@@ -43,7 +43,8 @@ def _load() -> Optional[ctypes.CDLL]:
                     ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
                      "-o", _SO + ".tmp", _SRC],
                     check=True, capture_output=True, timeout=120)
-                os.replace(_SO + ".tmp", _SO)
+                from consul_tpu import storage
+                storage.OS.replace(_SO + ".tmp", _SO)
             lib = ctypes.CDLL(_SO)
         except (OSError, subprocess.SubprocessError):
             _build_failed = True

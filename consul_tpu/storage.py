@@ -1,0 +1,116 @@
+"""The storage seam: every durability-relevant file operation funnels
+through one object so the storage nemesis can sit between the code and
+the disk.
+
+Consul's durability story leans on a small set of primitives — append +
+fsync on the WAL (raft-boltdb's bolt file), tmp-write + rename + dir
+fsync for atomic metadata (FileSnapshotStore), and nothing else.  Those
+primitives are exactly where disks betray you: torn appends, fsyncs
+that fail or silently lie, renames that hit the journal before the data
+they name, ENOSPC mid-record.  `StorageOps` is the honest
+implementation; `consul_tpu.chaos.FaultyStorage` implements the same
+interface over a simulated page-cache/durable split and injects those
+betrayals deterministically.
+
+The seam is enforced: `tools/storage_audit.py` fails the build if any
+`consul_tpu/` code calls `os.fsync`/`os.replace` outside this module —
+an I/O call the nemesis can't intercept is an I/O call the crash-point
+harness can't prove safe.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import BinaryIO, Tuple
+
+
+class StorageOps:
+    """Real-disk implementation of the seam.  One shared instance
+    (`OS`) serves every caller; the methods are stateless."""
+
+    # ------------------------------------------------------------ handles
+
+    def open_append(self, path: str) -> BinaryIO:
+        return open(path, "ab")
+
+    def open_read(self, path: str) -> BinaryIO:
+        return open(path, "rb")
+
+    def open_rw(self, path: str) -> BinaryIO:
+        return open(path, "r+b")
+
+    def create_tmp(self, directory: str,
+                   prefix: str) -> Tuple[BinaryIO, str]:
+        """A unique scratch file in `directory` (same filesystem, so a
+        later replace() is an atomic rename)."""
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=prefix)
+        return os.fdopen(fd, "wb"), tmp
+
+    # ------------------------------------------------------- durable ops
+
+    def write(self, f: BinaryIO, data: bytes) -> None:
+        f.write(data)
+
+    def fsync(self, f: BinaryIO) -> None:
+        """Flush + fsync: the only call that makes bytes durable."""
+        f.flush()
+        os.fsync(f.fileno())
+
+    def truncate(self, f: BinaryIO, size: int) -> None:
+        f.truncate(size)
+
+    def replace(self, src: str, dst: str) -> None:
+        """Atomic rename.  NOT durable until fsync_dir() on the parent
+        — a crash in between may undo it (or, on reordering disks,
+        keep the name but lose the renamed file's data; the WAL layer
+        defends with checksums + a previous-generation fallback)."""
+        os.replace(src, dst)
+
+    def fsync_dir(self, directory: str) -> None:
+        """Make preceding renames in `directory` durable."""
+        fd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+    # -------------------------------------------------------- inspection
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def getsize(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def unlink(self, path: str) -> None:
+        os.unlink(path)
+
+
+OS = StorageOps()
+
+
+def atomic_replace(path: str, data: bytes, sync: bool = False,
+                   ops: StorageOps = None) -> None:
+    """tmp-write + rename for the config/state persisters (agent local
+    state, ACL tokens, auto-config bootstrap, built native objects):
+    readers see the old file or the new file, never a torn middle.
+    `sync=True` adds the fsync + dir-fsync pair for files that must
+    survive power loss, not just process death."""
+    io = ops or OS
+    d = os.path.dirname(path) or "."
+    f, tmp = io.create_tmp(d, ".tmp-")
+    try:
+        with f:
+            io.write(f, data)
+            if sync:
+                io.fsync(f)
+        io.replace(tmp, path)
+        if sync:
+            io.fsync_dir(d)
+    except BaseException:
+        try:
+            io.unlink(tmp)
+        except OSError:
+            pass
+        raise

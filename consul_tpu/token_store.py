@@ -89,10 +89,8 @@ class TokenStore:
             return
         os.makedirs(self.data_dir, exist_ok=True)
         data = {name: self._tokens[name] for name in self._from_api}
-        tmp = self._path() + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(data, f)
-        os.replace(tmp, self._path())
+        from consul_tpu import storage
+        storage.atomic_replace(self._path(), json.dumps(data).encode())
 
     def _load(self) -> None:
         try:

@@ -1,0 +1,58 @@
+"""Hop-by-hop header stripping (RFC 7230 §6.1) — the pure routine the
+built-in HTTP relay applies before forwarding (ADVICE r5).  Lives in
+connect/l7.py so it unit-tests without the TLS stack; the end-to-end
+relay assertion rides tests/test_l7_routing.py."""
+
+from consul_tpu.connect.l7 import strip_hop_headers
+
+
+def test_connection_nominated_headers_are_stripped():
+    lines = ["Host: api",
+             "Connection: keep-alive, x-foo",
+             "X-Foo: hop-secret",
+             "Keep-Alive: timeout=5",
+             "X-End-To-End: stays"]
+    kept = strip_hop_headers(lines, "keep-alive, x-foo")
+    names = {ln.partition(":")[0].strip().lower() for ln in kept}
+    assert names == {"host", "x-end-to-end"}
+
+
+def test_keep_alive_stripped_even_when_not_nominated():
+    kept = strip_hop_headers(["Keep-Alive: timeout=5", "Host: a"], "")
+    assert kept == ["Host: a"]
+
+
+def test_nomination_is_case_and_whitespace_insensitive():
+    kept = strip_hop_headers(
+        ["X-Trace-Id: t1", "Host: a"], "  X-TRACE-ID ,close ")
+    assert kept == ["Host: a"]
+
+
+def test_plain_headers_survive_and_empty_lines_drop():
+    kept = strip_hop_headers(
+        ["Host: a", "", "Accept: */*"], "close")
+    assert kept == ["Host: a", "Accept: */*"]
+
+
+def test_repeated_connection_headers_combine_not_overwrite():
+    """RFC 7230 §3.2.2: repeated field lines combine as a comma list —
+    a second `Connection: close` line must not let the first line's
+    nominated token dodge the strip."""
+    from consul_tpu.connect.l7 import parse_http_head
+    head = (b"GET /x?a=1 HTTP/1.1\r\nHost: api\r\n"
+            b"Connection: x-secret-hop\r\n"
+            b"X-Secret-Hop: leak\r\n"
+            b"Connection: close\r\n")
+    method, path, qs, headers, query, proto = parse_http_head(head)
+    assert (method, path, qs) == ("GET", "/x", "a=1")
+    assert headers["connection"] == "x-secret-hop, close"
+    kept = strip_hop_headers(
+        ["Host: api", "Connection: x-secret-hop",
+         "X-Secret-Hop: leak", "Connection: close"],
+        headers["connection"])
+    assert kept == ["Host: api"]
+
+
+def test_parse_http_head_rejects_malformed_request_line():
+    from consul_tpu.connect.l7 import parse_http_head
+    assert parse_http_head(b"GARBAGE\r\n") is None

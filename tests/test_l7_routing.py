@@ -278,6 +278,49 @@ def test_relay_forces_connection_close_toward_upstream(mesh):
         assert conns == ["connection: close"], conns
 
 
+def test_relay_strips_connection_nominated_hop_headers(mesh):
+    """RFC 7230 §6.1: headers NOMINATED by the Connection token list
+    are hop-by-hop for this hop — `Connection: keep-alive, x-foo`
+    must strip X-Foo and Keep-Alive toward the upstream, not just the
+    Connection header itself (ADVICE r5).  End-to-end headers ride
+    through untouched."""
+    a, web_proxy, stable, canary = mesh
+    port = web_proxy.upstreams[0].port
+    for echo in (stable, canary):
+        echo.last_head = b""
+    for _ in range(20):   # enough rolls to land on each split leg
+        s = socket.create_connection(("127.0.0.1", port), timeout=10)
+        try:
+            s.sendall(b"GET / HTTP/1.1\r\nHost: api\r\n"
+                      b"Connection: keep-alive, x-foo\r\n"
+                      b"X-Foo: hop-secret\r\n"
+                      b"Keep-Alive: timeout=5\r\n"
+                      b"X-End-To-End: stays\r\n\r\n")
+            buf = b""
+            while b"}" not in buf:
+                chunk = s.recv(65536)
+                if not chunk:
+                    break
+                buf += chunk
+            assert b"200" in buf.split(b"\r\n", 1)[0], buf[:80]
+        finally:
+            s.close()
+    seen = 0
+    for echo in (stable, canary):
+        if not echo.last_head:
+            continue
+        seen += 1
+        hdrs = [ln.lower() for ln in
+                echo.last_head.decode("latin-1").split("\r\n")[1:]]
+        names = {h.partition(":")[0].strip() for h in hdrs}
+        assert "x-foo" not in names, hdrs
+        assert "keep-alive" not in names, hdrs
+        assert "x-end-to-end" in names, hdrs
+        conns = [h for h in hdrs if h.startswith("connection:")]
+        assert conns == ["connection: close"], conns
+    assert seen
+
+
 def test_http_failover_when_primary_leg_empties(mesh):
     """A resolver failover leg carries traffic when the primary
     target's endpoints vanish — the Python data plane honoring the

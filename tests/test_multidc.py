@@ -131,6 +131,52 @@ def test_acl_token_replication_primary_to_secondary():
     assert "write" in secondary.acl_policy_get("p1")["rules"]
 
 
+def test_acl_replication_status_http(federation):
+    """GET /v1/acl/replication (acl_endpoint.go ACLReplicationStatus):
+    a secondary wired to a replicator reports Enabled/Running/round
+    outcomes; an agent with no replicator reports Enabled=false."""
+    import json
+    import urllib.request
+    agents, _routers = federation
+    primary, secondary = agents["dc1"], agents["dc2"]
+    primary.store.acl_policy_set(
+        "rp1", "rep-status", 'key_prefix "" { policy = "read" }')
+    rep = AclReplicator(primary.store, secondary.store, interval=999,
+                        source_dc="dc1")
+    secondary.api.acl_replicator = rep
+    try:
+        rep.run_round()
+        out = json.loads(urllib.request.urlopen(
+            secondary.http_address + "/v1/acl/replication",
+            timeout=5).read())
+        assert out["Enabled"] is True
+        assert out["Running"] is False       # round-driven, no loop
+        assert out["SourceDatacenter"] == "dc1"
+        assert out["ReplicationType"] == "tokens"
+        assert out["ReplicatedIndex"] >= 1
+        assert out["LastSuccess"] is not None
+        assert out["LastError"] is None
+
+        # a failing round records the error without clobbering success
+        rep.primary = None
+        with pytest.raises(Exception):
+            rep.run_round()
+        out = json.loads(urllib.request.urlopen(
+            secondary.http_address + "/v1/acl/replication",
+            timeout=5).read())
+        assert out["LastError"] is not None
+        assert out["LastErrorMessage"]
+        assert out["LastSuccess"] is not None
+
+        # replication not enabled on the primary: the disabled shape
+        out = json.loads(urllib.request.urlopen(
+            primary.http_address + "/v1/acl/replication",
+            timeout=5).read())
+        assert out["Enabled"] is False and out["Running"] is False
+    finally:
+        secondary.api.acl_replicator = None
+
+
 def test_federation_state_replication_and_http():
     """Federation states: per-DC mesh gateway lists replicate primary →
     secondary (federation_state_replication.go) and serve over HTTP."""

@@ -79,3 +79,56 @@ def test_services_and_nodes_watch(agent):
 def test_unknown_type_rejected():
     with pytest.raises(ValueError):
         WatchPlan(None, "nope")
+
+
+def test_required_params_enforced_for_new_types():
+    # Parse-time validation (watch.go:21): connect_leaf needs
+    # -service, agent_service needs -service_id
+    with pytest.raises(ValueError):
+        WatchPlan(None, "connect_leaf")
+    with pytest.raises(ValueError):
+        WatchPlan(None, "agent_service")
+
+
+def test_agent_service_watch(agent):
+    """funcs.go agentServiceWatch: fires on the initial snapshot and
+    again when the local service definition changes."""
+    c = Client(agent.http_address)
+    c.agent_service_register("wsvc", service_id="wsvc-1", port=8080)
+    agent.syncer.sync_full_now()
+    plan = WatchPlan(c, "agent_service", wait="5s",
+                     service_id="wsvc-1")
+
+    def reregister():
+        c.agent_service_register("wsvc", service_id="wsvc-1",
+                                 port=9090)
+        agent.syncer.sync_full_now()
+
+    got = _collect(plan, 2, trigger=reregister)
+    assert len(got) == 2
+    assert got[0][1]["Port"] == 8080
+    assert got[1][1]["Port"] == 9090
+
+
+def test_connect_roots_watch(agent):
+    """funcs.go connectRootsWatch: a CA rotation flips ActiveRootID."""
+    pytest.importorskip("cryptography")
+    c = Client(agent.http_address)
+    plan = WatchPlan(c, "connect_roots", wait="5s")
+    got = _collect(plan, 2, trigger=c.connect_ca_rotate)
+    assert len(got) == 2
+    assert got[0][1]["ActiveRootID"] != got[1][1]["ActiveRootID"]
+    assert got[1][1]["Roots"]
+
+
+def test_connect_leaf_watch(agent):
+    """funcs.go connectLeafWatch: rotation re-issues the leaf under
+    the new root, so the watched cert changes."""
+    pytest.importorskip("cryptography")
+    c = Client(agent.http_address)
+    c.agent_service_register("leafw", service_id="leafw-1", port=81)
+    plan = WatchPlan(c, "connect_leaf", wait="5s", service="leafw")
+    got = _collect(plan, 2, trigger=c.connect_ca_rotate, delay=0.6)
+    assert len(got) == 2
+    assert got[0][1]["Service"] == "leafw"
+    assert got[0][1]["CertPEM"] != got[1][1]["CertPEM"]

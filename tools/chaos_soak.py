@@ -4,13 +4,16 @@ violation (ISSUE 3 tentpole).
 
     python tools/chaos_soak.py                    # full soak, all
                                                   # scenarios, emits
-                                                  # CHAOS_r01.json
+                                                  # CHAOS_r02.json
     python tools/chaos_soak.py --seed 42          # same suite, seed 42
     python tools/chaos_soak.py --scenario partition_heal --seed 13
     python tools/chaos_soak.py --check            # tier-1 smoke: fixed
                                                   # seeds, small N,
                                                   # virtual-time
-                                                  # scenarios + a
+                                                  # scenarios (network
+                                                  # + the bounded
+                                                  # storage-nemesis
+                                                  # set) + a
                                                   # determinism
                                                   # double-run
 
@@ -38,7 +41,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "CHAOS_r01.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r02.json")
 CHECK_SEED = 7
 
 
@@ -104,6 +107,14 @@ def run_soak(names, seed: int, out_path: str) -> int:
             "linearizable KV register (client histories)",
             "no committed death of a reachable live node",
             "re-convergence within tick budget after heal",
+            "WAL recovery at every I/O boundary (crash matrix): "
+            "acked entries present, in order, once",
+            "term/vote never behind an acked write after recovery",
+            "no resurrection of acked truncations",
+            "single-bit rot detected by checksum, quarantined or "
+            "generation-fallback, never replayed into the FSM",
+            "ENOSPC fails loudly: no ack without durability, old WAL "
+            "survives an aborted rewrite",
         ],
     }
     with open(out_path, "w") as f:

@@ -1,0 +1,68 @@
+"""Storage-seam audit: fail if consul_tpu/ code performs durability
+I/O behind the nemesis's back (ISSUE 4 satellite; metrics_audit.py
+style).
+
+`os.fsync` and `os.replace` are the two calls that decide what
+survives a crash.  Every one of them must route through the
+`consul_tpu/storage.py` seam — an I/O call outside the seam is one
+chaos.FaultyStorage cannot intercept, which means a durability
+boundary tools/crash_matrix.py cannot enumerate and nobody has proven
+recoverable.
+
+Usage: python tools/storage_audit.py
+Exit 0 = clean; 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "consul_tpu")
+
+# the seam itself is the single allowed caller
+ALLOWED = {os.path.join("consul_tpu", "storage.py")}
+
+CALL_RE = re.compile(r"\bos\s*\.\s*(fsync|replace)\s*\(")
+
+
+def audit() -> List[str]:
+    out = []
+    for root, _dirs, files in os.walk(PKG):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, REPO)
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    stripped = line.split("#", 1)[0]
+                    m = CALL_RE.search(stripped)
+                    if m:
+                        out.append(
+                            f"{rel}:{lineno}: os.{m.group(1)} outside "
+                            f"the storage seam (route it through "
+                            f"consul_tpu/storage.py)")
+    return out
+
+
+def main() -> int:
+    violations = audit()
+    if violations:
+        for v in violations:
+            print(f"VIOLATION: {v}", file=sys.stderr)
+        print(f"storage_audit: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("storage_audit: OK — all fsync/replace calls route through "
+          "the storage seam")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
