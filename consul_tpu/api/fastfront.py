@@ -250,8 +250,15 @@ class FastKVServer:
                     self._fallback(conn, addr, request_bytes)
                 if want_close:
                     return
+        except OSError:
+            pass   # routine ungraceful disconnect (RST, LB probe)
         except Exception:
-            pass
+            # a dying connection loop must not kill the acceptor —
+            # but a non-socket failure here is a real server bug and
+            # must be countable (consul.http.fastfront_error)
+            from consul_tpu import telemetry
+            telemetry.incr_counter(("http", "fastfront_error"),
+                                   labels={"kind": "conn"})
         finally:
             try:
                 conn.close()
@@ -353,6 +360,8 @@ class FastKVServer:
         except Exception as e:
             # store/raft faults (leader loss mid-write, ...) must reach
             # the client as the legacy 500, not a connection reset
+            telemetry.incr_counter(("http", "fastfront_error"),
+                                   labels={"kind": "request"})
             try:
                 msg = f"{type(e).__name__}: {e}".encode()
                 self._write(conn, 500, msg,

@@ -903,13 +903,16 @@ def _make_handler(srv: ApiServer):
             except BexprError as e:
                 try:
                     self._err(400, f"invalid filter: {e}")
-                except Exception:
-                    pass
+                except OSError:
+                    pass   # client went away mid-error-response
             except Exception as e:  # pragma: no cover
+                # consul.http.request_error: 500s an operator can
+                # alarm on (the handler itself must never die)
+                telemetry.incr_counter(("http", "request_error"))
                 try:
                     self._err(500, f"{type(e).__name__}: {e}")
-                except Exception:
-                    pass
+                except OSError:
+                    pass   # client went away mid-error-response
             finally:
                 trace.record("http.request", tid, wall0,
                              _time.perf_counter() - t0,
@@ -1127,7 +1130,10 @@ def _make_handler(srv: ApiServer):
                     try:
                         oracle.publish_sim_metrics()
                     except Exception:
-                        pass      # metrics must serve even mid-compile
+                        # metrics must serve even mid-compile — but a
+                        # failing sim publication is itself a signal
+                        telemetry.incr_counter(
+                            ("http", "sim_metrics_error"))
                 if q.get("format") == "prometheus":
                     # the reference serves text exposition when
                     # prometheus retention is on (agent_endpoint.go

@@ -15,6 +15,16 @@ import json
 import time
 from typing import List, Optional
 
+from http.client import HTTPException
+
+from consul_tpu.api.client import ApiError
+
+# what a best-effort cleanup call can see from the HTTP client: an
+# HTTP-level error (ApiError), a socket/connection failure (OSError,
+# incl. urllib.error.URLError), or a torn response (HTTPException,
+# e.g. IncompleteRead when the agent dies mid-body)
+_TRANSPORT_ERRORS = (ApiError, OSError, HTTPException)
+
 # reference defaults (api/lock.go:32-43, semaphore.go:30-41)
 DEFAULT_SESSION_TTL = "15s"
 LOCK_FLAG = 0x2DDCCD18
@@ -66,7 +76,10 @@ class _SessionHeartbeat:
                     failures = 0
                     wait = period
                 except Exception as e:
-                    from consul_tpu.api.client import ApiError
+                    from consul_tpu import telemetry
+                    # consul.session.renew_failed: every missed renew
+                    # is a step toward a lost lock — count them
+                    telemetry.incr_counter(("session", "renew_failed"))
                     if isinstance(e, ApiError) and e.code == 404:
                         self.lost.set()    # session reaped: definitive
                         return
@@ -183,8 +196,8 @@ class Lock:
             self.client.kv_put(self.key, b"", release=sid)
         try:
             self.client.session_destroy(sid)
-        except Exception:
-            pass   # already reaped
+        except _TRANSPORT_ERRORS:
+            pass   # already reaped (or agent gone) — expected here
 
     def destroy(self) -> None:
         """Delete the lock key if free (api/lock.go Destroy)."""
@@ -303,9 +316,12 @@ class Semaphore:
             hb.stop()
             try:
                 self.client.kv_delete(self._contender_key(sid))
-            except Exception:
-                pass
-            self.client.session_destroy(sid)
+            except _TRANSPORT_ERRORS:
+                pass   # best-effort: the outer raise carries the cause
+            try:
+                self.client.session_destroy(sid)
+            except _TRANSPORT_ERRORS:
+                pass   # best-effort: the outer raise carries the cause
             raise
 
     def release(self) -> None:
@@ -329,8 +345,8 @@ class Semaphore:
         self.client.kv_delete(self._contender_key(sid))
         try:
             self.client.session_destroy(sid)
-        except Exception:
-            pass   # already reaped
+        except _TRANSPORT_ERRORS:
+            pass   # already reaped (or agent gone) — expected here
 
     def __enter__(self) -> "Semaphore":
         if not self.acquire():

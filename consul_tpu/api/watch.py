@@ -56,7 +56,10 @@ class WatchPlan:
             except Exception:
                 # transient failure (agent restart, momentary 500): the
                 # reference's watch loop retries with backoff instead of
-                # dying (watch.go run loop)
+                # dying (watch.go run loop) — counted so a flapping
+                # agent shows up in consul.watch.retry
+                from consul_tpu import telemetry
+                telemetry.incr_counter(("watch", "retry"))
                 if self._stop.wait(backoff):
                     break
                 backoff = min(backoff * 2, 30.0)

@@ -497,5 +497,7 @@ class FaultyTcpTransport(TcpTransport):
             self.sever(target)
             return
         if isinstance(act, tuple) and act[0] == "delay":
+            # head-of-line delay injection IS the fault being modeled
+            # lint: ok=blocking-call (nemesis delay fault on purpose)
             time.sleep(act[1])
         super().send(target, msg)

@@ -58,6 +58,9 @@ def main():
             SimConfig(n_nodes=args.nodes, rumor_slots=slots,
                       p_loss=0.01, seed=args.seed))
 
+        # one jit per swept slot config: each `params` closure compiles
+        # exactly once by design (the sweep IS the config axis)
+        # lint: ok=recompile-hazard (fresh jit per swept config, once each)
         @partial(jax.jit, donate_argnums=donation(0))
         def warm(s):
             return swim.run(params, s, 25)[0]
@@ -71,6 +74,7 @@ def main():
 
         # donate only the state carry (arg 0); the victim mask is reused
         # across every chunk of the drain loop
+        # lint: ok=recompile-hazard (fresh jit per swept config, once each)
         run_chunk = jax.jit(run_chunk, static_argnums=(1,),
                             donate_argnums=donation(0))
 

@@ -1,0 +1,22 @@
+"""Invariant linter entry point — the `go vet` of this repo.
+
+    python tools/lint.py --check        # the tier-1 build gate
+    python tools/lint.py --json         # findings for trend tracking
+    python tools/lint.py --list         # available checkers
+
+The implementation lives in the `tools/lint/` package (framework in
+`lint.core`, checkers in `lint.checkers`); this shim only puts the
+tools directory on sys.path, where the package directory shadows this
+module for imports.  See README "Static analysis" for suppression and
+baseline workflows.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

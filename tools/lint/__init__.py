@@ -1,0 +1,25 @@
+"""Invariant linter: AST-based static analysis for the repo's
+cross-layer contracts.
+
+Upstream Consul gates every build on `go vet` and the race detector;
+this package is the Python/JAX equivalent for this repo's own
+invariants — the PR-2 dtype/donation discipline, the PR-3
+never-block-the-tick-thread and jit-purity rules, and the PR-4
+all-durability-through-`storage.py` seam — encoded as plugin checkers
+over one shared parsed-module cache.
+
+Entry points:
+
+    python tools/lint.py --check          # the build gate (tier-1)
+    python tools/lint.py --json           # findings as JSON
+    python tools/lint.py --list           # available checkers
+
+See `lint.core` for the framework (Finding / Checker / ModuleCache /
+suppression / baseline) and `lint.checkers` for the checker registry.
+"""
+
+from lint.core import (Checker, Finding, Module, ModuleCache,  # noqa: F401
+                       load_baseline, run_checkers, split_baselined)
+
+__all__ = ["Checker", "Finding", "Module", "ModuleCache",
+           "load_baseline", "run_checkers", "split_baselined"]

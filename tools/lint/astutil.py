@@ -1,0 +1,140 @@
+"""Small AST helpers and shared constants for the checkers.
+
+The jit-boundary vocabulary (JIT_WRAPPERS, is_jit_wrapper_call) and
+the hot-loop module set (HOT_PREFIXES) live here exactly once: a new
+wrapper name (a repo-local jit helper, say) or a new hot module is
+added in one place and every checker agrees on the boundary.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+# callables that wrap a function into a compiled entry point
+JIT_WRAPPERS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+
+# modules on the device hot loop (the jitted SWIM tick and its ops):
+# nothing here may block, and dtype discipline is enforced
+HOT_PREFIXES = ("consul_tpu/models/", "consul_tpu/ops/",
+                "consul_tpu/parallel/")
+
+
+def is_jit_wrapper_call(node: ast.Call) -> bool:
+    """True for `jax.jit(...)` / `partial(jax.jit, ...)` forms."""
+    name = dotted(node.func) or ""
+    if name in JIT_WRAPPERS:
+        return True
+    if name in {"partial", "functools.partial"} and node.args:
+        return (dotted(node.args[0]) or "") in JIT_WRAPPERS
+    return False
+
+
+def member_call_names(tree: ast.AST, module_name: str,
+                      member: str) -> Set[str]:
+    """Every dotted-call spelling under which `module_name.member` is
+    reachable in this module: `import m [as t]` yields `t.member`,
+    `from m import member [as s]` yields the bare bound name.  Used to
+    alias-proof checkers (a rename must not slip past the gate)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) \
+                and node.module == module_name:
+            for a in node.names:
+                if a.name == member:
+                    names.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module_name:
+                    names.add(f"{a.asname or a.name}.{member}")
+    return names
+
+
+def import_aliases(tree: ast.AST) -> dict:
+    """Local binding -> canonical dotted origin for every import in
+    the module: `import time as t` maps `t` -> `time`, `from time
+    import time as now` maps `now` -> `time.time`.  Feed the result to
+    `canonical_name` so prefix-matching checkers see through renames
+    the same way `member_call_names` does for single members."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def canonical_name(name: str, aliases: dict) -> str:
+    """Rewrite the leading segment of a dotted call name through the
+    module's import aliases (`t.sleep` -> `time.sleep`)."""
+    head, sep, rest = name.partition(".")
+    if head in aliases:
+        return aliases[head] + (sep + rest if sep else "")
+    return name
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted(node.func)
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def int_literals(node: ast.AST) -> Optional[Set[int]]:
+    """The set of ints in an int / tuple-of-int literal, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            got = int_literals(el)
+            if got is None:
+                return None
+            out |= got
+        return out
+    return None
+
+
+def assigned_names(target: ast.AST) -> Set[str]:
+    """Names bound by an assignment target (incl. tuple unpacking)."""
+    out: Set[str] = set()
+    for node in ast.walk(target):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            out.add(node.id)
+    return out
+
+
+def in_loop_lines(tree: ast.AST) -> Set[int]:
+    """Line numbers that sit inside a for/while body (loop headers
+    excluded) — used to spot per-iteration retracing hazards."""
+    lines: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+            for stmt in node.body + node.orelse:
+                for sub in ast.walk(stmt):
+                    lineno = getattr(sub, "lineno", None)
+                    if lineno is not None:
+                        lines.add(lineno)
+    return lines

@@ -1,0 +1,24 @@
+"""Checker registry.  Each module exports one Checker subclass;
+`ALL` is the build-gate suite in the order findings are reported."""
+
+from lint.checkers.blocking_call import BlockingCallChecker
+from lint.checkers.donation_safety import DonationSafetyChecker
+from lint.checkers.dtype_discipline import DtypeDisciplineChecker
+from lint.checkers.exception_hygiene import ExceptionHygieneChecker
+from lint.checkers.jit_purity import JitPurityChecker
+from lint.checkers.metric_names import MetricNamesChecker
+from lint.checkers.recompile_hazard import RecompileHazardChecker
+from lint.checkers.storage_seam import StorageSeamChecker
+
+ALL = [
+    JitPurityChecker(),
+    RecompileHazardChecker(),
+    DtypeDisciplineChecker(),
+    DonationSafetyChecker(),
+    BlockingCallChecker(),
+    ExceptionHygieneChecker(),
+    StorageSeamChecker(),
+    MetricNamesChecker(),
+]
+
+BY_NAME = {c.name: c for c in ALL}

@@ -1,0 +1,83 @@
+"""blocking-call: the PR-3 never-block-the-tick-thread rule.
+
+The raft tick thread and the jitted SWIM scan drive every peer's
+liveness; one inline `time.sleep` (or an unbounded wait) on those
+paths stalls the whole cluster behind a single slow peer — the exact
+failure _ConnPool's cooldown-in-state design exists to prevent.
+
+Scope, by construction rather than heuristics:
+
+  * the device hot-loop modules (`consul_tpu/models/`, `ops/`,
+    `parallel/`) — nothing there may sleep, wait, or touch files;
+  * the RPC send path (`consul_tpu/rpc/`) — transports' `send` /
+    `oneway` / `call` run on the raft tick thread, and listener
+    handler bodies run one-per-connection where a sleep head-of-line
+    blocks every queued frame.
+
+Flags `time.sleep`, `select.select`, `Event.wait()` / `.join()` /
+`sock.accept()` *without a timeout bound*, and `open(...)` in both
+scopes.  Intentional fault injection that sleeps
+on purpose (chaos delay schedules) carries a
+`# lint: ok=blocking-call (...)` suppression with its reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from lint.astutil import HOT_PREFIXES, call_name, member_call_names
+from lint.core import Checker, Finding, Module
+
+RPC_PREFIXES = ("consul_tpu/rpc/",)
+
+UNBOUNDED_METHODS = {"wait", "join", "accept"}
+
+
+class BlockingCallChecker(Checker):
+    name = "blocking-call"
+    description = ("time.sleep / unbounded waits / file I/O on the "
+                   "tick thread and RPC send/handler paths")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        hot = module.relpath.startswith(HOT_PREFIXES)
+        rpc = module.relpath.startswith(RPC_PREFIXES)
+        if not (hot or rpc):
+            return
+        where = "hot-loop module" if hot else "RPC path"
+        # every local spelling of time.sleep / select.select: aliases
+        # (`import time as t`, `from select import select as sl`)
+        # must not slip past the gate the storage-seam checker closed
+        # for os.*
+        sleep_calls = member_call_names(module.tree, "time", "sleep")
+        select_calls = member_call_names(module.tree, "select",
+                                         "select")
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node) or ""
+            seg = name.rsplit(".", 1)[-1]
+            if name in sleep_calls:
+                yield module.finding(
+                    self.name, node,
+                    f"time.sleep on the {where} — the tick thread "
+                    f"stalls every peer behind it; keep backoff in "
+                    f"state (see _ConnPool's cooldown) or move the "
+                    f"wait off-thread")
+            elif name in select_calls and len(node.args) < 4:
+                yield module.finding(
+                    self.name, node,
+                    f"select.select without a timeout on the {where}")
+            elif seg in UNBOUNDED_METHODS and "." in name \
+                    and not node.args and not any(
+                        kw.arg == "timeout" for kw in node.keywords):
+                yield module.finding(
+                    self.name, node,
+                    f"`{name}()` with no timeout on the {where} — an "
+                    f"unbounded wait; pass a timeout bound")
+            elif name == "open":
+                yield module.finding(
+                    self.name, node,
+                    f"file I/O on the {where} — host I/O does not "
+                    f"belong next to the jitted tick or on the raft "
+                    f"tick thread; route it through the caller")

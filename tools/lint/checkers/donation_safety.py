@@ -1,0 +1,144 @@
+"""donation-safety: a donated buffer is dead after the donating call.
+
+`jax.jit(..., donate_argnums=...)` lets XLA update the [N]/[N, U]
+state arrays in place — and leaves the caller's reference pointing at
+freed (or aliased, on CPU) memory.  Reading it afterwards raises on
+TPU and *silently returns stale data* under some backends, which is
+why bench/tool loops must always rebind (`state = fn(state)`).
+
+The checker tracks names bound to donating jits within a module —
+
+    f = jax.jit(g, donate_argnums=donation(0))
+    @partial(jax.jit, donate_argnums=(1,))
+
+— then, per straight-line statement block, flags any Name load of a
+donated argument after the donating call, until the name is rebound.
+The analysis is deliberately linear (no CFG): donation sites in this
+repo live in flat bench/tool driver loops, and a checker that is
+simple enough to trust beats one that is clever enough to lie.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from lint.astutil import (assigned_names, call_name, dotted,
+                          int_literals, is_jit_wrapper_call)
+from lint.core import Checker, Finding, Module
+
+
+def _donated_positions(call: ast.Call) -> Optional[Set[int]]:
+    """Literal donate_argnums of a jax.jit(...) call; `donation(k...)`
+    (utils.sync's CPU-gated helper) counts with positions k."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        got = int_literals(kw.value)
+        if got is not None:
+            return got
+        if isinstance(kw.value, ast.Call) and (
+                call_name(kw.value) or "").rsplit(".", 1)[-1] \
+                == "donation":
+            return int_literals(ast.Tuple(
+                elts=list(kw.value.args), ctx=ast.Load()))
+    return None
+
+
+class DonationSafetyChecker(Checker):
+    name = "donation-safety"
+    description = ("use of a donated buffer after the donating call")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        tree = module.tree
+        donors: Dict[str, Set[int]] = {}
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) \
+                    and is_jit_wrapper_call(node.value):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for tgt in node.targets:
+                        name = dotted(tgt)
+                        if name:
+                            donors[name] = pos
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call) \
+                            and is_jit_wrapper_call(dec):
+                        pos = _donated_positions(dec)
+                        if pos:
+                            donors[node.name] = pos
+
+        if not donors:
+            return
+        # every straight-line statement list in the file (module and
+        # function bodies, loop/if/with/try arms) is scanned as its
+        # own block — cross-block flow is not modeled (conservative)
+        for node in ast.walk(tree):
+            for field in ("body", "orelse", "finalbody"):
+                block = getattr(node, field, None)
+                if isinstance(block, list) and block \
+                        and isinstance(block[0], ast.stmt):
+                    yield from self._scan_block(module, block, donors)
+
+    def _scan_block(self, module: Module, body: List[ast.stmt],
+                    donors: Dict[str, Set[int]]) -> Iterator[Finding]:
+        # donated name -> (donor callee, call lineno)
+        dead: Dict[str, Tuple[str, int]] = {}
+        for stmt in body:
+            # 1. findings: loads of dead names in this statement
+            #    (before processing rebinds, which resurrect them)
+            if dead:
+                rebound_here = set()
+                if isinstance(stmt, ast.Assign):
+                    for tgt in stmt.targets:
+                        rebound_here |= assigned_names(tgt)
+                elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                    rebound_here |= assigned_names(stmt.target)
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, ast.Name) \
+                            and isinstance(sub.ctx, ast.Load) \
+                            and sub.id in dead:
+                        callee, at = dead[sub.id]
+                        # the donating call itself re-donating is the
+                        # rebind pattern `state = fn(state)` — only
+                        # *later* statements count, and stmt ranges
+                        # after `at` by construction here
+                        yield module.finding(
+                            self.name, sub,
+                            f"`{sub.id}` read after being donated to "
+                            f"`{callee}` (line {at}) — the buffer was"
+                            f" consumed; rebind the result or drop "
+                            f"donate_argnums")
+                for name in rebound_here:
+                    dead.pop(name, None)
+            # 2. new donations in this statement
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    callee = call_name(sub)
+                    if callee in donors:
+                        for i in donors[callee]:
+                            if i < len(sub.args):
+                                arg = sub.args[i]
+                                if isinstance(arg, ast.Name):
+                                    dead[arg.id] = (callee, sub.lineno)
+            # 3. a donation whose result rebinds the same name is safe
+            if isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    for name in assigned_names(tgt):
+                        dead.pop(name, None)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                for name in assigned_names(stmt.target):
+                    dead.pop(name, None)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                for name in assigned_names(stmt.target):
+                    dead.pop(name, None)
+                # loop bodies rebind across iterations — reset rather
+                # than model the back edge
+                dead.clear()
+            elif isinstance(stmt, (ast.While, ast.If, ast.With,
+                                   ast.Try)):
+                dead.clear()

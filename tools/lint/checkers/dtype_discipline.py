@@ -1,0 +1,176 @@
+"""dtype-discipline: keep the PR-2 narrowed state narrow.
+
+PR 2 halved the biggest hot-loop buffers by narrowing SwimState
+fields (`learn_tick`/`chaos_grp` → wrapping int16; `r_kind` /
+`r_confirm` / `sus_confirm` / `sends_left` / `awareness` → int8).
+Widening one of those *stores* silently doubles/quadruples the
+[N, U] HBM footprint and the bench guard only catches it once the
+regression ships.  In the hot-loop modules this checker flags:
+
+  * a narrowed field stored wide: `state.replace(field=...)` or a
+    state-constructor keyword whose value RESOLVES to a 32/64-bit
+    dtype — an outermost `.astype(jnp.int32)` / `jnp.zeros(...,
+    jnp.int32)` / `jnp.int32(...)`, or arithmetic whose widest
+    operand is wide (`x.astype(jnp.int32) + d` with the trailing
+    re-narrow forgotten).  Transient widening capped by an outer
+    re-narrow (`(x.astype(jnp.int32) + d).astype(jnp.int16)`) is the
+    sanctioned overflow-safe pattern and does not fire — only what is
+    stored matters;
+  * any 64-bit dtype mention (`jnp.int64`, `float64`, `dtype=
+    "float64"`) — x64 is off and TPUs demote it, so it is either dead
+    or a silent double-width buffer on CPU backends;
+  * a fresh 2-D allocation (`jnp.zeros/ones/full/empty` with a
+    2-element shape) carrying an explicit 32-bit+ dtype — the
+    [N, U]-shaped intermediates are exactly the allocations PR 2
+    narrowed.  1-D [N] buffers stay free to be int32 (incarnations
+    are).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from lint.astutil import HOT_PREFIXES, call_name, dotted
+from lint.core import Checker, Finding, Module
+
+# field → the dtype PR 2 narrowed it to
+NARROWED = {
+    "learn_tick": "int16", "chaos_grp": "int16",
+    "r_kind": "int8", "r_confirm": "int8", "sus_confirm": "int8",
+    "sends_left": "int8", "awareness": "int8",
+}
+WIDE = {"int32", "int64", "uint32", "uint64", "float32", "float64"}
+# the [N, U] intermediates PR 2 narrowed are integer state (plus the
+# float64 TPU hazard) — float32 is the legitimate compute dtype for
+# coordinates/RTT math (vivaldi), so 2-D float32 allocations pass
+ALLOC_WIDE = {"int32", "int64", "uint32", "uint64", "float64"}
+WIDE64 = {"int64", "uint64", "float64"}
+ALLOC_FNS = {"zeros", "ones", "full", "empty"}
+
+
+def _dtype_name(node: ast.AST) -> Optional[str]:
+    """'int32' for jnp.int32 / np.int32 / "int32" literals."""
+    name = dotted(node)
+    if name and "." in name:
+        return name.rsplit(".", 1)[-1]
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _outermost_dtype(node: ast.AST) -> Optional[str]:
+    """The dtype an expression's RESULT is stored as, when the
+    outermost operation states one explicitly."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node) or ""
+    seg = name.rsplit(".", 1)[-1]
+    if seg == "astype" and node.args:
+        return _dtype_name(node.args[0])
+    if seg in ALLOC_FNS | {"asarray", "array", "arange"}:
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                return _dtype_name(kw.value)
+        if len(node.args) >= 2:
+            return _dtype_name(node.args[1])
+        return None
+    if seg in WIDE | {"int8", "int16", "uint8", "uint16", "float16",
+                      "bfloat16"} and name.startswith(
+                          ("jnp.", "jax.numpy.", "np.", "_np.")):
+        return seg
+    if seg == "where" and len(node.args) == 3:
+        a = _outermost_dtype(node.args[1])
+        b = _outermost_dtype(node.args[2])
+        return a if a == b else None
+    return None
+
+
+_WIDTH = {"int8": 8, "uint8": 8, "bool_": 8,
+          "int16": 16, "uint16": 16, "float16": 16, "bfloat16": 16,
+          "int32": 32, "uint32": 32, "float32": 32,
+          "int64": 64, "uint64": 64, "float64": 64}
+
+
+def _stored_dtype(node: ast.AST) -> Optional[str]:
+    """The dtype a stored expression resolves to: the outermost
+    explicit dtype when there is one, else — for arithmetic — the
+    widest operand dtype (promotion keeps the wide side, so
+    `x.astype(jnp.int32) + d` with no trailing re-narrow STORES
+    int32; the sanctioned PR-2 idiom ends in `.astype(jnp.int16)`
+    which is the outermost op and wins)."""
+    got = _outermost_dtype(node)
+    if got is not None:
+        return got
+    if isinstance(node, ast.UnaryOp):
+        return _stored_dtype(node.operand)
+    if isinstance(node, ast.BinOp):
+        a = _stored_dtype(node.left)
+        b = _stored_dtype(node.right)
+        return max((d for d in (a, b) if d in _WIDTH),
+                   key=_WIDTH.get, default=None)
+    return None
+
+
+def _shape_rank(node: ast.Call) -> Optional[int]:
+    if node.args and isinstance(node.args[0], (ast.Tuple, ast.List)):
+        return len(node.args[0].elts)
+    return None
+
+
+class DtypeDisciplineChecker(Checker):
+    name = "dtype-discipline"
+    description = ("narrowed SwimState fields stored wide, 64-bit "
+                   "dtypes, and wide 2-D allocations in hot-loop "
+                   "modules")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith(HOT_PREFIXES):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                # 64-bit mentions outside calls (annotations, dtype
+                # tables) are caught by the dotted-name walk below
+                continue
+            name = call_name(node) or ""
+            seg = name.rsplit(".", 1)[-1]
+
+            # narrowed field stored wide via .replace(...) / ctor kw
+            if seg == "replace" or seg.endswith("State"):
+                for kw in node.keywords:
+                    if kw.arg in NARROWED:
+                        got = _stored_dtype(kw.value)
+                        if got in WIDE:
+                            want = NARROWED[kw.arg]
+                            yield module.finding(
+                                self.name, kw.value,
+                                f"narrowed field `{kw.arg}` stored as "
+                                f"{got} (PR-2 narrowed it to {want}) "
+                                f"— re-narrow with .astype(jnp.{want})"
+                                f" before storing")
+
+            # wide 2-D allocation
+            if seg in ALLOC_FNS and name.startswith(
+                    ("jnp.", "jax.numpy.")):
+                rank = _shape_rank(node)
+                got = _outermost_dtype(node)
+                if rank is not None and rank >= 2 and got in ALLOC_WIDE:
+                    yield module.finding(
+                        self.name, node,
+                        f"{rank}-D jnp.{seg} allocated as {got} in a "
+                        f"hot-loop module — [N, U]-shaped "
+                        f"intermediates are the buffers PR 2 "
+                        f"narrowed; justify with a suppression or "
+                        f"narrow the dtype")
+
+        # 64-bit dtype mentions anywhere in a hot module
+        for node in ast.walk(module.tree):
+            name = dotted(node)
+            if name and name.rsplit(".", 1)[-1] in WIDE64 \
+                    and name.startswith(("jnp.", "jax.numpy.", "np.",
+                                         "_np.", "numpy.")):
+                yield module.finding(
+                    self.name, node,
+                    f"64-bit dtype `{name}` in a hot-loop module — "
+                    f"x64 is disabled (TPU demotes it); use a 32-bit "
+                    f"or narrower dtype")

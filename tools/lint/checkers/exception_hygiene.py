@@ -1,0 +1,87 @@
+"""exception-hygiene: broad excepts must log, count, or re-raise.
+
+The reference agent never swallows an RPC/consensus error silently —
+every failure path logs and bumps a counter the operator can alarm
+on (`consul.rpc.failed` and friends).  In this repo's `rpc/`, `api/`,
+and `consensus/` layers, a bare `except:` or `except Exception:` /
+`except BaseException:` whose handler neither
+
+  * re-raises,
+  * calls a logging function (any dotted name with a `log` / `warn` /
+    `error` / `exception` / `debug` / `info` segment, or
+    `trace.record`), nor
+  * bumps a telemetry counter / sample (`incr_counter`,
+    `add_sample`, `measure_since`)
+
+turns an operational failure into a silent no-op — the class of bug
+the PR-3 nemesis kept finding by hand.  Handlers for *expected*
+conditions should catch the narrow exception type instead (which
+also documents what the code expects to happen).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from lint.astutil import call_name
+from lint.core import Checker, Finding, Module
+
+SCOPE_PREFIXES = ("consul_tpu/rpc/", "consul_tpu/api/",
+                  "consul_tpu/consensus/")
+
+BROAD = {"Exception", "BaseException"}
+LOG_SEGMENTS = {"log", "logger", "logging", "warning", "warn", "error",
+                "exception", "debug", "info", "critical", "record",
+                "print"}
+COUNTER_FNS = {"incr_counter", "add_sample", "measure_since",
+               "set_gauge"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(el, "id", getattr(el, "attr", ""))
+                 for el in t.elts]
+    else:
+        names = [getattr(t, "id", getattr(t, "attr", ""))]
+    return any(n in BROAD for n in names)
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    """True when the handler raises, logs, or counts."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            segments = set(name.lower().split("."))
+            if segments & LOG_SEGMENTS:
+                return True
+            if name.rsplit(".", 1)[-1] in COUNTER_FNS:
+                return True
+    return False
+
+
+class ExceptionHygieneChecker(Checker):
+    name = "exception-hygiene"
+    description = ("broad except that swallows errors without a log, "
+                   "a consul.* failure counter, or a re-raise in "
+                   "rpc/, api/, consensus/")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith(SCOPE_PREFIXES):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler) and _is_broad(node) \
+                    and not _handles(node):
+                shown = ("bare except" if node.type is None else
+                         f"except {ast.unparse(node.type)}")
+                yield module.finding(
+                    self.name, node,
+                    f"{shown} swallows the error — log it, bump a "
+                    f"consul.* failure counter, re-raise, or catch "
+                    f"the narrow type this code actually expects")

@@ -1,0 +1,205 @@
+"""jit-purity: no Python side effects inside jit/scan bodies.
+
+A function is *jit-reachable* when it is (a) passed to or decorated by
+`jax.jit`/`jax.pmap` (incl. `partial(jax.jit, ...)`), (b) passed as a
+body/branch to `lax.scan`/`cond`/`while_loop`/`fori_loop`/`switch`
+/`map`, (c) named in EXTRA_ROOTS (entry points jitted from *other*
+modules — the oracle jits `swim.step`, chaos jits `swim.run`), or
+(d) called from any of the above within the same module.
+
+Inside that set we flag:
+
+  * host side effects: `print`, `open`, `input`, `breakpoint`,
+    `os.*`, `sys.*`, `logging.*`, `subprocess.*`;
+  * host clocks and blocking: `time.*` (the PR-3 rule — a sleep or a
+    wall-clock read inside a traced body either burns at trace time
+    only, silently, or crashes);
+  * host RNG: `random.*` / `np.random.*` — nondeterministic across
+    retraces; randomness must be counter-based `jax.random`;
+  * host sync: `jax.device_get`, `.block_until_ready()`, `np.asarray`
+    and friends on traced values (numpy *dtype constructors* like
+    `np.int32(-1)` are static constants and stay allowed);
+  * `if`/`while` tests that call into `jnp.*` — a Python branch on a
+    tracer (`if jnp.any(x):`) is a concretization error or, worse, a
+    trace-time constant.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set
+
+from lint.astutil import (JIT_WRAPPERS, call_name, canonical_name,
+                          dotted, import_aliases)
+from lint.core import Checker, Finding, Module
+
+LAX_HOF = {  # higher-order jax.lax combinators: which args are bodies
+    "scan": (0,), "cond": (1, 2, 3), "while_loop": (0, 1),
+    "fori_loop": (2,), "switch": None, "map": (0,), "associative_scan": (0,),
+}
+# combinators whose bare name collides with a Python builtin: only the
+# lax./jax.lax. prefixed spelling counts (builtin map() over an I/O
+# helper must not mark that helper jit-reachable)
+BUILTIN_HOMONYMS = {"map", "filter"}
+
+# entry points jitted from OTHER modules (oracle.py, chaos.py, bench
+# and tool scans): reachability cannot see across files, so the known
+# cross-module jit roots are pinned here.
+EXTRA_ROOTS = {
+    "consul_tpu/models/swim.py": {
+        "step", "step_with_obs", "run", "metrics_vector"},
+    "consul_tpu/models/serf.py": {"step", "run", "metrics_vector"},
+    "consul_tpu/models/wan.py": {"step", "run"},
+}
+
+BANNED_PREFIXES = (
+    "time.", "random.", "os.", "sys.", "logging.", "subprocess.",
+    "np.random.", "_np.random.", "numpy.random.", "threading.",
+    "socket.",
+)
+BANNED_NAMES = {
+    "print", "open", "input", "breakpoint", "exec", "eval",
+    "jax.device_get", "jax.debug.breakpoint",
+}
+# numpy dtype constructors produce static scalars — allowed
+NP_SCALAR_OK = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_",
+}
+NP_MODULES = ("np", "_np", "numpy")
+
+
+def _np_host_call(name: str) -> bool:
+    for mod in NP_MODULES:
+        if name.startswith(mod + "."):
+            rest = name[len(mod) + 1:]
+            if rest not in NP_SCALAR_OK:
+                return True
+    return False
+
+
+class JitPurityChecker(Checker):
+    name = "jit-purity"
+    description = ("no host side effects, clocks, RNG, or tracer "
+                   "branches inside jit/scan-reachable functions")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        tree = module.tree
+        # local function defs by simple name (module level + nested);
+        # last definition wins, which matches runtime rebinding
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs[node.name] = node
+
+        roots: List[ast.AST] = []
+        root_names: Set[str] = set(
+            EXTRA_ROOTS.get(module.relpath, set()))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name in JIT_WRAPPERS or (
+                        name in {"partial", "functools.partial"}
+                        and node.args
+                        and (dotted(node.args[0]) or "") in JIT_WRAPPERS):
+                    args = node.args[1:] if name.startswith(
+                        ("partial", "functools")) else node.args
+                    for arg in args:
+                        self._root(arg, roots, root_names)
+                seg = name.rsplit(".", 1)[-1]
+                if seg in LAX_HOF and (
+                        name.startswith(("jax.lax.", "lax."))
+                        or (name == seg
+                            and seg not in BUILTIN_HOMONYMS)):
+                    body_idx = LAX_HOF[seg]
+                    idxs = range(len(node.args)) if body_idx is None \
+                        else body_idx
+                    for i in idxs:
+                        if i < len(node.args):
+                            self._root(node.args[i], roots, root_names)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dn = dotted(dec) or (
+                        call_name(dec) if isinstance(dec, ast.Call)
+                        else None) or ""
+                    inner = ""
+                    if isinstance(dec, ast.Call) and dec.args:
+                        inner = dotted(dec.args[0]) or ""
+                    if dn in JIT_WRAPPERS or inner in JIT_WRAPPERS:
+                        root_names.add(node.name)
+
+        # closure over module-local calls
+        seen: Set[str] = set()
+        frontier = [n for n in root_names if n in defs]
+        while frontier:
+            fname = frontier.pop()
+            if fname in seen:
+                continue
+            seen.add(fname)
+            fn = defs[fname]
+            roots.append(fn)
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    callee = call_name(call) or ""
+                    if callee in defs and callee not in seen:
+                        frontier.append(callee)
+
+        # see through import renames: `import time as t` /
+        # `from time import time as now` must not slip past the
+        # prefix match below
+        aliases = import_aliases(tree)
+        reported: Set[int] = set()
+        for root in roots:
+            yield from self._scan_body(module, root, reported, aliases)
+
+    def _root(self, arg: ast.AST, roots: List[ast.AST],
+              root_names: Set[str]) -> None:
+        if isinstance(arg, ast.Lambda):
+            roots.append(arg)
+        else:
+            name = dotted(arg)
+            if name and "." not in name:
+                root_names.add(name)
+
+    def _scan_body(self, module: Module, root: ast.AST,
+                   reported: Set[int],
+                   aliases: dict) -> Iterator[Finding]:
+        where = getattr(root, "name", "<lambda>")
+        for node in ast.walk(root):
+            if isinstance(node, ast.Call):
+                name = canonical_name(call_name(node) or "", aliases)
+                bad = None
+                if name in BANNED_NAMES:
+                    bad = name
+                elif name.startswith(BANNED_PREFIXES):
+                    bad = name
+                elif _np_host_call(name):
+                    bad = name
+                elif name.endswith(".block_until_ready"):
+                    bad = name
+                if bad and id(node) not in reported:
+                    reported.add(id(node))
+                    yield module.finding(
+                        self.name, node,
+                        f"host call `{bad}` inside jit-reachable "
+                        f"`{where}` — side effects burn at trace time "
+                        f"only (move it outside the jit boundary or "
+                        f"use jax.debug.print / jax.random)")
+            elif isinstance(node, (ast.If, ast.While)):
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call):
+                        cn = canonical_name(call_name(sub) or "",
+                                            aliases)
+                        if cn.startswith(("jnp.", "jax.numpy.")) \
+                                and id(node) not in reported:
+                            reported.add(id(node))
+                            yield module.finding(
+                                self.name, node,
+                                f"Python `{type(node).__name__.lower()}`"
+                                f" branches on `{cn}(...)` inside "
+                                f"jit-reachable `{where}` — a tracer "
+                                f"in a host branch is a concretization"
+                                f" error; use lax.cond/jnp.where")
+                            break

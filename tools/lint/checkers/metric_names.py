@@ -1,0 +1,147 @@
+"""metric-names: the go-metrics naming convention, checked at the
+call site.
+
+Every metric in the tree is emitted through `telemetry.incr_counter`
+/ `set_gauge` / `add_sample` / `measure_since`, whose name argument
+is a dotted string or a tuple of parts joined under the `consul.`
+prefix.  The *dynamic* audit (`tools/metrics_audit.py`, whose
+`audit_names` / `audit_cardinality` / `audit_prometheus` now live
+here) validates whatever a live registry accumulated; this static
+checker catches the same violations at the source line, before any
+process runs:
+
+  * literal name parts must match `[A-Za-z0-9_-]+` (camelCase like
+    `commitTime` is Consul-shaped and allowed; dots inside a part,
+    spaces, or empty parts are not);
+  * a literal name must not start with `consul` — the registry
+    prepends the prefix, so a literal `consul.` doubles it;
+  * a literal labels dict must stay within MAX_LABELS_PER_METRIC keys
+    and its keys must be literal strings (a computed label KEY is the
+    cardinality foot-gun's close cousin).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Iterator, List
+
+import ast
+
+from lint.astutil import call_name, literal_str
+from lint.core import Checker, Finding, Module
+
+NAME_RE = re.compile(r"^consul(\.[A-Za-z0-9_-]+)+$")
+PART_RE = re.compile(r"^[A-Za-z0-9_-]+$")
+MAX_LABEL_SETS = 64
+MAX_LABELS_PER_METRIC = 8
+
+EMIT_FNS = {"incr_counter", "set_gauge", "add_sample", "measure_since"}
+
+
+class MetricNamesChecker(Checker):
+    name = "metric-names"
+    description = ("literal metric names/labels at telemetry call "
+                   "sites must satisfy the go-metrics convention")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = (call_name(node) or "").rsplit(".", 1)[-1]
+            if fn not in EMIT_FNS or not node.args:
+                continue
+            name_arg = node.args[0]
+            parts: List[str] = []
+            if isinstance(name_arg, (ast.Tuple, ast.List)):
+                parts = [p for p in map(literal_str, name_arg.elts)
+                         if p is not None]
+            else:
+                lit = literal_str(name_arg)
+                if lit is not None:
+                    parts = lit.split(".")
+            for part in parts:
+                if not PART_RE.match(part):
+                    yield module.finding(
+                        self.name, name_arg,
+                        f"metric name part {part!r} violates the "
+                        f"go-metrics convention ([A-Za-z0-9_-]+ per "
+                        f"dotted part)")
+            if parts and parts[0] == "consul":
+                yield module.finding(
+                    self.name, name_arg,
+                    "literal metric name already starts with "
+                    "'consul' — the registry prepends the prefix, "
+                    "so this emits consul.consul.*")
+            for kw in node.keywords:
+                if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+                    if len(kw.value.keys) > MAX_LABELS_PER_METRIC:
+                        yield module.finding(
+                            self.name, kw.value,
+                            f"{len(kw.value.keys)} labels > "
+                            f"{MAX_LABELS_PER_METRIC} on one metric")
+                    for key in kw.value.keys:
+                        if key is not None and literal_str(key) is None:
+                            yield module.finding(
+                                self.name, key,
+                                "computed label KEY — label keys must "
+                                "be literals (values may vary, keys "
+                                "may not)")
+
+
+# --------------------------------------------------------------------
+# Dynamic-registry audits, migrated verbatim from tools/metrics_audit
+# (the shim re-exports them; tests/test_device_counters and
+# tests/test_metrics_golden call them on live dumps).
+
+
+def audit_names(dump: dict) -> List[str]:
+    """Naming-convention violations in a Registry.dump()."""
+    out = []
+    for section in ("Counters", "Gauges", "Samples"):
+        for row in dump.get(section, []):
+            name = row.get("Name", "")
+            if not NAME_RE.match(name):
+                out.append(f"bad metric name ({section.lower()}): "
+                           f"{name!r} does not match {NAME_RE.pattern}")
+    return out
+
+
+def audit_cardinality(dump: dict,
+                      max_sets: int = MAX_LABEL_SETS) -> List[str]:
+    """Label-cardinality violations: distinct label sets per name."""
+    sets: dict = {}
+    out = []
+    for section in ("Counters", "Gauges", "Samples"):
+        for row in dump.get(section, []):
+            labels = row.get("Labels") or {}
+            if len(labels) > MAX_LABELS_PER_METRIC:
+                out.append(f"too many labels on {row['Name']!r}: "
+                           f"{len(labels)} > {MAX_LABELS_PER_METRIC}")
+            key = (section, row["Name"])
+            sets.setdefault(key, set()).add(
+                tuple(sorted(labels.items())))
+    for (section, name), variants in sorted(sets.items()):
+        if len(variants) > max_sets:
+            out.append(f"unbounded label cardinality on {name!r}: "
+                       f"{len(variants)} label sets > {max_sets}")
+    return out
+
+
+def audit_prometheus(text: str) -> List[str]:
+    """Exposition-format violations: duplicate # TYPE blocks."""
+    seen: dict = {}
+    out = []
+    for line in text.splitlines():
+        if not line.startswith("# TYPE "):
+            continue
+        _, _, rest = line.partition("# TYPE ")
+        parts = rest.split()
+        if len(parts) != 2:
+            out.append(f"malformed TYPE line: {line!r}")
+            continue
+        name, kind = parts
+        if name in seen:
+            out.append(f"duplicate # TYPE block for {name!r} "
+                       f"({seen[name]} then {kind})")
+        seen[name] = kind
+    return out

@@ -1,0 +1,100 @@
+"""recompile-hazard: call patterns that retrace/recompile a jitted
+function every invocation.
+
+Three shapes, all observed in the wild and all invisible until the
+profiler shows 1-2 s of tracing per call:
+
+  * `jax.jit(...)` **inside a loop body** — a fresh jit wrapper (and a
+    fresh trace cache) per iteration.  Building a jit once into a
+    module-level cache keyed by static config (chaos.py's
+    `_SWIM_COMPILED`) is the sanctioned pattern and does not fire;
+  * **immediate invocation** `jax.jit(f)(x)` inside a function — the
+    wrapper is born and dies per call, so nothing is ever cached;
+  * calling a known-jitted entry point with a **non-hashable literal**
+    (list/dict/set display) in a `static_argnums` position — every
+    call raises or, with unhashable-containers quietly stringified,
+    retraces.  Fresh lambdas in any argument position of a jitted
+    call retrace too (a new closure identity per call).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from lint.astutil import (call_name, dotted, in_loop_lines,
+                          int_literals, is_jit_wrapper_call)
+from lint.core import Checker, Finding, Module
+
+
+def _static_positions(node: ast.Call) -> Optional[Set[int]]:
+    """Literal static_argnums of a jax.jit call, when statically
+    known."""
+    for kw in node.keywords:
+        if kw.arg == "static_argnums":
+            return int_literals(kw.value)
+    return None
+
+
+class RecompileHazardChecker(Checker):
+    name = "recompile-hazard"
+    description = ("jit-in-loop, jit(f)(x) immediate invocation, and "
+                   "non-hashable/fresh-closure args to jitted entry "
+                   "points")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        tree = module.tree
+        loop_lines = in_loop_lines(tree)
+
+        # names bound (anywhere) to a jit-wrapped callable, with their
+        # literal static positions when known:  f = jax.jit(g, ...)
+        # or  self._f = jax.jit(g, ...)
+        jitted: Dict[str, Optional[Set[int]]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call) and is_jit_wrapper_call(node.value):
+                for tgt in node.targets:
+                    name = dotted(tgt)
+                    if name:
+                        jitted[name] = _static_positions(node.value)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if is_jit_wrapper_call(node):
+                if node.lineno in loop_lines:
+                    yield module.finding(
+                        self.name, node,
+                        "jax.jit created inside a loop body — a fresh "
+                        "trace cache per iteration; hoist it (or key "
+                        "it in a module-level cache like chaos.py's "
+                        "_SWIM_COMPILED)")
+                continue
+            # jax.jit(f)(x): the callee itself is a jit call
+            if isinstance(node.func, ast.Call) \
+                    and is_jit_wrapper_call(node.func):
+                yield module.finding(
+                    self.name, node,
+                    "jax.jit(f)(...) invoked immediately — the "
+                    "wrapper (and its compile cache) dies after this "
+                    "call; bind the jitted function once and reuse it")
+                continue
+            callee = call_name(node)
+            if callee in jitted:
+                statics = jitted[callee]
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, (ast.List, ast.Dict, ast.Set)) \
+                            and statics is not None and i in statics:
+                        kind = type(arg).__name__.lower()
+                        yield module.finding(
+                            self.name, arg,
+                            f"{kind} literal passed to jitted "
+                            f"`{callee}` arg {i} — non-hashable as a "
+                            f"static arg and a fresh pytree identity "
+                            f"per call; pass a tuple or hoist it")
+                    elif isinstance(arg, ast.Lambda):
+                        yield module.finding(
+                            self.name, arg,
+                            f"fresh lambda passed to jitted "
+                            f"`{callee}` — a new closure identity "
+                            f"per call retraces; hoist the function")

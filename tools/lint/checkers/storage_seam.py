@@ -1,0 +1,105 @@
+"""storage-seam: all durability I/O routes through storage.py.
+
+`os.fsync` and `os.replace` decide what survives a crash.  Any such
+call outside `consul_tpu/storage.py` is one `chaos.FaultyStorage`
+cannot intercept — a durability boundary `tools/crash_matrix.py`
+cannot enumerate and nobody has proven recoverable (PR 4).
+
+This is the AST successor of `tools/storage_audit.py` (which is now a
+thin shim over `scan_tree` below).  Beyond the old regex it also
+catches `from os import fsync/replace` aliasing, which the
+line-oriented grep could never see.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, List, Optional, Set
+
+from lint.astutil import call_name, member_call_names
+from lint.core import Checker, Finding, Module, ModuleCache
+
+SEAM = "consul_tpu/storage.py"
+SCOPE_PREFIX = "consul_tpu/"
+DURABILITY_CALLS = {"fsync", "replace"}
+
+
+def _violations(module: Module) -> Iterator[tuple]:
+    """(node, dotted-name) pairs for durability I/O in a module.
+    Alias-proof: `import os as _os` / `from os import replace as mv`
+    resolve to the same gate as the literal spelling."""
+    spellings = {}
+    for c in DURABILITY_CALLS:
+        for n in {f"os.{c}"} | member_call_names(module.tree, "os", c):
+            spellings[n] = f"os.{c}"
+    called = set()
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            name = call_name(node) or ""
+            if name in spellings:
+                called.add(spellings[name])
+                yield node, spellings[name]
+    # a `from os import fsync` with no call is still a leak waiting to
+    # happen and gets flagged at the import; when the alias IS called,
+    # the call line alone carries the finding (one violation, one
+    # suppression point)
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name in DURABILITY_CALLS \
+                        and f"os.{alias.name}" not in called:
+                    yield node, f"os.{alias.name}"
+
+
+class StorageSeamChecker(Checker):
+    name = "storage-seam"
+    description = ("os.fsync/os.replace outside consul_tpu/storage.py "
+                   "— durability I/O the nemesis cannot intercept")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith(SCOPE_PREFIX) \
+                or module.relpath == SEAM:
+            return
+        for node, name in _violations(module):
+            yield module.finding(
+                self.name, node,
+                f"{name} outside the storage seam (route it through "
+                f"consul_tpu/storage.py)")
+
+
+def scan_tree(pkg_root: str, repo_root: str,
+              allowed: Optional[Set[str]] = None) -> List[str]:
+    """Legacy storage_audit.audit() surface: walk `pkg_root`, return
+    `"{rel}:{line}: os.X outside the storage seam (...)"` strings.
+    `allowed` holds repo-relative paths (default: the seam itself)."""
+    allowed = allowed if allowed is not None else {
+        os.path.join("consul_tpu", "storage.py")}
+    allowed = {p.replace(os.sep, "/") for p in allowed}
+    cache = ModuleCache(repo_root)
+    rows: List[tuple] = []
+    for module in cache.walk([pkg_root]):
+        if module.relpath in allowed:
+            continue
+        if module.parse_error is not None:
+            # the old line-grep scanned broken files too — an
+            # unparseable file must surface, not silently pass
+            rows.append((module.relpath,
+                         module.parse_error.lineno or 0,
+                         f"file does not parse "
+                         f"({module.parse_error.msg}) — cannot prove "
+                         f"the storage seam holds"))
+            continue
+        for node, name in _violations(module):
+            # honor the driver's suppression comments: the shim and
+            # `tools/lint.py --check` must agree on every line, or a
+            # legitimately suppressed call greens one gate and reds
+            # the other
+            if module.suppressed(node.lineno, StorageSeamChecker.name):
+                continue
+            rows.append((module.relpath, node.lineno,
+                         f"{name} outside the storage seam (route it "
+                         f"through consul_tpu/storage.py)"))
+    # sort on (path, line) BEFORE rendering: lexicographic sort of the
+    # strings would put line 10 before line 9
+    return [f"{rel}:{line}: {msg}" for rel, line, msg in sorted(rows)]

@@ -1,0 +1,138 @@
+"""Driver CLI: `python tools/lint.py [--check|--json|--list] ...`.
+
+Exit codes: 0 clean (or informational modes), 1 non-baselined
+findings or stale baseline entries, 2 usage/baseline-format errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import List
+
+from lint import checkers as checker_registry
+from lint.core import (Finding, ModuleCache, baseline_entries,
+                       load_baseline, run_checkers, split_baselined)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# what the gate scans: the package, the drivers that own the jit/
+# donation call sites, and the lint tooling itself
+DEFAULT_ROOTS = ["consul_tpu", "tools", "bench.py"]
+DEFAULT_BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lint.py",
+        description="Invariant linter: AST checkers for this repo's "
+                    "cross-layer contracts (the go vet of this tree).")
+    p.add_argument("--check", action="store_true",
+                   help="gate mode: exit 1 on any non-baselined "
+                        "finding or stale baseline entry (tier-1)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit findings as JSON (trend tracking)")
+    p.add_argument("--list", action="store_true", dest="list_checkers",
+                   help="list available checkers and exit")
+    p.add_argument("--checker", action="append", default=None,
+                   metavar="NAME", help="run only NAME (repeatable)")
+    p.add_argument("--paths", nargs="+", default=None,
+                   help=f"roots to scan (default: {DEFAULT_ROOTS})")
+    p.add_argument("--repo-root", default=REPO,
+                   help="root that path-scoped rules (consul_tpu/rpc/"
+                        " etc.) are resolved against — point it at a "
+                        "fixture tree to lint one out-of-repo")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (default: tools/"
+                        "lint_baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline to the current "
+                        "findings (each entry still needs a hand-"
+                        "written reason before --check accepts it)")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print findings the baseline covers")
+    return p
+
+
+def main(argv: List[str] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_checkers:
+        for c in checker_registry.ALL:
+            print(f"{c.name:20s} {c.description}")
+        return 0
+
+    active = checker_registry.ALL
+    if args.checker:
+        unknown = [n for n in args.checker
+                   if n not in checker_registry.BY_NAME]
+        if unknown:
+            print(f"unknown checker(s): {', '.join(unknown)} "
+                  f"(see --list)", file=sys.stderr)
+            return 2
+        active = [checker_registry.BY_NAME[n] for n in args.checker]
+
+    roots = args.paths or DEFAULT_ROOTS
+    t0 = time.perf_counter()
+    cache = ModuleCache(args.repo_root)
+    findings = run_checkers(cache, roots, active)
+    elapsed = time.perf_counter() - t0
+
+    try:
+        baseline = load_baseline(
+            args.baseline, allow_placeholder=args.update_baseline)
+    except (ValueError, json.JSONDecodeError) as e:
+        print(f"lint: bad baseline file {args.baseline}: {e}",
+              file=sys.stderr)
+        return 2
+    new, baselined, stale = split_baselined(
+        findings, baseline, checker_names=[c.name for c in active],
+        roots=roots, repo_root=args.repo_root)
+
+    if args.update_baseline:
+        entries = baseline_entries(new)
+        merged = [e for e in baseline
+                  if e not in stale] + entries
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(merged, f, indent=2)
+            f.write("\n")
+        print(f"lint: baseline rewritten — {len(entries)} new "
+              f"entr{'y' if len(entries) == 1 else 'ies'} (fill in "
+              f"each 'reason'), {len(stale)} stale dropped")
+        return 0
+
+    if args.as_json:
+        print(json.dumps({
+            "new": [f.to_json() for f in new],
+            "baselined": [f.to_json() for f in baselined],
+            "stale_baseline": stale,
+            "checkers": [c.name for c in active],
+            "elapsed_s": round(elapsed, 3),
+        }, indent=2))
+        return 1 if (args.check and (new or stale)) else 0
+
+    for f in new:
+        print(f"VIOLATION: {f.render()}", file=sys.stderr)
+    if args.show_baselined:
+        for f in baselined:
+            print(f"baselined: {f.render()}")
+    for e in stale:
+        print(f"STALE BASELINE: [{e['checker']}] {e['path']}: "
+              f"{e['code']!r} no longer matches — delete the entry",
+              file=sys.stderr)
+
+    n_files = len(cache._cache)
+    if new or stale:
+        print(f"lint: {len(new)} violation(s), {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"across {n_files} files ({elapsed:.2f}s)",
+              file=sys.stderr)
+        return 1
+    extra = f", {len(baselined)} baselined" if baselined else ""
+    print(f"lint: OK — {n_files} files, {len(active)} checkers, "
+          f"0 violations{extra} ({elapsed:.2f}s)")
+    return 0

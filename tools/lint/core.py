@@ -1,0 +1,275 @@
+"""Framework core: parsed-module cache, findings, suppression,
+baseline.
+
+Design (mirrors the structure of a `go vet` driver):
+
+  * every file is read and `ast.parse`d exactly ONCE (ModuleCache) no
+    matter how many checkers run over it — the whole tree lints in
+    well under the 15 s tier-1 budget;
+  * a checker is a tiny object with a `name` and a
+    `run(module) -> findings` method, registered in
+    `lint.checkers.ALL` — adding an invariant is one file;
+  * per-line suppression: `# lint: ok=<checker>[,<checker>] (reason)`
+    on the flagged line, or alone on the line above, silences that
+    line for those checkers.  Suppressions are for *intentional*
+    violations (e.g. chaos fault injection that sleeps on purpose) and
+    should carry the reason in the trailing comment text;
+  * baseline: `tools/lint_baseline.json` holds legacy findings that
+    predate a checker, keyed by (checker, path, stripped source line)
+    so they survive unrelated line shifts.  Every entry MUST carry a
+    one-line `reason`.  `--check` fails on any finding not in the
+    baseline, and reports baseline entries that no longer match
+    anything (stale debt that must be deleted, never accumulated).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*lint:\s*ok=([A-Za-z0-9_,\-]+)")
+
+# directories never walked (generated code, caches, the lint package
+# itself is still scanned — it must hold to its own rules)
+SKIP_DIRS = {"__pycache__", ".git", "node_modules", "golden"}
+
+
+class Finding:
+    """One violation: checker name, repo-relative path, 1-based line,
+    message, and the stripped source line (the baseline fingerprint)."""
+
+    __slots__ = ("checker", "path", "line", "message", "code")
+
+    def __init__(self, checker: str, path: str, line: int,
+                 message: str, code: str = ""):
+        self.checker = checker
+        self.path = path.replace(os.sep, "/")
+        self.line = line
+        self.message = message
+        self.code = code
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.checker, self.path, self.code)
+
+    def sort_key(self):
+        return (self.path, self.line, self.checker, self.message)
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}] "
+                f"{self.message}")
+
+    def to_json(self) -> dict:
+        return {"checker": self.checker, "path": self.path,
+                "line": self.line, "message": self.message,
+                "code": self.code}
+
+    def __repr__(self) -> str:  # debugging convenience
+        return f"<Finding {self.render()!r}>"
+
+
+class Module:
+    """One parsed source file, shared by every checker."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = e
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, checker: str, node_or_line, message: str) -> Finding:
+        lineno = (node_or_line if isinstance(node_or_line, int)
+                  else getattr(node_or_line, "lineno", 0))
+        return Finding(checker, self.relpath, lineno, message,
+                       self.line(lineno).strip())
+
+    def suppressed(self, lineno: int, checker: str) -> bool:
+        """`# lint: ok=<names>` on the line, or alone on the line
+        above (for statements whose flagged line is too long to carry
+        a trailing comment)."""
+        for cand in (self.line(lineno), ):
+            m = SUPPRESS_RE.search(cand)
+            if m and checker in m.group(1).split(","):
+                return True
+        above = self.line(lineno - 1).strip()
+        if above.startswith("#"):
+            m = SUPPRESS_RE.search(above)
+            if m and checker in m.group(1).split(","):
+                return True
+        return False
+
+
+class Checker:
+    """Base class: subclass, set `name`/`description`, implement
+    `run`.  Checkers must be pure functions of the Module — no global
+    state, so the driver can run them in any order."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class ModuleCache:
+    """Parse every file once; hand the same Module to every checker."""
+
+    def __init__(self, repo_root: str):
+        self.repo_root = os.path.abspath(repo_root)
+        self._cache: Dict[str, Module] = {}
+
+    def get(self, path: str) -> Module:
+        path = os.path.abspath(path)
+        if path not in self._cache:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            rel = os.path.relpath(path, self.repo_root)
+            self._cache[path] = Module(path, rel, source)
+        return self._cache[path]
+
+    def walk(self, roots: Iterable[str]) -> Iterator[Module]:
+        seen = set()
+        for root in roots:
+            root = os.path.join(self.repo_root, root) \
+                if not os.path.isabs(root) else root
+            if os.path.isfile(root):
+                if root.endswith(".py") and root not in seen:
+                    seen.add(root)
+                    yield self.get(root)
+                continue
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in SKIP_DIRS)
+                for name in sorted(filenames):
+                    if not name.endswith(".py"):
+                        continue
+                    path = os.path.join(dirpath, name)
+                    if path in seen:
+                        continue
+                    seen.add(path)
+                    yield self.get(path)
+
+
+def run_checkers(cache: ModuleCache, roots: Iterable[str],
+                 checkers: Iterable[Checker]) -> List[Finding]:
+    """All non-suppressed findings over `roots`, sorted for stable
+    output.  A file that fails to parse yields one `parse-error`
+    finding instead of crashing the driver."""
+    checkers = list(checkers)
+    findings: List[Finding] = []
+    for mod in cache.walk(roots):
+        if mod.parse_error is not None:
+            findings.append(Finding(
+                "parse-error", mod.relpath,
+                mod.parse_error.lineno or 0,
+                f"file does not parse: {mod.parse_error.msg}"))
+            continue
+        for checker in checkers:
+            for f in checker.run(mod):
+                if not mod.suppressed(f.line, checker.name):
+                    findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+# ------------------------------------------------------------- baseline
+
+
+def load_baseline(path: str,
+                  allow_placeholder: bool = False) -> List[dict]:
+    """Entries: {"checker", "path", "code", "reason"} — `reason` is
+    mandatory (the debt must be justified, not just parked).
+    `allow_placeholder` tolerates the `--update-baseline` "TODO"
+    reasons so that command can re-read (and rewrite) its own
+    output; `--check` never sets it."""
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        entries = json.load(f)
+    for i, e in enumerate(entries):
+        for key in ("checker", "path", "code", "reason"):
+            if not str(e.get(key, "")).strip():
+                raise ValueError(
+                    f"baseline entry {i} missing non-empty {key!r}: {e}")
+        if not allow_placeholder and \
+                str(e["reason"]).strip().upper().startswith("TODO"):
+            raise ValueError(
+                f"baseline entry {i} still carries the --update-"
+                f"baseline placeholder reason — write the actual "
+                f"justification: {e}")
+    return entries
+
+
+def split_baselined(findings: List[Finding], baseline: List[dict],
+                    checker_names: Optional[Iterable[str]] = None,
+                    roots: Optional[Iterable[str]] = None,
+                    repo_root: Optional[str] = None
+                    ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(new, baselined, stale_baseline_entries).  Matching is by
+    (checker, path, stripped source line) so entries survive line
+    shifts; a baseline entry may match several identical lines.
+
+    Staleness is only decidable for entries the run could have
+    re-found: on a scoped run (--checker / --paths), entries whose
+    checker did not run or whose path was not scanned are neither
+    matched nor stale — they are out of scope and must survive an
+    --update-baseline untouched."""
+    index = {(e["checker"], e["path"], e["code"]): e for e in baseline}
+    matched = set()
+    new, old = [], []
+    for f in findings:
+        key = f.fingerprint()
+        if key in index:
+            matched.add(key)
+            old.append(f)
+        else:
+            new.append(f)
+    names = set(checker_names) if checker_names is not None else None
+    rels = None
+    if roots is not None and repo_root is not None:
+        rels = []
+        for r in roots:
+            rel = os.path.relpath(
+                r if os.path.isabs(r) else os.path.join(repo_root, r),
+                repo_root).replace(os.sep, "/")
+            rels.append(rel)
+    stale = []
+    for e in baseline:
+        if (e["checker"], e["path"], e["code"]) in matched:
+            continue
+        if names is not None and e["checker"] not in names:
+            continue
+        if rels is not None and not any(
+                e["path"] == r or e["path"].startswith(r + "/")
+                for r in rels):
+            continue
+        stale.append(e)
+    return new, old, stale
+
+
+def baseline_entries(findings: List[Finding],
+                     reason: str = "TODO: justify") -> List[dict]:
+    """Render findings as baseline entries (the --update-baseline
+    path); dedupes identical fingerprints."""
+    out, seen = [], set()
+    for f in findings:
+        key = f.fingerprint()
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append({"checker": f.checker, "path": f.path,
+                    "code": f.code, "reason": reason})
+    return out

@@ -14,6 +14,14 @@ serf counters), dumps the process registry, and FAILS on:
   * invalid prometheus exposition — duplicate `# TYPE` blocks (the
     sanitize-collision regression this PR fixed).
 
+The audit logic itself lives in the invariant-lint framework
+(tools/lint/checkers/metric_names.py) next to its static sibling:
+the `metric-names` checker catches literal-name violations at the
+source line, while this dynamic run validates what a LIVE registry
+accumulated (computed names, runtime label sets, exposition output).
+This shim keeps the CLI and re-exports audit_names /
+audit_cardinality / audit_prometheus for the tier-1 tests.
+
 Usage: JAX_PLATFORMS=cpu python tools/metrics_audit.py
 Exit 0 = clean; 1 = violations (printed one per line).
 """
@@ -21,70 +29,16 @@ Exit 0 = clean; 1 = violations (printed one per line).
 from __future__ import annotations
 
 import os
-import re
 import sys
 import time
-from typing import List
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-NAME_RE = re.compile(r"^consul(\.[A-Za-z0-9_-]+)+$")
-MAX_LABEL_SETS = 64
-MAX_LABELS_PER_METRIC = 8
-
-
-def audit_names(dump: dict) -> List[str]:
-    """Naming-convention violations in a Registry.dump()."""
-    out = []
-    for section in ("Counters", "Gauges", "Samples"):
-        for row in dump.get(section, []):
-            name = row.get("Name", "")
-            if not NAME_RE.match(name):
-                out.append(f"bad metric name ({section.lower()}): "
-                           f"{name!r} does not match {NAME_RE.pattern}")
-    return out
-
-
-def audit_cardinality(dump: dict,
-                      max_sets: int = MAX_LABEL_SETS) -> List[str]:
-    """Label-cardinality violations: distinct label sets per name."""
-    sets: dict = {}
-    out = []
-    for section in ("Counters", "Gauges", "Samples"):
-        for row in dump.get(section, []):
-            labels = row.get("Labels") or {}
-            if len(labels) > MAX_LABELS_PER_METRIC:
-                out.append(f"too many labels on {row['Name']!r}: "
-                           f"{len(labels)} > {MAX_LABELS_PER_METRIC}")
-            key = (section, row["Name"])
-            sets.setdefault(key, set()).add(
-                tuple(sorted(labels.items())))
-    for (section, name), variants in sorted(sets.items()):
-        if len(variants) > max_sets:
-            out.append(f"unbounded label cardinality on {name!r}: "
-                       f"{len(variants)} label sets > {max_sets}")
-    return out
-
-
-def audit_prometheus(text: str) -> List[str]:
-    """Exposition-format violations: duplicate # TYPE blocks."""
-    seen: dict = {}
-    out = []
-    for line in text.splitlines():
-        if not line.startswith("# TYPE "):
-            continue
-        _, _, rest = line.partition("# TYPE ")
-        parts = rest.split()
-        if len(parts) != 2:
-            out.append(f"malformed TYPE line: {line!r}")
-            continue
-        name, kind = parts
-        if name in seen:
-            out.append(f"duplicate # TYPE block for {name!r} "
-                       f"({seen[name]} then {kind})")
-        seen[name] = kind
-    return out
+from lint.checkers.metric_names import (  # noqa: E402,F401
+    MAX_LABEL_SETS, MAX_LABELS_PER_METRIC, NAME_RE, audit_cardinality,
+    audit_names, audit_prometheus)
 
 
 def _exercise() -> None:

@@ -1,24 +1,26 @@
-"""Storage-seam audit: fail if consul_tpu/ code performs durability
-I/O behind the nemesis's back (ISSUE 4 satellite; metrics_audit.py
-style).
+"""Storage-seam audit — thin CLI shim over the invariant linter.
 
-`os.fsync` and `os.replace` are the two calls that decide what
-survives a crash.  Every one of them must route through the
-`consul_tpu/storage.py` seam — an I/O call outside the seam is one
-chaos.FaultyStorage cannot intercept, which means a durability
-boundary tools/crash_matrix.py cannot enumerate and nobody has proven
-recoverable.
+The actual analysis moved into the lint framework as the
+`storage-seam` checker (tools/lint/checkers/storage_seam.py, AST-
+based — it also catches `from os import fsync/replace` aliasing the
+old regex could not see).  This shim keeps the historical CLI and the
+`audit()` / `PKG` / `ALLOWED` surface that tests monkeypatch
+(tests/test_storage_nemesis.py).
 
-Usage: python tools/storage_audit.py
+Usage: python tools/storage_audit.py        (or: tools/lint.py
+       --checker storage-seam --check)
 Exit 0 = clean; 1 = violations (printed one per line).
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 from typing import List
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from lint.checkers.storage_seam import scan_tree  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "consul_tpu")
@@ -26,29 +28,9 @@ PKG = os.path.join(REPO, "consul_tpu")
 # the seam itself is the single allowed caller
 ALLOWED = {os.path.join("consul_tpu", "storage.py")}
 
-CALL_RE = re.compile(r"\bos\s*\.\s*(fsync|replace)\s*\(")
-
 
 def audit() -> List[str]:
-    out = []
-    for root, _dirs, files in os.walk(PKG):
-        for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            rel = os.path.relpath(path, REPO)
-            if rel in ALLOWED:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    stripped = line.split("#", 1)[0]
-                    m = CALL_RE.search(stripped)
-                    if m:
-                        out.append(
-                            f"{rel}:{lineno}: os.{m.group(1)} outside "
-                            f"the storage seam (route it through "
-                            f"consul_tpu/storage.py)")
-    return out
+    return scan_tree(PKG, REPO, allowed=ALLOWED)
 
 
 def main() -> int:
