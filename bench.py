@@ -51,22 +51,37 @@ def enable_compilation_cache():
 
 def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
                     victim: int = VICTIM, max_ticks: int = 1200,
-                    seed: int = 7) -> dict:
+                    seed: int = 7, mesh=None) -> dict:
     """The north-star pipeline, parameterized by pool size: warm scan +
     compile of the exact timed shape, kill, timed drain to >99.9%
     believed-down, accuracy accounting.  main() runs it at 1M on the
     chip; tools/bench_guard.py --check runs THIS SAME code CPU-scaled —
-    the CI smoke must never drift from the pipeline it gates."""
+    the CI smoke must never drift from the pipeline it gates.
+
+    `mesh` shards the node axis over a jax.sharding.Mesh
+    (parallel/mesh.py): the donated scan compiles once with the
+    sharding threaded through the jit, cross-shard rumor/probe traffic
+    rides GSPMD collectives, and the state stays sharded for the whole
+    drain (asserted)."""
     params = serf.make_params(GossipConfig.lan(),
                               SimConfig(n_nodes=n_nodes, rumor_slots=32,
                                         alloc_cap=8, p_loss=0.01,
-                                        seed=seed))
+                                        seed=seed,
+                                        shard_blocks=(mesh.size
+                                                      if mesh is not None
+                                                      else 1)))
     s = serf.init_state(params)
+    out_shardings = None
+    if mesh is not None:
+        from consul_tpu.parallel import mesh as meshlib
+        sharding = meshlib.state_sharding(s, mesh)
+        s = jax.device_put(s, sharding)
+        out_shardings = (sharding, None)
     # donate the state carry: the ~dozen [N]-shaped (and [N, U]-shaped)
     # state arrays update in place across scan calls instead of
     # double-buffering 1M-row tensors in HBM
     run = jax.jit(serf.run, static_argnums=(0, 2, 3),
-                  donate_argnums=donation(1))
+                  donate_argnums=donation(1), out_shardings=out_shardings)
 
     # warm start: steady-state gossip + compile the exact timed shape.
     # HARD sync via host transfer — block_until_ready through the remote
@@ -98,6 +113,10 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     # window silently included an XLA compile
     compiles = int(run._cache_size()) if hasattr(run, "_cache_size") \
         else None
+    if mesh is not None:
+        from consul_tpu.parallel import mesh as meshlib
+        meshlib.assert_node_sharded(s.swim.know, mesh.size,
+                                    "knowledge matrix after drain")
 
     ok = frac > 0.999
     # detection accuracy at the measured end state: recall = the victim
@@ -111,7 +130,16 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     f1 = 2 * precision * tp / max(precision + tp, 1e-9)
     return {"params": params, "state": s, "wall": wall, "frac": frac,
             "ticks": ticks, "converged": ok, "f1": f1,
-            "false_commits": false_commits, "compiles": compiles}
+            "false_commits": false_commits, "compiles": compiles,
+            # topology stamp: every bench artifact records WHERE the
+            # number came from, so the guard can refuse to gate
+            # CPU-scaled medians against chip baselines (the exact
+            # confusion PROFILE_r06.json documents) instead of
+            # silently comparing across machines
+            "topology": {"backend": jax.default_backend(),
+                         "devices": mesh.size if mesh is not None else 1,
+                         "mesh_shape": dict(mesh.shape)
+                         if mesh is not None else None}}
 
 
 def main():
@@ -134,6 +162,7 @@ def main():
         "f1": round(r["f1"], 4),
         "false_commits": r["false_commits"],
         "compiles": r["compiles"],
+        "topology": r["topology"],
         "sim_counters": sim_counters,
     }))
     if not r["converged"]:

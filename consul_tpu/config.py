@@ -106,6 +106,14 @@ class SimConfig:
     rtt_spread_ms: float = 30.0    # scale of the coordinate space (ms)
     coord_dims: int = 2            # ground-truth latency-space dims
     seed: int = 0
+    # node-axis shard count the ring-exchange lowering should assume
+    # (ops/rolls.py): set to the mesh device count when the pool shards
+    # over a jax.sharding.Mesh so cross-shard ring traffic lowers to
+    # static collective-permutes instead of a full all-gather of the
+    # doubled buffer.  PURE LOWERING HINT — results are bit-identical
+    # for any value (tests/test_sharding.py equivalence); 1 = the
+    # single-device doubled-buffer fast path.  Must divide n_nodes.
+    shard_blocks: int = 1
     # nemesis hooks (consul_tpu/chaos.py): compiles the per-node
     # partition-group and delivery-rate masks into the tick so a
     # host-side fault schedule can evolve them BETWEEN device scans

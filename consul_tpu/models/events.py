@@ -44,6 +44,8 @@ class EventParams:
     expiry_ticks: int = 64
     p_loss: float = 0.0
     seed: int = 0
+    # ring-exchange lowering hint (ops/rolls.py; see SimConfig)
+    shard_blocks: int = 1
 
 
 def make_params(gossip: GossipConfig, sim: SimConfig,
@@ -58,6 +60,7 @@ def make_params(gossip: GossipConfig, sim: SimConfig,
         retransmit_limit=gossip.retransmit_limit(sim.n_nodes),
         expiry_ticks=spread,
         seed=sim.seed ^ 0xE7E7,
+        shard_blocks=sim.shard_blocks,
     )
 
 
@@ -144,7 +147,8 @@ def step(params: EventParams, s: EventState, up: jnp.ndarray,
                                          params.retransmit_limit, 127),
                                      p_loss=params.p_loss,
                                      key=prng.tick_key(params.seed,
-                                                       s.tick, 6))
+                                                       s.tick, 6),
+                                     blocks=params.shard_blocks)
         deliver_tick = jnp.where(res.newly, s.tick, s.deliver_tick)
         # Lamport witness: clock jumps past the max ltime delivered this tick
         seen = jnp.where(res.newly, s.e_ltime[None, :], 0)

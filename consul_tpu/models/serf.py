@@ -44,7 +44,8 @@ def make_params(gossip: GossipConfig | None = None,
     return SerfParams(
         swim=swim.make_params(gossip, sim),
         vivaldi=vivaldi.VivaldiParams(n_nodes=sim.n_nodes, dims=coord_dims,
-                                      seed=sim.seed),
+                                      seed=sim.seed,
+                                      shard_blocks=sim.shard_blocks),
         events=events.make_params(gossip, sim, event_slots),
     )
 
@@ -86,6 +87,43 @@ def metrics_vector(params: SerfParams, s: ClusterState) -> jnp.ndarray:
     """Device-side telemetry for the whole pool (swim.METRIC_NAMES
     order) — the consul.serf.* gauge source, one transfer per scrape."""
     return swim.metrics_vector(params.swim, s.swim)
+
+
+def status_vector(params: SerfParams, s: ClusterState) -> jnp.ndarray:
+    """[N] int8 member status (swim.STATUS_*) — stays on device."""
+    return swim.status_vector(params.swim, s.swim)
+
+
+def membership_counts(params: SerfParams, s: ClusterState,
+                      provisioned: jnp.ndarray) -> jnp.ndarray:
+    return swim.membership_counts(params.swim, s.swim, provisioned)
+
+
+def membership_page(params: SerfParams, s: ClusterState, ids: jnp.ndarray):
+    return swim.membership_page(params.swim, s.swim, ids)
+
+
+def membership_delta(params: SerfParams, s: ClusterState,
+                     prev_status: jnp.ndarray, provisioned: jnp.ndarray,
+                     k: int):
+    return swim.membership_delta(params.swim, s.swim, prev_status,
+                                 provisioned, k)
+
+
+def rtt_order(params: SerfParams, s: ClusterState, origin: jnp.ndarray,
+              ids: jnp.ndarray, valid: jnp.ndarray) -> jnp.ndarray:
+    """?near= ordering computed ON DEVICE (agent/consul/rtt.go:196 /
+    lib/rtt.go:13-43 semantics): distances from `origin` to `ids`
+    ([K] int32, `valid` masks padding), invalid rows sort last.
+    Returns the [K] argsort — the only transfer is O(K) indices, never
+    the [N, D] coordinate tensor."""
+    c = s.coords
+    diff = c.coords[ids] - c.coords[origin]
+    d = jnp.linalg.norm(diff, axis=-1) + c.height[ids] + c.height[origin]
+    adjusted = d + c.adjustment[ids] + c.adjustment[origin]
+    dist = jnp.where(adjusted > 0.0, adjusted, d)
+    dist = jnp.where(valid, dist, jnp.inf)
+    return jnp.argsort(dist, stable=True)
 
 
 def fire_event(params: SerfParams, s: ClusterState, origin: int,

@@ -162,10 +162,17 @@ class SwimParams:
     # the per-node chaos_grp / chaos_ok state fields on every leg.
     # Static so the default (False) build keeps the hot path untouched.
     chaos: bool = False
+    # ring-exchange lowering hint (ops/rolls.py): node-axis shard count
+    # so cross-shard ring traffic lowers to static collective-permutes.
+    # Results are identical for any value; 1 = single-device fast path.
+    shard_blocks: int = 1
 
 
 def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
     n = sim.n_nodes
+    if sim.shard_blocks > 1 and n % sim.shard_blocks:
+        raise ValueError(f"shard_blocks={sim.shard_blocks} must divide "
+                         f"n_nodes={n}")
     # int8 retransmit budget: the log-scaled limit is ~28 at 1M nodes
     limit = min(gossip.retransmit_limit(n), 127)
     # A rumor is fully disseminated within ~O(log N) gossip ticks; keep the
@@ -206,6 +213,7 @@ def make_params(gossip: GossipConfig, sim: SimConfig) -> SwimParams:
         degraded_loss=sim.degraded_loss,
         seed=sim.seed,
         chaos=sim.chaos,
+        shard_blocks=sim.shard_blocks,
     )
 
 
@@ -492,28 +500,28 @@ def _believes_down_shift(params: SwimParams, s: SwimState, maps,
     """
     suspect_of, dead_of, left_of, alive_val = maps
     u = params.rumor_slots
-    down = rolls.pull(s.committed_dead | s.committed_left, shift)
-    down |= _row_gather(s.know, rolls.pull(dead_of, shift))
-    down |= _row_gather(s.know, rolls.pull(left_of, shift))
+    down = rolls.pull(s.committed_dead | s.committed_left, shift, blocks=params.shard_blocks)
+    down |= _row_gather(s.know, rolls.pull(dead_of, shift, blocks=params.shard_blocks))
+    down |= _row_gather(s.know, rolls.pull(left_of, shift, blocks=params.shard_blocks))
     # expired unrefuted suspicion
-    ss = rolls.pull(suspect_of, shift)
+    ss = rolls.pull(suspect_of, shift, blocks=params.shard_blocks)
     know_s = _row_gather(s.know, ss)
     learn = _row_gather(s.learn_tick, ss)
     conf = _table_lookup(s.r_confirm, ss)
     expired = know_s & (_age(tick, learn)
                         >= _t16(_suspicion_timeout_ticks(params, conf)))
-    av = rolls.pull(alive_val, shift)
+    av = rolls.pull(alive_val, shift, blocks=params.shard_blocks)
     a_slot = jnp.where(av >= 0, av % u, -1)
     a_inc = jnp.where(av >= 0, av // u, -1)
     s_inc = _table_lookup(s.r_inc, ss)
     refuted = (av >= 0) & (a_inc > s_inc) & _row_gather(s.know, a_slot)
-    refuted |= s_inc < rolls.pull(s.committed_inc, shift)
+    refuted |= s_inc < rolls.pull(s.committed_inc, shift, blocks=params.shard_blocks)
     down |= expired & ~refuted
     # bulk-channel subjects are past their suspicion timeout and
     # awaiting only dissemination — probers skip them (memberlist nodes
     # that marked X dead stop probing X; here the skip is global one
     # detection-latency ahead of per-observer hearing, documented)
-    down |= rolls.pull(s.bulk_member, shift)
+    down |= rolls.pull(s.bulk_member, shift, blocks=params.shard_blocks)
     return down
 
 
@@ -552,6 +560,42 @@ def believed_down_fraction(params: SwimParams, s: SwimState, subject: int) -> jn
 # ---------------------------------------------------------------------------
 # rumor allocation / origination
 # ---------------------------------------------------------------------------
+
+def _top_k_sharded(x: jnp.ndarray, k: int, blocks: int):
+    """lax.top_k over a node-sharded [N] vector without a full gather:
+    per-block top-k (local to each shard), then top-k over the tiny
+    [blocks * k] candidate set (replicated).  RESULT-identical to flat
+    lax.top_k for any `blocks` — including tie-breaks: top_k prefers
+    the earlier index among equals, candidates are emitted in global
+    index order within each value, and a candidate-position tie-break
+    therefore picks the same global index the flat sort would."""
+    n = x.shape[0]
+    if blocks <= 1 or n % blocks or k > n // blocks:
+        return jax.lax.top_k(x, k)
+    ell = n // blocks
+    xb = x.reshape(blocks, ell)
+    # per-block selection by k rounds of (max, argmax, one-hot mask):
+    # row-wise reductions and elementwise selects partition cleanly
+    # where lax.top_k's sort lowering all-gathers its index operand
+    lo = jnp.iinfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.integer) \
+        else jnp.finfo(x.dtype).min
+    cols = jnp.arange(ell, dtype=jnp.int32)[None, :]
+    vs, is_ = [], []
+    cur = xb
+    for _ in range(k):
+        v = jnp.max(cur, axis=1)                         # [B]
+        i = jnp.argmax(cur, axis=1).astype(jnp.int32)    # first max
+        vs.append(v)
+        is_.append(i)
+        cur = jnp.where(cols == i[:, None], lo, cur)
+    v = jnp.stack(vs, axis=1)                            # [B, k]
+    gi = jnp.stack(is_, axis=1) \
+        + (jnp.arange(blocks, dtype=jnp.int32) * ell)[:, None]
+    # candidate round over the tiny replicated [B * k] set; ties keep
+    # global index order because candidates are emitted block-major
+    v2, j = jax.lax.top_k(v.reshape(-1), k)
+    return v2, gi.reshape(-1)[j]
+
 
 def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
                kind: int, inc_of_subject: jnp.ndarray,
@@ -593,7 +637,7 @@ def _originate(params: SwimParams, s: SwimState, want_score: jnp.ndarray,
         return _release(st, done, coverage)
 
     s = jax.lax.cond(demand > free, evict, lambda st: st, s)
-    score, subjects = jax.lax.top_k(want_score, a)
+    score, subjects = _top_k_sharded(want_score, a, params.shard_blocks)
     free_score, slots = jax.lax.top_k(jnp.where(s.r_active, 0, 1) *
                                       (u - jnp.arange(u, dtype=jnp.int32)), a)
     ok = (score > 0) & (free_score > 0)
@@ -679,7 +723,7 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
         lha_go = jnp.ones((n,), bool)
     prober = live & lha_go
     skip = _believes_down_shift(params, s, maps, d, tick)
-    t_up = rolls.pull(live, d)
+    t_up = rolls.pull(live, d, blocks=params.shard_blocks)
 
     # per-node leg delivery rate: a degraded node (Lifeguard's bad-NIC
     # scenario) loses each of ITS legs at degraded_loss; a leg between
@@ -700,13 +744,13 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
         # exists between same-group endpoints)
         ok_node = ok_node * s.chaos_ok
         grp = s.chaos_grp
-        same_t = grp == rolls.pull(grp, d)          # origin <-> target
+        same_t = grp == rolls.pull(grp, d, blocks=params.shard_blocks)          # origin <-> target
 
     # direct probe: two UDP legs + RTT under the (LHA-scaled) timeout
-    rtt = jnp.linalg.norm(s.coords - rolls.pull(s.coords, d), axis=-1) \
+    rtt = jnp.linalg.norm(s.coords - rolls.pull(s.coords, d, blocks=params.shard_blocks), axis=-1) \
         + params.rtt_base_ms
     rtt = rtt * (1.0 + jax.random.exponential(k_rtt, (n,)) * 0.1)
-    ok_t = rolls.pull(ok_node, d)
+    ok_t = rolls.pull(ok_node, d, blocks=params.shard_blocks)
     legs_ok = jax.random.uniform(k_direct, (n,)) \
         < jnp.minimum(ok_node, ok_t) ** 2
     if params.chaos:
@@ -722,7 +766,7 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
     if params.indirect_checks > 0:
         kA, kB, kC = jax.random.split(k_leg, 3)
         shape = (n, params.indirect_checks)
-        ok_r = jnp.stack([rolls.pull(ok_node, offs[1 + k])
+        ok_r = jnp.stack([rolls.pull(ok_node, offs[1 + k], blocks=params.shard_blocks)
                           for k in range(params.indirect_checks)], axis=-1)
         uA = jax.random.uniform(kA, shape)
         uB = jax.random.uniform(kB, shape)
@@ -733,15 +777,15 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
         if params.chaos:
             # partition gating per leg: origin<->relay and
             # relay<->target must each be same-group
-            rgrp = jnp.stack([rolls.pull(grp, offs[1 + k])
+            rgrp = jnp.stack([rolls.pull(grp, offs[1 + k], blocks=params.shard_blocks)
                               for k in range(params.indirect_checks)],
                              axis=-1)
             same_r = rgrp == grp[:, None]
-            same_rt = rgrp == rolls.pull(grp, d)[:, None]
+            same_rt = rgrp == rolls.pull(grp, d, blocks=params.shard_blocks)[:, None]
             l1 &= same_r
             l4 &= same_r
             l23 &= same_rt
-        relay_ok = jnp.stack([rolls.pull(live, offs[1 + k])
+        relay_ok = jnp.stack([rolls.pull(live, offs[1 + k], blocks=params.shard_blocks)
                               for k in range(params.indirect_checks)],
                              axis=-1)
         ind_ack = relay_ok & l1 & (t_up[:, None] & l23) & l4
@@ -755,7 +799,7 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
     # not probed at all — memberlist only probes its member list; without
     # this gate a sparse pool suspects and eventually commits phantom
     # deaths for every free slot, saturating the rumor table
-    t_member = rolls.pull(s.member, d)
+    t_member = rolls.pull(s.member, d, blocks=params.shard_blocks)
     failed = prober & ~skip & ~ack & t_member
     # Lifeguard self-awareness update (memberlist probeNode): an acked
     # probe is evidence of our own health (-1); a failed probe is
@@ -780,7 +824,7 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
             params.awareness_max - 1).astype(jnp.int8))
     # per-subject suspector count: the shift is a bijection — exactly one
     # prober per subject per round (cnt in {0,1}), like memberlist's ring
-    cnt = rolls.push(failed, d).astype(jnp.int32)
+    cnt = rolls.push(failed, d, blocks=params.shard_blocks).astype(jnp.int32)
     suspect_of, dead_of, left_of, _ = maps
 
     # (a) confirm existing suspicions (Lifeguard): each independent suspector
@@ -788,7 +832,7 @@ def _probe_round(params: SwimParams, s: SwimState, maps):
     r_confirm = s.r_confirm.astype(jnp.int32) + jnp.where(
         s.r_active & (s.r_kind == SUSPECT), jnp.minimum(cnt[s.r_subject], 8), 0)
     r_confirm = jnp.minimum(r_confirm, 64).astype(jnp.int8)
-    es = rolls.pull(suspect_of, d)                              # [N] existing slot
+    es = rolls.pull(suspect_of, d, blocks=params.shard_blocks)                              # [N] existing slot
     joiner = failed & (es >= 0)
     cell = (es[:, None] == jnp.arange(params.rumor_slots)[None, :]) \
         & joiner[:, None]
@@ -978,7 +1022,7 @@ def _dense_suspicion_expiry(params: SwimParams, s: SwimState,
     # the rumor would allocate with zero live carriers and rot in its
     # slot (the subject is re-probed by a DIFFERENT ring prober next
     # round, so a dead prober only defers one round)
-    prober_live = rolls.push(s.up & s.member, shift)              # [N]
+    prober_live = rolls.push(s.up & s.member, shift, blocks=params.shard_blocks)              # [N]
     want = jnp.where(expired & (dead_of < 0) & (left_of < 0)
                      & (suspect_of < 0) & ~s.committed_dead
                      & ~s.bulk_member & prober_live, 1, 0)
@@ -986,7 +1030,7 @@ def _dense_suspicion_expiry(params: SwimParams, s: SwimState,
     # row i's probe target this round is (i+shift)%N: seed the dead
     # rumor at the prober rows whose subject wants one (pull = ring
     # rotation, no gather)
-    row_subject = jnp.where(rolls.pull(want, shift) > 0, target, -1)
+    row_subject = jnp.where(rolls.pull(want, shift, blocks=params.shard_blocks) > 0, target, -1)
     s, alloc = _originate(params, s, want, DEAD, s.incarnation,
                           row_subject)
     # overflow: expired subjects that could not win a dead slot THIS
@@ -1016,7 +1060,7 @@ def _dense_suspicion_expiry(params: SwimParams, s: SwimState,
     # committed (or a revive withdrew the last subject) the channel is
     # empty and heard counts must restart from zero.
     v_prev = jnp.sum(s.bulk_member).astype(jnp.float32)
-    seeded = rolls.pull(overflow, shift)
+    seeded = rolls.pull(overflow, shift, blocks=params.shard_blocks)
     bulk_heard = jnp.minimum(
         jnp.minimum(s.bulk_heard, v_prev) + seeded.astype(jnp.float32),
         jnp.sum(bulk_member).astype(jnp.float32))
@@ -1120,7 +1164,8 @@ def _disseminate(params: SwimParams, s: SwimState) -> SwimState:
                                  key=prng.tick_key(params.seed, tick, 5),
                                  group=s.chaos_grp if params.chaos else None,
                                  node_ok=s.chaos_ok if params.chaos
-                                 else None)
+                                 else None,
+                                 blocks=params.shard_blocks)
     learn_tick = jnp.where(res.newly, tick.astype(jnp.int16), s.learn_tick)
     # consul.serf.gossip.* device counters (memberlist gossip timer's
     # accounting): the op already computed the reductions
@@ -1172,12 +1217,12 @@ def _bulk_disseminate(params: SwimParams, s: SwimState) -> SwimState:
     supply_src = jnp.where(s.up, heard, 0.0)
     n_up = jnp.maximum(jnp.sum(s.up), 1).astype(jnp.float32)
     mean_supply = jnp.sum(supply_src) / n_up
-    views = rolls.pull_multi(supply_src, offs)     # one doubled buffer
+    views = rolls.pull_multi(supply_src, offs, blocks=params.shard_blocks)     # one doubled buffer
     if params.chaos:
         # nemesis: cross-group contacts carry nothing; degraded
         # endpoints scale the transfer by the pairwise delivery rate
-        gviews = rolls.pull_multi(s.chaos_grp, offs)
-        okviews = rolls.pull_multi(s.chaos_ok, offs)
+        gviews = rolls.pull_multi(s.chaos_grp, offs, blocks=params.shard_blocks)
+        okviews = rolls.pull_multi(s.chaos_ok, offs, blocks=params.shard_blocks)
         views = [jnp.where(gv == s.chaos_grp, v * ov * s.chaos_ok, 0.0)
                  for v, gv, ov in zip(views, gviews, okviews)]
     for view in views:
@@ -1383,6 +1428,78 @@ def metrics_vector(params: SwimParams, s: SwimState) -> jnp.ndarray:
         s.tick.astype(f32),
     ])
     return jnp.concatenate([s.ctr, gauges])
+
+
+# ---------------------------------------------------------------------------
+# oracle read path: device-side membership reductions (gather-free)
+# ---------------------------------------------------------------------------
+# The oracle must answer members()/status() against SHARDED state without
+# pulling the whole node axis to host (ROADMAP item 5's delta contract,
+# linted by gather_discipline).  Everything here is elementwise or a
+# bounded-output reduction over [N] leaves: under a node-sharded mesh the
+# [N] intermediates stay sharded and only the tiny [K]-bounded outputs
+# replicate and transfer.
+
+STATUS_ALIVE = 0
+STATUS_FAILED = 1
+STATUS_LEFT = 2
+
+
+def status_vector(params: SwimParams, s: SwimState) -> jnp.ndarray:
+    """[N] int8 serf member status (0 alive, 1 failed, 2 left), the
+    oracle's view: failed = committed dead OR an active dead rumor;
+    left = committed left OR never a member; left wins over failed
+    (serf precedence).  Stays on device — callers page or reduce it."""
+    is_dead = s.r_active & (s.r_kind == DEAD)
+    dead_rumor = jnp.zeros_like(s.committed_dead).at[
+        jnp.where(is_dead, s.r_subject, 0)].max(is_dead)
+    failed = s.committed_dead | dead_rumor
+    left = s.committed_left | ~s.member
+    return jnp.where(left, STATUS_LEFT,
+                     jnp.where(failed, STATUS_FAILED,
+                               STATUS_ALIVE)).astype(jnp.int8)
+
+
+def membership_counts(params: SwimParams, s: SwimState,
+                      provisioned: jnp.ndarray) -> jnp.ndarray:
+    """[4] int32 (alive, failed, left, total) over provisioned slots —
+    the members_summary() source: a full device-side reduction whose
+    transfer is 16 bytes regardless of N."""
+    st = status_vector(params, s)
+    i32 = jnp.int32
+    return jnp.stack([
+        jnp.sum(provisioned & (st == STATUS_ALIVE)).astype(i32),
+        jnp.sum(provisioned & (st == STATUS_FAILED)).astype(i32),
+        jnp.sum(provisioned & (st == STATUS_LEFT)).astype(i32),
+        jnp.sum(provisioned).astype(i32),
+    ])
+
+
+def membership_page(params: SwimParams, s: SwimState,
+                    ids: jnp.ndarray):
+    """Gather one page of member rows: (status, incarnation, up) at
+    `ids` ([K] int32, padded with 0 — callers mask).  Transfers O(K)."""
+    st = status_vector(params, s)
+    return st[ids], s.incarnation[ids], s.up[ids]
+
+
+def membership_delta(params: SwimParams, s: SwimState,
+                     prev_status: jnp.ndarray, provisioned: jnp.ndarray,
+                     k: int):
+    """Changed PROVISIONED members since a status checkpoint:
+    (new_status [N], n_changed scalar, idx [k] int32 padded -1,
+    state [k] int8).  Unprovisioned slots never count — a sparse pool's
+    first delta reports its members, not its empty slots.
+
+    The incremental device→control-plane seam (ROADMAP item 5): a pool
+    with F flaps since the checkpoint moves min(F, k) rows to host, not
+    a full gather — callers re-checkpoint with the returned vector and
+    fall back to paged listing when n_changed > k."""
+    st = status_vector(params, s)
+    changed = (st != prev_status) & provisioned
+    idx = jnp.where(changed, size=k, fill_value=-1)[0].astype(jnp.int32)
+    return st, jnp.sum(changed).astype(jnp.int32), idx, \
+        st[jnp.maximum(idx, 0)]
 
 
 # ---------------------------------------------------------------------------

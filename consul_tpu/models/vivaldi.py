@@ -44,6 +44,8 @@ class VivaldiParams:
     height_min: float = 10.0e-6       # seconds
     gravity_rho: float = 150.0        # pull toward origin per second of radius
     seed: int = 0
+    # ring-exchange lowering hint (ops/rolls.py; see SimConfig)
+    shard_blocks: int = 1
 
 
 @struct.dataclass
@@ -165,9 +167,9 @@ def observe_ring(params: VivaldiParams, s: VivaldiState, shift,
     n = s.coords.shape[0]
     rtt = jnp.maximum(rtt, 1.0e-6)
     ci, hi, ei = s.coords, s.height, s.error
-    cj = rolls.pull(s.coords, shift)
-    hj = rolls.pull(s.height, shift)
-    ej = rolls.pull(s.error, shift)
+    cj = rolls.pull(s.coords, shift, blocks=params.shard_blocks)
+    hj = rolls.pull(s.height, shift, blocks=params.shard_blocks)
+    ej = rolls.pull(s.error, shift, blocks=params.shard_blocks)
 
     diff = ci - cj
     norm = jnp.linalg.norm(diff, axis=-1)

@@ -44,11 +44,18 @@ class WanParams:
 def make_params(n_dcs: int = 3, nodes_per_dc: int = 1024,
                 servers_per_dc: int = 5, p_loss: float = 0.01,
                 seed: int = 0, rumor_slots: int = 16,
-                event_slots: int = 16) -> WanParams:
+                event_slots: int = 16,
+                shard_blocks: int = 1) -> WanParams:
+    # `shard_blocks` = per-DC node-axis shard count under a 2-D
+    # make_wan_mesh (devices / n_dcs): threads the ops/rolls.py
+    # ring-collective lowering hint into every LAN pool so its pulls
+    # never all-gather the [N, ...] leaves (pure lowering hint, results
+    # identical — see SimConfig).  The WAN pool stays at 1: its
+    # [D*S]-sized buffers are tiny and the doubled-buffer path is fine.
     lan = serf.make_params(
         GossipConfig.lan(),
         SimConfig(n_nodes=nodes_per_dc, rumor_slots=rumor_slots,
-                  p_loss=p_loss, seed=seed),
+                  p_loss=p_loss, seed=seed, shard_blocks=shard_blocks),
         event_slots=event_slots)
     wan = serf.make_params(
         GossipConfig.wan(),
@@ -124,50 +131,75 @@ def step(params: WanParams, s: WanState) -> WanState:
 
 
 def _bridge_events(params: WanParams, s: WanState) -> WanState:
+    """Sharding-safe bridge: under the 2-D dc x nodes mesh
+    (parallel/mesh.make_wan_mesh) the batched LAN leaves must never be
+    sliced at a dc index and restacked — GSPMD lowers that
+    slice/where/stack round-trip of a sharded batch axis to unreduced
+    partial sums (observed: tick multiplied by the nodes-axis replica
+    count every step).  Instead the per-DC decisions are computed from
+    small REPLICATED tables (wan_state_sharding keeps every [D, small]
+    leaf replicated) plus mask-based reductions over the sharded node
+    axis, and the one write into the big [D, N, E] leaves goes through
+    a vmapped `events.fire` — the same batched formulation as the
+    vmapped `serf.step`, which GSPMD partitions correctly."""
     d, sp = params.n_dcs, params.servers_per_dc
     lan_ev, wan_ev = s.lan.events, s.wan.events
     bridged, bridged_ptr = s.bridged, s.bridged_ptr
 
-    # ---- LAN -> WAN: a server that knows a local event injects it
+    # batched server views via row masks — no slicing of the (possibly
+    # node-sharded) row axis; reductions over it lower to all-reduces
+    srv = jnp.arange(params.lan.events.n_nodes) < sp            # [N]
+    served = jnp.any(lan_ev.know & srv[None, :, None], axis=1)  # [D, E]
+    srv_any = jnp.any(lan_ev.know, axis=2) & srv[None, :]       # [D, N]
+    # first server row that knows any event (0 when none, like the
+    # original argmax over an all-False server slice)
+    lan_origin = jnp.argmax(srv_any, axis=1).astype(jnp.int32)  # [D]
+
+    # ---- LAN -> WAN: a server that knows a local event injects it.
+    # Sequential over dc by design (each injection changes the WAN
+    # candidate set the next dc checks); everything touched is a small
+    # replicated table, so the python loop stays GSPMD-local.
     for dc in range(d):
-        ev = jax.tree_util.tree_map(lambda x: x[dc], lan_ev)
-        served = jnp.any(ev.know[:sp, :], axis=0)          # [E] some server knows
         found, slot = _first_active_candidate(
-            ev.e_active, served, ev.e_id,
+            lan_ev.e_active[dc], served[dc], lan_ev.e_id[dc],
             _active_ids(wan_ev.e_active, wan_ev.e_id), bridged[dc])
-        origin_server = dc * sp + jnp.argmax(
-            jnp.any(ev.know[:sp, :], axis=1))
+        eid = lan_ev.e_id[dc, slot]
+        origin_server = dc * sp + lan_origin[dc]
         wan_ev = jax.tree_util.tree_map(
             lambda new, old: jnp.where(found, new, old),
-            events.fire(params.wan.events, wan_ev, origin_server,
-                        ev.e_id[slot]),
+            events.fire(params.wan.events, wan_ev, origin_server, eid),
             wan_ev)
-        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc],
-                              ev.e_id[slot], found)
+        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc], eid, found)
         bridged = bridged.at[dc].set(row)
         bridged_ptr = bridged_ptr.at[dc].set(ptr)
 
-    # ---- WAN -> LAN: a server that knows a WAN event fires it locally
-    new_lan_ev = []
+    # ---- WAN -> LAN: a server that knows a WAN event fires it locally.
+    # Decisions first (small replicated wan tables), then ONE vmapped
+    # fire applies every DC's write to the batched lan events tree.
+    founds, eids, origins = [], [], []
     for dc in range(d):
-        ev = jax.tree_util.tree_map(lambda x: x[dc], lan_ev)
         my_servers = wan_ev.know[dc * sp:(dc + 1) * sp, :]  # [S, E]
         known_here = jnp.any(my_servers, axis=0)            # [E]
         found, slot = _first_active_candidate(
             wan_ev.e_active, known_here, wan_ev.e_id,
-            _active_ids(ev.e_active, ev.e_id), bridged[dc])
-        local_origin = jnp.argmax(jnp.any(my_servers, axis=1))
-        fired = events.fire(params.lan.events, ev, local_origin,
-                            wan_ev.e_id[slot])
-        new_lan_ev.append(jax.tree_util.tree_map(
-            lambda new, old: jnp.where(found, new, old), fired, ev))
-        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc],
-                              wan_ev.e_id[slot], found)
+            _active_ids(lan_ev.e_active[dc], lan_ev.e_id[dc]),
+            bridged[dc])
+        eid = wan_ev.e_id[slot]
+        founds.append(found)
+        eids.append(eid)
+        origins.append(jnp.argmax(jnp.any(my_servers, axis=1))
+                       .astype(jnp.int32))
+        row, ptr = _ring_push(bridged[dc], bridged_ptr[dc], eid, found)
         bridged = bridged.at[dc].set(row)
         bridged_ptr = bridged_ptr.at[dc].set(ptr)
 
-    lan_ev = jax.tree_util.tree_map(
-        lambda *xs: jnp.stack(xs, axis=0), *new_lan_ev)
+    def apply_fire(ev, found, origin, eid):
+        fired = events.fire(params.lan.events, ev, origin, eid)
+        return jax.tree_util.tree_map(
+            lambda new, old: jnp.where(found, new, old), fired, ev)
+
+    lan_ev = jax.vmap(apply_fire)(lan_ev, jnp.stack(founds),
+                                  jnp.stack(origins), jnp.stack(eids))
     return s.replace(lan=s.lan.replace(events=lan_ev),
                      wan=s.wan.replace(events=wan_ev),
                      bridged=bridged, bridged_ptr=bridged_ptr)

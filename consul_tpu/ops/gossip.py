@@ -49,7 +49,8 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
                 p_loss: float = 0.0,
                 key: Optional[jnp.ndarray] = None,
                 group: Optional[jnp.ndarray] = None,
-                node_ok: Optional[jnp.ndarray] = None) -> GossipResult:
+                node_ok: Optional[jnp.ndarray] = None,
+                blocks: int = 1) -> GossipResult:
     """One fanout round.
 
     offsets: [G] int32 ring offsets shared by all nodes this tick (node i
@@ -70,7 +71,7 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
     """
     fanout = offsets.shape[0]
     serve = know & (sends_left > 0) & sender_ok[:, None]         # [N, S]
-    views = rolls.pull_multi(serve, offsets)
+    views = rolls.pull_multi(serve, offsets, blocks=blocks)
     # per-carrier queued-cell count, reduced ONCE and rotated as a 1-D
     # vector where per-contact accounting needs it — per-view [N, S]
     # reductions measurably broke the slice+mask fusion (~35%/tick).
@@ -83,16 +84,16 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
         n = know.shape[0]
         p_ok = jnp.full((n, fanout), 1.0 - p_loss, jnp.float32)
         if node_ok is not None:
-            senders = jnp.stack(rolls.pull_multi(node_ok, offsets),
+            senders = jnp.stack(rolls.pull_multi(node_ok, offsets, blocks=blocks),
                                 axis=1)                          # [N, G]
             p_ok = p_ok * node_ok[:, None] * senders
         ok = jax.random.uniform(key, (n, fanout)) < p_ok
         if group is not None:
-            gviews = jnp.stack(rolls.pull_multi(group, offsets), axis=1)
+            gviews = jnp.stack(rolls.pull_multi(group, offsets, blocks=blocks), axis=1)
             # a severed link is a partition, not loss: it neither
             # delivers nor counts against the loss telemetry
             ok &= gviews == group[:, None]
-        carried = jnp.stack(rolls.pull_multi(cells, offsets), axis=1)
+        carried = jnp.stack(rolls.pull_multi(cells, offsets, blocks=blocks), axis=1)
         if group is not None:
             carried = jnp.where(gviews == group[:, None], carried, 0.0)
         lost = jnp.sum(jnp.where(ok, 0.0, carried))
@@ -104,7 +105,7 @@ def disseminate(offsets: jnp.ndarray, know: jnp.ndarray,
         # of each dropped contact (a lost packet from a sender with
         # nothing queued never held gossip — counting it would make
         # lost incomparable to served in sparse/half-dead pools)
-        carried = jnp.stack(rolls.pull_multi(cells, offsets), axis=1)
+        carried = jnp.stack(rolls.pull_multi(cells, offsets, blocks=blocks), axis=1)
         lost = jnp.sum(jnp.where(ok, 0.0, carried))
         views = [v & ok[:, g:g + 1] for g, v in enumerate(views)]
     got = views[0]

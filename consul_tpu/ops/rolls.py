@@ -27,28 +27,61 @@ def offsets(key, n: int, k: int) -> jnp.ndarray:
     return jax.random.randint(key, (k,), 1, n, dtype=jnp.int32)
 
 
-def pull_multi(mat: jnp.ndarray, offsets) -> list:
-    """k ring views sharing ONE doubled buffer: out[g][i] =
-    mat[(i + offsets[g]) % N].  Offsets may be traced.  Lowers to
-    dynamic slices over the doubled buffer — sequential HBM traffic, no
-    gather (and one copy of the lowering for every caller)."""
+def pull_multi(mat: jnp.ndarray, offsets, blocks: int = 1) -> list:
+    """k ring views: out[g][i] = mat[(i + offsets[g]) % N].  Offsets may
+    be traced.  `blocks` is a LOWERING hint, never a semantic one — the
+    result is the exact rotation for any value (so a sharded run's
+    trajectory is bit-identical to single-device; tests/test_sharding).
+
+    blocks == 1 (single device): dynamic slices over one doubled
+    buffer shared by every view — sequential HBM traffic, no gather.
+
+    blocks == device count (node axis sharded over a mesh): the naive
+    doubled-buffer slice at a TRACED offset makes GSPMD all-gather the
+    whole [2N, ...] buffer onto every device (the slice window spans
+    every shard).  Instead the rotation is decomposed as
+    d = s * L + r (L = N / blocks): the block-level rotation by s runs
+    as log2(blocks) STATIC rolls on the sharded axis (each a
+    collective-permute of the local shard, selected by s's bits), and
+    the residual r becomes a dynamic slice along the UNSHARDED axis of
+    a [blocks, 2L, ...] per-block doubled buffer — so cross-shard
+    rumor/probe traffic lowers to neighbor collectives and per-device
+    traffic stays O(L log blocks), never O(N)."""
     n = mat.shape[0]
-    doubled = jnp.concatenate([mat, mat], axis=0)
+    if blocks <= 1 or n % blocks:
+        doubled = jnp.concatenate([mat, mat], axis=0)
+        return [jax.lax.dynamic_slice_in_dim(
+            doubled, jnp.asarray(offsets[g], jnp.int32) % n, n, axis=0)
+            for g in range(len(offsets))]
+    ell = n // blocks
+    m = mat.reshape((blocks, ell) + mat.shape[1:])
     out = []
     for g in range(len(offsets)):
         d = jnp.asarray(offsets[g], jnp.int32) % n
-        out.append(jax.lax.dynamic_slice_in_dim(doubled, d, n, axis=0))
+        s, r = d // ell, d % ell
+        rot = m
+        step = 1
+        while step < blocks:
+            shifted = jnp.roll(rot, -step, axis=0)   # static: ppermute
+            rot = jnp.where((s // step) % 2 == 1, shifted, rot)
+            step *= 2
+        # out[a, p] = m[a+s, p+r] while p+r < L, else m[a+s+1, p+r-L]:
+        # pair each block with its successor and slice locally at r
+        nxt = jnp.roll(rot, -1, axis=0)
+        doubled = jnp.concatenate([rot, nxt], axis=1)
+        out.append(jax.lax.dynamic_slice_in_dim(doubled, r, ell, axis=1)
+                   .reshape(mat.shape))
     return out
 
 
-def pull(mat: jnp.ndarray, d) -> jnp.ndarray:
+def pull(mat: jnp.ndarray, d, blocks: int = 1) -> jnp.ndarray:
     """Row view from each node's ring peer: out[i] = mat[(i + d) % N]."""
-    return pull_multi(mat, [d])[0]
+    return pull_multi(mat, [d], blocks=blocks)[0]
 
 
-def push(mat: jnp.ndarray, d) -> jnp.ndarray:
+def push(mat: jnp.ndarray, d, blocks: int = 1) -> jnp.ndarray:
     """Inverse view: out[j] = mat[(j - d) % N] — what node j receives when
     every node i sends to (i + d) % N."""
     n = mat.shape[0]
     d = jnp.asarray(d, jnp.int32) % n
-    return pull(mat, n - d)
+    return pull(mat, n - d, blocks=blocks)

@@ -28,15 +28,58 @@ from consul_tpu.models import events as events_model
 from consul_tpu.models import serf, swim, vivaldi
 
 
+def _to_host(x) -> np.ndarray:
+    """The oracle's ONE device→host seam.  Everything the oracle ever
+    transfers funnels through here so (a) the O(k)-transfer contract is
+    testable by spying on a single function and (b) the
+    gather_discipline checker has exactly one module to reason about —
+    every caller hands it a bounded page/summary, never a bare
+    node-axis state leaf."""
+    return np.asarray(x)
+
+
+def _bucket(k: int, n: int) -> int:
+    """Round a page size up to a power of two (min 8, capped at n): the
+    paged read path compiles one kernel per BUCKET, not per request
+    size — at most log2(N) variants ever exist (recompile hygiene).
+    The cap never drops below k: a query list may exceed the pool size
+    (sort_by_rtt over a service list with duplicate instances)."""
+    b = 8
+    while b < k:
+        b *= 2
+    if k <= n:
+        b = min(b, max(n, 1))
+    return b
+
+
 class GossipOracle:
     def __init__(self, gossip: Optional[GossipConfig] = None,
                  sim: Optional[SimConfig] = None,
-                 node_prefix: str = "node"):
+                 node_prefix: str = "node",
+                 mesh=None):
         self.gossip = gossip or GossipConfig.lan()
         self.sim = sim or SimConfig(n_nodes=64, rumor_slots=16)
+        if mesh is not None and self.sim.shard_blocks != mesh.size:
+            # wire the mesh size into the ring-exchange lowering hint
+            # (ops/rolls.py) so the oracle's own step compiles to
+            # static collective-permutes instead of all-gathering the
+            # doubled ring buffer; results are identical either way
+            import dataclasses as _dc
+            self.sim = _dc.replace(self.sim, shard_blocks=mesh.size)
         self.params = serf.make_params(self.gossip, self.sim)
         self._state = serf.init_state(self.params,
                                       n_initial=self.sim.n_initial)
+        # optional device mesh: the pool's node axis shards across it
+        # (parallel/mesh.py) and EVERY read below answers against the
+        # sharded state — the paged/summary reductions replicate only
+        # their [k]-bounded outputs, so no full node-axis gather ever
+        # happens (the contract gather_discipline lints).
+        self.mesh = mesh
+        self._sharding = None
+        if mesh is not None:
+            from consul_tpu.parallel import mesh as meshlib
+            self._sharding = meshlib.state_sharding(self._state, mesh)
+            self._state = jax.device_put(self._state, self._sharding)
         self._lock = threading.RLock()
         # deliberately NOT donate_argnums: oracle readers (members
         # snapshots, the pacer's hard_sync, metrics scrapes) hold
@@ -44,8 +87,19 @@ class GossipOracle:
         # threads; donation would delete those buffers under them.
         # The bench and the batch tools own their state exclusively and
         # DO donate (bench.py, tools/profile_swim.py).
-        self._step = jax.jit(serf.step, static_argnums=0)
+        self._step = jax.jit(serf.step, static_argnums=0,
+                             out_shardings=self._sharding)
         self._metrics_fn = jax.jit(serf.metrics_vector, static_argnums=0)
+        # gather-free read kernels (bound once — recompile hygiene):
+        # device-side reductions whose outputs are O(page), never O(N)
+        self._counts_fn = jax.jit(serf.membership_counts, static_argnums=0)
+        self._page_fn = jax.jit(serf.membership_page, static_argnums=0)
+        self._delta_fn = jax.jit(serf.membership_delta,
+                                 static_argnums=(0, 4))
+        self._rtt_order_fn = jax.jit(serf.rtt_order, static_argnums=0)
+        self._coord_row_fn = jax.jit(
+            lambda c, i: (c.coords[i], c.error[i], c.adjustment[i],
+                          c.height[i]))
         self._node_prefix = node_prefix
         self._names: Dict[int, str] = {
             i: f"{node_prefix}{i}" for i in range(self.sim.n_nodes)}
@@ -56,6 +110,18 @@ class GossipOracle:
         # swim.init_state — single sentinel convention)
         n_init = self.sim.n_initial or self.sim.n_nodes
         self._provisioned = np.arange(self.sim.n_nodes) < n_init
+        # device mirror of the provisioned mask: summary reductions run
+        # against it on device (sharded under a mesh) — updated in place
+        # on spawn (one-element scatter), uploaded in full only here
+        prov = jnp.asarray(self._provisioned)
+        if self._sharding is not None:
+            from consul_tpu.parallel import mesh as meshlib
+            prov = jax.device_put(
+                prov, meshlib.state_sharding(prov, mesh))
+        self._prov_dev = prov
+        # device-side status checkpoint for members_delta(); None until
+        # the first delta call establishes it
+        self._status_ckpt = None
         self._events: List[dict] = []           # host-side payload ring
         self._event_ring = 256                  # reference ring size
         # gossip keyring (serf keyring: install/use/remove/list — the
@@ -129,14 +195,14 @@ class GossipOracle:
                         # it for the compile duration)
                         self._metrics_fn(self.params, s)):
                 jax.block_until_ready(out)
-        # the members/down-mask computation is every client's FIRST
-        # read — compile it too, then drop the snapshot it cached so
-        # later reads re-evaluate against current state
+        # the paged member read and the summary reduction are every
+        # client's FIRST reads — compile both (they carry no cache to
+        # invalidate: each call answers against current state)
         try:
             self.members(limit=1)
+            self.members_summary()
         except Exception:
             pass
-        self.__dict__.pop("_member_snap", None)
 
     # -------------------------------------------------------------- identity
 
@@ -155,77 +221,91 @@ class GossipOracle:
 
     # ------------------------------------------------------------ membership
 
-    def _members_host(self, max_age: float = 1.0):
-        """Host-side numpy snapshot of membership state (statuses 0=alive
-        1=failed 2=left, incarnation, up), refreshed at most every
-        `max_age` seconds — serving paths must not pay a device round-trip
-        or an O(N) python loop per request (VERDICT r1 weak #6)."""
-        now = time.monotonic()
-        snap = self.__dict__.get("_member_snap")
-        if snap is not None and now - snap[0] < max_age:
-            return snap[1]
-        with self._lock:
-            st = self._state.swim
-            up = np.asarray(st.up)
-            member = np.asarray(st.member)
-            dead = np.asarray(self._oracle_down_mask())
-            left = np.asarray(st.committed_left) | ~member
-            inc = np.asarray(st.incarnation)
-            status = np.zeros(len(up), np.int8)
-            status[dead] = 1
-            status[left] = 2      # left wins over failed (serf precedence)
-            host = (status, inc, up)
-            # store under the lock: a kill() invalidation must not be
-            # overwritten by a reader re-caching pre-mutation state
-            self.__dict__["_member_snap"] = (now, host)
-        return host
-
     _STATUS_NAMES = ("alive", "failed", "left")
+
+    def _page(self, ids: np.ndarray):
+        """Gather (status, incarnation, up) rows for `ids` via one
+        jitted device gather padded to a power-of-two bucket; transfers
+        O(len(ids)), never O(N)."""
+        k = len(ids)
+        bucket = _bucket(k, self.sim.n_nodes)
+        padded = np.zeros(bucket, np.int32)
+        padded[:k] = ids
+        with self._lock:
+            st, inc, up = self._page_fn(self.params, self._state,
+                                        jnp.asarray(padded))
+        return (_to_host(st)[:k], _to_host(inc)[:k], _to_host(up)[:k])
 
     def members(self, limit: Optional[int] = None,
                 offset: int = 0) -> List[dict]:
         """Serf member list with statuses (alive/failed/left), oracle view.
 
-        Paginated: python dicts are built only for the requested page —
-        the full status computation is vectorized numpy on a cached
-        snapshot, so this works at the N the sim targets."""
-        status, inc, up = self._members_host()
+        Paginated AND gather-free: the requested page's ids are gathered
+        on device and only those rows transfer — a members(limit=k) call
+        against a 1M-slot (possibly multi-device-sharded) pool moves
+        O(k) bytes to host."""
         ids = np.flatnonzero(self._provisioned)
         n = len(ids)
         offset = max(0, offset)
         end = n if limit is None else min(offset + max(0, limit), n)
+        page_ids = ids[offset:end]
+        if len(page_ids) == 0:
+            return []
+        status, inc, up = self._page(page_ids)
         names = self._STATUS_NAMES
-        return [{"name": self.node_name(i), "id": int(i),
-                 "status": names[status[i]], "incarnation": int(inc[i]),
-                 "actually_up": bool(up[i])}
-                for i in ids[offset:end]]
+        return [{"name": self.node_name(int(i)), "id": int(i),
+                 "status": names[status[j]], "incarnation": int(inc[j]),
+                 "actually_up": bool(up[j])}
+                for j, i in enumerate(page_ids)]
 
     def members_summary(self) -> Dict[str, int]:
-        """Counts by status — O(N) numpy, no per-node dicts; serves the
-        /v1/agent/metrics membership gauges (the reference's usage
+        """Counts by status — one jitted device reduction over the
+        provisioned mask, 16 bytes transferred regardless of N; serves
+        the /v1/agent/metrics membership gauges (the reference's usage
         metrics role, agent/consul/usagemetrics/)."""
-        status, _, _ = self._members_host()
-        counts = np.bincount(status[self._provisioned], minlength=3)
-        return {"alive": int(counts[0]), "failed": int(counts[1]),
-                "left": int(counts[2]),
-                "total": int(self._provisioned.sum())}
+        with self._lock:
+            counts = self._counts_fn(self.params, self._state,
+                                     self._prov_dev)
+        alive, failed, left, total = (int(v) for v in _to_host(counts))
+        return {"alive": alive, "failed": failed, "left": left,
+                "total": total}
 
-    def _oracle_down_mask(self) -> jnp.ndarray:
-        """Nodes the cluster (majority view) considers failed: committed dead
-        or an active dead rumor."""
-        st = self._state.swim
-        u = self.params.swim.rumor_slots
-        dead_rumor = jnp.zeros_like(st.committed_dead).at[
-            jnp.where(st.r_active & (st.r_kind == swim.DEAD), st.r_subject, 0)
-        ].max(st.r_active & (st.r_kind == swim.DEAD))
-        return st.committed_dead | dead_rumor
+    def members_delta(self, max_changes: int = 256) -> dict:
+        """Changed members since the last delta checkpoint — the
+        incremental device→control-plane read (ROADMAP item 5): a pool
+        with F flaps since the last call moves min(F, max_changes)
+        rows, not a full gather.  Returns {"count", "changed":
+        [(id, status_name)...], "truncated"}; on truncation (count >
+        max_changes) callers fall back to the paged listing.  The first
+        call reports every provisioned member as changed (no checkpoint
+        yet)."""
+        k = _bucket(max(1, max_changes), self.sim.n_nodes)
+        with self._lock:
+            prev = self._status_ckpt
+            if prev is None:
+                # no checkpoint yet: everything differs from the
+                # impossible status -1
+                prev = jnp.full((self.sim.n_nodes,), -1, jnp.int8)
+                if self._sharding is not None:
+                    from consul_tpu.parallel import mesh as meshlib
+                    prev = jax.device_put(
+                        prev, meshlib.state_sharding(prev, self.mesh))
+            st, n_changed, idx, states = self._delta_fn(
+                self.params, self._state, prev, self._prov_dev, k)
+            self._status_ckpt = st
+        n_changed = int(n_changed)
+        idx = _to_host(idx)
+        states = _to_host(states)
+        names = self._STATUS_NAMES
+        changed = [(int(i), names[states[j]])
+                   for j, i in enumerate(idx) if i >= 0]
+        return {"count": n_changed, "changed": changed,
+                "truncated": n_changed > k}
 
     def status(self, name: str) -> str:
         i = self.node_id(name)
-        status, _, _ = self._members_host()
-        if i >= len(status):
-            raise KeyError(name)
-        return self._STATUS_NAMES[status[i]]
+        status, _, _ = self._page(np.array([i], np.int32))
+        return self._STATUS_NAMES[int(status[0])]
 
     def believed_down_fraction(self, name: str) -> float:
         with self._lock:
@@ -233,8 +313,9 @@ class GossipOracle:
                 self.params.swim, self._state.swim, self.node_id(name)))
 
     def kill(self, name: str) -> None:
+        # no read-cache invalidation needed: the paged/summary reads
+        # answer against current device state on every call
         with self._lock:
-            self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
                 swim=swim.kill(self._state.swim, self.node_id(name)))
 
@@ -242,14 +323,12 @@ class GossipOracle:
         """Restart + rejoin: heals even a committed death (the node comes
         back with a higher incarnation and refutes — memberlist rejoin)."""
         with self._lock:
-            self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
                 swim=swim.rejoin(self.params.swim, self._state.swim,
                                  self.node_id(name)))
 
     def leave(self, name: str) -> None:
         with self._lock:
-            self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
                 swim=swim.leave(self.params.swim, self._state.swim,
                                 self.node_id(name)))
@@ -281,14 +360,15 @@ class GossipOracle:
                 self._ids.pop(old, None)
                 self._names[i] = name
                 self._ids[name] = i
-            # invalidation discipline (_members_host comment): drop the
-            # snapshot and update device state BEFORE flipping the
-            # provisioned mask — a concurrent reader pairing the OLD
-            # mask with the new snapshot merely misses the new node,
+            # ordering discipline: update device state BEFORE flipping
+            # the provisioned mask — a concurrent reader pairing the
+            # OLD mask with the new state merely misses the new node,
             # never reports it as a phantom "left"
-            self.__dict__.pop("_member_snap", None)
             self._state = self._state.replace(
                 swim=swim.rejoin(self.params.swim, self._state.swim, i))
+            # one-element device scatter keeps the mirror sharded in
+            # place — never a full host→device re-upload of the mask
+            self._prov_dev = self._prov_dev.at[i].set(True)
             self._provisioned[i] = True
             return self._names[i]
 
@@ -300,14 +380,17 @@ class GossipOracle:
     # ----------------------------------------------------------- coordinates
 
     def coordinate(self, name: str) -> dict:
+        """One member's Vivaldi coordinate — a single jitted row gather
+        (O(D) transfer), answered against sharded state unchanged."""
         i = self.node_id(name)
         with self._lock:
-            c = self._state.coords
-            return {"node": name,
-                    "vec": np.asarray(c.coords[i]).tolist(),
-                    "error": float(c.error[i]),
-                    "adjustment": float(c.adjustment[i]),
-                    "height": float(c.height[i])}
+            vec, err, adj, height = self._coord_row_fn(
+                self._state.coords, jnp.int32(i))
+        return {"node": name,
+                "vec": _to_host(vec).tolist(),
+                "error": float(err),
+                "adjustment": float(adj),
+                "height": float(height)}
 
     def rtt(self, a: str, b: str) -> float:
         """Estimated RTT seconds (consul rtt command — lib/rtt.go:13)."""
@@ -317,44 +400,30 @@ class GossipOracle:
                 self._state.coords,
                 jnp.array([ia], jnp.int32), jnp.array([ib], jnp.int32))[0])
 
-    def _coords_host(self, max_age: float = 1.0):
-        """Host-side numpy snapshot of the coordinate state, refreshed at
-        most every `max_age` seconds.  Serving paths (DNS ?near sorting,
-        /v1/coordinate) must not pay a device round-trip per request —
-        coordinates drift on gossip timescales, so a ~1s-stale view is
-        well inside Vivaldi's own error."""
-        import time as _time
-        now = _time.monotonic()
-        snap = self.__dict__.get("_coord_snap")
-        if snap is not None and now - snap[0] < max_age:
-            return snap[1]
-        with self._lock:
-            c = self._state.coords
-            host = (np.asarray(c.coords), np.asarray(c.height),
-                    np.asarray(c.adjustment))
-        self.__dict__["_coord_snap"] = (now, host)
-        return host
-
     def sort_by_rtt(self, origin: str, names: List[str]) -> List[str]:
-        """?near= ordering (agent/consul/rtt.go:196) — numpy on the cached
-        coordinate snapshot (estimate_rtt semantics, lib/rtt.go:13-43)."""
-        coords, height, adj = self._coords_host()
+        """?near= ordering (agent/consul/rtt.go:196) — the distance
+        computation and argsort run ON DEVICE (serf.rtt_order,
+        estimate_rtt semantics lib/rtt.go:13-43) against whatever
+        sharding the coordinate state carries; the only transfer is the
+        O(k) order vector, never the [N, D] coordinate tensor.  Query
+        ids pad to a power-of-two bucket so the kernel compiles at most
+        log2(N) times."""
+        if not names:
+            return []
         io = self.node_id(origin)
         ids = np.array([self.node_id(n) for n in names], np.int32)
-        if io >= len(coords) or (len(ids) and ids.max() >= len(coords)):
-            # node registered after the <=1s-stale snapshot: refresh it
-            # rather than IndexError into a 500/SERVFAIL (advisor finding)
-            self.__dict__.pop("_coord_snap", None)
-            coords, height, adj = self._coords_host()
-            keep = ids < len(coords)
-            if io >= len(coords) or not keep.all():
-                return list(names)  # fall back to given order
-        diff = coords[ids] - coords[io]
-        d = np.linalg.norm(diff, axis=-1) + height[ids] + height[io]
-        adjusted = d + adj[ids] + adj[io]
-        dist = np.where(adjusted > 0.0, adjusted, d)
-        order = np.argsort(dist, kind="stable")
-        return [names[i] for i in order]
+        k = len(ids)
+        bucket = _bucket(k, self.sim.n_nodes)
+        padded = np.zeros(bucket, np.int32)
+        padded[:k] = ids
+        valid = np.arange(bucket) < k
+        with self._lock:
+            order = self._rtt_order_fn(self.params, self._state,
+                                       jnp.int32(io),
+                                       jnp.asarray(padded),
+                                       jnp.asarray(valid))
+        order = _to_host(order)
+        return [names[i] for i in order if i < k]
 
     # ---------------------------------------------------------------- events
 

@@ -16,7 +16,10 @@ slice, with the WAN tensor replicated — see consul_tpu/models/wan.py.
 
 from __future__ import annotations
 
-from typing import Iterable
+import contextlib
+import os
+import re
+from typing import Iterable, List
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +27,152 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
 DC_AXIS = "dc"
+
+
+def _clear_backends() -> None:
+    try:
+        import jax.extend.backend as _jeb
+        _jeb.clear_backends()
+    except (ImportError, AttributeError):
+        jax.clear_backends()  # older JAX spelling
+
+
+def _backends_initialized():
+    """Best-effort: has this process already created an XLA client?
+    (XLA parses --xla_force_host_platform_device_count only at first
+    client creation, so device-count inflation is only reliable before
+    that point.)  None = unknown on future jax internals."""
+    try:
+        from jax._src import xla_bridge as _xb
+        return bool(_xb._backends)
+    except Exception:   # pragma: no cover - jax internals moved
+        return None
+
+
+@contextlib.contextmanager
+def cpu_devices(n: int):
+    """Expose >= n simulated CPU devices, SAVING AND RESTORING the
+    global platform/flags config on exit so an in-process caller (a
+    pytest module, the multichip smoke) never clobbers other tests.
+
+    Pins the platform to cpu BEFORE any device query: the ambient env
+    may register a (possibly broken / version-skewed) TPU backend, and
+    without the pin array creation would materialize there.  When the
+    current client already carries >= n CPU devices (the test rig's
+    conftest forces 8) nothing else is mutated at all.  Otherwise the
+    device count is inflated via jax_num_cpu_devices (newer jax; works
+    after clear_backends) or XLA_FLAGS (older jax; only parsed at the
+    FIRST client creation — if a backend already exists and the knob is
+    absent, this raises with guidance rather than silently running
+    single-device).  On exit the prior config/env is restored and any
+    freshly-created inflated client dropped; arrays created inside the
+    context live on that client — don't let them escape."""
+    prev_platforms = jax.config.jax_platforms
+    prev_flags = os.environ.get("XLA_FLAGS")
+    knob = "jax_num_cpu_devices"
+    try:
+        prev_ndev = getattr(jax.config, knob)
+    except AttributeError:
+        prev_ndev = None
+    initialized = _backends_initialized()
+    mutated_env = mutated_client = False
+    # when no client exists yet, the FIRST device query below creates
+    # one under our mutated (cpu-pinned, possibly inflated) config —
+    # that client is ours to drop on restore even when only the env
+    # route was used
+    created_client = initialized is False
+
+    def restore():
+        jax.config.update("jax_platforms", prev_platforms)
+        if mutated_env:
+            if prev_flags is None:
+                os.environ.pop("XLA_FLAGS", None)
+            else:
+                os.environ["XLA_FLAGS"] = prev_flags
+        if mutated_client and prev_ndev is not None:
+            jax.config.update(knob, prev_ndev)
+        if mutated_client or created_client:
+            # drop the client created under the mutated config so the
+            # restored config takes effect at the next backend init
+            _clear_backends()
+
+    # the setup itself mutates global state, so a setup FAILURE (rig
+    # can't grow to n devices) must restore too — not only the yield
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        if initialized is False:
+            # no client yet: the env route is still live — set it
+            # before the first device query below creates the client
+            flags = prev_flags or ""
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + f" --xla_force_host_platform_device_count={n}"
+                ).strip()
+                mutated_env = True
+        if len(jax.devices("cpu")) < n:
+            try:
+                _clear_backends()
+                jax.config.update(knob, n)
+                mutated_client = True
+            except AttributeError:
+                raise RuntimeError(
+                    f"need {n} cpu devices, have "
+                    f"{len(jax.devices('cpu'))}, and this jax lacks "
+                    f"{knob} while a backend is already initialized — "
+                    f"relaunch with XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={n}")
+        devs = jax.devices("cpu")
+        if len(devs) < n:
+            raise RuntimeError(
+                f"need {n} cpu devices, have {len(devs)} — set "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={n}")
+    except BaseException:
+        restore()
+        raise
+    try:
+        yield devs[:n]
+    finally:
+        restore()
+
+
+def assert_node_sharded(leaf, n_devices: int, what: str = "state") -> None:
+    """Fail loudly when a node-axis leaf is NOT spread across all
+    `n_devices` — the 'knowledge matrix stays sharded' acceptance
+    assert, usable on any scan output."""
+    devset = getattr(getattr(leaf, "sharding", None), "device_set", set())
+    if len(devset) != n_devices:
+        raise AssertionError(
+            f"{what} not sharded: on {len(devset)} device(s), "
+            f"expected {n_devices}")
+
+
+# an all-gather INSTRUCTION and its result shape(s), e.g.
+#   %all-gather.3 = f32[32768,32]{1,0} all-gather(...)
+#   %ag = (s8[128]{0}, s8[128]{0}) all-gather(...)
+# — only the defining line, never fusions that merely consume one
+_AG_RE = re.compile(r"=\s*(\([^)]*\)|[^\s(]+)\s+all-gather(?:-start)?\(")
+_SHAPE_RE = re.compile(r"\[([0-9,]*)\]")
+
+
+def full_gather_ops(hlo_text: str, n_nodes: int) -> List[str]:
+    """All-gather instructions in a compiled module whose RESULT
+    materializes a full node-axis buffer (some DIMENSION >= n_nodes —
+    a replicated [N], [N, U], or doubled [2N] buffer) — the 'no
+    accidental all-gather of the [N] / [N, U] buffers' audit.
+    Collectives over the replicated [U]-sized rumor/[U, U] map tables
+    pass regardless of element count (they ARE the cross-shard rumor
+    traffic); materializing the node axis on every device does not."""
+    bad = []
+    for line in hlo_text.splitlines():
+        m = _AG_RE.search(line)
+        if m is None:
+            continue
+        for dims in _SHAPE_RE.findall(m.group(1)):
+            if any(int(d) >= max(n_nodes, 2)
+                   for d in dims.split(",") if d):
+                bad.append(line.strip())
+                break
+    return bad
 
 
 def make_mesh(devices: Iterable[jax.Device] | None = None) -> Mesh:
@@ -49,7 +198,14 @@ def make_wan_mesh(devices: Iterable[jax.Device] | None = None,
 def wan_state_sharding(state, mesh: Mesh):
     """NamedSharding pytree for a WanState: LAN leaves are [D, N, ...]
     (dc-batched, node-sharded); WAN-pool leaves are [S, ...] sharded on
-    nodes; tiny tables replicate."""
+    nodes; tiny tables replicate.
+
+    The small per-DC tables ([D], [D, E], [D, U], the bridge ring) are
+    REPLICATED, not dc-sharded: sharding them saves nothing (a few
+    bytes per device) and the event-bridge's sequential per-dc reads
+    (`wan._bridge_events`) then stay device-local — GSPMD lowers
+    scalar-index slices of a sharded batch axis to mask+all-reduce
+    partial sums, which the replicated layout sidesteps entirely."""
     n_dc = mesh.shape[DC_AXIS]
     n_node = mesh.shape[NODE_AXIS]
 
@@ -57,8 +213,6 @@ def wan_state_sharding(state, mesh: Mesh):
         if leaf.ndim >= 2 and leaf.shape[0] == n_dc \
                 and _node_shardable(leaf.shape[1], n_node):
             return NamedSharding(mesh, P(DC_AXIS, NODE_AXIS))
-        if leaf.ndim >= 1 and leaf.shape[0] == n_dc:
-            return NamedSharding(mesh, P(DC_AXIS))
         return NamedSharding(mesh, P())
 
     def wan_spec(leaf):
@@ -69,8 +223,8 @@ def wan_state_sharding(state, mesh: Mesh):
     return type(state)(
         lan=jax.tree_util.tree_map(lan_spec, state.lan),
         wan=jax.tree_util.tree_map(wan_spec, state.wan),
-        bridged=NamedSharding(mesh, P(DC_AXIS)),
-        bridged_ptr=NamedSharding(mesh, P(DC_AXIS)),
+        bridged=NamedSharding(mesh, P()),
+        bridged_ptr=NamedSharding(mesh, P()),
     )
 
 

@@ -118,6 +118,18 @@ class SegmentedOracle:
                 total[k] = total.get(k, 0) + v
         return total
 
+    def members_delta(self, max_changes: int = 256) -> dict:
+        """Changed members since the last delta checkpoint across every
+        segment pool (GossipOracle.members_delta — the gather-free
+        incremental read): `changed` rows are (segment, id, status)."""
+        out = {"count": 0, "changed": [], "truncated": False}
+        for seg in sorted(self.pools):
+            d = self.pools[seg].members_delta(max_changes)
+            out["count"] += d["count"]
+            out["changed"] += [(seg, i, st) for i, st in d["changed"]]
+            out["truncated"] = out["truncated"] or d["truncated"]
+        return out
+
     def status(self, name: str) -> str:
         return self._pool_of(name)[1].status(name)
 

@@ -78,6 +78,40 @@ def test_guard_refuses_backend_mismatch_before_burning_runs():
     assert run_guard(5, 0.15, update=True) == 1
 
 
+def test_guard_refuses_cross_topology_comparison():
+    """A baseline stamped with one (backend, devices, mesh) must never
+    be compared against runs from another — a CPU-scaled 8-device mesh
+    number judged against a single-chip TPU baseline is the exact
+    confusion PROFILE_r06.json documents, and the guard now refuses it
+    instead of emitting a false regression/improvement."""
+    tpu1 = {"backend": "tpu", "devices": 1, "mesh_shape": None}
+    cpu8 = {"backend": "cpu", "devices": 8, "mesh_shape": {"nodes": 8}}
+    base = {"metric": METRIC, "median_s": 0.600, "topology": tpu1}
+    v = judge([{**_row(0.600), "topology": cpu8}], base)
+    assert not v["ok"] and v["verdict"] == "topology"
+    assert v["baseline_topology"] == tpu1
+    assert v["run_topology"] == cpu8
+    # same topology: judged on the numbers as before
+    v = judge([{**_row(0.610), "topology": tpu1}], base)
+    assert v["ok"] and v["verdict"] == "ok"
+    # rows without a stamp (legacy artifacts) are judged, not refused
+    v = judge([_row(0.610)], base)
+    assert v["ok"]
+    # topology-stamped baselines match on the stamp's backend
+    assert not backend_matches(base, "cpu")
+    assert backend_matches(base, "tpu")
+
+
+def test_make_baseline_records_topology_from_runs():
+    cpu8 = {"backend": "cpu", "devices": 8, "mesh_shape": {"nodes": 8}}
+    nb = make_baseline([{**_row(0.5), "topology": cpu8}], chip="cpu")
+    assert nb["topology"] == cpu8
+    json.loads(json.dumps(nb))
+    # legacy rows without a stamp stay loadable and match-anything
+    nb = make_baseline([_row(0.5)], chip="test")
+    assert nb["topology"] is None
+
+
 def test_checked_in_baseline_is_valid_and_matches_roundtrip():
     b = load_baseline()
     assert b["metric"] == METRIC

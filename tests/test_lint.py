@@ -497,6 +497,66 @@ def test_metric_names_fires_and_stays_silent():
     assert check_snippet("metric-names", clean) == []
 
 
+def test_gather_discipline_fires_and_stays_silent():
+    bad = """
+        import numpy as np
+
+        def members_host(self):
+            status = np.asarray(self._state.swim.up)
+            coords = np.array(self._state.coords.coords)
+            return status, coords
+    """
+    hits = check_snippet("gather-discipline", bad,
+                         relpath="consul_tpu/oracle.py")
+    assert len(hits) == 2
+    assert any("'.up'" in f.message for f in hits)
+    assert any("'.coords'" in f.message for f in hits)
+
+    clean = """
+        import numpy as np
+        import jax.numpy as jnp
+
+        def page(self, padded):
+            # bounded page through the seam: bare-name transfer
+            st = self._page_fn(self.params, self._state, padded)
+            return np.asarray(st)
+
+        def slots(self, st):
+            return np.asarray(st.events.e_id)      # [E] table, not [N]
+
+        def on_device(self, s):
+            return jnp.asarray(s.up)               # device-side, no hop
+    """
+    assert check_snippet("gather-discipline", clean,
+                         relpath="consul_tpu/oracle.py") == []
+
+    # blessed checkpoint module: the nemesis reads ground truth between
+    # scans by design
+    assert check_snippet("gather-discipline", bad,
+                         relpath="consul_tpu/chaos.py") == []
+    # out-of-package drivers (bench accuracy accounting) own their
+    # state and sync at scan boundaries — out of scope
+    assert check_snippet("gather-discipline", bad,
+                         relpath="bench.py") == []
+
+
+def test_gather_discipline_sees_through_import_aliases():
+    bad = """
+        import numpy
+        from jax import device_get as pull
+
+        def sneaky(s):
+            a = numpy.asarray(s.swim.know)
+            b = pull(s.swim.learn_tick)
+            return a, b
+    """
+    hits = check_snippet("gather-discipline", bad,
+                         relpath="consul_tpu/sneaky.py")
+    assert len(hits) == 2
+    assert any("'.know'" in f.message for f in hits)
+    assert any("'.learn_tick'" in f.message for f in hits)
+
+
 # ----------------------------------------------- framework machinery
 
 

@@ -1,11 +1,21 @@
-"""Multi-device SPMD tests on the virtual 8-device CPU mesh."""
+"""Multi-device SPMD tests on the virtual 8-device CPU mesh.
+
+Covers the full sharded scaling path (ISSUE 6): seeded trajectory
+equivalence of the sharded `serf.run` scan vs single-device (the
+`shard_blocks` ring-collective lowering is a pure lowering hint), the
+2-D `make_wan_mesh` federation case, the oracle's O(k)-transfer
+gather-free read contract, `cpu_devices` config hygiene, the
+in-process multichip smoke, and a bounded weak-scaling sweep smoke.
+"""
+
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from consul_tpu.config import GossipConfig, SimConfig
-from consul_tpu.models import swim
+from consul_tpu.models import serf, swim
 from consul_tpu.parallel import mesh as meshlib
 
 
@@ -31,3 +41,242 @@ def test_sharded_step_matches_single_device():
     assert len(got.know.sharding.device_set) == 8
     for la, lb in zip(jax.tree_util.tree_leaves(ref), jax.tree_util.tree_leaves(got)):
         np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_sharded_serf_run_matches_single_device():
+    """The FULL cluster scan (swim + events + vivaldi) sharded over 8
+    devices WITH the shard_blocks ring-collective lowering reproduces
+    the single-device membership trajectory bit-for-bit at equal N and
+    seed — shard_blocks is a lowering hint, never a semantic one."""
+    def trajectory(blocks, shard):
+        params = serf.make_params(
+            GossipConfig.lan(),
+            SimConfig(n_nodes=256, rumor_slots=16, p_loss=0.02, seed=11,
+                      shard_blocks=blocks))
+        s = serf.init_state(params)
+        s = s.replace(swim=swim.kill(s.swim, 3))
+        kw = {}
+        if shard:
+            m = meshlib.make_mesh()
+            sharding = meshlib.state_sharding(s, m)
+            s = jax.device_put(s, sharding)
+            kw["out_shardings"] = (sharding, None)
+        run = jax.jit(serf.run, static_argnums=(0, 2, 3), **kw)
+        out, frac = run(params, s, 40, 3)
+        return out, frac
+
+    ref, ref_frac = trajectory(blocks=1, shard=False)
+    got, got_frac = trajectory(blocks=8, shard=True)
+    meshlib.assert_node_sharded(got.swim.know, 8, "knowledge matrix")
+    np.testing.assert_array_equal(np.asarray(ref_frac),
+                                  np.asarray(got_frac))
+    for la, lb in zip(jax.tree_util.tree_leaves(ref),
+                      jax.tree_util.tree_leaves(got)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_top_k_sharded_matches_lax_top_k():
+    """The per-block top-k decomposition (swim._top_k_sharded) is
+    result-identical to flat lax.top_k — including tie-breaks (earlier
+    global index wins among equal values)."""
+    key = jax.random.PRNGKey(42)
+    for trial in range(4):
+        key, k1 = jax.random.split(key)
+        # small value range forces plenty of cross-block ties
+        x = jax.random.randint(k1, (256,), 0, 7, dtype=jnp.int32)
+        for k in (1, 4, 8, 32):
+            vr, ir = jax.lax.top_k(x, k)
+            vs, is_ = swim._top_k_sharded(x, k, 8)
+            np.testing.assert_array_equal(np.asarray(vr), np.asarray(vs))
+            np.testing.assert_array_equal(np.asarray(ir), np.asarray(is_))
+
+
+def test_wan_2d_mesh_run_matches_single_device():
+    """Federation model over the 2-D dc x nodes mesh (make_wan_mesh):
+    the vmapped per-DC pools shard over `dc`, each DC's node axis over
+    `nodes` WITH the shard_blocks ring-collective lowering threaded
+    into the LAN pools, the scanned trajectory matches single-device,
+    and the compiled wan program all-gathers no per-DC node-axis
+    buffer."""
+    from consul_tpu.models import wan as wanlib
+
+    def wan_params(shard_blocks):
+        return wanlib.make_params(n_dcs=2, nodes_per_dc=64,
+                                  servers_per_dc=4, p_loss=0.02,
+                                  rumor_slots=8, event_slots=8,
+                                  shard_blocks=shard_blocks)
+
+    params = wan_params(1)
+    s0 = wanlib.init_state(params)
+    ref = jax.jit(wanlib.run, static_argnums=(0, 2))(params, s0, 20)
+
+    # 8 devices = 2 dcs x 4 node shards
+    sparams = wan_params(4)
+    wmesh = meshlib.make_wan_mesh(jax.devices(), n_dcs=2)
+    wsharding = meshlib.wan_state_sharding(s0, wmesh)
+    sh = jax.device_put(s0, wsharding)
+    wrun = jax.jit(wanlib.run, static_argnums=(0, 2),
+                   out_shardings=wsharding)
+    compiled = wrun.lower(sparams, sh, 20).compile()
+    bad = meshlib.full_gather_ops(compiled.as_text(), 64)
+    assert not bad, f"wan program all-gathers node-axis buffers: {bad[0]}"
+    got = wrun(sparams, sh, 20)
+    meshlib.assert_node_sharded(got.lan.swim.know, 8,
+                                "federated LAN knowledge")
+    for la, lb in zip(jax.tree_util.tree_leaves(ref),
+                      jax.tree_util.tree_leaves(got)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_oracle_reads_transfer_o_k_not_o_n(monkeypatch):
+    """The gather-free oracle contract: members(limit=k) against a
+    SHARDED 4096-slot pool moves O(k) bytes through the single
+    `oracle._to_host` seam — never the node axis.  The summary and
+    coordinate reads are O(1)/O(D)."""
+    import consul_tpu.oracle as oracle_mod
+
+    n = 4096
+    o = oracle_mod.GossipOracle(
+        sim=SimConfig(n_nodes=n, rumor_slots=16),
+        mesh=meshlib.make_mesh())
+    # every read below must answer against sharded device state
+    meshlib.assert_node_sharded(o._state.swim.know, 8, "oracle state")
+
+    transferred = []
+    real = oracle_mod._to_host
+
+    def spy(x):
+        a = real(x)
+        transferred.append(a.nbytes)
+        return a
+
+    monkeypatch.setattr(oracle_mod, "_to_host", spy)
+
+    page = o.members(limit=8)
+    assert len(page) == 8
+    assert page[0]["status"] == "alive"
+    summary = o.members_summary()
+    assert summary["total"] == n and summary["alive"] == n
+    coord = o.coordinate("node7")
+    assert len(coord["vec"]) == o.params.vivaldi.dims
+    assert o.status("node3") == "alive"
+    order = o.sort_by_rtt("node0", ["node3", "node9", "node5"])
+    assert sorted(order) == ["node3", "node5", "node9"]
+
+    total = sum(transferred)
+    # every read together moved well under one byte per pool slot —
+    # a single full-axis gather would alone be >= n bytes
+    assert total < n, f"oracle reads moved {total}B against a {n}-pool"
+    assert max(transferred) < n
+
+
+def test_oracle_members_delta_moves_changed_rows(monkeypatch):
+    """members_delta: F flaps since the checkpoint move min(F, k)
+    rows — the incremental device→control-plane read (ROADMAP 5)."""
+    import consul_tpu.oracle as oracle_mod
+
+    n = 1024
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=n, rumor_slots=16),
+                                mesh=meshlib.make_mesh())
+    first = o.members_delta(max_changes=n)   # establishes checkpoint
+    assert first["count"] == n               # everything is new once
+
+    transferred = []
+    real = oracle_mod._to_host
+
+    def spy(x):
+        a = real(x)
+        transferred.append(a.nbytes)
+        return a
+
+    monkeypatch.setattr(oracle_mod, "_to_host", spy)
+
+    d = o.members_delta(max_changes=64)
+    assert d["count"] == 0 and d["changed"] == []
+    o.kill("node5")
+    o.advance(120)                           # let the dead rumor land
+                                             # (~tick 65 at N=1024)
+    d = o.members_delta(max_changes=64)
+    assert (5, "failed") in d["changed"]
+    assert not d["truncated"]
+    assert sum(transferred) < n              # O(k), not O(N)
+
+
+def test_oracle_members_delta_ignores_unprovisioned_slots():
+    """A sparse pool's first delta reports its MEMBERS, not its empty
+    slots: count matches len(changed) and never forces the paged
+    fallback for phantom changes."""
+    import consul_tpu.oracle as oracle_mod
+
+    o = oracle_mod.GossipOracle(
+        sim=SimConfig(n_nodes=1024, rumor_slots=16, n_initial=64))
+    first = o.members_delta(max_changes=256)
+    assert first["count"] == 64 == len(first["changed"])
+    assert not first["truncated"]
+    assert o.members_delta(max_changes=256)["count"] == 0
+
+
+def test_sort_by_rtt_handles_more_names_than_nodes():
+    """?near= query lists may exceed the pool size (duplicate service
+    instances): the page bucket must grow past n, not crash."""
+    import consul_tpu.oracle as oracle_mod
+
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=16, rumor_slots=8))
+    names = [f"node{i % 4}" for i in range(20)]
+    order = o.sort_by_rtt("node0", names)
+    assert sorted(order) == sorted(names)
+
+
+def test_cpu_devices_restores_global_config():
+    """`cpu_devices` must save/restore jax_platforms and XLA_FLAGS even
+    on an exception — the multichip smoke runs in-process under pytest
+    and must not clobber the rig's backend for later modules."""
+    prev_platforms = jax.config.jax_platforms
+    prev_flags = os.environ.get("XLA_FLAGS")
+    with meshlib.cpu_devices(8) as devs:
+        assert len(devs) == 8
+        assert all(d.platform == "cpu" for d in devs)
+    assert jax.config.jax_platforms == prev_platforms
+    assert os.environ.get("XLA_FLAGS") == prev_flags
+
+    try:
+        with meshlib.cpu_devices(2):
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert jax.config.jax_platforms == prev_platforms
+    assert os.environ.get("XLA_FLAGS") == prev_flags
+
+
+def test_dryrun_multichip_runs_in_process():
+    """The multichip smoke (1-D node mesh + 2-D federation mesh) runs
+    under pytest without mutating the ambient platform config — the
+    hygiene `cpu_devices` provides (it used to clear_backends
+    process-wide)."""
+    import __graft_entry__ as entry
+    prev_platforms = jax.config.jax_platforms
+    entry.dryrun_multichip(8)
+    assert jax.config.jax_platforms == prev_platforms
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_sweep_smoke():
+    """Bounded tier-1 weak-scaling smoke (pinned simulated device
+    series 1..4, small per-shard N): per-device compiled cost flat,
+    detection ~log N, one compile per topology, no node-axis
+    all-gathers — every assert the full MULTICHIP run makes, at smoke
+    scale."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import scale_sweep
+
+    report = scale_sweep.weak_scaling(4, per_shard=256, ticks=80,
+                                      tolerance=0.3)
+    assert report["ok"], report
+    assert report["device_series"] == [1, 2, 4]
+    assert all(r["compiles"] == 1 for r in report["rows"])
+    assert all(r["converged"] for r in report["rows"])
+    assert report["rows"][-1]["devices"] == 4
+    assert report["rows"][-1]["mesh_shape"] == {"nodes": 4}
+    assert report["backend"] == "cpu"

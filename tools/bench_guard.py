@@ -85,10 +85,28 @@ def accuracy_ok(result: dict) -> bool:
 
 def judge(results: list, baseline: dict,
           threshold: float = DEFAULT_THRESHOLD) -> dict:
-    """Full verdict over N bench results vs a baseline dict."""
-    bad = [r for r in results if not accuracy_ok(r)]
+    """Full verdict over N bench results vs a baseline dict.
+
+    Refuses (verdict "topology") before comparing numbers when any
+    result row's topology stamp differs from the baseline's — a
+    CPU-scaled median judged against a chip baseline is a guaranteed
+    false regression, and a chip median judged against a CPU-simulated
+    8-device mesh reads as a miraculous improvement; neither is a
+    comparison, both are the confusion PROFILE_r06.json documents."""
     values = [float(r["value"]) for r in results]
     median = statistics.median(values)
+    base_topo = baseline.get("topology")
+    if base_topo is not None:
+        mismatched = [r["topology"] for r in results
+                      if "topology" in r and r["topology"] != base_topo]
+        if mismatched:
+            return {"ok": False, "verdict": "topology",
+                    "median_s": median, "runs": values,
+                    "baseline_s": float(baseline["median_s"]),
+                    "threshold": threshold,
+                    "baseline_topology": base_topo,
+                    "run_topology": mismatched[0]}
+    bad = [r for r in results if not accuracy_ok(r)]
     out = compare(median, float(baseline["median_s"]), threshold)
     out["runs"] = values
     if bad:
@@ -105,8 +123,13 @@ def backend_matches(baseline: dict, backend: str) -> bool:
     tunnel-down CPU fallback must neither be judged against TPU numbers
     (guaranteed false 'regression') nor rewrite them via --update
     (after which every chip run reads 'improved' and the guard is
-    blind).  Matches on the backend name appearing in the baseline's
-    recorded chip string; an unrecorded chip matches anything."""
+    blind).  Matches on the recorded topology stamp's backend when the
+    baseline carries one (post-r06 baselines), else on the backend name
+    appearing in the recorded chip string; an unrecorded chip matches
+    anything."""
+    topo = baseline.get("topology")
+    if topo is not None:
+        return topo.get("backend") == backend
     chip = str(baseline.get("chip", ""))
     return not chip or backend in chip
 
@@ -121,11 +144,16 @@ def load_baseline(path: str = BASELINE_PATH) -> dict:
 
 def make_baseline(results: list, chip: str, note: str = "") -> dict:
     values = sorted(float(r["value"]) for r in results)
+    # topology stamp from the runs themselves (bench.py emits it):
+    # future judges compare apples to apples or refuse
+    topo = next((r["topology"] for r in results if "topology" in r),
+                None)
     return {
         "metric": METRIC,
         "median_s": statistics.median(values),
         "runs_s": values,
         "chip": chip,
+        "topology": topo,
         "threshold": DEFAULT_THRESHOLD,
         "updated": time.strftime("%Y-%m-%d"),
         "note": note or "rolling baseline; update with "
@@ -217,7 +245,8 @@ def scaled_smoke(n_nodes: int = 4096, seed: int = 7) -> dict:
     return {"metric": METRIC + "_smoke", "value": round(r["wall"], 3),
             "n_nodes": n_nodes, "f1": round(r["f1"], 4),
             "false_commits": r["false_commits"],
-            "compiles": r["compiles"], "converged": r["converged"]}
+            "compiles": r["compiles"], "converged": r["converged"],
+            "topology": r["topology"]}
 
 
 def run_check() -> int:
@@ -248,6 +277,18 @@ def run_check() -> int:
                 fake_base)
     if acc["ok"]:
         failures.append("guard PASSED a fast-but-wrong result")
+    # cross-topology refusal: a CPU-simulated 8-device median must not
+    # be judged against a single-chip TPU baseline even when the
+    # number itself looks healthy
+    topo_base = {"metric": METRIC, "median_s": 0.600,
+                 "topology": {"backend": "tpu", "devices": 1,
+                              "mesh_shape": None}}
+    xt = judge([{"value": 0.600, "f1": 1.0, "false_commits": 0,
+                 "topology": {"backend": "cpu", "devices": 8,
+                              "mesh_shape": {"nodes": 8}}}], topo_base)
+    if xt["ok"] or xt["verdict"] != "topology":
+        failures.append("guard COMPARED across topologies "
+                        "(cpu x8 mesh vs tpu x1)")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

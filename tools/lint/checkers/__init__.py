@@ -5,6 +5,7 @@ from lint.checkers.blocking_call import BlockingCallChecker
 from lint.checkers.donation_safety import DonationSafetyChecker
 from lint.checkers.dtype_discipline import DtypeDisciplineChecker
 from lint.checkers.exception_hygiene import ExceptionHygieneChecker
+from lint.checkers.gather_discipline import GatherDisciplineChecker
 from lint.checkers.jit_purity import JitPurityChecker
 from lint.checkers.metric_names import MetricNamesChecker
 from lint.checkers.recompile_hazard import RecompileHazardChecker
@@ -19,6 +20,7 @@ ALL = [
     ExceptionHygieneChecker(),
     StorageSeamChecker(),
     MetricNamesChecker(),
+    GatherDisciplineChecker(),
 ]
 
 BY_NAME = {c.name: c for c in ALL}

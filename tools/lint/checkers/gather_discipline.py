@@ -1,0 +1,108 @@
+"""gather-discipline: no full node-axis device→host transfers outside
+blessed checkpoint sites.
+
+The scaling contract (ROADMAP items 1 and 5): a pool sharded over an
+n-device mesh dies the moment a serving path materializes the node
+axis on host — `np.asarray(state.swim.up)` on a 100M-slot pool is a
+cross-device all-gather plus a 100MB host copy per request.  The
+oracle answers members()/status()/coordinate() through jitted
+device-side reductions whose outputs are O(page), funneled through the
+single `oracle._to_host` seam; everything else must page or reduce on
+device too.
+
+This checker flags host-transfer calls (`np.asarray`, `np.array`,
+`jax.device_get` — alias-proof) whose argument reaches a NODE-AXIS
+state leaf (an attribute named like a `[N, ...]`-shaped field of
+SwimState / VivaldiState / EventState — `know`, `up`, `coords`, ...).
+Replicated small tables (`r_kind` [U], `e_id` [E]) and bare-name
+transfers of already-bounded pages (`np.asarray(padded_page)`) pass:
+boundedness of a local variable is the oracle seam's job, the leaf
+list is this checker's.
+
+Blessed checkpoint sites (never scanned):
+
+  * `consul_tpu/chaos.py` — the nemesis evolves fault state and checks
+    ground-truth invariants BETWEEN device scans; its full-state reads
+    are the documented host-sync checkpoint (PR 3).
+
+Drivers outside `consul_tpu/` (bench.py accuracy accounting, tools/)
+own their state exclusively and sync at scan boundaries — the checker
+scopes to the serving package, like storage-seam.
+
+Intentional one-off checkpoints inside the package carry
+`# lint: ok=gather-discipline (reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from lint.astutil import call_name, canonical_name, import_aliases
+from lint.core import Checker, Finding, Module
+
+SCOPE_PREFIX = "consul_tpu/"
+
+# modules whose full-state host reads ARE the checkpoint contract
+BLESSED = {
+    "consul_tpu/chaos.py",
+}
+
+# canonical dotted spellings that move device memory to host
+TRANSFER_CALLS = {
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.device_get",
+}
+
+# node-axis ([N, ...]-leading) state-leaf field names across
+# SwimState / VivaldiState / EventState / AeState.  Replicated tables
+# (r_* [U], e_* [E], a_*/d_* [S], ctr) are deliberately absent: pulling
+# them is O(1) in N and collectives over them ARE the rumor traffic.
+NODE_LEAVES: Set[str] = {
+    # SwimState
+    "up", "member", "incarnation", "committed_dead", "committed_left",
+    "committed_inc", "know", "learn_tick", "sends_left", "sus_start",
+    "sus_confirm", "bulk_member", "bulk_heard", "bulk_cov",
+    "awareness", "sus_count", "chaos_grp", "chaos_ok",
+    # VivaldiState
+    "coords", "height", "error", "adjustment", "adj_window",
+    # EventState
+    "lamport", "deliver_tick",
+    # AeState
+    "next_full", "n_dirty",
+}
+
+
+def _leaf_attrs(node: ast.AST) -> Iterator[ast.Attribute]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in NODE_LEAVES:
+            yield sub
+
+
+class GatherDisciplineChecker(Checker):
+    name = "gather-discipline"
+    description = ("np.asarray/jax.device_get on a node-axis state "
+                   "leaf outside blessed checkpoint sites — a full "
+                   "device→host gather a sharded pool cannot afford")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith(SCOPE_PREFIX) \
+                or module.relpath in BLESSED:
+            return
+        aliases = import_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = canonical_name(call_name(node) or "", aliases)
+            # `import numpy as np` canonicalizes np.asarray ->
+            # numpy.asarray; `from numpy import asarray as h` -> same
+            if name not in TRANSFER_CALLS or not node.args:
+                continue
+            for attr in _leaf_attrs(node.args[0]):
+                yield module.finding(
+                    self.name, node,
+                    f"{name} on node-axis state leaf '.{attr.attr}' — "
+                    f"a full device→host gather; page or reduce on "
+                    f"device (oracle._to_host contract) or bless the "
+                    f"checkpoint with a suppression")
+                break

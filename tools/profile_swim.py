@@ -13,6 +13,15 @@ Vivaldi solver — and a donated fixed-length scan (the exact shape the
 bench times) to show the in-place-update speedup buffer donation buys.
 
 Usage: python tools/profile_swim.py [N] [reps]
+       python tools/profile_swim.py [N] [reps] --devices D
+
+`--devices D` profiles the SHARDED program (node axis over a D-device
+`jax.sharding.Mesh`, ops/rolls.py ring traffic lowered to static
+collective-permutes): per-device HLO cost of the full step and the
+donated scan, the collective-op census, and the `full_gather_ops`
+audit asserting no [N]/[N, U] buffer is ever all-gathered — the
+per-shard cost table ROADMAP item 1 asks for.  Runs on simulated CPU
+devices when no multi-chip backend is attached.
 """
 
 from __future__ import annotations
@@ -93,9 +102,101 @@ def compile_with_stats(jfn, *args):
     return compiled, out
 
 
+def count_collectives(hlo_text: str) -> dict:
+    """Instruction census of the cross-shard traffic GSPMD inserted:
+    collective-permutes ARE the ring rumor/probe exchange
+    (ops/rolls.py decomposition); all-gathers should only ever touch
+    replicated [U]-sized tables (full_gather_ops proves it)."""
+    out = {}
+    for op in ("collective-permute", "all-gather", "all-reduce",
+               "all-to-all"):
+        c = hlo_text.count(f" {op}(") + hlo_text.count(f" {op}-start(")
+        if c:
+            out[op] = c
+    return out
+
+
+def main_sharded(n: int, reps: int, n_devices: int) -> None:
+    """Per-shard cost table of the SHARDED step + donated scan."""
+    from consul_tpu.parallel import mesh as meshlib
+    from consul_tpu.utils import donation
+
+    with meshlib.cpu_devices(n_devices) as devs:
+        mesh = meshlib.make_mesh(devs)
+        params = serf.make_params(GossipConfig.lan(),
+                                  SimConfig(n_nodes=n, rumor_slots=32,
+                                            alloc_cap=8, p_loss=0.01,
+                                            seed=7,
+                                            shard_blocks=n_devices))
+        s = serf.init_state(params)
+        s = s.replace(swim=swim.kill(s.swim, 7))
+        sharding = meshlib.state_sharding(s, mesh)
+        s = jax.device_put(s, sharding)
+        warm = jax.jit(lambda st: serf.run(params, st, 12, 7)[0],
+                       out_shardings=sharding)
+        s = jax.block_until_ready(warm(s))
+        meshlib.assert_node_sharded(s.swim.know, n_devices,
+                                    "knowledge matrix (warm)")
+
+        report = {"n_nodes": n, "reps": reps, "devices": n_devices,
+                  "mesh_shape": dict(mesh.shape),
+                  "backend": jax.default_backend(), "sharded": True}
+        passes = {}
+
+        def measure(name, jfn, *args, timer=None):
+            """One audited pass: compile, assert no full node-axis
+            all-gathers, census the collectives, time with `timer`
+            (defaults to the repeated-call timeit; the donated scan
+            passes timeit_chain, which rebinds the consumed carry)."""
+            compiled, stats = compile_with_stats(jfn, *args)
+            if compiled is not None:
+                hlo = compiled.as_text()
+                bad = meshlib.full_gather_ops(hlo, n)
+                assert not bad, (
+                    f"{name}: {len(bad)} all-gather(s) of full "
+                    f"node-axis buffers — first: {bad[0][:200]}")
+                stats["collectives"] = count_collectives(hlo)
+                stats["full_node_gathers"] = 0
+            fn = compiled if compiled is not None else jfn
+            t = (timer or (lambda f, *a: timeit(f, *a, reps=reps)))(
+                fn, *args)
+            passes[name] = {"time_s": round(t, 6), **stats}
+            return t
+
+        full = jax.jit(lambda st: serf.step(params, st),
+                       out_shardings=sharding)
+        report["serf_step_s"] = measure("serf_step", full, s)
+
+        # the bench's inner loop LAST (donation consumes `s`)
+        chunk = 20
+        scan = jax.jit(lambda st: serf.run(params, st, chunk, 7)[0],
+                       donate_argnums=donation(0),
+                       out_shardings=sharding)
+        t = measure("serf_scan_donated(20t)", scan, s,
+                    timer=lambda f, st: timeit_chain(
+                        f, st, reps=max(2, reps // 4)))
+        report["serf_scan_donated_per_tick_s"] = round(t / chunk, 6)
+        report["passes"] = passes
+        print(json.dumps(report, indent=2))
+
+
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    reps = int(sys.argv[2]) if len(sys.argv) > 2 else 20
+    argv = list(sys.argv[1:])
+    devices = None
+    for i, a in enumerate(list(argv)):
+        if a == "--devices":
+            devices = int(argv[i + 1])
+            argv[i:i + 2] = []
+            break
+        if a.startswith("--devices="):
+            devices = int(a.split("=", 1)[1])
+            argv.remove(a)
+            break
+    n = int(argv[0]) if len(argv) > 0 else 1_000_000
+    reps = int(argv[1]) if len(argv) > 1 else 20
+    if devices is not None:
+        main_sharded(n, reps, devices)
+        return
     params = serf.make_params(GossipConfig.lan(),
                               SimConfig(n_nodes=n, rumor_slots=32,
                                         alloc_cap=8, p_loss=0.01, seed=7))
