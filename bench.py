@@ -21,6 +21,7 @@ import numpy as np
 
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.models import serf, swim
+from consul_tpu.profiler import TickProfiler
 from consul_tpu.utils import donation, hard_sync
 
 N = 1_000_000
@@ -83,12 +84,20 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     run = jax.jit(serf.run, static_argnums=(0, 2, 3),
                   donate_argnums=donation(1), out_shardings=out_shardings)
 
+    # always-on tick profile: a local profiler (NOT the process-wide
+    # default — bench numbers must not mix with a live agent's) whose
+    # per-pass EMA table rides the emitted artifact (ROADMAP item 3's
+    # re-baselining input)
+    prof = TickProfiler()
+
     # warm start: steady-state gossip + compile the exact timed shape.
     # HARD sync via host transfer — block_until_ready through the remote
     # tunnel returns early, which silently folded the warm scan and the
     # eager kill dispatch into the timed window
-    s, _ = run(params, s, chunk, victim)
-    hard_sync(s)
+    with prof.span("warm_scan"):
+        s, _ = run(params, s, chunk, victim)
+        hard_sync(s)
+    prof.note_jit("serf.run", run)
 
     s = s.replace(swim=swim.kill(s.swim, victim))
     hard_sync(s.swim.up)   # fence the kill's OUTPUT, not a stale buffer
@@ -96,8 +105,10 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     ticks = 0
     frac = 0.0
     while ticks < max_ticks:
+        tc0 = time.perf_counter()
         s, fr = run(params, s, chunk, victim)
         fr = np.asarray(fr)       # the single host sync per scan
+        prof.observe("timed_scan", time.perf_counter() - tc0)
         ticks += chunk
         if (fr > 0.999).any():
             extra = int(np.argmax(fr > 0.999)) + 1
@@ -106,6 +117,7 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
             break
         frac = float(fr[-1])
     wall = time.time() - t0
+    prof.note_jit("serf.run", run)
 
     # recompile hygiene: the timed loop must have reused the ONE
     # compilation the warm call produced — a second cache entry means
@@ -131,6 +143,10 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     return {"params": params, "state": s, "wall": wall, "frac": frac,
             "ticks": ticks, "converged": ok, "f1": f1,
             "false_commits": false_commits, "compiles": compiles,
+            # per-pass EMA table + recompile accounting (the always-on
+            # profiler's view of THIS bench run; bench_guard tolerates
+            # the key without judging it)
+            "profile": prof.snapshot(),
             # topology stamp: every bench artifact records WHERE the
             # number came from, so the guard can refuse to gate
             # CPU-scaled medians against chip baselines (the exact
@@ -163,6 +179,7 @@ def main():
         "false_commits": r["false_commits"],
         "compiles": r["compiles"],
         "topology": r["topology"],
+        "profile": r["profile"],
         "sim_counters": sim_counters,
     }))
     if not r["converged"]:

@@ -351,8 +351,12 @@ class Agent:
         self._reconcile_thread = threading.Thread(target=reconcile_loop,
                                                   daemon=True)
         self._reconcile_thread.start()
+        from consul_tpu import flight
+        flight.emit("agent.started", labels={"node": self.node_name})
 
     def stop(self) -> None:
+        from consul_tpu import flight
+        flight.emit("agent.stopped", labels={"node": self.node_name})
         self._running = False
         if getattr(self, "usage", None) is not None:
             self.usage.stop()

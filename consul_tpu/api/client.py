@@ -174,6 +174,26 @@ class Client:
         return self._call("GET", "/v1/agent/members",
                           {"segment": segment})[0]
 
+    def agent_events(self, since: int = 0, wait: Optional[str] = None,
+                     name: Optional[str] = None,
+                     limit: Optional[int] = None) -> tuple:
+        """Flight-recorder journal read: (events, last_seq).  `since`
+        is the seq cursor; with `wait` the call blocks server-side
+        until a newer event lands (blocking-query shape)."""
+        params = {"since": str(since)}
+        if wait is not None:
+            params["wait"] = wait
+        if name is not None:
+            params["name"] = name
+        if limit is not None:
+            params["limit"] = str(limit)
+        out, idx, _ = self._call("GET", "/v1/agent/events", params)
+        return out, idx
+
+    def agent_profile(self) -> dict:
+        """The always-on tick profiler's EMA table + recompile count."""
+        return self._call("GET", "/v1/agent/profile")[0]
+
     def agent_service_register(self, name: str, service_id: Optional[str] = None,
                                port: int = 0, tags: List[str] | None = None,
                                check: Optional[dict] = None) -> None:

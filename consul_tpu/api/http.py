@@ -6,6 +6,7 @@ agent/http.go:115 registerEndpoint).  Implemented routes:
 
   status:    /v1/status/leader /v1/status/peers
   agent:     /v1/agent/self /v1/agent/members /v1/agent/metrics
+             /v1/agent/events[?since=&wait=] /v1/agent/profile
              /v1/agent/service/register /v1/agent/service/deregister/<id>
              /v1/agent/check/register /v1/agent/check/(pass|warn|fail)/<id>
              /v1/agent/force-leave/<node> /v1/agent/leave
@@ -1117,6 +1118,57 @@ def _make_handler(srv: ApiServer):
                 limit = int(q["limit"]) if "limit" in q else None
                 self._send(trace.dump(limit=limit,
                                       trace_id=q.get("trace_id")))
+                return True
+            if path == "/v1/agent/events" and verb == "GET":
+                # the flight-recorder journal (consul_tpu/flight.py):
+                # ?since=<seq> cursor + blocking-query semantics — with
+                # ?wait= the request parks on the recorder's condition
+                # until a newer event lands (the monitor/blocking-query
+                # hybrid the reference splits over /v1/event/list and
+                # /v1/agent/monitor)
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
+                from consul_tpu import flight
+                rec = flight.default_recorder()
+                since = int(q.get("since", 0) or 0)
+                limit = int(q["limit"]) if "limit" in q else None
+                flt = {"name": q.get("name"),
+                       "severity": q.get("severity")}
+                rows, horizon = rec.read_page(since=since, limit=limit,
+                                              **flt)
+                if "wait" in q and limit != 0:
+                    # park until a MATCHING event exists (or timeout):
+                    # waiting on "any event" once would instantly
+                    # return empty pages while unrelated traffic keeps
+                    # the journal busy — a filtered watch would
+                    # busy-loop.  limit=0 can never match; answer now.
+                    deadline = time.time() + _parse_wait(q["wait"])
+                    while not rows and time.time() < deadline:
+                        rec.wait(horizon, deadline - time.time())
+                        rows, horizon = rec.read_page(
+                            since=since, limit=limit, **flt)
+                # the cursor header is the last seq actually RETURNED
+                # (a ?limit= page never skips the still-pending rows
+                # behind it); an EMPTY result advances to the horizon
+                # the scan examined under the read lock — everything
+                # up to it is known non-matching, and anything newer
+                # raced in AFTER the scan so the next poll sees it
+                self._send([{
+                    "Seq": r["seq"], "Ts": r["ts"], "Name": r["name"],
+                    "Severity": r["severity"], "Labels": r["labels"],
+                    "TraceID": r["trace_id"], "Msg": r.get("msg", "")}
+                    for r in rows],
+                    index=rows[-1]["seq"] if rows
+                    else max(since, horizon))
+                return True
+            if path == "/v1/agent/profile" and verb == "GET":
+                # the always-on tick profiler (consul_tpu/profiler.py):
+                # per-pass EMA table + recompile accounting — the live
+                # sibling of tools/profile_swim.py's offline report
+                if not self.authz.agent_read(srv.node_name):
+                    return self._forbid()
+                from consul_tpu.profiler import default_profiler
+                self._send(default_profiler().snapshot())
                 return True
             if path == "/v1/agent/metrics" and verb == "GET":
                 if not self.authz.agent_read(srv.node_name):

@@ -30,6 +30,7 @@ class Autopilot:
         self.server = server
         self.config = config or AutopilotConfig()
         self._unhealthy_since: Dict[str, float] = {}
+        self._last_healthy: Dict[str, bool] = {}
         self.removed: List[str] = []
 
     # --------------------------------------------------------------- health
@@ -64,10 +65,26 @@ class Autopilot:
     def run(self, now: float) -> None:
         """One autopilot pass — call from the leader's tick
         (the reference's promoter loop)."""
+        from consul_tpu import flight
         raft = self.server.raft
-        if not raft.is_leader() or not self.config.cleanup_dead_servers:
+        if not raft.is_leader():
             return
         health = {h["ID"]: h for h in self.server_health(now)}
+        # journal health TRANSITIONS (not steady state) BEFORE the
+        # cleanup gate: turning dead-server cleanup off must not blind
+        # the observability feed — ts is the caller's clock, virtual
+        # under the test cluster, so timelines stay deterministic
+        for sid, h in health.items():
+            prev = self._last_healthy.get(sid)
+            if prev is not None and prev != h["Healthy"]:
+                flight.emit("autopilot.health.changed",
+                            labels={"server": sid,
+                                    "healthy": h["Healthy"]},
+                            severity="info" if h["Healthy"] else "warn",
+                            ts=now)
+            self._last_healthy[sid] = h["Healthy"]
+        if not self.config.cleanup_dead_servers:
+            return
         for peer in list(raft.peers):
             h = health.get(peer)
             if h is None or h["Healthy"]:
@@ -84,5 +101,7 @@ class Autopilot:
                 raft.remove_peer(peer)
                 self.removed.append(peer)
                 self._unhealthy_since.pop(peer, None)
+                flight.emit("autopilot.server.removed",
+                            labels={"server": peer}, ts=now)
             except Exception:
                 pass  # not leader anymore / racing change — retry next tick

@@ -113,16 +113,27 @@ class LinkInjector:
 
     def set_default(self, **kw) -> None:
         self.default = LinkRule(**kw) if kw else None
+        from consul_tpu import flight
+        flight.emit("chaos.fault.injected" if kw
+                    else "chaos.fault.healed",
+                    labels={"fault": "link", "target": "*"})
 
     def set_link(self, src: Optional[str], dst: Optional[str],
                  **kw) -> None:
         """Rule for a directed link; None is a wildcard endpoint —
         asymmetric faults are (src, None) rules."""
         self.links[(src, dst)] = LinkRule(**kw)
+        from consul_tpu import flight
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": "link",
+                            "target": f"{src or '*'}|{dst or '*'}"})
 
     def clear(self) -> None:
         self.default = None
         self.links.clear()
+        from consul_tpu import flight
+        flight.emit("chaos.fault.healed",
+                    labels={"fault": "link", "target": "*"})
 
     def _rule(self, src: str, dst: str) -> Optional[LinkRule]:
         return (self.links.get((src, dst))
@@ -224,8 +235,19 @@ class FaultyStorage(storage.StorageOps):
         self.op_count += 1
         self.oplog.append((kind, os.path.basename(path)))
         if self.crash_at is not None and i >= self.crash_at:
+            self._journal("crash_at",
+                          f"{kind}:{os.path.basename(path)}@{i}")
             raise SimulatedCrash(i, kind, path)
         return i
+
+    @staticmethod
+    def _journal(fault: str, target: str) -> None:
+        """Each storage betrayal is one correlated flight-recorder row
+        (ts from the recorder's clock — constant under the nemesis, so
+        timelines stay byte-identical)."""
+        from consul_tpu import flight
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": fault, "target": target})
 
     def _file_rng(self, path: str) -> random.Random:
         return random.Random(
@@ -270,6 +292,7 @@ class FaultyStorage(storage.StorageOps):
             else:
                 self.enospc_after_writes -= 1
         if self.enospc:
+            self._journal("enospc", os.path.basename(path))
             raise OSError(errno.ENOSPC, "No space left on device")
         f.write(data)
 
@@ -279,9 +302,11 @@ class FaultyStorage(storage.StorageOps):
         f.flush()
         if self.fail_next_fsyncs > 0:
             self.fail_next_fsyncs -= 1
+            self._journal("fsync_eio", os.path.basename(path))
             raise OSError(errno.EIO, "Input/output error")
         if self.lose_next_fsyncs > 0:
             self.lose_next_fsyncs -= 1
+            self._journal("fsync_lost", os.path.basename(path))
             return                      # the disk lied: nothing durable
         try:
             with open(path, "rb") as r:
@@ -318,6 +343,7 @@ class FaultyStorage(storage.StorageOps):
         materialize it onto the real files, applying the armed
         betrayals (torn tails, reordered renames, bit rot).  The model
         stays usable afterwards — its durable map is the new disk."""
+        self._journal("power_loss", "disk")
         for f in self._handles:
             try:
                 f.close()
@@ -969,6 +995,10 @@ class RaftChaosHarness:
         FaultyStorage the crash also collapses the simulated page
         cache, tearing/losing whatever the fault schedule dictates;
         only durable bytes greet the restart."""
+        from consul_tpu import flight
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": "crash", "target": nid},
+                    ts=self.now)
         node = self.nodes[nid]
         if node.store is not None:
             node.store.abort()
@@ -983,6 +1013,10 @@ class RaftChaosHarness:
         if not self.durable:
             raise RuntimeError("restart without a durable log would "
                                "forge raft persistent state")
+        from consul_tpu import flight
+        flight.emit("chaos.fault.healed",
+                    labels={"fault": "crash", "target": nid},
+                    ts=self.now)
         self.logs[nid].clear()
         self.value[nid] = None
         self.nodes[nid] = self._mk_node(nid)
@@ -1182,8 +1216,22 @@ class SwimChaosHarness:
 
     def _check_clean(self) -> None:
         np = self._np
-        committed = np.asarray(self.state.committed_dead) \
-            | np.asarray(self.state.committed_left)
+        dead = np.asarray(self.state.committed_dead)
+        committed = dead | np.asarray(self.state.committed_left)
+        # flap feed: each NEWLY committed member journals one event —
+        # O(changes) rows per chunk, stamped with the device tick so a
+        # seeded scenario's timeline replays byte-identical
+        new = committed & ~self.ever_committed
+        if new.any():
+            from consul_tpu import flight
+            tick = int(self.state.tick)
+            for i in np.flatnonzero(new):
+                flight.emit(
+                    "serf.member.flap",
+                    labels={"node": f"node{int(i)}",
+                            "status": "failed" if dead[i] else "left",
+                            "tick": tick},
+                    ts=float(tick))
         self.ever_committed |= committed
         bad = committed & self.clean & np.asarray(self.state.up) \
             & np.asarray(self.state.member)
@@ -1203,11 +1251,14 @@ class SwimChaosHarness:
         np, jnp = self._np, _jnp()
         mask = np.asarray(mask, bool)
         self.clean &= ~mask
+        self._journal("chaos.fault.injected", "partition",
+                      f"{int(mask.sum())}nodes")
         self.state = self.state.replace(
             chaos_grp=jnp.asarray(mask.astype(np.int16)))
 
     def heal_partition(self) -> None:
         jnp = _jnp()
+        self._journal("chaos.fault.healed", "partition", "*")
         self.state = self.state.replace(
             chaos_grp=jnp.zeros((self.n,), jnp.int16))
 
@@ -1216,6 +1267,8 @@ class SwimChaosHarness:
         mask = np.asarray(mask, bool)
         self.clean &= ~mask
         self.crashed |= mask
+        self._journal("chaos.fault.injected", "crash",
+                      f"{int(mask.sum())}nodes")
         self.state = self._swim.kill_mask(self.state, _jnp().asarray(mask))
 
     def flap_revive(self, mask) -> None:
@@ -1225,6 +1278,8 @@ class SwimChaosHarness:
         np = self._np
         mask = np.asarray(mask, bool)
         self.crashed &= ~mask
+        self._journal("chaos.fault.healed", "crash",
+                      f"{int(mask.sum())}nodes")
         self.state = self._swim.revive_mask(self.state,
                                             _jnp().asarray(mask))
 
@@ -1233,6 +1288,8 @@ class SwimChaosHarness:
         nodes deliver each of THEIR legs at rate `ok`."""
         np, jnp = self._np, _jnp()
         mask = np.asarray(mask, bool)
+        self._journal("chaos.fault.injected", "degrade",
+                      f"{int(mask.sum())}nodes@{ok}")
         cur = np.array(self.state.chaos_ok)      # writable host copy
         cur[mask] = ok
         self.state = self.state.replace(chaos_ok=jnp.asarray(cur))
@@ -1242,14 +1299,24 @@ class SwimChaosHarness:
         the baseline — realized as a global per-node multiplier of
         sqrt(1-p) (a leg pays both endpoints)."""
         jnp = _jnp()
+        self._journal("chaos.fault.injected", "loss", f"p={p}")
         self.state = self.state.replace(
             chaos_ok=jnp.full((self.n,), math.sqrt(max(0.0, 1.0 - p)),
                               jnp.float32))
 
     def calm(self) -> None:
         jnp = _jnp()
+        self._journal("chaos.fault.healed", "loss", "*")
         self.state = self.state.replace(
             chaos_ok=jnp.ones((self.n,), jnp.float32))
+
+    def _journal(self, name: str, fault: str, target: str) -> None:
+        """One correlated flight-recorder row per injected fault,
+        stamped with the device tick (deterministic)."""
+        from consul_tpu import flight
+        tick = int(self.state.tick)
+        flight.emit(name, labels={"fault": fault, "target": target,
+                                  "tick": tick}, ts=float(tick))
 
     # --------------------------------------------------------------- checks
 
@@ -1933,5 +2000,21 @@ CHECK_SCENARIOS = ("partition_heal", "crash_restart", "loss_burst",
 
 
 def run_scenario(name: str, seed: int, tmp: Optional[str] = None,
-                 soak: bool = False) -> dict:
-    return SCENARIOS[name](seed, tmp=tmp, soak=soak)
+                 soak: bool = False, recorder=None) -> dict:
+    """Run one scenario under a scoped flight recorder and attach its
+    timeline to the report (`"events"`: JSON lines, one row per
+    injected fault / flap / election / recovery event).
+
+    The default recorder uses a CONSTANT clock and no log fan-out, so
+    every row's timestamp comes from the emitters' own virtual clocks
+    (raft `now`, device tick) — a seeded run's timeline is
+    byte-identical across replays, which `chaos_soak --check` asserts.
+    Pass `recorder=flight.default_recorder()` to journal into the
+    process ring instead (the /v1/agent/events + debug-bundle path)."""
+    from consul_tpu import flight
+    rec = recorder if recorder is not None else flight.FlightRecorder(
+        clock=lambda: 0.0, forward_to_log=False)
+    with flight.use(rec):
+        row = SCENARIOS[name](seed, tmp=tmp, soak=soak)
+    row["events"] = rec.dump_jsonl().decode()
+    return row

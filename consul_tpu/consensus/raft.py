@@ -134,6 +134,11 @@ class InMemTransport(Transport):
             self._cut.add((a, b))
             if bidir:
                 self._cut.add((b, a))
+            now = self._now
+        from consul_tpu import flight
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": "partition", "target": f"{a}|{b}"},
+                    ts=now)
 
     def heal(self, a: Optional[str] = None, b: Optional[str] = None) -> None:
         with self._lock:
@@ -142,6 +147,12 @@ class InMemTransport(Transport):
             else:
                 self._cut.discard((a, b))
                 self._cut.discard((b, a))
+            now = self._now
+        from consul_tpu import flight
+        flight.emit("chaos.fault.healed",
+                    labels={"fault": "partition",
+                            "target": "*" if a is None else f"{a}|{b}"},
+                    ts=now)
 
     def isolate(self, node_id: str) -> None:
         with self._lock:
@@ -312,10 +323,28 @@ class RaftNode:
         self.voted_for = state["voted_for"]
         self.log_base = state["base"]
         self.log_base_term = state["base_term"]
+        # journal what recovery found (staged — flushed with the first
+        # tick's metrics; no ts, so the recorder's clock stamps it:
+        # deterministic under the nemesis's fixed-clock recorder)
+        rec = state.get("recovery") or {}
+        self._metrics_buf.append(
+            ("e", "raft.recovery.completed",
+             {"node": self.node_id,
+              "torn_tail": rec.get("torn_tail", 0),
+              "corrupt_frame": rec.get("corrupt_frame", 0),
+              "meta_fallback": rec.get("meta_fallback", False),
+              "snap_fallback": rec.get("snap_fallback", False),
+              "snap_lost": rec.get("snap_lost", False),
+              "wal_window_dropped": state["base"] > state["snap_index"]},
+             None))
         if state["snapshot"] is not None:
             self.snapshot_data = state["snapshot"]
             self.snap_index = state["snap_index"]
             self.snap_term = state["snap_term"]
+            self._metrics_buf.append(
+                ("e", "raft.snapshot.restored",
+                 {"node": self.node_id, "index": state["snap_index"],
+                  "term": state["snap_term"]}, None))
             self._unwrap_restore(state["snapshot"])
         if self.log_base > self.snap_index:
             # the WAL window assumes a NEWER snapshot than the one
@@ -399,16 +428,28 @@ class RaftNode:
             return self.state == LEADER
 
     def _flush_metrics(self) -> None:
-        """Emit staged metrics; call with the raft lock RELEASED."""
+        """Emit staged metrics + flight events; call with the raft
+        lock RELEASED (sinks may do I/O; flight forwards to the log
+        fan-out)."""
         with self._lock:
             if not self._metrics_buf:
                 return
             buf, self._metrics_buf = self._metrics_buf, []
-        for kind, name, value in buf:
+        for kind, name, value, *rest in buf:
             if kind == "c":
                 telemetry.incr_counter(name, value)
             elif kind == "g":
                 telemetry.set_gauge(name, value)
+            elif kind == "e":
+                # staged flight event: (kind, name, labels, ts) — ts is
+                # the raft clock at the transition (virtual under the
+                # nemesis, so chaos timelines replay byte-identical).
+                # trace_id explicitly empty: the flush may run inside
+                # some unrelated traced request, but the transition it
+                # reports happened in raft's own time, not that trace
+                from consul_tpu import flight
+                flight.emit(name, labels=value, ts=rest[0],
+                            trace_id="")
             else:
                 telemetry.add_sample(name, value)
 
@@ -541,11 +582,18 @@ class RaftNode:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
         if term > self.current_term:
+            self._metrics_buf.append(
+                ("e", "raft.term.changed",
+                 {"node": self.node_id, "term": term,
+                  "from": self.current_term}, now))
             self.current_term = term
             self.voted_for = None
             self._persist_term_vote()
         self._reset_election_timer(now)
         if was_leader:
+            self._metrics_buf.append(
+                ("e", "raft.leadership.lost",
+                 {"node": self.node_id, "term": self.current_term}, now))
             self._fail_pending(NotLeaderError(self.leader_id))
             for fn in self._leader_observers:
                 fn(False)
@@ -578,6 +626,9 @@ class RaftNode:
         self.state = CANDIDATE
         self._metrics_buf.append(("c", ("raft", "state", "candidate"), 1.0))
         self.current_term += 1
+        self._metrics_buf.append(
+            ("e", "raft.election.started",
+             {"node": self.node_id, "term": self.current_term}, now))
         self.voted_for = self.node_id
         # durable BEFORE any request_vote leaves: a crashed-and-
         # restarted candidate must not double-vote in this term
@@ -600,6 +651,9 @@ class RaftNode:
             self.state = LEADER
             self._metrics_buf.append(("c", ("raft", "state", "leader"),
                                       1.0))
+            self._metrics_buf.append(
+                ("e", "raft.election.won",
+                 {"node": self.node_id, "term": self.current_term}, now))
             self.leader_id = self.node_id
             nxt = self.last_log_index + 1
             self.next_index = {p: nxt for p in self.peers}
@@ -793,6 +847,10 @@ class RaftNode:
             self._last_contact = now
             self._reset_election_timer(now)
             if msg["last_index"] > self.last_applied:
+                self._metrics_buf.append(
+                    ("e", "raft.snapshot.installed",
+                     {"node": self.node_id, "index": msg["last_index"],
+                      "term": msg["last_term"]}, now))
                 self._unwrap_restore(msg["data"])
                 self.snapshot_data = msg["data"]
                 self.log_base = msg["last_index"]

@@ -110,9 +110,12 @@ def capture(agent=None, intervals: int = 2,
             interval_s: float = 0.5) -> bytes:
     """Sampled debug archive (debug.go capture loop): per-interval
     metrics (JSON + prometheus exposition) + thread dumps, plus
-    one-shot host/agent/log captures and the trace-span ring buffer."""
-    from consul_tpu import telemetry, trace
+    one-shot host/agent/log captures, the trace-span ring buffer, the
+    flight-recorder event journal (events.jsonl), and the tick
+    profiler's EMA table (profile.json)."""
+    from consul_tpu import flight, telemetry, trace
     from consul_tpu.logging import default_buffer
+    from consul_tpu.profiler import default_profiler
 
     buf = io.BytesIO()
     with tarfile.open(fileobj=buf, mode="w:gz") as tar:
@@ -145,9 +148,12 @@ def capture(agent=None, intervals: int = 2,
             add(f"{i}/threads.txt", thread_dump().encode())
             if i < intervals - 1:
                 time.sleep(interval_s)
-        # the span ring LAST: it then includes spans recorded during
-        # the capture window itself
+        # the rings LAST: they then include spans/events recorded
+        # during the capture window itself
         add("trace.json", json.dumps(trace.dump(), indent=2).encode())
+        add("events.jsonl", flight.default_recorder().dump_jsonl())
+        add("profile.json", json.dumps(default_profiler().snapshot(),
+                                       indent=2).encode())
     return buf.getvalue()
 
 

@@ -1,0 +1,365 @@
+"""Flight recorder: a process-wide structured event journal.
+
+PR 1's metrics and PR 2's profiles record *what is slow*; this module
+records *what happened when*.  The reference scatters that story over
+streaming log monitors (logging/monitor), `consul debug` archives, and
+Serf user events — an operator reconstructing an incident greps three
+surfaces and correlates timestamps by hand.  Here every layer that
+already KNOWS something happened (raft elections, WAL recovery,
+membership flaps, chaos injections, autopilot removals, user events)
+journals one structured row into a single bounded ring:
+
+    {"seq", "ts", "name", "severity", "labels", "trace_id", "msg"}
+
+Design constraints, deliberate:
+
+  * **Registered schema.**  Every event name and its allowed label
+    keys are declared in `CATALOG` below — a literal dict, so the
+    `event-names` lint checker (tools/lint/checkers/metric_names.py)
+    can validate emit sites statically, and `emit()` enforces the same
+    contract at runtime.  An unregistered name is a bug, not a row.
+  * **Bounded memory, bounded emission cost.**  A deque ring (one
+    lock, one append) exactly like trace.py's span ring; label values
+    are clamped; nothing on the emit path blocks.  Optional WAL spill
+    writes evicted/all rows through the `storage.py` seam (so the
+    storage nemesis can sit under it), best-effort, never fsynced on
+    the emit path.
+  * **Deterministic under the nemesis.**  `ts` comes from the
+    caller's clock when passed explicitly (raft passes its virtual
+    `now`; the SWIM harness passes the device tick) and from the
+    recorder's `clock` otherwise — chaos scenarios install a scoped
+    recorder with a constant clock, so the journal of a seeded run is
+    byte-identical across replays (chaos_soak --check asserts it).
+  * **O(flaps), never O(N).**  The membership emitter consumes
+    `oracle.members_delta()` — the PR 6 gather-free incremental read —
+    so a 16M-node pool with 50 flaps per checkpoint journals 50 rows
+    and moves 50 rows over the device→host seam (asserted by spying
+    `oracle._to_host`).
+
+Serving: /v1/agent/events (blocking-query + ?since= cursor), events
+multiplexed onto /v1/agent/monitor streams through the log buffer, and
+`debug.capture()` bundles carry the ring as events.jsonl.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Tuple
+
+SEVERITIES = ("info", "warn", "error")
+
+RING = 4096
+MAX_LABELS = 8
+MAX_LABEL_VALUE = 128
+
+# ---------------------------------------------------------------------------
+# The event catalog: name -> {"severity": default, "labels": allowed keys}.
+#
+# A LITERAL dict, deliberately: the event-names lint checker parses this
+# assignment's AST to validate emit sites without importing anything.
+# Register new events here (and nowhere else); an emit of an
+# unregistered name raises at runtime and fails the lint gate at review
+# time.  Label keys are the bounded vocabulary — values vary (node ids,
+# terms), keys may not.
+# ---------------------------------------------------------------------------
+
+CATALOG: Dict[str, dict] = {
+    # agent lifecycle
+    "agent.started": {"severity": "info", "labels": ("node",)},
+    "agent.stopped": {"severity": "info", "labels": ("node",)},
+    # raft / consensus (emitters in consensus/raft.py, staged through
+    # the same buffer as the raft metrics so nothing emits under the
+    # raft lock; ts is the raft tick's `now` — virtual under the
+    # nemesis, wall-clock live)
+    "raft.election.started": {"severity": "info",
+                              "labels": ("node", "term")},
+    "raft.election.won": {"severity": "info", "labels": ("node", "term")},
+    "raft.leadership.lost": {"severity": "warn",
+                             "labels": ("node", "term")},
+    "raft.term.changed": {"severity": "info",
+                          "labels": ("node", "term", "from")},
+    "raft.snapshot.installed": {"severity": "info",
+                                "labels": ("node", "index", "term")},
+    "raft.snapshot.restored": {"severity": "info",
+                               "labels": ("node", "index", "term")},
+    "raft.recovery.completed": {
+        "severity": "info",
+        "labels": ("node", "torn_tail", "corrupt_frame", "meta_fallback",
+                   "snap_fallback", "snap_lost", "wal_window_dropped")},
+    # membership (the oracle's members_delta flap feed + the chaos
+    # harness's ground-truth commit diffs)
+    "serf.member.flap": {"severity": "info",
+                         "labels": ("node", "status", "tick")},
+    "serf.flap.truncated": {"severity": "warn",
+                            "labels": ("count", "limit", "tick")},
+    # serf user events (oracle.fire_event; trace id rides from the
+    # HTTP entry contextvar so /v1/event/fire correlates end to end)
+    "serf.user_event": {"severity": "info",
+                        "labels": ("name", "origin", "id", "ltime")},
+    # chaos nemesis: every injected fault is a correlated row so a
+    # soak violation prints a timeline next to the seed reproducer
+    "chaos.fault.injected": {"severity": "warn",
+                             "labels": ("fault", "target", "tick")},
+    "chaos.fault.healed": {"severity": "info",
+                           "labels": ("fault", "target", "tick")},
+    # autopilot (server-health transitions + dead-server cleanup)
+    "autopilot.health.changed": {"severity": "warn",
+                                 "labels": ("server", "healthy")},
+    "autopilot.server.removed": {"severity": "warn",
+                                 "labels": ("server",)},
+    # runtime (the tick profiler's recompile watchdog)
+    "runtime.recompile": {"severity": "warn",
+                          "labels": ("fn", "cache_size")},
+}
+
+
+class FlightRecorder:
+    """Bounded event ring + optional WAL spill + subscriber wakeups."""
+
+    def __init__(self, ring: int = RING,
+                 clock: Callable[[], float] = time.time,
+                 forward_to_log: bool = True):
+        self._ring: deque = deque(maxlen=ring)
+        self._clock = clock
+        self._forward_to_log = forward_to_log
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._seq = 0
+        self._spill = None          # (ops, file handle, path)
+        self._spill_lock = threading.Lock()
+        # re-entrancy guard: a nemesis-backed spill (FaultyStorage)
+        # journals its OWN fault events from inside ops.write() — that
+        # nested emit must skip the spill (ring-only) or it would
+        # deadlock on the spill lock / recurse through the fault
+        self._spill_tls = threading.local()
+        self.dropped = 0            # spill write failures (best-effort)
+
+    # ----------------------------------------------------------------- emit
+
+    def emit(self, name: str, labels: Optional[dict] = None,
+             severity: Optional[str] = None, msg: str = "",
+             trace_id: Optional[str] = None,
+             ts: Optional[float] = None) -> int:
+        """Journal one event; returns its seq.  Raises ValueError on an
+        unregistered name or undeclared label key — the runtime twin of
+        the event-names lint gate (all emitters are in-repo; misuse is
+        a bug to surface, not traffic to shed)."""
+        schema = CATALOG.get(name)
+        if schema is None:
+            raise ValueError(f"unregistered event name {name!r} — "
+                             f"add it to flight.CATALOG")
+        allowed = schema.get("labels", ())
+        lbl: Dict[str, str] = {}
+        if labels:
+            if len(labels) > MAX_LABELS:
+                raise ValueError(f"{len(labels)} labels on {name!r} > "
+                                 f"{MAX_LABELS}")
+            for k, v in labels.items():
+                if k not in allowed:
+                    raise ValueError(
+                        f"label {k!r} not declared for event {name!r} "
+                        f"(allowed: {allowed})")
+                lbl[k] = str(v)[:MAX_LABEL_VALUE]
+        sev = severity or schema.get("severity", "info")
+        if sev not in SEVERITIES:
+            raise ValueError(f"severity {sev!r} not one of {SEVERITIES}")
+        if trace_id is None:
+            from consul_tpu import trace
+            trace_id = trace.current_trace() or ""
+        rec = {"seq": 0,        # assigned under the lock below
+               "ts": round(self._clock() if ts is None else ts, 6),
+               "name": name, "severity": sev, "labels": lbl,
+               "trace_id": trace_id}
+        if msg:
+            rec["msg"] = msg
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+            spill = self._spill
+            self._cond.notify_all()
+        if spill is not None and \
+                not getattr(self._spill_tls, "busy", False):
+            # spill I/O OUTSIDE the ring lock: a slow disk must never
+            # serialize emitters/readers/waiters behind a write (the
+            # whole point of raft's staged emission).  The dedicated
+            # spill lock keeps lines whole; concurrent emitters may
+            # interleave out of seq order — rows carry their seq.
+            # Events emitted FROM the spill write itself (a nemesis
+            # disk journaling its injected fault) stay ring-only.
+            ops, f, _ = spill
+            self._spill_tls.busy = True
+            try:
+                with self._spill_lock:
+                    # re-check under the spill lock: a concurrent
+                    # detach_spill() may have popped + closed the
+                    # handle since we snapshotted it above
+                    if self._spill is spill:
+                        ops.write(f, (json.dumps(rec, sort_keys=True)
+                                      + "\n").encode())
+            except (OSError, ValueError):
+                self.dropped += 1       # spill is best-effort
+            finally:
+                self._spill_tls.busy = False
+        if self._forward_to_log:
+            self._to_log(rec)
+        return rec["seq"]
+
+    @staticmethod
+    def _to_log(rec: dict) -> None:
+        """Multiplex the event onto the log plane: one formatted line
+        into the process LogBuffer, which fans it out to every live
+        /v1/agent/monitor subscription (logging/monitor role)."""
+        from consul_tpu.logging import default_buffer
+        level = {"info": "INFO", "warn": "WARN",
+                 "error": "ERROR"}[rec["severity"]]
+        kv = "".join(f" {k}={v}" for k, v in rec["labels"].items())
+        wall = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+        default_buffer().write(
+            f"{wall} [{level}] flight: event={rec['name']}"
+            f" seq={rec['seq']}{kv}"
+            + (f" trace_id={rec['trace_id']}" if rec["trace_id"] else ""))
+
+    # ----------------------------------------------------------------- read
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def read_page(self, since: int = 0, limit: Optional[int] = None,
+                  name: Optional[str] = None,
+                  severity: Optional[str] = None
+                  ) -> Tuple[List[dict], int]:
+        """(rows, horizon): events with seq > `since`, oldest first,
+        optionally filtered and capped to the OLDEST `limit` rows —
+        forward-paging semantics (`tail()` serves the newest-N case).
+        `horizon` is the journal's last seq captured under the SAME
+        lock as the scan: when rows is empty, every event ≤ horizon
+        was examined and did not match, so a cursor may safely advance
+        to it (the blocking-query endpoint leans on this — echoing a
+        stale cursor past live non-matching traffic would busy-loop
+        the client).  `limit=0` examines nothing, so its horizon is
+        `since` itself — never an advance past rows the zero-size page
+        merely truncated away."""
+        if limit == 0:
+            return [], since
+        with self._lock:
+            out = [dict(r) for r in self._ring if r["seq"] > since]
+            horizon = self._seq
+        if name is not None:
+            out = [r for r in out if r["name"] == name]
+        if severity is not None:
+            out = [r for r in out if r["severity"] == severity]
+        if limit is not None and limit >= 0:
+            out = out[:limit]
+        return out, horizon
+
+    def read(self, since: int = 0, limit: Optional[int] = None,
+             name: Optional[str] = None,
+             severity: Optional[str] = None) -> List[dict]:
+        """read_page() without the horizon."""
+        return self.read_page(since, limit, name, severity)[0]
+
+    def tail(self, n: int) -> List[dict]:
+        with self._lock:
+            out = list(self._ring)[-n:] if n else []
+        return [dict(r) for r in out]
+
+    def wait(self, since: int, timeout: float) -> int:
+        """Block until an event with seq > `since` exists (or timeout);
+        returns the latest seq — the blocking-query wait behind
+        /v1/agent/events?since=N&wait=T."""
+        deadline = time.monotonic() + max(0.0, timeout)
+        with self._lock:
+            while self._seq <= since:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            return self._seq
+
+    def dump_jsonl(self) -> bytes:
+        """The whole ring as JSON lines (the debug-archive section;
+        sort_keys so a fixed-clock recorder's dump is byte-stable)."""
+        with self._lock:
+            rows = list(self._ring)
+        return "".join(json.dumps(r, sort_keys=True) + "\n"
+                       for r in rows).encode()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # ---------------------------------------------------------------- spill
+
+    def attach_spill(self, path: str, ops=None) -> None:
+        """Append every subsequent event as a JSON line to `path`
+        through the storage seam (`storage.StorageOps`) — the WAL
+        spill: the ring bounds memory, the spill keeps history.  Never
+        fsynced on the emit path; `detach_spill()` flushes."""
+        from consul_tpu import storage
+        io = ops or storage.OS
+        f = io.open_append(path)
+        with self._lock:
+            self._spill = (io, f, path)
+
+    def detach_spill(self, sync: bool = False) -> None:
+        with self._lock:
+            spill, self._spill = self._spill, None
+        if spill is None:
+            return
+        ops, f, _ = spill
+        try:
+            with self._spill_lock:      # drain in-flight line writes
+                if sync:
+                    ops.fsync(f)
+                f.close()
+        except OSError:
+            self.dropped += 1
+
+
+# ---------------------------------------------------------------------------
+# process-wide default + scoped override (the chaos harness installs a
+# deterministic-clock recorder for the duration of one scenario)
+# ---------------------------------------------------------------------------
+
+_default = FlightRecorder()
+_current = _default
+_swap_lock = threading.Lock()
+
+
+def default_recorder() -> FlightRecorder:
+    return _default
+
+
+def current() -> FlightRecorder:
+    return _current
+
+
+@contextmanager
+def use(recorder: FlightRecorder):
+    """Route module-level `emit()` to `recorder` within the block.
+    Process-global (not thread-local) by design: the nemesis owns the
+    process while a scenario runs, and emitters deep in raft/oracle
+    must not need a recorder threaded through every signature."""
+    global _current
+    with _swap_lock:
+        prev, _current = _current, recorder
+    try:
+        yield recorder
+    finally:
+        with _swap_lock:
+            _current = prev
+
+
+def emit(name: str, labels: Optional[dict] = None,
+         severity: Optional[str] = None, msg: str = "",
+         trace_id: Optional[str] = None,
+         ts: Optional[float] = None) -> int:
+    return _current.emit(name, labels=labels, severity=severity,
+                         msg=msg, trace_id=trace_id, ts=ts)

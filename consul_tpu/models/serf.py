@@ -94,6 +94,13 @@ def status_vector(params: SerfParams, s: ClusterState) -> jnp.ndarray:
     return swim.status_vector(params.swim, s.swim)
 
 
+def shard_metrics(params: SerfParams, s: ClusterState,
+                  n_blocks: int) -> jnp.ndarray:
+    """[B, K] per-shard gauges (swim.SHARD_METRIC_NAMES order) — the
+    consul.serf.*{shard} split, one transfer per scrape."""
+    return swim.shard_metrics(params.swim, s.swim, n_blocks)
+
+
 def membership_counts(params: SerfParams, s: ClusterState,
                       provisioned: jnp.ndarray) -> jnp.ndarray:
     return swim.membership_counts(params.swim, s.swim, provisioned)

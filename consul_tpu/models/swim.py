@@ -1430,6 +1430,40 @@ def metrics_vector(params: SwimParams, s: SwimState) -> jnp.ndarray:
     return jnp.concatenate([s.ctr, gauges])
 
 
+# Per-shard split of the pool gauges (flight-recorder telemetry): the
+# node axis reshapes into `n_blocks` contiguous blocks — exactly the
+# mesh shards under `SimConfig.shard_blocks` — and each gauge reduces
+# per block.  Under a node-sharded mesh every block's reduction is
+# device-local; only the tiny [B, K] table replicates and transfers.
+SHARD_METRIC_NAMES = (
+    "members.alive", "members.failed_committed",
+    "members.left_committed", "awareness.mean",
+)
+
+
+def shard_metrics(params: SwimParams, s: SwimState,
+                  n_blocks: int) -> jnp.ndarray:
+    """[n_blocks, len(SHARD_METRIC_NAMES)] f32 per-shard gauges (jit
+    with n_blocks static).  Same checkpoint discipline as
+    metrics_vector: reductions over state the device already holds,
+    one small transfer per scrape."""
+    f32 = jnp.float32
+
+    def blk(x):
+        return x.reshape(n_blocks, -1)
+
+    live = blk(s.up & s.member)
+    alive = jnp.sum(live, axis=1).astype(f32)
+    n_live = jnp.maximum(alive, 1.0)
+    failed = jnp.sum(blk(s.committed_dead), axis=1).astype(f32)
+    left = jnp.sum(blk(s.committed_left), axis=1).astype(f32)
+    aware = jnp.sum(
+        blk(jnp.where(s.up & s.member,
+                      s.awareness.astype(jnp.int32), 0)),
+        axis=1).astype(f32) / n_live
+    return jnp.stack([alive, failed, left, aware], axis=1)
+
+
 # ---------------------------------------------------------------------------
 # oracle read path: device-side membership reductions (gather-free)
 # ---------------------------------------------------------------------------

@@ -90,6 +90,8 @@ class GossipOracle:
         self._step = jax.jit(serf.step, static_argnums=0,
                              out_shardings=self._sharding)
         self._metrics_fn = jax.jit(serf.metrics_vector, static_argnums=0)
+        self._shard_metrics_fn = jax.jit(serf.shard_metrics,
+                                         static_argnums=(0, 2))
         # gather-free read kernels (bound once — recompile hygiene):
         # device-side reductions whose outputs are O(page), never O(N)
         self._counts_fn = jax.jit(serf.membership_counts, static_argnums=0)
@@ -119,9 +121,13 @@ class GossipOracle:
             prov = jax.device_put(
                 prov, meshlib.state_sharding(prov, mesh))
         self._prov_dev = prov
-        # device-side status checkpoint for members_delta(); None until
-        # the first delta call establishes it
+        # device-side status checkpoints, one per delta CONSUMER: the
+        # public members_delta() cursor and the flight recorder's flap
+        # journal each own a slot — a metrics scrape consuming the
+        # journal's delta must never starve a delta client (or vice
+        # versa).  None until that consumer's first call establishes it.
         self._status_ckpt = None
+        self._flap_ckpt = None
         self._events: List[dict] = []           # host-side payload ring
         self._event_ring = 256                  # reference ring size
         # gossip keyring (serf keyring: install/use/remove/list — the
@@ -169,11 +175,20 @@ class GossipOracle:
             self._thread = None
 
     def advance(self, n_ticks: int = 1) -> None:
+        from consul_tpu.profiler import default_profiler
+        prof = default_profiler()
+        t0 = time.perf_counter()
         with self._lock:
             s = self._state
             for _ in range(n_ticks):
                 s = self._step(self.params, s)
             self._state = s
+        # always-on tick profile: per-tick dispatch EMA + the recompile
+        # watchdog, both OUTSIDE the oracle lock (note_cache_size may
+        # emit telemetry + a flight event on an unexpected recompile)
+        prof.observe("oracle.advance",
+                     (time.perf_counter() - t0) / max(1, n_ticks))
+        prof.note_jit("oracle.step", self._step)
 
     def warmup(self) -> None:
         """Precompile the mutating kernels (rejoin/leave/kill + a tick)
@@ -270,19 +285,17 @@ class GossipOracle:
         return {"alive": alive, "failed": failed, "left": left,
                 "total": total}
 
-    def members_delta(self, max_changes: int = 256) -> dict:
-        """Changed members since the last delta checkpoint — the
-        incremental device→control-plane read (ROADMAP item 5): a pool
-        with F flaps since the last call moves min(F, max_changes)
-        rows, not a full gather.  Returns {"count", "changed":
-        [(id, status_name)...], "truncated"}; on truncation (count >
-        max_changes) callers fall back to the paged listing.  The first
-        call reports every provisioned member as changed (no checkpoint
-        yet)."""
+    def _delta_read(self, ckpt_attr: str, max_changes: int) -> dict:
+        """Shared incremental-delta body against a NAMED checkpoint
+        slot (atomic check-read-advance under the oracle lock).
+        Returns {"count", "changed", "truncated", "page", "first"} —
+        `page` is the power-of-two row budget actually used, `first`
+        marks the checkpoint-establishing call."""
         k = _bucket(max(1, max_changes), self.sim.n_nodes)
         with self._lock:
-            prev = self._status_ckpt
-            if prev is None:
+            prev = getattr(self, ckpt_attr)
+            first = prev is None
+            if first:
                 # no checkpoint yet: everything differs from the
                 # impossible status -1
                 prev = jnp.full((self.sim.n_nodes,), -1, jnp.int8)
@@ -292,7 +305,7 @@ class GossipOracle:
                         prev, meshlib.state_sharding(prev, self.mesh))
             st, n_changed, idx, states = self._delta_fn(
                 self.params, self._state, prev, self._prov_dev, k)
-            self._status_ckpt = st
+            setattr(self, ckpt_attr, st)
         n_changed = int(n_changed)
         idx = _to_host(idx)
         states = _to_host(states)
@@ -300,7 +313,22 @@ class GossipOracle:
         changed = [(int(i), names[states[j]])
                    for j, i in enumerate(idx) if i >= 0]
         return {"count": n_changed, "changed": changed,
-                "truncated": n_changed > k}
+                "truncated": n_changed > k, "page": k, "first": first}
+
+    def members_delta(self, max_changes: int = 256) -> dict:
+        """Changed members since the last delta checkpoint — the
+        incremental device→control-plane read (ROADMAP item 5): a pool
+        with F flaps since the last call moves min(F, max_changes)
+        rows, not a full gather.  Returns {"count", "changed":
+        [(id, status_name)...], "truncated"}; on truncation (count >
+        the page budget) callers fall back to the paged listing.  The
+        first call reports every provisioned member as changed (no
+        checkpoint yet).  This cursor is independent of the flight
+        recorder's (journal_flaps) — a metrics scrape never consumes a
+        delta client's pending changes."""
+        d = self._delta_read("_status_ckpt", max_changes)
+        return {"count": d["count"], "changed": d["changed"],
+                "truncated": d["truncated"]}
 
     def status(self, name: str) -> str:
         i = self.node_id(name)
@@ -449,7 +477,15 @@ class GossipOracle:
             self._events.append(rec)
             if len(self._events) > self._event_ring:
                 self._events = self._events[-self._event_ring:]
-            return str(eid)
+        # journal OUTSIDE the oracle lock; the trace id rides in from
+        # the HTTP entry contextvar so a /v1/event/fire shows up in
+        # /v1/agent/events and monitor streams correlated to its
+        # request trace (user_event.go → flight recorder)
+        from consul_tpu import flight
+        flight.emit("serf.user_event",
+                    labels={"name": name, "origin": origin,
+                            "id": eid, "ltime": ltime})
+        return str(eid)
 
     def event_list(self) -> List[dict]:
         with self._lock:
@@ -509,21 +545,96 @@ class GossipOracle:
         the device already holds, one small transfer — the per-tick
         accumulation rides SwimState.ctr inside the step, so the hot
         loop never pays a host round-trip for metrics."""
-        with self._lock:
-            vec = self._metrics_fn(self.params, self._state)
-        vals = np.asarray(vec)
+        from consul_tpu.profiler import default_profiler
+        with default_profiler().span("oracle.metrics"):
+            with self._lock:
+                vec = self._metrics_fn(self.params, self._state)
+            vals = _to_host(vec)
         return {name: float(v)
                 for name, v in zip(swim.METRIC_NAMES, vals)}
+
+    def shard_metrics(self) -> Dict[int, Dict[str, float]]:
+        """Per-shard device telemetry: swim.SHARD_METRIC_NAMES gauges
+        for each of the `shard_blocks` node-axis blocks (the mesh
+        shards under a device mesh), one [B, K] transfer.  Empty when
+        the pool is unsharded or N doesn't split evenly."""
+        blocks = self.sim.shard_blocks
+        if blocks <= 1 or self.sim.n_nodes % blocks:
+            return {}
+        with self._lock:
+            mat = self._shard_metrics_fn(self.params, self._state,
+                                         blocks)
+        mat = _to_host(mat)
+        return {b: {name: float(v)
+                    for name, v in zip(swim.SHARD_METRIC_NAMES, mat[b])}
+                for b in range(blocks)}
+
+    def journal_flaps(self, max_changes: int = 256) -> int:
+        """Membership flap events for the flight recorder, derived from
+        the incremental delta (ROADMAP item 5 seam) against the
+        journal's OWN checkpoint: F flaps since the last call journal
+        min(F, page) rows and move that many rows over the device→host
+        seam — never a node-axis gather.  The first call only
+        establishes the checkpoint (journaling a whole pool as
+        'flapped' would be noise, not signal).  When more members
+        flapped than the page holds, the fetched rows are journaled
+        anyway and one `serf.flap.truncated` warning records the true
+        count — a mass-failure timeline keeps the identities it paid
+        to transfer.  Returns the number of flap rows journaled."""
+        from consul_tpu import flight
+        d = self._delta_read("_flap_ckpt", max_changes)
+        if d["first"]:
+            return 0
+        tick = self.tick
+        # trace_id explicitly EMPTY: a flap is cluster state, not an
+        # artifact of whichever request's scrape happened to surface it
+        # — inheriting the contextvar would stamp membership changes
+        # with a random GET /v1/agent/metrics trace
+        if d["truncated"]:
+            flight.emit("serf.flap.truncated",
+                        labels={"count": d["count"],
+                                "limit": d["page"], "tick": tick},
+                        trace_id="")
+        for i, status in d["changed"]:
+            flight.emit("serf.member.flap",
+                        labels={"node": self.node_name(int(i)),
+                                "status": status, "tick": tick},
+                        trace_id="")
+        return len(d["changed"])
 
     def publish_sim_metrics(self, registry=None) -> Dict[str, float]:
         """Surface sim_metrics() as consul.serf.* gauges (the reference's
         serf/memberlist go-metrics names land under consul.serf/
-        consul.memberlist; the sim's single pool maps to consul.serf)."""
+        consul.memberlist; the sim's single pool maps to consul.serf).
+
+        This call is a host-sync CHECKPOINT, so it also (a) publishes
+        the per-shard split of the pool gauges as consul.serf.*{shard}
+        plus cross-shard skew/imbalance, and (b) feeds the flight
+        recorder's membership-flap journal from the incremental delta
+        — O(flaps) rows per scrape, the device plane's event feed."""
         from consul_tpu import telemetry
         reg = registry or telemetry.default_registry()
         m = self.sim_metrics()
         for name, v in m.items():
             reg.set_gauge(("serf",) + tuple(name.split(".")), v)
+        shards = self.shard_metrics()
+        if shards:
+            for b, row in shards.items():
+                for name, v in row.items():
+                    reg.set_gauge(("serf",) + tuple(name.split(".")),
+                                  v, labels={"shard": str(b)})
+            alive = [row["members.alive"] for row in shards.values()]
+            mean = sum(alive) / len(alive)
+            # skew: spread of live membership across shards relative to
+            # the mean (0 = perfectly balanced); imbalance: the hottest
+            # shard's load factor — the signal that one device carries
+            # disproportionate gossip state
+            reg.set_gauge(("serf", "shard", "skew"),
+                          (max(alive) - min(alive)) / mean
+                          if mean else 0.0)
+            reg.set_gauge(("serf", "shard", "imbalance"),
+                          max(alive) / mean if mean else 0.0)
+        self.journal_flaps()
         return m
 
     # ------------------------------------------------------------------ misc

@@ -1,0 +1,133 @@
+"""Always-on tick profiler: per-pass EMA timings + recompile watchdog.
+
+PROFILE_r06.json is a one-shot offline table (tools/profile_swim.py);
+what operators need live is the same per-pass story cheap enough to
+leave ON: an exponential moving average of each named pass's wall
+time, sampled at the host-sync checkpoints the runtime already pays
+(the oracle's advance/scrape boundaries, the bench's scan readbacks) —
+never inside the jitted tick.
+
+The second job is the recompile watchdog.  PR 2's discipline says the
+hot scan compiles ONCE per topology; a silent recompile mid-run means
+something perturbed a static config and the operator is paying
+multi-second XLA compiles in production.  `note_cache_size()` tracks
+each jitted entry point's trace-cache size between checkpoints: growth
+past the first compile increments `consul.runtime.compiles` and
+journals a `runtime.recompile` warning into the flight recorder
+(consul_tpu/flight.py) so the event timeline shows WHEN the recompile
+hit relative to elections/flaps.
+
+Surfaced at /v1/agent/profile, stamped into bench.py /
+tools/scale_sweep.py artifacts (ROADMAP item 3's re-baselining input),
+and carried in debug bundles as profile.json.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+EMA_ALPHA = 0.2
+
+
+class TickProfiler:
+    def __init__(self, alpha: float = EMA_ALPHA):
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        # name -> [ema_s, last_s, count, total_s]
+        self._passes: Dict[str, list] = {}
+        # fn name -> last observed jit trace-cache size
+        self._cache_sizes: Dict[str, int] = {}
+        self.recompiles = 0
+
+    # ---------------------------------------------------------------- passes
+
+    def observe(self, name: str, dur_s: float) -> None:
+        """Fold one pass duration into the EMA (one dict write under a
+        lock — cheap enough for every host-sync checkpoint)."""
+        with self._lock:
+            row = self._passes.get(name)
+            if row is None:
+                self._passes[name] = [dur_s, dur_s, 1, dur_s]
+            else:
+                row[0] += self.alpha * (dur_s - row[0])
+                row[1] = dur_s
+                row[2] += 1
+                row[3] += dur_s
+
+    @contextmanager
+    def span(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # ------------------------------------------------------------- recompiles
+
+    def note_jit(self, fn_name: str, jitted_fn) -> None:
+        """Probe a jitted entry point's trace-cache size and feed the
+        watchdog — the one place that knows how to ask (older jax
+        without `_cache_size` degrades to no signal)."""
+        self.note_cache_size(
+            fn_name, int(jitted_fn._cache_size())
+            if hasattr(jitted_fn, "_cache_size") else None)
+
+    def note_cache_size(self, fn_name: str, size: Optional[int]) -> None:
+        """Record a jitted entry point's trace-cache size at a
+        checkpoint.  The first compile is expected; any growth AFTER a
+        compile exists is an unexpected recompile: count it and journal
+        a warning event (the operator's 'why did this tick take 8 s'
+        answer)."""
+        if size is None:        # jax without _cache_size(): no signal
+            return
+        with self._lock:
+            prev = self._cache_sizes.get(fn_name)
+            self._cache_sizes[fn_name] = size
+            unexpected = (prev is not None and prev >= 1
+                          and size > prev)
+        if unexpected:
+            from consul_tpu import flight, telemetry
+            with self._lock:
+                self.recompiles += size - prev
+            telemetry.incr_counter(("runtime", "compiles"),
+                                   float(size - prev))
+            try:
+                flight.emit("runtime.recompile",
+                            labels={"fn": fn_name,
+                                    "cache_size": size})
+            except ValueError:
+                pass    # catalog drift must not break the hot path
+
+    # -------------------------------------------------------------- snapshot
+
+    def snapshot(self) -> dict:
+        """The /v1/agent/profile shape: per-pass EMA table + compile
+        accounting, JSON-safe."""
+        with self._lock:
+            passes = {
+                name: {"ema_ms": round(row[0] * 1000.0, 3),
+                       "last_ms": round(row[1] * 1000.0, 3),
+                       "count": row[2],
+                       "total_ms": round(row[3] * 1000.0, 3)}
+                for name, row in sorted(self._passes.items())}
+            return {"passes": passes,
+                    "alpha": self.alpha,
+                    "compile_cache": dict(sorted(
+                        self._cache_sizes.items())),
+                    "recompiles": self.recompiles}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._passes.clear()
+            self._cache_sizes.clear()
+            self.recompiles = 0
+
+
+_default = TickProfiler()
+
+
+def default_profiler() -> TickProfiler:
+    return _default

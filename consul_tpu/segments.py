@@ -130,6 +130,29 @@ class SegmentedOracle:
             out["truncated"] = out["truncated"] or d["truncated"]
         return out
 
+    def journal_flaps(self, max_changes: int = 256) -> int:
+        """Flight-recorder flap feed across every segment pool
+        (GossipOracle.journal_flaps — O(flaps) rows per pool)."""
+        return sum(p.journal_flaps(max_changes)
+                   for p in self.pools.values())
+
+    def publish_sim_metrics(self, registry=None) -> Dict[str, float]:
+        """Per-segment consul.serf.* gauges, labeled {segment=…} (the
+        reference reports serf metrics per LAN segment pool), plus
+        each pool's flap journal feeding the flight recorder.  Returns
+        the LAST pool's raw metrics dict for API parity."""
+        from consul_tpu import telemetry
+        reg = registry or telemetry.default_registry()
+        m: Dict[str, float] = {}
+        for seg in sorted(self.pools):
+            p = self.pools[seg]
+            m = p.sim_metrics()
+            for name, v in m.items():
+                reg.set_gauge(("serf",) + tuple(name.split(".")), v,
+                              labels={"segment": seg or "default"})
+            p.journal_flaps()
+        return m
+
     def status(self, name: str) -> str:
         return self._pool_of(name)[1].status(name)
 

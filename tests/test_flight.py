@@ -1,0 +1,579 @@
+"""Flight recorder (ISSUE 8 tentpole): the structured event journal,
+its emitters, the per-shard telemetry split, and the tick profiler.
+
+The two contracts the acceptance criteria pin:
+
+  * membership-flap journaling moves O(flaps) rows over the oracle's
+    `_to_host` seam — never a node-axis gather (spied below);
+  * a seeded chaos run's timeline is deterministic (byte-identical
+    dump under a fixed-clock recorder).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from consul_tpu import flight
+from consul_tpu.config import SimConfig
+from consul_tpu.profiler import TickProfiler
+
+
+def fresh():
+    return flight.FlightRecorder(clock=lambda: 0.0, forward_to_log=False)
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_emit_validates_against_catalog():
+    r = fresh()
+    seq = r.emit("agent.started", labels={"node": "n1"})
+    assert seq == 1
+    with pytest.raises(ValueError):
+        r.emit("not.registered")
+    with pytest.raises(ValueError):
+        r.emit("agent.started", labels={"undeclared": "x"})
+    with pytest.raises(ValueError):
+        r.emit("agent.started", severity="fatal")
+
+
+def test_ring_bounds_memory_and_seq_survives_eviction():
+    r = flight.FlightRecorder(ring=8, clock=lambda: 0.0,
+                              forward_to_log=False)
+    for i in range(20):
+        r.emit("serf.member.flap",
+               labels={"node": f"n{i}", "status": "failed", "tick": i})
+    rows = r.read()
+    assert len(rows) == 8
+    # seqs keep counting past eviction (a since-cursor never repeats)
+    assert [e["seq"] for e in rows] == list(range(13, 21))
+    assert r.last_seq == 20
+
+
+def test_since_cursor_and_filters():
+    r = fresh()
+    r.emit("agent.started", labels={"node": "a"})
+    r.emit("chaos.fault.injected", labels={"fault": "crash"})
+    r.emit("agent.stopped", labels={"node": "a"})
+    assert [e["name"] for e in r.read(since=1)] == \
+        ["chaos.fault.injected", "agent.stopped"]
+    assert [e["seq"] for e in r.read(name="agent.stopped")] == [3]
+    assert [e["name"] for e in r.read(severity="warn")] == \
+        ["chaos.fault.injected"]
+    assert r.read(limit=0) == []
+    # forward paging: limit caps to the OLDEST rows past the cursor,
+    # so a paging client never skips pending events
+    page = r.read(since=0, limit=2)
+    assert [e["seq"] for e in page] == [1, 2]
+    assert [e["seq"] for e in r.read(since=page[-1]["seq"])] == [3]
+
+
+def test_wait_blocks_until_emit():
+    import threading
+    import time as _time
+    r = fresh()
+    r.emit("agent.started", labels={"node": "a"})
+    got = {}
+
+    def waiter():
+        got["seq"] = r.wait(since=1, timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    _time.sleep(0.1)
+    r.emit("agent.stopped", labels={"node": "a"})
+    t.join(timeout=5.0)
+    assert got["seq"] == 2
+    # timeout path: no newer event -> returns current seq after wait
+    t0 = _time.monotonic()
+    assert r.wait(since=99, timeout=0.05) == 2
+    assert _time.monotonic() - t0 < 1.0
+
+
+def test_dump_jsonl_is_byte_stable_per_run():
+    def run():
+        r = fresh()
+        r.emit("raft.election.won", labels={"node": "n1", "term": 2},
+               ts=1.25)
+        r.emit("serf.member.flap",
+               labels={"node": "n3", "status": "failed", "tick": 7},
+               ts=7.0)
+        return r.dump_jsonl()
+
+    a, b = run(), run()
+    assert a == b
+    rows = [json.loads(line) for line in a.decode().splitlines()]
+    assert rows[0]["name"] == "raft.election.won"
+    assert rows[1]["labels"]["status"] == "failed"
+
+
+def test_scoped_use_restores_default():
+    r = fresh()
+    before = flight.current()
+    with flight.use(r):
+        assert flight.current() is r
+        flight.emit("agent.started", labels={"node": "x"})
+    assert flight.current() is before
+    assert r.last_seq == 1
+
+
+def test_label_values_clamped():
+    r = fresh()
+    r.emit("agent.started", labels={"node": "x" * 1000})
+    assert len(r.read()[0]["labels"]["node"]) == flight.MAX_LABEL_VALUE
+
+
+def test_spill_through_storage_seam(tmp_path):
+    """WAL spill: every emit appends a JSON line via the storage-seam
+    ops object — interceptable by the storage nemesis."""
+    from consul_tpu import storage
+
+    calls = []
+
+    class SpyOps(storage.StorageOps):
+        def write(self, f, data):
+            calls.append(len(data))
+            super().write(f, data)
+
+    path = str(tmp_path / "flight.jsonl")
+    r = fresh()
+    r.attach_spill(path, ops=SpyOps())
+    r.emit("agent.started", labels={"node": "a"})
+    r.emit("agent.stopped", labels={"node": "a"})
+    r.detach_spill(sync=True)
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2 == len(calls)
+    assert json.loads(lines[0])["name"] == "agent.started"
+    # post-detach emits stay in the ring only
+    r.emit("agent.started", labels={"node": "b"})
+    assert len(open(path).read().splitlines()) == 2
+
+
+def test_spill_on_faulty_storage_never_deadlocks(tmp_path):
+    """The nemesis disk journals its OWN fault events from inside the
+    spill write — that nested emit must stay ring-only instead of
+    re-entering the spill lock (deadlock) or the fault (recursion)."""
+    from consul_tpu.chaos import FaultyStorage
+
+    fs = FaultyStorage(seed=1)
+    r = fresh()
+    with flight.use(r):
+        r.attach_spill(str(tmp_path / "spill.jsonl"), ops=fs)
+        fs.enospc = True                  # every write betrays + journals
+        r.emit("agent.started", labels={"node": "a"})
+        r.detach_spill()
+    # both the original event AND the nested fault event are in the
+    # ring; the failed spill line was counted, and we did not hang
+    names = [e["name"] for e in r.read()]
+    assert names == ["agent.started", "chaos.fault.injected"]
+    assert r.dropped == 1
+
+
+def test_read_page_limit_zero_does_not_advance_horizon():
+    """limit=0 examines nothing: its horizon must stay at `since`, or
+    a cursor client would skip every truncated-out event."""
+    r = fresh()
+    for i in range(3):
+        r.emit("serf.member.flap",
+               labels={"node": f"n{i}", "status": "failed", "tick": i})
+    rows, horizon = r.read_page(since=1, limit=0)
+    assert rows == [] and horizon == 1
+    # a real page then resumes without loss
+    rows, _ = r.read_page(since=1)
+    assert [e["seq"] for e in rows] == [2, 3]
+
+
+def test_events_multiplex_onto_monitor_stream():
+    """forward_to_log recorders fan events into the process LogBuffer,
+    so live /v1/agent/monitor subscriptions see them as lines."""
+    from consul_tpu.logging import default_buffer
+    mon = default_buffer().monitor("WARN")
+    try:
+        r = flight.FlightRecorder(clock=lambda: 0.0)   # forwards
+        r.emit("chaos.fault.injected",
+               labels={"fault": "partition", "target": "a|b"})
+        lines = mon.lines(timeout=2.0)
+        assert any("event=chaos.fault.injected" in ln and
+                   "fault=partition" in ln for ln in lines)
+    finally:
+        mon.stop()
+
+
+# ------------------------------------------------------------- profiler
+
+
+def test_profiler_ema_and_snapshot():
+    p = TickProfiler(alpha=0.5)
+    p.observe("pass.a", 0.100)
+    p.observe("pass.a", 0.300)
+    with p.span("pass.b"):
+        pass
+    snap = p.snapshot()
+    assert snap["passes"]["pass.a"]["count"] == 2
+    assert snap["passes"]["pass.a"]["ema_ms"] == pytest.approx(200.0)
+    assert snap["passes"]["pass.a"]["last_ms"] == pytest.approx(300.0)
+    assert "pass.b" in snap["passes"]
+    assert snap["recompiles"] == 0
+    json.dumps(snap)                      # JSON-safe for the artifacts
+
+
+def test_profiler_recompile_watchdog_journals_event():
+    from consul_tpu import telemetry
+    r = fresh()
+    p = TickProfiler()
+    with flight.use(r):
+        p.note_cache_size("fn", 1)        # first compile: expected
+        p.note_cache_size("fn", 1)
+        assert r.last_seq == 0
+        p.note_cache_size("fn", 3)        # growth: 2 recompiles
+    assert p.recompiles == 2
+    evs = r.read(name="runtime.recompile")
+    assert len(evs) == 1 and evs[0]["severity"] == "warn"
+    assert evs[0]["labels"]["fn"] == "fn"
+    dump = telemetry.default_registry().dump()
+    assert any(c["Name"] == "consul.runtime.compiles"
+               for c in dump["Counters"])
+
+
+def test_profiler_none_cache_size_is_noop():
+    p = TickProfiler()
+    p.note_cache_size("fn", None)
+    p.note_cache_size("fn", None)
+    assert p.recompiles == 0
+
+
+# ------------------------------------- oracle: flap journal + O(flaps)
+
+
+def test_flap_journal_moves_o_flaps_rows(monkeypatch):
+    """ACCEPTANCE: with the recorder on, journaling membership flaps
+    after F flaps moves O(F) rows through `oracle._to_host` — never a
+    node-axis gather — and journals exactly the flapped members."""
+    import consul_tpu.oracle as oracle_mod
+
+    n = 512
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=n, rumor_slots=16,
+                                              p_loss=0.0, seed=3))
+    r = fresh()
+    with flight.use(r):
+        assert o.journal_flaps() == 0     # first call: baseline only
+    assert r.last_seq == 0
+
+    transferred = []
+    real = oracle_mod._to_host
+
+    def spy(x):
+        a = real(x)
+        transferred.append(a.nbytes)
+        return a
+
+    monkeypatch.setattr(oracle_mod, "_to_host", spy)
+
+    o.kill("node5")
+    o.kill("node77")
+    o.advance(160)                        # dead rumors commit/land
+    with flight.use(r):
+        journaled = o.journal_flaps(max_changes=64)
+    assert journaled >= 2
+    flaps = {(e["labels"]["node"], e["labels"]["status"])
+             for e in r.read(name="serf.member.flap")}
+    assert ("node5", "failed") in flaps
+    assert ("node77", "failed") in flaps
+    # O(flaps): every transfer for the journal is rows-bounded, far
+    # under one byte per pool slot (a gather would be >= n bytes)
+    assert sum(transferred) < n, \
+        f"flap journal moved {sum(transferred)}B against a {n}-pool"
+    # flap rows are cluster state, never correlated to whichever
+    # request's scrape surfaced them: trace_id stays empty even when
+    # the journaling call runs under a bound trace
+    from consul_tpu import trace
+    tok = trace.set_current("deadbeef")
+    try:
+        o.kill("node200")
+        o.advance(160)
+        with flight.use(r):
+            o.journal_flaps(max_changes=64)
+    finally:
+        trace.reset(tok)
+    late = [e for e in r.read(name="serf.member.flap")
+            if e["labels"]["node"] == "node200"]
+    assert late and late[0]["trace_id"] == ""
+
+
+def test_flap_journal_truncation_emits_single_event():
+    import consul_tpu.oracle as oracle_mod
+
+    # same SimConfig as the O(flaps) test: the jitted oracle kernels
+    # compile once for the whole module (params is a static argnum)
+    n = 512
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=n, rumor_slots=16,
+                                              p_loss=0.0, seed=3))
+    r = fresh()
+    with flight.use(r):
+        o.journal_flaps()                 # baseline
+        for i in range(40):
+            o.kill(f"node{i}")
+        o.advance(200)
+        journaled = o.journal_flaps(max_changes=8)
+    # the fetched page still journals (a mass-failure timeline keeps
+    # the identities it paid to transfer) plus ONE truncation warning
+    # recording the true count and the page budget actually used
+    assert journaled == 8
+    assert len(r.read(name="serf.member.flap")) == 8
+    evs = r.read(name="serf.flap.truncated")
+    assert len(evs) == 1
+    assert int(evs[0]["labels"]["count"]) > 8
+    assert evs[0]["labels"]["limit"] == "8"
+
+
+def test_flap_journal_cursor_independent_of_members_delta():
+    """The journal's checkpoint is its own: a metrics scrape consuming
+    the flap feed never starves a members_delta() client, and a delta
+    client never eats flaps out of the timeline."""
+    import consul_tpu.oracle as oracle_mod
+
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=512,
+                                              rumor_slots=16,
+                                              p_loss=0.0, seed=3))
+    r = fresh()
+    with flight.use(r):
+        o.journal_flaps()                 # journal baseline
+        o.members_delta()                 # client baseline
+        o.kill("node11")
+        o.advance(160)
+        # the scrape-side journal consumes ITS delta first...
+        assert o.journal_flaps() >= 1
+        # ...and the delta client still sees the same flap
+        d = o.members_delta()
+        assert (11, "failed") in d["changed"]
+        # symmetric: a fresh flap read by the client first still
+        # reaches the journal on the next scrape
+        o.kill("node13")
+        o.advance(160)
+        assert any(i == 13 for i, _ in o.members_delta()["changed"])
+        assert o.journal_flaps() >= 1
+        assert any(e["labels"]["node"] == "node13"
+                   for e in r.read(name="serf.member.flap"))
+
+
+def test_publish_sim_metrics_feeds_flap_journal():
+    """A metrics scrape IS the host-sync checkpoint: publish_sim_metrics
+    establishes the delta baseline, then journals subsequent flaps."""
+    import consul_tpu.oracle as oracle_mod
+    from consul_tpu import telemetry
+
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=512,
+                                              rumor_slots=16,
+                                              p_loss=0.0, seed=3))
+    reg = telemetry.Registry()
+    r = fresh()
+    with flight.use(r):
+        o.publish_sim_metrics(reg)        # baseline checkpoint
+        o.kill("node9")
+        o.advance(160)
+        o.publish_sim_metrics(reg)
+    assert any(e["labels"]["node"] == "node9"
+               for e in r.read(name="serf.member.flap"))
+
+
+# --------------------------------------------- per-shard telemetry
+
+
+def test_shard_metrics_matches_numpy_reference():
+    from consul_tpu.config import GossipConfig
+    from consul_tpu.models import swim
+
+    params = swim.make_params(GossipConfig.lan(),
+                              SimConfig(n_nodes=64, rumor_slots=16,
+                                        p_loss=0.0, seed=2))
+    s = swim.init_state(params)
+    s = swim.kill(s, 3)
+    s = swim.kill(s, 35)
+    blocks = 4
+    mat = np.asarray(swim.shard_metrics(params, s, blocks))
+    assert mat.shape == (blocks, len(swim.SHARD_METRIC_NAMES))
+    up = np.asarray(s.up) & np.asarray(s.member)
+    dead = np.asarray(s.committed_dead)
+    for b in range(blocks):
+        sl = slice(b * 16, (b + 1) * 16)
+        assert mat[b][0] == up[sl].sum()
+        assert mat[b][1] == dead[sl].sum()
+    # the whole-pool sum of per-shard alive equals the global gauge
+    assert mat[:, 0].sum() == up.sum()
+
+
+def test_publish_sim_metrics_emits_per_shard_and_skew_gauges():
+    import consul_tpu.oracle as oracle_mod
+    from consul_tpu import telemetry
+
+    o = oracle_mod.GossipOracle(
+        sim=SimConfig(n_nodes=128, rumor_slots=16, p_loss=0.0, seed=5,
+                      shard_blocks=4))
+    reg = telemetry.Registry()
+    with flight.use(fresh()):
+        o.publish_sim_metrics(reg)
+    dump = reg.dump()
+    shard_rows = [g for g in dump["Gauges"]
+                  if g["Name"] == "consul.serf.members.alive"
+                  and "Labels" in g]
+    assert {g["Labels"]["shard"] for g in shard_rows} == \
+        {"0", "1", "2", "3"}
+    assert sum(g["Value"] for g in shard_rows) == 128
+    names = {g["Name"] for g in dump["Gauges"]}
+    assert "consul.serf.shard.skew" in names
+    assert "consul.serf.shard.imbalance" in names
+    skew = next(g["Value"] for g in dump["Gauges"]
+                if g["Name"] == "consul.serf.shard.skew")
+    assert skew == 0.0                    # fully alive pool: balanced
+
+
+def test_unsharded_pool_publishes_no_shard_gauges():
+    import consul_tpu.oracle as oracle_mod
+    from consul_tpu import telemetry
+
+    o = oracle_mod.GossipOracle(sim=SimConfig(n_nodes=64,
+                                              rumor_slots=16, seed=5))
+    reg = telemetry.Registry()
+    with flight.use(fresh()):
+        o.publish_sim_metrics(reg)
+    assert o.shard_metrics() == {}
+    assert not any("shard" in str(g.get("Labels", {})) or
+                   g["Name"].startswith("consul.serf.shard.")
+                   for g in reg.dump()["Gauges"])
+
+
+# ------------------------------------------------------- raft emitters
+
+
+def test_raft_election_and_leadership_events():
+    from consul_tpu.chaos import RaftChaosHarness
+
+    r = fresh()
+    with flight.use(r):
+        h = RaftChaosHarness(n=3, seed=11)
+        h.step(1.0)
+        leader = h._leader()
+        assert leader is not None
+        h.transport.isolate(leader.node_id)
+        h.step(2.0)
+        h.transport.heal()
+        h.step(1.0)
+    names = [e["name"] for e in r.read()]
+    assert "raft.election.started" in names
+    assert "raft.election.won" in names
+    assert "raft.term.changed" in names
+    # the deposed leader steps down when it hears the higher term
+    assert "raft.leadership.lost" in names
+    won = next(e for e in r.read(name="raft.election.won"))
+    assert set(won["labels"]) == {"node", "term"}
+    # virtual-clock timestamps ride the events
+    assert all(e["ts"] <= 10.0 for e in r.read())
+
+
+def test_raft_recovery_event_on_restart():
+    from consul_tpu.chaos import RaftChaosHarness
+
+    r = fresh()
+    with flight.use(r):
+        with __import__("tempfile").TemporaryDirectory() as d:
+            h = RaftChaosHarness(n=3, seed=4, data_root=d)
+            h.step(1.0)
+            h.do_write()
+            h.step(0.5)
+            follower = next(i for i in h.ids
+                            if not h.nodes[i].is_leader())
+            h.crash(follower)
+            h.step(0.5)
+            h.restart(follower)
+            h.step(1.0)
+    names = [e["name"] for e in r.read()]
+    assert "chaos.fault.injected" in names
+    assert "chaos.fault.healed" in names
+    assert "raft.recovery.completed" in names
+    rec = next(e for e in r.read(name="raft.recovery.completed"))
+    assert rec["labels"]["node"] == follower
+
+
+# --------------------------------------------------------- autopilot
+
+
+def test_autopilot_health_transition_events():
+    from consul_tpu.autopilot import Autopilot, AutopilotConfig
+
+    class FakeRaft:
+        # 5 servers: losing one still leaves failure tolerance >= 1,
+        # so dead-server cleanup may proceed (the quorum guard)
+        peers = ["s2", "s3", "s4", "s5"]
+        last_ack = {"s2": 0.0, "s3": 0.0, "s4": 0.0, "s5": 0.0}
+
+        def is_leader(self):
+            return True
+
+        def remove_peer(self, p):
+            self.peers.remove(p)
+
+    class FakeServer:
+        node_id = "s1"
+        raft = FakeRaft()
+
+    def acks(now, dead=("s2",)):
+        return {p: (0.0 if p in dead else now)
+                for p in ("s2", "s3", "s4", "s5")}
+
+    ap = Autopilot(FakeServer(), AutopilotConfig(
+        last_contact_threshold=0.2, server_stabilization_time=0.5))
+    r = fresh()
+    with flight.use(r):
+        ap.run(0.1)                       # all healthy: baseline
+        assert r.last_seq == 0
+        FakeRaft.last_ack = acks(5.1)
+        ap.run(5.1)                       # s2 unhealthy: transition
+        evs = r.read(name="autopilot.health.changed")
+        assert len(evs) == 1
+        assert evs[0]["labels"] == {"server": "s2", "healthy": "False"}
+        assert evs[0]["ts"] == 5.1
+        FakeRaft.last_ack = acks(5.8)     # others stay healthy
+        ap.run(5.8)                       # past stabilization: removed
+    removed = r.read(name="autopilot.server.removed")
+    assert [e["labels"]["server"] for e in removed] == ["s2"]
+    assert "s2" not in FakeServer.raft.peers
+
+    # transitions journal even with dead-server CLEANUP disabled —
+    # an operator config choice must not blind the observability feed
+    ap2 = Autopilot(FakeServer(), AutopilotConfig(
+        cleanup_dead_servers=False, last_contact_threshold=0.2))
+    r2 = fresh()
+    with flight.use(r2):
+        FakeRaft.last_ack = acks(0.1, dead=())
+        ap2.run(0.1)
+        FakeRaft.last_ack = acks(9.0, dead=("s3",))
+        ap2.run(9.0)
+    evs = r2.read(name="autopilot.health.changed")
+    assert [e["labels"]["server"] for e in evs] == ["s3"]
+    assert r2.read(name="autopilot.server.removed") == []
+
+
+# ------------------------------------------------- chaos determinism
+
+
+def test_chaos_scenario_timeline_correlated():
+    """A seeded scenario journals one correlated timeline — injected
+    fault → heal — with raft activity in the same journal.  (Byte-
+    identity across the determinism double-run is asserted by
+    `chaos_soak --check`, which tier-1 runs via tests/test_chaos.py.)"""
+    from consul_tpu import chaos
+
+    a = chaos.run_scenario("loss_burst", 7)
+    rows = [json.loads(ln) for ln in a["events"].splitlines()]
+    names = [e["name"] for e in rows]
+    assert "chaos.fault.injected" in names
+    assert "chaos.fault.healed" in names
+    # election activity from the raft layer rides the same journal
+    assert "raft.election.won" in names
+    # ordering: the SWIM loss injection precedes its calm/heal
+    loss = [(e["name"], i) for i, e in enumerate(rows)
+            if e.get("labels", {}).get("fault") == "loss"]
+    assert [n for n, _ in loss] == ["chaos.fault.injected",
+                                    "chaos.fault.healed"]

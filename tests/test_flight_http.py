@@ -1,0 +1,235 @@
+"""Flight recorder HTTP surface + end-to-end timelines (ISSUE 8):
+/v1/agent/events blocking cursor, /v1/event/fire correlation with
+trace IDs, /v1/agent/profile, monitor multiplexing over HTTP, the
+chaos→events→debug-bundle acceptance path, and the debug_bundle CLI.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu import flight
+from consul_tpu.agent import Agent
+from consul_tpu.api.client import Client
+from consul_tpu.config import GossipConfig, SimConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def agent():
+    a = Agent(GossipConfig.lan(),
+              SimConfig(n_nodes=32, rumor_slots=16, p_loss=0.0, seed=9))
+    a.start(tick_seconds=0.05, reconcile_interval=0.2)
+    yield a
+    a.stop()
+
+
+@pytest.fixture()
+def client(agent):
+    return Client(agent.http_address)
+
+
+def test_agent_events_endpoint_and_since_cursor(agent, client):
+    rows, idx = client.agent_events()
+    # agent.started journaled at Agent.start into the default recorder
+    assert any(r["Name"] == "agent.started" for r in rows)
+    assert idx == flight.default_recorder().last_seq
+    # cursor: nothing newer than the returned index
+    rows2, _ = client.agent_events(since=idx)
+    assert rows2 == []
+    # name filter
+    only, _ = client.agent_events(name="agent.started")
+    assert only and all(r["Name"] == "agent.started" for r in only)
+
+
+def test_agent_events_blocking_wakes_on_fire(client):
+    _, idx = client.agent_events()
+    got = {}
+
+    def waiter():
+        t0 = time.perf_counter()
+        got["rows"], got["idx"] = client.agent_events(
+            since=idx, wait="10s")
+        got["wall"] = time.perf_counter() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    client.event_fire("deploy", b"v2")
+    t.join(timeout=10.0)
+    assert not t.is_alive()
+    assert got["wall"] < 9.0              # woke on the event, not timeout
+    names = [r["Name"] for r in got["rows"]]
+    assert "serf.user_event" in names
+
+
+def test_filtered_blocking_query_parks_and_advances_cursor(client):
+    """A name-filtered long-poll must not busy-loop while unrelated
+    events flow: empty results advance the cursor to the examined
+    horizon, and the park re-arms until a MATCHING event lands."""
+    _, idx = client.agent_events()
+    # unrelated traffic advances the journal...
+    client.event_fire("unrelated", b"")
+    rows, idx2 = client.agent_events(since=idx, wait="1s",
+                                     name="agent.stopped")
+    # ...the filter returns nothing, but the cursor moved PAST the
+    # non-matching rows (no permanent stall at idx)
+    assert rows == []
+    assert idx2 > idx
+    # and a matching event wakes a parked filtered poll
+    got = {}
+
+    def waiter():
+        t0 = time.perf_counter()
+        got["rows"], _ = client.agent_events(
+            since=idx2, wait="10s", name="serf.user_event")
+        got["wall"] = time.perf_counter() - t0
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.2)
+    client.event_fire("wake-me", b"")
+    t.join(timeout=10.0)
+    assert got["wall"] < 9.0
+    assert any(r["Labels"].get("name") == "wake-me"
+               for r in got["rows"])
+
+
+def test_user_event_correlates_with_trace(client):
+    """Satellite: a fired user event rides the journal with the trace
+    ID minted at its OWN /v1/event/fire request."""
+    client.event_fire("release", b"payload")
+    rows, _ = client.agent_events(name="serf.user_event")
+    ev = [r for r in rows if r["Labels"].get("name") == "release"][-1]
+    assert ev["TraceID"] != ""
+    # the same trace id names the /v1/event/fire span in the ring
+    from consul_tpu import trace
+    spans = trace.dump(trace_id=ev["TraceID"])
+    assert any(s["name"] == "http.request"
+               and s.get("attrs", {}).get("path")
+               == "/v1/event/fire/release"
+               for s in spans)
+
+
+def test_user_event_reaches_monitor_stream(agent, client):
+    """Satellite: fired events multiplex onto /v1/agent/monitor."""
+    url = agent.http_address
+    got = {}
+
+    def reader():
+        req = urllib.request.urlopen(
+            f"{url}/v1/agent/monitor?wait=1s", timeout=10.0)
+        got["body"] = req.read().decode()
+
+    t = threading.Thread(target=reader)
+    t.start()
+    time.sleep(0.2)
+    client.event_fire("monitored-event", b"")
+    t.join(timeout=10.0)
+    assert "event=serf.user_event" in got["body"]
+    assert "name=monitored-event" in got["body"]
+
+
+def test_agent_profile_endpoint(agent, client):
+    # the pacer has advanced the oracle: the EMA table carries the
+    # advance pass and the recompile watchdog tracked the step fn
+    time.sleep(0.3)
+    snap = client.agent_profile()
+    assert "oracle.advance" in snap["passes"]
+    assert snap["passes"]["oracle.advance"]["count"] >= 1
+    assert snap["passes"]["oracle.advance"]["ema_ms"] >= 0.0
+    assert "oracle.step" in snap["compile_cache"]
+    assert snap["recompiles"] == 0
+
+
+def test_metrics_scrape_journals_flaps_end_to_end(agent, client):
+    """Tentpole e2e: kill a member → the next metrics scrape (a
+    host-sync checkpoint) journals the flap → /v1/agent/events serves
+    it."""
+    # establish the delta baseline via a scrape
+    urllib.request.urlopen(
+        f"{agent.http_address}/v1/agent/metrics", timeout=10.0).read()
+    agent.oracle.kill("node3")
+    deadline = time.time() + 30.0
+    seen = False
+    while time.time() < deadline and not seen:
+        time.sleep(0.5)
+        urllib.request.urlopen(
+            f"{agent.http_address}/v1/agent/metrics",
+            timeout=10.0).read()
+        rows, _ = client.agent_events(name="serf.member.flap")
+        seen = any(r["Labels"].get("node") == "node3"
+                   and r["Labels"].get("status") == "failed"
+                   for r in rows)
+    assert seen, "node3 flap never reached /v1/agent/events"
+
+
+# ------------------------------------------------- acceptance: chaos
+
+
+def test_chaos_timeline_queryable_and_in_debug_bundle(agent, client):
+    """ACCEPTANCE: a chaos scenario journaled into the process
+    recorder yields one correlated timeline — injected fault → flap
+    events → election activity → heal — queryable via
+    /v1/agent/events and present in the debug bundle."""
+    from consul_tpu import chaos, debug
+
+    start = flight.default_recorder().last_seq
+    chaos.run_scenario("partition_heal", 7,
+                       recorder=flight.default_recorder())
+    rows, _ = client.agent_events(since=start)
+    names = [r["Name"] for r in rows]
+    # the correlated story, in order: injection, then flap commits,
+    # then heal; raft election activity from the same scenario rides
+    # the same journal
+    inj = names.index("chaos.fault.injected")
+    assert "serf.member.flap" in names
+    heal_idx = [i for i, n in enumerate(names)
+                if n == "chaos.fault.healed"]
+    flap_idx = [i for i, n in enumerate(names)
+                if n == "serf.member.flap"]
+    assert inj < flap_idx[0] < heal_idx[-1]
+    assert "raft.election.won" in names
+
+    # the same timeline rides the debug bundle as events.jsonl
+    blob = debug.capture(agent=None, intervals=1, interval_s=0.0)
+    with tarfile.open(fileobj=io.BytesIO(blob)) as tar:
+        lines = tar.extractfile("events.jsonl").read().decode()
+        names_in_tar = tar.getnames()
+    bundled = [json.loads(ln)["name"] for ln in lines.splitlines()]
+    assert "chaos.fault.injected" in bundled
+    assert "serf.member.flap" in bundled
+    assert "profile.json" in names_in_tar
+
+
+# ------------------------------------------------- debug_bundle CLI
+
+
+def test_debug_bundle_cli_smoke(tmp_path):
+    """Satellite: one command produces an archive with metrics.prom,
+    traces, events.jsonl, profile.json, and host info in under 10 s."""
+    out = str(tmp_path / "bundle.tar.gz")
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "debug_bundle.py"),
+         "--out", out],
+        capture_output=True, text=True, timeout=60, cwd=REPO)
+    wall = time.perf_counter() - t0
+    assert proc.returncode == 0, proc.stderr
+    assert wall < 10.0, f"debug bundle took {wall:.1f}s (budget 10s)"
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["ok"] and row["missing"] == []
+    with tarfile.open(out) as tar:
+        names = tar.getnames()
+    for section in ("host.json", "0/metrics.prom", "trace.json",
+                    "events.jsonl", "profile.json"):
+        assert section in names

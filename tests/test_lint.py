@@ -497,6 +497,93 @@ def test_metric_names_fires_and_stays_silent():
     assert check_snippet("metric-names", clean) == []
 
 
+def test_event_names_fires_and_stays_silent():
+    """event-names: flight emit sites must use CATALOG-registered
+    names with declared literal label keys; computed label sets are
+    the unbounded-cardinality foot-gun and fail the gate."""
+    bad = """
+        from consul_tpu import flight
+
+        def go(node, labels):
+            flight.emit("raft.election.exploded",
+                        labels={"node": node})
+            flight.emit("raft.election.won",
+                        labels={"node": node, "planet": "mars"})
+            flight.emit("raft.election.won", labels=labels)
+    """
+    hits = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert "unregistered event name 'raft.election.exploded'" in msgs
+    assert "label 'planet' not declared" in msgs
+    assert "computed labels" in msgs
+
+    clean = """
+        from consul_tpu import flight
+
+        def go(node, term, rec):
+            flight.emit("raft.election.won",
+                        labels={"node": node, "term": term})
+            rec.emit("serf.member.flap",
+                     labels={"node": node, "status": "failed",
+                             "tick": 3})
+            flight.emit("agent.started", labels=None)
+    """
+    assert check_snippet("event-names", clean) == []
+
+
+def test_event_names_gates_positional_labels():
+    """emit(name, labels) and emit(name=..., labels=...) — every call
+    shape must hit the same gates as the canonical spelling."""
+    bad = """
+        from consul_tpu import flight
+
+        def go(node, some_dict):
+            flight.emit("raft.election.won", some_dict)
+            flight.emit("raft.election.won", {"planet": "mars"})
+            flight.emit(name="raft.election.exploded")
+            flight.emit(name="raft.election.won", labels=some_dict)
+    """
+    hits = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 4
+    assert msgs.count("computed labels") == 2
+    assert "label 'planet' not declared" in msgs
+    assert "unregistered event name 'raft.election.exploded'" in msgs
+
+    clean = """
+        from consul_tpu import flight
+
+        def go(node):
+            flight.emit("raft.election.won", {"node": node, "term": 2})
+    """
+    assert check_snippet("event-names", clean) == []
+
+
+def test_event_names_ignores_non_event_emit_calls():
+    """The telemetry sinks' emit("counter", ...) and arbitrary .emit()
+    APIs with undotted or non-literal first args are out of scope."""
+    clean = """
+        def flush(sink, name, v, dynamic_name):
+            sink.emit("counter", name, v)
+            sink.emit(dynamic_name, labels={"x": 1})
+    """
+    assert check_snippet("event-names", clean) == []
+
+
+def test_event_names_catalog_parses_real_flight_module():
+    """The checker's AST catalog matches the runtime CATALOG — drift
+    between them would let the gate and the validator disagree."""
+    from consul_tpu import flight as flight_mod
+    from lint.checkers.metric_names import parse_event_catalog
+    with open(os.path.join(REPO, "consul_tpu", "flight.py")) as f:
+        parsed = parse_event_catalog(f.read())
+    assert set(parsed) == set(flight_mod.CATALOG)
+    for name, labels in parsed.items():
+        assert labels == tuple(
+            flight_mod.CATALOG[name].get("labels", ()))
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

@@ -52,6 +52,9 @@ def _enable_compilation_cache() -> None:
     enable_compilation_cache()
 
 
+TIMELINE_TAIL = 25      # events printed next to a violation report
+
+
 def run_suite(names, seed: int, soak: bool) -> list:
     from consul_tpu import chaos
     rows = []
@@ -66,6 +69,15 @@ def run_suite(names, seed: int, soak: bool) -> list:
         for v in row["violations"]:
             print(f"VIOLATION [{name}]: {v}", file=sys.stderr)
             print(f"  reproduce: {row['repro']}", file=sys.stderr)
+        if row["violations"]:
+            # the flight-recorder timeline: what the nemesis injected
+            # and what the system did, in order, next to the seed —
+            # the last N rows are the ones that bracket the violation
+            tail = row.get("events", "").splitlines()[-TIMELINE_TAIL:]
+            print(f"  timeline (last {len(tail)} events):",
+                  file=sys.stderr)
+            for line in tail:
+                print(f"    {line}", file=sys.stderr)
     return rows
 
 
@@ -84,9 +96,22 @@ def run_check() -> int:
         failures.append(
             f"partition_heal not reproducible from seed {CHECK_SEED}: "
             f"{first['digest']} vs {again['digest']}")
+    # the flight-recorder timeline must replay BYTE-identical too — a
+    # timeline that drifts across identical runs is useless as the
+    # violation-report evidence it exists to be
+    timeline_identical = again.get("events") == first.get("events")
+    if not timeline_identical:
+        failures.append(
+            f"partition_heal event timeline not byte-identical across "
+            f"the determinism double-run (seed {CHECK_SEED}): "
+            f"{len(first.get('events', ''))} vs "
+            f"{len(again.get('events', ''))} bytes")
     out = {"mode": "check", "seed": CHECK_SEED,
            "scenarios": [r["scenario"] for r in rows],
            "deterministic": deterministic,
+           "timeline_identical": timeline_identical,
+           "events_journaled": sum(
+               len(r.get("events", "").splitlines()) for r in rows),
            "ok": not failures, "failures": failures}
     print(json.dumps(out))
     return 1 if failures else 0
@@ -95,6 +120,10 @@ def run_check() -> int:
 def run_soak(names, seed: int, out_path: str) -> int:
     from consul_tpu import chaos
     rows = run_suite(names, seed, soak=True)
+    for r in rows:
+        # bound the artifact: keep the timeline tail, not the full ring
+        r["events"] = "\n".join(
+            r.get("events", "").splitlines()[-200:])
     report = {
         "suite": "chaos_soak",
         "seed": seed,

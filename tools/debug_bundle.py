@@ -1,0 +1,71 @@
+"""One-command debug bundle: the `consul debug` capture as a CLI.
+
+    python tools/debug_bundle.py                       # ./debug_bundle.tar.gz
+    python tools/debug_bundle.py --out /tmp/cap.tar.gz
+    python tools/debug_bundle.py --intervals 3 --interval 0.5
+
+A thin wrapper over `consul_tpu.debug.capture()` (command/debug/debug.go
+role): the archive carries host info, recent logs, per-interval metrics
+(JSON + prometheus exposition) and thread dumps, the trace-span ring,
+the flight-recorder event journal (events.jsonl), and the tick
+profiler's EMA table (profile.json).  Defaults are sized for the tier-1
+smoke: one interval, sub-second capture, archive written in well under
+10 s.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tarfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+DEFAULT_OUT = "debug_bundle.tar.gz"
+
+# sections every bundle must carry (the smoke test asserts presence)
+REQUIRED_SECTIONS = ("host.json", "logs.txt", "0/metrics.json",
+                     "0/metrics.prom", "0/threads.txt", "trace.json",
+                     "events.jsonl", "profile.json")
+
+
+def build(out_path: str, intervals: int = 1,
+          interval_s: float = 0.2, agent=None) -> dict:
+    """Capture + write + verify; returns a summary row."""
+    from consul_tpu import debug
+    t0 = time.perf_counter()
+    blob = debug.capture(agent=agent, intervals=max(1, intervals),
+                         interval_s=interval_s)
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    wall = time.perf_counter() - t0
+    with tarfile.open(out_path, "r:gz") as tar:
+        names = tar.getnames()
+    missing = [s for s in REQUIRED_SECTIONS if s not in names]
+    return {"out": out_path, "bytes": len(blob),
+            "wall_s": round(wall, 3), "sections": names,
+            "missing": missing, "ok": not missing}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--intervals", type=int, default=1,
+                    help="metric/thread-dump sampling intervals")
+    ap.add_argument("--interval", type=float, default=0.2,
+                    help="seconds between intervals")
+    args = ap.parse_args(argv)
+    row = build(args.out, intervals=args.intervals,
+                interval_s=args.interval)
+    import json
+    print(json.dumps({k: row[k] for k in
+                      ("out", "bytes", "wall_s", "ok", "missing")}))
+    return 0 if row["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,7 +7,8 @@ from lint.checkers.dtype_discipline import DtypeDisciplineChecker
 from lint.checkers.exception_hygiene import ExceptionHygieneChecker
 from lint.checkers.gather_discipline import GatherDisciplineChecker
 from lint.checkers.jit_purity import JitPurityChecker
-from lint.checkers.metric_names import MetricNamesChecker
+from lint.checkers.metric_names import (EventNamesChecker,
+                                        MetricNamesChecker)
 from lint.checkers.recompile_hazard import RecompileHazardChecker
 from lint.checkers.storage_seam import StorageSeamChecker
 
@@ -20,6 +21,7 @@ ALL = [
     ExceptionHygieneChecker(),
     StorageSeamChecker(),
     MetricNamesChecker(),
+    EventNamesChecker(),
     GatherDisciplineChecker(),
 ]
 

@@ -1,4 +1,4 @@
-"""metric-names: the go-metrics naming convention, checked at the
+"""metric-names + event-names: naming conventions checked at the
 call site.
 
 Every metric in the tree is emitted through `telemetry.incr_counter`
@@ -18,12 +18,23 @@ process runs:
   * a literal labels dict must stay within MAX_LABELS_PER_METRIC keys
     and its keys must be literal strings (a computed label KEY is the
     cardinality foot-gun's close cousin).
+
+The sibling `event-names` checker applies the same discipline to the
+flight recorder (consul_tpu/flight.py): every `flight.emit(...)` /
+`<recorder>.emit(...)` call site whose first argument is a literal
+dotted event name must name an event registered in `flight.CATALOG`
+(parsed from the literal dict's AST — no imports), its literal label
+keys must be declared in that event's schema, and a NON-literal
+`labels=` argument is flagged as an unbounded label set (the
+cardinality foot-gun the runtime validator can only catch after the
+fact).
 """
 
 from __future__ import annotations
 
+import os
 import re
-from typing import Iterator, List
+from typing import Dict, Iterator, List, Optional, Tuple
 
 import ast
 
@@ -86,6 +97,136 @@ class MetricNamesChecker(Checker):
                                 "computed label KEY — label keys must "
                                 "be literals (values may vary, keys "
                                 "may not)")
+
+
+# --------------------------------------------------------------------
+# event-names: the flight recorder's registered-schema catalog, at the
+# emit site (the static twin of flight.FlightRecorder.emit's runtime
+# validation)
+
+
+EVENT_NAME_RE = re.compile(r"^[a-z0-9_-]+(\.[a-z0-9_-]+)+$")
+FLIGHT_MODULE = os.path.join("consul_tpu", "flight.py")
+
+
+def parse_event_catalog(source: str) -> Dict[str, Tuple[str, ...]]:
+    """{event name: allowed label keys} from the literal `CATALOG`
+    assignment in flight.py — pure AST, no import of the package."""
+    out: Dict[str, Tuple[str, ...]] = {}
+    tree = ast.parse(source)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "CATALOG"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            continue
+        for key, val in zip(node.value.keys, node.value.values):
+            name = literal_str(key) if key is not None else None
+            if name is None or not isinstance(val, ast.Dict):
+                continue
+            labels: Tuple[str, ...] = ()
+            for k2, v2 in zip(val.keys, val.values):
+                if k2 is not None and literal_str(k2) == "labels" \
+                        and isinstance(v2, (ast.Tuple, ast.List)):
+                    labels = tuple(
+                        s for s in map(literal_str, v2.elts)
+                        if s is not None)
+            out[name] = labels
+    return out
+
+
+class EventNamesChecker(Checker):
+    name = "event-names"
+    description = ("flight-recorder emit sites must use names "
+                   "registered in flight.CATALOG with declared, "
+                   "literal label keys")
+
+    def __init__(self):
+        # catalog cache keyed by (flight.py path, mtime): the checker
+        # stays a pure function of its inputs — same tree, same result
+        self._cache: Dict[Tuple[str, float],
+                          Dict[str, Tuple[str, ...]]] = {}
+
+    def _catalog(self, module: Module
+                 ) -> Optional[Dict[str, Tuple[str, ...]]]:
+        rel = module.relpath.replace("/", os.sep)
+        root = module.path[:-len(rel)] if module.path.endswith(rel) \
+            else None
+        if root is None:
+            return None
+        path = os.path.join(root, FLIGHT_MODULE)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return None
+        key = (path, mtime)
+        if key not in self._cache:
+            with open(path, encoding="utf-8") as f:
+                self._cache = {key: parse_event_catalog(f.read())}
+        return self._cache[key]
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        catalog = self._catalog(module)
+        if catalog is None:
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = (call_name(node) or "").rsplit(".", 1)[-1]
+            if fn != "emit":
+                continue
+            # the event name arrives positionally or as name= — both
+            # shapes gate (a keyword spelling must not slip past)
+            name_node = node.args[0] if node.args else next(
+                (kw.value for kw in node.keywords if kw.arg == "name"),
+                None)
+            lit = literal_str(name_node) if name_node is not None \
+                else None
+            # only dotted event-shaped literals: the telemetry sinks'
+            # emit("counter", ...) and arbitrary .emit() APIs carry
+            # undotted or non-literal first args and stay out of scope
+            if lit is None or not EVENT_NAME_RE.match(lit):
+                continue
+            schema = catalog.get(lit)
+            if schema is None:
+                yield module.finding(
+                    self.name, name_node,
+                    f"unregistered event name {lit!r} — register it "
+                    f"in flight.CATALOG (name, severity, label keys)")
+                continue
+            # labels arrive as the second positional arg (emit's
+            # signature) or the labels= keyword — both shapes gate
+            label_nodes = [kw.value for kw in node.keywords
+                           if kw.arg == "labels"]
+            if len(node.args) >= 2:
+                label_nodes.append(node.args[1])
+            for val in label_nodes:
+                if not isinstance(val, ast.Dict):
+                    if not (isinstance(val, ast.Constant)
+                            and val.value is None):
+                        yield module.finding(
+                            self.name, val,
+                            f"computed labels on event {lit!r} — an "
+                            f"unbounded label set; pass a literal "
+                            f"dict with declared keys")
+                    continue
+                for key in val.keys:
+                    k = literal_str(key) if key is not None else None
+                    if k is None:
+                        yield module.finding(
+                            self.name, val,
+                            f"computed label KEY on event {lit!r} — "
+                            f"label keys must be literals declared "
+                            f"in the catalog")
+                    elif k not in schema:
+                        yield module.finding(
+                            self.name, val,
+                            f"label {k!r} not declared for event "
+                            f"{lit!r} (allowed: {schema})")
 
 
 # --------------------------------------------------------------------

@@ -44,6 +44,7 @@ import numpy as np
 from consul_tpu.config import GossipConfig, SimConfig
 from consul_tpu.models import serf, swim
 from consul_tpu.parallel import mesh as meshlib
+from consul_tpu.profiler import TickProfiler
 from consul_tpu.utils import donation, hard_sync
 
 
@@ -96,30 +97,38 @@ def sweep(n: int, mesh=None, ticks: int = 250) -> dict:
             if ca.get(k_in) is not None:
                 hlo[k_out] = float(ca[k_in])
         del compiled
-    # ONE compiled shape for warm/timed/converge
-    s, _ = run(params, s, ticks, victim)
-    hard_sync(s)
+    # ONE compiled shape for warm/timed/converge; a local profiler
+    # stamps each pass's EMA into the row (the bench artifacts' new
+    # "profile" key — ROADMAP item 3's re-baselining input)
+    prof = TickProfiler()
+    with prof.span("warm_scan"):
+        s, _ = run(params, s, ticks, victim)
+        hard_sync(s)
+    prof.note_jit("serf.run", run)
     if mesh is not None:
         meshlib.assert_node_sharded(s.swim.know, n_devices,
                                     "knowledge matrix (warm scan)")
     # per-tick cost (steady state); chain through the output — the
     # donated input is consumed by the call
     t0 = time.perf_counter()
-    s, _ = run(params, s, ticks, victim)
-    hard_sync(s)
+    with prof.span("timed_scan"):
+        s, _ = run(params, s, ticks, victim)
+        hard_sync(s)
     per_tick_ms = (time.perf_counter() - t0) / ticks * 1000
     # convergence after a crash
     s = s.replace(swim=swim.kill(s.swim, victim))
     hard_sync(s.swim.up)
     t0 = time.time()
-    s, fr = run(params, s, ticks, victim)
-    fr = np.asarray(fr)
+    with prof.span("converge_scan"):
+        s, fr = run(params, s, ticks, victim)
+        fr = np.asarray(fr)
     wall = time.time() - t0
     if mesh is not None:
         meshlib.assert_node_sharded(s.swim.know, n_devices,
                                     "knowledge matrix (full scan)")
     compiles = int(run._cache_size()) if hasattr(run, "_cache_size") \
         else None
+    prof.note_cache_size("serf.run", compiles)
     assert compiles in (None, 1), \
         f"sharded scan compiled {compiles}x (expected exactly 1)"
     conv_tick = int(np.argmax(fr > 0.999)) + 1 if (fr > 0.999).any() \
@@ -137,7 +146,7 @@ def sweep(n: int, mesh=None, ticks: int = 250) -> dict:
             "scan_wall_s": round(wall, 3),
             "converged": bool((fr > 0.999).any()),
             "sharded": mesh is not None,
-            "compiles": compiles, **hlo}
+            "compiles": compiles, "profile": prof.snapshot(), **hlo}
 
 
 def weak_scaling(max_devices: int, per_shard: int, ticks: int,
