@@ -7,7 +7,9 @@ independent module."""
 from __future__ import annotations
 
 import base64
+import http.client
 import json
+import socket
 import time
 import urllib.error
 import urllib.parse
@@ -16,23 +18,71 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class ApiError(Exception):
+    """HTTP-level error (the server answered with a status >= 400).
+    `ambiguous` says whether the request MAY have taken effect anyway —
+    the distinction a history collector needs to classify outcomes
+    (Jepsen's :ok / :fail / :info trichotomy)."""
+
+    ambiguous = False
+
     def __init__(self, code: int, body: str):
         super().__init__(f"HTTP {code}: {body}")
         self.code = code
         self.body = body
 
 
+class ApiTimeoutError(ApiError):
+    """The request was (possibly) sent but no answer arrived in time —
+    a socket timeout, reset, or broken pipe.  AMBIGUOUS: a write may
+    have committed before the answer was lost; callers recording
+    client histories must treat the outcome as unknown, not failed."""
+
+    ambiguous = True
+
+    def __init__(self, detail: str):
+        Exception.__init__(self, f"timeout/ambiguous: {detail}")
+        self.code = None
+        self.body = detail
+
+
+class ApiConnectionError(ApiError):
+    """No listener reachable (connection refused / no such host): the
+    request never entered a server, so a write DEFINITELY did not
+    take effect.  Safe to count as a failure in a client history."""
+
+    ambiguous = False
+
+    def __init__(self, detail: str):
+        Exception.__init__(self, f"connection failed: {detail}")
+        self.code = None
+        self.body = detail
+
+
+# reasons that prove the request never reached a serving process (the
+# TCP connect itself was rejected) vs. everything else, where bytes may
+# already have crossed into a server before the failure
+_DEFINITE_REASONS = (ConnectionRefusedError, socket.gaierror)
+
+
+def _classify_oserror(e: BaseException, url: str) -> ApiError:
+    if isinstance(e, _DEFINITE_REASONS):
+        return ApiConnectionError(f"{url}: {e}")
+    return ApiTimeoutError(f"{url}: {e}")
+
+
 class Client:
     def __init__(self, address: str = "http://127.0.0.1:8500",
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 timeout: float = 330.0):
         self.address = address.rstrip("/")
         self.token = token
+        self.timeout = timeout
 
     # ------------------------------------------------------------- transport
 
     def _call(self, verb: str, path: str, params: Dict[str, Any] | None = None,
               body: bytes | None = None,
-              timeout: float = 330.0) -> Tuple[Any, int, bytes]:
+              timeout: Optional[float] = None) -> Tuple[Any, int, bytes]:
         qs = urllib.parse.urlencode(
             {k: v for k, v in (params or {}).items() if v is not None})
         url = f"{self.address}{path}" + (f"?{qs}" if qs else "")
@@ -40,7 +90,9 @@ class Client:
         if self.token:
             req.add_header("X-Consul-Token", self.token)
         try:
-            with urllib.request.urlopen(req, timeout=timeout) as resp:
+            with urllib.request.urlopen(
+                    req, timeout=timeout if timeout is not None
+                    else self.timeout) as resp:
                 raw = resp.read()
                 idx = int(resp.headers.get("X-Consul-Index") or 0)
                 ctype = resp.headers.get("Content-Type", "")
@@ -49,6 +101,22 @@ class Client:
                 return None, idx, raw
         except urllib.error.HTTPError as e:
             raise ApiError(e.code, e.read().decode(errors="replace")) from None
+        except urllib.error.URLError as e:
+            # connect-phase failures ride URLError; split DEFINITE
+            # (refused: no listener, the write cannot have applied)
+            # from AMBIGUOUS (timeout/reset: it may have committed)
+            reason = e.reason if isinstance(e.reason, BaseException) \
+                else OSError(str(e.reason))
+            raise _classify_oserror(reason, url) from None
+        except (TimeoutError, socket.timeout) as e:
+            # read-phase timeouts surface raw from http.client
+            raise ApiTimeoutError(f"{url}: {e}") from None
+        except (ConnectionError, OSError) as e:
+            raise _classify_oserror(e, url) from None
+        except http.client.HTTPException as e:
+            # torn response (peer died mid-reply): request was sent,
+            # outcome unknown
+            raise ApiTimeoutError(f"{url}: {e}") from None
 
     # -------------------------------------------------------------------- kv
 

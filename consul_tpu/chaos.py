@@ -208,12 +208,20 @@ class FaultyStorage(storage.StorageOps):
 
     def __init__(self, seed: int = 0, crash_at: Optional[int] = None,
                  torn: bool = False, rename_reorder: bool = False,
-                 corrupt_on_crash: Tuple[str, ...] = ()):
+                 corrupt_on_crash: Tuple[str, ...] = (),
+                 adopt_existing: bool = False):
         self.seed = seed
         self.crash_at = crash_at
         self.torn = torn
         self.rename_reorder = rename_reorder
         self.corrupt_on_crash = tuple(corrupt_on_crash)
+        # adopt_existing: a FRESH FaultyStorage opening files written
+        # by a PREVIOUS process life (the live nemesis restarts a
+        # server on its data-dir) must treat their on-disk bytes as
+        # already durable — without this, the first crash() of the new
+        # life could tear into bytes an earlier fsync made safe, a
+        # disk state no real power loss can produce
+        self.adopt_existing = adopt_existing
         self.lose_next_fsyncs = 0
         self.fail_next_fsyncs = 0
         self.enospc = False
@@ -255,6 +263,15 @@ class FaultyStorage(storage.StorageOps):
             ^ zlib.crc32(os.path.basename(path).encode()))
 
     def _register(self, f: BinaryIO, path: str) -> BinaryIO:
+        if self.adopt_existing and path not in self.files \
+                and path not in self._tracked and os.path.exists(path):
+            try:
+                with open(path, "rb") as r:
+                    blob = r.read()
+                if blob:
+                    self.files[path] = blob
+            except OSError:
+                pass
         self._paths[id(f)] = path
         self._handles.append(f)
         self._tracked.add(path)
@@ -320,6 +337,18 @@ class FaultyStorage(storage.StorageOps):
 
     def replace(self, src: str, dst: str) -> None:
         self._op("replace", dst)
+        if self.adopt_existing and dst not in self.files \
+                and dst not in self._tracked and os.path.exists(dst):
+            # the file being replaced carries a previous process life's
+            # durable bytes: a crash before fsync_dir must be able to
+            # roll back to them, so adopt them before the rename
+            try:
+                with open(dst, "rb") as r:
+                    blob = r.read()
+                if blob:
+                    self.files[dst] = blob
+            except OSError:
+                pass
         storage.StorageOps.replace(self, src, dst)
         self._tracked.add(dst)
         self._pending.append((src, dst))

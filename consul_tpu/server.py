@@ -76,7 +76,7 @@ class Server:
     def __init__(self, node_id: str, peers: List[str], transport: Transport,
                  registry: Dict[str, "Server"],
                  raft_config: Optional[RaftConfig] = None, seed: int = 0,
-                 data_dir: Optional[str] = None):
+                 data_dir: Optional[str] = None, storage_io=None):
         self.node_id = node_id
         self.transport = transport
         self.store = StateStore()
@@ -84,12 +84,17 @@ class Server:
         self.registry = registry
         # data_dir → durable raft log + vote + snapshots (the
         # raft-boltdb + FileSnapshotStore role, server.go:728): a
-        # kill -9 of the whole fleet recovers to the last commit
+        # kill -9 of the whole fleet recovers to the last commit.
+        # `storage_io` is the storage.py seam instance the WAL writes
+        # through — the live nemesis threads a chaos.FaultyStorage in
+        # here (tools/server_proc.py --storage-faults) so a real server
+        # PROCESS can suffer torn-disk power loss.
         durable = None
         if data_dir:
             from consul_tpu.consensus.logstore import DurableLog
             import os
-            durable = DurableLog(os.path.join(data_dir, "raft"))
+            durable = DurableLog(os.path.join(data_dir, "raft"),
+                                 io=storage_io)
         self.raft = RaftNode(
             node_id, peers, transport,
             apply_fn=self.fsm.apply,

@@ -33,7 +33,12 @@ from typing import Optional, Tuple
 class MeshGatewayForwarder:
     """Federation data plane of one mesh gateway: accept → connect to
     the local serving address → splice bytes both ways until either
-    side closes."""
+    side closes.
+
+    Subclass hooks (the live nemesis's `chaos_live.LinkProxy` builds
+    its toxiproxy-style link interposer on this same machinery):
+    `_admit()` gates each accepted connection, `_pre_forward(data)`
+    gates/paces each spliced chunk — both default to pass-through."""
 
     def __init__(self, target_host: str, target_port: int,
                  host: str = "127.0.0.1", port: int = 0):
@@ -44,9 +49,16 @@ class MeshGatewayForwarder:
         self._lsock.listen(64)
         self.host, self.port = self._lsock.getsockname()
         self._running = False
+        self._stopped = False
         self._accept_thread: Optional[threading.Thread] = None
         # live splice threads, joined on stop so no pump outlives us
         self._pumps: list = []
+        # live spliced sockets: stop() must shut these down or a pump
+        # parked in recv() on a healthy conn outlives the gateway
+        # (thread leak + a splice that keeps moving bytes after
+        # "death" — the live nemesis kills gateways mid-transfer)
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -57,12 +69,29 @@ class MeshGatewayForwarder:
         self._accept_thread.start()
 
     def stop(self) -> None:
+        """Idempotent, callable mid-transfer: closes the listener,
+        tears down every live splice (waking pumps parked in recv),
+        and joins all pump threads — no thread survives stop()."""
+        already = self._stopped
+        self._stopped = True
         self._running = False
-        shutdown_and_close(self._lsock)
+        if not already:
+            shutdown_and_close(self._lsock)
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5.0)
+            self._accept_thread = None
+        self._close_live()
         for t in self._pumps:
             t.join(timeout=2.0)
+        self._pumps = [t for t in self._pumps if t.is_alive()]
+
+    def _close_live(self) -> None:
+        """Tear down every live splice, waking pumps parked in recv."""
+        with self._conns_lock:
+            live = list(self._conns)
+            self._conns.clear()
+        for sock in live:
+            shutdown_and_close(sock)
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -70,12 +99,27 @@ class MeshGatewayForwarder:
 
     # ------------------------------------------------------------- data path
 
+    # ----------------------------------------------------- subclass hooks
+
+    def _admit(self) -> bool:
+        """May this accepted connection splice?  (LinkProxy: False
+        while the link is severed.)"""
+        return True
+
+    def _pre_forward(self, data: bytes) -> bool:
+        """Called per spliced chunk before forwarding; return False to
+        kill the splice.  (LinkProxy: sever check + delay fault.)"""
+        return True
+
     def _accept_loop(self) -> None:
         while self._running:
             try:
                 conn, _ = self._lsock.accept()
             except OSError:
                 return  # listener closed
+            if not self._admit():
+                conn.close()
+                continue
             try:
                 upstream = socket.create_connection(self.target,
                                                     timeout=10.0)
@@ -85,29 +129,42 @@ class MeshGatewayForwarder:
             # prune finished pumps first: a long-lived gateway must not
             # accumulate two Thread objects per connection forever
             self._pumps = [t for t in self._pumps if t.is_alive()]
+            with self._conns_lock:
+                if not self._running:
+                    # lost the race with stop(): it already swept
+                    # _conns, so these two would leak open forever
+                    conn.close()
+                    upstream.close()
+                    return
+                self._conns.update((conn, upstream))
             for a, b in ((conn, upstream), (upstream, conn)):
                 t = threading.Thread(target=self._pump, args=(a, b),
                                      daemon=True)
                 t.start()
                 self._pumps.append(t)
 
-    @staticmethod
-    def _pump(src: socket.socket, dst: socket.socket) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
         try:
             while True:
                 data = src.recv(65536)
                 if not data:
                     break
+                if not self._pre_forward(data):
+                    break
                 dst.sendall(data)
         except OSError:
             pass
         finally:
-            # half-close so the peer's pump drains and exits too
+            # half-close so the peer's pump drains and exits too; when
+            # BOTH directions have half-closed the conns drop from the
+            # live set (each side's pump closes its read end)
             for s, how in ((dst, socket.SHUT_WR), (src, socket.SHUT_RD)):
                 try:
                     s.shutdown(how)
                 except OSError:
                     pass
+            with self._conns_lock:
+                self._conns.discard(src)
 
 
 def gateway_address(store, dc: str) -> Optional[Tuple[str, int]]:

@@ -1,0 +1,50 @@
+"""Shared socket helpers for the network test suites."""
+
+import socket
+import threading
+
+
+def echo_upstream():
+    """A raw TCP echo upstream + an abrupt-death switch.
+
+    Returns (port, die): `die()` closes the listener AND every
+    accepted conn — the peer process dying mid-transfer.  A peer that
+    merely sees EOF closes its conn like a well-behaved process
+    (pumps must terminate either way)."""
+    from consul_tpu.utils.net import shutdown_and_close
+    lsock = socket.socket()
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(8)
+    conns = []
+
+    def serve():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            conns.append(conn)
+
+            def pump(conn=conn):
+                try:
+                    while True:
+                        data = conn.recv(4096)
+                        if not data:
+                            return
+                        conn.sendall(data)
+                except OSError:
+                    return
+                finally:
+                    conn.close()    # a real peer closes on EOF
+
+            threading.Thread(target=pump, daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+
+    def die():
+        shutdown_and_close(lsock)
+        for conn in conns:
+            shutdown_and_close(conn)
+
+    return lsock.getsockname()[1], die

@@ -1,0 +1,304 @@
+"""Live-cluster nemesis (ISSUE 9 tentpole): the per-link TCP
+interposer, the client outcome taxonomy (ambiguous vs definite), the
+FaultyStorage cross-process adoption rule, the event-feed merge, and
+— against a REAL 3-process cluster over real sockets — the graceful
+SIGTERM path, torn-disk power-loss restart, and proxy partitions.
+
+The full scenario families run through `chaos_live --check` inside
+`chaos_soak --check` (tests/test_chaos.py); this file unit-tests the
+pieces and exercises the process-level fault surface directly.
+"""
+
+import json
+import os
+import socket
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from consul_tpu import chaos_live
+from consul_tpu.api.client import (
+    ApiConnectionError, ApiError, ApiTimeoutError, Client,
+)
+from consul_tpu.chaos import FaultyStorage
+from consul_tpu.chaos_live import EventCollector, LinkProxy, LiveCluster
+from netutil import echo_upstream
+
+
+# ------------------------------------------------- outcome taxonomy
+
+
+def test_connection_refused_is_definite_failure():
+    """No listener → the request never entered a server → a write
+    definitely did not apply (safe to discard from a history)."""
+    port = chaos_live.free_ports(1)[0]
+    c = Client(f"http://127.0.0.1:{port}", timeout=1.0)
+    with pytest.raises(ApiConnectionError) as ei:
+        c.kv_put("x", b"1")
+    assert ei.value.ambiguous is False
+    assert isinstance(ei.value, ApiError)   # existing handlers still work
+
+
+def test_socket_timeout_is_ambiguous():
+    """A server that accepts but never answers: the bytes may be in a
+    server — the op may have committed — so the outcome is AMBIGUOUS,
+    distinct from connection-refused."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)   # backlog completes the handshake; nobody answers
+    try:
+        c = Client(f"http://127.0.0.1:{srv.getsockname()[1]}",
+                   timeout=0.5)
+        with pytest.raises(ApiTimeoutError) as ei:
+            c.kv_put("x", b"1")
+        assert ei.value.ambiguous is True
+        assert isinstance(ei.value, ApiError)
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------- the link interposer
+
+
+def test_link_proxy_splice_delay_sever_heal():
+    port, close = echo_upstream()
+    p = LinkProxy(("127.0.0.1", port), name="t")
+    p.start()
+    try:
+        # splice
+        s = socket.create_connection((p.host, p.port), timeout=5)
+        s.settimeout(5)
+        s.sendall(b"hi")
+        assert s.recv(10) == b"hi"
+        # delay: per-chunk head-of-line latency
+        p.set_delay(0.25)
+        t0 = time.time()
+        s.sendall(b"slow")
+        assert s.recv(10) == b"slow"
+        assert time.time() - t0 >= 0.2
+        p.set_delay(0.0)
+        # sever kills the LIVE splice...
+        p.sever()
+        deadline = time.time() + 5
+        dead = False
+        while time.time() < deadline and not dead:
+            try:
+                s.sendall(b"x")
+                if s.recv(10) == b"":
+                    dead = True
+            except OSError:
+                dead = True
+        assert dead, "severed link kept carrying bytes"
+        s.close()
+        # ...and refuses new splices (accept-then-close: EOF at once)
+        s2 = socket.create_connection((p.host, p.port), timeout=5)
+        s2.settimeout(5)
+        try:
+            s2.sendall(b"y")
+            assert s2.recv(10) == b""
+        except OSError:
+            pass            # RST is an equally dead link
+        finally:
+            s2.close()
+        # heal restores the path
+        p.heal()
+        s3 = socket.create_connection((p.host, p.port), timeout=5)
+        s3.settimeout(5)
+        s3.sendall(b"back")
+        assert s3.recv(10) == b"back"
+        s3.close()
+    finally:
+        p.stop()
+        close()
+
+
+def test_link_proxy_stop_leaves_no_pumps():
+    port, close = echo_upstream()
+    p = LinkProxy(("127.0.0.1", port), name="t2")
+    p.start()
+    s = socket.create_connection((p.host, p.port), timeout=5)
+    s.sendall(b"hold")
+    p.stop()
+    s.close()
+    close()
+    deadline = time.time() + 3
+    while time.time() < deadline and any(
+            t.is_alive() for t in p._pumps):
+        time.sleep(0.05)
+    assert not any(t.is_alive() for t in p._pumps)
+
+
+# -------------------------------------- FaultyStorage adoption rule
+
+
+def test_faulty_storage_adopts_previous_life_bytes(tmp_path):
+    """A restarted process opening a previous life's WAL must treat
+    its on-disk bytes as durable: a power loss may tear ONLY the
+    un-fsynced bytes of THIS life, never the inherited prefix."""
+    path = str(tmp_path / "wal.log")
+    durable = b"DURABLE-FROM-LAST-LIFE-0123456789"
+    with open(path, "wb") as f:
+        f.write(durable)
+    fs = FaultyStorage(seed=3, torn=True, adopt_existing=True)
+    h = fs.open_append(path)
+    fs.write(h, b"UNSYNCED-TAIL")     # never fsynced
+    fs.crash()
+    with open(path, "rb") as f:
+        got = f.read()
+    assert got[:len(durable)] == durable
+    assert len(durable) <= len(got) <= len(durable) + len(b"UNSYNCED-TAIL")
+
+
+def test_faulty_storage_without_adoption_can_tear_inherited_bytes(
+        tmp_path):
+    """The contrast case documenting WHY adoption exists: a fresh
+    FaultyStorage that does not adopt treats the whole file as
+    un-fsynced, so crash() may tear into bytes a previous life made
+    durable — an impossible disk state for a real power loss."""
+    path = str(tmp_path / "wal.log")
+    durable = b"DURABLE-FROM-LAST-LIFE-0123456789"
+    with open(path, "wb") as f:
+        f.write(durable)
+    # seed chosen so the seeded tear lands strictly inside the
+    # inherited prefix (deterministic per-file RNG)
+    for seed in range(64):
+        fs = FaultyStorage(seed=seed, torn=True)
+        h = fs.open_append(path)
+        fs.write(h, b"UNSYNCED-TAIL")
+        fs.crash()
+        try:
+            with open(path, "rb") as f:
+                got = f.read()
+        except FileNotFoundError:
+            return      # torn to nothing: demonstrated
+        if len(got) < len(durable):
+            return      # demonstrated
+        with open(path, "wb") as f:
+            f.write(durable)
+    pytest.fail("no seed in 0..63 tore the inherited prefix — the "
+                "non-adopting model may have grown adoption silently")
+
+
+# ----------------------------------------------- event-feed merging
+
+
+def test_event_collector_merges_and_parses_elections():
+    col = EventCollector(SimpleNamespace(servers=[]))
+    col.rows = [
+        {"node": "server1", "gen": 1, "seq": 1, "ts": 2.0,
+         "name": "raft.election.won", "severity": "info",
+         "labels": {"node": "server1", "term": 3}},
+        {"node": "server0", "gen": 1, "seq": 1, "ts": 1.0,
+         "name": "agent.started", "severity": "info",
+         "labels": {"node": "server0"}},
+    ]
+    nemesis = [{"seq": 0, "ts": 1.5, "name": "chaos.fault.injected",
+                "severity": "warn", "labels": {"fault": "kill9",
+                                               "target": "server0"}}]
+    lines = [json.loads(x) for x in
+             col.merged_jsonl(nemesis).splitlines()]
+    assert [r["name"] for r in lines] == [
+        "agent.started", "chaos.fault.injected", "raft.election.won"]
+    assert lines[1]["node"] == "nemesis"
+    assert col.election_wins() == [(3, "server1")]
+
+
+# ------------------------------------- the real 3-process cluster
+
+
+@pytest.fixture(scope="module")
+def live_cluster(tmp_path_factory):
+    root = tmp_path_factory.mktemp("live-nemesis")
+    c = LiveCluster(n=3, data_root=str(root),
+                    storage_faults="seed=5,torn=1")
+    c.start()
+    yield c
+    c.stop()
+
+
+def _await_local(cluster, i, key, want, timeout=20.0):
+    """Poll node i's LOCAL replica (default-consistency read) until
+    `key` carries `want`."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            row, _ = cluster.client(i, timeout=2.0).kv_get(key)
+            if row is not None and row["Value"] == want:
+                return True
+        except (ApiError, OSError):
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def test_live_cluster_replicates_over_proxied_links(live_cluster):
+    c = live_cluster
+    assert c.client(0, timeout=5.0).kv_put("t/a", b"1")
+    for i in range(3):
+        assert _await_local(c, i, "t/a", b"1"), \
+            f"replication never reached server{i}"
+
+
+def test_sigterm_is_graceful_and_member_rejoins(live_cluster):
+    c = live_cluster
+    li = c.leader()
+    victim = (li + 1) % 3
+    rc = c.servers[victim].terminate()
+    assert rc == 0, f"graceful shutdown exited {rc!r}"
+    log_path = os.path.join(
+        c.servers[victim].data_dir,
+        f"log.gen{c.servers[victim].generation}.txt")
+    with open(log_path, "rb") as f:
+        assert b"graceful shutdown" in f.read()
+    c.restart(victim)
+    assert c.wait_http(victim)
+    # writes still replicate to the rejoined member
+    assert c.client(li, timeout=5.0).kv_put("t/rejoin", b"2")
+    assert _await_local(c, victim, "t/rejoin", b"2")
+
+
+def test_power_loss_torn_restart_preserves_acked_writes(live_cluster):
+    """The acceptance path: SIGUSR1 collapses the FaultyStorage page
+    cache (seeded torn tail), the process dies hard, and the restart
+    on the same data-dir rejoins with every ACKED write present."""
+    c = live_cluster
+    li = c.leader()
+    acked = []
+    cl = c.client(li, timeout=5.0)
+    for k in range(12):
+        val = f"pl.{k}".encode()
+        assert cl.kv_put(f"pl/{k:03d}", val)
+        acked.append((f"pl/{k:03d}", val))
+    victim = (li + 2) % 3
+    rc = c.servers[victim].power_loss()
+    assert rc == 137, f"power loss exited {rc!r}"
+    c.restart(victim)
+    assert c.wait_http(victim)
+    for key, val in acked:
+        assert _await_local(c, victim, key, val), \
+            f"acked write {key} lost across torn-disk restart"
+
+
+def test_proxy_partition_and_heal(live_cluster):
+    """Severing every link of the leader through the interposers
+    forces a majority election; healing lets the old leader rejoin."""
+    c = live_cluster
+    li = c.leader(timeout=30.0)
+    c.sever_node(li)
+    try:
+        # the majority elects and serves (retry through the window)
+        other = (li + 1) % 3
+        deadline = time.time() + 25
+        ok = False
+        while time.time() < deadline and not ok:
+            try:
+                ok = c.client(other, timeout=2.5).kv_put(
+                    "t/during-partition", b"3")
+            except (ApiError, OSError):
+                time.sleep(0.3)
+        assert ok, "majority never served writes during the partition"
+    finally:
+        c.heal()
+    # the healed ex-leader catches up
+    assert _await_local(c, li, "t/during-partition", b"3")

@@ -866,3 +866,30 @@ def test_legacy_audit_shims_still_detect():
         "# TYPE consul_x counter\n# TYPE consul_x gauge\n")
     assert len(dup) == 1 and "duplicate" in dup[0]
     assert storage_audit.audit() == []
+
+
+def test_blocking_call_covers_live_nemesis_module():
+    """consul_tpu/chaos_live.py is in the blocking-call scope (its
+    LinkProxy pumps ARE the inter-server RPC data path); legitimate
+    wait sites there need per-line suppressions with reasons."""
+    bad = """
+        import time
+
+        def pump(chunk):
+            time.sleep(0.1)
+            return chunk
+    """
+    hits = check_snippet("blocking-call", bad,
+                         relpath="consul_tpu/chaos_live.py")
+    assert len(hits) == 1 and "time.sleep" in hits[0].message
+
+    suppressed = """
+        import time
+
+        def pump(chunk):
+            # lint: ok=blocking-call (delay fault on purpose)
+            time.sleep(0.1)
+            return chunk
+    """
+    assert check_snippet("blocking-call", suppressed,
+                         relpath="consul_tpu/chaos_live.py") == []

@@ -129,3 +129,85 @@ def test_config_flag_enables_wanfed(tmp_path):
         assert a.runtime_config.connect_mesh_gateway_wan_federation
     finally:
         a.stop()   # never started: stop must not hang (shutdown guard)
+
+
+# --------------------------------------------------------------------
+# forwarder under abrupt peer death (ISSUE 9 satellite): half-closed
+# pumps terminate, no thread leak, stop() is idempotent mid-transfer
+# --------------------------------------------------------------------
+
+
+import time
+
+from netutil import echo_upstream
+
+
+def _no_live_pumps(gw, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if not any(t.is_alive() for t in gw._pumps):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_forwarder_pumps_exit_on_abrupt_upstream_death():
+    port, die = echo_upstream()
+    gw = MeshGatewayForwarder("127.0.0.1", port)
+    gw.start()
+    try:
+        s = socket.create_connection(gw.address, timeout=5)
+        s.settimeout(5)
+        s.sendall(b"ping")
+        assert s.recv(10) == b"ping"
+        # the upstream process dies mid-connection
+        die()
+        # the client side sees EOF/reset, both pumps terminate
+        try:
+            assert s.recv(10) == b""
+        except OSError:
+            pass
+        s.close()
+        assert _no_live_pumps(gw), \
+            "pump threads survived abrupt upstream death"
+    finally:
+        gw.stop()
+
+
+def test_forwarder_stop_idempotent_mid_transfer():
+    port, die = echo_upstream()
+    gw = MeshGatewayForwarder("127.0.0.1", port)
+    gw.start()
+    s = socket.create_connection(gw.address, timeout=5)
+    s.settimeout(5)
+    s.sendall(b"hold")
+    assert s.recv(10) == b"hold"
+    # stop mid-transfer, twice: both calls return, nothing raises,
+    # and no pump survives (stop tears down live splices itself)
+    gw.stop()
+    gw.stop()
+    assert _no_live_pumps(gw)
+    try:
+        assert s.recv(10) == b""
+    except OSError:
+        pass
+    s.close()
+    die()
+
+
+def test_forwarder_no_thread_leak_over_many_connections():
+    port, die = echo_upstream()
+    gw = MeshGatewayForwarder("127.0.0.1", port)
+    gw.start()
+    try:
+        for i in range(10):
+            s = socket.create_connection(gw.address, timeout=5)
+            s.settimeout(5)
+            s.sendall(b"x")
+            assert s.recv(10) == b"x"
+            s.close()
+        assert _no_live_pumps(gw), \
+            "closed connections left live pump threads"
+    finally:
+        gw.stop()
+        die()

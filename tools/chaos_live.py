@@ -1,0 +1,132 @@
+"""Live-cluster chaos runner: Jepsen the real multi-process cluster
+over real sockets (ISSUE 9 tentpole).
+
+    python tools/chaos_live.py                  # every live scenario,
+                                                # emits CHAOS_r03.json
+    python tools/chaos_live.py --seed 42        # same suite, seed 42
+    python tools/chaos_live.py --scenario live_kill_leader_loop --seed 3
+    python tools/chaos_live.py --check          # the bounded tier-1
+                                                # smoke (also rides
+                                                # chaos_soak --check)
+
+Each scenario spawns a REAL N-process cluster (tools/server_proc.py,
+one process per member, raft + leader forwarding over TCP), routes
+every inter-server link through a per-link TCP interposer proxy, and
+injects process/link/disk faults while concurrent load workers
+collect live HTTP client histories (timeouts = ambiguous).  The
+existing invariant checkers verify them; any violation prints the
+one-line seed reproducer plus the merged last-N-events cluster
+timeline (every node's /v1/agent/events feed + the nemesis journal).
+
+The fault PLAN is drawn from one seeded RNG in fixed order, so the
+same seed reproduces the same fault timeline (the report digest
+covers the plan).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+ARTIFACT = os.path.join(REPO, "CHAOS_r03.json")
+CHECK_SEED = 7
+
+
+def run_suite(names, seed: int, check: bool) -> list:
+    from consul_tpu import chaos_live
+    rows = []
+    for name in names:
+        t0 = time.time()
+        row = chaos_live.run_live_scenario(name, seed, check=check)
+        row["wall_s"] = round(time.time() - t0, 2)
+        rows.append(row)
+        print(json.dumps({k: row[k] for k in
+                          ("scenario", "seed", "ok", "digest",
+                           "wall_s")}))
+        if row["violations"]:
+            chaos_live.print_violation_tail(row)
+    return rows
+
+
+def run_check() -> int:
+    from consul_tpu import chaos_live
+    row = chaos_live.run_live_smoke(CHECK_SEED)
+    out = {"mode": "check", "seed": CHECK_SEED,
+           "scenario": row["scenario"], "ok": row["ok"],
+           "wall_s": row["wall_s"], "budget_s": row["budget_s"],
+           "violations": row["violations"]}
+    if row["violations"]:
+        chaos_live.print_violation_tail(row)
+    print(json.dumps(out))
+    return 0 if row["ok"] else 1
+
+
+def run_soak(names, seed: int, out_path: str) -> int:
+    rows = run_suite(names, seed, check=False)
+    for r in rows:
+        # bound the artifact: the timeline tail, not the full merge
+        r["events"] = "\n".join(
+            r.get("events", "").splitlines()[-200:])
+    report = {
+        "suite": "chaos_live",
+        "seed": seed,
+        "date": time.strftime("%Y-%m-%d"),
+        "ok": all(r["ok"] for r in rows),
+        "scenarios": rows,
+        "topology": "one tools/server_proc.py process per member; "
+                    "raft + leader forwarding over TCP through "
+                    "per-link userspace interposer proxies; live "
+                    "HTTP client histories",
+        "invariants": [
+            "election safety (<=1 leader per term, from merged "
+            "/v1/agent/events feeds)",
+            "acked-write durability across kill -9 / power-loss "
+            "restarts on the same data-dir",
+            "pairwise replica prefix consistency "
+            "(ModifyIndex-ordered dumps)",
+            "linearizable KV register over live HTTP histories "
+            "(timeouts ambiguous)",
+            "graceful SIGTERM exits 0 with a flushed WAL",
+            "cross-DC requests fail fast (no hangs) when the only "
+            "mesh gateway dies; replacement gateway restores service",
+        ],
+    }
+    with open(out_path, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+        f.write("\n")
+    print(f"wrote {out_path} ok={report['ok']}")
+    return 0 if report["ok"] else 1
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--scenario", default=None,
+                    help="run one live scenario (default: the suite)")
+    ap.add_argument("--check", action="store_true",
+                    help="bounded tier-1 smoke under the hard wall "
+                         "budget")
+    ap.add_argument("--out", default=ARTIFACT)
+    args = ap.parse_args()
+    from consul_tpu import chaos_live
+    if args.check:
+        sys.exit(run_check())
+    if args.scenario is not None:
+        if args.scenario not in chaos_live.LIVE_SCENARIOS:
+            ap.error(f"unknown scenario {args.scenario!r}; one of "
+                     f"{sorted(chaos_live.LIVE_SCENARIOS)}")
+        rows = run_suite([args.scenario], args.seed, check=False)
+        sys.exit(0 if all(r["ok"] for r in rows) else 1)
+    sys.exit(run_soak(list(chaos_live.LIVE_SCENARIOS), args.seed,
+                      args.out))
+
+
+if __name__ == "__main__":
+    main()

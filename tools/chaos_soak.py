@@ -83,7 +83,9 @@ def run_suite(names, seed: int, soak: bool) -> list:
 
 def run_check() -> int:
     """Tier-1 smoke: the virtual-time scenario set at small scale with
-    a fixed seed, plus a bit-reproducibility double-run."""
+    a fixed seed, plus a bit-reproducibility double-run, plus the
+    BOUNDED LIVE smoke (a real multi-process cluster under kill -9 +
+    restart, consul_tpu/chaos_live.py) under its hard wall budget."""
     from consul_tpu import chaos
     rows = run_suite(chaos.CHECK_SCENARIOS, CHECK_SEED, soak=False)
     failures = [f"{r['scenario']}: {v}" for r in rows if not r["ok"]
@@ -106,12 +108,29 @@ def run_check() -> int:
             f"the determinism double-run (seed {CHECK_SEED}): "
             f"{len(first.get('events', ''))} vs "
             f"{len(again.get('events', ''))} bytes")
+    # the live smoke: real server processes over real sockets, the
+    # leader kill -9'd and restarted on its data-dir under load, all
+    # inside a hard wall-clock budget (chaos_live.SMOKE_BUDGET_S)
+    from consul_tpu import chaos_live
+    live = chaos_live.run_live_smoke(CHECK_SEED)
+    print(json.dumps({k: live[k] for k in
+                      ("scenario", "seed", "ok", "digest",
+                       "wall_s")}))
+    if not live["ok"]:
+        failures += [f"{live['scenario']}: {v}"
+                     for v in live["violations"]]
+        chaos_live.print_violation_tail(live)
     out = {"mode": "check", "seed": CHECK_SEED,
-           "scenarios": [r["scenario"] for r in rows],
+           "scenarios": [r["scenario"] for r in rows]
+           + [live["scenario"]],
            "deterministic": deterministic,
            "timeline_identical": timeline_identical,
            "events_journaled": sum(
                len(r.get("events", "").splitlines()) for r in rows),
+           "live": {"scenario": live["scenario"],
+                    "wall_s": live["wall_s"],
+                    "budget_s": live["budget_s"],
+                    "ok": live["ok"]},
            "ok": not failures, "failures": failures}
     print(json.dumps(out))
     return 1 if failures else 0

@@ -48,19 +48,47 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
     `addresses` round-robin — the reference's nginx-LB-over-3-servers
     row is the same fan-out."""
     import http.client
+    import socket
     import urllib.parse
     errors = []
+    # per-worker slots summed after join: `amb[0] += 1` shared across
+    # threads is a lossy read-modify-write
+    amb = [0] * conns
 
     def worker(wid):
         host = urllib.parse.urlparse(addresses[wid % len(addresses)])
-        conn = http.client.HTTPConnection(host.hostname, host.port,
-                                          timeout=30)
+
+        def fresh():
+            return http.client.HTTPConnection(host.hostname, host.port,
+                                              timeout=30)
+
+        conn = fresh()
         try:
             for i in range(per):
-                conn.request(verb, f"/v1/kv/bench/{wid}/{i % 128}",
-                             body=body)
-                r = conn.getresponse()
-                r.read()
+                try:
+                    conn.request(verb, f"/v1/kv/bench/{wid}/{i % 128}",
+                                 body=body)
+                    r = conn.getresponse()
+                    r.read()
+                except (socket.timeout, TimeoutError,
+                        ConnectionError):
+                    # TIMED OUT / RESET, not failed: the op may have
+                    # committed server-side after the connection died
+                    # (Jepsen's :info outcome) — count it separately
+                    # from errors and keep going on a fresh connection
+                    # (the old one is unusable; an unhandled reset
+                    # would silently kill the worker and overstate
+                    # throughput)
+                    amb[wid] += 1
+                    conn.close()
+                    conn = fresh()
+                    continue
+                if verb == "GET" and r.status == 404:
+                    # a PUT-phase timeout may have left this key slot
+                    # unwritten: the hole is the ambiguity showing up
+                    # one phase later, not a bench failure
+                    amb[wid] += 1
+                    continue
                 if r.status >= 400:
                     errors.append(r.status)
                     return
@@ -81,7 +109,7 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
         t.start()
     for t in threads:
         t.join()
-    q.put((time.perf_counter() - t0, errors[:3]))
+    q.put((time.perf_counter() - t0, errors[:3], sum(amb)))
 
 
 def drive(addresses, n_ops, conns, verb, body=None, procs=1):
@@ -122,11 +150,12 @@ def drive(addresses, n_ops, conns, verb, body=None, procs=1):
     for p in ps:
         p.join(timeout=30)
     dt = time.perf_counter() - t0
-    errs = [e for _, errors in results for e in errors]
+    errs = [e for _, errors, _ in results for e in errors]
     if errs:
         raise RuntimeError(f"bench errors: {errs[:3]}")
     total = per_conn * conns_per_proc * len(ps)
-    return total / dt, dt
+    ambiguous = sum(a for _, _, a in results)
+    return total / dt, dt, ambiguous
 
 
 def main():
@@ -155,27 +184,38 @@ def main():
     }
     value = b"x" * 64
     if args.cluster:
-        addresses, procs = start_cluster_procs(3)
+        # reap INSIDE try/finally: a load-gen raise (bench error,
+        # broken barrier, queue timeout) must never leak three server
+        # processes holding their ports
+        procs = []
         try:
-            rps, dt = drive(addresses[:1], args.n_ops, args.conns,
-                            "PUT", body=value)
+            addresses, procs = start_cluster_procs(3)
+            rps, dt, put_amb = drive(addresses[:1], args.n_ops,
+                                     args.conns, "PUT", body=value)
             emit({
                 "metric": "kv_put_rps_cluster3", "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
-                "cores": cores,
+                "cores": cores, "ambiguous": put_amb,
                 "vs_baseline": round(rps / baselines["kv_put"], 2)})
             time.sleep(1.0)   # let replication land on followers
-            rps, dt = drive(addresses, args.n_ops, args.conns,
-                            "GET")
+            rps, dt, get_amb = drive(addresses, args.n_ops, args.conns,
+                                     "GET")
+            # a GET-phase 404 is tolerable ONLY as the shadow of a
+            # PUT-phase timeout (the op that never learned its
+            # outcome); more holes than ambiguous PUTs is data LOSS
+            if get_amb > put_amb:
+                raise RuntimeError(
+                    f"bench: {get_amb} GET 404/timeout holes but only "
+                    f"{put_amb} ambiguous PUTs — acked writes went "
+                    f"missing")
             emit({
                 "metric": "kv_get_rps_lb3", "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
-                "cores": cores,
+                "cores": cores, "ambiguous": get_amb,
                 "vs_baseline": round(rps / baselines["kv_get_lb3"],
                                      2)})
         finally:
-            for p in procs:
-                p.terminate()
+            reap_procs(procs)
         _write_artifact(args.out, rows, cores)
         return
 
@@ -188,19 +228,19 @@ def main():
     # pacer would just burn the GIL the HTTP handlers need
     agent.start(tick_seconds=0.2, reconcile_interval=1.0)
     try:
-        rps, dt = drive(agent.http_address, args.n_ops, args.conns,
-                        "PUT", body=value)
+        rps, dt, amb = drive(agent.http_address, args.n_ops, args.conns,
+                             "PUT", body=value)
         emit({
             "metric": "kv_put_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
-            "cores": cores,
+            "cores": cores, "ambiguous": amb,
             "vs_baseline": round(rps / baselines["kv_put"], 2)})
-        rps, dt = drive(agent.http_address, args.n_ops, args.conns,
-                        "GET")
+        rps, dt, amb = drive(agent.http_address, args.n_ops, args.conns,
+                             "GET")
         emit({
             "metric": "kv_get_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
-            "cores": cores,
+            "cores": cores, "ambiguous": amb,
             "vs_baseline": round(rps / baselines["kv_get"], 2)})
     finally:
         agent.stop()
@@ -231,39 +271,62 @@ def _write_artifact(path, rows, cores):
         json.dump(data, f, indent=2)
 
 
+def reap_procs(procs):
+    """terminate → bounded wait → kill: nothing may outlive the bench
+    (a terminate() alone leaves a wedged server holding its ports)."""
+    for p in procs:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
+
+
 def start_cluster_procs(n=3, rpc_base=7101, http_base=7201):
     """Spawn one server PROCESS per member (tools/server_proc.py — the
-    reference's one-agent-per-box shape) and wait for a leader."""
+    reference's one-agent-per-box shape) and wait for a leader.  Reaps
+    whatever it spawned on ANY failure before re-raising."""
     import subprocess
     import urllib.request
     peers = ",".join(f"server{i}=127.0.0.1:{rpc_base + i}"
                      for i in range(n))
     procs = []
     addresses = []
-    for i in range(n):
-        procs.append(subprocess.Popen(
-            [sys.executable, "tools/server_proc.py",
-             "--node", f"server{i}", "--peers", peers,
-             "--http-port", str(http_base + i)],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
-        addresses.append(f"http://127.0.0.1:{http_base + i}")
-    # readiness: a write succeeds once a leader exists (followers
-    # forward); poll through server0.  NOTE: the GET phase 404-safely
-    # reads only keys the PUT phase wrote because both use the same
-    # wid/i%128 generator — keep the phases' --n-ops/--conns aligned
-    deadline = time.time() + 60
-    while time.time() < deadline:
-        try:
-            req = urllib.request.Request(
-                addresses[0] + "/v1/kv/bench-ready", data=b"1",
-                method="PUT")
-            urllib.request.urlopen(req, timeout=3)
-            return addresses, procs
-        except Exception:
-            time.sleep(0.5)
-    for p in procs:
-        p.terminate()
-    raise RuntimeError("cluster never elected a leader")
+    try:
+        for i in range(n):
+            procs.append(subprocess.Popen(
+                [sys.executable, "tools/server_proc.py",
+                 "--node", f"server{i}", "--peers", peers,
+                 "--http-port", str(http_base + i)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+            addresses.append(f"http://127.0.0.1:{http_base + i}")
+        # readiness: a write succeeds once a leader exists (followers
+        # forward); poll through server0.  NOTE: the phases share the
+        # wid/i%128 key generator, so GETs target keys the PUT phase
+        # wrote — a PUT that timed out may leave a hole, which the GET
+        # phase counts as ambiguous (404-tolerant), not as an error
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                req = urllib.request.Request(
+                    addresses[0] + "/v1/kv/bench-ready", data=b"1",
+                    method="PUT")
+                urllib.request.urlopen(req, timeout=3)
+                return addresses, procs
+            except Exception:
+                time.sleep(0.5)
+        raise RuntimeError("cluster never elected a leader")
+    except BaseException:
+        reap_procs(procs)
+        raise
 
 
 if __name__ == "__main__":

@@ -19,6 +19,13 @@ Flags `time.sleep`, `select.select`, `Event.wait()` / `.join()` /
 scopes.  Intentional fault injection that sleeps
 on purpose (chaos delay schedules) carries a
 `# lint: ok=blocking-call (...)` suppression with its reason.
+
+The live nemesis (`consul_tpu/chaos_live.py`) is ALSO in scope: its
+LinkProxy interposers sit ON the inter-server RPC data path, so an
+accidental unbounded wait there stalls the cluster under test the
+same way one in rpc/ would.  Its legitimate wait sites (the nemesis
+pacing funnel `_nap`, the accept loop, delay-fault sleeps, harness
+log files) each carry a per-line suppression with the reason.
 """
 
 from __future__ import annotations
@@ -30,6 +37,9 @@ from lint.astutil import HOT_PREFIXES, call_name, member_call_names
 from lint.core import Checker, Finding, Module
 
 RPC_PREFIXES = ("consul_tpu/rpc/",)
+# the live-nemesis interposer module: on the RPC data path by
+# construction (every inter-server frame flows through its pumps)
+LIVE_NEMESIS_FILES = ("consul_tpu/chaos_live.py",)
 
 UNBOUNDED_METHODS = {"wait", "join", "accept"}
 
@@ -41,7 +51,8 @@ class BlockingCallChecker(Checker):
 
     def run(self, module: Module) -> Iterator[Finding]:
         hot = module.relpath.startswith(HOT_PREFIXES)
-        rpc = module.relpath.startswith(RPC_PREFIXES)
+        rpc = module.relpath.startswith(RPC_PREFIXES) \
+            or module.relpath in LIVE_NEMESIS_FILES
         if not (hot or rpc):
             return
         where = "hot-loop module" if hot else "RPC path"

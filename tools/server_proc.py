@@ -6,14 +6,38 @@ GIL/cores, raft frames and leader-forwarded writes over real sockets
 (consul_tpu/rpc), HTTP on a per-server port.  Used by
 tools/kv_bench.py --cluster to measure the multi-process scale-out the
 reference benched behind an nginx LB (bench/results-0.7.1.md:184-193),
-and runnable standalone:
+by the live-cluster nemesis (consul_tpu/chaos_live.py) as the fault
+target, and runnable standalone:
 
   python tools/server_proc.py --node server0 \
       --peers server0=127.0.0.1:7101,server1=127.0.0.1:7102,... \
       --http-port 7201
+
+Signals (the nemesis's process-level fault surface):
+
+  SIGTERM   graceful shutdown — stop the HTTP API, close the RPC
+            listener + forwarder, fsync + close the WAL, exit 0 (the
+            reference's leave/shutdown path; required for clean
+            rolling restarts)
+  SIGKILL   kill -9 — nothing runs; the data-dir flock releases with
+            the process and a restart on the same --data-dir recovers
+            every committed write from the WAL
+  SIGUSR1   simulated POWER LOSS (only with --storage-faults): the
+            FaultyStorage collapses the page cache to the durable
+            view — tearing the un-fsynced WAL tail per the fault
+            model — and the process dies hard (exit 137) without any
+            shutdown path running
+
+--storage-faults "seed=N[,torn=1][,rename_reorder=1]" threads a
+chaos.FaultyStorage into the raft WAL (via Server(storage_io=...)) so
+torn-disk restarts can be injected on a REAL server process; the
+CONSUL_TPU_STORAGE_FAULTS env var is the equivalent hook for spawners
+that cannot alter argv.
 """
 
 import argparse
+import os
+import signal
 import sys
 import time
 
@@ -29,6 +53,25 @@ def parse_peers(spec: str):
     return out
 
 
+def parse_storage_faults(spec: str):
+    """"seed=3,torn=1" → a FaultyStorage armed for live power loss.
+    `adopt_existing` is always on: a restarted process must treat the
+    previous life's on-disk bytes as durable (no real power loss can
+    un-write an fsynced byte)."""
+    from consul_tpu.chaos import FaultyStorage
+    kv = {}
+    for part in spec.split(","):
+        if not part:
+            continue
+        k, _, v = part.partition("=")
+        kv[k.strip()] = v.strip()
+    return FaultyStorage(seed=int(kv.get("seed", 0)),
+                         torn=bool(int(kv.get("torn", 1))),
+                         rename_reorder=bool(
+                             int(kv.get("rename_reorder", 0))),
+                         adopt_existing=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--node", required=True)
@@ -39,12 +82,24 @@ def main():
     ap.add_argument("--data-dir", default=None,
                     help="durable raft log/vote/snapshots; restart on "
                          "the same dir recovers every committed write")
+    ap.add_argument("--storage-faults", default=None,
+                    help='arm a chaos.FaultyStorage under the WAL, '
+                         'e.g. "seed=3,torn=1"; SIGUSR1 then injects '
+                         'a power loss (torn un-fsynced tail + hard '
+                         'exit).  Env: CONSUL_TPU_STORAGE_FAULTS')
     args = ap.parse_args()
 
+    from consul_tpu import flight
     from consul_tpu.api.http import ApiServer
     from consul_tpu.consensus.raft import RaftConfig
     from consul_tpu.rpc import TcpTransport
     from consul_tpu.server import Server
+
+    faults_spec = args.storage_faults \
+        or os.environ.get("CONSUL_TPU_STORAGE_FAULTS")
+    storage_io = None
+    if faults_spec and args.data_dir:
+        storage_io = parse_storage_faults(faults_spec)
 
     addresses = parse_peers(args.peers)
     my_rpc = addresses[args.node]
@@ -55,17 +110,51 @@ def main():
     server = Server(args.node, sorted(addresses), transport,
                     registry={}, raft_config=RaftConfig(),
                     seed=zlib.crc32(args.node.encode()) & 0xFFFF,
-                    data_dir=args.data_dir)
+                    data_dir=args.data_dir, storage_io=storage_io)
     server.serve_rpc(host=my_rpc[0], port=my_rpc[1])
     api = ApiServer(server, node_name=args.node, port=args.http_port)
     api.start()
     print(f"server {args.node} rpc={my_rpc} "
           f"http={api.address}", flush=True)
+    flight.emit("agent.started", labels={"node": args.node})
     import threading
     wake = threading.Event()
     server.raft.on_activity = wake.set
+    stop = threading.Event()
+
+    def on_sigterm(signum, frame):
+        # graceful shutdown: flip the flag and let the MAIN loop run
+        # the orderly teardown below — doing real work inside a signal
+        # handler would race the tick it interrupted
+        stop.set()
+        wake.set()
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+
+    power_loss = threading.Event()
+    if storage_io is not None:
+        def on_power_loss(signum, frame):
+            # only flag it: crash() journals through the flight
+            # recorder's non-reentrant lock, and a signal handler
+            # interrupting the main thread MID-emit would self-
+            # deadlock acquiring it — the main loop below runs the
+            # actual power loss from a safe point within one tick
+            power_loss.set()
+            wake.set()
+
+        signal.signal(signal.SIGUSR1, on_power_loss)
+
     try:
-        while True:
+        while not stop.is_set():
+            if power_loss.is_set():
+                # simulated power loss: collapse the page cache to
+                # the durable view (torn tail per the fault model)
+                # and die WITHOUT any shutdown path — os._exit skips
+                # finally blocks the way a yanked cord does
+                try:
+                    storage_io.crash()
+                finally:
+                    os._exit(137)
             server.tick(time.time())
             # event-driven: a client write or inbound raft frame wakes
             # the loop immediately instead of waiting out the sleep;
@@ -75,8 +164,21 @@ def main():
     except KeyboardInterrupt:
         pass
     finally:
+        # orderly teardown (SIGTERM / ^C): stop serving API traffic,
+        # close the RPC plane, then make the WAL durable and release
+        # the data-dir lock — a rolling restart must find a cleanly
+        # closed log (no torn tail, no stale flock)
+        flight.emit("agent.stopped", labels={"node": args.node})
         api.stop()
         server.close_rpc()
+        store = server.raft.store
+        if store is not None:
+            try:
+                store.close()       # close() runs the final sync()
+            except OSError as e:
+                print(f"WAL close failed: {e}", file=sys.stderr,
+                      flush=True)
+        print(f"server {args.node} graceful shutdown", flush=True)
 
 
 if __name__ == "__main__":
