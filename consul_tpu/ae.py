@@ -50,6 +50,11 @@ class StateSyncer:
         self.syncs_full = 0
         self.syncs_partial = 0
         self.failures = 0
+        # last successful sync (wall clock): feeds the consul.ae.lag
+        # gauge — seconds the local state has gone without a confirmed
+        # catalog sync, the anti-entropy half of the visibility SLI
+        # (a watcher can only see what AE pushed)
+        self.last_success = time.time()
 
     # ---------------------------------------------------------------- pacing
 
@@ -90,7 +95,16 @@ class StateSyncer:
         # times in agent/ae (StateSyncer full vs triggered partial)
         telemetry.measure_since(("ae", "sync"), t0,
                                 labels={"type": "full"})
+        self._mark_synced()
         return n
+
+    def _mark_synced(self) -> None:
+        self.last_success = time.time()
+        telemetry.set_gauge(("ae", "lag"), 0.0)
+
+    def lag(self) -> float:
+        """Seconds since the catalog last confirmed a sync."""
+        return max(0.0, time.time() - self.last_success)
 
     # ------------------------------------------------------------------ loop
 
@@ -119,7 +133,13 @@ class StateSyncer:
                     self.syncs_partial += 1
                     telemetry.measure_since(("ae", "sync"), t0,
                                             labels={"type": "partial"})
+                    self._mark_synced()
             except Exception:
                 self.failures += 1
                 telemetry.incr_counter(("ae", "sync_failed"))
+                # the lag gauge grows only while syncs FAIL (success
+                # resets it to 0): a flat-lining catalog shows up as a
+                # climbing consul.ae.lag, the AE leg of the
+                # commit-to-visibility SLI
+                telemetry.set_gauge(("ae", "lag"), self.lag())
                 next_full = min(next_full, now + self.retry_fail_interval)

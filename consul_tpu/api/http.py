@@ -164,6 +164,14 @@ class ApiServer:
         # ui_config.metrics_proxy (reloadable): {base_url,
         # path_allowlist, add_headers} — empty dict = disabled
         self.ui_metrics_proxy: dict = {}
+        # cluster federation (consul_tpu/introspect.py): the HTTP
+        # addresses of every server in this cluster, served back as one
+        # merged view at /v1/internal/ui/cluster-metrics.  None =
+        # endpoint disabled (same stance as the metrics proxy); set
+        # programmatically or via tools/server_proc.py --cluster-http.
+        # A fixed configured set, never caller-supplied URLs — the
+        # agent must not become an open scrape proxy (SSRF).
+        self.cluster_nodes: Optional[list] = None
         self.txn_max_ops = 64
         # guards the per-proxy xDS delta payload caches: handler
         # threads race on insert/evict (ThreadingHTTPServer)
@@ -480,6 +488,12 @@ def _make_handler(srv: ApiServer):
             n = int(self.headers.get("Content-Length") or 0)
             return self.rfile.read(n) if n else b""
 
+        # store index a parked blocking query was woken at — set by
+        # _block, consumed by _send so the response write emits the
+        # apply->flush visibility stage (per-connection handler state,
+        # reset per request)
+        _vis_index = None
+
         def _send(self, obj, code: int = 200, raw: bytes | None = None,
                   index: int | None = None, ctype: str | None = None,
                   extra_headers: dict | None = None):
@@ -495,6 +509,11 @@ def _make_handler(srv: ApiServer):
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
+            vis, self._vis_index = self._vis_index, None
+            if vis is not None and index == vis:
+                # the watcher's response bytes are on the wire: the
+                # end of the commit-to-visibility pipeline
+                store.visibility.stage("flush", vis)
 
         def _err(self, code: int, msg: str):
             self._send(None, code, raw=msg.encode())
@@ -532,10 +551,21 @@ def _make_handler(srv: ApiServer):
                 from consul_tpu import telemetry
                 telemetry.incr_counter(("rpc", "query"))
                 wait = _parse_wait(q.get("wait", "300s"))
+                pre = store.index
                 if watches:
-                    return store.wait_on(watches, int(q["index"]),
-                                         timeout=wait)
-                return store.wait_for(int(q["index"]), timeout=wait)
+                    idx = store.wait_on(watches, int(q["index"]),
+                                        timeout=wait)
+                else:
+                    idx = store.wait_for(int(q["index"]), timeout=wait)
+                if idx > pre:
+                    # a write LANDED while this query was parked (not a
+                    # stale-cursor immediate return, whose apply could
+                    # be arbitrarily old): sample the wakeup stage and
+                    # arm _send to sample the flush — the two ends of
+                    # the watch-delivery half of the visibility SLI
+                    store.visibility.stage("wakeup", idx)
+                    self._vis_index = idx
+                return idx
             return store.index
 
         def _forbid(self) -> bool:
@@ -867,6 +897,10 @@ def _make_handler(srv: ApiServer):
             import time as _time
             t0 = _time.perf_counter()
             wall0 = _time.time()
+            # keep-alive handlers persist across requests: a blocking
+            # query that armed the flush stage but errored before its
+            # _send must not leak the stamp into the next request
+            self._vis_index = None
             # trace: minted here at the API entry point unless the
             # caller (another agent's ?dc= hop, or an instrumented
             # client) already carries a VALID one — the ID then rides
@@ -1186,35 +1220,31 @@ def _make_handler(srv: ApiServer):
                         # failing sim publication is itself a signal
                         telemetry.incr_counter(
                             ("http", "sim_metrics_error"))
+                # per-scrape live values — ONE extras dict feeds both
+                # exposition forms, so the prometheus text serves the
+                # same families as the JSON dump (sanitize-dedupe
+                # applied by Registry.prometheus; the shared registry
+                # is never mutated by a scrape)
+                extras = {"consul.sim.tick": float(oracle.tick),
+                          "consul.catalog.index": float(store.index)}
+                if hasattr(oracle, "members_summary"):
+                    extras.update(
+                        {f"consul.members.{k}": float(v)
+                         for k, v in oracle.members_summary().items()})
                 if q.get("format") == "prometheus":
                     # the reference serves text exposition when
                     # prometheus retention is on (agent_endpoint.go
-                    # AgentMetrics + lib/telemetry.go PrometheusOpts).
-                    # The live gauges append as TEXT — rendering a
-                    # scrape must not mutate the shared registry (or
-                    # later JSON dumps would carry stale duplicates
-                    # and sinks would see scrape side effects)
+                    # AgentMetrics + lib/telemetry.go PrometheusOpts)
                     reg = telemetry.default_registry()
-                    extra = (
-                        "# TYPE consul_sim_tick gauge\n"
-                        f"consul_sim_tick {int(oracle.tick)}\n"
-                        "# TYPE consul_catalog_index gauge\n"
-                        f"consul_catalog_index {store.index}\n")
                     self._send(None,
-                               raw=(reg.prometheus() + extra).encode(),
+                               raw=reg.prometheus(
+                                   extra_gauges=extras).encode(),
                                ctype="text/plain; version=0.0.4; "
                                      "charset=utf-8")
                     return True
                 out = telemetry.default_registry().dump()
-                out["Gauges"] += [
-                    {"Name": "consul.sim.tick", "Value": oracle.tick},
-                    {"Name": "consul.catalog.index", "Value": store.index},
-                ]
-                if hasattr(oracle, "members_summary"):
-                    ms = oracle.members_summary()
-                    out["Gauges"] += [
-                        {"Name": f"consul.members.{k}", "Value": v}
-                        for k, v in ms.items()]
+                out["Gauges"] += [{"Name": n, "Value": v}
+                                  for n, v in sorted(extras.items())]
                 self._send(out)
                 return True
             if path == "/v1/agent/monitor" and verb == "GET":
@@ -1991,6 +2021,29 @@ def _make_handler(srv: ApiServer):
                        if self.authz.service_read(r["Name"])]
                 self._send(self._filtered(q, out), index=idx,
                            extra_headers=self._cache_headers(state))
+                return True
+            if path == "/v1/internal/ui/cluster-metrics" \
+                    and verb == "GET":
+                # the federation view (consul_tpu/introspect.py): every
+                # configured node's /v1/agent/metrics + raft config +
+                # visibility SLIs merged into one leader/lag table —
+                # the metrics-proxy-shaped sibling endpoint serving the
+                # CLUSTER's own telemetry instead of an external
+                # provider's.  Same ACL bar as the metrics proxy
+                # (metric names can leak node/service names).
+                if srv.cluster_nodes is None:
+                    self._err(404, "cluster metrics are not enabled "
+                                   "(no cluster_nodes configured)")
+                    return True
+                if not (self.authz.node_read_all()
+                        and self.authz.service_read_all()):
+                    return self._forbid()
+                from consul_tpu import introspect
+                view = introspect.cluster_view(
+                    srv.cluster_nodes,
+                    events_since=int(q.get("events_since", 0) or 0),
+                    events_limit=int(q.get("events_limit", 50) or 0))
+                self._send(view)
                 return True
             if path.startswith("/v1/internal/ui/metrics-proxy/") \
                     and verb == "GET":

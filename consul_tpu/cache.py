@@ -65,6 +65,17 @@ class Cache:
         refresh-type entry then stays fresh in the background.  `max_age`
         forces a refetch when the entry is older (Cache-Control
         semantics on ?cached requests)."""
+        value, index, hit = self._get(type_name, key, max_age)
+        # consul.cache.{hit,miss}{type}: the ?cached serving ratio
+        # (agent/cache's hit metrics) — emitted here, outside every
+        # entry lock; cardinality bounded by the registered types
+        from consul_tpu import telemetry
+        telemetry.incr_counter(("cache", "hit" if hit else "miss"),
+                               labels={"type": type_name})
+        return value, index, hit
+
+    def _get(self, type_name: str, key: str,
+             max_age: Optional[float] = None) -> Tuple[Any, int, bool]:
         t = self._types[type_name]
         ekey = (type_name, key)
         with self._lock:

@@ -68,6 +68,12 @@ class StateStore:
         # streaming + fine-grained watches (stream/event_publisher.go:12;
         # per-index watch channels state_store.go:102-120)
         self.publisher = EventPublisher()
+        # commit-to-visibility table (consul_tpu/visibility.py):
+        # per-STORE because index spaces are — shared on the publisher
+        # so stream-side consumers (submatview) can reach it
+        from consul_tpu.visibility import VisibilityTable
+        self.visibility = VisibilityTable()
+        self.publisher.visibility = self.visibility
         self._waiters: List[_Waiter] = []
         # parked blocking queries right now (coarse + fine), feeding the
         # consul.rpc.queries_blocking gauge (rpc.go's queriesBlocking).
@@ -151,6 +157,11 @@ class StateStore:
 
     def _apply_bump_effects(self, idx: int,
                             events: Sequence[Tuple[str, str]]) -> None:
+        # commit-to-visibility: stamp (index, apply ts, proposer trace)
+        # the moment this write becomes readable.  Pure table writes
+        # (consul_tpu/visibility.py) — no sink I/O lands under the
+        # store lock; the observing blocking query emits the samples.
+        self.visibility.note_apply(idx)
         for topic, key in events:
             tmap = self._topic_index.get(topic)
             if tmap is None:
@@ -173,8 +184,12 @@ class StateStore:
                 w.fired = True
                 w.cond.notify_all()
         if events:
-            self.publisher.publish([Event(topic=t, key=k, index=idx)
+            tid = (self.visibility.lookup(idx) or {}).get(
+                "trace_id") or ""
+            self.publisher.publish([Event(topic=t, key=k, index=idx,
+                                          trace_id=tid)
                                     for t, k in events])
+            self.visibility.note_publish(idx)
 
     def watch_index(self, watches: Sequence[Tuple[str, str]]) -> int:
         """Highest commit index that touched any of `watches`.

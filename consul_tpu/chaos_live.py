@@ -74,6 +74,9 @@ from consul_tpu.chaos import (
     DurabilityChecker, ElectionSafetyChecker, RegisterHistory,
     check_linearizable,
 )
+# promoted to introspect.py by ISSUE 10; re-exported for the harness
+# and its tests (no behavior change)
+from consul_tpu.introspect import EventCollector  # noqa: F401
 from consul_tpu.wanfed import MeshGatewayForwarder
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -192,13 +195,15 @@ class LiveServer:
 
     def __init__(self, name: str, rpc_port: int, http_port: int,
                  data_dir: str, peers_spec: str,
-                 storage_faults: Optional[str] = None):
+                 storage_faults: Optional[str] = None,
+                 cluster_http: Optional[str] = None):
         self.name = name
         self.rpc_port = rpc_port
         self.http_port = http_port
         self.data_dir = data_dir
         self.peers_spec = peers_spec
         self.storage_faults = storage_faults
+        self.cluster_http = cluster_http
         self.proc: Optional[subprocess.Popen] = None
         self.generation = 0
         self.paused = False
@@ -221,6 +226,8 @@ class LiveServer:
                "--data-dir", self.data_dir]
         if self.storage_faults:
             cmd += ["--storage-faults", self.storage_faults]
+        if self.cluster_http:
+            cmd += ["--cluster-http", self.cluster_http]
         # per-generation log: the post-mortem evidence when a scenario
         # fails (never parsed, only for humans)
         # lint: ok=blocking-call (harness-side log file, not a tick thread)
@@ -323,6 +330,10 @@ class LiveCluster:
             for s in socks:
                 s.close()
         self.servers: List[LiveServer] = []
+        # every member knows the whole fleet's HTTP surface: enables
+        # each node's /v1/internal/ui/cluster-metrics federation view
+        cluster_http = ",".join(
+            f"server{j}=http://127.0.0.1:{http[j]}" for j in range(n))
         for i in range(n):
             parts = []
             for j in range(n):
@@ -334,7 +345,8 @@ class LiveCluster:
             self.servers.append(LiveServer(
                 f"server{i}", rpc[i], http[i],
                 os.path.join(data_root, f"server{i}"), ",".join(parts),
-                storage_faults=storage_faults))
+                storage_faults=storage_faults,
+                cluster_http=cluster_http))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -439,108 +451,11 @@ class LiveCluster:
 
 
 # ---------------------------------------------------------------------------
-# the cluster-wide flight-recorder merge
+# the cluster-wide flight-recorder merge — promoted to
+# consul_tpu/introspect.py (ISSUE 10: the collector is the federation
+# layer's core, not a chaos-only tool); re-exported at the top of this
+# module so every harness/test import path keeps working
 # ---------------------------------------------------------------------------
-
-
-class EventCollector:
-    """Polls every node's /v1/agent/events feed on a cursor, tags rows
-    with (node, generation), survives node deaths and seq resets
-    across restarts, and merges everything — plus the nemesis's own
-    injection journal — into one timeline ordered by wall timestamp."""
-
-    def __init__(self, cluster: LiveCluster, period: float = 0.4):
-        self.cluster = cluster
-        self.period = period
-        self.rows: List[dict] = []
-        self._cursors: Dict[str, int] = {}
-        self._gens: Dict[str, int] = {}
-        self._lock = threading.Lock()
-        self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._loop,
-                                        name="event-collector",
-                                        daemon=True)
-        self._thread.start()
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        self.poll_once()        # final sweep after the cluster settles
-
-    def _loop(self) -> None:
-        while not self._stop.wait(self.period):
-            self.poll_once()
-
-    def poll_once(self) -> None:
-        for s in self.cluster.servers:
-            if not s.alive() or s.paused:
-                continue
-            gen = s.generation
-            if self._gens.get(s.name) != gen:
-                # fresh process ⇒ fresh recorder ⇒ seq restarts at 0
-                self._gens[s.name] = gen
-                self._cursors[s.name] = 0
-            try:
-                events, idx = Client(s.http, timeout=1.5).agent_events(
-                    since=self._cursors.get(s.name, 0))
-            except (ApiError, OSError):
-                continue
-            if not events:
-                continue
-            with self._lock:
-                self._cursors[s.name] = max(
-                    self._cursors.get(s.name, 0), idx)
-                for e in events:
-                    self.rows.append({
-                        "node": s.name, "gen": gen, "seq": e["Seq"],
-                        "ts": e["Ts"], "name": e["Name"],
-                        "severity": e["Severity"],
-                        "labels": e["Labels"]})
-
-    # ------------------------------------------------------------- readers
-
-    def election_wins(self) -> List[Tuple[int, str]]:
-        """(term, node) for every raft.election.won row — the feed for
-        ElectionSafetyChecker.note()."""
-        out = []
-        with self._lock:
-            for r in self.rows:
-                if r["name"] == "raft.election.won":
-                    labels = r["labels"] or {}
-                    try:
-                        out.append((int(labels.get("term")),
-                                    str(labels.get("node"))))
-                    except (TypeError, ValueError):
-                        continue
-        return out
-
-    def count(self, name: str) -> int:
-        with self._lock:
-            return sum(1 for r in self.rows if r["name"] == name)
-
-    def merged_jsonl(self, nemesis_rows: List[dict]) -> str:
-        """One cluster timeline: every node's feed + the nemesis's own
-        injection journal (node='nemesis'), ordered by timestamp."""
-        rows = []
-        with self._lock:
-            rows.extend(self.rows)
-        for r in nemesis_rows:
-            rows.append({"node": "nemesis", "gen": 0, "seq": r["seq"],
-                         "ts": r["ts"], "name": r["name"],
-                         "severity": r["severity"],
-                         "labels": r["labels"]})
-        rows.sort(key=lambda r: (r["ts"], r["node"], r["gen"],
-                                 r["seq"]))
-        return "\n".join(
-            json.dumps({"ts": round(r["ts"], 3), "node": r["node"],
-                        "name": r["name"], "labels": r["labels"]},
-                       sort_keys=True)
-            for r in rows)
 
 
 # ---------------------------------------------------------------------------

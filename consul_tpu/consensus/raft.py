@@ -24,6 +24,7 @@ boltdb log + snapshot store collapse into one object here).
 
 from __future__ import annotations
 
+import bisect
 import heapq
 import random
 import threading
@@ -31,7 +32,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from consul_tpu import telemetry
+from consul_tpu import telemetry, visibility
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -292,6 +293,15 @@ class RaftNode:
         self._chunk_buf: Dict[str, list] = {}   # gid -> b64 parts
         self._lock = threading.RLock()
         self._pending: Dict[int, _Pending] = {}   # log index -> waiter
+        # proposer trace ids by log index (LOCAL only — never
+        # replicated; trace.py's byte-identical-payload rule).  The
+        # apply loop pops them to scope visibility.applying() around
+        # the FSM apply so store bumps correlate to the writer's trace.
+        self._trace_ids: Dict[int, str] = {}
+        # (log index, wall ts) of leader-side appends: the feed for the
+        # per-peer replication-lag-in-ms gauge — the age of the oldest
+        # entry a follower has not acked.  Pruned below min(match).
+        self._append_ts: List[Tuple[int, float]] = []
         # telemetry staging: helpers that run under self._lock append
         # (kind, name, value) here and tick()/apply_many() flush AFTER
         # releasing it — sink emission (UDP sendto per configured sink)
@@ -437,9 +447,11 @@ class RaftNode:
             buf, self._metrics_buf = self._metrics_buf, []
         for kind, name, value, *rest in buf:
             if kind == "c":
-                telemetry.incr_counter(name, value)
+                telemetry.incr_counter(name, value,
+                                       labels=rest[0] if rest else None)
             elif kind == "g":
-                telemetry.set_gauge(name, value)
+                telemetry.set_gauge(name, value,
+                                    labels=rest[0] if rest else None)
             elif kind == "e":
                 # staged flight event: (kind, name, labels, ts) — ts is
                 # the raft clock at the transition (virtual under the
@@ -451,7 +463,8 @@ class RaftNode:
                 flight.emit(name, labels=value, ts=rest[0],
                             trace_id="")
             else:
-                telemetry.add_sample(name, value)
+                telemetry.add_sample(name, value,
+                                     labels=rest[0] if rest else None)
 
     def add_leader_observer(self, fn: Callable[[bool], None]) -> None:
         """Mirror of raft's LeaderCh feeding monitorLeadership
@@ -502,13 +515,23 @@ class RaftNode:
         appliers batch into the single per-tick append."""
         return self.apply_many([cmd], noop=noop)[0]
 
-    def apply_many(self, cmds: list, noop: bool = False) -> list:
+    def apply_many(self, cmds: list, noop: bool = False,
+                   trace_ids: Optional[list] = None) -> list:
         """Group commit: append a whole batch of commands under ONE
         lock acquisition, one broadcast flag, and (durably) the shared
         per-tick fsync — returning a waiter per command.  This is the
         leader half of quorum-write batching: a forwarding follower
         coalesces its concurrent applies into one apply_batch RPC
-        (server.py), and the batch lands here as one raft round."""
+        (server.py), and the batch lands here as one raft round.
+
+        `trace_ids` (one per command, or None) correlates each apply
+        with its proposing request for commit-to-visibility tracing;
+        defaults to the calling thread's current trace (the in-process
+        propose path runs on the request thread).  The ids stay LOCAL —
+        they ride `_trace_ids`, never the replicated payload."""
+        if trace_ids is None:
+            from consul_tpu import trace as _trace
+            trace_ids = [_trace.current_trace()] * len(cmds)
         batches = [self._expand_entries(c, noop) for c in cmds]
         pends = []
         with self._lock:
@@ -521,7 +544,9 @@ class RaftNode:
                 # real leader doesn't double-count the write
                 self._metrics_buf.append(
                     ("c", ("raft", "apply"), float(len(cmds))))
-            for entries in batches:
+            append_wall = self._now if self._now is not None \
+                else _time.time()
+            for bi, entries in enumerate(batches):
                 for e_cmd in entries:
                     ent = _Entry(self.current_term, e_cmd, noop)
                     self.log.append(ent)
@@ -530,11 +555,20 @@ class RaftNode:
                     # decision (_advance_commit) — one group-commit
                     # fsync per tick covers every write batched into it
                     self._persist_entry(idx, ent)
+                    self._append_ts.append((idx, append_wall))
+                    if len(self._append_ts) > 4096:
+                        # a permanently-dead peer must not grow the
+                        # ring with write volume; the lag head then
+                        # clamps to the oldest retained stamp
+                        del self._append_ts[:2048]
                 # the waiter resolves when the FINAL chunk (or the
                 # single entry) applies
                 pend = _Pending()
                 self._pending[idx] = pend
                 pends.append(pend)
+                tid = trace_ids[bi] if bi < len(trace_ids) else None
+                if tid and not noop:
+                    self._trace_ids[idx] = tid
             self.match_index[self.node_id] = self.last_log_index
             self._needs_bcast = True
         self._flush_metrics()
@@ -603,6 +637,7 @@ class RaftNode:
             pend.error = err
             pend.event.set()
         self._pending.clear()
+        self._trace_ids.clear()
 
     def _start_election(self, now: float) -> None:
         """Election timeout fired.  Phase 1 is Pre-Vote (Raft thesis §9.6,
@@ -663,6 +698,14 @@ class RaftNode:
             barrier = _Entry(self.current_term, None, True)
             self.log.append(barrier)
             self._persist_entry(self.last_log_index, barrier)
+            # fresh leadership = fresh lag stamps: a previous reign's
+            # ring may hold indexes that were truncated while we were
+            # a follower — appending this term's entries after them
+            # would leave the ring unsorted with duplicate indexes and
+            # make the bisect in _stage_replication_lag resolve a
+            # caught-up peer to a stale pre-deposition timestamp
+            self._append_ts.clear()
+            self._append_ts.append((self.last_log_index, now))
             self.match_index[self.node_id] = self.last_log_index
             self._heartbeat_due = now
             self._broadcast_append(now)
@@ -684,8 +727,55 @@ class RaftNode:
                 self._metrics_buf.append(
                     ("g", ("raft", "leader", "lastContact"),
                      round(age_ms, 3)))
+        self._stage_replication_lag(now)
         for p in self.peers:
             self._send_append(p)
+
+    def _stage_replication_lag(self, now: float) -> None:
+        """Per-peer follower lag at heartbeat cadence, leader-side —
+        the reference exposes none of this; the streaming-reads
+        redesign (ROADMAP item 2) needs it as an SLI.  Two gauges per
+        peer, staged through _metrics_buf like every raft metric:
+
+          consul.raft.replication.lag{peer}     entries the follower
+                                                has not acked
+          consul.raft.replication.lag_ms{peer}  age of the OLDEST
+                                                unacked entry (0 when
+                                                caught up)
+
+        Label cardinality is bounded by the peer set.  `_append_ts` is
+        pruned below min(match) here — entries every follower acked can
+        never be a lag head again."""
+        if not self.peers:
+            return
+        matches = [self.match_index.get(p, 0) for p in self.peers]
+        floor = min(matches)
+        ts = self._append_ts
+        drop = 0
+        while drop < len(ts) and ts[drop][0] <= floor:
+            drop += 1
+        if drop:
+            del ts[:drop]
+        head = self.last_log_index
+        for p, m in zip(self.peers, matches):
+            lag = max(0, head - m)
+            self._metrics_buf.append(
+                ("g", ("raft", "replication", "lag"), float(lag),
+                 {"peer": p}))
+            if lag == 0:
+                lag_ms = 0.0
+            else:
+                # oldest unacked entry's age (ts is idx-sorted, so
+                # bisect, not a scan — this runs every heartbeat); an
+                # entry older than the ring reaches back is at least
+                # as old as the ring head
+                pos = bisect.bisect_right(ts, m, key=lambda e: e[0])
+                oldest = ts[pos][1] if pos < len(ts) \
+                    else (ts[0][1] if ts else now)
+                lag_ms = max(0.0, (now - oldest) * 1000.0)
+            self._metrics_buf.append(
+                ("g", ("raft", "replication", "lag_ms"),
+                 round(lag_ms, 3), {"peer": p}))
 
     def _send_append(self, peer: str) -> None:
         nxt = self.next_index.get(peer, self.last_log_index + 1)
@@ -900,8 +990,14 @@ class RaftNode:
             result = None
             if not ent.noop:
                 t0 = _time.perf_counter()
+                # commit-to-visibility: the proposer's trace (local
+                # propose-time stamp; absent on followers and after a
+                # restart) scopes the FSM apply so every store index
+                # this command bumps correlates to the writing request
+                tid = self._trace_ids.pop(self.last_applied, None)
                 if isinstance(ent.cmd, dict) and "__chunk__" in ent.cmd:
-                    result = self._apply_chunk(ent.cmd["__chunk__"])
+                    with visibility.applying(tid):
+                        result = self._apply_chunk(ent.cmd["__chunk__"])
                 elif isinstance(ent.cmd, dict) \
                         and "__raft_remove_peer__" in ent.cmd:
                     # replicated membership change (simplified joint
@@ -910,7 +1006,8 @@ class RaftNode:
                     result = self._apply_remove_peer(
                         ent.cmd["__raft_remove_peer__"])
                 else:
-                    result = self.apply_fn(ent.cmd)
+                    with visibility.applying(tid):
+                        result = self.apply_fn(ent.cmd)
                 self._metrics_buf.append(
                     ("s", ("raft", "fsm", "apply"),
                      _time.perf_counter() - t0))

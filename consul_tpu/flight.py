@@ -114,6 +114,17 @@ CATALOG: Dict[str, dict] = {
     # runtime (the tick profiler's recompile watchdog)
     "runtime.recompile": {"severity": "warn",
                           "labels": ("fn", "cache_size")},
+    # commit-to-visibility pipeline (consul_tpu/visibility.py): a
+    # watch-delivery stage lagging its raft apply past the stall budget
+    "kv.visibility.stall": {"severity": "warn",
+                            "labels": ("stage", "index", "ms")},
+    # stream plane (stream/publisher.py): a subscriber draining a queue
+    # that backed up past the slow threshold, and a follower that fell
+    # off the topic buffer tail (forced re-snapshot)
+    "stream.subscriber.slow": {"severity": "warn",
+                               "labels": ("topic", "depth")},
+    "stream.subscriber.reset": {"severity": "warn",
+                                "labels": ("topic", "key")},
 }
 
 

@@ -1,0 +1,356 @@
+"""Cluster introspection: scrape + merge every node's observability
+surfaces into ONE view.
+
+PR 1 gave each process `/v1/agent/metrics`, PR 8 `/v1/agent/events` +
+`/v1/agent/profile` — but an operator (and the chaos harness, and the
+visibility prober) needs the CLUSTER's story: who leads, how far each
+follower lags, what the commit-to-visibility SLIs look like, and one
+merged timeline across nodes that survives restarts.  The reference
+builds the same cross-node view for its UI behind
+`/v1/internal/ui/metrics-proxy` and the streaming-reads telemetry
+(PAPER.md: contributing/rpc/streaming/); here the pieces are:
+
+  * `EventCollector` — promoted from PR 9's `chaos_live.py` (the chaos
+    harness re-exports it; no behavior change): polls every node's
+    `/v1/agent/events` feed on a cursor, tags rows (node, generation),
+    survives deaths and seq resets across restarts, merges everything
+    into one timestamp-ordered timeline.
+  * `scrape_node` / `cluster_view` — one-shot scrapes of
+    `/v1/agent/{self,metrics,events,profile}` +
+    `/v1/operator/raft/configuration` per node, merged into a
+    leader/lag table with per-stage visibility quantiles.  Served by
+    `/v1/internal/ui/cluster-metrics` (api/http.py) and rendered by
+    `tools/cluster_top.py`; `tools/debug_bundle.py --cluster` archives
+    the raw per-node scrapes next to the merged timeline.
+  * `StaticCluster` — adapts a plain URL list to the duck type
+    `EventCollector` polls (the chaos harness hands it a LiveCluster
+    whose servers restart; static fleets are generation 1 forever).
+
+Everything is best-effort per node: a dead node contributes
+`alive: false`, never an exception — the whole point is reading a
+cluster mid-incident.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional, Tuple, Union
+
+from consul_tpu.api.client import ApiError, Client
+
+SCRAPE_TIMEOUT = 2.5
+
+
+class StaticNode:
+    """URL-only member with the LiveServer surface EventCollector and
+    the scrapers poll (alive is assumed; a refused scrape reports it)."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.http = url.rstrip("/")
+        self.generation = 1
+        self.paused = False
+
+    def alive(self) -> bool:
+        return True
+
+
+class StaticCluster:
+    """A fixed fleet of StaticNodes from URLs or a name->url map."""
+
+    def __init__(self, nodes: Union[List[str], Dict[str, str]]):
+        if isinstance(nodes, dict):
+            self.servers = [StaticNode(n, u)
+                            for n, u in sorted(nodes.items())]
+        else:
+            self.servers = [StaticNode(f"node{i}", u)
+                            for i, u in enumerate(nodes)]
+
+
+class EventCollector:
+    """Polls every node's /v1/agent/events feed on a cursor, tags rows
+    with (node, generation), survives node deaths and seq resets
+    across restarts, and merges everything — plus the nemesis's own
+    injection journal — into one timeline ordered by wall timestamp."""
+
+    def __init__(self, cluster, period: float = 0.4):
+        self.cluster = cluster
+        self.period = period
+        self.rows: List[dict] = []
+        self._cursors: Dict[str, int] = {}
+        self._gens: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop,
+                                        name="event-collector",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.poll_once()        # final sweep after the cluster settles
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period):
+            self.poll_once()
+
+    def poll_once(self) -> None:
+        for s in self.cluster.servers:
+            if not s.alive() or s.paused:
+                continue
+            gen = s.generation
+            if self._gens.get(s.name) != gen:
+                # fresh process ⇒ fresh recorder ⇒ seq restarts at 0
+                self._gens[s.name] = gen
+                self._cursors[s.name] = 0
+            try:
+                events, idx = Client(
+                    s.http, timeout=1.5).agent_events(
+                    since=self._cursors.get(s.name, 0))
+            except (ApiError, OSError):
+                continue
+            if not events:
+                continue
+            with self._lock:
+                self._cursors[s.name] = max(
+                    self._cursors.get(s.name, 0), idx)
+                for e in events:
+                    self.rows.append({
+                        "node": s.name, "gen": gen, "seq": e["Seq"],
+                        "ts": e["Ts"], "name": e["Name"],
+                        "severity": e["Severity"],
+                        "labels": e["Labels"]})
+
+    # ------------------------------------------------------------- readers
+
+    def election_wins(self) -> List[Tuple[int, str]]:
+        """(term, node) for every raft.election.won row — the feed for
+        ElectionSafetyChecker.note()."""
+        out = []
+        with self._lock:
+            for r in self.rows:
+                if r["name"] == "raft.election.won":
+                    labels = r["labels"] or {}
+                    try:
+                        out.append((int(labels.get("term")),
+                                    str(labels.get("node"))))
+                    except (TypeError, ValueError):
+                        continue
+        return out
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return sum(1 for r in self.rows if r["name"] == name)
+
+    def merged_jsonl(self, nemesis_rows: List[dict]) -> str:
+        """One cluster timeline: every node's feed + the nemesis's own
+        injection journal (node='nemesis'), ordered by timestamp."""
+        rows = []
+        with self._lock:
+            rows.extend(self.rows)
+        for r in nemesis_rows:
+            rows.append({"node": "nemesis", "gen": 0, "seq": r["seq"],
+                         "ts": r["ts"], "name": r["name"],
+                         "severity": r["severity"],
+                         "labels": r["labels"]})
+        return "\n".join(
+            json.dumps({"ts": round(r["ts"], 3), "node": r["node"],
+                        "name": r["name"], "labels": r["labels"]},
+                       sort_keys=True)
+            for r in merge_timelines(rows))
+
+
+def merge_timelines(rows: List[dict]) -> List[dict]:
+    """Order cross-node event rows into one timeline: wall timestamp
+    first, then (node, generation, seq) so a restarted node's reset
+    seq space cannot interleave backwards within one instant."""
+    return sorted(rows, key=lambda r: (r["ts"], r["node"],
+                                       r.get("gen", 0), r["seq"]))
+
+
+# ---------------------------------------------------------------------------
+# one-shot scraping: the /v1/internal/ui/cluster-metrics backing
+# ---------------------------------------------------------------------------
+
+
+def _metric_maps(dump: dict) -> Tuple[dict, dict]:
+    """(gauges, samples) keyed by (name, sorted-label-tuple)."""
+    gauges = {}
+    for g in (dump or {}).get("Gauges", []):
+        lk = tuple(sorted((g.get("Labels") or {}).items()))
+        gauges[(g["Name"], lk)] = g["Value"]
+    samples = {}
+    for s in (dump or {}).get("Samples", []):
+        lk = tuple(sorted((s.get("Labels") or {}).items()))
+        samples[(s["Name"], lk)] = s
+    return gauges, samples
+
+
+def visibility_stages(dump: dict) -> Dict[str, dict]:
+    """{stage: {p50_ms, p99_ms, count}} from a node's metrics dump —
+    the consul.kv.visibility summary, per stage label."""
+    _, samples = _metric_maps(dump)
+    out = {}
+    for (name, lk), s in samples.items():
+        if name != "consul.kv.visibility":
+            continue
+        stage = dict(lk).get("stage")
+        if stage:
+            out[stage] = {
+                "p50_ms": round(s.get("P50", 0.0) * 1000.0, 3),
+                "p99_ms": round(s.get("P99", 0.0) * 1000.0, 3),
+                "count": s.get("Count", 0)}
+    return out
+
+
+def replication_lag(dump: dict) -> Dict[str, dict]:
+    """{peer: {entries, ms}} from a leader's metrics dump."""
+    gauges, _ = _metric_maps(dump)
+    out: Dict[str, dict] = {}
+    for (name, lk), v in gauges.items():
+        peer = dict(lk).get("peer")
+        if peer is None:
+            continue
+        if name == "consul.raft.replication.lag":
+            out.setdefault(peer, {})["entries"] = v
+        elif name == "consul.raft.replication.lag_ms":
+            out.setdefault(peer, {})["ms"] = v
+    return out
+
+
+def scrape_node(url: str, events_since: int = 0,
+                events_limit: int = 50,
+                timeout: float = SCRAPE_TIMEOUT) -> dict:
+    """Best-effort scrape of one node's observability surfaces.
+    Always returns a row; `alive` says whether anything answered."""
+    c = Client(url, timeout=timeout)
+    row: dict = {"url": url.rstrip("/"), "alive": False,
+                 "name": None, "metrics": None, "profile": None,
+                 "events": [], "events_cursor": events_since,
+                 "raft": None, "error": None}
+    try:
+        row["name"] = (c.agent_self() or {}).get(
+            "Config", {}).get("NodeName")
+        row["alive"] = True
+    except (ApiError, OSError) as e:
+        row["error"] = str(e)
+        return row
+    for field, fetch in (
+            ("metrics", lambda: c._call(
+                "GET", "/v1/agent/metrics")[0]),
+            ("profile", lambda: c.agent_profile()),
+            ("raft", lambda: c._call(
+                "GET", "/v1/operator/raft/configuration")[0])):
+        try:
+            row[field] = fetch()
+        except (ApiError, OSError):
+            pass                      # partial scrapes still merge
+    try:
+        events, cursor = c.agent_events(since=events_since,
+                                        limit=events_limit)
+        row["events"] = events
+        row["events_cursor"] = cursor
+    except (ApiError, OSError):
+        pass
+    return row
+
+
+def _self_leader(raft_cfg: Optional[dict],
+                 name: Optional[str]) -> bool:
+    """Does this node's OWN raft configuration mark itself leader —
+    the self-claim election safety audits (chaos_live.leader())."""
+    for srv in (raft_cfg or {}).get("Servers", []):
+        if srv.get("Leader") and srv.get("ID") == name:
+            return True
+    return False
+
+
+def scrape_cluster(nodes: Union[List[str], Dict[str, str]],
+                   events_since: int = 0,
+                   events_limit: int = 50) -> List[Tuple[str, dict]]:
+    """One scrape pass over the fleet -> [(unique name, row)].  Names
+    prefer the caller's label, then the node's self-reported NodeName,
+    then the URL — deduplicated so two nodes claiming one name (a
+    misconfigured fleet, or a URL listed twice) cannot silently
+    collapse into a single entry."""
+    if isinstance(nodes, dict):
+        items = sorted(nodes.items())
+    else:
+        items = [(None, u) for u in nodes]
+    rows: List[Tuple[str, dict]] = []
+    seen: Dict[str, int] = {}
+    for label, url in items:
+        row = scrape_node(url, events_since=events_since,
+                          events_limit=events_limit)
+        name = label or row["name"] or row["url"]
+        if name in seen:
+            seen[name] += 1
+            name = f"{name}#{seen[name]}"
+        else:
+            seen[name] = 1
+        rows.append((name, row))
+    return rows
+
+
+def cluster_view(nodes: Union[List[str], Dict[str, str]],
+                 events_since: int = 0,
+                 events_limit: int = 50) -> dict:
+    """Scrape every node and merge — see view_from_scrapes."""
+    return view_from_scrapes(scrape_cluster(
+        nodes, events_since=events_since, events_limit=events_limit))
+
+
+def view_from_scrapes(rows: List[Tuple[str, dict]]) -> dict:
+    """Merge pre-fetched scrape rows: leader + per-node index table,
+    the leader's per-peer replication lag, per-stage visibility
+    quantiles, and a generation-unaware merged event tail (one-shot
+    scrapes have no restart history; the long-lived EventCollector is
+    the generation-aware feed).  Split from cluster_view so callers
+    that also archive the raw rows (debug_bundle --cluster) scrape the
+    fleet ONCE — mid-incident, every dead node costs a scrape timeout."""
+    view: dict = {"nodes": {}, "leader": None,
+                  "replication_lag": {}, "visibility": {},
+                  "events": []}
+    all_events = []
+    for name, row in rows:
+        gauges, _ = _metric_maps(row["metrics"])
+        node_view = {
+            "url": row["url"], "alive": row["alive"],
+            "leader": _self_leader(row["raft"], row["name"]),
+            "index": gauges.get(("consul.catalog.index", ())),
+            "tick": gauges.get(("consul.sim.tick", ())),
+            "blocking_queries": gauges.get(
+                ("consul.rpc.queries_blocking", ())),
+            "visibility": visibility_stages(row["metrics"]),
+            "events_cursor": row["events_cursor"],
+        }
+        if row["error"]:
+            node_view["error"] = row["error"]
+        view["nodes"][name] = node_view
+        if node_view["leader"]:
+            view["leader"] = name
+            view["replication_lag"] = replication_lag(row["metrics"])
+            view["visibility"] = node_view["visibility"]
+        for e in row["events"]:
+            all_events.append({"node": name, "gen": 1, "seq": e["Seq"],
+                               "ts": e["Ts"], "name": e["Name"],
+                               "severity": e["Severity"],
+                               "labels": e["Labels"]})
+    view["events"] = merge_timelines(all_events)
+    if view["leader"] is None and view["nodes"]:
+        # no self-claimed leader scraped: still surface SOME visibility
+        # table (max-count node) so the view degrades, not blanks
+        best = max(view["nodes"].values(),
+                   key=lambda n: sum(s.get("count", 0)
+                                     for s in n["visibility"].values()))
+        view["visibility"] = best["visibility"]
+    view["generated_at"] = round(time.time(), 3)
+    return view

@@ -394,8 +394,10 @@ class Server:
                          _apply_wait_budget(args) - (time.time() - t_in))
             with trace.span("leader.apply", trace_id=args.get("trace"),
                             op=args.get("op"), node=self.node_id):
-                pend = self.raft.apply({"op": args["op"],
-                                        "args": args.get("args") or {}})
+                pend = self.raft.apply_many(
+                    [{"op": args["op"],
+                      "args": args.get("args") or {}}],
+                    trace_ids=[args.get("trace")])[0]
                 if not pend.event.wait(wait_s):
                     raise TimeoutError("apply timed out")
             if pend.error is not None:
@@ -413,7 +415,8 @@ class Server:
             t_wall, t0 = time.time(), time.perf_counter()
             pends = self.raft.apply_many(
                 [{"op": it["op"], "args": it.get("args") or {}}
-                 for it in args["items"]])
+                 for it in args["items"]],
+                trace_ids=[it.get("trace") for it in args["items"]])
             # group-commit wait bounded by the batch's shipped RPC
             # budget (= the longest remaining caller deadline) MINUS
             # whatever the election hold consumed, floored like the
@@ -700,6 +703,7 @@ class Server:
                             op, args,
                             timeout=max(0.05, deadline - time.time()))
                         if out is not None:
+                            self._bind_visibility(out)
                             return out
                         # a None result means the remote apply raced a
                         # deposition — retry within the deadline rather
@@ -720,10 +724,23 @@ class Server:
                 if pend.error is not None:
                     last_err = pend.error
                     continue
+                self._bind_visibility(pend.result)
                 return pend.result
             last_err = TimeoutError(f"raft apply {op} timed out")
             break
         raise NoLeaderError(str(last_err))
+
+    def _bind_visibility(self, result) -> None:
+        """Proposer-side commit-to-visibility correlation: the apply
+        result carried the store index this write landed at — bind the
+        request's trace id to it (late upsert; the FSM-side
+        `visibility.applying` scope already stamped it on the node
+        that ran the apply, this covers the FORWARDING node's own
+        replica, whose apply arrives by replication without a trace)."""
+        if isinstance(result, dict) and "index" in result:
+            from consul_tpu import trace
+            self.store.visibility.bind_trace(result["index"],
+                                             trace.current_trace())
 
     def consistent_index(self, timeout: float = 5.0) -> int:
         """Leader barrier — readers wanting ?consistent semantics call this

@@ -43,13 +43,19 @@ TOPIC_CA = "ca"                        # connect CA roots/leaf rotation
 
 @dataclass(frozen=True)
 class Event:
-    """One state-change event (stream/event_publisher.go Event shape)."""
+    """One state-change event (stream/event_publisher.go Event shape).
+
+    `trace_id` is the PROPOSING request's trace (commit-to-visibility
+    correlation, consul_tpu/visibility.py) — observability metadata,
+    empty for replicated/untraced writes; never part of equality-
+    relevant state."""
 
     topic: str
     key: str
     index: int
     payload: Any = None
     op: str = "update"          # update | delete | snapshot-end
+    trace_id: str = field(default="", compare=False)
 
 
 class SnapshotRequired(Exception):
@@ -57,6 +63,13 @@ class SnapshotRequired(Exception):
 
     Mirrors the reference's NewSnapshotToFollow reset frame
     (stream/subscription.go forceClose on buffer eviction)."""
+
+
+# a subscriber queue backing up past this many undrained batches is
+# SLOW: flagged during publish, journaled (stream.subscriber.slow)
+# when its consumer finally drains — the per-subscriber tripwire for
+# ROADMAP item 2's 1M-watcher fan-out
+SLOW_QUEUE_DEPTH = 128
 
 
 @dataclass
@@ -67,6 +80,7 @@ class _Sub:
     cond: threading.Condition
     closed: bool = False
     queue: deque = field(default_factory=deque)
+    slow_depth: int = 0                # max depth seen while backed up
 
 
 class Subscription:
@@ -87,9 +101,27 @@ class Subscription:
             if s.closed:
                 raise SnapshotRequired("subscription reset")
             out: List[Event] = []
+            depth = len(s.queue)
             while s.queue:
                 out.extend(s.queue.popleft())
-            return out
+            slow_depth, s.slow_depth = s.slow_depth, 0
+        # telemetry on the CONSUMER's thread, after releasing the sub
+        # condition (publish() runs under the store lock and stages
+        # only; this drain is where the stream plane may emit)
+        if out:
+            from consul_tpu import telemetry
+            telemetry.add_sample(("stream", "queue_depth"),
+                                 float(depth),
+                                 labels={"topic": s.topic})
+            telemetry.incr_counter(("stream", "delivered"),
+                                   float(len(out)),
+                                   labels={"topic": s.topic})
+        if slow_depth:
+            from consul_tpu import flight
+            flight.emit("stream.subscriber.slow",
+                        labels={"topic": s.topic, "depth": slow_depth})
+        self._pub._flush_stats()
+        return out
 
     def close(self) -> None:
         self._pub.unsubscribe(self)
@@ -108,6 +140,11 @@ class EventPublisher:
     commit index; delivery to subscriber queues is synchronous (queues are
     unbounded, consumers drain them on their own threads)."""
 
+    # the owning store's VisibilityTable (set by StateStore.__init__);
+    # stream-side consumers (submatview) reach the commit-to-visibility
+    # correlation through it
+    visibility = None
+
     def __init__(self, buffer_len: int = 1024):
         self._lock = threading.Lock()
         self._buffer_len = buffer_len
@@ -119,6 +156,12 @@ class EventPublisher:
         # cross-topic index gaps as eviction
         self._evicted_through: Dict[str, int] = {}
         self._subs: List[_Sub] = []
+        # gauges staged during publish (which runs under the STORE
+        # lock) and flushed by drain/subscribe sites on their own
+        # threads: topic -> last fan-out width; eviction counts
+        self._stats_lock = threading.Lock()
+        self._fanout_stats: Dict[str, int] = {}
+        self._evict_stats: Dict[str, int] = {}
 
     # ----------------------------------------------------------- publishing
 
@@ -128,22 +171,53 @@ class EventPublisher:
         by_topic: Dict[str, List[Event]] = {}
         for e in events:
             by_topic.setdefault(e.topic, []).append(e)
+        evicted = []
         with self._lock:
             for topic, evs in by_topic.items():
                 buf = self._buffers.setdefault(
                     topic, deque(maxlen=self._buffer_len))
                 if len(buf) == self._buffer_len:
                     self._evicted_through[topic] = buf[0][0]
+                    evicted.append(topic)
                 buf.append((evs[0].index, evs))
             subs = list(self._subs)
+        fanout: Dict[str, int] = {t: 0 for t in by_topic}
         for s in subs:
             mine = [e for e in by_topic.get(s.topic, ())
                     if s.key is None or e.key == s.key]
             if not mine:
                 continue
+            fanout[s.topic] += 1
             with s.cond:
                 s.queue.append(mine)
+                depth = len(s.queue)
+                if depth > SLOW_QUEUE_DEPTH and depth > s.slow_depth:
+                    # flag only — the consumer journals the slow event
+                    # when it drains; publish may run under the store
+                    # lock and must not emit
+                    s.slow_depth = depth
                 s.cond.notify_all()
+        with self._stats_lock:
+            self._fanout_stats.update(fanout)
+            for t in evicted:
+                self._evict_stats[t] = self._evict_stats.get(t, 0) + 1
+
+    def _flush_stats(self) -> None:
+        """Emit staged per-topic gauges/counters — called from
+        consumer-side paths (drain, subscribe) that hold no store or
+        publisher lock."""
+        with self._stats_lock:
+            fanout, self._fanout_stats = self._fanout_stats, {}
+            evicts, self._evict_stats = self._evict_stats, {}
+        if not fanout and not evicts:
+            return
+        from consul_tpu import telemetry
+        for topic, n in fanout.items():
+            telemetry.set_gauge(("stream", "fanout"), float(n),
+                                labels={"topic": topic})
+        for topic, n in evicts.items():
+            telemetry.incr_counter(("stream", "evicted"), float(n),
+                                   labels={"topic": topic})
 
     # --------------------------------------------------------- subscription
 
@@ -159,28 +233,58 @@ class EventPublisher:
         subscribing (submatview materializers)."""
         sub = _Sub(topic=topic, key=key, next_index=since_index or 0,
                    cond=threading.Condition())
-        with self._lock:
-            buf = self._buffers.get(topic, ())
-            if since_index is None:
+        n = None
+        try:
+            with self._lock:
+                buf = self._buffers.get(topic, ())
+                if since_index is None:
+                    self._subs.append(sub)
+                    n = sum(1 for s in self._subs if s.topic == topic)
+                    return Subscription(self, sub)
+                evicted = self._evicted_through.get(topic, 0)
+                if since_index < evicted:
+                    n = None
+                    raise SnapshotRequired(
+                        f"events through {evicted} evicted, "
+                        f"need {since_index}")
+                replay = [[e for e in evs if key is None or e.key == key]
+                          for idx, evs in buf if idx > since_index]
+                replay = [b for b in replay if b]
+                for b in replay:
+                    sub.queue.append(b)
                 self._subs.append(sub)
-                return Subscription(self, sub)
-            evicted = self._evicted_through.get(topic, 0)
-            if since_index < evicted:
-                raise SnapshotRequired(
-                    f"events through {evicted} evicted, need {since_index}")
-            replay = [[e for e in evs if key is None or e.key == key]
-                      for idx, evs in buf if idx > since_index]
-            replay = [b for b in replay if b]
-            for b in replay:
-                sub.queue.append(b)
-            self._subs.append(sub)
-        return Subscription(self, sub)
+                n = sum(1 for s in self._subs if s.topic == topic)
+            return Subscription(self, sub)
+        except SnapshotRequired:
+            # the follower fell off the buffer tail: journal the
+            # forced re-snapshot (the reset IS the stall signal a slow
+            # materializer leaves behind) — off the publisher lock
+            from consul_tpu import flight
+            flight.emit("stream.subscriber.reset",
+                        labels={"topic": topic, "key": key or ""})
+            raise
+        finally:
+            # subscribe runs on watcher/materializer threads (never
+            # under the store lock); emit AFTER releasing the publisher
+            # lock so publish() — which takes it under the store lock —
+            # cannot queue behind sink I/O
+            if n is not None:
+                self._subscribers_gauge(topic, n)
+                self._flush_stats()
+
+    @staticmethod
+    def _subscribers_gauge(topic: str, n: int) -> None:
+        from consul_tpu import telemetry
+        telemetry.set_gauge(("stream", "subscribers"), float(n),
+                            labels={"topic": topic})
 
     def unsubscribe(self, subscription: Subscription) -> None:
         s = subscription._sub
         with self._lock:
             if s in self._subs:
                 self._subs.remove(s)
+            n = sum(1 for x in self._subs if x.topic == s.topic)
+        self._subscribers_gauge(s.topic, n)
         with s.cond:
             s.closed = True
             s.cond.notify_all()

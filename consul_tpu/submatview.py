@@ -72,6 +72,9 @@ class Materializer:
             self._cond.notify_all()
 
     def _follow(self) -> None:
+        import time as _time
+
+        from consul_tpu import telemetry
         while self._running:
             try:
                 events = self._sub.events(timeout=1.0)
@@ -79,12 +82,26 @@ class Materializer:
                 if not self._running:
                     return
                 self.resets += 1
+                telemetry.incr_counter(("stream", "view_resets"),
+                                       labels={"topic": self.topic})
                 self._materialize()
                 continue
             if not events:
                 continue
             top = max(e.index for e in events)
+            t0 = _time.perf_counter()
             value, index = self.snapshot_fn()
+            # consul.stream.materialize: re-materialization cost per
+            # relevant event batch — the per-wakeup work the streaming
+            # read path saves the query layer (materializer.go role)
+            telemetry.measure_since(("stream", "materialize"), t0,
+                                    labels={"topic": self.topic})
+            # view freshness is a wakeup in the commit-to-visibility
+            # pipeline: the materialized state now reflects `top`
+            # (the publisher shares its store's table)
+            vt = getattr(self.publisher, "visibility", None)
+            if vt is not None:
+                vt.stage("wakeup", top)
             with self._cond:
                 self._value = value
                 self._index = max(index, top, self._index)

@@ -340,7 +340,8 @@ class Registry:
             for k, v in pairs)
         return "{" + body + "}"
 
-    def prometheus(self) -> str:
+    def prometheus(self, extra_gauges: Optional[Dict[str, float]] = None
+                   ) -> str:
         """Prometheus text exposition (the PrometheusOpts role,
         lib/telemetry.go:200; served at /v1/agent/metrics
         ?format=prometheus like the reference's agent_endpoint.go
@@ -349,12 +350,20 @@ class Registry:
         Names sanitize '.'/'-' to '_' with deterministic collision
         suffixes (one `# TYPE` block per exposition name); labels render
         as {k="v"}; samples expose the full summary shape —
-        _sum/_count plus quantile series and min/max gauges."""
+        _sum/_count plus quantile series and min/max gauges.
+
+        `extra_gauges` ({full raw name: value}) are live values the
+        endpoint computes per scrape (sim tick, catalog index, member
+        summary) WITHOUT mutating the shared registry; they ride the
+        same sanitize-dedupe allocation as registered series, so the
+        text and JSON forms expose identical families."""
         with self._lock:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             samples = {k: (s.count, s.total, s.min, s.max, s.quantiles())
                        for k, s in self._samples.items()}
+        for name, v in (extra_gauges or {}).items():
+            gauges.setdefault((name, ()), float(v))
 
         # min/max companions (the in-memory sink's extra aggregate),
         # keyed by their OWNING sample — exposition names derive from

@@ -1,0 +1,213 @@
+"""Commit-to-visibility tracing: how long until a committed write is SEEN.
+
+PR 1 instrumented every layer in isolation (raft commitTime, http
+latency) and PR 8 journals what happened — but nothing measured the
+question the north star turns on: *a write commits; when does a parked
+watcher actually observe it?*  This module threads one correlation
+through the whole pipeline:
+
+    raft FSM apply          -> StateStore._bump stamps (index, ts,
+                               trace id of the proposing request)
+    stream publish          -> EventPublisher fan-out stamps publish_ts
+    watch wakeup            -> a parked blocking query that a write woke
+                               samples apply->wakeup
+    HTTP flush              -> the response write samples apply->flush
+
+producing `consul.kv.visibility{stage}` latency histograms (each stage
+measured FROM the apply — the per-stage p50/p99 curve the SLO probe in
+tools/visibility_probe.py sweeps against watcher count), per-stage
+trace spans sharing the WRITER's trace id (so `/v1/agent/traces
+?trace_id=` shows one correlated write->delivery story), and a
+`kv.visibility.stall` flight event when a stage blows its budget.
+
+Design constraints, deliberate:
+
+  * **Nothing emits under the store lock.**  `note_apply`/`note_publish`
+    run inside `StateStore._apply_bump_effects` (store lock held) and
+    are PURE table writes — one dict insert under this module's own
+    lock, no sink I/O.  Samples, spans, and stall events are emitted by
+    `stage()` on the OBSERVER's thread (the woken blocking query), off
+    every store/publisher lock — the same staging rule raft's
+    `_metrics_buf` and the store's `_query_metrics` follow.
+  * **Bounded memory.**  An OrderedDict ring of TABLE_CAP records keyed
+    by store index; old indexes fall off the front.  A watcher waking
+    for an index that aged out simply emits nothing.
+  * **Trace ids merge in any order.**  The proposer learns the store
+    index only when its apply resolves, while replication can wake a
+    watcher first — `note_apply` and `bind_trace` both upsert, so the
+    record ends up correlated regardless of which side stamps first.
+  * **The publish stage is emitted lazily**, once, by the first
+    observer of that index: `EventPublisher.publish` also runs under
+    the store lock, so it only stamps `publish_ts`; the first `stage()`
+    call flips `publish_emitted` and emits the sample off-lock.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+TABLE_CAP = 4096
+
+# a stage lagging its apply by more than this journals a flight event
+# (kv.visibility.stall) — the slow-subscriber tripwire for ROADMAP
+# item 2's 1M-watcher redesign
+STALL_SECONDS = 1.0
+
+STAGES = ("publish", "wakeup", "flush")
+
+# the thread applying a raft command binds the proposer's trace id here
+# (raft._apply_committed wraps apply_fn in `applying(tid)`) so the
+# store's _bump can correlate the index it mints without the trace
+# riding the replicated command payload
+_applying = threading.local()
+
+
+class _ApplyScope:
+    def __init__(self, trace_id: Optional[str]):
+        self._tid = trace_id
+
+    def __enter__(self):
+        _applying.tid = self._tid
+        return self
+
+    def __exit__(self, *exc):
+        _applying.tid = None
+        return False
+
+
+def applying(trace_id: Optional[str]) -> _ApplyScope:
+    """Scope a raft FSM apply: store bumps inside the block bind
+    `trace_id` (the proposer's) to the indexes they mint."""
+    return _ApplyScope(trace_id)
+
+
+def apply_trace() -> Optional[str]:
+    return getattr(_applying, "tid", None)
+
+
+class VisibilityTable:
+    """index -> {apply_ts, publish_ts, trace_id, publish_emitted}."""
+
+    def __init__(self, cap: int = TABLE_CAP):
+        self._cap = cap
+        self._lock = threading.Lock()
+        self._rec: "OrderedDict[int, dict]" = OrderedDict()
+
+    # ------------------------------------------------------------- stamping
+    # (called under the STORE lock — table writes only, no emission)
+
+    def note_apply(self, index: int, ts: Optional[float] = None,
+                   trace_id: Optional[str] = None) -> None:
+        if trace_id is None:
+            trace_id = apply_trace()
+            if trace_id is None:
+                # standalone (non-raft) writes run on the request
+                # thread itself — its contextvar IS the proposer trace
+                from consul_tpu import trace
+                trace_id = trace.current_trace()
+        now = time.time() if ts is None else ts
+        with self._lock:
+            rec = self._rec.get(index)
+            if rec is None:
+                rec = self._rec[index] = {"apply_ts": now,
+                                          "publish_ts": None,
+                                          "trace_id": trace_id or "",
+                                          "publish_emitted": False}
+                while len(self._rec) > self._cap:
+                    self._rec.popitem(last=False)
+            else:
+                # bind_trace may have created the record with no
+                # apply stamp yet (setdefault would keep the None)
+                if rec.get("apply_ts") is None:
+                    rec["apply_ts"] = now
+                if trace_id and not rec.get("trace_id"):
+                    rec["trace_id"] = trace_id
+
+    def note_publish(self, index: int, ts: Optional[float] = None) -> None:
+        now = time.time() if ts is None else ts
+        with self._lock:
+            rec = self._rec.get(index)
+            if rec is not None and rec["publish_ts"] is None:
+                rec["publish_ts"] = now
+
+    def bind_trace(self, index: int, trace_id: Optional[str]) -> None:
+        """Proposer-side late bind: the apply result carried the store
+        index back to the thread that owns the request trace."""
+        if not trace_id:
+            return
+        with self._lock:
+            rec = self._rec.get(index)
+            if rec is None:
+                rec = self._rec[index] = {"apply_ts": None,
+                                          "publish_ts": None,
+                                          "trace_id": trace_id,
+                                          "publish_emitted": False}
+                while len(self._rec) > self._cap:
+                    self._rec.popitem(last=False)
+            elif not rec.get("trace_id"):
+                rec["trace_id"] = trace_id
+
+    # -------------------------------------------------------------- reading
+
+    def lookup(self, index: int) -> Optional[dict]:
+        with self._lock:
+            rec = self._rec.get(index)
+            return dict(rec) if rec is not None else None
+
+    def stage(self, stage: str, index: int,
+              ts: Optional[float] = None) -> Optional[Tuple[float, str]]:
+        """Emit one observed stage for `index`: the
+        `consul.kv.visibility{stage}` sample (seconds since apply), a
+        `kv.visibility.<stage>` trace span under the WRITER's trace id,
+        and a stall event past STALL_SECONDS.  Runs on the observer's
+        thread — never call while holding the store/publisher lock.
+
+        Returns (latency_s, trace_id), or None when the index aged out
+        of the table (nothing to correlate against)."""
+        now = time.time() if ts is None else ts
+        emit_publish = None
+        with self._lock:
+            rec = self._rec.get(index)
+            if rec is None or rec.get("apply_ts") is None:
+                return None
+            apply_ts = rec["apply_ts"]
+            tid = rec.get("trace_id") or ""
+            if not rec["publish_emitted"] and rec["publish_ts"] is not None:
+                rec["publish_emitted"] = True
+                emit_publish = rec["publish_ts"] - apply_ts
+        from consul_tpu import telemetry, trace
+        if emit_publish is not None:
+            lat = max(0.0, emit_publish)
+            telemetry.add_sample(("kv", "visibility"), lat,
+                                 labels={"stage": "publish"})
+            trace.record("kv.visibility.publish", tid,
+                         apply_ts, lat, index=index)
+        lat = max(0.0, now - apply_ts)
+        telemetry.add_sample(("kv", "visibility"), lat,
+                             labels={"stage": stage})
+        trace.record(f"kv.visibility.{stage}", tid, apply_ts, lat,
+                     index=index)
+        if lat > STALL_SECONDS:
+            from consul_tpu import flight
+            flight.emit("kv.visibility.stall",
+                        labels={"stage": stage, "index": index,
+                                "ms": round(lat * 1000.0, 1)},
+                        trace_id=tid)
+        return lat, tid
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rec.clear()
+
+
+# NO process-wide default table, deliberately: index spaces are
+# per-store, and one process routinely hosts several stores (multi-DC
+# tests, in-process clusters, secondary agents) — a shared table would
+# cross-correlate store A's index 7 with store B's.  Each StateStore
+# owns a VisibilityTable (`store.visibility`, also reachable through
+# its EventPublisher for stream-side consumers); only the applying()
+# trace scope is process-global, because a thread applies for exactly
+# one store at a time.

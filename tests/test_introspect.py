@@ -1,0 +1,165 @@
+"""Federation layer (ISSUE 10 tentpole b): introspect.py scrape/merge,
+the EventCollector promotion, the /v1/internal/ui/cluster-metrics
+endpoint, cluster_top rendering, and debug_bundle --cluster.
+
+Everything here runs against in-process ApiServers over real HTTP —
+cheap; tests/test_visibility_live.py covers the multi-process cluster.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import tempfile
+import threading
+import time
+import urllib.request
+
+from consul_tpu import flight, introspect
+from consul_tpu.api.http import ApiServer
+from consul_tpu.catalog.store import StateStore
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_event_collector_promoted_and_reexported():
+    """The chaos harness's import path is the SAME class object —
+    promotion, not a fork (satellite 1: no behavior change)."""
+    from consul_tpu import chaos_live
+    assert chaos_live.EventCollector is introspect.EventCollector
+    # and it still polls the duck type the harness hands it
+    from types import SimpleNamespace
+    col = introspect.EventCollector(SimpleNamespace(servers=[]))
+    col.poll_once()
+    assert col.rows == []
+
+
+def test_merge_timelines_orders_by_ts_then_node_gen_seq():
+    rows = [
+        {"node": "b", "gen": 1, "seq": 2, "ts": 5.0, "name": "x"},
+        {"node": "a", "gen": 2, "seq": 1, "ts": 5.0, "name": "y"},
+        {"node": "a", "gen": 1, "seq": 9, "ts": 5.0, "name": "z"},
+        {"node": "c", "gen": 1, "seq": 1, "ts": 1.0, "name": "w"},
+    ]
+    out = introspect.merge_timelines(rows)
+    assert [r["name"] for r in out] == ["w", "z", "y", "x"]
+
+
+def _start_api(name):
+    api = ApiServer(StateStore(), node_name=name)
+    api.start()
+    return api
+
+
+def test_cluster_view_merges_two_live_nodes():
+    a, b = _start_api("intro-a"), _start_api("intro-b")
+    try:
+        # light one node's visibility pipeline: parked watcher + write
+        done = {}
+
+        def watch():
+            with urllib.request.urlopen(
+                    a.address + "/v1/kv/iv/k?index=1&wait=5s",
+                    timeout=10) as r:
+                done["idx"] = r.headers["X-Consul-Index"]
+        t = threading.Thread(target=watch)
+        t.start()
+        time.sleep(0.25)
+        req = urllib.request.Request(a.address + "/v1/kv/iv/k",
+                                     data=b"v", method="PUT")
+        urllib.request.urlopen(req, timeout=5).read()
+        t.join(timeout=6)
+        flight.emit("agent.started", labels={"node": "intro-a"})
+
+        view = introspect.cluster_view({"intro-a": a.address,
+                                        "intro-b": b.address})
+        assert set(view["nodes"]) == {"intro-a", "intro-b"}
+        na = view["nodes"]["intro-a"]
+        assert na["alive"] and na["index"] >= 1.0
+        # the visibility stages scraped off the woken watcher
+        assert "wakeup" in na["visibility"]
+        assert "flush" in na["visibility"]
+        assert na["visibility"]["wakeup"]["count"] >= 1
+        # no raft on a bare store: nobody self-claims leader, the view
+        # degrades to the best-populated visibility table, not a blank
+        assert view["leader"] is None
+        assert "wakeup" in view["visibility"]
+        # merged events carry node tags and sort by ts
+        assert any(e["node"] == "intro-a" for e in view["events"])
+        ts = [e["ts"] for e in view["events"]]
+        assert ts == sorted(ts)
+        # a dead node degrades to a dead row, never an exception
+        view2 = introspect.cluster_view(
+            {"intro-a": a.address,
+             "gone": "http://127.0.0.1:9"})
+        assert view2["nodes"]["gone"]["alive"] is False
+        assert view2["nodes"]["gone"]["error"]
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_cluster_metrics_endpoint_and_cluster_top_render():
+    a = _start_api("intro-top")
+    try:
+        # unconfigured: the endpoint is OFF (metrics-proxy stance)
+        try:
+            urllib.request.urlopen(
+                a.address + "/v1/internal/ui/cluster-metrics",
+                timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        a.cluster_nodes = {"intro-top": a.address}
+        out = json.loads(urllib.request.urlopen(
+            a.address + "/v1/internal/ui/cluster-metrics",
+            timeout=10).read())
+        assert set(out["nodes"]) == {"intro-top"}
+        assert out["nodes"]["intro-top"]["alive"] is True
+        # the CLI renders the same view without blowing up
+        from cluster_top import render
+        text = render(out, events_tail=5)
+        assert "intro-top" in text and "leader=<none>" in text
+    finally:
+        a.stop()
+
+
+def test_debug_bundle_cluster_subprocess_smoke():
+    """`debug_bundle.py --cluster URL,URL` from a cold subprocess:
+    per-node subdirs + merged cluster_events.jsonl, ok=true, bounded
+    wall (satellite 4)."""
+    a, b = _start_api("bundle-a"), _start_api("bundle-b")
+    tmp = tempfile.mkdtemp(prefix="bundle-cluster-")
+    out_path = os.path.join(tmp, "cap.tar.gz")
+    try:
+        flight.emit("agent.started", labels={"node": "bundle-a"})
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "debug_bundle.py"),
+             "--cluster", f"{a.address},{b.address}",
+             "--out", out_path],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row["ok"], row
+        with tarfile.open(out_path, "r:gz") as tar:
+            names = tar.getnames()
+            assert "cluster_view.json" in names
+            assert "cluster_events.jsonl" in names
+            for node in ("bundle-a", "bundle-b"):
+                for sec in ("metrics.json", "events.jsonl",
+                            "profile.json", "raft.json"):
+                    assert f"{node}/{sec}" in names
+            view = json.loads(tar.extractfile(
+                "cluster_view.json").read())
+            assert set(view["nodes"]) == {"bundle-a", "bundle-b"}
+            merged = tar.extractfile(
+                "cluster_events.jsonl").read().decode()
+            rows = [json.loads(ln) for ln in merged.splitlines()]
+            assert any(r["name"] == "agent.started" for r in rows)
+    finally:
+        a.stop()
+        b.stop()

@@ -584,6 +584,81 @@ def test_event_names_catalog_parses_real_flight_module():
             flight_mod.CATALOG[name].get("labels", ()))
 
 
+def test_issue10_visibility_metric_names_registered():
+    """ISSUE 10's new consul.raft.replication.* / consul.kv.visibility
+    / consul.stream.* families conform to the metric-names convention
+    exactly as emitted, and a malformed sibling still fires (the
+    checker gates the NEW vocabulary, not just the old)."""
+    clean = """
+        from consul_tpu import telemetry
+
+        def emit_slis(peer, topic, lat, n):
+            telemetry.set_gauge(("raft", "replication", "lag"), 3.0,
+                                labels={"peer": peer})
+            telemetry.set_gauge(("raft", "replication", "lag_ms"),
+                                1.5, labels={"peer": peer})
+            telemetry.add_sample(("kv", "visibility"), lat,
+                                 labels={"stage": "wakeup"})
+            telemetry.set_gauge(("stream", "subscribers"), n,
+                                labels={"topic": topic})
+            telemetry.set_gauge(("stream", "fanout"), n,
+                                labels={"topic": topic})
+            telemetry.incr_counter(("stream", "delivered"), n,
+                                   labels={"topic": topic})
+            telemetry.add_sample(("stream", "queue_depth"), n,
+                                 labels={"topic": topic})
+            telemetry.set_gauge(("ae", "lag"), 0.0)
+            telemetry.incr_counter(("cache", "hit"),
+                                   labels={"type": "kv"})
+    """
+    assert check_snippet("metric-names", clean) == []
+    bad = """
+        from consul_tpu import telemetry
+
+        def emit_slis(lat, stage):
+            telemetry.add_sample(("kv", "visi bility"), lat)
+            telemetry.add_sample(("kv", "visibility"), lat,
+                                 labels={stage: "wakeup"})
+    """
+    hits = check_snippet("metric-names", bad)
+    assert len(hits) == 2
+    assert any("visi bility" in f.message for f in hits)
+    assert any("computed label KEY" in f.message for f in hits)
+
+
+def test_issue10_visibility_event_names_registered():
+    """The new flight events (kv.visibility.stall, stream.subscriber
+    slow/reset) are registered in CATALOG with their exact label sets;
+    an unregistered sibling or undeclared label still fires."""
+    clean = """
+        from consul_tpu import flight
+
+        def stall(stage, index, ms, topic, depth, key):
+            flight.emit("kv.visibility.stall",
+                        labels={"stage": stage, "index": index,
+                                "ms": ms})
+            flight.emit("stream.subscriber.slow",
+                        labels={"topic": topic, "depth": depth})
+            flight.emit("stream.subscriber.reset",
+                        labels={"topic": topic, "key": key})
+    """
+    assert check_snippet("event-names", clean) == []
+    bad = """
+        from consul_tpu import flight
+
+        def stall(stage, topic):
+            flight.emit("kv.visibility.bogus",
+                        labels={"stage": stage})
+            flight.emit("stream.subscriber.slow",
+                        labels={"topic": topic, "lane": 3})
+    """
+    hits = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 2
+    assert "unregistered event name 'kv.visibility.bogus'" in msgs
+    assert "label 'lane' not declared" in msgs
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

@@ -104,6 +104,85 @@ def test_reservoir_is_bounded_and_still_estimates():
     assert p90 > p50 and p99 >= p90
 
 
+def test_prometheus_extra_gauges_parity_and_dedupe():
+    """The endpoint's per-scrape extras (sim tick, catalog index,
+    member summary) ride Registry.prometheus(extra_gauges=...) through
+    the SAME sanitize-dedupe allocation as registered series — so the
+    text and JSON forms expose identical families, and an extra that
+    sanitizes onto a registered name collides deterministically
+    instead of emitting a duplicate TYPE block (satellite: parity with
+    a golden alongside the exposition golden)."""
+    r = _build_registry()
+    extras = {"consul.sim.tick": 42.0,
+              "consul.catalog.index": 7.0,
+              "consul.members.alive": 3.0}
+    text = r.prometheus(extra_gauges=extras)
+    # the plain exposition is UNCHANGED by the extras (golden still
+    # guards it) plus exactly the extra families appended in-order
+    assert r.prometheus() == _build_registry().prometheus()
+    for line in ("consul_sim_tick 42", "consul_catalog_index 7",
+                 "consul_members_alive 3"):
+        assert line in text
+    types = [ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE ")]
+    assert len(types) == len(set(types)), "duplicate # TYPE blocks"
+    # a colliding extra (sanitizes onto an existing gauge name): one
+    # of the two gets a deterministic crc suffix, never a duplicate
+    # TYPE block — and both data points survive
+    clash = r.prometheus(
+        extra_gauges={"consul.rpc.queries-blocking": 9.0})
+    types = [ln.split()[2] for ln in clash.splitlines()
+             if ln.startswith("# TYPE ")]
+    assert len(types) == len(set(types))
+    data = [ln for ln in clash.splitlines()
+            if ln.startswith("consul_rpc_queries_blocking")]
+    assert any(ln.endswith(" 2") for ln in data)
+    assert any(ln.endswith(" 9") for ln in data)
+    # a registered series beats the extra: the extra may not CLOBBER
+    # an existing value either
+    same = r.prometheus(extra_gauges={"consul.rpc.queries_blocking":
+                                      99.0})
+    assert "consul_rpc_queries_blocking 2" in same
+    assert "consul_rpc_queries_blocking 99" not in same
+
+
+def test_metrics_json_and_prometheus_serve_same_families():
+    """Live-endpoint parity: every gauge family the JSON form reports
+    appears in the prometheus exposition (sanitize applied), incl. the
+    per-scrape extras that used to be hand-formatted text."""
+    import sys
+    import urllib.request
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    from metrics_audit import audit_prometheus
+
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.catalog.store import StateStore
+
+    api = ApiServer(StateStore(), node_name="parity")
+    api.start()
+    try:
+        urllib.request.urlopen(api.address + "/v1/agent/self",
+                               timeout=15).read()
+        dump = json.loads(urllib.request.urlopen(
+            api.address + "/v1/agent/metrics", timeout=15).read())
+        prom = urllib.request.urlopen(
+            api.address + "/v1/agent/metrics?format=prometheus",
+            timeout=15).read().decode()
+        assert audit_prometheus(prom) == []
+        from consul_tpu.telemetry import Registry
+        for g in dump["Gauges"]:
+            if g.get("Labels"):
+                continue          # labeled series render as {k="v"}
+            assert Registry._sanitize(g["Name"]) + " " in prom, \
+                f"JSON gauge {g['Name']} missing from exposition"
+        assert "consul_sim_tick" in prom
+        assert "consul_catalog_index" in prom
+    finally:
+        api.stop()
+
+
 def test_live_prometheus_endpoint_structure():
     """/v1/agent/metrics?format=prometheus over an ApiServer (plain
     store + NullOracle — no sim device needed): parseable exposition,

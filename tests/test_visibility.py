@@ -1,0 +1,307 @@
+"""Commit-to-visibility tracing (ISSUE 10 tentpole a), in-process.
+
+The pipeline under test: a write's raft/store apply stamps
+(index, ts, proposer trace) into the visibility table
+(consul_tpu/visibility.py); the stream publish stamps publish_ts; a
+parked blocking query that the write wakes emits the wakeup stage; the
+HTTP response write emits the flush stage — all as
+`consul.kv.visibility{stage}` samples and `kv.visibility.*` trace
+spans sharing the WRITER's trace id.  Plus the new SLI surfaces: raft
+per-peer replication lag, stream fanout/slow-subscriber telemetry, AE
+lag, and cache hit/miss counters.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from consul_tpu import flight, telemetry, visibility
+from consul_tpu.catalog.store import StateStore
+
+
+def _gauge(name, labels=None):
+    key = (name, tuple(sorted((labels or {}).items())))
+    for g in telemetry.default_registry().dump()["Gauges"]:
+        if (g["Name"], tuple(sorted(
+                (g.get("Labels") or {}).items()))) == key:
+            return g["Value"]
+    return None
+
+
+def _counter(name, labels=None):
+    key = (name, tuple(sorted((labels or {}).items())))
+    for c in telemetry.default_registry().dump()["Counters"]:
+        if (c["Name"], tuple(sorted(
+                (c.get("Labels") or {}).items()))) == key:
+            return c["Count"]
+    return 0.0
+
+
+def _samples(name):
+    return [s for s in telemetry.default_registry().dump()["Samples"]
+            if s["Name"] == name]
+
+
+# ------------------------------------------------------------ table unit
+
+
+def test_visibility_table_merges_in_any_order_and_stays_bounded():
+    t = visibility.VisibilityTable(cap=8)
+    # proposer binds first (forwarded apply resolved before the local
+    # replica caught up), apply stamps second — the record merges
+    t.bind_trace(5, "aaa")
+    t.note_apply(5, ts=100.0)
+    rec = t.lookup(5)
+    assert rec["trace_id"] == "aaa" and rec["apply_ts"] == 100.0
+    # reverse order on another index
+    t.note_apply(6, ts=101.0, trace_id="bbb")
+    t.bind_trace(6, "zzz")          # first bind wins; no clobber
+    assert t.lookup(6)["trace_id"] == "bbb"
+    # bounded: 20 more indexes evict the oldest
+    for i in range(10, 30):
+        t.note_apply(i, ts=float(i))
+    assert t.lookup(5) is None
+    assert t.lookup(29) is not None
+    # stage() on an aged-out index is a no-op, not an error
+    assert t.stage("wakeup", 5) is None
+
+
+def test_stage_emits_sample_span_and_stall_event(monkeypatch):
+    t = visibility.VisibilityTable()
+    t.note_apply(42, ts=time.time() - 5.0, trace_id="cafe01")
+    t.note_publish(42, ts=time.time() - 4.9)
+    monkeypatch.setattr(visibility, "STALL_SECONDS", 1.0)
+    rec = flight.FlightRecorder(forward_to_log=False)
+    with flight.use(rec):
+        out = t.stage("wakeup", 42)
+    assert out is not None
+    lat, tid = out
+    assert lat > 4.0 and tid == "cafe01"
+    stalls = rec.read(name="kv.visibility.stall")
+    assert len(stalls) == 1
+    assert stalls[0]["labels"]["stage"] == "wakeup"
+    assert stalls[0]["trace_id"] == "cafe01"
+    # the lazy publish stage was emitted exactly once, by this first
+    # observer; a second stage call must not re-emit it
+    pubs = [s for s in _samples("consul.kv.visibility")
+            if (s.get("Labels") or {}).get("stage") == "publish"]
+    count0 = pubs[0]["Count"]
+    with flight.use(rec):
+        t.stage("flush", 42)
+    pubs = [s for s in _samples("consul.kv.visibility")
+            if (s.get("Labels") or {}).get("stage") == "publish"]
+    assert pubs[0]["Count"] == count0
+
+
+# ------------------------------------------ the HTTP pipeline, end to end
+
+
+def test_blocking_query_yields_one_correlated_trace():
+    """PUT with a trace id + a parked watcher: apply, publisher event,
+    watch wakeup, and HTTP flush all share the writer's trace id, and
+    the stage histograms populate — ISSUE 10's acceptance, in-process
+    (tests/test_visibility_live.py proves it on the real cluster)."""
+    from consul_tpu.api.http import ApiServer
+    api = ApiServer(StateStore(), node_name="vis0")
+    api.start()
+    base = api.address
+    tid = "ab" * 16
+    got = {}
+    try:
+        def watch():
+            req = urllib.request.Request(
+                base + "/v1/kv/vis/k?index=1&wait=5s")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                got["index"] = int(r.headers["X-Consul-Index"])
+                got["rows"] = json.loads(r.read())
+        w = threading.Thread(target=watch)
+        w.start()
+        time.sleep(0.3)          # the watcher parks first
+        req = urllib.request.Request(
+            base + "/v1/kv/vis/k", data=b"v1", method="PUT",
+            headers={"X-Consul-Trace-Id": tid})
+        urllib.request.urlopen(req, timeout=5).read()
+        w.join(timeout=6)
+        assert got["rows"][0]["Key"] == "vis/k"
+        idx = got["index"]
+        # the visibility record correlates the store index to the trace
+        rec = api.store.visibility.lookup(idx)
+        assert rec is not None and rec["trace_id"] == tid
+        # one correlated trace: every pipeline stage shares the id
+        spans = json.loads(urllib.request.urlopen(
+            base + f"/v1/agent/traces?trace_id={tid}",
+            timeout=5).read())
+        names = {s["name"] for s in spans}
+        assert {"http.request", "kv.visibility.publish",
+                "kv.visibility.wakeup",
+                "kv.visibility.flush"} <= names
+        vis_spans = [s for s in spans
+                     if s["name"].startswith("kv.visibility")]
+        assert all(s["attrs"]["index"] == idx for s in vis_spans)
+        # stage histograms populated, wakeup <= flush by construction
+        stages = {(s.get("Labels") or {}).get("stage"): s
+                  for s in _samples("consul.kv.visibility")}
+        assert {"publish", "wakeup", "flush"} <= set(stages)
+        # a plain poll with a stale cursor (data already present) must
+        # NOT inflate the histograms with ancient apply deltas
+        counts0 = {k: s["Count"] for k, s in stages.items()}
+        urllib.request.urlopen(
+            base + "/v1/kv/vis/k?index=1&wait=10ms",
+            timeout=5).read()
+        stages = {(s.get("Labels") or {}).get("stage"): s
+                  for s in _samples("consul.kv.visibility")}
+        assert {k: s["Count"] for k, s in stages.items()} == counts0
+    finally:
+        api.stop()
+
+
+def test_event_carries_writer_trace_id():
+    """The published stream event itself carries the proposer's trace
+    (submatview/watch consumers can correlate without a table read)."""
+    from consul_tpu import trace
+    store = StateStore()
+    sub = store.publisher.subscribe("kv", "t/k")
+    tok = trace.set_current("feed" * 8)
+    try:
+        store.kv_set("t/k", b"x")
+    finally:
+        trace.reset(tok)
+    batch = sub.events(timeout=2.0)
+    assert batch and batch[0].trace_id == "feed" * 8
+    assert batch[0].index == store.index
+
+
+# ----------------------------------------------- raft replication lag SLI
+
+
+def test_raft_replication_lag_gauges():
+    from consul_tpu.consensus.raft import (InMemTransport, RaftConfig,
+                                           RaftNode)
+    ids = ["n0", "n1", "n2"]
+    tr = InMemTransport()
+    nodes = {i: RaftNode(i, ids, tr, apply_fn=lambda c: c,
+                         config=RaftConfig(), seed=3) for i in ids}
+    for n in nodes.values():
+        tr.register(n)
+    t = 0.0
+    leader = None
+    for _ in range(400):
+        t += 0.02
+        for n in nodes.values():
+            n.tick(t)
+        leaders = [n for n in nodes.values() if n.is_leader()]
+        if leaders:
+            leader = leaders[0]
+            break
+    assert leader is not None
+    for i in range(4):
+        leader.apply({"w": i})
+        t += 0.06                    # past a heartbeat each round
+        for n in nodes.values():
+            n.tick(t)
+    for _ in range(3):               # settle: acks land, gauges re-stage
+        t += 0.06
+        for n in nodes.values():
+            n.tick(t)
+    peers = [i for i in ids if i != leader.node_id]
+    for p in peers:
+        assert _gauge("consul.raft.replication.lag",
+                      {"peer": p}) == 0.0
+        assert _gauge("consul.raft.replication.lag_ms",
+                      {"peer": p}) == 0.0
+    # sever one follower: its lag grows in entries AND ms while the
+    # healthy peer stays caught up
+    dead = peers[0]
+    tr.unregister(dead)
+    for i in range(3):
+        leader.apply({"w": 100 + i})
+        t += 0.06
+        for i2, n in nodes.items():
+            if i2 != dead:
+                n.tick(t)
+    for _ in range(3):               # settle the healthy peer's acks
+        t += 0.06
+        for i2, n in nodes.items():
+            if i2 != dead:
+                n.tick(t)
+    assert _gauge("consul.raft.replication.lag",
+                  {"peer": dead}) >= 3.0
+    assert _gauge("consul.raft.replication.lag_ms",
+                  {"peer": dead}) > 0.0
+    assert _gauge("consul.raft.replication.lag",
+                  {"peer": peers[1]}) == 0.0
+
+
+# ------------------------------------------------- stream plane telemetry
+
+
+def test_publisher_fanout_subscribers_and_slow_subscriber_event():
+    from consul_tpu.stream.publisher import (SLOW_QUEUE_DEPTH, Event,
+                                             EventPublisher)
+    pub = EventPublisher()
+    sub = pub.subscribe("kv", None)
+    assert _gauge("consul.stream.subscribers", {"topic": "kv"}) == 1.0
+    rec = flight.FlightRecorder(forward_to_log=False)
+    with flight.use(rec):
+        for i in range(SLOW_QUEUE_DEPTH + 5):
+            pub.publish([Event(topic="kv", key=f"k{i}", index=i + 1)])
+        assert rec.read(name="stream.subscriber.slow") == []
+        batch = sub.events(timeout=1.0)
+    assert len(batch) == SLOW_QUEUE_DEPTH + 5
+    # the slow event is journaled by the DRAIN (publish runs under the
+    # store lock and must not emit), with the backed-up depth
+    slow = rec.read(name="stream.subscriber.slow")
+    assert len(slow) == 1
+    assert int(slow[0]["labels"]["depth"]) > SLOW_QUEUE_DEPTH
+    assert _gauge("consul.stream.fanout", {"topic": "kv"}) == 1.0
+    assert _counter("consul.stream.delivered",
+                    {"topic": "kv"}) >= SLOW_QUEUE_DEPTH + 5
+    depth = [s for s in _samples("consul.stream.queue_depth")
+             if (s.get("Labels") or {}).get("topic") == "kv"]
+    assert depth and depth[0]["Max"] >= SLOW_QUEUE_DEPTH
+    # falling off the buffer tail journals the reset
+    with flight.use(rec):
+        from consul_tpu.stream.publisher import SnapshotRequired
+        small = EventPublisher(buffer_len=4)
+        for i in range(10):
+            small.publish([Event(topic="kv", key="k", index=i + 1)])
+        with pytest.raises(SnapshotRequired):
+            small.subscribe("kv", "k", since_index=1)
+    resets = rec.read(name="stream.subscriber.reset")
+    assert resets and resets[0]["labels"]["topic"] == "kv"
+    sub.close()
+    assert _gauge("consul.stream.subscribers", {"topic": "kv"}) == 0.0
+
+
+# ------------------------------------------------ AE lag + cache counters
+
+
+def test_ae_lag_gauge_resets_on_success_and_grows_on_failure():
+    from consul_tpu.ae import StateSyncer
+    from consul_tpu.local import LocalState
+    local = LocalState("vis-node", "127.0.0.1")
+    sy = StateSyncer(local, StateStore())
+    sy.sync_full_now()
+    assert _gauge("consul.ae.lag") == 0.0
+    assert sy.lag() < 5.0
+    sy.last_success -= 30.0
+    assert sy.lag() >= 30.0
+
+
+def test_cache_hit_miss_counters_by_type():
+    from consul_tpu.cache import Cache
+    c = Cache()
+    c.register_type("vis_t", lambda key, mi, t: ({"k": key}, 1))
+    base_miss = _counter("consul.cache.miss", {"type": "vis_t"})
+    base_hit = _counter("consul.cache.hit", {"type": "vis_t"})
+    c.get("vis_t", "a")
+    c.get("vis_t", "a")
+    c.get("vis_t", "a")
+    assert _counter("consul.cache.miss",
+                    {"type": "vis_t"}) == base_miss + 1
+    assert _counter("consul.cache.hit",
+                    {"type": "vis_t"}) == base_hit + 2
+    c.close()

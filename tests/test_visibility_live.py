@@ -1,0 +1,126 @@
+"""ISSUE 10 acceptance on the REAL multi-process cluster: one
+committed write yields one correlated trace (apply index, publisher
+event, watch wakeup, HTTP flush share the trace id), the SLO probe
+produces per-stage quantiles, the federation endpoint serves the
+leader/lag view, and X-Consul-Index on a watched route never decreases
+across a leader change (satellite 3).
+
+These spawn tools/server_proc.py fleets over real sockets — the two
+tests here are budgeted ~15 s each; everything cheaper lives in
+tests/test_visibility.py / test_introspect.py.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+from consul_tpu.api.client import ApiError
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_live_probe_point_stages_and_correlated_trace():
+    """One SLO-probe sweep point against a live 3-process cluster:
+    watchers deliver, the leader's stage histograms populate, the
+    traced PUT's id rides the kv.visibility spans, and the leader
+    reports per-peer replication lag."""
+    import visibility_probe
+    with tempfile.TemporaryDirectory(prefix="vis-live-") as tmp:
+        row = visibility_probe.run_point(n_watchers=2, writes=8,
+                                         pace_s=0.05, data_root=tmp,
+                                         seed=1)
+    assert row["deliveries"] > 0
+    assert row["end_to_end_ms"]["p50"] > 0.0
+    assert row["end_to_end_ms"]["p99"] >= row["end_to_end_ms"]["p50"]
+    stages = row["stages_ms"]
+    assert {"wakeup", "flush"} <= set(stages)
+    for s in stages.values():
+        assert s["count"] >= 1 and s["p99_ms"] >= s["p50_ms"]
+    # the acceptance correlation: the traced write's spans
+    spans = row["correlated_trace"]["spans"]
+    assert "http.request" in spans
+    assert any(s.startswith("kv.visibility.") for s in spans)
+    # 3-server cluster: the leader reports lag for both followers
+    assert len(row["replication_lag"]) == 2
+    for peer in row["replication_lag"].values():
+        assert "entries" in peer and "ms" in peer
+
+
+def test_live_cluster_metrics_and_index_monotonic_across_leader_kill():
+    from consul_tpu.chaos_live import LiveCluster
+
+    def put_retry(cluster, key, val, deadline_s=15.0):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            for i in cluster.alive_ids():
+                try:
+                    if cluster.client(i, timeout=2.5).kv_put(key, val):
+                        return True
+                except (ApiError, OSError):
+                    continue
+            time.sleep(0.2)
+        raise AssertionError(f"write {key} never acked")
+
+    with tempfile.TemporaryDirectory(prefix="vis-mono-") as tmp:
+        cluster = LiveCluster(n=3, data_root=tmp)
+        try:
+            cluster.start()
+            li = cluster.leader()
+            follower = (li + 1) % 3
+            # ---- federation endpoint, live (tentpole b): every node
+            # got --cluster-http, so any node serves the merged view
+            view = json.loads(urllib.request.urlopen(
+                cluster.servers[follower].http
+                + "/v1/internal/ui/cluster-metrics",
+                timeout=10).read())
+            assert set(view["nodes"]) == {"server0", "server1",
+                                          "server2"}
+            assert view["leader"] == f"server{li}"
+            assert len(view["replication_lag"]) == 2
+            # ---- X-Consul-Index monotonicity across a leader change
+            put_retry(cluster, "mono/k", b"v0")
+            cursor = 0
+
+            def poll(i, blocking=True):
+                nonlocal cursor
+                c = cluster.client(i, timeout=8.0)
+                deadline = time.time() + 10.0
+                while True:
+                    row, idx = c.kv_get(
+                        "mono/k",
+                        index=cursor if blocking and cursor else None,
+                        wait="3s" if blocking else None)
+                    if row is not None:
+                        break
+                    # local replica still catching up (default-
+                    # consistency reads serve the local store)
+                    assert time.time() < deadline, \
+                        f"server{i} never replicated mono/k"
+                    time.sleep(0.2)
+                assert idx >= cursor, \
+                    (f"X-Consul-Index went BACKWARDS on server{i}: "
+                     f"{idx} < {cursor}")
+                cursor = max(cursor, idx)
+
+            poll(follower, blocking=False)
+            assert cursor > 0
+            put_retry(cluster, "mono/k", b"v1")
+            poll(follower)
+            # kill -9 the leader, restart it on the same data dir
+            cluster.kill(li)
+            put_retry(cluster, "mono/k", b"v2")
+            poll(follower)
+            cluster.restart(li)
+            assert cluster.wait_http(li)
+            put_retry(cluster, "mono/k", b"v3")
+            # the RESTARTED ex-leader must catch up past the cursor,
+            # never serve an older index on the watched route
+            poll(li)
+            poll(follower)
+            assert cursor > 0
+        finally:
+            cluster.stop()

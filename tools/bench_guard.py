@@ -300,6 +300,19 @@ def run_check() -> int:
     if not dec["ok"]:
         failures.append("guard judged the profiler-stamp keys instead "
                         "of tolerating them")
+    # the VISIBILITY_* artifact keys (ISSUE 10's SLO probe) are
+    # metadata too: a result row decorated with a visibility stamp
+    # must be tolerated-not-judged, exactly like the profiler stamp
+    vis = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                  "visibility": {"watchers": 8,
+                                 "end_to_end_ms": {"p50": 3.1,
+                                                   "p99": 9.9},
+                                 "stages_ms": {"wakeup":
+                                               {"p50_ms": 1.0}}}}],
+                fake_base)
+    if not vis["ok"]:
+        failures.append("guard judged the VISIBILITY_* artifact keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

@@ -1,0 +1,100 @@
+"""cluster_top: one-screen live view of a whole cluster.
+
+    python tools/cluster_top.py http://127.0.0.1:8501 http://127.0.0.1:8502 ...
+    python tools/cluster_top.py --json URL...          # machine-readable
+    python tools/cluster_top.py --watch 2 URL...       # refresh loop
+    python tools/cluster_top.py --events 20 URL...     # timeline tail
+
+The `consul operator`-flavored CLI over `consul_tpu/introspect.py`
+(the same merge the /v1/internal/ui/cluster-metrics endpoint serves):
+leader + per-node commit-index table, the leader's per-peer
+replication lag (entries + ms), the commit-to-visibility stage
+quantiles (`consul.kv.visibility{stage}` p50/p99), and the merged
+cross-node flight-recorder tail.  Dead nodes render as dead rows —
+this is an incident tool; partial clusters are the point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def render(view: dict, events_tail: int = 0) -> str:
+    out = []
+    leader = view.get("leader")
+    out.append(f"cluster: {len(view['nodes'])} nodes, "
+               f"leader={leader or '<none>'}")
+    out.append(f"{'NODE':<12} {'ROLE':<9} {'ALIVE':<6} "
+               f"{'INDEX':>8} {'BLOCKED':>8}  URL")
+    for name, n in sorted(view["nodes"].items()):
+        role = "leader" if n.get("leader") else "follower"
+        idx = n.get("index")
+        out.append(
+            f"{name:<12} {role:<9} {str(n['alive']).lower():<6} "
+            f"{int(idx) if idx is not None else '-':>8} "
+            f"{int(n['blocking_queries'] or 0):>8}  {n['url']}")
+    lag = view.get("replication_lag") or {}
+    if lag:
+        out.append("replication lag (leader view):")
+        for peer, row in sorted(lag.items()):
+            out.append(f"  {peer:<12} {row.get('entries', 0):>6.0f} "
+                       f"entries  {row.get('ms', 0.0):>9.1f} ms")
+    vis = view.get("visibility") or {}
+    if vis:
+        out.append("commit-to-visibility (ms since apply):")
+        out.append(f"  {'STAGE':<9} {'P50':>9} {'P99':>9} {'COUNT':>8}")
+        for stage in ("publish", "wakeup", "flush"):
+            row = vis.get(stage)
+            if row:
+                out.append(f"  {stage:<9} {row['p50_ms']:>9.2f} "
+                           f"{row['p99_ms']:>9.2f} "
+                           f"{row['count']:>8}")
+    if events_tail:
+        out.append(f"cluster timeline (last {events_tail}):")
+        for e in view.get("events", [])[-events_tail:]:
+            kv = " ".join(f"{k}={v}"
+                          for k, v in (e["labels"] or {}).items())
+            out.append(f"  {e['ts']:.3f} {e['node']:<12} "
+                       f"{e['name']} {kv}")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("nodes", nargs="+", help="node HTTP base URLs")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw merged view as JSON")
+    ap.add_argument("--watch", type=float, default=0.0,
+                    help="refresh every N seconds until interrupted")
+    ap.add_argument("--events", type=int, default=10,
+                    help="timeline tail length (0 = off)")
+    args = ap.parse_args(argv)
+
+    from consul_tpu import introspect
+    while True:
+        view = introspect.cluster_view(args.nodes,
+                                       events_limit=max(args.events,
+                                                        10))
+        if args.json:
+            print(json.dumps(view, indent=2, sort_keys=True))
+        else:
+            print(render(view, events_tail=args.events))
+        if not args.watch:
+            return 0
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            return 0
+        print()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

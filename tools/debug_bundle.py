@@ -3,6 +3,7 @@
     python tools/debug_bundle.py                       # ./debug_bundle.tar.gz
     python tools/debug_bundle.py --out /tmp/cap.tar.gz
     python tools/debug_bundle.py --intervals 3 --interval 0.5
+    python tools/debug_bundle.py --cluster URL1,URL2,...   # whole fleet
 
 A thin wrapper over `consul_tpu.debug.capture()` (command/debug/debug.go
 role): the archive carries host info, recent logs, per-interval metrics
@@ -11,11 +12,20 @@ the flight-recorder event journal (events.jsonl), and the tick
 profiler's EMA table (profile.json).  Defaults are sized for the tier-1
 smoke: one interval, sub-second capture, archive written in well under
 10 s.
+
+`--cluster` captures a LIVE FLEET instead of this process: every
+node's /v1/agent/{metrics,events,profile} + raft configuration scraped
+through `consul_tpu/introspect.py` into per-node subdirs, plus ONE
+merged `cluster_events.jsonl` timeline and the leader/lag
+`cluster_view.json` — the whole-cluster incident capture the
+single-process archive cannot give.
 """
 
 from __future__ import annotations
 
 import argparse
+import io
+import json
 import os
 import sys
 import tarfile
@@ -31,6 +41,76 @@ DEFAULT_OUT = "debug_bundle.tar.gz"
 REQUIRED_SECTIONS = ("host.json", "logs.txt", "0/metrics.json",
                      "0/metrics.prom", "0/threads.txt", "trace.json",
                      "events.jsonl", "profile.json")
+
+# per-node sections a --cluster bundle must carry for every LIVE node,
+# plus the merged cluster files
+CLUSTER_SECTIONS = ("cluster_view.json", "cluster_events.jsonl")
+CLUSTER_NODE_SECTIONS = ("metrics.json", "events.jsonl",
+                         "profile.json", "raft.json")
+
+
+def build_cluster(out_path: str, urls: list,
+                  events_limit: int = 500) -> dict:
+    """Scrape every node via introspect, archive per-node subdirs +
+    the merged timeline; returns a summary row."""
+    from consul_tpu import introspect
+    t0 = time.perf_counter()
+    # ONE scrape pass feeds both the per-node subdirs and the merged
+    # view (a dead node mid-incident costs one timeout, not two, and
+    # the archive cannot disagree with cluster_view.json about who was
+    # alive); names are deduplicated by scrape_cluster so a doubled
+    # URL or shared node name cannot silently drop a capture
+    scraped = introspect.scrape_cluster(urls,
+                                        events_limit=events_limit)
+    rows = dict(scraped)
+    all_events = []
+    for name, row in scraped:
+        for e in row["events"]:
+            all_events.append({
+                "node": name, "gen": 1, "seq": e["Seq"], "ts": e["Ts"],
+                "name": e["Name"], "severity": e["Severity"],
+                "labels": e["Labels"]})
+    view = introspect.view_from_scrapes(scraped)
+    view["events"] = []      # cluster_events.jsonl carries the timeline
+    merged = introspect.merge_timelines(all_events)
+    with tarfile.open(out_path, "w:gz") as tar:
+        def add(name: str, data: bytes):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        add("cluster_view.json",
+            json.dumps(view, indent=2, sort_keys=True).encode())
+        add("cluster_events.jsonl", "".join(
+            json.dumps({"ts": e["ts"], "node": e["node"],
+                        "name": e["name"], "labels": e["labels"]},
+                       sort_keys=True) + "\n"
+            for e in merged).encode())
+        for name, row in rows.items():
+            add(f"{name}/metrics.json",
+                json.dumps(row["metrics"], indent=2).encode())
+            add(f"{name}/events.jsonl", "".join(
+                json.dumps(e, sort_keys=True) + "\n"
+                for e in row["events"]).encode())
+            add(f"{name}/profile.json",
+                json.dumps(row["profile"], indent=2).encode())
+            add(f"{name}/raft.json",
+                json.dumps(row["raft"], indent=2).encode())
+    wall = time.perf_counter() - t0
+    with tarfile.open(out_path, "r:gz") as tar:
+        names = tar.getnames()
+    missing = [s for s in CLUSTER_SECTIONS if s not in names]
+    for name, row in rows.items():
+        if row["alive"]:
+            missing += [f"{name}/{s}"
+                        for s in CLUSTER_NODE_SECTIONS
+                        if f"{name}/{s}" not in names]
+    return {"out": out_path,
+            "bytes": os.path.getsize(out_path),
+            "wall_s": round(wall, 3), "sections": names,
+            "nodes": {n: r["alive"] for n, r in rows.items()},
+            "missing": missing, "ok": not missing}
 
 
 def build(out_path: str, intervals: int = 1,
@@ -58,10 +138,16 @@ def main(argv=None) -> int:
                     help="metric/thread-dump sampling intervals")
     ap.add_argument("--interval", type=float, default=0.2,
                     help="seconds between intervals")
+    ap.add_argument("--cluster", default=None, metavar="URL,URL,...",
+                    help="scrape a LIVE fleet's HTTP surfaces instead "
+                         "of capturing this process")
     args = ap.parse_args(argv)
-    row = build(args.out, intervals=args.intervals,
-                interval_s=args.interval)
-    import json
+    if args.cluster:
+        row = build_cluster(args.out,
+                            [u for u in args.cluster.split(",") if u])
+    else:
+        row = build(args.out, intervals=args.intervals,
+                    interval_s=args.interval)
     print(json.dumps({k: row[k] for k in
                       ("out", "bytes", "wall_s", "ok", "missing")}))
     return 0 if row["ok"] else 1

@@ -87,6 +87,11 @@ def main():
                          'e.g. "seed=3,torn=1"; SIGUSR1 then injects '
                          'a power loss (torn un-fsynced tail + hard '
                          'exit).  Env: CONSUL_TPU_STORAGE_FAULTS')
+    ap.add_argument("--cluster-http", default=None,
+                    help="name=url,name=url,... HTTP addresses of "
+                         "every cluster member: enables the "
+                         "/v1/internal/ui/cluster-metrics federation "
+                         "endpoint (consul_tpu/introspect.py)")
     args = ap.parse_args()
 
     from consul_tpu import flight
@@ -113,6 +118,11 @@ def main():
                     data_dir=args.data_dir, storage_io=storage_io)
     server.serve_rpc(host=my_rpc[0], port=my_rpc[1])
     api = ApiServer(server, node_name=args.node, port=args.http_port)
+    if args.cluster_http:
+        api.cluster_nodes = {
+            name: url for name, url in
+            (part.split("=", 1) for part in
+             args.cluster_http.split(",") if part)}
     api.start()
     print(f"server {args.node} rpc={my_rpc} "
           f"http={api.address}", flush=True)

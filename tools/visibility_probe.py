@@ -1,0 +1,215 @@
+"""Visibility SLO probe: write -> watch-delivery latency, live.
+
+    python tools/visibility_probe.py                       # full sweep
+    python tools/visibility_probe.py --watchers 1 8 32 --writes 60
+    python tools/visibility_probe.py --check               # bounded CI shape
+    python tools/visibility_probe.py --out VISIBILITY_r01.json
+
+Drives the PR 9 REAL multi-process cluster (chaos_live.LiveCluster:
+one tools/server_proc.py process per member, raft + forwarding over
+real sockets) with N parked blocking watchers on one key, streams
+writes through the leader, and measures:
+
+  * client-observed end-to-end latency per delivery (PUT issued ->
+    watcher's blocking GET returns the new value), p50/p99 per
+    watcher-count sweep point;
+  * the server's own per-stage `consul.kv.visibility{stage}`
+    histograms (apply->publish/wakeup/flush — consul_tpu/visibility.py)
+    scraped via introspect after each point, so the artifact shows
+    WHERE the time goes as fan-out grows;
+  * the leader's per-peer replication lag at the end of each point;
+  * one correlated trace: a PUT carrying X-Consul-Trace-Id whose id
+    shows up on the leader's kv.visibility.* spans (the ISSUE 10
+    acceptance demonstration).
+
+The emitted VISIBILITY_r01.json is the baseline ROADMAP item 2's
+event-driven front redesign will be judged against: today's
+thread-per-watcher curve is the number to beat at 1M watchers.
+
+Each sweep point runs against a FRESH cluster so the per-stage
+reservoirs are not blended across fan-out levels.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROBE_KEY = "vis/probe"
+
+
+def pctl(values, q: float) -> float:
+    """Nearest-rank percentile (telemetry._Sample's rule)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, max(0, int(q * len(s))))]
+
+
+def _watcher(client, stop, seen, lock):
+    """One parked blocking watcher: long-poll the probe key, stamp
+    first-seen wall time per value."""
+    from consul_tpu.api.client import ApiError
+    cursor = 0
+    while not stop.is_set():
+        try:
+            row, idx = client.kv_get(PROBE_KEY, index=cursor or None,
+                                     wait="5s")
+        except (ApiError, OSError):
+            if stop.is_set():
+                return
+            time.sleep(0.05)
+            continue
+        now = time.time()
+        cursor = max(cursor, idx, 1)
+        if row is None:
+            continue
+        val = row["Value"].decode()
+        with lock:
+            seen.setdefault(val, []).append(now)
+
+
+def run_point(n_watchers: int, writes: int, pace_s: float,
+              data_root: str, seed: int = 0) -> dict:
+    from consul_tpu import introspect
+    from consul_tpu.chaos_live import LiveCluster
+    from consul_tpu.trace import new_trace_id
+
+    cluster = LiveCluster(n=3, data_root=data_root)
+    stop = threading.Event()
+    threads = []
+    try:
+        cluster.start()
+        li = cluster.leader()
+        leader_url = cluster.servers[li].http
+        seen: dict = {}
+        lock = threading.Lock()
+        for w in range(n_watchers):
+            t = threading.Thread(
+                target=_watcher,
+                args=(cluster.client(li, timeout=8.0), stop, seen,
+                      lock),
+                name=f"vis-w{w}", daemon=True)
+            threads.append(t)
+            t.start()
+        time.sleep(0.5)          # watchers park before the first write
+        writer = cluster.client(li, timeout=8.0)
+        write_ts = {}
+        for i in range(writes):
+            val = f"v{seed}.{i}"
+            write_ts[val] = time.time()
+            writer.kv_put(PROBE_KEY, val.encode())
+            time.sleep(pace_s)
+        time.sleep(1.0)          # drain the last deliveries
+        stop.set()
+        # one traced write proves the correlation end to end: its id
+        # must appear on the leader's kv.visibility.* spans
+        tid = new_trace_id()
+        import urllib.request
+        req = urllib.request.Request(
+            f"{leader_url}/v1/kv/{PROBE_KEY}", data=b"traced",
+            method="PUT", headers={"X-Consul-Trace-Id": tid})
+        urllib.request.urlopen(req, timeout=8.0).read()
+        time.sleep(0.3)
+        spans = json.loads(urllib.request.urlopen(
+            f"{leader_url}/v1/agent/traces?trace_id={tid}",
+            timeout=8.0).read())
+        # scrape AFTER the load: the point's stage quantiles
+        scrape = introspect.scrape_node(leader_url)
+        with lock:
+            lat_ms = [
+                (ts - write_ts[v]) * 1000.0
+                for v, stamps in seen.items() if v in write_ts
+                for ts in stamps]
+            delivered = sum(len(s) for v, s in seen.items()
+                            if v in write_ts)
+        return {
+            "watchers": n_watchers, "writes": writes,
+            "deliveries": delivered,
+            "end_to_end_ms": {
+                "p50": round(pctl(lat_ms, 0.5), 3),
+                "p99": round(pctl(lat_ms, 0.99), 3),
+                "max": round(max(lat_ms), 3) if lat_ms else 0.0},
+            "stages_ms": introspect.visibility_stages(
+                scrape["metrics"]),
+            "replication_lag": introspect.replication_lag(
+                scrape["metrics"]),
+            "correlated_trace": {
+                "trace_id": tid,
+                "spans": sorted({s["name"] for s in spans}),
+            },
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=3.0)
+        cluster.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--watchers", type=int, nargs="+",
+                    default=[1, 8, 32])
+    ap.add_argument("--writes", type=int, default=60)
+    ap.add_argument("--pace", type=float, default=0.05,
+                    help="seconds between writes")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact here (e.g. "
+                         "VISIBILITY_r01.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="bounded smoke: one tiny sweep point, shape "
+                         "asserts, no artifact unless --out")
+    args = ap.parse_args(argv)
+    if args.check:
+        args.watchers, args.writes = [2], 8
+
+    import tempfile
+    rows = []
+    for n in args.watchers:
+        with tempfile.TemporaryDirectory(
+                prefix=f"vis-probe-{n}-") as tmp:
+            row = run_point(n, args.writes, args.pace, tmp, seed=n)
+        rows.append(row)
+        print(json.dumps(row))
+    artifact = {
+        "metric": "kv_visibility",
+        "rows": rows,
+        "cores": os.cpu_count() or 1,
+        "analysis": (
+            "Write->watch-delivery latency on the live 3-process "
+            "cluster, per parked-watcher count.  end_to_end_ms is the "
+            "client-observed PUT->blocking-GET-return; stages_ms are "
+            "the leader's consul.kv.visibility histograms (each stage "
+            "measured from the raft apply).  Thread-per-connection "
+            "watchers: this curve is the baseline the ROADMAP item 2 "
+            "event-driven front must beat."),
+    }
+    if args.check:
+        row = rows[0]
+        ok = (row["deliveries"] > 0
+              and row["end_to_end_ms"]["p50"] > 0.0
+              and "wakeup" in row["stages_ms"]
+              and "flush" in row["stages_ms"]
+              and any(s.startswith("kv.visibility")
+                      for s in row["correlated_trace"]["spans"]))
+        print(json.dumps({"check": "visibility_probe", "ok": ok}))
+        if not ok:
+            return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
