@@ -70,6 +70,17 @@ def _classify_oserror(e: BaseException, url: str) -> ApiError:
     return ApiTimeoutError(f"{url}: {e}")
 
 
+def consistency_params(stale: bool = False,
+                       max_stale: Optional[str] = None,
+                       consistent: bool = False) -> dict:
+    """Query params for the read plane's consistency modes (the
+    reference's QueryOptions AllowStale / MaxStaleDuration /
+    RequireConsistent).  `max_stale` implies stale."""
+    return {"stale": "" if (stale or max_stale) else None,
+            "max_stale": max_stale,
+            "consistent": "" if consistent else None}
+
+
 class Client:
     def __init__(self, address: str = "http://127.0.0.1:8500",
                  token: Optional[str] = None,
@@ -77,6 +88,11 @@ class Client:
         self.address = address.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # consistency metadata of the LAST response (X-Consul-
+        # KnownLeader / X-Consul-LastContact) — how stale the data the
+        # server handed back may be (api.QueryMeta role)
+        self.last_known_leader: Optional[bool] = None
+        self.last_contact_ms: Optional[int] = None
 
     # ------------------------------------------------------------- transport
 
@@ -95,6 +111,12 @@ class Client:
                     else self.timeout) as resp:
                 raw = resp.read()
                 idx = int(resp.headers.get("X-Consul-Index") or 0)
+                kl = resp.headers.get("X-Consul-KnownLeader")
+                if kl is not None:
+                    self.last_known_leader = kl == "true"
+                lc = resp.headers.get("X-Consul-LastContact")
+                if lc is not None:
+                    self.last_contact_ms = int(lc)
                 ctype = resp.headers.get("Content-Type", "")
                 if "json" in ctype:
                     return (json.loads(raw) if raw else None), idx, raw
@@ -132,12 +154,14 @@ class Client:
 
     def kv_get(self, key: str, index: Optional[int] = None,
                wait: Optional[str] = None,
-               consistent: bool = False) -> Tuple[Optional[dict], int]:
+               consistent: bool = False, stale: bool = False,
+               max_stale: Optional[str] = None
+               ) -> Tuple[Optional[dict], int]:
         try:
-            out, idx, _ = self._call("GET", f"/v1/kv/{key}",
-                                     {"index": index, "wait": wait,
-                                      "consistent": "" if consistent
-                                      else None})
+            out, idx, _ = self._call(
+                "GET", f"/v1/kv/{key}",
+                {"index": index, "wait": wait,
+                 **consistency_params(stale, max_stale, consistent)})
         except ApiError as e:
             if e.code == 404:
                 return None, 0
@@ -146,17 +170,21 @@ class Client:
         row["Value"] = base64.b64decode(row["Value"]) if row["Value"] else b""
         return row, idx
 
-    def kv_list(self, prefix: str) -> List[dict]:
-        return self.kv_list_blocking(prefix)[0]
+    def kv_list(self, prefix: str, stale: bool = False,
+                max_stale: Optional[str] = None) -> List[dict]:
+        return self.kv_list_blocking(prefix, stale=stale,
+                                     max_stale=max_stale)[0]
 
     def kv_list_blocking(self, prefix: str, index: Optional[int] = None,
-                         wait: Optional[str] = None):
+                         wait: Optional[str] = None, stale: bool = False,
+                         max_stale: Optional[str] = None):
         """Recurse read returning (rows, index) — the watch-loop shape
         (one return type; kv_list is the rows-only convenience)."""
         try:
-            out, idx, _ = self._call("GET", f"/v1/kv/{prefix}",
-                                     {"recurse": "", "index": index,
-                                      "wait": wait})
+            out, idx, _ = self._call(
+                "GET", f"/v1/kv/{prefix}",
+                {"recurse": "", "index": index, "wait": wait,
+                 **consistency_params(stale, max_stale)})
         except ApiError as e:
             if e.code == 404:
                 return [], 0
@@ -183,18 +211,25 @@ class Client:
     # --------------------------------------------------------------- catalog
 
     def catalog_nodes(self, near: Optional[str] = None,
-                      filter: Optional[str] = None) -> List[dict]:
-        return self._call("GET", "/v1/catalog/nodes",
-                          {"near": near, "filter": filter})[0]
+                      filter: Optional[str] = None, stale: bool = False,
+                      max_stale: Optional[str] = None) -> List[dict]:
+        return self._call(
+            "GET", "/v1/catalog/nodes",
+            {"near": near, "filter": filter,
+             **consistency_params(stale, max_stale)})[0]
 
     def catalog_services(self) -> Dict[str, List[str]]:
         return self._call("GET", "/v1/catalog/services")[0]
 
     def catalog_service(self, name: str, tag: Optional[str] = None,
                         near: Optional[str] = None,
-                        filter: Optional[str] = None) -> List[dict]:
-        return self._call("GET", f"/v1/catalog/service/{name}",
-                          {"tag": tag, "near": near, "filter": filter})[0]
+                        filter: Optional[str] = None,
+                        stale: bool = False,
+                        max_stale: Optional[str] = None) -> List[dict]:
+        return self._call(
+            "GET", f"/v1/catalog/service/{name}",
+            {"tag": tag, "near": near, "filter": filter,
+             **consistency_params(stale, max_stale)})[0]
 
     def catalog_register(self, node: str, address: str,
                          service: Optional[dict] = None,
@@ -222,9 +257,13 @@ class Client:
                        near: Optional[str] = None,
                        index: Optional[int] = None,
                        wait: Optional[str] = None,
-                       filter: Optional[str] = None) -> Tuple[List[dict], int]:
+                       filter: Optional[str] = None,
+                       stale: bool = False,
+                       max_stale: Optional[str] = None
+                       ) -> Tuple[List[dict], int]:
         params = {"tag": tag, "near": near, "index": index, "wait": wait,
-                  "filter": filter}
+                  "filter": filter,
+                  **consistency_params(stale, max_stale)}
         if passing:
             params["passing"] = ""
         out, idx, _ = self._call("GET", f"/v1/health/service/{name}", params)

@@ -34,13 +34,23 @@ from typing import Optional
 from consul_tpu.utils.net import shutdown_and_close
 
 # query params that force the legacy path for /v1/kv (blocking reads,
-# recursion, listings, cross-dc, filtered or cached semantics)
+# recursion, listings, cross-dc, filtered or cached semantics).
+# ?stale / ?max_stale deliberately ABSENT: the stale follower read is
+# the read plane's hot path (readplane.py) and is served inline below;
+# only a violated max_stale bound falls back so the legacy handler
+# shapes the 500 + rejected counter + flight event.
 _KV_COLD_PARAMS = frozenset((
-    "recurse", "keys", "index", "wait", "consistent", "stale", "dc",
+    "recurse", "keys", "index", "wait", "consistent", "dc",
     "filter", "cached", "separator", "raw", "near",
 ))
 
 _HOP = b"HTTP/1.1 "
+
+# hoisted hot-path telemetry keys (one tuple/dict per PROCESS, not per
+# request — the readplane mode counter rides every hot GET)
+_RP_STALE = ("readplane", "stale")
+_RP_DEFAULT = ("readplane", "default")
+_RP_KV_LABELS = {"route": "kv"}
 
 
 class _FakeSock:
@@ -295,6 +305,25 @@ class FastKVServer:
         store = srv.store
         from consul_tpu import telemetry
         import time as _time
+        # read-plane mode resolution for the hot GET (readplane.py):
+        # ?stale serves this replica inline unless its lag violates
+        # ?max_stale (legacy path shapes that 500); a default-mode GET
+        # on a follower with a configured fleet map must leader-forward
+        # — also legacy.  The discipline rule holds: nothing below
+        # performs a leader RPC for a stale read.
+        stale = "stale" in q or "max_stale" in q
+        if verb == "GET":
+            rp = srv.readplane
+            if stale:
+                if not rp.hot_stale_ok(q):
+                    return False
+                telemetry.incr_counter(_RP_STALE,
+                                       labels=_RP_KV_LABELS)
+            else:
+                if not rp.hot_default_ok():
+                    return False
+                telemetry.incr_counter(_RP_DEFAULT,
+                                       labels=_RP_KV_LABELS)
         # parse numeric params BEFORE counting/handling: malformed
         # values fall back so the legacy path shapes the 400 (and is
         # the only one to count the request)
@@ -322,10 +351,11 @@ class FastKVServer:
             if verb == "GET":
                 if not authz.key_read(key):
                     return self._plain(conn, 403, b"Permission denied")
+                meta = self._read_meta()
                 e = store.kv_get(key)
                 if not e:
                     return self._plain(conn, 404, b"",
-                                       index=store.index)
+                                       index=store.index, meta=meta)
                 # serialized-row cache: hot keys re-read far more often
                 # than they change (the VERDICT's "cache serialized hot
                 # responses" lever); keyed by modify_index so any write
@@ -338,7 +368,8 @@ class FastKVServer:
                     if len(self._row_cache) > 4096:
                         self._row_cache.clear()
                     self._row_cache[ck] = hit
-                return self._raw_json(conn, hit, index=store.index)
+                return self._raw_json(conn, hit, index=store.index,
+                                      meta=meta)
             if verb == "PUT":
                 if not authz.key_write(key):
                     return self._plain(conn, 403, b"Permission denied")
@@ -385,28 +416,43 @@ class FastKVServer:
                413: b"Payload Too Large",
                500: b"Internal Server Error"}
 
+    def _read_meta(self) -> bytes:
+        """The consistency headers every read response carries
+        (readplane.headers(), pre-encoded for the raw writer)."""
+        rp = self._api.readplane
+        lc = rp.last_contact_ms()
+        return (b"X-Consul-KnownLeader: "
+                + (b"true" if rp.known_leader() else b"false")
+                + b"\r\nX-Consul-LastContact: "
+                + str(int(lc) if lc != float("inf") else 0).encode()
+                + b"\r\n")
+
     def _write(self, conn, code: int, payload: bytes, ctype: bytes,
-               index: Optional[int]) -> bool:
+               index: Optional[int], meta: bytes = b"") -> bool:
         idx = index if index is not None else self._api.store.index
         conn.sendall(
             _HOP + str(code).encode() + b" "
             + self._REASON.get(code, b"X") + b"\r\n"
             b"Content-Type: " + ctype + b"\r\n"
             b"Content-Length: " + str(len(payload)).encode() + b"\r\n"
-            b"X-Consul-Index: " + str(idx).encode() + b"\r\n\r\n"
+            b"X-Consul-Index: " + str(idx).encode() + b"\r\n"
+            + meta + b"\r\n"
             + payload)
         return True
 
-    def _json(self, conn, obj, index: Optional[int] = None) -> bool:
+    def _json(self, conn, obj, index: Optional[int] = None,
+              meta: bytes = b"") -> bool:
         return self._write(conn, 200, json.dumps(obj).encode(),
-                           b"application/json", index)
+                           b"application/json", index, meta)
 
     def _raw_json(self, conn, payload: bytes,
-                  index: Optional[int] = None) -> bool:
+                  index: Optional[int] = None,
+                  meta: bytes = b"") -> bool:
         return self._write(conn, 200, payload, b"application/json",
-                           index)
+                           index, meta)
 
     def _plain(self, conn, code: int, payload: bytes,
-               index: Optional[int] = None) -> bool:
+               index: Optional[int] = None,
+               meta: bytes = b"") -> bool:
         return self._write(conn, code, payload,
-                           b"application/octet-stream", index)
+                           b"application/octet-stream", index, meta)

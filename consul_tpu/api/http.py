@@ -188,6 +188,17 @@ class ApiServer:
         from consul_tpu.cache import Cache as AgentCache
         self.agent_cache = AgentCache()
         self._register_cache_types()
+        # read plane (consul_tpu/readplane.py): consistency-mode
+        # resolution for every read route — ?stale serves the local
+        # replica (lag-bounded by ?max_stale), ?consistent barriers,
+        # and default-mode reads on a follower forward to the leader
+        # WHEN the fleet HTTP map is configured (cluster_nodes doubles
+        # as the leader-forward route table; without it a standalone
+        # node serves locally, the pre-readplane behavior)
+        from consul_tpu.readplane import ReadPlane
+        self.readplane = ReadPlane(
+            store, node_name=node_name,
+            cluster_nodes_fn=lambda: self.cluster_nodes)
         handler = _make_handler(self)
         # Custom threaded front: hot KV ops on a minimal parser, every
         # other route replayed through `handler` byte-for-byte — the
@@ -505,7 +516,16 @@ def _make_handler(srv: ApiServer):
             self.send_header("Content-Length", str(len(payload)))
             self.send_header("X-Consul-Index",
                              str(index if index is not None else store.index))
-            for k, v in (extra_headers or {}).items():
+            extra = extra_headers or {}
+            if getattr(self, "command", "") == "GET":
+                # consistency metadata on every read response
+                # (agent/http.go setMeta); a leader-forwarded response
+                # passes the LEADER's values through extra_headers —
+                # they describe the node that executed the read
+                for k, v in srv.readplane.headers().items():
+                    if k not in extra:
+                        self.send_header(k, v)
+            for k, v in extra.items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(payload)
@@ -1015,6 +1035,54 @@ def _make_handler(srv: ApiServer):
                            "/v1/query", "/v1/session/", "/v1/coordinate/",
                            "/v1/event/", "/v1/txn")
 
+        # set per-request in _dispatch; class default covers error
+        # paths that _send before resolution ran
+        _read_mode = "default"
+
+        def _forward_leader(self, verb: str, path: str, q) -> bool:
+            """Default-consistency read on a follower: replay against
+            the leader's HTTP surface (the read half of ForwardRPC,
+            rpc.go:549).  The X-Consul-Read-Forwarded hop marker stops
+            a stale leader hint from looping; the leader's consistency
+            headers pass through — they describe the node that
+            actually executed the read."""
+            import urllib.error
+            import urllib.request
+            addr = srv.readplane.leader_http()
+            if addr is None:
+                self._err(500, "No cluster leader")
+                return True
+            qs = urllib.parse.urlencode(q)
+            url = addr + urllib.parse.quote(path) \
+                + (f"?{qs}" if qs else "")
+            req = urllib.request.Request(url, method=verb)
+            req.add_header("X-Consul-Read-Forwarded", "1")
+            if self.token:
+                req.add_header("X-Consul-Token", self.token)
+            from consul_tpu import trace
+            tid = trace.current_trace()
+            if tid:
+                req.add_header("X-Consul-Trace-Id", tid)
+            try:
+                with urllib.request.urlopen(req, timeout=330.0) as resp:
+                    raw = resp.read()
+                    meta = {k: resp.headers[k] for k in
+                            ("X-Consul-KnownLeader",
+                             "X-Consul-LastContact")
+                            if k in resp.headers}
+                    self._send(None, resp.status, raw=raw,
+                               index=int(resp.headers.get(
+                                   "X-Consul-Index") or 0),
+                               ctype=resp.headers.get("Content-Type"),
+                               extra_headers=meta)
+            except urllib.error.HTTPError as e:
+                self._err(e.code, e.read().decode(errors="replace"))
+            except OSError as e:
+                # the leader died mid-forward: surface it as the
+                # no-leader error the caller retries on
+                self._err(500, f"leader read forward failed: {e}")
+            return True
+
         def _dispatch(self, verb: str, path: str, q) -> bool:
             # compile ?filter= up front: a malformed expression must 400
             # immediately, not after a 5-minute blocking wait
@@ -1031,6 +1099,20 @@ def _make_handler(srv: ApiServer):
                     return True
                 return self._forward_dc(verb, path, q)
             q.pop("dc", None)
+            # read plane: resolve the consistency mode for every GET
+            # (consul_tpu/readplane.py) — stale serves below from the
+            # local replica, a violated ?max_stale bound rejects here,
+            # and a default-mode read on a follower forwards to the
+            # leader when the fleet HTTP map is configured
+            self._read_mode = "default"
+            if verb == "GET":
+                dec = srv.readplane.resolve(path, q, self.headers)
+                self._read_mode = dec.mode
+                if dec.action == "reject":
+                    self._err(dec.code, dec.message)
+                    return True
+                if dec.action == "forward":
+                    return self._forward_leader(verb, path, q)
             if path.startswith("/v1/kv/"):
                 return self._kv(verb, path[len("/v1/kv/"):], q)
             if path.startswith(("/v1/acl/login", "/v1/acl/logout",
@@ -1838,7 +1920,7 @@ def _make_handler(srv: ApiServer):
             if path == "/v1/catalog/nodes" and verb == "GET":
                 raw_nodes, idx, state = self._cache_or_live(
                     "catalog_nodes", "", q, store.nodes,
-                    ("nodes", ""))
+                    ("nodes", ""), view_topic="nodes")
                 rows = [{"Node": n["node"], "ID": n["id"],
                          "Address": n["address"], "Meta": n["meta"],
                          "ModifyIndex": n["modify_index"]}
@@ -1868,7 +1950,9 @@ def _make_handler(srv: ApiServer):
                     lambda: store.service_nodes(m.group(1),
                                                 tag=q.get("tag")),
                     ("services", m.group(1)), ("nodes", ""),
-                    cacheable=not q.get("tag"))
+                    cacheable=not q.get("tag"),
+                    view_topic="services", view_sub_key=m.group(1),
+                    view_disc=f"tag={q.get('tag') or ''}")
                 out = self._filtered(q, [_catalog_service_json(r)
                                          for r in rows])
                 if "near" in q:
@@ -1934,10 +2018,15 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.service_read(m.group(1)):
                     return self._forbid()
                 name = m.group(1)
-                if "cached" in q and srv.view_store is not None:
+                if ("cached" in q or self._read_mode == "stale") \
+                        and srv.view_store is not None:
                     # backend choice (rpcclient/health): Cache-Control
                     # max-age rides the request-keyed agent cache; plain
-                    # ?cached rides the streaming materialized view
+                    # ?cached — and every ?stale read, the follower
+                    # read plane's heavy-GET path — rides the streaming
+                    # materialized view, so N clients polling one
+                    # service share one Materializer + one store
+                    # subscription (agent/submatview role)
                     tag = q.get("tag")
                     passing = "passing" in q
                     hit = srv.cached_read(
@@ -3702,16 +3791,39 @@ def _make_handler(srv: ApiServer):
             return True
 
         def _cache_or_live(self, type_name, key, q, live_fn, *watches,
-                           cacheable=True):
+                           cacheable=True, view_topic=None,
+                           view_sub_key=None, view_disc=""):
             """(value, index, cache_state): the shared tail for every
             typed-cache route — cached_read's gate decides, the live
             branch blocks on `watches` like an uncached request.
             `cacheable=False` forces the live path (query variants the
-            typed key doesn't discriminate, e.g. ?tag / ?passing)."""
+            typed key doesn't discriminate, e.g. ?tag / ?passing).
+
+            `view_topic` opts the route's ?stale reads into the SHARED
+            materialized-view cache (submatview.ViewStore): N stale
+            pollers of one key share one Materializer + one publisher
+            subscription instead of N store scans per wakeup — the
+            follower read plane's heavy-GET amortization
+            (view_sub_key scopes the event subscription; None follows
+            every key on the topic; `view_disc` carries any request
+            discriminator the snapshot closure bakes in — e.g. ?tag —
+            so differently-shaped requests never share one view)."""
             hit = srv.cached_read(type_name, key, self.headers, q) \
                 if cacheable else None
             if hit is not None:
                 return hit
+            if view_topic is not None and srv.view_store is not None \
+                    and self._read_mode == "stale":
+                view = srv.view_store.get(
+                    view_topic, view_sub_key,
+                    lambda: (live_fn(), store.index),
+                    view_key=f"t:{type_name}|k:{key}|{view_disc}")
+                min_idx = int(q["index"]) if "index" in q else 0
+                rows, idx = view.fetch(
+                    min_idx,
+                    timeout=_parse_wait(q.get("wait", "300s"))
+                    if "index" in q else 0.0)
+                return rows, idx, None
             idx = self._block(q, *watches) if watches else None
             return live_fn(), idx, None
 

@@ -382,7 +382,18 @@ class StateStore:
                       node_id: str | None = None) -> int:
         """Catalog.Register node part (agent/consul/catalog_endpoint.go:144)."""
         with self._lock:
-            idx = self._bump([("nodes", node)])
+            # a node UPDATE (address/meta change) alters every catalog
+            # and health row of the services it hosts: wake their
+            # topic watchers and materialized views too (the reference
+            # folds node changes into service-health events,
+            # agent/consul/state/events.go) — without this a shared
+            # ("services", name) view serves a dead address forever
+            ev = [("nodes", node)]
+            for (n, _sid), v in self._services.items():
+                if n == node:
+                    ev += [("services", v["name"]),
+                           ("health", v["name"])]
+            idx = self._bump(ev)
             existing = self._nodes.get(node, {})
             self._nodes[node] = {
                 "address": address, "meta": meta or {},

@@ -863,9 +863,19 @@ class RegisterHistory:
     def __init__(self):
         self.ops: List[dict] = []
 
-    def invoke(self, kind: str, val: Any, now: float) -> int:
-        self.ops.append({"kind": kind, "val": val, "call": now,
-                         "ret": None, "ok": True, "discard": False})
+    def invoke(self, kind: str, val: Any, now: float,
+               stale: bool = False,
+               max_stale: Optional[float] = None) -> int:
+        """`stale=True` tags a follower read (?stale): it is checked
+        against the weaker serializable-prefix-within-max_stale model
+        instead of strict linearizability.  `max_stale` is the bound
+        in SECONDS the caller requested (None = unbounded)."""
+        op = {"kind": kind, "val": val, "call": now,
+              "ret": None, "ok": True, "discard": False}
+        if stale:
+            op["stale"] = True
+            op["max_stale"] = max_stale
+        self.ops.append(op)
         return len(self.ops) - 1
 
     def complete(self, op_id: int, now: float, val: Any = None) -> None:
@@ -886,6 +896,53 @@ class RegisterHistory:
         return [o for o in self.ops if not o["discard"]]
 
 
+def _stale_read_ok(op: dict, writes: List[dict],
+                   init: Any) -> Tuple[bool, Optional[str]]:
+    """The stale-read taxonomy (ISSUE 12): a read tagged `stale=True`
+    is NOT required to linearize — it may observe any *serializable
+    prefix* of the write order that was possibly current within
+    `max_stale` of its invocation (the reference's AllowStale +
+    MaxStaleDuration contract: a follower serves its replica, whose
+    state is some commit prefix at most its replication lag behind).
+
+    Formally: the read of value v over window [call − max_stale, ret]
+    is legal iff there is an instant τ in that window at which v was
+    POSSIBLY the committed register — v's write may have taken effect
+    by τ (w.call ≤ τ) and no acked write that is *certainly after* it
+    (w2.call ≥ w.ret) had certainly completed by τ (w2.ret ≤ τ).
+    A genuinely FORKED stale read — a value never written, or one
+    certainly overwritten before the window opened — still fails."""
+    INF = float("inf")
+    bound = op.get("max_stale")
+    t0 = op["call"] - (bound if bound is not None else INF)
+    t1 = op["ret"]
+    v = op["val"]
+
+    def certainly_dead_by(w_ret: float, tau: float) -> bool:
+        return any(w2["ok"] is True and w2["call"] >= w_ret
+                   and w2["ret"] <= tau for w2 in writes)
+
+    if v is None:
+        # the initial state: possibly current at the window's OPEN
+        # unless some acked write had certainly completed by then
+        if not certainly_dead_by(-INF, t0):
+            return True, None
+        return False, (f"stale read of initial state at "
+                       f"call={op['call']} but an acked write "
+                       f"certainly completed before its "
+                       f"max_stale={bound}s window opened")
+    for w in writes:
+        if w["val"] != v or w["call"] > t1:
+            continue
+        # earliest instant v could be current inside the window
+        tau = max(t0, w["call"])
+        if not certainly_dead_by(w["ret"], tau):
+            return True, None
+    return False, (f"stale read of {v!r} (call={op['call']}, "
+                   f"max_stale={bound}) is a fork: value never "
+                   f"possibly current within its staleness window")
+
+
 def check_linearizable(ops: List[dict],
                        init: Any = None) -> Tuple[bool, Optional[str]]:
     """Wing & Gong linearizability search for a single register.
@@ -894,12 +951,28 @@ def check_linearizable(ops: List[dict],
     forever), ok (None = ambiguous write: may apply anywhere after its
     call, or never).  Memoized on (remaining-ops, register value); the
     harness keeps histories small and concurrency bounded, so the
-    search stays well under the exponential worst case."""
+    search stays well under the exponential worst case.
+
+    Reads tagged `stale=True` (follower ?stale reads) are verified
+    against the weaker serializable-prefix-within-max_stale model
+    (`_stale_read_ok`) and excluded from the strict search — the
+    reference never promises linearizable stale reads, only bounded
+    ones."""
     INF = float("inf")
     ops = [dict(o) for o in ops if not o.get("discard")]
     for o in ops:
         if o["ret"] is None:
             o["ret"] = INF
+    stale_reads = [o for o in ops
+                   if o["kind"] == "r" and o.get("stale")]
+    if stale_reads:
+        writes = [o for o in ops if o["kind"] == "w"]
+        for o in stale_reads:
+            ok, why = _stale_read_ok(o, writes, init)
+            if not ok:
+                return False, why
+        ops = [o for o in ops
+               if not (o["kind"] == "r" and o.get("stale"))]
     n = len(ops)
     seen = set()
 

@@ -484,7 +484,9 @@ class LiveLoad:
                  reg_writers: int = 2, readers: int = 1,
                  dur_writers: int = 2, reg_period: float = 0.3,
                  dur_period: float = 0.08,
-                 client_timeout: float = 2.5):
+                 client_timeout: float = 2.5,
+                 stale_readers: int = 0,
+                 stale_period: float = 0.15):
         self.cluster = cluster
         self.seed = seed
         self.history = RegisterHistory()
@@ -500,6 +502,14 @@ class LiveLoad:
         self.reg_period = reg_period
         self.dur_period = dur_period
         self.client_timeout = client_timeout
+        # follower read plane (ISSUE 12): ?stale GETs round-robined
+        # over EVERY node's HTTP, outcomes recorded per-op so a
+        # scenario can assert "stale reads kept serving through the
+        # fault window"; reads enter the history tagged stale=True for
+        # the serializable-prefix checker model
+        self.stale_readers = stale_readers
+        self.stale_period = stale_period
+        self.stale_ops: List[dict] = []   # {t, target, ok, lat, err}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
 
@@ -516,6 +526,10 @@ class LiveLoad:
         for d in range(self.dur_writers):
             self._threads.append(mk(target=self._dur_writer, args=(d,),
                                     name=f"load-d{d}", daemon=True))
+        for s in range(self.stale_readers):
+            self._threads.append(mk(target=self._stale_reader,
+                                    args=(s,),
+                                    name=f"load-s{s}", daemon=True))
         for t in self._threads:
             t.start()
 
@@ -596,6 +610,50 @@ class LiveLoad:
                 target = (target + 1) % self.cluster.n
             _nap(self.reg_period * (0.75 + rng.random() * 0.5))
 
+    def _stale_reader(self, rid: int) -> None:
+        """?stale GETs round-robined over every node (follower fanout):
+        the read plane's promise under test — a follower keeps
+        answering from its local replica through leader faults.  Every
+        outcome lands in `stale_ops` with its target and latency so
+        scenarios can assert zero refusals and bounded latency inside
+        a fault window; successful reads join the history tagged
+        stale=True (checked against the serializable-prefix model)."""
+        rng = random.Random((self.seed << 8) ^ (0x57A1E + rid))
+        target = rid % self.cluster.n
+        while not self._stop.is_set():
+            t = time.time()
+            with self._hlock:
+                op = self.history.invoke("r", None, t, stale=True)
+            row = {"t": t, "target": target, "ok": False,
+                   "lat": 0.0, "err": None}
+            try:
+                got, _ = self.cluster.client(
+                    target, timeout=self.client_timeout).kv_get(
+                        REG_KEY, stale=True)
+                val = got["Value"].decode() if got else None
+                row["ok"] = True
+                with self._hlock:
+                    self.history.complete(op, time.time(), val)
+                self._count("ok")
+            except ApiError as e:
+                with self._hlock:
+                    self.history.discard(op)
+                kind = ("ambiguous" if e.ambiguous
+                        else "refused" if e.code is None
+                        else "http_error")
+                row["err"] = kind
+                self._count(kind)
+            except OSError:
+                with self._hlock:
+                    self.history.discard(op)
+                row["err"] = "refused"
+                self._count("refused")
+            row["lat"] = round(time.time() - t, 4)
+            with self._clock:
+                self.stale_ops.append(row)
+            target = (target + 1) % self.cluster.n
+            _nap(self.stale_period * (0.75 + rng.random() * 0.5))
+
     def _dur_writer(self, wid: int) -> None:
         rng = random.Random((self.seed << 8) ^ (0xD00D + wid))
         target = wid % self.cluster.n
@@ -629,10 +687,14 @@ class LiveLoad:
 
 
 def _node_dump(cluster: LiveCluster, i: int) -> Optional[List[dict]]:
-    """This node's LOCAL replica view of the durability stream
-    (default-consistency reads serve the local store)."""
+    """This node's LOCAL replica view of the durability stream —
+    a ?stale read, the read plane's explicit local-replica mode
+    (default-consistency reads now leader-forward on followers when
+    the fleet HTTP map is configured, which would make every dump the
+    LEADER's view and blind the pairwise prefix check)."""
     try:
-        return cluster.client(i, timeout=3.0).kv_list(DUR_PREFIX)
+        return cluster.client(i, timeout=3.0).kv_list(DUR_PREFIX,
+                                                      stale=True)
     except (ApiError, OSError):
         return None
 
@@ -1052,6 +1114,155 @@ def live_pause_resume(seed: int, check: bool = False) -> dict:
         lv.close()
 
 
+def live_stale_reads_through_election(seed: int,
+                                      check: bool = False) -> dict:
+    """The follower read plane under fire (ISSUE 12 acceptance):
+
+      phase 1  kill -9 the leader with stale-read load fanned out over
+               every node: ?stale GETs against the SURVIVORS keep
+               succeeding through the whole election window — zero
+               refusals, latency bounded well under the client timeout
+               (a stale read never waits on an election);
+
+      phase 2  fully sever one follower from its peers: its staleness
+               bound grows with the partition, so (a) ?max_stale=1s
+               reads against it start REJECTING with 500 once its lag
+               exceeds the bound (consul.readplane.rejected +
+               readplane.rejected flight events in the merged
+               timeline), (b) plain ?stale reads against it KEEP
+               serving its frozen replica, and (c) ?consistent reads
+               against it 500 leaderless once its election timer fires
+               and it drops the leader hint.
+
+    The standard checkers still run over everything: stale reads enter
+    the history tagged stale=True (serializable-prefix model),
+    writes/consistent-reads stay strictly linearizable."""
+    lv = _Live("live_stale_reads_through_election", seed, check=check,
+               budget_s=120 if check else 300,
+               load_kw={"stale_readers": 2})
+    try:
+        lv.start()
+        lv.run_for(1.5)
+        # ---- phase 1: leader kill under stale fanout
+        li = lv.cluster.leader()
+        window = lv.draw("dead_window", 2.0, 2.5 if check else 3.5)
+        t_kill = time.time()
+        lv.fault("kill9", f"server{li}")
+        lv.cluster.kill(li)
+        lv.run_for(window)
+        t_heal = time.time()
+        lv.fault("restart", f"server{li}")
+        lv.cluster.restart(li)
+        if not lv.cluster.wait_http(li):
+            lv.violations.append(
+                f"server{li} HTTP never came back after restart")
+        lv.run_for(1.5)
+        with lv.load._clock:
+            rows = [dict(r) for r in lv.load.stale_ops]
+        in_window = [r for r in rows
+                     if t_kill <= r["t"] <= t_heal
+                     and r["target"] != li]
+        lv.detail["stale_reads_in_window"] = len(in_window)
+        if not in_window:
+            lv.violations.append(
+                "stale plane: no stale reads landed on survivors "
+                "during the leader-dead window (load too thin to "
+                "prove anything)")
+        failed = [r for r in in_window if not r["ok"]]
+        if failed:
+            lv.violations.append(
+                f"stale plane: {len(failed)}/{len(in_window)} stale "
+                f"GETs against SURVIVING followers failed during the "
+                f"leader-dead window — the follower read plane must "
+                f"keep serving through an election "
+                f"(first: {failed[0]})")
+        slow = [r for r in in_window
+                if r["lat"] > lv.load.client_timeout * 0.8]
+        if slow:
+            lv.violations.append(
+                f"stale plane: {len(slow)} stale GETs took "
+                f">{lv.load.client_timeout * 0.8:.1f}s during the "
+                f"election — a local replica read must never wait "
+                f"out an election")
+        # ---- phase 2: severed follower — bounded staleness enforced
+        li2 = lv.cluster.leader()
+        followers = [i for i in range(lv.cluster.n) if i != li2]
+        victim = followers[lv.pick("sever_pick", len(followers))]
+        lv.fault("sever", f"server{victim}")
+        lv.cluster.sever_node(victim)
+        vc = lv.cluster.client(victim, timeout=2.5)
+        # (a) max_stale rejects fire once lag exceeds the bound
+        deadline = time.time() + 15.0
+        saw_reject = False
+        while time.time() < deadline and not saw_reject:
+            try:
+                vc.kv_get(REG_KEY, max_stale="1s")
+            except ApiError as e:
+                if e.code == 500 and "max_stale" in e.body:
+                    saw_reject = True
+                    break
+            except OSError:
+                pass
+            _nap(0.3)
+        if not saw_reject:
+            lv.violations.append(
+                "stale plane: ?max_stale=1s against a follower "
+                "severed >15s never rejected — the lag bound is not "
+                "enforced")
+        # (b) plain ?stale keeps serving the frozen replica
+        stale_ok = False
+        try:
+            vc.kv_get(REG_KEY, stale=True)
+            stale_ok = True
+        except (ApiError, OSError):
+            pass
+        if not stale_ok:
+            lv.violations.append(
+                "stale plane: plain ?stale against the severed "
+                "follower failed — unbounded stale reads must keep "
+                "serving the local replica")
+        # (c) ?consistent 500s leaderless on the severed follower
+        deadline = time.time() + 15.0
+        consistent_500 = False
+        while time.time() < deadline and not consistent_500:
+            try:
+                vc.kv_get(REG_KEY, consistent=True)
+            except ApiError as e:
+                if e.code is not None and e.code >= 500:
+                    consistent_500 = True
+                    break
+            except OSError:
+                pass
+            _nap(0.3)
+        if not consistent_500:
+            lv.violations.append(
+                "stale plane: ?consistent against the leaderless "
+                "severed follower never 500ed — it must fail loud, "
+                "not serve stale data")
+        lv.heal_mark(f"server{victim}")
+        lv.cluster.heal()
+        lv.run_for(2.0 if check else 3.0)
+        lv.detail["phase2"] = {"severed": f"server{victim}",
+                               "max_stale_reject": saw_reject,
+                               "stale_served": stale_ok,
+                               "consistent_500": consistent_500}
+        row = lv.finish()
+        # the merged cluster timeline must carry the reject events —
+        # the flight-recorder proof the rejects actually fired where
+        # they were injected
+        rejects = lv.collector.count("readplane.rejected")
+        row["detail"]["readplane_rejected_events"] = rejects
+        if saw_reject and rejects < 1:
+            row["violations"].append(
+                "stale plane: max_stale rejects observed over HTTP "
+                "but no readplane.rejected event reached the merged "
+                "flight timeline")
+            row["ok"] = False
+        return row
+    finally:
+        lv.close()
+
+
 def live_gateway_loss(seed: int, check: bool = False) -> dict:
     """Mesh-gateway death during cross-DC forwarding: dc1 reaches dc2
     ONLY through dc2's gateway (wanfed); the nemesis kills the gateway
@@ -1213,6 +1424,8 @@ LIVE_SCENARIOS = {
     "live_torn_disk_restart": live_torn_disk_restart,
     "live_pause_resume": live_pause_resume,
     "live_gateway_loss": live_gateway_loss,
+    "live_stale_reads_through_election":
+        live_stale_reads_through_election,
 }
 
 # the bounded tier-1 smoke (chaos_soak --check): kill -9 the leader,

@@ -302,6 +302,12 @@ class RaftNode:
         # per-peer replication-lag-in-ms gauge — the age of the oldest
         # entry a follower has not acked.  Pruned below min(match).
         self._append_ts: List[Tuple[int, float]] = []
+        # (log index, receive ts) of FOLLOWER-side appends: the feed
+        # for this replica's own staleness bound (readplane max_stale
+        # enforcement) — the age of the oldest entry received from the
+        # leader but not yet applied.  Pruned below last_applied.
+        self._recv_ts: List[Tuple[int, float]] = []
+        self._self_lag_due = 0.0
         # telemetry staging: helpers that run under self._lock append
         # (kind, name, value) here and tick()/apply_many() flush AFTER
         # releasing it — sink emission (UDP sendto per configured sink)
@@ -436,6 +442,55 @@ class RaftNode:
     def is_leader(self) -> bool:
         with self._lock:
             return self.state == LEADER
+
+    # ------------------------------------------------- replica staleness
+
+    @property
+    def known_leader(self) -> bool:
+        """Whether this node currently knows of a leader (itself
+        included) — the X-Consul-KnownLeader header's source."""
+        return self.leader_id is not None
+
+    def last_contact_s(self, now: Optional[float] = None) -> float:
+        """Seconds since this node last heard from a valid leader
+        (0.0 on the leader itself) — the X-Consul-LastContact header's
+        source.  inf before any contact.  Lock-free: scalar reads are
+        GIL-atomic and this sits on the stale-read hot path."""
+        if self.state == LEADER:
+            return 0.0
+        now = _time.time() if now is None else now
+        lc = self._last_contact
+        if lc <= -1e17:
+            return float("inf")
+        return max(0.0, now - lc)
+
+    def staleness(self, now: Optional[float] = None) -> float:
+        """Upper bound, in seconds, on how far this replica's readable
+        state may trail an acked write — what ?max_stale is enforced
+        against (readplane).  The leader is 0 by definition.  A
+        follower's bound is the worse of:
+
+          * time since last leader contact (everything the leader
+            acked since then is invisible here), and
+          * age of the oldest entry RECEIVED but not yet applied
+            (the `_recv_ts` ring, the follower-side sibling of the
+            leader's `_append_ts` lag machinery).
+        """
+        if self.state == LEADER:
+            return 0.0
+        now = _time.time() if now is None else now
+        age = self.last_contact_s(now)
+        # oldest received-but-unapplied entry; the ring is pruned
+        # below last_applied by the apply loop, so its head IS the
+        # oldest candidate (snapshot the list ref — it may be swapped,
+        # never mutated in place, under the raft lock)
+        rt = self._recv_ts
+        la = self.last_applied
+        for idx, ts in rt[:8]:
+            if idx > la:
+                age = max(age, now - ts)
+                break
+        return age
 
     def _flush_metrics(self) -> None:
         """Emit staged metrics + flight events; call with the raft
@@ -604,6 +659,18 @@ class RaftNode:
             self._advance_commit()
             self._apply_committed()
             self._maybe_compact()
+            if self.state == FOLLOWER and now >= self._self_lag_due:
+                # follower lag self-report at heartbeat cadence: the
+                # node's own staleness bound (last-contact age ∨ oldest
+                # unapplied age) — what its readplane enforces
+                # ?max_stale against and cluster_top renders next to
+                # the leader-side per-peer gauges
+                self._self_lag_due = now + self.cfg.heartbeat_interval
+                lag_s = self.staleness(now)
+                if lag_s < 1e12:        # no-contact sentinel: skip
+                    self._metrics_buf.append(
+                        ("g", ("raft", "replication", "self_lag_ms"),
+                         round(lag_s * 1000.0, 3)))
         self._flush_metrics()
 
     # -------------------------------------------------------------- internal
@@ -706,6 +773,7 @@ class RaftNode:
             # caught-up peer to a stale pre-deposition timestamp
             self._append_ts.clear()
             self._append_ts.append((self.last_log_index, now))
+            self._recv_ts.clear()       # a leader is 0-stale by definition
             self.match_index[self.node_id] = self.last_log_index
             self._heartbeat_due = now
             self._broadcast_append(now)
@@ -886,6 +954,12 @@ class RaftNode:
                                    ent.get("noop", False))
                         self.log.append(e)
                         self._persist_entry(idx, e)
+                        # receive stamp for the follower's own
+                        # staleness bound; capped like _append_ts so a
+                        # stalled apply loop cannot grow it unbounded
+                        self._recv_ts.append((idx, now))
+                        if len(self._recv_ts) > 4096:
+                            del self._recv_ts[:2048]
                 if msg["leader_commit"] > self.commit_index:
                     self.commit_index = min(msg["leader_commit"],
                                             self.last_log_index)
@@ -950,6 +1024,10 @@ class RaftNode:
                 self.log = []
                 self.commit_index = max(self.commit_index, self.log_base)
                 self.last_applied = max(self.last_applied, self.log_base)
+                # the restored snapshot IS applied state: stale receive
+                # stamps below it would fake an unapplied backlog
+                self._recv_ts = [p for p in self._recv_ts
+                                 if p[0] > self.last_applied]
                 if self.store is not None:
                     # durable before the ack: the leader stops
                     # re-sending once it sees last_index.  Journal a
@@ -1012,6 +1090,14 @@ class RaftNode:
                     ("s", ("raft", "fsm", "apply"),
                      _time.perf_counter() - t0))
             self.applied_index_log.append(self.last_applied)
+            # prune the follower receive-stamp ring: applied entries
+            # can never be a staleness head again
+            rt = self._recv_ts
+            if rt and rt[0][0] <= self.last_applied:
+                drop = 0
+                while drop < len(rt) and rt[drop][0] <= self.last_applied:
+                    drop += 1
+                del rt[:drop]
             pend = self._pending.pop(self.last_applied, None)
             if pend is not None:
                 # append → quorum commit → FSM apply latency, observed

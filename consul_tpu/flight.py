@@ -125,6 +125,13 @@ CATALOG: Dict[str, dict] = {
                                "labels": ("topic", "depth")},
     "stream.subscriber.reset": {"severity": "warn",
                                 "labels": ("topic", "key")},
+    # read plane (consul_tpu/readplane.py): a read this node REFUSED —
+    # ?max_stale bound exceeded by the replica's own lag, default-mode
+    # read with no cluster leader, conflicting modes, or a stale
+    # leader-forward hint bouncing off a non-leader.  The chaos
+    # timeline's proof that lag-bounded rejects fire when they must.
+    "readplane.rejected": {"severity": "warn",
+                           "labels": ("reason", "route", "node")},
 }
 
 

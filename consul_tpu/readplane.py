@@ -1,0 +1,286 @@
+"""Read plane: consistency-mode resolution for every read route.
+
+The reference serves most production read traffic from FOLLOWERS: a
+`?stale` query may be answered by any server from its local replica
+(agent/consul/rpc.go:~880 canServeReadRequest), `?consistent` adds a
+leader barrier, and the default mode is leader-verified — a non-leader
+server forwards the RPC to the leader (rpc.go:549 ForwardRPC).  Every
+read response carries `X-Consul-KnownLeader` and `X-Consul-LastContact`
+so the CALLER can judge the staleness it was served
+(agent/http.go setMeta; website/content/api-docs/features/consistency).
+
+This module is that policy, factored into one object the HTTP layer
+(api/http.py `_dispatch`, api/fastfront.py hot path) consults per
+request:
+
+  mode        resolved from the query string: `default` / `?stale` /
+              `?consistent` (`?max_stale=<dur>` implies stale, the
+              reference's MaxStaleDuration semantics); requesting
+              stale AND consistent together is a 400.
+
+  stale       served LOCALLY from this node's replicated store —
+              never a leader RPC (the readplane-discipline lint rule
+              enforces the never statically).  `?max_stale` bounds it:
+              the node's own staleness estimate
+              (raft.staleness(): last-leader-contact age ∨ oldest
+              received-but-unapplied entry age, the follower-side
+              sibling of the PR 10 `_append_ts` lag machinery) must
+              not exceed the caller's bound, else the read is REJECTED
+              with 500 (`consul.readplane.rejected{reason="max_stale"}`
+              + a `readplane.rejected` flight event).  The reference
+              re-forwards to the leader instead; rejecting keeps the
+              contract visible and lets a client-side LB retry a
+              fresher replica.
+
+  consistent  the existing leader barrier (api/http.py `_consistent`);
+              500s leaderless.
+
+  default     leader-verified.  On a follower whose fleet HTTP map is
+              configured (`ApiServer.cluster_nodes` — the same fixed,
+              never-caller-supplied set the federation endpoint uses),
+              the request is FORWARDED to the leader's HTTP surface;
+              leaderless, it 500s like the reference's
+              structs.ErrNoLeader.  Without the fleet map (standalone
+              agents, in-process rigs) the node serves locally — the
+              pre-readplane behavior, kept so a lone agent stays
+              useful.
+
+Metrics: `consul.readplane.{stale,consistent,default}{route}` count
+mode resolution per route family, `consul.readplane.forward{route}`
+counts default-mode leader forwards (the counter the "stale reads do
+NO leader RPC" acceptance asserts against), and
+`consul.readplane.rejected{reason}` counts refusals.  Route-family
+labels are a bounded vocabulary (the /v1 surface's first segment).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from consul_tpu import telemetry
+
+# routes whose reads are REPLICATED state and honor the consistency
+# modes (the reference's blockingQuery surface); /v1/agent, /v1/status,
+# /v1/operator and friends are node-local by design and never forward
+LEADER_READ_PREFIXES = (
+    "/v1/kv/", "/v1/catalog/", "/v1/health/", "/v1/session/",
+    "/v1/coordinate/", "/v1/query",
+)
+
+# bounded route-family vocabulary for the {route} label
+_FAMILIES = ("kv", "catalog", "health", "session", "coordinate",
+             "query", "txn", "agent", "status", "acl", "event",
+             "config", "connect", "internal", "operator", "snapshot")
+
+_HDR_FORWARDED = "X-Consul-Read-Forwarded"
+
+
+def route_family(path: str) -> str:
+    """`/v1/<family>/...` → bounded label value ("other" off-surface)."""
+    parts = path.split("/", 3)
+    fam = parts[2] if len(parts) > 2 and parts[1] == "v1" else ""
+    return fam if fam in _FAMILIES else "other"
+
+
+def parse_max_stale(val: str) -> float:
+    from consul_tpu.utils.duration import parse_duration
+    return parse_duration(val, 10.0)
+
+
+class ReadDecision:
+    """resolve()'s verdict for one read request."""
+
+    __slots__ = ("mode", "route", "action", "code", "message", "reason")
+
+    def __init__(self, mode: str, route: str, action: str = "local",
+                 code: int = 0, message: str = "",
+                 reason: str = ""):
+        self.mode = mode            # default | stale | consistent
+        self.route = route          # bounded family label
+        self.action = action        # local | forward | reject
+        self.code = code            # HTTP status when action == reject
+        self.message = message
+        self.reason = reason        # rejected{reason} label value
+
+    @property
+    def is_stale(self) -> bool:
+        return self.mode == "stale"
+
+
+class ReadPlane:
+    """Per-ApiServer consistency policy over a duck-typed store.
+
+    `store` may be a raft-backed Server (read_staleness / known_leader /
+    leader_id / is_leader) or a bare StateStore (trivially leader-like:
+    0-stale, leader always "known").  `cluster_nodes_fn` returns the
+    fleet's {node name: http url} map (ApiServer.cluster_nodes) or
+    None — the leader-forward route table."""
+
+    def __init__(self, store, node_name: str = "",
+                 cluster_nodes_fn: Optional[Callable[[], Optional[Dict[str, str]]]] = None):
+        self.store = store
+        self.node_name = node_name
+        self._cluster_nodes = cluster_nodes_fn or (lambda: None)
+
+    # ------------------------------------------------------------- state
+
+    @property
+    def raft_backed(self) -> bool:
+        return getattr(self.store, "raft", None) is not None
+
+    def is_leader(self) -> bool:
+        if not self.raft_backed:
+            return True
+        return self.store.is_leader()
+
+    def known_leader(self) -> bool:
+        if not self.raft_backed:
+            return True
+        return bool(self.store.known_leader())
+
+    def staleness_s(self) -> float:
+        """This node's current staleness bound in seconds (0 when it
+        is the leader or a bare store)."""
+        if not self.raft_backed:
+            return 0.0
+        return float(self.store.read_staleness())
+
+    def last_contact_ms(self) -> float:
+        if not self.raft_backed:
+            return 0.0
+        return float(self.store.last_contact_ms())
+
+    def leader_http(self) -> Optional[str]:
+        """The leader's HTTP address from the fleet map, or None."""
+        nodes = self._cluster_nodes()
+        if not nodes or not self.raft_backed:
+            return None
+        lid = self.store.leader_id
+        if lid is None or lid == self.node_name:
+            return None
+        return nodes.get(lid)
+
+    # ----------------------------------------------------------- headers
+
+    def headers(self) -> Dict[str, str]:
+        """The consistency metadata stamped on every read response
+        (agent/http.go setMeta): whether a leader is known, and how
+        long ago this node last heard from it."""
+        lc = self.last_contact_ms()
+        return {
+            "X-Consul-KnownLeader":
+                "true" if self.known_leader() else "false",
+            "X-Consul-LastContact":
+                "0" if lc == float("inf") else str(int(lc)),
+        }
+
+    # ----------------------------------------------------------- resolve
+
+    def resolve(self, path: str, q, headers=None) -> ReadDecision:
+        """Resolve the consistency mode for one GET and decide where it
+        is served.  Counts the mode, counts/journals rejections, and
+        never touches the leader itself — forwarding is the CALLER's
+        move (api/http.py `_forward_leader`)."""
+        route = route_family(path)
+        if not path.startswith(LEADER_READ_PREFIXES):
+            # node-local surface: modes are inert, headers still stamp
+            return ReadDecision("default", route)
+        stale = "stale" in q or "max_stale" in q
+        consistent = "consistent" in q
+        if stale and consistent:
+            return self._reject(
+                ReadDecision("default", route), 400, "conflicting",
+                "?stale and ?consistent are mutually exclusive")
+        if stale:
+            dec = ReadDecision("stale", route)
+            self._count(dec)
+            max_stale = q.get("max_stale")
+            if max_stale is not None:
+                bound = parse_max_stale(max_stale)
+                lag = self.staleness_s()
+                if lag > bound:
+                    return self._reject(
+                        dec, 500, "max_stale",
+                        f"stale read refused: replica lag "
+                        f"{'inf' if lag == float('inf') else round(lag, 3)}s"
+                        f" exceeds max_stale {bound:g}s")
+            return dec
+        if consistent:
+            dec = ReadDecision("consistent", route)
+            self._count(dec)
+            # leaderless consistent reads fail in the barrier itself
+            # (api/http.py _consistent → 500); nothing to decide here
+            return dec
+        dec = ReadDecision("default", route)
+        self._count(dec)
+        if not self.raft_backed or self.is_leader():
+            return dec
+        forwarded = bool(headers and headers.get(_HDR_FORWARDED))
+        if forwarded:
+            # loop guard: the forwarder believed we were leader and we
+            # are not — bounce rather than chase a moving leader hint
+            return self._reject(
+                dec, 500, "not_leader",
+                "not the leader (stale read-forward hint); retry")
+        nodes = self._cluster_nodes()
+        if not nodes:
+            # no fleet route table (standalone/in-process): serve the
+            # local replica like the pre-readplane tree did — the
+            # headers still tell the caller how stale it may be
+            return dec
+        target = self.leader_http()
+        if target is None:
+            if not self.known_leader():
+                return self._reject(
+                    dec, 500, "no_leader", "No cluster leader")
+            # leader known but not in the fleet map: local, degraded
+            return dec
+        dec.action = "forward"
+        telemetry.incr_counter(("readplane", "forward"),
+                               labels={"route": route})
+        return dec
+
+    # ----------------------------------------------------------- helpers
+
+    def _count(self, dec: ReadDecision) -> None:
+        telemetry.incr_counter(("readplane", dec.mode),
+                               labels={"route": dec.route})
+
+    def _reject(self, dec: ReadDecision, code: int, reason: str,
+                message: str) -> ReadDecision:
+        dec.action = "reject"
+        dec.code = code
+        dec.reason = reason
+        dec.message = message
+        telemetry.incr_counter(("readplane", "rejected"),
+                               labels={"reason": reason})
+        from consul_tpu import flight
+        flight.emit("readplane.rejected",
+                    labels={"reason": reason, "route": dec.route,
+                            "node": self.node_name})
+        return dec
+
+    # fastfront's cheap gate: may a plain (no-param) KV GET be served
+    # inline, or must it fall back to the legacy handler for mode
+    # resolution (leader forward / no-leader reject)?
+    def hot_default_ok(self) -> bool:
+        if not self.raft_backed:
+            return True
+        if self.store.is_leader():
+            return True
+        return not self._cluster_nodes()
+
+    # fastfront's stale gate: serve ?stale inline unless a max_stale
+    # bound needs the full reject path
+    def hot_stale_ok(self, q) -> bool:
+        if "max_stale" not in q:
+            return True
+        try:
+            return self.staleness_s() <= parse_max_stale(q["max_stale"])
+        except (TypeError, ValueError):
+            return False
+
+
+def now_ms() -> float:
+    return time.time() * 1000.0

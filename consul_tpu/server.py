@@ -742,6 +742,24 @@ class Server:
             self.store.visibility.bind_trace(result["index"],
                                              trace.current_trace())
 
+    # ------------------------------------------------------- read plane
+    # The follower-read surface consul_tpu/readplane.py duck-types:
+    # a bare StateStore has none of these and is treated as 0-stale.
+
+    def read_staleness(self) -> float:
+        """Seconds this replica's readable state may trail an acked
+        write (0.0 on the leader) — the ?max_stale enforcement bound."""
+        return self.raft.staleness()
+
+    def known_leader(self) -> bool:
+        return self.raft.known_leader
+
+    def last_contact_ms(self) -> float:
+        """Milliseconds since last leader contact (0 on the leader) —
+        the X-Consul-LastContact header value."""
+        s = self.raft.last_contact_s()
+        return 0.0 if s == float("inf") else s * 1000.0
+
     def consistent_index(self, timeout: float = 5.0) -> int:
         """Leader barrier — readers wanting ?consistent semantics call this
         first (VerifyLeader / consistentRead)."""

@@ -127,13 +127,44 @@ class Materializer:
                 self._inflight -= 1
 
 
+class _ViewEntry:
+    """One shared view slot: the Materializer once ready, the
+    single-flight gate concurrent requesters park on, and the
+    last-access stamp the idle sweep judges."""
+
+    __slots__ = ("view", "last", "ready", "error")
+
+    def __init__(self, now: float):
+        self.view: Optional[Materializer] = None
+        self.last = now
+        self.ready = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
 class ViewStore:
-    """Shared view registry with idle expiry (submatview/store.go)."""
+    """SHARED cross-client materialized-view cache keyed on
+    (topic, key, view_key) with single-flight materialization
+    (submatview/store.go Store).
+
+    Promotion contract (ISSUE 12 tentpole): N concurrent clients
+    polling the same service share ONE Materializer and ONE publisher
+    subscription — the first requester materializes, every concurrent
+    requester for the same key parks on the entry's gate instead of
+    re-materializing (single-flight), and requesters for OTHER keys
+    never wait behind it (the registry lock is held only for dict
+    ops, never across a snapshot).  `consul.cache.hit/miss{type}`
+    counts the sharing ratio per topic; idle views reap on TTL unless
+    a blocking reader has them pinned (`_inflight`, the reference's
+    refcounting)."""
+
+    # single-flight wait bound: a wedged creator must surface as an
+    # error to its waiters, not park them forever
+    MATERIALIZE_TIMEOUT = 30.0
 
     def __init__(self, publisher, idle_ttl: float = 120.0):
         self.publisher = publisher
         self.idle_ttl = idle_ttl
-        self._views: Dict[Tuple[str, str], Tuple[Materializer, float]] = {}
+        self._views: Dict[Tuple[str, str, str], _ViewEntry] = {}
         self._lock = threading.Lock()
 
     _closed = False
@@ -145,31 +176,78 @@ class ViewStore:
         distinguishes views sharing a subscription but differing in
         request shape (tag/passing filters) — the reference keys views by
         the full request hash (submatview/store.go)."""
+        from consul_tpu import telemetry
         vkey = (topic, key or "", view_key)
         now = time.time()
+        creator = False
+        doomed: list = []
         with self._lock:
             if self._closed:
                 raise RuntimeError("view store closed")
             # idle sweep on EVERY access, else a stable working set never
             # expires its idle neighbors; views with parked blocking
-            # readers are pinned (the reference refcounts views)
-            for k, (view, last) in list(self._views.items()):
-                if k != vkey and now - last > self.idle_ttl \
-                        and view._inflight == 0:
-                    view.stop()
+            # readers are pinned (the reference refcounts views), and
+            # the stop()s run OUTSIDE this lock so reaping a dead view
+            # never stalls live requesters
+            for k, e in list(self._views.items()):
+                if k != vkey and e.ready.is_set() and e.view is not None \
+                        and now - e.last > self.idle_ttl \
+                        and e.view._inflight == 0:
+                    doomed.append(e.view)
                     del self._views[k]
-            hit = self._views.get(vkey)
-            if hit is not None:
-                self._views[vkey] = (hit[0], now)
-                return hit[0]
+            ent = self._views.get(vkey)
+            if ent is not None:
+                ent.last = now
+            else:
+                ent = _ViewEntry(now)
+                self._views[vkey] = ent
+                creator = True
+        for v in doomed:
+            v.stop()
+        telemetry.incr_counter(("cache", "miss" if creator else "hit"),
+                               labels={"type": f"view:{topic}"})
+        if creator:
             m = Materializer(self.publisher, topic, key, snapshot_fn)
-            m.start()
-            self._views[vkey] = (m, now)
+            try:
+                m.start()
+            except BaseException as e:
+                # a failed materialization must release its waiters AND
+                # vacate the slot so the next requester retries fresh
+                with self._lock:
+                    ent.error = e
+                    if self._views.get(vkey) is ent:
+                        del self._views[vkey]
+                ent.ready.set()
+                raise
+            with self._lock:
+                ent.view = m
+                ent.last = time.time()
+            ent.ready.set()
             return m
+        # single-flight: park on the creator's gate, never re-snapshot
+        if not ent.ready.wait(self.MATERIALIZE_TIMEOUT):
+            raise RuntimeError(
+                f"view {vkey} materialization timed out")
+        if ent.view is None:
+            raise RuntimeError(
+                f"view {vkey} creation failed: {ent.error}")
+        return ent.view
+
+    def stats(self) -> dict:
+        """Live registry shape (tests + /v1/agent/profile debugging)."""
+        with self._lock:
+            return {
+                "views": len(self._views),
+                "inflight": sum(e.view._inflight
+                                for e in self._views.values()
+                                if e.view is not None),
+            }
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            for m, _ in self._views.values():
-                m.stop()
+            views = [e.view for e in self._views.values()
+                     if e.view is not None]
             self._views.clear()
+        for m in views:
+            m.stop()

@@ -84,6 +84,112 @@ def test_linearizability_ambiguous_write_may_or_may_not_apply():
     assert not ok
 
 
+def _stale_op(val, call, ret, max_stale=None):
+    op = _op("r", val, call, ret)
+    op["stale"] = True
+    op["max_stale"] = max_stale
+    return op
+
+
+def test_stale_read_taxonomy_accepts_lagged_reads_within_bound():
+    """ISSUE 12: a read tagged stale=True is judged against the
+    serializable-prefix-within-max_stale model, not strict
+    linearizability — the SAME history that fails as a linearizable
+    read passes as a bounded stale one."""
+    history = [
+        _op("w", 1, 0.0, 1.0),
+        _op("w", 2, 2.0, 3.0),
+    ]
+    # strict read of the overwritten value: rejected (existing test)
+    ok, _ = check_linearizable(history + [_op("r", 1, 4.0, 5.0)])
+    assert not ok
+    # the same observation as a stale read with a bound that reaches
+    # back to when 1 was current: accepted
+    ok, _ = check_linearizable(history + [_stale_op(1, 4.0, 5.0,
+                                                    max_stale=3.0)])
+    assert ok
+    # unbounded stale (no max_stale): any previously-current value
+    ok, _ = check_linearizable(history + [_stale_op(1, 100.0, 101.0)])
+    assert ok
+    # stale read of the CURRENT value always passes
+    ok, _ = check_linearizable(history + [_stale_op(2, 4.0, 5.0,
+                                                    max_stale=0.5)])
+    assert ok
+    # stale read of the initial state within bound of the first write
+    ok, _ = check_linearizable([_op("w", 1, 2.0, 3.0),
+                                _stale_op(None, 4.0, 5.0,
+                                          max_stale=3.0)])
+    assert ok
+
+
+def test_stale_read_taxonomy_falsifiability_fork_still_fails():
+    """The weaker model still has teeth: a genuinely-forked stale read
+    (value never written, or older than the bound allows) fails."""
+    history = [
+        _op("w", 1, 0.0, 1.0),
+        _op("w", 2, 2.0, 3.0),
+    ]
+    # a value never written anywhere: fork
+    ok, why = check_linearizable(history + [_stale_op(99, 4.0, 5.0)])
+    assert not ok and "fork" in why
+    # value 1 was certainly overwritten by t=3.0; a 1s window opening
+    # at t=9.0 cannot reach it
+    ok, why = check_linearizable(history + [_stale_op(1, 10.0, 10.5,
+                                                      max_stale=1.0)])
+    assert not ok and "fork" in why
+    # initial state past an acked write + a too-small bound
+    ok, why = check_linearizable(history + [_stale_op(None, 10.0, 10.5,
+                                                      max_stale=1.0)])
+    assert not ok
+    # a stale read from the FUTURE (value written after it returned)
+    ok, why = check_linearizable(
+        [_op("w", 1, 0.0, 1.0), _op("w", 2, 6.0, 7.0),
+         _stale_op(2, 3.0, 4.0)])
+    assert not ok and "fork" in why
+
+
+def test_stale_read_taxonomy_ambiguous_write_values_allowed():
+    """A stale read may surface an AMBIGUOUS write's value (it may
+    have committed) and ambiguous writes never 'certainly overwrite'
+    an older value."""
+    ok, _ = check_linearizable([
+        _op("w", 1, 0.0, 1.0),
+        _op("w", 2, 2.0, None, ok=None),    # timed out
+        _stale_op(2, 3.0, 4.0, max_stale=0.5),
+    ])
+    assert ok
+    ok, _ = check_linearizable([
+        _op("w", 1, 0.0, 1.0),
+        _op("w", 2, 2.0, None, ok=None),
+        _stale_op(1, 10.0, 11.0, max_stale=1.0),   # 2 never CERTAIN
+    ])
+    assert ok
+
+
+def test_stale_reads_do_not_relax_the_strict_ops():
+    """Mixing stale reads into a history must not weaken the strict
+    checker over the rest of it."""
+    ok, _ = check_linearizable([
+        _op("w", 1, 0.0, 1.0),
+        _op("w", 2, 2.0, 3.0),
+        _stale_op(1, 4.0, 5.0),
+        _op("r", 1, 6.0, 7.0),      # STRICT stale read: still a bug
+    ])
+    assert not ok
+
+
+def test_register_history_tags_stale_reads():
+    from consul_tpu.chaos import RegisterHistory
+    h = RegisterHistory()
+    i = h.invoke("r", None, 1.0, stale=True, max_stale=2.5)
+    h.complete(i, 1.5, "v")
+    j = h.invoke("r", None, 2.0)
+    h.complete(j, 2.5, "v")
+    ops = h.recorded()
+    assert ops[0]["stale"] is True and ops[0]["max_stale"] == 2.5
+    assert "stale" not in ops[1]
+
+
 def test_election_safety_checker_flags_double_leader():
     c = ElectionSafetyChecker()
     c.note(3, "n0")

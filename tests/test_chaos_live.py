@@ -218,12 +218,14 @@ def live_cluster(tmp_path_factory):
 
 
 def _await_local(cluster, i, key, want, timeout=20.0):
-    """Poll node i's LOCAL replica (default-consistency read) until
-    `key` carries `want`."""
+    """Poll node i's LOCAL replica (?stale — the read plane's explicit
+    local-replica mode; default reads leader-forward now that the
+    fleet map is configured) until `key` carries `want`."""
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            row, _ = cluster.client(i, timeout=2.0).kv_get(key)
+            row, _ = cluster.client(i, timeout=2.0).kv_get(key,
+                                                           stale=True)
             if row is not None and row["Value"] == want:
                 return True
         except (ApiError, OSError):

@@ -968,3 +968,101 @@ def test_blocking_call_covers_live_nemesis_module():
     """
     assert check_snippet("blocking-call", suppressed,
                          relpath="consul_tpu/chaos_live.py") == []
+
+
+# ------------------------------------------- ISSUE 12: the read plane
+
+
+def test_readplane_discipline_fires_and_stays_silent():
+    """readplane-discipline: a leader-forwarding call inside a
+    stale-guarded branch (or a stale-named function) re-centralizes
+    the read path the follower read plane decentralizes — fires; the
+    same call in the non-stale world is the default mode's job —
+    silent."""
+    bad = """
+        class Handler:
+            def _serve(self, verb, path, q, dec):
+                if dec.mode == "stale":
+                    return self._forward_leader(verb, path, q)
+
+            def serve_stale_fallback(self, store, op, args):
+                return store.raft_apply(op, **args)
+
+            def hot(self, q, idx):
+                if "stale" in q and idx == 0:
+                    self.store.consistent_index()
+    """
+    hits = check_snippet("readplane-discipline", bad,
+                         relpath="consul_tpu/api/http.py")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert "_forward_leader()" in msgs
+    assert "raft_apply()" in msgs
+    assert "consistent_index()" in msgs
+
+    clean = """
+        class Handler:
+            def _serve(self, verb, path, q, dec):
+                if dec.mode == "stale":
+                    return self._serve_local(q)
+                return self._forward_leader(verb, path, q)
+
+            def _block(self, q):
+                if "consistent" in q:
+                    self.store.consistent_index()
+    """
+    assert check_snippet("readplane-discipline", clean,
+                         relpath="consul_tpu/api/http.py") == []
+
+
+def test_readplane_discipline_scoped_to_the_serving_layer():
+    """The rule binds the serving layer only: server.py's write plane
+    legitimately mentions 'stale' (stale leader hints) around
+    raft_apply and must not fire."""
+    snippet = """
+        def retry(self, op, stale_hint):
+            if stale_hint:
+                return self.raft_apply(op)
+    """
+    assert check_snippet("readplane-discipline", snippet,
+                         relpath="consul_tpu/server.py") == []
+    # ... and the identical code inside the scope DOES fire
+    assert len(check_snippet("readplane-discipline", snippet,
+                             relpath="consul_tpu/api/http.py")) == 1
+
+
+def test_readplane_event_and_metric_names_registered():
+    """ISSUE 12's vocabulary: readplane.rejected is CATALOG-registered
+    with its declared labels (fires on an undeclared one), and the
+    consul.readplane.* metric family conforms to the convention."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def reject(reason, route, node):
+            flight.emit("readplane.rejected",
+                        labels={"reason": reason, "route": route,
+                                "node": node})
+            telemetry.incr_counter(("readplane", "rejected"),
+                                   labels={"reason": reason})
+            telemetry.incr_counter(("readplane", "stale"),
+                                   labels={"route": route})
+            telemetry.incr_counter(("readplane", "forward"),
+                                   labels={"route": route})
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+
+    bad = """
+        from consul_tpu import flight
+
+        def reject(reason):
+            flight.emit("readplane.rejected",
+                        labels={"reason": reason, "planet": "mars"})
+            flight.emit("readplane.exploded",
+                        labels={"reason": reason})
+    """
+    hits = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 2
+    assert "label 'planet' not declared" in msgs
+    assert "unregistered event name 'readplane.exploded'" in msgs

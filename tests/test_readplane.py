@@ -1,0 +1,319 @@
+"""Read plane (ISSUE 12 tentpole): consistency-mode resolution,
+follower-local stale serving, lag-bounded rejection, default-mode
+leader forwarding, and the consistency headers — in-process, over real
+HTTP against a raft-backed ServerCluster.
+
+The live 3-process acceptance (follower answers ?stale with ZERO
+leader RPCs, asserted via counters) lives in
+tests/test_readplane_live.py; everything cheap and deterministic is
+here.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu import telemetry
+from consul_tpu.api.client import ApiError, Client
+from consul_tpu.api.http import ApiServer
+from consul_tpu.readplane import ReadPlane, route_family
+from consul_tpu.server import ServerCluster
+
+
+# ------------------------------------------------------------ unit level
+
+
+class _FakeRaftStore:
+    """Duck-typed raft-backed store for resolve() unit tests."""
+
+    raft = object()          # truthy: raft-backed
+
+    def __init__(self, leader=False, known=True, staleness=0.0,
+                 leader_id="server0"):
+        self._leader = leader
+        self._known = known
+        self._staleness = staleness
+        self.leader_id = leader_id
+
+    def is_leader(self):
+        return self._leader
+
+    def known_leader(self):
+        return self._known
+
+    def read_staleness(self):
+        return self._staleness
+
+    def last_contact_ms(self):
+        return self._staleness * 1000.0
+
+
+def _counter(name, labels):
+    for row in telemetry.default_registry().dump()["Counters"]:
+        if row["Name"] == name and (row.get("Labels") or {}) == labels:
+            return row["Count"]
+    return 0.0
+
+
+def test_route_family_is_bounded():
+    assert route_family("/v1/kv/a/b") == "kv"
+    assert route_family("/v1/health/service/web") == "health"
+    assert route_family("/v1/agent/self") == "agent"
+    assert route_family("/v1/unheard-of/x") == "other"
+    assert route_family("/ui") == "other"
+
+
+def test_resolve_modes_and_conflicts():
+    rp = ReadPlane(_FakeRaftStore(leader=True), node_name="server0")
+    assert rp.resolve("/v1/kv/x", {}).mode == "default"
+    assert rp.resolve("/v1/kv/x", {"stale": ""}).mode == "stale"
+    assert rp.resolve("/v1/kv/x", {"max_stale": "5s"}).mode == "stale"
+    assert rp.resolve("/v1/kv/x", {"consistent": ""}).mode \
+        == "consistent"
+    dec = rp.resolve("/v1/kv/x", {"stale": "", "consistent": ""})
+    assert dec.action == "reject" and dec.code == 400
+    # node-local surface: modes are inert, nothing forwards
+    dec = rp.resolve("/v1/agent/self", {"stale": ""})
+    assert dec.action == "local"
+
+
+def test_resolve_max_stale_rejects_on_lagging_replica():
+    rp = ReadPlane(_FakeRaftStore(leader=False, staleness=7.5),
+                   node_name="server1")
+    ok = rp.resolve("/v1/kv/x", {"stale": "", "max_stale": "10s"})
+    assert ok.action == "local" and ok.mode == "stale"
+    bad = rp.resolve("/v1/kv/x", {"stale": "", "max_stale": "1s"})
+    assert bad.action == "reject" and bad.code == 500
+    assert bad.reason == "max_stale"
+    assert "max_stale" in bad.message
+    # the reject journaled a flight event
+    from consul_tpu import flight
+    rows = flight.default_recorder().read(name="readplane.rejected")
+    assert any(r["labels"].get("reason") == "max_stale" for r in rows)
+
+
+def test_resolve_default_forwarding_rules():
+    fleet = {"server0": "http://127.0.0.1:1", "server1": "x"}
+    # follower + fleet map + known leader -> forward
+    rp = ReadPlane(_FakeRaftStore(leader=False),
+                   node_name="server1", cluster_nodes_fn=lambda: fleet)
+    assert rp.resolve("/v1/kv/x", {}).action == "forward"
+    # stale NEVER forwards, whatever the topology
+    assert rp.resolve("/v1/kv/x", {"stale": ""}).action == "local"
+    # no fleet map -> local (standalone compatibility)
+    rp2 = ReadPlane(_FakeRaftStore(leader=False), node_name="server1")
+    assert rp2.resolve("/v1/kv/x", {}).action == "local"
+    # leaderless + fleet map -> 500 No cluster leader
+    rp3 = ReadPlane(_FakeRaftStore(leader=False, known=False,
+                                   leader_id=None),
+                    node_name="server1", cluster_nodes_fn=lambda: fleet)
+    dec = rp3.resolve("/v1/kv/x", {})
+    assert dec.action == "reject" and dec.code == 500
+    assert dec.reason == "no_leader"
+    # a forwarded request bouncing off a non-leader must NOT loop
+    dec = rp.resolve("/v1/kv/x", {},
+                     headers={"X-Consul-Read-Forwarded": "1"})
+    assert dec.action == "reject" and dec.reason == "not_leader"
+
+
+def test_raft_staleness_components():
+    """The follower's self-reported bound: last-contact age ∨ oldest
+    received-but-unapplied entry age (the _recv_ts ring)."""
+    from consul_tpu.consensus.raft import FOLLOWER, LEADER, RaftNode
+
+    class _T:
+        def send(self, *a):
+            pass
+
+    n = RaftNode("n0", ["n0", "n1"], _T(), apply_fn=lambda c: None)
+    now = 1000.0
+    n.state = LEADER
+    assert n.staleness(now) == 0.0
+    n.state = FOLLOWER
+    n.leader_id = "n1"
+    n._last_contact = now - 2.0
+    assert abs(n.staleness(now) - 2.0) < 1e-9
+    # an older unapplied entry dominates the last-contact age
+    n.commit_index = 5
+    n.last_applied = 4
+    n._recv_ts = [(5, now - 3.5)]
+    assert abs(n.staleness(now) - 3.5) < 1e-9
+    # applied entries can't be a staleness head
+    n.last_applied = 5
+    assert abs(n.staleness(now) - 2.0) < 1e-9
+
+
+# ------------------------------------------- in-process cluster over HTTP
+
+
+@pytest.fixture(scope="module")
+def rig():
+    cluster = ServerCluster(3)
+    cluster.start(tick_seconds=0.005)
+    leader = None
+    deadline = time.time() + 20.0
+    while time.time() < deadline and leader is None:
+        time.sleep(0.1)
+        leaders = [s for s in cluster.servers if s.is_leader()]
+        if len(leaders) == 1:
+            leader = leaders[0]
+    assert leader is not None, "no leader elected"
+    apis = {s.node_id: ApiServer(s, node_name=s.node_id)
+            for s in cluster.servers}
+    for a in apis.values():
+        a.start()
+    urls = {n: a.address for n, a in apis.items()}
+    Client(urls[leader.node_id]).kv_put("rp/seed", b"v0")
+    time.sleep(0.4)
+    yield cluster, apis, urls, leader
+    for a in apis.values():
+        a.stop()
+    cluster.stop()
+
+
+def _follower(cluster, leader):
+    return next(s for s in cluster.servers
+                if s.node_id != leader.node_id and not s.is_leader())
+
+
+def test_stale_read_serves_follower_locally_with_headers(rig):
+    cluster, apis, urls, leader = rig
+    f = _follower(cluster, leader)
+    fc = Client(urls[f.node_id])
+    before_fwd = _counter("consul.readplane.forward", {"route": "kv"})
+    row, idx = fc.kv_get("rp/seed", stale=True)
+    assert row["Value"] == b"v0"
+    # the consistency headers (fastfront hot path writes them raw)
+    assert fc.last_known_leader is True
+    assert fc.last_contact_ms is not None and fc.last_contact_ms >= 0
+    # a stale read NEVER forwarded, fleet map or not
+    for a in apis.values():
+        a.cluster_nodes = dict(urls)
+    try:
+        row, _ = fc.kv_get("rp/seed", stale=True)
+        assert row["Value"] == b"v0"
+        assert _counter("consul.readplane.forward",
+                        {"route": "kv"}) == before_fwd
+        assert _counter("consul.readplane.stale", {"route": "kv"}) > 0
+    finally:
+        for a in apis.values():
+            a.cluster_nodes = None
+
+
+def test_default_read_forwards_to_leader_with_fleet_map(rig):
+    cluster, apis, urls, leader = rig
+    f = _follower(cluster, leader)
+    fc = Client(urls[f.node_id])
+    lc = Client(urls[leader.node_id])
+    assert lc.kv_put("rp/fwd", b"v1")
+    time.sleep(0.3)
+    for a in apis.values():
+        a.cluster_nodes = dict(urls)
+    try:
+        before = _counter("consul.readplane.forward", {"route": "kv"})
+        row, _ = fc.kv_get("rp/fwd")
+        assert row["Value"] == b"v1"
+        assert _counter("consul.readplane.forward",
+                        {"route": "kv"}) == before + 1
+        # the forwarded response carries the LEADER's last-contact (0)
+        assert fc.last_contact_ms == 0
+        # the loop guard: a pre-forwarded request at a non-leader 500s
+        try:
+            fc._call("GET", "/v1/kv/rp/fwd", {},
+                     timeout=5.0)
+        except ApiError:
+            pass
+        import urllib.request
+        req = urllib.request.Request(
+            urls[f.node_id] + "/v1/kv/rp/fwd",
+            headers={"X-Consul-Read-Forwarded": "1"})
+        try:
+            urllib.request.urlopen(req, timeout=5.0)
+            assert False, "forwarded request at non-leader must 500"
+        except urllib.error.HTTPError as e:
+            assert e.code == 500
+    finally:
+        for a in apis.values():
+            a.cluster_nodes = None
+
+
+def test_max_stale_reject_over_http_counts_and_journals(rig):
+    cluster, apis, urls, leader = rig
+    f = _follower(cluster, leader)
+    fc = Client(urls[f.node_id])
+    rp = apis[f.node_id].readplane
+    orig = rp.staleness_s
+    rp.staleness_s = lambda: 42.0        # inject replication lag
+    try:
+        before = _counter("consul.readplane.rejected",
+                          {"reason": "max_stale"})
+        with pytest.raises(ApiError) as ei:
+            fc.kv_get("rp/seed", max_stale="1s")
+        assert ei.value.code == 500
+        assert "max_stale" in ei.value.body
+        assert _counter("consul.readplane.rejected",
+                        {"reason": "max_stale"}) == before + 1
+        # an in-bound request still serves
+        row, _ = fc.kv_get("rp/seed", max_stale="100s")
+        assert row["Value"] == b"v0"
+    finally:
+        rp.staleness_s = orig
+
+
+def test_conflicting_modes_400_over_http(rig):
+    cluster, apis, urls, leader = rig
+    fc = Client(urls[_follower(cluster, leader).node_id])
+    with pytest.raises(ApiError) as ei:
+        fc._call("GET", "/v1/kv/rp/seed",
+                 {"stale": "", "consistent": ""})
+    assert ei.value.code == 400
+
+
+def test_stale_health_watchers_share_one_subscription(rig):
+    """ISSUE 12 acceptance: N concurrent stale watchers of one service
+    hold exactly ONE publisher subscription (the shared view), and all
+    wake on the next write."""
+    cluster, apis, urls, leader = rig
+    lc = Client(urls[leader.node_id])
+    lc.catalog_register("web-n1", "10.9.0.1",
+                        service={"Service": "rp-web", "Port": 80})
+    time.sleep(0.4)
+    f = _follower(cluster, leader)
+    api = apis[f.node_id]
+    fc = Client(urls[f.node_id])
+    rows, idx = fc.health_service("rp-web", stale=True)
+    assert len(rows) == 1
+    views_before = api.view_store.stats()["views"]
+
+    results = []
+    lock = threading.Lock()
+
+    def watcher():
+        c = Client(urls[f.node_id], timeout=30.0)
+        out, i2 = c.health_service("rp-web", stale=True, index=idx,
+                                   wait="10s")
+        with lock:
+            results.append((len(out), i2))
+
+    threads = [threading.Thread(target=watcher, daemon=True)
+               for _ in range(5)]
+    for t in threads:
+        t.start()
+    time.sleep(0.6)          # all five parked on the shared view
+    stats = api.view_store.stats()
+    assert stats["views"] == views_before, \
+        "concurrent watchers minted extra views"
+    assert stats["inflight"] >= 5
+    # the publisher gauge: ONE subscription for the topic on this node
+    gauges = {tuple(sorted((r.get("Labels") or {}).items())): r["Value"]
+              for r in telemetry.default_registry().dump()["Gauges"]
+              if r["Name"] == "consul.stream.subscribers"}
+    assert gauges.get((("topic", "health"),)) == 1.0
+    # one write wakes all five
+    lc.catalog_register("web-n2", "10.9.0.2",
+                        service={"Service": "rp-web", "Port": 81})
+    for t in threads:
+        t.join(timeout=15.0)
+    assert len(results) == 5
+    assert all(n == 2 for n, _ in results), results

@@ -1,0 +1,84 @@
+"""ISSUE 12 acceptance on the REAL multi-process cluster: a follower
+answers `GET ?stale` locally — correct X-Consul-KnownLeader /
+X-Consul-LastContact headers, ZERO leader forwards (asserted via the
+follower's own consul.readplane.* counters) — while a default-mode GET
+against the same follower leader-forwards (the fleet HTTP map is
+configured by LiveCluster).
+
+One live 3-process fleet, budgeted ~15 s; everything cheaper lives in
+tests/test_readplane.py.
+"""
+
+import json
+import tempfile
+import time
+import urllib.request
+
+from consul_tpu.api.client import Client
+from consul_tpu.chaos_live import LiveCluster
+
+
+def _counters(url, prefix):
+    """{(name, sorted-label-items): count} from /v1/agent/metrics."""
+    dump = json.loads(urllib.request.urlopen(
+        url + "/v1/agent/metrics", timeout=10).read())
+    out = {}
+    for row in dump.get("Counters", []):
+        if row["Name"].startswith(prefix):
+            key = (row["Name"],
+                   tuple(sorted((row.get("Labels") or {}).items())))
+            out[key] = row["Count"]
+    return out
+
+
+def test_follower_stale_reads_are_local_and_default_reads_forward():
+    with tempfile.TemporaryDirectory(prefix="rp-live-") as tmp:
+        cluster = LiveCluster(n=3, data_root=tmp)
+        try:
+            cluster.start()
+            li = cluster.leader()
+            fi = (li + 1) % 3
+            furl = cluster.servers[fi].http
+            # seed through any node (writes forward)
+            assert cluster.client(0, timeout=5.0).kv_put(
+                "rpl/k", b"v0")
+            # wait until the FOLLOWER's replica carries the key
+            fc = Client(furl, timeout=8.0)
+            deadline = time.time() + 15.0
+            row = None
+            while time.time() < deadline:
+                row, _ = fc.kv_get("rpl/k", stale=True)
+                if row is not None:
+                    break
+                time.sleep(0.2)
+            assert row is not None and row["Value"] == b"v0"
+
+            before = _counters(furl, "consul.readplane")
+            n_stale = 8
+            for _ in range(n_stale):
+                got, _ = fc.kv_get("rpl/k", stale=True)
+                assert got["Value"] == b"v0"
+            # headers on the stale response (raw, so we see the wire)
+            resp = urllib.request.urlopen(
+                furl + "/v1/kv/rpl/k?stale=", timeout=8)
+            assert resp.headers["X-Consul-KnownLeader"] == "true"
+            assert int(resp.headers["X-Consul-LastContact"]) >= 0
+            after = _counters(furl, "consul.readplane")
+            fwd_key = ("consul.readplane.forward", (("route", "kv"),))
+            stale_key = ("consul.readplane.stale", (("route", "kv"),))
+            assert after.get(stale_key, 0) - before.get(stale_key, 0) \
+                >= n_stale
+            # THE acceptance: zero leader forwards for stale reads
+            assert after.get(fwd_key, 0) == before.get(fwd_key, 0), \
+                "a ?stale read forwarded to the leader"
+
+            # contrast: a default-mode GET on the follower forwards
+            got, _ = fc.kv_get("rpl/k")
+            assert got["Value"] == b"v0"
+            # the forwarded response reports the LEADER's last
+            # contact (0: it executed the read)
+            assert fc.last_contact_ms == 0
+            after2 = _counters(furl, "consul.readplane")
+            assert after2.get(fwd_key, 0) == after.get(fwd_key, 0) + 1
+        finally:
+            cluster.stop()

@@ -1,0 +1,218 @@
+"""Shared ViewStore coverage (ISSUE 12 satellite): the materialized-
+view cache is CROSS-CLIENT — N concurrent requesters of one
+(topic, key) share one Materializer and one publisher subscription
+(single-flight), idle views reap on TTL under load without touching
+the hot key, and a slow client cannot wedge the shared view for the
+fast ones.
+
+Pure host-side threading — no jax, no sockets.
+"""
+
+import threading
+import time
+
+from consul_tpu.stream.publisher import Event, EventPublisher
+from consul_tpu.submatview import Materializer, ViewStore
+
+
+class CountingPublisher(EventPublisher):
+    """EventPublisher that counts subscribe() calls per topic."""
+
+    def __init__(self):
+        super().__init__()
+        self.subscribes = 0
+
+    def subscribe(self, topic, key=None, since_index=0):
+        self.subscribes += 1
+        return super().subscribe(topic, key, since_index)
+
+
+def _snapshot_counter(value="v", delay=0.0):
+    calls = [0]
+    lock = threading.Lock()
+
+    def fn():
+        with lock:
+            calls[0] += 1
+        if delay:
+            time.sleep(delay)
+        return value, calls[0]
+
+    return fn, calls
+
+
+def test_concurrent_clients_share_one_materializer_single_flight():
+    """Two clients racing get() on the same (topic, key) get the SAME
+    Materializer, the snapshot runs ONCE, and the publisher holds ONE
+    subscription — the 1M-clients-one-view contract."""
+    pub = CountingPublisher()
+    store = ViewStore(pub)
+    # a slow snapshot widens the race window: the second requester
+    # must park on the single-flight gate, not re-materialize
+    snap, calls = _snapshot_counter(delay=0.15)
+    got = []
+    errs = []
+
+    def client():
+        try:
+            got.append(store.get("health", "web", snap))
+        except BaseException as e:   # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errs
+    assert len(got) == 4
+    assert all(g is got[0] for g in got), "clients got different views"
+    assert calls[0] == 1, f"snapshot ran {calls[0]}x (want 1: " \
+                          f"single-flight)"
+    assert pub.subscribes == 1, \
+        f"{pub.subscribes} publisher subscriptions for one shared view"
+    store.close()
+
+
+def test_distinct_keys_do_not_serialize_behind_a_slow_materialization():
+    """The registry lock is held only for dict ops: while key A's
+    creator is inside its (slow) snapshot, a requester for key B must
+    complete — the per-client-to-shared promotion must not introduce a
+    global materialization lock."""
+    pub = CountingPublisher()
+    store = ViewStore(pub)
+    slow_snap, _ = _snapshot_counter(delay=1.0)
+    fast_snap, fast_calls = _snapshot_counter()
+    started = threading.Event()
+    done_b = threading.Event()
+
+    def slow_client():
+        started.set()
+        store.get("health", "slow-svc", slow_snap)
+
+    def fast_client():
+        started.wait(5.0)
+        time.sleep(0.05)     # let the slow creator enter its snapshot
+        store.get("health", "fast-svc", fast_snap)
+        done_b.set()
+
+    ta = threading.Thread(target=slow_client, daemon=True)
+    tb = threading.Thread(target=fast_client, daemon=True)
+    ta.start()
+    tb.start()
+    assert done_b.wait(0.8), \
+        "fast-svc view creation stalled behind slow-svc's snapshot"
+    ta.join(timeout=5.0)
+    assert fast_calls[0] == 1
+    store.close()
+
+
+def test_idle_ttl_reaping_under_load_pins_inflight_readers():
+    """A hot working set sweeps its idle neighbors on every access —
+    but a view with a PARKED blocking reader is pinned (refcount) even
+    past the TTL, and the hot key itself never reaps."""
+    pub = CountingPublisher()
+    store = ViewStore(pub, idle_ttl=0.2)
+    hot_snap, _ = _snapshot_counter()
+    idle_snap, _ = _snapshot_counter()
+    pinned_snap, _ = _snapshot_counter()
+    store.get("health", "idle-svc", idle_snap)
+    pinned = store.get("health", "pinned-svc", pinned_snap)
+
+    # park a blocking reader on the pinned view (index far ahead)
+    parked = threading.Thread(
+        target=lambda: pinned.fetch(10**9, timeout=2.0), daemon=True)
+    parked.start()
+    time.sleep(0.1)
+    assert pinned._inflight == 1
+
+    # hammer the hot key past the TTL: the idle view reaps, the
+    # pinned one survives
+    deadline = time.time() + 0.6
+    while time.time() < deadline:
+        store.get("health", "hot-svc", hot_snap)
+        time.sleep(0.05)
+    with store._lock:
+        keys = {k[1] for k in store._views}
+    assert "idle-svc" not in keys, "idle view never reaped under load"
+    assert "hot-svc" in keys
+    assert "pinned-svc" in keys, "view with a parked reader was reaped"
+    parked.join(timeout=5.0)
+    store.close()
+
+
+def test_slow_client_cannot_wedge_the_shared_view():
+    """Bounded fetch isolation: one client parked in a long fetch()
+    must not stop the follow loop from updating the view, nor other
+    clients from reading fresh values immediately."""
+    pub = EventPublisher()
+    pub_idx = [1]
+    val = ["v1"]
+
+    def snap():
+        return val[0], pub_idx[0]
+
+    store = ViewStore(pub)
+    view = store.get("kv", "k", snap)
+    assert view.fetch(0, timeout=1.0) == ("v1", 1)
+
+    # the slow client: parks waiting for an index that arrives late
+    slow_result = {}
+
+    def slow_client():
+        slow_result["got"] = view.fetch(2, timeout=5.0)
+
+    ts = threading.Thread(target=slow_client, daemon=True)
+    ts.start()
+    time.sleep(0.1)
+
+    # a write lands while the slow client is parked
+    val[0] = "v2"
+    pub_idx[0] = 3
+    pub.publish([Event(topic="kv", key="k", index=3)])
+
+    # a FAST client sees the fresh value promptly — the slow fetch
+    # holds no lock the follow loop or other readers need
+    deadline = time.time() + 5.0
+    got = view.fetch(1, timeout=5.0)
+    assert time.time() < deadline
+    assert got == ("v2", 3)
+    ts.join(timeout=5.0)
+    assert slow_result.get("got") == ("v2", 3)
+    store.close()
+
+
+def test_failed_materialization_releases_waiters_and_vacates_slot():
+    """A snapshot_fn that raises must fail BOTH the creator and any
+    single-flight waiters, and leave the slot empty so the next
+    requester retries fresh instead of inheriting a corpse."""
+    pub = EventPublisher()
+    store = ViewStore(pub)
+    boom = [True]
+
+    def snap():
+        if boom[0]:
+            time.sleep(0.1)
+            raise RuntimeError("snapshot exploded")
+        return "ok", 1
+
+    results = []
+
+    def client():
+        try:
+            results.append(("ok", store.get("kv", "k", snap)))
+        except RuntimeError as e:
+            results.append(("err", str(e)))
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert len(results) == 3
+    assert all(kind == "err" for kind, _ in results)
+    # the slot vacated: a healthy retry materializes
+    boom[0] = False
+    view = store.get("kv", "k", snap)
+    assert view.fetch(0, timeout=1.0) == ("ok", 1)
+    store.close()

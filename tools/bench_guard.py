@@ -313,6 +313,16 @@ def run_check() -> int:
     if not vis["ok"]:
         failures.append("guard judged the VISIBILITY_* artifact keys "
                         "instead of tolerating them")
+    # the read-plane stamp (ISSUE 12: kv_bench --stale rows carry
+    # {"read": {mode, servers, fanout, stale_mix}}) is metadata too:
+    # a decorated within-threshold row must be tolerated-not-judged
+    rd = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                 "read": {"mode": "stale", "servers": 3,
+                          "fanout": True, "stale_mix": 1.0}}],
+               fake_base)
+    if not rd["ok"]:
+        failures.append("guard judged the read-plane stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

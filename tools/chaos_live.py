@@ -2,7 +2,7 @@
 over real sockets (ISSUE 9 tentpole).
 
     python tools/chaos_live.py                  # every live scenario,
-                                                # emits CHAOS_r03.json
+                                                # emits CHAOS_r04.json
     python tools/chaos_live.py --seed 42        # same suite, seed 42
     python tools/chaos_live.py --scenario live_kill_leader_loop --seed 3
     python tools/chaos_live.py --check          # the bounded tier-1
@@ -35,7 +35,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "CHAOS_r03.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r04.json")
 CHECK_SEED = 7
 
 
@@ -96,6 +96,11 @@ def run_soak(names, seed: int, out_path: str) -> int:
             "graceful SIGTERM exits 0 with a flushed WAL",
             "cross-DC requests fail fast (no hangs) when the only "
             "mesh gateway dies; replacement gateway restores service",
+            "follower ?stale reads keep serving (zero refused, "
+            "bounded latency) through a leader kill; ?max_stale "
+            "rejects fire once a severed follower's lag exceeds the "
+            "bound; ?consistent 500s leaderless; stale reads verified "
+            "against the serializable-prefix-within-max_stale model",
         ],
     }
     with open(out_path, "w") as f:

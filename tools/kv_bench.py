@@ -9,13 +9,19 @@ prints one JSON line per phase.
 
 Run: python tools/kv_bench.py [--n-ops 20000] [--conns 32] [--cluster]
 
---cluster benches the replicated 3-server path: one server PROCESS
-per member (tools/server_proc.py), raft + leader forwarding over real
-sockets, GETs round-robined across all three (the reference's
-LB-over-3 row).  NOTE: on a single-core box the three server
-processes and the load generators all share one CPU, so --cluster
-throughput is a functional demonstration there, not a scaling
-measurement; the standalone numbers are the per-core comparison.
+--cluster benches the replicated N-server path (--servers, default
+3): one server PROCESS per member (tools/server_proc.py), raft +
+leader forwarding over real sockets, GETs round-robined across all
+members (the reference's LB-over-3 row).  Every member gets the fleet
+HTTP map, so DEFAULT-mode GETs against followers leader-forward (the
+read plane's leader-verified semantics); --stale adds the ?stale
+follower-fanout phases where every server answers from its local
+replica (the reference's stale-LB row — its 16,068.8 req/s vs
+7,524.9 default on identical hardware) plus a 90/10 stale/default
+mix.  NOTE: on a single-core box the server processes and the load
+generators all share one CPU, so --cluster throughput is a
+functional demonstration there, not a scaling measurement; the
+standalone numbers are the per-core comparison.
 
 Measured on the round-5 rig (1 core; BENCH_kv.json): standalone PUT
 ~6.2k req/s (1.63x the reference's absolute 3,779.9) and GET ~8.2k
@@ -40,13 +46,19 @@ import time
 sys.path.insert(0, ".")
 
 
-def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
+def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
+               stale_mix=0.0):
     """One load-generator PROCESS running `conns` connection threads.
     Load generation lives outside the server process so the server
     keeps its own GIL (the reference bench used a separate loadgen
     box for the same reason).  Each worker pins one address from
     `addresses` round-robin — the reference's nginx-LB-over-3-servers
-    row is the same fan-out."""
+    row is the same fan-out.
+
+    `stale_mix` (GETs only): the fraction of reads sent as `?stale`
+    follower reads (deterministic per op index, no RNG) — 1.0 is the
+    pure stale-fanout mode, 0.0 the default-consistency baseline every
+    follower hop of which leader-forwards."""
     import http.client
     import socket
     import urllib.parse
@@ -54,6 +66,7 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
     # per-worker slots summed after join: `amb[0] += 1` shared across
     # threads is a lossy read-modify-write
     amb = [0] * conns
+    stale_per_100 = int(round(stale_mix * 100))
 
     def worker(wid):
         host = urllib.parse.urlparse(addresses[wid % len(addresses)])
@@ -65,9 +78,11 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
         conn = fresh()
         try:
             for i in range(per):
+                path = f"/v1/kv/bench/{wid}/{i % 128}"
+                if verb == "GET" and (i % 100) < stale_per_100:
+                    path += "?stale="
                 try:
-                    conn.request(verb, f"/v1/kv/bench/{wid}/{i % 128}",
-                                 body=body)
+                    conn.request(verb, path, body=body)
                     r = conn.getresponse()
                     r.read()
                 except (socket.timeout, TimeoutError,
@@ -112,7 +127,8 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None):
     q.put((time.perf_counter() - t0, errors[:3], sum(amb)))
 
 
-def drive(addresses, n_ops, conns, verb, body=None, procs=1):
+def drive(addresses, n_ops, conns, verb, body=None, procs=1,
+          stale_mix=0.0):
     """`procs` load processes × (conns//procs) connections each,
     spread over `addresses` (one or several servers).
 
@@ -137,7 +153,7 @@ def drive(addresses, n_ops, conns, verb, body=None, procs=1):
     barrier = ctx.Barrier(procs + 1)
     ps = [ctx.Process(target=_load_proc,
                       args=(addresses, per_conn, conns_per_proc, verb,
-                            body, q, barrier), daemon=True)
+                            body, q, barrier, stale_mix), daemon=True)
           for _ in range(procs)]
     for p in ps:
         p.start()
@@ -163,6 +179,15 @@ def main():
     ap.add_argument("--n-ops", type=int, default=20000)
     ap.add_argument("--conns", type=int, default=32)
     ap.add_argument("--cluster", action="store_true")
+    ap.add_argument("--servers", type=int, default=3,
+                    help="cluster size for --cluster (scaling sweeps "
+                         "merge rows across runs via --out)")
+    ap.add_argument("--stale", action="store_true",
+                    help="add the ?stale read phases: pure stale "
+                         "follower-fanout (GETs spread over every "
+                         "server, each answering from its local "
+                         "replica) and a 90%% stale / 10%% default "
+                         "mix — the reference's production read shape")
     ap.add_argument("--out", default=None,
                     help="also append rows to this JSON artifact")
     args = ap.parse_args()
@@ -187,17 +212,24 @@ def main():
         # reap INSIDE try/finally: a load-gen raise (bench error,
         # broken barrier, queue timeout) must never leak three server
         # processes holding their ports
+        n = args.servers
         procs = []
         try:
-            addresses, procs = start_cluster_procs(3)
+            addresses, procs = start_cluster_procs(n)
             rps, dt, put_amb = drive(addresses[:1], args.n_ops,
                                      args.conns, "PUT", body=value)
             emit({
-                "metric": "kv_put_rps_cluster3", "value": round(rps, 1),
+                "metric": f"kv_put_rps_cluster{n}",
+                "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": put_amb,
+                "read": {"servers": n},
                 "vs_baseline": round(rps / baselines["kv_put"], 2)})
             time.sleep(1.0)   # let replication land on followers
+            # default-consistency GETs round-robined over every
+            # server: a follower hop leader-forwards (the read plane's
+            # default mode — every read verified by the leader), so
+            # this is the FLAT baseline the stale fanout must beat
             rps, dt, get_amb = drive(addresses, args.n_ops, args.conns,
                                      "GET")
             # a GET-phase 404 is tolerable ONLY as the shadow of a
@@ -209,11 +241,48 @@ def main():
                     f"{put_amb} ambiguous PUTs — acked writes went "
                     f"missing")
             emit({
-                "metric": "kv_get_rps_lb3", "value": round(rps, 1),
+                "metric": f"kv_get_rps_lb{n}", "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": get_amb,
+                "read": {"mode": "default", "servers": n,
+                         "fanout": True},
                 "vs_baseline": round(rps / baselines["kv_get_lb3"],
                                      2)})
+            if args.stale:
+                # pure stale follower fanout: every server answers
+                # GETs from its own replica — the read-scaling mode
+                # (the reference's 16,068.8 req/s LB row was exactly
+                # this: stale reads behind an LB over 3 servers)
+                rps, dt, amb = drive(addresses, args.n_ops,
+                                     args.conns, "GET", stale_mix=1.0)
+                if amb > put_amb:
+                    raise RuntimeError(
+                        f"bench: {amb} stale-GET holes but only "
+                        f"{put_amb} ambiguous PUTs — acked writes "
+                        f"went missing")
+                emit({
+                    "metric": f"kv_get_rps_lb{n}_stale",
+                    "value": round(rps, 1),
+                    "unit": "req/s", "wall_s": round(dt, 2),
+                    "cores": cores, "ambiguous": amb,
+                    "read": {"mode": "stale", "servers": n,
+                             "fanout": True, "stale_mix": 1.0},
+                    "vs_baseline": round(
+                        rps / baselines["kv_get_lb3"], 2)})
+                # 90/10 stale/default mix: the production read shape
+                # (most traffic tolerates bounded staleness, a tail
+                # needs leader verification)
+                rps, dt, amb = drive(addresses, args.n_ops,
+                                     args.conns, "GET", stale_mix=0.9)
+                emit({
+                    "metric": f"kv_get_rps_lb{n}_mixed",
+                    "value": round(rps, 1),
+                    "unit": "req/s", "wall_s": round(dt, 2),
+                    "cores": cores, "ambiguous": amb,
+                    "read": {"mode": "mixed", "servers": n,
+                             "fanout": True, "stale_mix": 0.9},
+                    "vs_baseline": round(
+                        rps / baselines["kv_get_lb3"], 2)})
         finally:
             reap_procs(procs)
         _write_artifact(args.out, rows, cores)
@@ -266,7 +335,18 @@ def _write_artifact(path, rows, cores):
         f"share {cores} core(s). Cluster quorum-write throughput here "
         "is CPU-bound across 4+ processes on one core; per server-core "
         "the quorum-write path sustains several times the reference's "
-        "~157 req/s per server core.")
+        "~157 req/s per server core. READ MODES (ISSUE 12): "
+        "kv_get_rps_lbN is DEFAULT consistency — every follower hop "
+        "leader-forwards, so it measures the reference's real "
+        "leader-verified semantics (pre-readplane trees served these "
+        "from the local replica, i.e. silently stale); "
+        "kv_get_rps_lbN_stale is the ?stale follower fanout (every "
+        "server answers from its own replica — the reference's "
+        "16,068.8 req/s LB row, 2.1x its default-GET rate on the same "
+        "hardware); _mixed is 90% stale / 10% default. On a 1-core "
+        "rig the stale fanout shows the per-request saving (no "
+        "forward hop), not multi-core scale-out — N servers still "
+        "share one core.")
     with open(path, "w") as f:
         json.dump(data, f, indent=2)
 
@@ -293,11 +373,19 @@ def reap_procs(procs):
 def start_cluster_procs(n=3, rpc_base=7101, http_base=7201):
     """Spawn one server PROCESS per member (tools/server_proc.py — the
     reference's one-agent-per-box shape) and wait for a leader.  Reaps
-    whatever it spawned on ANY failure before re-raising."""
+    whatever it spawned on ANY failure before re-raising.
+
+    Every member gets the fleet HTTP map (--cluster-http): that arms
+    the read plane's default-mode leader forwarding, so the bench's
+    default-GET rows measure the reference's real semantics (every
+    unqualified read verified by the leader) instead of silently
+    serving unbounded-staleness local reads."""
     import subprocess
     import urllib.request
     peers = ",".join(f"server{i}=127.0.0.1:{rpc_base + i}"
                      for i in range(n))
+    cluster_http = ",".join(
+        f"server{i}=http://127.0.0.1:{http_base + i}" for i in range(n))
     procs = []
     addresses = []
     try:
@@ -305,7 +393,8 @@ def start_cluster_procs(n=3, rpc_base=7101, http_base=7201):
             procs.append(subprocess.Popen(
                 [sys.executable, "tools/server_proc.py",
                  "--node", f"server{i}", "--peers", peers,
-                 "--http-port", str(http_base + i)],
+                 "--http-port", str(http_base + i),
+                 "--cluster-http", cluster_http],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
             addresses.append(f"http://127.0.0.1:{http_base + i}")
         # readiness: a write succeeds once a leader exists (followers

@@ -9,6 +9,9 @@ from lint.checkers.gather_discipline import GatherDisciplineChecker
 from lint.checkers.jit_purity import JitPurityChecker
 from lint.checkers.metric_names import (EventNamesChecker,
                                         MetricNamesChecker)
+from lint.checkers.readplane_discipline import (
+    ReadplaneDisciplineChecker,
+)
 from lint.checkers.recompile_hazard import RecompileHazardChecker
 from lint.checkers.storage_seam import StorageSeamChecker
 
@@ -23,6 +26,7 @@ ALL = [
     MetricNamesChecker(),
     EventNamesChecker(),
     GatherDisciplineChecker(),
+    ReadplaneDisciplineChecker(),
 ]
 
 BY_NAME = {c.name: c for c in ALL}

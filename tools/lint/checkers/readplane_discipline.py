@@ -1,0 +1,104 @@
+"""readplane-discipline: stale-mode read paths never touch the leader.
+
+The follower read plane's whole value (ISSUE 12) is that a `?stale`
+read is served from THIS node's replica — no leader RPC, no forward,
+no barrier.  One forwarding call smuggled into a stale-guarded branch
+re-centralizes the read path and silently reintroduces the
+every-read-funnels-through-the-leader bottleneck the plane exists to
+remove, while still LOOKING like a follower read in every benchmark
+that only counts HTTP hops.
+
+This checker encodes the contract statically over the serving layer
+(`consul_tpu/readplane.py`, `consul_tpu/api/`):
+
+  * inside any `if` branch whose CONDITION tests staleness (a name or
+    attribute containing `stale`, or a comparison against the literal
+    `"stale"` — the `mode == "stale"` / `dec.is_stale` /
+    `if stale:` shapes), and
+  * inside any function whose NAME contains `stale`,
+
+a call to a leader-forwarding helper is a finding.  The helper list is
+the tree's actual leader surface: HTTP read forwarding, cross-DC
+forwarding, the consistent-read barrier, and the raft write/forward
+plane.  Intentional exceptions carry
+`# lint: ok=readplane-discipline (reason)`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from lint.astutil import call_name
+from lint.core import Checker, Finding, Module
+
+# the serving layer where stale-mode branches live
+SCOPE = (
+    "consul_tpu/readplane.py",
+    "consul_tpu/api/",
+)
+
+# calls that reach the leader (or another node) on a read's behalf
+FORWARD_HELPERS = {
+    "_forward_leader",      # HTTP read forward to the leader
+    "_forward_dc",          # cross-DC HTTP forward
+    "consistent_index",     # leader barrier (consistent reads)
+    "raft_apply",           # write-plane forwarding
+    "_forward_apply",       # the forward coalescer
+    "_hold_for_leader",     # election hold on the forward path
+}
+
+
+def _mentions_stale(test: ast.AST) -> bool:
+    """Does this if-condition test staleness?  Names/attributes
+    containing 'stale' (`if stale:`, `dec.is_stale`, `"stale" in q`)
+    or comparisons against the literal "stale" (`mode == "stale"`)."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Name) and "stale" in sub.id.lower():
+            return True
+        if isinstance(sub, ast.Attribute) \
+                and "stale" in sub.attr.lower():
+            return True
+        if isinstance(sub, ast.Constant) \
+                and isinstance(sub.value, str) \
+                and "stale" in sub.value.lower():
+            return True
+    return False
+
+
+class ReadplaneDisciplineChecker(Checker):
+    name = "readplane-discipline"
+    description = ("stale-mode read branches may not call "
+                   "leader-forwarding helpers — a ?stale read is "
+                   "served from the local replica by contract")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith(SCOPE):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.If) and _mentions_stale(node.test):
+                # the stale-guarded branch is node.body; orelse is the
+                # non-stale world and may forward freely
+                yield from self._scan(module, node.body,
+                                      "stale-guarded branch")
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    and "stale" in node.name.lower():
+                yield from self._scan(module, node.body,
+                                      f"stale-path function "
+                                      f"{node.name}()")
+
+    def _scan(self, module: Module, body, where: str
+              ) -> Iterator[Finding]:
+        for stmt in body:
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = (call_name(sub) or "").rsplit(".", 1)[-1]
+                if fn in FORWARD_HELPERS:
+                    yield module.finding(
+                        self.name, sub,
+                        f"{fn}() inside a {where} — a ?stale read is "
+                        f"served from the LOCAL replica; forwarding "
+                        f"re-centralizes the read path the follower "
+                        f"read plane exists to decentralize")
