@@ -21,14 +21,52 @@ class ApiError(Exception):
     """HTTP-level error (the server answered with a status >= 400).
     `ambiguous` says whether the request MAY have taken effect anyway —
     the distinction a history collector needs to classify outcomes
-    (Jepsen's :ok / :fail / :info trichotomy)."""
+    (Jepsen's :ok / :fail / :info trichotomy).  `nack` marks the
+    server's explicit definitely-NOT-applied rejections (rate limit,
+    apply admission) — for a write, a nack is a proof of
+    non-commitment, unlike a generic 500 that may have fired after the
+    entry was proposed.  `reason` carries the machine-readable
+    X-Consul-Reason header when the server stamped one."""
 
     ambiguous = False
+    nack = False
 
     def __init__(self, code: int, body: str):
         super().__init__(f"HTTP {code}: {body}")
         self.code = code
         self.body = body
+        self.reason: Optional[str] = None
+        self.retry_after: Optional[float] = None
+
+
+class ApiRateLimitError(ApiError):
+    """429 + Retry-After from the ingress rate limiter: the request
+    was shed BEFORE any store or raft work, so a rejected write cannot
+    have committed (ambiguous=False, nack=True).  `retry_after` is the
+    server's hint in seconds; the retrying helpers honor it with
+    capped jittered backoff (retry_backoff)."""
+
+    nack = True
+
+    def __init__(self, code: int, body: str,
+                 retry_after: Optional[float] = None):
+        super().__init__(code, body)
+        self.reason = "rate-limited"
+        self.retry_after = retry_after
+
+
+class ApiOverloadError(ApiError):
+    """503 + X-Consul-Reason queue-full/deadline: the leader's apply
+    admission NACKed the write strictly before the raft append — it
+    was never proposed and definitely did not commit (nack=True).
+    The unambiguous face of leader overload (vs the timeout it
+    replaces)."""
+
+    nack = True
+
+    def __init__(self, code: int, body: str, reason: str):
+        super().__init__(code, body)
+        self.reason = reason
 
 
 class ApiTimeoutError(ApiError):
@@ -68,6 +106,45 @@ def _classify_oserror(e: BaseException, url: str) -> ApiError:
     if isinstance(e, _DEFINITE_REASONS):
         return ApiConnectionError(f"{url}: {e}")
     return ApiTimeoutError(f"{url}: {e}")
+
+
+# X-Consul-Reason values that mark an explicit server-side NACK of a
+# write before it could reach the raft log
+_NACK_REASONS = ("queue-full", "deadline")
+
+
+def _classify_http_error(e) -> ApiError:
+    """HTTPError → the typed taxonomy, discriminating on status +
+    X-Consul-Reason (ISSUE 13).  A 429 counts as rate limiting only
+    when the limiter's fingerprints (Retry-After or the reason header)
+    are present — /v1/agent/health also answers 429 for 'warning' and
+    must stay a plain ApiError."""
+    body = e.read().decode(errors="replace")
+    reason = e.headers.get("X-Consul-Reason")
+    ra = e.headers.get("Retry-After")
+    if e.code == 429 and (ra is not None or reason == "rate-limited"):
+        try:
+            retry_after = float(ra) if ra is not None else None
+        except ValueError:
+            retry_after = None
+        return ApiRateLimitError(e.code, body, retry_after=retry_after)
+    if e.code == 503 and reason in _NACK_REASONS:
+        return ApiOverloadError(e.code, body, reason)
+    err = ApiError(e.code, body)
+    err.reason = reason
+    return err
+
+
+def retry_backoff(e: Optional[BaseException] = None, attempt: int = 0,
+                  base: float = 0.2, cap: float = 5.0) -> float:
+    """Seconds to sleep before retrying after `e`: the server's
+    Retry-After hint when it sent one (429), else exponential in
+    `attempt` — either way capped at `cap` and jittered to half-full
+    so a thundering herd of limited clients decorrelates."""
+    import random
+    hint = getattr(e, "retry_after", None)
+    d = hint if hint is not None else base * (2 ** attempt)
+    return min(cap, max(0.0, d)) * (0.5 + random.random() * 0.5)
 
 
 def consistency_params(stale: bool = False,
@@ -122,7 +199,7 @@ class Client:
                     return (json.loads(raw) if raw else None), idx, raw
                 return None, idx, raw
         except urllib.error.HTTPError as e:
-            raise ApiError(e.code, e.read().decode(errors="replace")) from None
+            raise _classify_http_error(e) from None
         except urllib.error.URLError as e:
             # connect-phase failures ride URLError; split DEFINITE
             # (refused: no listener, the write cannot have applied)
@@ -445,11 +522,18 @@ class Client:
 
     def lock_acquire(self, key: str, value: bytes = b"", ttl: str = "15s",
                      retries: int = 30, retry_wait: float = 0.2) -> Optional[str]:
-        """api/lock.go Lock(): session + acquire loop."""
+        """api/lock.go Lock(): session + acquire loop.  A rate-limited
+        attempt (429) costs a retry slot and backs off per the
+        server's Retry-After hint (capped, jittered) instead of
+        hammering a limiter that just shed us."""
         sid = self.session_create(ttl=ttl)
-        for _ in range(retries):
-            if self.kv_put(key, value, acquire=sid):
-                return sid
+        for attempt in range(retries):
+            try:
+                if self.kv_put(key, value, acquire=sid):
+                    return sid
+            except ApiRateLimitError as e:
+                time.sleep(retry_backoff(e, attempt, base=retry_wait))
+                continue
             time.sleep(retry_wait)
         self.session_destroy(sid)
         return None

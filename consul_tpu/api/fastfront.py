@@ -255,7 +255,8 @@ class FastKVServer:
                         + body
 
                 handled = self._try_hot(conn, verb, target, token, body,
-                                        trace_id=trace_id)
+                                        trace_id=trace_id,
+                                        client=addr[0] if addr else "")
                 if not handled:
                     self._fallback(conn, addr, request_bytes)
                 if want_close:
@@ -288,7 +289,8 @@ class FastKVServer:
 
     def _try_hot(self, conn, verb: str, target: str,
                  token: Optional[str], body: bytes,
-                 trace_id: Optional[str] = None) -> bool:
+                 trace_id: Optional[str] = None,
+                 client: str = "") -> bool:
         if not target.startswith("/v1/kv/"):
             return False
         srv = self._api
@@ -332,6 +334,23 @@ class FastKVServer:
             cas = int(q["cas"]) if "cas" in q else None
         except ValueError:
             return False
+        # ingress rate limiting on the hot path (ISSUE 13): the shed
+        # must happen HERE, inline — falling back to the legacy front
+        # to say "429" would make the shed path slower than the served
+        # path, the opposite of load shedding.  Disabled mode costs
+        # one attribute read.
+        rl = srv.ratelimit
+        if rl.mode != "disabled":
+            wait = rl.check(token or client,
+                            "read" if verb == "GET" else "write")
+            if wait is not None:
+                from consul_tpu.ratelimit import retry_after_header
+                return self._plain(
+                    conn, 429, b"rate limit exceeded",
+                    meta=b"X-Consul-Reason: rate-limited\r\n"
+                         b"Retry-After: "
+                         + retry_after_header(wait).encode()
+                         + b"\r\n")
         t0 = _time.perf_counter()
         wall0 = _time.time()
         telemetry.incr_counter(("http", verb.lower()))
@@ -389,14 +408,28 @@ class FastKVServer:
             ok, idx = store.kv_delete(key, recurse=False, cas=cas)
             return self._json(conn, ok, index=idx)
         except Exception as e:
-            # store/raft faults (leader loss mid-write, ...) must reach
-            # the client as the legacy 500, not a connection reset
-            telemetry.incr_counter(("http", "fastfront_error"),
-                                   labels={"kind": "request"})
+            # overload/unavailable outcomes keep their distinct status
+            # + machine-readable reason on the hot path too (ISSUE 13):
+            # an admission NACK must reach the client as the same 503
+            # X-Consul-Reason the legacy front shapes
+            from consul_tpu.api.http import _overload_response
+            mapped = _overload_response(e)
             try:
                 msg = f"{type(e).__name__}: {e}".encode()
-                self._write(conn, 500, msg,
-                            b"application/octet-stream", None)
+                if mapped is not None:
+                    code, rsn = mapped
+                    self._write(conn, code, msg,
+                                b"application/octet-stream", None,
+                                meta=b"X-Consul-Reason: "
+                                     + rsn.encode() + b"\r\n")
+                else:
+                    # store/raft faults (leader loss mid-write, ...)
+                    # must reach the client as the legacy 500, not a
+                    # connection reset
+                    telemetry.incr_counter(("http", "fastfront_error"),
+                                           labels={"kind": "request"})
+                    self._write(conn, 500, msg,
+                                b"application/octet-stream", None)
             except OSError:
                 pass
             return True
@@ -414,7 +447,9 @@ class FastKVServer:
 
     _REASON = {200: b"OK", 403: b"Forbidden", 404: b"Not Found",
                413: b"Payload Too Large",
-               500: b"Internal Server Error"}
+               429: b"Too Many Requests",
+               500: b"Internal Server Error",
+               503: b"Service Unavailable"}
 
     def _read_meta(self) -> bytes:
         """The consistency headers every read response carries

@@ -54,6 +54,22 @@ def _parse_wait(val: str) -> float:
     return parse_duration(val, 10.0)
 
 
+def _overload_response(e: BaseException):
+    """(status, X-Consul-Reason) for overload/unavailable exceptions,
+    None for everything else (which stays the generic 500).  Lazy
+    imports: the handler's exception path must not couple module
+    import order."""
+    from consul_tpu.ratelimit import ApplyRejectedError
+    from consul_tpu.server import NoLeaderError
+    if isinstance(e, ApplyRejectedError):
+        # queue_full / deadline — the admission NACK: definitely not
+        # committed, and a 503 the client maps to ambiguous=False
+        return 503, e.reason.replace("_", "-")
+    if isinstance(e, NoLeaderError):
+        return 503, "no-leader"
+    return None
+
+
 class NullOracle:
     """Inert oracle for server-backed ApiServers with no gossip device
     attached (the pure control-plane deployment shape)."""
@@ -199,6 +215,16 @@ class ApiServer:
         self.readplane = ReadPlane(
             store, node_name=node_name,
             cluster_nodes_fn=lambda: self.cluster_nodes)
+        # ingress rate limiting (consul_tpu/ratelimit.py, the
+        # reference's agent/consul/rate role): per-client/per-route-
+        # class token buckets consulted by BOTH fronts — over-limit
+        # requests shed fast with 429 + Retry-After + X-Consul-Reason.
+        # Disabled by default (one attribute read on the hot path);
+        # operators configure via ratelimit.configure() /
+        # tools/server_proc.py --rate-limit, observing in permissive
+        # mode before enforcing.
+        from consul_tpu.ratelimit import RateLimiter
+        self.ratelimit = RateLimiter()
         handler = _make_handler(self)
         # Custom threaded front: hot KV ops on a minimal parser, every
         # other route replayed through `handler` byte-for-byte — the
@@ -535,8 +561,22 @@ def _make_handler(srv: ApiServer):
                 # end of the commit-to-visibility pipeline
                 store.visibility.stage("flush", vis)
 
-        def _err(self, code: int, msg: str):
-            self._send(None, code, raw=msg.encode())
+        def _err(self, code: int, msg: str, reason: str = "",
+                 retry_after: float = None):
+            """Error response; `reason` stamps the machine-readable
+            X-Consul-Reason header (ISSUE 13: 429 rate-limited vs 503
+            no-leader/queue-full/deadline/max-stale vs 500 internal —
+            clients and chaos checkers discriminate on it instead of
+            grepping bodies), `retry_after` the RFC 9110 Retry-After
+            hint in seconds."""
+            extra = {}
+            if reason:
+                extra["X-Consul-Reason"] = reason
+            if retry_after is not None:
+                from consul_tpu.ratelimit import retry_after_header
+                extra["Retry-After"] = retry_after_header(retry_after)
+            self._send(None, code, raw=msg.encode(),
+                       extra_headers=extra or None)
 
         def _consistent(self, q) -> None:
             """?consistent: leader barrier, then wait for the LOCAL
@@ -949,6 +989,27 @@ def _make_handler(srv: ApiServer):
                 # request token > agent default token > anonymous)
                 self.authz = srv.acl.resolve(
                     token or srv.tokens.user_token() or None)
+                # ingress rate limiting (ISSUE 13): shed over-limit
+                # data-plane requests FAST, before any store work —
+                # the fastfront checks its own hot path, this covers
+                # the legacy front AND every fastfront fallback.
+                # Client identity = ACL token when present (the
+                # reference keys its limits the same way), else the
+                # peer address.
+                rl = srv.ratelimit
+                if rl.mode != "disabled":
+                    from consul_tpu import ratelimit as _rlmod
+                    rc = _rlmod.route_class(verb, path)
+                    if rc is not None:
+                        wait = rl.check(
+                            token or self.client_address[0], rc)
+                        if wait is not None:
+                            self._err(429, "rate limit exceeded",
+                                      reason="rate-limited",
+                                      retry_after=wait)
+                            telemetry.measure_since(
+                                ("http", "latency"), t0)
+                            return
                 if self._dispatch(verb, path, q):
                     telemetry.measure_since(("http", "latency"), t0)
                     return
@@ -961,11 +1022,22 @@ def _make_handler(srv: ApiServer):
                 except OSError:
                     pass   # client went away mid-error-response
             except Exception as e:  # pragma: no cover
-                # consul.http.request_error: 500s an operator can
-                # alarm on (the handler itself must never die)
-                telemetry.incr_counter(("http", "request_error"))
+                # overload/unavailable outcomes get their own status +
+                # machine-readable reason (ISSUE 13): an admission
+                # NACK (definitely-not-committed) and a leaderless
+                # write are 503s a client can discriminate, not 500s
+                mapped = _overload_response(e)
                 try:
-                    self._err(500, f"{type(e).__name__}: {e}")
+                    if mapped is not None:
+                        code, rsn = mapped
+                        self._err(code, f"{type(e).__name__}: {e}",
+                                  reason=rsn)
+                    else:
+                        # consul.http.request_error: 500s an operator
+                        # can alarm on (the handler must never die)
+                        telemetry.incr_counter(("http",
+                                                "request_error"))
+                        self._err(500, f"{type(e).__name__}: {e}")
                 except OSError:
                     pass   # client went away mid-error-response
             finally:
@@ -1050,7 +1122,7 @@ def _make_handler(srv: ApiServer):
             import urllib.request
             addr = srv.readplane.leader_http()
             if addr is None:
-                self._err(500, "No cluster leader")
+                self._err(503, "No cluster leader", reason="no-leader")
                 return True
             qs = urllib.parse.urlencode(q)
             url = addr + urllib.parse.quote(path) \
@@ -1079,8 +1151,9 @@ def _make_handler(srv: ApiServer):
                 self._err(e.code, e.read().decode(errors="replace"))
             except OSError as e:
                 # the leader died mid-forward: surface it as the
-                # no-leader error the caller retries on
-                self._err(500, f"leader read forward failed: {e}")
+                # unavailable error the caller retries on
+                self._err(503, f"leader read forward failed: {e}",
+                          reason="no-leader")
             return True
 
         def _dispatch(self, verb: str, path: str, q) -> bool:
@@ -1109,7 +1182,8 @@ def _make_handler(srv: ApiServer):
                 dec = srv.readplane.resolve(path, q, self.headers)
                 self._read_mode = dec.mode
                 if dec.action == "reject":
-                    self._err(dec.code, dec.message)
+                    self._err(dec.code, dec.message,
+                              reason=dec.reason.replace("_", "-"))
                     return True
                 if dec.action == "forward":
                     return self._forward_leader(verb, path, q)

@@ -53,14 +53,20 @@ class WatchPlan:
                 result, new_index = fetch(self.client, index, self.wait,
                                           self.params)
                 backoff = 0.5
-            except Exception:
+            except Exception as e:
                 # transient failure (agent restart, momentary 500): the
                 # reference's watch loop retries with backoff instead of
                 # dying (watch.go run loop) — counted so a flapping
-                # agent shows up in consul.watch.retry
+                # agent shows up in consul.watch.retry.  A 429 carries
+                # the limiter's Retry-After hint: honor it (capped,
+                # jittered) so parked watchers drain the overload they
+                # are part of instead of re-offering it
                 from consul_tpu import telemetry
                 telemetry.incr_counter(("watch", "retry"))
-                if self._stop.wait(backoff):
+                hint = getattr(e, "retry_after", None)
+                wait_s = backoff if hint is None \
+                    else min(max(hint, backoff), 30.0)
+                if self._stop.wait(wait_s):
                     break
                 backoff = min(backoff * 2, 30.0)
                 continue

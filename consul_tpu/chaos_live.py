@@ -196,7 +196,8 @@ class LiveServer:
     def __init__(self, name: str, rpc_port: int, http_port: int,
                  data_dir: str, peers_spec: str,
                  storage_faults: Optional[str] = None,
-                 cluster_http: Optional[str] = None):
+                 cluster_http: Optional[str] = None,
+                 rate_limit: Optional[str] = None):
         self.name = name
         self.rpc_port = rpc_port
         self.http_port = http_port
@@ -204,6 +205,7 @@ class LiveServer:
         self.peers_spec = peers_spec
         self.storage_faults = storage_faults
         self.cluster_http = cluster_http
+        self.rate_limit = rate_limit
         self.proc: Optional[subprocess.Popen] = None
         self.generation = 0
         self.paused = False
@@ -228,6 +230,8 @@ class LiveServer:
             cmd += ["--storage-faults", self.storage_faults]
         if self.cluster_http:
             cmd += ["--cluster-http", self.cluster_http]
+        if self.rate_limit:
+            cmd += ["--rate-limit", self.rate_limit]
         # per-generation log: the post-mortem evidence when a scenario
         # fails (never parsed, only for humans)
         # lint: ok=blocking-call (harness-side log file, not a tick thread)
@@ -307,7 +311,8 @@ class LiveCluster:
     cluster majority cannot)."""
 
     def __init__(self, n: int = 3, data_root: str = ".",
-                 storage_faults: Optional[str] = None):
+                 storage_faults: Optional[str] = None,
+                 rate_limit: Optional[str] = None):
         self.n = n
         # one reservation batch held CONCURRENTLY: rpc and http ports
         # are guaranteed distinct, and the proxies bind their own
@@ -346,7 +351,7 @@ class LiveCluster:
                 f"server{i}", rpc[i], http[i],
                 os.path.join(data_root, f"server{i}"), ",".join(parts),
                 storage_faults=storage_faults,
-                cluster_http=cluster_http))
+                cluster_http=cluster_http, rate_limit=rate_limit))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -493,8 +498,12 @@ class LiveLoad:
         self._hlock = threading.Lock()
         self.acked: List[Tuple[str, str]] = []        # (key, value)
         self.ambiguous: List[Tuple[str, str]] = []
+        # "rejected" = explicit server NACKs (429 rate limit / 503
+        # queue-full/deadline): definite non-writes, discarded from
+        # the history instead of widening the ambiguous set — the
+        # Wing & Gong payoff of ISSUE 13's admission control
         self.counts = {"ok": 0, "ambiguous": 0, "refused": 0,
-                       "http_error": 0}
+                       "http_error": 0, "rejected": 0}
         self._clock = threading.Lock()
         self.reg_writers = reg_writers
         self.readers = readers
@@ -567,12 +576,20 @@ class LiveLoad:
                 self._count("refused")
                 target = (target + 1) % self.cluster.n
             except ApiError as e:
-                # timeouts AND http errors (a 500 can fire after the
-                # entry was proposed) are AMBIGUOUS for a write
-                with self._hlock:
-                    self.history.ambiguous(op)
-                self._count("ambiguous" if e.ambiguous else
-                            "http_error")
+                if getattr(e, "nack", False):
+                    # explicit NACK (rate limit / apply admission):
+                    # the server proved the write never entered the
+                    # log — a definite failure, not an ambiguous op
+                    with self._hlock:
+                        self.history.discard(op)
+                    self._count("rejected")
+                else:
+                    # timeouts AND other http errors (a 500 can fire
+                    # after the entry was proposed) are AMBIGUOUS
+                    with self._hlock:
+                        self.history.ambiguous(op)
+                    self._count("ambiguous" if e.ambiguous else
+                                "http_error")
                 target = (target + 1) % self.cluster.n
             _nap(self.reg_period * (0.75 + rng.random() * 0.5))
 
@@ -673,10 +690,15 @@ class LiveLoad:
                 self._count("refused")
                 target = (target + 1) % self.cluster.n
             except ApiError as e:
-                with self._clock:
-                    self.ambiguous.append((key, val))
-                self._count("ambiguous" if e.ambiguous else
-                            "http_error")
+                if getattr(e, "nack", False):
+                    # definite non-write: not acked, not ambiguous —
+                    # the durability checker must not allow it either
+                    self._count("rejected")
+                else:
+                    with self._clock:
+                        self.ambiguous.append((key, val))
+                    self._count("ambiguous" if e.ambiguous else
+                                "http_error")
                 target = (target + 1) % self.cluster.n
             _nap(self.dur_period * (0.75 + rng.random() * 0.5))
 
@@ -789,7 +811,8 @@ class _Live:
                  check: bool = False,
                  storage_faults: Optional[str] = None,
                  budget_s: Optional[float] = None,
-                 load_kw: Optional[dict] = None):
+                 load_kw: Optional[dict] = None,
+                 rate_limit: Optional[str] = None):
         self.name = name
         self.seed = seed
         self.check = check
@@ -809,7 +832,8 @@ class _Live:
         self._closed = False
         try:
             self.cluster = LiveCluster(n=n, data_root=self._tmp.name,
-                                       storage_faults=storage_faults)
+                                       storage_faults=storage_faults,
+                                       rate_limit=rate_limit)
             self.collector = EventCollector(self.cluster)
             self.load = LiveLoad(self.cluster, seed,
                                  **(load_kw or {}))
@@ -1198,7 +1222,11 @@ def live_stale_reads_through_election(seed: int,
             try:
                 vc.kv_get(REG_KEY, max_stale="1s")
             except ApiError as e:
-                if e.code == 500 and "max_stale" in e.body:
+                # the reject is discriminable now (ISSUE 13): 503 +
+                # X-Consul-Reason: max-stale, not a bare 500 — assert
+                # on the machine-readable contract
+                if e.code == 503 and \
+                        getattr(e, "reason", None) == "max-stale":
                     saw_reject = True
                     break
             except OSError:
@@ -1259,6 +1287,118 @@ def live_stale_reads_through_election(seed: int,
                 "flight timeline")
             row["ok"] = False
         return row
+    finally:
+        lv.close()
+
+
+def live_overload_shed(seed: int, check: bool = False) -> dict:
+    """The overload survival plane under a real burst (ISSUE 13): a
+    3-proc cluster with ENFORCING ingress limits takes a write burst
+    far past its configured rate.
+
+      shed fast     a healthy fraction of burst writes must come back
+                    429 + Retry-After (the limiter fired), and every
+                    429 must land well under the client timeout — a
+                    shed that is slower than service is not a shed;
+
+      shed true     429 is a NACK: burst writes use unique keys, and
+                    after the burst NO rejected key may exist on any
+                    replica — a "rejected" write that committed would
+                    be the limiter lying about non-commitment;
+
+      serve through the background LiveLoad keeps writing under the
+                    limit through the burst, and the standard checkers
+                    (durability, linearizability, election safety)
+                    stay green — shedding the excess must protect the
+                    admitted traffic, not corrupt it."""
+    lv = _Live("live_overload_shed", seed, check=check,
+               budget_s=SMOKE_BUDGET_S if check else 180,
+               rate_limit="mode=enforcing,write_rate=60,"
+                          "write_burst=90,read_rate=800,"
+                          "read_burst=1600",
+               # trickle load well under the 60/s write budget
+               load_kw={"reg_writers": 1, "dur_writers": 1,
+                        "readers": 1, "reg_period": 0.25,
+                        "dur_period": 0.15})
+    try:
+        lv.start()
+        lv.run_for(1.0)
+        target = lv.pick("burst_target", lv.cluster.n)
+        window = lv.draw("burst_window", 2.5, 3.0 if check else 5.0)
+        lv.fault("overload_burst", f"server{target}")
+        stop_at = time.time() + window
+        outcomes: List[dict] = []
+        olock = threading.Lock()
+
+        def burster(bid: int) -> None:
+            c = lv.cluster.client(target, timeout=3.0)
+            seq = 0
+            while time.time() < stop_at:
+                key = f"burst/{bid}/{seq:05d}"
+                seq += 1
+                t0 = time.time()
+                row = {"key": key, "outcome": "ok",
+                       "lat": 0.0, "retry_after": None}
+                try:
+                    c.kv_put(key, b"x")
+                except ApiError as e:
+                    row["outcome"] = "rate_limited" \
+                        if getattr(e, "nack", False) else (
+                            "ambiguous" if e.ambiguous else "error")
+                    row["retry_after"] = getattr(e, "retry_after",
+                                                 None)
+                except OSError:
+                    row["outcome"] = "refused"
+                row["lat"] = round(time.time() - t0, 4)
+                with olock:
+                    outcomes.append(row)
+
+        bursters = [threading.Thread(target=burster, args=(b,),
+                                     daemon=True) for b in range(4)]
+        for t in bursters:
+            t.start()
+        for t in bursters:
+            t.join(timeout=window + 10.0)
+        lv.heal_mark(f"server{target}")
+        lv.run_for(1.5)
+        shed = [o for o in outcomes if o["outcome"] == "rate_limited"]
+        okd = [o for o in outcomes if o["outcome"] == "ok"]
+        lv.detail["burst"] = {
+            "ops": len(outcomes), "ok": len(okd), "shed": len(shed),
+            "max_shed_lat_s": round(
+                max((o["lat"] for o in shed), default=0.0), 3)}
+        if not shed:
+            lv.violations.append(
+                f"overload: a {len(outcomes)}-op burst against a "
+                f"60/s enforcing limiter produced ZERO 429s — "
+                f"nothing shed")
+        slow_sheds = [o for o in shed if o["lat"] > 0.5]
+        if slow_sheds:
+            lv.violations.append(
+                f"overload: {len(slow_sheds)} 429s took >0.5s — the "
+                f"shed path must be faster than service, not slower")
+        missing_hint = [o for o in shed if o["retry_after"] is None]
+        if missing_hint:
+            lv.violations.append(
+                f"overload: {len(missing_hint)} 429s arrived without "
+                f"a Retry-After hint")
+        # NACK truthfulness: no rejected key may exist anywhere —
+        # checked over ?stale local-replica dumps on every node
+        leaked = []
+        shed_keys = {o["key"] for o in shed}
+        for i in lv.cluster.alive_ids():
+            try:
+                rows = lv.cluster.client(i, timeout=3.0).kv_list(
+                    "burst/", stale=True)
+            except (ApiError, OSError):
+                continue
+            leaked += [r["Key"] for r in rows if r["Key"] in shed_keys]
+        if leaked:
+            lv.violations.append(
+                f"overload: {len(set(leaked))} rate-LIMITED writes "
+                f"exist on replicas ({sorted(set(leaked))[:3]}...) — "
+                f"a 429 must prove non-commitment")
+        return lv.finish()
     finally:
         lv.close()
 
@@ -1426,6 +1566,7 @@ LIVE_SCENARIOS = {
     "live_gateway_loss": live_gateway_loss,
     "live_stale_reads_through_election":
         live_stale_reads_through_election,
+    "live_overload_shed": live_overload_shed,
 }
 
 # the bounded tier-1 smoke (chaos_soak --check): kill -9 the leader,

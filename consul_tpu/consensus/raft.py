@@ -443,6 +443,13 @@ class RaftNode:
         with self._lock:
             return self.state == LEADER
 
+    def pending_count(self) -> int:
+        """Proposed-but-unapplied entries with live waiters — the
+        leader's in-flight apply queue depth, the quantity the
+        ApplyGate's queue_full bound admits against (ratelimit.py)."""
+        with self._lock:
+            return len(self._pending)
+
     # ------------------------------------------------- replica staleness
 
     @property

@@ -132,6 +132,24 @@ CATALOG: Dict[str, dict] = {
     # timeline's proof that lag-bounded rejects fire when they must.
     "readplane.rejected": {"severity": "warn",
                            "labels": ("reason", "route", "node")},
+    # overload defense plane (consul_tpu/ratelimit.py): an ingress
+    # request shed by the token-bucket limiter, and a leader apply
+    # NACKed before the raft append (queue_full / deadline — a
+    # definite non-write, never an ambiguous timeout).  Both emitters
+    # throttle to one row per second per class so a rejection storm
+    # cannot wash the ring of the fault that caused it.
+    "ratelimit.rejected": {"severity": "warn",
+                           "labels": ("route_class", "mode")},
+    "raft.apply.rejected": {"severity": "warn",
+                            "labels": ("reason", "pending")},
+    # stream plane: a subscriber whose bounded buffer filled without a
+    # drain (sustained lag) was EVICTED — its consumer gets a
+    # SnapshotRequired reset; `count` aggregates evictions staged in
+    # one publish/flush cycle so 10k simultaneous evictions journal a
+    # handful of rows, not 10k
+    "stream.subscriber.evicted": {"severity": "warn",
+                                  "labels": ("topic", "count",
+                                             "depth")},
 }
 
 

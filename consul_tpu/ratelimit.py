@@ -1,0 +1,368 @@
+"""Overload defense plane: ingress rate limiting + apply admission.
+
+Production traffic means overload, and before this module nothing shed
+load: every request was admitted, the leader's apply path queued
+without bound, and an overloaded cluster failed by TIMING OUT — the
+worst possible failure mode, because a timed-out write is AMBIGUOUS
+(it may have committed; Jepsen's :info outcome) and ambiguity is
+expensive everywhere downstream: clients must treat the op as
+maybe-applied, the Wing & Gong checker must explore both worlds, and
+operators cannot tell saturation from partition.
+
+The reference treats overload defense as a first-class subsystem
+(`agent/consul/rate` RequestLimitsHandler: token-bucket global write/
+read limits with a `permissive`/`enforcing`/`disabled` mode switch;
+`agent/consul/server.go`'s rpcHoldTimeout + RPCMaxBurst machinery).
+Two mechanisms here, same stance:
+
+  RateLimiter    per-client / per-route-class token buckets consulted
+                 by BOTH HTTP fronts (api/http.py `_route`,
+                 api/fastfront.py hot path) and the server RPC apply
+                 handlers.  Over-limit requests get a FAST 429 with a
+                 `Retry-After` hint and `X-Consul-Reason:
+                 rate-limited` — a definite non-write, shed in
+                 microseconds instead of timed out in seconds.  The
+                 mode switch lets operators observe (`permissive`
+                 counts + journals but admits) before they enforce.
+
+  ApplyGate      bounded-queue + deadline admission in front of the
+                 leader's `apply`/`apply_batch` (server.py).  Both
+                 checks run STRICTLY BEFORE the raft log append, so a
+                 rejection is a proof of non-commitment: the entry was
+                 never proposed, the write CANNOT exist anywhere.
+                 That turns leader overload from timeout ambiguity
+                 into an unambiguous NACK
+                 (`consul.raft.apply.rejected{reason}`), which the
+                 Wing & Gong checker counts as a definite non-write —
+                 shrinking the ambiguous-op set under chaos
+                 (tests/test_overload.py asserts the shrink).
+
+Metrics: `consul.ratelimit.{allowed,rejected}{route_class,mode}`,
+`consul.raft.apply.rejected{reason}`, `consul.raft.apply.pending`
+gauge.  Flight events `ratelimit.rejected` / `raft.apply.rejected`
+are emission-throttled (at most one per second per class) so a
+rejection storm cannot wash the flight ring of the faults that
+caused it.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from consul_tpu import telemetry
+
+MODES = ("disabled", "permissive", "enforcing")
+
+# route families subject to ingress limiting: the replicated data
+# plane.  /v1/agent, /v1/status, /v1/operator, /v1/internal stay
+# EXEMPT by design — during the overload the limiter exists for, the
+# observability surfaces (metrics federation, flight events, raft
+# config) must keep answering or the operator is blind exactly when
+# they need to see (the reference likewise scopes its limits to
+# data-plane RPCs, not the operator surface).
+_LIMITED_PREFIXES = (
+    "/v1/kv/", "/v1/catalog/", "/v1/health/", "/v1/session/",
+    "/v1/txn", "/v1/event/", "/v1/query", "/v1/coordinate/",
+)
+
+# flight-ring protection: at most one rejected-event journal entry per
+# class per this many seconds
+_EVENT_THROTTLE_S = 1.0
+
+# bounded client table: the limiter must not become its own memory
+# leak under a rotating-client attack
+_MAX_CLIENTS = 4096
+
+
+def route_class(verb: str, path: str) -> Optional[str]:
+    """The bounded {route_class} label for one request, or None when
+    the route is exempt from ingress limiting (operator surface)."""
+    if not path.startswith(_LIMITED_PREFIXES):
+        return None
+    return "read" if verb == "GET" else "write"
+
+
+class RateLimitedError(Exception):
+    """Rejected by the ingress limiter — a fast, definite 429."""
+
+    def __init__(self, rc: str, retry_after: float):
+        super().__init__(
+            f"rate limit exceeded for {rc} requests; retry after "
+            f"{retry_after:.2f}s")
+        self.route_class = rc
+        self.retry_after = retry_after
+
+
+class _Bucket:
+    __slots__ = ("tokens", "last")
+
+    def __init__(self, burst: float, now: float):
+        self.tokens = burst
+        self.last = now
+
+
+class RateLimiter:
+    """Token-bucket limiter: one global bucket per route class plus
+    one per (client, route class), where `client` is the request's ACL
+    token when present, else its peer address.  A request is admitted
+    only when BOTH buckets have a token (the reference's global limit
+    + per-caller fairness split).  Thread-safe; `disabled` mode costs
+    one attribute read on the hot path."""
+
+    def __init__(self, mode: str = "disabled",
+                 read_rate: float = 500.0, read_burst: float = 1000.0,
+                 write_rate: float = 200.0, write_burst: float = 400.0):
+        self._lock = threading.Lock()
+        self.configure(mode=mode, read_rate=read_rate,
+                       read_burst=read_burst, write_rate=write_rate,
+                       write_burst=write_burst)
+
+    def configure(self, mode: Optional[str] = None,
+                  read_rate: Optional[float] = None,
+                  read_burst: Optional[float] = None,
+                  write_rate: Optional[float] = None,
+                  write_burst: Optional[float] = None) -> None:
+        """Reconfigure live (the operator's observe-then-enforce
+        workflow: start permissive, watch the rejected counters, flip
+        to enforcing).  Buckets reset so new burst sizes take effect
+        immediately."""
+        with self._lock:
+            if mode is not None:
+                if mode not in MODES:
+                    raise ValueError(f"mode {mode!r} not one of {MODES}")
+                self.mode = mode
+            prev_r = getattr(self, "_read", (500.0, 1000.0))
+            prev_w = getattr(self, "_write", (200.0, 400.0))
+            if read_rate is not None or read_burst is not None:
+                r = float(read_rate) if read_rate is not None \
+                    else prev_r[0]
+                self._read = (r, float(read_burst)
+                              if read_burst is not None else r * 2)
+            else:
+                self._read = prev_r
+            if write_rate is not None or write_burst is not None:
+                w = float(write_rate) if write_rate is not None \
+                    else prev_w[0]
+                self._write = (w, float(write_burst)
+                               if write_burst is not None else w * 2)
+            else:
+                self._write = prev_w
+            now = time.monotonic()
+            self._global: Dict[str, _Bucket] = {
+                "read": _Bucket(self._read[1], now),
+                "write": _Bucket(self._write[1], now)}
+            # (client, class) -> bucket; bounded, LRU-ish eviction
+            self._clients: Dict[Tuple[str, str], _Bucket] = {}
+            self._last_event: Dict[str, float] = {}
+
+    # ------------------------------------------------------------- checking
+
+    def _params(self, rc: str) -> Tuple[float, float]:
+        return self._read if rc == "read" else self._write
+
+    @staticmethod
+    def _take(b: _Bucket, rate: float, burst: float,
+              now: float) -> Optional[float]:
+        """Refill + take one token; None on success, else seconds
+        until a token exists (the Retry-After hint).  Elapsed time is
+        clamped non-negative: callers may mix clock bases (tests pin
+        `now`), and a negative elapse must never DRAIN the bucket."""
+        b.tokens = min(burst, b.tokens + max(0.0, now - b.last) * rate)
+        b.last = now
+        if b.tokens >= 1.0:
+            b.tokens -= 1.0
+            return None
+        return (1.0 - b.tokens) / rate if rate > 0 else 1.0
+
+    def check(self, client: str, rc: str,
+              now: Optional[float] = None) -> Optional[float]:
+        """Admit one request for `client` on route class `rc`.
+
+        Returns None when admitted; else the Retry-After hint in
+        seconds — in `enforcing` mode the caller must shed (429), in
+        `permissive` mode the over-limit request was counted and
+        journaled but None is returned (admitted)."""
+        mode = self.mode
+        if mode == "disabled":
+            return None
+        rate, burst = self._params(rc)
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            wait_g = self._take(self._global[rc], rate, burst, now)
+            # per-client fairness bucket: a single hot client exhausts
+            # its own allowance (half the global rate) before it can
+            # starve the global bucket for everyone
+            ckey = (client, rc)
+            cb = self._clients.get(ckey)
+            if cb is None:
+                if len(self._clients) >= _MAX_CLIENTS:
+                    # evict the stalest entry: bounded memory beats
+                    # perfect fairness under client churn
+                    oldest = min(self._clients,
+                                 key=lambda k: self._clients[k].last)
+                    del self._clients[oldest]
+                cb = self._clients[ckey] = _Bucket(burst, now)
+            wait_c = self._take(cb, rate, burst, now)
+            wait = wait_g if wait_c is None else wait_c \
+                if wait_g is None else max(wait_g, wait_c)
+            journal = False
+            if wait is not None:
+                last = self._last_event.get(rc)
+                if last is None or now - last >= _EVENT_THROTTLE_S:
+                    self._last_event[rc] = now
+                    journal = True
+        labels = {"route_class": rc, "mode": mode}
+        if wait is None:
+            telemetry.incr_counter(("ratelimit", "allowed"),
+                                   labels=labels)
+            return None
+        telemetry.incr_counter(("ratelimit", "rejected"), labels=labels)
+        if journal:
+            from consul_tpu import flight
+            flight.emit("ratelimit.rejected",
+                        labels={"route_class": rc, "mode": mode})
+        if mode == "permissive":
+            return None
+        return wait
+
+
+# ---------------------------------------------------------------------------
+# apply-path admission control
+# ---------------------------------------------------------------------------
+
+
+class ApplyRejectedError(Exception):
+    """The leader NACKed an apply BEFORE appending it to the raft log:
+    the write was never proposed and therefore definitely did not —
+    and never will — commit.  `reason` is `queue_full` (the pending
+    apply queue is at its bound) or `deadline` (the caller's shipped
+    RPC budget cannot cover even the floor of a commit wait, so
+    admitting it could only produce an ambiguous timeout).
+
+    The whole point of this error is its non-ambiguity: api/client.py
+    maps it to a definite failure (ambiguous=False), and the Wing &
+    Gong checker treats it as a definite non-write."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(
+            f"apply rejected reason={reason}"
+            + (f" {detail}" if detail else ""))
+        self.reason = reason
+
+    @classmethod
+    def from_rpc(cls, message: str) -> Optional["ApplyRejectedError"]:
+        """Reconstruct from the RPC error string
+        (`"ApplyRejectedError: apply rejected reason=<r> ..."`) so a
+        forwarded NACK stays a NACK on the follower that forwarded —
+        re-wrapping it as a generic RpcError would launder the
+        definite failure back into ambiguity."""
+        marker = "apply rejected reason="
+        at = message.find(marker)
+        if at < 0:
+            return None
+        reason = message[at + len(marker):].split()[0].strip()
+        return cls(reason or "queue_full")
+
+
+class ApplyGate:
+    """Bounded-queue + deadline admission for the leader apply path.
+
+    `max_pending` bounds the number of proposed-but-unapplied raft
+    entries (the leader's in-flight apply queue — RaftNode._pending);
+    `min_budget_s` is the commit-wait floor below which admitting a
+    write can only end in an ambiguous timeout.  A commit-latency EMA
+    (fed by the apply handlers' observed waits) tightens the deadline
+    check under sustained load: when recent commits take longer than
+    the caller's whole remaining budget, NACK now rather than time
+    out later."""
+
+    def __init__(self, max_pending: int = 4096,
+                 min_budget_s: float = 0.05, enabled: bool = True):
+        self.max_pending = int(max_pending)
+        self.min_budget_s = float(min_budget_s)
+        self.enabled = enabled
+        self._ema_commit_s = 0.0
+        self._last_event = 0.0
+        self._lock = threading.Lock()
+
+    def observe_commit(self, seconds: float) -> None:
+        """Feed one observed commit wait into the deadline EMA."""
+        with self._lock:
+            e = self._ema_commit_s
+            self._ema_commit_s = seconds if e == 0.0 \
+                else 0.9 * e + 0.1 * seconds
+
+    def reject_reason(self, pending: int, n_items: int,
+                      budget_s: float) -> Optional[str]:
+        if not self.enabled:
+            return None
+        if pending + n_items > self.max_pending:
+            return "queue_full"
+        if budget_s <= self.min_budget_s:
+            return "deadline"
+        with self._lock:
+            ema = min(self._ema_commit_s, 2.0)
+        # the EMA influence is deliberately conservative (half the
+        # recent commit latency, capped): a single slow commit must
+        # not flip the gate into rejecting everything
+        if ema > 0.0 and budget_s < 0.5 * ema:
+            return "deadline"
+        return None
+
+    def admit(self, pending: int, n_items: int,
+              budget_s: float) -> None:
+        """Raise ApplyRejectedError (and count/journal it) when this
+        batch must be shed; otherwise record the pending gauge.
+        Runs on RPC handler / HTTP request threads — never the raft
+        tick thread — so direct emission is safe."""
+        reason = self.reject_reason(pending, n_items, budget_s)
+        telemetry.set_gauge(("raft", "apply", "pending"),
+                            float(pending))
+        if reason is None:
+            return
+        telemetry.incr_counter(("raft", "apply", "rejected"),
+                               labels={"reason": reason})
+        now = time.monotonic()
+        with self._lock:
+            journal = now - self._last_event >= _EVENT_THROTTLE_S
+            if journal:
+                self._last_event = now
+        if journal:
+            from consul_tpu import flight
+            flight.emit("raft.apply.rejected",
+                        labels={"reason": reason, "pending": pending})
+        raise ApplyRejectedError(
+            reason, detail=f"pending={pending} n={n_items} "
+                           f"budget={budget_s:.3f}s")
+
+
+def retry_after_header(wait_s: float) -> str:
+    """Retry-After is whole seconds on the wire (RFC 9110); always at
+    least 1 so a client honoring it actually backs off."""
+    return str(max(1, math.ceil(wait_s)))
+
+
+def parse_limit_spec(spec: str) -> dict:
+    """"mode=enforcing,write_rate=50,write_burst=100,
+    apply_max_pending=512" → kwargs split between RateLimiter.configure
+    and the ApplyGate (tools/server_proc.py --rate-limit; env
+    CONSUL_TPU_RATE_LIMIT)."""
+    out: dict = {}
+    for part in spec.split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        k = k.strip()
+        if k == "mode":
+            out["mode"] = v.strip()
+        elif k in ("read_rate", "read_burst", "write_rate",
+                   "write_burst", "apply_min_budget"):
+            out[k] = float(v)
+        elif k in ("apply_max_pending",):
+            out[k] = int(v)
+        else:
+            raise ValueError(f"unknown rate-limit key {k!r}")
+    return out

@@ -26,8 +26,9 @@ request:
               received-but-unapplied entry age, the follower-side
               sibling of the PR 10 `_append_ts` lag machinery) must
               not exceed the caller's bound, else the read is REJECTED
-              with 500 (`consul.readplane.rejected{reason="max_stale"}`
-              + a `readplane.rejected` flight event).  The reference
+              with 503 + `X-Consul-Reason: max-stale`
+              (`consul.readplane.rejected{reason="max_stale"}` + a
+              `readplane.rejected` flight event).  The reference
               re-forwards to the leader instead; rejecting keeps the
               contract visible and lets a client-side LB retry a
               fresher replica.
@@ -200,8 +201,12 @@ class ReadPlane:
                 bound = parse_max_stale(max_stale)
                 lag = self.staleness_s()
                 if lag > bound:
+                    # 503 (unavailable: THIS replica cannot honor the
+                    # bound right now — retry a fresher one), not a
+                    # 500: the condition is operational, not a bug,
+                    # and clients discriminate on X-Consul-Reason
                     return self._reject(
-                        dec, 500, "max_stale",
+                        dec, 503, "max_stale",
                         f"stale read refused: replica lag "
                         f"{'inf' if lag == float('inf') else round(lag, 3)}s"
                         f" exceeds max_stale {bound:g}s")
@@ -221,7 +226,7 @@ class ReadPlane:
             # loop guard: the forwarder believed we were leader and we
             # are not — bounce rather than chase a moving leader hint
             return self._reject(
-                dec, 500, "not_leader",
+                dec, 503, "not_leader",
                 "not the leader (stale read-forward hint); retry")
         nodes = self._cluster_nodes()
         if not nodes:
@@ -233,7 +238,7 @@ class ReadPlane:
         if target is None:
             if not self.known_leader():
                 return self._reject(
-                    dec, 500, "no_leader", "No cluster leader")
+                    dec, 503, "no_leader", "No cluster leader")
             # leader known but not in the fleet map: local, degraded
             return dec
         dec.action = "forward"

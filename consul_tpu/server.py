@@ -124,6 +124,16 @@ class Server:
         self.auto_config_settings: Dict[str, Any] = {}
         from consul_tpu.autopilot import Autopilot
         self.autopilot = Autopilot(self)
+        # apply-path admission control (ISSUE 13): bounded-queue +
+        # deadline admission STRICTLY BEFORE the raft append, so a
+        # rejection is an unambiguous NACK — the write was never
+        # proposed (consul_tpu/ratelimit.py ApplyGate).  Set to None
+        # (or .enabled = False) to disable; leader-internal housekeeping
+        # (_leader_propose: session reaping, member reconcile) bypasses
+        # the gate by design — shedding the reconciler would trade
+        # overload for unbounded catalog drift.
+        from consul_tpu.ratelimit import ApplyGate
+        self.apply_gate: Optional[ApplyGate] = ApplyGate()
 
     # --------------------------------------------------------------- rpc net
 
@@ -366,6 +376,17 @@ class Server:
                         it["error"] = e
                         it["event"].set()
 
+    def _admit_apply(self, n_items: int, budget_s: float) -> None:
+        """Apply-path admission (ratelimit.ApplyGate): NACK — raise
+        ApplyRejectedError — when the pending apply queue is at its
+        bound or the caller's remaining budget cannot cover a commit
+        wait.  Called strictly BEFORE raft.apply_many so a rejection
+        proves non-commitment."""
+        gate = self.apply_gate
+        if gate is None or not gate.enabled:
+            return
+        gate.admit(self.raft.pending_count(), n_items, budget_s)
+
     def _handle_rpc(self, method: str, args: dict):
         """Server-side forwarded calls (the RPC endpoints the mux routes
         to, agent/consul/rpc.go:130).  'apply' rejects at a non-leader —
@@ -392,14 +413,21 @@ class Server:
             # or the definitive response lands after it hung up.
             wait_s = max(0.05,
                          _apply_wait_budget(args) - (time.time() - t_in))
+            # admission BEFORE the append: a NACK here proves the
+            # write never entered the log (ratelimit.ApplyGate)
+            self._admit_apply(1, wait_s)
             with trace.span("leader.apply", trace_id=args.get("trace"),
                             op=args.get("op"), node=self.node_id):
+                t_commit = time.perf_counter()
                 pend = self.raft.apply_many(
                     [{"op": args["op"],
                       "args": args.get("args") or {}}],
                     trace_ids=[args.get("trace")])[0]
                 if not pend.event.wait(wait_s):
                     raise TimeoutError("apply timed out")
+                if self.apply_gate is not None:
+                    self.apply_gate.observe_commit(
+                        time.perf_counter() - t_commit)
             if pend.error is not None:
                 raise pend.error
             return pend.result
@@ -412,6 +440,14 @@ class Server:
             if not self.raft.is_leader() \
                     and not self._hold_for_leader(_apply_wait_budget(args)):
                 raise NotLeaderError(self.raft.leader_id)
+            # batch admission: admit or shed the batch as a unit —
+            # the coalescer already grouped these callers, and a
+            # partial admit would hand half of them a NACK whose
+            # reason ("queue_full") the other half just caused
+            self._admit_apply(
+                len(args["items"]),
+                max(0.05, _apply_wait_budget(args)
+                    - (time.time() - t_in)))
             t_wall, t0 = time.time(), time.perf_counter()
             pends = self.raft.apply_many(
                 [{"op": it["op"], "args": it.get("args") or {}}
@@ -441,6 +477,10 @@ class Server:
             # one leader.apply span per batched item, each under ITS
             # caller's trace id (the shared wait is the group commit)
             dur = time.perf_counter() - t0
+            if self.apply_gate is not None and any(
+                    e is None for e in errors):
+                # feed the deadline EMA only from commits that landed
+                self.apply_gate.observe_commit(dur)
             for it in args["items"]:
                 trace.record("leader.apply", it.get("trace"), t_wall,
                              dur, op=it.get("op"), node=self.node_id,
@@ -673,7 +713,13 @@ class Server:
 
     def raft_apply(self, op: str, timeout: float = 5.0, **args) -> Any:
         """Propose on the leader (forwarding like ForwardRPC, rpc.go:549)
-        and wait for FSM apply.  Retries once across leader changes."""
+        and wait for FSM apply.  Retries once across leader changes.
+
+        An ApplyRejectedError — the leader's admission NACK — escapes
+        IMMEDIATELY, never retried: the NACK is load shedding, and a
+        retry loop would re-offer the exact load the gate just shed
+        (clients back off with their own policy instead)."""
+        from consul_tpu.ratelimit import ApplyRejectedError
         from consul_tpu.rpc import RpcError
         deadline = time.time() + timeout
         last_err: Optional[Exception] = None
@@ -711,10 +757,21 @@ class Server:
                         last_err = RpcError("empty apply result")
                     except (RpcError, TimeoutError,
                             NoLeaderError) as e:
+                        # a forwarded admission NACK arrives as an
+                        # RpcError string — reconstruct it so the NACK
+                        # stays a definite failure on this side too
+                        rej = ApplyRejectedError.from_rpc(str(e))
+                        if rej is not None:
+                            raise rej from None
                         last_err = e
                 _pause()
                 continue
             try:
+                # in-process leader: same admission the RPC handlers
+                # run, before the append (the NACK escapes — see
+                # docstring)
+                target._admit_apply(
+                    1, max(0.05, deadline - time.time()))
                 pend = target.raft.apply({"op": op, "args": args})
             except NotLeaderError as e:
                 last_err = e

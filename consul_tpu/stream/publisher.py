@@ -71,6 +71,17 @@ class SnapshotRequired(Exception):
 # ROADMAP item 2's 1M-watcher fan-out
 SLOW_QUEUE_DEPTH = 128
 
+# the per-subscriber buffer BOUND (ISSUE 13): a queue that reaches
+# this many undrained batches IS sustained lag — the subscriber is
+# evicted (closed; its consumer gets a SnapshotRequired reset and must
+# re-snapshot), journaled as stream.subscriber.evicted.  Eviction
+# happens strictly before the bound would silently drop a batch, so
+# delivered streams are never holey — a consumer either sees every
+# batch or sees the reset.  This is the contract that lets 10k wedged
+# watchers cost the publisher nothing after their bound fills
+# (tests/test_overload.py).
+MAX_SUB_QUEUE = 1024
+
 
 @dataclass
 class _Sub:
@@ -79,8 +90,13 @@ class _Sub:
     next_index: int
     cond: threading.Condition
     closed: bool = False
-    queue: deque = field(default_factory=deque)
+    # bounded by construction (the bounded-queue lint rule); the
+    # publisher evicts at maxlen-1 so the deque's own drop-oldest
+    # behavior is a dead backstop, never a silent data loss
+    queue: deque = field(
+        default_factory=lambda: deque(maxlen=MAX_SUB_QUEUE))
     slow_depth: int = 0                # max depth seen while backed up
+    evicted: bool = False
 
 
 class Subscription:
@@ -98,8 +114,17 @@ class Subscription:
         with s.cond:
             if not s.queue and not s.closed:
                 s.cond.wait(timeout)
-            if s.closed:
-                raise SnapshotRequired("subscription reset")
+            closed, evicted = s.closed, s.evicted
+        if closed:
+            # the reset drain is a consumer-side flush point too: an
+            # eviction staged during publish must reach the flight
+            # ring even when the EVICTED consumer is the only one
+            # still draining (no healthy sub left to flush it)
+            self._pub._flush_stats()
+            raise SnapshotRequired(
+                "subscriber evicted after sustained lag"
+                if evicted else "subscription reset")
+        with s.cond:
             out: List[Event] = []
             depth = len(s.queue)
             while s.queue:
@@ -145,9 +170,13 @@ class EventPublisher:
     # correlation through it
     visibility = None
 
-    def __init__(self, buffer_len: int = 1024):
+    def __init__(self, buffer_len: int = 1024,
+                 max_sub_queue: int = MAX_SUB_QUEUE):
         self._lock = threading.Lock()
         self._buffer_len = buffer_len
+        # per-subscriber buffer bound (eviction threshold); tests
+        # shrink it to exercise the eviction contract cheaply
+        self._max_sub_queue = max(2, int(max_sub_queue))
         # topic -> deque[(index, [Event])]
         self._buffers: Dict[str, deque] = {}
         # topic -> highest index evicted off the buffer tail (0 = nothing
@@ -162,6 +191,10 @@ class EventPublisher:
         self._stats_lock = threading.Lock()
         self._fanout_stats: Dict[str, int] = {}
         self._evict_stats: Dict[str, int] = {}
+        # staged SUBSCRIBER evictions: topic -> [count, max depth],
+        # aggregated so a mass eviction journals one flight row per
+        # topic per flush, not one per subscriber
+        self._sub_evict_stats: Dict[str, list] = {}
 
     # ----------------------------------------------------------- publishing
 
@@ -182,6 +215,7 @@ class EventPublisher:
                 buf.append((evs[0].index, evs))
             subs = list(self._subs)
         fanout: Dict[str, int] = {t: 0 for t in by_topic}
+        evicted_subs: List[_Sub] = []
         for s in subs:
             mine = [e for e in by_topic.get(s.topic, ())
                     if s.key is None or e.key == s.key]
@@ -189,18 +223,47 @@ class EventPublisher:
                 continue
             fanout[s.topic] += 1
             with s.cond:
-                s.queue.append(mine)
+                if s.closed:
+                    continue
                 depth = len(s.queue)
+                if depth >= (s.queue.maxlen or MAX_SUB_QUEUE) - 1:
+                    # sustained lag: the bounded buffer filled without
+                    # a single drain — EVICT rather than let the deque
+                    # silently drop the oldest batch (a holey stream
+                    # would be corruption; a reset is a contract).
+                    # The consumer's next events() raises
+                    # SnapshotRequired; materializers re-snapshot.
+                    s.closed = True
+                    s.evicted = True
+                    s.queue.clear()
+                    s.cond.notify_all()
+                    evicted_subs.append(s)
+                    continue
+                s.queue.append(mine)
+                depth += 1
                 if depth > SLOW_QUEUE_DEPTH and depth > s.slow_depth:
                     # flag only — the consumer journals the slow event
                     # when it drains; publish may run under the store
                     # lock and must not emit
                     s.slow_depth = depth
                 s.cond.notify_all()
+        if evicted_subs:
+            # drop evicted subs from the registry so the NEXT publish
+            # no longer pays their fan-out cost (the whole point: 10k
+            # wedged watchers cost one eviction pass, then nothing)
+            with self._lock:
+                for s in evicted_subs:
+                    if s in self._subs:
+                        self._subs.remove(s)
         with self._stats_lock:
             self._fanout_stats.update(fanout)
             for t in evicted:
                 self._evict_stats[t] = self._evict_stats.get(t, 0) + 1
+            for s in evicted_subs:
+                row = self._sub_evict_stats.setdefault(s.topic, [0, 0])
+                row[0] += 1
+                row[1] = max(row[1],
+                             (s.queue.maxlen or MAX_SUB_QUEUE) - 1)
 
     def _flush_stats(self) -> None:
         """Emit staged per-topic gauges/counters — called from
@@ -209,7 +272,9 @@ class EventPublisher:
         with self._stats_lock:
             fanout, self._fanout_stats = self._fanout_stats, {}
             evicts, self._evict_stats = self._evict_stats, {}
-        if not fanout and not evicts:
+            sub_evicts, self._sub_evict_stats = \
+                self._sub_evict_stats, {}
+        if not fanout and not evicts and not sub_evicts:
             return
         from consul_tpu import telemetry
         for topic, n in fanout.items():
@@ -218,6 +283,14 @@ class EventPublisher:
         for topic, n in evicts.items():
             telemetry.incr_counter(("stream", "evicted"), float(n),
                                    labels={"topic": topic})
+        for topic, (n, depth) in sub_evicts.items():
+            telemetry.incr_counter(
+                ("stream", "subscriber", "evicted"), float(n),
+                labels={"topic": topic})
+            from consul_tpu import flight
+            flight.emit("stream.subscriber.evicted",
+                        labels={"topic": topic, "count": n,
+                                "depth": depth})
 
     # --------------------------------------------------------- subscription
 
@@ -232,7 +305,8 @@ class EventPublisher:
         check — for consumers that snapshot state themselves right after
         subscribing (submatview materializers)."""
         sub = _Sub(topic=topic, key=key, next_index=since_index or 0,
-                   cond=threading.Condition())
+                   cond=threading.Condition(),
+                   queue=deque(maxlen=self._max_sub_queue))
         n = None
         try:
             with self._lock:
@@ -250,6 +324,13 @@ class EventPublisher:
                 replay = [[e for e in evs if key is None or e.key == key]
                           for idx, evs in buf if idx > since_index]
                 replay = [b for b in replay if b]
+                if len(replay) >= (sub.queue.maxlen or MAX_SUB_QUEUE):
+                    # the backlog alone overflows the subscriber's
+                    # bounded buffer: appending would silently drop
+                    # its head — a fresh snapshot is the honest answer
+                    raise SnapshotRequired(
+                        f"replay of {len(replay)} batches exceeds the "
+                        f"subscriber buffer bound")
                 for b in replay:
                     sub.queue.append(b)
                 self._subs.append(sub)

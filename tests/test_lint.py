@@ -1066,3 +1066,154 @@ def test_readplane_event_and_metric_names_registered():
     assert len(hits) == 2
     assert "label 'planet' not declared" in msgs
     assert "unregistered event name 'readplane.exploded'" in msgs
+
+
+# --------------------------------------------------- ISSUE 13: the
+# bounded-queue checker + the overload-plane vocabulary
+
+
+def test_bounded_queue_fires_and_stays_silent():
+    bad = """
+        import queue
+        from collections import deque
+
+        def build():
+            inbox = deque()
+            jobs = queue.Queue()
+            lifo = queue.LifoQueue(0)
+            return inbox, jobs, lifo
+    """
+    hits = check_snippet("bounded-queue", bad,
+                         relpath="consul_tpu/rpc/snippet.py")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert "deque() without maxlen" in msgs
+    assert "queue.Queue() without a positive maxsize" in msgs
+    assert "queue.LifoQueue() without a positive maxsize" in msgs
+
+    clean = """
+        import queue
+        from collections import deque
+
+        def build():
+            inbox = deque(maxlen=1024)
+            replay = deque([1, 2], 16)
+            jobs = queue.Queue(maxsize=256)
+            return inbox, replay, jobs
+    """
+    assert check_snippet("bounded-queue", clean,
+                         relpath="consul_tpu/rpc/snippet.py") == []
+
+
+def test_bounded_queue_sees_through_aliases_and_factories():
+    """`from collections import deque as dq` and the dataclass
+    `default_factory=deque` spelling (the publisher's pre-eviction
+    per-subscriber queue) must not slip past; a lambda-wrapped bounded
+    factory stays silent."""
+    bad = """
+        import queue as q
+        from collections import deque as dq
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Sub:
+            queue: dq = field(default_factory=dq)
+
+        def build():
+            return dq(), q.Queue()
+    """
+    hits = check_snippet("bounded-queue", bad,
+                         relpath="consul_tpu/stream/snippet.py")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert "default_factory=dq" in msgs
+
+    clean = """
+        from collections import deque
+        from dataclasses import dataclass, field
+
+        @dataclass
+        class Sub:
+            queue: deque = field(
+                default_factory=lambda: deque(maxlen=64))
+    """
+    assert check_snippet("bounded-queue", clean,
+                         relpath="consul_tpu/stream/snippet.py") == []
+
+
+def test_bounded_queue_scoped_to_the_request_path():
+    """Out-of-scope modules (chaos harnesses, tools) keep their
+    unbounded queues — the rule binds the request path only; the
+    unboundable SimpleQueue fires in scope."""
+    snippet = """
+        from collections import deque
+
+        def build():
+            return deque()
+    """
+    assert check_snippet("bounded-queue", snippet,
+                         relpath="consul_tpu/chaos.py") == []
+    assert len(check_snippet("bounded-queue", snippet,
+                             relpath="consul_tpu/api/http.py")) == 1
+    simple = """
+        import queue
+
+        def build():
+            return queue.SimpleQueue()
+    """
+    hits = check_snippet("bounded-queue", simple,
+                         relpath="consul_tpu/consensus/snippet.py")
+    assert len(hits) == 1 and "cannot be bounded" in hits[0].message
+
+
+def test_overload_event_and_metric_names_registered():
+    """ISSUE 13's vocabulary: ratelimit.rejected / raft.apply.rejected
+    / stream.subscriber.evicted are CATALOG-registered with their
+    declared labels, and the consul.ratelimit.* / consul.raft.apply.*
+    metric families conform; undeclared labels and unregistered
+    siblings still fire."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def shed(rc, mode, reason, pending, topic, n, depth):
+            flight.emit("ratelimit.rejected",
+                        labels={"route_class": rc, "mode": mode})
+            flight.emit("raft.apply.rejected",
+                        labels={"reason": reason, "pending": pending})
+            flight.emit("stream.subscriber.evicted",
+                        labels={"topic": topic, "count": n,
+                                "depth": depth})
+            telemetry.incr_counter(("ratelimit", "allowed"),
+                                   labels={"route_class": rc,
+                                           "mode": mode})
+            telemetry.incr_counter(("ratelimit", "rejected"),
+                                   labels={"route_class": rc,
+                                           "mode": mode})
+            telemetry.incr_counter(("raft", "apply", "rejected"),
+                                   labels={"reason": reason})
+            telemetry.set_gauge(("raft", "apply", "pending"),
+                                float(pending))
+            telemetry.incr_counter(
+                ("stream", "subscriber", "evicted"), float(n),
+                labels={"topic": topic})
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+
+    bad = """
+        from consul_tpu import flight
+
+        def shed(rc, reason):
+            flight.emit("ratelimit.rejected",
+                        labels={"route_class": rc, "victim": "x"})
+            flight.emit("ratelimit.vaporized",
+                        labels={"route_class": rc})
+            flight.emit("raft.apply.rejected",
+                        labels={"reason": reason, "speed": 9})
+    """
+    hits = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 3
+    assert "label 'victim' not declared" in msgs
+    assert "unregistered event name 'ratelimit.vaporized'" in msgs
+    assert "label 'speed' not declared" in msgs

@@ -1,0 +1,422 @@
+"""Overload survival plane (ISSUE 13): ingress rate limiting, apply
+admission NACKs, and the subscriber-eviction contract.
+
+The acceptance bars live here:
+
+  * under leader overload, writes fail FAST as unambiguous NACKs, and
+    the Wing & Gong ambiguous-op count is STRICTLY LOWER than the same
+    scenario with admission control disabled;
+  * 10k deliberately-slow stream consumers cannot stall publish
+    latency for healthy watchers nor wedge submatview materialization,
+    and the evictions land in the flight timeline;
+  * both HTTP fronts shed over-limit requests with 429 + Retry-After +
+    X-Consul-Reason, the client maps the taxonomy (rate limit and
+    apply NACKs are ambiguous=False), and overload/unavailable
+    responses are discriminable from 500s.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu import flight, ratelimit
+from consul_tpu.api.client import (
+    ApiError, ApiOverloadError, ApiRateLimitError, Client,
+    retry_backoff,
+)
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.ratelimit import (
+    ApplyGate, ApplyRejectedError, RateLimiter, route_class,
+)
+from consul_tpu.stream.publisher import (
+    Event, EventPublisher, SnapshotRequired,
+)
+
+
+# ---------------------------------------------------------------------------
+# RateLimiter unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_token_bucket_admits_burst_then_sheds_with_hint():
+    rl = RateLimiter(mode="enforcing", write_rate=10.0, write_burst=3.0)
+    assert [rl.check("c", "write", now=0.0) for _ in range(3)] \
+        == [None, None, None]
+    wait = rl.check("c", "write", now=0.0)
+    assert wait is not None and 0.0 < wait <= 0.2
+    # refill: after the hinted wait a token exists again
+    assert rl.check("c", "write", now=wait + 1e-6) is None
+
+
+def test_permissive_mode_counts_but_admits():
+    rl = RateLimiter(mode="permissive", write_rate=1.0, write_burst=1.0)
+    assert rl.check("c", "write", now=0.0) is None
+    # over-limit, but permissive: admitted (None), counted as rejected
+    from consul_tpu import telemetry
+    before = _counter("consul.ratelimit.rejected",
+                      {"route_class": "write", "mode": "permissive"})
+    assert rl.check("c", "write", now=0.0) is None
+    assert _counter("consul.ratelimit.rejected",
+                    {"route_class": "write",
+                     "mode": "permissive"}) == before + 1
+
+
+def test_disabled_mode_is_free_and_route_classes_bound():
+    rl = RateLimiter()      # disabled default
+    assert rl.mode == "disabled"
+    assert rl.check("c", "write") is None
+    assert route_class("PUT", "/v1/kv/x") == "write"
+    assert route_class("GET", "/v1/health/service/web") == "read"
+    # the operator surface is exempt: visibility survives overload
+    assert route_class("GET", "/v1/agent/metrics") is None
+    assert route_class("GET", "/v1/operator/raft/configuration") is None
+
+
+def test_per_client_fairness_and_bounded_table():
+    rl = RateLimiter(mode="enforcing", write_rate=1e-9,
+                     write_burst=2.0)
+    # one hot client exhausts ITS bucket; a different client still has
+    # its own allowance even with the global bucket shared
+    assert rl.check("hog", "write", now=0.0) is None
+    assert rl.check("hog", "write", now=0.0) is None
+    assert rl.check("hog", "write", now=0.0) is not None
+    # table stays bounded under client churn
+    for i in range(ratelimit._MAX_CLIENTS + 50):
+        rl.check(f"client{i}", "write", now=float(i))
+    assert len(rl._clients) <= ratelimit._MAX_CLIENTS
+
+
+def test_rejected_flight_event_is_throttled():
+    rec = flight.FlightRecorder(forward_to_log=False)
+    rl = RateLimiter(mode="enforcing", write_rate=0.001,
+                     write_burst=1.0)
+    with flight.use(rec):
+        for i in range(50):
+            rl.check("c", "write", now=0.001 * i)   # all within 1s
+    rows = rec.read(name="ratelimit.rejected")
+    assert len(rows) == 1       # 49 rejections, ONE journal row
+
+
+def _counter(name, labels):
+    from consul_tpu import telemetry
+    for row in telemetry.default_registry().dump()["Counters"]:
+        if row["Name"] == name and (row.get("Labels") or {}) == labels:
+            return row["Count"]
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# ApplyGate + the ambiguity-shrink acceptance
+# ---------------------------------------------------------------------------
+
+
+def test_apply_gate_reasons():
+    g = ApplyGate(max_pending=8, min_budget_s=0.05)
+    assert g.reject_reason(0, 1, 1.0) is None
+    assert g.reject_reason(8, 1, 1.0) == "queue_full"
+    assert g.reject_reason(0, 1, 0.05) == "deadline"
+    # EMA influence: recent commits slower than the caller's whole
+    # budget NACK now instead of timing out later
+    for _ in range(20):
+        g.observe_commit(1.0)
+    assert g.reject_reason(0, 1, 0.2) == "deadline"
+    assert g.reject_reason(0, 1, 0.8) is None
+    g.enabled = False
+    assert g.reject_reason(99, 1, 0.0) is None
+
+
+def test_apply_rejected_error_rpc_roundtrip():
+    e = ApplyRejectedError("queue_full", detail="pending=9")
+    wire = f"{type(e).__name__}: {e}"          # rpc/net.py format
+    back = ApplyRejectedError.from_rpc(wire)
+    assert back is not None and back.reason == "queue_full"
+    assert ApplyRejectedError.from_rpc("TimeoutError: slow") is None
+
+
+def _run_overload(gate: bool, n_writes: int = 10,
+                  timeout: float = 0.15):
+    """Drive writes at a leader whose cluster is NOT ticking (commits
+    frozen — the overload stand-in): with the gate, writes past the
+    bound NACK instantly; without it, every write times out ambiguous.
+    Returns (ambiguous, rejected, values_attempted, cluster)."""
+    from consul_tpu.server import NoLeaderError, ServerCluster
+    cluster = ServerCluster(3, seed=5)
+    leader = cluster.wait_leader()
+    if gate:
+        leader.apply_gate = ApplyGate(max_pending=3,
+                                      min_budget_s=0.01)
+    else:
+        leader.apply_gate = None
+    ambiguous, rejected = [], []
+    for i in range(n_writes):
+        val = f"v{i}"
+        try:
+            leader.raft_apply("kv_set", timeout=timeout, key="reg",
+                              value=val, flags=0, cas=None,
+                              acquire=None, release=None)
+        except ApplyRejectedError:
+            rejected.append(val)
+        except NoLeaderError:
+            # timed out: the entry may be in the log — ambiguous
+            ambiguous.append(val)
+    return ambiguous, rejected, cluster
+
+
+def test_admission_shrinks_the_ambiguous_set():
+    """The ISSUE 13 acceptance: same frozen-leader overload, with vs
+    without admission control — the ambiguous-op count with the gate
+    is STRICTLY lower, every NACK is a definite non-write (the value
+    never appears after the cluster resumes), and the admitted writes
+    commit normally."""
+    amb_gated, rejected, cluster = _run_overload(gate=True)
+    try:
+        assert rejected, "the gate never fired"
+        assert len(amb_gated) <= 3      # only the in-queue writes
+        # resume the cluster: frozen applies commit, NACKed ones must
+        # not exist anywhere, ever
+        cluster.step(2.0)
+        final = cluster.leader().store.kv_get("reg")
+        assert final is not None
+        committed = final["value"].decode()
+        assert committed in amb_gated
+        assert committed not in rejected
+        # every replica agrees nothing rejected ever applied
+        for s in cluster.servers:
+            row = s.store.kv_get("reg")
+            assert row is None or \
+                row["value"].decode() not in rejected
+    finally:
+        pass
+    amb_plain, rejected_plain, cluster2 = _run_overload(gate=False)
+    assert rejected_plain == []
+    assert len(amb_gated) < len(amb_plain), (
+        f"admission control must strictly shrink the ambiguous set "
+        f"({len(amb_gated)} vs {len(amb_plain)})")
+
+
+def test_gate_rejections_count_and_journal():
+    rec = flight.FlightRecorder(forward_to_log=False)
+    g = ApplyGate(max_pending=2, min_budget_s=0.05)
+    before = _counter("consul.raft.apply.rejected",
+                      {"reason": "queue_full"})
+    with flight.use(rec):
+        with pytest.raises(ApplyRejectedError):
+            g.admit(5, 1, 1.0)
+    assert _counter("consul.raft.apply.rejected",
+                    {"reason": "queue_full"}) == before + 1
+    rows = rec.read(name="raft.apply.rejected")
+    assert rows and rows[0]["labels"]["reason"] == "queue_full"
+
+
+# ---------------------------------------------------------------------------
+# HTTP fronts: 429 shed + reason-discriminated 503s
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def api():
+    from consul_tpu.api.http import ApiServer
+    srv = ApiServer(StateStore(), port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_both_fronts_shed_429_with_hint(api):
+    api.ratelimit.configure(mode="enforcing", write_rate=0.001,
+                            write_burst=2.0, read_rate=0.001,
+                            read_burst=2.0)
+    c = Client(api.address, timeout=5.0)
+    assert c.kv_put("ol/a", b"1")       # burst admits
+    assert c.kv_put("ol/b", b"2")
+    # fastfront hot path: the PUT sheds inline
+    with pytest.raises(ApiRateLimitError) as ei:
+        c.kv_put("ol/c", b"3")
+    e = ei.value
+    assert e.code == 429 and e.nack and not e.ambiguous
+    assert e.retry_after is not None and e.retry_after >= 1.0
+    assert e.reason == "rate-limited"
+    # the NACK is true: the shed write does not exist
+    api.ratelimit.configure(mode="disabled")
+    assert c.kv_get("ol/c")[0] is None
+    # legacy front (recurse forces the fallback path): same shed shape
+    api.ratelimit.configure(mode="enforcing", read_rate=0.001,
+                            read_burst=1.0)
+    assert len(c.kv_list("ol/")) >= 2   # burst admits one read
+    with pytest.raises(ApiRateLimitError):
+        c.kv_list("ol/")
+    api.ratelimit.configure(mode="disabled")
+
+
+def test_rate_limited_blocking_helpers_honor_hint(api):
+    """retry_backoff honors Retry-After, capped and jittered."""
+    e = ApiRateLimitError(429, "", retry_after=2.0)
+    for _ in range(20):
+        d = retry_backoff(e, attempt=0, cap=5.0)
+        assert 1.0 <= d <= 2.0          # hinted, jittered half-full
+    d = retry_backoff(e, attempt=0, cap=1.0)
+    assert d <= 1.0                     # capped
+    plain = retry_backoff(None, attempt=2, base=0.2, cap=5.0)
+    assert 0.4 <= plain <= 0.8          # exponential fallback
+
+
+def test_health_429_stays_plain_api_error(api):
+    """/v1/agent/health answers 429 for 'warning' WITHOUT limiter
+    fingerprints — it must not classify as rate limiting."""
+    st = api.store
+    st.register_node("node0", "127.0.0.1")
+    st.register_service("node0", "web", "web")
+    st.register_check("node0", "c1", "c1", status="warning",
+                      service_id="web")
+    c = Client(api.address)
+    out = c.agent_health_service_by_id("web")   # swallows the 429
+    assert out["AggregatedStatus"] == "warning"
+    try:
+        c._call("GET", "/v1/agent/health/service/id/web")
+        assert False, "expected 429"
+    except ApiRateLimitError:
+        assert False, "health 429 misclassified as rate limiting"
+    except ApiError as e:
+        assert e.code == 429 and not e.nack
+
+
+def test_apply_nack_maps_to_503_reason_over_http():
+    """A leader whose gate rejects surfaces over BOTH fronts as 503 +
+    X-Consul-Reason (queue-full), which the client maps to the
+    unambiguous ApiOverloadError."""
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.server import ServerCluster
+    cluster = ServerCluster(3, seed=11)
+    leader = cluster.wait_leader()
+    cluster.start(tick_seconds=0.005)
+    api = ApiServer(leader, port=0)
+    api.start()
+    try:
+        c = Client(api.address, timeout=5.0)
+        assert c.kv_put("nk/a", b"1")
+        # slam the gate shut: everything NACKs queue_full
+        leader.apply_gate = ApplyGate(max_pending=0)
+        with pytest.raises(ApiOverloadError) as ei:
+            c.kv_put("nk/b", b"2")      # fastfront path
+        assert ei.value.code == 503
+        assert ei.value.reason == "queue-full"
+        assert ei.value.nack and not ei.value.ambiguous
+        # legacy front write (sessions never ride the fastfront):
+        # identical shed shape
+        with pytest.raises(ApiOverloadError):
+            c.session_create(node="server0")
+        leader.apply_gate = ApplyGate()
+        assert c.kv_put("nk/d", b"4")   # gate reopened
+        assert c.kv_get("nk/b")[0] is None      # the NACK was true
+    finally:
+        api.stop()
+        cluster.stop()
+
+
+# ---------------------------------------------------------------------------
+# the subscriber-eviction contract (10k slow consumers)
+# ---------------------------------------------------------------------------
+
+
+def test_10k_slow_consumers_cannot_stall_healthy_watchers():
+    """ISSUE 13 acceptance: 10k deliberately-wedged subscribers are
+    evicted at their buffer bound; healthy-watcher publish latency
+    stays bounded afterwards, the healthy stream has no holes, the
+    evictions land in the flight timeline, and a submatview
+    materializer on the same publisher keeps materializing."""
+    from consul_tpu.submatview import Materializer
+    rec = flight.FlightRecorder(forward_to_log=False)
+    pub = EventPublisher(max_sub_queue=8)
+    state = {"idx": 0}
+    view = Materializer(pub, "kv", None,
+                        snapshot_fn=lambda: (state["idx"],
+                                             state["idx"]))
+    view.start()
+    slow = [pub.subscribe("kv") for _ in range(10_000)]
+    healthy = pub.subscribe("kv")
+    got = []
+    with flight.use(rec):
+        for i in range(1, 9):           # 8th publish hits the bound
+            state["idx"] = i
+            pub.publish([Event("kv", "k", i)])
+            got += healthy.events(timeout=1.0)
+        # every slow subscriber is gone at the bound (depth 7 == 8-1)
+        with pub._lock:
+            left = len(pub._subs)
+        assert left <= 2                # healthy + the materializer
+        # post-eviction publish cost is the healthy fan-out only
+        t0 = time.perf_counter()
+        for i in range(9, 29):
+            state["idx"] = i
+            pub.publish([Event("kv", "k", i)])
+            got += healthy.events(timeout=1.0)
+        assert (time.perf_counter() - t0) < 1.0
+    # the healthy stream saw EVERY index, in order — eviction never
+    # punched holes in a live subscriber's stream
+    assert [e.index for e in got] == list(range(1, 29))
+    # evicted consumers get the reset contract, not silence
+    with pytest.raises(SnapshotRequired):
+        slow[0].events(timeout=0.05)
+    # the materializer kept up (or re-snapshotted) — not wedged
+    val, idx = view.fetch(min_index=27, timeout=5.0)
+    assert idx >= 28
+    view.stop()
+    # evictions journaled (aggregated — bounded ring protection)
+    rows = rec.read(name="stream.subscriber.evicted")
+    assert rows
+    assert sum(int(r["labels"]["count"]) for r in rows) >= 10_000
+    counted = _counter("consul.stream.subscriber.evicted",
+                       {"topic": "kv"})
+    assert counted >= 10_000
+
+
+def test_materializer_survives_its_own_eviction():
+    """A materializer slow enough to be evicted (its follow loop
+    wedged in a long re-materialization while publishes pile onto its
+    bounded queue) must take the SnapshotRequired reset, re-snapshot,
+    and converge — eviction may never permanently wedge submatview
+    materialization."""
+    from consul_tpu.submatview import Materializer
+    pub = EventPublisher(max_sub_queue=4)
+    state = {"idx": 0}
+    slow = {"on": True}
+
+    def snap():
+        if slow["on"]:
+            time.sleep(0.15)            # the wedge
+        return state["idx"], state["idx"]
+
+    view = Materializer(pub, "kv", None, snapshot_fn=snap)
+    view.start()
+    # publish faster than the wedged view drains until it is evicted
+    deadline = time.time() + 10.0
+    while time.time() < deadline and view.resets == 0:
+        state["idx"] += 1
+        pub.publish([Event("kv", "k", state["idx"])])
+        time.sleep(0.01)
+    assert view.resets >= 1, "the wedged view was never evicted"
+    # un-wedge: the re-snapshotted view converges on fresh state
+    slow["on"] = False
+    state["idx"] += 1
+    final = state["idx"]
+    pub.publish([Event("kv", "k", final)])
+    val, idx = view.fetch(min_index=final - 1, timeout=5.0)
+    assert idx >= final
+    view.stop()
+
+
+# ---------------------------------------------------------------------------
+# reason-header discrimination (satellite: no more bare 500s)
+# ---------------------------------------------------------------------------
+
+
+def test_overload_response_mapping_unit():
+    from consul_tpu.api.http import _overload_response
+    from consul_tpu.server import NoLeaderError
+    assert _overload_response(ApplyRejectedError("queue_full")) \
+        == (503, "queue-full")
+    assert _overload_response(ApplyRejectedError("deadline")) \
+        == (503, "deadline")
+    assert _overload_response(NoLeaderError("x")) == (503, "no-leader")
+    assert _overload_response(ValueError("boom")) is None

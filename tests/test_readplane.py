@@ -84,7 +84,7 @@ def test_resolve_max_stale_rejects_on_lagging_replica():
     ok = rp.resolve("/v1/kv/x", {"stale": "", "max_stale": "10s"})
     assert ok.action == "local" and ok.mode == "stale"
     bad = rp.resolve("/v1/kv/x", {"stale": "", "max_stale": "1s"})
-    assert bad.action == "reject" and bad.code == 500
+    assert bad.action == "reject" and bad.code == 503
     assert bad.reason == "max_stale"
     assert "max_stale" in bad.message
     # the reject journaled a flight event
@@ -104,12 +104,13 @@ def test_resolve_default_forwarding_rules():
     # no fleet map -> local (standalone compatibility)
     rp2 = ReadPlane(_FakeRaftStore(leader=False), node_name="server1")
     assert rp2.resolve("/v1/kv/x", {}).action == "local"
-    # leaderless + fleet map -> 500 No cluster leader
+    # leaderless + fleet map -> 503 No cluster leader (ISSUE 13:
+    # unavailable gets its own status + machine-readable reason)
     rp3 = ReadPlane(_FakeRaftStore(leader=False, known=False,
                                    leader_id=None),
                     node_name="server1", cluster_nodes_fn=lambda: fleet)
     dec = rp3.resolve("/v1/kv/x", {})
-    assert dec.action == "reject" and dec.code == 500
+    assert dec.action == "reject" and dec.code == 503
     assert dec.reason == "no_leader"
     # a forwarded request bouncing off a non-leader must NOT loop
     dec = rp.resolve("/v1/kv/x", {},
@@ -218,7 +219,8 @@ def test_default_read_forwards_to_leader_with_fleet_map(rig):
                         {"route": "kv"}) == before + 1
         # the forwarded response carries the LEADER's last-contact (0)
         assert fc.last_contact_ms == 0
-        # the loop guard: a pre-forwarded request at a non-leader 500s
+        # the loop guard: a pre-forwarded request at a non-leader
+        # bounces 503 + X-Consul-Reason: not-leader (ISSUE 13)
         try:
             fc._call("GET", "/v1/kv/rp/fwd", {},
                      timeout=5.0)
@@ -230,9 +232,10 @@ def test_default_read_forwards_to_leader_with_fleet_map(rig):
             headers={"X-Consul-Read-Forwarded": "1"})
         try:
             urllib.request.urlopen(req, timeout=5.0)
-            assert False, "forwarded request at non-leader must 500"
+            assert False, "forwarded request at non-leader must 503"
         except urllib.error.HTTPError as e:
-            assert e.code == 500
+            assert e.code == 503
+            assert e.headers.get("X-Consul-Reason") == "not-leader"
     finally:
         for a in apis.values():
             a.cluster_nodes = None
@@ -250,7 +253,8 @@ def test_max_stale_reject_over_http_counts_and_journals(rig):
                           {"reason": "max_stale"})
         with pytest.raises(ApiError) as ei:
             fc.kv_get("rp/seed", max_stale="1s")
-        assert ei.value.code == 500
+        assert ei.value.code == 503
+        assert ei.value.reason == "max-stale"
         assert "max_stale" in ei.value.body
         assert _counter("consul.readplane.rejected",
                         {"reason": "max_stale"}) == before + 1

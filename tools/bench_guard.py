@@ -323,6 +323,19 @@ def run_check() -> int:
     if not rd["ok"]:
         failures.append("guard judged the read-plane stamp keys "
                         "instead of tolerating them")
+    # ISSUE 13's artifact stamps are metadata too: kv_bench rows carry
+    # {"rate_limited": n} in enforcing-mode runs and soak rows carry a
+    # {"soak": {...}} stamp — a decorated within-threshold row must be
+    # tolerated-not-judged like every other stamp
+    ol = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                 "rate_limited": 12,
+                 "ratelimit": {"mode": "enforcing", "write_rate": 60},
+                 "soak": {"seconds": 120, "faults": 4,
+                          "slo": {"p99_visibility_s": 5.0}}}],
+               fake_base)
+    if not ol["ok"]:
+        failures.append("guard judged the soak/ratelimit stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

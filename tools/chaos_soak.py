@@ -120,9 +120,30 @@ def run_check() -> int:
         failures += [f"{live['scenario']}: {v}"
                      for v in live["violations"]]
         chaos_live.print_violation_tail(live)
+    # the bounded overload smoke (ISSUE 13): a write burst against a
+    # 3-proc cluster with ENFORCING ingress limits — 429s fire fast
+    # with Retry-After, no rate-limited write exists on any replica,
+    # and the standard checkers stay green, under the same hard wall
+    # budget as the kill-9 smoke
+    t0 = time.time()
+    shed = chaos_live.run_live_scenario("live_overload_shed",
+                                        CHECK_SEED, check=True)
+    shed["wall_s"] = round(time.time() - t0, 2)
+    print(json.dumps({k: shed[k] for k in
+                      ("scenario", "seed", "ok", "digest",
+                       "wall_s")}))
+    if shed["wall_s"] > chaos_live.SMOKE_BUDGET_S:
+        shed["ok"] = False
+        shed["violations"].append(
+            f"overload smoke overran its wall budget: "
+            f"{shed['wall_s']}s > {chaos_live.SMOKE_BUDGET_S}s")
+    if not shed["ok"]:
+        failures += [f"{shed['scenario']}: {v}"
+                     for v in shed["violations"]]
+        chaos_live.print_violation_tail(shed)
     out = {"mode": "check", "seed": CHECK_SEED,
            "scenarios": [r["scenario"] for r in rows]
-           + [live["scenario"]],
+           + [live["scenario"], shed["scenario"]],
            "deterministic": deterministic,
            "timeline_identical": timeline_identical,
            "events_journaled": sum(
@@ -131,6 +152,11 @@ def run_check() -> int:
                     "wall_s": live["wall_s"],
                     "budget_s": live["budget_s"],
                     "ok": live["ok"]},
+           "overload": {"scenario": shed["scenario"],
+                        "wall_s": shed["wall_s"],
+                        "budget_s": chaos_live.SMOKE_BUDGET_S,
+                        "detail": shed.get("detail", {}).get("burst"),
+                        "ok": shed["ok"]},
            "ok": not failures, "failures": failures}
     print(json.dumps(out))
     return 1 if failures else 0

@@ -66,6 +66,10 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
     # per-worker slots summed after join: `amb[0] += 1` shared across
     # threads is a lossy read-modify-write
     amb = [0] * conns
+    # rate-limited ops (429, ISSUE 13): the limiter shedding load is
+    # an OUTCOME of the bench, not an error — counted in its own
+    # column so an enforcing-mode run reads honestly
+    rl = [0] * conns
     stale_per_100 = int(round(stale_mix * 100))
 
     def worker(wid):
@@ -104,6 +108,11 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
                     # one phase later, not a bench failure
                     amb[wid] += 1
                     continue
+                if r.status == 429:
+                    # shed by the ingress limiter: a definite
+                    # non-write/non-read, counted as its own outcome
+                    rl[wid] += 1
+                    continue
                 if r.status >= 400:
                     errors.append(r.status)
                     return
@@ -124,7 +133,7 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
         t.start()
     for t in threads:
         t.join()
-    q.put((time.perf_counter() - t0, errors[:3], sum(amb)))
+    q.put((time.perf_counter() - t0, errors[:3], sum(amb), sum(rl)))
 
 
 def drive(addresses, n_ops, conns, verb, body=None, procs=1,
@@ -166,12 +175,13 @@ def drive(addresses, n_ops, conns, verb, body=None, procs=1,
     for p in ps:
         p.join(timeout=30)
     dt = time.perf_counter() - t0
-    errs = [e for _, errors, _ in results for e in errors]
+    errs = [e for _, errors, _, _ in results for e in errors]
     if errs:
         raise RuntimeError(f"bench errors: {errs[:3]}")
     total = per_conn * conns_per_proc * len(ps)
-    ambiguous = sum(a for _, _, a in results)
-    return total / dt, dt, ambiguous
+    ambiguous = sum(a for _, _, a, _ in results)
+    rate_limited = sum(r for _, _, _, r in results)
+    return total / dt, dt, ambiguous, rate_limited
 
 
 def main():
@@ -216,13 +226,16 @@ def main():
         procs = []
         try:
             addresses, procs = start_cluster_procs(n)
-            rps, dt, put_amb = drive(addresses[:1], args.n_ops,
-                                     args.conns, "PUT", body=value)
+            rps, dt, put_amb, put_rl = drive(addresses[:1],
+                                             args.n_ops,
+                                             args.conns, "PUT",
+                                             body=value)
             emit({
                 "metric": f"kv_put_rps_cluster{n}",
                 "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": put_amb,
+                "rate_limited": put_rl,
                 "read": {"servers": n},
                 "vs_baseline": round(rps / baselines["kv_put"], 2)})
             time.sleep(1.0)   # let replication land on followers
@@ -230,8 +243,8 @@ def main():
             # server: a follower hop leader-forwards (the read plane's
             # default mode — every read verified by the leader), so
             # this is the FLAT baseline the stale fanout must beat
-            rps, dt, get_amb = drive(addresses, args.n_ops, args.conns,
-                                     "GET")
+            rps, dt, get_amb, get_rl = drive(addresses, args.n_ops,
+                                             args.conns, "GET")
             # a GET-phase 404 is tolerable ONLY as the shadow of a
             # PUT-phase timeout (the op that never learned its
             # outcome); more holes than ambiguous PUTs is data LOSS
@@ -244,6 +257,7 @@ def main():
                 "metric": f"kv_get_rps_lb{n}", "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": get_amb,
+                "rate_limited": get_rl,
                 "read": {"mode": "default", "servers": n,
                          "fanout": True},
                 "vs_baseline": round(rps / baselines["kv_get_lb3"],
@@ -253,8 +267,9 @@ def main():
                 # GETs from its own replica — the read-scaling mode
                 # (the reference's 16,068.8 req/s LB row was exactly
                 # this: stale reads behind an LB over 3 servers)
-                rps, dt, amb = drive(addresses, args.n_ops,
-                                     args.conns, "GET", stale_mix=1.0)
+                rps, dt, amb, rl = drive(addresses, args.n_ops,
+                                         args.conns, "GET",
+                                         stale_mix=1.0)
                 if amb > put_amb:
                     raise RuntimeError(
                         f"bench: {amb} stale-GET holes but only "
@@ -265,6 +280,7 @@ def main():
                     "value": round(rps, 1),
                     "unit": "req/s", "wall_s": round(dt, 2),
                     "cores": cores, "ambiguous": amb,
+                    "rate_limited": rl,
                     "read": {"mode": "stale", "servers": n,
                              "fanout": True, "stale_mix": 1.0},
                     "vs_baseline": round(
@@ -272,13 +288,15 @@ def main():
                 # 90/10 stale/default mix: the production read shape
                 # (most traffic tolerates bounded staleness, a tail
                 # needs leader verification)
-                rps, dt, amb = drive(addresses, args.n_ops,
-                                     args.conns, "GET", stale_mix=0.9)
+                rps, dt, amb, rl = drive(addresses, args.n_ops,
+                                         args.conns, "GET",
+                                         stale_mix=0.9)
                 emit({
                     "metric": f"kv_get_rps_lb{n}_mixed",
                     "value": round(rps, 1),
                     "unit": "req/s", "wall_s": round(dt, 2),
                     "cores": cores, "ambiguous": amb,
+                    "rate_limited": rl,
                     "read": {"mode": "mixed", "servers": n,
                              "fanout": True, "stale_mix": 0.9},
                     "vs_baseline": round(
@@ -297,19 +315,19 @@ def main():
     # pacer would just burn the GIL the HTTP handlers need
     agent.start(tick_seconds=0.2, reconcile_interval=1.0)
     try:
-        rps, dt, amb = drive(agent.http_address, args.n_ops, args.conns,
-                             "PUT", body=value)
+        rps, dt, amb, rl = drive(agent.http_address, args.n_ops,
+                                 args.conns, "PUT", body=value)
         emit({
             "metric": "kv_put_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
-            "cores": cores, "ambiguous": amb,
+            "cores": cores, "ambiguous": amb, "rate_limited": rl,
             "vs_baseline": round(rps / baselines["kv_put"], 2)})
-        rps, dt, amb = drive(agent.http_address, args.n_ops, args.conns,
-                             "GET")
+        rps, dt, amb, rl = drive(agent.http_address, args.n_ops,
+                                 args.conns, "GET")
         emit({
             "metric": "kv_get_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
-            "cores": cores, "ambiguous": amb,
+            "cores": cores, "ambiguous": amb, "rate_limited": rl,
             "vs_baseline": round(rps / baselines["kv_get"], 2)})
     finally:
         agent.stop()

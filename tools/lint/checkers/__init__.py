@@ -2,6 +2,7 @@
 `ALL` is the build-gate suite in the order findings are reported."""
 
 from lint.checkers.blocking_call import BlockingCallChecker
+from lint.checkers.bounded_queue import BoundedQueueChecker
 from lint.checkers.donation_safety import DonationSafetyChecker
 from lint.checkers.dtype_discipline import DtypeDisciplineChecker
 from lint.checkers.exception_hygiene import ExceptionHygieneChecker
@@ -27,6 +28,7 @@ ALL = [
     EventNamesChecker(),
     GatherDisciplineChecker(),
     ReadplaneDisciplineChecker(),
+    BoundedQueueChecker(),
 ]
 
 BY_NAME = {c.name: c for c in ALL}

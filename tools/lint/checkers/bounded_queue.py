@@ -1,0 +1,144 @@
+"""bounded-queue: no unbounded buffers on the request path.
+
+ISSUE 13's overload postmortem shape: every queue between a client and
+the FSM is somewhere load accumulates when the drain side slows, and
+an UNBOUNDED queue converts overload into unbounded memory growth plus
+unbounded latency — the system dies of the backlog instead of shedding
+it.  The defense plane (ratelimit.py, the publisher's subscriber
+eviction) bounds the front doors; this checker keeps the rule
+structural for every buffer behind them:
+
+  * `collections.deque()` without a `maxlen` (second positional or
+    keyword) — including `maxlen=None` spelled out — is flagged;
+  * `queue.Queue()` / `LifoQueue()` / `PriorityQueue()` without a
+    positive `maxsize` is flagged;
+  * a bare `deque` / `Queue` reference passed as a dataclass
+    `default_factory=` is flagged too (it constructs the unbounded
+    form at runtime, the exact spelling the publisher's per-subscriber
+    queue used before eviction became a contract).
+
+Scope, by construction: the modules a request flows through —
+`consul_tpu/rpc/`, `consul_tpu/stream/`, `consul_tpu/consensus/`, and
+the API fronts (`consul_tpu/api/`) plus `consul_tpu/server.py` (the
+forward coalescer).  Plain lists are out of scope (they carry
+different idioms and the request-path ones are drained synchronously);
+a deliberately unbounded queue carries a
+`# lint: ok=bounded-queue (reason)` suppression.
+
+Alias-proof like the storage-seam checker: `from collections import
+deque as dq` and `import queue as q` do not slip past.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from lint.astutil import call_name, member_call_names
+from lint.core import Checker, Finding, Module
+
+SCOPE = ("consul_tpu/rpc/", "consul_tpu/stream/",
+         "consul_tpu/consensus/", "consul_tpu/api/")
+SCOPE_FILES = ("consul_tpu/server.py",)
+
+_QUEUE_CLASSES = ("Queue", "LifoQueue", "PriorityQueue",
+                  "SimpleQueue")
+
+
+def _bound_names(tree: ast.AST) -> tuple:
+    """(deque spellings, queue-class spellings) reachable in this
+    module, through every import alias."""
+    deques: Set[str] = member_call_names(tree, "collections", "deque")
+    queues: Set[str] = set()
+    for cls in _QUEUE_CLASSES:
+        queues |= member_call_names(tree, "queue", cls)
+    return deques, queues
+
+
+class BoundedQueueChecker(Checker):
+    name = "bounded-queue"
+    description = ("queue.Queue()/deque() without maxsize/maxlen on "
+                   "the request path (rpc/, stream/, consensus/, API "
+                   "fronts) — unbounded buffers turn overload into "
+                   "memory growth instead of shed load")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        rel = module.relpath
+        if not (rel.startswith(SCOPE) or rel in SCOPE_FILES):
+            return
+        deques, queues = _bound_names(module.tree)
+        if not deques and not queues:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = call_name(node) or ""
+                if name in deques:
+                    yield from self._check_deque(module, node)
+                elif name in queues:
+                    yield from self._check_queue(module, node, name)
+                elif name.rsplit(".", 1)[-1] == "field":
+                    yield from self._check_factory(module, node,
+                                                   deques, queues)
+
+    # ------------------------------------------------------------ per-shape
+
+    def _check_deque(self, module: Module,
+                     node: ast.Call) -> Iterator[Finding]:
+        # deque(iterable, maxlen): bound is 2nd positional or keyword
+        bound = node.args[1] if len(node.args) >= 2 else next(
+            (kw.value for kw in node.keywords if kw.arg == "maxlen"),
+            None)
+        if bound is None or (isinstance(bound, ast.Constant)
+                             and bound.value is None):
+            yield module.finding(
+                self.name, node,
+                "deque() without maxlen on the request path — an "
+                "unbounded buffer; pass maxlen (and decide what "
+                "happens at the bound: evict, reset, or shed)")
+
+    def _check_queue(self, module: Module, node: ast.Call,
+                     name: str) -> Iterator[Finding]:
+        if name.rsplit(".", 1)[-1] == "SimpleQueue":
+            # SimpleQueue has NO maxsize parameter at all: it cannot
+            # be bounded, so its presence on the request path is the
+            # finding
+            yield module.finding(
+                self.name, node,
+                "queue.SimpleQueue on the request path cannot be "
+                "bounded — use queue.Queue(maxsize=...)")
+            return
+        bound = node.args[0] if node.args else next(
+            (kw.value for kw in node.keywords if kw.arg == "maxsize"),
+            None)
+        unbounded = bound is None or (
+            isinstance(bound, ast.Constant)
+            and isinstance(bound.value, int) and bound.value <= 0)
+        if unbounded:
+            yield module.finding(
+                self.name, node,
+                f"{name}() without a positive maxsize on the request "
+                f"path — an unbounded buffer; bound it and handle "
+                f"queue.Full as the shed signal")
+
+    def _check_factory(self, module: Module, node: ast.Call,
+                       deques: Set[str],
+                       queues: Set[str]) -> Iterator[Finding]:
+        for kw in node.keywords:
+            if kw.arg != "default_factory":
+                continue
+            ref = None
+            if isinstance(kw.value, (ast.Name, ast.Attribute)):
+                parts = []
+                v = kw.value
+                while isinstance(v, ast.Attribute):
+                    parts.append(v.attr)
+                    v = v.value
+                if isinstance(v, ast.Name):
+                    parts.append(v.id)
+                    ref = ".".join(reversed(parts))
+            if ref and (ref in deques or ref in queues):
+                yield module.finding(
+                    self.name, kw.value,
+                    f"default_factory={ref} constructs an UNBOUNDED "
+                    f"queue per instance on the request path — wrap "
+                    f"it in a lambda with maxlen/maxsize")

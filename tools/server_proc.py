@@ -92,6 +92,16 @@ def main():
                          "every cluster member: enables the "
                          "/v1/internal/ui/cluster-metrics federation "
                          "endpoint (consul_tpu/introspect.py)")
+    ap.add_argument("--rate-limit", default=None,
+                    help='overload defense config '
+                         '(consul_tpu/ratelimit.py), e.g. '
+                         '"mode=enforcing,write_rate=50,'
+                         'write_burst=100,apply_max_pending=512".  '
+                         'Keys: mode (disabled|permissive|enforcing), '
+                         'read_rate/read_burst/write_rate/write_burst '
+                         '(ingress token buckets), apply_max_pending/'
+                         'apply_min_budget (leader apply admission).  '
+                         'Env: CONSUL_TPU_RATE_LIMIT')
     args = ap.parse_args()
 
     from consul_tpu import flight
@@ -123,6 +133,17 @@ def main():
             name: url for name, url in
             (part.split("=", 1) for part in
              args.cluster_http.split(",") if part)}
+    limit_spec = args.rate_limit \
+        or os.environ.get("CONSUL_TPU_RATE_LIMIT")
+    if limit_spec:
+        from consul_tpu.ratelimit import parse_limit_spec
+        cfg = parse_limit_spec(limit_spec)
+        if "apply_max_pending" in cfg:
+            server.apply_gate.max_pending = cfg.pop("apply_max_pending")
+        if "apply_min_budget" in cfg:
+            server.apply_gate.min_budget_s = cfg.pop("apply_min_budget")
+        if cfg:
+            api.ratelimit.configure(**cfg)
     api.start()
     print(f"server {args.node} rpc={my_rpc} "
           f"http={api.address}", flush=True)
