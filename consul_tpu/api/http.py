@@ -45,7 +45,7 @@ from consul_tpu.acl.resolver import ACLResolver
 from consul_tpu.bexpr import BexprError
 from consul_tpu.catalog.store import StateStore
 from consul_tpu.oracle import GossipOracle
-from consul_tpu import servicemgr
+from consul_tpu import locks, servicemgr
 from consul_tpu.version import VERSION
 
 
@@ -167,7 +167,7 @@ class ApiServer:
         # fetcher; production would set one that can reach the IdP)
         self.oidc_token_fetcher = None
         self._oidc_states: dict = {}
-        self._oidc_lock = threading.Lock()
+        self._oidc_lock = locks.make_lock("http.oidc")
         # the agent's gRPC ADS port when one is bound (-1 = disabled);
         # surfaced via /v1/agent/self so `connect envoy -bootstrap`
         # can point a stock Envoy at it
@@ -191,10 +191,10 @@ class ApiServer:
         self.txn_max_ops = 64
         # guards the per-proxy xDS delta payload caches: handler
         # threads race on insert/evict (ThreadingHTTPServer)
-        self._xds_cache_lock = threading.Lock()
+        self._xds_cache_lock = locks.make_lock("http.xds_cache")
         # Connect CA (lazy: cert generation costs entropy/CPU at boot)
         self._ca = None
-        self._ca_lock = threading.Lock()
+        self._ca_lock = locks.make_lock("http.ca")
         # streaming read backend: materialized views over store events
         # (?cached serving — agent/submatview); the request-keyed Cache
         # serves Cache-Control max-age reads (agent/cache)
@@ -453,7 +453,7 @@ class ApiServer:
         return self._ca
 
     _proxycfg = None
-    _proxycfg_lock = threading.Lock()
+    _proxycfg_lock = locks.make_lock("http.proxycfg")
 
     @property
     def proxycfg(self):

@@ -30,6 +30,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from consul_tpu import locks
 from consul_tpu.native_index import PrefixIndex
 from consul_tpu.stream.publisher import Event, EventPublisher
 
@@ -45,7 +46,7 @@ class _Waiter:
     __slots__ = ("cond", "fired", "watches")
 
     def __init__(self, lock, watches):
-        self.cond = threading.Condition(lock)
+        self.cond = locks.make_condition(lock)
         self.fired = False
         self.watches = watches
 
@@ -62,9 +63,9 @@ def _watch_matches(watches, topic: str, key: str) -> bool:
 
 class StateStore:
     def __init__(self):
-        self._lock = threading.RLock()
-        self._cond = threading.Condition(self._lock)
-        self._index = 0
+        self._lock = locks.make_rlock("store.state")
+        self._cond = locks.make_condition(self._lock)
+        self._index = 0             # guarded-by: _lock
         # streaming + fine-grained watches (stream/event_publisher.go:12;
         # per-index watch channels state_store.go:102-120)
         self.publisher = EventPublisher()
@@ -74,37 +75,48 @@ class StateStore:
         from consul_tpu.visibility import VisibilityTable
         self.visibility = VisibilityTable()
         self.publisher.visibility = self.visibility
-        self._waiters: List[_Waiter] = []
+        self._waiters: List[_Waiter] = []   # guarded-by: _lock
         # parked blocking queries right now (coarse + fine), feeding the
         # consul.rpc.queries_blocking gauge (rpc.go's queriesBlocking).
         # Guarded by its own lock so gauge publication is ordered
         # WITHOUT holding the store lock across sink I/O.
-        self._blocked = 0
-        self._blocked_lock = threading.Lock()
+        self._blocked = 0           # guarded-by: _blocked_lock
+        self._blocked_lock = locks.make_lock("store.blocked_gauge")
         # topic -> ordered key->index map (native C++ prefix index when
         # buildable — the go-memdb radix-tree role; consul_tpu/
         # native_index.py): prefix watch lookups are O(log n + m), not a
         # full-topic scan
-        self._topic_index: Dict[str, object] = {}
-        self._topic_max: Dict[str, int] = {}                # topic -> idx
+        self._topic_index: Dict[str, object] = {}   # guarded-by: _lock
+        # topic -> idx  # guarded-by: _lock
+        self._topic_max: Dict[str, int] = {}
         # compaction floor: when a topic's per-key map is compacted, keys
         # dropped resolve to this index (conservative — may cause a
         # spurious immediate return, never a missed wakeup).  This is the
         # tombstone-GC analogue (reference state/graveyard.go).
-        self._topic_floor: Dict[str, int] = {}
+        self._topic_floor: Dict[str, int] = {}      # guarded-by: _lock
         # kv: key -> dict(value, flags, create_index, modify_index, session)
+        # guarded-by: _lock
         self._kv: Dict[str, dict] = {}
-        self._kv_delete_index: Dict[str, int] = {}  # prefix-bump on deletes
+        # prefix-bump on deletes  # guarded-by: _lock
+        self._kv_delete_index: Dict[str, int] = {}
         # catalog
         self._nodes: Dict[str, dict] = {}
         self._services: Dict[Tuple[str, str], dict] = {}   # (node, sid) -> svc
         self._checks: Dict[Tuple[str, str], dict] = {}     # (node, cid) -> chk
         # sessions: id -> dict(node, ttl, behavior, create_index, expires, lock_delay)
+        # guarded-by: _lock
         self._sessions: Dict[str, dict] = {}
         self._lock_delays: Dict[str, float] = {}           # key -> until ts
         # non-None while a txn is applying: _bump defers its effects
         # here so an abort publishes/wakes nothing (list of (idx, events))
+        # guarded-by: _lock
         self._txn_events: Optional[list] = None
+        locks.register_guards(self, self._lock, "_index", "_waiters",
+                              "_topic_index", "_topic_max",
+                              "_topic_floor", "_kv",
+                              "_kv_delete_index", "_sessions",
+                              "_txn_events")
+        locks.register_guards(self, self._blocked_lock, "_blocked")
         # ACL tables (agent/consul/state/acl.go): policies by id, tokens by
         # accessor id; bootstrap is one-shot guarded by a reset index
         self._acl_policies: Dict[str, dict] = {}
@@ -136,6 +148,7 @@ class StateStore:
         with self._lock:
             return self._index
 
+    # requires-lock: _lock
     def _bump(self, events: Sequence[Tuple[str, str]] = ()) -> int:
         """Advance the commit index, record per-(topic, key) indexes, wake
         matching fine-grained waiters, and publish stream events.
@@ -155,6 +168,7 @@ class StateStore:
         self._apply_bump_effects(idx, events)
         return idx
 
+    # requires-lock: _lock
     def _apply_bump_effects(self, idx: int,
                             events: Sequence[Tuple[str, str]]) -> None:
         # commit-to-visibility: stamp (index, apply ts, proposer trace)
@@ -290,6 +304,10 @@ class StateStore:
         from consul_tpu import telemetry
         with self._blocked_lock:
             self._blocked += delta
+            # _blocked_lock is a dedicated LEAF lock that exists to
+            # ORDER this one gauge publication; never held with the
+            # store lock, so the staging rule does not apply here.
+            # lint: ok=no-emit-under-lock (ordered publication under a dedicated leaf lock)
             telemetry.set_gauge(("rpc", "queries_blocking"),
                                 float(self._blocked))
 
@@ -821,6 +839,7 @@ class StateStore:
                     self._invalidate_session_locked(sid)
         return expired
 
+    # requires-lock: _lock
     def _invalidate_session_locked(self, sid: str,
                                    now: Optional[float] = None) -> None:
         """Release/delete locks held by the session, then drop it
@@ -1451,6 +1470,7 @@ class StateStore:
                 self._apply_bump_effects(idx, events)
             return True, results, self._index
 
+    # requires-lock: _lock
     def _txn_ops_locked(self, ops: List[dict],
                         results: List[Any]) -> bool:
         """Apply ops under the held lock, appending per-op results;

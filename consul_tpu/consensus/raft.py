@@ -32,7 +32,7 @@ import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from consul_tpu import telemetry, visibility
+from consul_tpu import locks, telemetry, visibility
 
 FOLLOWER = "follower"
 CANDIDATE = "candidate"
@@ -93,15 +93,19 @@ class InMemTransport(Transport):
     and fully deterministic under a seeded injector."""
 
     def __init__(self, seed: int = 0):
-        self._nodes: Dict[str, "RaftNode"] = {}
-        self._lock = threading.Lock()
-        self._cut: set = set()          # directed (src, dst) pairs down
+        self._nodes: Dict[str, "RaftNode"] = {}     # guarded-by: _lock
+        self._lock = locks.make_lock("raft.transport")
+        # directed (src, dst) pairs down  # guarded-by: _lock
+        self._cut: set = set()
         self.p_loss = 0.0
         self._rng = random.Random(seed)
         self.injector = None            # chaos.LinkInjector-shaped
         self._now = 0.0
         self._seq = 0                   # FIFO tiebreak for equal due times
-        self._pending: List[tuple] = []  # heap of (due, seq, dst, msg)
+        # heap of (due, seq, dst, msg)  # guarded-by: _lock
+        self._pending: List[tuple] = []
+        locks.register_guards(self, self._lock,
+                              "_nodes", "_cut", "_pending")
 
     def register(self, node: "RaftNode") -> None:
         with self._lock:
@@ -283,37 +287,39 @@ class RaftNode:
         self.leader_id: Optional[str] = None
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
-        self._votes: set = set()
-        self._prevotes: set = set()
+        self._votes: set = set()        # guarded-by: _lock
+        self._prevotes: set = set()     # guarded-by: _lock
         self._last_contact = -1e18      # last valid leader contact (for pre-vote)
         self._election_deadline = 0.0
         self._heartbeat_due = 0.0
         self._needs_bcast = False
-        self._inbox: List[dict] = []
-        self._chunk_buf: Dict[str, list] = {}   # gid -> b64 parts
-        self._lock = threading.RLock()
-        self._pending: Dict[int, _Pending] = {}   # log index -> waiter
+        self._inbox: List[dict] = []    # guarded-by: _lock
+        # gid -> b64 parts  # guarded-by: _lock
+        self._chunk_buf: Dict[str, list] = {}
+        self._lock = locks.make_rlock("raft.node")
+        # log index -> waiter  # guarded-by: _lock
+        self._pending: Dict[int, _Pending] = {}
         # proposer trace ids by log index (LOCAL only — never
         # replicated; trace.py's byte-identical-payload rule).  The
         # apply loop pops them to scope visibility.applying() around
         # the FSM apply so store bumps correlate to the writer's trace.
-        self._trace_ids: Dict[int, str] = {}
+        self._trace_ids: Dict[int, str] = {}    # guarded-by: _lock
         # (log index, wall ts) of leader-side appends: the feed for the
         # per-peer replication-lag-in-ms gauge — the age of the oldest
         # entry a follower has not acked.  Pruned below min(match).
-        self._append_ts: List[Tuple[int, float]] = []
+        self._append_ts: List[Tuple[int, float]] = []   # guarded-by: _lock
         # (log index, receive ts) of FOLLOWER-side appends: the feed
         # for this replica's own staleness bound (readplane max_stale
         # enforcement) — the age of the oldest entry received from the
         # leader but not yet applied.  Pruned below last_applied.
-        self._recv_ts: List[Tuple[int, float]] = []
+        self._recv_ts: List[Tuple[int, float]] = []     # guarded-by: _lock
         self._self_lag_due = 0.0
         # telemetry staging: helpers that run under self._lock append
         # (kind, name, value) here and tick()/apply_many() flush AFTER
         # releasing it — sink emission (UDP sendto per configured sink)
         # must never serialize raft progress behind syscalls (the same
         # rule catalog/store.py applies to its blocking-query metrics)
-        self._metrics_buf: List[tuple] = []
+        self._metrics_buf: List[tuple] = []     # guarded-by: _lock
         self._leader_observers: List[Callable[[bool], None]] = []
         self.applied_index_log: List[int] = []    # for tests/metrics
         self._first_tick = True
@@ -322,12 +328,17 @@ class RaftNode:
         # reference's replication goroutines fire on notify; timers
         # still ride the periodic tick)
         self.on_activity: Optional[Callable[[], None]] = None
+        locks.register_guards(self, self._lock, "_votes", "_prevotes",
+                              "_inbox", "_chunk_buf", "_pending",
+                              "_trace_ids", "_append_ts", "_recv_ts",
+                              "_metrics_buf")
         # AFTER the volatile block: boot recovery sets last_applied/
         # commit_index to the snapshot horizon and must not be
         # clobbered by the zero-inits above
         if store is not None:
             self._boot_from_store()
 
+    # requires-lock: _lock
     def _boot_from_store(self) -> None:
         """Crash recovery: rebuild term/vote/log/snapshot from disk.
         Entries above the snapshot base stay UNCOMMITTED until a leader
@@ -488,12 +499,14 @@ class RaftNode:
         now = _time.time() if now is None else now
         age = self.last_contact_s(now)
         # oldest received-but-unapplied entry; the ring is pruned
-        # below last_applied by the apply loop, so its head IS the
-        # oldest candidate (snapshot the list ref — it may be swapped,
-        # never mutated in place, under the raft lock)
-        rt = self._recv_ts
-        la = self.last_applied
-        for idx, ts in rt[:8]:
+        # below last_applied by the apply loop.  Snapshot the head
+        # UNDER the lock: the apply loop prunes it in place (`del
+        # rt[:drop]`), so the old lock-free read here raced the prune —
+        # the guarded-by sanitizer surfaced exactly this
+        with self._lock:
+            rt = self._recv_ts[:8]
+            la = self.last_applied
+        for idx, ts in rt:
             if idx > la:
                 age = max(age, now - ts)
                 break
@@ -686,6 +699,7 @@ class RaftNode:
         lo, hi = self.cfg.election_timeout
         self._election_deadline = now + self._rng.uniform(lo, hi)
 
+    # requires-lock: _lock
     def _become_follower(self, term: int, now: float) -> None:
         was_leader = self.state == LEADER
         self.state = FOLLOWER
@@ -706,6 +720,7 @@ class RaftNode:
             for fn in self._leader_observers:
                 fn(False)
 
+    # requires-lock: _lock
     def _fail_pending(self, err: Exception) -> None:
         for pend in self._pending.values():
             pend.error = err
@@ -713,6 +728,7 @@ class RaftNode:
         self._pending.clear()
         self._trace_ids.clear()
 
+    # requires-lock: _lock
     def _start_election(self, now: float) -> None:
         """Election timeout fired.  Phase 1 is Pre-Vote (Raft thesis §9.6,
         hashicorp/raft PreVote): probe electability WITHOUT bumping our term
@@ -727,6 +743,7 @@ class RaftNode:
                 "last_log_term": self.last_log_term})
         self._maybe_prevote_win(now)
 
+    # requires-lock: _lock
     def _maybe_prevote_win(self, now: float) -> None:
         if self.state == LEADER:
             return
@@ -753,6 +770,7 @@ class RaftNode:
                 "last_log_term": self.last_log_term})
         self._maybe_win(now)
 
+    # requires-lock: _lock
     def _maybe_win(self, now: float) -> None:
         if self.state != CANDIDATE:
             return
@@ -787,6 +805,7 @@ class RaftNode:
             for fn in self._leader_observers:
                 fn(True)
 
+    # requires-lock: _lock
     def _broadcast_append(self, now: float) -> None:
         self._needs_bcast = False
         self._heartbeat_due = now + self.cfg.heartbeat_interval
@@ -806,6 +825,7 @@ class RaftNode:
         for p in self.peers:
             self._send_append(p)
 
+    # requires-lock: _lock
     def _stage_replication_lag(self, now: float) -> None:
         """Per-peer follower lag at heartbeat cadence, leader-side —
         the reference exposes none of this; the streaming-reads
@@ -870,6 +890,7 @@ class RaftNode:
             "entries": self._entries_from(nxt, self.cfg.max_append_entries),
             "leader_commit": self.commit_index})
 
+    # requires-lock: _lock
     def _handle(self, msg: dict, now: float) -> None:
         t = msg["type"]
         if t == "pre_vote":
@@ -931,6 +952,7 @@ class RaftNode:
             "type": "vote_reply", "from": self.node_id,
             "term": self.current_term, "granted": grant})
 
+    # requires-lock: _lock
     def _on_append_entries(self, msg: dict, now: float) -> None:
         ok = False
         if msg["term"] >= self.current_term:
@@ -1010,6 +1032,7 @@ class RaftNode:
             self.next_index[peer] = max(1, msg.get("hint_index", 1))
             self._send_append(peer)
 
+    # requires-lock: _lock
     def _on_install_snapshot(self, msg: dict, now: float) -> None:
         if msg["term"] >= self.current_term:
             if self.state != FOLLOWER:
@@ -1065,6 +1088,7 @@ class RaftNode:
                 and self._term_at(candidate) == self.current_term):
             self.commit_index = candidate
 
+    # requires-lock: _lock
     def _apply_committed(self) -> None:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
@@ -1118,6 +1142,7 @@ class RaftNode:
                     pend.result = result
                 pend.event.set()
 
+    # requires-lock: _lock
     def _apply_chunk(self, chunk: dict):
         """Reassemble chunked commands in log order; the FULL command
         applies exactly when its final chunk commits (every replica
@@ -1162,11 +1187,13 @@ class RaftNode:
     # landing mid-group would otherwise make a restored replica drop
     # the group's tail and silently never apply a command every other
     # replica applied.
+    # requires-lock: _lock
     def _wrap_snapshot(self):
         return {"__fsm__": self.snapshot_fn(),
                 "__chunks__": {k: list(v)
                                for k, v in self._chunk_buf.items()}}
 
+    # requires-lock: _lock
     def _unwrap_restore(self, data) -> None:
         if isinstance(data, dict) and "__fsm__" in data:
             self._chunk_buf = {k: list(v)

@@ -50,6 +50,8 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Tuple
 
+from consul_tpu import locks
+
 SEVERITIES = ("info", "warn", "error")
 
 RING = 4096
@@ -150,6 +152,18 @@ CATALOG: Dict[str, dict] = {
     "stream.subscriber.evicted": {"severity": "warn",
                                   "labels": ("topic", "count",
                                              "depth")},
+    # lock-discipline plane (consul_tpu/locks.py, audit mode): an
+    # acquisition that waited past the contention threshold, a hold
+    # past the hold budget, and an observed acquisition-order cycle —
+    # the runtime twins of the lock-order/guarded-by lint checkers.
+    # Journaled to the DEFAULT recorder only (never a chaos scenario's
+    # scoped deterministic ring) and always after the audited lock is
+    # released.
+    "runtime.lock.contention": {"severity": "warn",
+                                "labels": ("lock", "ms")},
+    "runtime.lock.held_too_long": {"severity": "warn",
+                                   "labels": ("lock", "ms")},
+    "runtime.lock.cycle": {"severity": "error", "labels": ("edge",)},
 }
 
 
@@ -159,22 +173,48 @@ class FlightRecorder:
     def __init__(self, ring: int = RING,
                  clock: Callable[[], float] = time.time,
                  forward_to_log: bool = True):
-        self._ring: deque = deque(maxlen=ring)
+        self._ring: deque = deque(maxlen=ring)  # guarded-by: _lock
         self._clock = clock
         self._forward_to_log = forward_to_log
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._seq = 0
-        self._spill = None          # (ops, file handle, path)
-        self._spill_lock = threading.Lock()
+        self._lock = locks.make_lock("flight.ring")
+        self._cond = locks.make_condition(self._lock)
+        self._seq = 0               # guarded-by: _lock
+        self._spill = None          # guarded-by: _lock — (ops, fh, path)
+        self._spill_lock = locks.make_lock("flight.spill")
         # re-entrancy guard: a nemesis-backed spill (FaultyStorage)
         # journals its OWN fault events from inside ops.write() — that
         # nested emit must skip the spill (ring-only) or it would
         # deadlock on the spill lock / recurse through the fault
         self._spill_tls = threading.local()
+        # emit-path re-entrancy guard (the PR 9 SIGUSR1 hazard): set
+        # for the duration of any critical section OR a full emit, so
+        # an emit re-entered on the same thread (a signal handler
+        # interrupting mid-emit, or an emit-observer on the log fan-out
+        # emitting back into the ring) takes the non-blocking ring-only
+        # path instead of self-deadlocking on the non-reentrant lock or
+        # recursing through the fan-out
+        self._emit_tls = threading.local()
         self.dropped = 0            # spill write failures (best-effort)
+        self.reentrant_dropped = 0  # re-entrant emits the ring was too
+        #                             busy to take (never a deadlock)
+        locks.register_guards(self, self._lock,
+                              "_ring", "_seq", "_spill")
 
     # ----------------------------------------------------------------- emit
+
+    @contextmanager
+    def _ring_lock(self):
+        """`with self._lock` plus the re-entrancy flag: any same-thread
+        emit() that starts while we are inside (a signal handler, an
+        emit-observer) sees `busy` and takes the non-blocking path."""
+        tls = self._emit_tls
+        prev = getattr(tls, "busy", False)
+        tls.busy = True
+        try:
+            with self._lock:
+                yield
+        finally:
+            tls.busy = prev
 
     def emit(self, name: str, labels: Optional[dict] = None,
              severity: Optional[str] = None, msg: str = "",
@@ -183,7 +223,16 @@ class FlightRecorder:
         """Journal one event; returns its seq.  Raises ValueError on an
         unregistered name or undeclared label key — the runtime twin of
         the event-names lint gate (all emitters are in-repo; misuse is
-        a bug to surface, not traffic to shed)."""
+        a bug to surface, not traffic to shed).
+
+        Re-entrancy safe: an emit re-entered on the SAME thread (a
+        signal handler firing mid-emit — the hazard PR 9's SIGUSR1
+        handler worked around with a flag-only dance — or a log-plane
+        observer emitting from inside the fan-out) journals ring-only
+        via a non-blocking acquire, or drops with `reentrant_dropped`
+        incremented when the ring lock is provably held by this very
+        thread.  It never deadlocks and never recurses the fan-out;
+        returns -1 for a dropped re-entrant row."""
         schema = CATALOG.get(name)
         if schema is None:
             raise ValueError(f"unregistered event name {name!r} — "
@@ -212,37 +261,67 @@ class FlightRecorder:
                "trace_id": trace_id}
         if msg:
             rec["msg"] = msg
-        with self._lock:
-            self._seq += 1
-            rec["seq"] = self._seq
-            self._ring.append(rec)
-            spill = self._spill
-            self._cond.notify_all()
-        if spill is not None and \
-                not getattr(self._spill_tls, "busy", False):
-            # spill I/O OUTSIDE the ring lock: a slow disk must never
-            # serialize emitters/readers/waiters behind a write (the
-            # whole point of raft's staged emission).  The dedicated
-            # spill lock keeps lines whole; concurrent emitters may
-            # interleave out of seq order — rows carry their seq.
-            # Events emitted FROM the spill write itself (a nemesis
-            # disk journaling its injected fault) stay ring-only.
-            ops, f, _ = spill
-            self._spill_tls.busy = True
-            try:
-                with self._spill_lock:
-                    # re-check under the spill lock: a concurrent
-                    # detach_spill() may have popped + closed the
-                    # handle since we snapshotted it above
-                    if self._spill is spill:
-                        ops.write(f, (json.dumps(rec, sort_keys=True)
-                                      + "\n").encode())
-            except (OSError, ValueError):
-                self.dropped += 1       # spill is best-effort
-            finally:
-                self._spill_tls.busy = False
-        if self._forward_to_log:
-            self._to_log(rec)
+        tls = self._emit_tls
+        if getattr(tls, "busy", False):
+            # re-entered on this thread: best-effort ring-only append —
+            # no spill, no log fan-out, no blocking on a lock the
+            # interrupted frame below us may already hold
+            if self._lock.acquire(False):
+                try:
+                    # lint: ok=guarded-by (held via the explicit non-blocking acquire above)
+                    self._seq += 1
+                    # lint: ok=guarded-by (held via the explicit non-blocking acquire above)
+                    rec["seq"] = self._seq
+                    # lint: ok=guarded-by (held via the explicit non-blocking acquire above)
+                    self._ring.append(rec)
+                    self._cond.notify_all()
+                finally:
+                    self._lock.release()
+                return rec["seq"]
+            self.reentrant_dropped += 1
+            return -1
+        tls.busy = True
+        try:
+            with self._ring_lock():
+                self._seq += 1
+                rec["seq"] = self._seq
+                self._ring.append(rec)
+                spill = self._spill
+                self._cond.notify_all()
+            if spill is not None and \
+                    not getattr(self._spill_tls, "busy", False):
+                # spill I/O OUTSIDE the ring lock: a slow disk must
+                # never serialize emitters/readers/waiters behind a
+                # write (the whole point of raft's staged emission).
+                # The dedicated spill lock keeps lines whole;
+                # concurrent emitters may interleave out of seq order —
+                # rows carry their seq.  Events emitted FROM the spill
+                # write itself (a nemesis disk journaling its injected
+                # fault) stay ring-only.
+                ops, f, _ = spill
+                self._spill_tls.busy = True
+                try:
+                    with self._spill_lock:
+                        # re-check under the spill lock: a concurrent
+                        # detach_spill() may have popped + closed the
+                        # handle since we snapshotted it above.  A
+                        # benign unlocked READ by design: both outcomes
+                        # of the race are safe (stale non-None writes a
+                        # line the detach already drained behind the
+                        # spill lock; stale None drops one spill row).
+                        # lint: ok=guarded-by (benign racy re-check; both outcomes safe under _spill_lock)
+                        if self._spill is spill:
+                            ops.write(
+                                f, (json.dumps(rec, sort_keys=True)
+                                    + "\n").encode())
+                except (OSError, ValueError):
+                    self.dropped += 1       # spill is best-effort
+                finally:
+                    self._spill_tls.busy = False
+            if self._forward_to_log:
+                self._to_log(rec)
+        finally:
+            tls.busy = False
         return rec["seq"]
 
     @staticmethod
@@ -264,7 +343,7 @@ class FlightRecorder:
 
     @property
     def last_seq(self) -> int:
-        with self._lock:
+        with self._ring_lock():
             return self._seq
 
     def read_page(self, since: int = 0, limit: Optional[int] = None,
@@ -284,7 +363,7 @@ class FlightRecorder:
         merely truncated away."""
         if limit == 0:
             return [], since
-        with self._lock:
+        with self._ring_lock():
             out = [dict(r) for r in self._ring if r["seq"] > since]
             horizon = self._seq
         if name is not None:
@@ -302,7 +381,7 @@ class FlightRecorder:
         return self.read_page(since, limit, name, severity)[0]
 
     def tail(self, n: int) -> List[dict]:
-        with self._lock:
+        with self._ring_lock():
             out = list(self._ring)[-n:] if n else []
         return [dict(r) for r in out]
 
@@ -311,7 +390,7 @@ class FlightRecorder:
         returns the latest seq — the blocking-query wait behind
         /v1/agent/events?since=N&wait=T."""
         deadline = time.monotonic() + max(0.0, timeout)
-        with self._lock:
+        with self._ring_lock():
             while self._seq <= since:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
@@ -322,13 +401,13 @@ class FlightRecorder:
     def dump_jsonl(self) -> bytes:
         """The whole ring as JSON lines (the debug-archive section;
         sort_keys so a fixed-clock recorder's dump is byte-stable)."""
-        with self._lock:
+        with self._ring_lock():
             rows = list(self._ring)
         return "".join(json.dumps(r, sort_keys=True) + "\n"
                        for r in rows).encode()
 
     def clear(self) -> None:
-        with self._lock:
+        with self._ring_lock():
             self._ring.clear()
 
     # ---------------------------------------------------------------- spill
@@ -341,11 +420,11 @@ class FlightRecorder:
         from consul_tpu import storage
         io = ops or storage.OS
         f = io.open_append(path)
-        with self._lock:
+        with self._ring_lock():
             self._spill = (io, f, path)
 
     def detach_spill(self, sync: bool = False) -> None:
-        with self._lock:
+        with self._ring_lock():
             spill, self._spill = self._spill, None
         if spill is None:
             return

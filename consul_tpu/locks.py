@@ -1,0 +1,565 @@
+"""Instrumented lock seam: the runtime half of the lock-discipline plane.
+
+The reference's standing concurrency gate is `go test -race` over a tree
+where every subsystem shares state across goroutines.  Python has no
+TSan, so four PRs' worth of locking contracts (raft's staged
+`_metrics_buf`, the store's "nothing emits under the store lock",
+ViewStore's "registry lock never held across a snapshot", the
+publisher's stage-then-flush eviction accounting) lived only in PR
+descriptions.  This module is the TSan-lite seam that makes them
+observable:
+
+  * `make_lock(name)` / `make_rlock(name)` / `make_condition(lock)` —
+    every production lock in consensus/, catalog/, stream/, api/,
+    ratelimit.py, visibility.py, submatview.py, flight.py is created
+    through these.  **Zero-cost passthrough** unless audit mode is on:
+    with `CONSUL_TPU_LOCK_AUDIT` unset they return the plain
+    `threading` primitives — no wrapper, no indirection, nothing on the
+    hot path.
+  * Audit mode (`CONSUL_TPU_LOCK_AUDIT=1`, or `enable_audit()` before
+    the audited objects are constructed) swaps in `_TrackedLock` /
+    `_TrackedRLock`: per-thread held stacks feed a process-wide
+    acquisition-order graph keyed by lock NAME (instances of the same
+    class rank equal — see `same_name_nesting` below), observed
+    inversions are recorded as cycles (and journaled as
+    `runtime.lock.cycle`), acquisition waits and hold times past
+    thresholds journal `runtime.lock.contention` /
+    `runtime.lock.held_too_long` flight events — always AFTER release,
+    never under the audited lock, and always into the process DEFAULT
+    recorder so a chaos scenario's scoped deterministic ring stays
+    byte-identical across replays.
+  * `register_guards(obj, lock, *fields)` — the runtime twin of the
+    static `guarded-by` checker: under audit the owning class's
+    `__setattr__` is patched once, and every REBIND of a registered
+    field (`self._index += 1`, the `buf, self._buf = self._buf, []`
+    staging swap) is owner-checked against the guarding lock.  A rebind
+    by a thread that does not hold the lock is recorded as a sampled
+    race.  In-place container mutation does not route through
+    `__setattr__` — the sampler sees the rebind traffic (counters,
+    staging swaps, table installs), which is exactly where the
+    write-write races of this codebase's idiom live; the static checker
+    covers the rest at the source line.
+
+Same-name nesting: one process hosts many instances of the same class
+(three RaftNodes in an in-process cluster; a store per DC).  Their
+locks share a graph node, so A.lock -> B.lock between two instances
+would read as a self-cycle.  Those edges are counted in
+`same_name_nesting` and excluded from cycle detection — a deliberate
+precision trade documented in README "Race & lock discipline".
+
+Nothing here imports jax; `flight` is imported lazily at emission time
+(flight.py itself creates its ring lock through this module).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+AUDIT_ENV = "CONSUL_TPU_LOCK_AUDIT"
+
+# journaling thresholds (seconds); tests shrink them on the auditor
+CONTENTION_S = 0.05
+HELD_S = 0.25
+
+_audit = os.environ.get(AUDIT_ENV, "") == "1"
+_auditor: Optional["LockAuditor"] = None
+_state_lock = threading.Lock()
+
+
+def audit_enabled() -> bool:
+    return _audit
+
+
+def enable_audit() -> "LockAuditor":
+    """Turn audit mode on for locks created FROM NOW ON (existing plain
+    locks stay plain — enable before constructing the objects under
+    test, or set CONSUL_TPU_LOCK_AUDIT=1 at process start to cover
+    module-level singletons like flight's default recorder)."""
+    global _audit
+    with _state_lock:
+        _audit = True
+        return _get_auditor()
+
+
+def disable_audit() -> None:
+    global _audit
+    with _state_lock:
+        _audit = False
+
+
+def _get_auditor() -> "LockAuditor":
+    global _auditor
+    if _auditor is None:
+        _auditor = LockAuditor()
+    return _auditor
+
+
+def auditor() -> Optional["LockAuditor"]:
+    return _auditor
+
+
+def reset_audit() -> None:
+    """Drop the accumulated graph/stats (tests; the audit CLI between
+    phases).  Patched classes stay patched — their checks no-op for
+    instances registered with the discarded auditor."""
+    global _auditor
+    with _state_lock:
+        _auditor = None
+
+
+# ----------------------------------------------------------------- factories
+
+
+def make_lock(name: str):
+    """A mutex for production state.  Plain `threading.Lock` unless
+    audit mode is on."""
+    if _audit:
+        return _TrackedLock(name, _get_auditor())
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if _audit:
+        return _TrackedRLock(name, _get_auditor())
+    return threading.RLock()
+
+
+def make_condition(lock=None, name: str = "cond"):
+    """`threading.Condition` over an (optionally tracked) lock.  With
+    no lock, the condition gets its own — tracked under `name` in
+    audit mode."""
+    if lock is None:
+        lock = make_rlock(name)
+    return threading.Condition(lock)
+
+
+def lock_of(primitive):
+    """The lock behind a Condition made by `make_condition` (or the
+    primitive itself) — what `register_guards` wants when a class
+    synchronizes on a condition rather than a bare lock."""
+    return getattr(primitive, "_lock", primitive)
+
+
+def held_by_me(lock) -> bool:
+    """True when the calling thread holds `lock` — only answerable for
+    tracked locks; plain locks conservatively report True (the check
+    is an audit-mode assertion, never a control-flow input)."""
+    if isinstance(lock, (_TrackedLock, _TrackedRLock)):
+        return lock.held_by_me()
+    return True
+
+
+# ------------------------------------------------------------------- auditor
+
+
+class _Held:
+    __slots__ = ("lock", "t_acq", "waited", "count")
+
+    def __init__(self, lock, t_acq: float, waited: float):
+        self.lock = lock
+        self.t_acq = t_acq
+        self.waited = waited
+        self.count = 1
+
+
+class LockAuditor:
+    """Process-wide acquisition-order graph + contention/hold stats +
+    the guarded-field rebind sampler.  Internally synchronized by a
+    PLAIN lock (auditing the auditor would recurse)."""
+
+    def __init__(self, contention_s: float = CONTENTION_S,
+                 held_s: float = HELD_S):
+        self.contention_s = contention_s
+        self.held_s = held_s
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        # name -> name -> count: "held a while acquiring b"
+        self.edges: Dict[str, Dict[str, int]] = {}
+        self.cycles: List[dict] = []
+        self._cycle_keys: set = set()
+        self.same_name_nesting: Dict[str, int] = {}
+        # name -> {acquisitions, contended, wait_total_s, wait_max_s,
+        #          hold_total_s, hold_max_s}
+        self.stats: Dict[str, dict] = {}
+        self.races: List[dict] = []
+        self._race_keys: set = set()
+        self.sampled_writes = 0
+        # guarded-field registry: id(obj) -> (weakref, lock, fields)
+        self._instances: Dict[int, tuple] = {}
+        self._class_fields: Dict[type, set] = {}
+        self.guarded_fields = 0
+
+    # ------------------------------------------------------------ held stack
+
+    def _held(self) -> List[_Held]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _emitting(self) -> bool:
+        return getattr(self._tls, "emitting", False)
+
+    def find_held(self, lock) -> Optional[_Held]:
+        for h in reversed(self._held()):
+            if h.lock is lock:
+                return h
+        return None
+
+    # ------------------------------------------------------- acquire/release
+
+    def note_acquired(self, lock, waited: float) -> None:
+        if self._emitting():
+            return
+        held = self._held()
+        name = lock.name
+        with self._mu:
+            st = self.stats.setdefault(name, {
+                "acquisitions": 0, "contended": 0, "wait_total_s": 0.0,
+                "wait_max_s": 0.0, "hold_total_s": 0.0,
+                "hold_max_s": 0.0})
+            st["acquisitions"] += 1
+            if waited > 0.0:
+                st["contended"] += 1
+                st["wait_total_s"] += waited
+                st["wait_max_s"] = max(st["wait_max_s"], waited)
+            for h in held:
+                if h.lock.name == name:
+                    self.same_name_nesting[name] = \
+                        self.same_name_nesting.get(name, 0) + 1
+                    continue
+                out = self.edges.setdefault(h.lock.name, {})
+                fresh = name not in out
+                out[name] = out.get(name, 0) + 1
+                if fresh:
+                    path = self._path(name, h.lock.name)
+                    if path is not None:
+                        key = tuple(sorted(path))
+                        if key not in self._cycle_keys:
+                            self._cycle_keys.add(key)
+                            self.cycles.append(
+                                {"edge": f"{h.lock.name}->{name}",
+                                 "path": path})
+                            self._emit("runtime.lock.cycle",
+                                       {"edge": "<".join(path)})
+        held.append(_Held(lock, time.perf_counter(), waited))
+
+    def note_released(self, lock) -> Optional[_Held]:
+        if self._emitting():
+            return None
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].lock is lock:
+                h = held[i]
+                if h.count > 1:
+                    h.count -= 1
+                    return None
+                del held[i]
+                hold = time.perf_counter() - h.t_acq
+                with self._mu:
+                    st = self.stats.get(lock.name)
+                    if st is not None:
+                        st["hold_total_s"] += hold
+                        st["hold_max_s"] = max(st["hold_max_s"], hold)
+                h.t_acq = hold          # reuse the slot: hold time out
+                return h
+        return None
+
+    def after_release(self, lock, h: _Held) -> None:
+        """Threshold journaling — strictly after the lock is free, so
+        the journal write never happens under the audited lock."""
+        if h.waited > self.contention_s:
+            self._emit("runtime.lock.contention",
+                       {"lock": lock.name,
+                        "ms": round(h.waited * 1000.0, 2)})
+        if h.t_acq > self.held_s:       # t_acq holds the hold time now
+            self._emit("runtime.lock.held_too_long",
+                       {"lock": lock.name,
+                        "ms": round(h.t_acq * 1000.0, 2)})
+
+    def _emit(self, name: str, labels: dict) -> None:
+        self._tls.emitting = True
+        try:
+            from consul_tpu import flight
+            # the DEFAULT recorder, not the scoped current(): chaos
+            # scenarios assert byte-identical scoped rings across
+            # seeded replays, and lock timings are wall-clock noise
+            flight.default_recorder().emit(name, labels=labels)
+        except Exception:
+            pass                        # audit must never take the tree down
+        finally:
+            self._tls.emitting = False
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """DFS: a path src -> ... -> dst in the edge graph (the reverse
+        path that would close a cycle with the edge just added)."""
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self.edges.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ------------------------------------------------------- guarded fields
+
+    def register_guards(self, obj, lock, fields: Tuple[str, ...]) -> None:
+        cls = type(obj)
+        with self._mu:
+            known = self._class_fields.setdefault(cls, set())
+            fresh = set(fields) - known
+            known.update(fields)
+            self.guarded_fields += len(fresh)
+            oid = id(obj)
+            rec = self._instances.get(oid)
+            # merge: one object may guard field groups under several
+            # locks (the publisher's registry vs stats locks)
+            fmap = dict(rec[1]) if rec is not None and \
+                rec[0]() is obj else {}
+            fmap.update({f: lock for f in fields})
+
+            def _gone(_ref, _oid=oid, _self=self):
+                _self._instances.pop(_oid, None)
+
+            self._instances[oid] = (weakref.ref(obj, _gone), fmap)
+        self._patch_setattr(cls)
+
+    def _patch_setattr(self, cls: type) -> None:
+        orig = cls.__setattr__
+        if getattr(orig, "_lock_audit_patch", False):
+            return
+        aud_ref = weakref.ref(self)
+
+        def checked(selfo, attr, value,
+                    _orig=orig, _cls=cls, _aud_ref=aud_ref):
+            aud = _aud_ref()
+            if aud is not None and aud is _auditor:
+                fields = aud._class_fields.get(_cls)
+                if fields is not None and attr in fields:
+                    aud._check_write(selfo, attr)
+            _orig(selfo, attr, value)
+
+        checked._lock_audit_patch = True
+        cls.__setattr__ = checked
+
+    def _check_write(self, obj, attr: str) -> None:
+        rec = self._instances.get(id(obj))
+        if rec is None or rec[0]() is not obj:
+            return
+        lock = rec[1].get(attr)
+        if lock is None:
+            return
+        self.sampled_writes += 1
+        if held_by_me(lock):
+            return
+        key = (type(obj).__name__, attr)
+        with self._mu:
+            if key in self._race_keys:
+                return
+            self._race_keys.add(key)
+            self.races.append({
+                "class": key[0], "field": attr,
+                "lock": getattr(lock, "name", "?"),
+                "thread": threading.current_thread().name})
+
+    # ------------------------------------------------------------- reporting
+
+    def report(self) -> dict:
+        with self._mu:
+            edges = [{"from": a, "to": b, "count": n}
+                     for a, out in sorted(self.edges.items())
+                     for b, n in sorted(out.items())]
+            stats = {}
+            for name, st in sorted(self.stats.items()):
+                n = max(1, st["acquisitions"])
+                stats[name] = {
+                    "acquisitions": st["acquisitions"],
+                    "contended": st["contended"],
+                    "wait_max_ms": round(st["wait_max_s"] * 1e3, 3),
+                    "wait_mean_ms": round(
+                        st["wait_total_s"] / n * 1e3, 4),
+                    "hold_max_ms": round(st["hold_max_s"] * 1e3, 3),
+                    "hold_mean_ms": round(
+                        st["hold_total_s"] / n * 1e3, 4)}
+            return {
+                "edges": edges,
+                "cycles": list(self.cycles),
+                "same_name_nesting": dict(self.same_name_nesting),
+                "locks": stats,
+                "races": list(self.races),
+                "sampled_writes": self.sampled_writes,
+                "guarded_fields": self.guarded_fields,
+                "guarded_instances": len(self._instances),
+            }
+
+
+def audit_report() -> dict:
+    """The full report, or a stub when audit never ran."""
+    a = _auditor
+    if a is None:
+        return {"enabled": False}
+    out = a.report()
+    out["enabled"] = True
+    return out
+
+
+def audit_summary() -> dict:
+    """The one-paragraph artifact stamp (soak/chaos reports)."""
+    a = _auditor
+    if a is None:
+        return {"enabled": False}
+    r = a.report()
+    return {"enabled": True, "locks": len(r["locks"]),
+            "edges": len(r["edges"]), "cycles": len(r["cycles"]),
+            "races": len(r["races"]),
+            "sampled_writes": r["sampled_writes"],
+            "guarded_fields": r["guarded_fields"]}
+
+
+def check_clean() -> List[str]:
+    """Violations the audit observed — the list a gate fails on."""
+    a = _auditor
+    if a is None:
+        return []
+    out = []
+    for c in a.cycles:
+        out.append(f"lock-order cycle observed at runtime: "
+                   f"{'<'.join(c['path'])} (closing edge {c['edge']})")
+    for r in a.races:
+        out.append(f"unlocked write to guarded field "
+                   f"{r['class']}.{r['field']} (guarded by "
+                   f"{r['lock']}) on thread {r['thread']}")
+    return out
+
+
+def register_guards(obj, lock, *fields: str) -> None:
+    """Declare `fields` of `obj` guarded by `lock` for the runtime
+    sampler.  No-op (one boolean test) unless audit mode is on — call
+    it at the end of __init__, after the fields exist."""
+    if not _audit:
+        return
+    if isinstance(lock, (_TrackedLock, _TrackedRLock)):
+        _get_auditor().register_guards(obj, lock, fields)
+
+
+# ------------------------------------------------------------ tracked locks
+
+
+class _TrackedLock:
+    """A named, audited mutex.  API-compatible with threading.Lock for
+    every use in this tree (with-statement, Condition backing,
+    non-blocking acquire)."""
+
+    __slots__ = ("name", "_inner", "_aud")
+
+    def __init__(self, name: str, aud: LockAuditor):
+        self.name = name
+        self._inner = threading.Lock()
+        self._aud = aud
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(False)
+        waited = 0.0
+        if not got:
+            if not blocking:
+                return False
+            t0 = time.perf_counter()
+            got = self._inner.acquire(True, timeout)
+            waited = time.perf_counter() - t0
+            if not got:
+                return False
+        self._aud.note_acquired(self, waited)
+        return True
+
+    def release(self) -> None:
+        h = self._aud.note_released(self)
+        self._inner.release()
+        if h is not None:
+            self._aud.after_release(self, h)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def held_by_me(self) -> bool:
+        return self._aud.find_held(self) is not None
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class _TrackedRLock:
+    """Audited re-entrant lock; implements the `_release_save` /
+    `_acquire_restore` / `_is_owned` protocol so threading.Condition
+    fully releases recursion across wait()."""
+
+    __slots__ = ("name", "_inner", "_aud")
+
+    def __init__(self, name: str, aud: LockAuditor):
+        self.name = name
+        self._inner = threading.RLock()
+        self._aud = aud
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = self._aud.find_held(self)
+        if held is not None:
+            if not self._inner.acquire(blocking, timeout):
+                return False
+            held.count += 1
+            return True
+        got = self._inner.acquire(False)
+        waited = 0.0
+        if not got:
+            if not blocking:
+                return False
+            t0 = time.perf_counter()
+            got = self._inner.acquire(True, timeout)
+            waited = time.perf_counter() - t0
+            if not got:
+                return False
+        self._aud.note_acquired(self, waited)
+        return True
+
+    def release(self) -> None:
+        h = self._aud.note_released(self)
+        self._inner.release()
+        if h is not None:
+            self._aud.after_release(self, h)
+
+    def held_by_me(self) -> bool:
+        return self._aud.find_held(self) is not None
+
+    # Condition protocol: full-depth release around wait()
+    def _release_save(self):
+        h = self._aud.find_held(self)
+        if h is not None:
+            h.count = 1                 # collapse recursion, then pop
+            self._aud.note_released(self)
+        return self._inner._release_save()
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._aud.note_acquired(self, 0.0)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False

@@ -48,11 +48,10 @@ caused it.
 from __future__ import annotations
 
 import math
-import threading
 import time
 from typing import Dict, Optional, Tuple
 
-from consul_tpu import telemetry
+from consul_tpu import locks, telemetry
 
 MODES = ("disabled", "permissive", "enforcing")
 
@@ -115,10 +114,12 @@ class RateLimiter:
     def __init__(self, mode: str = "disabled",
                  read_rate: float = 500.0, read_burst: float = 1000.0,
                  write_rate: float = 200.0, write_burst: float = 400.0):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("ratelimit.limiter")
         self.configure(mode=mode, read_rate=read_rate,
                        read_burst=read_burst, write_rate=write_rate,
                        write_burst=write_burst)
+        locks.register_guards(self, self._lock,
+                              "_global", "_clients", "_last_event")
 
     def configure(self, mode: Optional[str] = None,
                   read_rate: Optional[float] = None,
@@ -151,11 +152,14 @@ class RateLimiter:
             else:
                 self._write = prev_w
             now = time.monotonic()
+            # guarded-by: _lock
             self._global: Dict[str, _Bucket] = {
                 "read": _Bucket(self._read[1], now),
                 "write": _Bucket(self._write[1], now)}
             # (client, class) -> bucket; bounded, LRU-ish eviction
+            # guarded-by: _lock
             self._clients: Dict[Tuple[str, str], _Bucket] = {}
+            # guarded-by: _lock
             self._last_event: Dict[str, float] = {}
 
     # ------------------------------------------------------------- checking
@@ -284,9 +288,11 @@ class ApplyGate:
         self.max_pending = int(max_pending)
         self.min_budget_s = float(min_budget_s)
         self.enabled = enabled
-        self._ema_commit_s = 0.0
-        self._last_event = 0.0
-        self._lock = threading.Lock()
+        self._ema_commit_s = 0.0    # guarded-by: _lock
+        self._last_event = 0.0      # guarded-by: _lock
+        self._lock = locks.make_lock("ratelimit.applygate")
+        locks.register_guards(self, self._lock,
+                              "_ema_commit_s", "_last_event")
 
     def observe_commit(self, seconds: float) -> None:
         """Feed one observed commit wait into the deadline EMA."""

@@ -26,6 +26,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
+from consul_tpu import locks
+
 # Topic names (reference pbsubscribe topics + the memdb tables that feed
 # blocking queries; state/schema.go:10).
 TOPIC_KV = "kv"
@@ -172,29 +174,36 @@ class EventPublisher:
 
     def __init__(self, buffer_len: int = 1024,
                  max_sub_queue: int = MAX_SUB_QUEUE):
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("stream.publisher")
         self._buffer_len = buffer_len
         # per-subscriber buffer bound (eviction threshold); tests
         # shrink it to exercise the eviction contract cheaply
         self._max_sub_queue = max(2, int(max_sub_queue))
-        # topic -> deque[(index, [Event])]
+        # topic -> deque[(index, [Event])]  # guarded-by: _lock
         self._buffers: Dict[str, deque] = {}
         # topic -> highest index evicted off the buffer tail (0 = nothing
         # evicted): the explicit loss marker subscribe() checks against —
         # inferring loss from the oldest buffered batch would misread
-        # cross-topic index gaps as eviction
+        # cross-topic index gaps as eviction  # guarded-by: _lock
         self._evicted_through: Dict[str, int] = {}
-        self._subs: List[_Sub] = []
+        self._subs: List[_Sub] = []     # guarded-by: _lock
         # gauges staged during publish (which runs under the STORE
         # lock) and flushed by drain/subscribe sites on their own
         # threads: topic -> last fan-out width; eviction counts
-        self._stats_lock = threading.Lock()
+        self._stats_lock = locks.make_lock("stream.publisher.stats")
+        # guarded-by: _stats_lock
         self._fanout_stats: Dict[str, int] = {}
+        # guarded-by: _stats_lock
         self._evict_stats: Dict[str, int] = {}
         # staged SUBSCRIBER evictions: topic -> [count, max depth],
         # aggregated so a mass eviction journals one flight row per
         # topic per flush, not one per subscriber
+        # guarded-by: _stats_lock
         self._sub_evict_stats: Dict[str, list] = {}
+        locks.register_guards(self, self._lock,
+                              "_buffers", "_evicted_through", "_subs")
+        locks.register_guards(self, self._stats_lock, "_fanout_stats",
+                              "_evict_stats", "_sub_evict_stats")
 
     # ----------------------------------------------------------- publishing
 
@@ -305,7 +314,7 @@ class EventPublisher:
         check — for consumers that snapshot state themselves right after
         subscribing (submatview materializers)."""
         sub = _Sub(topic=topic, key=key, next_index=since_index or 0,
-                   cond=threading.Condition(),
+                   cond=locks.make_condition(name="stream.sub"),
                    queue=deque(maxlen=self._max_sub_queue))
         n = None
         try:

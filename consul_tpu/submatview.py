@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from consul_tpu import locks
 from consul_tpu.stream.publisher import SnapshotRequired
 
 
@@ -33,13 +34,16 @@ class Materializer:
         self.topic = topic
         self.key = key
         self.snapshot_fn = snapshot_fn
-        self._cond = threading.Condition()
-        self._value: Any = None
-        self._index = 0
+        self._cond = locks.make_condition(name="submatview.view")
+        self._value: Any = None       # guarded-by: _cond
+        self._index = 0               # guarded-by: _cond
         self._running = False
         self._thread: Optional[threading.Thread] = None
         self.resets = 0               # SnapshotRequired re-snapshots
-        self._inflight = 0            # parked fetch()ers (sweep guard)
+        self._inflight = 0            # guarded-by: _cond — parked
+        #                               fetch()ers (sweep guard)
+        locks.register_guards(self, locks.lock_of(self._cond),
+                              "_value", "_index", "_inflight")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -164,8 +168,11 @@ class ViewStore:
     def __init__(self, publisher, idle_ttl: float = 120.0):
         self.publisher = publisher
         self.idle_ttl = idle_ttl
+        # the shared view registry; held for dict ops ONLY, never
+        # across a snapshot/materialization  # guarded-by: _lock
         self._views: Dict[Tuple[str, str, str], _ViewEntry] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("submatview.registry")
+        locks.register_guards(self, self._lock, "_views")
 
     _closed = False
 

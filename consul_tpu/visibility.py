@@ -49,6 +49,8 @@ import time
 from collections import OrderedDict
 from typing import Optional, Tuple
 
+from consul_tpu import locks
+
 TABLE_CAP = 4096
 
 # a stage lagging its apply by more than this journals a flight event
@@ -93,8 +95,10 @@ class VisibilityTable:
 
     def __init__(self, cap: int = TABLE_CAP):
         self._cap = cap
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("visibility.table")
+        # the bounded index->record ring  # guarded-by: _lock
         self._rec: "OrderedDict[int, dict]" = OrderedDict()
+        locks.register_guards(self, self._lock, "_rec")
 
     # ------------------------------------------------------------- stamping
     # (called under the STORE lock — table writes only, no emission)

@@ -8,6 +8,8 @@ XLA_FLAGS *and* override the config after import, before any backend
 initialization."""
 
 import os
+import threading
+import time
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -17,6 +19,64 @@ import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(autouse=True)
+def _thread_hygiene(request):
+    """Tier-1 thread-leak tripwire (ISSUE 14): every test gets a
+    snapshot of live threads on entry and fails if it leaves behind a
+    NON-DAEMON thread the snapshot didn't contain — the class of leak
+    that wedges interpreter shutdown and was hand-chased out of the
+    chaos_live/wanfed/submatview reapers in PR 9.  Daemon threads are
+    tolerated (reapers/materializers are daemonized by design; the
+    module fixture teardown and process exit collect them).
+
+    Opt out for intentionally long-lived machinery with
+    `@pytest.mark.thread_leak_ok(reason=...)`."""
+    if request.node.get_closest_marker("thread_leak_ok"):
+        yield
+        return
+    before = set(threading.enumerate())
+    yield
+    deadline = time.time() + 2.0        # teardown grace: joins race us
+    while True:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive() and not t.daemon]
+        if not leaked or time.time() > deadline:
+            break
+        time.sleep(0.05)
+    if leaked:
+        names = ", ".join(f"{t.name} (target={getattr(t, '_target', None)})"
+                          for t in leaked)
+        pytest.fail(
+            f"test leaked {len(leaked)} non-daemon thread(s): {names} "
+            f"— join them in teardown, daemonize them, or mark the "
+            f"test @pytest.mark.thread_leak_ok(reason=...)")
+
+
+@pytest.fixture(autouse=True)
+def _lock_audit_clean():
+    """When the lock-discipline audit is armed (CONSUL_TPU_LOCK_AUDIT=1
+    / tools/lock_audit.py), any test that OBSERVES a lock-order cycle
+    or an unlocked guarded-field rebind fails on the spot, with the
+    offending edge/field named.  Free when audit is off."""
+    from consul_tpu import locks
+    aud = locks.auditor()
+    if aud is None:
+        yield
+        return
+    cycles0, races0 = len(aud.cycles), len(aud.races)
+    yield
+    aud = locks.auditor()
+    if aud is None:
+        return
+    fresh = ([f"cycle: {'<'.join(c['path'])}"
+              for c in aud.cycles[cycles0:]]
+             + [f"race: {r['class']}.{r['field']} (thread "
+                f"{r['thread']})" for r in aud.races[races0:]])
+    if fresh:
+        pytest.fail("lock audit observed violations during this test: "
+                    + "; ".join(fresh))
 
 
 @pytest.fixture(autouse=True, scope="module")

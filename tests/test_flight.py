@@ -201,6 +201,66 @@ def test_events_multiplex_onto_monitor_stream():
         mon.stop()
 
 
+def test_emit_reentrant_from_emit_observer_never_deadlocks():
+    """ISSUE 14 regression (the PR 9 SIGUSR1 flag-only-dance hazard):
+    an observer on the log fan-out that emits BACK into the recorder
+    must neither deadlock on the non-reentrant ring lock nor recurse
+    the fan-out — the nested emit journals ring-only and returns."""
+    from consul_tpu.logging import default_buffer
+
+    r = flight.FlightRecorder(clock=lambda: 0.0)   # forwards to log
+
+    class EmitBack:
+        calls = 0
+
+        def _push(self, line):
+            if "event=chaos.fault.injected" in line:
+                EmitBack.calls += 1
+                # re-enter emit from INSIDE the observer fan-out; with
+                # unbounded recursion this would re-trigger itself
+                r.emit("chaos.fault.healed",
+                       labels={"fault": "partition", "target": "a|b"})
+
+    buf = default_buffer()
+    obs = EmitBack()
+    buf._monitors.append(obs)
+    try:
+        seq = r.emit("chaos.fault.injected",
+                     labels={"fault": "partition", "target": "a|b"})
+        assert seq > 0
+        assert EmitBack.calls == 1          # fan-out ran exactly once
+        names = [e["name"] for e in r.tail(4)]
+        # the nested emit landed in the ring (ring-only path) next to
+        # the outer one; nothing was dropped
+        assert "chaos.fault.injected" in names
+        assert "chaos.fault.healed" in names
+        assert r.reentrant_dropped == 0
+    finally:
+        buf._monitors.remove(obs)
+
+
+def test_emit_reentrant_while_ring_lock_held_drops_with_counter():
+    """The signal-handler shape: emit re-entered while THIS thread sits
+    inside a ring critical section cannot block — it drops the row and
+    counts it instead of self-deadlocking."""
+    r = fresh()
+    r.emit("agent.started", labels={"node": "n1"})
+    # simulate the interrupted-mid-critical-section state: the ring
+    # lock held by this thread, the re-entrancy flag set (exactly what
+    # _ring_lock() establishes when a signal lands inside it)
+    r._lock.acquire()
+    r._emit_tls.busy = True
+    try:
+        seq = r.emit("agent.stopped", labels={"node": "n1"})
+    finally:
+        r._emit_tls.busy = False
+        r._lock.release()
+    assert seq == -1
+    assert r.reentrant_dropped == 1
+    # the recorder stays fully functional afterwards
+    assert r.emit("agent.stopped", labels={"node": "n1"}) > 0
+
+
 # ------------------------------------------------------------- profiler
 
 

@@ -1217,3 +1217,330 @@ def test_overload_event_and_metric_names_registered():
     assert "label 'victim' not declared" in msgs
     assert "unregistered event name 'ratelimit.vaporized'" in msgs
     assert "label 'speed' not declared" in msgs
+
+
+# ------------------------------------------ lock-discipline (ISSUE 14)
+
+
+def test_guarded_by_fires_and_stays_silent():
+    """guarded-by: an annotated field touched outside `with
+    self.<lock>` fires (including through a self-alias); accesses
+    under the lock, the condition built over it, copies, the
+    ownership-transfer swap, and requires-lock helpers stay silent."""
+    bad = """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rec = {}          # guarded-by: _lock
+
+            def bare(self, k):
+                return self._rec.get(k)
+
+            def alias_bypass(self, k, v):
+                s = self
+                s._rec[k] = v
+    """
+    hits = check_snippet("guarded-by", bad,
+                         relpath="consul_tpu/catalog/snippet.py")
+    assert len(hits) == 2
+    assert all("guarded-by '_lock'" in f.message for f in hits)
+    assert {f.line for f in hits} == {10, 14}   # incl. the alias line
+
+    clean = """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._rec = {}          # guarded-by: _lock
+
+            def put(self, k, v):
+                with self._lock:
+                    self._rec[k] = v
+
+            def get_via_cond(self, k):
+                with self._cond:
+                    return dict(self._rec)
+
+            def drain(self):
+                with self._lock:
+                    out, self._rec = self._rec, {}
+                return out
+
+            # requires-lock: _lock
+            def helper(self):
+                return len(self._rec)
+    """
+    assert check_snippet("guarded-by", clean,
+                         relpath="consul_tpu/catalog/snippet.py") == []
+
+
+def test_guarded_by_escape_analysis():
+    """The escape pass: a guarded MUTABLE container returned bare or
+    aliased past the end of the critical section fires; copies and
+    scalar fields do not."""
+    bad = """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rec = {}          # guarded-by: _lock
+
+            def leak_return(self):
+                with self._lock:
+                    return self._rec
+
+            def leak_alias(self):
+                with self._lock:
+                    rec = self._rec
+                return rec.get("x")
+    """
+    hits = check_snippet("guarded-by", bad,
+                         relpath="consul_tpu/catalog/snippet.py")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 2
+    assert "returned bare out of the critical section" in msgs
+    assert "escapes the critical section" in msgs
+
+    clean = """
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rec = {}          # guarded-by: _lock
+                self._n = 0             # guarded-by: _lock
+
+            def snapshot(self):
+                with self._lock:
+                    return dict(self._rec)
+
+            def count(self):
+                with self._lock:
+                    return self._n
+    """
+    assert check_snippet("guarded-by", clean,
+                         relpath="consul_tpu/catalog/snippet.py") == []
+
+
+def _write_lock_order_fixture(root, invert: bool):
+    pkg = root / "consul_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    # a three-module chain StoreA -> StoreB -> StoreC; `invert` closes
+    # the cycle C -> A (the raft-lock->store-lock inversion class,
+    # spread across modules so only the merged graph can see it)
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        from consul_tpu.locks import make_lock
+
+        class StoreA:
+            def __init__(self):
+                self._lock = make_lock("fx.a")
+
+            def step_a(self, b):
+                with self._lock:
+                    b.step_b()
+    """))
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        from consul_tpu.locks import make_lock
+
+        class StoreB:
+            def __init__(self):
+                self._lock = make_lock("fx.b")
+
+            def step_b(self, c):
+                with self._lock:
+                    c.step_c()
+    """))
+    tail = "a.step_a()" if invert else "pass"
+    (pkg / "c.py").write_text(textwrap.dedent(f"""
+        from consul_tpu.locks import make_lock
+
+        class StoreC:
+            def __init__(self):
+                self._lock = make_lock("fx.c")
+
+            def step_c(self, a):
+                with self._lock:
+                    {tail}
+    """))
+
+
+def test_lock_order_three_module_cycle_fires(tmp_path):
+    """lock-order: a cycle assembled across THREE modules (each edge
+    innocent in isolation) fails at every participating site; the
+    same chain without the closing edge stays silent."""
+    from lint.checkers.lock_discipline import LockOrderChecker
+    _write_lock_order_fixture(tmp_path, invert=True)
+    cache = ModuleCache(str(tmp_path))
+    found = run_checkers(cache, ["consul_tpu"], [LockOrderChecker()])
+    assert found, "three-module inversion not detected"
+    paths = {f.path for f in found}
+    assert paths == {"consul_tpu/a.py", "consul_tpu/b.py",
+                     "consul_tpu/c.py"}
+    assert all("lock-order cycle" in f.message for f in found)
+
+
+def test_lock_order_acyclic_chain_stays_silent(tmp_path):
+    from lint.checkers.lock_discipline import LockOrderChecker
+    _write_lock_order_fixture(tmp_path, invert=False)
+    cache = ModuleCache(str(tmp_path))
+    assert run_checkers(cache, ["consul_tpu"],
+                        [LockOrderChecker()]) == []
+
+
+def test_lock_order_lexical_nesting_and_same_name_skip(tmp_path):
+    """Directly nested withs feed the graph too; two locks sharing a
+    registered name (two instances of one class) do NOT self-cycle —
+    that's the runtime auditor's same_name_nesting bucket."""
+    pkg = tmp_path / "consul_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "m.py").write_text(textwrap.dedent("""
+        from consul_tpu.locks import make_lock
+
+        class P:
+            def __init__(self):
+                self._lock = make_lock("fx.p")
+
+            def ab(self, q):
+                with self._lock:
+                    with q._other_lock:
+                        pass
+
+        class Q:
+            def ba(self, p, q2):
+                with q2._other_lock:
+                    with p._other_lock:
+                        pass
+    """))
+    from lint.checkers.lock_discipline import LockOrderChecker
+    cache = ModuleCache(str(tmp_path))
+    # P.ab: fx.p -> _other_lock (lexical); Q.ba nests _other_lock under
+    # _other_lock — a same-name edge, skipped, so no cycle
+    assert run_checkers(cache, ["consul_tpu"],
+                        [LockOrderChecker()]) == []
+
+
+def test_no_emit_under_lock_fires_and_stays_silent():
+    """no-emit-under-lock: flight emits, telemetry sink calls, sleeps,
+    and non-condition blocking waits inside a critical section fire;
+    the stage-then-flush idiom and condition parking stay silent."""
+    bad = """
+        import time
+        from consul_tpu import flight, telemetry
+
+        class S:
+            def publish(self):
+                with self._lock:
+                    flight.emit("kv.visibility.stall",
+                                labels={"stage": "x"})
+                    telemetry.incr_counter(("rpc", "request"))
+                    time.sleep(0.1)
+                    self._done.wait(1.0)
+    """
+    hits = check_snippet("no-emit-under-lock", bad,
+                         relpath="consul_tpu/catalog/snippet.py")
+    msgs = "\n".join(f.message for f in hits)
+    assert len(hits) == 4
+    assert "stage the event and emit after release" in msgs
+    assert "sink I/O" in msgs
+    assert "time.sleep" in msgs
+    assert "non-condition object" in msgs
+
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        class S:
+            def publish(self):
+                with self._lock:
+                    buf, self._buf = self._buf, []
+                    self._cond.wait(0.5)
+                for row in buf:
+                    telemetry.incr_counter(("rpc", "request"))
+                flight.emit("kv.visibility.stall",
+                            labels={"stage": "x"})
+    """
+    assert check_snippet("no-emit-under-lock", clean,
+                         relpath="consul_tpu/catalog/snippet.py") == []
+
+
+def test_no_emit_under_lock_scoped_to_staging_contract_modules():
+    """The rule binds the store/raft/stream/defense planes; a chaos
+    harness sleeping under its own lock is out of scope."""
+    snippet = """
+        import time
+
+        class H:
+            def inject(self):
+                with self._lock:
+                    time.sleep(0.01)
+    """
+    assert check_snippet("no-emit-under-lock", snippet,
+                         relpath="consul_tpu/chaos.py") == []
+    assert len(check_snippet("no-emit-under-lock", snippet,
+                             relpath="consul_tpu/consensus/x.py")) == 1
+
+
+def test_guarded_by_sees_contextmanager_lock_wrappers():
+    """flight.py's `with self._ring_lock():` idiom: a @contextmanager
+    helper whose body takes the lock counts as holding it."""
+    clean = """
+        import threading
+        from contextlib import contextmanager
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._ring = []         # guarded-by: _lock
+
+            @contextmanager
+            def _ring_lock(self):
+                with self._lock:
+                    yield
+
+            def add(self, x):
+                with self._ring_lock():
+                    self._ring.append(x)
+    """
+    assert check_snippet("guarded-by", clean,
+                         relpath="consul_tpu/catalog/snippet.py") == []
+
+    bad = clean.replace("with self._ring_lock():\n", "if True:\n")
+    assert len(check_snippet("guarded-by", bad,
+                             relpath="consul_tpu/catalog/snippet.py")) == 1
+
+
+def test_lint_timing_flag_and_budget():
+    """--timing prints one wall-time row per checker; the gate total
+    stays inside the tier-1 budget even with the checker family grown
+    to 15 (the lock-discipline plane added three)."""
+    r = subprocess.run([sys.executable, LINT_PY, "--check", "--timing"],
+                       capture_output=True, text=True, timeout=60,
+                       cwd=REPO)
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    rows = {}
+    for line in r.stdout.splitlines():
+        if line.startswith("timing: "):
+            name, secs = line[len("timing: "):].rsplit(None, 1)
+            rows[name.strip()] = float(secs.rstrip("s"))
+    assert set(c.name for c in ALL) <= set(rows)
+    assert "TOTAL" in rows
+    assert rows["TOTAL"] < 15.0, f"lint gate at {rows['TOTAL']:.1f}s"
+    # no single checker may eat the whole budget (the lock-order tree
+    # scan is cached per run; keep it honest)
+    worst = max((v for k, v in rows.items() if k != "TOTAL"),
+                default=0.0)
+    assert worst < 8.0
+
+
+def test_lock_discipline_baseline_is_empty():
+    """ISSUE 14 acceptance: the new checkers land with every real
+    finding FIXED — the committed baseline carries no lock-discipline
+    debt (and stays empty altogether)."""
+    entries = load_baseline(os.path.join(TOOLS, "lint_baseline.json"))
+    assert entries == []

@@ -1,0 +1,73 @@
+"""tools/lock_audit.py gate (ISSUE 14): the audit-mode concurrency
+smoke runs green inside its tier-1 wall budget, proves coverage over
+the converted lock vocabulary, and the full mode emits the committed
+LOCKS_r01.json artifact shape.
+
+Subprocess-driven like the chaos/bench gates: the tool arms
+CONSUL_TPU_LOCK_AUDIT=1 before any consul_tpu lock exists, which must
+not leak into this process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOL = os.path.join(REPO, "tools", "lock_audit.py")
+BUDGET_S = 40.0
+
+
+@pytest.mark.thread_leak_ok(reason="subprocess only; marker exercises "
+                                   "the opt-out path of the hygiene "
+                                   "fixture")
+def test_lock_audit_check_green_within_budget():
+    t0 = time.time()
+    r = subprocess.run([sys.executable, TOOL, "--check"],
+                       capture_output=True, text=True,
+                       timeout=BUDGET_S + 30, cwd=REPO)
+    elapsed = time.time() - t0
+    assert r.returncode == 0, f"stdout={r.stdout}\nstderr={r.stderr}"
+    assert elapsed < BUDGET_S, (f"lock_audit --check took "
+                                f"{elapsed:.1f}s (budget {BUDGET_S}s)")
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["ok"] is True
+    lk = row["locks"]
+    assert lk["cycles"] == 0 and lk["races"] == 0
+    # the conversion's coverage bar: the audit actually exercised the
+    # production lock seam, and the guarded-by registry spans the
+    # annotated subsystems (>= 30 fields per the acceptance criteria)
+    assert lk["tracked"] >= 12
+    assert lk["edges"] >= 4
+    assert lk["guarded_fields"] >= 30
+    # the workout starved nowhere (each subsystem saw real traffic)
+    assert all(n > 0 for n in row["workload"].values())
+
+
+def test_committed_locks_artifact_matches_reality():
+    """LOCKS_r01.json: committed from a real audit soak — cycle-free,
+    race-free, with the contention/hold table over the expected lock
+    names and the store->stream / raft->transport edges observed."""
+    with open(os.path.join(REPO, "LOCKS_r01.json")) as f:
+        art = json.load(f)
+    assert art["suite"] == "lock_audit" and art["ok"] is True
+    rep = art["locks"]
+    assert rep["cycles"] == [] and rep["races"] == []
+    assert rep["guarded_fields"] >= 30
+    names = set(rep["locks"])
+    for expect in ("store.state", "stream.publisher", "raft.node",
+                   "raft.transport", "flight.ring",
+                   "ratelimit.limiter", "submatview.registry",
+                   "visibility.table"):
+        assert expect in names, expect
+    edges = {(e["from"], e["to"]) for e in rep["edges"]}
+    assert ("store.state", "stream.publisher") in edges
+    assert ("raft.node", "raft.transport") in edges
+    # every stats row carries the contention/hold columns the README
+    # documents
+    for row in rep["locks"].values():
+        assert {"acquisitions", "contended", "wait_max_ms",
+                "hold_max_ms"} <= set(row)

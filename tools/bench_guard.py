@@ -336,6 +336,20 @@ def run_check() -> int:
     if not ol["ok"]:
         failures.append("guard judged the soak/ratelimit stamp keys "
                         "instead of tolerating them")
+    # ISSUE 14's lock-audit stamp is metadata too: audit-mode runs
+    # decorate result rows with {"locks": {...}} (graph size, cycle/
+    # race counts, contention table) — a decorated within-threshold
+    # row must be tolerated-not-judged like every other stamp
+    lkrow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                    "locks": {"enabled": True, "edges": 5,
+                              "cycles": 0, "races": 0,
+                              "guarded_fields": 41,
+                              "contended": {"store.state":
+                                            {"wait_max_ms": 3.0}}}}],
+                  fake_base)
+    if not lkrow["ok"]:
+        failures.append("guard judged the locks artifact stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

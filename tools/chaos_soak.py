@@ -85,8 +85,18 @@ def run_check() -> int:
     """Tier-1 smoke: the virtual-time scenario set at small scale with
     a fixed seed, plus a bit-reproducibility double-run, plus the
     BOUNDED LIVE smoke (a real multi-process cluster under kill -9 +
-    restart, consul_tpu/chaos_live.py) under its hard wall budget."""
-    from consul_tpu import chaos
+    restart, consul_tpu/chaos_live.py) under its hard wall budget.
+
+    Runs with the lock-discipline audit armed (ISSUE 14): the nemesis
+    is the race amplifier, so every tracked lock acquired across the
+    scenarios feeds the acquisition-order graph, and an observed cycle
+    or unlocked guarded-field rebind fails the smoke.  The env var is
+    exported so the LIVE smoke's server subprocesses run audited too.
+    Lock events journal only to the default recorder, so the scoped
+    deterministic timelines stay byte-identical."""
+    from consul_tpu import chaos, locks
+    os.environ[locks.AUDIT_ENV] = "1"
+    locks.enable_audit()
     rows = run_suite(chaos.CHECK_SCENARIOS, CHECK_SEED, soak=False)
     failures = [f"{r['scenario']}: {v}" for r in rows if not r["ok"]
                 for v in r["violations"]]
@@ -141,9 +151,11 @@ def run_check() -> int:
         failures += [f"{shed['scenario']}: {v}"
                      for v in shed["violations"]]
         chaos_live.print_violation_tail(shed)
+    failures += locks.check_clean()
     out = {"mode": "check", "seed": CHECK_SEED,
            "scenarios": [r["scenario"] for r in rows]
            + [live["scenario"], shed["scenario"]],
+           "locks": locks.audit_summary(),
            "deterministic": deterministic,
            "timeline_identical": timeline_identical,
            "events_journaled": sum(

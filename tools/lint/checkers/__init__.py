@@ -8,6 +8,9 @@ from lint.checkers.dtype_discipline import DtypeDisciplineChecker
 from lint.checkers.exception_hygiene import ExceptionHygieneChecker
 from lint.checkers.gather_discipline import GatherDisciplineChecker
 from lint.checkers.jit_purity import JitPurityChecker
+from lint.checkers.lock_discipline import (GuardedByChecker,
+                                           LockOrderChecker,
+                                           NoEmitUnderLockChecker)
 from lint.checkers.metric_names import (EventNamesChecker,
                                         MetricNamesChecker)
 from lint.checkers.readplane_discipline import (
@@ -29,6 +32,9 @@ ALL = [
     GatherDisciplineChecker(),
     ReadplaneDisciplineChecker(),
     BoundedQueueChecker(),
+    GuardedByChecker(),
+    LockOrderChecker(),
+    NoEmitUnderLockChecker(),
 ]
 
 BY_NAME = {c.name: c for c in ALL}

@@ -1,0 +1,815 @@
+"""lock-discipline checker family: guarded-by, lock-order,
+no-emit-under-lock — the static half of the race & lock-discipline
+plane (runtime half: consul_tpu/locks.py).
+
+The reference's standing concurrency gates are `go test -race` plus a
+lock-hierarchy convention enforced in review; here the equivalent
+contracts accumulated across PRs 8/10/12/13 as prose ("nothing emits
+under the store lock", "registry lock never held across a snapshot",
+raft's `_metrics_buf` staging).  These checkers turn them structural:
+
+  guarded-by          a field annotated `# guarded-by: <lock>` on its
+                      declaration may only be touched inside a
+                      `with self.<lock>` scope of the owning object
+                      (conditions constructed over the lock count).
+                      Alias-proof for self-aliases (`s = self`), with
+                      an escape pass: a guarded MUTABLE container may
+                      not be returned bare or aliased into a local
+                      that outlives the critical section (ownership-
+                      transfer swaps `old, self.f = self.f, new` are
+                      the sanctioned staging idiom and stay silent).
+                      A helper that runs with the lock already held by
+                      its caller (or with construction-time exclusive
+                      access) declares `# requires-lock: <lock>` on
+                      its def line.
+
+  lock-order          the static lock graph: every lexically nested
+                      `with`-acquire across consul_tpu/ adds an edge
+                      held->acquired, keyed `<Class>.<attr>`; any cycle
+                      fails at every participating site.  Same-name
+                      edges (two instances of one class) are skipped —
+                      the runtime auditor counts those separately.
+                      Lexical nesting only: cross-function acquisition
+                      chains are the runtime auditor's half.
+
+  no-emit-under-lock  inside store/raft/stream/visibility/submatview/
+                      ratelimit/flight critical sections (`with
+                      self.<lock-ish>`), flight emits, telemetry sink
+                      calls, `time.sleep`, and blocking waits on
+                      non-condition objects are violations: stage under
+                      the lock, flush after release (the PR 8/10/13
+                      contract).  `*.cond.wait()` on the held lock's
+                      condition is the sanctioned parking idiom and
+                      stays silent.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from lint.astutil import call_name, canonical_name, dotted, import_aliases
+from lint.core import Checker, Finding, Module
+
+GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+REQUIRES_RE = re.compile(
+    r"#\s*requires-lock:\s*"
+    r"([A-Za-z_][A-Za-z0-9_]*(?:\s*,\s*[A-Za-z_][A-Za-z0-9_]*)*)")
+# an attribute that IS a lock/condition by naming convention — the
+# with-acquire detection both lock-order and no-emit-under-lock share
+LOCKISH_RE = re.compile(r"(lock|cond|cv|mutex)s?$", re.IGNORECASE)
+
+_CONTAINER_CALLS = {"dict", "list", "set", "deque", "OrderedDict",
+                    "defaultdict", "Counter", "PrefixIndex"}
+
+
+def _is_container_expr(node: Optional[ast.AST]) -> bool:
+    """Does this __init__ RHS construct a MUTABLE container?  Drives
+    the escape pass: returning an int bare is fine, returning the live
+    dict is a data race handed to the caller."""
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                         ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        name = (call_name(node) or "").rsplit(".", 1)[-1]
+        return name in _CONTAINER_CALLS
+    return False
+
+
+def _lockish(attr: str) -> bool:
+    return bool(LOCKISH_RE.search(attr))
+
+
+def _walk_no_funcs(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does NOT descend into nested function/lambda
+    bodies — those run later, outside the enclosing critical section,
+    and are analyzed separately."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _stmt_parts(stmt: ast.stmt) -> Tuple[List[List[ast.stmt]],
+                                         List[ast.AST]]:
+    """(statement blocks, header expressions) of one compound or
+    simple statement; except-handlers contribute their bodies as
+    blocks so held-lock tracking survives try/except."""
+    blocks: List[List[ast.stmt]] = []
+    exprs: List[ast.AST] = []
+    for _, val in ast.iter_fields(stmt):
+        if isinstance(val, list) and val and \
+                isinstance(val[0], ast.stmt):
+            blocks.append(val)
+        elif isinstance(val, list) and val and \
+                isinstance(val[0], ast.excepthandler):
+            for h in val:
+                if h.type is not None:
+                    exprs.append(h.type)
+                blocks.append(h.body)
+        elif isinstance(val, ast.AST):
+            exprs.append(val)
+        elif isinstance(val, list):
+            exprs.extend(v for v in val if isinstance(v, ast.AST))
+    return blocks, exprs
+
+
+class _ClassGuards:
+    """Per-class contract parsed from __init__ / class-level assigns:
+    guarded fields, whether each is a mutable container, the
+    condition->owning-lock alias map, and @contextmanager lock-wrapper
+    methods (`with self._ring_lock():` acquires `_lock` — the
+    scoped-lockable analogue)."""
+
+    def __init__(self):
+        self.guards: Dict[str, str] = {}        # field -> lock attr
+        self.container: Dict[str, bool] = {}
+        self.cond_owner: Dict[str, str] = {}    # cond attr -> lock attr
+        self.cm_owner: Dict[str, str] = {}      # cm method -> lock attr
+
+
+def _self_attr(node: ast.AST, aliases: Set[str]) -> Optional[str]:
+    """`self.X` (or alias `s.X`) -> X, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id in aliases:
+        return node.attr
+    return None
+
+
+def _parse_class(cls: ast.ClassDef, module: Module) -> _ClassGuards:
+    info = _ClassGuards()
+    # declarations live in __init__ by convention, but re-init helpers
+    # (RateLimiter.configure) declare under the lock too — an
+    # annotated `self.X = ...` counts wherever it appears in the class
+    bodies: List[List[ast.stmt]] = [cls.body]
+    for stmt in cls.body:
+        if isinstance(stmt, ast.FunctionDef):
+            bodies.append(stmt.body)
+    for body in bodies:
+        for stmt in ast.walk(ast.Module(body=body, type_ignores=[])):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            for t in targets:
+                attr = _self_attr(t, {"self"})
+                if attr is None and isinstance(t, ast.Name):
+                    attr = t.id          # class-level declaration
+                if attr is None:
+                    continue
+                # condition aliasing: Condition(self.L) /
+                # make_condition(self.L) binds the cond to L's scope
+                if isinstance(value, ast.Call):
+                    fn = (call_name(value) or "").rsplit(".", 1)[-1]
+                    if fn in ("Condition", "make_condition") \
+                            and value.args:
+                        owner = _self_attr(value.args[0], {"self"})
+                        if owner is not None:
+                            info.cond_owner[attr] = owner
+                line = module.line(stmt.lineno)
+                m = GUARD_RE.search(line) or \
+                    GUARD_RE.search(module.line(stmt.lineno - 1).strip()
+                                    if module.line(
+                                        stmt.lineno - 1).strip()
+                                    .startswith("#") else "")
+                if m:
+                    info.guards[attr] = m.group(1)
+                    info.container[attr] = _is_container_expr(value)
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not any((dotted(d) or "").endswith("contextmanager")
+                   for d in fn.decorator_list):
+            continue
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    attr = _self_attr(item.context_expr, {"self"})
+                    if attr is not None and _lockish(attr):
+                        info.cm_owner[fn.name] = \
+                            info.cond_owner.get(attr, attr)
+                        break
+    return info
+
+
+def _requires(module: Module, fn: ast.FunctionDef) -> Set[str]:
+    for lineno in (fn.lineno, fn.lineno - 1):
+        m = REQUIRES_RE.search(module.line(lineno))
+        if m:
+            return {s.strip() for s in m.group(1).split(",")}
+    return set()
+
+
+def _with_tokens(item: ast.withitem, aliases: Set[str],
+                 info: "_ClassGuards") -> Optional[str]:
+    """The lock attr a with-item acquires, resolved through the
+    condition alias map and the contextmanager wrapper map; None for
+    non-lock contexts."""
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        attr = _self_attr(expr.func, aliases)
+        if attr is not None:
+            return info.cm_owner.get(attr)
+        return None
+    attr = _self_attr(expr, aliases)
+    if attr is None:
+        return None
+    return info.cond_owner.get(attr, attr)
+
+
+# ===================================================================
+# guarded-by
+# ===================================================================
+
+
+class GuardedByChecker(Checker):
+    name = "guarded-by"
+    description = ("fields annotated `# guarded-by: <lock>` may only "
+                   "be touched inside `with self.<lock>` (alias-proof, "
+                   "with container escape analysis); helpers declare "
+                   "`# requires-lock: <lock>`")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith("consul_tpu/"):
+            return
+        if "guarded-by" not in module.source:
+            return
+        for cls in ast.walk(module.tree):
+            if isinstance(cls, ast.ClassDef):
+                info = _parse_class(cls, module)
+                if info.guards:
+                    yield from self._check_class(module, cls, info)
+
+    def _check_class(self, module: Module, cls: ast.ClassDef,
+                     info: _ClassGuards) -> Iterator[Finding]:
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) or \
+                    fn.name == "__init__":
+                continue
+            # held: lock attr -> end line of the with-block providing
+            # it (None = held for the whole function via requires-lock,
+            # where nothing can "escape" the critical section)
+            held0 = {lock: None for lock in _requires(module, fn)}
+            self._escapes: List[Tuple[str, int, ast.AST]] = []
+            yield from self._visit(module, info, fn.body,
+                                   aliases={"self"}, held=dict(held0))
+            # alias-escape second pass: a local bound to a guarded
+            # container inside the critical section, read after it
+            for name, end_line, alias_node in self._escapes:
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Name) and node.id == name \
+                            and isinstance(node.ctx, ast.Load) \
+                            and node.lineno > end_line:
+                        yield module.finding(
+                            self.name, alias_node,
+                            f"guarded container aliased into "
+                            f"{name!r} escapes the critical section "
+                            f"(used at line {node.lineno}) — copy it, "
+                            f"or transfer ownership with "
+                            f"`{name}, self.X = self.X, <fresh>`")
+                        break
+
+    def _visit(self, module: Module, info: _ClassGuards,
+               stmts: List[ast.stmt], aliases: Set[str],
+               held: Dict[str, Optional[int]]) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                end = max((n.lineno for n in ast.walk(stmt)
+                           if hasattr(n, "lineno")),
+                          default=stmt.lineno)
+                inner_held = dict(held)
+                for item in stmt.items:
+                    tok = _with_tokens(item, aliases, info)
+                    if tok is not None:
+                        inner_held[tok] = end
+                yield from self._scan_exprs(
+                    module, info, [i.context_expr for i in stmt.items],
+                    aliases, held)
+                yield from self._visit(module, info, stmt.body,
+                                       aliases, inner_held)
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested function runs later, when the lock may not
+                # be held: its body is checked lock-free (it may carry
+                # its own requires-lock annotation)
+                inner = {lock: None
+                         for lock in _requires(module, stmt)}
+                yield from self._visit(module, info, stmt.body,
+                                       {"self"}, inner)
+                continue
+            # self aliasing (`s = self`) and guarded-container aliasing
+            if isinstance(stmt, ast.Assign):
+                if isinstance(stmt.value, ast.Name) \
+                        and stmt.value.id in aliases:
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+                self._note_aliases(info, stmt, aliases, held)
+            if isinstance(stmt, ast.Return) and held:
+                yield from self._check_return(module, info, stmt,
+                                              aliases, held)
+            # generic expression scan of this statement (headers of
+            # compound statements included), then recurse into blocks
+            blocks, exprs = _stmt_parts(stmt)
+            yield from self._scan_exprs(module, info, exprs, aliases,
+                                        held)
+            for block in blocks:
+                yield from self._visit(module, info, block, aliases,
+                                       held)
+
+    def _scan_exprs(self, module: Module, info: _ClassGuards,
+                    exprs: List[ast.AST], aliases: Set[str],
+                    held: Dict[str, Optional[int]]) -> Iterator[Finding]:
+        for expr in exprs:
+            for node in _walk_no_funcs(expr):
+                attr = _self_attr(node, aliases)
+                if attr is None or attr not in info.guards:
+                    continue
+                lock = info.guards[attr]
+                if lock not in held:
+                    yield module.finding(
+                        self.name, node,
+                        f"field {attr!r} is guarded-by {lock!r} but "
+                        f"accessed outside `with self.{lock}` — "
+                        f"acquire the lock, or mark the helper "
+                        f"`# requires-lock: {lock}` if the caller "
+                        f"holds it")
+
+    def _note_aliases(self, info: _ClassGuards, stmt: ast.Assign,
+                      aliases: Set[str],
+                      held: Dict[str, Optional[int]]) -> None:
+        if not held:
+            return
+        attr = _self_attr(stmt.value, aliases)
+        if attr is None or attr not in info.guards or \
+                not info.container.get(attr) or \
+                info.guards[attr] not in held:
+            return
+        with_end = held[info.guards[attr]]
+        if with_end is None:
+            return      # whole-function hold: nothing escapes it
+        # ownership transfer: the SAME statement rebinds the field
+        # (`buf, self._buf = self._buf, []`) — the sanctioned staging
+        # swap; the local owns the old container exclusively
+        for t in stmt.targets:
+            for sub in ast.walk(t):
+                if _self_attr(sub, aliases) == attr:
+                    return
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                self._escapes.append((t.id, with_end, stmt.value))
+
+    def _check_return(self, module: Module, info: _ClassGuards,
+                      stmt: ast.Return, aliases: Set[str],
+                      held: Dict[str, Optional[int]]
+                      ) -> Iterator[Finding]:
+        candidates = [stmt.value]
+        if isinstance(stmt.value, ast.Tuple):
+            candidates = list(stmt.value.elts)
+        for cand in candidates:
+            attr = _self_attr(cand, aliases) if cand is not None \
+                else None
+            if attr is not None and attr in info.guards and \
+                    info.container.get(attr) and \
+                    held.get(info.guards[attr], 0) is not None:
+                yield module.finding(
+                    self.name, cand,
+                    f"guarded container {attr!r} returned bare out of "
+                    f"the critical section — the caller would mutate/"
+                    f"iterate it unlocked; return a copy "
+                    f"(dict(...)/list(...))")
+
+
+# ===================================================================
+# lock-order
+# ===================================================================
+
+
+Edge = Tuple[str, str]
+
+_MAKE_LOCK_FNS = {"make_lock", "make_rlock"}
+
+
+def collect_lock_names(tree: ast.AST) -> Dict[Tuple[str, str], str]:
+    """{(ClassName, attr): registered runtime lock name} from
+    `self.<attr> = locks.make_lock("<name>")` assignments (and
+    make_rlock / make_condition), resolving conditions constructed
+    over a named lock (`Condition(self._lock)`) to the lock's name.
+    This is what lets the graph identify ONE lock across every module
+    that nests on it, instead of merging every `_lock` attr."""
+    names: Dict[Tuple[str, str], str] = {}
+    aliases: Dict[Tuple[str, str], str] = {}    # (cls, cond) -> lock attr
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            fn = (call_name(value) or "").rsplit(".", 1)[-1]
+            for t in targets:
+                attr = _self_attr(t, {"self"})
+                if attr is None:
+                    continue
+                if fn in _MAKE_LOCK_FNS and value.args and \
+                        isinstance(value.args[0], ast.Constant) and \
+                        isinstance(value.args[0].value, str):
+                    names[(cls.name, attr)] = value.args[0].value
+                elif fn in ("Condition", "make_condition"):
+                    kw = next((k.value for k in value.keywords
+                               if k.arg == "name"), None)
+                    if isinstance(kw, ast.Constant) and \
+                            isinstance(kw.value, str):
+                        names[(cls.name, attr)] = kw.value
+                    elif value.args:
+                        owner = _self_attr(value.args[0], {"self"})
+                        if owner is not None:
+                            aliases[(cls.name, attr)] = owner
+    for (cname, attr), owner in aliases.items():
+        if (cname, owner) in names:
+            names[(cname, attr)] = names[(cname, owner)]
+    # @contextmanager lock wrappers: `with self._ring_lock():` keys to
+    # the lock the wrapper's body acquires
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for fn in cls.body:
+            if not isinstance(fn, ast.FunctionDef) or not any(
+                    (dotted(d) or "").endswith("contextmanager")
+                    for d in fn.decorator_list):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        attr = _self_attr(item.context_expr, {"self"})
+                        if attr is not None and _lockish(attr):
+                            names[(cls.name, fn.name)] = names.get(
+                                (cls.name, attr),
+                                f"{cls.name}.{attr}")
+                            break
+    return names
+
+
+# method names too generic to resolve across objects: a call `x.get()`
+# is overwhelmingly a dict, not ViewStore.get — resolving it would
+# attribute the registry lock to every cache lookup in the tree
+_COMMON_METHODS = frozenset({
+    "get", "set", "pop", "add", "remove", "discard", "append",
+    "extend", "update", "clear", "copy", "items", "keys", "values",
+    "read", "write", "open", "close", "send", "recv", "join", "wait",
+    "notify", "notify_all", "acquire", "release", "start", "stop",
+    "run", "put", "emit", "load", "save", "flush", "reset", "next",
+})
+
+
+class _MethodScan:
+    """Per-method summary: locks acquired lexically, every call made,
+    and the calls made while a lock is held (with the held key and
+    site) — the inputs to the cross-module transitive graph."""
+
+    __slots__ = ("lex_locks", "calls", "held_calls", "relpath")
+
+    def __init__(self, relpath: str):
+        self.lex_locks: Set[str] = set()
+        self.calls: List[Tuple[str, str]] = []      # (kind, name)
+        self.held_calls: List[Tuple[str, Tuple[str, str], int]] = []
+        self.relpath = relpath
+
+
+def scan_module(tree: ast.AST, relpath: str,
+                names: Dict[Tuple[str, str], str]
+                ) -> Tuple[Dict[Edge, List[Tuple[str, int]]],
+                           Dict[Tuple[str, str], _MethodScan]]:
+    """(lexical nested-with edges, per-(class, method) summaries) for
+    one module.  Node keys, most to least precise: the registered
+    `make_lock` name; `<Class>.<attr>` for self-attrs of classes
+    without one; the bare attribute name for non-self expressions."""
+    edges: Dict[Edge, List[Tuple[str, int]]] = {}
+    methods: Dict[Tuple[str, str], _MethodScan] = {}
+
+    def key_for(item: ast.withitem, cls: Optional[str]) -> Optional[str]:
+        expr = item.context_expr
+        name = dotted(expr.func) if isinstance(expr, ast.Call) \
+            else dotted(expr)
+        if name is None or "." not in name:
+            return None
+        base, attr = name.rsplit(".", 1)
+        if not _lockish(attr):
+            return None
+        if base == "self" and cls:
+            return names.get((cls, attr), f"{cls}.{attr}")
+        return attr
+
+    def note_call(node: ast.Call, scan: Optional[_MethodScan],
+                  stack: List[str]):
+        if scan is None:
+            return
+        name = dotted(node.func)
+        if name is None or "." not in name:
+            return
+        base, meth = name.rsplit(".", 1)
+        ref = ("self", meth) if base == "self" else ("other", meth)
+        scan.calls.append(ref)
+        if stack:
+            scan.held_calls.append((stack[-1], ref, node.lineno))
+
+    def walk(node: ast.AST, cls: Optional[str],
+             scan: Optional[_MethodScan], stack: List[str]):
+        if isinstance(node, ast.ClassDef):
+            cls = node.name
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if cls is not None:
+                scan = methods.setdefault((cls, node.name),
+                                          _MethodScan(relpath))
+                stack = []
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = [(key_for(i, cls), i.context_expr.lineno)
+                        for i in node.items]
+            acquired = [(k, ln) for k, ln in acquired if k is not None]
+            for k, ln in acquired:
+                if scan is not None:
+                    scan.lex_locks.add(k)
+                for h in stack:
+                    if h != k:
+                        edges.setdefault((h, k), []).append(
+                            (relpath, ln))
+            inner = stack + [k for k, _ in acquired]
+            for child in ast.iter_child_nodes(node):
+                walk(child, cls, scan, inner)
+            return
+        elif isinstance(node, ast.Call):
+            note_call(node, scan, stack)
+        for child in ast.iter_child_nodes(node):
+            walk(child, cls, scan, stack)
+
+    walk(tree, None, None, [])
+    return edges, methods
+
+
+def call_graph_edges(methods: Dict[Tuple[str, str], _MethodScan]
+                     ) -> Dict[Edge, List[Tuple[str, int]]]:
+    """Edges from calls made while holding a lock into everything the
+    callee may acquire, transitively (fixpoint over the method call
+    graph).  `self.m()` resolves within the class; `x.m()` resolves
+    only when `m` is defined in exactly one scanned class and is not
+    a generic container-method name."""
+    by_name: Dict[str, List[Tuple[str, str]]] = {}
+    for (cname, meth) in methods:
+        by_name.setdefault(meth, []).append((cname, meth))
+
+    def resolve(cls: str, ref: Tuple[str, str]
+                ) -> Optional[Tuple[str, str]]:
+        kind, meth = ref
+        if kind == "self":
+            if (cls, meth) in methods:
+                return (cls, meth)
+            return None
+        if meth in _COMMON_METHODS:
+            return None
+        cands = by_name.get(meth, ())
+        return cands[0] if len(cands) == 1 else None
+
+    # ACQ fixpoint: every lock a method may acquire through any call
+    acq: Dict[Tuple[str, str], Set[str]] = {
+        k: set(m.lex_locks) for k, m in methods.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, m in methods.items():
+            mine = acq[key]
+            before = len(mine)
+            for ref in m.calls:
+                target = resolve(key[0], ref)
+                if target is not None:
+                    mine |= acq[target]
+            if len(mine) != before:
+                changed = True
+    edges: Dict[Edge, List[Tuple[str, int]]] = {}
+    for key, m in methods.items():
+        for held, ref, line in m.held_calls:
+            target = resolve(key[0], ref)
+            if target is None:
+                continue
+            for k in acq[target]:
+                if k != held:
+                    edges.setdefault((held, k), []).append(
+                        (m.relpath, line))
+    return edges
+
+
+def build_edges(tree: ast.AST, relpath: str,
+                names: Optional[Dict[Tuple[str, str], str]] = None
+                ) -> Dict[Edge, List[Tuple[str, int]]]:
+    """Full lock-order edge set for one module analyzed alone:
+    lexical nesting plus the call-graph expansion (tests; the checker
+    merges summaries across the whole tree instead)."""
+    lex, methods = scan_module(tree, relpath, names or {})
+    for edge, sites in call_graph_edges(methods).items():
+        lex.setdefault(edge, []).extend(sites)
+    return lex
+
+
+def find_cyclic_edges(edges: Dict[Edge, List[Tuple[str, int]]]
+                      ) -> Dict[Edge, List[str]]:
+    """{edge: cycle path} for every edge (a, b) where b reaches a."""
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    out: Dict[Edge, List[str]] = {}
+    for a, b in edges:
+        stack = [(b, [b])]
+        seen = {b}
+        while stack:
+            node, path = stack.pop()
+            if node == a:
+                out[(a, b)] = path
+                break
+            for nxt in graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+    return out
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = ("the static lock graph over nested with-acquire "
+                   "sites across consul_tpu/ must be cycle-free (the "
+                   "raft-lock->store-lock inversion class)")
+
+    def __init__(self):
+        # per repo root: (mtime signature, findings by relpath)
+        self._cache: Dict[str, tuple] = {}
+
+    def _root(self, module: Module) -> Optional[str]:
+        rel = module.relpath.replace("/", os.sep)
+        if module.path.endswith(rel):
+            return module.path[:-len(rel)] or "."
+        return None
+
+    def _tree_findings(self, root: str) -> Dict[str, List[tuple]]:
+        pkg = os.path.join(root, "consul_tpu")
+        files: List[Tuple[str, str]] = []
+        for dirpath, dirnames, filenames in os.walk(pkg):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ("__pycache__",))
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    path = os.path.join(dirpath, f)
+                    files.append((path, os.path.relpath(path, root)
+                                  .replace(os.sep, "/")))
+        sig = tuple((p, os.path.getmtime(p)) for p, _ in files)
+        cached = self._cache.get(root)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        trees: List[Tuple[ast.AST, str]] = []
+        names: Dict[Tuple[str, str], str] = {}
+        for path, rel in files:
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, SyntaxError):
+                continue
+            trees.append((tree, rel))
+            names.update(collect_lock_names(tree))
+        all_edges: Dict[Edge, List[Tuple[str, int]]] = {}
+        methods: Dict[Tuple[str, str], _MethodScan] = {}
+        for tree, rel in trees:
+            lex, mods = scan_module(tree, rel, names)
+            for edge, sites in lex.items():
+                all_edges.setdefault(edge, []).extend(sites)
+            methods.update(mods)
+        for edge, sites in call_graph_edges(methods).items():
+            all_edges.setdefault(edge, []).extend(sites)
+        cyclic = find_cyclic_edges(all_edges)
+        findings: Dict[str, List[tuple]] = {}
+        for (a, b), path_back in sorted(cyclic.items()):
+            for rel, line in all_edges[(a, b)]:
+                findings.setdefault(rel, []).append(
+                    (line,
+                     f"lock-order cycle: {b!r} acquired here while "
+                     f"{a!r} is held, but elsewhere the graph runs "
+                     f"{'->'.join(path_back)} — pick one global "
+                     f"order and stage the other side"))
+        self._cache = {root: (sig, findings)}
+        return findings
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        if not module.relpath.startswith("consul_tpu/"):
+            return
+        root = self._root(module)
+        if root is None:
+            return
+        for line, msg in self._tree_findings(root).get(
+                module.relpath, []):
+            yield module.finding(self.name, line, msg)
+
+
+# ===================================================================
+# no-emit-under-lock
+# ===================================================================
+
+
+# the modules whose critical sections carry the staging contract: the
+# write path (store/raft), the fan-out path (publisher/visibility/
+# submatview), the defense plane, and the recorder itself
+SCOPE_PREFIXES = ("consul_tpu/catalog/", "consul_tpu/consensus/",
+                  "consul_tpu/stream/")
+SCOPE_FILES = ("consul_tpu/visibility.py", "consul_tpu/submatview.py",
+               "consul_tpu/ratelimit.py", "consul_tpu/flight.py")
+
+_TELEMETRY_FNS = {"incr_counter", "set_gauge", "add_sample",
+                  "measure_since"}
+_CONDISH_RE = re.compile(r"(cond|cv)s?$", re.IGNORECASE)
+
+
+class NoEmitUnderLockChecker(Checker):
+    name = "no-emit-under-lock"
+    description = ("no flight emit / telemetry sink call / sleep / "
+                   "non-condition blocking wait inside store/raft/"
+                   "stream/visibility/submatview/ratelimit/flight "
+                   "critical sections — stage under the lock, flush "
+                   "after release")
+
+    def run(self, module: Module) -> Iterator[Finding]:
+        rel = module.relpath
+        if not (rel.startswith(SCOPE_PREFIXES) or rel in SCOPE_FILES):
+            return
+        aliases = import_aliases(module.tree)
+        yield from self._visit(module, module.tree.body, aliases,
+                               depth=0)
+
+    def _visit(self, module: Module, stmts: List[ast.stmt],
+               aliases: dict, depth: int) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = 0
+                for item in stmt.items:
+                    expr = item.context_expr
+                    name = dotted(expr.func) \
+                        if isinstance(expr, ast.Call) else dotted(expr)
+                    if name is not None and "." in name and \
+                            _lockish(name.rsplit(".", 1)[1]):
+                        acquired += 1
+                yield from self._visit(module, stmt.body, aliases,
+                                       depth + (1 if acquired else 0))
+                continue
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested def's body runs outside this critical
+                # section (it is *called* later)
+                yield from self._visit(module, stmt.body, aliases, 0)
+                continue
+            blocks, exprs = _stmt_parts(stmt)
+            if depth > 0:
+                for expr in exprs:
+                    yield from self._scan(module, expr, aliases)
+            for block in blocks:
+                yield from self._visit(module, block, aliases, depth)
+
+    def _scan(self, module: Module, expr: ast.AST,
+              aliases: dict) -> Iterator[Finding]:
+        for node in _walk_no_funcs(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            raw = call_name(node) or ""
+            name = canonical_name(raw, aliases)
+            tail = name.rsplit(".", 1)[-1]
+            if tail == "emit" and name != "emit" or name == "emit":
+                yield module.finding(
+                    self.name, node,
+                    f"{raw}() inside a critical section — the flight "
+                    f"ring and its log fan-out must never run under a "
+                    f"store/raft/stream lock; stage the event and "
+                    f"emit after release (raft's _metrics_buf idiom)")
+            elif tail in _TELEMETRY_FNS:
+                yield module.finding(
+                    self.name, node,
+                    f"{raw}() inside a critical section — sink I/O "
+                    f"(UDP sendto per configured sink) would "
+                    f"serialize this lock behind syscalls; stage and "
+                    f"flush after release")
+            elif name == "time.sleep":
+                yield module.finding(
+                    self.name, node,
+                    "time.sleep() while holding a lock — every other "
+                    "thread queues behind the nap")
+            elif tail == "wait" and "." in name:
+                base_attr = name.rsplit(".", 2)[-2]
+                if not _CONDISH_RE.search(base_attr):
+                    yield module.finding(
+                        self.name, node,
+                        f"blocking {raw}() under a lock on a non-"
+                        f"condition object — a condition wait "
+                        f"releases the lock while parked, this does "
+                        f"not; park outside the critical section")

@@ -55,6 +55,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "written reason before --check accepts it)")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print findings the baseline covers")
+    p.add_argument("--timing", action="store_true",
+                   help="print per-checker wall time (the budget "
+                        "surface test_lint asserts against)")
     return p
 
 
@@ -79,8 +82,13 @@ def main(argv: List[str] = None) -> int:
     roots = args.paths or DEFAULT_ROOTS
     t0 = time.perf_counter()
     cache = ModuleCache(args.repo_root)
-    findings = run_checkers(cache, roots, active)
+    timings = {} if args.timing else None
+    findings = run_checkers(cache, roots, active, timings=timings)
     elapsed = time.perf_counter() - t0
+    if timings is not None:
+        for name in sorted(timings, key=timings.get, reverse=True):
+            print(f"timing: {name:22s} {timings[name]:8.3f}s")
+        print(f"timing: {'TOTAL':22s} {elapsed:8.3f}s")
 
     try:
         baseline = load_baseline(

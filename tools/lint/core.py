@@ -164,10 +164,16 @@ class ModuleCache:
 
 
 def run_checkers(cache: ModuleCache, roots: Iterable[str],
-                 checkers: Iterable[Checker]) -> List[Finding]:
+                 checkers: Iterable[Checker],
+                 timings: Optional[Dict[str, float]] = None
+                 ) -> List[Finding]:
     """All non-suppressed findings over `roots`, sorted for stable
     output.  A file that fails to parse yields one `parse-error`
-    finding instead of crashing the driver."""
+    finding instead of crashing the driver.  Pass a dict as `timings`
+    to accumulate per-checker wall seconds (the --timing budget
+    surface: the checker count keeps growing, the tier-1 gate's 15 s
+    budget does not)."""
+    import time as _time
     checkers = list(checkers)
     findings: List[Finding] = []
     for mod in cache.walk(roots):
@@ -178,9 +184,13 @@ def run_checkers(cache: ModuleCache, roots: Iterable[str],
                 f"file does not parse: {mod.parse_error.msg}"))
             continue
         for checker in checkers:
+            t0 = _time.perf_counter()
             for f in checker.run(mod):
                 if not mod.suppressed(f.line, checker.name):
                     findings.append(f)
+            if timings is not None:
+                timings[checker.name] = timings.get(
+                    checker.name, 0.0) + _time.perf_counter() - t0
     findings.sort(key=Finding.sort_key)
     return findings
 

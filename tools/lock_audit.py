@@ -1,0 +1,297 @@
+"""Lock-discipline audit driver (ISSUE 14): a bounded audit-mode
+concurrency smoke over the real production lock seam, emitting the
+lock-graph artifact.
+
+    python tools/lock_audit.py                 # full workout -> LOCKS_r01.json
+    python tools/lock_audit.py --seconds 30
+    python tools/lock_audit.py --check         # tier-1 smoke: short
+                                               # workout, no artifact,
+                                               # hard 40 s wall budget
+
+What it runs, all under `CONSUL_TPU_LOCK_AUDIT=1` (every lock created
+through consul_tpu/locks.py becomes a TrackedLock):
+
+  * a 3-node raft cluster on the in-memory transport — one tick
+    thread, one apply (writer) thread, and a nemesis thread cycling
+    partition/heal/isolate faults (the race amplifier: elections,
+    term churn, pending-waiter failure all interleave with applies);
+  * a StateStore under concurrent kv writers, fine-grained blocking
+    queries (`wait_on`), and stream subscribers draining the
+    publisher — the store->publisher->subscriber lock chain;
+  * a shared ViewStore with concurrent single-flight `get`s and
+    blocking `fetch`es over live writes — the registry-lock-never-
+    held-across-a-snapshot contract;
+  * RateLimiter / ApplyGate checks from many client threads —
+    the bounded client table under churn.
+
+Afterwards it asserts the audit observed NO lock-order cycles and NO
+unlocked guarded-field rebinds, that coverage reached the expected
+lock vocabulary, and writes the acquisition-order graph + contention/
+hold-time table as LOCKS_r01.json.  Host-side only — no jax import,
+so the smoke stays far inside its tier-1 budget.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+# audit mode must be on BEFORE consul_tpu modules construct their
+# module-level locks (flight's default recorder ring)
+os.environ.setdefault("CONSUL_TPU_LOCK_AUDIT", "1")
+
+ARTIFACT = os.path.join(REPO, "LOCKS_r01.json")
+CHECK_BUDGET_S = 40.0
+
+# every subsystem the conversion touched must appear in the observed
+# stats table — a workout that misses one proves nothing about it
+EXPECTED_LOCKS = (
+    "raft.node", "raft.transport", "store.state", "stream.publisher",
+    "stream.publisher.stats", "stream.sub", "submatview.registry",
+    "submatview.view", "ratelimit.limiter", "ratelimit.applygate",
+    "visibility.table", "flight.ring",
+)
+
+
+def run_workout(seconds: float, seed: int) -> dict:
+    from consul_tpu import locks, ratelimit, submatview
+    from consul_tpu.catalog.store import StateStore
+    from consul_tpu.consensus.raft import (InMemTransport, LEADER,
+                                           NotLeaderError, RaftConfig,
+                                           RaftNode)
+
+    locks.enable_audit()
+    stop = threading.Event()
+    errors: list = []
+    counts = {"applies": 0, "kv_writes": 0, "kv_waits": 0,
+              "stream_batches": 0, "view_fetches": 0,
+              "ratelimit_checks": 0, "nemesis_faults": 0}
+    cmu = threading.Lock()
+
+    def bump(key, n=1):
+        with cmu:
+            counts[key] += n
+
+    def guarded(fn):
+        def run():
+            try:
+                fn()
+            except Exception as e:      # pragma: no cover - surfaced in report
+                errors.append(f"{fn.__name__}: {type(e).__name__}: {e}")
+        return run
+
+    # ---------------------------------------------------- raft + nemesis
+    transport = InMemTransport(seed=seed)
+    ids = ["s0", "s1", "s2"]
+    applied = {i: [] for i in ids}
+    nodes = {}
+    for i in ids:
+        node = RaftNode(
+            i, ids, transport,
+            apply_fn=(lambda cmd, _i=i: applied[_i].append(cmd)),
+            snapshot_fn=(lambda _i=i: list(applied[_i])),
+            restore_fn=(lambda data, _i=i: applied.__setitem__(
+                _i, list(data))),
+            config=RaftConfig(snapshot_threshold=64,
+                              snapshot_trailing=8),
+            seed=seed)
+        transport.register(node)
+        nodes[i] = node
+    now = [0.0]
+
+    def tick_loop():
+        while not stop.is_set():
+            now[0] += 0.01
+            for n in nodes.values():
+                n.tick(now[0])
+            transport.advance(now[0])
+            time.sleep(0.001)
+
+    def raft_writer():
+        k = 0
+        while not stop.is_set():
+            lead = next((n for n in nodes.values()
+                         if n.state == LEADER), None)
+            if lead is None:
+                time.sleep(0.01)
+                continue
+            try:
+                lead.apply(f"cmd{k}")
+                bump("applies")
+            except NotLeaderError:
+                pass
+            k += 1
+            time.sleep(0.002)
+
+    def nemesis():
+        rng = random.Random(seed)
+        while not stop.is_set():
+            a, b = rng.sample(ids, 2)
+            transport.partition(a, b)
+            bump("nemesis_faults")
+            time.sleep(0.05)
+            transport.heal(a, b)
+            if rng.random() < 0.3:
+                v = rng.choice(ids)
+                transport.isolate(v)
+                time.sleep(0.05)
+                transport.heal()
+            time.sleep(0.02)
+
+    # ------------------------------------------- store + stream + views
+    store = StateStore()
+    views = submatview.ViewStore(store.publisher, idle_ttl=0.5)
+
+    def kv_writer(wid: int):
+        k = 0
+        while not stop.is_set():
+            store.kv_set(f"w{wid}/k{k % 16}", b"v%d" % k)
+            bump("kv_writes")
+            k += 1
+            time.sleep(0.001)
+
+    def kv_watcher(wid: int):
+        idx = 0
+        while not stop.is_set():
+            idx = store.wait_on([("kv:prefix", f"w{wid % 2}/")], idx,
+                                timeout=0.2)
+            bump("kv_waits")
+
+    def stream_reader():
+        sub = store.publisher.subscribe("kv", None, since_index=None)
+        try:
+            while not stop.is_set():
+                try:
+                    if sub.events(timeout=0.1):
+                        bump("stream_batches")
+                except Exception:
+                    sub = store.publisher.subscribe("kv", None,
+                                                    since_index=None)
+        finally:
+            sub.close()
+
+    def view_fetcher(vid: int):
+        key = f"w0/k{vid % 4}"
+        while not stop.is_set():
+            m = views.get("kv", key,
+                          lambda k=key: (store.kv_get(k),
+                                         store.index))
+            m.fetch(0, timeout=0.05)
+            bump("view_fetches")
+            time.sleep(0.002)
+
+    # --------------------------------------------------- defense plane
+    limiter = ratelimit.RateLimiter(mode="enforcing", read_rate=500.0,
+                                    write_rate=200.0)
+    gate = ratelimit.ApplyGate(max_pending=64)
+
+    def limit_client(cid: int):
+        rng = random.Random(cid)
+        while not stop.is_set():
+            rc = "read" if rng.random() < 0.7 else "write"
+            limiter.check(f"client{cid % 8}", rc)
+            gate.observe_commit(rng.uniform(0.001, 0.05))
+            try:
+                gate.admit(rng.randrange(80), 1, rng.uniform(0.01, 1.0))
+            except ratelimit.ApplyRejectedError:
+                pass
+            bump("ratelimit_checks")
+            time.sleep(0.001)
+
+    workers = ([tick_loop, raft_writer, nemesis, stream_reader]
+               + [lambda w=w: kv_writer(w) for w in range(2)]
+               + [lambda w=w: kv_watcher(w) for w in range(2)]
+               + [lambda v=v: view_fetcher(v) for v in range(3)]
+               + [lambda c=c: limit_client(c) for c in range(2)])
+    threads = [threading.Thread(target=guarded(fn), daemon=True)
+               for fn in workers]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(5.0)
+    views.close()
+    store.publisher.close_all()
+    wall = time.time() - t0
+
+    report = locks.audit_report()
+    failures = list(locks.check_clean())
+    failures += errors
+    live = [t for t in threads if t.is_alive()]
+    if live:
+        failures.append(f"{len(live)} workout thread(s) failed to "
+                        f"join (wedged on a lock?)")
+    seen = set(report.get("locks", ()))
+    missing = [n for n in EXPECTED_LOCKS if n not in seen]
+    if missing:
+        failures.append(f"audit coverage gap — locks never acquired: "
+                        f"{missing}")
+    for key in ("applies", "kv_writes", "kv_waits", "view_fetches",
+                "ratelimit_checks"):
+        if counts[key] == 0:
+            failures.append(f"workout starved: zero {key}")
+    return {
+        "suite": "lock_audit",
+        "seed": seed,
+        "seconds": seconds,
+        "wall_s": round(wall, 2),
+        "date": time.strftime("%Y-%m-%d"),
+        "workload": counts,
+        "threads": len(threads),
+        "ok": not failures,
+        "failures": failures,
+        "locks": report,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seconds", type=float, default=15.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--check", action="store_true",
+                    help="tier-1 smoke: short workout, no artifact, "
+                         f"{CHECK_BUDGET_S:.0f}s wall budget")
+    ap.add_argument("--out", default=ARTIFACT)
+    args = ap.parse_args()
+    t0 = time.time()
+    row = run_workout(2.5 if args.check else args.seconds, args.seed)
+    if args.check:
+        wall = time.time() - t0
+        if wall > CHECK_BUDGET_S:
+            row["ok"] = False
+            row["failures"].append(
+                f"lock_audit --check overran its wall budget: "
+                f"{wall:.1f}s > {CHECK_BUDGET_S}s")
+        summary = {k: row[k] for k in ("suite", "ok", "wall_s",
+                                       "workload", "failures")}
+        summary["locks"] = {
+            "tracked": len(row["locks"].get("locks", {})),
+            "edges": len(row["locks"].get("edges", [])),
+            "cycles": len(row["locks"].get("cycles", [])),
+            "races": len(row["locks"].get("races", [])),
+            "guarded_fields": row["locks"].get("guarded_fields", 0),
+        }
+        print(json.dumps(summary))
+    else:
+        with open(args.out, "w") as f:
+            json.dump(row, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out} ok={row['ok']}")
+    for fail in row["failures"]:
+        print(f"LOCK AUDIT FAILURE: {fail}", file=sys.stderr)
+    sys.exit(0 if row["ok"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -165,11 +165,15 @@ def main():
     power_loss = threading.Event()
     if storage_io is not None:
         def on_power_loss(signum, frame):
-            # only flag it: crash() journals through the flight
-            # recorder's non-reentrant lock, and a signal handler
-            # interrupting the main thread MID-emit would self-
-            # deadlock acquiring it — the main loop below runs the
-            # actual power loss from a safe point within one tick
+            # journal the injection from the signal context — safe now
+            # that flight.emit is reentrancy-proof (a handler landing
+            # mid-emit takes the non-blocking ring path instead of
+            # deadlocking on the ring lock).  The crash itself still
+            # runs from the main loop: collapsing the page cache must
+            # not race the WAL write it interrupted.
+            flight.emit("chaos.fault.injected",
+                        labels={"fault": "power_loss",
+                                "target": args.node})
             power_loss.set()
             wake.set()
 

@@ -301,10 +301,17 @@ def overload_burst(cluster, target: int, seconds: float,
 
 
 def run_soak(seconds: float, seed: int, out_path: str) -> int:
-    from consul_tpu import chaos_live, flight
+    from consul_tpu import chaos_live, flight, locks
     from consul_tpu.chaos import (ElectionSafetyChecker,
                                   check_linearizable)
     from consul_tpu.introspect import EventCollector
+
+    # arm the lock-discipline audit for the whole soak (ISSUE 14): the
+    # fault scheduler is the race amplifier, and the soak is where the
+    # contention/hold-time table comes from.  Exported so the live
+    # server subprocesses run audited too.
+    os.environ[locks.AUDIT_ENV] = "1"
+    locks.enable_audit()
 
     rng = random.Random(seed)
     recorder = flight.FlightRecorder(clock=time.time,
@@ -502,6 +509,10 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                   "put_p99_ms": w["put_p99_ms"]} for w in tail]}
     slo["checkers_green"] = {"ok": not violations,
                              "violations": violations}
+    lock_problems = locks.check_clean()
+    slo["lock_discipline"] = {"ok": not lock_problems,
+                              "violations": lock_problems,
+                              **locks.audit_summary()}
     ok = all(v["ok"] for v in slo.values())
 
     report = {
