@@ -374,6 +374,21 @@ class Client:
         out, idx, _ = self._call("GET", "/v1/agent/events", params)
         return out, idx
 
+    def agent_traces(self, since: int = 0,
+                     trace_id: Optional[str] = None,
+                     limit: Optional[int] = None) -> tuple:
+        """Trace-span ring read: (spans, cursor).  `since` is the span
+        seq cursor (spans with seq > since), `trace_id` filters to one
+        correlated trace — the pair the WAN probe and federation view
+        use to correlate cross-DC spans without re-downloading the
+        ring each poll."""
+        params: Dict[str, Any] = {"since": str(since) if since else None,
+                                  "trace_id": trace_id}
+        if limit is not None:
+            params["limit"] = str(limit)
+        out, idx, _ = self._call("GET", "/v1/agent/traces", params)
+        return out, idx
+
     def agent_profile(self) -> dict:
         """The always-on tick profiler's EMA table + recompile count."""
         return self._call("GET", "/v1/agent/profile")[0]

@@ -188,6 +188,17 @@ class ApiServer:
         # A fixed configured set, never caller-supplied URLs — the
         # agent must not become an open scrape proxy (SSRF).
         self.cluster_nodes: Optional[list] = None
+        # WAN federation view (consul_tpu/introspect.federation_view):
+        # DC -> list/map of that DC's server HTTP addresses, served as
+        # one merged multi-DC view at /v1/internal/ui/federation.
+        # Same SSRF stance as cluster_nodes: a fixed configured set
+        # (tools/server_proc.py --federation-http), never the caller's.
+        self.federation_nodes: Optional[dict] = None
+        # the datacenter dimension of every visibility sample/span
+        # (ISSUE 15): the store mints indexes, this server knows the DC
+        vis = getattr(self.store, "visibility", None)
+        if vis is not None:
+            vis.dc = dc
         self.txn_max_ops = 64
         # guards the per-proxy xDS delta payload caches: handler
         # threads race on insert/evict (ThreadingHTTPServer)
@@ -1057,6 +1068,7 @@ def _make_handler(srv: ApiServer):
             from consul_tpu.router import NoPathError
             dc = q.pop("dc")
             addr = None
+            via_gateway = False
             if srv.wan_fed_via_gateways:
                 # wanfed: the remote DC is reachable only through its
                 # mesh gateway, located from replicated federation
@@ -1065,6 +1077,7 @@ def _make_handler(srv: ApiServer):
                 gw = gateway_address(store, dc)
                 if gw is not None:
                     addr = f"http://{gw[0]}:{gw[1]}"
+                    via_gateway = True
             if addr is None and srv.router is not None:
                 try:
                     handle = srv.router.handle(dc)
@@ -1087,16 +1100,31 @@ def _make_handler(srv: ApiServer):
             from consul_tpu import telemetry, trace
             telemetry.incr_counter(("rpc", "cross-dc"),
                                    labels={"dc": dc})
+            if via_gateway:
+                # the WAN hop proper: this request leaves the local
+                # DC through the remote DC's mesh gateway (ISSUE 15
+                # SLI — cross-DC traffic by (src, dst) pair)
+                telemetry.incr_counter(("wanfed", "forward"),
+                                       labels={"src_dc": srv.dc,
+                                               "dst_dc": dc})
             tid = trace.current_trace()
             if tid:
                 req.add_header("X-Consul-Trace-Id", tid)
             try:
-                with urllib.request.urlopen(req, timeout=330.0) as resp:
-                    raw = resp.read()
-                    self._send(None, resp.status, raw=raw,
-                               index=int(resp.headers.get(
-                                   "X-Consul-Index") or 0),
-                               ctype=resp.headers.get("Content-Type"))
+                # the wanfed.forward span is the local-DC leg of the
+                # cross-DC trace: same id as the remote DC's spans, so
+                # ?trace_id= on EITHER side shows its half of the hop
+                with trace.span("wanfed.forward" if via_gateway
+                                else "rpc.forward_dc",
+                                src_dc=srv.dc, dst_dc=dc):
+                    with urllib.request.urlopen(req,
+                                                timeout=330.0) as resp:
+                        raw = resp.read()
+                        self._send(None, resp.status, raw=raw,
+                                   index=int(resp.headers.get(
+                                       "X-Consul-Index") or 0),
+                                   ctype=resp.headers.get(
+                                       "Content-Type"))
             except urllib.error.HTTPError as e:
                 self._err(e.code, e.read().decode(errors="replace"))
             return True
@@ -1306,8 +1334,19 @@ def _make_handler(srv: ApiServer):
                     return self._forbid()
                 from consul_tpu import trace
                 limit = int(q["limit"]) if "limit" in q else None
-                self._send(trace.dump(limit=limit,
-                                      trace_id=q.get("trace_id")))
+                since = int(q.get("since", 0) or 0)
+                spans = trace.dump(limit=limit,
+                                   trace_id=q.get("trace_id"),
+                                   since=since)
+                # forward-paging cursor (the /v1/agent/events shape):
+                # X-Consul-Index echoes the last seq RETURNED, or the
+                # ring horizon on an empty filtered page — everything
+                # up to it was examined, so a poller (the WAN probe,
+                # federation_view correlation) advances instead of
+                # re-downloading the ring
+                self._send(spans,
+                           index=spans[-1].get("seq", 0) if spans
+                           else max(since, trace.last_seq()))
                 return True
             if path == "/v1/agent/events" and verb == "GET":
                 # the flight-recorder journal (consul_tpu/flight.py):
@@ -2205,6 +2244,28 @@ def _make_handler(srv: ApiServer):
                 view = introspect.cluster_view(
                     srv.cluster_nodes,
                     events_since=int(q.get("events_since", 0) or 0),
+                    events_limit=int(q.get("events_limit", 50) or 0))
+                self._send(view)
+                return True
+            if path == "/v1/internal/ui/federation" and verb == "GET":
+                # the WAN view (introspect.federation_view): every
+                # configured DC's cluster_view merged into one
+                # DC -> leader/lag/visibility table + a dc-tagged
+                # cross-DC event timeline — the multi-DC sibling of
+                # cluster-metrics (the reference's UI topology +
+                # metrics-proxy serve the same story per-DC).  Same
+                # no-SSRF discipline: a fixed configured set only,
+                # same ACL bar as the metrics proxy.
+                if srv.federation_nodes is None:
+                    self._err(404, "federation view is not enabled "
+                                   "(no federation_nodes configured)")
+                    return True
+                if not (self.authz.node_read_all()
+                        and self.authz.service_read_all()):
+                    return self._forbid()
+                from consul_tpu import introspect
+                view = introspect.federation_view(
+                    srv.federation_nodes,
                     events_limit=int(q.get("events_limit", 50) or 0))
                 self._send(view)
                 return True

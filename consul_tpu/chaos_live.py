@@ -64,6 +64,7 @@ import sys
 import tempfile
 import threading
 import time
+import urllib.request
 from typing import Dict, List, Optional, Tuple
 
 from consul_tpu import flight
@@ -197,7 +198,9 @@ class LiveServer:
                  data_dir: str, peers_spec: str,
                  storage_faults: Optional[str] = None,
                  cluster_http: Optional[str] = None,
-                 rate_limit: Optional[str] = None):
+                 rate_limit: Optional[str] = None,
+                 dc: Optional[str] = None,
+                 wanfed: bool = False):
         self.name = name
         self.rpc_port = rpc_port
         self.http_port = http_port
@@ -206,6 +209,11 @@ class LiveServer:
         self.storage_faults = storage_faults
         self.cluster_http = cluster_http
         self.rate_limit = rate_limit
+        self.dc = dc
+        self.wanfed = wanfed
+        # dc1=url|url,dc2=... — set by LiveWan AFTER construction
+        # (every DC's ports exist before any process spawns)
+        self.federation_http: Optional[str] = None
         self.proc: Optional[subprocess.Popen] = None
         self.generation = 0
         self.paused = False
@@ -232,6 +240,12 @@ class LiveServer:
             cmd += ["--cluster-http", self.cluster_http]
         if self.rate_limit:
             cmd += ["--rate-limit", self.rate_limit]
+        if self.dc:
+            cmd += ["--dc", self.dc]
+        if self.wanfed:
+            cmd += ["--wanfed"]
+        if self.federation_http:
+            cmd += ["--federation-http", self.federation_http]
         # per-generation log: the post-mortem evidence when a scenario
         # fails (never parsed, only for humans)
         # lint: ok=blocking-call (harness-side log file, not a tick thread)
@@ -312,8 +326,11 @@ class LiveCluster:
 
     def __init__(self, n: int = 3, data_root: str = ".",
                  storage_faults: Optional[str] = None,
-                 rate_limit: Optional[str] = None):
+                 rate_limit: Optional[str] = None,
+                 dc: Optional[str] = None,
+                 wanfed: bool = False):
         self.n = n
+        self.dc = dc
         # one reservation batch held CONCURRENTLY: rpc and http ports
         # are guaranteed distinct, and the proxies bind their own
         # ephemeral ports while the reservations are still held, so
@@ -351,7 +368,8 @@ class LiveCluster:
                 f"server{i}", rpc[i], http[i],
                 os.path.join(data_root, f"server{i}"), ",".join(parts),
                 storage_faults=storage_faults,
-                cluster_http=cluster_http, rate_limit=rate_limit))
+                cluster_http=cluster_http, rate_limit=rate_limit,
+                dc=dc, wanfed=wanfed))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -453,6 +471,86 @@ class LiveCluster:
 
     def restart(self, i: int) -> None:
         self.servers[i].spawn()
+
+
+class LiveWan:
+    """N federated datacenters, each a REAL LiveCluster, all cross-DC
+    traffic through per-DC mesh gateways (ISSUE 15 tentpole d).
+
+    The composition the ROADMAP item-4 chaos families run against:
+    every DC is a full multi-process server cluster; each DC is
+    fronted by ONE dc-labeled `wanfed.MeshGatewayForwarder` (running
+    in THIS process, so its WAN SLIs and wanfed.splice.* events land
+    in the harness's telemetry/flight ring); every server in every DC
+    learns every REMOTE DC's gateway via replicated federation states
+    and forwards ?dc= requests through it (`--wanfed`), and every
+    server serves the merged `/v1/internal/ui/federation` view
+    (`--federation-http`).  dc1 never holds a direct route to dc2's
+    servers — only dc2's gateway is ever dialed."""
+
+    def __init__(self, data_root: str = ".", dcs=("dc1", "dc2"),
+                 n: int = 3):
+        self.clusters: Dict[str, LiveCluster] = {
+            dc: LiveCluster(n=n, data_root=os.path.join(data_root, dc),
+                            dc=dc, wanfed=True)
+            for dc in dcs}
+        # the federation spec is known before any process spawns
+        # (every cluster reserved its HTTP ports at construction)
+        fed = ",".join(
+            f"{dc}=" + "|".join(s.http for s in c.servers)
+            for dc, c in sorted(self.clusters.items()))
+        for c in self.clusters.values():
+            for s in c.servers:
+                s.federation_http = fed
+        self.gateways: Dict[str, MeshGatewayForwarder] = {}
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self, ready_timeout: float = 60.0) -> None:
+        try:
+            for c in self.clusters.values():
+                c.start(ready_timeout=ready_timeout)
+            for dc, c in sorted(self.clusters.items()):
+                gw = MeshGatewayForwarder(
+                    "127.0.0.1", c.servers[0].http_port,
+                    dc=dc, gw_name=f"{dc}-gw")
+                gw.start()
+                self.gateways[dc] = gw
+            self.advertise()
+        except BaseException:
+            self.stop()
+            raise
+
+    def advertise(self) -> None:
+        """Plant every remote DC's gateway address in every server's
+        federation states (the replicated-federation-state role; each
+        store is DC-local, so every server learns it directly)."""
+        for src, cluster in self.clusters.items():
+            for dst, gw in self.gateways.items():
+                if src == dst:
+                    continue
+                body = json.dumps({"MeshGateways": [
+                    {"address": gw.host, "port": gw.port}]}).encode()
+                for s in cluster.servers:
+                    req = urllib.request.Request(
+                        f"{s.http}/v1/internal/federation-state/{dst}",
+                        data=body, method="PUT")
+                    urllib.request.urlopen(req, timeout=5.0).read()
+
+    def stop(self) -> None:
+        for gw in self.gateways.values():
+            gw.stop()
+        self.gateways = {}
+        for c in self.clusters.values():
+            c.stop()
+
+    # -------------------------------------------------------------- queries
+
+    def federation_nodes(self) -> Dict[str, Dict[str, str]]:
+        """{dc: {node name: url}} — the introspect.federation_view
+        input (and the shape --federation-http serializes)."""
+        return {dc: {s.name: s.http for s in c.servers}
+                for dc, c in self.clusters.items()}
 
 
 # ---------------------------------------------------------------------------

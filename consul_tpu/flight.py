@@ -118,8 +118,21 @@ CATALOG: Dict[str, dict] = {
                           "labels": ("fn", "cache_size")},
     # commit-to-visibility pipeline (consul_tpu/visibility.py): a
     # watch-delivery stage lagging its raft apply past the stall budget
+    # (dc: the datacenter dimension of the federated view, ISSUE 15)
     "kv.visibility.stall": {"severity": "warn",
-                            "labels": ("stage", "index", "ms")},
+                            "labels": ("stage", "index", "ms", "dc")},
+    # WAN federation data plane (consul_tpu/wanfed.py, dc-labeled
+    # gateways only — the chaos LinkProxy interposer stays silent so a
+    # seeded scenario's journal remains byte-identical): one row per
+    # accepted cross-DC splice, stamped with the trace id sniffed from
+    # the spliced request's X-Consul-Trace-Id header so the gateway
+    # hop joins the writer's commit-to-visibility trace; failed = the
+    # upstream dial was refused (the fail-fast the live_gateway_loss
+    # scenario audits)
+    "wanfed.splice.opened": {"severity": "info",
+                             "labels": ("gateway", "dc")},
+    "wanfed.splice.failed": {"severity": "warn",
+                             "labels": ("gateway", "dc", "error")},
     # stream plane (stream/publisher.py): a subscriber draining a queue
     # that backed up past the slow threshold, and a follower that fell
     # off the topic buffer tail (forced re-snapshot)

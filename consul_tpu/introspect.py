@@ -75,9 +75,14 @@ class EventCollector:
     across restarts, and merges everything — plus the nemesis's own
     injection journal — into one timeline ordered by wall timestamp."""
 
-    def __init__(self, cluster, period: float = 0.4):
+    def __init__(self, cluster, period: float = 0.4,
+                 dc: Optional[str] = None):
         self.cluster = cluster
         self.period = period
+        # the datacenter tag (ISSUE 15): a WAN harness runs one
+        # collector per DC and merges — every row carries its DC so
+        # the federated timeline can tell dc2's wakeup from dc1's
+        self.dc = dc
         self.rows: List[dict] = []
         self._cursors: Dict[str, int] = {}
         self._gens: Dict[str, int] = {}
@@ -123,11 +128,14 @@ class EventCollector:
                 self._cursors[s.name] = max(
                     self._cursors.get(s.name, 0), idx)
                 for e in events:
-                    self.rows.append({
+                    row = {
                         "node": s.name, "gen": gen, "seq": e["Seq"],
                         "ts": e["Ts"], "name": e["Name"],
                         "severity": e["Severity"],
-                        "labels": e["Labels"]})
+                        "labels": e["Labels"]}
+                    if self.dc is not None:
+                        row["dc"] = self.dc
+                    self.rows.append(row)
 
     # ------------------------------------------------------------- readers
 
@@ -230,18 +238,32 @@ def scrape_node(url: str, events_since: int = 0,
                 events_limit: int = 50,
                 timeout: float = SCRAPE_TIMEOUT) -> dict:
     """Best-effort scrape of one node's observability surfaces.
-    Always returns a row; `alive` says whether anything answered."""
+    Always returns a row; `alive` says whether anything answered.
+
+    Partial failures do NOT vanish (ISSUE 15 satellite): every surface
+    that refused lands in `degraded` with its error, `error` carries
+    the first failure, and `consul.introspect.scrape_failed{node}`
+    counts the scrape — a node whose metrics endpoint wedged
+    mid-incident must show up as a degraded row, never as a silently
+    thinner view."""
+    from consul_tpu import telemetry
     c = Client(url, timeout=timeout)
     row: dict = {"url": url.rstrip("/"), "alive": False,
-                 "name": None, "metrics": None, "profile": None,
-                 "events": [], "events_cursor": events_since,
-                 "raft": None, "error": None}
+                 "name": None, "dc": None, "metrics": None,
+                 "profile": None, "events": [],
+                 "events_cursor": events_since,
+                 "raft": None, "error": None, "degraded": []}
     try:
-        row["name"] = (c.agent_self() or {}).get(
-            "Config", {}).get("NodeName")
+        cfg = (c.agent_self() or {}).get("Config", {})
+        row["name"] = cfg.get("NodeName")
+        row["dc"] = cfg.get("Datacenter")
         row["alive"] = True
     except (ApiError, OSError) as e:
         row["error"] = str(e)
+        row["degraded"].append({"surface": "self", "error": str(e)})
+        telemetry.incr_counter(("introspect", "scrape_failed"),
+                               labels={"node": row["name"]
+                                       or row["url"]})
         return row
     for field, fetch in (
             ("metrics", lambda: c._call(
@@ -251,15 +273,21 @@ def scrape_node(url: str, events_since: int = 0,
                 "GET", "/v1/operator/raft/configuration")[0])):
         try:
             row[field] = fetch()
-        except (ApiError, OSError):
-            pass                      # partial scrapes still merge
+        except (ApiError, OSError) as e:
+            # partial scrapes still merge — but loudly
+            row["degraded"].append({"surface": field, "error": str(e)})
     try:
         events, cursor = c.agent_events(since=events_since,
                                         limit=events_limit)
         row["events"] = events
         row["events_cursor"] = cursor
-    except (ApiError, OSError):
-        pass
+    except (ApiError, OSError) as e:
+        row["degraded"].append({"surface": "events", "error": str(e)})
+    if row["degraded"]:
+        row["error"] = row["degraded"][0]["error"]
+        telemetry.incr_counter(("introspect", "scrape_failed"),
+                               labels={"node": row["name"]
+                                       or row["url"]})
     return row
 
 
@@ -324,6 +352,7 @@ def view_from_scrapes(rows: List[Tuple[str, dict]]) -> dict:
         gauges, _ = _metric_maps(row["metrics"])
         node_view = {
             "url": row["url"], "alive": row["alive"],
+            "dc": row.get("dc"),
             "leader": _self_leader(row["raft"], row["name"]),
             "index": gauges.get(("consul.catalog.index", ())),
             "tick": gauges.get(("consul.sim.tick", ())),
@@ -334,6 +363,11 @@ def view_from_scrapes(rows: List[Tuple[str, dict]]) -> dict:
         }
         if row["error"]:
             node_view["error"] = row["error"]
+        if row.get("degraded"):
+            # the surfaces that refused: rendered as a DEGRADED row by
+            # cluster_top, never dropped from the table
+            node_view["degraded"] = [d["surface"]
+                                     for d in row["degraded"]]
         view["nodes"][name] = node_view
         if node_view["leader"]:
             view["leader"] = name
@@ -354,3 +388,85 @@ def view_from_scrapes(rows: List[Tuple[str, dict]]) -> dict:
         view["visibility"] = best["visibility"]
     view["generated_at"] = round(time.time(), 3)
     return view
+
+
+# ---------------------------------------------------------------------------
+# federation v2 (ISSUE 15): the multi-DC merge behind
+# /v1/internal/ui/federation, cluster_top --wan, debug_bundle --wan
+# ---------------------------------------------------------------------------
+
+
+def parse_dc_spec(spec: str) -> Dict[str, List[str]]:
+    """"dc1=url|url,dc2=url" -> {dc: [urls]} — the CLI/--federation-http
+    wire form (| separates URLs because , already separates DCs and
+    URLs carry ':' and '=')."""
+    out: Dict[str, List[str]] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        dc, _, urls = part.partition("=")
+        if not dc or not urls:
+            raise ValueError(f"malformed DC spec part {part!r} "
+                             f"(want dc=url|url|...)")
+        out.setdefault(dc, []).extend(
+            u for u in urls.split("|") if u)
+    return out
+
+
+def scrape_federation(dc_nodes: Dict[str, Union[List[str],
+                                                Dict[str, str]]],
+                      events_limit: int = 50
+                      ) -> Dict[str, List[Tuple[str, dict]]]:
+    """One scrape pass over every DC's fleet -> {dc: scrape rows}.
+    Split from federation_view for the same reason view_from_scrapes
+    exists: debug_bundle --wan archives the raw per-node rows AND the
+    merged view from ONE pass (a dead WAN link mid-incident costs one
+    timeout per node, not two)."""
+    return {dc: scrape_cluster(dc_nodes[dc], events_limit=events_limit)
+            for dc in sorted(dc_nodes)}
+
+
+def federation_from_scrapes(
+        dc_scrapes: Dict[str, List[Tuple[str, dict]]]) -> dict:
+    """Merge pre-fetched per-DC scrape rows into the federated view:
+    one row per DC (leader, alive/degraded node sets, the leader's
+    worst replication lag, the wakeup visibility quantiles), the full
+    per-DC node tables, and ONE dc-tagged cross-DC event timeline.
+    Degraded scrapes stay in the table (ISSUE 15 satellite) — a DC
+    whose nodes half-answer renders as degraded rows, not absences."""
+    view: dict = {"dcs": {}, "events": []}
+    all_events: List[dict] = []
+    for dc, scraped in sorted(dc_scrapes.items()):
+        dcv = view_from_scrapes(scraped)
+        for e in dcv.pop("events"):
+            e["dc"] = dc
+            all_events.append(e)
+        lag = dcv.get("replication_lag") or {}
+        wakeup = (dcv.get("visibility") or {}).get("wakeup") or {}
+        view["dcs"][dc] = {
+            "leader": dcv["leader"],
+            "nodes": dcv["nodes"],
+            "replication_lag": lag,
+            "visibility": dcv["visibility"],
+            "alive": sum(1 for n in dcv["nodes"].values()
+                         if n["alive"]),
+            "degraded": sorted(
+                n for n, v in dcv["nodes"].items()
+                if v.get("degraded") or not v["alive"]),
+            "lag_ms_max": max((r.get("ms", 0.0)
+                               for r in lag.values()), default=0.0),
+            "wakeup_p50_ms": wakeup.get("p50_ms"),
+            "wakeup_p99_ms": wakeup.get("p99_ms"),
+        }
+    view["events"] = merge_timelines(all_events)
+    view["generated_at"] = round(time.time(), 3)
+    return view
+
+
+def federation_view(dc_nodes: Dict[str, Union[List[str],
+                                              Dict[str, str]]],
+                    events_limit: int = 50) -> dict:
+    """Scrape every DC and merge — see federation_from_scrapes."""
+    return federation_from_scrapes(
+        scrape_federation(dc_nodes, events_limit=events_limit))

@@ -34,6 +34,7 @@ SPAN_RING = 2048
 
 _ring: deque = deque(maxlen=SPAN_RING)
 _lock = threading.Lock()
+_seq = 0      # monotone span cursor (rides /v1/agent/traces?since=)
 _current: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
     "consul_tpu_trace_id", default=None)
 
@@ -75,7 +76,11 @@ def reset(token) -> None:
 def record(name: str, trace_id: Optional[str], start_wall: float,
            dur_s: float, **attrs) -> None:
     """Append one completed span.  `attrs` values must be JSON-safe
-    scalars (they ride /v1/agent/traces and the debug archive)."""
+    scalars (they ride /v1/agent/traces and the debug archive).  Each
+    span gets a monotone `seq` so pollers (the WAN probe, the
+    federation view) can cursor with ?since= instead of re-downloading
+    the whole ring."""
+    global _seq
     rec = {
         "trace_id": trace_id or "",
         "name": name,
@@ -86,6 +91,8 @@ def record(name: str, trace_id: Optional[str], start_wall: float,
     if attrs:
         rec["attrs"] = {k: v for k, v in attrs.items() if v is not None}
     with _lock:
+        _seq += 1
+        rec["seq"] = _seq
         _ring.append(rec)
 
 
@@ -104,17 +111,28 @@ def span(name: str, trace_id: Optional[str] = None, **attrs):
 
 
 def dump(limit: Optional[int] = None,
-         trace_id: Optional[str] = None) -> List[dict]:
+         trace_id: Optional[str] = None,
+         since: int = 0) -> List[dict]:
     """Snapshot of the ring, oldest first; optionally filtered to one
-    trace and/or capped to the newest `limit` records."""
+    trace, to spans with seq > `since` (forward-paging cursor), and/or
+    capped to the newest `limit` records."""
     with _lock:
         out = list(_ring)
+    if since:
+        out = [r for r in out if r.get("seq", 0) > since]
     if trace_id:
         out = [r for r in out if r["trace_id"] == trace_id]
     if limit is not None and limit >= 0:
         # out[-0:] is the WHOLE list — limit=0 must mean zero records
         out = out[-limit:] if limit else []
     return out
+
+
+def last_seq() -> int:
+    """The cursor horizon: every span ≤ this seq has been recorded
+    (the ?since= echo when a filtered page comes back empty)."""
+    with _lock:
+        return _seq
 
 
 def clear() -> None:

@@ -93,8 +93,14 @@ def apply_trace() -> Optional[str]:
 class VisibilityTable:
     """index -> {apply_ts, publish_ts, trace_id, publish_emitted}."""
 
-    def __init__(self, cap: int = TABLE_CAP):
+    def __init__(self, cap: int = TABLE_CAP, dc: str = "dc1"):
         self._cap = cap
+        # the datacenter dimension (ISSUE 15): every emitted sample,
+        # span, and stall event carries {dc} so a federated scrape can
+        # tell DC2's wakeup quantiles from DC1's.  Plain attribute —
+        # the owning ApiServer/agent rebinds it once at wiring time
+        # (the store itself has no concept of a datacenter).
+        self.dc = dc
         self._lock = locks.make_lock("visibility.table")
         # the bounded index->record ring  # guarded-by: _lock
         self._rec: "OrderedDict[int, dict]" = OrderedDict()
@@ -183,22 +189,24 @@ class VisibilityTable:
                 rec["publish_emitted"] = True
                 emit_publish = rec["publish_ts"] - apply_ts
         from consul_tpu import telemetry, trace
+        dc = self.dc
         if emit_publish is not None:
             lat = max(0.0, emit_publish)
             telemetry.add_sample(("kv", "visibility"), lat,
-                                 labels={"stage": "publish"})
+                                 labels={"stage": "publish", "dc": dc})
             trace.record("kv.visibility.publish", tid,
-                         apply_ts, lat, index=index)
+                         apply_ts, lat, index=index, dc=dc)
         lat = max(0.0, now - apply_ts)
         telemetry.add_sample(("kv", "visibility"), lat,
-                             labels={"stage": stage})
+                             labels={"stage": stage, "dc": dc})
         trace.record(f"kv.visibility.{stage}", tid, apply_ts, lat,
-                     index=index)
+                     index=index, dc=dc)
         if lat > STALL_SECONDS:
             from consul_tpu import flight
             flight.emit("kv.visibility.stall",
                         labels={"stage": stage, "index": index,
-                                "ms": round(lat * 1000.0, 1)},
+                                "ms": round(lat * 1000.0, 1),
+                                "dc": dc},
                         trace_id=tid)
         return lat, tid
 

@@ -38,11 +38,25 @@ class MeshGatewayForwarder:
     Subclass hooks (the live nemesis's `chaos_live.LinkProxy` builds
     its toxiproxy-style link interposer on this same machinery):
     `_admit()` gates each accepted connection, `_pre_forward(data)`
-    gates/paces each spliced chunk — both default to pass-through."""
+    gates/paces each spliced chunk — both default to pass-through.
+
+    Observability (ISSUE 15) is opt-in via `dc`: a gateway that knows
+    which datacenter it fronts emits the WAN SLIs — per-splice
+    `consul.wanfed.gateway.{active,bytes,dial_ms}{gateway,dc}` and
+    `wanfed.splice.{opened,failed}` flight events, with the splice's
+    trace id sniffed from the spliced request's X-Consul-Trace-Id
+    header (the envelope hop: a cross-DC write's trace must survive
+    the gateway, not die at the TCP boundary).  The chaos LinkProxy
+    interposer passes no dc and stays silent — a seeded scenario's
+    event journal must remain byte-identical across replays, and raft
+    heartbeat splices would wash the ring."""
 
     def __init__(self, target_host: str, target_port: int,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 dc: Optional[str] = None, gw_name: str = "gateway"):
         self.target = (target_host, target_port)
+        self.dc = dc                # None = observability off
+        self.gw_name = gw_name
         self._lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._lsock.bind((host, port))
@@ -111,6 +125,36 @@ class MeshGatewayForwarder:
         kill the splice.  (LinkProxy: sever check + delay fault.)"""
         return True
 
+    # ------------------------------------------------------------ WAN SLIs
+
+    def _gauge_active(self) -> None:
+        """consul.wanfed.gateway.active: live splices through this
+        gateway (each splice holds two sockets in the live set)."""
+        from consul_tpu import telemetry
+        with self._conns_lock:
+            n = len(self._conns) // 2
+        telemetry.set_gauge(("wanfed", "gateway", "active"), float(n),
+                            labels={"gateway": self.gw_name,
+                                    "dc": self.dc})
+
+    @staticmethod
+    def _sniff_trace(data: bytes) -> str:
+        """Best-effort X-Consul-Trace-Id from the first spliced chunk
+        (cross-DC hops are HTTP; the header rides in the first frame).
+        Returns "" when absent/invalid — an unparseable splice still
+        journals, just uncorrelated."""
+        low = data[:4096].lower()
+        i = low.find(b"x-consul-trace-id:")
+        if i < 0:
+            return ""
+        val = data[i + len(b"x-consul-trace-id:"):]
+        val = val.split(b"\r\n", 1)[0].split(b"\n", 1)[0].strip()
+        try:
+            from consul_tpu import trace
+            return trace.sanitize_id(val.decode("latin-1")) or ""
+        except UnicodeDecodeError:
+            return ""
+
     def _accept_loop(self) -> None:
         while self._running:
             try:
@@ -120,12 +164,27 @@ class MeshGatewayForwarder:
             if not self._admit():
                 conn.close()
                 continue
+            import time as _time
+            t0 = _time.perf_counter()
             try:
                 upstream = socket.create_connection(self.target,
                                                     timeout=10.0)
-            except OSError:
+            except OSError as e:
                 conn.close()
+                if self.dc is not None:
+                    from consul_tpu import flight
+                    flight.emit("wanfed.splice.failed",
+                                labels={"gateway": self.gw_name,
+                                        "dc": self.dc,
+                                        "error": type(e).__name__},
+                                trace_id="")
                 continue
+            if self.dc is not None:
+                from consul_tpu import telemetry
+                telemetry.add_sample(
+                    ("wanfed", "gateway", "dial_ms"),
+                    (_time.perf_counter() - t0) * 1000.0,
+                    labels={"gateway": self.gw_name, "dc": self.dc})
             # prune finished pumps first: a long-lived gateway must not
             # accumulate two Thread objects per connection forever
             self._pumps = [t for t in self._pumps if t.is_alive()]
@@ -137,20 +196,44 @@ class MeshGatewayForwarder:
                     upstream.close()
                     return
                 self._conns.update((conn, upstream))
-            for a, b in ((conn, upstream), (upstream, conn)):
-                t = threading.Thread(target=self._pump, args=(a, b),
+            if self.dc is not None:
+                self._gauge_active()
+            # the client→upstream pump sniffs the splice envelope (the
+            # request headers cross first, carrying the trace id)
+            for a, b, sniff in ((conn, upstream, True),
+                                (upstream, conn, False)):
+                t = threading.Thread(target=self._pump,
+                                     args=(a, b, sniff),
                                      daemon=True)
                 t.start()
                 self._pumps.append(t)
 
-    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+    def _pump(self, src: socket.socket, dst: socket.socket,
+              sniff: bool = False) -> None:
+        observed = self.dc is not None
+        first = sniff and observed
         try:
             while True:
                 data = src.recv(65536)
                 if not data:
                     break
+                if first:
+                    # one wanfed.splice.opened per splice, correlated
+                    # to the spliced request's own trace id — the
+                    # gateway leg of the cross-DC visibility trace
+                    first = False
+                    from consul_tpu import flight
+                    flight.emit("wanfed.splice.opened",
+                                labels={"gateway": self.gw_name,
+                                        "dc": self.dc},
+                                trace_id=self._sniff_trace(data))
                 if not self._pre_forward(data):
                     break
+                if observed:
+                    from consul_tpu import telemetry
+                    telemetry.incr_counter(
+                        ("wanfed", "gateway", "bytes"), float(len(data)),
+                        labels={"gateway": self.gw_name, "dc": self.dc})
                 dst.sendall(data)
         except OSError:
             pass
@@ -165,6 +248,8 @@ class MeshGatewayForwarder:
                     pass
             with self._conns_lock:
                 self._conns.discard(src)
+            if observed:
+                self._gauge_active()
 
 
 def gateway_address(store, dc: str) -> Optional[Tuple[str, int]]:

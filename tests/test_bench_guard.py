@@ -112,6 +112,26 @@ def test_make_baseline_records_topology_from_runs():
     assert nb["topology"] is None
 
 
+def test_guard_tolerates_wan_and_federation_stamps():
+    """ISSUE 15: wan_visibility_probe rows decorate results with
+    {"wan": ...}/{"federation": ...} stamps (and the BENCH-style
+    topology stamp) — the judge must tolerate the metadata and keep
+    judging ONLY the median + accuracy gates."""
+    base = {"metric": METRIC, "median_s": 0.600}
+    row = {**_row(0.650),
+           "wan": {"dcs": 2, "dc_size": 3,
+                   "cross_dc_ms": {"p50": 4.2, "p99": 19.0}},
+           "federation": {"dcs": ["dc1", "dc2"], "degraded": []}}
+    assert judge([row], base)["ok"]
+    # the topology refusal still applies to a stamped WAN row
+    topo_base = {"metric": METRIC, "median_s": 0.600,
+                 "topology": {"backend": "tpu", "devices": 1,
+                              "mesh_shape": None}}
+    out = judge([{**row, "topology": {"backend": "cpu", "devices": 1,
+                                      "mesh_shape": None}}], topo_base)
+    assert not out["ok"] and out["verdict"] == "topology"
+
+
 def test_checked_in_baseline_is_valid_and_matches_roundtrip():
     b = load_baseline()
     assert b["metric"] == METRIC

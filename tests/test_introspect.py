@@ -126,6 +126,191 @@ def test_cluster_metrics_endpoint_and_cluster_top_render():
         a.stop()
 
 
+# --------------------------------------------------------------------
+# ISSUE 15: degraded scrapes, the scrape_failed counter, and the
+# federated multi-DC view (introspect.federation_view + the
+# /v1/internal/ui/federation endpoint + cluster_top --wan + the
+# debug_bundle --wan archive)
+# --------------------------------------------------------------------
+
+
+def _counter(name, labels):
+    from consul_tpu import telemetry
+    key = tuple(sorted(labels.items()))
+    for c in telemetry.default_registry().dump()["Counters"]:
+        if c["Name"] == name and tuple(sorted(
+                (c.get("Labels") or {}).items())) == key:
+            return c["Count"]
+    return 0.0
+
+
+def _half_dead_handler():
+    """An HTTP stub that self-reports but refuses its metrics surface
+    — the degraded-node shape a wedged process serves mid-incident."""
+    import http.server
+
+    class H(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/v1/agent/self"):
+                body = json.dumps({"Config": {
+                    "NodeName": "halfdead",
+                    "Datacenter": "dc9"}}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", len(body))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(500, "wedged")
+    return H
+
+
+def test_scrape_degradation_is_counted_and_kept():
+    """A half-answering node lands in the view as a DEGRADED row with
+    its error — and bumps consul.introspect.scrape_failed{node} —
+    instead of silently thinning the merge (ISSUE 15 satellite)."""
+    import http.server
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            _half_dead_handler())
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        before = _counter("consul.introspect.scrape_failed",
+                          {"node": "halfdead"})
+        row = introspect.scrape_node(url)
+        assert row["alive"] is True and row["name"] == "halfdead"
+        assert row["dc"] == "dc9"
+        surfaces = {d["surface"] for d in row["degraded"]}
+        assert {"metrics", "profile", "raft", "events"} <= surfaces
+        assert row["error"]
+        assert _counter("consul.introspect.scrape_failed",
+                        {"node": "halfdead"}) == before + 1
+        # the merged view keeps the row, marked degraded
+        view = introspect.view_from_scrapes([("halfdead", row)])
+        nv = view["nodes"]["halfdead"]
+        assert nv["alive"] is True and nv["error"]
+        assert "metrics" in nv["degraded"]
+        # cluster_top renders it distinctly, not as a healthy row
+        from cluster_top import render
+        text = render(view)
+        assert "DEGRADED" in text and "halfdead" in text
+        # a fully dead node still counts a failed scrape (by URL)
+        dead_url = "http://127.0.0.1:9"
+        b2 = _counter("consul.introspect.scrape_failed",
+                      {"node": dead_url})
+        introspect.scrape_node(dead_url)
+        assert _counter("consul.introspect.scrape_failed",
+                        {"node": dead_url}) == b2 + 1
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_parse_dc_spec():
+    import pytest
+    assert introspect.parse_dc_spec(
+        "dc1=http://a:1|http://b:2,dc2=http://c:3") == {
+        "dc1": ["http://a:1", "http://b:2"],
+        "dc2": ["http://c:3"]}
+    # repeated DC keys append
+    assert introspect.parse_dc_spec("dc1=u1,dc1=u2") == {
+        "dc1": ["u1", "u2"]}
+    with pytest.raises(ValueError):
+        introspect.parse_dc_spec("justaurl")
+
+
+def test_federation_view_endpoint_and_wan_render():
+    """Two in-process 'DCs' merge into one federated view: DC-keyed
+    tables, dc-tagged timeline, the /v1/internal/ui/federation
+    endpoint (404 until configured — SSRF stance), and the
+    cluster_top --wan render."""
+    a = ApiServer(StateStore(), node_name="fed-a", dc="dc1")
+    b = ApiServer(StateStore(), node_name="fed-b", dc="dc2")
+    a.start()
+    b.start()
+    try:
+        flight.emit("agent.started", labels={"node": "fed-a"})
+        spec = {"dc1": {"fed-a": a.address},
+                "dc2": {"fed-b": b.address}}
+        view = introspect.federation_view(spec)
+        assert set(view["dcs"]) == {"dc1", "dc2"}
+        assert view["dcs"]["dc1"]["nodes"]["fed-a"]["dc"] == "dc1"
+        assert view["dcs"]["dc1"]["alive"] == 1
+        assert all(e["dc"] in ("dc1", "dc2")
+                   for e in view["events"])
+        assert any(e["dc"] == "dc1" and e["name"] == "agent.started"
+                   for e in view["events"])
+        # unconfigured: the endpoint is OFF (metrics-proxy stance)
+        try:
+            urllib.request.urlopen(
+                a.address + "/v1/internal/ui/federation", timeout=5)
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        a.federation_nodes = spec
+        out = json.loads(urllib.request.urlopen(
+            a.address + "/v1/internal/ui/federation",
+            timeout=10).read())
+        assert set(out["dcs"]) == {"dc1", "dc2"}
+        from cluster_top import render_wan
+        text = render_wan(out, events_tail=5)
+        assert "dc1" in text and "dc2" in text and "fed-b" in text
+    finally:
+        a.stop()
+        b.stop()
+
+
+def test_debug_bundle_wan_subprocess_smoke():
+    """`debug_bundle.py --wan dc=URL,...` from a cold subprocess:
+    per-DC subdirs + merged federation_view.json + wan_events.jsonl,
+    ok=true, bounded wall (ISSUE 15 satellite — the <10 s smoke
+    extended to the WAN capture)."""
+    a = ApiServer(StateStore(), node_name="wb-a", dc="dc1")
+    b = ApiServer(StateStore(), node_name="wb-b", dc="dc2")
+    a.start()
+    b.start()
+    tmp = tempfile.mkdtemp(prefix="bundle-wan-")
+    out_path = os.path.join(tmp, "wan.tar.gz")
+    try:
+        flight.emit("agent.started", labels={"node": "wb-a"})
+        t0 = time.perf_counter()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "debug_bundle.py"),
+             "--wan", f"dc1={a.address},dc2={b.address}",
+             "--out", out_path],
+            capture_output=True, text=True, timeout=60, cwd=REPO,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        wall = time.perf_counter() - t0
+        assert proc.returncode == 0, proc.stderr[-800:]
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert row["ok"], row
+        assert wall < 30.0          # cold interpreter + scrape + tar
+        with tarfile.open(out_path, "r:gz") as tar:
+            names = tar.getnames()
+            assert "federation_view.json" in names
+            assert "wan_events.jsonl" in names
+            for dc, node in (("dc1", "wb-a"), ("dc2", "wb-b")):
+                for sec in ("metrics.json", "events.jsonl",
+                            "profile.json", "raft.json"):
+                    assert f"{dc}/{node}/{sec}" in names
+            view = json.loads(tar.extractfile(
+                "federation_view.json").read())
+            assert set(view["dcs"]) == {"dc1", "dc2"}
+            merged = tar.extractfile(
+                "wan_events.jsonl").read().decode()
+            rows = [json.loads(ln) for ln in merged.splitlines()]
+            assert any(r["name"] == "agent.started"
+                       and r["dc"] == "dc1" for r in rows)
+    finally:
+        a.stop()
+        b.stop()
+
+
 def test_debug_bundle_cluster_subprocess_smoke():
     """`debug_bundle.py --cluster URL,URL` from a cold subprocess:
     per-node subdirs + merged cluster_events.jsonl, ok=true, bounded

@@ -659,6 +659,62 @@ def test_issue10_visibility_event_names_registered():
     assert "label 'lane' not declared" in msgs
 
 
+def test_issue15_wan_metric_and_event_names_registered():
+    """The WAN visibility vocabulary (ISSUE 15 satellite): the
+    consul.wanfed.* / consul.introspect.scrape_failed families pass
+    the metric gate and the wanfed.splice.* events are registered in
+    CATALOG with their exact label sets — while a malformed sibling
+    or undeclared label still fires (the checker gates the NEW
+    vocabulary, not just the old)."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def wan(gw, dc, err, n, ms, src, dst, node, stage, index):
+            flight.emit("wanfed.splice.opened",
+                        labels={"gateway": gw, "dc": dc})
+            flight.emit("wanfed.splice.failed",
+                        labels={"gateway": gw, "dc": dc,
+                                "error": err})
+            flight.emit("kv.visibility.stall",
+                        labels={"stage": stage, "index": index,
+                                "ms": ms, "dc": dc})
+            telemetry.set_gauge(("wanfed", "gateway", "active"), n,
+                                labels={"gateway": gw, "dc": dc})
+            telemetry.incr_counter(("wanfed", "gateway", "bytes"), n,
+                                   labels={"gateway": gw, "dc": dc})
+            telemetry.add_sample(("wanfed", "gateway", "dial_ms"), ms,
+                                 labels={"gateway": gw, "dc": dc})
+            telemetry.incr_counter(("wanfed", "forward"),
+                                   labels={"src_dc": src,
+                                           "dst_dc": dst})
+            telemetry.incr_counter(("introspect", "scrape_failed"),
+                                   labels={"node": node})
+            telemetry.add_sample(("kv", "visibility"), ms,
+                                 labels={"stage": stage, "dc": dc})
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+    bad = """
+        from consul_tpu import flight, telemetry
+
+        def wan(gw, dc, labels):
+            flight.emit("wanfed.splice.exploded",
+                        labels={"gateway": gw})
+            flight.emit("wanfed.splice.opened",
+                        labels={"gateway": gw, "lane": dc})
+            flight.emit("wanfed.splice.failed", labels=labels)
+            telemetry.add_sample(("wanfed", "dial ms!"), 1.0)
+    """
+    ev = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in ev)
+    assert len(ev) == 3
+    assert "unregistered event name 'wanfed.splice.exploded'" in msgs
+    assert "label 'lane' not declared" in msgs
+    assert "computed labels" in msgs
+    mn = check_snippet("metric-names", bad)
+    assert any("dial ms!" in f.message for f in mn)
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

@@ -144,6 +144,56 @@ def test_forwarded_write_single_trace_follower_leader_apply():
 
 # ----------------------------------------------- HTTP minting + endpoint
 
+def test_span_seq_cursor_pages_forward():
+    """?since= semantics at the module layer (ISSUE 15 satellite):
+    spans carry a monotone seq, dump(since=) pages strictly forward,
+    and last_seq() is the horizon an empty filtered page echoes."""
+    trace.clear()
+    with trace.span("cur.a", trace_id="aa" * 16):
+        pass
+    horizon = trace.last_seq()
+    with trace.span("cur.b", trace_id="bb" * 16):
+        pass
+    newer = trace.dump(since=horizon)
+    assert [s["name"] for s in newer] == ["cur.b"]
+    assert all(s["seq"] > horizon for s in newer)
+    # seq survives clear() monotonically — a cursor never re-reads
+    assert trace.dump(since=trace.last_seq()) == []
+    # composed with the trace filter
+    assert trace.dump(since=horizon, trace_id="aa" * 16) == []
+
+
+def test_traces_endpoint_since_cursor_and_client_helper():
+    """/v1/agent/traces?since= + ?trace_id= with the X-Consul-Index
+    cursor header, through the api.client.agent_traces helper — the
+    probe/federation correlation path that must not re-download the
+    ring each poll."""
+    from consul_tpu.api.client import Client
+    from consul_tpu.api.http import ApiServer
+    from consul_tpu.catalog.store import StateStore
+
+    api = ApiServer(StateStore(), node_name="cursor")
+    api.start()
+    try:
+        c = Client(api.address, timeout=10)
+        tid = "cc" * 16
+        req = urllib.request.Request(api.address + "/v1/agent/self")
+        req.add_header("X-Consul-Trace-Id", tid)
+        urllib.request.urlopen(req, timeout=15).read()
+        spans, cursor = c.agent_traces(trace_id=tid)
+        assert spans and cursor >= max(s["seq"] for s in spans)
+        # paging from the cursor returns nothing until new spans land
+        page, cursor2 = c.agent_traces(since=cursor, trace_id=tid)
+        assert page == [] and cursor2 >= cursor
+        urllib.request.urlopen(req, timeout=15).read()
+        page, cursor3 = c.agent_traces(since=cursor2, trace_id=tid)
+        assert page and all(s["seq"] > cursor2 for s in page)
+        assert all(s["trace_id"] == tid for s in page)
+        assert cursor3 == max(s["seq"] for s in page)
+    finally:
+        api.stop()
+
+
 def test_http_mints_trace_and_serves_ring():
     from consul_tpu.api.http import ApiServer
     from consul_tpu.catalog.store import StateStore

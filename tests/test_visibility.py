@@ -96,6 +96,28 @@ def test_stage_emits_sample_span_and_stall_event(monkeypatch):
     assert pubs[0]["Count"] == count0
 
 
+def test_stage_emissions_carry_dc_label(monkeypatch):
+    """Every visibility sample, span, and stall event carries the
+    table's datacenter (ISSUE 15): two DCs' pipelines in one process
+    stay distinguishable in the federated scrape."""
+    from consul_tpu import trace
+    t = visibility.VisibilityTable(dc="dc7")
+    t.note_apply(9, ts=time.time() - 5.0, trace_id="beef" * 8)
+    monkeypatch.setattr(visibility, "STALL_SECONDS", 1.0)
+    rec = flight.FlightRecorder(forward_to_log=False)
+    with flight.use(rec):
+        t.stage("wakeup", 9)
+    labels = [(s.get("Labels") or {}) for s in
+              _samples("consul.kv.visibility")]
+    assert any(lb == {"stage": "wakeup", "dc": "dc7"}
+               for lb in labels)
+    span = trace.dump(trace_id="beef" * 8)[-1]
+    assert span["name"] == "kv.visibility.wakeup"
+    assert span["attrs"]["dc"] == "dc7"
+    stall = rec.read(name="kv.visibility.stall")[0]
+    assert stall["labels"]["dc"] == "dc7"
+
+
 # ------------------------------------------ the HTTP pipeline, end to end
 
 

@@ -1,0 +1,129 @@
+"""ISSUE 15 acceptance on the REAL 2-DC federation: ONE trace id
+correlates a DC1 HTTP write -> mesh-gateway splice -> DC2 apply ->
+DC2 watcher wakeup (spans from BOTH DCs' trace rings + dc-labeled
+visibility stages + the gateway's trace-stamped splice event), and
+`cluster_top --wan` renders the per-DC leader/lag/visibility table
+with degraded scrapes as degraded rows, not absences.
+
+This spawns a chaos_live.LiveWan — two real multi-process server
+clusters with ALL cross-DC traffic spliced through per-DC mesh
+gateways — budgeted ~20 s; everything cheaper lives in
+tests/test_wanfed.py / test_introspect.py.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+from consul_tpu import flight, telemetry
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_live_2dc_correlated_trace_and_federated_view():
+    from consul_tpu.api.client import Client
+    from consul_tpu.chaos_live import LiveWan
+    from consul_tpu.trace import new_trace_id
+
+    with tempfile.TemporaryDirectory(prefix="wan-live-") as tmp:
+        wan = LiveWan(data_root=tmp, dcs=("dc1", "dc2"), n=2)
+        try:
+            wan.start()
+            dc1_url = wan.clusters["dc1"].servers[0].http
+            dc2 = wan.clusters["dc2"]
+            got = {}
+
+            def watch():
+                with urllib.request.urlopen(
+                        dc2.servers[0].http
+                        + "/v1/kv/wan/live?index=1&wait=10s",
+                        timeout=20) as r:
+                    got["idx"] = int(r.headers["X-Consul-Index"])
+                    got["rows"] = json.loads(r.read())
+
+            w = threading.Thread(target=watch)
+            w.start()
+            time.sleep(0.6)          # the watcher parks first
+            tid = new_trace_id()
+            req = urllib.request.Request(
+                dc1_url + "/v1/kv/wan/live?dc=dc2", data=b"xdc",
+                method="PUT", headers={"X-Consul-Trace-Id": tid})
+            urllib.request.urlopen(req, timeout=30).read()
+            w.join(timeout=12)
+            # the cross-DC write woke the DC2 watcher
+            assert got["rows"][0]["Key"] == "wan/live"
+            time.sleep(0.5)
+
+            # ---- ONE trace id, three legs.  DC1's ring: the entry +
+            # the WAN hop through dc2's gateway
+            dc1_spans, _ = Client(dc1_url, timeout=8.0).agent_traces(
+                trace_id=tid)
+            names1 = {s["name"] for s in dc1_spans}
+            assert {"http.request", "wanfed.forward"} <= names1
+            fwd = next(s for s in dc1_spans
+                       if s["name"] == "wanfed.forward")
+            assert fwd["attrs"] == {"src_dc": "dc1", "dst_dc": "dc2"}
+            # the gateway leg: the splice event sniffed the SAME id
+            # off the spliced request (the gateways run in this
+            # process, so their journal is the local flight ring)
+            opened = flight.default_recorder().read(
+                name="wanfed.splice.opened")
+            assert any(r["trace_id"] == tid
+                       and r["labels"]["dc"] == "dc2"
+                       for r in opened)
+            # DC2's ring: apply -> publish -> wakeup -> flush under
+            # the SAME id, every visibility span dc2-labeled
+            dc2_spans = []
+            for srv in dc2.servers:
+                spans, _ = Client(srv.http, timeout=8.0).agent_traces(
+                    trace_id=tid)
+                dc2_spans.extend(spans)
+            names2 = {s["name"] for s in dc2_spans}
+            assert {"kv.visibility.publish", "kv.visibility.wakeup",
+                    "kv.visibility.flush"} <= names2
+            assert all(s["attrs"]["dc"] == "dc2" for s in dc2_spans
+                       if s["name"].startswith("kv.visibility"))
+
+            # ---- dc-labeled visibility stages + the WAN SLIs
+            from consul_tpu import introspect
+            li = dc2.leader()
+            scrape = introspect.scrape_node(dc2.servers[li].http)
+            stages = [
+                s for s in (scrape["metrics"] or {}).get("Samples", [])
+                if s["Name"] == "consul.kv.visibility"]
+            assert stages and all(
+                (s.get("Labels") or {}).get("dc") == "dc2"
+                for s in stages)
+            dump = telemetry.default_registry().dump()
+            assert any(c["Name"] == "consul.wanfed.gateway.bytes"
+                       and c["Labels"]["dc"] == "dc2"
+                       for c in dump["Counters"])
+
+            # ---- the federated view: live endpoint + cluster_top
+            # --wan render, with a degraded scrape as a DEGRADED row
+            fv = json.loads(urllib.request.urlopen(
+                dc1_url + "/v1/internal/ui/federation",
+                timeout=15).read())
+            assert set(fv["dcs"]) == {"dc1", "dc2"}
+            for dc in ("dc1", "dc2"):
+                assert fv["dcs"][dc]["leader"] is not None
+                assert fv["dcs"][dc]["alive"] == 2
+            nodes = wan.federation_nodes()
+            nodes["dc2"]["ghost"] = "http://127.0.0.1:9"
+            view = introspect.federation_view(nodes)
+            assert "ghost" in view["dcs"]["dc2"]["degraded"]
+            assert view["dcs"]["dc2"]["nodes"]["ghost"]["alive"] \
+                is False
+            from cluster_top import render_wan
+            text = render_wan(view, events_tail=5)
+            assert "dc1" in text and "dc2" in text
+            assert "ghost" in text and "dead" in text
+            # per-DC leader/lag/visibility table rendered live
+            assert "WAKEUP_P50" in text and "server0" in text
+        finally:
+            wan.stop()

@@ -195,6 +195,127 @@ def test_forwarder_stop_idempotent_mid_transfer():
     die()
 
 
+# --------------------------------------------------------------------
+# WAN SLIs + splice-envelope trace propagation (ISSUE 15 tentpole a/b):
+# a dc-labeled gateway journals wanfed.splice.{opened,failed} events
+# (trace id sniffed from the spliced request) and emits the
+# consul.wanfed.gateway.{active,bytes,dial_ms} family; an unlabeled
+# gateway (the chaos LinkProxy shape) stays silent.
+# --------------------------------------------------------------------
+
+
+def _wanfed_metrics():
+    from consul_tpu import telemetry
+    return telemetry.default_registry().dump()
+
+
+def test_observed_gateway_emits_slis_and_sniffs_trace(wanfed_pair):
+    from consul_tpu import flight
+    _, a2, _ = wanfed_pair
+    gw = MeshGatewayForwarder("127.0.0.1", a2.api.port,
+                              dc="dc2", gw_name="t-gw")
+    gw.start()
+    rec = flight.FlightRecorder(forward_to_log=False)
+    tid = "feedc0de" * 4
+    try:
+        with flight.use(rec):
+            with socket.create_connection(gw.address, timeout=10) as s:
+                s.sendall(b"GET /v1/status/leader HTTP/1.1\r\n"
+                          b"Host: x\r\n"
+                          b"X-Consul-Trace-Id: " + tid.encode()
+                          + b"\r\nConnection: close\r\n\r\n")
+                while s.recv(4096):
+                    pass
+            deadline = time.time() + 3.0
+            while time.time() < deadline and \
+                    not rec.read(name="wanfed.splice.opened"):
+                time.sleep(0.05)
+        opened = rec.read(name="wanfed.splice.opened")
+        assert len(opened) == 1
+        assert opened[0]["labels"] == {"gateway": "t-gw", "dc": "dc2"}
+        # the splice envelope carried the writer's trace id across
+        assert opened[0]["trace_id"] == tid
+        dump = _wanfed_metrics()
+        assert any(c["Name"] == "consul.wanfed.gateway.bytes"
+                   and c["Labels"] == {"gateway": "t-gw", "dc": "dc2"}
+                   and c["Count"] > 0 for c in dump["Counters"])
+        assert any(s["Name"] == "consul.wanfed.gateway.dial_ms"
+                   and s["Labels"]["dc"] == "dc2"
+                   for s in dump["Samples"])
+    finally:
+        gw.stop()
+    # every splice torn down: the active gauge drains to zero
+    dump = _wanfed_metrics()
+    active = [g for g in dump["Gauges"]
+              if g["Name"] == "consul.wanfed.gateway.active"
+              and g["Labels"].get("gateway") == "t-gw"]
+    assert active and active[0]["Value"] == 0.0
+
+
+def test_observed_gateway_journals_failed_dial():
+    from consul_tpu import flight
+    dead = socket.socket()
+    dead.bind(("127.0.0.1", 0))
+    port = dead.getsockname()[1]
+    dead.close()
+    gw = MeshGatewayForwarder("127.0.0.1", port, dc="dc9",
+                              gw_name="dead-gw")
+    gw.start()
+    rec = flight.FlightRecorder(forward_to_log=False)
+    try:
+        with flight.use(rec):
+            with socket.create_connection(gw.address, timeout=5) as s:
+                try:
+                    assert s.recv(10) == b""
+                except OSError:
+                    pass
+            deadline = time.time() + 3.0
+            while time.time() < deadline and \
+                    not rec.read(name="wanfed.splice.failed"):
+                time.sleep(0.05)
+        failed = rec.read(name="wanfed.splice.failed")
+        assert failed and failed[0]["labels"]["dc"] == "dc9"
+        assert failed[0]["labels"]["error"]
+    finally:
+        gw.stop()
+
+
+def test_unlabeled_gateway_stays_silent(wanfed_pair):
+    """No dc => no observability: the chaos LinkProxy interposer runs
+    on this machinery and a seeded scenario's journal must stay
+    byte-identical — raft-frame splices may not journal."""
+    from consul_tpu import flight
+    _, a2, _ = wanfed_pair
+    gw = MeshGatewayForwarder("127.0.0.1", a2.api.port)
+    gw.start()
+    rec = flight.FlightRecorder(forward_to_log=False)
+    try:
+        with flight.use(rec):
+            with socket.create_connection(gw.address, timeout=10) as s:
+                s.sendall(b"GET /v1/status/leader HTTP/1.1\r\n"
+                          b"Host: x\r\nConnection: close\r\n\r\n")
+                while s.recv(4096):
+                    pass
+            time.sleep(0.2)
+        assert rec.read(name="wanfed.splice.opened") == []
+    finally:
+        gw.stop()
+
+
+def test_trace_sniffer_parses_and_rejects():
+    sniff = MeshGatewayForwarder._sniff_trace
+    tid = "ab" * 16
+    assert sniff(b"PUT /v1/kv/x HTTP/1.1\r\nX-Consul-Trace-Id: "
+                 + tid.encode() + b"\r\n\r\n") == tid
+    # case-insensitive, LF-only tolerant
+    assert sniff(b"GET / HTTP/1.1\nx-consul-trace-id: " + tid.encode()
+                 + b"\n\n") == tid
+    # absent / malformed ids degrade to "" (uncorrelated, not wrong)
+    assert sniff(b"GET / HTTP/1.1\r\n\r\n") == ""
+    assert sniff(b"X-Consul-Trace-Id: not hex!\r\n") == ""
+    assert sniff(b"\x00\xff raw raft frame bytes") == ""
+
+
 def test_forwarder_no_thread_leak_over_many_connections():
     port, die = echo_upstream()
     gw = MeshGatewayForwarder("127.0.0.1", port)

@@ -350,6 +350,22 @@ def run_check() -> int:
     if not lkrow["ok"]:
         failures.append("guard judged the locks artifact stamp keys "
                         "instead of tolerating them")
+    # ISSUE 15's WAN artifact stamps are metadata too:
+    # wan_visibility_probe rows carry {"wan": {dcs, dc_size, ...}} and
+    # federated captures a {"federation": {...}} stamp — a decorated
+    # within-threshold row must be tolerated-not-judged (and the probe
+    # stamps topology like BENCH_BASELINE rows, which the topology
+    # refusal above already gates)
+    wanrow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                     "wan": {"dcs": 2, "dc_size": 3,
+                             "cross_dc_ms": {"p50": 4.2, "p99": 19.0},
+                             "correlated": True},
+                     "federation": {"dcs": ["dc1", "dc2"],
+                                    "degraded": []}}],
+                   fake_base)
+    if not wanrow["ok"]:
+        failures.append("guard judged the wan/federation artifact "
+                        "stamp keys instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

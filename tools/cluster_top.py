@@ -1,17 +1,25 @@
-"""cluster_top: one-screen live view of a whole cluster.
+"""cluster_top: one-screen live view of a whole cluster — or a WAN.
 
     python tools/cluster_top.py http://127.0.0.1:8501 http://127.0.0.1:8502 ...
     python tools/cluster_top.py --json URL...          # machine-readable
     python tools/cluster_top.py --watch 2 URL...       # refresh loop
     python tools/cluster_top.py --events 20 URL...     # timeline tail
+    python tools/cluster_top.py --wan dc1=URL|URL,dc2=URL|URL
 
 The `consul operator`-flavored CLI over `consul_tpu/introspect.py`
 (the same merge the /v1/internal/ui/cluster-metrics endpoint serves):
 leader + per-node commit-index table, the leader's per-peer
 replication lag (entries + ms), the commit-to-visibility stage
-quantiles (`consul.kv.visibility{stage}` p50/p99), and the merged
-cross-node flight-recorder tail.  Dead nodes render as dead rows —
-this is an incident tool; partial clusters are the point.
+quantiles (`consul.kv.visibility{stage,dc}` p50/p99), and the merged
+cross-node flight-recorder tail.  Dead nodes render as DEAD rows and
+half-answering nodes as DEGRADED rows (never absences) — this is an
+incident tool; partial clusters are the point.
+
+`--wan` renders the federated multi-DC view instead
+(introspect.federation_view, the /v1/internal/ui/federation merge):
+one row per DC — leader, alive/degraded counts, the leader's worst
+replication lag, wakeup visibility quantiles — plus the per-DC node
+tables and one dc-tagged cross-DC timeline.
 """
 
 from __future__ import annotations
@@ -27,20 +35,32 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 
+def _state(n: dict) -> str:
+    if not n.get("alive"):
+        return "dead"
+    if n.get("degraded"):
+        return "DEGRADED"
+    return "ok"
+
+
 def render(view: dict, events_tail: int = 0) -> str:
     out = []
     leader = view.get("leader")
     out.append(f"cluster: {len(view['nodes'])} nodes, "
                f"leader={leader or '<none>'}")
-    out.append(f"{'NODE':<12} {'ROLE':<9} {'ALIVE':<6} "
+    out.append(f"{'NODE':<12} {'ROLE':<9} {'STATE':<9} "
                f"{'INDEX':>8} {'BLOCKED':>8}  URL")
     for name, n in sorted(view["nodes"].items()):
         role = "leader" if n.get("leader") else "follower"
         idx = n.get("index")
-        out.append(
-            f"{name:<12} {role:<9} {str(n['alive']).lower():<6} "
+        state = _state(n)
+        line = (
+            f"{name:<12} {role:<9} {state:<9} "
             f"{int(idx) if idx is not None else '-':>8} "
             f"{int(n['blocking_queries'] or 0):>8}  {n['url']}")
+        if state == "DEGRADED":
+            line += "  [" + ",".join(n.get("degraded", [])) + "]"
+        out.append(line)
     lag = view.get("replication_lag") or {}
     if lag:
         out.append("replication lag (leader view):")
@@ -67,26 +87,70 @@ def render(view: dict, events_tail: int = 0) -> str:
     return "\n".join(out)
 
 
+def render_wan(view: dict, events_tail: int = 0) -> str:
+    """The federated view: one summary row per DC, then each DC's
+    node table (degraded/dead rows rendered distinctly)."""
+    out = [f"federation: {len(view['dcs'])} DCs"]
+    out.append(f"{'DC':<8} {'LEADER':<12} {'ALIVE':>5} {'DEGRADED':>9} "
+               f"{'LAG_MS':>8} {'WAKEUP_P50':>11} {'WAKEUP_P99':>11}")
+    for dc, row in sorted(view["dcs"].items()):
+        p50 = row.get("wakeup_p50_ms")
+        p99 = row.get("wakeup_p99_ms")
+        out.append(
+            f"{dc:<8} {row.get('leader') or '<none>':<12} "
+            f"{row['alive']:>3}/{len(row['nodes']):<1} "
+            f"{len(row['degraded']):>9} "
+            f"{row.get('lag_ms_max', 0.0):>8.1f} "
+            f"{p50 if p50 is not None else '-':>11} "
+            f"{p99 if p99 is not None else '-':>11}")
+    for dc, row in sorted(view["dcs"].items()):
+        out.append(f"-- {dc} " + "-" * 40)
+        out.append(render({"nodes": row["nodes"],
+                           "leader": row.get("leader"),
+                           "replication_lag": row["replication_lag"],
+                           "visibility": row["visibility"]}))
+    if events_tail:
+        out.append(f"wan timeline (last {events_tail}):")
+        for e in view.get("events", [])[-events_tail:]:
+            kv = " ".join(f"{k}={v}"
+                          for k, v in (e["labels"] or {}).items())
+            out.append(f"  {e['ts']:.3f} {e.get('dc', '?'):<6} "
+                       f"{e['node']:<12} {e['name']} {kv}")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("nodes", nargs="+", help="node HTTP base URLs")
+    ap.add_argument("nodes", nargs="+",
+                    help="node HTTP base URLs, or with --wan "
+                         "dc=url|url specs (comma- or space-separated)")
     ap.add_argument("--json", action="store_true",
                     help="print the raw merged view as JSON")
     ap.add_argument("--watch", type=float, default=0.0,
                     help="refresh every N seconds until interrupted")
     ap.add_argument("--events", type=int, default=10,
                     help="timeline tail length (0 = off)")
+    ap.add_argument("--wan", action="store_true",
+                    help="treat args as dc=url|url specs and render "
+                         "the federated multi-DC view")
     args = ap.parse_args(argv)
 
     from consul_tpu import introspect
     while True:
-        view = introspect.cluster_view(args.nodes,
-                                       events_limit=max(args.events,
-                                                        10))
+        if args.wan:
+            spec = introspect.parse_dc_spec(",".join(args.nodes))
+            view = introspect.federation_view(
+                spec, events_limit=max(args.events, 10))
+            text = render_wan(view, events_tail=args.events)
+        else:
+            view = introspect.cluster_view(args.nodes,
+                                           events_limit=max(args.events,
+                                                            10))
+            text = render(view, events_tail=args.events)
         if args.json:
             print(json.dumps(view, indent=2, sort_keys=True))
         else:
-            print(render(view, events_tail=args.events))
+            print(text)
         if not args.watch:
             return 0
         try:

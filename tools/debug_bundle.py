@@ -19,6 +19,11 @@ through `consul_tpu/introspect.py` into per-node subdirs, plus ONE
 merged `cluster_events.jsonl` timeline and the leader/lag
 `cluster_view.json` — the whole-cluster incident capture the
 single-process archive cannot give.
+
+`--wan dc1=URL|URL,dc2=URL|...` captures a whole FEDERATION: every
+DC's fleet scraped in one pass into per-DC subdirs (`dc/node/...`),
+plus the merged `federation_view.json` (the /v1/internal/ui/federation
+shape) and one dc-tagged `wan_events.jsonl` cross-DC timeline.
 """
 
 from __future__ import annotations
@@ -47,6 +52,71 @@ REQUIRED_SECTIONS = ("host.json", "logs.txt", "0/metrics.json",
 CLUSTER_SECTIONS = ("cluster_view.json", "cluster_events.jsonl")
 CLUSTER_NODE_SECTIONS = ("metrics.json", "events.jsonl",
                          "profile.json", "raft.json")
+
+# merged sections a --wan bundle must carry (per-DC/per-node subdirs
+# reuse CLUSTER_NODE_SECTIONS under dc/node/)
+WAN_SECTIONS = ("federation_view.json", "wan_events.jsonl")
+
+
+def _tar_add(tar, name: str, data: bytes) -> None:
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    info.mtime = int(time.time())
+    tar.addfile(info, io.BytesIO(data))
+
+
+def build_wan(out_path: str, spec: str,
+              events_limit: int = 500) -> dict:
+    """Scrape every DC's fleet once via introspect.scrape_federation,
+    archive dc/node subdirs + the merged federation view + the
+    dc-tagged cross-DC timeline; returns a summary row."""
+    from consul_tpu import introspect
+    t0 = time.perf_counter()
+    dc_nodes = introspect.parse_dc_spec(spec)
+    # ONE scrape pass feeds the per-node subdirs AND the merged view —
+    # mid-incident a dead WAN link costs one timeout per node, and
+    # federation_view.json cannot disagree with the archived rows
+    scraped = introspect.scrape_federation(dc_nodes,
+                                           events_limit=events_limit)
+    view = introspect.federation_from_scrapes(scraped)
+    merged = view["events"]
+    view = dict(view)
+    view["events"] = []      # wan_events.jsonl carries the timeline
+    nodes = {}
+    with tarfile.open(out_path, "w:gz") as tar:
+        _tar_add(tar, "federation_view.json",
+                 json.dumps(view, indent=2, sort_keys=True).encode())
+        _tar_add(tar, "wan_events.jsonl", "".join(
+            json.dumps({"ts": e["ts"], "dc": e.get("dc"),
+                        "node": e["node"], "name": e["name"],
+                        "labels": e["labels"]}, sort_keys=True) + "\n"
+            for e in merged).encode())
+        for dc, rows in sorted(scraped.items()):
+            for name, row in rows:
+                nodes[f"{dc}/{name}"] = row["alive"]
+                _tar_add(tar, f"{dc}/{name}/metrics.json",
+                         json.dumps(row["metrics"], indent=2).encode())
+                _tar_add(tar, f"{dc}/{name}/events.jsonl", "".join(
+                    json.dumps(e, sort_keys=True) + "\n"
+                    for e in row["events"]).encode())
+                _tar_add(tar, f"{dc}/{name}/profile.json",
+                         json.dumps(row["profile"], indent=2).encode())
+                _tar_add(tar, f"{dc}/{name}/raft.json",
+                         json.dumps(row["raft"], indent=2).encode())
+    wall = time.perf_counter() - t0
+    with tarfile.open(out_path, "r:gz") as tar:
+        names = tar.getnames()
+    missing = [s for s in WAN_SECTIONS if s not in names]
+    for dc, rows in scraped.items():
+        for name, row in rows:
+            if row["alive"]:
+                missing += [f"{dc}/{name}/{s}"
+                            for s in CLUSTER_NODE_SECTIONS
+                            if f"{dc}/{name}/{s}" not in names]
+    return {"out": out_path,
+            "bytes": os.path.getsize(out_path),
+            "wall_s": round(wall, 3), "sections": names,
+            "nodes": nodes, "missing": missing, "ok": not missing}
 
 
 def build_cluster(out_path: str, urls: list,
@@ -141,8 +211,14 @@ def main(argv=None) -> int:
     ap.add_argument("--cluster", default=None, metavar="URL,URL,...",
                     help="scrape a LIVE fleet's HTTP surfaces instead "
                          "of capturing this process")
+    ap.add_argument("--wan", default=None,
+                    metavar="dc1=URL|URL,dc2=URL,...",
+                    help="scrape a whole FEDERATION: per-DC subdirs + "
+                         "merged federation_view.json/wan_events.jsonl")
     args = ap.parse_args(argv)
-    if args.cluster:
+    if args.wan:
+        row = build_wan(args.out, args.wan)
+    elif args.cluster:
         row = build_cluster(args.out,
                             [u for u in args.cluster.split(",") if u])
     else:

@@ -92,6 +92,20 @@ def main():
                          "every cluster member: enables the "
                          "/v1/internal/ui/cluster-metrics federation "
                          "endpoint (consul_tpu/introspect.py)")
+    ap.add_argument("--dc", default="dc1",
+                    help="this server's datacenter: the ?dc= "
+                         "forwarding identity and the {dc} label on "
+                         "every visibility sample/span (ISSUE 15)")
+    ap.add_argument("--wanfed", action="store_true",
+                    help="route ?dc= forwarding through the target "
+                         "DC's mesh gateway from replicated federation "
+                         "states (consul_tpu/wanfed.py) instead of "
+                         "requiring a direct route")
+    ap.add_argument("--federation-http", default=None,
+                    help="dc1=url|url,dc2=url|... HTTP addresses of "
+                         "every DC's servers: enables the "
+                         "/v1/internal/ui/federation multi-DC view "
+                         "(introspect.federation_view)")
     ap.add_argument("--rate-limit", default=None,
                     help='overload defense config '
                          '(consul_tpu/ratelimit.py), e.g. '
@@ -127,12 +141,18 @@ def main():
                     seed=zlib.crc32(args.node.encode()) & 0xFFFF,
                     data_dir=args.data_dir, storage_io=storage_io)
     server.serve_rpc(host=my_rpc[0], port=my_rpc[1])
-    api = ApiServer(server, node_name=args.node, port=args.http_port)
+    api = ApiServer(server, node_name=args.node, port=args.http_port,
+                    dc=args.dc)
+    if args.wanfed:
+        api.wan_fed_via_gateways = True
     if args.cluster_http:
         api.cluster_nodes = {
             name: url for name, url in
             (part.split("=", 1) for part in
              args.cluster_http.split(",") if part)}
+    if args.federation_http:
+        from consul_tpu.introspect import parse_dc_spec
+        api.federation_nodes = parse_dc_spec(args.federation_http)
     limit_spec = args.rate_limit \
         or os.environ.get("CONSUL_TPU_RATE_LIMIT")
     if limit_spec:

@@ -1,0 +1,281 @@
+"""WAN visibility probe: cross-DC write -> remote watch wakeup, live.
+
+    python tools/wan_visibility_probe.py                  # full sweep
+    python tools/wan_visibility_probe.py --watchers 1 4 8 --writes 24
+    python tools/wan_visibility_probe.py --check          # bounded CI shape
+    python tools/wan_visibility_probe.py --out WANVIS_r01.json
+
+Drives the ISSUE 15 2-DC federation (chaos_live.LiveWan: each DC a
+REAL multi-process server cluster, ALL cross-DC traffic spliced
+through per-DC mesh gateways) with N parked blocking watchers on DC2,
+streams writes into DC1 with ?dc=dc2 — every write crosses the WAN
+through dc2's gateway before it can wake anyone — and measures:
+
+  * client-observed cross-DC end-to-end latency per delivery (PUT
+    issued against DC1 -> DC2 watcher's blocking GET returns the new
+    value), p50/p99 per watcher-count sweep point;
+  * DC2's own dc-labeled `consul.kv.visibility{stage,dc}` histograms
+    and DC1's `consul.wanfed.forward{src_dc,dst_dc}` counter, scraped
+    via introspect after each point;
+  * the gateway's WAN SLIs from THIS process (the gateways run in the
+    harness): `consul.wanfed.gateway.{active,bytes,dial_ms}` and the
+    `wanfed.splice.opened` flight events;
+  * the correlated-trace proof per point: ONE trace id spans the DC1
+    HTTP write (http.request + wanfed.forward spans in DC1's ring),
+    the gateway splice (wanfed.splice.opened stamped with the sniffed
+    id), and DC2's apply->publish->wakeup->flush (dc2-labeled
+    kv.visibility spans in DC2's ring) — fetched with the ?since=
+    span cursor, not a ring re-download.
+
+The emitted WANVIS_r01.json is the baseline the ROADMAP item-4
+`live_wan_partition` chaos family and the federated ACL-divergence
+work will be judged against.  Each sweep point runs a FRESH federation
+so per-stage reservoirs are not blended across fan-out levels; rows
+carry a {"wan": ...} stamp plus the BENCH_BASELINE-style topology
+stamp so bench_guard tolerates-not-judges them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+PROBE_KEY = "wan/probe"
+
+
+def pctl(values, q: float) -> float:
+    """Nearest-rank percentile (telemetry._Sample's rule)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, max(0, int(q * len(s))))]
+
+
+def topology_stamp() -> dict:
+    """The BENCH_BASELINE-shaped WHERE-did-this-number-come-from row."""
+    import jax
+    return {"backend": jax.default_backend(),
+            "devices": 1, "mesh_shape": None}
+
+
+def _watcher(client, stop, seen, lock):
+    """One parked cross-DC blocking watcher on a DC2 server."""
+    from consul_tpu.api.client import ApiError
+    cursor = 0
+    while not stop.is_set():
+        try:
+            row, idx = client.kv_get(PROBE_KEY, index=cursor or None,
+                                     wait="5s")
+        except (ApiError, OSError):
+            if stop.is_set():
+                return
+            time.sleep(0.05)
+            continue
+        now = time.time()
+        cursor = max(cursor, idx, 1)
+        if row is None:
+            continue
+        val = row["Value"].decode()
+        with lock:
+            seen.setdefault(val, []).append(now)
+
+
+def _counter(name_prefix: str, dump: dict) -> float:
+    return sum(c["Count"] for c in (dump or {}).get("Counters", [])
+               if c["Name"].startswith(name_prefix))
+
+
+def run_point(n_watchers: int, writes: int, pace_s: float,
+              data_root: str, dc_size: int = 3, seed: int = 0) -> dict:
+    import urllib.request
+
+    from consul_tpu import flight, introspect, telemetry
+    from consul_tpu.chaos_live import LiveWan
+    from consul_tpu.trace import new_trace_id
+
+    wan = LiveWan(data_root=data_root, dcs=("dc1", "dc2"), n=dc_size)
+    stop = threading.Event()
+    threads = []
+    try:
+        wan.start()
+        dc1, dc2 = wan.clusters["dc1"], wan.clusters["dc2"]
+        dc1_url = dc1.servers[0].http
+        seen: dict = {}
+        lock = threading.Lock()
+        for w in range(n_watchers):
+            # watchers round-robin over DC2's servers: the remote DC's
+            # whole fleet carries the parked cross-DC read load
+            srv = dc2.servers[w % len(dc2.servers)]
+            t = threading.Thread(
+                target=_watcher,
+                args=(dc2.client(srv, timeout=8.0), stop, seen, lock),
+                name=f"wan-w{w}", daemon=True)
+            threads.append(t)
+            t.start()
+        time.sleep(0.6)          # watchers park before the first write
+        write_ts = {}
+        tid = ""
+        for i in range(writes):
+            val = f"w{seed}.{i}"
+            tid = new_trace_id()     # last write's id = the proof
+            req = urllib.request.Request(
+                f"{dc1_url}/v1/kv/{PROBE_KEY}?dc=dc2",
+                data=val.encode(), method="PUT",
+                headers={"X-Consul-Trace-Id": tid})
+            write_ts[val] = time.time()
+            urllib.request.urlopen(req, timeout=30.0).read()
+            time.sleep(pace_s)
+        time.sleep(1.2)          # drain the last WAN deliveries
+        stop.set()
+        # ---- the correlated-trace proof: spans from BOTH DCs' rings
+        # (cursored via ?since=/trace_id=), the gateway's splice event
+        from consul_tpu.api.client import Client
+        dc1_spans, _ = Client(dc1_url, timeout=8.0).agent_traces(
+            trace_id=tid)
+        dc2_spans = []
+        for srv in dc2.servers:
+            try:
+                spans, _ = Client(srv.http, timeout=8.0).agent_traces(
+                    trace_id=tid)
+                dc2_spans.extend(spans)
+            except OSError:
+                continue
+        gw_rows = flight.default_recorder().read(
+            name="wanfed.splice.opened")
+        correlated = {
+            "trace_id": tid,
+            "dc1_spans": sorted({s["name"] for s in dc1_spans}),
+            "dc2_spans": sorted({s["name"] for s in dc2_spans}),
+            "dc2_span_dcs": sorted({
+                (s.get("attrs") or {}).get("dc")
+                for s in dc2_spans
+                if s["name"].startswith("kv.visibility")}),
+            "gateway_splice_traced": any(
+                r["trace_id"] == tid for r in gw_rows),
+        }
+        # ---- per-point SLI scrapes: DC2 leader's dc-labeled stages,
+        # DC1's wanfed.forward counter, the harness-local gateway SLIs
+        li = dc2.leader()
+        scrape2 = introspect.scrape_node(dc2.servers[li].http)
+        scrape1 = introspect.scrape_node(dc1_url)
+        local = telemetry.default_registry().dump()
+        dial = [s for s in local.get("Samples", [])
+                if s["Name"] == "consul.wanfed.gateway.dial_ms"]
+        with lock:
+            lat_ms = [
+                (ts - write_ts[v]) * 1000.0
+                for v, stamps in seen.items() if v in write_ts
+                for ts in stamps]
+            delivered = sum(len(s) for v, s in seen.items()
+                            if v in write_ts)
+        return {
+            "watchers": n_watchers, "writes": writes,
+            "deliveries": delivered,
+            "cross_dc_ms": {
+                "p50": round(pctl(lat_ms, 0.5), 3),
+                "p99": round(pctl(lat_ms, 0.99), 3),
+                "max": round(max(lat_ms), 3) if lat_ms else 0.0},
+            "stages_ms": introspect.visibility_stages(
+                scrape2["metrics"]),
+            "replication_lag": introspect.replication_lag(
+                scrape2["metrics"]),
+            "wanfed": {
+                "forwards": _counter("consul.wanfed.forward",
+                                     scrape1["metrics"]),
+                "gateway_bytes": _counter("consul.wanfed.gateway.bytes",
+                                          local),
+                "splices": sum(1 for r in gw_rows),
+                "dial_ms_p50": round(dial[0]["P50"], 3) if dial
+                else None},
+            "correlated_trace": correlated,
+            "wan": {"dcs": 2, "dc_size": dc_size,
+                    "gateways": sorted(wan.gateways)},
+            "topology": topology_stamp(),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=3.0)
+        wan.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--watchers", type=int, nargs="+",
+                    default=[1, 4, 8])
+    ap.add_argument("--writes", type=int, default=24)
+    ap.add_argument("--pace", type=float, default=0.05,
+                    help="seconds between writes")
+    ap.add_argument("--dc-size", type=int, default=3,
+                    help="servers per DC")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact here (e.g. "
+                         "WANVIS_r01.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="bounded smoke: one tiny point, shape "
+                         "asserts, no artifact unless --out")
+    args = ap.parse_args(argv)
+    if args.check:
+        args.watchers, args.writes, args.dc_size = [2], 6, 2
+
+    import tempfile
+    rows = []
+    for n in args.watchers:
+        with tempfile.TemporaryDirectory(
+                prefix=f"wanvis-{n}-") as tmp:
+            row = run_point(n, args.writes, args.pace, tmp,
+                            dc_size=args.dc_size, seed=n)
+        rows.append(row)
+        print(json.dumps(row))
+    artifact = {
+        "metric": "wan_visibility",
+        "rows": rows,
+        "cores": os.cpu_count() or 1,
+        "topology": topology_stamp(),
+        "analysis": (
+            "Cross-DC write->watch-delivery latency on the live 2-DC "
+            "federation (each DC a real server cluster; every write "
+            "enters DC1, rides dc2's mesh gateway, applies in DC2, "
+            "and wakes parked DC2 watchers).  cross_dc_ms is the "
+            "client-observed PUT->blocking-GET-return including the "
+            "WAN hop; stages_ms are DC2's dc-labeled "
+            "consul.kv.visibility histograms.  Every row carries a "
+            "correlated-trace proof: one trace id spanning DC1's "
+            "http.request/wanfed.forward spans, the gateway's "
+            "wanfed.splice.opened event, and DC2's kv.visibility "
+            "spans.  Baseline for the live_wan_partition chaos family "
+            "(ROADMAP item 4)."),
+    }
+    if args.check:
+        row = rows[0]
+        c = row["correlated_trace"]
+        ok = (row["deliveries"] > 0
+              and row["cross_dc_ms"]["p50"] > 0.0
+              and "wakeup" in row["stages_ms"]
+              and "wanfed.forward" in c["dc1_spans"]
+              and any(s.startswith("kv.visibility")
+                      for s in c["dc2_spans"])
+              and c["dc2_span_dcs"] == ["dc2"]
+              and c["gateway_splice_traced"]
+              and row["wanfed"]["forwards"] >= args.writes)
+        print(json.dumps({"check": "wan_visibility_probe", "ok": ok}))
+        if not ok:
+            return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
