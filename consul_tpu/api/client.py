@@ -393,6 +393,15 @@ class Client:
         """The always-on tick profiler's EMA table + recompile count."""
         return self._call("GET", "/v1/agent/profile")[0]
 
+    def internal_xds(self, local: bool = False) -> dict:
+        """The mesh-control-plane table (/v1/internal/ui/xds, ISSUE
+        16): with `local` this node's OWN per-proxy rows
+        ({node, proxies}); without it the merged configured-fleet view
+        ({nodes, proxies}) — 404 (ApiError) when no fleet map is
+        configured on the serving node."""
+        params = {"local": "1"} if local else None
+        return self._call("GET", "/v1/internal/ui/xds", params)[0]
+
     def agent_service_register(self, name: str, service_id: Optional[str] = None,
                                port: int = 0, tags: List[str] | None = None,
                                check: Optional[dict] = None) -> None:

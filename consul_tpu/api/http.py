@@ -2269,6 +2269,29 @@ def _make_handler(srv: ApiServer):
                     events_limit=int(q.get("events_limit", 50) or 0))
                 self._send(view)
                 return True
+            if path == "/v1/internal/ui/xds" and verb == "GET":
+                # the mesh-control-plane table (ISSUE 16): per-proxy
+                # rebuild/push SLIs off the proxycfg Manager.
+                # ?local=1 serves THIS node's own table; without it
+                # the merged fleet view scrapes the same fixed
+                # configured node set as cluster-metrics (never a
+                # caller-supplied URL — the no-SSRF stance), 404 when
+                # unconfigured.  Same ACL bar as the metrics proxy
+                # (proxy ids and service names leak topology).
+                if not (self.authz.node_read_all()
+                        and self.authz.service_read_all()):
+                    return self._forbid()
+                if q.get("local"):
+                    self._send({"node": srv.node_name,
+                                "proxies": srv.proxycfg.table()})
+                    return True
+                if srv.cluster_nodes is None:
+                    self._err(404, "xds view is not enabled "
+                                   "(no cluster_nodes configured)")
+                    return True
+                from consul_tpu import introspect
+                self._send(introspect.xds_view(srv.cluster_nodes))
+                return True
             if path.startswith("/v1/internal/ui/metrics-proxy/") \
                     and verb == "GET":
                 # reverse proxy to the configured metrics provider
@@ -3314,7 +3337,7 @@ def _make_handler(srv: ApiServer):
                     prev = cache.get(min_v) if "delta" in q \
                         and min_v != snap.version else None
                 if prev is not None:
-                    self._send({
+                    delta_payload = {
                         "VersionInfo": payload["VersionInfo"],
                         "FromVersion": str(min_v),
                         "ProxyID": payload["ProxyID"],
@@ -3322,9 +3345,20 @@ def _make_handler(srv: ApiServer):
                         "Kind": payload["Kind"],
                         "Delta": xdsmod.delta(prev,
                                               payload["Resources"]),
-                    })
+                    }
+                    self._send(delta_payload)
+                    if snap.version > min_v:
+                        xdsmod.note_http_push_counters(delta_payload)
+                    state.note_push(snap)
                     return True
                 self._send(payload)
+                # after the response left the process: the HTTP flush
+                # is this transport's ADS push (apply->push stage).
+                # A wait-timeout return (version unchanged) is a
+                # re-read, not a push: no counter.
+                if snap.version > min_v:
+                    xdsmod.note_http_push_counters(payload)
+                state.note_push(snap)
                 return True
             if path == "/v1/connect/ca/roots" and verb == "GET":
                 roots, _idx, state = self._cache_or_live(

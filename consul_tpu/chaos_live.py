@@ -200,10 +200,12 @@ class LiveServer:
                  cluster_http: Optional[str] = None,
                  rate_limit: Optional[str] = None,
                  dc: Optional[str] = None,
-                 wanfed: bool = False):
+                 wanfed: bool = False,
+                 grpc_port: Optional[int] = None):
         self.name = name
         self.rpc_port = rpc_port
         self.http_port = http_port
+        self.grpc_port = grpc_port
         self.data_dir = data_dir
         self.peers_spec = peers_spec
         self.storage_faults = storage_faults
@@ -222,6 +224,13 @@ class LiveServer:
     def http(self) -> str:
         return f"http://127.0.0.1:{self.http_port}"
 
+    @property
+    def grpc(self) -> Optional[str]:
+        """host:port of the gRPC ADS plane, None when not enabled."""
+        if self.grpc_port is None:
+            return None
+        return f"127.0.0.1:{self.grpc_port}"
+
     def alive(self) -> bool:
         return self.proc is not None and self.proc.poll() is None
 
@@ -234,6 +243,8 @@ class LiveServer:
                "--node", self.name, "--peers", self.peers_spec,
                "--http-port", str(self.http_port),
                "--data-dir", self.data_dir]
+        if self.grpc_port is not None:
+            cmd += ["--grpc-port", str(self.grpc_port)]
         if self.storage_faults:
             cmd += ["--storage-faults", self.storage_faults]
         if self.cluster_http:
@@ -328,19 +339,23 @@ class LiveCluster:
                  storage_faults: Optional[str] = None,
                  rate_limit: Optional[str] = None,
                  dc: Optional[str] = None,
-                 wanfed: bool = False):
+                 wanfed: bool = False,
+                 grpc: bool = False):
         self.n = n
         self.dc = dc
-        # one reservation batch held CONCURRENTLY: rpc and http ports
-        # are guaranteed distinct, and the proxies bind their own
-        # ephemeral ports while the reservations are still held, so
-        # the kernel cannot hand a proxy a reserved server port
-        socks = [socket.socket() for _ in range(2 * n)]
+        # one reservation batch held CONCURRENTLY: rpc, http (and grpc
+        # when enabled) ports are guaranteed distinct, and the proxies
+        # bind their own ephemeral ports while the reservations are
+        # still held, so the kernel cannot hand a proxy a reserved
+        # server port
+        batch = 3 * n if grpc else 2 * n
+        socks = [socket.socket() for _ in range(batch)]
         try:
             for s in socks:
                 s.bind(("127.0.0.1", 0))
             ports = [s.getsockname()[1] for s in socks]
-            rpc, http = ports[:n], ports[n:]
+            rpc, http = ports[:n], ports[n:2 * n]
+            grpc_ports = ports[2 * n:] if grpc else [None] * n
             self.proxies: Dict[Tuple[int, int], LinkProxy] = {}
             for i in range(n):
                 for j in range(n):
@@ -369,7 +384,7 @@ class LiveCluster:
                 os.path.join(data_root, f"server{i}"), ",".join(parts),
                 storage_faults=storage_faults,
                 cluster_http=cluster_http, rate_limit=rate_limit,
-                dc=dc, wanfed=wanfed))
+                dc=dc, wanfed=wanfed, grpc_port=grpc_ports[i]))
 
     # ------------------------------------------------------------ lifecycle
 

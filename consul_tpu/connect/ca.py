@@ -23,15 +23,28 @@ FSM like the reference's raft-backed CA tables.
 
 from __future__ import annotations
 
+import base64
 import datetime
+import json
 import threading
 import uuid
 from typing import Dict, List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:                                 # pragma: no cover
+    # the container may not ship `cryptography`; the mesh control
+    # plane (proxycfg snapshots, xDS pushes, intentions→RBAC) must
+    # still run, so a structurally-faithful stub provider takes over
+    # (PEM-shaped blobs, issuer chains, validity windows — no real
+    # crypto).  Anything needing true X.509 (external providers, JWT
+    # auth-methods) raises at use, not at import.
+    x509 = hashes = serialization = ec = NameOID = None
+    HAVE_CRYPTOGRAPHY = False
 
 _BACKDATE = datetime.timedelta(minutes=5)   # clock-skew allowance
 
@@ -218,6 +231,120 @@ class BuiltinCA(CAProvider):
         return cross.public_bytes(serialization.Encoding.PEM).decode()
 
 
+def _stub_pem(kind: str, payload: dict) -> str:
+    """PEM-shaped wrapper over a JSON payload: base64 body between the
+    canonical armor lines, so anything that greps for BEGIN/END
+    markers or ships certs around as opaque strings keeps working."""
+    body = base64.b64encode(
+        json.dumps(payload, sort_keys=True).encode()).decode()
+    lines = [body[i:i + 64] for i in range(0, len(body), 64)]
+    return (f"-----BEGIN {kind}-----\n" + "\n".join(lines)
+            + f"\n-----END {kind}-----\n")
+
+
+def _stub_payload(pem: str) -> dict:
+    body = "".join(ln for ln in pem.splitlines()
+                   if ln and not ln.startswith("-----"))
+    return json.loads(base64.b64decode(body))
+
+
+class StubBuiltinCA(CAProvider):
+    """`cryptography`-free builtin provider: the same surface as
+    BuiltinCA with deterministic PEM-shaped blobs instead of X.509.
+    Issuer chains, validity windows, SPIFFE URI SANs, and cross-signed
+    bridges all behave structurally (verify_leaf checks issuer +
+    window), which is what the proxycfg/xDS plane needs; only the
+    bytes aren't real certificates."""
+
+    name = "consul"
+
+    def __init__(self, trust_domain: str, dc: str = "dc1",
+                 root_ttl_days: int = 3650, leaf_ttl_hours: int = 72,
+                 serial: int = 1,
+                 key_pem: Optional[str] = None,
+                 cert_pem: Optional[str] = None):
+        if (key_pem is None) != (cert_pem is None):
+            raise ValueError("CA cert and key must be supplied together")
+        self.trust_domain = trust_domain
+        self.dc = dc
+        self.leaf_ttl_hours = leaf_ttl_hours
+        self.id = f"root-{serial}"
+        if cert_pem is not None:
+            payload = _stub_payload(cert_pem)
+            self._subject = payload["subject"]
+            self._cert_payload = payload
+            return
+        now = _utcnow().timestamp()
+        self._subject = f"Consul CA {serial} {uuid.uuid4().hex[:12]}"
+        self._cert_payload = {
+            "subject": self._subject, "issuer": self._subject,
+            "serial": uuid.uuid4().hex, "ca": True,
+            "not_before": now - _BACKDATE.total_seconds(),
+            "not_after": now + root_ttl_days * 86400.0,
+            "uris": [f"spiffe://{trust_domain}"],
+        }
+
+    @property
+    def cert_pem(self) -> str:
+        return _stub_pem("CERTIFICATE", self._cert_payload)
+
+    @property
+    def key_pem(self) -> str:
+        return _stub_pem("PRIVATE KEY",
+                         {"subject": self._subject, "stub": True})
+
+    def spiffe_id(self, service: str) -> str:
+        return (f"spiffe://{self.trust_domain}/ns/default/dc/{self.dc}"
+                f"/svc/{service}")
+
+    def sign(self, common_name: str, sans: list,
+             ttl: datetime.timedelta) -> Tuple[str, str]:
+        now = _utcnow().timestamp()
+        cert = _stub_pem("CERTIFICATE", {
+            "subject": common_name, "issuer": self._subject,
+            "serial": uuid.uuid4().hex, "ca": False,
+            "not_before": now - _BACKDATE.total_seconds(),
+            "not_after": now + ttl.total_seconds(),
+            "uris": [str(s) for s in sans],
+        })
+        key = _stub_pem("PRIVATE KEY",
+                        {"subject": common_name, "stub": True})
+        return cert, key
+
+    def sign_leaf(self, service: str) -> Tuple[str, str]:
+        return self.sign(
+            service, [self.spiffe_id(service)],
+            datetime.timedelta(hours=self.leaf_ttl_hours))
+
+    def verify_leaf(self, cert_pem: str) -> bool:
+        try:
+            payload = _stub_payload(cert_pem)
+        except Exception:
+            return False
+        now = _utcnow().timestamp()
+        return (payload.get("issuer") == self._subject
+                and payload.get("not_before", 0.0) <= now
+                <= payload.get("not_after", 0.0))
+
+    def cross_sign(self, cert_pem: str) -> str:
+        other = _stub_payload(cert_pem)
+        now = _utcnow().timestamp()
+        return _stub_pem("CERTIFICATE", {
+            "subject": other["subject"], "issuer": self._subject,
+            "serial": uuid.uuid4().hex, "ca": True,
+            "not_before": now - _BACKDATE.total_seconds(),
+            "not_after": other.get("not_after", now),
+            "uris": other.get("uris", []),
+        })
+
+
+def new_builtin_ca(*args, **kwargs) -> CAProvider:
+    """The builtin provider for this interpreter: real X.509 when
+    `cryptography` is importable, the structural stub otherwise."""
+    cls = BuiltinCA if HAVE_CRYPTOGRAPHY else StubBuiltinCA
+    return cls(*args, **kwargs)
+
+
 class ExternalCA(BuiltinCA):
     """Operator-supplied root material (the Vault / ACM-PCA provider
     shape, provider_vault.go — minus the network fetch: in a no-egress
@@ -275,8 +402,8 @@ class CAManager:
         self._lock = threading.Lock()
         self._serial = 1
         self._roots: List[CAProvider] = [
-            BuiltinCA(self.trust_domain, dc, serial=1,
-                      leaf_ttl_hours=leaf_ttl_hours)]
+            new_builtin_ca(self.trust_domain, dc, serial=1,
+                           leaf_ttl_hours=leaf_ttl_hours)]
         # cross-signed bridge certs per root id (rotation trust path)
         self._cross_signed: Dict[str, str] = {}
         # leaf-CSR token bucket (server.go:148 csrRateLimiter);
@@ -318,9 +445,9 @@ class CAManager:
         leader_connect_ca.go)."""
         with self._lock:
             self._serial += 1
-            new = BuiltinCA(self.trust_domain, self.dc,
-                            serial=self._serial,
-                            leaf_ttl_hours=self.leaf_ttl_hours)
+            new = new_builtin_ca(self.trust_domain, self.dc,
+                                 serial=self._serial,
+                                 leaf_ttl_hours=self.leaf_ttl_hours)
             self._activate_locked(new)
             return new.id
 
@@ -333,10 +460,14 @@ class CAManager:
         with self._lock:
             self._serial += 1
             if provider in ("consul", "builtin"):
-                new: CAProvider = BuiltinCA(
+                new: CAProvider = new_builtin_ca(
                     self.trust_domain, self.dc, serial=self._serial,
                     leaf_ttl_hours=self.leaf_ttl_hours)
             elif provider == "external":
+                if not HAVE_CRYPTOGRAPHY:
+                    raise ValueError(
+                        "external CA provider requires the "
+                        "'cryptography' package")
                 new = ExternalCA(
                     self.trust_domain,
                     cert_pem=config.get("RootCert", ""),

@@ -89,9 +89,12 @@ class ServerFSM:
                                                   node_id)}
 
     def _register_service(self, node, service_id, name, port=0, tags=None,
-                          meta=None, address=""):
+                          meta=None, address="", kind="", proxy=None):
+        # kind/proxy carry the mesh shape (connect-proxy sidecars +
+        # gateways); absent in older log entries, so they default
         return {"index": self.store.register_service(
-            node, service_id, name, port, tags, meta, address)}
+            node, service_id, name, port, tags, meta, address,
+            kind=kind, proxy=proxy)}
 
     def _register_check(self, node, check_id, name, status="critical",
                         service_id="", output=""):

@@ -148,6 +148,19 @@ def capture(agent=None, intervals: int = 2,
             add(f"{i}/threads.txt", thread_dump().encode())
             if i < intervals - 1:
                 time.sleep(interval_s)
+        # the mesh-control-plane table (ISSUE 16): the agent's
+        # per-proxy rebuild/push SLI rows; empty without an agent (the
+        # section always exists so bundle consumers need no probing)
+        xds_rows: list = []
+        if agent is not None:
+            try:
+                api = getattr(agent, "api", None)
+                if api is not None:
+                    xds_rows = api.proxycfg.table()
+            except Exception:
+                pass
+        add("xds.json", json.dumps({"proxies": xds_rows},
+                                   indent=2).encode())
         # the rings LAST: they then include spans/events recorded
         # during the capture window itself
         add("trace.json", json.dumps(trace.dump(), indent=2).encode())

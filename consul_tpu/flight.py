@@ -165,6 +165,21 @@ CATALOG: Dict[str, dict] = {
     "stream.subscriber.evicted": {"severity": "warn",
                                   "labels": ("topic", "count",
                                              "depth")},
+    # mesh control plane (consul_tpu/proxycfg.py / xds_grpc.py,
+    # ISSUE 16): a proxy snapshot rebuild (staged off the proxycfg
+    # condition, trace id inherited from the triggering stream Event),
+    # an ADS NACK (the client REJECTED a pushed config — the xds
+    # server logs-and-waits, so the journal is where the rejection
+    # becomes visible), and a rebuild/push stage lagging its raft
+    # apply past the stall budget (the xds twin of
+    # kv.visibility.stall)
+    "xds.rebuild": {"severity": "info",
+                    "labels": ("proxy", "kind", "version", "index")},
+    "xds.push.nack": {"severity": "warn",
+                      "labels": ("proxy", "type", "detail")},
+    "xds.visibility.stall": {"severity": "warn",
+                             "labels": ("stage", "index", "ms",
+                                        "proxy_kind")},
     # lock-discipline plane (consul_tpu/locks.py, audit mode): an
     # acquisition that waited past the contention threshold, a hold
     # past the hold budget, and an observed acquisition-order cycle —

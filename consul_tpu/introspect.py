@@ -219,6 +219,68 @@ def visibility_stages(dump: dict) -> Dict[str, dict]:
     return out
 
 
+def xds_stages(dump: dict) -> Dict[str, dict]:
+    """{stage: {p50_ms, p99_ms, count}} from a node's metrics dump —
+    the consul.xds.visibility summary (rebuild|push), merged across
+    proxy-kind label sets per stage (max quantile, summed count)."""
+    _, samples = _metric_maps(dump)
+    out: Dict[str, dict] = {}
+    for (name, lk), s in samples.items():
+        if name != "consul.xds.visibility":
+            continue
+        stage = dict(lk).get("stage")
+        if not stage:
+            continue
+        cur = out.setdefault(stage, {"p50_ms": 0.0, "p99_ms": 0.0,
+                                     "count": 0})
+        cur["p50_ms"] = max(cur["p50_ms"],
+                            round(s.get("P50", 0.0) * 1000.0, 3))
+        cur["p99_ms"] = max(cur["p99_ms"],
+                            round(s.get("P99", 0.0) * 1000.0, 3))
+        cur["count"] += s.get("Count", 0)
+    return out
+
+
+def xds_view(nodes: Union[List[str], Dict[str, str]]) -> dict:
+    """The merged mesh-control-plane view behind /v1/internal/ui/xds
+    (ISSUE 16): every CONFIGURED node's own per-proxy table
+    (?local=1 — the fixed fleet map, never a caller-supplied URL)
+    plus its consul.xds.visibility stage quantiles.  Dead nodes
+    degrade to an error row, the cluster-metrics stance."""
+    if isinstance(nodes, dict):
+        items = sorted(nodes.items())
+    else:
+        items = [(None, u) for u in nodes]
+    view: dict = {"nodes": {}, "proxies": []}
+    seen: Dict[str, int] = {}
+    for label, url in items:
+        c = Client(url, timeout=SCRAPE_TIMEOUT)
+        row: dict = {"url": url.rstrip("/"), "alive": False,
+                     "proxies": [], "xds_visibility": {}}
+        name = label
+        try:
+            local = c.internal_xds(local=True)
+            row["alive"] = True
+            row["proxies"] = local.get("proxies", [])
+            name = label or local.get("node") or row["url"]
+            dump = c._call("GET", "/v1/agent/metrics")[0]
+            row["xds_visibility"] = xds_stages(dump)
+        except (ApiError, OSError) as e:
+            row["error"] = str(e)
+            name = label or row["url"]
+        if name in seen:
+            seen[name] += 1
+            name = f"{name}#{seen[name]}"
+        else:
+            seen[name] = 1
+        view["nodes"][name] = row
+        for p in row["proxies"]:
+            view["proxies"].append(dict(p, node=name))
+    view["proxies"].sort(key=lambda p: (p["node"], p["proxy_id"]))
+    view["generated_at"] = round(time.time(), 3)
+    return view
+
+
 def replication_lag(dump: dict) -> Dict[str, dict]:
     """{peer: {entries, ms}} from a leader's metrics dump."""
     gauges, _ = _metric_maps(dump)

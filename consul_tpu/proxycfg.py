@@ -15,8 +15,10 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from consul_tpu import locks
 from consul_tpu.connect import intentions as imod
 
 # re-sign margin: leaves refresh well before their notAfter
@@ -90,6 +92,15 @@ class ConfigSnapshot:
         # agent/xds/config.go:28,34 envoy_public_listener_json /
         # envoy_local_cluster_json)
         self.opaque_config = opaque_config or {}
+        # commit-to-push correlation (ISSUE 16): the store index and
+        # writer trace id of the stream event that TRIGGERED this
+        # rebuild (0/"" for the initial build — nothing to correlate),
+        # plus a once-only marker the first push site to deliver this
+        # snapshot flips (under the owning state's lock) so the
+        # apply->push stage is sampled exactly once per snapshot
+        self.store_index = 0
+        self.trace_id = ""
+        self.push_emitted = False
 
 
 class ProxyState:
@@ -100,25 +111,48 @@ class ProxyState:
         self.manager = manager
         self.proxy_id = proxy_id
         self.svc = svc
-        self._cond = threading.Condition()
-        self._snapshot: Optional[ConfigSnapshot] = None
+        self.kind = svc.get("kind", "connect-proxy")
+        # one lock guards the whole per-proxy state; the condition is
+        # built OVER it so `with self._cond:` and `with self._lock:`
+        # are the same critical section (fetch parks on the condition,
+        # everything else takes the lock directly)
+        self._lock = locks.make_lock("proxycfg.state")
+        self._cond = locks.make_condition(self._lock)
+        self._snapshot: Optional[ConfigSnapshot] = None  # guarded-by: _lock
         # versions survive state replacement: a long-poller parked on
         # version N must see N+1 from the REPLACED state, not a restart
-        # at 1 it would read as no-change
+        # at 1 it would read as no-change  # guarded-by: _lock
         self._version = start_version
-        self._subs = []
+        self._subs: List[object] = []                    # guarded-by: _lock
         # ingress/terminating gateways: per-bound-service health subs,
         # resynced after each rebuild as bindings change
-        self._health_subs: Dict[str, object] = {}
-        self._running = False
+        self._health_subs: Dict[str, object] = {}        # guarded-by: _lock
+        self._running = False                            # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
+        # per-proxy SLI bookkeeping (ISSUE 16): rebuild-duration ring
+        # (p50/p99 for the /v1/internal/ui/xds table), counters, and
+        # last-activity clocks  # guarded-by: _lock
+        self._rebuild_ms = deque(maxlen=128)
+        self._rebuilds = 0                               # guarded-by: _lock
+        # shared wakeup for the follow loop: attached to EVERY
+        # subscription so one park covers the whole watch set (Event
+        # is self-synchronized; not guarded)
+        self._wake = threading.Event()
+        self._pushes = 0                                 # guarded-by: _lock
+        self._last_rebuild_ts = 0.0                      # guarded-by: _lock
+        self._last_push_ts = 0.0                         # guarded-by: _lock
+        locks.register_guards(self, self._lock, "_snapshot", "_version",
+                              "_subs", "_health_subs", "_running",
+                              "_rebuild_ms", "_rebuilds", "_pushes",
+                              "_last_rebuild_ts", "_last_push_ts")
 
     def start(self) -> None:
-        self._running = True
+        with self._lock:
+            self._running = True
         self._rebuild()
         pub = self.manager.store.publisher
         proxy = self.svc.get("proxy") or {}
-        kind = self.svc.get("kind", "connect-proxy")
+        kind = self.kind
         # CA topic included: a root rotation must rebuild every proxy
         # snapshot without waiting for unrelated churn
         topics = [("intentions", None), ("ca", None)]
@@ -153,34 +187,61 @@ class ProxyState:
             # writes anywhere must rebuild, like ingress; endpoint
             # health stays per bound service via _sync_health_subs
             topics += [("config", None), ("services", None)]
-        self._subs = [pub.subscribe(t, k, since_index=None)
-                      for t, k in topics]
+        subs = [pub.subscribe(t, k, since_index=None)
+                for t, k in topics]
+        for s in subs:
+            s.attach_wake(self._wake)
+        with self._lock:
+            stopped = not self._running
+            if not stopped:
+                self._subs = subs
+        if stopped:
+            # stop() raced start(): release the fresh subscriptions
+            # instead of leaking them on a dead state
+            for s in subs:
+                s.close()
+            return
         self._sync_health_subs()
-        self._thread = threading.Thread(target=self._follow, daemon=True)
+        self._thread = threading.Thread(
+            target=self._follow, daemon=True,
+            name=f"proxycfg-{self.proxy_id}")
         self._thread.start()
 
     def stop(self) -> None:
-        self._running = False
-        with self._cond:
+        """Idempotent, callable from any thread (a degenerate call
+        from the follow thread itself skips the self-join), and safe
+        mid-`_rebuild`: the in-flight rebuild finishes against closed
+        subscriptions and the loop exits on its next `_running`
+        check."""
+        with self._lock:
+            self._running = False
             # wake parked fetchers so they re-poll (and land on the
             # replacement state) instead of sleeping out their wait
             self._cond.notify_all()
-        for s in list(self._subs) + list(self._health_subs.values()):
+            subs = list(self._subs) + list(self._health_subs.values())
+            self._subs = []
+            self._health_subs = {}
+        self._wake.set()         # unpark the follow loop immediately
+        for s in subs:
             s.close()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
+        t = self._thread
+        self._thread = None
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
 
     def _sync_health_subs(self) -> None:
         """Re-key per-service health subscriptions to the gateway's
         CURRENT bound services (bindings change with its config entry;
         a stale watch set would miss new services or churn on dropped
-        ones).  Runs in whichever thread just rebuilt — the follow loop
-        snapshots the sub lists, so mutation here is safe."""
-        kind = self.svc.get("kind", "connect-proxy")
+        ones).  Runs in whichever thread just rebuilt; sub churn
+        happens under the state lock so a concurrent stop() can't
+        leak a freshly created subscription."""
+        kind = self.kind
         if kind not in ("ingress-gateway", "terminating-gateway",
                         "connect-proxy"):
             return
-        snap = self._snapshot
+        with self._lock:
+            snap = self._snapshot
         if kind == "connect-proxy":
             # chain split/failover targets beyond the upstreams already
             # watched at start(): their health moves chain_endpoints
@@ -200,36 +261,79 @@ class ProxyState:
                 for chain in (snap.chains if snap else {}).values():
                     want |= set(dchain.chain_target_services(chain))
         pub = self.manager.store.publisher
-        for svc in list(self._health_subs):
-            if svc not in want:
-                self._health_subs.pop(svc).close()
-        for svc in want - set(self._health_subs):
-            self._health_subs[svc] = pub.subscribe(
-                "health", svc, since_index=None)
+        drop = []
+        with self._lock:
+            if not self._running:
+                return
+            for svc in list(self._health_subs):
+                if svc not in want:
+                    drop.append(self._health_subs.pop(svc))
+            for svc in want - set(self._health_subs):
+                s = pub.subscribe("health", svc, since_index=None)
+                s.attach_wake(self._wake)
+                self._health_subs[svc] = s
+        for s in drop:
+            s.close()
 
     def _follow(self) -> None:
         from consul_tpu.stream.publisher import SnapshotRequired
-        while self._running:
+        while True:
+            with self._lock:
+                if not self._running:
+                    return
+                watched = list(self._subs) + \
+                    list(self._health_subs.values())
             fired = False
-            for s in list(self._subs) + list(self._health_subs.values()):
+            # clear-then-drain: a publish landing on ANY sub after its
+            # drain below re-sets the shared wake, so the park at the
+            # bottom returns immediately — no lost-wakeup window
+            self._wake.clear()
+            # the rebuild TRIGGER: the max-index drained event carries
+            # the writer's trace id (stream Event.trace_id) — the
+            # rebuild it causes inherits that correlation (ISSUE 16)
+            trigger: Optional[Tuple[int, str]] = None
+            for s in watched:
                 try:
-                    if s.events(timeout=0.2):
-                        fired = True
+                    # non-blocking drain of the whole watch set; the
+                    # shared wake (attached to every sub) replaces
+                    # per-sub blocking.  Serial per-sub timeouts would
+                    # stack (0.2s × topic count) onto commit-to-push
+                    # visibility for events landing on later subs —
+                    # measured at ~1.3s before the xds_bench existed
+                    evs = s.events(timeout=0.0)
                 except SnapshotRequired:
-                    if not self._running:
-                        return
+                    with self._lock:
+                        if not self._running:
+                            return
                     fired = True
-            if fired:
-                try:
-                    self._rebuild()
-                except Exception:
-                    # a transient failure (CSR rate pressure, store
-                    # contention) must not kill the follow thread and
-                    # freeze this proxy's snapshot forever; the next
-                    # event retries
-                    logging.getLogger("consul_tpu.proxycfg").warning(
-                        "proxy %s rebuild failed; will retry",
-                        self.proxy_id, exc_info=True)
+                    continue
+                if evs:
+                    fired = True
+                    for ev in evs:
+                        idx = getattr(ev, "index", 0) or 0
+                        if trigger is None or idx >= trigger[0]:
+                            trigger = (idx,
+                                       getattr(ev, "trace_id", "")
+                                       or "")
+            if not fired:
+                # nothing buffered anywhere: park on the shared wake.
+                # Bounded so a missed set (none known) can't wedge the
+                # proxy; stop() sets it for an immediate exit.
+                self._wake.wait(timeout=0.5)
+                continue
+            with self._lock:
+                if not self._running:
+                    return
+            try:
+                self._rebuild(trigger)
+            except Exception:
+                # a transient failure (CSR rate pressure, store
+                # contention) must not kill the follow thread and
+                # freeze this proxy's snapshot forever; the next
+                # event retries
+                logging.getLogger("consul_tpu.proxycfg").warning(
+                    "proxy %s rebuild failed; will retry",
+                    self.proxy_id, exc_info=True)
 
     def _connect_endpoints(self, name: str,
                            target: Optional[dict] = None) -> List[dict]:
@@ -323,15 +427,93 @@ class ProxyState:
                         "node": s.get("node", "")})
         return eps
 
-    def _rebuild(self) -> None:
-        kind = self.svc.get("kind", "connect-proxy")
+    def _rebuild(self, trigger: Optional[Tuple[int, str]] = None) -> None:
+        t0 = time.time()
+        kind = self.kind
         if kind in ("mesh-gateway", "ingress-gateway",
                     "terminating-gateway"):
-            self._rebuild_gateway(kind)
+            self._rebuild_gateway(kind, trigger)
         else:
-            self._rebuild_connect_proxy()
+            self._rebuild_connect_proxy(trigger)
+        dur_ms = (time.time() - t0) * 1000.0
+        with self._lock:
+            self._rebuild_ms.append(dur_ms)
+            self._rebuilds += 1
+            self._last_rebuild_ts = time.time()
+            version = self._version
+        # SLI emission strictly AFTER every proxycfg lock release —
+        # staged like raft's _metrics_buf; stage_xds takes only the
+        # visibility table's own lock
+        from consul_tpu import flight, telemetry
+        telemetry.incr_counter(("xds", "rebuilds"), 1,
+                               labels={"kind": kind})
+        index, tid = trigger if trigger is not None else (0, "")
+        flight.emit("xds.rebuild",
+                    labels={"proxy": self.proxy_id, "kind": kind,
+                            "version": version, "index": index},
+                    trace_id=tid or None)
+        if index:
+            vis = getattr(self.manager.store, "visibility", None)
+            if vis is not None:
+                vis.stage_xds("rebuild", index, kind, self.proxy_id)
 
-    def _rebuild_connect_proxy(self) -> None:
+    def note_push(self, snap: Optional[ConfigSnapshot]) -> None:
+        """Push-site bookkeeping, called by the ADS stream / HTTP
+        long-poll AFTER the response left this process: stamps the
+        per-proxy push clock and emits the apply->push visibility
+        stage once per snapshot (the first transport to deliver it
+        wins; stage_xds runs off every proxycfg lock)."""
+        emit_stage = False
+        with self._lock:
+            self._pushes += 1
+            self._last_push_ts = time.time()
+            if snap is not None and not snap.push_emitted \
+                    and snap.store_index:
+                snap.push_emitted = True
+                emit_stage = True
+        if not emit_stage:
+            return
+        vis = getattr(self.manager.store, "visibility", None)
+        if vis is not None:
+            vis.stage_xds("push", snap.store_index, snap.kind,
+                          self.proxy_id)
+
+    def stats(self, now: Optional[float] = None) -> dict:
+        """One per-proxy row of the /v1/internal/ui/xds table."""
+        now = time.time() if now is None else now
+        with self._lock:
+            snap = self._snapshot
+            version = self._version
+            ms = sorted(self._rebuild_ms)
+            rebuilds, pushes = self._rebuilds, self._pushes
+            last_rebuild = self._last_rebuild_ts
+            last_push = self._last_push_ts
+
+        def _pctl(q: float) -> float:
+            if not ms:
+                return 0.0
+            return round(ms[min(len(ms) - 1,
+                                max(0, int(q * len(ms))))], 3)
+
+        return {
+            "proxy_id": self.proxy_id,
+            "kind": self.kind,
+            "service": (snap.service if snap is not None
+                        else self.svc.get("name", "")),
+            "version": version,
+            "store_index": (snap.store_index if snap is not None
+                            else 0),
+            "rebuilds": rebuilds,
+            "pushes": pushes,
+            "rebuild_ms": {"p50": _pctl(0.5), "p99": _pctl(0.99)},
+            "last_rebuild_age_s": (round(now - last_rebuild, 3)
+                                   if last_rebuild else None),
+            "last_push_age_s": (round(now - last_push, 3)
+                                if last_push else None),
+        }
+
+    def _rebuild_connect_proxy(
+            self, trigger: Optional[Tuple[int, str]] = None) -> None:
         from consul_tpu import discoverychain as dchain
         from consul_tpu import servicemgr
         m = self.manager
@@ -375,7 +557,7 @@ class ProxyState:
         leaf = m.get_leaf(service)
         with self._cond:
             self._version += 1
-            self._snapshot = ConfigSnapshot(
+            snap = ConfigSnapshot(
                 proxy_id=self.proxy_id, service=service,
                 upstreams=upstreams, roots=m.ca.roots(), leaf=leaf,
                 upstream_endpoints=endpoints, intentions=relevant,
@@ -389,6 +571,9 @@ class ProxyState:
                 transparent_proxy=proxy.get("transparent_proxy")
                 or {},
                 opaque_config=proxy.get("config") or {})
+            if trigger is not None:
+                snap.store_index, snap.trace_id = trigger
+            self._snapshot = snap
             self._cond.notify_all()
         self._sync_health_subs()
 
@@ -400,7 +585,9 @@ class ProxyState:
                         for g in f.get("mesh_gateways", [])]
         return []
 
-    def _rebuild_gateway(self, kind: str) -> None:
+    def _rebuild_gateway(self, kind: str,
+                         trigger: Optional[Tuple[int, str]] = None
+                         ) -> None:
         """Per-kind gateway snapshot (proxycfg/state.go
         initialize/handleUpdate for MeshGateway / TerminatingGateway /
         IngressGateway)."""
@@ -477,7 +664,7 @@ class ProxyState:
         leaf = m.get_leaf(gw_name)
         with self._cond:
             self._version += 1
-            self._snapshot = ConfigSnapshot(
+            snap = ConfigSnapshot(
                 proxy_id=self.proxy_id, service=gw_name,
                 upstreams=[], roots=m.ca.roots(), leaf=leaf,
                 upstream_endpoints=endpoints, intentions=intentions,
@@ -489,6 +676,9 @@ class ProxyState:
                 port=self.svc.get("port", 0),
                 bind_address=self.svc.get("address", ""),
                 chains=gw_chains, chain_endpoints=gw_chain_eps)
+            if trigger is not None:
+                snap.store_index, snap.trace_id = trigger
+            self._snapshot = snap
             self._cond.notify_all()
         self._sync_health_subs()
 
@@ -515,11 +705,13 @@ class Manager:
         self.ca = ca
         self.dc = dc or getattr(ca, "dc", "dc1")
         self.default_allow = default_allow
-        # svc -> (root_id, leaf, refresh_deadline)
+        self._leaf_lock = locks.make_lock("proxycfg.leaves")
+        # svc -> (root_id, leaf, refresh_deadline)  # guarded-by: _leaf_lock
         self._leaves: Dict[str, Tuple[str, dict, float]] = {}
-        self._leaf_lock = threading.Lock()
-        self._states: Dict[str, ProxyState] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.make_lock("proxycfg.manager")
+        self._states: Dict[str, ProxyState] = {}    # guarded-by: _lock
+        locks.register_guards(self, self._leaf_lock, "_leaves")
+        locks.register_guards(self, self._lock, "_states")
 
     def get_leaf(self, service: str) -> dict:
         """Cached leaf, re-signed when missing, when the active root
@@ -551,14 +743,21 @@ class Manager:
     @staticmethod
     def _leaf_still_valid(leaf: dict) -> bool:
         import datetime
+        from consul_tpu.connect import ca as camod
+        now = datetime.datetime.now(datetime.timezone.utc)
+        if not camod.HAVE_CRYPTOGRAPHY:
+            try:
+                payload = camod._stub_payload(leaf["CertPEM"])
+            except Exception:
+                return False
+            return payload.get("not_after", 0.0) > now.timestamp()
         from cryptography import x509
         try:
             cert = x509.load_pem_x509_certificate(
                 leaf["CertPEM"].encode())
         except Exception:
             return False
-        return cert.not_valid_after_utc > datetime.datetime.now(
-            datetime.timezone.utc)
+        return cert.not_valid_after_utc > now
 
     def watch(self, proxy_id: str) -> Optional[ProxyState]:
         """ProxyState for a registered connect-proxy service id
@@ -595,7 +794,33 @@ class Manager:
         return None
 
     def close(self) -> None:
+        """Stop every state and JOIN its follower thread (the PR 14
+        thread-hygiene contract): states detach under the lock, the
+        joins happen outside it so a slow in-flight rebuild can't
+        wedge concurrent watch() calls behind the registry."""
         with self._lock:
-            for st in self._states.values():
-                st.stop()
+            states = list(self._states.values())
             self._states.clear()
+        for st in states:
+            st.stop()
+
+    def table(self) -> List[dict]:
+        """The per-proxy mesh-control-plane table served at
+        /v1/internal/ui/xds: one row per live ProxyState (kind,
+        snapshot version, rebuild/push counters, rebuild p50/p99,
+        last-activity ages), plus the consul.xds.proxies{kind}
+        gauges — rows computed from a detached state list and gauges
+        emitted off every proxycfg lock."""
+        with self._lock:
+            states = list(self._states.values())
+        now = time.time()
+        rows = [st.stats(now) for st in states]
+        rows.sort(key=lambda r: r["proxy_id"])
+        from consul_tpu import telemetry
+        kinds: Dict[str, int] = {}
+        for r in rows:
+            kinds[r["kind"]] = kinds.get(r["kind"], 0) + 1
+        for kind, n in sorted(kinds.items()):
+            telemetry.set_gauge(("xds", "proxies"), float(n),
+                                labels={"kind": kind})
+        return rows

@@ -872,10 +872,11 @@ class Server:
             node_id=node_id or str(uuid.uuid4()))["index"]
 
     def register_service(self, node, service_id, name, port=0, tags=None,
-                         meta=None, address=""):
+                         meta=None, address="", kind="", proxy=None):
         return self.raft_apply(
             "register_service", node=node, service_id=service_id, name=name,
-            port=port, tags=tags, meta=meta, address=address)["index"]
+            port=port, tags=tags, meta=meta, address=address,
+            kind=kind, proxy=proxy)["index"]
 
     def register_check(self, node, check_id, name, status="critical",
                        service_id="", output=""):

@@ -99,6 +99,10 @@ class _Sub:
         default_factory=lambda: deque(maxlen=MAX_SUB_QUEUE))
     slow_depth: int = 0                # max depth seen while backed up
     evicted: bool = False
+    # optional SHARED wakeup: a consumer selecting over MANY subs
+    # (proxycfg's per-proxy follower) attaches one Event to all of
+    # them and parks on that instead of serially blocking per-sub
+    wake: Optional[threading.Event] = None
 
 
 class Subscription:
@@ -149,6 +153,17 @@ class Subscription:
                         labels={"topic": s.topic, "depth": slow_depth})
         self._pub._flush_stats()
         return out
+
+    def attach_wake(self, ev: threading.Event) -> None:
+        """Attach a shared wakeup Event: set by publish/evict/close so
+        one consumer can select over many subscriptions.  If batches
+        (or a reset) are already pending, the event is set immediately
+        — no lost-wakeup window between subscribe and attach."""
+        s = self._sub
+        with s.cond:
+            s.wake = ev
+            if s.queue or s.closed:
+                ev.set()
 
     def close(self) -> None:
         self._pub.unsubscribe(self)
@@ -246,6 +261,8 @@ class EventPublisher:
                     s.evicted = True
                     s.queue.clear()
                     s.cond.notify_all()
+                    if s.wake is not None:
+                        s.wake.set()
                     evicted_subs.append(s)
                     continue
                 s.queue.append(mine)
@@ -256,6 +273,9 @@ class EventPublisher:
                     # lock and must not emit
                     s.slow_depth = depth
                 s.cond.notify_all()
+                if s.wake is not None:
+                    # Event.set is emit-free: safe under the store lock
+                    s.wake.set()
         if evicted_subs:
             # drop evicted subs from the registry so the NEXT publish
             # no longer pays their fan-out cost (the whole point: 10k
@@ -378,6 +398,8 @@ class EventPublisher:
         with s.cond:
             s.closed = True
             s.cond.notify_all()
+            if s.wake is not None:
+                s.wake.set()
 
     def close_all(self) -> None:
         with self._lock:
@@ -386,3 +408,5 @@ class EventPublisher:
             with s.cond:
                 s.closed = True
                 s.cond.notify_all()
+                if s.wake is not None:
+                    s.wake.set()

@@ -60,6 +60,11 @@ STALL_SECONDS = 1.0
 
 STAGES = ("publish", "wakeup", "flush")
 
+# the mesh control plane's stages (ISSUE 16): a config-changing write's
+# proxycfg snapshot rebuild and its xDS push, both measured FROM the
+# same raft apply the KV stages measure from
+XDS_STAGES = ("rebuild", "push")
+
 # the thread applying a raft command binds the proposer's trace id here
 # (raft._apply_committed wraps apply_fn in `applying(tid)`) so the
 # store's _bump can correlate the index it mints without the trace
@@ -207,6 +212,46 @@ class VisibilityTable:
                         labels={"stage": stage, "index": index,
                                 "ms": round(lat * 1000.0, 1),
                                 "dc": dc},
+                        trace_id=tid)
+        return lat, tid
+
+    def stage_xds(self, stage: str, index: int, proxy_kind: str,
+                  proxy_id: str = "",
+                  ts: Optional[float] = None
+                  ) -> Optional[Tuple[float, str]]:
+        """Emit one mesh-control-plane stage for `index` (ISSUE 16):
+        the `consul.xds.visibility{stage,proxy_kind}` sample (seconds
+        since apply), an `xds.visibility.<stage>` trace span under the
+        WRITER's trace id, and an `xds.visibility.stall` flight event
+        past STALL_SECONDS.  Same discipline as `stage()`: runs on the
+        observer's thread (the proxycfg follow loop after releasing
+        its condition, or the ADS/HTTP push thread) — never call while
+        holding the store, publisher, or proxycfg locks.
+
+        Returns (latency_s, trace_id), or None when the index aged out
+        of the table (a rebuild triggered by pre-table history has
+        nothing to correlate against)."""
+        now = time.time() if ts is None else ts
+        with self._lock:
+            rec = self._rec.get(index)
+            if rec is None or rec.get("apply_ts") is None:
+                return None
+            apply_ts = rec["apply_ts"]
+            tid = rec.get("trace_id") or ""
+        from consul_tpu import telemetry, trace
+        lat = max(0.0, now - apply_ts)
+        telemetry.add_sample(("xds", "visibility"), lat,
+                             labels={"stage": stage,
+                                     "proxy_kind": proxy_kind})
+        trace.record(f"xds.visibility.{stage}", tid, apply_ts, lat,
+                     index=index, proxy_kind=proxy_kind,
+                     proxy=proxy_id or None, dc=self.dc)
+        if lat > STALL_SECONDS:
+            from consul_tpu import flight
+            flight.emit("xds.visibility.stall",
+                        labels={"stage": stage, "index": index,
+                                "ms": round(lat * 1000.0, 1),
+                                "proxy_kind": proxy_kind},
                         trace_id=tid)
         return lat, tid
 

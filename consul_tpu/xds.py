@@ -1131,6 +1131,29 @@ def delta(prev_resources: dict, new_resources: dict) -> dict:
     return {"Changed": changed, "Removed": removed}
 
 
+def note_http_push_counters(payload: dict) -> None:
+    """Transport parity for the JSON/HTTP ADS frontend: the same
+    `consul.xds.{pushes,resources}{type}` counters the gRPC stream
+    emits per type URL (xds_grpc._note_pushed), keyed here by the
+    payload's resource-group names.  For a ?delta response only the
+    CHANGED groups count — that is what actually crossed the wire.
+    Called AFTER the HTTP response flush; no store/proxycfg lock is
+    held."""
+    from consul_tpu import telemetry
+    res = payload.get("Resources")
+    if res is None:
+        res = (payload.get("Delta") or {}).get("Changed") or {}
+    if not isinstance(res, dict):
+        return
+    for group, rows in res.items():
+        telemetry.incr_counter(("xds", "pushes"), 1.0,
+                               labels={"type": group})
+        if rows:
+            telemetry.incr_counter(("xds", "resources"),
+                                   float(len(rows)),
+                                   labels={"type": group})
+
+
 def snapshot_resources(snap) -> dict:
     """Full ADS payload for one proxy version (DeltaAggregatedResources
     response analogue); gateway kinds get their own resource shapes."""

@@ -34,6 +34,7 @@ from typing import Callable, Dict, List, Optional
 
 import grpc
 
+from consul_tpu import locks
 from consul_tpu import xds as xdsmod
 from consul_tpu import xds_pb
 
@@ -90,8 +91,11 @@ class AdsServicer:
         # registration's config; the weak map can't collide and GC
         # evicts entries exactly when their snapshot is replaced.
         import weakref
+        self._payload_lock = locks.make_lock("xds.payload")
+        # snapshot object -> generated resource payload  # guarded-by: _payload_lock
         self._payload_cache = weakref.WeakKeyDictionary()
-        self._payload_lock = threading.Lock()
+        locks.register_guards(self, self._payload_lock,
+                              "_payload_cache")
 
     def _payload(self, st: "_StreamState", snap) -> dict:
         with self._payload_lock:
@@ -199,6 +203,8 @@ class AdsServicer:
                             "xds NACK proxy=%s type=%s: %s",
                             st.proxy_id, url,
                             req.error_detail.message)
+                        self._note_nack(st, url,
+                                        req.error_detail.message)
                         continue
                     if prev is not None and \
                             req.response_nonce == prev[1] and \
@@ -212,6 +218,32 @@ class AdsServicer:
         finally:
             stop.set()
 
+    @staticmethod
+    def _note_nack(st: _StreamState, url: str, detail: str) -> None:
+        """NACK SLIs (ISSUE 16): the consul.xds.nacks{type} counter
+        and an xds.push.nack flight event — a rejected config is
+        exactly the kind of rare, load-bearing fact the journal
+        exists for.  No proxycfg/xds lock is held here."""
+        from consul_tpu import flight, telemetry
+        group = GROUP_BY_URL.get(url, url)
+        telemetry.incr_counter(("xds", "nacks"), 1,
+                               labels={"type": group})
+        flight.emit("xds.push.nack",
+                    labels={"proxy": st.proxy_id or "", "type": group,
+                            "detail": (detail or "")[:200]})
+
+    @staticmethod
+    def _note_pushed(st: _StreamState, url: str, n_rows: int) -> None:
+        """Per-type push counters, emitted as the response is handed
+        to the gRPC machinery (no lock held)."""
+        from consul_tpu import telemetry
+        group = GROUP_BY_URL.get(url, url)
+        telemetry.incr_counter(("xds", "pushes"), 1,
+                               labels={"type": group})
+        if n_rows:
+            telemetry.incr_counter(("xds", "resources"), float(n_rows),
+                                   labels={"type": group})
+
     def _push(self, st: _StreamState, urls: List[str],
               names_override: Optional[Dict[str, tuple]] = None):
         if st.watch is None:
@@ -220,6 +252,7 @@ class AdsServicer:
         if snap is None:
             return
         payload = self._payload(st, snap)
+        pushed = False
         for url in urls:
             names = (names_override or {}).get(
                 url, st.sent.get(url, (0, "", ()))[2])
@@ -231,8 +264,15 @@ class AdsServicer:
                                  names)
             nonce = st.next_nonce()
             st.sent[url] = (snap.version, nonce, names)
+            self._note_pushed(st, url, len(rows))
+            pushed = True
             yield xds_pb.build_response(url, rows, str(snap.version),
                                         nonce)
+        if pushed:
+            # runs after the LAST response was consumed by the stream
+            # writer: stamps the per-proxy push clock and emits the
+            # apply->push visibility stage once per snapshot
+            st.watch.note_push(snap)
 
     # ------------------------------------------------------------- delta
 
@@ -267,6 +307,8 @@ class AdsServicer:
                         log.warning(
                             "xds delta NACK proxy=%s type=%s: %s",
                             st.proxy_id, url, req.error_detail.message)
+                        self._note_nack(st, url,
+                                        req.error_detail.message)
                         continue
                     have = held.setdefault(url, {})
                     for name, ver in req.initial_resource_versions.items():
@@ -293,6 +335,7 @@ class AdsServicer:
             return
         payload = self._payload(st, snap)
         version = str(snap.version)
+        pushed = False
         for url in urls:
             have = held.setdefault(url, {})
             rows = payload.get(GROUP_BY_URL[url], [])
@@ -314,8 +357,12 @@ class AdsServicer:
                 del have[n]
             nonce = st.next_nonce()
             st.sent[url] = (snap.version, nonce, ())
+            self._note_pushed(st, url, len(changed))
+            pushed = True
             yield xds_pb.build_delta_response(
                 url, changed, removed, version, nonce)
+        if pushed:
+            st.watch.note_push(snap)
 
 
 SUBSCRIBE_SERVICE = "consultpu.stream.v1.StateChangeSubscription"

@@ -111,13 +111,21 @@ def test_user_event_correlates_with_trace(client):
     rows, _ = client.agent_events(name="serf.user_event")
     ev = [r for r in rows if r["Labels"].get("name") == "release"][-1]
     assert ev["TraceID"] != ""
-    # the same trace id names the /v1/event/fire span in the ring
+    # the same trace id names the /v1/event/fire span in the ring;
+    # the span lands AFTER the response flush (it covers the whole
+    # handler), so give the handler thread a beat to reach the ring
     from consul_tpu import trace
-    spans = trace.dump(trace_id=ev["TraceID"])
-    assert any(s["name"] == "http.request"
-               and s.get("attrs", {}).get("path")
-               == "/v1/event/fire/release"
-               for s in spans)
+
+    def fire_span_present():
+        return any(s["name"] == "http.request"
+                   and s.get("attrs", {}).get("path")
+                   == "/v1/event/fire/release"
+                   for s in trace.dump(trace_id=ev["TraceID"]))
+
+    deadline = time.time() + 5.0
+    while not fire_span_present() and time.time() < deadline:
+        time.sleep(0.05)
+    assert fire_span_present()
 
 
 def test_user_event_reaches_monitor_stream(agent, client):
@@ -230,6 +238,6 @@ def test_debug_bundle_cli_smoke(tmp_path):
     assert row["ok"] and row["missing"] == []
     with tarfile.open(out) as tar:
         names = tar.getnames()
-    for section in ("host.json", "0/metrics.prom", "trace.json",
-                    "events.jsonl", "profile.json"):
+    for section in ("host.json", "0/metrics.prom", "xds.json",
+                    "trace.json", "events.jsonl", "profile.json"):
         assert section in names

@@ -715,6 +715,63 @@ def test_issue15_wan_metric_and_event_names_registered():
     assert any("dial ms!" in f.message for f in mn)
 
 
+def test_issue16_xds_metric_and_event_names_registered():
+    """The mesh control-plane visibility vocabulary (ISSUE 16
+    satellite): the consul.xds.* families pass the metric gate and
+    the xds.* events are registered in CATALOG with their exact label
+    sets — while a malformed sibling or undeclared label still fires
+    (the checker gates the NEW vocabulary, not just the old)."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def mesh(proxy, kind, ver, index, typ, detail, stage, ms, n):
+            flight.emit("xds.rebuild",
+                        labels={"proxy": proxy, "kind": kind,
+                                "version": ver, "index": index})
+            flight.emit("xds.push.nack",
+                        labels={"proxy": proxy, "type": typ,
+                                "detail": detail})
+            flight.emit("xds.visibility.stall",
+                        labels={"stage": stage, "index": index,
+                                "ms": ms, "proxy_kind": kind})
+            telemetry.set_gauge(("xds", "proxies"), n,
+                                labels={"kind": kind})
+            telemetry.incr_counter(("xds", "rebuilds"), n,
+                                   labels={"kind": kind})
+            telemetry.incr_counter(("xds", "pushes"), n,
+                                   labels={"type": typ})
+            telemetry.incr_counter(("xds", "resources"), n,
+                                   labels={"type": typ})
+            telemetry.incr_counter(("xds", "nacks"), n,
+                                   labels={"type": typ})
+            telemetry.add_sample(("xds", "visibility"), ms,
+                                 labels={"stage": stage,
+                                         "proxy_kind": kind})
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+    bad = """
+        from consul_tpu import flight, telemetry
+
+        def mesh(proxy, kind, labels):
+            flight.emit("xds.rebuild.exploded",
+                        labels={"proxy": proxy})
+            flight.emit("xds.rebuild",
+                        labels={"proxy": proxy, "kind": kind,
+                                "version": 1, "lane": 2})
+            flight.emit("xds.push.nack", labels=labels)
+            telemetry.add_sample(("xds", "push ms!"), 1.0)
+    """
+    ev = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in ev)
+    assert len(ev) == 3
+    assert "unregistered event name 'xds.rebuild.exploded'" in msgs
+    assert "label 'lane' not declared" in msgs
+    assert "computed labels" in msgs
+    mn = check_snippet("metric-names", bad)
+    assert any("push ms!" in f.message for f in mn)
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

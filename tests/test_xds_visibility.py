@@ -1,0 +1,154 @@
+"""ISSUE 16 acceptance: commit-to-push visibility through the mesh
+control plane, on the REAL multi-process cluster.
+
+One config-changing write (an intention flip) carries ONE trace id
+from the HTTP entry through raft apply, the proxycfg snapshot rebuild,
+and the ADS push — asserted against the server's trace ring, flight
+journal, and the /v1/internal/ui/xds per-proxy table.  The xds_bench
+sweep point runs here too, so the committed XDSVIS artifact's shape is
+regression-locked.
+
+These spawn tools/server_proc.py fleets over real sockets — budgeted
+~15 s each; everything cheaper (publisher wake seam, stage math,
+render) lives in test_stream/test_introspect/test_proxycfg_xds.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _put_json(url, payload, tid=""):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="PUT")
+    if tid:
+        req.add_header("X-Consul-Trace-Id", tid)
+    urllib.request.urlopen(req, timeout=15.0).read()
+
+
+def test_live_intention_flip_one_trace_commit_to_push():
+    """The tentpole correlation, end to end: a traced intention PUT's
+    id names the http.request span, the xds.visibility.rebuild span
+    (stamped with the apply index), the xds.visibility.push span, and
+    the xds.rebuild flight event; the per-proxy table and the merged
+    /v1/internal/ui/xds view both show the rebuilt proxy."""
+    from consul_tpu.api.client import Client
+    from consul_tpu.chaos_live import LiveCluster
+    import cluster_top
+
+    with tempfile.TemporaryDirectory(prefix="xdsvis-live-") as tmp:
+        cluster = LiveCluster(n=2, data_root=tmp, grpc=True)
+        try:
+            cluster.start()
+            li = cluster.leader()
+            leader = cluster.servers[li]
+            assert leader.grpc, "gRPC ADS plane not wired"
+            cl = Client(leader.http, timeout=10.0)
+            _put_json(leader.http + "/v1/agent/service/register",
+                      {"Name": "db", "ID": "db1", "Port": 5432})
+            _put_json(
+                leader.http + "/v1/agent/service/register",
+                {"Name": "web-sidecar-proxy", "ID": "web-sidecar-proxy",
+                 "Kind": "connect-proxy", "Port": 21000,
+                 "Proxy": {"DestinationServiceName": "web",
+                           "Upstreams": [{"DestinationName": "db",
+                                          "LocalBindPort": 9191}]}})
+            out = cl._call("GET", "/v1/agent/xds/web-sidecar-proxy")[0]
+            v = int(out["VersionInfo"])
+            got = {}
+
+            def poll():
+                got["out"] = cl._call(
+                    "GET", "/v1/agent/xds/web-sidecar-proxy"
+                    f"?version={v}&wait=10s")[0]
+
+            t = threading.Thread(target=poll, daemon=True)
+            t.start()
+            time.sleep(0.4)
+            tid = "ab" * 16
+            _put_json(leader.http + "/v1/connect/intentions",
+                      {"SourceName": "evil", "DestinationName": "web",
+                       "Action": "deny"}, tid=tid)
+            t.join(timeout=15.0)
+            assert int(got["out"]["VersionInfo"]) > v, \
+                "intention flip never pushed a new xDS version"
+            # ---- trace ring: ONE id spans write -> rebuild -> push
+            deadline = time.time() + 5.0
+            names = set()
+            while time.time() < deadline:
+                spans, _ = cl.agent_traces(trace_id=tid)
+                names = {s["name"] for s in spans}
+                if {"http.request", "xds.visibility.rebuild",
+                        "xds.visibility.push"} <= names:
+                    break
+                time.sleep(0.05)
+            assert {"http.request", "xds.visibility.rebuild",
+                    "xds.visibility.push"} <= names, names
+            rb = next(s for s in spans
+                      if s["name"] == "xds.visibility.rebuild")
+            assert rb["attrs"]["index"] > 0
+            assert rb["attrs"]["proxy_kind"] == "connect-proxy"
+            assert rb["attrs"]["proxy"] == "web-sidecar-proxy"
+            # ---- flight journal: the rebuild event carries the
+            # writer's id
+            evs, _ = cl.agent_events(name="xds.rebuild")
+            assert any(e["TraceID"] == tid for e in evs), \
+                [(e["Labels"], e["TraceID"]) for e in evs]
+            # ---- per-proxy table, local and merged
+            local = cl.internal_xds(local=True)
+            row = next(p for p in local["proxies"]
+                       if p["proxy_id"] == "web-sidecar-proxy")
+            assert row["rebuilds"] >= 2 and row["pushes"] >= 1
+            assert row["store_index"] == rb["attrs"]["index"]
+            merged = cl.internal_xds()
+            assert any(p["proxy_id"] == "web-sidecar-proxy"
+                       for p in merged["proxies"])
+            assert set(merged["nodes"]) == {"server0", "server1"}
+            # ---- the operator rendering consumes the merged view
+            text = cluster_top.render_xds(merged)
+            assert "web-sidecar-proxy" in text
+            # ---- stage summaries behind cluster_top --xds
+            dump = cl._call("GET", "/v1/agent/metrics")[0]
+            from consul_tpu import introspect
+            stages = introspect.xds_stages(dump)
+            assert {"rebuild", "push"} <= set(stages)
+            for s in stages.values():
+                assert s["count"] >= 1 and s["p99_ms"] >= s["p50_ms"]
+        finally:
+            cluster.stop()
+
+
+def test_live_xds_bench_point_shape():
+    """One xds_bench sweep point: deliveries complete, no proxy runs
+    stale, client-observed visibility and the commit-anchored stage
+    summaries populate, the push-throughput counters move, and the
+    point carries its correlated-trace proof — the committed
+    XDSVIS_r01.json row shape, regression-locked."""
+    import xds_bench
+    with tempfile.TemporaryDirectory(prefix="xdsbench-live-") as tmp:
+        row = xds_bench.run_point(n_proxies=2, routes=2, flips=6,
+                                  pace_s=0.05, data_root=tmp,
+                                  cluster_n=2, seed=1)
+    assert row["deliveries"] == 12 and row["stale"] == 0
+    assert row["visibility_ms"]["p50"] > 0.0
+    assert row["visibility_ms"]["p99"] >= row["visibility_ms"]["p50"]
+    stages = row["stages_ms"]
+    assert {"rebuild", "push"} <= set(stages)
+    for s in stages.values():
+        assert s["count"] >= 1 and s["p99_ms"] >= s["p50_ms"]
+    thr = row["throughput"]
+    assert thr["rebuilds"] >= 6 and thr["pushes"] > 0
+    assert thr["resources_per_s"] > 0.0 and thr["nacks"] == 0
+    c = row["correlated_trace"]
+    assert c["write_traced"] and c["rebuild_traced"] \
+        and c["push_traced"]
+    # the bench_guard tolerates-not-judges stamps ride every row
+    assert row["xds"] == {"proxies": 2, "routes": 2, "cluster": 2}
+    assert row["topology"]["backend"]

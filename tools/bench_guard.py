@@ -366,6 +366,18 @@ def run_check() -> int:
     if not wanrow["ok"]:
         failures.append("guard judged the wan/federation artifact "
                         "stamp keys instead of tolerating them")
+    # ISSUE 16's mesh-control-plane stamp is metadata too: xds_bench
+    # rows carry {"xds": {proxies, routes, cluster}} (plus the
+    # topology stamp the refusal above already gates) — a decorated
+    # within-threshold row must be tolerated-not-judged
+    xdsrow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                     "xds": {"proxies": 8, "routes": 8, "cluster": 3,
+                             "visibility_ms": {"p50": 11.4,
+                                               "p99": 24.1}}}],
+                   fake_base)
+    if not xdsrow["ok"]:
+        failures.append("guard judged the xds artifact stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

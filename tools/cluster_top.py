@@ -87,6 +87,40 @@ def render(view: dict, events_tail: int = 0) -> str:
     return "\n".join(out)
 
 
+def render_xds(view: dict) -> str:
+    """The mesh control-plane table: one row per proxy across the
+    fleet (rebuild/push counters, rebuild quantiles, snapshot
+    version/index), then each node's commit-to-push visibility stage
+    quantiles.  Dead nodes render as DEAD rows, never absences."""
+    out = [f"xds: {len(view['proxies'])} proxies across "
+           f"{len(view['nodes'])} nodes"]
+    out.append(f"{'NODE':<12} {'PROXY':<24} {'KIND':<16} "
+               f"{'VER':>5} {'INDEX':>8} {'REBUILDS':>8} {'PUSHES':>7} "
+               f"{'REB_P50':>8} {'REB_P99':>8}")
+    for p in view["proxies"]:
+        reb = p.get("rebuild_ms") or {}
+        out.append(
+            f"{p['node']:<12} {p['proxy_id']:<24} {p['kind']:<16} "
+            f"{p.get('version', 0):>5} {p.get('store_index', 0):>8} "
+            f"{p.get('rebuilds', 0):>8} {p.get('pushes', 0):>7} "
+            f"{reb.get('p50', 0.0):>8.2f} {reb.get('p99', 0.0):>8.2f}")
+    out.append("commit-to-push visibility (ms since apply):")
+    out.append(f"  {'NODE':<12} {'STAGE':<8} {'P50':>9} {'P99':>9} "
+               f"{'COUNT':>8}")
+    for name, n in sorted(view["nodes"].items()):
+        if not n.get("alive"):
+            out.append(f"  {name:<12} DEAD      [{n.get('error', '')}]")
+            continue
+        vis = n.get("xds_visibility") or {}
+        for stage in ("rebuild", "push"):
+            row = vis.get(stage)
+            if row:
+                out.append(f"  {name:<12} {stage:<8} "
+                           f"{row['p50_ms']:>9.2f} "
+                           f"{row['p99_ms']:>9.2f} {row['count']:>8}")
+    return "\n".join(out)
+
+
 def render_wan(view: dict, events_tail: int = 0) -> str:
     """The federated view: one summary row per DC, then each DC's
     node table (degraded/dead rows rendered distinctly)."""
@@ -133,11 +167,19 @@ def main(argv=None) -> int:
     ap.add_argument("--wan", action="store_true",
                     help="treat args as dc=url|url specs and render "
                          "the federated multi-DC view")
+    ap.add_argument("--xds", action="store_true",
+                    help="render the mesh control-plane table instead: "
+                         "per-proxy rebuild/push SLIs + the "
+                         "rebuild/push visibility quantiles "
+                         "(introspect.xds_view)")
     args = ap.parse_args(argv)
 
     from consul_tpu import introspect
     while True:
-        if args.wan:
+        if args.xds:
+            view = introspect.xds_view(args.nodes)
+            text = render_xds(view)
+        elif args.wan:
             spec = introspect.parse_dc_spec(",".join(args.nodes))
             view = introspect.federation_view(
                 spec, events_limit=max(args.events, 10))

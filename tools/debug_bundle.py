@@ -44,8 +44,8 @@ DEFAULT_OUT = "debug_bundle.tar.gz"
 
 # sections every bundle must carry (the smoke test asserts presence)
 REQUIRED_SECTIONS = ("host.json", "logs.txt", "0/metrics.json",
-                     "0/metrics.prom", "0/threads.txt", "trace.json",
-                     "events.jsonl", "profile.json")
+                     "0/metrics.prom", "0/threads.txt", "xds.json",
+                     "trace.json", "events.jsonl", "profile.json")
 
 # per-node sections a --cluster bundle must carry for every LIVE node,
 # plus the merged cluster files

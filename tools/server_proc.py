@@ -106,6 +106,11 @@ def main():
                          "every DC's servers: enables the "
                          "/v1/internal/ui/federation multi-DC view "
                          "(introspect.federation_view)")
+    ap.add_argument("--grpc-port", type=int, default=None,
+                    help="gRPC ADS control plane port (ports.grpc): "
+                         "None disables, 0 binds an ephemeral port — "
+                         "the live-cluster xDS push surface "
+                         "(consul_tpu/xds_grpc.py)")
     ap.add_argument("--rate-limit", default=None,
                     help='overload defense config '
                          '(consul_tpu/ratelimit.py), e.g. '
@@ -164,9 +169,23 @@ def main():
             server.apply_gate.min_budget_s = cfg.pop("apply_min_budget")
         if cfg:
             api.ratelimit.configure(**cfg)
+    xds_grpc = None
+    if args.grpc_port is not None:
+        # same wiring as Agent: ADS streams authorize service:write on
+        # the proxied service via x-consul-token metadata
+        from consul_tpu.xds_grpc import XdsGrpcServer
+        xds_grpc = XdsGrpcServer(
+            api.proxycfg, port=args.grpc_port,
+            authorize=lambda token, svc: api.acl.resolve(
+                token or None).service_write(svc))
+        api.grpc_port = xds_grpc.port
     api.start()
+    if xds_grpc is not None:
+        xds_grpc.start()
     print(f"server {args.node} rpc={my_rpc} "
-          f"http={api.address}", flush=True)
+          f"http={api.address}"
+          + (f" grpc={xds_grpc.address}" if xds_grpc else ""),
+          flush=True)
     flight.emit("agent.started", labels={"node": args.node})
     import threading
     wake = threading.Event()
@@ -224,6 +243,8 @@ def main():
         # the data-dir lock — a rolling restart must find a cleanly
         # closed log (no torn tail, no stale flock)
         flight.emit("agent.stopped", labels={"node": args.node})
+        if xds_grpc is not None:
+            xds_grpc.stop()
         api.stop()
         server.close_rpc()
         store = server.raft.store

@@ -1,0 +1,325 @@
+"""xDS reconfiguration-visibility bench: commit-to-push, live.
+
+    python tools/xds_bench.py                        # full sweep
+    python tools/xds_bench.py --proxies 1 4 8 --routes 2 8
+    python tools/xds_bench.py --check                # bounded CI shape
+    python tools/xds_bench.py --out XDSVIS_r01.json
+
+Drives a REAL multi-process LiveCluster (gRPC ADS plane enabled) with
+N registered sidecar proxies, each carrying a route table of R
+upstreams, and streams config-changing writes at it — intention flips
+plus register/deregister churn on a shared upstream — while one parked
+long-poll watcher per proxy observes the ADS version advance.  Per
+(proxies x route-table-size) sweep point it measures:
+
+  * client-observed reconfiguration visibility per delivery (traced
+    HTTP write issued -> the proxy's blocking xDS poll returns the
+    bumped version), p50/p99 across every proxy x flip;
+  * the server's own commit-anchored `consul.xds.visibility{stage}`
+    summaries (rebuild|push, measured FROM the raft apply, not from
+    scheduler wakeup) scraped after the churn window;
+  * push throughput: `consul.xds.{pushes,resources}` counter deltas
+    over the churn window -> resources/s;
+  * the correlated-trace proof per point: ONE trace id spans the HTTP
+    intention write (http.request), the proxy snapshot rebuild
+    (xds.visibility.rebuild), and the ADS push
+    (xds.visibility.push) in the server's trace ring.
+
+The emitted XDSVIS_r01.json is the mesh-control-plane baseline the
+ROADMAP item-4 chaos families (kill the leader mid-flip: how stale do
+sidecars run?) will be judged against.  Each sweep point runs a FRESH
+cluster so per-stage reservoirs are not blended across fan-out levels;
+rows carry an {"xds": ...} stamp plus the BENCH_BASELINE-style
+topology stamp so bench_guard tolerates-not-judges them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+def pctl(values, q: float) -> float:
+    """Nearest-rank percentile (telemetry._Sample's rule)."""
+    if not values:
+        return 0.0
+    s = sorted(values)
+    return s[min(len(s) - 1, max(0, int(q * len(s))))]
+
+
+def topology_stamp() -> dict:
+    """The BENCH_BASELINE-shaped WHERE-did-this-number-come-from row."""
+    import jax
+    return {"backend": jax.default_backend(),
+            "devices": 1, "mesh_shape": None}
+
+
+def _put_json(url: str, payload: dict, tid: str = "") -> None:
+    import urllib.request
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="PUT")
+    if tid:
+        req.add_header("X-Consul-Trace-Id", tid)
+    urllib.request.urlopen(req, timeout=30.0).read()
+
+
+def _watcher(client, pid: str, start_version: int, stop, state, lock):
+    """One parked xDS long-poll per proxy: observes version advance."""
+    from consul_tpu.api.client import ApiError
+    cur = start_version
+    while not stop.is_set():
+        try:
+            out = client._call(
+                "GET", f"/v1/agent/xds/{pid}?version={cur}&wait=5s")[0]
+        except (ApiError, OSError):
+            if stop.is_set():
+                return
+            time.sleep(0.05)
+            continue
+        now = time.time()
+        v = int(out.get("VersionInfo", cur))
+        if v > cur:
+            cur = v
+            res = out.get("Resources") or {}
+            with lock:
+                st = state[pid]
+                st["version"] = v
+                st["ts"] = now
+                st["resources"] += sum(len(r) for r in res.values())
+
+
+def _counter(dump: dict, name: str) -> float:
+    return sum(c["Count"] for c in (dump or {}).get("Counters", [])
+               if c["Name"] == name)
+
+
+def run_point(n_proxies: int, routes: int, flips: int, pace_s: float,
+              data_root: str, cluster_n: int = 3, seed: int = 0) -> dict:
+    from consul_tpu import introspect
+    from consul_tpu.api.client import Client
+    from consul_tpu.chaos_live import LiveCluster
+    from consul_tpu.trace import new_trace_id
+
+    cluster = LiveCluster(cluster_n, data_root=data_root, grpc=True)
+    stop = threading.Event()
+    threads = []
+    try:
+        cluster.start()
+        li = cluster.leader()
+        leader = cluster.servers[li]
+        cl = Client(leader.http, timeout=10.0)
+        # ---- the mesh: R route backends, N sidecars each watching all R
+        for j in range(routes):
+            _put_json(leader.http + "/v1/agent/service/register",
+                      {"Name": f"route-{j}", "ID": f"route-{j}",
+                       "Port": 7000 + j})
+        pids = []
+        for i in range(n_proxies):
+            pid = f"app{i}-sidecar-proxy"
+            _put_json(
+                leader.http + "/v1/agent/service/register",
+                {"Name": pid, "ID": pid, "Kind": "connect-proxy",
+                 "Port": 21000 + i,
+                 "Proxy": {
+                     "DestinationServiceName": f"app{i}",
+                     "Upstreams": [
+                         {"DestinationName": f"route-{j}",
+                          "LocalBindPort": 9100 + i * routes + j}
+                         for j in range(routes)]}})
+            pids.append(pid)
+        # prime each ProxyState (first GET builds the snapshot), then
+        # park one long-poll watcher per proxy
+        state = {}
+        lock = threading.Lock()
+        for pid in pids:
+            out = cl._call("GET", f"/v1/agent/xds/{pid}")[0]
+            v = int(out["VersionInfo"])
+            state[pid] = {"version": v, "ts": time.time(),
+                          "resources": sum(
+                              len(r) for r in
+                              (out.get("Resources") or {}).values())}
+            t = threading.Thread(
+                target=_watcher,
+                args=(Client(leader.http, timeout=10.0), pid, v, stop,
+                      state, lock),
+                name=f"xds-w-{pid}", daemon=True)
+            threads.append(t)
+            t.start()
+        time.sleep(0.4)          # watchers park before the first flip
+        # ---- the churn window: intention flips + register/dereg churn,
+        # every write traced, every write bumps every proxy's version
+        # (intentions topic-wide; route-0 is in every route table)
+        dump0 = cl._call("GET", "/v1/agent/metrics")[0]
+        lat_ms = []
+        stale = 0
+        tid = ""
+        t_start = time.time()
+        for i in range(flips):
+            with lock:
+                baseline = {pid: state[pid]["version"] for pid in pids}
+            tid = new_trace_id()
+            kind = i % 3
+            if kind == 0:
+                _put_json(leader.http + "/v1/connect/intentions",
+                          {"SourceName": f"src{seed}-{i}",
+                           "DestinationName": "app0",
+                           "Action": "deny" if i % 2 else "allow"},
+                          tid=tid)
+            elif kind == 1:
+                # endpoint churn: dereg the shared upstream instance
+                _put_json(leader.http
+                          + "/v1/agent/service/deregister/route-0",
+                          {}, tid=tid)
+            else:
+                # ...and bring it back on a rotated port
+                _put_json(leader.http + "/v1/agent/service/register",
+                          {"Name": "route-0", "ID": "route-0",
+                           "Port": 7000 + 100 + i}, tid=tid)
+            put_ts = time.time()
+            deadline = put_ts + 10.0
+            waiting = set(pids)
+            while waiting and time.time() < deadline:
+                with lock:
+                    for pid in list(waiting):
+                        st = state[pid]
+                        if st["version"] > baseline[pid]:
+                            lat_ms.append((st["ts"] - put_ts) * 1000.0)
+                            waiting.discard(pid)
+                if waiting:
+                    time.sleep(0.002)
+            stale += len(waiting)
+            time.sleep(pace_s)
+        elapsed = time.time() - t_start
+        stop.set()
+        # ---- the correlated-trace proof: the LAST flip's id names the
+        # HTTP write, the rebuild, and the push in the server's ring
+        spans, _ = cl.agent_traces(trace_id=tid)
+        names = sorted({s["name"] for s in spans})
+        correlated = {
+            "trace_id": tid,
+            "spans": names,
+            "write_traced": "http.request" in names,
+            "rebuild_traced": "xds.visibility.rebuild" in names,
+            "push_traced": "xds.visibility.push" in names,
+        }
+        # ---- per-point SLI scrape: commit-anchored stage summaries +
+        # push-throughput counter deltas over the churn window
+        dump1 = cl._call("GET", "/v1/agent/metrics")[0]
+        resources = (_counter(dump1, "consul.xds.resources")
+                     - _counter(dump0, "consul.xds.resources"))
+        with lock:
+            delivered = len(lat_ms)
+        return {
+            "proxies": n_proxies, "routes": routes, "flips": flips,
+            "deliveries": delivered, "stale": stale,
+            "visibility_ms": {
+                "p50": round(pctl(lat_ms, 0.5), 3),
+                "p99": round(pctl(lat_ms, 0.99), 3),
+                "max": round(max(lat_ms), 3) if lat_ms else 0.0},
+            "stages_ms": introspect.xds_stages(dump1),
+            "throughput": {
+                "resources": resources,
+                "resources_per_s": round(resources / elapsed, 3)
+                if elapsed > 0 else 0.0,
+                "pushes": _counter(dump1, "consul.xds.pushes")
+                - _counter(dump0, "consul.xds.pushes"),
+                "rebuilds": _counter(dump1, "consul.xds.rebuilds")
+                - _counter(dump0, "consul.xds.rebuilds"),
+                "nacks": _counter(dump1, "consul.xds.nacks")},
+            "correlated_trace": correlated,
+            "xds": {"proxies": n_proxies, "routes": routes,
+                    "cluster": cluster_n},
+            "topology": topology_stamp(),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=3.0)
+        cluster.stop()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--proxies", type=int, nargs="+", default=[1, 4, 8])
+    ap.add_argument("--routes", type=int, nargs="+", default=[2, 8])
+    ap.add_argument("--flips", type=int, default=9)
+    ap.add_argument("--pace", type=float, default=0.05,
+                    help="seconds between writes")
+    ap.add_argument("--cluster-n", type=int, default=3,
+                    help="servers in the live cluster")
+    ap.add_argument("--out", default=None,
+                    help="write the artifact here (e.g. "
+                         "XDSVIS_r01.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="bounded smoke: one tiny point, shape "
+                         "asserts, no artifact unless --out")
+    args = ap.parse_args(argv)
+    if args.check:
+        args.proxies, args.routes = [2], [2]
+        args.flips, args.cluster_n = 6, 2
+
+    import tempfile
+    rows = []
+    for n in args.proxies:
+        for r in args.routes:
+            with tempfile.TemporaryDirectory(
+                    prefix=f"xdsvis-{n}x{r}-") as tmp:
+                row = run_point(n, r, args.flips, args.pace, tmp,
+                                cluster_n=args.cluster_n,
+                                seed=n * 100 + r)
+            rows.append(row)
+            print(json.dumps(row))
+    artifact = {
+        "metric": "xds_visibility",
+        "rows": rows,
+        "cores": os.cpu_count() or 1,
+        "topology": topology_stamp(),
+        "analysis": (
+            "Commit-to-push reconfiguration visibility on the live "
+            "multi-process cluster: N sidecar proxies each carrying an "
+            "R-upstream route table, driven by traced intention flips "
+            "and register/deregister churn on a shared upstream.  "
+            "visibility_ms is the client-observed HTTP-write -> "
+            "blocking-xDS-poll-return latency across every proxy x "
+            "flip; stages_ms are the server's commit-anchored "
+            "consul.xds.visibility{stage=rebuild|push} summaries "
+            "(measured FROM the raft apply).  Every row carries a "
+            "correlated-trace proof: one trace id spanning the "
+            "http.request write span, the xds.visibility.rebuild "
+            "span, and the xds.visibility.push span in the server's "
+            "ring.  Baseline for the ROADMAP item-4 mesh chaos "
+            "families (leader kill mid-flip: how stale do sidecars "
+            "run?)."),
+    }
+    if args.check:
+        row = rows[0]
+        c = row["correlated_trace"]
+        ok = (row["deliveries"] > 0
+              and row["stale"] == 0
+              and row["visibility_ms"]["p50"] > 0.0
+              and "rebuild" in row["stages_ms"]
+              and "push" in row["stages_ms"]
+              and c["write_traced"] and c["rebuild_traced"]
+              and c["push_traced"]
+              and row["throughput"]["resources_per_s"] > 0.0)
+        print(json.dumps({"check": "xds_bench", "ok": ok}))
+        if not ok:
+            return 1
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=2)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
