@@ -8,13 +8,28 @@ round lists the primary's policies and tokens, diffs against the local
 secondary store by modify_index, and applies upserts + deletes.  Local
 tokens (`local: true`) never replicate (the reference's local-token
 carve-out).
+
+Divergence CHECKING (ISSUE 18): each replicator also carries a
+content-hash divergence checker — `snapshot()` canonicalizes the
+replicated payload class on either store, `check_divergence()`
+compares the two hashes, and the outcome feeds the
+`consul.replication.{lag,diverged}{type}` SLIs plus the
+`replication.{diverged,converged}` flight transitions.  Under a WAN
+partition the primary list fails, lag grows from the last proven-sync
+stamp, and the secondary is marked diverged; after heal one clean
+round converges it back.  The live chaos family
+(`chaos_live.live_wan_partition`) asserts exactly that arc.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Callable, Optional, Tuple
+
+from consul_tpu import telemetry
 
 
 class Replicator:
@@ -27,11 +42,16 @@ class Replicator:
     replication_type = "tokens"
 
     def __init__(self, primary_store, secondary_store,
-                 interval: float = 30.0, source_dc: str = "dc1"):
+                 interval: float = 30.0, source_dc: str = "dc1",
+                 gate: Optional[Callable[[], bool]] = None):
         self.primary = primary_store
         self.secondary = secondary_store
         self.interval = interval
         self.source_dc = source_dc
+        # leadership gate: the reference starts replication routines
+        # from the leader loop (leader.go) — only the secondary DC's
+        # LEADER replicates, so a follower's loop idles until it wins
+        self.gate = gate
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.last_round: Tuple[int, int] = (0, 0)  # (upserts, deletes)
@@ -41,6 +61,16 @@ class Replicator:
         self.last_error_message: Optional[str] = None
         self.replicated_index = 0
         self.rounds = 0
+        # divergence surface: a successful round PROVES sync (the diff
+        # applied everything), so lag counts up from the last clean
+        # round; a failed round (partitioned primary) means sync can
+        # no longer be proven → diverged until the next clean round
+        self.diverged = False
+        self.lag_s = 0.0
+        self.last_divergence_check: Optional[float] = None
+        self.content_hash_local: Optional[str] = None
+        self.content_hash_primary: Optional[str] = None
+        self._synced_at: Optional[float] = None
 
     def run_once(self) -> Tuple[int, int]:  # pragma: no cover
         raise NotImplementedError
@@ -53,11 +83,76 @@ class Replicator:
         except Exception as e:
             self.last_error = time.time()
             self.last_error_message = f"{type(e).__name__}: {e}"
+            self._note_divergence(diverged=True)
             raise
         self.rounds += 1
         self.last_success = time.time()
         self.replicated_index = getattr(self.primary, "index", 0)
+        self._synced_at = time.time()
+        self._note_divergence(diverged=False)
         return out
+
+    # ----------------------------------------------------- divergence checker
+
+    def snapshot(self, store) -> list:  # pragma: no cover
+        """The canonical replicated payload on `store` — what the two
+        sides must agree on for this replication type.  Subclasses
+        strip store-local fields (index columns) the same way their
+        diff does."""
+        raise NotImplementedError
+
+    def content_hash(self, store) -> str:
+        """Order-independent content hash of the replicated payload."""
+        payload = json.dumps(self.snapshot(store), sort_keys=True,
+                             default=str)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+    def check_divergence(self) -> dict:
+        """Compare both sides' content hashes WITHOUT applying a diff.
+        Primary unreachable (partition) counts as diverged: sync can
+        no longer be proven.  Feeds the SLIs + flight transitions."""
+        self.content_hash_local = self.content_hash(self.secondary)
+        try:
+            self.content_hash_primary = self.content_hash(self.primary)
+            diverged = self.content_hash_primary \
+                != self.content_hash_local
+            reason = "content" if diverged else None
+        except Exception as e:
+            self.content_hash_primary = None
+            diverged = True
+            reason = f"unreachable: {type(e).__name__}"
+        if not diverged:
+            self._synced_at = time.time()
+        self._note_divergence(diverged=diverged)
+        self.last_divergence_check = time.time()
+        return {"diverged": diverged, "reason": reason,
+                "local_hash": self.content_hash_local,
+                "primary_hash": self.content_hash_primary,
+                "lag_s": self.lag_s}
+
+    def _note_divergence(self, diverged: bool) -> None:
+        """Update lag + diverged state, publish the SLIs, and journal
+        the TRANSITIONS (not every round — a long partition is one
+        diverged event, not one per retry)."""
+        now = time.time()
+        if self._synced_at is None:
+            self._synced_at = now
+        self.lag_s = 0.0 if not diverged \
+            else max(0.0, now - self._synced_at)
+        was = self.diverged
+        self.diverged = diverged
+        labels = {"type": self.replication_type}
+        telemetry.set_gauge(("replication", "lag"), self.lag_s,
+                            labels=labels)
+        telemetry.set_gauge(("replication", "diverged"),
+                            1.0 if diverged else 0.0, labels=labels)
+        if was != diverged:
+            from consul_tpu import flight
+            flight.emit(
+                "replication.diverged" if diverged
+                else "replication.converged",
+                labels={"type": self.replication_type,
+                        "source_dc": self.source_dc})
 
     @property
     def running(self) -> bool:
@@ -81,6 +176,11 @@ class Replicator:
             "LastSuccess": stamp(self.last_success),
             "LastError": stamp(self.last_error),
             "LastErrorMessage": self.last_error_message,
+            "Diverged": self.diverged,
+            "LagSeconds": round(self.lag_s, 3),
+            "LastDivergenceCheck": stamp(self.last_divergence_check),
+            "ContentHash": self.content_hash_local,
+            "Rounds": self.rounds,
         }
 
     def start(self) -> None:
@@ -88,6 +188,11 @@ class Replicator:
 
         def loop():
             while not self._stop.is_set():
+                if self.gate is not None and not self.gate():
+                    # not the leader: idle without touching status —
+                    # the leader's loop owns the round bookkeeping
+                    self._stop.wait(self.interval)
+                    continue
                 try:
                     self.run_round()
                 except Exception:
@@ -162,6 +267,65 @@ class AclReplicator(Replicator):
         self.last_round = (ups, dels)
         return ups, dels
 
+    def snapshot(self, store) -> list:
+        pols = [{"id": p["id"], "name": p["name"],
+                 "rules": p["rules"],
+                 "description": p.get("description", "")}
+                for p in store.acl_policy_list()]
+        toks = [{"accessor": t["accessor"], "secret": t["secret"],
+                 "policies": t["policies"],
+                 "type": t.get("type"),
+                 "description": t.get("description", ""),
+                 "service_identities":
+                     t.get("service_identities") or [],
+                 "node_identities": t.get("node_identities") or []}
+                for t in store.acl_token_list() if not t.get("local")]
+        return [sorted(pols, key=lambda p: p["id"]),
+                sorted(toks, key=lambda t: t["accessor"])]
+
+
+class IntentionReplicator(Replicator):
+    """Primary → secondary connect-intention sync: the mesh's
+    allow/deny graph written in the primary DC must converge to every
+    secondary (the reference replicates intentions as config entries,
+    agent/consul/config_replication.go; here they are first-class
+    store rows keyed by id)."""
+
+    replication_type = "intentions"
+
+    @staticmethod
+    def _strip(i: dict) -> dict:
+        return {"id": i["id"], "source": i["source"],
+                "destination": i["destination"],
+                "action": i["action"],
+                "description": i.get("description", ""),
+                "meta": i.get("meta") or {}}
+
+    def run_once(self):
+        ups = dels = 0
+        prim = {i["id"]: self._strip(i)
+                for i in self.primary.intention_list()}
+        sec = {i["id"]: self._strip(i)
+               for i in self.secondary.intention_list()}
+        # deletes first: a delete+recreate of the same (src, dst) pair
+        # under a new id would otherwise trip the store's duplicate-
+        # pair check and wedge every later round
+        for iid in set(sec) - set(prim):
+            self.secondary.intention_delete(iid)
+            dels += 1
+        for iid, body in prim.items():
+            if sec.get(iid) != body:
+                self.secondary.intention_set(
+                    iid, body["source"], body["destination"],
+                    body["action"], body.get("description", ""),
+                    body.get("meta") or {})
+                ups += 1
+        self.last_round = (ups, dels)
+        return ups, dels
+
+    def snapshot(self, store) -> list:
+        return sorted((self._strip(i) for i in store.intention_list()),
+                      key=lambda i: i["id"])
 
 
 class ConfigEntryReplicator(Replicator):
@@ -196,6 +360,13 @@ class ConfigEntryReplicator(Replicator):
         self.last_round = (ups, dels)
         return ups, dels
 
+    def snapshot(self, store) -> list:
+        def strip(e):
+            return {k: v for k, v in e.items()
+                    if k not in ("create_index", "modify_index")}
+        return sorted((strip(e) for e in store.config_entry_list()),
+                      key=lambda e: (e["kind"], e["name"]))
+
 
 class FederationStateReplicator(Replicator):
     """Primary → secondary federation-state sync
@@ -224,3 +395,77 @@ class FederationStateReplicator(Replicator):
                 ups += 1
         self.last_round = (ups, dels)
         return ups, dels
+
+    def snapshot(self, store) -> list:
+        return sorted(
+            ({"datacenter": f["datacenter"],
+              "mesh_gateways": f["mesh_gateways"],
+              "updated": f.get("updated", "")}
+             for f in store.federation_state_list()),
+            key=lambda f: f["datacenter"])
+
+
+class RemoteDcStore:
+    """Read-only store adapter over the PRIMARY datacenter's HTTP
+    surface: list calls hit `GET /v1/internal/replication/<what>` with
+    `?dc=<primary>` on the LOCAL front, which WAN-forwards through the
+    mesh gateways (api/http.py `_DC_FORWARDABLE`) — so severing the
+    gateway link severs replication, exactly the failure the
+    divergence checker must observe.  Short timeouts keep a partition
+    from wedging a replication round for the client default 30 s."""
+
+    def __init__(self, client, dc: str, timeout: float = 3.0):
+        self.client = client
+        self.dc = dc
+        self.timeout = timeout
+        self.index = 0
+
+    def _rows(self, what: str) -> list:
+        data, _idx, _raw = self.client._call(
+            "GET", f"/v1/internal/replication/{what}",
+            params={"dc": self.dc}, timeout=self.timeout)
+        self.index = int((data or {}).get("index", 0))
+        return (data or {}).get("rows", [])
+
+    def acl_policy_list(self):
+        return self._rows("policies")
+
+    def acl_token_list(self):
+        return self._rows("tokens")
+
+    def intention_list(self):
+        return self._rows("intentions")
+
+    def config_entry_list(self):
+        return self._rows("config-entries")
+
+    def federation_state_list(self):
+        return self._rows("federation-states")
+
+
+def build_replicators(primary_store, secondary, source_dc: str,
+                      interval: float = 5.0,
+                      gate: Optional[Callable[[], bool]] = None,
+                      include_federation: bool = False) -> list:
+    """The secondary-DC replication set the leader loop runs
+    (leader.go:873-896 starts ACL + config + federation-state
+    replication routines together).  Federation states are OFF by
+    default: deployments that advertise DC-local gateway addresses
+    (each DC dials the remote through its own WAN link, as LiveWan
+    does) must not have the primary's self-view clobber the
+    secondary's routes — the primary holds no row for itself, so a
+    full-diff round would DELETE the secondary's route back to it."""
+    reps = [
+        AclReplicator(primary_store, secondary, interval=interval,
+                      source_dc=source_dc, gate=gate),
+        IntentionReplicator(primary_store, secondary, interval=interval,
+                            source_dc=source_dc, gate=gate),
+        ConfigEntryReplicator(primary_store, secondary,
+                              interval=interval, source_dc=source_dc,
+                              gate=gate),
+    ]
+    if include_federation:
+        reps.append(FederationStateReplicator(
+            primary_store, secondary, interval=interval,
+            source_dc=source_dc, gate=gate))
+    return reps

@@ -149,6 +149,15 @@ class ApiServer:
         # status GET /v1/acl/replication reports (None = replication
         # not enabled on this agent)
         self.acl_replicator = None
+        # secondary-DC replication SET (ISSUE 18): every live
+        # Replicator (tokens/intentions/config-entries/federation-
+        # states) — statuses served at /v1/internal/ui/replication,
+        # scraped into federation_view + debug bundles
+        self.replicators = []
+        # self-sizing write limits: the DynamicLimitController when
+        # armed (--rate-limit dynamic=1); exposed so introspection can
+        # report the CURRENT walked write_rate
+        self.limit_controller = None
         # multi-DC: a WanRouter enables ?dc= forwarding + query failover
         # (agent/consul/rpc.go:658 forwardDC)
         self.router = None
@@ -1130,10 +1139,15 @@ def _make_handler(srv: ApiServer):
             return True
 
         # dc-forwardable surfaces (the reference forwards catalog-style
-        # RPCs only; /v1/agent/* and /v1/acl/* are strictly local)
+        # RPCs only; /v1/agent/* and /v1/acl/* are strictly local).
+        # /v1/internal/replication/ rides the same WAN forward: a
+        # secondary's replicators reach the primary THROUGH the mesh
+        # gateways, so severing a gateway link severs replication —
+        # the failure mode the divergence checker exists to observe.
         _DC_FORWARDABLE = ("/v1/kv/", "/v1/catalog/", "/v1/health/",
                            "/v1/query", "/v1/session/", "/v1/coordinate/",
-                           "/v1/event/", "/v1/txn")
+                           "/v1/event/", "/v1/txn",
+                           "/v1/internal/replication/")
 
         # set per-request in _dispatch; class default covers error
         # paths that _send before resolution ran
@@ -2291,6 +2305,57 @@ def _make_handler(srv: ApiServer):
                     return True
                 from consul_tpu import introspect
                 self._send(introspect.xds_view(srv.cluster_nodes))
+                return True
+            if path == "/v1/internal/ui/replication" and verb == "GET":
+                # per-Replicator status table (ISSUE 18): lag,
+                # diverged, content hashes, rounds — the per-node
+                # surface federation_view + debug_bundle scrape.
+                # Readable without a token like /v1/acl/replication:
+                # hashes and lag leak no payload content.
+                reps = list(srv.replicators)
+                if srv.acl_replicator is not None \
+                        and srv.acl_replicator not in reps:
+                    reps.append(srv.acl_replicator)
+                rows = [r.status() for r in reps]
+                ctrl = srv.limit_controller
+                self._send({
+                    "node": srv.node_name, "dc": srv.dc,
+                    "replicators": rows,
+                    "write_rate": round(ctrl.rate, 1)
+                    if ctrl is not None else None})
+                return True
+            m = re.fullmatch(r"/v1/internal/replication/([a-z-]+)",
+                             path)
+            if m and verb == "GET":
+                # raw store-shaped replication feed (the internal
+                # replication RPCs, acl_replication.go /
+                # config_replication.go): a secondary DC's replicators
+                # list the primary's payload through this — reached
+                # cross-DC via the ?dc= WAN forward above.  Token and
+                # policy payloads carry SECRETS, so those lists demand
+                # acl:write (the replication token's bar in the
+                # reference); the mesh-routing lists settle for
+                # operator read via node+service read.
+                what = m.group(1)
+                listers = {
+                    "tokens": store.acl_token_list,
+                    "policies": store.acl_policy_list,
+                    "intentions": store.intention_list,
+                    "config-entries": store.config_entry_list,
+                    "federation-states": store.federation_state_list,
+                }
+                if what not in listers:
+                    self._err(404, f"unknown replication payload "
+                                   f"{what!r}")
+                    return True
+                if what in ("tokens", "policies"):
+                    if not self.authz.acl_write():
+                        return self._forbid()
+                elif not (self.authz.node_read_all()
+                          and self.authz.service_read_all()):
+                    return self._forbid()
+                self._send({"index": store.index,
+                            "rows": listers[what]()})
                 return True
             if path.startswith("/v1/internal/ui/metrics-proxy/") \
                     and verb == "GET":

@@ -201,7 +201,9 @@ class LiveServer:
                  rate_limit: Optional[str] = None,
                  dc: Optional[str] = None,
                  wanfed: bool = False,
-                 grpc_port: Optional[int] = None):
+                 grpc_port: Optional[int] = None,
+                 replicate_from: Optional[str] = None,
+                 replicate_interval: float = 1.0):
         self.name = name
         self.rpc_port = rpc_port
         self.http_port = http_port
@@ -213,6 +215,11 @@ class LiveServer:
         self.rate_limit = rate_limit
         self.dc = dc
         self.wanfed = wanfed
+        # secondary-DC replication (ISSUE 18): name of the primary DC
+        # this server's leader replicates ACL/intention/config state
+        # from, through its own ?dc= WAN forward
+        self.replicate_from = replicate_from
+        self.replicate_interval = replicate_interval
         # dc1=url|url,dc2=... — set by LiveWan AFTER construction
         # (every DC's ports exist before any process spawns)
         self.federation_http: Optional[str] = None
@@ -257,6 +264,10 @@ class LiveServer:
             cmd += ["--wanfed"]
         if self.federation_http:
             cmd += ["--federation-http", self.federation_http]
+        if self.replicate_from:
+            cmd += ["--replicate-from", self.replicate_from,
+                    "--replicate-interval",
+                    str(self.replicate_interval)]
         # per-generation log: the post-mortem evidence when a scenario
         # fails (never parsed, only for humans)
         # lint: ok=blocking-call (harness-side log file, not a tick thread)
@@ -340,7 +351,9 @@ class LiveCluster:
                  rate_limit: Optional[str] = None,
                  dc: Optional[str] = None,
                  wanfed: bool = False,
-                 grpc: bool = False):
+                 grpc: bool = False,
+                 replicate_from: Optional[str] = None,
+                 replicate_interval: float = 1.0):
         self.n = n
         self.dc = dc
         # one reservation batch held CONCURRENTLY: rpc, http (and grpc
@@ -384,7 +397,9 @@ class LiveCluster:
                 os.path.join(data_root, f"server{i}"), ",".join(parts),
                 storage_faults=storage_faults,
                 cluster_http=cluster_http, rate_limit=rate_limit,
-                dc=dc, wanfed=wanfed, grpc_port=grpc_ports[i]))
+                dc=dc, wanfed=wanfed, grpc_port=grpc_ports[i],
+                replicate_from=replicate_from,
+                replicate_interval=replicate_interval))
 
     # ------------------------------------------------------------ lifecycle
 
@@ -468,8 +483,38 @@ class LiveCluster:
             if a == i or b == i:
                 p.sever()
 
-    def sever_link(self, i: int, j: int) -> None:
-        self.proxies[(i, j)].sever()
+    @staticmethod
+    def _directions(i, j, direction):
+        """The directed pairs one (i, j, direction) spec names:
+        `out` is i→j only (the historical single-proxy default),
+        `in` is j→i, `both` is the full bidirectional partition."""
+        if direction not in ("out", "in", "both"):
+            raise ValueError(f"direction {direction!r} not one of "
+                             f"('out', 'in', 'both')")
+        pairs = []
+        if direction in ("out", "both"):
+            pairs.append((i, j))
+        if direction in ("in", "both"):
+            pairs.append((j, i))
+        return pairs
+
+    def sever_link(self, i: int, j: int,
+                   direction: str = "out") -> None:
+        """Sever the (i, j) link — one-directional by default, so
+        asymmetric partitions (i can't reach j but j still reaches i)
+        are expressible; direction="both" severs the pair."""
+        for pair in self._directions(i, j, direction):
+            self.proxies[pair].sever()
+
+    def heal_link(self, i: int, j: int,
+                  direction: str = "both") -> None:
+        """Heal one link (both directions by default) without
+        touching any other fault — the scalpel next to heal()'s
+        fix-everything escape hatch."""
+        for pair in self._directions(i, j, direction):
+            p = self.proxies[pair]
+            p.heal()
+            p.set_delay(0.0)
 
     def delay_node(self, i: int, seconds: float) -> None:
         for (a, b), p in self.proxies.items():
@@ -504,10 +549,21 @@ class LiveWan:
     servers — only dc2's gateway is ever dialed."""
 
     def __init__(self, data_root: str = ".", dcs=("dc1", "dc2"),
-                 n: int = 3):
+                 n: int = 3, rate_limit: Optional[str] = None,
+                 replicate: bool = False,
+                 replicate_interval: float = 1.0):
+        # replicate=True: the FIRST dc is the primary; every other
+        # DC's leader runs the secondary replication set against it
+        # (ACL tokens/policies, intentions, config entries) through
+        # the severable WAN links below
+        self.primary_dc = dcs[0]
         self.clusters: Dict[str, LiveCluster] = {
             dc: LiveCluster(n=n, data_root=os.path.join(data_root, dc),
-                            dc=dc, wanfed=True)
+                            dc=dc, wanfed=True, rate_limit=rate_limit,
+                            replicate_from=self.primary_dc
+                            if replicate and dc != self.primary_dc
+                            else None,
+                            replicate_interval=replicate_interval)
             for dc in dcs}
         # the federation spec is known before any process spawns
         # (every cluster reserved its HTTP ports at construction)
@@ -518,6 +574,11 @@ class LiveWan:
             for s in c.servers:
                 s.federation_http = fed
         self.gateways: Dict[str, MeshGatewayForwarder] = {}
+        # per-DIRECTION WAN links: (src, dst) → a LinkProxy fronting
+        # dst's gateway, advertised only to src's servers — so one
+        # direction of the WAN can be severed without touching the
+        # other (asymmetric partitions, ISSUE 18)
+        self.wan_links: Dict[Tuple[str, str], LinkProxy] = {}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -531,6 +592,14 @@ class LiveWan:
                     dc=dc, gw_name=f"{dc}-gw")
                 gw.start()
                 self.gateways[dc] = gw
+            for src in self.clusters:
+                for dst, gw in self.gateways.items():
+                    if src == dst:
+                        continue
+                    lp = LinkProxy((gw.host, gw.port),
+                                   name=f"{src}->{dst}-wan")
+                    lp.start()
+                    self.wan_links[(src, dst)] = lp
             self.advertise()
         except BaseException:
             self.stop()
@@ -539,13 +608,19 @@ class LiveWan:
     def advertise(self) -> None:
         """Plant every remote DC's gateway address in every server's
         federation states (the replicated-federation-state role; each
-        store is DC-local, so every server learns it directly)."""
+        store is DC-local, so every server learns it directly).  Each
+        src DC is pointed at its OWN (src, dst) wan link in front of
+        dst's gateway, so severing that link partitions exactly the
+        src→dst direction."""
         for src, cluster in self.clusters.items():
             for dst, gw in self.gateways.items():
                 if src == dst:
                     continue
+                link = self.wan_links.get((src, dst))
+                host, port = (link.host, link.port) \
+                    if link is not None else (gw.host, gw.port)
                 body = json.dumps({"MeshGateways": [
-                    {"address": gw.host, "port": gw.port}]}).encode()
+                    {"address": host, "port": port}]}).encode()
                 for s in cluster.servers:
                     req = urllib.request.Request(
                         f"{s.http}/v1/internal/federation-state/{dst}",
@@ -553,11 +628,41 @@ class LiveWan:
                     urllib.request.urlopen(req, timeout=5.0).read()
 
     def stop(self) -> None:
+        for lp in self.wan_links.values():
+            lp.stop()
+        self.wan_links = {}
         for gw in self.gateways.values():
             gw.stop()
         self.gateways = {}
         for c in self.clusters.values():
             c.stop()
+
+    # -------------------------------------------------------------- nemesis
+
+    def sever_link(self, a: str, b: str,
+                   direction: str = "both") -> None:
+        """Sever the WAN between DCs a and b: `out` cuts only a→b
+        (a's requests to b fail, b still reaches a — the asymmetric
+        partition), `in` cuts b→a, `both` the full partition."""
+        for src, dst in LiveCluster._directions(a, b, direction):
+            self.wan_links[(src, dst)].sever()
+
+    def heal_link(self, a: str, b: str,
+                  direction: str = "both") -> None:
+        """Heal one WAN link pair without touching anything else."""
+        for src, dst in LiveCluster._directions(a, b, direction):
+            lp = self.wan_links[(src, dst)]
+            lp.heal()
+            lp.set_delay(0.0)
+
+    def heal(self) -> None:
+        """The fix-everything escape hatch: every WAN link and every
+        intra-DC link healed, every delay cleared."""
+        for lp in self.wan_links.values():
+            lp.heal()
+            lp.set_delay(0.0)
+        for c in self.clusters.values():
+            c.heal()
 
     # -------------------------------------------------------------- queries
 
@@ -1670,6 +1775,294 @@ def live_gateway_loss(seed: int, check: bool = False) -> dict:
             "events": events}
 
 
+def live_wan_partition(seed: int, check: bool = False) -> dict:
+    """WAN partition under live replication (ISSUE 18 tentpole a+b):
+    a real two-DC LiveWan with dc2's leader replicating ACL tokens/
+    policies, intentions, and config entries from dc1 through the
+    severable per-direction WAN links.  The nemesis cuts ONLY the
+    dc2→dc1 direction (asymmetric partition): dc2's cross-DC requests
+    must fail fast and definitely while dc1→dc2 keeps working, the
+    replication divergence checker must report NONZERO divergence for
+    payloads written in dc1 during the cut, `federation_view` must
+    render the diverged DC as rows (with its lag) rather than dropping
+    it, and after `heal_link` everything must converge back to zero
+    divergence within the SLO, with the diverged→converged flight
+    transitions journaled on dc2's leader."""
+    from consul_tpu.acl.replication import (AclReplicator,
+                                            ConfigEntryReplicator,
+                                            IntentionReplicator,
+                                            RemoteDcStore)
+
+    rng = random.Random(seed)
+    plan: List[list] = []
+    violations: List[str] = []
+    detail: dict = {}
+    injected: List[list] = []
+    recorder = flight.FlightRecorder(clock=time.time,
+                                     forward_to_log=False)
+    t0 = time.time()
+
+    def fault(kind, target):
+        plan.append(["fault", kind])
+        injected.append([round(time.time() - t0, 2), kind, target])
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": kind, "target": target})
+
+    RECOVERY_SLO_S = 5.0      # post-heal cross-DC write must land
+    CONVERGE_S = 25.0         # replication must reconverge by here
+
+    def cross_dc(client, dc, key, timeout=4.0):
+        t = time.time()
+        try:
+            client._call("PUT", f"/v1/kv/{key}", {"dc": dc},
+                         body=b"v", timeout=timeout)
+            return {"ok": True, "lat": time.time() - t}
+        except (ApiError, OSError) as e:
+            return {"ok": False, "lat": time.time() - t,
+                    "ambiguous": getattr(e, "ambiguous", True)}
+
+    def rep_statuses(cluster):
+        """The diverged/lag rows off whichever node is running the
+        replication set (the leader's rounds advance; followers idle)."""
+        best = []
+        for i in cluster.alive_ids():
+            try:
+                out, _, _ = cluster.client(i, timeout=2.0)._call(
+                    "GET", "/v1/internal/ui/replication")
+            except (ApiError, OSError):
+                continue
+            rows = out.get("replicators") or []
+            if sum(r.get("Rounds", 0) for r in rows) > \
+                    sum(r.get("Rounds", 0) for r in best):
+                best = rows
+        return {r["ReplicationType"]: r for r in best}
+
+    def harness_checkers(wan):
+        """Harness-side divergence checkers over BOTH fronts directly
+        (localhost, never the severed WAN path): the independent
+        verdict the in-cluster checker is judged against."""
+        prim = lambda: RemoteDcStore(  # noqa: E731
+            wan.clusters["dc1"].client(0, timeout=3.0), "dc1")
+        sec = lambda: RemoteDcStore(  # noqa: E731
+            wan.clusters["dc2"].client(0, timeout=3.0), "dc2")
+        return [AclReplicator(prim(), sec()),
+                IntentionReplicator(prim(), sec()),
+                ConfigEntryReplicator(prim(), sec())]
+
+    wan = None
+    tmp = tempfile.TemporaryDirectory(prefix="chaos-live-wan-")
+    with flight.use(recorder):
+        try:
+            wan = LiveWan(data_root=tmp.name, replicate=True,
+                          replicate_interval=0.5)
+            wan.start()
+            dc1, dc2 = wan.clusters["dc1"], wan.clusters["dc2"]
+            lead1, lead2 = dc1.leader(), dc2.leader()
+            c1 = dc1.client(lead1, timeout=6.0)
+            c2 = dc2.client(lead2, timeout=6.0)
+            checkers = harness_checkers(wan)
+
+            # ---------------- phase 1: healthy — seed + converge
+            pol = c1.acl_policy_create(
+                "wan-base", 'key_prefix "" { policy = "read" }')
+            c1.acl_token_create([pol["Name"]],
+                                description="wan-base-token")
+            c1.intention_create("web", "db", "allow")
+            c1.config_write({"Kind": "service-resolver",
+                             "Name": "db"})
+            deadline = time.time() + 30.0
+            converged = False
+            while time.time() < deadline and not converged:
+                converged = all(not ck.check_divergence()["diverged"]
+                                for ck in checkers)
+                if not converged:
+                    _nap(0.5)
+            if not converged:
+                violations.append(
+                    "replication: secondary never converged on the "
+                    "seed payloads before the fault")
+            base = cross_dc(c2, "dc1", "wan/base")
+            if not base["ok"] or base["lat"] > RECOVERY_SLO_S:
+                violations.append(
+                    f"baseline cross-DC write dc2→dc1 not within SLO "
+                    f"({base})")
+
+            # ---------------- phase 2: asymmetric partition
+            fault("wan_sever", "dc2->dc1")
+            wan.sever_link("dc2", "dc1", direction="out")
+            # divergence fuel: new payloads land in the primary while
+            # the secondary cannot list it
+            pol2 = c1.acl_policy_create(
+                "wan-part", 'key_prefix "part/" { policy = "write" }')
+            c1.acl_token_create([pol2["Name"]],
+                                description="wan-part-token")
+            c1.intention_create("web", "cache", "deny")
+            c1.config_write({"Kind": "service-resolver",
+                             "Name": "cache"})
+            part_window = round(rng.uniform(4.0, 6.0), 3)
+            plan.append(["part_window", part_window])
+            _nap(part_window)
+            # asymmetry: dc1→dc2 must still work...
+            fwd = cross_dc(c1, "dc2", "wan/asym")
+            if not fwd["ok"]:
+                violations.append(
+                    f"asymmetric partition: dc1→dc2 write failed with "
+                    f"only dc2→dc1 severed ({fwd})")
+            # ...while dc2→dc1 fails FAST (bounded, no hang into the
+            # client timeout)
+            cut = cross_dc(c2, "dc1", "wan/cut")
+            if cut["ok"]:
+                violations.append(
+                    "partition: a dc2→dc1 write SUCCEEDED across the "
+                    "severed direction")
+            elif cut["lat"] > 3.0:
+                violations.append(
+                    f"partition: dc2→dc1 failed in {cut['lat']:.1f}s "
+                    f"— must fail fast, not hang")
+            # the harness checker proves NONZERO divergence
+            div = {type(ck).__name__: ck.check_divergence()
+                   for ck in checkers}
+            diverged_types = [k for k, v in div.items()
+                              if v["diverged"]]
+            if not diverged_types:
+                violations.append(
+                    "divergence: no payload class diverged although "
+                    "writes landed in dc1 behind a severed link")
+            # the IN-CLUSTER checker on dc2's leader must agree + lag
+            stats = rep_statuses(dc2)
+            in_cluster = [t for t, r in stats.items()
+                          if r.get("Diverged")]
+            if not in_cluster:
+                violations.append(
+                    f"divergence: dc2's own replication status shows "
+                    f"nothing diverged during the partition "
+                    f"({sorted(stats)})")
+            max_lag = max((r.get("LagSeconds", 0.0)
+                           for r in stats.values()), default=0.0)
+            if max_lag <= 0.0:
+                violations.append(
+                    "divergence: replication lag stayed zero through "
+                    "the partition")
+            # federation_view renders the diverged DC as ROWS with its
+            # lag — never an absence (scraped over localhost, so the
+            # WAN cut cannot hide a DC from the operator)
+            try:
+                fed, _, _ = c1._call("GET",
+                                     "/v1/internal/ui/federation")
+            except (ApiError, OSError) as e:
+                fed = None
+                violations.append(f"federation view unavailable "
+                                  f"during partition: {e}")
+            if fed is not None:
+                dcs = fed.get("dcs") or {}
+                if set(dcs) != {"dc1", "dc2"}:
+                    violations.append(
+                        f"federation view dropped a DC during the "
+                        f"partition (rows: {sorted(dcs)})")
+                row2 = dcs.get("dc2") or {}
+                rep_row = row2.get("replication") or {}
+                if not rep_row.get("diverged"):
+                    violations.append(
+                        "federation view: dc2 row does not surface "
+                        "its replication divergence")
+                detail["federation_during_partition"] = {
+                    "dcs": sorted(dcs),
+                    "dc2_replication": rep_row}
+
+            # ---------------- phase 3: heal + reconverge
+            plan.append(["heal", "dc2->dc1"])
+            injected.append([round(time.time() - t0, 2), "heal",
+                             "dc2->dc1"])
+            flight.emit("chaos.fault.healed",
+                        labels={"fault": "wan_sever",
+                                "target": "dc2->dc1"})
+            wan.heal_link("dc2", "dc1")
+            deadline = time.time() + CONVERGE_S
+            reconverged = False
+            while time.time() < deadline and not reconverged:
+                reconverged = all(
+                    not ck.check_divergence()["diverged"]
+                    for ck in checkers)
+                if not reconverged:
+                    _nap(0.5)
+            if not reconverged:
+                violations.append(
+                    f"heal: replication divergence did not converge "
+                    f"to zero within {CONVERGE_S}s")
+            stats = rep_statuses(dc2)
+            still = [t for t, r in stats.items() if r.get("Diverged")]
+            if reconverged and still:
+                violations.append(
+                    f"heal: dc2 still reports {still} diverged after "
+                    f"the harness checker converged")
+            # post-heal recovery SLO: the severed direction serves
+            t_heal = time.time()
+            post = cross_dc(c2, "dc1", "wan/healed",
+                            timeout=RECOVERY_SLO_S)
+            while not post["ok"] \
+                    and time.time() - t_heal < RECOVERY_SLO_S:
+                _nap(0.3)
+                post = cross_dc(c2, "dc1", "wan/healed",
+                                timeout=RECOVERY_SLO_S)
+            if not post["ok"]:
+                violations.append(
+                    f"heal: dc2→dc1 writes never recovered within "
+                    f"{RECOVERY_SLO_S}s ({post})")
+            # the diverged→converged transitions journaled on dc2
+            names = set()
+            for i in dc2.alive_ids():
+                try:
+                    evs, _ = dc2.client(i, timeout=2.0).agent_events()
+                    names |= {e.get("Name") for e in evs}
+                except (ApiError, OSError):
+                    continue
+            for want in ("replication.diverged",
+                         "replication.converged"):
+                if want not in names:
+                    violations.append(
+                        f"flight: {want} never journaled on any dc2 "
+                        f"node across the partition arc")
+            detail.update({
+                "diverged_types": diverged_types,
+                "in_cluster_diverged": in_cluster,
+                "max_lag_s": round(max_lag, 2),
+                "asym_forward_ok": fwd["ok"],
+                "cut_latency_s": round(cut["lat"], 2),
+                "recovered": post["ok"],
+                "statuses_after": {t: {k: r.get(k) for k in
+                                       ("Diverged", "LagSeconds",
+                                        "Rounds")}
+                                   for t, r in stats.items()},
+            })
+        except Exception:
+            import traceback
+            tb = traceback.format_exc()
+            violations.append(
+                f"scenario crashed: {tb.strip().splitlines()[-1]}")
+            detail["traceback"] = tb
+        finally:
+            if wan is not None:
+                wan.stop()
+            try:
+                tmp.cleanup()
+            except OSError:
+                pass
+    rows, _ = recorder.read_page(since=0)
+    events = "\n".join(
+        json.dumps({"ts": round(r["ts"], 3), "node": "nemesis",
+                    "name": r["name"], "labels": r["labels"]},
+                   sort_keys=True) for r in rows)
+    digest = hashlib.sha256(
+        json.dumps(plan, sort_keys=True).encode()).hexdigest()[:16]
+    return {"scenario": "live_wan_partition", "seed": seed,
+            "ok": not violations, "violations": violations,
+            "digest": digest, "plan": plan, "injected": injected,
+            "detail": detail,
+            "repro": f"python tools/chaos_live.py --scenario "
+                     f"live_wan_partition --seed {seed}",
+            "events": events}
+
+
 LIVE_SCENARIOS = {
     "live_partition_heal": live_partition_heal,
     "live_kill_leader_loop": live_kill_leader_loop,
@@ -1680,6 +2073,7 @@ LIVE_SCENARIOS = {
     "live_stale_reads_through_election":
         live_stale_reads_through_election,
     "live_overload_shed": live_overload_shed,
+    "live_wan_partition": live_wan_partition,
 }
 
 # the bounded tier-1 smoke (chaos_soak --check): kill -9 the leader,

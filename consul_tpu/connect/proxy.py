@@ -26,7 +26,15 @@ import tempfile
 import threading
 from typing import Callable, List, Optional, Tuple
 
-from cryptography import x509
+# lazy crypto (same gate as connect/ca.py / tlsutil.py): the module
+# must import without the 'cryptography' package — only SPIFFE peer
+# verification on a live mTLS splice needs the real parser
+try:  # pragma: no cover - import guard
+    from cryptography import x509
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover
+    x509 = None
+    HAVE_CRYPTO = False
 
 from consul_tpu.connect import intentions as imod
 from consul_tpu.utils.net import shutdown_and_close
@@ -64,6 +72,10 @@ def _pipe(a: socket.socket, b: socket.socket) -> None:
 def peer_spiffe_uri(tls_sock: ssl.SSLSocket) -> Optional[str]:
     """The spiffe:// URI SAN from the peer's (already chain-verified)
     certificate."""
+    if not HAVE_CRYPTO:
+        raise RuntimeError(
+            "peer_spiffe_uri requires the 'cryptography' package "
+            "(X.509 SAN parsing)")
     der = tls_sock.getpeercert(binary_form=True)
     if not der:
         return None

@@ -157,6 +157,22 @@ CATALOG: Dict[str, dict] = {
                            "labels": ("route_class", "mode")},
     "raft.apply.rejected": {"severity": "warn",
                             "labels": ("reason", "pending")},
+    # self-sizing limits (consul_tpu/ratelimit.py DynamicLimitController,
+    # ISSUE 18): every AIMD walk of the write_rate journals one row —
+    # direction is `decrease` (multiplicative backoff on an overloaded
+    # apply EMA / visibility p99) or `increase` (additive probe after
+    # the hysteresis streak of healthy ticks)
+    "ratelimit.adjusted": {"severity": "info",
+                           "labels": ("direction", "rate", "reason")},
+    # cross-DC replication divergence TRANSITIONS (acl/replication.py,
+    # ISSUE 18): one row when a replicator can no longer prove sync
+    # with the primary (content-hash mismatch or unreachable primary
+    # under a WAN partition), one when a clean round converges it
+    # back — transitions, not rounds, so a long partition is one row
+    "replication.diverged": {"severity": "warn",
+                             "labels": ("type", "source_dc")},
+    "replication.converged": {"severity": "info",
+                              "labels": ("type", "source_dc")},
     # stream plane: a subscriber whose bounded buffer filled without a
     # drain (sustained lag) was EVICTED — its consumer gets a
     # SnapshotRequired reset; `count` aggregates evictions staged in

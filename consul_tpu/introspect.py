@@ -338,6 +338,17 @@ def scrape_node(url: str, events_since: int = 0,
         except (ApiError, OSError) as e:
             # partial scrapes still merge — but loudly
             row["degraded"].append({"surface": field, "error": str(e)})
+    # cross-DC replication status (ISSUE 18): present only on nodes
+    # running a secondary-DC replication set — absence is NORMAL (a
+    # primary-DC node), so a 404/None never degrades the scrape
+    try:
+        rep, _, _ = c._call("GET", "/v1/internal/ui/replication")
+        if rep and rep.get("replicators"):
+            row["replication"] = rep
+        elif rep and rep.get("write_rate") is not None:
+            row["replication"] = rep
+    except (ApiError, OSError):
+        pass
     try:
         events, cursor = c.agent_events(since=events_since,
                                         limit=events_limit)
@@ -506,6 +517,28 @@ def federation_from_scrapes(
             all_events.append(e)
         lag = dcv.get("replication_lag") or {}
         wakeup = (dcv.get("visibility") or {}).get("wakeup") or {}
+        # cross-DC replication divergence/lag (ISSUE 18): the leader's
+        # replication set is the one whose rounds advance, so report
+        # the node with the most rounds; the dynamic write_rate rides
+        # the same per-node surface
+        rep_best: list = []
+        write_rate = None
+        for _name, r in scraped:
+            rep = r.get("replication") or {}
+            rows = rep.get("replicators") or []
+            if sum(s.get("Rounds", 0) for s in rows) > \
+                    sum(s.get("Rounds", 0) for s in rep_best):
+                rep_best = rows
+            if rep.get("write_rate") is not None:
+                write_rate = rep["write_rate"]
+        replication = {
+            "max_lag_s": round(max(
+                (s.get("LagSeconds", 0.0) or 0.0
+                 for s in rep_best), default=0.0), 3),
+            "diverged": sorted(s["ReplicationType"] for s in rep_best
+                               if s.get("Diverged")),
+            "types": sorted(s["ReplicationType"] for s in rep_best),
+        } if rep_best else None
         view["dcs"][dc] = {
             "leader": dcv["leader"],
             "nodes": dcv["nodes"],
@@ -520,6 +553,8 @@ def federation_from_scrapes(
                                for r in lag.values()), default=0.0),
             "wakeup_p50_ms": wakeup.get("p50_ms"),
             "wakeup_p99_ms": wakeup.get("p99_ms"),
+            "replication": replication,
+            "write_rate": write_rate,
         }
     view["events"] = merge_timelines(all_events)
     view["generated_at"] = round(time.time(), 3)

@@ -48,6 +48,7 @@ caused it.
 from __future__ import annotations
 
 import math
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -345,6 +346,124 @@ class ApplyGate:
                            f"budget={budget_s:.3f}s")
 
 
+# ---------------------------------------------------------------------------
+# self-sizing write limits (ISSUE 18)
+# ---------------------------------------------------------------------------
+
+
+class DynamicLimitController:
+    """AIMD walk of the ingress `write_rate` against the observed
+    apply latency: the reference sizes write limits from measured
+    apply cost rather than a hand-set constant (`agent/consul/rate`
+    + the leader's apply telemetry).  Additive increase probes for
+    headroom only after `hysteresis` consecutive healthy ticks (the
+    anti-oscillation guard); multiplicative decrease backs off the
+    moment the ApplyGate's commit EMA or the visibility p99 crosses
+    its high-water mark.  `step()` is PURE given its inputs so the
+    convergence/no-oscillation dynamics unit-test without a cluster
+    (tests/test_overload.py); the thread loop just samples the live
+    gate + visibility and applies the decision."""
+
+    def __init__(self, limiter: RateLimiter, apply_gate: ApplyGate,
+                 vis_p99_fn=None,
+                 floor: float = 20.0, ceiling: float = 2000.0,
+                 increase: float = 10.0, decrease_factor: float = 0.5,
+                 ema_high_s: float = 0.25, vis_high_ms: float = 2000.0,
+                 hysteresis: int = 3, interval: float = 1.0):
+        self.limiter = limiter
+        self.apply_gate = apply_gate
+        self.vis_p99_fn = vis_p99_fn
+        self.floor = float(floor)
+        self.ceiling = float(ceiling)
+        self.increase = float(increase)
+        self.decrease_factor = float(decrease_factor)
+        self.ema_high_s = float(ema_high_s)
+        self.vis_high_ms = float(vis_high_ms)
+        self.hysteresis = int(hysteresis)
+        self.interval = float(interval)
+        self.rate = float(limiter._write[0])
+        self.healthy_streak = 0
+        self.adjustments = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        telemetry.set_gauge(("ratelimit", "rate"), self.rate)
+
+    # ------------------------------------------------------------------ pure
+
+    def step(self, ema_s: float, p99_ms: Optional[float] = None
+             ) -> Optional[str]:
+        """One control tick: returns `decrease`/`increase`/None.
+        AIMD with hysteresis — decrease is immediate and
+        multiplicative (halve toward the floor), increase is additive
+        and only after `hysteresis` consecutive healthy ticks, so the
+        walk converges to a sawtooth under sustained load instead of
+        oscillating rail to rail."""
+        overloaded = ema_s > self.ema_high_s or (
+            p99_ms is not None and p99_ms > self.vis_high_ms)
+        if overloaded:
+            self.healthy_streak = 0
+            new = max(self.floor, self.rate * self.decrease_factor)
+            if new < self.rate:
+                self._apply(new, "decrease",
+                            "ema" if ema_s > self.ema_high_s
+                            else "visibility")
+                return "decrease"
+            return None
+        self.healthy_streak += 1
+        if self.healthy_streak >= self.hysteresis:
+            self.healthy_streak = 0
+            new = min(self.ceiling, self.rate + self.increase)
+            if new > self.rate:
+                self._apply(new, "increase", "healthy")
+                return "increase"
+        return None
+
+    def _apply(self, new_rate: float, direction: str,
+               reason: str) -> None:
+        self.rate = new_rate
+        self.adjustments += 1
+        # burst tracks rate at the limiter's default 2× ratio so a
+        # shrunken rate also shrinks the burst headroom
+        self.limiter.configure(write_rate=new_rate,
+                               write_burst=new_rate * 2)
+        telemetry.set_gauge(("ratelimit", "rate"), new_rate)
+        telemetry.incr_counter(("ratelimit", "adjust"),
+                               labels={"direction": direction})
+        from consul_tpu import flight
+        flight.emit("ratelimit.adjusted",
+                    labels={"direction": direction,
+                            "rate": int(new_rate), "reason": reason})
+
+    # ------------------------------------------------------------------ live
+
+    def tick(self) -> Optional[str]:
+        """Sample the live gate + visibility plane and step once."""
+        with self.apply_gate._lock:
+            ema = self.apply_gate._ema_commit_s
+        p99 = self.vis_p99_fn() if self.vis_p99_fn is not None else None
+        return self.step(ema, p99)
+
+    def start(self) -> None:
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.tick()
+                except Exception:
+                    pass  # a failed sample must not kill the controller
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+
 def retry_after_header(wait_s: float) -> str:
     """Retry-After is whole seconds on the wire (RFC 9110); always at
     least 1 so a client honoring it actually backs off."""
@@ -369,6 +488,14 @@ def parse_limit_spec(spec: str) -> dict:
             out[k] = float(v)
         elif k in ("apply_max_pending",):
             out[k] = int(v)
+        elif k == "dynamic":
+            # self-sizing write limits (DynamicLimitController):
+            # dynamic=1 arms the AIMD controller; the *_floor/_ceiling/
+            # _interval keys bound its walk
+            out[k] = bool(int(v))
+        elif k in ("dynamic_floor", "dynamic_ceiling",
+                   "dynamic_interval"):
+            out[k] = float(v)
         else:
             raise ValueError(f"unknown rate-limit key {k!r}")
     return out

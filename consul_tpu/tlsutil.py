@@ -22,7 +22,17 @@ import ssl
 import tempfile
 from typing import Optional, Tuple
 
-from cryptography import x509
+# lazy crypto (same gate as connect/ca.py): importing this module must
+# work without the 'cryptography' package so test collection and
+# transitive importers (connect proxy wiring) degrade to a clean skip
+# instead of a collection error; only actually minting certificates
+# requires the real dependency
+try:  # pragma: no cover - import guard
+    from cryptography import x509
+    HAVE_CRYPTO = True
+except ImportError:  # pragma: no cover
+    x509 = None
+    HAVE_CRYPTO = False
 
 
 def _write_tmp(data: str) -> str:
@@ -39,6 +49,11 @@ class Configurator:
                  verify_server_hostname: bool = False,
                  ca_cert_pem: Optional[str] = None,
                  ca_key_pem: Optional[str] = None):
+        if not HAVE_CRYPTO:
+            raise RuntimeError(
+                "tlsutil.Configurator requires the 'cryptography' "
+                "package (certificate minting rides its X.509 "
+                "builder)")
         from consul_tpu.connect.ca import BuiltinCA
         self.dc = dc
         self.domain = domain

@@ -14,7 +14,7 @@ from consul_tpu.acl.authmethod import make_jwt
 from consul_tpu.consensus.raft import RaftConfig
 from consul_tpu.rpc import RpcClient, RpcError, TcpTransport
 from consul_tpu.server import Server
-from consul_tpu.tlsutil import Configurator
+from consul_tpu.tlsutil import HAVE_CRYPTO, Configurator
 
 
 class _Cluster:
@@ -132,6 +132,9 @@ def test_disabled_by_default():
         c.stop()
 
 
+@pytest.mark.skipif(not HAVE_CRYPTO,
+                    reason="cert minting requires the "
+                           "'cryptography' package")
 def test_auto_config_over_bootstrap_listener(tmp_path):
     """The certless bootstrap listener serves auto_config: a fresh
     agent with only the CA + an intro JWT gets token AND certs."""

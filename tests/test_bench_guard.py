@@ -132,6 +132,30 @@ def test_guard_tolerates_wan_and_federation_stamps():
     assert not out["ok"] and out["verdict"] == "topology"
 
 
+def test_guard_tolerates_self_defense_stamps():
+    """ISSUE 18: CHAOS_r05/SOAK_r02 evidence decorates result rows
+    with {"wan_partition": ...} (divergence/heal arc),
+    {"controller": ...} (the AIMD walk), and {"replication": ...}
+    (per-type lag/divergence) stamps — metadata the judge must
+    tolerate while still judging ONLY the median + accuracy gates."""
+    base = {"metric": METRIC, "median_s": 0.600}
+    row = {**_row(0.650),
+           "wan_partition": {"diverged": True, "healed": True,
+                             "max_lag_s": 6.0,
+                             "direction": "dc2->dc1"},
+           "controller": {"floor": 40, "ceiling": 150,
+                          "adjustments": {"decrease": 2,
+                                          "increase": 9},
+                          "final_rate": 120.0},
+           "replication": {"types": ["tokens", "intentions",
+                                     "config-entries"],
+                           "diverged": [], "max_lag_s": 0.0}}
+    assert judge([row], base)["ok"]
+    # a stamped row over threshold still fails on the MEDIAN, proving
+    # the stamps were ignored rather than short-circuiting the judge
+    assert not judge([{**row, "value": 0.900}], base)["ok"]
+
+
 def test_checked_in_baseline_is_valid_and_matches_roundtrip():
     b = load_baseline()
     assert b["metric"] == METRIC

@@ -13,7 +13,12 @@ import urllib.error
 import urllib.request
 
 import pytest
-from cryptography import x509
+
+# skip (not error) the whole module when the optional 'cryptography'
+# package is absent: every test here builds real X.509 material
+pytest.importorskip("cryptography",
+                    reason="requires the 'cryptography' package")
+from cryptography import x509  # noqa: E402
 
 from consul_tpu.connect.ca import (
     BuiltinCA, CAManager, CARateLimitError, ExternalCA,

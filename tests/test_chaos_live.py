@@ -304,3 +304,21 @@ def test_proxy_partition_and_heal(live_cluster):
         c.heal()
     # the healed ex-leader catches up
     assert _await_local(c, li, "t/during-partition", b"3")
+
+
+def test_directions_spec_maps_to_directed_pairs():
+    """(i, j, direction) → directed proxy pairs: `out` is i→j only
+    (the historical single-proxy default), `in` is j→i, `both` is
+    the full bidirectional partition — the vocabulary sever_link/
+    heal_link and the live_wan_partition scenario speak."""
+    d = LiveCluster._directions
+    assert d(0, 2, "out") == [(0, 2)]
+    assert d(0, 2, "in") == [(2, 0)]
+    assert d(0, 2, "both") == [(0, 2), (2, 0)]
+    # a one-directional sever and its mirror name disjoint pairs, so
+    # cutting dc2→dc1 provably leaves dc1→dc2 forwarding
+    assert set(d(1, 0, "out")).isdisjoint(d(1, 0, "in"))
+    with pytest.raises(ValueError):
+        d(0, 1, "sideways")
+    with pytest.raises(ValueError):
+        d(0, 1, "")

@@ -21,9 +21,17 @@ import urllib.request
 
 import pytest
 
-from consul_tpu.agent import Agent
-from consul_tpu.config import GossipConfig, SimConfig
-from consul_tpu.connect.proxy import HttpUpstreamListener, SidecarProxy
+# these tests drive real mTLS sidecar pairs, which need real X.509
+# leaves (ssl.load_cert_chain): skip the module cleanly when the
+# optional 'cryptography' package is absent (same gate as
+# test_connect_proxy)
+pytest.importorskip("cryptography",
+                    reason="requires the 'cryptography' package")
+
+from consul_tpu.agent import Agent  # noqa: E402
+from consul_tpu.config import GossipConfig, SimConfig  # noqa: E402
+from consul_tpu.connect.proxy import (HttpUpstreamListener,  # noqa: E402
+                                      SidecarProxy)
 
 
 class HttpEcho:

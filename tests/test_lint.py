@@ -772,6 +772,56 @@ def test_issue16_xds_metric_and_event_names_registered():
     assert any("push ms!" in f.message for f in mn)
 
 
+def test_issue18_selfdefense_metric_and_event_names_registered():
+    """The self-defense vocabulary (ISSUE 18 satellite): the
+    consul.replication.{lag,diverged} / consul.ratelimit.{rate,adjust}
+    families pass the metric gate and the ratelimit.adjusted /
+    replication.{diverged,converged} events are registered in CATALOG
+    with their exact label sets — while a malformed sibling or
+    undeclared label still fires (the checker gates the NEW
+    vocabulary, not just the old)."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def defend(direction, rate, reason, typ, dc, lag, n):
+            flight.emit("ratelimit.adjusted",
+                        labels={"direction": direction, "rate": rate,
+                                "reason": reason})
+            flight.emit("replication.diverged",
+                        labels={"type": typ, "source_dc": dc})
+            flight.emit("replication.converged",
+                        labels={"type": typ, "source_dc": dc})
+            telemetry.set_gauge(("replication", "lag"), lag,
+                                labels={"type": typ})
+            telemetry.set_gauge(("replication", "diverged"), 1.0,
+                                labels={"type": typ})
+            telemetry.set_gauge(("ratelimit", "rate"), rate)
+            telemetry.incr_counter(("ratelimit", "adjust"), n,
+                                   labels={"direction": direction})
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+    bad = """
+        from consul_tpu import flight, telemetry
+
+        def defend(direction, rate, typ, dc, labels):
+            flight.emit("ratelimit.exploded",
+                        labels={"direction": direction})
+            flight.emit("replication.diverged",
+                        labels={"type": typ, "lane": dc})
+            flight.emit("ratelimit.adjusted", labels=labels)
+            telemetry.add_sample(("ratelimit", "adjust ms!"), 1.0)
+    """
+    ev = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in ev)
+    assert len(ev) == 3
+    assert "unregistered event name 'ratelimit.exploded'" in msgs
+    assert "label 'lane' not declared" in msgs
+    assert "computed labels" in msgs
+    mn = check_snippet("metric-names", bad)
+    assert any("adjust ms!" in f.message for f in mn)
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

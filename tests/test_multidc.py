@@ -248,3 +248,125 @@ def test_config_entry_replication():
     assert rep.run_once() == (1, 0)
     assert secondary.config_entry_get(
         "service-resolver", "web")["default_subset"] == "v2"
+
+
+def test_intention_replication_delete_before_upsert():
+    """Primary-DC connect intentions converge to secondaries
+    (config_replication.go role for intentions); deletes run BEFORE
+    upserts so a delete+recreate of the same (src, dst) pair under a
+    new id never trips the store's duplicate-pair check."""
+    from consul_tpu.acl.replication import IntentionReplicator
+    primary, secondary = StateStore(), StateStore()
+    primary.intention_set("i1", "web", "db", "allow")
+    primary.intention_set("i2", "api", "db", "deny", "no api writes")
+    rep = IntentionReplicator(primary, secondary, interval=999)
+    assert rep.run_once() == (2, 0)
+    assert {i["id"] for i in secondary.intention_list()} == {"i1",
+                                                            "i2"}
+    assert rep.run_once() == (0, 0)      # converged: no-op round
+
+    # delete+recreate the SAME pair under a new id in one round: the
+    # delete of i1 must land before the upsert of i9 or the
+    # duplicate-pair check wedges the round
+    primary.intention_delete("i1")
+    primary.intention_set("i9", "web", "db", "deny")
+    assert rep.run_once() == (1, 1)
+    sec = {i["id"]: i for i in secondary.intention_list()}
+    assert set(sec) == {"i2", "i9"}
+    assert sec["i9"]["action"] == "deny"
+
+    # field-level update re-replicates
+    primary.intention_set("i2", "api", "db", "allow")
+    assert rep.run_once() == (1, 0)
+
+
+def test_replication_divergence_content_arc_and_status():
+    """check_divergence() compares content hashes WITHOUT applying a
+    diff: in-sync stores agree, a primary-only write flips the
+    secondary to diverged with reason 'content' and a counting lag,
+    and the next clean round converges it back to zero — the arc the
+    live_wan_partition chaos scenario asserts end-to-end."""
+    primary, secondary = StateStore(), StateStore()
+    primary.acl_policy_set("p1", "ops",
+                           'key_prefix "" { policy = "read" }')
+    rep = AclReplicator(primary, secondary, interval=999)
+    rep.run_round()
+    out = rep.check_divergence()
+    assert out["diverged"] is False and out["reason"] is None
+    assert out["local_hash"] == out["primary_hash"]
+    assert out["lag_s"] == 0.0
+
+    # a primary-only write diverges the content hashes
+    primary.acl_token_set("acc9", "sek9", ["p1"])
+    time.sleep(0.02)                     # lag must count up from sync
+    out = rep.check_divergence()
+    assert out["diverged"] is True and out["reason"] == "content"
+    assert out["local_hash"] != out["primary_hash"]
+    assert out["lag_s"] > 0.0
+    st = rep.status()
+    assert st["Diverged"] is True
+    assert st["LagSeconds"] > 0.0
+    assert st["ContentHash"] == out["local_hash"]
+    assert st["LastDivergenceCheck"] is not None
+    assert st["ReplicationType"] == "tokens"
+
+    # one clean round heals it: hashes agree, lag resets to zero
+    rep.run_round()
+    out = rep.check_divergence()
+    assert out["diverged"] is False and out["lag_s"] == 0.0
+    st = rep.status()
+    assert st["Diverged"] is False and st["LagSeconds"] == 0.0
+    assert st["Rounds"] == 2
+
+
+def test_replication_divergence_unreachable_primary():
+    """A partitioned primary counts as diverged — sync can no longer
+    be PROVEN (the hash of an unreachable store is unknowable), which
+    is exactly what a severed WAN link looks like to the checker."""
+
+    class DeadStore:
+        def __getattr__(self, name):
+            raise ConnectionResetError("wan link severed")
+
+    secondary = StateStore()
+    rep = AclReplicator(DeadStore(), secondary, interval=999)
+    out = rep.check_divergence()
+    assert out["diverged"] is True
+    assert out["reason"].startswith("unreachable:")
+    assert out["primary_hash"] is None
+    assert out["local_hash"] is not None  # local side still hashes
+    assert rep.status()["Diverged"] is True
+
+    # a failed run_round marks divergence the same way
+    rep2 = AclReplicator(DeadStore(), StateStore(), interval=999)
+    with pytest.raises(ConnectionResetError):
+        rep2.run_round()
+    st = rep2.status()
+    assert st["Diverged"] is True
+    assert "ConnectionResetError" in st["LastErrorMessage"]
+
+
+def test_replication_flight_events_only_on_transitions():
+    """replication.diverged/converged journal STATE TRANSITIONS, not
+    rounds: a long partition is one diverged event no matter how many
+    checks run through it, and heal is one converged event."""
+    from consul_tpu import flight
+    primary, secondary = StateStore(), StateStore()
+    primary.acl_policy_set("p1", "ops", "x")
+    rep = AclReplicator(primary, secondary, interval=999,
+                        source_dc="dc1")
+    rec = flight.FlightRecorder(forward_to_log=False)
+    with flight.use(rec):
+        rep.run_round()                      # sync (no prior state)
+        primary.acl_policy_set("p2", "dev", "y")
+        rep.check_divergence()               # -> diverged (transition)
+        rep.check_divergence()               # still diverged: no event
+        rep.check_divergence()
+        rep.run_round()                      # -> converged (transition)
+        rep.check_divergence()               # still clean: no event
+    evs = [e for e in rec.tail(50)
+           if e["name"].startswith("replication.")]
+    assert [e["name"] for e in evs] == ["replication.diverged",
+                                       "replication.converged"]
+    assert all(e["labels"] == {"type": "tokens", "source_dc": "dc1"}
+               for e in evs)

@@ -420,3 +420,123 @@ def test_overload_response_mapping_unit():
         == (503, "deadline")
     assert _overload_response(NoLeaderError("x")) == (503, "no-leader")
     assert _overload_response(ValueError("boom")) is None
+
+
+# ---------------------------------------------------------------------------
+# ApplyGate EMA edge cases (ISSUE 18 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_apply_gate_ema_first_sample_seeds():
+    """The first observation SEEDS the EMA outright (no decay from a
+    zero history — 0.9*0 + 0.1*x would take ~50 samples to reflect a
+    steady 1s commit wait)."""
+    g = ApplyGate()
+    assert g._ema_commit_s == 0.0           # no influence yet
+    assert g.reject_reason(0, 1, 0.06) is None
+    g.observe_commit(1.0)
+    assert g._ema_commit_s == pytest.approx(1.0)
+    # second sample decays normally
+    g.observe_commit(0.0)
+    assert g._ema_commit_s == pytest.approx(0.9)
+
+
+def test_apply_gate_ema_clamped_at_two_seconds():
+    """A pathological commit wait (a paused leader's 60s stall) must
+    not poison the gate into NACKing every sane budget forever: the
+    deadline check reads the EMA clamped to 2.0s, so any budget over
+    1.0s still admits."""
+    g = ApplyGate()
+    for _ in range(50):
+        g.observe_commit(60.0)
+    assert g._ema_commit_s > 2.0            # the raw EMA is huge...
+    assert g.reject_reason(0, 1, 1.01) is None   # ...the gate is not
+    assert g.reject_reason(0, 1, 0.99) == "deadline"
+
+
+def test_apply_gate_fast_nack_below_half_ema():
+    """budget < 0.5 * EMA NACKs NOW (fail-fast) while budget at or
+    above the half-line rides through — the conservative half-factor
+    that keeps one slow commit from flipping the gate."""
+    g = ApplyGate()
+    g.observe_commit(0.8)                   # EMA seeded at 0.8
+    assert g.reject_reason(0, 1, 0.39) == "deadline"
+    assert g.reject_reason(0, 1, 0.41) is None
+    # boundary: exactly half the EMA is NOT a reject (strict <)
+    assert g.reject_reason(0, 1, 0.4) is None
+
+
+# ---------------------------------------------------------------------------
+# self-sizing AIMD controller dynamics (ISSUE 18 tentpole c)
+# ---------------------------------------------------------------------------
+
+
+def _controller(rate=120.0, **kw):
+    from consul_tpu.ratelimit import DynamicLimitController
+    lim = RateLimiter(mode="enforcing", write_rate=rate,
+                      write_burst=rate * 2)
+    kw.setdefault("floor", 20.0)
+    kw.setdefault("ceiling", 200.0)
+    return DynamicLimitController(lim, ApplyGate(), **kw), lim
+
+
+def test_aimd_converges_down_under_overload_then_recovers():
+    """Scripted latency trace: sustained overload walks the rate down
+    multiplicatively to the floor; a healthy tail walks it back up
+    additively — and the limiter itself is reconfigured in lockstep."""
+    ctrl, lim = _controller(rate=120.0)
+    for _ in range(4):                      # overloaded ticks
+        ctrl.step(ema_s=0.5)
+    assert ctrl.rate == pytest.approx(20.0)  # 120→60→30→floor
+    assert lim._write[0] == pytest.approx(20.0)
+    # healthy ticks: +10 only after `hysteresis` consecutive ones
+    for _ in range(9):
+        ctrl.step(ema_s=0.01)
+    assert ctrl.rate == pytest.approx(50.0)  # 3 increases in 9 ticks
+    assert lim._write[0] == pytest.approx(50.0)
+
+
+def test_aimd_hysteresis_blocks_oscillation():
+    """A flapping trace (one bad tick between healthy ones) must
+    never trigger an increase: the healthy streak resets on every
+    overload, so the rate only moves DOWN — no up/down sawtooth at
+    the overload boundary."""
+    ctrl, _ = _controller(rate=120.0)
+    directions = []
+    for i in range(12):
+        d = ctrl.step(ema_s=0.5 if i % 3 == 0 else 0.01)
+        if d:
+            directions.append(d)
+    assert "increase" not in directions
+    assert ctrl.rate >= ctrl.floor
+
+
+def test_aimd_bounds_and_vis_p99_trigger():
+    """The walk clamps to [floor, ceiling]; the visibility p99 is an
+    independent overload signal (a write path can commit fast yet
+    flush slowly — the controller must see it)."""
+    ctrl, _ = _controller(rate=190.0)
+    for _ in range(6):
+        ctrl.step(ema_s=0.01)
+    assert ctrl.rate <= ctrl.ceiling        # additive walk clamps
+    for _ in range(20):
+        ctrl.step(ema_s=0.01, p99_ms=5000.0)
+    assert ctrl.rate == pytest.approx(ctrl.floor)   # vis signal alone
+    # steady state at the floor: decreases stop (no churn below it)
+    assert ctrl.step(ema_s=0.5) is None
+
+
+def test_aimd_adjustments_reconfigure_burst_and_emit():
+    """Every applied adjustment reconfigures write_burst = 2x rate and
+    journals a ratelimit.adjusted flight event with the direction."""
+    rec = flight.FlightRecorder(clock=time.time, forward_to_log=False)
+    with flight.use(rec):
+        ctrl, lim = _controller(rate=120.0)
+        assert ctrl.step(ema_s=0.5) == "decrease"
+        assert lim._write[0] == pytest.approx(60.0)
+        assert lim._write[1] == pytest.approx(120.0)
+    rows, _ = rec.read_page(since=0)
+    adj = [r for r in rows if r["name"] == "ratelimit.adjusted"]
+    assert len(adj) == 1
+    assert adj[0]["labels"]["direction"] == "decrease"
+    assert adj[0]["labels"]["reason"]

@@ -14,7 +14,12 @@ import pytest
 from consul_tpu.consensus.raft import RaftConfig
 from consul_tpu.rpc import RpcClient, RpcError, TcpTransport
 from consul_tpu.server import Server
-from consul_tpu.tlsutil import Configurator
+from consul_tpu.tlsutil import HAVE_CRYPTO, Configurator
+
+# the whole module mints real certificates; without the optional
+# 'cryptography' package it must SKIP cleanly, not error collection
+pytestmark = pytest.mark.skipif(
+    not HAVE_CRYPTO, reason="requires the 'cryptography' package")
 
 
 class TlsCluster:

@@ -378,6 +378,27 @@ def run_check() -> int:
     if not xdsrow["ok"]:
         failures.append("guard judged the xds artifact stamp keys "
                         "instead of tolerating them")
+    # ISSUE 18's self-defense stamps are metadata too: CHAOS_r05/
+    # SOAK_r02 rows carry {"wan_partition": {...}} (divergence/heal
+    # evidence) and {"controller": {...}} (the AIMD walk) — a
+    # decorated within-threshold row must be tolerated-not-judged
+    sdrow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                    "wan_partition": {"diverged": True, "healed": True,
+                                      "max_lag_s": 6.0,
+                                      "direction": "dc2->dc1"},
+                    "controller": {"floor": 40, "ceiling": 150,
+                                   "adjustments": {"decrease": 2,
+                                                   "increase": 9},
+                                   "final_rate": 120.0},
+                    "replication": {"types": ["tokens", "intentions",
+                                              "config-entries"],
+                                    "diverged": [],
+                                    "max_lag_s": 0.0}}],
+                  fake_base)
+    if not sdrow["ok"]:
+        failures.append("guard judged the self-defense stamp keys "
+                        "(wan_partition/controller/replication) "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

@@ -2,7 +2,7 @@
 over real sockets (ISSUE 9 tentpole).
 
     python tools/chaos_live.py                  # every live scenario,
-                                                # emits CHAOS_r04.json
+                                                # emits CHAOS_r05.json
     python tools/chaos_live.py --seed 42        # same suite, seed 42
     python tools/chaos_live.py --scenario live_kill_leader_loop --seed 3
     python tools/chaos_live.py --check          # the bounded tier-1
@@ -35,7 +35,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "CHAOS_r04.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r05.json")
 CHECK_SEED = 7
 
 
@@ -101,6 +101,13 @@ def run_soak(names, seed: int, out_path: str) -> int:
             "rejects fire once a severed follower's lag exceeds the "
             "bound; ?consistent 500s leaderless; stale reads verified "
             "against the serializable-prefix-within-max_stale model",
+            "one-directional WAN severs cut exactly one direction: "
+            "the surviving direction keeps forwarding, the cut one "
+            "fails fast; in-cluster ACL/intention/config replication "
+            "reports nonzero divergence + lag through the partition "
+            "(federation view degrades the DC row, never drops it) "
+            "and converges to zero within the SLO after heal_link, "
+            "with replication.diverged/converged journaled",
         ],
     }
     with open(out_path, "w") as f:

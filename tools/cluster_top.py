@@ -126,17 +126,28 @@ def render_wan(view: dict, events_tail: int = 0) -> str:
     node table (degraded/dead rows rendered distinctly)."""
     out = [f"federation: {len(view['dcs'])} DCs"]
     out.append(f"{'DC':<8} {'LEADER':<12} {'ALIVE':>5} {'DEGRADED':>9} "
-               f"{'LAG_MS':>8} {'WAKEUP_P50':>11} {'WAKEUP_P99':>11}")
+               f"{'LAG_MS':>8} {'WAKEUP_P50':>11} {'WAKEUP_P99':>11} "
+               f"{'REP_LAG_S':>10} {'DIVERGED':<22} {'W_RATE':>7}")
     for dc, row in sorted(view["dcs"].items()):
         p50 = row.get("wakeup_p50_ms")
         p99 = row.get("wakeup_p99_ms")
+        # cross-DC replication health (secondary DCs only) + the
+        # self-sized write limit: '-' where the plane doesn't run
+        rep = row.get("replication") or {}
+        rep_lag = rep.get("max_lag_s")
+        diverged = ",".join(rep.get("diverged") or []) \
+            if rep else "-"
+        wr = row.get("write_rate")
         out.append(
             f"{dc:<8} {row.get('leader') or '<none>':<12} "
             f"{row['alive']:>3}/{len(row['nodes']):<1} "
             f"{len(row['degraded']):>9} "
             f"{row.get('lag_ms_max', 0.0):>8.1f} "
             f"{p50 if p50 is not None else '-':>11} "
-            f"{p99 if p99 is not None else '-':>11}")
+            f"{p99 if p99 is not None else '-':>11} "
+            f"{rep_lag if rep_lag is not None else '-':>10} "
+            f"{diverged or 'none':<22} "
+            f"{wr if wr is not None else '-':>7}")
     for dc, row in sorted(view["dcs"].items()):
         out.append(f"-- {dc} " + "-" * 40)
         out.append(render({"nodes": row["nodes"],

@@ -50,8 +50,12 @@ REQUIRED_SECTIONS = ("host.json", "logs.txt", "0/metrics.json",
 # per-node sections a --cluster bundle must carry for every LIVE node,
 # plus the merged cluster files
 CLUSTER_SECTIONS = ("cluster_view.json", "cluster_events.jsonl")
+# replication.json: the node's /v1/internal/ui/replication surface
+# (per-type diverged/lag rows + the self-sized write_rate) — null on
+# nodes that run neither replicators nor the dynamic limit controller
 CLUSTER_NODE_SECTIONS = ("metrics.json", "events.jsonl",
-                         "profile.json", "raft.json")
+                         "profile.json", "raft.json",
+                         "replication.json")
 
 # merged sections a --wan bundle must carry (per-DC/per-node subdirs
 # reuse CLUSTER_NODE_SECTIONS under dc/node/)
@@ -103,6 +107,9 @@ def build_wan(out_path: str, spec: str,
                          json.dumps(row["profile"], indent=2).encode())
                 _tar_add(tar, f"{dc}/{name}/raft.json",
                          json.dumps(row["raft"], indent=2).encode())
+                _tar_add(tar, f"{dc}/{name}/replication.json",
+                         json.dumps(row.get("replication"),
+                                     indent=2).encode())
     wall = time.perf_counter() - t0
     with tarfile.open(out_path, "r:gz") as tar:
         names = tar.getnames()
@@ -167,6 +174,8 @@ def build_cluster(out_path: str, urls: list,
                 json.dumps(row["profile"], indent=2).encode())
             add(f"{name}/raft.json",
                 json.dumps(row["raft"], indent=2).encode())
+            add(f"{name}/replication.json",
+                json.dumps(row.get("replication"), indent=2).encode())
     wall = time.perf_counter() - t0
     with tarfile.open(out_path, "r:gz") as tar:
         names = tar.getnames()

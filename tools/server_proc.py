@@ -119,8 +119,20 @@ def main():
                          'Keys: mode (disabled|permissive|enforcing), '
                          'read_rate/read_burst/write_rate/write_burst '
                          '(ingress token buckets), apply_max_pending/'
-                         'apply_min_budget (leader apply admission).  '
-                         'Env: CONSUL_TPU_RATE_LIMIT')
+                         'apply_min_budget (leader apply admission), '
+                         'dynamic=1 + dynamic_floor/dynamic_ceiling/'
+                         'dynamic_interval (AIMD self-sizing of '
+                         'write_rate against the apply EMA + '
+                         'visibility p99).  Env: CONSUL_TPU_RATE_LIMIT')
+    ap.add_argument("--replicate-from", default=None,
+                    help="primary DC name: run the secondary-DC "
+                         "replication set (ACL tokens/policies, "
+                         "intentions, config entries, federation "
+                         "states) against that DC, reached through "
+                         "this node's own ?dc= WAN forward — rounds "
+                         "run only while this node is raft leader")
+    ap.add_argument("--replicate-interval", type=float, default=1.0,
+                    help="seconds between replication rounds")
     args = ap.parse_args()
 
     from consul_tpu import flight
@@ -160,6 +172,7 @@ def main():
         api.federation_nodes = parse_dc_spec(args.federation_http)
     limit_spec = args.rate_limit \
         or os.environ.get("CONSUL_TPU_RATE_LIMIT")
+    limit_controller = None
     if limit_spec:
         from consul_tpu.ratelimit import parse_limit_spec
         cfg = parse_limit_spec(limit_spec)
@@ -167,8 +180,56 @@ def main():
             server.apply_gate.max_pending = cfg.pop("apply_max_pending")
         if "apply_min_budget" in cfg:
             server.apply_gate.min_budget_s = cfg.pop("apply_min_budget")
+        dynamic = cfg.pop("dynamic", False)
+        dyn_kw = {spec: cfg.pop(key) for spec, key in
+                  (("floor", "dynamic_floor"),
+                   ("ceiling", "dynamic_ceiling"),
+                   ("interval", "dynamic_interval")) if key in cfg}
         if cfg:
             api.ratelimit.configure(**cfg)
+        if dynamic:
+            # self-sizing write limits (ISSUE 18): AIMD-walk the
+            # write_rate against the live apply EMA + the visibility
+            # p99 read off this node's own telemetry samples
+            from consul_tpu import telemetry
+            from consul_tpu.ratelimit import DynamicLimitController
+
+            def vis_p99_ms():
+                worst = None
+                for s in telemetry.default_registry().dump()["Samples"]:
+                    if s["Name"] != "consul.kv.visibility":
+                        continue
+                    if (s.get("Labels") or {}).get("stage") \
+                            not in ("wakeup", "flush"):
+                        continue
+                    p99 = s["P99"] * 1000.0
+                    worst = p99 if worst is None else max(worst, p99)
+                return worst
+
+            limit_controller = DynamicLimitController(
+                api.ratelimit, server.apply_gate,
+                vis_p99_fn=vis_p99_ms, **dyn_kw)
+            api.limit_controller = limit_controller
+    replicators = []
+    if args.replicate_from:
+        # the secondary-DC leader loop (leader.go:873-896): replicate
+        # the primary's ACL/intention/config/federation payloads into
+        # the LOCAL raft through this node's own front — the primary
+        # is reached via the ?dc= WAN forward, i.e. through the mesh
+        # gateways, so a severed gateway link stalls these rounds and
+        # the divergence checker reports it
+        from consul_tpu.acl.replication import (RemoteDcStore,
+                                                build_replicators)
+        from consul_tpu.api.client import Client
+        remote = RemoteDcStore(
+            Client(f"http://127.0.0.1:{api.port}"),
+            dc=args.replicate_from)
+        replicators = build_replicators(
+            remote, server, source_dc=args.replicate_from,
+            interval=args.replicate_interval,
+            gate=server.raft.is_leader)
+        api.replicators = replicators
+        api.acl_replicator = replicators[0]
     xds_grpc = None
     if args.grpc_port is not None:
         # same wiring as Agent: ADS streams authorize service:write on
@@ -182,6 +243,10 @@ def main():
     api.start()
     if xds_grpc is not None:
         xds_grpc.start()
+    if limit_controller is not None:
+        limit_controller.start()
+    for rep in replicators:
+        rep.start()
     print(f"server {args.node} rpc={my_rpc} "
           f"http={api.address}"
           + (f" grpc={xds_grpc.address}" if xds_grpc else ""),
@@ -243,6 +308,10 @@ def main():
         # the data-dir lock — a rolling restart must find a cleanly
         # closed log (no torn tail, no stale flock)
         flight.emit("agent.stopped", labels={"node": args.node})
+        for rep in replicators:
+            rep.stop()
+        if limit_controller is not None:
+            limit_controller.stop()
         if xds_grpc is not None:
             xds_grpc.stop()
         api.stop()

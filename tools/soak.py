@@ -1,18 +1,29 @@
 """Throughput-under-chaos soak: the overload survival plane proved
-over time (ISSUE 13 tentpole d; ROADMAP item 5's gate).
+over time (ISSUE 13 tentpole d; ROADMAP item 5's gate), now composed
+with the self-defense loops (ISSUE 18): a SECOND federated DC
+replicates ACLs/intentions/config entries off the primary while the
+per-node write limit sizes ITSELF (the AIMD controller walking
+write_rate against the apply-commit EMA + visibility p99).
 
-Drives a REAL multi-process cluster (tools/server_proc.py over real
-sockets, every link interposed — the PR 9 nemesis shape) with
-ENFORCING ingress limits under sustained KV load, while a seeded
-scheduler composes fault families with randomly placed overload
-bursts:
+Drives a REAL two-DC LiveWan (tools/server_proc.py over real
+sockets, every link interposed, per-direction WAN links through the
+mesh gateways — the PR 9 nemesis shape federated per PR 15) with
+ENFORCING dynamic ingress limits under sustained KV load at dc1,
+while a seeded scheduler composes fault families with randomly
+placed overload bursts:
 
-    overload_burst   4 threads hammering PUTs far past the write
+    overload_burst   10 threads hammering PUTs far past the write
                      limit at one node (the limiter must shed)
     kill9_leader     kill -9 + same-data-dir restart (WAL recovery
                      under load)
     pause_leader     SIGSTOP past the election timeout, SIGCONT
     sever_follower   full bidirectional partition + heal
+    wan_partition    sever the dc2->dc1 WAN direction: dc2's
+                     replication must REPORT divergence (nonzero lag)
+                     while cut, then heal_link and converge
+    xds_churn_storm  rapid service/intention/config churn — every
+                     write storms the proxycfg/xDS recompute plane on
+                     all six nodes while the limiter is live
 
 Through every fault, per-window SLIs are recorded: client-side
 throughput + p99 latency per op class (ok / rate_limited / rejected /
@@ -33,14 +44,21 @@ SLO assertions (every one must hold for ok=true):
     window) and no rate-limited write exists on any replica;
   * the quiet tail recovers: writes succeed with bounded p99 after
     the last fault;
+  * every wan_partition actually shows in dc2's replication status
+    (Diverged + lag while cut) and converges after heal_link;
+  * the dynamic controller stays live and bounded (every sampled
+    write_rate within [floor, ceiling]) and SETTLES: no panic
+    decreases once the chaos stops (the AIMD sawtooth may keep
+    walking up — monotone recovery is convergence, flip-flopping
+    is not);
   * the standard checkers stay green (durability of acked writes,
     linearizable register, election safety).
 
-Run: python tools/soak.py [--seconds 75] [--seed 0]
-     [--out SOAK_r01.json]
+Run: python tools/soak.py [--seconds 100] [--seed 0]
+     [--out SOAK_r02.json]
 
 CI-bounded by --seconds; the same composition runs for hours by
-raising it (the scheduler loops).  Emits SOAK_r01.json.
+raising it (the scheduler loops).  Emits SOAK_r02.json.
 """
 
 from __future__ import annotations
@@ -59,17 +77,31 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "SOAK_r01.json")
+ARTIFACT = os.path.join(REPO, "SOAK_r02.json")
 WINDOW_S = 2.0          # SLI bucketing granularity
 VIS_SLO_S = 5.0         # p99 visibility bound outside leader faults
 LEADER_GRACE_S = 6.0    # SLO grace around a leader fault window
+SETTLE_TAIL_S = 8.0     # no-decrease window at the very end
+DYN_FLOOR = 20.0
+DYN_CEILING = 40.0
 
-# write budget sized for THIS rig: background SLI load runs ~50
-# writes/s/node (well inside 120/s), a 4-thread burst offers ~350/s
-# at one node — the overage exhausts the 180-token burst allowance in
-# under a second and the limiter sheds the rest (the soak asserts it)
-RATE_LIMIT = ("mode=enforcing,write_rate=120,write_burst=180,"
-              "read_rate=2000,read_burst=4000,apply_max_pending=2048")
+# write budget sized for THIS rig: the two-DC federation (6 servers +
+# gateways + links on one core) runs accepted writes SLOWLY under
+# load, so a burster thread stuck behind slow accepts can only offer
+# ~8 ops/s — a generous budget would never drain and nothing would
+# shed.  The DYNAMIC ceiling therefore sits at 40/s, well BELOW what
+# a 10-thread burst offers even fully starved: the bucket drains
+# within a couple of seconds, 429s come back fast, and the shedding
+# SLO stays meaningful no matter where the controller has walked the
+# rate.  Background SLI load runs ~27 writes/s/node, inside the
+# floor, so self-defense never starves the steady state.  The
+# starting rate sits BELOW the ceiling so the artifact captures the
+# controller actually walking (additive increases on healthy ticks),
+# not just holding a parked value.
+RATE_LIMIT = ("mode=enforcing,write_rate=30,write_burst=60,"
+              "read_rate=2000,read_burst=4000,apply_max_pending=2048,"
+              f"dynamic=1,dynamic_floor={DYN_FLOOR:.0f},"
+              f"dynamic_ceiling={DYN_CEILING:.0f},dynamic_interval=0.5")
 
 
 def _p99(vals):
@@ -205,6 +237,7 @@ class Sampler:
         from consul_tpu import introspect
         rows = introspect.scrape_cluster(self.fleet, events_limit=0)
         leader, flush_p99, pend_max = None, None, 0.0
+        write_rate = None
         for name, row in rows:
             gauges, _ = introspect._metric_maps(row["metrics"])
             pend = gauges.get(("consul.raft.apply.pending", ()))
@@ -215,6 +248,8 @@ class Sampler:
                 vis = introspect.visibility_stages(row["metrics"])
                 if "flush" in vis:
                     flush_p99 = vis["flush"]["p99_ms"]
+                write_rate = (row.get("replication") or {}).get(
+                    "write_rate")
         if flush_p99 is None:
             # leaderless mid-election (or leader not scraped): take
             # the max flush p99 any node reports so the SLO judges
@@ -227,7 +262,8 @@ class Sampler:
         self.samples.append({
             "t": round(time.time(), 3), "leader": leader,
             "vis_flush_p99_ms": flush_p99,
-            "apply_pending_max": pend_max})
+            "apply_pending_max": pend_max,
+            "write_rate": write_rate})
 
     def _loop(self):
         while not self._stop.is_set():
@@ -252,7 +288,7 @@ class Sampler:
 
 
 def overload_burst(cluster, target: int, seconds: float,
-                   threads: int = 4, epoch: int = 0):
+                   threads: int = 10, epoch: int = 0):
     """Hammer PUTs at `target` far past the write limit; returns
     (total, shed, leaked_keys) where leaked = rate-limited keys that
     exist on a replica afterwards (must be none).  `epoch` namespaces
@@ -300,6 +336,68 @@ def overload_burst(cluster, target: int, seconds: float,
     return counts["ops"], counts["shed"], sorted(leaked)
 
 
+def dc2_replication(dc2):
+    """{type: (Diverged, LagSeconds)} off whichever dc2 node runs the
+    replication set (the leader's rounds advance; followers idle)."""
+    best, best_rounds = [], -1
+    for i in dc2.alive_ids():
+        try:
+            out, _, _ = dc2.client(i, timeout=2.0)._call(
+                "GET", "/v1/internal/ui/replication")
+        except Exception:
+            continue
+        rows = out.get("replicators") or []
+        rounds = sum(r.get("Rounds", 0) for r in rows)
+        if rounds > best_rounds:
+            best, best_rounds = rows, rounds
+    return {r["ReplicationType"]: (bool(r.get("Diverged")),
+                                   float(r.get("LagSeconds") or 0.0))
+            for r in best}
+
+
+def xds_churn_storm(cluster, target: int, seconds: float,
+                    epoch: int = 0):
+    """Rapid service/intention/config churn at `target`: every write
+    lands a catalog/intention/config-entry delta that storms the
+    proxycfg snapshot + xDS recompute plane on every node.  Writes
+    ride the SAME enforced ingress budget as the KV load (shed counts
+    as churn served — the limiter defending the apply path against
+    control-plane storms is the point).  Returns (ops, shed)."""
+    from consul_tpu.api.client import ApiError
+    c = cluster.client(target, timeout=3.0)
+    stop_at = time.time() + seconds
+    ops = shed = k = 0
+    while time.time() < stop_at:
+        name = f"churn-{epoch}-{k}"
+        k += 1
+        iid = None
+        for step in ("reg", "intention", "config",
+                     "dereg", "unintention", "unconfig"):
+            try:
+                if step == "reg":
+                    c.agent_service_register(name, port=9000 + k % 999)
+                elif step == "intention":
+                    iid = c.intention_create("web", name, "allow")
+                elif step == "config":
+                    c.config_write({"Kind": "service-resolver",
+                                    "Name": name})
+                elif step == "dereg":
+                    c.agent_service_deregister(name)
+                elif step == "unintention":
+                    if iid:
+                        c.intention_delete(iid)
+                elif step == "unconfig":
+                    c.config_delete("service-resolver", name)
+                ops += 1
+            except ApiError as e:
+                ops += 1
+                if getattr(e, "nack", False):
+                    shed += 1
+            except OSError:
+                pass
+    return ops, shed
+
+
 def run_soak(seconds: float, seed: int, out_path: str) -> int:
     from consul_tpu import chaos_live, flight, locks
     from consul_tpu.chaos import (ElectionSafetyChecker,
@@ -320,12 +418,21 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
     violations = []
     tmp = tempfile.TemporaryDirectory(prefix="soak-")
     with flight.use(recorder):
-        cluster = chaos_live.LiveCluster(3, data_root=tmp.name,
-                                         rate_limit=RATE_LIMIT)
+        # the federated rig: dc1 takes all the load + process faults
+        # (the ISSUE 13 soak shape), dc2 replicates ACLs/intentions/
+        # config off it through severable per-direction WAN links —
+        # the wan_partition family cuts dc2->dc1 and asserts the
+        # divergence/heal loop while everything else keeps running
+        wan = chaos_live.LiveWan(data_root=tmp.name, n=3,
+                                 rate_limit=RATE_LIMIT,
+                                 replicate=True,
+                                 replicate_interval=0.75)
+        cluster = wan.clusters["dc1"]
+        dc2 = wan.clusters["dc2"]
         fleet = {s.name: s.http for s in cluster.servers}
         collector = load = sli = sampler = None
         try:
-            cluster.start()
+            wan.start()
             collector = EventCollector(cluster)
             collector.start()
             # correctness load (histories for the checkers) + SLI load
@@ -351,7 +458,8 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
 
             time.sleep(min(5.0, seconds * 0.1))     # warmup
             families = ["overload_burst", "kill9_leader",
-                        "overload_burst", "pause_leader",
+                        "wan_partition", "overload_burst",
+                        "pause_leader", "xds_churn_storm",
                         "sever_follower"]
             fi = 0
             # leave a quiet recovery tail (~20% of the run)
@@ -361,7 +469,7 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                 t0 = time.time()
                 if kind == "overload_burst":
                     tgt = rng.randrange(cluster.n)
-                    dur = rng.uniform(2.5, 4.0)
+                    dur = rng.uniform(5.0, 6.0)
                     ops, shed, leaked = overload_burst(
                         cluster, tgt, dur, epoch=fi)
                     mark(kind, f"server{tgt}", t0, time.time(),
@@ -397,6 +505,51 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                     time.sleep(rng.uniform(2.5, 3.5))
                     cluster.heal()
                     mark(kind, f"server{v}", t0, time.time())
+                elif kind == "wan_partition":
+                    # cut ONLY dc2->dc1: dc2's replication pulls stall
+                    # (it must SAY so), dc1 keeps serving untouched
+                    wan.sever_link("dc2", "dc1", direction="out")
+                    dvg_deadline = time.time() + 8.0
+                    diverged_seen = False
+                    while time.time() < dvg_deadline \
+                            and not diverged_seen:
+                        diverged_seen = any(
+                            d for d, _ in dc2_replication(dc2).values())
+                        if not diverged_seen:
+                            time.sleep(0.4)
+                    time.sleep(rng.uniform(1.0, 2.0))
+                    wan.heal_link("dc2", "dc1")
+                    heal_deadline = time.time() + 15.0
+                    healed = False
+                    while time.time() < heal_deadline and not healed:
+                        rep = dc2_replication(dc2)
+                        healed = bool(rep) and not any(
+                            d for d, _ in rep.values())
+                        if not healed:
+                            time.sleep(0.4)
+                    mark(kind, "dc2->dc1", t0, time.time(),
+                         diverged=diverged_seen, healed=healed)
+                    if not diverged_seen:
+                        violations.append(
+                            f"wan_partition at {t0 - t_start:.1f}s: "
+                            f"dc2 never reported replication "
+                            f"divergence while cut")
+                    if not healed:
+                        violations.append(
+                            f"wan_partition at {t0 - t_start:.1f}s: "
+                            f"dc2 replication did not converge within "
+                            f"15s of heal_link")
+                elif kind == "xds_churn_storm":
+                    tgt = rng.randrange(cluster.n)
+                    dur = rng.uniform(3.0, 4.0)
+                    ops, shed = xds_churn_storm(cluster, tgt, dur,
+                                                epoch=fi)
+                    mark(kind, f"server{tgt}", t0, time.time(),
+                         ops=ops, shed=shed)
+                    if ops == 0:
+                        violations.append(
+                            f"xds churn storm at {t0 - t_start:.1f}s "
+                            f"landed zero ops")
                 time.sleep(rng.uniform(2.0, 4.0))   # inter-fault gap
             # quiet tail: recovery must show in the series
             while time.time() < t_end:
@@ -427,7 +580,7 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                         part.stop()
                 except Exception:
                     pass
-            cluster.stop()
+            wan.stop()
             tmp.cleanup()
 
     # ------------------------------------------------------- the series
@@ -467,6 +620,9 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                 default=None),
             "apply_pending_max": max(
                 (s["apply_pending_max"] for s in svr), default=0.0),
+            "write_rate": next(
+                (s["write_rate"] for s in reversed(svr)
+                 if s.get("write_rate") is not None), None),
             "faults": sorted({f["kind"] for f in faults
                               if f["t0"] < w1 and f["t1"] > w0}),
         })
@@ -507,6 +663,51 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
                 if w["put_rps"] > 0),
         "tail": [{"t": w["t"], "put_rps": w["put_rps"],
                   "put_p99_ms": w["put_p99_ms"]} for w in tail]}
+    # self-sizing controller: live + bounded + settles.  Adjustments
+    # come off the merged flight timeline (ratelimit.adjusted fires on
+    # the adjusting node); the AIMD sawtooth walking UP through the
+    # tail is convergence — a DECREASE after the chaos stops is not.
+    adjusts = []
+    for ln in timeline.splitlines():
+        try:
+            e = json.loads(ln)
+        except ValueError:
+            continue
+        if e.get("name") == "ratelimit.adjusted":
+            adjusts.append({"t": round(e["ts"] - t_start, 2),
+                            "node": e.get("node"),
+                            "direction": e["labels"].get("direction"),
+                            "rate": e["labels"].get("rate"),
+                            "reason": e["labels"].get("reason")})
+    rates = [s["write_rate"] for s in sampler.samples
+             if s.get("write_rate") is not None]
+    tail_decreases = [a for a in adjusts
+                      if a["direction"] == "decrease"
+                      and a["t"] >= seconds - SETTLE_TAIL_S]
+    slo["controller_live_and_bounded"] = {
+        "ok": bool(rates) and all(
+            DYN_FLOOR - 0.5 <= r <= DYN_CEILING + 0.5 for r in rates),
+        "sampled": len(rates),
+        "min": min(rates, default=None),
+        "max": max(rates, default=None),
+        "floor": DYN_FLOOR, "ceiling": DYN_CEILING}
+    slo["controller_settles"] = {
+        "ok": not tail_decreases,
+        "tail_s": SETTLE_TAIL_S,
+        "tail_decreases": tail_decreases,
+        "adjustments": {"total": len(adjusts),
+                        "decrease": len([a for a in adjusts
+                                         if a["direction"]
+                                         == "decrease"]),
+                        "increase": len([a for a in adjusts
+                                         if a["direction"]
+                                         == "increase"])}}
+    parts = [f for f in faults if f["kind"] == "wan_partition"]
+    slo["wan_partition_diverges_and_heals"] = {
+        "ok": bool(parts) and all(f.get("diverged") and f.get("healed")
+                                  for f in parts),
+        "partitions": [{"t0": f["t0"], "diverged": f.get("diverged"),
+                        "healed": f.get("healed")} for f in parts]}
     slo["checkers_green"] = {"ok": not violations,
                              "violations": violations}
     lock_problems = locks.check_clean()
@@ -530,16 +731,22 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
         "repro": f"python tools/soak.py --seconds {int(seconds)} "
                  f"--seed {seed}",
         "analysis": (
-            "Throughput-under-chaos soak on the live 3-process "
-            "cluster with enforcing ingress limits "
-            f"({RATE_LIMIT}).  Fault windows annotate the per-"
-            f"{WINDOW_S:.0f}s SLI series; rate_limited/rejected are "
-            "DEFINITE non-writes (the ISSUE 13 NACK taxonomy), "
-            "counted apart from ambiguous.  Single-core rig: all "
-            "3 servers + load + burst threads share one CPU, so "
-            "absolute rps is a functional floor, not capacity; the "
-            "SLOs judge survival (visibility bound, bounded queues, "
-            "shedding, recovery), not peak throughput."),
+            "Throughput-under-chaos soak on the live two-DC "
+            "federation (3 processes per DC + per-DC mesh gateways "
+            "+ per-direction WAN links) with SELF-SIZING enforcing "
+            f"ingress limits ({RATE_LIMIT}).  dc1 takes the load and "
+            "the process faults; dc2 replicates ACLs/intentions/"
+            "config off it and must report divergence while its WAN "
+            "direction is cut, then converge after heal_link.  Fault "
+            f"windows annotate the per-{WINDOW_S:.0f}s SLI series; "
+            "rate_limited/rejected are DEFINITE non-writes (the "
+            "ISSUE 13 NACK taxonomy), counted apart from ambiguous.  "
+            "Single-core rig: all 6 servers + gateways + load + "
+            "burst threads share one CPU, so absolute rps is a "
+            "functional floor, not capacity; the SLOs judge survival "
+            "(visibility bound, bounded queues, shedding, controller "
+            "convergence, replication heal, recovery), not peak "
+            "throughput."),
     }
     with open(out_path, "w") as f:
         json.dump(report, f, indent=2)
@@ -556,7 +763,7 @@ def run_soak(seconds: float, seed: int, out_path: str) -> int:
 
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--seconds", type=float, default=75.0)
+    ap.add_argument("--seconds", type=float, default=100.0)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", default=ARTIFACT)
     args = ap.parse_args()
