@@ -2297,7 +2297,9 @@ def _make_handler(srv: ApiServer):
                     return self._forbid()
                 if q.get("local"):
                     self._send({"node": srv.node_name,
-                                "proxies": srv.proxycfg.table()})
+                                "proxies": srv.proxycfg.table(),
+                                "shapes":
+                                    srv.proxycfg.shape_stats()})
                     return True
                 if srv.cluster_nodes is None:
                     self._err(404, "xds view is not enabled "
@@ -3380,11 +3382,26 @@ def _make_handler(srv: ApiServer):
                 if not self.authz.service_write(
                         state.svc.get("name", m.group(1))):
                     return self._forbid()
+                from consul_tpu import flight
                 from consul_tpu import xds as xdsmod
                 min_v = int(q.get("version", 0) or 0)
                 wait = _parse_wait(q.get("wait", "300s")) \
                     if "version" in q else 0.0
                 snap = state.fetch(min_v, timeout=wait)
+                if not state.alive() and \
+                        srv.proxycfg.watch(m.group(1)) is None:
+                    # terminal answer (ISSUE 19 satellite): the proxy
+                    # deregistered while this long-poll was parked —
+                    # fetch() returned promptly and the client gets a
+                    # definitive Gone instead of waiting out the poll.
+                    # (alive()=False with the proxy still registered
+                    # means the state was merely REPLACED — fall
+                    # through and serve; the next poll rebinds.)
+                    self._err(410, "proxy deregistered")
+                    return True
+                if snap is None:
+                    self._err(404, "proxy snapshot unavailable")
+                    return True
                 payload = xdsmod.snapshot_resources(snap)
                 # incremental mode (?delta): cache recent payloads per
                 # proxy and ship only changed/removed resources when
@@ -3413,7 +3430,13 @@ def _make_handler(srv: ApiServer):
                     }
                     self._send(delta_payload)
                     if snap.version > min_v:
-                        xdsmod.note_http_push_counters(delta_payload)
+                        xdsmod.note_http_push_counters(delta_payload,
+                                                       mode="delta")
+                        flight.emit("xds.delta.pushed",
+                                    labels={"proxy": snap.proxy_id,
+                                            "mode": "delta",
+                                            "version": snap.version,
+                                            "index": snap.store_index})
                     state.note_push(snap)
                     return True
                 self._send(payload)
@@ -3422,7 +3445,21 @@ def _make_handler(srv: ApiServer):
                 # A wait-timeout return (version unchanged) is a
                 # re-read, not a push: no counter.
                 if snap.version > min_v:
-                    xdsmod.note_http_push_counters(payload)
+                    xdsmod.note_http_push_counters(payload,
+                                                   mode="full")
+                    if "delta" in q and min_v > 0:
+                        # the client ASKED for a delta but its version
+                        # fell out of the window: downgraded to a full
+                        # snapshot (version-gap fallback, ISSUE 19)
+                        flight.emit("xds.delta.fallback",
+                                    labels={"proxy": snap.proxy_id,
+                                            "from": min_v,
+                                            "version": snap.version})
+                    flight.emit("xds.delta.pushed",
+                                labels={"proxy": snap.proxy_id,
+                                        "mode": "full",
+                                        "version": snap.version,
+                                        "index": snap.store_index})
                 state.note_push(snap)
                 return True
             if path == "/v1/connect/ca/roots" and verb == "GET":

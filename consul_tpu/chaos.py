@@ -1010,6 +1010,68 @@ def check_linearizable(ops: List[dict],
                                  for o in ops], default=str)[:2000])
 
 
+def check_stale_routes(deregs: List[dict],
+                       holds: Dict[str, List[tuple]],
+                       slo_s: float,
+                       end_ts: float) -> Tuple[List[str], List[dict]]:
+    """The no-stale-route invariant (ISSUE 19): once an instance
+    deregisters at `ts`, every proxy whose config routed to it must
+    stop holding that endpoint within `slo_s` seconds.
+
+    deregs: [{"ts", "service", "address", "port"}] — catalog dereg
+        apply times (instances never re-register the same
+        address:port, so "cleared" is monotone).
+    holds: {proxy_id: [(ts, {service: {(addr, port), ...}}), ...]} —
+        every config a watcher RECEIVED, in arrival order: the proxy
+        HOLDS holds[p][i] from its ts until the next entry's ts.
+    end_ts: when observation stopped — a proxy still holding a dead
+        endpoint then is judged on the time it held it.
+
+    Returns (violations, lags): one lag row per (dereg, affected
+    proxy) = {"proxy", "service", "address", "port", "lag_s",
+    "cleared"}; a violation (and an `xds.stale_route` flight event)
+    whenever lag_s exceeds the SLO.  Pure function over the correlated
+    timeline — unit-testable without a live cluster."""
+    from consul_tpu import flight
+    violations: List[str] = []
+    lags: List[dict] = []
+    for d in deregs:
+        ep = (d["address"], d["port"])
+        svc = d["service"]
+        for proxy_id, timeline in sorted(holds.items()):
+            # the config the proxy held AT the dereg moment
+            held_at = None
+            for ts, cfg in timeline:
+                if ts <= d["ts"]:
+                    held_at = cfg
+                else:
+                    break
+            if held_at is None or ep not in held_at.get(svc, set()):
+                continue        # this proxy never routed to it
+            cleared_ts = None
+            for ts, cfg in timeline:
+                if ts > d["ts"] and ep not in cfg.get(svc, set()):
+                    cleared_ts = ts
+                    break
+            lag = (cleared_ts if cleared_ts is not None
+                   else end_ts) - d["ts"]
+            row = {"proxy": proxy_id, "service": svc,
+                   "address": d["address"], "port": d["port"],
+                   "lag_s": round(lag, 4),
+                   "cleared": cleared_ts is not None}
+            lags.append(row)
+            if lag > slo_s or cleared_ts is None:
+                violations.append(
+                    f"stale route: proxy {proxy_id} held dead "
+                    f"{svc}@{d['address']}:{d['port']} for "
+                    f"{lag:.3f}s (slo {slo_s:.3f}s, "
+                    f"cleared={cleared_ts is not None})")
+                flight.emit("xds.stale_route",
+                            labels={"proxy": proxy_id, "service": svc,
+                                    "ms": round(lag * 1000.0, 1)})
+    return violations, lags
+
+
 # ---------------------------------------------------------------------------
 # raft chaos harness (virtual time, bit-reproducible)
 # ---------------------------------------------------------------------------

@@ -73,7 +73,7 @@ from consul_tpu.api.client import (
 )
 from consul_tpu.chaos import (
     DurabilityChecker, ElectionSafetyChecker, RegisterHistory,
-    check_linearizable,
+    check_linearizable, check_stale_routes,
 )
 # promoted to introspect.py by ISSUE 10; re-exported for the harness
 # and its tests (no behavior change)
@@ -2063,6 +2063,409 @@ def live_wan_partition(seed: int, check: bool = False) -> dict:
             "events": events}
 
 
+def _xds_endpoint_map(rows: List[dict]) -> Dict[str, set]:
+    """{service: {(addr, port), ...}} off a list of EDS
+    ClusterLoadAssignment rows (cluster_name's first dot segment is
+    the service; chain clusters are `<target>.internal.<td>` and plain
+    upstreams are the bare destination name)."""
+    out: Dict[str, set] = {}
+    for row in rows:
+        svc = str(row.get("cluster_name", "")).split(".")[0]
+        eps = set()
+        for grp in row.get("endpoints") or []:
+            for lb in grp.get("lb_endpoints") or []:
+                sa = ((lb.get("endpoint") or {}).get("address") or
+                      {}).get("socket_address") or {}
+                if sa:
+                    eps.add((sa.get("address"), sa.get("port_value")))
+        out[svc] = eps
+    return out
+
+
+def _xds_stage_budget_s() -> Tuple[float, dict]:
+    """The tight phase-A stale-route SLO, derived from the committed
+    XDSVIS_r01.json stage summaries (ISSUE 19: dereg→last-push lag is
+    judged against the measured rebuild+push p99, not a magic
+    number).  200× the per-change pipeline cost, floored at 2 s so a
+    loaded CI box cannot flake the invariant."""
+    rebuild_ms, push_ms, src = 2.2, 1.1, "fallback"
+    try:
+        # lint: ok=blocking-call (harness-side artifact read at setup)
+        with open(os.path.join(REPO, "XDSVIS_r01.json")) as f:
+            art = json.load(f)
+        rows = art.get("rows") or []
+        rebuild_ms = max(r["stages_ms"]["rebuild"]["p99_ms"]
+                         for r in rows)
+        push_ms = max(r["stages_ms"]["push"]["p99_ms"] for r in rows)
+        src = "XDSVIS_r01.json"
+    except (OSError, ValueError, KeyError):
+        pass
+    budget = max(2.0, 0.2 * (rebuild_ms + push_ms))
+    return budget, {"rebuild_p99_ms": rebuild_ms,
+                    "push_p99_ms": push_ms, "source": src}
+
+
+def live_xds_churn_storm(seed: int, check: bool = False) -> dict:
+    """Churn storm against the mesh control plane (ISSUE 19 tentpole
+    c): proxies collapsed onto shared shapes park delta-mode xDS
+    long-polls on a live multi-process cluster while a seeded storm of
+    instance replacements, outright deregistrations, and intention
+    flips churns the catalog.  Every config every watcher ever held is
+    kept as a correlated timeline and judged by
+    `check_stale_routes`: NO proxy may hold a config routing to
+    a deregistered instance beyond the SLO — the hard gate at a
+    failover-covering bound, and pre-kill deregs additionally at the
+    tight budget derived from the committed XDSVIS_r01 stage
+    summaries.  Mid-storm the node serving every watcher (the leader)
+    is kill -9'd: watchers must fail over to a surviving server, the
+    storm keeps writing through the new leader, and every proxy must
+    reconverge to the correct final config.  `check=True` bounds the
+    run for tier-1: a 2-server cluster, a short storm, no kill phase
+    (quorum of two cannot lose a member) — the invariant checker and
+    delta plane still run for real."""
+    rng = random.Random(seed)
+    plan: List[list] = []
+    violations: List[str] = []
+    detail: dict = {}
+    injected: List[list] = []
+    recorder = flight.FlightRecorder(clock=time.time,
+                                     forward_to_log=False)
+    t0 = time.time()
+
+    def fault(kind, target):
+        plan.append(["fault", kind])
+        injected.append([round(time.time() - t0, 2), kind, target])
+        flight.emit("chaos.fault.injected",
+                    labels={"fault": kind, "target": target})
+
+    n = 2 if check else 3
+    shapes = 2
+    routes = 2
+    proxies = 4 if check else 8
+    ops_a = 6 if check else 10
+    ops_b = 0 if check else 6       # post-kill storm continues
+    pace_s = 0.15 if check else 0.25
+    STALE_SLO_S = 15.0              # hard gate, covers the failover
+    RECONV_SLO_S = 20.0             # post-kill convergence deadline
+    tight_slo_s, budget_src = _xds_stage_budget_s()
+
+    deregs: List[dict] = []
+    holds: Dict[str, List[tuple]] = {}
+    hold_lock = threading.Lock()
+    stats = {"delta": 0, "full": 0, "failovers": 0, "terminal": 0}
+    stats_lock = threading.Lock()
+    stop = threading.Event()
+    threads: List[threading.Thread] = []
+    cluster = None
+    tmp = tempfile.TemporaryDirectory(prefix="chaos-xds-storm-")
+
+    # catalog ground truth the storm maintains per route service
+    port_cur = {r: 7000 + 500 * r for r in range(routes)}
+    port_gen = {r: 0 for r in range(routes)}
+    registered = {r: True for r in range(routes)}
+    # the registrar node all catalog churn pins to (set post-election)
+    reg = {"i": None}
+
+    def put(cl_path, payload, timeout=20.0, pin=None):
+        """Leader-forwarded write, retried through election windows;
+        returns the apply-observed ts.  `pin` targets ONE node: agent
+        service registrations are node-scoped, so all catalog churn
+        goes through the surviving REGISTRAR node — the workload's
+        own agent, which the nemesis never kills (it kills the node
+        SERVING the watchers) — or replacement instances would land
+        on a different node and orphan the dead node's entries."""
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            targets = [pin] if pin is not None else \
+                cluster.alive_ids()
+            for i in targets:
+                try:
+                    cluster.client(i, timeout=5.0)._call(
+                        "PUT", cl_path,
+                        body=json.dumps(payload).encode())
+                    return time.time()
+                except (ApiError, OSError) as e:
+                    last = e
+            _nap(0.2)
+        raise RuntimeError(f"write {cl_path} never applied: {last}")
+
+    def watcher(pid, start_idx):
+        """One parked delta long-poll: maintains the proxy's HELD
+        {service: endpoints} map from full snapshots + per-subset
+        deltas, appending every received config to the correlated
+        timeline; fails over (full refetch — version cursors are
+        per-node) when its serving node dies."""
+        si = start_idx
+        cl = cluster.client(si, timeout=8.0)
+        cur, primed = 0, False
+        held: Dict[str, set] = {}
+
+        def record():
+            with hold_lock:
+                holds[pid].append(
+                    (time.time(),
+                     {s: set(v) for s, v in held.items()}))
+
+        while not stop.is_set():
+            try:
+                q = (f"?version={cur}&wait=3s&delta=1"
+                     if primed else "")
+                out = cl._call("GET", f"/v1/agent/xds/{pid}{q}")[0]
+            except (ApiError, OSError) as e:
+                if stop.is_set():
+                    return
+                if getattr(e, "code", None) == 410:
+                    held = {}
+                    record()        # terminal: proxy deregistered
+                    with stats_lock:
+                        stats["terminal"] += 1
+                    return
+                alive = cluster.alive_ids()
+                if not alive:
+                    _nap(0.2)
+                    continue
+                prev = si
+                si = next((a for a in alive if a != si), alive[0])
+                if si != prev:
+                    with stats_lock:
+                        stats["failovers"] += 1
+                cl = cluster.client(si, timeout=8.0)
+                cur, primed = 0, False
+                _nap(0.05)
+                continue
+            v = int(out.get("VersionInfo", cur) or 0)
+            if not primed:
+                held = _xds_endpoint_map(
+                    (out.get("Resources") or {}).get("endpoints")
+                    or [])
+                cur, primed = v, True
+                record()
+            elif v > cur:
+                cur = v
+                d = out.get("Delta")
+                if d is not None:
+                    held.update(_xds_endpoint_map(
+                        (d.get("Changed") or {}).get("endpoints")
+                        or []))
+                    for name in ((d.get("Removed") or {})
+                                 .get("endpoints") or []):
+                        held[str(name).split(".")[0]] = set()
+                    mode = "delta"
+                else:
+                    held = _xds_endpoint_map(
+                        (out.get("Resources") or {})
+                        .get("endpoints") or [])
+                    mode = "full"
+                with stats_lock:
+                    stats[mode] += 1
+                record()
+
+    def storm_op(i):
+        """One seeded churn op; records catalog deregs (instance
+        replacement deregisters the old port implicitly — ports are
+        never reused, so `cleared` is monotone for the checker)."""
+        k = rng.randrange(3)
+        if k == 0:
+            tgt = rng.randrange(shapes)
+            plan.append(["flip", tgt])
+            put("/v1/connect/intentions",
+                {"SourceName": f"storm-src-{i}",
+                 "DestinationName": f"storm{tgt}",
+                 "Action": "deny" if i % 2 else "allow"})
+            return
+        r = rng.randrange(routes)
+        if k == 1 or not registered[r]:
+            plan.append(["replace", r])
+            old = port_cur[r] if registered[r] else None
+            port_gen[r] += 1
+            fresh = 7000 + 500 * r + port_gen[r]
+            ts = put("/v1/agent/service/register",
+                     {"Name": f"route-{r}", "ID": f"route-{r}",
+                      "Port": fresh}, pin=reg["i"])
+            if old is not None:
+                deregs.append({"ts": ts, "service": f"route-{r}",
+                               "address": "127.0.0.1", "port": old})
+            port_cur[r], registered[r] = fresh, True
+        else:
+            plan.append(["dereg", r])
+            ts = put(f"/v1/agent/service/deregister/route-{r}",
+                     {}, pin=reg["i"])
+            deregs.append({"ts": ts, "service": f"route-{r}",
+                           "address": "127.0.0.1",
+                           "port": port_cur[r]})
+            registered[r] = False
+
+    kill_ts = None
+    with flight.use(recorder):
+        try:
+            cluster = LiveCluster(n, data_root=tmp.name, grpc=False)
+            cluster.start()
+            li = cluster.leader()
+            leader_http = cluster.servers[li].http
+            # the registrar: a follower the kill phase never touches,
+            # so every route instance lives on ONE surviving node
+            reg["i"] = next(i for i in range(n) if i != li)
+            for r in range(routes):
+                put("/v1/agent/service/register",
+                    {"Name": f"route-{r}", "ID": f"route-{r}",
+                     "Port": port_cur[r]}, pin=reg["i"])
+            pids = []
+            for i in range(proxies):
+                s = i % shapes
+                pid = f"storm{s}-{i}-sidecar-proxy"
+                put("/v1/agent/service/register",
+                    {"Name": f"storm{s}-sidecar-proxy", "ID": pid,
+                     "Kind": "connect-proxy", "Port": 22000 + i,
+                     "Proxy": {
+                         "DestinationServiceName": f"storm{s}",
+                         "Upstreams": [
+                             {"DestinationName":
+                              f"route-{s % routes}",
+                              "LocalBindPort": 9200 + s}]}})
+                pids.append(pid)
+                holds[pid] = []
+            # every watcher parks on the LEADER: the mid-storm kill -9
+            # hits the node serving ALL of them
+            for pid in pids:
+                t = threading.Thread(target=watcher, args=(pid, li),
+                                     name=f"storm-{pid}", daemon=True)
+                threads.append(t)
+                t.start()
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                with hold_lock:
+                    if all(holds[p] for p in pids):
+                        break
+                _nap(0.05)
+            with hold_lock:
+                unprimed = [p for p in pids if not holds[p]]
+            if unprimed:
+                violations.append(
+                    f"{len(unprimed)} watchers never primed their "
+                    f"first config off {leader_http}")
+
+            # ---------------- phase A: steady storm
+            for i in range(ops_a):
+                storm_op(i)
+                _nap(pace_s)
+
+            # ---------------- phase B: kill -9 the serving node
+            if ops_b:
+                fault("kill9", f"server{li} (serves every watcher)")
+                cluster.kill(li)
+                kill_ts = time.time()
+                nli = cluster.leader(timeout=25.0)
+                plan.append(["reelect"])
+                detail["new_leader"] = f"server{nli}"
+                for i in range(ops_b):
+                    storm_op(ops_a + i)
+                    _nap(pace_s)
+
+            # ---------------- reconvergence: every proxy's held map
+            # must match the final catalog
+            want = {r: ({("127.0.0.1", port_cur[r])}
+                        if registered[r] else set())
+                    for r in range(routes)}
+            t_conv = time.time()
+            laggards = dict.fromkeys(pids)
+            deadline = t_conv + RECONV_SLO_S
+            while laggards and time.time() < deadline:
+                with hold_lock:
+                    for pid in list(laggards):
+                        if not holds[pid]:
+                            continue
+                        r = (int(pid[5]) % routes)
+                        got = holds[pid][-1][1].get(f"route-{r}",
+                                                    set())
+                        if got == want[r]:
+                            del laggards[pid]
+                if laggards:
+                    _nap(0.05)
+            reconverge_s = round(time.time() - t_conv, 2)
+            for pid in sorted(laggards):
+                r = int(pid[5]) % routes
+                with hold_lock:
+                    got = (holds[pid][-1][1].get(f"route-{r}")
+                           if holds[pid] else None)
+                violations.append(
+                    f"reconvergence: {pid} still holds "
+                    f"{sorted(got) if got else got} for route-{r} "
+                    f"(want {sorted(want[r])}) "
+                    f"{RECONV_SLO_S}s after the storm"
+                    + (" and failover" if kill_ts else ""))
+
+            stop.set()
+            for t in threads:
+                t.join(timeout=5.0)
+            end_ts = time.time()
+
+            # ---------------- the no-stale-route invariant
+            v_hard, lags = check_stale_routes(
+                deregs, holds, STALE_SLO_S, end_ts)
+            violations += v_hard
+            pre_kill = [d for d in deregs
+                        if kill_ts is None
+                        or d["ts"] < kill_ts - 1.0]
+            v_tight, _ = check_stale_routes(
+                pre_kill, holds, tight_slo_s, end_ts)
+            violations += [f"stage-budget ({budget_src['source']}, "
+                           f"{tight_slo_s:.2f}s): {v}"
+                           for v in v_tight]
+            lag_vals = [r["lag_s"] for r in lags]
+            detail.update({
+                "proxies": proxies, "shapes": shapes,
+                "routes": routes,
+                "ops": ops_a + ops_b, "deregs": len(deregs),
+                "judged_pairs": len(lags),
+                "lag_s": {"max": round(max(lag_vals), 3)
+                          if lag_vals else 0.0,
+                          "n": len(lag_vals)},
+                "hard_slo_s": STALE_SLO_S,
+                "tight_slo_s": round(tight_slo_s, 2),
+                "stage_budget": budget_src,
+                "reconverge_s": reconverge_s,
+                "client_mode": {"delta": stats["delta"],
+                                "full": stats["full"]},
+                "failovers": stats["failovers"],
+                "killed": kill_ts is not None,
+            })
+            if not check and stats["delta"] == 0:
+                violations.append(
+                    "delta plane never exercised: every push the "
+                    "storm delivered was a full snapshot")
+        except Exception:
+            import traceback
+            tb = traceback.format_exc()
+            violations.append(
+                f"scenario crashed: {tb.strip().splitlines()[-1]}")
+            detail["traceback"] = tb
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=3.0)
+            if cluster is not None:
+                cluster.stop()
+            try:
+                tmp.cleanup()
+            except OSError:
+                pass
+    rows, _ = recorder.read_page(since=0)
+    events = "\n".join(
+        json.dumps({"ts": round(r["ts"], 3), "node": "nemesis",
+                    "name": r["name"], "labels": r["labels"]},
+                   sort_keys=True) for r in rows)
+    digest = hashlib.sha256(
+        json.dumps(plan, sort_keys=True).encode()).hexdigest()[:16]
+    return {"scenario": "live_xds_churn_storm", "seed": seed,
+            "ok": not violations, "violations": violations,
+            "digest": digest, "plan": plan, "injected": injected,
+            "detail": detail,
+            "repro": f"python tools/chaos_live.py --scenario "
+                     f"live_xds_churn_storm --seed {seed}",
+            "events": events}
+
+
 LIVE_SCENARIOS = {
     "live_partition_heal": live_partition_heal,
     "live_kill_leader_loop": live_kill_leader_loop,
@@ -2074,6 +2477,7 @@ LIVE_SCENARIOS = {
         live_stale_reads_through_election,
     "live_overload_shed": live_overload_shed,
     "live_wan_partition": live_wan_partition,
+    "live_xds_churn_storm": live_xds_churn_storm,
 }
 
 # the bounded tier-1 smoke (chaos_soak --check): kill -9 the leader,

@@ -196,6 +196,22 @@ CATALOG: Dict[str, dict] = {
     "xds.visibility.stall": {"severity": "warn",
                              "labels": ("stage", "index", "ms",
                                         "proxy_kind")},
+    # delta-xDS plane (ISSUE 19): one row per ADS response that
+    # shipped config — mode=delta|full tells whether the client got a
+    # versioned per-subset diff or a whole snapshot, index is the
+    # triggering store apply (correlates push back to the commit for
+    # the stale-route checker); a fallback row whenever a delta-mode
+    # client hit a version gap and was downgraded to a full snapshot;
+    # and a stale-route row per invariant violation the churn-storm
+    # checker found (a proxy held a config routing to a deregistered
+    # instance past the SLO — ms is how far past)
+    "xds.delta.pushed": {"severity": "info",
+                         "labels": ("proxy", "mode", "version",
+                                    "index")},
+    "xds.delta.fallback": {"severity": "info",
+                           "labels": ("proxy", "from", "version")},
+    "xds.stale_route": {"severity": "error",
+                        "labels": ("proxy", "service", "ms")},
     # lock-discipline plane (consul_tpu/locks.py, audit mode): an
     # acquisition that waited past the contention threshold, a hold
     # past the hold budget, and an observed acquisition-order cycle —

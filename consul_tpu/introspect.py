@@ -256,12 +256,16 @@ def xds_view(nodes: Union[List[str], Dict[str, str]]) -> dict:
     for label, url in items:
         c = Client(url, timeout=SCRAPE_TIMEOUT)
         row: dict = {"url": url.rstrip("/"), "alive": False,
-                     "proxies": [], "xds_visibility": {}}
+                     "proxies": [], "xds_visibility": {},
+                     "shapes": {}}
         name = label
         try:
             local = c.internal_xds(local=True)
             row["alive"] = True
             row["proxies"] = local.get("proxies", [])
+            # shared-shape registry (ISSUE 19): how many DISTINCT
+            # materializations this node's proxy population reduces to
+            row["shapes"] = local.get("shapes", {})
             name = label or local.get("node") or row["url"]
             dump = c._call("GET", "/v1/agent/metrics")[0]
             row["xds_visibility"] = xds_stages(dump)
@@ -277,6 +281,11 @@ def xds_view(nodes: Union[List[str], Dict[str, str]]) -> dict:
         for p in row["proxies"]:
             view["proxies"].append(dict(p, node=name))
     view["proxies"].sort(key=lambda p: (p["node"], p["proxy_id"]))
+    view["shapes"] = {
+        "distinct": sum((n.get("shapes") or {}).get("shapes", 0)
+                        for n in view["nodes"].values()),
+        "pinned": sum((n.get("shapes") or {}).get("pinned", 0)
+                      for n in view["nodes"].values())}
     view["generated_at"] = round(time.time(), 3)
     return view
 

@@ -4,14 +4,27 @@ The reference's proxycfg manager (agent/proxycfg/manager.go:38, Watch
 :303, state machine state.go) assembles, per registered sidecar proxy, a
 ConfigSnapshot from many watches — CA roots, the service leaf, upstream
 health, intentions — and pushes a fresh snapshot to the xDS server on
-every relevant change.  Here each snapshot rebuilds from materialized
-sources when a relevant store event lands (health of an upstream,
-intention change) or the CA rotates, and `watch()` serves blocking
-fetches keyed by version, exactly the shape the xDS layer long-polls.
+every relevant change.
+
+Shared-shape materialization (ISSUE 19 tentpole): N same-shaped sidecars
+of one service used to pay N materializations (and N publisher
+subscription sets) per catalog change.  The rebuild now routes through a
+single-flight shape store keyed on ``(kind, service, config-hash)`` —
+one `SharedShape` owns the follow loop, the watch set, and the expensive
+materialization; each `ProxyState` is a cheap projection that overlays
+the per-proxy fields (proxy id, leaf, bind address/ports) on the shared
+build.  Creation is single-flight (submatview.ViewStore discipline: the
+first requester materializes, concurrent requesters park on the entry
+gate, a failed creation releases waiters and vacates the slot), and the
+shape evicts on last disconnect.  `watch()` still serves blocking
+fetches keyed by per-proxy version, exactly the shape the xDS layer
+long-polls, and the per-proxy `stats()` rows keep rendering.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import threading
 import time
@@ -23,6 +36,29 @@ from consul_tpu.connect import intentions as imod
 
 # re-sign margin: leaves refresh well before their notAfter
 _LEAF_REFRESH_FRACTION = 0.75
+
+# per-proxy registration keys that do NOT shape the shared
+# materialization: everything else in the proxy block must hash equal
+# for two sidecars to share a build
+_PER_PROXY_KEYS = ("local_service_port",)
+
+
+def shape_key(svc: dict) -> Tuple[str, str, str]:
+    """The shape identity ``(kind, service, config-hash)`` of a proxy
+    registration: proxies agreeing on all three share one
+    materialization.  The hash covers the registration's proxy block
+    minus the per-proxy fields (bind port/address live at the top
+    level and never enter it)."""
+    kind = svc.get("kind", "connect-proxy")
+    proxy = svc.get("proxy") or {}
+    if kind == "connect-proxy":
+        service = proxy.get("destination_service", svc.get("name", ""))
+    else:
+        service = svc.get("name", "")
+    shaped = {k: v for k, v in proxy.items() if k not in _PER_PROXY_KEYS}
+    blob = json.dumps(shaped, sort_keys=True, default=str)
+    h = hashlib.sha1(blob.encode()).hexdigest()[:12]
+    return (kind, service, h)
 
 
 class ConfigSnapshot:
@@ -103,48 +139,46 @@ class ConfigSnapshot:
         self.push_emitted = False
 
 
-class ProxyState:
-    """Watch set + rebuild loop for one proxy (proxycfg/state.go)."""
+class SharedShape:
+    """ONE follow/rebuild loop per distinct (kind, service,
+    config-hash): the shared materialization every same-shaped proxy
+    projects from (ISSUE 19).  Owns the watch set (ONE publisher
+    subscription set per shape), the shape-level build (everything in
+    a ConfigSnapshot that does not name a specific proxy), and the
+    rebuild SLI ring the per-proxy stats rows render."""
 
-    def __init__(self, manager: "Manager", proxy_id: str, svc: dict,
-                 start_version: int = 0):
+    def __init__(self, manager: "Manager", key: Tuple[str, str, str],
+                 svc: dict):
         self.manager = manager
-        self.proxy_id = proxy_id
+        self.key = key
+        self.kind = key[0]
+        self.name = f"shape:{key[1]}@{key[2][:8]}"
+        # shape EXEMPLAR registration: the shared rebuild reads only
+        # shape-relevant fields from it (per-proxy fields are overlaid
+        # at projection time by each ProxyState)
         self.svc = svc
-        self.kind = svc.get("kind", "connect-proxy")
-        # one lock guards the whole per-proxy state; the condition is
-        # built OVER it so `with self._cond:` and `with self._lock:`
-        # are the same critical section (fetch parks on the condition,
-        # everything else takes the lock directly)
-        self._lock = locks.make_lock("proxycfg.state")
+        self._lock = locks.make_lock("proxycfg.shape")
         self._cond = locks.make_condition(self._lock)
-        self._snapshot: Optional[ConfigSnapshot] = None  # guarded-by: _lock
-        # versions survive state replacement: a long-poller parked on
-        # version N must see N+1 from the REPLACED state, not a restart
-        # at 1 it would read as no-change  # guarded-by: _lock
-        self._version = start_version
+        self._build: Optional[dict] = None               # guarded-by: _lock
+        self._version = 0                                # guarded-by: _lock
         self._subs: List[object] = []                    # guarded-by: _lock
-        # ingress/terminating gateways: per-bound-service health subs,
+        # gateways + chain targets: per-bound-service health subs,
         # resynced after each rebuild as bindings change
         self._health_subs: Dict[str, object] = {}        # guarded-by: _lock
         self._running = False                            # guarded-by: _lock
+        self._inflight = 0                               # guarded-by: _lock
         self._thread: Optional[threading.Thread] = None
-        # per-proxy SLI bookkeeping (ISSUE 16): rebuild-duration ring
-        # (p50/p99 for the /v1/internal/ui/xds table), counters, and
-        # last-activity clocks  # guarded-by: _lock
-        self._rebuild_ms = deque(maxlen=128)
-        self._rebuilds = 0                               # guarded-by: _lock
         # shared wakeup for the follow loop: attached to EVERY
         # subscription so one park covers the whole watch set (Event
         # is self-synchronized; not guarded)
         self._wake = threading.Event()
-        self._pushes = 0                                 # guarded-by: _lock
+        self._rebuild_ms = deque(maxlen=128)             # guarded-by: _lock
+        self._rebuilds = 0                               # guarded-by: _lock
         self._last_rebuild_ts = 0.0                      # guarded-by: _lock
-        self._last_push_ts = 0.0                         # guarded-by: _lock
-        locks.register_guards(self, self._lock, "_snapshot", "_version",
+        locks.register_guards(self, self._lock, "_build", "_version",
                               "_subs", "_health_subs", "_running",
-                              "_rebuild_ms", "_rebuilds", "_pushes",
-                              "_last_rebuild_ts", "_last_push_ts")
+                              "_inflight", "_rebuild_ms", "_rebuilds",
+                              "_last_rebuild_ts")
 
     def start(self) -> None:
         with self._lock:
@@ -197,14 +231,14 @@ class ProxyState:
                 self._subs = subs
         if stopped:
             # stop() raced start(): release the fresh subscriptions
-            # instead of leaking them on a dead state
+            # instead of leaking them on a dead shape
             for s in subs:
                 s.close()
             return
         self._sync_health_subs()
         self._thread = threading.Thread(
             target=self._follow, daemon=True,
-            name=f"proxycfg-{self.proxy_id}")
+            name=f"proxycfg-{self.name}")
         self._thread.start()
 
     def stop(self) -> None:
@@ -212,11 +246,10 @@ class ProxyState:
         from the follow thread itself skips the self-join), and safe
         mid-`_rebuild`: the in-flight rebuild finishes against closed
         subscriptions and the loop exits on its next `_running`
-        check."""
+        check.  Parked projections are notified so their fetches
+        return promptly instead of waiting out the poll."""
         with self._lock:
             self._running = False
-            # wake parked fetchers so they re-poll (and land on the
-            # replacement state) instead of sleeping out their wait
             self._cond.notify_all()
             subs = list(self._subs) + list(self._health_subs.values())
             self._subs = []
@@ -230,35 +263,36 @@ class ProxyState:
             t.join(timeout=5.0)
 
     def _sync_health_subs(self) -> None:
-        """Re-key per-service health subscriptions to the gateway's
+        """Re-key per-service health subscriptions to the shape's
         CURRENT bound services (bindings change with its config entry;
         a stale watch set would miss new services or churn on dropped
         ones).  Runs in whichever thread just rebuilt; sub churn
-        happens under the state lock so a concurrent stop() can't
+        happens under the shape lock so a concurrent stop() can't
         leak a freshly created subscription."""
         kind = self.kind
         if kind not in ("ingress-gateway", "terminating-gateway",
                         "connect-proxy"):
             return
         with self._lock:
-            snap = self._snapshot
+            build = self._build
         if kind == "connect-proxy":
             # chain split/failover targets beyond the upstreams already
             # watched at start(): their health moves chain_endpoints
             from consul_tpu import discoverychain as dchain
             direct = {up.get("destination_name", "")
-                      for up in (snap.upstreams if snap else [])}
+                      for up in (build["upstreams"] if build else [])}
             want = set()
-            for chain in (snap.chains if snap else {}).values():
+            for chain in (build["chains"] if build else {}).values():
                 want |= set(dchain.chain_target_services(chain))
             want -= direct
         else:
             want = {row["Service"] for row in
-                    (snap.gateway_services if snap is not None else [])}
+                    (build["gateway_services"] if build is not None
+                     else [])}
             if kind == "ingress-gateway":
                 # chain split/failover targets of bound services
                 from consul_tpu import discoverychain as dchain
-                for chain in (snap.chains if snap else {}).values():
+                for chain in (build["chains"] if build else {}).values():
                     want |= set(dchain.chain_target_services(chain))
         pub = self.manager.store.publisher
         drop = []
@@ -318,7 +352,7 @@ class ProxyState:
             if not fired:
                 # nothing buffered anywhere: park on the shared wake.
                 # Bounded so a missed set (none known) can't wedge the
-                # proxy; stop() sets it for an immediate exit.
+                # shape; stop() sets it for an immediate exit.
                 self._wake.wait(timeout=0.5)
                 continue
             with self._lock:
@@ -329,11 +363,11 @@ class ProxyState:
             except Exception:
                 # a transient failure (CSR rate pressure, store
                 # contention) must not kill the follow thread and
-                # freeze this proxy's snapshot forever; the next
+                # freeze this shape's build forever; the next
                 # event retries
                 logging.getLogger("consul_tpu.proxycfg").warning(
-                    "proxy %s rebuild failed; will retry",
-                    self.proxy_id, exc_info=True)
+                    "shape %s rebuild failed; will retry",
+                    self.name, exc_info=True)
 
     def _connect_endpoints(self, name: str,
                            target: Optional[dict] = None) -> List[dict]:
@@ -432,9 +466,17 @@ class ProxyState:
         kind = self.kind
         if kind in ("mesh-gateway", "ingress-gateway",
                     "terminating-gateway"):
-            self._rebuild_gateway(kind, trigger)
+            build = self._build_gateway(kind)
         else:
-            self._rebuild_connect_proxy(trigger)
+            build = self._build_connect_proxy()
+        index, tid = trigger if trigger is not None else (0, "")
+        build["store_index"], build["trace_id"] = index, tid
+        with self._cond:
+            self._version += 1
+            build["version"] = self._version
+            self._build = build
+            self._cond.notify_all()
+        self._sync_health_subs()
         dur_ms = (time.time() - t0) * 1000.0
         with self._lock:
             self._rebuild_ms.append(dur_ms)
@@ -443,83 +485,27 @@ class ProxyState:
             version = self._version
         # SLI emission strictly AFTER every proxycfg lock release —
         # staged like raft's _metrics_buf; stage_xds takes only the
-        # visibility table's own lock
+        # visibility table's own lock.  ONE rebuild row per shape
+        # materialization, however many proxies project it — that is
+        # the honest accounting the fan-out sweep judges.
         from consul_tpu import flight, telemetry
         telemetry.incr_counter(("xds", "rebuilds"), 1,
                                labels={"kind": kind})
-        index, tid = trigger if trigger is not None else (0, "")
         flight.emit("xds.rebuild",
-                    labels={"proxy": self.proxy_id, "kind": kind,
+                    labels={"proxy": self.name, "kind": kind,
                             "version": version, "index": index},
                     trace_id=tid or None)
         if index:
             vis = getattr(self.manager.store, "visibility", None)
             if vis is not None:
-                vis.stage_xds("rebuild", index, kind, self.proxy_id)
+                vis.stage_xds("rebuild", index, kind, self.name)
 
-    def note_push(self, snap: Optional[ConfigSnapshot]) -> None:
-        """Push-site bookkeeping, called by the ADS stream / HTTP
-        long-poll AFTER the response left this process: stamps the
-        per-proxy push clock and emits the apply->push visibility
-        stage once per snapshot (the first transport to deliver it
-        wins; stage_xds runs off every proxycfg lock)."""
-        emit_stage = False
-        with self._lock:
-            self._pushes += 1
-            self._last_push_ts = time.time()
-            if snap is not None and not snap.push_emitted \
-                    and snap.store_index:
-                snap.push_emitted = True
-                emit_stage = True
-        if not emit_stage:
-            return
-        vis = getattr(self.manager.store, "visibility", None)
-        if vis is not None:
-            vis.stage_xds("push", snap.store_index, snap.kind,
-                          self.proxy_id)
-
-    def stats(self, now: Optional[float] = None) -> dict:
-        """One per-proxy row of the /v1/internal/ui/xds table."""
-        now = time.time() if now is None else now
-        with self._lock:
-            snap = self._snapshot
-            version = self._version
-            ms = sorted(self._rebuild_ms)
-            rebuilds, pushes = self._rebuilds, self._pushes
-            last_rebuild = self._last_rebuild_ts
-            last_push = self._last_push_ts
-
-        def _pctl(q: float) -> float:
-            if not ms:
-                return 0.0
-            return round(ms[min(len(ms) - 1,
-                                max(0, int(q * len(ms))))], 3)
-
-        return {
-            "proxy_id": self.proxy_id,
-            "kind": self.kind,
-            "service": (snap.service if snap is not None
-                        else self.svc.get("name", "")),
-            "version": version,
-            "store_index": (snap.store_index if snap is not None
-                            else 0),
-            "rebuilds": rebuilds,
-            "pushes": pushes,
-            "rebuild_ms": {"p50": _pctl(0.5), "p99": _pctl(0.99)},
-            "last_rebuild_age_s": (round(now - last_rebuild, 3)
-                                   if last_rebuild else None),
-            "last_push_age_s": (round(now - last_push, 3)
-                                if last_push else None),
-        }
-
-    def _rebuild_connect_proxy(
-            self, trigger: Optional[Tuple[int, str]] = None) -> None:
+    def _build_connect_proxy(self) -> dict:
         from consul_tpu import discoverychain as dchain
         from consul_tpu import servicemgr
         m = self.manager
         raw_proxy = self.svc.get("proxy") or {}
-        service = raw_proxy.get("destination_service",
-                                self.svc.get("name", ""))
+        service = self.key[1]
         # ServiceManager merge: central proxy-defaults/service-defaults
         # land in every snapshot (mode, expose, transparent_proxy,
         # config) with the registration winning — the ("config", None)
@@ -554,28 +540,21 @@ class ProxyState:
                         tgt["Service"], target=tgt)
         relevant = imod.match_order(m.store.intention_list(), service,
                                     "destination")
-        leaf = m.get_leaf(service)
-        with self._cond:
-            self._version += 1
-            snap = ConfigSnapshot(
-                proxy_id=self.proxy_id, service=service,
-                upstreams=upstreams, roots=m.ca.roots(), leaf=leaf,
-                upstream_endpoints=endpoints, intentions=relevant,
-                default_allow=m.default_allow, version=self._version,
-                port=self.svc.get("port", 0),
-                bind_address=self.svc.get("address", ""),
-                local_port=proxy.get("local_service_port", 0),
-                chains=chains, chain_endpoints=chain_eps,
-                expose=proxy.get("expose") or {},
-                mode=proxy.get("mode", ""),
-                transparent_proxy=proxy.get("transparent_proxy")
-                or {},
-                opaque_config=proxy.get("config") or {})
-            if trigger is not None:
-                snap.store_index, snap.trace_id = trigger
-            self._snapshot = snap
-            self._cond.notify_all()
-        self._sync_health_subs()
+        return {
+            "kind": "connect-proxy", "service": service,
+            "upstreams": upstreams, "roots": m.ca.roots(),
+            "upstream_endpoints": endpoints, "intentions": relevant,
+            "default_allow": m.default_allow,
+            "gateway_services": [], "service_leaves": {},
+            "mesh_endpoints": {}, "federation_states": [],
+            "listeners": [],
+            "chains": chains, "chain_endpoints": chain_eps,
+            "expose": proxy.get("expose") or {},
+            "mode": proxy.get("mode", ""),
+            "transparent_proxy": proxy.get("transparent_proxy") or {},
+            "opaque_config": proxy.get("config") or {},
+            "local_port_default": proxy.get("local_service_port", 0),
+        }
 
     def _remote_dc_endpoints(self, dc: str) -> List[dict]:
         for f in self.manager.store.federation_state_list():
@@ -585,15 +564,13 @@ class ProxyState:
                         for g in f.get("mesh_gateways", [])]
         return []
 
-    def _rebuild_gateway(self, kind: str,
-                         trigger: Optional[Tuple[int, str]] = None
-                         ) -> None:
-        """Per-kind gateway snapshot (proxycfg/state.go
+    def _build_gateway(self, kind: str) -> dict:
+        """Per-kind gateway build (proxycfg/state.go
         initialize/handleUpdate for MeshGateway / TerminatingGateway /
         IngressGateway)."""
         from consul_tpu import gateways as gmod
         m = self.manager
-        gw_name = self.svc.get("name", "")
+        gw_name = self.key[1]
         endpoints: Dict[str, List[dict]] = {}
         bound: List[dict] = []
         service_leaves: Dict[str, dict] = {}
@@ -661,43 +638,282 @@ class ProxyState:
                     else:
                         gw_chain_eps[tid] = self._healthy_endpoints(
                             tgt["Service"], target=tgt)
-        leaf = m.get_leaf(gw_name)
-        with self._cond:
-            self._version += 1
-            snap = ConfigSnapshot(
-                proxy_id=self.proxy_id, service=gw_name,
-                upstreams=[], roots=m.ca.roots(), leaf=leaf,
-                upstream_endpoints=endpoints, intentions=intentions,
-                default_allow=m.default_allow, version=self._version,
-                kind=kind, gateway_services=bound,
-                service_leaves=service_leaves,
-                mesh_endpoints=mesh_endpoints,
-                federation_states=federation, listeners=listeners,
-                port=self.svc.get("port", 0),
-                bind_address=self.svc.get("address", ""),
-                chains=gw_chains, chain_endpoints=gw_chain_eps)
-            if trigger is not None:
-                snap.store_index, snap.trace_id = trigger
-            self._snapshot = snap
-            self._cond.notify_all()
-        self._sync_health_subs()
+        return {
+            "kind": kind, "service": gw_name, "upstreams": [],
+            "roots": m.ca.roots(), "upstream_endpoints": endpoints,
+            "intentions": intentions, "default_allow": m.default_allow,
+            "gateway_services": bound, "service_leaves": service_leaves,
+            "mesh_endpoints": mesh_endpoints,
+            "federation_states": federation, "listeners": listeners,
+            "chains": gw_chains, "chain_endpoints": gw_chain_eps,
+            "expose": {}, "mode": "", "transparent_proxy": {},
+            "opaque_config": {}, "local_port_default": 0,
+        }
+
+    def stats(self) -> dict:
+        """Shape-level slice of the per-proxy stats row."""
+        with self._lock:
+            ms = sorted(self._rebuild_ms)
+            rebuilds = self._rebuilds
+            last_rebuild = self._last_rebuild_ts
+            refs = 0
+
+        def _pctl(q: float) -> float:
+            if not ms:
+                return 0.0
+            return round(ms[min(len(ms) - 1,
+                                max(0, int(q * len(ms))))], 3)
+
+        return {"rebuilds": rebuilds,
+                "rebuild_ms": {"p50": _pctl(0.5), "p99": _pctl(0.99)},
+                "last_rebuild_ts": last_rebuild, "refs": refs}
+
+
+class _ShapeEntry:
+    """One shared shape slot: the SharedShape once ready, the
+    single-flight gate concurrent requesters park on, the attach
+    refcount last-disconnect eviction judges, and the tombstone flag
+    closing the attach/evict race."""
+
+    __slots__ = ("key", "shape", "ready", "error", "refs", "dead")
+
+    def __init__(self, key: Tuple[str, str, str]):
+        self.key = key
+        self.shape: Optional[SharedShape] = None
+        self.ready = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.refs = 0
+        self.dead = False
+
+
+class ProxyState:
+    """Cheap per-proxy projection over a SharedShape
+    (proxycfg/state.go's per-proxy surface): overlays proxy id, leaf,
+    and bind ports on the shared build, serves version-keyed blocking
+    fetches, and keeps the per-proxy push clocks the UI table and the
+    visibility plane read."""
+
+    def __init__(self, manager: "Manager", proxy_id: str, svc: dict,
+                 start_version: int = 0):
+        self.manager = manager
+        self.proxy_id = proxy_id
+        self.svc = svc
+        self.kind = svc.get("kind", "connect-proxy")
+        self._lock = locks.make_lock("proxycfg.state")
+        self._snapshot: Optional[ConfigSnapshot] = None  # guarded-by: _lock
+        self._snap_shape_v = 0                           # guarded-by: _lock
+        self._projections = 0                            # guarded-by: _lock
+        self._pushes = 0                                 # guarded-by: _lock
+        self._last_push_ts = 0.0                         # guarded-by: _lock
+        # versions survive state replacement: a long-poller parked on
+        # version N must see N+1 from the REPLACED state, not a restart
+        # at 1 it would read as no-change.  Per-proxy version =
+        # shape_version + _offset, fixed at attach time.
+        self._base = start_version
+        self._offset = 0
+        self._shape: Optional[SharedShape] = None
+        self._ent: Optional[_ShapeEntry] = None
+        # terminal marker (dereg / replacement): self-synchronized
+        # Event so fetchers parked on the SHAPE's condition can read it
+        # without taking this state's lock
+        self._stop_event = threading.Event()
+        self._stop_lock = threading.Lock()
+        self._stopped = False                # guarded-by: _stop_lock
+        locks.register_guards(self, self._lock, "_snapshot",
+                              "_snap_shape_v", "_projections",
+                              "_pushes", "_last_push_ts")
+
+    def start(self) -> None:
+        ent = self.manager._attach_shape(self.svc)
+        sh = ent.shape
+        with sh._lock:
+            shape_v0 = sh._version
+        self._ent = ent
+        self._shape = sh
+        # first projected version must exceed everything the previous
+        # incarnation served: current(v) = v + offset maps the shape's
+        # CURRENT build to base+1
+        self._offset = self._base + 1 - shape_v0
+
+    def alive(self) -> bool:
+        """False once deregistered or replaced — the terminal signal
+        the xDS frontends turn into a prompt terminal answer instead
+        of letting a parked long-poll wait out its timeout."""
+        return not self._stop_event.is_set()
+
+    def stop(self) -> None:
+        """Idempotent: marks the state terminal, wakes every fetcher
+        parked on the shared shape, and drops the shape refcount (last
+        disconnect evicts the shape and its subscription set)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self._stop_event.set()
+        sh, ent = self._shape, self._ent
+        if sh is not None:
+            with sh._cond:
+                sh._cond.notify_all()
+        if ent is not None:
+            self.manager._detach_shape(ent)
+
+    def current_version(self) -> int:
+        sh = self._shape
+        if sh is None:
+            return self._base
+        with sh._lock:
+            v = sh._version
+        return v + self._offset
 
     def fetch(self, min_version: int = 0,
-              timeout: float = 300.0) -> ConfigSnapshot:
+              timeout: float = 300.0) -> Optional[ConfigSnapshot]:
+        """Blocking per-proxy read: parks on the SHARED shape's
+        condition until the shape's build projects to a per-proxy
+        version > min_version, the deadline passes, or the state turns
+        terminal (dereg mid-long-poll returns promptly).  The
+        projection itself happens outside the shape lock — N proxies
+        of one shape share the park, not the overlay."""
+        sh = self._shape
+        if sh is None:
+            with self._lock:
+                return self._snapshot
         deadline = time.time() + timeout
-        with self._cond:
-            while (self._snapshot is None
-                   or self._snapshot.version <= min_version):
-                remaining = deadline - time.time()
-                if remaining <= 0:
-                    break
-                self._cond.wait(remaining)
+        with sh._cond:
+            sh._inflight += 1
+            try:
+                while (sh._version + self._offset <= min_version
+                       and sh._running
+                       and not self._stop_event.is_set()):
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        break
+                    sh._cond.wait(remaining)
+                build = self._build_ref(sh)
+                shape_v = sh._version
+            finally:
+                sh._inflight -= 1
+        if build is None:
+            with self._lock:
+                return self._snapshot
+        return self._project(build, shape_v)
+
+    @staticmethod
+    def _build_ref(sh: SharedShape) -> Optional[dict]:
+        # requires-lock: sh._lock
+        return sh._build
+
+    def _project(self, build: dict, shape_v: int) -> ConfigSnapshot:
+        """The cheap per-proxy overlay (ISSUE 19): shared references
+        for everything shape-level, fresh per-proxy leaf + identity +
+        bind surface.  Cached per shape version so concurrent fetchers
+        of one proxy share ONE snapshot object (the push_emitted
+        once-per-snapshot contract and the gRPC payload cache key on
+        object identity)."""
+        with self._lock:
+            snap = self._snapshot
+            if snap is not None and self._snap_shape_v >= shape_v:
+                return snap
+        m = self.manager
+        raw_proxy = self.svc.get("proxy") or {}
+        leaf = m.get_leaf(build["service"])
+        snap = ConfigSnapshot(
+            proxy_id=self.proxy_id, service=build["service"],
+            upstreams=build["upstreams"], roots=build["roots"],
+            leaf=leaf,
+            upstream_endpoints=build["upstream_endpoints"],
+            intentions=build["intentions"],
+            default_allow=build["default_allow"],
+            version=shape_v + self._offset, kind=build["kind"],
+            gateway_services=build["gateway_services"],
+            service_leaves=build["service_leaves"],
+            mesh_endpoints=build["mesh_endpoints"],
+            federation_states=build["federation_states"],
+            listeners=build["listeners"],
+            port=self.svc.get("port", 0),
+            bind_address=self.svc.get("address", ""),
+            local_port=raw_proxy.get("local_service_port")
+            or build["local_port_default"],
+            chains=build["chains"],
+            chain_endpoints=build["chain_endpoints"],
+            expose=build["expose"], mode=build["mode"],
+            transparent_proxy=build["transparent_proxy"],
+            opaque_config=build["opaque_config"])
+        snap.store_index = build["store_index"]
+        snap.trace_id = build["trace_id"]
+        with self._lock:
+            if self._snapshot is None or self._snap_shape_v < shape_v:
+                self._snapshot = snap
+                self._snap_shape_v = shape_v
+                self._projections += 1
             return self._snapshot
+
+    def note_push(self, snap: Optional[ConfigSnapshot]) -> None:
+        """Push-site bookkeeping, called by the ADS stream / HTTP
+        long-poll AFTER the response left this process: stamps the
+        per-proxy push clock and emits the apply->push visibility
+        stage once per snapshot (the first transport to deliver it
+        wins; stage_xds runs off every proxycfg lock)."""
+        emit_stage = False
+        with self._lock:
+            self._pushes += 1
+            self._last_push_ts = time.time()
+            if snap is not None and not snap.push_emitted \
+                    and snap.store_index:
+                snap.push_emitted = True
+                emit_stage = True
+        if not emit_stage:
+            return
+        vis = getattr(self.manager.store, "visibility", None)
+        if vis is not None:
+            vis.stage_xds("push", snap.store_index, snap.kind,
+                          self.proxy_id)
+
+    def stats(self, now: Optional[float] = None) -> dict:
+        """One per-proxy row of the /v1/internal/ui/xds table.
+        Rebuild cost/counters come from the SHARED shape (the honest
+        materialization accounting); pushes/projections stay
+        per-proxy."""
+        now = time.time() if now is None else now
+        with self._lock:
+            snap = self._snapshot
+            pushes = self._pushes
+            projections = self._projections
+            last_push = self._last_push_ts
+        sh = self._shape
+        shape_row = sh.stats() if sh is not None else {
+            "rebuilds": 0, "rebuild_ms": {"p50": 0.0, "p99": 0.0},
+            "last_rebuild_ts": 0.0}
+        last_rebuild = shape_row["last_rebuild_ts"]
+        return {
+            "proxy_id": self.proxy_id,
+            "kind": self.kind,
+            "service": (snap.service if snap is not None
+                        else self.svc.get("name", "")),
+            "version": self.current_version(),
+            "store_index": (snap.store_index if snap is not None
+                            else 0),
+            "shape": "/".join(self._ent.key) if self._ent is not None
+                     else "",
+            "rebuilds": shape_row["rebuilds"],
+            "projections": projections,
+            "pushes": pushes,
+            "rebuild_ms": shape_row["rebuild_ms"],
+            "last_rebuild_age_s": (round(now - last_rebuild, 3)
+                                   if last_rebuild else None),
+            "last_push_age_s": (round(now - last_push, 3)
+                                if last_push else None),
+        }
 
 
 class Manager:
     """Proxy registry (proxycfg.Manager): one ProxyState per registered
-    sidecar, created lazily from the catalog's connect-proxy services."""
+    sidecar, created lazily from the catalog's connect-proxy services,
+    projecting from single-flight SharedShapes keyed on
+    (kind, service, config-hash)."""
+
+    # single-flight wait bound: a wedged shape creator must surface as
+    # an error to its waiters, not park them forever
+    SHAPE_TIMEOUT = 30.0
 
     def __init__(self, store, ca, default_allow: bool = True,
                  dc: Optional[str] = None):
@@ -710,8 +926,22 @@ class Manager:
         self._leaves: Dict[str, Tuple[str, dict, float]] = {}
         self._lock = locks.make_lock("proxycfg.manager")
         self._states: Dict[str, ProxyState] = {}    # guarded-by: _lock
+        # the shared-shape registry; held for dict ops ONLY, never
+        # across a materialization (ViewStore discipline — requesters
+        # for OTHER shapes never wait behind a slow rebuild)
+        self._shape_lock = locks.make_lock("proxycfg.shapes")
+        self._shapes: Dict[Tuple[str, str, str], _ShapeEntry] = {}  # guarded-by: _shape_lock
+        # dereg reaper: one ("services") subscription that revalidates
+        # live states so a deregistered proxy's parked long-polls get
+        # their terminal answer promptly (ISSUE 19 satellite)
+        self._reap_stop = threading.Event()
+        self._reap_wake = threading.Event()
+        self._reap_thread: Optional[threading.Thread] = None
         locks.register_guards(self, self._leaf_lock, "_leaves")
         locks.register_guards(self, self._lock, "_states")
+        locks.register_guards(self, self._shape_lock, "_shapes")
+
+    # ------------------------------------------------------------- leaves
 
     def get_leaf(self, service: str) -> dict:
         """Cached leaf, re-signed when missing, when the active root
@@ -759,31 +989,192 @@ class Manager:
             return False
         return cert.not_valid_after_utc > now
 
+    # ------------------------------------------------------------- shapes
+
+    def _attach_shape(self, svc: dict) -> _ShapeEntry:
+        """Acquire + pin the shape for a registration (single-flight):
+        the first requester materializes, concurrent requesters for
+        the SAME key park on the entry gate, requesters for other keys
+        never wait behind it.  The returned entry holds one reference
+        for the caller; `_detach_shape` releases it."""
+        from consul_tpu import telemetry
+        key = shape_key(svc)
+        for _ in range(8):
+            creator = False
+            with self._shape_lock:
+                ent = self._shapes.get(key)
+                if ent is None:
+                    ent = _ShapeEntry(key)
+                    self._shapes[key] = ent
+                    creator = True
+            telemetry.incr_counter(
+                ("cache", "miss" if creator else "hit"),
+                labels={"type": f"shape:{key[0]}"})
+            if creator:
+                sh = SharedShape(self, key, svc)
+                try:
+                    sh.start()
+                except BaseException as e:
+                    # a failed materialization must release its
+                    # waiters AND vacate the slot so the next
+                    # requester retries fresh
+                    with self._shape_lock:
+                        ent.error = e
+                        ent.dead = True
+                        if self._shapes.get(key) is ent:
+                            del self._shapes[key]
+                    ent.ready.set()
+                    raise
+                with self._shape_lock:
+                    ent.shape = sh
+                    ent.refs += 1       # the creator's pin
+                ent.ready.set()
+                return ent
+            if not ent.ready.wait(self.SHAPE_TIMEOUT):
+                raise RuntimeError(
+                    f"shape {key} materialization timed out")
+            with self._shape_lock:
+                if ent.shape is not None and not ent.dead \
+                        and self._shapes.get(key) is ent:
+                    ent.refs += 1
+                    return ent
+            if ent.error is not None:
+                raise RuntimeError(
+                    f"shape {key} creation failed: {ent.error}")
+            # evicted between ready and pin (last-disconnect race):
+            # retry against a fresh slot
+        raise RuntimeError(f"shape {key} attach retry budget exhausted")
+
+    def _detach_shape(self, ent: _ShapeEntry) -> None:
+        """Release one pin; the LAST disconnect evicts the shape and
+        its whole subscription set (the reference refcounts proxycfg
+        watches the same way).  The stop runs outside the registry
+        lock so eviction never stalls unrelated attaches."""
+        dead = None
+        with self._shape_lock:
+            ent.refs -= 1
+            if ent.refs <= 0 and ent.shape is not None \
+                    and not ent.dead:
+                ent.dead = True
+                if self._shapes.get(ent.key) is ent:
+                    del self._shapes[ent.key]
+                dead = ent.shape
+        if dead is not None:
+            dead.stop()
+
+    def shape_stats(self) -> dict:
+        """Live shape-registry shape (tests + /v1/internal/ui/xds
+        summary): distinct shapes, total pins, per-shape rows."""
+        with self._shape_lock:
+            ents = [(e.key, e.refs, e.shape)
+                    for e in self._shapes.values()]
+        rows = []
+        inflight = 0
+        for key, refs, sh in ents:
+            if sh is None:
+                continue
+            with sh._lock:
+                rebuilds = sh._rebuilds
+                inflight += sh._inflight
+            rows.append({"shape": "/".join(key), "refs": refs,
+                         "rebuilds": rebuilds})
+        rows.sort(key=lambda r: r["shape"])
+        return {"shapes": len(rows),
+                "pinned": sum(r["refs"] for r in rows),
+                "inflight": inflight, "rows": rows}
+
+    # -------------------------------------------------------------- reaper
+
+    def _ensure_reaper(self) -> None:
+        if self._reap_thread is not None:
+            return
+        try:
+            sub = self.store.publisher.subscribe("services", None,
+                                                 since_index=None)
+        except Exception:
+            return
+        sub.attach_wake(self._reap_wake)
+        self._reap_thread = threading.Thread(
+            target=self._reap_loop, args=(sub,), daemon=True,
+            name="proxycfg-reaper")
+        self._reap_thread.start()
+
+    def _reap_loop(self, sub) -> None:
+        """Catalog-churn reaper: any services-topic event revalidates
+        every live state so a DEREGISTERED proxy's state stops (its
+        parked long-polls return terminally and its shape pin drops)
+        without waiting for the next watch() call."""
+        from consul_tpu.stream.publisher import SnapshotRequired
+        try:
+            while not self._reap_stop.is_set():
+                self._reap_wake.clear()
+                try:
+                    evs = sub.events(timeout=0.0)
+                except SnapshotRequired:
+                    evs = [True]
+                if not evs:
+                    self._reap_wake.wait(timeout=0.5)
+                    continue
+                with self._lock:
+                    pids = list(self._states)
+                for pid in pids:
+                    if self._reap_stop.is_set():
+                        return
+                    if self._find_proxy(pid) is not None:
+                        continue
+                    with self._lock:
+                        st = self._states.pop(pid, None)
+                    if st is not None:
+                        st.stop()
+        finally:
+            sub.close()
+
+    # --------------------------------------------------------------- watch
+
     def watch(self, proxy_id: str) -> Optional[ProxyState]:
         """ProxyState for a registered connect-proxy service id
         (Manager.Watch :303); None when no such proxy exists.  The
         catalog is revalidated on every call: a re-registration with a
-        changed proxy config replaces the state (new watch set), a
-        deregistered proxy drops it."""
+        changed proxy config replaces the state (new shape pin), a
+        deregistered proxy drops it.  The registry lock is held for
+        dict ops only — building a replacement (which may materialize
+        a new shape) never serializes unrelated watch() calls."""
         svc = self._find_proxy(proxy_id)
+        old = None
         with self._lock:
             st = self._states.get(proxy_id)
             if svc is None:
                 if st is not None:
-                    st.stop()
                     del self._states[proxy_id]
-                return None
-            if st is not None and st.svc.get("modify_index") == \
+                    old = st
+            elif st is not None and st.svc.get("modify_index") == \
                     svc.get("modify_index"):
                 return st
-            start_version = st._version if st is not None else 0
-            if st is not None:
-                st.stop()
-            st = ProxyState(self, proxy_id, svc,
-                            start_version=start_version)
-            st.start()
-            self._states[proxy_id] = st
-            return st
+            else:
+                old = st
+        if svc is None:
+            if old is not None:
+                old.stop()
+            return None
+        self._ensure_reaper()
+        start_version = old.current_version() if old is not None else 0
+        if old is not None:
+            old.stop()
+        new = ProxyState(self, proxy_id, svc,
+                         start_version=start_version)
+        new.start()
+        with self._lock:
+            cur = self._states.get(proxy_id)
+            if cur is not None and cur is not old and \
+                    cur.svc.get("modify_index") == \
+                    svc.get("modify_index"):
+                loser, winner = new, cur    # a concurrent watch() won
+            else:
+                self._states[proxy_id] = new
+                loser, winner = None, new
+        if loser is not None:
+            loser.stop()
+        return winner
 
     def _find_proxy(self, proxy_id: str) -> Optional[dict]:
         s = self.store.service_by_id(proxy_id)
@@ -794,25 +1185,41 @@ class Manager:
         return None
 
     def close(self) -> None:
-        """Stop every state and JOIN its follower thread (the PR 14
-        thread-hygiene contract): states detach under the lock, the
-        joins happen outside it so a slow in-flight rebuild can't
-        wedge concurrent watch() calls behind the registry."""
+        """Stop every state (detaching its shape pin) and the reaper;
+        any shape still pinned (a leaked ref) is stopped too.  States
+        detach under the lock, the stops happen outside it so a slow
+        in-flight rebuild can't wedge concurrent watch() calls behind
+        the registry."""
+        self._reap_stop.set()
+        self._reap_wake.set()
+        t = self._reap_thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._reap_thread = None
         with self._lock:
             states = list(self._states.values())
             self._states.clear()
         for st in states:
             st.stop()
+        with self._shape_lock:
+            ents = list(self._shapes.values())
+            self._shapes.clear()
+        for e in ents:
+            e.dead = True
+            if e.shape is not None:
+                e.shape.stop()
 
     def table(self) -> List[dict]:
         """The per-proxy mesh-control-plane table served at
         /v1/internal/ui/xds: one row per live ProxyState (kind,
         snapshot version, rebuild/push counters, rebuild p50/p99,
-        last-activity ages), plus the consul.xds.proxies{kind}
-        gauges — rows computed from a detached state list and gauges
-        emitted off every proxycfg lock."""
+        last-activity ages), plus the consul.xds.proxies{kind} and
+        consul.xds.shapes gauges — rows computed from a detached state
+        list and gauges emitted off every proxycfg lock."""
         with self._lock:
             states = list(self._states.values())
+        with self._shape_lock:
+            n_shapes = len(self._shapes)
         now = time.time()
         rows = [st.stats(now) for st in states]
         rows.sort(key=lambda r: r["proxy_id"])
@@ -823,4 +1230,5 @@ class Manager:
         for kind, n in sorted(kinds.items()):
             telemetry.set_gauge(("xds", "proxies"), float(n),
                                 labels={"kind": kind})
+        telemetry.set_gauge(("xds", "shapes"), float(n_shapes))
         return rows

@@ -1131,12 +1131,15 @@ def delta(prev_resources: dict, new_resources: dict) -> dict:
     return {"Changed": changed, "Removed": removed}
 
 
-def note_http_push_counters(payload: dict) -> None:
+def note_http_push_counters(payload: dict, mode: str = "full") -> None:
     """Transport parity for the JSON/HTTP ADS frontend: the same
-    `consul.xds.{pushes,resources}{type}` counters the gRPC stream
-    emits per type URL (xds_grpc._note_pushed), keyed here by the
-    payload's resource-group names.  For a ?delta response only the
-    CHANGED groups count — that is what actually crossed the wire.
+    `consul.xds.{pushes,resources}{type,mode}` counters the gRPC
+    stream emits per type URL (xds_grpc._note_pushed), keyed here by
+    the payload's resource-group names.  For a ?delta response only
+    the CHANGED groups count — that is what actually crossed the wire
+    — and `mode` records whether the client got a per-subset delta or
+    a whole snapshot (ISSUE 19: the delta/full split is how the
+    fan-out sweep proves wire cost scales with affected subsets).
     Called AFTER the HTTP response flush; no store/proxycfg lock is
     held."""
     from consul_tpu import telemetry
@@ -1147,11 +1150,12 @@ def note_http_push_counters(payload: dict) -> None:
         return
     for group, rows in res.items():
         telemetry.incr_counter(("xds", "pushes"), 1.0,
-                               labels={"type": group})
+                               labels={"type": group, "mode": mode})
         if rows:
             telemetry.incr_counter(("xds", "resources"),
                                    float(len(rows)),
-                                   labels={"type": group})
+                                   labels={"type": group,
+                                           "mode": mode})
 
 
 def snapshot_resources(snap) -> dict:

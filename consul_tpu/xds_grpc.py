@@ -161,7 +161,19 @@ class AdsServicer:
         version = 0
         slice_s = min(1.0, self.poll_interval)
         while not stop.is_set():
-            snap = st.watch.fetch(version, timeout=slice_s)
+            watch = st.watch
+            if not watch.alive():
+                # terminal state (ISSUE 19 satellite): the proxy
+                # deregistered or its registration was replaced.
+                # Rebind to the replacement if one exists; otherwise
+                # end the stream promptly (Envoy reconnects) instead
+                # of hot-spinning on a dead state's instant fetches.
+                rebound = self.manager.watch(st.proxy_id)
+                if rebound is None:
+                    q.put(("eof", None))
+                    return
+                st.watch = watch = rebound
+            snap = watch.fetch(version, timeout=slice_s)
             if snap is None:
                 continue
             if snap.version > version:
@@ -233,16 +245,20 @@ class AdsServicer:
                             "detail": (detail or "")[:200]})
 
     @staticmethod
-    def _note_pushed(st: _StreamState, url: str, n_rows: int) -> None:
+    def _note_pushed(st: _StreamState, url: str, n_rows: int,
+                     mode: str = "full") -> None:
         """Per-type push counters, emitted as the response is handed
-        to the gRPC machinery (no lock held)."""
+        to the gRPC machinery (no lock held).  `mode` distinguishes a
+        per-subset delta from a whole snapshot on the wire (ISSUE 19
+        accounting parity with the HTTP frontend)."""
         from consul_tpu import telemetry
         group = GROUP_BY_URL.get(url, url)
         telemetry.incr_counter(("xds", "pushes"), 1,
-                               labels={"type": group})
+                               labels={"type": group, "mode": mode})
         if n_rows:
             telemetry.incr_counter(("xds", "resources"), float(n_rows),
-                                   labels={"type": group})
+                                   labels={"type": group,
+                                           "mode": mode})
 
     def _push(self, st: _StreamState, urls: List[str],
               names_override: Optional[Dict[str, tuple]] = None):
@@ -273,6 +289,12 @@ class AdsServicer:
             # writer: stamps the per-proxy push clock and emits the
             # apply->push visibility stage once per snapshot
             st.watch.note_push(snap)
+            from consul_tpu import flight
+            flight.emit("xds.delta.pushed",
+                        labels={"proxy": st.proxy_id or "",
+                                "mode": "full",
+                                "version": snap.version,
+                                "index": snap.store_index})
 
     # ------------------------------------------------------------- delta
 
@@ -336,6 +358,8 @@ class AdsServicer:
         payload = self._payload(st, snap)
         version = str(snap.version)
         pushed = False
+        mode = "full"
+        fell_back = False
         for url in urls:
             have = held.setdefault(url, {})
             rows = payload.get(GROUP_BY_URL[url], [])
@@ -351,18 +375,39 @@ class AdsServicer:
                 st.sent[url] = (snap.version, st.sent.get(
                     url, (0, "", ()))[1], ())
                 continue
+            # accounting (ISSUE 19): a diff against a non-empty held
+            # set is a true per-subset delta; an empty held set means
+            # this client is getting the whole type from scratch.  A
+            # held set where EVERYTHING changed degenerated to a full
+            # resend — a version-gap fallback in delta clothing.
+            url_mode = "delta" if have else "full"
+            if have and len(changed) == len(current) and current:
+                fell_back = True
             for n, r in current.items():
                 have[n] = xds_pb.resource_version(r)
             for n in removed:
                 del have[n]
             nonce = st.next_nonce()
             st.sent[url] = (snap.version, nonce, ())
-            self._note_pushed(st, url, len(changed))
+            self._note_pushed(st, url, len(changed), mode=url_mode)
             pushed = True
+            if url_mode == "delta":
+                mode = "delta"
             yield xds_pb.build_delta_response(
                 url, changed, removed, version, nonce)
         if pushed:
             st.watch.note_push(snap)
+            from consul_tpu import flight
+            flight.emit("xds.delta.pushed",
+                        labels={"proxy": st.proxy_id or "",
+                                "mode": mode,
+                                "version": snap.version,
+                                "index": snap.store_index})
+            if fell_back:
+                flight.emit("xds.delta.fallback",
+                            labels={"proxy": st.proxy_id or "",
+                                    "from": 0,
+                                    "version": snap.version})
 
 
 SUBSCRIBE_SERVICE = "consultpu.stream.v1.StateChangeSubscription"

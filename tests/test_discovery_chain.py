@@ -218,8 +218,10 @@ def test_resolver_subsets_compile_to_targets():
 
 def test_subset_endpoints_filtered_by_meta():
     """proxycfg applies the subset's bexpr filter + only_passing when
-    resolving a subset target's endpoints."""
-    from consul_tpu.proxycfg import ProxyState
+    resolving a subset target's endpoints.  (ISSUE 19 moved endpoint
+    resolution from the per-proxy state onto the shared shape — the
+    projection must never re-resolve per proxy.)"""
+    from consul_tpu.proxycfg import SharedShape
     st = StateStore()
     st.register_node("n1", "10.0.0.1")
     st.register_node("n2", "10.0.0.2")
@@ -230,7 +232,7 @@ def test_subset_endpoints_filtered_by_meta():
 
     class _M:
         store = st
-    ps = ProxyState.__new__(ProxyState)
+    ps = SharedShape.__new__(SharedShape)
     ps.manager = _M()
     tgt = {"Subset": "v1", "Filter": "Service.Meta.version == v1",
            "OnlyPassing": False, "Service": "web",

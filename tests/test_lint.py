@@ -822,6 +822,58 @@ def test_issue18_selfdefense_metric_and_event_names_registered():
     assert any("adjust ms!" in f.message for f in mn)
 
 
+def test_issue19_churn_vocabulary_registered():
+    """The churn-storm vocabulary (ISSUE 19 satellite): the
+    xds.delta.{pushed,fallback} / xds.stale_route events are
+    registered in CATALOG with their exact label sets and the
+    mode-labelled consul.xds.{pushes,resources} counters pass the
+    metric gate — while a malformed sibling or undeclared label still
+    fires (the checker gates the NEW vocabulary, not just the old)."""
+    clean = """
+        from consul_tpu import flight, telemetry
+
+        def churn(proxy, mode, ver, index, svc, ms, n):
+            flight.emit("xds.delta.pushed",
+                        labels={"proxy": proxy, "mode": mode,
+                                "version": ver, "index": index})
+            flight.emit("xds.delta.fallback",
+                        labels={"proxy": proxy, "from": 0,
+                                "version": ver})
+            flight.emit("xds.stale_route",
+                        labels={"proxy": proxy, "service": svc,
+                                "ms": ms})
+            telemetry.incr_counter(("xds", "pushes"), n,
+                                   labels={"type": "endpoints",
+                                           "mode": mode})
+            telemetry.incr_counter(("xds", "resources"), n,
+                                   labels={"type": "endpoints",
+                                           "mode": mode})
+            telemetry.set_gauge(("xds", "shapes"), n)
+    """
+    assert check_snippet("event-names", clean) == []
+    assert check_snippet("metric-names", clean) == []
+    bad = """
+        from consul_tpu import flight, telemetry
+
+        def churn(proxy, mode, svc, labels):
+            flight.emit("xds.delta.exploded",
+                        labels={"proxy": proxy})
+            flight.emit("xds.stale_route",
+                        labels={"proxy": proxy, "service": svc,
+                                "lane": 2})
+            flight.emit("xds.delta.pushed", labels=labels)
+            telemetry.add_sample(("xds", "delta ms!"), 1.0)
+    """
+    ev = check_snippet("event-names", bad)
+    msgs = "\n".join(f.message for f in ev)
+    assert len(ev) == 3
+    assert "unregistered event name 'xds.delta.exploded'" in msgs
+    assert "label 'lane' not declared" in msgs
+    assert "computed labels" in msgs
+    mn = check_snippet("metric-names", bad)
+    assert any("delta ms!" in f.message for f in mn)
+
+
 def test_gather_discipline_fires_and_stays_silent():
     bad = """
         import numpy as np

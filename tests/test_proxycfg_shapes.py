@@ -1,0 +1,284 @@
+"""Shared-shape proxycfg materializations (ISSUE 19 tentpole a).
+
+N same-shaped sidecars must collapse onto ONE SharedShape — one
+publisher subscription set, one rebuild per catalog change — with
+per-proxy state a cheap projection.  The single-flight store must not
+serialize distinct shapes behind each other, must recover from a
+failed materialization, and must evict on last disconnect (including
+mid-long-poll deregistration, which also has to answer parked
+fetchers promptly).  All in-process against a real StateStore +
+publisher; the live HTTP 410 path rides test_proxycfg_xds.
+"""
+
+import threading
+import time
+
+import pytest
+
+from consul_tpu import proxycfg
+from consul_tpu.catalog.store import StateStore
+from consul_tpu.chaos import check_stale_routes
+from consul_tpu.connect.ca import CAManager
+
+
+def _register_proxy(store, pid, shape, port=0, bind_port=None):
+    proxy = {"destination_service": f"app{shape}",
+             "upstreams": [{"destination_name": f"route-{shape}",
+                            "local_bind_port": 9300 + shape}]}
+    if bind_port is not None:
+        proxy["local_service_port"] = bind_port
+    store.register_service("n1", pid, f"app{shape}-sidecar-proxy",
+                           port=21000 + port, kind="connect-proxy",
+                           proxy=proxy)
+
+
+@pytest.fixture()
+def mgr():
+    store = StateStore()
+    store.register_service("n1", "route-0", "route-0", port=7000)
+    store.register_service("n1", "route-1", "route-1", port=7001)
+    m = proxycfg.Manager(store, CAManager(dc="dc1"))
+    yield m, store
+    m.close()
+
+
+def _subs(store):
+    with store.publisher._lock:
+        return len(store.publisher._subs)
+
+
+def test_same_shape_proxies_share_one_materialization(mgr):
+    """Two proxies of one shape: ONE shape entry, ONE subscription
+    set (the spy), one rebuild per change, shared build references."""
+    m, store = mgr
+    _register_proxy(store, "p0", 0, port=0)
+    st0 = m.watch("p0")
+    base = _subs(store)
+    assert base > 0
+    _register_proxy(store, "p1", 0, port=1)
+    st1 = m.watch("p1")
+    # the second same-shape proxy added ZERO publisher subscriptions
+    assert _subs(store) == base
+    stats = m.shape_stats()
+    assert stats["shapes"] == 1 and stats["pinned"] == 2
+    s0 = st0.fetch(timeout=2.0)
+    s1 = st1.fetch(timeout=2.0)
+    # shape-level containers are the SAME objects (projection, not
+    # copy); per-proxy identity differs
+    assert s0.upstream_endpoints is s1.upstream_endpoints
+    assert s0.intentions is s1.intentions
+    assert s0.proxy_id == "p0" and s1.proxy_id == "p1"
+    # one catalog change = one shared rebuild, both versions advance
+    v0, v1 = st0.current_version(), st1.current_version()
+    before = st0.stats()["rebuilds"]
+    store.register_service("n1", "route-0b", "route-0", port=7100)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and (
+            st0.current_version() == v0 or st1.current_version() == v1):
+        time.sleep(0.02)
+    assert st0.current_version() > v0 and st1.current_version() > v1
+    after = st0.stats()["rebuilds"]
+    assert after >= before + 1
+    assert st1.stats()["rebuilds"] == after     # same shared counter
+
+
+def test_distinct_bind_port_still_shares_shape(mgr):
+    """local_service_port is per-proxy (overlaid at projection): two
+    proxies differing ONLY there still share one materialization."""
+    m, store = mgr
+    _register_proxy(store, "p0", 0, port=0, bind_port=8080)
+    _register_proxy(store, "p1", 0, port=1, bind_port=9090)
+    s0 = m.watch("p0").fetch(timeout=2.0)
+    s1 = m.watch("p1").fetch(timeout=2.0)
+    assert m.shape_stats()["shapes"] == 1
+    assert s0.local_port == 8080 and s1.local_port == 9090
+
+
+def test_dereg_mid_long_poll_terminal_and_evicts(mgr):
+    """Satellite 1: deregistering a proxy while a fetch is parked on
+    its (shared) condition answers the fetch promptly, drops the shape
+    refcount, and — on last disconnect — evicts the shape, closing its
+    whole subscription set (the publisher-spy regression)."""
+    m, store = mgr
+    base = _subs(store)
+    _register_proxy(store, "p0", 0)
+    st = m.watch("p0")
+    st.fetch(timeout=2.0)
+    after_attach = _subs(store)
+    assert after_attach > base
+    got = {}
+
+    def park():
+        t0 = time.time()
+        got["snap"] = st.fetch(min_version=st.current_version(),
+                               timeout=30.0)
+        got["lat"] = time.time() - t0
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    store.deregister_service("n1", "p0")
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "dereg left the long-poll parked"
+    assert got["lat"] < 5.0
+    assert not st.alive()
+    deadline = time.time() + 5.0
+    while time.time() < deadline and m.shape_stats()["shapes"]:
+        time.sleep(0.02)
+    assert m.shape_stats() == {"shapes": 0, "pinned": 0,
+                               "inflight": 0, "rows": []}
+    # eviction closed the shape's subscriptions; only the reaper's
+    # own services subscription may remain above the baseline
+    assert _subs(store) <= base + 1 < after_attach
+
+
+def test_two_shapes_do_not_serialize(mgr, monkeypatch):
+    """Single-flight is PER KEY: a slow materialization of shape A
+    must not stall an attach of shape B (ViewStore discipline — the
+    registry lock is never held across a build)."""
+    m, store = mgr
+    slow_started = threading.Event()
+    release = threading.Event()
+    orig = proxycfg.SharedShape._rebuild
+
+    def gated(self, trigger=None):
+        if self.key[1] == "app0" and not release.is_set():
+            slow_started.set()
+            assert release.wait(10.0)
+        return orig(self, trigger)
+
+    monkeypatch.setattr(proxycfg.SharedShape, "_rebuild", gated)
+    _register_proxy(store, "slow0", 0)
+    _register_proxy(store, "fast1", 1)
+    done = {}
+
+    def attach(pid):
+        done[pid] = m.watch(pid)
+
+    ta = threading.Thread(target=attach, args=("slow0",), daemon=True)
+    ta.start()
+    assert slow_started.wait(5.0)
+    t0 = time.time()
+    tb = threading.Thread(target=attach, args=("fast1",), daemon=True)
+    tb.start()
+    tb.join(timeout=5.0)
+    assert not tb.is_alive(), \
+        "shape app1 attach serialized behind app0's slow build"
+    fast_lat = time.time() - t0
+    assert fast_lat < 2.0
+    assert done["fast1"].fetch(timeout=2.0).service == "app1"
+    release.set()
+    ta.join(timeout=10.0)
+    assert done["slow0"] is not None
+    assert m.shape_stats()["shapes"] == 2
+
+
+def test_failed_materialization_releases_waiters_and_recovers(
+        mgr, monkeypatch):
+    """A creator whose build raises must propagate the error to every
+    parked waiter AND vacate the slot: the next attach retries fresh
+    and succeeds."""
+    m, store = mgr
+    _register_proxy(store, "p0", 0)
+    boom = {"n": 0}
+    orig = proxycfg.SharedShape._rebuild
+
+    def failing(self, trigger=None):
+        if self.key[1] == "app0" and boom["n"] == 0:
+            boom["n"] += 1
+            raise RuntimeError("injected build failure")
+        return orig(self, trigger)
+
+    monkeypatch.setattr(proxycfg.SharedShape, "_rebuild", failing)
+    with pytest.raises(RuntimeError):
+        m.watch("p0")
+    assert m.shape_stats()["shapes"] == 0   # slot vacated
+    st = m.watch("p0")                      # fresh creation succeeds
+    assert st is not None and st.fetch(timeout=2.0) is not None
+    assert m.shape_stats()["shapes"] == 1
+
+
+def test_eviction_with_inflight_fetch_returns_cleanly(mgr):
+    """Churn eviction must not strand in-flight fetches: a fetcher
+    parked on the shape's condition while BOTH pins drop (shape
+    evicted under it) returns promptly without raising."""
+    m, store = mgr
+    _register_proxy(store, "p0", 0, port=0)
+    _register_proxy(store, "p1", 0, port=1)
+    st0, st1 = m.watch("p0"), m.watch("p1")
+    st0.fetch(timeout=2.0)
+    got = {}
+
+    def park():
+        try:
+            got["snap"] = st0.fetch(
+                min_version=st0.current_version(), timeout=30.0)
+        except Exception as e:      # pragma: no cover - the failure
+            got["err"] = e
+        got["done"] = True
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    store.deregister_service("n1", "p0")
+    store.deregister_service("n1", "p1")
+    t.join(timeout=5.0)
+    assert got.get("done") and "err" not in got
+    deadline = time.time() + 5.0
+    while time.time() < deadline and m.shape_stats()["shapes"]:
+        time.sleep(0.02)
+    assert m.shape_stats()["shapes"] == 0
+    assert not st0.alive() and not st1.alive()
+
+
+def test_replacement_versions_stay_monotone(mgr):
+    """A re-registration with a CHANGED proxy block moves the proxy to
+    a new shape; the replacement state's versions continue past the
+    old ones so parked long-pollers never see a restart."""
+    m, store = mgr
+    _register_proxy(store, "p0", 0)
+    st = m.watch("p0")
+    st.fetch(timeout=2.0)
+    v = st.current_version()
+    store.register_service(
+        "n1", "p0", "app0-sidecar-proxy", port=21000,
+        kind="connect-proxy",
+        proxy={"destination_service": "app0",
+               "upstreams": [{"destination_name": "route-1",
+                              "local_bind_port": 9999}]})
+    st2 = m.watch("p0")
+    assert st2 is not st and not st.alive()
+    assert st2.current_version() > v
+    assert st2.fetch(timeout=2.0).version > v
+
+
+# ---------------------------------------------------------------- checker
+
+
+def test_check_stale_routes_flags_only_slo_breaches():
+    """Pure-function contract of the chaos invariant: cleared within
+    the SLO is silent, cleared late or never is a violation, proxies
+    that never routed to the instance are skipped."""
+    deregs = [{"ts": 10.0, "service": "db",
+               "address": "127.0.0.1", "port": 5432}]
+    ep = ("127.0.0.1", 5432)
+    holds = {
+        "fast": [(0.0, {"db": {ep}}), (10.5, {"db": set()})],
+        "slow": [(0.0, {"db": {ep}}), (14.0, {"db": set()})],
+        "never": [(0.0, {"db": {ep}})],
+        "unrelated": [(0.0, {"web": {("127.0.0.1", 80)}})],
+    }
+    violations, lags = check_stale_routes(deregs, holds, slo_s=2.0,
+                                          end_ts=20.0)
+    assert len(lags) == 3           # `unrelated` never judged
+    by = {r["proxy"]: r for r in lags}
+    assert by["fast"]["cleared"] and by["fast"]["lag_s"] == 0.5
+    assert by["slow"]["lag_s"] == 4.0
+    assert not by["never"]["cleared"] and by["never"]["lag_s"] == 10.0
+    assert len(violations) == 2
+    assert any("slow" in v for v in violations)
+    assert any("never" in v for v in violations)
+    # tightened observation: everything inside a lax SLO is silent
+    v2, _ = check_stale_routes(deregs, {"fast": holds["fast"]},
+                               slo_s=2.0, end_ts=20.0)
+    assert v2 == []

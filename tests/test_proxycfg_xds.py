@@ -164,6 +164,45 @@ def test_sidecar_deregisters_cleanly(agent):
     assert e.value.code == 404
 
 
+def test_dereg_mid_long_poll_gets_terminal_410(agent):
+    """A long-poll parked when its proxy deregisters must get a
+    PROMPT terminal answer (410 Gone), not wait out its poll — and a
+    fresh poll on the dead id is a plain 404 (ISSUE 19)."""
+    import urllib.request as _rq
+    req = _rq.Request(
+        agent.http_address + "/v1/agent/service/register",
+        data=json.dumps({
+            "Name": "gone-proxy", "ID": "gone-proxy",
+            "Kind": "connect-proxy",
+            "Proxy": {"DestinationServiceName": "gone"}}).encode(),
+        method="PUT")
+    _rq.urlopen(req, timeout=30)
+    v = int(_xds(agent, "gone-proxy")["VersionInfo"])
+    got = {}
+
+    def park():
+        t0 = time.time()
+        try:
+            _xds(agent, "gone-proxy", version=v, wait="25s")
+        except urllib.error.HTTPError as e:
+            got["code"] = e.code
+        got["lat"] = time.time() - t0
+
+    t = threading.Thread(target=park, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    _rq.urlopen(_rq.Request(
+        agent.http_address + "/v1/agent/service/deregister/gone-proxy",
+        data=b"", method="PUT"), timeout=30)
+    t.join(timeout=10.0)
+    assert not t.is_alive(), "dereg left the xDS long-poll parked"
+    assert got.get("code") == 410, got
+    assert got["lat"] < 10.0
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _xds(agent, "gone-proxy")
+    assert e.value.code == 404
+
+
 def test_delta_poll_ships_only_changed_resources(agent):
     """?delta&version=N returns changed/removed resources only
     (DeltaAggregatedResources semantics, agent/xds/delta.go:33)."""

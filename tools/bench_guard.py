@@ -399,6 +399,23 @@ def run_check() -> int:
         failures.append("guard judged the self-defense stamp keys "
                         "(wan_partition/controller/replication) "
                         "instead of tolerating them")
+    # ISSUE 19's saturation-axis stamps are metadata too: kv_bench
+    # --rate-limit rows carry {"ratelimit": {mode, spec}} and
+    # {"shed": {ratio, count, accepted_rps, lat_429_ms}} — a
+    # decorated within-threshold row must be tolerated-not-judged
+    shedrow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                      "rate_limited": 8000,
+                      "ratelimit": {"mode": "enforcing",
+                                    "spec": "mode=enforcing,"
+                                            "write_rate=500"},
+                      "shed": {"ratio": 0.4, "count": 8000,
+                               "accepted_rps": 1800.0,
+                               "lat_429_ms": {"p50": 0.8,
+                                              "p99": 2.1}}}],
+                    fake_base)
+    if not shedrow["ok"]:
+        failures.append("guard judged the ratelimit/shed stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

@@ -2,7 +2,7 @@
 over real sockets (ISSUE 9 tentpole).
 
     python tools/chaos_live.py                  # every live scenario,
-                                                # emits CHAOS_r05.json
+                                                # emits CHAOS_r06.json
     python tools/chaos_live.py --seed 42        # same suite, seed 42
     python tools/chaos_live.py --scenario live_kill_leader_loop --seed 3
     python tools/chaos_live.py --check          # the bounded tier-1
@@ -35,7 +35,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
-ARTIFACT = os.path.join(REPO, "CHAOS_r05.json")
+ARTIFACT = os.path.join(REPO, "CHAOS_r06.json")
 CHECK_SEED = 7
 
 
@@ -108,6 +108,14 @@ def run_soak(names, seed: int, out_path: str) -> int:
             "(federation view degrades the DC row, never drops it) "
             "and converges to zero within the SLO after heal_link, "
             "with replication.diverged/converged journaled",
+            "no stale routes under churn storms: shared-shape "
+            "proxies parked on delta long-polls never hold a config "
+            "routing to a deregistered instance beyond the SLO "
+            "(chaos.check_stale_routes over the correlated hold "
+            "timelines; pre-kill deregs judged at the "
+            "XDSVIS_r01-derived stage budget), and every proxy "
+            "reconverges to the correct config after the serving "
+            "node is kill -9'd mid-storm",
         ],
     }
     with open(out_path, "w") as f:

@@ -151,10 +151,32 @@ def run_check() -> int:
         failures += [f"{shed['scenario']}: {v}"
                      for v in shed["violations"]]
         chaos_live.print_violation_tail(shed)
+    # the bounded churn-storm smoke (ISSUE 19): shared-shape proxies
+    # park delta long-polls on a live 2-proc cluster while a seeded
+    # register/dereg/intention storm churns the catalog — the
+    # no-stale-route invariant (chaos.check_stale_routes) must hold
+    # at the XDSVIS-derived stage budget, under the same wall budget
+    t0 = time.time()
+    storm = chaos_live.run_live_scenario("live_xds_churn_storm",
+                                         CHECK_SEED, check=True)
+    storm["wall_s"] = round(time.time() - t0, 2)
+    print(json.dumps({k: storm[k] for k in
+                      ("scenario", "seed", "ok", "digest",
+                       "wall_s")}))
+    if storm["wall_s"] > chaos_live.SMOKE_BUDGET_S:
+        storm["ok"] = False
+        storm["violations"].append(
+            f"churn-storm smoke overran its wall budget: "
+            f"{storm['wall_s']}s > {chaos_live.SMOKE_BUDGET_S}s")
+    if not storm["ok"]:
+        failures += [f"{storm['scenario']}: {v}"
+                     for v in storm["violations"]]
+        chaos_live.print_violation_tail(storm)
     failures += locks.check_clean()
     out = {"mode": "check", "seed": CHECK_SEED,
            "scenarios": [r["scenario"] for r in rows]
-           + [live["scenario"], shed["scenario"]],
+           + [live["scenario"], shed["scenario"],
+              storm["scenario"]],
            "locks": locks.audit_summary(),
            "deterministic": deterministic,
            "timeline_identical": timeline_identical,
@@ -169,6 +191,14 @@ def run_check() -> int:
                         "budget_s": chaos_live.SMOKE_BUDGET_S,
                         "detail": shed.get("detail", {}).get("burst"),
                         "ok": shed["ok"]},
+           "churn_storm": {"scenario": storm["scenario"],
+                           "wall_s": storm["wall_s"],
+                           "budget_s": chaos_live.SMOKE_BUDGET_S,
+                           "detail": {k: storm.get("detail", {}).get(k)
+                                      for k in ("deregs", "lag_s",
+                                                "tight_slo_s",
+                                                "client_mode")},
+                           "ok": storm["ok"]},
            "ok": not failures, "failures": failures}
     print(json.dumps(out))
     return 1 if failures else 0

@@ -12,7 +12,14 @@ Run: python tools/kv_bench.py [--n-ops 20000] [--conns 32] [--cluster]
 --cluster benches the replicated N-server path (--servers, default
 3): one server PROCESS per member (tools/server_proc.py), raft +
 leader forwarding over real sockets, GETs round-robined across all
-members (the reference's LB-over-3 row).  Every member gets the fleet
+members (the reference's LB-over-3 row).  --rate-limit SPEC (ISSUE
+19 / ROADMAP item 5) arms every member's ingress limiter with the
+server_proc spec and turns the PUT phase into a saturation
+measurement: rows gain a `ratelimit` stamp plus `shed` columns —
+shed ratio, accepted req/s, and the client-observed 429-path latency
+(p50/p99), which must sit far under a quorum commit for the shed
+path to be a defense rather than a second queue.  Every member gets
+the fleet
 HTTP map, so DEFAULT-mode GETs against followers leader-forward (the
 read plane's leader-verified semantics); --stale adds the ?stale
 follower-fanout phases where every server answers from its local
@@ -70,6 +77,11 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
     # an OUTCOME of the bench, not an error — counted in its own
     # column so an enforcing-mode run reads honestly
     rl = [0] * conns
+    # 429-path round-trip latencies (ISSUE 19: the shed path must be
+    # CHEAP — a limiter that makes rejected writers wait as long as a
+    # quorum commit sheds nothing).  Bounded per worker so the result
+    # queue payload stays small at deep saturation.
+    rl_lat = [[] for _ in range(conns)]
     stale_per_100 = int(round(stale_mix * 100))
 
     def worker(wid):
@@ -86,6 +98,7 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
                 if verb == "GET" and (i % 100) < stale_per_100:
                     path += "?stale="
                 try:
+                    t_req = time.perf_counter()
                     conn.request(verb, path, body=body)
                     r = conn.getresponse()
                     r.read()
@@ -112,6 +125,9 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
                     # shed by the ingress limiter: a definite
                     # non-write/non-read, counted as its own outcome
                     rl[wid] += 1
+                    if len(rl_lat[wid]) < 2000:
+                        rl_lat[wid].append(
+                            time.perf_counter() - t_req)
                     continue
                 if r.status >= 400:
                     errors.append(r.status)
@@ -133,7 +149,8 @@ def _load_proc(addresses, per, conns, verb, body, q, barrier=None,
         t.start()
     for t in threads:
         t.join()
-    q.put((time.perf_counter() - t0, errors[:3], sum(amb), sum(rl)))
+    q.put((time.perf_counter() - t0, errors[:3], sum(amb), sum(rl),
+           [l for ws in rl_lat for l in ws]))
 
 
 def drive(addresses, n_ops, conns, verb, body=None, procs=1,
@@ -175,13 +192,42 @@ def drive(addresses, n_ops, conns, verb, body=None, procs=1,
     for p in ps:
         p.join(timeout=30)
     dt = time.perf_counter() - t0
-    errs = [e for _, errors, _, _ in results for e in errors]
+    errs = [e for _, errors, _, _, _ in results for e in errors]
     if errs:
         raise RuntimeError(f"bench errors: {errs[:3]}")
     total = per_conn * conns_per_proc * len(ps)
-    ambiguous = sum(a for _, _, a, _ in results)
-    rate_limited = sum(r for _, _, _, r in results)
-    return total / dt, dt, ambiguous, rate_limited
+    ambiguous = sum(a for _, _, a, _, _ in results)
+    rate_limited = sum(r for _, _, _, r, _ in results)
+    rl_lats = sorted(l for _, _, _, _, ls in results for l in ls)
+    return total / dt, dt, ambiguous, rate_limited, rl_lats
+
+
+def _pct(sorted_vals, p):
+    """Percentile over an already-sorted list (nearest-rank)."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * len(sorted_vals))) - 1))
+    return sorted_vals[idx]
+
+
+def _shed_cols(total, rate_limited, rl_lats, dt):
+    """The rate-limit axis columns (ISSUE 19 / ROADMAP item 5): what
+    fraction of offered load the enforcing limiter shed, and what the
+    429 path COSTS the client — the shed path only defends the
+    cluster if a rejected write returns in microseconds-to-low-ms,
+    far under a quorum commit's round trips."""
+    return {
+        "ratio": round(rate_limited / total, 4) if total else 0.0,
+        "count": rate_limited,
+        "accepted_rps": round((total - rate_limited) / dt, 1),
+        "lat_429_ms": {
+            "p50": round(_pct(rl_lats, 50) * 1000, 3)
+            if rl_lats else None,
+            "p99": round(_pct(rl_lats, 99) * 1000, 3)
+            if rl_lats else None,
+        },
+    }
 
 
 def main():
@@ -192,6 +238,17 @@ def main():
     ap.add_argument("--servers", type=int, default=3,
                     help="cluster size for --cluster (scaling sweeps "
                          "merge rows across runs via --out)")
+    ap.add_argument("--rate-limit", default=None,
+                    help="arm every --cluster server's ingress "
+                         "limiter with this spec (server_proc "
+                         "--rate-limit syntax, e.g. "
+                         "'mode=enforcing,write_rate=500,"
+                         "write_burst=500') and add the saturation "
+                         "columns: shed ratio, accepted req/s, and "
+                         "the 429-path client latency — the bench "
+                         "drives the same offered load, so an "
+                         "enforcing write_rate below the unlimited "
+                         "PUT row IS the saturation point")
     ap.add_argument("--stale", action="store_true",
                     help="add the ?stale read phases: pure stale "
                          "follower-fanout (GETs spread over every "
@@ -223,37 +280,65 @@ def main():
         # broken barrier, queue timeout) must never leak three server
         # processes holding their ports
         n = args.servers
+        rl_spec = args.rate_limit
+        rl_stamp = None
+        if rl_spec:
+            mode = next((kv.split("=", 1)[1] for kv in
+                         rl_spec.split(",") if
+                         kv.startswith("mode=")), "enforcing")
+            rl_stamp = {"mode": mode, "spec": rl_spec}
         procs = []
         try:
-            addresses, procs = start_cluster_procs(n)
-            rps, dt, put_amb, put_rl = drive(addresses[:1],
-                                             args.n_ops,
-                                             args.conns, "PUT",
-                                             body=value)
-            emit({
+            addresses, procs = start_cluster_procs(
+                n, rate_limit=rl_spec)
+            # the offered-op count drive() actually sends (its
+            # integer split across connections), not the requested
+            # --n-ops — the shed ratio must divide by reality
+            total_ops = max(1, args.n_ops // args.conns) * args.conns
+            rps, dt, put_amb, put_rl, put_429 = drive(
+                addresses[:1], args.n_ops, args.conns, "PUT",
+                body=value)
+            row = {
                 "metric": f"kv_put_rps_cluster{n}",
                 "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": put_amb,
                 "rate_limited": put_rl,
                 "read": {"servers": n},
-                "vs_baseline": round(rps / baselines["kv_put"], 2)})
+                "vs_baseline": round(rps / baselines["kv_put"], 2)}
+            if rl_stamp:
+                row["metric"] = f"kv_put_rps_cluster{n}_ratelimited"
+                row["ratelimit"] = rl_stamp
+                row["shed"] = _shed_cols(total_ops, put_rl, put_429,
+                                         dt)
+                if put_rl == 0:
+                    raise RuntimeError(
+                        "rate-limit axis: the enforcing limiter shed "
+                        "ZERO writes — offered load never reached "
+                        "saturation; lower write_rate or raise "
+                        "--n-ops so the shed columns measure "
+                        "something")
+            emit(row)
             time.sleep(1.0)   # let replication land on followers
             # default-consistency GETs round-robined over every
             # server: a follower hop leader-forwards (the read plane's
             # default mode — every read verified by the leader), so
             # this is the FLAT baseline the stale fanout must beat
-            rps, dt, get_amb, get_rl = drive(addresses, args.n_ops,
-                                             args.conns, "GET")
+            rps, dt, get_amb, get_rl, get_429 = drive(
+                addresses, args.n_ops, args.conns, "GET")
             # a GET-phase 404 is tolerable ONLY as the shadow of a
             # PUT-phase timeout (the op that never learned its
-            # outcome); more holes than ambiguous PUTs is data LOSS
-            if get_amb > put_amb:
+            # outcome) — or, on the rate-limit axis, of a shed PUT
+            # (a 429'd write is a DEFINITE non-write, so its key slot
+            # may legitimately be a hole); more holes than that is
+            # data LOSS
+            if get_amb > put_amb + (put_rl if rl_stamp else 0):
                 raise RuntimeError(
                     f"bench: {get_amb} GET 404/timeout holes but only "
-                    f"{put_amb} ambiguous PUTs — acked writes went "
-                    f"missing")
-            emit({
+                    f"{put_amb} ambiguous + "
+                    f"{put_rl if rl_stamp else 0} shed PUTs — acked "
+                    f"writes went missing")
+            row = {
                 "metric": f"kv_get_rps_lb{n}", "value": round(rps, 1),
                 "unit": "req/s", "wall_s": round(dt, 2),
                 "cores": cores, "ambiguous": get_amb,
@@ -261,16 +346,22 @@ def main():
                 "read": {"mode": "default", "servers": n,
                          "fanout": True},
                 "vs_baseline": round(rps / baselines["kv_get_lb3"],
-                                     2)})
+                                     2)}
+            if rl_stamp:
+                row["metric"] += "_ratelimited"
+                row["ratelimit"] = rl_stamp
+                row["shed"] = _shed_cols(total_ops, get_rl, get_429,
+                                         dt)
+            emit(row)
             if args.stale:
                 # pure stale follower fanout: every server answers
                 # GETs from its own replica — the read-scaling mode
                 # (the reference's 16,068.8 req/s LB row was exactly
                 # this: stale reads behind an LB over 3 servers)
-                rps, dt, amb, rl = drive(addresses, args.n_ops,
-                                         args.conns, "GET",
-                                         stale_mix=1.0)
-                if amb > put_amb:
+                rps, dt, amb, rl, _ = drive(addresses, args.n_ops,
+                                            args.conns, "GET",
+                                            stale_mix=1.0)
+                if amb > put_amb + (put_rl if rl_stamp else 0):
                     raise RuntimeError(
                         f"bench: {amb} stale-GET holes but only "
                         f"{put_amb} ambiguous PUTs — acked writes "
@@ -288,9 +379,9 @@ def main():
                 # 90/10 stale/default mix: the production read shape
                 # (most traffic tolerates bounded staleness, a tail
                 # needs leader verification)
-                rps, dt, amb, rl = drive(addresses, args.n_ops,
-                                         args.conns, "GET",
-                                         stale_mix=0.9)
+                rps, dt, amb, rl, _ = drive(addresses, args.n_ops,
+                                            args.conns, "GET",
+                                            stale_mix=0.9)
                 emit({
                     "metric": f"kv_get_rps_lb{n}_mixed",
                     "value": round(rps, 1),
@@ -315,15 +406,15 @@ def main():
     # pacer would just burn the GIL the HTTP handlers need
     agent.start(tick_seconds=0.2, reconcile_interval=1.0)
     try:
-        rps, dt, amb, rl = drive(agent.http_address, args.n_ops,
-                                 args.conns, "PUT", body=value)
+        rps, dt, amb, rl, _ = drive(agent.http_address, args.n_ops,
+                                    args.conns, "PUT", body=value)
         emit({
             "metric": "kv_put_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
             "cores": cores, "ambiguous": amb, "rate_limited": rl,
             "vs_baseline": round(rps / baselines["kv_put"], 2)})
-        rps, dt, amb, rl = drive(agent.http_address, args.n_ops,
-                                 args.conns, "GET")
+        rps, dt, amb, rl, _ = drive(agent.http_address, args.n_ops,
+                                    args.conns, "GET")
         emit({
             "metric": "kv_get_rps", "value": round(rps, 1),
             "unit": "req/s", "wall_s": round(dt, 2),
@@ -388,10 +479,17 @@ def reap_procs(procs):
                 pass
 
 
-def start_cluster_procs(n=3, rpc_base=7101, http_base=7201):
+def start_cluster_procs(n=3, rpc_base=7101, http_base=7201,
+                        rate_limit=None):
     """Spawn one server PROCESS per member (tools/server_proc.py — the
     reference's one-agent-per-box shape) and wait for a leader.  Reaps
     whatever it spawned on ANY failure before re-raising.
+
+    `rate_limit` (server_proc --rate-limit spec) arms every member's
+    ingress limiter — the rate-limit bench axis (ISSUE 19): an
+    enforcing write_rate below the offered load turns the PUT phase
+    into a saturation measurement whose shed ratio and 429-path
+    latency the caller reads out of drive()'s columns.
 
     Every member gets the fleet HTTP map (--cluster-http): that arms
     the read plane's default-mode leader forwarding, so the bench's
@@ -408,11 +506,14 @@ def start_cluster_procs(n=3, rpc_base=7101, http_base=7201):
     addresses = []
     try:
         for i in range(n):
+            argv = [sys.executable, "tools/server_proc.py",
+                    "--node", f"server{i}", "--peers", peers,
+                    "--http-port", str(http_base + i),
+                    "--cluster-http", cluster_http]
+            if rate_limit:
+                argv += ["--rate-limit", rate_limit]
             procs.append(subprocess.Popen(
-                [sys.executable, "tools/server_proc.py",
-                 "--node", f"server{i}", "--peers", peers,
-                 "--http-port", str(http_base + i),
-                 "--cluster-http", cluster_http],
+                argv,
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
             addresses.append(f"http://127.0.0.1:{http_base + i}")
         # readiness: a write succeeds once a leader exists (followers

@@ -20,11 +20,28 @@ Usage:
   python tools/scale_sweep.py [Ns...]              # single-device across N
   python tools/scale_sweep.py --devices 8          # weak scaling 1..8 devs
       [--per-shard 8192] [--ticks 250] [--tolerance 0.25] [--out=PATH]
+  python tools/scale_sweep.py --dcs 8              # WAN DC-count axis
+      [--nodes-per-dc 128] [--ticks 250] [--tolerance 0.25] [--out=PATH]
 
---devices runs on simulated CPU devices when no multi-chip backend is
-attached (parallel/mesh.cpu_devices pins + restores the platform
-config); re-measure on chip when the tunnel returns.  Prints one JSON
-line per row; --out writes the full artifact (MULTICHIP_r06.json).
+--dcs is the federation axis (ROADMAP item 5 / ISSUE 19): DC counts
+2, 4, ..., D on `wan.make_wan_mesh` (dc x nodes — the multi-slice/DCN
+layout), each row firing a user event at a NON-server member of DC 0
+and counting gossip ticks until every DC's live members have it
+(LAN -> server -> WAN pool -> remote servers -> remote LANs).  The
+gate is the federation scaling claim: cross-DC dissemination cost
+grows ~log(DCs) — the largest row's convergence ticks must not
+exceed the smallest row's scaled by log(D_max)/log(D_min) (+
+tolerance), because the WAN pool is one serf gossip pool over D*S
+servers and gossip rounds-to-saturation grow logarithmically in pool
+size.  Rows carry the same topology stamp as BENCH_BASELINE rows
+({backend, devices, mesh_shape}) so bench_guard's topology refusal
+applies to them unchanged.
+
+--devices/--dcs run on simulated CPU devices when no multi-chip
+backend is attached (parallel/mesh.cpu_devices pins + restores the
+platform config); re-measure on chip when the tunnel returns.  Prints
+one JSON line per row; --out writes the full artifact
+(MULTICHIP_r06.json / WANSCALE_r01.json).
 """
 
 from __future__ import annotations
@@ -223,9 +240,131 @@ def weak_scaling(max_devices: int, per_shard: int, ticks: int,
     }
 
 
+def _dc_point(devs, d: int, nodes_per_dc: int, servers_per_dc: int,
+              ticks: int, chunk: int, event_id: int) -> dict:
+    """One federation row at `d` DCs on a dc x nodes wan mesh: fire a
+    user event at a NON-server member of DC 0, step in `chunk`-tick
+    compiled scans until every DC's live members are covered."""
+    from consul_tpu.models import wan
+    mesh = meshlib.make_wan_mesh(devs[:d], n_dcs=d)
+    params = wan.make_params(n_dcs=d, nodes_per_dc=nodes_per_dc,
+                             servers_per_dc=servers_per_dc,
+                             p_loss=0.01, seed=7)
+    state = wan.init_state(params)
+    sharding = meshlib.wan_state_sharding(state, mesh)
+    state = jax.device_put(state, sharding)
+    # out_shardings pins the carry's layout to the input spec: without
+    # it the compiler's chosen output shardings differ from the
+    # explicit input placement and the second call recompiles
+    fed_run = jax.jit(wan.run, static_argnums=(0, 2),
+                      out_shardings=sharding)
+    # warm in the SAME chunk shape the poll loop uses (one compiled
+    # program per topology), long enough for mutual membership before
+    # the event fires
+    for _ in range(6):
+        state = fed_run(params, state, chunk)
+    hard_sync(state)
+    # the event starts at a LAN-only member: it must cross LAN gossip
+    # -> a server -> the WAN pool -> remote servers -> remote LANs —
+    # the full federation path
+    state = wan.fire_event(params, state, 0, nodes_per_dc - 1,
+                           event_id)
+    # restore the warm-run sharding the eager fire_event update may
+    # have disturbed, so the poll loop reuses the one compiled program
+    state = jax.device_put(state, sharding)
+    conv_tick = -1
+    cov_min = 0.0
+    t0 = time.perf_counter()
+    elapsed = 0
+    while elapsed < ticks:
+        state = fed_run(params, state, chunk)
+        elapsed += chunk
+        cov = np.asarray(wan.event_coverage_by_dc(
+            params, state, event_id))
+        cov_min = float(cov.min())
+        if cov_min >= 0.99:
+            conv_tick = elapsed
+            break
+    wall = time.perf_counter() - t0
+    compiles = int(fed_run._cache_size()) \
+        if hasattr(fed_run, "_cache_size") else None
+    assert compiles in (None, 1), \
+        f"dc sweep compiled {compiles}x (expected exactly 1)"
+    return {"n_dcs": d, "nodes_per_dc": nodes_per_dc,
+            "servers_per_dc": servers_per_dc,
+            "wan_pool": d * servers_per_dc,
+            "convergence_ticks": conv_tick,
+            "converge_wall_s": round(wall, 3),
+            "coverage_min": round(cov_min, 4),
+            "compiles": compiles,
+            "topology": {"backend": jax.default_backend(),
+                         "devices": mesh.size,
+                         "mesh_shape": dict(mesh.shape)}}
+
+
+def dc_sweep(max_dcs: int, nodes_per_dc: int, ticks: int,
+             tolerance: float) -> dict:
+    """DC-count series d = 2, 4, ..., max_dcs on wan.make_wan_mesh:
+    one federation per row, event fired in DC 0 at a non-server
+    member, convergence = every DC's live members covered.  Judges
+    the ~log(DCs) WAN dissemination claim."""
+    servers_per_dc = 3
+    event_id = 7
+    chunk = 5                   # coverage-poll granularity (ticks)
+    series = []
+    d = 2
+    while d <= max_dcs:
+        series.append(d)
+        d *= 2
+    rows = []
+    with meshlib.cpu_devices(max(series)) as devs:
+        backend = jax.default_backend()
+        for d in series:
+            row = _dc_point(devs, d, nodes_per_dc, servers_per_dc,
+                            ticks, chunk, event_id)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    conv = [(r["n_dcs"], r["convergence_ticks"]) for r in rows
+            if r["convergence_ticks"] > 0]
+    log_ok = len(conv) == len(rows)
+    budget = None
+    if log_ok and len(conv) >= 2:
+        (d0, c0), (d1, c1) = conv[0], conv[-1]
+        log_ratio = math.log10(d1) / math.log10(d0)
+        budget = round(c0 * log_ratio * (1.0 + tolerance), 1)
+        log_ok = c1 <= budget
+    return {
+        "mode": "dc_scaling",
+        "backend": backend,
+        "dc_series": series,
+        "nodes_per_dc": nodes_per_dc,
+        "servers_per_dc": servers_per_dc,
+        "ticks_budget": ticks,
+        "rows": rows,
+        "tolerance": tolerance,
+        "log_budget_ticks": budget,
+        "wan_cost_log_dcs": log_ok,
+        "ok": log_ok,
+        "note": "WAN gossip cost ~log(DCs): event fired at a "
+                "non-server member of DC 0, convergence = >=99% of "
+                "every DC's live members delivered; the largest "
+                "federation's tick count must fit the smallest's "
+                "scaled by log(D)/log(d) (+tolerance) because the "
+                "WAN pool is one serf gossip pool over D*S servers. "
+                "dc axis = multi-slice/DCN analogue, nodes axis = "
+                "intra-slice ICI (parallel/mesh.make_wan_mesh). "
+                "Simulated CPU devices share host cores: wall-clock "
+                "is smoke-level; the TICK counts are the scaling "
+                "signal.  Topology-stamped per row like "
+                "BENCH_BASELINE.",
+    }
+
+
 def main():
     ns = []
     devices = None
+    dcs = None
+    nodes_per_dc = 128
     per_shard = 8192
     ticks = 250
     tolerance = 0.25
@@ -240,6 +379,14 @@ def main():
             devices = int(argv[i + 1]); i += 1
         elif a.startswith("--devices="):
             devices = int(a.split("=", 1)[1])
+        elif a == "--dcs":
+            dcs = int(argv[i + 1]); i += 1
+        elif a.startswith("--dcs="):
+            dcs = int(a.split("=", 1)[1])
+        elif a == "--nodes-per-dc":
+            nodes_per_dc = int(argv[i + 1]); i += 1
+        elif a.startswith("--nodes-per-dc="):
+            nodes_per_dc = int(a.split("=", 1)[1])
         elif a == "--per-shard":
             per_shard = int(argv[i + 1]); i += 1
         elif a.startswith("--per-shard="):
@@ -260,6 +407,22 @@ def main():
             print(f"unknown flag {a}", file=sys.stderr)
             return 2
         i += 1
+
+    if dcs is not None:
+        report = dc_sweep(dcs, nodes_per_dc, ticks, tolerance)
+        print(json.dumps({k: v for k, v in report.items()
+                          if k != "rows"}), flush=True)
+        if out_path:
+            with open(out_path, "w") as f:
+                json.dump(report, f, indent=1)
+                f.write("\n")
+        if not report["ok"]:
+            print(f"dc scaling FAILED: wan_cost_log_dcs="
+                  f"{report['wan_cost_log_dcs']} (budget "
+                  f"{report['log_budget_ticks']} ticks)",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     if devices is not None:
         report = weak_scaling(devices, per_shard, ticks, tolerance)

@@ -71,34 +71,57 @@ def _put_json(url: str, payload: dict, tid: str = "") -> None:
     urllib.request.urlopen(req, timeout=30.0).read()
 
 
-def _watcher(client, pid: str, start_version: int, stop, state, lock):
-    """One parked xDS long-poll per proxy: observes version advance."""
+def _watcher(client, pid: str, start_version: int, stop, state, lock,
+             delta: bool = False):
+    """One parked xDS long-poll per proxy: observes version advance.
+    With `delta` the poll runs in incremental mode (ISSUE 19) and the
+    per-proxy state splits delta vs full responses — the wire-cost
+    evidence the fan-out sweep reports."""
     from consul_tpu.api.client import ApiError
     cur = start_version
+    extra = "&delta=1" if delta else ""
     while not stop.is_set():
         try:
             out = client._call(
-                "GET", f"/v1/agent/xds/{pid}?version={cur}&wait=5s")[0]
-        except (ApiError, OSError):
+                "GET", f"/v1/agent/xds/{pid}?version={cur}"
+                       f"&wait=5s{extra}")[0]
+        except (ApiError, OSError) as e:
             if stop.is_set():
                 return
+            if getattr(e, "code", None) == 410:
+                return        # terminal: the proxy deregistered
             time.sleep(0.05)
             continue
         now = time.time()
         v = int(out.get("VersionInfo", cur))
         if v > cur:
             cur = v
-            res = out.get("Resources") or {}
+            d = out.get("Delta")
+            if d is not None:
+                res = d.get("Changed") or {}
+                mode = "delta"
+            else:
+                res = out.get("Resources") or {}
+                mode = "full"
             with lock:
                 st = state[pid]
                 st["version"] = v
                 st["ts"] = now
                 st["resources"] += sum(len(r) for r in res.values())
+                st[mode] = st.get(mode, 0) + 1
 
 
-def _counter(dump: dict, name: str) -> float:
-    return sum(c["Count"] for c in (dump or {}).get("Counters", [])
-               if c["Name"] == name)
+def _counter(dump: dict, name: str, **labels) -> float:
+    """Sum a counter family, optionally filtered to a label subset
+    (e.g. mode="delta" — the ISSUE 19 delta/full accounting)."""
+    out = 0.0
+    for c in (dump or {}).get("Counters", []):
+        if c["Name"] != name:
+            continue
+        have = c.get("Labels") or {}
+        if all(have.get(k) == v for k, v in labels.items()):
+            out += c["Count"]
+    return out
 
 
 def run_point(n_proxies: int, routes: int, flips: int, pace_s: float,
@@ -246,6 +269,166 @@ def run_point(n_proxies: int, routes: int, flips: int, pace_s: float,
         cluster.stop()
 
 
+def run_fanout_point(n_proxies: int, shapes: int, routes: int,
+                     changes: int, pace_s: float, data_root: str,
+                     cluster_n: int = 3, seed: int = 0) -> dict:
+    """One high-fan-out sweep point (ISSUE 19 tentpole d): N proxies
+    collapsed onto S shared shapes, delta-mode watchers parked on all
+    of them, a churn window of intention flips (touch every shape) and
+    endpoint churn on shape 0's route (touch exactly one subset).  The
+    claim under test: rebuilds/change tracks DISTINCT SHAPES while
+    deliveries/change tracks subscribers — the shared-snapshot
+    refactor's whole point."""
+    from consul_tpu.api.client import Client
+    from consul_tpu.chaos_live import LiveCluster
+    from consul_tpu.trace import new_trace_id
+
+    cluster = LiveCluster(cluster_n, data_root=data_root, grpc=False)
+    stop = threading.Event()
+    threads = []
+    try:
+        cluster.start()
+        li = cluster.leader()
+        leader = cluster.servers[li]
+        cl = Client(leader.http, timeout=10.0)
+        for j in range(routes):
+            _put_json(leader.http + "/v1/agent/service/register",
+                      {"Name": f"route-{j}", "ID": f"route-{j}",
+                       "Port": 7000 + j})
+        # N proxies across S shapes: every proxy of shape s watches
+        # route-(s % routes) with the SAME upstream block (the bind
+        # port is part of the shape hash — only per-proxy top-level
+        # fields differ), so the manager must collapse them to S
+        # materializations
+        pids, shape_of = [], {}
+        for i in range(n_proxies):
+            s = i % shapes
+            pid = f"fan{s}-{i}-sidecar-proxy"
+            _put_json(
+                leader.http + "/v1/agent/service/register",
+                {"Name": f"fan{s}-sidecar-proxy", "ID": pid,
+                 "Kind": "connect-proxy", "Port": 21000 + i,
+                 "Proxy": {
+                     "DestinationServiceName": f"fan{s}",
+                     "Upstreams": [
+                         {"DestinationName": f"route-{s % routes}",
+                          "LocalBindPort": 9100 + s}]}})
+            pids.append(pid)
+            shape_of[pid] = s
+        state = {}
+        lock = threading.Lock()
+        for pid in pids:
+            out = cl._call("GET", f"/v1/agent/xds/{pid}")[0]
+            v = int(out["VersionInfo"])
+            state[pid] = {"version": v, "ts": time.time(),
+                          "resources": 0, "delta": 0, "full": 0}
+            t = threading.Thread(
+                target=_watcher,
+                args=(Client(leader.http, timeout=10.0), pid, v, stop,
+                      state, lock), kwargs={"delta": True},
+                name=f"xds-f-{pid}", daemon=True)
+            threads.append(t)
+            t.start()
+        time.sleep(0.6)
+        # distinct-shape proof straight off the manager's registry
+        reg = cl._call("GET",
+                       "/v1/internal/ui/xds?local=1")[0]["shapes"]
+        dump0 = cl._call("GET", "/v1/agent/metrics")[0]
+        lat_ms = []
+        stale = 0
+        t_start = time.time()
+        shape0 = [p for p in pids if shape_of[p] == 0]
+        for i in range(changes):
+            with lock:
+                baseline = {p: state[p]["version"] for p in pids}
+            tid = new_trace_id()
+            kind = i % 3
+            if kind == 0:
+                # topic-wide: every shape rebuilds, every proxy hears
+                _put_json(leader.http + "/v1/connect/intentions",
+                          {"SourceName": f"src{seed}-{i}",
+                           "DestinationName": "fan0",
+                           "Action": "deny" if i % 2 else "allow"},
+                          tid=tid)
+                affected = list(pids)
+            elif kind == 1:
+                # per-subset: only shape 0 watches route-0 — nobody
+                # else's version may move (the delta scoping claim)
+                _put_json(leader.http
+                          + "/v1/agent/service/deregister/route-0",
+                          {}, tid=tid)
+                affected = shape0
+            else:
+                _put_json(leader.http + "/v1/agent/service/register",
+                          {"Name": "route-0", "ID": "route-0",
+                           "Port": 7000 + 100 + i}, tid=tid)
+                affected = shape0
+            put_ts = time.time()
+            deadline = put_ts + 20.0
+            waiting = set(affected)
+            while waiting and time.time() < deadline:
+                with lock:
+                    for pid in list(waiting):
+                        if state[pid]["version"] > baseline[pid]:
+                            lat_ms.append(
+                                (state[pid]["ts"] - put_ts) * 1000.0)
+                            waiting.discard(pid)
+                if waiting:
+                    time.sleep(0.002)
+            stale += len(waiting)
+            time.sleep(pace_s)
+        elapsed = time.time() - t_start
+        stop.set()
+        dump1 = cl._call("GET", "/v1/agent/metrics")[0]
+        rebuilds = (_counter(dump1, "consul.xds.rebuilds")
+                    - _counter(dump0, "consul.xds.rebuilds"))
+        with lock:
+            delivered = len(lat_ms)
+            n_delta = sum(st.get("delta", 0)
+                          for st in state.values())
+            n_full = sum(st.get("full", 0) for st in state.values())
+        return {
+            "proxies": n_proxies, "shapes": shapes, "routes": routes,
+            "changes": changes, "deliveries": delivered,
+            "stale": stale,
+            "distinct_shapes": reg.get("shapes", 0),
+            "pinned": reg.get("pinned", 0),
+            "rebuilds": rebuilds,
+            "rebuilds_per_change": round(rebuilds / changes, 3),
+            "deliveries_per_change": round(delivered / changes, 3),
+            "client_mode": {"delta": n_delta, "full": n_full},
+            "push_counters": {
+                "delta": _counter(dump1, "consul.xds.pushes",
+                                  mode="delta")
+                - _counter(dump0, "consul.xds.pushes", mode="delta"),
+                "full": _counter(dump1, "consul.xds.pushes",
+                                 mode="full")
+                - _counter(dump0, "consul.xds.pushes", mode="full")},
+            "resource_counters": {
+                "delta": _counter(dump1, "consul.xds.resources",
+                                  mode="delta")
+                - _counter(dump0, "consul.xds.resources",
+                           mode="delta"),
+                "full": _counter(dump1, "consul.xds.resources",
+                                 mode="full")
+                - _counter(dump0, "consul.xds.resources",
+                           mode="full")},
+            "visibility_ms": {
+                "p50": round(pctl(lat_ms, 0.5), 3),
+                "p99": round(pctl(lat_ms, 0.99), 3),
+                "max": round(max(lat_ms), 3) if lat_ms else 0.0},
+            "elapsed_s": round(elapsed, 3),
+            "xds": {"proxies": n_proxies, "routes": routes,
+                    "cluster": cluster_n, "shapes": shapes},
+            "topology": topology_stamp(),
+        }
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=3.0)
+        cluster.stop()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--proxies", type=int, nargs="+", default=[1, 4, 8])
@@ -261,6 +444,16 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="bounded smoke: one tiny point, shape "
                          "asserts, no artifact unless --out")
+    ap.add_argument("--fanout", action="store_true",
+                    help="high-fan-out mode (ISSUE 19): N proxies "
+                         "over few shared shapes, delta watchers; "
+                         "proves rebuilds scale with shapes")
+    ap.add_argument("--fanout-proxies", type=int, nargs="+",
+                    default=[8, 64, 256],
+                    help="fan-out sweep sizes (10000 on the "
+                         "multi-core box)")
+    ap.add_argument("--shapes", type=int, default=8,
+                    help="distinct proxy shapes in --fanout mode")
     args = ap.parse_args(argv)
     if args.check:
         args.proxies, args.routes = [2], [2]
@@ -268,6 +461,56 @@ def main(argv=None) -> int:
 
     import tempfile
     rows = []
+    if args.fanout:
+        for n in args.fanout_proxies:
+            shapes = min(args.shapes, n)
+            with tempfile.TemporaryDirectory(
+                    prefix=f"xdsfan-{n}x{shapes}-") as tmp:
+                row = run_fanout_point(
+                    n, shapes, routes=4, changes=args.flips,
+                    pace_s=args.pace, data_root=tmp,
+                    cluster_n=args.cluster_n, seed=n)
+            rows.append(row)
+            print(json.dumps(row))
+        artifact = {
+            "metric": "xds_fanout",
+            "rows": rows,
+            "cores": os.cpu_count() or 1,
+            "topology": topology_stamp(),
+            "analysis": (
+                "High-fan-out mesh control plane (ISSUE 19): N "
+                "sidecar proxies collapsed onto <=8 shared shapes "
+                "((kind, service, config-hash) single-flight "
+                "materializations), delta-mode watchers parked on "
+                "every proxy, churn = topic-wide intention flips + "
+                "endpoint churn scoped to shape 0's route subset.  "
+                "rebuilds_per_change stays at the distinct-shape "
+                "count while deliveries_per_change grows with "
+                "subscribers — materialization cost scales with "
+                "SHAPES, wire fan-out with proxies, and the "
+                "delta/full counter split shows per-subset deltas "
+                "carrying the steady state.  The 10k-proxy point "
+                "runs on the multi-core box via --fanout-proxies "
+                "10000."),
+        }
+        ok = True
+        if len(rows) >= 2:
+            # the acceptance gate: rebuilds/change at the biggest
+            # point within 2x of the smallest, deliveries/change
+            # scaling with subscribers
+            r0, rN = rows[0], rows[-1]
+            ok = (rN["rebuilds_per_change"]
+                  <= 2.0 * max(r0["rebuilds_per_change"], 1.0)
+                  and rN["deliveries_per_change"]
+                  > r0["deliveries_per_change"]
+                  and all(r["stale"] == 0 for r in rows))
+            print(json.dumps({"check": "xds_bench_fanout", "ok": ok}))
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(artifact, f, indent=2)
+                f.write("\n")
+            print(f"wrote {args.out}")
+        return 0 if ok else 1
     for n in args.proxies:
         for r in args.routes:
             with tempfile.TemporaryDirectory(
