@@ -122,9 +122,10 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
     # recompile hygiene: the timed loop must have reused the ONE
     # compilation the warm call produced — a second cache entry means
     # something perturbed the static config mid-bench and the timed
-    # window silently included an XLA compile
-    compiles = int(run._cache_size()) if hasattr(run, "_cache_size") \
-        else None
+    # window silently included an XLA compile (main() gates via
+    # hlo_audit.assert_single_compile — the framework implementation)
+    from consul_tpu.parallel import hlo_audit
+    compiles = hlo_audit.cache_size(run)
     if mesh is not None:
         from consul_tpu.parallel import mesh as meshlib
         meshlib.assert_node_sharded(s.swim.know, mesh.size,
@@ -161,8 +162,8 @@ def run_convergence(n_nodes: int = N, chunk: int = CHUNK,
 def main():
     enable_compilation_cache()
     r = run_convergence()
-    assert r["compiles"] in (None, 1), \
-        f"bench expected exactly 1 compilation of run, saw {r['compiles']}"
+    from consul_tpu.parallel import hlo_audit
+    hlo_audit.assert_single_compile(r["compiles"], "bench serf.run")
     # device-side sim counters (swim.METRIC_NAMES): accumulated inside
     # the jitted tick, fetched HERE — one readback AFTER the timed
     # window, so telemetry costs the bench nothing

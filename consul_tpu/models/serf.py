@@ -123,13 +123,25 @@ def rtt_order(params: SerfParams, s: ClusterState, origin: jnp.ndarray,
     lib/rtt.go:13-43 semantics): distances from `origin` to `ids`
     ([K] int32, `valid` masks padding), invalid rows sort last.
     Returns the [K] argsort — the only transfer is O(K) indices, never
-    the [N, D] coordinate tensor."""
+    the [N, D] coordinate tensor.
+
+    The origin row is extracted by one-hot mask + sum and distances
+    are computed for EVERY node before the [K] index step: row-indexing
+    the sharded [N, D] coordinate tensor (`coords[ids]`) all-gathers it
+    under GSPMD (hlo_lint gather-freedom finding, ISSUE 20), while the
+    masked reduction lowers to local selects plus an all-reduce of [D]
+    partials and the full-N distance field stays elementwise-sharded.
+    Same arithmetic per node, so results are bit-identical."""
     c = s.coords
-    diff = c.coords[ids] - c.coords[origin]
-    d = jnp.linalg.norm(diff, axis=-1) + c.height[ids] + c.height[origin]
-    adjusted = d + c.adjustment[ids] + c.adjustment[origin]
-    dist = jnp.where(adjusted > 0.0, adjusted, d)
-    dist = jnp.where(valid, dist, jnp.inf)
+    n = c.coords.shape[0]
+    at_origin = jnp.arange(n, dtype=jnp.int32) == origin
+    ovec = jnp.sum(jnp.where(at_origin[:, None], c.coords, 0.0), axis=0)
+    oh = jnp.sum(jnp.where(at_origin, c.height, 0.0))
+    oadj = jnp.sum(jnp.where(at_origin, c.adjustment, 0.0))
+    d_all = jnp.linalg.norm(c.coords - ovec, axis=-1) + c.height + oh
+    adjusted = d_all + c.adjustment + oadj
+    dist_all = jnp.where(adjusted > 0.0, adjusted, d_all)
+    dist = jnp.where(valid, dist_all[ids], jnp.inf)
     return jnp.argsort(dist, stable=True)
 
 

@@ -339,7 +339,12 @@ def init_state(params: SwimParams, key=None,
     return SwimState(
         tick=jnp.int32(0),
         up=present,
-        member=present,
+        # DISTINCT buffer from `up`: a donated first call (bench scan,
+        # chaos build) flattens the state pytree into executable args,
+        # and XLA rejects donating the same buffer twice — aliased
+        # leaves made init_state's output donation-unsafe on every
+        # backend that honors donation (hlo_lint finding, ISSUE 20)
+        member=present.copy(),
         incarnation=jnp.zeros((n,), jnp.int32),
         coords=coords,
         committed_dead=jnp.zeros((n,), bool),
@@ -1528,10 +1533,28 @@ def membership_delta(params: SwimParams, s: SwimState,
     The incremental device→control-plane seam (ROADMAP item 5): a pool
     with F flaps since the checkpoint moves min(F, k) rows to host, not
     a full gather — callers re-checkpoint with the returned vector and
-    fall back to paged listing when n_changed > k."""
+    fall back to paged listing when n_changed > k.
+
+    The first-k changed indices come from _top_k_sharded over the
+    binary changed mask, NOT `jnp.where(..., size=k)`: the where/
+    nonzero lowering all-gathers the full [N] mask under a node-sharded
+    mesh (hlo_lint gather-freedom finding, ISSUE 20), while per-block
+    top-k stays local.  Equal scores break ties toward the earlier
+    global index, so the k ones selected are exactly where's ascending
+    first-k.  (When k exceeds N/shard_blocks the helper falls back to
+    flat top_k — a near-full listing is O(N) transfer by request.)"""
     st = status_vector(params, s)
     changed = (st != prev_status) & provisioned
-    idx = jnp.where(changed, size=k, fill_value=-1)[0].astype(jnp.int32)
+    n = changed.shape[0]
+    # top_k caps k at N where the old where(size=k) padded past it; a
+    # k > N request still returns [k] rows, tail forced to the pad
+    kk = min(k, n)
+    vals, idx = _top_k_sharded(changed.astype(jnp.int32), kk,
+                               params.shard_blocks)
+    idx = jnp.where(vals > 0, idx, jnp.int32(-1))
+    if kk < k:
+        idx = jnp.concatenate(
+            [idx, jnp.full((k - kk,), -1, jnp.int32)])
     return st, jnp.sum(changed).astype(jnp.int32), idx, \
         st[jnp.maximum(idx, 0)]
 

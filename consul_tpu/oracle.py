@@ -52,6 +52,23 @@ def _bucket(k: int, n: int) -> int:
     return b
 
 
+def _coord_row(c, i):
+    """One node's Vivaldi row from (possibly node-sharded) coordinate
+    state, gather-free: row-indexing the sharded [N, D] tensor
+    (`c.coords[i]`) all-gathers it under GSPMD (hlo_lint
+    gather-freedom finding, ISSUE 20); the one-hot mask + sum lowers
+    to local selects plus an all-reduce of [D] partials instead, and
+    is exact (one row survives the mask)."""
+    n = c.coords.shape[0]
+    at = jnp.arange(n, dtype=jnp.int32) == i
+    vec = jnp.sum(jnp.where(at[:, None], c.coords, 0.0), axis=0)
+
+    def pick(x):
+        return jnp.sum(jnp.where(at, x, 0.0))
+
+    return vec, pick(c.error), pick(c.adjustment), pick(c.height)
+
+
 class GossipOracle:
     def __init__(self, gossip: Optional[GossipConfig] = None,
                  sim: Optional[SimConfig] = None,
@@ -99,9 +116,7 @@ class GossipOracle:
         self._delta_fn = jax.jit(serf.membership_delta,
                                  static_argnums=(0, 4))
         self._rtt_order_fn = jax.jit(serf.rtt_order, static_argnums=0)
-        self._coord_row_fn = jax.jit(
-            lambda c, i: (c.coords[i], c.error[i], c.adjustment[i],
-                          c.height[i]))
+        self._coord_row_fn = jax.jit(_coord_row)
         self._node_prefix = node_prefix
         self._names: Dict[int, str] = {
             i: f"{node_prefix}{i}" for i in range(self.sim.n_nodes)}

@@ -1,0 +1,569 @@
+"""Compiled-program contracts: the HLO audit framework (ISSUE 20).
+
+PR 6 proved the sharded lowering gather-free and log2-collective by
+hand, then left those proofs scattered as ad-hoc audit blocks in
+bench.py, tools/profile_swim.py, tools/scale_sweep.py and
+tests/test_sharding.py.  This module is the ONE implementation of each
+of those rules, plus a registry of every production jit entry point so
+a new entry (the DNS front, a fused scan) cannot silently regress to
+an all-gather with nothing failing until a chip run.
+
+Rules (each falsifiability-tested in tests/test_hlo_lint.py):
+
+  * gather-freedom   — zero node-axis all-gathers in the compiled
+                       module (`meshlib.full_gather_ops`, promoted
+                       from the PR 6 audit blocks);
+  * collective census — per-family instruction counts within the
+                       committed budget, no family the budget never
+                       recorded (an unexpected all-reduce is a lowering
+                       regression even when gather-freedom holds);
+  * donation honored — `donate_argnums` must show up as
+                       `input_output_alias` entries in the compiled
+                       executable, not just be requested (the
+                       silent-copy failure mode: XLA warns once and
+                       double-buffers every [N]-shaped carry);
+  * dtype-width ledger — bytes per node slot across the state pytree
+                       must not widen past the committed number (the
+                       PR 2 narrowing, now checked on the program's
+                       actual avals rather than source text);
+  * flops / peak-bytes budget — XLA's own cost model within
+                       ±tolerance of the committed baseline,
+                       topology-stamped like BENCH_BASELINE with the
+                       same refuse-to-judge on topology mismatch;
+  * compile-count    — each entry compiles exactly once per topology
+                       (two dispatch-cache entries mean something
+                       perturbed the static config mid-run);
+  * permute scaling  — ring traffic lowers to log2(devices) static
+                       collective-permutes per rotation (ops/rolls.py),
+                       so permutes/log2(d) must stay flat across
+                       topologies: an O(devices) regression is visible
+                       even below the hard gather-freedom assert.
+
+The registry measurement side (`measure_entry`) compiles on simulated
+CPU devices (`meshlib.cpu_devices`); the judge (`judge_record` /
+`judge_scaling`) is pure dicts-in/dicts-out so tests can fabricate
+records the way tests/test_bench_guard.py fabricates bench rows.
+Manifest file I/O and the tree-wide jit-site scan live in
+tools/hlo_lint.py — this module never touches the filesystem.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from consul_tpu.config import GossipConfig, SimConfig
+from consul_tpu.models import serf, swim
+from consul_tpu.parallel import mesh as meshlib
+from consul_tpu.utils import donation
+from consul_tpu.utils.sync import backend_honors_donation
+
+# ---------------------------------------------------------------- rules
+# (promoted single implementations — every former ad-hoc audit block is
+# a shim over these)
+
+COLLECTIVE_FAMILIES = ("collective-permute", "all-gather", "all-reduce",
+                       "all-to-all")
+
+
+def collective_census(hlo_text: str) -> Dict[str, int]:
+    """Instruction census of the cross-shard traffic GSPMD inserted:
+    collective-permutes ARE the ring rumor/probe exchange
+    (ops/rolls.py decomposition); all-gathers should only ever touch
+    replicated [U]-sized tables (full_gather_ops proves it).  Promoted
+    from tools/profile_swim.py count_collectives."""
+    out = {}
+    for op in COLLECTIVE_FAMILIES:
+        c = hlo_text.count(f" {op}(") + hlo_text.count(f" {op}-start(")
+        if c:
+            out[op] = c
+    return out
+
+
+def alias_entries(hlo_text: str) -> int:
+    """Number of input→output alias pairs the compiled module header
+    declares, e.g. ``input_output_alias={ {0}: (1, {0}, may-alias) }``.
+    This is the donation EVIDENCE: `donate_argnums` that XLA could not
+    honor simply produces zero entries (plus a once-per-process
+    warning nobody reads) and silently double-buffers the carry."""
+    marker = "input_output_alias={"
+    start = hlo_text.find(marker)
+    if start < 0:
+        return 0
+    # the alias map nests braces ({output index}: (param, {param
+    # index}, kind)), so walk to the matching close instead of a regex
+    i = start + len(marker) - 1
+    depth = 0
+    for j in range(i, min(len(hlo_text), i + 1_000_000)):
+        ch = hlo_text[j]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                block = hlo_text[i:j + 1]
+                return block.count("(")
+    return 0
+
+
+def audit_compiled(compiled_or_text, n_nodes: int, name: str) -> dict:
+    """THE gather-freedom + census audit every former ad-hoc block now
+    calls: asserts zero all-gathers materializing a node-axis buffer
+    (meshlib.full_gather_ops) and returns the collective census.
+    Raises AssertionError naming `name` on violation."""
+    hlo = compiled_or_text if isinstance(compiled_or_text, str) \
+        else compiled_or_text.as_text()
+    bad = meshlib.full_gather_ops(hlo, n_nodes)
+    assert not bad, (
+        f"{name}: {len(bad)} all-gather(s) of full node-axis buffers "
+        f"— first: {bad[0][:200]}")
+    return {"collectives": collective_census(hlo),
+            "full_node_gathers": 0}
+
+
+def compiled_stats(compiled) -> dict:
+    """XLA's own cost/memory analysis of one compiled executable:
+    flops, HBM bytes touched, argument/output/temp sizes and the
+    peak-buffer proxy (output+temp) the budget rule judges.  Promoted
+    from tools/profile_swim.py compile_with_stats."""
+    out: Dict[str, Any] = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        ca = ca or {}
+        for k_out, k_in in (("flops", "flops"),
+                            ("bytes_accessed", "bytes accessed")):
+            v = ca.get(k_in)
+            if v is not None:
+                out[k_out] = float(v)
+    except Exception:       # pragma: no cover - backend-specific
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for k_out, k_in in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("temp_bytes", "temp_size_in_bytes")):
+            v = getattr(ma, k_in, None)
+            if v is not None:
+                out[k_out] = int(v)
+    except Exception:       # pragma: no cover - backend-specific
+        pass
+    if "output_bytes" in out and "temp_bytes" in out:
+        out["peak_bytes"] = out["output_bytes"] + out["temp_bytes"]
+    return out
+
+
+def cache_size(jfn) -> Optional[int]:
+    """Dispatch-cache entry count of a jitted callable (None when this
+    jax build hides it) — the compile-count ledger's raw number."""
+    return int(jfn._cache_size()) if hasattr(jfn, "_cache_size") else None
+
+
+def assert_single_compile(jfn_or_count, name: str) -> Optional[int]:
+    """The recompile-hygiene audit bench/scale_sweep shim over: the
+    dispatch cache must hold exactly ONE entry (a second means the
+    static config was perturbed mid-run and a timed window silently
+    included an XLA compile).  Accepts a jitted callable or an
+    already-read count; returns the count."""
+    c = jfn_or_count if (jfn_or_count is None
+                         or isinstance(jfn_or_count, int)) \
+        else cache_size(jfn_or_count)
+    assert c in (None, 1), f"{name}: compiled {c}x (expected exactly 1)"
+    return c
+
+
+def bytes_per_slot(state, slots: int) -> int:
+    """Dtype-width ledger: total bytes of every node-axis leaf in the
+    state pytree, per node slot.  A widened store (int8 → int32 on a
+    [N, U] buffer) moves this number and nothing else — the aval-level
+    complement of the dtype-discipline source lint."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(state):
+        shape = getattr(leaf, "shape", ())
+        if slots in shape:
+            total += int(leaf.nbytes) // slots
+    return total
+
+
+# ------------------------------------------------------------- registry
+
+@dataclasses.dataclass
+class Program:
+    """One buildable jit entry point at one topology: the jitted
+    callable, its example args, and the expectations the rules check.
+    `rebind` maps (args, first-call output) to the second call's args —
+    required when `donate` consumes the carry."""
+    jfn: Any
+    args: tuple
+    n_nodes: int                 # node-axis extent for gather-freedom
+    state: Any                   # pytree the dtype ledger sums over
+    slots: int                   # node-slot divisor for the ledger
+    mesh_shape: Optional[dict] = None
+    donate: bool = False
+    rebind: Optional[Callable[[tuple, Any], tuple]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class EntrySpec:
+    """A registered production jit entry point: how to build its
+    Program per topology, which device counts it must hold its
+    contracts on, and which `jax.jit` call sites in the tree it
+    covers for the registry-parity check (tools/hlo_lint.py)."""
+    name: str
+    build: Callable[[int, list], Program]
+    topologies: Tuple[int, ...]
+    covers: Tuple[Tuple[str, str], ...]
+
+
+_SELF = "consul_tpu/parallel/hlo_audit.py"
+_N = 256          # bounded pool: shardable to 8 devices (256 >= 4*8)
+_TICKS = 8
+_VICTIM = 3
+
+
+def _serf_setup(n_devices: int, devs: list):
+    """Shared serf fixture: params + state, sharded when n_devices > 1
+    (mirroring bench.run_convergence: single-device production runs
+    carry no mesh at all)."""
+    params = serf.make_params(
+        GossipConfig.lan(),
+        SimConfig(n_nodes=_N, rumor_slots=16, alloc_cap=8, p_loss=0.01,
+                  seed=7, shard_blocks=n_devices if n_devices > 1 else 1))
+    s = serf.init_state(params)
+    sharding = mesh_shape = mesh = None
+    if n_devices > 1:
+        mesh = meshlib.make_mesh(devs[:n_devices])
+        sharding = meshlib.state_sharding(s, mesh)
+        s = jax.device_put(s, sharding)
+        mesh_shape = dict(mesh.shape)
+    return params, s, sharding, mesh, mesh_shape
+
+
+def _shard_like_state(x, mesh):
+    """Place a bare node-axis array the way state_sharding would."""
+    if mesh is None:
+        return x
+    return jax.device_put(x, meshlib.state_sharding(x, mesh))
+
+
+def _build_scan(d: int, devs: list) -> Program:
+    """The bench's timed inner loop (bench.py run_convergence): the
+    donated fixed-length serf scan, out-shardings threaded."""
+    params, s, sharding, _, mesh_shape = _serf_setup(d, devs)
+    out_sh = (sharding, None) if sharding is not None else None
+    run = jax.jit(serf.run, static_argnums=(0, 2, 3),
+                  donate_argnums=donation(1), out_shardings=out_sh)
+    return Program(jfn=run, args=(params, s, _TICKS, _VICTIM),
+                   n_nodes=_N, state=s, slots=_N, mesh_shape=mesh_shape,
+                   donate=bool(donation(1)),
+                   rebind=lambda a, out: (a[0], out[0], a[2], a[3]))
+
+
+def _build_step(d: int, devs: list) -> Program:
+    """The oracle's tick (oracle.py _step): undonated — readers hold
+    references to the carry across advance() calls."""
+    params, s, sharding, _, mesh_shape = _serf_setup(d, devs)
+    step = jax.jit(serf.step, static_argnums=0, out_shardings=sharding)
+    return Program(jfn=step, args=(params, s), n_nodes=_N, state=s,
+                   slots=_N, mesh_shape=mesh_shape)
+
+
+def _read_kernel(fn, static, extra_args):
+    """Builder factory for the oracle's gather-free read kernels:
+    device-side reductions whose outputs are O(page), never O(N)."""
+    def build(d: int, devs: list) -> Program:
+        params, s, _, mesh, mesh_shape = _serf_setup(d, devs)
+        jfn = jax.jit(fn, static_argnums=static)
+        return Program(jfn=jfn, args=(params, s) + extra_args(mesh),
+                       n_nodes=_N, state=s, slots=_N,
+                       mesh_shape=mesh_shape)
+    return build
+
+
+def _build_coord_row(d: int, devs: list) -> Program:
+    """oracle.py's coordinate-row kernel: one masked O(D) row read
+    (oracle._coord_row — the gather-free rewrite this framework's
+    first tree-wide run forced)."""
+    from consul_tpu import oracle as _oracle
+    _, s, _, _, mesh_shape = _serf_setup(d, devs)
+    jfn = jax.jit(_oracle._coord_row)
+    return Program(jfn=jfn, args=(s.coords, jnp.int32(5)), n_nodes=_N,
+                   state=s.coords, slots=_N, mesh_shape=mesh_shape)
+
+
+def _build_chaos_swim(d: int, devs: list) -> Program:
+    """chaos.py compiled_swim_run's shape: a monitored swim.run chunk
+    closed over params/ticks/monitor (single-device — the nemesis
+    evolves the fault schedule on the host between scans)."""
+    params = swim.make_params(
+        GossipConfig.lan(),
+        SimConfig(n_nodes=_N, rumor_slots=16, p_loss=0.02, seed=7))
+    st = swim.init_state(params)
+    jfn = jax.jit(lambda s: swim.run(params, s, _TICKS, _VICTIM))
+    return Program(jfn=jfn, args=(st,), n_nodes=_N, state=st, slots=_N)
+
+
+def _build_wan(d: int, devs: list) -> Program:
+    """The 2-D dc x nodes federation program (meshlib.make_wan_mesh):
+    per-DC LAN pools sharded on `nodes`, dc batch on `dc`, WAN pool on
+    `nodes` — the multi-slice/DCN layout, at the exact shape
+    test_sharding proves against single-device (64 nodes/dc, 2 dcs x
+    4 node shards).  The entry pins topologies=(8,): GSPMD's
+    gather-free lowering of the cross-DC bulk step is specific to
+    this shape — 2x2 meshes and 32-node pools today emit bounded
+    [dc, N] all-gathers there (measured, not fixed here; the budget
+    would catch a regression OF THE PROVEN SHAPE, which is what ships
+    to the chip)."""
+    from consul_tpu.models import wan
+    n_per_dc = 64
+    params = wan.make_params(n_dcs=2, nodes_per_dc=n_per_dc,
+                             servers_per_dc=4, p_loss=0.02, seed=7,
+                             rumor_slots=8, event_slots=8,
+                             shard_blocks=max(d // 2, 1))
+    s0 = wan.init_state(params)
+    mesh = meshlib.make_wan_mesh(devs[:d], n_dcs=2)
+    sharding = meshlib.wan_state_sharding(s0, mesh)
+    sh = jax.device_put(s0, sharding)
+    run = jax.jit(wan.run, static_argnums=(0, 2), out_shardings=sharding)
+    return Program(jfn=run, args=(params, sh, 5), n_nodes=n_per_dc,
+                   state=sh, slots=n_per_dc,
+                   mesh_shape=dict(mesh.shape))
+
+
+def _counts_args(mesh):
+    return (_shard_like_state(jnp.ones((_N,), bool), mesh),)
+
+
+def _page_args(mesh):
+    return (jnp.arange(8, dtype=jnp.int32),)
+
+
+def _delta_args(mesh):
+    prev = _shard_like_state(jnp.full((_N,), -1, jnp.int8), mesh)
+    prov = _shard_like_state(jnp.ones((_N,), bool), mesh)
+    return (prev, prov, 16)
+
+
+def _rtt_args(mesh):
+    return (jnp.int32(0), jnp.arange(8, dtype=jnp.int32),
+            jnp.ones((8,), bool))
+
+
+def _shard_metrics_args(mesh):
+    return (8,)
+
+
+REGISTRY: Tuple[EntrySpec, ...] = (
+    EntrySpec("serf.scan", _build_scan, (1, 2, 4, 8),
+              covers=(("bench.py", "serf.run"), (_SELF, "serf.run"))),
+    EntrySpec("serf.step", _build_step, (1, 2, 4, 8),
+              covers=(("consul_tpu/oracle.py", "serf.step"),
+                      (_SELF, "serf.step"))),
+    EntrySpec("serf.metrics",
+              _read_kernel(serf.metrics_vector, 0, lambda m: ()),
+              (1, 8),
+              covers=(("bench.py", "serf.metrics_vector"),
+                      ("consul_tpu/oracle.py", "serf.metrics_vector"))),
+    EntrySpec("serf.status_vector",
+              _read_kernel(serf.status_vector, 0, lambda m: ()),
+              (1, 8),
+              covers=()),
+    EntrySpec("serf.shard_metrics",
+              _read_kernel(serf.shard_metrics, (0, 2),
+                           _shard_metrics_args),
+              (1, 8),
+              covers=(("consul_tpu/oracle.py", "serf.shard_metrics"),)),
+    EntrySpec("oracle.membership_counts",
+              _read_kernel(serf.membership_counts, 0, _counts_args),
+              (1, 8),
+              covers=(("consul_tpu/oracle.py", "serf.membership_counts"),)),
+    EntrySpec("oracle.membership_page",
+              _read_kernel(serf.membership_page, 0, _page_args),
+              (1, 8),
+              covers=(("consul_tpu/oracle.py", "serf.membership_page"),)),
+    EntrySpec("oracle.membership_delta",
+              _read_kernel(serf.membership_delta, (0, 4), _delta_args),
+              (1, 8),
+              covers=(("consul_tpu/oracle.py", "serf.membership_delta"),)),
+    EntrySpec("oracle.rtt_order",
+              _read_kernel(serf.rtt_order, 0, _rtt_args),
+              (1, 8),
+              covers=(("consul_tpu/oracle.py", "serf.rtt_order"),)),
+    EntrySpec("oracle.coord_row", _build_coord_row, (1, 8),
+              covers=(("consul_tpu/oracle.py", "_coord_row"),
+                      (_SELF, "_oracle._coord_row"))),
+    EntrySpec("chaos.swim_run", _build_chaos_swim, (1,),
+              covers=(("consul_tpu/chaos.py", "<lambda>"),
+                      (_SELF, "<lambda>"))),
+    # one topology: the 2 dcs x 4 node shards shape PR 6 proved
+    # gather-free (test_sharding's audited program); smaller wan
+    # meshes lower with bounded [dc, N] gathers in the cross-DC bulk
+    # step today — see _build_wan's docstring
+    EntrySpec("wan.mesh2d", _build_wan, (8,),
+              covers=((_SELF, "wan.run"),)),
+)
+
+# jax.jit call sites under consul_tpu/ + bench.py that are deliberately
+# NOT registry entries — each with its reason (the PR 5 suppression
+# discipline; a stale entry fails the parity check)
+SUPPRESSED_JIT_SITES: Dict[Tuple[str, str], str] = {
+    ("consul_tpu/utils/sync.py", "<lambda>"):
+        "donation-capability probe: one trivial add compiled once per "
+        "backend to read input_output_alias support — not a "
+        "production kernel, no state, no topology axis",
+    (_SELF, "fn"):
+        "the _read_kernel builder factory: `fn` is whichever oracle "
+        "read kernel the registry entry passed in — every concrete "
+        "kernel it wraps IS a registry entry (serf.metrics/"
+        "status_vector/shard_metrics, oracle.membership_*/rtt_order)",
+}
+
+
+def registry_parity(sites: List[Tuple[str, str]]) -> dict:
+    """Every scanned `jax.jit` call site must be covered by a registry
+    entry or suppressed with a reason; covers/suppressions pointing at
+    sites that no longer exist are STALE and fail too (the PR 5
+    empty-baseline discipline).  `sites` comes from the AST scan in
+    tools/hlo_lint.py — this stays pure so tests can fabricate it."""
+    scanned = set(sites)
+    covered = {c for spec in REGISTRY for c in spec.covers}
+    suppressed = set(SUPPRESSED_JIT_SITES)
+    uncovered = sorted(scanned - covered - suppressed)
+    stale = sorted((covered | suppressed) - scanned)
+    return {"ok": not uncovered and not stale,
+            "sites": len(scanned),
+            "uncovered": [list(s) for s in uncovered],
+            "stale": [list(s) for s in stale]}
+
+
+# ---------------------------------------------------------- measurement
+
+def topology_stamp(n_devices: int, mesh_shape: Optional[dict]) -> dict:
+    """The BENCH_BASELINE-style stamp every record carries, so the
+    judge can refuse cross-topology comparisons instead of silently
+    judging CPU numbers against chip budgets."""
+    return {"backend": jax.default_backend(), "devices": n_devices,
+            "mesh_shape": mesh_shape}
+
+
+def measure_entry(spec: EntrySpec, n_devices: int, devs: list) -> dict:
+    """Build + AOT-compile one entry at one topology and extract every
+    number the rules judge.  Also dispatches the jitted callable twice
+    (rebinding the donated carry) so the compile-count ledger reads
+    the real dispatch cache, not the AOT path."""
+    prog = spec.build(n_devices, list(devs))
+    compiled = prog.jfn.lower(*prog.args).compile()
+    hlo = compiled.as_text()
+    record = {
+        "topology": topology_stamp(n_devices, prog.mesh_shape),
+        **audit_compiled(hlo, prog.n_nodes,
+                         f"{spec.name}@{n_devices}d"),
+        "alias_entries": alias_entries(hlo),
+        "donate_expected": prog.donate,
+        "donation_capable": backend_honors_donation(),
+        "bytes_per_slot": bytes_per_slot(prog.state, prog.slots),
+        **compiled_stats(compiled),
+    }
+    # compile-count = dispatch-cache GROWTH across the two calls, not
+    # the absolute size: pjit shares its cache across jax.jit wrappers
+    # of the same function object, so another topology's measurement
+    # earlier in the process is visible in _cache_size() (and the AOT
+    # compile above contributes nothing to it)
+    pre = cache_size(prog.jfn)
+    out = prog.jfn(*prog.args)
+    jax.block_until_ready(out)
+    args2 = prog.rebind(prog.args, out) if prog.rebind is not None \
+        else prog.args
+    out2 = prog.jfn(*args2)
+    jax.block_until_ready(out2)
+    post = cache_size(prog.jfn)
+    record["compiles"] = None if post is None else post - (pre or 0)
+    return record
+
+
+# ---------------------------------------------------------------- judge
+
+def judge_record(run: dict, base: dict, tolerance: float) -> dict:
+    """Judge one measured record against its committed budget.  A
+    topology-stamp mismatch REFUSES (verdict "topology") rather than
+    judging — chip budgets must never gate CPU lowerings or vice
+    versa; re-baseline on the new topology instead
+    (hlo_lint --update-baseline)."""
+    rt = run.get("topology") or {}
+    bt = base.get("topology") or {}
+    if bt and rt and any(rt.get(k) != bt.get(k)
+                         for k in ("backend", "devices", "mesh_shape")):
+        return {"ok": False, "verdict": "topology", "failures": [],
+                "baseline_topology": bt, "run_topology": rt}
+    fails: List[dict] = []
+
+    def fail(rule, detail):
+        fails.append({"rule": rule, "detail": detail})
+
+    if run.get("full_node_gathers"):
+        fail("gather-freedom",
+             f"{run['full_node_gathers']} all-gather(s) materialize a "
+             f"node-axis buffer")
+    base_census = base.get("collectives") or {}
+    for fam, n in sorted((run.get("collectives") or {}).items()):
+        budget = base_census.get(fam)
+        if budget is None:
+            fail("collective-family",
+                 f"unexpected {fam} x{n} (family absent from budget)")
+        elif n > budget:
+            fail("collective-census", f"{fam} count {n} > budget {budget}")
+    if run.get("donate_expected") and run.get("donation_capable") \
+            and not run.get("alias_entries"):
+        fail("donation",
+             "donation requested and backend honors aliasing, but the "
+             "compiled executable aliases nothing — the silent-copy "
+             "failure mode (every donated carry double-buffers)")
+    bps, base_bps = run.get("bytes_per_slot"), base.get("bytes_per_slot")
+    if bps and base_bps and bps > base_bps:
+        fail("dtype-width",
+             f"state widened to {bps} B/slot (budget {base_bps} — the "
+             f"PR 2 narrowing)")
+    for k in ("flops", "peak_bytes"):
+        rv, bv = run.get(k), base.get(k)
+        if rv and bv and abs(rv - bv) > tolerance * bv:
+            fail("budget",
+                 f"{k} {rv} outside ±{tolerance:.0%} of budget {bv}")
+    if run.get("compiles") not in (None, 1):
+        fail("compile-count",
+             f"{run['compiles']} dispatch-cache entries (expected "
+             f"exactly 1 compile per topology)")
+    return {"ok": not fails,
+            "verdict": "ok" if not fails else "violation",
+            "failures": fails}
+
+
+def judge_scaling(records_by_devices: Dict[int, dict],
+                  tolerance: float) -> dict:
+    """The permute-law judge across topologies of ONE entry: ring
+    rotations lower to log2(devices) collective-permutes each
+    (ops/rolls.py), so permutes/log2(d) must not GROW with device
+    count — growth means a rotation regressed toward O(devices)
+    traffic.  The check is one-sided: the ratio at the smallest
+    sharded topology is the reference, and larger topologies may only
+    exceed it by the tolerance.  A ratio that shrinks with devices is
+    sub-log2 scaling — an improvement, never a violation."""
+    ratios = {}
+    for d, rec in records_by_devices.items():
+        if d > 1:
+            permutes = (rec.get("collectives") or {}).get(
+                "collective-permute", 0)
+            ratios[d] = permutes / math.log2(d)
+    if len(ratios) < 2:
+        return {"ok": True, "rule": "permute-scaling", "ratios": ratios,
+                "note": "needs >=2 sharded topologies"}
+    ref = ratios[min(ratios)]
+    hi = max(ratios.values())
+    ok = hi <= max(ref, 1e-9) * (1.0 + tolerance)
+    return {"ok": ok, "rule": "permute-scaling",
+            "ratios": {str(d): round(r, 2) for d, r in ratios.items()},
+            "growth_ratio": round(hi / max(ref, 1e-9), 3)}

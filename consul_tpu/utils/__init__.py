@@ -1,4 +1,5 @@
 from consul_tpu.utils import prng
-from consul_tpu.utils.sync import donation, hard_sync
+from consul_tpu.utils.sync import (backend_honors_donation, donation,
+                                   hard_sync)
 
-__all__ = ["prng", "hard_sync", "donation"]
+__all__ = ["prng", "hard_sync", "donation", "backend_honors_donation"]

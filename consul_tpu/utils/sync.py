@@ -19,16 +19,47 @@ import jax
 import numpy as np
 
 
+# donation capability per backend, probed once (a process never swaps
+# the implementation under a backend name)
+_DONATION_PROBED: dict = {}
+
+
+def backend_honors_donation() -> bool:
+    """Does the current backend actually alias donated buffers?  Probed
+    by compiling one trivial donated program and reading the
+    `input_output_alias` header from the executable — the same
+    evidence the hlo_lint donation rule judges, so the gate and the
+    gate's gate can never disagree.  (The old hard-coded `backend !=
+    "cpu"` test was stale: current jax CPU honors aliasing, and the
+    gate was silently disabling donation — and with it the
+    donation-honored contract — on the whole CPU test rig.  ISSUE 20's
+    first tree-wide finding.)"""
+    backend = jax.default_backend()
+    ok = _DONATION_PROBED.get(backend)
+    if ok is None:
+        import jax.numpy as jnp
+        probe = jax.jit(lambda x: x + 1, donate_argnums=0)
+        try:
+            hlo = probe.lower(
+                jnp.zeros((16,), jnp.float32)).compile().as_text()
+            ok = "input_output_alias" in hlo
+        except Exception:   # pragma: no cover - exotic backends
+            ok = False
+        _DONATION_PROBED[backend] = ok
+    return ok
+
+
 def donation(*argnums: int) -> tuple:
-    """`donate_argnums` for a state-carry jit, gated off the CPU backend.
+    """`donate_argnums` for a state-carry jit, gated on the backend's
+    PROBED aliasing support (backend_honors_donation) rather than a
+    hard-coded platform list.
 
     Donating the SwimState/ClusterState carry lets XLA update the
     [N]-shaped state arrays in place instead of double-buffering
-    1M-row tensors in HBM; the CPU backend ignores donation and warns
-    on every call, so the gate keeps test logs clean.  Only donate when
-    the caller owns its state exclusively and always rebinds to the
-    output (bench/tool loops do; the oracle does NOT — see oracle.py)."""
-    return tuple(argnums) if jax.default_backend() != "cpu" else ()
+    1M-row tensors in HBM.  Only donate when the caller owns its state
+    exclusively and always rebinds to the output (bench/tool loops do;
+    the oracle does NOT — see oracle.py)."""
+    return tuple(argnums) if backend_honors_donation() else ()
 
 
 def hard_sync(tree, all_leaves: bool = False) -> None:

@@ -156,6 +156,24 @@ def test_guard_tolerates_self_defense_stamps():
     assert not judge([{**row, "value": 0.900}], base)["ok"]
 
 
+def test_guard_tolerates_hlo_stamps():
+    """ISSUE 20: rows produced alongside an hlo_lint pass may carry an
+    {"hlo": ...} compiled-program stamp (census/budget summary —
+    HLOBUDGET_r01.json and tools/hlo_lint.py judge it, not this
+    guard) — metadata the judge must tolerate while still judging
+    ONLY the median + accuracy gates."""
+    base = {"metric": METRIC, "median_s": 0.600}
+    row = {**_row(0.650),
+           "hlo": {"entries": 12, "full_node_gathers": 0,
+                   "collectives": {"collective-permute": 147,
+                                   "all-reduce": 59},
+                   "budget": "HLOBUDGET_r01.json"}}
+    assert judge([row], base)["ok"]
+    # a stamped row over threshold still fails on the MEDIAN, proving
+    # the stamp was ignored rather than short-circuiting the judge
+    assert not judge([{**row, "value": 0.900}], base)["ok"]
+
+
 def test_checked_in_baseline_is_valid_and_matches_roundtrip():
     b = load_baseline()
     assert b["metric"] == METRIC

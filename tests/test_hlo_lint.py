@@ -1,0 +1,335 @@
+"""Compiled-program contract gates: falsifiability per hlo_lint rule +
+the tree-wide build gate (ISSUE 20).
+
+Mirrors tests/test_lint.py's bar: every rule must (a) FIRE on a seeded
+violation — a real all-gather lowering, a dropped donation, a forced
+recompile, a widened dtype — and (b) stay SILENT on the clean
+counterpart; a compiled-artifact gate that cannot detect its own
+target invariant being violated is worse than none.  On top of that
+the judge is exercised on fabricated records (the test_bench_guard
+pattern), registry parity runs against the real tree, and the real
+gate runs as a subprocess: `tools/hlo_lint.py --check` on bounded
+topologies, green, inside a wall-clock budget, with the --json shape
+the chip-day re-baseline workflow depends on.
+
+Unlike test_lint.py this file compiles small programs on the 8-device
+CPU rig (conftest) — the rules judge executables, not source text.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from consul_tpu.parallel import hlo_audit  # noqa: E402
+from consul_tpu.parallel import mesh as meshlib  # noqa: E402
+from hlo_lint import (DEFAULT_BASELINE, scan_jit_sites,  # noqa: E402
+                      load_baseline)
+
+HLO_LINT = os.path.join(REPO, "tools", "hlo_lint.py")
+
+# a clean fabricated record + its budget twin: each judge test perturbs
+# exactly ONE field (the test_bench_guard fabricated-row discipline)
+BASE = {
+    "topology": {"backend": "cpu", "devices": 8,
+                 "mesh_shape": {"nodes": 8}},
+    "collectives": {"collective-permute": 147, "all-reduce": 59},
+    "full_node_gathers": 0,
+    "alias_entries": 24,
+    "donate_expected": True,
+    "donation_capable": True,
+    "bytes_per_slot": 429,
+    "flops": 646274.0,
+    "peak_bytes": 1_000_000,
+    "compiles": 1,
+}
+
+
+def judge(run_over=None, base_over=None, tol=0.25):
+    run = {**BASE, **(run_over or {})}
+    base = {**BASE, **(base_over or {})}
+    return hlo_audit.judge_record(run, base, tol)
+
+
+def rules_fired(verdict):
+    return {f["rule"] for f in verdict["failures"]}
+
+
+# ------------------------------------------------- judge falsifiability
+# (fabricated records, no compiles — one fires/silent pair per rule)
+
+
+def test_judge_clean_record_is_silent():
+    v = judge()
+    assert v["ok"] and v["verdict"] == "ok" and not v["failures"]
+
+
+def test_gather_freedom_fires():
+    v = judge({"full_node_gathers": 2})
+    assert not v["ok"] and "gather-freedom" in rules_fired(v)
+
+
+def test_collective_census_fires_on_count_and_family():
+    over = judge({"collectives": {"collective-permute": 200,
+                                  "all-reduce": 59}})
+    assert "collective-census" in rules_fired(over)
+    alien = judge({"collectives": {"collective-permute": 147,
+                                   "all-reduce": 59, "all-to-all": 1}})
+    assert "collective-family" in rules_fired(alien)
+    # fewer collectives than budget is an improvement, not a violation
+    assert judge({"collectives": {"collective-permute": 10}})["ok"]
+
+
+def test_donation_rule_fires_only_when_capable_and_expected():
+    v = judge({"alias_entries": 0})
+    assert not v["ok"] and "donation" in rules_fired(v)
+    # an undonated entry or an incapable backend never fires
+    assert judge({"alias_entries": 0, "donate_expected": False})["ok"]
+    assert judge({"alias_entries": 0, "donation_capable": False})["ok"]
+
+
+def test_dtype_width_fires_on_widening_only():
+    v = judge({"bytes_per_slot": 433})
+    assert not v["ok"] and "dtype-width" in rules_fired(v)
+    assert judge({"bytes_per_slot": 400})["ok"]   # narrowing is fine
+
+
+def test_budget_fires_outside_tolerance():
+    v = judge({"flops": BASE["flops"] * 1.5})
+    assert not v["ok"] and "budget" in rules_fired(v)
+    v = judge({"peak_bytes": int(BASE["peak_bytes"] * 1.5)})
+    assert not v["ok"] and "budget" in rules_fired(v)
+    assert judge({"flops": BASE["flops"] * 1.1})["ok"]   # within ±25%
+
+
+def test_compile_count_fires_on_recompile():
+    v = judge({"compiles": 2})
+    assert not v["ok"] and "compile-count" in rules_fired(v)
+    assert judge({"compiles": None})["ok"]   # jax hides the cache: skip
+
+
+def test_topology_mismatch_refuses_not_judges():
+    """The bench_guard discipline: chip budgets never gate CPU
+    lowerings — a record from another topology REFUSES even when its
+    numbers would violate every rule."""
+    v = judge({"topology": {"backend": "tpu", "devices": 1,
+                            "mesh_shape": None},
+               "full_node_gathers": 9, "compiles": 3})
+    assert not v["ok"] and v["verdict"] == "topology" and not v["failures"]
+
+
+def test_permute_scaling_flat_ok_growth_fires():
+    def rec(permutes):
+        return {"collectives": {"collective-permute": permutes}}
+    flat = hlo_audit.judge_scaling(
+        {2: rec(49), 4: rec(98), 8: rec(147)}, 0.25)
+    assert flat["ok"]
+    grown = hlo_audit.judge_scaling(
+        {2: rec(49), 8: rec(400)}, 0.25)   # toward O(devices) traffic
+    assert not grown["ok"]
+    single = hlo_audit.judge_scaling({8: rec(147)}, 0.25)
+    assert single["ok"]   # needs >= 2 sharded topologies to judge
+    shrinking = hlo_audit.judge_scaling(
+        {2: rec(92), 4: rec(147), 8: rec(184)}, 0.25)
+    assert shrinking["ok"]   # sub-log2 growth is an improvement, not a bug
+
+
+# --------------------------------------- compiled-artifact falsifiability
+# (the rules' raw material: small real programs on the 8-device rig)
+
+
+def _mesh_and_x(n=64, d=8):
+    mesh = meshlib.make_mesh(jax.devices("cpu")[:d])
+    x = jax.device_put(jnp.zeros((n, 8), jnp.float32),
+                       meshlib.state_sharding(jnp.zeros((n, 8)), mesh))
+    return mesh, x
+
+
+def test_seeded_all_gather_fires_and_masked_read_stays_silent():
+    """The exact regression the gate exists for: row-indexing a
+    node-sharded tensor all-gathers it (the pre-fix oracle coord_row),
+    while the masked-reduction rewrite lowers gather-free."""
+    _, x = _mesh_and_x()
+    gathered = jax.jit(lambda v, i: v[i]).lower(
+        x, jnp.int32(3)).compile().as_text()
+    with pytest.raises(AssertionError, match="all-gather"):
+        hlo_audit.audit_compiled(gathered, 64, "seeded row index")
+
+    def masked(v, i):
+        at = jnp.arange(v.shape[0], dtype=jnp.int32) == i
+        return jnp.sum(jnp.where(at[:, None], v, 0.0), axis=0)
+
+    clean = jax.jit(masked).lower(x, jnp.int32(3)).compile().as_text()
+    out = hlo_audit.audit_compiled(clean, 64, "masked row read")
+    assert out["full_node_gathers"] == 0
+
+
+def test_dropped_donation_visible_in_alias_entries():
+    """alias_entries reads the EVIDENCE (the executable's aliasing
+    header), so requesting donation and dropping it are
+    distinguishable — the silent-copy failure mode the source-text
+    lint cannot see."""
+    assert hlo_audit.cache_size is not None
+    x = jnp.zeros((64,), jnp.float32)
+    donated = jax.jit(lambda v: v + 1, donate_argnums=0).lower(
+        x).compile().as_text()
+    dropped = jax.jit(lambda v: v + 1).lower(x).compile().as_text()
+    assert hlo_audit.alias_entries(donated) >= 1
+    assert hlo_audit.alias_entries(dropped) == 0
+
+
+def test_alias_entries_parses_nested_brace_header():
+    hlo = ("HloModule m, input_output_alias={ {0}: (1, {0}, may-alias), "
+           "{1}: (2, {}, must-alias) }, entry_computation_layout=...")
+    assert hlo_audit.alias_entries(hlo) == 2
+    assert hlo_audit.alias_entries("HloModule m, no aliases here") == 0
+
+
+def test_forced_recompile_fires_single_compile_stays_silent():
+    jfn = jax.jit(lambda v: v * 2)
+    jfn(jnp.zeros((8,), jnp.float32))
+    jfn(jnp.zeros((8,), jnp.float32))   # cache hit, still 1 entry
+    hlo_audit.assert_single_compile(jfn, "stable shape")
+    jfn(jnp.zeros((16,), jnp.float32))  # new shape: a second compile
+    with pytest.raises(AssertionError, match="compiled 2x"):
+        hlo_audit.assert_single_compile(jfn, "perturbed shape")
+
+
+def test_widened_dtype_moves_bytes_per_slot():
+    n = 32
+    narrow = {"a": np.zeros((n,), np.int8), "b": np.zeros((n, 4),
+                                                          np.float32),
+              "scalar": np.float32(0)}   # no node axis: excluded
+    wide = dict(narrow, a=np.zeros((n,), np.int32))
+    bps = hlo_audit.bytes_per_slot(narrow, n)
+    assert bps == 1 + 16
+    assert hlo_audit.bytes_per_slot(wide, n) == 4 + 16
+    v = judge({"bytes_per_slot": hlo_audit.bytes_per_slot(wide, n)},
+              {"bytes_per_slot": bps})
+    assert not v["ok"] and "dtype-width" in rules_fired(v)
+
+
+def test_donation_gate_probes_not_hardcodes():
+    """The stale-gate finding: utils.donation() must follow the PROBED
+    capability of the backend, not a platform list — on this rig
+    (jax CPU honors aliasing) donation is ACTIVE."""
+    from consul_tpu.utils import donation
+    from consul_tpu.utils.sync import backend_honors_donation
+    assert backend_honors_donation() is True
+    assert donation(1) == (1,)
+
+
+def test_init_state_donation_safe():
+    """Finding #3: up/member shared one buffer, so donating the fresh
+    state crashed every donation-honoring backend with 'attempt to
+    donate the same buffer twice'.  A donated identity scan over the
+    fresh state must dispatch cleanly."""
+    from consul_tpu.config import GossipConfig, SimConfig
+    from consul_tpu.models import serf
+    params = serf.make_params(
+        GossipConfig.lan(), SimConfig(n_nodes=64, rumor_slots=8,
+                                      p_loss=0.0, seed=3))
+    s = serf.init_state(params)
+    leaves = jax.tree_util.tree_leaves(s)
+    ptrs = [leaf.unsafe_buffer_pointer() for leaf in leaves
+            if hasattr(leaf, "unsafe_buffer_pointer")]
+    assert len(ptrs) == len(set(ptrs)), "state leaves share buffers"
+    out = jax.jit(lambda st: st, donate_argnums=0)(s)
+    jax.block_until_ready(jax.tree_util.tree_leaves(out))
+
+
+# --------------------------------------------------------- registry side
+
+
+def test_registry_parity_tree_wide():
+    """Every jax.jit site under consul_tpu/ + bench.py is a registry
+    entry's `covers` or suppressed with a reason — and none of either
+    is stale (the PR 5 empty-baseline discipline)."""
+    parity = hlo_audit.registry_parity(scan_jit_sites())
+    assert parity["ok"], parity
+
+
+def test_registry_parity_fires_on_uncovered_and_stale():
+    sites = scan_jit_sites()
+    seeded = sites + [("consul_tpu/newfront.py", "dns.answer")]
+    p = hlo_audit.registry_parity(seeded)
+    assert not p["ok"] and ["consul_tpu/newfront.py",
+                            "dns.answer"] in p["uncovered"]
+    # dropping a covered site leaves the registry's cover STALE
+    missing = [s for s in sites if s != ("bench.py", "serf.run")]
+    p = hlo_audit.registry_parity(missing)
+    assert not p["ok"] and ["bench.py", "serf.run"] in p["stale"]
+
+
+def test_measure_judge_roundtrip_cheap_entry():
+    """One real entry through the full pipe: measure on this rig,
+    self-judge against its own record as budget — green; then seed a
+    tighter budget and watch the census rule fire."""
+    spec = next(s for s in hlo_audit.REGISTRY
+                if s.name == "oracle.membership_counts")
+    rec = hlo_audit.measure_entry(spec, 1, jax.devices("cpu"))
+    v = hlo_audit.judge_record(rec, rec, 0.25)
+    assert v["ok"], v
+    assert rec["compiles"] == 1
+    tight = dict(rec, collectives={}, flops=rec.get("flops"))
+    if rec.get("collectives"):
+        v2 = hlo_audit.judge_record(rec, tight, 0.25)
+        assert not v2["ok"]
+
+
+# ----------------------------------------------- committed manifest + CLI
+
+
+def test_committed_manifest_covers_registry():
+    """HLOBUDGET_r01.json: every (entry, topology) pair the registry
+    declares has a committed, topology-stamped budget record."""
+    manifest = load_baseline(DEFAULT_BASELINE)
+    assert manifest.get("version") == "r01"
+    assert 0 < manifest.get("tolerance", 0) < 1
+    ents = manifest.get("entries", {})
+    for spec in hlo_audit.REGISTRY:
+        assert spec.name in ents, f"no budget for {spec.name}"
+        for d in spec.topologies:
+            rec = ents[spec.name].get(str(d))
+            assert rec, f"no budget for {spec.name}@{d}d"
+            assert rec["topology"]["devices"] == d
+            assert rec["topology"]["backend"] == "cpu"
+            assert rec["full_node_gathers"] == 0
+            assert rec["compiles"] in (None, 1)
+
+
+def test_check_mode_cli_green_in_budget_with_json_shape():
+    """The tier-1 gate as CI runs it: bounded topologies (single
+    device — the sharded 2/4/8 lowerings are covered by the in-process
+    falsifiability tests above and the full `--check` on demand),
+    green exit, summary JSON with the re-baseline workflow's shape,
+    inside a wall-clock budget (the `lint --timing` discipline scaled
+    to a compile-heavy gate; the persistent XLA cache keeps re-runs
+    cheap)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, HLO_LINT, "--check", "--topologies", "1",
+         "--json"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True
+    assert payload["tool"] == "hlo_lint"
+    assert payload["topologies"] == [1]
+    assert payload["parity"]["ok"] is True
+    assert payload["violations"] == [] and payload["refused"] == []
+    assert payload["wall_s"] < 240
+    # records/verdicts shape: entry -> devices -> dict
+    for name, by_dev in payload["records"].items():
+        for d, rec in by_dev.items():
+            assert "topology" in rec and "collectives" in rec, (name, d)
+            assert payload["verdicts"][name][d]["ok"] is True
+    assert "scaling" in payload["verdicts"]["serf.scan"]

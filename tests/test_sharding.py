@@ -118,8 +118,8 @@ def test_wan_2d_mesh_run_matches_single_device():
     wrun = jax.jit(wanlib.run, static_argnums=(0, 2),
                    out_shardings=wsharding)
     compiled = wrun.lower(sparams, sh, 20).compile()
-    bad = meshlib.full_gather_ops(compiled.as_text(), 64)
-    assert not bad, f"wan program all-gathers node-axis buffers: {bad[0]}"
+    from consul_tpu.parallel import hlo_audit
+    hlo_audit.audit_compiled(compiled, 64, "wan 2-D program")
     got = wrun(sparams, sh, 20)
     meshlib.assert_node_sharded(got.lan.swim.know, 8,
                                 "federated LAN knowledge")

@@ -416,6 +416,20 @@ def run_check() -> int:
     if not shedrow["ok"]:
         failures.append("guard judged the ratelimit/shed stamp keys "
                         "instead of tolerating them")
+    # ISSUE 20's compiled-program stamp is metadata too: rows produced
+    # alongside an hlo_lint pass may carry {"hlo": {...}} (the census/
+    # budget summary HLOBUDGET_r01.json judges — hlo_lint's job, not
+    # this guard's) — a decorated within-threshold row must be
+    # tolerated-not-judged
+    hlorow = judge([{"value": 0.650, "f1": 1.0, "false_commits": 0,
+                     "hlo": {"entries": 12, "full_node_gathers": 0,
+                             "collectives": {"collective-permute": 147,
+                                             "all-reduce": 59},
+                             "budget": "HLOBUDGET_r01.json"}}],
+                   fake_base)
+    if not hlorow["ok"]:
+        failures.append("guard judged the hlo artifact stamp keys "
+                        "instead of tolerating them")
     baseline = load_baseline()   # the checked-in file must stay valid
     row["baseline_median_s"] = baseline["median_s"]
     row["ok"] = not failures

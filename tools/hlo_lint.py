@@ -1,0 +1,275 @@
+"""Compiled-program contract gate — the HLO linter (ISSUE 20).
+
+    python tools/hlo_lint.py --check                  # tier-1 gate, all topologies
+    python tools/hlo_lint.py --check --topologies 1,2 # bounded (CI wall-clock)
+    python tools/hlo_lint.py --update-baseline        # chip-day re-baseline
+    python tools/hlo_lint.py --json                   # records + verdicts
+    python tools/hlo_lint.py --list                   # registry entries
+
+Where `tools/lint.py --check` gates the SOURCE TEXT, this gates the
+COMPILED ARTIFACT: every production jit entry point in the registry
+(consul_tpu/parallel/hlo_audit.py) is lowered and compiled per topology
+on simulated CPU devices (meshlib.cpu_devices) and judged against the
+committed budget manifest HLOBUDGET_r01.json — gather-freedom,
+collective census, donation honored, dtype-width, flops/peak-bytes
+within ±tolerance, compile-count, permute scaling.
+
+The framework (rules, registry, judge) is pure and lives in
+hlo_audit.py; THIS file owns the filesystem side: manifest I/O, the
+AST jit-site scan behind registry parity, and orchestration.  Budgets
+are topology-stamped like BENCH_BASELINE: judging a record against a
+budget from a different backend/device count REFUSES (exit 2) instead
+of failing — on the chip, re-baseline with --update-baseline (one
+command; the chip-day workflow README documents).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+DEFAULT_BASELINE = os.path.join(REPO, "HLOBUDGET_r01.json")
+DEFAULT_TOLERANCE = 0.25
+# where the registry-parity scan looks for jax.jit call sites
+PARITY_ROOTS = ("consul_tpu", "bench.py")
+
+
+# ------------------------------------------------------------ parity scan
+
+def _jit_callee(call: ast.Call) -> str:
+    """Label for what a jax.jit(...) call site wraps: the unparsed
+    first argument, or "<lambda>" — the registry `covers` key."""
+    if not call.args:
+        return "<none>"
+    first = call.args[0]
+    if isinstance(first, ast.Lambda):
+        return "<lambda>"
+    return ast.unparse(first)
+
+
+def _is_jax_jit(node: ast.expr) -> bool:
+    return isinstance(node, ast.Attribute) and node.attr == "jit" \
+        and isinstance(node.value, ast.Name) and node.value.id == "jax"
+
+
+def scan_jit_sites(repo: str = REPO) -> List[Tuple[str, str]]:
+    """Every `jax.jit` usage under PARITY_ROOTS as (relpath, callee)
+    pairs: call sites `jax.jit(f, ...)` label the wrapped callable,
+    decorator forms (`@jax.jit` / `@partial(jax.jit, ...)`) label the
+    decorated function.  Input to hlo_audit.registry_parity."""
+    files: List[str] = []
+    for root in PARITY_ROOTS:
+        path = os.path.join(repo, root)
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for dirpath, _, names in os.walk(path):
+            files.extend(os.path.join(dirpath, n) for n in names
+                         if n.endswith(".py"))
+    sites: List[Tuple[str, str]] = []
+    for path in sorted(files):
+        rel = os.path.relpath(path, repo)
+        with open(path, encoding="utf-8") as fh:
+            try:
+                tree = ast.parse(fh.read())
+            except SyntaxError:
+                continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                sites.append((rel, _jit_callee(node)))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    if _is_jax_jit(target):
+                        sites.append((rel, node.name))
+                    elif isinstance(dec, ast.Call) and dec.args \
+                            and _is_jax_jit(dec.args[0]):
+                        sites.append((rel, node.name))   # partial(jax.jit,)
+    return sites
+
+
+# ------------------------------------------------------------ manifest IO
+
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_baseline(path: str, manifest: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+# ------------------------------------------------------------- orchestrate
+
+def _parse_topologies(spec: str) -> Tuple[int, ...]:
+    return tuple(sorted({int(t) for t in spec.split(",") if t.strip()}))
+
+
+def measure_all(entries: List[str], topologies: Tuple[int, ...]) -> Dict:
+    """Measure every requested (entry, topology) under ONE simulated
+    device context sized to the largest topology.  Returns
+    {name: {devices: record}} with records straight from
+    hlo_audit.measure_entry."""
+    import bench
+    from consul_tpu.parallel import hlo_audit
+    from consul_tpu.parallel import mesh as meshlib
+    bench.enable_compilation_cache()
+    want = [s for s in hlo_audit.REGISTRY
+            if not entries or s.name in entries]
+    missing = set(entries or ()) - {s.name for s in want}
+    if missing:
+        raise SystemExit(f"unknown entries: {sorted(missing)} "
+                         f"(see --list)")
+    records: Dict[str, Dict[int, dict]] = {}
+    with meshlib.cpu_devices(max(topologies)) as devs:
+        for spec in want:
+            for d in spec.topologies:
+                if d not in topologies:
+                    continue
+                t0 = time.monotonic()
+                rec = hlo_audit.measure_entry(spec, d, list(devs))
+                rec["measure_s"] = round(time.monotonic() - t0, 3)
+                records.setdefault(spec.name, {})[d] = rec
+    return records
+
+
+def judge_all(records: Dict, manifest: dict, tolerance: float) -> dict:
+    """Judge every measured record against the committed manifest plus
+    the cross-topology permute law.  Separates refusals (topology
+    mismatch / missing budget — CANNOT judge, exit 2) from violations
+    (judged and failed, exit 1)."""
+    from consul_tpu.parallel import hlo_audit
+    base_entries = manifest.get("entries", {})
+    violations: List[dict] = []
+    refused: List[dict] = []
+    verdicts: Dict[str, Dict[str, dict]] = {}
+    for name, by_dev in sorted(records.items()):
+        for d, rec in sorted(by_dev.items()):
+            base = base_entries.get(name, {}).get(str(d))
+            if base is None:
+                refused.append({"entry": name, "devices": d,
+                                "why": "no committed budget — run "
+                                       "--update-baseline"})
+                continue
+            v = hlo_audit.judge_record(rec, base, tolerance)
+            verdicts.setdefault(name, {})[str(d)] = v
+            if v["verdict"] == "topology":
+                refused.append({"entry": name, "devices": d,
+                                "why": "topology stamp mismatch — "
+                                       "re-baseline on this topology",
+                                **{k: v[k] for k in ("baseline_topology",
+                                                     "run_topology")}})
+            elif not v["ok"]:
+                violations.append({"entry": name, "devices": d,
+                                   "failures": v["failures"]})
+        scaling = hlo_audit.judge_scaling(by_dev, tolerance)
+        verdicts.setdefault(name, {})["scaling"] = scaling
+        if not scaling["ok"]:
+            violations.append({"entry": name, "devices": "scaling",
+                               "failures": [scaling]})
+    return {"violations": violations, "refused": refused,
+            "verdicts": verdicts}
+
+
+def main(argv: List[str] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="hlo_lint", description=__doc__.splitlines()[0])
+    p.add_argument("--check", action="store_true",
+                   help="measure and judge against the committed "
+                        "budget manifest (the tier-1 gate)")
+    p.add_argument("--update-baseline", action="store_true",
+                   dest="update", help="write measured records into "
+                                       "the manifest (merge per "
+                                       "entry/topology)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="print full records + verdicts as JSON")
+    p.add_argument("--list", action="store_true", dest="list_entries",
+                   help="list registry entries and exit")
+    p.add_argument("--entries", default="",
+                   help="comma-separated entry names (default: all)")
+    p.add_argument("--topologies", default="1,2,4,8",
+                   help="comma-separated simulated device counts "
+                        "(default: 1,2,4,8; intersected with each "
+                        "entry's declared axes)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="budget manifest path")
+    p.add_argument("--tolerance", type=float, default=None,
+                   help="override the manifest's ±tolerance for "
+                        "flops/peak-bytes/permute-scaling")
+    args = p.parse_args(argv)
+
+    if args.list_entries:
+        from consul_tpu.parallel import hlo_audit
+        for spec in hlo_audit.REGISTRY:
+            print(f"{spec.name:28s} topologies={list(spec.topologies)}")
+        return 0
+    if not (args.check or args.update or args.as_json):
+        p.print_help()
+        return 0
+
+    t0 = time.monotonic()
+    from consul_tpu.parallel import hlo_audit
+    entries = [e for e in args.entries.split(",") if e.strip()]
+    topologies = _parse_topologies(args.topologies)
+    manifest = load_baseline(args.baseline)
+    tolerance = args.tolerance if args.tolerance is not None \
+        else manifest.get("tolerance", DEFAULT_TOLERANCE)
+
+    records = measure_all(entries, topologies)
+    parity = hlo_audit.registry_parity(scan_jit_sites())
+
+    if args.update:
+        manifest.setdefault("version", "r01")
+        manifest.setdefault("tolerance", DEFAULT_TOLERANCE)
+        ents = manifest.setdefault("entries", {})
+        for name, by_dev in records.items():
+            for d, rec in by_dev.items():
+                rec = dict(rec)
+                rec.pop("measure_s", None)
+                ents.setdefault(name, {})[str(d)] = rec
+        save_baseline(args.baseline, manifest)
+        print(f"hlo_lint: baseline updated — "
+              f"{sum(len(v) for v in records.values())} record(s) into "
+              f"{os.path.relpath(args.baseline, REPO)}")
+
+    judged = judge_all(records, load_baseline(args.baseline), tolerance)
+    ok = not judged["violations"] and not judged["refused"] \
+        and parity["ok"]
+    summary = {
+        "tool": "hlo_lint",
+        "ok": ok,
+        "entries": sum(len(v) for v in records.values()),
+        "topologies": list(topologies),
+        "violations": judged["violations"],
+        "refused": judged["refused"],
+        "parity": parity,
+        "tolerance": tolerance,
+        "wall_s": round(time.monotonic() - t0, 2),
+    }
+    if args.as_json:
+        print(json.dumps({**summary, "records": records,
+                          "verdicts": judged["verdicts"]}, indent=1,
+                         sort_keys=True, default=str))
+    else:
+        print(json.dumps(summary, sort_keys=True))
+    if judged["violations"] or not parity["ok"]:
+        return 1
+    if judged["refused"]:
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,17 +103,11 @@ def compile_with_stats(jfn, *args):
 
 
 def count_collectives(hlo_text: str) -> dict:
-    """Instruction census of the cross-shard traffic GSPMD inserted:
-    collective-permutes ARE the ring rumor/probe exchange
-    (ops/rolls.py decomposition); all-gathers should only ever touch
-    replicated [U]-sized tables (full_gather_ops proves it)."""
-    out = {}
-    for op in ("collective-permute", "all-gather", "all-reduce",
-               "all-to-all"):
-        c = hlo_text.count(f" {op}(") + hlo_text.count(f" {op}-start(")
-        if c:
-            out[op] = c
-    return out
+    """Shim over the framework census (promoted to
+    consul_tpu/parallel/hlo_audit.py by ISSUE 20 — ONE implementation
+    of each compiled-program rule); kept for callers of this module."""
+    from consul_tpu.parallel import hlo_audit
+    return hlo_audit.collective_census(hlo_text)
 
 
 def main_sharded(n: int, reps: int, n_devices: int) -> None:
@@ -150,13 +144,8 @@ def main_sharded(n: int, reps: int, n_devices: int) -> None:
             passes timeit_chain, which rebinds the consumed carry)."""
             compiled, stats = compile_with_stats(jfn, *args)
             if compiled is not None:
-                hlo = compiled.as_text()
-                bad = meshlib.full_gather_ops(hlo, n)
-                assert not bad, (
-                    f"{name}: {len(bad)} all-gather(s) of full "
-                    f"node-axis buffers — first: {bad[0][:200]}")
-                stats["collectives"] = count_collectives(hlo)
-                stats["full_node_gathers"] = 0
+                from consul_tpu.parallel import hlo_audit
+                stats.update(hlo_audit.audit_compiled(compiled, n, name))
             fn = compiled if compiled is not None else jfn
             t = (timer or (lambda f, *a: timeit(f, *a, reps=reps)))(
                 fn, *args)

@@ -97,22 +97,18 @@ def sweep(n: int, mesh=None, ticks: int = 250) -> dict:
     victim = n // 3
     if mesh is not None:
         # AOT view of the exact sharded program: per-device cost table
-        # + the no-full-gather audit (profile_swim --devices gives the
-        # per-pass breakdown).  This is a second compile of the same
-        # program — the dispatch-path cache below still must stay at 1.
+        # + the no-full-gather audit, both via the hlo_audit framework
+        # (profile_swim --devices gives the per-pass breakdown).  This
+        # is a second compile of the same program — the dispatch-path
+        # cache below still must stay at 1 (measured as growth).
+        from consul_tpu.parallel import hlo_audit
         compiled = run.lower(params, s, ticks, victim).compile()
-        bad = meshlib.full_gather_ops(compiled.as_text(), n)
-        assert not bad, (
-            f"{len(bad)} all-gather(s) of full node-axis buffers in "
-            f"the sharded scan — first: {bad[0][:200]}")
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        ca = ca or {}
+        hlo_audit.audit_compiled(compiled, n, "sharded scan")
+        stats = hlo_audit.compiled_stats(compiled)
         for k_out, k_in in (("hlo_flops_per_device", "flops"),
-                            ("hlo_bytes_per_device", "bytes accessed")):
-            if ca.get(k_in) is not None:
-                hlo[k_out] = float(ca[k_in])
+                            ("hlo_bytes_per_device", "bytes_accessed")):
+            if stats.get(k_in) is not None:
+                hlo[k_out] = float(stats[k_in])
         del compiled
     # ONE compiled shape for warm/timed/converge; a local profiler
     # stamps each pass's EMA into the row (the bench artifacts' new
@@ -143,11 +139,10 @@ def sweep(n: int, mesh=None, ticks: int = 250) -> dict:
     if mesh is not None:
         meshlib.assert_node_sharded(s.swim.know, n_devices,
                                     "knowledge matrix (full scan)")
-    compiles = int(run._cache_size()) if hasattr(run, "_cache_size") \
-        else None
+    from consul_tpu.parallel import hlo_audit
+    compiles = hlo_audit.cache_size(run)
     prof.note_cache_size("serf.run", compiles)
-    assert compiles in (None, 1), \
-        f"sharded scan compiled {compiles}x (expected exactly 1)"
+    hlo_audit.assert_single_compile(compiles, "sharded scan")
     conv_tick = int(np.argmax(fr > 0.999)) + 1 if (fr > 0.999).any() \
         else -1
     # the scan always runs the full `ticks`; time-to-convergence is the
@@ -286,10 +281,9 @@ def _dc_point(devs, d: int, nodes_per_dc: int, servers_per_dc: int,
             conv_tick = elapsed
             break
     wall = time.perf_counter() - t0
-    compiles = int(fed_run._cache_size()) \
-        if hasattr(fed_run, "_cache_size") else None
-    assert compiles in (None, 1), \
-        f"dc sweep compiled {compiles}x (expected exactly 1)"
+    from consul_tpu.parallel import hlo_audit
+    compiles = hlo_audit.cache_size(fed_run)
+    hlo_audit.assert_single_compile(compiles, "dc sweep")
     return {"n_dcs": d, "nodes_per_dc": nodes_per_dc,
             "servers_per_dc": servers_per_dc,
             "wan_pool": d * servers_per_dc,
